@@ -1,0 +1,22 @@
+"""ceph_tpu — a TPU-native storage-data-path framework.
+
+A ground-up re-architecture of Ceph's capability surface (reference:
+wannabe1991/ceph, Ceph Pacific) with the compute-heavy data-path math executed
+as batched tensor kernels on TPU via JAX/XLA/Pallas:
+
+- Erasure coding: Reed-Solomon / Cauchy GF(2^8) codes as GF(2) bit-matrix
+  matmuls on the MXU (reference seam: src/erasure-code/ErasureCodeInterface.h).
+- CRUSH placement: rjenkins hash + straw2 selection as vmapped int32 kernels
+  (reference seam: src/crush/mapper.c crush_do_rule).
+- Checksums: batched crc32c / xxhash (reference seam: src/common/Checksummer.h).
+- Compression candidate scoring on TPU behind a Compressor plugin registry
+  (reference seam: src/compressor/Compressor.h).
+
+The control plane (object store, placement maps, RADOS-lite daemons) is host
+Python/C++ — orchestration stays off the accelerator, math goes on it.
+"""
+
+__version__ = "16.0.0-tpu.1"
+
+# Release codename mirrors the reference's src/ceph_release scheme.
+CEPH_RELEASE_NAME = "pacific-tpu"

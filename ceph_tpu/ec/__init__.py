@@ -1,0 +1,4 @@
+"""Erasure-code framework: interface, codecs, plugin registry."""
+
+from ceph_tpu.ec.interface import ErasureCode, ErasureCodeProfile  # noqa: F401
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry  # noqa: F401

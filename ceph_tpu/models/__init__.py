@@ -1,0 +1,8 @@
+"""Codec families — the framework's "model zoo".
+
+Each module constructs the generator matrices / layouts for one erasure-code
+family (the analog of the reference's plugin techniques under
+src/erasure-code/): Reed-Solomon (Vandermonde, RAID6), Cauchy, LRC, SHEC,
+CLAY.  Construction is host-side integer math; execution is
+ceph_tpu.ops.gf on TPU.
+"""

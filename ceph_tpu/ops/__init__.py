@@ -1,0 +1,1 @@
+"""Tensor kernels: GF(2^8) algebra, hashes, checksums."""

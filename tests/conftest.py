@@ -1,0 +1,15 @@
+"""Test config: run JAX on a virtual 8-device CPU mesh.
+
+Real-TPU behavior is validated by bench.py and the driver's
+__graft_entry__.py compile checks; unit tests must be hermetic and fast, so
+they force the CPU backend with 8 virtual devices to exercise the same
+sharding code paths the multi-chip mesh uses.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

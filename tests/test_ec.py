@@ -1,0 +1,200 @@
+"""Erasure-code framework tests.
+
+Modeled on the reference's typed sweeps
+(/root/reference/src/test/erasure-code/TestErasureCodeJerasure.cc): per
+technique — encode/decode roundtrip, erasure recovery, minimum_to_decode,
+padding/alignment, chunk mapping; plus matrix-construction properties
+(systematic MDS, jerasure row-k-ones invariant) and the registry contract.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.interface import ErasureCodeError
+from ceph_tpu.ec.jax_plugin import ErasureCodeJax
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry, create_erasure_code
+from ceph_tpu.models import reed_solomon as rs
+from ceph_tpu.ops import gf
+
+TECHNIQUES = ["reed_sol_van", "reed_sol_r6_op", "cauchy_orig", "cauchy_good"]
+
+
+def make(technique, k, m, **extra):
+    codec = ErasureCodeJax(technique)
+    profile = {"k": str(k), "m": str(m)}
+    profile.update({key: str(v) for key, v in extra.items()})
+    codec.init(profile)
+    return codec
+
+
+# -- matrix constructions -------------------------------------------------
+
+
+def test_vandermonde_first_coding_row_all_ones():
+    # jerasure decodes reed_sol_van with row_k_ones=1: row k is the XOR row.
+    for k, m in [(2, 1), (4, 2), (8, 3), (10, 4)]:
+        mat = rs.reed_sol_van_matrix(k, m)
+        assert np.all(mat[0] == 1), (k, m)
+
+
+def test_vandermonde_mds_property():
+    # every k x k submatrix of [I; C] must be invertible
+    import itertools
+
+    k, m = 4, 3
+    mat = rs.reed_sol_van_matrix(k, m)
+    gen = np.concatenate([np.eye(k, dtype=np.uint8), mat])
+    for rows in itertools.combinations(range(k + m), k):
+        sub = gen[list(rows)]
+        gf.gf_invert_matrix(sub)  # raises if singular
+
+
+def test_cauchy_mds_property():
+    import itertools
+
+    k, m = 5, 3
+    for build in (rs.cauchy_orig_matrix, rs.cauchy_good_matrix):
+        mat = build(k, m)
+        gen = np.concatenate([np.eye(k, dtype=np.uint8), mat])
+        for rows in itertools.combinations(range(k + m), k):
+            gf.gf_invert_matrix(gen[list(rows)])
+
+
+def test_r6_matrix_shape():
+    mat = rs.reed_sol_r6_matrix(5)
+    assert np.all(mat[0] == 1)
+    assert list(mat[1]) == [1, 2, 4, 8, 16]
+
+
+# -- roundtrip sweeps (the TestErasureCodeJerasure pattern) ---------------
+
+
+@pytest.mark.parametrize("technique", TECHNIQUES)
+def test_encode_decode_roundtrip(technique):
+    k, m = (4, 2)
+    codec = make(technique, k, m)
+    rng = np.random.default_rng(42)
+    data = rng.integers(0, 256, 4000, dtype=np.uint8).tobytes()  # forces padding
+    want = set(range(k + m))
+    encoded = codec.encode(want, data)
+    assert len(encoded) == k + m
+    chunk_size = codec.get_chunk_size(len(data))
+    assert all(len(c) == chunk_size for c in encoded.values())
+
+    # no erasure
+    decoded = codec.decode(set(range(k)), encoded)
+    assert codec.decode_concat(encoded)[: len(data)] == data
+
+    # every single and double erasure
+    import itertools
+
+    for lost in itertools.chain(
+            itertools.combinations(range(k + m), 1),
+            itertools.combinations(range(k + m), 2)):
+        degraded = {i: c for i, c in encoded.items() if i not in lost}
+        decoded = codec.decode(set(lost) | set(range(k)), degraded)
+        for i in range(k):
+            assert decoded[i] == encoded[i], (technique, lost, i)
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (3, 2), (8, 3), (10, 4)])
+def test_roundtrip_shapes_reed_sol(k, m):
+    codec = make("reed_sol_van", k, m)
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, 1 << 14, dtype=np.uint8).tobytes()
+    encoded = codec.encode(set(range(k + m)), data)
+    lost = (0, k)  # one data, one coding
+    degraded = {i: c for i, c in encoded.items() if i not in lost[:m]}
+    assert codec.decode_concat(degraded)[: len(data)] == data
+
+
+def test_minimum_to_decode():
+    codec = make("reed_sol_van", 4, 2)
+    # want available -> itself
+    mini = codec.minimum_to_decode({0, 1}, {0, 1, 2, 3, 4, 5})
+    assert set(mini) == {0, 1}
+    # want missing -> first k available
+    mini = codec.minimum_to_decode({0}, {1, 2, 3, 4, 5})
+    assert set(mini) == {1, 2, 3, 4}
+    with pytest.raises(ErasureCodeError):
+        codec.minimum_to_decode({0}, {1, 2, 3})
+
+
+def test_chunk_size_alignment_matches_reference_formula():
+    # reed_sol_van w=8: alignment = k*w*4 = 32k (ErasureCodeJerasure.cc:173-184)
+    codec = make("reed_sol_van", 4, 2)
+    assert codec.get_alignment() == 4 * 8 * 4
+    # object 4000 -> padded to 4096 -> chunk 1024
+    assert codec.get_chunk_size(4000) == 1024
+    codec2 = make("reed_sol_van", 4, 2, **{"jerasure-per-chunk-alignment": "true"})
+    # per-chunk: ceil(4000/4)=1000 -> pad to w*16=128 multiple -> 1024
+    assert codec2.get_chunk_size(4000) == 1024
+
+
+def test_chunk_mapping():
+    codec = make("reed_sol_van", 2, 1, mapping="_DD")
+    assert codec.get_chunk_mapping() == [1, 2, 0]
+    data = bytes(range(128))
+    encoded = codec.encode({0, 1, 2}, data)
+    # data chunks live at positions 1 and 2, parity at 0
+    assert encoded[1] + encoded[2] == data
+    degraded = {i: c for i, c in encoded.items() if i != 1}
+    assert codec.decode_concat(degraded)[: len(data)] == data
+
+
+def test_padding_all_zero_tail_chunks():
+    # tiny object: chunks beyond the data are pure padding
+    k, m = 4, 2
+    codec = make("reed_sol_van", k, m)
+    data = b"x" * 10
+    encoded = codec.encode(set(range(k + m)), data)
+    cs = codec.get_chunk_size(10)
+    assert encoded[0][:10] == data[: cs][:10]
+    for i in range(1, k):
+        assert encoded[i] == b"\0" * cs
+    assert codec.decode_concat(encoded)[:10] == data
+
+
+# -- registry contract ----------------------------------------------------
+
+
+def test_registry_factory_and_aliases():
+    for plugin in ("ec_jax", "jerasure", "isa"):
+        codec = create_erasure_code(
+            {"plugin": plugin, "technique": "reed_sol_van", "k": "2", "m": "2"})
+        assert codec.get_chunk_count() == 4
+
+
+def test_registry_default_profile():
+    # osd_pool_default_erasure_code_profile (options.cc:2703)
+    codec = create_erasure_code(
+        {"plugin": "jerasure", "technique": "reed_sol_van", "k": "2", "m": "2"})
+    data = bytes(range(256)) * 8
+    encoded = codec.encode({0, 1, 2, 3}, data)
+    degraded = {i: c for i, c in encoded.items() if i not in (0, 1)}
+    assert codec.decode_concat(degraded)[: len(data)] == data
+
+
+def test_registry_load_errors():
+    reg = ErasureCodePluginRegistry.instance()
+    with pytest.raises(ErasureCodeError) as e:
+        reg.load("no_such_plugin_xyz")
+    assert e.value.errno == 2  # ENOENT
+
+
+def test_profile_echo():
+    codec = create_erasure_code(
+        {"plugin": "ec_jax", "k": "4", "m": "2", "technique": "reed_sol_van"})
+    prof = codec.get_profile()
+    assert prof["k"] == "4" and prof["technique"] == "reed_sol_van"
+
+
+def test_decode_table_cache():
+    codec = make("reed_sol_van", 4, 2)
+    data = bytes(range(256)) * 2
+    encoded = codec.encode(set(range(6)), data)
+    degraded = {i: c for i, c in encoded.items() if i != 0}
+    codec.decode({0}, degraded)
+    assert len(codec._decode_cache) == 1
+    codec.decode({0}, degraded)
+    assert len(codec._decode_cache) == 1  # cache hit, not regrown
