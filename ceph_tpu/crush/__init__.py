@@ -1,0 +1,4 @@
+"""CRUSH placement: map model, exact host mapper, vmapped TPU kernel."""
+
+from ceph_tpu.crush.map import CrushMap, Bucket, Rule  # noqa: F401
+from ceph_tpu.crush.mapper import crush_do_rule  # noqa: F401

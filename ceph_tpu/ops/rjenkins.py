@@ -1,0 +1,101 @@
+"""Robert Jenkins' 32-bit mix hash — CRUSH's hash family, as tensor kernels.
+
+Reference: /root/reference/src/crush/hash.c (crush_hash32_rjenkins1_{1..5},
+seed 1315423911).  The algorithm is Jenkins' public-domain evahash mix.  Two
+implementations with identical results:
+
+- numpy (uint32 wraparound) for the exact host mapper;
+- jax (uint32) for the vmapped bulk-placement kernel — every op is
+  elementwise int32-lane work, so millions of inputs hash in one dispatch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+CRUSH_HASH_SEED = np.uint32(1315423911)
+CRUSH_HASH_RJENKINS1 = 0
+
+
+def _mix(a, b, c, xp):
+    """One Jenkins mix round; xp is the array namespace (numpy or jax.numpy).
+
+    uint32 wraparound is the defined behavior; the errstate guard silences
+    numpy's overflow warnings for 0-d operands (no-op under jax).
+    """
+    u32 = lambda v: v.astype(xp.uint32) if hasattr(v, "astype") else xp.uint32(v)
+    a, b, c = u32(a), u32(b), u32(c)
+    with np.errstate(over="ignore"):
+        a = a - b; a = a - c; a = a ^ (c >> 13)
+        b = b - c; b = b - a; b = b ^ (a << 8)
+        c = c - a; c = c - b; c = c ^ (b >> 13)
+        a = a - b; a = a - c; a = a ^ (c >> 12)
+        b = b - c; b = b - a; b = b ^ (a << 16)
+        c = c - a; c = c - b; c = c ^ (b >> 5)
+        a = a - b; a = a - c; a = a ^ (c >> 3)
+        b = b - c; b = b - a; b = b ^ (a << 10)
+        c = c - a; c = c - b; c = c ^ (b >> 15)
+    return a, b, c
+
+
+def _as_u32(xp, *vals):
+    return tuple(xp.asarray(v).astype(xp.uint32) for v in vals)
+
+
+def hash32(a, xp=np):
+    (a,) = _as_u32(xp, a)
+    h = CRUSH_HASH_SEED ^ a
+    b, x, y = a, xp.uint32(231232), xp.uint32(1232)
+    b, x, h = _mix(b, x, h, xp)
+    y, a, h = _mix(y, a, h, xp)
+    return h
+
+
+def hash32_2(a, b, xp=np):
+    a, b = _as_u32(xp, a, b)
+    h = CRUSH_HASH_SEED ^ a ^ b
+    x, y = xp.uint32(231232), xp.uint32(1232)
+    a, b, h = _mix(a, b, h, xp)
+    x, a, h = _mix(x, a, h, xp)
+    b, y, h = _mix(b, y, h, xp)
+    return h
+
+
+def hash32_3(a, b, c, xp=np):
+    a, b, c = _as_u32(xp, a, b, c)
+    h = CRUSH_HASH_SEED ^ a ^ b ^ c
+    x, y = xp.uint32(231232), xp.uint32(1232)
+    a, b, h = _mix(a, b, h, xp)
+    c, x, h = _mix(c, x, h, xp)
+    y, a, h = _mix(y, a, h, xp)
+    b, x, h = _mix(b, x, h, xp)
+    y, c, h = _mix(y, c, h, xp)
+    return h
+
+
+def hash32_4(a, b, c, d, xp=np):
+    a, b, c, d = _as_u32(xp, a, b, c, d)
+    h = CRUSH_HASH_SEED ^ a ^ b ^ c ^ d
+    x, y = xp.uint32(231232), xp.uint32(1232)
+    a, b, h = _mix(a, b, h, xp)
+    c, d, h = _mix(c, d, h, xp)
+    a, x, h = _mix(a, x, h, xp)
+    y, b, h = _mix(y, b, h, xp)
+    c, x, h = _mix(c, x, h, xp)
+    y, d, h = _mix(y, d, h, xp)
+    return h
+
+
+def hash32_5(a, b, c, d, e, xp=np):
+    a, b, c, d, e = _as_u32(xp, a, b, c, d, e)
+    h = CRUSH_HASH_SEED ^ a ^ b ^ c ^ d ^ e
+    x, y = xp.uint32(231232), xp.uint32(1232)
+    a, b, h = _mix(a, b, h, xp)
+    c, d, h = _mix(c, d, h, xp)
+    e, x, h = _mix(e, x, h, xp)
+    y, a, h = _mix(y, a, h, xp)
+    b, x, h = _mix(b, x, h, xp)
+    y, c, h = _mix(y, c, h, xp)
+    d, x, h = _mix(d, x, h, xp)
+    y, e, h = _mix(y, e, h, xp)
+    return h

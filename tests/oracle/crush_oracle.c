@@ -1,0 +1,100 @@
+/* Test-only oracle shim: builds a CRUSH map with the *reference's own*
+ * builder/mapper C code (compiled from /root/reference at test time, never
+ * vendored into this repo) and exposes crush_do_rule through a flat C ABI
+ * for ctypes.  Used by test_crush_oracle.py to assert placement diff = 0
+ * between ceph_tpu.crush and the reference kernel.  This file contains only
+ * original shim code. */
+
+#include <stdlib.h>
+#include <string.h>
+
+#include "crush/crush.h"
+#include "crush/hash.h"
+#include "crush/builder.h"
+#include "crush/mapper.h"
+
+struct oracle {
+    struct crush_map *map;
+};
+
+void *oracle_create(void) {
+    struct oracle *o = calloc(1, sizeof(*o));
+    o->map = crush_create();
+    /* modern tunables, matching ceph_tpu.crush.map defaults */
+    o->map->choose_local_tries = 0;
+    o->map->choose_local_fallback_tries = 0;
+    o->map->choose_total_tries = 50;
+    o->map->chooseleaf_descend_once = 1;
+    o->map->chooseleaf_vary_r = 1;
+    o->map->chooseleaf_stable = 1;
+    return o;
+}
+
+/* alg: 1=uniform 2=list 3=tree 4=straw 5=straw2; returns bucket id (<0) */
+int oracle_add_bucket(void *vo, int alg, int type, int size,
+                      const int *items, const int *weights) {
+    struct oracle *o = vo;
+    struct crush_bucket *b = crush_make_bucket(
+        o->map, alg, CRUSH_HASH_RJENKINS1, type, size,
+        (int *)items, (int *)weights);
+    int id = 0;
+    if (!b)
+        return 1;  /* invalid (positive) to signal failure */
+    if (crush_add_bucket(o->map, 0, b, &id) < 0)
+        return 1;
+    return id;
+}
+
+int oracle_add_rule(void *vo, int len, int type,
+                    const int *ops, const int *arg1s, const int *arg2s) {
+    struct oracle *o = vo;
+    struct crush_rule *r = crush_make_rule(len, 0, type, 1, 10);
+    int i;
+    if (!r)
+        return -1;
+    for (i = 0; i < len; i++)
+        crush_rule_set_step(r, i, ops[i], arg1s[i], arg2s[i]);
+    return crush_add_rule(o->map, r, -1);
+}
+
+void oracle_set_max_devices(void *vo, int n) {
+    struct oracle *o = vo;
+    o->map->max_devices = n;
+}
+
+void oracle_set_tunables(void *vo, int total_tries, int local_tries,
+                         int local_fallback, int descend_once, int vary_r,
+                         int stable) {
+    struct oracle *o = vo;
+    o->map->choose_total_tries = total_tries;
+    o->map->choose_local_tries = local_tries;
+    o->map->choose_local_fallback_tries = local_fallback;
+    o->map->chooseleaf_descend_once = descend_once;
+    o->map->chooseleaf_vary_r = vary_r;
+    o->map->chooseleaf_stable = stable;
+}
+
+void oracle_finalize(void *vo) {
+    struct oracle *o = vo;
+    crush_finalize(o->map);
+}
+
+/* returns result length; result must hold result_max ints */
+int oracle_do_rule(void *vo, int ruleno, int x, int *result, int result_max,
+                   const unsigned *weight, int weight_max) {
+    struct oracle *o = vo;
+    int scratch_len = result_max * 3;
+    void *cwin = malloc(o->map->working_size + scratch_len * sizeof(int));
+    int n;
+    crush_init_workspace(o->map, cwin);
+    n = crush_do_rule(o->map, ruleno, x, result, result_max,
+                      weight, weight_max, cwin, NULL);
+    free(cwin);
+    return n;
+}
+
+void oracle_destroy(void *vo) {
+    struct oracle *o = vo;
+    crush_destroy(o->map);
+    free(o);
+}

@@ -1,0 +1,234 @@
+"""Placement-parity tests against the reference's own C mapper.
+
+Compiles the reference CRUSH core (mapper.c/hash.c/builder.c/crush.c, plain
+dependency-free C) from /root/reference into a throwaway shared library at
+test time and asserts `placement diff = 0` between ceph_tpu.crush.mapper and
+the real crush_do_rule across random hierarchies, inputs, and weight
+vectors.  Skipped when the reference tree or a C compiler is unavailable —
+the in-repo tests (test_crush.py) then still cover mapper-vs-kernel parity.
+"""
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+import pytest
+
+REF = "/root/reference/src/crush"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference tree not available")
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    tmp = tempfile.mkdtemp(prefix="crush_oracle_")
+    so = os.path.join(tmp, "liboracle.so")
+    # the reference expects a cmake-generated acconfig.h; an empty one makes
+    # int_types.h fall back to the portable typedefs
+    with open(os.path.join(tmp, "acconfig.h"), "w"):
+        pass
+    src = os.path.join(os.path.dirname(__file__), "oracle", "crush_oracle.c")
+    cmd = ["gcc", "-O2", "-fPIC", "-shared", "-o", so, src,
+           os.path.join(REF, "mapper.c"), os.path.join(REF, "hash.c"),
+           os.path.join(REF, "builder.c"), os.path.join(REF, "crush.c"),
+           "-I", tmp, "-I", os.path.dirname(REF), "-I", REF]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        pytest.skip(f"cannot build oracle: {e}")
+    lib = ctypes.CDLL(so)
+    lib.oracle_create.restype = ctypes.c_void_p
+    lib.oracle_add_bucket.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+    lib.oracle_add_rule.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.oracle_do_rule.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint), ctypes.c_int]
+    lib.oracle_destroy.argtypes = [ctypes.c_void_p]
+    lib.oracle_set_max_devices.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.oracle_set_tunables.argtypes = [ctypes.c_void_p] + [ctypes.c_int] * 6
+    lib.oracle_finalize.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _carr(vals):
+    return (ctypes.c_int * len(vals))(*vals)
+
+
+def build_both(lib, cmap):
+    """Replicate a ceph_tpu CrushMap into the oracle. Bucket ids must have
+    been allocated contiguously (-1, -2, ...) in insertion order."""
+    o = lib.oracle_create(None)
+    o = ctypes.c_void_p(o)
+    for bid in sorted(cmap.buckets, reverse=True):
+        b = cmap.buckets[bid]
+        got = lib.oracle_add_bucket(o, b.alg, b.type, b.size,
+                                    _carr(b.items), _carr(b.weights))
+        assert got == bid, (got, bid)
+    lib.oracle_set_max_devices(o, cmap.max_devices)
+    for rule in cmap.rules:
+        ops = _carr([s.op for s in rule.steps])
+        a1 = _carr([s.arg1 for s in rule.steps])
+        a2 = _carr([s.arg2 for s in rule.steps])
+        lib.oracle_add_rule(o, len(rule.steps), rule.rule_type, ops, a1, a2)
+    lib.oracle_set_tunables(
+        o, cmap.choose_total_tries, cmap.choose_local_tries,
+        cmap.choose_local_fallback_tries, cmap.chooseleaf_descend_once,
+        cmap.chooseleaf_vary_r, cmap.chooseleaf_stable)
+    lib.oracle_finalize(o)
+    return o
+
+
+def oracle_do_rule(lib, o, ruleno, x, result_max, weights):
+    res = (ctypes.c_int * result_max)()
+    warr = (ctypes.c_uint * len(weights))(*weights)
+    n = lib.oracle_do_rule(o, ruleno, x, res, result_max, warr, len(weights))
+    return list(res[:n])
+
+
+def _compare(lib, cmap, ruleno, xs, result_max, weights=None):
+    from ceph_tpu.crush.mapper import crush_do_rule
+
+    o = build_both(lib, cmap)
+    w = weights or cmap.full_weight_vector()
+    diff = 0
+    try:
+        for x in xs:
+            ref = oracle_do_rule(lib, o, ruleno, x, result_max, w)
+            got = crush_do_rule(cmap, ruleno, x, result_max, w)
+            if ref != got:
+                diff += 1
+                if diff <= 3:
+                    print(f"x={x}: ref={ref} got={got}")
+    finally:
+        lib.oracle_destroy(o)
+    assert diff == 0
+
+
+def test_flat_hierarchy_replicated_firstn(oracle):
+    from ceph_tpu.crush.map import build_flat_cluster
+
+    cmap = build_flat_cluster(64, osds_per_host=4)
+    cmap.add_simple_rule("data", "default", "host", mode="firstn")
+    _compare(oracle, cmap, 0, range(1024), 3)
+
+
+def test_rack_hierarchy_indep_ec(oracle):
+    from ceph_tpu.crush.map import build_flat_cluster
+
+    cmap = build_flat_cluster(96, osds_per_host=4, hosts_per_rack=4)
+    cmap.add_simple_rule("ecpool", "default", "host", mode="indep",
+                         pool_type="erasure")
+    _compare(oracle, cmap, 0, range(1024), 11)
+
+
+def test_choose_osd_direct(oracle):
+    # failure domain osd: CHOOSE_FIRSTN type 0
+    from ceph_tpu.crush.map import build_flat_cluster
+
+    cmap = build_flat_cluster(40, osds_per_host=40)  # one big bucket
+    cmap.add_simple_rule("flat", "default", "osd", mode="firstn")
+    _compare(oracle, cmap, 0, range(2048), 3)
+
+
+def test_reweighted_devices(oracle):
+    from ceph_tpu.crush.map import build_flat_cluster
+
+    rng = np.random.default_rng(9)
+    cmap = build_flat_cluster(64, osds_per_host=4)
+    cmap.add_simple_rule("data", "default", "host", mode="firstn")
+    # random in/out weights incl. fully-out and partial
+    w = [int(v) for v in rng.integers(0, 0x10001, 64)]
+    _compare(oracle, cmap, 0, range(1024), 3, weights=w)
+
+
+def test_uneven_bucket_weights(oracle):
+    from ceph_tpu.crush.map import CrushMap
+
+    rng = np.random.default_rng(11)
+    cmap = CrushMap()
+    root = cmap.add_bucket(-1, cmap.type_id("root"), "default")
+    dev = 0
+    for h in range(8):
+        host = cmap.add_bucket(None, cmap.type_id("host"), f"host{h}")
+        for _ in range(int(rng.integers(1, 6))):
+            cmap.add_device(dev)
+            host.add_item(dev, int(rng.integers(1, 4)) * 0x8000)
+            dev += 1
+        root.add_item(host.id, host.weight)
+    cmap.add_simple_rule("data", "default", "host", mode="firstn")
+    cmap.add_simple_rule("ec", "default", "host", mode="indep",
+                         pool_type="erasure")
+    _compare(oracle, cmap, 0, range(1024), 3)
+    _compare(oracle, cmap, 1, range(1024), 6)
+
+
+def test_multi_step_rule(oracle):
+    # TAKE root / CHOOSE 2 racks / CHOOSELEAF 2 per rack / EMIT
+    from ceph_tpu.crush.map import (
+        CRUSH_RULE_CHOOSELEAF_FIRSTN, CRUSH_RULE_CHOOSE_FIRSTN,
+        CRUSH_RULE_EMIT, CRUSH_RULE_TAKE, Rule, RuleStep, build_flat_cluster)
+
+    cmap = build_flat_cluster(96, osds_per_host=4, hosts_per_rack=4)
+    rack_t = cmap.type_id("rack")
+    host_t = cmap.type_id("host")
+    cmap.add_rule(Rule("spread", [
+        RuleStep(CRUSH_RULE_TAKE, cmap.name_to_item("default")),
+        RuleStep(CRUSH_RULE_CHOOSE_FIRSTN, 2, rack_t),
+        RuleStep(CRUSH_RULE_CHOOSELEAF_FIRSTN, 2, host_t),
+        RuleStep(CRUSH_RULE_EMIT),
+    ]))
+    _compare(oracle, cmap, 0, range(1024), 4)
+
+
+def test_legacy_tunables(oracle):
+    from ceph_tpu.crush.map import build_flat_cluster
+
+    cmap = build_flat_cluster(48, osds_per_host=4)
+    cmap.choose_local_tries = 2
+    cmap.choose_local_fallback_tries = 5
+    cmap.chooseleaf_vary_r = 0
+    cmap.chooseleaf_stable = 0
+    cmap.chooseleaf_descend_once = 0
+    cmap.add_simple_rule("data", "default", "host", mode="firstn")
+    _compare(oracle, cmap, 0, range(512), 3)
+
+
+def test_uniform_and_list_buckets(oracle):
+    from ceph_tpu.crush.map import (
+        CRUSH_BUCKET_LIST, CRUSH_BUCKET_UNIFORM, CrushMap)
+
+    for alg in (CRUSH_BUCKET_UNIFORM, CRUSH_BUCKET_LIST):
+        cmap = CrushMap()
+        root = cmap.add_bucket(-1, cmap.type_id("root"), "default")
+        dev = 0
+        for h in range(6):
+            host = cmap.add_bucket(None, cmap.type_id("host"), f"host{h}",
+                                   alg=alg)
+            for _ in range(4):
+                cmap.add_device(dev)
+                host.add_item(dev, 0x10000)
+                dev += 1
+            root.add_item(host.id, host.weight)
+        cmap.add_simple_rule("data", "default", "host", mode="firstn")
+        _compare(oracle, cmap, 0, range(512), 3)
+
+
+def test_10k_osd_map_spot(oracle):
+    # BASELINE config #4 shape: 10k OSDs; spot-check a slice of inputs
+    from ceph_tpu.crush.map import build_flat_cluster
+
+    cmap = build_flat_cluster(10000, osds_per_host=20, hosts_per_rack=10)
+    cmap.add_simple_rule("data", "default", "host", mode="firstn")
+    cmap.add_simple_rule("ec", "default", "host", mode="indep",
+                         pool_type="erasure")
+    _compare(oracle, cmap, 0, range(64), 3)
+    _compare(oracle, cmap, 1, range(64), 11)
