@@ -1,0 +1,1 @@
+"""Common runtime layer (the reference's src/common analog)."""

@@ -1,0 +1,110 @@
+"""Native (C++) runtime for ceph_tpu — build-on-demand ctypes bindings.
+
+The reference ships its host-side hot loops as C/C++/asm (crc32c asm,
+xxHash, jerasure/isa-l region ops).  ceph_tpu keeps the same split: bulk
+data-path math runs on TPU via JAX, while the host runtime (checksums for
+metadata, GF region fallback, per-block csum loops) is native C++ compiled
+here with g++ at first import and loaded through ctypes.
+
+Sources live in ceph_tpu/native/src/; the shared object is cached next to
+them keyed by a source hash, so rebuilds happen only when sources change.
+If no compiler is available the pure-python fallbacks in ceph_tpu.ops keep
+everything functional (slower).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "build")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_lock = threading.Lock()
+_build_error: Optional[str] = None
+
+
+def _sources():
+    return sorted(
+        os.path.join(_SRC_DIR, f)
+        for f in os.listdir(_SRC_DIR)
+        if f.endswith(".cc")
+    )
+
+
+def _source_hash() -> str:
+    h = hashlib.sha256()
+    for path in _sources():
+        with open(path, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _build() -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    so_path = os.path.join(_BUILD_DIR, f"libceph_tpu_native-{_source_hash()}.so")
+    if os.path.exists(so_path):
+        return so_path
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        "-o", so_path + ".tmp", *_sources(),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(so_path + ".tmp", so_path)
+    return so_path
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    u32, u64 = ctypes.c_uint32, ctypes.c_uint64
+
+    lib.ceph_tpu_crc32c.restype = u32
+    lib.ceph_tpu_crc32c.argtypes = [u32, u8p, u64]
+    lib.ceph_tpu_crc32c_zeros.restype = u32
+    lib.ceph_tpu_crc32c_zeros.argtypes = [u32, u64]
+    lib.ceph_tpu_crc32c_combine.restype = u32
+    lib.ceph_tpu_crc32c_combine.argtypes = [u32, u32, u64]
+    lib.ceph_tpu_crc32c_blocks.restype = None
+    lib.ceph_tpu_crc32c_blocks.argtypes = [u8p, u64, u64, u32, u32p]
+    lib.ceph_tpu_xxh32.restype = u32
+    lib.ceph_tpu_xxh32.argtypes = [u8p, u64, u32]
+    lib.ceph_tpu_xxh64.restype = u64
+    lib.ceph_tpu_xxh64.argtypes = [u8p, u64, u64]
+    lib.ceph_tpu_xxh32_blocks.restype = None
+    lib.ceph_tpu_xxh32_blocks.argtypes = [u8p, u64, u64, u32, u32p]
+    lib.ceph_tpu_xxh64_blocks.restype = None
+    lib.ceph_tpu_xxh64_blocks.argtypes = [u8p, u64, u64, u64, u64p]
+    lib.ceph_tpu_region_xor.restype = None
+    lib.ceph_tpu_region_xor.argtypes = [u8p, u8p, u64]
+    lib.ceph_tpu_region_mad.restype = None
+    lib.ceph_tpu_region_mad.argtypes = [u8p, u8p, u64, u8p]
+    lib.ceph_tpu_gf_matmul.restype = None
+    lib.ceph_tpu_gf_matmul.argtypes = [u8p, u64, u64, u8p, u64, u8p]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first call; None if unbuildable."""
+    global _lib, _build_error
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            _lib = _bind(ctypes.CDLL(_build()))
+        except Exception as e:  # pragma: no cover - only on broken toolchain
+            _build_error = str(e)
+            _lib = None
+    return _lib
+
+
+def build_error() -> Optional[str]:
+    return _build_error

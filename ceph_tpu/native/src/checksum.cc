@@ -1,0 +1,289 @@
+// Host-side checksum & GF(2^8) region kernels for ceph_tpu.
+//
+// Capability parity with the reference's native checksum layer:
+//   - crc32c (Castagnoli): /root/reference/src/include/crc32c.h:43-50 —
+//     ceph_crc32c(seed, data, len) with NO pre/post inversion; data==NULL
+//     means "len zero bytes".
+//   - ceph_crc32c_zeros: /root/reference/src/common/crc32c.cc:216-239 —
+//     O(log len) advance of a crc through a run of zeros.  The reference
+//     uses a precomputed 32x32 "turbo" table per power-of-two range; here
+//     the same math is GF(2) 32x32 matrix squaring computed at startup.
+//   - xxhash32/64: vendored xxHash in the reference (src/xxHash/); here a
+//     from-spec implementation (XXH32/XXH64, seedable).
+//   - GF(2^8) region multiply-accumulate: the scalar-fallback analog of
+//     isa-l/jerasure region ops (src/erasure-code/isa/xor_op.cc) used by the
+//     host (non-TPU) erasure-code path.
+//
+// The TPU path for bulk data lives in JAX/Pallas (ceph_tpu/ops); this file
+// is the low-latency host runtime for small buffers, metadata, and tests.
+//
+// Build: g++ -O3 -shared -fPIC (driven by ceph_tpu/native/__init__.py).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// crc32c (Castagnoli, reflected poly 0x82F63B78), slicing-by-8
+// ---------------------------------------------------------------------------
+
+static uint32_t crc_table[8][256];
+
+static void crc32c_init_tables() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+    crc_table[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = crc_table[0][i];
+    for (int t = 1; t < 8; t++) {
+      c = crc_table[0][c & 0xff] ^ (c >> 8);
+      crc_table[t][i] = c;
+    }
+  }
+}
+
+// GF(2) 32x32 matrices for zero-run folding (column b = image of bit b).
+static void gf2_matmul_vec(const uint32_t m[32], uint32_t *crc) {
+  uint32_t out = 0, v = *crc;
+  for (int b = 0; v; b++, v >>= 1)
+    if (v & 1) out ^= m[b];
+  *crc = out;
+}
+
+static void gf2_matmul_mat(const uint32_t a[32], const uint32_t b[32],
+                           uint32_t out[32]) {
+  for (int i = 0; i < 32; i++) {
+    uint32_t v = b[i];
+    gf2_matmul_vec(a, &v);
+    out[i] = v;
+  }
+}
+
+// zero_mat[r] advances a crc through 2^r zero bytes.
+static uint32_t zero_mat[64][32];
+
+static void crc32c_init_zero_mats() {
+  for (int b = 0; b < 32; b++) {  // one zero byte
+    uint32_t s = 1u << b;
+    zero_mat[0][b] = crc_table[0][s & 0xff] ^ (s >> 8);
+  }
+  for (int r = 1; r < 64; r++)
+    gf2_matmul_mat(zero_mat[r - 1], zero_mat[r - 1], zero_mat[r]);
+}
+
+uint32_t ceph_tpu_crc32c_zeros(uint32_t crc, uint64_t len) {
+  for (int r = 0; len; r++, len >>= 1)
+    if (len & 1) gf2_matmul_vec(zero_mat[r], &crc);
+  return crc;
+}
+
+uint32_t ceph_tpu_crc32c(uint32_t crc, const uint8_t *data, uint64_t len) {
+  if (data == nullptr) return ceph_tpu_crc32c_zeros(crc, len);
+  while (len && (reinterpret_cast<uintptr_t>(data) & 7)) {
+    crc = crc_table[0][(crc ^ *data++) & 0xff] ^ (crc >> 8);
+    len--;
+  }
+  while (len >= 8) {
+    uint64_t w;
+    std::memcpy(&w, data, 8);
+    w ^= crc;
+    crc = crc_table[7][w & 0xff] ^ crc_table[6][(w >> 8) & 0xff] ^
+          crc_table[5][(w >> 16) & 0xff] ^ crc_table[4][(w >> 24) & 0xff] ^
+          crc_table[3][(w >> 32) & 0xff] ^ crc_table[2][(w >> 40) & 0xff] ^
+          crc_table[1][(w >> 48) & 0xff] ^ crc_table[0][(w >> 56) & 0xff];
+    data += 8;
+    len -= 8;
+  }
+  while (len--) crc = crc_table[0][(crc ^ *data++) & 0xff] ^ (crc >> 8);
+  return crc;
+}
+
+// Per-block crc32c over a contiguous buffer of nblocks x block_size bytes
+// (the Checksummer inner loop; Checksummer.h calc() per csum_block_size).
+void ceph_tpu_crc32c_blocks(const uint8_t *data, uint64_t nblocks,
+                            uint64_t block_size, uint32_t init,
+                            uint32_t *out) {
+  for (uint64_t i = 0; i < nblocks; i++)
+    out[i] = ceph_tpu_crc32c(init, data + i * block_size, block_size);
+}
+
+// crc32c combine: crc(AB) from crc(A), crc(B), len(B)  (bufferlist-style
+// cached-crc composition, src/common/buffer.cc crc path).
+uint32_t ceph_tpu_crc32c_combine(uint32_t crc_a, uint32_t crc_b,
+                                 uint64_t len_b) {
+  return ceph_tpu_crc32c_zeros(crc_a, len_b) ^ crc_b;
+}
+
+// ---------------------------------------------------------------------------
+// xxHash32 / xxHash64 (from the public spec; seedable)
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+static inline uint64_t rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+static inline uint32_t read32(const uint8_t *p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+static inline uint64_t read64(const uint8_t *p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+static const uint32_t P32_1 = 2654435761u, P32_2 = 2246822519u,
+                      P32_3 = 3266489917u, P32_4 = 668265263u,
+                      P32_5 = 374761393u;
+
+uint32_t ceph_tpu_xxh32(const uint8_t *data, uint64_t len, uint32_t seed) {
+  const uint8_t *p = data, *end = data + len;
+  uint32_t h;
+  if (len >= 16) {
+    uint32_t v1 = seed + P32_1 + P32_2, v2 = seed + P32_2, v3 = seed,
+             v4 = seed - P32_1;
+    const uint8_t *limit = end - 16;
+    do {
+      v1 = rotl32(v1 + read32(p) * P32_2, 13) * P32_1; p += 4;
+      v2 = rotl32(v2 + read32(p) * P32_2, 13) * P32_1; p += 4;
+      v3 = rotl32(v3 + read32(p) * P32_2, 13) * P32_1; p += 4;
+      v4 = rotl32(v4 + read32(p) * P32_2, 13) * P32_1; p += 4;
+    } while (p <= limit);
+    h = rotl32(v1, 1) + rotl32(v2, 7) + rotl32(v3, 12) + rotl32(v4, 18);
+  } else {
+    h = seed + P32_5;
+  }
+  h += (uint32_t)len;
+  while (p + 4 <= end) {
+    h = rotl32(h + read32(p) * P32_3, 17) * P32_4;
+    p += 4;
+  }
+  while (p < end) {
+    h = rotl32(h + (*p) * P32_5, 11) * P32_1;
+    p++;
+  }
+  h ^= h >> 15; h *= P32_2; h ^= h >> 13; h *= P32_3; h ^= h >> 16;
+  return h;
+}
+
+static const uint64_t P64_1 = 11400714785074694791ull,
+                      P64_2 = 14029467366897019727ull,
+                      P64_3 = 1609587929392839161ull,
+                      P64_4 = 9650029242287828579ull,
+                      P64_5 = 2870177450012600261ull;
+
+static inline uint64_t xxh64_round(uint64_t acc, uint64_t input) {
+  return rotl64(acc + input * P64_2, 31) * P64_1;
+}
+static inline uint64_t xxh64_merge(uint64_t h, uint64_t v) {
+  h ^= xxh64_round(0, v);
+  return h * P64_1 + P64_4;
+}
+
+uint64_t ceph_tpu_xxh64(const uint8_t *data, uint64_t len, uint64_t seed) {
+  const uint8_t *p = data, *end = data + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + P64_1 + P64_2, v2 = seed + P64_2, v3 = seed,
+             v4 = seed - P64_1;
+    const uint8_t *limit = end - 32;
+    do {
+      v1 = xxh64_round(v1, read64(p)); p += 8;
+      v2 = xxh64_round(v2, read64(p)); p += 8;
+      v3 = xxh64_round(v3, read64(p)); p += 8;
+      v4 = xxh64_round(v4, read64(p)); p += 8;
+    } while (p <= limit);
+    h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+    h = xxh64_merge(h, v1);
+    h = xxh64_merge(h, v2);
+    h = xxh64_merge(h, v3);
+    h = xxh64_merge(h, v4);
+  } else {
+    h = seed + P64_5;
+  }
+  h += len;
+  while (p + 8 <= end) {
+    h ^= xxh64_round(0, read64(p));
+    h = rotl64(h, 27) * P64_1 + P64_4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h ^= (uint64_t)read32(p) * P64_1;
+    h = rotl64(h, 23) * P64_2 + P64_3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= (*p) * P64_5;
+    h = rotl64(h, 11) * P64_1;
+    p++;
+  }
+  h ^= h >> 33; h *= P64_2; h ^= h >> 29; h *= P64_3; h ^= h >> 32;
+  return h;
+}
+
+void ceph_tpu_xxh32_blocks(const uint8_t *data, uint64_t nblocks,
+                           uint64_t block_size, uint32_t seed, uint32_t *out) {
+  for (uint64_t i = 0; i < nblocks; i++)
+    out[i] = ceph_tpu_xxh32(data + i * block_size, block_size, seed);
+}
+
+void ceph_tpu_xxh64_blocks(const uint8_t *data, uint64_t nblocks,
+                           uint64_t block_size, uint64_t seed, uint64_t *out) {
+  for (uint64_t i = 0; i < nblocks; i++)
+    out[i] = ceph_tpu_xxh64(data + i * block_size, block_size, seed);
+}
+
+// ---------------------------------------------------------------------------
+// GF(2^8) region ops (host fallback for the erasure-code data path)
+// ---------------------------------------------------------------------------
+
+// dst ^= src over len bytes, word-at-a-time (xor_op.cc vector XOR analog).
+void ceph_tpu_region_xor(uint8_t *dst, const uint8_t *src, uint64_t len) {
+  uint64_t i = 0;
+  for (; i + 8 <= len; i += 8) {
+    uint64_t a, b;
+    std::memcpy(&a, dst + i, 8);
+    std::memcpy(&b, src + i, 8);
+    a ^= b;
+    std::memcpy(dst + i, &a, 8);
+  }
+  for (; i < len; i++) dst[i] ^= src[i];
+}
+
+// dst ^= mul_table[src] over len bytes; mul_table is the 256-entry GF(2^8)
+// multiply table of one matrix coefficient (jerasure region multiply analog).
+void ceph_tpu_region_mad(uint8_t *dst, const uint8_t *src, uint64_t len,
+                         const uint8_t *mul_table) {
+  for (uint64_t i = 0; i < len; i++) dst[i] ^= mul_table[src[i]];
+}
+
+// GF(2^8) matmul on host: out(R,S) = mat(R,K) * data(K,S) with XOR
+// accumulation, using per-coefficient 256-entry tables supplied by Python
+// (tables laid out as mat.size x 256).
+void ceph_tpu_gf_matmul(const uint8_t *mat_tables, uint64_t r, uint64_t k,
+                        const uint8_t *data, uint64_t s, uint8_t *out) {
+  std::memset(out, 0, r * s);
+  for (uint64_t j = 0; j < r; j++)
+    for (uint64_t i = 0; i < k; i++) {
+      const uint8_t *tbl = mat_tables + (j * k + i) * 256;
+      if (tbl[1] == 0) continue;  // coefficient 0: table all zero
+      ceph_tpu_region_mad(out + j * s, data + i * s, s, tbl);
+    }
+}
+
+struct NativeInit {
+  NativeInit() {
+    crc32c_init_tables();
+    crc32c_init_zero_mats();
+  }
+};
+static NativeInit _init;
+
+}  // extern "C"
