@@ -1,0 +1,132 @@
+"""Headline benchmark: ec_jax RS k=8,m=3 on 4 MiB stripes (BASELINE config #2).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+- value: on-chip encode throughput (GiB/s of data bytes consumed) for the
+  GF(2^8) MXU matmul, batched over stripes, steady state.
+- vs_baseline: ratio against the host CPU path (native C++ table-driven GF
+  region ops — the scalar-jerasure equivalent — measured on this machine).
+
+Measurement note: the axon TPU tunnel makes per-call timing unreliable
+(block_until_ready returns early; a host fetch pays ~0.5s RPC latency), so
+device time is measured by chaining N data-dependent encodes inside one jit
+and differencing two loop lengths — RPC overhead and the final fetch cancel.
+
+Details (decode, CPU numbers) go to bench_details.json; the driver contract
+is the one line.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.models import reed_solomon as rs
+    from ceph_tpu.ops import gf
+    from ceph_tpu import native
+
+    k, m = 8, 3
+    chunk = 512 * 1024          # 4 MiB stripe = k * 512 KiB
+    batch = 16                  # stripes per dispatch (64 MiB data)
+    matrix = rs.reed_sol_van_matrix(k, m)
+    mbits = jnp.asarray(gf.gf_matrix_to_bits(matrix))
+
+    rng = np.random.default_rng(0)
+    data_host = rng.integers(0, 256, (batch, k, chunk), dtype=np.uint8)
+    data = jax.device_put(jnp.asarray(data_host))
+    data_bytes = batch * k * chunk
+
+    @functools.partial(jax.jit, static_argnames=("n", "rows"))
+    def loop(mb, d, n, rows):
+        # data-dependent chain of encodes; scalar out forces completion
+        def body(_, carry):
+            p = gf.gf2_matmul_bytes(mb, carry)
+            return carry.at[:, :rows, :].set(p)
+
+        return jax.lax.fori_loop(0, n, body, d).astype(jnp.int32).sum()
+
+    def device_seconds_per_encode(mb, d, rows, n=201, iters=5):
+        for nn in (1, n):
+            float(loop(mb, d, nn, rows))  # compile + warm
+        def t(nn):
+            best = float("inf")
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                float(loop(mb, d, nn, rows))
+                best = min(best, time.perf_counter() - t0)
+            return best
+        return (t(n) - t(1)) / (n - 1)
+
+    t_enc = device_seconds_per_encode(mbits, data, rows=m)
+    enc_gibs = data_bytes / t_enc / (1 << 30)
+
+    # single-erasure decode: rebuild data chunk 0 from chunks 1..k-1 + p0;
+    # survivors carried as a (B, k, S) buffer, same matmul shape family
+    have = list(range(1, k)) + [k]
+    dmat = rs.decode_matrix(matrix, k, [0], have)
+    dmat_bits = jnp.asarray(gf.gf_matrix_to_bits(dmat))
+    t_dec = device_seconds_per_encode(dmat_bits, data, rows=1)
+    dec_gibs = data_bytes / t_dec / (1 << 30)
+
+    # CPU baseline: native C++ table-driven GF matmul, one stripe
+    lib = native.get_lib()
+    cpu_gibs = None
+    if lib is not None:
+        import ctypes
+
+        tables = np.zeros((m * k, 256), dtype=np.uint8)
+        for j in range(m):
+            for i in range(k):
+                tables[j * k + i] = gf.gf_mul(
+                    np.full(256, matrix[j, i], np.uint8),
+                    np.arange(256, dtype=np.uint8))
+        one = np.ascontiguousarray(data_host[0])
+        out = np.zeros((m, chunk), dtype=np.uint8)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+
+        def cpu_once():
+            lib.ceph_tpu_gf_matmul(
+                tables.ctypes.data_as(u8p), m, k,
+                one.ctypes.data_as(u8p), chunk,
+                out.ctypes.data_as(u8p))
+
+        cpu_once()
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cpu_once()
+            best = min(best, time.perf_counter() - t0)
+        cpu_gibs = (k * chunk) / best / (1 << 30)
+
+    vs_baseline = (enc_gibs / cpu_gibs) if cpu_gibs else 1.0
+
+    details = {
+        "encode_gibs": enc_gibs,
+        "decode_single_erasure_gibs": dec_gibs,
+        "cpu_native_gibs": cpu_gibs,
+        "encode_ms_per_batch": t_enc * 1e3,
+        "k": k, "m": m, "chunk_bytes": chunk, "batch": batch,
+        "backend": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }
+    with open("bench_details.json", "w") as f:
+        json.dump(details, f, indent=2)
+
+    print(json.dumps({
+        "metric": "ec_jax_encode_k8m3_4MiB_stripe",
+        "value": round(enc_gibs, 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(vs_baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -377,4 +377,5 @@ def compile_rule(cmap: CrushMap, ruleno: int, result_max: int,
         return np.asarray(batched(jnp.asarray(xs, dtype=jnp.int32)))
 
     run.dense_map = dm
+    run.trace_one = one  # traceable single-x evaluator for shard_map/pjit use
     return run

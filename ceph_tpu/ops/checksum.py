@@ -303,43 +303,81 @@ if HAVE_JAX:
         )
         return prod.astype(jnp.int32) & 1
 
-    @functools.partial(jax.jit, static_argnames=("levels",))
-    def _crc_cells_kernel(data, cell_mat_t, advances, levels: int):
-        """data (B, n*64) uint8 with n = 2**levels -> (B,) uint32 zero-seed crc."""
-        b = data.shape[0]
-        n = data.shape[1] // _CELL
-        cells = data.reshape(b, n, _CELL)
+    def make_crc_consts(length: int):
+        """Device constants for crc32c_partial_bits over `length`-byte rows."""
+        ncells = max(1, -(-length // _CELL))
+        levels = max(0, (ncells - 1).bit_length())
+        return {
+            "length": length,
+            "levels": levels,
+            "cell_mat_t": jnp.asarray(_cell_matrix().T),
+            "advances": tuple(
+                jnp.asarray(_zero_advance_matrix(_CELL * (1 << lvl)).T)
+                for lvl in range(levels)),
+        }
+
+    def crc32c_partial_bits(data, consts):
+        """Traceable: (..., L) uint8 -> (..., 32) int32 zero-seeded crc bits.
+
+        L = consts["length"]; rows are front-padded with zeros to a
+        power-of-two cell count inside the trace (a no-op for the
+        zero-seeded linear part of the CRC).
+        """
+        length = consts["length"]
+        levels = consts["levels"]
+        ncells = 1 << levels
+        lead = ncells * _CELL - length
+        if lead:
+            pad = [(0, 0)] * (data.ndim - 1) + [(lead, 0)]
+            data = jnp.pad(data, pad)
+        cells = data.reshape(*data.shape[:-1], ncells, _CELL)
         shifts = jnp.arange(8, dtype=jnp.uint8)
-        bits = ((cells[..., :, None] >> shifts) & 1).reshape(b, n, _CELL * 8)
-        part = _mod2_matmul(bits, cell_mat_t)  # (B, n, 32)
+        bits = ((cells[..., :, None] >> shifts) & 1).reshape(
+            *data.shape[:-1], ncells, _CELL * 8)
+        part = _mod2_matmul(bits, consts["cell_mat_t"])  # (..., n, 32)
         for lvl in range(levels):
-            pairs = part.reshape(b, part.shape[1] // 2, 2, 32)
-            left = _mod2_matmul(pairs[:, :, 0, :], advances[lvl])
-            part = left ^ pairs[:, :, 1, :]
-        out_bits = part[:, 0, :].astype(jnp.uint32)
-        return jnp.sum(out_bits << jnp.arange(32, dtype=jnp.uint32), axis=-1,
-                       dtype=jnp.uint32)
+            pairs = part.reshape(*part.shape[:-2], part.shape[-2] // 2, 2, 32)
+            left = _mod2_matmul(pairs[..., 0, :], consts["advances"][lvl])
+            part = left ^ pairs[..., 1, :]
+        return part[..., 0, :]
+
+    def crc32c_pack_bits(bits):
+        """(..., 32) 0/1 int32 -> (...,) uint32."""
+        return jnp.sum(bits.astype(jnp.uint32)
+                       << jnp.arange(32, dtype=jnp.uint32),
+                       axis=-1, dtype=jnp.uint32)
+
+    def crc32c_combine_bits(left_bits, right_bits, advance_t):
+        """GF(2) combine: crc(A||B) bits from zero-seeded partials.
+
+        advance_t is the transposed 32x32 zero-run matrix for len(B)
+        (from make_combine_advance).
+        """
+        return _mod2_matmul(left_bits, advance_t) ^ right_bits
+
+    def make_combine_advance(length: int):
+        """Transposed 32x32 advance matrix for combining over `length` bytes."""
+        return jnp.asarray(_zero_advance_matrix(length).T)
+
+    @functools.lru_cache(maxsize=None)
+    def _crc_batch_kernel(length: int):
+        consts = make_crc_consts(length)
+
+        @jax.jit
+        def kernel(data):
+            return crc32c_pack_bits(crc32c_partial_bits(data, consts))
+
+        return kernel
 
     def crc32c_batch_tpu(blocks: np.ndarray, init: int = 0xFFFFFFFF):
         """crc32c of each row of a (B, L) uint8 array, on device.
 
-        Returns a (B,) uint32 device array.  Math: front-pad to 64*2^q bytes
-        (no-op for the zero-seeded linear part), cell matmul + tree combine,
-        then XOR the host-folded seed advance.
+        Returns a (B,) uint32 device array: cell matmul + tree combine for
+        the zero-seeded linear part, XOR the host-folded seed advance.
         """
         blocks = np.asarray(blocks, dtype=np.uint8)
         assert blocks.ndim == 2
-        b, length = blocks.shape
-        ncells = max(1, -(-length // _CELL))
-        levels = max(0, (ncells - 1).bit_length())
-        ncells = 1 << levels
-        padded = np.zeros((b, ncells * _CELL), dtype=np.uint8)
-        if length:
-            padded[:, -length:] = blocks
-        advances = tuple(
-            jnp.asarray(_zero_advance_matrix(_CELL * (1 << lvl)).T)
-            for lvl in range(levels))
-        f = _crc_cells_kernel(jnp.asarray(padded),
-                              jnp.asarray(_cell_matrix().T), advances, levels)
+        _, length = blocks.shape
+        f = _crc_batch_kernel(length)(jnp.asarray(blocks))
         seed_adv = crc32c_zeros(init & 0xFFFFFFFF, length)
         return f ^ jnp.uint32(seed_adv)

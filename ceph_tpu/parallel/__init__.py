@@ -1,0 +1,18 @@
+"""Multi-chip parallelism: device meshes, sharded EC, sequence-parallel CRC.
+
+The reference scales with threads + NCCL-free TCP messengers
+(src/msg/async/); the TPU-native equivalent is a jax.sharding.Mesh whose
+axes carry the framework's two parallel dimensions:
+
+  - "dp" (data parallel): independent stripes/objects — Ceph's
+    many-PGs-many-objects concurrency;
+  - "sp" (sequence parallel): the byte axis of a stripe — Ceph's striping
+    of one large object across OSDs (SURVEY.md §5.7), here striped across
+    chips with XLA collectives over ICI doing the cross-shard math
+    (CRC combine; gather for reconstruction).
+"""
+
+from ceph_tpu.parallel.mesh import make_mesh  # noqa: F401
+from ceph_tpu.parallel.striped import (  # noqa: F401
+    ShardedPipeline,
+)

@@ -1,0 +1,39 @@
+"""Device-mesh construction helpers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def _pow2_divisor(n: int, cap: int) -> int:
+    d = 1
+    while d * 2 <= cap and n % (d * 2) == 0:
+        d *= 2
+    return d
+
+
+def make_mesh(devices: Optional[Sequence] = None,
+              dp: Optional[int] = None,
+              sp: Optional[int] = None) -> Mesh:
+    """A ("dp", "sp") mesh over the given (default: all) devices.
+
+    By default the sequence-parallel axis takes the largest power-of-two
+    divisor of the device count up to 4 — wide enough to exercise ICI
+    collectives, while most parallelism stays data-parallel (stripes are
+    plentiful; a single stripe's byte axis rarely needs >4 chips).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if sp is None:
+        sp = n // dp if dp else _pow2_divisor(n, 4)
+    if dp is None:
+        dp = n // sp
+    if dp * sp != n:
+        raise ValueError(f"dp({dp}) * sp({sp}) != device count ({n})")
+    arr = np.asarray(devices).reshape(dp, sp)
+    return Mesh(arr, axis_names=("dp", "sp"))

@@ -1,0 +1,153 @@
+"""Sharded storage pipeline: EC encode/decode + hinfo CRC over a chip mesh.
+
+This is the multi-chip version of the EC-on-OSD hot path (SURVEY.md §3.2):
+stripe batches are data-parallel over the mesh "dp" axis, and each chunk's
+byte axis is sequence-parallel over "sp" — the striping idea of
+libradosstriper/ECUtil (reference src/osd/ECUtil.h:27-80) mapped onto ICI.
+
+Per step, entirely on-device under one shard_map:
+  1. parity = GF(2^8) generator matmul (bit-decomposed on the MXU); purely
+     local — the byte axis is elementwise for the code, so "sp" needs no
+     collective here;
+  2. per-chunk hinfo crc32c (ECUtil::HashInfo, reference ECUtil.h:101-160):
+     each device folds its byte segment to 32 partial-CRC bits, then an
+     all_gather over "sp" + log-free linear fold with zero-run advance
+     matrices combines segments — the cross-chip traffic is 32 bits per
+     chunk, not the data;
+  3. optional CRUSH placement of each stripe's PG via the vmapped straw2
+     kernel (replicated over "sp").
+
+Decode runs the same matmul with host-inverted decode rows
+(ErasureCodeIsa-style table cache lives in the codec).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ceph_tpu.ops import checksum as cks
+from ceph_tpu.ops import gf
+
+
+def _gf2_matmul_local(mbits, data):
+    """mbits (8R, 8K) x data (B, K, S) uint8 -> (B, R, S) uint8 (traceable)."""
+    bits = gf._unpack_bits(data).astype(jnp.bfloat16)
+    prod = jnp.einsum("rk,bks->brs", mbits.astype(jnp.bfloat16), bits,
+                      preferred_element_type=jnp.float32)
+    return gf._pack_bits(prod.astype(jnp.int32) & 1)
+
+
+class ShardedPipeline:
+    """A compiled multi-chip encode(+hinfo crc)(+placement) step."""
+
+    def __init__(self, mesh: Mesh, k: int, m: int, chunk_bytes: int,
+                 matrix: np.ndarray, csum_init: int = 0xFFFFFFFF,
+                 placement_rule=None, result_max: int = 0):
+        self.mesh = mesh
+        self.k, self.m = k, m
+        self.chunk_bytes = chunk_bytes
+        self.sp = mesh.shape["sp"]
+        self.dp = mesh.shape["dp"]
+        if chunk_bytes % self.sp:
+            raise ValueError(
+                f"chunk_bytes {chunk_bytes} not divisible by sp={self.sp}")
+        self.seg = chunk_bytes // self.sp
+        self.csum_init = csum_init
+        self._mbits = jnp.asarray(gf.gf_matrix_to_bits(matrix))
+        self._crc_consts = cks.make_crc_consts(self.seg)
+        self._advance_t = cks.make_combine_advance(self.seg)
+        self._seed_adv = cks.crc32c_zeros(csum_init & 0xFFFFFFFF, chunk_bytes)
+        self._placement_one = (placement_rule.trace_one
+                               if placement_rule is not None else None)
+        self._result_max = result_max
+        self._encode = self._build_encode()
+        self._decode_cache = {}
+
+    # -- encode + hinfo + placement ---------------------------------------
+
+    def _fold_segments(self, gathered):
+        """(P, ..., 32) per-segment partial CRC bits -> (..., 32) total."""
+        total = gathered[0]
+        for p in range(1, gathered.shape[0]):
+            total = cks.crc32c_combine_bits(total, gathered[p],
+                                            self._advance_t)
+        return total
+
+    def _build_encode(self):
+        mesh = self.mesh
+
+        def local_step(mbits, data, pgs):
+            # data (B_l, k, S_l); pgs (B_l,)
+            parity = _gf2_matmul_local(mbits, data)
+            chunks = jnp.concatenate([data, parity], axis=1)
+            part = cks.crc32c_partial_bits(chunks, self._crc_consts)
+            gathered = jax.lax.all_gather(part, "sp")  # (P, B_l, k+m, 32)
+            crc = cks.crc32c_pack_bits(self._fold_segments(gathered))
+            crc = crc ^ jnp.uint32(self._seed_adv)
+            if self._placement_one is not None:
+                placement = jax.vmap(self._placement_one)(pgs)
+            else:
+                placement = jnp.zeros((pgs.shape[0], 1), dtype=jnp.int32)
+            return parity, crc, placement
+
+        shard = jax.shard_map(
+            functools.partial(local_step, self._mbits),
+            mesh=mesh,
+            in_specs=(P("dp", None, "sp"), P("dp")),
+            out_specs=(P("dp", None, "sp"), P("dp"), P("dp")),
+            check_vma=False,
+        )
+        return jax.jit(shard)
+
+    def data_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P("dp", None, "sp"))
+
+    def pg_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P("dp"))
+
+    def put_stripes(self, data) -> jax.Array:
+        """Place a (B, k, S) host batch onto the mesh with dp/sp sharding."""
+        return jax.device_put(jnp.asarray(data, dtype=jnp.uint8),
+                              self.data_sharding())
+
+    def encode(self, data, pgs=None):
+        """(B, k, S) stripes [+ (B,) pg ids] -> (parity, hinfo crcs, placement).
+
+        parity (B, m, S) stays mesh-sharded; crcs (B, k+m) uint32 and
+        placement (B, R) are dp-sharded, sp-replicated.
+        """
+        b = data.shape[0]
+        if pgs is None:
+            pgs = jnp.zeros((b,), dtype=jnp.int32)
+        return self._encode(data, jnp.asarray(pgs, dtype=jnp.int32))
+
+    # -- decode -----------------------------------------------------------
+
+    def _decode_fn(self, rows: int):
+        fn = self._decode_cache.get(rows)
+        if fn is None:
+            mesh = self.mesh
+
+            def local(dmat_bits, survivors):
+                return _gf2_matmul_local(dmat_bits, survivors)
+
+            shard = jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(P(), P("dp", None, "sp")),
+                out_specs=P("dp", None, "sp"),
+                check_vma=False,
+            )
+            fn = jax.jit(shard)
+            self._decode_cache[rows] = fn
+        return fn
+
+    def decode(self, dmat: np.ndarray, survivors):
+        """(B, k, S) surviving chunks x (R, k) decode rows -> (B, R, S)."""
+        dmat_bits = jnp.asarray(gf.gf_matrix_to_bits(dmat))
+        return self._decode_fn(dmat.shape[0])(dmat_bits, survivors)

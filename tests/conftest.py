@@ -4,12 +4,20 @@ Real-TPU behavior is validated by bench.py and the driver's
 __graft_entry__.py compile checks; unit tests must be hermetic and fast, so
 they force the CPU backend with 8 virtual devices to exercise the same
 sharding code paths the multi-chip mesh uses.
+
+Note: this environment preloads jax at interpreter startup (axon TPU
+tunnel .pth hook), so setting JAX_PLATFORMS here is too late; the backend
+is still uninitialized though, so jax.config wins.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
