@@ -1,0 +1,155 @@
+"""Built-in compressor plugins.
+
+Reference: /root/reference/src/compressor/{zlib,lz4,snappy,zstd,brotli}/ —
+each a thin Compressor subclass plus a CompressionPlugin registration.
+Here zlib uses the Python stdlib (the reference links zlib/isa-l), and
+lz4/snappy use the from-spec native C++ block codecs in
+ceph_tpu/native/src/compress.cc.  zstd and brotli have no codec in this
+image, so — like a reference build without HAVE_LZ4 — they simply don't
+register, and `Compressor.create("zstd")` returns None.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import zlib as _zlib
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ceph_tpu import native
+from ceph_tpu.compressor import (
+    COMP_ALG_LZ4,
+    COMP_ALG_SNAPPY,
+    COMP_ALG_ZLIB,
+    CompressionPlugin,
+    Compressor,
+)
+
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _u8(data: bytes) -> np.ndarray:
+    return np.frombuffer(data, dtype=np.uint8)
+
+
+def _ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(_U8P)
+
+
+class ZlibCompressor(Compressor):
+    """Deflate via stdlib zlib.
+
+    The reference's compressor_message carries the zlib window bits used at
+    compress time (ZlibCompressor.cc); same here.
+    """
+
+    WINDOW_BITS = -15  # raw deflate, matching the reference's isal/zlib path
+
+    def __init__(self, level: int = 5):
+        super().__init__(COMP_ALG_ZLIB, "zlib")
+        self.level = level
+
+    def compress(self, data: bytes) -> Tuple[bytes, Optional[int]]:
+        c = _zlib.compressobj(self.level, _zlib.DEFLATED, self.WINDOW_BITS)
+        return c.compress(data) + c.flush(), self.WINDOW_BITS
+
+    def decompress(self, data: bytes,
+                   compressor_message: Optional[int] = None) -> bytes:
+        wbits = compressor_message if compressor_message else self.WINDOW_BITS
+        d = _zlib.decompressobj(wbits)
+        out = d.decompress(data) + d.flush()
+        return out
+
+
+class _NativeBlockCompressor(Compressor):
+    """Shared driver for the native C++ block codecs."""
+
+    _prefix = ""
+
+    def __init__(self, alg: int, type_name: str):
+        super().__init__(alg, type_name)
+        self._lib = native.get_lib()
+        if self._lib is None:  # pragma: no cover - broken toolchain only
+            raise RuntimeError(
+                f"native codecs unavailable: {native.build_error()}")
+
+    def _fn(self, op: str):
+        return getattr(self._lib, f"ceph_tpu_{self._prefix}_{op}")
+
+    def compress(self, data: bytes) -> Tuple[bytes, Optional[int]]:
+        src = _u8(data)
+        cap = int(self._fn("compress_bound")(len(data)))
+        dst = np.empty(cap, dtype=np.uint8)
+        n = int(self._fn("compress")(_ptr(src), len(data), _ptr(dst), cap))
+        if n < 0:
+            raise RuntimeError(f"{self.type_name} compress failed")
+        # uncompressed length header for decompress sizing (the reference
+        # stores it in the blob metadata; snappy has it in-format)
+        return dst[:n].tobytes(), None
+
+    def _decompress_raw(self, data: bytes, out_cap: int) -> bytes:
+        src = _u8(data)
+        dst = np.empty(out_cap, dtype=np.uint8)
+        n = int(self._fn("decompress")(_ptr(src), len(data), _ptr(dst), out_cap))
+        if n < 0:
+            raise ValueError(f"{self.type_name}: malformed compressed data")
+        return dst[:n].tobytes()
+
+
+class Lz4Compressor(_NativeBlockCompressor):
+    """LZ4 block format (native C++ codec).
+
+    The reference prefixes each lz4-compressed blob with the uncompressed
+    segment lengths (LZ4Compressor.h compress framing); here a single
+    4-byte LE uncompressed length plays that role.
+    """
+
+    _prefix = "lz4"
+
+    def __init__(self):
+        super().__init__(COMP_ALG_LZ4, "lz4")
+
+    def compress(self, data: bytes) -> Tuple[bytes, Optional[int]]:
+        payload, msg = super().compress(data)
+        return len(data).to_bytes(4, "little") + payload, msg
+
+    def decompress(self, data: bytes,
+                   compressor_message: Optional[int] = None) -> bytes:
+        if len(data) < 4:
+            raise ValueError("lz4: truncated header")
+        want = int.from_bytes(data[:4], "little")
+        out = self._decompress_raw(data[4:], want)
+        if len(out) != want:
+            raise ValueError("lz4: length mismatch")
+        return out
+
+
+class SnappyCompressor(_NativeBlockCompressor):
+    """Snappy format (native C++ codec); length rides in-format."""
+
+    _prefix = "snappy"
+
+    def __init__(self):
+        super().__init__(COMP_ALG_SNAPPY, "snappy")
+
+    def decompress(self, data: bytes,
+                   compressor_message: Optional[int] = None) -> bytes:
+        src = _u8(data)
+        want = int(self._lib.ceph_tpu_snappy_uncompressed_length(
+            _ptr(src), len(data)))
+        if want < 0:
+            raise ValueError("snappy: malformed length header")
+        return self._decompress_raw(data, want)
+
+
+def register_all(registry) -> None:
+    registry.add("compressor", "zlib",
+                 CompressionPlugin("zlib", ZlibCompressor))
+    if native.get_lib() is not None:
+        registry.add("compressor", "lz4",
+                     CompressionPlugin("lz4", Lz4Compressor))
+        registry.add("compressor", "snappy",
+                     CompressionPlugin("snappy", SnappyCompressor))
+    # zstd / brotli: no codec in this image — intentionally unregistered,
+    # mirroring a reference build without HAVE_LZ4/HAVE_BROTLI.

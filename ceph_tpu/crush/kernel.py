@@ -378,4 +378,5 @@ def compile_rule(cmap: CrushMap, ruleno: int, result_max: int,
 
     run.dense_map = dm
     run.trace_one = one  # traceable single-x evaluator for shard_map/pjit use
+    run.result_max = result_max
     return run

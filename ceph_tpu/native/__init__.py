@@ -87,6 +87,20 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.ceph_tpu_region_mad.argtypes = [u8p, u8p, u64, u8p]
     lib.ceph_tpu_gf_matmul.restype = None
     lib.ceph_tpu_gf_matmul.argtypes = [u8p, u64, u64, u8p, u64, u8p]
+    try:  # compression codecs are an optional capability of the library
+        i64 = ctypes.c_int64
+        for alg in ("lz4", "snappy"):
+            bound = getattr(lib, f"ceph_tpu_{alg}_compress_bound")
+            bound.restype = u64
+            bound.argtypes = [u64]
+            for op in ("compress", "decompress"):
+                fn = getattr(lib, f"ceph_tpu_{alg}_{op}")
+                fn.restype = i64
+                fn.argtypes = [u8p, u64, u8p, u64]
+        lib.ceph_tpu_snappy_uncompressed_length.restype = i64
+        lib.ceph_tpu_snappy_uncompressed_length.argtypes = [u8p, u64]
+    except AttributeError:  # stale .so without compress.cc
+        pass
     return lib
 
 

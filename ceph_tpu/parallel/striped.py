@@ -35,14 +35,6 @@ from ceph_tpu.ops import checksum as cks
 from ceph_tpu.ops import gf
 
 
-def _gf2_matmul_local(mbits, data):
-    """mbits (8R, 8K) x data (B, K, S) uint8 -> (B, R, S) uint8 (traceable)."""
-    bits = gf._unpack_bits(data).astype(jnp.bfloat16)
-    prod = jnp.einsum("rk,bks->brs", mbits.astype(jnp.bfloat16), bits,
-                      preferred_element_type=jnp.float32)
-    return gf._pack_bits(prod.astype(jnp.int32) & 1)
-
-
 class ShardedPipeline:
     """A compiled multi-chip encode(+hinfo crc)(+placement) step."""
 
@@ -65,7 +57,11 @@ class ShardedPipeline:
         self._seed_adv = cks.crc32c_zeros(csum_init & 0xFFFFFFFF, chunk_bytes)
         self._placement_one = (placement_rule.trace_one
                                if placement_rule is not None else None)
-        self._result_max = result_max
+        if placement_rule is not None and result_max:
+            if placement_rule.result_max != result_max:
+                raise ValueError(
+                    f"placement_rule yields {placement_rule.result_max} osds"
+                    f" per input, caller expected {result_max}")
         self._encode = self._build_encode()
         self._decode_cache = {}
 
@@ -84,7 +80,7 @@ class ShardedPipeline:
 
         def local_step(mbits, data, pgs):
             # data (B_l, k, S_l); pgs (B_l,)
-            parity = _gf2_matmul_local(mbits, data)
+            parity = gf.gf2_matmul_bytes(mbits, data)
             chunks = jnp.concatenate([data, parity], axis=1)
             part = cks.crc32c_partial_bits(chunks, self._crc_consts)
             gathered = jax.lax.all_gather(part, "sp")  # (P, B_l, k+m, 32)
@@ -123,6 +119,8 @@ class ShardedPipeline:
         placement (B, R) are dp-sharded, sp-replicated.
         """
         b = data.shape[0]
+        if b % self.dp:
+            raise ValueError(f"batch {b} not divisible by dp={self.dp}")
         if pgs is None:
             pgs = jnp.zeros((b,), dtype=jnp.int32)
         return self._encode(data, jnp.asarray(pgs, dtype=jnp.int32))
@@ -135,7 +133,7 @@ class ShardedPipeline:
             mesh = self.mesh
 
             def local(dmat_bits, survivors):
-                return _gf2_matmul_local(dmat_bits, survivors)
+                return gf.gf2_matmul_bytes(dmat_bits, survivors)
 
             shard = jax.shard_map(
                 local, mesh=mesh,

@@ -18,6 +18,9 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+try:
+    import jax  # noqa: E402
+except ImportError:  # jax-free env: ops fall back to numpy, jax tests skip
+    pass
+else:
+    jax.config.update("jax_platforms", "cpu")
