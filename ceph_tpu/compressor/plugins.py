@@ -56,7 +56,8 @@ class ZlibCompressor(Compressor):
 
     def decompress(self, data: bytes,
                    compressor_message: Optional[int] = None) -> bytes:
-        wbits = compressor_message if compressor_message else self.WINDOW_BITS
+        wbits = (compressor_message if compressor_message is not None
+                 else self.WINDOW_BITS)
         d = _zlib.decompressobj(wbits)
         out = d.decompress(data) + d.flush()
         return out
@@ -88,7 +89,16 @@ class _NativeBlockCompressor(Compressor):
         # stores it in the blob metadata; snappy has it in-format)
         return dst[:n].tobytes(), None
 
+    # both block formats expand at most ~255x (length-extension bytes add up
+    # to 255 output bytes each); anything claiming more is corrupt — reject
+    # before allocating a multi-GiB buffer from a few untrusted header bytes
+    MAX_EXPANSION = 256
+
     def _decompress_raw(self, data: bytes, out_cap: int) -> bytes:
+        if out_cap > len(data) * self.MAX_EXPANSION + 1024:
+            raise ValueError(
+                f"{self.type_name}: implausible uncompressed length"
+                f" {out_cap} for {len(data)} compressed bytes")
         src = _u8(data)
         dst = np.empty(out_cap, dtype=np.uint8)
         n = int(self._fn("decompress")(_ptr(src), len(data), _ptr(dst), out_cap))
@@ -146,7 +156,8 @@ class SnappyCompressor(_NativeBlockCompressor):
 def register_all(registry) -> None:
     registry.add("compressor", "zlib",
                  CompressionPlugin("zlib", ZlibCompressor))
-    if native.get_lib() is not None:
+    lib = native.get_lib()
+    if lib is not None and hasattr(lib, "ceph_tpu_lz4_compress"):
         registry.add("compressor", "lz4",
                      CompressionPlugin("lz4", Lz4Compressor))
         registry.add("compressor", "snappy",

@@ -247,6 +247,7 @@ static inline uint8_t *snappy_emit_copy(uint8_t *op, uint32_t offset,
 
 int64_t ceph_tpu_snappy_compress(const uint8_t *src, uint64_t n, uint8_t *dst,
                                  uint64_t dst_cap) {
+  if (n >= (1ull << 32)) return -1;  // snappy length fields are 32-bit
   if (dst_cap < ceph_tpu_snappy_compress_bound(n)) return -1;
   uint8_t *op = dst;
   // varint uncompressed length

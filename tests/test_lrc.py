@@ -1,0 +1,236 @@
+"""LRC plugin tests, mirroring the reference's TestErasureCodeLrc.cc
+coverage: kml profile generation, layered encode/decode, local-repair
+minimum_to_decode, error paths."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.interface import ErasureCodeError
+from ceph_tpu.ec.lrc import ErasureCodeLrc
+from ceph_tpu.ec.registry import create_erasure_code
+
+
+def make_kml(k=4, m=2, l=3):
+    return create_erasure_code(
+        {"plugin": "lrc", "k": str(k), "m": str(m), "l": str(l)})
+
+
+def payload(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+def test_kml_generation():
+    lrc = make_kml(4, 2, 3)
+    prof = lrc.get_profile()
+    assert prof["mapping"] == "DD__DD__"
+    assert lrc.get_chunk_count() == 8
+    assert lrc.get_data_chunk_count() == 4
+    assert lrc.get_coding_chunk_count() == 4
+    assert len(lrc.layers) == 3  # one global + two locals
+    assert lrc.layers[0].chunks_map == "DDc_DDc_"
+    assert lrc.layers[1].chunks_map == "DDDc____"
+    assert lrc.layers[2].chunks_map == "____DDDc"
+
+
+def test_kml_modulo_errors():
+    with pytest.raises(ErasureCodeError):
+        make_kml(4, 2, 4)   # (k+m) % l != 0
+    with pytest.raises(ErasureCodeError):
+        make_kml(5, 1, 3)   # k % groups != 0
+    with pytest.raises(ErasureCodeError):
+        create_erasure_code({"plugin": "lrc", "k": "4", "m": "2"})  # partial kml
+
+
+def test_kml_rejects_generated_keys():
+    with pytest.raises(ErasureCodeError):
+        create_erasure_code({"plugin": "lrc", "k": "4", "m": "2", "l": "3",
+                             "mapping": "DD__DD__"})
+
+
+def test_missing_layers():
+    with pytest.raises(ErasureCodeError):
+        create_erasure_code({"plugin": "lrc"})
+    with pytest.raises(ErasureCodeError):
+        create_erasure_code({"plugin": "lrc",
+                             "layers": '[["DDc",""]]'})  # no mapping
+    with pytest.raises(ErasureCodeError):
+        create_erasure_code({"plugin": "lrc", "mapping": "DD_",
+                             "layers": "not json"})
+
+
+def test_round_trip_no_erasure():
+    lrc = make_kml()
+    data = payload(4096)
+    chunks = lrc.encode(range(lrc.get_chunk_count()), data)
+    assert len(chunks) == 8
+    assert lrc.decode_concat(chunks)[:len(data)] == data
+
+
+@pytest.mark.parametrize("erased", [
+    [0], [3], [2], [7],            # single erasures (local repair)
+    [0, 4],                        # one per group
+    [0, 1],                        # two in one group (needs global layer)
+    [0, 1, 4],                     # mixed
+    [2, 3],                        # global parity + local parity of group 0
+])
+def test_decode_with_erasures(erased):
+    lrc = make_kml()
+    data = payload(8192, seed=len(erased))
+    full = lrc.encode(range(8), data)
+    available = {i: c for i, c in full.items() if i not in erased}
+    decoded = lrc.decode(set(erased), available)
+    for i in erased:
+        assert decoded[i] == full[i], f"chunk {i}"
+    assert lrc.decode_concat(available)[:len(data)] == data
+
+
+def test_too_many_erasures():
+    lrc = make_kml()
+    data = payload(4096)
+    full = lrc.encode(range(8), data)
+    # all of group 0's data + parity beyond recoverability:
+    # global layer can fix 2 erasures, local 1 — 0,1,2,3 erased kills group 0
+    available = {i: c for i, c in full.items() if i not in (0, 1, 2, 3)}
+    with pytest.raises(ErasureCodeError):
+        lrc.decode({0, 1, 2, 3}, available)
+
+
+def test_minimum_to_decode_local_repair():
+    """The LRC headline property: a single lost chunk reads only its local
+    group (l chunks), not k chunks."""
+    lrc = make_kml(4, 2, 3)
+    want = set(range(8))
+    # chunk 1 lost: local layer DDDc____ covers it with the other 3 members
+    minimum = lrc.minimum_to_decode({1}, want - {1})
+    assert set(minimum) == {0, 2, 3}
+    # compare: a plain RS k=4 code would need 4 chunks
+
+
+def test_minimum_to_decode_no_erasure():
+    lrc = make_kml()
+    m = lrc.minimum_to_decode({0, 5}, set(range(8)))
+    assert set(m) == {0, 5}
+
+
+def test_minimum_to_decode_cascade():
+    """Erasures needing cascaded recovery fall through to case 3."""
+    lrc = make_kml()
+    # lose 1 (data) and 3 (its local parity): local layer of group 0 has two
+    # erasures > its single parity, so the global layer must recover 1
+    available = set(range(8)) - {1, 3}
+    minimum = lrc.minimum_to_decode({1}, available)
+    assert 1 not in minimum
+    assert set(minimum) <= available
+
+
+def test_minimum_to_decode_insufficient():
+    lrc = make_kml()
+    with pytest.raises(ErasureCodeError):
+        lrc.minimum_to_decode({0}, {4, 5, 6, 7})
+
+
+def test_explicit_layers_profile():
+    """Hand-written mapping/layers (the non-kml path)."""
+    profile = {
+        "plugin": "lrc",
+        "mapping": "DDD__",
+        "layers": '[["DDDc_", ""], ["DDD_c", ""]]',
+    }
+    lrc = create_erasure_code(profile)
+    assert lrc.get_chunk_count() == 5
+    assert lrc.get_data_chunk_count() == 3
+    data = payload(3000, seed=9)
+    full = lrc.encode(range(5), data)
+    for erased in ([3], [4], [0]):
+        avail = {i: c for i, c in full.items() if i not in erased}
+        out = lrc.decode(set(erased), avail)
+        for i in erased:
+            assert out[i] == full[i]
+
+
+def test_layer_map_length_mismatch():
+    with pytest.raises(ErasureCodeError):
+        create_erasure_code({
+            "plugin": "lrc", "mapping": "DD_",
+            "layers": '[["DDc_", ""]]'})
+
+
+def test_trailing_comma_layers():
+    """json_spirit-style trailing commas (the reference kml generator emits
+    them) must parse."""
+    profile = {
+        "plugin": "lrc",
+        "mapping": "DD_",
+        "layers": '[ [ "DDc", "" ], ]',
+    }
+    lrc = create_erasure_code(profile)
+    assert lrc.get_chunk_count() == 3
+
+
+def test_create_rule():
+    from ceph_tpu.crush.map import build_flat_cluster
+
+    cmap = build_flat_cluster(12, osds_per_host=2)
+    lrc = make_kml(4, 2, 3)
+    ruleno = lrc.create_rule("lrcrule", cmap)
+    assert ruleno >= 0
+    rule = cmap.rules[ruleno]
+    assert rule.rule_type == 3
+    # default kml steps: chooseleaf host 0
+    assert len(rule.steps) == 3  # take, chooseleaf, emit
+
+
+def test_create_rule_locality():
+    from ceph_tpu.crush.map import build_flat_cluster
+
+    cmap = build_flat_cluster(16, osds_per_host=2)
+    lrc = create_erasure_code({
+        "plugin": "lrc", "k": "4", "m": "2", "l": "3",
+        "crush-locality": "host", "crush-failure-domain": "osd"})
+    ruleno = lrc.create_rule("lrcrule2", cmap)
+    rule = cmap.rules[ruleno]
+    # take / choose host groups / chooseleaf osd l+1 / emit
+    assert len(rule.steps) == 4
+
+
+def test_kml_8_4_6():
+    """A larger valid kml shape (k=8 m=4 l=6 -> 2 groups of 7)."""
+    lrc = make_kml(8, 4, 6)
+    assert lrc.get_chunk_count() == 14
+    assert lrc.get_data_chunk_count() == 8
+    data = payload(1 << 16, seed=11)
+    full = lrc.encode(range(14), data)
+    for erased in ([0], [6], [13], [0, 7], [1, 2]):
+        avail = {i: c for i, c in full.items() if i not in erased}
+        out = lrc.decode(set(erased), avail)
+        for i in erased:
+            assert out[i] == full[i]
+    assert lrc.decode_concat(full)[:len(data)] == data
+
+
+def test_reference_implicit_parity_cascade():
+    """The reference's own tricky pattern (TestErasureCodeLrc.cc:525-600):
+    mapping __DDD__DD, erasures {2,7,8}: layer c_DDD____ recovers 2, then
+    _cDDD_cDD recovers 7 and 8.  Their truly-unrecoverable case {2,3,7,8}
+    must still fail."""
+    profile = {
+        "plugin": "lrc",
+        "mapping": "__DDD__DD",
+        "layers": '[ [ "_cDDD_cDD", "" ], [ "c_DDD____", "" ],'
+                  ' [ "_____cDDD", "" ], ]',
+    }
+    lrc = create_erasure_code(profile)
+    assert lrc.get_chunk_count() == 9
+
+    minimum = lrc.minimum_to_decode({8}, set(range(9)) - {2, 7, 8})
+    assert set(minimum) <= set(range(9)) - {2, 7, 8}
+
+    data = payload(9 * 512, seed=21)
+    full = lrc.encode(range(9), data)
+    avail = {i: c for i, c in full.items() if i not in (2, 7, 8)}
+    out = lrc.decode({8}, avail)
+    assert out[8] == full[8]
+
+    with pytest.raises(ErasureCodeError):
+        lrc.minimum_to_decode({8}, set(range(9)) - {2, 3, 7, 8})
