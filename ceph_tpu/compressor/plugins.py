@@ -54,13 +54,21 @@ class ZlibCompressor(Compressor):
         c = _zlib.compressobj(self.level, _zlib.DEFLATED, self.WINDOW_BITS)
         return c.compress(data) + c.flush(), self.WINDOW_BITS
 
+    # deflate expands at most ~1032x; cap output vs input size so a crafted
+    # stream can't balloon a small blob into a multi-GiB allocation
+    MAX_EXPANSION = 1100
+
     def decompress(self, data: bytes,
                    compressor_message: Optional[int] = None) -> bytes:
         wbits = (compressor_message if compressor_message is not None
                  else self.WINDOW_BITS)
         d = _zlib.decompressobj(wbits)
-        out = d.decompress(data) + d.flush()
-        return out
+        cap = len(data) * self.MAX_EXPANSION + 1024
+        out = d.decompress(data, cap)
+        if d.unconsumed_tail:
+            raise ValueError(
+                f"zlib: implausible expansion beyond {cap} bytes")
+        return out + d.flush()
 
 
 class _NativeBlockCompressor(Compressor):
@@ -121,6 +129,8 @@ class Lz4Compressor(_NativeBlockCompressor):
         super().__init__(COMP_ALG_LZ4, "lz4")
 
     def compress(self, data: bytes) -> Tuple[bytes, Optional[int]]:
+        if len(data) >= 1 << 32:  # 4-byte length header limit
+            raise RuntimeError("lz4: input too large (>= 4 GiB)")
         payload, msg = super().compress(data)
         return len(data).to_bytes(4, "little") + payload, msg
 

@@ -1,0 +1,49 @@
+"""Shared device-dispatch helpers for the erasure codecs.
+
+One home for the two patterns every codec repeats (flagged by review):
+GF matmul routed host-vs-TPU, and the bounded LRU cache keyed by erasure
+signature (the ErasureCodeIsaTableCache role).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Hashable
+
+import numpy as np
+
+from ceph_tpu.ops import gf
+
+
+def gf_matmul(mat: np.ndarray, data: np.ndarray, use_tpu: bool,
+              min_bytes: int = 1) -> np.ndarray:
+    """(R,K) GF(2^8) matrix x (K,S) or (B,K,S) uint8, device-dispatched."""
+    if use_tpu and gf.HAVE_JAX and data.size >= min_bytes:
+        return np.asarray(gf.gf_matmul_tpu(mat, data))
+    if data.ndim == 2:
+        return gf.gf_matmul_ref(mat, data)
+    return np.stack([gf.gf_matmul_ref(mat, d) for d in data])
+
+
+class LruCache:
+    """Tiny bounded LRU (decode tables keyed by erasure signature)."""
+
+    def __init__(self, cap: int = 256):
+        self._store: OrderedDict = OrderedDict()
+        self.cap = cap
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    _MISS = object()
+
+    def get_or_compute(self, key: Hashable, compute: Callable):
+        hit = self._store.get(key, self._MISS)
+        if hit is not self._MISS:
+            self._store.move_to_end(key)
+            return hit
+        value = compute()
+        self._store[key] = value
+        if len(self._store) > self.cap:
+            self._store.popitem(last=False)
+        return value

@@ -17,11 +17,11 @@ many stripes per dispatch.
 
 from __future__ import annotations
 
-from collections import OrderedDict
 from typing import Dict, Mapping, Set
 
 import numpy as np
 
+from ceph_tpu.ec import dispatch
 from ceph_tpu.ec.interface import ErasureCode, ErasureCodeError, to_bool, to_int
 from ceph_tpu.models import reed_solomon as rs
 from ceph_tpu.ops import gf
@@ -44,8 +44,7 @@ class ErasureCodeJax(ErasureCode):
         self.packetsize = 2048
         self.matrix: np.ndarray | None = None
         self._mbits_dev = None
-        self._decode_cache: OrderedDict[tuple, np.ndarray] = OrderedDict()
-        self._decode_cache_cap = 256
+        self._decode_cache = dispatch.LruCache(256)
         self.use_tpu = True
         self.tpu_min_bytes = 1  # kernel engages for everything unless configured
 
@@ -128,13 +127,7 @@ class ErasureCodeJax(ErasureCode):
 
     def _matmul(self, mat: np.ndarray, data: np.ndarray) -> np.ndarray:
         """(R,K) GF matrix x (K,S) or (B,K,S) uint8 -> parity, device-dispatched."""
-        nbytes = data.size
-        if self.use_tpu and nbytes >= self.tpu_min_bytes:
-            out = gf.gf_matmul_tpu(mat, data)
-            return np.asarray(out)
-        if data.ndim == 2:
-            return gf.gf_matmul_ref(mat, data)
-        return np.stack([gf.gf_matmul_ref(mat, d) for d in data])
+        return dispatch.gf_matmul(mat, data, self.use_tpu, self.tpu_min_bytes)
 
     def encode_chunks(self, want_to_encode: Set[int],
                       encoded: Dict[int, bytearray]) -> None:
@@ -169,16 +162,10 @@ class ErasureCodeJax(ErasureCode):
     def _decode_matrix(self, have: tuple, erasures: tuple) -> np.ndarray:
         """LRU-cached decode rows keyed by (have, erasures) — the signature
         cache of ErasureCodeIsaTableCache."""
-        key = (have, erasures)
-        cached = self._decode_cache.get(key)
-        if cached is not None:
-            self._decode_cache.move_to_end(key)
-            return cached
-        dmat = rs.decode_matrix(self.matrix, self.k, list(erasures), list(have))
-        self._decode_cache[key] = dmat
-        if len(self._decode_cache) > self._decode_cache_cap:
-            self._decode_cache.popitem(last=False)
-        return dmat
+        return self._decode_cache.get_or_compute(
+            (have, erasures),
+            lambda: rs.decode_matrix(self.matrix, self.k,
+                                     list(erasures), list(have)))
 
     # -- batched API (the TPU-native entry points) ------------------------
 

@@ -14,7 +14,7 @@ Reference parity: the lrc plugin
 - decode walks layers bottom-up (reverse), each layer recovering what it
   can into `decoded` so upper layers can reuse it (decode_chunks :702-780);
 - minimum_to_decode picks the cheapest covering layers, falling back to
-  cascaded recovery (三-case algorithm, _minimum_to_decode :135-289);
+  cascaded recovery (3-case algorithm, _minimum_to_decode :135-289);
 - crush rule from `crush-steps` (one choose step per locality level).
 
 Sub-codecs default to plugin=jerasure technique=reed_sol_van — which this

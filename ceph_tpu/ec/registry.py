@@ -154,7 +154,8 @@ def _register_builtin(reg: ErasureCodePluginRegistry) -> None:
     def shec_factory(profile: ErasureCodeProfile) -> ErasureCode:
         from ceph_tpu.ec.shec import ErasureCodeShec
 
-        codec = ErasureCodeShec()
+        codec = ErasureCodeShec(
+            technique=profile.setdefault("technique", "multiple"))
         codec.init(profile)
         return codec
 

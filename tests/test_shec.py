@@ -1,0 +1,167 @@
+"""SHEC plugin tests, mirroring the reference's TestErasureCodeShec*.cc:
+parameter validation, exhaustive erasure sweeps up to c failures, reduced
+recovery-read property, decode-table cache."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.interface import ErasureCodeError
+from ceph_tpu.ec.registry import create_erasure_code
+from ceph_tpu.ec.shec import recovery_efficiency1, shec_matrix
+
+
+def make(k=4, m=3, c=2, technique=None):
+    profile = {"plugin": "shec", "k": str(k), "m": str(m), "c": str(c)}
+    if technique:
+        profile["technique"] = technique
+    return create_erasure_code(profile)
+
+
+def payload(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+def test_defaults():
+    shec = create_erasure_code({"plugin": "shec"})
+    assert (shec.k, shec.m, shec.c) == (4, 3, 2)
+    assert shec.get_chunk_count() == 7
+    assert shec.get_profile()["technique"] == "multiple"
+
+
+def test_validation():
+    for bad in (
+        {"k": "4", "m": "3"},                       # partial kmc
+        {"k": "0", "m": "3", "c": "2"},             # k <= 0
+        {"k": "4", "m": "0", "c": "2"},             # m <= 0
+        {"k": "4", "m": "3", "c": "0"},             # c <= 0
+        {"k": "4", "m": "3", "c": "4"},             # c > m
+        {"k": "13", "m": "3", "c": "2"},            # k > 12
+        {"k": "12", "m": "12", "c": "2"},           # k+m > 20 (m > k too)
+        {"k": "3", "m": "4", "c": "2"},             # m > k
+        {"k": "x", "m": "3", "c": "2"},             # not an int
+    ):
+        with pytest.raises(ErasureCodeError):
+            create_erasure_code({"plugin": "shec", **bad})
+    with pytest.raises(ErasureCodeError):
+        make(technique="bogus")
+
+
+def test_matrix_is_shingled():
+    """Each parity row covers a strict subset of data columns; every data
+    column is covered by at least one parity."""
+    mat = shec_matrix(6, 4, 2, "multiple")
+    assert mat.shape == (4, 6)
+    nonzero_cols = [set(np.nonzero(mat[r])[0]) for r in range(4)]
+    assert any(len(s) < 6 for s in nonzero_cols)  # shingling happened
+    covered = set().union(*nonzero_cols)
+    assert covered == set(range(6))
+
+
+def test_single_vs_multiple_matrices_differ():
+    ms = shec_matrix(6, 4, 2, "single")
+    mm = shec_matrix(6, 4, 2, "multiple")
+    assert ms.shape == mm.shape == (4, 6)
+    assert not np.array_equal(ms, mm)
+
+
+def test_recovery_efficiency_sane():
+    r = recovery_efficiency1(6, 2, 2, 1, 1)
+    assert r > 0
+    assert recovery_efficiency1(6, 0, 2, 1, 1) == -1.0  # invalid split
+
+
+@pytest.mark.parametrize("technique", ["single", "multiple"])
+@pytest.mark.parametrize("kmc", [(4, 3, 2), (6, 4, 2), (8, 4, 3), (10, 5, 2)])
+def test_exhaustive_erasures_up_to_c(kmc, technique):
+    """Any pattern of <= c erasures must decode bit-exactly (the SHEC
+    durability contract; reference TestErasureCodeShec_all sweeps)."""
+    k, m, c = kmc
+    shec = make(k, m, c, technique)
+    n = k + m
+    data = payload(k * 256, seed=k * 100 + m)
+    full = shec.encode(range(n), data)
+    assert len(full) == n
+    for r in range(1, c + 1):
+        for erased in itertools.combinations(range(n), r):
+            avail = {i: ch for i, ch in full.items() if i not in erased}
+            out = shec.decode(set(erased), avail)
+            for i in erased:
+                assert out[i] == full[i], (kmc, technique, erased)
+
+
+def test_decode_concat_round_trip():
+    shec = make()
+    data = payload(10_000, seed=5)
+    full = shec.encode(range(7), data)
+    assert shec.decode_concat(full)[:len(data)] == data
+    # with erasures
+    avail = {i: ch for i, ch in full.items() if i not in (1, 5)}
+    assert shec.decode_concat(avail)[:len(data)] == data
+
+
+def test_minimum_to_decode_reduced_reads():
+    """The SHEC selling point: recovering one data chunk reads fewer than k
+    chunks (a shingle's width), unlike plain RS."""
+    shec = make(8, 4, 3)
+    n = 12
+    want = {2}
+    minimum = shec.minimum_to_decode(want, set(range(n)) - want)
+    assert len(minimum) < 8, sorted(minimum)
+    # and it actually decodes using just that set
+    data = payload(8 * 512, seed=7)
+    full = shec.encode(range(n), data)
+    avail = {i: full[i] for i in minimum}
+    out = shec.decode(want, avail)
+    assert out[2] == full[2]
+
+
+def test_minimum_to_decode_no_erasure():
+    shec = make()
+    m = shec.minimum_to_decode({0, 3}, set(range(7)))
+    assert set(m) == {0, 3}
+
+
+def test_unrecoverable_pattern():
+    """More erasures than any parity subset can solve -> EIO."""
+    shec = make(4, 3, 2)
+    data = payload(2048)
+    full = shec.encode(range(7), data)
+    # erase all parities plus a data chunk: nothing can recover chunk 0
+    erased = {0, 4, 5, 6}
+    avail = {i: ch for i, ch in full.items() if i not in erased}
+    with pytest.raises(ErasureCodeError):
+        shec.decode({0}, avail)
+    with pytest.raises(ErasureCodeError):
+        shec.minimum_to_decode({0}, set(avail))
+
+
+def test_missing_parity_reencoded():
+    """A wanted missing parity chunk is recomputed from its data window."""
+    shec = make()
+    data = payload(4096, seed=3)
+    full = shec.encode(range(7), data)
+    avail = {i: ch for i, ch in full.items() if i != 5}
+    out = shec.decode({5}, avail)
+    assert out[5] == full[5]
+
+
+def test_decode_cache_reused():
+    shec = make()
+    data = payload(1024)
+    full = shec.encode(range(7), data)
+    avail = {i: ch for i, ch in full.items() if i != 2}
+    shec.decode({2}, avail)
+    hits_before = len(shec._decode_cache)
+    shec.decode({2}, avail)
+    assert len(shec._decode_cache) == hits_before  # same signature, cached
+
+
+def test_chunk_size_alignment():
+    shec = make(4, 3, 2)
+    # alignment k*w*4 = 128; chunk = padded/k
+    assert shec.get_chunk_size(1) == 32
+    assert shec.get_chunk_size(4 * 32) == 32
+    assert shec.get_chunk_size(4 * 32 + 1) == 64
