@@ -107,6 +107,9 @@ class CrushMap:
         self.device_classes: Dict[int, str] = {}
         self.max_devices = 0
         self.choose_args: Dict[int, ChooseArg] = {}
+        # named choose_args maps (text format: `choose_args <name> {...}`);
+        # the mapper consumes one map (self.choose_args) at a time
+        self.choose_args_maps: Dict[str, Dict[int, ChooseArg]] = {}
         # tunables — modern/default profile (crush.h defaults as set by
         # CrushWrapper::set_tunables_default)
         self.choose_local_tries = 0
@@ -115,6 +118,14 @@ class CrushMap:
         self.chooseleaf_descend_once = 1
         self.chooseleaf_vary_r = 1
         self.chooseleaf_stable = 1
+        self.straw_calc_version = 1
+        self.allowed_bucket_algs = ((1 << CRUSH_BUCKET_UNIFORM) |
+                                    (1 << CRUSH_BUCKET_LIST) |
+                                    (1 << CRUSH_BUCKET_STRAW) |
+                                    (1 << CRUSH_BUCKET_STRAW2))
+        # per-class shadow hierarchies: (bucket_id, class) -> shadow id
+        # (CrushWrapper::populate_classes / class_bucket)
+        self.class_bucket: Dict[tuple, int] = {}
 
     # -- construction -----------------------------------------------------
 
@@ -152,6 +163,63 @@ class CrushMap:
 
     def bucket(self, item_id: int) -> Bucket:
         return self.buckets[item_id]
+
+    def populate_class_shadow(self, device_class: str) -> None:
+        """Build the per-class shadow hierarchy
+        (CrushWrapper::populate_classes / device_class_clone): for every
+        bucket that transitively contains devices of `device_class`, a
+        shadow bucket holding only those devices (and shadow children).
+        `step take <root> class <c>` then resolves to the shadow root.
+
+        A text map may pre-declare shadow ids (`id -12 class hdd` lines);
+        those ids are honored when the shadow bucket is materialized."""
+
+        def clone(bid: int) -> Optional[int]:
+            key = (bid, device_class)
+            declared = self.class_bucket.get(key)
+            if declared is not None and declared in self.buckets:
+                return declared
+            orig = self.buckets[bid]
+            items: List[int] = []
+            weights: List[int] = []
+            for item, weight in zip(orig.items, orig.weights):
+                if item >= 0:
+                    if self.device_classes.get(item) == device_class:
+                        items.append(item)
+                        weights.append(weight)
+                else:
+                    shadow = clone(item)
+                    if shadow is not None:
+                        items.append(shadow)
+                        weights.append(self.buckets[shadow].weight)
+            if not items:
+                return None
+            sb = self.add_bucket(
+                declared, orig.type,
+                f"{self.bucket_names[bid]}~{device_class}", alg=orig.alg)
+            sb.hash = orig.hash
+            for item, weight in zip(items, weights):
+                sb.add_item(item, weight)
+            self.class_bucket[key] = sb.id
+            return sb.id
+
+        for bid in sorted(self.buckets, reverse=True):
+            if "~" not in self.bucket_names[bid]:
+                clone(bid)
+
+    def class_shadow_id(self, bucket_id: int, device_class: str) -> int:
+        key = (bucket_id, device_class)
+        sid = self.class_bucket.get(key)
+        if sid is None or sid not in self.buckets:
+            # key may hold a pre-declared id from a text map whose shadow
+            # bucket hasn't been materialized yet — build the hierarchy
+            self.populate_class_shadow(device_class)
+            sid = self.class_bucket.get(key)
+        if sid is None or sid not in self.buckets:
+            raise KeyError(
+                f"bucket {self.bucket_names.get(bucket_id)} has no devices"
+                f" of class {device_class}")
+        return sid
 
     def add_rule(self, rule: Rule) -> int:
         self.rules.append(rule)

@@ -18,7 +18,7 @@ from ceph_tpu.ops import gf
 def gf_matmul(mat: np.ndarray, data: np.ndarray, use_tpu: bool,
               min_bytes: int = 1) -> np.ndarray:
     """(R,K) GF(2^8) matrix x (K,S) or (B,K,S) uint8, device-dispatched."""
-    if use_tpu and gf.HAVE_JAX and data.size >= min_bytes:
+    if use_tpu and gf.backend_available() and data.size >= min_bytes:
         return np.asarray(gf.gf_matmul_tpu(mat, data))
     if data.ndim == 2:
         return gf.gf_matmul_ref(mat, data)

@@ -66,7 +66,7 @@ class ErasureCodeJax(ErasureCode):
             "jerasure-per-chunk-alignment", profile, "false")
         if self.technique.startswith("cauchy"):
             self.packetsize = to_int("packetsize", profile, "2048")
-        self.use_tpu = to_bool("tpu", profile, "true") and gf.HAVE_JAX
+        self.use_tpu = to_bool("tpu", profile, "true") and gf.backend_available()
         self.tpu_min_bytes = to_int("tpu-min-bytes", profile, "1")
         self.sanity_check_k_m(self.k, self.m)
         mapping = profile.get("mapping")

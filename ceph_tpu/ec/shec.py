@@ -147,8 +147,10 @@ class ErasureCodeShec(ErasureCode):
             # shingles are out of scope for the TPU build
             self.w = 8
             profile["w"] = "8"
-        self.use_tpu = (profile.get("tpu", "true").lower()
-                        in ("true", "1", "yes")) and gf.HAVE_JAX
+        from ceph_tpu.ec.interface import to_bool
+
+        self.use_tpu = to_bool("tpu", profile, "true") and \
+            gf.backend_available()
         super().init(profile)
         self.matrix = shec_matrix(k, m, c, self.technique)
 

@@ -40,6 +40,28 @@ try:  # JAX is the TPU execution path; numpy path works without it.
 except Exception:  # pragma: no cover
     HAVE_JAX = False
 
+_backend_ok = None
+
+
+def backend_available() -> bool:
+    """True when a jax backend actually initializes.
+
+    `import jax` succeeding does not guarantee a usable backend (e.g.
+    JAX_PLATFORMS names a plugin that fails to load outside its home
+    directory); everything that device-dispatches must gate on this and
+    fall back to the host path."""
+    global _backend_ok
+    if _backend_ok is None:
+        if not HAVE_JAX:
+            _backend_ok = False
+        else:
+            try:
+                jax.devices()
+                _backend_ok = True
+            except Exception:
+                _backend_ok = False
+    return _backend_ok
+
 # ---------------------------------------------------------------------------
 # Field tables (host, numpy)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,1 @@
+"""OSD-layer components: stripe math, EC data path, maps."""

@@ -1,0 +1,242 @@
+"""EC stripe arithmetic and shard hashing.
+
+Reference parity: ECUtil (/root/reference/src/osd/ECUtil.{h,cc}):
+
+- stripe_info_t — pure logical<->chunk offset maps over
+  stripe_width = k * chunk_size rows (ECUtil.h:27-80);
+- ECUtil::encode/decode — adapt whole-object buffers to the per-stripe
+  codec (ECUtil.cc);
+- HashInfo — cumulative per-shard crc32c kept in an object xattr
+  (hinfo_key), the bit-exactness ledger updated on append
+  (ECUtil.h:101-160).
+
+TPU-first deviation: where the reference loops stripes calling the codec
+once per stripe, `encode`/`decode` here stack all stripes into one
+(B, k, chunk) batch and make a single device dispatch through the codec's
+batched entry points when available — host<->TPU latency is amortized over
+the whole object (SURVEY.md §7 hard part #4).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Mapping, Optional, Set, Tuple
+
+import numpy as np
+
+from ceph_tpu.ops import checksum as cks
+
+HINFO_KEY = "hinfo_key"
+
+
+def is_hinfo_key_string(key: str) -> bool:
+    return key == HINFO_KEY
+
+
+class StripeInfo:
+    """stripe_info_t: stripe_width = stripe_size (k) x chunk_size."""
+
+    def __init__(self, stripe_size: int, stripe_width: int):
+        assert stripe_width % stripe_size == 0
+        self.stripe_width = stripe_width
+        self.chunk_size = stripe_width // stripe_size
+
+    def logical_offset_is_stripe_aligned(self, logical: int) -> bool:
+        return logical % self.stripe_width == 0
+
+    def get_stripe_width(self) -> int:
+        return self.stripe_width
+
+    def get_chunk_size(self) -> int:
+        return self.chunk_size
+
+    def logical_to_prev_chunk_offset(self, offset: int) -> int:
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def logical_to_next_chunk_offset(self, offset: int) -> int:
+        return (-(-offset // self.stripe_width)) * self.chunk_size
+
+    def logical_to_prev_stripe_offset(self, offset: int) -> int:
+        return offset - offset % self.stripe_width
+
+    def logical_to_next_stripe_offset(self, offset: int) -> int:
+        rem = offset % self.stripe_width
+        return offset + (self.stripe_width - rem) if rem else offset
+
+    def aligned_logical_offset_to_chunk_offset(self, offset: int) -> int:
+        assert offset % self.stripe_width == 0
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def aligned_chunk_offset_to_logical_offset(self, offset: int) -> int:
+        assert offset % self.chunk_size == 0
+        return (offset // self.chunk_size) * self.stripe_width
+
+    def aligned_offset_len_to_chunk(self, off_len: Tuple[int, int]
+                                    ) -> Tuple[int, int]:
+        off, length = off_len
+        return (self.aligned_logical_offset_to_chunk_offset(off),
+                self.aligned_logical_offset_to_chunk_offset(length))
+
+    def offset_len_to_stripe_bounds(self, off_len: Tuple[int, int]
+                                    ) -> Tuple[int, int]:
+        off, length = off_len
+        start = self.logical_to_prev_stripe_offset(off)
+        end_len = self.logical_to_next_stripe_offset((off - start) + length)
+        return start, end_len
+
+
+def encode(sinfo: StripeInfo, ec_impl, data: bytes,
+           want: Iterable[int]) -> Dict[int, bytes]:
+    """Whole-object encode: (stripes x width) -> per-shard chunk streams.
+
+    Input must be stripe-aligned (callers zero-pad, as the reference tool
+    does).  All stripes go through the codec in one batched dispatch when
+    the codec exposes encode_batch (the ec_jax path).
+    """
+    logical_size = len(data)
+    assert logical_size % sinfo.get_stripe_width() == 0
+    want = set(want)
+    out: Dict[int, bytes] = {}
+    if logical_size == 0:
+        return out
+
+    width = sinfo.get_stripe_width()
+    chunk = sinfo.get_chunk_size()
+    n_stripes = logical_size // width
+    k = width // chunk
+    n = ec_impl.get_chunk_count()
+
+    if ec_impl.get_chunk_size(width) != chunk:
+        from ceph_tpu.ec.interface import ErasureCodeError
+
+        raise ErasureCodeError(
+            22, f"stripe unit {chunk} is incompatible with the codec's"
+            f" alignment: a {width}-byte stripe encodes to"
+            f" {ec_impl.get_chunk_size(width)}-byte chunks")
+
+    if hasattr(ec_impl, "encode_batch") and not ec_impl.get_chunk_mapping() \
+            and ec_impl.get_chunk_size(width) == chunk:
+        arr = np.frombuffer(data, dtype=np.uint8).reshape(n_stripes, k, chunk)
+        parity = ec_impl.encode_batch(arr)           # (B, m, chunk)
+        for i in range(n):
+            if i not in want:
+                continue
+            if i < k:
+                out[i] = arr[:, i, :].tobytes()
+            else:
+                out[i] = np.ascontiguousarray(
+                    parity[:, i - k, :]).tobytes()
+        return out
+
+    # generic path: per-stripe through the interface (array codes, mappings)
+    parts: Dict[int, List[bytes]] = {i: [] for i in want}
+    for s in range(n_stripes):
+        encoded = ec_impl.encode(want, data[s * width:(s + 1) * width])
+        for i, buf in encoded.items():
+            assert len(buf) == chunk
+            parts[i].append(buf)
+    return {i: b"".join(bufs) for i, bufs in parts.items()}
+
+
+def decode(sinfo: StripeInfo, ec_impl,
+           to_decode: Mapping[int, bytes]) -> bytes:
+    """Per-shard chunk streams -> the original logical byte stream."""
+    assert to_decode
+    chunk = sinfo.get_chunk_size()
+    width = sinfo.get_stripe_width()
+    k = width // chunk
+    total = len(next(iter(to_decode.values())))
+    assert total % chunk == 0
+    for buf in to_decode.values():
+        assert len(buf) == total
+    if total == 0:
+        return b""
+    n_stripes = total // chunk
+
+    have = tuple(sorted(to_decode))
+    want = tuple(range(k))
+    erased = tuple(i for i in want if i not in to_decode)
+    if not erased:
+        cols = [np.frombuffer(to_decode[i], dtype=np.uint8).reshape(
+            n_stripes, chunk) for i in range(k)]
+        return np.stack(cols, axis=1).tobytes()
+    if hasattr(ec_impl, "decode_batch") and not ec_impl.get_chunk_mapping() \
+            and len(have) >= k:
+        survivors = np.stack([
+            np.frombuffer(to_decode[i], dtype=np.uint8).reshape(
+                n_stripes, chunk)
+            for i in have[:k]], axis=1)             # (B, k, chunk)
+        recovered = ec_impl.decode_batch(have[:k], erased, survivors)
+        cols = []
+        for i in range(k):
+            if i in to_decode:
+                cols.append(np.frombuffer(
+                    to_decode[i], dtype=np.uint8).reshape(n_stripes, chunk))
+            else:
+                cols.append(np.asarray(recovered[:, erased.index(i), :]))
+        return np.stack(cols, axis=1).tobytes()
+
+    out = []
+    for s in range(n_stripes):
+        chunks = {i: buf[s * chunk:(s + 1) * chunk]
+                  for i, buf in to_decode.items()}
+        row = ec_impl.decode_concat(chunks)
+        assert len(row) == width
+        out.append(row)
+    return b"".join(out)
+
+
+class HashInfo:
+    """Cumulative per-shard crc32c ledger (ECUtil.h:101-160)."""
+
+    def __init__(self, num_chunks: int = 0):
+        self.total_chunk_size = 0
+        self.cumulative_shard_hashes: List[int] = [0xFFFFFFFF] * num_chunks
+        self.projected_total_chunk_size = 0
+
+    def append(self, old_size: int, to_append: Mapping[int, bytes]) -> None:
+        assert old_size == self.total_chunk_size
+        appended = 0
+        for shard, buf in to_append.items():
+            appended = len(buf)
+            if self.has_chunk_hash():
+                assert shard < len(self.cumulative_shard_hashes)
+                self.cumulative_shard_hashes[shard] = cks.crc32c(
+                    self.cumulative_shard_hashes[shard], buf)
+        self.total_chunk_size += appended
+
+    def clear(self) -> None:
+        self.total_chunk_size = 0
+        self.cumulative_shard_hashes = [
+            0xFFFFFFFF] * len(self.cumulative_shard_hashes)
+
+    def get_chunk_hash(self, shard: int) -> int:
+        assert shard < len(self.cumulative_shard_hashes)
+        return self.cumulative_shard_hashes[shard]
+
+    def get_total_chunk_size(self) -> int:
+        return self.total_chunk_size
+
+    def get_total_logical_size(self, sinfo: StripeInfo) -> int:
+        return self.total_chunk_size * (
+            sinfo.get_stripe_width() // sinfo.get_chunk_size())
+
+    def has_chunk_hash(self) -> bool:
+        return bool(self.cumulative_shard_hashes)
+
+    def set_total_chunk_size_clear_hash(self, new_chunk_size: int) -> None:
+        self.cumulative_shard_hashes = []
+        self.total_chunk_size = new_chunk_size
+
+    # -- wire/xattr form --------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {"total_chunk_size": self.total_chunk_size,
+                "cumulative_shard_hashes": list(self.cumulative_shard_hashes)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "HashInfo":
+        hi = cls(0)
+        hi.total_chunk_size = int(d["total_chunk_size"])
+        hi.cumulative_shard_hashes = [
+            int(x) for x in d["cumulative_shard_hashes"]]
+        return hi

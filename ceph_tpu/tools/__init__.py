@@ -1,0 +1,9 @@
+"""CLI tools mirroring the reference's operator/test surface:
+
+- erasure_code_benchmark  (ceph_erasure_code_benchmark)
+- erasure_code_tool       (ceph-erasure-code-tool)
+- non_regression          (ceph_erasure_code_non_regression)
+- crushtool               (crushtool)
+
+Run as `python -m ceph_tpu.tools.<name> ...` with the reference's flags.
+"""
