@@ -17,6 +17,7 @@ from __future__ import annotations
 import numpy as np
 
 from ceph_tpu.ops import checksum as cks
+from ceph_tpu.ops import gf
 
 CSUM_NONE = 1
 CSUM_XXHASH32 = 2
@@ -71,7 +72,7 @@ def _calc_values(csum_type: int, blocks: np.ndarray, block_size: int,
                  init_value: int, use_tpu: bool) -> np.ndarray:
     n = blocks.size // block_size
     if csum_type in (CSUM_CRC32C, CSUM_CRC32C_16, CSUM_CRC32C_8):
-        if use_tpu and cks.HAVE_JAX and n >= 8:
+        if use_tpu and gf.backend_available() and n >= 8:
             vals = np.asarray(
                 cks.crc32c_batch_tpu(blocks.reshape(n, block_size),
                                      init=init_value))

@@ -1,0 +1,160 @@
+"""Leveled, ring-buffered, async logging.
+
+Reference parity: the dout framework
+(/root/reference/src/log/Log.cc + src/common/dout.h): per-subsystem
+`<stderr level>/<memory level>` pairs (debug_osd = "1/5"), an async writer
+thread draining a queue to the log file, and an in-memory ring of the most
+recent high-verbosity entries dumped on crash (`log_max_recent`) — the
+cheap-always/verbose-on-crash split.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import queue
+import sys
+import threading
+import time
+import traceback
+from typing import Deque, Dict, Optional, TextIO, Tuple
+
+_LEVEL_CACHE: Dict[str, Tuple[int, int]] = {}
+
+
+def parse_levels(spec: str) -> Tuple[int, int]:
+    """"1/5" -> (log_level, gather_level); "3" -> (3, 3)."""
+    if spec in _LEVEL_CACHE:
+        return _LEVEL_CACHE[spec]
+    if "/" in spec:
+        log_s, mem_s = spec.split("/", 1)
+        out = (int(log_s), int(mem_s))
+    else:
+        out = (int(spec), int(spec))
+    _LEVEL_CACHE[spec] = out
+    return out
+
+
+class Log:
+    """Per-process logger: subsystem levels, ring buffer, writer thread."""
+
+    def __init__(self, config=None, name: str = "", max_recent: int = 500):
+        self._config = config
+        self.name = name
+        self._subsys: Dict[str, Tuple[int, int]] = {}
+        self._recent: Deque[str] = collections.deque(maxlen=max_recent)
+        self._queue: "queue.Queue[Optional[str]]" = queue.Queue()
+        self._file: Optional[TextIO] = None
+        self._file_path: Optional[str] = None
+        self._stderr_level_default = 1
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        if config is not None:
+            self.reload_config()
+            config.add_observer(lambda keys: self.reload_config(),
+                                None)
+
+    # -- config -----------------------------------------------------------
+
+    def reload_config(self) -> None:
+        from ceph_tpu.common.options import OPTIONS
+
+        for name in OPTIONS:
+            if name.startswith("debug_"):
+                self._subsys[name[len("debug_"):]] = parse_levels(
+                    str(self._config.get(name)))
+        path = self._config.get("log_file")
+        if path and path != self._file_path:
+            self.set_log_file(path)
+
+    def set_subsys_level(self, subsys: str, spec: str) -> None:
+        self._subsys[subsys] = parse_levels(spec)
+
+    def set_log_file(self, path: str) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+            self._file = open(path, "a", buffering=1)
+            self._file_path = path
+        self._ensure_thread()
+
+    # -- emit -------------------------------------------------------------
+
+    def dout(self, subsys: str, level: int, message: str) -> None:
+        log_level, gather_level = self._subsys.get(subsys, (1, 5))
+        if level > max(log_level, gather_level):
+            return
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime())
+        line = (f"{stamp} {os.getpid()} {self.name or '-'}"
+                f" {level} {subsys}: {message}")
+        if level <= gather_level:
+            self._recent.append(line)
+        if level <= log_level:
+            if self._file is not None:
+                self._queue.put(line)
+            else:
+                print(line, file=sys.stderr)
+
+    def error(self, subsys: str, message: str) -> None:
+        self.dout(subsys, -1, message)
+
+    # -- crash dump -------------------------------------------------------
+
+    def dump_recent(self, out: Optional[TextIO] = None) -> None:
+        """Flush the in-memory ring (called on crash / assert)."""
+        out = out or (self._file if self._file is not None else sys.stderr)
+        out.write(f"--- begin dump of recent events ({len(self._recent)})"
+                  " ---\n")
+        for line in self._recent:
+            out.write(line + "\n")
+        out.write("--- end dump of recent events ---\n")
+        out.flush()
+
+    def install_crash_handler(self) -> None:
+        import signal
+
+        def handler(signum, frame):
+            self.error("none", f"*** Caught signal {signum} ***")
+            self._recent.append("".join(traceback.format_stack(frame)))
+            self.dump_recent()
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+        for sig in (signal.SIGSEGV, signal.SIGABRT, signal.SIGBUS):
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, OSError):  # non-main thread
+                pass
+
+    # -- writer thread ----------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._writer, name="log", daemon=True)
+            self._thread.start()
+
+    def _writer(self) -> None:
+        while True:
+            line = self._queue.get()
+            try:
+                if line is None:
+                    return
+                with self._lock:
+                    if self._file is not None:
+                        self._file.write(line + "\n")
+            finally:
+                self._queue.task_done()
+
+    def flush(self) -> None:
+        # join() returns only after the writer has task_done'd every
+        # enqueued line, including one it had already dequeued
+        self._queue.join()
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+
+    def stop(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            self._queue.put(None)
+            self._thread.join(timeout=2)

@@ -1,0 +1,152 @@
+"""Typed option schema.
+
+Reference parity: the Option registry
+(/root/reference/src/common/options.cc — 1,649 typed `Option(...)`
+definitions; schema in options.h): each option carries type, level,
+default (optionally HDD/SSD variants), min/max, enum values, description,
+see_also, and flags.  This module keeps the same schema and declares the
+options this framework actually consumes; `ceph_tpu.common.config` layers
+values over these defaults.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# levels (Option::level_t)
+LEVEL_BASIC = "basic"
+LEVEL_ADVANCED = "advanced"
+LEVEL_DEV = "dev"
+
+# flags (Option::flag_t)
+FLAG_RUNTIME = 1 << 0       # may change at runtime
+FLAG_STARTUP = 1 << 1       # only at daemon startup
+FLAG_CREATE = 1 << 2        # only at cluster/daemon creation
+
+
+@dataclass
+class Option:
+    name: str
+    type: str                       # int | uint | float | bool | str | size | secs
+    default: Any
+    level: str = LEVEL_ADVANCED
+    desc: str = ""
+    long_desc: str = ""
+    min: Optional[float] = None
+    max: Optional[float] = None
+    enum_values: Tuple[str, ...] = ()
+    see_also: Tuple[str, ...] = ()
+    flags: int = FLAG_RUNTIME
+    daemon_default: Dict[str, Any] = field(default_factory=dict)
+
+    _CASTS = {"int": int, "uint": int, "float": float, "size": int,
+              "secs": float, "bool": None, "str": str}
+
+    def cast(self, value: Any) -> Any:
+        """Parse/validate a raw (usually string) value; raises ValueError."""
+        if self.type == "bool":
+            if isinstance(value, bool):
+                out: Any = value
+            elif str(value).lower() in ("true", "1", "yes", "on"):
+                out = True
+            elif str(value).lower() in ("false", "0", "no", "off"):
+                out = False
+            else:
+                raise ValueError(f"{self.name}: {value!r} is not a bool")
+        else:
+            caster = self._CASTS.get(self.type)
+            if caster is None:
+                raise ValueError(f"{self.name}: unknown type {self.type}")
+            try:
+                out = caster(value)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"{self.name}: {value!r} is not a {self.type}")
+        if self.enum_values and out not in self.enum_values:
+            raise ValueError(
+                f"{self.name}: {out!r} not in {self.enum_values}")
+        if self.min is not None and out < self.min:
+            raise ValueError(f"{self.name}: {out} < min {self.min}")
+        if self.max is not None and out > self.max:
+            raise ValueError(f"{self.name}: {out} > max {self.max}")
+        return out
+
+
+def _opts() -> List[Option]:
+    A, B, D = LEVEL_ADVANCED, LEVEL_BASIC, LEVEL_DEV
+    return [
+        # -- erasure code (options.cc:2662-2709) --------------------------
+        Option("osd_pool_default_erasure_code_profile", "str",
+               "plugin=jerasure technique=reed_sol_van k=2 m=2", A,
+               desc="default erasure code profile"),
+        Option("osd_pool_erasure_code_stripe_unit", "size", 4096, A,
+               desc="chunk size for EC pools"),
+        Option("osd_erasure_code_plugins", "str", "jerasure isa lrc", A,
+               desc="EC plugins to preload", flags=FLAG_STARTUP),
+        Option("erasure_code_dir", "str", "", A,
+               desc="plugin directory (module path prefix here)",
+               flags=FLAG_STARTUP),
+        # -- compression / checksums (options.cc:4236-4311) ---------------
+        Option("bluestore_compression_algorithm", "str", "snappy", A,
+               enum_values=("", "snappy", "zlib", "zstd", "lz4", "brotli",
+                            "none"),
+               desc="default blob compressor"),
+        Option("bluestore_compression_mode", "str", "none", A,
+               enum_values=("none", "passive", "aggressive", "force"),
+               desc="when to compress"),
+        Option("bluestore_compression_required_ratio", "float", 0.875, A,
+               min=0.0, max=1.0,
+               desc="compressed size must be below this ratio of raw"),
+        Option("bluestore_compression_min_blob_size", "size", 8192, A),
+        Option("bluestore_compression_max_blob_size", "size", 65536, A),
+        Option("bluestore_csum_type", "str", "crc32c", A,
+               enum_values=("none", "crc32c", "crc32c_16", "crc32c_8",
+                            "xxhash32", "xxhash64"),
+               desc="per-block checksum algorithm"),
+        Option("bluestore_csum_block_size", "size", 4096, D),
+        # -- tpu dispatch --------------------------------------------------
+        Option("tpu_ec_batch_stripes", "uint", 16, A,
+               desc="stripes coalesced per EC device dispatch"),
+        Option("tpu_min_dispatch_bytes", "size", 65536, A,
+               desc="below this the host codec runs instead of the TPU"),
+        # -- messenger / failure detection (options.cc:875-1108) ----------
+        Option("ms_inject_socket_failures", "uint", 0, D,
+               desc="inject a socket failure every Nth message"),
+        Option("ms_inject_internal_delays", "float", 0.0, D),
+        Option("ms_dispatch_throttle_bytes", "size", 100 << 20, A),
+        Option("osd_heartbeat_interval", "secs", 6.0, A, min=0.1, max=60),
+        Option("osd_heartbeat_grace", "secs", 20.0, A),
+        Option("mon_osd_min_down_reporters", "uint", 2, A),
+        Option("mon_osd_laggy_halflife", "secs", 3600.0, A),
+        Option("mon_osd_laggy_weight", "float", 0.3, A, min=0.0, max=1.0),
+        Option("mon_osd_adjust_heartbeat_grace", "bool", True, A),
+        Option("heartbeat_inject_failure", "uint", 0, D),
+        # -- osd/pg --------------------------------------------------------
+        Option("osd_pool_default_size", "uint", 3, B),
+        Option("osd_pool_default_min_size", "uint", 0, A),
+        Option("osd_pool_default_pg_num", "uint", 32, B),
+        Option("osd_max_backfills", "uint", 1, A),
+        Option("osd_recovery_max_active", "uint", 0, A),
+        Option("osd_scrub_auto_repair", "bool", False, A),
+        # -- logging -------------------------------------------------------
+        Option("log_file", "str", "", B, flags=FLAG_STARTUP),
+        Option("log_max_recent", "uint", 500, A),
+        Option("debug_osd", "str", "1/5", A),
+        Option("debug_ec", "str", "1/5", A),
+        Option("debug_crush", "str", "1/5", A),
+        Option("debug_compressor", "str", "1/5", A),
+        Option("debug_ms", "str", "0/5", A),
+        Option("debug_mon", "str", "1/5", A),
+        Option("debug_bluestore", "str", "1/5", A),
+        # -- admin socket --------------------------------------------------
+        Option("admin_socket", "str", "", A, flags=FLAG_STARTUP,
+               desc="path to the unix admin socket"),
+    ]
+
+
+OPTIONS: Dict[str, Option] = {o.name: o for o in _opts()}
+
+
+def get_option(name: str) -> Option:
+    return OPTIONS[name]

@@ -1,0 +1,94 @@
+"""Throttles: bounded counters gating queues.
+
+Reference parity: Throttle / BackoffThrottle
+(/root/reference/src/common/Throttle.{h,cc}): a named max-bounded counter;
+`get(c)` blocks while the budget is exhausted (FIFO wakeup), `get_or_fail`
+never blocks, `put(c)` returns budget and wakes waiters.  Used on every
+ingest path (messenger dispatch bytes, osd op bytes, recovery ops).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Optional
+
+
+class Throttle:
+    def __init__(self, name: str, max_: int):
+        self.name = name
+        self._max = max_
+        self._count = 0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # FIFO ticket queue: a blocked get() only proceeds at the head,
+        # so small requests cannot starve a large one (the reference keeps
+        # an ordered list of per-waiter condition variables)
+        self._tickets: collections.deque = collections.deque()
+
+    # -- introspection ----------------------------------------------------
+
+    def get_current(self) -> int:
+        with self._lock:
+            return self._count
+
+    def get_max(self) -> int:
+        return self._max
+
+    def past_midpoint(self) -> bool:
+        with self._lock:
+            return self._count >= self._max / 2
+
+    # -- acquire / release ------------------------------------------------
+
+    def _should_wait(self, c: int) -> bool:
+        if not self._max:
+            return False
+        # a single request larger than max is allowed through alone
+        return ((c <= self._max and self._count + c > self._max) or
+                (c > self._max and self._count > 0))
+
+    def get(self, c: int = 1, timeout: Optional[float] = None) -> bool:
+        """Block until c fits (FIFO order); False on timeout."""
+        assert c >= 0
+        ticket = object()
+        with self._cond:
+            self._tickets.append(ticket)
+            try:
+                ok = self._cond.wait_for(
+                    lambda: (self._tickets[0] is ticket
+                             and not self._should_wait(c)), timeout)
+                if not ok:
+                    return False
+                self._count += c
+                return True
+            finally:
+                self._tickets.remove(ticket)
+                self._cond.notify_all()  # next ticket may now be at head
+
+    def get_or_fail(self, c: int = 1) -> bool:
+        with self._lock:
+            if self._tickets or self._should_wait(c):
+                return False
+            self._count += c
+            return True
+
+    def put(self, c: int = 1) -> int:
+        with self._cond:
+            assert self._count >= c
+            self._count -= c
+            self._cond.notify_all()
+            return self._count
+
+    def reset_max(self, new_max: int) -> None:
+        with self._cond:
+            self._max = new_max
+            self._cond.notify_all()
+
+    def __enter__(self):
+        self.get(1)
+        return self
+
+    def __exit__(self, *exc):
+        self.put(1)
+        return False

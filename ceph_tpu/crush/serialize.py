@@ -47,6 +47,10 @@ def to_json(cmap: CrushMap) -> Dict[str, Any]:
         "choose_args": {
             str(bid): {"weight_set": ca.weight_set, "ids": ca.ids}
             for bid, ca in cmap.choose_args.items()},
+        "choose_args_maps": {
+            name: {str(bid): {"weight_set": ca.weight_set, "ids": ca.ids}
+                   for bid, ca in args.items()}
+            for name, args in cmap.choose_args_maps.items()},
     }
 
 
@@ -81,4 +85,9 @@ def from_json(data: Dict[str, Any]) -> CrushMap:
     for bid, ca in data.get("choose_args", {}).items():
         cmap.choose_args[int(bid)] = ChooseArg(
             weight_set=ca.get("weight_set"), ids=ca.get("ids"))
+    for name, args in data.get("choose_args_maps", {}).items():
+        cmap.choose_args_maps[name] = {
+            int(bid): ChooseArg(weight_set=ca.get("weight_set"),
+                                ids=ca.get("ids"))
+            for bid, ca in args.items()}
     return cmap

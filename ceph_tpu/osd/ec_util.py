@@ -113,8 +113,7 @@ def encode(sinfo: StripeInfo, ec_impl, data: bytes,
             f" alignment: a {width}-byte stripe encodes to"
             f" {ec_impl.get_chunk_size(width)}-byte chunks")
 
-    if hasattr(ec_impl, "encode_batch") and not ec_impl.get_chunk_mapping() \
-            and ec_impl.get_chunk_size(width) == chunk:
+    if hasattr(ec_impl, "encode_batch") and not ec_impl.get_chunk_mapping():
         arr = np.frombuffer(data, dtype=np.uint8).reshape(n_stripes, k, chunk)
         parity = ec_impl.encode_batch(arr)           # (B, m, chunk)
         for i in range(n):

@@ -103,7 +103,10 @@ def run_test(cmap: CrushMap, args: argparse.Namespace) -> int:
                       f" result size == {size}:\t{count}/{len(xs)}")
 
     if compare_lines is not None:
-        print(f"compared {compare_idx} mappings, {mismatches} mismatches")
+        # reference lines never reached are mismatches too
+        mismatches += max(0, len(compare_lines) - compare_idx)
+        print(f"compared {max(compare_idx, len(compare_lines))} mappings,"
+              f" {mismatches} mismatches")
         return 1 if mismatches else 0
     return 0
 

@@ -1,0 +1,360 @@
+"""Foundation tests: options/config layering, perf counters, admin socket
+wire protocol, logging ring, throttles."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.common import admin_socket as asok
+from ceph_tpu.common.config import Config
+from ceph_tpu.common.log import Log, parse_levels
+from ceph_tpu.common.options import OPTIONS, get_option
+from ceph_tpu.common.perf_counters import PerfCounters, PerfCountersCollection
+from ceph_tpu.common.throttle import Throttle
+
+
+# -- options ---------------------------------------------------------------
+
+
+def test_option_cast_types():
+    assert get_option("osd_pool_default_size").cast("5") == 5
+    assert get_option("bluestore_compression_required_ratio").cast("0.5") == 0.5
+    assert get_option("mon_osd_adjust_heartbeat_grace").cast("false") is False
+    with pytest.raises(ValueError):
+        get_option("osd_pool_default_size").cast("five")
+    with pytest.raises(ValueError):
+        get_option("bluestore_compression_mode").cast("sometimes")  # enum
+    with pytest.raises(ValueError):
+        get_option("bluestore_compression_required_ratio").cast("1.5")  # max
+
+
+def test_options_schema_is_populated():
+    assert len(OPTIONS) > 30
+    assert "bluestore_csum_type" in OPTIONS
+    assert OPTIONS["osd_pool_default_erasure_code_profile"].default.startswith(
+        "plugin=jerasure")
+
+
+# -- config layering -------------------------------------------------------
+
+
+def test_config_precedence():
+    cfg = Config()
+    assert cfg.get("osd_pool_default_size") == 3  # default
+    cfg.set_val("osd_pool_default_size", "5", source="file")
+    assert cfg.get("osd_pool_default_size") == 5
+    cfg.set_val("osd_pool_default_size", "4", source="mon")
+    assert cfg.get("osd_pool_default_size") == 4  # mon beats file
+    cfg.set_val("osd_pool_default_size", "2", source="runtime")
+    assert cfg.get("osd_pool_default_size") == 2  # runtime beats all
+    cfg.rm_val("osd_pool_default_size", source="runtime")
+    assert cfg.get("osd_pool_default_size") == 4  # falls back to mon
+    assert cfg.source_of("osd_pool_default_size") == "mon"
+
+
+def test_config_observers():
+    cfg = Config()
+    seen = []
+    cfg.add_observer(lambda keys: seen.append(sorted(keys)),
+                     keys=["osd_heartbeat_grace"])
+    cfg.set_val("osd_pool_default_size", 5)      # not watched
+    cfg.set_val("osd_heartbeat_grace", "30")
+    assert seen == [["osd_heartbeat_grace"]]
+    assert cfg.get("osd_heartbeat_grace") == 30.0
+
+
+def test_config_file_sections(tmp_path):
+    conf = tmp_path / "ceph.conf"
+    conf.write_text("""
+[global]
+osd pool default size = 5
+[osd]
+osd heartbeat grace = 25
+[osd.3]
+osd heartbeat grace = 40
+""")
+    cfg = Config(entity="osd.3")
+    cfg.parse_config_file(str(conf))
+    assert cfg.get("osd_pool_default_size") == 5
+    assert cfg.get("osd_heartbeat_grace") == 40.0  # most specific wins
+    cfg2 = Config(entity="osd.7")
+    cfg2.parse_config_file(str(conf))
+    assert cfg2.get("osd_heartbeat_grace") == 25.0
+
+
+def test_config_argv_and_env():
+    cfg = Config()
+    leftover = cfg.parse_argv(["--osd-pool-default-size=6", "positional",
+                               "--osd_heartbeat_grace", "33", "-x"])
+    assert leftover == ["positional", "-x"]
+    assert cfg.get("osd_pool_default_size") == 6
+    assert cfg.get("osd_heartbeat_grace") == 33.0
+    cfg.parse_env({"CEPH_TPU_OSD_POOL_DEFAULT_SIZE": "7"})
+    # env is BELOW cli in precedence
+    assert cfg.get("osd_pool_default_size") == 6
+    assert cfg.diff()["osd_pool_default_size"]["source"] == "cli"
+
+
+def test_config_rejects_unknown_and_invalid():
+    cfg = Config()
+    with pytest.raises(KeyError):
+        cfg.set_val("nonesuch_option", 1)
+    with pytest.raises(ValueError):
+        cfg.set_val("bluestore_compression_mode", "sometimes")
+
+
+# -- perf counters ---------------------------------------------------------
+
+
+def test_perf_counters_basic():
+    pc = PerfCounters("osd")
+    pc.add_u64_counter("op_w", "writes")
+    pc.add_time_avg("op_w_lat", "write latency")
+    pc.add_histogram("op_size", [1024, 4096, 65536])
+    pc.inc("op_w")
+    pc.inc("op_w", 4)
+    pc.tinc("op_w_lat", 0.5)
+    pc.tinc("op_w_lat", 1.5)
+    pc.hinc("op_size", 100)
+    pc.hinc("op_size", 5000)
+    pc.hinc("op_size", 10 << 20)
+    d = pc.dump()
+    assert d["op_w"] == 5
+    assert d["op_w_lat"]["avgcount"] == 2 and d["op_w_lat"]["avgtime"] == 1.0
+    assert d["op_size"]["buckets"] == [1, 0, 1, 1]
+
+
+def test_perf_counters_timer():
+    pc = PerfCounters("x")
+    pc.add_time_avg("lat")
+    with pc.time_it("lat"):
+        time.sleep(0.01)
+    assert pc.avg("lat") >= 0.01
+
+
+def test_perf_collection():
+    coll = PerfCountersCollection()
+    a, b = PerfCounters("osd"), PerfCounters("bluestore")
+    a.add_u64("n")
+    b.add_u64("m")
+    coll.add(a)
+    coll.add(b)
+    a.set("n", 42)
+    assert coll.dump()["osd"]["n"] == 42
+    assert set(coll.dump()) == {"osd", "bluestore"}
+    assert set(coll.dump("osd")) == {"osd"}
+    assert "description" in coll.schema()["bluestore"]["m"]
+
+
+# -- admin socket ----------------------------------------------------------
+
+
+@pytest.fixture
+def admin(tmp_path):
+    cfg = Config()
+    coll = PerfCountersCollection()
+    pc = PerfCounters("osd")
+    pc.add_u64_counter("ops")
+    pc.inc("ops", 7)
+    coll.add(pc)
+    sock = asok.AdminSocket(str(tmp_path / "asok"), config=cfg, perf=coll,
+                            version="16.0.0-tpu")
+    sock.init()
+    yield sock
+    sock.shutdown()
+
+
+def test_admin_socket_version(admin):
+    out = asok.admin_socket_request(admin.path, "version")
+    assert out == {"version": "16.0.0-tpu"}
+
+
+def test_admin_socket_perf_dump(admin):
+    out = asok.admin_socket_request(admin.path, {"prefix": "perf dump"})
+    assert out["osd"]["ops"] == 7
+
+
+def test_admin_socket_config_get_set(admin):
+    out = asok.admin_socket_request(
+        admin.path, {"prefix": "config get", "var": "osd_heartbeat_grace"})
+    assert out == {"osd_heartbeat_grace": 20.0}
+    out = asok.admin_socket_request(
+        admin.path, "config set osd_heartbeat_grace 42")
+    assert out == {"success": ""}
+    out = asok.admin_socket_request(
+        admin.path, "config get osd_heartbeat_grace")
+    assert out == {"osd_heartbeat_grace": 42.0}
+    out = asok.admin_socket_request(admin.path, "config diff")
+    assert out["osd_heartbeat_grace"]["source"] == "runtime"
+
+
+def test_admin_socket_help_and_unknown(admin):
+    out = asok.admin_socket_request(admin.path, "help")
+    assert "perf dump" in out
+    out = asok.admin_socket_request(admin.path, "frobnicate")
+    assert "error" in out
+
+
+def test_admin_socket_custom_command(admin):
+    admin.register_command("dump_ops_in_flight",
+                           lambda cmd: {"ops": [], "num_ops": 0})
+    out = asok.admin_socket_request(admin.path, "dump_ops_in_flight")
+    assert out == {"ops": [], "num_ops": 0}
+
+
+# -- logging ---------------------------------------------------------------
+
+
+def test_parse_levels():
+    assert parse_levels("1/5") == (1, 5)
+    assert parse_levels("3") == (3, 3)
+
+
+def test_log_levels_and_ring(tmp_path, capsys):
+    cfg = Config()
+    log = Log(cfg, name="osd.0")
+    log.set_subsys_level("osd", "1/5")
+    log.dout("osd", 0, "always visible")
+    log.dout("osd", 3, "ring only")       # gathered, not printed
+    log.dout("osd", 20, "dropped")
+    err = capsys.readouterr().err
+    assert "always visible" in err
+    assert "ring only" not in err
+    import io
+    buf = io.StringIO()
+    log.dump_recent(out=buf)
+    dumped = buf.getvalue()
+    assert "ring only" in dumped
+    assert "dropped" not in dumped
+
+
+def test_log_file_async(tmp_path):
+    cfg = Config()
+    log = Log(cfg, name="osd.1")
+    path = str(tmp_path / "osd.log")
+    log.set_log_file(path)
+    log.set_subsys_level("osd", "5/5")
+    for i in range(50):
+        log.dout("osd", 1, f"line {i}")
+    log.flush()
+    log.stop()
+    content = open(path).read()
+    assert "line 0" in content and "line 49" in content
+
+
+def test_log_reconfig_via_observer():
+    cfg = Config()
+    log = Log(cfg, name="x")
+    assert log._subsys["ms"] == (0, 5)
+    cfg.set_val("debug_ms", "4/9")
+    assert log._subsys["ms"] == (4, 9)
+
+
+# -- throttle --------------------------------------------------------------
+
+
+def test_throttle_basic():
+    t = Throttle("bytes", 100)
+    assert t.get(60)
+    assert t.get_or_fail(40)
+    assert not t.get_or_fail(1)   # full
+    t.put(50)
+    assert t.get_or_fail(10)
+    assert t.get_current() == 60
+
+
+def test_throttle_blocks_and_wakes():
+    t = Throttle("ops", 2)
+    t.get(2)
+    acquired = []
+
+    def worker():
+        t.get(1)
+        acquired.append(1)
+
+    th = threading.Thread(target=worker)
+    th.start()
+    time.sleep(0.05)
+    assert not acquired          # blocked
+    t.put(1)
+    th.join(timeout=2)
+    assert acquired == [1]
+
+
+def test_throttle_oversized_request():
+    t = Throttle("x", 10)
+    # a request larger than max is admitted when the throttle is empty
+    assert t.get(25, timeout=1)
+    assert t.get_current() == 25
+    assert not t.get_or_fail(1)
+    t.put(25)
+
+
+def test_throttle_timeout():
+    t = Throttle("x", 1)
+    t.get(1)
+    t0 = time.time()
+    assert not t.get(1, timeout=0.1)
+    assert time.time() - t0 < 1.0
+
+
+def test_throttle_unlimited():
+    t = Throttle("x", 0)  # max 0 = no limit (reference semantics)
+    assert t.get_or_fail(1 << 40)
+    t.put(1 << 40)
+
+
+def test_throttle_fifo_no_starvation():
+    """A large blocked request must not be starved by later small ones."""
+    t = Throttle("x", 100)
+    t.get(100)
+    order = []
+
+    def big():
+        t.get(80)
+        order.append("big")
+        t.put(80)
+
+    def small():
+        t.get(10)
+        order.append("small")
+        t.put(10)
+
+    tb = threading.Thread(target=big)
+    tb.start()
+    time.sleep(0.05)
+    ts = threading.Thread(target=small)
+    ts.start()
+    time.sleep(0.05)
+    # drain: big (queued first) must acquire before small
+    t.put(100)
+    tb.join(timeout=2)
+    ts.join(timeout=2)
+    assert order[0] == "big"
+
+
+def test_log_runtime_log_file_switch(tmp_path):
+    cfg = Config()
+    log = Log(cfg, name="osd.9")
+    a, b = str(tmp_path / "a.log"), str(tmp_path / "b.log")
+    cfg.set_val("log_file", a)
+    log.set_subsys_level("osd", "5/5")
+    log.dout("osd", 1, "to-a")
+    log.flush()
+    cfg.set_val("log_file", b)          # runtime switch via observer
+    log.dout("osd", 1, "to-b")
+    log.flush()
+    log.stop()
+    assert "to-a" in open(a).read()
+    content_b = open(b).read()
+    assert "to-b" in content_b and "to-a" not in content_b
+
+
+def test_admin_socket_perf_dump_filter(admin):
+    out = asok.admin_socket_request(admin.path, "perf dump osd")
+    assert set(out) == {"osd"}
+    out = asok.admin_socket_request(admin.path, "perf dump nonesuch")
+    assert out == {}
