@@ -85,10 +85,13 @@ class Config:
         if apply:
             self.apply_changes()
 
-    def rm_val(self, name: str, source: str = "runtime") -> None:
+    def rm_val(self, name: str, source: str = "runtime",
+               apply: bool = True) -> None:
         with self._lock:
             if self._values[source].pop(name, None) is not None:
                 self._staged.add(name)
+        if apply:
+            self.apply_changes()
 
     def apply_changes(self) -> Set[str]:
         with self._lock:

@@ -63,6 +63,9 @@ class Log:
             if name.startswith("debug_"):
                 self._subsys[name[len("debug_"):]] = parse_levels(
                     str(self._config.get(name)))
+        max_recent = int(self._config.get("log_max_recent"))
+        if max_recent != self._recent.maxlen:
+            self._recent = collections.deque(self._recent, maxlen=max_recent)
         path = self._config.get("log_file")
         if path and path != self._file_path:
             self.set_log_file(path)
@@ -71,6 +74,10 @@ class Log:
         self._subsys[subsys] = parse_levels(spec)
 
     def set_log_file(self, path: str) -> None:
+        if self._file is not None:
+            # drain queued lines into the OLD file before switching, so a
+            # runtime log_file change doesn't misroute earlier entries
+            self._queue.join()
         with self._lock:
             if self._file is not None:
                 self._file.close()

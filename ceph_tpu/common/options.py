@@ -43,8 +43,19 @@ class Option:
     _CASTS = {"int": int, "uint": int, "float": float, "size": int,
               "secs": float, "bool": None, "str": str}
 
+    _SIZE_SUFFIXES = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30,
+                      "t": 1 << 40, "p": 1 << 50}
+
     def cast(self, value: Any) -> Any:
         """Parse/validate a raw (usually string) value; raises ValueError."""
+        if self.type == "size" and isinstance(value, str):
+            s = value.strip().lower().rstrip("b").rstrip("i")
+            if s and s[-1] in self._SIZE_SUFFIXES:
+                try:
+                    value = int(float(s[:-1]) * self._SIZE_SUFFIXES[s[-1]])
+                except ValueError:
+                    raise ValueError(
+                        f"{self.name}: {value!r} is not a size")
         if self.type == "bool":
             if isinstance(value, bool):
                 out: Any = value

@@ -358,3 +358,33 @@ def test_admin_socket_perf_dump_filter(admin):
     assert set(out) == {"osd"}
     out = asok.admin_socket_request(admin.path, "perf dump nonesuch")
     assert out == {}
+
+
+def test_size_option_suffixes():
+    opt = get_option("tpu_min_dispatch_bytes")
+    assert opt.cast("64K") == 64 << 10
+    assert opt.cast("100M") == 100 << 20
+    assert opt.cast("1G") == 1 << 30
+    assert opt.cast("2MiB") == 2 << 20
+    with pytest.raises(ValueError):
+        opt.cast("64Q")
+
+
+def test_rm_val_notifies_observers():
+    cfg = Config()
+    seen = []
+    cfg.add_observer(lambda keys: seen.append(sorted(keys)),
+                     keys=["debug_ms"])
+    cfg.set_val("debug_ms", "4/9")
+    cfg.rm_val("debug_ms")
+    assert seen == [["debug_ms"], ["debug_ms"]]
+
+
+def test_log_max_recent_config():
+    cfg = Config()
+    log = Log(cfg, name="x")
+    cfg.set_val("log_max_recent", 7)
+    log.set_subsys_level("osd", "0/5")
+    for i in range(20):
+        log.dout("osd", 3, f"r{i}")
+    assert len(log._recent) == 7

@@ -1,0 +1,310 @@
+"""Encoding + OSDMap tests, mirroring TestOSDMap.cc coverage: placement
+pipeline (raw->upmap->up->temp), incrementals, encode/decode round trips,
+bulk mapping consistency, osdmaptool."""
+
+import pytest
+
+from ceph_tpu.common.encoding import Decoder, DecodeError, Encoder
+from ceph_tpu.crush.map import CRUSH_ITEM_NONE
+from ceph_tpu.osd.osdmap import (
+    CEPH_OSD_EXISTS,
+    CEPH_OSD_IN,
+    CEPH_OSD_UP,
+    Incremental,
+    OSDMap,
+    OSDMapMapping,
+    PgId,
+    PgPool,
+    TYPE_ERASURE,
+    TYPE_REPLICATED,
+    ceph_stable_mod,
+)
+
+
+# -- encoding --------------------------------------------------------------
+
+
+def test_encoding_primitives_round_trip():
+    enc = Encoder()
+    enc.u8(7)
+    enc.u32(0xDEADBEEF)
+    enc.s64(-12345678901234)
+    enc.f64(3.5)
+    enc.string("héllo")
+    enc.bytes(b"\x00\x01")
+    enc.list([1, 2, 3], Encoder.u16)
+    enc.map({"a": 1, "b": 2}, Encoder.string, Encoder.u32)
+    enc.optional(None, Encoder.u32)
+    enc.optional(9, Encoder.u32)
+    dec = Decoder(enc.to_bytes())
+    assert dec.u8() == 7
+    assert dec.u32() == 0xDEADBEEF
+    assert dec.s64() == -12345678901234
+    assert dec.f64() == 3.5
+    assert dec.string() == "héllo"
+    assert dec.bytes() == b"\x00\x01"
+    assert dec.list(Decoder.u16) == [1, 2, 3]
+    assert dec.map(Decoder.string, Decoder.u32) == {"a": 1, "b": 2}
+    assert dec.optional(Decoder.u32) is None
+    assert dec.optional(Decoder.u32) == 9
+    assert dec.remaining() == 0
+
+
+def test_encoding_versioned_skip_unknown_tail():
+    """A v2 encoder appends fields a v1 decoder doesn't know: DECODE_FINISH
+    must skip them (the rolling-upgrade contract)."""
+    enc = Encoder()
+    enc.start(2, 1)
+    enc.u32(42)
+    enc.string("new field the old decoder ignores")
+    enc.finish()
+    enc.u32(777)  # data after the struct
+    dec = Decoder(enc.to_bytes())
+    v = dec.start(1)
+    assert v == 2
+    assert dec.u32() == 42
+    dec.finish()              # skips the unknown string
+    assert dec.u32() == 777
+
+
+def test_encoding_compat_rejection():
+    enc = Encoder()
+    enc.start(5, 3)
+    enc.u32(1)
+    enc.finish()
+    dec = Decoder(enc.to_bytes())
+    with pytest.raises(DecodeError):
+        dec.start(2)          # we only understand compat 2 < 3
+
+
+def test_encoding_bounds_checked():
+    dec = Decoder(b"\x01\x00")
+    with pytest.raises(DecodeError):
+        dec.u32()
+
+
+# -- stable mod ------------------------------------------------------------
+
+
+def test_ceph_stable_mod():
+    # pg_num 12, mask 15: values >= 12 fold to & 7
+    assert ceph_stable_mod(5, 12, 15) == 5
+    assert ceph_stable_mod(13, 12, 15) == 13 & 7
+    for x in range(64):
+        assert 0 <= ceph_stable_mod(x, 12, 15) < 12
+
+
+# -- OSDMap placement ------------------------------------------------------
+
+
+@pytest.fixture
+def osdmap():
+    m = OSDMap.build_simple(12, osds_per_host=3)
+    m.create_pool("data", size=3, pg_num=32)
+    return m
+
+
+def test_build_simple(osdmap):
+    assert osdmap.max_osd == 12
+    assert all(osdmap.is_up(o) and osdmap.is_in(o) for o in range(12))
+    assert osdmap.lookup_pool("data") == 1
+    assert osdmap.lookup_pool("nope") == -1
+
+
+def test_placement_basic(osdmap):
+    seen = set()
+    for ps in range(32):
+        up, up_p, acting, acting_p = osdmap.pg_to_up_acting_osds(
+            PgId(1, ps))
+        assert len(up) == 3
+        assert len(set(up)) == 3             # distinct osds
+        assert up_p == up[0]
+        assert acting == up and acting_p == up_p
+        seen.update(up)
+    assert len(seen) >= 10                   # spread over the cluster
+
+
+def test_placement_out_of_range_pg(osdmap):
+    up, up_p, acting, acting_p = osdmap.pg_to_up_acting_osds(PgId(1, 999))
+    assert up == [] and up_p == -1
+    up, up_p, acting, acting_p = osdmap.pg_to_up_acting_osds(PgId(9, 0))
+    assert up == [] and acting == []
+
+
+def test_down_osd_filtered(osdmap):
+    pg = PgId(1, 5)
+    up0, _p, _a, _ap = osdmap.pg_to_up_acting_osds(pg)
+    victim = up0[0]
+    osdmap.osd_state[victim] &= ~CEPH_OSD_UP
+    up1, p1, _a1, _ap1 = osdmap.pg_to_up_acting_osds(pg)
+    assert victim not in up1
+    assert len(up1) == 2                     # replicated pool shifts
+    assert p1 == up1[0]
+
+
+def test_erasure_pool_holes():
+    m = OSDMap.build_simple(12, osds_per_host=3)
+    ruleno = m.crush.add_simple_rule(
+        "ecrule", "default", "host", "", "indep", pool_type="erasure")
+    m.create_pool("ecpool", type_=TYPE_ERASURE, size=4, pg_num=16,
+                  crush_rule=ruleno)
+    pg = PgId(1, 3)
+    up0, _p, _a, _ap = m.pg_to_up_acting_osds(pg)
+    assert len(up0) == 4
+    victim = up0[2]
+    m.osd_state[victim] &= ~CEPH_OSD_UP
+    up1, _p1, _a1, _ap1 = m.pg_to_up_acting_osds(pg)
+    assert len(up1) == 4
+    assert up1[2] == CRUSH_ITEM_NONE         # positional hole, no shift
+    assert [o for i, o in enumerate(up1) if i != 2] == \
+        [o for i, o in enumerate(up0) if i != 2]
+
+
+def test_pg_temp_overrides_acting(osdmap):
+    pg = PgId(1, 7)
+    up, up_p, acting, acting_p = osdmap.pg_to_up_acting_osds(pg)
+    override = [o for o in range(12) if o not in up][:3]
+    osdmap.pg_temp[pg] = override
+    up2, up_p2, acting2, acting_p2 = osdmap.pg_to_up_acting_osds(pg)
+    assert up2 == up                         # up unchanged
+    assert acting2 == override
+    assert acting_p2 == override[0]
+    osdmap.primary_temp[pg] = override[1]
+    _u, _up, _a, acting_p3 = osdmap.pg_to_up_acting_osds(pg)
+    assert acting_p3 == override[1]
+
+
+def test_pg_upmap(osdmap):
+    pg = PgId(1, 9)
+    up0, _p, _a, _ap = osdmap.pg_to_up_acting_osds(pg)
+    spare = [o for o in range(12) if o not in up0]
+    target = [spare[0], spare[1], up0[2]]
+    osdmap.pg_upmap[pg] = target
+    up1, _p1, _a1, _ap1 = osdmap.pg_to_up_acting_osds(pg)
+    assert up1 == target
+
+
+def test_pg_upmap_items(osdmap):
+    pg = PgId(1, 11)
+    up0, _p, _a, _ap = osdmap.pg_to_up_acting_osds(pg)
+    spare = [o for o in range(12) if o not in up0][0]
+    osdmap.pg_upmap_items[pg] = [(up0[1], spare)]
+    up1, _p1, _a1, _ap1 = osdmap.pg_to_up_acting_osds(pg)
+    assert up1[1] == spare
+    assert up1[0] == up0[0] and up1[2] == up0[2]
+
+
+def test_upmap_rejected_when_target_out(osdmap):
+    pg = PgId(1, 9)
+    up0, _p, _a, _ap = osdmap.pg_to_up_acting_osds(pg)
+    spare = [o for o in range(12) if o not in up0][0]
+    osdmap.osd_weight[spare] = 0             # marked out
+    osdmap.pg_upmap[pg] = [spare] + up0[1:]
+    up1, _p1, _a1, _ap1 = osdmap.pg_to_up_acting_osds(pg)
+    assert up1 == up0                        # explicit mapping ignored
+
+
+def test_primary_affinity(osdmap):
+    pg = PgId(1, 4)
+    up0, p0, _a, _ap = osdmap.pg_to_up_acting_osds(pg)
+    osdmap.osd_primary_affinity = [0x10000] * 12
+    osdmap.osd_primary_affinity[p0] = 0      # never primary
+    up1, p1, _a1, _ap1 = osdmap.pg_to_up_acting_osds(pg)
+    assert p1 != p0
+    assert p1 in up0
+    assert up1[0] == p1                      # replicated: moved to front
+
+
+def test_incremental_apply(osdmap):
+    epoch0 = osdmap.epoch
+    inc = Incremental(epoch=epoch0 + 1)
+    inc.new_state[3] = CEPH_OSD_UP           # XOR: up -> down
+    inc.new_weight[5] = 0                    # mark out
+    inc.new_erasure_code_profiles["myprofile"] = {
+        "plugin": "jerasure", "k": "4", "m": "2"}
+    osdmap.apply_incremental(inc)
+    assert osdmap.epoch == epoch0 + 1
+    assert osdmap.is_down(3)
+    assert osdmap.is_out(5)
+    assert osdmap.erasure_code_profiles["myprofile"]["k"] == "4"
+    # wrong epoch rejected
+    with pytest.raises(AssertionError):
+        osdmap.apply_incremental(Incremental(epoch=epoch0 + 5))
+    # revive via XOR
+    inc2 = Incremental(epoch=osdmap.epoch + 1)
+    inc2.new_state[3] = CEPH_OSD_UP
+    inc2.new_weight[5] = CEPH_OSD_IN
+    osdmap.apply_incremental(inc2)
+    assert osdmap.is_up(3) and osdmap.is_in(5)
+
+
+def test_pg_temp_incremental_removal(osdmap):
+    pg = PgId(1, 2)
+    inc = Incremental(epoch=osdmap.epoch + 1)
+    inc.new_pg_temp[pg] = [0, 1, 2]
+    osdmap.apply_incremental(inc)
+    assert osdmap.pg_temp[pg] == [0, 1, 2]
+    inc2 = Incremental(epoch=osdmap.epoch + 1)
+    inc2.new_pg_temp[pg] = []                # empty list removes
+    osdmap.apply_incremental(inc2)
+    assert pg not in osdmap.pg_temp
+
+
+def test_osdmap_encode_decode(osdmap):
+    osdmap.erasure_code_profiles["p"] = {"plugin": "jerasure", "k": "2",
+                                         "m": "1"}
+    osdmap.pg_temp[PgId(1, 3)] = [4, 5, 6]
+    osdmap.pg_upmap_items[PgId(1, 4)] = [(1, 7)]
+    data = osdmap.encode()
+    m2 = OSDMap.decode(data)
+    assert m2.epoch == osdmap.epoch
+    assert m2.max_osd == osdmap.max_osd
+    assert m2.pools[1].name == "data"
+    assert m2.erasure_code_profiles == osdmap.erasure_code_profiles
+    assert m2.pg_temp == osdmap.pg_temp
+    assert m2.pg_upmap_items == {PgId(1, 4): [(1, 7)]}
+    # placements identical after the round trip
+    for ps in range(32):
+        assert m2.pg_to_up_acting_osds(PgId(1, ps)) == \
+            osdmap.pg_to_up_acting_osds(PgId(1, ps))
+
+
+def test_bulk_mapping_matches_single(osdmap):
+    osdmap.pg_temp[PgId(1, 6)] = [0, 4, 8]
+    mapping = OSDMapMapping(osdmap)
+    for ps in range(32):
+        pg = PgId(1, ps)
+        assert mapping.get(pg) == osdmap.pg_to_up_acting_osds(pg), pg
+    by_osd = mapping.pgs_by_osd()
+    assert sum(len(v) for v in by_osd.values()) == 32 * 3
+
+
+def test_osdmaptool(tmp_path, capsys):
+    from ceph_tpu.tools import osdmaptool
+
+    path = str(tmp_path / "osdmap")
+    assert osdmaptool.run([path, "--createsimple", "8",
+                           "--with-default-pool"]) == 0
+    assert osdmaptool.run([path, "--print"]) == 0
+    out = capsys.readouterr().out
+    assert "max_osd 8" in out and "pool 1 'rbd'" in out
+    assert osdmaptool.run([path, "--test-map-pgs"]) == 0
+    out = capsys.readouterr().out
+    assert "avg" in out
+    assert osdmaptool.run([path, "--test-map-pg", "1.3"]) == 0
+    out = capsys.readouterr().out
+    assert "acting" in out
+    crush_out = str(tmp_path / "crush.json")
+    assert osdmaptool.run([path, "--export-crush", crush_out]) == 0
+    assert osdmaptool.run([path, "--import-crush", crush_out]) == 0
+
+
+def test_min_size_defaults():
+    m = OSDMap.build_simple(8)
+    repl = m.create_pool("r4", size=4)
+    assert repl.min_size == 2                # size - size/2
+    m.erasure_code_profiles["p83"] = {"plugin": "jerasure", "k": "8",
+                                      "m": "3"}
+    ec = m.create_pool("ec", type_=TYPE_ERASURE, size=11,
+                       erasure_code_profile="p83")
+    assert ec.min_size == 9                  # k + 1
