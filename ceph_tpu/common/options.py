@@ -49,13 +49,19 @@ class Option:
     def cast(self, value: Any) -> Any:
         """Parse/validate a raw (usually string) value; raises ValueError."""
         if self.type == "size" and isinstance(value, str):
-            s = value.strip().lower().rstrip("b").rstrip("i")
+            s = value.strip().lower()
+            if s.endswith("b"):
+                s = s[:-1]
+            if s.endswith("i"):
+                s = s[:-1]
             if s and s[-1] in self._SIZE_SUFFIXES:
                 try:
                     value = int(float(s[:-1]) * self._SIZE_SUFFIXES[s[-1]])
                 except ValueError:
                     raise ValueError(
                         f"{self.name}: {value!r} is not a size")
+            else:
+                value = s  # bare number, possibly after stripping B
         if self.type == "bool":
             if isinstance(value, bool):
                 out: Any = value

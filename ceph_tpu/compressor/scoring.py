@@ -46,43 +46,57 @@ def entropy_bits_per_byte_host(blocks: np.ndarray) -> np.ndarray:
     return terms.sum(axis=1).astype(np.float32)
 
 
+MIN_DEVICE_BYTES = 64 * 1024  # below this the host path wins on latency
+
+
+def _device_ok(blocks) -> bool:
+    from ceph_tpu.ops import gf
+
+    nbytes = getattr(blocks, "nbytes", 0) or np.asarray(blocks).nbytes
+    return (HAVE_JAX and nbytes >= MIN_DEVICE_BYTES
+            and gf.backend_available())
+
+
 if HAVE_JAX:
 
     @jax.jit
-    def byte_histograms(blocks):
-        """(B, S) uint8 -> (B, 256) int32, batched one-hot reduction."""
+    def _byte_histograms_dev(blocks):
         onehot = jax.nn.one_hot(blocks.astype(jnp.int32), 256,
                                 dtype=jnp.float32)
         return onehot.sum(axis=1).astype(jnp.int32)
 
     @jax.jit
-    def entropy_bits_per_byte(blocks):
-        """(B, S) uint8 -> (B,) float32 order-0 entropy in bits/byte."""
-        hist = byte_histograms(blocks).astype(jnp.float32)
+    def _entropy_dev(blocks):
+        hist = _byte_histograms_dev(blocks).astype(jnp.float32)
         s = blocks.shape[1]
         p = hist / s
         terms = jnp.where(p > 0, -p * jnp.log2(jnp.maximum(p, 1e-30)), 0.0)
         return terms.sum(axis=1)
 
-    def compress_decision(blocks, required_ratio: float = 0.875,
-                          margin: float = 0.05):
-        """(B, S) uint8 -> (B,) bool: worth running the codec?
 
-        True when the order-0 entropy bound predicts a ratio comfortably
-        under `required_ratio`; `margin` absorbs codec overhead vs the
-        entropy bound (real LZ output never beats order-0 entropy on
-        random data, but beats it easily on repetitive data — the margin
-        keeps marginal blobs on the "try it" side).
-        """
-        est_ratio = entropy_bits_per_byte(blocks) / 8.0
-        return est_ratio <= (required_ratio + margin)
+def byte_histograms(blocks):
+    """(B, S) uint8 -> (B, 256) int32, batched one-hot reduction."""
+    if _device_ok(blocks):
+        return _byte_histograms_dev(blocks)
+    return byte_histograms_host(np.asarray(blocks))
 
-else:  # pragma: no cover - CPU-only environments without jax
 
-    byte_histograms = byte_histograms_host
-    entropy_bits_per_byte = entropy_bits_per_byte_host
+def entropy_bits_per_byte(blocks):
+    """(B, S) uint8 -> (B,) float32 order-0 entropy in bits/byte."""
+    if _device_ok(blocks):
+        return _entropy_dev(blocks)
+    return entropy_bits_per_byte_host(np.asarray(blocks))
 
-    def compress_decision(blocks, required_ratio: float = 0.875,
-                          margin: float = 0.05):
-        est_ratio = entropy_bits_per_byte_host(np.asarray(blocks)) / 8.0
-        return est_ratio <= (required_ratio + margin)
+
+def compress_decision(blocks, required_ratio: float = 0.875,
+                      margin: float = 0.05):
+    """(B, S) uint8 -> (B,) bool: worth running the codec?
+
+    True when the order-0 entropy bound predicts a ratio comfortably
+    under `required_ratio`; `margin` absorbs codec overhead vs the
+    entropy bound (real LZ output never beats order-0 entropy on
+    random data, but beats it easily on repetitive data — the margin
+    keeps marginal blobs on the "try it" side).
+    """
+    est_ratio = np.asarray(entropy_bits_per_byte(blocks)) / 8.0
+    return est_ratio <= (required_ratio + margin)

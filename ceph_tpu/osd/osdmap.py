@@ -181,6 +181,7 @@ class OSDMap:
         self.primary_temp: Dict[PgId, int] = {}
         self.pg_upmap: Dict[PgId, List[int]] = {}
         self.pg_upmap_items: Dict[PgId, List[Tuple[int, int]]] = {}
+        self.pool_max = 0  # monotone pool-id counter; ids never reused
 
     # -- osd state ---------------------------------------------------------
 
@@ -381,7 +382,8 @@ class OSDMap:
     def create_pool(self, name: str, type_: int = TYPE_REPLICATED,
                     size: int = 3, pg_num: int = 32, crush_rule: int = 0,
                     erasure_code_profile: str = "") -> PgPool:
-        pool_id = max(self.pools, default=0) + 1
+        pool_id = max(self.pool_max, max(self.pools, default=0)) + 1
+        self.pool_max = pool_id
         min_size = 0
         if type_ == TYPE_ERASURE:
             profile = self.erasure_code_profiles.get(
@@ -413,6 +415,10 @@ class OSDMap:
             self.pools[pool_id] = pool
         for pool_id in inc.old_pools:
             self.pools.pop(pool_id, None)
+            for d in (self.pg_temp, self.primary_temp, self.pg_upmap,
+                      self.pg_upmap_items):
+                for pg in [pg for pg in d if pg.pool == pool_id]:
+                    del d[pg]
         for osd, addr in inc.new_up_osds.items():
             self.osd_state[osd] |= CEPH_OSD_EXISTS | CEPH_OSD_UP
             self.osd_addrs[osd] = addr
