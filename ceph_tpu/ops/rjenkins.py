@@ -99,3 +99,48 @@ def hash32_5(a, b, c, d, e, xp=np):
     d, x, h = _mix(d, x, h, xp)
     y, e, h = _mix(y, e, h, xp)
     return h
+
+
+def ceph_str_hash_rjenkins(data: bytes) -> int:
+    """Jenkins lookup2 string hash (reference src/common/ceph_hash.cc:21-78)
+    — hashes object names onto PG seeds (hobject_t::get_hash)."""
+    M = 0xFFFFFFFF
+
+    def mix(a, b, c):
+        a = (a - b - c) & M; a ^= c >> 13
+        b = (b - c - a) & M; b ^= (a << 8) & M
+        c = (c - a - b) & M; c ^= b >> 13
+        a = (a - b - c) & M; a ^= c >> 12
+        b = (b - c - a) & M; b ^= (a << 16) & M
+        c = (c - a - b) & M; c ^= b >> 5
+        a = (a - b - c) & M; a ^= c >> 3
+        b = (b - c - a) & M; b ^= (a << 10) & M
+        c = (c - a - b) & M; c ^= b >> 15
+        return a, b, c
+
+    length = len(data)
+    a = b = 0x9E3779B9
+    c = 0
+    i = 0
+    while length - i >= 12:
+        a = (a + int.from_bytes(data[i:i + 4], "little")) & M
+        b = (b + int.from_bytes(data[i + 4:i + 8], "little")) & M
+        c = (c + int.from_bytes(data[i + 8:i + 12], "little")) & M
+        a, b, c = mix(a, b, c)
+        i += 12
+    c = (c + length) & M
+    tail = data[i:]
+    n = len(tail)
+    if n >= 11: c = (c + (tail[10] << 24)) & M
+    if n >= 10: c = (c + (tail[9] << 16)) & M
+    if n >= 9:  c = (c + (tail[8] << 8)) & M
+    if n >= 8:  b = (b + (tail[7] << 24)) & M
+    if n >= 7:  b = (b + (tail[6] << 16)) & M
+    if n >= 6:  b = (b + (tail[5] << 8)) & M
+    if n >= 5:  b = (b + tail[4]) & M
+    if n >= 4:  a = (a + (tail[3] << 24)) & M
+    if n >= 3:  a = (a + (tail[2] << 16)) & M
+    if n >= 2:  a = (a + (tail[1] << 8)) & M
+    if n >= 1:  a = (a + tail[0]) & M
+    _a, _b, c = mix(a, b, c)
+    return c

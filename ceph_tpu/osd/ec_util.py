@@ -154,7 +154,7 @@ def decode(sinfo: StripeInfo, ec_impl,
     have = tuple(sorted(to_decode))
     want = tuple(range(k))
     erased = tuple(i for i in want if i not in to_decode)
-    if not erased:
+    if not erased and not ec_impl.get_chunk_mapping():
         cols = [np.frombuffer(to_decode[i], dtype=np.uint8).reshape(
             n_stripes, chunk) for i in range(k)]
         return np.stack(cols, axis=1).tobytes()

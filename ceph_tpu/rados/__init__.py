@@ -1,0 +1,1 @@
+"""RADOS layer: object access over placed, erasure-coded storage."""

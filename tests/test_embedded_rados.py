@@ -1,0 +1,186 @@
+"""End-to-end single-host slice tests (SURVEY.md §7.6): put -> stripe ->
+TPU encode -> CRUSH-placed shards + hinfo; get with erasures -> TPU
+decode; deep scrub and repair.  Mirrors the shape of
+qa/standalone/erasure-code/test-erasure-code.sh and test-erasure-eio.sh."""
+
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.os import ObjectId, Transaction
+from ceph_tpu.rados.embedded import (
+    HINFO_ATTR,
+    LocalCluster,
+    shard_collection,
+)
+
+EC_PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
+              "k": "4", "m": "2", "crush-failure-domain": "osd"}
+
+
+@pytest.fixture
+def cluster():
+    c = LocalCluster(num_osds=8, osds_per_host=2)
+    c.create_erasure_pool("ecpool", EC_PROFILE, pg_num=16)
+    c.create_replicated_pool("repl", size=3, pg_num=16)
+    yield c
+    c.shutdown()
+
+
+def payload(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+def test_ec_put_get_round_trip(cluster):
+    io = cluster.open_ioctx("ecpool")
+    for size in (0, 1, 4095, 4096, 100_000, 1 << 20):
+        data = payload(size, seed=size % 97)
+        io.write_full(f"obj-{size}", data)
+        assert io.read(f"obj-{size}") == data, size
+        assert io.stat(f"obj-{size}")["size"] == size
+
+
+def test_ec_shards_are_placed_by_crush(cluster):
+    io = cluster.open_ioctx("ecpool")
+    data = payload(50_000, seed=1)
+    io.write_full("placed", data)
+    pg = io.object_pg("placed")
+    acting, primary = io.acting(pg)
+    assert len(acting) == 6                     # k+m
+    assert len({o for o in acting if o >= 0}) == 6
+    # each shard really lives on its acting osd with an hinfo ledger
+    for shard, osd in enumerate(acting):
+        store = cluster.stores[osd]
+        cid = shard_collection(pg, shard)
+        buf = store.read(cid, ObjectId("placed"))
+        assert len(buf) > 0
+        hinfo = json.loads(store.getattr(cid, ObjectId("placed"),
+                                         HINFO_ATTR))
+        assert len(hinfo["cumulative_shard_hashes"]) == 6
+
+
+def test_ec_degraded_read_with_down_osds(cluster):
+    io = cluster.open_ioctx("ecpool")
+    data = payload(300_000, seed=2)
+    io.write_full("degraded", data)
+    pg = io.object_pg("degraded")
+    acting, _p = io.acting(pg)
+    # kill m=2 of the shard holders: read must still reconstruct
+    cluster.mark_osd_down(acting[0])
+    cluster.mark_osd_down(acting[3])
+    assert io.read("degraded") == data
+
+
+def test_ec_too_many_failures(cluster):
+    io = cluster.open_ioctx("ecpool")
+    io.write_full("doomed", payload(10_000, seed=3))
+    pg = io.object_pg("doomed")
+    acting, _p = io.acting(pg)
+    for osd in acting[:3]:                      # 3 > m=2
+        cluster.mark_osd_down(osd)
+    with pytest.raises(Exception):
+        io.read("doomed")
+
+
+def test_ec_corrupt_shard_detected_and_reconstructed(cluster):
+    """EIO-injection shape of test-erasure-eio.sh: a shard corrupted on
+    disk fails its hinfo crc and the read reconstructs around it."""
+    io = cluster.open_ioctx("ecpool")
+    data = payload(200_000, seed=4)
+    io.write_full("bitrot", data)
+    pg = io.object_pg("bitrot")
+    acting, _p = io.acting(pg)
+    victim_shard = 1
+    store = cluster.stores[acting[victim_shard]]
+    cid = shard_collection(pg, victim_shard)
+    buf = bytearray(store.read(cid, ObjectId("bitrot")))
+    buf[100] ^= 0xFF
+    t = Transaction()
+    t.write(cid, ObjectId("bitrot"), 0, len(buf), bytes(buf))
+    store.queue_transaction(t)                  # corrupt, hinfo unchanged
+    assert io.read("bitrot") == data            # reconstructed
+    problems = io.deep_scrub("bitrot")
+    assert any(shard == victim_shard and "crc" in why
+               for shard, why in problems)
+
+
+def test_ec_repair_rewrites_bad_shard(cluster):
+    io = cluster.open_ioctx("ecpool")
+    data = payload(150_000, seed=5)
+    io.write_full("fixme", data)
+    pg = io.object_pg("fixme")
+    acting, _p = io.acting(pg)
+    # destroy shard 2 entirely
+    store = cluster.stores[acting[2]]
+    t = Transaction()
+    t.remove(shard_collection(pg, 2), ObjectId("fixme"))
+    store.queue_transaction(t)
+    assert io.deep_scrub("fixme")
+    repaired = io.repair("fixme")
+    assert repaired == [2]
+    assert io.deep_scrub("fixme") == []
+    assert io.read("fixme") == data
+
+
+def test_replicated_pool(cluster):
+    io = cluster.open_ioctx("repl")
+    data = payload(80_000, seed=6)
+    io.write_full("robj", data)
+    assert io.read("robj") == data
+    pg = io.object_pg("robj")
+    acting, _p = io.acting(pg)
+    assert len(acting) == 3
+    # any single copy serves the read
+    cluster.mark_osd_down(acting[0])
+    assert io.read("robj") == data
+    assert io.deep_scrub("robj") == []
+
+
+def test_remove_and_list(cluster):
+    io = cluster.open_ioctx("ecpool")
+    for i in range(5):
+        io.write_full(f"o{i}", payload(1000, seed=i))
+    assert io.list_objects() == [f"o{i}" for i in range(5)]
+    io.remove("o2")
+    assert io.list_objects() == ["o0", "o1", "o3", "o4"]
+    with pytest.raises(KeyError):
+        io.read("o2")
+
+
+def test_lrc_pool_end_to_end(cluster):
+    cluster.create_erasure_pool(
+        "lrcpool", {"plugin": "lrc", "k": "4", "m": "2", "l": "3",
+                    "crush-failure-domain": "osd"}, pg_num=8)
+    io = cluster.open_ioctx("lrcpool")
+    data = payload(64_000, seed=7)
+    io.write_full("lrcobj", data)
+    assert io.read("lrcobj") == data
+    pg = io.object_pg("lrcobj")
+    acting, _p = io.acting(pg)
+    assert len(acting) == 8                     # k+m+groups
+    cluster.mark_osd_down(acting[1])
+    assert io.read("lrcobj") == data
+
+
+def test_unknown_pool_and_object(cluster):
+    with pytest.raises(KeyError):
+        cluster.open_ioctx("nope")
+    io = cluster.open_ioctx("ecpool")
+    with pytest.raises(KeyError):
+        io.read("never-written")
+    with pytest.raises(KeyError):
+        io.stat("never-written")
+
+
+def test_persistent_cluster_round_trip(tmp_path):
+    """The same slice over TPUStore-backed OSDs survives remount."""
+    c = LocalCluster(num_osds=6, osds_per_host=2,
+                     store_path=str(tmp_path))
+    c.create_erasure_pool("ecpool", EC_PROFILE, pg_num=8)
+    io = c.open_ioctx("ecpool")
+    data = payload(500_000, seed=8)
+    io.write_full("durable", data)
+    assert io.read("durable") == data
+    c.shutdown()
