@@ -57,9 +57,12 @@ def maybe_compress(
 ) -> Tuple[bytes, Optional[CompressionHeader]]:
     """Returns (payload, header); header is None when stored raw.
 
-    The accept test matches the reference: compressed length (including
-    header overhead) must be <= len(data) * required_ratio, else the raw
-    bytes are stored and the attempt counts as rejected.
+    The accept test mirrors the reference's required-ratio gate:
+    compressed length must be <= len(data) * required_ratio, else the raw
+    bytes are stored and the attempt counts as rejected.  Unlike
+    BlueStore (where bluestore_compression_header_t rides the stored
+    payload and counts against want_len), the header here lives in onode
+    metadata, so no header bytes are part of the comparison.
     """
     if compressor is None or not data or not want_compress(mode, alloc_hints):
         return data, None

@@ -57,6 +57,24 @@ def _device_ok(blocks) -> bool:
             and gf.backend_available())
 
 
+# lags probed for periodicity: every period p with p | some lag is caught
+# (covers power-of-two, ×3 and common text/record strides up to 512)
+_PROBE_LAGS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96,
+               128, 192, 256, 384, 512)
+
+
+def _match_fraction_host(blocks: np.ndarray) -> np.ndarray:
+    """(B, S) -> (B,) best self-match fraction over the probe lags."""
+    b, s = blocks.shape
+    best = np.zeros(b, dtype=np.float32)
+    for lag in _PROBE_LAGS:
+        if lag >= s:
+            break
+        frac = (blocks[:, lag:] == blocks[:, :-lag]).mean(axis=1)
+        best = np.maximum(best, frac.astype(np.float32))
+    return best
+
+
 if HAVE_JAX:
 
     @jax.jit
@@ -73,6 +91,18 @@ if HAVE_JAX:
         terms = jnp.where(p > 0, -p * jnp.log2(jnp.maximum(p, 1e-30)), 0.0)
         return terms.sum(axis=1)
 
+    @jax.jit
+    def _match_fraction_dev(blocks):
+        s = blocks.shape[1]
+        best = jnp.zeros(blocks.shape[0], dtype=jnp.float32)
+        for lag in _PROBE_LAGS:  # static python loop, unrolled at trace
+            if lag >= s:
+                break
+            frac = (blocks[:, lag:] == blocks[:, :-lag]).mean(
+                axis=1, dtype=jnp.float32)
+            best = jnp.maximum(best, frac)
+        return best
+
 
 def byte_histograms(blocks):
     """(B, S) uint8 -> (B, 256) int32, batched one-hot reduction."""
@@ -88,15 +118,36 @@ def entropy_bits_per_byte(blocks):
     return entropy_bits_per_byte_host(np.asarray(blocks))
 
 
+def match_fraction(blocks):
+    """(B, S) uint8 -> (B,) float32: best self-match fraction over the
+    probe lags — a cheap repetition signal that catches periodic data
+    whose byte histogram is uniform (LZ compresses it, entropy doesn't
+    see it)."""
+    if _device_ok(blocks):
+        return _match_fraction_dev(blocks)
+    return _match_fraction_host(np.asarray(blocks))
+
+
 def compress_decision(blocks, required_ratio: float = 0.875,
-                      margin: float = 0.05):
+                      margin: float = 0.05,
+                      match_threshold: float = 0.5):
     """(B, S) uint8 -> (B,) bool: worth running the codec?
 
-    True when the order-0 entropy bound predicts a ratio comfortably
-    under `required_ratio`; `margin` absorbs codec overhead vs the
-    entropy bound (real LZ output never beats order-0 entropy on
-    random data, but beats it easily on repetitive data — the margin
-    keeps marginal blobs on the "try it" side).
+    True when either (a) the order-0 entropy bound predicts a ratio
+    comfortably under `required_ratio` (`margin` absorbs codec overhead
+    vs the bound), or (b) the lag-probe repetition signal fires —
+    periodic data (e.g. a repeating 256-byte random pattern) has a
+    uniform histogram yet compresses far below required_ratio, so
+    entropy alone would permanently skip the codec for it.
+
+    Known false-negative class: data whose only redundancy is
+    long-range, aperiodic matches (period not dividing any probe lag,
+    or match distance > 512).  Such spans are stored raw; COMP_FORCE
+    mode bypasses this prescreen entirely at the store layer.
     """
     est_ratio = np.asarray(entropy_bits_per_byte(blocks)) / 8.0
-    return est_ratio <= (required_ratio + margin)
+    entropy_ok = est_ratio <= (required_ratio + margin)
+    if entropy_ok.all():  # common path: no need for the lag probe
+        return entropy_ok
+    repetitive = np.asarray(match_fraction(blocks)) >= match_threshold
+    return entropy_ok | repetitive

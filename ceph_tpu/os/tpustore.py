@@ -165,6 +165,12 @@ class TPUStore(ObjectStore):
         self._lock = threading.RLock()
         self._txc: Optional[Dict[bytes, Optional[_Onode]]] = None
         self._txc_colls: set = set()
+        # extents freed by the in-flight transaction; returned to the
+        # allocator only after the KV commit succeeds (BlueStore defers
+        # release until after kv commit) so no op in the same transaction
+        # — or a crash before the commit point — can overwrite data still
+        # referenced by committed onodes
+        self._txc_release: List[Tuple[int, int]] = []
         self._compressor: Optional[Compressor] = None
         self._mounted = False
         # config (bluestore_* options)
@@ -295,9 +301,11 @@ class TPUStore(ObjectStore):
         payload, header = raw, None
         if self.comp_mode and self._compressor is not None and raw:
             # TPU pre-score: skip the host codec for incompressible spans
+            # (COMP_FORCE bypasses the prescreen — forced means forced)
             arr = np.frombuffer(raw, dtype=np.uint8)[None, :]
-            if bool(np.asarray(scoring.compress_decision(
-                    arr, self.required_ratio))[0]):
+            if self.comp_mode == gate.COMP_FORCE or bool(
+                    np.asarray(scoring.compress_decision(
+                        arr, self.required_ratio))[0]):
                 payload, header = gate.maybe_compress(
                     raw, self._compressor, self.comp_mode,
                     onode.alloc_hint_flags, self.required_ratio)
@@ -316,8 +324,8 @@ class TPUStore(ObjectStore):
             header.alg if header else None,
             header.compressor_message if header else None,
             csum_type=self.csum_type, csum_block=self.csum_block_size)
-        if old is not None:
-            self._alloc.release(old.offset, old.stored_len)
+        if old is not None and old.stored_len:
+            self._txc_release.append((old.offset, old.stored_len))
 
     def _span_read(self, blob: _Blob) -> bytes:
         payload = self._pread(blob.offset, blob.stored_len)
@@ -372,7 +380,8 @@ class TPUStore(ObjectStore):
         except KeyError:
             return
         for blob in onode.blobs.values():
-            self._alloc.release(blob.offset, blob.stored_len)
+            if blob.stored_len:
+                self._txc_release.append((blob.offset, blob.stored_len))
         self._drop_onode(kvt, cid, oid)
         okey = self._okey(cid, oid)
         kvt.rm_range_keys(P_OMAP, okey + b"\0", okey + b"\1")
@@ -384,9 +393,11 @@ class TPUStore(ObjectStore):
             kvt = self._kv.get_transaction()
             self._txc = {}
             self._txc_colls = set()
+            self._txc_release = []
             # a failed apply must not leak half a transaction: restore the
-            # allocator (extents released/allocated by earlier ops) and
-            # submit nothing
+            # allocator (extents allocated by earlier ops) and submit
+            # nothing; pending releases are simply discarded, so nothing
+            # was freed and nothing freed was reusable mid-transaction
             alloc_snapshot = (list(self._alloc.free),
                               self._alloc.device_size)
             try:
@@ -394,16 +405,26 @@ class TPUStore(ObjectStore):
                     self._apply(kvt, op)
             except Exception:
                 self._alloc.free, self._alloc.device_size = alloc_snapshot
+                self._txc_release = []
                 raise
             finally:
                 self._txc = None
                 self._txc_colls = set()
+            # the persisted freelist is the post-commit truth: allocator
+            # state with this transaction's releases applied — but the
+            # in-memory allocator only sees them after the commit point
+            final_alloc = Allocator.from_json(self._alloc.to_json())
+            for off, ln in self._txc_release:
+                final_alloc.release(off, ln)
             kvt.set(P_FREELIST, b"state",
-                    json.dumps(self._alloc.to_json()).encode())
+                    json.dumps(final_alloc.to_json()).encode())
             # data first, then the metadata commit point
             self._block.flush()
             _os.fsync(self._block.fileno())
             self._kv.submit_transaction(kvt)
+            for off, ln in self._txc_release:
+                self._alloc.release(off, ln)
+            self._txc_release = []
         for cb in txn.on_commit:
             cb()
 
@@ -433,7 +454,9 @@ class TPUStore(ObjectStore):
                 keep_spans = -(-size // self.max_blob_size) if size else 0
                 for span in [s for s in onode.blobs if s >= keep_spans]:
                     blob = onode.blobs.pop(span)
-                    self._alloc.release(blob.offset, blob.stored_len)
+                    if blob.stored_len:
+                        self._txc_release.append(
+                            (blob.offset, blob.stored_len))
                 onode.size = size
                 # partial tail span: rewrite truncated
                 if size % self.max_blob_size and (size // self.max_blob_size) in onode.blobs:

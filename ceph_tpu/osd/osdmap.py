@@ -19,6 +19,7 @@ pg_pool_t (src/osd/osd_types.{h,cc}):
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -130,7 +131,7 @@ class PgPool:
     # -- encoding ----------------------------------------------------------
 
     def encode(self, enc: Encoder) -> None:
-        enc.start(1, 1)
+        enc.start(2, 1)  # v2: opts values JSON-typed
         enc.s64(self.id)
         enc.string(self.name)
         enc.u8(self.type)
@@ -142,13 +143,15 @@ class PgPool:
         enc.string(self.erasure_code_profile)
         enc.u64(self.flags)
         enc.u32(self.last_change)
+        # JSON-encode opt values so typed pool opts (ints/floats for
+        # csum/compression settings) survive an encode/decode round-trip
         enc.map(self.opts, Encoder.string,
-                lambda e, v: e.string(str(v)))
+                lambda e, v: e.string(json.dumps(v)))
         enc.finish()
 
     @classmethod
     def decode(cls, dec: Decoder) -> "PgPool":
-        dec.start(1)
+        struct_v = dec.start(2)
         pool = cls(dec.s64(), dec.string())
         pool.type = dec.u8()
         pool.size = dec.u32()
@@ -159,7 +162,11 @@ class PgPool:
         pool.erasure_code_profile = dec.string()
         pool.flags = dec.u64()
         pool.last_change = dec.u32()
-        pool.opts = dec.map(Decoder.string, Decoder.string)
+        raw_opts = dec.map(Decoder.string, Decoder.string)
+        if struct_v >= 2:
+            pool.opts = {k: json.loads(v) for k, v in raw_opts.items()}
+        else:  # v1 encoded opts as bare str(v); values stay strings
+            pool.opts = raw_opts
         dec.finish()
         return pool
 
@@ -261,10 +268,15 @@ class OSDMap:
                      raw: List[int]) -> None:
         pg = pool.raw_pg_to_pg(raw_pg)
         explicit = self.pg_upmap.get(pg)
-        if explicit:
+        if explicit is not None:
             if all(not (o != CRUSH_ITEM_NONE and 0 <= o < self.max_osd
                         and self.osd_weight[o] == 0) for o in explicit):
                 raw[:] = list(explicit)
+            # an explicit pg_upmap entry — even an empty one, or one
+            # rejected because a target OSD is out — precludes
+            # pg_upmap_items (OSDMap::_apply_upmap returns in both
+            # branches)
+            return
         for src, dst in self.pg_upmap_items.get(pg, []):
             exists = False
             pos = -1

@@ -201,3 +201,17 @@ def test_scoring_predicts_codec_outcome():
         _, hdr = gate.maybe_compress(data, codec)
         accepted = hdr is not None
         assert accepted == bool(pred), (len(data), pred)
+
+
+def test_scoring_catches_periodic_uniform_histogram():
+    """A repeating 256-byte random pattern has near-uniform histogram
+    (entropy says incompressible) but LZ crushes it; the lag-probe
+    repetition signal must keep it on the 'try it' side (advisor)."""
+    rng = np.random.default_rng(11)
+    pattern = rng.integers(0, 256, 256, dtype=np.uint8).tobytes()
+    periodic = np.frombuffer(pattern * 256, dtype=np.uint8)[None, :]
+    random = rng.integers(0, 256, (1, 256 * 256), dtype=np.uint8)
+    decision_p = np.asarray(scoring.compress_decision(periodic))
+    decision_r = np.asarray(scoring.compress_decision(random))
+    assert bool(decision_p[0]) is True
+    assert bool(decision_r[0]) is False

@@ -407,3 +407,70 @@ def test_tpustore_csum_config_change_keeps_data_readable(tmp_path):
     s2.mount()
     assert s2.read(CID, OID) == b"written with crc32c" * 100
     s2.umount()
+
+
+def test_tpustore_deferred_release_within_txn(tmp_path):
+    """Extents freed by one op must NOT be reusable by a later op in the
+    same transaction (advisor high finding): a txn that rewrites A, writes
+    B (first-fit would reuse A's freed extent), then fails must leave
+    committed A readable after the abort — and the same early-release
+    crash window must not exist on the success path either."""
+    s = TPUStore(str(tmp_path / "store"))
+    s.mkfs()
+    s.mount()
+    t = Transaction()
+    t.create_collection(CID)
+    s.queue_transaction(t)
+    data_a = b"A" * 30_000
+    _write(s, OID, 0, data_a)
+    a_off = s._get_onode(CID, OID).blobs[0].offset
+
+    # failing txn: rewrite A (frees its extent), write B (same size —
+    # first-fit would grab A's extent if released early), then fail
+    t = Transaction()
+    t.write(CID, OID, 0, len(data_a), b"a" * 30_000)
+    t.write(CID, ObjectId("B"), 0, 30_000, b"B" * 30_000)
+    t.rmattr(CID, ObjectId("missing"), "x")
+    with pytest.raises(KeyError):
+        s.queue_transaction(t)
+    assert s.read(CID, OID) == data_a          # A survives the abort
+    with pytest.raises(KeyError):
+        s.read(CID, ObjectId("B"))
+
+    # success path: same shape without the failure — B must not have been
+    # written over A's old extent before the commit point
+    t = Transaction()
+    t.write(CID, OID, 0, len(data_a), b"a" * 30_000)
+    t.write(CID, ObjectId("B"), 0, 30_000, b"B" * 30_000)
+    s.queue_transaction(t)
+    assert s.read(CID, OID) == b"a" * 30_000
+    assert s.read(CID, ObjectId("B")) == b"B" * 30_000
+    b_off = s._get_onode(CID, ObjectId("B")).blobs[0].offset
+    assert b_off != a_off
+    # after commit the freed extent IS reusable
+    t = Transaction()
+    t.write(CID, ObjectId("C"), 0, 30_000, b"C" * 30_000)
+    s.queue_transaction(t)
+    assert s._get_onode(CID, ObjectId("C")).blobs[0].offset == a_off
+    s.umount()
+
+
+def test_tpustore_remove_defers_release(tmp_path):
+    """_object_remove frees extents only after the KV commit: a remove+write
+    txn that fails must leave the removed object fully readable."""
+    s = TPUStore(str(tmp_path / "store"))
+    s.mkfs()
+    s.mount()
+    t = Transaction()
+    t.create_collection(CID)
+    s.queue_transaction(t)
+    data = b"keep me " * 4000
+    _write(s, OID, 0, data)
+    t = Transaction()
+    t.remove(CID, OID)
+    t.write(CID, ObjectId("B"), 0, len(data), b"B" * len(data))
+    t.rmattr(CID, ObjectId("missing"), "x")
+    with pytest.raises(KeyError):
+        s.queue_transaction(t)
+    assert s.read(CID, OID) == data
+    s.umount()

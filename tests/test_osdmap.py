@@ -308,3 +308,27 @@ def test_min_size_defaults():
     ec = m.create_pool("ec", type_=TYPE_ERASURE, size=11,
                        erasure_code_profile="p83")
     assert ec.min_size == 9                  # k + 1
+
+
+def test_upmap_rejected_precludes_upmap_items(osdmap):
+    """An explicit pg_upmap entry rejected (target out) must also suppress
+    pg_upmap_items for that pg (OSDMap::_apply_upmap returns early)."""
+    pg = PgId(1, 9)
+    up0, _p, _a, _ap = osdmap.pg_to_up_acting_osds(pg)
+    spares = [o for o in range(12) if o not in up0]
+    osdmap.osd_weight[spares[0]] = 0          # out -> upmap rejected
+    osdmap.pg_upmap[pg] = [spares[0]] + up0[1:]
+    osdmap.pg_upmap_items[pg] = [(up0[1], spares[1])]
+    up1, _p1, _a1, _ap1 = osdmap.pg_to_up_acting_osds(pg)
+    assert up1 == up0                         # items NOT applied either
+
+
+def test_pool_opts_typed_round_trip(osdmap):
+    """Typed pool opts (ints/floats) survive encode/decode (advisor)."""
+    pool = osdmap.pools[1]
+    pool.opts = {"compression_mode": "force", "csum_type": 3,
+                 "compression_required_ratio": 0.7}
+    m2 = OSDMap.decode(osdmap.encode())
+    assert m2.pools[1].opts == pool.opts
+    assert isinstance(m2.pools[1].opts["csum_type"], int)
+    assert isinstance(m2.pools[1].opts["compression_required_ratio"], float)
