@@ -1,0 +1,364 @@
+"""Mini-monitor: the cluster control plane, single-instance.
+
+Reference parity: Monitor + OSDMonitor
+(/root/reference/src/mon/Monitor.cc, OSDMonitor.cc) minus Paxos — one
+mon instance is authoritative (the reference's single-mon vstart shape);
+the PaxosService commit discipline survives as: every map mutation is an
+epoch bump whose full map is pushed to all subscribers.
+
+Covered OSDMonitor behaviors:
+- OSD lifecycle: MOSDBoot marks up + records the address
+  (OSDMonitor::prepare_boot); liveness beacons double as boot.
+- Failure adjudication (prepare_failure OSDMonitor.cc:2739,
+  check_failure :3156-3185): an OSD is marked down when enough distinct
+  reporters (mon_osd_min_down_reporters) have current failure reports
+  and the oldest report has aged past an ADAPTIVE grace: base
+  osd_heartbeat_grace plus a laggy term from the target's own history
+  (halflife-decayed laggy_probability/laggy_interval, the :3180-3185
+  math) — flapping OSDs earn longer grace.
+- Pool/profile commands (OSDMonitor.cc:7373-7712): erasure-code-profile
+  set (validated by instantiating the codec), pool create
+  replicated/erasure (EC pools get a rule from the codec like
+  create_rule), osd down/out/in, status/health.
+- Health checks (mon/health_check.h role): OSD_DOWN / PG_DEGRADED
+  summary served by `status` and `health` commands.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ceph_tpu.ec.registry import create_erasure_code
+from ceph_tpu.msg import Connection, Messenger
+from ceph_tpu.msg.messages import (
+    Message,
+    MGetMap,
+    MMonCommand,
+    MMonCommandReply,
+    MOSDBoot,
+    MOSDFailure,
+    MOSDMapMsg,
+)
+from ceph_tpu.osd.osdmap import (
+    CEPH_OSD_IN,
+    CEPH_OSD_UP,
+    Incremental,
+    OSDMap,
+    TYPE_ERASURE,
+    TYPE_REPLICATED,
+)
+
+log = logging.getLogger("mon")
+
+DEFAULTS = {
+    "mon_osd_min_down_reporters": 2,
+    "osd_heartbeat_grace": 20.0,
+    "mon_osd_laggy_halflife": 3600.0,
+    "mon_osd_laggy_weight": 0.3,
+    "mon_osd_adjust_heartbeat_grace": True,
+}
+
+
+class FailureReport:
+    __slots__ = ("first_reported", "last_reported", "failed_for")
+
+    def __init__(self, now: float, failed_for: float):
+        self.first_reported = now
+        self.last_reported = now
+        self.failed_for = failed_for
+
+
+class MonDaemon:
+    """Single authoritative monitor."""
+
+    def __init__(self, num_osds: int, osds_per_host: int = 2,
+                 config: Optional[Dict[str, Any]] = None):
+        self.config = dict(DEFAULTS)
+        self.config.update(config or {})
+        self.msgr = Messenger("mon.0")
+        self.msgr.dispatcher = self._dispatch
+        self.osdmap = OSDMap.build_simple(num_osds,
+                                          osds_per_host=osds_per_host)
+        # all OSDs start down (exist + in); boot marks them up
+        for osd in range(num_osds):
+            self.osdmap.osd_state[osd] &= ~CEPH_OSD_UP
+        self._subscribers: List[Connection] = []
+        # failure bookkeeping (OSDMonitor::failure_info_t)
+        self._failure_reports: Dict[int, Dict[int, FailureReport]] = {}
+        # laggy history for adaptive grace (osd_xinfo_t)
+        self._laggy_probability: Dict[int, float] = {}
+        self._laggy_interval: Dict[int, float] = {}
+        self._down_at: Dict[int, float] = {}
+        self._check_task: Optional[asyncio.Task] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        addr = await self.msgr.bind(host, port)
+        self._check_task = asyncio.get_running_loop().create_task(
+            self._check_failures_loop())
+        return addr
+
+    async def shutdown(self) -> None:
+        if self._check_task is not None:
+            self._check_task.cancel()
+        await self.msgr.shutdown()
+
+    @property
+    def addr(self) -> str:
+        return self.msgr.addr
+
+    # -- map mutation ------------------------------------------------------
+
+    def _commit(self, inc: Incremental) -> None:
+        """Apply an incremental and publish the new epoch (the Paxos
+        commit point of the single-instance world)."""
+        self.osdmap.apply_incremental(inc)
+        self._publish()
+
+    def _publish(self) -> None:
+        full = self.osdmap.encode()
+        msg = MOSDMapMsg(self.osdmap.epoch, full_map=full)
+        for conn in list(self._subscribers):
+            if conn.closed:
+                self._subscribers.remove(conn)
+                continue
+            self.msgr._spawn(self._send_quiet(conn, msg))
+
+    async def _send_quiet(self, conn: Connection, msg: Message) -> None:
+        try:
+            await conn.send(msg)
+        except (ConnectionError, OSError):
+            pass
+
+    # -- dispatch ----------------------------------------------------------
+
+    async def _dispatch(self, conn: Connection, msg: Message) -> None:
+        if isinstance(msg, MOSDBoot):
+            self._handle_boot(msg)
+        elif isinstance(msg, MGetMap):
+            if msg.subscribe and conn not in self._subscribers:
+                self._subscribers.append(conn)
+            await conn.send(MOSDMapMsg(self.osdmap.epoch,
+                                       full_map=self.osdmap.encode()))
+        elif isinstance(msg, MOSDFailure):
+            self._handle_failure(msg)
+        elif isinstance(msg, MMonCommand):
+            rc, out = self.handle_command(msg.cmd)
+            await conn.send(MMonCommandReply(msg.tid, rc, out))
+
+    # -- boot / failure ----------------------------------------------------
+
+    def _handle_boot(self, msg: MOSDBoot) -> None:
+        osd = msg.osd
+        if not (0 <= osd < self.osdmap.max_osd):
+            return
+        now = time.monotonic()
+        # returning after a mon-ordered down: update laggy history
+        # (OSDMonitor laggy tracking feeding the adaptive grace)
+        down_at = self._down_at.pop(osd, None)
+        if down_at is not None:
+            halflife = self.config["mon_osd_laggy_halflife"]
+            weight = self.config["mon_osd_laggy_weight"]
+            interval = now - down_at
+            decay = 0.5 ** (interval / halflife)
+            self._laggy_probability[osd] = min(
+                1.0, self._laggy_probability.get(osd, 0.0) * decay
+                + weight)
+            self._laggy_interval[osd] = (
+                self._laggy_interval.get(osd, 0.0) * decay
+                + interval * weight)
+        self._failure_reports.pop(osd, None)
+        if self.osdmap.is_up(osd) and \
+                self.osdmap.osd_addrs.get(osd) == msg.addr:
+            return
+        inc = Incremental(epoch=self.osdmap.epoch + 1)
+        inc.new_up_osds[osd] = msg.addr
+        if not self.osdmap.is_in(osd):
+            inc.new_weight[osd] = CEPH_OSD_IN
+        self._commit(inc)
+        log.info("mon: osd.%d booted at %s (epoch %d)", osd, msg.addr,
+                 self.osdmap.epoch)
+
+    def _handle_failure(self, msg: MOSDFailure) -> None:
+        target = msg.target_osd
+        if not self.osdmap.is_up(target):
+            return
+        reports = self._failure_reports.setdefault(target, {})
+        now = time.monotonic()
+        report = reports.get(msg.reporter)
+        if report is None:
+            reports[msg.reporter] = FailureReport(now, msg.failed_for)
+        else:
+            report.last_reported = now
+            report.failed_for = msg.failed_for
+        self._check_failure(target, now)
+
+    def _grace(self, target: int) -> float:
+        """Adaptive grace (OSDMonitor.cc:3180-3185): base + decayed
+        laggy_probability * laggy_interval."""
+        grace = float(self.config["osd_heartbeat_grace"])
+        if self.config["mon_osd_adjust_heartbeat_grace"]:
+            prob = self._laggy_probability.get(target, 0.0)
+            interval = self._laggy_interval.get(target, 0.0)
+            if prob > 0.05 and interval > 0:
+                grace += prob * interval
+        return grace
+
+    def _check_failure(self, target: int, now: float) -> None:
+        reports = self._failure_reports.get(target, {})
+        if len(reports) < int(self.config["mon_osd_min_down_reporters"]):
+            return
+        oldest = min(r.first_reported for r in reports.values())
+        max_failed = max(r.failed_for for r in reports.values())
+        if max(now - oldest, max_failed) < self._grace(target):
+            return
+        log.info("mon: marking osd.%d down (%d reporters, grace %.1fs)",
+                 target, len(reports), self._grace(target))
+        self._failure_reports.pop(target, None)
+        self._down_at[target] = now
+        inc = Incremental(epoch=self.osdmap.epoch + 1)
+        inc.new_state[target] = CEPH_OSD_UP  # XOR: up -> down
+        self._commit(inc)
+
+    async def _check_failures_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.25)
+            now = time.monotonic()
+            for target in list(self._failure_reports):
+                self._check_failure(target, now)
+
+    # -- commands (MonCommands.h / OSDMonitor command surface) -------------
+
+    def handle_command(self, cmd: Dict[str, Any]
+                       ) -> Tuple[int, Dict[str, Any]]:
+        prefix = cmd.get("prefix", "")
+        try:
+            handler = {
+                "osd erasure-code-profile set": self._cmd_profile_set,
+                "osd erasure-code-profile get": self._cmd_profile_get,
+                "osd pool create": self._cmd_pool_create,
+                "osd down": self._cmd_osd_down,
+                "osd out": self._cmd_osd_out,
+                "osd in": self._cmd_osd_in,
+                "status": self._cmd_status,
+                "health": self._cmd_health,
+            }.get(prefix)
+            if handler is None:
+                return -22, {"error": f"unknown command {prefix!r}"}
+            return handler(cmd)
+        except Exception as e:  # command errors must not kill the mon
+            log.exception("mon: command %r failed", prefix)
+            return -22, {"error": str(e)}
+
+    def _cmd_profile_set(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        name = cmd["name"]
+        profile = dict(cmd["profile"])
+        create_erasure_code(dict(profile))  # validate before committing
+        inc = Incremental(epoch=self.osdmap.epoch + 1)
+        inc.new_erasure_code_profiles[name] = profile
+        self._commit(inc)
+        return 0, {}
+
+    def _cmd_profile_get(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        profile = self.osdmap.erasure_code_profiles.get(cmd["name"])
+        if profile is None:
+            return -2, {"error": "no such profile"}
+        return 0, {"profile": profile}
+
+    def _cmd_pool_create(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        name = cmd["name"]
+        if self.osdmap.lookup_pool(name) >= 0:
+            return 0, {"pool_id": self.osdmap.lookup_pool(name)}
+        pg_num = int(cmd.get("pg_num", 32))
+        pool_type = cmd.get("pool_type", "replicated")
+        # stage on a scratch copy so the committed map and the published
+        # pool agree on the epoch
+        if pool_type == "erasure":
+            profile_name = cmd.get("erasure_code_profile", "default")
+            profile = self.osdmap.erasure_code_profiles.get(profile_name)
+            if profile is None:
+                return -2, {"error": f"no profile {profile_name!r}"}
+            codec = create_erasure_code(dict(profile))
+            ruleno = codec.create_rule(f"{name}_rule", self.osdmap.crush)
+            pool = self.osdmap.create_pool(
+                name, type_=TYPE_ERASURE, size=codec.get_chunk_count(),
+                pg_num=pg_num, crush_rule=ruleno,
+                erasure_code_profile=profile_name)
+        else:
+            size = int(cmd.get("size", 3))
+            pool = self.osdmap.create_pool(
+                name, type_=TYPE_REPLICATED, size=size, pg_num=pg_num)
+        # create_pool mutated the map in place; bump the epoch + publish
+        self.osdmap.epoch += 1
+        self._publish()
+        return 0, {"pool_id": pool.id}
+
+    def _cmd_osd_down(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        osd = int(cmd["osd"])
+        if self.osdmap.is_up(osd):
+            inc = Incremental(epoch=self.osdmap.epoch + 1)
+            inc.new_state[osd] = CEPH_OSD_UP
+            self._commit(inc)
+        return 0, {}
+
+    def _cmd_osd_out(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        osd = int(cmd["osd"])
+        if self.osdmap.is_in(osd):
+            inc = Incremental(epoch=self.osdmap.epoch + 1)
+            inc.new_weight[osd] = 0
+            self._commit(inc)
+        return 0, {}
+
+    def _cmd_osd_in(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        osd = int(cmd["osd"])
+        if not self.osdmap.is_in(osd):
+            inc = Incremental(epoch=self.osdmap.epoch + 1)
+            inc.new_weight[osd] = CEPH_OSD_IN
+            self._commit(inc)
+        return 0, {}
+
+    def _cmd_status(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        up = self.osdmap.get_up_osds()
+        rc, health = self._cmd_health(cmd)
+        return 0, {
+            "epoch": self.osdmap.epoch,
+            "num_osds": self.osdmap.max_osd,
+            "num_up_osds": len(up),
+            "num_in_osds": sum(1 for o in range(self.osdmap.max_osd)
+                               if self.osdmap.is_in(o)),
+            "pools": {p.name: {"id": p.id, "type": p.type,
+                               "size": p.size, "pg_num": p.pg_num}
+                      for p in self.osdmap.pools.values()},
+            "health": health,
+        }
+
+    def _cmd_health(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        checks: Dict[str, Dict[str, Any]] = {}
+        down = [o for o in range(self.osdmap.max_osd)
+                if self.osdmap.exists(o) and self.osdmap.is_down(o)]
+        if down:
+            checks["OSD_DOWN"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(down)} osds down",
+                "detail": [f"osd.{o} is down" for o in down]}
+        degraded = 0
+        for pool in self.osdmap.pools.values():
+            for ps in range(pool.pg_num):
+                from ceph_tpu.osd.osdmap import PgId
+                acting, _p = self.osdmap.pg_to_acting_osds(
+                    PgId(pool.id, ps))
+                alive = [o for o in acting
+                         if o >= 0 and self.osdmap.is_up(o)]
+                if len(alive) < len([o for o in acting if o >= 0]) or \
+                        len(alive) < pool.size:
+                    degraded += 1
+        if degraded:
+            checks["PG_DEGRADED"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{degraded} pgs degraded"}
+        status = "HEALTH_OK" if not checks else "HEALTH_WARN"
+        return 0, {"status": status, "checks": checks}

@@ -1,0 +1,193 @@
+"""Async messenger (L3).
+
+Reference parity: AsyncMessenger + Connection + Dispatcher
+(/root/reference/src/msg/Messenger.h:1-824, src/msg/async/) re-designed
+on asyncio: each daemon owns one event loop; connections are asyncio
+streams carrying crc32c-framed messages (frames.py, the frames_v2
+discipline).  Dispatch is fast-dispatch only — a received message is
+handed straight to the dispatcher coroutine, no DispatchQueue thread
+(DispatchQueue.h:200-203's fast path is the only path here).
+
+Lossy-client semantics (src/msg/Policy.h): a dead connection is simply
+forgotten; recovery is the caller's job (the Objecter-role client resends
+ops on map change / reconnect, exactly like the reference's lossy client
+policy).
+
+TPU note: this layer is pure host control-plane.  Bulk data riding in
+messages stays bytes; the compute (EC encode, crc, placement) happens in
+the OSD daemon's batched device dispatches before/after the wire.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Awaitable, Callable, Dict, Optional
+
+from ceph_tpu.msg import frames
+from ceph_tpu.msg.messages import Message, MHello, decode_message
+
+log = logging.getLogger("msgr")
+
+DispatchFn = Callable[["Connection", Message], Awaitable[None]]
+
+
+class Connection:
+    """One peer session (Connection role)."""
+
+    def __init__(self, messenger: "Messenger",
+                 reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter,
+                 peer_name: str = "", peer_addr: str = ""):
+        self.messenger = messenger
+        self.reader = reader
+        self.writer = writer
+        self.peer_name = peer_name
+        self.peer_addr = peer_addr
+        self._seq = itertools.count()
+        self._send_lock = asyncio.Lock()
+        self.closed = False
+
+    async def send(self, msg: Message) -> None:
+        if self.closed:
+            raise ConnectionError(f"connection to {self.peer_name} closed")
+        frame = frames.encode_frame(msg.TAG, next(self._seq), msg.encode())
+        async with self._send_lock:
+            self.writer.write(frame)
+            await self.writer.drain()
+
+    def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+
+    def __repr__(self) -> str:
+        return f"Connection(peer={self.peer_name}@{self.peer_addr})"
+
+
+class Messenger:
+    """Bind/connect endpoint owning all connections of one entity."""
+
+    def __init__(self, entity_name: str):
+        self.entity_name = entity_name
+        self.addr: str = ""
+        self.dispatcher: Optional[DispatchFn] = None
+        self.on_connection_fault: Optional[
+            Callable[[Connection], None]] = None
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._conns: Dict[str, Connection] = {}      # by peer addr
+        self._accepted: list = []                     # inbound conns
+        self._tasks: set = set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def bind(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self._server = await asyncio.start_server(
+            self._handle_accept, host, port)
+        port = self._server.sockets[0].getsockname()[1]
+        self.addr = f"{host}:{port}"
+        return self.addr
+
+    async def shutdown(self) -> None:
+        # close live connections BEFORE wait_closed(): since 3.12 it
+        # waits for all connection handlers, which sit in read loops
+        # until their connection dies
+        if self._server is not None:
+            self._server.close()
+        for conn in list(self._conns.values()) + list(self._accepted):
+            conn.close()
+        self._conns.clear()
+        self._accepted.clear()
+        for task in list(self._tasks):
+            task.cancel()
+        if self._server is not None:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5)
+            except (Exception, asyncio.TimeoutError):
+                pass
+            self._server = None
+
+    # -- outbound ----------------------------------------------------------
+
+    async def connect(self, addr: str) -> Connection:
+        """Get-or-create a connection to addr (cached, like the
+        AsyncMessenger connection table)."""
+        conn = self._conns.get(addr)
+        if conn is not None and not conn.closed:
+            return conn
+        host, port_s = addr.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port_s))
+        conn = Connection(self, reader, writer, peer_addr=addr)
+        self._conns[addr] = conn
+        await conn.send(MHello(self.entity_name, self.addr))
+        self._spawn(self._read_loop(conn))
+        return conn
+
+    async def send_to(self, addr: str, msg: Message) -> None:
+        conn = await self.connect(addr)
+        await conn.send(msg)
+
+    # -- inbound -----------------------------------------------------------
+
+    async def _handle_accept(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        conn = Connection(self, reader, writer)
+        self._accepted.append(conn)
+        await self._read_loop(conn)
+
+    def _spawn(self, coro) -> asyncio.Task:
+        task = asyncio.get_running_loop().create_task(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    async def _read_loop(self, conn: Connection) -> None:
+        try:
+            while True:
+                pre = await conn.reader.readexactly(
+                    frames.PREAMBLE_WIRE_LEN)
+                tag, _flags, _seq, length = frames.decode_preamble(pre)
+                payload = await conn.reader.readexactly(length)
+                frames.check_payload(
+                    payload, await conn.reader.readexactly(4))
+                msg = decode_message(tag, payload)
+                if isinstance(msg, MHello):
+                    conn.peer_name = msg.entity_name
+                    conn.peer_addr = msg.addr
+                    continue
+                if self.dispatcher is not None:
+                    # fast dispatch: run handlers concurrently so a slow
+                    # op never blocks the connection's read loop
+                    self._spawn(self._dispatch_one(conn, msg))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass  # peer went away: lossy policy, just forget it
+        except frames.FrameError as e:
+            log.warning("%s: dropping %s: %s", self.entity_name, conn, e)
+        except asyncio.CancelledError:
+            raise
+        finally:
+            conn.close()
+            # evict only THIS connection: an accepted conn can share the
+            # peer's listen addr with a healthy outbound conn
+            if self._conns.get(conn.peer_addr) is conn:
+                del self._conns[conn.peer_addr]
+            if conn in self._accepted:
+                self._accepted.remove(conn)
+            if self.on_connection_fault is not None:
+                try:
+                    self.on_connection_fault(conn)
+                except Exception:
+                    log.exception("connection fault handler failed")
+
+    async def _dispatch_one(self, conn: Connection, msg: Message) -> None:
+        try:
+            await self.dispatcher(conn, msg)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("%s: dispatch of %r failed",
+                          self.entity_name, msg)

@@ -1,0 +1,66 @@
+"""Wire frame discipline.
+
+Reference parity: the msgr2 frame format
+(/root/reference/src/msg/async/frames_v2.cc:44-77) — a fixed preamble
+carrying tag + segment layout protected by its own crc32c, segments each
+followed by a crc32c epilogue.  This framework uses one segment per frame
+(payloads are single encoded messages; large data rides inside them), so
+the format collapses to:
+
+    preamble (20 bytes):
+        magic   u32  = 0xCE9F0205
+        tag     u16  (message type)
+        flags   u16
+        seq     u64  (per-connection frame counter)
+        len     u32  (payload length)
+    preamble_crc u32  crc32c(-1) over the 20 preamble bytes
+    payload      len bytes
+    payload_crc  u32  crc32c(-1) over payload
+
+Any crc or magic mismatch is a protocol error: the connection is dropped
+(the reference resets the session on a bad frame; lossless peers
+reconnect and replay, lossy clients resend at the Objecter layer).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Tuple
+
+from ceph_tpu.ops.checksum import crc32c
+
+MAGIC = 0xCE9F0205
+PREAMBLE = struct.Struct("<IHHQI")
+CRC = struct.Struct("<I")
+
+
+class FrameError(Exception):
+    """Bad magic or crc: the connection must be dropped."""
+
+
+def encode_frame(tag: int, seq: int, payload: bytes,
+                 flags: int = 0) -> bytes:
+    pre = PREAMBLE.pack(MAGIC, tag, flags, seq, len(payload))
+    return b"".join((
+        pre, CRC.pack(crc32c(0xFFFFFFFF, pre)),
+        payload, CRC.pack(crc32c(0xFFFFFFFF, payload))))
+
+
+def decode_preamble(buf: bytes) -> Tuple[int, int, int, int]:
+    """24 preamble+crc bytes -> (tag, flags, seq, payload_len)."""
+    magic, tag, flags, seq, length = PREAMBLE.unpack_from(buf)
+    (crc,) = CRC.unpack_from(buf, PREAMBLE.size)
+    if magic != MAGIC:
+        raise FrameError(f"bad magic {magic:#x}")
+    if crc32c(0xFFFFFFFF, buf[:PREAMBLE.size]) != crc:
+        raise FrameError("preamble crc mismatch")
+    return tag, flags, seq, length
+
+
+def check_payload(payload: bytes, crc_bytes: bytes) -> None:
+    (crc,) = CRC.unpack(crc_bytes)
+    if crc32c(0xFFFFFFFF, payload) != crc:
+        raise FrameError("payload crc mismatch")
+
+
+PREAMBLE_WIRE_LEN = PREAMBLE.size + CRC.size  # 24

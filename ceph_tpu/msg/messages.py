@@ -1,0 +1,552 @@
+"""Typed wire messages.
+
+Reference parity: src/messages/ (MOSDOp.h, MOSDPing.h, MOSDFailure.h,
+MOSDMap.h, MMonCommand.h, MOSDECSubOpWrite.h, ...) — each message is a
+versioned struct carried in a tagged frame.  The reference dispatches on
+the header type id; here every class has a TAG and a registry maps tag ->
+class at decode time.  Payloads use the versioned encoder
+(ceph_tpu.common.encoding), so messages can grow fields without breaking
+older peers (DECODE_FINISH skips unknown tails).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from ceph_tpu.common.encoding import Decoder, Encoder
+from ceph_tpu.osd.osdmap import PgId
+
+_REGISTRY: Dict[int, type] = {}
+
+
+def register(cls):
+    assert cls.TAG not in _REGISTRY, f"duplicate tag {cls.TAG}"
+    _REGISTRY[cls.TAG] = cls
+    return cls
+
+
+class Message:
+    TAG = 0
+    VERSION = 1
+    COMPAT = 1
+
+    def encode(self) -> bytes:
+        enc = Encoder()
+        enc.start(self.VERSION, self.COMPAT)
+        self.encode_payload(enc)
+        enc.finish()
+        return enc.to_bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Message":
+        dec = Decoder(data)
+        dec.start(cls.VERSION)
+        msg = cls.decode_payload(dec)
+        dec.finish()
+        return msg
+
+    def encode_payload(self, enc: Encoder) -> None:
+        raise NotImplementedError
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "Message":
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        fields = ", ".join(f"{k}={v!r}" for k, v in vars(self).items()
+                           if not k.startswith("_") and k != "data")
+        return f"{type(self).__name__}({fields})"
+
+
+def decode_message(tag: int, payload: bytes) -> Message:
+    cls = _REGISTRY.get(tag)
+    if cls is None:
+        raise ValueError(f"unknown message tag {tag}")
+    return cls.decode(payload)
+
+
+def _enc_pg(enc: Encoder, pg: PgId) -> None:
+    enc.s64(pg.pool)
+    enc.u32(pg.ps)
+
+
+def _dec_pg(dec: Decoder) -> PgId:
+    return PgId(dec.s64(), dec.u32())
+
+
+# -- session / control ------------------------------------------------------
+
+
+@register
+class MHello(Message):
+    """Connection handshake: who is on the other end (entity_addr_t role)."""
+
+    TAG = 1
+
+    def __init__(self, entity_name: str, addr: str):
+        self.entity_name = entity_name
+        self.addr = addr
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.string(self.entity_name)
+        enc.string(self.addr)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MHello":
+        return cls(dec.string(), dec.string())
+
+
+PING = 0
+PING_REPLY = 1
+
+
+@register
+class MPing(Message):
+    """MOSDPing role: OSD<->OSD heartbeat (OSD.cc:5235 handle_osd_ping)."""
+
+    TAG = 2
+
+    def __init__(self, kind: int, stamp: float, epoch: int = 0,
+                 from_osd: int = -1):
+        self.kind = kind
+        self.stamp = stamp
+        self.epoch = epoch
+        self.from_osd = from_osd
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u8(self.kind)
+        enc.f64(self.stamp)
+        enc.u32(self.epoch)
+        enc.s32(self.from_osd)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MPing":
+        return cls(dec.u8(), dec.f64(), dec.u32(), dec.s32())
+
+
+@register
+class MOSDBoot(Message):
+    """OSD -> mon: I'm up at this address (MOSDBoot role)."""
+
+    TAG = 3
+
+    def __init__(self, osd: int, addr: str, boot_epoch: int = 0):
+        self.osd = osd
+        self.addr = addr
+        self.boot_epoch = boot_epoch
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.s32(self.osd)
+        enc.string(self.addr)
+        enc.u32(self.boot_epoch)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MOSDBoot":
+        return cls(dec.s32(), dec.string(), dec.u32())
+
+
+@register
+class MOSDFailure(Message):
+    """OSD -> mon failure report (MOSDFailure; OSDMonitor::prepare_failure)."""
+
+    TAG = 4
+
+    def __init__(self, target_osd: int, reporter: int, failed_for: float,
+                 epoch: int):
+        self.target_osd = target_osd
+        self.reporter = reporter
+        self.failed_for = failed_for
+        self.epoch = epoch
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.s32(self.target_osd)
+        enc.s32(self.reporter)
+        enc.f64(self.failed_for)
+        enc.u32(self.epoch)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MOSDFailure":
+        return cls(dec.s32(), dec.s32(), dec.f64(), dec.u32())
+
+
+@register
+class MGetMap(Message):
+    """Client/OSD -> mon: send me the OSDMap (subscribe semantics)."""
+
+    TAG = 5
+
+    def __init__(self, since_epoch: int = 0, subscribe: bool = True):
+        self.since_epoch = since_epoch
+        self.subscribe = subscribe
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u32(self.since_epoch)
+        enc.bool(self.subscribe)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MGetMap":
+        return cls(dec.u32(), dec.bool())
+
+
+@register
+class MOSDMapMsg(Message):
+    """Mon -> peer: full map and/or incrementals (MOSDMap role)."""
+
+    TAG = 6
+
+    def __init__(self, epoch: int, full_map: Optional[bytes] = None,
+                 incrementals: Optional[List[bytes]] = None):
+        self.epoch = epoch
+        self.full_map = full_map
+        self.incrementals = incrementals or []
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u32(self.epoch)
+        enc.optional(self.full_map, Encoder.bytes)
+        enc.list(self.incrementals, Encoder.bytes)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MOSDMapMsg":
+        return cls(dec.u32(), dec.optional(Decoder.bytes),
+                   dec.list(Decoder.bytes))
+
+
+@register
+class MMonCommand(Message):
+    """JSON command to the mon (MMonCommand / `ceph` CLI role)."""
+
+    TAG = 7
+
+    def __init__(self, tid: int, cmd: Dict[str, Any]):
+        self.tid = tid
+        self.cmd = cmd
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        enc.string(json.dumps(self.cmd))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MMonCommand":
+        return cls(dec.u64(), json.loads(dec.string()))
+
+
+@register
+class MMonCommandReply(Message):
+    TAG = 8
+
+    def __init__(self, tid: int, rc: int, out: Dict[str, Any]):
+        self.tid = tid
+        self.rc = rc
+        self.out = out
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        enc.s32(self.rc)
+        enc.string(json.dumps(self.out))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MMonCommandReply":
+        return cls(dec.u64(), dec.s32(), json.loads(dec.string()))
+
+
+# -- client data path -------------------------------------------------------
+
+
+class OSDOp:
+    """One sub-operation of an MOSDOp (OSDOp / ceph_osd_op role)."""
+
+    def __init__(self, op: str, offset: int = 0, length: int = 0,
+                 data: bytes = b"", args: Optional[Dict[str, Any]] = None):
+        self.op = op
+        self.offset = offset
+        self.length = length
+        self.data = data
+        self.args = args or {}
+
+    def encode(self, enc: Encoder) -> None:
+        enc.string(self.op)
+        enc.u64(self.offset)
+        enc.u64(self.length)
+        enc.bytes(self.data)
+        enc.string(json.dumps(self.args))
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "OSDOp":
+        return cls(dec.string(), dec.u64(), dec.u64(), dec.bytes(),
+                   json.loads(dec.string()))
+
+    def __repr__(self) -> str:
+        return (f"OSDOp({self.op!r}, off={self.offset}, "
+                f"len={self.length or len(self.data)})")
+
+
+@register
+class MOSDOp(Message):
+    """Client -> primary OSD op (MOSDOp.h role)."""
+
+    TAG = 9
+
+    def __init__(self, tid: int, client: str, pg: PgId, oid: str,
+                 ops: List[OSDOp], epoch: int):
+        self.tid = tid
+        self.client = client
+        self.pg = pg
+        self.oid = oid
+        self.ops = ops
+        self.epoch = epoch
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        enc.string(self.client)
+        _enc_pg(enc, self.pg)
+        enc.string(self.oid)
+        enc.list(self.ops, lambda e, op: op.encode(e))
+        enc.u32(self.epoch)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MOSDOp":
+        return cls(dec.u64(), dec.string(), _dec_pg(dec), dec.string(),
+                   dec.list(OSDOp.decode), dec.u32())
+
+
+@register
+class MOSDOpReply(Message):
+    TAG = 10
+
+    def __init__(self, tid: int, rc: int, data: bytes = b"",
+                 out: Optional[Dict[str, Any]] = None,
+                 replay_epoch: int = 0):
+        self.tid = tid
+        self.rc = rc
+        self.data = data
+        self.out = out or {}
+        # >0: client should wait for this map epoch and resend (the
+        # ENOENT-on-wrong-primary / EAGAIN resend discipline)
+        self.replay_epoch = replay_epoch
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        enc.s32(self.rc)
+        enc.bytes(self.data)
+        enc.string(json.dumps(self.out))
+        enc.u32(self.replay_epoch)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MOSDOpReply":
+        return cls(dec.u64(), dec.s32(), dec.bytes(),
+                   json.loads(dec.string()), dec.u32())
+
+
+# -- primary -> shard sub-ops ----------------------------------------------
+
+
+class ShardOp:
+    """One ObjectStore-level mutation on a shard (ECSubWrite payload item)."""
+
+    def __init__(self, op: str, offset: int = 0, data: bytes = b"",
+                 name: str = "", value: bytes = b"", size: int = 0):
+        self.op = op          # write | truncate | remove | setattr | create
+        self.offset = offset
+        self.data = data
+        self.name = name
+        self.value = value
+        self.size = size
+
+    def encode(self, enc: Encoder) -> None:
+        enc.string(self.op)
+        enc.u64(self.offset)
+        enc.bytes(self.data)
+        enc.string(self.name)
+        enc.bytes(self.value)
+        enc.u64(self.size)
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "ShardOp":
+        return cls(dec.string(), dec.u64(), dec.bytes(), dec.string(),
+                   dec.bytes(), dec.u64())
+
+
+@register
+class MOSDSubWrite(Message):
+    """Primary -> shard write (MOSDECSubOpWrite / MOSDRepOp role).
+
+    Carries the shard transaction plus the pg log entry for that write so
+    replicas journal the op (PGLog) before applying it.
+    """
+
+    TAG = 11
+
+    def __init__(self, tid: int, pg: PgId, shard: int, oid: str,
+                 ops: List[ShardOp], epoch: int,
+                 log_entry: Optional[Dict[str, Any]] = None,
+                 from_osd: int = -1):
+        self.tid = tid
+        self.pg = pg
+        self.shard = shard
+        self.oid = oid
+        self.ops = ops
+        self.epoch = epoch
+        self.log_entry = log_entry
+        self.from_osd = from_osd
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        _enc_pg(enc, self.pg)
+        enc.s32(self.shard)
+        enc.string(self.oid)
+        enc.list(self.ops, lambda e, op: op.encode(e))
+        enc.u32(self.epoch)
+        enc.optional(self.log_entry,
+                     lambda e, v: e.string(json.dumps(v)))
+        enc.s32(self.from_osd)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MOSDSubWrite":
+        return cls(dec.u64(), _dec_pg(dec), dec.s32(), dec.string(),
+                   dec.list(ShardOp.decode), dec.u32(),
+                   dec.optional(lambda d: json.loads(d.string())),
+                   dec.s32())
+
+
+@register
+class MOSDSubWriteReply(Message):
+    TAG = 12
+
+    def __init__(self, tid: int, rc: int, shard: int = -1):
+        self.tid = tid
+        self.rc = rc
+        self.shard = shard
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        enc.s32(self.rc)
+        enc.s32(self.shard)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MOSDSubWriteReply":
+        return cls(dec.u64(), dec.s32(), dec.s32())
+
+
+@register
+class MOSDSubRead(Message):
+    """Primary -> shard read (MOSDECSubOpRead role)."""
+
+    TAG = 13
+
+    def __init__(self, tid: int, pg: PgId, shard: int, oid: str,
+                 offset: int = 0, length: int = 0,
+                 want_attrs: bool = True):
+        self.tid = tid
+        self.pg = pg
+        self.shard = shard
+        self.oid = oid
+        self.offset = offset
+        self.length = length
+        self.want_attrs = want_attrs
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        _enc_pg(enc, self.pg)
+        enc.s32(self.shard)
+        enc.string(self.oid)
+        enc.u64(self.offset)
+        enc.u64(self.length)
+        enc.bool(self.want_attrs)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MOSDSubRead":
+        return cls(dec.u64(), _dec_pg(dec), dec.s32(), dec.string(),
+                   dec.u64(), dec.u64(), dec.bool())
+
+
+@register
+class MOSDSubReadReply(Message):
+    TAG = 14
+
+    def __init__(self, tid: int, rc: int, data: bytes = b"",
+                 attrs: Optional[Dict[str, bytes]] = None,
+                 shard: int = -1):
+        self.tid = tid
+        self.rc = rc
+        self.data = data
+        self.attrs = attrs or {}
+        self.shard = shard
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        enc.s32(self.rc)
+        enc.bytes(self.data)
+        enc.map(self.attrs, Encoder.string, Encoder.bytes)
+        enc.s32(self.shard)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MOSDSubReadReply":
+        return cls(dec.u64(), dec.s32(), dec.bytes(),
+                   dec.map(Decoder.string, Decoder.bytes), dec.s32())
+
+
+# -- peering ----------------------------------------------------------------
+
+
+@register
+class MPGQuery(Message):
+    """Primary -> replica: send me your pg info + log (GetLog/GetInfo)."""
+
+    TAG = 15
+
+    def __init__(self, tid: int, pg: PgId, epoch: int, from_osd: int):
+        self.tid = tid
+        self.pg = pg
+        self.epoch = epoch
+        self.from_osd = from_osd
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        _enc_pg(enc, self.pg)
+        enc.u32(self.epoch)
+        enc.s32(self.from_osd)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MPGQuery":
+        return cls(dec.u64(), _dec_pg(dec), dec.u32(), dec.s32())
+
+
+@register
+class MPGLogMsg(Message):
+    """Replica -> primary: pg info + full log (MOSDPGLog role)."""
+
+    TAG = 16
+
+    def __init__(self, tid: int, pg: PgId, shard: int,
+                 info: Dict[str, Any], entries: List[Dict[str, Any]],
+                 epoch: int = 0, from_osd: int = -1,
+                 is_reply: bool = False):
+        self.tid = tid
+        self.pg = pg
+        self.shard = shard
+        self.info = info
+        self.entries = entries
+        self.epoch = epoch
+        self.from_osd = from_osd
+        # pushes (primary -> peer, authoritative log) and replies (peer ->
+        # primary) share this struct; the flag keeps them apart — tids
+        # alone cannot, since each daemon numbers its own requests
+        self.is_reply = is_reply
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        _enc_pg(enc, self.pg)
+        enc.s32(self.shard)
+        enc.string(json.dumps(self.info))
+        enc.list(self.entries, lambda e, v: e.string(json.dumps(v)))
+        enc.u32(self.epoch)
+        enc.s32(self.from_osd)
+        enc.bool(self.is_reply)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MPGLogMsg":
+        return cls(dec.u64(), _dec_pg(dec), dec.s32(),
+                   json.loads(dec.string()),
+                   dec.list(lambda d: json.loads(d.string())),
+                   dec.u32(), dec.s32(), dec.bool())

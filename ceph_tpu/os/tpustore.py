@@ -413,11 +413,17 @@ class TPUStore(ObjectStore):
             # the persisted freelist is the post-commit truth: allocator
             # state with this transaction's releases applied — but the
             # in-memory allocator only sees them after the commit point
-            final_alloc = Allocator.from_json(self._alloc.to_json())
-            for off, ln in self._txc_release:
-                final_alloc.release(off, ln)
+            if self._txc_release:
+                final_alloc = Allocator()
+                final_alloc.free = list(self._alloc.free)
+                final_alloc.device_size = self._alloc.device_size
+                for off, ln in self._txc_release:
+                    final_alloc.release(off, ln)
+                state_json = final_alloc.to_json()
+            else:
+                state_json = self._alloc.to_json()
             kvt.set(P_FREELIST, b"state",
-                    json.dumps(final_alloc.to_json()).encode())
+                    json.dumps(state_json).encode())
             # data first, then the metadata commit point
             self._block.flush()
             _os.fsync(self._block.fileno())

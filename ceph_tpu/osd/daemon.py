@@ -1,0 +1,985 @@
+"""OSD daemon: the data-plane node.
+
+Reference parity: ceph-osd (/root/reference/src/osd/OSD.cc,
+PrimaryLogPG.cc, ECBackend.cc, ReplicatedBackend.cc) re-designed on an
+asyncio event loop:
+
+- boot: connect the mon, MOSDBoot, subscribe to map epochs
+  (OSD::init OSD.cc:3283 + monc subscribe);
+- client ops (MOSDOp) hit the primary's op engine: version assignment +
+  pg log entry (PrimaryLogPG::execute_ctx), EC encode / replica fan-out
+  as sub-writes carrying the log entry (ECBackend::submit_transaction
+  ECBackend.cc:1502 -> :2066, ReplicatedBackend's repop), client acked
+  when every up shard committed;
+- peering on map change (PeeringState roles): primary queries shard
+  infos+logs (GetInfo/GetLog), elects the authoritative log (max
+  last_update), pushes it to peers who merge + rewind divergent entries
+  (PGLog.h:1241-1247), computes per-shard missing sets, recovers
+  missing objects (EC reconstruct + push — the RecoveryOp role,
+  ECBackend.h:249), then activates and drains queued ops;
+- OSD<->OSD heartbeats (OSD.cc:5235 handle_osd_ping) with failure
+  reports to the mon after the local grace (OSD.cc:5889 send_failures).
+
+TPU placement: the per-op EC encode/decode goes through the registered
+codec (ec_jax — batched GF(2^8) MXU matmuls on device when available);
+placement comes from the shared OSDMap/CRUSH kernel path; everything
+else is host control-plane.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ceph_tpu.crush.map import CRUSH_ITEM_NONE
+from ceph_tpu.ec.registry import create_erasure_code
+from ceph_tpu.msg import Connection, Messenger
+from ceph_tpu.msg.messages import (
+    Message,
+    MGetMap,
+    MOSDBoot,
+    MOSDFailure,
+    MOSDMapMsg,
+    MOSDOp,
+    MOSDOpReply,
+    MOSDSubRead,
+    MOSDSubReadReply,
+    MOSDSubWrite,
+    MOSDSubWriteReply,
+    MPGLogMsg,
+    MPGQuery,
+    MPing,
+    PING,
+    PING_REPLY,
+    ShardOp,
+)
+from ceph_tpu.ops import checksum as cks
+from ceph_tpu.os import ObjectId, ObjectStore, Transaction
+from ceph_tpu.os.memstore import MemStore
+from ceph_tpu.osd import ec_util
+from ceph_tpu.osd.osdmap import OSDMap, PgId, TYPE_ERASURE, TYPE_REPLICATED
+from ceph_tpu.osd.pg_log import (
+    PGLog,
+    PGMETA_OID,
+    ZERO,
+    ev,
+    make_entry,
+)
+from ceph_tpu.rados.embedded import HINFO_ATTR, OI_ATTR, shard_collection
+
+log = logging.getLogger("osd")
+
+EAGAIN = -11
+ENOENT = -2
+ESTALE = -116
+EIO = -5
+
+DEFAULTS = {
+    "osd_heartbeat_interval": 1.0,
+    "osd_heartbeat_grace": 4.0,
+    "osd_sub_op_timeout": 5.0,
+    "osd_min_pg_log_entries": 100,
+    "osd_pool_erasure_code_stripe_unit": 4096,
+}
+
+
+class PGState:
+    """In-memory PG bookkeeping (PG + PeeringState role)."""
+
+    def __init__(self, pg: PgId):
+        self.pg = pg
+        self.acting: List[int] = []
+        self.primary = -1
+        self.state = "inactive"          # inactive|peering|active
+        self.interval_epoch = 0          # same_interval_since
+        self.log: Optional[PGLog] = None  # my shard's log (lazy)
+        self.next_version = 1            # primary: next log version
+        self.peer_missing: Dict[int, Dict[str, tuple]] = {}
+        self.active_event = asyncio.Event()
+        self.peering_task: Optional[asyncio.Task] = None
+
+    def my_shard(self, osd: int, pool_type: int) -> int:
+        if pool_type == TYPE_REPLICATED:
+            return -1
+        try:
+            return self.acting.index(osd)
+        except ValueError:
+            return -1
+
+
+class OSDDaemon:
+    def __init__(self, osd_id: int, mon_addr: str,
+                 store: Optional[ObjectStore] = None,
+                 config: Optional[Dict[str, Any]] = None):
+        self.osd_id = osd_id
+        self.mon_addr = mon_addr
+        self.config = dict(DEFAULTS)
+        self.config.update(config or {})
+        self.msgr = Messenger(f"osd.{osd_id}")
+        self.msgr.dispatcher = self._dispatch
+        self.store = store if store is not None else MemStore()
+        self._own_store = store is None
+        self.osdmap: Optional[OSDMap] = None
+        self.pgs: Dict[PgId, PGState] = {}
+        self._codecs: Dict[int, Any] = {}
+        self._tid = 0
+        self._futures: Dict[int, asyncio.Future] = {}
+        self._hb_last_rx: Dict[int, float] = {}
+        self._hb_task: Optional[asyncio.Task] = None
+        self._map_event = asyncio.Event()
+        self._stopping = False
+        self._last_boot_sent = 0.0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        if self._own_store:
+            self.store.mkfs()
+            self.store.mount()
+        addr = await self.msgr.bind(host, port)
+        mon = await self.msgr.connect(self.mon_addr)
+        await mon.send(MGetMap(subscribe=True))
+        await mon.send(MOSDBoot(self.osd_id, addr))
+        # wait until the map marks us up (prepare_boot round trip)
+        for _ in range(200):
+            if self.osdmap is not None and \
+                    self.osdmap.is_up(self.osd_id) and \
+                    self.osdmap.osd_addrs.get(self.osd_id) == addr:
+                break
+            await asyncio.sleep(0.02)
+        self._hb_task = asyncio.get_running_loop().create_task(
+            self._heartbeat_loop())
+        return addr
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+        for ps in self.pgs.values():
+            if ps.peering_task is not None:
+                ps.peering_task.cancel()
+        await self.msgr.shutdown()
+        if self._own_store:
+            self.store.umount()
+
+    async def kill(self) -> None:
+        """Crash: drop off the network without unmounting cleanly."""
+        self._stopping = True
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+        for ps in self.pgs.values():
+            if ps.peering_task is not None:
+                ps.peering_task.cancel()
+        await self.msgr.shutdown()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _next_tid(self) -> int:
+        self._tid += 1
+        return self._tid
+
+    def _codec(self, pool_id: int):
+        codec = self._codecs.get(pool_id)
+        if codec is None:
+            pool = self.osdmap.pools[pool_id]
+            profile = self.osdmap.erasure_code_profiles[
+                pool.erasure_code_profile]
+            codec = create_erasure_code(dict(profile))
+            self._codecs[pool_id] = codec
+        return codec
+
+    def _sinfo(self, pool_id: int) -> ec_util.StripeInfo:
+        codec = self._codec(pool_id)
+        k = codec.get_data_chunk_count()
+        unit = codec.get_chunk_size(
+            k * int(self.config["osd_pool_erasure_code_stripe_unit"]))
+        return ec_util.StripeInfo(k, k * unit)
+
+    async def _request(self, osd: int, msg: Message,
+                       tid: int) -> Optional[Message]:
+        """Send to a peer OSD and await the tid-matched reply; None on
+        timeout/fault (caller treats the shard as unavailable)."""
+        addr = self.osdmap.osd_addrs.get(osd)
+        if addr is None:
+            return None
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._futures[tid] = fut
+        try:
+            await self.msgr.send_to(addr, msg)
+            return await asyncio.wait_for(
+                fut, self.config["osd_sub_op_timeout"])
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            return None
+        finally:
+            self._futures.pop(tid, None)
+
+    def _resolve(self, tid: int, msg: Message) -> bool:
+        fut = self._futures.get(tid)
+        if fut is not None and not fut.done():
+            fut.set_result(msg)
+            return True
+        return False
+
+    # -- dispatch ----------------------------------------------------------
+
+    async def _dispatch(self, conn: Connection, msg: Message) -> None:
+        if isinstance(msg, MOSDMapMsg):
+            self._handle_map(msg)
+        elif isinstance(msg, MPing):
+            await self._handle_ping(conn, msg)
+        elif isinstance(msg, MOSDOp):
+            await self._handle_client_op(conn, msg)
+        elif isinstance(msg, MOSDSubWrite):
+            await self._handle_sub_write(conn, msg)
+        elif isinstance(msg, MOSDSubRead):
+            await self._handle_sub_read(conn, msg)
+        elif isinstance(msg, (MOSDSubWriteReply, MOSDSubReadReply)):
+            self._resolve(msg.tid, msg)
+        elif isinstance(msg, MPGQuery):
+            await self._handle_pg_query(conn, msg)
+        elif isinstance(msg, MPGLogMsg):
+            if msg.is_reply:
+                self._resolve(msg.tid, msg)  # late replies just drop
+            else:
+                await self._handle_pg_log_push(conn, msg)
+
+    # -- map handling ------------------------------------------------------
+
+    def _handle_map(self, msg: MOSDMapMsg) -> None:
+        if msg.full_map is None:
+            return
+        newmap = OSDMap.decode(msg.full_map)
+        if self.osdmap is not None and newmap.epoch <= self.osdmap.epoch:
+            return
+        self.osdmap = newmap
+        self._map_event.set()
+        self._map_event = asyncio.Event()
+        # falsely marked down while alive: re-boot (MOSDAlive role)
+        if not newmap.is_up(self.osd_id) and not self._stopping and \
+                self.msgr.addr and \
+                time.monotonic() - self._last_boot_sent > 1.0:
+            self._last_boot_sent = time.monotonic()
+            self.msgr._spawn(self.msgr.send_to(
+                self.mon_addr, MOSDBoot(self.osd_id, self.msgr.addr)))
+        self._scan_pgs()
+
+    def _scan_pgs(self) -> None:
+        """Map epoch changed: find my PGs, detect interval changes,
+        kick peering where I'm primary (the load_pgs/advance_pg role)."""
+        for pool in self.osdmap.pools.values():
+            for ps_num in range(pool.pg_num):
+                pg = PgId(pool.id, ps_num)
+                acting, primary = self.osdmap.pg_to_acting_osds(pg)
+                in_acting = self.osd_id in [
+                    o for o in acting if o != CRUSH_ITEM_NONE]
+                state = self.pgs.get(pg)
+                if not in_acting:
+                    if state is not None:
+                        state.state = "inactive"
+                    continue
+                if state is None:
+                    state = PGState(pg)
+                    self.pgs[pg] = state
+                if state.acting != acting or state.primary != primary:
+                    state.acting = acting
+                    state.primary = primary
+                    state.interval_epoch = self.osdmap.epoch
+                    state.state = "inactive"
+                    state.active_event.clear()
+                    if state.peering_task is not None:
+                        state.peering_task.cancel()
+                        state.peering_task = None
+                if primary == self.osd_id and state.state == "inactive" \
+                        and state.peering_task is None:
+                    state.state = "peering"
+                    state.peering_task = \
+                        asyncio.get_running_loop().create_task(
+                            self._peer_pg(state, pool))
+
+    # -- heartbeats --------------------------------------------------------
+
+    async def _handle_ping(self, conn: Connection, msg: MPing) -> None:
+        if msg.from_osd >= 0:
+            self._hb_last_rx[msg.from_osd] = time.monotonic()
+        if msg.kind == PING:
+            await conn.send(MPing(PING_REPLY, msg.stamp,
+                                  epoch=self._epoch(),
+                                  from_osd=self.osd_id))
+
+    def _epoch(self) -> int:
+        return self.osdmap.epoch if self.osdmap is not None else 0
+
+    async def _heartbeat_loop(self) -> None:
+        interval = self.config["osd_heartbeat_interval"]
+        grace = self.config["osd_heartbeat_grace"]
+        while not self._stopping:
+            await asyncio.sleep(interval)
+            if self.osdmap is None:
+                continue
+            now = time.monotonic()
+            for peer in self.osdmap.get_up_osds():
+                if peer == self.osd_id:
+                    continue
+                addr = self.osdmap.osd_addrs.get(peer)
+                if addr is None:
+                    continue
+                self._hb_last_rx.setdefault(peer, now)
+                try:
+                    await self.msgr.send_to(
+                        addr, MPing(PING, now, epoch=self._epoch(),
+                                    from_osd=self.osd_id))
+                except (ConnectionError, OSError):
+                    pass
+                elapsed = now - self._hb_last_rx[peer]
+                if elapsed > grace:
+                    # report to mon (send_failures, OSD.cc:5889)
+                    try:
+                        await self.msgr.send_to(
+                            self.mon_addr,
+                            MOSDFailure(peer, self.osd_id, elapsed,
+                                        self._epoch()))
+                    except (ConnectionError, OSError):
+                        pass
+
+    # -- local shard store helpers -----------------------------------------
+
+    def _cid(self, pg: PgId, shard: int) -> str:
+        return shard_collection(pg, shard)
+
+    def _load_log(self, state: PGState, pool) -> PGLog:
+        if state.log is None:
+            shard = state.my_shard(self.osd_id, pool.type)
+            state.log = PGLog.load(self.store, self._cid(state.pg, shard))
+        return state.log
+
+    def _apply_shard_ops(self, t: Transaction, cid: str, oid: str,
+                         ops: List[ShardOp]) -> None:
+        obj = ObjectId(oid)
+        if not self.store.collection_exists(cid):
+            t.create_collection(cid)
+        for op in ops:
+            if op.op == "create":
+                t.touch(cid, obj)
+            elif op.op == "truncate":
+                t.truncate(cid, obj, op.size)
+            elif op.op == "write":
+                t.write(cid, obj, op.offset, len(op.data), op.data)
+            elif op.op == "setattr":
+                t.setattr(cid, obj, op.name, op.value)
+            elif op.op == "remove":
+                t.remove(cid, obj)
+            else:
+                raise ValueError(f"unknown shard op {op.op!r}")
+
+    def _read_shard(self, pg: PgId, shard: int, oid: str
+                    ) -> Tuple[int, bytes, Dict[str, bytes]]:
+        """Local shard read with attrs; rc<0 on missing/corrupt."""
+        cid = self._cid(pg, shard)
+        obj = ObjectId(oid)
+        try:
+            data = self.store.read(cid, obj)
+            attrs = self.store.getattrs(cid, obj)
+        except KeyError:
+            return ENOENT, b"", {}
+        except IOError:
+            return EIO, b"", {}
+        return 0, data, attrs
+
+    # -- sub-ops (replica side) --------------------------------------------
+
+    async def _handle_sub_write(self, conn: Connection,
+                                msg: MOSDSubWrite) -> None:
+        state = self.pgs.get(msg.pg)
+        # fencing: a primary from an older interval must not mutate
+        if state is not None and msg.epoch < state.interval_epoch:
+            await conn.send(MOSDSubWriteReply(msg.tid, ESTALE, msg.shard))
+            return
+        pool = self.osdmap.pools.get(msg.pg.pool) if self.osdmap else None
+        cid = self._cid(msg.pg, msg.shard)
+        t = Transaction()
+        try:
+            self._apply_shard_ops(t, cid, msg.oid, msg.ops)
+            if state is None:
+                state = self.pgs.setdefault(msg.pg, PGState(msg.pg))
+            if pool is not None:
+                plog = self._load_log(state, pool)
+            else:
+                plog = state.log or PGLog()
+                state.log = plog
+            if msg.log_entry is not None:
+                version = ev(msg.log_entry["version"])
+                if version > plog.info.last_update:
+                    plog.append(msg.log_entry)
+                    plog.trim_to(
+                        int(self.config["osd_min_pg_log_entries"]))
+            # a write (client or recovery push) fills the object in
+            plog.missing.pop(msg.oid, None)
+            plog.stage(t, cid)
+            self.store.queue_transaction(t)
+        except Exception:
+            log.exception("osd.%d: sub-write %s/%s failed",
+                          self.osd_id, msg.pg, msg.oid)
+            await conn.send(MOSDSubWriteReply(msg.tid, EIO, msg.shard))
+            return
+        await conn.send(MOSDSubWriteReply(msg.tid, 0, msg.shard))
+
+    async def _handle_sub_read(self, conn: Connection,
+                               msg: MOSDSubRead) -> None:
+        state = self.pgs.get(msg.pg)
+        pool = self.osdmap.pools.get(msg.pg.pool) if self.osdmap else None
+        if state is not None and pool is not None:
+            plog = self._load_log(state, pool)
+            if msg.oid in plog.missing:
+                await conn.send(MOSDSubReadReply(
+                    msg.tid, ENOENT, shard=msg.shard))
+                return
+        rc, data, attrs = self._read_shard(msg.pg, msg.shard, msg.oid)
+        if rc == 0 and msg.length:
+            data = data[msg.offset:msg.offset + msg.length]
+        await conn.send(MOSDSubReadReply(
+            msg.tid, rc, data, attrs if msg.want_attrs else {},
+            shard=msg.shard))
+
+    # -- peering -----------------------------------------------------------
+
+    async def _handle_pg_query(self, conn: Connection,
+                               msg: MPGQuery) -> None:
+        pool = self.osdmap.pools.get(msg.pg.pool) if self.osdmap else None
+        state = self.pgs.setdefault(msg.pg, PGState(msg.pg))
+        if pool is not None:
+            plog = self._load_log(state, pool)
+        else:
+            plog = state.log or PGLog()
+        info = plog.info.to_dict()
+        info["missing"] = {k: list(v) for k, v in plog.missing.items()}
+        shard = state.my_shard(self.osd_id, pool.type) if pool else -1
+        await conn.send(MPGLogMsg(msg.tid, msg.pg, shard, info,
+                                  list(plog.entries),
+                                  epoch=self._epoch(),
+                                  from_osd=self.osd_id, is_reply=True))
+
+    async def _handle_pg_log_push(self, conn: Connection,
+                                  msg: MPGLogMsg) -> None:
+        """Primary pushed the authoritative log: merge + rewind, persist,
+        reply with my resulting missing set."""
+        from ceph_tpu.osd.pg_log import PGInfo
+
+        pool = self.osdmap.pools.get(msg.pg.pool) if self.osdmap else None
+        state = self.pgs.setdefault(msg.pg, PGState(msg.pg))
+        if pool is None:
+            return
+        plog = self._load_log(state, pool)
+        auth_info = PGInfo.from_dict(msg.info)
+        missing = plog.merge(auth_info, msg.entries)
+        # keep pre-existing missing entries not superseded by the merge
+        for oid, need in list(plog.missing.items()):
+            missing.setdefault(oid, need)
+        plog.missing = missing
+        cid = self._cid(msg.pg, msg.shard)
+        t = Transaction()
+        if not self.store.collection_exists(cid):
+            t.create_collection(cid)
+        plog.stage(t, cid)
+        self.store.queue_transaction(t)
+        info = plog.info.to_dict()
+        info["missing"] = {k: list(v) for k, v in plog.missing.items()}
+        await conn.send(MPGLogMsg(msg.tid, msg.pg, msg.shard, info, [],
+                                  epoch=self._epoch(),
+                                  from_osd=self.osd_id, is_reply=True))
+
+    async def _peer_pg(self, state: PGState, pool) -> None:
+        """Primary peering: GetInfo/GetLog -> auth election -> push ->
+        missing -> recover -> active."""
+        pg = state.pg
+        try:
+            my_shard = state.my_shard(self.osd_id, pool.type)
+            plog = self._load_log(state, pool)
+            # 1. collect infos+logs from up acting shards
+            peers: Dict[int, Tuple[Any, List[dict], Dict[str, tuple]]] = {}
+            peers[my_shard] = (plog.info, list(plog.entries),
+                              dict(plog.missing))
+            peer_shards: Dict[int, int] = {}  # shard -> osd
+            for idx, osd in enumerate(state.acting):
+                shard = idx if pool.type == TYPE_ERASURE else -1
+                if osd == CRUSH_ITEM_NONE or osd == self.osd_id or \
+                        not self.osdmap.is_up(osd):
+                    continue
+                if pool.type == TYPE_REPLICATED and shard == -1:
+                    shard_key = -(idx + 2)  # unique key per replica
+                else:
+                    shard_key = shard
+                tid = self._next_tid()
+                reply = await self._request(
+                    osd, MPGQuery(tid, pg, self._epoch(), self.osd_id),
+                    tid)
+                if reply is None or reply.pg != pg:
+                    continue
+                from ceph_tpu.osd.pg_log import PGInfo
+
+                info = PGInfo.from_dict(reply.info)
+                peer_missing = {k: ev(v) for k, v in
+                                reply.info.get("missing", {}).items()}
+                peers[shard_key] = (info, reply.entries, peer_missing)
+                peer_shards[shard_key] = osd
+            # 2. elect authoritative log (max last_update, then longest)
+            auth_key = max(
+                peers,
+                key=lambda s: (peers[s][0].last_update,
+                               len(peers[s][1]),
+                               s == my_shard))
+            auth_info, auth_entries, _ = peers[auth_key]
+            # 3. adopt locally if I'm not authoritative
+            if auth_key != my_shard:
+                my_missing = plog.merge(auth_info, auth_entries)
+                for oid, need in my_missing.items():
+                    plog.missing.setdefault(oid, need)
+                cid = self._cid(pg, my_shard)
+                t = Transaction()
+                if not self.store.collection_exists(cid):
+                    t.create_collection(cid)
+                plog.stage(t, cid)
+                self.store.queue_transaction(t)
+            # 4. push auth log to peers; collect their missing sets
+            state.peer_missing = {}
+            auth_wire_info = plog.info.to_dict()
+            for shard_key, osd in peer_shards.items():
+                shard = shard_key if shard_key >= -1 else -1
+                tid = self._next_tid()
+                reply = await self._request(
+                    osd, MPGLogMsg(tid, pg, shard, auth_wire_info,
+                                   list(plog.entries),
+                                   epoch=self._epoch(),
+                                   from_osd=self.osd_id), tid)
+                if reply is None or reply.pg != pg:
+                    continue
+                state.peer_missing[shard_key] = {
+                    k: ev(v)
+                    for k, v in reply.info.get("missing", {}).items()}
+            # 5. recovery: self first, then peers
+            await self._recover_pg(state, pool, peer_shards)
+            # 6. activate
+            state.next_version = plog.info.last_update[1] + 1
+            plog.info.same_interval_since = state.interval_epoch
+            plog.info.last_epoch_started = self._epoch()
+            state.state = "active"
+            state.active_event.set()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("osd.%d: peering %s failed", self.osd_id, pg)
+            state.state = "inactive"
+        finally:
+            state.peering_task = None
+
+    # -- recovery ----------------------------------------------------------
+
+    async def _gather_object_shards(
+            self, state: PGState, pool, oid: str,
+            exclude_missing: bool = True
+    ) -> Tuple[Dict[int, bytes], Dict[int, Dict[str, bytes]]]:
+        """Collect available shard payloads+attrs for an object from up
+        acting shards (local read for mine, sub-reads for peers)."""
+        pg = state.pg
+        shards: Dict[int, bytes] = {}
+        attrs: Dict[int, Dict[str, bytes]] = {}
+        my_shard = state.my_shard(self.osd_id, pool.type)
+        plog = self._load_log(state, pool)
+        for idx, osd in enumerate(state.acting):
+            shard = idx if pool.type == TYPE_ERASURE else -1
+            if osd == CRUSH_ITEM_NONE or not self.osdmap.is_up(osd):
+                continue
+            if osd == self.osd_id:
+                if exclude_missing and oid in plog.missing:
+                    continue
+                rc, data, at = self._read_shard(pg, shard, oid)
+                if rc == 0:
+                    shards[shard], attrs[shard] = data, at
+                if pool.type == TYPE_REPLICATED:
+                    if rc == 0:
+                        break
+                continue
+            tid = self._next_tid()
+            reply = await self._request(
+                osd, MOSDSubRead(tid, pg, shard, oid), tid)
+            if reply is not None and reply.rc == 0:
+                shards[shard], attrs[shard] = reply.data, reply.attrs
+                if pool.type == TYPE_REPLICATED:
+                    break
+        return shards, attrs
+
+    async def _recover_pg(self, state: PGState, pool,
+                          peer_shards: Dict[int, int]) -> None:
+        """Recover missing objects: mine by reconstruct, peers by push."""
+        pg = state.pg
+        plog = self._load_log(state, pool)
+        my_shard = state.my_shard(self.osd_id, pool.type)
+        # union of all objects anyone is missing
+        todo: Set[str] = set(plog.missing)
+        for missing in state.peer_missing.values():
+            todo.update(missing)
+        for oid in sorted(todo):
+            await self._recover_object(state, pool, oid, peer_shards)
+        # clear recovered state
+        if plog.missing:
+            plog.missing = {}
+            cid = self._cid(pg, my_shard)
+            t = Transaction()
+            plog.stage(t, cid)
+            self.store.queue_transaction(t)
+
+    async def _recover_object(self, state: PGState, pool, oid: str,
+                              peer_shards: Dict[int, int]) -> None:
+        """Reconstruct one object and install it wherever it's missing
+        (RecoveryOp: read k shards, re-encode, push)."""
+        pg = state.pg
+        plog = self._load_log(state, pool)
+        my_shard = state.my_shard(self.osd_id, pool.type)
+        shards, attrs = await self._gather_object_shards(state, pool, oid)
+        targets = [(shard_key, osd)
+                   for shard_key, osd in peer_shards.items()
+                   if oid in state.peer_missing.get(shard_key, {})]
+        i_need = oid in plog.missing
+
+        if not shards:
+            # object does not exist at any authoritative source: the
+            # divergent entry was a create nobody kept — remove it
+            for shard_key, osd in targets:
+                shard = shard_key if shard_key >= -1 else -1
+                tid = self._next_tid()
+                await self._request(
+                    osd, MOSDSubWrite(tid, pg, shard, oid,
+                                      [ShardOp("remove")],
+                                      self._epoch(), None, self.osd_id),
+                    tid)
+            if i_need:
+                t = Transaction()
+                cid = self._cid(pg, my_shard)
+                t.remove(cid, ObjectId(oid))
+                plog.missing.pop(oid, None)
+                plog.stage(t, cid)
+                try:
+                    self.store.queue_transaction(t)
+                except KeyError:
+                    pass
+            return
+
+        if pool.type == TYPE_REPLICATED:
+            src = next(iter(shards))
+            payload = {-1: shards[src]}
+            obj_attrs = attrs[src]
+        else:
+            codec = self._codec(pool.id)
+            sinfo = self._sinfo(pool.id)
+            data = ec_util.decode(sinfo, codec, dict(shards))
+            full = ec_util.encode(sinfo, codec, data,
+                                  range(codec.get_chunk_count()))
+            payload = full
+            obj_attrs = attrs[next(iter(shards))]
+
+        async def install(shard: int, osd: int) -> None:
+            buf = payload.get(shard if pool.type == TYPE_ERASURE else -1,
+                              b"")
+            ops = [ShardOp("create"), ShardOp("truncate", size=0),
+                   ShardOp("write", 0, buf)]
+            for name, value in obj_attrs.items():
+                ops.append(ShardOp("setattr", name=name, value=value))
+            if osd == self.osd_id:
+                t = Transaction()
+                cid = self._cid(pg, shard)
+                self._apply_shard_ops(t, cid, oid, ops)
+                plog.missing.pop(oid, None)
+                plog.stage(t, cid)
+                self.store.queue_transaction(t)
+            else:
+                tid = self._next_tid()
+                await self._request(
+                    osd, MOSDSubWrite(tid, pg, shard, oid, ops,
+                                      self._epoch(), None, self.osd_id),
+                    tid)
+
+        if i_need:
+            await install(my_shard, self.osd_id)
+        for shard_key, osd in targets:
+            await install(shard_key if shard_key >= -1 else -1, osd)
+            state.peer_missing.get(shard_key, {}).pop(oid, None)
+
+    # -- client op engine (primary) ----------------------------------------
+
+    async def _handle_client_op(self, conn: Connection,
+                                msg: MOSDOp) -> None:
+        if self.osdmap is None:
+            await conn.send(MOSDOpReply(msg.tid, EAGAIN))
+            return
+        pool = self.osdmap.pools.get(msg.pg.pool)
+        state = self.pgs.get(msg.pg)
+        acting, primary = self.osdmap.pg_to_acting_osds(msg.pg)
+        if pool is None or primary != self.osd_id or state is None:
+            await conn.send(MOSDOpReply(
+                msg.tid, EAGAIN, replay_epoch=self._epoch()))
+            return
+        if state.state != "active":
+            # queue until peering completes (waiting_for_active)
+            try:
+                await asyncio.wait_for(state.active_event.wait(), 10.0)
+            except asyncio.TimeoutError:
+                await conn.send(MOSDOpReply(
+                    msg.tid, EAGAIN, replay_epoch=self._epoch()))
+                return
+        try:
+            rc, data, out = await self._execute_ops(state, pool, msg)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("osd.%d: op %r failed", self.osd_id, msg)
+            rc, data, out = EIO, b"", {}
+        await conn.send(MOSDOpReply(msg.tid, rc, data, out,
+                                    replay_epoch=self._epoch()
+                                    if rc == EAGAIN else 0))
+
+    async def _execute_ops(self, state: PGState, pool, msg: MOSDOp
+                           ) -> Tuple[int, bytes, Dict[str, Any]]:
+        rc, data, out = 0, b"", {}
+        for op in msg.ops:
+            if op.op == "write_full":
+                rc = await self._op_write_full(state, pool, msg.oid,
+                                               op.data)
+            elif op.op == "write":
+                rc = await self._op_write(state, pool, msg.oid,
+                                          op.offset, op.data)
+            elif op.op == "read":
+                rc, data = await self._op_read(state, pool, msg.oid,
+                                               op.offset, op.length)
+            elif op.op == "stat":
+                rc, out = await self._op_stat(state, pool, msg.oid)
+            elif op.op == "remove":
+                rc = await self._op_remove(state, pool, msg.oid)
+            elif op.op == "pgls":
+                rc, out = self._op_pgls(state, pool)
+            else:
+                rc = -22
+            if rc < 0:
+                break
+        return rc, data, out
+
+    def _up_shard_targets(self, state: PGState, pool
+                          ) -> List[Tuple[int, int]]:
+        """[(shard, osd)] for up acting members; shard=-1 replicated."""
+        out = []
+        for idx, osd in enumerate(state.acting):
+            if osd == CRUSH_ITEM_NONE or not self.osdmap.is_up(osd):
+                continue
+            shard = idx if pool.type == TYPE_ERASURE else -1
+            out.append((shard, osd))
+        return out
+
+    def _min_size(self, pool) -> int:
+        if pool.type == TYPE_ERASURE:
+            codec = self._codec(pool.id)
+            return max(pool.min_size, codec.get_data_chunk_count())
+        return max(1, pool.min_size or 1)
+
+    async def _submit_shard_writes(
+            self, state: PGState, pool, oid: str,
+            shard_ops: Dict[int, List[ShardOp]],
+            entry: Optional[dict]) -> int:
+        """Fan out sub-writes to up shards (local applies directly);
+        all must ack (sub_write_committed discipline)."""
+        pg = state.pg
+        targets = self._up_shard_targets(state, pool)
+        if len(targets) < self._min_size(pool):
+            return EAGAIN
+        plog = self._load_log(state, pool)
+        pending = []
+        for shard, osd in targets:
+            ops = shard_ops.get(shard)
+            if ops is None:
+                continue
+            if osd == self.osd_id:
+                t = Transaction()
+                cid = self._cid(pg, shard)
+                self._apply_shard_ops(t, cid, oid, ops)
+                if entry is not None and \
+                        ev(entry["version"]) > plog.info.last_update:
+                    plog.append(entry)
+                    plog.trim_to(
+                        int(self.config["osd_min_pg_log_entries"]))
+                plog.missing.pop(oid, None)
+                plog.stage(t, cid)
+                self.store.queue_transaction(t)
+            else:
+                tid = self._next_tid()
+                pending.append(self._request(
+                    osd, MOSDSubWrite(tid, pg, shard, oid, ops,
+                                      self._epoch(), entry,
+                                      self.osd_id), tid))
+        replies = await asyncio.gather(*pending) if pending else []
+        # a shard that failed mid-write recovers via peering on the next
+        # interval (its pg log lags); the write succeeds if enough
+        # shards committed (min_size durability floor)
+        acked = 1 + sum(1 for r in replies
+                        if r is not None and r.rc == 0)
+        if acked < self._min_size(pool):
+            return EAGAIN
+        return 0
+
+    def _next_entry(self, state: PGState, pool, oid: str, op: str,
+                    size: int = 0) -> dict:
+        plog = self._load_log(state, pool)
+        prior = plog.info.last_update
+        version = (self._epoch(), state.next_version)
+        state.next_version += 1
+        return make_entry(version, prior, oid, op, size)
+
+    async def _op_write_full(self, state: PGState, pool, oid: str,
+                             data: bytes) -> int:
+        entry = self._next_entry(state, pool, oid, "modify", len(data))
+        oi = json.dumps({"size": len(data),
+                         "version": entry["version"]}).encode()
+        if pool.type == TYPE_REPLICATED:
+            ops = [ShardOp("create"), ShardOp("truncate", size=0),
+                   ShardOp("write", 0, data),
+                   ShardOp("setattr", name=OI_ATTR, value=oi)]
+            shard_ops = {-1: ops}
+        else:
+            codec = self._codec(pool.id)
+            sinfo = self._sinfo(pool.id)
+            width = sinfo.get_stripe_width()
+            padded = data + bytes(-len(data) % width)
+            shards = ec_util.encode(sinfo, codec, padded,
+                                    range(codec.get_chunk_count()))
+            hinfo = ec_util.HashInfo(codec.get_chunk_count())
+            hinfo.append(0, shards)
+            hinfo_raw = json.dumps(hinfo.to_dict()).encode()
+            shard_ops = {}
+            for shard in range(codec.get_chunk_count()):
+                buf = shards.get(shard, b"")
+                shard_ops[shard] = [
+                    ShardOp("create"), ShardOp("truncate", size=0),
+                    ShardOp("write", 0, buf),
+                    ShardOp("setattr", name=OI_ATTR, value=oi),
+                    ShardOp("setattr", name=HINFO_ATTR, value=hinfo_raw)]
+        return await self._submit_shard_writes(state, pool, oid,
+                                               shard_ops, entry)
+
+    async def _op_write(self, state: PGState, pool, oid: str,
+                        offset: int, data: bytes) -> int:
+        """Partial-extent write.  Replicated: direct range write.
+        EC: read-modify-write of the touched range (RMW pipeline)."""
+        if pool.type == TYPE_REPLICATED:
+            entry = self._next_entry(state, pool, oid, "modify")
+            rc, old_size = await self._stat_size(state, pool, oid)
+            new_size = max(old_size if rc == 0 else 0,
+                           offset + len(data))
+            oi = json.dumps({"size": new_size,
+                             "version": entry["version"]}).encode()
+            ops = [ShardOp("create"),
+                   ShardOp("write", offset, data),
+                   ShardOp("setattr", name=OI_ATTR, value=oi)]
+            return await self._submit_shard_writes(state, pool, oid,
+                                                   {-1: ops}, entry)
+        # EC RMW v0: full-object read, merge, re-encode (extent-cache
+        # batched stripe RMW lands with the dedicated RMW milestone)
+        rc, old = await self._op_read(state, pool, oid, 0, 0)
+        if rc == ENOENT:
+            old = b""
+        elif rc < 0:
+            return rc
+        new = bytearray(max(len(old), offset + len(data)))
+        new[:len(old)] = old
+        new[offset:offset + len(data)] = data
+        return await self._op_write_full(state, pool, oid, bytes(new))
+
+    async def _stat_size(self, state: PGState, pool, oid: str
+                         ) -> Tuple[int, int]:
+        rc, out = await self._op_stat(state, pool, oid)
+        return rc, out.get("size", 0)
+
+    async def _op_read(self, state: PGState, pool, oid: str,
+                       offset: int, length: int
+                       ) -> Tuple[int, bytes]:
+        shards, attrs = await self._gather_object_shards(state, pool, oid)
+        if not shards:
+            return ENOENT, b""
+        if pool.type == TYPE_REPLICATED:
+            shard = next(iter(shards))
+            oi = json.loads(attrs[shard].get(OI_ATTR, b"{}"))
+            data = shards[shard][:oi.get("size", len(shards[shard]))]
+            if length:
+                data = data[offset:offset + length]
+            elif offset:
+                data = data[offset:]
+            return 0, data
+        codec = self._codec(pool.id)
+        sinfo = self._sinfo(pool.id)
+        # verify hinfo crc per shard; drop corrupt shards (erasures)
+        good: Dict[int, bytes] = {}
+        size = None
+        for shard, buf in shards.items():
+            at = attrs.get(shard, {})
+            try:
+                oi = json.loads(at[OI_ATTR])
+                hi = ec_util.HashInfo.from_dict(
+                    json.loads(at[HINFO_ATTR]))
+            except (KeyError, ValueError):
+                continue
+            if hi.has_chunk_hash() and \
+                    cks.crc32c(0xFFFFFFFF, buf) != hi.get_chunk_hash(
+                        shard):
+                continue
+            good[shard] = buf
+            size = oi.get("size", size)
+        if size is None:
+            return EIO, b""
+        k = codec.get_data_chunk_count()
+        want = {codec.chunk_index(i) for i in range(k)}
+        try:
+            minimum = codec.minimum_to_decode(want, set(good))
+        except Exception:
+            return EIO, b""
+        data = ec_util.decode(sinfo, codec,
+                              {s: good[s] for s in minimum if s in good})
+        data = data[:size]
+        if length:
+            data = data[offset:offset + length]
+        elif offset:
+            data = data[offset:]
+        return 0, data
+
+    async def _op_stat(self, state: PGState, pool, oid: str
+                       ) -> Tuple[int, Dict[str, Any]]:
+        shards, attrs = await self._gather_object_shards(state, pool, oid)
+        for shard, at in attrs.items():
+            if OI_ATTR in at:
+                oi = json.loads(at[OI_ATTR])
+                return 0, {"size": oi.get("size", 0),
+                           "version": oi.get("version")}
+        return ENOENT, {}
+
+    async def _op_remove(self, state: PGState, pool, oid: str) -> int:
+        rc, _ = await self._op_stat(state, pool, oid)
+        if rc == ENOENT:
+            return ENOENT
+        entry = self._next_entry(state, pool, oid, "delete")
+        ops = [ShardOp("remove")]
+        if pool.type == TYPE_REPLICATED:
+            shard_ops = {-1: list(ops)}
+        else:
+            codec = self._codec(pool.id)
+            shard_ops = {s: list(ops)
+                         for s in range(codec.get_chunk_count())}
+        return await self._submit_shard_writes(state, pool, oid,
+                                               shard_ops, entry)
+
+    def _op_pgls(self, state: PGState, pool
+                 ) -> Tuple[int, Dict[str, Any]]:
+        shard = state.my_shard(self.osd_id, pool.type)
+        cid = self._cid(state.pg, shard)
+        try:
+            names = [str(o) for o in self.store.list_objects(cid)
+                     if str(o) != PGMETA_OID]
+        except KeyError:
+            names = []
+        return 0, {"objects": sorted(names)}

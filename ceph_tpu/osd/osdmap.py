@@ -131,7 +131,9 @@ class PgPool:
     # -- encoding ----------------------------------------------------------
 
     def encode(self, enc: Encoder) -> None:
-        enc.start(2, 1)  # v2: opts values JSON-typed
+        # v2 changes the meaning of opts values (str -> JSON), so compat
+        # is 2 as well: a v1-only decoder must reject, not misread
+        enc.start(2, 2)
         enc.s64(self.id)
         enc.string(self.name)
         enc.u8(self.type)

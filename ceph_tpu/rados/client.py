@@ -1,0 +1,300 @@
+"""Networked RADOS client (Objecter + librados roles).
+
+Reference parity: Objecter (/root/reference/src/osdc/Objecter.cc) —
+placement computed client-side with the same CRUSH/OSDMap math the OSDs
+use (`_calc_target` Objecter.cc:2692), ops tagged with the client's map
+epoch and resent when the map changes or the primary bounces them
+(EAGAIN / replay_epoch), lossy connections simply re-established —
+and librados::IoCtx (librados_cxx.cc:1247) as the user-facing surface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+from ceph_tpu.msg import Connection, Messenger
+from ceph_tpu.msg.messages import (
+    MGetMap,
+    MMonCommand,
+    MMonCommandReply,
+    MOSDMapMsg,
+    MOSDOp,
+    MOSDOpReply,
+    Message,
+    OSDOp,
+)
+from ceph_tpu.ops.rjenkins import ceph_str_hash_rjenkins
+from ceph_tpu.osd.osdmap import OSDMap, PgId
+
+log = logging.getLogger("rados")
+
+EAGAIN = -11
+ENOENT = -2
+ESTALE = -116
+
+
+class RadosError(Exception):
+    def __init__(self, rc: int, what: str = ""):
+        super().__init__(f"rc={rc} {what}")
+        self.rc = rc
+
+
+class ObjectNotFound(RadosError):
+    pass
+
+
+class RadosClient:
+    def __init__(self, mon_addr: str, name: str = "client.0",
+                 op_timeout: float = 10.0, max_retries: int = 30):
+        self.mon_addr = mon_addr
+        self.msgr = Messenger(name)
+        self.msgr.dispatcher = self._dispatch
+        self.osdmap: Optional[OSDMap] = None
+        self.op_timeout = op_timeout
+        self.max_retries = max_retries
+        self._tid = 0
+        self._futures: Dict[int, asyncio.Future] = {}
+        self._map_waiters: List[asyncio.Event] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def connect(self) -> None:
+        await self.msgr.bind()
+        mon = await self.msgr.connect(self.mon_addr)
+        await mon.send(MGetMap(subscribe=True))
+        for _ in range(500):
+            if self.osdmap is not None:
+                return
+            await asyncio.sleep(0.01)
+        raise TimeoutError("no osdmap from mon")
+
+    async def shutdown(self) -> None:
+        await self.msgr.shutdown()
+
+    # -- dispatch ----------------------------------------------------------
+
+    async def _dispatch(self, conn: Connection, msg: Message) -> None:
+        if isinstance(msg, MOSDMapMsg):
+            if msg.full_map is not None:
+                newmap = OSDMap.decode(msg.full_map)
+                if self.osdmap is None or \
+                        newmap.epoch > self.osdmap.epoch:
+                    self.osdmap = newmap
+                    for event in self._map_waiters:
+                        event.set()
+                    self._map_waiters.clear()
+        elif isinstance(msg, (MOSDOpReply, MMonCommandReply)):
+            fut = self._futures.pop(msg.tid, None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+
+    def _next_tid(self) -> int:
+        self._tid += 1
+        return self._tid
+
+    async def wait_for_new_map(self, timeout: float = 5.0) -> None:
+        event = asyncio.Event()
+        self._map_waiters.append(event)
+        try:
+            await asyncio.wait_for(event.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+
+    async def refresh_map(self) -> None:
+        mon = await self.msgr.connect(self.mon_addr)
+        await mon.send(MGetMap(subscribe=True))
+        await self.wait_for_new_map(1.0)
+
+    # -- mon commands ------------------------------------------------------
+
+    async def mon_command(self, cmd: Dict[str, Any]
+                          ) -> Tuple[int, Dict[str, Any]]:
+        tid = self._next_tid()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._futures[tid] = fut
+        mon = await self.msgr.connect(self.mon_addr)
+        await mon.send(MMonCommand(tid, cmd))
+        try:
+            reply = await asyncio.wait_for(fut, self.op_timeout)
+        finally:
+            self._futures.pop(tid, None)
+        return reply.rc, reply.out
+
+    async def create_replicated_pool(self, name: str, size: int = 3,
+                                     pg_num: int = 32) -> int:
+        rc, out = await self.mon_command({
+            "prefix": "osd pool create", "name": name,
+            "pool_type": "replicated", "size": size, "pg_num": pg_num})
+        if rc != 0:
+            raise RadosError(rc, str(out))
+        await self._wait_for_pool(name)
+        return out["pool_id"]
+
+    async def create_ec_pool(self, name: str, profile: Dict[str, str],
+                             pg_num: int = 32,
+                             profile_name: str = "") -> int:
+        profile_name = profile_name or f"{name}_profile"
+        rc, out = await self.mon_command({
+            "prefix": "osd erasure-code-profile set",
+            "name": profile_name, "profile": profile})
+        if rc != 0:
+            raise RadosError(rc, str(out))
+        rc, out = await self.mon_command({
+            "prefix": "osd pool create", "name": name,
+            "pool_type": "erasure", "erasure_code_profile": profile_name,
+            "pg_num": pg_num})
+        if rc != 0:
+            raise RadosError(rc, str(out))
+        await self._wait_for_pool(name)
+        return out["pool_id"]
+
+    async def _wait_for_pool(self, name: str) -> None:
+        for _ in range(500):
+            if self.osdmap is not None and \
+                    self.osdmap.lookup_pool(name) >= 0:
+                return
+            await asyncio.sleep(0.01)
+        raise TimeoutError(f"pool {name!r} never appeared in the map")
+
+    def open_ioctx(self, pool_name: str) -> "IoCtx":
+        pool_id = self.osdmap.lookup_pool(pool_name)
+        if pool_id < 0:
+            raise KeyError(f"no pool {pool_name!r}")
+        return IoCtx(self, pool_id)
+
+
+class IoCtx:
+    """librados::IoCtx over the wire."""
+
+    def __init__(self, client: RadosClient, pool_id: int):
+        self.client = client
+        self.pool_id = pool_id
+
+    @property
+    def pool(self):
+        return self.client.osdmap.pools[self.pool_id]
+
+    def object_pg(self, name: str) -> PgId:
+        ps = ceph_str_hash_rjenkins(name.encode())
+        return self.pool.raw_pg_to_pg(PgId(self.pool_id, ps))
+
+    # -- op submission (Objecter::_op_submit + resend discipline) ----------
+
+    async def _submit(self, oid: str, ops: List[OSDOp]) -> MOSDOpReply:
+        client = self.client
+        pg = self.object_pg(oid)
+        last_error: Optional[Exception] = None
+        for attempt in range(client.max_retries):
+            osdmap = client.osdmap
+            _acting, primary = osdmap.pg_to_acting_osds(pg)
+            addr = osdmap.osd_addrs.get(primary, None) \
+                if primary >= 0 else None
+            if addr is None or not osdmap.is_up(primary):
+                await client.wait_for_new_map(1.0)
+                continue
+            tid = client._next_tid()
+            fut: asyncio.Future = \
+                asyncio.get_running_loop().create_future()
+            client._futures[tid] = fut
+            try:
+                await client.msgr.send_to(
+                    addr, MOSDOp(tid, client.msgr.entity_name, pg, oid,
+                                 ops, osdmap.epoch))
+                reply = await asyncio.wait_for(fut, client.op_timeout)
+            except (ConnectionError, OSError) as e:
+                last_error = e
+                client._futures.pop(tid, None)
+                await client.refresh_map()
+                continue
+            except asyncio.TimeoutError as e:
+                last_error = e
+                client._futures.pop(tid, None)
+                await client.refresh_map()
+                continue
+            if reply.rc == EAGAIN:
+                # wrong/new primary or pg not active: wait for progress
+                await client.wait_for_new_map(0.5)
+                continue
+            return reply
+        raise RadosError(EAGAIN, f"op on {oid!r} exhausted retries"
+                                 f" ({last_error!r})")
+
+    # -- public API --------------------------------------------------------
+
+    async def write_full(self, oid: str, data: bytes) -> None:
+        reply = await self._submit(oid, [OSDOp("write_full", data=data)])
+        if reply.rc != 0:
+            raise RadosError(reply.rc, f"write_full {oid!r}")
+
+    async def write(self, oid: str, data: bytes, offset: int) -> None:
+        reply = await self._submit(
+            oid, [OSDOp("write", offset=offset, data=data)])
+        if reply.rc != 0:
+            raise RadosError(reply.rc, f"write {oid!r}@{offset}")
+
+    async def read(self, oid: str, offset: int = 0,
+                   length: int = 0) -> bytes:
+        reply = await self._submit(
+            oid, [OSDOp("read", offset=offset, length=length)])
+        if reply.rc == ENOENT:
+            raise ObjectNotFound(reply.rc, oid)
+        if reply.rc != 0:
+            raise RadosError(reply.rc, f"read {oid!r}")
+        return reply.data
+
+    async def stat(self, oid: str) -> Dict[str, Any]:
+        reply = await self._submit(oid, [OSDOp("stat")])
+        if reply.rc == ENOENT:
+            raise ObjectNotFound(reply.rc, oid)
+        if reply.rc != 0:
+            raise RadosError(reply.rc, f"stat {oid!r}")
+        return reply.out
+
+    async def remove(self, oid: str) -> None:
+        reply = await self._submit(oid, [OSDOp("remove")])
+        if reply.rc == ENOENT:
+            raise ObjectNotFound(reply.rc, oid)
+        if reply.rc != 0:
+            raise RadosError(reply.rc, f"remove {oid!r}")
+
+    async def list_objects(self) -> List[str]:
+        """pgls across every PG of the pool (ListObjects role)."""
+        names: set = set()
+        seen_pgs: set = set()
+        for ps in range(self.pool.pg_num):
+            pg = self.pool.raw_pg_to_pg(PgId(self.pool_id, ps))
+            if pg in seen_pgs:
+                continue
+            seen_pgs.add(pg)
+            client = self.client
+            for attempt in range(client.max_retries):
+                osdmap = client.osdmap
+                _a, primary = osdmap.pg_to_acting_osds(pg)
+                addr = osdmap.osd_addrs.get(primary) \
+                    if primary >= 0 and osdmap.is_up(primary) else None
+                if addr is None:
+                    await client.wait_for_new_map(1.0)
+                    continue
+                tid = client._next_tid()
+                fut: asyncio.Future = \
+                    asyncio.get_running_loop().create_future()
+                client._futures[tid] = fut
+                try:
+                    await client.msgr.send_to(
+                        addr, MOSDOp(tid, client.msgr.entity_name, pg,
+                                     "", [OSDOp("pgls")], osdmap.epoch))
+                    reply = await asyncio.wait_for(fut,
+                                                   client.op_timeout)
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    client._futures.pop(tid, None)
+                    await client.refresh_map()
+                    continue
+                if reply.rc == EAGAIN:
+                    await client.wait_for_new_map(0.5)
+                    continue
+                if reply.rc == 0:
+                    names.update(reply.out.get("objects", []))
+                break
+        return sorted(names)

@@ -1,0 +1,132 @@
+"""In-loop cluster harness (the qa/standalone/ceph-helpers.sh role).
+
+Spins a mini-mon + N OSD daemons on loopback inside one asyncio loop —
+all "nodes" are endpoints on 127.0.0.1, exactly like ceph-helpers runs
+real daemons on one host (SURVEY.md §4.2).  kill_osd drops a daemon off
+the network without clean shutdown (its store survives, like a crashed
+process with an intact disk); revive_osd boots a fresh daemon on the
+surviving store.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional
+
+from ceph_tpu.mon import MonDaemon
+from ceph_tpu.os.memstore import MemStore
+from ceph_tpu.osd.daemon import OSDDaemon
+from ceph_tpu.rados.client import RadosClient
+
+FAST_CONFIG = {
+    # tight timings so failure-detection tests run in seconds
+    "osd_heartbeat_interval": 0.2,
+    "osd_heartbeat_grace": 0.8,
+    "osd_sub_op_timeout": 2.0,
+}
+FAST_MON_CONFIG = {
+    "mon_osd_min_down_reporters": 1,
+    "osd_heartbeat_grace": 0.8,
+}
+
+
+class Cluster:
+    def __init__(self, num_osds: int = 4, osds_per_host: int = 2,
+                 osd_config: Optional[dict] = None,
+                 mon_config: Optional[dict] = None,
+                 store_factory=None):
+        self.num_osds = num_osds
+        self.osds_per_host = osds_per_host
+        self.osd_config = dict(FAST_CONFIG)
+        self.osd_config.update(osd_config or {})
+        self.mon_config = dict(FAST_MON_CONFIG)
+        self.mon_config.update(mon_config or {})
+        self.store_factory = store_factory or (lambda osd_id: MemStore())
+        self.mon: Optional[MonDaemon] = None
+        self.osds: Dict[int, OSDDaemon] = {}
+        self.stores: Dict[int, object] = {}
+        self.client: Optional[RadosClient] = None
+
+    async def start(self) -> None:
+        self.mon = MonDaemon(self.num_osds,
+                             osds_per_host=self.osds_per_host,
+                             config=self.mon_config)
+        await self.mon.start()
+        for osd_id in range(self.num_osds):
+            store = self.store_factory(osd_id)
+            store.mkfs()
+            store.mount()
+            self.stores[osd_id] = store
+            await self._boot_osd(osd_id)
+        self.client = RadosClient(self.mon.addr)
+        await self.client.connect()
+
+    async def _boot_osd(self, osd_id: int) -> None:
+        osd = OSDDaemon(osd_id, self.mon.addr,
+                        store=self.stores[osd_id],
+                        config=self.osd_config)
+        self.osds[osd_id] = osd
+        await osd.start()
+
+    async def stop(self) -> None:
+        if self.client is not None:
+            await self.client.shutdown()
+        for osd in self.osds.values():
+            await osd.stop()
+        for store in self.stores.values():
+            try:
+                store.umount()
+            except Exception:
+                pass
+        if self.mon is not None:
+            await self.mon.shutdown()
+
+    # -- failure injection (thrashosds kill_osd/revive_osd role) -----------
+
+    async def kill_osd(self, osd_id: int) -> None:
+        await self.osds[osd_id].kill()
+        del self.osds[osd_id]
+
+    async def revive_osd(self, osd_id: int) -> None:
+        assert osd_id not in self.osds
+        await self._boot_osd(osd_id)
+
+    async def wait_for_osd_down(self, osd_id: int,
+                                timeout: float = 15.0) -> None:
+        await self._wait(lambda: self.mon.osdmap.is_down(osd_id),
+                         timeout, f"osd.{osd_id} never marked down")
+
+    async def wait_for_osd_up(self, osd_id: int,
+                              timeout: float = 15.0) -> None:
+        await self._wait(lambda: self.mon.osdmap.is_up(osd_id),
+                         timeout, f"osd.{osd_id} never marked up")
+
+    async def wait_for_clean(self, timeout: float = 30.0) -> None:
+        """All PGs of all pools active on their primaries
+        (wait_for_clean role)."""
+        def _clean() -> bool:
+            epoch = self.mon.osdmap.epoch
+            for osd in self.osds.values():
+                if osd.osdmap is None or osd.osdmap.epoch < epoch:
+                    return False
+            for pool in self.mon.osdmap.pools.values():
+                from ceph_tpu.osd.osdmap import PgId
+
+                for ps in range(pool.pg_num):
+                    pg = PgId(pool.id, ps)
+                    _a, primary = self.mon.osdmap.pg_to_acting_osds(pg)
+                    if primary < 0 or primary not in self.osds:
+                        return False
+                    state = self.osds[primary].pgs.get(pg)
+                    if state is None or state.state != "active":
+                        return False
+            return True
+
+        await self._wait(_clean, timeout, "cluster never went clean")
+
+    async def _wait(self, cond, timeout: float, what: str) -> None:
+        for _ in range(int(timeout / 0.05)):
+            if cond():
+                return
+            await asyncio.sleep(0.05)
+        raise TimeoutError(what)
