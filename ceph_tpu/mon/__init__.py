@@ -92,6 +92,7 @@ class MonDaemon:
         self._laggy_probability: Dict[int, float] = {}
         self._laggy_interval: Dict[int, float] = {}
         self._down_at: Dict[int, float] = {}
+        self._up_from: Dict[int, int] = {}  # boot epoch per osd
         self._check_task: Optional[asyncio.Task] = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -180,12 +181,17 @@ class MonDaemon:
         if not self.osdmap.is_in(osd):
             inc.new_weight[osd] = CEPH_OSD_IN
         self._commit(inc)
+        self._up_from[osd] = self.osdmap.epoch
         log.info("mon: osd.%d booted at %s (epoch %d)", osd, msg.addr,
                  self.osdmap.epoch)
 
     def _handle_failure(self, msg: MOSDFailure) -> None:
         target = msg.target_osd
         if not self.osdmap.is_up(target):
+            return
+        # a report from before the target's current boot is about a
+        # previous incarnation (OSDMonitor::prepare_failure epoch check)
+        if msg.epoch < self._up_from.get(target, 0):
             return
         reports = self._failure_reports.setdefault(target, {})
         now = time.monotonic()

@@ -1,0 +1,39 @@
+"""Run a mini-mon as a real process: python -m ceph_tpu.mon
+
+Prints `MON_ADDR <host:port>` on stdout once bound (the ceph-helpers
+run_mon contract: callers parse the address to wire up OSDs/clients).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from ceph_tpu.mon import MonDaemon
+
+
+async def _main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-osds", type=int, required=True)
+    ap.add_argument("--osds-per-host", type=int, default=2)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--config", type=str, default="{}",
+                    help="JSON mon config overrides")
+    args = ap.parse_args()
+    mon = MonDaemon(args.num_osds, osds_per_host=args.osds_per_host,
+                    config=json.loads(args.config))
+    addr = await mon.start(port=args.port)
+    print(f"MON_ADDR {addr}", flush=True)
+    try:
+        await asyncio.Event().wait()  # serve until killed
+    finally:
+        await mon.shutdown()
+
+
+if __name__ == "__main__":
+    try:
+        asyncio.run(_main())
+    except KeyboardInterrupt:
+        sys.exit(0)

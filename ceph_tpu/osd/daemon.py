@@ -85,6 +85,12 @@ DEFAULTS = {
     "osd_pool_erasure_code_stripe_unit": 4096,
 }
 
+# rollback-generation shard object (ECBackend keeps the previous shard
+# generation until a write commits everywhere, so a partial overwrite
+# can never destroy the last completed write's reconstructability —
+# the ghobject generation / rollback machinery of ECTransaction)
+RB_PREFIX = "_rbgen_"
+
 
 class PGState:
     """In-memory PG bookkeeping (PG + PeeringState role)."""
@@ -100,6 +106,9 @@ class PGState:
         self.peer_missing: Dict[int, Dict[str, tuple]] = {}
         self.active_event = asyncio.Event()
         self.peering_task: Optional[asyncio.Task] = None
+        # objects recovery could not reconstruct yet (pg_missing with no
+        # found location); re-peered when the up set changes
+        self.unfound = False
 
     def my_shard(self, osd: int, pool_type: int) -> int:
         if pool_type == TYPE_REPLICATED:
@@ -254,6 +263,15 @@ class OSDDaemon:
         newmap = OSDMap.decode(msg.full_map)
         if self.osdmap is not None and newmap.epoch <= self.osdmap.epoch:
             return
+        # reset the heartbeat clock for peers that just came (back) up:
+        # their last_rx predates the outage and would otherwise make us
+        # insta-report the freshly booted peer as failed again
+        # (maybe_update_heartbeat_peers role, OSD.cc)
+        now = time.monotonic()
+        prev = self.osdmap
+        for osd in newmap.get_up_osds():
+            if prev is None or not prev.is_up(osd):
+                self._hb_last_rx[osd] = now
         self.osdmap = newmap
         self._map_event.set()
         self._map_event = asyncio.Event()
@@ -292,9 +310,13 @@ class OSDDaemon:
                     if state.peering_task is not None:
                         state.peering_task.cancel()
                         state.peering_task = None
-                if primary == self.osd_id and state.state == "inactive" \
-                        and state.peering_task is None:
+                if primary == self.osd_id and state.peering_task is None \
+                        and (state.state == "inactive" or
+                             (state.state == "active" and state.unfound)):
+                    # an unfound-carrying PG re-peers on ANY map change:
+                    # a revived stray may now hold the needed shards
                     state.state = "peering"
+                    state.active_event.clear()
                     state.peering_task = \
                         asyncio.get_running_loop().create_task(
                             self._peer_pg(state, pool))
@@ -356,10 +378,21 @@ class OSDDaemon:
         return state.log
 
     def _apply_shard_ops(self, t: Transaction, cid: str, oid: str,
-                         ops: List[ShardOp]) -> None:
+                         ops: List[ShardOp],
+                         save_rollback: bool = False) -> None:
         obj = ObjectId(oid)
         if not self.store.collection_exists(cid):
             t.create_collection(cid)
+        if save_rollback:
+            # preserve the current generation before overwriting: until
+            # this write commits on every shard, the previous version
+            # must stay reconstructable
+            try:
+                self.store.stat(cid, obj)
+            except (KeyError, IOError):
+                pass
+            else:
+                t.clone(cid, obj, ObjectId(RB_PREFIX + oid))
         for op in ops:
             if op.op == "create":
                 t.touch(cid, obj)
@@ -397,11 +430,15 @@ class OSDDaemon:
         if state is not None and msg.epoch < state.interval_epoch:
             await conn.send(MOSDSubWriteReply(msg.tid, ESTALE, msg.shard))
             return
+        if state is not None:
+            # a newer-interval primary's write also fences older ones
+            state.interval_epoch = max(state.interval_epoch, msg.epoch)
         pool = self.osdmap.pools.get(msg.pg.pool) if self.osdmap else None
         cid = self._cid(msg.pg, msg.shard)
         t = Transaction()
         try:
-            self._apply_shard_ops(t, cid, msg.oid, msg.ops)
+            self._apply_shard_ops(t, cid, msg.oid, msg.ops,
+                                  save_rollback=msg.log_entry is not None)
             if state is None:
                 state = self.pgs.setdefault(msg.pg, PGState(msg.pg))
             if pool is not None:
@@ -432,7 +469,11 @@ class OSDDaemon:
         pool = self.osdmap.pools.get(msg.pg.pool) if self.osdmap else None
         if state is not None and pool is not None:
             plog = self._load_log(state, pool)
-            if msg.oid in plog.missing:
+            # the missing guard protects my CURRENT shard only; stray
+            # reads of prior-interval shard collections are always fair
+            # game (they serve the MissingLoc search)
+            if msg.shard == state.my_shard(self.osd_id, pool.type) and \
+                    msg.oid in plog.missing:
                 await conn.send(MOSDSubReadReply(
                     msg.tid, ENOENT, shard=msg.shard))
                 return
@@ -449,6 +490,11 @@ class OSDDaemon:
                                msg: MPGQuery) -> None:
         pool = self.osdmap.pools.get(msg.pg.pool) if self.osdmap else None
         state = self.pgs.setdefault(msg.pg, PGState(msg.pg))
+        # answering a peering query is a BARRIER: once we reply, no
+        # older-interval primary may commit further writes here, or the
+        # new interval could roll back an acked write (the PeeringState
+        # Reset discipline — the reply's content must stay authoritative)
+        state.interval_epoch = max(state.interval_epoch, msg.epoch)
         if pool is not None:
             plog = self._load_log(state, pool)
         else:
@@ -456,10 +502,21 @@ class OSDDaemon:
         info = plog.info.to_dict()
         info["missing"] = {k: list(v) for k, v in plog.missing.items()}
         shard = state.my_shard(self.osd_id, pool.type) if pool else -1
+        # shard object listing rides along so the primary can build
+        # backfill sets for peers too far behind the log tail
+        info["objects"] = self._list_shard_objects(msg.pg, shard)
         await conn.send(MPGLogMsg(msg.tid, msg.pg, shard, info,
                                   list(plog.entries),
                                   epoch=self._epoch(),
                                   from_osd=self.osd_id, is_reply=True))
+
+    def _list_shard_objects(self, pg: PgId, shard: int) -> List[str]:
+        cid = self._cid(pg, shard)
+        try:
+            return sorted(str(o) for o in self.store.list_objects(cid)
+                          if str(o) != PGMETA_OID)
+        except KeyError:
+            return []
 
     async def _handle_pg_log_push(self, conn: Connection,
                                   msg: MPGLogMsg) -> None:
@@ -471,6 +528,7 @@ class OSDDaemon:
         state = self.pgs.setdefault(msg.pg, PGState(msg.pg))
         if pool is None:
             return
+        state.interval_epoch = max(state.interval_epoch, msg.epoch)
         plog = self._load_log(state, pool)
         auth_info = PGInfo.from_dict(msg.info)
         missing = plog.merge(auth_info, msg.entries)
@@ -497,10 +555,11 @@ class OSDDaemon:
         try:
             my_shard = state.my_shard(self.osd_id, pool.type)
             plog = self._load_log(state, pool)
-            # 1. collect infos+logs from up acting shards
-            peers: Dict[int, Tuple[Any, List[dict], Dict[str, tuple]]] = {}
+            # 1. collect infos+logs(+object listings) from up shards
+            peers: Dict[int, tuple] = {}
             peers[my_shard] = (plog.info, list(plog.entries),
-                              dict(plog.missing))
+                               dict(plog.missing),
+                               self._list_shard_objects(pg, my_shard))
             peer_shards: Dict[int, int] = {}  # shard -> osd
             for idx, osd in enumerate(state.acting):
                 shard = idx if pool.type == TYPE_ERASURE else -1
@@ -522,15 +581,19 @@ class OSDDaemon:
                 info = PGInfo.from_dict(reply.info)
                 peer_missing = {k: ev(v) for k, v in
                                 reply.info.get("missing", {}).items()}
-                peers[shard_key] = (info, reply.entries, peer_missing)
+                peers[shard_key] = (info, reply.entries, peer_missing,
+                                    reply.info.get("objects", []))
                 peer_shards[shard_key] = osd
+            # pre-merge heads: needed for the backfill decision below
+            pre_lu = {k: v[0].last_update for k, v in peers.items()}
             # 2. elect authoritative log (max last_update, then longest)
             auth_key = max(
                 peers,
                 key=lambda s: (peers[s][0].last_update,
                                len(peers[s][1]),
                                s == my_shard))
-            auth_info, auth_entries, _ = peers[auth_key]
+            auth_info, auth_entries = peers[auth_key][0], \
+                peers[auth_key][1]
             # 3. adopt locally if I'm not authoritative
             if auth_key != my_shard:
                 my_missing = plog.merge(auth_info, auth_entries)
@@ -558,9 +621,27 @@ class OSDDaemon:
                 state.peer_missing[shard_key] = {
                     k: ev(v)
                     for k, v in reply.info.get("missing", {}).items()}
+            # 4b. backfill: a shard whose pre-merge head predates the
+            # auth log tail cannot be caught up by log replay — every
+            # object in the auth shard's listing is potentially stale
+            # (the scan-based backfill of PeeringState)
+            tail = plog.info.log_tail
+            if tail > ZERO:
+                auth_objects = peers[auth_key][3]
+                if auth_key != my_shard and pre_lu[my_shard] < tail:
+                    for obj in auth_objects:
+                        plog.missing.setdefault(obj, ZERO)
+                for shard_key in peer_shards:
+                    if pre_lu.get(shard_key, ZERO) < tail:
+                        pm = state.peer_missing.setdefault(shard_key, {})
+                        for obj in auth_objects:
+                            pm.setdefault(obj, ZERO)
             # 5. recovery: self first, then peers
             await self._recover_pg(state, pool, peer_shards)
-            # 6. activate
+            # 6. activate (possibly with unfound objects: reads of those
+            # fail until a map change brings a shard source back)
+            state.unfound = bool(plog.missing) or \
+                any(bool(m) for m in state.peer_missing.values())
             state.next_version = plog.info.last_update[1] + 1
             plog.info.same_interval_since = state.interval_epoch
             plog.info.last_epoch_started = self._epoch()
@@ -571,44 +652,146 @@ class OSDDaemon:
         except Exception:
             log.exception("osd.%d: peering %s failed", self.osd_id, pg)
             state.state = "inactive"
+            # retry: peering must not park the PG forever on a transient
+            # failure (a peer bouncing mid-query)
+            if not self._stopping:
+                asyncio.get_running_loop().create_task(
+                    self._retry_peering(state))
         finally:
             state.peering_task = None
 
+    async def _retry_peering(self, state: PGState) -> None:
+        await asyncio.sleep(0.5)
+        if self._stopping or state.state != "inactive" or \
+                state.peering_task is not None or self.osdmap is None:
+            return
+        pool = self.osdmap.pools.get(state.pg.pool)
+        if pool is None or state.primary != self.osd_id:
+            return
+        state.state = "peering"
+        state.peering_task = asyncio.get_running_loop().create_task(
+            self._peer_pg(state, pool))
+
     # -- recovery ----------------------------------------------------------
+
+    async def _read_candidates(
+            self, pg: PgId, shard: int, osd: int, oid: str,
+            include_rollback: bool
+    ) -> List[Tuple[int, bytes, Dict[str, bytes]]]:
+        """Read one (shard, osd)'s main object — and, when asked, its
+        rollback generation — as selection candidates."""
+        names = [oid]
+        if include_rollback:
+            names.append(RB_PREFIX + oid)
+        out: List[Tuple[int, bytes, Dict[str, bytes]]] = []
+        for name in names:
+            if osd == self.osd_id:
+                rc, data, at = self._read_shard(pg, shard, name)
+                if rc == 0:
+                    out.append((shard, data, at))
+                continue
+            tid = self._next_tid()
+            reply = await self._request(
+                osd, MOSDSubRead(tid, pg, shard, name), tid)
+            if reply is not None and reply.rc == 0:
+                out.append((shard, reply.data, reply.attrs))
+        return out
 
     async def _gather_object_shards(
             self, state: PGState, pool, oid: str,
-            exclude_missing: bool = True
-    ) -> Tuple[Dict[int, bytes], Dict[int, Dict[str, bytes]]]:
-        """Collect available shard payloads+attrs for an object from up
-        acting shards (local read for mine, sub-reads for peers)."""
+            exclude_missing: bool = True,
+            include_rollback: bool = False
+    ) -> List[Tuple[int, bytes, Dict[str, bytes]]]:
+        """Collect available (shard, payload, attrs) candidates for an
+        object from up acting shards (local read for mine, sub-reads for
+        peers).  include_rollback adds each shard's preserved previous
+        generation to the candidate pool."""
         pg = state.pg
-        shards: Dict[int, bytes] = {}
-        attrs: Dict[int, Dict[str, bytes]] = {}
-        my_shard = state.my_shard(self.osd_id, pool.type)
+        candidates: List[Tuple[int, bytes, Dict[str, bytes]]] = []
         plog = self._load_log(state, pool)
         for idx, osd in enumerate(state.acting):
             shard = idx if pool.type == TYPE_ERASURE else -1
             if osd == CRUSH_ITEM_NONE or not self.osdmap.is_up(osd):
                 continue
-            if osd == self.osd_id:
-                if exclude_missing and oid in plog.missing:
-                    continue
-                rc, data, at = self._read_shard(pg, shard, oid)
-                if rc == 0:
-                    shards[shard], attrs[shard] = data, at
-                if pool.type == TYPE_REPLICATED:
-                    if rc == 0:
-                        break
+            if osd == self.osd_id and exclude_missing and \
+                    oid in plog.missing:
                 continue
-            tid = self._next_tid()
-            reply = await self._request(
-                osd, MOSDSubRead(tid, pg, shard, oid), tid)
-            if reply is not None and reply.rc == 0:
-                shards[shard], attrs[shard] = reply.data, reply.attrs
-                if pool.type == TYPE_REPLICATED:
-                    break
-        return shards, attrs
+            candidates += await self._read_candidates(
+                pg, shard, osd, oid, include_rollback)
+        return candidates
+
+    async def _gather_stray_shards(
+            self, state: PGState, pool, oid: str,
+            have: Set[Tuple[int, int]]
+    ) -> List[Tuple[int, bytes, Dict[str, bytes]]]:
+        """Search shards OUTSIDE the acting mapping: prior-interval
+        members may hold the only up-to-date copies after several
+        remaps (the MissingLoc / might_have_unfound role,
+        /root/reference/src/osd/MissingLoc.h).  Queries every up OSD for
+        every shard collection of this pg not already in `have`
+        ((shard, osd) pairs)."""
+        pg = state.pg
+        if pool.type == TYPE_ERASURE:
+            shard_list = list(
+                range(self._codec(pool.id).get_chunk_count()))
+        else:
+            shard_list = [-1]
+        candidates: List[Tuple[int, bytes, Dict[str, bytes]]] = []
+        for osd in self.osdmap.get_up_osds():
+            for shard in shard_list:
+                if (shard, osd) in have:
+                    continue
+                candidates += await self._read_candidates(
+                    pg, shard, osd, oid, include_rollback=True)
+        return candidates
+
+    @staticmethod
+    def _oi_version(at: Dict[str, bytes]) -> Optional[tuple]:
+        try:
+            oi = json.loads(at[OI_ATTR])
+            version = oi.get("version")
+            return ev(version) if version else ZERO
+        except (KeyError, ValueError):
+            return None
+
+    def _select_consistent(
+            self, candidates: List[Tuple[int, bytes, Dict[str, bytes]]],
+            need: int, verify_hinfo: bool = False
+    ) -> Tuple[Optional[tuple], Dict[int, bytes], Optional[dict]]:
+        """Newest object version reconstructible from >= need distinct
+        shards.
+
+        Mixing shard generations corrupts EC decode and lets stale data
+        win reads, so every multi-shard consumer picks ONE version: the
+        newest one enough shards agree on.  An unacked write that
+        reached < need shards is thereby rolled back to the last
+        completed write (the role of ECBackend's rollback-aware log).
+        Returns (version, {shard: payload}, object_info) or
+        (None, {}, None).
+        """
+        groups: Dict[tuple, Dict[int, bytes]] = {}
+        ois: Dict[tuple, dict] = {}
+        for shard, payload, at in candidates:
+            version = self._oi_version(at)
+            if version is None:
+                continue
+            if verify_hinfo:
+                try:
+                    hi = ec_util.HashInfo.from_dict(
+                        json.loads(at[HINFO_ATTR]))
+                except (KeyError, ValueError):
+                    continue
+                if hi.has_chunk_hash() and cks.crc32c(
+                        0xFFFFFFFF, payload) != \
+                        hi.get_chunk_hash(shard):
+                    continue  # corrupt shard: erasure
+            groups.setdefault(version, {}).setdefault(shard, payload)
+            ois.setdefault(version, json.loads(at[OI_ATTR]))
+        for version in sorted(groups, reverse=True):
+            members = groups[version]
+            if len(members) >= need:
+                return version, members, ois[version]
+        return None, {}, None
 
     async def _recover_pg(self, state: PGState, pool,
                           peer_shards: Dict[int, int]) -> None:
@@ -621,14 +804,22 @@ class OSDDaemon:
         for missing in state.peer_missing.values():
             todo.update(missing)
         for oid in sorted(todo):
-            await self._recover_object(state, pool, oid, peer_shards)
-        # clear recovered state
-        if plog.missing:
-            plog.missing = {}
-            cid = self._cid(pg, my_shard)
-            t = Transaction()
-            plog.stage(t, cid)
-            self.store.queue_transaction(t)
+            try:
+                await self._recover_object(state, pool, oid, peer_shards)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # an unrecoverable object (not enough consistent
+                # shards yet) stays missing; the next interval retries
+                log.exception("osd.%d: recovery of %s/%s failed",
+                              self.osd_id, pg, oid)
+        # persist whatever missing state remains
+        cid = self._cid(pg, my_shard)
+        t = Transaction()
+        if not self.store.collection_exists(cid):
+            t.create_collection(cid)
+        plog.stage(t, cid)
+        self.store.queue_transaction(t)
 
     async def _recover_object(self, state: PGState, pool, oid: str,
                               peer_shards: Dict[int, int]) -> None:
@@ -637,13 +828,21 @@ class OSDDaemon:
         pg = state.pg
         plog = self._load_log(state, pool)
         my_shard = state.my_shard(self.osd_id, pool.type)
-        shards, attrs = await self._gather_object_shards(state, pool, oid)
+        candidates = await self._gather_object_shards(state, pool, oid)
+        # always search strays during recovery: after several remaps the
+        # newest acked version may exist only on prior-interval members
+        have = set()
+        for idx, osd in enumerate(state.acting):
+            if osd != CRUSH_ITEM_NONE:
+                have.add((idx if pool.type == TYPE_ERASURE else -1, osd))
+        candidates += await self._gather_stray_shards(
+            state, pool, oid, have)
         targets = [(shard_key, osd)
                    for shard_key, osd in peer_shards.items()
                    if oid in state.peer_missing.get(shard_key, {})]
         i_need = oid in plog.missing
 
-        if not shards:
+        if not candidates:
             # object does not exist at any authoritative source: the
             # divergent entry was a create nobody kept — remove it
             for shard_key, osd in targets:
@@ -666,18 +865,41 @@ class OSDDaemon:
                     pass
             return
 
+        def _attrs_of(version, chosen) -> Dict[str, bytes]:
+            src = next(iter(chosen))
+            for shard, _payload, at in candidates:
+                if shard == src and self._oi_version(at) == version:
+                    return at
+            return {}
+
         if pool.type == TYPE_REPLICATED:
-            src = next(iter(shards))
-            payload = {-1: shards[src]}
-            obj_attrs = attrs[src]
+            version, chosen, _oi = self._select_consistent(
+                candidates, need=1)
+            if version is None:
+                return  # no readable copy with an object_info: retry
+            payload = {-1: chosen[next(iter(chosen))]}
+            obj_attrs = _attrs_of(version, chosen)
         else:
             codec = self._codec(pool.id)
             sinfo = self._sinfo(pool.id)
-            data = ec_util.decode(sinfo, codec, dict(shards))
+            k = codec.get_data_chunk_count()
+            version, chosen, _oi = self._select_consistent(
+                candidates, need=k, verify_hinfo=True)
+            if version is None:
+                # not enough same-version shards anywhere yet: the
+                # object stays missing (unfound) and a later interval
+                # retries
+                log.warning("osd.%d: %s/%s unfound (candidate versions"
+                            " %s)", self.osd_id, pg, oid,
+                            sorted({self._oi_version(at)
+                                    for _s, _p, at in candidates
+                                    if self._oi_version(at)}))
+                return
+            data = ec_util.decode(sinfo, codec, chosen)
             full = ec_util.encode(sinfo, codec, data,
                                   range(codec.get_chunk_count()))
             payload = full
-            obj_attrs = attrs[next(iter(shards))]
+            obj_attrs = _attrs_of(version, chosen)
 
         async def install(shard: int, osd: int) -> None:
             buf = payload.get(shard if pool.type == TYPE_ERASURE else -1,
@@ -725,6 +947,13 @@ class OSDDaemon:
             try:
                 await asyncio.wait_for(state.active_event.wait(), 10.0)
             except asyncio.TimeoutError:
+                await conn.send(MOSDOpReply(
+                    msg.tid, EAGAIN, replay_epoch=self._epoch()))
+                return
+            # a parked op must not execute as a zombie in a LATER
+            # interval than it was sent for — the client already
+            # resent it there (require_same_or_newer_map discipline)
+            if msg.epoch < state.interval_epoch:
                 await conn.send(MOSDOpReply(
                     msg.tid, EAGAIN, replay_epoch=self._epoch()))
                 return
@@ -788,6 +1017,10 @@ class OSDDaemon:
         """Fan out sub-writes to up shards (local applies directly);
         all must ack (sub_write_committed discipline)."""
         pg = state.pg
+        # fenced by a newer interval (a peering query outran our map):
+        # stop writing, incl. the local shard apply
+        if self._epoch() < state.interval_epoch:
+            return EAGAIN
         targets = self._up_shard_targets(state, pool)
         if len(targets) < self._min_size(pool):
             return EAGAIN
@@ -800,7 +1033,8 @@ class OSDDaemon:
             if osd == self.osd_id:
                 t = Transaction()
                 cid = self._cid(pg, shard)
-                self._apply_shard_ops(t, cid, oid, ops)
+                self._apply_shard_ops(t, cid, oid, ops,
+                                      save_rollback=entry is not None)
                 if entry is not None and \
                         ev(entry["version"]) > plog.info.last_update:
                     plog.append(entry)
@@ -897,43 +1131,59 @@ class OSDDaemon:
         rc, out = await self._op_stat(state, pool, oid)
         return rc, out.get("size", 0)
 
+    def _pg_is_clean(self, state: PGState, pool, oid: str) -> bool:
+        plog = self._load_log(state, pool)
+        if oid in plog.missing:
+            return False
+        return not any(oid in m for m in state.peer_missing.values())
+
     async def _op_read(self, state: PGState, pool, oid: str,
                        offset: int, length: int
                        ) -> Tuple[int, bytes]:
-        shards, attrs = await self._gather_object_shards(state, pool, oid)
-        if not shards:
-            return ENOENT, b""
         if pool.type == TYPE_REPLICATED:
-            shard = next(iter(shards))
-            oi = json.loads(attrs[shard].get(OI_ATTR, b"{}"))
-            data = shards[shard][:oi.get("size", len(shards[shard]))]
+            # fast path: primary serves from its own copy when the
+            # object is fully recovered (the reference's normal read)
+            if self._pg_is_clean(state, pool, oid):
+                shard = state.my_shard(self.osd_id, pool.type)
+                rc, data, at = self._read_shard(state.pg, shard, oid)
+                if rc == 0 and OI_ATTR in at:
+                    oi = json.loads(at[OI_ATTR])
+                    data = data[:oi.get("size", len(data))]
+                    if length:
+                        data = data[offset:offset + length]
+                    elif offset:
+                        data = data[offset:]
+                    return 0, data
+                if rc == ENOENT:
+                    return ENOENT, b""
+            candidates = await self._gather_object_shards(
+                state, pool, oid)
+            if not candidates:
+                return ENOENT, b""
+            version, chosen, oi = self._select_consistent(
+                candidates, need=1)
+            if version is None:
+                return EIO, b""
+            data = chosen[next(iter(chosen))]
+            data = data[:oi.get("size", len(data))]
             if length:
                 data = data[offset:offset + length]
             elif offset:
                 data = data[offset:]
             return 0, data
+        candidates = await self._gather_object_shards(state, pool, oid)
+        if not candidates:
+            return ENOENT, b""
         codec = self._codec(pool.id)
         sinfo = self._sinfo(pool.id)
-        # verify hinfo crc per shard; drop corrupt shards (erasures)
-        good: Dict[int, bytes] = {}
-        size = None
-        for shard, buf in shards.items():
-            at = attrs.get(shard, {})
-            try:
-                oi = json.loads(at[OI_ATTR])
-                hi = ec_util.HashInfo.from_dict(
-                    json.loads(at[HINFO_ATTR]))
-            except (KeyError, ValueError):
-                continue
-            if hi.has_chunk_hash() and \
-                    cks.crc32c(0xFFFFFFFF, buf) != hi.get_chunk_hash(
-                        shard):
-                continue
-            good[shard] = buf
-            size = oi.get("size", size)
-        if size is None:
-            return EIO, b""
         k = codec.get_data_chunk_count()
+        # newest version with >= k intact same-version shards wins;
+        # hinfo crc drops corrupt shards (handle_sub_read's verify)
+        version, good, oi = self._select_consistent(
+            candidates, need=k, verify_hinfo=True)
+        if version is None:
+            return EIO, b""
+        size = oi.get("size", 0)
         want = {codec.chunk_index(i) for i in range(k)}
         try:
             minimum = codec.minimum_to_decode(want, set(good))
@@ -950,13 +1200,18 @@ class OSDDaemon:
 
     async def _op_stat(self, state: PGState, pool, oid: str
                        ) -> Tuple[int, Dict[str, Any]]:
-        shards, attrs = await self._gather_object_shards(state, pool, oid)
-        for shard, at in attrs.items():
-            if OI_ATTR in at:
-                oi = json.loads(at[OI_ATTR])
-                return 0, {"size": oi.get("size", 0),
-                           "version": oi.get("version")}
-        return ENOENT, {}
+        candidates = await self._gather_object_shards(state, pool, oid)
+        if not candidates:
+            return ENOENT, {}
+        need = self._codec(pool.id).get_data_chunk_count() \
+            if pool.type == TYPE_ERASURE else 1
+        version, _chosen, oi = self._select_consistent(
+            candidates, need=need,
+            verify_hinfo=pool.type == TYPE_ERASURE)
+        if version is None:
+            return EIO, {}
+        return 0, {"size": oi.get("size", 0),
+                   "version": oi.get("version")}
 
     async def _op_remove(self, state: PGState, pool, oid: str) -> int:
         rc, _ = await self._op_stat(state, pool, oid)

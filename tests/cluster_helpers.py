@@ -118,7 +118,8 @@ class Cluster:
                     if primary < 0 or primary not in self.osds:
                         return False
                     state = self.osds[primary].pgs.get(pg)
-                    if state is None or state.state != "active":
+                    if state is None or state.state != "active" or \
+                            state.unfound:
                         return False
             return True
 

@@ -1,0 +1,124 @@
+"""True multi-process tier (qa/standalone/ceph-helpers.sh role).
+
+Spawns the mon and each OSD as a REAL separate python process on
+loopback (TPUStore-backed so data survives a SIGKILL), drives them with
+the networked client, kills an OSD process with SIGKILL mid-run, reads
+through reconstruction, restarts the process, and checks recovery —
+the test-erasure-code.sh shape end to end."""
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+OSD_CONFIG = ('{"osd_heartbeat_interval": 0.2, '
+              '"osd_heartbeat_grace": 1.0}')
+MON_CONFIG = ('{"mon_osd_min_down_reporters": 1, '
+              '"osd_heartbeat_grace": 1.0}')
+
+
+def _spawn(args):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # daemons never need a device
+    env["PYTHONPATH"] = str(REPO)
+    return subprocess.Popen(
+        [sys.executable, "-u", *args], cwd=str(REPO), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+
+
+def _read_addr(proc, tag: str, timeout: float = 60.0) -> str:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError(f"daemon exited: rc={proc.poll()}")
+        if line.startswith(tag):
+            return line.split()[1]
+    raise TimeoutError(f"no {tag} line")
+
+
+@pytest.mark.slow
+def test_multiprocess_cluster_ec_kill_restart(tmp_path):
+    procs = {}
+    mon = _spawn(["-m", "ceph_tpu.mon", "--num-osds", "4",
+                  "--config", MON_CONFIG])
+    try:
+        mon_addr = _read_addr(mon, "MON_ADDR")
+        for i in range(4):
+            procs[i] = _spawn(
+                ["-m", "ceph_tpu.osd", "--id", str(i),
+                 "--mon", mon_addr,
+                 "--store-path", str(tmp_path / f"osd.{i}"),
+                 "--config", OSD_CONFIG])
+        for i in range(4):
+            _read_addr(procs[i], "OSD_ADDR")
+
+        async def drive():
+            from ceph_tpu.rados.client import RadosClient
+
+            client = RadosClient(mon_addr)
+            await client.connect()
+            try:
+                await client.create_ec_pool("ecpool", {
+                    "plugin": "ec_jax", "technique": "reed_sol_van",
+                    "k": "2", "m": "1",
+                    "crush-failure-domain": "osd"}, pg_num=8)
+                ioctx = client.open_ioctx("ecpool")
+                payloads = {
+                    f"obj{i}": np.random.default_rng(i).integers(
+                        0, 256, 40_000, dtype=np.uint8).tobytes()
+                    for i in range(6)}
+                for name, data in payloads.items():
+                    await ioctx.write_full(name, data)
+                for name, data in payloads.items():
+                    assert await ioctx.read(name) == data
+
+                # SIGKILL osd.2's PROCESS: no clean shutdown at all
+                procs[2].send_signal(signal.SIGKILL)
+                procs[2].wait()
+                # wait for the mon to mark it down via failure reports
+                for _ in range(300):
+                    rc, out = await client.mon_command(
+                        {"prefix": "status"})
+                    if out["num_up_osds"] == 3:
+                        break
+                    await asyncio.sleep(0.1)
+                else:
+                    raise TimeoutError("osd.2 never marked down")
+                # degraded reads reconstruct through the lost shard
+                for name, data in payloads.items():
+                    assert await ioctx.read(name) == data
+
+                # restart the process on the surviving store
+                procs[2] = _spawn(
+                    ["-m", "ceph_tpu.osd", "--id", "2",
+                     "--mon", mon_addr,
+                     "--store-path", str(tmp_path / "osd.2"),
+                     "--config", OSD_CONFIG])
+                _read_addr(procs[2], "OSD_ADDR")
+                for _ in range(300):
+                    rc, out = await client.mon_command(
+                        {"prefix": "status"})
+                    if out["num_up_osds"] == 4:
+                        break
+                    await asyncio.sleep(0.1)
+                # data still correct post-rejoin
+                for name, data in payloads.items():
+                    assert await ioctx.read(name) == data
+            finally:
+                await client.shutdown()
+
+        asyncio.run(asyncio.wait_for(drive(), 180))
+    finally:
+        for proc in list(procs.values()) + [mon]:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()

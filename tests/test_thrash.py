@@ -1,0 +1,157 @@
+"""Thrasher tier: randomized kill/revive under load with a model checker.
+
+The thrashosds/ceph_test_rados shape
+(/root/reference/qa/tasks/ceph_manager.py:2702,2744 kill_osd/revive_osd;
+/root/reference/src/test/osd/RadosModel.h): a workload of writes runs
+while OSDs are killed mid-write and revived; a client-side model tracks
+every ACKED write.  Invariants at the end (after the cluster goes
+clean):
+
+1. zero data loss: every acked write reads back exactly;
+2. log convergence: every shard of every object matches the re-encode
+   of the object's current readable state (kill-replica-mid-write logs
+   converged on all shards).
+"""
+
+import asyncio
+import random
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.registry import create_erasure_code
+from ceph_tpu.osd import ec_util
+from ceph_tpu.osd.pg_log import PGMETA_OID
+from ceph_tpu.rados.client import RadosError
+
+from cluster_helpers import Cluster
+
+EC_PROFILE = {"plugin": "ec_jax", "technique": "reed_sol_van",
+              "k": "2", "m": "1", "crush-failure-domain": "osd"}
+
+
+async def _thrash_once(rng, cluster, down: set) -> None:
+    """One thrash action: kill+out a random up OSD, or revive+in."""
+    alive = sorted(set(cluster.osds) - down)
+    if down and (len(alive) <= 3 or rng.random() < 0.5):
+        osd = rng.choice(sorted(down))
+        down.discard(osd)
+        await cluster.revive_osd(osd)
+        await cluster.wait_for_osd_up(osd)
+        await cluster.client.mon_command({"prefix": "osd in",
+                                          "osd": osd})
+    elif len(alive) > 3:
+        osd = rng.choice(alive)
+        down.add(osd)
+        await cluster.kill_osd(osd)       # mid-write: no quiesce
+        await cluster.wait_for_osd_down(osd)
+        await cluster.client.mon_command({"prefix": "osd out",
+                                          "osd": osd})
+
+
+@pytest.mark.slow
+def test_thrash_ec_no_data_loss_and_converged_shards():
+    async def main():
+        rng = random.Random(1234)
+        cluster = Cluster(num_osds=5, osds_per_host=1)
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool("ec", EC_PROFILE,
+                                                pg_num=8)
+            ioctx = cluster.client.open_ioctx("ec")
+            # RadosModel discipline: an ACKED write must survive; an
+            # UNACKED write (error/timeout) may have committed anyway,
+            # so the legal states are {last acked} U {unacked attempts
+            # since the last ack}
+            model: dict = {}       # oid -> acked payload
+            maybe: dict = {}       # oid -> [unacked payloads since ack]
+            down: set = set()
+
+            async def workload():
+                seq = 0
+                while True:
+                    seq += 1
+                    oid = f"obj-{rng.randrange(12)}"
+                    data = np.random.default_rng(seq).integers(
+                        0, 256, rng.randrange(1000, 60_000),
+                        dtype=np.uint8).tobytes()
+                    # record BEFORE submitting: a cancelled/failed
+                    # attempt may still commit (indeterminate)
+                    maybe.setdefault(oid, []).append(data)
+                    try:
+                        await ioctx.write_full(oid, data)
+                        model[oid] = data   # acked -> must survive
+                        maybe[oid] = []     # pre-ack attempts are dead:
+                        # the daemon fences zombie parked ops
+                    except RadosError:
+                        pass
+                    await asyncio.sleep(0)
+
+            task = asyncio.get_running_loop().create_task(workload())
+            try:
+                for _round in range(6):
+                    await asyncio.sleep(0.4)
+                    await _thrash_once(rng, cluster, down)
+            finally:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+            # heal everything
+            for osd in sorted(down):
+                await cluster.revive_osd(osd)
+                await cluster.wait_for_osd_up(osd)
+                await cluster.client.mon_command(
+                    {"prefix": "osd in", "osd": osd})
+            await cluster.wait_for_clean()
+
+            # invariant 1: zero data loss — every object reads back as
+            # its last acked payload or a later indeterminate attempt
+            assert model, "workload never acked anything"
+            final: dict = {}
+            for oid, data in model.items():
+                got = await ioctx.read(oid)
+                legal = [data] + maybe.get(oid, [])
+                assert any(got == want for want in legal), \
+                    (f"{oid}: read ({len(got)}B) matches neither the "
+                     f"acked write ({len(data)}B) nor any of "
+                     f"{len(maybe.get(oid, []))} indeterminate attempts")
+                final[oid] = got
+
+            # invariant 2: all shards converged to the readable state
+            codec = create_erasure_code(dict(EC_PROFILE))
+            pool_id = ioctx.pool_id
+            stripe_unit = 4096
+            k = codec.get_data_chunk_count()
+            unit = codec.get_chunk_size(k * stripe_unit)
+            sinfo = ec_util.StripeInfo(k, k * unit)
+            checked = 0
+            for oid, data in final.items():
+                pg = ioctx.object_pg(oid)
+                acting, _p = cluster.mon.osdmap.pg_to_acting_osds(pg)
+                width = sinfo.get_stripe_width()
+                padded = data + bytes(-len(data) % width)
+                expect = ec_util.encode(
+                    sinfo, codec, padded,
+                    range(codec.get_chunk_count()))
+                for shard, osd in enumerate(acting):
+                    if osd < 0 or osd not in cluster.osds:
+                        continue
+                    store = cluster.stores[osd]
+                    cid = f"{pg.pool}.{pg.ps:x}s{shard}_head"
+                    from ceph_tpu.os import ObjectId
+
+                    try:
+                        buf = store.read(cid, ObjectId(oid))
+                    except KeyError:
+                        raise AssertionError(
+                            f"{oid} shard {shard} missing on osd.{osd}")
+                    assert buf == expect.get(shard, b""), \
+                        f"{oid} shard {shard} on osd.{osd} diverged"
+                    checked += 1
+            assert checked > 0
+        finally:
+            await cluster.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 300))
