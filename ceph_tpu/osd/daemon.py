@@ -76,6 +76,7 @@ EAGAIN = -11
 ENOENT = -2
 ESTALE = -116
 EIO = -5
+EINVAL = -22
 
 DEFAULTS = {
     "osd_heartbeat_interval": 1.0,
@@ -297,6 +298,12 @@ class OSDDaemon:
                 if not in_acting:
                     if state is not None:
                         state.state = "inactive"
+                        state.active_event.clear()
+                        # a demoted member's in-flight peering must not
+                        # keep pushing logs under the old interval
+                        if state.peering_task is not None:
+                            state.peering_task.cancel()
+                            state.peering_task = None
                     continue
                 if state is None:
                     state = PGState(pg)
@@ -514,7 +521,8 @@ class OSDDaemon:
         cid = self._cid(pg, shard)
         try:
             return sorted(str(o) for o in self.store.list_objects(cid)
-                          if str(o) != PGMETA_OID)
+                          if str(o) != PGMETA_OID
+                          and not str(o).startswith(RB_PREFIX))
         except KeyError:
             return []
 
@@ -971,24 +979,35 @@ class OSDDaemon:
     async def _execute_ops(self, state: PGState, pool, msg: MOSDOp
                            ) -> Tuple[int, bytes, Dict[str, Any]]:
         rc, data, out = 0, b"", {}
+        if msg.oid.startswith(RB_PREFIX):
+            # rollback generations are internal bookkeeping, not
+            # client-addressable objects
+            return EINVAL, b"", {}
+        # interval the op was admitted under: sub-writes are stamped
+        # with this so a demoted primary's parked op cannot pass replica
+        # fencing with a fresher live epoch
+        state_admit_epoch = state.interval_epoch
         for op in msg.ops:
             if op.op == "write_full":
                 rc = await self._op_write_full(state, pool, msg.oid,
-                                               op.data)
+                                               op.data,
+                                               state_admit_epoch)
             elif op.op == "write":
                 rc = await self._op_write(state, pool, msg.oid,
-                                          op.offset, op.data)
+                                          op.offset, op.data,
+                                          state_admit_epoch)
             elif op.op == "read":
                 rc, data = await self._op_read(state, pool, msg.oid,
                                                op.offset, op.length)
             elif op.op == "stat":
                 rc, out = await self._op_stat(state, pool, msg.oid)
             elif op.op == "remove":
-                rc = await self._op_remove(state, pool, msg.oid)
+                rc = await self._op_remove(state, pool, msg.oid,
+                                           state_admit_epoch)
             elif op.op == "pgls":
                 rc, out = self._op_pgls(state, pool)
             else:
-                rc = -22
+                rc = EINVAL
             if rc < 0:
                 break
         return rc, data, out
@@ -1013,13 +1032,22 @@ class OSDDaemon:
     async def _submit_shard_writes(
             self, state: PGState, pool, oid: str,
             shard_ops: Dict[int, List[ShardOp]],
-            entry: Optional[dict]) -> int:
+            entry: Optional[dict],
+            admit_epoch: Optional[int] = None) -> int:
         """Fan out sub-writes to up shards (local applies directly);
-        all must ack (sub_write_committed discipline)."""
+        all must ack (sub_write_committed discipline).
+
+        Sub-writes carry admit_epoch — the interval the op was admitted
+        under — not the live epoch, so an op parked across an interval
+        change can never outrun replica fencing."""
         pg = state.pg
-        # fenced by a newer interval (a peering query outran our map):
-        # stop writing, incl. the local shard apply
-        if self._epoch() < state.interval_epoch:
+        if admit_epoch is None:
+            admit_epoch = state.interval_epoch
+        # fenced by a newer interval (a peering query outran our map, or
+        # the interval changed after this op was admitted): stop
+        # writing, incl. the local shard apply
+        if self._epoch() < state.interval_epoch or \
+                admit_epoch < state.interval_epoch:
             return EAGAIN
         targets = self._up_shard_targets(state, pool)
         if len(targets) < self._min_size(pool):
@@ -1047,7 +1075,7 @@ class OSDDaemon:
                 tid = self._next_tid()
                 pending.append(self._request(
                     osd, MOSDSubWrite(tid, pg, shard, oid, ops,
-                                      self._epoch(), entry,
+                                      admit_epoch, entry,
                                       self.osd_id), tid))
         replies = await asyncio.gather(*pending) if pending else []
         # a shard that failed mid-write recovers via peering on the next
@@ -1057,7 +1085,42 @@ class OSDDaemon:
                         if r is not None and r.rc == 0)
         if acked < self._min_size(pool):
             return EAGAIN
+        if entry is not None and acked == len(
+                [s for s, _o in targets if shard_ops.get(s) is not None]):
+            # every shard committed: the preserved previous generation
+            # can never be needed again — trim it (the role of
+            # ECBackend's rollback trim as log entries commit).  Awaited
+            # (not fire-and-forget) so a sequential client's NEXT
+            # overwrite — which clones a fresh rollback — cannot race
+            # with this trim and lose its clone.
+            await self._trim_rollbacks(state, oid, targets, admit_epoch)
         return 0
+
+    async def _trim_rollbacks(self, state: PGState, oid: str,
+                              targets: List[Tuple[int, int]],
+                              epoch: int) -> None:
+        """Best-effort removal of each shard's rollback clone."""
+        pg = state.pg
+        rb = RB_PREFIX + oid
+        pending = []
+        for shard, osd in targets:
+            try:
+                if osd == self.osd_id:
+                    cid = self._cid(pg, shard)
+                    t = Transaction()
+                    t.remove(cid, ObjectId(rb))
+                    self.store.queue_transaction(t)
+                else:
+                    tid = self._next_tid()
+                    pending.append(self._request(
+                        osd, MOSDSubWrite(tid, pg, shard, rb,
+                                          [ShardOp("remove")],
+                                          epoch, None, self.osd_id),
+                        tid))
+            except (KeyError, ConnectionError, OSError):
+                pass  # a stale clone is only garbage
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
 
     def _next_entry(self, state: PGState, pool, oid: str, op: str,
                     size: int = 0) -> dict:
@@ -1068,7 +1131,8 @@ class OSDDaemon:
         return make_entry(version, prior, oid, op, size)
 
     async def _op_write_full(self, state: PGState, pool, oid: str,
-                             data: bytes) -> int:
+                             data: bytes,
+                             admit_epoch: Optional[int] = None) -> int:
         entry = self._next_entry(state, pool, oid, "modify", len(data))
         oi = json.dumps({"size": len(data),
                          "version": entry["version"]}).encode()
@@ -1096,10 +1160,12 @@ class OSDDaemon:
                     ShardOp("setattr", name=OI_ATTR, value=oi),
                     ShardOp("setattr", name=HINFO_ATTR, value=hinfo_raw)]
         return await self._submit_shard_writes(state, pool, oid,
-                                               shard_ops, entry)
+                                               shard_ops, entry,
+                                               admit_epoch)
 
     async def _op_write(self, state: PGState, pool, oid: str,
-                        offset: int, data: bytes) -> int:
+                        offset: int, data: bytes,
+                        admit_epoch: Optional[int] = None) -> int:
         """Partial-extent write.  Replicated: direct range write.
         EC: read-modify-write of the touched range (RMW pipeline)."""
         if pool.type == TYPE_REPLICATED:
@@ -1113,7 +1179,8 @@ class OSDDaemon:
                    ShardOp("write", offset, data),
                    ShardOp("setattr", name=OI_ATTR, value=oi)]
             return await self._submit_shard_writes(state, pool, oid,
-                                                   {-1: ops}, entry)
+                                                   {-1: ops}, entry,
+                                                   admit_epoch)
         # EC RMW v0: full-object read, merge, re-encode (extent-cache
         # batched stripe RMW lands with the dedicated RMW milestone)
         rc, old = await self._op_read(state, pool, oid, 0, 0)
@@ -1124,7 +1191,8 @@ class OSDDaemon:
         new = bytearray(max(len(old), offset + len(data)))
         new[:len(old)] = old
         new[offset:offset + len(data)] = data
-        return await self._op_write_full(state, pool, oid, bytes(new))
+        return await self._op_write_full(state, pool, oid, bytes(new),
+                                         admit_epoch)
 
     async def _stat_size(self, state: PGState, pool, oid: str
                          ) -> Tuple[int, int]:
@@ -1213,7 +1281,8 @@ class OSDDaemon:
         return 0, {"size": oi.get("size", 0),
                    "version": oi.get("version")}
 
-    async def _op_remove(self, state: PGState, pool, oid: str) -> int:
+    async def _op_remove(self, state: PGState, pool, oid: str,
+                         admit_epoch: Optional[int] = None) -> int:
         rc, _ = await self._op_stat(state, pool, oid)
         if rc == ENOENT:
             return ENOENT
@@ -1226,7 +1295,8 @@ class OSDDaemon:
             shard_ops = {s: list(ops)
                          for s in range(codec.get_chunk_count())}
         return await self._submit_shard_writes(state, pool, oid,
-                                               shard_ops, entry)
+                                               shard_ops, entry,
+                                               admit_epoch)
 
     def _op_pgls(self, state: PGState, pool
                  ) -> Tuple[int, Dict[str, Any]]:
@@ -1234,7 +1304,8 @@ class OSDDaemon:
         cid = self._cid(state.pg, shard)
         try:
             names = [str(o) for o in self.store.list_objects(cid)
-                     if str(o) != PGMETA_OID]
+                     if str(o) != PGMETA_OID
+                     and not str(o).startswith(RB_PREFIX)]
         except KeyError:
             names = []
         return 0, {"objects": sorted(names)}

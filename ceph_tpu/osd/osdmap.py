@@ -271,14 +271,15 @@ class OSDMap:
         pg = pool.raw_pg_to_pg(raw_pg)
         explicit = self.pg_upmap.get(pg)
         if explicit is not None:
-            if all(not (o != CRUSH_ITEM_NONE and 0 <= o < self.max_osd
-                        and self.osd_weight[o] == 0) for o in explicit):
-                raw[:] = list(explicit)
-            # an explicit pg_upmap entry — even an empty one, or one
-            # rejected because a target OSD is out — precludes
-            # pg_upmap_items (OSDMap::_apply_upmap returns in both
-            # branches)
-            return
+            if any(o != CRUSH_ITEM_NONE and 0 <= o < self.max_osd
+                   and self.osd_weight[o] == 0 for o in explicit):
+                # a marked-out target rejects the whole explicit mapping
+                # AND short-circuits pg_upmap_items
+                # (OSDMap::_apply_upmap early return, OSDMap.cc:2466-2476)
+                return
+            raw[:] = list(explicit)
+            # applied mapping falls through to pg_upmap_items
+            # (OSDMap.cc:2478-2481 "continue to check and apply")
         for src, dst in self.pg_upmap_items.get(pg, []):
             exists = False
             pos = -1

@@ -332,3 +332,17 @@ def test_pool_opts_typed_round_trip(osdmap):
     assert m2.pools[1].opts == pool.opts
     assert isinstance(m2.pools[1].opts["csum_type"], int)
     assert isinstance(m2.pools[1].opts["compression_required_ratio"], float)
+
+
+def test_upmap_applied_falls_through_to_items(osdmap):
+    """An APPLIED explicit pg_upmap does NOT suppress pg_upmap_items:
+    the reference falls through and applies both
+    (OSDMap.cc:2478-2481 "continue to check and apply")."""
+    pg = PgId(1, 9)
+    up0, _p, _a, _ap = osdmap.pg_to_up_acting_osds(pg)
+    spares = [o for o in range(12) if o not in up0]
+    explicit = [spares[0], up0[1], up0[2]]
+    osdmap.pg_upmap[pg] = explicit
+    osdmap.pg_upmap_items[pg] = [(up0[1], spares[1])]
+    up1, _p1, _a1, _ap1 = osdmap.pg_to_up_acting_osds(pg)
+    assert up1 == [spares[0], spares[1], up0[2]]
