@@ -21,8 +21,8 @@ def gf_matmul(mat: np.ndarray, data: np.ndarray, use_tpu: bool,
     if use_tpu and gf.backend_available() and data.size >= min_bytes:
         return np.asarray(gf.gf_matmul_tpu(mat, data))
     if data.ndim == 2:
-        return gf.gf_matmul_ref(mat, data)
-    return np.stack([gf.gf_matmul_ref(mat, d) for d in data])
+        return gf.gf_matmul_host(mat, data)
+    return np.stack([gf.gf_matmul_host(mat, d) for d in data])
 
 
 class LruCache:

@@ -87,6 +87,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.ceph_tpu_region_mad.argtypes = [u8p, u8p, u64, u8p]
     lib.ceph_tpu_gf_matmul.restype = None
     lib.ceph_tpu_gf_matmul.argtypes = [u8p, u64, u64, u8p, u64, u8p]
+    try:  # SIMD GF tier (gf_simd.cc) — optional on stale .so
+        lib.ceph_tpu_gf_simd_level.restype = ctypes.c_int
+        lib.ceph_tpu_gf_simd_level.argtypes = []
+        lib.ceph_tpu_gf_region_mad_v.restype = None
+        lib.ceph_tpu_gf_region_mad_v.argtypes = [u8p, u8p, u64, u8p]
+        lib.ceph_tpu_gf_matmul_simd.restype = None
+        lib.ceph_tpu_gf_matmul_simd.argtypes = [u8p, u64, u64, u8p,
+                                                u64, u8p]
+    except AttributeError:
+        pass
     try:  # compression codecs are an optional capability of the library
         i64 = ctypes.c_int64
         for alg in ("lz4", "snappy"):

@@ -1,0 +1,216 @@
+// GF(2^8) SIMD region kernels — the host-CPU speed tier.
+//
+// Role parity: the reference's vectorized GF region ops — Intel ISA-L's
+// ec_encode_data / gf_vect_mad (used via
+// /root/reference/src/erasure-code/isa/ErasureCodeIsa.cc:119-131) and
+// jerasure's SSE region multiply (src/erasure-code/jerasure/).  These give
+// ceph_tpu an honest CPU baseline for bench.py's vs_baseline ratio and a
+// fast host fallback for the ec_jax codec when no device is available.
+//
+// Technique: 4-bit split tables + (V)PSHUFB byte shuffle.  GF(2^8)
+// multiplication by a constant c is GF(2)-linear in the input bits, so
+//   c*x == c*(x & 0x0f) ^ c*(x & 0xf0)
+// and each half is a 16-entry lookup — exactly the shape of the x86 byte
+// shuffle instruction.  This is the well-known public method implemented
+// by gf-complete ("SPLIT 8 4") and ISA-L; the code below is written from
+// the technique, not copied from any implementation.
+
+#include <cstdint>
+#include <cstring>
+
+#if defined(__x86_64__) || defined(__i386__)
+#include <immintrin.h>
+#define CEPH_TPU_X86 1
+#endif
+
+namespace {
+
+// Split a 256-entry multiply table into its two 16-entry nibble tables.
+// Valid because the table is linear: tbl[x] == tbl[x & 0xf] ^ tbl[x & 0xf0].
+inline void nibble_tables(const uint8_t *tbl, uint8_t lo[16],
+                          uint8_t hi[16]) {
+  for (int i = 0; i < 16; i++) {
+    lo[i] = tbl[i];
+    hi[i] = tbl[i << 4];
+  }
+}
+
+void mad_scalar(uint8_t *dst, const uint8_t *src, uint64_t len,
+                const uint8_t lo[16], const uint8_t hi[16]) {
+  for (uint64_t i = 0; i < len; i++)
+    dst[i] ^= lo[src[i] & 0x0f] ^ hi[src[i] >> 4];
+}
+
+#ifdef CEPH_TPU_X86
+
+__attribute__((target("ssse3")))
+void mad_ssse3(uint8_t *dst, const uint8_t *src, uint64_t len,
+               const uint8_t lo[16], const uint8_t hi[16]) {
+  const __m128i vlo = _mm_loadu_si128((const __m128i *)lo);
+  const __m128i vhi = _mm_loadu_si128((const __m128i *)hi);
+  const __m128i mask = _mm_set1_epi8(0x0f);
+  uint64_t i = 0;
+  for (; i + 16 <= len; i += 16) {
+    __m128i s = _mm_loadu_si128((const __m128i *)(src + i));
+    __m128i d = _mm_loadu_si128((const __m128i *)(dst + i));
+    __m128i p = _mm_xor_si128(
+        _mm_shuffle_epi8(vlo, _mm_and_si128(s, mask)),
+        _mm_shuffle_epi8(vhi, _mm_and_si128(_mm_srli_epi64(s, 4), mask)));
+    _mm_storeu_si128((__m128i *)(dst + i), _mm_xor_si128(d, p));
+  }
+  mad_scalar(dst + i, src + i, len - i, lo, hi);
+}
+
+__attribute__((target("avx2")))
+void mad_avx2(uint8_t *dst, const uint8_t *src, uint64_t len,
+              const uint8_t lo[16], const uint8_t hi[16]) {
+  const __m256i vlo =
+      _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i *)lo));
+  const __m256i vhi =
+      _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i *)hi));
+  const __m256i mask = _mm256_set1_epi8(0x0f);
+  uint64_t i = 0;
+  for (; i + 64 <= len; i += 64) {
+    __m256i s0 = _mm256_loadu_si256((const __m256i *)(src + i));
+    __m256i s1 = _mm256_loadu_si256((const __m256i *)(src + i + 32));
+    __m256i d0 = _mm256_loadu_si256((const __m256i *)(dst + i));
+    __m256i d1 = _mm256_loadu_si256((const __m256i *)(dst + i + 32));
+    __m256i p0 = _mm256_xor_si256(
+        _mm256_shuffle_epi8(vlo, _mm256_and_si256(s0, mask)),
+        _mm256_shuffle_epi8(
+            vhi, _mm256_and_si256(_mm256_srli_epi64(s0, 4), mask)));
+    __m256i p1 = _mm256_xor_si256(
+        _mm256_shuffle_epi8(vlo, _mm256_and_si256(s1, mask)),
+        _mm256_shuffle_epi8(
+            vhi, _mm256_and_si256(_mm256_srli_epi64(s1, 4), mask)));
+    _mm256_storeu_si256((__m256i *)(dst + i), _mm256_xor_si256(d0, p0));
+    _mm256_storeu_si256((__m256i *)(dst + i + 32),
+                        _mm256_xor_si256(d1, p1));
+  }
+  for (; i + 32 <= len; i += 32) {
+    __m256i s = _mm256_loadu_si256((const __m256i *)(src + i));
+    __m256i d = _mm256_loadu_si256((const __m256i *)(dst + i));
+    __m256i p = _mm256_xor_si256(
+        _mm256_shuffle_epi8(vlo, _mm256_and_si256(s, mask)),
+        _mm256_shuffle_epi8(
+            vhi, _mm256_and_si256(_mm256_srli_epi64(s, 4), mask)));
+    _mm256_storeu_si256((__m256i *)(dst + i), _mm256_xor_si256(d, p));
+  }
+  mad_scalar(dst + i, src + i, len - i, lo, hi);
+}
+
+__attribute__((target("avx2")))
+void xor_avx2(uint8_t *dst, const uint8_t *src, uint64_t len) {
+  uint64_t i = 0;
+  for (; i + 64 <= len; i += 64) {
+    __m256i d0 = _mm256_loadu_si256((const __m256i *)(dst + i));
+    __m256i d1 = _mm256_loadu_si256((const __m256i *)(dst + i + 32));
+    __m256i s0 = _mm256_loadu_si256((const __m256i *)(src + i));
+    __m256i s1 = _mm256_loadu_si256((const __m256i *)(src + i + 32));
+    _mm256_storeu_si256((__m256i *)(dst + i), _mm256_xor_si256(d0, s0));
+    _mm256_storeu_si256((__m256i *)(dst + i + 32),
+                        _mm256_xor_si256(d1, s1));
+  }
+  for (; i < len; i++) dst[i] ^= src[i];
+}
+
+#endif  // CEPH_TPU_X86
+
+using mad_fn = void (*)(uint8_t *, const uint8_t *, uint64_t,
+                        const uint8_t[16], const uint8_t[16]);
+
+int detect_level() {
+#ifdef CEPH_TPU_X86
+  if (__builtin_cpu_supports("avx2")) return 2;
+  if (__builtin_cpu_supports("ssse3")) return 1;
+#endif
+  return 0;
+}
+
+const int g_level = detect_level();
+
+mad_fn pick_mad() {
+#ifdef CEPH_TPU_X86
+  if (g_level == 2) return mad_avx2;
+  if (g_level == 1) return mad_ssse3;
+#endif
+  return mad_scalar;
+}
+
+const mad_fn g_mad = pick_mad();
+
+}  // namespace
+
+extern "C" {
+
+// declared in checksum.cc
+void ceph_tpu_region_xor(uint8_t *dst, const uint8_t *src, uint64_t len);
+void ceph_tpu_gf_matmul(const uint8_t *mat_tables, uint64_t r, uint64_t k,
+                        const uint8_t *data, uint64_t s, uint8_t *out);
+
+// 0 = scalar, 1 = SSSE3 (128-bit), 2 = AVX2 (256-bit)
+int ceph_tpu_gf_simd_level(void) { return g_level; }
+
+// dst ^= tbl[src] over len bytes, vectorized; tbl is a 256-entry GF(2^8)
+// multiply table (one matrix coefficient).
+void ceph_tpu_gf_region_mad_v(uint8_t *dst, const uint8_t *src,
+                              uint64_t len, const uint8_t *tbl) {
+  uint8_t lo[16], hi[16];
+  nibble_tables(tbl, lo, hi);
+  g_mad(dst, src, len, lo, hi);
+}
+
+// Vectorized GF(2^8) matmul: out(R,S) = mat(R,K) * data(K,S), XOR
+// accumulation, strip-mined so the data strip stays in L1 across the R
+// output rows.  Same signature family as ceph_tpu_gf_matmul (scalar).
+void ceph_tpu_gf_matmul_simd(const uint8_t *mat_tables, uint64_t r,
+                             uint64_t k, const uint8_t *data, uint64_t s,
+                             uint8_t *out) {
+  // pre-split tables live on the stack: bound the matrix size (far above
+  // any real EC profile) and fall back to the scalar path beyond it
+  constexpr uint64_t MAXRK = 64 * 64;
+  if (r * k > MAXRK) {
+    ceph_tpu_gf_matmul(mat_tables, r, k, data, s, out);
+    return;
+  }
+  std::memset(out, 0, r * s);
+  uint8_t lo[MAXRK][16], hi[MAXRK][16];
+  uint8_t kind[MAXRK];  // 0 = zero coeff, 1 = identity (XOR), 2 = general
+  for (uint64_t j = 0; j < r; j++)
+    for (uint64_t i = 0; i < k; i++) {
+      const uint8_t *tbl = mat_tables + (j * k + i) * 256;
+      uint64_t idx = j * k + i;
+      nibble_tables(tbl, lo[idx], hi[idx]);
+      if (tbl[1] == 0)
+        kind[idx] = 0;
+      else if (tbl[1] == 1 && tbl[2] == 2 && tbl[255] == 255)
+        kind[idx] = 1;
+      else
+        kind[idx] = 2;
+    }
+  constexpr uint64_t STRIP = 16 * 1024;
+  for (uint64_t off = 0; off < s; off += STRIP) {
+    uint64_t n = (s - off < STRIP) ? (s - off) : STRIP;
+    for (uint64_t j = 0; j < r; j++) {
+      uint8_t *dst = out + j * s + off;
+      for (uint64_t i = 0; i < k; i++) {
+        const uint8_t *src = data + i * s + off;
+        uint64_t idx = j * k + i;
+        if (kind[idx] == 0) continue;
+        if (kind[idx] == 1) {
+#ifdef CEPH_TPU_X86
+          if (g_level == 2) {
+            xor_avx2(dst, src, n);
+            continue;
+          }
+#endif
+          ceph_tpu_region_xor(dst, src, n);
+        } else {
+          g_mad(dst, src, n, lo[idx], hi[idx]);
+        }
+      }
+    }
+  }
+}
+
+}  // extern "C"

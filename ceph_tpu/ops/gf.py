@@ -140,6 +140,53 @@ def gf_matmul_ref(m: np.ndarray, d: np.ndarray) -> np.ndarray:
     return out
 
 
+_mul_table_cache: dict = {}
+
+
+def gf_mul_tables(m: np.ndarray) -> np.ndarray:
+    """(R,K) GF matrix -> (R*K, 256) per-coefficient multiply tables
+    (the jerasure/isa-l table form consumed by the native region ops)."""
+    m = np.asarray(m, dtype=np.uint8)
+    key = m.tobytes()
+    hit = _mul_table_cache.get(key)
+    if hit is None:
+        r, k = m.shape
+        idx = np.arange(256, dtype=np.uint8)
+        hit = np.zeros((r * k, 256), dtype=np.uint8)
+        for j in range(r):
+            for i in range(k):
+                hit[j * k + i] = gf_mul(
+                    np.full(256, m[j, i], np.uint8), idx)
+        if len(_mul_table_cache) > 64:
+            _mul_table_cache.clear()
+        _mul_table_cache[key] = hit
+    return hit
+
+
+def gf_matmul_host(m: np.ndarray, d: np.ndarray) -> np.ndarray:
+    """Host GF(2^8) matmul through the native SIMD kernel when built
+    (AVX2/SSSE3 split-table shuffle — the isa-l/jerasure speed tier,
+    ceph_tpu/native/src/gf_simd.cc); numpy reference otherwise."""
+    from ceph_tpu import native
+
+    lib = native.get_lib()
+    if lib is None or not hasattr(lib, "ceph_tpu_gf_matmul_simd"):
+        return gf_matmul_ref(m, d)
+    import ctypes
+
+    m = np.asarray(m, dtype=np.uint8)
+    d = np.ascontiguousarray(d, dtype=np.uint8)
+    r, k = m.shape
+    s = d.shape[1]
+    tables = gf_mul_tables(m)
+    out = np.empty((r, s), dtype=np.uint8)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.ceph_tpu_gf_matmul_simd(
+        tables.ctypes.data_as(u8p), r, k,
+        d.ctypes.data_as(u8p), s, out.ctypes.data_as(u8p))
+    return out
+
+
 def gf_invert_matrix(a: np.ndarray) -> np.ndarray:
     """Invert a square GF(2^8) matrix by Gauss-Jordan elimination (host).
 
