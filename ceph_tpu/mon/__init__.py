@@ -86,6 +86,11 @@ class MonDaemon:
         for osd in range(num_osds):
             self.osdmap.osd_state[osd] &= ~CEPH_OSD_UP
         self._subscribers: List[Connection] = []
+        # encoded Incremental per epoch (MonitorDBStore osdmap log
+        # role): lets daemons replay the map stream epoch by epoch —
+        # interval detection requires seeing EVERY epoch in order
+        self._inc_log: Dict[int, bytes] = {}
+        self._inc_log_max = 1000
         # failure bookkeeping (OSDMonitor::failure_info_t)
         self._failure_reports: Dict[int, Dict[int, FailureReport]] = {}
         # laggy history for adaptive grace (osd_xinfo_t)
@@ -117,12 +122,25 @@ class MonDaemon:
     def _commit(self, inc: Incremental) -> None:
         """Apply an incremental and publish the new epoch (the Paxos
         commit point of the single-instance world)."""
+        raw = inc.encode()
         self.osdmap.apply_incremental(inc)
+        self._inc_log[inc.epoch] = raw
+        while len(self._inc_log) > self._inc_log_max:
+            del self._inc_log[min(self._inc_log)]
         self._publish()
 
     def _publish(self) -> None:
-        full = self.osdmap.encode()
-        msg = MOSDMapMsg(self.osdmap.epoch, full_map=full)
+        """Push the new epoch to subscribers as the committing
+        incremental alone — every subscriber (daemon or client) applies
+        epochs in order and pulls missing ranges with MGetMap on a gap,
+        so re-encoding and shipping the full map per commit would be
+        O(map x subscribers) of pure waste."""
+        epoch = self.osdmap.epoch
+        inc = self._inc_log.get(epoch)
+        if inc is not None:
+            msg = MOSDMapMsg(epoch, incrementals=[inc])
+        else:  # no incremental for this epoch: fall back to a full map
+            msg = MOSDMapMsg(epoch, full_map=self.osdmap.encode())
         for conn in list(self._subscribers):
             if conn.closed:
                 self._subscribers.remove(conn)
@@ -143,8 +161,18 @@ class MonDaemon:
         elif isinstance(msg, MGetMap):
             if msg.subscribe and conn not in self._subscribers:
                 self._subscribers.append(conn)
-            await conn.send(MOSDMapMsg(self.osdmap.epoch,
-                                       full_map=self.osdmap.encode()))
+            cur = self.osdmap.epoch
+            since = msg.since_epoch
+            if since and all(e in self._inc_log
+                             for e in range(since + 1, cur + 1)):
+                await conn.send(MOSDMapMsg(
+                    cur, incrementals=[self._inc_log[e]
+                                       for e in range(since + 1,
+                                                      cur + 1)]))
+            else:
+                await conn.send(MOSDMapMsg(
+                    cur, full_map=self.osdmap.encode(),
+                    gap_unfillable=bool(since)))
         elif isinstance(msg, MOSDFailure):
             self._handle_failure(msg)
         elif isinstance(msg, MMonCommand):
@@ -281,26 +309,30 @@ class MonDaemon:
             return 0, {"pool_id": self.osdmap.lookup_pool(name)}
         pg_num = int(cmd.get("pg_num", 32))
         pool_type = cmd.get("pool_type", "replicated")
-        # stage on a scratch copy so the committed map and the published
-        # pool agree on the epoch
+        # stage on a SCRATCH map, then commit the result through an
+        # Incremental like every other mutation: the change replays via
+        # apply_incremental on every daemon and lands in the inc log
+        scratch = OSDMap.decode(self.osdmap.encode())
         if pool_type == "erasure":
             profile_name = cmd.get("erasure_code_profile", "default")
             profile = self.osdmap.erasure_code_profiles.get(profile_name)
             if profile is None:
                 return -2, {"error": f"no profile {profile_name!r}"}
             codec = create_erasure_code(dict(profile))
-            ruleno = codec.create_rule(f"{name}_rule", self.osdmap.crush)
-            pool = self.osdmap.create_pool(
+            ruleno = codec.create_rule(f"{name}_rule", scratch.crush)
+            pool = scratch.create_pool(
                 name, type_=TYPE_ERASURE, size=codec.get_chunk_count(),
                 pg_num=pg_num, crush_rule=ruleno,
                 erasure_code_profile=profile_name)
         else:
             size = int(cmd.get("size", 3))
-            pool = self.osdmap.create_pool(
+            pool = scratch.create_pool(
                 name, type_=TYPE_REPLICATED, size=size, pg_num=pg_num)
-        # create_pool mutated the map in place; bump the epoch + publish
-        self.osdmap.epoch += 1
-        self._publish()
+        inc = Incremental(epoch=self.osdmap.epoch + 1)
+        inc.new_pools[pool.id] = pool
+        if pool_type == "erasure":
+            inc.new_crush = scratch.crush  # carries the new EC rule
+        self._commit(inc)
         return 0, {"pool_id": pool.id}
 
     def _cmd_osd_down(self, cmd) -> Tuple[int, Dict[str, Any]]:

@@ -196,20 +196,26 @@ class MOSDMapMsg(Message):
     TAG = 6
 
     def __init__(self, epoch: int, full_map: Optional[bytes] = None,
-                 incrementals: Optional[List[bytes]] = None):
+                 incrementals: Optional[List[bytes]] = None,
+                 gap_unfillable: bool = False):
         self.epoch = epoch
         self.full_map = full_map
         self.incrementals = incrementals or []
+        # mon could not supply the contiguous incremental range (log
+        # trimmed): the receiver must adopt the full map despite the
+        # epoch gap instead of re-requesting forever
+        self.gap_unfillable = gap_unfillable
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u32(self.epoch)
         enc.optional(self.full_map, Encoder.bytes)
         enc.list(self.incrementals, Encoder.bytes)
+        enc.bool(self.gap_unfillable)
 
     @classmethod
     def decode_payload(cls, dec: Decoder) -> "MOSDMapMsg":
         return cls(dec.u32(), dec.optional(Decoder.bytes),
-                   dec.list(Decoder.bytes))
+                   dec.list(Decoder.bytes), dec.bool())
 
 
 @register

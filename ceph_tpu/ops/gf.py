@@ -289,10 +289,20 @@ if HAVE_JAX:
         par = prod.astype(jnp.int32) & 1
         return _pack_bits(par)
 
-    def gf_matmul_tpu(m: np.ndarray, data):
-        """(R,K) GF(2^8) matrix x (..., K, S) uint8 chunks on TPU."""
+    def gf_matmul_device(m: np.ndarray, data):
+        """(R,K) GF(2^8) matrix x (..., K, S) uint8 through the fastest
+        device path: the packed-word xtime Pallas kernel on TPU
+        (ops/gf_pallas.py), the XLA bit-decomposition elsewhere."""
+        from ceph_tpu.ops import gf_pallas
+
+        if gf_pallas.supported(np.shape(data)):
+            return gf_pallas.gf_matmul_words_pallas(m, data)
         mbits = jnp.asarray(gf_matrix_to_bits(m))
         return gf2_matmul_bytes(mbits, jnp.asarray(data, dtype=jnp.uint8))
+
+    def gf_matmul_tpu(m: np.ndarray, data):
+        """(R,K) GF(2^8) matrix x (..., K, S) uint8 chunks on TPU."""
+        return gf_matmul_device(m, data)
 
     def gf_mul_jax(a, b):
         """Elementwise GF(2^8) product via log/antilog gathers (uint8 arrays)."""

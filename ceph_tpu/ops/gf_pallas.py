@@ -1,0 +1,146 @@
+"""Pallas TPU kernel for GF(2^8) matrix x data — the hot EC kernel.
+
+Two device formulations exist for `parity = M (*) data` over GF(2^8):
+
+1. Bit-decomposition on the MXU (gf.gf2_matmul_bytes): exact, but every
+   data byte must be unpacked into 8 one-bit lane elements before the
+   matmul.  Whether XLA materializes the expansion in HBM or a kernel
+   does it in VMEM, the VPU pays ~8 lane-ops per byte at one *bit* per
+   lane — measured ceiling ~19 GiB/s on a v5e regardless of tiling.
+
+2. This kernel: the xtime/XOR formulation on *packed words*.  Each int32
+   lane carries 4 data bytes.  Multiplying a whole row by x (aka xtime,
+   the GF(2^8) doubling step) is 6 bitwise lane-ops with all cross-byte
+   contamination masked off:
+
+       t   = v & 0x80808080        # bit 7 of every byte
+       u   = (v << 1) & 0xfefefefe # shift, drop cross-byte carry-in
+       out = u ^ ((t >> 7) * 0x1d) # reduce by p(x) = 0x11d per byte
+
+   A coefficient c then contributes XOR of the xtime-powers selected by
+   c's set bits.  The matrix is static at trace time, so the kernel
+   unrolls to straight-line VPU code: ~12 lane-ops per data byte at 4
+   bytes per lane — ~4x less VPU work than bit-decomposition, and HBM
+   sees only data-in + parity-out.
+
+The xtime identity is textbook GF(2^8) arithmetic (any AES or
+Reed-Solomon text); the reference's SIMD equivalents live in
+/root/reference/src/erasure-code/ (jerasure/gf-complete, isa-l).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Inner tile per data row: (TS, 128) int32 lanes = TS*512 data bytes.
+# At TS=32 a K=8 tile holds 128 KiB of data resident in VMEM.
+_TS = 32
+
+_M80 = int(0x80808080) - (1 << 32)  # as signed int32 literals
+_MFE = int(0xFEFEFEFE) - (1 << 32)
+
+
+def _xtime(v):
+    """Multiply every packed byte by x in GF(2^8)/0x11d (6 lane-ops).
+
+    The >>7 must be a LOGICAL shift: int32 arithmetic shift would smear
+    the sign across the top byte's reduction mask."""
+    t = v & jnp.int32(_M80)
+    u = (v << 1) & jnp.int32(_MFE)
+    hi = jax.lax.shift_right_logical(t, jnp.int32(7))
+    return u ^ (hi * jnp.int32(0x1D))
+
+
+def _kernel(d_ref, out_ref, *, coeffs, k: int, r: int):
+    """One (batch, column tile): acc_j = XOR_i c_ji (*) d_i, unrolled.
+
+    coeffs is a static (r, k) tuple-of-tuples of python ints, so the
+    double loop below unrolls at trace time into pure vector code.
+    Every array the VPU touches is (TS, 128) — full sublane x lane
+    tiles; per-row slices of a (K, T) layout would run at 1/8 VPU
+    utilization."""
+    v = d_ref[0]                      # (K, TS, 128) int32, 4 bytes/lane
+    acc = [None] * r
+    u = [v[i] for i in range(k)]      # K x (TS, 128)
+    for s in range(8):                # xtime power s of every input row
+        for j in range(r):
+            for i in range(k):
+                if (coeffs[j][i] >> s) & 1:
+                    acc[j] = u[i] if acc[j] is None else acc[j] ^ u[i]
+        if s != 7:
+            u = [_xtime(x) for x in u]
+    zero = jnp.zeros_like(v[0])
+    out_ref[0] = jnp.stack(
+        [a if a is not None else zero for a in acc])
+
+
+@functools.partial(jax.jit, static_argnames=("coeffs", "ts"))
+def _matmul_words(d4, coeffs, ts: int):
+    r, k = len(coeffs), len(coeffs[0])
+    g = d4.shape[0]
+    kern = functools.partial(_kernel, coeffs=coeffs, k=k, r=r)
+    return pl.pallas_call(
+        kern,
+        grid=(g,),
+        in_specs=[
+            pl.BlockSpec((1, k, ts, 128),
+                         lambda gi: (gi, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, r, ts, 128),
+                               lambda gi: (gi, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((g, r, ts, 128), jnp.int32),
+    )(d4)
+
+
+def supported(data_shape) -> bool:
+    """Handles (..., K, S) uint8 with S a multiple of 2048 on a TPU
+    backend (2048 bytes = one (4, 128) int32 tile row minimum)."""
+    try:
+        if jax.devices()[0].platform != "tpu":
+            return False
+    except Exception:
+        return False
+    s = data_shape[-1]
+    return s % 2048 == 0 and s > 0
+
+
+def gf_matmul_words_pallas(matrix: np.ndarray, data):
+    """matrix (R,K) uint8 x data (..., K, S) uint8 -> (..., R, S) uint8
+    via the packed-word xtime kernel.  data may be a device array."""
+    m = np.asarray(matrix, dtype=np.uint8)
+    r, k = m.shape
+    coeffs = tuple(tuple(int(c) for c in row) for row in m)
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    squeeze = data.ndim == 2
+    if squeeze:
+        data = data[None]
+    lead = data.shape[:-2]
+    b = int(np.prod(lead)) if lead else 1
+    s = data.shape[-1]
+    s4 = s // 4
+    ts = _TS
+    while ts > 4 and s4 % (ts * 128):
+        ts //= 2
+    nt = s4 // (ts * 128)
+    # grid = (b*nt,): fold batch and column tiles into one axis so every
+    # block is a plain 4-D (1, K, TS, 128) — the transpose that brings K
+    # next to the tile is one extra device pass, far cheaper than the
+    # expansion it replaces
+    d5 = jax.lax.bitcast_convert_type(
+        data.reshape(b, k, s4, 4), jnp.int32).reshape(
+        b, k, nt, ts, 128)
+    d4 = jnp.moveaxis(d5, 2, 1).reshape(b * nt, k, ts, 128)
+    out4 = _matmul_words(d4, coeffs, ts)
+    out = jnp.moveaxis(out4.reshape(b, nt, r, ts, 128), 1, 2)
+    out = jax.lax.bitcast_convert_type(
+        out.reshape(b, r, s4), jnp.uint8).reshape(*lead, r, s)
+    return out[0] if squeeze else out

@@ -10,6 +10,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import logging
 import os
 import sys
 
@@ -18,6 +19,8 @@ from ceph_tpu.osd.daemon import OSDDaemon
 
 
 async def _main() -> None:
+    if os.environ.get("CEPH_TPU_DEBUG"):
+        logging.basicConfig(level=logging.DEBUG)
     ap = argparse.ArgumentParser()
     ap.add_argument("--id", type=int, required=True)
     ap.add_argument("--mon", type=str, required=True)

@@ -29,6 +29,7 @@ else is host control-plane.
 from __future__ import annotations
 
 import asyncio
+import bisect
 import json
 import logging
 import time
@@ -81,6 +82,7 @@ EINVAL = -22
 DEFAULTS = {
     "osd_heartbeat_interval": 1.0,
     "osd_heartbeat_grace": 4.0,
+    "osd_heartbeat_max_peers": 10,
     "osd_sub_op_timeout": 5.0,
     "osd_min_pg_log_entries": 100,
     "osd_pool_erasure_code_stripe_unit": 4096,
@@ -259,26 +261,78 @@ class OSDDaemon:
     # -- map handling ------------------------------------------------------
 
     def _handle_map(self, msg: MOSDMapMsg) -> None:
-        if msg.full_map is None:
+        """Advance the local map EPOCH BY EPOCH.
+
+        Interval detection (_scan_pgs) is only correct if every epoch is
+        observed in order: a skipped epoch can hide a primary change, so
+        a daemon would keep writing under an interval its replicas have
+        already fenced off.  Incrementals apply contiguously; a gap
+        triggers a pull of the missing range from the mon (the
+        handle_osd_map / osdmap subscribe discipline, OSD.cc)."""
+        from ceph_tpu.osd.osdmap import Incremental
+
+        applied = False
+        if msg.incrementals and self.osdmap is not None:
+            for raw in msg.incrementals:
+                inc = Incremental.decode(raw)
+                if inc.epoch <= self.osdmap.epoch:
+                    continue
+                if inc.epoch != self.osdmap.epoch + 1:
+                    log.debug("osd.%d: inc %d does not follow %d,"
+                              " pulling range", self.osd_id, inc.epoch,
+                              self.osdmap.epoch)
+                    self._request_map_range()
+                    return
+                prev_up = set(self.osdmap.get_up_osds())
+                self.osdmap.apply_incremental(inc)
+                log.debug("osd.%d: advanced to epoch %d (inc)",
+                          self.osd_id, self.osdmap.epoch)
+                self._post_map_epoch(prev_up)
+                applied = True
+        if applied or msg.full_map is None:
             return
         newmap = OSDMap.decode(msg.full_map)
         if self.osdmap is not None and newmap.epoch <= self.osdmap.epoch:
             return
+        if self.osdmap is not None and \
+                newmap.epoch > self.osdmap.epoch + 1 and \
+                not msg.gap_unfillable:
+            self._request_map_range()
+            return
+        prev_up = set(self.osdmap.get_up_osds()) \
+            if self.osdmap is not None else set()
+        if self.osdmap is not None and msg.gap_unfillable:
+            log.warning("osd.%d: adopting full map %d over a gap from"
+                        " %d (mon inc log trimmed)", self.osd_id,
+                        newmap.epoch, self.osdmap.epoch)
+        self.osdmap = newmap
+        self._post_map_epoch(prev_up)
+
+    def _request_map_range(self) -> None:
+        """Pull the incrementals between my epoch and the mon's."""
+        now = time.monotonic()
+        if now - getattr(self, "_last_range_req", 0.0) < 0.2:
+            return
+        self._last_range_req = now
+        self.msgr._spawn(self.msgr.send_to(
+            self.mon_addr,
+            MGetMap(since_epoch=self.osdmap.epoch, subscribe=False)))
+
+    def _post_map_epoch(self, prev_up: Set[int]) -> None:
+        """Per-epoch bookkeeping after the local map advanced."""
         # reset the heartbeat clock for peers that just came (back) up:
         # their last_rx predates the outage and would otherwise make us
         # insta-report the freshly booted peer as failed again
         # (maybe_update_heartbeat_peers role, OSD.cc)
         now = time.monotonic()
-        prev = self.osdmap
-        for osd in newmap.get_up_osds():
-            if prev is None or not prev.is_up(osd):
+        for osd in self.osdmap.get_up_osds():
+            if osd not in prev_up:
                 self._hb_last_rx[osd] = now
-        self.osdmap = newmap
         self._map_event.set()
         self._map_event = asyncio.Event()
         # falsely marked down while alive: re-boot (MOSDAlive role)
-        if not newmap.is_up(self.osd_id) and not self._stopping and \
-                self.msgr.addr and \
+        if not self.osdmap.is_up(self.osd_id) and not self._stopping \
+                and self.msgr.addr and \
                 time.monotonic() - self._last_boot_sent > 1.0:
             self._last_boot_sent = time.monotonic()
             self.msgr._spawn(self.msgr.send_to(
@@ -295,20 +349,21 @@ class OSDDaemon:
                 in_acting = self.osd_id in [
                     o for o in acting if o != CRUSH_ITEM_NONE]
                 state = self.pgs.get(pg)
-                if not in_acting:
-                    if state is not None:
-                        state.state = "inactive"
-                        state.active_event.clear()
-                        # a demoted member's in-flight peering must not
-                        # keep pushing logs under the old interval
-                        if state.peering_task is not None:
-                            state.peering_task.cancel()
-                            state.peering_task = None
-                    continue
                 if state is None:
+                    if not in_acting:
+                        continue
                     state = PGState(pg)
                     self.pgs[pg] = state
                 if state.acting != acting or state.primary != primary:
+                    # every member records EVERY membership change —
+                    # including intervals it is not part of.  Skipping
+                    # the not-in-acting epochs would make a member that
+                    # leaves and rejoins with identical membership see
+                    # "no change" and keep an interval stamp its peers
+                    # have long fenced off.  Deterministic because
+                    # _handle_map advances epoch by epoch, so all
+                    # daemons observe the same acting-change epochs
+                    # (same_interval_since discipline).
                     state.acting = acting
                     state.primary = primary
                     state.interval_epoch = self.osdmap.epoch
@@ -317,6 +372,13 @@ class OSDDaemon:
                     if state.peering_task is not None:
                         state.peering_task.cancel()
                         state.peering_task = None
+                if not in_acting:
+                    state.state = "inactive"
+                    state.active_event.clear()
+                    if state.peering_task is not None:
+                        state.peering_task.cancel()
+                        state.peering_task = None
+                    continue
                 if primary == self.osd_id and state.peering_task is None \
                         and (state.state == "inactive" or
                              (state.state == "active" and state.unfound)):
@@ -341,6 +403,35 @@ class OSDDaemon:
     def _epoch(self) -> int:
         return self.osdmap.epoch if self.osdmap is not None else 0
 
+    def _heartbeat_peers(self) -> Set[int]:
+        """Bounded peer set (OSD.cc maybe_update_heartbeat_peers role):
+        OSDs sharing a PG with me, plus my ring neighbors in the sorted
+        up set so detection coverage stays connected, capped at
+        osd_heartbeat_max_peers.  The full N x N mesh is quadratic
+        traffic and saturates loops past ~8 daemons."""
+        pg_peers: Set[int] = set()
+        for state in self.pgs.values():
+            for osd in state.acting:
+                if osd != CRUSH_ITEM_NONE and osd != self.osd_id:
+                    pg_peers.add(osd)
+        ring: Set[int] = set()
+        up = [o for o in self.osdmap.get_up_osds() if o != self.osd_id]
+        if up:
+            # ring neighbors by rank around my id
+            pos = bisect.bisect_left(up, self.osd_id)
+            ring.add(up[pos % len(up)])
+            ring.add(up[(pos - 1) % len(up)])
+        cap = int(self.config.get("osd_heartbeat_max_peers", 10))
+        pg_peers = {p for p in pg_peers
+                    if self.osdmap.is_up(p) and p not in ring}
+        # the cap trims only the PG-peer overflow — ring neighbors are
+        # the connectedness guarantee (a naive global sort-and-truncate
+        # would leave the highest-id OSDs unmonitored by everyone)
+        keep = max(0, cap - len(ring))
+        if len(pg_peers) > keep:
+            pg_peers = set(sorted(pg_peers)[:keep])
+        return ring | pg_peers
+
     async def _heartbeat_loop(self) -> None:
         interval = self.config["osd_heartbeat_interval"]
         grace = self.config["osd_heartbeat_grace"]
@@ -349,12 +440,15 @@ class OSDDaemon:
             if self.osdmap is None:
                 continue
             now = time.monotonic()
-            for peer in self.osdmap.get_up_osds():
-                if peer == self.osd_id:
-                    continue
+            peers = self._heartbeat_peers()
+            # prune state for ex-peers so a later re-add restarts fresh
+            for gone in set(self._hb_last_rx) - peers:
+                self._hb_last_rx.pop(gone, None)
+
+            async def ping_one(peer: int) -> None:
                 addr = self.osdmap.osd_addrs.get(peer)
                 if addr is None:
-                    continue
+                    return
                 self._hb_last_rx.setdefault(peer, now)
                 try:
                     await self.msgr.send_to(
@@ -372,6 +466,8 @@ class OSDDaemon:
                                         self._epoch()))
                     except (ConnectionError, OSError):
                         pass
+
+            await asyncio.gather(*(ping_one(p) for p in peers))
 
     # -- local shard store helpers -----------------------------------------
 
@@ -435,6 +531,9 @@ class OSDDaemon:
         state = self.pgs.get(msg.pg)
         # fencing: a primary from an older interval must not mutate
         if state is not None and msg.epoch < state.interval_epoch:
+            log.debug("osd.%d: sub-write %s/%s fenced: epoch %d <"
+                      " interval %d", self.osd_id, msg.pg, msg.oid,
+                      msg.epoch, state.interval_epoch)
             await conn.send(MOSDSubWriteReply(msg.tid, ESTALE, msg.shard))
             return
         if state is not None:
@@ -579,9 +678,13 @@ class OSDDaemon:
                 else:
                     shard_key = shard
                 tid = self._next_tid()
+                # the query carries the INTERVAL epoch, not the live
+                # one: replies to it are the interval barrier, and
+                # sub-writes of this interval are stamped with the same
+                # value so they pass the fence the barrier establishes
                 reply = await self._request(
-                    osd, MPGQuery(tid, pg, self._epoch(), self.osd_id),
-                    tid)
+                    osd, MPGQuery(tid, pg, state.interval_epoch,
+                                  self.osd_id), tid)
                 if reply is None or reply.pg != pg:
                     continue
                 from ceph_tpu.osd.pg_log import PGInfo
@@ -622,7 +725,7 @@ class OSDDaemon:
                 reply = await self._request(
                     osd, MPGLogMsg(tid, pg, shard, auth_wire_info,
                                    list(plog.entries),
-                                   epoch=self._epoch(),
+                                   epoch=state.interval_epoch,
                                    from_osd=self.osd_id), tid)
                 if reply is None or reply.pg != pg:
                     continue
@@ -856,11 +959,14 @@ class OSDDaemon:
             for shard_key, osd in targets:
                 shard = shard_key if shard_key >= -1 else -1
                 tid = self._next_tid()
+                # recovery ops carry the INTERVAL epoch: a live-epoch
+                # stamp would raise replica fences above this interval
+                # and fence out every subsequent client write
                 await self._request(
                     osd, MOSDSubWrite(tid, pg, shard, oid,
                                       [ShardOp("remove")],
-                                      self._epoch(), None, self.osd_id),
-                    tid)
+                                      state.interval_epoch, None,
+                                      self.osd_id), tid)
             if i_need:
                 t = Transaction()
                 cid = self._cid(pg, my_shard)
@@ -927,8 +1033,8 @@ class OSDDaemon:
                 tid = self._next_tid()
                 await self._request(
                     osd, MOSDSubWrite(tid, pg, shard, oid, ops,
-                                      self._epoch(), None, self.osd_id),
-                    tid)
+                                      state.interval_epoch, None,
+                                      self.osd_id), tid)
 
         if i_need:
             await install(my_shard, self.osd_id)
@@ -945,7 +1051,10 @@ class OSDDaemon:
             return
         pool = self.osdmap.pools.get(msg.pg.pool)
         state = self.pgs.get(msg.pg)
-        acting, primary = self.osdmap.pg_to_acting_osds(msg.pg)
+        # placement comes from the PGState cache maintained per epoch by
+        # _scan_pgs — recomputing CRUSH per op costs ~ms in the host
+        # mapper and is pure waste (the reference's PG lookup is a map)
+        primary = state.primary if state is not None else -1
         if pool is None or primary != self.osd_id or state is None:
             await conn.send(MOSDOpReply(
                 msg.tid, EAGAIN, replay_epoch=self._epoch()))
@@ -1048,9 +1157,15 @@ class OSDDaemon:
         # writing, incl. the local shard apply
         if self._epoch() < state.interval_epoch or \
                 admit_epoch < state.interval_epoch:
+            log.debug("osd.%d: write %s/%s fenced: admit %d, epoch %d,"
+                      " interval %d", self.osd_id, pg, oid, admit_epoch,
+                      self._epoch(), state.interval_epoch)
             return EAGAIN
         targets = self._up_shard_targets(state, pool)
         if len(targets) < self._min_size(pool):
+            log.debug("osd.%d: write %s/%s: %d up targets < min_size %d",
+                      self.osd_id, pg, oid, len(targets),
+                      self._min_size(pool))
             return EAGAIN
         plog = self._load_log(state, pool)
         pending = []
@@ -1084,6 +1199,10 @@ class OSDDaemon:
         acked = 1 + sum(1 for r in replies
                         if r is not None and r.rc == 0)
         if acked < self._min_size(pool):
+            log.debug("osd.%d: write %s/%s: %d acks < min_size %d"
+                      " (rcs=%s)", self.osd_id, pg, oid, acked,
+                      self._min_size(pool),
+                      [None if r is None else r.rc for r in replies])
             return EAGAIN
         if entry is not None and acked == len(
                 [s for s, _o in targets if shard_ops.get(s) is not None]):

@@ -565,6 +565,82 @@ class Incremental:
     old_pg_upmap_items: List[PgId] = field(default_factory=list)
     new_crush: Optional[CrushMap] = None
 
+    def encode(self) -> bytes:
+        """Wire codec (OSDMap::Incremental encode role) — lets the mon
+        keep an incremental log and daemons replay the map stream epoch
+        by epoch (interval detection depends on seeing EVERY epoch)."""
+        import json as _json
+
+        from ceph_tpu.crush.serialize import to_json
+
+        enc = Encoder()
+        enc.start(1, 1)
+        enc.u32(self.epoch)
+        enc.optional(self.new_max_osd, Encoder.u32)
+        enc.optional(self.new_flags, Encoder.u64)
+        enc.u32(len(self.new_pools))
+        for pool in self.new_pools.values():
+            pool.encode(enc)
+        enc.list(self.old_pools, Encoder.s64)
+        enc.map(self.new_erasure_code_profiles, Encoder.string,
+                lambda e, p: e.map(p, Encoder.string, Encoder.string))
+        enc.list(self.old_erasure_code_profiles, Encoder.string)
+        enc.map(self.new_up_osds, Encoder.s32, Encoder.string)
+        enc.map(self.new_state, Encoder.s32, Encoder.u32)
+        enc.map(self.new_weight, Encoder.s32, Encoder.u32)
+        enc.map(self.new_pg_temp, _enc_pg,
+                lambda e, v: e.list(v, Encoder.s32))
+        enc.map(self.new_primary_temp, _enc_pg, Encoder.s32)
+        enc.map(self.new_pg_upmap, _enc_pg,
+                lambda e, v: e.list(v, Encoder.s32))
+        enc.list(self.old_pg_upmap, _enc_pg)
+        enc.map(self.new_pg_upmap_items, _enc_pg,
+                lambda e, v: e.list(
+                    v, lambda e2, p: (e2.s32(p[0]), e2.s32(p[1]))))
+        enc.list(self.old_pg_upmap_items, _enc_pg)
+        enc.optional(self.new_crush,
+                     lambda e, c: e.bytes(
+                         _json.dumps(to_json(c)).encode()))
+        enc.finish()
+        return enc.to_bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Incremental":
+        import json as _json
+
+        from ceph_tpu.crush.serialize import from_json
+
+        dec = Decoder(data)
+        dec.start(1)
+        inc = cls(epoch=dec.u32())
+        inc.new_max_osd = dec.optional(Decoder.u32)
+        inc.new_flags = dec.optional(Decoder.u64)
+        for _ in range(dec.u32()):
+            pool = PgPool.decode(dec)
+            inc.new_pools[pool.id] = pool
+        inc.old_pools = dec.list(Decoder.s64)
+        inc.new_erasure_code_profiles = dec.map(
+            Decoder.string,
+            lambda d: d.map(Decoder.string, Decoder.string))
+        inc.old_erasure_code_profiles = dec.list(Decoder.string)
+        inc.new_up_osds = dec.map(Decoder.s32, Decoder.string)
+        inc.new_state = dec.map(Decoder.s32, Decoder.u32)
+        inc.new_weight = dec.map(Decoder.s32, Decoder.u32)
+        inc.new_pg_temp = dec.map(_dec_pg,
+                                  lambda d: d.list(Decoder.s32))
+        inc.new_primary_temp = dec.map(_dec_pg, Decoder.s32)
+        inc.new_pg_upmap = dec.map(_dec_pg,
+                                   lambda d: d.list(Decoder.s32))
+        inc.old_pg_upmap = dec.list(_dec_pg)
+        inc.new_pg_upmap_items = dec.map(
+            _dec_pg, lambda d: d.list(lambda d2: (d2.s32(), d2.s32())))
+        inc.old_pg_upmap_items = dec.list(_dec_pg)
+        raw = dec.optional(Decoder.bytes)
+        if raw is not None:
+            inc.new_crush = from_json(_json.loads(raw))
+        dec.finish()
+        return inc
+
 
 class OSDMapMapping:
     """Bulk whole-map placement (OSDMapMapping + ParallelPGMapper).

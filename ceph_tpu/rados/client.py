@@ -57,6 +57,7 @@ class RadosClient:
         self._tid = 0
         self._futures: Dict[int, asyncio.Future] = {}
         self._map_waiters: List[asyncio.Event] = []
+        self._placement_cache: Dict[Tuple[int, PgId], int] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -77,22 +78,61 @@ class RadosClient:
 
     async def _dispatch(self, conn: Connection, msg: Message) -> None:
         if isinstance(msg, MOSDMapMsg):
-            if msg.full_map is not None:
-                newmap = OSDMap.decode(msg.full_map)
-                if self.osdmap is None or \
-                        newmap.epoch > self.osdmap.epoch:
-                    self.osdmap = newmap
-                    for event in self._map_waiters:
-                        event.set()
-                    self._map_waiters.clear()
+            if self._advance_map(msg):
+                for event in self._map_waiters:
+                    event.set()
+                self._map_waiters.clear()
         elif isinstance(msg, (MOSDOpReply, MMonCommandReply)):
             fut = self._futures.pop(msg.tid, None)
             if fut is not None and not fut.done():
                 fut.set_result(msg)
 
+    def _advance_map(self, msg: MOSDMapMsg) -> bool:
+        """Advance the local map from a publish: contiguous
+        incrementals apply directly; a gap (or a fresh client) falls
+        back to the full map or a refresh pull."""
+        from ceph_tpu.osd.osdmap import Incremental
+
+        advanced = False
+        if msg.incrementals and self.osdmap is not None:
+            for raw in msg.incrementals:
+                inc = Incremental.decode(raw)
+                if inc.epoch <= self.osdmap.epoch:
+                    continue
+                if inc.epoch != self.osdmap.epoch + 1:
+                    break  # gap: handled below
+                self.osdmap.apply_incremental(inc)
+                advanced = True
+            if advanced and msg.epoch <= self.osdmap.epoch:
+                return True
+        if msg.full_map is not None:
+            newmap = OSDMap.decode(msg.full_map)
+            if self.osdmap is None or newmap.epoch > self.osdmap.epoch:
+                self.osdmap = newmap
+                return True
+            return advanced
+        if self.osdmap is not None and msg.epoch > self.osdmap.epoch:
+            # inc-only publish we could not apply: pull a fresh map
+            self.msgr._spawn(self.refresh_map())
+        return advanced
+
     def _next_tid(self) -> int:
         self._tid += 1
         return self._tid
+
+    def _primary_cached(self, osdmap: OSDMap, pg: PgId) -> int:
+        """Placement memoized per (epoch, pg): the host CRUSH mapper
+        costs milliseconds per PG and the answer is a pure function of
+        the map (Objecter keeps the same cache implicitly in its
+        session targets)."""
+        key = (osdmap.epoch, pg)
+        hit = self._placement_cache.get(key)
+        if hit is None:
+            _acting, hit = osdmap.pg_to_acting_osds(pg)
+            if len(self._placement_cache) > 4096:
+                self._placement_cache.clear()
+            self._placement_cache[key] = hit
+        return hit
 
     async def wait_for_new_map(self, timeout: float = 5.0) -> None:
         event = asyncio.Event()
@@ -188,7 +228,7 @@ class IoCtx:
         last_error: Optional[Exception] = None
         for attempt in range(client.max_retries):
             osdmap = client.osdmap
-            _acting, primary = osdmap.pg_to_acting_osds(pg)
+            primary = client._primary_cached(osdmap, pg)
             addr = osdmap.osd_addrs.get(primary, None) \
                 if primary >= 0 else None
             if addr is None or not osdmap.is_up(primary):
@@ -214,8 +254,13 @@ class IoCtx:
                 await client.refresh_map()
                 continue
             if reply.rc == EAGAIN:
-                # wrong/new primary or pg not active: wait for progress
+                # wrong/new primary or pg not active: wait for progress.
+                # The floor sleep matters: during bring-up/peering churn
+                # maps arrive continuously, and without it the retry
+                # budget burns in milliseconds while PGs are still
+                # peering (Objecter's backoff discipline).
                 await client.wait_for_new_map(0.5)
+                await asyncio.sleep(0.15)
                 continue
             return reply
         raise RadosError(EAGAIN, f"op on {oid!r} exhausted retries"

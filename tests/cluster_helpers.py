@@ -38,6 +38,11 @@ class Cluster:
         self.num_osds = num_osds
         self.osds_per_host = osds_per_host
         self.osd_config = dict(FAST_CONFIG)
+        if num_osds > 8:
+            # one shared event loop: scale grace with daemon count so
+            # scheduling jitter can't masquerade as failures
+            self.osd_config["osd_heartbeat_interval"] = 0.5
+            self.osd_config["osd_heartbeat_grace"] = 3.0
         self.osd_config.update(osd_config or {})
         self.mon_config = dict(FAST_MON_CONFIG)
         self.mon_config.update(mon_config or {})
