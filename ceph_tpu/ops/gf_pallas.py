@@ -103,7 +103,14 @@ def _matmul_words(d4, coeffs, ts: int):
 
 def supported(data_shape) -> bool:
     """Handles (..., K, S) uint8 with S a multiple of 2048 on a TPU
-    backend (2048 bytes = one (4, 128) int32 tile row minimum)."""
+    backend (2048 bytes = one (4, 128) int32 tile row minimum).
+
+    Gated by CEPH_TPU_PALLAS until validated on real TPU hardware (set
+    CEPH_TPU_PALLAS=0 to force the XLA path)."""
+    import os
+
+    if os.environ.get("CEPH_TPU_PALLAS", "0") != "1":
+        return False
     try:
         if jax.devices()[0].platform != "tpu":
             return False
