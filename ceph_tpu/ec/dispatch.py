@@ -22,7 +22,13 @@ def gf_matmul(mat: np.ndarray, data: np.ndarray, use_tpu: bool,
         return np.asarray(gf.gf_matmul_tpu(mat, data))
     if data.ndim == 2:
         return gf.gf_matmul_host(mat, data)
-    return np.stack([gf.gf_matmul_host(mat, d) for d in data])
+    # batched host path: the GF matmul is elementwise across columns, so
+    # B stripes fold into ONE wide (K, B*S) region op — per-stripe calls
+    # would pay kernel setup B times for tiny regions
+    b, k, s = data.shape
+    flat = np.ascontiguousarray(np.moveaxis(data, 1, 0)).reshape(k, b * s)
+    par = gf.gf_matmul_host(mat, flat)
+    return np.moveaxis(par.reshape(-1, b, s), 0, 1)
 
 
 class LruCache:

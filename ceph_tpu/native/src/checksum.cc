@@ -81,8 +81,7 @@ uint32_t ceph_tpu_crc32c_zeros(uint32_t crc, uint64_t len) {
   return crc;
 }
 
-uint32_t ceph_tpu_crc32c(uint32_t crc, const uint8_t *data, uint64_t len) {
-  if (data == nullptr) return ceph_tpu_crc32c_zeros(crc, len);
+static uint32_t crc32c_sw(uint32_t crc, const uint8_t *data, uint64_t len) {
   while (len && (reinterpret_cast<uintptr_t>(data) & 7)) {
     crc = crc_table[0][(crc ^ *data++) & 0xff] ^ (crc >> 8);
     len--;
@@ -100,6 +99,40 @@ uint32_t ceph_tpu_crc32c(uint32_t crc, const uint8_t *data, uint64_t len) {
   }
   while (len--) crc = crc_table[0][(crc ^ *data++) & 0xff] ^ (crc >> 8);
   return crc;
+}
+
+#if defined(__x86_64__)
+// Hardware CRC32C (the SSE4.2 crc32 instruction computes exactly the
+// Castagnoli reflected CRC) — the crc32c_intel_fast role
+// (/root/reference/src/common/crc32c_intel_fast.c); ~10x the
+// slicing-by-8 tables.
+__attribute__((target("sse4.2")))
+static uint32_t crc32c_hw(uint32_t crc, const uint8_t *data, uint64_t len) {
+  uint64_t c = crc;
+  while (len >= 8) {
+    uint64_t w;
+    std::memcpy(&w, data, 8);
+    c = __builtin_ia32_crc32di(c, w);
+    data += 8;
+    len -= 8;
+  }
+  uint32_t c32 = static_cast<uint32_t>(c);
+  while (len--) c32 = __builtin_ia32_crc32qi(c32, *data++);
+  return c32;
+}
+
+static bool have_sse42() {
+  static const bool ok = __builtin_cpu_supports("sse4.2");
+  return ok;
+}
+#endif
+
+uint32_t ceph_tpu_crc32c(uint32_t crc, const uint8_t *data, uint64_t len) {
+  if (data == nullptr) return ceph_tpu_crc32c_zeros(crc, len);
+#if defined(__x86_64__)
+  if (have_sse42()) return crc32c_hw(crc, data, len);
+#endif
+  return crc32c_sw(crc, data, len);
 }
 
 // Per-block crc32c over a contiguous buffer of nblocks x block_size bytes

@@ -115,15 +115,20 @@ def encode(sinfo: StripeInfo, ec_impl, data: bytes,
 
     if hasattr(ec_impl, "encode_batch") and not ec_impl.get_chunk_mapping():
         arr = np.frombuffer(data, dtype=np.uint8).reshape(n_stripes, k, chunk)
-        parity = ec_impl.encode_batch(arr)           # (B, m, chunk)
+        # shard-STREAM layout: one contiguous transpose up front, then
+        # every downstream step (the matmul, the per-shard bytes) works
+        # on contiguous rows — per-stripe dispatch and strided copies
+        # both cost more than the whole encode
+        streams = np.ascontiguousarray(
+            np.moveaxis(arr, 1, 0)).reshape(k, n_stripes * chunk)
+        parity = ec_impl.encode_batch(streams[None])[0]  # (m, B*chunk)
         for i in range(n):
             if i not in want:
                 continue
             if i < k:
-                out[i] = arr[:, i, :].tobytes()
+                out[i] = streams[i].tobytes()
             else:
-                out[i] = np.ascontiguousarray(
-                    parity[:, i - k, :]).tobytes()
+                out[i] = np.ascontiguousarray(parity[i - k]).tobytes()
         return out
 
     # generic path: per-stripe through the interface (array codes, mappings)

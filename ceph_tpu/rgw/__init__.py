@@ -1,0 +1,24 @@
+"""RGW-lite: the S3-gateway role over networked RADOS.
+
+Reference parity: the RGW data path — RGWPutObj::execute
+(/root/reference/src/rgw/rgw_op.cc:3712) feeding the put-object
+processor pipeline (rgw_putobj_processor.h:73-211: HeadObjectProcessor
+-> ChunkProcessor -> StripeProcessor -> RadosWriter), multipart uploads
+(MultipartObjectProcessor rgw_putobj_processor.h:211) and the
+CompleteMultipart manifest stitch (rgw_op.cc:5933
+RGWCompleteMultipart::execute).
+
+Re-designed for this stack: asyncio end to end, JSON manifests/indexes
+(the versioned-encoding discipline of the repo), bounded-concurrency
+stripe writes over the Objecter-role client (the Aio throttle role), and
+erasure-coded data pools whose encode path batches onto the TPU through
+the shared ec_jax codec.  No HTTP frontend yet — the S3 op surface is
+the API of RGWLite (gateway.py); a beast/asio-role frontend can wrap it.
+"""
+
+from ceph_tpu.rgw.gateway import RGWLite, RGWError  # noqa: F401
+from ceph_tpu.rgw.put_processor import (  # noqa: F401
+    Manifest,
+    PutObjProcessor,
+    StripeWriter,
+)

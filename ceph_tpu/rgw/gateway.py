@@ -1,0 +1,260 @@
+"""RGWLite: the S3 op surface (bucket/object/multipart) over RADOS.
+
+Reference parity:
+- RGWPutObj::execute (/root/reference/src/rgw/rgw_op.cc:3712) — atomic
+  object PUT through the processor pipeline, head object carrying the
+  manifest (AtomicObjectProcessor, rgw_putobj_processor.h:173).
+- Multipart: init (RGWInitMultipart rgw_op.cc:5778), per-part upload
+  (MultipartObjectProcessor rgw_putobj_processor.h:211 — parts live in
+  `_multipart_<key>.<upload_id>.<num>` objects), complete
+  (RGWCompleteMultipart rgw_op.cc:5933 — part manifests stitched in
+  part order, multipart ETag = hash-of-hashes "-<nparts>").
+- Bucket index: cls_rgw omap entries in the reference; here a JSON
+  index object per bucket (the omap op surface is a separate
+  milestone), updated read-modify-write.
+
+Data placement: object data goes to the bucket's DATA pool (typically
+erasure-coded — BASELINE #5 uses EC 8+3); index/meta JSON docs go to a
+replicated META pool, mirroring the reference's pool split
+(default.rgw.buckets.data vs .index/.meta).
+
+ETags are hex crc32c of content (the repo's checksum tier) rather than
+MD5 — same uniqueness role, honest about not being S3-MD5-compatible.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ceph_tpu.ops import checksum as cks
+from ceph_tpu.rgw.put_processor import (
+    DEFAULT_STRIPE_SIZE,
+    Manifest,
+    PutObjProcessor,
+    StripeWriter,
+)
+
+MULTIPART_PREFIX = "_multipart_"
+
+
+class RGWError(Exception):
+    def __init__(self, code: str, what: str = ""):
+        super().__init__(f"{code}: {what}")
+        self.code = code
+
+
+def _etag(data: bytes) -> str:
+    return format(cks.crc32c(0xFFFFFFFF, data), "08x")
+
+
+class RGWLite:
+    """One gateway instance over a connected RadosClient."""
+
+    def __init__(self, client, data_pool: str, meta_pool: str,
+                 stripe_size: int = DEFAULT_STRIPE_SIZE,
+                 aio_window: int = 8):
+        self.client = client
+        self.data = client.open_ioctx(data_pool)
+        self.meta = client.open_ioctx(meta_pool)
+        self.stripe_size = stripe_size
+        self.aio_window = aio_window
+        self._uploads = 0
+
+    # -- meta-doc helpers (JSON docs in the meta pool) ---------------------
+
+    async def _load(self, oid: str) -> Optional[Dict]:
+        try:
+            raw = await self.meta.read(oid)
+        except Exception:
+            return None
+        return json.loads(raw.decode())
+
+    async def _store(self, oid: str, doc: Dict) -> None:
+        await self.meta.write_full(oid, json.dumps(doc).encode())
+
+    @staticmethod
+    def _bucket_oid(bucket: str) -> str:
+        return f"bucket.index.{bucket}"
+
+    @staticmethod
+    def _upload_oid(bucket: str, key: str, upload_id: str) -> str:
+        return f"multipart.{bucket}.{key}.{upload_id}"
+
+    def _head_oid(self, bucket: str, key: str) -> str:
+        return f"{bucket}/{key}"
+
+    # -- buckets -----------------------------------------------------------
+
+    async def create_bucket(self, bucket: str) -> None:
+        if await self._load(self._bucket_oid(bucket)) is not None:
+            raise RGWError("BucketAlreadyExists", bucket)
+        await self._store(self._bucket_oid(bucket),
+                          {"name": bucket, "objects": {}})
+
+    async def _bucket(self, bucket: str) -> Dict:
+        doc = await self._load(self._bucket_oid(bucket))
+        if doc is None:
+            raise RGWError("NoSuchBucket", bucket)
+        return doc
+
+    async def list_objects(self, bucket: str) -> List[Dict[str, Any]]:
+        doc = await self._bucket(bucket)
+        return [dict(v, key=k) for k, v in sorted(doc["objects"].items())]
+
+    # -- atomic PUT / GET / DELETE ----------------------------------------
+
+    async def put_object(self, bucket: str, key: str,
+                         data: bytes) -> str:
+        """Single-shot PUT (RGWPutObj + AtomicObjectProcessor role)."""
+        await self._bucket(bucket)
+        writer = StripeWriter(self.data, self.aio_window)
+        proc = PutObjProcessor(writer, self._head_oid(bucket, key),
+                               self.stripe_size)
+        try:
+            await proc.process(data)
+            manifest = await proc.complete()
+        except Exception:
+            await writer.cancel()
+            raise
+        etag = _etag(data)
+        await self._link(bucket, key, manifest, etag)
+        return etag
+
+    async def _link(self, bucket: str, key: str, manifest: Manifest,
+                    etag: str) -> None:
+        """Write the head manifest doc + bucket index entry (the bucket
+        index transaction role of AtomicObjectProcessor::complete)."""
+        await self._store(f"head.{bucket}.{key}",
+                          {"manifest": manifest.to_dict(), "etag": etag})
+        doc = await self._bucket(bucket)
+        doc["objects"][key] = {"size": manifest.obj_size, "etag": etag,
+                               "mtime": time.time()}
+        await self._store(self._bucket_oid(bucket), doc)
+
+    async def _manifest(self, bucket: str, key: str) -> Tuple[Manifest,
+                                                              str]:
+        head = await self._load(f"head.{bucket}.{key}")
+        if head is None:
+            raise RGWError("NoSuchKey", f"{bucket}/{key}")
+        return Manifest.from_dict(head["manifest"]), head["etag"]
+
+    async def get_object(self, bucket: str, key: str) -> bytes:
+        """GET: walk the manifest, fetch stripes concurrently."""
+        import asyncio
+
+        manifest, _ = await self._manifest(bucket, key)
+        sem = asyncio.Semaphore(self.aio_window)
+
+        async def fetch(stripe: Dict) -> bytes:
+            async with sem:
+                return await self.data.read(stripe["oid"])
+
+        parts = await asyncio.gather(
+            *(fetch(s) for s in manifest.stripes))
+        out = b"".join(p[:s["size"]]
+                       for p, s in zip(parts, manifest.stripes))
+        if len(out) != manifest.obj_size:
+            raise RGWError("IncompleteBody",
+                           f"{len(out)} != {manifest.obj_size}")
+        return out
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        manifest, _ = await self._manifest(bucket, key)
+        for stripe in manifest.stripes:
+            try:
+                await self.data.remove(stripe["oid"])
+            except Exception:
+                pass
+        await self.meta.remove(f"head.{bucket}.{key}")
+        doc = await self._bucket(bucket)
+        doc["objects"].pop(key, None)
+        await self._store(self._bucket_oid(bucket), doc)
+
+    # -- multipart ---------------------------------------------------------
+
+    async def init_multipart(self, bucket: str, key: str) -> str:
+        """RGWInitMultipart role: mint an upload id, persist state."""
+        await self._bucket(bucket)
+        self._uploads += 1
+        upload_id = f"u{self._uploads}-{int(time.time() * 1000):x}"
+        await self._store(self._upload_oid(bucket, key, upload_id),
+                          {"bucket": bucket, "key": key,
+                           "parts": {}})
+        return upload_id
+
+    async def _upload(self, bucket: str, key: str,
+                      upload_id: str) -> Dict:
+        doc = await self._load(self._upload_oid(bucket, key, upload_id))
+        if doc is None:
+            raise RGWError("NoSuchUpload", upload_id)
+        return doc
+
+    def _part_prefix(self, bucket: str, key: str, upload_id: str,
+                     part_num: int) -> str:
+        # the reference's part naming: <key>._multipart_.<uploadid>.<num>
+        return (f"{bucket}/{MULTIPART_PREFIX}{key}"
+                f".{upload_id}.{part_num}")
+
+    async def upload_part(self, bucket: str, key: str, upload_id: str,
+                          part_num: int, data: bytes) -> str:
+        """MultipartObjectProcessor role: a part is its own striped
+        object family; re-upload of the same part replaces it."""
+        if part_num < 1 or part_num > 10000:
+            raise RGWError("InvalidPart", str(part_num))
+        doc = await self._upload(bucket, key, upload_id)
+        writer = StripeWriter(self.data, self.aio_window)
+        proc = PutObjProcessor(
+            writer, self._part_prefix(bucket, key, upload_id, part_num),
+            self.stripe_size)
+        try:
+            await proc.process(data)
+            manifest = await proc.complete()
+        except Exception:
+            await writer.cancel()
+            raise
+        etag = _etag(data)
+        doc["parts"][str(part_num)] = {
+            "etag": etag, "size": manifest.obj_size,
+            "manifest": manifest.to_dict()}
+        await self._store(self._upload_oid(bucket, key, upload_id), doc)
+        return etag
+
+    async def complete_multipart(self, bucket: str, key: str,
+                                 upload_id: str,
+                                 parts: List[Tuple[int, str]]) -> str:
+        """RGWCompleteMultipart::execute role (rgw_op.cc:5933): validate
+        the client's part list, stitch part manifests in part order,
+        write the head, unlink upload state."""
+        doc = await self._upload(bucket, key, upload_id)
+        if not parts:
+            raise RGWError("InvalidRequest", "empty part list")
+        nums = [p[0] for p in parts]
+        if nums != sorted(nums) or len(set(nums)) != len(nums):
+            raise RGWError("InvalidPartOrder", str(nums))
+        stitched = Manifest(stripe_size=self.stripe_size)
+        etags = []
+        for num, etag in parts:
+            part = doc["parts"].get(str(num))
+            if part is None or part["etag"] != etag:
+                raise RGWError("InvalidPart", f"part {num}")
+            stitched.append(Manifest.from_dict(part["manifest"]))
+            etags.append(etag)
+        # multipart etag: hash of concatenated part hashes, "-<nparts>"
+        combined = _etag("".join(etags).encode()) + f"-{len(parts)}"
+        await self._link(bucket, key, stitched, combined)
+        await self.meta.remove(self._upload_oid(bucket, key, upload_id))
+        return combined
+
+    async def abort_multipart(self, bucket: str, key: str,
+                              upload_id: str) -> None:
+        """RGWAbortMultipart role: delete parts + upload state."""
+        doc = await self._upload(bucket, key, upload_id)
+        for part in doc["parts"].values():
+            for stripe in part["manifest"]["stripes"]:
+                try:
+                    await self.data.remove(stripe["oid"])
+                except Exception:
+                    pass
+        await self.meta.remove(self._upload_oid(bucket, key, upload_id))
