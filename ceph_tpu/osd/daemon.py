@@ -33,6 +33,7 @@ import bisect
 import json
 import logging
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ceph_tpu.crush.map import CRUSH_ITEM_NONE
@@ -112,6 +113,24 @@ class PGState:
         # objects recovery could not reconstruct yet (pg_missing with no
         # found location); re-peered when the up set changes
         self.unfound = False
+        # per-object write serialization + primary-side extent cache
+        # (the ECBackend ExtentCache role): oid -> {"version", "size",
+        # "stripes": {stripe_start: logical stripe bytes}}.  Coherent
+        # because the primary serializes writes per object and the
+        # cache is dropped on any interval change.
+        self.obj_locks: Dict[str, list] = {}  # oid -> [Lock, refcount]
+        self.extent_cache: "OrderedDict[str, Dict[str, Any]]" = \
+            OrderedDict()
+
+    def obj_lock(self, oid: str) -> "_ObjLockCtx":
+        """Refcounted per-object lock: the entry is only evictable when
+        NO task holds or awaits it.  (A bare `not lock.locked()` sweep
+        races the release->waiter-wakeup window of asyncio.Lock, which
+        could hand two writers the same object.)"""
+        entry = self.obj_locks.get(oid)
+        if entry is None:
+            entry = self.obj_locks[oid] = [asyncio.Lock(), 0]
+        return _ObjLockCtx(self.obj_locks, oid, entry)
 
     def my_shard(self, osd: int, pool_type: int) -> int:
         if pool_type == TYPE_REPLICATED:
@@ -120,6 +139,29 @@ class PGState:
             return self.acting.index(osd)
         except ValueError:
             return -1
+
+
+class _ObjLockCtx:
+    """Context manager pairing an asyncio.Lock with a user refcount so
+    idle entries can be dropped without racing pending acquirers."""
+
+    def __init__(self, table: Dict[str, list], oid: str, entry: list):
+        self._table = table
+        self._oid = oid
+        self._entry = entry
+
+    async def __aenter__(self):
+        self._entry[1] += 1
+        await self._entry[0].acquire()
+        return self
+
+    async def __aexit__(self, *exc):
+        self._entry[0].release()
+        self._entry[1] -= 1
+        if self._entry[1] == 0 and \
+                self._table.get(self._oid) is self._entry:
+            del self._table[self._oid]
+        return False
 
 
 class OSDDaemon:
@@ -144,6 +186,10 @@ class OSDDaemon:
         self._map_event = asyncio.Event()
         self._stopping = False
         self._last_boot_sent = 0.0
+        # data-path transfer/dispatch accounting (perf-counter tier);
+        # tests assert small writes/reads move O(stripe), not O(object)
+        self.perf = {"subread_bytes": 0, "subwrite_bytes": 0,
+                     "encode_dispatches": 0, "decode_dispatches": 0}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -369,6 +415,9 @@ class OSDDaemon:
                     state.interval_epoch = self.osdmap.epoch
                     state.state = "inactive"
                     state.active_event.clear()
+                    # primary-side extent cache is only coherent within
+                    # one interval
+                    state.extent_cache.clear()
                     if state.peering_task is not None:
                         state.peering_task.cancel()
                         state.peering_task = None
@@ -510,13 +559,16 @@ class OSDDaemon:
             else:
                 raise ValueError(f"unknown shard op {op.op!r}")
 
-    def _read_shard(self, pg: PgId, shard: int, oid: str
+    def _read_shard(self, pg: PgId, shard: int, oid: str,
+                    offset: int = 0, length: int = 0
                     ) -> Tuple[int, bytes, Dict[str, bytes]]:
-        """Local shard read with attrs; rc<0 on missing/corrupt."""
+        """Local shard read with attrs; rc<0 on missing/corrupt.
+        offset/length push the range down to the STORE so a ranged read
+        costs O(range) of store I/O, not O(shard)."""
         cid = self._cid(pg, shard)
         obj = ObjectId(oid)
         try:
-            data = self.store.read(cid, obj)
+            data = self.store.read(cid, obj, offset, length)
             attrs = self.store.getattrs(cid, obj)
         except KeyError:
             return ENOENT, b"", {}
@@ -583,9 +635,9 @@ class OSDDaemon:
                 await conn.send(MOSDSubReadReply(
                     msg.tid, ENOENT, shard=msg.shard))
                 return
-        rc, data, attrs = self._read_shard(msg.pg, msg.shard, msg.oid)
-        if rc == 0 and msg.length:
-            data = data[msg.offset:msg.offset + msg.length]
+        rc, data, attrs = self._read_shard(
+            msg.pg, msg.shard, msg.oid,
+            msg.offset if msg.length else 0, msg.length)
         await conn.send(MOSDSubReadReply(
             msg.tid, rc, data, attrs if msg.want_attrs else {},
             shard=msg.shard))
@@ -787,39 +839,47 @@ class OSDDaemon:
 
     async def _read_candidates(
             self, pg: PgId, shard: int, osd: int, oid: str,
-            include_rollback: bool
+            include_rollback: bool,
+            offset: int = 0, length: int = 0
     ) -> List[Tuple[int, bytes, Dict[str, bytes]]]:
         """Read one (shard, osd)'s main object — and, when asked, its
-        rollback generation — as selection candidates."""
+        rollback generation — as selection candidates.  offset/length
+        trim the shard payload to the requested chunk range (the
+        get_want_to_read_shards range discipline)."""
         names = [oid]
         if include_rollback:
             names.append(RB_PREFIX + oid)
         out: List[Tuple[int, bytes, Dict[str, bytes]]] = []
         for name in names:
             if osd == self.osd_id:
-                rc, data, at = self._read_shard(pg, shard, name)
+                rc, data, at = self._read_shard(
+                    pg, shard, name, offset if length else 0, length)
                 if rc == 0:
                     out.append((shard, data, at))
                 continue
             tid = self._next_tid()
             reply = await self._request(
-                osd, MOSDSubRead(tid, pg, shard, name), tid)
+                osd, MOSDSubRead(tid, pg, shard, name, offset, length),
+                tid)
             if reply is not None and reply.rc == 0:
+                self.perf["subread_bytes"] += len(reply.data)
                 out.append((shard, reply.data, reply.attrs))
         return out
 
     async def _gather_object_shards(
             self, state: PGState, pool, oid: str,
             exclude_missing: bool = True,
-            include_rollback: bool = False
+            include_rollback: bool = False,
+            offset: int = 0, length: int = 0
     ) -> List[Tuple[int, bytes, Dict[str, bytes]]]:
         """Collect available (shard, payload, attrs) candidates for an
-        object from up acting shards (local read for mine, sub-reads for
-        peers).  include_rollback adds each shard's preserved previous
-        generation to the candidate pool."""
+        object from up acting shards, CONCURRENTLY (local read for mine,
+        sub-reads for peers).  include_rollback adds each shard's
+        preserved previous generation; offset/length restrict each
+        shard's payload to a chunk range."""
         pg = state.pg
-        candidates: List[Tuple[int, bytes, Dict[str, bytes]]] = []
         plog = self._load_log(state, pool)
+        jobs = []
         for idx, osd in enumerate(state.acting):
             shard = idx if pool.type == TYPE_ERASURE else -1
             if osd == CRUSH_ITEM_NONE or not self.osdmap.is_up(osd):
@@ -827,9 +887,10 @@ class OSDDaemon:
             if osd == self.osd_id and exclude_missing and \
                     oid in plog.missing:
                 continue
-            candidates += await self._read_candidates(
-                pg, shard, osd, oid, include_rollback)
-        return candidates
+            jobs.append(self._read_candidates(
+                pg, shard, osd, oid, include_rollback, offset, length))
+        results = await asyncio.gather(*jobs) if jobs else []
+        return [c for sub in results for c in sub]
 
     async def _gather_stray_shards(
             self, state: PGState, pool, oid: str,
@@ -847,14 +908,13 @@ class OSDDaemon:
                 range(self._codec(pool.id).get_chunk_count()))
         else:
             shard_list = [-1]
-        candidates: List[Tuple[int, bytes, Dict[str, bytes]]] = []
-        for osd in self.osdmap.get_up_osds():
-            for shard in shard_list:
-                if (shard, osd) in have:
-                    continue
-                candidates += await self._read_candidates(
-                    pg, shard, osd, oid, include_rollback=True)
-        return candidates
+        jobs = [self._read_candidates(pg, shard, osd, oid,
+                                      include_rollback=True)
+                for osd in self.osdmap.get_up_osds()
+                for shard in shard_list
+                if (shard, osd) not in have]
+        results = await asyncio.gather(*jobs) if jobs else []
+        return [c for sub in results for c in sub]
 
     @staticmethod
     def _oi_version(at: Dict[str, bytes]) -> Optional[tuple]:
@@ -939,6 +999,7 @@ class OSDDaemon:
         pg = state.pg
         plog = self._load_log(state, pool)
         my_shard = state.my_shard(self.osd_id, pool.type)
+        state.extent_cache.pop(oid, None)  # recovery rewrites shards
         candidates = await self._gather_object_shards(state, pool, oid)
         # always search strays during recovery: after several remaps the
         # newest acked version may exist only on prior-interval members
@@ -1188,6 +1249,8 @@ class OSDDaemon:
                 self.store.queue_transaction(t)
             else:
                 tid = self._next_tid()
+                self.perf["subwrite_bytes"] += sum(
+                    len(op.data) for op in ops)
                 pending.append(self._request(
                     osd, MOSDSubWrite(tid, pg, shard, oid, ops,
                                       admit_epoch, entry,
@@ -1252,6 +1315,17 @@ class OSDDaemon:
     async def _op_write_full(self, state: PGState, pool, oid: str,
                              data: bytes,
                              admit_epoch: Optional[int] = None) -> int:
+        if pool.type == TYPE_ERASURE:
+            async with state.obj_lock(oid):
+                state.extent_cache.pop(oid, None)
+                return await self._op_write_full_locked(
+                    state, pool, oid, data, admit_epoch)
+        return await self._op_write_full_locked(state, pool, oid, data,
+                                                admit_epoch)
+
+    async def _op_write_full_locked(
+            self, state: PGState, pool, oid: str, data: bytes,
+            admit_epoch: Optional[int] = None) -> int:
         entry = self._next_entry(state, pool, oid, "modify", len(data))
         oi = json.dumps({"size": len(data),
                          "version": entry["version"]}).encode()
@@ -1286,7 +1360,7 @@ class OSDDaemon:
                         offset: int, data: bytes,
                         admit_epoch: Optional[int] = None) -> int:
         """Partial-extent write.  Replicated: direct range write.
-        EC: read-modify-write of the touched range (RMW pipeline)."""
+        EC: stripe-level read-modify-write (the start_rmw pipeline)."""
         if pool.type == TYPE_REPLICATED:
             entry = self._next_entry(state, pool, oid, "modify")
             rc, old_size = await self._stat_size(state, pool, oid)
@@ -1300,18 +1374,140 @@ class OSDDaemon:
             return await self._submit_shard_writes(state, pool, oid,
                                                    {-1: ops}, entry,
                                                    admit_epoch)
-        # EC RMW v0: full-object read, merge, re-encode (extent-cache
-        # batched stripe RMW lands with the dedicated RMW milestone)
-        rc, old = await self._op_read(state, pool, oid, 0, 0)
-        if rc == ENOENT:
-            old = b""
-        elif rc < 0:
-            return rc
-        new = bytearray(max(len(old), offset + len(data)))
-        new[:len(old)] = old
-        new[offset:offset + len(data)] = data
-        return await self._op_write_full(state, pool, oid, bytes(new),
-                                         admit_epoch)
+        async with state.obj_lock(oid):
+            return await self._ec_rmw(state, pool, oid, offset, data,
+                                      admit_epoch)
+
+    async def _ec_rmw(self, state: PGState, pool, oid: str,
+                      offset: int, data: bytes,
+                      admit_epoch: Optional[int]) -> int:
+        """Stripe-level EC read-modify-write (ECBackend start_rmw ->
+        try_state_to_reads -> try_reads_to_commit,
+        /root/reference/src/osd/ECBackend.cc:1858-2087, with the
+        ExtentCache role played by state.extent_cache).
+
+        Reads ONLY the touched stripes' chunk ranges (served from the
+        extent cache when a preceding write on this object covered
+        them), merges the new bytes, re-encodes just those stripes in
+        one batched dispatch, and writes back per-shard chunk RANGES.
+        Cumulative shard hashes cannot survive a mid-stream overwrite,
+        so the hinfo drops its chunk hashes (the reference's
+        set_total_chunk_size_clear_hash overwrite discipline); version
+        agreement carries read consistency, scrub recomputes digests."""
+        codec = self._codec(pool.id)
+        sinfo = self._sinfo(pool.id)
+        width = sinfo.get_stripe_width()
+        chunk = sinfo.get_chunk_size()
+        k = codec.get_data_chunk_count()
+        n = codec.get_chunk_count()
+
+        start, span = sinfo.offset_len_to_stripe_bounds(
+            (offset, len(data)))
+        cache = state.extent_cache.get(oid)
+
+        old_size = None
+        merged: Optional[bytearray] = None
+        if cache is not None:
+            missing_stripes = [
+                s for s in range(start, start + span, width)
+                if s not in cache["stripes"]]
+            old_size = cache["size"]
+            old_padded = -(-old_size // width) * width
+            if not any(s < old_padded for s in missing_stripes):
+                # cache + zero-fill covers the whole span: no reads
+                merged = bytearray(span)
+                for s in range(start, start + span, width):
+                    frag = cache["stripes"].get(s)
+                    if frag is not None:
+                        merged[s - start:s - start + width] = frag
+        if merged is None:
+            # read the touched stripes' chunk ranges from the acting
+            # shards and reconstruct the span
+            chunk_off = (start // width) * chunk
+            chunk_len = (span // width) * chunk
+            candidates = await self._gather_object_shards(
+                state, pool, oid, offset=chunk_off, length=chunk_len)
+            merged = bytearray(span)
+            if candidates:
+                version, good, oi = self._select_consistent(
+                    candidates, need=k)
+                if version is None:
+                    return EIO
+                old_size = oi.get("size", 0)
+                old_padded = -(-old_size // width) * width
+                # shards may come back short when the range reaches past
+                # the old object end: pad to the span's chunk length
+                frag_len = min(
+                    chunk_len,
+                    max(0, (old_padded // width) * chunk
+                        - chunk_off))
+                if frag_len > 0:
+                    want = {codec.chunk_index(i) for i in range(k)}
+                    minimum = codec.minimum_to_decode(want, set(good))
+                    frags = {}
+                    for s in minimum:
+                        buf = good[s][:frag_len]
+                        if len(buf) < frag_len:
+                            buf = buf + bytes(frag_len - len(buf))
+                        frags[s] = buf
+                    self.perf["decode_dispatches"] += 1
+                    decoded = ec_util.decode(sinfo, codec, frags)
+                    merged[:len(decoded)] = decoded
+            else:
+                old_size = 0
+        # overlay the client bytes
+        rel = offset - start
+        merged[rel:rel + len(data)] = data
+        new_size = max(old_size or 0, offset + len(data))
+
+        entry = self._next_entry(state, pool, oid, "modify", new_size)
+        oi_raw = json.dumps({"size": new_size,
+                             "version": entry["version"]}).encode()
+        hinfo = ec_util.HashInfo(n)
+        hinfo.set_total_chunk_size_clear_hash(
+            (-(-new_size // width)) * chunk)
+        hinfo_raw = json.dumps(hinfo.to_dict()).encode()
+        self.perf["encode_dispatches"] += 1
+        shards = ec_util.encode(sinfo, codec, bytes(merged), range(n))
+        chunk_off = (start // width) * chunk
+        shard_ops = {}
+        for shard in range(n):
+            frag = shards.get(shard, b"")
+            shard_ops[shard] = [
+                ShardOp("create"),
+                ShardOp("write", chunk_off, frag),
+                ShardOp("setattr", name=OI_ATTR, value=oi_raw),
+                ShardOp("setattr", name=HINFO_ATTR, value=hinfo_raw)]
+        rc = await self._submit_shard_writes(state, pool, oid,
+                                             shard_ops, entry,
+                                             admit_epoch)
+        if rc == 0:
+            self._cache_put(state, oid, entry["version"], new_size,
+                            start, bytes(merged), width)
+        else:
+            state.extent_cache.pop(oid, None)
+        return rc
+
+    # extent-cache bookkeeping (bounded; coherent under the per-object
+    # lock + single-primary discipline; dropped on interval change)
+    _CACHE_MAX_STRIPES = 256
+
+    def _cache_put(self, state: PGState, oid: str, version, size: int,
+                   start: int, span_bytes: bytes, width: int) -> None:
+        entry = state.extent_cache.get(oid)
+        if entry is None or entry.get("version") is None:
+            entry = {"version": version, "size": size, "stripes": {}}
+        entry["version"] = version
+        entry["size"] = size
+        for s in range(0, len(span_bytes), width):
+            entry["stripes"][start + s] = span_bytes[s:s + width]
+        state.extent_cache.pop(oid, None)
+        state.extent_cache[oid] = entry
+        total = sum(len(e["stripes"])
+                    for e in state.extent_cache.values())
+        while total > self._CACHE_MAX_STRIPES and state.extent_cache:
+            _old_oid, old_e = state.extent_cache.popitem(last=False)
+            total -= len(old_e["stripes"])
 
     async def _stat_size(self, state: PGState, pool, oid: str
                          ) -> Tuple[int, int]:
@@ -1358,12 +1554,55 @@ class OSDDaemon:
             elif offset:
                 data = data[offset:]
             return 0, data
-        candidates = await self._gather_object_shards(state, pool, oid)
-        if not candidates:
-            return ENOENT, b""
         codec = self._codec(pool.id)
         sinfo = self._sinfo(pool.id)
         k = codec.get_data_chunk_count()
+        width = sinfo.get_stripe_width()
+        chunk = sinfo.get_chunk_size()
+        if length > 0:
+            # ranged read: fetch ONLY the touched stripes' chunk ranges
+            # (get_want_to_read_shards, ECBackend.cc:2380) — a 4 KiB
+            # read of a large object moves O(stripe), not O(object).
+            # Consistency rides version agreement; the whole-shard crc
+            # cannot be checked on a fragment (scrub's job).
+            start, span = sinfo.offset_len_to_stripe_bounds(
+                (offset, length))
+            chunk_off = (start // width) * chunk
+            chunk_len = (span // width) * chunk
+            candidates = await self._gather_object_shards(
+                state, pool, oid, offset=chunk_off, length=chunk_len)
+            if not candidates:
+                return ENOENT, b""
+            version, good, oi = self._select_consistent(
+                candidates, need=k)
+            if version is None:
+                return EIO, b""
+            size = oi.get("size", 0)
+            if offset >= size:
+                return 0, b""
+            padded = -(-size // width) * width
+            frag_len = min(chunk_len,
+                           max(0, (padded // width) * chunk - chunk_off))
+            if frag_len <= 0:
+                return 0, b""
+            want = {codec.chunk_index(i) for i in range(k)}
+            try:
+                minimum = codec.minimum_to_decode(want, set(good))
+            except Exception:
+                return EIO, b""
+            frags = {}
+            for s in minimum:
+                buf = good[s][:frag_len]
+                if len(buf) < frag_len:
+                    buf += bytes(frag_len - len(buf))
+                frags[s] = buf
+            self.perf["decode_dispatches"] += 1
+            data = ec_util.decode(sinfo, codec, frags)
+            rel = offset - start
+            return 0, data[rel:rel + min(length, size - offset)]
+        candidates = await self._gather_object_shards(state, pool, oid)
+        if not candidates:
+            return ENOENT, b""
         # newest version with >= k intact same-version shards wins;
         # hinfo crc drops corrupt shards (handle_sub_read's verify)
         version, good, oi = self._select_consistent(
@@ -1376,6 +1615,7 @@ class OSDDaemon:
             minimum = codec.minimum_to_decode(want, set(good))
         except Exception:
             return EIO, b""
+        self.perf["decode_dispatches"] += 1
         data = ec_util.decode(sinfo, codec,
                               {s: good[s] for s in minimum if s in good})
         data = data[:size]
@@ -1387,14 +1627,16 @@ class OSDDaemon:
 
     async def _op_stat(self, state: PGState, pool, oid: str
                        ) -> Tuple[int, Dict[str, Any]]:
-        candidates = await self._gather_object_shards(state, pool, oid)
+        # stat needs attrs + version agreement only: fetch one byte per
+        # shard, not the whole payload
+        candidates = await self._gather_object_shards(
+            state, pool, oid, offset=0, length=1)
         if not candidates:
             return ENOENT, {}
         need = self._codec(pool.id).get_data_chunk_count() \
             if pool.type == TYPE_ERASURE else 1
         version, _chosen, oi = self._select_consistent(
-            candidates, need=need,
-            verify_hinfo=pool.type == TYPE_ERASURE)
+            candidates, need=need)
         if version is None:
             return EIO, {}
         return 0, {"size": oi.get("size", 0),
@@ -1402,6 +1644,7 @@ class OSDDaemon:
 
     async def _op_remove(self, state: PGState, pool, oid: str,
                          admit_epoch: Optional[int] = None) -> int:
+        state.extent_cache.pop(oid, None)
         rc, _ = await self._op_stat(state, pool, oid)
         if rc == ENOENT:
             return ENOENT

@@ -61,6 +61,27 @@ class RGWLite:
         self.stripe_size = stripe_size
         self.aio_window = aio_window
         self._uploads = 0
+        self._writes = 0
+        # serializes read-modify-writes of upload/bucket meta docs
+        # within this gateway instance (one gateway per cluster in this
+        # tier; multi-gateway index updates need the omap op milestone)
+        self._meta_locks: Dict[str, "asyncio.Lock"] = {}
+
+    def _meta_lock(self, key: str):
+        import asyncio
+
+        lock = self._meta_locks.get(key)
+        if lock is None:
+            lock = self._meta_locks[key] = asyncio.Lock()
+        return lock
+
+    def _write_id(self) -> str:
+        """Unique suffix per PUT: an overwrite writes FRESH stripe
+        objects, so a failed upload's cleanup can never delete the live
+        object's data and readers never see torn old/new stripes
+        (the reference's rgw_obj random-oid-prefix discipline)."""
+        self._writes += 1
+        return f"w{self._writes}-{int(time.time() * 1000):x}"
 
     # -- meta-doc helpers (JSON docs in the meta pool) ---------------------
 
@@ -74,16 +95,24 @@ class RGWLite:
     async def _store(self, oid: str, doc: Dict) -> None:
         await self.meta.write_full(oid, json.dumps(doc).encode())
 
-    @staticmethod
-    def _bucket_oid(bucket: str) -> str:
-        return f"bucket.index.{bucket}"
+    # meta-oid components are joined with the unit separator so bucket
+    # or key names containing dots/slashes can never collide
+    _SEP = "\x1f"
 
-    @staticmethod
-    def _upload_oid(bucket: str, key: str, upload_id: str) -> str:
-        return f"multipart.{bucket}.{key}.{upload_id}"
+    @classmethod
+    def _meta_oid(cls, kind: str, *parts: str) -> str:
+        return cls._SEP.join((kind,) + parts)
+
+    @classmethod
+    def _bucket_oid(cls, bucket: str) -> str:
+        return cls._meta_oid("bucket.index", bucket)
+
+    @classmethod
+    def _upload_oid(cls, bucket: str, key: str, upload_id: str) -> str:
+        return cls._meta_oid("multipart", bucket, key, upload_id)
 
     def _head_oid(self, bucket: str, key: str) -> str:
-        return f"{bucket}/{key}"
+        return self._SEP.join((bucket, key))
 
     # -- buckets -----------------------------------------------------------
 
@@ -110,8 +139,8 @@ class RGWLite:
         """Single-shot PUT (RGWPutObj + AtomicObjectProcessor role)."""
         await self._bucket(bucket)
         writer = StripeWriter(self.data, self.aio_window)
-        proc = PutObjProcessor(writer, self._head_oid(bucket, key),
-                               self.stripe_size)
+        prefix = f"{self._head_oid(bucket, key)}.{self._write_id()}"
+        proc = PutObjProcessor(writer, prefix, self.stripe_size)
         try:
             await proc.process(data)
             manifest = await proc.complete()
@@ -124,18 +153,31 @@ class RGWLite:
 
     async def _link(self, bucket: str, key: str, manifest: Manifest,
                     etag: str) -> None:
-        """Write the head manifest doc + bucket index entry (the bucket
-        index transaction role of AtomicObjectProcessor::complete)."""
-        await self._store(f"head.{bucket}.{key}",
+        """Flip the head manifest doc + bucket index entry (the bucket
+        index transaction role of AtomicObjectProcessor::complete),
+        then garbage-collect the replaced object's stripes (the GC
+        list role)."""
+        head_doc = self._meta_oid("head", bucket, key)
+        old = await self._load(head_doc)
+        await self._store(head_doc,
                           {"manifest": manifest.to_dict(), "etag": etag})
-        doc = await self._bucket(bucket)
-        doc["objects"][key] = {"size": manifest.obj_size, "etag": etag,
-                               "mtime": time.time()}
-        await self._store(self._bucket_oid(bucket), doc)
+        async with self._meta_lock(self._bucket_oid(bucket)):
+            doc = await self._bucket(bucket)
+            doc["objects"][key] = {"size": manifest.obj_size,
+                                   "etag": etag, "mtime": time.time()}
+            await self._store(self._bucket_oid(bucket), doc)
+        if old is not None:
+            new_oids = {s["oid"] for s in manifest.stripes}
+            for stripe in old["manifest"]["stripes"]:
+                if stripe["oid"] not in new_oids:
+                    try:
+                        await self.data.remove(stripe["oid"])
+                    except Exception:
+                        pass
 
     async def _manifest(self, bucket: str, key: str) -> Tuple[Manifest,
                                                               str]:
-        head = await self._load(f"head.{bucket}.{key}")
+        head = await self._load(self._meta_oid("head", bucket, key))
         if head is None:
             raise RGWError("NoSuchKey", f"{bucket}/{key}")
         return Manifest.from_dict(head["manifest"]), head["etag"]
@@ -167,10 +209,11 @@ class RGWLite:
                 await self.data.remove(stripe["oid"])
             except Exception:
                 pass
-        await self.meta.remove(f"head.{bucket}.{key}")
-        doc = await self._bucket(bucket)
-        doc["objects"].pop(key, None)
-        await self._store(self._bucket_oid(bucket), doc)
+        await self.meta.remove(self._meta_oid("head", bucket, key))
+        async with self._meta_lock(self._bucket_oid(bucket)):
+            doc = await self._bucket(bucket)
+            doc["objects"].pop(key, None)
+            await self._store(self._bucket_oid(bucket), doc)
 
     # -- multipart ---------------------------------------------------------
 
@@ -192,21 +235,27 @@ class RGWLite:
         return doc
 
     def _part_prefix(self, bucket: str, key: str, upload_id: str,
-                     part_num: int) -> str:
-        # the reference's part naming: <key>._multipart_.<uploadid>.<num>
-        return (f"{bucket}/{MULTIPART_PREFIX}{key}"
-                f".{upload_id}.{part_num}")
+                     part_num: int, write_id: str) -> str:
+        # the reference's part naming (<key>._multipart_.<uploadid>.<num>)
+        # plus a unique write id so a part RE-upload writes fresh
+        # objects instead of clobbering the live ones
+        return self._SEP.join(
+            (bucket, f"{MULTIPART_PREFIX}{key}"
+                     f".{upload_id}.{part_num}.{write_id}"))
 
     async def upload_part(self, bucket: str, key: str, upload_id: str,
                           part_num: int, data: bytes) -> str:
         """MultipartObjectProcessor role: a part is its own striped
-        object family; re-upload of the same part replaces it."""
+        object family; re-upload of the same part replaces it.
+        Concurrent parts of one upload are the normal S3 pattern, so
+        the upload-doc update is serialized per upload."""
         if part_num < 1 or part_num > 10000:
             raise RGWError("InvalidPart", str(part_num))
-        doc = await self._upload(bucket, key, upload_id)
+        await self._upload(bucket, key, upload_id)  # upload must exist
         writer = StripeWriter(self.data, self.aio_window)
         proc = PutObjProcessor(
-            writer, self._part_prefix(bucket, key, upload_id, part_num),
+            writer, self._part_prefix(bucket, key, upload_id, part_num,
+                                      self._write_id()),
             self.stripe_size)
         try:
             await proc.process(data)
@@ -215,10 +264,20 @@ class RGWLite:
             await writer.cancel()
             raise
         etag = _etag(data)
-        doc["parts"][str(part_num)] = {
-            "etag": etag, "size": manifest.obj_size,
-            "manifest": manifest.to_dict()}
-        await self._store(self._upload_oid(bucket, key, upload_id), doc)
+        upload_oid = self._upload_oid(bucket, key, upload_id)
+        async with self._meta_lock(upload_oid):
+            doc = await self._upload(bucket, key, upload_id)
+            old = doc["parts"].get(str(part_num))
+            doc["parts"][str(part_num)] = {
+                "etag": etag, "size": manifest.obj_size,
+                "manifest": manifest.to_dict()}
+            await self._store(upload_oid, doc)
+        if old is not None:  # GC the replaced part's stripes
+            for stripe in old["manifest"]["stripes"]:
+                try:
+                    await self.data.remove(stripe["oid"])
+                except Exception:
+                    pass
         return etag
 
     async def complete_multipart(self, bucket: str, key: str,

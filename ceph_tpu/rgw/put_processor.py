@@ -64,10 +64,16 @@ class StripeWriter:
         self.written: List[str] = []
 
     async def _write(self, oid: str, data: bytes) -> None:
-        async with self._sem:
+        try:
             await self.ioctx.write_full(oid, data)
+        finally:
+            self._sem.release()
 
-    def submit(self, oid: str, data: bytes) -> None:
+    async def submit(self, oid: str, data: bytes) -> None:
+        """Acquire a window slot BEFORE buffering the stripe in a task:
+        memory stays O(window x stripe) no matter how large the object
+        is (the rgw_put_obj_min_window_size backpressure role)."""
+        await self._sem.acquire()
         self.written.append(oid)
         self._tasks.append(
             asyncio.get_running_loop().create_task(
@@ -118,28 +124,26 @@ class PutObjProcessor:
         return self.oid_prefix if n == 0 else \
             f"{self.oid_prefix}_shadow_{n}"
 
-    def _flush_stripe(self, data: bytes) -> None:
+    async def _flush_stripe(self, data: bytes) -> None:
         oid = self.oid_for_stripe(self._stripe_no)
         self._stripe_no += 1
         self.manifest.stripes.append({"oid": oid, "size": len(data)})
         self.manifest.obj_size += len(data)
-        self.writer.submit(oid, data)
+        await self.writer.submit(oid, data)
 
     async def process(self, data: bytes) -> None:
-        """Feed a run of bytes; full stripes are written as they fill."""
+        """Feed a run of bytes; full stripes are written as they fill
+        (submit blocks on the writer window — the backpressure seam)."""
         self._buf.extend(data)
         while len(self._buf) >= self.stripe_size:
             stripe = bytes(self._buf[:self.stripe_size])
             del self._buf[:self.stripe_size]
-            self._flush_stripe(stripe)
-            # bounded buffering: let the writer window apply backpressure
-            if self.writer._sem.locked():
-                await asyncio.sleep(0)
+            await self._flush_stripe(stripe)
 
     async def complete(self) -> Manifest:
         """Flush the tail and wait for every stripe to be durable."""
         if self._buf:
-            self._flush_stripe(bytes(self._buf))
+            await self._flush_stripe(bytes(self._buf))
             self._buf = bytearray()
         await self.writer.drain()
         return self.manifest
