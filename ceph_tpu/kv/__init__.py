@@ -145,6 +145,37 @@ class SQLiteDB(KeyValueDB):
                         " AND key < ?", (prefix, key, value))
             self._conn.commit()
 
+    def submit_transaction_sync(self, t: Transaction) -> None:
+        """Really-durable commit: synchronous=FULL for this transaction
+        so a machine crash cannot forget state a caller already
+        published (the mon's Paxos-commit requirement; WAL+NORMAL only
+        survives process death)."""
+        with self._lock:
+            self._conn.execute("PRAGMA synchronous=FULL")
+            try:
+                cur = self._conn.cursor()
+                for op, prefix, key, value in t.ops:
+                    if op == "set":
+                        cur.execute(
+                            "INSERT OR REPLACE INTO kv"
+                            " (prefix, key, value) VALUES (?, ?, ?)",
+                            (prefix, key, value))
+                    elif op == "rm":
+                        cur.execute(
+                            "DELETE FROM kv WHERE prefix = ?"
+                            " AND key = ?", (prefix, key))
+                    elif op == "rm_prefix":
+                        cur.execute("DELETE FROM kv WHERE prefix = ?",
+                                    (prefix,))
+                    elif op == "rm_range":
+                        cur.execute(
+                            "DELETE FROM kv WHERE prefix = ?"
+                            " AND key >= ? AND key < ?",
+                            (prefix, key, value))
+                self._conn.commit()
+            finally:
+                self._conn.execute("PRAGMA synchronous=NORMAL")
+
     def get(self, prefix: str, key: bytes) -> Optional[bytes]:
         with self._lock:
             row = self._conn.execute(

@@ -27,6 +27,7 @@ Covered OSDMonitor behaviors:
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -75,20 +76,19 @@ class MonDaemon:
     """Single authoritative monitor."""
 
     def __init__(self, num_osds: int, osds_per_host: int = 2,
-                 config: Optional[Dict[str, Any]] = None):
+                 config: Optional[Dict[str, Any]] = None,
+                 store=None):
         self.config = dict(DEFAULTS)
         self.config.update(config or {})
         self.msgr = Messenger("mon.0")
         self.msgr.dispatcher = self._dispatch
-        self.osdmap = OSDMap.build_simple(num_osds,
-                                          osds_per_host=osds_per_host)
-        # all OSDs start down (exist + in); boot marks them up
-        for osd in range(num_osds):
-            self.osdmap.osd_state[osd] &= ~CEPH_OSD_UP
+        # durable state (the MonitorDBStore role,
+        # /root/reference/src/mon/MonitorDBStore.h): every commit writes
+        # the incremental, the resulting full map, and the auxiliary
+        # adjudication state into the KeyValueDB in one transaction, so
+        # a mon restart is a reload, not cluster amnesia
+        self.store = store
         self._subscribers: List[Connection] = []
-        # encoded Incremental per epoch (MonitorDBStore osdmap log
-        # role): lets daemons replay the map stream epoch by epoch —
-        # interval detection requires seeing EVERY epoch in order
         self._inc_log: Dict[int, bytes] = {}
         self._inc_log_max = 1000
         # failure bookkeeping (OSDMonitor::failure_info_t)
@@ -99,6 +99,56 @@ class MonDaemon:
         self._down_at: Dict[int, float] = {}
         self._up_from: Dict[int, int] = {}  # boot epoch per osd
         self._check_task: Optional[asyncio.Task] = None
+        if store is not None and self._load_store():
+            return
+        self.osdmap = OSDMap.build_simple(num_osds,
+                                          osds_per_host=osds_per_host)
+        # all OSDs start down (exist + in); boot marks them up
+        for osd in range(num_osds):
+            self.osdmap.osd_state[osd] &= ~CEPH_OSD_UP
+        if store is not None:
+            self._persist(None)
+
+    def _load_store(self) -> bool:
+        raw = self.store.get("mon", b"osdmap_full")
+        if raw is None:
+            return False
+        self.osdmap = OSDMap.decode(raw)
+        # load at most the newest _inc_log_max incrementals (the store
+        # is trimmed on commit, but never trust unbounded history)
+        loaded = [(int.from_bytes(key, "big"), val)
+                  for key, val in self.store.get_iterator("osdmap")]
+        for epoch, val in loaded[-self._inc_log_max:]:
+            self._inc_log[epoch] = val
+        aux = self.store.get("mon", b"aux")
+        if aux:
+            doc = json.loads(aux.decode())
+            self._laggy_probability = {
+                int(k): v for k, v in doc["laggy_probability"].items()}
+            self._laggy_interval = {
+                int(k): v for k, v in doc["laggy_interval"].items()}
+            self._up_from = {int(k): v
+                             for k, v in doc["up_from"].items()}
+        log.info("mon: reloaded epoch %d from store", self.osdmap.epoch)
+        return True
+
+    def _persist(self, inc_raw: Optional[bytes]) -> None:
+        """One durable transaction per commit (Paxos commit point)."""
+        t = self.store.get_transaction()
+        if inc_raw is not None:
+            t.set("osdmap",
+                  self.osdmap.epoch.to_bytes(8, "big"), inc_raw)
+            # keep the durable inc log bounded like the in-memory one
+            floor = max(0, self.osdmap.epoch - self._inc_log_max)
+            t.rm_range_keys("osdmap", (0).to_bytes(8, "big"),
+                            floor.to_bytes(8, "big"))
+        t.set("mon", b"osdmap_full", self.osdmap.encode())
+        t.set("mon", b"aux", json.dumps({
+            "laggy_probability": self._laggy_probability,
+            "laggy_interval": self._laggy_interval,
+            "up_from": self._up_from,
+        }).encode())
+        self.store.submit_transaction_sync(t)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -127,6 +177,10 @@ class MonDaemon:
         self._inc_log[inc.epoch] = raw
         while len(self._inc_log) > self._inc_log_max:
             del self._inc_log[min(self._inc_log)]
+        if self.store is not None:
+            # durable BEFORE published: a subscriber must never see an
+            # epoch a restarted mon could forget
+            self._persist(raw)
         self._publish()
 
     def _publish(self) -> None:

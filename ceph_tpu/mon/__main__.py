@@ -21,9 +21,18 @@ async def _main() -> None:
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--config", type=str, default="{}",
                     help="JSON mon config overrides")
+    ap.add_argument("--store-path", type=str, default="",
+                    help="durable MonitorDBStore (SQLite); a restart"
+                         " on the same path reloads cluster state")
     args = ap.parse_args()
+    store = None
+    if args.store_path:
+        from ceph_tpu.kv import SQLiteDB
+
+        store = SQLiteDB(args.store_path)
+        store.create_and_open()
     mon = MonDaemon(args.num_osds, osds_per_host=args.osds_per_host,
-                    config=json.loads(args.config))
+                    config=json.loads(args.config), store=store)
     addr = await mon.start(port=args.port)
     print(f"MON_ADDR {addr}", flush=True)
     try:

@@ -186,6 +186,7 @@ class OSDDaemon:
         self._map_event = asyncio.Event()
         self._stopping = False
         self._last_boot_sent = 0.0
+        self._last_map_rx = time.monotonic()
         # data-path transfer/dispatch accounting (perf-counter tier);
         # tests assert small writes/reads move O(stripe), not O(object)
         self.perf = {"subread_bytes": 0, "subwrite_bytes": 0,
@@ -307,6 +308,11 @@ class OSDDaemon:
     # -- map handling ------------------------------------------------------
 
     def _handle_map(self, msg: MOSDMapMsg) -> None:
+        """Advance the local map EPOCH BY EPOCH."""
+        self._last_map_rx = time.monotonic()
+        self._handle_map_inner(msg)
+
+    def _handle_map_inner(self, msg: MOSDMapMsg) -> None:
         """Advance the local map EPOCH BY EPOCH.
 
         Interval detection (_scan_pgs) is only correct if every epoch is
@@ -489,6 +495,23 @@ class OSDDaemon:
             if self.osdmap is None:
                 continue
             now = time.monotonic()
+            # mon session keepalive: a restarted mon loses subscriber
+            # connections silently; if maps have gone quiet, drop the
+            # possibly-half-open cached connection and re-subscribe on a
+            # fresh one (MonClient hunting/renew role).  A healthy mon
+            # answers MGetMap at once, which resets the quiet clock.
+            if now - self._last_map_rx > max(5.0, 4 * interval):
+                self._last_map_rx = now
+                stale = self.msgr._conns.get(self.mon_addr)
+                if stale is not None:
+                    stale.close()
+                try:
+                    await self.msgr.send_to(
+                        self.mon_addr,
+                        MGetMap(since_epoch=self.osdmap.epoch,
+                                subscribe=True))
+                except (ConnectionError, OSError):
+                    pass  # mon still down; retry next cycle
             peers = self._heartbeat_peers()
             # prune state for ex-peers so a later re-add restarts fresh
             for gone in set(self._hb_last_rx) - peers:

@@ -151,16 +151,38 @@ class RadosClient:
 
     async def mon_command(self, cmd: Dict[str, Any]
                           ) -> Tuple[int, Dict[str, Any]]:
-        tid = self._next_tid()
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._futures[tid] = fut
-        mon = await self.msgr.connect(self.mon_addr)
-        await mon.send(MMonCommand(tid, cmd))
-        try:
-            reply = await asyncio.wait_for(fut, self.op_timeout)
-        finally:
-            self._futures.pop(tid, None)
-        return reply.rc, reply.out
+        last: Optional[Exception] = None
+        resubscribe = False
+        for attempt in range(4):
+            tid = self._next_tid()
+            fut: asyncio.Future = \
+                asyncio.get_running_loop().create_future()
+            self._futures[tid] = fut
+            try:
+                mon = await self.msgr.connect(self.mon_addr)
+                if resubscribe:
+                    # the dropped connection carried the map
+                    # subscription: renew it or map updates silently
+                    # stop flowing to this client
+                    await mon.send(MGetMap(subscribe=True))
+                    resubscribe = False
+                await mon.send(MMonCommand(tid, cmd))
+                reply = await asyncio.wait_for(fut, self.op_timeout)
+                return reply.rc, reply.out
+            except (asyncio.TimeoutError, ConnectionError,
+                    OSError) as e:
+                # a restarted mon leaves a stale cached connection that
+                # may not have seen EOF yet: drop it and retry fresh
+                # after a beat (a restarting mon needs a moment to bind)
+                last = e
+                mon = self.msgr._conns.get(self.mon_addr)
+                if mon is not None:
+                    mon.close()
+                resubscribe = True
+                await asyncio.sleep(0.3 * (attempt + 1))
+            finally:
+                self._futures.pop(tid, None)
+        raise RadosError(EAGAIN, f"mon command {cmd!r} failed ({last!r})")
 
     async def create_replicated_pool(self, name: str, size: int = 3,
                                      pg_num: int = 32) -> int:

@@ -122,3 +122,69 @@ def test_multiprocess_cluster_ec_kill_restart(tmp_path):
             if proc.poll() is None:
                 proc.kill()
                 proc.wait()
+
+
+@pytest.mark.slow
+def test_mon_restart_survives(tmp_path):
+    """SIGKILL the MON process mid-run and restart it on its persisted
+    MonitorDBStore: pools, epochs, OSD states, and client I/O survive
+    (the Paxos-commit durability discipline, MonitorDBStore.h)."""
+    procs = {}
+    mon = _spawn(["-m", "ceph_tpu.mon", "--num-osds", "3",
+                  "--config", MON_CONFIG,
+                  "--store-path", str(tmp_path / "mon.db")])
+    try:
+        mon_addr = _read_addr(mon, "MON_ADDR")
+        mon_port = mon_addr.rsplit(":", 1)[1]
+        for i in range(3):
+            procs[i] = _spawn(
+                ["-m", "ceph_tpu.osd", "--id", str(i),
+                 "--mon", mon_addr,
+                 "--store-path", str(tmp_path / f"osd.{i}"),
+                 "--config", OSD_CONFIG])
+        for i in range(3):
+            _read_addr(procs[i], "OSD_ADDR")
+
+        async def drive():
+            from ceph_tpu.rados.client import RadosClient
+
+            client = RadosClient(mon_addr)
+            await client.connect()
+            try:
+                await client.create_replicated_pool(
+                    "rbd", size=3, pg_num=8)
+                ioctx = client.open_ioctx("rbd")
+                await ioctx.write_full("before", b"pre" * 5000)
+                epoch_before = client.osdmap.epoch
+
+                # SIGKILL the mon, restart on the SAME port + store
+                mon.send_signal(signal.SIGKILL)
+                mon.wait()
+                mon2 = _spawn(["-m", "ceph_tpu.mon", "--num-osds", "3",
+                               "--config", MON_CONFIG,
+                               "--port", mon_port,
+                               "--store-path",
+                               str(tmp_path / "mon.db")])
+                # register for cleanup IMMEDIATELY: a failing assert
+                # below must not leak the process (and its port)
+                procs["mon2"] = mon2
+                addr2 = _read_addr(mon2, "MON_ADDR")
+                assert addr2 == mon_addr
+
+                # cluster state survived: pool exists, epoch not reset
+                rc, out = await client.mon_command({"prefix": "status"})
+                assert rc == 0
+                assert out["epoch"] >= epoch_before
+                # old data reads and new writes work (OSDs re-subscribe)
+                assert await ioctx.read("before") == b"pre" * 5000
+                await ioctx.write_full("after", b"post" * 5000)
+                assert await ioctx.read("after") == b"post" * 5000
+            finally:
+                await client.shutdown()
+
+        asyncio.run(asyncio.wait_for(drive(), 180))
+    finally:
+        for proc in list(procs.values()) + [mon]:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
