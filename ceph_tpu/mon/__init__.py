@@ -329,6 +329,8 @@ class MonDaemon:
                 "osd erasure-code-profile set": self._cmd_profile_set,
                 "osd erasure-code-profile get": self._cmd_profile_get,
                 "osd pool create": self._cmd_pool_create,
+                "osd pool mksnap": self._cmd_snap_create,
+                "osd pool rmsnap": self._cmd_snap_remove,
                 "osd down": self._cmd_osd_down,
                 "osd out": self._cmd_osd_out,
                 "osd in": self._cmd_osd_in,
@@ -388,6 +390,49 @@ class MonDaemon:
             inc.new_crush = scratch.crush  # carries the new EC rule
         self._commit(inc)
         return 0, {"pool_id": pool.id}
+
+    def _pool_snap_inc(self, name: str):
+        """Scratch-copy a pool for a snap mutation; returns
+        (pool_copy, incremental) or (None, None) when no such pool."""
+        pool_id = self.osdmap.lookup_pool(name)
+        if pool_id < 0:
+            return None, None
+        from ceph_tpu.common.encoding import Decoder, Encoder
+
+        enc = Encoder()
+        self.osdmap.pools[pool_id].encode(enc)
+        from ceph_tpu.osd.osdmap import PgPool
+
+        pool = PgPool.decode(Decoder(enc.to_bytes()))
+        inc = Incremental(epoch=self.osdmap.epoch + 1)
+        inc.new_pools[pool.id] = pool
+        return pool, inc
+
+    def _cmd_snap_create(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        """Self-managed snapshot id allocation (the
+        OSDMonitor selfmanaged_snap_create role): bump the pool's
+        snap_seq through an Incremental and hand the id back."""
+        pool, inc = self._pool_snap_inc(cmd["name"])
+        if pool is None:
+            return -2, {"error": "no such pool"}
+        pool.snap_seq += 1
+        self._commit(inc)
+        return 0, {"snap_id": pool.snap_seq}
+
+    def _cmd_snap_remove(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        """Retire a snap id: lands in pool.removed_snaps; primaries trim
+        clones when they observe the new map (snap trim role)."""
+        pool, inc = self._pool_snap_inc(cmd["name"])
+        if pool is None:
+            return -2, {"error": "no such pool"}
+        snap_id = int(cmd["snap_id"])
+        if snap_id <= 0 or snap_id > pool.snap_seq:
+            return -22, {"error": f"bad snap id {snap_id}"}
+        if snap_id not in pool.removed_snaps:
+            pool.removed_snaps.append(snap_id)
+            pool.removed_snaps.sort()
+        self._commit(inc)
+        return 0, {}
 
     def _cmd_osd_down(self, cmd) -> Tuple[int, Dict[str, Any]]:
         osd = int(cmd["osd"])

@@ -294,13 +294,26 @@ class MOSDOp(Message):
     TAG = 9
 
     def __init__(self, tid: int, client: str, pg: PgId, oid: str,
-                 ops: List[OSDOp], epoch: int):
+                 ops: List[OSDOp], epoch: int,
+                 snapc_seq: int = 0,
+                 snapc_snaps: Optional[List[int]] = None,
+                 snap_id: int = 0):
         self.tid = tid
         self.client = client
         self.pg = pg
         self.oid = oid
         self.ops = ops
         self.epoch = epoch
+        # write-time snap context (SnapContext: seq + live snap ids,
+        # newest first) and read-time snap id (0 = head)
+        self.snapc_seq = snapc_seq
+        self.snapc_snaps = snapc_snaps or []
+        self.snap_id = snap_id
+
+    # v2 appends the snap context + read snap; COMPAT stays 1 so a v1
+    # frame (pre-snapshot peer) still decodes with head-only defaults
+    VERSION = 2
+    COMPAT = 1
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u64(self.tid)
@@ -309,11 +322,22 @@ class MOSDOp(Message):
         enc.string(self.oid)
         enc.list(self.ops, lambda e, op: op.encode(e))
         enc.u32(self.epoch)
+        enc.u64(self.snapc_seq)
+        enc.list(self.snapc_snaps, Encoder.u64)
+        enc.u64(self.snap_id)
 
     @classmethod
-    def decode_payload(cls, dec: Decoder) -> "MOSDOp":
-        return cls(dec.u64(), dec.string(), _dec_pg(dec), dec.string(),
-                   dec.list(OSDOp.decode), dec.u32())
+    def decode(cls, data: bytes) -> "MOSDOp":
+        dec = Decoder(data)
+        struct_v = dec.start(cls.VERSION)
+        msg = cls(dec.u64(), dec.string(), _dec_pg(dec), dec.string(),
+                  dec.list(OSDOp.decode), dec.u32())
+        if struct_v >= 2:
+            msg.snapc_seq = dec.u64()
+            msg.snapc_snaps = dec.list(Decoder.u64)
+            msg.snap_id = dec.u64()
+        dec.finish()
+        return msg
 
 
 @register

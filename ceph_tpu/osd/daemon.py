@@ -70,7 +70,12 @@ from ceph_tpu.osd.pg_log import (
     ev,
     make_entry,
 )
-from ceph_tpu.rados.embedded import HINFO_ATTR, OI_ATTR, shard_collection
+from ceph_tpu.rados.embedded import (
+    HINFO_ATTR,
+    OI_ATTR,
+    SS_ATTR,
+    shard_collection,
+)
 
 log = logging.getLogger("osd")
 
@@ -94,6 +99,20 @@ DEFAULTS = {
 # can never destroy the last completed write's reconstructability —
 # the ghobject generation / rollback machinery of ECTransaction)
 RB_PREFIX = "_rbgen_"
+
+# snapshot clone objects: "<head>\x16<cloneid>" (the ghobject snap
+# field role).  The separator is unprintable so client object names can
+# never collide with clone names.
+SNAP_SEP = "\x16"
+
+
+def clone_name(oid: str, cloneid: int) -> str:
+    return f"{oid}{SNAP_SEP}{cloneid}"
+
+
+def is_internal_name(name: str) -> bool:
+    """Names clients may not address and pgls must not list."""
+    return name.startswith(RB_PREFIX) or SNAP_SEP in name
 
 
 class PGState:
@@ -121,6 +140,9 @@ class PGState:
         self.obj_locks: Dict[str, list] = {}  # oid -> [Lock, refcount]
         self.extent_cache: "OrderedDict[str, Dict[str, Any]]" = \
             OrderedDict()
+        # snap ids this primary has already trimmed from its objects
+        self.trimmed_snaps: Set[int] = set()
+        self.trim_task: Optional[asyncio.Task] = None
 
     def obj_lock(self, oid: str) -> "_ObjLockCtx":
         """Refcounted per-object lock: the entry is only evictable when
@@ -444,6 +466,7 @@ class OSDDaemon:
                     state.peering_task = \
                         asyncio.get_running_loop().create_task(
                             self._peer_pg(state, pool))
+                self._note_trim_candidates(state, pool)
 
     # -- heartbeats --------------------------------------------------------
 
@@ -579,6 +602,17 @@ class OSDDaemon:
                 t.setattr(cid, obj, op.name, op.value)
             elif op.op == "remove":
                 t.remove(cid, obj)
+            elif op.op == "clone":
+                # snapshot clone-on-write (make_writeable role): copy
+                # the shard's CURRENT state to the clone object.  A
+                # shard that doesn't hold the object yet (degraded)
+                # simply skips — recovery will reconstruct the clone.
+                try:
+                    self.store.stat(cid, obj)
+                except (KeyError, IOError):
+                    pass
+                else:
+                    t.clone(cid, obj, ObjectId(op.name))
             else:
                 raise ValueError(f"unknown shard op {op.op!r}")
 
@@ -987,6 +1021,179 @@ class OSDDaemon:
                 return version, members, ois[version]
         return None, {}, None
 
+    # -- snapshots (self-managed snaps, SnapMapper-lite) -------------------
+    #
+    # SnapSet JSON on every head shard (SS_ATTR): {"seq", "clones":
+    # [{"cloneid", "snaps", "size"}]} — the object_snaps/SnapSet role
+    # (/root/reference/src/osd/osd_types.h SnapSet,
+    # src/osd/PrimaryLogPG.cc make_writeable).  Clone shard objects are
+    # "<oid>\x16<cloneid>" in the same collections, recovered/backfilled
+    # like any object.
+
+    @staticmethod
+    def _decode_ss(at: Dict[str, bytes]) -> Dict[str, Any]:
+        try:
+            return json.loads(at[SS_ATTR])
+        except (KeyError, ValueError):
+            return {"seq": 0, "clones": []}
+
+    async def _head_info(self, state: PGState, pool, oid: str
+                         ) -> Tuple[Optional[dict], Dict[str, Any]]:
+        """(object_info | None, snapset) of the head via a 1-byte
+        ranged gather (attrs ride along)."""
+        candidates = await self._gather_object_shards(
+            state, pool, oid, offset=0, length=1)
+        if not candidates:
+            return None, {"seq": 0, "clones": []}
+        need = self._codec(pool.id).get_data_chunk_count() \
+            if pool.type == TYPE_ERASURE else 1
+        version, chosen, oi = self._select_consistent(candidates,
+                                                      need=need)
+        if version is None:
+            return None, {"seq": 0, "clones": []}
+        src = next(iter(chosen))
+        for shard, _payload, at in candidates:
+            if shard == src and self._oi_version(at) == version:
+                return oi, self._decode_ss(at)
+        return oi, {"seq": 0, "clones": []}
+
+    async def _snap_clone_prep(
+            self, state: PGState, pool, oid: str,
+            snapc_seq: int, snapc_snaps: List[int]
+    ) -> Tuple[List[ShardOp], Optional[bytes]]:
+        """make_writeable: if the object predates the newest snap,
+        emit clone ops (prepended to the write on every shard) and the
+        updated SnapSet attr bytes.  Returns ([], None) when no snap
+        bookkeeping applies to this write."""
+        if snapc_seq <= 0:
+            return [], None
+        oi, ss = await self._head_info(state, pool, oid)
+        clone_ops: List[ShardOp] = []
+        if oi is not None and not oi.get("whiteout") and \
+                ss.get("seq", 0) < snapc_seq:
+            covered = sorted(s for s in snapc_snaps
+                             if s > ss.get("seq", 0))
+            if covered:
+                cloneid = covered[-1]
+                clone_ops.append(
+                    ShardOp("clone", name=clone_name(oid, cloneid)))
+                ss.setdefault("clones", []).append(
+                    {"cloneid": cloneid, "snaps": covered,
+                     "size": oi.get("size", 0)})
+        ss["seq"] = max(ss.get("seq", 0), snapc_seq)
+        return clone_ops, json.dumps(ss).encode()
+
+    async def _resolve_read_snap(self, state: PGState, pool, oid: str,
+                                 snap_id: int) -> Optional[str]:
+        """Map (oid, snap_id) -> the object holding that snap's data:
+        the head (data unchanged since the snap) or a clone.  None =
+        did not exist at that snap (PrimaryLogPG find_object_context
+        snap resolution)."""
+        oi, ss = await self._head_info(state, pool, oid)
+        if oi is None and not ss.get("clones"):
+            return None
+        prev = 0
+        for clone in sorted(ss.get("clones", []),
+                            key=lambda c: c["cloneid"]):
+            # a clone covers the snap range (prev_cloneid, cloneid],
+            # but only the snaps RECORDED in it existed with this
+            # object alive — a snap in the range but not in the list
+            # predates the object's creation (ENOENT at that snap)
+            if prev < snap_id <= clone["cloneid"]:
+                if snap_id in clone["snaps"]:
+                    return clone_name(oid, clone["cloneid"])
+                return None
+            prev = clone["cloneid"]
+        if oi is not None and not oi.get("whiteout") and \
+                snap_id > ss.get("seq", 0):
+            # no write has landed since that snap: head IS the snap.
+            # A snap <= seq with no covering clone predates the
+            # object's creation (the head was first written under a
+            # newer snap context) — ENOENT.
+            return oid
+        return None
+
+    def _note_trim_candidates(self, state: PGState, pool) -> None:
+        """Spawn a background trim when the pool's removed_snaps grew
+        (the snap trim role; scan-based SnapMapper-lite)."""
+        removed = set(getattr(pool, "removed_snaps", []))
+        pending = removed - state.trimmed_snaps
+        if not pending or state.primary != self.osd_id or \
+                state.state != "active" or state.trim_task is not None:
+            return
+        state.trim_task = asyncio.get_running_loop().create_task(
+            self._trim_pg_snaps(state, pool, pending))
+
+    async def _trim_pg_snaps(self, state: PGState, pool,
+                             pending: Set[int]) -> None:
+        try:
+            my_shard = state.my_shard(self.osd_id, pool.type)
+            # heads only: clones carry a STALE SnapSet copied by the
+            # store-level clone op and must never drive trim decisions
+            heads = [name for name in
+                     self._list_shard_objects(state.pg, my_shard)
+                     if not is_internal_name(name)]
+            for oid in heads:
+                async with state.obj_lock(oid):
+                    await self._trim_object(state, pool, oid, pending)
+            state.trimmed_snaps |= pending
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("osd.%d: snap trim %s failed", self.osd_id,
+                          state.pg)
+        finally:
+            state.trim_task = None
+            # snaps removed WHILE this trim ran would otherwise wait
+            # for an unrelated map change: re-check immediately
+            if not self._stopping and self.osdmap is not None:
+                cur = self.osdmap.pools.get(state.pg.pool)
+                if cur is not None:
+                    self._note_trim_candidates(state, cur)
+
+    async def _trim_object(self, state: PGState, pool, oid: str,
+                           pending: Set[int]) -> None:
+        oi, ss = await self._head_info(state, pool, oid)
+        clones = ss.get("clones", [])
+        if not clones:
+            return
+        keep = []
+        doomed = []
+        for clone in clones:
+            live = [s for s in clone["snaps"] if s not in pending]
+            if live:
+                clone["snaps"] = live
+                keep.append(clone)
+            else:
+                doomed.append(clone)
+        if not doomed:
+            return
+        ss["clones"] = keep
+        n_shards = self._codec(pool.id).get_chunk_count() \
+            if pool.type == TYPE_ERASURE else 1
+        shards = range(n_shards) if pool.type == TYPE_ERASURE else [-1]
+        for clone in doomed:
+            entry = self._next_entry(
+                state, pool, clone_name(oid, clone["cloneid"]),
+                "delete")
+            await self._submit_shard_writes(
+                state, pool, clone_name(oid, clone["cloneid"]),
+                {s: [ShardOp("remove")] for s in shards}, entry)
+        if oi is not None and oi.get("whiteout") and not keep:
+            # deleted head kept alive only for its clones: finish it
+            entry = self._next_entry(state, pool, oid, "delete")
+            await self._submit_shard_writes(
+                state, pool, oid,
+                {s: [ShardOp("remove")] for s in shards}, entry)
+        elif oi is not None:
+            entry = self._next_entry(state, pool, oid, "modify",
+                                     oi.get("size", 0))
+            ss_raw = json.dumps(ss).encode()
+            await self._submit_shard_writes(
+                state, pool, oid,
+                {s: [ShardOp("setattr", name=SS_ATTR, value=ss_raw)]
+                 for s in shards}, entry)
+
     async def _recover_pg(self, state: PGState, pool,
                           peer_shards: Dict[int, int]) -> None:
         """Recover missing objects: mine by reconstruct, peers by push."""
@@ -1172,31 +1379,42 @@ class OSDDaemon:
     async def _execute_ops(self, state: PGState, pool, msg: MOSDOp
                            ) -> Tuple[int, bytes, Dict[str, Any]]:
         rc, data, out = 0, b"", {}
-        if msg.oid.startswith(RB_PREFIX):
-            # rollback generations are internal bookkeeping, not
-            # client-addressable objects
+        if is_internal_name(msg.oid):
+            # rollback generations and snap clones are internal
+            # bookkeeping, not client-addressable objects
             return EINVAL, b"", {}
         # interval the op was admitted under: sub-writes are stamped
         # with this so a demoted primary's parked op cannot pass replica
         # fencing with a fresher live epoch
         state_admit_epoch = state.interval_epoch
+        snapc = (msg.snapc_seq, msg.snapc_snaps) \
+            if msg.snapc_seq > 0 else None
+        read_oid = msg.oid
+        if msg.snap_id > 0:
+            # snap reads resolve to the head or a clone server-side
+            resolved = await self._resolve_read_snap(
+                state, pool, msg.oid, msg.snap_id)
+            if resolved is None:
+                return ENOENT, b"", {}
+            read_oid = resolved
         for op in msg.ops:
             if op.op == "write_full":
                 rc = await self._op_write_full(state, pool, msg.oid,
                                                op.data,
-                                               state_admit_epoch)
+                                               state_admit_epoch,
+                                               snapc)
             elif op.op == "write":
                 rc = await self._op_write(state, pool, msg.oid,
                                           op.offset, op.data,
-                                          state_admit_epoch)
+                                          state_admit_epoch, snapc)
             elif op.op == "read":
-                rc, data = await self._op_read(state, pool, msg.oid,
+                rc, data = await self._op_read(state, pool, read_oid,
                                                op.offset, op.length)
             elif op.op == "stat":
-                rc, out = await self._op_stat(state, pool, msg.oid)
+                rc, out = await self._op_stat(state, pool, read_oid)
             elif op.op == "remove":
                 rc = await self._op_remove(state, pool, msg.oid,
-                                           state_admit_epoch)
+                                           state_admit_epoch, snapc)
             elif op.op == "pgls":
                 rc, out = self._op_pgls(state, pool)
             else:
@@ -1337,18 +1555,24 @@ class OSDDaemon:
 
     async def _op_write_full(self, state: PGState, pool, oid: str,
                              data: bytes,
-                             admit_epoch: Optional[int] = None) -> int:
-        if pool.type == TYPE_ERASURE:
-            async with state.obj_lock(oid):
+                             admit_epoch: Optional[int] = None,
+                             snapc=None) -> int:
+        # per-object lock on EVERY pool type: SnapSet updates are
+        # read-modify-write and must not race other writes or trim
+        async with state.obj_lock(oid):
+            if pool.type == TYPE_ERASURE:
                 state.extent_cache.pop(oid, None)
-                return await self._op_write_full_locked(
-                    state, pool, oid, data, admit_epoch)
-        return await self._op_write_full_locked(state, pool, oid, data,
-                                                admit_epoch)
+            return await self._op_write_full_locked(
+                state, pool, oid, data, admit_epoch, snapc)
 
     async def _op_write_full_locked(
             self, state: PGState, pool, oid: str, data: bytes,
-            admit_epoch: Optional[int] = None) -> int:
+            admit_epoch: Optional[int] = None, snapc=None) -> int:
+        clone_ops: List[ShardOp] = []
+        ss_raw: Optional[bytes] = None
+        if snapc is not None:
+            clone_ops, ss_raw = await self._snap_clone_prep(
+                state, pool, oid, snapc[0], snapc[1])
         entry = self._next_entry(state, pool, oid, "modify", len(data))
         oi = json.dumps({"size": len(data),
                          "version": entry["version"]}).encode()
@@ -1375,16 +1599,40 @@ class OSDDaemon:
                     ShardOp("write", 0, buf),
                     ShardOp("setattr", name=OI_ATTR, value=oi),
                     ShardOp("setattr", name=HINFO_ATTR, value=hinfo_raw)]
+        self._apply_snap_ops(shard_ops, clone_ops, ss_raw)
         return await self._submit_shard_writes(state, pool, oid,
                                                shard_ops, entry,
                                                admit_epoch)
 
+    @staticmethod
+    def _apply_snap_ops(shard_ops: Dict[int, List[ShardOp]],
+                        clone_ops: List[ShardOp],
+                        ss_raw: Optional[bytes]) -> None:
+        """Prepend the clone (captures pre-write state) and append the
+        updated SnapSet attr on every shard's op list."""
+        for ops in shard_ops.values():
+            if clone_ops:
+                ops[:0] = list(clone_ops)
+            if ss_raw is not None:
+                ops.append(ShardOp("setattr", name=SS_ATTR,
+                                   value=ss_raw))
+
     async def _op_write(self, state: PGState, pool, oid: str,
                         offset: int, data: bytes,
-                        admit_epoch: Optional[int] = None) -> int:
+                        admit_epoch: Optional[int] = None,
+                        snapc=None) -> int:
         """Partial-extent write.  Replicated: direct range write.
-        EC: stripe-level read-modify-write (the start_rmw pipeline)."""
-        if pool.type == TYPE_REPLICATED:
+        EC: stripe-level read-modify-write (the start_rmw pipeline).
+        Both under the per-object lock (SnapSet RMW must not race)."""
+        async with state.obj_lock(oid):
+            if pool.type == TYPE_ERASURE:
+                return await self._ec_rmw(state, pool, oid, offset,
+                                          data, admit_epoch, snapc)
+            clone_ops: List[ShardOp] = []
+            ss_raw: Optional[bytes] = None
+            if snapc is not None:
+                clone_ops, ss_raw = await self._snap_clone_prep(
+                    state, pool, oid, snapc[0], snapc[1])
             entry = self._next_entry(state, pool, oid, "modify")
             rc, old_size = await self._stat_size(state, pool, oid)
             new_size = max(old_size if rc == 0 else 0,
@@ -1394,16 +1642,16 @@ class OSDDaemon:
             ops = [ShardOp("create"),
                    ShardOp("write", offset, data),
                    ShardOp("setattr", name=OI_ATTR, value=oi)]
+            shard_ops = {-1: ops}
+            self._apply_snap_ops(shard_ops, clone_ops, ss_raw)
             return await self._submit_shard_writes(state, pool, oid,
-                                                   {-1: ops}, entry,
+                                                   shard_ops, entry,
                                                    admit_epoch)
-        async with state.obj_lock(oid):
-            return await self._ec_rmw(state, pool, oid, offset, data,
-                                      admit_epoch)
 
     async def _ec_rmw(self, state: PGState, pool, oid: str,
                       offset: int, data: bytes,
-                      admit_epoch: Optional[int]) -> int:
+                      admit_epoch: Optional[int],
+                      snapc=None) -> int:
         """Stripe-level EC read-modify-write (ECBackend start_rmw ->
         try_state_to_reads -> try_reads_to_commit,
         /root/reference/src/osd/ECBackend.cc:1858-2087, with the
@@ -1423,6 +1671,12 @@ class OSDDaemon:
         chunk = sinfo.get_chunk_size()
         k = codec.get_data_chunk_count()
         n = codec.get_chunk_count()
+
+        clone_ops: List[ShardOp] = []
+        ss_raw: Optional[bytes] = None
+        if snapc is not None:
+            clone_ops, ss_raw = await self._snap_clone_prep(
+                state, pool, oid, snapc[0], snapc[1])
 
         start, span = sinfo.offset_len_to_stripe_bounds(
             (offset, len(data)))
@@ -1501,6 +1755,7 @@ class OSDDaemon:
                 ShardOp("write", chunk_off, frag),
                 ShardOp("setattr", name=OI_ATTR, value=oi_raw),
                 ShardOp("setattr", name=HINFO_ATTR, value=hinfo_raw)]
+        self._apply_snap_ops(shard_ops, clone_ops, ss_raw)
         rc = await self._submit_shard_writes(state, pool, oid,
                                              shard_ops, entry,
                                              admit_epoch)
@@ -1554,6 +1809,8 @@ class OSDDaemon:
                 rc, data, at = self._read_shard(state.pg, shard, oid)
                 if rc == 0 and OI_ATTR in at:
                     oi = json.loads(at[OI_ATTR])
+                    if oi.get("whiteout"):
+                        return ENOENT, b""
                     data = data[:oi.get("size", len(data))]
                     if length:
                         data = data[offset:offset + length]
@@ -1570,6 +1827,8 @@ class OSDDaemon:
                 candidates, need=1)
             if version is None:
                 return EIO, b""
+            if oi.get("whiteout"):
+                return ENOENT, b""
             data = chosen[next(iter(chosen))]
             data = data[:oi.get("size", len(data))]
             if length:
@@ -1600,6 +1859,8 @@ class OSDDaemon:
                 candidates, need=k)
             if version is None:
                 return EIO, b""
+            if oi.get("whiteout"):
+                return ENOENT, b""
             size = oi.get("size", 0)
             if offset >= size:
                 return 0, b""
@@ -1632,6 +1893,8 @@ class OSDDaemon:
             candidates, need=k, verify_hinfo=True)
         if version is None:
             return EIO, b""
+        if oi.get("whiteout"):
+            return ENOENT, b""
         size = oi.get("size", 0)
         want = {codec.chunk_index(i) for i in range(k)}
         try:
@@ -1662,35 +1925,73 @@ class OSDDaemon:
             candidates, need=need)
         if version is None:
             return EIO, {}
+        if oi.get("whiteout"):
+            return ENOENT, {}
         return 0, {"size": oi.get("size", 0),
                    "version": oi.get("version")}
 
     async def _op_remove(self, state: PGState, pool, oid: str,
-                         admit_epoch: Optional[int] = None) -> int:
-        state.extent_cache.pop(oid, None)
-        rc, _ = await self._op_stat(state, pool, oid)
-        if rc == ENOENT:
-            return ENOENT
-        entry = self._next_entry(state, pool, oid, "delete")
-        ops = [ShardOp("remove")]
-        if pool.type == TYPE_REPLICATED:
-            shard_ops = {-1: list(ops)}
-        else:
-            codec = self._codec(pool.id)
-            shard_ops = {s: list(ops)
-                         for s in range(codec.get_chunk_count())}
-        return await self._submit_shard_writes(state, pool, oid,
-                                               shard_ops, entry,
-                                               admit_epoch)
+                         admit_epoch: Optional[int] = None,
+                         snapc=None) -> int:
+        async with state.obj_lock(oid):
+            state.extent_cache.pop(oid, None)
+            # the whiteout decision depends on the HEAD's SnapSet, not
+            # on whether the deleting client supplied a snap context: a
+            # snapless client's remove must never orphan live clones
+            oi, ss = await self._head_info(state, pool, oid)
+            if oi is None or oi.get("whiteout"):
+                return ENOENT
+            clone_ops: List[ShardOp] = []
+            ss_raw: Optional[bytes] = None
+            if snapc is not None:
+                clone_ops, ss_raw = await self._snap_clone_prep(
+                    state, pool, oid, snapc[0], snapc[1])
+                if ss_raw is not None:
+                    ss = json.loads(ss_raw)
+            if pool.type == TYPE_REPLICATED:
+                shards = [-1]
+            else:
+                shards = list(
+                    range(self._codec(pool.id).get_chunk_count()))
+            if clone_ops or ss.get("clones"):
+                # snapshots still reference this object's data: the
+                # head becomes a WHITEOUT carrying the SnapSet until
+                # every clone is trimmed (the snapdir/whiteout role)
+                entry = self._next_entry(state, pool, oid, "modify")
+                oi_raw = json.dumps(
+                    {"size": 0, "whiteout": True,
+                     "version": entry["version"]}).encode()
+                ops = [ShardOp("truncate", size=0),
+                       ShardOp("setattr", name=OI_ATTR, value=oi_raw)]
+                shard_ops = {s: list(ops) for s in shards}
+                self._apply_snap_ops(shard_ops, clone_ops,
+                                     ss_raw or json.dumps(ss).encode())
+                return await self._submit_shard_writes(
+                    state, pool, oid, shard_ops, entry, admit_epoch)
+            entry = self._next_entry(state, pool, oid, "delete")
+            shard_ops = {s: [ShardOp("remove")] for s in shards}
+            return await self._submit_shard_writes(state, pool, oid,
+                                                   shard_ops, entry,
+                                                   admit_epoch)
 
     def _op_pgls(self, state: PGState, pool
                  ) -> Tuple[int, Dict[str, Any]]:
         shard = state.my_shard(self.osd_id, pool.type)
         cid = self._cid(state.pg, shard)
+        names = []
         try:
-            names = [str(o) for o in self.store.list_objects(cid)
-                     if str(o) != PGMETA_OID
-                     and not str(o).startswith(RB_PREFIX)]
+            for o in self.store.list_objects(cid):
+                name = str(o)
+                if name == PGMETA_OID or is_internal_name(name):
+                    continue
+                try:  # whiteouts (deleted heads kept for snaps) hidden
+                    oi = json.loads(self.store.getattr(
+                        cid, o, OI_ATTR))
+                    if oi.get("whiteout"):
+                        continue
+                except (KeyError, ValueError):
+                    pass
+                names.append(name)
         except KeyError:
-            names = []
+            pass
         return 0, {"objects": sorted(names)}

@@ -101,6 +101,11 @@ class PgPool:
         self.flags = flags
         self.opts: Dict[str, object] = {}  # pool_opts_t (csum/compression)
         self.last_change = 0
+        # self-managed snapshots (pg_pool_t snap_seq / removed_snaps):
+        # snap ids are allocated by the mon from snap_seq; removed ids
+        # accumulate until every OSD has trimmed them
+        self.snap_seq = 0
+        self.removed_snaps: List[int] = []
 
     @property
     def pg_num_mask(self) -> int:
@@ -132,8 +137,10 @@ class PgPool:
 
     def encode(self, enc: Encoder) -> None:
         # v2 changes the meaning of opts values (str -> JSON), so compat
-        # is 2 as well: a v1-only decoder must reject, not misread
-        enc.start(2, 2)
+        # is 2 as well: a v1-only decoder must reject, not misread.
+        # v3 appends snap_seq/removed_snaps (readable by v2 logic? no —
+        # appended fields are version-gated below, compat stays 2).
+        enc.start(3, 2)
         enc.s64(self.id)
         enc.string(self.name)
         enc.u8(self.type)
@@ -149,11 +156,13 @@ class PgPool:
         # csum/compression settings) survive an encode/decode round-trip
         enc.map(self.opts, Encoder.string,
                 lambda e, v: e.string(json.dumps(v)))
+        enc.u64(self.snap_seq)
+        enc.list(self.removed_snaps, Encoder.u64)
         enc.finish()
 
     @classmethod
     def decode(cls, dec: Decoder) -> "PgPool":
-        struct_v = dec.start(2)
+        struct_v = dec.start(3)
         pool = cls(dec.s64(), dec.string())
         pool.type = dec.u8()
         pool.size = dec.u32()
@@ -169,6 +178,9 @@ class PgPool:
             pool.opts = {k: json.loads(v) for k, v in raw_opts.items()}
         else:  # v1 encoded opts as bare str(v); values stay strings
             pool.opts = raw_opts
+        if struct_v >= 3:
+            pool.snap_seq = dec.u64()
+            pool.removed_snaps = dec.list(Decoder.u64)
         dec.finish()
         return pool
 

@@ -233,10 +233,46 @@ class IoCtx:
     def __init__(self, client: RadosClient, pool_id: int):
         self.client = client
         self.pool_id = pool_id
+        # write-time snap context (librados set_snap_context role) and
+        # read-time snap id (snap_set_read role); 0 = head
+        self.snapc_seq = 0
+        self.snapc_snaps: List[int] = []
+        self.read_snap = 0
 
     @property
     def pool(self):
         return self.client.osdmap.pools[self.pool_id]
+
+    # -- self-managed snapshots (librados selfmanaged_snap_* roles) --------
+
+    async def create_selfmanaged_snap(self) -> int:
+        """Allocate a snap id from the mon and fold it into this
+        IoCtx's snap context."""
+        rc, out = await self.client.mon_command({
+            "prefix": "osd pool mksnap", "name": self.pool.name})
+        if rc != 0:
+            raise RadosError(rc, str(out))
+        snap_id = out["snap_id"]
+        self.set_snap_context(snap_id, [snap_id] + self.snapc_snaps)
+        return snap_id
+
+    async def remove_selfmanaged_snap(self, snap_id: int) -> None:
+        rc, out = await self.client.mon_command({
+            "prefix": "osd pool rmsnap", "name": self.pool.name,
+            "snap_id": snap_id})
+        if rc != 0:
+            raise RadosError(rc, str(out))
+        self.set_snap_context(
+            self.snapc_seq,
+            [s for s in self.snapc_snaps if s != snap_id])
+
+    def set_snap_context(self, seq: int, snaps: List[int]) -> None:
+        self.snapc_seq = seq
+        self.snapc_snaps = sorted(snaps, reverse=True)
+
+    def snap_set_read(self, snap_id: int) -> None:
+        """Subsequent reads resolve at this snap (0 = head)."""
+        self.read_snap = snap_id
 
     def object_pg(self, name: str) -> PgId:
         ps = ceph_str_hash_rjenkins(name.encode())
@@ -263,7 +299,10 @@ class IoCtx:
             try:
                 await client.msgr.send_to(
                     addr, MOSDOp(tid, client.msgr.entity_name, pg, oid,
-                                 ops, osdmap.epoch))
+                                 ops, osdmap.epoch,
+                                 snapc_seq=self.snapc_seq,
+                                 snapc_snaps=self.snapc_snaps,
+                                 snap_id=self.read_snap))
                 reply = await asyncio.wait_for(fut, client.op_timeout)
             except (ConnectionError, OSError) as e:
                 last_error = e

@@ -38,6 +38,7 @@ from ceph_tpu.osd.osdmap import (
 
 OI_ATTR = "_"            # object_info_t xattr key
 HINFO_ATTR = ec_util.HINFO_KEY
+SS_ATTR = "snapset"      # SnapSet xattr key (SS_ATTR role)
 
 
 def shard_collection(pg: PgId, shard: int) -> str:

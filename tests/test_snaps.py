@@ -1,0 +1,164 @@
+"""Self-managed snapshot tier: clone-on-write, read-at-snap, whiteout,
+trim — the write/snap/overwrite/read-at-snap/trim round-trip of the
+reference's snapshot model (PrimaryLogPG make_writeable, SnapSet,
+SnapMapper trim; /root/reference/src/osd/SnapMapper.h:102)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from cluster_helpers import Cluster
+
+EC22 = {"plugin": "ec_jax", "technique": "reed_sol_van",
+        "k": "2", "m": "2", "crush-failure-domain": "osd",
+        "tpu": "false"}
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 180))
+
+
+async def _snap_round_trip(cluster, make_pool):
+    io = await make_pool(cluster)
+    v1 = bytes(np.random.default_rng(1).integers(0, 256, 60_000,
+                                                 dtype=np.uint8))
+    v2 = bytes(np.random.default_rng(2).integers(0, 256, 70_000,
+                                                 dtype=np.uint8))
+    v3 = bytes(np.random.default_rng(3).integers(0, 256, 40_000,
+                                                 dtype=np.uint8))
+    await io.write_full("obj", v1)
+    s1 = await io.create_selfmanaged_snap()
+    await io.write_full("obj", v2)          # clones v1 under s1
+    s2 = await io.create_selfmanaged_snap()
+    await io.write("obj", v3, 10_000)       # partial write clones v2
+    head = bytearray(v2)
+    head[10_000:10_000 + len(v3)] = v3
+
+    assert await io.read("obj") == bytes(head)
+    io.snap_set_read(s1)
+    assert await io.read("obj") == v1
+    io.snap_set_read(s2)
+    assert await io.read("obj") == v2
+    io.snap_set_read(0)
+    assert await io.read("obj") == bytes(head)
+    # snap reads of never-written objects miss
+    io.snap_set_read(s1)
+    with pytest.raises(Exception):
+        await io.read("nope")
+    io.snap_set_read(0)
+    return io, v1, v2, bytes(head), s1, s2
+
+
+def test_replicated_snap_round_trip_and_trim():
+    async def main():
+        cluster = Cluster(num_osds=4)
+        await cluster.start()
+        try:
+            async def mk(c):
+                await c.client.create_replicated_pool(
+                    "p", size=3, pg_num=8)
+                return c.client.open_ioctx("p")
+
+            io, v1, v2, head, s1, s2 = await _snap_round_trip(
+                cluster, mk)
+
+            # trim s1: its clone dies once every primary observes the
+            # removal; s2's data must survive
+            await io.remove_selfmanaged_snap(s1)
+            await asyncio.sleep(1.0)
+            io.snap_set_read(s2)
+            assert await io.read("obj") == v2
+            io.snap_set_read(0)
+            assert await io.read("obj") == head
+            # the s1 clone object is gone from every store
+            for osd in cluster.osds.values():
+                for cid in osd.store.list_collections():
+                    for o in osd.store.list_objects(cid):
+                        assert f"obj\x16{s1}" != str(o), \
+                            f"untrimmed clone on osd {cid}"
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_ec_snap_round_trip():
+    async def main():
+        cluster = Cluster(num_osds=5)
+        await cluster.start()
+        try:
+            async def mk(c):
+                await c.client.create_ec_pool(
+                    "ec", profile=EC22, pg_num=8)
+                return c.client.open_ioctx("ec")
+
+            await _snap_round_trip(cluster, mk)
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_remove_with_snaps_whiteout_then_trim():
+    """Deleting a snapshotted object hides it from reads/listing but
+    keeps snap data readable until the snaps are removed; trimming the
+    last snap finishes the delete."""
+    async def main():
+        cluster = Cluster(num_osds=4)
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool(
+                "p", size=3, pg_num=8)
+            io = cluster.client.open_ioctx("p")
+            await io.write_full("obj", b"alive" * 1000)
+            snap = await io.create_selfmanaged_snap()
+            await io.remove("obj")
+            with pytest.raises(Exception):
+                await io.read("obj")
+            assert await io.list_objects() == []
+            io.snap_set_read(snap)
+            assert await io.read("obj") == b"alive" * 1000
+            io.snap_set_read(0)
+            # trim the snap: everything about the object disappears
+            await io.remove_selfmanaged_snap(snap)
+            await asyncio.sleep(1.0)
+            for osd in cluster.osds.values():
+                for cid in osd.store.list_collections():
+                    for o in osd.store.list_objects(cid):
+                        assert "obj" not in str(o) or \
+                            "_pgmeta_" in str(o), f"leftover {o}"
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_snap_before_creation_is_enoent():
+    """A snap taken before an object existed must read ENOENT at that
+    snap, even after later writes create clones (review r3)."""
+    async def main():
+        cluster = Cluster(num_osds=4)
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool(
+                "p", size=3, pg_num=8)
+            io = cluster.client.open_ioctx("p")
+            s1 = await io.create_selfmanaged_snap()   # before creation
+            await io.write_full("late", b"born" * 500)
+            s2 = await io.create_selfmanaged_snap()
+            await io.write_full("late", b"grew" * 600)
+            io.snap_set_read(s1)
+            with pytest.raises(Exception):
+                await io.read("late")
+            io.snap_set_read(s2)
+            assert await io.read("late") == b"born" * 500
+            # snapless client's remove must keep clones reachable
+            io2 = cluster.client.open_ioctx("p")
+            await io2.remove("late")
+            io.snap_set_read(s2)
+            assert await io.read("late") == b"born" * 500
+        finally:
+            await cluster.stop()
+
+    run(main())
