@@ -376,7 +376,10 @@ class ShardOp:
 
     def __init__(self, op: str, offset: int = 0, data: bytes = b"",
                  name: str = "", value: bytes = b"", size: int = 0):
-        self.op = op          # write | truncate | remove | setattr | create
+        # write | truncate | remove | setattr | rmattr | create |
+        # clone | omap_set | omap_rm  (omap payloads ride in `data`
+        # as an encoded map/list)
+        self.op = op
         self.offset = offset
         self.data = data
         self.name = name
@@ -464,9 +467,12 @@ class MOSDSubRead(Message):
 
     TAG = 13
 
+    VERSION = 2  # v2 appends want_omap
+    COMPAT = 1
+
     def __init__(self, tid: int, pg: PgId, shard: int, oid: str,
                  offset: int = 0, length: int = 0,
-                 want_attrs: bool = True):
+                 want_attrs: bool = True, want_omap: bool = False):
         self.tid = tid
         self.pg = pg
         self.shard = shard
@@ -474,6 +480,7 @@ class MOSDSubRead(Message):
         self.offset = offset
         self.length = length
         self.want_attrs = want_attrs
+        self.want_omap = want_omap
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u64(self.tid)
@@ -483,25 +490,37 @@ class MOSDSubRead(Message):
         enc.u64(self.offset)
         enc.u64(self.length)
         enc.bool(self.want_attrs)
+        enc.bool(self.want_omap)
 
     @classmethod
-    def decode_payload(cls, dec: Decoder) -> "MOSDSubRead":
-        return cls(dec.u64(), _dec_pg(dec), dec.s32(), dec.string(),
-                   dec.u64(), dec.u64(), dec.bool())
+    def decode(cls, data: bytes) -> "MOSDSubRead":
+        dec = Decoder(data)
+        struct_v = dec.start(cls.VERSION)
+        msg = cls(dec.u64(), _dec_pg(dec), dec.s32(), dec.string(),
+                  dec.u64(), dec.u64(), dec.bool())
+        if struct_v >= 2:
+            msg.want_omap = dec.bool()
+        dec.finish()
+        return msg
 
 
 @register
 class MOSDSubReadReply(Message):
     TAG = 14
 
+    VERSION = 2  # v2 appends the omap payload
+    COMPAT = 1
+
     def __init__(self, tid: int, rc: int, data: bytes = b"",
                  attrs: Optional[Dict[str, bytes]] = None,
-                 shard: int = -1):
+                 shard: int = -1,
+                 omap: Optional[Dict[str, bytes]] = None):
         self.tid = tid
         self.rc = rc
         self.data = data
         self.attrs = attrs or {}
         self.shard = shard
+        self.omap = omap or {}
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u64(self.tid)
@@ -509,11 +528,18 @@ class MOSDSubReadReply(Message):
         enc.bytes(self.data)
         enc.map(self.attrs, Encoder.string, Encoder.bytes)
         enc.s32(self.shard)
+        enc.map(self.omap, Encoder.string, Encoder.bytes)
 
     @classmethod
-    def decode_payload(cls, dec: Decoder) -> "MOSDSubReadReply":
-        return cls(dec.u64(), dec.s32(), dec.bytes(),
-                   dec.map(Decoder.string, Decoder.bytes), dec.s32())
+    def decode(cls, data: bytes) -> "MOSDSubReadReply":
+        dec = Decoder(data)
+        struct_v = dec.start(cls.VERSION)
+        msg = cls(dec.u64(), dec.s32(), dec.bytes(),
+                  dec.map(Decoder.string, Decoder.bytes), dec.s32())
+        if struct_v >= 2:
+            msg.omap = dec.map(Decoder.string, Decoder.bytes)
+        dec.finish()
+        return msg
 
 
 # -- peering ----------------------------------------------------------------
@@ -580,3 +606,85 @@ class MPGLogMsg(Message):
                    json.loads(dec.string()),
                    dec.list(lambda d: json.loads(d.string())),
                    dec.u32(), dec.s32(), dec.bool())
+
+
+@register
+class MWatchNotify(Message):
+    """Primary -> watcher: a notify fired on an object you watch
+    (MWatchNotify role, /root/reference/src/messages/MWatchNotify.h)."""
+
+    TAG = 17
+
+    def __init__(self, notify_id: int, pool: int, oid: str,
+                 payload: bytes = b"", cookie: int = 0):
+        self.notify_id = notify_id
+        self.pool = pool
+        self.oid = oid
+        self.payload = payload
+        self.cookie = cookie
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.notify_id)
+        enc.s64(self.pool)
+        enc.string(self.oid)
+        enc.bytes(self.payload)
+        enc.u64(self.cookie)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MWatchNotify":
+        return cls(dec.u64(), dec.s64(), dec.string(), dec.bytes(),
+                   dec.u64())
+
+
+@register
+class MWatchNotifyAck(Message):
+    """Watcher -> primary: notify delivered to the local callback."""
+
+    TAG = 18
+
+    def __init__(self, notify_id: int, cookie: int = 0):
+        self.notify_id = notify_id
+        self.cookie = cookie
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.notify_id)
+        enc.u64(self.cookie)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MWatchNotifyAck":
+        return cls(dec.u64(), dec.u64())
+
+
+# -- small wire codecs shared by ShardOp omap payloads ----------------------
+
+
+def encode_kv_map(kv) -> bytes:
+    enc = Encoder()
+    enc.start(1, 1)
+    enc.map(dict(kv), Encoder.string, Encoder.bytes)
+    enc.finish()
+    return enc.to_bytes()
+
+
+def decode_kv_map(raw: bytes) -> Dict[str, bytes]:
+    dec = Decoder(raw)
+    dec.start(1)
+    out = dec.map(Decoder.string, Decoder.bytes)
+    dec.finish()
+    return out
+
+
+def encode_str_list(items) -> bytes:
+    enc = Encoder()
+    enc.start(1, 1)
+    enc.list(list(items), Encoder.string)
+    enc.finish()
+    return enc.to_bytes()
+
+
+def decode_str_list(raw: bytes) -> List[str]:
+    dec = Decoder(raw)
+    dec.start(1)
+    out = dec.list(Decoder.string)
+    dec.finish()
+    return out

@@ -54,9 +54,14 @@ from ceph_tpu.msg.messages import (
     MPGLogMsg,
     MPGQuery,
     MPing,
+    MWatchNotify,
+    MWatchNotifyAck,
     PING,
     PING_REPLY,
     ShardOp,
+    decode_kv_map,
+    decode_str_list,
+    encode_kv_map,
 )
 from ceph_tpu.ops import checksum as cks
 from ceph_tpu.os import ObjectId, ObjectStore, Transaction
@@ -109,6 +114,15 @@ SNAP_SEP = "\x16"
 def clone_name(oid: str, cloneid: int) -> str:
     return f"{oid}{SNAP_SEP}{cloneid}"
 
+
+# user xattrs are namespaced so they can never collide with internal
+# attrs (OI/SS/hinfo) — the reference splits "_"-prefixed internals the
+# same way (object_info vs user xattr namespace)
+USER_ATTR_PREFIX = "u:"
+
+_encode_kv_map = encode_kv_map
+_decode_kv_map = decode_kv_map
+_decode_str_list = decode_str_list
 
 def is_internal_name(name: str) -> bool:
     """Names clients may not address and pgls must not list."""
@@ -213,6 +227,11 @@ class OSDDaemon:
         # tests assert small writes/reads move O(stripe), not O(object)
         self.perf = {"subread_bytes": 0, "subwrite_bytes": 0,
                      "encode_dispatches": 0, "decode_dispatches": 0}
+        # watch/notify: (pool, oid) -> {(client, cookie): Connection}
+        self.watchers: Dict[Tuple[int, str],
+                            Dict[Tuple[str, int], Connection]] = {}
+        self._notify_seq = 0
+        self._pending_notifies: Dict[int, Dict[str, Any]] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -319,6 +338,8 @@ class OSDDaemon:
             await self._handle_sub_read(conn, msg)
         elif isinstance(msg, (MOSDSubWriteReply, MOSDSubReadReply)):
             self._resolve(msg.tid, msg)
+        elif isinstance(msg, MWatchNotifyAck):
+            self._handle_notify_ack(conn, msg)
         elif isinstance(msg, MPGQuery):
             await self._handle_pg_query(conn, msg)
         elif isinstance(msg, MPGLogMsg):
@@ -600,6 +621,14 @@ class OSDDaemon:
                 t.write(cid, obj, op.offset, len(op.data), op.data)
             elif op.op == "setattr":
                 t.setattr(cid, obj, op.name, op.value)
+            elif op.op == "rmattr":
+                t.rmattr(cid, obj, op.name)
+            elif op.op == "omap_set":
+                t.omap_setkeys(cid, obj, _decode_kv_map(op.data))
+            elif op.op == "omap_rm":
+                t.omap_rmkeys(cid, obj, _decode_str_list(op.data))
+            elif op.op == "omap_clear":
+                t.omap_clear(cid, obj)
             elif op.op == "remove":
                 t.remove(cid, obj)
             elif op.op == "clone":
@@ -695,9 +724,16 @@ class OSDDaemon:
         rc, data, attrs = self._read_shard(
             msg.pg, msg.shard, msg.oid,
             msg.offset if msg.length else 0, msg.length)
+        omap: Dict[str, bytes] = {}
+        if rc == 0 and msg.want_omap:
+            try:
+                omap = self.store.omap_get(
+                    self._cid(msg.pg, msg.shard), ObjectId(msg.oid))
+            except (KeyError, IOError):
+                omap = {}
         await conn.send(MOSDSubReadReply(
             msg.tid, rc, data, attrs if msg.want_attrs else {},
-            shard=msg.shard))
+            shard=msg.shard, omap=omap))
 
     # -- peering -----------------------------------------------------------
 
@@ -1194,6 +1230,29 @@ class OSDDaemon:
                 {s: [ShardOp("setattr", name=SS_ATTR, value=ss_raw)]
                  for s in shards}, entry)
 
+    async def _fetch_omap_any(self, state: PGState, pool, oid: str
+                              ) -> Optional[Dict[str, bytes]]:
+        """Best-effort omap fetch from any up holder (recovery needs
+        the omap too, or a recovered replica silently loses it)."""
+        plog = self._load_log(state, pool)
+        if oid not in plog.missing:
+            try:
+                return self.store.omap_get(self._cid(state.pg, -1),
+                                           ObjectId(oid))
+            except (KeyError, IOError):
+                pass
+        for osd in state.acting:
+            if osd == CRUSH_ITEM_NONE or osd == self.osd_id or \
+                    not self.osdmap.is_up(osd):
+                continue
+            tid = self._next_tid()
+            reply = await self._request(
+                osd, MOSDSubRead(tid, state.pg, -1, oid, 0, 1,
+                                 want_omap=True), tid)
+            if reply is not None and reply.rc == 0:
+                return reply.omap
+        return None
+
     async def _recover_pg(self, state: PGState, pool,
                           peer_shards: Dict[int, int]) -> None:
         """Recover missing objects: mine by reconstruct, peers by push."""
@@ -1277,6 +1336,7 @@ class OSDDaemon:
                     return at
             return {}
 
+        omap_payload: Optional[Dict[str, bytes]] = None
         if pool.type == TYPE_REPLICATED:
             version, chosen, _oi = self._select_consistent(
                 candidates, need=1)
@@ -1284,6 +1344,7 @@ class OSDDaemon:
                 return  # no readable copy with an object_info: retry
             payload = {-1: chosen[next(iter(chosen))]}
             obj_attrs = _attrs_of(version, chosen)
+            omap_payload = await self._fetch_omap_any(state, pool, oid)
         else:
             codec = self._codec(pool.id)
             sinfo = self._sinfo(pool.id)
@@ -1313,6 +1374,13 @@ class OSDDaemon:
                    ShardOp("write", 0, buf)]
             for name, value in obj_attrs.items():
                 ops.append(ShardOp("setattr", name=name, value=value))
+            if pool.type == TYPE_REPLICATED:
+                # authoritative omap REPLACES the target's: clear
+                # first or deleted keys resurrect on the recovered copy
+                ops.append(ShardOp("omap_clear"))
+                if omap_payload:
+                    ops.append(ShardOp(
+                        "omap_set", data=encode_kv_map(omap_payload)))
             if osd == self.osd_id:
                 t = Transaction()
                 cid = self._cid(pg, shard)
@@ -1366,7 +1434,8 @@ class OSDDaemon:
                     msg.tid, EAGAIN, replay_epoch=self._epoch()))
                 return
         try:
-            rc, data, out = await self._execute_ops(state, pool, msg)
+            rc, data, out = await self._execute_ops(state, pool, msg,
+                                                    conn)
         except asyncio.CancelledError:
             raise
         except Exception:
@@ -1376,7 +1445,8 @@ class OSDDaemon:
                                     replay_epoch=self._epoch()
                                     if rc == EAGAIN else 0))
 
-    async def _execute_ops(self, state: PGState, pool, msg: MOSDOp
+    async def _execute_ops(self, state: PGState, pool, msg: MOSDOp,
+                           conn: Optional[Connection] = None
                            ) -> Tuple[int, bytes, Dict[str, Any]]:
         rc, data, out = 0, b"", {}
         if is_internal_name(msg.oid):
@@ -1412,9 +1482,47 @@ class OSDDaemon:
                                                op.offset, op.length)
             elif op.op == "stat":
                 rc, out = await self._op_stat(state, pool, read_oid)
+            elif op.op == "append":
+                rc = await self._op_write(state, pool, msg.oid,
+                                          0, op.data,
+                                          state_admit_epoch, snapc,
+                                          append=True)
             elif op.op == "remove":
                 rc = await self._op_remove(state, pool, msg.oid,
                                            state_admit_epoch, snapc)
+            elif op.op == "setxattr":
+                rc = await self._op_setxattr(state, pool, msg.oid,
+                                             op.args["name"], op.data,
+                                             state_admit_epoch, snapc)
+            elif op.op == "rmxattr":
+                rc = await self._op_setxattr(state, pool, msg.oid,
+                                             op.args["name"], None,
+                                             state_admit_epoch, snapc)
+            elif op.op == "getxattr":
+                rc, data = await self._op_getxattr(state, pool,
+                                                   read_oid,
+                                                   op.args["name"])
+            elif op.op == "getxattrs":
+                rc, out = await self._op_getxattrs(state, pool,
+                                                   read_oid)
+            elif op.op == "omap_set":
+                rc = await self._op_omap_write(state, pool, msg.oid,
+                                               "omap_set", op.data,
+                                               state_admit_epoch)
+            elif op.op == "omap_rm":
+                rc = await self._op_omap_write(state, pool, msg.oid,
+                                               "omap_rm", op.data,
+                                               state_admit_epoch)
+            elif op.op == "omap_get":
+                rc, data = await self._op_omap_get(state, pool,
+                                                   read_oid)
+            elif op.op == "watch":
+                rc = self._op_watch(state, pool, msg, conn,
+                                    op.args.get("cookie", 0),
+                                    op.args.get("unwatch", False))
+            elif op.op == "notify":
+                rc, out = await self._op_notify(state, pool, msg.oid,
+                                                op.data)
             elif op.op == "pgls":
                 rc, out = self._op_pgls(state, pool)
             else:
@@ -1620,11 +1728,17 @@ class OSDDaemon:
     async def _op_write(self, state: PGState, pool, oid: str,
                         offset: int, data: bytes,
                         admit_epoch: Optional[int] = None,
-                        snapc=None) -> int:
+                        snapc=None, append: bool = False) -> int:
         """Partial-extent write.  Replicated: direct range write.
         EC: stripe-level read-modify-write (the start_rmw pipeline).
-        Both under the per-object lock (SnapSet RMW must not race)."""
+        Both under the per-object lock (SnapSet RMW must not race).
+        append=True resolves the offset to the current object end
+        INSIDE the lock so concurrent appends serialize correctly."""
         async with state.obj_lock(oid):
+            if append:
+                oi, _ss = await self._head_info(state, pool, oid)
+                offset = oi.get("size", 0) \
+                    if oi is not None and not oi.get("whiteout") else 0
             if pool.type == TYPE_ERASURE:
                 return await self._ec_rmw(state, pool, oid, offset,
                                           data, admit_epoch, snapc)
@@ -1973,6 +2087,212 @@ class OSDDaemon:
             return await self._submit_shard_writes(state, pool, oid,
                                                    shard_ops, entry,
                                                    admit_epoch)
+
+    # -- xattr / omap client ops (the ObjectOperation attr surface) --------
+
+    async def _op_setxattr(self, state: PGState, pool, oid: str,
+                           name: str, value: Optional[bytes],
+                           admit_epoch: Optional[int],
+                           snapc=None) -> int:
+        """Set (value) or remove (value=None) a USER xattr — a logged,
+        versioned write on every shard (attrs are object metadata and
+        ride with the object through snapshots and recovery)."""
+        async with state.obj_lock(oid):
+            oi, _ss = await self._head_info(state, pool, oid)
+            if oi is None or oi.get("whiteout"):
+                return ENOENT
+            clone_ops: List[ShardOp] = []
+            ss_raw: Optional[bytes] = None
+            if snapc is not None:
+                clone_ops, ss_raw = await self._snap_clone_prep(
+                    state, pool, oid, snapc[0], snapc[1])
+            entry = self._next_entry(state, pool, oid, "modify",
+                                     oi.get("size", 0))
+            oi_raw = json.dumps({"size": oi.get("size", 0),
+                                 "version": entry["version"]}).encode()
+            key = USER_ATTR_PREFIX + name
+            if value is None:
+                attr_op = ShardOp("rmattr", name=key)
+            else:
+                attr_op = ShardOp("setattr", name=key, value=value)
+            ops = [attr_op,
+                   ShardOp("setattr", name=OI_ATTR, value=oi_raw)]
+            if pool.type == TYPE_REPLICATED:
+                shard_ops = {-1: list(ops)}
+            else:
+                n = self._codec(pool.id).get_chunk_count()
+                shard_ops = {s: list(ops) for s in range(n)}
+            self._apply_snap_ops(shard_ops, clone_ops, ss_raw)
+            return await self._submit_shard_writes(state, pool, oid,
+                                                   shard_ops, entry,
+                                                   admit_epoch)
+
+    async def _op_getxattr(self, state: PGState, pool, oid: str,
+                           name: str) -> Tuple[int, bytes]:
+        rc, attrs = await self._gather_user_attrs(state, pool, oid)
+        if rc != 0:
+            return rc, b""
+        value = attrs.get(name)
+        if value is None:
+            return -61, b""  # ENODATA
+        return 0, value
+
+    async def _op_getxattrs(self, state: PGState, pool, oid: str
+                            ) -> Tuple[int, Dict[str, Any]]:
+        rc, attrs = await self._gather_user_attrs(state, pool, oid)
+        if rc != 0:
+            return rc, {}
+        # JSON reply surface: values as latin-1-safe strings
+        return 0, {"xattrs": {k: v.decode("latin-1")
+                              for k, v in attrs.items()}}
+
+    async def _gather_user_attrs(self, state: PGState, pool, oid: str
+                                 ) -> Tuple[int, Dict[str, bytes]]:
+        candidates = await self._gather_object_shards(
+            state, pool, oid, offset=0, length=1)
+        if not candidates:
+            return ENOENT, {}
+        need = self._codec(pool.id).get_data_chunk_count() \
+            if pool.type == TYPE_ERASURE else 1
+        version, chosen, oi = self._select_consistent(candidates,
+                                                      need=need)
+        if version is None:
+            return EIO, {}
+        if oi.get("whiteout"):
+            return ENOENT, {}
+        src = next(iter(chosen))
+        for shard, _payload, at in candidates:
+            if shard == src and self._oi_version(at) == version:
+                return 0, {k[len(USER_ATTR_PREFIX):]: v
+                           for k, v in at.items()
+                           if k.startswith(USER_ATTR_PREFIX)}
+        return 0, {}
+
+    async def _op_omap_write(self, state: PGState, pool, oid: str,
+                             kind: str, payload: bytes,
+                             admit_epoch: Optional[int]) -> int:
+        """omap set/rm — REPLICATED pools only, like the reference
+        (EC pools reject omap: PrimaryLogPG EOPNOTSUPP)."""
+        if pool.type == TYPE_ERASURE:
+            return -95  # EOPNOTSUPP
+        async with state.obj_lock(oid):
+            oi, _ss = await self._head_info(state, pool, oid)
+            size = oi.get("size", 0) \
+                if oi is not None and not oi.get("whiteout") else 0
+            entry = self._next_entry(state, pool, oid, "modify", size)
+            oi_raw = json.dumps({"size": size,
+                                 "version": entry["version"]}).encode()
+            ops = [ShardOp("create"),
+                   ShardOp(kind, data=payload),
+                   ShardOp("setattr", name=OI_ATTR, value=oi_raw)]
+            return await self._submit_shard_writes(state, pool, oid,
+                                                   {-1: ops}, entry,
+                                                   admit_epoch)
+
+    async def _op_omap_get(self, state: PGState, pool, oid: str
+                           ) -> Tuple[int, bytes]:
+        if pool.type == TYPE_ERASURE:
+            return -95, b""
+        # existence/whiteout gate first: stores differ on whether a
+        # never-created object's omap read errors, and a
+        # snapshot-deleted (whiteout) head must read as gone
+        oi, _ss = await self._head_info(state, pool, oid)
+        if oi is None or oi.get("whiteout"):
+            return ENOENT, b""
+        # omap is identical on every replica; serve locally when clean,
+        # else from any up replica via a want_omap sub-read
+        if self._pg_is_clean(state, pool, oid):
+            cid = self._cid(state.pg, -1)
+            try:
+                omap = self.store.omap_get(cid, ObjectId(oid))
+            except (KeyError, IOError):
+                return ENOENT, b""
+            return 0, _encode_kv_map(omap)
+        for idx, osd in enumerate(state.acting):
+            if osd == CRUSH_ITEM_NONE or not self.osdmap.is_up(osd) \
+                    or osd == self.osd_id:
+                continue
+            tid = self._next_tid()
+            reply = await self._request(
+                osd, MOSDSubRead(tid, state.pg, -1, oid, 0, 1,
+                                 want_omap=True), tid)
+            if reply is not None and reply.rc == 0:
+                return 0, _encode_kv_map(reply.omap)
+        return EAGAIN, b""
+
+    # -- watch / notify (linger op surface, Objecter linger role) ----------
+
+    def _op_watch(self, state: PGState, pool, msg: MOSDOp,
+                  conn: Optional[Connection], cookie: int,
+                  unwatch: bool) -> int:
+        """Register/unregister this connection as a watcher of the
+        object.  Watch state is primary-local and in-memory — clients
+        re-register on map changes (the Objecter linger resend role)."""
+        key = (pool.id, msg.oid)
+        table = self.watchers.setdefault(key, {})
+        if unwatch:
+            table.pop((msg.client, cookie), None)
+            if not table:
+                self.watchers.pop(key, None)
+            return 0
+        if conn is None:
+            return EINVAL
+        table[(msg.client, cookie)] = conn
+        return 0
+
+    async def _op_notify(self, state: PGState, pool, oid: str,
+                         payload: bytes
+                         ) -> Tuple[int, Dict[str, Any]]:
+        """Fan the notify out to every live watcher and wait for acks
+        (watch_notify timeout discipline)."""
+        key = (pool.id, oid)
+        table = dict(self.watchers.get(key, {}))
+        live = {k: c for k, c in table.items() if not c.closed}
+        self._notify_seq += 1
+        notify_id = self._notify_seq
+        if not live:
+            return 0, {"acked": [], "missed": []}
+        event = asyncio.Event()
+        pending = {"want": set(live), "acks": set(), "event": event}
+        self._pending_notifies[notify_id] = pending
+        try:
+            for (client, cookie), wconn in live.items():
+                try:
+                    await wconn.send(MWatchNotify(
+                        notify_id, pool.id, oid, payload, cookie))
+                except (ConnectionError, OSError):
+                    pending["want"].discard((client, cookie))
+            # acks may have landed during the sends (each send is a
+            # yield point), and failed sends shrink the want set — only
+            # wait if someone is still outstanding
+            if pending["want"] - pending["acks"]:
+                try:
+                    await asyncio.wait_for(
+                        event.wait(),
+                        float(self.config.get("osd_notify_timeout",
+                                              5.0)))
+                except asyncio.TimeoutError:
+                    pass
+            # watchers are identified by (client, cookie): cookies are
+            # per-client counters and collide across clients
+            acked = sorted([cl, c] for cl, c in pending["acks"])
+            missed = sorted([cl, c] for cl, c in
+                            pending["want"] - pending["acks"])
+            return 0, {"acked": acked, "missed": missed}
+        finally:
+            self._pending_notifies.pop(notify_id, None)
+
+    def _handle_notify_ack(self, conn: Connection,
+                           msg: MWatchNotifyAck) -> None:
+        pending = self._pending_notifies.get(msg.notify_id)
+        if pending is None:
+            return
+        for who in list(pending["want"]):
+            if who[1] == msg.cookie and \
+                    who[0] == conn.peer_name:
+                pending["acks"].add(who)
+        if pending["acks"] >= pending["want"]:
+            pending["event"].set()
 
     def _op_pgls(self, state: PGState, pool
                  ) -> Tuple[int, Dict[str, Any]]:

@@ -22,8 +22,13 @@ from ceph_tpu.msg.messages import (
     MOSDMapMsg,
     MOSDOp,
     MOSDOpReply,
+    MWatchNotify,
+    MWatchNotifyAck,
     Message,
     OSDOp,
+    decode_kv_map,
+    encode_kv_map,
+    encode_str_list,
 )
 from ceph_tpu.ops.rjenkins import ceph_str_hash_rjenkins
 from ceph_tpu.osd.osdmap import OSDMap, PgId
@@ -58,6 +63,30 @@ class RadosClient:
         self._futures: Dict[int, asyncio.Future] = {}
         self._map_waiters: List[asyncio.Event] = []
         self._placement_cache: Dict[Tuple[int, PgId], int] = {}
+        # (pool, oid, cookie) -> (ioctx, callback); re-registered with
+        # the primary on every map change (linger resend role)
+        self._watches: Dict[Tuple[int, str, int], tuple] = {}
+        self._watch_cookie = 0
+        self._watch_keepalive: Optional[asyncio.Task] = None
+
+    def _next_watch_cookie(self) -> int:
+        self._watch_cookie += 1
+        return self._watch_cookie
+
+    def _ensure_watch_keepalive(self) -> None:
+        """Watches must survive silent TCP drops, not just map
+        changes: periodically re-register every live watch (the
+        registration is idempotent on the primary)."""
+        if self._watch_keepalive is None or \
+                self._watch_keepalive.done():
+            self._watch_keepalive = \
+                asyncio.get_running_loop().create_task(
+                    self._watch_keepalive_loop())
+
+    async def _watch_keepalive_loop(self) -> None:
+        while self._watches:
+            await asyncio.sleep(3.0)
+            await self._reregister_watches()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -72,6 +101,8 @@ class RadosClient:
         raise TimeoutError("no osdmap from mon")
 
     async def shutdown(self) -> None:
+        if self._watch_keepalive is not None:
+            self._watch_keepalive.cancel()
         await self.msgr.shutdown()
 
     # -- dispatch ----------------------------------------------------------
@@ -82,6 +113,26 @@ class RadosClient:
                 for event in self._map_waiters:
                     event.set()
                 self._map_waiters.clear()
+                if self._watches:
+                    # primaries may have moved: re-register watches
+                    self.msgr._spawn(self._reregister_watches())
+        elif isinstance(msg, MWatchNotify):
+            # run the callback, then ack so the notifier unblocks
+            for (pool, oid, cookie), (ioctx, cb) in \
+                    list(self._watches.items()):
+                if pool == msg.pool and oid == msg.oid and \
+                        cookie == msg.cookie:
+                    try:
+                        res = cb(msg.payload)
+                        if asyncio.iscoroutine(res):
+                            await res
+                    except Exception:
+                        log.exception("watch callback failed")
+            try:
+                await conn.send(MWatchNotifyAck(msg.notify_id,
+                                                msg.cookie))
+            except (ConnectionError, OSError):
+                pass
         elif isinstance(msg, (MOSDOpReply, MMonCommandReply)):
             fut = self._futures.pop(msg.tid, None)
             if fut is not None and not fut.done():
@@ -115,6 +166,15 @@ class RadosClient:
             # inc-only publish we could not apply: pull a fresh map
             self.msgr._spawn(self.refresh_map())
         return advanced
+
+    async def _reregister_watches(self) -> None:
+        for (pool, oid, cookie), (ioctx, _cb) in \
+                list(self._watches.items()):
+            try:
+                await ioctx._submit(
+                    oid, [OSDOp("watch", args={"cookie": cookie})])
+            except Exception:
+                pass  # next map change retries
 
     def _next_tid(self) -> int:
         self._tid += 1
@@ -364,6 +424,94 @@ class IoCtx:
             raise ObjectNotFound(reply.rc, oid)
         if reply.rc != 0:
             raise RadosError(reply.rc, f"remove {oid!r}")
+
+    async def append(self, oid: str, data: bytes) -> None:
+        reply = await self._submit(oid, [OSDOp("append", data=data)])
+        if reply.rc != 0:
+            raise RadosError(reply.rc, f"append {oid!r}")
+
+    # -- xattrs ------------------------------------------------------------
+
+    async def setxattr(self, oid: str, name: str, value: bytes) -> None:
+        reply = await self._submit(
+            oid, [OSDOp("setxattr", data=value, args={"name": name})])
+        if reply.rc != 0:
+            raise RadosError(reply.rc, f"setxattr {oid!r}.{name}")
+
+    async def rmxattr(self, oid: str, name: str) -> None:
+        reply = await self._submit(
+            oid, [OSDOp("rmxattr", args={"name": name})])
+        if reply.rc != 0:
+            raise RadosError(reply.rc, f"rmxattr {oid!r}.{name}")
+
+    async def getxattr(self, oid: str, name: str) -> bytes:
+        reply = await self._submit(
+            oid, [OSDOp("getxattr", args={"name": name})])
+        if reply.rc != 0:
+            raise RadosError(reply.rc, f"getxattr {oid!r}.{name}")
+        return reply.data
+
+    async def getxattrs(self, oid: str) -> Dict[str, bytes]:
+        reply = await self._submit(oid, [OSDOp("getxattrs")])
+        if reply.rc != 0:
+            raise RadosError(reply.rc, f"getxattrs {oid!r}")
+        return {k: v.encode("latin-1")
+                for k, v in reply.out.get("xattrs", {}).items()}
+
+    # -- omap (replicated pools only, like the reference) ------------------
+
+    async def omap_set(self, oid: str,
+                       kv: Dict[str, bytes]) -> None:
+        reply = await self._submit(
+            oid, [OSDOp("omap_set", data=encode_kv_map(kv))])
+        if reply.rc != 0:
+            raise RadosError(reply.rc, f"omap_set {oid!r}")
+
+    async def omap_rm_keys(self, oid: str, keys: List[str]) -> None:
+        reply = await self._submit(
+            oid, [OSDOp("omap_rm", data=encode_str_list(keys))])
+        if reply.rc != 0:
+            raise RadosError(reply.rc, f"omap_rm {oid!r}")
+
+    async def omap_get(self, oid: str) -> Dict[str, bytes]:
+        reply = await self._submit(oid, [OSDOp("omap_get")])
+        if reply.rc != 0:
+            raise RadosError(reply.rc, f"omap_get {oid!r}")
+        return decode_kv_map(reply.data) if reply.data else {}
+
+    # -- watch / notify ----------------------------------------------------
+
+    async def watch(self, oid: str, callback) -> int:
+        """Register a watch; callback(payload: bytes) fires on every
+        notify.  Returns the watch cookie (linger op role — the client
+        re-registers automatically when the map changes)."""
+        cookie = self.client._next_watch_cookie()
+        self.client._watches[(self.pool_id, oid, cookie)] = \
+            (self, callback)
+        reply = await self._submit(
+            oid, [OSDOp("watch", args={"cookie": cookie})])
+        if reply.rc != 0:
+            self.client._watches.pop((self.pool_id, oid, cookie), None)
+            raise RadosError(reply.rc, f"watch {oid!r}")
+        self.client._ensure_watch_keepalive()
+        return cookie
+
+    async def unwatch(self, oid: str, cookie: int) -> None:
+        self.client._watches.pop((self.pool_id, oid, cookie), None)
+        reply = await self._submit(
+            oid, [OSDOp("watch", args={"cookie": cookie,
+                                       "unwatch": True})])
+        if reply.rc != 0:
+            raise RadosError(reply.rc, f"unwatch {oid!r}")
+
+    async def notify(self, oid: str,
+                     payload: bytes = b"") -> Dict[str, Any]:
+        """Fire a notify; returns {"acked": [...], "missed": [...]}."""
+        reply = await self._submit(
+            oid, [OSDOp("notify", data=payload)])
+        if reply.rc != 0:
+            raise RadosError(reply.rc, f"notify {oid!r}")
+        return reply.out
 
     async def list_objects(self) -> List[str]:
         """pgls across every PG of the pool (ListObjects role)."""

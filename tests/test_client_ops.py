@@ -1,0 +1,166 @@
+"""Extended client op surface: append, xattrs, omap, watch/notify
+(the ObjectOperation + linger-op surface of librados/Objecter;
+/root/reference/src/osdc/Objecter.cc linger ops, src/cls substrate)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.rados.client import RadosError
+
+from cluster_helpers import Cluster
+
+EC22 = {"plugin": "ec_jax", "technique": "reed_sol_van",
+        "k": "2", "m": "2", "crush-failure-domain": "osd",
+        "tpu": "false"}
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 180))
+
+
+def test_append_and_xattrs_replicated():
+    async def main():
+        cluster = Cluster(num_osds=4)
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool(
+                "p", size=3, pg_num=8)
+            io = cluster.client.open_ioctx("p")
+            await io.write_full("obj", b"hello")
+            await io.append("obj", b" world")
+            await io.append("obj", b"!")
+            assert await io.read("obj") == b"hello world!"
+            # concurrent appends serialize (no lost updates)
+            await asyncio.gather(*(io.append("obj", bytes([65 + i]))
+                                   for i in range(8)))
+            data = await io.read("obj")
+            assert len(data) == len(b"hello world!") + 8
+            assert sorted(data[-8:]) == list(range(65, 73))
+
+            await io.setxattr("obj", "color", b"blue")
+            await io.setxattr("obj", "shape", b"round")
+            assert await io.getxattr("obj", "color") == b"blue"
+            attrs = await io.getxattrs("obj")
+            assert attrs == {"color": b"blue", "shape": b"round"}
+            await io.rmxattr("obj", "color")
+            with pytest.raises(RadosError):
+                await io.getxattr("obj", "color")
+            # xattr on a missing object
+            with pytest.raises(RadosError):
+                await io.setxattr("nope", "a", b"b")
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_append_and_xattrs_ec():
+    async def main():
+        cluster = Cluster(num_osds=5)
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "ec", profile=EC22, pg_num=8)
+            io = cluster.client.open_ioctx("ec")
+            blob = bytes(np.random.default_rng(4).integers(
+                0, 256, 30_000, dtype=np.uint8))
+            await io.write_full("obj", blob)
+            await io.append("obj", b"tail" * 100)
+            assert await io.read("obj") == blob + b"tail" * 100
+            await io.setxattr("obj", "k", b"v")
+            assert await io.getxattr("obj", "k") == b"v"
+            # omap is refused on EC pools, like the reference
+            with pytest.raises(RadosError):
+                await io.omap_set("obj", {"a": b"1"})
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_omap_round_trip_and_recovery():
+    async def main():
+        cluster = Cluster(num_osds=4)
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool(
+                "p", size=3, pg_num=8)
+            io = cluster.client.open_ioctx("p")
+            await io.write_full("idx", b"")
+            await io.omap_set("idx", {"k1": b"v1", "k2": b"v2",
+                                      "k3": b"v3"})
+            await io.omap_rm_keys("idx", ["k2"])
+            assert await io.omap_get("idx") == {"k1": b"v1",
+                                                "k3": b"v3"}
+            # omap survives an OSD kill + revive (recovery carries it)
+            await cluster.kill_osd(0)
+            await cluster.wait_for_osd_down(0)
+            assert await io.omap_get("idx") == {"k1": b"v1",
+                                                "k3": b"v3"}
+            # mark it OUT (the mon's down-out interval role) so CRUSH
+            # re-places the PG and degraded writes regain min_size
+            await cluster.client.mon_command(
+                {"prefix": "osd out", "osd": 0})
+            await io.omap_set("idx", {"k4": b"v4"})
+            await cluster.client.mon_command(
+                {"prefix": "osd in", "osd": 0})
+            await cluster.revive_osd(0)
+            await cluster.wait_for_osd_up(0)
+            await cluster.wait_for_clean()
+            assert await io.omap_get("idx") == {"k1": b"v1",
+                                                "k3": b"v3",
+                                                "k4": b"v4"}
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_watch_notify():
+    async def main():
+        cluster = Cluster(num_osds=4)
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool(
+                "p", size=3, pg_num=8)
+            io = cluster.client.open_ioctx("p")
+            await io.write_full("obj", b"watched")
+
+            got: list = []
+            cookie = await io.watch("obj", lambda p: got.append(p))
+            res = await io.notify("obj", b"ping-1")
+            # watchers are identified by (client, cookie) pairs —
+            # cookies alone collide across clients
+            assert res["acked"] == [["client.0", cookie]]
+            assert res["missed"] == []
+            assert got == [b"ping-1"]
+
+            # a second watcher from a second client
+            from ceph_tpu.rados.client import RadosClient
+
+            client2 = RadosClient(cluster.mon.addr, name="client.2")
+            await client2.connect()
+            try:
+                io2 = client2.open_ioctx("p")
+                got2: list = []
+                c2 = await io2.watch("obj", lambda p: got2.append(p))
+                res = await io.notify("obj", b"ping-2")
+                assert sorted(map(tuple, res["acked"])) == sorted(
+                    [("client.0", cookie), ("client.2", c2)])
+                assert got[-1] == b"ping-2" and got2 == [b"ping-2"]
+                await io2.unwatch("obj", c2)
+            finally:
+                await client2.shutdown()
+
+            res = await io.notify("obj", b"ping-3")
+            assert res["acked"] == [["client.0", cookie]]
+            await io.unwatch("obj", cookie)
+            res = await io.notify("obj", b"ping-4")
+            assert res["acked"] == []
+            assert got[-1] == b"ping-3"
+        finally:
+            await cluster.stop()
+
+    run(main())
