@@ -129,6 +129,21 @@ def is_internal_name(name: str) -> bool:
     return name.startswith(RB_PREFIX) or SNAP_SEP in name
 
 
+def _hinfo_chunk_ok(at: Dict[str, bytes], shard: int,
+                    payload: bytes) -> bool:
+    """Does this shard payload match its recorded hinfo chunk crc?
+    Shards without chunk hashes (RMW-era objects) pass — version
+    agreement is their consistency story.  The ONE hash-check rule,
+    shared by read-path selection and scrub."""
+    try:
+        hi = ec_util.HashInfo.from_dict(json.loads(at[HINFO_ATTR]))
+    except (KeyError, ValueError):
+        return True
+    if not hi.has_chunk_hash():
+        return True
+    return cks.crc32c(0xFFFFFFFF, payload) == hi.get_chunk_hash(shard)
+
+
 class PGState:
     """In-memory PG bookkeeping (PG + PeeringState role)."""
 
@@ -232,6 +247,18 @@ class OSDDaemon:
                             Dict[Tuple[str, int], Connection]] = {}
         self._notify_seq = 0
         self._pending_notifies: Dict[int, Dict[str, Any]] = {}
+        # op tracking + background scrub + admin socket
+        from ceph_tpu.osd.op_tracker import OpTracker
+
+        self.op_tracker = OpTracker(
+            history_size=int(self.config.get("osd_op_history_size",
+                                             20)),
+            complaint_time=float(self.config.get(
+                "osd_op_complaint_time", 30.0)),
+            who=f"osd.{osd_id}")
+        self._scrub_task: Optional[asyncio.Task] = None
+        self._admin_socket = None
+        self.scrub_stats = {"objects": 0, "errors": 0, "repaired": 0}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -252,10 +279,51 @@ class OSDDaemon:
             await asyncio.sleep(0.02)
         self._hb_task = asyncio.get_running_loop().create_task(
             self._heartbeat_loop())
+        scrub_iv = float(self.config.get("osd_scrub_interval", 0))
+        if scrub_iv > 0:
+            self._scrub_task = asyncio.get_running_loop().create_task(
+                self._scrub_loop(scrub_iv))
+        admin_path = self.config.get("admin_socket", "")
+        if admin_path:
+            self._start_admin_socket(admin_path)
         return addr
+
+    def _start_admin_socket(self, path: str) -> None:
+        from ceph_tpu.common.admin_socket import AdminSocket
+
+        sock = AdminSocket(path, version=f"ceph_tpu osd.{self.osd_id}")
+        sock.register_command(
+            "dump_ops_in_flight",
+            lambda cmd: self.op_tracker.dump_in_flight(),
+            "show in-flight client ops")
+        sock.register_command(
+            "dump_historic_ops",
+            lambda cmd: self.op_tracker.dump_historic(),
+            "show recently completed client ops")
+        sock.register_command(
+            "perf dump", lambda cmd: dict(self.perf),
+            "data-path transfer/dispatch counters")
+        sock.register_command(
+            "dump_pgs",
+            lambda cmd: {str(pg): {"state": st.state,
+                                   "primary": st.primary,
+                                   "acting": list(st.acting)}
+                         for pg, st in list(self.pgs.items())},
+            "per-PG state")
+        sock.register_command(
+            "scrub_stats", lambda cmd: dict(self.scrub_stats),
+            "lifetime scrub object/error/repair counters")
+        sock.init()
+        self._admin_socket = sock
 
     async def stop(self) -> None:
         self._stopping = True
+        if self._admin_socket is not None:
+            # shutdown joins the serve thread: keep that wait OFF the
+            # shared event loop (co-hosted daemons keep running)
+            await asyncio.to_thread(self._admin_socket.shutdown)
+        if self._scrub_task is not None:
+            self._scrub_task.cancel()
         if self._hb_task is not None:
             self._hb_task.cancel()
         for ps in self.pgs.values():
@@ -556,6 +624,7 @@ class OSDDaemon:
                                 subscribe=True))
                 except (ConnectionError, OSError):
                     pass  # mon still down; retry next cycle
+            self.op_tracker.check_slow()
             peers = self._heartbeat_peers()
             # prune state for ex-peers so a later re-add restarts fresh
             for gone in set(self._hb_last_rx) - peers:
@@ -1040,14 +1109,9 @@ class OSDDaemon:
             if version is None:
                 continue
             if verify_hinfo:
-                try:
-                    hi = ec_util.HashInfo.from_dict(
-                        json.loads(at[HINFO_ATTR]))
-                except (KeyError, ValueError):
-                    continue
-                if hi.has_chunk_hash() and cks.crc32c(
-                        0xFFFFFFFF, payload) != \
-                        hi.get_chunk_hash(shard):
+                if HINFO_ATTR not in at:
+                    continue  # EC shard without its ledger: suspicious
+                if not _hinfo_chunk_ok(at, shard, payload):
                     continue  # corrupt shard: erasure
             groups.setdefault(version, {}).setdefault(shard, payload)
             ois.setdefault(version, json.loads(at[OI_ATTR]))
@@ -1253,6 +1317,197 @@ class OSDDaemon:
                 return reply.omap
         return None
 
+    # -- scrub (daemon-side scheduled scrub; PG.cc scrub + be_deep_scrub
+    # roles) ---------------------------------------------------------------
+
+    async def _scrub_loop(self, interval: float) -> None:
+        """Background scrub: walk my primary PGs comparing shard
+        payloads against their recorded digests, repairing through the
+        recovery path."""
+        while not self._stopping:
+            await asyncio.sleep(interval)
+            if self.osdmap is None:
+                continue
+            for pg, state in list(self.pgs.items()):
+                if state.primary != self.osd_id or \
+                        state.state != "active":
+                    continue
+                pool = self.osdmap.pools.get(pg.pool)
+                if pool is None:
+                    continue
+                try:
+                    await self.scrub_pg(state, pool)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("osd.%d: scrub %s failed",
+                                  self.osd_id, pg)
+
+    async def scrub_pg(self, state: PGState, pool) -> Dict[str, int]:
+        """Scrub one PG; returns this run's {objects, errors,
+        repaired}.  Exposed for tests and an admin trigger."""
+        run = {"objects": 0, "errors": 0, "repaired": 0}
+        my_shard = state.my_shard(self.osd_id, pool.type)
+        scrub_interval_epoch = state.interval_epoch
+        names = [n for n in
+                 self._list_shard_objects(state.pg, my_shard)
+                 if not is_internal_name(n)]
+        for oid in names:
+            async with state.obj_lock(oid):
+                # an interval change mid-scrub hands the PG to peering;
+                # repairs computed against the old acting set would
+                # corrupt state — abort and let the next pass rescan
+                if state.state != "active" or \
+                        state.interval_epoch != scrub_interval_epoch:
+                    break
+                await self._scrub_object(state, pool, oid, run)
+        self.scrub_stats["objects"] += run["objects"]
+        self.scrub_stats["errors"] += run["errors"]
+        self.scrub_stats["repaired"] += run["repaired"]
+        return run
+
+    async def _scrub_object(self, state: PGState, pool, oid: str,
+                            run: Dict[str, int]) -> None:
+        run["objects"] += 1
+        plog = self._load_log(state, pool)
+        if oid in plog.missing or \
+                any(oid in m for m in state.peer_missing.values()):
+            return  # recovery owns this object right now
+        # gather with explicit per-copy identity: (acting position,
+        # osd, payload, attrs) — candidate order from the generic
+        # gather cannot identify WHICH replica a copy came from
+        copies: List[Tuple[int, int, bytes, Dict[str, bytes]]] = []
+
+        async def fetch(idx: int, osd: int, shard: int) -> None:
+            if osd == self.osd_id:
+                rc, data, at = self._read_shard(state.pg, shard, oid)
+            else:
+                tid = self._next_tid()
+                reply = await self._request(
+                    osd, MOSDSubRead(tid, state.pg, shard, oid), tid)
+                if reply is None or reply.rc != 0:
+                    return
+                rc, data, at = 0, reply.data, reply.attrs
+            if rc == 0:
+                copies.append((idx, osd, data, at))
+
+        jobs = []
+        expected: List[Tuple[int, int]] = []
+        for idx, osd in enumerate(state.acting):
+            if osd == CRUSH_ITEM_NONE or not self.osdmap.is_up(osd):
+                continue
+            shard = idx if pool.type == TYPE_ERASURE else -1
+            expected.append((idx, osd))
+            jobs.append(fetch(idx, osd, shard))
+        await asyncio.gather(*jobs)
+        if not copies:
+            return
+        # an up acting member that should hold the object but returned
+        # nothing IS an inconsistency (a silently lost copy) — count it
+        # and repair it like a corrupt one
+        absent = [(idx, osd) for idx, osd in expected
+                  if not any(c[0] == idx for c in copies)]
+        k = self._codec(pool.id).get_data_chunk_count() \
+            if pool.type == TYPE_ERASURE else 1
+        versions: Dict[tuple, int] = {}
+        for _idx, _osd, _data, at in copies:
+            v = self._oi_version(at)
+            if v is not None:
+                versions[v] = versions.get(v, 0) + 1
+        auth = [v for v, n in versions.items() if n >= k]
+        if not auth:
+            run["errors"] += 1
+            return
+        version = max(auth)
+        bad: List[Tuple[int, int]] = []  # (acting idx, osd)
+        if pool.type == TYPE_ERASURE:
+            # hinfo chunk crcs identify the corrupt shard exactly
+            # (be_deep_scrub re-hash, ECBackend.cc:2494); RMW-era
+            # objects without chunk hashes fall back to the version
+            # agreement already checked above
+            for idx, osd, payload, at in copies:
+                if self._oi_version(at) != version:
+                    continue
+                if not _hinfo_chunk_ok(at, idx, payload):
+                    bad.append((idx, osd))
+        else:
+            # replicated: a STRICT majority digest wins; dissenters are
+            # corrupt.  A tie (1-vs-1 on a 2-copy object) is
+            # undecidable — repairing on a tie can destroy the good
+            # copy, so it is reported and left alone (inconsistent).
+            digests: Dict[int, List[Tuple[int, int]]] = {}
+            voters = 0
+            for idx, osd, payload, at in copies:
+                if self._oi_version(at) != version:
+                    continue
+                voters += 1
+                d = cks.crc32c(0xFFFFFFFF, payload)
+                digests.setdefault(d, []).append((idx, osd))
+            if len(digests) > 1:
+                majority = max(digests.values(), key=len)
+                if len(majority) * 2 > voters:
+                    bad = [who for members in digests.values()
+                           if members is not majority
+                           for who in members]
+                else:
+                    run["errors"] += 1
+                    log.warning(
+                        "osd.%d: scrub %s/%s: digest tie (%d groups),"
+                        " cannot adjudicate — left inconsistent",
+                        self.osd_id, state.pg, oid, len(digests))
+                    return
+        bad.extend(absent)
+        if not bad:
+            return
+        run["errors"] += len(bad)
+        log.warning("osd.%d: scrub %s/%s: %d bad cop%s at %s",
+                    self.osd_id, state.pg, oid, len(bad),
+                    "y" if len(bad) == 1 else "ies", bad)
+        repaired = await self._scrub_repair(state, pool, oid, bad)
+        run["repaired"] += repaired
+
+    async def _scrub_repair(self, state: PGState, pool, oid: str,
+                            bad: List[Tuple[int, int]]) -> int:
+        """Repair through the recovery path: drop the corrupt copies,
+        mark them missing, reconstruct + push."""
+        peer_shards: Dict[int, int] = {}
+        for idx, osd in enumerate(state.acting):
+            if osd == CRUSH_ITEM_NONE or osd == self.osd_id or \
+                    not self.osdmap.is_up(osd):
+                continue
+            shard_key = idx if pool.type == TYPE_ERASURE else -(idx + 2)
+            peer_shards[shard_key] = osd
+        plog = self._load_log(state, pool)
+        my_cid = self._cid(state.pg,
+                           state.my_shard(self.osd_id, pool.type))
+        for idx, osd in bad:
+            shard = idx if pool.type == TYPE_ERASURE else -1
+            shard_key = idx if pool.type == TYPE_ERASURE else -(idx + 2)
+            # drop the corrupt copy so recovery can't re-select it
+            if osd == self.osd_id:
+                t = Transaction()
+                t.remove(self._cid(state.pg, shard), ObjectId(oid))
+                plog.missing[oid] = plog.info.last_update
+                # DURABLE missing marker in the same txn as the drop:
+                # a crash between drop and recovery must resume, not
+                # strand the object at reduced redundancy
+                plog.stage(t, my_cid)
+                self.store.queue_transaction(t)
+            else:
+                tid = self._next_tid()
+                await self._request(
+                    osd, MOSDSubWrite(tid, state.pg, shard, oid,
+                                      [ShardOp("remove")],
+                                      state.interval_epoch, None,
+                                      self.osd_id), tid)
+                state.peer_missing.setdefault(shard_key, {})[oid] = \
+                    plog.info.last_update
+        await self._recover_object(state, pool, oid, peer_shards)
+        # count repaired only if recovery actually restored everything
+        still_bad = (oid in plog.missing) or any(
+            oid in m for m in state.peer_missing.values())
+        return 0 if still_bad else len(bad)
+
     async def _recover_pg(self, state: PGState, pool,
                           peer_shards: Dict[int, int]) -> None:
         """Recover missing objects: mine by reconstruct, peers by push."""
@@ -1405,6 +1660,17 @@ class OSDDaemon:
 
     async def _handle_client_op(self, conn: Connection,
                                 msg: MOSDOp) -> None:
+        op_id = self.op_tracker.create(
+            f"osd_op({msg.client} {msg.pg} {msg.oid!r} "
+            f"{[op.op for op in msg.ops]})")
+        try:
+            await self._handle_client_op_tracked(conn, msg, op_id)
+        finally:
+            self.op_tracker.finish(op_id)
+
+    async def _handle_client_op_tracked(self, conn: Connection,
+                                        msg: MOSDOp,
+                                        op_id: int) -> None:
         if self.osdmap is None:
             await conn.send(MOSDOpReply(msg.tid, EAGAIN))
             return
@@ -1420,6 +1686,7 @@ class OSDDaemon:
             return
         if state.state != "active":
             # queue until peering completes (waiting_for_active)
+            self.op_tracker.mark(op_id, "waiting_for_active")
             try:
                 await asyncio.wait_for(state.active_event.wait(), 10.0)
             except asyncio.TimeoutError:
@@ -1433,6 +1700,7 @@ class OSDDaemon:
                 await conn.send(MOSDOpReply(
                     msg.tid, EAGAIN, replay_epoch=self._epoch()))
                 return
+        self.op_tracker.mark(op_id, "started")
         try:
             rc, data, out = await self._execute_ops(state, pool, msg,
                                                     conn)

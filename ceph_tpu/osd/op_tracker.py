@@ -1,0 +1,107 @@
+"""OpTracker: in-flight op registry + historic ring + slow-op warnings.
+
+Reference parity: TrackedOp/OpTracker
+(/root/reference/src/common/TrackedOp.h) — every client op is wrapped
+in a tracked record with an event timeline; `dump_ops_in_flight` and
+`dump_historic_ops` are served over the admin socket, and ops older
+than the warn threshold raise slow-op warnings (the
+`osd_op_complaint_time` discipline).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger("osd")
+
+
+class TrackedOp:
+    __slots__ = ("description", "start", "events", "warned")
+
+    def __init__(self, description: str):
+        self.description = description
+        self.start = time.monotonic()
+        self.events: List[tuple] = [(self.start, "initiated")]
+        self.warned = False
+
+    def mark(self, event: str) -> None:
+        self.events.append((time.monotonic(), event))
+
+    def age(self) -> float:
+        return time.monotonic() - self.start
+
+    def dump(self) -> Dict[str, Any]:
+        return {
+            "description": self.description,
+            "age": round(self.age(), 6),
+            "duration": round(self.events[-1][0] - self.start, 6),
+            "events": [{"time": round(t - self.start, 6), "event": e}
+                       for t, e in self.events],
+        }
+
+
+class OpTracker:
+    """Bounded registry: live ops by id + a historic ring of completed
+    ops (osd_op_history_size role)."""
+
+    def __init__(self, history_size: int = 20,
+                 complaint_time: float = 30.0,
+                 who: str = "osd"):
+        self._live: Dict[int, TrackedOp] = {}
+        self._seq = 0
+        self._history: deque = deque(maxlen=history_size)
+        self.complaint_time = complaint_time
+        self.who = who
+        self.slow_ops = 0  # lifetime count of ops that breached
+        # the admin-socket serve THREAD dumps while the event loop
+        # mutates: every structural access takes this lock
+        self._lock = threading.Lock()
+
+    def create(self, description: str) -> int:
+        with self._lock:
+            self._seq += 1
+            self._live[self._seq] = TrackedOp(description)
+            return self._seq
+
+    def mark(self, op_id: int, event: str) -> None:
+        op = self._live.get(op_id)
+        if op is not None:
+            op.mark(event)
+
+    def finish(self, op_id: int, event: str = "done") -> None:
+        with self._lock:
+            op = self._live.pop(op_id, None)
+            if op is not None:
+                op.mark(event)
+                self._history.append(op)
+
+    def check_slow(self) -> List[TrackedOp]:
+        """Warn once per op that breaches the complaint threshold
+        (the OpTracker check_ops_in_flight role)."""
+        slow = []
+        with self._lock:
+            live = list(self._live.values())
+        for op in live:
+            if not op.warned and op.age() > self.complaint_time:
+                op.warned = True
+                self.slow_ops += 1
+                slow.append(op)
+                log.warning("%s: slow op (%.1fs >= %.1fs): %s",
+                            self.who, op.age(), self.complaint_time,
+                            op.description)
+        return slow
+
+    def dump_in_flight(self) -> Dict[str, Any]:
+        with self._lock:
+            ops = [op.dump() for op in list(self._live.values())]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic(self) -> Dict[str, Any]:
+        with self._lock:
+            ops = [op.dump() for op in list(self._history)]
+        return {"num_ops": len(ops), "ops": ops,
+                "slow_ops_total": self.slow_ops}
