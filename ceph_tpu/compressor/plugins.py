@@ -2,16 +2,20 @@
 
 Reference: /root/reference/src/compressor/{zlib,lz4,snappy,zstd,brotli}/ —
 each a thin Compressor subclass plus a CompressionPlugin registration.
-Here zlib uses the Python stdlib (the reference links zlib/isa-l), and
+Here zlib uses the Python stdlib (the reference links zlib/isa-l),
 lz4/snappy use the from-spec native C++ block codecs in
-ceph_tpu/native/src/compress.cc.  zstd and brotli have no codec in this
-image, so — like a reference build without HAVE_LZ4 — they simply don't
-register, and `Compressor.create("zstd")` returns None.
+ceph_tpu/native/src/compress.cc, and zstd/brotli bind the system
+shared libraries directly via ctypes (the reference vendors/links
+libzstd and libbrotli the same way — ZstdCompressor.h wraps the
+streaming API, BrotliCompressor.cc the one-shot API).  Any codec whose
+library is absent simply doesn't register, like a reference build
+without HAVE_LZ4/HAVE_BROTLI.
 """
 
 from __future__ import annotations
 
 import ctypes
+import ctypes.util
 import zlib as _zlib
 from typing import Optional, Tuple
 
@@ -19,9 +23,11 @@ import numpy as np
 
 from ceph_tpu import native
 from ceph_tpu.compressor import (
+    COMP_ALG_BROTLI,
     COMP_ALG_LZ4,
     COMP_ALG_SNAPPY,
     COMP_ALG_ZLIB,
+    COMP_ALG_ZSTD,
     CompressionPlugin,
     Compressor,
 )
@@ -163,6 +169,148 @@ class SnappyCompressor(_NativeBlockCompressor):
         return self._decompress_raw(data, want)
 
 
+def _load_shared(name: str) -> Optional[ctypes.CDLL]:
+    """dlopen a system library by soname candidates; None if absent."""
+    for cand in (ctypes.util.find_library(name), f"lib{name}.so.1",
+                 f"lib{name}.so"):
+        if not cand:
+            continue
+        try:
+            return ctypes.CDLL(cand)
+        except OSError:
+            continue
+    return None
+
+
+class ZstdCompressor(Compressor):
+    """zstd via the system libzstd one-shot API
+    (ZSTD_compress/ZSTD_decompress — the simple-API tier of the
+    streaming path the reference wraps in ZstdCompressor.h).  The
+    uncompressed length rides in the zstd frame header, so decompress
+    needs no side-channel."""
+
+    _lib: Optional[ctypes.CDLL] = None
+
+    @classmethod
+    def lib(cls) -> Optional[ctypes.CDLL]:
+        if cls._lib is None:
+            lz = _load_shared("zstd")
+            if lz is not None:
+                lz.ZSTD_compressBound.restype = ctypes.c_size_t
+                lz.ZSTD_compress.restype = ctypes.c_size_t
+                lz.ZSTD_decompress.restype = ctypes.c_size_t
+                lz.ZSTD_isError.restype = ctypes.c_uint
+                lz.ZSTD_getFrameContentSize.restype = ctypes.c_ulonglong
+            cls._lib = lz
+        return cls._lib
+
+    def __init__(self, level: int = 1):
+        super().__init__(COMP_ALG_ZSTD, "zstd")
+        # the reference's compressor_zstd_level default is 1
+        self.level = level
+
+    def compress(self, data: bytes) -> Tuple[bytes, Optional[int]]:
+        lz = self.lib()
+        src = _u8(data)
+        cap = int(lz.ZSTD_compressBound(ctypes.c_size_t(len(data))))
+        dst = np.empty(cap, dtype=np.uint8)
+        n = int(lz.ZSTD_compress(_ptr(dst), ctypes.c_size_t(cap),
+                                 _ptr(src), ctypes.c_size_t(len(data)),
+                                 ctypes.c_int(self.level)))
+        if lz.ZSTD_isError(ctypes.c_size_t(n)):
+            raise RuntimeError("zstd compress failed")
+        return dst[:n].tobytes(), None
+
+    # ruler-constant data compresses ~20000:1 per zstd block; cap what a
+    # frame header may claim so corrupt metadata can't force a huge alloc
+    MAX_EXPANSION = 1 << 17
+
+    def decompress(self, data: bytes,
+                   compressor_message: Optional[int] = None) -> bytes:
+        lz = self.lib()
+        src = _u8(data)
+        want = int(lz.ZSTD_getFrameContentSize(
+            _ptr(src), ctypes.c_size_t(len(data))))
+        # ZSTD_CONTENTSIZE_UNKNOWN / _ERROR are 2**64-1 / 2**64-2
+        # (the restype is unsigned, so they arrive as huge positives)
+        if want >= (1 << 64) - 2 or \
+                want > len(data) * self.MAX_EXPANSION + 1024:
+            raise ValueError("zstd: malformed/implausible frame header")
+        dst = np.empty(max(want, 1), dtype=np.uint8)
+        n = int(lz.ZSTD_decompress(_ptr(dst), ctypes.c_size_t(want),
+                                   _ptr(src),
+                                   ctypes.c_size_t(len(data))))
+        if lz.ZSTD_isError(ctypes.c_size_t(n)) or n != want:
+            raise ValueError("zstd: malformed compressed data")
+        return dst[:n].tobytes()
+
+
+class BrotliCompressor(Compressor):
+    """brotli via the system libbrotlienc/dec one-shot API
+    (BrotliEncoderCompress/BrotliDecoderDecompress; the reference's
+    BrotliCompressor.cc uses the same pair).  Brotli's format carries
+    no length, so a 4-byte LE header plays the blob-metadata role."""
+
+    _enc: Optional[ctypes.CDLL] = None
+    _dec: Optional[ctypes.CDLL] = None
+
+    @classmethod
+    def libs(cls):
+        if cls._enc is None:
+            cls._enc = _load_shared("brotlienc")
+            cls._dec = _load_shared("brotlidec")
+            if cls._enc is not None:
+                cls._enc.BrotliEncoderCompress.restype = ctypes.c_int
+                cls._enc.BrotliEncoderMaxCompressedSize.restype = \
+                    ctypes.c_size_t
+            if cls._dec is not None:
+                cls._dec.BrotliDecoderDecompress.restype = ctypes.c_int
+        return cls._enc, cls._dec
+
+    def __init__(self, quality: int = 5):
+        super().__init__(COMP_ALG_BROTLI, "brotli")
+        self.quality = quality
+
+    def compress(self, data: bytes) -> Tuple[bytes, Optional[int]]:
+        if len(data) >= 1 << 32:
+            raise RuntimeError("brotli: input too large (>= 4 GiB)")
+        enc, _dec = self.libs()
+        src = _u8(data)
+        cap = int(enc.BrotliEncoderMaxCompressedSize(
+            ctypes.c_size_t(len(data)))) or len(data) + 1024
+        dst = np.empty(cap, dtype=np.uint8)
+        out_len = ctypes.c_size_t(cap)
+        ok = enc.BrotliEncoderCompress(
+            ctypes.c_int(self.quality), ctypes.c_int(22),  # lgwin default
+            ctypes.c_int(0),  # mode: generic
+            ctypes.c_size_t(len(data)), _ptr(src),
+            ctypes.byref(out_len), _ptr(dst))
+        if not ok:
+            raise RuntimeError("brotli compress failed")
+        return (len(data).to_bytes(4, "little")
+                + dst[:out_len.value].tobytes()), None
+
+    MAX_EXPANSION = 1 << 17  # window-sized back-references: huge ratios
+
+    def decompress(self, data: bytes,
+                   compressor_message: Optional[int] = None) -> bytes:
+        if len(data) < 4:
+            raise ValueError("brotli: truncated header")
+        _enc, dec = self.libs()
+        want = int.from_bytes(data[:4], "little")
+        if want > (len(data) - 4) * self.MAX_EXPANSION + 1024:
+            raise ValueError("brotli: implausible uncompressed length")
+        src = _u8(data[4:])
+        dst = np.empty(max(want, 1), dtype=np.uint8)
+        out_len = ctypes.c_size_t(want)
+        rc = dec.BrotliDecoderDecompress(
+            ctypes.c_size_t(len(src)), _ptr(src),
+            ctypes.byref(out_len), _ptr(dst))
+        if rc != 1 or out_len.value != want:  # BROTLI_DECODER_RESULT_SUCCESS
+            raise ValueError("brotli: malformed compressed data")
+        return dst[:out_len.value].tobytes()
+
+
 def register_all(registry) -> None:
     registry.add("compressor", "zlib",
                  CompressionPlugin("zlib", ZlibCompressor))
@@ -172,5 +320,11 @@ def register_all(registry) -> None:
                      CompressionPlugin("lz4", Lz4Compressor))
         registry.add("compressor", "snappy",
                      CompressionPlugin("snappy", SnappyCompressor))
-    # zstd / brotli: no codec in this image — intentionally unregistered,
-    # mirroring a reference build without HAVE_LZ4/HAVE_BROTLI.
+    # zstd / brotli register only when the system libraries resolve,
+    # mirroring a reference build without HAVE_LZ4/HAVE_BROTLI
+    if ZstdCompressor.lib() is not None:
+        registry.add("compressor", "zstd",
+                     CompressionPlugin("zstd", ZstdCompressor))
+    if all(BrotliCompressor.libs()):
+        registry.add("compressor", "brotli",
+                     CompressionPlugin("brotli", BrotliCompressor))

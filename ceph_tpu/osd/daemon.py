@@ -43,6 +43,8 @@ from ceph_tpu.msg.messages import (
     Message,
     MGetMap,
     MOSDBoot,
+    MOSDCommand,
+    MOSDCommandReply,
     MOSDFailure,
     MOSDMapMsg,
     MOSDOp,
@@ -142,6 +144,12 @@ def _hinfo_chunk_ok(at: Dict[str, bytes], shard: int,
     if not hi.has_chunk_hash():
         return True
     return cks.crc32c(0xFFFFFFFF, payload) == hi.get_chunk_hash(shard)
+
+
+class UnfoundObject(Exception):
+    """Raised when an op needs an object whose acked data is currently
+    unlocatable (all sources down); mapped to EAGAIN so the client
+    retries until recovery finds a source."""
 
 
 class PGState:
@@ -288,31 +296,38 @@ class OSDDaemon:
             self._start_admin_socket(admin_path)
         return addr
 
+    def _admin_commands(self):
+        """name -> (handler, help): one admin surface served both by
+        the local admin socket and by MOSDCommand over the wire (the
+        reference's asok commands vs `ceph tell osd.N` duality —
+        OSD::do_command and AdminSocket share the handler tables)."""
+        return {
+            "dump_ops_in_flight": (
+                lambda cmd: self.op_tracker.dump_in_flight(),
+                "show in-flight client ops"),
+            "dump_historic_ops": (
+                lambda cmd: self.op_tracker.dump_historic(),
+                "show recently completed client ops"),
+            "perf dump": (
+                lambda cmd: dict(self.perf),
+                "data-path transfer/dispatch counters"),
+            "dump_pgs": (
+                lambda cmd: {str(pg): {"state": st.state,
+                                       "primary": st.primary,
+                                       "acting": list(st.acting)}
+                             for pg, st in list(self.pgs.items())},
+                "per-PG state"),
+            "scrub_stats": (
+                lambda cmd: dict(self.scrub_stats),
+                "lifetime scrub object/error/repair counters"),
+        }
+
     def _start_admin_socket(self, path: str) -> None:
         from ceph_tpu.common.admin_socket import AdminSocket
 
         sock = AdminSocket(path, version=f"ceph_tpu osd.{self.osd_id}")
-        sock.register_command(
-            "dump_ops_in_flight",
-            lambda cmd: self.op_tracker.dump_in_flight(),
-            "show in-flight client ops")
-        sock.register_command(
-            "dump_historic_ops",
-            lambda cmd: self.op_tracker.dump_historic(),
-            "show recently completed client ops")
-        sock.register_command(
-            "perf dump", lambda cmd: dict(self.perf),
-            "data-path transfer/dispatch counters")
-        sock.register_command(
-            "dump_pgs",
-            lambda cmd: {str(pg): {"state": st.state,
-                                   "primary": st.primary,
-                                   "acting": list(st.acting)}
-                         for pg, st in list(self.pgs.items())},
-            "per-PG state")
-        sock.register_command(
-            "scrub_stats", lambda cmd: dict(self.scrub_stats),
-            "lifetime scrub object/error/repair counters")
+        for name, (fn, help_text) in self._admin_commands().items():
+            sock.register_command(name, fn, help_text)
         sock.init()
         self._admin_socket = sock
 
@@ -415,6 +430,41 @@ class OSDDaemon:
                 self._resolve(msg.tid, msg)  # late replies just drop
             else:
                 await self._handle_pg_log_push(conn, msg)
+        elif isinstance(msg, MOSDCommand):
+            await self._handle_osd_command(conn, msg)
+
+    async def _handle_osd_command(self, conn: Connection,
+                                  msg: MOSDCommand) -> None:
+        """`ceph tell osd.N` surface: the admin-socket command table
+        served over the wire (OSD::do_command role)."""
+        prefix = msg.cmd.get("prefix", "")
+        entry = self._admin_commands().get(prefix)
+        try:
+            if entry is not None:
+                out = entry[0](msg.cmd)
+                rc = 0
+            elif prefix == "scrub":
+                # trigger an immediate scrub of my primary PGs and
+                # report the run's totals (`ceph tell osd.N scrub`)
+                out = {"objects": 0, "errors": 0, "repaired": 0}
+                for pg, state in list(self.pgs.items()):
+                    if state.primary != self.osd_id or \
+                            state.state != "active" or self.osdmap is None:
+                        continue
+                    pool = self.osdmap.pools.get(pg.pool)
+                    if pool is None:
+                        continue
+                    run = await self.scrub_pg(state, pool)
+                    for key in out:
+                        out[key] += run[key]
+                rc = 0
+            else:
+                rc, out = EINVAL, {"error": f"unknown command {prefix!r}"}
+        except Exception as e:
+            log.exception("osd.%d: command %r failed", self.osd_id,
+                          prefix)
+            rc, out = EINVAL, {"error": str(e)}
+        await conn.send(MOSDCommandReply(msg.tid, rc, out))
 
     # -- map handling ------------------------------------------------------
 
@@ -1003,21 +1053,31 @@ class OSDDaemon:
             self, pg: PgId, shard: int, osd: int, oid: str,
             include_rollback: bool,
             offset: int = 0, length: int = 0
-    ) -> List[Tuple[int, bytes, Dict[str, bytes]]]:
+    ) -> Tuple[List[Tuple[int, bytes, Dict[str, bytes]]], bool]:
         """Read one (shard, osd)'s main object — and, when asked, its
         rollback generation — as selection candidates.  offset/length
         trim the shard payload to the requested chunk range (the
-        get_want_to_read_shards range discipline)."""
+        get_want_to_read_shards range discipline).
+
+        Second return: True iff every query got a DEFINITIVE answer
+        (the copy exists, rc=0, or definitively does not, ENOENT).  A
+        dead peer or transport failure is NOT evidence of absence —
+        conflating the two is how acked writes get garbage-collected
+        as "divergent creates" (the MissingLoc have-vs-unfound
+        distinction, /root/reference/src/osd/MissingLoc.h)."""
         names = [oid]
         if include_rollback:
             names.append(RB_PREFIX + oid)
         out: List[Tuple[int, bytes, Dict[str, bytes]]] = []
+        definitive = True
         for name in names:
             if osd == self.osd_id:
                 rc, data, at = self._read_shard(
                     pg, shard, name, offset if length else 0, length)
                 if rc == 0:
                     out.append((shard, data, at))
+                elif rc != ENOENT:
+                    definitive = False
                 continue
             tid = self._next_tid()
             reply = await self._request(
@@ -1026,25 +1086,35 @@ class OSDDaemon:
             if reply is not None and reply.rc == 0:
                 self.perf["subread_bytes"] += len(reply.data)
                 out.append((shard, reply.data, reply.attrs))
-        return out
+            elif reply is None or reply.rc != ENOENT:
+                definitive = False
+        return out, definitive
 
     async def _gather_object_shards(
             self, state: PGState, pool, oid: str,
             exclude_missing: bool = True,
             include_rollback: bool = False,
             offset: int = 0, length: int = 0
-    ) -> List[Tuple[int, bytes, Dict[str, bytes]]]:
+    ) -> Tuple[List[Tuple[int, bytes, Dict[str, bytes]]], bool]:
         """Collect available (shard, payload, attrs) candidates for an
         object from up acting shards, CONCURRENTLY (local read for mine,
         sub-reads for peers).  include_rollback adds each shard's
         preserved previous generation; offset/length restrict each
-        shard's payload to a chunk range."""
+        shard's payload to a chunk range.
+
+        Second return: True iff every acting member was probed and
+        answered definitively (a down member or failed query means the
+        gather proves nothing about absence)."""
         pg = state.pg
         plog = self._load_log(state, pool)
         jobs = []
+        complete = True
         for idx, osd in enumerate(state.acting):
             shard = idx if pool.type == TYPE_ERASURE else -1
-            if osd == CRUSH_ITEM_NONE or not self.osdmap.is_up(osd):
+            if osd == CRUSH_ITEM_NONE:
+                continue
+            if not self.osdmap.is_up(osd):
+                complete = False
                 continue
             if osd == self.osd_id and exclude_missing and \
                     oid in plog.missing:
@@ -1052,31 +1122,41 @@ class OSDDaemon:
             jobs.append(self._read_candidates(
                 pg, shard, osd, oid, include_rollback, offset, length))
         results = await asyncio.gather(*jobs) if jobs else []
-        return [c for sub in results for c in sub]
+        complete = complete and all(ok for _sub, ok in results)
+        return [c for sub, _ok in results for c in sub], complete
 
     async def _gather_stray_shards(
             self, state: PGState, pool, oid: str,
             have: Set[Tuple[int, int]]
-    ) -> List[Tuple[int, bytes, Dict[str, bytes]]]:
+    ) -> Tuple[List[Tuple[int, bytes, Dict[str, bytes]]], bool]:
         """Search shards OUTSIDE the acting mapping: prior-interval
         members may hold the only up-to-date copies after several
         remaps (the MissingLoc / might_have_unfound role,
         /root/reference/src/osd/MissingLoc.h).  Queries every up OSD for
         every shard collection of this pg not already in `have`
-        ((shard, osd) pairs)."""
+        ((shard, osd) pairs).
+
+        Second return: True iff the search was EXHAUSTIVE — every OSD
+        that could possibly hold a stray copy was probed and answered.
+        Any down-but-existing OSD makes it False: it might be the sole
+        holder of the newest acked write (might_have_unfound)."""
         pg = state.pg
         if pool.type == TYPE_ERASURE:
             shard_list = list(
                 range(self._codec(pool.id).get_chunk_count()))
         else:
             shard_list = [-1]
+        complete = all(self.osdmap.is_up(o)
+                       for o in range(self.osdmap.max_osd)
+                       if self.osdmap.exists(o))
         jobs = [self._read_candidates(pg, shard, osd, oid,
                                       include_rollback=True)
                 for osd in self.osdmap.get_up_osds()
                 for shard in shard_list
                 if (shard, osd) not in have]
         results = await asyncio.gather(*jobs) if jobs else []
-        return [c for sub in results for c in sub]
+        complete = complete and all(ok for _sub, ok in results)
+        return [c for sub, _ok in results for c in sub], complete
 
     @staticmethod
     def _oi_version(at: Dict[str, bytes]) -> Optional[tuple]:
@@ -1140,10 +1220,12 @@ class OSDDaemon:
     async def _head_info(self, state: PGState, pool, oid: str
                          ) -> Tuple[Optional[dict], Dict[str, Any]]:
         """(object_info | None, snapset) of the head via a 1-byte
-        ranged gather (attrs ride along)."""
-        candidates = await self._gather_object_shards(
+        ranged gather (attrs ride along).  Raises UnfoundObject when
+        the head exists per the log but no copy is locatable."""
+        candidates, _complete = await self._gather_object_shards(
             state, pool, oid, offset=0, length=1)
         if not candidates:
+            self._block_if_unfound(state, pool, oid)
             return None, {"seq": 0, "clones": []}
         need = self._codec(pool.id).get_data_chunk_count() \
             if pool.type == TYPE_ERASURE else 1
@@ -1544,21 +1626,47 @@ class OSDDaemon:
         plog = self._load_log(state, pool)
         my_shard = state.my_shard(self.osd_id, pool.type)
         state.extent_cache.pop(oid, None)  # recovery rewrites shards
-        candidates = await self._gather_object_shards(state, pool, oid)
+        candidates, acting_complete = await self._gather_object_shards(
+            state, pool, oid)
         # always search strays during recovery: after several remaps the
         # newest acked version may exist only on prior-interval members
         have = set()
         for idx, osd in enumerate(state.acting):
             if osd != CRUSH_ITEM_NONE:
                 have.add((idx if pool.type == TYPE_ERASURE else -1, osd))
-        candidates += await self._gather_stray_shards(
+        strays, stray_complete = await self._gather_stray_shards(
             state, pool, oid, have)
+        candidates += strays
+        probes_complete = acting_complete and stray_complete
         targets = [(shard_key, osd)
                    for shard_key, osd in peer_shards.items()
                    if oid in state.peer_missing.get(shard_key, {})]
         i_need = oid in plog.missing
+        # the newest version the PG log says was acked — recovery may
+        # not install anything OLDER unless every possible source was
+        # probed (otherwise a stale stray copy silently rolls back an
+        # acked write while its real holder is down)
+        need_v = plog.missing.get(oid) or ZERO
+        for shard_key, _osd in targets:
+            nv = state.peer_missing.get(shard_key, {}).get(oid) or ZERO
+            if nv > need_v:
+                need_v = nv
 
         if not candidates:
+            if not probes_complete:
+                # zero copies found but a possible source is down or
+                # unreachable: the object is UNFOUND, not deleted.
+                # Removing here would garbage-collect an acked write
+                # whose only holders are temporarily dead.  Keep it
+                # missing; the PG stays unfound and re-peers on every
+                # map change until a source comes back (the reference
+                # blocks recovery the same way until might_have_unfound
+                # is drained or an OSD is declared lost).
+                log.warning(
+                    "osd.%d: %s/%s unfound (0 copies located, probes"
+                    " incomplete — possible source down)",
+                    self.osd_id, pg, oid)
+                return
             # object does not exist at any authoritative source: the
             # divergent entry was a create nobody kept — remove it
             for shard_key, osd in targets:
@@ -1621,6 +1729,13 @@ class OSDDaemon:
                                   range(codec.get_chunk_count()))
             payload = full
             obj_attrs = _attrs_of(version, chosen)
+
+        if not probes_complete and need_v > version:
+            log.warning(
+                "osd.%d: %s/%s unfound at acked version %s (best"
+                " located %s, probes incomplete — possible source"
+                " down)", self.osd_id, pg, oid, need_v, version)
+            return
 
         async def install(shard: int, osd: int) -> None:
             buf = payload.get(shard if pool.type == TYPE_ERASURE else -1,
@@ -1706,6 +1821,8 @@ class OSDDaemon:
                                                     conn)
         except asyncio.CancelledError:
             raise
+        except UnfoundObject:
+            rc, data, out = EAGAIN, b"", {}
         except Exception:
             log.exception("osd.%d: op %r failed", self.osd_id, msg)
             rc, data, out = EIO, b"", {}
@@ -2084,13 +2201,18 @@ class OSDDaemon:
             # shards and reconstruct the span
             chunk_off = (start // width) * chunk
             chunk_len = (span // width) * chunk
-            candidates = await self._gather_object_shards(
+            candidates, _complete = await self._gather_object_shards(
                 state, pool, oid, offset=chunk_off, length=chunk_len)
+            # an unfound object must not be zero-filled and overwritten
+            # as if it never existed — block the write like the reads
+            if not candidates:
+                self._block_if_unfound(state, pool, oid)
             merged = bytearray(span)
             if candidates:
                 version, good, oi = self._select_consistent(
                     candidates, need=k)
                 if version is None:
+                    self._block_if_unfound(state, pool, oid)
                     return EIO
                 old_size = oi.get("size", 0)
                 old_padded = -(-old_size // width) * width
@@ -2180,6 +2302,17 @@ class OSDDaemon:
             return False
         return not any(oid in m for m in state.peer_missing.values())
 
+    def _block_if_unfound(self, state: PGState, pool, oid: str) -> None:
+        """Called when an op could not locate/decode an object's data:
+        if the PG log still says the object exists (it is in a missing
+        set), the acked bytes live on a source that is currently down
+        or unprobed — UNFOUND.  Block the op (EAGAIN via UnfoundObject,
+        the waiting_for_unreadable_object role) instead of reporting
+        ENOENT/EIO or zero-filling — any of those would invent a
+        deletion or corruption the log never recorded."""
+        if not self._pg_is_clean(state, pool, oid):
+            raise UnfoundObject(oid)
+
     async def _op_read(self, state: PGState, pool, oid: str,
                        offset: int, length: int
                        ) -> Tuple[int, bytes]:
@@ -2201,13 +2334,15 @@ class OSDDaemon:
                     return 0, data
                 if rc == ENOENT:
                     return ENOENT, b""
-            candidates = await self._gather_object_shards(
+            candidates, _complete = await self._gather_object_shards(
                 state, pool, oid)
             if not candidates:
+                self._block_if_unfound(state, pool, oid)
                 return ENOENT, b""
             version, chosen, oi = self._select_consistent(
                 candidates, need=1)
             if version is None:
+                self._block_if_unfound(state, pool, oid)
                 return EIO, b""
             if oi.get("whiteout"):
                 return ENOENT, b""
@@ -2233,13 +2368,15 @@ class OSDDaemon:
                 (offset, length))
             chunk_off = (start // width) * chunk
             chunk_len = (span // width) * chunk
-            candidates = await self._gather_object_shards(
+            candidates, _complete = await self._gather_object_shards(
                 state, pool, oid, offset=chunk_off, length=chunk_len)
             if not candidates:
+                self._block_if_unfound(state, pool, oid)
                 return ENOENT, b""
             version, good, oi = self._select_consistent(
                 candidates, need=k)
             if version is None:
+                self._block_if_unfound(state, pool, oid)
                 return EIO, b""
             if oi.get("whiteout"):
                 return ENOENT, b""
@@ -2266,14 +2403,17 @@ class OSDDaemon:
             data = ec_util.decode(sinfo, codec, frags)
             rel = offset - start
             return 0, data[rel:rel + min(length, size - offset)]
-        candidates = await self._gather_object_shards(state, pool, oid)
+        candidates, _complete = await self._gather_object_shards(
+            state, pool, oid)
         if not candidates:
+            self._block_if_unfound(state, pool, oid)
             return ENOENT, b""
         # newest version with >= k intact same-version shards wins;
         # hinfo crc drops corrupt shards (handle_sub_read's verify)
         version, good, oi = self._select_consistent(
             candidates, need=k, verify_hinfo=True)
         if version is None:
+            self._block_if_unfound(state, pool, oid)
             return EIO, b""
         if oi.get("whiteout"):
             return ENOENT, b""
@@ -2297,15 +2437,17 @@ class OSDDaemon:
                        ) -> Tuple[int, Dict[str, Any]]:
         # stat needs attrs + version agreement only: fetch one byte per
         # shard, not the whole payload
-        candidates = await self._gather_object_shards(
+        candidates, _complete = await self._gather_object_shards(
             state, pool, oid, offset=0, length=1)
         if not candidates:
+            self._block_if_unfound(state, pool, oid)
             return ENOENT, {}
         need = self._codec(pool.id).get_data_chunk_count() \
             if pool.type == TYPE_ERASURE else 1
         version, _chosen, oi = self._select_consistent(
             candidates, need=need)
         if version is None:
+            self._block_if_unfound(state, pool, oid)
             return EIO, {}
         if oi.get("whiteout"):
             return ENOENT, {}
@@ -2416,15 +2558,17 @@ class OSDDaemon:
 
     async def _gather_user_attrs(self, state: PGState, pool, oid: str
                                  ) -> Tuple[int, Dict[str, bytes]]:
-        candidates = await self._gather_object_shards(
+        candidates, _complete = await self._gather_object_shards(
             state, pool, oid, offset=0, length=1)
         if not candidates:
+            self._block_if_unfound(state, pool, oid)
             return ENOENT, {}
         need = self._codec(pool.id).get_data_chunk_count() \
             if pool.type == TYPE_ERASURE else 1
         version, chosen, oi = self._select_consistent(candidates,
                                                       need=need)
         if version is None:
+            self._block_if_unfound(state, pool, oid)
             return EIO, {}
         if oi.get("whiteout"):
             return ENOENT, {}

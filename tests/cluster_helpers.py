@@ -19,14 +19,19 @@ from ceph_tpu.osd.daemon import OSDDaemon
 from ceph_tpu.rados.client import RadosClient
 
 FAST_CONFIG = {
-    # tight timings so failure-detection tests run in seconds
-    "osd_heartbeat_interval": 0.2,
-    "osd_heartbeat_grace": 0.8,
+    # tight timings so failure-detection tests run in seconds — but not
+    # so tight that a CPU-contended test host (full-suite runs, JAX
+    # compiles) stalls the shared event loop past the grace and the mon
+    # falsely marks live OSDs down, churning every test into remap
+    # storms.  Real kills are still detected in ~3s << the 15s
+    # wait_for_osd_down budget.
+    "osd_heartbeat_interval": 0.3,
+    "osd_heartbeat_grace": 2.5,
     "osd_sub_op_timeout": 2.0,
 }
 FAST_MON_CONFIG = {
     "mon_osd_min_down_reporters": 1,
-    "osd_heartbeat_grace": 0.8,
+    "osd_heartbeat_grace": 2.5,
 }
 
 
@@ -42,7 +47,7 @@ class Cluster:
             # one shared event loop: scale grace with daemon count so
             # scheduling jitter can't masquerade as failures
             self.osd_config["osd_heartbeat_interval"] = 0.5
-            self.osd_config["osd_heartbeat_grace"] = 3.0
+            self.osd_config["osd_heartbeat_grace"] = 4.0
         self.osd_config.update(osd_config or {})
         self.mon_config = dict(FAST_MON_CONFIG)
         self.mon_config.update(mon_config or {})

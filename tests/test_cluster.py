@@ -312,3 +312,48 @@ def test_overwrite_hides_and_trims_rollback_clones():
             await cluster.stop()
 
     run(main())
+
+
+def test_unfound_object_blocks_reads_until_source_returns():
+    """Kill every holder of an EC object's decodable set: reads must
+    BLOCK (EAGAIN resend loop), not ENOENT — the acked data still
+    exists on the dead OSDs.  When one revives, the read completes
+    with the original bytes (MissingLoc unfound semantics +
+    waiting_for_unreadable_object)."""
+    async def main():
+        cluster = Cluster(num_osds=4)
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "ecpool", profile=EC_PROFILE, pg_num=8)
+            ioctx = cluster.client.open_ioctx("ecpool")
+            payload = bytes(range(256)) * 64
+            await ioctx.write_full("victim", payload)
+            pg = ioctx.object_pg("victim")
+            acting, _p = cluster.mon.osdmap.pg_to_acting_osds(pg)
+            holders = [o for o in acting if o >= 0]
+            # kill 2 of the 3 shard holders: below k=2, undecodable
+            dead = holders[1:3]
+            for osd in dead:
+                await cluster.kill_osd(osd)
+                await cluster.wait_for_osd_down(osd)
+            for osd in dead:
+                await cluster.client.mon_command(
+                    {"prefix": "osd out", "osd": osd})
+            # the read must hang (EAGAIN retry loop), not fail ENOENT
+            read_task = asyncio.get_running_loop().create_task(
+                ioctx.read("victim"))
+            done, _pending = await asyncio.wait([read_task], timeout=3.0)
+            assert not done, (
+                "read of an unfound object completed instead of "
+                f"blocking: {read_task.result() if done else None!r}")
+            # revive one holder: data becomes locatable, read completes
+            await cluster.revive_osd(dead[0])
+            await cluster.wait_for_osd_up(dead[0])
+            await cluster.client.mon_command(
+                {"prefix": "osd in", "osd": dead[0]})
+            assert await asyncio.wait_for(read_task, 60.0) == payload
+        finally:
+            await cluster.stop()
+
+    run(main())

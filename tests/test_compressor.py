@@ -10,7 +10,13 @@ import pytest
 
 from ceph_tpu import compressor as comp
 from ceph_tpu.compressor import gate, scoring
-from ceph_tpu.compressor.plugins import Lz4Compressor, SnappyCompressor, ZlibCompressor
+from ceph_tpu.compressor.plugins import (
+    BrotliCompressor,
+    Lz4Compressor,
+    SnappyCompressor,
+    ZlibCompressor,
+    ZstdCompressor,
+)
 
 
 def _payloads():
@@ -40,9 +46,10 @@ def test_available_algorithms():
     assert "zlib" in algs
     assert "lz4" in algs
     assert "snappy" in algs
-    # gated out of this build, like a reference build without the lib
-    assert "zstd" not in algs
-    assert "brotli" not in algs
+    # bound to the system libzstd/libbrotli (present in this image);
+    # on a host without the libs they gate out instead
+    assert "zstd" in algs
+    assert "brotli" in algs
 
 
 def test_round_trip(codec):
@@ -64,7 +71,9 @@ def test_ratio_on_text(codec):
     assert len(payload) < len(data) // 2
 
 
-@pytest.mark.parametrize("cls", [Lz4Compressor, SnappyCompressor])
+@pytest.mark.parametrize(
+    "cls", [Lz4Compressor, SnappyCompressor, ZstdCompressor,
+            BrotliCompressor])
 def test_corruption_rejected(cls):
     codec = cls()
     data = (b"abcdefgh" * 1000)
@@ -85,7 +94,8 @@ def test_corruption_rejected(cls):
 
 
 def test_truncation_rejected():
-    for cls in (Lz4Compressor, SnappyCompressor):
+    for cls in (Lz4Compressor, SnappyCompressor, ZstdCompressor,
+                BrotliCompressor):
         codec = cls()
         payload, msg = codec.compress(b"abcdefgh" * 1000)
         for cut in (1, len(payload) // 2, len(payload) - 1):
@@ -98,7 +108,7 @@ def test_truncation_rejected():
 
 def test_factory():
     assert comp.Compressor.create("none") is None
-    assert comp.Compressor.create("zstd") is None       # gated
+    assert comp.Compressor.create("zstd") is not None
     assert comp.Compressor.create("nonesuch") is None
     c = comp.Compressor.create("random")
     assert c is not None and c.get_type_name() in comp.available_algorithms()
