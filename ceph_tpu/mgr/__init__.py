@@ -1,0 +1,166 @@
+"""MGR role: cluster-wide aggregation + management modules.
+
+Reference parity: the ceph-mgr daemon (/root/reference/src/mgr/ —
+MgrStandby/Mgr/DaemonServer) hosting python modules under
+/root/reference/src/pybind/mgr/ (balancer, pg_autoscaler, prometheus).
+The reference mgr receives daemon perf reports over its own messenger
+and exposes module surfaces; here the mgr is a CLIENT of the cluster —
+it subscribes to maps like any rados client and scrapes per-OSD state
+over the MOSDCommand wire surface (`ceph tell` role), which the mini-mon
+architecture makes equivalent and far simpler: no second server-side
+report path to keep consistent.
+
+Modules follow the pybind/mgr shape: a registry of named module
+instances, each driven by a periodic serve tick, reading cluster state
+through the hosting daemon and acting through mon/osd commands.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+from ceph_tpu.osd.osdmap import OSDMap, PgId
+from ceph_tpu.rados.client import RadosClient
+
+log = logging.getLogger("mgr")
+
+
+class MgrModule:
+    """Base for mgr modules (pybind/mgr MgrModule role)."""
+
+    NAME = ""
+
+    def __init__(self, mgr: "MgrDaemon"):
+        self.mgr = mgr
+
+    async def serve_once(self) -> None:
+        """One periodic tick; modules do their work here."""
+
+    async def start(self) -> None:
+        """Module bring-up (servers, sockets)."""
+
+    async def stop(self) -> None:
+        """Module teardown."""
+
+
+class MgrDaemon:
+    """Hosts mgr modules over a rados client connection.
+
+    `modules` selects which modules run (names); None = all built-in
+    (balancer runs in manual mode — see its `active` flag — matching
+    the reference default of `balancer mode none`).
+    """
+
+    def __init__(self, mon_addr: str,
+                 modules: Optional[List[str]] = None,
+                 tick_interval: float = 1.0,
+                 config: Optional[Dict[str, Any]] = None):
+        self.mon_addr = mon_addr
+        self.config = config or {}
+        self.tick_interval = tick_interval
+        self.client = RadosClient(mon_addr, name="mgr.x")
+        self.modules: Dict[str, MgrModule] = {}
+        self._module_filter = modules
+        self._tick_task: Optional[asyncio.Task] = None
+        self._stopping = False
+
+    @property
+    def osdmap(self) -> Optional[OSDMap]:
+        return self.client.osdmap
+
+    async def start(self) -> None:
+        from ceph_tpu.mgr.balancer import BalancerModule
+        from ceph_tpu.mgr.pg_autoscaler import PgAutoscalerModule
+        from ceph_tpu.mgr.prometheus import PrometheusModule
+
+        await self.client.connect()
+        for cls in (BalancerModule, PgAutoscalerModule,
+                    PrometheusModule):
+            if self._module_filter is not None and \
+                    cls.NAME not in self._module_filter:
+                continue
+            mod = cls(self)
+            self.modules[cls.NAME] = mod
+            await mod.start()
+        self._tick_task = asyncio.get_running_loop().create_task(
+            self._tick_loop())
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._tick_task is not None:
+            self._tick_task.cancel()
+            try:
+                await self._tick_task
+            except asyncio.CancelledError:
+                pass
+        for mod in self.modules.values():
+            await mod.stop()
+        await self.client.shutdown()
+
+    async def _tick_loop(self) -> None:
+        while not self._stopping:
+            await asyncio.sleep(self.tick_interval)
+            for name, mod in list(self.modules.items()):
+                try:
+                    await mod.serve_once()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("mgr: module %s tick failed", name)
+
+    # -- shared cluster-state helpers (modules read through these) --------
+
+    def pg_mappings(self, pool_id: int) -> Dict[PgId, List[int]]:
+        """pg -> up osd set for one pool, from the subscribed map."""
+        osdmap = self.osdmap
+        out: Dict[PgId, List[int]] = {}
+        if osdmap is None:
+            return out
+        pool = osdmap.pools.get(pool_id)
+        if pool is None:
+            return out
+        for ps in range(pool.pg_num):
+            pg = PgId(pool_id, ps)
+            up, _primary = osdmap.pg_to_acting_osds(pg)
+            out[pg] = [o for o in up if o >= 0]
+        return out
+
+    def pgs_per_osd(self, pool_id: Optional[int] = None
+                    ) -> Dict[int, int]:
+        """PG replica count per OSD (one pool or all pools)."""
+        osdmap = self.osdmap
+        counts: Dict[int, int] = {}
+        if osdmap is None:
+            return counts
+        for o in range(osdmap.max_osd):
+            if osdmap.exists(o) and osdmap.is_in(o):
+                counts[o] = 0
+        pools = ([pool_id] if pool_id is not None
+                 else list(osdmap.pools))
+        for pid in pools:
+            for _pg, osds in self.pg_mappings(pid).items():
+                for o in osds:
+                    if o in counts:
+                        counts[o] += 1
+        return counts
+
+    async def scrape_osd_perf(self) -> Dict[int, Dict[str, Any]]:
+        """perf counters from every up OSD via `tell` commands."""
+        osdmap = self.osdmap
+        out: Dict[int, Dict[str, Any]] = {}
+        if osdmap is None:
+            return out
+
+        async def one(osd: int) -> None:
+            try:
+                rc, perf = await self.client.osd_command(
+                    osd, {"prefix": "perf dump"})
+                if rc == 0:
+                    out[osd] = perf
+            except Exception:
+                pass  # a dead/slow OSD just has no row this scrape
+
+        await asyncio.gather(*(one(o) for o in osdmap.get_up_osds()))
+        return out

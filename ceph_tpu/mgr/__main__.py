@@ -1,0 +1,45 @@
+"""Run a mgr as a real process: python -m ceph_tpu.mgr
+
+Prints `MGR_PROMETHEUS <host:port>` once the exporter is bound (the
+ceph-helpers run_mgr contract analog).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from ceph_tpu.mgr import MgrDaemon
+
+
+async def _main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mon-addr", type=str, required=True)
+    ap.add_argument("--modules", type=str, default="",
+                    help="comma list; empty = all built-in")
+    ap.add_argument("--config", type=str, default="{}",
+                    help="JSON mgr config overrides (balancer_active,"
+                         " prometheus_port, upmap_max_deviation, ...)")
+    args = ap.parse_args()
+    modules = [m for m in args.modules.split(",") if m] or None
+    mgr = MgrDaemon(args.mon_addr, modules=modules,
+                    config=json.loads(args.config))
+    await mgr.start()
+    prom = mgr.modules.get("prometheus")
+    if prom is not None:
+        print(f"MGR_PROMETHEUS {prom.addr}", flush=True)
+    else:
+        print("MGR_UP", flush=True)
+    try:
+        await asyncio.Event().wait()  # serve until killed
+    finally:
+        await mgr.stop()
+
+
+if __name__ == "__main__":
+    try:
+        asyncio.run(_main())
+    except KeyboardInterrupt:
+        sys.exit(0)

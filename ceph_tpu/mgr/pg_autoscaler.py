@@ -1,0 +1,93 @@
+"""pg_autoscaler module: recommended pg_num per pool.
+
+Reference parity: /root/reference/src/pybind/mgr/pg_autoscaler/module.py —
+target PGs per OSD (mon_target_pg_per_osd, default 100) scaled by the
+pool's replication factor, rounded to a power of two, recommendations
+surfaced and (in the reference's `on` mode) applied.
+
+This build surfaces recommendations (`warn` mode): live pg_num changes
+require PG splitting in the OSDs, which the mini-RADOS does not do —
+the recommendation rows and the POOL_TOO_FEW_PGS-style warnings are the
+autoscaler's contract here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ceph_tpu.mgr import MgrModule
+
+TARGET_PG_PER_OSD = 100  # mon_target_pg_per_osd default
+
+
+def _nearest_power_of_two(n: float) -> int:
+    if n <= 1:
+        return 1
+    lo = 1 << (int(n).bit_length() - 1)
+    hi = lo << 1
+    # reference rounds down unless > 1.5x away from the lower power
+    return hi if n >= lo * 1.5 else lo
+
+
+class PgAutoscalerModule(MgrModule):
+    NAME = "pg_autoscaler"
+
+    def __init__(self, mgr, target_pg_per_osd: int = TARGET_PG_PER_OSD):
+        super().__init__(mgr)
+        self.target_pg_per_osd = int(
+            mgr.config.get("mon_target_pg_per_osd", target_pg_per_osd))
+        self.recommendations: Dict[int, Dict[str, Any]] = {}
+
+    async def serve_once(self) -> None:
+        self.recommendations = self.compute()
+
+    def compute(self) -> Dict[int, Dict[str, Any]]:
+        """Per-pool rows mirroring `osd pool autoscale-status`."""
+        osdmap = self.mgr.osdmap
+        out: Dict[int, Dict[str, Any]] = {}
+        if osdmap is None or not osdmap.pools:
+            return out
+        num_osds = sum(1 for o in range(osdmap.max_osd)
+                       if osdmap.exists(o) and osdmap.is_in(o))
+        if num_osds == 0:
+            return out
+        # equal-share capacity split across pools (no per-pool
+        # target_size_ratio surface yet: every pool gets 1/N)
+        budget = self.target_pg_per_osd * num_osds
+        share = budget / len(osdmap.pools)
+        for pool in osdmap.pools.values():
+            # replica count multiplies PG cost on the OSDs; pool.size
+            # is already the full width for both types (replica count
+            # for replicated, k+m for erasure)
+            width = pool.size
+            ideal = _nearest_power_of_two(max(1.0, share / width))
+            row = {
+                "pool_name": pool.name,
+                "pg_num_current": pool.pg_num,
+                "pg_num_ideal": ideal,
+                "replica_width": width,
+                "would_adjust": _would_adjust(pool.pg_num, ideal),
+            }
+            out[pool.id] = row
+        return out
+
+    def health_warnings(self) -> List[str]:
+        """POOL_TOO_FEW_PGS / POOL_TOO_MANY_PGS summaries."""
+        out = []
+        for row in (self.recommendations or self.compute()).values():
+            if not row["would_adjust"]:
+                continue
+            kind = ("too few" if row["pg_num_ideal"] >
+                    row["pg_num_current"] else "too many")
+            out.append(
+                f"pool {row['pool_name']} has {kind} PGs "
+                f"({row['pg_num_current']}, ideal {row['pg_num_ideal']})")
+        return out
+
+
+def _would_adjust(current: int, ideal: int) -> bool:
+    # the reference only flags when off by >= 4x (threshold 3.0 in
+    # newer builds): small drift is not worth a data movement storm
+    if ideal > current:
+        return ideal / max(current, 1) >= 4
+    return current / max(ideal, 1) >= 4

@@ -1,0 +1,145 @@
+"""prometheus module: /metrics exposition endpoint.
+
+Reference parity: /root/reference/src/pybind/mgr/prometheus/module.py —
+an HTTP endpoint serving cluster health, OSD up/in state, pool
+metadata, per-daemon perf counters in the Prometheus text exposition
+format.  The reference runs cherrypy; here a minimal asyncio HTTP/1.0
+responder (GET-only) is plenty and keeps the daemon dependency-free.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, List, Optional
+
+from ceph_tpu.mgr import MgrModule
+
+log = logging.getLogger("mgr")
+
+
+def _esc(value) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt(name: str, value, labels: Optional[Dict[str, Any]] = None
+         ) -> str:
+    if labels:
+        inner = ",".join(f'{k}="{_esc(v)}"' for k, v in labels.items())
+        return f"{name}{{{inner}}} {value}"
+    return f"{name} {value}"
+
+
+class PrometheusModule(MgrModule):
+    NAME = "prometheus"
+
+    def __init__(self, mgr, port: int = 0):
+        super().__init__(mgr)
+        self.port = int(mgr.config.get("prometheus_port", port))
+        self.addr: Optional[str] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, "127.0.0.1", self.port)
+        sock = self._server.sockets[0]
+        host, port = sock.getsockname()[:2]
+        self.addr = f"{host}:{port}"
+        log.info("mgr: prometheus exporter on %s", self.addr)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            request = await asyncio.wait_for(reader.readline(), 5.0)
+            while True:  # drain headers
+                line = await asyncio.wait_for(reader.readline(), 5.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            parts = request.split()
+            path = parts[1].decode("latin-1") if len(parts) >= 2 else None
+            if path in ("/", "/metrics", "/metrics/"):
+                body = await self.collect()
+                status = "200 OK"
+            elif path is None:
+                body, status = "bad request\n", "400 Bad Request"
+            else:
+                body, status = "not found\n", "404 Not Found"
+            payload = body.encode()
+            writer.write(
+                f"HTTP/1.0 {status}\r\n"
+                f"Content-Type: text/plain; version=0.0.4\r\n"
+                f"Content-Length: {len(payload)}\r\n\r\n".encode()
+                + payload)
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+
+    async def collect(self) -> str:
+        """One exposition document from the subscribed map + scrapes."""
+        lines: List[str] = []
+        osdmap = self.mgr.osdmap
+        if osdmap is None:
+            return "# cluster map not yet received\n"
+        lines.append("# TYPE ceph_osdmap_epoch gauge")
+        lines.append(_fmt("ceph_osdmap_epoch", osdmap.epoch))
+        lines.append("# TYPE ceph_osd_up gauge")
+        lines.append("# TYPE ceph_osd_in gauge")
+        for o in range(osdmap.max_osd):
+            if not osdmap.exists(o):
+                continue
+            labels = {"ceph_daemon": f"osd.{o}"}
+            lines.append(_fmt("ceph_osd_up",
+                              int(osdmap.is_up(o)), labels))
+            lines.append(_fmt("ceph_osd_in",
+                              int(osdmap.is_in(o)), labels))
+        lines.append("# TYPE ceph_pool_pg_num gauge")
+        for pool in osdmap.pools.values():
+            lines.append(_fmt("ceph_pool_pg_num", pool.pg_num,
+                              {"pool": pool.name}))
+        lines.append("# TYPE ceph_pg_per_osd gauge")
+        for o, n in self.mgr.pgs_per_osd().items():
+            lines.append(_fmt("ceph_pg_per_osd", n,
+                              {"ceph_daemon": f"osd.{o}"}))
+        # autoscaler recommendations ride along when the module is up
+        scaler = self.mgr.modules.get("pg_autoscaler")
+        if scaler is not None:
+            lines.append(
+                "# TYPE ceph_pool_recommended_pg_num gauge")
+            for row in scaler.compute().values():
+                lines.append(_fmt("ceph_pool_recommended_pg_num",
+                                  row["pg_num_ideal"],
+                                  {"pool": row["pool_name"]}))
+        # per-OSD perf counters over the tell surface
+        perf = await self.mgr.scrape_osd_perf()
+        seen_types = set()
+        for o, counters in sorted(perf.items()):
+            for key, value in sorted(counters.items()):
+                if not isinstance(value, (int, float)):
+                    continue
+                metric = f"ceph_osd_{key}"
+                if metric not in seen_types:
+                    lines.append(f"# TYPE {metric} counter")
+                    seen_types.add(metric)
+                lines.append(_fmt(metric, value,
+                                  {"ceph_daemon": f"osd.{o}"}))
+        # mon health
+        try:
+            rc, health = await self.mgr.client.mon_command(
+                {"prefix": "health"})
+            if rc == 0:
+                lines.append("# TYPE ceph_health_status gauge")
+                lines.append(_fmt(
+                    "ceph_health_status",
+                    0 if health.get("status") == "HEALTH_OK" else 1))
+        except Exception:
+            pass
+        return "\n".join(lines) + "\n"

@@ -44,6 +44,7 @@ from ceph_tpu.msg.messages import (
     MOSDMapMsg,
 )
 from ceph_tpu.osd.osdmap import (
+    CEPH_OSD_DESTROYED,
     CEPH_OSD_IN,
     CEPH_OSD_UP,
     Incremental,
@@ -262,6 +263,10 @@ class MonDaemon:
         inc.new_up_osds[osd] = msg.addr
         if not self.osdmap.is_in(osd):
             inc.new_weight[osd] = CEPH_OSD_IN
+        if self.osdmap.is_destroyed(osd):
+            # a lost OSD that comes back rejoins with normal probe
+            # semantics (its declared-gone window is over)
+            inc.new_state[osd] = CEPH_OSD_DESTROYED  # XOR: clear
         self._commit(inc)
         self._up_from[osd] = self.osdmap.epoch
         log.info("mon: osd.%d booted at %s (epoch %d)", osd, msg.addr,
@@ -334,6 +339,9 @@ class MonDaemon:
                 "osd down": self._cmd_osd_down,
                 "osd out": self._cmd_osd_out,
                 "osd in": self._cmd_osd_in,
+                "osd lost": self._cmd_osd_lost,
+                "osd pg-upmap-items": self._cmd_pg_upmap_items,
+                "osd rm-pg-upmap-items": self._cmd_rm_pg_upmap_items,
                 "status": self._cmd_status,
                 "health": self._cmd_health,
             }.get(prefix)
@@ -457,6 +465,73 @@ class MonDaemon:
             inc.new_weight[osd] = CEPH_OSD_IN
             self._commit(inc)
         return 0, {}
+
+    def _cmd_osd_lost(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        """`osd lost <id> --yes-i-really-mean-it`: declare a dead
+        OSD's data permanently gone (OSDMonitor.cc `osd lost`).  Marks
+        DESTROYED so recovery probes count it as definitively absent —
+        the escape hatch that lets unfound-object adjudication finish
+        when a source will never return."""
+        osd = int(cmd["osd"])
+        if not cmd.get("yes_i_really_mean_it"):
+            return -1, {"error": "this makes data loss permanent; pass"
+                                 " yes_i_really_mean_it"}
+        if not self.osdmap.exists(osd):
+            return -2, {"error": f"osd.{osd} does not exist"}
+        if self.osdmap.is_up(osd):
+            return -16, {"error": f"osd.{osd} is up — only a down osd"
+                                  " can be declared lost"}
+        if not self.osdmap.is_destroyed(osd):
+            inc = Incremental(epoch=self.osdmap.epoch + 1)
+            inc.new_state[osd] = CEPH_OSD_DESTROYED  # XOR: set
+            self._commit(inc)
+        return 0, {"epoch": self.osdmap.epoch}
+
+    def _cmd_pg_upmap_items(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        """`osd pg-upmap-items <pool.ps> <from> <to> [...]` — the
+        balancer's remap primitive (OSDMonitor.cc `osd pg-upmap-items`
+        command).  Validates pairs against the live map before
+        committing (maybe_remove_pg_upmaps discipline)."""
+        from ceph_tpu.osd.osdmap import PgId
+
+        pool_id, ps = cmd["pgid"].split(".")
+        pg = PgId(int(pool_id), int(ps))
+        if pg.pool not in self.osdmap.pools or \
+                pg.ps >= self.osdmap.pools[pg.pool].pg_num:
+            return -2, {"error": f"pg {cmd['pgid']} does not exist"}
+        pairs = [(int(a), int(b)) for a, b in cmd["mappings"]]
+        if not pairs:
+            return -22, {"error": "empty mappings (use"
+                                  " rm-pg-upmap-items to clear)"}
+        pool = self.osdmap.pools[pg.pool]
+        raw, _pps = self.osdmap._pg_to_raw_osds(pool, pg)
+        for src, dst in pairs:
+            if not (self.osdmap.exists(dst) and self.osdmap.is_in(dst)):
+                return -22, {"error": f"target osd.{dst} not in"}
+            if src == dst:
+                return -22, {"error": "identity mapping"}
+            if src not in raw:
+                # a src outside the CRUSH raw mapping would commit as
+                # permanent dead state _apply_upmap never matches
+                # (maybe_remove_pg_upmaps rejection)
+                return -22, {"error": f"osd.{src} is not in the raw"
+                                      f" mapping of {cmd['pgid']}"}
+        inc = Incremental(epoch=self.osdmap.epoch + 1)
+        inc.new_pg_upmap_items[pg] = pairs
+        self._commit(inc)
+        return 0, {"epoch": self.osdmap.epoch}
+
+    def _cmd_rm_pg_upmap_items(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        from ceph_tpu.osd.osdmap import PgId
+
+        pool_id, ps = cmd["pgid"].split(".")
+        pg = PgId(int(pool_id), int(ps))
+        if pg not in self.osdmap.pg_upmap_items:
+            return 0, {}
+        inc = Incremental(epoch=self.osdmap.epoch + 1)
+        inc.old_pg_upmap_items.append(pg)
+        self._commit(inc)
+        return 0, {"epoch": self.osdmap.epoch}
 
     def _cmd_status(self, cmd) -> Tuple[int, Dict[str, Any]]:
         up = self.osdmap.get_up_osds()

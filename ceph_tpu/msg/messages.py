@@ -655,6 +655,48 @@ class MWatchNotifyAck(Message):
         return cls(dec.u64(), dec.u64())
 
 
+@register
+class MOSDCommand(Message):
+    """JSON command to an OSD daemon over the wire — the `ceph tell
+    osd.N <cmd>` role (reference: MCommand.h carried over the client
+    messenger, handled in OSD::do_command, OSD.cc).  Same admin
+    surface as the local admin socket (perf dump, dump_ops_in_flight,
+    scrub) but reachable by the mgr and remote CLIs."""
+
+    TAG = 19
+
+    def __init__(self, tid: int, cmd: Dict[str, Any]):
+        self.tid = tid
+        self.cmd = cmd
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        enc.string(json.dumps(self.cmd))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MOSDCommand":
+        return cls(dec.u64(), json.loads(dec.string()))
+
+
+@register
+class MOSDCommandReply(Message):
+    TAG = 20
+
+    def __init__(self, tid: int, rc: int, out: Dict[str, Any]):
+        self.tid = tid
+        self.rc = rc
+        self.out = out
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        enc.s32(self.rc)
+        enc.string(json.dumps(self.out))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MOSDCommandReply":
+        return cls(dec.u64(), dec.s32(), json.loads(dec.string()))
+
+
 # -- small wire codecs shared by ShardOp omap payloads ----------------------
 
 

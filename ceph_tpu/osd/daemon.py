@@ -1114,7 +1114,8 @@ class OSDDaemon:
             if osd == CRUSH_ITEM_NONE:
                 continue
             if not self.osdmap.is_up(osd):
-                complete = False
+                if not self.osdmap.is_destroyed(osd):
+                    complete = False
                 continue
             if osd == self.osd_id and exclude_missing and \
                     oid in plog.missing:
@@ -1146,7 +1147,9 @@ class OSDDaemon:
                 range(self._codec(pool.id).get_chunk_count()))
         else:
             shard_list = [-1]
-        complete = all(self.osdmap.is_up(o)
+        # a DESTROYED (`osd lost`) OSD is definitively absent by admin
+        # decree — only plain-down OSDs leave the search inconclusive
+        complete = all(self.osdmap.is_up(o) or self.osdmap.is_destroyed(o)
                        for o in range(self.osdmap.max_osd)
                        if self.osdmap.exists(o))
         jobs = [self._read_candidates(pg, shard, osd, oid,
@@ -1232,7 +1235,9 @@ class OSDDaemon:
         version, chosen, oi = self._select_consistent(candidates,
                                                       need=need)
         if version is None:
+            self._block_if_unfound(state, pool, oid)
             return None, {"seq": 0, "clones": []}
+        self._require_fresh(state, pool, oid, version)
         src = next(iter(chosen))
         for shard, _payload, at in candidates:
             if shard == src and self._oi_version(at) == version:
@@ -2214,6 +2219,7 @@ class OSDDaemon:
                 if version is None:
                     self._block_if_unfound(state, pool, oid)
                     return EIO
+                self._require_fresh(state, pool, oid, version)
                 old_size = oi.get("size", 0)
                 old_padded = -(-old_size // width) * width
                 # shards may come back short when the range reaches past
@@ -2313,6 +2319,26 @@ class OSDDaemon:
         if not self._pg_is_clean(state, pool, oid):
             raise UnfoundObject(oid)
 
+    def _acked_version(self, state: PGState, pool, oid: str) -> tuple:
+        """Newest version any missing set records as acked for oid."""
+        plog = self._load_log(state, pool)
+        need = plog.missing.get(oid) or ZERO
+        for m in state.peer_missing.values():
+            nv = m.get(oid) or ZERO
+            if nv > need:
+                need = nv
+        return need
+
+    def _require_fresh(self, state: PGState, pool, oid: str,
+                       version) -> None:
+        """Serving a version OLDER than the acked one in a missing set
+        would expose a rolled-back write while its real holder is down
+        (reads and recovery must agree on the acked-write invariant —
+        recovery's need_v guard is the other half)."""
+        if version is not None and \
+                self._acked_version(state, pool, oid) > version:
+            raise UnfoundObject(oid)
+
     async def _op_read(self, state: PGState, pool, oid: str,
                        offset: int, length: int
                        ) -> Tuple[int, bytes]:
@@ -2344,6 +2370,7 @@ class OSDDaemon:
             if version is None:
                 self._block_if_unfound(state, pool, oid)
                 return EIO, b""
+            self._require_fresh(state, pool, oid, version)
             if oi.get("whiteout"):
                 return ENOENT, b""
             data = chosen[next(iter(chosen))]
@@ -2378,6 +2405,7 @@ class OSDDaemon:
             if version is None:
                 self._block_if_unfound(state, pool, oid)
                 return EIO, b""
+            self._require_fresh(state, pool, oid, version)
             if oi.get("whiteout"):
                 return ENOENT, b""
             size = oi.get("size", 0)
@@ -2415,6 +2443,7 @@ class OSDDaemon:
         if version is None:
             self._block_if_unfound(state, pool, oid)
             return EIO, b""
+        self._require_fresh(state, pool, oid, version)
         if oi.get("whiteout"):
             return ENOENT, b""
         size = oi.get("size", 0)
@@ -2449,6 +2478,7 @@ class OSDDaemon:
         if version is None:
             self._block_if_unfound(state, pool, oid)
             return EIO, {}
+        self._require_fresh(state, pool, oid, version)
         if oi.get("whiteout"):
             return ENOENT, {}
         return 0, {"size": oi.get("size", 0),
@@ -2570,6 +2600,7 @@ class OSDDaemon:
         if version is None:
             self._block_if_unfound(state, pool, oid)
             return EIO, {}
+        self._require_fresh(state, pool, oid, version)
         if oi.get("whiteout"):
             return ENOENT, {}
         src = next(iter(chosen))

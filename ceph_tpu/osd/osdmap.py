@@ -222,6 +222,13 @@ class OSDMap:
     def is_down(self, osd: int) -> bool:
         return not self.is_up(osd)
 
+    def is_destroyed(self, osd: int) -> bool:
+        """Data declared permanently gone (`osd lost` / destroy —
+        OSDMap.h is_destroyed): probes may treat this OSD as
+        definitively absent rather than merely unreachable."""
+        return (self.exists(osd)
+                and self.osd_state[osd] & CEPH_OSD_DESTROYED != 0)
+
     def is_in(self, osd: int) -> bool:
         return self.exists(osd) and self.osd_weight[osd] > 0
 

@@ -19,6 +19,8 @@ from ceph_tpu.msg.messages import (
     MGetMap,
     MMonCommand,
     MMonCommandReply,
+    MOSDCommand,
+    MOSDCommandReply,
     MOSDMapMsg,
     MOSDOp,
     MOSDOpReply,
@@ -133,7 +135,8 @@ class RadosClient:
                                                 msg.cookie))
             except (ConnectionError, OSError):
                 pass
-        elif isinstance(msg, (MOSDOpReply, MMonCommandReply)):
+        elif isinstance(msg, (MOSDOpReply, MMonCommandReply,
+                              MOSDCommandReply)):
             fut = self._futures.pop(msg.tid, None)
             if fut is not None and not fut.done():
                 fut.set_result(msg)
@@ -243,6 +246,36 @@ class RadosClient:
             finally:
                 self._futures.pop(tid, None)
         raise RadosError(EAGAIN, f"mon command {cmd!r} failed ({last!r})")
+
+    async def osd_command(self, osd_id: int, cmd: Dict[str, Any]
+                          ) -> Tuple[int, Dict[str, Any]]:
+        """`ceph tell osd.N <cmd>`: the OSD admin surface over the
+        wire (perf dump, dump_pgs, scrub, ...)."""
+        osdmap = self.osdmap
+        if osdmap is None or not osdmap.is_up(osd_id):
+            raise RadosError(ENOENT, f"osd.{osd_id} not up")
+        addr = osdmap.osd_addrs.get(osd_id)
+        if addr is None:
+            raise RadosError(ENOENT, f"osd.{osd_id} has no address")
+        last: Optional[Exception] = None
+        for attempt in range(2):
+            tid = self._next_tid()
+            fut: asyncio.Future = \
+                asyncio.get_running_loop().create_future()
+            self._futures[tid] = fut
+            try:
+                await self.msgr.send_to(addr, MOSDCommand(tid, cmd))
+                reply = await asyncio.wait_for(fut, self.op_timeout)
+                return reply.rc, reply.out
+            except (asyncio.TimeoutError, ConnectionError, OSError) as e:
+                last = e
+                await asyncio.sleep(0.2)
+            finally:
+                self._futures.pop(tid, None)
+        # same error contract as mon_command/_submit: RadosError, not
+        # raw transport exceptions
+        raise RadosError(EAGAIN,
+                         f"tell osd.{osd_id} {cmd!r} failed ({last!r})")
 
     async def create_replicated_pool(self, name: str, size: int = 3,
                                      pg_num: int = 32) -> int:

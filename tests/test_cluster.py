@@ -357,3 +357,56 @@ def test_unfound_object_blocks_reads_until_source_returns():
             await cluster.stop()
 
     run(main())
+
+
+def test_osd_lost_completes_probe_adjudication():
+    """`osd lost` declares a dead OSD's data permanently gone: stray
+    probes then count it definitively absent, so unfound adjudication
+    (divergent-create GC, missing-version checks) can conclude instead
+    of blocking until the OSD returns."""
+    async def main():
+        cluster = Cluster(num_osds=4)
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool(
+                "p", size=2, pg_num=8)
+            io = cluster.client.open_ioctx("p")
+            await io.write_full("o", b"z" * 1000)
+            pg = io.object_pg("o")
+            acting, primary = cluster.mon.osdmap.pg_to_acting_osds(pg)
+            victim = next(o for o in range(4) if o not in acting)
+            await cluster.kill_osd(victim)
+            await cluster.wait_for_osd_down(victim)
+            posd = cluster.osds[primary]
+            state = posd.pgs[pg]
+            pool = posd.osdmap.pools[pg.pool]
+            # a plain-down OSD leaves the stray search inconclusive
+            _c, complete = await posd._gather_stray_shards(
+                state, pool, "o", set())
+            assert not complete
+            # refusing without the safety latch
+            rc, out = await cluster.client.mon_command(
+                {"prefix": "osd lost", "osd": victim})
+            assert rc != 0
+            rc, _ = await cluster.client.mon_command(
+                {"prefix": "osd lost", "osd": victim,
+                 "yes_i_really_mean_it": True})
+            assert rc == 0
+            await cluster._wait(
+                lambda: posd.osdmap is not None
+                and posd.osdmap.is_destroyed(victim),
+                10.0, "lost state never reached the OSDs")
+            _c, complete = await posd._gather_stray_shards(
+                state, pool, "o", set())
+            assert complete
+            # a live OSD cannot be declared lost
+            rc, _ = await cluster.client.mon_command(
+                {"prefix": "osd lost", "osd": acting[0],
+                 "yes_i_really_mean_it": True})
+            assert rc != 0
+            # data was never on the victim: cluster still serves it
+            assert await io.read("o") == b"z" * 1000
+        finally:
+            await cluster.stop()
+
+    run(main())
