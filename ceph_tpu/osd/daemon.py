@@ -1597,7 +1597,18 @@ class OSDDaemon:
 
     async def _recover_pg(self, state: PGState, pool,
                           peer_shards: Dict[int, int]) -> None:
-        """Recover missing objects: mine by reconstruct, peers by push."""
+        """Recover missing objects: mine by reconstruct, peers by push.
+
+        Three phases, shaped for the device (the RecoveryOp batching of
+        ECBackend.h:249, re-designed TPU-first):
+        1. PLAN — gather candidate shards for EVERY missing object
+           concurrently (each gather already fans its sub-reads out).
+        2. RECONSTRUCT — group EC objects by survivor-shard set and
+           decode + re-encode each group's concatenated stripe streams
+           in ONE device dispatch per group (dispatch-per-object would
+           pay host<->device latency O(objects) times).
+        3. COMMIT — install/push all objects concurrently.
+        """
         pg = state.pg
         plog = self._load_log(state, pool)
         my_shard = state.my_shard(self.osd_id, pool.type)
@@ -1605,16 +1616,45 @@ class OSDDaemon:
         todo: Set[str] = set(plog.missing)
         for missing in state.peer_missing.values():
             todo.update(missing)
-        for oid in sorted(todo):
-            try:
-                await self._recover_object(state, pool, oid, peer_shards)
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                # an unrecoverable object (not enough consistent
-                # shards yet) stays missing; the next interval retries
-                log.exception("osd.%d: recovery of %s/%s failed",
-                              self.osd_id, pg, oid)
+        order = sorted(todo)
+        # fixed-size waves bound memory (shard streams + reconstructed
+        # payloads resident at once) and in-flight probe RPCs while
+        # keeping the per-wave dispatch batching win
+        WAVE = 64
+        for lo in range(0, len(order), WAVE):
+            wave = order[lo:lo + WAVE]
+            results = await asyncio.gather(
+                *(self._recover_plan(state, pool, oid, peer_shards)
+                  for oid in wave),
+                return_exceptions=True)
+            plans = []
+            for oid, plan in zip(wave, results):
+                if isinstance(plan, Exception):
+                    # an unrecoverable object stays missing; the next
+                    # interval retries
+                    log.error(
+                        "osd.%d: recovery plan of %s/%s failed",
+                        self.osd_id, pg, oid, exc_info=plan)
+                    continue
+                if isinstance(plan, BaseException):  # Cancelled etc.
+                    raise plan
+                if plan is not None:
+                    plans.append(plan)
+            reconstructed = self._batch_reconstruct(
+                pool, [p for p in plans if p["kind"] == "ec"])
+            plans = [p for p in plans
+                     if p["kind"] != "ec" or p in reconstructed]
+            commits = await asyncio.gather(
+                *(self._recover_commit(state, pool, plan)
+                  for plan in plans),
+                return_exceptions=True)
+            for plan, res in zip(plans, commits):
+                if isinstance(res, Exception):
+                    log.error(
+                        "osd.%d: recovery commit of %s/%s failed",
+                        self.osd_id, pg, plan["oid"], exc_info=res)
+                elif isinstance(res, BaseException):
+                    raise res
         # persist whatever missing state remains
         cid = self._cid(pg, my_shard)
         t = Transaction()
@@ -1625,11 +1665,23 @@ class OSDDaemon:
 
     async def _recover_object(self, state: PGState, pool, oid: str,
                               peer_shards: Dict[int, int]) -> None:
-        """Reconstruct one object and install it wherever it's missing
-        (RecoveryOp: read k shards, re-encode, push)."""
+        """Single-object recovery (scrub repair's entry point): plan,
+        reconstruct, commit — the unbatched form of _recover_pg."""
+        plan = await self._recover_plan(state, pool, oid, peer_shards)
+        if plan is None:
+            return
+        if plan["kind"] == "ec" and \
+                not self._batch_reconstruct(pool, [plan]):
+            return
+        await self._recover_commit(state, pool, plan)
+
+    async def _recover_plan(self, state: PGState, pool, oid: str,
+                            peer_shards: Dict[int, int]
+                            ) -> Optional[Dict[str, Any]]:
+        """Locate and select an object's authoritative copy; returns a
+        commit plan or None (unfound — stays missing)."""
         pg = state.pg
         plog = self._load_log(state, pool)
-        my_shard = state.my_shard(self.osd_id, pool.type)
         state.extent_cache.pop(oid, None)  # recovery rewrites shards
         candidates, acting_complete = await self._gather_object_shards(
             state, pool, oid)
@@ -1671,10 +1723,155 @@ class OSDDaemon:
                     "osd.%d: %s/%s unfound (0 copies located, probes"
                     " incomplete — possible source down)",
                     self.osd_id, pg, oid)
-                return
+                return None
             # object does not exist at any authoritative source: the
             # divergent entry was a create nobody kept — remove it
-            for shard_key, osd in targets:
+            return {"kind": "remove", "oid": oid, "targets": targets,
+                    "i_need": i_need}
+
+        def _attrs_of(version, chosen) -> Dict[str, bytes]:
+            src = next(iter(chosen))
+            for shard, _payload, at in candidates:
+                if shard == src and self._oi_version(at) == version:
+                    return at
+            return {}
+
+        if pool.type == TYPE_REPLICATED:
+            version, chosen, _oi = self._select_consistent(
+                candidates, need=1)
+            if version is None:
+                return None  # no readable copy with object_info: retry
+            if not probes_complete and need_v > version:
+                log.warning(
+                    "osd.%d: %s/%s unfound at acked version %s (best"
+                    " located %s, probes incomplete — possible source"
+                    " down)", self.osd_id, pg, oid, need_v, version)
+                return None
+            return {"kind": "replicated", "oid": oid,
+                    "targets": targets, "i_need": i_need,
+                    "payload": {-1: chosen[next(iter(chosen))]},
+                    "attrs": _attrs_of(version, chosen),
+                    "omap": await self._fetch_omap_any(
+                        state, pool, oid)}
+
+        codec = self._codec(pool.id)
+        k = codec.get_data_chunk_count()
+        version, chosen, _oi = self._select_consistent(
+            candidates, need=k, verify_hinfo=True)
+        if version is None:
+            # not enough same-version shards anywhere yet: the object
+            # stays missing (unfound) and a later interval retries
+            log.warning("osd.%d: %s/%s unfound (candidate versions"
+                        " %s)", self.osd_id, pg, oid,
+                        sorted({self._oi_version(at)
+                                for _s, _p, at in candidates
+                                if self._oi_version(at)}))
+            return None
+        if not probes_complete and need_v > version:
+            log.warning(
+                "osd.%d: %s/%s unfound at acked version %s (best"
+                " located %s, probes incomplete — possible source"
+                " down)", self.osd_id, pg, oid, need_v, version)
+            return None
+        # normalize to the first k shards (what decode consumes) so
+        # equal survivor sets batch together
+        chosen_k = {s: chosen[s] for s in sorted(chosen)[:k]}
+        return {"kind": "ec", "oid": oid, "targets": targets,
+                "i_need": i_need, "chosen": chosen_k,
+                "attrs": _attrs_of(version, chosen), "omap": None}
+
+    def _batch_reconstruct(self, pool,
+                           ec_plans: List[Dict[str, Any]]
+                           ) -> List[Dict[str, Any]]:
+        """Fill each EC plan's `payload` (all n shard streams): decode
+        groups that share a survivor set in one dispatch each, then
+        re-encode every successful object's data in one dispatch total
+        — shard streams are chunk-aligned, so cross-object batching is
+        plain concatenation along the stripe axis.  A group whose batch
+        fails falls back to per-object decode so one malformed object
+        cannot livelock the rest of the PG; returns the plans that got
+        payloads."""
+        if not ec_plans:
+            return []
+        codec = self._codec(pool.id)
+        sinfo = self._sinfo(pool.id)
+        n = codec.get_chunk_count()
+        chunk = sinfo.get_chunk_size()
+        width = sinfo.get_stripe_width()
+        groups: Dict[tuple, List[Dict[str, Any]]] = {}
+        for plan in ec_plans:
+            groups.setdefault(tuple(sorted(plan["chosen"])),
+                              []).append(plan)
+        datas: Dict[str, bytes] = {}
+
+        def decode_one(p: Dict[str, Any]) -> None:
+            self.perf["decode_dispatches"] += 1
+            datas[p["oid"]] = ec_util.decode(sinfo, codec, p["chosen"])
+
+        for have, group in groups.items():
+            try:
+                streams = {s: b"".join(p["chosen"][s] for p in group)
+                           for s in have}
+                self.perf["decode_dispatches"] += 1
+                data = ec_util.decode(sinfo, codec, streams)
+                off = 0
+                for p in group:
+                    stream_len = len(next(iter(p["chosen"].values())))
+                    span = (stream_len // chunk) * width
+                    datas[p["oid"]] = data[off:off + span]
+                    off += span
+            except Exception:
+                for p in group:
+                    try:
+                        decode_one(p)
+                    except Exception:
+                        log.exception(
+                            "osd.%d: reconstruct of %s failed",
+                            self.osd_id, p["oid"])
+        done = [p for p in ec_plans if p["oid"] in datas]
+        if not done:
+            return []
+        try:
+            all_data = b"".join(datas[p["oid"]] for p in done)
+            self.perf["encode_dispatches"] += 1
+            full = ec_util.encode(sinfo, codec, all_data, range(n))
+            offsets: Dict[int, int] = {s: 0 for s in range(n)}
+            for p in done:
+                span = len(datas[p["oid"]])
+                shard_len = (span // width) * chunk
+                payload = {}
+                for s in range(n):
+                    payload[s] = full.get(s, b"")[
+                        offsets[s]:offsets[s] + shard_len]
+                    offsets[s] += shard_len
+                p["payload"] = payload
+        except Exception:
+            done2 = []
+            for p in done:
+                try:
+                    self.perf["encode_dispatches"] += 1
+                    p["payload"] = ec_util.encode(
+                        sinfo, codec, datas[p["oid"]], range(n))
+                    done2.append(p)
+                except Exception:
+                    log.exception("osd.%d: re-encode of %s failed",
+                                  self.osd_id, p["oid"])
+            done = done2
+        return done
+
+    async def _recover_commit(self, state: PGState, pool,
+                              plan: Dict[str, Any]) -> None:
+        """Apply one plan: remove everywhere, or install the
+        reconstructed copy wherever it's missing (concurrent pushes)."""
+        pg = state.pg
+        plog = self._load_log(state, pool)
+        my_shard = state.my_shard(self.osd_id, pool.type)
+        oid = plan["oid"]
+        targets = plan["targets"]
+        i_need = plan["i_need"]
+
+        if plan["kind"] == "remove":
+            async def remove_peer(shard_key: int, osd: int) -> None:
                 shard = shard_key if shard_key >= -1 else -1
                 tid = self._next_tid()
                 # recovery ops carry the INTERVAL epoch: a live-epoch
@@ -1685,6 +1882,9 @@ class OSDDaemon:
                                       [ShardOp("remove")],
                                       state.interval_epoch, None,
                                       self.osd_id), tid)
+
+            await asyncio.gather(*(remove_peer(sk, osd)
+                                   for sk, osd in targets))
             if i_need:
                 t = Transaction()
                 cid = self._cid(pg, my_shard)
@@ -1697,52 +1897,12 @@ class OSDDaemon:
                     pass
             return
 
-        def _attrs_of(version, chosen) -> Dict[str, bytes]:
-            src = next(iter(chosen))
-            for shard, _payload, at in candidates:
-                if shard == src and self._oi_version(at) == version:
-                    return at
-            return {}
+        payload = plan["payload"]
+        obj_attrs = plan["attrs"]
+        omap_payload = plan["omap"]
 
-        omap_payload: Optional[Dict[str, bytes]] = None
-        if pool.type == TYPE_REPLICATED:
-            version, chosen, _oi = self._select_consistent(
-                candidates, need=1)
-            if version is None:
-                return  # no readable copy with an object_info: retry
-            payload = {-1: chosen[next(iter(chosen))]}
-            obj_attrs = _attrs_of(version, chosen)
-            omap_payload = await self._fetch_omap_any(state, pool, oid)
-        else:
-            codec = self._codec(pool.id)
-            sinfo = self._sinfo(pool.id)
-            k = codec.get_data_chunk_count()
-            version, chosen, _oi = self._select_consistent(
-                candidates, need=k, verify_hinfo=True)
-            if version is None:
-                # not enough same-version shards anywhere yet: the
-                # object stays missing (unfound) and a later interval
-                # retries
-                log.warning("osd.%d: %s/%s unfound (candidate versions"
-                            " %s)", self.osd_id, pg, oid,
-                            sorted({self._oi_version(at)
-                                    for _s, _p, at in candidates
-                                    if self._oi_version(at)}))
-                return
-            data = ec_util.decode(sinfo, codec, chosen)
-            full = ec_util.encode(sinfo, codec, data,
-                                  range(codec.get_chunk_count()))
-            payload = full
-            obj_attrs = _attrs_of(version, chosen)
-
-        if not probes_complete and need_v > version:
-            log.warning(
-                "osd.%d: %s/%s unfound at acked version %s (best"
-                " located %s, probes incomplete — possible source"
-                " down)", self.osd_id, pg, oid, need_v, version)
-            return
-
-        async def install(shard: int, osd: int) -> None:
+        async def install(shard: int, osd: int,
+                          shard_key: Optional[int] = None) -> None:
             buf = payload.get(shard if pool.type == TYPE_ERASURE else -1,
                               b"")
             ops = [ShardOp("create"), ShardOp("truncate", size=0),
@@ -1765,16 +1925,31 @@ class OSDDaemon:
                 self.store.queue_transaction(t)
             else:
                 tid = self._next_tid()
-                await self._request(
+                reply = await self._request(
                     osd, MOSDSubWrite(tid, pg, shard, oid, ops,
                                       state.interval_epoch, None,
                                       self.osd_id), tid)
+                if reply is None or reply.rc != 0:
+                    # the push did NOT land: leave this target in
+                    # peer_missing so the next interval retries it
+                    log.warning(
+                        "osd.%d: recovery push of %s/%s to osd.%d"
+                        " failed (%s)", self.osd_id, pg, oid, osd,
+                        "timeout" if reply is None else reply.rc)
+                    return
+            # mark THIS target recovered as soon as its own push
+            # lands: a failed sibling push must not cause successful
+            # targets to be re-pushed next interval
+            if shard_key is not None:
+                state.peer_missing.get(shard_key, {}).pop(oid, None)
 
+        jobs = []
         if i_need:
-            await install(my_shard, self.osd_id)
+            jobs.append(install(my_shard, self.osd_id))
         for shard_key, osd in targets:
-            await install(shard_key if shard_key >= -1 else -1, osd)
-            state.peer_missing.get(shard_key, {}).pop(oid, None)
+            jobs.append(install(shard_key if shard_key >= -1 else -1,
+                                osd, shard_key))
+        await asyncio.gather(*jobs)
 
     # -- client op engine (primary) ----------------------------------------
 
