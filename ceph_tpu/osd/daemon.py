@@ -255,6 +255,10 @@ class OSDDaemon:
                             Dict[Tuple[str, int], Connection]] = {}
         self._notify_seq = 0
         self._pending_notifies: Dict[int, Dict[str, Any]] = {}
+        # object classes (ClassHandler::open_all role)
+        from ceph_tpu.cls import default_handler
+
+        self.class_handler = default_handler()
         # op tracking + background scrub + admin socket
         from ceph_tpu.osd.op_tracker import OpTracker
 
@@ -1759,14 +1763,33 @@ class OSDDaemon:
         version, chosen, _oi = self._select_consistent(
             candidates, need=k, verify_hinfo=True)
         if version is None:
-            # not enough same-version shards anywhere yet: the object
-            # stays missing (unfound) and a later interval retries
-            log.warning("osd.%d: %s/%s unfound (candidate versions"
-                        " %s)", self.osd_id, pg, oid,
-                        sorted({self._oi_version(at)
-                                for _s, _p, at in candidates
-                                if self._oi_version(at)}))
-            return None
+            if not probes_complete:
+                # not enough same-version shards REACHABLE yet: the
+                # object stays missing (unfound), a later interval
+                # retries when sources return
+                log.warning("osd.%d: %s/%s unfound (candidate versions"
+                            " %s, probes incomplete)", self.osd_id, pg,
+                            oid, sorted({self._oi_version(at)
+                                         for _s, _p, at in candidates
+                                         if self._oi_version(at)}))
+                return None
+            # EVERY possible source answered and no version — head or
+            # rollback generation — reaches k shards: the logged entry
+            # was an in-progress write that never committed on enough
+            # shards (its older generations were already consumed or
+            # the object was removed before it).  Roll back to the last
+            # complete state, which the candidate set proved is
+            # "object absent" — the role of ECBackend's rollback of
+            # uncommitted log entries (ECBackend.cc try_state_to_reads
+            # rollback path, PGLog rollback metadata).  An acked write
+            # can never land here: ack requires every shard durable, so
+            # some version would reconstruct.
+            log.warning("osd.%d: %s/%s: no reconstructible version"
+                        " after exhaustive probe — rolling back the"
+                        " uncommitted entry (remove)",
+                        self.osd_id, pg, oid)
+            return {"kind": "remove", "oid": oid, "targets": targets,
+                    "i_need": i_need, "purge": True}
         if not probes_complete and need_v > version:
             log.warning(
                 "osd.%d: %s/%s unfound at acked version %s (best"
@@ -1883,8 +1906,39 @@ class OSDDaemon:
                                       state.interval_epoch, None,
                                       self.osd_id), tid)
 
+            removals = list(targets)
+            if plan.get("purge"):
+                # rolling back an uncommitted entry must also drop the
+                # partial shards that DO exist — on acting members AND
+                # on strays (the exhaustive probe that justified this
+                # purge searched every up OSD x shard, so the purge
+                # sweeps the same breadth) — or the orphan fragments
+                # resurface as below-k candidates on every later read
+                if pool.type == TYPE_ERASURE:
+                    shard_list = list(range(
+                        self._codec(pool.id).get_chunk_count()))
+                else:
+                    shard_list = [-1]
+                seen = {(sk if sk >= -1 else -1, osd)
+                        for sk, osd in removals}
+                for osd in self.osdmap.get_up_osds():
+                    if osd == self.osd_id:
+                        continue
+                    for shard in shard_list:
+                        if (shard, osd) not in seen:
+                            removals.append((shard, osd))
             await asyncio.gather(*(remove_peer(sk, osd)
-                                   for sk, osd in targets))
+                                   for sk, osd in removals))
+            if plan.get("purge") and not i_need:
+                # my own partial shard goes too (I may hold data while
+                # not being in my own missing set)
+                t = Transaction()
+                cid = self._cid(pg, my_shard)
+                t.remove(cid, ObjectId(oid))
+                try:
+                    self.store.queue_transaction(t)
+                except KeyError:
+                    pass
             if i_need:
                 t = Transaction()
                 cid = self._cid(pg, my_shard)
@@ -2088,6 +2142,12 @@ class OSDDaemon:
             elif op.op == "notify":
                 rc, out = await self._op_notify(state, pool, msg.oid,
                                                 op.data)
+            elif op.op == "call":
+                rc, data = await self._op_call(
+                    state, pool, read_oid, op.args.get("cls", ""),
+                    op.args.get("method", ""), op.data,
+                    state_admit_epoch, snapc,
+                    read_only=msg.snap_id > 0)
             elif op.op == "pgls":
                 rc, out = self._op_pgls(state, pool)
             else:
@@ -2857,6 +2917,43 @@ class OSDDaemon:
             return EINVAL
         table[(msg.client, cookie)] = conn
         return 0
+
+    async def _op_call(self, state: PGState, pool, oid: str,
+                       cls: str, method: str, data: bytes,
+                       admit_epoch: int, snapc,
+                       read_only: bool = False) -> Tuple[int, bytes]:
+        """`exec` op: run a registered object-class method
+        (ClassHandler::ClassMethod::exec, PrimaryLogPG::do_osd_ops
+        CEPH_OSD_OP_CALL).  Concurrent calls on one object serialize
+        on a per-object cls lock, so read-modify-write methods
+        (numops, lock) are atomic against each other; each inner op
+        additionally takes the normal object lock on its own."""
+        from ceph_tpu.cls import ClsError, MethodContext
+
+        entry = self.class_handler.lookup(cls, method)
+        if entry is None:
+            return EINVAL, b""
+        fn, flags = entry
+        from ceph_tpu.cls import WR as CLS_WR
+
+        if read_only and flags & CLS_WR:
+            # a WR method at a snap would mutate the immutable clone
+            # the read resolved to (the reference's -EROFS for writes
+            # at a non-head snapid)
+            return -30, b""  # EROFS
+        ctx = MethodContext(self, state, pool, oid, admit_epoch,
+                            snapc, flags)
+        async with state.obj_lock(f"_cls_\x00{oid}"):
+            try:
+                return 0, await fn(ctx, data)
+            except ClsError as e:
+                return e.rc, b""
+            except UnfoundObject:
+                raise
+            except Exception:
+                log.exception("osd.%d: cls %s.%s on %r failed",
+                              self.osd_id, cls, method, oid)
+                return EIO, b""
 
     async def _op_notify(self, state: PGState, pool, oid: str,
                          payload: bytes

@@ -465,6 +465,20 @@ class IoCtx:
 
     # -- xattrs ------------------------------------------------------------
 
+    async def execute(self, oid: str, cls: str, method: str,
+                      data: bytes = b"") -> bytes:
+        """Run an object-class method server-side (rados_exec role).
+        Returns the method's output bytes; errors raise RadosError
+        with the method's rc."""
+        reply = await self._submit(
+            oid, [OSDOp("call", data=data,
+                        args={"cls": cls, "method": method})])
+        if reply.rc == ENOENT:
+            raise ObjectNotFound(reply.rc, oid)
+        if reply.rc != 0:
+            raise RadosError(reply.rc, f"exec {cls}.{method} on {oid!r}")
+        return reply.data
+
     async def setxattr(self, oid: str, name: str, value: bytes) -> None:
         reply = await self._submit(
             oid, [OSDOp("setxattr", data=value, args={"name": name})])
@@ -508,6 +522,8 @@ class IoCtx:
 
     async def omap_get(self, oid: str) -> Dict[str, bytes]:
         reply = await self._submit(oid, [OSDOp("omap_get")])
+        if reply.rc == ENOENT:
+            raise ObjectNotFound(reply.rc, oid)
         if reply.rc != 0:
             raise RadosError(reply.rc, f"omap_get {oid!r}")
         return decode_kv_map(reply.data) if reply.data else {}

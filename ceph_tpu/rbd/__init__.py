@@ -1,0 +1,306 @@
+"""RBD role: block images striped over rados objects.
+
+Reference parity: librbd (/root/reference/src/librbd/ — librbd.cc API
+surface, ObjectMap/image layout in src/librbd/image/CreateRequest.cc):
+
+- an image is an id, a header object `rbd_header.<id>` (metadata in
+  omap: size, order, snapshots), and data objects
+  `rbd_data.<id>.<objectno:016x>`, each covering 2^order bytes;
+- `rbd_directory` maps name <-> id (src/cls/rbd dir_* methods);
+- byte-range I/O maps to object extents (the Striper role,
+  src/osdc/Striper.cc:file_to_extents) and fans out in parallel —
+  absent data objects read as zeros (sparse images);
+- erasure-coded backends use a separate data pool (`rbd create
+  --data-pool`, librbd data_pool feature): metadata/omap stays on a
+  replicated pool (omap is unsupported on EC pools, here as in the
+  reference) while data objects live on the EC pool;
+- snapshots ride the pool's self-managed snap machinery: snap_create
+  allocates a snap id and folds it into the image's write snap
+  context, so ordinary clone-on-write in the OSDs preserves the
+  snapshot state; reading at a snap sets the read-snap on the data
+  ioctx (librbd::Image::snap_set).
+
+The reference keeps image state in cls_rbd stored procedures; here the
+same records live directly in header-object omap — the cls-lite layer
+can host them later without changing the layout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+from typing import Any, Dict, List, Optional
+
+from ceph_tpu.rados.client import IoCtx, ObjectNotFound, RadosError
+
+RBD_DIRECTORY = "rbd_directory"
+DEFAULT_ORDER = 22  # 4 MiB objects, the reference default
+
+
+def _header(image_id: str) -> str:
+    return f"rbd_header.{image_id}"
+
+
+def _data(image_id: str, objectno: int) -> str:
+    return f"rbd_data.{image_id}.{objectno:016x}"
+
+
+class RBD:
+    """Image management surface (librbd::RBD)."""
+
+    async def create(self, ioctx: IoCtx, name: str, size: int,
+                     order: int = DEFAULT_ORDER,
+                     data_pool: Optional[str] = None) -> str:
+        """Create an image; returns its id.  data_pool places the data
+        objects on a different (e.g. erasure-coded) pool while
+        metadata stays on this replicated pool (--data-pool role)."""
+        if not (12 <= order <= 26):
+            raise RadosError(-22, f"order {order} out of range")
+        directory = await self._dir(ioctx)
+        if name in directory:
+            raise RadosError(-17, f"image {name!r} exists")  # EEXIST
+        digest = hashlib.sha1(name.encode()).hexdigest()[:10]
+        image_id = f"{ioctx.pool_id:x}{digest}"
+        meta = {"name": name, "size": size, "order": order,
+                "snaps": {}, "snap_seq": 0, "data_pool": data_pool}
+        await ioctx.omap_set(_header(image_id),
+                             {"rbd": json.dumps(meta).encode()})
+        await ioctx.omap_set(RBD_DIRECTORY,
+                             {f"name_{name}": image_id.encode()})
+        return image_id
+
+    async def remove(self, ioctx: IoCtx, name: str) -> None:
+        directory = await self._dir(ioctx)
+        image_id = directory.get(name)
+        if image_id is None:
+            raise ObjectNotFound(-2, name)
+        img = await self.open(ioctx, name)
+        if img.meta["snaps"]:
+            raise RadosError(-39, "image has snapshots")  # ENOTEMPTY
+        objects = (img.size() + img.object_size - 1) // img.object_size
+        await asyncio.gather(*(
+            _ignore_enoent(img.data_ioctx.remove(_data(image_id, i)))
+            for i in range(objects)))
+        await _ignore_enoent(ioctx.remove(_header(image_id)))
+        await ioctx.omap_rm_keys(RBD_DIRECTORY, [f"name_{name}"])
+
+    async def list(self, ioctx: IoCtx) -> List[str]:
+        return sorted(await self._dir(ioctx))
+
+    async def open(self, ioctx: IoCtx, name: str) -> "Image":
+        directory = await self._dir(ioctx)
+        image_id = directory.get(name)
+        if image_id is None:
+            raise ObjectNotFound(-2, name)
+        img = Image(ioctx, name, image_id)
+        await img.refresh()
+        return img
+
+    async def _dir(self, ioctx: IoCtx) -> Dict[str, str]:
+        try:
+            omap = await ioctx.omap_get(RBD_DIRECTORY)
+        except ObjectNotFound:
+            return {}
+        return {k[len("name_"):]: v.decode()
+                for k, v in omap.items() if k.startswith("name_")}
+
+
+async def _ignore_enoent(coro) -> None:
+    try:
+        await coro
+    except ObjectNotFound:
+        pass
+
+
+class Image:
+    """An open image (librbd::Image): byte-addressed I/O + snaps."""
+
+    def __init__(self, ioctx: IoCtx, name: str, image_id: str):
+        # a dedicated ioctx: image snap context must not leak into the
+        # caller's other I/O
+        self.ioctx = IoCtx(ioctx.client, ioctx.pool_id)
+        # data objects may live on a separate (EC) pool; bound in
+        # refresh() once the header names it
+        self.data_ioctx = self.ioctx
+        self.name = name
+        self.id = image_id
+        self.meta: Dict[str, Any] = {}
+        self._read_snap: Optional[str] = None
+
+    # -- metadata ----------------------------------------------------------
+
+    async def refresh(self) -> None:
+        omap = await self.ioctx.omap_get(_header(self.id))
+        self.meta = json.loads(omap["rbd"].decode())
+        data_pool = self.meta.get("data_pool")
+        if data_pool and self.data_ioctx is self.ioctx:
+            self.data_ioctx = self.ioctx.client.open_ioctx(data_pool)
+        self._apply_snapc()
+
+    async def _save(self) -> None:
+        await self.ioctx.omap_set(
+            _header(self.id), {"rbd": json.dumps(self.meta).encode()})
+
+    def _apply_snapc(self) -> None:
+        snaps = sorted((s["id"] for s in self.meta["snaps"].values()),
+                       reverse=True)
+        self.data_ioctx.set_snap_context(self.meta["snap_seq"], snaps)
+
+    @property
+    def object_size(self) -> int:
+        return 1 << self.meta["order"]
+
+    def size(self) -> int:
+        if self._read_snap is not None:
+            return self.meta["snaps"][self._read_snap]["size"]
+        return self.meta["size"]
+
+    async def stat(self) -> Dict[str, Any]:
+        return {"size": self.size(), "order": self.meta["order"],
+                "obj_size": self.object_size,
+                "num_objs": (self.size() + self.object_size - 1)
+                // self.object_size}
+
+    # -- extent mapping (Striper::file_to_extents role) --------------------
+
+    def _extents(self, offset: int, length: int):
+        """(objectno, in-object offset, length) covering the range."""
+        out = []
+        end = offset + length
+        while offset < end:
+            objectno = offset // self.object_size
+            in_off = offset % self.object_size
+            span = min(self.object_size - in_off, end - offset)
+            out.append((objectno, in_off, span))
+            offset += span
+        return out
+
+    # -- I/O ---------------------------------------------------------------
+
+    async def read(self, offset: int, length: int) -> bytes:
+        size = self.size()
+        if offset >= size:
+            return b""
+        length = min(length, size - offset)
+
+        async def one(objectno: int, in_off: int, span: int) -> bytes:
+            try:
+                buf = await self.data_ioctx.read(
+                    _data(self.id, objectno), in_off, span)
+            except ObjectNotFound:
+                return bytes(span)  # sparse: absent object reads zeros
+            if len(buf) < span:  # short object tail is sparse too
+                buf += bytes(span - len(buf))
+            return buf
+
+        parts = await asyncio.gather(
+            *(one(*ext) for ext in self._extents(offset, length)))
+        return b"".join(parts)
+
+    async def write(self, offset: int, data: bytes) -> int:
+        if self._read_snap is not None:
+            raise RadosError(-30, "image is open at a snapshot")  # EROFS
+        if offset + len(data) > self.meta["size"]:
+            raise RadosError(-27, "write past image size")  # EFBIG
+        pos = 0
+        jobs = []
+        for objectno, in_off, span in self._extents(offset, len(data)):
+            chunk = data[pos:pos + span]
+            pos += span
+            jobs.append(self.data_ioctx.write(
+                _data(self.id, objectno), chunk, in_off))
+        await asyncio.gather(*jobs)
+        return len(data)
+
+    async def discard(self, offset: int, length: int) -> None:
+        """Deallocate a range: whole objects are removed (returning
+        them to sparse), partial spans are zeroed."""
+        if self._read_snap is not None:
+            raise RadosError(-30, "image is open at a snapshot")
+        jobs = []
+        for objectno, in_off, span in self._extents(offset, length):
+            name = _data(self.id, objectno)
+            if in_off == 0 and span == self.object_size:
+                jobs.append(_ignore_enoent(
+                    self.data_ioctx.remove(name)))
+            else:
+                jobs.append(self.data_ioctx.write(
+                    name, bytes(span), in_off))
+        await asyncio.gather(*jobs)
+
+    async def resize(self, new_size: int) -> None:
+        if self._read_snap is not None:
+            raise RadosError(-30, "image is open at a snapshot")
+        old = self.meta["size"]
+        if new_size < old:
+            # drop whole objects past the end; zero the partial tail
+            first_dead = (new_size + self.object_size - 1) \
+                // self.object_size
+            last = (old + self.object_size - 1) // self.object_size
+            await asyncio.gather(*(
+                _ignore_enoent(
+                    self.data_ioctx.remove(_data(self.id, i)))
+                for i in range(first_dead, last)))
+            if new_size % self.object_size:
+                tail = new_size % self.object_size
+                await self.data_ioctx.write(
+                    _data(self.id, new_size // self.object_size),
+                    bytes(self.object_size - tail), tail)
+        self.meta["size"] = new_size
+        await self._save()
+
+    # -- snapshots (librbd snap_create/list/remove/set) --------------------
+
+    async def snap_create(self, snap_name: str) -> int:
+        if snap_name in self.meta["snaps"]:
+            raise RadosError(-17, f"snap {snap_name!r} exists")
+        snap_id = await self.data_ioctx.create_selfmanaged_snap()
+        self.meta["snaps"][snap_name] = {
+            "id": snap_id, "size": self.meta["size"]}
+        self.meta["snap_seq"] = max(self.meta["snap_seq"], snap_id)
+        self._apply_snapc()
+        await self._save()
+        return snap_id
+
+    async def snap_list(self) -> List[Dict[str, Any]]:
+        return [{"name": n, **s}
+                for n, s in sorted(self.meta["snaps"].items(),
+                                   key=lambda kv: kv[1]["id"])]
+
+    async def snap_remove(self, snap_name: str) -> None:
+        snap = self.meta["snaps"].pop(snap_name, None)
+        if snap is None:
+            raise ObjectNotFound(-2, snap_name)
+        self._apply_snapc()
+        await self._save()
+        await self.data_ioctx.remove_selfmanaged_snap(snap["id"])
+
+    def snap_set(self, snap_name: Optional[str]) -> None:
+        """Open the image read-only at a snapshot (None = head)."""
+        if snap_name is None:
+            self._read_snap = None
+            self.data_ioctx.snap_set_read(0)
+            return
+        snap = self.meta["snaps"].get(snap_name)
+        if snap is None:
+            raise ObjectNotFound(-2, snap_name)
+        self._read_snap = snap_name
+        self.data_ioctx.snap_set_read(snap["id"])
+
+    async def snap_rollback(self, snap_name: str) -> None:
+        """Copy the snap's content back over the head (librbd
+        snap_rollback: reads at the snap, writes to the head)."""
+        snap = self.meta["snaps"].get(snap_name)
+        if snap is None:
+            raise ObjectNotFound(-2, snap_name)
+        reader = Image(self.ioctx, self.name, self.id)
+        await reader.refresh()  # binds data_ioctx (data_pool images)
+        reader.snap_set(snap_name)
+        if self.meta["size"] != snap["size"]:
+            await self.resize(snap["size"])
+        step = self.object_size
+        for off in range(0, snap["size"], step):
+            span = min(step, snap["size"] - off)
+            buf = await reader.read(off, span)
+            await self.write(off, buf)

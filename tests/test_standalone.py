@@ -19,10 +19,10 @@ import pytest
 
 REPO = Path(__file__).resolve().parent.parent
 
-OSD_CONFIG = ('{"osd_heartbeat_interval": 0.2, '
-              '"osd_heartbeat_grace": 1.0}')
+OSD_CONFIG = ('{"osd_heartbeat_interval": 0.3, '
+              '"osd_heartbeat_grace": 2.5}')
 MON_CONFIG = ('{"mon_osd_min_down_reporters": 1, '
-              '"osd_heartbeat_grace": 1.0}')
+              '"osd_heartbeat_grace": 2.5}')
 
 
 def _spawn(args):
@@ -186,5 +186,151 @@ def test_mon_restart_survives(tmp_path):
     finally:
         for proc in list(procs.values()) + [mon]:
             if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
+@pytest.mark.slow
+def test_multiprocess_thrash_sigkill_under_load(tmp_path):
+    """Process-grade thrasher: a continuous write workload runs while
+    random TPUStore-backed OSD PROCESSES are SIGKILLed mid-write and
+    restarted on their surviving stores — and the mon itself is
+    SIGKILLed and restarted once mid-thrash.  The RadosModel acked/
+    indeterminate discipline must hold with zero data loss
+    (qa/tasks/thrashosds + ceph_test_rados, at process granularity)."""
+    import random
+
+    NUM = 6
+    rng = random.Random(4242)
+    procs = {}
+    mon_port = [None]
+    mon_box = [None]
+
+    def spawn_osd(i):
+        return _spawn(
+            ["-m", "ceph_tpu.osd", "--id", str(i),
+             "--mon", f"127.0.0.1:{mon_port[0]}",
+             "--store-path", str(tmp_path / f"osd.{i}"),
+             "--config", OSD_CONFIG])
+
+    def spawn_mon(port=0):
+        return _spawn(
+            ["-m", "ceph_tpu.mon", "--num-osds", str(NUM),
+             "--config", MON_CONFIG, "--port", str(port),
+             "--store-path", str(tmp_path / "mon.db")])
+
+    mon_box[0] = _spawn(["-m", "ceph_tpu.mon", "--num-osds", str(NUM),
+                         "--config", MON_CONFIG,
+                         "--store-path", str(tmp_path / "mon.db")])
+    try:
+        mon_addr = _read_addr(mon_box[0], "MON_ADDR")
+        mon_port[0] = mon_addr.rsplit(":", 1)[1]
+        for i in range(NUM):
+            procs[i] = spawn_osd(i)
+        for i in range(NUM):
+            _read_addr(procs[i], "OSD_ADDR")
+
+        async def drive():
+            from ceph_tpu.rados.client import ObjectNotFound
+            from ceph_tpu.rados.client import RadosClient, RadosError
+
+            client = RadosClient(mon_addr)
+            await client.connect()
+            try:
+                await client.create_ec_pool("thrash", {
+                    "plugin": "ec_jax", "technique": "reed_sol_van",
+                    "k": "2", "m": "2",
+                    "crush-failure-domain": "osd"}, pg_num=8)
+                ioctx = client.open_ioctx("thrash")
+                model: dict = {}
+                maybe: dict = {}
+                acked = [0]
+
+                async def workload():
+                    seq = 0
+                    while True:
+                        seq += 1
+                        oid = f"o-{rng.randrange(10)}"
+                        data = np.random.default_rng(seq).integers(
+                            0, 256, rng.randrange(1000, 40_000),
+                            dtype=np.uint8).tobytes()
+                        maybe.setdefault(oid, []).append(data)
+                        try:
+                            await ioctx.write_full(oid, data)
+                            model[oid] = data
+                            maybe[oid] = []
+                            acked[0] += 1
+                        except RadosError:
+                            pass
+                        await asyncio.sleep(0)
+
+                async def up_count(want, timeout=60.0):
+                    for _ in range(int(timeout / 0.1)):
+                        try:
+                            rc, out = await client.mon_command(
+                                {"prefix": "status"})
+                            if rc == 0 and \
+                                    out["num_up_osds"] == want:
+                                return
+                        except RadosError:
+                            pass
+                        await asyncio.sleep(0.1)
+                    raise TimeoutError(f"never reached {want} up osds")
+
+                task = asyncio.get_running_loop().create_task(
+                    workload())
+                try:
+                    for cycle in range(5):
+                        victim = rng.randrange(NUM)
+                        procs[victim].send_signal(signal.SIGKILL)
+                        procs[victim].wait()
+                        await up_count(NUM - 1)
+                        # keep writing degraded for a beat
+                        await asyncio.sleep(1.0)
+                        if cycle == 2:
+                            # SIGKILL + restart the mon mid-thrash on
+                            # its durable store: cluster state and the
+                            # in-flight workload must survive
+                            mon_box[0].send_signal(signal.SIGKILL)
+                            mon_box[0].wait()
+                            mon_box[0] = spawn_mon(mon_port[0])
+                            procs[f"mon-{cycle}"] = mon_box[0]
+                            _read_addr(mon_box[0], "MON_ADDR")
+                        procs[victim] = spawn_osd(victim)
+                        _read_addr(procs[victim], "OSD_ADDR")
+                        await up_count(NUM)
+                finally:
+                    task.cancel()
+                    try:
+                        await task
+                    except asyncio.CancelledError:
+                        pass
+                assert acked[0] >= 10, f"only {acked[0]} acked writes"
+                # settle: health returns to OK (recovery converged)
+                for _ in range(600):
+                    try:
+                        rc, out = await client.mon_command(
+                            {"prefix": "health"})
+                        if rc == 0 and out["status"] == "HEALTH_OK":
+                            break
+                    except RadosError:
+                        pass
+                    await asyncio.sleep(0.1)
+                # zero data loss across process kills + mon restart
+                for oid, data in model.items():
+                    try:
+                        got = await ioctx.read(oid)
+                    except ObjectNotFound:
+                        got = None
+                    legal = [data] + maybe.get(oid, [])
+                    assert any(got == want for want in legal), \
+                        f"{oid}: acked write lost"
+            finally:
+                await client.shutdown()
+
+        asyncio.run(asyncio.wait_for(drive(), 360))
+    finally:
+        for proc in list(procs.values()) + [mon_box[0]]:
+            if proc is not None and proc.poll() is None:
                 proc.kill()
                 proc.wait()

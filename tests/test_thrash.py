@@ -1,46 +1,48 @@
-"""Thrasher tier: randomized kill/revive under load with a model checker.
+"""Thrasher tier: randomized kill/revive under sustained load with a
+model checker.
 
 The thrashosds/ceph_test_rados shape
 (/root/reference/qa/tasks/ceph_manager.py:2702,2744 kill_osd/revive_osd;
-/root/reference/src/test/osd/RadosModel.h): a workload of writes runs
-while OSDs are killed mid-write and revived; a client-side model tracks
-every ACKED write.  Invariants at the end (after the cluster goes
-clean):
+/root/reference/src/test/osd/RadosModel.h): a workload of writes and
+removes runs while OSDs are killed mid-write and revived; a client-side
+model tracks every ACKED operation.  Invariants at the end (after the
+cluster goes clean):
 
-1. zero data loss: every acked write reads back exactly;
-2. log convergence: every shard of every object matches the re-encode
-   of the object's current readable state (kill-replica-mid-write logs
-   converged on all shards).
+1. zero data loss: every object reads back as its last acked state or
+   a later indeterminate (unacked) attempt;
+2. shard/replica convergence: every stored copy of every object matches
+   the re-encode (EC) or the bytes (replicated) of its readable state.
+
+Three in-loop profiles (EC 2+2, EC 8+3, replicated size-3) run >= 60 s
+of load and >= 40 thrash actions each; a separate process tier SIGKILLs
+TPUStore-backed OSD processes and the mon mid-write.
 """
 
 import asyncio
 import random
+import time
 
 import numpy as np
 import pytest
 
 from ceph_tpu.ec.registry import create_erasure_code
 from ceph_tpu.osd import ec_util
-from ceph_tpu.osd.pg_log import PGMETA_OID
-from ceph_tpu.rados.client import RadosError
+from ceph_tpu.rados.client import ObjectNotFound, RadosError
 
 from cluster_helpers import Cluster
 
-EC_PROFILE = {"plugin": "ec_jax", "technique": "reed_sol_van",
-              "k": "2", "m": "1", "crush-failure-domain": "osd"}
 
-
-async def _thrash_once(rng, cluster, down: set) -> None:
+async def _thrash_once(rng, cluster, down: set, min_alive: int) -> None:
     """One thrash action: kill+out a random up OSD, or revive+in."""
     alive = sorted(set(cluster.osds) - down)
-    if down and (len(alive) <= 3 or rng.random() < 0.5):
+    if down and (len(alive) <= min_alive or rng.random() < 0.5):
         osd = rng.choice(sorted(down))
         down.discard(osd)
         await cluster.revive_osd(osd)
         await cluster.wait_for_osd_up(osd)
         await cluster.client.mon_command({"prefix": "osd in",
                                           "osd": osd})
-    elif len(alive) > 3:
+    elif len(alive) > min_alive:
         osd = rng.choice(alive)
         down.add(osd)
         await cluster.kill_osd(osd)       # mid-write: no quiesce
@@ -49,109 +51,169 @@ async def _thrash_once(rng, cluster, down: set) -> None:
                                           "osd": osd})
 
 
-@pytest.mark.slow
-def test_thrash_ec_no_data_loss_and_converged_shards():
-    async def main():
-        rng = random.Random(1234)
-        cluster = Cluster(num_osds=5, osds_per_host=1)
-        await cluster.start()
-        try:
-            await cluster.client.create_ec_pool("ec", EC_PROFILE,
-                                                pg_num=8)
-            ioctx = cluster.client.open_ioctx("ec")
-            # RadosModel discipline: an ACKED write must survive; an
-            # UNACKED write (error/timeout) may have committed anyway,
-            # so the legal states are {last acked} U {unacked attempts
-            # since the last ack}
-            model: dict = {}       # oid -> acked payload
-            maybe: dict = {}       # oid -> [unacked payloads since ack]
-            down: set = set()
+async def _run_thrash(*, seed: int, num_osds: int, osds_per_host: int,
+                      pool: dict, min_alive: int,
+                      duration_s: float = 60.0, min_actions: int = 40,
+                      n_objects: int = 16) -> None:
+    rng = random.Random(seed)
+    cluster = Cluster(num_osds=num_osds, osds_per_host=osds_per_host)
+    await cluster.start()
+    try:
+        if pool["kind"] == "ec":
+            await cluster.client.create_ec_pool(
+                "thrash", pool["profile"], pg_num=pool["pg_num"])
+        else:
+            await cluster.client.create_replicated_pool(
+                "thrash", size=pool["size"], pg_num=pool["pg_num"])
+        ioctx = cluster.client.open_ioctx("thrash")
+        # RadosModel discipline: an ACKED op must stick; an UNACKED op
+        # (error/timeout) may have committed anyway, so the legal
+        # states are {last acked} U {unacked attempts since the ack}.
+        # None models an acked remove.
+        model: dict = {}       # oid -> acked payload | None
+        maybe: dict = {}       # oid -> [indeterminate states since ack]
+        stats = {"acked": 0, "unacked": 0, "removes": 0}
+        down: set = set()
 
-            async def workload():
-                seq = 0
-                while True:
-                    seq += 1
-                    oid = f"obj-{rng.randrange(12)}"
-                    data = np.random.default_rng(seq).integers(
-                        0, 256, rng.randrange(1000, 60_000),
-                        dtype=np.uint8).tobytes()
-                    # record BEFORE submitting: a cancelled/failed
-                    # attempt may still commit (indeterminate)
-                    maybe.setdefault(oid, []).append(data)
+        async def workload():
+            seq = 0
+            while True:
+                seq += 1
+                oid = f"obj-{rng.randrange(n_objects)}"
+                if oid in model and rng.random() < 0.08:
+                    maybe.setdefault(oid, []).append(None)
                     try:
-                        await ioctx.write_full(oid, data)
-                        model[oid] = data   # acked -> must survive
-                        maybe[oid] = []     # pre-ack attempts are dead:
-                        # the daemon fences zombie parked ops
-                    except RadosError:
-                        pass
-                    await asyncio.sleep(0)
-
-            task = asyncio.get_running_loop().create_task(workload())
-            try:
-                for _round in range(6):
-                    await asyncio.sleep(0.4)
-                    await _thrash_once(rng, cluster, down)
-            finally:
-                task.cancel()
+                        await ioctx.remove(oid)
+                        model[oid] = None
+                        maybe[oid] = []
+                        stats["removes"] += 1
+                    except (RadosError, ObjectNotFound):
+                        stats["unacked"] += 1
+                    continue
+                data = np.random.default_rng(seed * 100_000 + seq) \
+                    .integers(0, 256, rng.randrange(1000, 60_000),
+                              dtype=np.uint8).tobytes()
+                # record BEFORE submitting: a cancelled/failed attempt
+                # may still commit (indeterminate)
+                maybe.setdefault(oid, []).append(data)
                 try:
-                    await task
-                except asyncio.CancelledError:
-                    pass
-            # heal everything
-            for osd in sorted(down):
-                await cluster.revive_osd(osd)
-                await cluster.wait_for_osd_up(osd)
-                await cluster.client.mon_command(
-                    {"prefix": "osd in", "osd": osd})
-            await cluster.wait_for_clean()
+                    await ioctx.write_full(oid, data)
+                    model[oid] = data   # acked -> must survive
+                    maybe[oid] = []     # pre-ack attempts are dead: the
+                    # daemon fences zombie parked ops
+                    stats["acked"] += 1
+                except RadosError:
+                    stats["unacked"] += 1
+                await asyncio.sleep(0)
 
-            # invariant 1: zero data loss — every object reads back as
-            # its last acked payload or a later indeterminate attempt
-            assert model, "workload never acked anything"
-            final: dict = {}
-            for oid, data in model.items():
+        task = asyncio.get_running_loop().create_task(workload())
+        actions = 0
+        t0 = time.monotonic()
+        try:
+            while time.monotonic() - t0 < duration_s or \
+                    actions < min_actions:
+                await asyncio.sleep(
+                    max(0.2, duration_s / (min_actions + 5)))
+                await _thrash_once(rng, cluster, down, min_alive)
+                actions += 1
+        finally:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        # heal everything
+        for osd in sorted(down):
+            await cluster.revive_osd(osd)
+            await cluster.wait_for_osd_up(osd)
+            await cluster.client.mon_command(
+                {"prefix": "osd in", "osd": osd})
+        await cluster.wait_for_clean(timeout=180.0)
+        assert actions >= min_actions
+        assert stats["acked"] >= 20, stats
+
+        # invariant 1: zero data loss
+        final: dict = {}
+        for oid, data in model.items():
+            try:
                 got = await ioctx.read(oid)
-                legal = [data] + maybe.get(oid, [])
-                assert any(got == want for want in legal), \
-                    (f"{oid}: read ({len(got)}B) matches neither the "
-                     f"acked write ({len(data)}B) nor any of "
-                     f"{len(maybe.get(oid, []))} indeterminate attempts")
+            except ObjectNotFound:
+                got = None
+            legal = [data] + maybe.get(oid, [])
+            assert any(got == want for want in legal), \
+                (f"{oid}: read "
+                 f"({len(got) if got is not None else 'ENOENT'}) matches"
+                 f" neither the acked state nor any of"
+                 f" {len(maybe.get(oid, []))} indeterminate attempts")
+            if got is not None:
                 final[oid] = got
 
-            # invariant 2: all shards converged to the readable state
-            codec = create_erasure_code(dict(EC_PROFILE))
-            pool_id = ioctx.pool_id
-            stripe_unit = 4096
+        # invariant 2: every stored copy converged to the read state
+        checked = 0
+        if pool["kind"] == "ec":
+            codec = create_erasure_code(dict(pool["profile"]))
             k = codec.get_data_chunk_count()
-            unit = codec.get_chunk_size(k * stripe_unit)
+            unit = codec.get_chunk_size(k * 4096)
             sinfo = ec_util.StripeInfo(k, k * unit)
-            checked = 0
-            for oid, data in final.items():
-                pg = ioctx.object_pg(oid)
-                acting, _p = cluster.mon.osdmap.pg_to_acting_osds(pg)
+        from ceph_tpu.os import ObjectId
+
+        for oid, data in final.items():
+            pg = ioctx.object_pg(oid)
+            acting, _p = cluster.mon.osdmap.pg_to_acting_osds(pg)
+            if pool["kind"] == "ec":
                 width = sinfo.get_stripe_width()
                 padded = data + bytes(-len(data) % width)
                 expect = ec_util.encode(
                     sinfo, codec, padded,
                     range(codec.get_chunk_count()))
-                for shard, osd in enumerate(acting):
-                    if osd < 0 or osd not in cluster.osds:
-                        continue
-                    store = cluster.stores[osd]
-                    cid = f"{pg.pool}.{pg.ps:x}s{shard}_head"
-                    from ceph_tpu.os import ObjectId
+            for idx, osd in enumerate(acting):
+                if osd < 0 or osd not in cluster.osds:
+                    continue
+                store = cluster.stores[osd]
+                if pool["kind"] == "ec":
+                    cid = f"{pg.pool}.{pg.ps:x}s{idx}_head"
+                    want = expect.get(idx, b"")
+                else:
+                    cid = f"{pg.pool}.{pg.ps:x}_head"
+                    want = data
+                try:
+                    buf = store.read(cid, ObjectId(oid))
+                except KeyError:
+                    raise AssertionError(
+                        f"{oid} copy {idx} missing on osd.{osd}")
+                if pool["kind"] == "replicated":
+                    buf = buf[:len(want)]
+                assert buf == want, \
+                    f"{oid} copy {idx} on osd.{osd} diverged"
+                checked += 1
+        assert checked > 0
+    finally:
+        await cluster.stop()
 
-                    try:
-                        buf = store.read(cid, ObjectId(oid))
-                    except KeyError:
-                        raise AssertionError(
-                            f"{oid} shard {shard} missing on osd.{osd}")
-                    assert buf == expect.get(shard, b""), \
-                        f"{oid} shard {shard} on osd.{osd} diverged"
-                    checked += 1
-            assert checked > 0
-        finally:
-            await cluster.stop()
 
-    asyncio.run(asyncio.wait_for(main(), 300))
+@pytest.mark.slow
+def test_thrash_ec_k2m2():
+    asyncio.run(asyncio.wait_for(_run_thrash(
+        seed=1234, num_osds=8, osds_per_host=1,
+        pool={"kind": "ec", "pg_num": 8, "profile": {
+            "plugin": "ec_jax", "technique": "reed_sol_van",
+            "k": "2", "m": "2", "crush-failure-domain": "osd"}},
+        min_alive=5), 420))
+
+
+@pytest.mark.slow
+def test_thrash_ec_k8m3():
+    asyncio.run(asyncio.wait_for(_run_thrash(
+        seed=77, num_osds=13, osds_per_host=1,
+        pool={"kind": "ec", "pg_num": 8, "profile": {
+            "plugin": "ec_jax", "technique": "reed_sol_van",
+            "k": "8", "m": "3", "crush-failure-domain": "osd"}},
+        min_alive=11, n_objects=10), 420))
+
+
+@pytest.mark.slow
+def test_thrash_replicated():
+    asyncio.run(asyncio.wait_for(_run_thrash(
+        seed=9, num_osds=6, osds_per_host=1,
+        pool={"kind": "replicated", "size": 3, "pg_num": 8},
+        min_alive=4), 420))
