@@ -1,0 +1,290 @@
+"""CephFS client role: POSIX-shaped filesystem over the cluster.
+
+Reference parity: libcephfs / the kernel client
+(/root/reference/src/libcephfs.cc, src/client/Client.cc): metadata ops
+go to the MDS (MClientRequest), file DATA reads/writes go straight to
+the OSDs as striped objects (Client::_read/_write via the Objecter,
+filer/striper layout).  The MDS address is discovered from the
+mds_lock object in the metadata pool (the MDSMap role).
+
+File layout: fixed-block striping `fsdata.<ino:x>.<blockno:016x>` in
+the data pool (file_layout_t object_size, default 4 MiB), sparse like
+the reference (absent blocks read as zeros).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, List, Optional
+
+from ceph_tpu.mds import ADDR_ATTR, LOCK_OBJ, data_obj
+from ceph_tpu.msg.messages import MClientRequest
+from ceph_tpu.rados.client import (
+    IoCtx,
+    ObjectNotFound,
+    RadosClient,
+    RadosError,
+)
+
+log = logging.getLogger("cephfs")
+
+ENOENT = -2
+ESTALE = -116
+EROFS = -30
+
+
+class CephFSError(Exception):
+    def __init__(self, rc: int, what: str = ""):
+        super().__init__(f"rc={rc} {what}")
+        self.rc = rc
+
+
+class CephFS:
+    """Mounted filesystem handle (libcephfs ceph_mount role)."""
+
+    def __init__(self, client: RadosClient, metadata_pool: str,
+                 data_pool: str):
+        self.client = client
+        self.meta = client.open_ioctx(metadata_pool)
+        self.data = client.open_ioctx(data_pool)
+        self._tid = 0
+        self._mds_addr: Optional[str] = None
+
+    # -- MDS session -------------------------------------------------------
+
+    async def _discover_mds(self) -> str:
+        for _ in range(100):
+            try:
+                raw = await self.meta.getxattr(LOCK_OBJ, ADDR_ATTR)
+                return raw.decode()
+            except (ObjectNotFound, RadosError):
+                await asyncio.sleep(0.1)
+        raise CephFSError(ESTALE, "no active MDS published an address")
+
+    async def _request(self, op: str, args: Dict[str, Any]
+                       ) -> Dict[str, Any]:
+        """Send one metadata op; on ESTALE/timeout re-discover the
+        active MDS and resend (Client session reconnect role)."""
+        last: Optional[BaseException] = None
+        for attempt in range(30):
+            if self._mds_addr is None:
+                self._mds_addr = await self._discover_mds()
+            # ride the rados client's messenger + future table:
+            # MClientReply resolves through its dispatcher like any
+            # other tid-matched reply
+            tid = self.client._next_tid()
+            fut: asyncio.Future = \
+                asyncio.get_running_loop().create_future()
+            self.client._futures[tid] = fut
+            try:
+                await self.client.msgr.send_to(
+                    self._mds_addr, MClientRequest(tid, op, args))
+                reply = await asyncio.wait_for(fut, 10.0)
+            except (ConnectionError, OSError,
+                    asyncio.TimeoutError) as e:
+                last = e
+                self._mds_addr = None   # re-discover (failover)
+                await asyncio.sleep(0.3)
+                continue
+            finally:
+                self.client._futures.pop(tid, None)
+            if reply.rc == ESTALE:
+                self._mds_addr = None   # standby answered: re-discover
+                await asyncio.sleep(0.3)
+                continue
+            if reply.rc != 0:
+                raise CephFSError(reply.rc,
+                                  f"{op} {args.get('path', '')!r}"
+                                  f" {reply.out.get('error', '')}")
+            return reply.out
+        raise CephFSError(ESTALE, f"{op}: no MDS reachable ({last!r})")
+
+    # -- namespace ops -----------------------------------------------------
+
+    async def mkdir(self, path: str, mode: int = 0o755) -> None:
+        await self._request("mkdir", {"path": path, "mode": mode})
+
+    async def rmdir(self, path: str) -> None:
+        await self._request("rmdir", {"path": path})
+
+    async def listdir(self, path: str) -> List[str]:
+        out = await self._request("readdir", {"path": path})
+        return list(out["entries"])
+
+    async def readdir(self, path: str) -> Dict[str, dict]:
+        out = await self._request("readdir", {"path": path})
+        return out["entries"]
+
+    async def stat(self, path: str) -> dict:
+        out = await self._request("stat", {"path": path})
+        return out["inode"]
+
+    async def exists(self, path: str) -> bool:
+        try:
+            await self.stat(path)
+            return True
+        except CephFSError as e:
+            if e.rc == ENOENT:
+                return False
+            raise
+
+    async def symlink(self, target: str, path: str) -> None:
+        await self._request("symlink", {"path": path, "target": target})
+
+    async def readlink(self, path: str) -> str:
+        out = await self._request("readlink", {"path": path})
+        return out["target"]
+
+    async def rename(self, src: str, dst: str) -> None:
+        await self._request("rename", {"src": src, "dst": dst})
+
+    async def unlink(self, path: str) -> None:
+        out = await self._request("unlink", {"path": path})
+        inode = out["inode"]
+        # purge the file's data objects (the client-driven purge;
+        # the reference queues this on the MDS PurgeQueue)
+        bs = inode.get("block_size", 1 << 22)
+        blocks = (inode.get("size", 0) + bs - 1) // bs
+        await asyncio.gather(*(
+            _ignore_enoent(self.data.remove(
+                data_obj(inode["ino"], b)))
+            for b in range(blocks)))
+
+    async def truncate(self, path: str, size: int) -> None:
+        inode = await self.stat(path)
+        if inode["type"] != "file":
+            raise CephFSError(-21, path)  # EISDIR
+        bs = inode.get("block_size", 1 << 22)
+        if size < inode["size"]:
+            first_dead = (size + bs - 1) // bs
+            last = (inode["size"] + bs - 1) // bs
+            await asyncio.gather(*(
+                _ignore_enoent(self.data.remove(
+                    data_obj(inode["ino"], b)))
+                for b in range(first_dead, last)))
+            if size % bs:
+                await self.data.write(
+                    data_obj(inode["ino"], size // bs),
+                    bytes(bs - size % bs), size % bs)
+        await self._request("setattr", {"path": path, "size": size})
+
+    # -- file I/O ----------------------------------------------------------
+
+    async def open(self, path: str, flags: str = "r",
+                   mode: int = 0o644,
+                   block_size: int = 1 << 22) -> "File":
+        """block_size is the file_layout_t object_size: fixed at
+        create time, ignored on existing files."""
+        create = any(f in flags for f in "wax")
+        if create:
+            out = await self._request(
+                "create", {"path": path, "mode": mode,
+                           "exclusive": "x" in flags,
+                           "block_size": block_size})
+            inode = out["inode"]
+            if "w" in flags and inode.get("size", 0) > 0:
+                await self.truncate(path, 0)
+                inode = await self.stat(path)
+        else:
+            inode = await self.stat(path)
+            if inode["type"] == "dir":
+                raise CephFSError(-21, path)
+        return File(self, path, inode,
+                    writable=create or "+" in flags)
+
+    # convenience one-shots (qa-workunit style helpers)
+
+    async def write_file(self, path: str, data: bytes) -> None:
+        f = await self.open(path, "w")
+        await f.write(0, data)
+        await f.close()
+
+    async def read_file(self, path: str) -> bytes:
+        f = await self.open(path, "r")
+        try:
+            return await f.read(0, f.inode["size"])
+        finally:
+            await f.close()
+
+
+async def _ignore_enoent(coro) -> None:
+    try:
+        await coro
+    except ObjectNotFound:
+        pass
+
+
+class File:
+    """An open file handle (Fh role): offset I/O over striped data
+    objects, size flushed to the MDS on write/close."""
+
+    def __init__(self, fs: CephFS, path: str, inode: dict,
+                 writable: bool):
+        self.fs = fs
+        self.path = path
+        self.inode = inode
+        self.writable = writable
+        self._max_written = inode.get("size", 0)
+
+    @property
+    def block_size(self) -> int:
+        return self.inode.get("block_size", 1 << 22)
+
+    def _extents(self, offset: int, length: int):
+        out = []
+        end = offset + length
+        while offset < end:
+            blockno = offset // self.block_size
+            in_off = offset % self.block_size
+            span = min(self.block_size - in_off, end - offset)
+            out.append((blockno, in_off, span))
+            offset += span
+        return out
+
+    async def read(self, offset: int, length: int) -> bytes:
+        size = self.inode.get("size", 0)
+        if offset >= size:
+            return b""
+        length = min(length, size - offset)
+
+        async def one(blockno: int, in_off: int, span: int) -> bytes:
+            try:
+                buf = await self.fs.data.read(
+                    data_obj(self.inode["ino"], blockno), in_off, span)
+            except ObjectNotFound:
+                return bytes(span)
+            if len(buf) < span:
+                buf += bytes(span - len(buf))
+            return buf
+
+        parts = await asyncio.gather(
+            *(one(*ext) for ext in self._extents(offset, length)))
+        return b"".join(parts)
+
+    async def write(self, offset: int, data: bytes) -> int:
+        if not self.writable:
+            raise CephFSError(EROFS, self.path)
+        pos = 0
+        jobs = []
+        for blockno, in_off, span in self._extents(offset, len(data)):
+            chunk = data[pos:pos + span]
+            pos += span
+            jobs.append(self.fs.data.write(
+                data_obj(self.inode["ino"], blockno), chunk, in_off))
+        await asyncio.gather(*jobs)
+        end = offset + len(data)
+        if end > self._max_written:
+            self._max_written = end
+            # size flush: max-merge on the MDS so concurrent writers
+            # never shrink each other
+            out = await self.fs._request(
+                "setattr", {"path": self.path, "size_max": end})
+            self.inode = out["inode"]
+        return len(data)
+
+    async def append(self, data: bytes) -> int:
+        return await self.write(self.inode.get("size", 0), data)
+
+    async def close(self) -> None:
+        return None  # write-through: nothing buffered

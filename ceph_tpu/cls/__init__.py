@@ -173,9 +173,11 @@ class ClassHandler:
 
 def default_handler() -> ClassHandler:
     """The in-tree classes, registered (ClassHandler::open_all role)."""
+    from ceph_tpu.cls import dir as dir_cls
     from ceph_tpu.cls import hello, lock, numops
 
     handler = ClassHandler()
+    dir_cls.register(handler)
     hello.register(handler)
     lock.register(handler)
     numops.register(handler)

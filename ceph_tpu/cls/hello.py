@@ -7,7 +7,7 @@ rewrite with EEXIST), replay (reads it back).
 
 from __future__ import annotations
 
-from ceph_tpu.cls import ClsError, MethodContext, RD, WR
+from ceph_tpu.cls import ClsError, ENOATTR, ENOENT, MethodContext, RD, WR
 
 EEXIST = -17
 GREETING_ATTR = "hello.greeting"
@@ -21,12 +21,15 @@ async def say_hello(ctx: MethodContext, data: bytes) -> bytes:
 
 
 async def record_hello(ctx: MethodContext, data: bytes) -> bytes:
+    recorded = True
     try:
         await ctx.getxattr(GREETING_ATTR)
-        raise ClsError(EEXIST, "already said hello")
     except ClsError as e:
-        if e.rc == EEXIST:
-            raise
+        if e.rc not in (ENOENT, ENOATTR):
+            raise  # EIO etc: state UNKNOWN — never clobber
+        recorded = False
+    if recorded:
+        raise ClsError(EEXIST, "already said hello")
     greeting = await say_hello(ctx, data)
     await ctx.write_full(greeting)
     await ctx.setxattr(GREETING_ATTR, greeting)

@@ -12,12 +12,16 @@ from __future__ import annotations
 
 import json
 
-from ceph_tpu.cls import ClsError, MethodContext, RD, WR
-
-EBUSY = -16
-ENOENT = -2
-EPERM = -1
-EINVAL = -22
+from ceph_tpu.cls import (
+    ClsError,
+    EBUSY,
+    EINVAL,
+    ENOATTR,
+    ENOENT,
+    MethodContext,
+    RD,
+    WR,
+)
 
 EXCLUSIVE = "exclusive"
 SHARED = "shared"
@@ -27,14 +31,11 @@ def _attr(name: str) -> str:
     return f"lock.{name}"
 
 
-ENODATA = -61
-
-
 async def _load(ctx: MethodContext, name: str) -> dict:
     try:
         return json.loads(await ctx.getxattr(_attr(name)))
     except ClsError as e:
-        if e.rc in (ENOENT, ENODATA):
+        if e.rc in (ENOENT, ENOATTR):
             return {"type": None, "tag": "", "lockers": {}}
         # EIO/EAGAIN etc: the lock state is UNKNOWN, not absent —
         # treating it as unlocked would grant a second exclusive owner

@@ -10,9 +10,7 @@ from __future__ import annotations
 
 import json
 
-from ceph_tpu.cls import ClsError, MethodContext, RD, WR
-
-EINVAL = -22
+from ceph_tpu.cls import ClsError, EINVAL, ENOENT, MethodContext, RD, WR
 
 
 async def _rmw(ctx: MethodContext, data: bytes, op) -> bytes:
@@ -25,7 +23,7 @@ async def _rmw(ctx: MethodContext, data: bytes, op) -> bytes:
     try:
         omap = await ctx.omap_get()
     except ClsError as e:
-        if e.rc != -2:  # first call: the object does not exist yet
+        if e.rc != ENOENT:  # first call: object does not exist yet
             raise
         omap = {}
     try:

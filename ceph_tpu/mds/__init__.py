@@ -1,0 +1,434 @@
+"""MDS role: the filesystem metadata server.
+
+Reference parity: the ceph-mds daemon
+(/root/reference/src/mds/MDSDaemon.cc, MDCache.cc, Server.cc) — a
+single ACTIVE metadata server owns the namespace, serializes every
+metadata mutation, and stores directories as objects in a METADATA
+pool (one object per directory fragment, dentries in omap —
+CDir::commit, src/mds/CDir.cc).  Clients send MClientRequest ops for
+metadata and do file DATA I/O directly against the data pool.
+
+Re-designs vs the reference, deliberate:
+
+- WRITE-THROUGH metadata instead of the MDS journal: every mutation
+  lands in the directory object's omap (replicated, logged, recovered
+  by RADOS) before the client sees an ack, so RADOS is the journal.
+  The reference's MDLog exists to batch and reorder updates for
+  latency; correctness comes from the same place (rados durability).
+  An MDS restart recovers by lazily reloading directory objects — no
+  replay phase.
+- Active/standby election rides cls_lock: the active MDS holds an
+  exclusive lock on the `mds_lock` object (renewed on a heartbeat
+  interval, stored with its address); a standby polls, breaks a stale
+  lock, and takes over (the mon's MDSMap beacon machinery, collapsed
+  onto the object-lock it ultimately implements).
+- Inode numbers come from an atomic numops counter object (InoTable
+  role, src/mds/InoTable.h).
+
+Layout in the metadata pool:
+  mds_lock                 cls_lock state + active MDS addr (xattr)
+  mds_ino                  omap: {"next": counter}
+  dir.<ino:x>              omap: dentry name -> inode JSON
+File data objects (data pool): fsdata.<ino:x>.<blockno:016x>
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ceph_tpu.msg import Connection, Messenger
+from ceph_tpu.msg.messages import (
+    MClientReply,
+    MClientRequest,
+    Message,
+)
+from ceph_tpu.rados.client import (
+    IoCtx,
+    ObjectNotFound,
+    RadosClient,
+    RadosError,
+)
+
+log = logging.getLogger("mds")
+
+EPERM = -1
+ENOENT = -2
+EIO = -5
+EEXIST = -17
+ENOTDIR = -20
+EISDIR = -21
+EINVAL = -22
+ENOTEMPTY = -39
+ESTALE = -116
+
+ROOT_INO = 1
+LOCK_OBJ = "mds_lock"
+INO_OBJ = "mds_ino"
+ADDR_ATTR = "mds.addr"
+
+
+def dir_obj(ino: int) -> str:
+    return f"dir.{ino:x}"
+
+
+def data_obj(ino: int, blockno: int) -> str:
+    return f"fsdata.{ino:x}.{blockno:016x}"
+
+
+class MDSDaemon:
+    """Single-active metadata server with standby takeover."""
+
+    def __init__(self, mon_addr: str, metadata_pool: str,
+                 data_pool: str, name: str = "a",
+                 lock_interval: float = 1.0):
+        self.mon_addr = mon_addr
+        self.metadata_pool = metadata_pool
+        self.data_pool = data_pool
+        self.name = name
+        self.lock_interval = lock_interval
+        self.client = RadosClient(mon_addr, name=f"mds.{name}")
+        self.msgr = Messenger(f"mds.{name}")
+        self.msgr.dispatcher = self._dispatch
+        self.meta: Optional[IoCtx] = None
+        self.state = "standby"
+        # dirty-free write-through cache: dir ino -> {name: inode dict}
+        self._dirs: Dict[int, Dict[str, dict]] = {}
+        self._lock_task: Optional[asyncio.Task] = None
+        self._stopping = False
+        # namespace mutations serialize through one lock (the MDS's
+        # whole reason to exist); reads go lock-free off the cache
+        self._mutation_lock = asyncio.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, port: int = 0) -> str:
+        await self.client.connect()
+        self.meta = self.client.open_ioctx(self.metadata_pool)
+        addr = await self.msgr.bind(port=port)
+        self._lock_task = asyncio.get_running_loop().create_task(
+            self._lock_loop())
+        return addr
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._lock_task is not None:
+            self._lock_task.cancel()
+            try:
+                await self._lock_task
+            except asyncio.CancelledError:
+                pass
+        if self.state == "active":
+            try:
+                await self.meta.execute(LOCK_OBJ, "lock", "unlock",
+                                        json.dumps({
+                                            "name": "active",
+                                            "owner": self.name,
+                                        }).encode())
+            except Exception:
+                pass
+        await self.msgr.shutdown()
+        await self.client.shutdown()
+
+    # -- active/standby via cls_lock (MDSMap beacon role) ------------------
+
+    async def _lock_loop(self) -> None:
+        while not self._stopping:
+            try:
+                await self._try_acquire_or_renew()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("mds.%s: lock loop error", self.name)
+            await asyncio.sleep(self.lock_interval)
+
+    async def _try_acquire_or_renew(self) -> None:
+        req = json.dumps({"name": "active", "type": "exclusive",
+                          "owner": self.name,
+                          "tag": "mds"}).encode()
+        try:
+            await self.meta.execute(LOCK_OBJ, "lock", "lock", req)
+        except RadosError:
+            # someone else is active: stale-ness check — if their
+            # renewal stamp is old, break the lock and take over
+            if self.state == "active":
+                # lost our own lock (e.g. broken by a standby while we
+                # were partitioned): step down, drop caches
+                log.warning("mds.%s: lost the active lock, standby",
+                            self.name)
+                self.state = "standby"
+                self._dirs.clear()
+            try:
+                raw = await self.meta.getxattr(LOCK_OBJ, "renewal")
+                holder, stamp = json.loads(raw)
+                if time.time() - stamp < self.lock_interval * 5:
+                    return  # holder is live
+                await self.meta.execute(
+                    LOCK_OBJ, "lock", "break_lock",
+                    json.dumps({"name": "active",
+                                "locker": holder}).encode())
+                log.warning("mds.%s: broke stale lock of mds.%s",
+                            self.name, holder)
+            except (RadosError, ObjectNotFound, ValueError):
+                pass
+            return
+        # lock held (fresh or renewal): stamp + publish the address
+        await self.meta.setxattr(
+            LOCK_OBJ, "renewal",
+            json.dumps([self.name, time.time()]).encode())
+        await self.meta.setxattr(LOCK_OBJ, ADDR_ATTR,
+                                 self.msgr.addr.encode())
+        if self.state != "active":
+            log.info("mds.%s: ACTIVE at %s", self.name, self.msgr.addr)
+            self.state = "active"
+            self._dirs.clear()  # cold cache: reload from rados
+            await self._ensure_root()
+
+    async def _ensure_root(self) -> None:
+        try:
+            await self.meta.omap_get(dir_obj(ROOT_INO))
+        except ObjectNotFound:
+            await self.meta.omap_set(dir_obj(ROOT_INO), {})
+            await self.meta.omap_set(INO_OBJ,
+                                     {"next": str(ROOT_INO + 1).encode()})
+
+    async def _alloc_ino(self) -> int:
+        out = await self.meta.execute(
+            INO_OBJ, "numops", "add",
+            json.dumps({"key": "next", "value": 1}).encode())
+        return int(float(out.decode()))
+
+    # -- directory cache (write-through; CDir::fetch/commit roles) ---------
+
+    async def _load_dir(self, ino: int) -> Dict[str, dict]:
+        cached = self._dirs.get(ino)
+        if cached is not None:
+            return cached
+        try:
+            omap = await self.meta.omap_get(dir_obj(ino))
+        except ObjectNotFound:
+            raise MDSError(ENOENT, f"no directory {ino:x}")
+        entries = {name: json.loads(raw.decode())
+                   for name, raw in omap.items()}
+        self._dirs[ino] = entries
+        return entries
+
+    async def _store_dentry(self, dir_ino: int, name: str,
+                            inode: Optional[dict]) -> None:
+        if inode is None:
+            await self.meta.omap_rm_keys(dir_obj(dir_ino), [name])
+            self._dirs.get(dir_ino, {}).pop(name, None)
+        else:
+            await self.meta.omap_set(
+                dir_obj(dir_ino),
+                {name: json.dumps(inode).encode()})
+            self._dirs.setdefault(dir_ino, {})[name] = inode
+
+    # -- path resolution (MDCache::path_traverse role) ---------------------
+
+    async def _resolve(self, path: str) -> Tuple[int, str,
+                                                 Optional[dict]]:
+        """path -> (parent dir ino, leaf name, inode | None).
+        '/' resolves to (0, '', root-pseudo-inode)."""
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            return 0, "", {"ino": ROOT_INO, "type": "dir", "mode": 0o755,
+                           "size": 0, "mtime": 0}
+        cur = ROOT_INO
+        for i, part in enumerate(parts[:-1]):
+            entries = await self._load_dir(cur)
+            inode = entries.get(part)
+            if inode is None:
+                raise MDSError(ENOENT, "/".join(parts[:i + 1]))
+            if inode["type"] != "dir":
+                raise MDSError(ENOTDIR, part)
+            cur = inode["ino"]
+        entries = await self._load_dir(cur)
+        return cur, parts[-1], entries.get(parts[-1])
+
+    # -- request dispatch (Server::handle_client_request role) -------------
+
+    async def _dispatch(self, conn: Connection, msg: Message) -> None:
+        if not isinstance(msg, MClientRequest):
+            return
+        if self.state != "active":
+            await conn.send(MClientReply(msg.tid, ESTALE,
+                                         {"error": "not active"}))
+            return
+        handler = getattr(self, f"_op_{msg.op}", None)
+        if handler is None:
+            await conn.send(MClientReply(msg.tid, EINVAL,
+                                         {"error": f"bad op {msg.op}"}))
+            return
+        try:
+            if msg.op in ("lookup", "readdir", "stat", "readlink"):
+                rc, out = await handler(msg.args)   # lock-free reads
+            else:
+                async with self._mutation_lock:
+                    rc, out = await handler(msg.args)
+        except MDSError as e:
+            rc, out = e.rc, {"error": str(e)}
+        except ObjectNotFound:
+            rc, out = ENOENT, {}
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("mds.%s: op %s failed", self.name, msg.op)
+            rc, out = EIO, {}
+        try:
+            await conn.send(MClientReply(msg.tid, rc, out))
+        except (ConnectionError, OSError):
+            pass
+
+    # -- metadata ops ------------------------------------------------------
+
+    @staticmethod
+    def _now() -> float:
+        return time.time()
+
+    async def _op_mkdir(self, args) -> Tuple[int, Dict[str, Any]]:
+        parent, name, existing = await self._resolve(args["path"])
+        if not name:
+            return EEXIST, {}
+        if existing is not None:
+            return EEXIST, {}
+        ino = await self._alloc_ino()
+        await self.meta.omap_set(dir_obj(ino), {})
+        inode = {"ino": ino, "type": "dir",
+                 "mode": args.get("mode", 0o755),
+                 "size": 0, "mtime": self._now()}
+        await self._store_dentry(parent, name, inode)
+        return 0, {"inode": inode}
+
+    async def _op_create(self, args) -> Tuple[int, Dict[str, Any]]:
+        parent, name, existing = await self._resolve(args["path"])
+        if not name:
+            return EISDIR, {}
+        if existing is not None:
+            if existing["type"] == "dir":
+                return EISDIR, {}
+            if args.get("exclusive"):
+                return EEXIST, {}
+            return 0, {"inode": existing}
+        ino = await self._alloc_ino()
+        inode = {"ino": ino, "type": "file",
+                 "mode": args.get("mode", 0o644),
+                 "size": 0, "mtime": self._now(),
+                 "block_size": int(args.get("block_size", 1 << 22))}
+        await self._store_dentry(parent, name, inode)
+        return 0, {"inode": inode}
+
+    async def _op_symlink(self, args) -> Tuple[int, Dict[str, Any]]:
+        parent, name, existing = await self._resolve(args["path"])
+        if not name or existing is not None:
+            return EEXIST, {}
+        ino = await self._alloc_ino()
+        inode = {"ino": ino, "type": "symlink",
+                 "mode": 0o777, "size": len(args["target"]),
+                 "mtime": self._now(), "target": args["target"]}
+        await self._store_dentry(parent, name, inode)
+        return 0, {"inode": inode}
+
+    async def _op_lookup(self, args) -> Tuple[int, Dict[str, Any]]:
+        _parent, _name, inode = await self._resolve(args["path"])
+        if inode is None:
+            return ENOENT, {}
+        return 0, {"inode": inode}
+
+    _op_stat = _op_lookup
+
+    async def _op_readlink(self, args) -> Tuple[int, Dict[str, Any]]:
+        _p, _n, inode = await self._resolve(args["path"])
+        if inode is None:
+            return ENOENT, {}
+        if inode["type"] != "symlink":
+            return EINVAL, {}
+        return 0, {"target": inode["target"]}
+
+    async def _op_readdir(self, args) -> Tuple[int, Dict[str, Any]]:
+        _parent, _name, inode = await self._resolve(args["path"])
+        if inode is None:
+            return ENOENT, {}
+        if inode["type"] != "dir":
+            return ENOTDIR, {}
+        entries = await self._load_dir(inode["ino"])
+        return 0, {"entries": {n: i for n, i in sorted(entries.items())}}
+
+    async def _op_unlink(self, args) -> Tuple[int, Dict[str, Any]]:
+        parent, name, inode = await self._resolve(args["path"])
+        if inode is None:
+            return ENOENT, {}
+        if inode["type"] == "dir":
+            return EISDIR, {}
+        await self._store_dentry(parent, name, None)
+        return 0, {"inode": inode}  # client purges the data objects
+
+    async def _op_rmdir(self, args) -> Tuple[int, Dict[str, Any]]:
+        parent, name, inode = await self._resolve(args["path"])
+        if inode is None:
+            return ENOENT, {}
+        if inode["type"] != "dir":
+            return ENOTDIR, {}
+        entries = await self._load_dir(inode["ino"])
+        if entries:
+            return ENOTEMPTY, {}
+        await self._store_dentry(parent, name, None)
+        try:
+            await self.meta.remove(dir_obj(inode["ino"]))
+        except ObjectNotFound:
+            pass
+        self._dirs.pop(inode["ino"], None)
+        return 0, {}
+
+    async def _op_rename(self, args) -> Tuple[int, Dict[str, Any]]:
+        src_parent, src_name, inode = await self._resolve(args["src"])
+        if inode is None:
+            return ENOENT, {}
+        dst_parent, dst_name, existing = await self._resolve(
+            args["dst"])
+        if not dst_name:
+            return EINVAL, {}
+        if existing is not None:
+            if existing["type"] == "dir":
+                if inode["type"] != "dir":
+                    return EISDIR, {}
+                if await self._load_dir(existing["ino"]):
+                    return ENOTEMPTY, {}
+            elif inode["type"] == "dir":
+                return ENOTDIR, {}
+        # link target first, unlink source second: a crash between the
+        # two leaves an extra (visible, fsck-able) link rather than a
+        # lost file — the MDS journal's EUpdate would make this atomic
+        await self._store_dentry(dst_parent, dst_name, inode)
+        if (src_parent, src_name) != (dst_parent, dst_name):
+            await self._store_dentry(src_parent, src_name, None)
+        return 0, {"inode": inode}
+
+    async def _op_setattr(self, args) -> Tuple[int, Dict[str, Any]]:
+        parent, name, inode = await self._resolve(args["path"])
+        if inode is None:
+            return ENOENT, {}
+        changed = False
+        for key in ("size", "mode", "mtime"):
+            if key in args:
+                inode[key] = args[key]
+                changed = True
+        if args.get("size_max") is not None:
+            # concurrent writers race size updates: take the max
+            # (the size-extending cap flush discipline)
+            new = max(inode.get("size", 0), int(args["size_max"]))
+            changed = changed or new != inode.get("size")
+            inode["size"] = new
+        if changed:
+            inode["mtime"] = args.get("mtime", self._now())
+            await self._store_dentry(parent, name, inode)
+        return 0, {"inode": inode}
+
+
+class MDSError(Exception):
+    def __init__(self, rc: int, what: str = ""):
+        super().__init__(f"rc={rc} {what}")
+        self.rc = rc

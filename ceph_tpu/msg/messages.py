@@ -697,6 +697,48 @@ class MOSDCommandReply(Message):
         return cls(dec.u64(), dec.s32(), json.loads(dec.string()))
 
 
+@register
+class MClientRequest(Message):
+    """Client -> MDS metadata request (MClientRequest.h role): a named
+    op with JSON args.  File DATA never rides this — clients talk to
+    the OSDs directly for data, like the reference."""
+
+    TAG = 21
+
+    def __init__(self, tid: int, op: str, args: Dict[str, Any]):
+        self.tid = tid
+        self.op = op
+        self.args = args
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        enc.string(self.op)
+        enc.string(json.dumps(self.args))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MClientRequest":
+        return cls(dec.u64(), dec.string(), json.loads(dec.string()))
+
+
+@register
+class MClientReply(Message):
+    TAG = 22
+
+    def __init__(self, tid: int, rc: int, out: Dict[str, Any]):
+        self.tid = tid
+        self.rc = rc
+        self.out = out
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        enc.s32(self.rc)
+        enc.string(json.dumps(self.out))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MClientReply":
+        return cls(dec.u64(), dec.s32(), json.loads(dec.string()))
+
+
 # -- small wire codecs shared by ShardOp omap payloads ----------------------
 
 

@@ -259,6 +259,13 @@ class OSDDaemon:
         from ceph_tpu.cls import default_handler
 
         self.class_handler = default_handler()
+        # completed-op replay cache (osd_reqid_t dedup): a client
+        # resend after a lost reply gets the STORED reply instead of
+        # re-executing a non-idempotent op.  Keyed (client, tid);
+        # bounded.  Survives neither restart nor failover — the
+        # reference carries reqids in the PG log for those cases.
+        self._completed_ops: "OrderedDict[Tuple[str, int], Tuple]" = \
+            OrderedDict()
         # op tracking + background scrub + admin socket
         from ceph_tpu.osd.op_tracker import OpTracker
 
@@ -1687,8 +1694,12 @@ class OSDDaemon:
         pg = state.pg
         plog = self._load_log(state, pool)
         state.extent_cache.pop(oid, None)  # recovery rewrites shards
+        # include_rollback: an acked write that later partial writes
+        # pushed off some heads may survive only in acting members'
+        # rollback generations — recovery (and especially the
+        # no-version purge decision below) must see them
         candidates, acting_complete = await self._gather_object_shards(
-            state, pool, oid)
+            state, pool, oid, include_rollback=True)
         # always search strays during recovery: after several remaps the
         # newest acked version may exist only on prior-interval members
         have = set()
@@ -1788,8 +1799,27 @@ class OSDDaemon:
                         " after exhaustive probe — rolling back the"
                         " uncommitted entry (remove)",
                         self.osd_id, pg, oid)
+            # locate the partial fragments so the purge removes
+            # exactly the holders (quiet + O(holders), not a
+            # cluster-wide broadcast)
+            if pool.type == TYPE_ERASURE:
+                shard_list = list(
+                    range(self._codec(pool.id).get_chunk_count()))
+            else:
+                shard_list = [-1]
+            probes = [(shard, osd)
+                      for osd in self.osdmap.get_up_osds()
+                      for shard in shard_list if osd != self.osd_id]
+            results = await asyncio.gather(
+                *(self._read_candidates(pg, shard, osd, oid,
+                                        include_rollback=True)
+                  for shard, osd in probes))
+            holders = [(shard, osd)
+                       for (shard, osd), (cands, _ok)
+                       in zip(probes, results) if cands]
             return {"kind": "remove", "oid": oid, "targets": targets,
-                    "i_need": i_need, "purge": True}
+                    "i_need": i_need, "purge": True,
+                    "purge_locations": holders}
         if not probes_complete and need_v > version:
             log.warning(
                 "osd.%d: %s/%s unfound at acked version %s (best"
@@ -1910,23 +1940,14 @@ class OSDDaemon:
             if plan.get("purge"):
                 # rolling back an uncommitted entry must also drop the
                 # partial shards that DO exist — on acting members AND
-                # on strays (the exhaustive probe that justified this
-                # purge searched every up OSD x shard, so the purge
-                # sweeps the same breadth) — or the orphan fragments
-                # resurface as below-k candidates on every later read
-                if pool.type == TYPE_ERASURE:
-                    shard_list = list(range(
-                        self._codec(pool.id).get_chunk_count()))
-                else:
-                    shard_list = [-1]
+                # on strays — or the orphan fragments resurface as
+                # below-k candidates on every later read.  The plan
+                # phase located the exact holders.
                 seen = {(sk if sk >= -1 else -1, osd)
                         for sk, osd in removals}
-                for osd in self.osdmap.get_up_osds():
-                    if osd == self.osd_id:
-                        continue
-                    for shard in shard_list:
-                        if (shard, osd) not in seen:
-                            removals.append((shard, osd))
+                for shard, osd in plan.get("purge_locations", []):
+                    if (shard, osd) not in seen:
+                        removals.append((shard, osd))
             await asyncio.gather(*(remove_peer(sk, osd)
                                    for sk, osd in removals))
             if plan.get("purge") and not i_need:
@@ -2050,16 +2071,30 @@ class OSDDaemon:
                     msg.tid, EAGAIN, replay_epoch=self._epoch()))
                 return
         self.op_tracker.mark(op_id, "started")
-        try:
-            rc, data, out = await self._execute_ops(state, pool, msg,
-                                                    conn)
-        except asyncio.CancelledError:
-            raise
-        except UnfoundObject:
-            rc, data, out = EAGAIN, b"", {}
-        except Exception:
-            log.exception("osd.%d: op %r failed", self.osd_id, msg)
-            rc, data, out = EIO, b"", {}
+        # reqid dedup: a resend of an op this primary already executed
+        # gets the stored reply — re-running a non-idempotent op
+        # (append, exec) would double-apply it
+        reqid = (msg.client, msg.tid)
+        cached = self._completed_ops.get(reqid)
+        if cached is not None:
+            rc, data, out = cached
+        else:
+            try:
+                rc, data, out = await self._execute_ops(state, pool,
+                                                        msg, conn)
+            except asyncio.CancelledError:
+                raise
+            except UnfoundObject:
+                rc, data, out = EAGAIN, b"", {}
+            except Exception:
+                log.exception("osd.%d: op %r failed", self.osd_id, msg)
+                rc, data, out = EIO, b"", {}
+            if rc != EAGAIN:
+                # EAGAIN replies commit nothing: the resend must
+                # actually execute
+                self._completed_ops[reqid] = (rc, data, out)
+                while len(self._completed_ops) > 4096:
+                    self._completed_ops.popitem(last=False)
         await conn.send(MOSDOpReply(msg.tid, rc, data, out,
                                     replay_epoch=self._epoch()
                                     if rc == EAGAIN else 0))

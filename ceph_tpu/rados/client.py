@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ceph_tpu.msg import Connection, Messenger
 from ceph_tpu.msg.messages import (
+    MClientReply,
     MGetMap,
     MMonCommand,
     MMonCommandReply,
@@ -136,7 +137,7 @@ class RadosClient:
             except (ConnectionError, OSError):
                 pass
         elif isinstance(msg, (MOSDOpReply, MMonCommandReply,
-                              MOSDCommandReply)):
+                              MOSDCommandReply, MClientReply)):
             fut = self._futures.pop(msg.tid, None)
             if fut is not None and not fut.done():
                 fut.set_result(msg)
@@ -377,6 +378,12 @@ class IoCtx:
         client = self.client
         pg = self.object_pg(oid)
         last_error: Optional[Exception] = None
+        # ONE tid for the op's whole lifetime: a resend after a lost
+        # reply carries the same reqid, so the primary's dedup cache
+        # can replay the stored reply instead of re-executing a
+        # non-idempotent op (append, exec) — the osd_reqid_t
+        # discipline (PrimaryLogPG check_in_progress_op)
+        tid = client._next_tid()
         for attempt in range(client.max_retries):
             osdmap = client.osdmap
             primary = client._primary_cached(osdmap, pg)
@@ -385,7 +392,6 @@ class IoCtx:
             if addr is None or not osdmap.is_up(primary):
                 await client.wait_for_new_map(1.0)
                 continue
-            tid = client._next_tid()
             fut: asyncio.Future = \
                 asyncio.get_running_loop().create_future()
             client._futures[tid] = fut

@@ -57,17 +57,19 @@ class RBD:
         metadata stays on this replicated pool (--data-pool role)."""
         if not (12 <= order <= 26):
             raise RadosError(-22, f"order {order} out of range")
-        directory = await self._dir(ioctx)
-        if name in directory:
-            raise RadosError(-17, f"image {name!r} exists")  # EEXIST
         digest = hashlib.sha1(name.encode()).hexdigest()[:10]
         image_id = f"{ioctx.pool_id:x}{digest}"
+        # claim the name FIRST, atomically server-side (cls dir.add is
+        # check-and-set under the object lock — cls_rbd dir_add_image):
+        # two concurrent creators race the claim, not the metadata
+        await ioctx.execute(
+            RBD_DIRECTORY, "dir", "add",
+            json.dumps({"key": f"name_{name}",
+                        "value": image_id}).encode())
         meta = {"name": name, "size": size, "order": order,
                 "snaps": {}, "snap_seq": 0, "data_pool": data_pool}
         await ioctx.omap_set(_header(image_id),
                              {"rbd": json.dumps(meta).encode()})
-        await ioctx.omap_set(RBD_DIRECTORY,
-                             {f"name_{name}": image_id.encode()})
         return image_id
 
     async def remove(self, ioctx: IoCtx, name: str) -> None:
@@ -83,7 +85,9 @@ class RBD:
             _ignore_enoent(img.data_ioctx.remove(_data(image_id, i)))
             for i in range(objects)))
         await _ignore_enoent(ioctx.remove(_header(image_id)))
-        await ioctx.omap_rm_keys(RBD_DIRECTORY, [f"name_{name}"])
+        await ioctx.execute(
+            RBD_DIRECTORY, "dir", "remove",
+            json.dumps({"key": f"name_{name}"}).encode())
 
     async def list(self, ioctx: IoCtx) -> List[str]:
         return sorted(await self._dir(ioctx))

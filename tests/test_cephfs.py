@@ -1,0 +1,187 @@
+"""CephFS tests: MDS + client over a live mini-cluster.
+
+Mirrors the reference's libcephfs unit shapes
+(/root/reference/src/test/libcephfs/test.cc: MountRemount, Dir ops,
+ReadWrite, Rename, Symlink) plus the MDS failover discipline
+(qa/tasks/mds_thrash.py role at small scale).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from cluster_helpers import Cluster
+
+from ceph_tpu.cephfs import CephFS, CephFSError
+from ceph_tpu.mds import MDSDaemon
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 150))
+
+
+async def _fs_cluster(num_mds=1):
+    cluster = Cluster(num_osds=4)
+    await cluster.start()
+    await cluster.client.create_replicated_pool(
+        "cephfs.meta", size=2, pg_num=8)
+    await cluster.client.create_replicated_pool(
+        "cephfs.data", size=2, pg_num=8)
+    mdss = []
+    for i in range(num_mds):
+        mds = MDSDaemon(cluster.mon.addr, "cephfs.meta", "cephfs.data",
+                        name=chr(ord("a") + i), lock_interval=0.3)
+        await mds.start()
+        mdss.append(mds)
+    fs = CephFS(cluster.client, "cephfs.meta", "cephfs.data")
+    return cluster, mdss, fs
+
+
+async def _teardown(cluster, mdss):
+    for mds in mdss:
+        await mds.stop()
+    await cluster.stop()
+
+
+def test_namespace_round_trip():
+    async def main():
+        cluster, mdss, fs = await _fs_cluster()
+        try:
+            await fs.mkdir("/a")
+            await fs.mkdir("/a/b")
+            with pytest.raises(CephFSError):
+                await fs.mkdir("/a")          # EEXIST
+            with pytest.raises(CephFSError):
+                await fs.mkdir("/nope/c")     # ENOENT mid-path
+            await fs.write_file("/a/b/f.txt", b"hello fs")
+            assert await fs.read_file("/a/b/f.txt") == b"hello fs"
+            assert await fs.listdir("/") == ["a"]
+            assert await fs.listdir("/a") == ["b"]
+            assert await fs.listdir("/a/b") == ["f.txt"]
+            st = await fs.stat("/a/b/f.txt")
+            assert st["type"] == "file" and st["size"] == 8
+            assert (await fs.stat("/a"))["type"] == "dir"
+            with pytest.raises(CephFSError):
+                await fs.rmdir("/a")          # ENOTEMPTY
+            await fs.unlink("/a/b/f.txt")
+            assert not await fs.exists("/a/b/f.txt")
+            await fs.rmdir("/a/b")
+            await fs.rmdir("/a")
+            assert await fs.listdir("/") == []
+        finally:
+            await _teardown(cluster, mdss)
+
+    run(main())
+
+
+def test_large_file_striping_and_truncate():
+    async def main():
+        cluster, mdss, fs = await _fs_cluster()
+        try:
+            rng = np.random.default_rng(3)
+            # small blocks so the file stripes across objects
+            f = await fs.open("/big", "w", mode=0o600,
+                              block_size=16384)
+            data = rng.integers(0, 256, 100_000,
+                                dtype=np.uint8).tobytes()
+            await f.write(0, data)
+            assert await f.read(0, len(data)) == data
+            # unaligned overwrite across a block boundary
+            await f.write(16000, b"\xee" * 1000)
+            got = await f.read(15900, 1200)
+            assert got[100:1100] == b"\xee" * 1000
+            # data objects actually striped
+            objs = [o for o in await fs.data.list_objects()
+                    if o.startswith("fsdata.")]
+            assert len(objs) >= 6
+            # sparse read past a hole
+            f2 = await fs.open("/big", "r")
+            assert len(await f2.read(0, 100_000)) == 100_000
+            # truncate drops tail objects and shrinks size
+            await fs.truncate("/big", 20_000)
+            assert (await fs.stat("/big"))["size"] == 20_000
+            assert await fs.read_file("/big") == \
+                data[:16000] + b"\xee" * 1000 + data[17000:20_000]
+        finally:
+            await _teardown(cluster, mdss)
+
+    run(main())
+
+
+def test_rename_and_symlink():
+    async def main():
+        cluster, mdss, fs = await _fs_cluster()
+        try:
+            await fs.mkdir("/src")
+            await fs.mkdir("/dst")
+            await fs.write_file("/src/f", b"payload")
+            await fs.rename("/src/f", "/dst/g")
+            assert not await fs.exists("/src/f")
+            assert await fs.read_file("/dst/g") == b"payload"
+            # rename over an existing file replaces it
+            await fs.write_file("/dst/h", b"old")
+            await fs.rename("/dst/g", "/dst/h")
+            assert await fs.read_file("/dst/h") == b"payload"
+            await fs.symlink("/dst/h", "/link")
+            assert await fs.readlink("/link") == "/dst/h"
+            assert (await fs.stat("/link"))["type"] == "symlink"
+        finally:
+            await _teardown(cluster, mdss)
+
+    run(main())
+
+
+def test_metadata_survives_mds_restart():
+    """Write-through metadata: a brand-new MDS on the same pools
+    serves the namespace with zero replay."""
+    async def main():
+        cluster, mdss, fs = await _fs_cluster()
+        try:
+            await fs.mkdir("/keep")
+            await fs.write_file("/keep/f", b"durable" * 100)
+            await mdss[0].stop()
+            mds2 = MDSDaemon(cluster.mon.addr, "cephfs.meta",
+                             "cephfs.data", name="b",
+                             lock_interval=0.3)
+            await mds2.start()
+            mdss.append(mds2)
+            assert await fs.read_file("/keep/f") == b"durable" * 100
+            await fs.write_file("/keep/g", b"post-restart")
+            assert sorted(await fs.listdir("/keep")) == ["f", "g"]
+        finally:
+            await _teardown(cluster, mdss)
+
+    run(main())
+
+
+@pytest.mark.slow
+def test_standby_mds_takes_over():
+    """Active/standby: killing the active MDS mid-run moves the lock
+    to the standby and clients fail over transparently."""
+    async def main():
+        cluster, mdss, fs = await _fs_cluster(num_mds=2)
+        try:
+            await fs.mkdir("/d")
+            await fs.write_file("/d/f", b"before failover")
+            active = next(m for m in mdss if m.state == "active")
+            standby = next(m for m in mdss if m is not active)
+            # hard-stop the active (no unlock: the standby must BREAK
+            # the stale lock)
+            active._stopping = True
+            active._lock_task.cancel()
+            await active.msgr.shutdown()
+            await active.client.shutdown()
+            # client ops ride through the takeover
+            for _ in range(200):
+                if standby.state == "active":
+                    break
+                await asyncio.sleep(0.1)
+            assert standby.state == "active"
+            assert await fs.read_file("/d/f") == b"before failover"
+            await fs.write_file("/d/g", b"after failover")
+            assert sorted(await fs.listdir("/d")) == ["f", "g"]
+        finally:
+            await _teardown(cluster, mdss)
+
+    run(main())

@@ -181,8 +181,6 @@ async def _run_thrash(*, seed: int, num_osds: int, osds_per_host: int,
                 except KeyError:
                     raise AssertionError(
                         f"{oid} copy {idx} missing on osd.{osd}")
-                if pool["kind"] == "replicated":
-                    buf = buf[:len(want)]
                 assert buf == want, \
                     f"{oid} copy {idx} on osd.{osd} diverged"
                 checked += 1
