@@ -69,6 +69,7 @@ from ceph_tpu.ops import checksum as cks
 from ceph_tpu.os import ObjectId, ObjectStore, Transaction
 from ceph_tpu.os.memstore import MemStore
 from ceph_tpu.osd import ec_util
+from ceph_tpu.osd import scheduler as sched_mod
 from ceph_tpu.osd.osdmap import OSDMap, PgId, TYPE_ERASURE, TYPE_REPLICATED
 from ceph_tpu.osd.pg_log import (
     PGLog,
@@ -180,6 +181,9 @@ class PGState:
         # snap ids this primary has already trimmed from its objects
         self.trimmed_snaps: Set[int] = set()
         self.trim_task: Optional[asyncio.Task] = None
+        # in-place recovery retry for unfound leftovers (no interval
+        # change to trigger re-peering)
+        self._unfound_retry: Optional[asyncio.Task] = None
 
     def obj_lock(self, oid: str) -> "_ObjLockCtx":
         """Refcounted per-object lock: the entry is only evictable when
@@ -255,6 +259,7 @@ class OSDDaemon:
                             Dict[Tuple[str, int], Connection]] = {}
         self._notify_seq = 0
         self._pending_notifies: Dict[int, Dict[str, Any]] = {}
+        self._pending_repairs: Set[Tuple[PgId, str]] = set()
         # object classes (ClassHandler::open_all role)
         from ceph_tpu.cls import default_handler
 
@@ -266,6 +271,12 @@ class OSDDaemon:
         # reference carries reqids in the PG log for those cases.
         self._completed_ops: "OrderedDict[Tuple[str, int], Tuple]" = \
             OrderedDict()
+        # QoS op scheduler (mClock/WPQ role): client vs recovery vs
+        # scrub arbitration at the execute stage
+        self.scheduler = sched_mod.make_scheduler(
+            str(self.config.get("osd_op_queue", "mclock_scheduler")),
+            max_concurrent=int(self.config.get(
+                "osd_op_num_threads", 8)))
         # op tracking + background scrub + admin socket
         from ceph_tpu.osd.op_tracker import OpTracker
 
@@ -344,6 +355,7 @@ class OSDDaemon:
 
     async def stop(self) -> None:
         self._stopping = True
+        await self.scheduler.stop()
         if self._admin_socket is not None:
             # shutdown joins the serve thread: keep that wait OFF the
             # shared event loop (co-hosted daemons keep running)
@@ -355,6 +367,8 @@ class OSDDaemon:
         for ps in self.pgs.values():
             if ps.peering_task is not None:
                 ps.peering_task.cancel()
+            if ps._unfound_retry is not None:
+                ps._unfound_retry.cancel()
         await self.msgr.shutdown()
         if self._own_store:
             self.store.umount()
@@ -364,9 +378,14 @@ class OSDDaemon:
         self._stopping = True
         if self._hb_task is not None:
             self._hb_task.cancel()
+        if self._scrub_task is not None:
+            self._scrub_task.cancel()
+        await self.scheduler.stop()
         for ps in self.pgs.values():
             if ps.peering_task is not None:
                 ps.peering_task.cancel()
+            if ps._unfound_retry is not None:
+                ps._unfound_retry.cancel()
         await self.msgr.shutdown()
 
     # -- plumbing ----------------------------------------------------------
@@ -599,6 +618,9 @@ class OSDDaemon:
                     if state.peering_task is not None:
                         state.peering_task.cancel()
                         state.peering_task = None
+                    if state._unfound_retry is not None:
+                        state._unfound_retry.cancel()
+                        state._unfound_retry = None
                 if not in_acting:
                     state.state = "inactive"
                     state.active_event.clear()
@@ -761,6 +783,12 @@ class OSDDaemon:
                 t.omap_clear(cid, obj)
             elif op.op == "remove":
                 t.remove(cid, obj)
+                # the rollback clone goes with it: a deleted object
+                # whose clone survives is RESURRECTABLE — the
+                # rollback-aware recovery gather would reassemble the
+                # pre-remove generation from k surviving clones and
+                # reinstall an object the client was told is gone
+                t.remove(cid, ObjectId(RB_PREFIX + oid))
             elif op.op == "clone":
                 # snapshot clone-on-write (make_writeable role): copy
                 # the shard's CURRENT state to the clone object.  A
@@ -809,33 +837,88 @@ class OSDDaemon:
             state.interval_epoch = max(state.interval_epoch, msg.epoch)
         pool = self.osdmap.pools.get(msg.pg.pool) if self.osdmap else None
         cid = self._cid(msg.pg, msg.shard)
-        t = Transaction()
+        if state is None:
+            state = self.pgs.setdefault(msg.pg, PGState(msg.pg))
         try:
-            self._apply_shard_ops(t, cid, msg.oid, msg.ops,
-                                  save_rollback=msg.log_entry is not None)
-            if state is None:
-                state = self.pgs.setdefault(msg.pg, PGState(msg.pg))
-            if pool is not None:
-                plog = self._load_log(state, pool)
-            else:
-                plog = state.log or PGLog()
-                state.log = plog
-            if msg.log_entry is not None:
-                version = ev(msg.log_entry["version"])
-                if version > plog.info.last_update:
-                    plog.append(msg.log_entry)
-                    plog.trim_to(
-                        int(self.config["osd_min_pg_log_entries"]))
-            # a write (client or recovery push) fills the object in
-            plog.missing.pop(msg.oid, None)
-            plog.stage(t, cid)
-            self.store.queue_transaction(t)
+            # dispatch is concurrent per message, so two sub-writes to
+            # one object can otherwise apply OUT OF ORDER — a delayed
+            # older write overwriting a newer one leaves stale data
+            # under a current-looking log (the reference's sequential
+            # per-PG op queue makes this impossible; here the object
+            # lock + version monotonicity restores it)
+            async with state.obj_lock(f"sub\x00{msg.shard}\x00"
+                                      f"{msg.oid}"):
+                if pool is not None:
+                    plog = self._load_log(state, pool)
+                else:
+                    plog = state.log or PGLog()
+                    state.log = plog
+                # ordering guard for CLIENT writes only (they carry a
+                # log entry): recovery/repair installs may legitimately
+                # install an OLDER authoritative version (divergent
+                # rewind, rollback reinstall) and must not be refused
+                incoming = self._sub_write_version(msg) \
+                    if msg.log_entry is not None else None
+                if incoming is not None:
+                    # version floor = newer of (stored OI, newest PG
+                    # log entry for this object).  The log term is
+                    # load-bearing after a DELETE: the remove erases
+                    # the object's own version history, and without it
+                    # a straggler sub-write of an older write would
+                    # silently RESURRECT the deleted object.
+                    floor = self._oi_version(
+                        self._read_shard(msg.pg, msg.shard, msg.oid,
+                                         0, 1)[2])
+                    for le in reversed(plog.entries):
+                        if le.get("oid") == msg.oid:
+                            lv = ev(le["version"])
+                            if floor is None or lv > floor:
+                                floor = lv
+                            break
+                    if floor is not None and incoming < floor:
+                        # a late straggler that already lost the race:
+                        # the newer state supersedes it — ack without
+                        # applying (idempotent-outcome discipline)
+                        await conn.send(MOSDSubWriteReply(
+                            msg.tid, 0, msg.shard))
+                        return
+                t = Transaction()
+                self._apply_shard_ops(
+                    t, cid, msg.oid, msg.ops,
+                    save_rollback=msg.log_entry is not None)
+                if msg.log_entry is not None:
+                    version = ev(msg.log_entry["version"])
+                    if version > plog.info.last_update:
+                        plog.append(msg.log_entry)
+                        plog.trim_to(
+                            int(self.config["osd_min_pg_log_entries"]))
+                # a write (client or recovery push) fills the object in
+                plog.missing.pop(msg.oid, None)
+                plog.stage(t, cid)
+                self.store.queue_transaction(t)
         except Exception:
             log.exception("osd.%d: sub-write %s/%s failed",
                           self.osd_id, msg.pg, msg.oid)
             await conn.send(MOSDSubWriteReply(msg.tid, EIO, msg.shard))
             return
         await conn.send(MOSDSubWriteReply(msg.tid, 0, msg.shard))
+
+    @staticmethod
+    def _sub_write_version(msg: MOSDSubWrite) -> Optional[tuple]:
+        """The object generation this sub-write installs: the log
+        entry's version (client writes) or the OI attr riding the ops
+        (recovery installs); None for version-less ops (remove,
+        attr-only tweaks) which must always apply."""
+        if msg.log_entry is not None:
+            return ev(msg.log_entry["version"])
+        for op in msg.ops:
+            if op.op == "setattr" and op.name == OI_ATTR:
+                try:
+                    v = json.loads(op.value).get("version")
+                    return ev(v) if v else None
+                except (ValueError, AttributeError):
+                    return None
+        return None
 
     async def _handle_sub_read(self, conn: Connection,
                                msg: MOSDSubRead) -> None:
@@ -1033,6 +1116,13 @@ class OSDDaemon:
             plog.info.last_epoch_started = self._epoch()
             state.state = "active"
             state.active_event.set()
+            if state.unfound:
+                # leftover missing entries are not only map-change
+                # driven: a recovery PUSH can fail on a transient
+                # timeout with no interval change, and nothing else
+                # would ever retry it — keep retrying in place with
+                # backoff (the DoRecovery requeue discipline)
+                self._schedule_unfound_retry(state, pool)
         except asyncio.CancelledError:
             raise
         except Exception:
@@ -1045,6 +1135,54 @@ class OSDDaemon:
                     self._retry_peering(state))
         finally:
             state.peering_task = None
+
+    def _schedule_unfound_retry(self, state: PGState, pool) -> None:
+        """Re-run recovery for an active PG that still carries missing
+        entries, with backoff, until it drains or the interval moves
+        on (then peering owns it again).  Armed from EVERY path that
+        can leave entries behind without an interval change —
+        activation, failed recovery pushes, scrub repairs."""
+        interval = state.interval_epoch
+        if state._unfound_retry is not None:
+            return
+        state.unfound = True
+
+        def live_peers() -> Dict[int, int]:
+            out: Dict[int, int] = {}
+            for idx, osd in enumerate(state.acting):
+                if osd == CRUSH_ITEM_NONE or osd == self.osd_id or \
+                        not self.osdmap.is_up(osd):
+                    continue
+                out[idx if pool.type == TYPE_ERASURE
+                    else -(idx + 2)] = osd
+            return out
+
+        async def retry() -> None:
+            backoff = 1.0
+            try:
+                while not self._stopping and state.state == "active" \
+                        and state.interval_epoch == interval \
+                        and state.unfound:
+                    await asyncio.sleep(backoff)
+                    backoff = min(backoff * 2, 8.0)
+                    if state.state != "active" or \
+                            state.interval_epoch != interval:
+                        return
+                    plog = self._load_log(state, pool)
+                    await self._recover_pg(state, pool, live_peers())
+                    state.unfound = bool(plog.missing) or \
+                        any(bool(m)
+                            for m in state.peer_missing.values())
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("osd.%d: unfound retry of %s failed",
+                              self.osd_id, state.pg)
+            finally:
+                state._unfound_retry = None
+
+        state._unfound_retry = \
+            asyncio.get_running_loop().create_task(retry())
 
     async def _retry_peering(self, state: PGState) -> None:
         await asyncio.sleep(0.5)
@@ -1130,6 +1268,14 @@ class OSDDaemon:
                 continue
             if osd == self.osd_id and exclude_missing and \
                     oid in plog.missing:
+                continue
+            shard_key = idx if pool.type == TYPE_ERASURE else -(idx + 2)
+            if exclude_missing and \
+                    oid in state.peer_missing.get(shard_key, {}):
+                # a copy scrub adjudicated bad (or a peer known to
+                # lack the object) must never serve as a repair
+                # source — the data stays on disk but is excluded
+                # from selection
                 continue
             jobs.append(self._read_candidates(
                 pg, shard, osd, oid, include_rollback, offset, length))
@@ -1451,14 +1597,23 @@ class OSDDaemon:
                  self._list_shard_objects(state.pg, my_shard)
                  if not is_internal_name(n)]
         for oid in names:
-            async with state.obj_lock(oid):
-                # an interval change mid-scrub hands the PG to peering;
-                # repairs computed against the old acting set would
-                # corrupt state — abort and let the next pass rescan
-                if state.state != "active" or \
-                        state.interval_epoch != scrub_interval_epoch:
-                    break
-                await self._scrub_object(state, pool, oid, run)
+            # QoS admit BEFORE taking the object lock: a scrub item
+            # parked in the queue while holding the lock would stall
+            # that object's client ops behind the lowest-priority class
+            async def scrub_one(oid=oid):
+                async with state.obj_lock(oid):
+                    if state.state != "active" or \
+                            state.interval_epoch != scrub_interval_epoch:
+                        return False
+                    await self._scrub_object(state, pool, oid, run)
+                    return True
+
+            if not await self.scheduler.run(sched_mod.SCRUB, 1.0,
+                                            scrub_one):
+                # an interval change mid-scrub hands the PG to
+                # peering; repairs computed against the old acting set
+                # would corrupt state — abort, next pass rescans
+                break
         self.scrub_stats["objects"] += run["objects"]
         self.scrub_stats["errors"] += run["errors"]
         self.scrub_stats["repaired"] += run["repaired"]
@@ -1514,7 +1669,15 @@ class OSDDaemon:
                 versions[v] = versions.get(v, 0) + 1
         auth = [v for v, n in versions.items() if n >= k]
         if not auth:
+            # no version reaches k among the acting HEADS — a
+            # soft-failed write fan-out left mixed generations.
+            # Re-select across heads + rollback generations + strays
+            # and reinstall every acting shard (the roll-forward/
+            # roll-back decision ECBackend encodes in log entries,
+            # recomputed from the data itself).
             run["errors"] += 1
+            if await self._repair_mixed_generations(state, pool, oid):
+                run["repaired"] += 1
             return
         version = max(auth)
         bad: List[Tuple[int, int]] = []  # (acting idx, osd)
@@ -1561,13 +1724,80 @@ class OSDDaemon:
         log.warning("osd.%d: scrub %s/%s: %d bad cop%s at %s",
                     self.osd_id, state.pg, oid, len(bad),
                     "y" if len(bad) == 1 else "ies", bad)
-        repaired = await self._scrub_repair(state, pool, oid, bad)
+        repaired = await self._scrub_repair(state, pool, oid, bad,
+                                            version)
         run["repaired"] += repaired
 
+    async def _repair_mixed_generations(self, state: PGState, pool,
+                                        oid: str) -> bool:
+        """Reinstall one consistent generation of an object whose
+        acting heads disagree below reconstructibility: select the
+        newest version reaching k across heads + rollback generations
+        + strays, rebuild, and install on EVERY acting shard."""
+        candidates, _c1 = await self._gather_object_shards(
+            state, pool, oid, exclude_missing=False,
+            include_rollback=True)
+        have = {(idx if pool.type == TYPE_ERASURE else -1, osd)
+                for idx, osd in enumerate(state.acting)
+                if osd != CRUSH_ITEM_NONE}
+        strays, _c2 = await self._gather_stray_shards(
+            state, pool, oid, have)
+        candidates += strays
+
+        def attrs_of(version, chosen) -> Dict[str, bytes]:
+            src = next(iter(chosen))
+            for shard, _payload, at in candidates:
+                if shard == src and self._oi_version(at) == version:
+                    return at
+            return {}
+
+        targets = []
+        for idx, osd in enumerate(state.acting):
+            if osd == CRUSH_ITEM_NONE or osd == self.osd_id or \
+                    not self.osdmap.is_up(osd):
+                continue
+            targets.append((idx if pool.type == TYPE_ERASURE
+                            else -(idx + 2), osd))
+        if pool.type == TYPE_REPLICATED:
+            version, chosen, _oi = self._select_consistent(
+                candidates, need=1)
+            if version is None:
+                return False
+            plan = {"kind": "replicated", "oid": oid,
+                    "targets": targets, "i_need": True,
+                    "payload": {-1: chosen[next(iter(chosen))]},
+                    "attrs": attrs_of(version, chosen),
+                    "omap": await self._fetch_omap_any(
+                        state, pool, oid)}
+        else:
+            codec = self._codec(pool.id)
+            k = codec.get_data_chunk_count()
+            version, chosen, _oi = self._select_consistent(
+                candidates, need=k, verify_hinfo=True)
+            if version is None:
+                return False  # genuinely below k: recovery/rollback
+                # adjudication owns this on the next peering
+            plan = {"kind": "ec", "oid": oid, "targets": targets,
+                    "i_need": True,
+                    "chosen": {s: chosen[s]
+                               for s in sorted(chosen)[:k]},
+                    "attrs": attrs_of(version, chosen), "omap": None}
+            if not self._batch_reconstruct(pool, [plan]):
+                return False
+        await self._recover_commit(state, pool, plan)
+        log.info("osd.%d: %s/%s: reinstalled generation %s across"
+                 " the acting set", self.osd_id, state.pg, oid,
+                 version)
+        return True
+
     async def _scrub_repair(self, state: PGState, pool, oid: str,
-                            bad: List[Tuple[int, int]]) -> int:
+                            bad: List[Tuple[int, int]],
+                            version: tuple) -> int:
         """Repair through the recovery path: drop the corrupt copies,
-        mark them missing, reconstruct + push."""
+        mark them missing AT THE OBJECT'S authoritative version (not
+        the PG head's last_update — recovery's need_v guard compares
+        against this, and an inflated version makes the located,
+        correct copy look too old to install), reconstruct + push."""
         peer_shards: Dict[int, int] = {}
         for idx, osd in enumerate(state.acting):
             if osd == CRUSH_ITEM_NONE or osd == self.osd_id or \
@@ -1579,31 +1809,31 @@ class OSDDaemon:
         my_cid = self._cid(state.pg,
                            state.my_shard(self.osd_id, pool.type))
         for idx, osd in bad:
-            shard = idx if pool.type == TYPE_ERASURE else -1
             shard_key = idx if pool.type == TYPE_ERASURE else -(idx + 2)
-            # drop the corrupt copy so recovery can't re-select it
+            # mark missing WITHOUT removing the data: recovery's
+            # install overwrites the stale copy atomically, so a
+            # failed push leaves the old (degraded but real) copy
+            # instead of destroying it — repeated drop-then-fail
+            # cycles under load would otherwise bleed away every copy
+            # of the authoritative generation one scrub at a time
             if osd == self.osd_id:
                 t = Transaction()
-                t.remove(self._cid(state.pg, shard), ObjectId(oid))
-                plog.missing[oid] = plog.info.last_update
-                # DURABLE missing marker in the same txn as the drop:
-                # a crash between drop and recovery must resume, not
-                # strand the object at reduced redundancy
+                plog.missing[oid] = version
+                # DURABLE missing marker: a crash before recovery must
+                # resume the repair, not strand reduced redundancy
                 plog.stage(t, my_cid)
                 self.store.queue_transaction(t)
             else:
-                tid = self._next_tid()
-                await self._request(
-                    osd, MOSDSubWrite(tid, state.pg, shard, oid,
-                                      [ShardOp("remove")],
-                                      state.interval_epoch, None,
-                                      self.osd_id), tid)
                 state.peer_missing.setdefault(shard_key, {})[oid] = \
-                    plog.info.last_update
+                    version
         await self._recover_object(state, pool, oid, peer_shards)
         # count repaired only if recovery actually restored everything
         still_bad = (oid in plog.missing) or any(
             oid in m for m in state.peer_missing.values())
+        if still_bad:
+            # arm the in-place retry: nothing else re-runs recovery
+            # for entries created outside peering
+            self._schedule_unfound_retry(state, pool)
         return 0 if still_bad else len(bad)
 
     async def _recover_pg(self, state: PGState, pool,
@@ -1635,7 +1865,10 @@ class OSDDaemon:
         for lo in range(0, len(order), WAVE):
             wave = order[lo:lo + WAVE]
             results = await asyncio.gather(
-                *(self._recover_plan(state, pool, oid, peer_shards)
+                *(self.scheduler.run(
+                    sched_mod.RECOVERY, 1.0,
+                    lambda oid=oid: self._recover_plan(
+                        state, pool, oid, peer_shards))
                   for oid in wave),
                 return_exceptions=True)
             plans = []
@@ -1656,7 +1889,10 @@ class OSDDaemon:
             plans = [p for p in plans
                      if p["kind"] != "ec" or p in reconstructed]
             commits = await asyncio.gather(
-                *(self._recover_commit(state, pool, plan)
+                *(self.scheduler.run(
+                    sched_mod.RECOVERY, 1.0,
+                    lambda plan=plan: self._recover_commit(
+                        state, pool, plan))
                   for plan in plans),
                 return_exceptions=True)
             for plan, res in zip(plans, commits):
@@ -2080,8 +2316,13 @@ class OSDDaemon:
             rc, data, out = cached
         else:
             try:
-                rc, data, out = await self._execute_ops(state, pool,
-                                                        msg, conn)
+                # QoS admit: cost scales with payload so a stream of
+                # huge writes is charged accordingly (mClock item cost)
+                cost = 1.0 + sum(len(op.data) for op in msg.ops) \
+                    / (1 << 20)
+                rc, data, out = await self.scheduler.run(
+                    sched_mod.CLIENT, cost,
+                    lambda: self._execute_ops(state, pool, msg, conn))
             except asyncio.CancelledError:
                 raise
             except UnfoundObject:
@@ -2276,8 +2517,9 @@ class OSDDaemon:
                       self._min_size(pool),
                       [None if r is None else r.rc for r in replies])
             return EAGAIN
-        if entry is not None and acked == len(
-                [s for s, _o in targets if shard_ops.get(s) is not None]):
+        full = len([s for s, _o in targets
+                    if shard_ops.get(s) is not None])
+        if entry is not None and acked == full:
             # every shard committed: the preserved previous generation
             # can never be needed again — trim it (the role of
             # ECBackend's rollback trim as log entries commit).  Awaited
@@ -2285,7 +2527,54 @@ class OSDDaemon:
             # overwrite — which clones a fresh rollback — cannot race
             # with this trim and lose its clone.
             await self._trim_rollbacks(state, oid, targets, admit_epoch)
+        elif acked < full:
+            # a shard missed the write WITHOUT an interval change (an
+            # alive-but-slow peer timed out).  The reference's
+            # invariant — sub-write failure implies peer death implies
+            # re-peer implies log repair — does not hold for a soft
+            # timeout, so nothing would fix the mixed-version object
+            # until the next remap; EC reads below k would EIO.
+            # Repair the object now through the scrub-repair path.
+            self._schedule_object_repair(state, pool, oid)
         return 0
+
+    def _schedule_object_repair(self, state: PGState, pool,
+                                oid: str) -> None:
+        """Deduplicated async single-object repair after a partially
+        failed write fan-out."""
+        key = (state.pg, oid)
+        if key in self._pending_repairs or self._stopping:
+            return
+        self._pending_repairs.add(key)
+
+        async def repair() -> None:
+            try:
+                # give straggler sub-writes a moment to land: the slow
+                # peer may still apply, making the repair a no-op scan
+                await asyncio.sleep(1.0)
+                interval = state.interval_epoch
+                async with state.obj_lock(oid):
+                    if self._stopping or state.state != "active" or \
+                            state.interval_epoch != interval or \
+                            state.primary != self.osd_id:
+                        return  # peering owns repair across intervals
+                    run = {"objects": 0, "errors": 0, "repaired": 0}
+                    await self._scrub_object(state, pool, oid, run)
+                    if run["errors"]:
+                        log.info(
+                            "osd.%d: post-write repair of %s/%s:"
+                            " %d inconsistencies, %d repaired",
+                            self.osd_id, state.pg, oid,
+                            run["errors"], run["repaired"])
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("osd.%d: post-write repair of %s/%s"
+                              " failed", self.osd_id, state.pg, oid)
+            finally:
+                self._pending_repairs.discard(key)
+
+        asyncio.get_running_loop().create_task(repair())
 
     async def _trim_rollbacks(self, state: PGState, oid: str,
                               targets: List[Tuple[int, int]],
@@ -2488,7 +2777,8 @@ class OSDDaemon:
                     candidates, need=k)
                 if version is None:
                     self._block_if_unfound(state, pool, oid)
-                    return EIO
+                    self._schedule_object_repair(state, pool, oid)
+                    return EAGAIN
                 self._require_fresh(state, pool, oid, version)
                 old_size = oi.get("size", 0)
                 old_padded = -(-old_size // width) * width
@@ -2674,7 +2964,10 @@ class OSDDaemon:
                 candidates, need=k)
             if version is None:
                 self._block_if_unfound(state, pool, oid)
-                return EIO, b""
+                # clean PG but no k-agreement: a soft-failed write
+                # left mixed generations — repair + client retry
+                self._schedule_object_repair(state, pool, oid)
+                return EAGAIN, b""
             self._require_fresh(state, pool, oid, version)
             if oi.get("whiteout"):
                 return ENOENT, b""
@@ -2712,7 +3005,8 @@ class OSDDaemon:
             candidates, need=k, verify_hinfo=True)
         if version is None:
             self._block_if_unfound(state, pool, oid)
-            return EIO, b""
+            self._schedule_object_repair(state, pool, oid)
+            return EAGAIN, b""
         self._require_fresh(state, pool, oid, version)
         if oi.get("whiteout"):
             return ENOENT, b""

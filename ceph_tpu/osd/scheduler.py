@@ -1,0 +1,274 @@
+"""Op scheduler: QoS arbitration between client and background work.
+
+Reference parity: the OSD's op queue
+(/root/reference/src/osd/scheduler/mClockScheduler.h — dmClock tags
+with per-class reservation/weight/limit; src/common/WeightedPriorityQueue.h
+— the WPQ alternative; op classes in src/osd/scheduler/OpSchedulerItem.h:
+client, background_recovery, background_best_effort, scrub).
+
+The reference queues OpSchedulerItems into sharded work queues; here
+the daemon's work units are coroutines, so the scheduler is an ADMIT
+gate: work of class c calls `await scheduler.run(c, cost, fn)` and the
+grant loop decides WHEN it starts, with at most `max_concurrent`
+in-flight grants.  Two disciplines:
+
+- WPQScheduler: deficit-weighted round robin over class FIFOs.
+- MClockScheduler: dmClock-lite — each class carries
+  (reservation, weight, limit) in ops/sec; a queued item gets an
+  R-tag (reservation deadline), P-tag (proportional-share virtual
+  time), L-tag (limit gate).  Selection: any class behind its
+  reservation goes first (lowest R-tag); otherwise the lowest P-tag
+  among classes under their limit.  This is the same tag algebra as
+  the reference's dmclock library (src/dmclock/), minus the
+  distributed delta/rho piggybacking (single-OSD scope here).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import time
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+
+CLIENT = "client"
+RECOVERY = "background_recovery"
+SCRUB = "background_scrub"
+BEST_EFFORT = "background_best_effort"
+
+# (reservation ops/s, weight, limit ops/s or 0 = unlimited) — the
+# shape of osd_mclock_profile "balanced": client weighted highest,
+# recovery guaranteed a floor so a client flood cannot starve it
+DEFAULT_PROFILES: Dict[str, Tuple[float, float, float]] = {
+    CLIENT: (50.0, 10.0, 0.0),
+    RECOVERY: (25.0, 3.0, 200.0),
+    SCRUB: (5.0, 1.0, 50.0),
+    BEST_EFFORT: (0.0, 1.0, 50.0),
+}
+
+
+class _Item:
+    __slots__ = ("cost", "fn", "future", "r_tag", "p_tag")
+
+    def __init__(self, cost: float, fn, future):
+        self.cost = cost
+        self.fn = fn
+        self.future = future
+        self.r_tag = 0.0
+        self.p_tag = 0.0
+
+
+class OpSchedulerBase:
+    """Admit gate: run(cls, cost, fn) parks until granted."""
+
+    def __init__(self, max_concurrent: int = 8):
+        self.max_concurrent = max_concurrent
+        self._in_flight = 0
+        self._queues: Dict[str, List[_Item]] = {}
+        self._wake = asyncio.Event()
+        self._grant_task: Optional[asyncio.Task] = None
+        self._stopping = False
+        self.granted: Dict[str, int] = {}
+
+    def start(self) -> None:
+        if self._grant_task is None:
+            self._grant_task = asyncio.get_running_loop().create_task(
+                self._grant_loop())
+
+    async def stop(self) -> None:
+        self._stopping = True
+        self._wake.set()
+        if self._grant_task is not None:
+            self._grant_task.cancel()
+            try:
+                await self._grant_task
+            except asyncio.CancelledError:
+                pass
+            self._grant_task = None
+        for q in self._queues.values():
+            for item in q:
+                if not item.future.done():
+                    item.future.cancel()
+            q.clear()
+
+    async def run(self, op_class: str, cost: float,
+                  fn: Callable[[], Awaitable[Any]]) -> Any:
+        """Queue fn under op_class; execute once granted."""
+        if self._stopping:
+            # a latched-stopped scheduler must fail fast: start()
+            # would spawn a grant loop that exits immediately and the
+            # queued future would park the caller forever
+            raise RuntimeError("scheduler stopped")
+        self.start()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        item = _Item(max(cost, 1.0), fn, fut)
+        self._enqueue(op_class, item)
+        self._wake.set()
+        try:
+            await fut  # grant
+        except asyncio.CancelledError:
+            # cancelled AFTER the grant landed: the slot was consumed
+            # and fn never ran — release it or the leak eventually
+            # deadlocks every class (cancelled-before-grant is handled
+            # by the grant loop when it pops the done future)
+            if fut.done() and not fut.cancelled():
+                self._in_flight -= 1
+                self._wake.set()
+            raise
+        try:
+            return await fn()
+        finally:
+            self._in_flight -= 1
+            self._wake.set()
+
+    # -- subclass surface --------------------------------------------------
+
+    def _enqueue(self, op_class: str, item: _Item) -> None:
+        raise NotImplementedError
+
+    def _select(self) -> Optional[Tuple[str, _Item]]:
+        raise NotImplementedError
+
+    def _queued(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    async def _grant_loop(self) -> None:
+        while not self._stopping:
+            while self._in_flight < self.max_concurrent:
+                picked = self._select()
+                if picked is None:
+                    break
+                op_class, item = picked
+                self._in_flight += 1
+                self.granted[op_class] = \
+                    self.granted.get(op_class, 0) + 1
+                if not item.future.done():
+                    item.future.set_result(None)
+                else:  # caller vanished: release the slot
+                    self._in_flight -= 1
+            self._wake.clear()
+            if self._queued() == 0 or \
+                    self._in_flight >= self.max_concurrent:
+                await self._wake.wait()
+            else:
+                # everything queued is rate-gated: poll shortly
+                await asyncio.sleep(0.005)
+
+
+class WPQScheduler(OpSchedulerBase):
+    """Weighted fair queueing over per-class FIFOs
+    (WeightedPriorityQueue.h role): grant the class with the smallest
+    weight-normalized service so sustained load shares
+    proportionally — a high-weight flood slows, never starves, the
+    others."""
+
+    def __init__(self, weights: Optional[Dict[str, float]] = None,
+                 max_concurrent: int = 8):
+        super().__init__(max_concurrent)
+        self.weights = weights or {
+            c: w for c, (_r, w, _l) in DEFAULT_PROFILES.items()}
+        self._served: Dict[str, float] = {}  # weight-normalized
+
+    def _enqueue(self, op_class: str, item: _Item) -> None:
+        q = self._queues.setdefault(op_class, [])
+        if not q:
+            # a class waking from idle must not replay its idle time
+            # as a burst: catch its virtual service up to the floor of
+            # the currently-backlogged classes
+            active = [self._served.get(c, 0.0)
+                      for c, qq in self._queues.items() if qq]
+            floor = min(active) if active else 0.0
+            self._served[op_class] = max(
+                self._served.get(op_class, 0.0), floor)
+        q.append(item)
+
+    def _select(self) -> Optional[Tuple[str, _Item]]:
+        best = None
+        for op_class, q in self._queues.items():
+            if not q:
+                continue
+            key = self._served.get(op_class, 0.0)
+            if best is None or key < best[1]:
+                best = (op_class, key)
+        if best is None:
+            return None
+        op_class = best[0]
+        item = self._queues[op_class].pop(0)
+        self._served[op_class] = self._served.get(op_class, 0.0) + \
+            item.cost / max(self.weights.get(op_class, 1.0), 1e-9)
+        return op_class, item
+
+
+class MClockScheduler(OpSchedulerBase):
+    """dmClock-lite tag scheduler (mClockScheduler.h role)."""
+
+    def __init__(self,
+                 profiles: Optional[
+                     Dict[str, Tuple[float, float, float]]] = None,
+                 max_concurrent: int = 8):
+        super().__init__(max_concurrent)
+        self.profiles = dict(profiles or DEFAULT_PROFILES)
+        self._last_r: Dict[str, float] = {}
+        self._last_p: Dict[str, float] = {}
+        self._last_l: Dict[str, float] = {}
+
+    def _enqueue(self, op_class: str, item: _Item) -> None:
+        now = time.monotonic()
+        r, w, l = self.profiles.get(op_class, (0.0, 1.0, 0.0))
+        if r > 0:
+            item.r_tag = max(now, self._last_r.get(op_class, 0.0)
+                             + item.cost / r)
+            self._last_r[op_class] = item.r_tag
+        else:
+            item.r_tag = float("inf")
+        item.p_tag = max(now, self._last_p.get(op_class, 0.0)) \
+            + item.cost / max(w, 1e-9)
+        self._last_p[op_class] = item.p_tag
+        self._queues.setdefault(op_class, []).append(item)
+
+    def _limit_ok(self, op_class: str, now: float) -> bool:
+        _r, _w, l = self.profiles.get(op_class, (0.0, 1.0, 0.0))
+        if l <= 0:
+            return True
+        return self._last_l.get(op_class, 0.0) <= now
+
+    def _charge_limit(self, op_class: str, item: _Item,
+                      now: float) -> None:
+        _r, _w, l = self.profiles.get(op_class, (0.0, 1.0, 0.0))
+        if l > 0:
+            self._last_l[op_class] = \
+                max(now, self._last_l.get(op_class, 0.0)) \
+                + item.cost / l
+
+
+    def _select(self) -> Optional[Tuple[str, _Item]]:
+        now = time.monotonic()
+        # phase 1: reservations behind schedule (constraint-based)
+        best = None
+        for op_class, q in self._queues.items():
+            if q and q[0].r_tag <= now:
+                if best is None or q[0].r_tag < best[1]:
+                    best = (op_class, q[0].r_tag)
+        if best is not None:
+            op_class = best[0]
+            item = self._queues[op_class].pop(0)
+            self._charge_limit(op_class, item, now)
+            return op_class, item
+        # phase 2: proportional share among classes under their limit
+        best = None
+        for op_class, q in self._queues.items():
+            if q and self._limit_ok(op_class, now):
+                if best is None or q[0].p_tag < best[1]:
+                    best = (op_class, q[0].p_tag)
+        if best is None:
+            return None  # everything rate-gated: grant loop polls
+        op_class = best[0]
+        item = self._queues[op_class].pop(0)
+        self._charge_limit(op_class, item, now)
+        return op_class, item
+
+
+def make_scheduler(kind: str, **kwargs):
+    """osd_op_queue option: 'mclock_scheduler' (default) or 'wpq'."""
+    if kind in ("wpq", "WPQ"):
+        return WPQScheduler(**kwargs)
+    return MClockScheduler(**kwargs)

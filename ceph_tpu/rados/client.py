@@ -54,15 +54,28 @@ class ObjectNotFound(RadosError):
 
 
 class RadosClient:
-    def __init__(self, mon_addr: str, name: str = "client.0",
+    def __init__(self, mon_addr: str, name: Optional[str] = None,
                  op_timeout: float = 10.0, max_retries: int = 30):
         self.mon_addr = mon_addr
+        if name is None:
+            # entity names must be GLOBALLY unique: the OSDs' reqid
+            # dedup cache keys on (client name, tid), and two clients
+            # sharing a name would replay each other's cached replies
+            # (the mon-assigned global_id role, MonClient::get_global_id)
+            import uuid
+
+            name = f"client.{uuid.uuid4().hex[:12]}"
         self.msgr = Messenger(name)
         self.msgr.dispatcher = self._dispatch
         self.osdmap: Optional[OSDMap] = None
         self.op_timeout = op_timeout
         self.max_retries = max_retries
-        self._tid = 0
+        # random tid base: a RESTARTED daemon client reusing a fixed
+        # name (mds.a, mgr.x) must not collide with its previous
+        # incarnation's reqids in OSD dedup caches
+        import random as _random
+
+        self._tid = _random.getrandbits(48)
         self._futures: Dict[int, asyncio.Future] = {}
         self._map_waiters: List[asyncio.Event] = []
         self._placement_cache: Dict[Tuple[int, PgId], int] = {}

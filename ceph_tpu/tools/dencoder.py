@@ -1,0 +1,125 @@
+"""ceph-dencoder parity: encode/decode/inspect versioned wire types.
+
+Reference: /root/reference/src/tools/ceph-dencoder/ — `ceph-dencoder
+type <T> import <file> decode dump_json` for debugging encodings and
+pinning cross-version compatibility corpora.  Here the type registry
+covers the framework's versioned structs (OSDMap, Incremental) and
+every tagged wire message.
+
+Usage:
+  python -m ceph_tpu.tools.dencoder list_types
+  python -m ceph_tpu.tools.dencoder type OSDMap import m.bin decode \
+      dump_json
+  python -m ceph_tpu.tools.dencoder message import frame.bin decode
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ceph_tpu.msg import messages as msgmod
+from ceph_tpu.osd.osdmap import Incremental, OSDMap
+
+
+def _jsonable(obj, depth: int = 0):
+    if depth > 6:
+        return repr(obj)
+    if isinstance(obj, bytes):
+        return {"__bytes__": len(obj),
+                "hex_head": obj[:32].hex()}
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v, depth + 1)
+                for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set)):
+        return [_jsonable(v, depth + 1) for v in obj]
+    if isinstance(obj, (int, float, str, bool)) or obj is None:
+        return obj
+    if hasattr(obj, "__dict__"):
+        return {k: _jsonable(v, depth + 1)
+                for k, v in vars(obj).items()
+                if not k.startswith("_")}
+    return repr(obj)
+
+
+TYPES = {
+    "OSDMap": (OSDMap.decode, lambda m: m.encode()),
+    "OSDMap::Incremental": (Incremental.decode,
+                            lambda i: i.encode()),
+}
+
+
+def _message_types() -> dict:
+    return {cls.__name__: cls
+            for cls in msgmod._REGISTRY.values()}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="dencoder")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("list_types")
+    tp = sub.add_parser("type")
+    tp.add_argument("name")
+    tp.add_argument("verbs", nargs="+",
+                    help="import <file> | decode | dump_json")
+    msg = sub.add_parser("message")
+    msg.add_argument("verbs", nargs="+",
+                     help="import <file> | decode  (tagged frame:"
+                          " 2-byte LE tag + payload)")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "list_types":
+        for name in sorted(TYPES):
+            print(name)
+        for name in sorted(_message_types()):
+            print(name)
+        return 0
+
+    verbs = args.verbs
+    data = b""
+    i = 0
+    while i < len(verbs):
+        verb = verbs[i]
+        if verb == "import":
+            i += 1
+            path = verbs[i]
+            data = sys.stdin.buffer.read() if path == "-" else \
+                open(path, "rb").read()
+        elif verb == "decode":
+            pass  # decoding happens at dump time (stateless CLI)
+        elif verb == "dump_json":
+            pass
+        else:
+            print(f"error: unknown verb {verb!r}", file=sys.stderr)
+            return 2
+        i += 1
+
+    if args.cmd == "type":
+        entry = TYPES.get(args.name)
+        if entry is None:
+            cls = _message_types().get(args.name)
+            if cls is None:
+                print(f"error: unknown type {args.name!r}",
+                      file=sys.stderr)
+                return 2
+            obj = cls.decode(data)
+        else:
+            obj = entry[0](data)
+        print(json.dumps(_jsonable(obj), indent=2, sort_keys=True))
+        return 0
+
+    # tagged message frame: 2-byte LE tag + versioned payload
+    if len(data) < 2:
+        print("error: short frame", file=sys.stderr)
+        return 2
+    tag = int.from_bytes(data[:2], "little")
+    obj = msgmod.decode_message(tag, data[2:])
+    print(json.dumps({"tag": tag, "type": type(obj).__name__,
+                      "fields": _jsonable(obj)}, indent=2,
+                     sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,211 @@
+"""`rados` CLI parity: object I/O + pool admin against a live cluster.
+
+Reference: /root/reference/src/tools/rados/rados.cc — the workhorse
+admin CLI: put/get/rm/ls/stat/append, xattr and omap surfaces,
+mkpool/lspools, bench, plus `ceph`-style mon/osd commands (`status`,
+`health`, `tell`).  One process, one command, JSON-friendly output.
+
+Usage examples:
+  python -m ceph_tpu.tools.rados -m HOST:PORT lspools
+  python -m ceph_tpu.tools.rados -m HOST:PORT mkpool data --size 3
+  python -m ceph_tpu.tools.rados -m HOST:PORT -p data put obj ./file
+  python -m ceph_tpu.tools.rados -m HOST:PORT -p data get obj -
+  python -m ceph_tpu.tools.rados -m HOST:PORT -p data ls
+  python -m ceph_tpu.tools.rados -m HOST:PORT status
+  python -m ceph_tpu.tools.rados -m HOST:PORT tell 0 perf dump
+  python -m ceph_tpu.tools.rados -m HOST:PORT -p data bench 5 write
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+import numpy as np
+
+from ceph_tpu.rados.client import RadosClient, RadosError
+
+
+def _out(obj) -> None:
+    print(json.dumps(obj, indent=2, sort_keys=True))
+
+
+async def _run(args) -> int:
+    client = RadosClient(args.mon)
+    await client.connect()
+    try:
+        return await _dispatch(client, args)
+    finally:
+        await client.shutdown()
+
+
+async def _dispatch(client: RadosClient, args) -> int:
+    cmd = args.cmd
+    if cmd == "lspools":
+        for pool in client.osdmap.pools.values():
+            print(pool.name)
+        return 0
+    if cmd == "mkpool":
+        if args.ec_profile:
+            profile = json.loads(args.ec_profile)
+            await client.create_ec_pool(args.name, profile,
+                                        pg_num=args.pg_num)
+        else:
+            await client.create_replicated_pool(
+                args.name, size=args.size, pg_num=args.pg_num)
+        return 0
+    if cmd == "status" or cmd == "health":
+        rc, out = await client.mon_command({"prefix": cmd})
+        _out(out)
+        return 0 if rc == 0 else 1
+    if cmd == "tell":
+        rc, out = await client.osd_command(
+            args.osd, {"prefix": " ".join(args.tell_cmd)})
+        _out(out)
+        return 0 if rc == 0 else 1
+
+    # object commands need a pool
+    if not args.pool:
+        print("error: -p/--pool required", file=sys.stderr)
+        return 2
+    io = client.open_ioctx(args.pool)
+    if cmd == "put":
+        data = sys.stdin.buffer.read() if args.file == "-" else \
+            open(args.file, "rb").read()
+        await io.write_full(args.obj, data)
+        return 0
+    if cmd == "get":
+        data = await io.read(args.obj)
+        if args.file == "-":
+            sys.stdout.buffer.write(data)
+        else:
+            with open(args.file, "wb") as f:
+                f.write(data)
+        return 0
+    if cmd == "append":
+        data = sys.stdin.buffer.read() if args.file == "-" else \
+            open(args.file, "rb").read()
+        await io.append(args.obj, data)
+        return 0
+    if cmd == "rm":
+        await io.remove(args.obj)
+        return 0
+    if cmd == "ls":
+        for name in await io.list_objects():
+            print(name)
+        return 0
+    if cmd == "stat":
+        _out(await io.stat(args.obj))
+        return 0
+    if cmd == "setxattr":
+        await io.setxattr(args.obj, args.name, args.value.encode())
+        return 0
+    if cmd == "getxattr":
+        sys.stdout.buffer.write(await io.getxattr(args.obj, args.name))
+        return 0
+    if cmd == "listxattr":
+        for k in sorted(await io.getxattrs(args.obj)):
+            print(k)
+        return 0
+    if cmd == "setomapval":
+        await io.omap_set(args.obj, {args.name: args.value.encode()})
+        return 0
+    if cmd == "listomapvals":
+        for k, v in sorted((await io.omap_get(args.obj)).items()):
+            print(f"{k}: {v.decode('latin-1')}")
+        return 0
+    if cmd == "bench":
+        return await _bench(io, args)
+    print(f"error: unknown command {cmd!r}", file=sys.stderr)
+    return 2
+
+
+async def _bench(io, args) -> int:
+    """`rados bench <seconds> write|seq` (rados.cc bench role)."""
+    size = args.block_size
+    payload = np.random.default_rng(0).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+    deadline = time.monotonic() + args.seconds
+    done = [0]
+
+    async def writer(slot: int) -> None:
+        i = 0
+        while time.monotonic() < deadline:
+            await io.write_full(f"bench_{slot}_{i}", payload)
+            done[0] += 1
+            i += 1
+
+    async def reader(slot: int) -> None:
+        i = 0
+        while time.monotonic() < deadline:
+            try:
+                await io.read(f"bench_{slot}_{i}")
+            except RadosError:
+                i = 0
+                continue
+            done[0] += 1
+            i += 1
+
+    t0 = time.monotonic()
+    fn = writer if args.mode == "write" else reader
+    await asyncio.gather(*(fn(s) for s in range(args.concurrency)))
+    secs = time.monotonic() - t0
+    _out({"mode": args.mode, "ops": done[0], "seconds": round(secs, 3),
+          "ops_per_sec": round(done[0] / secs, 2),
+          "mib_per_sec": round(done[0] * size / secs / (1 << 20), 2)})
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="rados")
+    ap.add_argument("-m", "--mon", required=True,
+                    help="mon address host:port")
+    ap.add_argument("-p", "--pool", default="")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("lspools")
+    mk = sub.add_parser("mkpool")
+    mk.add_argument("name")
+    mk.add_argument("--size", type=int, default=3)
+    mk.add_argument("--pg-num", type=int, default=32)
+    mk.add_argument("--ec-profile", default="",
+                    help="JSON EC profile (makes an EC pool)")
+    sub.add_parser("status")
+    sub.add_parser("health")
+    tell = sub.add_parser("tell")
+    tell.add_argument("osd", type=int)
+    tell.add_argument("tell_cmd", nargs="+")
+    for name in ("put", "get", "append"):
+        p = sub.add_parser(name)
+        p.add_argument("obj")
+        p.add_argument("file")
+    for name in ("rm", "stat", "listxattr", "listomapvals"):
+        p = sub.add_parser(name)
+        p.add_argument("obj")
+    sub.add_parser("ls")
+    for name in ("setxattr", "setomapval"):
+        p = sub.add_parser(name)
+        p.add_argument("obj")
+        p.add_argument("name")
+        p.add_argument("value")
+    gx = sub.add_parser("getxattr")
+    gx.add_argument("obj")
+    gx.add_argument("name")
+    bench = sub.add_parser("bench")
+    bench.add_argument("seconds", type=int)
+    bench.add_argument("mode", choices=["write", "seq"])
+    bench.add_argument("-b", "--block-size", type=int,
+                       default=4 << 20)
+    bench.add_argument("-t", "--concurrency", type=int, default=16)
+    args = ap.parse_args(argv)
+    try:
+        return asyncio.run(_run(args))
+    except RadosError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,7 +27,10 @@ FAST_CONFIG = {
     # wait_for_osd_down budget.
     "osd_heartbeat_interval": 0.3,
     "osd_heartbeat_grace": 2.5,
-    "osd_sub_op_timeout": 2.0,
+    # generous: a DEAD peer fails fast via connection refusal; this
+    # only bites for alive-but-CPU-stalled peers, where a short
+    # timeout manufactures indeterminate sub-writes by the hundreds
+    "osd_sub_op_timeout": 8.0,
 }
 FAST_MON_CONFIG = {
     "mon_osd_min_down_reporters": 1,
@@ -47,7 +50,7 @@ class Cluster:
             # one shared event loop: scale grace with daemon count so
             # scheduling jitter can't masquerade as failures
             self.osd_config["osd_heartbeat_interval"] = 0.5
-            self.osd_config["osd_heartbeat_grace"] = 4.0
+            self.osd_config["osd_heartbeat_grace"] = 6.0
         self.osd_config.update(osd_config or {})
         self.mon_config = dict(FAST_MON_CONFIG)
         self.mon_config.update(mon_config or {})
@@ -102,12 +105,12 @@ class Cluster:
         await self._boot_osd(osd_id)
 
     async def wait_for_osd_down(self, osd_id: int,
-                                timeout: float = 15.0) -> None:
+                                timeout: float = 30.0) -> None:
         await self._wait(lambda: self.mon.osdmap.is_down(osd_id),
                          timeout, f"osd.{osd_id} never marked down")
 
     async def wait_for_osd_up(self, osd_id: int,
-                              timeout: float = 15.0) -> None:
+                              timeout: float = 30.0) -> None:
         await self._wait(lambda: self.mon.osdmap.is_up(osd_id),
                          timeout, f"osd.{osd_id} never marked up")
 

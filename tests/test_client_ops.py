@@ -133,7 +133,8 @@ def test_watch_notify():
             res = await io.notify("obj", b"ping-1")
             # watchers are identified by (client, cookie) pairs —
             # cookies alone collide across clients
-            assert res["acked"] == [["client.0", cookie]]
+            me = cluster.client.msgr.entity_name
+            assert res["acked"] == [[me, cookie]]
             assert res["missed"] == []
             assert got == [b"ping-1"]
 
@@ -148,14 +149,14 @@ def test_watch_notify():
                 c2 = await io2.watch("obj", lambda p: got2.append(p))
                 res = await io.notify("obj", b"ping-2")
                 assert sorted(map(tuple, res["acked"])) == sorted(
-                    [("client.0", cookie), ("client.2", c2)])
+                    [(me, cookie), ("client.2", c2)])
                 assert got[-1] == b"ping-2" and got2 == [b"ping-2"]
                 await io2.unwatch("obj", c2)
             finally:
                 await client2.shutdown()
 
             res = await io.notify("obj", b"ping-3")
-            assert res["acked"] == [["client.0", cookie]]
+            assert res["acked"] == [[me, cookie]]
             await io.unwatch("obj", cookie)
             res = await io.notify("obj", b"ping-4")
             assert res["acked"] == []
