@@ -49,13 +49,24 @@ class Connection:
         self._send_lock = asyncio.Lock()
         self.closed = False
 
+    # a wedged peer (stopped reading, socket buffer full) must not
+    # park drain() — and with it this connection's send lock — forever;
+    # on timeout the connection dies and the next send reconnects
+    DRAIN_TIMEOUT = 15.0
+
     async def send(self, msg: Message) -> None:
         if self.closed:
             raise ConnectionError(f"connection to {self.peer_name} closed")
         frame = frames.encode_frame(msg.TAG, next(self._seq), msg.encode())
         async with self._send_lock:
             self.writer.write(frame)
-            await self.writer.drain()
+            try:
+                await asyncio.wait_for(self.writer.drain(),
+                                       self.DRAIN_TIMEOUT)
+            except asyncio.TimeoutError:
+                self.close()
+                raise ConnectionError(
+                    f"drain to {self.peer_name} timed out")
 
     def close(self) -> None:
         if not self.closed:

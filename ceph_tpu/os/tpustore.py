@@ -12,7 +12,12 @@ Write model: objects are covered by fixed logical spans of
 always lands in freshly allocated extents, and the KV batch that commits
 the new blob map also returns the old extents to the freelist, so a crash
 between the two leaves the old object intact (BlueStore's no-overwrite
-discipline without its deferred-write WAL).
+discipline) — EXCEPT small overwrites of existing uncompressed blobs,
+which take BlueStore's deferred-write path: the new bytes ride the KV
+commit batch itself (the WAL), the transaction skips the block-file
+fsync entirely, and the in-place overwrite is applied after the commit
+point and journal-trimmed in batches; mount replays any pending
+entries (BlueStore.cc _deferred_queue/_deferred_replay).
 
 TPU hook: per-blob crc32c runs through the batched Checksummer path, and
 compression candidates are pre-scored on device
@@ -39,6 +44,7 @@ P_SUPER = "S"
 P_ONODE = "O"
 P_OMAP = "M"
 P_FREELIST = "F"
+P_DEFER = "D"   # deferred-write WAL (BlueStore deferred_transaction_t)
 
 
 class Allocator:
@@ -171,10 +177,24 @@ class TPUStore(ObjectStore):
         # — or a crash before the commit point — can overwrite data still
         # referenced by committed onodes
         self._txc_release: List[Tuple[int, int]] = []
+        # deferred-write WAL state: entries journaled by the in-flight
+        # txn, and applied-but-not-yet-trimmed journal keys
+        self._txc_defer: List[Tuple[int, bytes, bytes]] = []
+        self._txc_direct = False
+        # (journal key, extent offset, length) applied but untrimmed
+        self._pending_defer: List[Tuple[bytes, int, int]] = []
+        self._defer_seq = 0
+        # journaled-but-not-yet-applied bytes, keyed by blob offset:
+        # reads (including later ops in the SAME txn) must see the
+        # deferred data even though the block file still holds the old
+        # bytes until the post-commit apply
+        self._defer_overlay: Dict[int, bytes] = {}
         self._compressor: Optional[Compressor] = None
         self._mounted = False
         # config (bluestore_* options)
         self.max_blob_size = 64 * 1024
+        self.prefer_deferred_size = 32 * 1024
+        self.deferred_batch = 32
         self.csum_type = csum_mod.CSUM_CRC32C
         self.csum_block_size = 4096
         self.comp_mode = 0  # COMP_NONE unless configured
@@ -222,9 +242,43 @@ class TPUStore(ObjectStore):
         state = self._kv.get(P_FREELIST, b"state")
         self._alloc = Allocator.from_json(json.loads(state))
         self._block = open(self._block_path, "r+b")
+        self._replay_deferred()
         self._mounted = True
 
+    def _replay_deferred(self) -> None:
+        """Apply journaled in-place writes that may not have reached
+        the block file before a crash (idempotent), then trim."""
+        keys = []
+        for key, value in self._kv.get_iterator(P_DEFER):
+            off = int.from_bytes(value[:8], "little")
+            self._pwrite(off, value[8:])
+            keys.append(key)
+            self._defer_seq = max(self._defer_seq, int(key))
+        if keys:
+            self._block.flush()
+            _os.fsync(self._block.fileno())
+            t = self._kv.get_transaction()
+            for key in keys:
+                t.rmkey(P_DEFER, key)
+            self._kv.submit_transaction(t)
+
+    def _flush_deferred(self) -> None:
+        """Make applied deferred writes durable on the block file,
+        then trim their journal entries (one fsync per batch — the
+        amortization that makes small overwrites cheap)."""
+        if not self._pending_defer:
+            return
+        self._block.flush()
+        _os.fsync(self._block.fileno())
+        t = self._kv.get_transaction()
+        for key, _off, _ln in self._pending_defer:
+            t.rmkey(P_DEFER, key)
+        self._kv.submit_transaction(t)
+        self._pending_defer = []
+
     def umount(self) -> None:
+        if self._block is not None:
+            self._flush_deferred()
         if self._block is not None:
             self._block.flush()
             _os.fsync(self._block.fileno())
@@ -284,6 +338,12 @@ class TPUStore(ObjectStore):
         self._block.seek(offset)
         self._block.write(data)
 
+    def _pwrite_direct(self, offset: int, data: bytes) -> None:
+        """A write that must be durable at THIS transaction's commit
+        (marks the txn as needing the pre-commit block fsync)."""
+        self._txc_direct = True
+        self._pwrite(offset, data)
+
     def _pread(self, offset: int, length: int) -> bytes:
         self._block.seek(offset)
         out = self._block.read(length)
@@ -294,10 +354,45 @@ class TPUStore(ObjectStore):
     # -- write path (_do_alloc_write) --------------------------------------
 
     def _span_write(self, kvt, onode: _Onode, span: int,
-                    raw: bytes) -> None:
+                    raw: bytes, write_len: Optional[int] = None
+                    ) -> None:
         """Store one logical span COW-style: compress-candidate scoring,
-        gate, csum, allocate, write; old extent freed in the same batch."""
+        gate, csum, allocate, write; old extent freed in the same batch.
+
+        Small overwrites (write_len <= prefer_deferred_size) of an
+        existing uncompressed blob take the DEFERRED path instead: the
+        bytes are journaled into this txn's KV batch and applied
+        in-place after the commit point — no COW, no per-write block
+        fsync."""
         old = onode.blobs.get(span)
+        if (write_len is not None and old is not None
+                and old.comp_alg is None
+                and old.stored_len >= len(raw) > 0
+                and write_len <= self.prefer_deferred_size
+                and not (self.comp_mode and self._compressor)):
+            csum_data = bytearray()
+            if self.csum_type != CSUM_NONE:
+                padded_len = -(-len(raw) // self.csum_block_size) * \
+                    self.csum_block_size
+                padded = raw + bytes(padded_len - len(raw))
+                Checksummer.calculate(
+                    self.csum_type, self.csum_block_size, 0,
+                    padded_len, padded, csum_data)
+            self._defer_seq += 1
+            key = f"{self._defer_seq:020d}".encode()
+            kvt.set(P_DEFER, key,
+                    old.offset.to_bytes(8, "little") + raw)
+            self._txc_defer.append((old.offset, bytes(raw), key))
+            self._defer_overlay[old.offset] = bytes(raw)
+            if old.stored_len > len(raw):
+                # the shrunken tail is unreferenced: free it
+                self._txc_release.append(
+                    (old.offset + len(raw), old.stored_len - len(raw)))
+            onode.blobs[span] = _Blob(
+                old.offset, len(raw), len(raw), bytes(csum_data),
+                None, None, csum_type=self.csum_type,
+                csum_block=self.csum_block_size)
+            return
         payload, header = raw, None
         if self.comp_mode and self._compressor is not None and raw:
             # TPU pre-score: skip the host codec for incompressible spans
@@ -318,7 +413,7 @@ class TPUStore(ObjectStore):
                                   padded_len, padded, csum_data)
         offset = self._alloc.allocate(len(payload)) if payload else 0
         if payload:
-            self._pwrite(offset, payload)
+            self._pwrite_direct(offset, payload)
         onode.blobs[span] = _Blob(
             offset, len(payload), len(raw), bytes(csum_data),
             header.alg if header else None,
@@ -328,7 +423,11 @@ class TPUStore(ObjectStore):
             self._txc_release.append((old.offset, old.stored_len))
 
     def _span_read(self, blob: _Blob) -> bytes:
-        payload = self._pread(blob.offset, blob.stored_len)
+        overlay = self._defer_overlay.get(blob.offset)
+        if overlay is not None and len(overlay) >= blob.stored_len:
+            payload = overlay[:blob.stored_len]
+        else:
+            payload = self._pread(blob.offset, blob.stored_len)
         if blob.csum_type != CSUM_NONE and blob.csum_data:
             padded_len = -(-len(payload) // blob.csum_block) * \
                 blob.csum_block
@@ -370,7 +469,8 @@ class TPUStore(ObjectStore):
             raw[w_start - s_start:w_end - s_start] = \
                 data[pos:pos + (w_end - w_start)]
             pos += w_end - w_start
-            self._span_write(kvt, onode, span, bytes(raw))
+            self._span_write(kvt, onode, span, bytes(raw),
+                             write_len=w_end - w_start)
         onode.size = max(onode.size, end)
         self._put_onode(kvt, cid, oid, onode)
 
@@ -394,6 +494,8 @@ class TPUStore(ObjectStore):
             self._txc = {}
             self._txc_colls = set()
             self._txc_release = []
+            self._txc_defer = []
+            self._txc_direct = False
             # a failed apply must not leak half a transaction: restore the
             # allocator (extents allocated by earlier ops) and submit
             # nothing; pending releases are simply discarded, so nothing
@@ -406,6 +508,9 @@ class TPUStore(ObjectStore):
             except Exception:
                 self._alloc.free, self._alloc.device_size = alloc_snapshot
                 self._txc_release = []
+                for off, _raw, _key in self._txc_defer:
+                    self._defer_overlay.pop(off, None)
+                self._txc_defer = []
                 raise
             finally:
                 self._txc = None
@@ -424,10 +529,36 @@ class TPUStore(ObjectStore):
                 state_json = self._alloc.to_json()
             kvt.set(P_FREELIST, b"state",
                     json.dumps(state_json).encode())
-            # data first, then the metadata commit point
-            self._block.flush()
-            _os.fsync(self._block.fileno())
+            # data first, then the metadata commit point — but a
+            # purely-deferred txn carries its data IN the KV batch and
+            # skips the block fsync entirely (the deferred-write win)
+            if self._txc_direct:
+                self._block.flush()
+                _os.fsync(self._block.fileno())
             self._kv.submit_transaction(kvt)
+            # apply deferred in-place writes AFTER the commit point:
+            # their durability is the journal entry; the block file
+            # catches up here and fsyncs lazily in batches
+            for off, raw, key in self._txc_defer:
+                self._pwrite(off, raw)
+                # drop the overlay only if no NEWER deferred write to
+                # the same offset superseded this one
+                if self._defer_overlay.get(off) == raw:
+                    del self._defer_overlay[off]
+                self._pending_defer.append((key, off, len(raw)))
+            self._txc_defer = []
+            # releases overlapping a pending journal entry must wait
+            # for the journal trim: a crash would otherwise REPLAY the
+            # stale bytes over whatever reallocated the extent
+            # (BlueStore holds deferred extents out of the freelist
+            # for the same reason)
+            if self._txc_release and self._pending_defer and any(
+                    r_off < d_off + d_ln and d_off < r_off + r_ln
+                    for r_off, r_ln in self._txc_release
+                    for _k, d_off, d_ln in self._pending_defer):
+                self._flush_deferred()
+            elif len(self._pending_defer) >= self.deferred_batch:
+                self._flush_deferred()
             for off, ln in self._txc_release:
                 self._alloc.release(off, ln)
             self._txc_release = []

@@ -147,6 +147,11 @@ def _hinfo_chunk_ok(at: Dict[str, bytes], shard: int,
     return cks.crc32c(0xFFFFFFFF, payload) == hi.get_chunk_hash(shard)
 
 
+class _SkipApply(Exception):
+    """Internal: a sub-write adjudicated as a superseded straggler —
+    ack success without applying."""
+
+
 class UnfoundObject(Exception):
     """Raised when an op needs an object whose acked data is currently
     unlocatable (all sources down); mapped to EAGAIN so the client
@@ -878,10 +883,11 @@ class OSDDaemon:
                     if floor is not None and incoming < floor:
                         # a late straggler that already lost the race:
                         # the newer state supersedes it — ack without
-                        # applying (idempotent-outcome discipline)
-                        await conn.send(MOSDSubWriteReply(
-                            msg.tid, 0, msg.shard))
-                        return
+                        # applying (idempotent-outcome discipline).
+                        # The reply is sent OUTSIDE the lock: a send
+                        # wedged on a dead peer must never park this
+                        # (shard, object)'s write lock.
+                        raise _SkipApply()
                 t = Transaction()
                 self._apply_shard_ops(
                     t, cid, msg.oid, msg.ops,
@@ -896,6 +902,8 @@ class OSDDaemon:
                 plog.missing.pop(msg.oid, None)
                 plog.stage(t, cid)
                 self.store.queue_transaction(t)
+        except _SkipApply:
+            pass
         except Exception:
             log.exception("osd.%d: sub-write %s/%s failed",
                           self.osd_id, msg.pg, msg.oid)
@@ -1593,9 +1601,22 @@ class OSDDaemon:
         run = {"objects": 0, "errors": 0, "repaired": 0}
         my_shard = state.my_shard(self.osd_id, pool.type)
         scrub_interval_epoch = state.interval_epoch
-        names = [n for n in
-                 self._list_shard_objects(state.pg, my_shard)
-                 if not is_internal_name(n)]
+        # union the listings across the ACTING set: a straggler copy
+        # (e.g. one that missed a remove fan-out) may exist only on a
+        # peer shard, invisible to the primary's own listing — the
+        # reference's scrub maps cover every shard for the same reason
+        name_set = set(self._list_shard_objects(state.pg, my_shard))
+        for idx, osd in enumerate(state.acting):
+            if osd == CRUSH_ITEM_NONE or osd == self.osd_id or \
+                    not self.osdmap.is_up(osd):
+                continue
+            tid = self._next_tid()
+            reply = await self._request(
+                osd, MPGQuery(tid, state.pg, state.interval_epoch,
+                              self.osd_id), tid)
+            if reply is not None:
+                name_set.update(reply.info.get("objects", []))
+        names = sorted(n for n in name_set if not is_internal_name(n))
         for oid in names:
             # QoS admit BEFORE taking the object lock: a scrub item
             # parked in the queue while holding the lock would stall
@@ -1619,6 +1640,13 @@ class OSDDaemon:
         self.scrub_stats["repaired"] += run["repaired"]
         return run
 
+    @staticmethod
+    def _newest_log_entry(plog, oid: str) -> Optional[Dict[str, Any]]:
+        for le in reversed(plog.entries):
+            if le.get("oid") == oid:
+                return le
+        return None
+
     async def _scrub_object(self, state: PGState, pool, oid: str,
                             run: Dict[str, int]) -> None:
         run["objects"] += 1
@@ -1626,6 +1654,15 @@ class OSDDaemon:
         if oid in plog.missing or \
                 any(oid in m for m in state.peer_missing.values()):
             return  # recovery owns this object right now
+        newest = self._newest_log_entry(plog, oid)
+        if newest is not None and newest.get("op") == "delete":
+            # the log says this object was DELETED: any surviving copy
+            # is a straggler that missed the remove fan-out — purge it
+            # rather than adjudicating it as data (reinstalling would
+            # resurrect a deletion the client was acked for)
+            await self._purge_deleted_stragglers(state, pool, oid,
+                                                 ev(newest["version"]))
+            return
         # gather with explicit per-copy identity: (acting position,
         # osd, payload, attrs) — candidate order from the generic
         # gather cannot identify WHICH replica a copy came from
@@ -1681,6 +1718,14 @@ class OSDDaemon:
             return
         version = max(auth)
         bad: List[Tuple[int, int]] = []  # (acting idx, osd)
+        # a copy at any OTHER version than the adjudicated one is
+        # stale (older: missed a write fan-out; newer: an unacked
+        # partial that lost — ECBackend would roll it back).  Without
+        # this, a soft-timed-out shard stays divergent forever while
+        # the k-quorum masks it, and redundancy silently degrades.
+        for idx, osd, _payload, at in copies:
+            if self._oi_version(at) != version:
+                bad.append((idx, osd))
         if pool.type == TYPE_ERASURE:
             # hinfo chunk crcs identify the corrupt shard exactly
             # (be_deep_scrub re-hash, ECBackend.cc:2494); RMW-era
@@ -1727,6 +1772,47 @@ class OSDDaemon:
         repaired = await self._scrub_repair(state, pool, oid, bad,
                                             version)
         run["repaired"] += repaired
+
+    async def _purge_deleted_stragglers(self, state: PGState, pool,
+                                        oid: str,
+                                        del_version: tuple) -> None:
+        """Remove copies of an object the log says was deleted at
+        del_version from every acting shard that still holds one."""
+        pg = state.pg
+        for idx, osd in enumerate(state.acting):
+            if osd == CRUSH_ITEM_NONE:
+                continue
+            shard = idx if pool.type == TYPE_ERASURE else -1
+            if osd == self.osd_id:
+                rc, _d, at = self._read_shard(pg, shard, oid, 0, 1)
+                if rc == 0:
+                    v = self._oi_version(at)
+                    if v is None or v < del_version:
+                        t = Transaction()
+                        cid = self._cid(pg, shard)
+                        t.remove(cid, ObjectId(oid))
+                        t.remove(cid, ObjectId(RB_PREFIX + oid))
+                        self.store.queue_transaction(t)
+                        log.info("osd.%d: scrub purged deleted"
+                                 " straggler %s/%s (shard %d)",
+                                 self.osd_id, pg, oid, shard)
+            elif self.osdmap.is_up(osd):
+                cands, _ok = await self._read_candidates(
+                    pg, shard, osd, oid, include_rollback=False,
+                    offset=0, length=1)
+                for _s, _p, at in cands:
+                    v = self._oi_version(at)
+                    if v is None or v < del_version:
+                        tid = self._next_tid()
+                        await self._request(
+                            osd, MOSDSubWrite(
+                                tid, pg, shard, oid,
+                                [ShardOp("remove")],
+                                state.interval_epoch, None,
+                                self.osd_id), tid)
+                        log.info("osd.%d: scrub purged deleted"
+                                 " straggler %s/%s on osd.%d",
+                                 self.osd_id, pg, oid, osd)
 
     async def _repair_mixed_generations(self, state: PGState, pool,
                                         oid: str) -> bool:

@@ -26,7 +26,6 @@ in-flight grants.  Two disciplines:
 from __future__ import annotations
 
 import asyncio
-import heapq
 import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 

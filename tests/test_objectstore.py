@@ -411,7 +411,9 @@ def test_tpustore_csum_config_change_keeps_data_readable(tmp_path):
 
 def test_tpustore_deferred_release_within_txn(tmp_path):
     """Extents freed by one op must NOT be reusable by a later op in the
-    same transaction (advisor high finding): a txn that rewrites A, writes
+    same transaction (advisor high finding; sizes above
+    prefer_deferred_size so the COW path — the one with extent
+    churn — is what's exercised): a txn that rewrites A, writes
     B (first-fit would reuse A's freed extent), then fails must leave
     committed A readable after the abort — and the same early-release
     crash window must not exist on the success path either."""
@@ -421,15 +423,15 @@ def test_tpustore_deferred_release_within_txn(tmp_path):
     t = Transaction()
     t.create_collection(CID)
     s.queue_transaction(t)
-    data_a = b"A" * 30_000
+    data_a = b"A" * 40_000
     _write(s, OID, 0, data_a)
     a_off = s._get_onode(CID, OID).blobs[0].offset
 
     # failing txn: rewrite A (frees its extent), write B (same size —
     # first-fit would grab A's extent if released early), then fail
     t = Transaction()
-    t.write(CID, OID, 0, len(data_a), b"a" * 30_000)
-    t.write(CID, ObjectId("B"), 0, 30_000, b"B" * 30_000)
+    t.write(CID, OID, 0, len(data_a), b"a" * 40_000)
+    t.write(CID, ObjectId("B"), 0, 40_000, b"B" * 40_000)
     t.rmattr(CID, ObjectId("missing"), "x")
     with pytest.raises(KeyError):
         s.queue_transaction(t)
@@ -440,16 +442,16 @@ def test_tpustore_deferred_release_within_txn(tmp_path):
     # success path: same shape without the failure — B must not have been
     # written over A's old extent before the commit point
     t = Transaction()
-    t.write(CID, OID, 0, len(data_a), b"a" * 30_000)
-    t.write(CID, ObjectId("B"), 0, 30_000, b"B" * 30_000)
+    t.write(CID, OID, 0, len(data_a), b"a" * 40_000)
+    t.write(CID, ObjectId("B"), 0, 40_000, b"B" * 40_000)
     s.queue_transaction(t)
-    assert s.read(CID, OID) == b"a" * 30_000
-    assert s.read(CID, ObjectId("B")) == b"B" * 30_000
+    assert s.read(CID, OID) == b"a" * 40_000
+    assert s.read(CID, ObjectId("B")) == b"B" * 40_000
     b_off = s._get_onode(CID, ObjectId("B")).blobs[0].offset
     assert b_off != a_off
     # after commit the freed extent IS reusable
     t = Transaction()
-    t.write(CID, ObjectId("C"), 0, 30_000, b"C" * 30_000)
+    t.write(CID, ObjectId("C"), 0, 40_000, b"C" * 40_000)
     s.queue_transaction(t)
     assert s._get_onode(CID, ObjectId("C")).blobs[0].offset == a_off
     s.umount()
@@ -474,3 +476,68 @@ def test_tpustore_remove_defers_release(tmp_path):
         s.queue_transaction(t)
     assert s.read(CID, OID) == data
     s.umount()
+
+
+def test_tpustore_deferred_write_wal(tmp_path):
+    """Small overwrites take the deferred path: journaled in the KV
+    batch, applied in place after commit, REPLAYED on mount if the
+    block file never caught up (BlueStore _deferred_replay)."""
+    s = TPUStore(str(tmp_path / "store"))
+    s.mkfs()
+    s.mount()
+    t = Transaction()
+    t.create_collection(CID)
+    s.queue_transaction(t)
+    _write(s, OID, 0, b"x" * 8000)
+    base_off = s._get_onode(CID, OID).blobs[0].offset
+
+    # small overwrite: same extent (in-place), journal entry present
+    _write(s, OID, 1000, b"Y" * 500)
+    assert s._get_onode(CID, OID).blobs[0].offset == base_off
+    got = s.read(CID, OID)
+    assert got[1000:1500] == b"Y" * 500 and got[:1000] == b"x" * 1000
+
+    # crash before the lazy block flush: nuke the block file's new
+    # bytes by restoring pre-overwrite content, then remount — the
+    # journal must replay the overwrite
+    s._block.flush()
+    import os
+
+    with open(s._block_path, "r+b") as f:
+        f.seek(base_off)
+        f.write(b"x" * 8000)  # simulate lost in-place write
+    s._kv.close()
+    s._block.close()
+    s._mounted = False
+    s2 = TPUStore(str(tmp_path / "store"))
+    s2.mount()
+    got = s2.read(CID, OID)
+    assert got[1000:1500] == b"Y" * 500, "WAL replay lost the write"
+    # replay trims the journal
+    assert list(s2._kv.get_iterator("D")) == []
+    s2.umount()
+
+
+def test_tpustore_deferred_batch_trim(tmp_path):
+    s = TPUStore(str(tmp_path / "store"))
+    s.deferred_batch = 4
+    s.mkfs()
+    s.mount()
+    t = Transaction()
+    t.create_collection(CID)
+    s.queue_transaction(t)
+    _write(s, OID, 0, b"x" * 4000)
+    for i in range(6):
+        _write(s, OID, 100 * i, bytes([i]) * 50)
+    # after 4+ deferred commits the batch flushed: <= 2 entries remain
+    assert len(list(s._kv.get_iterator("D"))) <= 2
+    assert len(s._pending_defer) <= 2
+    out = s.read(CID, OID)
+    for i in range(6):
+        assert out[100 * i:100 * i + 50] == bytes([i]) * 50, i
+    s.umount()
+    # umount flushed everything
+    s3 = TPUStore(str(tmp_path / "store"))
+    s3.mount()
+    assert list(s3._kv.get_iterator("D")) == []
+    s3.umount()
