@@ -1,0 +1,55 @@
+"""cephx-lite: shared-secret message authentication.
+
+Reference parity: the cephx protocol's MESSAGE SIGNING tier
+(/root/reference/src/auth/cephx/CephxSessionHandler.cc:sign_message —
+every frame carries an HMAC over its header+payload keyed by the
+session key; `cephx_sign_messages`).  Deliberate simplification: one
+static cluster secret plays the session-key role (no ticket exchange /
+per-session key negotiation — the mon-as-KDC machinery of
+CephxServiceHandler).  The security property kept: a peer WITHOUT the
+key cannot forge or tamper with frames — unsigned or mis-signed frames
+drop the connection.  NOT kept (needs the session-key handshake):
+replay protection — an observer who records a signed frame can replay
+it on a new connection, since the key is static and frame seq is not
+bound to a per-session nonce.  Appropriate threat model: accidental
+cross-cluster joins and non-recording network peers, not an active
+recording attacker.
+
+Keyring format (`ceph-authtool` role): a hex string, one per file.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from typing import Optional
+
+SIG_LEN = 8  # truncated HMAC-SHA256, like cephx's 64-bit signatures
+
+
+def generate_secret() -> str:
+    return os.urandom(32).hex()
+
+
+def parse_secret(raw: Optional[str]) -> Optional[bytes]:
+    """hex keyring string -> key bytes (None/empty = auth disabled)."""
+    if not raw:
+        return None
+    return bytes.fromhex(raw)
+
+
+def load_keyring(path: str) -> Optional[bytes]:
+    with open(path) as f:
+        return parse_secret(f.read().strip())
+
+
+def sign(secret: bytes, *parts: bytes) -> bytes:
+    mac = hmac.new(secret, digestmod=hashlib.sha256)
+    for part in parts:
+        mac.update(part)
+    return mac.digest()[:SIG_LEN]
+
+
+def verify(secret: bytes, sig: bytes, *parts: bytes) -> bool:
+    return hmac.compare_digest(sign(secret, *parts), sig)

@@ -84,14 +84,19 @@ class MDSDaemon:
 
     def __init__(self, mon_addr: str, metadata_pool: str,
                  data_pool: str, name: str = "a",
-                 lock_interval: float = 1.0):
+                 lock_interval: float = 1.0,
+                 secret: "Optional[str]" = None):
         self.mon_addr = mon_addr
         self.metadata_pool = metadata_pool
         self.data_pool = data_pool
         self.name = name
         self.lock_interval = lock_interval
-        self.client = RadosClient(mon_addr, name=f"mds.{name}")
-        self.msgr = Messenger(f"mds.{name}")
+        from ceph_tpu.common.auth import parse_secret
+
+        self.client = RadosClient(mon_addr, name=f"mds.{name}",
+                                  secret=secret)
+        self.msgr = Messenger(f"mds.{name}",
+                              secret=parse_secret(secret))
         self.msgr.dispatcher = self._dispatch
         self.meta: Optional[IoCtx] = None
         self.state = "standby"

@@ -60,7 +60,9 @@ class MgrDaemon:
         self.mon_addr = mon_addr
         self.config = config or {}
         self.tick_interval = tick_interval
-        self.client = RadosClient(mon_addr, name="mgr.x")
+        self.client = RadosClient(
+            mon_addr, name="mgr.x",
+            secret=self.config.get("auth_secret"))
         self.modules: Dict[str, MgrModule] = {}
         self._module_filter = modules
         self._tick_task: Optional[asyncio.Task] = None

@@ -81,7 +81,11 @@ class MonDaemon:
                  store=None):
         self.config = dict(DEFAULTS)
         self.config.update(config or {})
-        self.msgr = Messenger("mon.0")
+        from ceph_tpu.common.auth import parse_secret
+
+        self.msgr = Messenger(
+            "mon.0", secret=parse_secret(
+                self.config.get("auth_secret")))
         self.msgr.dispatcher = self._dispatch
         # durable state (the MonitorDBStore role,
         # /root/reference/src/mon/MonitorDBStore.h): every commit writes

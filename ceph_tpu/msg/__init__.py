@@ -25,6 +25,7 @@ import itertools
 import logging
 from typing import Awaitable, Callable, Dict, Optional
 
+from ceph_tpu.common import auth
 from ceph_tpu.msg import frames
 from ceph_tpu.msg.messages import Message, MHello, decode_message
 
@@ -57,7 +58,9 @@ class Connection:
     async def send(self, msg: Message) -> None:
         if self.closed:
             raise ConnectionError(f"connection to {self.peer_name} closed")
-        frame = frames.encode_frame(msg.TAG, next(self._seq), msg.encode())
+        frame = frames.encode_frame(msg.TAG, next(self._seq),
+                                    msg.encode(),
+                                    secret=self.messenger.secret)
         async with self._send_lock:
             self.writer.write(frame)
             try:
@@ -83,8 +86,11 @@ class Connection:
 class Messenger:
     """Bind/connect endpoint owning all connections of one entity."""
 
-    def __init__(self, entity_name: str):
+    def __init__(self, entity_name: str, secret=None):
         self.entity_name = entity_name
+        # cephx-lite cluster secret: frames are HMAC-signed and
+        # unsigned/mis-signed inbound frames drop the connection
+        self.secret = secret
         self.addr: str = ""
         self.dispatcher: Optional[DispatchFn] = None
         self.on_connection_fault: Optional[
@@ -161,10 +167,15 @@ class Messenger:
             while True:
                 pre = await conn.reader.readexactly(
                     frames.PREAMBLE_WIRE_LEN)
-                tag, _flags, _seq, length = frames.decode_preamble(pre)
+                tag, flags, _seq, length = frames.decode_preamble(pre)
                 payload = await conn.reader.readexactly(length)
                 frames.check_payload(
                     payload, await conn.reader.readexactly(4))
+                sig = b""
+                if flags & frames.FLAG_SIGNED:
+                    sig = await conn.reader.readexactly(auth.SIG_LEN)
+                frames.check_signature(self.secret, flags, pre,
+                                       payload, sig)
                 msg = decode_message(tag, payload)
                 if isinstance(msg, MHello):
                     conn.peer_name = msg.entity_name

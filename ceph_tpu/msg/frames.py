@@ -20,6 +20,12 @@ the format collapses to:
 Any crc or magic mismatch is a protocol error: the connection is dropped
 (the reference resets the session on a bad frame; lossless peers
 reconnect and replay, lossy clients resend at the Objecter layer).
+
+cephx-lite signing (ceph_tpu.common.auth): when a secret is configured,
+FLAG_SIGNED is set and an 8-byte truncated HMAC-SHA256 over
+preamble+payload follows the payload crc (CephxSessionHandler
+sign_message role); a receiver with a secret drops unsigned or
+mis-signed frames.
 """
 
 from __future__ import annotations
@@ -32,6 +38,7 @@ from ceph_tpu.ops.checksum import crc32c
 MAGIC = 0xCE9F0205
 PREAMBLE = struct.Struct("<IHHQI")
 CRC = struct.Struct("<I")
+FLAG_SIGNED = 0x0001
 
 
 class FrameError(Exception):
@@ -39,11 +46,30 @@ class FrameError(Exception):
 
 
 def encode_frame(tag: int, seq: int, payload: bytes,
-                 flags: int = 0) -> bytes:
+                 flags: int = 0, secret=None) -> bytes:
+    if secret is not None:
+        flags |= FLAG_SIGNED
     pre = PREAMBLE.pack(MAGIC, tag, flags, seq, len(payload))
-    return b"".join((
-        pre, CRC.pack(crc32c(0xFFFFFFFF, pre)),
-        payload, CRC.pack(crc32c(0xFFFFFFFF, payload))))
+    parts = [pre, CRC.pack(crc32c(0xFFFFFFFF, pre)),
+             payload, CRC.pack(crc32c(0xFFFFFFFF, payload))]
+    if secret is not None:
+        from ceph_tpu.common import auth
+
+        parts.append(auth.sign(secret, pre, payload))
+    return b"".join(parts)
+
+
+def check_signature(secret, flags: int, pre_buf: bytes,
+                    payload: bytes, sig: bytes) -> None:
+    """Receiver-side auth adjudication; FrameError drops the conn."""
+    from ceph_tpu.common import auth
+
+    if secret is None:
+        return
+    if not flags & FLAG_SIGNED:
+        raise FrameError("unsigned frame from peer (auth required)")
+    if not auth.verify(secret, sig, pre_buf[:PREAMBLE.size], payload):
+        raise FrameError("frame signature mismatch (wrong key?)")
 
 
 def decode_preamble(buf: bytes) -> Tuple[int, int, int, int]:

@@ -354,8 +354,8 @@ class TPUStore(ObjectStore):
     # -- write path (_do_alloc_write) --------------------------------------
 
     def _span_write(self, kvt, onode: _Onode, span: int,
-                    raw: bytes, write_len: Optional[int] = None
-                    ) -> None:
+                    raw: bytes, write_len: Optional[int] = None,
+                    write_off: int = 0) -> None:
         """Store one logical span COW-style: compress-candidate scoring,
         gate, csum, allocate, write; old extent freed in the same batch.
 
@@ -380,9 +380,17 @@ class TPUStore(ObjectStore):
                     padded_len, padded, csum_data)
             self._defer_seq += 1
             key = f"{self._defer_seq:020d}".encode()
+            # journal ONLY the touched byte range (BlueStore journals
+            # the modified chunks, not the whole blob — a 50-byte
+            # overwrite must not WAL 64 KiB); crash replay applies the
+            # delta over the intact pre-image, matching the committed
+            # csum computed over the merged span
+            delta = raw[write_off:write_off + write_len]
             kvt.set(P_DEFER, key,
-                    old.offset.to_bytes(8, "little") + raw)
-            self._txc_defer.append((old.offset, bytes(raw), key))
+                    (old.offset + write_off).to_bytes(8, "little")
+                    + delta)
+            self._txc_defer.append(
+                (old.offset + write_off, delta, key))
             self._defer_overlay[old.offset] = bytes(raw)
             if old.stored_len > len(raw):
                 # the shrunken tail is unreferenced: free it
@@ -470,7 +478,8 @@ class TPUStore(ObjectStore):
                 data[pos:pos + (w_end - w_start)]
             pos += w_end - w_start
             self._span_write(kvt, onode, span, bytes(raw),
-                             write_len=w_end - w_start)
+                             write_len=w_end - w_start,
+                             write_off=w_start - s_start)
         onode.size = max(onode.size, end)
         self._put_onode(kvt, cid, oid, onode)
 
@@ -539,13 +548,14 @@ class TPUStore(ObjectStore):
             # apply deferred in-place writes AFTER the commit point:
             # their durability is the journal entry; the block file
             # catches up here and fsyncs lazily in batches
-            for off, raw, key in self._txc_defer:
-                self._pwrite(off, raw)
-                # drop the overlay only if no NEWER deferred write to
-                # the same offset superseded this one
-                if self._defer_overlay.get(off) == raw:
-                    del self._defer_overlay[off]
-                self._pending_defer.append((key, off, len(raw)))
+            for off, delta, key in self._txc_defer:
+                self._pwrite(off, delta)
+                self._pending_defer.append((key, off, len(delta)))
+            if self._txc_defer:
+                # the block file has caught up: overlays are stale
+                # (a newer same-txn overlay was already overwritten by
+                # its own later _span_write call)
+                self._defer_overlay.clear()
             self._txc_defer = []
             # releases overlapping a pending journal entry must wait
             # for the journal trim: a crash would otherwise REPLAY the

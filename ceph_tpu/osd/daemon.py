@@ -240,7 +240,11 @@ class OSDDaemon:
         self.mon_addr = mon_addr
         self.config = dict(DEFAULTS)
         self.config.update(config or {})
-        self.msgr = Messenger(f"osd.{osd_id}")
+        from ceph_tpu.common.auth import parse_secret
+
+        self.msgr = Messenger(
+            f"osd.{osd_id}", secret=parse_secret(
+                self.config.get("auth_secret")))
         self.msgr.dispatcher = self._dispatch
         self.store = store if store is not None else MemStore()
         self._own_store = store is None
@@ -1606,14 +1610,18 @@ class OSDDaemon:
         # peer shard, invisible to the primary's own listing — the
         # reference's scrub maps cover every shard for the same reason
         name_set = set(self._list_shard_objects(state.pg, my_shard))
-        for idx, osd in enumerate(state.acting):
-            if osd == CRUSH_ITEM_NONE or osd == self.osd_id or \
-                    not self.osdmap.is_up(osd):
-                continue
+
+        async def peer_listing(osd: int):
             tid = self._next_tid()
-            reply = await self._request(
+            return await self._request(
                 osd, MPGQuery(tid, state.pg, state.interval_epoch,
                               self.osd_id), tid)
+
+        peers = [osd for osd in state.acting
+                 if osd != CRUSH_ITEM_NONE and osd != self.osd_id
+                 and self.osdmap.is_up(osd)]
+        for reply in await asyncio.gather(*(peer_listing(o)
+                                            for o in peers)):
             if reply is not None:
                 name_set.update(reply.info.get("objects", []))
         names = sorted(n for n in name_set if not is_internal_name(n))
@@ -1752,9 +1760,11 @@ class OSDDaemon:
             if len(digests) > 1:
                 majority = max(digests.values(), key=len)
                 if len(majority) * 2 > voters:
-                    bad = [who for members in digests.values()
-                           if members is not majority
-                           for who in members]
+                    # EXTEND: version-stale copies collected above must
+                    # not be discarded by the digest adjudication
+                    bad.extend(who for members in digests.values()
+                               if members is not majority
+                               for who in members)
                 else:
                     run["errors"] += 1
                     log.warning(

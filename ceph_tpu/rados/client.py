@@ -55,7 +55,8 @@ class ObjectNotFound(RadosError):
 
 class RadosClient:
     def __init__(self, mon_addr: str, name: Optional[str] = None,
-                 op_timeout: float = 10.0, max_retries: int = 30):
+                 op_timeout: float = 10.0, max_retries: int = 30,
+                 secret: Optional[str] = None):
         self.mon_addr = mon_addr
         if name is None:
             # entity names must be GLOBALLY unique: the OSDs' reqid
@@ -65,7 +66,9 @@ class RadosClient:
             import uuid
 
             name = f"client.{uuid.uuid4().hex[:12]}"
-        self.msgr = Messenger(name)
+        from ceph_tpu.common.auth import parse_secret
+
+        self.msgr = Messenger(name, secret=parse_secret(secret))
         self.msgr.dispatcher = self._dispatch
         self.osdmap: Optional[OSDMap] = None
         self.op_timeout = op_timeout

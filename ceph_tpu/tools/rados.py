@@ -34,7 +34,11 @@ def _out(obj) -> None:
 
 
 async def _run(args) -> int:
-    client = RadosClient(args.mon)
+    secret = args.secret
+    if not secret and args.keyring:
+        with open(args.keyring) as f:
+            secret = f.read().strip()
+    client = RadosClient(args.mon, secret=secret or None)
     await client.connect()
     try:
         return await _dispatch(client, args)
@@ -164,6 +168,10 @@ def main(argv=None) -> int:
     ap.add_argument("-m", "--mon", required=True,
                     help="mon address host:port")
     ap.add_argument("-p", "--pool", default="")
+    ap.add_argument("--secret", default="",
+                    help="cephx-lite hex secret for a keyed cluster")
+    ap.add_argument("-k", "--keyring", default="",
+                    help="file holding the hex secret")
     sub = ap.add_subparsers(dest="cmd", required=True)
     sub.add_parser("lspools")
     mk = sub.add_parser("mkpool")

@@ -42,7 +42,8 @@ class Cluster:
     def __init__(self, num_osds: int = 4, osds_per_host: int = 2,
                  osd_config: Optional[dict] = None,
                  mon_config: Optional[dict] = None,
-                 store_factory=None):
+                 store_factory=None,
+                 client_secret: Optional[str] = None):
         self.num_osds = num_osds
         self.osds_per_host = osds_per_host
         self.osd_config = dict(FAST_CONFIG)
@@ -55,6 +56,7 @@ class Cluster:
         self.mon_config = dict(FAST_MON_CONFIG)
         self.mon_config.update(mon_config or {})
         self.store_factory = store_factory or (lambda osd_id: MemStore())
+        self.client_secret = client_secret
         self.mon: Optional[MonDaemon] = None
         self.osds: Dict[int, OSDDaemon] = {}
         self.stores: Dict[int, object] = {}
@@ -71,7 +73,8 @@ class Cluster:
             store.mount()
             self.stores[osd_id] = store
             await self._boot_osd(osd_id)
-        self.client = RadosClient(self.mon.addr)
+        self.client = RadosClient(self.mon.addr,
+                                  secret=self.client_secret)
         await self.client.connect()
 
     async def _boot_osd(self, osd_id: int) -> None:

@@ -1,0 +1,95 @@
+"""cephx-lite tests: signed frames end to end.
+
+Mirrors /root/reference/src/test/ cephx shapes at the operative level:
+a keyed cluster accepts keyed peers, rejects unkeyed and wrong-keyed
+ones, and signatures detect tampering.
+"""
+
+import asyncio
+
+import pytest
+
+from cluster_helpers import Cluster
+
+from ceph_tpu.common import auth
+from ceph_tpu.msg import frames
+from ceph_tpu.rados.client import RadosClient, RadosError
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 120))
+
+
+def test_sign_verify_unit():
+    secret = auth.parse_secret(auth.generate_secret())
+    sig = auth.sign(secret, b"pre", b"payload")
+    assert len(sig) == auth.SIG_LEN
+    assert auth.verify(secret, sig, b"pre", b"payload")
+    assert not auth.verify(secret, sig, b"pre", b"tampered")
+    other = auth.parse_secret(auth.generate_secret())
+    assert not auth.verify(other, sig, b"pre", b"payload")
+    assert auth.parse_secret(None) is None
+    assert auth.parse_secret("") is None
+
+
+def test_frame_signing_round_trip():
+    secret = auth.parse_secret(auth.generate_secret())
+    frame = frames.encode_frame(7, 1, b"hello", secret=secret)
+    pre = frame[:frames.PREAMBLE_WIRE_LEN]
+    tag, flags, _seq, length = frames.decode_preamble(pre)
+    assert flags & frames.FLAG_SIGNED
+    payload = frame[frames.PREAMBLE_WIRE_LEN:
+                    frames.PREAMBLE_WIRE_LEN + length]
+    sig = frame[-auth.SIG_LEN:]
+    frames.check_signature(secret, flags, pre, payload, sig)
+    # tampered payload fails even though its own crc could be fixed up
+    with pytest.raises(frames.FrameError):
+        frames.check_signature(secret, flags, pre, b"hellp", sig)
+    # unsigned frame against a keyed receiver fails
+    plain = frames.encode_frame(7, 1, b"hello")
+    ptag, pflags, _s, _l = frames.decode_preamble(
+        plain[:frames.PREAMBLE_WIRE_LEN])
+    with pytest.raises(frames.FrameError):
+        frames.check_signature(secret, pflags,
+                               plain[:frames.PREAMBLE_WIRE_LEN],
+                               b"hello", b"")
+    # keyless receiver accepts anything (auth disabled)
+    frames.check_signature(None, pflags,
+                           plain[:frames.PREAMBLE_WIRE_LEN],
+                           b"hello", b"")
+
+
+def test_keyed_cluster_accepts_keyed_rejects_unkeyed():
+    secret = auth.generate_secret()
+
+    async def main():
+        cluster = Cluster(
+            num_osds=3,
+            osd_config={"auth_secret": secret},
+            mon_config={"auth_secret": secret},
+            client_secret=secret)
+        await cluster.start()
+        try:
+            # keyed client: full data path works signed end to end
+            await cluster.client.create_replicated_pool(
+                "p", size=2, pg_num=8)
+            io = cluster.client.open_ioctx("p")
+            await io.write_full("obj", b"signed payload " * 100)
+            assert await io.read("obj") == b"signed payload " * 100
+
+            # unkeyed client: the mon drops its frames — no map, no ops
+            intruder = RadosClient(cluster.mon.addr)
+            with pytest.raises(Exception):
+                await asyncio.wait_for(intruder.connect(), 3.0)
+            await intruder.shutdown()
+
+            # wrong-keyed client: same rejection
+            intruder2 = RadosClient(cluster.mon.addr,
+                                    secret=auth.generate_secret())
+            with pytest.raises(Exception):
+                await asyncio.wait_for(intruder2.connect(), 3.0)
+            await intruder2.shutdown()
+        finally:
+            await cluster.stop()
+
+    run(main())

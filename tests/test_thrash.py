@@ -262,7 +262,7 @@ def test_thrash_ec_k2m2():
         pool={"kind": "ec", "pg_num": 8, "profile": {
             "plugin": "ec_jax", "technique": "reed_sol_van",
             "k": "2", "m": "2", "crush-failure-domain": "osd"}},
-        min_alive=5), 420))
+        min_alive=5), 600))
 
 
 @pytest.mark.slow
@@ -272,7 +272,7 @@ def test_thrash_ec_k8m3():
         pool={"kind": "ec", "pg_num": 8, "profile": {
             "plugin": "ec_jax", "technique": "reed_sol_van",
             "k": "8", "m": "3", "crush-failure-domain": "osd"}},
-        min_alive=11, n_objects=10), 420))
+        min_alive=11, n_objects=10), 600))
 
 
 @pytest.mark.slow
@@ -280,4 +280,4 @@ def test_thrash_replicated():
     asyncio.run(asyncio.wait_for(_run_thrash(
         seed=9, num_osds=6, osds_per_host=1,
         pool={"kind": "replicated", "size": 3, "pg_num": 8},
-        min_alive=4), 420))
+        min_alive=4), 600))
