@@ -3,17 +3,20 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 - value: on-chip encode throughput (GiB/s of data bytes consumed) for the
-  GF(2^8) MXU matmul, batched over stripes, steady state.
-- vs_baseline: ratio against the host CPU path (native C++ table-driven GF
-  region ops — the scalar-jerasure equivalent — measured on this machine).
+  packed-word xtime Pallas kernel (ops/gf_pallas.py) — the default device
+  path gf.gf_matmul_device dispatches on TPU — batched over stripes,
+  steady state on the device-native int32 word layout.  Bit-exactness
+  against the host SIMD oracle is asserted before timing.
+- vs_baseline: ratio against the host CPU path (native C++ SIMD split-table
+  GF region ops — the jerasure-SSE/isa-l speed tier, measured here).
 
 Measurement note: the axon TPU tunnel makes per-call timing unreliable
 (block_until_ready returns early; a host fetch pays ~0.5s RPC latency), so
 device time is measured by chaining N data-dependent encodes inside one jit
 and differencing two loop lengths — RPC overhead and the final fetch cancel.
 
-Details (decode, CPU numbers) go to bench_details.json; the driver contract
-is the one line.
+Details (decode sweep over 1..m erasures, XLA-path and CPU numbers) go to
+bench_details.json; the driver contract is the one line.
 """
 
 from __future__ import annotations
@@ -202,19 +205,32 @@ def main() -> None:
     import jax.numpy as jnp
 
     from ceph_tpu.models import reed_solomon as rs
-    from ceph_tpu.ops import gf
+    from ceph_tpu.ops import gf, gf_pallas
     from ceph_tpu import native
 
     k, m = 8, 3
     chunk = 512 * 1024          # 4 MiB stripe = k * 512 KiB
     batch = 16                  # stripes per dispatch (64 MiB data)
     matrix = rs.reed_sol_van_matrix(k, m)
+    gf_pallas.register_matrix(matrix)  # what ec_jax init() does
     mbits = jnp.asarray(gf.gf_matrix_to_bits(matrix))
 
     rng = np.random.default_rng(0)
     data_host = rng.integers(0, 256, (batch, k, chunk), dtype=np.uint8)
     data = jax.device_put(jnp.asarray(data_host))
     data_bytes = batch * k * chunk
+    use_pallas = gf_pallas.supported((batch, k, chunk))
+    # device-native word layout (free view of the same bytes on host)
+    words = jax.device_put(jnp.asarray(
+        gf_pallas.words_from_bytes(data_host))) if use_pallas else None
+
+    # integrity: the Pallas kernel's parity is bit-exact vs the host SIMD
+    # oracle before any timing
+    if use_pallas:
+        got = gf_pallas.gf_matmul_pallas(matrix, data_host[:2])
+        want = np.stack([gf.gf_matmul_host(matrix, data_host[i])
+                         for i in range(2)])
+        assert np.array_equal(got, want), "pallas parity != host oracle"
 
     @functools.partial(jax.jit, static_argnames=("n", "rows"))
     def loop(mb, d, n, rows):
@@ -225,28 +241,63 @@ def main() -> None:
 
         return jax.lax.fori_loop(0, n, body, d).astype(jnp.int32).sum()
 
-    def device_seconds_per_encode(mb, d, rows, n=201, iters=5):
+    @functools.partial(jax.jit, static_argnames=("mat_key", "n", "rows"))
+    def loop_words(d, mat_key, n, rows):
+        mat = np.array(mat_key, dtype=np.uint8)
+        def body(_, carry):
+            p = gf_pallas.gf_matmul_words(mat, carry)
+            return carry.at[:, :rows].set(p)
+
+        return jax.lax.fori_loop(0, n, body, d).astype(jnp.int32).sum()
+
+    def differenced(run, n, iters=5):
         for nn in (1, n):
-            float(loop(mb, d, nn, rows))  # compile + warm
+            float(run(nn))  # compile + warm
         def t(nn):
             best = float("inf")
             for _ in range(iters):
                 t0 = time.perf_counter()
-                float(loop(mb, d, nn, rows))
+                float(run(nn))
                 best = min(best, time.perf_counter() - t0)
             return best
         return (t(n) - t(1)) / (n - 1)
 
-    t_enc = device_seconds_per_encode(mbits, data, rows=m)
-    enc_gibs = data_bytes / t_enc / (1 << 30)
+    def device_seconds_per_encode(mb, d, rows, n=201, iters=5):
+        return differenced(lambda nn: loop(mb, d, nn, rows), n, iters)
 
-    # single-erasure decode: rebuild data chunk 0 from chunks 1..k-1 + p0;
-    # survivors carried as a (B, k, S) buffer, same matmul shape family
-    have = list(range(1, k)) + [k]
-    dmat = rs.decode_matrix(matrix, k, [0], have)
-    dmat_bits = jnp.asarray(gf.gf_matrix_to_bits(dmat))
-    t_dec = device_seconds_per_encode(dmat_bits, data, rows=1)
-    dec_gibs = data_bytes / t_dec / (1 << 30)
+    def words_seconds(mat, d, rows, n=801, iters=5):
+        key = tuple(tuple(int(c) for c in row) for row in mat)
+        return differenced(lambda nn: loop_words(d, key, nn, rows), n, iters)
+
+    enc_xla_gibs = None
+    if use_pallas:
+        t_enc = words_seconds(matrix, words, rows=m)
+        enc_gibs = data_bytes / t_enc / (1 << 30)
+        t_xla = device_seconds_per_encode(mbits, data, rows=m)
+        enc_xla_gibs = data_bytes / t_xla / (1 << 30)
+    else:
+        t_enc = device_seconds_per_encode(mbits, data, rows=m)
+        enc_gibs = data_bytes / t_enc / (1 << 30)
+
+    # decode sweep over 1..m erasures (the reference benchmark sweeps
+    # erasure counts: ceph_erasure_code_benchmark.cc:251-317).  Lost
+    # chunks 0..e-1 rebuilt from k survivors; the production decode path
+    # is the generic SMEM-coefficient kernel (unregistered matrices).
+    decode_sweep = {}
+    dec_gibs = None
+    for e in range(1, m + 1):
+        lost = list(range(e))
+        have = list(range(e, k)) + list(range(k, k + e))
+        dmat = rs.decode_matrix(matrix, k, lost, have)
+        if use_pallas:
+            t_d = words_seconds(dmat, words, rows=e)
+        else:
+            dmb = jnp.asarray(gf.gf_matrix_to_bits(dmat))
+            t_d = device_seconds_per_encode(dmb, data, rows=e)
+        decode_sweep[f"decode_{e}_erasure_gibs"] = (
+            data_bytes / t_d / (1 << 30))
+        if e == 1:
+            dec_gibs = decode_sweep["decode_1_erasure_gibs"]
 
     # CPU baseline: native SIMD GF matmul (AVX2/SSSE3 split-table
     # shuffle, gf_simd.cc — the jerasure-SSE/isa-l speed tier), one
@@ -260,8 +311,9 @@ def main() -> None:
 
         u8p = ctypes.POINTER(ctypes.c_uint8)
 
-        def cpu_bench(fn, kk, mm, size, iters=5):
-            mat = rs.reed_sol_van_matrix(kk, mm)
+        def cpu_bench(fn, kk, mm, size, iters=5, mat=None):
+            if mat is None:
+                mat = rs.reed_sol_van_matrix(kk, mm)
             tables = np.ascontiguousarray(gf.gf_mul_tables(mat))
             src = np.ascontiguousarray(
                 rng.integers(0, 256, (kk, size), dtype=np.uint8))
@@ -287,6 +339,13 @@ def main() -> None:
             # BASELINE config #1 shape: k=4 m=2, 1 MiB objects
             cpu_k4m2_gibs = cpu_bench(lib.ceph_tpu_gf_matmul_simd,
                                       4, 2, (1 << 20) // 4)
+            # decode sweep, CPU SIMD tier (same matrices as the TPU sweep)
+            for e in range(1, m + 1):
+                dmat = rs.decode_matrix(
+                    matrix, k, list(range(e)),
+                    list(range(e, k)) + list(range(k, k + e)))
+                decode_sweep[f"cpu_decode_{e}_erasure_gibs"] = cpu_bench(
+                    lib.ceph_tpu_gf_matmul_simd, k, e, chunk, mat=dmat)
         cpu_scalar_gibs = cpu_bench(lib.ceph_tpu_gf_matmul, k, m, chunk)
         if cpu_gibs is None:
             cpu_gibs = cpu_scalar_gibs
@@ -314,7 +373,10 @@ def main() -> None:
 
     details = {
         "encode_gibs": enc_gibs,
+        "encode_path": "pallas_words" if use_pallas else "xla_bitplanes",
+        "encode_xla_gibs": enc_xla_gibs,
         "decode_single_erasure_gibs": dec_gibs,
+        **decode_sweep,
         "cpu_native_gibs": cpu_gibs,
         "cpu_scalar_gibs": cpu_scalar_gibs,
         "cpu_simd_level": simd_level,

@@ -89,6 +89,11 @@ class ErasureCodeJax(ErasureCode):
         if self.use_tpu:
             import jax.numpy as jnp
 
+            from ceph_tpu.ops import gf_pallas
+
+            # Hot generator matrix: compiles into the specialized
+            # unrolled Pallas kernel on first device dispatch.
+            gf_pallas.register_matrix(self.matrix)
             self._mbits_dev = jnp.asarray(gf.gf_matrix_to_bits(self.matrix))
 
     # -- geometry (layout-parity with ErasureCodeJerasure) ----------------

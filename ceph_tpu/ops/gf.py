@@ -291,12 +291,16 @@ if HAVE_JAX:
 
     def gf_matmul_device(m: np.ndarray, data):
         """(R,K) GF(2^8) matrix x (..., K, S) uint8 through the fastest
-        device path: the packed-word xtime Pallas kernel on TPU
-        (ops/gf_pallas.py), the XLA bit-decomposition elsewhere."""
+        device path: the packed-word xtime Pallas kernel on TPU for
+        host-side (numpy) inputs (ops/gf_pallas.py — word-layout entry,
+        ~360 GiB/s on a v5e), the XLA bit-decomposition for device-
+        resident uint8 arrays and non-TPU backends (a device-side
+        uint8->int32 relayout would cost more than the encode)."""
         from ceph_tpu.ops import gf_pallas
 
-        if gf_pallas.supported(np.shape(data)):
-            return gf_pallas.gf_matmul_words_pallas(m, data)
+        if isinstance(data, np.ndarray) and gf_pallas.supported(
+                np.shape(data)):
+            return gf_pallas.gf_matmul_pallas(m, data)
         mbits = jnp.asarray(gf_matrix_to_bits(m))
         return gf2_matmul_bytes(mbits, jnp.asarray(data, dtype=jnp.uint8))
 

