@@ -1,10 +1,14 @@
-"""Mini-monitor: the cluster control plane, single-instance.
+"""Mini-monitor: the cluster control plane (single- or multi-instance).
 
 Reference parity: Monitor + OSDMonitor
-(/root/reference/src/mon/Monitor.cc, OSDMonitor.cc) minus Paxos — one
-mon instance is authoritative (the reference's single-mon vstart shape);
-the PaxosService commit discipline survives as: every map mutation is an
-epoch bump whose full map is pushed to all subscribers.
+(/root/reference/src/mon/Monitor.cc, OSDMonitor.cc).  With one mon the
+PaxosService commit discipline survives as: every map mutation is an
+epoch bump whose incremental is pushed to all subscribers.  With a
+multi-mon monmap, every mutation is a Paxos proposal (mon/paxos.py:
+collect/begin/accept/commit/lease + rank-priority elections); only the
+leader mutates, peons forward boot/failure/commands to it (MForward
+role) and serve map reads from their committed state; a 2-of-3 quorum
+survives the loss of any one mon, including the leader mid-write.
 
 Covered OSDMonitor behaviors:
 - OSD lifecycle: MOSDBoot marks up + records the address
@@ -33,15 +37,21 @@ import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ceph_tpu.ec.registry import create_erasure_code
+from ceph_tpu.mon import paxos as paxos_mod
 from ceph_tpu.msg import Connection, Messenger
 from ceph_tpu.msg.messages import (
     Message,
     MGetMap,
     MMonCommand,
     MMonCommandReply,
+    MMonElection,
+    MMonForward,
+    MMonForwardReply,
+    MMonPaxos,
     MOSDBoot,
     MOSDFailure,
     MOSDMapMsg,
+    decode_message,
 )
 from ceph_tpu.osd.osdmap import (
     CEPH_OSD_DESTROYED,
@@ -74,17 +84,21 @@ class FailureReport:
 
 
 class MonDaemon:
-    """Single authoritative monitor."""
+    """One monitor instance (rank r of a monmap of n; n=1 keeps the
+    single-authoritative shape with zero consensus traffic)."""
 
     def __init__(self, num_osds: int, osds_per_host: int = 2,
                  config: Optional[Dict[str, Any]] = None,
-                 store=None):
+                 store=None, rank: int = 0,
+                 mon_addrs: Optional[List[str]] = None):
         self.config = dict(DEFAULTS)
         self.config.update(config or {})
         from ceph_tpu.common.auth import parse_secret
 
+        self.rank = rank
+        self.mon_addrs: List[str] = list(mon_addrs or [])
         self.msgr = Messenger(
-            "mon.0", secret=parse_secret(
+            f"mon.{rank}", secret=parse_secret(
                 self.config.get("auth_secret")))
         self.msgr.dispatcher = self._dispatch
         # durable state (the MonitorDBStore role,
@@ -104,6 +118,16 @@ class MonDaemon:
         self._down_at: Dict[int, float] = {}
         self._up_from: Dict[int, int] = {}  # boot epoch per osd
         self._check_task: Optional[asyncio.Task] = None
+        self._lease_watch_task: Optional[asyncio.Task] = None
+        # one map mutation in flight at a time (the PaxosService
+        # single-proposal round): handlers read the map, build an
+        # incremental, and propose under this lock
+        self._mutation_lock = asyncio.Lock()
+        # forwarded-command reply routing (MForward role)
+        self._fwd_tid = 0
+        self._fwd_pending: Dict[int, Tuple[Connection, int]] = {}
+        self.paxos: Optional[paxos_mod.Paxos] = None
+        self.elector: Optional[paxos_mod.Elector] = None
         if store is not None and self._load_store():
             return
         self.osdmap = OSDMap.build_simple(num_osds,
@@ -137,9 +161,8 @@ class MonDaemon:
         log.info("mon: reloaded epoch %d from store", self.osdmap.epoch)
         return True
 
-    def _persist(self, inc_raw: Optional[bytes]) -> None:
-        """One durable transaction per commit (Paxos commit point)."""
-        t = self.store.get_transaction()
+    def _stage_mon(self, t, inc_raw: Optional[bytes]) -> None:
+        """Stage the mon's map state into a store transaction."""
         if inc_raw is not None:
             t.set("osdmap",
                   self.osdmap.epoch.to_bytes(8, "big"), inc_raw)
@@ -153,6 +176,11 @@ class MonDaemon:
             "laggy_interval": self._laggy_interval,
             "up_from": self._up_from,
         }).encode())
+
+    def _persist(self, inc_raw: Optional[bytes]) -> None:
+        """One durable transaction per commit (Paxos commit point)."""
+        t = self.store.get_transaction()
+        self._stage_mon(t, inc_raw)
         self.store.submit_transaction_sync(t)
 
     # -- lifecycle ---------------------------------------------------------
@@ -161,32 +189,141 @@ class MonDaemon:
         addr = await self.msgr.bind(host, port)
         self._check_task = asyncio.get_running_loop().create_task(
             self._check_failures_loop())
+        if self.mon_addrs:
+            await self.start_consensus()
         return addr
+
+    async def set_peers(self, mon_addrs: List[str]) -> None:
+        """Install the monmap (addresses by rank) once every mon is
+        bound, then start elections; for dynamically-bound test
+        clusters this replaces passing mon_addrs to the constructor."""
+        self.mon_addrs = list(mon_addrs)
+        await self.start_consensus()
+
+    async def start_consensus(self) -> None:
+        n = len(self.mon_addrs)
+        self.paxos = paxos_mod.Paxos(
+            self.rank, n, self._send_rank, self.store,
+            self._paxos_apply, lambda: self.osdmap.encode(),
+            self._paxos_install, self.config)
+        self.paxos.on_leader_dead = self._on_quorum_lost
+        self.elector = paxos_mod.Elector(
+            self.rank, n, self._send_rank, self._on_win,
+            self._on_lose, self.config)
+        if self.store is not None:
+            raw = self.store.get("mon", b"election_epoch")
+            if raw:
+                self.elector.epoch = int(raw)
+        await self.elector.start()
+        if n > 1:
+            self._lease_watch_task = \
+                asyncio.get_running_loop().create_task(
+                    self._lease_watch())
 
     async def shutdown(self) -> None:
         if self._check_task is not None:
             self._check_task.cancel()
+        if self._lease_watch_task is not None:
+            self._lease_watch_task.cancel()
+        if self.elector is not None:
+            self.elector.shutdown()
+        if self.paxos is not None:
+            self.paxos.shutdown()
         await self.msgr.shutdown()
 
     @property
     def addr(self) -> str:
         return self.msgr.addr
 
-    # -- map mutation ------------------------------------------------------
+    def is_leader(self) -> bool:
+        return self.elector is None or self.elector.leader == self.rank
 
-    def _commit(self, inc: Incremental) -> None:
-        """Apply an incremental and publish the new epoch (the Paxos
-        commit point of the single-instance world)."""
-        raw = inc.encode()
+    # -- consensus plumbing ------------------------------------------------
+
+    async def _send_rank(self, peer: int, msg: Message) -> None:
+        if hasattr(msg, "from_rank"):
+            msg.from_rank = self.rank
+        try:
+            await self.msgr.send_to(self.mon_addrs[peer], msg)
+        except (ConnectionError, OSError):
+            pass  # elections/leases tolerate drops; paxos retries
+
+    def _save_election_epoch(self) -> None:
+        if self.store is not None and self.elector is not None:
+            t = self.store.get_transaction()
+            t.set("mon", b"election_epoch",
+                  str(self.elector.epoch).encode())
+            self.store.submit_transaction_sync(t)
+
+    async def _on_win(self, epoch: int, quorum) -> None:
+        self._save_election_epoch()
+        self._failure_reports.clear()  # re-reported by live OSDs
+        await self.paxos.leader_init(set(quorum))
+
+    async def _on_lose(self, epoch: int, leader: int) -> None:
+        self._save_election_epoch()
+        self.paxos.become_peon()
+
+    async def _on_quorum_lost(self) -> None:
+        await self.elector.call_election()
+
+    async def _lease_watch(self) -> None:
+        """Peon-side leader failure detection: an expired lease (no
+        leader traffic) calls a new election (Paxos lease timeout)."""
+        while True:
+            await asyncio.sleep(0.3)
+            if self.elector is None or self.elector.electing:
+                continue
+            if self.is_leader():
+                continue
+            if not self.paxos.lease_valid():
+                log.warning("mon.%d: lease expired — leader %s silent,"
+                            " calling election", self.rank,
+                            self.elector.leader)
+                await self.elector.call_election()
+
+    def _paxos_apply(self, v: int, value: bytes, t) -> None:
+        """Committed-value application (every mon, leader and peon):
+        decode the incremental, advance the map, stage durable state
+        into the SAME transaction as the paxos commit, publish."""
+        inc = Incremental.decode(value)
         self.osdmap.apply_incremental(inc)
-        self._inc_log[inc.epoch] = raw
+        self._inc_log[inc.epoch] = value
         while len(self._inc_log) > self._inc_log_max:
             del self._inc_log[min(self._inc_log)]
-        if self.store is not None:
-            # durable BEFORE published: a subscriber must never see an
-            # epoch a restarted mon could forget
-            self._persist(raw)
+        self._stage_mon(t, value)
         self._publish()
+
+    def _paxos_install(self, v: int, blob: bytes, t) -> None:
+        """Full-state catch-up past a trimmed log (OP_FULL)."""
+        self.osdmap = OSDMap.decode(blob)
+        self._inc_log.clear()
+        self._stage_mon(t, None)
+        self._publish()
+        log.info("mon.%d: installed full snapshot at epoch %d",
+                 self.rank, self.osdmap.epoch)
+
+    # -- map mutation ------------------------------------------------------
+
+    async def _commit(self, inc: Incremental) -> bool:
+        """Replicate one incremental through Paxos (leader only; the
+        n=1 fast path commits inline with zero network traffic).
+        Caller holds _mutation_lock.  Returns False when quorum could
+        not commit — the caller surfaces EAGAIN and the client retries."""
+        if self.paxos is None:
+            # pre-consensus (constructor persistence only)
+            raw = inc.encode()
+            self.osdmap.apply_incremental(inc)
+            self._inc_log[inc.epoch] = raw
+            if self.store is not None:
+                self._persist(raw)
+            self._publish()
+            return True
+        # re-stamp under the mutation lock: the handler built the inc
+        # against the map as it read it; the epoch must be the commit
+        # point's successor
+        inc.epoch = self.osdmap.epoch + 1
+        return await self.paxos.propose(inc.encode())
 
     def _publish(self) -> None:
         """Push the new epoch to subscribers as the committing
@@ -216,8 +353,14 @@ class MonDaemon:
 
     async def _dispatch(self, conn: Connection, msg: Message) -> None:
         if isinstance(msg, MOSDBoot):
-            self._handle_boot(msg)
+            if self.is_leader():
+                await self._handle_boot(msg)
+            else:
+                await self._forward(msg)
         elif isinstance(msg, MGetMap):
+            # served from committed state on ANY mon: epochs are
+            # monotonic and consumers pull ranges, so a peon answering
+            # slightly behind the leader is safe by construction
             if msg.subscribe and conn not in self._subscribers:
                 self._subscribers.append(conn)
             cur = self.osdmap.epoch
@@ -233,14 +376,81 @@ class MonDaemon:
                     cur, full_map=self.osdmap.encode(),
                     gap_unfillable=bool(since)))
         elif isinstance(msg, MOSDFailure):
-            self._handle_failure(msg)
+            if self.is_leader():
+                await self._handle_failure(msg)
+            else:
+                await self._forward(msg)
         elif isinstance(msg, MMonCommand):
-            rc, out = self.handle_command(msg.cmd)
-            await conn.send(MMonCommandReply(msg.tid, rc, out))
+            if self.is_leader():
+                rc, out = await self.handle_command(msg.cmd)
+                await conn.send(MMonCommandReply(msg.tid, rc, out))
+            else:
+                await self._forward(msg, conn, msg.tid)
+        elif isinstance(msg, MMonElection):
+            if self.elector is not None:
+                await self.elector.handle(msg)
+        elif isinstance(msg, MMonPaxos):
+            if self.paxos is not None and msg.from_rank >= 0:
+                await self.paxos.handle(msg.from_rank, msg)
+        elif isinstance(msg, MMonForward):
+            await self._handle_forward(conn, msg)
+        elif isinstance(msg, MMonForwardReply):
+            pending = self._fwd_pending.pop(msg.fwd_tid, None)
+            if pending is not None:
+                client_conn, tid = pending
+                await self._send_quiet(client_conn, MMonCommandReply(
+                    tid, msg.rc, msg.out))
+
+    async def _forward(self, msg: Message,
+                       conn: Optional[Connection] = None,
+                       tid: Optional[int] = None) -> None:
+        """Relay a client message to the leader (MForward role).
+        Commands get reply routing via fwd_tid; boot/failure reports
+        are fire-and-forget (their effect shows up in the next map)."""
+        leader = self.elector.leader if self.elector else None
+        if leader is None or leader == self.rank:
+            if conn is not None and tid is not None:
+                await self._send_quiet(conn, MMonCommandReply(
+                    tid, -11, {"error": "no quorum leader (election"
+                                        " in progress); retry"}))
+            return
+        fwd_tid = 0
+        if conn is not None and tid is not None:
+            self._fwd_tid += 1
+            fwd_tid = self._fwd_tid
+            self._fwd_pending[fwd_tid] = (conn, tid)
+            while len(self._fwd_pending) > 1024:
+                self._fwd_pending.pop(next(iter(self._fwd_pending)))
+        try:
+            await self.msgr.send_to(
+                self.mon_addrs[leader],
+                MMonForward(fwd_tid, msg.TAG, msg.encode()))
+        except (ConnectionError, OSError):
+            self._fwd_pending.pop(fwd_tid, None)
+
+    async def _handle_forward(self, conn: Connection,
+                              msg: MMonForward) -> None:
+        """Leader side of the relay."""
+        try:
+            inner = decode_message(msg.inner_tag, msg.inner_payload)
+        except Exception:
+            log.exception("mon.%d: bad forwarded message", self.rank)
+            return
+        if not self.is_leader():
+            return  # leadership moved mid-flight; sender will refresh
+        if isinstance(inner, MMonCommand):
+            rc, out = await self.handle_command(inner.cmd)
+            if msg.fwd_tid:
+                await self._send_quiet(conn, MMonForwardReply(
+                    msg.fwd_tid, rc, out))
+        elif isinstance(inner, MOSDBoot):
+            await self._handle_boot(inner)
+        elif isinstance(inner, MOSDFailure):
+            await self._handle_failure(inner)
 
     # -- boot / failure ----------------------------------------------------
 
-    def _handle_boot(self, msg: MOSDBoot) -> None:
+    async def _handle_boot(self, msg: MOSDBoot) -> None:
         osd = msg.osd
         if not (0 <= osd < self.osdmap.max_osd):
             return
@@ -260,23 +470,25 @@ class MonDaemon:
                 self._laggy_interval.get(osd, 0.0) * decay
                 + interval * weight)
         self._failure_reports.pop(osd, None)
-        if self.osdmap.is_up(osd) and \
-                self.osdmap.osd_addrs.get(osd) == msg.addr:
-            return
-        inc = Incremental(epoch=self.osdmap.epoch + 1)
-        inc.new_up_osds[osd] = msg.addr
-        if not self.osdmap.is_in(osd):
-            inc.new_weight[osd] = CEPH_OSD_IN
-        if self.osdmap.is_destroyed(osd):
-            # a lost OSD that comes back rejoins with normal probe
-            # semantics (its declared-gone window is over)
-            inc.new_state[osd] = CEPH_OSD_DESTROYED  # XOR: clear
-        self._commit(inc)
+        async with self._mutation_lock:
+            if self.osdmap.is_up(osd) and \
+                    self.osdmap.osd_addrs.get(osd) == msg.addr:
+                return
+            inc = Incremental(epoch=self.osdmap.epoch + 1)
+            inc.new_up_osds[osd] = msg.addr
+            if not self.osdmap.is_in(osd):
+                inc.new_weight[osd] = CEPH_OSD_IN
+            if self.osdmap.is_destroyed(osd):
+                # a lost OSD that comes back rejoins with normal probe
+                # semantics (its declared-gone window is over)
+                inc.new_state[osd] = CEPH_OSD_DESTROYED  # XOR: clear
+            if not await self._commit(inc):
+                return  # no quorum; the OSD's boot loop retries
         self._up_from[osd] = self.osdmap.epoch
-        log.info("mon: osd.%d booted at %s (epoch %d)", osd, msg.addr,
-                 self.osdmap.epoch)
+        log.info("mon.%d: osd.%d booted at %s (epoch %d)", self.rank,
+                 osd, msg.addr, self.osdmap.epoch)
 
-    def _handle_failure(self, msg: MOSDFailure) -> None:
+    async def _handle_failure(self, msg: MOSDFailure) -> None:
         target = msg.target_osd
         if not self.osdmap.is_up(target):
             return
@@ -292,7 +504,7 @@ class MonDaemon:
         else:
             report.last_reported = now
             report.failed_for = msg.failed_for
-        self._check_failure(target, now)
+        await self._check_failure(target, now)
 
     def _grace(self, target: int) -> float:
         """Adaptive grace (OSDMonitor.cc:3180-3185): base + decayed
@@ -305,7 +517,7 @@ class MonDaemon:
                 grace += prob * interval
         return grace
 
-    def _check_failure(self, target: int, now: float) -> None:
+    async def _check_failure(self, target: int, now: float) -> None:
         reports = self._failure_reports.get(target, {})
         if len(reports) < int(self.config["mon_osd_min_down_reporters"]):
             return
@@ -313,25 +525,33 @@ class MonDaemon:
         max_failed = max(r.failed_for for r in reports.values())
         if max(now - oldest, max_failed) < self._grace(target):
             return
-        log.info("mon: marking osd.%d down (%d reporters, grace %.1fs)",
-                 target, len(reports), self._grace(target))
+        log.info("mon.%d: marking osd.%d down (%d reporters, grace"
+                 " %.1fs)", self.rank, target, len(reports),
+                 self._grace(target))
         self._failure_reports.pop(target, None)
         self._down_at[target] = now
-        inc = Incremental(epoch=self.osdmap.epoch + 1)
-        inc.new_state[target] = CEPH_OSD_UP  # XOR: up -> down
-        self._commit(inc)
+        async with self._mutation_lock:
+            if not self.osdmap.is_up(target):
+                return
+            inc = Incremental(epoch=self.osdmap.epoch + 1)
+            inc.new_state[target] = CEPH_OSD_UP  # XOR: up -> down
+            await self._commit(inc)
 
     async def _check_failures_loop(self) -> None:
         while True:
             await asyncio.sleep(0.25)
+            if not self.is_leader():
+                # failure adjudication is the leader's job; a peon's
+                # stale report set resets on the next election win
+                continue
             now = time.monotonic()
             for target in list(self._failure_reports):
-                self._check_failure(target, now)
+                await self._check_failure(target, now)
 
     # -- commands (MonCommands.h / OSDMonitor command surface) -------------
 
-    def handle_command(self, cmd: Dict[str, Any]
-                       ) -> Tuple[int, Dict[str, Any]]:
+    async def handle_command(self, cmd: Dict[str, Any]
+                             ) -> Tuple[int, Dict[str, Any]]:
         prefix = cmd.get("prefix", "")
         try:
             handler = {
@@ -348,35 +568,47 @@ class MonDaemon:
                 "osd rm-pg-upmap-items": self._cmd_rm_pg_upmap_items,
                 "status": self._cmd_status,
                 "health": self._cmd_health,
+                "mon stat": self._cmd_mon_stat,
             }.get(prefix)
             if handler is None:
                 return -22, {"error": f"unknown command {prefix!r}"}
-            return handler(cmd)
+            return await handler(cmd)
         except Exception as e:  # command errors must not kill the mon
             log.exception("mon: command %r failed", prefix)
             return -22, {"error": str(e)}
 
-    def _cmd_profile_set(self, cmd) -> Tuple[int, Dict[str, Any]]:
+    async def _cmd_profile_set(self, cmd) -> Tuple[int, Dict[str, Any]]:
         name = cmd["name"]
         profile = dict(cmd["profile"])
         create_erasure_code(dict(profile))  # validate before committing
-        inc = Incremental(epoch=self.osdmap.epoch + 1)
-        inc.new_erasure_code_profiles[name] = profile
-        self._commit(inc)
+        async with self._mutation_lock:
+            inc = Incremental(epoch=self.osdmap.epoch + 1)
+            inc.new_erasure_code_profiles[name] = profile
+            if not await self._commit(inc):
+                return -11, {"error": "no quorum; retry"}
         return 0, {}
 
-    def _cmd_profile_get(self, cmd) -> Tuple[int, Dict[str, Any]]:
+    async def _cmd_profile_get(self, cmd) -> Tuple[int, Dict[str, Any]]:
         profile = self.osdmap.erasure_code_profiles.get(cmd["name"])
         if profile is None:
             return -2, {"error": "no such profile"}
         return 0, {"profile": profile}
 
-    def _cmd_pool_create(self, cmd) -> Tuple[int, Dict[str, Any]]:
+    async def _cmd_pool_create(self, cmd) -> Tuple[int, Dict[str, Any]]:
         name = cmd["name"]
         if self.osdmap.lookup_pool(name) >= 0:
             return 0, {"pool_id": self.osdmap.lookup_pool(name)}
         pg_num = int(cmd.get("pg_num", 32))
         pool_type = cmd.get("pool_type", "replicated")
+        # the WHOLE build runs under the mutation lock: the scratch map
+        # allocates the next pool id, and two concurrent creates off
+        # the same map would otherwise mint the same id (one pool
+        # silently clobbering the other)
+        async with self._mutation_lock:
+            return await self._pool_create_locked(
+                cmd, name, pg_num, pool_type)
+
+    async def _pool_create_locked(self, cmd, name, pg_num, pool_type):
         # stage on a SCRATCH map, then commit the result through an
         # Incremental like every other mutation: the change replays via
         # apply_incremental on every daemon and lands in the inc log
@@ -400,7 +632,8 @@ class MonDaemon:
         inc.new_pools[pool.id] = pool
         if pool_type == "erasure":
             inc.new_crush = scratch.crush  # carries the new EC rule
-        self._commit(inc)
+        if not await self._commit(inc):
+            return -11, {"error": "no quorum; retry"}
         return 0, {"pool_id": pool.id}
 
     def _pool_snap_inc(self, name: str):
@@ -420,57 +653,67 @@ class MonDaemon:
         inc.new_pools[pool.id] = pool
         return pool, inc
 
-    def _cmd_snap_create(self, cmd) -> Tuple[int, Dict[str, Any]]:
+    async def _cmd_snap_create(self, cmd) -> Tuple[int, Dict[str, Any]]:
         """Self-managed snapshot id allocation (the
         OSDMonitor selfmanaged_snap_create role): bump the pool's
         snap_seq through an Incremental and hand the id back."""
-        pool, inc = self._pool_snap_inc(cmd["name"])
-        if pool is None:
-            return -2, {"error": "no such pool"}
-        pool.snap_seq += 1
-        self._commit(inc)
+        async with self._mutation_lock:
+            pool, inc = self._pool_snap_inc(cmd["name"])
+            if pool is None:
+                return -2, {"error": "no such pool"}
+            pool.snap_seq += 1
+            if not await self._commit(inc):
+                return -11, {"error": "no quorum; retry"}
         return 0, {"snap_id": pool.snap_seq}
 
-    def _cmd_snap_remove(self, cmd) -> Tuple[int, Dict[str, Any]]:
+    async def _cmd_snap_remove(self, cmd) -> Tuple[int, Dict[str, Any]]:
         """Retire a snap id: lands in pool.removed_snaps; primaries trim
         clones when they observe the new map (snap trim role)."""
-        pool, inc = self._pool_snap_inc(cmd["name"])
-        if pool is None:
-            return -2, {"error": "no such pool"}
-        snap_id = int(cmd["snap_id"])
-        if snap_id <= 0 or snap_id > pool.snap_seq:
-            return -22, {"error": f"bad snap id {snap_id}"}
-        if snap_id not in pool.removed_snaps:
-            pool.removed_snaps.append(snap_id)
-            pool.removed_snaps.sort()
-        self._commit(inc)
+        async with self._mutation_lock:
+            pool, inc = self._pool_snap_inc(cmd["name"])
+            if pool is None:
+                return -2, {"error": "no such pool"}
+            snap_id = int(cmd["snap_id"])
+            if snap_id <= 0 or snap_id > pool.snap_seq:
+                return -22, {"error": f"bad snap id {snap_id}"}
+            if snap_id not in pool.removed_snaps:
+                pool.removed_snaps.append(snap_id)
+                pool.removed_snaps.sort()
+            if not await self._commit(inc):
+                return -11, {"error": "no quorum; retry"}
         return 0, {}
 
-    def _cmd_osd_down(self, cmd) -> Tuple[int, Dict[str, Any]]:
+    async def _cmd_osd_down(self, cmd) -> Tuple[int, Dict[str, Any]]:
         osd = int(cmd["osd"])
-        if self.osdmap.is_up(osd):
-            inc = Incremental(epoch=self.osdmap.epoch + 1)
-            inc.new_state[osd] = CEPH_OSD_UP
-            self._commit(inc)
+        async with self._mutation_lock:
+            if self.osdmap.is_up(osd):
+                inc = Incremental(epoch=self.osdmap.epoch + 1)
+                inc.new_state[osd] = CEPH_OSD_UP
+                if not await self._commit(inc):
+                    return -11, {"error": "no quorum; retry"}
         return 0, {}
 
-    def _cmd_osd_out(self, cmd) -> Tuple[int, Dict[str, Any]]:
+    async def _cmd_osd_out(self, cmd) -> Tuple[int, Dict[str, Any]]:
         osd = int(cmd["osd"])
-        if self.osdmap.is_in(osd):
-            inc = Incremental(epoch=self.osdmap.epoch + 1)
-            inc.new_weight[osd] = 0
-            self._commit(inc)
+        async with self._mutation_lock:
+            if self.osdmap.is_in(osd):
+                inc = Incremental(epoch=self.osdmap.epoch + 1)
+                inc.new_weight[osd] = 0
+                if not await self._commit(inc):
+                    return -11, {"error": "no quorum; retry"}
         return 0, {}
 
-    def _cmd_osd_in(self, cmd) -> Tuple[int, Dict[str, Any]]:
+    async def _cmd_osd_in(self, cmd) -> Tuple[int, Dict[str, Any]]:
         osd = int(cmd["osd"])
-        if not self.osdmap.is_in(osd):
-            inc = Incremental(epoch=self.osdmap.epoch + 1)
-            inc.new_weight[osd] = CEPH_OSD_IN
-            self._commit(inc)
+        async with self._mutation_lock:
+            if not self.osdmap.is_in(osd):
+                inc = Incremental(epoch=self.osdmap.epoch + 1)
+                inc.new_weight[osd] = CEPH_OSD_IN
+                if not await self._commit(inc):
+                    return -11, {"error": "no quorum; retry"}
         return 0, {}
 
-    def _cmd_osd_lost(self, cmd) -> Tuple[int, Dict[str, Any]]:
+    async def _cmd_osd_lost(self, cmd) -> Tuple[int, Dict[str, Any]]:
         """`osd lost <id> --yes-i-really-mean-it`: declare a dead
         OSD's data permanently gone (OSDMonitor.cc `osd lost`).  Marks
         DESTROYED so recovery probes count it as definitively absent —
@@ -485,13 +728,15 @@ class MonDaemon:
         if self.osdmap.is_up(osd):
             return -16, {"error": f"osd.{osd} is up — only a down osd"
                                   " can be declared lost"}
-        if not self.osdmap.is_destroyed(osd):
-            inc = Incremental(epoch=self.osdmap.epoch + 1)
-            inc.new_state[osd] = CEPH_OSD_DESTROYED  # XOR: set
-            self._commit(inc)
+        async with self._mutation_lock:
+            if not self.osdmap.is_destroyed(osd):
+                inc = Incremental(epoch=self.osdmap.epoch + 1)
+                inc.new_state[osd] = CEPH_OSD_DESTROYED  # XOR: set
+                if not await self._commit(inc):
+                    return -11, {"error": "no quorum; retry"}
         return 0, {"epoch": self.osdmap.epoch}
 
-    def _cmd_pg_upmap_items(self, cmd) -> Tuple[int, Dict[str, Any]]:
+    async def _cmd_pg_upmap_items(self, cmd) -> Tuple[int, Dict[str, Any]]:
         """`osd pg-upmap-items <pool.ps> <from> <to> [...]` — the
         balancer's remap primitive (OSDMonitor.cc `osd pg-upmap-items`
         command).  Validates pairs against the live map before
@@ -522,10 +767,12 @@ class MonDaemon:
                                       f" mapping of {cmd['pgid']}"}
         inc = Incremental(epoch=self.osdmap.epoch + 1)
         inc.new_pg_upmap_items[pg] = pairs
-        self._commit(inc)
+        async with self._mutation_lock:
+            if not await self._commit(inc):
+                return -11, {"error": "no quorum; retry"}
         return 0, {"epoch": self.osdmap.epoch}
 
-    def _cmd_rm_pg_upmap_items(self, cmd) -> Tuple[int, Dict[str, Any]]:
+    async def _cmd_rm_pg_upmap_items(self, cmd) -> Tuple[int, Dict[str, Any]]:
         from ceph_tpu.osd.osdmap import PgId
 
         pool_id, ps = cmd["pgid"].split(".")
@@ -534,12 +781,27 @@ class MonDaemon:
             return 0, {}
         inc = Incremental(epoch=self.osdmap.epoch + 1)
         inc.old_pg_upmap_items.append(pg)
-        self._commit(inc)
+        async with self._mutation_lock:
+            if not await self._commit(inc):
+                return -11, {"error": "no quorum; retry"}
         return 0, {"epoch": self.osdmap.epoch}
 
-    def _cmd_status(self, cmd) -> Tuple[int, Dict[str, Any]]:
+    async def _cmd_mon_stat(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        """Quorum observability (`ceph mon stat` role)."""
+        out = {"rank": self.rank, "num_mons": len(self.mon_addrs) or 1,
+               "addrs": self.mon_addrs}
+        if self.elector is not None:
+            out["leader"] = self.elector.leader
+            out["election_epoch"] = self.elector.epoch
+            out["quorum"] = sorted(self.elector.quorum)
+        if self.paxos is not None:
+            out["last_committed"] = self.paxos.last_committed
+            out["lease_valid"] = self.paxos.lease_valid()
+        return 0, out
+
+    async def _cmd_status(self, cmd) -> Tuple[int, Dict[str, Any]]:
         up = self.osdmap.get_up_osds()
-        rc, health = self._cmd_health(cmd)
+        rc, health = await self._cmd_health(cmd)
         return 0, {
             "epoch": self.osdmap.epoch,
             "num_osds": self.osdmap.max_osd,
@@ -552,7 +814,7 @@ class MonDaemon:
             "health": health,
         }
 
-    def _cmd_health(self, cmd) -> Tuple[int, Dict[str, Any]]:
+    async def _cmd_health(self, cmd) -> Tuple[int, Dict[str, Any]]:
         checks: Dict[str, Dict[str, Any]] = {}
         down = [o for o in range(self.osdmap.max_osd)
                 if self.osdmap.exists(o) and self.osdmap.is_down(o)]

@@ -19,6 +19,12 @@ async def _main() -> None:
     ap.add_argument("--num-osds", type=int, required=True)
     ap.add_argument("--osds-per-host", type=int, default=2)
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--rank", type=int, default=0,
+                    help="this mon's rank in the monmap")
+    ap.add_argument("--mon-addrs", type=str, default="",
+                    help="comma-separated monmap (host:port by rank);"
+                         " enables multi-mon quorum.  This mon binds"
+                         " its own rank's port from the list.")
     ap.add_argument("--config", type=str, default="{}",
                     help="JSON mon config overrides")
     ap.add_argument("--store-path", type=str, default="",
@@ -31,9 +37,15 @@ async def _main() -> None:
 
         store = SQLiteDB(args.store_path)
         store.create_and_open()
+    mon_addrs = [a for a in args.mon_addrs.split(",") if a]
+    host, port = "127.0.0.1", args.port
+    if mon_addrs:
+        host, port_s = mon_addrs[args.rank].rsplit(":", 1)
+        port = int(port_s)
     mon = MonDaemon(args.num_osds, osds_per_host=args.osds_per_host,
-                    config=json.loads(args.config), store=store)
-    addr = await mon.start(port=args.port)
+                    config=json.loads(args.config), store=store,
+                    rank=args.rank, mon_addrs=mon_addrs)
+    addr = await mon.start(host=host, port=port)
     print(f"MON_ADDR {addr}", flush=True)
     try:
         await asyncio.Event().wait()  # serve until killed

@@ -409,11 +409,14 @@ class MOSDSubWrite(Message):
     """
 
     TAG = 11
+    VERSION = 2  # v2 appends guard (recovery-push causality token)
+    COMPAT = 1   # v1 peers decode head fields; guard defaults to None
 
     def __init__(self, tid: int, pg: PgId, shard: int, oid: str,
                  ops: List[ShardOp], epoch: int,
                  log_entry: Optional[Dict[str, Any]] = None,
-                 from_osd: int = -1):
+                 from_osd: int = -1,
+                 guard: Optional[tuple] = None):
         self.tid = tid
         self.pg = pg
         self.shard = shard
@@ -422,6 +425,12 @@ class MOSDSubWrite(Message):
         self.epoch = epoch
         self.log_entry = log_entry
         self.from_osd = from_osd
+        # guard: for recovery/repair sub-writes (log_entry=None), the
+        # newest object version the primary's plan OBSERVED when it
+        # adjudicated.  The replica refuses a below-floor install whose
+        # guard predates its current state — that is exactly a stale
+        # (timed-out, still-in-flight) push overtaken by a newer write.
+        self.guard = tuple(guard) if guard is not None else None
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u64(self.tid)
@@ -433,13 +442,21 @@ class MOSDSubWrite(Message):
         enc.optional(self.log_entry,
                      lambda e, v: e.string(json.dumps(v)))
         enc.s32(self.from_osd)
+        enc.optional(self.guard,
+                     lambda e, v: (e.u64(v[0]), e.u64(v[1])))
 
     @classmethod
-    def decode_payload(cls, dec: Decoder) -> "MOSDSubWrite":
-        return cls(dec.u64(), _dec_pg(dec), dec.s32(), dec.string(),
-                   dec.list(ShardOp.decode), dec.u32(),
-                   dec.optional(lambda d: json.loads(d.string())),
-                   dec.s32())
+    def decode(cls, data: bytes) -> "MOSDSubWrite":
+        dec = Decoder(data)
+        struct_v = dec.start(cls.VERSION)
+        msg = cls(dec.u64(), _dec_pg(dec), dec.s32(), dec.string(),
+                  dec.list(ShardOp.decode), dec.u32(),
+                  dec.optional(lambda d: json.loads(d.string())),
+                  dec.s32())
+        if struct_v >= 2:
+            msg.guard = dec.optional(lambda d: (d.u64(), d.u64()))
+        dec.finish()
+        return msg
 
 
 @register
@@ -736,6 +753,125 @@ class MClientReply(Message):
 
     @classmethod
     def decode_payload(cls, dec: Decoder) -> "MClientReply":
+        return cls(dec.u64(), dec.s32(), json.loads(dec.string()))
+
+
+# -- mon quorum (Paxos + elections) -----------------------------------------
+
+
+@register
+class MMonElection(Message):
+    """Election traffic (MMonElection role, src/messages/MMonElection.h):
+    kind PROPOSE/ACK/VICTORY, epoch-numbered, rank-priority."""
+
+    TAG = 23
+
+    def __init__(self, kind: int, epoch: int, rank: int,
+                 quorum: Optional[List[int]] = None):
+        self.kind = kind
+        self.epoch = epoch
+        self.rank = rank
+        self.quorum = quorum or []
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u8(self.kind)
+        enc.u64(self.epoch)
+        enc.s32(self.rank)
+        enc.list(self.quorum, Encoder.s32)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MMonElection":
+        return cls(dec.u8(), dec.u64(), dec.s32(),
+                   dec.list(Decoder.s32))
+
+
+@register
+class MMonPaxos(Message):
+    """Paxos traffic (MMonPaxos role, src/messages/MMonPaxos.h): one
+    message shape for collect/last/begin/accept/commit/lease (+ the
+    pull/full catch-up ops), fields meaningful per op."""
+
+    TAG = 24
+
+    def __init__(self, op: int, pn: int = 0, version: int = 0,
+                 value: bytes = b"", last_committed: int = 0,
+                 first_committed: int = 0,
+                 values: Optional[Dict[int, bytes]] = None,
+                 lease: float = 0.0, uncommitted_pn: int = 0,
+                 from_rank: int = -1):
+        self.op = op
+        self.pn = pn
+        self.version = version
+        self.value = value
+        self.last_committed = last_committed
+        self.first_committed = first_committed
+        self.values = values or {}
+        self.lease = lease
+        self.uncommitted_pn = uncommitted_pn
+        self.from_rank = from_rank
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u8(self.op)
+        enc.u64(self.pn)
+        enc.u64(self.version)
+        enc.bytes(self.value)
+        enc.u64(self.last_committed)
+        enc.u64(self.first_committed)
+        enc.map(self.values, Encoder.u64, Encoder.bytes)
+        enc.f64(self.lease)
+        enc.u64(self.uncommitted_pn)
+        enc.s32(self.from_rank)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MMonPaxos":
+        return cls(dec.u8(), dec.u64(), dec.u64(), dec.bytes(),
+                   dec.u64(), dec.u64(),
+                   dec.map(Decoder.u64, Decoder.bytes), dec.f64(),
+                   dec.u64(), dec.s32())
+
+
+@register
+class MMonForward(Message):
+    """Peon -> leader relay of a client message (MForward role): the
+    inner message rides as (tag, payload); fwd_tid routes the reply
+    back through the peon; fwd_tid 0 = fire-and-forget."""
+
+    TAG = 25
+
+    def __init__(self, fwd_tid: int, inner_tag: int,
+                 inner_payload: bytes):
+        self.fwd_tid = fwd_tid
+        self.inner_tag = inner_tag
+        self.inner_payload = inner_payload
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.fwd_tid)
+        enc.u32(self.inner_tag)
+        enc.bytes(self.inner_payload)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MMonForward":
+        return cls(dec.u64(), dec.u32(), dec.bytes())
+
+
+@register
+class MMonForwardReply(Message):
+    """Leader -> peon reply for a forwarded command."""
+
+    TAG = 26
+
+    def __init__(self, fwd_tid: int, rc: int, out: Dict[str, Any]):
+        self.fwd_tid = fwd_tid
+        self.rc = rc
+        self.out = out
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.fwd_tid)
+        enc.s32(self.rc)
+        enc.string(json.dumps(self.out))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MMonForwardReply":
         return cls(dec.u64(), dec.s32(), json.loads(dec.string()))
 
 

@@ -102,6 +102,13 @@ DEFAULTS = {
     "osd_pool_erasure_code_stripe_unit": 4096,
 }
 
+# client ops whose replay must return the stored reply instead of
+# re-executing (non-idempotent mutations; the reqid dedup scope — the
+# reference tracks reqids for completed writes, PrimaryLogPG log reqids)
+_MUTATING_CLIENT_OPS = frozenset({
+    "write_full", "write", "append", "remove", "setxattr", "rmxattr",
+    "omap_set", "omap_rm", "call"})
+
 # rollback-generation shard object (ECBackend keeps the previous shard
 # generation until a write commits everywhere, so a partial overwrite
 # can never destroy the last completed write's reconstructability —
@@ -233,11 +240,18 @@ class _ObjLockCtx:
 
 
 class OSDDaemon:
-    def __init__(self, osd_id: int, mon_addr: str,
+    def __init__(self, osd_id: int, mon_addr,
                  store: Optional[ObjectStore] = None,
                  config: Optional[Dict[str, Any]] = None):
         self.osd_id = osd_id
-        self.mon_addr = mon_addr
+        # one mon address, a comma-separated list, or a list: the OSD
+        # hunts to the next mon when the current one goes quiet
+        # (MonClient hunting role)
+        if isinstance(mon_addr, str):
+            self.mon_addrs = [a for a in mon_addr.split(",") if a]
+        else:
+            self.mon_addrs = list(mon_addr)
+        self._mon_idx = 0
         self.config = dict(DEFAULTS)
         self.config.update(config or {})
         from ceph_tpu.common.auth import parse_secret
@@ -299,6 +313,16 @@ class OSDDaemon:
         self._admin_socket = None
         self.scrub_stats = {"objects": 0, "errors": 0, "repaired": 0}
 
+    @property
+    def mon_addr(self) -> str:
+        return self.mon_addrs[self._mon_idx % len(self.mon_addrs)]
+
+    def _hunt_mon(self) -> None:
+        stale = self.msgr._conns.get(self.mon_addr)
+        if stale is not None:
+            stale.close()
+        self._mon_idx += 1
+
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
@@ -306,10 +330,17 @@ class OSDDaemon:
             self.store.mkfs()
             self.store.mount()
         addr = await self.msgr.bind(host, port)
-        mon = await self.msgr.connect(self.mon_addr)
-        await mon.send(MGetMap(subscribe=True))
-        await mon.send(MOSDBoot(self.osd_id, addr))
-        # wait until the map marks us up (prepare_boot round trip)
+        for _attempt in range(2 * len(self.mon_addrs)):
+            try:
+                mon = await self.msgr.connect(self.mon_addr)
+                await mon.send(MGetMap(subscribe=True))
+                await mon.send(MOSDBoot(self.osd_id, addr))
+                break
+            except (ConnectionError, OSError):
+                self._hunt_mon()
+                await asyncio.sleep(0.2)
+        # wait until the map marks us up (prepare_boot round trip;
+        # _post_map_epoch keeps re-sending boot if adjudication lags)
         for _ in range(200):
             if self.osdmap is not None and \
                     self.osdmap.is_up(self.osd_id) and \
@@ -706,16 +737,17 @@ class OSDDaemon:
             # answers MGetMap at once, which resets the quiet clock.
             if now - self._last_map_rx > max(5.0, 4 * interval):
                 self._last_map_rx = now
-                stale = self.msgr._conns.get(self.mon_addr)
-                if stale is not None:
-                    stale.close()
+                # hunt: the current mon has gone quiet — a dead mon, a
+                # dead leader behind it, or a silently dropped conn.
+                # Rotating through the monmap finds a serving peer.
+                self._hunt_mon()
                 try:
                     await self.msgr.send_to(
                         self.mon_addr,
                         MGetMap(since_epoch=self.osdmap.epoch,
                                 subscribe=True))
                 except (ConnectionError, OSError):
-                    pass  # mon still down; retry next cycle
+                    pass  # this mon down too; next cycle hunts on
             self.op_tracker.check_slow()
             peers = self._heartbeat_peers()
             # prune state for ex-peers so a later re-add restarts fresh
@@ -862,19 +894,13 @@ class OSDDaemon:
                 else:
                     plog = state.log or PGLog()
                     state.log = plog
-                # ordering guard for CLIENT writes only (they carry a
-                # log entry): recovery/repair installs may legitimately
-                # install an OLDER authoritative version (divergent
-                # rewind, rollback reinstall) and must not be refused
-                incoming = self._sub_write_version(msg) \
-                    if msg.log_entry is not None else None
-                if incoming is not None:
-                    # version floor = newer of (stored OI, newest PG
-                    # log entry for this object).  The log term is
-                    # load-bearing after a DELETE: the remove erases
-                    # the object's own version history, and without it
-                    # a straggler sub-write of an older write would
-                    # silently RESURRECT the deleted object.
+                # version floor = newer of (stored OI, newest PG
+                # log entry for this object).  The log term is
+                # load-bearing after a DELETE: the remove erases
+                # the object's own version history, and without it
+                # a straggler sub-write of an older write would
+                # silently RESURRECT the deleted object.
+                def current_floor() -> Optional[tuple]:
                     floor = self._oi_version(
                         self._read_shard(msg.pg, msg.shard, msg.oid,
                                          0, 1)[2])
@@ -884,7 +910,15 @@ class OSDDaemon:
                             if floor is None or lv > floor:
                                 floor = lv
                             break
-                    if floor is not None and incoming < floor:
+                    return floor
+
+                if msg.log_entry is not None:
+                    # CLIENT write ordering guard
+                    incoming = self._sub_write_version(msg)
+                    floor = current_floor() \
+                        if incoming is not None else None
+                    if incoming is not None and floor is not None \
+                            and incoming < floor:
                         # a late straggler that already lost the race:
                         # the newer state supersedes it — ack without
                         # applying (idempotent-outcome discipline).
@@ -892,6 +926,35 @@ class OSDDaemon:
                         # wedged on a dead peer must never park this
                         # (shard, object)'s write lock.
                         raise _SkipApply()
+                elif msg.oid not in plog.missing:
+                    # RECOVERY/REPAIR sub-write (no log entry) to an
+                    # object this shard is NOT missing.  Legitimate
+                    # below-floor installs (divergent rewind, rollback
+                    # reinstall) always target objects in the missing
+                    # set; outside it, a below-floor install is a stale
+                    # push — one that timed out at the primary, stayed
+                    # in flight, and was overtaken by a newer client
+                    # write — and applying it would silently roll this
+                    # copy back under a current-looking PG log.  The
+                    # guard token decides: the push applies only if the
+                    # plan OBSERVED (adjudicated over) this shard's
+                    # current state.  Covers removes too: a stale
+                    # rollback-purge remove must not destroy an object
+                    # a client has since recreated.
+                    floor = current_floor()
+                    if floor is not None:
+                        rec_v = self._sub_write_version(msg)
+                        observed = msg.guard is not None and \
+                            msg.guard >= floor
+                        if rec_v is not None:
+                            if rec_v < floor and not observed:
+                                raise _SkipApply()
+                        elif any(op.op == "remove" for op in msg.ops):
+                            # includes rollback trims: guard=prior keeps
+                            # a stale trim from eating the FRESH clone a
+                            # later write just preserved
+                            if not observed:
+                                raise _SkipApply()
                 t = Transaction()
                 self._apply_shard_ops(
                     t, cid, msg.oid, msg.ops,
@@ -1819,7 +1882,7 @@ class OSDDaemon:
                                 tid, pg, shard, oid,
                                 [ShardOp("remove")],
                                 state.interval_epoch, None,
-                                self.osd_id), tid)
+                                self.osd_id, guard=del_version), tid)
                         log.info("osd.%d: scrub purged deleted"
                                  " straggler %s/%s on osd.%d",
                                  self.osd_id, pg, oid, osd)
@@ -1854,6 +1917,7 @@ class OSDDaemon:
                 continue
             targets.append((idx if pool.type == TYPE_ERASURE
                             else -(idx + 2), osd))
+        guard = self._plan_guard(candidates)
         if pool.type == TYPE_REPLICATED:
             version, chosen, _oi = self._select_consistent(
                 candidates, need=1)
@@ -1861,6 +1925,7 @@ class OSDDaemon:
                 return False
             plan = {"kind": "replicated", "oid": oid,
                     "targets": targets, "i_need": True,
+                    "guard": guard,
                     "payload": {-1: chosen[next(iter(chosen))]},
                     "attrs": attrs_of(version, chosen),
                     "omap": await self._fetch_omap_any(
@@ -1874,7 +1939,7 @@ class OSDDaemon:
                 return False  # genuinely below k: recovery/rollback
                 # adjudication owns this on the next peering
             plan = {"kind": "ec", "oid": oid, "targets": targets,
-                    "i_need": True,
+                    "i_need": True, "guard": guard,
                     "chosen": {s: chosen[s]
                                for s in sorted(chosen)[:k]},
                     "attrs": attrs_of(version, chosen), "omap": None}
@@ -1894,13 +1959,7 @@ class OSDDaemon:
         the PG head's last_update — recovery's need_v guard compares
         against this, and an inflated version makes the located,
         correct copy look too old to install), reconstruct + push."""
-        peer_shards: Dict[int, int] = {}
-        for idx, osd in enumerate(state.acting):
-            if osd == CRUSH_ITEM_NONE or osd == self.osd_id or \
-                    not self.osdmap.is_up(osd):
-                continue
-            shard_key = idx if pool.type == TYPE_ERASURE else -(idx + 2)
-            peer_shards[shard_key] = osd
+        peer_shards = self._acting_peer_shards(state, pool)
         plog = self._load_log(state, pool)
         my_cid = self._cid(state.pg,
                            state.my_shard(self.osd_id, pool.type))
@@ -1960,44 +2019,73 @@ class OSDDaemon:
         WAVE = 64
         for lo in range(0, len(order), WAVE):
             wave = order[lo:lo + WAVE]
-            results = await asyncio.gather(
-                *(self.scheduler.run(
-                    sched_mod.RECOVERY, 1.0,
-                    lambda oid=oid: self._recover_plan(
-                        state, pool, oid, peer_shards))
-                  for oid in wave),
-                return_exceptions=True)
-            plans = []
-            for oid, plan in zip(wave, results):
-                if isinstance(plan, Exception):
-                    # an unrecoverable object stays missing; the next
-                    # interval retries
-                    log.error(
-                        "osd.%d: recovery plan of %s/%s failed",
-                        self.osd_id, pg, oid, exc_info=plan)
-                    continue
-                if isinstance(plan, BaseException):  # Cancelled etc.
-                    raise plan
-                if plan is not None:
-                    plans.append(plan)
-            reconstructed = self._batch_reconstruct(
-                pool, [p for p in plans if p["kind"] == "ec"])
-            plans = [p for p in plans
-                     if p["kind"] != "ec" or p in reconstructed]
-            commits = await asyncio.gather(
-                *(self.scheduler.run(
-                    sched_mod.RECOVERY, 1.0,
-                    lambda plan=plan: self._recover_commit(
-                        state, pool, plan))
-                  for plan in plans),
-                return_exceptions=True)
-            for plan, res in zip(plans, commits):
-                if isinstance(res, Exception):
-                    log.error(
-                        "osd.%d: recovery commit of %s/%s failed",
-                        self.osd_id, pg, plan["oid"], exc_info=res)
-                elif isinstance(res, BaseException):
-                    raise res
+            # each object's lock is held from plan through commit:
+            # client writes to an object being recovered wait (and vice
+            # versa), so a push selected at version v can never be
+            # overtaken by a concurrent write at v+1 on the primary
+            # (the wait_for_degraded_object serialization; the replica-
+            # side guard token covers the timed-out-push-in-flight case)
+            #
+            # LOCK/SLOT DISCIPLINE: client ops wait for obj locks while
+            # INSIDE bounded scheduler slots, so a lock holder must
+            # never wait on a slot grant — blocked clients would pin
+            # every slot and wedge the grant loop.  QoS pacing for
+            # recovery therefore uses a pacing token (a slot acquired
+            # and released BEFORE touching any lock); plan and commit
+            # themselves run outside the scheduler.
+            held: Dict[str, Any] = {}
+
+            async def _noop():
+                return None
+
+            async def plan_locked(oid: str):
+                await self.scheduler.run(sched_mod.RECOVERY, 1.0, _noop)
+                ctx = state.obj_lock(oid)
+                await ctx.__aenter__()
+                held[oid] = ctx
+                return await self._recover_plan(
+                    state, pool, oid, peer_shards)
+
+            try:
+                results = await asyncio.gather(
+                    *(plan_locked(oid) for oid in wave),
+                    return_exceptions=True)
+                plans = []
+                for oid, plan in zip(wave, results):
+                    if isinstance(plan, Exception):
+                        # an unrecoverable object stays missing; the
+                        # next interval retries
+                        log.error(
+                            "osd.%d: recovery plan of %s/%s failed",
+                            self.osd_id, pg, oid, exc_info=plan)
+                        continue
+                    if isinstance(plan, BaseException):  # Cancelled
+                        raise plan
+                    if plan is not None:
+                        plans.append(plan)
+                reconstructed = self._batch_reconstruct(
+                    pool, [p for p in plans if p["kind"] == "ec"])
+                plans = [p for p in plans
+                         if p["kind"] != "ec" or p in reconstructed]
+                # commits run OUTSIDE the QoS scheduler: object locks
+                # are held here, and client ops blocked on those locks
+                # sit inside scheduler slots — commits queued behind
+                # them would deadlock the slot pool.  The wave is
+                # already QoS-paced by its plan phase.
+                commits = await asyncio.gather(
+                    *(self._recover_commit(state, pool, plan)
+                      for plan in plans),
+                    return_exceptions=True)
+                for plan, res in zip(plans, commits):
+                    if isinstance(res, Exception):
+                        log.error(
+                            "osd.%d: recovery commit of %s/%s failed",
+                            self.osd_id, pg, plan["oid"], exc_info=res)
+                    elif isinstance(res, BaseException):
+                        raise res
+            finally:
+                for ctx in held.values():
+                    await ctx.__aexit__(None, None, None)
         # persist whatever missing state remains
         cid = self._cid(pg, my_shard)
         t = Transaction()
@@ -2008,8 +2096,11 @@ class OSDDaemon:
 
     async def _recover_object(self, state: PGState, pool, oid: str,
                               peer_shards: Dict[int, int]) -> None:
-        """Single-object recovery (scrub repair's entry point): plan,
-        reconstruct, commit — the unbatched form of _recover_pg."""
+        """Single-object recovery (scrub repair's and
+        wait_for_degraded's entry point): plan, reconstruct, commit —
+        the unbatched form of _recover_pg.  CONTRACT: the caller holds
+        state.obj_lock(oid) (every current caller does), which is what
+        serializes this install against concurrent client writes."""
         plan = await self._recover_plan(state, pool, oid, peer_shards)
         if plan is None:
             return
@@ -2055,6 +2146,11 @@ class OSDDaemon:
             nv = state.peer_missing.get(shard_key, {}).get(oid) or ZERO
             if nv > need_v:
                 need_v = nv
+        # causality token for the pushes: the newest version this plan
+        # OBSERVED anywhere.  A replica whose state moved past this
+        # after the plan was made (a newer client write landed) refuses
+        # the push — that push is by definition stale.
+        guard = self._plan_guard(candidates, need_v)
 
         if not candidates:
             if not probes_complete:
@@ -2074,7 +2170,7 @@ class OSDDaemon:
             # object does not exist at any authoritative source: the
             # divergent entry was a create nobody kept — remove it
             return {"kind": "remove", "oid": oid, "targets": targets,
-                    "i_need": i_need}
+                    "i_need": i_need, "guard": guard}
 
         def _attrs_of(version, chosen) -> Dict[str, bytes]:
             src = next(iter(chosen))
@@ -2096,6 +2192,7 @@ class OSDDaemon:
                 return None
             return {"kind": "replicated", "oid": oid,
                     "targets": targets, "i_need": i_need,
+                    "guard": guard,
                     "payload": {-1: chosen[next(iter(chosen))]},
                     "attrs": _attrs_of(version, chosen),
                     "omap": await self._fetch_omap_any(
@@ -2150,7 +2247,7 @@ class OSDDaemon:
                        for (shard, osd), (cands, _ok)
                        in zip(probes, results) if cands]
             return {"kind": "remove", "oid": oid, "targets": targets,
-                    "i_need": i_need, "purge": True,
+                    "i_need": i_need, "purge": True, "guard": guard,
                     "purge_locations": holders}
         if not probes_complete and need_v > version:
             log.warning(
@@ -2162,7 +2259,7 @@ class OSDDaemon:
         # equal survivor sets batch together
         chosen_k = {s: chosen[s] for s in sorted(chosen)[:k]}
         return {"kind": "ec", "oid": oid, "targets": targets,
-                "i_need": i_need, "chosen": chosen_k,
+                "i_need": i_need, "chosen": chosen_k, "guard": guard,
                 "attrs": _attrs_of(version, chosen), "omap": None}
 
     def _batch_reconstruct(self, pool,
@@ -2244,6 +2341,21 @@ class OSDDaemon:
             done = done2
         return done
 
+    def _plan_guard(self, candidates, *extra) -> tuple:
+        """Newest object version a recovery plan observed: max over the
+        probed candidates' OI versions and any extra versions (need_v,
+        adjudicated version).  Stamped on the plan's pushes so replicas
+        can refuse pushes that predate their current state."""
+        guard = ZERO
+        for v in extra:
+            if v is not None and v > guard:
+                guard = v
+        for _s, _p, at in candidates:
+            v = self._oi_version(at)
+            if v is not None and v > guard:
+                guard = v
+        return guard
+
     async def _recover_commit(self, state: PGState, pool,
                               plan: Dict[str, Any]) -> None:
         """Apply one plan: remove everywhere, or install the
@@ -2262,11 +2374,26 @@ class OSDDaemon:
                 # recovery ops carry the INTERVAL epoch: a live-epoch
                 # stamp would raise replica fences above this interval
                 # and fence out every subsequent client write
-                await self._request(
+                reply = await self._request(
                     osd, MOSDSubWrite(tid, pg, shard, oid,
                                       [ShardOp("remove")],
                                       state.interval_epoch, None,
-                                      self.osd_id), tid)
+                                      self.osd_id,
+                                      guard=plan.get("guard")), tid)
+                # the remove RESOLVES the missing entry: the rollback
+                # adjudicated "object does not exist" as the recovered
+                # state.  Leaving peer_missing populated would re-plan
+                # the same remove from the unfound-retry loop forever —
+                # the silent livelock that parked k2m2 thrash runs with
+                # an active+unfound PG and an empty log.
+                if reply is None or reply.rc != 0:
+                    log.warning(
+                        "osd.%d: recovery remove of %s/%s on osd.%d"
+                        " failed (%s)", self.osd_id, pg, oid, osd,
+                        "timeout" if reply is None else reply.rc)
+                    return
+                if shard_key in state.peer_missing:
+                    state.peer_missing[shard_key].pop(oid, None)
 
             removals = list(targets)
             if plan.get("purge"):
@@ -2335,7 +2462,8 @@ class OSDDaemon:
                 reply = await self._request(
                     osd, MOSDSubWrite(tid, pg, shard, oid, ops,
                                       state.interval_epoch, None,
-                                      self.osd_id), tid)
+                                      self.osd_id,
+                                      guard=plan.get("guard")), tid)
                 if reply is None or reply.rc != 0:
                     # the push did NOT land: leave this target in
                     # peer_missing so the next interval retries it
@@ -2426,9 +2554,16 @@ class OSDDaemon:
             except Exception:
                 log.exception("osd.%d: op %r failed", self.osd_id, msg)
                 rc, data, out = EIO, b"", {}
-            if rc != EAGAIN:
-                # EAGAIN replies commit nothing: the resend must
-                # actually execute
+            # dedup-cache replies of non-idempotent MUTATING ops only
+            # (the reference tracks reqids for completed writes alone):
+            # read-only replays are idempotent, and caching their
+            # payloads would pin up to 4096 objects' data in memory.
+            # Mutating errors ARE cached — an op vector can partially
+            # commit before the failing op (e.g. append ok, omap EIO),
+            # so re-executing the resend would double-apply the prefix.
+            # EAGAIN alone commits nothing and must re-execute.
+            if rc != EAGAIN and any(op.op in _MUTATING_CLIENT_OPS
+                                    for op in msg.ops):
                 self._completed_ops[reqid] = (rc, data, out)
                 while len(self._completed_ops) > 4096:
                     self._completed_ops.popitem(last=False)
@@ -2622,7 +2757,8 @@ class OSDDaemon:
             # (not fire-and-forget) so a sequential client's NEXT
             # overwrite — which clones a fresh rollback — cannot race
             # with this trim and lose its clone.
-            await self._trim_rollbacks(state, oid, targets, admit_epoch)
+            await self._trim_rollbacks(state, oid, targets, admit_epoch,
+                                       prior=ev(entry["prior"]))
         elif acked < full:
             # a shard missed the write WITHOUT an interval change (an
             # alive-but-slow peer timed out).  The reference's
@@ -2674,8 +2810,15 @@ class OSDDaemon:
 
     async def _trim_rollbacks(self, state: PGState, oid: str,
                               targets: List[Tuple[int, int]],
-                              epoch: int) -> None:
-        """Best-effort removal of each shard's rollback clone."""
+                              epoch: int,
+                              prior: Optional[tuple] = None) -> None:
+        """Best-effort removal of each shard's rollback clone.
+
+        guard=prior (the committed entry's previous generation): the
+        clone this trim targets captured exactly that generation, so a
+        trim that outlives its write — times out, stays in flight, and
+        lands after a LATER write preserved a fresh clone — fails the
+        replica's guard check instead of eating the fresh clone."""
         pg = state.pg
         rb = RB_PREFIX + oid
         pending = []
@@ -2691,7 +2834,8 @@ class OSDDaemon:
                     pending.append(self._request(
                         osd, MOSDSubWrite(tid, pg, shard, rb,
                                           [ShardOp("remove")],
-                                          epoch, None, self.osd_id),
+                                          epoch, None, self.osd_id,
+                                          guard=prior),
                         tid))
             except (KeyError, ConnectionError, OSError):
                 pass  # a stale clone is only garbage
@@ -2780,6 +2924,7 @@ class OSDDaemon:
         append=True resolves the offset to the current object end
         INSIDE the lock so concurrent appends serialize correctly."""
         async with state.obj_lock(oid):
+            await self._wait_for_degraded(state, pool, oid)
             if append:
                 oi, _ss = await self._head_info(state, pool, oid)
                 offset = oi.get("size", 0) \
@@ -2963,6 +3108,40 @@ class OSDDaemon:
         if oid in plog.missing:
             return False
         return not any(oid in m for m in state.peer_missing.values())
+
+    async def _wait_for_degraded(self, state: PGState, pool,
+                                 oid: str) -> None:
+        """wait_for_degraded_object role (PrimaryLogPG.cc): a PARTIAL
+        mutation (extent write, EC RMW, xattr, omap) on an object some
+        acting member is missing must not proceed — on the missing
+        replica it would create a hole-ridden partial object under a
+        current-looking version.  Recover the object inline first
+        (caller holds the object lock, so background recovery of this
+        object cannot interleave); if it stays missing, the data is
+        unfound and the op blocks (EAGAIN) rather than inventing state.
+
+        Full-object overwrites (write_full, remove) do NOT come here:
+        they supersede every shard's content and double as recovery-by-
+        overwrite."""
+        if self._pg_is_clean(state, pool, oid):
+            return
+        await self._recover_object(state, pool, oid,
+                                   self._acting_peer_shards(state, pool))
+        if not self._pg_is_clean(state, pool, oid):
+            raise UnfoundObject(oid)
+
+    def _acting_peer_shards(self, state: PGState, pool
+                            ) -> Dict[int, int]:
+        """shard_key -> osd for every UP acting member except me (EC:
+        positional shard; replicated: unique -(idx+2) key per replica)."""
+        peer_shards: Dict[int, int] = {}
+        for idx, osd in enumerate(state.acting):
+            if osd == CRUSH_ITEM_NONE or osd == self.osd_id or \
+                    not self.osdmap.is_up(osd):
+                continue
+            shard_key = idx if pool.type == TYPE_ERASURE else -(idx + 2)
+            peer_shards[shard_key] = osd
+        return peer_shards
 
     def _block_if_unfound(self, state: PGState, pool, oid: str) -> None:
         """Called when an op could not locate/decode an object's data:
@@ -3198,6 +3377,7 @@ class OSDDaemon:
         versioned write on every shard (attrs are object metadata and
         ride with the object through snapshots and recovery)."""
         async with state.obj_lock(oid):
+            await self._wait_for_degraded(state, pool, oid)
             oi, _ss = await self._head_info(state, pool, oid)
             if oi is None or oi.get("whiteout"):
                 return ENOENT
@@ -3279,6 +3459,7 @@ class OSDDaemon:
         if pool.type == TYPE_ERASURE:
             return -95  # EOPNOTSUPP
         async with state.obj_lock(oid):
+            await self._wait_for_degraded(state, pool, oid)
             oi, _ss = await self._head_info(state, pool, oid)
             size = oi.get("size", 0) \
                 if oi is not None and not oi.get("whiteout") else 0

@@ -54,10 +54,16 @@ class ObjectNotFound(RadosError):
 
 
 class RadosClient:
-    def __init__(self, mon_addr: str, name: Optional[str] = None,
+    def __init__(self, mon_addr, name: Optional[str] = None,
                  op_timeout: float = 10.0, max_retries: int = 30,
                  secret: Optional[str] = None):
-        self.mon_addr = mon_addr
+        # mon_addr: one address, a comma-separated list, or a list —
+        # the client hunts across them on failure (MonClient hunting)
+        if isinstance(mon_addr, str):
+            self.mon_addrs = [a for a in mon_addr.split(",") if a]
+        else:
+            self.mon_addrs = list(mon_addr)
+        self._mon_idx = 0
         if name is None:
             # entity names must be GLOBALLY unique: the OSDs' reqid
             # dedup cache keys on (client name, tid), and two clients
@@ -107,17 +113,37 @@ class RadosClient:
             await asyncio.sleep(3.0)
             await self._reregister_watches()
 
+    @property
+    def mon_addr(self) -> str:
+        return self.mon_addrs[self._mon_idx % len(self.mon_addrs)]
+
+    def _hunt_mon(self) -> None:
+        """Rotate to the next mon in the monmap after a failure."""
+        stale = self.msgr._conns.get(self.mon_addr)
+        if stale is not None:
+            stale.close()
+        self._mon_idx += 1
+
     # -- lifecycle ---------------------------------------------------------
 
     async def connect(self) -> None:
         await self.msgr.bind()
-        mon = await self.msgr.connect(self.mon_addr)
-        await mon.send(MGetMap(subscribe=True))
-        for _ in range(500):
-            if self.osdmap is not None:
-                return
-            await asyncio.sleep(0.01)
-        raise TimeoutError("no osdmap from mon")
+        last: Optional[Exception] = None
+        for _attempt in range(3 * len(self.mon_addrs)):
+            try:
+                mon = await self.msgr.connect(self.mon_addr)
+                await mon.send(MGetMap(subscribe=True))
+            except (ConnectionError, OSError) as e:
+                last = e
+                self._hunt_mon()
+                await asyncio.sleep(0.2)
+                continue
+            for _ in range(500):
+                if self.osdmap is not None:
+                    return
+                await asyncio.sleep(0.01)
+            self._hunt_mon()
+        raise TimeoutError(f"no osdmap from any mon ({last!r})")
 
     async def shutdown(self) -> None:
         if self._watch_keepalive is not None:
@@ -233,7 +259,7 @@ class RadosClient:
                           ) -> Tuple[int, Dict[str, Any]]:
         last: Optional[Exception] = None
         resubscribe = False
-        for attempt in range(4):
+        for attempt in range(max(4, 3 * len(self.mon_addrs))):
             tid = self._next_tid()
             fut: asyncio.Future = \
                 asyncio.get_running_loop().create_future()
@@ -248,16 +274,20 @@ class RadosClient:
                     resubscribe = False
                 await mon.send(MMonCommand(tid, cmd))
                 reply = await asyncio.wait_for(fut, self.op_timeout)
+                if reply.rc == -11 and "quorum" in str(
+                        reply.out.get("error", "")):
+                    # election in progress: wait it out and retry
+                    last = RadosError(-11, str(reply.out))
+                    await asyncio.sleep(0.4 * (attempt + 1))
+                    continue
                 return reply.rc, reply.out
             except (asyncio.TimeoutError, ConnectionError,
                     OSError) as e:
-                # a restarted mon leaves a stale cached connection that
-                # may not have seen EOF yet: drop it and retry fresh
-                # after a beat (a restarting mon needs a moment to bind)
+                # a restarted/dead mon leaves a stale cached connection
+                # that may not have seen EOF yet: drop it, hunt to the
+                # next mon in the monmap, retry after a beat
                 last = e
-                mon = self.msgr._conns.get(self.mon_addr)
-                if mon is not None:
-                    mon.close()
+                self._hunt_mon()
                 resubscribe = True
                 await asyncio.sleep(0.3 * (attempt + 1))
             finally:

@@ -43,9 +43,11 @@ class Cluster:
                  osd_config: Optional[dict] = None,
                  mon_config: Optional[dict] = None,
                  store_factory=None,
-                 client_secret: Optional[str] = None):
+                 client_secret: Optional[str] = None,
+                 num_mons: int = 1):
         self.num_osds = num_osds
         self.osds_per_host = osds_per_host
+        self.num_mons = num_mons
         self.osd_config = dict(FAST_CONFIG)
         if num_osds > 8:
             # one shared event loop: scale grace with daemon count so
@@ -57,28 +59,57 @@ class Cluster:
         self.mon_config.update(mon_config or {})
         self.store_factory = store_factory or (lambda osd_id: MemStore())
         self.client_secret = client_secret
-        self.mon: Optional[MonDaemon] = None
+        self.mons: Dict[int, MonDaemon] = {}
+        self.mon_addrs: List[str] = []
         self.osds: Dict[int, OSDDaemon] = {}
         self.stores: Dict[int, object] = {}
         self.client: Optional[RadosClient] = None
 
+    @property
+    def mon(self) -> Optional[MonDaemon]:
+        """The current quorum leader (falls back to any live mon) —
+        the handle tests use for map/adjudication assertions."""
+        live = [m for m in self.mons.values()]
+        if not live:
+            return None
+        for m in live:
+            if m.is_leader():
+                return m
+        return live[0]
+
     async def start(self) -> None:
-        self.mon = MonDaemon(self.num_osds,
-                             osds_per_host=self.osds_per_host,
-                             config=self.mon_config)
-        await self.mon.start()
+        for rank in range(self.num_mons):
+            mon = MonDaemon(self.num_osds,
+                            osds_per_host=self.osds_per_host,
+                            config=self.mon_config, rank=rank)
+            self.mons[rank] = mon
+        # two-phase: bind all, then install the monmap + elections
+        self.mon_addrs = [await m.start() for m in self.mons.values()]
+        if self.num_mons > 1:
+            for m in self.mons.values():
+                await m.set_peers(self.mon_addrs)
+            await self.wait_for_quorum()
         for osd_id in range(self.num_osds):
             store = self.store_factory(osd_id)
             store.mkfs()
             store.mount()
             self.stores[osd_id] = store
             await self._boot_osd(osd_id)
-        self.client = RadosClient(self.mon.addr,
+        self.client = RadosClient(self.mon_addrs,
                                   secret=self.client_secret)
         await self.client.connect()
 
+    async def wait_for_quorum(self, timeout: float = 15.0) -> None:
+        def _quorum() -> bool:
+            leaders = {m.elector.leader for m in self.mons.values()
+                       if m.elector is not None
+                       and not m.elector.electing}
+            return len(leaders) == 1 and None not in leaders
+
+        await self._wait(_quorum, timeout, "mons never formed a quorum")
+
     async def _boot_osd(self, osd_id: int) -> None:
-        osd = OSDDaemon(osd_id, self.mon.addr,
+        osd = OSDDaemon(osd_id, self.mon_addrs,
                         store=self.stores[osd_id],
                         config=self.osd_config)
         self.osds[osd_id] = osd
@@ -94,8 +125,35 @@ class Cluster:
                 store.umount()
             except Exception:
                 pass
-        if self.mon is not None:
-            await self.mon.shutdown()
+        for mon in self.mons.values():
+            await mon.shutdown()
+
+    # -- mon failure injection (thrash the control plane) ------------------
+
+    async def kill_mon(self, rank: int) -> None:
+        """Drop a mon off the network without clean shutdown."""
+        mon = self.mons.pop(rank)
+        await mon.msgr.shutdown()
+        if mon._check_task is not None:
+            mon._check_task.cancel()
+        if mon._lease_watch_task is not None:
+            mon._lease_watch_task.cancel()
+        if mon.elector is not None:
+            mon.elector.shutdown()
+        if mon.paxos is not None:
+            mon.paxos.shutdown()
+
+    async def revive_mon(self, rank: int) -> None:
+        """Boot a fresh mon at the dead rank's address; it rejoins the
+        quorum and catches up via collect/OP_FULL."""
+        assert rank not in self.mons
+        host, port = self.mon_addrs[rank].rsplit(":", 1)
+        mon = MonDaemon(self.num_osds,
+                        osds_per_host=self.osds_per_host,
+                        config=self.mon_config, rank=rank)
+        self.mons[rank] = mon
+        await mon.start(host=host, port=int(port))
+        await mon.set_peers(self.mon_addrs)
 
     # -- failure injection (thrashosds kill_osd/revive_osd role) -----------
 

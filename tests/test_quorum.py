@@ -1,0 +1,230 @@
+"""Multi-monitor quorum tier: elections, Paxos replication, leader
+failover, peon forwarding, catch-up.
+
+Mirrors the reference's mon thrasher / paxos unit coverage
+(/root/reference/src/test/mon/test_election.cc, qa mon_thrash role):
+map mutations must survive the loss of any minority of mons, including
+the leader mid-stream, and a rejoining mon must converge.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.rados.client import RadosClient
+
+from cluster_helpers import Cluster
+
+FAST_QUORUM = {
+    "mon_lease": 0.8,
+    "mon_election_timeout": 1.0,
+    "mon_accept_timeout": 1.5,
+}
+
+
+def quorum_cluster(num_osds=4, **kw):
+    return Cluster(num_osds=num_osds, osds_per_host=1, num_mons=3,
+                   mon_config=dict(FAST_QUORUM), **kw)
+
+
+def test_election_lowest_rank_wins():
+    async def run():
+        cluster = quorum_cluster(num_osds=2)
+        await cluster.start()
+        try:
+            leaders = {m.elector.leader
+                       for m in cluster.mons.values()}
+            assert leaders == {0}, leaders
+            assert cluster.mons[0].is_leader()
+            assert not cluster.mons[1].is_leader()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 60))
+
+
+def test_mutations_replicate_to_all_mons():
+    async def run():
+        cluster = quorum_cluster(num_osds=3)
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool(
+                "qpool", size=2, pg_num=4)
+            # commit is majority-durable; peons may apply a beat later
+            for _ in range(100):
+                if all(m.osdmap.lookup_pool("qpool") >= 0
+                       for m in cluster.mons.values()):
+                    break
+                await asyncio.sleep(0.05)
+            epochs = {m.osdmap.epoch for m in cluster.mons.values()}
+            assert len(epochs) == 1, epochs
+            lcs = {m.paxos.last_committed
+                   for m in cluster.mons.values()}
+            assert len(lcs) == 1, lcs
+            for m in cluster.mons.values():
+                assert m.osdmap.lookup_pool("qpool") >= 0
+        finally:
+            await cluster.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 60))
+
+
+def test_command_via_peon_is_forwarded():
+    async def run():
+        cluster = quorum_cluster(num_osds=2)
+        await cluster.start()
+        try:
+            # a client connected ONLY to a peon still mutates the map
+            peon = RadosClient([cluster.mon_addrs[2]])
+            await peon.connect()
+            try:
+                rc, out = await peon.mon_command(
+                    {"prefix": "osd pool create", "name": "fwd",
+                     "pg_num": 4, "pool_type": "replicated",
+                     "size": 2})
+                assert rc == 0, out
+                rc, out = await peon.mon_command({"prefix": "mon stat"})
+                assert rc == 0
+                assert out["leader"] == 0
+                assert sorted(out["quorum"]) == [0, 1, 2]
+            finally:
+                await peon.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 60))
+
+
+def test_leader_kill_fails_over_and_serves():
+    async def run():
+        cluster = quorum_cluster(num_osds=3)
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool(
+                "fail", size=2, pg_num=4)
+            ioctx = cluster.client.open_ioctx("fail")
+            await ioctx.write_full("before", b"x" * 4096)
+            await cluster.kill_mon(0)
+            # surviving 2-of-3 elect a new leader and keep serving
+            await cluster.wait_for_quorum(timeout=20.0)
+            assert cluster.mon.rank in (1, 2)
+            rc, out = await cluster.client.mon_command(
+                {"prefix": "status"})
+            assert rc == 0
+            # map mutations still commit on the 2-mon majority
+            rc, out = await cluster.client.mon_command(
+                {"prefix": "osd pool create", "name": "after",
+                 "pg_num": 4, "pool_type": "replicated", "size": 2})
+            assert rc == 0, out
+            # and the data plane still works end to end
+            await ioctx.write_full("after-failover", b"y" * 8192)
+            assert await ioctx.read("before") == b"x" * 4096
+            assert await ioctx.read("after-failover") == b"y" * 8192
+        finally:
+            await cluster.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 120))
+
+
+def test_peon_kill_quorum_continues():
+    async def run():
+        cluster = quorum_cluster(num_osds=2)
+        await cluster.start()
+        try:
+            await cluster.kill_mon(2)
+            rc, out = await cluster.client.mon_command(
+                {"prefix": "osd pool create", "name": "p2",
+                 "pg_num": 4, "pool_type": "replicated", "size": 2})
+            assert rc == 0, out
+            assert cluster.mons[0].is_leader()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 60))
+
+
+def test_rejoining_mon_catches_up():
+    async def run():
+        cluster = quorum_cluster(num_osds=2)
+        await cluster.start()
+        try:
+            await cluster.kill_mon(2)
+            for i in range(5):
+                rc, _ = await cluster.client.mon_command(
+                    {"prefix": "osd pool create", "name": f"cu{i}",
+                     "pg_num": 4, "pool_type": "replicated",
+                     "size": 2})
+                assert rc == 0
+            lead_lc = cluster.mons[0].paxos.last_committed
+            await cluster.revive_mon(2)
+            for _ in range(200):
+                m2 = cluster.mons[2]
+                if m2.paxos is not None and \
+                        m2.paxos.last_committed >= lead_lc:
+                    break
+                await asyncio.sleep(0.05)
+            m2 = cluster.mons[2]
+            assert m2.paxos.last_committed >= lead_lc
+            assert m2.osdmap.lookup_pool("cu4") >= 0
+        finally:
+            await cluster.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 90))
+
+
+@pytest.mark.slow
+def test_leader_kill_mid_write_load():
+    """The mon-thrash shape: kill the LEADER while a write workload
+    runs; no acked write may be lost and the cluster must go clean."""
+
+    async def run():
+        cluster = quorum_cluster(num_osds=4)
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool(
+                "load", size=3, pg_num=8)
+            ioctx = cluster.client.open_ioctx("load")
+            acked = {}
+            maybe: dict = {}  # indeterminate attempts since last ack
+
+            async def workload():
+                seq = 0
+                while True:
+                    seq += 1
+                    oid = f"o-{seq % 12}"
+                    data = bytes([seq % 256]) * (1000 + seq % 5000)
+                    # record BEFORE submitting: a timed-out attempt may
+                    # still commit (RadosModel indeterminacy rule)
+                    maybe.setdefault(oid, []).append(data)
+                    try:
+                        await ioctx.write_full(oid, data)
+                        acked[oid] = data
+                        maybe[oid] = []
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0)
+
+            task = asyncio.get_running_loop().create_task(workload())
+            try:
+                await asyncio.sleep(2.0)
+                await cluster.kill_mon(0)   # leader, mid-write
+                await cluster.wait_for_quorum(timeout=20.0)
+                await asyncio.sleep(3.0)    # writes continue post-failover
+            finally:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+            assert len(acked) >= 5
+            await cluster.wait_for_clean(timeout=60.0)
+            for oid, data in acked.items():
+                got = await ioctx.read(oid)
+                legal = [data] + maybe.get(oid, [])
+                assert any(got == want for want in legal), \
+                    f"{oid}: read {got[:8]!r}x{len(got)} matches " \
+                    f"neither ack nor {len(legal) - 1} attempts"
+        finally:
+            await cluster.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 180))

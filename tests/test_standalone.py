@@ -334,3 +334,95 @@ def test_multiprocess_thrash_sigkill_under_load(tmp_path):
             if proc is not None and proc.poll() is None:
                 proc.kill()
                 proc.wait()
+
+
+@pytest.mark.slow
+def test_quorum_survives_permanent_leader_loss(tmp_path):
+    """3 real mon PROCESSES with durable stores forming a Paxos
+    quorum; SIGKILL the leader PERMANENTLY (never restarted).  The
+    2-of-3 majority must elect a new leader, keep committing map
+    mutations, and keep serving client I/O."""
+    import socket
+
+    # reserve three loopback ports for a static monmap
+    socks = [socket.socket() for _ in range(3)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    monmap = ",".join(f"127.0.0.1:{p}" for p in ports)
+
+    quorum_cfg = ('{"mon_osd_min_down_reporters": 1, '
+                  '"osd_heartbeat_grace": 2.5, "mon_lease": 1.0, '
+                  '"mon_election_timeout": 1.5}')
+    mons = {}
+    procs = {}
+    try:
+        for rank in range(3):
+            mons[rank] = _spawn(
+                ["-m", "ceph_tpu.mon", "--num-osds", "3",
+                 "--rank", str(rank), "--mon-addrs", monmap,
+                 "--store-path", str(tmp_path / f"mon.{rank}"),
+                 "--config", quorum_cfg])
+        for rank in range(3):
+            _read_addr(mons[rank], "MON_ADDR")
+        for i in range(3):
+            procs[i] = _spawn(
+                ["-m", "ceph_tpu.osd", "--id", str(i),
+                 "--mon", monmap,
+                 "--store-path", str(tmp_path / f"osd.{i}"),
+                 "--config", OSD_CONFIG])
+        for i in range(3):
+            _read_addr(procs[i], "OSD_ADDR")
+
+        async def drive():
+            from ceph_tpu.rados.client import RadosClient
+
+            client = RadosClient(monmap)
+            await client.connect()
+            try:
+                # quorum up: leader must be rank 0
+                rc, out = await client.mon_command(
+                    {"prefix": "mon stat"})
+                assert rc == 0 and out["leader"] == 0, out
+                await client.create_replicated_pool(
+                    "qs", size=2, pg_num=8)
+                ioctx = client.open_ioctx("qs")
+                await ioctx.write_full("pre", b"p" * 9000)
+
+                # permanent leader loss
+                mons[0].send_signal(signal.SIGKILL)
+                mons[0].wait()
+
+                # 2-of-3 elect a new leader and keep committing
+                deadline = time.monotonic() + 60
+                while True:
+                    try:
+                        rc, out = await client.mon_command(
+                            {"prefix": "mon stat"})
+                        if rc == 0 and out["leader"] in (1, 2):
+                            break
+                    except Exception:
+                        pass
+                    if time.monotonic() > deadline:
+                        raise TimeoutError("no new leader elected")
+                    await asyncio.sleep(0.3)
+                rc, out = await client.mon_command(
+                    {"prefix": "osd pool create", "name": "post",
+                     "pg_num": 4, "pool_type": "replicated",
+                     "size": 2})
+                assert rc == 0, out
+                # data plane alive through the failover
+                await ioctx.write_full("post", b"q" * 5000)
+                assert await ioctx.read("pre") == b"p" * 9000
+                assert await ioctx.read("post") == b"q" * 5000
+            finally:
+                await client.shutdown()
+
+        asyncio.run(asyncio.wait_for(drive(), 240))
+    finally:
+        for proc in list(procs.values()) + list(mons.values()):
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
