@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import json
+import os
 import time
 
 import numpy as np
@@ -116,14 +117,21 @@ def bench_put_e2e() -> float:
     end to end — host bytes through RGW-lite's processor pipeline, the
     networked rados client, the OSD op engine's EC encode, down to
     durable shards on every OSD store.  Wall-clock GiB/s of object
-    bytes.  Spins a 12-OSD in-loop cluster (MemStore) for the
-    measurement.
+    bytes.
+
+    Topology: a 12-OSD in-loop cluster (MemStore).  The bench hosts
+    are single-core (nproc=1 on the axon TPU VMs), so real daemon
+    processes would only add context switches — the in-loop cluster is
+    the faster AND the honest shape for this host; the standalone test
+    tier covers the multi-process topology for correctness.  Parts
+    upload concurrently (stock S3 client behavior); each part's
+    stripes pipeline through the processor's aio window.
 
     The per-object EC encode dispatches to the device only when a
     dispatch round-trip is cheap; through a high-latency tunnel the
-    codec's host SIMD path wins and the dispatch gate (the tpu-min-bytes
-    profile knob) picks it — that choice is part of the design and of
-    this number."""
+    codec's host SIMD path wins and the dispatch gate (the
+    tpu-min-bytes profile knob) picks it — that choice is part of the
+    design and of this number."""
     import asyncio
     import os
     import sys
@@ -172,27 +180,36 @@ def bench_put_e2e() -> float:
                 "rgw.meta", size=3, pg_num=8)
             await cluster.client.create_ec_pool(
                 "rgw.data", profile=profile, pg_num=8)
-            rgw = RGWLite(cluster.client, "rgw.data", "rgw.meta")
+            # 16 MiB stripes (a deployment knob, rgw_obj_stripe_size):
+            # on a single-core host, per-message overhead is the
+            # budget, so fewer+larger rados objects win
+            rgw = RGWLite(cluster.client, "rgw.data", "rgw.meta",
+                          stripe_size=16 << 20)
             await rgw.create_bucket("bench")
             payload = np.random.default_rng(5).integers(
                 0, 256, 64 << 20, dtype=np.uint8).tobytes()
             psize = 16 << 20
             best = float("inf")
-            for trial in range(3):
+            for trial in range(4):
                 key = f"obj{trial}"
                 t0 = time.perf_counter()
                 upload = await rgw.init_multipart("bench", key)
-                parts = []
-                for num in range(1, 5):
+
+                async def one_part(num):
                     chunk = payload[(num - 1) * psize:num * psize]
                     etag = await rgw.upload_part(
                         "bench", key, upload, num, chunk)
-                    parts.append((num, etag))
-                await rgw.complete_multipart("bench", key, upload,
-                                             parts)
-                best = min(best, time.perf_counter() - t0)
+                    return (num, etag)
+
+                parts = await asyncio.gather(
+                    *(one_part(n) for n in range(1, 5)))
+                await rgw.complete_multipart(
+                    "bench", key, upload, list(parts))
+                dt = time.perf_counter() - t0
+                if trial > 0:   # first trial warms connections
+                    best = min(best, dt)
             # integrity: the bytes made it back out
-            assert await rgw.get_object("bench", "obj0") == payload
+            assert await rgw.get_object("bench", "obj1") == payload
             return len(payload) / best / (1 << 30)
         finally:
             await cluster.stop()
@@ -383,6 +400,7 @@ def main() -> None:
         "cpu_simd_k4m2_1MiB_gibs": cpu_k4m2_gibs,
         "lrc_k8m4l4_crc32c_16MiB_gibs": lrc_gibs,
         "put_64MiB_ec8p3_gibs": put_gibs,
+        "host_cores": os.cpu_count(),
         "encode_ms_per_batch": t_enc * 1e3,
         "k": k, "m": m, "chunk_bytes": chunk, "batch": batch,
         "backend": jax.devices()[0].platform,

@@ -53,7 +53,10 @@ class Encoder:
 
     def bytes(self, v: bytes) -> None:
         self.u32(len(v))
-        self._raw(bytes(v))
+        # bytes passes through untouched; memoryview rides as-is into
+        # the join (bulk data must not pay an extra pass here)
+        self._raw(v if isinstance(v, (bytes, memoryview))
+                  else bytes(v))
 
     def string(self, v: str) -> None:
         self.bytes(v.encode("utf-8"))
@@ -147,6 +150,13 @@ class Decoder:
     def bytes(self) -> bytes:
         n = self.u32()
         return bytes(self._take(n))
+
+    def bytes_view(self) -> memoryview:
+        """Zero-copy bulk-data read: a view into the frame buffer.
+        For multi-MiB payload fields the bytes() copy is a full extra
+        pass over the data."""
+        n = self.u32()
+        return self._take(n)
 
     def string(self) -> str:
         return self.bytes().decode("utf-8")

@@ -58,11 +58,12 @@ class Connection:
     async def send(self, msg: Message) -> None:
         if self.closed:
             raise ConnectionError(f"connection to {self.peer_name} closed")
-        frame = frames.encode_frame(msg.TAG, next(self._seq),
-                                    msg.encode(),
-                                    secret=self.messenger.secret)
+        parts = frames.encode_frame_parts(msg.TAG, next(self._seq),
+                                          msg.encode(),
+                                          secret=self.messenger.secret)
         async with self._send_lock:
-            self.writer.write(frame)
+            for part in parts:
+                self.writer.write(part)
             try:
                 await asyncio.wait_for(self.writer.drain(),
                                        self.DRAIN_TIMEOUT)
@@ -102,9 +103,13 @@ class Messenger:
 
     # -- lifecycle ---------------------------------------------------------
 
+    # stream buffer: bulk data frames are multi-MiB; the 64 KiB default
+    # limit makes readexactly assemble them from ~64 tiny feeds
+    STREAM_LIMIT = 8 << 20
+
     async def bind(self, host: str = "127.0.0.1", port: int = 0) -> str:
         self._server = await asyncio.start_server(
-            self._handle_accept, host, port)
+            self._handle_accept, host, port, limit=self.STREAM_LIMIT)
         port = self._server.sockets[0].getsockname()[1]
         self.addr = f"{host}:{port}"
         return self.addr
@@ -137,7 +142,8 @@ class Messenger:
         if conn is not None and not conn.closed:
             return conn
         host, port_s = addr.rsplit(":", 1)
-        reader, writer = await asyncio.open_connection(host, int(port_s))
+        reader, writer = await asyncio.open_connection(
+            host, int(port_s), limit=self.STREAM_LIMIT)
         conn = Connection(self, reader, writer, peer_addr=addr)
         self._conns[addr] = conn
         await conn.send(MHello(self.entity_name, self.addr))

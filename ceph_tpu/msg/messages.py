@@ -279,8 +279,8 @@ class OSDOp:
 
     @classmethod
     def decode(cls, dec: Decoder) -> "OSDOp":
-        return cls(dec.string(), dec.u64(), dec.u64(), dec.bytes(),
-                   json.loads(dec.string()))
+        return cls(dec.string(), dec.u64(), dec.u64(),
+                   dec.bytes_view(), json.loads(dec.string()))
 
     def __repr__(self) -> str:
         return (f"OSDOp({self.op!r}, off={self.offset}, "
@@ -396,8 +396,8 @@ class ShardOp:
 
     @classmethod
     def decode(cls, dec: Decoder) -> "ShardOp":
-        return cls(dec.string(), dec.u64(), dec.bytes(), dec.string(),
-                   dec.bytes(), dec.u64())
+        return cls(dec.string(), dec.u64(), dec.bytes_view(),
+                   dec.string(), dec.bytes(), dec.u64())
 
 
 @register

@@ -77,21 +77,31 @@ def _as_ptr(arr: np.ndarray):
     return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 
 
+@functools.lru_cache(maxsize=1)
+def _fast_crc():
+    """Direct c_char_p prototype bound to the native symbol: bytes
+    pass straight through with no per-call cast (the cast dominated the
+    messenger's per-frame crcs at ~20us/call)."""
+    lib = native.get_lib()
+    if lib is None:
+        return None
+    import ctypes
+
+    proto = ctypes.CFUNCTYPE(ctypes.c_uint32, ctypes.c_uint32,
+                             ctypes.c_char_p, ctypes.c_uint64)
+    return proto(("ceph_tpu_crc32c", lib))
+
+
 def crc32c(crc: int, data, length: int | None = None) -> int:
     """ceph_crc32c: data=None means `length` zero bytes."""
-    lib = native.get_lib()
     if data is None:
         return crc32c_zeros(crc, length or 0)
+    if isinstance(data, bytes):
+        fast = _fast_crc()
+        if fast is not None:
+            return fast(crc & 0xFFFFFFFF, data, len(data))
+    lib = native.get_lib()
     if lib is not None:
-        import ctypes
-
-        if isinstance(data, bytes):
-            # zero-copy fast path: a c_char_p points straight into the
-            # bytes object — the numpy detour costs ~50us/call, which
-            # dominates the messenger's per-frame crcs
-            ptr = ctypes.cast(ctypes.c_char_p(data),
-                              ctypes.POINTER(ctypes.c_uint8))
-            return lib.ceph_tpu_crc32c(crc & 0xFFFFFFFF, ptr, len(data))
         if isinstance(data, (bytearray, memoryview)):
             arr = np.frombuffer(data, dtype=np.uint8)  # zero-copy view
         else:

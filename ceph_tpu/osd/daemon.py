@@ -2882,7 +2882,10 @@ class OSDDaemon:
             codec = self._codec(pool.id)
             sinfo = self._sinfo(pool.id)
             width = sinfo.get_stripe_width()
-            padded = data + bytes(-len(data) % width)
+            pad = -len(data) % width
+            # data may be a zero-copy memoryview of the op frame; only
+            # materialize when padding actually forces a copy
+            padded = (bytes(data) + bytes(pad)) if pad else data
             shards = ec_util.encode(sinfo, codec, padded,
                                     range(codec.get_chunk_count()))
             hinfo = ec_util.HashInfo(codec.get_chunk_count())
@@ -3536,6 +3539,10 @@ class OSDDaemon:
         additionally takes the normal object lock on its own."""
         from ceph_tpu.cls import ClsError, MethodContext
 
+        # class methods receive real bytes (they json-decode inputs);
+        # the wire decode hands bulk data as a zero-copy memoryview
+        if not isinstance(data, bytes):
+            data = bytes(data)
         entry = self.class_handler.lookup(cls, method)
         if entry is None:
             return EINVAL, b""
