@@ -5,10 +5,11 @@ target PGs per OSD (mon_target_pg_per_osd, default 100) scaled by the
 pool's replication factor, rounded to a power of two, recommendations
 surfaced and (in the reference's `on` mode) applied.
 
-This build surfaces recommendations (`warn` mode): live pg_num changes
-require PG splitting in the OSDs, which the mini-RADOS does not do —
-the recommendation rows and the POOL_TOO_FEW_PGS-style warnings are the
-autoscaler's contract here.
+Modes (the reference's pg_autoscale_mode pool knob, global here):
+`warn` (default) surfaces recommendation rows and POOL_TOO_FEW_PGS-style
+warnings; `on` APPLIES growth via `osd pool set pg_num` — the OSDs
+split live PGs (daemon._split_pool_pgs).  Shrink recommendations are
+never applied (PG merge unsupported).
 """
 
 from __future__ import annotations
@@ -36,10 +37,30 @@ class PgAutoscalerModule(MgrModule):
         super().__init__(mgr)
         self.target_pg_per_osd = int(
             mgr.config.get("mon_target_pg_per_osd", target_pg_per_osd))
+        self.mode = str(mgr.config.get("pg_autoscale_mode", "warn"))
         self.recommendations: Dict[int, Dict[str, Any]] = {}
+        self.applied: Dict[str, int] = {}
 
     async def serve_once(self) -> None:
         self.recommendations = self.compute()
+        if self.mode != "on":
+            return
+        for row in self.recommendations.values():
+            if not row["would_adjust"]:
+                continue
+            ideal = row["pg_num_ideal"]
+            current = row["pg_num_current"]
+            if ideal <= current:
+                continue  # merge unsupported; warn-only downward
+            # ratchet gradually (the reference bounds pg_num steps):
+            # one 4x growth per tick keeps the split/peering storm and
+            # the data movement bounded; later ticks converge the rest
+            step = min(ideal, current * 4)
+            rc, out = await self.mgr.client.mon_command(
+                {"prefix": "osd pool set", "name": row["pool_name"],
+                 "var": "pg_num", "val": step})
+            if rc == 0:
+                self.applied[row["pool_name"]] = step
 
     def compute(self) -> Dict[int, Dict[str, Any]]:
         """Per-pool rows mirroring `osd pool autoscale-status`."""

@@ -558,6 +558,7 @@ class MonDaemon:
                 "osd erasure-code-profile set": self._cmd_profile_set,
                 "osd erasure-code-profile get": self._cmd_profile_get,
                 "osd pool create": self._cmd_pool_create,
+                "osd pool set": self._cmd_pool_set,
                 "osd pool mksnap": self._cmd_snap_create,
                 "osd pool rmsnap": self._cmd_snap_remove,
                 "osd down": self._cmd_osd_down,
@@ -635,6 +636,35 @@ class MonDaemon:
         if not await self._commit(inc):
             return -11, {"error": "no quorum; retry"}
         return 0, {"pool_id": pool.id}
+
+    async def _cmd_pool_set(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        """`osd pool set <name> pg_num <n>` — PG splitting
+        (OSDMonitor's pg_num ratchet).  Growth only: live PG merging
+        is out of scope (documented deviation; the reference gained
+        merge in nautilus)."""
+        var = cmd.get("var")
+        if var != "pg_num":
+            return -22, {"error": f"unsupported pool var {var!r}"}
+        try:
+            val = int(cmd["val"])
+        except (KeyError, ValueError):
+            return -22, {"error": "pg_num must be an integer"}
+        async with self._mutation_lock:
+            pool, inc = self._pool_snap_inc(cmd["name"])
+            if pool is None:
+                return -2, {"error": "no such pool"}
+            if val < pool.pg_num:
+                return -22, {"error": "pg_num can only grow (PG merge"
+                                      " unsupported)"}
+            if val == pool.pg_num:
+                return 0, {"pg_num": val}
+            pool.pg_num = val
+            pool.pgp_num = val
+            if not await self._commit(inc):
+                return -11, {"error": "no quorum; retry"}
+        log.info("mon.%d: pool %s pg_num -> %d (epoch %d)", self.rank,
+                 cmd["name"], val, self.osdmap.epoch)
+        return 0, {"pg_num": val, "epoch": self.osdmap.epoch}
 
     def _pool_snap_inc(self, name: str):
         """Scratch-copy a pool for a snap mutation; returns

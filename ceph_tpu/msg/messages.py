@@ -564,25 +564,40 @@ class MOSDSubReadReply(Message):
 
 @register
 class MPGQuery(Message):
-    """Primary -> replica: send me your pg info + log (GetLog/GetInfo)."""
+    """Primary -> replica: send me your pg info + log (GetLog/GetInfo).
+
+    v2 appends an optional explicit shard: a split child's primary
+    sweeps NON-acting OSDs for stray shard state, and a stray cannot
+    derive its shard from an acting set it is not part of."""
 
     TAG = 15
+    VERSION = 2
+    COMPAT = 1
 
-    def __init__(self, tid: int, pg: PgId, epoch: int, from_osd: int):
+    def __init__(self, tid: int, pg: PgId, epoch: int, from_osd: int,
+                 shard: Optional[int] = None):
         self.tid = tid
         self.pg = pg
         self.epoch = epoch
         self.from_osd = from_osd
+        self.shard = shard
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u64(self.tid)
         _enc_pg(enc, self.pg)
         enc.u32(self.epoch)
         enc.s32(self.from_osd)
+        enc.optional(self.shard, Encoder.s32)
 
     @classmethod
-    def decode_payload(cls, dec: Decoder) -> "MPGQuery":
-        return cls(dec.u64(), _dec_pg(dec), dec.u32(), dec.s32())
+    def decode(cls, data: bytes) -> "MPGQuery":
+        dec = Decoder(data)
+        struct_v = dec.start(cls.VERSION)
+        msg = cls(dec.u64(), _dec_pg(dec), dec.u32(), dec.s32())
+        if struct_v >= 2:
+            msg.shard = dec.optional(Decoder.s32)
+        dec.finish()
+        return msg
 
 
 @register

@@ -264,6 +264,13 @@ class OSDDaemon:
         self._own_store = store is None
         self.osdmap: Optional[OSDMap] = None
         self.pgs: Dict[PgId, PGState] = {}
+        # pg_num per pool as of the last map processed: growth triggers
+        # local PG splitting (PG::split_into role)
+        self._pool_pg_nums: Dict[int, int] = {}
+        # children minted by a split: their first peering sweeps all up
+        # OSDs (the data lives on the PARENT's members, which the
+        # child's acting mapping knows nothing about)
+        self._split_children: Set[PgId] = set()
         self._codecs: Dict[int, Any] = {}
         self._tid = 0
         self._futures: Dict[int, asyncio.Future] = {}
@@ -329,6 +336,7 @@ class OSDDaemon:
         if self._own_store:
             self.store.mkfs()
             self.store.mount()
+        self._load_split_meta()
         addr = await self.msgr.bind(host, port)
         for _attempt in range(2 * len(self.mon_addrs)):
             try:
@@ -601,8 +609,165 @@ class OSDDaemon:
             self.mon_addr,
             MGetMap(since_epoch=self.osdmap.epoch, subscribe=False)))
 
+    _META_CID = "osd_meta"
+
+    def _load_split_meta(self) -> None:
+        """Split bookkeeping survives restarts: a durable OSD that was
+        down across a pg_num increase must still redistribute its
+        on-disk objects when it boots into the grown map."""
+        try:
+            omap = self.store.omap_get(self._META_CID,
+                                       ObjectId("split_state"))
+            doc = json.loads(omap["v"])
+            self._pool_pg_nums = {int(k): v
+                                  for k, v in doc["pg_nums"].items()}
+            self._split_children = {PgId(p, ps)
+                                    for p, ps in doc["children"]}
+        except (KeyError, ValueError):
+            pass
+
+    def _save_split_meta(self, t: Optional[Transaction] = None) -> None:
+        own = t is None
+        if own:
+            t = Transaction()
+        if not self.store.collection_exists(self._META_CID):
+            t.create_collection(self._META_CID)
+        t.omap_setkeys(self._META_CID, ObjectId("split_state"), {
+            "v": json.dumps({
+                "pg_nums": self._pool_pg_nums,
+                "children": sorted([p.pool, p.ps]
+                                   for p in self._split_children),
+            }).encode()})
+        if own:
+            self.store.queue_transaction(t)
+
+    def _check_pool_splits(self) -> None:
+        """pg_num growth observed: redistribute local PG state.  Safe
+        across multi-epoch jumps — stable-mod placement depends only on
+        the FINAL pg_num, so folding several growth steps into one
+        redistribution lands objects exactly where stepwise splitting
+        would."""
+        changed = False
+        for pool in self.osdmap.pools.values():
+            old = self._pool_pg_nums.get(pool.id)
+            if old != pool.pg_num:
+                changed = True
+            self._pool_pg_nums[pool.id] = pool.pg_num
+            if old is None or pool.pg_num <= old:
+                continue
+            try:
+                self._split_pool_pgs(pool, old, pool.pg_num)
+            except Exception:
+                log.exception("osd.%d: split of pool %d (%d->%d)"
+                              " failed", self.osd_id, pool.id, old,
+                              pool.pg_num)
+        if changed:
+            self._save_split_meta()
+
+    @staticmethod
+    def _head_name(name: str) -> str:
+        """Companion object -> owning head (rollback generations and
+        snap clones split WITH their head)."""
+        if name.startswith(RB_PREFIX):
+            name = name[len(RB_PREFIX):]
+        return name.split(SNAP_SEP, 1)[0]
+
+    def _split_pool_pgs(self, pool, old_num: int, new_num: int) -> None:
+        """PG::split_into (PG.cc:578) re-designed for this store: move
+        each object (with its companions) whose stable-mod placement
+        under new_num leaves its parent into the child's shard
+        collection, and partition the parent's PG log/missing by
+        object the same way.  Children inherit the parent's
+        last_update/log_tail, so auth-log election at the child's
+        first peering prefers members holding split state."""
+        from ceph_tpu.ops.rjenkins import ceph_str_hash_rjenkins
+        from ceph_tpu.osd.osdmap import _calc_mask
+        from ceph_tpu.osd.pg_log import PGInfo
+
+        mask = _calc_mask(new_num)
+        if pool.type == TYPE_ERASURE:
+            shard_list = list(
+                range(self._codec(pool.id).get_chunk_count()))
+        else:
+            shard_list = [-1]
+
+        def child_ps_of(head: str) -> int:
+            from ceph_tpu.osd.osdmap import ceph_stable_mod
+
+            return ceph_stable_mod(
+                ceph_str_hash_rjenkins(head.encode()), new_num, mask)
+
+        for ps in range(old_num):
+            parent = PgId(pool.id, ps)
+            for shard in shard_list:
+                cid = self._cid(parent, shard)
+                if not self.store.collection_exists(cid):
+                    continue
+                plog = PGLog.load(self.store, cid)
+                moves: Dict[int, List[str]] = {}
+                for o in self.store.list_objects(cid):
+                    name = str(o)
+                    if name == PGMETA_OID:
+                        continue
+                    cps = child_ps_of(self._head_name(name))
+                    if cps != ps:
+                        moves.setdefault(cps, []).append(name)
+                child_entries: Dict[int, List[dict]] = {}
+                keep_entries = []
+                for e in plog.entries:
+                    cps = child_ps_of(self._head_name(e.get("oid", "")))
+                    if cps == ps:
+                        keep_entries.append(e)
+                    else:
+                        child_entries.setdefault(cps, []).append(e)
+                child_missing: Dict[int, Dict[str, tuple]] = {}
+                keep_missing = {}
+                for oid, v in plog.missing.items():
+                    cps = child_ps_of(self._head_name(oid))
+                    if cps == ps:
+                        keep_missing[oid] = v
+                    else:
+                        child_missing.setdefault(cps, {})[oid] = v
+                touched = (set(moves) | set(child_entries)
+                           | set(child_missing))
+                if not touched:
+                    continue
+                t = Transaction()
+                for cps in touched:
+                    ccid = self._cid(PgId(pool.id, cps), shard)
+                    if not self.store.collection_exists(ccid):
+                        t.create_collection(ccid)
+                    for name in moves.get(cps, []):
+                        t.collection_move_rename(
+                            cid, ObjectId(name), ccid, ObjectId(name))
+                    clog = PGLog(
+                        PGInfo(last_update=plog.info.last_update,
+                               log_tail=plog.info.log_tail),
+                        child_entries.get(cps, []),
+                        child_missing.get(cps, {}))
+                    clog.stage(t, ccid)
+                plog.entries = keep_entries
+                plog.missing = keep_missing
+                plog.stage(t, cid)
+                self.store.queue_transaction(t)
+                log.info("osd.%d: split %s shard %s: %d objects to %d"
+                         " children", self.osd_id, parent, shard,
+                         sum(len(v) for v in moves.values()),
+                         len(touched))
+            # parent's cached log is stale after the partition
+            ps_state = self.pgs.get(parent)
+            if ps_state is not None:
+                ps_state.log = None
+        for cps in range(old_num, new_num):
+            child = PgId(pool.id, cps)
+            self._split_children.add(child)
+            cstate = self.pgs.get(child)
+            if cstate is not None:
+                cstate.log = None
+
     def _post_map_epoch(self, prev_up: Set[int]) -> None:
         """Per-epoch bookkeeping after the local map advanced."""
+        self._check_pool_splits()
         # reset the heartbeat clock for peers that just came (back) up:
         # their last_rx predates the outage and would otherwise make us
         # insta-report the freshly booted peer as failed again
@@ -1034,13 +1199,22 @@ class OSDDaemon:
         # new interval could roll back an acked write (the PeeringState
         # Reset discipline — the reply's content must stay authoritative)
         state.interval_epoch = max(state.interval_epoch, msg.epoch)
-        if pool is not None:
-            plog = self._load_log(state, pool)
+        if msg.shard is not None:
+            # explicit-shard query (split-child stray sweep): answer
+            # from that shard's collection directly — a stray cannot
+            # be located through an acting set it is not part of
+            shard = msg.shard
+            plog = PGLog.load(self.store,
+                              self._cid(msg.pg, shard))
         else:
-            plog = state.log or PGLog()
+            shard = state.my_shard(self.osd_id, pool.type) if pool \
+                else -1
+            if pool is not None:
+                plog = self._load_log(state, pool)
+            else:
+                plog = state.log or PGLog()
         info = plog.info.to_dict()
         info["missing"] = {k: list(v) for k, v in plog.missing.items()}
-        shard = state.my_shard(self.osd_id, pool.type) if pool else -1
         # shard object listing rides along so the primary can build
         # backfill sets for peers too far behind the log tail
         info["objects"] = self._list_shard_objects(msg.pg, shard)
@@ -1128,6 +1302,18 @@ class OSDDaemon:
                 peers[shard_key] = (info, reply.entries, peer_missing,
                                     reply.info.get("objects", []))
                 peer_shards[shard_key] = osd
+            if pg in self._split_children:
+                # split child: its data was minted on the PARENT's
+                # members, which this acting mapping knows nothing
+                # about.  One exhaustive (up-OSDs x shards) info/log
+                # sweep lets the auth election see the split state;
+                # per-object recovery already probes strays.  (The
+                # reference instead instantiates children directly on
+                # the parent's OSDs; this sweep is the asyncio-shaped
+                # equivalent, paid only at the first post-split
+                # peering.)
+                await self._sweep_split_strays(state, pool, peers,
+                                               peer_shards)
             # pre-merge heads: needed for the backfill decision below
             pre_lu = {k: v[0].last_update for k, v in peers.items()}
             # 2. elect authoritative log (max last_update, then longest)
@@ -1191,6 +1377,11 @@ class OSDDaemon:
             plog.info.last_epoch_started = self._epoch()
             state.state = "active"
             state.active_event.set()
+            # a split child that peered once has adopted its state
+            # from the parent's members; later peerings are normal
+            if pg in self._split_children:
+                self._split_children.discard(pg)
+                self._save_split_meta()
             if state.unfound:
                 # leftover missing entries are not only map-change
                 # driven: a recovery PUSH can fail on a transient
@@ -1210,6 +1401,69 @@ class OSDDaemon:
                     self._retry_peering(state))
         finally:
             state.peering_task = None
+
+    async def _sweep_split_strays(self, state: PGState, pool,
+                                  peers: Dict[int, tuple],
+                                  peer_shards: Dict[int, int]) -> None:
+        """Collect split-child state from OUTSIDE the acting mapping:
+        every up OSD is asked for every shard collection of this pg.
+        Hits join the auth-log election under synthetic keys (never
+        push/recovery targets — those stay acting-only; the per-object
+        gather finds the stray payloads on its own)."""
+        from ceph_tpu.osd.pg_log import PGInfo
+
+        pg = state.pg
+        if pool.type == TYPE_ERASURE:
+            shard_list = list(
+                range(self._codec(pool.id).get_chunk_count()))
+        else:
+            shard_list = [-1]
+        # my own non-acting shard collections (an ex-parent member's
+        # parent-shard index need not match its child acting slot)
+        my_shard = state.my_shard(self.osd_id, pool.type)
+        for shard in shard_list:
+            if shard == my_shard:
+                continue
+            cid = self._cid(pg, shard)
+            if not self.store.collection_exists(cid):
+                continue
+            lplog = PGLog.load(self.store, cid)
+            if lplog.info.last_update > ZERO:
+                key = -(10_000 + self.osd_id * 64 + shard + 2)
+                peers[key] = (lplog.info, list(lplog.entries),
+                              dict(lplog.missing),
+                              self._list_shard_objects(pg, shard))
+        # (osd, shard) pairs already covered: the acting loop asked
+        # each acting member for ITS OWN slot only — an acting member
+        # may still hold split state under a DIFFERENT shard index
+        # (its parent slot), so acting OSDs are swept for the others
+        covered = {(osd, sk if sk >= -1 else -1)
+                   for sk, osd in peer_shards.items()}
+        covered |= {(self.osd_id, shard) for shard in shard_list}
+
+        async def ask(osd: int, shard: int):
+            tid = self._next_tid()
+            reply = await self._request(
+                osd, MPGQuery(tid, pg, state.interval_epoch,
+                              self.osd_id, shard=shard), tid)
+            return osd, shard, reply
+
+        jobs = [ask(osd, shard)
+                for osd in self.osdmap.get_up_osds()
+                for shard in shard_list
+                if (osd, shard) not in covered]
+        results = await asyncio.gather(*jobs) if jobs else []
+        for osd, shard, reply in results:
+            if reply is None or reply.pg != pg:
+                continue
+            info = PGInfo.from_dict(reply.info)
+            if info.last_update <= ZERO:
+                continue  # nothing split onto this OSD
+            key = -(10_000 + osd * 64 + shard + 2)
+            peers[key] = (info, reply.entries,
+                          {k: ev(v) for k, v in
+                           reply.info.get("missing", {}).items()},
+                          reply.info.get("objects", []))
 
     def _schedule_unfound_retry(self, state: PGState, pool) -> None:
         """Re-run recovery for an active PG that still carries missing
@@ -2152,6 +2406,28 @@ class OSDDaemon:
         # the push — that push is by definition stale.
         guard = self._plan_guard(candidates, need_v)
 
+        # DELETE-AWARE adjudication: if the authoritative log's newest
+        # word on this object is a delete (and nothing recreated it
+        # after), the recovered state is ABSENT.  Without this check a
+        # stale replica's older generation reaches k/1 candidates and
+        # recovery would faithfully REINSTALL it — resurrecting an
+        # acked remove (found by the thrash model checker).  The
+        # reference encodes deletes in the missing set as
+        # "need > have, item.is_delete()" (PGLog) for the same reason.
+        newest = self._newest_log_entry(plog, oid)
+        if newest is not None and newest.get("op") == "delete" and \
+                ev(newest["version"]) >= need_v:
+            dv = ev(newest["version"])
+            if dv > guard:
+                guard = dv
+            holders = await self._locate_holders(pg, pool, oid)
+            log.info("osd.%d: %s/%s: newest log entry is a delete at"
+                     " %s — propagating removal (%d stale holders)",
+                     self.osd_id, pg, oid, dv, len(holders))
+            return {"kind": "remove", "oid": oid, "targets": targets,
+                    "i_need": i_need, "purge": True, "guard": guard,
+                    "purge_locations": holders}
+
         if not candidates:
             if not probes_complete:
                 # zero copies found but a possible source is down or
@@ -2231,21 +2507,7 @@ class OSDDaemon:
             # locate the partial fragments so the purge removes
             # exactly the holders (quiet + O(holders), not a
             # cluster-wide broadcast)
-            if pool.type == TYPE_ERASURE:
-                shard_list = list(
-                    range(self._codec(pool.id).get_chunk_count()))
-            else:
-                shard_list = [-1]
-            probes = [(shard, osd)
-                      for osd in self.osdmap.get_up_osds()
-                      for shard in shard_list if osd != self.osd_id]
-            results = await asyncio.gather(
-                *(self._read_candidates(pg, shard, osd, oid,
-                                        include_rollback=True)
-                  for shard, osd in probes))
-            holders = [(shard, osd)
-                       for (shard, osd), (cands, _ok)
-                       in zip(probes, results) if cands]
+            holders = await self._locate_holders(pg, pool, oid)
             return {"kind": "remove", "oid": oid, "targets": targets,
                     "i_need": i_need, "purge": True, "guard": guard,
                     "purge_locations": holders}
@@ -2340,6 +2602,26 @@ class OSDDaemon:
                                   self.osd_id, p["oid"])
             done = done2
         return done
+
+    async def _locate_holders(self, pg: PgId, pool,
+                              oid: str) -> List[Tuple[int, int]]:
+        """(shard, osd) pairs of every up OSD holding any copy/fragment
+        of oid — the purge target list for rollback/delete propagation."""
+        if pool.type == TYPE_ERASURE:
+            shard_list = list(
+                range(self._codec(pool.id).get_chunk_count()))
+        else:
+            shard_list = [-1]
+        probes = [(shard, osd)
+                  for osd in self.osdmap.get_up_osds()
+                  for shard in shard_list if osd != self.osd_id]
+        results = await asyncio.gather(
+            *(self._read_candidates(pg, shard, osd, oid,
+                                    include_rollback=True)
+              for shard, osd in probes))
+        return [(shard, osd)
+                for (shard, osd), (cands, _ok)
+                in zip(probes, results) if cands]
 
     def _plan_guard(self, candidates, *extra) -> tuple:
         """Newest object version a recovery plan observed: max over the
@@ -2514,6 +2796,25 @@ class OSDDaemon:
             await conn.send(MOSDOpReply(
                 msg.tid, EAGAIN, replay_epoch=self._epoch()))
             return
+        # misdirected-op check (handle_misdirected_op role): a client
+        # on a pre-split map addresses the PARENT pg; the parent's
+        # acting set may be unchanged, so no fence fires — but
+        # executing here would land the object in a PG it no longer
+        # maps to (permanently invisible to post-split readers).
+        # EAGAIN + replay_epoch makes the client refresh and resend to
+        # the child.
+        if msg.oid and not is_internal_name(msg.oid) and \
+                not any(op.op == "pgls" for op in msg.ops):
+            # pgls (and other PG-addressed ops) target the pg itself,
+            # with no object name to place
+            from ceph_tpu.ops.rjenkins import ceph_str_hash_rjenkins
+
+            raw = PgId(pool.id,
+                       ceph_str_hash_rjenkins(msg.oid.encode()))
+            if pool.raw_pg_to_pg(raw) != msg.pg:
+                await conn.send(MOSDOpReply(
+                    msg.tid, EAGAIN, replay_epoch=self._epoch()))
+                return
         if state.state != "active":
             # queue until peering completes (waiting_for_active)
             self.op_tracker.mark(op_id, "waiting_for_active")

@@ -422,7 +422,6 @@ class IoCtx:
 
     async def _submit(self, oid: str, ops: List[OSDOp]) -> MOSDOpReply:
         client = self.client
-        pg = self.object_pg(oid)
         last_error: Optional[Exception] = None
         # ONE tid for the op's whole lifetime: a resend after a lost
         # reply carries the same reqid, so the primary's dedup cache
@@ -432,6 +431,10 @@ class IoCtx:
         tid = client._next_tid()
         for attempt in range(client.max_retries):
             osdmap = client.osdmap
+            # placement recomputed per attempt: a pg_num split between
+            # retries remaps the object to a CHILD pg, and the primary
+            # bounces misdirected ops with EAGAIN until we follow
+            pg = self.object_pg(oid)
             primary = client._primary_cached(osdmap, pg)
             addr = osdmap.osd_addrs.get(primary, None) \
                 if primary >= 0 else None
