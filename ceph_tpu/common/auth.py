@@ -1,55 +1,182 @@
-"""cephx-lite: shared-secret message authentication.
+"""cephx: shared-secret authentication with per-connection session keys,
+tickets, and key rotation.
 
-Reference parity: the cephx protocol's MESSAGE SIGNING tier
-(/root/reference/src/auth/cephx/CephxSessionHandler.cc:sign_message —
-every frame carries an HMAC over its header+payload keyed by the
-session key; `cephx_sign_messages`).  Deliberate simplification: one
-static cluster secret plays the session-key role (no ticket exchange /
-per-session key negotiation — the mon-as-KDC machinery of
-CephxServiceHandler).  The security property kept: a peer WITHOUT the
-key cannot forge or tamper with frames — unsigned or mis-signed frames
-drop the connection.  NOT kept (needs the session-key handshake):
-replay protection — an observer who records a signed frame can replay
-it on a new connection, since the key is static and frame seq is not
-bound to a per-session nonce.  Appropriate threat model: accidental
-cross-cluster joins and non-recording network peers, not an active
-recording attacker.
+Reference parity:
+- MESSAGE SIGNING (/root/reference/src/auth/cephx/CephxSessionHandler.cc
+  sign_message): every frame carries a truncated HMAC keyed by the
+  connection's SESSION key.
+- Session-key negotiation: a mutual nonce handshake per connection
+  derives session_key = HMAC(base_key, nonce_a || nonce_b) — the
+  CephxSessionHandler session-key role.  A frame recorded on one
+  connection can never verify on another (fresh nonces => fresh key),
+  and within a connection the receiver enforces strictly increasing
+  signed sequence numbers — together these kill replay.
+- Mon-as-KDC tickets (/root/reference/src/auth/cephx/
+  CephxServiceHandler.h:23, CephxProtocol.h): a client proves key
+  possession against a server challenge; the mon grants a signed,
+  expiring ticket whose base key any service holding the cluster key
+  derives offline — services never consult the KDC to validate.
+- Key rotation (KeyServer rotating-secrets role): the keyring holds
+  multiple (kid, key) entries; new handshakes/tickets use the active
+  kid, peers accept any listed kid, operators rotate by adding a key,
+  flipping active, then dropping the old one.
 
-Keyring format (`ceph-authtool` role): a hex string, one per file.
+Deliberate simplifications (documented, not hidden): one cluster-wide
+key plays the per-entity key role (named per-entity keys are a keyring
+layout away, not a protocol change), and ticket blobs are signed
+assertions rather than encrypted grants — the base key is derived, not
+carried, so nothing secret rides the wire.
+
+Keyring format (`ceph-authtool` role): a hex string (kid 0), or
+comma-separated `kid:hex` entries — the FIRST entry is the active key.
 """
 
 from __future__ import annotations
 
 import hashlib
 import hmac
+import json
 import os
-from typing import Optional
+import time
+from typing import Dict, Optional, Tuple
 
-SIG_LEN = 8  # truncated HMAC-SHA256, like cephx's 64-bit signatures
+SIG_LEN = 8       # truncated HMAC-SHA256, like cephx's 64-bit signatures
+NONCE_LEN = 16
+TICKET_LIFETIME = 3600.0  # auth_service_ticket_ttl default role
 
 
 def generate_secret() -> str:
     return os.urandom(32).hex()
 
 
-def parse_secret(raw: Optional[str]) -> Optional[bytes]:
-    """hex keyring string -> key bytes (None/empty = auth disabled)."""
+class Keyring:
+    """Rotating key set: {kid: key}; the active kid signs new work."""
+
+    def __init__(self, keys: Dict[int, bytes], active: int):
+        self.keys = keys
+        self.active = active
+
+    @property
+    def active_key(self) -> bytes:
+        return self.keys[self.active]
+
+    def get(self, kid: int) -> Optional[bytes]:
+        return self.keys.get(kid)
+
+
+def parse_secret(raw) -> Optional[Keyring]:
+    """Keyring string -> Keyring (None/empty = auth disabled).
+
+    `<hex>` (kid 0) or `kid:hex,kid:hex,...` (first = active)."""
     if not raw:
         return None
-    return bytes.fromhex(raw)
+    if isinstance(raw, Keyring):
+        return raw
+    keys: Dict[int, bytes] = {}
+    active = None
+    for part in str(raw).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            kid_s, hexkey = part.split(":", 1)
+            kid = int(kid_s)
+        else:
+            kid, hexkey = 0, part
+        keys[kid] = bytes.fromhex(hexkey)
+        if active is None:
+            active = kid
+    if active is None:
+        return None
+    return Keyring(keys, active)
 
 
-def load_keyring(path: str) -> Optional[bytes]:
+def load_keyring(path: str) -> Optional[Keyring]:
     with open(path) as f:
         return parse_secret(f.read().strip())
 
 
-def sign(secret: bytes, *parts: bytes) -> bytes:
-    mac = hmac.new(secret, digestmod=hashlib.sha256)
+def sign(key: bytes, *parts: bytes) -> bytes:
+    mac = hmac.new(key, digestmod=hashlib.sha256)
     for part in parts:
         mac.update(part)
     return mac.digest()[:SIG_LEN]
 
 
-def verify(secret: bytes, sig: bytes, *parts: bytes) -> bool:
-    return hmac.compare_digest(sign(secret, *parts), sig)
+def verify(key: bytes, sig: bytes, *parts: bytes) -> bool:
+    return hmac.compare_digest(sign(key, *parts), sig)
+
+
+def _prf(key: bytes, label: bytes, *parts: bytes) -> bytes:
+    mac = hmac.new(key, label, hashlib.sha256)
+    for part in parts:
+        mac.update(part)
+    return mac.digest()
+
+
+def new_nonce() -> bytes:
+    return os.urandom(NONCE_LEN)
+
+
+def derive_session(base_key: bytes, nonce_a: bytes,
+                   nonce_b: bytes) -> bytes:
+    """Per-connection session key: fresh nonces on both sides make a
+    frame recorded elsewhere unverifiable here."""
+    return _prf(base_key, b"cephx-session", nonce_a, nonce_b)
+
+
+# -- mon-as-KDC tickets ------------------------------------------------------
+
+
+def auth_proof(key: bytes, entity: str, client_challenge: bytes,
+               server_challenge: bytes) -> bytes:
+    """Client's proof of key possession (the CephxServiceHandler
+    challenge-hash role)."""
+    return _prf(key, b"cephx-proof", entity.encode(),
+                client_challenge, server_challenge)[:SIG_LEN]
+
+
+def check_proof(key: bytes, entity: str, client_challenge: bytes,
+                server_challenge: bytes, proof: bytes) -> bool:
+    """Constant-time validation of a client's proof (the verify()
+    sibling of auth_proof)."""
+    return hmac.compare_digest(
+        auth_proof(key, entity, client_challenge, server_challenge),
+        bytes(proof))
+
+
+def make_ticket(keyring: Keyring, entity: str,
+                lifetime: float = TICKET_LIFETIME) -> bytes:
+    """Signed expiring assertion; blob = json || sig."""
+    blob = json.dumps({
+        "entity": entity,
+        "expires": time.time() + lifetime,
+        "kid": keyring.active,
+        "nonce": os.urandom(8).hex(),
+    }, sort_keys=True).encode()
+    return blob + sign(keyring.active_key, b"cephx-ticket", blob)
+
+
+def ticket_base_key(key: bytes, blob: bytes) -> bytes:
+    return _prf(key, b"cephx-ticket-base", blob)
+
+
+def check_ticket(keyring: Keyring, ticket: bytes
+                 ) -> Optional[Tuple[str, bytes]]:
+    """Validate a ticket offline; returns (entity, base_key) or None."""
+    if len(ticket) <= SIG_LEN:
+        return None
+    blob, sig = ticket[:-SIG_LEN], ticket[-SIG_LEN:]
+    try:
+        doc = json.loads(blob)
+        kid = int(doc["kid"])
+    except (ValueError, KeyError, TypeError):
+        return None
+    key = keyring.get(kid)
+    if key is None:
+        return None
+    if not verify(key, sig, b"cephx-ticket", blob):
+        return None
+    if doc.get("expires", 0) < time.time():
+        return None
+    return str(doc.get("entity", "")), ticket_base_key(key, blob)

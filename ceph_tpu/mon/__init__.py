@@ -40,6 +40,8 @@ from ceph_tpu.ec.registry import create_erasure_code
 from ceph_tpu.mon import paxos as paxos_mod
 from ceph_tpu.msg import Connection, Messenger
 from ceph_tpu.msg.messages import (
+    MAuth,
+    MAuthReply,
     Message,
     MGetMap,
     MMonCommand,
@@ -386,6 +388,8 @@ class MonDaemon:
                 await conn.send(MMonCommandReply(msg.tid, rc, out))
             else:
                 await self._forward(msg, conn, msg.tid)
+        elif isinstance(msg, MAuth):
+            await self._handle_auth(conn, msg)
         elif isinstance(msg, MMonElection):
             if self.elector is not None:
                 await self.elector.handle(msg)
@@ -400,6 +404,39 @@ class MonDaemon:
                 client_conn, tid = pending
                 await self._send_quiet(client_conn, MMonCommandReply(
                     tid, msg.rc, msg.out))
+
+    async def _handle_auth(self, conn: Connection, msg: MAuth) -> None:
+        """Mon-as-KDC ticket service (CephxServiceHandler role): stage
+        1 hands out a server challenge, stage 2 validates the client's
+        proof of key possession and grants a signed expiring ticket.
+        Served by ANY mon — the keyring is cluster-wide state."""
+        from ceph_tpu.common import auth as auth_mod
+
+        keyring = self.msgr.secret
+        if keyring is None:
+            await self._send_quiet(conn, MAuthReply(msg.tid, -95))
+            return
+        if msg.stage == 1:
+            challenge = auth_mod.new_nonce()
+            conn._auth_challenge = challenge
+            await self._send_quiet(conn, MAuthReply(
+                msg.tid, 0, server_challenge=challenge))
+            return
+        challenge = getattr(conn, "_auth_challenge", b"")
+        key = keyring.get(msg.kid)
+        ok = (bool(challenge) and key is not None
+              and auth_mod.check_proof(key, msg.entity,
+                                       bytes(msg.client_challenge),
+                                       challenge, bytes(msg.proof)))
+        if not ok:
+            log.warning("mon.%d: auth proof failure for %r", self.rank,
+                        msg.entity)
+            await self._send_quiet(conn, MAuthReply(msg.tid, -13))
+            return
+        conn._auth_challenge = b""  # single use
+        ticket = auth_mod.make_ticket(keyring, msg.entity)
+        await self._send_quiet(conn, MAuthReply(msg.tid, 0,
+                                                ticket=ticket))
 
     async def _forward(self, msg: Message,
                        conn: Optional[Connection] = None,

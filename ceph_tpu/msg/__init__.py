@@ -8,6 +8,16 @@ discipline).  Dispatch is fast-dispatch only — a received message is
 handed straight to the dispatcher coroutine, no DispatchQueue thread
 (DispatchQueue.h:200-203's fast path is the only path here).
 
+Authentication (cephx, common/auth.py): with a keyring configured, the
+hello exchange is a mutual nonce handshake — each side's hello is
+signed with a listed cluster key and carries a fresh nonce (plus an
+optional mon ticket); both sides derive a per-connection SESSION key
+and every later frame is signed with it and must arrive with a
+strictly increasing sequence number.  A recorded frame therefore
+verifies nowhere else (fresh nonces => fresh key) and never twice on
+the same connection (seq monotonicity) — the CephxSessionHandler
+sign_message + session-key discipline.
+
 Lossy-client semantics (src/msg/Policy.h): a dead connection is simply
 forgotten; recovery is the caller's job (the Objecter-role client resends
 ops on map change / reconnect, exactly like the reference's lossy client
@@ -33,6 +43,8 @@ log = logging.getLogger("msgr")
 
 DispatchFn = Callable[["Connection", Message], Awaitable[None]]
 
+HANDSHAKE_TIMEOUT = 10.0
+
 
 class Connection:
     """One peer session (Connection role)."""
@@ -40,15 +52,26 @@ class Connection:
     def __init__(self, messenger: "Messenger",
                  reader: asyncio.StreamReader,
                  writer: asyncio.StreamWriter,
-                 peer_name: str = "", peer_addr: str = ""):
+                 peer_name: str = "", peer_addr: str = "",
+                 outbound: bool = False):
         self.messenger = messenger
         self.reader = reader
         self.writer = writer
         self.peer_name = peer_name
         self.peer_addr = peer_addr
+        self.outbound = outbound
         self._seq = itertools.count()
         self._send_lock = asyncio.Lock()
         self.closed = False
+        # cephx session state
+        self.session_key: Optional[bytes] = None
+        self.session_ready = asyncio.Event()
+        self.my_nonce: bytes = b""
+        self.base_key: Optional[bytes] = None  # connector side choice
+        # acceptor replies with the CONNECTOR's kid: during rotation a
+        # peer still on the old key must be able to verify our hello
+        self.reply_kid: Optional[int] = None
+        self.rx_seq = -1
 
     # a wedged peer (stopped reading, socket buffer full) must not
     # park drain() — and with it this connection's send lock — forever;
@@ -56,11 +79,28 @@ class Connection:
     DRAIN_TIMEOUT = 15.0
 
     async def send(self, msg: Message) -> None:
+        key = None
+        if self.messenger.secret is not None:
+            if self.session_key is None:
+                # wait out the handshake: pre-session frames would be
+                # unverifiable at a keyed receiver
+                try:
+                    await asyncio.wait_for(self.session_ready.wait(),
+                                           HANDSHAKE_TIMEOUT)
+                except asyncio.TimeoutError:
+                    self.close()
+                    raise ConnectionError(
+                        f"cephx handshake with {self.peer_name or self.peer_addr}"
+                        " timed out")
+            key = self.session_key
+        await self._send_signed(msg, key)
+
+    async def _send_signed(self, msg: Message,
+                           key: Optional[bytes]) -> None:
         if self.closed:
             raise ConnectionError(f"connection to {self.peer_name} closed")
         parts = frames.encode_frame_parts(msg.TAG, next(self._seq),
-                                          msg.encode(),
-                                          secret=self.messenger.secret)
+                                          msg.encode(), key=key)
         async with self._send_lock:
             for part in parts:
                 self.writer.write(part)
@@ -72,9 +112,28 @@ class Connection:
                 raise ConnectionError(
                     f"drain to {self.peer_name} timed out")
 
+    async def send_hello(self, ticket: bytes = b"") -> None:
+        """Handshake frame: signed with the ACTIVE static key (the only
+        shared context before a session exists), carrying my nonce."""
+        m = self.messenger
+        if not self.my_nonce:
+            self.my_nonce = auth.new_nonce()
+        key = None
+        kid = 0
+        if m.secret is not None:
+            kid = m.secret.active if self.reply_kid is None \
+                else self.reply_kid
+            key = m.secret.get(kid)
+        hello = MHello(m.entity_name, m.addr, nonce=self.my_nonce,
+                       kid=kid, ticket=ticket)
+        await self._send_signed(hello, key)
+
     def close(self) -> None:
         if not self.closed:
             self.closed = True
+            # wake handshake waiters: closed=True makes their send
+            # raise immediately instead of riding out the timeout
+            self.session_ready.set()
             try:
                 self.writer.close()
             except Exception:
@@ -89,9 +148,14 @@ class Messenger:
 
     def __init__(self, entity_name: str, secret=None):
         self.entity_name = entity_name
-        # cephx-lite cluster secret: frames are HMAC-signed and
-        # unsigned/mis-signed inbound frames drop the connection
-        self.secret = secret
+        # cephx keyring (auth.Keyring): hellos are static-signed, all
+        # later frames session-signed; unsigned/mis-signed inbound
+        # frames drop the connection
+        self.secret = auth.parse_secret(secret) \
+            if not isinstance(secret, auth.Keyring) else secret
+        # mon-granted ticket attached to outbound hellos (clients set
+        # this after an MAuth exchange; services validate offline)
+        self.ticket: bytes = b""
         self.addr: str = ""
         self.dispatcher: Optional[DispatchFn] = None
         self.on_connection_fault: Optional[
@@ -101,11 +165,11 @@ class Messenger:
         self._accepted: list = []                     # inbound conns
         self._tasks: set = set()
 
-    # -- lifecycle ---------------------------------------------------------
-
     # stream buffer: bulk data frames are multi-MiB; the 64 KiB default
     # limit makes readexactly assemble them from ~64 tiny feeds
     STREAM_LIMIT = 8 << 20
+
+    # -- lifecycle ---------------------------------------------------------
 
     async def bind(self, host: str = "127.0.0.1", port: int = 0) -> str:
         self._server = await asyncio.start_server(
@@ -144,9 +208,19 @@ class Messenger:
         host, port_s = addr.rsplit(":", 1)
         reader, writer = await asyncio.open_connection(
             host, int(port_s), limit=self.STREAM_LIMIT)
-        conn = Connection(self, reader, writer, peer_addr=addr)
+        conn = Connection(self, reader, writer, peer_addr=addr,
+                          outbound=True)
         self._conns[addr] = conn
-        await conn.send(MHello(self.entity_name, self.addr))
+        ticket = self.ticket
+        if ticket and self.secret is not None:
+            chk = auth.check_ticket(self.secret, ticket)
+            if chk is not None:
+                conn.base_key = chk[1]
+            else:
+                ticket = b""  # expired locally: fall back to static
+        if conn.base_key is None and self.secret is not None:
+            conn.base_key = self.secret.active_key
+        await conn.send_hello(ticket=ticket)
         self._spawn(self._read_loop(conn))
         return conn
 
@@ -168,24 +242,87 @@ class Messenger:
         task.add_done_callback(self._tasks.discard)
         return task
 
+    async def _read_frame(self, conn: Connection):
+        pre = await conn.reader.readexactly(frames.PREAMBLE_WIRE_LEN)
+        tag, flags, seq, length = frames.decode_preamble(pre)
+        payload = await conn.reader.readexactly(length)
+        frames.check_payload(payload,
+                             await conn.reader.readexactly(4))
+        sig = b""
+        if flags & frames.FLAG_SIGNED:
+            sig = await conn.reader.readexactly(auth.SIG_LEN)
+        return pre, tag, flags, seq, payload, sig
+
+    async def _handshake_hello(self, conn: Connection, tag, pre, flags,
+                               seq, payload, sig) -> None:
+        """First frame at a keyed endpoint: a static-signed hello.
+        Raises FrameError on any auth failure."""
+        if not flags & frames.FLAG_SIGNED:
+            raise frames.FrameError("unsigned frame (auth required)")
+        msg = decode_message(tag, payload)
+        if not isinstance(msg, MHello):
+            raise frames.FrameError("expected hello before session")
+        key = self.secret.get(msg.kid)
+        if key is None or not auth.verify(
+                key, sig, pre[:frames.PREAMBLE.size], payload):
+            raise frames.FrameError("hello signature mismatch"
+                                    " (wrong key?)")
+        base = key
+        if msg.ticket:
+            chk = auth.check_ticket(self.secret, bytes(msg.ticket))
+            if chk is None:
+                raise frames.FrameError("invalid or expired ticket")
+            _entity, base = chk
+        conn.rx_seq = seq
+        conn.peer_name = msg.entity_name
+        conn.peer_addr = msg.addr or conn.peer_addr
+        if conn.outbound:
+            # acceptor's reply: session = f(base, my_nonce, its_nonce)
+            conn.session_key = auth.derive_session(
+                conn.base_key, conn.my_nonce, msg.nonce)
+            conn.session_ready.set()
+        else:
+            conn.base_key = base
+            conn.reply_kid = msg.kid
+            # reply with MY hello BEFORE arming the session, so the
+            # hello is guaranteed to be this side's first frame
+            await conn.send_hello()
+            conn.session_key = auth.derive_session(
+                base, msg.nonce, conn.my_nonce)
+            conn.session_ready.set()
+
     async def _read_loop(self, conn: Connection) -> None:
         try:
             while True:
-                pre = await conn.reader.readexactly(
-                    frames.PREAMBLE_WIRE_LEN)
-                tag, flags, _seq, length = frames.decode_preamble(pre)
-                payload = await conn.reader.readexactly(length)
-                frames.check_payload(
-                    payload, await conn.reader.readexactly(4))
-                sig = b""
-                if flags & frames.FLAG_SIGNED:
-                    sig = await conn.reader.readexactly(auth.SIG_LEN)
-                frames.check_signature(self.secret, flags, pre,
-                                       payload, sig)
+                pre, tag, flags, seq, payload, sig = \
+                    await self._read_frame(conn)
+                if self.secret is not None:
+                    if conn.session_key is None:
+                        await self._handshake_hello(
+                            conn, tag, pre, flags, seq, payload, sig)
+                        continue
+                    if not flags & frames.FLAG_SIGNED:
+                        raise frames.FrameError(
+                            "unsigned frame (auth required)")
+                    if not auth.verify(conn.session_key, sig,
+                                       pre[:frames.PREAMBLE.size],
+                                       payload):
+                        raise frames.FrameError(
+                            "session signature mismatch (replayed or"
+                            " forged frame)")
+                    if seq != conn.rx_seq + 1:
+                        raise frames.FrameError(
+                            f"non-monotonic frame seq {seq} (last"
+                            f" {conn.rx_seq}): replay rejected")
+                    conn.rx_seq = seq
                 msg = decode_message(tag, payload)
                 if isinstance(msg, MHello):
+                    # keyless endpoint: hellos are identification only
+                    # (a keyed connector talking to a keyless acceptor
+                    # rejects the unsigned reply and drops — keyed
+                    # peers refuse keyless clusters by design)
                     conn.peer_name = msg.entity_name
-                    conn.peer_addr = msg.addr
+                    conn.peer_addr = msg.addr or conn.peer_addr
                     continue
                 if self.dispatcher is not None:
                     # fast dispatch: run handlers concurrently so a slow

@@ -46,38 +46,41 @@ class FrameError(Exception):
 
 
 def encode_frame_parts(tag: int, seq: int, payload: bytes,
-                       flags: int = 0, secret=None) -> list:
+                       flags: int = 0, key=None) -> list:
     """Frame as (head, payload, tail): the payload rides as-is —
     zero-copy at this layer; for multi-MiB data frames the join it
-    avoids is a full extra pass over the object."""
-    if secret is not None:
+    avoids is a full extra pass over the object.
+
+    key: the signing key BYTES for this frame (a cephx session key, or
+    the static active key during the hello handshake); None = unsigned."""
+    if key is not None:
         flags |= FLAG_SIGNED
     pre = PREAMBLE.pack(MAGIC, tag, flags, seq, len(payload))
     head = pre + CRC.pack(crc32c(0xFFFFFFFF, pre))
     tail = CRC.pack(crc32c(0xFFFFFFFF, payload))
-    if secret is not None:
+    if key is not None:
         from ceph_tpu.common import auth
 
-        tail += auth.sign(secret, pre, payload)
+        tail += auth.sign(key, pre, payload)
     return [head, payload, tail]
 
 
 def encode_frame(tag: int, seq: int, payload: bytes,
-                 flags: int = 0, secret=None) -> bytes:
+                 flags: int = 0, key=None) -> bytes:
     return b"".join(encode_frame_parts(tag, seq, payload,
-                                       flags=flags, secret=secret))
+                                       flags=flags, key=key))
 
 
-def check_signature(secret, flags: int, pre_buf: bytes,
+def check_signature(key, flags: int, pre_buf: bytes,
                     payload: bytes, sig: bytes) -> None:
     """Receiver-side auth adjudication; FrameError drops the conn."""
     from ceph_tpu.common import auth
 
-    if secret is None:
+    if key is None:
         return
     if not flags & FLAG_SIGNED:
         raise FrameError("unsigned frame from peer (auth required)")
-    if not auth.verify(secret, sig, pre_buf[:PREAMBLE.size], payload):
+    if not auth.verify(key, sig, pre_buf[:PREAMBLE.size], payload):
         raise FrameError("frame signature mismatch (wrong key?)")
 
 

@@ -80,21 +80,42 @@ def _dec_pg(dec: Decoder) -> PgId:
 
 @register
 class MHello(Message):
-    """Connection handshake: who is on the other end (entity_addr_t role)."""
+    """Connection handshake: who is on the other end (entity_addr_t
+    role).  v2 appends the cephx session-negotiation fields: a fresh
+    nonce, the key id the hello is signed with, and an optional
+    mon-granted ticket (CephxSessionHandler / msgr2 auth frames role)."""
 
     TAG = 1
+    VERSION = 2
+    COMPAT = 1
 
-    def __init__(self, entity_name: str, addr: str):
+    def __init__(self, entity_name: str, addr: str,
+                 nonce: bytes = b"", kid: int = 0,
+                 ticket: bytes = b""):
         self.entity_name = entity_name
         self.addr = addr
+        self.nonce = nonce
+        self.kid = kid
+        self.ticket = ticket
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.string(self.entity_name)
         enc.string(self.addr)
+        enc.bytes(self.nonce)
+        enc.s32(self.kid)
+        enc.bytes(self.ticket)
 
     @classmethod
-    def decode_payload(cls, dec: Decoder) -> "MHello":
-        return cls(dec.string(), dec.string())
+    def decode(cls, data: bytes) -> "MHello":
+        dec = Decoder(data)
+        struct_v = dec.start(cls.VERSION)
+        msg = cls(dec.string(), dec.string())
+        if struct_v >= 2:
+            msg.nonce = dec.bytes()
+            msg.kid = dec.s32()
+            msg.ticket = dec.bytes()
+        dec.finish()
+        return msg
 
 
 PING = 0
@@ -888,6 +909,65 @@ class MMonForwardReply(Message):
     @classmethod
     def decode_payload(cls, dec: Decoder) -> "MMonForwardReply":
         return cls(dec.u64(), dec.s32(), json.loads(dec.string()))
+
+
+# -- cephx KDC (mon ticket service) -----------------------------------------
+
+
+@register
+class MAuth(Message):
+    """Client -> mon ticket request (MAuth role, CephxServiceHandler
+    two-step: stage 1 fetches a server challenge, stage 2 presents the
+    proof)."""
+
+    TAG = 27
+
+    def __init__(self, tid: int, entity: str, stage: int,
+                 kid: int = 0, client_challenge: bytes = b"",
+                 proof: bytes = b""):
+        self.tid = tid
+        self.entity = entity
+        self.stage = stage
+        self.kid = kid
+        self.client_challenge = client_challenge
+        self.proof = proof
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        enc.string(self.entity)
+        enc.u8(self.stage)
+        enc.s32(self.kid)
+        enc.bytes(self.client_challenge)
+        enc.bytes(self.proof)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MAuth":
+        return cls(dec.u64(), dec.string(), dec.u8(), dec.s32(),
+                   dec.bytes(), dec.bytes())
+
+
+@register
+class MAuthReply(Message):
+    """Mon -> client: server challenge (stage 1) or ticket (stage 2)."""
+
+    TAG = 28
+
+    def __init__(self, tid: int, rc: int,
+                 server_challenge: bytes = b"", ticket: bytes = b""):
+        self.tid = tid
+        self.rc = rc
+        self.server_challenge = server_challenge
+        self.ticket = ticket
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        enc.s32(self.rc)
+        enc.bytes(self.server_challenge)
+        enc.bytes(self.ticket)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MAuthReply":
+        return cls(dec.u64(), dec.s32(), dec.bytes(), dec.bytes())
 
 
 # -- small wire codecs shared by ShardOp omap payloads ----------------------

@@ -16,6 +16,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ceph_tpu.msg import Connection, Messenger
 from ceph_tpu.msg.messages import (
+    MAuth,
+    MAuthReply,
     MClientReply,
     MGetMap,
     MMonCommand,
@@ -178,7 +180,8 @@ class RadosClient:
                                                 msg.cookie))
             except (ConnectionError, OSError):
                 pass
-        elif isinstance(msg, (MOSDOpReply, MMonCommandReply,
+        elif isinstance(msg, (MAuthReply,
+                              MOSDOpReply, MMonCommandReply,
                               MOSDCommandReply, MClientReply)):
             fut = self._futures.pop(msg.tid, None)
             if fut is not None and not fut.done():
@@ -252,6 +255,46 @@ class RadosClient:
         mon = await self.msgr.connect(self.mon_addr)
         await mon.send(MGetMap(subscribe=True))
         await self.wait_for_new_map(1.0)
+
+    # -- cephx tickets (MonClient auth role) -------------------------------
+
+    async def auth_get_ticket(self) -> bytes:
+        """Fetch a mon-granted cephx ticket (two-step challenge proof,
+        CephxServiceHandler shape) and attach it to every subsequent
+        outbound connection's hello.  Services validate the ticket
+        offline and bind the connection's session key to it."""
+        from ceph_tpu.common import auth as auth_mod
+
+        keyring = self.msgr.secret
+        if keyring is None:
+            raise RadosError(-95, "auth disabled (no keyring)")
+        mon = await self.msgr.connect(self.mon_addr)
+
+        async def ask(msg):
+            fut = asyncio.get_running_loop().create_future()
+            self._futures[msg.tid] = fut
+            try:
+                await mon.send(msg)
+                return await asyncio.wait_for(fut, self.op_timeout)
+            finally:
+                self._futures.pop(msg.tid, None)
+
+        entity = self.msgr.entity_name
+        r1 = await ask(MAuth(self._next_tid(), entity, 1))
+        if r1.rc != 0:
+            raise RadosError(r1.rc, "auth stage 1 refused")
+        client_challenge = auth_mod.new_nonce()
+        proof = auth_mod.auth_proof(
+            keyring.active_key, entity, client_challenge,
+            bytes(r1.server_challenge))
+        r2 = await ask(MAuth(self._next_tid(), entity, 2,
+                             kid=keyring.active,
+                             client_challenge=client_challenge,
+                             proof=proof))
+        if r2.rc != 0:
+            raise RadosError(r2.rc, "auth proof rejected")
+        self.msgr.ticket = bytes(r2.ticket)
+        return self.msgr.ticket
 
     # -- mon commands ------------------------------------------------------
 
