@@ -267,21 +267,23 @@ class Messenger:
                 key, sig, pre[:frames.PREAMBLE.size], payload):
             raise frames.FrameError("hello signature mismatch"
                                     " (wrong key?)")
-        base = key
-        if msg.ticket:
-            chk = auth.check_ticket(self.secret, bytes(msg.ticket))
-            if chk is None:
-                raise frames.FrameError("invalid or expired ticket")
-            _entity, base = chk
         conn.rx_seq = seq
         conn.peer_name = msg.entity_name
         conn.peer_addr = msg.addr or conn.peer_addr
         if conn.outbound:
-            # acceptor's reply: session = f(base, my_nonce, its_nonce)
+            # acceptor's reply (never ticket-bearing): session =
+            # f(base chosen at connect, my_nonce, its_nonce)
             conn.session_key = auth.derive_session(
                 conn.base_key, conn.my_nonce, msg.nonce)
             conn.session_ready.set()
         else:
+            base = key
+            if msg.ticket:
+                chk = auth.check_ticket(self.secret, bytes(msg.ticket))
+                if chk is None:
+                    raise frames.FrameError("invalid or expired"
+                                            " ticket")
+                _entity, base = chk
             conn.base_key = base
             conn.reply_kid = msg.kid
             # reply with MY hello BEFORE arming the session, so the

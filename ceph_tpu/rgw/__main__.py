@@ -1,0 +1,48 @@
+"""Run the S3 gateway as a real process: python -m ceph_tpu.rgw
+
+The radosgw role: connects to the cluster, serves S3-over-HTTP with
+sigv4 auth.  Prints `RGW_ADDR <host:port>` once bound.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from ceph_tpu.rados.client import RadosClient
+from ceph_tpu.rgw import RGWLite
+from ceph_tpu.rgw.s3_frontend import S3Frontend
+
+
+async def _main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mon", type=str, required=True,
+                    help="mon address(es), comma-separated")
+    ap.add_argument("--port", type=int, default=7480)  # radosgw default
+    ap.add_argument("--data-pool", type=str, default="rgw.data")
+    ap.add_argument("--meta-pool", type=str, default="rgw.meta")
+    ap.add_argument("--access-key", type=str, required=True)
+    ap.add_argument("--secret-key", type=str, required=True)
+    ap.add_argument("--secret", type=str, default="",
+                    help="cluster cephx keyring")
+    args = ap.parse_args()
+    client = RadosClient(args.mon, name="client.rgw",
+                         secret=args.secret or None)
+    await client.connect()
+    rgw = RGWLite(client, args.data_pool, args.meta_pool)
+    fe = S3Frontend(rgw, {args.access_key: args.secret_key})
+    addr = await fe.start(port=args.port)
+    print(f"RGW_ADDR {addr}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await fe.stop()
+        await client.shutdown()
+
+
+if __name__ == "__main__":
+    try:
+        asyncio.run(_main())
+    except KeyboardInterrupt:
+        sys.exit(0)

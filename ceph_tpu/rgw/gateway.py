@@ -18,17 +18,18 @@ erasure-coded — BASELINE #5 uses EC 8+3); index/meta JSON docs go to a
 replicated META pool, mirroring the reference's pool split
 (default.rgw.buckets.data vs .index/.meta).
 
-ETags are hex crc32c of content (the repo's checksum tier) rather than
-MD5 — same uniqueness role, honest about not being S3-MD5-compatible.
+ETags are S3-compatible: hex MD5 of content for simple PUTs, and the
+multipart form md5(concat(part md5 digests))-"<nparts>" for completed
+multipart uploads — what stock S3 clients verify against.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from ceph_tpu.ops import checksum as cks
 from ceph_tpu.rgw.put_processor import (
     DEFAULT_STRIPE_SIZE,
     Manifest,
@@ -46,7 +47,7 @@ class RGWError(Exception):
 
 
 def _etag(data: bytes) -> str:
-    return format(cks.crc32c(0xFFFFFFFF, data), "08x")
+    return hashlib.md5(data).hexdigest()
 
 
 class RGWLite:
@@ -121,6 +122,12 @@ class RGWLite:
             raise RGWError("BucketAlreadyExists", bucket)
         await self._store(self._bucket_oid(bucket),
                           {"name": bucket, "objects": {}})
+        reg_oid = self._meta_oid("bucket.registry")
+        async with self._meta_lock(reg_oid):
+            reg = await self._load(reg_oid) or {"buckets": []}
+            if bucket not in reg["buckets"]:
+                reg["buckets"].append(bucket)
+                await self._store(reg_oid, reg)
 
     async def _bucket(self, bucket: str) -> Dict:
         doc = await self._load(self._bucket_oid(bucket))
@@ -128,9 +135,41 @@ class RGWLite:
             raise RGWError("NoSuchBucket", bucket)
         return doc
 
-    async def list_objects(self, bucket: str) -> List[Dict[str, Any]]:
+    async def list_objects(self, bucket: str,
+                           prefix: str = "") -> List[Dict[str, Any]]:
         doc = await self._bucket(bucket)
-        return [dict(v, key=k) for k, v in sorted(doc["objects"].items())]
+        return [dict(v, key=k)
+                for k, v in sorted(doc["objects"].items())
+                if k.startswith(prefix)]
+
+    async def list_buckets(self) -> List[str]:
+        """ListAllMyBuckets role — served from the bucket registry."""
+        doc = await self._load(self._meta_oid("bucket.registry")) or {}
+        return sorted(doc.get("buckets", []))
+
+    async def delete_bucket(self, bucket: str) -> None:
+        # emptiness check + removal under the bucket meta lock: a PUT
+        # linking a new object concurrently must not be orphaned by a
+        # delete that checked before the link landed
+        async with self._meta_lock(self._bucket_oid(bucket)):
+            doc = await self._bucket(bucket)
+            if doc["objects"]:
+                raise RGWError("BucketNotEmpty", bucket)
+            await self.meta.remove(self._bucket_oid(bucket))
+        reg_oid = self._meta_oid("bucket.registry")
+        async with self._meta_lock(reg_oid):
+            reg = await self._load(reg_oid) or {"buckets": []}
+            if bucket in reg["buckets"]:
+                reg["buckets"].remove(bucket)
+                await self._store(reg_oid, reg)
+
+    async def head_object(self, bucket: str, key: str
+                          ) -> Dict[str, Any]:
+        doc = await self._bucket(bucket)
+        entry = doc["objects"].get(key)
+        if entry is None:
+            raise RGWError("NoSuchKey", f"{bucket}/{key}")
+        return dict(entry, key=key)
 
     # -- atomic PUT / GET / DELETE ----------------------------------------
 
@@ -183,10 +222,16 @@ class RGWLite:
         return Manifest.from_dict(head["manifest"]), head["etag"]
 
     async def get_object(self, bucket: str, key: str) -> bytes:
-        """GET: walk the manifest, fetch stripes concurrently."""
+        data, _etag_ = await self.get_object_ex(bucket, key)
+        return data
+
+    async def get_object_ex(self, bucket: str,
+                            key: str) -> Tuple[bytes, str]:
+        """GET: walk the manifest, fetch stripes concurrently;
+        returns (bytes, etag) from ONE head load."""
         import asyncio
 
-        manifest, _ = await self._manifest(bucket, key)
+        manifest, etag = await self._manifest(bucket, key)
         sem = asyncio.Semaphore(self.aio_window)
 
         async def fetch(stripe: Dict) -> bytes:
@@ -200,7 +245,7 @@ class RGWLite:
         if len(out) != manifest.obj_size:
             raise RGWError("IncompleteBody",
                            f"{len(out)} != {manifest.obj_size}")
-        return out
+        return out, etag
 
     async def delete_object(self, bucket: str, key: str) -> None:
         manifest, _ = await self._manifest(bucket, key)
@@ -300,8 +345,10 @@ class RGWLite:
                 raise RGWError("InvalidPart", f"part {num}")
             stitched.append(Manifest.from_dict(part["manifest"]))
             etags.append(etag)
-        # multipart etag: hash of concatenated part hashes, "-<nparts>"
-        combined = _etag("".join(etags).encode()) + f"-{len(parts)}"
+        # multipart etag (S3 semantics): md5 over the concatenated
+        # part md5 DIGESTS (raw bytes, not hex), suffixed "-<nparts>"
+        combined = _etag(b"".join(
+            bytes.fromhex(e) for e in etags)) + f"-{len(parts)}"
         await self._link(bucket, key, stitched, combined)
         await self.meta.remove(self._upload_oid(bucket, key, upload_id))
         return combined
