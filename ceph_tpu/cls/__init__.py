@@ -132,6 +132,16 @@ class MethodContext:
         if rc != 0:
             raise ClsError(rc, "omap_set")
 
+    async def omap_rm_keys(self, keys) -> None:
+        from ceph_tpu.msg.messages import encode_str_list
+
+        self._need_wr()
+        rc = await self._d._op_omap_write(
+            self._state, self._pool, self.oid, "omap_rm",
+            encode_str_list(list(keys)), self._admit_epoch)
+        if rc != 0:
+            raise ClsError(rc, "omap_rm_keys")
+
     async def remove(self) -> None:
         self._need_wr()
         rc = await self._d._op_remove(self._state, self._pool,
@@ -174,11 +184,12 @@ class ClassHandler:
 def default_handler() -> ClassHandler:
     """The in-tree classes, registered (ClassHandler::open_all role)."""
     from ceph_tpu.cls import dir as dir_cls
-    from ceph_tpu.cls import hello, lock, numops
+    from ceph_tpu.cls import hello, journal, lock, numops
 
     handler = ClassHandler()
     dir_cls.register(handler)
     hello.register(handler)
+    journal.register(handler)
     lock.register(handler)
     numops.register(handler)
     return handler

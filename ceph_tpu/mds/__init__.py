@@ -10,13 +10,21 @@ metadata and do file DATA I/O directly against the data pool.
 
 Re-designs vs the reference, deliberate:
 
-- WRITE-THROUGH metadata instead of the MDS journal: every mutation
-  lands in the directory object's omap (replicated, logged, recovered
-  by RADOS) before the client sees an ack, so RADOS is the journal.
-  The reference's MDLog exists to batch and reorder updates for
-  latency; correctness comes from the same place (rados durability).
-  An MDS restart recovers by lazily reloading directory objects — no
-  replay phase.
+- The MDS JOURNAL (MDLog/EUpdate role, src/mds/journal.cc): every
+  metadata mutation — including compound ones like rename — is first
+  appended as ONE fenced journal entry (cls_journal on `mds_journal`),
+  then applied write-through to the directory objects.  Takeover
+  replays entries past the applied watermark before serving, so a
+  crash mid-compound-op always converges to the journaled state:
+  a SIGKILL mid-rename yields exactly-src (append never landed) or
+  exactly-dst (append landed, replay finishes it) — never both, never
+  neither.
+- FENCING (the mon-blocklist role): the journal object carries an
+  epoch; takeover bumps it (cls `take_over`) and every append/trim
+  from the deposed epoch fails EPERM server-side — a partitioned
+  ex-active physically cannot mutate metadata, with no cross-host
+  clock comparison anywhere.  Staleness detection for lock takeover
+  uses RENEWAL COUNTERS aged by the standby's own monotonic clock.
 - Active/standby election rides cls_lock: the active MDS holds an
   exclusive lock on the `mds_lock` object (renewed on a heartbeat
   interval, stored with its address); a standby polls, breaks a stale
@@ -27,6 +35,7 @@ Re-designs vs the reference, deliberate:
 
 Layout in the metadata pool:
   mds_lock                 cls_lock state + active MDS addr (xattr)
+  mds_journal              fenced journal (cls_journal omap entries)
   mds_ino                  omap: {"next": counter}
   dir.<ino:x>              omap: dentry name -> inode JSON
 File data objects (data pool): fsdata.<ino:x>.<blockno:016x>
@@ -68,7 +77,10 @@ ESTALE = -116
 ROOT_INO = 1
 LOCK_OBJ = "mds_lock"
 INO_OBJ = "mds_ino"
+JOURNAL_OBJ = "mds_journal"
 ADDR_ATTR = "mds.addr"
+# advance the applied watermark (and trim) after this many entries
+APPLIED_BATCH = 16
 
 
 def dir_obj(ino: int) -> str:
@@ -99,6 +111,7 @@ class MDSDaemon:
                               secret=parse_secret(secret))
         self.msgr.dispatcher = self._dispatch
         self.meta: Optional[IoCtx] = None
+        self.data_io: Optional[IoCtx] = None
         self.state = "standby"
         # dirty-free write-through cache: dir ino -> {name: inode dict}
         self._dirs: Dict[int, Dict[str, dict]] = {}
@@ -107,12 +120,25 @@ class MDSDaemon:
         # namespace mutations serialize through one lock (the MDS's
         # whole reason to exist); reads go lock-free off the cache
         self._mutation_lock = asyncio.Lock()
+        # journal state (valid while active)
+        self._epoch = 0        # fencing epoch from journal take_over
+        self._seq = 0          # next journal sequence
+        self._applied_mark = 0  # last watermark pushed to the journal
+        # renewal-counter staleness (no cross-host clocks): last seen
+        # renewal blob + the LOCAL monotonic time it changed
+        self._renew_counter = 0
+        self._seen_renewal: Optional[Tuple[bytes, float]] = None
+        # test failpoints (the reference's failpoint/killpoint role):
+        # simulate a crash just before/after the journal append
+        self._fail_before_journal = False
+        self._fail_after_journal = False
 
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self, port: int = 0) -> str:
         await self.client.connect()
         self.meta = self.client.open_ioctx(self.metadata_pool)
+        self.data_io = self.client.open_ioctx(self.data_pool)
         addr = await self.msgr.bind(port=port)
         self._lock_task = asyncio.get_running_loop().create_task(
             self._lock_loop())
@@ -157,20 +183,29 @@ class MDSDaemon:
         try:
             await self.meta.execute(LOCK_OBJ, "lock", "lock", req)
         except RadosError:
-            # someone else is active: stale-ness check — if their
-            # renewal stamp is old, break the lock and take over
+            # someone else is active: stale-ness check via RENEWAL
+            # COUNTERS aged by OUR monotonic clock — never comparing
+            # wall clocks across hosts (a skewed clock must not
+            # trigger a false takeover)
             if self.state == "active":
-                # lost our own lock (e.g. broken by a standby while we
-                # were partitioned): step down, drop caches
+                # lost our own lock (broken by a standby while we were
+                # partitioned): step down, drop caches.  The journal
+                # epoch fence already made our writes impotent.
                 log.warning("mds.%s: lost the active lock, standby",
                             self.name)
                 self.state = "standby"
                 self._dirs.clear()
             try:
                 raw = await self.meta.getxattr(LOCK_OBJ, "renewal")
-                holder, stamp = json.loads(raw)
-                if time.time() - stamp < self.lock_interval * 5:
-                    return  # holder is live
+                now = time.monotonic()
+                if self._seen_renewal is None or \
+                        self._seen_renewal[0] != raw:
+                    self._seen_renewal = (raw, now)
+                    return  # counter moved: holder is live
+                if now - self._seen_renewal[1] < \
+                        self.lock_interval * 5:
+                    return  # unchanged, but not for long enough
+                holder = json.loads(raw)[0]
                 await self.meta.execute(
                     LOCK_OBJ, "lock", "break_lock",
                     json.dumps({"name": "active",
@@ -180,17 +215,59 @@ class MDSDaemon:
             except (RadosError, ObjectNotFound, ValueError):
                 pass
             return
-        # lock held (fresh or renewal): stamp + publish the address
+        # lock held (fresh or renewal): stamp a counter + the address
+        self._renew_counter += 1
         await self.meta.setxattr(
             LOCK_OBJ, "renewal",
-            json.dumps([self.name, time.time()]).encode())
+            json.dumps([self.name, self._renew_counter]).encode())
         await self.meta.setxattr(LOCK_OBJ, ADDR_ATTR,
                                  self.msgr.addr.encode())
         if self.state != "active":
-            log.info("mds.%s: ACTIVE at %s", self.name, self.msgr.addr)
-            self.state = "active"
-            self._dirs.clear()  # cold cache: reload from rados
-            await self._ensure_root()
+            await self._take_over()
+
+    async def _take_over(self) -> None:
+        """Fence the previous active, replay its journal tail, serve.
+        (MDLog replay + the mon-blocklist fencing role.)"""
+        out = await self.meta.execute(JOURNAL_OBJ, "journal",
+                                      "take_over", b"")
+        self._epoch = int(out.decode())
+        self._dirs.clear()  # cold cache: reload from rados
+        await self._ensure_root()
+        await self._replay_journal()
+        log.info("mds.%s: ACTIVE at %s (epoch %d)", self.name,
+                 self.msgr.addr, self._epoch)
+        self.state = "active"
+
+    async def _replay_journal(self) -> None:
+        from ceph_tpu.cls.journal import ENTRY_PREFIX
+
+        raw = await self.meta.execute(JOURNAL_OBJ, "journal",
+                                      "get_state", b"")
+        st = json.loads(raw.decode())
+        applied = int(st["applied"])
+        try:
+            omap = await self.meta.omap_get(JOURNAL_OBJ)
+        except ObjectNotFound:
+            omap = {}
+        entries = sorted(
+            (int(k[len(ENTRY_PREFIX):]), v)
+            for k, v in omap.items() if k.startswith(ENTRY_PREFIX))
+        top = applied
+        for seq, blob in entries:
+            if seq <= applied:
+                continue
+            ops = json.loads(blob.decode())
+            await self._apply_ops(ops)
+            top = seq
+        self._seq = max(top, applied) + 1
+        self._applied_mark = top
+        await self.meta.execute(
+            JOURNAL_OBJ, "journal", "set_applied",
+            json.dumps({"epoch": self._epoch, "applied": top,
+                        "from": applied}).encode())
+        if top > applied:
+            log.info("mds.%s: replayed %d journal entries",
+                     self.name, top - applied)
 
     async def _ensure_root(self) -> None:
         try:
@@ -221,16 +298,115 @@ class MDSDaemon:
         self._dirs[ino] = entries
         return entries
 
-    async def _store_dentry(self, dir_ino: int, name: str,
-                            inode: Optional[dict]) -> None:
-        if inode is None:
-            await self.meta.omap_rm_keys(dir_obj(dir_ino), [name])
-            self._dirs.get(dir_ino, {}).pop(name, None)
-        else:
-            await self.meta.omap_set(
-                dir_obj(dir_ino),
-                {name: json.dumps(inode).encode()})
-            self._dirs.setdefault(dir_ino, {})[name] = inode
+    async def _guarded(self, method: str, oid: str, req: dict) -> None:
+        """Epoch-guarded apply write (cls journal guarded_*): the
+        fence xattr on each object refuses any epoch OLDER than one
+        that already touched it — the apply-phase half of fencing (a
+        deposed active can at most re-apply state the new active
+        already replayed, which is idempotent)."""
+        req = dict(req, epoch=self._epoch)
+        await self.meta.execute(oid, "journal", method,
+                                json.dumps(req).encode())
+
+    async def _apply_ops(self, ops) -> None:
+        """Apply one journal entry's ops write-through (idempotent:
+        absolute sets/removes, so replay after a partial apply
+        converges).  Every write is epoch-guarded."""
+        for op in ops:
+            kind = op["op"]
+            if kind == "dentry":
+                dir_ino, name, inode = op["dir"], op["name"], op["inode"]
+                val = None if inode is None else json.dumps(inode)
+                await self._guarded("guarded_update",
+                                    dir_obj(dir_ino),
+                                    {"set": {name: val}})
+                if inode is None:
+                    self._dirs.get(dir_ino, {}).pop(name, None)
+                else:
+                    self._dirs.setdefault(dir_ino, {})[name] = inode
+            elif kind == "mkdirobj":
+                await self._guarded("guarded_update",
+                                    dir_obj(op["ino"]), {"set": {}})
+            elif kind == "rmdirobj":
+                try:
+                    await self._guarded("guarded_remove",
+                                        dir_obj(op["ino"]), {})
+                except RadosError as e:
+                    if e.rc != ENOENT:
+                        raise
+                self._dirs.pop(op["ino"], None)
+            elif kind == "purgefile":
+                # a rename clobbered a file: its data objects have no
+                # dentry left to purge them through — best-effort
+                # server-side purge (the PurgeQueue role)
+                size = int(op.get("size", 0))
+                bs = max(1, int(op.get("block_size", 1 << 22)))
+                for blk in range((size + bs - 1) // bs):
+                    try:
+                        await self.data_io.remove(
+                            data_obj(op["ino"], blk))
+                    except (ObjectNotFound, RadosError):
+                        pass
+
+    class _CrashPoint(Exception):
+        """Test failpoint fired: simulate the daemon dying here."""
+
+    async def _commit(self, ops) -> None:
+        """One compound metadata update (the EUpdate role): fenced
+        journal append FIRST, then write-through apply.  The append is
+        the commit point — a crash after it is finished by the next
+        active's replay; a fenced append (EPERM: a newer epoch took
+        over) steps this MDS down without touching anything."""
+        if self._fail_before_journal:
+            await self._simulate_crash()
+            raise self._CrashPoint()
+        seq = self._seq
+        self._seq += 1
+        try:
+            await self.meta.execute(
+                JOURNAL_OBJ, "journal", "append",
+                json.dumps({"epoch": self._epoch, "seq": seq,
+                            "entry": ops}).encode())
+        except RadosError as e:
+            if e.rc == EPERM:
+                log.warning("mds.%s: journal append fenced — a newer"
+                            " active exists; stepping down",
+                            self.name)
+                self.state = "standby"
+                self._dirs.clear()
+                raise MDSError(ESTALE, "fenced by a newer active")
+            # transient rados failure: the mutation did NOT commit;
+            # stay active (stepping down on EAGAIN would turn OSD
+            # churn into MDS failover storms)
+            raise MDSError(EIO, f"journal append failed ({e.rc})")
+        if self._fail_after_journal:
+            await self._simulate_crash()
+            raise self._CrashPoint()
+        await self._apply_ops(ops)
+        if seq - self._applied_mark >= APPLIED_BATCH:
+            prev = self._applied_mark
+            self._applied_mark = seq
+            try:
+                await self.meta.execute(
+                    JOURNAL_OBJ, "journal", "set_applied",
+                    json.dumps({"epoch": self._epoch, "applied": seq,
+                                "from": prev}).encode())
+            except RadosError:
+                pass  # fenced trim: the new active owns the journal
+
+    async def _simulate_crash(self) -> None:
+        """Failpoint: die like a SIGKILL — stop serving instantly,
+        leave all rados state exactly as it is."""
+        self._stopping = True
+        self.state = "killed"
+        if self._lock_task is not None:
+            self._lock_task.cancel()
+        await self.msgr.shutdown()
+
+    @staticmethod
+    def _dentry(dir_ino: int, name: str, inode) -> dict:
+        return {"op": "dentry", "dir": dir_ino, "name": name,
+                "inode": inode}
 
     # -- path resolution (MDCache::path_traverse role) ---------------------
 
@@ -301,11 +477,11 @@ class MDSDaemon:
         if existing is not None:
             return EEXIST, {}
         ino = await self._alloc_ino()
-        await self.meta.omap_set(dir_obj(ino), {})
         inode = {"ino": ino, "type": "dir",
                  "mode": args.get("mode", 0o755),
                  "size": 0, "mtime": self._now()}
-        await self._store_dentry(parent, name, inode)
+        await self._commit([{"op": "mkdirobj", "ino": ino},
+                            self._dentry(parent, name, inode)])
         return 0, {"inode": inode}
 
     async def _op_create(self, args) -> Tuple[int, Dict[str, Any]]:
@@ -323,7 +499,7 @@ class MDSDaemon:
                  "mode": args.get("mode", 0o644),
                  "size": 0, "mtime": self._now(),
                  "block_size": int(args.get("block_size", 1 << 22))}
-        await self._store_dentry(parent, name, inode)
+        await self._commit([self._dentry(parent, name, inode)])
         return 0, {"inode": inode}
 
     async def _op_symlink(self, args) -> Tuple[int, Dict[str, Any]]:
@@ -334,7 +510,7 @@ class MDSDaemon:
         inode = {"ino": ino, "type": "symlink",
                  "mode": 0o777, "size": len(args["target"]),
                  "mtime": self._now(), "target": args["target"]}
-        await self._store_dentry(parent, name, inode)
+        await self._commit([self._dentry(parent, name, inode)])
         return 0, {"inode": inode}
 
     async def _op_lookup(self, args) -> Tuple[int, Dict[str, Any]]:
@@ -368,7 +544,7 @@ class MDSDaemon:
             return ENOENT, {}
         if inode["type"] == "dir":
             return EISDIR, {}
-        await self._store_dentry(parent, name, None)
+        await self._commit([self._dentry(parent, name, None)])
         return 0, {"inode": inode}  # client purges the data objects
 
     async def _op_rmdir(self, args) -> Tuple[int, Dict[str, Any]]:
@@ -380,12 +556,8 @@ class MDSDaemon:
         entries = await self._load_dir(inode["ino"])
         if entries:
             return ENOTEMPTY, {}
-        await self._store_dentry(parent, name, None)
-        try:
-            await self.meta.remove(dir_obj(inode["ino"]))
-        except ObjectNotFound:
-            pass
-        self._dirs.pop(inode["ino"], None)
+        await self._commit([self._dentry(parent, name, None),
+                            {"op": "rmdirobj", "ino": inode["ino"]}])
         return 0, {}
 
     async def _op_rename(self, args) -> Tuple[int, Dict[str, Any]]:
@@ -404,12 +576,25 @@ class MDSDaemon:
                     return ENOTEMPTY, {}
             elif inode["type"] == "dir":
                 return ENOTDIR, {}
-        # link target first, unlink source second: a crash between the
-        # two leaves an extra (visible, fsck-able) link rather than a
-        # lost file — the MDS journal's EUpdate would make this atomic
-        await self._store_dentry(dst_parent, dst_name, inode)
+        # ONE journal entry carries both dentry ops: rename is
+        # crash-atomic — the append is the commit point, replay
+        # finishes a half-applied rename (journal.cc EUpdate role).
+        # Clobbered targets are cleaned up in the same entry: an empty
+        # dir's object is removed, a file's data objects purged.
+        ops = [self._dentry(dst_parent, dst_name, inode)]
         if (src_parent, src_name) != (dst_parent, dst_name):
-            await self._store_dentry(src_parent, src_name, None)
+            ops.append(self._dentry(src_parent, src_name, None))
+            if existing is not None and existing["ino"] != inode["ino"]:
+                if existing["type"] == "dir":
+                    ops.append({"op": "rmdirobj",
+                                "ino": existing["ino"]})
+                elif existing["type"] == "file":
+                    ops.append({"op": "purgefile",
+                                "ino": existing["ino"],
+                                "size": existing.get("size", 0),
+                                "block_size": existing.get(
+                                    "block_size", 1 << 22)})
+        await self._commit(ops)
         return 0, {"inode": inode}
 
     async def _op_setattr(self, args) -> Tuple[int, Dict[str, Any]]:
@@ -429,7 +614,7 @@ class MDSDaemon:
             inode["size"] = new
         if changed:
             inode["mtime"] = args.get("mtime", self._now())
-            await self._store_dentry(parent, name, inode)
+            await self._commit([self._dentry(parent, name, inode)])
         return 0, {"inode": inode}
 
 

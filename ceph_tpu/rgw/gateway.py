@@ -122,12 +122,6 @@ class RGWLite:
             raise RGWError("BucketAlreadyExists", bucket)
         await self._store(self._bucket_oid(bucket),
                           {"name": bucket, "objects": {}})
-        reg_oid = self._meta_oid("bucket.registry")
-        async with self._meta_lock(reg_oid):
-            reg = await self._load(reg_oid) or {"buckets": []}
-            if bucket not in reg["buckets"]:
-                reg["buckets"].append(bucket)
-                await self._store(reg_oid, reg)
 
     async def _bucket(self, bucket: str) -> Dict:
         doc = await self._load(self._bucket_oid(bucket))
@@ -143,9 +137,13 @@ class RGWLite:
                 if k.startswith(prefix)]
 
     async def list_buckets(self) -> List[str]:
-        """ListAllMyBuckets role — served from the bucket registry."""
-        doc = await self._load(self._meta_oid("bucket.registry")) or {}
-        return sorted(doc.get("buckets", []))
+        """ListAllMyBuckets role — the bucket.index objects ARE the
+        truth (a separate registry doc could desync on a crash between
+        two writes); enumerate them from the meta pool."""
+        prefix = self._bucket_oid("")
+        names = await self.meta.list_objects()
+        return sorted(n[len(prefix):] for n in names
+                      if n.startswith(prefix))
 
     async def delete_bucket(self, bucket: str) -> None:
         # emptiness check + removal under the bucket meta lock: a PUT
@@ -156,12 +154,6 @@ class RGWLite:
             if doc["objects"]:
                 raise RGWError("BucketNotEmpty", bucket)
             await self.meta.remove(self._bucket_oid(bucket))
-        reg_oid = self._meta_oid("bucket.registry")
-        async with self._meta_lock(reg_oid):
-            reg = await self._load(reg_oid) or {"buckets": []}
-            if bucket in reg["buckets"]:
-                reg["buckets"].remove(bucket)
-                await self._store(reg_oid, reg)
 
     async def head_object(self, bucket: str, key: str
                           ) -> Dict[str, Any]:
@@ -198,10 +190,15 @@ class RGWLite:
         list role)."""
         head_doc = self._meta_oid("head", bucket, key)
         old = await self._load(head_doc)
-        await self._store(head_doc,
-                          {"manifest": manifest.to_dict(), "etag": etag})
+        # head store + index entry BOTH under the bucket lock, with the
+        # existence check inside: a concurrent delete_bucket (which
+        # holds the same lock for its emptiness check) can never strand
+        # an orphaned head doc that would resurrect as a phantom object
+        # when the bucket name is recreated
         async with self._meta_lock(self._bucket_oid(bucket)):
             doc = await self._bucket(bucket)
+            await self._store(head_doc, {"manifest": manifest.to_dict(),
+                                         "etag": etag})
             doc["objects"][key] = {"size": manifest.obj_size,
                                    "etag": etag, "mtime": time.time()}
             await self._store(self._bucket_oid(bucket), doc)

@@ -112,6 +112,11 @@ class S3Frontend:
                     return  # malformed framing: drop the connection
                 if length > MAX_BODY or length < 0:
                     return
+                if length and not self._plausible_auth(headers):
+                    # screen BEFORE buffering: an unauthenticated peer
+                    # must not make the gateway hold a multi-GiB body
+                    # in memory just to 403 it
+                    return
                 body = await reader.readexactly(length) if length else b""
                 keep = headers.get("connection", "").lower() != "close"
                 status, rhdrs, rbody = await self._handle(
@@ -140,6 +145,18 @@ class S3Frontend:
                 writer.close()
             except Exception:
                 pass
+
+    def _plausible_auth(self, headers: Dict[str, str]) -> bool:
+        """Cheap pre-body screen: sigv4-shaped Authorization with a
+        KNOWN access key (full verification still runs on the body)."""
+        authz = headers.get("authorization", "")
+        if not authz.startswith("AWS4-HMAC-SHA256 "):
+            return False
+        for part in authz[len("AWS4-HMAC-SHA256 "):].split(","):
+            k, _, v = part.strip().partition("=")
+            if k == "Credential":
+                return v.split("/", 1)[0] in self.users
+        return False
 
     # -- sigv4 -------------------------------------------------------------
 

@@ -185,3 +185,124 @@ def test_standby_mds_takes_over():
             await _teardown(cluster, mdss)
 
     run(main())
+
+
+def test_rename_crash_atomicity():
+    """SIGKILLing the MDS mid-rename never leaves both or neither
+    dentry: crash BEFORE the journal append -> exactly the source;
+    crash AFTER the append -> the standby's replay finishes the
+    rename -> exactly the destination (the MDLog/EUpdate property)."""
+
+    async def main():
+        from ceph_tpu.msg.messages import MClientRequest
+
+        cluster, mdss, fs = await _fs_cluster(num_mds=2)
+
+        async def one_shot_rename(addr, src, dst):
+            """Single unretried request — the dying MDS never answers,
+            exactly like a client watching its server get SIGKILLed."""
+            client = cluster.client
+            tid = client._next_tid()
+            fut = asyncio.get_running_loop().create_future()
+            client._futures[tid] = fut
+            try:
+                await client.msgr.send_to(addr, MClientRequest(
+                    tid, "rename", {"src": src, "dst": dst}))
+                await asyncio.wait_for(fut, 3.0)
+            except Exception:
+                pass
+            finally:
+                client._futures.pop(tid, None)
+
+        try:
+            mds_a, mds_b = mdss
+            await fs.mkdir("/d1")
+            await fs.mkdir("/d2")
+            await fs.write_file("/d1/x", b"payload-x")
+            await fs.write_file("/d1/y", b"payload-y")
+            active = mds_a if mds_a.state == "active" else mds_b
+
+            # crash BEFORE the append: rename never happened
+            active._fail_before_journal = True
+            await one_shot_rename(active.msgr.addr, "/d1/x", "/d2/x")
+            for _ in range(100):
+                if any(m.state == "active" and m is not active
+                       for m in mdss):
+                    break
+                await asyncio.sleep(0.2)
+            names1 = await fs.listdir("/d1")
+            names2 = await fs.listdir("/d2")
+            assert "x" in names1 and "x" not in names2, \
+                (names1, names2)
+            assert await fs.read_file("/d1/x") == b"payload-x"
+
+            # crash AFTER the append (mid-rename, nothing applied):
+            # replay must FINISH the rename.  Phase 1 consumed one
+            # standby, so enlist a fresh one first.
+            from ceph_tpu.mds import MDSDaemon
+
+            survivor = MDSDaemon(cluster.mon.addr, "cephfs.meta",
+                                 "cephfs.data", name="c",
+                                 lock_interval=0.3)
+            await survivor.start()
+            mdss.append(survivor)
+            active2 = next(m for m in mdss if m.state == "active")
+            active2._fail_after_journal = True
+            await one_shot_rename(active2.msgr.addr, "/d1/y", "/d2/y")
+            for _ in range(100):
+                if survivor.state == "active":
+                    break
+                await asyncio.sleep(0.2)
+            assert survivor.state == "active"
+            names1 = await fs.listdir("/d1")
+            names2 = await fs.listdir("/d2")
+            assert "y" not in names1 and "y" in names2, \
+                (names1, names2)
+            assert await fs.read_file("/d2/y") == b"payload-y"
+        finally:
+            await _teardown(cluster, mdss)
+
+    run(main())
+
+
+def test_deposed_active_is_fenced():
+    """The ADVICE finding: a partitioned ex-active whose lock a
+    standby broke must not be able to land metadata mutations — the
+    journal epoch fence rejects its appends server-side (no clocks
+    involved)."""
+
+    async def main():
+        cluster, mdss, fs = await _fs_cluster(num_mds=2)
+        try:
+            mds_a, mds_b = mdss
+            await fs.mkdir("/safe")
+            old = mds_a if mds_a.state == "active" else mds_b
+            new = mds_b if old is mds_a else mds_a
+            # freeze the old active's lock loop (partition): it still
+            # believes it is active and keeps its warm cache
+            old._lock_task.cancel()
+            # the standby breaks the stale lock and takes over
+            for _ in range(150):
+                if new.state == "active":
+                    break
+                await asyncio.sleep(0.2)
+            assert new.state == "active"
+            # the deposed active tries to mutate directly: the fenced
+            # journal append must refuse and step it down
+            from ceph_tpu.mds import MDSError
+            with pytest.raises(MDSError):
+                await old._commit([old._dentry(1, "evil",
+                                               {"ino": 999,
+                                                "type": "file",
+                                                "mode": 0o644,
+                                                "size": 0,
+                                                "mtime": 0})])
+            assert old.state == "standby"
+            # namespace unpolluted; the NEW active serves writes fine
+            assert "evil" not in await fs.listdir("/")
+            await fs.write_file("/safe/f", b"after fencing")
+            assert await fs.read_file("/safe/f") == b"after fencing"
+        finally:
+            await _teardown(cluster, mdss)
+
+    run(main())
