@@ -37,21 +37,18 @@ async def add(ctx: MethodContext, data: bytes) -> bytes:
 
 
 async def remove(ctx: MethodContext, data: bytes) -> bytes:
+    """{key, value?}: remove an entry; with `value`, only if the
+    stored value still matches (compare-and-swap — a racing writer who
+    replaced the entry must not have it deleted under them)."""
     req = json.loads(data.decode())
     key = req.get("key")
     omap = await _omap(ctx)
     if key not in omap:
         raise ClsError(ENOENT, f"no entry {key!r}")
-    # omap_rm through the engine op (MethodContext has set; rm rides
-    # the same ShardOp path)
-    from ceph_tpu.msg.messages import encode_str_list
-
-    ctx._need_wr()
-    rc = await ctx._d._op_omap_write(
-        ctx._state, ctx._pool, ctx.oid, "omap_rm",
-        encode_str_list([key]), ctx._admit_epoch)
-    if rc != 0:
-        raise ClsError(rc, "omap_rm")
+    expect = req.get("value")
+    if expect is not None and omap[key].decode() != expect:
+        raise ClsError(EEXIST, f"{key!r} value changed")
+    await ctx.omap_rm_keys([key])
     return b""
 
 

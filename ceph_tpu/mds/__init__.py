@@ -382,7 +382,22 @@ class MDSDaemon:
         if self._fail_after_journal:
             await self._simulate_crash()
             raise self._CrashPoint()
-        await self._apply_ops(ops)
+        try:
+            await self._apply_ops(ops)
+        except RadosError as e:
+            if e.rc == EPERM:
+                # a newer epoch fenced the APPLY phase mid-op: the
+                # entry IS journaled — the new active's replay commits
+                # it.  Step down and tell the client to re-resolve
+                # (retrying against us would double-report failure for
+                # an op that took effect).
+                log.warning("mds.%s: apply fenced mid-op — stepping"
+                            " down (the entry is journaled; the new"
+                            " active replays it)", self.name)
+                self.state = "standby"
+                self._dirs.clear()
+                raise MDSError(ESTALE, "fenced during apply")
+            raise
         if seq - self._applied_mark >= APPLIED_BATCH:
             prev = self._applied_mark
             self._applied_mark = seq

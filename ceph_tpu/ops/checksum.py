@@ -363,6 +363,32 @@ if HAVE_JAX:
             part = left ^ pairs[..., 1, :]
         return part[..., 0, :]
 
+    def crc32c_partial_bits_words(words, consts):
+        """crc32c_partial_bits over the device-native int32 WORD layout
+        (..., L//4): bit k of a little-endian word is bit k%8 of byte
+        k//8, so a 0..31 shift unpack yields exactly the byte-then-bit
+        order the cell matrix expects — words stay words, no uint8
+        relayout (that relayout costs more than the whole crc)."""
+        length = consts["length"]
+        levels = consts["levels"]
+        ncells = 1 << levels
+        lead = (ncells * _CELL - length) // 4
+        if lead:
+            pad = [(0, 0)] * (words.ndim - 1) + [(lead, 0)]
+            words = jnp.pad(words, pad)
+        cells = words.reshape(*words.shape[:-1], ncells, _CELL // 4)
+        shifts = jnp.arange(32, dtype=jnp.int32)
+        bits = ((cells[..., :, None] >> shifts) & 1).reshape(
+            *words.shape[:-1], ncells, _CELL * 8)
+        part = _mod2_matmul(bits, consts["cell_mat_t"])
+        for lvl in range(levels):
+            pairs = part.reshape(*part.shape[:-2],
+                                 part.shape[-2] // 2, 2, 32)
+            left = _mod2_matmul(pairs[..., 0, :],
+                                consts["advances"][lvl])
+            part = left ^ pairs[..., 1, :]
+        return part[..., 0, :]
+
     def crc32c_pack_bits(bits):
         """(..., 32) 0/1 int32 -> (...,) uint32."""
         return jnp.sum(bits.astype(jnp.uint32)

@@ -28,7 +28,6 @@ can host them later without changing the layout.
 from __future__ import annotations
 
 import asyncio
-import hashlib
 import json
 from typing import Any, Dict, List, Optional
 
@@ -57,19 +56,59 @@ class RBD:
         metadata stays on this replicated pool (--data-pool role)."""
         if not (12 <= order <= 26):
             raise RadosError(-22, f"order {order} out of range")
-        digest = hashlib.sha1(name.encode()).hexdigest()[:10]
-        image_id = f"{ioctx.pool_id:x}{digest}"
-        # claim the name FIRST, atomically server-side (cls dir.add is
-        # check-and-set under the object lock — cls_rbd dir_add_image):
-        # two concurrent creators race the claim, not the metadata
-        await ioctx.execute(
-            RBD_DIRECTORY, "dir", "add",
-            json.dumps({"key": f"name_{name}",
-                        "value": image_id}).encode())
+        # FRESH unique id per create (the reference allocates one from
+        # rbd_directory too): remove+recreate must never reuse an id,
+        # or leftovers from a partially failed remove would resurface
+        # as data inside the new image
+        import os as _os
+
+        image_id = f"{ioctx.pool_id:x}{_os.urandom(6).hex()}"
+        # header FIRST, name claim SECOND: a crash in between leaves
+        # only an invisible orphan header (garbage, reclaimable name) —
+        # the reverse order left a claimed name with no header that
+        # could never be recreated
         meta = {"name": name, "size": size, "order": order,
                 "snaps": {}, "snap_seq": 0, "data_pool": data_pool}
         await ioctx.omap_set(_header(image_id),
                              {"rbd": json.dumps(meta).encode()})
+        try:
+            await ioctx.execute(
+                RBD_DIRECTORY, "dir", "add",
+                json.dumps({"key": f"name_{name}",
+                            "value": image_id}).encode())
+        except RadosError:
+            # name taken — but a previous crash may have left a claim
+            # whose header never landed (the old create order): that
+            # name is RECLAIMABLE, anything else is a real EEXIST
+            directory = await self._dir(ioctx)
+            old_id = directory.get(name)
+            stale = old_id is not None
+            if stale:
+                try:
+                    await ioctx.omap_get(_header(old_id))
+                    stale = False  # live image: real conflict
+                except ObjectNotFound:
+                    pass
+            if not stale:
+                await _ignore_enoent(ioctx.remove(_header(image_id)))
+                raise RadosError(-17, f"image {name!r} exists")
+            try:
+                # value-checked removal: only the EXACT stale claim we
+                # adjudicated dies — a racing reclaimer who already
+                # replaced it must not lose its fresh claim
+                await ioctx.execute(
+                    RBD_DIRECTORY, "dir", "remove",
+                    json.dumps({"key": f"name_{name}",
+                                "value": old_id}).encode())
+                await ioctx.execute(
+                    RBD_DIRECTORY, "dir", "add",
+                    json.dumps({"key": f"name_{name}",
+                                "value": image_id}).encode())
+            except RadosError:
+                # lost the reclaim race: clean up our header, surface
+                # EEXIST like any other conflict
+                await _ignore_enoent(ioctx.remove(_header(image_id)))
+                raise RadosError(-17, f"image {name!r} exists")
         return image_id
 
     async def remove(self, ioctx: IoCtx, name: str) -> None:
@@ -85,9 +124,15 @@ class RBD:
             _ignore_enoent(img.data_ioctx.remove(_data(image_id, i)))
             for i in range(objects)))
         await _ignore_enoent(ioctx.remove(_header(image_id)))
-        await ioctx.execute(
-            RBD_DIRECTORY, "dir", "remove",
-            json.dumps({"key": f"name_{name}"}).encode())
+        try:
+            # value-checked: if a concurrent create already reclaimed
+            # the name with a fresh id, its claim must survive
+            await ioctx.execute(
+                RBD_DIRECTORY, "dir", "remove",
+                json.dumps({"key": f"name_{name}",
+                            "value": image_id}).encode())
+        except RadosError:
+            pass
 
     async def list(self, ioctx: IoCtx) -> List[str]:
         return sorted(await self._dir(ioctx))
@@ -98,7 +143,15 @@ class RBD:
         if image_id is None:
             raise ObjectNotFound(-2, name)
         img = Image(ioctx, name, image_id)
-        await img.refresh()
+        try:
+            await img.refresh()
+        except ObjectNotFound:
+            # a half-created image (claim without header, pre-crash):
+            # clear error instead of a raw header miss; create() can
+            # reclaim the name
+            raise RadosError(
+                -5, f"image {name!r} has no header (interrupted"
+                    " create?); re-create to reclaim the name")
         return img
 
     async def _dir(self, ioctx: IoCtx) -> Dict[str, str]:

@@ -189,14 +189,15 @@ class RGWLite:
         then garbage-collect the replaced object's stripes (the GC
         list role)."""
         head_doc = self._meta_oid("head", bucket, key)
-        old = await self._load(head_doc)
-        # head store + index entry BOTH under the bucket lock, with the
-        # existence check inside: a concurrent delete_bucket (which
-        # holds the same lock for its emptiness check) can never strand
-        # an orphaned head doc that would resurrect as a phantom object
-        # when the bucket name is recreated
+        # old-head read, head store and index entry ALL under the
+        # bucket lock: a concurrent PUT to the same key must observe
+        # the winner's head (or the winner observes its), or the
+        # loser's stripes are never referenced and never GC'd; a
+        # concurrent delete_bucket (same lock) can never strand an
+        # orphaned head doc either
         async with self._meta_lock(self._bucket_oid(bucket)):
             doc = await self._bucket(bucket)
+            old = await self._load(head_doc)
             await self._store(head_doc, {"manifest": manifest.to_dict(),
                                          "etag": etag})
             doc["objects"][key] = {"size": manifest.obj_size,

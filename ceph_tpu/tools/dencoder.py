@@ -26,7 +26,8 @@ from ceph_tpu.osd.osdmap import Incremental, OSDMap
 def _jsonable(obj, depth: int = 0):
     if depth > 6:
         return repr(obj)
-    if isinstance(obj, bytes):
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        obj = bytes(obj)
         return {"__bytes__": len(obj),
                 "hex_head": obj[:32].hex()}
     if isinstance(obj, dict):
@@ -55,6 +56,150 @@ def _message_types() -> dict:
             for cls in msgmod._REGISTRY.values()}
 
 
+def _samples():
+    """One representative, field-populated instance of EVERY versioned
+    wire type — the corpus generator (ceph-object-corpus role).  Keep
+    values deterministic: the corpus pins bytes, and the dump compare
+    pins semantics."""
+    from ceph_tpu.osd.osdmap import PgId
+
+    m = msgmod
+    pg = PgId(3, 5)
+    entry = {"version": [7, 42], "prior": [7, 41], "oid": "obj-1",
+             "op": "modify", "size": 4096}
+    info = {"last_update": [7, 42], "log_tail": [1, 2],
+            "missing": {"obj-2": [7, 40]},
+            "objects": ["obj-1", "obj-2"]}
+    osdmap = OSDMap.build_simple(6, osds_per_host=2)
+    scratch = OSDMap.decode(osdmap.encode())
+    pool = scratch.create_pool("corpus", type_=1, size=3, pg_num=8)
+    inc = Incremental(epoch=osdmap.epoch + 1)
+    inc.new_pools[pool.id] = pool
+    inc.new_up_osds[2] = "127.0.0.1:6801"
+    inc.new_weight[3] = 0x10000
+    inc.new_pg_upmap_items[pg] = [(1, 4)]
+    ops = [m.OSDOp("write_full", data=b"corpus-bytes" * 10),
+           m.OSDOp("read", offset=512, length=1024)]
+    shard_ops = [m.ShardOp("write", 128, b"shard-data"),
+                 m.ShardOp("setattr", name="_", value=b"{}"),
+                 m.ShardOp("remove")]
+    yield "OSDMap", osdmap
+    yield "OSDMap::Incremental", inc
+    yield "MHello", m.MHello("osd.1", "127.0.0.1:6800",
+                             nonce=b"n" * 16, kid=2, ticket=b"tkt")
+    yield "MPing", m.MPing(1, 12.5, epoch=9, from_osd=4)
+    yield "MOSDBoot", m.MOSDBoot(2, "127.0.0.1:6802", boot_epoch=5)
+    yield "MOSDFailure", m.MOSDFailure(3, 1, 7.25, 11)
+    yield "MGetMap", m.MGetMap(since_epoch=8, subscribe=True)
+    yield "MOSDMapMsg", m.MOSDMapMsg(
+        12, full_map=osdmap.encode(), incrementals=[inc.encode()],
+        gap_unfillable=True)
+    yield "MMonCommand", m.MMonCommand(77, {"prefix": "status"})
+    yield "MMonCommandReply", m.MMonCommandReply(77, 0, {"ok": True})
+    yield "MOSDOp", m.MOSDOp(88, "client.abc", pg, "obj-1", ops, 12,
+                             snapc_seq=4, snapc_snaps=[4, 2],
+                             snap_id=3)
+    yield "MOSDOpReply", m.MOSDOpReply(88, 0, b"reply-data",
+                                       {"size": 10}, replay_epoch=13)
+    yield "MOSDSubWrite", m.MOSDSubWrite(99, pg, 2, "obj-1",
+                                         shard_ops, 12, entry, 1,
+                                         guard=(7, 41))
+    yield "MOSDSubWriteReply", m.MOSDSubWriteReply(99, 0, 2)
+    yield "MOSDSubRead", m.MOSDSubRead(100, pg, 1, "obj-1", 0, 4096,
+                                       True, True)
+    yield "MOSDSubReadReply", m.MOSDSubReadReply(
+        100, 0, b"sub-data", {"_": b"{}"}, 1, {"k": b"v"})
+    yield "MPGQuery", m.MPGQuery(101, pg, 12, 0, shard=2)
+    yield "MPGLogMsg", m.MPGLogMsg(102, pg, 1, info, [entry],
+                                   epoch=12, from_osd=0,
+                                   is_reply=True)
+    yield "MWatchNotify", m.MWatchNotify(5, 3, "obj-1",
+                                         b"notify-payload", 9)
+    yield "MWatchNotifyAck", m.MWatchNotifyAck(5, 9)
+    yield "MOSDCommand", m.MOSDCommand(103, {"prefix": "perf dump"})
+    yield "MOSDCommandReply", m.MOSDCommandReply(103, 0,
+                                                 {"counters": {}})
+    yield "MClientRequest", m.MClientRequest(104, "mkdir",
+                                             {"path": "/a"})
+    yield "MClientReply", m.MClientReply(104, 0, {"inode": {"ino": 7}})
+    yield "MMonElection", m.MMonElection(3, 15, 1, quorum=[0, 1, 2])
+    yield "MMonPaxos", m.MMonPaxos(
+        5, pn=201, version=9, value=b"paxos-value",
+        last_committed=8, first_committed=1, values={9: b"paxos-value"},
+        lease=2.0, uncommitted_pn=101, from_rank=1)
+    yield "MMonForward", m.MMonForward(6, 7, b"inner-payload")
+    yield "MMonForwardReply", m.MMonForwardReply(6, 0, {"done": 1})
+    yield "MAuth", m.MAuth(105, "client.x", 2, kid=1,
+                           client_challenge=b"c" * 16,
+                           proof=b"p" * 8)
+    yield "MAuthReply", m.MAuthReply(105, 0, b"s" * 16, b"ticket")
+
+
+def _dump(obj) -> dict:
+    return _jsonable(obj)
+
+
+def _decode_named(name: str, blob: bytes):
+    if name in TYPES:
+        return TYPES[name][0](blob)
+    cls = _message_types()[name]
+    return cls.decode(blob)
+
+
+def corpus_create(directory: str) -> int:
+    """Write <dir>/<Type>.bin + .json for every versioned type
+    (ceph-object-corpus generation, readable.sh's archive step)."""
+    import os
+
+    os.makedirs(directory, exist_ok=True)
+    n = 0
+    for name, obj in _samples():
+        blob = obj.encode()
+        safe = name.replace(":", "_")
+        with open(os.path.join(directory, safe + ".bin"), "wb") as f:
+            f.write(blob)
+        with open(os.path.join(directory, safe + ".json"), "w") as f:
+            json.dump({"type": name, "dump": _dump(obj)}, f, indent=1,
+                      sort_keys=True)
+        n += 1
+    print(f"archived {n} types into {directory}")
+    return 0
+
+
+def corpus_check(directory: str) -> int:
+    """Decode every archived blob with TODAY's code and compare its
+    dump against the archived one (readable.sh's check step): a wire
+    change that breaks decoding of an older release's bytes — or
+    silently changes their meaning — fails here."""
+    import glob
+    import os
+
+    failures = 0
+    count = 0
+    for jpath in sorted(glob.glob(os.path.join(directory, "*.json"))):
+        with open(jpath) as f:
+            doc = json.load(f)
+        name = doc["type"]
+        with open(jpath[:-5] + ".bin", "rb") as f:
+            blob = f.read()
+        count += 1
+        try:
+            got = _dump(_decode_named(name, blob))
+        except Exception as e:
+            print(f"FAIL {name}: decode raised {e!r}")
+            failures += 1
+            continue
+        if got != doc["dump"]:
+            print(f"FAIL {name}: dump drifted")
+            for k in set(got) | set(doc["dump"]):
+                if got.get(k) != doc["dump"].get(k):
+                    print(f"  field {k}: archived="
+                          f"{doc['dump'].get(k)!r} now={got.get(k)!r}")
+            failures += 1
+    print(f"checked {count} archived types, {failures} failures")
+    return 1 if failures else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="dencoder")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -67,8 +212,16 @@ def main(argv=None) -> int:
     msg.add_argument("verbs", nargs="+",
                      help="import <file> | decode  (tagged frame:"
                           " 2-byte LE tag + payload)")
+    cc = sub.add_parser("corpus_create")
+    cc.add_argument("directory")
+    ck = sub.add_parser("corpus_check")
+    ck.add_argument("directory")
     args = ap.parse_args(argv)
 
+    if args.cmd == "corpus_create":
+        return corpus_create(args.directory)
+    if args.cmd == "corpus_check":
+        return corpus_check(args.directory)
     if args.cmd == "list_types":
         for name in sorted(TYPES):
             print(name)

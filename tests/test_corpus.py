@@ -1,0 +1,32 @@
+"""Cross-version encoding corpus (the ceph-object-corpus /
+readable.sh role): every release archives one encoded blob + canonical
+dump per versioned wire type under tests/corpus/<tag>/; this test
+decodes EVERY archived release's blobs with TODAY's code and compares
+dumps — a wire change that breaks or silently reinterprets an older
+release's bytes fails here, BEFORE it ships.
+
+Adding a new version: python -m ceph_tpu.tools.dencoder corpus_create
+tests/corpus/<new-tag>  (never regenerate an old tag's directory)."""
+
+import glob
+import os
+
+from ceph_tpu.tools import dencoder
+
+CORPUS_ROOT = os.path.join(os.path.dirname(__file__), "corpus")
+
+
+def test_all_archived_versions_decode():
+    dirs = sorted(d for d in glob.glob(os.path.join(CORPUS_ROOT, "*"))
+                  if os.path.isdir(d))
+    assert dirs, "no archived corpus versions"
+    for d in dirs:
+        assert dencoder.corpus_check(d) == 0, f"corpus {d} drifted"
+
+
+def test_fresh_corpus_round_trips(tmp_path):
+    """Harness self-check: a corpus generated NOW must verify NOW."""
+    out = str(tmp_path / "fresh")
+    assert dencoder.corpus_create(out) == 0
+    assert dencoder.corpus_check(out) == 0
+    assert len(glob.glob(out + "/*.bin")) >= 30
