@@ -125,6 +125,31 @@ def derive_session(base_key: bytes, nonce_a: bytes,
     return _prf(base_key, b"cephx-session", nonce_a, nonce_b)
 
 
+def seal(session_key: bytes, role: bytes, seq: int,
+         data: bytes) -> bytes:
+    """On-wire encryption (the msgr2 secure-mode role,
+    /root/reference/src/msg/async/crypto_onwire.cc — AES-GCM there):
+    XOR with a SHAKE-256 keystream keyed by (session key, direction
+    role, frame seq).  The nonce never repeats: session keys are
+    per-connection, seqs are strictly increasing per direction, and
+    the role byte separates the two directions' streams.  Integrity
+    comes from the frame signature (HMAC over preamble+ciphertext).
+    Deliberate substitution documented: stdlib has no AES; SHAKE-256
+    as a keyed XOF is a standard PRF-stream construction."""
+    if not data:
+        return data
+    ks = hashlib.shake_256(
+        session_key + role + seq.to_bytes(8, "big")).digest(len(data))
+    import numpy as np
+
+    a = np.frombuffer(data, dtype=np.uint8)
+    b = np.frombuffer(ks, dtype=np.uint8)
+    return (a ^ b).tobytes()
+
+
+unseal = seal  # XOR stream: decryption is the same operation
+
+
 # -- mon-as-KDC tickets ------------------------------------------------------
 
 

@@ -1,0 +1,129 @@
+"""lockdep: lock-order inversion detection for the asyncio runtime.
+
+Reference parity: /root/reference/src/common/lockdep.cc — every lock
+acquisition records "B taken while holding A" edges in a global order
+graph; an acquisition that would close a cycle is a potential deadlock
+and is reported at ACQUISITION time, long before any real interleaving
+hits it.
+
+Why this framework needs less than pthread lockdep — the in-tree
+argument for §5.2 (race detection): every daemon runs ONE asyncio event
+loop, so data races on plain Python state are impossible — state only
+changes at explicit `await` points, and all mutual exclusion is
+asyncio.Lock-shaped.  The remaining hazard class is therefore exactly
+lock-order deadlock between coroutines (plus the slot/lock coupling
+documented at the QoS scheduler), which this module detects.
+
+Model: lock CLASSES, not instances (as in the reference) — the object
+lock of obj-1 and obj-2 are the same class.  Same-class nesting is
+allowed (the recovery wave legally holds many object locks via
+independent subtasks; a single task re-entering the same class is the
+caller's documented discipline).  Cross-class cycles are flagged.
+
+Enabled via CEPH_TPU_LOCKDEP=1 (tests) — the hooks are no-ops
+otherwise, so the production path pays one attribute check.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Dict, List, Set
+
+log = logging.getLogger("lockdep")
+
+enabled = os.environ.get("CEPH_TPU_LOCKDEP", "0") == "1"
+
+# class -> classes acquired while holding it
+_edges: Dict[str, Set[str]] = {}
+# id(task) -> stack of held lock classes
+_held: Dict[int, List[str]] = {}
+
+
+class LockOrderInversion(Exception):
+    """Acquiring this lock class here can deadlock: the reverse order
+    is already on record."""
+
+
+def _reachable(src: str, dst: str) -> bool:
+    seen: Set[str] = set()
+    stack = [src]
+    while stack:
+        node = stack.pop()
+        if node == dst:
+            return True
+        if node in seen:
+            continue
+        seen.add(node)
+        stack.extend(_edges.get(node, ()))
+    return False
+
+
+def acquire(cls: str) -> None:
+    """Record an acquisition of lock class `cls` by the current task;
+    raises LockOrderInversion on a would-be cycle."""
+    if not enabled:
+        return
+    task = asyncio.current_task()
+    if task is None:
+        return
+    held = _held.setdefault(id(task), [])
+    for h in held:
+        if h == cls:
+            continue  # same-class nesting: allowed (see docstring)
+        if cls not in _edges.get(h, ()):  # new edge h -> cls
+            if _reachable(cls, h):
+                order = " -> ".join(held + [cls])
+                log.error("lockdep: ORDER INVERSION acquiring %s "
+                          "while holding %s (existing order has "
+                          "%s -> ... -> %s)", cls, held, cls, h)
+                raise LockOrderInversion(order)
+            _edges.setdefault(h, set()).add(cls)
+    held.append(cls)
+
+
+def release(cls: str) -> None:
+    if not enabled:
+        return
+    task = asyncio.current_task()
+    if task is None:
+        return
+    held = _held.get(id(task))
+    if held:
+        try:
+            held.reverse()
+            held.remove(cls)
+            held.reverse()
+        except ValueError:
+            pass
+        if not held:
+            _held.pop(id(task), None)
+
+
+def reset() -> None:
+    """Test hook: clear the global order graph."""
+    _edges.clear()
+    _held.clear()
+
+
+class guard:
+    """Async context manager pairing an asyncio.Lock with lockdep
+    tracking: `async with lockdep.guard(lock, "mds.mutation"): ...`"""
+
+    def __init__(self, lock: asyncio.Lock, cls: str):
+        self._lock = lock
+        self._cls = cls
+
+    async def __aenter__(self):
+        acquire(self._cls)
+        try:
+            await self._lock.acquire()
+        except BaseException:
+            release(self._cls)
+            raise
+        return self
+
+    async def __aexit__(self, *exc):
+        self._lock.release()
+        release(self._cls)

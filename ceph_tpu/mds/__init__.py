@@ -97,7 +97,8 @@ class MDSDaemon:
     def __init__(self, mon_addr: str, metadata_pool: str,
                  data_pool: str, name: str = "a",
                  lock_interval: float = 1.0,
-                 secret: "Optional[str]" = None):
+                 secret: "Optional[str]" = None,
+                 secure: bool = False):
         self.mon_addr = mon_addr
         self.metadata_pool = metadata_pool
         self.data_pool = data_pool
@@ -106,9 +107,10 @@ class MDSDaemon:
         from ceph_tpu.common.auth import parse_secret
 
         self.client = RadosClient(mon_addr, name=f"mds.{name}",
-                                  secret=secret)
+                                  secret=secret, secure=secure)
         self.msgr = Messenger(f"mds.{name}",
                               secret=parse_secret(secret))
+        self.msgr.secure = secure
         self.msgr.dispatcher = self._dispatch
         self.meta: Optional[IoCtx] = None
         self.data_io: Optional[IoCtx] = None

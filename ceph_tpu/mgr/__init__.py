@@ -62,7 +62,8 @@ class MgrDaemon:
         self.tick_interval = tick_interval
         self.client = RadosClient(
             mon_addr, name="mgr.x",
-            secret=self.config.get("auth_secret"))
+            secret=self.config.get("auth_secret"),
+            secure=bool(self.config.get("auth_secure")))
         self.modules: Dict[str, MgrModule] = {}
         self._module_filter = modules
         self._tick_task: Optional[asyncio.Task] = None

@@ -42,6 +42,8 @@ from ceph_tpu.msg import Connection, Messenger
 from ceph_tpu.msg.messages import (
     MAuth,
     MAuthReply,
+    MConfig,
+    MLog,
     Message,
     MGetMap,
     MMonCommand,
@@ -102,6 +104,7 @@ class MonDaemon:
         self.msgr = Messenger(
             f"mon.{rank}", secret=parse_secret(
                 self.config.get("auth_secret")))
+        self.msgr.secure = bool(self.config.get("auth_secure"))
         self.msgr.dispatcher = self._dispatch
         # durable state (the MonitorDBStore role,
         # /root/reference/src/mon/MonitorDBStore.h): every commit writes
@@ -125,6 +128,15 @@ class MonDaemon:
         # single-proposal round): handlers read the map, build an
         # incremental, and propose under this lock
         self._mutation_lock = asyncio.Lock()
+        # centralized config (ConfigMonitor role): {section: {k: v}},
+        # quorum-replicated through paxos, pushed to subscribers
+        self._config_kv: Dict[str, Dict[str, str]] = {}
+        self._config_version = 0
+        # cluster log ring (LogMonitor role): one place to read a
+        # multi-daemon incident instead of grepping N process logs
+        from collections import deque
+
+        self._cluster_log: "deque" = deque(maxlen=2048)
         # forwarded-command reply routing (MForward role)
         self._fwd_tid = 0
         self._fwd_pending: Dict[int, Tuple[Connection, int]] = {}
@@ -151,6 +163,11 @@ class MonDaemon:
                   for key, val in self.store.get_iterator("osdmap")]
         for epoch, val in loaded[-self._inc_log_max:]:
             self._inc_log[epoch] = val
+        cfg = self.store.get("mon", b"config")
+        if cfg:
+            doc = json.loads(cfg.decode())
+            self._config_kv = doc.get("kv", {})
+            self._config_version = int(doc.get("version", 0))
         aux = self.store.get("mon", b"aux")
         if aux:
             doc = json.loads(aux.decode())
@@ -173,6 +190,10 @@ class MonDaemon:
             t.rm_range_keys("osdmap", (0).to_bytes(8, "big"),
                             floor.to_bytes(8, "big"))
         t.set("mon", b"osdmap_full", self.osdmap.encode())
+        t.set("mon", b"config", json.dumps({
+            "kv": self._config_kv,
+            "version": self._config_version,
+        }).encode())
         t.set("mon", b"aux", json.dumps({
             "laggy_probability": self._laggy_probability,
             "laggy_interval": self._laggy_interval,
@@ -191,6 +212,10 @@ class MonDaemon:
         addr = await self.msgr.bind(host, port)
         self._check_task = asyncio.get_running_loop().create_task(
             self._check_failures_loop())
+        if not self.mon_addrs and self.rank == 0:
+            # standalone mon: a 1-entry monmap — paxos runs the same
+            # commit pipeline with zero consensus traffic
+            self.mon_addrs = [addr]
         if self.mon_addrs:
             await self.start_consensus()
         return addr
@@ -206,7 +231,7 @@ class MonDaemon:
         n = len(self.mon_addrs)
         self.paxos = paxos_mod.Paxos(
             self.rank, n, self._send_rank, self.store,
-            self._paxos_apply, lambda: self.osdmap.encode(),
+            self._paxos_apply, self._paxos_snapshot,
             self._paxos_install, self.config)
         self.paxos.on_leader_dead = self._on_quorum_lost
         self.elector = paxos_mod.Elector(
@@ -285,9 +310,27 @@ class MonDaemon:
                 await self.elector.call_election()
 
     def _paxos_apply(self, v: int, value: bytes, t) -> None:
-        """Committed-value application (every mon, leader and peon):
-        decode the incremental, advance the map, stage durable state
-        into the SAME transaction as the paxos commit, publish."""
+        """Committed-value application (every mon, leader and peon).
+        Values are tagged: b"M"+incremental (map mutation) or
+        b"C"+json (centralized config mutation) — the PaxosService
+        multiplexing role collapsed onto one tag byte; untagged values
+        are legacy map incrementals."""
+        if value[:1] == b"C":
+            doc = json.loads(value[1:].decode())
+            section, name = doc["section"], doc["name"]
+            if doc.get("value") is None:
+                self._config_kv.get(section, {}).pop(name, None)
+                if not self._config_kv.get(section, True):
+                    self._config_kv.pop(section, None)
+            else:
+                sect = self._config_kv.setdefault(section, {})
+                sect[name] = str(doc["value"])
+            self._config_version = v
+            self._stage_mon(t, None)
+            self._push_config()
+            return
+        if value[:1] == b"M":
+            value = value[1:]
         inc = Incremental.decode(value)
         self.osdmap.apply_incremental(inc)
         self._inc_log[inc.epoch] = value
@@ -296,9 +339,27 @@ class MonDaemon:
         self._stage_mon(t, value)
         self._publish()
 
+    def _paxos_snapshot(self) -> bytes:
+        """OP_FULL payload: EVERY replicated state — the map AND the
+        centralized config (a snapshot that missed config would
+        silently re-persist a stale kv on the caught-up mon)."""
+        m = self.osdmap.encode()
+        cfg = json.dumps({"kv": self._config_kv,
+                          "version": self._config_version}).encode()
+        return (len(m).to_bytes(8, "big") + m
+                + len(cfg).to_bytes(8, "big") + cfg)
+
     def _paxos_install(self, v: int, blob: bytes, t) -> None:
         """Full-state catch-up past a trimmed log (OP_FULL)."""
-        self.osdmap = OSDMap.decode(blob)
+        mlen = int.from_bytes(blob[:8], "big")
+        self.osdmap = OSDMap.decode(blob[8:8 + mlen])
+        rest = blob[8 + mlen:]
+        if rest:
+            clen = int.from_bytes(rest[:8], "big")
+            doc = json.loads(rest[8:8 + clen].decode())
+            self._config_kv = doc.get("kv", {})
+            self._config_version = int(doc.get("version", 0))
+            self._push_config()
         self._inc_log.clear()
         self._stage_mon(t, None)
         self._publish()
@@ -325,7 +386,19 @@ class MonDaemon:
         # against the map as it read it; the epoch must be the commit
         # point's successor
         inc.epoch = self.osdmap.epoch + 1
-        return await self.paxos.propose(inc.encode())
+        return await self.paxos.propose(b"M" + inc.encode())
+
+    def _push_config(self) -> None:
+        msg = MConfig(self._config_version, self._config_kv)
+        for conn in list(self._subscribers):
+            if not conn.closed:
+                self.msgr._spawn(self._send_quiet(conn, msg))
+
+    def clog(self, level: str, who: str, message: str) -> None:
+        """Append one cluster-log entry (LogMonitor ingest)."""
+        self._cluster_log.append({
+            "stamp": time.time(), "level": level, "who": who,
+            "message": message})
 
     def _publish(self) -> None:
         """Push the new epoch to subscribers as the committing
@@ -365,6 +438,9 @@ class MonDaemon:
             # slightly behind the leader is safe by construction
             if msg.subscribe and conn not in self._subscribers:
                 self._subscribers.append(conn)
+                if self._config_kv:
+                    await self._send_quiet(conn, MConfig(
+                        self._config_version, self._config_kv))
             cur = self.osdmap.epoch
             since = msg.since_epoch
             if since and all(e in self._inc_log
@@ -388,6 +464,12 @@ class MonDaemon:
                 await conn.send(MMonCommandReply(msg.tid, rc, out))
             else:
                 await self._forward(msg, conn, msg.tid)
+        elif isinstance(msg, MLog):
+            if self.is_leader():
+                for e in msg.entries:
+                    self._cluster_log.append(dict(e))
+            else:
+                await self._forward(msg)
         elif isinstance(msg, MAuth):
             await self._handle_auth(conn, msg)
         elif isinstance(msg, MMonElection):
@@ -484,6 +566,9 @@ class MonDaemon:
             await self._handle_boot(inner)
         elif isinstance(inner, MOSDFailure):
             await self._handle_failure(inner)
+        elif isinstance(inner, MLog):
+            for e in inner.entries:
+                self._cluster_log.append(dict(e))
 
     # -- boot / failure ----------------------------------------------------
 
@@ -565,6 +650,9 @@ class MonDaemon:
         log.info("mon.%d: marking osd.%d down (%d reporters, grace"
                  " %.1fs)", self.rank, target, len(reports),
                  self._grace(target))
+        self.clog("WRN", f"mon.{self.rank}",
+                  f"osd.{target} marked down ({len(reports)}"
+                  " reporters)")
         self._failure_reports.pop(target, None)
         self._down_at[target] = now
         async with self._mutation_lock:
@@ -607,6 +695,10 @@ class MonDaemon:
                 "status": self._cmd_status,
                 "health": self._cmd_health,
                 "mon stat": self._cmd_mon_stat,
+                "config set": self._cmd_config_set,
+                "config rm": self._cmd_config_rm,
+                "config get": self._cmd_config_get,
+                "log last": self._cmd_log_last,
             }.get(prefix)
             if handler is None:
                 return -22, {"error": f"unknown command {prefix!r}"}
@@ -852,6 +944,48 @@ class MonDaemon:
             if not await self._commit(inc):
                 return -11, {"error": "no quorum; retry"}
         return 0, {"epoch": self.osdmap.epoch}
+
+    async def _cmd_config_set(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        """`ceph config set <who> <name> <value>` (ConfigMonitor):
+        who = global | osd | mon | mds | osd.N ... — quorum-committed,
+        pushed to every subscriber, durable across restarts."""
+        section, name = cmd.get("who", "global"), cmd.get("name")
+        if not name:
+            return -22, {"error": "missing option name"}
+        async with self._mutation_lock:
+            ok = await self.paxos.propose(b"C" + json.dumps({
+                "section": section, "name": name,
+                "value": str(cmd.get("value", ""))}).encode())
+            if not ok:
+                return -11, {"error": "no quorum; retry"}
+        self.clog("INF", f"mon.{self.rank}",
+                  f"config set {section}/{name}")
+        return 0, {"version": self._config_version}
+
+    async def _cmd_config_rm(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        section, name = cmd.get("who", "global"), cmd.get("name")
+        if not name:
+            return -22, {"error": "missing option name"}
+        async with self._mutation_lock:
+            ok = await self.paxos.propose(b"C" + json.dumps({
+                "section": section, "name": name,
+                "value": None}).encode())
+            if not ok:
+                return -11, {"error": "no quorum; retry"}
+        return 0, {"version": self._config_version}
+
+    async def _cmd_config_get(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        who = cmd.get("who")
+        if who:
+            return 0, {"config": self._config_kv.get(who, {}),
+                       "version": self._config_version}
+        return 0, {"config": self._config_kv,
+                   "version": self._config_version}
+
+    async def _cmd_log_last(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        """`ceph log last [n]` — the cluster log tail."""
+        n = int(cmd.get("num", 20))
+        return 0, {"entries": list(self._cluster_log)[-n:]}
 
     async def _cmd_mon_stat(self, cmd) -> Tuple[int, Dict[str, Any]]:
         """Quorum observability (`ceph mon stat` role)."""

@@ -73,6 +73,12 @@ class Connection:
         self.reply_kid: Optional[int] = None
         self.rx_seq = -1
 
+    def _tx_role(self) -> bytes:
+        return b"c" if self.outbound else b"s"
+
+    def _rx_role(self) -> bytes:
+        return b"s" if self.outbound else b"c"
+
     # a wedged peer (stopped reading, socket buffer full) must not
     # park drain() — and with it this connection's send lock — forever;
     # on timeout the connection dies and the next send reconnects
@@ -99,8 +105,19 @@ class Connection:
                            key: Optional[bytes]) -> None:
         if self.closed:
             raise ConnectionError(f"connection to {self.peer_name} closed")
-        parts = frames.encode_frame_parts(msg.TAG, next(self._seq),
-                                          msg.encode(), key=key)
+        seq = next(self._seq)
+        payload = msg.encode()
+        flags = 0
+        if key is not None and key is self.session_key and \
+                self.messenger.secure:
+            # secure mode: the payload rides encrypted under the
+            # session keystream (hellos stay plaintext — they carry
+            # no secrets and exist before the session does)
+            payload = auth.seal(key, self._tx_role(), seq, payload)
+            flags = frames.FLAG_SECURE
+        parts = frames.encode_frame_parts(msg.TAG, seq,
+                                          payload, flags=flags,
+                                          key=key)
         async with self._send_lock:
             for part in parts:
                 self.writer.write(part)
@@ -156,6 +173,10 @@ class Messenger:
         # mon-granted ticket attached to outbound hellos (clients set
         # this after an MAuth exchange; services validate offline)
         self.ticket: bytes = b""
+        # on-wire encryption (msgr2 secure mode): session-keystream
+        # payload encryption; a secure endpoint also REFUSES plaintext
+        # post-handshake frames
+        self.secure = False
         self.addr: str = ""
         self.dispatcher: Optional[DispatchFn] = None
         self.on_connection_fault: Optional[
@@ -172,6 +193,12 @@ class Messenger:
     # -- lifecycle ---------------------------------------------------------
 
     async def bind(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        if self.secure and self.secret is None:
+            # claiming wire encryption with no key would silently send
+            # plaintext — refuse to start misconfigured
+            raise ValueError(
+                f"{self.entity_name}: auth_secure requires a keyring"
+                " (auth_secret)")
         self._server = await asyncio.start_server(
             self._handle_accept, host, port, limit=self.STREAM_LIMIT)
         port = self._server.sockets[0].getsockname()[1]
@@ -317,6 +344,13 @@ class Messenger:
                             f"non-monotonic frame seq {seq} (last"
                             f" {conn.rx_seq}): replay rejected")
                     conn.rx_seq = seq
+                    if flags & frames.FLAG_SECURE:
+                        payload = auth.unseal(conn.session_key,
+                                              conn._rx_role(), seq,
+                                              payload)
+                    elif self.secure:
+                        raise frames.FrameError(
+                            "plaintext frame but secure mode required")
                 msg = decode_message(tag, payload)
                 if isinstance(msg, MHello):
                     # keyless endpoint: hellos are identification only

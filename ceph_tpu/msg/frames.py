@@ -39,6 +39,7 @@ MAGIC = 0xCE9F0205
 PREAMBLE = struct.Struct("<IHHQI")
 CRC = struct.Struct("<I")
 FLAG_SIGNED = 0x0001
+FLAG_SECURE = 0x0002  # payload encrypted with the session keystream
 
 
 class FrameError(Exception):

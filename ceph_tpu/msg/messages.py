@@ -911,6 +911,48 @@ class MMonForwardReply(Message):
         return cls(dec.u64(), dec.s32(), json.loads(dec.string()))
 
 
+# -- centralized config + cluster log ---------------------------------------
+
+
+@register
+class MConfig(Message):
+    """Mon -> daemon: the centralized config snapshot relevant to the
+    subscriber (ConfigMonitor's config push role).  Sent on
+    subscription and on every config commit."""
+
+    TAG = 29
+
+    def __init__(self, version: int, values: Dict[str, Any]):
+        self.version = version
+        self.values = values
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.version)
+        enc.string(json.dumps(self.values))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MConfig":
+        return cls(dec.u64(), json.loads(dec.string()))
+
+
+@register
+class MLog(Message):
+    """Daemon -> mon: structured cluster-log entries (MLog /
+    LogMonitor role) — one place to read a multi-daemon incident."""
+
+    TAG = 30
+
+    def __init__(self, entries: List[Dict[str, Any]]):
+        self.entries = entries
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.string(json.dumps(self.entries))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MLog":
+        return cls(json.loads(dec.string()))
+
+
 # -- cephx KDC (mon ticket service) -----------------------------------------
 
 

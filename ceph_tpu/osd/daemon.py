@@ -38,8 +38,11 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ceph_tpu.crush.map import CRUSH_ITEM_NONE
 from ceph_tpu.ec.registry import create_erasure_code
+from ceph_tpu.common import lockdep
 from ceph_tpu.msg import Connection, Messenger
 from ceph_tpu.msg.messages import (
+    MConfig,
+    MLog,
     Message,
     MGetMap,
     MOSDBoot,
@@ -216,9 +219,21 @@ class PGState:
             return -1
 
 
+def _lock_class(oid: str) -> str:
+    """lockdep class of an object lock key (lock classes, not
+    instances — the reference's lockdep model)."""
+    if oid.startswith("sub\x00"):
+        return "osd.sublock"
+    if oid.startswith("_cls_\x00"):
+        return "osd.clslock"
+    return "osd.objlock"
+
+
 class _ObjLockCtx:
     """Context manager pairing an asyncio.Lock with a user refcount so
-    idle entries can be dropped without racing pending acquirers."""
+    idle entries can be dropped without racing pending acquirers.
+    Acquisitions feed lockdep (CEPH_TPU_LOCKDEP=1) for order-inversion
+    detection."""
 
     def __init__(self, table: Dict[str, list], oid: str, entry: list):
         self._table = table
@@ -226,12 +241,23 @@ class _ObjLockCtx:
         self._entry = entry
 
     async def __aenter__(self):
+        if lockdep.enabled:
+            self._cls = _lock_class(self._oid)
+            lockdep.acquire(self._cls)
         self._entry[1] += 1
-        await self._entry[0].acquire()
+        try:
+            await self._entry[0].acquire()
+        except BaseException:
+            self._entry[1] -= 1
+            if lockdep.enabled:
+                lockdep.release(self._cls)
+            raise
         return self
 
     async def __aexit__(self, *exc):
         self._entry[0].release()
+        if lockdep.enabled and getattr(self, "_cls", None):
+            lockdep.release(self._cls)
         self._entry[1] -= 1
         if self._entry[1] == 0 and \
                 self._table.get(self._oid) is self._entry:
@@ -259,6 +285,7 @@ class OSDDaemon:
         self.msgr = Messenger(
             f"osd.{osd_id}", secret=parse_secret(
                 self.config.get("auth_secret")))
+        self.msgr.secure = bool(self.config.get("auth_secure"))
         self.msgr.dispatcher = self._dispatch
         self.store = store if store is not None else MemStore()
         self._own_store = store is None
@@ -487,6 +514,9 @@ class OSDDaemon:
     # -- dispatch ----------------------------------------------------------
 
     async def _dispatch(self, conn: Connection, msg: Message) -> None:
+        if isinstance(msg, MConfig):
+            self._apply_central_config(msg)
+            return
         if isinstance(msg, MOSDMapMsg):
             self._handle_map(msg)
         elif isinstance(msg, MPing):
@@ -545,6 +575,59 @@ class OSDDaemon:
         await conn.send(MOSDCommandReply(msg.tid, rc, out))
 
     # -- map handling ------------------------------------------------------
+
+    def _apply_central_config(self, msg: MConfig) -> None:
+        """ConfigMonitor push: overlay centralized options with the
+        reference's mask precedence (global < osd < osd.N), coerced to
+        the local option's existing type.  Loops read config per tick,
+        so changes take effect live."""
+        merged: Dict[str, str] = {}
+        for section in ("global", "osd", f"osd.{self.osd_id}"):
+            merged.update(msg.values.get(section, {}))
+        if not hasattr(self, "_central_baseline"):
+            self._central_baseline: Dict[str, Any] = {}
+        # a key REMOVED centrally reverts to its pre-override value
+        # (config rm must take effect live, not at next restart)
+        for name in list(self._central_baseline):
+            if name not in merged:
+                val = self._central_baseline.pop(name)
+                log.info("osd.%d: config %s -> %r (central override"
+                         " removed)", self.osd_id, name, val)
+                self.config[name] = val
+        for name, raw in merged.items():
+            cur = self.config.get(name)
+            val: Any = raw
+            try:
+                if isinstance(cur, bool):
+                    val = str(raw).lower() in ("1", "true", "yes", "on")
+                elif isinstance(cur, int):
+                    val = int(raw)
+                elif isinstance(cur, float):
+                    val = float(raw)
+            except (TypeError, ValueError):
+                log.warning("osd.%d: bad central config %s=%r",
+                            self.osd_id, name, raw)
+                continue
+            if self.config.get(name) != val:
+                self._central_baseline.setdefault(name, cur)
+                log.info("osd.%d: config %s -> %r (centralized)",
+                         self.osd_id, name, val)
+                self.config[name] = val
+
+    def _clog(self, level: str, message: str) -> None:
+        """Fire one cluster-log entry at the mon (MLog role)."""
+        import time as _time
+
+        entry = {"stamp": _time.time(), "level": level,
+                 "who": f"osd.{self.osd_id}", "message": message}
+
+        async def send():
+            try:
+                await self.msgr.send_to(self.mon_addr, MLog([entry]))
+            except (ConnectionError, OSError):
+                pass
+
+        self.msgr._spawn(send())
 
     def _handle_map(self, msg: MOSDMapMsg) -> None:
         """Advance the local map EPOCH BY EPOCH."""
@@ -1383,6 +1466,8 @@ class OSDDaemon:
                 self._split_children.discard(pg)
                 self._save_split_meta()
             if state.unfound:
+                self._clog("WRN", f"pg {pg} active with unfound"
+                                  " objects (sources down?)")
                 # leftover missing entries are not only map-change
                 # driven: a recovery PUSH can fail on a transient
                 # timeout with no interval change, and nothing else
@@ -1963,6 +2048,10 @@ class OSDDaemon:
         self.scrub_stats["objects"] += run["objects"]
         self.scrub_stats["errors"] += run["errors"]
         self.scrub_stats["repaired"] += run["repaired"]
+        if run["errors"]:
+            self._clog("ERR", f"scrub {state.pg}: {run['errors']}"
+                              f" inconsistencies, {run['repaired']}"
+                              " repaired")
         return run
 
     @staticmethod

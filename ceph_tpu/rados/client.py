@@ -58,7 +58,7 @@ class ObjectNotFound(RadosError):
 class RadosClient:
     def __init__(self, mon_addr, name: Optional[str] = None,
                  op_timeout: float = 10.0, max_retries: int = 30,
-                 secret: Optional[str] = None):
+                 secret: Optional[str] = None, secure: bool = False):
         # mon_addr: one address, a comma-separated list, or a list —
         # the client hunts across them on failure (MonClient hunting)
         if isinstance(mon_addr, str):
@@ -77,6 +77,7 @@ class RadosClient:
         from ceph_tpu.common.auth import parse_secret
 
         self.msgr = Messenger(name, secret=parse_secret(secret))
+        self.msgr.secure = secure
         self.msgr.dispatcher = self._dispatch
         self.osdmap: Optional[OSDMap] = None
         self.op_timeout = op_timeout

@@ -26,9 +26,12 @@ async def _main() -> None:
     ap.add_argument("--secret-key", type=str, required=True)
     ap.add_argument("--secret", type=str, default="",
                     help="cluster cephx keyring")
+    ap.add_argument("--secure", action="store_true",
+                    help="on-wire encryption (requires --secret)")
     args = ap.parse_args()
     client = RadosClient(args.mon, name="client.rgw",
-                         secret=args.secret or None)
+                         secret=args.secret or None,
+                         secure=args.secure)
     await client.connect()
     rgw = RGWLite(client, args.data_pool, args.meta_pool)
     fe = S3Frontend(rgw, {args.access_key: args.secret_key})

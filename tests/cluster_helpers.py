@@ -44,7 +44,7 @@ class Cluster:
                  mon_config: Optional[dict] = None,
                  store_factory=None,
                  client_secret: Optional[str] = None,
-                 num_mons: int = 1):
+                 num_mons: int = 1, client_secure: bool = False):
         self.num_osds = num_osds
         self.osds_per_host = osds_per_host
         self.num_mons = num_mons
@@ -59,6 +59,7 @@ class Cluster:
         self.mon_config.update(mon_config or {})
         self.store_factory = store_factory or (lambda osd_id: MemStore())
         self.client_secret = client_secret
+        self.client_secure = client_secure
         self.mons: Dict[int, MonDaemon] = {}
         self.mon_addrs: List[str] = []
         self.osds: Dict[int, OSDDaemon] = {}
@@ -96,7 +97,8 @@ class Cluster:
             self.stores[osd_id] = store
             await self._boot_osd(osd_id)
         self.client = RadosClient(self.mon_addrs,
-                                  secret=self.client_secret)
+                                  secret=self.client_secret,
+                                  secure=self.client_secure)
         await self.client.connect()
 
     async def wait_for_quorum(self, timeout: float = 15.0) -> None:

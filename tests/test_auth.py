@@ -337,3 +337,75 @@ def test_ticket_grant_and_use():
             await cluster.stop()
 
     run(main())
+
+
+def test_secure_mode_encrypts_the_wire():
+    """msgr2 secure-mode role: with auth_secure on, payloads are
+    encrypted under the per-connection session keystream — a wire
+    sniffer sees no plaintext, and the data path still round-trips."""
+    secret = auth.generate_secret()
+    marker = b"SUPER-SECRET-PAYLOAD-MARKER"
+
+    async def main():
+        cluster = Cluster(
+            num_osds=3,
+            osd_config={"auth_secret": secret, "auth_secure": True},
+            mon_config={"auth_secret": secret, "auth_secure": True},
+            client_secret=secret, client_secure=True)
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool(
+                "enc", size=2, pg_num=4)
+            io = cluster.client.open_ioctx("enc")
+            # sniff every byte of every connection the client opens
+            # from here on (the OSD data connections are fresh)
+            sniffed = bytearray()
+            import ceph_tpu.msg as msg_mod
+
+            orig_oc = msg_mod.asyncio.open_connection
+
+            async def tee_oc(*args, **kw):
+                r, w = await orig_oc(*args, **kw)
+                ow = w.write
+
+                def tee(data, _ow=ow):
+                    sniffed.extend(data)
+                    return _ow(data)
+
+                w.write = tee
+                return r, w
+
+            msg_mod.asyncio.open_connection = tee_oc
+            try:
+                payload = marker * 200
+                await io.write_full("obj", payload)
+                assert await io.read("obj") == payload
+            finally:
+                msg_mod.asyncio.open_connection = orig_oc
+            assert len(sniffed) > len(payload)
+            assert marker not in bytes(sniffed), \
+                "plaintext leaked on the wire in secure mode"
+
+            # a keyed-but-plaintext client is refused by the secure
+            # cluster after the handshake
+            plain = RadosClient(cluster.mon.addr, secret=secret,
+                                secure=False)
+            with pytest.raises(Exception):
+                await asyncio.wait_for(plain.connect(), 4.0)
+            await plain.shutdown()
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_seal_unseal_unit():
+    key = auth.parse_secret(auth.generate_secret()).active_key
+    data = b"x" * 100_000
+    ct = auth.seal(key, b"c", 7, data)
+    assert ct != data
+    assert auth.unseal(key, b"c", 7, ct) == data
+    # direction and seq separate the keystreams
+    assert auth.seal(key, b"s", 7, data) != ct
+    assert auth.seal(key, b"c", 8, data) != ct
+    assert auth.seal(key, b"c", 7, b"") == b""
