@@ -438,9 +438,11 @@ class MonDaemon:
             # slightly behind the leader is safe by construction
             if msg.subscribe and conn not in self._subscribers:
                 self._subscribers.append(conn)
-                if self._config_kv:
-                    await self._send_quiet(conn, MConfig(
-                        self._config_version, self._config_kv))
+                # unconditionally — an EMPTY snapshot is load-bearing:
+                # a resubscriber whose overrides were removed while it
+                # was away must revert them
+                await self._send_quiet(conn, MConfig(
+                    self._config_version, self._config_kv))
             cur = self.osdmap.epoch
             since = msg.since_epoch
             if since and all(e in self._inc_log

@@ -593,7 +593,12 @@ class OSDDaemon:
                 val = self._central_baseline.pop(name)
                 log.info("osd.%d: config %s -> %r (central override"
                          " removed)", self.osd_id, name, val)
-                self.config[name] = val
+                if val is None:
+                    # the option had NO local value before the central
+                    # override: restore absence, not a None mapping
+                    self.config.pop(name, None)
+                else:
+                    self.config[name] = val
         for name, raw in merged.items():
             cur = self.config.get(name)
             val: Any = raw
@@ -616,9 +621,7 @@ class OSDDaemon:
 
     def _clog(self, level: str, message: str) -> None:
         """Fire one cluster-log entry at the mon (MLog role)."""
-        import time as _time
-
-        entry = {"stamp": _time.time(), "level": level,
+        entry = {"stamp": time.time(), "level": level,
                  "who": f"osd.{self.osd_id}", "message": message}
 
         async def send():

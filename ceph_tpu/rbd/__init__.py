@@ -14,6 +14,13 @@ surface, ObjectMap/image layout in src/librbd/image/CreateRequest.cc):
   --data-pool`, librbd data_pool feature): metadata/omap stays on a
   replicated pool (omap is unsupported on EC pools, here as in the
   reference) while data objects live on the EC pool;
+- the EXCLUSIVE LOCK feature (librbd::ExclusiveLock,
+  src/librbd/ExclusiveLock.h): a writer auto-acquires a cls_lock on
+  the header object on its first mutation and renews it on a
+  heartbeat; a second writer is refused (EBUSY) while the holder is
+  live and breaks the lock only after its renewal counter goes stale
+  by the CHALLENGER's own clock — two clients can no longer interleave
+  the header's read-modify-write (snapc/size updates);
 - snapshots ride the pool's self-managed snap machinery: snap_create
   allocates a snap id and folds it into the image's write snap
   context, so ordinary clone-on-write in the OSDs preserves the
@@ -50,7 +57,8 @@ class RBD:
 
     async def create(self, ioctx: IoCtx, name: str, size: int,
                      order: int = DEFAULT_ORDER,
-                     data_pool: Optional[str] = None) -> str:
+                     data_pool: Optional[str] = None,
+                     exclusive_lock: bool = False) -> str:
         """Create an image; returns its id.  data_pool places the data
         objects on a different (e.g. erasure-coded) pool while
         metadata stays on this replicated pool (--data-pool role)."""
@@ -68,7 +76,9 @@ class RBD:
         # the reverse order left a claimed name with no header that
         # could never be recreated
         meta = {"name": name, "size": size, "order": order,
-                "snaps": {}, "snap_seq": 0, "data_pool": data_pool}
+                "snaps": {}, "snap_seq": 0, "data_pool": data_pool,
+                "features": (["exclusive-lock"] if exclusive_lock
+                             else [])}
         await ioctx.omap_set(_header(image_id),
                              {"rbd": json.dumps(meta).encode()})
         try:
@@ -173,6 +183,10 @@ async def _ignore_enoent(coro) -> None:
 class Image:
     """An open image (librbd::Image): byte-addressed I/O + snaps."""
 
+    LOCK_NAME = "rbd_lock"
+    LOCK_RENEW = 1.0       # holder renewal period (seconds)
+    LOCK_STALE = 5         # challenger: renewals missed before break
+
     def __init__(self, ioctx: IoCtx, name: str, image_id: str):
         # a dedicated ioctx: image snap context must not leak into the
         # caller's other I/O
@@ -184,6 +198,16 @@ class Image:
         self.id = image_id
         self.meta: Dict[str, Any] = {}
         self._read_snap: Optional[str] = None
+        # exclusive-lock state (feature-gated); per-HANDLE cookie so
+        # two handles of one client contend like strangers (librbd's
+        # cookie role) and closing one cannot unlock the other
+        import uuid as _uuid
+
+        self._lock_owned = False
+        self._lock_cookie = _uuid.uuid4().hex[:12]
+        self._lock_task: Optional[asyncio.Task] = None
+        self._renew_n = 0
+        self._seen_renewal = None  # (raw, my monotonic) for staleness
 
     # -- metadata ----------------------------------------------------------
 
@@ -255,11 +279,132 @@ class Image:
             *(one(*ext) for ext in self._extents(offset, length)))
         return b"".join(parts)
 
+    # -- exclusive lock (librbd::ExclusiveLock role) -----------------------
+
+    def _exclusive_enabled(self) -> bool:
+        return "exclusive-lock" in self.meta.get("features", [])
+
+    async def _ensure_lock(self) -> None:
+        """Lock-on-write policy: the first mutation acquires; a live
+        peer holder means EBUSY; a stale holder (renewal counter
+        unchanged for LOCK_STALE periods of OUR clock) is broken."""
+        if not self._exclusive_enabled() or self._lock_owned:
+            return
+        import time
+
+        req = json.dumps({"name": self.LOCK_NAME, "type": "exclusive",
+                          "owner": self.ioctx.client.msgr.entity_name,
+                          "cookie": self._lock_cookie,
+                          "tag": "rbd"}).encode()
+        deadline = time.monotonic() + \
+            self.LOCK_RENEW * (self.LOCK_STALE + 2)
+        while True:
+            try:
+                await self.ioctx.execute(_header(self.id), "lock",
+                                         "lock", req)
+                break
+            except RadosError:
+                pass
+            if time.monotonic() > deadline:
+                raise RadosError(
+                    -16, f"image {self.name!r} is exclusively"
+                         " locked by a live client")  # EBUSY
+            try:
+                raw = await self.ioctx.getxattr(
+                    _header(self.id), "rbd.lock.renewal")
+            except Exception:
+                raw = b""
+            now = time.monotonic()
+            if self._seen_renewal is None or \
+                    self._seen_renewal[0] != raw:
+                self._seen_renewal = (raw, now)
+            elif now - self._seen_renewal[1] > \
+                    self.LOCK_RENEW * self.LOCK_STALE:
+                # holder dead: break (by its full locker identity from
+                # the cls lock state, not just the stamp) and retry
+                try:
+                    info = json.loads((await self.ioctx.execute(
+                        _header(self.id), "lock", "get_info",
+                        json.dumps({"name": self.LOCK_NAME})
+                        .encode())).decode())
+                    for locker in info.get("lockers", {}).values():
+                        await self.ioctx.execute(
+                            _header(self.id), "lock", "break_lock",
+                            json.dumps({
+                                "name": self.LOCK_NAME,
+                                "locker": locker["owner"],
+                                "cookie": locker.get("cookie", ""),
+                            }).encode())
+                except (RadosError, ValueError, KeyError):
+                    pass
+                self._seen_renewal = None
+            await asyncio.sleep(self.LOCK_RENEW / 2)
+        self._lock_owned = True
+        # the header may have moved while someone else held the lock:
+        # re-read it UNDER the lock so our read-modify-writes (snapc,
+        # size, snaps) start from the current state
+        await self.refresh()
+        await self._renew_lock_stamp()
+        self._lock_task = asyncio.get_running_loop().create_task(
+            self._lock_renew_loop())
+
+    async def _renew_lock_stamp(self) -> None:
+        self._renew_n += 1
+        await self.ioctx.setxattr(
+            _header(self.id), "rbd.lock.renewal",
+            json.dumps([self.ioctx.client.msgr.entity_name,
+                        self._lock_cookie, self._renew_n]).encode())
+
+    async def _lock_renew_loop(self) -> None:
+        misses = 0
+        try:
+            while self._lock_owned:
+                await asyncio.sleep(self.LOCK_RENEW)
+                try:
+                    await self._renew_lock_stamp()
+                    misses = 0
+                except Exception:
+                    misses += 1
+                    if misses * 2 >= self.LOCK_STALE:
+                        # cannot prove liveness anymore: DEMOTE before
+                        # a challenger breaks the lock, or two writers
+                        # would interleave the header RMW — the next
+                        # mutation re-acquires cleanly
+                        self._lock_owned = False
+                        return
+        except asyncio.CancelledError:
+            pass
+
+    async def release_exclusive_lock(self) -> None:
+        if not self._lock_owned:
+            return
+        self._lock_owned = False
+        if self._lock_task is not None:
+            self._lock_task.cancel()
+            self._lock_task = None
+        try:
+            await self.ioctx.execute(
+                _header(self.id), "lock", "unlock",
+                json.dumps({
+                    "name": self.LOCK_NAME,
+                    "owner": self.ioctx.client.msgr.entity_name,
+                    "cookie": self._lock_cookie,
+                }).encode())
+        except RadosError:
+            pass
+
+    async def close(self) -> None:
+        """Release the exclusive lock (librbd close)."""
+        await self.release_exclusive_lock()
+
+    # -- I/O (mutators) ----------------------------------------------------
+
     async def write(self, offset: int, data: bytes) -> int:
         if self._read_snap is not None:
             raise RadosError(-30, "image is open at a snapshot")  # EROFS
         if offset + len(data) > self.meta["size"]:
             raise RadosError(-27, "write past image size")  # EFBIG
+        await self._ensure_lock()
         pos = 0
         jobs = []
         for objectno, in_off, span in self._extents(offset, len(data)):
@@ -275,6 +420,7 @@ class Image:
         them to sparse), partial spans are zeroed."""
         if self._read_snap is not None:
             raise RadosError(-30, "image is open at a snapshot")
+        await self._ensure_lock()
         jobs = []
         for objectno, in_off, span in self._extents(offset, length):
             name = _data(self.id, objectno)
@@ -289,6 +435,7 @@ class Image:
     async def resize(self, new_size: int) -> None:
         if self._read_snap is not None:
             raise RadosError(-30, "image is open at a snapshot")
+        await self._ensure_lock()
         old = self.meta["size"]
         if new_size < old:
             # drop whole objects past the end; zero the partial tail
@@ -312,6 +459,7 @@ class Image:
     async def snap_create(self, snap_name: str) -> int:
         if snap_name in self.meta["snaps"]:
             raise RadosError(-17, f"snap {snap_name!r} exists")
+        await self._ensure_lock()
         snap_id = await self.data_ioctx.create_selfmanaged_snap()
         self.meta["snaps"][snap_name] = {
             "id": snap_id, "size": self.meta["size"]}

@@ -189,3 +189,68 @@ def test_image_on_ec_data_pool():
             await cluster.stop()
 
     run(main())
+
+
+def test_exclusive_lock_single_writer():
+    """librbd ExclusiveLock role: with the feature on, the first
+    mutation auto-acquires the header lock; a second live writer is
+    refused; a DEAD holder's lock is broken after its renewals go
+    stale, and the image stays consistent."""
+
+    async def main():
+        from ceph_tpu.rados.client import RadosClient
+
+        cluster = Cluster(num_osds=3)
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool(
+                "rbdx", size=2, pg_num=4)
+            rbd = RBD()
+            io_a = cluster.client.open_ioctx("rbdx")
+            await rbd.create(io_a, "vol", 8 << 20,
+                             exclusive_lock=True)
+
+            img_a = await rbd.open(io_a, "vol")
+            img_a.LOCK_RENEW = 0.3
+            await img_a.write(0, b"A" * 4096)   # auto-acquires
+            assert img_a._lock_owned
+
+            # two handles of the SAME client contend like strangers
+            # (per-handle cookies): the second is refused while the
+            # first is live
+            img_c = await rbd.open(io_a, "vol")
+            img_c.LOCK_RENEW = 0.3
+            with pytest.raises(RadosError):
+                await img_c.write(0, b"C" * 512)
+
+            client_b = RadosClient(cluster.mon.addr)
+            await client_b.connect()
+            io_b = client_b.open_ioctx("rbdx")
+            img_b = await rbd.open(io_b, "vol")
+            img_b.LOCK_RENEW = 0.3
+            # holder is LIVE: B must be refused (EBUSY), not corrupt
+            with pytest.raises(RadosError):
+                await img_b.write(4096, b"B" * 4096)
+            assert not img_b._lock_owned
+
+            # holder dies without unlocking (SIGKILL shape): renewals
+            # stop; B breaks the stale lock and proceeds
+            img_a._lock_owned = False
+            img_a._lock_task.cancel()
+            img_b._seen_renewal = None
+            await img_b.write(4096, b"B" * 4096)
+            assert img_b._lock_owned
+            assert await img_b.read(0, 4096) == b"A" * 4096
+            assert await img_b.read(4096, 4096) == b"B" * 4096
+            await img_b.close()
+            await client_b.shutdown()
+
+            # images WITHOUT the feature stay lock-free
+            await rbd.create(io_a, "plain", 1 << 20)
+            img_p = await rbd.open(io_a, "plain")
+            await img_p.write(0, b"z" * 512)
+            assert not img_p._lock_owned
+        finally:
+            await cluster.stop()
+
+    run(main())
