@@ -92,12 +92,20 @@ def _fast_crc():
     return proto(("ceph_tpu_crc32c", lib))
 
 
+_fast_crc_fn = None
+
+
 def crc32c(crc: int, data, length: int | None = None) -> int:
     """ceph_crc32c: data=None means `length` zero bytes."""
+    global _fast_crc_fn
     if data is None:
         return crc32c_zeros(crc, length or 0)
     if isinstance(data, bytes):
-        fast = _fast_crc()
+        # module-global binding: this is the messenger's per-frame hot
+        # path, and even an lru_cache lookup per call shows up
+        fast = _fast_crc_fn
+        if fast is None:
+            fast = _fast_crc_fn = _fast_crc()
         if fast is not None:
             return fast(crc & 0xFFFFFFFF, data, len(data))
     lib = native.get_lib()
