@@ -21,9 +21,14 @@ async def _main() -> None:
     ap.add_argument("--metadata-pool", type=str, default="cephfs.meta")
     ap.add_argument("--data-pool", type=str, default="cephfs.data")
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--secret", type=str, default="",
+                    help="cluster cephx keyring")
+    ap.add_argument("--secure", action="store_true",
+                    help="on-wire encryption (requires --secret)")
     args = ap.parse_args()
     mds = MDSDaemon(args.mon, args.metadata_pool, args.data_pool,
-                    name=args.name)
+                    name=args.name, secret=args.secret or None,
+                    secure=args.secure)
     addr = await mds.start(port=args.port)
     print(f"MDS_ADDR {addr}", flush=True)
     try:

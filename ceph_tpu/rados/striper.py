@@ -1,0 +1,202 @@
+"""RadosStriper: byte-addressed striped objects over an IoCtx.
+
+Reference parity: libradosstriper
+(/root/reference/src/libradosstriper/RadosStriperImpl.cc) — a logical
+"striped object" soid maps onto rados objects `soid.%016x`, byte
+ranges spread RAID-0 style across a stripe set (stripe_unit x
+stripe_count, object_size per backing object), layout + logical size
+recorded on the FIRST object so any client can reopen the stream.
+
+Layout math is the Striper::file_to_extents shape
+(/root/reference/src/osdc/Striper.cc): offset -> (stripe unit index,
+object set, object within set, in-object offset).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, List, Tuple
+
+from ceph_tpu.rados.client import IoCtx, ObjectNotFound, RadosError
+
+DEFAULT_STRIPE_UNIT = 512 * 1024
+DEFAULT_STRIPE_COUNT = 4
+DEFAULT_OBJECT_SIZE = 4 << 20
+
+LAYOUT_ATTR = "striper.layout"
+
+
+class RadosStriper:
+    """libradosstriper::RadosStriper role over one IoCtx."""
+
+    def __init__(self, ioctx: IoCtx,
+                 stripe_unit: int = DEFAULT_STRIPE_UNIT,
+                 stripe_count: int = DEFAULT_STRIPE_COUNT,
+                 object_size: int = DEFAULT_OBJECT_SIZE):
+        if object_size % stripe_unit:
+            raise RadosError(-22, "object_size % stripe_unit != 0")
+        self.ioctx = ioctx
+        self.stripe_unit = stripe_unit
+        self.stripe_count = stripe_count
+        self.object_size = object_size
+
+    @staticmethod
+    def _obj(soid: str, objectno: int) -> str:
+        return f"{soid}.{objectno:016x}"
+
+    async def _layout(self, soid: str) -> Dict[str, Any]:
+        try:
+            raw = await self.ioctx.getxattr(self._obj(soid, 0),
+                                            LAYOUT_ATTR)
+        except RadosError as e:
+            if e.rc in (-2, -61):   # ENOENT / ENODATA
+                raise ObjectNotFound(-2, soid)
+            raise
+        return json.loads(raw.decode())
+
+    async def _save_layout(self, soid: str, size: int) -> None:
+        await self.ioctx.setxattr(
+            self._obj(soid, 0), LAYOUT_ATTR,
+            json.dumps({"stripe_unit": self.stripe_unit,
+                        "stripe_count": self.stripe_count,
+                        "object_size": self.object_size,
+                        "size": size}).encode())
+
+    def _extents(self, offset: int, length: int,
+                 layout: Dict[str, Any] = None
+                 ) -> List[Tuple[int, int, int]]:
+        """byte range -> [(objectno, in-object offset, span)] — the
+        file_to_extents RAID-0 walk.  Geometry comes from the STORED
+        layout when given (reads/truncates of an existing stream must
+        follow how it was written, not this handle's defaults)."""
+        if layout is not None:
+            su = layout["stripe_unit"]
+            sc = layout["stripe_count"]
+            osz = layout["object_size"]
+        else:
+            su, sc, osz = (self.stripe_unit, self.stripe_count,
+                           self.object_size)
+        per_set = osz * sc           # bytes per object set
+        units_per_obj = osz // su
+        out: List[Tuple[int, int, int]] = []
+        end = offset + length
+        while offset < end:
+            unit = offset // su      # global stripe unit index
+            in_unit = offset % su
+            setno = offset // per_set
+            unit_in_set = unit % (sc * units_per_obj)
+            obj_in_set = unit_in_set % sc
+            row = unit_in_set // sc  # unit row within the object
+            objectno = setno * sc + obj_in_set
+            obj_off = row * su + in_unit
+            span = min(su - in_unit, end - offset)
+            out.append((objectno, obj_off, span))
+            offset += span
+        return out
+
+    # -- API (libradosstriper surface) -------------------------------------
+
+    async def write(self, soid: str, data: bytes,
+                    offset: int = 0) -> None:
+        layout_size = offset + len(data)
+        try:
+            cur = await self._layout(soid)
+        except ObjectNotFound:
+            cur = None  # fresh stream
+        # any OTHER error propagates: treating a transient read
+        # failure as "fresh" would rewrite the stored size downward
+        # (silent truncation)
+        if cur is not None:
+            if (cur["stripe_unit"], cur["stripe_count"],
+                    cur["object_size"]) != (self.stripe_unit,
+                                            self.stripe_count,
+                                            self.object_size):
+                raise RadosError(-22, "layout mismatch with existing"
+                                      " striped object")
+            layout_size = max(cur["size"], layout_size)
+        jobs = []
+        pos = 0
+        for objectno, obj_off, span in self._extents(offset, len(data)):
+            chunk = data[pos:pos + span]
+            pos += span
+            jobs.append(self.ioctx.write(self._obj(soid, objectno),
+                                         chunk, obj_off))
+        if jobs:
+            await asyncio.gather(*jobs)
+        await self._save_layout(soid, layout_size)
+
+    async def write_full(self, soid: str, data: bytes) -> None:
+        try:
+            await self.remove(soid)
+        except ObjectNotFound:
+            pass
+        await self.write(soid, data, 0)
+
+    async def append(self, soid: str, data: bytes) -> None:
+        size = await self.size(soid)
+        await self.write(soid, data, size)
+
+    async def read(self, soid: str, offset: int = 0,
+                   length: int = 0) -> bytes:
+        layout = await self._layout(soid)
+        size = layout["size"]
+        if offset >= size:
+            return b""
+        if length == 0 or offset + length > size:
+            length = size - offset
+
+        async def one(objectno: int, obj_off: int, span: int) -> bytes:
+            try:
+                buf = await self.ioctx.read(
+                    self._obj(soid, objectno), obj_off, span)
+            except ObjectNotFound:
+                return bytes(span)   # sparse
+            if len(buf) < span:
+                buf += bytes(span - len(buf))
+            return buf
+
+        parts = await asyncio.gather(
+            *(one(*ext)
+              for ext in self._extents(offset, length, layout)))
+        return b"".join(parts)
+
+    async def size(self, soid: str) -> int:
+        return (await self._layout(soid))["size"]
+
+    async def stat(self, soid: str) -> Dict[str, Any]:
+        return dict(await self._layout(soid))
+
+    async def remove(self, soid: str) -> None:
+        layout = await self._layout(soid)
+        per_set = layout["object_size"] * layout["stripe_count"]
+        nsets = max(1, -(-layout["size"] // per_set))
+        nobjs = nsets * layout["stripe_count"]
+
+        async def rm(objectno: int) -> None:
+            try:
+                await self.ioctx.remove(self._obj(soid, objectno))
+            except ObjectNotFound:
+                pass
+
+        # shadows concurrently; the layout holder (object 0) LAST so a
+        # crashed remove leaves the stream reopenable, never orphaned
+        if nobjs > 1:
+            await asyncio.gather(*(rm(i) for i in range(1, nobjs)))
+        await rm(0)
+
+    async def truncate(self, soid: str, size: int) -> None:
+        layout = await self._layout(soid)
+        if size > layout["size"]:
+            await self._save_layout(soid, size)
+            return
+        # drop data past the new end (object granularity via
+        # zeroing), walking the STORED geometry
+        for objectno, obj_off, span in self._extents(
+                size, layout["size"] - size, layout):
+            try:
+                await self.ioctx.write(self._obj(soid, objectno),
+                                       bytes(span), obj_off)
+            except ObjectNotFound:
+                pass
+        await self._save_layout(soid, size)
