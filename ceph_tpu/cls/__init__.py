@@ -100,7 +100,7 @@ class MethodContext:
 
     async def write_full(self, data: bytes) -> None:
         self._need_wr()
-        rc = await self._d._op_write_full(
+        rc, _out = await self._d._op_write_full(
             self._state, self._pool, self.oid, data,
             self._admit_epoch, self._snapc)
         if rc != 0:

@@ -11,6 +11,7 @@ RBD/RGW use this for image and bucket-index ownership.
 from __future__ import annotations
 
 import json
+import time
 
 from ceph_tpu.cls import (
     ClsError,
@@ -33,13 +34,25 @@ def _attr(name: str) -> str:
 
 async def _load(ctx: MethodContext, name: str) -> dict:
     try:
-        return json.loads(await ctx.getxattr(_attr(name)))
+        st = json.loads(await ctx.getxattr(_attr(name)))
     except ClsError as e:
         if e.rc in (ENOENT, ENOATTR):
             return {"type": None, "tag": "", "lockers": {}}
         # EIO/EAGAIN etc: the lock state is UNKNOWN, not absent —
         # treating it as unlocked would grant a second exclusive owner
         raise
+    # expiry (the reference lock_info_t expiration,
+    # src/cls/lock/cls_lock.cc:147 remove expired): a locker taken
+    # with duration>0 that outlived it is dropped on load, so a
+    # crashed client can never brick the object forever
+    now = time.time()
+    expired = [k for k, v in st["lockers"].items()
+               if v.get("expires", 0) and v["expires"] < now]
+    for k in expired:
+        del st["lockers"][k]
+    if not st["lockers"]:
+        st["type"] = None
+    return st
 
 
 def _key(owner: str, cookie: str) -> str:
@@ -86,7 +99,10 @@ async def lock(ctx: MethodContext, data: bytes) -> bytes:
     else:
         st["type"] = ltype
     st["tag"] = tag
-    st["lockers"][me] = {"owner": owner, "cookie": cookie}
+    duration = float(req.get("duration", 0) or 0)
+    st["lockers"][me] = {"owner": owner, "cookie": cookie,
+                         "expires": time.time() + duration
+                         if duration else 0}
     await _store(ctx, name, st)
     return b""
 

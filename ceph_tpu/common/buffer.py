@@ -1,0 +1,105 @@
+"""Zero-copy shard buffer views (the bufferlist share-don't-copy role).
+
+Reference parity: ceph::buffer::list (/root/reference/src/include/
+buffer.h) lets every layer pass refcounted views of the same pages —
+an EC data shard handed to the ObjectStore is a view of the client's
+message buffer, never a copy.  Python's memoryview covers the
+contiguous case; `StridedBuf` covers the one layout bufferlists get
+from a ptr-list that a flat buffer cannot express: an EC DATA shard,
+which is every k-th chunk of the logical object (chunk c of shard i
+lives at stripe offset i*chunk — ErasureCodeInterface.h:39-78).
+Holding the stripes as a strided numpy view of the adopted client
+buffer removes the whole-object transpose copy from the write path;
+byte materialization happens only where a consumer genuinely needs
+contiguous bytes (socket framing, ranged reads).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def is_immutable(data) -> bool:
+    """True when no OTHER owner can mutate the buffer's bytes.
+
+    The store-adoption guard (os/memstore.py): adopted buffers must
+    never change under the recorded crcs.  Walks the base chain:
+
+    - bytes: immutable by construction.
+    - memoryview: must be readonly AND backed by an immutable base —
+      `memoryview(ba).toreadonly()` is readonly while its owner still
+      mutates `ba`, so readonly alone is not proof.
+    - ndarray / StridedBuf: every view on the chain must be frozen
+      (non-writeable) down to a root that either owns its memory
+      (frozen owner — producers in this repo freeze via setflags and
+      never thaw; the claim contract covers them) or wraps an
+      immutable buffer.
+    """
+    if isinstance(data, bytes):
+        return True
+    if isinstance(data, memoryview):
+        return data.readonly and is_immutable(data.obj)
+    if isinstance(data, StridedBuf):
+        return is_immutable(data.view)
+    if isinstance(data, np.ndarray):
+        if data.flags.writeable:
+            return False
+        if data.base is None:
+            return True  # frozen owner
+        return is_immutable(data.base)
+    return False
+
+
+class StridedBuf:
+    """Read-only logical byte string backed by a strided uint8 view.
+
+    view: np.ndarray shaped (rows, row_len) — logical content is the
+    C-order concatenation of the rows.  Supports the small surface the
+    stores and messengers use: len, slicing (returns bytes), bytes().
+    """
+
+    __slots__ = ("view", "_flat")
+
+    def __init__(self, view: np.ndarray):
+        assert view.ndim == 2 and view.dtype == np.uint8
+        self.view = view
+        self._flat = None
+
+    def __len__(self) -> int:
+        return int(self.view.size)
+
+    def tobytes(self) -> bytes:
+        if self._flat is None:
+            self._flat = self.view.tobytes()
+        return self._flat
+
+    def __bytes__(self) -> bytes:
+        return self.tobytes()
+
+    def __getitem__(self, key) -> bytes:
+        if not isinstance(key, slice):
+            raise TypeError("StridedBuf supports slice access only")
+        start, stop, step = key.indices(len(self))
+        if step != 1:
+            raise ValueError("StridedBuf slices must be contiguous")
+        if self._flat is not None:
+            return self._flat[start:stop]
+        rows, row_len = self.view.shape
+        r0, c0 = divmod(start, row_len)
+        r1, c1 = divmod(stop, row_len)
+        if r0 == r1:  # within one row: one contiguous copy
+            return self.view[r0, c0:c1].tobytes()
+        if r1 - r0 <= 2 and c0 == 0 and c1 == 0:
+            return self.view[r0:r1].tobytes()
+        # spans many rows: materialize once, serve from the flat form
+        return self.tobytes()[start:stop]
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, (bytes, bytearray, memoryview)):
+            return self.tobytes() == bytes(other)
+        if isinstance(other, StridedBuf):
+            return self.tobytes() == other.tobytes()
+        return NotImplemented
+
+    def __repr__(self) -> str:
+        return f"StridedBuf(len={len(self)})"

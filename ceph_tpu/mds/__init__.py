@@ -111,6 +111,7 @@ class MDSDaemon:
         self.msgr = Messenger(f"mds.{name}",
                               secret=parse_secret(secret))
         self.msgr.secure = secure
+        self.msgr.local_fastpath = True
         self.msgr.dispatcher = self._dispatch
         self.meta: Optional[IoCtx] = None
         self.data_io: Optional[IoCtx] = None

@@ -265,6 +265,13 @@ class Paxos:
         # durable state
         self.last_pn = 0          # highest PN promised (collect)
         self.accepted_pn = 0      # PN of the collect we accepted
+        # PN of MY current reign (leader only).  accepted_pn can be
+        # overwritten by a rival's higher-PN collect while we still
+        # think we lead; proposing with accepted_pn would then make two
+        # proposers share one PN and peons would accept both BEGINs for
+        # the same version — divergent commits.  _begin proposes with
+        # _my_pn and steps down on mismatch (quorum-safety guard).
+        self._my_pn = 0
         self.last_committed = 0
         self.first_committed = 0
         self.uncommitted: Optional[tuple] = None  # (pn, v, value)
@@ -365,10 +372,12 @@ class Paxos:
                 pn, v, value = self.uncommitted
                 if v == self.last_committed + 1:
                     self._commit_value(v, value)
+            self._my_pn = self.accepted_pn
             self.active = True
             return
         pn = self._new_pn()
         self.accepted_pn = pn
+        self._my_pn = pn
         self._persist()
         self._last = {}
         collect = MMonPaxos(OP_COLLECT, pn=pn,
@@ -467,7 +476,20 @@ class Paxos:
 
     async def _begin(self, value: bytes) -> bool:
         v = self.last_committed + 1
-        pn = self.accepted_pn
+        pn = self._my_pn
+        if pn != self.accepted_pn or not self.leading:
+            # a rival's higher-PN collect superseded this reign between
+            # proposals (see _my_pn) — step down instead of proposing
+            # under a PN that is no longer exclusively ours
+            log.warning("mon.%d: reign pn %d superseded by %d —"
+                        " stepping down", self.rank, pn,
+                        self.accepted_pn)
+            self.leading = False
+            self.active = False
+            self._stop_lease()
+            if self.on_leader_dead is not None:
+                await self.on_leader_dead()
+            return False
         self.uncommitted = (pn, v, value)
         self._persist()
         self._accepts = {self.rank}
@@ -557,6 +579,18 @@ class Paxos:
         op = msg.op
         if op == OP_COLLECT:
             if msg.pn > max(self.last_pn, self.accepted_pn):
+                if self.leading:
+                    # a rival reign with a higher PN exists: demote NOW
+                    # (election-resets-paxos discipline,
+                    # /root/reference/src/mon/Paxos.cc handle_collect
+                    # via election) — a stale leader must never keep
+                    # proposing under the rival's PN
+                    log.warning("mon.%d: higher-pn collect %d from"
+                                " mon.%d while leading — demoting",
+                                self.rank, msg.pn, from_rank)
+                    self.leading = False
+                    self.active = False
+                    self._stop_lease()
                 self.last_pn = msg.pn
                 self.accepted_pn = msg.pn
                 reply = MMonPaxos(
@@ -583,6 +617,10 @@ class Paxos:
                 self._last[from_rank] = m
         elif op == OP_BEGIN:
             if msg.pn >= self.accepted_pn:
+                if self.leading and from_rank != self.rank:
+                    self.leading = False
+                    self.active = False
+                    self._stop_lease()
                 self.accepted_pn = msg.pn
                 self.uncommitted = (msg.pn, msg.version, msg.value)
                 self._persist()

@@ -14,8 +14,10 @@ signed with a listed cluster key and carries a fresh nonce (plus an
 optional mon ticket); both sides derive a per-connection SESSION key
 and every later frame is signed with it and must arrive with a
 strictly increasing sequence number.  A recorded frame therefore
-verifies nowhere else (fresh nonces => fresh key) and never twice on
-the same connection (seq monotonicity) — the CephxSessionHandler
+verifies nowhere else (fresh nonces => fresh key), never twice on
+the same connection (seq monotonicity), and never in the OPPOSITE
+direction (the sender's role byte is bound into every signature, so
+reflection by an active MITM fails) — the CephxSessionHandler
 sign_message + session-key discipline.
 
 Lossy-client semantics (src/msg/Policy.h): a dead connection is simply
@@ -44,6 +46,13 @@ log = logging.getLogger("msgr")
 DispatchFn = Callable[["Connection", Message], Awaitable[None]]
 
 HANDSHAKE_TIMEOUT = 10.0
+
+# Process-global kill switch for the in-process fast path (tests that
+# must observe wire bytes — sniffers, frame-level auth tests — flip it)
+LOCAL_FASTPATH = True
+
+# bound addr -> Messenger, for same-process endpoint discovery
+_LOCAL_REGISTRY: Dict[str, "Messenger"] = {}
 
 
 class Connection:
@@ -117,7 +126,8 @@ class Connection:
             flags = frames.FLAG_SECURE
         parts = frames.encode_frame_parts(msg.TAG, seq,
                                           payload, flags=flags,
-                                          key=key)
+                                          key=key,
+                                          role=self._tx_role())
         async with self._send_lock:
             for part in parts:
                 self.writer.write(part)
@@ -160,6 +170,76 @@ class Connection:
         return f"Connection(peer={self.peer_name}@{self.peer_addr})"
 
 
+class LocalConnection(Connection):
+    """In-process peer session: the loopback fast path.
+
+    Reference parity: AsyncMessenger delivers messages addressed to an
+    endpoint in the same process without serializing them onto a socket
+    (Messenger::get_loopback_connection / DispatchQueue local_delivery,
+    /root/reference/src/msg/DispatchQueue.h:200-245 local_delivery +
+    Messenger.h loopback connection) — same discipline here: a Message
+    object is handed to the peer dispatcher as-is, zero-copy, no
+    framing, no signing (same-process peers share a trust domain; the
+    fast path only engages when both endpoints hold the SAME keyring
+    and secure flag, so a mis-keyed peer still takes the socket path
+    and fails authentication honestly).
+
+    Contract: a sent Message is TRANSFERRED — the sender must not
+    mutate or resend the same instance (matching the reference, where
+    a queued local message is owned by the dispatch queue).
+    """
+
+    def __init__(self, messenger: "Messenger", peer_name: str,
+                 peer_addr: str, outbound: bool):
+        self.messenger = messenger
+        self.peer_name = peer_name
+        self.peer_addr = peer_addr
+        self.outbound = outbound
+        self.closed = False
+        self.session_key = None
+        self._peer: Optional["LocalConnection"] = None
+
+    async def send(self, msg: Message) -> None:
+        peer = self._peer
+        if self.closed or peer is None or peer.closed:
+            raise ConnectionError(
+                f"local connection to {self.peer_name} closed")
+        m = peer.messenger
+        if m.dispatcher is not None:
+            if isinstance(msg, MHello):
+                return  # identification already happened at connect
+            m._spawn(m._dispatch_one(peer, msg))
+
+    async def send_hello(self, ticket: bytes = b"") -> None:
+        pass  # no handshake: identities were exchanged at connect
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        peer = self._peer
+        m = self.messenger
+        if m._conns.get(self.peer_addr) is self:
+            del m._conns[self.peer_addr]
+        if self in m._accepted:
+            m._accepted.remove(self)
+        if m.on_connection_fault is not None:
+            try:
+                m.on_connection_fault(self)
+            except Exception:
+                log.exception("connection fault handler failed")
+        if peer is not None and not peer.closed:
+            # propagate asynchronously, mimicking the socket path where
+            # the peer's read loop notices the close a tick later
+            try:
+                asyncio.get_running_loop().call_soon(peer.close)
+            except RuntimeError:
+                peer.close()
+
+    def __repr__(self) -> str:
+        return f"LocalConnection(peer={self.peer_name}@{self.peer_addr})"
+
+
 class Messenger:
     """Bind/connect endpoint owning all connections of one entity."""
 
@@ -178,6 +258,11 @@ class Messenger:
         # post-handshake frames
         self.secure = False
         self.addr: str = ""
+        # opt-in per endpoint: daemons and clients enable it
+        # (ms_local_fastpath); frame-level tests leave it off so two
+        # in-process messengers still exercise the real wire
+        self.local_fastpath = False
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self.dispatcher: Optional[DispatchFn] = None
         self.on_connection_fault: Optional[
             Callable[[Connection], None]] = None
@@ -203,12 +288,16 @@ class Messenger:
             self._handle_accept, host, port, limit=self.STREAM_LIMIT)
         port = self._server.sockets[0].getsockname()[1]
         self.addr = f"{host}:{port}"
+        self._loop = asyncio.get_running_loop()
+        _LOCAL_REGISTRY[self.addr] = self
         return self.addr
 
     async def shutdown(self) -> None:
         # close live connections BEFORE wait_closed(): since 3.12 it
         # waits for all connection handlers, which sit in read loops
         # until their connection dies
+        if _LOCAL_REGISTRY.get(self.addr) is self:
+            del _LOCAL_REGISTRY[self.addr]
         if self._server is not None:
             self._server.close()
         for conn in list(self._conns.values()) + list(self._accepted):
@@ -232,6 +321,13 @@ class Messenger:
         conn = self._conns.get(addr)
         if conn is not None and not conn.closed:
             return conn
+        if LOCAL_FASTPATH and self.local_fastpath:
+            target = _LOCAL_REGISTRY.get(addr)
+            if (target is not None and target is not self
+                    and target.local_fastpath
+                    and target._loop is asyncio.get_running_loop()
+                    and self._local_compatible(target)):
+                return self._connect_local(addr, target)
         host, port_s = addr.rsplit(":", 1)
         reader, writer = await asyncio.open_connection(
             host, int(port_s), limit=self.STREAM_LIMIT)
@@ -250,6 +346,33 @@ class Messenger:
         await conn.send_hello(ticket=ticket)
         self._spawn(self._read_loop(conn))
         return conn
+
+    def _local_compatible(self, target: "Messenger") -> bool:
+        """The fast path must not launder authentication: it engages
+        only where the socket handshake would trivially succeed — both
+        endpoints keyless, or both holding the same active key with the
+        same secure-mode stance."""
+        if (self.secret is None) != (target.secret is None):
+            return False
+        if self.secret is not None:
+            if self.secret.active_key != target.secret.active_key:
+                return False
+            if bool(self.secure) != bool(target.secure):
+                return False
+        return True
+
+    def _connect_local(self, addr: str,
+                       target: "Messenger") -> "LocalConnection":
+        me = LocalConnection(self, target.entity_name, addr,
+                             outbound=True)
+        back = LocalConnection(
+            target, self.entity_name,
+            self.addr or f"local:{self.entity_name}", outbound=False)
+        me._peer = back
+        back._peer = me
+        self._conns[addr] = me
+        target._accepted.append(back)
+        return me
 
     async def send_to(self, addr: str, msg: Message) -> None:
         conn = await self.connect(addr)
@@ -291,7 +414,8 @@ class Messenger:
             raise frames.FrameError("expected hello before session")
         key = self.secret.get(msg.kid)
         if key is None or not auth.verify(
-                key, sig, pre[:frames.PREAMBLE.size], payload):
+                key, sig, conn._rx_role(),
+                pre[:frames.PREAMBLE.size], payload):
             raise frames.FrameError("hello signature mismatch"
                                     " (wrong key?)")
         conn.rx_seq = seq
@@ -334,6 +458,7 @@ class Messenger:
                         raise frames.FrameError(
                             "unsigned frame (auth required)")
                     if not auth.verify(conn.session_key, sig,
+                                       conn._rx_role(),
                                        pre[:frames.PREAMBLE.size],
                                        payload):
                         raise frames.FrameError(

@@ -47,13 +47,21 @@ class FrameError(Exception):
 
 
 def encode_frame_parts(tag: int, seq: int, payload: bytes,
-                       flags: int = 0, key=None) -> list:
+                       flags: int = 0, key=None,
+                       role: bytes = b"") -> list:
     """Frame as (head, payload, tail): the payload rides as-is —
     zero-copy at this layer; for multi-MiB data frames the join it
     avoids is a full extra pass over the object.
 
     key: the signing key BYTES for this frame (a cephx session key, or
-    the static active key during the hello handshake); None = unsigned."""
+    the static active key during the hello handshake); None = unsigned.
+
+    role: the sender's direction byte (b"c"/b"s"), BOUND INTO the
+    signature so a frame recorded in one direction can never verify in
+    the other — without it, symmetric per-direction seq counters let
+    an active MITM reflect a captured frame back to its sender (the
+    reference binds direction via distinct c->s / s->c nonce halves,
+    msg/async/crypto_onwire.cc:34-46)."""
     if key is not None:
         flags |= FLAG_SIGNED
     pre = PREAMBLE.pack(MAGIC, tag, flags, seq, len(payload))
@@ -62,26 +70,29 @@ def encode_frame_parts(tag: int, seq: int, payload: bytes,
     if key is not None:
         from ceph_tpu.common import auth
 
-        tail += auth.sign(key, pre, payload)
+        tail += auth.sign(key, role, pre, payload)
     return [head, payload, tail]
 
 
 def encode_frame(tag: int, seq: int, payload: bytes,
-                 flags: int = 0, key=None) -> bytes:
+                 flags: int = 0, key=None, role: bytes = b"") -> bytes:
     return b"".join(encode_frame_parts(tag, seq, payload,
-                                       flags=flags, key=key))
+                                       flags=flags, key=key, role=role))
 
 
 def check_signature(key, flags: int, pre_buf: bytes,
-                    payload: bytes, sig: bytes) -> None:
-    """Receiver-side auth adjudication; FrameError drops the conn."""
+                    payload: bytes, sig: bytes,
+                    role: bytes = b"") -> None:
+    """Receiver-side auth adjudication; FrameError drops the conn.
+    role: the SENDER's direction byte (the receiver's rx role)."""
     from ceph_tpu.common import auth
 
     if key is None:
         return
     if not flags & FLAG_SIGNED:
         raise FrameError("unsigned frame from peer (auth required)")
-    if not auth.verify(key, sig, pre_buf[:PREAMBLE.size], payload):
+    if not auth.verify(key, sig, role, pre_buf[:PREAMBLE.size],
+                       payload):
         raise FrameError("frame signature mismatch (wrong key?)")
 
 

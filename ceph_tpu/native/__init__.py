@@ -111,6 +111,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.ceph_tpu_snappy_uncompressed_length.argtypes = [u8p, u64]
     except AttributeError:  # stale .so without compress.cc
         pass
+    try:
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        lib.ceph_tpu_ec_encode_noT.restype = None
+        lib.ceph_tpu_ec_encode_noT.argtypes = [
+            u8p, u64, u64, u8p, u64, u64, u8p, u32p, u64, u32p]
+    except AttributeError:  # stale .so without datapath.cc
+        pass
     return lib
 
 
@@ -124,10 +131,30 @@ def get_lib() -> Optional[ctypes.CDLL]:
             return _lib
         try:
             _lib = _bind(ctypes.CDLL(_build()))
+            _tune_allocator()
         except Exception as e:  # pragma: no cover - only on broken toolchain
             _build_error = str(e)
             _lib = None
     return _lib
+
+
+def _tune_allocator() -> None:
+    """Keep multi-MiB data-path buffers on the recycled heap.
+
+    glibc serves large mallocs with fresh mmaps and unmaps them on
+    free, so every encode's stripe/parity arenas pay page-fault +
+    zero-fill for all their pages — a measured ~3x slowdown of the
+    fused encode on the bench host.  The reference links tcmalloc for
+    exactly this reason (do_cmake.sh ALLOCATOR=tcmalloc; perfglue/).
+    mallopt(M_MMAP_THRESHOLD) is the glibc-native equivalent: large
+    blocks come from the main heap and are reused across ops.
+    """
+    try:
+        libc = ctypes.CDLL(None)
+        M_MMAP_THRESHOLD = -3
+        libc.mallopt(M_MMAP_THRESHOLD, 256 << 20)
+    except Exception:  # non-glibc platform: harmless to skip
+        pass
 
 
 def build_error() -> Optional[str]:

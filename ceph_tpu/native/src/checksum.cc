@@ -101,13 +101,54 @@ static uint32_t crc32c_sw(uint32_t crc, const uint8_t *data, uint64_t len) {
   return crc;
 }
 
+// Composed zero-advance matrix for an arbitrary length, cached: the
+// 3-way interleave below combines its lanes through these, and the
+// lengths it asks for repeat (a handful of chunk sizes), so each is
+// composed once (~12 32x32 GF(2) products) and then costs one 32-row
+// apply per combine.  Role parity: the reference folds lanes with
+// PCLMULQDQ constants precomputed per block size
+// (crc32c_intel_fast_zero_asm.s); GF(2) matrices are this build's
+// equivalent (crc is linear either way).
+struct AdvEntry {
+  uint64_t len;
+  uint32_t m[32];
+};
+static AdvEntry g_adv_cache[32];
+static int g_adv_n = 0;
+
+static void compose_advance(uint64_t len, uint32_t out[32]) {
+  for (int i = 0; i < 32; i++) out[i] = 1u << i;  // identity
+  uint32_t tmp[32];
+  for (int r = 0; len; r++, len >>= 1)
+    if (len & 1) {
+      gf2_matmul_mat(zero_mat[r], out, tmp);
+      std::memcpy(out, tmp, sizeof(tmp));
+    }
+}
+
+static const uint32_t *adv_matrix(uint64_t len) {
+  for (int i = 0; i < g_adv_n; i++)
+    if (g_adv_cache[i].len == len) return g_adv_cache[i].m;
+  if (g_adv_n < 32) {
+    AdvEntry &e = g_adv_cache[g_adv_n];
+    e.len = len;
+    compose_advance(len, e.m);
+    g_adv_n++;
+    return e.m;
+  }
+  static uint32_t scratch[32];  // cache full: compose uncached
+  compose_advance(len, scratch);
+  return scratch;
+}
+
 #if defined(__x86_64__)
 // Hardware CRC32C (the SSE4.2 crc32 instruction computes exactly the
 // Castagnoli reflected CRC) — the crc32c_intel_fast role
 // (/root/reference/src/common/crc32c_intel_fast.c); ~10x the
 // slicing-by-8 tables.
 __attribute__((target("sse4.2")))
-static uint32_t crc32c_hw(uint32_t crc, const uint8_t *data, uint64_t len) {
+static uint32_t crc32c_hw_1way(uint32_t crc, const uint8_t *data,
+                               uint64_t len) {
   uint64_t c = crc;
   while (len >= 8) {
     uint64_t w;
@@ -119,6 +160,39 @@ static uint32_t crc32c_hw(uint32_t crc, const uint8_t *data, uint64_t len) {
   uint32_t c32 = static_cast<uint32_t>(c);
   while (len--) c32 = __builtin_ia32_crc32qi(c32, *data++);
   return c32;
+}
+
+// The crc32 instruction has ~3-cycle latency, 1-cycle throughput: a
+// single dependency chain caps at ~2.7 B/cycle.  Three independent
+// lanes fill the pipeline (~8 B/cycle), recombined through cached
+// zero-advance matrices — the standard interleave the reference's asm
+// tier implements with PCLMULQDQ folding.
+__attribute__((target("sse4.2")))
+static uint32_t crc32c_hw(uint32_t crc, const uint8_t *data, uint64_t len) {
+  constexpr uint64_t MIN3 = 3 * 256;
+  if (len < MIN3) return crc32c_hw_1way(crc, data, len);
+  uint64_t lane = (len / 24) * 8;  // 8-byte-aligned lane length
+  const uint8_t *pa = data, *pb = data + lane, *pc = data + 2 * lane;
+  uint64_t a = crc, b = 0, c = 0;
+  for (uint64_t i = 0; i < lane; i += 8) {
+    uint64_t wa, wb, wc;
+    std::memcpy(&wa, pa + i, 8);
+    std::memcpy(&wb, pb + i, 8);
+    std::memcpy(&wc, pc + i, 8);
+    a = __builtin_ia32_crc32di(a, wa);
+    b = __builtin_ia32_crc32di(b, wb);
+    c = __builtin_ia32_crc32di(c, wc);
+  }
+  uint64_t tail = len - 3 * lane;
+  uint32_t a32 = static_cast<uint32_t>(a);
+  uint32_t b32 = static_cast<uint32_t>(b);
+  uint32_t c32 = static_cast<uint32_t>(c);
+  // result = advance(a, 2*lane + tail) ^ advance(b, lane + tail) ^
+  //          crc(c seeded 0 over partC+tail)
+  c32 = crc32c_hw_1way(c32, data + 3 * lane, tail);
+  gf2_matmul_vec(adv_matrix(2 * lane + tail), &a32);
+  gf2_matmul_vec(adv_matrix(lane + tail), &b32);
+  return a32 ^ b32 ^ c32;
 }
 
 static bool have_sse42() {

@@ -1,0 +1,85 @@
+// Fused host datapath helpers: the per-byte passes of an EC object
+// write collapsed into one native call.
+//
+// Reference parity: the reference's write path stacks independent
+// native passes — bufferlist rebuild/alignment (src/common/buffer.cc
+// rebuild_aligned_size_and_memory), jerasure/isa-l region encode
+// (src/erasure-code/), per-shard cumulative crc32c for HashInfo
+// (src/osd/ECUtil.h:101-160, crc asm in src/common/crc32c_intel_fast.c)
+// — each a separate C++ loop over the data.  Here the GF(2^8) parity
+// accumulate, the per-shard hinfo crcs and the logical content digest
+// run chunk-by-chunk in ONE cache-resident pass (and one
+// Python->native transition), and the data shards are never copied at
+// all — the store adopts strided views (common/buffer.py StridedBuf).
+//
+// The TPU path replaces the matmul pass with the batched Pallas words
+// kernel (ops/gf_pallas.py); this file is the host tier the empirical
+// dispatch gate races it against (ec/dispatch.py).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// from checksum.cc
+uint32_t ceph_tpu_crc32c(uint32_t crc, const uint8_t *data, uint64_t len);
+
+// declared in gf_simd.cc
+void ceph_tpu_gf_region_mad_v(uint8_t *dst, const uint8_t *src,
+                              uint64_t len, const uint8_t *tbl);
+void ceph_tpu_gf_region_mul_v(uint8_t *dst, const uint8_t *src,
+                              uint64_t len, const uint8_t *tbl);
+
+// Transpose-free whole-object encode, one cache-resident pass:
+//   src         (n_stripes, k, chunk) logical object bytes
+//   parity_out  (m, n_stripes*chunk)  per-shard parity streams
+//   crc_inout   k+m seeds -> cumulative per-shard crc32c (may be null)
+//   logical_len unpadded byte count of src; *logical_crc_inout (may be
+//               null) accumulates crc32c over src[:logical_len] — the
+//               content digest the write reply carries back so the
+//               gateway never re-reads the object for its ETag (the
+//               librados returnvec role, osd_types.h OSDOp::outdata).
+// The k data shards are NOT copied: callers hand the store strided
+// views of src (shard i = src[:, i, :]) — the bufferlist
+// share-don't-copy discipline; on a low-memory-bandwidth host the
+// eliminated 2x object-size of transpose traffic is the difference.
+// Column 0 uses the non-accumulating mul so the parity buffers need no
+// memset pass.  Per 4 KiB chunk everything (parity mads, crcs) runs
+// while the chunk is L1/L2-hot, so total memory traffic is
+// read(object) + write(parity).
+void ceph_tpu_ec_encode_noT(const uint8_t *mat_tables, uint64_t m,
+                            uint64_t k, const uint8_t *src,
+                            uint64_t n_stripes, uint64_t chunk,
+                            uint8_t *parity_out, uint32_t *crc_inout,
+                            uint64_t logical_len,
+                            uint32_t *logical_crc_inout) {
+  const uint64_t stream = n_stripes * chunk;
+  uint64_t remaining = logical_len;
+  for (uint64_t s = 0; s < n_stripes; s++) {
+    const uint8_t *row = src + s * k * chunk;
+    for (uint64_t i = 0; i < k; i++) {
+      const uint8_t *d = row + i * chunk;
+      for (uint64_t j = 0; j < m; j++) {
+        const uint8_t *tbl = mat_tables + (j * k + i) * 256;
+        uint8_t *dst = parity_out + j * stream + s * chunk;
+        if (i == 0)
+          ceph_tpu_gf_region_mul_v(dst, d, chunk, tbl);
+        else
+          ceph_tpu_gf_region_mad_v(dst, d, chunk, tbl);
+      }
+      if (crc_inout != nullptr)
+        crc_inout[i] = ceph_tpu_crc32c(crc_inout[i], d, chunk);
+      if (logical_crc_inout != nullptr && remaining > 0) {
+        uint64_t take = remaining < chunk ? remaining : chunk;
+        *logical_crc_inout = ceph_tpu_crc32c(*logical_crc_inout, d, take);
+        remaining -= take;
+      }
+    }
+    if (crc_inout != nullptr)
+      for (uint64_t j = 0; j < m; j++)
+        crc_inout[k + j] = ceph_tpu_crc32c(
+            crc_inout[k + j], parity_out + j * stream + s * chunk, chunk);
+  }
+}
+
+}  // extern "C"

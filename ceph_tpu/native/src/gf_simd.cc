@@ -99,6 +99,49 @@ void mad_avx2(uint8_t *dst, const uint8_t *src, uint64_t len,
   mad_scalar(dst + i, src + i, len - i, lo, hi);
 }
 
+__attribute__((target("ssse3")))
+void mul_ssse3(uint8_t *dst, const uint8_t *src, uint64_t len,
+               const uint8_t lo[16], const uint8_t hi[16]) {
+  const __m128i vlo = _mm_loadu_si128((const __m128i *)lo);
+  const __m128i vhi = _mm_loadu_si128((const __m128i *)hi);
+  const __m128i mask = _mm_set1_epi8(0x0f);
+  uint64_t i = 0;
+  for (; i + 16 <= len; i += 16) {
+    __m128i s = _mm_loadu_si128((const __m128i *)(src + i));
+    __m128i p = _mm_xor_si128(
+        _mm_shuffle_epi8(vlo, _mm_and_si128(s, mask)),
+        _mm_shuffle_epi8(vhi, _mm_and_si128(_mm_srli_epi64(s, 4), mask)));
+    _mm_storeu_si128((__m128i *)(dst + i), p);
+  }
+  for (; i < len; i++) dst[i] = lo[src[i] & 0x0f] ^ hi[src[i] >> 4];
+}
+
+__attribute__((target("avx2")))
+void mul_avx2(uint8_t *dst, const uint8_t *src, uint64_t len,
+              const uint8_t lo[16], const uint8_t hi[16]) {
+  const __m256i vlo =
+      _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i *)lo));
+  const __m256i vhi =
+      _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i *)hi));
+  const __m256i mask = _mm256_set1_epi8(0x0f);
+  uint64_t i = 0;
+  for (; i + 32 <= len; i += 32) {
+    __m256i s = _mm256_loadu_si256((const __m256i *)(src + i));
+    __m256i p = _mm256_xor_si256(
+        _mm256_shuffle_epi8(vlo, _mm256_and_si256(s, mask)),
+        _mm256_shuffle_epi8(
+            vhi, _mm256_and_si256(_mm256_srli_epi64(s, 4), mask)));
+    _mm256_storeu_si256((__m256i *)(dst + i), p);
+  }
+  for (; i < len; i++) dst[i] = lo[src[i] & 0x0f] ^ hi[src[i] >> 4];
+}
+
+void mul_scalar(uint8_t *dst, const uint8_t *src, uint64_t len,
+                const uint8_t lo[16], const uint8_t hi[16]) {
+  for (uint64_t i = 0; i < len; i++)
+    dst[i] = lo[src[i] & 0x0f] ^ hi[src[i] >> 4];
+}
+
 __attribute__((target("avx2")))
 void xor_avx2(uint8_t *dst, const uint8_t *src, uint64_t len) {
   uint64_t i = 0;
@@ -139,6 +182,16 @@ mad_fn pick_mad() {
 
 const mad_fn g_mad = pick_mad();
 
+mad_fn pick_mul() {
+#ifdef CEPH_TPU_X86
+  if (g_level == 2) return mul_avx2;
+  if (g_level == 1) return mul_ssse3;
+#endif
+  return mul_scalar;
+}
+
+const mad_fn g_mul = pick_mul();
+
 }  // namespace
 
 extern "C" {
@@ -158,6 +211,15 @@ void ceph_tpu_gf_region_mad_v(uint8_t *dst, const uint8_t *src,
   uint8_t lo[16], hi[16];
   nibble_tables(tbl, lo, hi);
   g_mad(dst, src, len, lo, hi);
+}
+
+// dst = tbl[src] (no accumulate): the first-column store that lets the
+// encode loop skip a whole memset pass over the parity buffers.
+void ceph_tpu_gf_region_mul_v(uint8_t *dst, const uint8_t *src,
+                              uint64_t len, const uint8_t *tbl) {
+  uint8_t lo[16], hi[16];
+  nibble_tables(tbl, lo, hi);
+  g_mul(dst, src, len, lo, hi);
 }
 
 // Vectorized GF(2^8) matmul: out(R,S) = mat(R,K) * data(K,S), XOR

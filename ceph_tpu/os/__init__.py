@@ -54,8 +54,14 @@ class Transaction:
 
     def write(self, cid: str, oid: ObjectId, offset: int,
               length: int, data: bytes) -> None:
+        """Buffers are CLAIMED, not copied (the reference Transaction
+        holds bufferlist refs, src/os/Transaction.h — writers never
+        mutate a buffer after queueing it); bytearrays are the one
+        caller-mutable type, so only they are snapshotted."""
         assert length == len(data)
-        self.ops.append(("write", cid, oid, offset, bytes(data)))
+        if isinstance(data, bytearray):
+            data = bytes(data)
+        self.ops.append(("write", cid, oid, offset, data))
 
     def zero(self, cid: str, oid: ObjectId, offset: int,
              length: int) -> None:

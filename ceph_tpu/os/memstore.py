@@ -10,10 +10,18 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, List
 
+from ceph_tpu.common.buffer import StridedBuf
 from ceph_tpu.os import ObjectId, ObjectStore, Transaction
 
 
 class _Object:
+    """data is a bytearray OR an adopted immutable buffer
+    (bytes/memoryview) — the reference MemStore holds refcounted
+    bufferlists, sharing the writer's pages zero-copy (MemStore.h
+    BufferlistObject); a full-object write here adopts the submitted
+    buffer by reference and any later mutating op promotes it to a
+    private bytearray first."""
+
     __slots__ = ("data", "xattrs", "omap", "omap_header")
 
     def __init__(self) -> None:
@@ -22,13 +30,30 @@ class _Object:
         self.omap: Dict[str, bytes] = {}
         self.omap_header = b""
 
+    def mutable(self) -> bytearray:
+        if not isinstance(self.data, bytearray):
+            self.data = bytearray(
+                self.data.tobytes() if isinstance(self.data, StridedBuf)
+                else self.data)
+        return self.data
+
     def clone(self) -> "_Object":
         out = _Object()
-        out.data = bytearray(self.data)
+        if isinstance(self.data, bytearray):
+            out.data = bytearray(self.data)
+        else:
+            # adopted buffers are immutable (MemStore._immutable):
+            # share them — the refcounted-bufferlist COW discipline;
+            # a later mutating op promotes through mutable()
+            out.data = self.data
         out.xattrs = dict(self.xattrs)
         out.omap = dict(self.omap)
         out.omap_header = self.omap_header
         return out
+
+
+# full-object writes at least this large are adopted by reference
+_ADOPT_MIN = 64 * 1024
 
 
 class MemStore(ObjectStore):
@@ -55,6 +80,18 @@ class MemStore(ObjectStore):
         for cb in txn.on_commit:
             cb()
 
+    @staticmethod
+    def _immutable(data) -> bool:
+        """Only provably-immutable buffers are adopted by reference: a
+        WRITABLE view (or a readonly view over a caller-mutable base)
+        could change under the recorded crcs after the op returns.
+        The base-chain walk lives in common.buffer.is_immutable (the
+        reference's bufferlists are refcounted immutable pages — same
+        guarantee)."""
+        from ceph_tpu.common.buffer import is_immutable
+
+        return is_immutable(data)
+
     def _obj(self, cid: str, oid: ObjectId, create: bool = False) -> _Object:
         coll = self._colls[cid]
         if oid not in coll:
@@ -74,24 +111,51 @@ class MemStore(ObjectStore):
         elif kind == "write":
             _k, cid, oid, offset, data = op
             obj = self._obj(cid, oid, create=True)
+            size = len(obj.data)
+            if offset == 0 and size == 0:
+                if len(data) >= _ADOPT_MIN and self._immutable(data):
+                    # adopt by reference (class docstring): zero-copy
+                    obj.data = data
+                elif len(data) >= _ADOPT_MIN:
+                    # writable buffer: the caller may legally reuse it
+                    # after the op returns — snapshot
+                    obj.data = bytes(data)
+                else:
+                    obj.data = bytearray(
+                        data.tobytes() if isinstance(data, StridedBuf)
+                        else data)
+                return
+            if isinstance(data, StridedBuf):
+                data = data.tobytes()
+            buf = obj.mutable()
+            if offset == size:
+                # append fast path: one memcpy, no zero-fill pass
+                buf += data
+                return
             end = offset + len(data)
-            if len(obj.data) < end:
-                obj.data.extend(b"\0" * (end - len(obj.data)))
-            obj.data[offset:end] = data
+            if size < offset:
+                buf.extend(b"\0" * (offset - size))
+                buf += data
+                return
+            buf[offset:end] = data
         elif kind == "zero":
             _k, cid, oid, offset, length = op
             obj = self._obj(cid, oid, create=True)
+            buf = obj.mutable()
             end = offset + length
-            if len(obj.data) < end:
-                obj.data.extend(b"\0" * (end - len(obj.data)))
-            obj.data[offset:end] = b"\0" * length
+            if len(buf) < end:
+                buf.extend(b"\0" * (end - len(buf)))
+            buf[offset:end] = b"\0" * length
         elif kind == "truncate":
             _k, cid, oid, size = op
             obj = self._obj(cid, oid, create=True)
             if len(obj.data) > size:
-                del obj.data[size:]
+                if isinstance(obj.data, bytearray):
+                    del obj.data[size:]
+                else:
+                    obj.data = obj.data[:size]  # zero-copy narrow
             else:
-                obj.data.extend(b"\0" * (size - len(obj.data)))
+                obj.mutable().extend(b"\0" * (size - len(obj.data)))
         elif kind == "remove":
             self._colls[op[1]].pop(op[2], None)
         elif kind == "clone":

@@ -590,6 +590,8 @@ class TPUStore(ObjectStore):
             self._put_onode(kvt, cid, oid, onode)
         elif kind == "write":
             _k, cid, oid, offset, data = op
+            if not isinstance(data, (bytes, bytearray, memoryview)):
+                data = bytes(data)  # StridedBuf: durable store is a copy anyway
             self._object_write(kvt, cid, oid, offset, data)
         elif kind == "zero":
             _k, cid, oid, offset, length = op

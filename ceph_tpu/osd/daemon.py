@@ -286,6 +286,8 @@ class OSDDaemon:
             f"osd.{osd_id}", secret=parse_secret(
                 self.config.get("auth_secret")))
         self.msgr.secure = bool(self.config.get("auth_secure"))
+        self.msgr.local_fastpath = bool(
+            self.config.get("ms_local_fastpath", True))
         self.msgr.dispatcher = self._dispatch
         self.store = store if store is not None else MemStore()
         self._own_store = store is None
@@ -482,8 +484,19 @@ class OSDDaemon:
     def _sinfo(self, pool_id: int) -> ec_util.StripeInfo:
         codec = self._codec(pool_id)
         k = codec.get_data_chunk_count()
-        unit = codec.get_chunk_size(
-            k * int(self.config["osd_pool_erasure_code_stripe_unit"]))
+        # per-profile stripe_unit override, falling back to the global
+        # default — the reference's erasure-code-profile stripe_unit
+        # key (OSDMonitor.cc parse_erasure_code_profile; option
+        # osd_pool_erasure_code_stripe_unit options.cc:2662).  Larger
+        # units amortize per-chunk costs (crc lane combines, region-op
+        # setup) on big-object pools.
+        pool = self.osdmap.pools[pool_id]
+        profile = self.osdmap.erasure_code_profiles.get(
+            pool.erasure_code_profile, {})
+        base = int(profile.get(
+            "stripe_unit",
+            self.config["osd_pool_erasure_code_stripe_unit"]))
+        unit = codec.get_chunk_size(k * base)
         return ec_util.StripeInfo(k, k * unit)
 
     async def _request(self, osd: int, msg: Message,
@@ -683,6 +696,8 @@ class OSDDaemon:
                         " %d (mon inc log trimmed)", self.osd_id,
                         newmap.epoch, self.osdmap.epoch)
         self.osdmap = newmap
+        # mutation-through-incrementals contract: enable placement memo
+        self.osdmap.cache_placement = True
         self._post_map_epoch(prev_up)
 
     def _request_map_range(self) -> None:
@@ -2988,10 +3003,10 @@ class OSDDaemon:
             read_oid = resolved
         for op in msg.ops:
             if op.op == "write_full":
-                rc = await self._op_write_full(state, pool, msg.oid,
-                                               op.data,
-                                               state_admit_epoch,
-                                               snapc)
+                rc, out = await self._op_write_full(state, pool,
+                                                    msg.oid, op.data,
+                                                    state_admit_epoch,
+                                                    snapc)
             elif op.op == "write":
                 rc = await self._op_write(state, pool, msg.oid,
                                           op.offset, op.data,
@@ -3246,7 +3261,7 @@ class OSDDaemon:
     async def _op_write_full(self, state: PGState, pool, oid: str,
                              data: bytes,
                              admit_epoch: Optional[int] = None,
-                             snapc=None) -> int:
+                             snapc=None) -> Tuple[int, Dict[str, Any]]:
         # per-object lock on EVERY pool type: SnapSet updates are
         # read-modify-write and must not race other writes or trim
         async with state.obj_lock(oid):
@@ -3257,7 +3272,15 @@ class OSDDaemon:
 
     async def _op_write_full_locked(
             self, state: PGState, pool, oid: str, data: bytes,
-            admit_epoch: Optional[int] = None, snapc=None) -> int:
+            admit_epoch: Optional[int] = None, snapc=None
+    ) -> Tuple[int, Dict[str, Any]]:
+        if isinstance(data, bytearray) or (
+                isinstance(data, memoryview) and not data.readonly):
+            # caller-mutable buffer (possible via the loopback fast
+            # path): snapshot BEFORE the stores adopt views of it, or
+            # a client reusing its buffer would corrupt durable shards
+            # under already-recorded hinfo crcs
+            data = bytes(data)
         clone_ops: List[ShardOp] = []
         ss_raw: Optional[bytes] = None
         if snapc is not None:
@@ -3266,6 +3289,7 @@ class OSDDaemon:
         entry = self._next_entry(state, pool, oid, "modify", len(data))
         oi = json.dumps({"size": len(data),
                          "version": entry["version"]}).encode()
+        out: Dict[str, Any] = {}
         if pool.type == TYPE_REPLICATED:
             ops = [ShardOp("create"), ShardOp("truncate", size=0),
                    ShardOp("write", 0, data),
@@ -3279,10 +3303,14 @@ class OSDDaemon:
             # data may be a zero-copy memoryview of the op frame; only
             # materialize when padding actually forces a copy
             padded = (bytes(data) + bytes(pad)) if pad else data
-            shards = ec_util.encode(sinfo, codec, padded,
-                                    range(codec.get_chunk_count()))
-            hinfo = ec_util.HashInfo(codec.get_chunk_count())
-            hinfo.append(0, shards)
+            shards, hinfo, data_crc = ec_util.encode_with_hinfo(
+                sinfo, codec, padded, range(codec.get_chunk_count()),
+                logical_len=len(data))
+            if data_crc is not None:
+                # content digest back to the client (the librados
+                # returnvec role): a gateway can derive its ETag from
+                # this instead of re-reading the whole object
+                out["data_crc"] = data_crc
             hinfo_raw = json.dumps(hinfo.to_dict()).encode()
             shard_ops = {}
             for shard in range(codec.get_chunk_count()):
@@ -3293,9 +3321,10 @@ class OSDDaemon:
                     ShardOp("setattr", name=OI_ATTR, value=oi),
                     ShardOp("setattr", name=HINFO_ATTR, value=hinfo_raw)]
         self._apply_snap_ops(shard_ops, clone_ops, ss_raw)
-        return await self._submit_shard_writes(state, pool, oid,
-                                               shard_ops, entry,
-                                               admit_epoch)
+        rc = await self._submit_shard_writes(state, pool, oid,
+                                             shard_ops, entry,
+                                             admit_epoch)
+        return rc, out
 
     @staticmethod
     def _apply_snap_ops(shard_ops: Dict[int, List[ShardOp]],
@@ -3319,6 +3348,11 @@ class OSDDaemon:
         Both under the per-object lock (SnapSet RMW must not race).
         append=True resolves the offset to the current object end
         INSIDE the lock so concurrent appends serialize correctly."""
+        if isinstance(data, bytearray) or (
+                isinstance(data, memoryview) and not data.readonly):
+            # snapshot caller-mutable buffers before any store adopts a
+            # view of them (same guard as _op_write_full_locked)
+            data = bytes(data)
         async with state.obj_lock(oid):
             await self._wait_for_degraded(state, pool, oid)
             if append:

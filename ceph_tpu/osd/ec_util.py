@@ -141,6 +141,91 @@ def encode(sinfo: StripeInfo, ec_impl, data: bytes,
     return {i: b"".join(bufs) for i, bufs in parts.items()}
 
 
+def encode_with_hinfo(sinfo: StripeInfo, ec_impl, data,
+                      want: Iterable[int],
+                      logical_len: Optional[int] = None
+                      ) -> Tuple[Dict[int, object], "HashInfo",
+                                 Optional[int]]:
+    """Whole-object encode + per-shard cumulative crc32c in one step.
+
+    Matches ECTransaction::generate_transactions followed by
+    HashInfo::append (ECBackend.cc:2000, ECUtil.h:132-147) but fused:
+    on the host tier the parity accumulate and every crc run inside
+    ONE cache-resident native pass (native/src/datapath.cc), data
+    shards come back as zero-copy StridedBuf views of the caller's
+    buffer, and the logical content crc32c over data[:logical_len]
+    (when asked for) rides along for the write reply's data-digest.
+    """
+    from ceph_tpu import native
+
+    n = ec_impl.get_chunk_count()
+    matrix = getattr(ec_impl, "matrix", None)
+    lib = native.get_lib()
+    use_device = bool(getattr(ec_impl, "use_tpu", False)) and \
+        len(data) >= getattr(ec_impl, "tpu_min_bytes", 1)
+    if (matrix is None or ec_impl.get_chunk_mapping() or lib is None
+            or use_device
+            or not hasattr(lib, "ceph_tpu_ec_encode_noT")):
+        if not isinstance(data, (bytes, bytearray, memoryview)):
+            data = bytes(data)
+        shards = encode(sinfo, ec_impl, data, want)
+        hinfo = HashInfo(n)
+        hinfo.append(0, shards)
+        crc = None
+        if logical_len is not None:
+            crc = cks.crc32c(0xFFFFFFFF, memoryview(data)[:logical_len])
+        return shards, hinfo, crc
+
+    import ctypes
+
+    from ceph_tpu.common.buffer import StridedBuf
+
+    width = sinfo.get_stripe_width()
+    chunk = sinfo.get_chunk_size()
+    assert len(data) % width == 0
+    n_stripes = len(data) // width
+    k = width // chunk
+    m = n - k
+    stream = n_stripes * chunk
+    tables = getattr(ec_impl, "_mul_tables", None)
+    if tables is None:
+        from ceph_tpu.ops import gf
+
+        tables = np.ascontiguousarray(gf.gf_mul_tables(matrix))
+        ec_impl._mul_tables = tables
+    src = np.frombuffer(data, dtype=np.uint8)
+    parity_out = np.empty((max(m, 1), stream), dtype=np.uint8)
+    crcs = np.full(n, 0xFFFFFFFF, dtype=np.uint32)
+    lcrc = np.full(1, 0xFFFFFFFF, dtype=np.uint32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.ceph_tpu_ec_encode_noT(
+        tables.ctypes.data_as(u8p), m, k,
+        src.ctypes.data_as(u8p), n_stripes, chunk,
+        parity_out.ctypes.data_as(u8p), crcs.ctypes.data_as(u32p),
+        0 if logical_len is None else logical_len,
+        lcrc.ctypes.data_as(u32p) if logical_len is not None else None)
+    # data shards stay strided views of the adopted source buffer —
+    # no transpose copy is ever made (StridedBuf docstring).  Both
+    # shard kinds are frozen read-only: nothing mutates them after the
+    # kernel, and only immutable buffers are store-adoptable.
+    if src.flags.writeable:
+        src.setflags(write=False)
+    parity_out.setflags(write=False)
+    stripes = src.reshape(n_stripes, k, chunk)
+    want = set(want)
+    out: Dict[int, object] = {}
+    for i in range(n):
+        if i not in want:
+            continue
+        out[i] = StridedBuf(stripes[:, i, :]) if i < k \
+            else parity_out[i - k].data
+    hinfo = HashInfo(n)
+    hinfo.cumulative_shard_hashes = [int(c) for c in crcs]
+    hinfo.total_chunk_size = stream
+    return out, hinfo, (int(lcrc[0]) if logical_len is not None else None)
+
+
 def decode(sinfo: StripeInfo, ec_impl,
            to_decode: Mapping[int, bytes]) -> bytes:
     """Per-shard chunk streams -> the original logical byte stream."""

@@ -203,10 +203,26 @@ class OSDMap:
         self.pg_upmap: Dict[PgId, List[int]] = {}
         self.pg_upmap_items: Dict[PgId, List[Tuple[int, int]]] = {}
         self.pool_max = 0  # monotone pool-id counter; ids never reused
+        # placement memo (the OSDMapMapping precompute role,
+        # /root/reference/src/osd/OSDMapMapping.h:18 — the reference
+        # caches every PG's mapping per epoch).  OPT-IN: off on a raw
+        # map (tests and tools freely poke osd_state/pg_temp between
+        # queries); daemons and clients that mutate their map ONLY
+        # through apply_incremental / whole-map install set
+        # cache_placement = True after each map change.  Entries key on
+        # (epoch, pg) and the store resets on epoch change.
+        self.cache_placement = False
+        self._pcache: Dict[PgId, Tuple] = {}
+        self._pcache_epoch = -1
+
+    def _invalidate_placement(self) -> None:
+        self._pcache.clear()
+        self._pcache_epoch = self.epoch
 
     # -- osd state ---------------------------------------------------------
 
     def set_max_osd(self, n: int) -> None:
+        self._invalidate_placement()
         self.max_osd = n
         while len(self.osd_state) < n:
             self.osd_state.append(0)
@@ -374,6 +390,20 @@ class OSDMap:
     def pg_to_up_acting_osds(self, pg: PgId
                              ) -> Tuple[List[int], int, List[int], int]:
         """-> (up, up_primary, acting, acting_primary)."""
+        if not self.cache_placement:
+            return self._pg_to_up_acting_uncached(pg)
+        if self._pcache_epoch != self.epoch:
+            self._invalidate_placement()
+        hit = self._pcache.get(pg)
+        if hit is not None:
+            up, upp, acting, actp = hit
+            return list(up), upp, list(acting), actp
+        out = self._pg_to_up_acting_uncached(pg)
+        self._pcache[pg] = (tuple(out[0]), out[1], tuple(out[2]), out[3])
+        return out
+
+    def _pg_to_up_acting_uncached(self, pg: PgId
+                                  ) -> Tuple[List[int], int, List[int], int]:
         pool = self.get_pg_pool(pg.pool)
         if pool is None or pg.ps >= pool.pg_num:
             return [], -1, [], -1
@@ -429,6 +459,7 @@ class OSDMap:
                       erasure_code_profile=erasure_code_profile)
         pool.last_change = self.epoch
         self.pools[pool_id] = pool
+        self._invalidate_placement()
         return pool
 
     # -- incrementals (OSDMap::Incremental) --------------------------------

@@ -78,6 +78,7 @@ class RadosClient:
 
         self.msgr = Messenger(name, secret=parse_secret(secret))
         self.msgr.secure = secure
+        self.msgr.local_fastpath = True
         self.msgr.dispatcher = self._dispatch
         self.osdmap: Optional[OSDMap] = None
         self.op_timeout = op_timeout
@@ -208,6 +209,7 @@ class RadosClient:
                 return True
         if msg.full_map is not None:
             newmap = OSDMap.decode(msg.full_map)
+            newmap.cache_placement = True
             if self.osdmap is None or newmap.epoch > self.osdmap.epoch:
                 self.osdmap = newmap
                 return True
@@ -521,10 +523,14 @@ class IoCtx:
 
     # -- public API --------------------------------------------------------
 
-    async def write_full(self, oid: str, data: bytes) -> None:
+    async def write_full(self, oid: str, data: bytes) -> Dict[str, Any]:
+        """Returns the op's out map — for EC pools it carries
+        {"data_crc": crc32c of the written bytes}, the OSD-computed
+        content digest (librados returnvec role)."""
         reply = await self._submit(oid, [OSDOp("write_full", data=data)])
         if reply.rc != 0:
             raise RadosError(reply.rc, f"write_full {oid!r}")
+        return reply.out or {}
 
     async def write(self, oid: str, data: bytes, offset: int) -> None:
         reply = await self._submit(

@@ -16,9 +16,13 @@ from __future__ import annotations
 
 import asyncio
 import json
-from typing import Any, Dict, List, Tuple
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
 
 from ceph_tpu.rados.client import IoCtx, ObjectNotFound, RadosError
+
+LOCK_NAME = "striper.lock"
+LOCK_DURATION = 30.0
 
 DEFAULT_STRIPE_UNIT = 512 * 1024
 DEFAULT_STRIPE_COUNT = 4
@@ -37,6 +41,7 @@ class RadosStriper:
         if object_size % stripe_unit:
             raise RadosError(-22, "object_size % stripe_unit != 0")
         self.ioctx = ioctx
+        self._renewals: Dict[str, "asyncio.Task"] = {}
         self.stripe_unit = stripe_unit
         self.stripe_count = stripe_count
         self.object_size = object_size
@@ -55,13 +60,76 @@ class RadosStriper:
             raise
         return json.loads(raw.decode())
 
-    async def _save_layout(self, soid: str, size: int) -> None:
+    async def _save_layout(self, soid: str, size: int,
+                           max_size: Optional[int] = None) -> None:
+        """max_size is the HIGH-WATER size: truncate only zeroes data,
+        so backing objects can outlive `size` — remove() walks the
+        high-water extent or it would orphan them (the reference
+        striper tracks this via the object-set it actually deletes)."""
         await self.ioctx.setxattr(
             self._obj(soid, 0), LAYOUT_ATTR,
             json.dumps({"stripe_unit": self.stripe_unit,
                         "stripe_count": self.stripe_count,
                         "object_size": self.object_size,
-                        "size": size}).encode())
+                        "size": size,
+                        "max_size": size if max_size is None
+                        else max_size}).encode())
+
+    # -- exclusive op lock (RadosStriperImpl lock-on-first-object) --------
+
+    async def _lock(self, soid: str, timeout: float = 10.0) -> str:
+        """Exclusive cls_lock on object 0: append/write/truncate/remove
+        are read-modify-writes of the stored layout (size), and two
+        unsynchronized appends would both read size S and overwrite
+        each other — the reference serializes these under a cls lock
+        on the first object (RadosStriperImpl.cc aioWrite/truncate
+        lockObject).  Busy-waits with backoff until acquired; taken
+        with a 30s duration so a crashed holder expires instead of
+        bricking the object (lock_info_t expiration)."""
+        cookie = uuid.uuid4().hex
+        req = json.dumps({"name": LOCK_NAME, "type": "exclusive",
+                          "cookie": cookie, "duration": LOCK_DURATION,
+                          "owner": f"striper.{cookie[:8]}"}).encode()
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            try:
+                await self.ioctx.execute(self._obj(soid, 0), "lock",
+                                         "lock", req)
+                break
+            except RadosError as e:
+                if e.rc != -16:   # EBUSY: another striper op holds it
+                    raise
+                if asyncio.get_running_loop().time() > deadline:
+                    raise
+                await asyncio.sleep(0.02)
+        # renewal heartbeat: an op outliving the duration (recovery
+        # stalls, huge objects) must not silently lose its exclusion —
+        # re-locking with the same (owner, cookie) extends the expiry
+        # (the reference renews across long ops); a CRASHED holder
+        # stops renewing and expires within LOCK_DURATION
+        async def renew():
+            while True:
+                await asyncio.sleep(LOCK_DURATION / 3)
+                try:
+                    await self.ioctx.execute(self._obj(soid, 0),
+                                             "lock", "lock", req)
+                except Exception:
+                    return  # lost/removed: the op will fail on its own
+        task = asyncio.get_running_loop().create_task(renew())
+        self._renewals[cookie] = task
+        return cookie
+
+    async def _unlock(self, soid: str, cookie: str) -> None:
+        task = self._renewals.pop(cookie, None)
+        if task is not None:
+            task.cancel()
+        req = json.dumps({"name": LOCK_NAME, "cookie": cookie,
+                          "owner": f"striper.{cookie[:8]}"}).encode()
+        try:
+            await self.ioctx.execute(self._obj(soid, 0), "lock",
+                                     "unlock", req)
+        except (ObjectNotFound, RadosError):
+            pass  # object 0 removed with the stream: lock died with it
 
     def _extents(self, offset: int, length: int,
                  layout: Dict[str, Any] = None
@@ -99,6 +167,14 @@ class RadosStriper:
 
     async def write(self, soid: str, data: bytes,
                     offset: int = 0) -> None:
+        cookie = await self._lock(soid)
+        try:
+            await self._write_locked(soid, data, offset)
+        finally:
+            await self._unlock(soid, cookie)
+
+    async def _write_locked(self, soid: str, data: bytes,
+                            offset: int) -> None:
         layout_size = offset + len(data)
         try:
             cur = await self._layout(soid)
@@ -107,6 +183,7 @@ class RadosStriper:
         # any OTHER error propagates: treating a transient read
         # failure as "fresh" would rewrite the stored size downward
         # (silent truncation)
+        max_size = layout_size
         if cur is not None:
             if (cur["stripe_unit"], cur["stripe_count"],
                     cur["object_size"]) != (self.stripe_unit,
@@ -115,6 +192,8 @@ class RadosStriper:
                 raise RadosError(-22, "layout mismatch with existing"
                                       " striped object")
             layout_size = max(cur["size"], layout_size)
+            max_size = max(cur.get("max_size", cur["size"]),
+                           layout_size)
         jobs = []
         pos = 0
         for objectno, obj_off, span in self._extents(offset, len(data)):
@@ -124,7 +203,7 @@ class RadosStriper:
                                          chunk, obj_off))
         if jobs:
             await asyncio.gather(*jobs)
-        await self._save_layout(soid, layout_size)
+        await self._save_layout(soid, layout_size, max_size)
 
     async def write_full(self, soid: str, data: bytes) -> None:
         try:
@@ -134,8 +213,16 @@ class RadosStriper:
         await self.write(soid, data, 0)
 
     async def append(self, soid: str, data: bytes) -> None:
-        size = await self.size(soid)
-        await self.write(soid, data, size)
+        """size read + write UNDER ONE LOCK: two appends that both read
+        size S would otherwise write the same extents, silently
+        overwriting each other."""
+        await self._layout(soid)  # exist-check BEFORE locking (below)
+        cookie = await self._lock(soid)
+        try:
+            size = (await self._layout(soid))["size"]
+            await self._write_locked(soid, data, size)
+        finally:
+            await self._unlock(soid, cookie)
 
     async def read(self, soid: str, offset: int = 0,
                    length: int = 0) -> bytes:
@@ -168,9 +255,26 @@ class RadosStriper:
         return dict(await self._layout(soid))
 
     async def remove(self, soid: str) -> None:
+        # under the op lock like every other layout RMW: an unlocked
+        # remove racing an append could delete extents the append is
+        # writing and then be resurrected by its _save_layout.
+        # Existence is checked BEFORE locking: the lock exec would
+        # CREATE object 0 (a WR exec creates), so probing a missing
+        # soid would otherwise litter the pool with lock-only orphans
+        await self._layout(soid)
+        cookie = await self._lock(soid)
+        try:
+            await self._remove_locked(soid)
+        finally:
+            await self._unlock(soid, cookie)
+
+    async def _remove_locked(self, soid: str) -> None:
         layout = await self._layout(soid)
         per_set = layout["object_size"] * layout["stripe_count"]
-        nsets = max(1, -(-layout["size"] // per_set))
+        # walk the HIGH-WATER extent: a truncate only zeroes/removes
+        # data objects, so objects past the current size may exist
+        hw = max(layout["size"], layout.get("max_size", layout["size"]))
+        nsets = max(1, -(-hw // per_set))
         nobjs = nsets * layout["stripe_count"]
 
         async def rm(objectno: int) -> None:
@@ -186,17 +290,47 @@ class RadosStriper:
         await rm(0)
 
     async def truncate(self, soid: str, size: int) -> None:
-        layout = await self._layout(soid)
-        if size > layout["size"]:
-            await self._save_layout(soid, size)
-            return
-        # drop data past the new end (object granularity via
-        # zeroing), walking the STORED geometry
-        for objectno, obj_off, span in self._extents(
-                size, layout["size"] - size, layout):
-            try:
-                await self.ioctx.write(self._obj(soid, objectno),
-                                       bytes(span), obj_off)
-            except ObjectNotFound:
-                pass
-        await self._save_layout(soid, size)
+        await self._layout(soid)  # exist-check BEFORE locking (remove())
+        cookie = await self._lock(soid)
+        try:
+            layout = await self._layout(soid)
+            hw = max(layout["size"],
+                     layout.get("max_size", layout["size"]))
+            if size > layout["size"]:
+                await self._save_layout(soid, size, max(hw, size))
+                return
+            su = layout["stripe_unit"]
+            sc = layout["stripe_count"]
+            per_set = layout["object_size"] * sc
+            nsets = max(1, -(-hw // per_set))
+            # objects whose FIRST stored byte is past the new end hold
+            # no live data: actually remove them (the reference
+            # truncates/deletes backing objects, RadosStriperImpl.cc
+            # truncate) — zeroing alone would orphan space
+            removed = set()
+            for objectno in range(nsets * sc):
+                if objectno == 0:
+                    continue  # layout holder stays
+                first = ((objectno // sc) * per_set
+                         + (objectno % sc) * su)
+                if first >= size:
+                    removed.add(objectno)
+                    try:
+                        await self.ioctx.remove(
+                            self._obj(soid, objectno))
+                    except ObjectNotFound:
+                        pass
+            # zero the dropped range (up to the high-water mark) on
+            # the objects that survive
+            for objectno, obj_off, span in self._extents(
+                    size, hw - size, layout):
+                if objectno in removed:
+                    continue
+                try:
+                    await self.ioctx.write(self._obj(soid, objectno),
+                                           bytes(span), obj_off)
+                except ObjectNotFound:
+                    pass
+            await self._save_layout(soid, size, size)
+        finally:
+            await self._unlock(soid, cookie)

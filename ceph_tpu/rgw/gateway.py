@@ -21,6 +21,13 @@ replicated META pool, mirroring the reference's pool split
 ETags are S3-compatible: hex MD5 of content for simple PUTs, and the
 multipart form md5(concat(part md5 digests))-"<nparts>" for completed
 multipart uploads — what stock S3 clients verify against.
+
+etag_hash="crc32c" is the deployment knob for CPU-constrained
+gateways: MD5 is a serial ~0.5 GiB/s/core hash with no integrity role
+here (shard durability is covered end-to-end by the EC hinfo crc32c
+ledger and per-frame wire crcs), and S3 itself does not promise
+ETag==MD5 for every object (multipart and SSE-KMS objects already
+return non-MD5 ETags).  Default stays "md5" for stock-client interop.
 """
 
 from __future__ import annotations
@@ -55,8 +62,9 @@ class RGWLite:
 
     def __init__(self, client, data_pool: str, meta_pool: str,
                  stripe_size: int = DEFAULT_STRIPE_SIZE,
-                 aio_window: int = 8):
+                 aio_window: int = 8, etag_hash: str = "md5"):
         self.client = client
+        self.etag_hash = etag_hash
         self.data = client.open_ioctx(data_pool)
         self.meta = client.open_ioctx(meta_pool)
         self.stripe_size = stripe_size
@@ -67,6 +75,37 @@ class RGWLite:
         # within this gateway instance (one gateway per cluster in this
         # tier; multi-gateway index updates need the omap op milestone)
         self._meta_locks: Dict[str, "asyncio.Lock"] = {}
+
+    def _etag_of(self, data: bytes) -> str:
+        """Content ETag under the configured hash (class docstring)."""
+        if self.etag_hash == "crc32c":
+            from ceph_tpu.ops import checksum as cks
+
+            return "%08x" % cks.crc32c(0xFFFFFFFF, data)
+        return _etag(data)
+
+    def _etag_from_manifest(self, manifest: Manifest, data) -> str:
+        """crc32c-mode ETag without re-reading the object: stitch the
+        OSD-computed per-stripe content digests from the write replies
+        (StripeWriter._write).  crc32c is affine in the seed, so
+        crc(S1||S2, seed) = zeros(crc1, len2) ^ crc2 ^ zeros(seed, len2)
+        — the crc32c_combine/zeros folding discipline
+        (/root/reference/src/common/crc32c.cc:216-239).  Falls back to
+        hashing the bytes when any stripe lacks a digest (replicated
+        data pools don't return one)."""
+        from ceph_tpu.ops import checksum as cks
+
+        if self.etag_hash != "crc32c" or not manifest.stripes or                 any("crc" not in st for st in manifest.stripes):
+            return self._etag_of(bytes(data) if not isinstance(
+                data, (bytes, bytearray, memoryview)) else data)
+        crc = manifest.stripes[0]["crc"]
+        for st in manifest.stripes[1:]:
+            # stripe crcs are 0xFFFFFFFF-seeded; linearity folds the
+            # seed compensation into one combine:
+            #   crc(A||B, s) = combine(crc_A ^ s, crc_B_seeded_s, |B|)
+            crc = cks.crc32c_combine(crc ^ 0xFFFFFFFF, st["crc"],
+                                     st["size"])
+        return "%08x" % crc
 
     def _meta_lock(self, key: str):
         import asyncio
@@ -178,7 +217,7 @@ class RGWLite:
         except Exception:
             await writer.cancel()
             raise
-        etag = _etag(data)
+        etag = self._etag_from_manifest(manifest, data)
         await self._link(bucket, key, manifest, etag)
         return etag
 
@@ -306,7 +345,7 @@ class RGWLite:
         except Exception:
             await writer.cancel()
             raise
-        etag = _etag(data)
+        etag = self._etag_from_manifest(manifest, data)
         upload_oid = self._upload_oid(bucket, key, upload_id)
         async with self._meta_lock(upload_oid):
             doc = await self._upload(bucket, key, upload_id)

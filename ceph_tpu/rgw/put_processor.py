@@ -63,13 +63,20 @@ class StripeWriter:
         self._tasks: List[asyncio.Task] = []
         self.written: List[str] = []
 
-    async def _write(self, oid: str, data: bytes) -> None:
+    async def _write(self, oid: str, data: bytes,
+                     entry: Optional[Dict] = None) -> None:
         try:
-            await self.ioctx.write_full(oid, data)
+            out = await self.ioctx.write_full(oid, data)
+            if entry is not None and out and "data_crc" in out:
+                # OSD-computed content digest (write reply returnvec):
+                # the manifest carries it so the gateway's ETag needs
+                # no second pass over the object bytes
+                entry["crc"] = out["data_crc"]
         finally:
             self._sem.release()
 
-    async def submit(self, oid: str, data: bytes) -> None:
+    async def submit(self, oid: str, data: bytes,
+                     entry: Optional[Dict] = None) -> None:
         """Acquire a window slot BEFORE buffering the stripe in a task:
         memory stays O(window x stripe) no matter how large the object
         is (the rgw_put_obj_min_window_size backpressure role)."""
@@ -77,7 +84,7 @@ class StripeWriter:
         self.written.append(oid)
         self._tasks.append(
             asyncio.get_running_loop().create_task(
-                self._write(oid, data)))
+                self._write(oid, data, entry)))
 
     async def drain(self) -> None:
         """Wait for every in-flight stripe; raise the first failure."""
@@ -127,18 +134,42 @@ class PutObjProcessor:
     async def _flush_stripe(self, data: bytes) -> None:
         oid = self.oid_for_stripe(self._stripe_no)
         self._stripe_no += 1
-        self.manifest.stripes.append({"oid": oid, "size": len(data)})
+        entry = {"oid": oid, "size": len(data)}
+        self.manifest.stripes.append(entry)
         self.manifest.obj_size += len(data)
-        await self.writer.submit(oid, data)
+        await self.writer.submit(oid, data, entry)
 
     async def process(self, data: bytes) -> None:
         """Feed a run of bytes; full stripes are written as they fill
-        (submit blocks on the writer window — the backpressure seam)."""
-        self._buf.extend(data)
-        while len(self._buf) >= self.stripe_size:
-            stripe = bytes(self._buf[:self.stripe_size])
-            del self._buf[:self.stripe_size]
-            await self._flush_stripe(stripe)
+        (submit blocks on the writer window — the backpressure seam).
+
+        Stripe-aligned runs never touch the staging buffer: full
+        stripes are cut as zero-copy views of the caller's bytes, so a
+        part-sized PUT reaches the rados write with no gateway-side
+        copy at all (the reference's bufferlist claim/splice
+        discipline in ChunkProcessor::process)."""
+        view = memoryview(data)
+        # zero-copy only for immutable input: stripes are written
+        # asynchronously after this call returns, and a caller
+        # refilling a reused bytearray would corrupt queued stripes
+        writable = not view.readonly
+        off = 0
+        if self._buf:
+            need = self.stripe_size - len(self._buf)
+            take = min(need, len(view))
+            self._buf.extend(view[:take])
+            off = take
+            if len(self._buf) >= self.stripe_size:
+                full = self._buf
+                self._buf = bytearray()
+                await self._flush_stripe(bytes(full))
+        while len(view) - off >= self.stripe_size:
+            stripe = view[off:off + self.stripe_size]
+            await self._flush_stripe(bytes(stripe) if writable
+                                     else stripe)
+            off += self.stripe_size
+        if off < len(view):
+            self._buf.extend(view[off:])
 
     async def complete(self) -> Manifest:
         """Flush the tail and wait for every stripe to be durable."""

@@ -20,6 +20,21 @@ def run(coro):
     asyncio.run(asyncio.wait_for(coro, 120))
 
 
+@pytest.fixture
+def wire_transport():
+    """Force real sockets for tests that observe wire BYTES (replay
+    recording, sniffing): same-process endpoints otherwise ride the
+    messenger's zero-copy loopback fast path and put nothing on the
+    wire.  The properties under test are transport-level, so the test
+    must pin the transport."""
+    import ceph_tpu.msg as msg_mod
+
+    old = msg_mod.LOCAL_FASTPATH
+    msg_mod.LOCAL_FASTPATH = False
+    yield
+    msg_mod.LOCAL_FASTPATH = old
+
+
 def test_sign_verify_unit():
     key = auth.parse_secret(auth.generate_secret()).active_key
     sig = auth.sign(key, b"pre", b"payload")
@@ -132,7 +147,7 @@ def test_keyed_cluster_accepts_keyed_rejects_unkeyed():
     run(main())
 
 
-def test_replayed_recorded_session_is_rejected():
+def test_replayed_recorded_session_is_rejected(wire_transport):
     """THE cephx property: an attacker who records a whole legitimate
     session (hello + signed command frames) and replays it byte-for-
     byte on a new connection gets dropped — fresh server nonce means a
@@ -217,7 +232,7 @@ def test_replayed_recorded_session_is_rejected():
     run(main())
 
 
-def test_in_connection_replay_rejected_by_seq():
+def test_in_connection_replay_rejected_by_seq(wire_transport):
     """A frame replayed WITHIN a live session fails the strict
     sequence check."""
     secret = auth.generate_secret()
@@ -339,7 +354,7 @@ def test_ticket_grant_and_use():
     run(main())
 
 
-def test_secure_mode_encrypts_the_wire():
+def test_secure_mode_encrypts_the_wire(wire_transport):
     """msgr2 secure-mode role: with auth_secure on, payloads are
     encrypted under the per-connection session keystream — a wire
     sniffer sees no plaintext, and the data path still round-trips."""

@@ -1,0 +1,296 @@
+"""Fused native datapath + zero-copy buffer discipline.
+
+Covers the round-5 write-path redesign: the transpose-free native
+encode (datapath.cc ceph_tpu_ec_encode_noT), StridedBuf shard views,
+MemStore buffer adoption, the messenger loopback fast path, and the
+OSD-returned content digest feeding RGW ETags.  Oracles are the
+pre-existing slow paths (ec_util.encode + HashInfo.append, socket
+messengers, direct crc32c) so every fast path is pinned bit-exact to
+the code it replaced.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from cluster_helpers import Cluster
+
+from ceph_tpu.common.buffer import StridedBuf
+from ceph_tpu.ec.registry import create_erasure_code
+from ceph_tpu.ops import checksum as cks
+from ceph_tpu.osd import ec_util
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 120))
+
+
+# -- StridedBuf --------------------------------------------------------------
+
+def test_stridedbuf_matches_flat_bytes():
+    rng = np.random.default_rng(1)
+    arr = rng.integers(0, 256, (7, 4096), dtype=np.uint8)
+    base = arr.reshape(-1)[: 7 * 4096].reshape(7, 4096)
+    # a strided view: every 3rd row of a bigger array
+    big = rng.integers(0, 256, (21, 4096), dtype=np.uint8)
+    view = big[::3]
+    sb = StridedBuf(view)
+    flat = view.tobytes()
+    assert len(sb) == len(flat)
+    assert bytes(sb) == flat
+    assert sb.tobytes() == flat
+    # slices at chunk boundaries, inside one chunk, and spanning many
+    for a, b in [(0, 4096), (4096, 8192), (100, 200), (4000, 4200),
+                 (0, len(flat)), (5000, 20000), (len(flat) - 1,
+                                                 len(flat))]:
+        assert sb[a:b] == flat[a:b], (a, b)
+    assert sb == flat
+    del base
+
+
+# -- fused encode ------------------------------------------------------------
+
+@pytest.mark.parametrize("k,m,nstripes", [(8, 3, 16), (4, 2, 1),
+                                          (2, 2, 5)])
+def test_encode_with_hinfo_matches_slow_path(k, m, nstripes):
+    codec = create_erasure_code({
+        "plugin": "ec_jax", "technique": "reed_sol_van",
+        "k": str(k), "m": str(m), "tpu": "false"})
+    sinfo = ec_util.StripeInfo(k, k * 4096)
+    width = sinfo.get_stripe_width()
+    data = np.random.default_rng(2).integers(
+        0, 256, nstripes * width, dtype=np.uint8).tobytes()
+
+    want = range(codec.get_chunk_count())
+    shards, hinfo, crc = ec_util.encode_with_hinfo(
+        sinfo, codec, data, want, logical_len=len(data) - 100)
+
+    oracle = ec_util.encode(sinfo, codec, data, want)
+    oracle_hi = ec_util.HashInfo(codec.get_chunk_count())
+    oracle_hi.append(0, oracle)
+    for i in want:
+        assert bytes(shards[i]) == bytes(oracle[i]), f"shard {i}"
+    assert hinfo.cumulative_shard_hashes == \
+        oracle_hi.cumulative_shard_hashes
+    assert hinfo.total_chunk_size == oracle_hi.total_chunk_size
+    assert crc == cks.crc32c(0xFFFFFFFF, data[:len(data) - 100])
+    # data shards must be zero-copy views, not copies
+    assert isinstance(shards[0], StridedBuf)
+
+
+def test_encode_with_hinfo_cumulative_append_contract():
+    """hinfo from the fused path must equal a HashInfo that appended
+    the same shards (the ECUtil.h:132-147 cumulative ledger)."""
+    codec = create_erasure_code({
+        "plugin": "ec_jax", "technique": "cauchy_good",
+        "k": "4", "m": "2", "tpu": "false"})
+    sinfo = ec_util.StripeInfo(4, 4 * 4096)
+    data = np.random.default_rng(3).integers(
+        0, 256, 8 * sinfo.get_stripe_width(), dtype=np.uint8).tobytes()
+    shards, hinfo, _ = ec_util.encode_with_hinfo(
+        sinfo, codec, data, range(6))
+    ledger = ec_util.HashInfo(6)
+    ledger.append(0, {i: bytes(b) for i, b in shards.items()})
+    assert hinfo.cumulative_shard_hashes == \
+        ledger.cumulative_shard_hashes
+
+
+# -- MemStore adoption -------------------------------------------------------
+
+def test_memstore_adopts_and_promotes():
+    from ceph_tpu.os import ObjectId, Transaction
+    from ceph_tpu.os.memstore import MemStore
+
+    store = MemStore()
+    store.mkfs()
+    store.mount()
+    payload = bytes(np.random.default_rng(4).integers(
+        0, 256, 256 * 1024, dtype=np.uint8))
+    t = Transaction()
+    t.create_collection("c")
+    t.write("c", ObjectId("o"), 0, len(payload), payload)
+    store.queue_transaction(t)
+    assert store.read("c", ObjectId("o")) == payload
+    # partial overwrite promotes the adopted buffer to a private copy
+    t = Transaction()
+    t.write("c", ObjectId("o"), 10, 5, b"XXXXX")
+    store.queue_transaction(t)
+    got = store.read("c", ObjectId("o"))
+    assert got[:10] == payload[:10] and got[10:15] == b"XXXXX"
+    assert got[15:] == payload[15:]
+    # truncate on an adopted buffer narrows without copying the world
+    t = Transaction()
+    t.write("c", ObjectId("p"), 0, len(payload), payload)
+    t.truncate("c", ObjectId("p"), 1000)
+    store.queue_transaction(t)
+    assert store.read("c", ObjectId("p")) == payload[:1000]
+    # StridedBuf adoption
+    view = np.frombuffer(payload, dtype=np.uint8).reshape(64, 4096)
+    sb = StridedBuf(view[::2])
+    t = Transaction()
+    t.write("c", ObjectId("q"), 0, len(sb), sb)
+    store.queue_transaction(t)
+    assert store.read("c", ObjectId("q")) == sb.tobytes()
+
+
+def test_transaction_snapshots_mutable_buffers():
+    """bytearrays are caller-mutable: the transaction must snapshot
+    them; immutable buffers ride by reference (claim semantics)."""
+    from ceph_tpu.os import ObjectId, Transaction
+    from ceph_tpu.os.memstore import MemStore
+
+    store = MemStore()
+    store.mkfs()
+    store.mount()
+    buf = bytearray(b"A" * 128 * 1024)
+    t = Transaction()
+    t.create_collection("c")
+    t.write("c", ObjectId("o"), 0, len(buf), buf)
+    buf[:5] = b"BBBBB"  # mutate AFTER queueing, before apply
+    store.queue_transaction(t)
+    assert store.read("c", ObjectId("o"))[:5] == b"AAAAA"
+
+
+# -- messenger loopback fast path -------------------------------------------
+
+def test_local_fastpath_used_and_close_propagates():
+    from ceph_tpu.msg import LocalConnection, Messenger
+    from ceph_tpu.msg.messages import MPing
+
+    async def main():
+        got = []
+        a, b = Messenger("a"), Messenger("b")
+        a.local_fastpath = b.local_fastpath = True
+
+        async def dispatch(conn, msg):
+            got.append((conn.peer_name, msg))
+
+        b.dispatcher = dispatch
+        addr = await b.bind()
+        conn = await a.connect(addr)
+        assert isinstance(conn, LocalConnection)
+        await conn.send(MPing(0, 1.0))
+        await asyncio.sleep(0.05)
+        assert len(got) == 1 and got[0][0] == "a"
+        faults = []
+        b.on_connection_fault = faults.append
+        conn.close()
+        await asyncio.sleep(0.05)
+        # both ends closed, fault handler ran on the peer side
+        assert conn.closed and len(faults) == 1
+        with pytest.raises(ConnectionError):
+            await conn.send(MPing(0, 2.0))
+        await a.shutdown()
+        await b.shutdown()
+
+    run(main())
+
+
+def test_local_fastpath_requires_matching_auth():
+    """A mis-keyed or differently-secured peer must NOT ride the
+    loopback path: that would bypass the socket handshake's
+    rejection (permission laundering through the fast path)."""
+    from ceph_tpu.common import auth
+    from ceph_tpu.msg import LocalConnection, Messenger
+
+    async def main():
+        k1, k2 = auth.generate_secret(), auth.generate_secret()
+        srv = Messenger("srv", secret=k1)
+        srv.local_fastpath = True
+        srv.dispatcher = lambda c, m: asyncio.sleep(0)
+        addr = await srv.bind()
+        # same key: local
+        c_ok = Messenger("ok", secret=k1)
+        c_ok.local_fastpath = True
+        assert isinstance(await c_ok.connect(addr), LocalConnection)
+        # wrong key: socket path (and the handshake then rejects it)
+        c_bad = Messenger("bad", secret=k2)
+        c_bad.local_fastpath = True
+        conn = await c_bad.connect(addr)
+        assert not isinstance(conn, LocalConnection)
+        # secure-mode mismatch: socket path too
+        c_sec = Messenger("sec", secret=k1)
+        c_sec.local_fastpath = True
+        c_sec.secure = True
+        conn2 = await c_sec.connect(addr)
+        assert not isinstance(conn2, LocalConnection)
+        for m in (c_ok, c_bad, c_sec, srv):
+            await m.shutdown()
+
+    run(main())
+
+
+def test_opt_out_messengers_use_sockets():
+    from ceph_tpu.msg import LocalConnection, Messenger
+
+    async def main():
+        a, b = Messenger("a"), Messenger("b")  # no opt-in
+        b.dispatcher = lambda c, m: asyncio.sleep(0)
+        addr = await b.bind()
+        conn = await a.connect(addr)
+        assert not isinstance(conn, LocalConnection)
+        await a.shutdown()
+        await b.shutdown()
+
+    run(main())
+
+
+# -- OSD content digest -> ETag ---------------------------------------------
+
+def test_ec_write_reply_carries_data_crc():
+    profile = {"plugin": "ec_jax", "technique": "reed_sol_van",
+               "k": "2", "m": "1", "crush-failure-domain": "osd",
+               "tpu": "false"}
+
+    async def main():
+        cluster = Cluster(num_osds=4, osds_per_host=2)
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool("ec", profile=profile,
+                                                pg_num=4)
+            io = cluster.client.open_ioctx("ec")
+            payload = bytes(np.random.default_rng(7).integers(
+                0, 256, 100_000, dtype=np.uint8))
+            out = await io.write_full("obj", payload)
+            assert out.get("data_crc") == cks.crc32c(0xFFFFFFFF,
+                                                     payload)
+            assert await io.read("obj") == payload
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_rgw_crc_etag_matches_content():
+    """crc32c-mode ETags: the manifest-stitched digest must equal the
+    digest of the bytes — across multiple stripes (combine math) and
+    on the md5 fallback path."""
+    profile = {"plugin": "ec_jax", "technique": "reed_sol_van",
+               "k": "2", "m": "1", "crush-failure-domain": "osd",
+               "tpu": "false"}
+
+    async def main():
+        from ceph_tpu.rgw import RGWLite
+
+        cluster = Cluster(num_osds=4, osds_per_host=2)
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool(
+                "meta", size=2, pg_num=4)
+            await cluster.client.create_ec_pool(
+                "data", profile=profile, pg_num=4)
+            rgw = RGWLite(cluster.client, "data", "meta",
+                          stripe_size=256 * 1024, etag_hash="crc32c")
+            await rgw.create_bucket("b")
+            # 3.5 stripes: exercises the crc32c_combine stitching
+            payload = bytes(np.random.default_rng(8).integers(
+                0, 256, 896 * 1024, dtype=np.uint8))
+            etag = await rgw.put_object("b", "k", payload)
+            assert etag == "%08x" % cks.crc32c(0xFFFFFFFF, payload)
+            got, etag2 = await rgw.get_object_ex("b", "k")
+            assert got == payload and etag2 == etag
+        finally:
+            await cluster.stop()
+
+    run(main())
