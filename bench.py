@@ -52,7 +52,7 @@ def bench_lrc_crc() -> float:
     from ceph_tpu.ec.registry import create_erasure_code
     from ceph_tpu.models import reed_solomon as rs
     from ceph_tpu.ops import checksum as cks
-    from ceph_tpu.ops import gf, gf_pallas
+    from ceph_tpu.ops import crc_pallas, gf, gf_pallas
 
     kd, S = 8, 2 << 20  # 8 data chunks x 2 MiB = 16 MiB blob
     csum_block = 4096
@@ -100,6 +100,28 @@ def bench_lrc_crc() -> float:
             crcs = cks.crc32c_pack_bits(
                 cks.crc32c_partial_bits_words(blocks, consts))
             fold = (jnp.sum(crcs, dtype=jnp.uint32)
+                    & 0xFF).astype(jnp.int32)
+            return carry.at[0, 0, 0, 0].set(carry[0, 0, 0, 0] ^ fold)
+
+        return jax.lax.fori_loop(0, n, body, dd).astype(
+            jnp.int32).sum()
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def loop_words_mxu_crc(dd, n):
+        # the Pallas crc kernel (ops/crc_pallas.py): per-block crcs as
+        # int8 MXU dots straight off the encode kernel's word layout;
+        # data and parity blocks are checksummed as separate views so
+        # no concat copy rides the hot loop
+        mat = np.array(comp_key, dtype=np.uint8)
+
+        def body(_, carry):
+            par = gf_pallas.gf_matmul_words(mat, carry)
+            dblocks = carry.reshape(kd * blocks_per, wpb * 128)
+            pblocks = par.reshape(4 * blocks_per, wpb * 128)
+            c1 = crc_pallas.crc32c_blocks_words(dblocks, csum_block)
+            c2 = crc_pallas.crc32c_blocks_words(pblocks, csum_block)
+            fold = ((jnp.sum(c1, dtype=jnp.uint32)
+                     ^ jnp.sum(c2, dtype=jnp.uint32))
                     & 0xFF).astype(jnp.int32)
             return carry.at[0, 0, 0, 0].set(carry[0, 0, 0, 0] ^ fold)
 
@@ -156,15 +178,26 @@ def bench_lrc_crc() -> float:
             cks.crc32c_partial_bits_words(words_blocks[:4], consts)))
         assert [int(c) for c in got_crcs] == want_crcs, \
             "words crc mismatch"
-        # the crc's bit-unpack dominates this row, and its best layout
-        # differs from the GF kernel's — race the two formulations and
-        # report the winner (what a deployed codec's dispatch would do)
+        # race the formulations and report the winner (what a deployed
+        # codec's dispatch would do): XLA bit-planes, words-layout XLA
+        # crc, and the Pallas MXU crc kernel
         best = max(best, measure(lambda nn: float(loop_words(words,
                                                              nn))))
+        if crc_pallas.supported(csum_block, 12 * blocks_per):
+            # bit-exactness of the MXU crc vs the host oracle
+            dblocks = jnp.asarray(words).reshape(
+                kd * blocks_per, wpb * 128)
+            got_mxu = np.asarray(crc_pallas.crc32c_blocks_words(
+                dblocks, csum_block, init=0))[:4]
+            assert [int(c) for c in got_mxu] == want_crcs, \
+                "mxu crc mismatch"
+            best = max(best, measure(
+                lambda nn: float(loop_words_mxu_crc(words, nn)),
+                n=401))
     return best
 
 
-def bench_put_e2e() -> float:
+def bench_put_e2e() -> Tuple[float, float, dict]:
     """BASELINE config #5: 64 MiB multipart PUT into an EC 8+3 pool,
     end to end — host bytes through RGW-lite's processor pipeline, the
     networked rados client, the OSD op engine's EC encode, down to

@@ -101,46 +101,6 @@ static uint32_t crc32c_sw(uint32_t crc, const uint8_t *data, uint64_t len) {
   return crc;
 }
 
-// Composed zero-advance matrix for an arbitrary length, cached: the
-// 3-way interleave below combines its lanes through these, and the
-// lengths it asks for repeat (a handful of chunk sizes), so each is
-// composed once (~12 32x32 GF(2) products) and then costs one 32-row
-// apply per combine.  Role parity: the reference folds lanes with
-// PCLMULQDQ constants precomputed per block size
-// (crc32c_intel_fast_zero_asm.s); GF(2) matrices are this build's
-// equivalent (crc is linear either way).
-struct AdvEntry {
-  uint64_t len;
-  uint32_t m[32];
-};
-static AdvEntry g_adv_cache[32];
-static int g_adv_n = 0;
-
-static void compose_advance(uint64_t len, uint32_t out[32]) {
-  for (int i = 0; i < 32; i++) out[i] = 1u << i;  // identity
-  uint32_t tmp[32];
-  for (int r = 0; len; r++, len >>= 1)
-    if (len & 1) {
-      gf2_matmul_mat(zero_mat[r], out, tmp);
-      std::memcpy(out, tmp, sizeof(tmp));
-    }
-}
-
-static const uint32_t *adv_matrix(uint64_t len) {
-  for (int i = 0; i < g_adv_n; i++)
-    if (g_adv_cache[i].len == len) return g_adv_cache[i].m;
-  if (g_adv_n < 32) {
-    AdvEntry &e = g_adv_cache[g_adv_n];
-    e.len = len;
-    compose_advance(len, e.m);
-    g_adv_n++;
-    return e.m;
-  }
-  static uint32_t scratch[32];  // cache full: compose uncached
-  compose_advance(len, scratch);
-  return scratch;
-}
-
 #if defined(__x86_64__)
 // Hardware CRC32C (the SSE4.2 crc32 instruction computes exactly the
 // Castagnoli reflected CRC) — the crc32c_intel_fast role
@@ -164,9 +124,9 @@ static uint32_t crc32c_hw_1way(uint32_t crc, const uint8_t *data,
 
 // The crc32 instruction has ~3-cycle latency, 1-cycle throughput: a
 // single dependency chain caps at ~2.7 B/cycle.  Three independent
-// lanes fill the pipeline (~8 B/cycle), recombined through cached
-// zero-advance matrices — the standard interleave the reference's asm
-// tier implements with PCLMULQDQ folding.
+// lanes fill the pipeline (~8 B/cycle), recombined through zero-run
+// advance folds — the standard interleave the reference's asm tier
+// implements with PCLMULQDQ folding.
 __attribute__((target("sse4.2")))
 static uint32_t crc32c_hw(uint32_t crc, const uint8_t *data, uint64_t len) {
   constexpr uint64_t MIN3 = 3 * 256;
@@ -188,10 +148,12 @@ static uint32_t crc32c_hw(uint32_t crc, const uint8_t *data, uint64_t len) {
   uint32_t b32 = static_cast<uint32_t>(b);
   uint32_t c32 = static_cast<uint32_t>(c);
   // result = advance(a, 2*lane + tail) ^ advance(b, lane + tail) ^
-  //          crc(c seeded 0 over partC+tail)
+  //          crc(c seeded 0 over partC+tail).  Advances are O(log n)
+  //          zero-run vector folds — race-free, cache-free, and cheap
+  //          against >=768-byte lanes.
   c32 = crc32c_hw_1way(c32, data + 3 * lane, tail);
-  gf2_matmul_vec(adv_matrix(2 * lane + tail), &a32);
-  gf2_matmul_vec(adv_matrix(lane + tail), &b32);
+  a32 = ceph_tpu_crc32c_zeros(a32, 2 * lane + tail);
+  b32 = ceph_tpu_crc32c_zeros(b32, lane + tail);
   return a32 ^ b32 ^ c32;
 }
 

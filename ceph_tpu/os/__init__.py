@@ -56,10 +56,11 @@ class Transaction:
               length: int, data: bytes) -> None:
         """Buffers are CLAIMED, not copied (the reference Transaction
         holds bufferlist refs, src/os/Transaction.h — writers never
-        mutate a buffer after queueing it); bytearrays are the one
-        caller-mutable type, so only they are snapshotted."""
+        mutate a buffer after queueing it); caller-mutable buffers
+        (bytearrays, writable views) are snapshotted."""
         assert length == len(data)
-        if isinstance(data, bytearray):
+        if isinstance(data, bytearray) or (
+                isinstance(data, memoryview) and not data.readonly):
             data = bytes(data)
         self.ops.append(("write", cid, oid, offset, data))
 

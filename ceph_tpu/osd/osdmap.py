@@ -211,7 +211,7 @@ class OSDMap:
         # through apply_incremental / whole-map install set
         # cache_placement = True after each map change.  Entries key on
         # (epoch, pg) and the store resets on epoch change.
-        self.cache_placement = False
+        self._cache_placement = False
         self._pcache: Dict[PgId, Tuple] = {}
         self._pcache_epoch = -1
 
@@ -390,7 +390,7 @@ class OSDMap:
     def pg_to_up_acting_osds(self, pg: PgId
                              ) -> Tuple[List[int], int, List[int], int]:
         """-> (up, up_primary, acting, acting_primary)."""
-        if not self.cache_placement:
+        if not self._cache_placement:
             return self._pg_to_up_acting_uncached(pg)
         if self._pcache_epoch != self.epoch:
             self._invalidate_placement()

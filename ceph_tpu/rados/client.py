@@ -209,7 +209,7 @@ class RadosClient:
                 return True
         if msg.full_map is not None:
             newmap = OSDMap.decode(msg.full_map)
-            newmap.cache_placement = True
+            newmap._cache_placement = True
             if self.osdmap is None or newmap.epoch > self.osdmap.epoch:
                 self.osdmap = newmap
                 return True

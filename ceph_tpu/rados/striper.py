@@ -219,10 +219,26 @@ class RadosStriper:
         await self._layout(soid)  # exist-check BEFORE locking (below)
         cookie = await self._lock(soid)
         try:
-            size = (await self._layout(soid))["size"]
+            size = (await self._layout_or_cleanup(soid))["size"]
             await self._write_locked(soid, data, size)
         finally:
             await self._unlock(soid, cookie)
+
+    async def _layout_or_cleanup(self, soid: str) -> Dict[str, Any]:
+        """Layout read INSIDE the op lock.  If the stream vanished
+        between the pre-lock exist-check and here (a concurrent
+        remove), our lock exec has re-created object 0 as a bare
+        lock holder — delete it before failing, or every such race
+        leaks a phantom object (we hold the lock, so the delete
+        cannot race another writer)."""
+        try:
+            return await self._layout(soid)
+        except ObjectNotFound:
+            try:
+                await self.ioctx.remove(self._obj(soid, 0))
+            except Exception:
+                pass
+            raise
 
     async def read(self, soid: str, offset: int = 0,
                    length: int = 0) -> bytes:
@@ -269,7 +285,7 @@ class RadosStriper:
             await self._unlock(soid, cookie)
 
     async def _remove_locked(self, soid: str) -> None:
-        layout = await self._layout(soid)
+        layout = await self._layout_or_cleanup(soid)
         per_set = layout["object_size"] * layout["stripe_count"]
         # walk the HIGH-WATER extent: a truncate only zeroes/removes
         # data objects, so objects past the current size may exist
@@ -293,7 +309,7 @@ class RadosStriper:
         await self._layout(soid)  # exist-check BEFORE locking (remove())
         cookie = await self._lock(soid)
         try:
-            layout = await self._layout(soid)
+            layout = await self._layout_or_cleanup(soid)
             hw = max(layout["size"],
                      layout.get("max_size", layout["size"]))
             if size > layout["size"]:

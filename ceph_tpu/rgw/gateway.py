@@ -95,7 +95,8 @@ class RGWLite:
         data pools don't return one)."""
         from ceph_tpu.ops import checksum as cks
 
-        if self.etag_hash != "crc32c" or not manifest.stripes or                 any("crc" not in st for st in manifest.stripes):
+        if self.etag_hash != "crc32c" or not manifest.stripes \
+                or any("crc" not in st for st in manifest.stripes):
             return self._etag_of(bytes(data) if not isinstance(
                 data, (bytes, bytearray, memoryview)) else data)
         crc = manifest.stripes[0]["crc"]

@@ -167,3 +167,35 @@ def test_crc32c_partial_bits_words_matches_bytes():
         cks.crc32c_partial_bits_words(words, consts)))
     assert [int(c) for c in got_bytes] == want
     assert [int(c) for c in got_words] == want
+
+
+def test_crc_pallas_blocks_bit_exact():
+    """ops/crc_pallas.py: the MXU crc kernel (interpret mode on CPU)
+    must be bit-exact vs the host crc across block sizes, seeds, and
+    non-tile-aligned block counts."""
+    import numpy as np
+
+    from ceph_tpu.ops import checksum as cks
+    from ceph_tpu.ops import crc_pallas
+
+    if not crc_pallas.HAVE_JAX:
+        import pytest
+
+        pytest.skip("no jax")
+    import jax.numpy as jnp
+
+    crc_pallas.FORCE_INTERPRET = True
+    try:
+        rng = np.random.default_rng(11)
+        for length, n in [(4096, 5), (4096, 130), (512, 9), (64, 3)]:
+            data = rng.integers(0, 256, (n, length), dtype=np.uint8)
+            words = jnp.asarray(data.view(np.int32))
+            for init in (0, 0xFFFFFFFF, 0xDEADBEEF):
+                got = np.asarray(crc_pallas.crc32c_blocks_words(
+                    words, length, init=init))
+                want = np.array(
+                    [cks.crc32c(init, row.tobytes()) for row in data],
+                    dtype=np.uint32)
+                assert np.array_equal(got, want), (length, n, init)
+    finally:
+        crc_pallas.FORCE_INTERPRET = False
