@@ -17,8 +17,18 @@ from ceph_tpu.ops import gf
 
 def gf_matmul(mat: np.ndarray, data: np.ndarray, use_tpu: bool,
               min_bytes: int = 1) -> np.ndarray:
-    """(R,K) GF(2^8) matrix x (K,S) or (B,K,S) uint8, device-dispatched."""
+    """(R,K) GF(2^8) matrix x (K,S) or (B,K,S) uint8, device-dispatched.
+
+    The device branch routes through the DEFAULT-MESH sharded pipeline
+    (parallel/backend.py) — the daemons' EC path and the multi-chip
+    dryrun compile the same program; a single chip is the (1,1) mesh.
+    """
     if use_tpu and gf.backend_available() and data.size >= min_bytes:
+        from ceph_tpu.parallel import backend
+
+        out = backend.matmul(mat, data)
+        if out is not None:
+            return out
         return np.asarray(gf.gf_matmul_tpu(mat, data))
     if data.ndim == 2:
         return gf.gf_matmul_host(mat, data)

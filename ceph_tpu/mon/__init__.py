@@ -152,7 +152,7 @@ class MonDaemon:
         for osd in range(num_osds):
             self.osdmap.osd_state[osd] &= ~CEPH_OSD_UP
         # from here the map mutates only via apply_incremental
-        self.osdmap._cache_placement = True
+        self.osdmap.enable_placement_cache()
         if store is not None:
             self._persist(None)
 
@@ -161,7 +161,7 @@ class MonDaemon:
         if raw is None:
             return False
         self.osdmap = OSDMap.decode(raw)
-        self.osdmap._cache_placement = True
+        self.osdmap.enable_placement_cache()
         # load at most the newest _inc_log_max incrementals (the store
         # is trimmed on commit, but never trust unbounded history)
         loaded = [(int.from_bytes(key, "big"), val)
@@ -358,7 +358,7 @@ class MonDaemon:
         """Full-state catch-up past a trimmed log (OP_FULL)."""
         mlen = int.from_bytes(blob[:8], "big")
         self.osdmap = OSDMap.decode(blob[8:8 + mlen])
-        self.osdmap._cache_placement = True
+        self.osdmap.enable_placement_cache()
         rest = blob[8 + mlen:]
         if rest:
             clen = int.from_bytes(rest[:8], "big")

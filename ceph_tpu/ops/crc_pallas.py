@@ -89,6 +89,10 @@ def _mk_stack(length: int) -> np.ndarray:
 
 def supported(length: int, n_blocks: int,
               platform: str | None = None) -> bool:
+    import os
+
+    if os.environ.get("CEPH_TPU_PALLAS", "1") == "0":
+        return False  # same kill switch as gf_pallas
     if not HAVE_JAX:
         return False
     if length % cks._CELL or length // 4 > _MAX_W:

@@ -226,6 +226,16 @@ if HAVE_JAX:
         mwords = jnp.asarray(np.asarray(matrix, np.uint8).astype(np.int32))
         return _gen_call(r, k, b, r4, ts)(mwords, words)
 
+    def gf_matmul_words_runtime(mwords, words):
+        """Traceable words-kernel entry: the (R,K) coefficient matrix is
+        a RUNTIME int32 operand (the generic SMEM kernel), so one
+        compile per shape covers every matrix — the decode path's
+        contract (per-erasure-signature matrices must not retrace)."""
+        b, k, r4, lanes = words.shape
+        r = mwords.shape[0]
+        assert lanes == 128
+        return _gen_call(r, k, b, r4, _pick_ts(r4))(mwords, words)
+
     def gf_matmul_pallas(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
         """Host entry: (..., K, S) uint8 numpy -> (..., R, S) uint8 numpy
         (leading dims flattened into the kernel batch axis).

@@ -56,11 +56,14 @@ class Transaction:
               length: int, data: bytes) -> None:
         """Buffers are CLAIMED, not copied (the reference Transaction
         holds bufferlist refs, src/os/Transaction.h — writers never
-        mutate a buffer after queueing it); caller-mutable buffers
-        (bytearrays, writable views) are snapshotted."""
+        mutate a buffer after queueing it); anything not PROVABLY
+        immutable (common.buffer.is_immutable walks the base chain —
+        a readonly view over a caller-mutable bytearray is still
+        caller-mutable) is snapshotted."""
         assert length == len(data)
-        if isinstance(data, bytearray) or (
-                isinstance(data, memoryview) and not data.readonly):
+        from ceph_tpu.common.buffer import is_immutable
+
+        if not is_immutable(data):
             data = bytes(data)
         self.ops.append(("write", cid, oid, offset, data))
 

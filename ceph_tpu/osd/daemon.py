@@ -697,7 +697,7 @@ class OSDDaemon:
                         newmap.epoch, self.osdmap.epoch)
         self.osdmap = newmap
         # mutation-through-incrementals contract: enable placement memo
-        self.osdmap._cache_placement = True
+        self.osdmap.enable_placement_cache()
         self._post_map_epoch(prev_up)
 
     def _request_map_range(self) -> None:

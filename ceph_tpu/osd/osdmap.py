@@ -209,11 +209,18 @@ class OSDMap:
         # map (tests and tools freely poke osd_state/pg_temp between
         # queries); daemons and clients that mutate their map ONLY
         # through apply_incremental / whole-map install set
-        # cache_placement = True after each map change.  Entries key on
-        # (epoch, pg) and the store resets on epoch change.
+        # enable_placement_cache() after each map change.  Entries
+        # key on (epoch, pg) and the store resets on epoch change.
         self._cache_placement = False
         self._pcache: Dict[PgId, Tuple] = {}
         self._pcache_epoch = -1
+
+    def enable_placement_cache(self) -> None:
+        """Owner promises mutation-through-incrementals (or whole-map
+        install) from here on — daemons/clients call this after every
+        map change; raw maps in tools/tests stay uncached so direct
+        state surgery between queries stays safe."""
+        self._cache_placement = True
 
     def _invalidate_placement(self) -> None:
         self._pcache.clear()

@@ -1,0 +1,76 @@
+"""Process-default mesh backend: the cluster's own EC device dispatch.
+
+Every codec's device path (ec/dispatch.gf_matmul) routes here, so the
+code the OSD daemon runs on a write IS the sharded pipeline that
+`__graft_entry__.dryrun_multichip` compiles over N virtual devices —
+a single real chip is simply the (dp=1, sp=1) mesh, multi-chip needs
+no separate implementation (the SURVEY §5.7/§5.8 stance: striping
+across chips is the same program over a bigger mesh).
+
+Matmuls are dp-sharded over the stripe batch; at sp==1 the per-device
+kernel is the packed-word Pallas path (ops/gf_pallas.py) for host
+inputs, the XLA bit-decomposition otherwise; at sp>1 the byte axis is
+sequence-parallel and the XLA path runs with the crc combines riding
+ICI collectives (parallel/striped.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+# observability: how many device dispatches the pipeline served — the
+# dryrun and tests assert the cluster datapath actually lands here
+stats: Dict[str, int] = {"matmul_calls": 0}
+
+
+@functools.lru_cache(maxsize=1)
+def default_mesh():
+    import jax
+
+    from ceph_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(jax.devices())
+
+
+@functools.lru_cache(maxsize=64)
+def _pipeline(k: int, r: int, chunk: int):
+    """Keyed by SHAPE only: matrices ride as runtime operands (decode
+    cycles through per-erasure-signature matrices — keying on the
+    matrix would rebuild and recompile per signature)."""
+    from ceph_tpu.models import reed_solomon as rs
+    from ceph_tpu.parallel.striped import ShardedPipeline
+
+    return ShardedPipeline(default_mesh(), k, r, chunk,
+                           rs.reed_sol_van_matrix(k, r))
+
+
+def matmul(mat: np.ndarray, data) -> Optional[np.ndarray]:
+    """(R,K) GF(2^8) matrix x (K,S)/(B,K,S) uint8 over the default
+    mesh; None when the input cannot ride the mesh (caller falls back
+    to the single-device path)."""
+    if not isinstance(data, np.ndarray):
+        return None
+    mesh = default_mesh()
+    sp = mesh.shape["sp"]
+    dp = mesh.shape["dp"]
+    arr = data
+    squeeze = False
+    if arr.ndim == 2:
+        arr = arr[None]
+        squeeze = True
+    b, k, s = arr.shape
+    if s == 0 or s % sp or s % 4:
+        return None
+    pipe = _pipeline(k, len(mat), s)
+    pad = -b % dp
+    if pad:
+        arr = np.concatenate(
+            [arr, np.zeros((pad, k, s), dtype=np.uint8)], axis=0)
+    stats["matmul_calls"] += 1
+    out = np.asarray(pipe.matmul(np.asarray(mat, np.uint8), arr))
+    if pad:
+        out = out[:b]
+    return out[0] if squeeze else out

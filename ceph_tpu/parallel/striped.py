@@ -64,6 +64,7 @@ class ShardedPipeline:
                     f" per input, caller expected {result_max}")
         self._encode = self._build_encode()
         self._decode_cache = {}
+        self._words_cache = {}
 
     # -- encode + hinfo + placement ---------------------------------------
 
@@ -149,3 +150,66 @@ class ShardedPipeline:
         """(B, k, S) surviving chunks x (R, k) decode rows -> (B, R, S)."""
         dmat_bits = jnp.asarray(gf.gf_matrix_to_bits(dmat))
         return self._decode_fn(dmat.shape[0])(dmat_bits, survivors)
+
+    # -- generalized mesh matmul (the codec device dispatch) ---------------
+
+    def matmul(self, mat: np.ndarray, data: np.ndarray):
+        """(R, K) x (B, K, S) host batch -> (B, R, S) over the mesh.
+
+        Encode and decode are the same product (decode rows come from
+        the codec's signature cache), so this one entry serves both —
+        it is what ec/dispatch routes the daemons' device path
+        through.  At sp == 1 each device runs the packed-word Pallas
+        kernel (host bytes view as words for free); at sp > 1 the byte
+        axis is sequence-parallel and the XLA bit-decomposition runs
+        under shard_map.
+        """
+        from ceph_tpu.ops import gf_pallas
+
+        b, k, s = data.shape
+        if self.sp == 1 and gf_pallas.supported((b, k, s)):
+            return self._matmul_words(mat, data)
+        dev = jax.device_put(jnp.asarray(data, dtype=jnp.uint8),
+                             self.data_sharding())
+        return self.decode(np.asarray(mat, dtype=np.uint8), dev)
+
+    def _matmul_words(self, mat: np.ndarray, data: np.ndarray):
+        from ceph_tpu.ops import gf_pallas
+
+        key = gf_pallas._coeff_key(mat)
+        if key in gf_pallas._registered:
+            # hot encode generators: the unrolled specialized kernel,
+            # one compile per registered matrix (bounded set)
+            fn = self._words_cache.get(key)
+            if fn is None:
+                matarr = np.array(key, dtype=np.uint8)
+
+                def local(w):
+                    return gf_pallas.gf_matmul_words(matarr, w)
+
+                fn = self._jit_words(local)
+                self._words_cache[key] = fn
+            args = (fn,)
+        else:
+            # decode matrices vary per erasure signature: ONE compile
+            # per (r, k) shape, matrix as a runtime SMEM operand
+            r, k = len(key), len(key[0])
+            fn = self._words_cache.get((r, k))
+            if fn is None:
+                fn = self._jit_words(gf_pallas.gf_matmul_words_runtime,
+                                     runtime_mat=True)
+                self._words_cache[(r, k)] = fn
+            args = (fn, jnp.asarray(
+                np.asarray(mat, np.uint8).astype(np.int32)))
+        words = jnp.asarray(gf_pallas.words_from_bytes(data))
+        sharding = NamedSharding(self.mesh, P("dp", None, None, None))
+        dw = jax.device_put(words, sharding)
+        out = np.asarray(args[0](*args[1:], dw))
+        return gf_pallas.bytes_from_words(out)
+
+    def _jit_words(self, local, runtime_mat: bool = False):
+        spec = P("dp", None, None, None)
+        in_specs = (P(), spec) if runtime_mat else (spec,)
+        return jax.jit(jax.shard_map(
+            local, mesh=self.mesh, in_specs=in_specs,
+            out_specs=spec, check_vma=False))

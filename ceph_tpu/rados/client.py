@@ -209,7 +209,7 @@ class RadosClient:
                 return True
         if msg.full_map is not None:
             newmap = OSDMap.decode(msg.full_map)
-            newmap._cache_placement = True
+            newmap.enable_placement_cache()
             if self.osdmap is None or newmap.epoch > self.osdmap.epoch:
                 self.osdmap = newmap
                 return True

@@ -97,3 +97,40 @@ class TestShardedPlacement:
         _, _, placement = pipe.encode(pipe.put_stripes(data), pgs)
         expected = rule(pgs)
         np.testing.assert_array_equal(np.asarray(placement), expected)
+
+
+def test_codec_device_path_rides_mesh_pipeline(mesh):
+    """The EC codec's device dispatch must route through the
+    default-mesh ShardedPipeline (parallel/backend.py) — the cluster's
+    own datapath and the multi-chip dryrun share one program."""
+    import numpy as np
+
+    from ceph_tpu.ec.registry import create_erasure_code
+    from ceph_tpu.ops import gf
+    from ceph_tpu.parallel import backend
+
+    assert mesh.shape["dp"] * mesh.shape["sp"] == 8
+    backend._pipeline.cache_clear()
+    old = backend.default_mesh
+    backend.default_mesh = lambda: mesh
+    try:
+        codec = create_erasure_code({
+            "plugin": "ec_jax", "technique": "reed_sol_van",
+            "k": "4", "m": "2", "tpu": "true", "tpu-min-bytes": "1"})
+        rng = np.random.default_rng(3)
+        # batch NOT divisible by dp, byte axis divisible by sp
+        data = rng.integers(0, 256, (5, 4, 64 * mesh.shape["sp"]),
+                            dtype=np.uint8)
+        before = backend.stats["matmul_calls"]
+        par = codec.encode_batch(data)
+        assert backend.stats["matmul_calls"] > before
+        want = np.stack([gf.gf_matmul_host(codec.matrix, d)
+                         for d in data])
+        assert np.array_equal(np.asarray(par), want)
+        # decode rows over the same path
+        dec = codec.decode_batch((2, 3, 4, 5), (0, 1),
+                                 data[:, :4, :])
+        assert np.asarray(dec).shape == (5, 2, data.shape[2])
+    finally:
+        backend.default_mesh = old
+        backend._pipeline.cache_clear()
