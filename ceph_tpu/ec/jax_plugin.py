@@ -58,8 +58,14 @@ class ErasureCodeJax(ErasureCode):
         self.k = to_int("k", profile, dk)
         self.m = to_int("m", profile, dm)
         self.w = to_int("w", profile, "8")
-        if self.w != 8:
-            raise ErasureCodeError(22, "ec_jax supports w=8 (jerasure default)")
+        if self.w not in (8, 16, 32):
+            raise ErasureCodeError(22, f"w={self.w} not in {{8, 16, 32}}")
+        if self.w != 8 and self.technique != "reed_sol_van":
+            # matches the reference: wide words are a reed_sol_van
+            # feature; the cauchy/r6 constructions here are w=8
+            # (ErasureCodeJerasure.cc:62-78 parses w per technique)
+            raise ErasureCodeError(
+                22, f"technique {self.technique} supports w=8 only")
         if self.technique == "reed_sol_r6_op" and self.m != 2:
             raise ErasureCodeError(22, "reed_sol_r6_op requires m=2")
         self.per_chunk_alignment = to_bool(
@@ -78,6 +84,15 @@ class ErasureCodeJax(ErasureCode):
         self._prepare()
 
     def _prepare(self) -> None:
+        if self.technique == "reed_sol_van" and self.w != 8:
+            from ceph_tpu.models import gf_wide
+
+            # wide-word Vandermonde (GF(2^16)/GF(2^32)); the device
+            # layout is w=8-specific, so wide codecs run the host tier
+            self.matrix = gf_wide.reed_sol_van_matrix_w(
+                self.k, self.m, self.w)
+            self.use_tpu = False
+            return
         if self.technique == "reed_sol_van":
             self.matrix = rs.reed_sol_van_matrix(self.k, self.m)
         elif self.technique == "reed_sol_r6_op":
@@ -132,7 +147,34 @@ class ErasureCodeJax(ErasureCode):
 
     def _matmul(self, mat: np.ndarray, data: np.ndarray) -> np.ndarray:
         """(R,K) GF matrix x (K,S) or (B,K,S) uint8 -> parity, device-dispatched."""
+        if self.w != 8:
+            return self._matmul_wide(mat, data)
         return dispatch.gf_matmul(mat, data, self.use_tpu, self.tpu_min_bytes)
+
+    def _matmul_wide(self, mat: np.ndarray, data: np.ndarray) -> np.ndarray:
+        """Host GF(2^w) matmul for w in {16, 32}: chunks viewed as
+        little-endian w-bit words (jerasure's word semantics)."""
+        from ceph_tpu.models import gf_wide
+
+        f = gf_wide.Field(self.w)
+        batched = data.ndim == 3
+        if not batched:
+            data = data[None]
+        b, kk, s = data.shape
+        assert s % (self.w // 8) == 0, (s, self.w)
+        words = data.view(f.dtype)
+        out = np.zeros((b, mat.shape[0], words.shape[-1]), dtype=f.dtype)
+        for j in range(mat.shape[0]):
+            for i in range(kk):
+                c = int(mat[j, i])
+                if c == 0:
+                    continue
+                if c == 1:
+                    out[:, j] ^= words[:, i]
+                else:
+                    out[:, j] ^= f.mul_vec(c, words[:, i])
+        res = out.view(np.uint8).reshape(b, mat.shape[0], s)
+        return res if batched else res[0]
 
     def encode_chunks(self, want_to_encode: Set[int],
                       encoded: Dict[int, bytearray]) -> None:
@@ -167,6 +209,14 @@ class ErasureCodeJax(ErasureCode):
     def _decode_matrix(self, have: tuple, erasures: tuple) -> np.ndarray:
         """LRU-cached decode rows keyed by (have, erasures) — the signature
         cache of ErasureCodeIsaTableCache."""
+        if self.w != 8:
+            from ceph_tpu.models import gf_wide
+
+            return self._decode_cache.get_or_compute(
+                (have, erasures),
+                lambda: gf_wide.decode_matrix_w(
+                    self.matrix, self.k, list(erasures), list(have),
+                    self.w))
         return self._decode_cache.get_or_compute(
             (have, erasures),
             lambda: rs.decode_matrix(self.matrix, self.k,

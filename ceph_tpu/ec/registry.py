@@ -127,9 +127,14 @@ class ErasureCodePluginRegistry:
 
 def _make_jax_factory(technique: str) -> Factory:
     def factory(profile: ErasureCodeProfile) -> ErasureCode:
+        from ceph_tpu.ec.bitmatrix_plugin import ErasureCodeJaxBitmatrix
         from ceph_tpu.ec.jax_plugin import ErasureCodeJax
 
-        codec = ErasureCodeJax(technique=profile.get("technique", technique))
+        tech = profile.get("technique", technique)
+        if tech in ErasureCodeJaxBitmatrix.TECHNIQUES:
+            codec: ErasureCode = ErasureCodeJaxBitmatrix(technique=tech)
+        else:
+            codec = ErasureCodeJax(technique=tech)
         codec.init(profile)
         return codec
 

@@ -5,15 +5,10 @@ plugin (jerasure's reed_sol.c / cauchy.c, per Plank's tutorial and its 2003
 correction) so that encoded chunks are bit-identical with the reference for
 technique=reed_sol_van / reed_sol_r6_op / cauchy_orig at w=8
 (/root/reference/src/erasure-code/jerasure/ErasureCodeJerasure.cc:200-204,
-:252-255, :327).  Implementation is original, written from the algorithm:
-
-1. Extended (k+m) x k Vandermonde matrix over GF(2^8):
-   row 0 = e_0, row (k+m-1) = e_{k-1}, row i = [1, i, i^2, ... i^(k-1)].
-2. Elementary column operations turn the top k x k into the identity
-   (column ops right-multiply the generator by an invertible matrix — the
-   code stays MDS and becomes systematic).
-3. Each column of the *coding rows only* is scaled so the first coding row
-   becomes all ones (the XOR row; jerasure decodes with row_k_ones=1).
+:252-255, :327).  Implementation is original, written from the algorithm (extended
+Vandermonde -> systematic by column ops -> coding columns scaled so the
+first coding row is all ones); the single Field-parameterized copy
+lives in models/gf_wide.py and serves w in {8, 16, 32}.
 """
 
 from __future__ import annotations
@@ -23,60 +18,18 @@ import numpy as np
 from ceph_tpu.ops.gf import gf_div, gf_inv, gf_mul, gf_pow
 
 
-def extended_vandermonde(rows: int, cols: int) -> np.ndarray:
-    v = np.zeros((rows, cols), dtype=np.uint8)
-    v[0, 0] = 1
-    if rows == 1:
-        return v
-    v[rows - 1, cols - 1] = 1
-    for i in range(1, rows - 1):
-        acc = 1
-        for j in range(cols):
-            v[i, j] = acc
-            acc = gf_mul(np.uint8(acc), np.uint8(i)).item()
-    return v
-
-
-def _systematize(v: np.ndarray, k: int) -> np.ndarray:
-    """Column-reduce so the top k x k block is the identity."""
-    v = v.copy()
-    rows = v.shape[0]
-    for i in range(k):
-        if v[i, i] == 0:
-            for j in range(i + 1, k):
-                if v[i, j] != 0:
-                    v[:, [i, j]] = v[:, [j, i]]
-                    break
-            else:
-                raise ValueError("vandermonde not reducible")
-        if v[i, i] != 1:
-            inv = gf_inv(int(v[i, i]))
-            v[:, i] = gf_mul(v[:, i], np.uint8(inv))
-        for j in range(k):
-            if j != i and v[i, j] != 0:
-                c = np.uint8(v[i, j])
-                v[:, j] ^= gf_mul(v[:, i], c)
-    return v
-
-
 def reed_sol_van_matrix(k: int, m: int) -> np.ndarray:
-    """(m, k) coding matrix, jerasure reed_sol_vandermonde_coding_matrix(w=8)."""
+    """(m, k) coding matrix, jerasure reed_sol_vandermonde_coding_matrix(w=8).
+
+    ONE implementation serves every word size: the Field-parameterized
+    construction in models/gf_wide.py (this w=8 entry is what the
+    golden-vector and independent-derivation tests pin, so wide words
+    inherit the pinned algorithm rather than a drifting copy)."""
     if k + m > 256:
         raise ValueError("k+m must be <= 256 for w=8")
-    dist = _systematize(extended_vandermonde(k + m, k), k)
-    coding = dist[k:, :].copy()
-    # Scale coding-row columns so the first coding row is all ones.  Only the
-    # coding rows are touched, so the systematic identity above is preserved
-    # and every k x k submatrix determinant changes by a nonzero factor (MDS
-    # preserved).
-    for j in range(k):
-        a = int(coding[0, j])
-        if a == 0:
-            raise ValueError("MDS violation in vandermonde construction")
-        if a != 1:
-            inv = np.uint8(gf_inv(a))
-            coding[:, j] = gf_mul(coding[:, j], inv)
-    return coding
+    from ceph_tpu.models.gf_wide import reed_sol_van_matrix_w
+
+    return reed_sol_van_matrix_w(k, m, 8)
 
 
 def reed_sol_r6_matrix(k: int) -> np.ndarray:
