@@ -27,6 +27,22 @@ surface, ObjectMap/image layout in src/librbd/image/CreateRequest.cc):
   snapshot state; reading at a snap sets the read-snap on the data
   ioctx (librbd::Image::snap_set).
 
+- LAYERING (librbd clone v2, src/librbd/ parent I/O through ImageCtx
+  and cls_rbd children records): a clone is a new image whose header
+  carries a parent link {pool, image, snap, overlap}; reads of absent
+  child objects fall through to the parent AT THE SNAP (up to the
+  overlap), the first partial write to an absent object COPIES UP the
+  parent's object content, and flatten() copies every remaining
+  parent-backed object down and severs the link.  Clone requires a
+  PROTECTED snapshot; unprotect refuses while children exist
+  (cls_rbd children bookkeeping lives in the parent's header meta).
+- OBJECT MAP (librbd::ObjectMap, src/librbd/object_map/): a 2-bit
+  per-object state bitmap in `rbd_object_map.<id>` (requires
+  exclusive-lock, as in the reference).  Writes mark objects EXISTS
+  before data lands; discard/remove mark NONEXISTENT; reads skip the
+  data round-trip for NONEXISTENT objects, and image remove deletes
+  only mapped objects instead of probing every index.
+
 The reference keeps image state in cls_rbd stored procedures; here the
 same records live directly in header-object omap — the cls-lite layer
 can host them later without changing the layout.
@@ -52,13 +68,23 @@ def _data(image_id: str, objectno: int) -> str:
     return f"rbd_data.{image_id}.{objectno:016x}"
 
 
+def _object_map(image_id: str) -> str:
+    return f"rbd_object_map.{image_id}"
+
+
+# object-map states (ObjectMap.h OBJECT_*)
+OM_NONEXISTENT = 0
+OM_EXISTS = 1
+
+
 class RBD:
     """Image management surface (librbd::RBD)."""
 
     async def create(self, ioctx: IoCtx, name: str, size: int,
                      order: int = DEFAULT_ORDER,
                      data_pool: Optional[str] = None,
-                     exclusive_lock: bool = False) -> str:
+                     exclusive_lock: bool = False,
+                     object_map: bool = False) -> str:
         """Create an image; returns its id.  data_pool places the data
         objects on a different (e.g. erasure-coded) pool while
         metadata stays on this replicated pool (--data-pool role)."""
@@ -75,10 +101,17 @@ class RBD:
         # only an invisible orphan header (garbage, reclaimable name) —
         # the reverse order left a claimed name with no header that
         # could never be recreated
+        features = ["exclusive-lock"] if exclusive_lock else []
+        if object_map:
+            if not exclusive_lock:
+                # the reference gates object-map on exclusive-lock:
+                # an unserialized bitmap would race its own writers
+                raise RadosError(-22, "object-map requires"
+                                      " exclusive-lock")
+            features.append("object-map")
         meta = {"name": name, "size": size, "order": order,
                 "snaps": {}, "snap_seq": 0, "data_pool": data_pool,
-                "features": (["exclusive-lock"] if exclusive_lock
-                             else [])}
+                "features": features}
         await ioctx.omap_set(_header(image_id),
                              {"rbd": json.dumps(meta).encode()})
         try:
@@ -121,6 +154,59 @@ class RBD:
                 raise RadosError(-17, f"image {name!r} exists")
         return image_id
 
+    async def clone(self, p_ioctx: IoCtx, parent_name: str,
+                    snap_name: str, c_ioctx: IoCtx, clone_name: str,
+                    data_pool: Optional[str] = None,
+                    exclusive_lock: bool = False,
+                    object_map: bool = False) -> str:
+        """Clone from a PROTECTED parent snapshot (librbd clone v2,
+        rbd_op clone).  The child starts with zero data objects;
+        every read falls through to the parent at the snap until
+        writes copy objects up."""
+        parent = await self.open(p_ioctx, parent_name)
+        snap = parent.meta["snaps"].get(snap_name)
+        if snap is None:
+            raise ObjectNotFound(-2, snap_name)
+        if not snap.get("protected"):
+            raise RadosError(-22, f"snap {snap_name!r} is not"
+                                  " protected")
+        child_id = await self.create(
+            c_ioctx, clone_name, size=snap["size"],
+            order=parent.meta["order"], data_pool=data_pool,
+            exclusive_lock=exclusive_lock, object_map=object_map)
+        child = Image(c_ioctx, clone_name, child_id)
+        await child.refresh()
+        child.meta["parent"] = {
+            "pool_id": p_ioctx.pool_id, "image_id": parent.id,
+            "snap_name": snap_name, "snap_id": snap["id"],
+            "overlap": snap["size"]}
+        child.meta["features"] = sorted(
+            set(child.meta["features"]) | {"layering"})
+        await child._save()
+        # children bookkeeping on the parent (cls_rbd children role),
+        # under the parent header lock: concurrent clones or an
+        # unprotect racing this registration must serialize, or a
+        # child record is lost and unprotect orphans the clone
+        cookie = await _header_lock(p_ioctx, parent.id)
+        try:
+            await parent.refresh()
+            snap = parent.meta["snaps"].get(snap_name)
+            if snap is None or not snap.get("protected"):
+                raise RadosError(
+                    -22, f"snap {snap_name!r} lost protection during"
+                         " clone")
+            kids = parent.meta.setdefault("children", [])
+            kids.append({"pool_id": c_ioctx.pool_id,
+                         "image_id": child_id,
+                         "snap_name": snap_name})
+            await parent._save()
+        except Exception:
+            await _ignore_enoent(self.remove(c_ioctx, clone_name))
+            raise
+        finally:
+            await _header_unlock(p_ioctx, parent.id, cookie)
+        return child_id
+
     async def remove(self, ioctx: IoCtx, name: str) -> None:
         directory = await self._dir(ioctx)
         image_id = directory.get(name)
@@ -129,10 +215,23 @@ class RBD:
         img = await self.open(ioctx, name)
         if img.meta["snaps"]:
             raise RadosError(-39, "image has snapshots")  # ENOTEMPTY
+        if img.meta.get("children"):
+            raise RadosError(-39, "image has dependent clones")
         objects = (img.size() + img.object_size - 1) // img.object_size
+        todo = range(objects)
+        if img._om_enabled():
+            # object-map acceleration: delete only objects the map
+            # says exist instead of probing every index
+            om = await img._om_load()
+            todo = [i for i in range(objects)
+                    if img._om_get(om, i) == OM_EXISTS]
         await asyncio.gather(*(
             _ignore_enoent(img.data_ioctx.remove(_data(image_id, i)))
-            for i in range(objects)))
+            for i in todo))
+        await _ignore_enoent(ioctx.remove(_object_map(image_id)))
+        parent = img.meta.get("parent")
+        if parent is not None:
+            await img._deregister_child()
         await _ignore_enoent(ioctx.remove(_header(image_id)))
         try:
             # value-checked: if a concurrent create already reclaimed
@@ -180,6 +279,43 @@ async def _ignore_enoent(coro) -> None:
         pass
 
 
+META_LOCK = "rbd_meta_lock"
+
+
+async def _header_lock(ioctx: IoCtx, image_id: str,
+                       timeout: float = 10.0) -> str:
+    """Exclusive cls lock serializing header-metadata RMWs that span
+    HANDLES (children registration, protection adjudication) — the
+    cls_rbd single-writer discipline.  Expires (duration) so a crashed
+    holder cannot brick the image."""
+    import time as _time
+    import uuid as _uuid
+
+    cookie = _uuid.uuid4().hex[:12]
+    req = json.dumps({"name": META_LOCK, "type": "exclusive",
+                      "cookie": cookie, "duration": 15.0,
+                      "owner": f"rbdmeta.{cookie}"}).encode()
+    deadline = _time.monotonic() + timeout
+    while True:
+        try:
+            await ioctx.execute(_header(image_id), "lock", "lock", req)
+            return cookie
+        except RadosError as e:
+            if e.rc != -16 or _time.monotonic() > deadline:
+                raise
+            await asyncio.sleep(0.02)
+
+
+async def _header_unlock(ioctx: IoCtx, image_id: str,
+                         cookie: str) -> None:
+    req = json.dumps({"name": META_LOCK, "cookie": cookie,
+                      "owner": f"rbdmeta.{cookie}"}).encode()
+    try:
+        await ioctx.execute(_header(image_id), "lock", "unlock", req)
+    except (ObjectNotFound, RadosError):
+        pass  # header removed with the image: lock died with it
+
+
 class Image:
     """An open image (librbd::Image): byte-addressed I/O + snaps."""
 
@@ -208,12 +344,29 @@ class Image:
         self._lock_task: Optional[asyncio.Task] = None
         self._renew_n = 0
         self._seen_renewal = None  # (raw, my monotonic) for staleness
+        # layering: parent reader handle, bound lazily in _parent()
+        self._parent_img: Optional["Image"] = None
+        # object map: in-memory bitmap cache (authoritative while the
+        # exclusive lock is held, the reference's in-memory ObjectMap);
+        # _om_lock serializes load+mutate so parallel per-object write
+        # tasks can never fork the bitmap and lose marks
+        self._om_cache: Optional[bytearray] = None
+        self._om_lock = asyncio.Lock()
+        # serializes absent-check + copyup: without it two concurrent
+        # partial writes to one absent object both copy up and the
+        # second copyup erases the first write's chunk (librbd guards
+        # this with a server-side object-absent condition)
+        self._copyup_lock = asyncio.Lock()
 
     # -- metadata ----------------------------------------------------------
 
     async def refresh(self) -> None:
         omap = await self.ioctx.omap_get(_header(self.id))
         self.meta = json.loads(omap["rbd"].decode())
+        # derived caches follow the header: a peer may have changed
+        # the map or the parent link since they were filled
+        self._om_cache = None
+        self._parent_img = None
         data_pool = self.meta.get("data_pool")
         if data_pool and self.data_ioctx is self.ioctx:
             self.data_ioctx = self.ioctx.client.open_ioctx(data_pool)
@@ -257,6 +410,187 @@ class Image:
             offset += span
         return out
 
+    # -- layering (parent I/O, librbd ImageCtx parent role) ---------------
+
+    def _has_parent(self) -> bool:
+        return self.meta.get("parent") is not None
+
+    async def _parent(self) -> "Image":
+        """The parent image opened read-only AT THE CLONE SNAP."""
+        if self._parent_img is None:
+            p = self.meta["parent"]
+            p_ioctx = IoCtx(self.ioctx.client, p["pool_id"])
+            # open by id: the parent may have been renamed since
+            img = Image(p_ioctx, "", p["image_id"])
+            await img.refresh()
+            img.snap_set(p["snap_name"])
+            self._parent_img = img
+        return self._parent_img
+
+    def _effective_overlap(self) -> int:
+        if self._read_snap is not None:
+            snap = self.meta["snaps"][self._read_snap]
+            return snap.get("parent_overlap",
+                            self.meta["parent"]["overlap"])
+        return self.meta["parent"]["overlap"]
+
+    async def _parent_read(self, objectno: int, in_off: int,
+                           span: int) -> bytes:
+        """Read the byte range from the parent at the snap, clamped to
+        the overlap (the READ snap's recorded overlap when reading at
+        a snapshot); beyond-overlap bytes are zeros."""
+        start = objectno * self.object_size + in_off
+        end = min(start + span, self._effective_overlap())
+        if end <= start:
+            return bytes(span)
+        parent = await self._parent()
+        buf = await parent.read(start, end - start)
+        return buf + bytes(span - len(buf))
+
+    async def _copyup(self, objectno: int) -> None:
+        """First partial write to an absent child object: copy the
+        parent's content for that object down (librbd CopyupRequest).
+        Idempotent — re-running after a crash converges."""
+        content = await self._parent_read(objectno, 0,
+                                          self.object_size)
+        content = content.rstrip(b"\x00")
+        await self.data_ioctx.write_full(_data(self.id, objectno),
+                                         content)
+        await self._om_mark(objectno, OM_EXISTS)
+
+    async def _child_object_absent(self, objectno: int) -> bool:
+        if self._om_enabled():
+            om = await self._om_load()
+            return self._om_get(om, objectno) == OM_NONEXISTENT
+        try:
+            await self.data_ioctx.stat(_data(self.id, objectno))
+            return False
+        except ObjectNotFound:
+            return True
+
+    async def _deregister_child(self) -> None:
+        p = self.meta.get("parent")
+        if p is None:
+            return
+        p_ioctx = IoCtx(self.ioctx.client, p["pool_id"])
+        parent = Image(p_ioctx, "", p["image_id"])
+        try:
+            cookie = await _header_lock(p_ioctx, p["image_id"])
+        except ObjectNotFound:
+            return
+        try:
+            await parent.refresh()
+            kids = [c for c in parent.meta.get("children", [])
+                    if c["image_id"] != self.id]
+            parent.meta["children"] = kids
+            await parent._save()
+        except ObjectNotFound:
+            pass
+        finally:
+            await _header_unlock(p_ioctx, p["image_id"], cookie)
+
+    async def flatten(self) -> None:
+        """Copy every still-parent-backed object down, then sever the
+        parent link (librbd flatten)."""
+        if not self._has_parent():
+            return
+        await self._ensure_lock()
+        overlap = self.meta["parent"]["overlap"]
+        objects = -(-overlap // self.object_size)
+        sem = asyncio.Semaphore(8)
+
+        async def one(objectno: int) -> None:
+            async with sem:
+                if await self._child_object_absent(objectno):
+                    await self._copyup(objectno)
+
+        await asyncio.gather(*(one(i) for i in range(objects)))
+        await self._deregister_child()
+        self.meta["parent"] = None
+        self.meta["features"] = [f for f in self.meta["features"]
+                                 if f != "layering"]
+        await self._save()
+        self._parent_img = None
+
+    # -- object map (librbd::ObjectMap role) ------------------------------
+
+    def _om_enabled(self) -> bool:
+        return "object-map" in self.meta.get("features", [])
+
+    async def _om_load(self) -> bytearray:
+        if self._om_cache is not None:
+            return self._om_cache
+        objects = -(-self.meta["size"] // self.object_size)
+        nbytes = -(-objects // 4)  # 2 bits per object
+        try:
+            raw = bytearray(await self.ioctx.read(
+                _object_map(self.id)))
+        except ObjectNotFound:
+            raw = bytearray()
+        if len(raw) < nbytes:
+            raw.extend(bytes(nbytes - len(raw)))
+        self._om_cache = raw
+        return raw
+
+    @staticmethod
+    def _om_get(om: bytearray, objectno: int) -> int:
+        return (om[objectno // 4] >> ((objectno % 4) * 2)) & 3
+
+    async def _om_mark(self, objectno: int, state: int) -> None:
+        if not self._om_enabled():
+            return
+        async with self._om_lock:
+            om = await self._om_load()
+            if objectno // 4 >= len(om):
+                om.extend(bytes(objectno // 4 + 1 - len(om)))
+            shift = (objectno % 4) * 2
+            om[objectno // 4] = (om[objectno // 4] & ~(3 << shift)) \
+                | (state << shift)
+            await self.ioctx.write_full(_object_map(self.id),
+                                        bytes(om))
+
+    async def rebuild_object_map(self) -> None:
+        """Scan actual data objects and rewrite the map (rbd
+        object-map rebuild)."""
+        await self._ensure_lock()
+        objects = -(-self.meta["size"] // self.object_size)
+        om = bytearray(-(-objects // 4))
+        sem = asyncio.Semaphore(8)
+
+        async def probe(i: int) -> None:
+            async with sem:
+                try:
+                    await self.data_ioctx.stat(_data(self.id, i))
+                    om[i // 4] |= OM_EXISTS << ((i % 4) * 2)
+                except ObjectNotFound:
+                    pass
+
+        await asyncio.gather(*(probe(i) for i in range(objects)))
+        self._om_cache = om
+        await self.ioctx.write_full(_object_map(self.id), bytes(om))
+
+    async def diff_objects(self) -> List[int]:
+        """Object indexes with data (fast-diff lite): straight from
+        the map when enabled, probe otherwise."""
+        objects = -(-self.meta["size"] // self.object_size)
+        if self._om_enabled():
+            om = await self._om_load()
+            return [i for i in range(objects)
+                    if self._om_get(om, i) == OM_EXISTS]
+        sem = asyncio.Semaphore(8)
+
+        async def probe(i: int) -> bool:
+            async with sem:
+                try:
+                    await self.data_ioctx.stat(_data(self.id, i))
+                    return True
+                except ObjectNotFound:
+                    return False
+
+        hits = await asyncio.gather(*(probe(i)
+                                      for i in range(objects)))
+        return [i for i, hit in enumerate(hits) if hit]
+
     # -- I/O ---------------------------------------------------------------
 
     async def read(self, offset: int, length: int) -> bytes:
@@ -264,12 +598,28 @@ class Image:
         if offset >= size:
             return b""
         length = min(length, size - offset)
+        om = await self._om_load() if self._om_enabled() and \
+            self._read_snap is None else None
 
         async def one(objectno: int, in_off: int, span: int) -> bytes:
+            if om is not None and \
+                    self._om_get(om, objectno) == OM_NONEXISTENT:
+                # map says absent: skip the data round-trip entirely
+                if self._has_parent():
+                    return await self._parent_read(objectno, in_off,
+                                                   span)
+                return bytes(span)
             try:
                 buf = await self.data_ioctx.read(
                     _data(self.id, objectno), in_off, span)
             except ObjectNotFound:
+                if self._has_parent():
+                    # clone fallthrough: the parent provides content
+                    # until a write copies the object up (also for
+                    # reads at a CHILD snap — the parent is frozen at
+                    # its own snap, so its content is time-invariant)
+                    return await self._parent_read(objectno, in_off,
+                                                   span)
                 return bytes(span)  # sparse: absent object reads zeros
             if len(buf) < span:  # short object tail is sparse too
                 buf += bytes(span - len(buf))
@@ -410,10 +760,27 @@ class Image:
         for objectno, in_off, span in self._extents(offset, len(data)):
             chunk = data[pos:pos + span]
             pos += span
-            jobs.append(self.data_ioctx.write(
-                _data(self.id, objectno), chunk, in_off))
+            jobs.append(self._write_object(objectno, in_off, span,
+                                           chunk))
         await asyncio.gather(*jobs)
         return len(data)
+
+    async def _write_object(self, objectno: int, in_off: int,
+                            span: int, chunk: bytes) -> None:
+        """One object's slice of a write: copyup-then-write for
+        partial writes into a parent-backed absent object (librbd
+        AbstractObjectWriteRequest copyup path), object-map EXISTS
+        before data lands."""
+        full = in_off == 0 and span == self.object_size
+        if self._has_parent() and not full and \
+                objectno * self.object_size \
+                < self.meta["parent"]["overlap"]:
+            async with self._copyup_lock:
+                if await self._child_object_absent(objectno):
+                    await self._copyup(objectno)
+        await self._om_mark(objectno, OM_EXISTS)
+        await self.data_ioctx.write(_data(self.id, objectno), chunk,
+                                    in_off)
 
     async def discard(self, offset: int, length: int) -> None:
         """Deallocate a range: whole objects are removed (returning
@@ -421,16 +788,24 @@ class Image:
         if self._read_snap is not None:
             raise RadosError(-30, "image is open at a snapshot")
         await self._ensure_lock()
+        overlap = self.meta["parent"]["overlap"] \
+            if self._has_parent() else 0
         jobs = []
         for objectno, in_off, span in self._extents(offset, length):
             name = _data(self.id, objectno)
-            if in_off == 0 and span == self.object_size:
-                jobs.append(_ignore_enoent(
-                    self.data_ioctx.remove(name)))
+            full = in_off == 0 and span == self.object_size
+            if full and objectno * self.object_size >= overlap:
+                jobs.append(self._discard_object(objectno, name))
             else:
-                jobs.append(self.data_ioctx.write(
-                    name, bytes(span), in_off))
+                # parent-backed range (or partial span): removal would
+                # EXPOSE the parent's bytes again — zero instead
+                jobs.append(self._write_object(objectno, in_off, span,
+                                               bytes(span)))
         await asyncio.gather(*jobs)
+
+    async def _discard_object(self, objectno: int, name: str) -> None:
+        await _ignore_enoent(self.data_ioctx.remove(name))
+        await self._om_mark(objectno, OM_NONEXISTENT)
 
     async def resize(self, new_size: int) -> None:
         if self._read_snap is not None:
@@ -442,15 +817,28 @@ class Image:
             first_dead = (new_size + self.object_size - 1) \
                 // self.object_size
             last = (old + self.object_size - 1) // self.object_size
+            dead = range(first_dead, last)
+            if self._om_enabled():
+                om = await self._om_load()
+                dead = [i for i in dead
+                        if self._om_get(om, i) == OM_EXISTS]
             await asyncio.gather(*(
-                _ignore_enoent(
-                    self.data_ioctx.remove(_data(self.id, i)))
-                for i in range(first_dead, last)))
+                self._discard_object(i, _data(self.id, i))
+                for i in dead))
             if new_size % self.object_size:
+                # through the copyup-aware path: a raw zero-write
+                # would CREATE the tail object and cut off parent
+                # fallthrough for its still-live head bytes
                 tail = new_size % self.object_size
-                await self.data_ioctx.write(
-                    _data(self.id, new_size // self.object_size),
-                    bytes(self.object_size - tail), tail)
+                await self._write_object(
+                    new_size // self.object_size, tail,
+                    self.object_size - tail,
+                    bytes(self.object_size - tail))
+            if self._has_parent():
+                # shrink shrinks the parent overlap permanently
+                # (librbd: overlap = min(overlap, size))
+                self.meta["parent"]["overlap"] = min(
+                    self.meta["parent"]["overlap"], new_size)
         self.meta["size"] = new_size
         await self._save()
 
@@ -461,8 +849,14 @@ class Image:
             raise RadosError(-17, f"snap {snap_name!r} exists")
         await self._ensure_lock()
         snap_id = await self.data_ioctx.create_selfmanaged_snap()
-        self.meta["snaps"][snap_name] = {
-            "id": snap_id, "size": self.meta["size"]}
+        entry = {"id": snap_id, "size": self.meta["size"]}
+        if self._has_parent():
+            # snapshot-time parent overlap: a later shrink clamps the
+            # HEAD overlap, but reads at this snap must keep seeing
+            # what was parent-visible when it was taken (librbd
+            # parent_overlap per snap)
+            entry["parent_overlap"] = self.meta["parent"]["overlap"]
+        self.meta["snaps"][snap_name] = entry
         self.meta["snap_seq"] = max(self.meta["snap_seq"], snap_id)
         self._apply_snapc()
         await self._save()
@@ -473,10 +867,49 @@ class Image:
                 for n, s in sorted(self.meta["snaps"].items(),
                                    key=lambda kv: kv[1]["id"])]
 
-    async def snap_remove(self, snap_name: str) -> None:
-        snap = self.meta["snaps"].pop(snap_name, None)
+    async def snap_protect(self, snap_name: str) -> None:
+        """Protect a snap so clones may reference it (librbd
+        snap_protect; unprotect refuses while children exist)."""
+        snap = self.meta["snaps"].get(snap_name)
         if snap is None:
             raise ObjectNotFound(-2, snap_name)
+        snap["protected"] = True
+        await self._save()
+
+    async def snap_unprotect(self, snap_name: str) -> None:
+        # children check + protection clear under the header lock:
+        # a clone() registering concurrently must either land before
+        # (we refuse) or after (it sees protection gone and aborts)
+        cookie = await _header_lock(self.ioctx, self.id)
+        try:
+            await self.refresh()
+            snap = self.meta["snaps"].get(snap_name)
+            if snap is None:
+                raise ObjectNotFound(-2, snap_name)
+            kids = [c for c in self.meta.get("children", [])
+                    if c.get("snap_name") == snap_name]
+            if kids:
+                raise RadosError(
+                    -16, f"snap {snap_name!r} has"
+                         f" {len(kids)} clone(s)")  # EBUSY
+            snap["protected"] = False
+            await self._save()
+        finally:
+            await _header_unlock(self.ioctx, self.id, cookie)
+
+    async def snap_is_protected(self, snap_name: str) -> bool:
+        snap = self.meta["snaps"].get(snap_name)
+        if snap is None:
+            raise ObjectNotFound(-2, snap_name)
+        return bool(snap.get("protected"))
+
+    async def snap_remove(self, snap_name: str) -> None:
+        snap = self.meta["snaps"].get(snap_name)
+        if snap is None:
+            raise ObjectNotFound(-2, snap_name)
+        if snap.get("protected"):
+            raise RadosError(-16, f"snap {snap_name!r} is protected")
+        self.meta["snaps"].pop(snap_name)
         self._apply_snapc()
         await self._save()
         await self.data_ioctx.remove_selfmanaged_snap(snap["id"])

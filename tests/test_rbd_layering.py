@@ -1,0 +1,270 @@
+"""RBD layering (clone/COW/flatten) + object map.
+
+Mirrors the reference's clone semantics (src/librbd/ parent I/O,
+cls_rbd children/protection bookkeeping) and object-map behavior
+(src/librbd/object_map/): protected-snap gating, parent fallthrough,
+copy-on-first-write, overlap clamping on shrink, flatten severing the
+link, and the bitmap accelerating reads/removes — checked against a
+flat-image oracle under a randomized op stream.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from cluster_helpers import Cluster
+
+from ceph_tpu.rbd import RBD, OM_EXISTS, _data
+from ceph_tpu.rados.client import RadosError
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 180))
+
+
+async def _cluster():
+    cluster = Cluster(num_osds=4, osds_per_host=2)
+    await cluster.start()
+    await cluster.client.create_replicated_pool("rbd", size=2, pg_num=4)
+    return cluster
+
+
+def test_clone_requires_protected_snap():
+    async def main():
+        cluster = await _cluster()
+        try:
+            io = cluster.client.open_ioctx("rbd")
+            rbd = RBD()
+            await rbd.create(io, "parent", 1 << 20, order=16)
+            img = await rbd.open(io, "parent")
+            await img.snap_create("s1")
+            with pytest.raises(RadosError):
+                await rbd.clone(io, "parent", "s1", io, "child")
+            await img.snap_protect("s1")
+            assert await img.snap_is_protected("s1")
+            await rbd.clone(io, "parent", "s1", io, "child")
+            # protected snap cannot be removed; unprotect refused
+            # while the clone exists
+            with pytest.raises(RadosError):
+                await img.snap_remove("s1")
+            await img.refresh()
+            with pytest.raises(RadosError):
+                await img.snap_unprotect("s1")
+            # parent cannot be removed while a clone depends on it
+            with pytest.raises(RadosError):
+                await rbd.remove(io, "parent")
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_clone_cow_and_flatten():
+    async def main():
+        cluster = await _cluster()
+        try:
+            io = cluster.client.open_ioctx("rbd")
+            rbd = RBD()
+            size = 1 << 18          # 4 objects of 64 KiB
+            await rbd.create(io, "parent", size, order=16)
+            parent = await rbd.open(io, "parent")
+            base = bytes(np.random.default_rng(1).integers(
+                0, 256, size, dtype=np.uint8))
+            await parent.write(0, base)
+            await parent.snap_create("gold")
+            await parent.snap_protect("gold")
+            # parent keeps changing AFTER the snap; the clone must not
+            # see it (it reads at the snap)
+            await parent.write(0, b"\xEE" * 4096)
+
+            await rbd.clone(io, "parent", "gold", io, "child")
+            child = await rbd.open(io, "child")
+            assert await child.read(0, size) == base, "fallthrough"
+
+            # partial write -> copyup: the rest of that object must
+            # still be the parent's bytes
+            await child.write(100, b"X" * 50)
+            got = await child.read(0, 1 << 16)
+            want = bytearray(base[:1 << 16])
+            want[100:150] = b"X" * 50
+            assert got == bytes(want), "copyup preserved parent bytes"
+            # parent unchanged at the snap
+            psnap = await rbd.open(io, "parent")
+            psnap.snap_set("gold")
+            assert await psnap.read(0, size) == base
+
+            # discard inside the overlap zeroes (must NOT re-expose
+            # the parent)
+            await child.discard(0, 1 << 16)
+            assert await child.read(0, 1 << 16) == bytes(1 << 16)
+
+            # flatten: content identical before/after, link severed,
+            # unprotect+remove of the parent snap now succeeds
+            before = await child.read(0, size)
+            await child.flatten()
+            assert not child._has_parent()
+            assert await child.read(0, size) == before
+            await parent.refresh()
+            await parent.snap_unprotect("gold")
+            await parent.snap_remove("gold")
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_clone_shrink_clamps_overlap():
+    async def main():
+        cluster = await _cluster()
+        try:
+            io = cluster.client.open_ioctx("rbd")
+            rbd = RBD()
+            size = 1 << 18
+            await rbd.create(io, "p2", size, order=16)
+            parent = await rbd.open(io, "p2")
+            base = bytes(np.random.default_rng(2).integers(
+                0, 256, size, dtype=np.uint8))
+            await parent.write(0, base)
+            await parent.snap_create("s")
+            await parent.snap_protect("s")
+            await rbd.clone(io, "p2", "s", io, "c2")
+            child = await rbd.open(io, "c2")
+            await child.resize(1 << 16)       # shrink to one object
+            await child.resize(size)          # grow back
+            # the dropped range must now read ZEROS, not parent bytes
+            # (overlap was clamped by the shrink)
+            assert await child.read(1 << 16, 1 << 16) == bytes(1 << 16)
+            assert await child.read(0, 1 << 16) == base[:1 << 16]
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_random_ops_vs_flat_oracle():
+    """Randomized write/discard/read stream applied to a clone AND to
+    a flat oracle image initialized with the parent content — contents
+    must stay identical throughout (the ceph_test_rados model-based
+    discipline, src/test/osd/RadosModel.h, for layering)."""
+    async def main():
+        cluster = await _cluster()
+        try:
+            io = cluster.client.open_ioctx("rbd")
+            rbd = RBD()
+            size = 3 << 16
+            rng = np.random.default_rng(7)
+            base = bytes(rng.integers(0, 256, size, dtype=np.uint8))
+            await rbd.create(io, "pr", size, order=16)
+            parent = await rbd.open(io, "pr")
+            await parent.write(0, base)
+            await parent.snap_create("s")
+            await parent.snap_protect("s")
+            await rbd.clone(io, "pr", "s", io, "cl")
+            clone = await rbd.open(io, "cl")
+            await rbd.create(io, "flat", size, order=16)
+            flat = await rbd.open(io, "flat")
+            await flat.write(0, base)
+            for _ in range(40):
+                op = rng.integers(0, 3)
+                off = int(rng.integers(0, size - 1))
+                ln = int(rng.integers(1, min(size - off, 100_000)))
+                if op == 0:
+                    buf = bytes(rng.integers(0, 256, ln,
+                                             dtype=np.uint8))
+                    await clone.write(off, buf)
+                    await flat.write(off, buf)
+                elif op == 1:
+                    await clone.discard(off, ln)
+                    await flat.discard(off, ln)
+                else:
+                    assert await clone.read(off, ln) == \
+                        await flat.read(off, ln), (op, off, ln)
+            assert await clone.read(0, size) == \
+                await flat.read(0, size)
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_interrupted_copyup_retries_converge():
+    """Crash-point shape: the first copyup write fails mid-flight; the
+    retried write converges to the same content (copyup idempotence,
+    the CopyupRequest restart discipline)."""
+    async def main():
+        cluster = await _cluster()
+        try:
+            io = cluster.client.open_ioctx("rbd")
+            rbd = RBD()
+            size = 1 << 17
+            base = bytes(np.random.default_rng(3).integers(
+                0, 256, size, dtype=np.uint8))
+            await rbd.create(io, "p3", size, order=16)
+            parent = await rbd.open(io, "p3")
+            await parent.write(0, base)
+            await parent.snap_create("s")
+            await parent.snap_protect("s")
+            await rbd.clone(io, "p3", "s", io, "c3")
+            child = await rbd.open(io, "c3")
+
+            orig = child.data_ioctx.write_full
+            fails = {"n": 1}
+
+            async def flaky(oid, data):
+                if fails["n"]:
+                    fails["n"] -= 1
+                    raise ConnectionError("injected copyup failure")
+                return await orig(oid, data)
+
+            child.data_ioctx.write_full = flaky
+            with pytest.raises(ConnectionError):
+                await child.write(10, b"Y" * 10)
+            # retry converges
+            await child.write(10, b"Y" * 10)
+            got = await child.read(0, 1 << 16)
+            want = bytearray(base[:1 << 16])
+            want[10:20] = b"Y" * 10
+            assert got == bytes(want)
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_object_map_tracks_and_accelerates():
+    async def main():
+        cluster = await _cluster()
+        try:
+            io = cluster.client.open_ioctx("rbd")
+            rbd = RBD()
+            size = 4 << 16
+            await rbd.create(io, "om", size, order=16,
+                             exclusive_lock=True, object_map=True)
+            img = await rbd.open(io, "om")
+            await img.write(0, b"A" * 100)             # object 0
+            await img.write(2 << 16, b"B" * 100)       # object 2
+            assert await img.diff_objects() == [0, 2]
+            await img.discard(2 << 16, 1 << 16)        # drop object 2
+            assert await img.diff_objects() == [0]
+            # reads of mapped-nonexistent objects skip the data pool:
+            # break the data ioctx read to prove no round trip happens
+            async def boom(*a, **k):
+                raise AssertionError("data read despite NONEXISTENT map")
+            orig = img.data_ioctx.read
+            img.data_ioctx.read = boom
+            assert await img.read(3 << 16, 100) == bytes(100)
+            img.data_ioctx.read = orig
+            # rebuild agrees with reality
+            await img.rebuild_object_map()
+            assert await img.diff_objects() == [0]
+            # remove() deletes only mapped objects (and the map object)
+            await img.close()
+            await rbd.remove(io, "om")
+            # object-map without exclusive-lock is refused
+            with pytest.raises(RadosError):
+                await rbd.create(io, "bad", size, object_map=True)
+        finally:
+            await cluster.stop()
+
+    run(main())
