@@ -514,6 +514,11 @@ class Messenger:
             await self.dispatcher(conn, msg)
         except asyncio.CancelledError:
             raise
+        except ConnectionError as e:
+            # replying into a just-closed connection is ordinary churn
+            # (peer died between request and response): debug, not error
+            log.debug("%s: dispatch of %r hit dead conn: %s",
+                      self.entity_name, msg, e)
         except Exception:
             log.exception("%s: dispatch of %r failed",
                           self.entity_name, msg)

@@ -18,6 +18,16 @@ erasure-coded — BASELINE #5 uses EC 8+3); index/meta JSON docs go to a
 replicated META pool, mirroring the reference's pool split
 (default.rgw.buckets.data vs .index/.meta).
 
+Versioning (rgw_op.cc:3712 RGWPutObj under versioning): an enabled
+bucket keeps every PUT as an immutable version (newest first in a
+per-key versions doc); deletes insert delete markers; GET serves the
+newest non-marker version or a named versionId.  Suspended buckets
+write the "null" version in place.  Lifecycle (rgw_lc.cc) expires
+current objects, prunes noncurrent versions, and aborts stale
+multipart uploads on a sweep; replaced/deleted stripes are DEFERRED
+to a GC queue (rgw_gc.cc role) drained by gc_process(), so a crash
+between index update and data delete leaks an entry, not objects.
+
 ETags are S3-compatible: hex MD5 of content for simple PUTs, and the
 multipart form md5(concat(part md5 digests))-"<nparts>" for completed
 multipart uploads — what stock S3 clients verify against.
@@ -45,6 +55,11 @@ from ceph_tpu.rgw.put_processor import (
 )
 
 MULTIPART_PREFIX = "_multipart_"
+
+# bucket versioning states (RGWBucketVersioningStatus)
+VER_OFF = "off"
+VER_ENABLED = "enabled"
+VER_SUSPENDED = "suspended"
 
 
 class RGWError(Exception):
@@ -155,6 +170,102 @@ class RGWLite:
     def _head_oid(self, bucket: str, key: str) -> str:
         return self._SEP.join((bucket, key))
 
+    @classmethod
+    def _versions_oid(cls, bucket: str, key: str) -> str:
+        return cls._meta_oid("versions", bucket, key)
+
+    @classmethod
+    def _gc_oid(cls) -> str:
+        return cls._meta_oid("gc")
+
+    # -- deferred stripe GC (rgw_gc.cc role) -------------------------------
+
+    async def _gc_defer(self, oids) -> None:
+        """Queue data objects for deferred deletion.  The entry lands
+        BEFORE the index stops referencing the stripes, so a crash
+        leaves a re-drainable entry, never an orphaned object."""
+        oids = [o for o in oids]
+        if not oids:
+            return
+        async with self._meta_lock(self._gc_oid()):
+            doc = await self._load(self._gc_oid()) or {"entries": []}
+            doc["entries"].extend(
+                {"oid": o, "at": time.time()} for o in oids)
+            await self._store(self._gc_oid(), doc)
+
+    async def gc_process(self, max_entries: int = 0) -> int:
+        """Drain the GC queue (rgw gc process); returns entries
+        removed.  Already-gone objects dequeue; any OTHER removal
+        failure (down OSDs, timeouts) keeps its entry queued for the
+        next sweep — dropping it would orphan the stripes, the exact
+        leak deferred GC exists to prevent."""
+        from ceph_tpu.rados.client import ObjectNotFound
+
+        async with self._meta_lock(self._gc_oid()):
+            doc = await self._load(self._gc_oid()) or {"entries": []}
+            todo = doc["entries"][:max_entries] if max_entries \
+                else list(doc["entries"])
+            kept = []
+            done = 0
+            for entry in todo:
+                try:
+                    await self.data.remove(entry["oid"])
+                    done += 1
+                except ObjectNotFound:
+                    done += 1
+                except Exception:
+                    kept.append(entry)
+            doc["entries"] = kept + doc["entries"][len(todo):]
+            await self._store(self._gc_oid(), doc)
+        return done
+
+    # -- versioning (RGWSetBucketVersioning / versioned PUT-GET-DEL) -------
+
+    async def put_bucket_versioning(self, bucket: str,
+                                    status: str) -> None:
+        if status not in (VER_ENABLED, VER_SUSPENDED):
+            raise RGWError("InvalidRequest", f"bad status {status!r}")
+        async with self._meta_lock(self._bucket_oid(bucket)):
+            doc = await self._bucket(bucket)
+            doc["versioning"] = status
+            await self._store(self._bucket_oid(bucket), doc)
+
+    async def get_bucket_versioning(self, bucket: str) -> str:
+        return (await self._bucket(bucket)).get("versioning", VER_OFF)
+
+    def _new_version_id(self) -> str:
+        self._writes += 1
+        return f"v{int(time.time() * 1000):x}.{self._writes}"
+
+    async def _versions(self, bucket: str, key: str) -> Dict:
+        return await self._load(self._versions_oid(bucket, key))             or {"versions": []}
+
+    async def list_object_versions(self, bucket: str,
+                                   prefix: str = "") -> List[Dict]:
+        """GET ?versions: every version and delete marker, newest
+        first per key (RGWListBucketVersions)."""
+        doc = await self._bucket(bucket)
+        out: List[Dict] = []
+        keys = sorted(set(doc["objects"])
+                      | set(doc.get("versioned_keys", [])))
+        for key in keys:
+            if not key.startswith(prefix):
+                continue
+            vdoc = await self._versions(bucket, key)
+            if vdoc["versions"]:
+                for v in vdoc["versions"]:
+                    out.append(dict(v, key=key))
+            else:
+                # never-versioned key: listed as VersionId "null"
+                # (S3 lists unversioned objects this way)
+                ent = doc["objects"][key]
+                out.append({"key": key, "version_id": "null",
+                            "etag": ent.get("etag", ""),
+                            "size": ent.get("size", 0),
+                            "mtime": ent.get("mtime", 0),
+                            "delete_marker": False})
+        return out
+
     # -- buckets -----------------------------------------------------------
 
     async def create_bucket(self, bucket: str) -> None:
@@ -176,6 +287,151 @@ class RGWLite:
                 for k, v in sorted(doc["objects"].items())
                 if k.startswith(prefix)]
 
+    async def list_objects_v2(self, bucket: str, prefix: str = "",
+                              delimiter: str = "",
+                              continuation_token: str = "",
+                              max_keys: int = 1000) -> Dict[str, Any]:
+        """ListObjectsV2 (RGWListBucket::execute with v2 semantics):
+        prefix filter, delimiter roll-up into CommonPrefixes,
+        continuation token (start strictly after), max-keys
+        truncation counting contents + prefixes."""
+        doc = await self._bucket(bucket)
+        contents: List[Dict[str, Any]] = []
+        prefixes: List[str] = []
+        truncated = False
+        next_token = ""
+        last_seen = ""
+        for key in sorted(doc["objects"]):
+            if not key.startswith(prefix):
+                continue
+            if continuation_token and key <= continuation_token:
+                continue
+            if delimiter:
+                rest = key[len(prefix):]
+                cut = rest.find(delimiter)
+                if cut >= 0:
+                    cp = prefix + rest[:cut + len(delimiter)]
+                    if prefixes and prefixes[-1] == cp:
+                        last_seen = key
+                        continue
+                    if len(contents) + len(prefixes) >= max_keys:
+                        truncated = True
+                        break
+                    prefixes.append(cp)
+                    last_seen = key
+                    continue
+            if len(contents) + len(prefixes) >= max_keys:
+                truncated = True
+                break
+            contents.append(dict(doc["objects"][key], key=key))
+            last_seen = key
+        if truncated:
+            # the token is the LAST RETURNED key: continuation resumes
+            # strictly after it (a first-excluded-key token would skip
+            # that key on the next page)
+            next_token = last_seen
+        return {"contents": contents, "common_prefixes": prefixes,
+                "is_truncated": truncated,
+                "next_token": next_token if truncated else ""}
+
+    # -- lifecycle (rgw_lc.cc role) ----------------------------------------
+
+    async def put_bucket_lifecycle(self, bucket: str,
+                                   rules: List[Dict]) -> None:
+        for rule in rules:
+            if rule.get("status", "Enabled") not in ("Enabled",
+                                                     "Disabled"):
+                raise RGWError("InvalidRequest", "bad rule status")
+            if not any(k in rule for k in
+                       ("expiration_days", "noncurrent_days",
+                        "abort_multipart_days")):
+                raise RGWError("InvalidRequest",
+                               "rule with no action")
+        async with self._meta_lock(self._bucket_oid(bucket)):
+            doc = await self._bucket(bucket)
+            doc["lifecycle"] = list(rules)
+            await self._store(self._bucket_oid(bucket), doc)
+
+    async def get_bucket_lifecycle(self, bucket: str) -> List[Dict]:
+        return (await self._bucket(bucket)).get("lifecycle", [])
+
+    async def lifecycle_process(self,
+                                now: Optional[float] = None
+                                ) -> Dict[str, int]:
+        """One LC sweep over every bucket (RGWLC::process): expire
+        current objects, prune noncurrent versions, drop lone delete
+        markers, abort stale multipart uploads.  `now` is injectable
+        for tests."""
+        now = time.time() if now is None else now
+        stats = {"expired": 0, "noncurrent_pruned": 0,
+                 "markers_removed": 0, "uploads_aborted": 0}
+        for bucket in await self.list_buckets():
+            doc = await self._bucket(bucket)
+            rules = [r for r in doc.get("lifecycle", [])
+                     if r.get("status", "Enabled") == "Enabled"]
+            if not rules:
+                continue
+            for rule in rules:
+                await self._lc_rule(bucket, rule, now, stats)
+        return stats
+
+    async def _lc_rule(self, bucket: str, rule: Dict, now: float,
+                       stats: Dict[str, int]) -> None:
+        prefix = rule.get("prefix", "")
+        day = 86400.0
+        exp = rule.get("expiration_days")
+        if exp is not None:
+            doc = await self._bucket(bucket)
+            for key, ent in list(doc["objects"].items()):
+                if key.startswith(prefix) and \
+                        now - ent.get("mtime", now) > exp * day:
+                    await self.delete_object(bucket, key)
+                    stats["expired"] += 1
+        nc = rule.get("noncurrent_days")
+        if nc is not None:
+            doc = await self._bucket(bucket)
+            for key in list(doc.get("versioned_keys", [])):
+                if not key.startswith(prefix):
+                    continue
+                vdoc = await self._versions(bucket, key)
+                for v in vdoc["versions"][1:]:
+                    if now - v["mtime"] > nc * day:
+                        await self._delete_version(bucket, key,
+                                                   v["version_id"])
+                        stats["noncurrent_pruned"] += 1
+                # a delete marker left as the ONLY version expires
+                # with it (expired-object delete marker cleanup)
+                vdoc = await self._versions(bucket, key)
+                if len(vdoc["versions"]) == 1 and \
+                        vdoc["versions"][0]["delete_marker"]:
+                    await self._delete_version(
+                        bucket, key, vdoc["versions"][0]["version_id"])
+                    stats["markers_removed"] += 1
+        ab = rule.get("abort_multipart_days")
+        if ab is not None:
+            uploads = await self.list_multipart_uploads(bucket)
+            for up in uploads:
+                if up["key"].startswith(prefix) and \
+                        now - up.get("created", now) > ab * day:
+                    await self.abort_multipart(bucket, up["key"],
+                                               up["upload_id"])
+                    stats["uploads_aborted"] += 1
+
+    async def list_multipart_uploads(self, bucket: str) -> List[Dict]:
+        """In-progress uploads for a bucket (ListMultipartUploads)."""
+        prefix = self._meta_oid("multipart", bucket, "")
+        names = await self.meta.list_objects()
+        out = []
+        for n in names:
+            if not n.startswith(prefix):
+                continue
+            doc = await self._load(n)
+            if doc is not None:
+                _, _, key, upload_id = n.split(self._SEP, 3)
+                out.append({"key": key, "upload_id": upload_id,
+                            "created": doc.get("created")})
+        return out
+
     async def list_buckets(self) -> List[str]:
         """ListAllMyBuckets role — the bucket.index objects ARE the
         truth (a separate registry doc could desync on a crash between
@@ -191,7 +447,7 @@ class RGWLite:
         # delete that checked before the link landed
         async with self._meta_lock(self._bucket_oid(bucket)):
             doc = await self._bucket(bucket)
-            if doc["objects"]:
+            if doc["objects"] or doc.get("versioned_keys"):
                 raise RGWError("BucketNotEmpty", bucket)
             await self.meta.remove(self._bucket_oid(bucket))
 
@@ -207,8 +463,16 @@ class RGWLite:
 
     async def put_object(self, bucket: str, key: str,
                          data: bytes) -> str:
-        """Single-shot PUT (RGWPutObj + AtomicObjectProcessor role)."""
-        await self._bucket(bucket)
+        etag, _vid = await self.put_object_ex(bucket, key, data)
+        return etag
+
+    async def put_object_ex(self, bucket: str, key: str,
+                            data: bytes) -> Tuple[str, Optional[str]]:
+        """Single-shot PUT (RGWPutObj + AtomicObjectProcessor role);
+        under versioning every PUT lands as a new immutable version
+        (rgw_op.cc:3712's versioned path).  Returns (etag, version_id)
+        — version_id None on unversioned buckets."""
+        await self._bucket(bucket)  # existence check before the write
         writer = StripeWriter(self.data, self.aio_window)
         prefix = f"{self._head_oid(bucket, key)}.{self._write_id()}"
         proc = PutObjProcessor(writer, prefix, self.stripe_size)
@@ -219,41 +483,120 @@ class RGWLite:
             await writer.cancel()
             raise
         etag = self._etag_from_manifest(manifest, data)
-        await self._link(bucket, key, manifest, etag)
-        return etag
+        return await self._link_by_status(bucket, key, manifest, etag)
 
-    async def _link(self, bucket: str, key: str, manifest: Manifest,
-                    etag: str) -> None:
-        """Flip the head manifest doc + bucket index entry (the bucket
-        index transaction role of AtomicObjectProcessor::complete),
-        then garbage-collect the replaced object's stripes (the GC
-        list role)."""
-        head_doc = self._meta_oid("head", bucket, key)
-        # old-head read, head store and index entry ALL under the
-        # bucket lock: a concurrent PUT to the same key must observe
-        # the winner's head (or the winner observes its), or the
-        # loser's stripes are never referenced and never GC'd; a
-        # concurrent delete_bucket (same lock) can never strand an
-        # orphaned head doc either
+    async def _link_by_status(self, bucket: str, key: str,
+                              manifest: Manifest, etag: str
+                              ) -> Tuple[str, Optional[str]]:
+        """Link a finished upload under ONE bucket lock, adjudicating
+        the versioning status AT LINK TIME — a versioning flip during
+        the (long) stripe upload must not split-brain the key into a
+        head doc coexisting with a versions doc.  Shared by atomic PUT
+        and multipart completion."""
         async with self._meta_lock(self._bucket_oid(bucket)):
             doc = await self._bucket(bucket)
-            old = await self._load(head_doc)
-            await self._store(head_doc, {"manifest": manifest.to_dict(),
-                                         "etag": etag})
-            doc["objects"][key] = {"size": manifest.obj_size,
-                                   "etag": etag, "mtime": time.time()}
-            await self._store(self._bucket_oid(bucket), doc)
+            status = doc.get("versioning", VER_OFF)
+            vdoc = await self._versions(bucket, key)
+            if status == VER_OFF and not vdoc["versions"]:
+                await self._link_locked(doc, bucket, key, manifest,
+                                        etag)
+                return etag, None
+            # versioned path — also when the key ALREADY has versions
+            # with versioning since switched off: existing versions
+            # must never be silently clobbered by a head doc
+            vid = await self._link_version_locked(
+                doc, vdoc, bucket, key, manifest, etag,
+                null_version=(status != VER_ENABLED))
+            return etag, vid
+
+    async def _link_locked(self, doc: Dict, bucket: str, key: str,
+                           manifest: Manifest, etag: str) -> None:
+        """Unversioned head flip + index entry (the bucket index
+        transaction role of AtomicObjectProcessor::complete); caller
+        holds the bucket lock.  Replaced stripes go to deferred GC."""
+        head_doc = self._meta_oid("head", bucket, key)
+        old = await self._load(head_doc)
+        await self._store(head_doc, {"manifest": manifest.to_dict(),
+                                     "etag": etag})
+        doc["objects"][key] = {"size": manifest.obj_size,
+                               "etag": etag, "mtime": time.time()}
+        await self._store(self._bucket_oid(bucket), doc)
         if old is not None:
             new_oids = {s["oid"] for s in manifest.stripes}
-            for stripe in old["manifest"]["stripes"]:
-                if stripe["oid"] not in new_oids:
-                    try:
-                        await self.data.remove(stripe["oid"])
-                    except Exception:
-                        pass
+            await self._gc_defer(
+                stripe["oid"] for stripe in old["manifest"]["stripes"]
+                if stripe["oid"] not in new_oids)
 
-    async def _manifest(self, bucket: str, key: str) -> Tuple[Manifest,
-                                                              str]:
+    async def _migrate_legacy_head(self, bucket: str,
+                                   key: str) -> List[Dict]:
+        """First versioned write to a pre-versioning key: fold the
+        legacy head into a "null" version so it stays addressable."""
+        head = await self._load(self._meta_oid("head", bucket, key))
+        if head is None:
+            return []
+        await self.meta.remove(self._meta_oid("head", bucket, key))
+        return [{"version_id": "null", "etag": head["etag"],
+                 "manifest": head["manifest"],
+                 "size": head["manifest"]["obj_size"],
+                 "mtime": time.time(), "delete_marker": False}]
+
+    async def _link_version_locked(self, doc: Dict, vdoc: Dict,
+                                   bucket: str, key: str,
+                                   manifest: Manifest, etag: str,
+                                   null_version: bool) -> str:
+        vid = "null" if null_version else self._new_version_id()
+        entry = {"version_id": vid, "etag": etag,
+                 "manifest": manifest.to_dict(),
+                 "size": manifest.obj_size, "mtime": time.time(),
+                 "delete_marker": False}
+        if not vdoc["versions"]:
+            vdoc["versions"] = await self._migrate_legacy_head(
+                bucket, key)
+        if null_version:
+            # suspended: the new null version REPLACES a previous
+            # null (its stripes go to GC); other versions survive
+            for old in vdoc["versions"]:
+                if old["version_id"] == "null" and \
+                        not old["delete_marker"]:
+                    await self._gc_defer(
+                        st["oid"]
+                        for st in old["manifest"]["stripes"])
+            vdoc["versions"] = [v for v in vdoc["versions"]
+                                if v["version_id"] != "null"]
+        vdoc["versions"].insert(0, entry)
+        await self._store(self._versions_oid(bucket, key), vdoc)
+        doc["objects"][key] = {"size": manifest.obj_size,
+                               "etag": etag, "mtime": entry["mtime"]}
+        vk = set(doc.setdefault("versioned_keys", []))
+        vk.add(key)
+        doc["versioned_keys"] = sorted(vk)
+        await self._store(self._bucket_oid(bucket), doc)
+        return vid
+
+    async def _manifest(self, bucket: str, key: str,
+                        version_id: Optional[str] = None
+                        ) -> Tuple[Manifest, str]:
+        vdoc = await self._load(self._versions_oid(bucket, key))
+        if vdoc is not None and vdoc["versions"]:
+            if version_id is None:
+                newest = vdoc["versions"][0]
+                if newest["delete_marker"]:
+                    raise RGWError("NoSuchKey",
+                                   f"{bucket}/{key} (delete marker)")
+                entry = newest
+            else:
+                entry = next((v for v in vdoc["versions"]
+                              if v["version_id"] == version_id), None)
+                if entry is None:
+                    raise RGWError("NoSuchVersion",
+                                   f"{bucket}/{key}@{version_id}")
+                if entry["delete_marker"]:
+                    raise RGWError("MethodNotAllowed",
+                                   "version is a delete marker")
+            return Manifest.from_dict(entry["manifest"]), entry["etag"]
+        if version_id is not None and version_id != "null":
+            raise RGWError("NoSuchVersion",
+                           f"{bucket}/{key}@{version_id}")
         head = await self._load(self._meta_oid("head", bucket, key))
         if head is None:
             raise RGWError("NoSuchKey", f"{bucket}/{key}")
@@ -263,13 +606,14 @@ class RGWLite:
         data, _etag_ = await self.get_object_ex(bucket, key)
         return data
 
-    async def get_object_ex(self, bucket: str,
-                            key: str) -> Tuple[bytes, str]:
+    async def get_object_ex(self, bucket: str, key: str,
+                            version_id: Optional[str] = None
+                            ) -> Tuple[bytes, str]:
         """GET: walk the manifest, fetch stripes concurrently;
         returns (bytes, etag) from ONE head load."""
         import asyncio
 
-        manifest, etag = await self._manifest(bucket, key)
+        manifest, etag = await self._manifest(bucket, key, version_id)
         sem = asyncio.Semaphore(self.aio_window)
 
         async def fetch(stripe: Dict) -> bytes:
@@ -285,18 +629,143 @@ class RGWLite:
                            f"{len(out)} != {manifest.obj_size}")
         return out, etag
 
-    async def delete_object(self, bucket: str, key: str) -> None:
-        manifest, _ = await self._manifest(bucket, key)
-        for stripe in manifest.stripes:
-            try:
-                await self.data.remove(stripe["oid"])
-            except Exception:
-                pass
-        await self.meta.remove(self._meta_oid("head", bucket, key))
+    async def delete_object(self, bucket: str, key: str,
+                            version_id: Optional[str] = None
+                            ) -> Optional[str]:
+        """DELETE, adjudicated under ONE bucket lock.  Unversioned:
+        drop the object (stripes deferred to GC).  Versioning enabled
+        + no versionId: insert a DELETE MARKER (versions survive).
+        versionId given: permanently remove that version — "null"
+        addresses a never-versioned object too; anything else on an
+        unversioned key is NoSuchVersion (rgw_op.cc RGWDeleteObj
+        versioned semantics).  Returns the delete marker's version id
+        when one was created."""
         async with self._meta_lock(self._bucket_oid(bucket)):
             doc = await self._bucket(bucket)
+            status = doc.get("versioning", VER_OFF)
+            vdoc = await self._versions(bucket, key)
+            versioned = bool(vdoc["versions"])
+            if version_id is not None:
+                if versioned:
+                    self._drop_version_locked(vdoc, version_id)
+                    await self._finish_versions_locked(doc, bucket,
+                                                       key, vdoc)
+                    return None
+                if version_id != "null":
+                    raise RGWError("NoSuchVersion",
+                                   f"{bucket}/{key}@{version_id}")
+                # versionId=null on a never-versioned key: the plain
+                # object IS the null version — permanent delete
+                await self._delete_unversioned_locked(doc, bucket,
+                                                      key)
+                return None
+            if status == VER_ENABLED:
+                if not vdoc["versions"]:
+                    vdoc["versions"] = \
+                        await self._migrate_legacy_head(bucket, key)
+                    if not vdoc["versions"]:
+                        raise RGWError("NoSuchKey", f"{bucket}/{key}")
+                marker = {"version_id": self._new_version_id(),
+                          "etag": "", "manifest": None, "size": 0,
+                          "mtime": time.time(), "delete_marker": True}
+                vdoc["versions"].insert(0, marker)
+                await self._store(self._versions_oid(bucket, key),
+                                  vdoc)
+                doc["objects"].pop(key, None)
+                vk = set(doc.setdefault("versioned_keys", []))
+                vk.add(key)
+                doc["versioned_keys"] = sorted(vk)
+                await self._store(self._bucket_oid(bucket), doc)
+                return marker["version_id"]
+            if versioned:
+                # suspended: remove the null version and leave a null
+                # delete marker, in ONE locked mutation (S3 suspended
+                # semantics; a two-lock version let a concurrent null
+                # PUT interleave and duplicate the null id)
+                self._drop_version_locked(vdoc, "null",
+                                          missing_ok=True)
+                gc = vdoc.pop("_gc", [])
+                if gc:
+                    await self._gc_defer(gc)
+                marker = {"version_id": "null", "etag": "",
+                          "manifest": None, "size": 0,
+                          "mtime": time.time(), "delete_marker": True}
+                vdoc["versions"].insert(0, marker)
+                await self._store(self._versions_oid(bucket, key),
+                                  vdoc)
+                doc["objects"].pop(key, None)
+                await self._store(self._bucket_oid(bucket), doc)
+                return "null"
+            await self._delete_unversioned_locked(doc, bucket, key)
+            return None
+
+    async def _delete_unversioned_locked(self, doc: Dict, bucket: str,
+                                         key: str) -> None:
+        head = await self._load(self._meta_oid("head", bucket, key))
+        if head is None:
+            raise RGWError("NoSuchKey", f"{bucket}/{key}")
+        await self._gc_defer(st["oid"]
+                             for st in head["manifest"]["stripes"])
+        await self.meta.remove(self._meta_oid("head", bucket, key))
+        doc["objects"].pop(key, None)
+        await self._store(self._bucket_oid(bucket), doc)
+
+    def _drop_version_locked(self, vdoc: Dict, version_id: str,
+                             missing_ok: bool = False) -> None:
+        """Remove one version from an in-memory vdoc, deferring its
+        stripes; caller persists + refreshes the index."""
+        entry = next((v for v in vdoc["versions"]
+                      if v["version_id"] == version_id), None)
+        if entry is None:
+            if missing_ok:
+                return
+            raise RGWError("NoSuchVersion", version_id)
+        vdoc["versions"] = [v for v in vdoc["versions"]
+                            if v["version_id"] != version_id]
+        if entry["manifest"] is not None:
+            vdoc.setdefault("_gc", []).extend(
+                st["oid"] for st in entry["manifest"]["stripes"])
+
+    async def _finish_versions_locked(self, doc: Dict, bucket: str,
+                                      key: str, vdoc: Dict) -> None:
+        """Persist a mutated vdoc + refresh the bucket index; flush
+        any stripes _drop_version_locked queued."""
+        gc = vdoc.pop("_gc", [])
+        if gc:
+            await self._gc_defer(gc)
+        if vdoc["versions"]:
+            await self._store(self._versions_oid(bucket, key), vdoc)
+        else:
+            try:
+                await self.meta.remove(self._versions_oid(bucket,
+                                                          key))
+            except Exception:
+                pass
+            vk = set(doc.get("versioned_keys", []))
+            vk.discard(key)
+            doc["versioned_keys"] = sorted(vk)
+        # refresh the plain listing: newest surviving non-marker
+        newest = next((v for v in vdoc["versions"]
+                       if not v["delete_marker"]), None)
+        newest_is_head = vdoc["versions"] and \
+            vdoc["versions"][0] is newest
+        if newest is not None and newest_is_head:
+            doc["objects"][key] = {"size": newest["size"],
+                                   "etag": newest["etag"],
+                                   "mtime": newest["mtime"]}
+        else:
             doc["objects"].pop(key, None)
-            await self._store(self._bucket_oid(bucket), doc)
+        await self._store(self._bucket_oid(bucket), doc)
+
+    async def _delete_version(self, bucket: str, key: str,
+                              version_id: str,
+                              missing_ok: bool = False) -> None:
+        """Public per-version delete (lock-acquiring wrapper)."""
+        async with self._meta_lock(self._bucket_oid(bucket)):
+            doc = await self._bucket(bucket)
+            vdoc = await self._versions(bucket, key)
+            self._drop_version_locked(vdoc, version_id, missing_ok)
+            await self._finish_versions_locked(doc, bucket, key, vdoc)
 
     # -- multipart ---------------------------------------------------------
 
@@ -307,7 +776,7 @@ class RGWLite:
         upload_id = f"u{self._uploads}-{int(time.time() * 1000):x}"
         await self._store(self._upload_oid(bucket, key, upload_id),
                           {"bucket": bucket, "key": key,
-                           "parts": {}})
+                           "created": time.time(), "parts": {}})
         return upload_id
 
     async def _upload(self, bucket: str, key: str,
@@ -387,7 +856,11 @@ class RGWLite:
         # part md5 DIGESTS (raw bytes, not hex), suffixed "-<nparts>"
         combined = _etag(b"".join(
             bytes.fromhex(e) for e in etags)) + f"-{len(parts)}"
-        await self._link(bucket, key, stitched, combined)
+        # versioning adjudicated at link time, same as atomic PUT —
+        # a multipart completion on a versioned bucket lands as a
+        # version, never as a stray head doc
+        _etag_, _vid = await self._link_by_status(bucket, key,
+                                                  stitched, combined)
         await self.meta.remove(self._upload_oid(bucket, key, upload_id))
         return combined
 

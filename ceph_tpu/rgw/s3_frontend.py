@@ -40,10 +40,20 @@ _ERR_STATUS = {
     "NoSuchBucket": 404, "NoSuchKey": 404, "NoSuchUpload": 404,
     "BucketAlreadyExists": 409, "BucketNotEmpty": 409,
     "InvalidPart": 400, "InvalidPartOrder": 400,
-    "InvalidRequest": 400, "AccessDenied": 403,
+    "InvalidRequest": 400, "InvalidArgument": 400,
+    "MalformedXML": 400, "NoSuchVersion": 404,
+    "MethodNotAllowed": 405, "AccessDenied": 403,
     "RequestTimeTooSkewed": 403,
     "SignatureDoesNotMatch": 403, "InternalError": 500,
 }
+
+
+def _int_or_400(text, what: str) -> int:
+    """Malformed numeric client input is a 400, not a stack trace."""
+    try:
+        return int(text)
+    except (TypeError, ValueError):
+        raise _HttpError("InvalidArgument", f"bad {what}: {text!r}")
 
 
 class _HttpError(Exception):
@@ -240,7 +250,7 @@ class S3Frontend:
                     return await self._list_buckets()
                 raise _HttpError("InvalidRequest", "no bucket")
             if not key:
-                return await self._bucket_op(method, bucket, q)
+                return await self._bucket_op(method, bucket, q, body)
             return await self._object_op(method, bucket, key, q,
                                          headers, body)
         except _HttpError as e:
@@ -273,13 +283,115 @@ class S3Frontend:
             ET.SubElement(b, "Name").text = name
         return self._xml(root)
 
-    async def _bucket_op(self, method: str, bucket: str, q: Dict):
+    async def _bucket_op(self, method: str, bucket: str, q: Dict,
+                         body: bytes = b""):
+        if method == "PUT" and "versioning" in q:
+            try:
+                root = ET.fromstring(body)
+            except ET.ParseError:
+                raise _HttpError("MalformedXML", "bad versioning xml")
+            st_el = next((c for c in root
+                          if c.tag.endswith("Status")), None)
+            if st_el is None:
+                # legal S3: a VersioningConfiguration with no Status
+                # means "no change" — never silently suspend
+                return 200, {}, b""
+            if st_el.text == "Enabled":
+                status = "enabled"
+            elif st_el.text == "Suspended":
+                status = "suspended"
+            else:
+                raise _HttpError("MalformedXML",
+                                 f"bad Status {st_el.text!r}")
+            await self.rgw.put_bucket_versioning(bucket, status)
+            return 200, {}, b""
+        if method == "GET" and "versioning" in q:
+            status = await self.rgw.get_bucket_versioning(bucket)
+            root = ET.Element("VersioningConfiguration")
+            if status != "off":
+                ET.SubElement(root, "Status").text = \
+                    "Enabled" if status == "enabled" else "Suspended"
+            return self._xml(root)
+        if method == "PUT" and "lifecycle" in q:
+            await self.rgw.put_bucket_lifecycle(
+                bucket, self._parse_lifecycle(body))
+            return 200, {}, b""
+        if method == "GET" and "lifecycle" in q:
+            rules = await self.rgw.get_bucket_lifecycle(bucket)
+            root = ET.Element("LifecycleConfiguration")
+            for r in rules:
+                rule = ET.SubElement(root, "Rule")
+                ET.SubElement(rule, "ID").text = r.get("id", "")
+                ET.SubElement(rule, "Prefix").text = \
+                    r.get("prefix", "")
+                ET.SubElement(rule, "Status").text = \
+                    r.get("status", "Enabled")
+                if "expiration_days" in r:
+                    e = ET.SubElement(rule, "Expiration")
+                    ET.SubElement(e, "Days").text = \
+                        str(r["expiration_days"])
+                if "noncurrent_days" in r:
+                    e = ET.SubElement(rule,
+                                      "NoncurrentVersionExpiration")
+                    ET.SubElement(e, "NoncurrentDays").text = \
+                        str(r["noncurrent_days"])
+                if "abort_multipart_days" in r:
+                    e = ET.SubElement(rule,
+                                      "AbortIncompleteMultipartUpload")
+                    ET.SubElement(e, "DaysAfterInitiation").text = \
+                        str(r["abort_multipart_days"])
+            return self._xml(root)
+        if method == "GET" and "versions" in q:
+            entries = await self.rgw.list_object_versions(
+                bucket, prefix=q.get("prefix", ""))
+            root = ET.Element("ListVersionsResult")
+            ET.SubElement(root, "Name").text = bucket
+            for e in entries:
+                tag = "DeleteMarker" if e["delete_marker"] \
+                    else "Version"
+                v = ET.SubElement(root, tag)
+                ET.SubElement(v, "Key").text = e["key"]
+                ET.SubElement(v, "VersionId").text = e["version_id"]
+                if not e["delete_marker"]:
+                    ET.SubElement(v, "Size").text = str(e["size"])
+                    ET.SubElement(v, "ETag").text = \
+                        f"\"{e['etag']}\""
+            return self._xml(root)
         if method == "PUT":
             await self.rgw.create_bucket(bucket)
             return 200, {}, b""
         if method == "DELETE":
             await self.rgw.delete_bucket(bucket)
             return 204, {}, b""
+        if method in ("GET", "HEAD") and q.get("list-type") == "2":
+            try:
+                max_keys = int(q.get("max-keys", "1000"))
+            except ValueError:
+                raise _HttpError("InvalidArgument", "bad max-keys")
+            res = await self.rgw.list_objects_v2(
+                bucket, prefix=q.get("prefix", ""),
+                delimiter=q.get("delimiter", ""),
+                continuation_token=q.get("continuation-token", ""),
+                max_keys=max_keys)
+            root = ET.Element("ListBucketResult")
+            ET.SubElement(root, "Name").text = bucket
+            ET.SubElement(root, "KeyCount").text = \
+                str(len(res["contents"]) + len(res["common_prefixes"]))
+            ET.SubElement(root, "IsTruncated").text = \
+                "true" if res["is_truncated"] else "false"
+            if res["next_token"]:
+                ET.SubElement(root, "NextContinuationToken").text = \
+                    res["next_token"]
+            for e in res["contents"]:
+                c = ET.SubElement(root, "Contents")
+                ET.SubElement(c, "Key").text = e["key"]
+                ET.SubElement(c, "Size").text = str(e.get("size", 0))
+                ET.SubElement(c, "ETag").text = \
+                    f"\"{e.get('etag', '')}\""
+            for p in res["common_prefixes"]:
+                cp = ET.SubElement(root, "CommonPrefixes")
+                ET.SubElement(cp, "Prefix").text = p
+            return self._xml(root)
         if method in ("GET", "HEAD"):
             entries = await self.rgw.list_objects(
                 bucket, prefix=q.get("prefix", ""))
@@ -294,6 +406,44 @@ class S3Frontend:
                     f"\"{e.get('etag', '')}\""
             return self._xml(root)
         raise _HttpError("InvalidRequest", method)
+
+    @staticmethod
+    def _parse_lifecycle(body: bytes):
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise _HttpError("InvalidRequest", "bad lifecycle xml")
+        rules = []
+        for rel in root:
+            if not rel.tag.endswith("Rule"):
+                continue
+            rule = {}
+            for child in rel:
+                tag = child.tag.rsplit("}", 1)[-1]
+                if tag == "ID":
+                    rule["id"] = child.text or ""
+                elif tag == "Prefix":
+                    rule["prefix"] = child.text or ""
+                elif tag == "Status":
+                    rule["status"] = child.text or "Enabled"
+                elif tag == "Expiration":
+                    for d in child:
+                        if d.tag.endswith("Days"):
+                            rule["expiration_days"] = \
+                                _int_or_400(d.text, "Days")
+                elif tag == "NoncurrentVersionExpiration":
+                    for d in child:
+                        if d.tag.endswith("NoncurrentDays"):
+                            rule["noncurrent_days"] = \
+                                _int_or_400(d.text, "NoncurrentDays")
+                elif tag == "AbortIncompleteMultipartUpload":
+                    for d in child:
+                        if d.tag.endswith("DaysAfterInitiation"):
+                            rule["abort_multipart_days"] = \
+                                _int_or_400(d.text,
+                                            "DaysAfterInitiation")
+            rules.append(rule)
+        return rules
 
     async def _object_op(self, method: str, bucket: str, key: str,
                          q: Dict, headers: Dict, body: bytes):
@@ -326,8 +476,11 @@ class S3Frontend:
             await rgw.abort_multipart(bucket, key, q["uploadId"])
             return 204, {}, b""
         if method == "PUT":
-            etag = await rgw.put_object(bucket, key, body)
-            return 200, {"ETag": f"\"{etag}\""}, b""
+            etag, vid = await rgw.put_object_ex(bucket, key, body)
+            hdrs = {"ETag": f"\"{etag}\""}
+            if vid is not None:
+                hdrs["x-amz-version-id"] = vid
+            return 200, hdrs, b""
         if method == "HEAD":
             head = await rgw.head_object(bucket, key)
             return 200, {"ETag": f"\"{head.get('etag', '')}\"",
@@ -335,13 +488,19 @@ class S3Frontend:
                          "Content-Length": str(head.get("size", 0))
                          }, b""
         if method == "GET":
-            data, etag = await rgw.get_object_ex(bucket, key)
+            data, etag = await rgw.get_object_ex(
+                bucket, key, version_id=q.get("versionId"))
             return 200, {"ETag": f"\"{etag}\"",
                          "Content-Type": "application/octet-stream",
                          "Content-Length": str(len(data))}, data
         if method == "DELETE":
-            await rgw.delete_object(bucket, key)
-            return 204, {}, b""
+            marker = await rgw.delete_object(
+                bucket, key, version_id=q.get("versionId"))
+            hdrs = {}
+            if marker is not None:
+                hdrs["x-amz-delete-marker"] = "true"
+                hdrs["x-amz-version-id"] = marker
+            return 204, hdrs, b""
         raise _HttpError("InvalidRequest", method)
 
     @staticmethod

@@ -1,0 +1,400 @@
+"""RGW versioning + lifecycle + GC + ListObjectsV2.
+
+Reference parity shapes: RGWPutObj under versioning
+(/root/reference/src/rgw/rgw_op.cc:3712), delete markers and
+per-version addressing (RGWDeleteObj), lifecycle expiration sweeps
+(rgw_lc.cc), deferred data GC (rgw_gc.cc), and v2 bucket listing
+(RGWListBucket).  A curl-if-available leg drives the HTTP frontend
+with an INDEPENDENT sigv4 implementation (stock curl --aws-sigv4).
+"""
+
+import asyncio
+import shutil
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from cluster_helpers import Cluster
+
+from ceph_tpu.rgw import RGWLite
+from ceph_tpu.rgw.gateway import RGWError
+from ceph_tpu.rgw.s3_frontend import S3Frontend
+
+ACCESS, SECRET = "AKIDEXAMPLE", "s3cr3t-key-for-tests"
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 180))
+
+
+async def _rgw(cluster):
+    await cluster.client.create_replicated_pool("meta", size=2,
+                                                pg_num=4)
+    await cluster.client.create_replicated_pool("data", size=2,
+                                                pg_num=4)
+    return RGWLite(cluster.client, "data", "meta",
+                   stripe_size=64 * 1024)
+
+
+def test_versioned_put_get_delete_cycle():
+    async def main():
+        cluster = Cluster(num_osds=4, osds_per_host=2)
+        await cluster.start()
+        try:
+            rgw = await _rgw(cluster)
+            await rgw.create_bucket("b")
+            # pre-versioning object becomes the "null" version
+            await rgw.put_object("b", "k", b"gen0")
+            await rgw.put_bucket_versioning("b", "enabled")
+            assert await rgw.get_bucket_versioning("b") == "enabled"
+            _, v1 = await rgw.put_object_ex("b", "k", b"gen1")
+            _, v2 = await rgw.put_object_ex("b", "k", b"gen2")
+            assert v1 and v2 and v1 != v2
+            # newest wins; every version stays addressable
+            assert await rgw.get_object("b", "k") == b"gen2"
+            assert (await rgw.get_object_ex("b", "k", v1))[0] == b"gen1"
+            assert (await rgw.get_object_ex(
+                "b", "k", "null"))[0] == b"gen0"
+            # plain DELETE inserts a marker; GET turns NoSuchKey but
+            # versions survive
+            marker = await rgw.delete_object("b", "k")
+            assert marker is not None
+            with pytest.raises(RGWError):
+                await rgw.get_object("b", "k")
+            assert (await rgw.get_object_ex("b", "k", v2))[0] == b"gen2"
+            versions = await rgw.list_object_versions("b")
+            kinds = [(v["version_id"], v["delete_marker"])
+                     for v in versions]
+            assert kinds[0] == (marker, True)
+            assert len(versions) == 4  # marker + gen2 + gen1 + null
+            # deleting the MARKER undeletes (newest again visible)
+            await rgw.delete_object("b", "k", version_id=marker)
+            assert await rgw.get_object("b", "k") == b"gen2"
+            # permanent per-version delete
+            await rgw.delete_object("b", "k", version_id=v2)
+            assert await rgw.get_object("b", "k") == b"gen1"
+            # bucket with versions refuses deletion
+            with pytest.raises(RGWError):
+                await rgw.delete_bucket("b")
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_suspended_versioning_null_replacement():
+    async def main():
+        cluster = Cluster(num_osds=4, osds_per_host=2)
+        await cluster.start()
+        try:
+            rgw = await _rgw(cluster)
+            await rgw.create_bucket("b")
+            await rgw.put_bucket_versioning("b", "enabled")
+            _, v1 = await rgw.put_object_ex("b", "k", b"kept")
+            await rgw.put_bucket_versioning("b", "suspended")
+            _, n1 = await rgw.put_object_ex("b", "k", b"null-1")
+            _, n2 = await rgw.put_object_ex("b", "k", b"null-2")
+            assert n1 == n2 == "null"
+            # the second null REPLACED the first; v1 survives
+            versions = await rgw.list_object_versions("b")
+            vids = [v["version_id"] for v in versions]
+            assert vids.count("null") == 1 and v1 in vids
+            assert await rgw.get_object("b", "k") == b"null-2"
+            assert (await rgw.get_object_ex("b", "k", v1))[0] == b"kept"
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_gc_defers_and_drains():
+    async def main():
+        cluster = Cluster(num_osds=4, osds_per_host=2)
+        await cluster.start()
+        try:
+            rgw = await _rgw(cluster)
+            await rgw.create_bucket("b")
+            await rgw.put_object("b", "k", b"A" * 100_000)
+            await rgw.put_object("b", "k", b"B" * 100_000)  # replace
+            await rgw.delete_object("b", "k")
+            # replaced + deleted stripes are queued, not yet gone
+            names_before = await rgw.data.list_objects()
+            assert names_before, "stripes should still exist pre-GC"
+            n = await rgw.gc_process()
+            assert n >= 2
+            assert await rgw.data.list_objects() == []
+            assert await rgw.gc_process() == 0  # idempotent drain
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_lifecycle_sweep():
+    async def main():
+        cluster = Cluster(num_osds=4, osds_per_host=2)
+        await cluster.start()
+        try:
+            rgw = await _rgw(cluster)
+            await rgw.create_bucket("b")
+            await rgw.put_bucket_versioning("b", "enabled")
+            await rgw.put_object_ex("b", "logs/old", b"ancient")
+            await rgw.put_object_ex("b", "logs/old", b"current")
+            await rgw.put_object_ex("b", "keep/x", b"kept")
+            up = await rgw.init_multipart("b", "logs/stale-upload")
+            await rgw.put_bucket_lifecycle("b", [
+                {"id": "expire-logs", "prefix": "logs/",
+                 "status": "Enabled", "expiration_days": 7,
+                 "noncurrent_days": 3, "abort_multipart_days": 2}])
+            # nothing is old enough yet
+            stats = await rgw.lifecycle_process()
+            assert stats["expired"] == 0
+            assert stats["uploads_aborted"] == 0
+            # jump 10 days into the future
+            future = time.time() + 10 * 86400
+            stats = await rgw.lifecycle_process(now=future)
+            assert stats["expired"] == 1          # logs/old current
+            assert stats["noncurrent_pruned"] >= 1
+            # the expiration's delete marker, left as the only
+            # version, is cleaned up in the same sweep
+            assert stats["markers_removed"] >= 1
+            assert stats["uploads_aborted"] == 1
+            with pytest.raises(RGWError):
+                await rgw._upload("b", "logs/stale-upload", up)
+            assert await rgw.list_object_versions("b", "logs/") == []
+            # untouched prefix survives
+            assert await rgw.get_object("b", "keep/x") == b"kept"
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_list_objects_v2_semantics():
+    async def main():
+        cluster = Cluster(num_osds=4, osds_per_host=2)
+        await cluster.start()
+        try:
+            rgw = await _rgw(cluster)
+            await rgw.create_bucket("b")
+            for key in ("a.txt", "dir/one", "dir/two", "dir2/x",
+                        "z.txt"):
+                await rgw.put_object("b", key, b"x")
+            res = await rgw.list_objects_v2("b", delimiter="/")
+            assert [c["key"] for c in res["contents"]] == \
+                ["a.txt", "z.txt"]
+            assert res["common_prefixes"] == ["dir/", "dir2/"]
+            assert not res["is_truncated"]
+            # prefix + delimiter descends one level
+            res = await rgw.list_objects_v2("b", prefix="dir/",
+                                            delimiter="/")
+            assert [c["key"] for c in res["contents"]] == \
+                ["dir/one", "dir/two"]
+            # pagination with continuation tokens covers everything
+            got, token = [], ""
+            while True:
+                res = await rgw.list_objects_v2(
+                    "b", continuation_token=token, max_keys=2)
+                got.extend(c["key"] for c in res["contents"])
+                if not res["is_truncated"]:
+                    break
+                token = res["next_token"]
+            assert got == ["a.txt", "dir/one", "dir/two", "dir2/x",
+                           "z.txt"]
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_http_versioning_and_v2_listing():
+    """The same semantics through the HTTP frontend (sigv4)."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_s3_http import MiniS3
+
+    async def main():
+        cluster = Cluster(num_osds=4, osds_per_host=2)
+        await cluster.start()
+        front = None
+        client = None
+        try:
+            rgw = await _rgw(cluster)
+            front = S3Frontend(rgw, {ACCESS: SECRET})
+            addr = await front.start()
+            client = MiniS3(addr)
+            st, _, _ = await client.request("PUT", "/vb")
+            assert st == 200
+            st, _, _ = await client.request(
+                "PUT", "/vb", {"versioning": ""},
+                b"<VersioningConfiguration><Status>Enabled</Status>"
+                b"</VersioningConfiguration>")
+            assert st == 200
+            st, h1, _ = await client.request("PUT", "/vb/k",
+                                             body=b"one")
+            st, h2, _ = await client.request("PUT", "/vb/k",
+                                             body=b"two")
+            v1 = h1["x-amz-version-id"]
+            assert st == 200 and v1 != h2["x-amz-version-id"]
+            st, _, body = await client.request(
+                "GET", "/vb/k", {"versionId": v1})
+            assert body == b"one"
+            st, hdrs, _ = await client.request("DELETE", "/vb/k")
+            assert hdrs.get("x-amz-delete-marker") == "true"
+            st, _, _ = await client.request("GET", "/vb/k")
+            assert st == 404
+            st, _, body = await client.request(
+                "GET", "/vb", {"versions": ""})
+            assert b"DeleteMarker" in body and b"<Version>" in body
+            # v2 listing with delimiter through HTTP
+            for key in ("d/x", "d/y", "top"):
+                await client.request("PUT", f"/vb/{key}", body=b"z")
+            st, _, body = await client.request(
+                "GET", "/vb", {"list-type": "2", "delimiter": "/"})
+            assert b"<Prefix>d/</Prefix>" in body
+            assert b"<Key>top</Key>" in body
+            # lifecycle round-trip through HTTP
+            st, _, _ = await client.request(
+                "PUT", "/vb", {"lifecycle": ""},
+                b"<LifecycleConfiguration><Rule><ID>r1</ID>"
+                b"<Prefix>d/</Prefix><Status>Enabled</Status>"
+                b"<Expiration><Days>5</Days></Expiration>"
+                b"</Rule></LifecycleConfiguration>")
+            assert st == 200
+            st, _, body = await client.request(
+                "GET", "/vb", {"lifecycle": ""})
+            assert b"<Days>5</Days>" in body
+        finally:
+            if client:
+                await client.close()
+            if front:
+                await front.stop()
+            await cluster.stop()
+
+    run(main())
+
+
+@pytest.mark.skipif(shutil.which("curl") is None,
+                    reason="curl not available")
+def test_curl_interop_leg():
+    """Interop with an INDEPENDENT sigv4 implementation: stock curl
+    --aws-sigv4 drives PUT/GET/DELETE + versioning against the
+    frontend (the reproducible form of round 4's hand validation)."""
+    async def main():
+        cluster = Cluster(num_osds=4, osds_per_host=2)
+        await cluster.start()
+        front = None
+        try:
+            rgw = await _rgw(cluster)
+            front = S3Frontend(rgw, {ACCESS: SECRET})
+            addr = await front.start()
+
+            def curl(method, path, data=None, extra=()):
+                cmd = ["curl", "-s", "-o", "-", "-w",
+                       "\n%{http_code}", "-X", method,
+                       "--aws-sigv4", "aws:amz:us-east-1:s3",
+                       "--user", f"{ACCESS}:{SECRET}",
+                       f"http://{addr}{path}", *extra]
+                if data is not None:
+                    cmd += ["--data-binary", data]
+                out = subprocess.run(cmd, capture_output=True,
+                                     timeout=30)
+                body, _, code = out.stdout.rpartition(b"\n")
+                return int(code), body
+
+            loop = asyncio.get_running_loop()
+
+            async def acurl(*a, **k):
+                return await loop.run_in_executor(
+                    None, lambda: curl(*a, **k))
+
+            code, _ = await acurl("PUT", "/curlb")
+            assert code == 200
+            code, _ = await acurl("PUT", "/curlb/hello",
+                                  data="payload-from-curl")
+            assert code == 200
+            code, body = await acurl("GET", "/curlb/hello")
+            assert code == 200 and body == b"payload-from-curl"
+            code, body = await acurl("GET", "/curlb",
+                                     extra=["-G", "-d",
+                                            "list-type=2"])
+            assert code == 200 and b"<Key>hello</Key>" in body
+            code, _ = await acurl("DELETE", "/curlb/hello")
+            assert code == 204
+            code, _ = await acurl("GET", "/curlb/hello")
+            assert code == 404
+            # a WRONG secret must be rejected by the verifier
+            out = await loop.run_in_executor(None, lambda: subprocess.run(
+                ["curl", "-s", "-o", "/dev/null", "-w", "%{http_code}",
+                 "--aws-sigv4", "aws:amz:us-east-1:s3",
+                 "--user", f"{ACCESS}:wrong-secret",
+                 f"http://{addr}/curlb"],
+                capture_output=True, timeout=30))
+            assert out.stdout.strip() == b"403"
+        finally:
+            if front:
+                await front.stop()
+            await cluster.stop()
+
+    run(main())
+
+
+def test_multipart_complete_respects_versioning():
+    """A multipart completion on a versioning-enabled bucket must land
+    as a version (review finding: it wrote a legacy head, orphaning
+    the multipart data behind the versions doc)."""
+    async def main():
+        cluster = Cluster(num_osds=4, osds_per_host=2)
+        await cluster.start()
+        try:
+            rgw = await _rgw(cluster)
+            await rgw.create_bucket("b")
+            await rgw.put_bucket_versioning("b", "enabled")
+            _, v1 = await rgw.put_object_ex("b", "k", b"atomic-gen")
+            up = await rgw.init_multipart("b", "k")
+            payload = bytes(np.random.default_rng(4).integers(
+                0, 256, 200_000, dtype=np.uint8))
+            e1 = await rgw.upload_part("b", "k", up, 1,
+                                       payload[:100_000])
+            e2 = await rgw.upload_part("b", "k", up, 2,
+                                       payload[100_000:])
+            await rgw.complete_multipart("b", "k", up,
+                                         [(1, e1), (2, e2)])
+            # the multipart object is the newest version; the atomic
+            # generation is still addressable
+            assert await rgw.get_object("b", "k") == payload
+            assert (await rgw.get_object_ex(
+                "b", "k", v1))[0] == b"atomic-gen"
+            versions = await rgw.list_object_versions("b")
+            assert len(versions) == 2
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_version_id_on_unversioned_bucket():
+    """versionId semantics on never-versioned keys: "null" addresses
+    the plain object; any other id is NoSuchVersion — never a silent
+    whole-object delete (review finding)."""
+    async def main():
+        cluster = Cluster(num_osds=4, osds_per_host=2)
+        await cluster.start()
+        try:
+            rgw = await _rgw(cluster)
+            await rgw.create_bucket("b")
+            await rgw.put_object("b", "k", b"data")
+            with pytest.raises(RGWError) as ei:
+                await rgw.delete_object("b", "k", version_id="bogus")
+            assert ei.value.code == "NoSuchVersion"
+            assert await rgw.get_object("b", "k") == b"data"
+            await rgw.delete_object("b", "k", version_id="null")
+            with pytest.raises(RGWError):
+                await rgw.get_object("b", "k")
+        finally:
+            await cluster.stop()
+
+    run(main())
