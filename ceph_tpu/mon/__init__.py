@@ -108,6 +108,10 @@ class MonDaemon:
         self.msgr.local_fastpath = bool(
             self.config.get("ms_local_fastpath", True))
         self.msgr.dispatcher = self._dispatch
+        self.msgr.inject_socket_failures = int(
+            self.config.get("ms_inject_socket_failures", 0) or 0)
+        self.msgr.inject_internal_delays = float(
+            self.config.get("ms_inject_internal_delays", 0) or 0)
         # durable state (the MonitorDBStore role,
         # /root/reference/src/mon/MonitorDBStore.h): every commit writes
         # the incremental, the resulting full map, and the auxiliary

@@ -35,6 +35,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import random
 from typing import Awaitable, Callable, Dict, Optional
 
 from ceph_tpu.common import auth
@@ -114,6 +115,7 @@ class Connection:
                            key: Optional[bytes]) -> None:
         if self.closed:
             raise ConnectionError(f"connection to {self.peer_name} closed")
+        await self.messenger._inject_faults(self)
         seq = next(self._seq)
         payload = msg.encode()
         flags = 0
@@ -204,6 +206,22 @@ class LocalConnection(Connection):
         if self.closed or peer is None or peer.closed:
             raise ConnectionError(
                 f"local connection to {self.peer_name} closed")
+        # the fast path is still "the wire" for fault purposes: both
+        # endpoints' injection settings apply, like a socket whose
+        # either end can fail it
+        await self.messenger._inject_faults(self)
+        if peer.closed:
+            raise ConnectionError(
+                f"local connection to {self.peer_name} closed")
+        try:
+            await peer.messenger._inject_faults(peer)
+        except ConnectionError:
+            # receiver-side roll = the lost-ack shape: the message is
+            # swallowed and the connection dies, but the SENDER returns
+            # success — it cannot know the peer never dispatched
+            # (mirrors the socket path, where the drop happens after
+            # the sender's write completed)
+            return
         m = peer.messenger
         if m.dispatcher is not None:
             if isinstance(msg, MHello):
@@ -270,10 +288,36 @@ class Messenger:
         self._conns: Dict[str, Connection] = {}      # by peer addr
         self._accepted: list = []                     # inbound conns
         self._tasks: set = set()
+        # fault injection (ms_inject_* options,
+        # /root/reference/src/common/options.cc:1087-1108): daemons wire
+        # these from config at boot and on every central-config push.
+        # N > 0 fails roughly every Nth frame; delay > 0 sleeps a
+        # uniform [0, delay) before each send (the reference's
+        # ms_inject_internal_delays discipline).
+        self.inject_socket_failures: int = 0
+        self.inject_internal_delays: float = 0.0
+        self._inject_rng = random.Random()
 
     # stream buffer: bulk data frames are multi-MiB; the 64 KiB default
     # limit makes readexactly assemble them from ~64 tiny feeds
     STREAM_LIMIT = 8 << 20
+
+    async def _inject_faults(self, conn: Connection) -> None:
+        """Honor ms_inject_* on this frame: maybe delay, maybe kill the
+        connection (AsyncConnection::inject_delay + the every-Nth
+        socket-failure roll).  Killing closes the connection exactly
+        like a real socket fault — the peer sees EOF, the fault handler
+        fires, and callers get ConnectionError."""
+        d = self.inject_internal_delays
+        if d > 0:
+            await asyncio.sleep(self._inject_rng.random() * d)
+        n = self.inject_socket_failures
+        if n > 0 and self._inject_rng.randrange(n) == 0:
+            log.info("%s: injecting socket failure on %r",
+                     self.entity_name, conn)
+            conn.close()
+            raise ConnectionError(
+                f"injected socket failure to {conn.peer_name or conn.peer_addr}")
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -449,6 +493,11 @@ class Messenger:
             while True:
                 pre, tag, flags, seq, payload, sig = \
                     await self._read_frame(conn)
+                # receive-side injection: drop the connection AFTER a
+                # frame arrived but BEFORE it dispatches — the lost-ack
+                # shape (sender thinks it delivered; receiver never saw
+                # it) that distinguishes socket faults from clean stops
+                await self._inject_faults(conn)
                 if self.secret is not None:
                     if conn.session_key is None:
                         await self._handshake_hello(

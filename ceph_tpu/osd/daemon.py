@@ -289,6 +289,10 @@ class OSDDaemon:
         self.msgr.local_fastpath = bool(
             self.config.get("ms_local_fastpath", True))
         self.msgr.dispatcher = self._dispatch
+        self._apply_msgr_injection()
+        # heartbeat_inject_failure: while now < this, the daemon goes
+        # heartbeat-silent (no pings, no replies) without dying
+        self._hb_mute_until = 0.0
         self.store = store if store is not None else MemStore()
         self._own_store = store is None
         self.osdmap: Optional[OSDMap] = None
@@ -631,6 +635,19 @@ class OSDDaemon:
                 log.info("osd.%d: config %s -> %r (centralized)",
                          self.osd_id, name, val)
                 self.config[name] = val
+        self._apply_msgr_injection()
+
+    def _apply_msgr_injection(self) -> None:
+        """Push ms_inject_* config into the live messenger (the options
+        take effect on the next frame, like the reference's md_config
+        observer on AsyncMessenger)."""
+        try:
+            self.msgr.inject_socket_failures = int(
+                self.config.get("ms_inject_socket_failures", 0) or 0)
+            self.msgr.inject_internal_delays = float(
+                self.config.get("ms_inject_internal_delays", 0) or 0)
+        except (TypeError, ValueError):
+            pass
 
     def _clog(self, level: str, message: str) -> None:
         """Fire one cluster-log entry at the mon (MLog role)."""
@@ -879,8 +896,12 @@ class OSDDaemon:
                 self._hb_last_rx[osd] = now
         self._map_event.set()
         self._map_event = asyncio.Event()
-        # falsely marked down while alive: re-boot (MOSDAlive role)
+        # falsely marked down while alive: re-boot (MOSDAlive role).
+        # NOT while heartbeat-muted — an injected heartbeat outage must
+        # look dead to the cluster, so re-booting through it would
+        # defeat the injection (recovery happens when the mute expires)
         if not self.osdmap.is_up(self.osd_id) and not self._stopping \
+                and now >= self._hb_mute_until \
                 and self.msgr.addr and \
                 time.monotonic() - self._last_boot_sent > 1.0:
             self._last_boot_sent = time.monotonic()
@@ -949,6 +970,8 @@ class OSDDaemon:
     # -- heartbeats --------------------------------------------------------
 
     async def _handle_ping(self, conn: Connection, msg: MPing) -> None:
+        if time.monotonic() < self._hb_mute_until:
+            return  # injected heartbeat failure: swallow pings silently
         if msg.from_osd >= 0:
             self._hb_last_rx[msg.from_osd] = time.monotonic()
         if msg.kind == PING:
@@ -996,6 +1019,37 @@ class OSDDaemon:
             if self.osdmap is None:
                 continue
             now = time.monotonic()
+            # one-shot injected heartbeat outage
+            # (heartbeat_inject_failure = seconds of silence): mute
+            # pings AND replies for that long, then self-clear.  Peers
+            # see a dead heartbeat surface on a live daemon — exactly
+            # the failure the mon's reporter quorum must adjudicate.
+            inj = float(self.config.get(
+                "heartbeat_inject_failure", 0) or 0)
+            if inj > 0 and now >= self._hb_mute_until:
+                self.config["heartbeat_inject_failure"] = 0
+                self._hb_mute_until = now + inj
+                log.warning("osd.%d: injecting %.1fs heartbeat"
+                            " failure", self.osd_id, inj)
+            if now < self._hb_mute_until:
+                self._hb_resume_stale = True
+                continue
+            if getattr(self, "_hb_resume_stale", False):
+                # coming out of a mute: every peer timestamp is stale by
+                # the mute length — restart the clocks or this daemon
+                # would instantly (and falsely) report every peer failed
+                self._hb_resume_stale = False
+                self._hb_last_rx.clear()
+                # and if the outage got us (rightly) marked down, no map
+                # event will re-fire the MOSDAlive path — re-boot now
+                if not self.osdmap.is_up(self.osd_id) and self.msgr.addr:
+                    self._last_boot_sent = now
+                    try:
+                        await self.msgr.send_to(
+                            self.mon_addr,
+                            MOSDBoot(self.osd_id, self.msgr.addr))
+                    except (ConnectionError, OSError):
+                        pass
             # mon session keepalive: a restarted mon loses subscriber
             # connections silently; if maps have gone quiet, drop the
             # possibly-half-open cached connection and re-subscribe on a

@@ -255,8 +255,13 @@ class RadosClient:
             pass
 
     async def refresh_map(self) -> None:
-        mon = await self.msgr.connect(self.mon_addr)
-        await mon.send(MGetMap(subscribe=True))
+        try:
+            mon = await self.msgr.connect(self.mon_addr)
+            await mon.send(MGetMap(subscribe=True))
+        except (ConnectionError, OSError):
+            # called from op-retry paths: a dead/faulted mon must not
+            # crash the op — hunt and let the caller's retry loop spin
+            self._hunt_mon()
         await self.wait_for_new_map(1.0)
 
     # -- cephx tickets (MonClient auth role) -------------------------------

@@ -450,3 +450,36 @@ def test_recovery_batches_device_dispatches():
             await cluster.stop()
 
     run(main())
+
+
+def test_heartbeat_inject_failure_marks_down_then_recovers():
+    """heartbeat_inject_failure (options.cc:1087-1108 family): push the
+    option through CENTRAL CONFIG to one live daemon; it goes
+    heartbeat-silent (no pings, no replies) without dying, peers report
+    it, the mon marks it down — and when the injected outage expires the
+    daemon notices the false down-mark and re-boots (MOSDAlive role)."""
+    async def main():
+        cluster = Cluster(num_osds=4)
+        await cluster.start()
+        try:
+            assert cluster.mon.osdmap.is_up(2)
+            rc, _ = await cluster.client.mon_command(
+                {"prefix": "config set", "who": "osd.2",
+                 "name": "heartbeat_inject_failure", "value": "8"})
+            assert rc == 0
+            # mute > grace (2.5s): peers must report, mon must adjudicate
+            await cluster.wait_for_osd_down(2)
+            # daemon is ALIVE the whole time (this is not a crash)
+            assert not cluster.osds[2]._stopping
+            # drop the central override so the post-reboot config
+            # re-push cannot re-arm the injection
+            rc, _ = await cluster.client.mon_command(
+                {"prefix": "config rm", "who": "osd.2",
+                 "name": "heartbeat_inject_failure"})
+            assert rc == 0
+            # outage expires -> heartbeats resume -> self re-boot
+            await cluster.wait_for_osd_up(2, timeout=30.0)
+        finally:
+            await cluster.stop()
+
+    run(main())

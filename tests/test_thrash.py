@@ -54,9 +54,12 @@ async def _thrash_once(rng, cluster, down: set, min_alive: int) -> None:
 async def _run_thrash(*, seed: int, num_osds: int, osds_per_host: int,
                       pool: dict, min_alive: int,
                       duration_s: float = 60.0, min_actions: int = 40,
-                      n_objects: int = 16) -> None:
+                      n_objects: int = 16,
+                      osd_config: dict = None,
+                      mon_config: dict = None) -> None:
     rng = random.Random(seed)
-    cluster = Cluster(num_osds=num_osds, osds_per_host=osds_per_host)
+    cluster = Cluster(num_osds=num_osds, osds_per_host=osds_per_host,
+                      osd_config=osd_config, mon_config=mon_config)
     await cluster.start()
     try:
         if pool["kind"] == "ec":
@@ -281,3 +284,25 @@ def test_thrash_replicated():
         seed=9, num_osds=6, osds_per_host=1,
         pool={"kind": "replicated", "size": 3, "pg_num": 8},
         min_alive=4), 600))
+
+
+@pytest.mark.slow
+def test_thrash_with_socket_injection():
+    """Thrash WITH wire-fault injection on every daemon
+    (ms_inject_socket_failures=50: every ~50th frame kills its
+    connection; plus sub-ms internal delays).  The reference runs its
+    msgr failure-injection this way in qa suites
+    (/root/reference/src/common/options.cc:1087-1108) — the point is
+    that retry/resend discipline, not lossless transport, carries the
+    durability invariants."""
+    inject = {"ms_inject_socket_failures": 50,
+              "ms_inject_internal_delays": 0.002}
+    asyncio.run(asyncio.wait_for(_run_thrash(
+        seed=4242, num_osds=6, osds_per_host=1,
+        pool={"kind": "replicated", "size": 3, "pg_num": 8},
+        min_alive=4, duration_s=30.0, min_actions=20,
+        # short sub-op timeout: an injected-away reply must recycle in
+        # seconds or serialized recovery crawls past the clean budget
+        osd_config=dict(inject, osd_heartbeat_grace=4.0,
+                        osd_sub_op_timeout=2.0),
+        mon_config=dict(inject, osd_heartbeat_grace=4.0)), 600))
