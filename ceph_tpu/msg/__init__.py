@@ -290,9 +290,10 @@ class Messenger:
         self._tasks: set = set()
         # fault injection (ms_inject_* options,
         # /root/reference/src/common/options.cc:1087-1108): daemons wire
-        # these from config at boot and on every central-config push.
-        # N > 0 fails roughly every Nth frame; delay > 0 sleeps a
-        # uniform [0, delay) before each send (the reference's
+        # these from config at boot (OSDs also re-wire on every
+        # central-config push; mons are boot-time only).  N > 0 fails
+        # roughly every Nth frame; delay > 0 sleeps a uniform
+        # [0, delay) before each send (the reference's
         # ms_inject_internal_delays discipline).
         self.inject_socket_failures: int = 0
         self.inject_internal_delays: float = 0.0

@@ -640,14 +640,23 @@ class OSDDaemon:
     def _apply_msgr_injection(self) -> None:
         """Push ms_inject_* config into the live messenger (the options
         take effect on the next frame, like the reference's md_config
-        observer on AsyncMessenger)."""
+        observer on AsyncMessenger).  Each option parses independently
+        — one bad value must neither block the other nor vanish
+        silently."""
         try:
             self.msgr.inject_socket_failures = int(
                 self.config.get("ms_inject_socket_failures", 0) or 0)
+        except (TypeError, ValueError):
+            log.warning("osd.%d: ignoring bad ms_inject_socket_"
+                        "failures=%r", self.osd_id,
+                        self.config.get("ms_inject_socket_failures"))
+        try:
             self.msgr.inject_internal_delays = float(
                 self.config.get("ms_inject_internal_delays", 0) or 0)
         except (TypeError, ValueError):
-            pass
+            log.warning("osd.%d: ignoring bad ms_inject_internal_"
+                        "delays=%r", self.osd_id,
+                        self.config.get("ms_inject_internal_delays"))
 
     def _clog(self, level: str, message: str) -> None:
         """Fire one cluster-log entry at the mon (MLog role)."""

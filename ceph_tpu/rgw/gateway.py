@@ -90,6 +90,7 @@ class RGWLite:
         # within this gateway instance (one gateway per cluster in this
         # tier; multi-gateway index updates need the omap op milestone)
         self._meta_locks: Dict[str, "asyncio.Lock"] = {}
+        self._gc_task = None  # background sweep (start_gc)
 
     def _etag_of(self, data: bytes) -> str:
         """Content ETag under the configured hash (class docstring)."""
@@ -175,49 +176,173 @@ class RGWLite:
         return cls._meta_oid("versions", bucket, key)
 
     @classmethod
-    def _gc_oid(cls) -> str:
-        return cls._meta_oid("gc")
+    def _gc_oid(cls, shard: int = 0) -> str:
+        # shard 0 keeps the pre-sharding name so legacy queue docs
+        # drain without migration
+        return cls._meta_oid("gc") if shard == 0 \
+            else cls._meta_oid("gc", str(shard))
 
     # -- deferred stripe GC (rgw_gc.cc role) -------------------------------
 
-    async def _gc_defer(self, oids) -> None:
-        """Queue data objects for deferred deletion.  The entry lands
-        BEFORE the index stops referencing the stripes, so a crash
-        leaves a re-drainable entry, never an orphaned object."""
+    # GC queue shards (the rgw_gc_max_objs chain-shard role): mutation
+    # churn across buckets spreads over GC_SHARDS independent queue
+    # docs/locks instead of serializing on one hot object
+    GC_SHARDS = 8
+
+    async def _gc_load_locked(self, shard: int) -> Dict:
+        """Load + normalize one GC shard doc (caller holds its lock).
+        Legacy entries (pre-two-phase) get ids and count as ready."""
+        doc = await self._load(self._gc_oid(shard)) or \
+            {"entries": [], "next_id": 1}
+        doc.setdefault("next_id", 1)
+        for e in doc["entries"]:
+            if "id" not in e:
+                e["id"] = doc["next_id"]
+                doc["next_id"] += 1
+            e.setdefault("state", "ready")
+        return doc
+
+    async def _gc_defer(self, oids) -> List[Tuple[int, int]]:
+        """Queue data objects for deferred deletion, state=PENDING.
+        Two-phase against the index mutation (the cls_rgw chain-queue
+        role, where the reference makes this atomic OSD-side): the
+        entry lands BEFORE the index stops referencing the stripes, and
+        only _gc_commit (called AFTER the index mutation persisted)
+        makes it drainable.  A crash on either side of the index write
+        therefore leaves a PENDING entry — a listable, reclaimable leak
+        — never a deletion of still-referenced data and never a silent
+        orphan.  Returns (shard, id) pairs for _gc_commit."""
         oids = [o for o in oids]
         if not oids:
-            return
-        async with self._meta_lock(self._gc_oid()):
-            doc = await self._load(self._gc_oid()) or {"entries": []}
-            doc["entries"].extend(
-                {"oid": o, "at": time.time()} for o in oids)
-            await self._store(self._gc_oid(), doc)
+            return []
+        # one mutation's stripes land on one shard (one lock round
+        # trip); successive mutations round-robin across shards
+        shard = self._writes % self.GC_SHARDS
+        async with self._meta_lock(self._gc_oid(shard)):
+            doc = await self._gc_load_locked(shard)
+            ids = []
+            for o in oids:
+                eid = doc["next_id"]
+                doc["next_id"] += 1
+                doc["entries"].append(
+                    {"id": eid, "oid": o, "at": time.time(),
+                     "state": "pending"})
+                ids.append((shard, eid))
+            await self._store(self._gc_oid(shard), doc)
+        return ids
 
-    async def gc_process(self, max_entries: int = 0) -> int:
-        """Drain the GC queue (rgw gc process); returns entries
+    async def _gc_commit(self, ids: List[Tuple[int, int]]) -> None:
+        """Flip entries PENDING -> READY once the index mutation that
+        dropped their references has persisted."""
+        by_shard: Dict[int, set] = {}
+        for shard, eid in ids:
+            by_shard.setdefault(shard, set()).add(eid)
+        for shard, want in by_shard.items():
+            async with self._meta_lock(self._gc_oid(shard)):
+                doc = await self._gc_load_locked(shard)
+                for e in doc["entries"]:
+                    if e["id"] in want:
+                        e["state"] = "ready"
+                await self._store(self._gc_oid(shard), doc)
+
+    async def gc_list(self) -> List[Dict]:
+        """Queue contents (rgw gc list): ready entries plus any
+        pending leftovers from interrupted mutations."""
+        out: List[Dict] = []
+        for shard in range(self.GC_SHARDS):
+            async with self._meta_lock(self._gc_oid(shard)):
+                out.extend(
+                    (await self._gc_load_locked(shard))["entries"])
+        return out
+
+    async def gc_process(self, max_entries: int = 0,
+                         reclaim_pending_after: Optional[float] = None
+                         ) -> int:
+        """Drain READY queue entries (rgw gc process); returns entries
         removed.  Already-gone objects dequeue; any OTHER removal
         failure (down OSDs, timeouts) keeps its entry queued for the
         next sweep — dropping it would orphan the stripes, the exact
-        leak deferred GC exists to prevent."""
+        leak deferred GC exists to prevent.  PENDING entries are
+        skipped (their index mutation may never have committed, so the
+        data may still be live) unless older than
+        reclaim_pending_after — an explicit operator decision.
+
+        Shard locks are held only around queue snapshots/updates, never
+        across data-pool removals: a slow drain (down OSDs timing out)
+        must not block PUT/DELETE mutations behind the queue docs."""
         from ceph_tpu.rados.client import ObjectNotFound
 
-        async with self._meta_lock(self._gc_oid()):
-            doc = await self._load(self._gc_oid()) or {"entries": []}
-            todo = doc["entries"][:max_entries] if max_entries \
-                else list(doc["entries"])
-            kept = []
-            done = 0
-            for entry in todo:
+        now = time.time()
+        done = 0
+        for shard in range(self.GC_SHARDS):
+            async with self._meta_lock(self._gc_oid(shard)):
+                doc = await self._gc_load_locked(shard)
+                eligible = [
+                    e for e in doc["entries"]
+                    if e["state"] == "ready"
+                    or (reclaim_pending_after is not None
+                        and now - e["at"] >= reclaim_pending_after)]
+                if max_entries:
+                    eligible = eligible[:max_entries - done]
+            # lock released: removals run against a snapshot
+            removed_ids = set()
+            for entry in eligible:
                 try:
                     await self.data.remove(entry["oid"])
-                    done += 1
                 except ObjectNotFound:
-                    done += 1
+                    pass
                 except Exception:
-                    kept.append(entry)
-            doc["entries"] = kept + doc["entries"][len(todo):]
-            await self._store(self._gc_oid(), doc)
+                    continue  # stays queued for the next sweep
+                removed_ids.add(entry["id"])
+                done += 1
+            if removed_ids:
+                async with self._meta_lock(self._gc_oid(shard)):
+                    doc = await self._gc_load_locked(shard)
+                    doc["entries"] = [e for e in doc["entries"]
+                                      if e["id"] not in removed_ids]
+                    await self._store(self._gc_oid(shard), doc)
+            if max_entries and done >= max_entries:
+                break
         return done
+
+    def start_gc(self, interval: float = 30.0) -> None:
+        """Spawn the background GC sweep (the rgw_gc worker-thread
+        role).  Idempotent; stop with stop_gc()."""
+        import asyncio
+
+        if self._gc_task is not None and not self._gc_task.done():
+            return
+
+        async def sweep():
+            import logging
+
+            while True:
+                await asyncio.sleep(interval)
+                try:
+                    await self.gc_process()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    # next sweep retries; entries never drop — but a
+                    # persistently failing sweep must be VISIBLE or
+                    # garbage accumulates behind a healthy-looking
+                    # gateway
+                    logging.getLogger("rgw").exception(
+                        "gc sweep failed; will retry in %.0fs",
+                        interval)
+
+        self._gc_task = asyncio.get_running_loop().create_task(sweep())
+
+    async def stop_gc(self) -> None:
+        import asyncio
+
+        if self._gc_task is not None:
+            self._gc_task.cancel()
+            try:
+                await self._gc_task
+            except asyncio.CancelledError:
+                pass
+            self._gc_task = None
 
     # -- versioning (RGWSetBucketVersioning / versioned PUT-GET-DEL) -------
 
@@ -296,6 +421,12 @@ class RGWLite:
         continuation token (start strictly after), max-keys
         truncation counting contents + prefixes."""
         doc = await self._bucket(bucket)
+        if max_keys <= 0:
+            # S3 semantics: max-keys=0 is a valid request returning no
+            # entries and IsTruncated=false (a truncated=true answer
+            # with an empty token would loop naive paginators forever)
+            return {"contents": [], "common_prefixes": [],
+                    "is_truncated": False, "next_token": ""}
         contents: List[Dict[str, Any]] = []
         prefixes: List[str] = []
         truncated = False
@@ -516,16 +647,21 @@ class RGWLite:
         holds the bucket lock.  Replaced stripes go to deferred GC."""
         head_doc = self._meta_oid("head", bucket, key)
         old = await self._load(head_doc)
+        gc_ids: List[int] = []
+        if old is not None:
+            # defer BEFORE the head flip (entry-lands-first invariant):
+            # a crash mid-overwrite leaves a pending entry, not an
+            # untracked orphan of the replaced stripes
+            new_oids = {s["oid"] for s in manifest.stripes}
+            gc_ids = await self._gc_defer(
+                stripe["oid"] for stripe in old["manifest"]["stripes"]
+                if stripe["oid"] not in new_oids)
         await self._store(head_doc, {"manifest": manifest.to_dict(),
                                      "etag": etag})
         doc["objects"][key] = {"size": manifest.obj_size,
                                "etag": etag, "mtime": time.time()}
         await self._store(self._bucket_oid(bucket), doc)
-        if old is not None:
-            new_oids = {s["oid"] for s in manifest.stripes}
-            await self._gc_defer(
-                stripe["oid"] for stripe in old["manifest"]["stripes"]
-                if stripe["oid"] not in new_oids)
+        await self._gc_commit(gc_ids)
 
     async def _migrate_legacy_head(self, bucket: str,
                                    key: str) -> List[Dict]:
@@ -552,15 +688,16 @@ class RGWLite:
         if not vdoc["versions"]:
             vdoc["versions"] = await self._migrate_legacy_head(
                 bucket, key)
+        gc_ids: List[int] = []
         if null_version:
             # suspended: the new null version REPLACES a previous
             # null (its stripes go to GC); other versions survive
             for old in vdoc["versions"]:
                 if old["version_id"] == "null" and \
                         not old["delete_marker"]:
-                    await self._gc_defer(
+                    gc_ids.extend(await self._gc_defer(
                         st["oid"]
-                        for st in old["manifest"]["stripes"])
+                        for st in old["manifest"]["stripes"]))
             vdoc["versions"] = [v for v in vdoc["versions"]
                                 if v["version_id"] != "null"]
         vdoc["versions"].insert(0, entry)
@@ -571,6 +708,7 @@ class RGWLite:
         vk.add(key)
         doc["versioned_keys"] = sorted(vk)
         await self._store(self._bucket_oid(bucket), doc)
+        await self._gc_commit(gc_ids)
         return vid
 
     async def _manifest(self, bucket: str, key: str,
@@ -684,9 +822,7 @@ class RGWLite:
                 # PUT interleave and duplicate the null id)
                 self._drop_version_locked(vdoc, "null",
                                           missing_ok=True)
-                gc = vdoc.pop("_gc", [])
-                if gc:
-                    await self._gc_defer(gc)
+                gc_ids = await self._gc_defer(vdoc.pop("_gc", []))
                 marker = {"version_id": "null", "etag": "",
                           "manifest": None, "size": 0,
                           "mtime": time.time(), "delete_marker": True}
@@ -695,6 +831,7 @@ class RGWLite:
                                   vdoc)
                 doc["objects"].pop(key, None)
                 await self._store(self._bucket_oid(bucket), doc)
+                await self._gc_commit(gc_ids)
                 return "null"
             await self._delete_unversioned_locked(doc, bucket, key)
             return None
@@ -704,11 +841,12 @@ class RGWLite:
         head = await self._load(self._meta_oid("head", bucket, key))
         if head is None:
             raise RGWError("NoSuchKey", f"{bucket}/{key}")
-        await self._gc_defer(st["oid"]
-                             for st in head["manifest"]["stripes"])
+        gc_ids = await self._gc_defer(
+            st["oid"] for st in head["manifest"]["stripes"])
         await self.meta.remove(self._meta_oid("head", bucket, key))
         doc["objects"].pop(key, None)
         await self._store(self._bucket_oid(bucket), doc)
+        await self._gc_commit(gc_ids)
 
     def _drop_version_locked(self, vdoc: Dict, version_id: str,
                              missing_ok: bool = False) -> None:
@@ -730,9 +868,7 @@ class RGWLite:
                                       key: str, vdoc: Dict) -> None:
         """Persist a mutated vdoc + refresh the bucket index; flush
         any stripes _drop_version_locked queued."""
-        gc = vdoc.pop("_gc", [])
-        if gc:
-            await self._gc_defer(gc)
+        gc_ids = await self._gc_defer(vdoc.pop("_gc", []))
         if vdoc["versions"]:
             await self._store(self._versions_oid(bucket, key), vdoc)
         else:
@@ -756,6 +892,7 @@ class RGWLite:
         else:
             doc["objects"].pop(key, None)
         await self._store(self._bucket_oid(bucket), doc)
+        await self._gc_commit(gc_ids)
 
     async def _delete_version(self, bucket: str, key: str,
                               version_id: str,

@@ -79,14 +79,20 @@ class S3Frontend:
         self._server: Optional[asyncio.base_events.Server] = None
         self.addr = ""
 
-    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+    async def start(self, host: str = "127.0.0.1", port: int = 0,
+                    gc_interval: float = 30.0) -> str:
         self._server = await asyncio.start_server(
             self._serve, host, port, limit=8 << 20)
         port = self._server.sockets[0].getsockname()[1]
         self.addr = f"{host}:{port}"
+        # a serving gateway owns the GC sweep (rgw_gc worker role):
+        # without it, overwrite/delete churn accumulates stripes forever
+        if gc_interval > 0:
+            self.rgw.start_gc(gc_interval)
         return self.addr
 
     async def stop(self) -> None:
+        await self.rgw.stop_gc()
         if self._server is not None:
             self._server.close()
             try:

@@ -398,3 +398,56 @@ def test_version_id_on_unversioned_bucket():
             await cluster.stop()
 
     run(main())
+
+
+def test_gc_two_phase_pending_protects_referenced_data():
+    """The crash window between _gc_defer and the index mutation leaves
+    PENDING entries: gc_process must NOT delete them (the data may
+    still be referenced) until an operator reclaims explicitly."""
+    async def main():
+        cluster = Cluster(num_osds=4, osds_per_host=2)
+        await cluster.start()
+        try:
+            rgw = await _rgw(cluster)
+            await rgw.create_bucket("b")
+            await rgw.put_object("b", "k", b"LIVE" * 25_000)
+            # simulate the crash state: stripes deferred, index
+            # mutation never committed (no _gc_commit)
+            head = await rgw._load(rgw._meta_oid("head", "b", "k"))
+            oids = [s["oid"] for s in head["manifest"]["stripes"]]
+            await rgw._gc_defer(oids)
+            assert await rgw.gc_process() == 0  # pending: untouchable
+            # the object the entries still reference reads back intact
+            assert await rgw.get_object("b", "k") == b"LIVE" * 25_000
+            entries = await rgw.gc_list()
+            assert entries and all(e["state"] == "pending"
+                                   for e in entries)
+            # explicit operator reclaim drains them
+            n = await rgw.gc_process(reclaim_pending_after=0.0)
+            assert n == len(oids)
+            assert await rgw.gc_list() == []
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_list_v2_max_keys_zero():
+    async def main():
+        cluster = Cluster(num_osds=4, osds_per_host=2)
+        await cluster.start()
+        try:
+            rgw = await _rgw(cluster)
+            await rgw.create_bucket("b")
+            await rgw.put_object("b", "k1", b"x")
+            await rgw.put_object("b", "k2", b"y")
+            out = await rgw.list_objects_v2("b", max_keys=0)
+            # S3: max-keys=0 => empty, NOT truncated (a truncated
+            # answer with an empty token loops naive paginators)
+            assert out["contents"] == []
+            assert out["is_truncated"] is False
+            assert out["next_token"] == ""
+        finally:
+            await cluster.stop()
+
+    run(main())
