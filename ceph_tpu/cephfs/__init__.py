@@ -7,6 +7,16 @@ the OSDs as striped objects (Client::_read/_write via the Objecter,
 filer/striper layout).  The MDS address is discovered from the
 mds_lock object in the metadata pool (the MDSMap role).
 
+CLIENT CAPS (Client.cc caps + mds/Locker.cc): metadata replies can
+GRANT a capability on the inode ("r": cache attrs and serve stat/read
+locally; "rw": additionally buffer dirty size/mtime and flush on
+close/recall) — so a hot stat/read loop costs ZERO MDS round trips.
+Coherence is recall-based: when another client's access conflicts, the
+MDS sends MClientCaps revoke; this client folds its dirty attrs into
+the ack and drops the cached entries.  Caps die with the MDS
+connection (failover = start capless) and carry a TTL as a belt
+against partitions where the recall cannot reach us.
+
 File layout: fixed-block striping `fsdata.<ino:x>.<blockno:016x>` in
 the data pool (file_layout_t object_size, default 4 MiB), sparse like
 the reference (absent blocks read as zeros).
@@ -16,10 +26,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Any, Dict, List, Optional
+import time
+from typing import Any, Dict, List, Optional, Set
 
 from ceph_tpu.mds import ADDR_ATTR, LOCK_OBJ, data_obj
-from ceph_tpu.msg.messages import MClientRequest
+from ceph_tpu.msg.messages import MClientCaps, MClientRequest
 from ceph_tpu.rados.client import (
     IoCtx,
     ObjectNotFound,
@@ -44,12 +55,148 @@ class CephFS:
     """Mounted filesystem handle (libcephfs ceph_mount role)."""
 
     def __init__(self, client: RadosClient, metadata_pool: str,
-                 data_pool: str):
+                 data_pool: str, caps_ttl: float = 60.0):
         self.client = client
         self.meta = client.open_ioctx(metadata_pool)
         self.data = client.open_ioctx(data_pool)
         self._tid = 0
         self._mds_addr: Optional[str] = None
+        # -- caps state (Client.cc cap cache) ------------------------------
+        self.caps_ttl = caps_ttl
+        self._caps: Dict[int, str] = {}            # ino -> "r"|"rw"
+        self._cap_expiry: Dict[int, float] = {}    # ino -> monotonic
+        self._attr_cache: Dict[str, dict] = {}     # path -> inode
+        self._ino_paths: Dict[int, Set[str]] = {}  # reverse index
+        # ino -> buffered dirty attrs awaiting flush (rw caps only)
+        self._dirty: Dict[int, Dict[str, Any]] = {}
+        # observability (tests assert the zero-round-trip property)
+        self.mds_requests = 0
+        self.cap_hits = 0
+        # route cap recalls arriving on the shared rados messenger
+        client.fs_caps_handler = self._handle_caps
+
+    # -- caps cache (Client.cc insert_trace / handle_caps roles) -----------
+
+    # bound on cached caps (the mds_max_caps_per_client role): a tree
+    # walk over millions of files must not grow the mount's memory
+    # forever — past the bound the soonest-expiring quarter is shed
+    max_caps = 4096
+
+    def _record_cap(self, path: str, inode: dict, cap: str) -> None:
+        if not cap or not isinstance(inode, dict):
+            return
+        ino = inode["ino"]
+        if ino not in self._caps and len(self._caps) >= self.max_caps:
+            self._trim_caps()
+        self._caps[ino] = cap
+        self._cap_expiry[ino] = time.monotonic() + self.caps_ttl
+        self._attr_cache[path] = inode
+        self._ino_paths.setdefault(ino, set()).add(path)
+
+    def _trim_caps(self) -> None:
+        victims = sorted(self._cap_expiry,
+                         key=self._cap_expiry.get)[:self.max_caps // 4]
+        addr = self._mds_addr
+        conn = self.client.msgr._conns.get(addr) if addr else None
+        for ino in victims:
+            if ino in self._dirty:
+                continue  # never shed unflushed state
+            self._drop_ino(ino)
+            if conn is not None and not conn.closed:
+                # best-effort voluntary return so the MDS table shrinks
+                # too and later writers skip a recall round trip
+                try:
+                    self.client.msgr._spawn(conn.send(
+                        MClientCaps("release", ino)))
+                except Exception:
+                    pass
+
+    def _drop_ino(self, ino: int) -> None:
+        self._caps.pop(ino, None)
+        self._cap_expiry.pop(ino, None)
+        for path in self._ino_paths.pop(ino, set()):
+            self._attr_cache.pop(path, None)
+
+    def _drop_all_caps(self) -> None:
+        self._caps.clear()
+        self._cap_expiry.clear()
+        self._attr_cache.clear()
+        self._ino_paths.clear()
+        # dirty sizes survive — close()/flush() re-sends them through
+        # the ordinary setattr path, which retries across failover
+
+    def _cap_valid(self, ino: int) -> bool:
+        """A cap is usable only while its TTL holds AND the connection
+        it was granted on is alive — a dead conn means the MDS has
+        already evicted us (or a new MDS knows nothing of us)."""
+        if ino not in self._caps:
+            return False
+        if time.monotonic() > self._cap_expiry.get(ino, 0.0):
+            self._drop_ino(ino)
+            return False
+        addr = self._mds_addr
+        conn = self.client.msgr._conns.get(addr) if addr else None
+        if conn is None or conn.closed:
+            self._drop_all_caps()
+            return False
+        return True
+
+    def _cached_inode(self, path: str) -> Optional[dict]:
+        inode = self._attr_cache.get(path)
+        if inode is not None and self._cap_valid(inode["ino"]):
+            self.cap_hits += 1
+            return inode
+        return None
+
+    async def _handle_caps(self, conn, msg: MClientCaps) -> None:
+        """MDS-initiated recall: fold dirty attrs into the ack, drop
+        the cache.  op=evict (MDS stepping down) drops everything, no
+        ack expected."""
+        if msg.op == "evict":
+            self._drop_all_caps()
+            return
+        if msg.op != "revoke":
+            return
+        # the ack carries our dirty attrs INCLUDING the path: recalls
+        # driven by a directory rename persist bystander flushes by
+        # path while those paths still resolve
+        attrs = self._dirty.pop(msg.ino, {})
+        self._drop_ino(msg.ino)
+        try:
+            await conn.send(MClientCaps("ack", msg.ino, tid=msg.tid,
+                                        attrs=attrs))
+        except (ConnectionError, OSError):
+            pass  # conn died: the MDS evicts us on timeout/fault
+
+    def _note_dirty(self, ino: int, path: str, size: int,
+                    mtime: float) -> None:
+        d = self._dirty.setdefault(ino, {"size_max": 0})
+        d["size_max"] = max(int(d.get("size_max", 0)), size)
+        d["mtime"] = mtime
+        d["path"] = path
+
+    async def _flush_dirty_path(self, path: str) -> None:
+        """Flush any buffered attrs recorded FOR this path — keyed on
+        the dirty table itself, not the attr cache, so a failover
+        (which clears the cache but keeps dirty records) cannot skip
+        the flush."""
+        for ino, d in list(self._dirty.items()):
+            if d.get("path") == path:
+                await self._flush_dirty(ino)
+
+    async def _flush_dirty(self, ino: int) -> None:
+        """Push buffered size/mtime to the MDS (cap flush): done on
+        close/fsync; recall-time flushes ride the ack instead."""
+        d = self._dirty.pop(ino, None)
+        if d is None:
+            return
+        args = {"path": d["path"], "size_max": d["size_max"]}
+        if d.get("mtime") is not None:
+            args["mtime"] = d["mtime"]
+        try:
+            await self._request("setattr", args)
+        except CephFSError:
+            pass  # path raced away (unlink/rename revoked us already)
 
     # -- MDS session -------------------------------------------------------
 
@@ -67,9 +214,13 @@ class CephFS:
         """Send one metadata op; on ESTALE/timeout re-discover the
         active MDS and resend (Client session reconnect role)."""
         last: Optional[BaseException] = None
+        self.mds_requests += 1
         for attempt in range(30):
             if self._mds_addr is None:
                 self._mds_addr = await self._discover_mds()
+                # fresh discovery: whatever we cached was granted by a
+                # possibly-dead incarnation — start capless
+                self._drop_all_caps()
             # ride the rados client's messenger + future table:
             # MClientReply resolves through its dispatcher like any
             # other tid-matched reply
@@ -97,8 +248,29 @@ class CephFS:
                 raise CephFSError(reply.rc,
                                   f"{op} {args.get('path', '')!r}"
                                   f" {reply.out.get('error', '')}")
+            self._trace_reply(op, args, reply.out)
             return reply.out
         raise CephFSError(ESTALE, f"{op}: no MDS reachable ({last!r})")
+
+    def _trace_reply(self, op: str, args: Dict[str, Any],
+                     out: Dict[str, Any]) -> None:
+        """Fold a mutation's reply back into OUR cap cache (the
+        insert_trace role): the MDS only recalls OTHER clients'
+        caps, so our own cached attrs would go stale without this."""
+        if op == "setattr":
+            inode = out.get("inode")
+            if inode and args["path"] in self._attr_cache:
+                self._attr_cache[args["path"]] = inode
+        elif op in ("unlink", "rmdir"):
+            self._drop_path(args["path"])
+        elif op == "rename":
+            self._drop_path(args["src"])
+            self._drop_path(args["dst"])
+
+    def _drop_path(self, path: str) -> None:
+        inode = self._attr_cache.get(path)
+        if inode is not None:
+            self._drop_ino(inode["ino"])
 
     # -- namespace ops -----------------------------------------------------
 
@@ -117,7 +289,11 @@ class CephFS:
         return out["entries"]
 
     async def stat(self, path: str) -> dict:
-        out = await self._request("stat", {"path": path})
+        cached = self._cached_inode(path)
+        if cached is not None:
+            return dict(cached)   # zero MDS round trips
+        out = await self._request("stat", {"path": path, "want": "r"})
+        self._record_cap(path, out["inode"], out.get("cap", ""))
         return out["inode"]
 
     async def exists(self, path: str) -> bool:
@@ -137,9 +313,15 @@ class CephFS:
         return out["target"]
 
     async def rename(self, src: str, dst: str) -> None:
+        # our own dirty size must land while the dentry still exists
+        # at src (the MDS folds FOREIGN writers via recall; ours is
+        # local knowledge it cannot recall mid-request)
+        await self._flush_dirty_path(src)
         await self._request("rename", {"src": src, "dst": dst})
 
     async def unlink(self, path: str) -> None:
+        # flush our own buffered size first: the MDS purges by size
+        await self._flush_dirty_path(path)
         out = await self._request("unlink", {"path": path})
         inode = out["inode"]
         # purge the file's data objects (the client-driven purge;
@@ -152,6 +334,7 @@ class CephFS:
             for b in range(blocks)))
 
     async def truncate(self, path: str, size: int) -> None:
+        await self._flush_dirty_path(path)
         inode = await self.stat(path)
         if inode["type"] != "file":
             raise CephFSError(-21, path)  # EISDIR
@@ -177,21 +360,30 @@ class CephFS:
         """block_size is the file_layout_t object_size: fixed at
         create time, ignored on existing files."""
         create = any(f in flags for f in "wax")
+        writable = create or "+" in flags
+        want = "rw" if writable else "r"
         if create:
             out = await self._request(
                 "create", {"path": path, "mode": mode,
                            "exclusive": "x" in flags,
-                           "block_size": block_size})
+                           "block_size": block_size, "want": want})
             inode = out["inode"]
+            self._record_cap(path, inode, out.get("cap", ""))
             if "w" in flags and inode.get("size", 0) > 0:
                 await self.truncate(path, 0)
                 inode = await self.stat(path)
         else:
-            inode = await self.stat(path)
+            cached = self._cached_inode(path)
+            if cached is not None and not writable:
+                inode = dict(cached)
+            else:
+                out = await self._request(
+                    "stat", {"path": path, "want": want})
+                inode = out["inode"]
+                self._record_cap(path, inode, out.get("cap", ""))
             if inode["type"] == "dir":
                 raise CephFSError(-21, path)
-        return File(self, path, inode,
-                    writable=create or "+" in flags)
+        return File(self, path, inode, writable=writable)
 
     # convenience one-shots (qa-workunit style helpers)
 
@@ -203,7 +395,8 @@ class CephFS:
     async def read_file(self, path: str) -> bytes:
         f = await self.open(path, "r")
         try:
-            return await f.read(0, f.inode["size"])
+            # read() revalidates and clamps to the CURRENT size
+            return await f.read(0, 1 << 62)
         finally:
             await f.close()
 
@@ -242,7 +435,18 @@ class File:
             offset += span
         return out
 
+    async def _revalidate(self) -> None:
+        """Refresh the inode before trusting its size: served from the
+        cap cache when we still hold the cap (zero round trips), else
+        re-stat — a revoke since open means someone changed it."""
+        cached = self.fs._cached_inode(self.path)
+        if cached is not None:
+            self.inode = cached
+        else:
+            self.inode = await self.fs.stat(self.path)
+
     async def read(self, offset: int, length: int) -> bytes:
+        await self._revalidate()
         size = self.inode.get("size", 0)
         if offset >= size:
             return b""
@@ -276,15 +480,46 @@ class File:
         end = offset + len(data)
         if end > self._max_written:
             self._max_written = end
-            # size flush: max-merge on the MDS so concurrent writers
-            # never shrink each other
-            out = await self.fs._request(
-                "setattr", {"path": self.path, "size_max": end})
-            self.inode = out["inode"]
+            now = time.time()
+            ino = self.inode["ino"]
+            if self.fs._caps.get(ino) == "rw" and \
+                    self.fs._cap_valid(ino):
+                # rw cap held: BUFFER the size locally (the Fw dirty-
+                # caps discipline) — no MDS round trip per write.  It
+                # flushes on close/fsync, or rides the revoke ack if
+                # another client conflicts first.
+                if end > self.inode.get("size", 0):
+                    self.inode = dict(self.inode, size=end, mtime=now)
+                    self.fs._attr_cache[self.path] = self.inode
+                self.fs._note_dirty(ino, self.path, end, now)
+            else:
+                # capless: write-through size flush, max-merged on the
+                # MDS so concurrent writers never shrink each other
+                out = await self.fs._request(
+                    "setattr", {"path": self.path, "size_max": end})
+                self.inode = out["inode"]
         return len(data)
 
     async def append(self, data: bytes) -> int:
+        await self._revalidate()
         return await self.write(self.inode.get("size", 0), data)
 
+    async def flush(self) -> None:
+        """fsync-of-metadata: push any buffered size/mtime now."""
+        await self.fs._flush_dirty(self.inode["ino"])
+
     async def close(self) -> None:
-        return None  # write-through: nothing buffered
+        ino = self.inode["ino"]
+        await self.fs._flush_dirty(ino)
+        if self.writable and self.fs._caps.get(ino) == "rw":
+            # voluntarily return the exclusive cap so other clients'
+            # opens don't pay a recall round trip (dirty already
+            # flushed above, so the release carries nothing)
+            self.fs._drop_ino(ino)
+            addr = self.fs._mds_addr
+            if addr is not None:
+                try:
+                    await self.fs.client.msgr.send_to(
+                        addr, MClientCaps("release", ino))
+                except (ConnectionError, OSError):
+                    pass

@@ -51,6 +51,7 @@ from typing import Any, Dict, Optional, Tuple
 
 from ceph_tpu.msg import Connection, Messenger
 from ceph_tpu.msg.messages import (
+    MClientCaps,
     MClientReply,
     MClientRequest,
     Message,
@@ -135,6 +136,21 @@ class MDSDaemon:
         # simulate a crash just before/after the journal append
         self._fail_before_journal = False
         self._fail_after_journal = False
+        # -- client caps (the Locker.cc grant/recall role) ----------------
+        # per-inode capability table keyed by the client's Connection:
+        # a session IS its connection here (death of either evicts the
+        # caps, so a reconnecting client starts capless and re-reads).
+        # Modes: "r" (may cache attrs + serve reads locally; many
+        # holders) and "rw" (may additionally buffer dirty size/mtime;
+        # exclusive).  Grants ride metadata replies; recalls are
+        # MClientCaps revoke/ack round trips whose acks carry the
+        # holder's dirty attrs (the cap-flush discipline).
+        self._caps: Dict[int, Dict[Any, str]] = {}
+        self._caps_lock = asyncio.Lock()
+        self._cap_tid = 0
+        self._cap_acks: Dict[int, asyncio.Future] = {}
+        self.cap_revoke_timeout = 3.0
+        self.msgr.on_connection_fault = self._conn_fault
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -198,6 +214,7 @@ class MDSDaemon:
                             self.name)
                 self.state = "standby"
                 self._dirs.clear()
+                self._drop_all_caps()
             try:
                 raw = await self.meta.getxattr(LOCK_OBJ, "renewal")
                 now = time.monotonic()
@@ -377,6 +394,7 @@ class MDSDaemon:
                             self.name)
                 self.state = "standby"
                 self._dirs.clear()
+                self._drop_all_caps()
                 raise MDSError(ESTALE, "fenced by a newer active")
             # transient rados failure: the mutation did NOT commit;
             # stay active (stepping down on EAGAIN would turn OSD
@@ -399,6 +417,7 @@ class MDSDaemon:
                             " active replays it)", self.name)
                 self.state = "standby"
                 self._dirs.clear()
+                self._drop_all_caps()
                 raise MDSError(ESTALE, "fenced during apply")
             raise
         if seq - self._applied_mark >= APPLIED_BATCH:
@@ -426,6 +445,166 @@ class MDSDaemon:
         return {"op": "dentry", "dir": dir_ino, "name": name,
                 "inode": inode}
 
+    # -- client caps (Locker grant/recall) ---------------------------------
+
+    def _conn_fault(self, conn) -> None:
+        """A client connection died: its session's caps die with it
+        (the session-eviction role) — no ack will ever come."""
+        for ino in list(self._caps):
+            self._caps[ino].pop(conn, None)
+            if not self._caps[ino]:
+                del self._caps[ino]
+        # unblock any revoke waiting on this conn
+        for tid, fut in list(self._cap_acks.items()):
+            if getattr(fut, "_cap_conn", None) is conn and \
+                    not fut.done():
+                fut.set_result({})
+
+    async def _revoke_caps(self, ino: int,
+                           keep: Any = None) -> Dict[str, Any]:
+        """Recall every cap on ino except `keep`'s; returns the merged
+        dirty attrs flushed back in the acks ({} if none).  An
+        unresponsive holder is evicted after cap_revoke_timeout — a
+        dead client must not wedge the namespace (Locker's
+        session-autoclose discipline)."""
+        merged: Dict[str, Any] = {}
+        async with self._caps_lock:
+            holders = self._caps.get(ino)
+            if not holders:
+                return merged
+            waits = []
+            for conn, _mode in list(holders.items()):
+                if conn is keep:
+                    continue
+                self._cap_tid += 1
+                tid = self._cap_tid
+                fut: asyncio.Future = \
+                    asyncio.get_running_loop().create_future()
+                fut._cap_conn = conn
+                self._cap_acks[tid] = fut
+                try:
+                    await conn.send(MClientCaps("revoke", ino,
+                                                tid=tid))
+                except (ConnectionError, OSError):
+                    self._cap_acks.pop(tid, None)
+                    holders.pop(conn, None)
+                    continue
+                waits.append((conn, tid, fut))
+            # wait for all acks CONCURRENTLY under one shared timeout:
+            # N unresponsive holders must cost cap_revoke_timeout
+            # total, not N times it (this stall holds _caps_lock and
+            # usually the mutation lock)
+            if waits:
+                await asyncio.wait([f for _c, _t, f in waits],
+                                   timeout=self.cap_revoke_timeout)
+            for conn, tid, fut in waits:
+                if fut.done():
+                    attrs = fut.result()
+                    if attrs.get("size_max") is not None:
+                        merged["size_max"] = max(
+                            int(merged.get("size_max", 0)),
+                            int(attrs["size_max"]))
+                        if attrs.get("mtime") is not None:
+                            merged["mtime"] = max(
+                                float(merged.get("mtime", 0)),
+                                float(attrs["mtime"]))
+                        if attrs.get("path"):
+                            merged["path"] = attrs["path"]
+                else:
+                    log.warning("mds.%s: cap revoke on %x timed out;"
+                                " evicting session", self.name, ino)
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                self._cap_acks.pop(tid, None)
+                holders.pop(conn, None)
+            if not holders:
+                self._caps.pop(ino, None)
+        return merged
+
+    async def _revoke_all_caps(self) -> list:
+        """Recall EVERY outstanding cap (directory rename: all cached
+        descendant paths go stale cluster-wide).  Returns the flushed
+        dirty attrs, each carrying the holder's path, for the caller
+        to persist BEFORE the rename moves those paths."""
+        flushes = []
+        for ino in list(self._caps):
+            flush = await self._revoke_caps(ino)
+            if flush.get("size_max") is not None:
+                flushes.append(flush)
+        return flushes
+
+    async def _acquire_cap(self, conn, ino: int,
+                           want: str) -> Tuple[str, Dict[str, Any]]:
+        """Try to grant `want` to conn; returns (granted_mode,
+        flushed_attrs_from_conflicting_holders)."""
+        if conn is None or want not in ("r", "rw"):
+            return "", {}
+        flush: Dict[str, Any] = {}
+        async with self._caps_lock:
+            holders = self._caps.get(ino, {})
+            conflict = any(
+                c is not conn and (want == "rw" or m == "rw")
+                for c, m in holders.items())
+        if conflict:
+            flush = await self._revoke_caps(ino, keep=conn)
+        async with self._caps_lock:
+            holders = self._caps.setdefault(ino, {})
+            # re-check under the lock: a rival grant may have landed
+            # between the revoke and here — then no cap this time
+            # (correctness first; the client just doesn't cache)
+            if any(c is not conn and (want == "rw" or m == "rw")
+                   for c, m in holders.items()):
+                if not holders:
+                    self._caps.pop(ino, None)
+                return "", flush
+            holders[conn] = want
+        return want, flush
+
+    async def _apply_flush(self, flush: Dict[str, Any],
+                           path: str) -> None:
+        """Persist dirty attrs collected by a recall (the cap-flush
+        commit): max-merge the size under the mutation lock."""
+        if flush.get("size_max") is None or not path:
+            return
+        async with self._mutation_lock:
+            await self._apply_flush_locked(flush, path)
+
+    async def _apply_flush_locked(self, flush: Dict[str, Any],
+                                  path: str) -> None:
+        """As _apply_flush, for callers already holding the mutation
+        lock (mutation handlers persisting bystander flushes)."""
+        if flush.get("size_max") is None or not path:
+            return
+        try:
+            parent, name, inode = await self._resolve(path)
+        except MDSError:
+            return  # path raced away; flush has nowhere to land
+        if inode is None or inode.get("type") != "file":
+            return
+        new = max(inode.get("size", 0), int(flush["size_max"]))
+        if new != inode.get("size"):
+            inode["size"] = new
+            inode["mtime"] = float(flush.get("mtime", self._now()))
+            await self._commit([self._dentry(parent, name, inode)])
+
+    def _drop_all_caps(self) -> None:
+        """Step-down/shutdown: tell every holder to forget its caps
+        (no ack expected — we may be fenced already), then clear."""
+        sent = set()
+        for ino, holders in self._caps.items():
+            for conn in holders:
+                if id(conn) in sent:
+                    continue
+                sent.add(id(conn))
+                try:
+                    self.msgr._spawn(conn.send(
+                        MClientCaps("evict", 0)))
+                except Exception:
+                    pass
+        self._caps.clear()
+
     # -- path resolution (MDCache::path_traverse role) ---------------------
 
     async def _resolve(self, path: str) -> Tuple[int, str,
@@ -451,6 +630,20 @@ class MDSDaemon:
     # -- request dispatch (Server::handle_client_request role) -------------
 
     async def _dispatch(self, conn: Connection, msg: Message) -> None:
+        if isinstance(msg, MClientCaps):
+            if msg.op == "ack":
+                fut = self._cap_acks.get(msg.tid)
+                if fut is not None and not fut.done():
+                    fut.set_result(msg.attrs)
+            elif msg.op == "release":
+                # voluntary cap return (dirty attrs were flushed via a
+                # regular setattr first): just drop the table entry
+                holders = self._caps.get(msg.ino)
+                if holders is not None:
+                    holders.pop(conn, None)
+                    if not holders:
+                        self._caps.pop(msg.ino, None)
+            return
         if not isinstance(msg, MClientRequest):
             return
         if self.state != "active":
@@ -464,10 +657,10 @@ class MDSDaemon:
             return
         try:
             if msg.op in ("lookup", "readdir", "stat", "readlink"):
-                rc, out = await handler(msg.args)   # lock-free reads
+                rc, out = await handler(msg.args, conn)  # lock-free reads
             else:
                 async with self._mutation_lock:
-                    rc, out = await handler(msg.args)
+                    rc, out = await handler(msg.args, conn)
         except MDSError as e:
             rc, out = e.rc, {"error": str(e)}
         except ObjectNotFound:
@@ -488,7 +681,8 @@ class MDSDaemon:
     def _now() -> float:
         return time.time()
 
-    async def _op_mkdir(self, args) -> Tuple[int, Dict[str, Any]]:
+    async def _op_mkdir(self, args,
+                        conn=None) -> Tuple[int, Dict[str, Any]]:
         parent, name, existing = await self._resolve(args["path"])
         if not name:
             return EEXIST, {}
@@ -502,7 +696,8 @@ class MDSDaemon:
                             self._dentry(parent, name, inode)])
         return 0, {"inode": inode}
 
-    async def _op_create(self, args) -> Tuple[int, Dict[str, Any]]:
+    async def _op_create(self, args,
+                         conn=None) -> Tuple[int, Dict[str, Any]]:
         parent, name, existing = await self._resolve(args["path"])
         if not name:
             return EISDIR, {}
@@ -511,16 +706,31 @@ class MDSDaemon:
                 return EISDIR, {}
             if args.get("exclusive"):
                 return EEXIST, {}
-            return 0, {"inode": existing}
+            # open of an existing file: recall conflicting holders
+            # (an opener wanting rw must flush/stop every caching
+            # reader; a reader-opener must flush a foreign writer)
+            cap, flush = await self._acquire_cap(
+                conn, existing["ino"], args.get("want", ""))
+            if flush.get("size_max") is not None:
+                existing["size"] = max(existing.get("size", 0),
+                                       int(flush["size_max"]))
+                existing["mtime"] = float(
+                    flush.get("mtime", self._now()))
+                await self._commit([self._dentry(parent, name,
+                                                 existing)])
+            return 0, {"inode": existing, "cap": cap}
         ino = await self._alloc_ino()
         inode = {"ino": ino, "type": "file",
                  "mode": args.get("mode", 0o644),
                  "size": 0, "mtime": self._now(),
                  "block_size": int(args.get("block_size", 1 << 22))}
         await self._commit([self._dentry(parent, name, inode)])
-        return 0, {"inode": inode}
+        cap, _ = await self._acquire_cap(conn, ino,
+                                         args.get("want", ""))
+        return 0, {"inode": inode, "cap": cap}
 
-    async def _op_symlink(self, args) -> Tuple[int, Dict[str, Any]]:
+    async def _op_symlink(self, args,
+                          conn=None) -> Tuple[int, Dict[str, Any]]:
         parent, name, existing = await self._resolve(args["path"])
         if not name or existing is not None:
             return EEXIST, {}
@@ -531,15 +741,29 @@ class MDSDaemon:
         await self._commit([self._dentry(parent, name, inode)])
         return 0, {"inode": inode}
 
-    async def _op_lookup(self, args) -> Tuple[int, Dict[str, Any]]:
+    async def _op_lookup(self, args,
+                         conn=None) -> Tuple[int, Dict[str, Any]]:
         _parent, _name, inode = await self._resolve(args["path"])
         if inode is None:
             return ENOENT, {}
-        return 0, {"inode": inode}
+        want = args.get("want", "")
+        if not want:
+            return 0, {"inode": inode}
+        # grant a cap so the client may cache this answer; recalling a
+        # foreign writer first means the size we serve (and the flush
+        # we persist) is current — the rdlock-revokes-Fw discipline
+        cap, flush = await self._acquire_cap(conn, inode["ino"], want)
+        if flush.get("size_max") is not None:
+            await self._apply_flush(flush, args["path"])
+            _p, _n, inode = await self._resolve(args["path"])
+            if inode is None:
+                return ENOENT, {}
+        return 0, {"inode": inode, "cap": cap}
 
     _op_stat = _op_lookup
 
-    async def _op_readlink(self, args) -> Tuple[int, Dict[str, Any]]:
+    async def _op_readlink(self, args,
+                           conn=None) -> Tuple[int, Dict[str, Any]]:
         _p, _n, inode = await self._resolve(args["path"])
         if inode is None:
             return ENOENT, {}
@@ -547,7 +771,8 @@ class MDSDaemon:
             return EINVAL, {}
         return 0, {"target": inode["target"]}
 
-    async def _op_readdir(self, args) -> Tuple[int, Dict[str, Any]]:
+    async def _op_readdir(self, args,
+                          conn=None) -> Tuple[int, Dict[str, Any]]:
         _parent, _name, inode = await self._resolve(args["path"])
         if inode is None:
             return ENOENT, {}
@@ -556,16 +781,24 @@ class MDSDaemon:
         entries = await self._load_dir(inode["ino"])
         return 0, {"entries": {n: i for n, i in sorted(entries.items())}}
 
-    async def _op_unlink(self, args) -> Tuple[int, Dict[str, Any]]:
+    async def _op_unlink(self, args,
+                         conn=None) -> Tuple[int, Dict[str, Any]]:
         parent, name, inode = await self._resolve(args["path"])
         if inode is None:
             return ENOENT, {}
         if inode["type"] == "dir":
             return EISDIR, {}
+        # recall ALL caps (requester's too — the inode is dying); a
+        # writer's flushed size feeds the purge block count
+        flush = await self._revoke_caps(inode["ino"])
+        if flush.get("size_max") is not None:
+            inode["size"] = max(inode.get("size", 0),
+                                int(flush["size_max"]))
         await self._commit([self._dentry(parent, name, None)])
         return 0, {"inode": inode}  # client purges the data objects
 
-    async def _op_rmdir(self, args) -> Tuple[int, Dict[str, Any]]:
+    async def _op_rmdir(self, args,
+                        conn=None) -> Tuple[int, Dict[str, Any]]:
         parent, name, inode = await self._resolve(args["path"])
         if inode is None:
             return ENOENT, {}
@@ -574,11 +807,13 @@ class MDSDaemon:
         entries = await self._load_dir(inode["ino"])
         if entries:
             return ENOTEMPTY, {}
+        await self._revoke_caps(inode["ino"])
         await self._commit([self._dentry(parent, name, None),
                             {"op": "rmdirobj", "ino": inode["ino"]}])
         return 0, {}
 
-    async def _op_rename(self, args) -> Tuple[int, Dict[str, Any]]:
+    async def _op_rename(self, args,
+                         conn=None) -> Tuple[int, Dict[str, Any]]:
         src_parent, src_name, inode = await self._resolve(args["src"])
         if inode is None:
             return ENOENT, {}
@@ -586,6 +821,9 @@ class MDSDaemon:
             args["dst"])
         if not dst_name:
             return EINVAL, {}
+        # VALIDATE FIRST: recalls collect writers' dirty sizes, and an
+        # error return after a recall would discard a flush that only
+        # _commit can persist
         if existing is not None:
             if existing["type"] == "dir":
                 if inode["type"] != "dir":
@@ -594,6 +832,27 @@ class MDSDaemon:
                     return ENOTEMPTY, {}
             elif inode["type"] == "dir":
                 return ENOTDIR, {}
+        # recall caps on the moved inode (cached paths go stale) and
+        # fold a writer's dirty size into the dentry we re-link; the
+        # clobbered target's caps go too (it is dying), its flushed
+        # size feeding the purge.  Renaming a DIRECTORY invalidates
+        # every descendant's cached PATH on every client — paths are
+        # the cache key, so recall everything (dir renames are rare;
+        # the reference's per-dentry lease recall is finer-grained)
+        if inode["type"] == "dir":
+            # bystander writers' flushed sizes must land while their
+            # paths still resolve (we hold the mutation lock)
+            for fl in await self._revoke_all_caps():
+                await self._apply_flush_locked(fl, fl.get("path", ""))
+        flush = await self._revoke_caps(inode["ino"])
+        if flush.get("size_max") is not None:
+            inode["size"] = max(inode.get("size", 0),
+                                int(flush["size_max"]))
+        if existing is not None and existing["ino"] != inode["ino"]:
+            eflush = await self._revoke_caps(existing["ino"])
+            if eflush.get("size_max") is not None:
+                existing["size"] = max(existing.get("size", 0),
+                                       int(eflush["size_max"]))
         # ONE journal entry carries both dentry ops: rename is
         # crash-atomic — the append is the commit point, replay
         # finishes a half-applied rename (journal.cc EUpdate role).
@@ -615,11 +874,20 @@ class MDSDaemon:
         await self._commit(ops)
         return 0, {"inode": inode}
 
-    async def _op_setattr(self, args) -> Tuple[int, Dict[str, Any]]:
+    async def _op_setattr(self, args,
+                          conn=None) -> Tuple[int, Dict[str, Any]]:
         parent, name, inode = await self._resolve(args["path"])
         if inode is None:
             return ENOENT, {}
+        # a foreign setattr invalidates cached attrs everywhere else;
+        # a foreign writer's dirty size folds in first so an explicit
+        # truncate wins over it but a size_max merge sees it
+        flush = await self._revoke_caps(inode["ino"], keep=conn)
         changed = False
+        if flush.get("size_max") is not None and "size" not in args:
+            new = max(inode.get("size", 0), int(flush["size_max"]))
+            changed = new != inode.get("size")
+            inode["size"] = new
         for key in ("size", "mode", "mtime"):
             if key in args:
                 inode[key] = args[key]

@@ -792,6 +792,41 @@ class MClientReply(Message):
         return cls(dec.u64(), dec.s32(), json.loads(dec.string()))
 
 
+@register
+class MClientCaps(Message):
+    """Capability traffic between MDS and client (MClientCaps.h role).
+
+    MDS -> client: op="revoke" — give up the cap on ino (down to the
+    mode in `cap`, "" = none); the client must drop the matching cache
+    entries, fold any DIRTY buffered attrs into `attrs`, and answer
+    op="ack" with the same tid.  Client -> MDS: op="release" — a
+    voluntary cap return (close of a write handle), attrs carrying the
+    final flushed size/mtime.  Grants ride metadata REPLIES (the
+    `cap` field of MClientReply.out), not this message."""
+
+    TAG = 31
+
+    def __init__(self, op: str, ino: int, cap: str = "",
+                 tid: int = 0, attrs: Optional[Dict[str, Any]] = None):
+        self.op = op
+        self.ino = ino
+        self.cap = cap
+        self.tid = tid
+        self.attrs = attrs or {}
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.string(self.op)
+        enc.u64(self.ino)
+        enc.string(self.cap)
+        enc.u64(self.tid)
+        enc.string(json.dumps(self.attrs))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MClientCaps":
+        return cls(dec.string(), dec.u64(), dec.string(), dec.u64(),
+                   json.loads(dec.string()))
+
+
 # -- mon quorum (Paxos + elections) -----------------------------------------
 
 

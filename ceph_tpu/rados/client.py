@@ -18,6 +18,7 @@ from ceph_tpu.msg import Connection, Messenger
 from ceph_tpu.msg.messages import (
     MAuth,
     MAuthReply,
+    MClientCaps,
     MClientReply,
     MGetMap,
     MMonCommand,
@@ -97,6 +98,9 @@ class RadosClient:
         self._watches: Dict[Tuple[int, str, int], tuple] = {}
         self._watch_cookie = 0
         self._watch_keepalive: Optional[asyncio.Task] = None
+        # CephFS cap recalls arriving on this shared messenger are
+        # routed to the mounted filesystem (set by CephFS.__init__)
+        self.fs_caps_handler = None
 
     def _next_watch_cookie(self) -> int:
         self._watch_cookie += 1
@@ -182,6 +186,9 @@ class RadosClient:
                                                 msg.cookie))
             except (ConnectionError, OSError):
                 pass
+        elif isinstance(msg, MClientCaps):
+            if self.fs_caps_handler is not None:
+                await self.fs_caps_handler(conn, msg)
         elif isinstance(msg, (MAuthReply,
                               MOSDOpReply, MMonCommandReply,
                               MOSDCommandReply, MClientReply)):
