@@ -65,6 +65,12 @@ class CephFS:
         self.caps_ttl = caps_ttl
         self._caps: Dict[int, str] = {}            # ino -> "r"|"rw"
         self._cap_expiry: Dict[int, float] = {}    # ino -> monotonic
+        # the Connection each cap was granted on: a silent reconnect
+        # makes a NEW conn at the same addr, and the MDS evicted our
+        # caps when the old one died — identity, not liveness, is the
+        # validity test
+        self._cap_conn: Dict[int, Any] = {}
+        self._last_mds_conn: Any = None
         self._attr_cache: Dict[str, dict] = {}     # path -> inode
         self._ino_paths: Dict[int, Set[str]] = {}  # reverse index
         # ino -> buffered dirty attrs awaiting flush (rw caps only)
@@ -90,6 +96,7 @@ class CephFS:
             self._trim_caps()
         self._caps[ino] = cap
         self._cap_expiry[ino] = time.monotonic() + self.caps_ttl
+        self._cap_conn[ino] = self._last_mds_conn
         self._attr_cache[path] = inode
         self._ino_paths.setdefault(ino, set()).add(path)
 
@@ -114,12 +121,14 @@ class CephFS:
     def _drop_ino(self, ino: int) -> None:
         self._caps.pop(ino, None)
         self._cap_expiry.pop(ino, None)
+        self._cap_conn.pop(ino, None)
         for path in self._ino_paths.pop(ino, set()):
             self._attr_cache.pop(path, None)
 
     def _drop_all_caps(self) -> None:
         self._caps.clear()
         self._cap_expiry.clear()
+        self._cap_conn.clear()
         self._attr_cache.clear()
         self._ino_paths.clear()
         # dirty sizes survive — close()/flush() re-sends them through
@@ -136,7 +145,11 @@ class CephFS:
             return False
         addr = self._mds_addr
         conn = self.client.msgr._conns.get(addr) if addr else None
-        if conn is None or conn.closed:
+        granted_on = self._cap_conn.get(ino)
+        if conn is None or conn.closed or conn is not granted_on:
+            # the granting connection is gone (or a reconnect minted a
+            # new one): the MDS evicted us with it, so every cached
+            # answer it covered is suspect
             self._drop_all_caps()
             return False
         return True
@@ -166,7 +179,11 @@ class CephFS:
             await conn.send(MClientCaps("ack", msg.ino, tid=msg.tid,
                                         attrs=attrs))
         except (ConnectionError, OSError):
-            pass  # conn died: the MDS evicts us on timeout/fault
+            # conn died mid-ack: the MDS evicts us on timeout/fault,
+            # but the buffered attrs never reached it — restore them
+            # so close()/flush() re-sends through the ordinary path
+            if attrs:
+                self._dirty.setdefault(msg.ino, attrs)
 
     def _note_dirty(self, ino: int, path: str, size: int,
                     mtime: float) -> None:
@@ -229,8 +246,9 @@ class CephFS:
                 asyncio.get_running_loop().create_future()
             self.client._futures[tid] = fut
             try:
-                await self.client.msgr.send_to(
-                    self._mds_addr, MClientRequest(tid, op, args))
+                conn = await self.client.msgr.connect(self._mds_addr)
+                self._last_mds_conn = conn  # caps bind to THIS conn
+                await conn.send(MClientRequest(tid, op, args))
                 reply = await asyncio.wait_for(fut, 10.0)
             except (ConnectionError, OSError,
                     asyncio.TimeoutError) as e:

@@ -125,17 +125,94 @@ def derive_session(base_key: bytes, nonce_a: bytes,
     return _prf(base_key, b"cephx-session", nonce_a, nonce_b)
 
 
-def seal(session_key: bytes, role: bytes, seq: int,
-         data: bytes) -> bytes:
-    """On-wire encryption (the msgr2 secure-mode role,
-    /root/reference/src/msg/async/crypto_onwire.cc — AES-GCM there):
-    XOR with a SHAKE-256 keystream keyed by (session key, direction
-    role, frame seq).  The nonce never repeats: session keys are
-    per-connection, seqs are strictly increasing per direction, and
-    the role byte separates the two directions' streams.  Integrity
-    comes from the frame signature (HMAC over preamble+ciphertext).
-    Deliberate substitution documented: stdlib has no AES; SHAKE-256
-    as a keyed XOF is a standard PRF-stream construction."""
+# -- secure mode (crypto_onwire.cc AES-GCM role) ----------------------------
+#
+# seal/unseal wrap each secure frame's payload in AES-256-GCM: the
+# 12-byte nonce is role(1) || seq(8, big-endian) || 0^3 — it never
+# repeats under a key because session keys are per-connection, seqs are
+# strictly increasing per direction, and the role byte separates the
+# two directions' streams (the reference's distinct c->s / s->c nonce
+# halves, crypto_onwire.cc:34-46).  Output = mode byte || ciphertext ||
+# 16-byte tag; a receiver REJECTS any mode weaker than its best (a
+# MITM must not be able to downgrade two AEAD-capable peers to the
+# keystream fallback by flipping the mode byte).
+#
+# The AEAD is the in-repo native C++ implementation (native/src/
+# aesgcm.cc, validated bit-exact against `cryptography`'s OpenSSL-
+# backed AESGCM); `cryptography` is the second choice, and the old
+# SHAKE-256 keystream XOR (integrity from the frame signature) remains
+# only as the no-compiler, no-cryptography fallback.
+
+MODE_XOR = 0x00
+MODE_AESGCM = 0x01
+
+_aead_impl = None  # resolved lazily: "native" | "cryptography" | None
+
+
+def _resolve_aead() -> Optional[str]:
+    global _aead_impl
+    if _aead_impl is not None:
+        return _aead_impl or None
+    impl = ""
+    try:
+        from ceph_tpu import native
+
+        lib = native.get_lib()
+        if lib is not None and hasattr(lib, "ceph_tpu_aesgcm_seal"):
+            impl = "native"
+    except Exception:
+        pass
+    if not impl:
+        try:
+            from cryptography.hazmat.primitives.ciphers.aead import (  # noqa: F401
+                AESGCM,
+            )
+
+            impl = "cryptography"
+        except Exception:
+            impl = ""
+    _aead_impl = impl
+    return impl or None
+
+
+def _gcm_nonce(role: bytes, seq: int) -> bytes:
+    return (role or b"?")[:1] + seq.to_bytes(8, "big") + b"\x00\x00\x00"
+
+
+def _gcm_key(session_key: bytes) -> bytes:
+    # session keys are HMAC-SHA256 outputs (32 bytes) — AES-256 direct
+    return session_key if len(session_key) == 32 else \
+        hashlib.sha256(session_key).digest()
+
+
+def _native_gcm(op: str, key: bytes, nonce: bytes,
+                data: bytes) -> Optional[bytes]:
+    import ctypes
+
+    from ceph_tpu import native
+
+    lib = native.get_lib()
+    u8 = ctypes.c_uint8
+    n = len(data)
+    if op == "seal":
+        out = (u8 * (n + 16))()
+        fn, outlen = lib.ceph_tpu_aesgcm_seal, n + 16
+    else:
+        if n < 16:
+            return None
+        out = (u8 * max(1, n - 16))()
+        fn, outlen = lib.ceph_tpu_aesgcm_open, n - 16
+    src = (u8 * max(1, n)).from_buffer_copy(data or b"\x00")
+    rc = fn((u8 * 32).from_buffer_copy(key),
+            (u8 * 12).from_buffer_copy(nonce),
+            (u8 * 1)(), 0, src, n, out)
+    if rc != 0:
+        return None
+    return bytes(out[:outlen])
+
+
+def _xor_keystream(session_key: bytes, role: bytes, seq: int,
+                   data: bytes) -> bytes:
     if not data:
         return data
     ks = hashlib.shake_256(
@@ -147,7 +224,58 @@ def seal(session_key: bytes, role: bytes, seq: int,
     return (a ^ b).tobytes()
 
 
-unseal = seal  # XOR stream: decryption is the same operation
+def seal(session_key: bytes, role: bytes, seq: int,
+         data: bytes) -> bytes:
+    impl = _resolve_aead()
+    if impl is None:
+        return bytes([MODE_XOR]) + _xor_keystream(session_key, role,
+                                                  seq, data)
+    key, nonce = _gcm_key(session_key), _gcm_nonce(role, seq)
+    if impl == "native":
+        ct = _native_gcm("seal", key, nonce, data)
+        if ct is not None:
+            return bytes([MODE_AESGCM]) + ct
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    return bytes([MODE_AESGCM]) + AESGCM(key).encrypt(nonce, data,
+                                                      None)
+
+
+class SealError(Exception):
+    """Authentication failure or downgrade attempt on a secure frame."""
+
+
+def unseal(session_key: bytes, role: bytes, seq: int,
+           data: bytes) -> bytes:
+    if not data:
+        raise SealError("empty secure payload")
+    mode, body = data[0], data[1:]
+    impl = _resolve_aead()
+    if mode == MODE_AESGCM:
+        if impl is None:
+            raise SealError("peer sent AES-GCM but no AEAD available")
+        key, nonce = _gcm_key(session_key), _gcm_nonce(role, seq)
+        if impl == "native":
+            pt = _native_gcm("open", key, nonce, body)
+            if pt is None:
+                raise SealError("AES-GCM tag mismatch")
+            return pt
+        from cryptography.exceptions import InvalidTag
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        try:
+            return AESGCM(key).decrypt(nonce, body, None)
+        except InvalidTag:
+            raise SealError("AES-GCM tag mismatch")
+    if mode == MODE_XOR:
+        if impl is not None:
+            # both of us could do AEAD: a keystream frame here is a
+            # downgrade (an attacker flipping the mode byte), not a
+            # legitimate fallback
+            raise SealError("keystream frame from an AEAD-capable"
+                            " peer: downgrade rejected")
+        return _xor_keystream(session_key, role, seq, body)
+    raise SealError(f"unknown secure mode {mode:#x}")
 
 
 # -- mon-as-KDC tickets ------------------------------------------------------

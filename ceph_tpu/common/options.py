@@ -131,6 +131,15 @@ def _opts() -> List[Option]:
         Option("ms_inject_socket_failures", "uint", 0, D,
                desc="inject a socket failure every Nth message"),
         Option("ms_inject_internal_delays", "float", 0.0, D),
+        # -- wire compression (ms_osd_compress_mode family) ----------------
+        Option("ms_compress_methods", "str", "", A,
+               desc="csv of accepted wire compression methods, in"
+                    " preference order (empty = off)"),
+        Option("ms_compress_min_size", "size", 4096, A,
+               desc="frames below this never compress"),
+        Option("ms_compress_secure", "bool", False, A,
+               desc="allow compression on AEAD-secured connections"
+                    " (length side channel: off by default)"),
         Option("ms_dispatch_throttle_bytes", "size", 100 << 20, A),
         Option("osd_heartbeat_interval", "secs", 6.0, A, min=0.1, max=60),
         Option("osd_heartbeat_grace", "secs", 20.0, A),

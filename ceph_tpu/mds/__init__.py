@@ -467,49 +467,58 @@ class MDSDaemon:
         unresponsive holder is evicted after cap_revoke_timeout — a
         dead client must not wedge the namespace (Locker's
         session-autoclose discipline)."""
-        merged: Dict[str, Any] = {}
+        return (await self._revoke_many([ino], keep=keep)).get(ino, {})
+
+    async def _revoke_many(self, inos, keep: Any = None
+                           ) -> Dict[int, Dict[str, Any]]:
+        """Recall caps on every listed inode at once: ALL revokes go
+        out first, then ALL acks are awaited under ONE shared timeout
+        — a directory rename recalling thousands of inodes (or N
+        unresponsive holders) costs one cap_revoke_timeout total, not
+        one per inode, while this stall holds _caps_lock and usually
+        the mutation lock."""
+        merged: Dict[int, Dict[str, Any]] = {}
         async with self._caps_lock:
-            holders = self._caps.get(ino)
-            if not holders:
-                return merged
             waits = []
-            for conn, _mode in list(holders.items()):
-                if conn is keep:
+            for ino in inos:
+                holders = self._caps.get(ino)
+                if not holders:
                     continue
-                self._cap_tid += 1
-                tid = self._cap_tid
-                fut: asyncio.Future = \
-                    asyncio.get_running_loop().create_future()
-                fut._cap_conn = conn
-                self._cap_acks[tid] = fut
-                try:
-                    await conn.send(MClientCaps("revoke", ino,
-                                                tid=tid))
-                except (ConnectionError, OSError):
-                    self._cap_acks.pop(tid, None)
-                    holders.pop(conn, None)
-                    continue
-                waits.append((conn, tid, fut))
-            # wait for all acks CONCURRENTLY under one shared timeout:
-            # N unresponsive holders must cost cap_revoke_timeout
-            # total, not N times it (this stall holds _caps_lock and
-            # usually the mutation lock)
+                for conn, _mode in list(holders.items()):
+                    if conn is keep:
+                        continue
+                    self._cap_tid += 1
+                    tid = self._cap_tid
+                    fut: asyncio.Future = \
+                        asyncio.get_running_loop().create_future()
+                    fut._cap_conn = conn
+                    self._cap_acks[tid] = fut
+                    try:
+                        await conn.send(MClientCaps("revoke", ino,
+                                                    tid=tid))
+                    except (ConnectionError, OSError):
+                        self._cap_acks.pop(tid, None)
+                        holders.pop(conn, None)
+                        continue
+                    waits.append((ino, conn, tid, fut))
             if waits:
-                await asyncio.wait([f for _c, _t, f in waits],
+                await asyncio.wait([f for _i, _c, _t, f in waits],
                                    timeout=self.cap_revoke_timeout)
-            for conn, tid, fut in waits:
+            for ino, conn, tid, fut in waits:
+                holders = self._caps.get(ino, {})
                 if fut.done():
                     attrs = fut.result()
                     if attrs.get("size_max") is not None:
-                        merged["size_max"] = max(
-                            int(merged.get("size_max", 0)),
+                        m = merged.setdefault(ino, {})
+                        m["size_max"] = max(
+                            int(m.get("size_max", 0)),
                             int(attrs["size_max"]))
                         if attrs.get("mtime") is not None:
-                            merged["mtime"] = max(
-                                float(merged.get("mtime", 0)),
+                            m["mtime"] = max(
+                                float(m.get("mtime", 0)),
                                 float(attrs["mtime"]))
                         if attrs.get("path"):
-                            merged["path"] = attrs["path"]
+                            m["path"] = attrs["path"]
                 else:
                     log.warning("mds.%s: cap revoke on %x timed out;"
                                 " evicting session", self.name, ino)
@@ -519,21 +528,19 @@ class MDSDaemon:
                         pass
                 self._cap_acks.pop(tid, None)
                 holders.pop(conn, None)
-            if not holders:
-                self._caps.pop(ino, None)
+                if not holders:
+                    self._caps.pop(ino, None)
         return merged
 
     async def _revoke_all_caps(self) -> list:
         """Recall EVERY outstanding cap (directory rename: all cached
-        descendant paths go stale cluster-wide).  Returns the flushed
-        dirty attrs, each carrying the holder's path, for the caller
-        to persist BEFORE the rename moves those paths."""
-        flushes = []
-        for ino in list(self._caps):
-            flush = await self._revoke_caps(ino)
-            if flush.get("size_max") is not None:
-                flushes.append(flush)
-        return flushes
+        descendant paths go stale cluster-wide) in ONE batched round.
+        Returns the flushed dirty attrs, each carrying the holder's
+        path, for the caller to persist BEFORE the rename moves those
+        paths."""
+        merged = await self._revoke_many(list(self._caps))
+        return [fl for fl in merged.values()
+                if fl.get("size_max") is not None]
 
     async def _acquire_cap(self, conn, ino: int,
                            want: str) -> Tuple[str, Dict[str, Any]]:

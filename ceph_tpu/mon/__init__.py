@@ -112,6 +112,7 @@ class MonDaemon:
             self.config.get("ms_inject_socket_failures", 0) or 0)
         self.msgr.inject_internal_delays = float(
             self.config.get("ms_inject_internal_delays", 0) or 0)
+        self.msgr.apply_compress_config(self.config)
         # durable state (the MonitorDBStore role,
         # /root/reference/src/mon/MonitorDBStore.h): every commit writes
         # the incremental, the resulting full map, and the auxiliary

@@ -78,6 +78,13 @@ class Connection:
         self.session_ready = asyncio.Event()
         self.my_nonce: bytes = b""
         self.base_key: Optional[bytes] = None  # connector side choice
+        # per-direction compression, negotiated from the two hellos
+        # (frames_v2 compression negotiation): tx = first method the
+        # PEER accepts that we support; rx = first method WE accept
+        # that the peer supports.  None until the peer's hello arrives.
+        self.peer_compress: tuple = ()
+        self._tx_comp = None   # (name, Compressor) | None
+        self._rx_comp = None
         # acceptor replies with the CONNECTOR's kid: during rotation a
         # peer still on the old key must be able to verify our hello
         self.reply_kid: Optional[int] = None
@@ -111,6 +118,35 @@ class Connection:
             key = self.session_key
         await self._send_signed(msg, key)
 
+    def _negotiated_comp(self, direction: str):
+        """Resolve (lazily) the compressor for one direction from the
+        two advertised method lists; None = no common method."""
+        cached = self._tx_comp if direction == "tx" else self._rx_comp
+        if cached is not None:
+            return cached[1]
+        mine = self.messenger.compress_methods
+        theirs = self.peer_compress
+        if not mine or not theirs:
+            return None
+        from ceph_tpu.compressor import Compressor
+
+        # the RECEIVER's preference order rules: tx picks from the
+        # peer's list, rx from ours — both sides compute the same
+        # method for each direction
+        prefer, support = (theirs, mine) if direction == "tx" \
+            else (mine, theirs)
+        for name in prefer:
+            if name in support:
+                comp = Compressor.create(name)
+                if comp is not None:
+                    pair = (name, comp)
+                    if direction == "tx":
+                        self._tx_comp = pair
+                    else:
+                        self._rx_comp = pair
+                    return comp
+        return None
+
     async def _send_signed(self, msg: Message,
                            key: Optional[bytes]) -> None:
         if self.closed:
@@ -119,13 +155,29 @@ class Connection:
         seq = next(self._seq)
         payload = msg.encode()
         flags = 0
+        m = self.messenger
+        if not isinstance(msg, MHello) \
+                and len(payload) >= m.compress_min_size \
+                and (not m.secure or m.compress_secure):
+            # negotiated wire compression (frames_v2 compression role;
+            # secure connections compress only when ms_compress_secure
+            # says so — compress-then-encrypt leaks payload entropy)
+            comp = self._negotiated_comp("tx")
+            if comp is not None:
+                import struct as _struct
+
+                blob, cmsg = comp.compress(bytes(payload))
+                if len(blob) + 4 < len(payload):
+                    payload = _struct.pack(
+                        "<i", -1 if cmsg is None else cmsg) + blob
+                    flags |= frames.FLAG_COMPRESSED
         if key is not None and key is self.session_key and \
                 self.messenger.secure:
-            # secure mode: the payload rides encrypted under the
-            # session keystream (hellos stay plaintext — they carry
-            # no secrets and exist before the session does)
+            # secure mode: the payload rides AEAD-sealed under the
+            # session key (hellos stay plaintext — they carry no
+            # secrets and exist before the session does)
             payload = auth.seal(key, self._tx_role(), seq, payload)
-            flags = frames.FLAG_SECURE
+            flags |= frames.FLAG_SECURE
         parts = frames.encode_frame_parts(msg.TAG, seq,
                                           payload, flags=flags,
                                           key=key,
@@ -154,7 +206,8 @@ class Connection:
                 else self.reply_kid
             key = m.secret.get(kid)
         hello = MHello(m.entity_name, m.addr, nonce=self.my_nonce,
-                       kid=kid, ticket=ticket)
+                       kid=kid, ticket=ticket,
+                       compression=",".join(m.compress_methods))
         await self._send_signed(hello, key)
 
     def close(self) -> None:
@@ -298,6 +351,45 @@ class Messenger:
         self.inject_socket_failures: int = 0
         self.inject_internal_delays: float = 0.0
         self._inject_rng = random.Random()
+        # wire compression (ms_compress_* options): methods this
+        # endpoint ACCEPTS, advertised in its hello, in preference
+        # order; empty = no compression.  min_size gates tiny frames
+        # (compression overhead beats the saving); compress_secure
+        # must be opted into (compressed-then-encrypted length leaks)
+        self.compress_methods: tuple = ()
+        self.compress_min_size: int = 4096
+        self.compress_secure: bool = False
+
+    def apply_compress_config(self, config: dict) -> None:
+        """Wire the ms_compress_* options into this endpoint.  The
+        advertised list is filtered to codecs that actually LOAD here:
+        negotiation is computed independently on both ends from the
+        two advertised lists, so advertising a codec this host cannot
+        instantiate would make the two ends settle on different
+        methods for one direction — every bulk frame would then die in
+        decompression."""
+        from ceph_tpu.compressor import Compressor
+
+        methods = []
+        for name in str(config.get("ms_compress_methods", "")
+                        or "").split(","):
+            name = name.strip()
+            if not name or name == "random":
+                continue  # "random" diverges per instantiation
+            if Compressor.create(name) is None:
+                log.warning("%s: compression method %r unavailable"
+                            " here; not advertising it",
+                            self.entity_name, name)
+                continue
+            methods.append(name)
+        self.compress_methods = tuple(methods)
+        try:
+            self.compress_min_size = int(config.get(
+                "ms_compress_min_size", 4096))
+        except (TypeError, ValueError):
+            pass
+        self.compress_secure = bool(config.get("ms_compress_secure",
+                                               False))
 
     # stream buffer: bulk data frames are multi-MiB; the 64 KiB default
     # limit makes readexactly assemble them from ~64 tiny feeds
@@ -466,6 +558,8 @@ class Messenger:
         conn.rx_seq = seq
         conn.peer_name = msg.entity_name
         conn.peer_addr = msg.addr or conn.peer_addr
+        conn.peer_compress = tuple(
+            x for x in getattr(msg, "compression", "").split(",") if x)
         if conn.outbound:
             # acceptor's reply (never ticket-bearing): session =
             # f(base chosen at connect, my_nonce, its_nonce)
@@ -526,6 +620,21 @@ class Messenger:
                     elif self.secure:
                         raise frames.FrameError(
                             "plaintext frame but secure mode required")
+                if flags & frames.FLAG_COMPRESSED:
+                    comp = conn._negotiated_comp("rx")
+                    if comp is None:
+                        raise frames.FrameError(
+                            "compressed frame but no negotiated codec")
+                    import struct as _struct
+
+                    (cmsg,) = _struct.unpack_from("<i", payload)
+                    try:
+                        payload = comp.decompress(
+                            bytes(payload[4:]),
+                            None if cmsg < 0 else cmsg)
+                    except Exception as e:
+                        raise frames.FrameError(
+                            f"decompression failed: {e}")
                 msg = decode_message(tag, payload)
                 if isinstance(msg, MHello):
                     # keyless endpoint: hellos are identification only
@@ -534,6 +643,16 @@ class Messenger:
                     # peers refuse keyless clusters by design)
                     conn.peer_name = msg.entity_name
                     conn.peer_addr = msg.addr or conn.peer_addr
+                    conn.peer_compress = tuple(
+                        x for x in getattr(msg, "compression",
+                                           "").split(",") if x)
+                    if not conn.outbound and \
+                            not getattr(conn, "_hello_sent", False):
+                        # identify back: the connector needs OUR
+                        # advertised compression methods (and name)
+                        # to finish the per-direction negotiation
+                        conn._hello_sent = True
+                        await conn.send_hello()
                     continue
                 if self.dispatcher is not None:
                     # fast dispatch: run handlers concurrently so a slow
@@ -541,7 +660,7 @@ class Messenger:
                     self._spawn(self._dispatch_one(conn, msg))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass  # peer went away: lossy policy, just forget it
-        except frames.FrameError as e:
+        except (frames.FrameError, auth.SealError) as e:
             log.warning("%s: dropping %s: %s", self.entity_name, conn, e)
         except asyncio.CancelledError:
             raise

@@ -39,7 +39,8 @@ MAGIC = 0xCE9F0205
 PREAMBLE = struct.Struct("<IHHQI")
 CRC = struct.Struct("<I")
 FLAG_SIGNED = 0x0001
-FLAG_SECURE = 0x0002  # payload encrypted with the session keystream
+FLAG_SECURE = 0x0002      # payload AEAD-sealed under the session key
+FLAG_COMPRESSED = 0x0004  # payload compressed with the negotiated codec
 
 
 class FrameError(Exception):

@@ -83,20 +83,27 @@ class MHello(Message):
     """Connection handshake: who is on the other end (entity_addr_t
     role).  v2 appends the cephx session-negotiation fields: a fresh
     nonce, the key id the hello is signed with, and an optional
-    mon-granted ticket (CephxSessionHandler / msgr2 auth frames role)."""
+    mon-granted ticket (CephxSessionHandler / msgr2 auth frames role).
+    v3 appends the sender's accepted compression methods (csv, in
+    preference order — the frames_v2 compression negotiation role,
+    /root/reference/src/msg/async/frames_v2.cc)."""
 
     TAG = 1
-    VERSION = 2
+    VERSION = 3
     COMPAT = 1
 
     def __init__(self, entity_name: str, addr: str,
                  nonce: bytes = b"", kid: int = 0,
-                 ticket: bytes = b""):
+                 ticket: bytes = b"", compression: str = ""):
         self.entity_name = entity_name
         self.addr = addr
         self.nonce = nonce
         self.kid = kid
         self.ticket = ticket
+        # set only when non-empty so dumps of pre-v3 blobs (and the
+        # archived corpus) are unchanged
+        if compression:
+            self.compression = compression
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.string(self.entity_name)
@@ -104,6 +111,7 @@ class MHello(Message):
         enc.bytes(self.nonce)
         enc.s32(self.kid)
         enc.bytes(self.ticket)
+        enc.string(getattr(self, "compression", ""))
 
     @classmethod
     def decode(cls, data: bytes) -> "MHello":
@@ -114,6 +122,10 @@ class MHello(Message):
             msg.nonce = dec.bytes()
             msg.kid = dec.s32()
             msg.ticket = dec.bytes()
+        if struct_v >= 3:
+            comp = dec.string()
+            if comp:
+                msg.compression = comp
         dec.finish()
         return msg
 

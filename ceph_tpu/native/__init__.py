@@ -118,6 +118,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             u8p, u64, u64, u8p, u64, u64, u8p, u32p, u64, u32p]
     except AttributeError:  # stale .so without datapath.cc
         pass
+    try:  # AEAD (aesgcm.cc) — msgr2 secure mode
+        for op in ("seal", "open"):
+            fn = getattr(lib, f"ceph_tpu_aesgcm_{op}")
+            fn.restype = ctypes.c_int
+            fn.argtypes = [u8p, u8p, u8p, u64, u8p, u64, u8p]
+    except AttributeError:  # stale .so without aesgcm.cc
+        pass
     return lib
 
 

@@ -657,6 +657,7 @@ class OSDDaemon:
             log.warning("osd.%d: ignoring bad ms_inject_internal_"
                         "delays=%r", self.osd_id,
                         self.config.get("ms_inject_internal_delays"))
+        self.msgr.apply_compress_config(self.config)
 
     def _clog(self, level: str, message: str) -> None:
         """Fire one cluster-log entry at the mon (MLog role)."""

@@ -200,3 +200,128 @@ def test_connection_fault_callback():
         await client.shutdown()
 
     asyncio.run(main())
+
+
+# -- wire compression negotiation (frames_v2 compression role) --------------
+
+
+def _comp_pair(server_methods, client_methods, **kw):
+    server = Messenger("osd.0")
+    client = Messenger("client.1")
+    server.compress_methods = server_methods
+    client.compress_methods = client_methods
+    for k, v in kw.items():
+        setattr(server, k, v)
+        setattr(client, k, v)
+    return server, client
+
+
+def test_compression_negotiated_and_round_trips():
+    """Both ends accept snappy: bulk frames ride compressed (flag on
+    the wire, payload smaller) and round-trip byte-exact."""
+    async def main():
+        server, client = _comp_pair(("snappy", "zlib"), ("snappy",))
+        got = asyncio.Queue()
+        seen_flags = []
+
+        orig = frames.decode_preamble
+
+        def spy(buf):
+            out = orig(buf)
+            seen_flags.append(out[1])
+            return out
+
+        frames.decode_preamble = spy
+        try:
+            async def server_dispatch(conn, msg):
+                await conn.send(MOSDOpReply(msg.tid, 0, msg.ops[0].data))
+
+            server.dispatcher = server_dispatch
+            client.dispatcher = lambda c, m: got.put(m)
+            addr = await server.bind()
+            conn = await client.connect(addr)
+            # compressible payload well over min_size
+            data = b"compress me! " * 20_000
+            await conn.send(MOSDOp(9, "client.1", PgId(1, 0), "o",
+                                   [OSDOp("write", data=data)], 1))
+            reply = await asyncio.wait_for(got.get(), 5)
+            assert bytes(reply.data) == data
+            assert any(f & frames.FLAG_COMPRESSED for f in seen_flags), \
+                "no frame carried FLAG_COMPRESSED"
+            # the first client frame may race the server's hello
+            # (keyless conns negotiate opportunistically); by the
+            # second send both directions are settled on snappy
+            await conn.send(MOSDOp(10, "client.1", PgId(1, 0), "o",
+                                   [OSDOp("write", data=data)], 1))
+            reply = await asyncio.wait_for(got.get(), 5)
+            assert bytes(reply.data) == data
+            assert conn._tx_comp[0] == "snappy"
+        finally:
+            frames.decode_preamble = orig
+            await client.shutdown()
+            await server.shutdown()
+
+    asyncio.run(main())
+
+
+def test_compression_no_common_method_stays_plain():
+    async def main():
+        server, client = _comp_pair(("zlib",), ("snappy",))
+        got = asyncio.Queue()
+
+        async def server_dispatch(conn, msg):
+            await conn.send(MOSDOpReply(msg.tid, 0, msg.ops[0].data))
+
+        server.dispatcher = server_dispatch
+        client.dispatcher = lambda c, m: got.put(m)
+        addr = await server.bind()
+        conn = await client.connect(addr)
+        data = b"plain " * 10_000
+        await conn.send(MOSDOp(1, "client.1", PgId(1, 0), "o",
+                               [OSDOp("write", data=data)], 1))
+        reply = await asyncio.wait_for(got.get(), 5)
+        assert bytes(reply.data) == data
+        assert conn._negotiated_comp("tx") is None
+        await client.shutdown()
+        await server.shutdown()
+
+    asyncio.run(main())
+
+
+def test_compression_secure_gated():
+    """On an AEAD connection compression stays OFF unless
+    ms_compress_secure opts in (length side channel)."""
+    async def main():
+        from ceph_tpu.common import auth as auth_mod
+
+        secret = auth_mod.generate_secret()
+        for opt_in in (False, True):
+            server, client = _comp_pair(("snappy",), ("snappy",),
+                                        compress_secure=opt_in)
+            server.secret = auth_mod.parse_secret(secret)
+            client.secret = auth_mod.parse_secret(secret)
+            server.secure = client.secure = True
+            got = asyncio.Queue()
+
+            async def server_dispatch(conn, msg):
+                await conn.send(MOSDOpReply(msg.tid, 0, b"ok"))
+
+            server.dispatcher = server_dispatch
+            client.dispatcher = lambda c, m: got.put(m)
+            addr = await server.bind()
+            conn = await client.connect(addr)
+            data = b"secret " * 10_000
+            await conn.send(MOSDOp(2, "client.1", PgId(1, 0), "o",
+                                   [OSDOp("write", data=data)], 1))
+            reply = await asyncio.wait_for(got.get(), 5)
+            assert reply.data == b"ok"
+            # inspect what the sender actually did on the last bulk
+            # frame via the negotiated state: with the gate closed the
+            # compressor is never even resolved
+            if not opt_in:
+                assert conn._tx_comp is None, \
+                    "secure frame compressed without ms_compress_secure"
+            await client.shutdown()
+            await server.shutdown()
+
+    asyncio.run(main())
