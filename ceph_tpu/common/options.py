@@ -141,6 +141,21 @@ def _opts() -> List[Option]:
                desc="allow compression on AEAD-secured connections"
                     " (length side channel: off by default)"),
         Option("ms_dispatch_throttle_bytes", "size", 100 << 20, A),
+        # -- elections (options.cc mon_election_*, ElectionLogic.h) --------
+        Option("mon_election_default_strategy", "uint", 1, A,
+               min=1, max=3,
+               desc="1=classic (rank priority), 3=connectivity"
+                    " (reachability-scored candidates)"),
+        Option("mon_elector_ping_interval", "secs", 0.4, A,
+               min=0.05, max=10.0,
+               desc="mon-to-mon liveness probe period feeding the"
+                    " connection tracker"),
+        Option("mon_elector_score_halflife", "secs", 4.0, A,
+               min=0.1, max=3600.0,
+               desc="connectivity score decay half-life"),
+        Option("mon_elector_ignore_propose_margin", "float", 0.05, A,
+               min=0.0, max=1.0,
+               desc="score difference below which rank breaks the tie"),
         Option("osd_heartbeat_interval", "secs", 6.0, A, min=0.1, max=60),
         Option("osd_heartbeat_grace", "secs", 20.0, A),
         Option("mon_osd_min_down_reporters", "uint", 2, A),

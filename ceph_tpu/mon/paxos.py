@@ -40,6 +40,12 @@ log = logging.getLogger("mon.paxos")
 E_PROPOSE = 1
 E_ACK = 2
 E_VICTORY = 3
+E_PING = 4
+E_PONG = 5
+
+# mon_election_default_strategy values (ElectionLogic.h)
+STRATEGY_CLASSIC = 1
+STRATEGY_CONNECTIVITY = 3
 
 # MMonPaxos ops (Paxos.h op names)
 OP_COLLECT = 1
@@ -57,7 +63,64 @@ DEFAULTS = {
     "mon_election_timeout": 2.5,
     "mon_accept_timeout": 2.0,
     "paxos_max_log": 1024,
+    "mon_election_default_strategy": STRATEGY_CLASSIC,
+    "mon_elector_ping_interval": 0.4,
+    "mon_elector_score_halflife": 4.0,
+    "mon_elector_ignore_propose_margin": 0.05,
 }
+
+
+class ConnectionTracker:
+    """Peer-reachability scores for CONNECTIVITY elections.
+
+    Reference parity: /root/reference/src/mon/ConnectionTracker.cc —
+    each mon scores every peer by the fraction of recent ping epochs it
+    answered, decayed with a half-life so old history fades.  The
+    reference gossips full per-peer report blobs and averages everyone's
+    view of a candidate; here each mon keeps its own view and candidates
+    self-report one aggregate in the PROPOSE message — the two views are
+    averaged at the voter (same signal, one float on the wire).
+    """
+
+    def __init__(self, half_life: float = 4.0):
+        self.half_life = max(0.1, float(half_life))
+        # peer -> [score, last_report_monotonic]; unseen peers score 1.0
+        # (a freshly-booted quorum must be electable before any pings)
+        self._scores: Dict[int, List[float]] = {}
+
+    def report(self, peer: int, ok: bool,
+               now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        ent = self._scores.get(peer)
+        if ent is None:
+            ent = [1.0, now]
+            self._scores[peer] = ent
+        score, last = ent
+        # decay the old estimate toward this observation: weight halves
+        # every half_life seconds of elapsed time (so a peer that stops
+        # answering slides to 0 at a rate set by config, not ping count)
+        w = 0.5 ** (max(0.0, now - last) / self.half_life)
+        ent[0] = score * w + (0.0 + ok) * (1.0 - w)
+        ent[1] = now
+
+    def score(self, peer: int) -> float:
+        ent = self._scores.get(peer)
+        return 1.0 if ent is None else ent[0]
+
+    def my_score(self, n: int, me: int) -> float:
+        """Aggregate: mean reachability of every OTHER mon from here —
+        a mon with lossy links sees low scores everywhere, so its own
+        candidacy self-reports weak (get_total_connection_score role)."""
+        others = [self.score(p) for p in range(n) if p != me]
+        return sum(others) / len(others) if others else 1.0
+
+    def best_link(self, n: int, me: int) -> float:
+        """Max peer score: distinguishes 'I am healthy, THAT peer is
+        lossy' (max ~1: some link is solid) from 'MY links are lossy'
+        (max low: every view is degraded).  The mean cannot tell the
+        two apart — both drag it down."""
+        others = [self.score(p) for p in range(n) if p != me]
+        return max(others) if others else 1.0
 
 
 class MemStore:
@@ -100,8 +163,10 @@ class MemStore:
 
 
 class Elector:
-    """Rank-priority elections: the lowest rank that a majority can
-    reach wins (ElectionLogic's CLASSIC strategy)."""
+    """Elections (ElectionLogic.cc strategies): CLASSIC — the lowest
+    rank a majority can reach wins; CONNECTIVITY — candidates carry a
+    reachability score and voters defer to the best-connected candidate
+    (rank only breaks ties), so a flapping low-rank mon stops winning."""
 
     def __init__(self, rank: int, n: int,
                  send: Callable[[int, Any], Awaitable[None]],
@@ -124,13 +189,119 @@ class Elector:
         # this, two proposers can both assemble a majority in the same
         # epoch (the split-vote a promise rules out)
         self._promised: tuple = (0, -1)
+        self.strategy = int(config.get(
+            "mon_election_default_strategy", STRATEGY_CLASSIC))
+        self.tracker = ConnectionTracker(float(config.get(
+            "mon_elector_score_halflife", 4.0)))
+        self._ping_task: Optional[asyncio.Task] = None
+        self._pong_pending: Set[int] = set()
+        # boot grace: a peer still booting (messenger bound, elector
+        # not yet dispatching) must not poison the tracker before it
+        # had a chance to answer — but only for a bounded time, or a
+        # permanently dark peer would keep a perfect score forever
+        self._ever_ponged: Set[int] = set()
+        self._first_ping: Dict[int, float] = {}
+        self._last_dethrone = 0.0
+        # retained: asyncio holds tasks weakly — an unreferenced
+        # dethrone election could be GC'd mid-flight
+        self._dethrone_task: Optional[asyncio.Task] = None
 
     @property
     def majority(self) -> int:
         return self.n // 2 + 1
 
+    def _margin(self) -> float:
+        return float(self.config.get(
+            "mon_elector_ignore_propose_margin", 0.05))
+
+    def my_score(self) -> float:
+        return self.tracker.my_score(self.n, self.rank)
+
+    def _should_defer(self, msg: Any) -> bool:
+        """CONNECTIVITY vote: defer to a candidate that is better
+        connected than me (averaging its self-report with my own view of
+        it — the reference averages every mon's report); within the
+        margin, fall back to rank priority so equal-health quorums still
+        converge on rank like CLASSIC."""
+        if self.strategy != STRATEGY_CONNECTIVITY:
+            return msg.rank < self.rank
+        cand = (msg.score + self.tracker.score(msg.rank)) / 2.0
+        mine = self.my_score()
+        m = self._margin()
+        if cand > mine + m:
+            return True
+        if cand < mine - m:
+            return False
+        return msg.rank < self.rank
+
     async def start(self) -> None:
+        if self.strategy == STRATEGY_CONNECTIVITY and self.n > 1:
+            self._ping_task = asyncio.get_running_loop().create_task(
+                self._ping_loop())
         await self.call_election()
+
+    async def _ping_loop(self) -> None:
+        """Mon-to-mon liveness probes feeding the tracker (Elector's
+        send_peer_ping/begin_peer_ping role): a peer that misses the
+        round-trip by the next cycle scores a failure.  Probes run
+        concurrently under a timeout — a blackholed peer (dropped-SYN
+        partition, the very case CONNECTIVITY exists for) must not
+        stall the other peers' probes behind its TCP connect."""
+        interval = float(self.config.get(
+            "mon_elector_ping_interval", 0.4))
+        boot_grace = float(self.config.get(
+            "mon_election_timeout", 2.5))
+
+        async def probe(peer: int) -> None:
+            try:
+                await asyncio.wait_for(
+                    self.send(peer, MMonElection(
+                        E_PING, self.epoch, self.rank)),
+                    timeout=max(interval, 0.1))
+            except Exception:
+                pass  # the missed pong is the signal
+
+        while True:
+            now = time.monotonic()
+            for peer in self._pong_pending:
+                if peer in self._ever_ponged or \
+                        now - self._first_ping.get(peer, now) \
+                        > boot_grace:
+                    self.tracker.report(peer, False)
+            self._pong_pending = {p for p in range(self.n)
+                                  if p != self.rank}
+            for peer in self._pong_pending:
+                self._first_ping.setdefault(peer, now)
+            await asyncio.gather(*(probe(p)
+                                   for p in self._pong_pending))
+            self._maybe_dethrone(now)
+            await asyncio.sleep(interval)
+
+    def _maybe_dethrone(self, now: float) -> None:
+        """Scores are otherwise only consulted at election time — a
+        sitting leader whose links collapse would reign as long as the
+        odd lease squeaks through.  A peon dethrones only on ABSOLUTE
+        evidence: the leader's link to me has collapsed (score below
+        the bar) AND I hold at least one solid link (a mon whose OWN
+        links are lossy sees everyone low, including the leader — a
+        relative mine-vs-leader comparison would let the flapping node
+        itself thrash elections).  Rate-limited to one per election
+        timeout so a borderline score can't thrash either."""
+        if self.electing or self.leader is None or \
+                self.leader == self.rank:
+            return
+        cooldown = float(self.config.get("mon_election_timeout", 2.5))
+        if now - self._last_dethrone < cooldown:
+            return
+        lead = self.tracker.score(self.leader)
+        best = self.tracker.best_link(self.n, self.rank)
+        if lead < 0.5 and best >= 0.75:
+            self._last_dethrone = now
+            log.warning("mon.%d: leader mon.%d connectivity score %.2f"
+                        " collapsed (my best link %.2f) — calling"
+                        " election", self.rank, self.leader, lead, best)
+            self._dethrone_task = asyncio.get_running_loop() \
+                .create_task(self.call_election())
 
     async def call_election(self) -> None:
         # campaign above every epoch seen OR promised: a promise given
@@ -151,7 +322,8 @@ class Elector:
         for peer in range(self.n):
             if peer != self.rank:
                 await self.send(peer, MMonElection(
-                    E_PROPOSE, self.epoch, self.rank))
+                    E_PROPOSE, self.epoch, self.rank,
+                    score=self.my_score()))
         self._arm_timer()
 
     def _arm_timer(self) -> None:
@@ -185,8 +357,17 @@ class Elector:
         await self.on_win(self.epoch, self.quorum)
 
     async def handle(self, msg: MMonElection) -> None:
+        if msg.kind == E_PING:
+            await self.send(msg.rank, MMonElection(
+                E_PONG, msg.epoch, self.rank))
+            return
+        if msg.kind == E_PONG:
+            self._pong_pending.discard(msg.rank)
+            self._ever_ponged.add(msg.rank)
+            self.tracker.report(msg.rank, True)
+            return
         if msg.kind == E_PROPOSE:
-            if msg.rank < self.rank:
+            if self._should_defer(msg):
                 # one promise per epoch: ack only a bid NEWER than the
                 # last promise (re-ack the same candidate is fine)
                 pe, pr = self._promised
@@ -201,9 +382,9 @@ class Elector:
                 await self.send(msg.rank, MMonElection(
                     E_ACK, msg.epoch, self.rank))
             else:
-                # I outrank the proposer: push my own candidacy (a
-                # live lower rank always preempts — the CLASSIC
-                # strategy's convergence rule)
+                # I am the better candidate (lower rank under CLASSIC;
+                # better-connected under CONNECTIVITY): push my own
+                # candidacy — the strategy's convergence rule
                 await self.call_election()
         elif msg.kind == E_ACK:
             if self.electing and msg.epoch == self.epoch:
@@ -227,16 +408,30 @@ class Elector:
                     self._timer.cancel()
                     self._timer = None
                 await self.on_lose(msg.epoch, msg.rank)
-                if msg.rank > self.rank:
-                    # a higher-rank leader while I am alive: take the
-                    # quorum back (Ceph: a booting lower rank calls an
-                    # election and wins it)
+                if msg.rank > self.rank and self._should_preempt(msg):
+                    # a worse candidate leads while I am alive: take
+                    # the quorum back (Ceph: a booting lower rank calls
+                    # an election and wins it) — under CONNECTIVITY
+                    # only when I am demonstrably better connected,
+                    # else a lossy low-rank mon thrashes the quorum
                     await self.call_election()
+
+    def _should_preempt(self, msg: MMonElection) -> bool:
+        if self.strategy != STRATEGY_CONNECTIVITY:
+            return True
+        return self.my_score() > \
+            self.tracker.score(msg.rank) + self._margin()
 
     def shutdown(self) -> None:
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
+        if self._ping_task is not None:
+            self._ping_task.cancel()
+            self._ping_task = None
+        if self._dethrone_task is not None:
+            self._dethrone_task.cancel()
+            self._dethrone_task = None
 
 
 class Paxos:

@@ -627,12 +627,16 @@ class Messenger:
                             "compressed frame but no negotiated codec")
                     import struct as _struct
 
-                    (cmsg,) = _struct.unpack_from("<i", payload)
                     try:
+                        (cmsg,) = _struct.unpack_from("<i", payload)
                         payload = comp.decompress(
                             bytes(payload[4:]),
                             None if cmsg < 0 else cmsg)
+                    except frames.FrameError:
+                        raise
                     except Exception as e:
+                        # includes a truncated (<4 byte) length prefix —
+                        # malformed frames all take the FrameError path
                         raise frames.FrameError(
                             f"decompression failed: {e}")
                 msg = decode_message(tag, payload)

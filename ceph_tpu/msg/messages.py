@@ -845,27 +845,37 @@ class MClientCaps(Message):
 @register
 class MMonElection(Message):
     """Election traffic (MMonElection role, src/messages/MMonElection.h):
-    kind PROPOSE/ACK/VICTORY, epoch-numbered, rank-priority."""
+    kind PROPOSE/ACK/VICTORY/PING/PONG, epoch-numbered.  v2 adds the
+    sender's connectivity score (the reference ships a full
+    ConnectionTracker blob in its `sharing_bl`; here one aggregate
+    float carries the CONNECTIVITY-strategy signal)."""
 
     TAG = 23
+    VERSION = 2
 
     def __init__(self, kind: int, epoch: int, rank: int,
-                 quorum: Optional[List[int]] = None):
+                 quorum: Optional[List[int]] = None,
+                 score: float = 0.0):
         self.kind = kind
         self.epoch = epoch
         self.rank = rank
         self.quorum = quorum or []
+        self.score = score
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u8(self.kind)
         enc.u64(self.epoch)
         enc.s32(self.rank)
         enc.list(self.quorum, Encoder.s32)
+        enc.f64(self.score)
 
     @classmethod
     def decode_payload(cls, dec: Decoder) -> "MMonElection":
-        return cls(dec.u8(), dec.u64(), dec.s32(),
-                   dec.list(Decoder.s32))
+        kind, epoch, rank = dec.u8(), dec.u64(), dec.s32()
+        quorum = dec.list(Decoder.s32)
+        # v1 blobs end here; DECODE_FINISH discipline skips/supplies
+        score = dec.f64() if dec.remaining() >= 8 else 0.0
+        return cls(kind, epoch, rank, quorum, score)
 
 
 @register

@@ -1,0 +1,176 @@
+"""CONNECTIVITY election strategy: a flapping low-rank mon must stop
+winning elections.
+
+Mirrors /root/reference/src/mon/ElectionLogic.cc (CONNECTIVITY) +
+ConnectionTracker.cc: mons score peer reachability from liveness
+probes, candidates carry their aggregate score, and voters defer to the
+best-connected candidate with rank only breaking near-ties.
+"""
+
+import asyncio
+
+from ceph_tpu.mon import paxos as paxos_mod
+from ceph_tpu.mon.paxos import ConnectionTracker, Elector
+from ceph_tpu.msg.messages import MMonElection
+
+from cluster_helpers import Cluster
+
+CONN_QUORUM = {
+    "mon_lease": 0.8,
+    "mon_election_timeout": 1.0,
+    "mon_accept_timeout": 1.5,
+    "mon_election_default_strategy": paxos_mod.STRATEGY_CONNECTIVITY,
+    "mon_elector_ping_interval": 0.15,
+    "mon_elector_score_halflife": 1.0,
+}
+
+
+# -- unit: tracker + vote rule ----------------------------------------------
+
+def test_tracker_decay_and_scores():
+    t = ConnectionTracker(half_life=1.0)
+    assert t.score(1) == 1.0          # unseen peers start healthy
+    # decay is TIME-based (dt=0 first touch is a no-op): one half-life
+    # of sustained failure halves the score
+    t.report(1, False, now=0.0)
+    t.report(1, False, now=1.0)
+    t.report(1, False, now=2.0)
+    assert abs(t.score(1) - 0.25) < 1e-9
+    # recovery climbs back at the same half-life
+    t.report(1, True, now=3.0)
+    t.report(1, True, now=4.0)
+    assert t.score(1) > 0.5
+    # aggregate: mean over the OTHER ranks
+    t.report(2, False, now=4.0)
+    lo, hi = sorted([t.score(1), t.score(2)])
+    assert abs(t.my_score(3, 0) - (lo + hi) / 2) < 1e-9
+
+
+def _elector(rank, n, strategy, config=None):
+    async def _noop(*a):
+        pass
+    cfg = {"mon_election_default_strategy": strategy}
+    cfg.update(config or {})
+    return Elector(rank, n, _noop, _noop, _noop, cfg)
+
+
+def test_defer_rule_classic_is_rank_only():
+    e = _elector(1, 3, paxos_mod.STRATEGY_CLASSIC)
+    e.tracker.report(0, False, now=0.0)   # even a dead-looking mon.0
+    assert e._should_defer(MMonElection(1, 1, 0, score=0.0))
+    assert not e._should_defer(MMonElection(1, 1, 2, score=1.0))
+
+
+def test_defer_rule_connectivity():
+    e = _elector(1, 3, paxos_mod.STRATEGY_CONNECTIVITY)
+    # all healthy: near-tie falls back to rank priority
+    assert e._should_defer(MMonElection(1, 1, 0, score=1.0))
+    assert not e._should_defer(MMonElection(1, 1, 2, score=1.0))
+    # mon.0 looks lossy from here AND self-reports weak: refuse it
+    for now in (0.0, 1.0, 2.0):
+        e.tracker.report(0, False, now=now)
+    assert not e._should_defer(MMonElection(1, 1, 0, score=0.2))
+    # a better-connected HIGHER rank beats me once I am the lossy one
+    for now in (0.0, 1.0, 2.0):
+        e.tracker.report(2, False, now=now)  # my links are bad
+    assert e._should_defer(MMonElection(1, 1, 2, score=1.0))
+
+
+def test_victory_preempt_gated_by_score():
+    e = _elector(0, 3, paxos_mod.STRATEGY_CONNECTIVITY)
+    win = MMonElection(3, 4, 1, quorum=[1, 2])
+    # healthy everywhere: scores tie, no preempt thrash
+    assert not e._should_preempt(win)
+    # I can reach everyone but the tracker says mon.1 flaps: take over
+    for now in (0.0, 1.0, 2.0):
+        e.tracker.report(1, False, now=now)
+    e.tracker.report(2, True, now=2.0)
+    assert e._should_preempt(win)
+    # classic always preempts on rank
+    assert _elector(0, 3,
+                    paxos_mod.STRATEGY_CLASSIC)._should_preempt(win)
+
+
+def test_dethrone_requires_absolute_evidence():
+    """The dethrone trigger must fire for a healthy peon watching the
+    leader's link collapse — and must NOT fire from the lossy mon
+    itself, whose view of EVERYONE (leader included) is degraded."""
+    async def run():
+        fired = []
+
+        async def _noop():
+            pass
+
+        e = _elector(1, 3, paxos_mod.STRATEGY_CONNECTIVITY,
+                     {"mon_election_timeout": 0.0,
+                      "mon_elector_score_halflife": 1.0})
+        e.leader = 0
+        e.electing = False
+        e.call_election = lambda: fired.append(1) or _noop()
+        # healthy view: leader fine -> no trigger
+        e._maybe_dethrone(now=100.0)
+        assert not fired
+        # leader collapsed, my link to mon.2 is solid -> trigger
+        for now in (0.0, 1.0, 2.0, 3.0):
+            e.tracker.report(0, False, now=now)
+        e.tracker.report(2, True, now=3.0)
+        e._maybe_dethrone(now=100.0)
+        assert fired
+        # lossy node: every view degraded, no solid link -> no trigger
+        e2 = _elector(0, 3, paxos_mod.STRATEGY_CONNECTIVITY,
+                      {"mon_election_timeout": 0.0,
+                       "mon_elector_score_halflife": 1.0})
+        e2.leader = 1
+        e2.electing = False
+        e2.call_election = lambda: fired.append(2) or _noop()
+        for now in (0.0, 1.0, 2.0, 3.0):
+            e2.tracker.report(1, False, now=now)
+            e2.tracker.report(2, False, now=now)
+        e2._maybe_dethrone(now=100.0)
+        assert 2 not in fired, "lossy mon dethroned a healthy leader"
+        await asyncio.sleep(0)  # drain the spawned election task
+
+    asyncio.run(run())
+
+
+# -- integration: lossy mon.0 loses the quorum lead -------------------------
+
+def test_lossy_rank0_stops_leading():
+    """3-mon quorum under CONNECTIVITY: healthy cluster elects mon.0
+    (rank tie-break), then mon.0's links turn lossy — leadership must
+    settle on a healthy mon and mon.0 must not win it back while it
+    flaps (the ElectionLogic.cc scenario the strategy exists for)."""
+    async def run():
+        cluster = Cluster(num_osds=2, osds_per_host=1, num_mons=3,
+                          mon_config=dict(CONN_QUORUM))
+        await cluster.start()
+        try:
+            assert cluster.mons[0].is_leader()
+            # every ~4th frame on any mon.0 connection kills it —
+            # pings still occasionally round-trip (a flap, not a death)
+            cluster.mons[0].msgr.inject_socket_failures = 4
+            # let probes drag mon.0's score down and the quorum re-form
+            await asyncio.sleep(3.0)
+            observed = set()
+            deadline = asyncio.get_running_loop().time() + 6.0
+            while asyncio.get_running_loop().time() < deadline:
+                for rank in (1, 2):
+                    el = cluster.mons[rank].elector
+                    if not el.electing and el.leader is not None:
+                        observed.add(el.leader)
+                await asyncio.sleep(0.1)
+            assert observed, "healthy mons never reached a stable view"
+            assert 0 not in observed, (
+                f"flapping mon.0 still won leadership: {observed}")
+            # the healthy pair holds a working quorum meanwhile (poll:
+            # a sampled instant may land mid-election)
+            healthy = [cluster.mons[r] for r in (1, 2)]
+            for _ in range(40):
+                if any(m.is_leader() for m in healthy):
+                    break
+                await asyncio.sleep(0.1)
+            assert any(m.is_leader() for m in healthy)
+        finally:
+            await cluster.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 90))

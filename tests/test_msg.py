@@ -288,6 +288,49 @@ def test_compression_no_common_method_stays_plain():
     asyncio.run(main())
 
 
+def test_compressed_frame_truncated_length_prefix_dropped():
+    """A frame with FLAG_COMPRESSED but a <4-byte payload (the length
+    prefix itself truncated) must take the malformed-frame drop path —
+    not escape the read loop as a raw struct.error — and the server
+    must keep serving other connections."""
+    async def main():
+        server, client = _comp_pair(("snappy",), ("snappy",))
+        got = asyncio.Queue()
+
+        async def server_dispatch(conn, msg):
+            await conn.send(MOSDOpReply(msg.tid, 0, b"ok"))
+
+        server.dispatcher = server_dispatch
+        client.dispatcher = lambda c, m: got.put(m)
+        addr = await server.bind()
+        conn = await client.connect(addr)
+        # settle negotiation (hello exchange) with one normal op
+        await conn.send(MOSDOp(1, "client.1", PgId(1, 0), "o",
+                               [OSDOp("read")], 1))
+        await asyncio.wait_for(got.get(), 5)
+        # hand-craft the poison frame on the raw socket
+        conn.writer.write(frames.encode_frame(
+            MOSDOp.TAG, next(conn._seq), b"\x01",
+            flags=frames.FLAG_COMPRESSED))
+        await conn.writer.drain()
+        # server drops that connection...
+        for _ in range(100):
+            if conn.reader.at_eof():
+                break
+            await asyncio.sleep(0.05)
+        assert conn.reader.at_eof(), "poison frame did not drop conn"
+        # ...and still serves a fresh one
+        conn2 = await client.connect(addr)
+        await conn2.send(MOSDOp(2, "client.1", PgId(1, 0), "o",
+                                [OSDOp("read")], 1))
+        reply = await asyncio.wait_for(got.get(), 5)
+        assert reply.tid == 2
+        await client.shutdown()
+        await server.shutdown()
+
+    asyncio.run(main())
+
+
 def test_compression_secure_gated():
     """On an AEAD connection compression stays OFF unless
     ms_compress_secure opts in (length side channel)."""
