@@ -70,7 +70,6 @@ class CephFS:
         # caps when the old one died — identity, not liveness, is the
         # validity test
         self._cap_conn: Dict[int, Any] = {}
-        self._last_mds_conn: Any = None
         self._attr_cache: Dict[str, dict] = {}     # path -> inode
         self._ino_paths: Dict[int, Set[str]] = {}  # reverse index
         # ino -> buffered dirty attrs awaiting flush (rw caps only)
@@ -88,15 +87,22 @@ class CephFS:
     # forever — past the bound the soonest-expiring quarter is shed
     max_caps = 4096
 
-    def _record_cap(self, path: str, inode: dict, cap: str) -> None:
-        if not cap or not isinstance(inode, dict):
+    def _record_cap(self, path: str, inode: dict, cap: str,
+                    conn: Any = None) -> None:
+        """conn: the connection the reply that granted this cap rode in
+        on (stamped into the reply by _request).  It must NOT be read
+        from shared mutable state: a concurrent request can reconnect
+        and rebind such state while this reply is in flight, and the
+        cap would then pass the conn-identity check against a session
+        the MDS never granted it on."""
+        if not cap or not isinstance(inode, dict) or conn is None:
             return
         ino = inode["ino"]
         if ino not in self._caps and len(self._caps) >= self.max_caps:
             self._trim_caps()
         self._caps[ino] = cap
         self._cap_expiry[ino] = time.monotonic() + self.caps_ttl
-        self._cap_conn[ino] = self._last_mds_conn
+        self._cap_conn[ino] = conn
         self._attr_cache[path] = inode
         self._ino_paths.setdefault(ino, set()).add(path)
 
@@ -181,9 +187,22 @@ class CephFS:
         except (ConnectionError, OSError):
             # conn died mid-ack: the MDS evicts us on timeout/fault,
             # but the buffered attrs never reached it — restore them
-            # so close()/flush() re-sends through the ordinary path
+            # so close()/flush() re-sends through the ordinary path.
+            # MERGE, never setdefault: a concurrent write during the
+            # send may have re-dirtied the ino with a SMALLER size_max,
+            # and dropping the older high-water mark would let the
+            # eventual flush truncate acknowledged data
             if attrs:
-                self._dirty.setdefault(msg.ino, attrs)
+                d = self._dirty.get(msg.ino)
+                if d is None:
+                    self._dirty[msg.ino] = attrs
+                else:
+                    d["size_max"] = max(
+                        int(d.get("size_max", 0)),
+                        int(attrs.get("size_max", 0)))
+                    if d.get("mtime") is None and \
+                            attrs.get("mtime") is not None:
+                        d["mtime"] = attrs["mtime"]
 
     def _note_dirty(self, ino: int, path: str, size: int,
                     mtime: float) -> None:
@@ -247,7 +266,6 @@ class CephFS:
             self.client._futures[tid] = fut
             try:
                 conn = await self.client.msgr.connect(self._mds_addr)
-                self._last_mds_conn = conn  # caps bind to THIS conn
                 await conn.send(MClientRequest(tid, op, args))
                 reply = await asyncio.wait_for(fut, 10.0)
             except (ConnectionError, OSError,
@@ -267,6 +285,9 @@ class CephFS:
                                   f"{op} {args.get('path', '')!r}"
                                   f" {reply.out.get('error', '')}")
             self._trace_reply(op, args, reply.out)
+            # stamp the conn this reply rode in on: any cap in the
+            # reply was granted on THAT session (see _record_cap)
+            reply.out["_conn"] = conn
             return reply.out
         raise CephFSError(ESTALE, f"{op}: no MDS reachable ({last!r})")
 
@@ -311,7 +332,8 @@ class CephFS:
         if cached is not None:
             return dict(cached)   # zero MDS round trips
         out = await self._request("stat", {"path": path, "want": "r"})
-        self._record_cap(path, out["inode"], out.get("cap", ""))
+        self._record_cap(path, out["inode"], out.get("cap", ""),
+                         out.get("_conn"))
         return out["inode"]
 
     async def exists(self, path: str) -> bool:
@@ -386,7 +408,8 @@ class CephFS:
                            "exclusive": "x" in flags,
                            "block_size": block_size, "want": want})
             inode = out["inode"]
-            self._record_cap(path, inode, out.get("cap", ""))
+            self._record_cap(path, inode, out.get("cap", ""),
+                             out.get("_conn"))
             if "w" in flags and inode.get("size", 0) > 0:
                 await self.truncate(path, 0)
                 inode = await self.stat(path)
@@ -398,7 +421,8 @@ class CephFS:
                 out = await self._request(
                     "stat", {"path": path, "want": want})
                 inode = out["inode"]
-                self._record_cap(path, inode, out.get("cap", ""))
+                self._record_cap(path, inode, out.get("cap", ""),
+                                 out.get("_conn"))
             if inode["type"] == "dir":
                 raise CephFSError(-21, path)
         return File(self, path, inode, writable=writable)

@@ -224,10 +224,21 @@ def _xor_keystream(session_key: bytes, role: bytes, seq: int,
     return (a ^ b).tobytes()
 
 
+def aead_available() -> bool:
+    """Capability probe for the MHello aead advertisement: peers
+    negotiate the sealing mode instead of guessing from their OWN
+    toolchain (a no-AEAD peer is a legitimate fallback, not an
+    attack — but only when it SAYS so in its signed hello)."""
+    return _resolve_aead() is not None
+
+
 def seal(session_key: bytes, role: bytes, seq: int,
-         data: bytes) -> bytes:
+         data: bytes, peer_aead: Optional[bool] = None) -> bytes:
+    """peer_aead: the peer's hello-advertised AEAD capability (None =
+    unknown).  A peer that advertised False cannot open AES-GCM, so
+    the frame legitimately falls back to the keystream mode."""
     impl = _resolve_aead()
-    if impl is None:
+    if impl is None or peer_aead is False:
         return bytes([MODE_XOR]) + _xor_keystream(session_key, role,
                                                   seq, data)
     key, nonce = _gcm_key(session_key), _gcm_nonce(role, seq)
@@ -246,7 +257,12 @@ class SealError(Exception):
 
 
 def unseal(session_key: bytes, role: bytes, seq: int,
-           data: bytes) -> bytes:
+           data: bytes, peer_aead: Optional[bool] = None) -> bytes:
+    """peer_aead: the peer's hello-advertised AEAD capability (None =
+    unknown).  Gates the downgrade check below: a keystream frame is
+    legitimate from a peer that ADVERTISED no AEAD (its hello is
+    signed, so the advertisement is authentic), and an attack when the
+    peer is known or presumed capable."""
     if not data:
         raise SealError("empty secure payload")
     mode, body = data[0], data[1:]
@@ -268,10 +284,11 @@ def unseal(session_key: bytes, role: bytes, seq: int,
         except InvalidTag:
             raise SealError("AES-GCM tag mismatch")
     if mode == MODE_XOR:
-        if impl is not None:
-            # both of us could do AEAD: a keystream frame here is a
-            # downgrade (an attacker flipping the mode byte), not a
-            # legitimate fallback
+        if impl is not None and peer_aead is not False:
+            # the peer either advertised AEAD or never said (same-
+            # version peers always advertise): a keystream frame here
+            # is a downgrade (an attacker flipping the mode byte), not
+            # a legitimate fallback
             raise SealError("keystream frame from an AEAD-capable"
                             " peer: downgrade rejected")
         return _xor_keystream(session_key, role, seq, body)

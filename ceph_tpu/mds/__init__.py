@@ -99,7 +99,8 @@ class MDSDaemon:
                  data_pool: str, name: str = "a",
                  lock_interval: float = 1.0,
                  secret: "Optional[str]" = None,
-                 secure: bool = False):
+                 secure: bool = False,
+                 config: "Optional[dict]" = None):
         self.mon_addr = mon_addr
         self.metadata_pool = metadata_pool
         self.data_pool = data_pool
@@ -108,12 +109,15 @@ class MDSDaemon:
         from ceph_tpu.common.auth import parse_secret
 
         self.client = RadosClient(mon_addr, name=f"mds.{name}",
-                                  secret=secret, secure=secure)
+                                  secret=secret, secure=secure,
+                                  config=config)
         self.msgr = Messenger(f"mds.{name}",
                               secret=parse_secret(secret))
         self.msgr.secure = secure
         self.msgr.local_fastpath = True
         self.msgr.dispatcher = self._dispatch
+        # ms_compress_* applies to the MDS service messenger too
+        self.msgr.apply_compress_config(config or {})
         self.meta: Optional[IoCtx] = None
         self.data_io: Optional[IoCtx] = None
         self.state = "standby"

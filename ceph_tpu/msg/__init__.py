@@ -83,6 +83,10 @@ class Connection:
         # PEER accepts that we support; rx = first method WE accept
         # that the peer supports.  None until the peer's hello arrives.
         self.peer_compress: tuple = ()
+        # peer's hello-advertised AEAD capability (None until its
+        # hello arrives; secure sends wait on session_ready, which is
+        # set only after that hello is processed)
+        self.peer_aead = None
         self._tx_comp = None   # (name, Compressor) | None
         self._rx_comp = None
         # acceptor replies with the CONNECTOR's kid: during rotation a
@@ -176,7 +180,8 @@ class Connection:
             # secure mode: the payload rides AEAD-sealed under the
             # session key (hellos stay plaintext — they carry no
             # secrets and exist before the session does)
-            payload = auth.seal(key, self._tx_role(), seq, payload)
+            payload = auth.seal(key, self._tx_role(), seq, payload,
+                                peer_aead=self.peer_aead)
             flags |= frames.FLAG_SECURE
         parts = frames.encode_frame_parts(msg.TAG, seq,
                                           payload, flags=flags,
@@ -207,7 +212,8 @@ class Connection:
             key = m.secret.get(kid)
         hello = MHello(m.entity_name, m.addr, nonce=self.my_nonce,
                        kid=kid, ticket=ticket,
-                       compression=",".join(m.compress_methods))
+                       compression=",".join(m.compress_methods),
+                       aead=auth.aead_available())
         await self._send_signed(hello, key)
 
     def close(self) -> None:
@@ -560,6 +566,7 @@ class Messenger:
         conn.peer_addr = msg.addr or conn.peer_addr
         conn.peer_compress = tuple(
             x for x in getattr(msg, "compression", "").split(",") if x)
+        conn.peer_aead = getattr(msg, "aead", None)
         if conn.outbound:
             # acceptor's reply (never ticket-bearing): session =
             # f(base chosen at connect, my_nonce, its_nonce)
@@ -616,7 +623,8 @@ class Messenger:
                     if flags & frames.FLAG_SECURE:
                         payload = auth.unseal(conn.session_key,
                                               conn._rx_role(), seq,
-                                              payload)
+                                              payload,
+                                              peer_aead=conn.peer_aead)
                     elif self.secure:
                         raise frames.FrameError(
                             "plaintext frame but secure mode required")
@@ -650,6 +658,7 @@ class Messenger:
                     conn.peer_compress = tuple(
                         x for x in getattr(msg, "compression",
                                            "").split(",") if x)
+                    conn.peer_aead = getattr(msg, "aead", None)
                     if not conn.outbound and \
                             not getattr(conn, "_hello_sent", False):
                         # identify back: the connector needs OUR

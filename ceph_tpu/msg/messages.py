@@ -86,15 +86,20 @@ class MHello(Message):
     mon-granted ticket (CephxSessionHandler / msgr2 auth frames role).
     v3 appends the sender's accepted compression methods (csv, in
     preference order — the frames_v2 compression negotiation role,
-    /root/reference/src/msg/async/frames_v2.cc)."""
+    /root/reference/src/msg/async/frames_v2.cc).  v4 appends the
+    sender's AEAD capability so secure-mode peers can negotiate the
+    sealing mode instead of each side guessing from its OWN toolchain
+    (the crypto_onwire mode-selection role): absent = unknown
+    (pre-v4 peer), True/False = advertised."""
 
     TAG = 1
-    VERSION = 3
+    VERSION = 4
     COMPAT = 1
 
     def __init__(self, entity_name: str, addr: str,
                  nonce: bytes = b"", kid: int = 0,
-                 ticket: bytes = b"", compression: str = ""):
+                 ticket: bytes = b"", compression: str = "",
+                 aead: Optional[bool] = None):
         self.entity_name = entity_name
         self.addr = addr
         self.nonce = nonce
@@ -104,6 +109,8 @@ class MHello(Message):
         # archived corpus) are unchanged
         if compression:
             self.compression = compression
+        if aead is not None:
+            self.aead = aead
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.string(self.entity_name)
@@ -112,6 +119,7 @@ class MHello(Message):
         enc.s32(self.kid)
         enc.bytes(self.ticket)
         enc.string(getattr(self, "compression", ""))
+        enc.bool(getattr(self, "aead", False))
 
     @classmethod
     def decode(cls, data: bytes) -> "MHello":
@@ -126,6 +134,8 @@ class MHello(Message):
             comp = dec.string()
             if comp:
                 msg.compression = comp
+        if struct_v >= 4:
+            msg.aead = dec.bool()
         dec.finish()
         return msg
 

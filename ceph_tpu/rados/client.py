@@ -59,7 +59,8 @@ class ObjectNotFound(RadosError):
 class RadosClient:
     def __init__(self, mon_addr, name: Optional[str] = None,
                  op_timeout: float = 10.0, max_retries: int = 30,
-                 secret: Optional[str] = None, secure: bool = False):
+                 secret: Optional[str] = None, secure: bool = False,
+                 config: Optional[dict] = None):
         # mon_addr: one address, a comma-separated list, or a list —
         # the client hunts across them on failure (MonClient hunting)
         if isinstance(mon_addr, str):
@@ -81,6 +82,10 @@ class RadosClient:
         self.msgr.secure = secure
         self.msgr.local_fastpath = True
         self.msgr.dispatcher = self._dispatch
+        # ms_compress_* applies to EVERY messenger, not just daemons —
+        # without this a cluster-wide compression setting silently
+        # skips client links
+        self.msgr.apply_compress_config(config or {})
         self.osdmap: Optional[OSDMap] = None
         self.op_timeout = op_timeout
         self.max_retries = max_retries

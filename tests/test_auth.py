@@ -464,6 +464,29 @@ def test_aead_negative_paths():
     assert c1[1:33] != c2[1:33]
 
 
+def test_aead_capability_negotiation():
+    """A peer that ADVERTISED no AEAD in its signed hello is a
+    legitimate keystream fallback, not a downgrade — sealing-mode
+    choice follows the peer's advertisement, and the downgrade
+    rejection only applies to peers known or presumed capable
+    (crypto_onwire mode-selection role)."""
+    key = auth.parse_secret(auth.generate_secret()).active_key
+    data = b"mixed-capability frame" * 50
+    # sender learns the peer can't open AES-GCM -> keystream mode
+    ct = auth.seal(key, b"c", 3, data, peer_aead=False)
+    assert ct[0] == auth.MODE_XOR
+    # receiver with AEAD accepts it BECAUSE the peer advertised False
+    assert auth.unseal(key, b"c", 3, ct, peer_aead=False) == data
+    # same frame from a capable (True) or silent (None) peer = attack
+    with pytest.raises(auth.SealError):
+        auth.unseal(key, b"c", 3, ct, peer_aead=True)
+    with pytest.raises(auth.SealError):
+        auth.unseal(key, b"c", 3, ct)
+    # capable peers still get AES-GCM
+    assert auth.seal(key, b"c", 3, data,
+                     peer_aead=True)[0] == auth.MODE_AESGCM
+
+
 def test_native_aesgcm_matches_cryptography():
     """The in-repo C++ AES-GCM must be bit-exact vs the OpenSSL-backed
     `cryptography` AESGCM (independent implementation cross-check)."""
