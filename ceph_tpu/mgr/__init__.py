@@ -75,12 +75,13 @@ class MgrDaemon:
 
     async def start(self) -> None:
         from ceph_tpu.mgr.balancer import BalancerModule
+        from ceph_tpu.mgr.dashboard import DashboardModule
         from ceph_tpu.mgr.pg_autoscaler import PgAutoscalerModule
         from ceph_tpu.mgr.prometheus import PrometheusModule
 
         await self.client.connect()
         for cls in (BalancerModule, PgAutoscalerModule,
-                    PrometheusModule):
+                    PrometheusModule, DashboardModule):
             if self._module_filter is not None and \
                     cls.NAME not in self._module_filter:
                 continue

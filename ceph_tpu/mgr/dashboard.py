@@ -1,0 +1,221 @@
+"""dashboard module: read-only HTTP status UI.
+
+Reference parity: /root/reference/src/pybind/mgr/dashboard/ — the
+mgr-hosted web UI over cluster state.  The reference is a full
+cherrypy+angular application with auth, CRUD and a REST layer; this
+build deliberately keeps the mgr surface READ-ONLY (mutations go
+through the CLI/mon command path like everything else) and serves:
+
+  GET /              one self-contained HTML status page (no assets)
+  GET /api/status    cluster summary (epoch, osd counts, pools, health)
+  GET /api/health    health checks
+  GET /api/osds      per-OSD up/in + pg count + op counters
+  GET /api/pools     pool table incl. autoscaler recommendations
+  GET /api/mons      quorum state
+  GET /api/log       recent cluster log lines
+
+The HTML is rendered client-side from /api/status+osds+log by a few
+lines of inline JS, auto-refreshing — same information architecture as
+the reference's landing page (health tile, capacity tile, daemon
+table), none of the framework weight.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Dict, Optional
+
+from ceph_tpu.mgr import MgrModule
+
+log = logging.getLogger("mgr")
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ceph_tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2em;background:#fafafa}
+ h1{font-size:1.3em} h2{font-size:1.05em;margin-top:1.4em}
+ table{border-collapse:collapse;background:#fff}
+ td,th{border:1px solid #ddd;padding:.3em .7em;font-size:.9em}
+ th{background:#f0f0f0;text-align:left}
+ .ok{color:#2a7} .warn{color:#b60} .err{color:#c22}
+ #health{font-weight:bold}
+ pre{background:#fff;border:1px solid #ddd;padding:.6em;
+     font-size:.8em;max-height:14em;overflow:auto}
+</style></head><body>
+<h1>ceph_tpu cluster <span id="health">…</span></h1>
+<div id="summary"></div>
+<h2>OSDs</h2><table id="osds"></table>
+<h2>Pools</h2><table id="pools"></table>
+<h2>Monitors</h2><div id="mons"></div>
+<h2>Cluster log</h2><pre id="log"></pre>
+<script>
+async function j(p){return (await fetch(p)).json()}
+function row(cells,tag){return "<tr>"+cells.map(
+  c=>"<"+tag+">"+c+"</"+tag+">").join("")+"</tr>"}
+async function refresh(){
+ try{
+  const s=await j("/api/status"), o=await j("/api/osds"),
+        m=await j("/api/mons"), lg=await j("/api/log");
+  const st=s.health.status;
+  const cls=st==="HEALTH_OK"?"ok":(st==="HEALTH_WARN"?"warn":"err");
+  document.getElementById("health").innerHTML=
+    "<span class='"+cls+"'>"+st+"</span>";
+  let checks="";
+  for(const [k,v] of Object.entries(s.health.checks||{}))
+    checks+=" &mdash; "+k+": "+v.summary;
+  document.getElementById("summary").innerHTML=
+    "epoch "+s.epoch+" &middot; "+s.num_up_osds+"/"+s.num_osds+
+    " osds up &middot; "+Object.keys(s.pools).length+" pools"+checks;
+  let t="<tr><th>osd</th><th>up</th><th>in</th><th>pgs</th>"+
+        "<th>ops</th></tr>";
+  for(const r of o.osds) t+=row([r.id,r.up?"up":"<b class=err>down"+
+    "</b>",r.in?"in":"out",r.pgs,r.ops??"-"],"td");
+  document.getElementById("osds").innerHTML=t;
+  let p="<tr><th>pool</th><th>id</th><th>type</th><th>size</th>"+
+        "<th>pg_num</th><th>recommended</th></tr>";
+  for(const r of s.pool_table) p+=row([r.name,r.id,r.type,r.size,
+    r.pg_num,r.pg_num_ideal??"-"],"td");
+  document.getElementById("pools").innerHTML=p;
+  document.getElementById("mons").textContent=
+    "quorum "+JSON.stringify(m.quorum)+" leader mon."+m.leader+
+    " epoch "+m.election_epoch;
+  document.getElementById("log").textContent=
+    (lg.lines||[]).join("\\n");
+ }catch(e){document.getElementById("health").textContent=
+   "unreachable: "+e}
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>
+"""
+
+
+class DashboardModule(MgrModule):
+    NAME = "dashboard"
+
+    def __init__(self, mgr, port: int = 0):
+        super().__init__(mgr)
+        self.port = int(mgr.config.get("dashboard_port", port))
+        self.addr: Optional[str] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, "127.0.0.1", self.port)
+        sock = self._server.sockets[0]
+        host, port = sock.getsockname()[:2]
+        self.addr = f"{host}:{port}"
+        log.info("mgr: dashboard on http://%s/", self.addr)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            request = await asyncio.wait_for(reader.readline(), 5.0)
+            while True:  # drain headers
+                line = await asyncio.wait_for(reader.readline(), 5.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            parts = request.split()
+            path = parts[1].decode("latin-1") if len(parts) >= 2 \
+                else None
+            ctype = "application/json"
+            if path in ("/", "/index.html"):
+                body, status, ctype = _PAGE, "200 OK", "text/html"
+            elif path and path.startswith("/api/"):
+                doc = await self._api(path[len("/api/"):])
+                if doc is None:
+                    body, status = '{"error": "not found"}\n', \
+                        "404 Not Found"
+                else:
+                    body, status = json.dumps(doc) + "\n", "200 OK"
+            else:
+                body, status = '{"error": "not found"}\n', \
+                    "404 Not Found"
+            payload = body.encode()
+            writer.write(
+                f"HTTP/1.0 {status}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(payload)}\r\n\r\n".encode()
+                + payload)
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+
+    async def _api(self, what: str) -> Optional[Dict[str, Any]]:
+        try:
+            if what == "status":
+                return await self._status()
+            if what == "health":
+                rc, health = await self.mgr.client.mon_command(
+                    {"prefix": "health"})
+                return health if rc == 0 else {"status": "UNKNOWN"}
+            if what == "osds":
+                return await self._osds()
+            if what == "pools":
+                doc = await self._status()
+                return {"pools": doc["pool_table"]}
+            if what == "mons":
+                rc, stat = await self.mgr.client.mon_command(
+                    {"prefix": "mon stat"})
+                return stat if rc == 0 else {}
+            if what == "log":
+                rc, out = await self.mgr.client.mon_command(
+                    {"prefix": "log last", "num": 50})
+                if rc != 0:
+                    return {"lines": []}
+                return {"lines": [
+                    f"[{e.get('level', 'INF')}] {e.get('who', '?')}:"
+                    f" {e.get('message', '')}"
+                    for e in out.get("entries", [])]}
+        except Exception as e:  # surface, don't 500 silently
+            return {"error": repr(e)}
+        return None
+
+    async def _status(self) -> Dict[str, Any]:
+        rc, doc = await self.mgr.client.mon_command(
+            {"prefix": "status"})
+        if rc != 0:
+            return {"error": rc}
+        # pool table + autoscaler recommendations, dashboard-shaped
+        recommend: Dict[str, Any] = {}
+        scaler = self.mgr.modules.get("pg_autoscaler")
+        if scaler is not None:
+            try:
+                recommend = {row["pool_name"]: row["pg_num_ideal"]
+                             for row in scaler.compute().values()}
+            except Exception:
+                pass
+        table = []
+        for name, p in sorted(doc.get("pools", {}).items()):
+            table.append(dict(p, name=name,
+                              pg_num_ideal=recommend.get(name)))
+        doc["pool_table"] = table
+        return doc
+
+    async def _osds(self) -> Dict[str, Any]:
+        osdmap = self.mgr.osdmap
+        if osdmap is None:
+            return {"osds": []}
+        pgs = self.mgr.pgs_per_osd()
+        perf = await self.mgr.scrape_osd_perf()
+        out = []
+        for o in range(osdmap.max_osd):
+            if not osdmap.exists(o):
+                continue
+            counters = perf.get(o, {})
+            out.append({
+                "id": o,
+                "up": osdmap.is_up(o),
+                "in": osdmap.is_in(o),
+                "pgs": pgs.get(o, 0),
+                "ops": counters.get("op", counters.get("ops")),
+            })
+        return {"osds": out}

@@ -187,3 +187,62 @@ def test_prometheus_exporter_serves_metrics():
             await cluster.stop()
 
     run(main())
+
+
+def test_dashboard_serves_status_ui():
+    """The dashboard module answers the HTML page and every /api/*
+    document with live cluster state (read-only mgr UI role)."""
+    async def main():
+        import json
+
+        cluster = Cluster(num_osds=3)
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool(
+                "p", size=2, pg_num=8)
+            io = cluster.client.open_ioctx("p")
+            await io.write_full("o", b"z" * 1024)
+            mgr = await _start_mgr(cluster)
+            dash = mgr.modules["dashboard"]
+            host, port = dash.addr.split(":")
+
+            async def get(path):
+                reader, writer = await asyncio.open_connection(
+                    host, int(port))
+                writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+                await writer.drain()
+                raw = await asyncio.wait_for(reader.read(), 10.0)
+                writer.close()
+                head, body = raw.decode().split("\r\n\r\n", 1)
+                return head, body
+
+            head, body = await get("/")
+            assert head.startswith("HTTP/1.0 200")
+            assert "text/html" in head and "ceph_tpu" in body
+
+            head, body = await get("/api/status")
+            assert head.startswith("HTTP/1.0 200")
+            doc = json.loads(body)
+            assert doc["num_up_osds"] == 3
+            assert doc["health"]["status"] == "HEALTH_OK"
+            assert any(p["name"] == "p" and p["pg_num"] == 8
+                       for p in doc["pool_table"])
+
+            _, body = await get("/api/osds")
+            osds = json.loads(body)["osds"]
+            assert len(osds) == 3 and all(o["up"] for o in osds)
+            assert sum(o["pgs"] for o in osds) == 16  # 8 pgs x size 2
+
+            _, body = await get("/api/mons")
+            assert json.loads(body)["num_mons"] >= 1
+
+            _, body = await get("/api/log")
+            assert isinstance(json.loads(body)["lines"], list)
+
+            head, _ = await get("/api/nonesuch")
+            assert head.startswith("HTTP/1.0 404")
+            await mgr.stop()
+        finally:
+            await cluster.stop()
+
+    run(main())
