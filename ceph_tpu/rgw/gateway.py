@@ -61,6 +61,13 @@ VER_OFF = "off"
 VER_ENABLED = "enabled"
 VER_SUSPENDED = "suspended"
 
+# canned ACLs (rgw_acl_s3.cc's rgw_canned_acl set, minus the
+# aws-exec/log-delivery grants that have no meaning here).  The
+# reference also stores full grant lists; canned policies cover the
+# practical surface and stay one word in metadata.
+CANNED_ACLS = ("private", "public-read", "public-read-write",
+               "authenticated-read")
+
 
 class RGWError(Exception):
     def __init__(self, code: str, what: str = ""):
@@ -393,11 +400,49 @@ class RGWLite:
 
     # -- buckets -----------------------------------------------------------
 
-    async def create_bucket(self, bucket: str) -> None:
+    async def create_bucket(self, bucket: str, owner: str = "",
+                            acl: str = "private") -> None:
+        if acl not in CANNED_ACLS:
+            raise RGWError("InvalidArgument", f"bad acl {acl!r}")
         if await self._load(self._bucket_oid(bucket)) is not None:
             raise RGWError("BucketAlreadyExists", bucket)
         await self._store(self._bucket_oid(bucket),
-                          {"name": bucket, "objects": {}})
+                          {"name": bucket, "objects": {},
+                           "owner": owner, "acl": acl})
+
+    # -- ACLs (rgw_acl.cc / RGWAccessControlPolicy role) -------------------
+
+    async def get_bucket_acl_info(self, bucket: str) -> Dict[str, str]:
+        doc = await self._bucket(bucket)
+        return {"owner": doc.get("owner", ""),
+                "acl": doc.get("acl", "private")}
+
+    async def put_bucket_acl(self, bucket: str, acl: str) -> None:
+        if acl not in CANNED_ACLS:
+            raise RGWError("InvalidArgument", f"bad acl {acl!r}")
+        async with self._meta_lock(self._bucket_oid(bucket)):
+            doc = await self._bucket(bucket)
+            doc["acl"] = acl
+            await self._store(self._bucket_oid(bucket), doc)
+
+    async def get_object_acl(self, bucket: str, key: str) -> str:
+        doc = await self._bucket(bucket)
+        entry = doc["objects"].get(key)
+        if entry is None:
+            raise RGWError("NoSuchKey", f"{bucket}/{key}")
+        return entry.get("acl", "private")
+
+    async def put_object_acl(self, bucket: str, key: str,
+                             acl: str) -> None:
+        if acl not in CANNED_ACLS:
+            raise RGWError("InvalidArgument", f"bad acl {acl!r}")
+        async with self._meta_lock(self._bucket_oid(bucket)):
+            doc = await self._bucket(bucket)
+            entry = doc["objects"].get(key)
+            if entry is None:
+                raise RGWError("NoSuchKey", f"{bucket}/{key}")
+            entry["acl"] = acl
+            await self._store(self._bucket_oid(bucket), doc)
 
     async def _bucket(self, bucket: str) -> Dict:
         doc = await self._load(self._bucket_oid(bucket))
@@ -598,7 +643,8 @@ class RGWLite:
         return etag
 
     async def put_object_ex(self, bucket: str, key: str,
-                            data: bytes) -> Tuple[str, Optional[str]]:
+                            data: bytes, acl: Optional[str] = None
+                            ) -> Tuple[str, Optional[str]]:
         """Single-shot PUT (RGWPutObj + AtomicObjectProcessor role);
         under versioning every PUT lands as a new immutable version
         (rgw_op.cc:3712's versioned path).  Returns (etag, version_id)
@@ -614,10 +660,12 @@ class RGWLite:
             await writer.cancel()
             raise
         etag = self._etag_from_manifest(manifest, data)
-        return await self._link_by_status(bucket, key, manifest, etag)
+        return await self._link_by_status(bucket, key, manifest, etag,
+                                          acl=acl)
 
     async def _link_by_status(self, bucket: str, key: str,
-                              manifest: Manifest, etag: str
+                              manifest: Manifest, etag: str,
+                              acl: Optional[str] = None
                               ) -> Tuple[str, Optional[str]]:
         """Link a finished upload under ONE bucket lock, adjudicating
         the versioning status AT LINK TIME — a versioning flip during
@@ -630,18 +678,19 @@ class RGWLite:
             vdoc = await self._versions(bucket, key)
             if status == VER_OFF and not vdoc["versions"]:
                 await self._link_locked(doc, bucket, key, manifest,
-                                        etag)
+                                        etag, acl=acl)
                 return etag, None
             # versioned path — also when the key ALREADY has versions
             # with versioning since switched off: existing versions
             # must never be silently clobbered by a head doc
             vid = await self._link_version_locked(
                 doc, vdoc, bucket, key, manifest, etag,
-                null_version=(status != VER_ENABLED))
+                null_version=(status != VER_ENABLED), acl=acl)
             return etag, vid
 
     async def _link_locked(self, doc: Dict, bucket: str, key: str,
-                           manifest: Manifest, etag: str) -> None:
+                           manifest: Manifest, etag: str,
+                           acl: Optional[str] = None) -> None:
         """Unversioned head flip + index entry (the bucket index
         transaction role of AtomicObjectProcessor::complete); caller
         holds the bucket lock.  Replaced stripes go to deferred GC."""
@@ -658,8 +707,19 @@ class RGWLite:
                 if stripe["oid"] not in new_oids)
         await self._store(head_doc, {"manifest": manifest.to_dict(),
                                      "etag": etag})
-        doc["objects"][key] = {"size": manifest.obj_size,
-                               "etag": etag, "mtime": time.time()}
+        entry = {"size": manifest.obj_size,
+                 "etag": etag, "mtime": time.time()}
+        if acl is None:
+            # an overwrite without an explicit canned ACL keeps the
+            # previous object ACL (S3: each PUT resets to private
+            # unless x-amz-acl given; kept here because the frontend
+            # always passes the effective canned value)
+            prev = doc["objects"].get(key, {})
+            if "acl" in prev:
+                entry["acl"] = prev["acl"]
+        else:
+            entry["acl"] = acl
+        doc["objects"][key] = entry
         await self._store(self._bucket_oid(bucket), doc)
         await self._gc_commit(gc_ids)
 
@@ -679,7 +739,8 @@ class RGWLite:
     async def _link_version_locked(self, doc: Dict, vdoc: Dict,
                                    bucket: str, key: str,
                                    manifest: Manifest, etag: str,
-                                   null_version: bool) -> str:
+                                   null_version: bool,
+                                   acl: Optional[str] = None) -> str:
         vid = "null" if null_version else self._new_version_id()
         entry = {"version_id": vid, "etag": etag,
                  "manifest": manifest.to_dict(),
@@ -702,8 +763,13 @@ class RGWLite:
                                 if v["version_id"] != "null"]
         vdoc["versions"].insert(0, entry)
         await self._store(self._versions_oid(bucket, key), vdoc)
-        doc["objects"][key] = {"size": manifest.obj_size,
-                               "etag": etag, "mtime": entry["mtime"]}
+        head_entry = {"size": manifest.obj_size,
+                      "etag": etag, "mtime": entry["mtime"]}
+        if acl is not None:
+            head_entry["acl"] = acl
+        elif "acl" in doc["objects"].get(key, {}):
+            head_entry["acl"] = doc["objects"][key]["acl"]
+        doc["objects"][key] = head_entry
         vk = set(doc.setdefault("versioned_keys", []))
         vk.add(key)
         doc["versioned_keys"] = sorted(vk)
@@ -906,14 +972,20 @@ class RGWLite:
 
     # -- multipart ---------------------------------------------------------
 
-    async def init_multipart(self, bucket: str, key: str) -> str:
-        """RGWInitMultipart role: mint an upload id, persist state."""
+    async def init_multipart(self, bucket: str, key: str,
+                             acl: Optional[str] = None) -> str:
+        """RGWInitMultipart role: mint an upload id, persist state.
+        acl: canned ACL from the initiate request, applied when the
+        upload completes (S3 binds the ACL at initiate time)."""
         await self._bucket(bucket)
+        if acl is not None and acl not in CANNED_ACLS:
+            raise RGWError("InvalidArgument", f"bad acl {acl!r}")
         self._uploads += 1
         upload_id = f"u{self._uploads}-{int(time.time() * 1000):x}"
         await self._store(self._upload_oid(bucket, key, upload_id),
                           {"bucket": bucket, "key": key,
-                           "created": time.time(), "parts": {}})
+                           "created": time.time(), "parts": {},
+                           "acl": acl})
         return upload_id
 
     async def _upload(self, bucket: str, key: str,
@@ -996,8 +1068,8 @@ class RGWLite:
         # versioning adjudicated at link time, same as atomic PUT —
         # a multipart completion on a versioned bucket lands as a
         # version, never as a stray head doc
-        _etag_, _vid = await self._link_by_status(bucket, key,
-                                                  stitched, combined)
+        _etag_, _vid = await self._link_by_status(
+            bucket, key, stitched, combined, acl=doc.get("acl"))
         await self.meta.remove(self._upload_oid(bucket, key, upload_id))
         return combined
 

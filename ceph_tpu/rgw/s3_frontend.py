@@ -29,12 +29,17 @@ import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import Dict, List, Optional, Tuple
 
-from ceph_tpu.rgw.gateway import RGWError, RGWLite
+from ceph_tpu.rgw.gateway import CANNED_ACLS, RGWError, RGWLite
 
 log = logging.getLogger("rgw.http")
 
 UNSIGNED = "UNSIGNED-PAYLOAD"
 MAX_BODY = 5 << 30
+# anonymous (ACL-gated) requests may carry a body — public-read-write
+# buckets accept unauthenticated PUTs — but the pre-auth buffering
+# screen still applies: cap what an unauthenticated peer can make the
+# gateway hold in memory before the ACL check rejects it
+ANON_MAX_BODY = 16 << 20
 
 _ERR_STATUS = {
     "NoSuchBucket": 404, "NoSuchKey": 404, "NoSuchUpload": 404,
@@ -73,9 +78,14 @@ def _sig_key(secret: str, date: str, region: str, service: str) -> bytes:
 class S3Frontend:
     """One HTTP endpoint over an RGWLite gateway."""
 
-    def __init__(self, rgw: RGWLite, users: Dict[str, str]):
+    def __init__(self, rgw: RGWLite, users: Dict[str, str],
+                 anonymous_ok: bool = True):
         self.rgw = rgw
         self.users = dict(users)  # access_key -> secret_key
+        # anonymous_ok: admit unauthenticated requests as identity
+        # None so canned-ACL checks adjudicate them (public-read
+        # buckets); False restores require-sigv4-always
+        self.anonymous_ok = anonymous_ok
         self._server: Optional[asyncio.base_events.Server] = None
         self.addr = ""
 
@@ -131,8 +141,13 @@ class S3Frontend:
                 if length and not self._plausible_auth(headers):
                     # screen BEFORE buffering: an unauthenticated peer
                     # must not make the gateway hold a multi-GiB body
-                    # in memory just to 403 it
-                    return
+                    # in memory just to 403 it.  A request with NO auth
+                    # at all may still be a legitimate anonymous write
+                    # to a public-read-write bucket — allowed through
+                    # under the smaller anonymous cap
+                    if "authorization" in headers or \
+                            length > ANON_MAX_BODY:
+                        return
                 body = await reader.readexactly(length) if length else b""
                 keep = headers.get("connection", "").lower() != "close"
                 status, rhdrs, rbody = await self._handle(
@@ -206,24 +221,34 @@ class S3Frontend:
                 payload_hash != hashlib.sha256(body).hexdigest():
             raise _HttpError("SignatureDoesNotMatch",
                              "payload hash mismatch")
-        # canonical request
-        cq = "&".join(sorted(
+        # canonical request — spec form first; legacy curl (<8.3,
+        # --aws-sigv4) signs the RAW query string verbatim (no sort,
+        # no k= for bare keys), so a second pass accepts that form:
+        # same HMAC strength, alternative canonicalization
+        cq_spec = "&".join(sorted(
             "=".join((urllib.parse.quote(k, safe="-_.~"),
                       urllib.parse.quote(v, safe="-_.~")))
             for k, v in urllib.parse.parse_qsl(
                 query, keep_blank_values=True)))
         ch = "".join(f"{h}:{' '.join(headers.get(h, '').split())}\n"
                      for h in signed_headers.split(";"))
-        creq = "\n".join([method, path, cq, ch, signed_headers,
-                          payload_hash])
         scope = f"{date}/{region}/{service}/aws4_request"
         amz_date = headers.get("x-amz-date", "")
-        to_sign = "\n".join([
-            "AWS4-HMAC-SHA256", amz_date, scope,
-            hashlib.sha256(creq.encode()).hexdigest()])
-        want = hmac.new(_sig_key(secret, date, region, service),
-                        to_sign.encode(), hashlib.sha256).hexdigest()
-        if not hmac.compare_digest(want, fields.get("Signature", "")):
+        got_sig = fields.get("Signature", "")
+
+        def matches(cq: str) -> bool:
+            creq = "\n".join([method, path, cq, ch, signed_headers,
+                              payload_hash])
+            to_sign = "\n".join([
+                "AWS4-HMAC-SHA256", amz_date, scope,
+                hashlib.sha256(creq.encode()).hexdigest()])
+            want = hmac.new(_sig_key(secret, date, region, service),
+                            to_sign.encode(),
+                            hashlib.sha256).hexdigest()
+            return hmac.compare_digest(want, got_sig)
+
+        if not matches(cq_spec) and \
+                not (query != cq_spec and matches(query)):
             raise _HttpError("SignatureDoesNotMatch", "bad signature")
         # clock-skew window (S3's RequestTimeTooSkewed, ~15 min): a
         # captured signed request must not replay indefinitely
@@ -245,7 +270,14 @@ class S3Frontend:
                       ) -> Tuple[int, Dict[str, str], bytes]:
         path, _, query = target.partition("?")
         try:
-            self._verify_sigv4(method, path, query, headers, body)
+            if headers.get("authorization") or not self.anonymous_ok:
+                access = self._verify_sigv4(method, path, query,
+                                            headers, body)
+            else:
+                # anonymous request: identity None, every op gated by
+                # the canned-ACL checks below (RGWHandler_REST's
+                # anonymous auth applier role)
+                access = None
             q = dict(urllib.parse.parse_qsl(query,
                                             keep_blank_values=True))
             parts = urllib.parse.unquote(path).lstrip("/").split("/", 1)
@@ -253,12 +285,16 @@ class S3Frontend:
             key = parts[1] if len(parts) > 1 else ""
             if not bucket:
                 if method == "GET":
+                    if access is None:
+                        raise _HttpError("AccessDenied",
+                                         "anonymous service listing")
                     return await self._list_buckets()
                 raise _HttpError("InvalidRequest", "no bucket")
             if not key:
-                return await self._bucket_op(method, bucket, q, body)
+                return await self._bucket_op(method, bucket, q, body,
+                                             headers, access)
             return await self._object_op(method, bucket, key, q,
-                                         headers, body)
+                                         headers, body, access)
         except _HttpError as e:
             return self._error(e.code, str(e))
         except RGWError as e:
@@ -289,8 +325,117 @@ class S3Frontend:
             ET.SubElement(b, "Name").text = name
         return self._xml(root)
 
+    # -- canned-ACL adjudication (rgw_acl.cc verify_permission role) -------
+
+    @staticmethod
+    def _is_owner(access: Optional[str], owner: str) -> bool:
+        # pre-ACL buckets recorded no owner; they stay what they were
+        # before ACLs existed here — open to every AUTHENTICATED user
+        return access is not None and (not owner or access == owner)
+
+    @classmethod
+    def _may_read(cls, access: Optional[str], owner: str,
+                  acl: str) -> bool:
+        if cls._is_owner(access, owner):
+            return True
+        if acl in ("public-read", "public-read-write"):
+            return True
+        return acl == "authenticated-read" and access is not None
+
+    @classmethod
+    def _may_write(cls, access: Optional[str], owner: str,
+                   acl: str) -> bool:
+        if cls._is_owner(access, owner):
+            return True
+        return acl == "public-read-write"
+
+    def _require(self, ok: bool, what: str) -> None:
+        if not ok:
+            raise _HttpError("AccessDenied", what)
+
+    def _canned_from_headers(self, headers: Dict[str, str]
+                             ) -> Optional[str]:
+        acl = headers.get("x-amz-acl")
+        if acl is not None and acl not in CANNED_ACLS:
+            raise _HttpError("InvalidArgument", f"bad x-amz-acl {acl!r}")
+        return acl
+
+    def _acl_policy_xml(self, owner: str, acl: str):
+        """AccessControlPolicy rendering of a canned ACL (the
+        RGWAccessControlPolicy_S3 to_xml role)."""
+        root = ET.Element("AccessControlPolicy")
+        root.set("xmlns:xsi", "http://www.w3.org/2001/XMLSchema-instance")
+        o = ET.SubElement(root, "Owner")
+        ET.SubElement(o, "ID").text = owner
+        grants = ET.SubElement(root, "AccessControlList")
+
+        def grant(grantee: str, perm: str):
+            g = ET.SubElement(grants, "Grant")
+            ge = ET.SubElement(g, "Grantee")
+            if grantee == "owner":
+                ge.set("xsi:type", "CanonicalUser")
+                ET.SubElement(ge, "ID").text = owner
+            else:
+                ge.set("xsi:type", "Group")
+                ET.SubElement(ge, "URI").text = (
+                    "http://acs.amazonaws.com/groups/global/" + grantee)
+            ET.SubElement(g, "Permission").text = perm
+
+        grant("owner", "FULL_CONTROL")
+        if acl in ("public-read", "public-read-write"):
+            grant("AllUsers", "READ")
+        if acl == "public-read-write":
+            grant("AllUsers", "WRITE")
+        if acl == "authenticated-read":
+            grant("AuthenticatedUsers", "READ")
+        return self._xml(root)
+
     async def _bucket_op(self, method: str, bucket: str, q: Dict,
-                         body: bytes = b""):
+                         body: bytes = b"",
+                         headers: Optional[Dict] = None,
+                         access: Optional[str] = None):
+        headers = headers or {}
+        if method == "PUT" and "acl" in q:
+            info = await self.rgw.get_bucket_acl_info(bucket)
+            self._require(self._is_owner(access, info["owner"]),
+                          "bucket acl is owner-only")
+            acl = self._canned_from_headers(headers)
+            if acl is None:
+                raise _HttpError("InvalidArgument",
+                                 "x-amz-acl required (canned ACLs)")
+            await self.rgw.put_bucket_acl(bucket, acl)
+            return 200, {}, b""
+        if method == "GET" and "acl" in q:
+            info = await self.rgw.get_bucket_acl_info(bucket)
+            self._require(self._is_owner(access, info["owner"]),
+                          "bucket acl is owner-only")
+            return self._acl_policy_xml(info["owner"], info["acl"])
+        if method == "PUT" and not ("versioning" in q
+                                    or "lifecycle" in q):
+            # bucket creation: authenticated only, creator = owner
+            self._require(access is not None, "anonymous create")
+            await self.rgw.create_bucket(
+                bucket, owner=access,
+                acl=self._canned_from_headers(headers) or "private")
+            return 200, {}, b""
+        info = await self.rgw.get_bucket_acl_info(bucket)
+        owner, bacl = info["owner"], info["acl"]
+        if method in ("GET", "HEAD"):
+            # listings (plain, V2, ?versions, ?versioning, ?lifecycle)
+            # are bucket READs; config subresources stay owner-only
+            if "versioning" in q or "lifecycle" in q:
+                self._require(self._is_owner(access, owner),
+                              "bucket config is owner-only")
+            else:
+                self._require(self._may_read(access, owner, bacl),
+                              "bucket listing denied by acl")
+        elif method in ("PUT", "DELETE"):
+            self._require(self._is_owner(access, owner),
+                          "bucket mutation is owner-only")
+        return await self._bucket_op_authed(method, bucket, q, body)
+
+    async def _bucket_op_authed(self, method: str, bucket: str,
+                                q: Dict, body: bytes = b""):
         if method == "PUT" and "versioning" in q:
             try:
                 root = ET.fromstring(body)
@@ -363,9 +508,6 @@ class S3Frontend:
                     ET.SubElement(v, "ETag").text = \
                         f"\"{e['etag']}\""
             return self._xml(root)
-        if method == "PUT":
-            await self.rgw.create_bucket(bucket)
-            return 200, {}, b""
         if method == "DELETE":
             await self.rgw.delete_bucket(bucket)
             return 204, {}, b""
@@ -452,10 +594,45 @@ class S3Frontend:
         return rules
 
     async def _object_op(self, method: str, bucket: str, key: str,
-                         q: Dict, headers: Dict, body: bytes):
+                         q: Dict, headers: Dict, body: bytes,
+                         access: Optional[str] = None):
         rgw = self.rgw
+        info = await rgw.get_bucket_acl_info(bucket)
+        owner, bacl = info["owner"], info["acl"]
+        if "acl" in q and method in ("GET", "PUT"):
+            # object ?acl subresource: owner-only (READ_ACP/WRITE_ACP
+            # collapse onto ownership under canned policies)
+            self._require(self._is_owner(access, owner),
+                          "object acl is owner-only")
+            if method == "GET":
+                oacl = await rgw.get_object_acl(bucket, key)
+                return self._acl_policy_xml(owner, oacl)
+            acl = self._canned_from_headers(headers)
+            if acl is None:
+                raise _HttpError("InvalidArgument",
+                                 "x-amz-acl required (canned ACLs)")
+            await rgw.put_object_acl(bucket, key, acl)
+            return 200, {}, b""
+        if method in ("GET", "HEAD"):
+            # object reads: the OBJECT acl governs, with the bucket
+            # acl honored as a floor (a public-read bucket serves its
+            # objects; stricter per-object ACLs need per-object grants
+            # the canned model doesn't express)
+            try:
+                oacl = await rgw.get_object_acl(bucket, key)
+            except RGWError:
+                oacl = "private"  # versioned-only key: bucket governs
+            self._require(
+                self._may_read(access, owner, oacl)
+                or self._may_read(access, owner, bacl),
+                "object read denied by acl")
+        else:
+            # PUT/DELETE/multipart: bucket WRITE permission
+            self._require(self._may_write(access, owner, bacl),
+                          "object write denied by acl")
         if method == "POST" and "uploads" in q:
-            upload_id = await rgw.init_multipart(bucket, key)
+            upload_id = await rgw.init_multipart(
+                bucket, key, acl=self._canned_from_headers(headers))
             root = ET.Element("InitiateMultipartUploadResult")
             ET.SubElement(root, "Bucket").text = bucket
             ET.SubElement(root, "Key").text = key
@@ -482,7 +659,9 @@ class S3Frontend:
             await rgw.abort_multipart(bucket, key, q["uploadId"])
             return 204, {}, b""
         if method == "PUT":
-            etag, vid = await rgw.put_object_ex(bucket, key, body)
+            etag, vid = await rgw.put_object_ex(
+                bucket, key, body,
+                acl=self._canned_from_headers(headers))
             hdrs = {"ETag": f"\"{etag}\""}
             if vid is not None:
                 hdrs["x-amz-version-id"] = vid
