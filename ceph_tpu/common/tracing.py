@@ -1,0 +1,101 @@
+"""Distributed tracing spans (the blkin/zipkin role).
+
+Reference parity: /root/reference/src/blkin/ + the OSD/Messenger
+tracepoints behind `osd_blkin_trace_all` — a client op carries a trace
+context across the wire; every daemon it touches contributes spans
+(parent-linked, timestamped, annotated) so one request's journey
+(client -> primary -> replica sub-ops) reconstructs as a tree.  The
+reference emits LTTng events consumed by an external zipkin collector;
+this build keeps spans IN the daemons (bounded ring per Tracer) and
+exposes them over the admin-socket/tell surface (`dump_traces`), which
+fits the single-binary deployment the way the asok perf dump does.
+
+Propagation: a (trace_id, span_id) pair rides in MOSDOp/MOSDSubWrite
+(versioned tail fields — untraced peers skip them).  Inside a daemon
+the active span travels by contextvar, so nested sends (the primary's
+sub-writes fanned out under the op task) attach the right parent
+without threading a span through every call signature.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import secrets
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+# the span the running task is working under (primary op execution
+# sets it; sub-op sends read it) — context propagates per asyncio task
+current_span: contextvars.ContextVar[Optional["Span"]] = \
+    contextvars.ContextVar("ceph_tpu_current_span", default=None)
+
+
+def _id64() -> int:
+    return secrets.randbits(63) | 1  # nonzero
+
+
+class Span:
+    __slots__ = ("trace_id", "span_id", "parent_id", "name",
+                 "service", "start", "end", "events")
+
+    def __init__(self, trace_id: int, span_id: int, parent_id: int,
+                 name: str, service: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.service = service
+        self.start = time.time()
+        self.end: Optional[float] = None
+        self.events: List[Tuple[float, str]] = []
+
+    def event(self, what: str) -> None:
+        self.events.append((time.time(), what))
+
+    @property
+    def context(self) -> Tuple[int, int]:
+        """What goes on the wire: (trace_id, my span id)."""
+        return (self.trace_id, self.span_id)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"trace_id": f"{self.trace_id:016x}",
+                "span_id": f"{self.span_id:016x}",
+                "parent_id": f"{self.parent_id:016x}"
+                             if self.parent_id else "",
+                "name": self.name, "service": self.service,
+                "start": self.start,
+                "duration_us": int(((self.end or time.time())
+                                    - self.start) * 1e6),
+                "events": [{"t": t, "what": w}
+                           for t, w in self.events]}
+
+
+class Tracer:
+    """Per-daemon span collector: bounded ring, admin-socket dump."""
+
+    def __init__(self, service: str, max_spans: int = 2048):
+        self.service = service
+        self._done: deque = deque(maxlen=max_spans)
+
+    def start(self, name: str,
+              context: Optional[Tuple[int, int]] = None) -> Span:
+        """New span: child of `context` ((trace_id, parent_span_id)
+        from the wire or a local parent's .context), or a fresh root
+        trace when context is None."""
+        if context is not None:
+            trace_id, parent = int(context[0]), int(context[1])
+        else:
+            trace_id, parent = _id64(), 0
+        return Span(trace_id, _id64(), parent, name, self.service)
+
+    def finish(self, span: Span) -> None:
+        span.end = time.time()
+        self._done.append(span)
+
+    def dump(self, trace_id: Optional[int] = None) -> List[Dict]:
+        out = [s.to_dict() for s in self._done]
+        if trace_id is not None:
+            want = f"{trace_id:016x}"
+            out = [s for s in out if s["trace_id"] == want]
+        return out

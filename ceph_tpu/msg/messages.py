@@ -352,10 +352,12 @@ class MOSDOp(Message):
         self.snapc_seq = snapc_seq
         self.snapc_snaps = snapc_snaps or []
         self.snap_id = snap_id
+        # blkin-role trace context: (trace_id, parent span id) or None
+        self.trace: Optional[tuple] = None
 
-    # v2 appends the snap context + read snap; COMPAT stays 1 so a v1
-    # frame (pre-snapshot peer) still decodes with head-only defaults
-    VERSION = 2
+    # v2 appends the snap context + read snap; v3 the trace context.
+    # COMPAT stays 1 so a v1 frame still decodes with defaults
+    VERSION = 3
     COMPAT = 1
 
     def encode_payload(self, enc: Encoder) -> None:
@@ -368,6 +370,8 @@ class MOSDOp(Message):
         enc.u64(self.snapc_seq)
         enc.list(self.snapc_snaps, Encoder.u64)
         enc.u64(self.snap_id)
+        enc.optional(self.trace,
+                     lambda e, v: (e.u64(v[0]), e.u64(v[1])))
 
     @classmethod
     def decode(cls, data: bytes) -> "MOSDOp":
@@ -379,6 +383,8 @@ class MOSDOp(Message):
             msg.snapc_seq = dec.u64()
             msg.snapc_snaps = dec.list(Decoder.u64)
             msg.snap_id = dec.u64()
+        if struct_v >= 3:
+            msg.trace = dec.optional(lambda d: (d.u64(), d.u64()))
         dec.finish()
         return msg
 
@@ -452,8 +458,9 @@ class MOSDSubWrite(Message):
     """
 
     TAG = 11
-    VERSION = 2  # v2 appends guard (recovery-push causality token)
-    COMPAT = 1   # v1 peers decode head fields; guard defaults to None
+    VERSION = 3  # v2 appends guard (recovery-push causality token);
+    #              v3 the blkin-role trace context
+    COMPAT = 1   # v1 peers decode head fields; tails default to None
 
     def __init__(self, tid: int, pg: PgId, shard: int, oid: str,
                  ops: List[ShardOp], epoch: int,
@@ -474,6 +481,8 @@ class MOSDSubWrite(Message):
         # guard predates its current state — that is exactly a stale
         # (timed-out, still-in-flight) push overtaken by a newer write.
         self.guard = tuple(guard) if guard is not None else None
+        # blkin-role trace context: (trace_id, parent span id) or None
+        self.trace: Optional[tuple] = None
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u64(self.tid)
@@ -487,6 +496,8 @@ class MOSDSubWrite(Message):
         enc.s32(self.from_osd)
         enc.optional(self.guard,
                      lambda e, v: (e.u64(v[0]), e.u64(v[1])))
+        enc.optional(self.trace,
+                     lambda e, v: (e.u64(v[0]), e.u64(v[1])))
 
     @classmethod
     def decode(cls, data: bytes) -> "MOSDSubWrite":
@@ -498,6 +509,8 @@ class MOSDSubWrite(Message):
                   dec.s32())
         if struct_v >= 2:
             msg.guard = dec.optional(lambda d: (d.u64(), d.u64()))
+        if struct_v >= 3:
+            msg.trace = dec.optional(lambda d: (d.u64(), d.u64()))
         dec.finish()
         return msg
 

@@ -352,6 +352,9 @@ class OSDDaemon:
         self._scrub_task: Optional[asyncio.Task] = None
         self._admin_socket = None
         self.scrub_stats = {"objects": 0, "errors": 0, "repaired": 0}
+        from ceph_tpu.common import tracing
+
+        self.tracer = tracing.Tracer(f"osd.{osd_id}")
 
     @property
     def mon_addr(self) -> str:
@@ -423,6 +426,11 @@ class OSDDaemon:
             "scrub_stats": (
                 lambda cmd: dict(self.scrub_stats),
                 "lifetime scrub object/error/repair counters"),
+            "dump_traces": (
+                lambda cmd: {"spans": self.tracer.dump(
+                    int(cmd["trace_id"], 16)
+                    if cmd.get("trace_id") else None)},
+                "blkin-role spans collected on this daemon"),
         }
 
     def _start_admin_socket(self, path: str) -> None:
@@ -510,6 +518,14 @@ class OSDDaemon:
         addr = self.osdmap.osd_addrs.get(osd)
         if addr is None:
             return None
+        if isinstance(msg, MOSDSubWrite) and msg.trace is None:
+            # sub-ops fanned out under a traced client op inherit its
+            # span as parent (blkin's "span per sub-op" shape)
+            from ceph_tpu.common import tracing
+
+            parent = tracing.current_span.get()
+            if parent is not None:
+                msg.trace = parent.context
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._futures[tid] = fut
         try:
@@ -1195,6 +1211,19 @@ class OSDDaemon:
 
     async def _handle_sub_write(self, conn: Connection,
                                 msg: MOSDSubWrite) -> None:
+        if msg.trace is not None:
+            span = self.tracer.start(
+                f"sub_write {msg.oid} shard {msg.shard}",
+                context=msg.trace)
+            try:
+                await self._handle_sub_write_inner(conn, msg)
+            finally:
+                self.tracer.finish(span)
+            return
+        await self._handle_sub_write_inner(conn, msg)
+
+    async def _handle_sub_write_inner(self, conn: Connection,
+                                      msg: MOSDSubWrite) -> None:
         state = self.pgs.get(msg.pg)
         # fencing: a primary from an older interval must not mutate
         if state is not None and msg.epoch < state.interval_epoch:
@@ -2946,10 +2975,25 @@ class OSDDaemon:
         op_id = self.op_tracker.create(
             f"osd_op({msg.client} {msg.pg} {msg.oid!r} "
             f"{[op.op for op in msg.ops]})")
+        span = token = None
+        if msg.trace is not None:
+            # continue the client's trace: this span parents every
+            # sub-op span fanned out below (contextvar propagation)
+            from ceph_tpu.common import tracing
+
+            span = self.tracer.start(
+                f"osd_op {msg.oid} {'+'.join(o.op for o in msg.ops)}",
+                context=msg.trace)
+            token = tracing.current_span.set(span)
         try:
             await self._handle_client_op_tracked(conn, msg, op_id)
         finally:
             self.op_tracker.finish(op_id)
+            if span is not None:
+                from ceph_tpu.common import tracing
+
+                tracing.current_span.reset(token)
+                self.tracer.finish(span)
 
     async def _handle_client_op_tracked(self, conn: Connection,
                                         msg: MOSDOp,

@@ -86,6 +86,12 @@ class RadosClient:
         # without this a cluster-wide compression setting silently
         # skips client links
         self.msgr.apply_compress_config(config or {})
+        # blkin-role tracing: when trace_all is on, every submitted op
+        # opens a client span and carries its context to the OSDs
+        from ceph_tpu.common.tracing import Tracer
+
+        self.tracer = Tracer(name)
+        self.trace_all = bool((config or {}).get("client_trace_all"))
         self.osdmap: Optional[OSDMap] = None
         self.op_timeout = op_timeout
         self.max_retries = max_retries
@@ -492,6 +498,20 @@ class IoCtx:
         # non-idempotent op (append, exec) — the osd_reqid_t
         # discipline (PrimaryLogPG check_in_progress_op)
         tid = client._next_tid()
+        span = None
+        if client.trace_all:
+            span = client.tracer.start(
+                f"{'+'.join(op.op for op in ops)} {oid}")
+        try:
+            return await self._submit_traced(oid, ops, tid, span)
+        finally:
+            if span is not None:
+                client.tracer.finish(span)
+
+    async def _submit_traced(self, oid: str, ops: List[OSDOp],
+                             tid: int, span) -> MOSDOpReply:
+        client = self.client
+        last_error: Optional[Exception] = None
         for attempt in range(client.max_retries):
             osdmap = client.osdmap
             # placement recomputed per attempt: a pg_num split between
@@ -508,13 +528,20 @@ class IoCtx:
                 asyncio.get_running_loop().create_future()
             client._futures[tid] = fut
             try:
-                await client.msgr.send_to(
-                    addr, MOSDOp(tid, client.msgr.entity_name, pg, oid,
-                                 ops, osdmap.epoch,
-                                 snapc_seq=self.snapc_seq,
-                                 snapc_snaps=self.snapc_snaps,
-                                 snap_id=self.read_snap))
+                msg = MOSDOp(tid, client.msgr.entity_name, pg, oid,
+                             ops, osdmap.epoch,
+                             snapc_seq=self.snapc_seq,
+                             snapc_snaps=self.snapc_snaps,
+                             snap_id=self.read_snap)
+                if span is not None:
+                    msg.trace = span.context
+                    span.event(f"sent to osd.{primary}"
+                               + (f" (retry {attempt})" if attempt
+                                  else ""))
+                await client.msgr.send_to(addr, msg)
                 reply = await asyncio.wait_for(fut, client.op_timeout)
+                if span is not None:
+                    span.event("reply")
             except (ConnectionError, OSError) as e:
                 last_error = e
                 client._futures.pop(tid, None)
