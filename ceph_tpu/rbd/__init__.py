@@ -84,7 +84,8 @@ class RBD:
                      order: int = DEFAULT_ORDER,
                      data_pool: Optional[str] = None,
                      exclusive_lock: bool = False,
-                     object_map: bool = False) -> str:
+                     object_map: bool = False,
+                     journaling: bool = False) -> str:
         """Create an image; returns its id.  data_pool places the data
         objects on a different (e.g. erasure-coded) pool while
         metadata stays on this replicated pool (--data-pool role)."""
@@ -109,6 +110,13 @@ class RBD:
                 raise RadosError(-22, "object-map requires"
                                       " exclusive-lock")
             features.append("object-map")
+        if journaling:
+            if not exclusive_lock:
+                # librbd gates journaling on exclusive-lock too: the
+                # event stream needs one writer ordering it
+                raise RadosError(-22, "journaling requires"
+                                      " exclusive-lock")
+            features.append("journaling")
         meta = {"name": name, "size": size, "order": order,
                 "snaps": {}, "snap_seq": 0, "data_pool": data_pool,
                 "features": features}
@@ -229,6 +237,8 @@ class RBD:
             _ignore_enoent(img.data_ioctx.remove(_data(image_id, i)))
             for i in todo))
         await _ignore_enoent(ioctx.remove(_object_map(image_id)))
+        if img._journal is not None:
+            await img._journal.destroy()
         parent = img.meta.get("parent")
         if parent is not None:
             await img._deregister_child()
@@ -261,6 +271,9 @@ class RBD:
             raise RadosError(
                 -5, f"image {name!r} has no header (interrupted"
                     " create?); re-create to reclaim the name")
+        # journaling feature: replay events a crashed writer appended
+        # but never applied (librbd::Journal open-time replay)
+        await img._journal_replay()
         return img
 
     async def _dir(self, ioctx: IoCtx) -> Dict[str, str]:
@@ -357,6 +370,11 @@ class Image:
         # second copyup erases the first write's chunk (librbd guards
         # this with a server-side object-absent condition)
         self._copyup_lock = asyncio.Lock()
+        # journaling (feature-gated): write-ahead event log; see
+        # ceph_tpu.rbd.journal.  _replaying suppresses re-journaling
+        # while replay applies events through the ordinary op methods
+        self._journal = None
+        self._replaying = False
 
     # -- metadata ----------------------------------------------------------
 
@@ -371,6 +389,12 @@ class Image:
         if data_pool and self.data_ioctx is self.ioctx:
             self.data_ioctx = self.ioctx.client.open_ioctx(data_pool)
         self._apply_snapc()
+        if "journaling" in self.meta.get("features", []):
+            from ceph_tpu.rbd.journal import ImageJournal
+
+            if self._journal is None:
+                self._journal = ImageJournal(self.ioctx, self.id)
+            await self._journal.open()
 
     async def _save(self) -> None:
         await self.ioctx.omap_set(
@@ -380,6 +404,56 @@ class Image:
         snaps = sorted((s["id"] for s in self.meta["snaps"].values()),
                        reverse=True)
         self.data_ioctx.set_snap_context(self.meta["snap_seq"], snaps)
+
+    # -- journaling (librbd::Journal role) ---------------------------------
+
+    async def _j_append(self, ev) -> Optional[int]:
+        """Write-ahead: journal the event before applying it (no-op
+        without the feature, and during replay)."""
+        if self._journal is None or self._replaying:
+            return None
+        return await self._journal.append(ev)
+
+    async def _j_commit(self, seq: Optional[int]) -> None:
+        if seq is not None and self._journal is not None:
+            await self._journal.commit(seq)
+
+    async def _journal_replay(self) -> None:
+        """Apply events a crashed writer journaled but never applied
+        (seq above the commit position).  Events are idempotent
+        full-state mutations, so at-least-once re-application is
+        safe; snap ops tolerate already-done errors."""
+        if self._journal is None:
+            return
+        committed = int(self._journal.hdr.get("committed", 0))
+        events = await self._journal.events_since(committed)
+        if not events:
+            return
+        self._replaying = True
+        try:
+            for ev in events:
+                try:
+                    await self._apply_event(ev)
+                except RadosError:
+                    pass  # snap already created/removed, etc.
+                await self._journal.commit(ev["seq"])
+        finally:
+            self._replaying = False
+
+    async def _apply_event(self, ev) -> None:
+        op = ev["op"]
+        if op == "write":
+            await self.write(ev["offset"], ev["data"])
+        elif op == "discard":
+            await self.discard(ev["offset"], ev["length"])
+        elif op == "resize":
+            await self.resize(ev["size"])
+        elif op == "snap_create":
+            await self.snap_create(ev["snap_name"])
+        elif op == "snap_remove":
+            await self.snap_remove(ev["snap_name"])
+        elif op == "snap_rollback":
+            await self.snap_rollback(ev["snap_name"])
 
     @property
     def object_size(self) -> int:
@@ -755,6 +829,8 @@ class Image:
         if offset + len(data) > self.meta["size"]:
             raise RadosError(-27, "write past image size")  # EFBIG
         await self._ensure_lock()
+        seq = await self._j_append({"op": "write", "offset": offset,
+                                    "data": data})
         pos = 0
         jobs = []
         for objectno, in_off, span in self._extents(offset, len(data)):
@@ -763,6 +839,7 @@ class Image:
             jobs.append(self._write_object(objectno, in_off, span,
                                            chunk))
         await asyncio.gather(*jobs)
+        await self._j_commit(seq)
         return len(data)
 
     async def _write_object(self, objectno: int, in_off: int,
@@ -788,6 +865,8 @@ class Image:
         if self._read_snap is not None:
             raise RadosError(-30, "image is open at a snapshot")
         await self._ensure_lock()
+        seq = await self._j_append({"op": "discard", "offset": offset,
+                                    "length": length})
         overlap = self.meta["parent"]["overlap"] \
             if self._has_parent() else 0
         jobs = []
@@ -802,6 +881,7 @@ class Image:
                 jobs.append(self._write_object(objectno, in_off, span,
                                                bytes(span)))
         await asyncio.gather(*jobs)
+        await self._j_commit(seq)
 
     async def _discard_object(self, objectno: int, name: str) -> None:
         await _ignore_enoent(self.data_ioctx.remove(name))
@@ -811,6 +891,7 @@ class Image:
         if self._read_snap is not None:
             raise RadosError(-30, "image is open at a snapshot")
         await self._ensure_lock()
+        seq = await self._j_append({"op": "resize", "size": new_size})
         old = self.meta["size"]
         if new_size < old:
             # drop whole objects past the end; zero the partial tail
@@ -841,6 +922,7 @@ class Image:
                     self.meta["parent"]["overlap"], new_size)
         self.meta["size"] = new_size
         await self._save()
+        await self._j_commit(seq)
 
     # -- snapshots (librbd snap_create/list/remove/set) --------------------
 
@@ -848,6 +930,8 @@ class Image:
         if snap_name in self.meta["snaps"]:
             raise RadosError(-17, f"snap {snap_name!r} exists")
         await self._ensure_lock()
+        jseq = await self._j_append({"op": "snap_create",
+                                     "snap_name": snap_name})
         snap_id = await self.data_ioctx.create_selfmanaged_snap()
         entry = {"id": snap_id, "size": self.meta["size"]}
         if self._has_parent():
@@ -860,6 +944,7 @@ class Image:
         self.meta["snap_seq"] = max(self.meta["snap_seq"], snap_id)
         self._apply_snapc()
         await self._save()
+        await self._j_commit(jseq)
         return snap_id
 
     async def snap_list(self) -> List[Dict[str, Any]]:
@@ -909,10 +994,13 @@ class Image:
             raise ObjectNotFound(-2, snap_name)
         if snap.get("protected"):
             raise RadosError(-16, f"snap {snap_name!r} is protected")
+        jseq = await self._j_append({"op": "snap_remove",
+                                     "snap_name": snap_name})
         self.meta["snaps"].pop(snap_name)
         self._apply_snapc()
         await self._save()
         await self.data_ioctx.remove_selfmanaged_snap(snap["id"])
+        await self._j_commit(jseq)
 
     def snap_set(self, snap_name: Optional[str]) -> None:
         """Open the image read-only at a snapshot (None = head)."""
@@ -932,6 +1020,19 @@ class Image:
         snap = self.meta["snaps"].get(snap_name)
         if snap is None:
             raise ObjectNotFound(-2, snap_name)
+        jseq = await self._j_append({"op": "snap_rollback",
+                                     "snap_name": snap_name})
+        # the rollback's internal resize/writes re-journal unless
+        # suppressed: ONE rollback event stands for the whole copy
+        was_replaying, self._replaying = self._replaying, True
+        try:
+            await self._snap_rollback_inner(snap_name, snap)
+        finally:
+            self._replaying = was_replaying
+        await self._j_commit(jseq)
+
+    async def _snap_rollback_inner(self, snap_name: str,
+                                   snap) -> None:
         reader = Image(self.ioctx, self.name, self.id)
         await reader.refresh()  # binds data_ioctx (data_pool images)
         reader.snap_set(snap_name)
