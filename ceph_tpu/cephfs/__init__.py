@@ -29,7 +29,13 @@ import logging
 import time
 from typing import Any, Dict, List, Optional, Set
 
-from ceph_tpu.mds import ADDR_ATTR, LOCK_OBJ, data_obj
+from ceph_tpu.mds import (
+    ADDR_ATTR,
+    MDSMAP_OBJ,
+    data_obj,
+    owner_rank,
+    rank_lock_obj,
+)
 from ceph_tpu.msg.messages import MClientCaps, MClientRequest
 from ceph_tpu.rados.client import (
     IoCtx,
@@ -60,7 +66,10 @@ class CephFS:
         self.meta = client.open_ioctx(metadata_pool)
         self.data = client.open_ioctx(data_pool)
         self._tid = 0
-        self._mds_addr: Optional[str] = None
+        # one address per MDS RANK (multi-active subtree partitioning;
+        # rank layout discovered from the mds_map object)
+        self._mds_addrs: Dict[int, str] = {}
+        self._num_ranks: Optional[int] = None
         # -- caps state (Client.cc cap cache) ------------------------------
         self.caps_ttl = caps_ttl
         self._caps: Dict[int, str] = {}            # ino -> "r"|"rw"
@@ -109,11 +118,12 @@ class CephFS:
     def _trim_caps(self) -> None:
         victims = sorted(self._cap_expiry,
                          key=self._cap_expiry.get)[:self.max_caps // 4]
-        addr = self._mds_addr
-        conn = self.client.msgr._conns.get(addr) if addr else None
         for ino in victims:
             if ino in self._dirty:
                 continue  # never shed unflushed state
+            # voluntary release goes to the conn the cap was granted
+            # on (its rank's session)
+            conn = self._cap_conn.get(ino)
             self._drop_ino(ino)
             if conn is not None and not conn.closed:
                 # best-effort voluntary return so the MDS table shrinks
@@ -149,16 +159,22 @@ class CephFS:
         if time.monotonic() > self._cap_expiry.get(ino, 0.0):
             self._drop_ino(ino)
             return False
-        addr = self._mds_addr
-        conn = self.client.msgr._conns.get(addr) if addr else None
         granted_on = self._cap_conn.get(ino)
-        if conn is None or conn.closed or conn is not granted_on:
+        if granted_on is None or granted_on.closed or \
+                self.client.msgr._conns.get(
+                    granted_on.peer_addr) is not granted_on:
             # the granting connection is gone (or a reconnect minted a
-            # new one): the MDS evicted us with it, so every cached
-            # answer it covered is suspect
-            self._drop_all_caps()
+            # new one): that MDS evicted us with it, so every cached
+            # answer granted on it is suspect (other ranks' sessions
+            # are independent and keep their caps)
+            self._drop_conn_caps(granted_on)
             return False
         return True
+
+    def _drop_conn_caps(self, conn) -> None:
+        for ino in [i for i, c in self._cap_conn.items()
+                    if c is conn or c is None]:
+            self._drop_ino(ino)
 
     def _cached_inode(self, path: str) -> Optional[dict]:
         inode = self._attr_cache.get(path)
@@ -236,27 +252,55 @@ class CephFS:
 
     # -- MDS session -------------------------------------------------------
 
-    async def _discover_mds(self) -> str:
+    async def _num_mds_ranks(self) -> int:
+        """Rank-layout discovery (MDSMap role): published by the
+        active MDS; absent on a still-booting cluster — fall back to
+        single-active until it appears."""
+        if self._num_ranks is not None:
+            return self._num_ranks
+        try:
+            import json as _json
+
+            raw = await self.meta.read(MDSMAP_OBJ)
+            self._num_ranks = int(_json.loads(
+                raw.decode()).get("num_ranks", 1))
+        except Exception:
+            return 1
+        return self._num_ranks
+
+    def _rank_of(self, op: str, args: Dict[str, Any],
+                 num_ranks: int) -> int:
+        """The rank serving this op: same parent-directory rule the
+        daemons enforce (owner_rank); rename routes to the SRC owner,
+        which coordinates the dst rank itself."""
+        path = args.get("path") or args.get("src") or "/"
+        return owner_rank(path, num_ranks)
+
+    async def _discover_mds(self, rank: int = 0) -> str:
         for _ in range(100):
             try:
-                raw = await self.meta.getxattr(LOCK_OBJ, ADDR_ATTR)
+                raw = await self.meta.getxattr(rank_lock_obj(rank),
+                                               ADDR_ATTR)
                 return raw.decode()
             except (ObjectNotFound, RadosError):
                 await asyncio.sleep(0.1)
-        raise CephFSError(ESTALE, "no active MDS published an address")
+        raise CephFSError(
+            ESTALE, f"no active MDS for rank {rank} published"
+                    " an address")
 
     async def _request(self, op: str, args: Dict[str, Any]
                        ) -> Dict[str, Any]:
-        """Send one metadata op; on ESTALE/timeout re-discover the
-        active MDS and resend (Client session reconnect role)."""
+        """Send one metadata op to the owning rank; on ESTALE/timeout
+        re-discover and resend (Client session reconnect role)."""
         last: Optional[BaseException] = None
         self.mds_requests += 1
         for attempt in range(30):
-            if self._mds_addr is None:
-                self._mds_addr = await self._discover_mds()
-                # fresh discovery: whatever we cached was granted by a
-                # possibly-dead incarnation — start capless
-                self._drop_all_caps()
+            rank = self._rank_of(op, args, await self._num_mds_ranks())
+            if rank not in self._mds_addrs:
+                self._mds_addrs[rank] = await self._discover_mds(rank)
+                # fresh discovery: whatever this rank granted was from
+                # a possibly-dead incarnation — conn-identity checks
+                # in _cap_valid retire those caps lazily
             # ride the rados client's messenger + future table:
             # MClientReply resolves through its dispatcher like any
             # other tid-matched reply
@@ -265,19 +309,23 @@ class CephFS:
                 asyncio.get_running_loop().create_future()
             self.client._futures[tid] = fut
             try:
-                conn = await self.client.msgr.connect(self._mds_addr)
+                conn = await self.client.msgr.connect(
+                    self._mds_addrs[rank])
                 await conn.send(MClientRequest(tid, op, args))
                 reply = await asyncio.wait_for(fut, 10.0)
             except (ConnectionError, OSError,
                     asyncio.TimeoutError) as e:
                 last = e
-                self._mds_addr = None   # re-discover (failover)
+                self._mds_addrs.pop(rank, None)  # re-discover
                 await asyncio.sleep(0.3)
                 continue
             finally:
                 self.client._futures.pop(tid, None)
             if reply.rc == ESTALE:
-                self._mds_addr = None   # standby answered: re-discover
+                # standby answered, or the rank layout changed under
+                # us (misrouted): re-discover both
+                self._mds_addrs.pop(rank, None)
+                self._num_ranks = None
                 await asyncio.sleep(0.3)
                 continue
             if reply.rc != 0:
@@ -556,12 +604,12 @@ class File:
         if self.writable and self.fs._caps.get(ino) == "rw":
             # voluntarily return the exclusive cap so other clients'
             # opens don't pay a recall round trip (dirty already
-            # flushed above, so the release carries nothing)
+            # flushed above, so the release carries nothing) — to the
+            # conn it was granted on (that rank's session)
+            conn = self.fs._cap_conn.get(ino)
             self.fs._drop_ino(ino)
-            addr = self.fs._mds_addr
-            if addr is not None:
+            if conn is not None and not conn.closed:
                 try:
-                    await self.fs.client.msgr.send_to(
-                        addr, MClientCaps("release", ino))
+                    await conn.send(MClientCaps("release", ino))
                 except (ConnectionError, OSError):
                     pass

@@ -32,11 +32,25 @@ Re-designs vs the reference, deliberate:
   onto the object-lock it ultimately implements).
 - Inode numbers come from an atomic numops counter object (InoTable
   role, src/mds/InoTable.h).
+- MULTI-ACTIVE (the multimds/Migrator/MDBalancer role, re-designed):
+  N ranks each own a static namespace partition — root-parented
+  entries at rank 0, everything under top-level dir c at hash(c)
+  (the export-pin shape, src/mds/MDSMap.h, as a hash rule instead of
+  an operator attribute).  Because metadata lives in SHARED rados
+  behind guarded per-object ops, ranks are serialization domains, not
+  data silos: each rank has its own lock object, journal and standby
+  chain; foreign directories are readable by any rank (uncached);
+  exactly one rank ever mutates a given directory object.  Cross-rank
+  renames run a one-round peer_revoke RPC (caps + dir-cache
+  invalidation at the dst rank — the Migrator handshake collapsed to
+  invalidation, since no data needs to move).  Clients route by the
+  same rule from the published mds_map object.
 
 Layout in the metadata pool:
-  mds_lock                 cls_lock state + active MDS addr (xattr)
-  mds_journal              fenced journal (cls_journal omap entries)
-  mds_ino                  omap: {"next": counter}
+  mds_lock[.r]             cls_lock state + rank r's MDS addr (xattr)
+  mds_journal[.r]          fenced journal (cls_journal omap entries)
+  mds_map                  JSON: {"num_ranks": N}
+  mds_ino                  omap: {"next": counter} (shared, atomic)
   dir.<ino:x>              omap: dentry name -> inode JSON
 File data objects (data pool): fsdata.<ino:x>.<blockno:016x>
 """
@@ -79,9 +93,40 @@ ROOT_INO = 1
 LOCK_OBJ = "mds_lock"
 INO_OBJ = "mds_ino"
 JOURNAL_OBJ = "mds_journal"
+MDSMAP_OBJ = "mds_map"
 ADDR_ATTR = "mds.addr"
 # advance the applied watermark (and trim) after this many entries
 APPLIED_BATCH = 16
+
+
+def rank_lock_obj(rank: int) -> str:
+    """Per-rank active/standby lock object (rank 0 keeps the legacy
+    name so single-active layouts survive an upgrade)."""
+    return LOCK_OBJ if rank == 0 else f"{LOCK_OBJ}.{rank}"
+
+
+def rank_journal_obj(rank: int) -> str:
+    return JOURNAL_OBJ if rank == 0 else f"{JOURNAL_OBJ}.{rank}"
+
+
+def owner_rank(path: str, num_ranks: int) -> int:
+    """Subtree partitioning rule shared by MDS daemons and clients
+    (the export-pin role, /root/reference/src/mds/MDSMap.h mds_export
+    pinning re-designed as static hashing): an op belongs to the rank
+    owning the MUTATED PARENT directory — root-parented ops (top-level
+    dentries) at rank 0, deeper ops at hash(first component).  With
+    metadata in shared rados behind guarded per-object ops, ranks are
+    serialization domains, not data silos: foreign dirs are readable
+    by anyone (uncached), and exactly one rank mutates any given
+    directory object."""
+    if num_ranks <= 1:
+        return 0
+    parts = [p for p in path.split("/") if p]
+    if len(parts) <= 1:
+        return 0
+    from ceph_tpu.ops.rjenkins import ceph_str_hash_rjenkins
+
+    return ceph_str_hash_rjenkins(parts[0].encode()) % num_ranks
 
 
 def dir_obj(ino: int) -> str:
@@ -100,12 +145,22 @@ class MDSDaemon:
                  lock_interval: float = 1.0,
                  secret: "Optional[str]" = None,
                  secure: bool = False,
-                 config: "Optional[dict]" = None):
+                 config: "Optional[dict]" = None,
+                 rank: int = 0, num_ranks: int = 1):
         self.mon_addr = mon_addr
         self.metadata_pool = metadata_pool
         self.data_pool = data_pool
         self.name = name
         self.lock_interval = lock_interval
+        # multi-active: this daemon serves ONE rank (standbys for a
+        # rank run with the same rank number); see owner_rank()
+        self.rank = int(rank)
+        self.num_ranks = int(num_ranks)
+        self.lock_obj = rank_lock_obj(self.rank)
+        self.journal_obj = rank_journal_obj(self.rank)
+        self._peer_tid = 0
+        self._peer_futs: Dict[int, asyncio.Future] = {}
+        self.ops_served = 0  # client ops this daemon executed
         from ceph_tpu.common.auth import parse_secret
 
         self.client = RadosClient(mon_addr, name=f"mds.{name}",
@@ -177,7 +232,7 @@ class MDSDaemon:
                 pass
         if self.state == "active":
             try:
-                await self.meta.execute(LOCK_OBJ, "lock", "unlock",
+                await self.meta.execute(self.lock_obj, "lock", "unlock",
                                         json.dumps({
                                             "name": "active",
                                             "owner": self.name,
@@ -204,7 +259,7 @@ class MDSDaemon:
                           "owner": self.name,
                           "tag": "mds"}).encode()
         try:
-            await self.meta.execute(LOCK_OBJ, "lock", "lock", req)
+            await self.meta.execute(self.lock_obj, "lock", "lock", req)
         except RadosError:
             # someone else is active: stale-ness check via RENEWAL
             # COUNTERS aged by OUR monotonic clock — never comparing
@@ -220,7 +275,7 @@ class MDSDaemon:
                 self._dirs.clear()
                 self._drop_all_caps()
             try:
-                raw = await self.meta.getxattr(LOCK_OBJ, "renewal")
+                raw = await self.meta.getxattr(self.lock_obj, "renewal")
                 now = time.monotonic()
                 if self._seen_renewal is None or \
                         self._seen_renewal[0] != raw:
@@ -231,7 +286,7 @@ class MDSDaemon:
                     return  # unchanged, but not for long enough
                 holder = json.loads(raw)[0]
                 await self.meta.execute(
-                    LOCK_OBJ, "lock", "break_lock",
+                    self.lock_obj, "lock", "break_lock",
                     json.dumps({"name": "active",
                                 "locker": holder}).encode())
                 log.warning("mds.%s: broke stale lock of mds.%s",
@@ -242,17 +297,22 @@ class MDSDaemon:
         # lock held (fresh or renewal): stamp a counter + the address
         self._renew_counter += 1
         await self.meta.setxattr(
-            LOCK_OBJ, "renewal",
+            self.lock_obj, "renewal",
             json.dumps([self.name, self._renew_counter]).encode())
-        await self.meta.setxattr(LOCK_OBJ, ADDR_ATTR,
+        await self.meta.setxattr(self.lock_obj, ADDR_ATTR,
                                  self.msgr.addr.encode())
         if self.state != "active":
             await self._take_over()
+            # publish the rank layout so clients route without
+            # out-of-band config (the MDSMap role, one JSON object)
+            await self.meta.write_full(
+                MDSMAP_OBJ,
+                json.dumps({"num_ranks": self.num_ranks}).encode())
 
     async def _take_over(self) -> None:
         """Fence the previous active, replay its journal tail, serve.
         (MDLog replay + the mon-blocklist fencing role.)"""
-        out = await self.meta.execute(JOURNAL_OBJ, "journal",
+        out = await self.meta.execute(self.journal_obj, "journal",
                                       "take_over", b"")
         self._epoch = int(out.decode())
         self._dirs.clear()  # cold cache: reload from rados
@@ -265,12 +325,12 @@ class MDSDaemon:
     async def _replay_journal(self) -> None:
         from ceph_tpu.cls.journal import ENTRY_PREFIX
 
-        raw = await self.meta.execute(JOURNAL_OBJ, "journal",
+        raw = await self.meta.execute(self.journal_obj, "journal",
                                       "get_state", b"")
         st = json.loads(raw.decode())
         applied = int(st["applied"])
         try:
-            omap = await self.meta.omap_get(JOURNAL_OBJ)
+            omap = await self.meta.omap_get(self.journal_obj)
         except ObjectNotFound:
             omap = {}
         entries = sorted(
@@ -286,7 +346,7 @@ class MDSDaemon:
         self._seq = max(top, applied) + 1
         self._applied_mark = top
         await self.meta.execute(
-            JOURNAL_OBJ, "journal", "set_applied",
+            self.journal_obj, "journal", "set_applied",
             json.dumps({"epoch": self._epoch, "applied": top,
                         "from": applied}).encode())
         if top > applied:
@@ -309,17 +369,24 @@ class MDSDaemon:
 
     # -- directory cache (write-through; CDir::fetch/commit roles) ---------
 
-    async def _load_dir(self, ino: int) -> Dict[str, dict]:
-        cached = self._dirs.get(ino)
-        if cached is not None:
-            return cached
+    async def _load_dir(self, ino: int,
+                        owned: bool = True) -> Dict[str, dict]:
+        """owned=False: a FOREIGN directory (another rank mutates it)
+        — always read through to rados, never cache: the write-through
+        cache is only coherent for dirs this rank exclusively
+        mutates."""
+        if owned:
+            cached = self._dirs.get(ino)
+            if cached is not None:
+                return cached
         try:
             omap = await self.meta.omap_get(dir_obj(ino))
         except ObjectNotFound:
             raise MDSError(ENOENT, f"no directory {ino:x}")
         entries = {name: json.loads(raw.decode())
                    for name, raw in omap.items()}
-        self._dirs[ino] = entries
+        if owned:
+            self._dirs[ino] = entries
         return entries
 
     async def _guarded(self, method: str, oid: str, req: dict) -> None:
@@ -388,7 +455,7 @@ class MDSDaemon:
         self._seq += 1
         try:
             await self.meta.execute(
-                JOURNAL_OBJ, "journal", "append",
+                self.journal_obj, "journal", "append",
                 json.dumps({"epoch": self._epoch, "seq": seq,
                             "entry": ops}).encode())
         except RadosError as e:
@@ -429,7 +496,7 @@ class MDSDaemon:
             self._applied_mark = seq
             try:
                 await self.meta.execute(
-                    JOURNAL_OBJ, "journal", "set_applied",
+                    self.journal_obj, "journal", "set_applied",
                     json.dumps({"epoch": self._epoch, "applied": seq,
                                 "from": prev}).encode())
             except RadosError:
@@ -626,21 +693,91 @@ class MDSDaemon:
         if not parts:
             return 0, "", {"ino": ROOT_INO, "type": "dir", "mode": 0o755,
                            "size": 0, "mtime": 0}
+        # ownership per dir along the walk: the root object belongs to
+        # rank 0; every dir under top-level component c belongs to
+        # hash(c) — only OWNED dirs may be served from (and fill) the
+        # write-through cache
+        if self.num_ranks <= 1:
+            subtree_owned = True
+        else:
+            from ceph_tpu.ops.rjenkins import ceph_str_hash_rjenkins
+
+            subtree_owned = ceph_str_hash_rjenkins(
+                parts[0].encode()) % self.num_ranks == self.rank
         cur = ROOT_INO
         for i, part in enumerate(parts[:-1]):
-            entries = await self._load_dir(cur)
+            owned = (self.rank == 0) if cur == ROOT_INO \
+                else subtree_owned
+            entries = await self._load_dir(cur, owned=owned)
             inode = entries.get(part)
             if inode is None:
                 raise MDSError(ENOENT, "/".join(parts[:i + 1]))
             if inode["type"] != "dir":
                 raise MDSError(ENOTDIR, part)
             cur = inode["ino"]
-        entries = await self._load_dir(cur)
+        owned = (self.rank == 0) if cur == ROOT_INO else subtree_owned
+        entries = await self._load_dir(cur, owned=owned)
         return cur, parts[-1], entries.get(parts[-1])
+
+    # -- multi-active plumbing (Migrator/peer coordination role) -----------
+
+    def _dir_owned(self, path: str) -> bool:
+        """Is the directory OBJECT addressed by path mutated by this
+        rank?  (Root belongs to rank 0; dirs under top-level component
+        c to hash(c).)"""
+        if self.num_ranks <= 1:
+            return True
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            return self.rank == 0
+        from ceph_tpu.ops.rjenkins import ceph_str_hash_rjenkins
+
+        return ceph_str_hash_rjenkins(
+            parts[0].encode()) % self.num_ranks == self.rank
+
+    async def _peer_request(self, rank: int, op: str, args: dict,
+                            timeout: float = 3.0):
+        """MDS-to-MDS RPC over the service messenger (the reference's
+        MMDSPeerRequest role): address discovered from the peer rank's
+        lock object."""
+        raw = await self.meta.getxattr(rank_lock_obj(rank), ADDR_ATTR)
+        addr = raw.decode()
+        self._peer_tid += 1
+        tid = self._peer_tid
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._peer_futs[tid] = fut
+        try:
+            conn = await self.msgr.connect(addr)
+            await conn.send(MClientRequest(tid, op, args))
+            reply = await asyncio.wait_for(fut, timeout)
+            return reply.rc, reply.out
+        finally:
+            self._peer_futs.pop(tid, None)
+
+    async def _op_peer_revoke(self, args,
+                              conn=None) -> Tuple[int, Dict[str, Any]]:
+        """Peer rank asks us to revoke caps / drop dir-cache entries
+        it is about to invalidate (cross-rank rename coordination).
+        MUST run without the mutation lock: two ranks cross-renaming
+        into each other would deadlock otherwise."""
+        if args.get("revoke_all"):
+            await self._revoke_many(list(self._caps))
+            self._dirs.clear()
+        else:
+            await self._revoke_many(list(args.get("inos", [])))
+            for ino in args.get("invalidate_dirs", []):
+                self._dirs.pop(int(ino), None)
+        return 0, {}
 
     # -- request dispatch (Server::handle_client_request role) -------------
 
     async def _dispatch(self, conn: Connection, msg: Message) -> None:
+        if isinstance(msg, MClientReply):
+            # a peer rank answering our _peer_request
+            fut = self._peer_futs.get(msg.tid)
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+            return
         if isinstance(msg, MClientCaps):
             if msg.op == "ack":
                 fut = self._cap_acks.get(msg.tid)
@@ -666,9 +803,22 @@ class MDSDaemon:
             await conn.send(MClientReply(msg.tid, EINVAL,
                                          {"error": f"bad op {msg.op}"}))
             return
+        if self.num_ranks > 1 and msg.op != "peer_revoke":
+            # subtree routing guard: a misrouted op must bounce, not
+            # execute — executing here would mutate a dir object a
+            # DIFFERENT rank caches and serializes
+            path = msg.args.get("path") or msg.args.get("src") or "/"
+            want = owner_rank(path, self.num_ranks)
+            if want != self.rank:
+                await conn.send(MClientReply(
+                    msg.tid, ESTALE,
+                    {"error": "misrouted", "rank": want}))
+                return
+        self.ops_served += 1
         try:
-            if msg.op in ("lookup", "readdir", "stat", "readlink"):
-                rc, out = await handler(msg.args, conn)  # lock-free reads
+            if msg.op in ("lookup", "readdir", "stat", "readlink",
+                          "peer_revoke"):
+                rc, out = await handler(msg.args, conn)  # lock-free
             else:
                 async with self._mutation_lock:
                     rc, out = await handler(msg.args, conn)
@@ -789,7 +939,8 @@ class MDSDaemon:
             return ENOENT, {}
         if inode["type"] != "dir":
             return ENOTDIR, {}
-        entries = await self._load_dir(inode["ino"])
+        entries = await self._load_dir(
+            inode["ino"], owned=self._dir_owned(args["path"]))
         return 0, {"entries": {n: i for n, i in sorted(entries.items())}}
 
     async def _op_unlink(self, args,
@@ -815,7 +966,8 @@ class MDSDaemon:
             return ENOENT, {}
         if inode["type"] != "dir":
             return ENOTDIR, {}
-        entries = await self._load_dir(inode["ino"])
+        entries = await self._load_dir(
+            inode["ino"], owned=self._dir_owned(args["path"]))
         if entries:
             return ENOTEMPTY, {}
         await self._revoke_caps(inode["ino"])
@@ -839,7 +991,9 @@ class MDSDaemon:
             if existing["type"] == "dir":
                 if inode["type"] != "dir":
                     return EISDIR, {}
-                if await self._load_dir(existing["ino"]):
+                if await self._load_dir(
+                        existing["ino"],
+                        owned=self._dir_owned(args["dst"])):
                     return ENOTEMPTY, {}
             elif inode["type"] == "dir":
                 return ENOTDIR, {}
@@ -850,6 +1004,12 @@ class MDSDaemon:
         # every descendant's cached PATH on every client — paths are
         # the cache key, so recall everything (dir renames are rare;
         # the reference's per-dentry lease recall is finer-grained)
+        if self.num_ranks > 1:
+            rc = await self._rename_peer_coordinate(args, inode,
+                                                    dst_parent,
+                                                    existing)
+            if rc != 0:
+                return rc, {"error": "peer rank unavailable"}
         if inode["type"] == "dir":
             # bystander writers' flushed sizes must land while their
             # paths still resolve (we hold the mutation lock)
@@ -884,6 +1044,39 @@ class MDSDaemon:
                                     "block_size", 1 << 22)})
         await self._commit(ops)
         return 0, {"inode": inode}
+
+    async def _rename_peer_coordinate(self, args, inode, dst_parent,
+                                      existing) -> int:
+        """Cross-rank rename: before mutating a directory object a
+        peer rank owns, make that rank drop its caps and cache entries
+        for everything this rename touches (the Migrator's
+        export/import handshake collapsed onto one revoke round — the
+        shared-rados design means no data moves, only invalidation).
+        A DIRECTORY rename can re-home a whole subtree (top-level
+        rename changes hash ownership), so every peer flushes."""
+        dst_rank = owner_rank(args["dst"], self.num_ranks)
+        try:
+            if inode["type"] == "dir":
+                for r in range(self.num_ranks):
+                    if r != self.rank:
+                        await self._peer_request(
+                            r, "peer_revoke", {"revoke_all": True})
+            elif dst_rank != self.rank:
+                inos = [dst_parent, inode["ino"]]
+                inval = [dst_parent]
+                if existing is not None:
+                    inos.append(existing["ino"])
+                    if existing["type"] == "dir":
+                        inval.append(existing["ino"])
+                await self._peer_request(
+                    dst_rank, "peer_revoke",
+                    {"inos": inos, "invalidate_dirs": inval})
+        except (RadosError, ObjectNotFound, ConnectionError, OSError,
+                asyncio.TimeoutError):
+            # the peer rank is mid-takeover (or partitioned): the
+            # client retries on ESTALE after re-discovering
+            return ESTALE
+        return 0
 
     async def _op_setattr(self, args,
                           conn=None) -> Tuple[int, Dict[str, Any]]:

@@ -1,0 +1,169 @@
+"""Multi-active MDS: subtree-partitioned ranks, cross-rank rename
+coordination, per-rank standby takeover.
+
+Mirrors the reference's multimds coverage (qa/tasks/cephfs multimds,
+/root/reference/src/mds/MDSMap.h export pins): multiple active
+metadata servers each own a namespace partition, clients route by
+path, and a rank failure only stalls that rank's subtree until its
+standby takes over."""
+
+import asyncio
+
+from cluster_helpers import Cluster
+
+from ceph_tpu.cephfs import CephFS
+from ceph_tpu.mds import MDSDaemon, owner_rank
+
+
+def run(coro, timeout=120):
+    asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+FAST = {"lock_interval": 0.3}
+
+
+async def _fs_stack(cluster, num_ranks=2):
+    await cluster.client.create_replicated_pool("fsmeta", size=2,
+                                                pg_num=4)
+    await cluster.client.create_replicated_pool("fsdata", size=2,
+                                                pg_num=4)
+    daemons = []
+    for r in range(num_ranks):
+        mds = MDSDaemon(cluster.mon_addrs, "fsmeta", "fsdata",
+                        name=f"r{r}", rank=r, num_ranks=num_ranks,
+                        **FAST)
+        await mds.start()
+        daemons.append(mds)
+    fs = CephFS(cluster.client, "fsmeta", "fsdata")
+    return daemons, fs
+
+
+def _two_dirs_different_ranks(num_ranks=2):
+    """Top-level names landing on rank 0 and rank 1."""
+    by_rank = {}
+    for i in range(100):
+        name = f"dir{i}"
+        by_rank.setdefault(owner_rank(f"{name}/x", num_ranks), name)
+        if len(by_rank) == num_ranks:
+            break
+    assert len(by_rank) == num_ranks
+    return by_rank[0], by_rank[1]
+
+
+def test_owner_rank_rule():
+    # root-parented ops pin to rank 0; deeper ops hash the first
+    # component; single-rank layouts collapse to 0
+    assert owner_rank("/", 2) == 0
+    assert owner_rank("/anything", 2) == 0
+    assert owner_rank("/a/b", 1) == 0
+    r = owner_rank("/a/b", 2)
+    assert r == owner_rank("/a/b/c/d", 2) == owner_rank("/a/zz", 2)
+
+
+def test_two_ranks_serve_their_subtrees():
+    async def main():
+        cluster = Cluster(num_osds=2)
+        await cluster.start()
+        daemons = []
+        try:
+            daemons, fs = await _fs_stack(cluster)
+            d0, d1 = _two_dirs_different_ranks()
+            await fs.mkdir(f"/{d0}")
+            await fs.mkdir(f"/{d1}")
+            base0, base1 = (d.ops_served for d in daemons)
+            await fs.write_file(f"/{d0}/f", b"rank zero data")
+            await fs.write_file(f"/{d1}/f", b"rank one data")
+            assert await fs.read_file(f"/{d0}/f") == b"rank zero data"
+            assert await fs.read_file(f"/{d1}/f") == b"rank one data"
+            # deep trees under each partition
+            await fs.mkdir(f"/{d1}/sub")
+            await fs.write_file(f"/{d1}/sub/g", b"deep")
+            assert sorted(await fs.listdir(f"/{d1}")) == ["f", "sub"]
+            assert sorted(await fs.listdir("/")) == sorted([d0, d1])
+            # BOTH ranks actually executed ops (the partition is real)
+            assert daemons[0].ops_served > base0
+            assert daemons[1].ops_served > base1
+        finally:
+            for d in daemons:
+                await d.stop()
+            await cluster.stop()
+
+    run(main())
+
+
+def test_cross_rank_rename_coherent():
+    async def main():
+        cluster = Cluster(num_osds=2)
+        await cluster.start()
+        daemons = []
+        try:
+            daemons, fs = await _fs_stack(cluster)
+            d0, d1 = _two_dirs_different_ranks()
+            await fs.mkdir(f"/{d0}")
+            await fs.mkdir(f"/{d1}")
+            await fs.write_file(f"/{d0}/src", b"moving target")
+            await fs.write_file(f"/{d1}/dst", b"to be clobbered")
+            # a SECOND client caches the dst through ITS own session
+            from ceph_tpu.rados.client import RadosClient
+
+            c2 = RadosClient(cluster.mon_addrs)
+            await c2.connect()
+            fs2 = CephFS(c2, "fsmeta", "fsdata")
+            st = await fs2.stat(f"/{d1}/dst")
+            assert st["size"] == len(b"to be clobbered")
+            assert fs2._cached_inode(f"/{d1}/dst") is not None
+            # cross-rank rename: src owner coordinates the dst rank
+            await fs.rename(f"/{d0}/src", f"/{d1}/dst")
+            assert await fs.read_file(f"/{d1}/dst") == b"moving target"
+            # the peer revoke reached fs2: its cached dst is gone and a
+            # fresh stat sees the NEW inode
+            st2 = await fs2.stat(f"/{d1}/dst")
+            assert st2["size"] == len(b"moving target")
+            assert (await fs.listdir(f"/{d0}")) == []
+            await c2.shutdown()
+        finally:
+            for d in daemons:
+                await d.stop()
+            await cluster.stop()
+
+    run(main())
+
+
+def test_rank_standby_takeover():
+    async def main():
+        cluster = Cluster(num_osds=2)
+        await cluster.start()
+        daemons, extra = [], []
+        try:
+            daemons, fs = await _fs_stack(cluster)
+            d0, d1 = _two_dirs_different_ranks()
+            await fs.mkdir(f"/{d1}")
+            await fs.write_file(f"/{d1}/f", b"before failover")
+            # standby FOR RANK 1 joins
+            standby = MDSDaemon(cluster.mon_addrs, "fsmeta", "fsdata",
+                                name="r1b", rank=1, num_ranks=2,
+                                **FAST)
+            await standby.start()
+            extra.append(standby)
+            # hard-kill the rank-1 active (no clean unlock)
+            await daemons[1].msgr.shutdown()
+            daemons[1]._stopping = True
+            if daemons[1]._lock_task:
+                daemons[1]._lock_task.cancel()
+            # ops on rank 1's subtree continue after takeover
+            for _ in range(100):
+                if standby.state == "active":
+                    break
+                await asyncio.sleep(0.1)
+            assert standby.state == "active"
+            assert await fs.read_file(f"/{d1}/f") == b"before failover"
+            await fs.write_file(f"/{d1}/g", b"after failover")
+            assert await fs.read_file(f"/{d1}/g") == b"after failover"
+            # rank 0 never blinked
+            assert daemons[0].state == "active"
+        finally:
+            for d in daemons + extra:
+                await d.stop()
+            await cluster.stop()
+
+    run(main(), timeout=180)
