@@ -176,6 +176,17 @@ class CephFS:
                     if c is conn or c is None]:
             self._drop_ino(ino)
 
+    def _drop_addr_caps(self, addr: str) -> None:
+        """Failover hygiene: a rank's address was re-discovered, so
+        anything granted over connections to the OLD address came from
+        a possibly-fenced incarnation — even if that conn is still
+        open (a hung-but-connected deposed active must not keep
+        serving stale cached attrs until TTL)."""
+        for ino in [i for i, c in self._cap_conn.items()
+                    if c is None or getattr(c, "peer_addr", None)
+                    == addr]:
+            self._drop_ino(ino)
+
     def _cached_inode(self, path: str) -> Optional[dict]:
         inode = self._attr_cache.get(path)
         if inode is not None and self._cap_valid(inode["ino"]):
@@ -316,7 +327,9 @@ class CephFS:
             except (ConnectionError, OSError,
                     asyncio.TimeoutError) as e:
                 last = e
-                self._mds_addrs.pop(rank, None)  # re-discover
+                old = self._mds_addrs.pop(rank, None)  # re-discover
+                if old is not None:
+                    self._drop_addr_caps(old)
                 await asyncio.sleep(0.3)
                 continue
             finally:
@@ -324,7 +337,9 @@ class CephFS:
             if reply.rc == ESTALE:
                 # standby answered, or the rank layout changed under
                 # us (misrouted): re-discover both
-                self._mds_addrs.pop(rank, None)
+                old = self._mds_addrs.pop(rank, None)
+                if old is not None:
+                    self._drop_addr_caps(old)
                 self._num_ranks = None
                 await asyncio.sleep(0.3)
                 continue

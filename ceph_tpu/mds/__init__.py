@@ -41,10 +41,17 @@ Re-designs vs the reference, deliberate:
   data silos: each rank has its own lock object, journal and standby
   chain; foreign directories are readable by any rank (uncached);
   exactly one rank ever mutates a given directory object.  Cross-rank
-  renames run a one-round peer_revoke RPC (caps + dir-cache
-  invalidation at the dst rank — the Migrator handshake collapsed to
-  invalidation, since no data needs to move).  Clients route by the
-  same rule from the published mds_map object.
+  FILE renames are a durable-intent protocol: the src rank journals a
+  rename_intent, the DST rank links the dentry under its own lock,
+  journal and fencing epoch (peer_link, idempotent), then the src
+  rank commits the removal + a rename_finish marker — takeover
+  re-drives unfinished intents.  Top-level rmdir asks the owner rank
+  to adjudicate emptiness and fence creates (peer_rmdir_begin/done,
+  TTL-bounded dying mark); the owner removes the dir object under its
+  own epoch.  DIRECTORY renames that would re-home a subtree return
+  EXDEV (per-rank fencing epochs are incomparable; the reference's
+  Migrator moves metadata instead — documented gap).  Clients route
+  by the same rule from the published mds_map object.
 
 Layout in the metadata pool:
   mds_lock[.r]             cls_lock state + rank r's MDS addr (xattr)
@@ -83,6 +90,7 @@ EPERM = -1
 ENOENT = -2
 EIO = -5
 EEXIST = -17
+EXDEV = -18
 ENOTDIR = -20
 EISDIR = -21
 EINVAL = -22
@@ -161,6 +169,12 @@ class MDSDaemon:
         self._peer_tid = 0
         self._peer_futs: Dict[int, asyncio.Future] = {}
         self.ops_served = 0  # client ops this daemon executed
+        # cross-rank rename intents journaled but not yet finished
+        # (crash recovery drives them to completion on takeover)
+        self._pending_intents: Dict[int, Dict[str, Any]] = {}
+        # top-level dirs another rank is removing: our creates into
+        # them bounce until the mark clears or expires (peer_rmdir)
+        self._dying_dirs: Dict[int, float] = {}
         from ceph_tpu.common.auth import parse_secret
 
         self.client = RadosClient(mon_addr, name=f"mds.{name}",
@@ -321,6 +335,11 @@ class MDSDaemon:
         log.info("mds.%s: ACTIVE at %s (epoch %d)", self.name,
                  self.msgr.addr, self._epoch)
         self.state = "active"
+        if self._pending_intents:
+            # crashed mid cross-rank rename: drive each intent to its
+            # journaled conclusion (state must be active first — the
+            # peer RPCs below go through live messengers)
+            await self._finish_pending_renames()
 
     async def _replay_journal(self) -> None:
         from ceph_tpu.cls.journal import ENTRY_PREFIX
@@ -337,12 +356,23 @@ class MDSDaemon:
             (int(k[len(ENTRY_PREFIX):]), v)
             for k, v in omap.items() if k.startswith(ENTRY_PREFIX))
         top = applied
+        pending: Dict[int, Dict[str, Any]] = {}
         for seq, blob in entries:
+            ops = json.loads(blob.decode())
+            # intent/finish pairing spans the applied watermark: an
+            # intent may be applied (and trimmed from replay's range)
+            # while its finish never landed — scan ALL retained
+            # entries for pairing, apply only the un-applied ones
+            for op in ops:
+                if op.get("op") == "rename_intent":
+                    pending[seq] = op
+                elif op.get("op") == "rename_finish":
+                    pending.pop(int(op.get("intent_seq", -1)), None)
             if seq <= applied:
                 continue
-            ops = json.loads(blob.decode())
             await self._apply_ops(ops)
             top = seq
+        self._pending_intents = pending
         self._seq = max(top, applied) + 1
         self._applied_mark = top
         await self.meta.execute(
@@ -411,10 +441,13 @@ class MDSDaemon:
                 await self._guarded("guarded_update",
                                     dir_obj(dir_ino),
                                     {"set": {name: val}})
+                # update ONLY an already-loaded cache entry: seeding a
+                # partial entry here would later be served as the
+                # complete directory (lazy _load_dir fills cold dirs)
                 if inode is None:
                     self._dirs.get(dir_ino, {}).pop(name, None)
-                else:
-                    self._dirs.setdefault(dir_ino, {})[name] = inode
+                elif dir_ino in self._dirs:
+                    self._dirs[dir_ino][name] = inode
             elif kind == "mkdirobj":
                 await self._guarded("guarded_update",
                                     dir_obj(op["ino"]), {"set": {}})
@@ -426,6 +459,12 @@ class MDSDaemon:
                     if e.rc != ENOENT:
                         raise
                 self._dirs.pop(op["ino"], None)
+            elif kind in ("rename_intent", "rename_finish"):
+                # bookkeeping entries for the cross-rank rename
+                # protocol: no object mutation — replay pairs them up
+                # (_replay_journal) and _finish_pending_renames drives
+                # any unfinished intent to completion
+                pass
             elif kind == "purgefile":
                 # a rename clobbered a file: its data objects have no
                 # dentry left to purge them through — best-effort
@@ -442,12 +481,14 @@ class MDSDaemon:
     class _CrashPoint(Exception):
         """Test failpoint fired: simulate the daemon dying here."""
 
-    async def _commit(self, ops) -> None:
+    async def _commit(self, ops) -> int:
         """One compound metadata update (the EUpdate role): fenced
         journal append FIRST, then write-through apply.  The append is
         the commit point — a crash after it is finished by the next
         active's replay; a fenced append (EPERM: a newer epoch took
-        over) steps this MDS down without touching anything."""
+        over) steps this MDS down without touching anything.
+        Returns the entry's journal seq (rename intents reference
+        it)."""
         if self._fail_before_journal:
             await self._simulate_crash()
             raise self._CrashPoint()
@@ -501,6 +542,7 @@ class MDSDaemon:
                                 "from": prev}).encode())
             except RadosError:
                 pass  # fenced trim: the new active owns the journal
+        return seq
 
     async def _simulate_crash(self) -> None:
         """Failpoint: die like a SIGKILL — stop serving instantly,
@@ -697,13 +739,8 @@ class MDSDaemon:
         # rank 0; every dir under top-level component c belongs to
         # hash(c) — only OWNED dirs may be served from (and fill) the
         # write-through cache
-        if self.num_ranks <= 1:
-            subtree_owned = True
-        else:
-            from ceph_tpu.ops.rjenkins import ceph_str_hash_rjenkins
-
-            subtree_owned = ceph_str_hash_rjenkins(
-                parts[0].encode()) % self.num_ranks == self.rank
+        subtree_owned = self.num_ranks <= 1 or \
+            self._subtree_rank(parts[0]) == self.rank
         cur = ROOT_INO
         for i, part in enumerate(parts[:-1]):
             owned = (self.rank == 0) if cur == ROOT_INO \
@@ -721,25 +758,37 @@ class MDSDaemon:
 
     # -- multi-active plumbing (Migrator/peer coordination role) -----------
 
+    def _subtree_rank(self, first_component: str) -> int:
+        """The ONE rank serving every path under top-level component
+        c — the single source of the partition rule (owner_rank and
+        _dir_owned derive from it)."""
+        if self.num_ranks <= 1:
+            return 0
+        from ceph_tpu.ops.rjenkins import ceph_str_hash_rjenkins
+
+        return ceph_str_hash_rjenkins(
+            first_component.encode()) % self.num_ranks
+
     def _dir_owned(self, path: str) -> bool:
         """Is the directory OBJECT addressed by path mutated by this
         rank?  (Root belongs to rank 0; dirs under top-level component
         c to hash(c).)"""
-        if self.num_ranks <= 1:
-            return True
         parts = [p for p in path.split("/") if p]
         if not parts:
-            return self.rank == 0
-        from ceph_tpu.ops.rjenkins import ceph_str_hash_rjenkins
-
-        return ceph_str_hash_rjenkins(
-            parts[0].encode()) % self.num_ranks == self.rank
+            return self.num_ranks <= 1 or self.rank == 0
+        return self._subtree_rank(parts[0]) == self.rank
 
     async def _peer_request(self, rank: int, op: str, args: dict,
-                            timeout: float = 3.0):
+                            timeout: Optional[float] = None):
         """MDS-to-MDS RPC over the service messenger (the reference's
         MMDSPeerRequest role): address discovered from the peer rank's
-        lock object."""
+        lock object.  NEVER call while holding the mutation lock — the
+        peer's handler may take ITS mutation lock, and two ranks
+        calling each other would deadlock.  Default timeout exceeds
+        the peer's cap_revoke_timeout: a revoke waiting out a dead
+        holder must not time out at the caller first."""
+        if timeout is None:
+            timeout = self.cap_revoke_timeout + 2.0
         raw = await self.meta.getxattr(rank_lock_obj(rank), ADDR_ATTR)
         addr = raw.decode()
         self._peer_tid += 1
@@ -803,7 +852,7 @@ class MDSDaemon:
             await conn.send(MClientReply(msg.tid, EINVAL,
                                          {"error": f"bad op {msg.op}"}))
             return
-        if self.num_ranks > 1 and msg.op != "peer_revoke":
+        if self.num_ranks > 1 and not msg.op.startswith("peer_"):
             # subtree routing guard: a misrouted op must bounce, not
             # execute — executing here would mutate a dir object a
             # DIFFERENT rank caches and serializes
@@ -817,8 +866,12 @@ class MDSDaemon:
         self.ops_served += 1
         try:
             if msg.op in ("lookup", "readdir", "stat", "readlink",
-                          "peer_revoke"):
-                rc, out = await handler(msg.args, conn)  # lock-free
+                          "peer_revoke", "rename", "rmdir"):
+                # reads are lock-free; rename/rmdir manage their own
+                # locking (they must release it around peer RPCs);
+                # peer_revoke must never wait on the mutation lock
+                # (its caller holds its own — distributed deadlock)
+                rc, out = await handler(msg.args, conn)
             else:
                 async with self._mutation_lock:
                     rc, out = await handler(msg.args, conn)
@@ -847,6 +900,8 @@ class MDSDaemon:
         parent, name, existing = await self._resolve(args["path"])
         if not name:
             return EEXIST, {}
+        if self._dying(parent):
+            return ESTALE, {"error": "parent dir is being removed"}
         if existing is not None:
             return EEXIST, {}
         ino = await self._alloc_ino()
@@ -862,6 +917,8 @@ class MDSDaemon:
         parent, name, existing = await self._resolve(args["path"])
         if not name:
             return EISDIR, {}
+        if self._dying(parent):
+            return ESTALE, {"error": "parent dir is being removed"}
         if existing is not None:
             if existing["type"] == "dir":
                 return EISDIR, {}
@@ -895,6 +952,8 @@ class MDSDaemon:
         parent, name, existing = await self._resolve(args["path"])
         if not name or existing is not None:
             return EEXIST, {}
+        if self._dying(parent):
+            return ESTALE, {"error": "parent dir is being removed"}
         ino = await self._alloc_ino()
         inode = {"ino": ino, "type": "symlink",
                  "mode": 0o777, "size": len(args["target"]),
@@ -961,22 +1020,119 @@ class MDSDaemon:
 
     async def _op_rmdir(self, args,
                         conn=None) -> Tuple[int, Dict[str, Any]]:
-        parent, name, inode = await self._resolve(args["path"])
-        if inode is None:
-            return ENOENT, {}
-        if inode["type"] != "dir":
-            return ENOTDIR, {}
-        entries = await self._load_dir(
-            inode["ino"], owned=self._dir_owned(args["path"]))
-        if entries:
-            return ENOTEMPTY, {}
-        await self._revoke_caps(inode["ino"])
-        await self._commit([self._dentry(parent, name, None),
-                            {"op": "rmdirobj", "ino": inode["ino"]}])
-        return 0, {}
+        """Manages its OWN locking (like rename): removing a TOP-LEVEL
+        dir another rank owns runs the peer_rmdir protocol — the owner
+        adjudicates emptiness under ITS mutation lock and fences new
+        creates with a dying mark, so an empty-check here cannot race
+        a create committing there; the owner also removes the dir
+        object under its own fencing epoch (cross-rank epochs are
+        incomparable)."""
+        parts = [p for p in args["path"].split("/") if p]
+        foreign = None
+        if self.num_ranks > 1 and len(parts) == 1:
+            r = self._subtree_rank(parts[0])
+            if r != self.rank:
+                foreign = r
+        if foreign is None:
+            async with self._mutation_lock:
+                parent, name, inode = await self._resolve(
+                    args["path"])
+                if inode is None:
+                    return ENOENT, {}
+                if inode["type"] != "dir":
+                    return ENOTDIR, {}
+                entries = await self._load_dir(
+                    inode["ino"],
+                    owned=self._dir_owned(args["path"]))
+                if entries:
+                    return ENOTEMPTY, {}
+                await self._revoke_caps(inode["ino"])
+                await self._commit([
+                    self._dentry(parent, name, None),
+                    {"op": "rmdirobj", "ino": inode["ino"]}])
+                return 0, {}
+        async with self._mutation_lock:
+            parent, name, inode = await self._resolve(args["path"])
+            if inode is None:
+                return ENOENT, {}
+            if inode["type"] != "dir":
+                return ENOTDIR, {}
+        try:
+            rc, out = await self._peer_request(
+                foreign, "peer_rmdir_begin", {"ino": inode["ino"]})
+        except (RadosError, ObjectNotFound, ConnectionError, OSError,
+                asyncio.TimeoutError):
+            return ESTALE, {"error": "owner rank unavailable"}
+        if rc != 0:
+            return rc, out
+        removed = False
+        async with self._mutation_lock:
+            _p2, _n2, cur = await self._resolve(args["path"])
+            if cur is not None and cur["ino"] == inode["ino"]:
+                await self._revoke_caps(inode["ino"])
+                # dentry removal only — the OWNER removes the dir
+                # object in peer_rmdir_done under its epoch.  Crash
+                # before done: the dying mark expires and the object
+                # leaks invisibly (logged there), never corrupts.
+                await self._commit([self._dentry(parent, name, None)])
+                removed = True
+        try:
+            await self._peer_request(
+                foreign, "peer_rmdir_done",
+                {"ino": inode["ino"], "removed": removed})
+        except (RadosError, ObjectNotFound, ConnectionError, OSError,
+                asyncio.TimeoutError):
+            log.warning("mds.%s: peer_rmdir_done to rank %d lost;"
+                        " dir object %x may leak", self.name,
+                        foreign, inode["ino"])
+        return (0, {}) if removed else (ESTALE,
+                                        {"error": "dentry raced away"})
 
     async def _op_rename(self, args,
                          conn=None) -> Tuple[int, Dict[str, Any]]:
+        """Rename (manages its OWN locking — _dispatch leaves it
+        lock-free so the cross-rank path can release the mutation lock
+        around peer RPCs; two ranks cross-renaming into each other
+        while holding their locks would deadlock).
+
+        Cross-rank protocol (the Migrator handshake re-designed for
+        shared rados): the SRC rank journals a durable rename_intent,
+        then asks the DST rank to link the dentry UNDER ITS OWN
+        mutation lock, journal and fencing epoch (peer_link) — only
+        the object owner ever mutates a directory object, so dst-side
+        concurrency and cache coherence are its own single-rank
+        problem.  The src rank then commits the src-dentry removal
+        plus a rename_finish marker.  A crash leaves the intent in the
+        src journal; takeover re-drives it (peer_link is idempotent).
+
+        DIRECTORY renames that would RE-HOME a subtree (src and dst
+        top-level hashes differ) return EXDEV: per-rank fencing epochs
+        are incomparable, so migrating object ownership across ranks
+        is not supported — callers fall back to copy+delete exactly as
+        they do for rename(2) across filesystems.  (The reference's
+        Migrator moves the metadata instead; documented gap.)"""
+        src_parts = [p for p in args["src"].split("/") if p]
+        dst_parts = [p for p in args["dst"].split("/") if p]
+        if not src_parts or not dst_parts:
+            return EINVAL, {}
+        dst_rank = owner_rank(args["dst"], self.num_ranks)
+        if self.num_ranks > 1 and dst_rank != self.rank:
+            return await self._rename_cross_rank(args, dst_rank,
+                                                 src_parts, dst_parts)
+        async with self._mutation_lock:
+            return await self._rename_local(args, src_parts,
+                                            dst_parts)
+
+    def _dir_move_ranks(self, src_parts, dst_parts,
+                        is_dir: bool) -> Tuple[int, Optional[int]]:
+        """For a DIRECTORY rename: (subtree rank serving the moved
+        paths, or EXDEV-sentinel None if the move would re-home)."""
+        s = self._subtree_rank(src_parts[0])
+        d = self._subtree_rank(dst_parts[0])
+        return s, (s if s == d else None)
+
+    async def _rename_local(self, args, src_parts, dst_parts
+                            ) -> Tuple[int, Dict[str, Any]]:
         src_parent, src_name, inode = await self._resolve(args["src"])
         if inode is None:
             return ENOENT, {}
@@ -997,6 +1153,24 @@ class MDSDaemon:
                     return ENOTEMPTY, {}
             elif inode["type"] == "dir":
                 return ENOTDIR, {}
+        if inode["type"] == "dir" and self.num_ranks > 1:
+            sub, ok = self._dir_move_ranks(src_parts, dst_parts, True)
+            if ok is None:
+                return EXDEV, {"error": "directory rename would"
+                                        " re-home its subtree"}
+            if sub != self.rank:
+                # paths under the moved dir are served by rank `sub`:
+                # its clients' path caches (and its path-keyed state)
+                # must flush.  Called WITHOUT our mutation lock?  No —
+                # peer_revoke never takes the peer's mutation lock, so
+                # holding ours here cannot deadlock.
+                try:
+                    await self._peer_request(
+                        sub, "peer_revoke", {"revoke_all": True})
+                except (RadosError, ObjectNotFound, ConnectionError,
+                        OSError, asyncio.TimeoutError):
+                    return ESTALE, {"error": "subtree rank"
+                                             " unavailable"}
         # recall caps on the moved inode (cached paths go stale) and
         # fold a writer's dirty size into the dentry we re-link; the
         # clobbered target's caps go too (it is dying), its flushed
@@ -1004,12 +1178,6 @@ class MDSDaemon:
         # every descendant's cached PATH on every client — paths are
         # the cache key, so recall everything (dir renames are rare;
         # the reference's per-dentry lease recall is finer-grained)
-        if self.num_ranks > 1:
-            rc = await self._rename_peer_coordinate(args, inode,
-                                                    dst_parent,
-                                                    existing)
-            if rc != 0:
-                return rc, {"error": "peer rank unavailable"}
         if inode["type"] == "dir":
             # bystander writers' flushed sizes must land while their
             # paths still resolve (we hold the mutation lock)
@@ -1045,40 +1213,214 @@ class MDSDaemon:
         await self._commit(ops)
         return 0, {"inode": inode}
 
-    async def _rename_peer_coordinate(self, args, inode, dst_parent,
-                                      existing) -> int:
-        """Cross-rank rename: before mutating a directory object a
-        peer rank owns, make that rank drop its caps and cache entries
-        for everything this rename touches (the Migrator's
-        export/import handshake collapsed onto one revoke round — the
-        shared-rados design means no data moves, only invalidation).
-        A DIRECTORY rename can re-home a whole subtree (top-level
-        rename changes hash ownership), so every peer flushes."""
-        dst_rank = owner_rank(args["dst"], self.num_ranks)
-        try:
+    async def _rename_cross_rank(self, args, dst_rank, src_parts,
+                                 dst_parts
+                                 ) -> Tuple[int, Dict[str, Any]]:
+        async with self._mutation_lock:
+            src_parent, src_name, inode = await self._resolve(
+                args["src"])
+            if inode is None:
+                return ENOENT, {}
             if inode["type"] == "dir":
-                for r in range(self.num_ranks):
-                    if r != self.rank:
-                        await self._peer_request(
-                            r, "peer_revoke", {"revoke_all": True})
-            elif dst_rank != self.rank:
-                inos = [dst_parent, inode["ino"]]
-                inval = [dst_parent]
-                if existing is not None:
-                    inos.append(existing["ino"])
-                    if existing["type"] == "dir":
-                        inval.append(existing["ino"])
-                await self._peer_request(
-                    dst_rank, "peer_revoke",
-                    {"inos": inos, "invalidate_dirs": inval})
+                sub, ok = self._dir_move_ranks(src_parts, dst_parts,
+                                               True)
+                if ok is None:
+                    return EXDEV, {"error": "directory rename would"
+                                            " re-home its subtree"}
+            flush = await self._revoke_caps(inode["ino"])
+            if flush.get("size_max") is not None:
+                inode["size"] = max(inode.get("size", 0),
+                                    int(flush["size_max"]))
+            intent_seq = await self._commit([
+                {"op": "rename_intent", "src_dir": src_parent,
+                 "src_name": src_name, "dst": args["dst"],
+                 "inode": inode}])
+        # dir rename: the subtree rank's clients hold the moving
+        # paths (no lock held: peer RPCs)
+        if inode["type"] == "dir":
+            target = self._subtree_rank(src_parts[0])
+            if target != self.rank:
+                try:
+                    await self._peer_request(
+                        target, "peer_revoke", {"revoke_all": True})
+                except (RadosError, ObjectNotFound, ConnectionError,
+                        OSError, asyncio.TimeoutError):
+                    async with self._mutation_lock:
+                        await self._commit([{
+                            "op": "rename_finish",
+                            "intent_seq": intent_seq}])
+                    return ESTALE, {"error": "subtree rank"
+                                             " unavailable"}
+            else:
+                for fl in await self._revoke_all_caps():
+                    await self._apply_flush(fl, fl.get("path", ""))
+        try:
+            rc, out = await self._peer_request(
+                dst_rank, "peer_link",
+                {"dst": args["dst"], "inode": inode})
         except (RadosError, ObjectNotFound, ConnectionError, OSError,
                 asyncio.TimeoutError):
-            # the peer rank is mid-takeover (or partitioned): the
-            # client retries on ESTALE after re-discovering
-            return ESTALE
-        return 0
+            rc, out = ESTALE, {"error": "dst rank unavailable"}
+        async with self._mutation_lock:
+            if rc != 0:
+                await self._commit([{"op": "rename_finish",
+                                     "intent_seq": intent_seq}])
+                return rc, out
+            cur_p, cur_n, cur = await self._resolve(args["src"])
+            if cur is not None and cur.get("ino") == inode["ino"]:
+                await self._commit([
+                    self._dentry(src_parent, src_name, None),
+                    {"op": "rename_finish",
+                     "intent_seq": intent_seq}])
+                return 0, {"inode": inode}
+        # the src dentry changed while the lock was released (a
+        # concurrent op won the race): compensate — unlink the dst
+        # dentry we just linked, value-checked so a NEWER dst write
+        # survives
+        try:
+            await self._peer_request(
+                dst_rank, "peer_unlink_ifmatch",
+                {"dst": args["dst"], "ino": inode["ino"]})
+        except (RadosError, ObjectNotFound, ConnectionError, OSError,
+                asyncio.TimeoutError):
+            log.warning("mds.%s: rename compensation to rank %d"
+                        " failed; dst keeps the link", self.name,
+                        dst_rank)
+        async with self._mutation_lock:
+            await self._commit([{"op": "rename_finish",
+                                 "intent_seq": intent_seq}])
+        return ESTALE, {"error": "src dentry raced away"}
+
+    async def _finish_pending_renames(self) -> None:
+        """Takeover recovery: every journaled rename_intent without a
+        rename_finish is re-driven — peer_link again (idempotent at
+        the dst), then src removal + finish.  If the src dentry no
+        longer carries the ino the intent names, the rename already
+        finished (or lost a race) — just close the intent."""
+        for seq, intent in sorted(self._pending_intents.items()):
+            args = {"src": None, "dst": intent["dst"]}
+            inode = intent["inode"]
+            dst_rank = owner_rank(intent["dst"], self.num_ranks)
+            src_dir, src_name = intent["src_dir"], intent["src_name"]
+            try:
+                entries = await self._load_dir(src_dir)
+                cur = entries.get(src_name)
+            except MDSError:
+                cur = None
+            if cur is None or cur.get("ino") != inode["ino"]:
+                await self._commit([{"op": "rename_finish",
+                                     "intent_seq": seq}])
+                continue
+            try:
+                rc, _out = await self._peer_request(
+                    dst_rank, "peer_link",
+                    {"dst": intent["dst"], "inode": inode})
+            except (RadosError, ObjectNotFound, ConnectionError,
+                    OSError, asyncio.TimeoutError):
+                log.warning("mds.%s: pending rename intent %d: dst"
+                            " rank %d unreachable; left pending",
+                            self.name, seq, dst_rank)
+                continue  # stays pending; next takeover retries
+            ops = [{"op": "rename_finish", "intent_seq": seq}]
+            if rc == 0:
+                ops.insert(0, self._dentry(src_dir, src_name, None))
+            await self._commit(ops)
+        self._pending_intents.clear()
+
+    async def _op_peer_link(self, args,
+                            conn=None) -> Tuple[int, Dict[str, Any]]:
+        """Dst half of a cross-rank rename, executed by the OWNER of
+        the dst directory under ITS mutation lock/journal/epoch.
+        Idempotent: a replayed intent whose link already landed
+        returns success without re-journaling."""
+        inode = args["inode"]
+        dst_parent, dst_name, existing = await self._resolve(
+            args["dst"])
+        if not dst_name:
+            return EINVAL, {}
+        if self._dying(dst_parent):
+            return ESTALE, {"error": "dst dir is being removed"}
+        if existing is not None and existing["ino"] == inode["ino"]:
+            return 0, {}
+        if existing is not None:
+            if existing["type"] == "dir":
+                if inode["type"] != "dir":
+                    return EISDIR, {}
+                if await self._load_dir(
+                        existing["ino"],
+                        owned=self._dir_owned(args["dst"])):
+                    return ENOTEMPTY, {}
+            elif inode["type"] == "dir":
+                return ENOTDIR, {}
+        inos = [inode["ino"]]
+        if existing is not None:
+            inos.append(existing["ino"])
+        merged = await self._revoke_many(inos)
+        if existing is not None and                 merged.get(existing["ino"], {}).get("size_max")                 is not None:
+            existing["size"] = max(
+                existing.get("size", 0),
+                int(merged[existing["ino"]]["size_max"]))
+        ops = [self._dentry(dst_parent, dst_name, inode)]
+        if existing is not None and existing["ino"] != inode["ino"]:
+            if existing["type"] == "dir":
+                ops.append({"op": "rmdirobj", "ino": existing["ino"]})
+            elif existing["type"] == "file":
+                ops.append({"op": "purgefile", "ino": existing["ino"],
+                            "size": existing.get("size", 0),
+                            "block_size": existing.get("block_size",
+                                                       1 << 22)})
+        await self._commit(ops)
+        return 0, {}
+
+    async def _op_peer_unlink_ifmatch(self, args, conn=None
+                                      ) -> Tuple[int, Dict[str, Any]]:
+        """Compensation: remove the dst dentry IFF it still carries
+        the ino a failed cross-rank rename linked (value-checked — a
+        newer write to the same name survives)."""
+        dst_parent, dst_name, cur = await self._resolve(args["dst"])
+        if cur is not None and cur.get("ino") == args.get("ino"):
+            await self._revoke_caps(cur["ino"])
+            await self._commit([self._dentry(dst_parent, dst_name,
+                                             None)])
+        return 0, {}
+
+    # -- top-level rmdir across ranks (owner-side adjudication) ------------
+
+    def _dying(self, ino: int) -> bool:
+        exp = self._dying_dirs.get(ino)
+        if exp is None:
+            return False
+        if exp <= time.monotonic():
+            self._dying_dirs.pop(ino, None)
+            return False
+        return True
+
+    async def _op_peer_rmdir_begin(self, args, conn=None
+                                   ) -> Tuple[int, Dict[str, Any]]:
+        """Rank 0 wants to remove a top-level dir WE own: adjudicate
+        emptiness under OUR mutation lock and fence new creates into
+        it with a dying mark (TTL-bounded so a crashed remover cannot
+        wedge the dir forever)."""
+        ino = int(args["ino"])
+        entries = await self._load_dir(ino, owned=True)
+        if entries:
+            return ENOTEMPTY, {}
+        self._dying_dirs[ino] = time.monotonic() + 10.0
+        return 0, {}
+
+    async def _op_peer_rmdir_done(self, args, conn=None
+                                  ) -> Tuple[int, Dict[str, Any]]:
+        """Close the protocol: if the dentry removal committed, WE
+        remove the (empty) directory object under OUR epoch; either
+        way the dying mark clears."""
+        ino = int(args["ino"])
+        self._dying_dirs.pop(ino, None)
+        if args.get("removed"):
+            await self._commit([{"op": "rmdirobj", "ino": ino}])
+        return 0, {}
 
     async def _op_setattr(self, args,
+
                           conn=None) -> Tuple[int, Dict[str, Any]]:
         parent, name, inode = await self._resolve(args["path"])
         if inode is None:

@@ -167,3 +167,148 @@ def test_rank_standby_takeover():
             await cluster.stop()
 
     run(main(), timeout=180)
+
+
+def test_rehoming_dir_rename_is_exdev():
+    """A directory rename that would move its subtree to a different
+    rank returns EXDEV (per-rank fencing epochs are incomparable;
+    callers fall back to copy+delete as for cross-fs rename(2))."""
+    async def main():
+        from ceph_tpu.cephfs import CephFSError
+
+        cluster = Cluster(num_osds=2)
+        await cluster.start()
+        daemons = []
+        try:
+            daemons, fs = await _fs_stack(cluster)
+            d0, d1 = _two_dirs_different_ranks()
+            await fs.mkdir(f"/{d0}")
+            await fs.mkdir(f"/{d1}")
+            await fs.mkdir(f"/{d0}/inner")
+            await fs.write_file(f"/{d0}/inner/f", b"stay")
+            try:
+                await fs.rename(f"/{d0}/inner", f"/{d1}/moved")
+                assert False, "re-homing dir rename must fail"
+            except CephFSError as e:
+                assert e.rc == -18, e  # EXDEV
+            # contents untouched
+            assert await fs.read_file(f"/{d0}/inner/f") == b"stay"
+            # FILE renames across the same ranks still work
+            await fs.rename(f"/{d0}/inner/f", f"/{d1}/f")
+            assert await fs.read_file(f"/{d1}/f") == b"stay"
+            # and a top-level dir rename KEEPING its hash rank works
+            same = None
+            from ceph_tpu.mds import owner_rank as _or
+            for i in range(100, 200):
+                if _or(f"cand{i}/x", 2) == _or(f"{d0}/x", 2) \
+                        and f"cand{i}" != d0:
+                    same = f"cand{i}"
+                    break
+            await fs.rename(f"/{d0}", f"/{same}")
+            assert "inner" in await fs.listdir(f"/{same}")
+        finally:
+            for d in daemons:
+                await d.stop()
+            await cluster.stop()
+
+    run(main())
+
+
+def test_cross_rank_rename_crash_recovery():
+    """Crash the src rank right after the rename_intent lands (before
+    the dst link): the standby's takeover must drive the intent to
+    completion — file at dst, src dentry gone (the EUpdate-replay
+    guarantee extended across ranks)."""
+    async def main():
+        cluster = Cluster(num_osds=2)
+        await cluster.start()
+        daemons, extra = [], []
+        try:
+            daemons, fs = await _fs_stack(cluster)
+            d0, d1 = _two_dirs_different_ranks()
+            await fs.mkdir(f"/{d0}")
+            await fs.mkdir(f"/{d1}")
+            await fs.write_file(f"/{d0}/victim", b"must survive")
+            # standby for the SRC rank (rank of d0-parented ops)
+            from ceph_tpu.mds import owner_rank as _or
+
+            src_rank = _or(f"{d0}/victim", 2)
+            standby = MDSDaemon(cluster.mon_addrs, "fsmeta", "fsdata",
+                                name="sb", rank=src_rank, num_ranks=2,
+                                **FAST)
+            await standby.start()
+            extra.append(standby)
+            # arm the failpoint: the src rank dies right after its
+            # NEXT journal append — the rename_intent
+            daemons[src_rank]._fail_after_journal = True
+            try:
+                await fs.rename(f"/{d0}/victim", f"/{d1}/rescued")
+            except Exception:
+                pass  # the crash surfaces as a client-side error/retry
+            # takeover + intent recovery
+            for _ in range(200):
+                if standby.state == "active" and \
+                        not standby._pending_intents:
+                    break
+                await asyncio.sleep(0.1)
+            assert standby.state == "active"
+            # the rename CONVERGED: dst has the bytes, src is gone
+            assert await fs.read_file(f"/{d1}/rescued") == \
+                b"must survive"
+            try:
+                await fs.stat(f"/{d0}/victim")
+                assert False, "src dentry survived the recovery"
+            except Exception:
+                pass
+        finally:
+            for d in daemons + extra:
+                await d.stop()
+            await cluster.stop()
+
+    run(main(), timeout=180)
+
+
+def test_toplevel_rmdir_fences_concurrent_create():
+    """peer_rmdir protocol: while rank 0 removes a top-level dir, the
+    OWNER rank fences creates into it — no orphaned files, no
+    acknowledged-then-destroyed dentries."""
+    async def main():
+        cluster = Cluster(num_osds=2)
+        await cluster.start()
+        daemons = []
+        try:
+            daemons, fs = await _fs_stack(cluster)
+            _d0, d1 = _two_dirs_different_ranks()
+            await fs.mkdir(f"/{d1}")
+            # mark the dir dying at its owner (what peer_rmdir_begin
+            # does), then try to create into it through the client
+            from ceph_tpu.mds import owner_rank as _or
+
+            owner = daemons[_or(f"{d1}/x", 2)]
+            _parent, _name, inode = await owner._resolve(f"/{d1}")
+            rc, _ = await owner._op_peer_rmdir_begin(
+                {"ino": inode["ino"]})
+            assert rc == 0
+            try:
+                await asyncio.wait_for(
+                    fs.write_file(f"/{d1}/sneak", b"x"), 8)
+                created = True
+            except Exception:
+                created = False
+            assert not created, \
+                "create into a dying dir must be fenced"
+            # protocol closes WITHOUT removal: dir usable again
+            await owner._op_peer_rmdir_done(
+                {"ino": inode["ino"], "removed": False})
+            await fs.write_file(f"/{d1}/ok", b"y")
+            assert await fs.read_file(f"/{d1}/ok") == b"y"
+            # and the real rmdir path works end to end when empty
+            await fs.unlink(f"/{d1}/ok")
+            await fs.rmdir(f"/{d1}")
+            assert d1 not in await fs.listdir("/")
+        finally:
+            for d in daemons:
+                await d.stop()
+            await cluster.stop()
+
+    run(main(), timeout=180)
