@@ -1,0 +1,248 @@
+"""`rbd` CLI parity: block-image admin against a live cluster.
+
+Reference: /root/reference/src/tools/rbd/ — the block-storage
+workhorse CLI: create/ls/info/rm, resize, snapshot management
+(create/ls/protect/unprotect/rollback/rm), clone/flatten/children,
+export/import, and mirroring control.  One process, one command.
+
+Usage examples:
+  python -m ceph_tpu.tools.rbd -m HOST:PORT -p rbd create img --size 64M
+  python -m ceph_tpu.tools.rbd -m HOST:PORT -p rbd ls
+  python -m ceph_tpu.tools.rbd -m HOST:PORT -p rbd info img
+  python -m ceph_tpu.tools.rbd -m HOST:PORT -p rbd snap create img@s1
+  python -m ceph_tpu.tools.rbd -m HOST:PORT -p rbd snap protect img@s1
+  python -m ceph_tpu.tools.rbd -m HOST:PORT -p rbd clone img@s1 child
+  python -m ceph_tpu.tools.rbd -m HOST:PORT -p rbd flatten child
+  python -m ceph_tpu.tools.rbd -m HOST:PORT -p rbd export img ./img.bin
+  python -m ceph_tpu.tools.rbd -m HOST:PORT -p rbd import ./img.bin img2
+  python -m ceph_tpu.tools.rbd -m HOST:PORT -p rbd mirror img --dst-pool backup
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from ceph_tpu.rados.client import RadosClient, RadosError
+from ceph_tpu.rbd import RBD, Image
+
+
+def _size(text: str) -> int:
+    """64M / 1G / 4096 -> bytes."""
+    text = text.strip().upper()
+    mult = 1
+    for suffix, m in (("K", 1 << 10), ("M", 1 << 20), ("G", 1 << 30),
+                      ("T", 1 << 40)):
+        if text.endswith(suffix):
+            mult, text = m, text[:-1]
+            break
+    return int(float(text) * mult)
+
+
+def _img_snap(spec: str):
+    """img[@snap] -> (img, snap|None)."""
+    name, _, snap = spec.partition("@")
+    return name, (snap or None)
+
+
+async def _run(args) -> int:
+    client = RadosClient(args.mon, secret=args.secret or None)
+    await client.connect()
+    try:
+        ioctx = client.open_ioctx(args.pool)
+        rbd = RBD()
+        return await _dispatch(client, ioctx, rbd, args)
+    finally:
+        await client.shutdown()
+
+
+async def _dispatch(client, ioctx, rbd: RBD, args) -> int:
+    cmd = args.cmd
+    if cmd == "create":
+        await rbd.create(ioctx, args.image, _size(args.size),
+                         order=args.order,
+                         data_pool=args.data_pool,
+                         exclusive_lock=args.exclusive_lock
+                         or args.object_map or args.journaling,
+                         object_map=args.object_map,
+                         journaling=args.journaling)
+        return 0
+    if cmd == "ls":
+        for name in await rbd.list(ioctx):
+            print(name)
+        return 0
+    if cmd == "rm":
+        await rbd.remove(ioctx, args.image)
+        return 0
+    if cmd == "info":
+        img = await rbd.open(ioctx, args.image)
+        meta = img.meta
+        doc = {"name": args.image, "id": img.id,
+               "size": meta["size"], "order": meta["order"],
+               "object_size": img.object_size,
+               "features": meta.get("features", []),
+               "data_pool": meta.get("data_pool"),
+               "snapshots": sorted(meta.get("snaps", {})),
+               }
+        if meta.get("parent"):
+            p = meta["parent"]
+            doc["parent"] = (f"pool{p['pool_id']}/"
+                             f"{p['image_id']}@{p['snap_name']}")
+        await img.close()
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0
+    if cmd == "resize":
+        img = await rbd.open(ioctx, args.image)
+        await img.resize(_size(args.size))
+        await img.close()
+        return 0
+    if cmd == "snap":
+        return await _snap(ioctx, rbd, args)
+    if cmd == "clone":
+        parent, snap = _img_snap(args.parent)
+        if snap is None:
+            print("clone needs parent@snap", file=sys.stderr)
+            return 22
+        await rbd.clone(ioctx, parent, snap, ioctx, args.child,
+                        data_pool=args.data_pool)
+        return 0
+    if cmd == "flatten":
+        img = await rbd.open(ioctx, args.image)
+        await img.flatten()
+        await img.close()
+        return 0
+    if cmd == "children":
+        img = await rbd.open(ioctx, args.image)
+        for child in img.meta.get("children", []):
+            print(f"pool{child['pool_id']}/{child['image_id']}"
+                  f"@{child['snap_name']}")
+        await img.close()
+        return 0
+    if cmd == "export":
+        name, snap = _img_snap(args.image)
+        img = await rbd.open(ioctx, name)
+        if snap:
+            img.snap_set(snap)
+        out = sys.stdout.buffer if args.path == "-" \
+            else open(args.path, "wb")
+        step = img.object_size
+        total = img.size()
+        for off in range(0, total, step):
+            out.write(await img.read(off, min(step, total - off)))
+        if out is not sys.stdout.buffer:
+            out.close()
+        await img.close()
+        return 0
+    if cmd == "import":
+        src = sys.stdin.buffer if args.path == "-" \
+            else open(args.path, "rb")
+        data = src.read()
+        if src is not sys.stdin.buffer:
+            src.close()
+        await rbd.create(ioctx, args.image, len(data),
+                         order=args.order)
+        img = await rbd.open(ioctx, args.image)
+        step = img.object_size
+        for off in range(0, len(data), step):
+            await img.write(off, data[off:off + step])
+        await img.close()
+        return 0
+    if cmd == "mirror":
+        from ceph_tpu.rbd.mirror import MirrorReplayer
+
+        dst_io = client.open_ioctx(args.dst_pool)
+        m = MirrorReplayer(ioctx, dst_io, args.image)
+        await m.bootstrap()
+        applied = await m.replay_once()
+        print(json.dumps({"bootstrapped": True,
+                          "events_replayed": applied}))
+        return 0
+    print(f"unknown command {cmd}", file=sys.stderr)
+    return 22
+
+
+async def _snap(ioctx, rbd: RBD, args) -> int:
+    name, snap = _img_snap(args.spec)
+    img = await rbd.open(ioctx, name)
+    try:
+        verb = args.verb
+        if verb == "ls":
+            for s in await img.snap_list():
+                print(json.dumps(s))
+            return 0
+        if snap is None:
+            print("need image@snap", file=sys.stderr)
+            return 22
+        if verb == "create":
+            await img.snap_create(snap)
+        elif verb == "rm":
+            await img.snap_remove(snap)
+        elif verb == "protect":
+            await img.snap_protect(snap)
+        elif verb == "unprotect":
+            await img.snap_unprotect(snap)
+        elif verb == "rollback":
+            await img.snap_rollback(snap)
+        else:
+            print(f"unknown snap verb {verb}", file=sys.stderr)
+            return 22
+        return 0
+    finally:
+        await img.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="rbd")
+    ap.add_argument("-m", "--mon", required=True)
+    ap.add_argument("-p", "--pool", default="rbd")
+    ap.add_argument("--secret", default="")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("create")
+    c.add_argument("image")
+    c.add_argument("--size", required=True)
+    c.add_argument("--order", type=int, default=22)
+    c.add_argument("--data-pool", default=None)
+    c.add_argument("--exclusive-lock", action="store_true")
+    c.add_argument("--object-map", action="store_true")
+    c.add_argument("--journaling", action="store_true")
+
+    sub.add_parser("ls")
+    for name in ("rm", "info", "flatten", "children"):
+        sp = sub.add_parser(name)
+        sp.add_argument("image")
+    r = sub.add_parser("resize")
+    r.add_argument("image")
+    r.add_argument("--size", required=True)
+    s = sub.add_parser("snap")
+    s.add_argument("verb",
+                   choices=["create", "ls", "rm", "protect",
+                            "unprotect", "rollback"])
+    s.add_argument("spec", help="image or image@snap")
+    cl = sub.add_parser("clone")
+    cl.add_argument("parent", help="image@snap")
+    cl.add_argument("child")
+    cl.add_argument("--data-pool", default=None)
+    e = sub.add_parser("export")
+    e.add_argument("image", help="image or image@snap")
+    e.add_argument("path")
+    i = sub.add_parser("import")
+    i.add_argument("path")
+    i.add_argument("image")
+    i.add_argument("--order", type=int, default=22)
+    mi = sub.add_parser("mirror")
+    mi.add_argument("image")
+    mi.add_argument("--dst-pool", required=True)
+
+    args = ap.parse_args(argv)
+    try:
+        return asyncio.run(_run(args))
+    except RadosError as e:
+        print(f"rbd: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

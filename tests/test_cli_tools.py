@@ -152,3 +152,112 @@ def test_dencoder_round_trips(tmp_path, capsys):
     rc = dencoder.main(["list_types"])
     out = capsys.readouterr().out
     assert "OSDMap" in out and "MOSDOp" in out
+
+
+def test_rbd_cli_end_to_end(tmp_path):
+    """create/ls/info/snap/clone/flatten/export/import/mirror through
+    the rbd CLI binary against a live cluster (src/tools/rbd role)."""
+    async def main():
+        cluster = Cluster(num_osds=2)
+        await cluster.start()
+        try:
+            mon = cluster.mon.addr
+            rc0, _, err = await _rbd_cli(mon, "ls")
+            # pool missing yet: make pools via the rados CLI first
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, "-m", "ceph_tpu.tools.rados",
+                "-m", mon, "mkpool", "rbd", "--size", "2",
+                "--pg-num", "4",
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                env=_CLI_ENV)
+            await proc.communicate()
+            assert proc.returncode == 0
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, "-m", "ceph_tpu.tools.rados",
+                "-m", mon, "mkpool", "backup", "--size", "2",
+                "--pg-num", "4",
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                env=_CLI_ENV)
+            await proc.communicate()
+            assert proc.returncode == 0
+
+            rc, out, err = await _rbd_cli(
+                mon, "create", "disk", "--size", "256K",
+                "--order", "14", "--journaling")
+            assert rc == 0, err
+            rc, out, _ = await _rbd_cli(mon, "ls")
+            assert b"disk" in out
+            rc, out, err = await _rbd_cli(mon, "info", "disk")
+            assert rc == 0, err
+            doc = json.loads(out)
+            assert doc["size"] == 256 << 10
+            assert "journaling" in doc["features"]
+
+            # write through the API, export through the CLI
+            from ceph_tpu.rbd import RBD
+
+            ioctx = cluster.client.open_ioctx("rbd")
+            rbd = RBD()
+            img = await rbd.open(ioctx, "disk")
+            await img.write(0, b"cli export me")
+            await img.close()
+            out_path = tmp_path / "disk.bin"
+            rc, _, err = await _rbd_cli(mon, "export", "disk",
+                                        str(out_path))
+            assert rc == 0, err
+            blob = out_path.read_bytes()
+            assert blob[:13] == b"cli export me"
+            assert len(blob) == 256 << 10
+
+            # snapshot + protect + clone + flatten
+            rc, _, err = await _rbd_cli(mon, "snap", "create",
+                                        "disk@s1")
+            assert rc == 0, err
+            rc, _, err = await _rbd_cli(mon, "snap", "protect",
+                                        "disk@s1")
+            assert rc == 0, err
+            rc, _, err = await _rbd_cli(mon, "clone", "disk@s1",
+                                        "child")
+            assert rc == 0, err
+            rc, out, err = await _rbd_cli(mon, "info", "child")
+            assert rc == 0, err
+            assert "@s1" in json.loads(out).get("parent", "")
+            rc, _, err = await _rbd_cli(mon, "flatten", "child")
+            assert rc == 0, err
+            rc, out, _ = await _rbd_cli(mon, "info", "child")
+            assert "parent" not in json.loads(out)
+
+            # import round-trips
+            rc, _, err = await _rbd_cli(mon, "import", str(out_path),
+                                        "disk2", "--order", "14")
+            assert rc == 0, err
+            rc, out, _ = await _rbd_cli(mon, "info", "disk2")
+            assert json.loads(out)["size"] == 256 << 10
+
+            # mirror bootstrap+replay to a second pool
+            rc, out, err = await _rbd_cli(mon, "mirror", "disk",
+                                          "--dst-pool", "backup")
+            assert rc == 0, err
+            assert json.loads(out)["bootstrapped"] is True
+            bio = cluster.client.open_ioctx("backup")
+            mirrored = await rbd.open(bio, "disk")
+            assert await mirrored.read(0, 13) == b"cli export me"
+            await mirrored.close()
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+_CLI_ENV = {"PYTHONPATH": ".", "JAX_PLATFORMS": "cpu",
+            "PATH": "/usr/bin:/bin:/usr/local/bin"}
+
+
+async def _rbd_cli(mon, *args, input_=None):
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, "-m", "ceph_tpu.tools.rbd", "-m", mon,
+        "-p", "rbd", *args,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env=_CLI_ENV)
+    out, err = await proc.communicate(input_)
+    return proc.returncode, out, err
