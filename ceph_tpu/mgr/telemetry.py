@@ -1,0 +1,106 @@
+"""telemetry module: periodic anonymized cluster report.
+
+Reference parity: /root/reference/src/pybind/mgr/telemetry/module.py —
+collects an anonymized snapshot of cluster composition and health
+(counts, versions, pool shapes — never object names or user data) on
+an interval.  The reference POSTs it to telemetry.ceph.com; this
+build has zero egress by design, so the report lands in a rados
+object (`mgr_telemetry_report` in the first pool) and is served over
+the module's surface (`report()`), which covers the operational role:
+an operator (or a support bundle) reads one JSON document describing
+the cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Any, Dict, Optional
+
+from ceph_tpu.mgr import MgrModule
+
+log = logging.getLogger("mgr")
+
+REPORT_OBJ = "mgr_telemetry_report"
+
+
+class TelemetryModule(MgrModule):
+    NAME = "telemetry"
+
+    def __init__(self, mgr, interval: float = 60.0):
+        super().__init__(mgr)
+        self.interval = float(mgr.config.get("telemetry_interval",
+                                             interval))
+        self.last_report: Optional[Dict[str, Any]] = None
+        self._last_t = 0.0
+
+    async def serve_once(self) -> None:
+        if time.monotonic() - self._last_t < self.interval:
+            return
+        self._last_t = time.monotonic()
+        try:
+            await self.compile_and_store()
+        except Exception:
+            log.exception("mgr: telemetry collection failed")
+
+    async def report(self) -> Dict[str, Any]:
+        """One anonymized cluster snapshot (collected fresh)."""
+        osdmap = self.mgr.osdmap
+        doc: Dict[str, Any] = {"ts": time.time(),
+                               "channel": "basic"}
+        if osdmap is None:
+            return doc
+        up = osdmap.get_up_osds()
+        doc["osd"] = {
+            "count": sum(1 for o in range(osdmap.max_osd)
+                         if osdmap.exists(o)),
+            "up": len(up),
+            "in": sum(1 for o in range(osdmap.max_osd)
+                      if osdmap.is_in(o)),
+        }
+        # pool SHAPES only — names are user data and stay out, like
+        # the reference's anonymization
+        doc["pools"] = [
+            {"type": p.type, "size": p.size, "pg_num": p.pg_num,
+             "ec_profile": {k: v for k, v in (getattr(
+                 p, "ec_profile", None) or {}).items()
+                 if k in ("plugin", "technique", "k", "m", "l", "d")}}
+            for p in osdmap.pools.values()]
+        doc["epoch"] = osdmap.epoch
+        try:
+            rc, health = await self.mgr.client.mon_command(
+                {"prefix": "health"})
+            if rc == 0:
+                doc["health"] = {
+                    "status": health.get("status"),
+                    "checks": sorted(health.get("checks", {}))}
+        except Exception:
+            pass
+        try:
+            rc, stat = await self.mgr.client.mon_command(
+                {"prefix": "mon stat"})
+            if rc == 0:
+                doc["mon"] = {"count": stat.get("num_mons", 1),
+                              "quorum": len(stat.get("quorum", []))
+                              or 1}
+        except Exception:
+            pass
+        return doc
+
+    async def compile_and_store(self) -> Dict[str, Any]:
+        doc = await self.report()
+        self.last_report = doc
+        # persist into the first pool (support-bundle pickup point)
+        osdmap = self.mgr.osdmap
+        if osdmap is not None and osdmap.pools:
+            from ceph_tpu.rados.client import IoCtx
+
+            pool_id = sorted(osdmap.pools)[0]
+            io = IoCtx(self.mgr.client, pool_id)
+            try:
+                await io.write_full(REPORT_OBJ,
+                                    json.dumps(doc).encode())
+            except Exception:
+                pass  # a degraded pool must not kill the tick
+        return doc
