@@ -61,12 +61,18 @@ class TelemetryModule(MgrModule):
         }
         # pool SHAPES only — names are user data and stay out, like
         # the reference's anonymization
-        doc["pools"] = [
-            {"type": p.type, "size": p.size, "pg_num": p.pg_num,
-             "ec_profile": {k: v for k, v in (getattr(
-                 p, "ec_profile", None) or {}).items()
-                 if k in ("plugin", "technique", "k", "m", "l", "d")}}
-            for p in osdmap.pools.values()]
+        pools = []
+        for p in osdmap.pools.values():
+            profile = osdmap.erasure_code_profiles.get(
+                p.erasure_code_profile, {})
+            pools.append(
+                {"type": "erasure" if p.is_erasure()
+                 else "replicated",
+                 "size": p.size, "pg_num": p.pg_num,
+                 "ec_profile": {k: v for k, v in profile.items()
+                                if k in ("plugin", "technique", "k",
+                                         "m", "l", "d")}})
+        doc["pools"] = pools
         doc["epoch"] = osdmap.epoch
         try:
             rc, health = await self.mgr.client.mon_command(
