@@ -83,6 +83,8 @@ class CephFS:
         self._ino_paths: Dict[int, Set[str]] = {}  # reverse index
         # ino -> buffered dirty attrs awaiting flush (rw caps only)
         self._dirty: Dict[int, Dict[str, Any]] = {}
+        # snapid -> data-pool IoCtx reading at that snapshot
+        self._snap_ios: Dict[int, IoCtx] = {}
         # observability (tests assert the zero-round-trip property)
         self.mds_requests = 0
         self.cap_hits = 0
@@ -203,6 +205,12 @@ class CephFS:
             return
         if msg.op != "revoke":
             return
+        snapc = msg.attrs.get("snapc")
+        if snapc is not None:
+            # a recall after mksnap carries the fresh snap context —
+            # arm it NOW so our next write clones, even with no
+            # further MDS round trip
+            self.data.set_snap_context(snapc[0], snapc[1])
         # the ack carries our dirty attrs INCLUDING the path: recalls
         # driven by a directory rename persist bystander flushes by
         # path while those paths still resolve
@@ -347,6 +355,12 @@ class CephFS:
                 raise CephFSError(reply.rc,
                                   f"{op} {args.get('path', '')!r}"
                                   f" {reply.out.get('error', '')}")
+            dsnapc = reply.out.pop("_dsnapc", None)
+            if dsnapc is not None:
+                # the MDS publishes the data-pool snap context on
+                # every reply: our direct-to-OSD writes must COW
+                # against every live CephFS snapshot
+                self.data.set_snap_context(dsnapc[0], dsnapc[1])
             self._trace_reply(op, args, reply.out)
             # stamp the conn this reply rode in on: any cap in the
             # reply was granted on THAT session (see _record_cap)
@@ -376,11 +390,55 @@ class CephFS:
 
     # -- namespace ops -----------------------------------------------------
 
+    @staticmethod
+    def _snap_mkdir_target(path: str):
+        """'/a/b/.snap/s1' -> ('/a/b', 's1') — mkdir/rmdir inside a
+        .snap pseudo-directory IS snapshot create/remove (the
+        reference's mkdir-on-snapdir semantics)."""
+        parts = [p for p in path.split("/") if p]
+        if len(parts) >= 2 and parts[-2] == ".snap":
+            return "/" + "/".join(parts[:-2]), parts[-1]
+        return None
+
     async def mkdir(self, path: str, mode: int = 0o755) -> None:
+        snap = self._snap_mkdir_target(path)
+        if snap is not None:
+            await self.mksnap(snap[0], snap[1])
+            return
         await self._request("mkdir", {"path": path, "mode": mode})
 
     async def rmdir(self, path: str) -> None:
+        snap = self._snap_mkdir_target(path)
+        if snap is not None:
+            await self.rmsnap(snap[0], snap[1])
+            return
         await self._request("rmdir", {"path": path})
+
+    # -- snapshots (.snap pseudo-directory surface) ------------------------
+
+    async def mksnap(self, path: str, name: str) -> int:
+        out = await self._request("mksnap",
+                                  {"path": path, "name": name})
+        return out.get("snapid", 0)
+
+    async def rmsnap(self, path: str, name: str) -> None:
+        await self._request("rmsnap", {"path": path, "name": name})
+
+    async def lssnap(self, path: str) -> List[dict]:
+        out = await self._request("lssnap", {"path": path})
+        return out["snaps"]
+
+    def _snap_data_io(self, snapid: int) -> IoCtx:
+        """Data-pool IoCtx reading at a snapshot (cached; snapshots
+        are immutable)."""
+        io = self._snap_ios.get(snapid)
+        if io is None:
+            if len(self._snap_ios) >= 64:
+                self._snap_ios.clear()  # bounded: rebuilt on demand
+            io = IoCtx(self.client, self.data.pool_id)
+            io.snap_set_read(snapid)
+            self._snap_ios[snapid] = io
+        return io
 
     async def listdir(self, path: str) -> List[str]:
         out = await self._request("readdir", {"path": path})
@@ -437,6 +495,11 @@ class CephFS:
             for b in range(blocks)))
 
     async def truncate(self, path: str, size: int) -> None:
+        if ".snap" in path.split("/"):
+            # guard BEFORE touching data objects: the snap-aware stat
+            # below would resolve to the live ino and the head purge
+            # would destroy the live file before the MDS said EROFS
+            raise CephFSError(EROFS, path)
         await self._flush_dirty_path(path)
         inode = await self.stat(path)
         if inode["type"] != "file":
@@ -464,6 +527,8 @@ class CephFS:
         create time, ignored on existing files."""
         create = any(f in flags for f in "wax")
         writable = create or "+" in flags
+        if ".snap" in path.split("/") and (create or writable):
+            raise CephFSError(EROFS, path)
         want = "rw" if writable else "r"
         if create:
             out = await self._request(
@@ -557,9 +622,13 @@ class File:
             return b""
         length = min(length, size - offset)
 
+        # a snapshot inode reads its data AT the snapshot's snapid
+        snapid = self.inode.get("snapid", 0)
+        io = self.fs._snap_data_io(snapid) if snapid else self.fs.data
+
         async def one(blockno: int, in_off: int, span: int) -> bytes:
             try:
-                buf = await self.fs.data.read(
+                buf = await io.read(
                     data_obj(self.inode["ino"], blockno), in_off, span)
             except ObjectNotFound:
                 return bytes(span)

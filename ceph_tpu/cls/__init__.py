@@ -128,7 +128,7 @@ class MethodContext:
         self._need_wr()
         rc = await self._d._op_omap_write(
             self._state, self._pool, self.oid, "omap_set",
-            encode_kv_map(kv), self._admit_epoch)
+            encode_kv_map(kv), self._admit_epoch, self._snapc)
         if rc != 0:
             raise ClsError(rc, "omap_set")
 
@@ -138,7 +138,8 @@ class MethodContext:
         self._need_wr()
         rc = await self._d._op_omap_write(
             self._state, self._pool, self.oid, "omap_rm",
-            encode_str_list(list(keys)), self._admit_epoch)
+            encode_str_list(list(keys)), self._admit_epoch,
+            self._snapc)
         if rc != 0:
             raise ClsError(rc, "omap_rm_keys")
 

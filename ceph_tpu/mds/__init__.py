@@ -97,11 +97,26 @@ EINVAL = -22
 ENOTEMPTY = -39
 ESTALE = -116
 
+EROFS = -30
+
 ROOT_INO = 1
 LOCK_OBJ = "mds_lock"
 INO_OBJ = "mds_ino"
 JOURNAL_OBJ = "mds_journal"
 MDSMAP_OBJ = "mds_map"
+# SnapServer role (src/mds/SnapServer.h): the cluster-wide snapshot
+# table.  One omap key per snapshot (never read-modify-written, so
+# ranks write concurrently without coordination): key = the data-pool
+# snapid zero-padded, value = JSON {name, ino, meta_snap, data_snap,
+# ctime}.  COW itself is the RADOS self-managed snap machinery: each
+# CephFS snapshot allocates ONE snapid per pool (metadata + data);
+# every writer (MDS dir-omap mutations, client file-data writes)
+# carries the union of live snapids as its snap context, so the OSDs
+# clone heads before the first post-snap mutation.  ".snap" paths
+# resolve by reading dir objects AT the metadata snapid and file
+# blocks AT the data snapid.
+SNAPTABLE_OBJ = "mds_snaptable"
+SNAP_DIR = ".snap"
 ADDR_ATTR = "mds.addr"
 # advance the applied watermark (and trim) after this many entries
 APPLIED_BATCH = 16
@@ -224,6 +239,16 @@ class MDSDaemon:
         self._cap_acks: Dict[int, asyncio.Future] = {}
         self.cap_revoke_timeout = 3.0
         self.msgr.on_connection_fault = self._conn_fault
+        # -- snapshots (SnapServer/SnapRealm role) ------------------------
+        # data-pool snap context published to clients (rides replies
+        # and cap revokes so writers COW against every live snap)
+        self._data_snapc: Tuple[int, list] = (0, [])
+        # snapid -> metadata-pool IoCtx with read_snap set (immutable
+        # once created; reads of dir omap at that snap)
+        self._snap_ios: Dict[int, IoCtx] = {}
+        # (dir ino, meta snapid) -> entries; immutable so cacheable,
+        # bounded by wholesale eviction
+        self._snap_dirs: Dict[Tuple[int, int], Dict[str, dict]] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -331,6 +356,9 @@ class MDSDaemon:
         self._epoch = int(out.decode())
         self._dirs.clear()  # cold cache: reload from rados
         await self._ensure_root()
+        # snap contexts BEFORE any replayed mutation: replayed dir
+        # writes and purges must COW against every live snapshot
+        await self._refresh_snapc()
         await self._replay_journal()
         log.info("mds.%s: ACTIVE at %s (epoch %d)", self.name,
                  self.msgr.addr, self._epoch)
@@ -606,9 +634,17 @@ class MDSDaemon:
                         asyncio.get_running_loop().create_future()
                     fut._cap_conn = conn
                     self._cap_acks[tid] = fut
+                    # the recall itself carries the (possibly empty)
+                    # snap context: a recalled writer must COW its
+                    # very next write — or stop cloning after the
+                    # last rmsnap — before any MDS round trip
+                    revoke_attrs = {"snapc": [
+                        self._data_snapc[0],
+                        list(self._data_snapc[1])]}
                     try:
                         await conn.send(MClientCaps("revoke", ino,
-                                                    tid=tid))
+                                                    tid=tid,
+                                                    attrs=revoke_attrs))
                     except (ConnectionError, OSError):
                         self._cap_acks.pop(tid, None)
                         holders.pop(conn, None)
@@ -732,6 +768,10 @@ class MDSDaemon:
         """path -> (parent dir ino, leaf name, inode | None).
         '/' resolves to (0, '', root-pseudo-inode)."""
         parts = [p for p in path.split("/") if p]
+        if SNAP_DIR in parts:
+            # every MUTATION resolves through here: snapshots are
+            # read-only (snap-aware reads branch before _resolve)
+            raise MDSError(EROFS, path)
         if not parts:
             return 0, "", {"ino": ROOT_INO, "type": "dir", "mode": 0o755,
                            "size": 0, "mtime": 0}
@@ -866,7 +906,8 @@ class MDSDaemon:
         self.ops_served += 1
         try:
             if msg.op in ("lookup", "readdir", "stat", "readlink",
-                          "peer_revoke", "rename", "rmdir"):
+                          "peer_revoke", "rename", "rmdir", "lssnap",
+                          "peer_snap_refresh"):
                 # reads are lock-free; rename/rmdir manage their own
                 # locking (they must release it around peer RPCs);
                 # peer_revoke must never wait on the mutation lock
@@ -884,6 +925,15 @@ class MDSDaemon:
         except Exception:
             log.exception("mds.%s: op %s failed", self.name, msg.op)
             rc, out = EIO, {}
+        if rc == 0 and isinstance(out, dict):
+            # piggyback the data-pool snap context on every reply so
+            # clients' direct-to-OSD file writes COW against every
+            # live snapshot (the SnapRealm-propagation role).  An
+            # EMPTY context is published too: after the last rmsnap
+            # clients must STOP cloning against the removed snapid,
+            # or post-trim clones leak unreclaimably
+            out.setdefault("_dsnapc", [self._data_snapc[0],
+                                       list(self._data_snapc[1])])
         try:
             await conn.send(MClientReply(msg.tid, rc, out))
         except (ConnectionError, OSError):
@@ -963,6 +1013,8 @@ class MDSDaemon:
 
     async def _op_lookup(self, args,
                          conn=None) -> Tuple[int, Dict[str, Any]]:
+        if self._split_snap_path(args["path"]) is not None:
+            return await self._snap_lookup(args)
         _parent, _name, inode = await self._resolve(args["path"])
         if inode is None:
             return ENOENT, {}
@@ -984,7 +1036,10 @@ class MDSDaemon:
 
     async def _op_readlink(self, args,
                            conn=None) -> Tuple[int, Dict[str, Any]]:
-        _p, _n, inode = await self._resolve(args["path"])
+        if self._split_snap_path(args["path"]) is not None:
+            inode = await self._snap_resolve(args["path"])
+        else:
+            _p, _n, inode = await self._resolve(args["path"])
         if inode is None:
             return ENOENT, {}
         if inode["type"] != "symlink":
@@ -993,6 +1048,8 @@ class MDSDaemon:
 
     async def _op_readdir(self, args,
                           conn=None) -> Tuple[int, Dict[str, Any]]:
+        if self._split_snap_path(args["path"]) is not None:
+            return await self._snap_readdir(args)
         _parent, _name, inode = await self._resolve(args["path"])
         if inode is None:
             return ENOENT, {}
@@ -1448,6 +1505,287 @@ class MDSDaemon:
             inode["mtime"] = args.get("mtime", self._now())
             await self._commit([self._dentry(parent, name, inode)])
         return 0, {"inode": inode}
+
+    # -- snapshots (SnapServer + SnapRealm + snapdir roles) ----------------
+    #
+    # Reference parity: src/mds/SnapServer.h (snapid allocation +
+    # global snap table), src/mds/snap.cc SnapRealm (which snaps cover
+    # an inode), src/mds/Server.cc handle_client_mksnap/rmsnap and the
+    # client's ".snap" pseudo-directory (src/client/Client.cc
+    # vinodeno_t snapid traversal).  Re-design: COW is delegated
+    # entirely to RADOS self-managed snapshots (one snapid per pool
+    # per CephFS snapshot) instead of past-parent dentry versioning —
+    # dir omap objects clone on the owning rank's next mutation, file
+    # data objects clone on the clients' next writes, and ".snap"
+    # reads resolve against those snapids.  Point-in-time is the
+    # mksnap window (in-flight writes racing mksnap may land on
+    # either side), matching the reference's non-linearizable snap
+    # semantics.
+
+    @staticmethod
+    def _split_snap_path(path: str):
+        """'/a/b/.snap/s1/c' -> (['a','b'], ['s1','c']); None when the
+        path has no .snap component."""
+        parts = [p for p in path.split("/") if p]
+        if SNAP_DIR not in parts:
+            return None
+        i = parts.index(SNAP_DIR)
+        return parts[:i], parts[i + 1:]
+
+    async def _snap_records(self) -> Dict[str, dict]:
+        """The global snap table: omap key -> record dict."""
+        try:
+            omap = await self.meta.omap_get(SNAPTABLE_OBJ)
+        except ObjectNotFound:
+            return {}
+        return {k: json.loads(v.decode()) for k, v in omap.items()}
+
+    async def _dir_snaps(self, ino: int) -> Dict[str, dict]:
+        """Snapshots taken ON directory ino: name -> record."""
+        return {rec["name"]: rec
+                for rec in (await self._snap_records()).values()
+                if rec["ino"] == ino}
+
+    async def _refresh_snapc(self) -> None:
+        """Recompute both pools' write snap contexts from the snap
+        table and arm them on this rank's IoCtxs (the SnapRealm
+        get_snap_context role, collapsed to one global realm)."""
+        recs = (await self._snap_records()).values()
+        meta_snaps = sorted((r["meta_snap"] for r in recs),
+                            reverse=True)
+        data_snaps = sorted((r["data_snap"] for r in recs),
+                            reverse=True)
+        self.meta.set_snap_context(
+            meta_snaps[0] if meta_snaps else 0, meta_snaps)
+        self.data_io.set_snap_context(
+            data_snaps[0] if data_snaps else 0, data_snaps)
+        self._data_snapc = (data_snaps[0] if data_snaps else 0,
+                            data_snaps)
+
+    def _snap_io(self, meta_snap: int) -> IoCtx:
+        io = self._snap_ios.get(meta_snap)
+        if io is None:
+            io = self.client.open_ioctx(self.metadata_pool)
+            io.snap_set_read(meta_snap)
+            self._snap_ios[meta_snap] = io
+        return io
+
+    async def _load_dir_snap(self, ino: int,
+                             meta_snap: int) -> Dict[str, dict]:
+        """Directory entries as of a metadata snapid (reads resolve to
+        the head or a clone server-side).  Immutable -> cacheable."""
+        key = (ino, meta_snap)
+        cached = self._snap_dirs.get(key)
+        if cached is not None:
+            return cached
+        try:
+            omap = await self._snap_io(meta_snap).omap_get(
+                dir_obj(ino))
+        except ObjectNotFound:
+            raise MDSError(ENOENT, f"no directory {ino:x}@{meta_snap}")
+        entries = {name: json.loads(raw.decode())
+                   for name, raw in omap.items()}
+        if len(self._snap_dirs) >= 512:
+            self._snap_dirs.clear()
+        self._snap_dirs[key] = entries
+        return entries
+
+    async def _snap_base(self, base_parts) -> dict:
+        """Resolve the directory the .snap component hangs off (at
+        head)."""
+        if not base_parts:
+            return {"ino": ROOT_INO, "type": "dir", "mode": 0o755,
+                    "size": 0, "mtime": 0}
+        _p, _n, inode = await self._resolve("/" + "/".join(base_parts))
+        if inode is None:
+            raise MDSError(ENOENT, "/".join(base_parts))
+        if inode["type"] != "dir":
+            raise MDSError(ENOTDIR, "/".join(base_parts))
+        return inode
+
+    async def _snap_resolve(self, path: str) -> Optional[dict]:
+        """Resolve a path BELOW .snap/<name> to its inode as of that
+        snapshot, annotated with the data snapid for file reads.
+        Returns None for ENOENT mid-walk."""
+        base, rest = self._split_snap_path(path)
+        dir_inode = await self._snap_base(base)
+        if not rest:  # the .snap pseudo-directory itself
+            return {"ino": 0, "type": "dir", "mode": 0o555,
+                    "size": 0, "mtime": 0, "readonly": True}
+        snaps = await self._dir_snaps(dir_inode["ino"])
+        rec = snaps.get(rest[0])
+        if rec is None:
+            return None
+        cur = dict(dir_inode)
+        for comp in rest[1:]:
+            if cur["type"] != "dir":
+                raise MDSError(ENOTDIR, comp)
+            entries = await self._load_dir_snap(cur["ino"],
+                                                rec["meta_snap"])
+            nxt = entries.get(comp)
+            if nxt is None:
+                return None
+            cur = dict(nxt)
+        cur["snapid"] = rec["data_snap"]
+        cur["readonly"] = True
+        return cur
+
+    async def _snap_lookup(self, args) -> Tuple[int, Dict[str, Any]]:
+        """lookup/stat on a .snap path: never grants caps (snapshots
+        are immutable; nothing to keep coherent)."""
+        base, rest = self._split_snap_path(args["path"])
+        if not rest:  # the .snap pseudo-directory itself
+            await self._snap_base(base)  # existence check
+            return 0, {"inode": {"ino": 0, "type": "dir",
+                                 "mode": 0o555, "size": 0, "mtime": 0,
+                                 "readonly": True}}
+        inode = await self._snap_resolve(args["path"])
+        if inode is None:
+            return ENOENT, {}
+        return 0, {"inode": inode}
+
+    async def _snap_readdir(self, args) -> Tuple[int, Dict[str, Any]]:
+        base, rest = self._split_snap_path(args["path"])
+        dir_inode = await self._snap_base(base)
+        snaps = await self._dir_snaps(dir_inode["ino"])
+        if not rest:
+            # ls /a/.snap -> one pseudo-dir per snapshot
+            entries = {
+                name: {"ino": dir_inode["ino"], "type": "dir",
+                       "mode": 0o555, "size": 0,
+                       "mtime": rec.get("ctime", 0),
+                       "snapid": rec["data_snap"], "readonly": True}
+                for name, rec in snaps.items()}
+            return 0, {"entries": dict(sorted(entries.items()))}
+        rec = snaps.get(rest[0])
+        if rec is None:
+            return ENOENT, {}
+        cur_ino, cur_type = dir_inode["ino"], "dir"
+        for comp in rest[1:]:
+            if cur_type != "dir":
+                return ENOTDIR, {}
+            entries = await self._load_dir_snap(cur_ino,
+                                                rec["meta_snap"])
+            nxt = entries.get(comp)
+            if nxt is None:
+                return ENOENT, {}
+            cur_ino, cur_type = nxt["ino"], nxt["type"]
+        if cur_type != "dir":
+            return ENOTDIR, {}
+        entries = await self._load_dir_snap(cur_ino, rec["meta_snap"])
+        out = {}
+        for name, inode in sorted(entries.items()):
+            inode = dict(inode)
+            inode["snapid"] = rec["data_snap"]
+            inode["readonly"] = True
+            out[name] = inode
+        return 0, {"entries": out}
+
+    async def _op_mksnap(self, args,
+                         conn=None) -> Tuple[int, Dict[str, Any]]:
+        """Snapshot the directory at args['path'] under args['name']
+        (handle_client_mksnap).  Ordering: allocate snapids -> publish
+        in the snap table -> refresh every rank's and client's snap
+        context (peer fan-out + cap recall) -> ack.  A crash before
+        the table write leaks only pool snapids (harmless, trimmed as
+        empty); after it, the snapshot exists and takeover republishes
+        contexts."""
+        name = args.get("name", "")
+        if not name or "/" in name or name == SNAP_DIR:
+            return EINVAL, {}
+        if self._split_snap_path(args["path"]) is not None:
+            return EROFS, {}
+        _p, _n, inode = await self._resolve(args["path"])
+        if inode is None:
+            return ENOENT, {}
+        if inode["type"] != "dir":
+            return ENOTDIR, {}
+        if name in await self._dir_snaps(inode["ino"]):
+            return EEXIST, {}
+        data_snap = await self.data_io.create_selfmanaged_snap()
+        meta_snap = await self.meta.create_selfmanaged_snap()
+        rec = {"name": name, "ino": inode["ino"],
+               "meta_snap": meta_snap, "data_snap": data_snap,
+               "ctime": self._now()}
+        await self.meta.omap_set(
+            SNAPTABLE_OBJ,
+            {f"{data_snap:016x}": json.dumps(rec).encode()})
+        await self._refresh_snapc()
+        await self._snap_fanout()
+        # recall every cap so writers re-learn the snap context before
+        # their next uncoordinated write (coarse, correct)
+        flushed = await self._revoke_all_caps()
+        for fl in flushed:
+            await self._apply_flush_locked(fl, fl.get("path", ""))
+        return 0, {"snapid": data_snap}
+
+    async def _op_rmsnap(self, args,
+                         conn=None) -> Tuple[int, Dict[str, Any]]:
+        """Remove a snapshot: drop the table row, then release both
+        pool snapids — the OSDs' snap-trim machinery reclaims the
+        clones (handle_client_rmsnap + snap trim)."""
+        name = args.get("name", "")
+        _p, _n, inode = await self._resolve(args["path"])
+        if inode is None:
+            return ENOENT, {}
+        snaps = await self._dir_snaps(inode["ino"])
+        rec = snaps.get(name)
+        if rec is None:
+            return ENOENT, {}
+        # release the pool snapids FIRST (tolerating already-gone), so
+        # a transient failure leaves the table row in place and a
+        # retried rmsnap reaches the remove calls again — dropping the
+        # row first would strand the snapids outside removed_snaps and
+        # their clones would never trim
+        for io, snapid in ((self.data_io, rec["data_snap"]),
+                           (self.meta, rec["meta_snap"])):
+            try:
+                await io.remove_selfmanaged_snap(snapid)
+            except RadosError as e:
+                if e.rc != ENOENT:
+                    raise
+        await self.meta.omap_rm_keys(
+            SNAPTABLE_OBJ, [f"{rec['data_snap']:016x}"])
+        self._snap_ios.pop(rec["meta_snap"], None)
+        self._snap_dirs = {k: v for k, v in self._snap_dirs.items()
+                           if k[1] != rec["meta_snap"]}
+        await self._refresh_snapc()
+        await self._snap_fanout()
+        return 0, {}
+
+    async def _op_lssnap(self, args,
+                         conn=None) -> Tuple[int, Dict[str, Any]]:
+        _p, _n, inode = await self._resolve(args["path"])
+        if inode is None:
+            return ENOENT, {}
+        snaps = await self._dir_snaps(inode["ino"])
+        return 0, {"snaps": [
+            {"name": n, "snapid": r["data_snap"],
+             "ctime": r.get("ctime", 0)}
+            for n, r in sorted(snaps.items())]}
+
+    async def _op_peer_snap_refresh(self, args, conn=None
+                                    ) -> Tuple[int, Dict[str, Any]]:
+        """Another rank changed the snap table: re-arm our snap
+        contexts (lock-free — pure IoCtx state, no dir mutation)."""
+        await self._refresh_snapc()
+        return 0, {}
+
+    async def _snap_fanout(self) -> None:
+        """Tell every other rank to refresh its snap context.
+        Best-effort: a rank that misses it refreshes on takeover, and
+        its stale window only shifts the snapshot's point-in-time for
+        dirs it owns (same non-linearizable semantics as the
+        reference)."""
+        for rank in range(self.num_ranks):
+            if rank == self.rank:
+                continue
+            try:
+                await self._peer_request(rank, "peer_snap_refresh",
+                                         {}, timeout=3.0)
+            except Exception:
+                log.warning("mds.%s: snap refresh to rank %d failed",
+                            self.name, rank)
 
 
 class MDSError(Exception):

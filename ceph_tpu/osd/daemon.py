@@ -3150,11 +3150,13 @@ class OSDDaemon:
             elif op.op == "omap_set":
                 rc = await self._op_omap_write(state, pool, msg.oid,
                                                "omap_set", op.data,
-                                               state_admit_epoch)
+                                               state_admit_epoch,
+                                               snapc)
             elif op.op == "omap_rm":
                 rc = await self._op_omap_write(state, pool, msg.oid,
                                                "omap_rm", op.data,
-                                               state_admit_epoch)
+                                               state_admit_epoch,
+                                               snapc)
             elif op.op == "omap_get":
                 rc, data = await self._op_omap_get(state, pool,
                                                    read_oid)
@@ -3991,13 +3993,22 @@ class OSDDaemon:
 
     async def _op_omap_write(self, state: PGState, pool, oid: str,
                              kind: str, payload: bytes,
-                             admit_epoch: Optional[int]) -> int:
+                             admit_epoch: Optional[int],
+                             snapc=None) -> int:
         """omap set/rm — REPLICATED pools only, like the reference
-        (EC pools reject omap: PrimaryLogPG EOPNOTSUPP)."""
+        (EC pools reject omap: PrimaryLogPG EOPNOTSUPP).  Honors the
+        write snap context like data writes do (make_writeable clones
+        before ANY mutation, omap included — the store-level clone op
+        copies omap, so snap reads of the clone see the old keys)."""
         if pool.type == TYPE_ERASURE:
             return -95  # EOPNOTSUPP
         async with state.obj_lock(oid):
             await self._wait_for_degraded(state, pool, oid)
+            clone_ops: List[ShardOp] = []
+            ss_raw: Optional[bytes] = None
+            if snapc is not None:
+                clone_ops, ss_raw = await self._snap_clone_prep(
+                    state, pool, oid, snapc[0], snapc[1])
             oi, _ss = await self._head_info(state, pool, oid)
             size = oi.get("size", 0) \
                 if oi is not None and not oi.get("whiteout") else 0
@@ -4007,8 +4018,10 @@ class OSDDaemon:
             ops = [ShardOp("create"),
                    ShardOp(kind, data=payload),
                    ShardOp("setattr", name=OI_ATTR, value=oi_raw)]
+            shard_ops = {-1: ops}
+            self._apply_snap_ops(shard_ops, clone_ops, ss_raw)
             return await self._submit_shard_writes(state, pool, oid,
-                                                   {-1: ops}, entry,
+                                                   shard_ops, entry,
                                                    admit_epoch)
 
     async def _op_omap_get(self, state: PGState, pool, oid: str

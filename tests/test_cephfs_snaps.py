@@ -1,0 +1,286 @@
+"""CephFS snapshots: the .snap pseudo-directory over RADOS
+self-managed snaps.
+
+Reference parity targets (/root/reference/src/mds/SnapServer.h,
+src/mds/snap.cc SnapRealm, src/mds/Server.cc handle_client_mksnap,
+src/client/Client.cc snapdir traversal):
+
+1. mkdir <dir>/.snap/<name> snapshots the subtree; files later
+   overwritten/deleted keep their snapshot content readable through
+   <dir>/.snap/<name>/...;
+2. names created AFTER the snapshot do not appear in it;
+3. rmdir <dir>/.snap/<name> removes it (and the OSDs trim the clones);
+4. everything under .snap is read-only;
+5. snapshots survive MDS failover (snap table + contexts re-armed on
+   takeover);
+6. a capped writer that never talks to the MDS again still COWs its
+   first post-snapshot write (the recall carries the snap context).
+"""
+
+import asyncio
+
+import pytest
+
+from cluster_helpers import Cluster
+
+from ceph_tpu.cephfs import CephFS, CephFSError
+from ceph_tpu.mds import MDSDaemon
+from ceph_tpu.rados.client import RadosClient
+
+EROFS = -30
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 150))
+
+
+async def _fs_cluster(num_clients=1, num_mds=1, num_ranks=1):
+    cluster = Cluster(num_osds=4)
+    await cluster.start()
+    await cluster.client.create_replicated_pool(
+        "cephfs.meta", size=2, pg_num=8)
+    await cluster.client.create_replicated_pool(
+        "cephfs.data", size=2, pg_num=8)
+    mdss = []
+    for i in range(num_mds):
+        mds = MDSDaemon(cluster.mon.addr, "cephfs.meta", "cephfs.data",
+                        name=chr(ord("a") + i), lock_interval=0.3,
+                        rank=i % num_ranks, num_ranks=num_ranks)
+        await mds.start()
+        mdss.append(mds)
+    clients, fss = [], []
+    for i in range(num_clients):
+        rc = RadosClient(cluster.mon.addr, name=f"client.snap{i}")
+        await rc.connect()
+        clients.append(rc)
+        fss.append(CephFS(rc, "cephfs.meta", "cephfs.data"))
+    return cluster, mdss, clients, fss
+
+
+async def _teardown(cluster, mdss, clients):
+    for mds in mdss:
+        await mds.stop()
+    for rc in clients:
+        await rc.shutdown()
+    await cluster.stop()
+
+
+def test_snapshot_basic_cow():
+    """Overwrite after mksnap: head shows new bytes, .snap the old."""
+    async def main():
+        cluster, mdss, clients, (fs,) = await _fs_cluster()
+        try:
+            await fs.mkdir("/proj")
+            await fs.write_file("/proj/f", b"version-one")
+            # the reference's surface: mkdir inside .snap IS mksnap
+            await fs.mkdir("/proj/.snap/s1")
+            await fs.write_file("/proj/f", b"version-TWO!")
+            assert await fs.read_file("/proj/f") == b"version-TWO!"
+            assert await fs.read_file("/proj/.snap/s1/f") == \
+                b"version-one"
+            st = await fs.stat("/proj/.snap/s1/f")
+            assert st["size"] == len(b"version-one")
+            assert await fs.listdir("/proj/.snap") == ["s1"]
+            snaps = await fs.lssnap("/proj")
+            assert [s["name"] for s in snaps] == ["s1"]
+        finally:
+            await _teardown(cluster, mdss, clients)
+    run(main())
+
+
+def test_snapshot_namespace_membership():
+    """Deleted files stay in the snapshot; later files don't appear."""
+    async def main():
+        cluster, mdss, clients, (fs,) = await _fs_cluster()
+        try:
+            await fs.mkdir("/d")
+            await fs.write_file("/d/a", b"alpha-bytes")
+            await fs.write_file("/d/b", b"bravo-bytes")
+            await fs.mksnap("/d", "before")
+            await fs.unlink("/d/a")
+            await fs.write_file("/d/c", b"charlie")
+            assert sorted(await fs.listdir("/d")) == ["b", "c"]
+            assert sorted(await fs.listdir("/d/.snap/before")) == \
+                ["a", "b"]
+            # the deleted file's DATA is still readable at the snap
+            # (whiteout head + retained clone on the OSDs)
+            assert await fs.read_file("/d/.snap/before/a") == \
+                b"alpha-bytes"
+            with pytest.raises(CephFSError):
+                await fs.read_file("/d/.snap/before/c")
+        finally:
+            await _teardown(cluster, mdss, clients)
+    run(main())
+
+
+def test_nested_dirs_and_multiple_snaps():
+    async def main():
+        cluster, mdss, clients, (fs,) = await _fs_cluster()
+        try:
+            await fs.mkdir("/top")
+            await fs.mkdir("/top/sub")
+            await fs.write_file("/top/sub/deep", b"one")
+            await fs.mksnap("/top", "s1")
+            await fs.write_file("/top/sub/deep", b"two-longer")
+            await fs.mkdir("/top/sub/later")
+            await fs.mksnap("/top", "s2")
+            assert await fs.read_file("/top/.snap/s1/sub/deep") == \
+                b"one"
+            assert await fs.read_file("/top/.snap/s2/sub/deep") == \
+                b"two-longer"
+            assert await fs.listdir("/top/.snap/s1/sub") == ["deep"]
+            assert sorted(await fs.listdir("/top/.snap/s2/sub")) == \
+                ["deep", "later"]
+            # readdir entries at a snap are annotated read-only
+            ents = await fs.readdir("/top/.snap/s1/sub")
+            assert ents["deep"]["readonly"]
+        finally:
+            await _teardown(cluster, mdss, clients)
+    run(main())
+
+
+def test_rmsnap():
+    async def main():
+        cluster, mdss, clients, (fs,) = await _fs_cluster()
+        try:
+            await fs.mkdir("/r")
+            await fs.write_file("/r/f", b"keep")
+            await fs.mksnap("/r", "gone")
+            await fs.rmdir("/r/.snap/gone")   # rmdir-on-snapdir form
+            assert await fs.lssnap("/r") == []
+            with pytest.raises(CephFSError):
+                await fs.read_file("/r/.snap/gone/f")
+            # head unaffected
+            assert await fs.read_file("/r/f") == b"keep"
+            with pytest.raises(CephFSError):
+                await fs.rmsnap("/r", "gone")  # idempotence: ENOENT
+        finally:
+            await _teardown(cluster, mdss, clients)
+    run(main())
+
+
+def test_snap_paths_are_read_only():
+    async def main():
+        cluster, mdss, clients, (fs,) = await _fs_cluster()
+        try:
+            await fs.mkdir("/ro")
+            await fs.write_file("/ro/f", b"data")
+            await fs.mksnap("/ro", "s")
+            for coro in (
+                    fs.write_file("/ro/.snap/s/f", b"nope"),
+                    fs.open("/ro/.snap/s/f", "r+"),
+                    fs.mkdir("/ro/.snap/s/newdir"),
+                    fs.unlink("/ro/.snap/s/f"),
+                    fs.rename("/ro/.snap/s/f", "/ro/g"),
+                    fs.truncate("/ro/.snap/s/f", 0)):
+                with pytest.raises(CephFSError) as ei:
+                    await coro
+                assert ei.value.rc == EROFS, ei.value
+        finally:
+            await _teardown(cluster, mdss, clients)
+    run(main())
+
+
+def test_capped_writer_cows_after_recall():
+    """The recall-carried snap context: a writer holding an rw cap
+    keeps writing with no MDS round trip; a snapshot taken by another
+    mount must still be COW-protected from those writes."""
+    async def main():
+        cluster, mdss, clients, (fs_a, fs_b) = \
+            await _fs_cluster(num_clients=2)
+        try:
+            f = await fs_a.open("/hot", "w")
+            await f.write(0, b"pre-snap!")
+            await f.flush()
+            # B snapshots the root while A still holds the handle
+            await fs_b.mksnap("/", "r1")
+            # A's next write goes straight to the OSDs — the cap
+            # recall must have armed A's snap context already
+            await f.write(0, b"POST-SNAP")
+            await f.close()
+            assert await fs_b.read_file("/.snap/r1/hot") == \
+                b"pre-snap!"
+            assert await fs_a.read_file("/hot") == b"POST-SNAP"
+        finally:
+            await _teardown(cluster, mdss, clients)
+    run(main())
+
+
+def test_snapshots_survive_mds_failover():
+    async def main():
+        cluster, mdss, clients, (fs,) = await _fs_cluster()
+        try:
+            await fs.mkdir("/p")
+            await fs.write_file("/p/f", b"gen-1")
+            await fs.mksnap("/p", "keep")
+            await mdss[0].stop()
+            nxt = MDSDaemon(cluster.mon.addr, "cephfs.meta",
+                            "cephfs.data", name="b",
+                            lock_interval=0.3)
+            await nxt.start()
+            mdss[:] = [nxt]
+            # takeover re-arms snap contexts: post-failover writes
+            # still COW against the pre-failover snapshot
+            await fs.write_file("/p/f", b"gen-2x")
+            assert [s["name"] for s in await fs.lssnap("/p")] == \
+                ["keep"]
+            assert await fs.read_file("/p/.snap/keep/f") == b"gen-1"
+            assert await fs.read_file("/p/f") == b"gen-2x"
+        finally:
+            await _teardown(cluster, mdss, clients)
+    run(main())
+
+
+def test_root_snapshot_covers_tree():
+    async def main():
+        cluster, mdss, clients, (fs,) = await _fs_cluster()
+        try:
+            await fs.mkdir("/a")
+            await fs.mkdir("/a/b")
+            await fs.write_file("/a/b/f", b"rooted")
+            await fs.mksnap("/", "whole")
+            await fs.unlink("/a/b/f")
+            await fs.rmdir("/a/b")
+            assert await fs.read_file("/.snap/whole/a/b/f") == \
+                b"rooted"
+            assert await fs.listdir("/.snap/whole/a") == ["b"]
+        finally:
+            await _teardown(cluster, mdss, clients)
+    run(main())
+
+
+def test_multi_rank_snapshot_refresh():
+    """A snapshot on rank-1's subtree must make rank-0 (and every
+    other rank) COW its own dir mutations too — the peer_snap_refresh
+    fan-out."""
+    async def main():
+        cluster, mdss, clients, (fs,) = await _fs_cluster(
+            num_mds=2, num_ranks=2)
+        try:
+            # find a top-level name owned by each rank
+            from ceph_tpu.mds import owner_rank
+            name1 = next(f"d{i}" for i in range(64)
+                         if owner_rank(f"/d{i}/x", 2) == 1)
+            name0 = next(f"e{i}" for i in range(64)
+                         if owner_rank(f"/e{i}/x", 2) == 0)
+            await fs.mkdir(f"/{name1}")
+            await fs.mkdir(f"/{name0}")
+            await fs.write_file(f"/{name1}/f", b"rank1-v1")
+            await fs.write_file(f"/{name0}/f", b"rank0-v1")
+            # snapshot ROOT (rank 0 adjudicates) — rank 1 must learn
+            # the new context through the fan-out
+            await fs.mksnap("/", "all")
+            await fs.write_file(f"/{name1}/f", b"rank1-v2")
+            await fs.write_file(f"/{name0}/f", b"rank0-v2")
+            assert await fs.read_file(f"/.snap/all/{name1}/f") == \
+                b"rank1-v1"
+            assert await fs.read_file(f"/.snap/all/{name0}/f") == \
+                b"rank0-v1"
+            # and a snapshot ON the rank-1 subtree routes to rank 1
+            await fs.mksnap(f"/{name1}", "mine")
+            await fs.write_file(f"/{name1}/f", b"rank1-v3")
+            assert await fs.read_file(
+                f"/{name1}/.snap/mine/f") == b"rank1-v2"
+        finally:
+            await _teardown(cluster, mdss, clients)
+    run(main())
