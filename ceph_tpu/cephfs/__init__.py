@@ -85,6 +85,10 @@ class CephFS:
         self._dirty: Dict[int, Dict[str, Any]] = {}
         # snapid -> data-pool IoCtx reading at that snapshot
         self._snap_ios: Dict[int, IoCtx] = {}
+        # snap-context version (regression guard): a reply from an MDS
+        # rank that missed the snap fan-out must not downgrade a
+        # fresher context another rank already gave us
+        self._snapc_ver = 0
         # observability (tests assert the zero-round-trip property)
         self.mds_requests = 0
         self.cap_hits = 0
@@ -210,7 +214,7 @@ class CephFS:
             # a recall after mksnap carries the fresh snap context —
             # arm it NOW so our next write clones, even with no
             # further MDS round trip
-            self.data.set_snap_context(snapc[0], snapc[1])
+            self._apply_snapc(snapc)
         # the ack carries our dirty attrs INCLUDING the path: recalls
         # driven by a directory rename persist bystander flushes by
         # path while those paths still resolve
@@ -360,7 +364,7 @@ class CephFS:
                 # the MDS publishes the data-pool snap context on
                 # every reply: our direct-to-OSD writes must COW
                 # against every live CephFS snapshot
-                self.data.set_snap_context(dsnapc[0], dsnapc[1])
+                self._apply_snapc(dsnapc)
             self._trace_reply(op, args, reply.out)
             # stamp the conn this reply rode in on: any cap in the
             # reply was granted on THAT session (see _record_cap)
@@ -427,6 +431,15 @@ class CephFS:
     async def lssnap(self, path: str) -> List[dict]:
         out = await self._request("lssnap", {"path": path})
         return out["snaps"]
+
+    def _apply_snapc(self, v) -> None:
+        """[ver, seq, snaps] from an MDS: apply unless it would
+        REGRESS the version — a rank that missed the snap fan-out
+        serves a stale context, and downgrading would make our next
+        write skip COW for a live snapshot."""
+        if v[0] >= self._snapc_ver:
+            self._snapc_ver = v[0]
+            self.data.set_snap_context(v[1], v[2])
 
     def _snap_data_io(self, snapid: int) -> IoCtx:
         """Data-pool IoCtx reading at a snapshot (cached; snapshots

@@ -116,6 +116,12 @@ MDSMAP_OBJ = "mds_map"
 # resolve by reading dir objects AT the metadata snapid and file
 # blocks AT the data snapid.
 SNAPTABLE_OBJ = "mds_snaptable"
+# version counter key inside the snap table's omap (NUL prefix keeps
+# it clear of the 16-hex-digit snapshot keys): bumped atomically (cls
+# numops) on every table mutation.  Snap contexts published to clients
+# carry it, and clients REFUSE to regress — a reply from a rank that
+# missed the fan-out can no longer downgrade a fresher context.
+SNAPVER_KEY = "\x00ver"
 SNAP_DIR = ".snap"
 ADDR_ATTR = "mds.addr"
 # advance the applied watermark (and trim) after this many entries
@@ -241,8 +247,18 @@ class MDSDaemon:
         self.msgr.on_connection_fault = self._conn_fault
         # -- snapshots (SnapServer/SnapRealm role) ------------------------
         # data-pool snap context published to clients (rides replies
-        # and cap revokes so writers COW against every live snap)
+        # and cap revokes so writers COW against every live snap),
+        # versioned by the table's counter (regression guard)
         self._data_snapc: Tuple[int, list] = (0, [])
+        self._snapc_ver = 0
+        # snap-table read cache: every .snap path op consults the
+        # table; re-reading the omap per lookup would make snapshot
+        # tree walks O(table) round trips each.  Invalidated by our
+        # own mutations + peer fan-out; the TTL self-heals a missed
+        # fan-out.
+        self._snap_cache: Optional[Tuple[float, int,
+                                         Dict[str, dict]]] = None
+        self._snap_cache_ttl = 2.0
         # snapid -> metadata-pool IoCtx with read_snap set (immutable
         # once created; reads of dir omap at that snap)
         self._snap_ios: Dict[int, IoCtx] = {}
@@ -639,6 +655,7 @@ class MDSDaemon:
                     # very next write — or stop cloning after the
                     # last rmsnap — before any MDS round trip
                     revoke_attrs = {"snapc": [
+                        self._snapc_ver,
                         self._data_snapc[0],
                         list(self._data_snapc[1])]}
                     try:
@@ -932,7 +949,8 @@ class MDSDaemon:
             # EMPTY context is published too: after the last rmsnap
             # clients must STOP cloning against the removed snapid,
             # or post-trim clones leak unreclaimably
-            out.setdefault("_dsnapc", [self._data_snapc[0],
+            out.setdefault("_dsnapc", [self._snapc_ver,
+                                       self._data_snapc[0],
                                        list(self._data_snapc[1])])
         try:
             await conn.send(MClientReply(msg.tid, rc, out))
@@ -1533,12 +1551,35 @@ class MDSDaemon:
         return parts[:i], parts[i + 1:]
 
     async def _snap_records(self) -> Dict[str, dict]:
-        """The global snap table: omap key -> record dict."""
+        """The global snap table: omap key -> record dict (cached
+        briefly; version and records come from ONE omap read so they
+        are mutually consistent)."""
+        now = time.monotonic()
+        if self._snap_cache is not None and \
+                now - self._snap_cache[0] < self._snap_cache_ttl:
+            return self._snap_cache[2]
         try:
             omap = await self.meta.omap_get(SNAPTABLE_OBJ)
         except ObjectNotFound:
-            return {}
-        return {k: json.loads(v.decode()) for k, v in omap.items()}
+            omap = {}
+        ver = 0
+        recs: Dict[str, dict] = {}
+        for k, v in omap.items():
+            if k == SNAPVER_KEY:
+                ver = int(float(v.decode()))
+            elif not k.startswith("\x00"):
+                recs[k] = json.loads(v.decode())
+        self._snap_cache = (now, ver, recs)
+        return recs
+
+    def _snap_invalidate(self) -> None:
+        self._snap_cache = None
+
+    async def _bump_snap_ver(self) -> int:
+        raw = await self.meta.execute(
+            SNAPTABLE_OBJ, "numops", "add",
+            json.dumps({"key": SNAPVER_KEY, "value": 1}).encode())
+        return int(float(raw.decode()))
 
     async def _dir_snaps(self, ino: int) -> Dict[str, dict]:
         """Snapshots taken ON directory ino: name -> record."""
@@ -1550,7 +1591,10 @@ class MDSDaemon:
         """Recompute both pools' write snap contexts from the snap
         table and arm them on this rank's IoCtxs (the SnapRealm
         get_snap_context role, collapsed to one global realm)."""
+        self._snap_invalidate()
         recs = (await self._snap_records()).values()
+        self._snapc_ver = self._snap_cache[1] \
+            if self._snap_cache is not None else 0
         meta_snaps = sorted((r["meta_snap"] for r in recs),
                             reverse=True)
         data_snaps = sorted((r["data_snap"] for r in recs),
@@ -1700,23 +1744,42 @@ class MDSDaemon:
             return ENOENT, {}
         if inode["type"] != "dir":
             return ENOTDIR, {}
+        self._snap_invalidate()
         if name in await self._dir_snaps(inode["ino"]):
             return EEXIST, {}
+        # Phase 1 — allocate snapids, but keep OUR metadata write
+        # context on the pre-snap side: the cap-flush persists below
+        # must not clone against the new snapid, or the snapshot would
+        # record capped writers' stale (possibly zero) sizes forever.
+        meta_ctx = (self.meta.snapc_seq, list(self.meta.snapc_snaps))
         data_snap = await self.data_io.create_selfmanaged_snap()
         meta_snap = await self.meta.create_selfmanaged_snap()
+        self.meta.set_snap_context(*meta_ctx)  # defer metadata arming
+        # Phase 2 — bump the DURABLE table version first, then arm the
+        # client-facing data context at that version and recall every
+        # cap: each recall carries the new context (a capped writer
+        # COWs its very next write), and the acks return dirty sizes,
+        # persisted on the pre-snapshot side of the metadata.  The
+        # durable bump precedes any advertisement, so a crash here
+        # leaves table-ver >= every advertised ver and a takeover's
+        # refresh can still correct the clients (regression guard
+        # compares >=).
+        self._snapc_ver = await self._bump_snap_ver()
+        self._data_snapc = (data_snap,
+                            [data_snap] + list(self._data_snapc[1]))
+        flushed = await self._revoke_all_caps()
+        for fl in flushed:
+            await self._apply_flush_locked(fl, fl.get("path", ""))
+        # Phase 3 — publish the snapshot and arm everyone else.
         rec = {"name": name, "ino": inode["ino"],
                "meta_snap": meta_snap, "data_snap": data_snap,
                "ctime": self._now()}
         await self.meta.omap_set(
             SNAPTABLE_OBJ,
             {f"{data_snap:016x}": json.dumps(rec).encode()})
+        await self._bump_snap_ver()
         await self._refresh_snapc()
         await self._snap_fanout()
-        # recall every cap so writers re-learn the snap context before
-        # their next uncoordinated write (coarse, correct)
-        flushed = await self._revoke_all_caps()
-        for fl in flushed:
-            await self._apply_flush_locked(fl, fl.get("path", ""))
         return 0, {"snapid": data_snap}
 
     async def _op_rmsnap(self, args,
@@ -1728,6 +1791,7 @@ class MDSDaemon:
         _p, _n, inode = await self._resolve(args["path"])
         if inode is None:
             return ENOENT, {}
+        self._snap_invalidate()  # adjudicate on fresh table state
         snaps = await self._dir_snaps(inode["ino"])
         rec = snaps.get(name)
         if rec is None:
@@ -1746,6 +1810,7 @@ class MDSDaemon:
                     raise
         await self.meta.omap_rm_keys(
             SNAPTABLE_OBJ, [f"{rec['data_snap']:016x}"])
+        await self._bump_snap_ver()
         self._snap_ios.pop(rec["meta_snap"], None)
         self._snap_dirs = {k: v for k, v in self._snap_dirs.items()
                            if k[1] != rec["meta_snap"]}

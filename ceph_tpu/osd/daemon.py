@@ -1928,15 +1928,22 @@ class OSDDaemon:
 
     async def _snap_clone_prep(
             self, state: PGState, pool, oid: str,
-            snapc_seq: int, snapc_snaps: List[int]
+            snapc_seq: int, snapc_snaps: List[int],
+            head: Optional[Tuple[Optional[dict], Dict[str, Any]]] = None
     ) -> Tuple[List[ShardOp], Optional[bytes]]:
         """make_writeable: if the object predates the newest snap,
         emit clone ops (prepended to the write on every shard) and the
         updated SnapSet attr bytes.  Returns ([], None) when no snap
-        bookkeeping applies to this write."""
+        bookkeeping applies to this write.  Callers that already hold
+        the head's (oi, ss) pass them via `head` to skip the re-read
+        (both reads happen under the same object lock)."""
         if snapc_seq <= 0:
             return [], None
-        oi, ss = await self._head_info(state, pool, oid)
+        oi, ss = head if head is not None \
+            else await self._head_info(state, pool, oid)
+        # never mutate a caller-held SnapSet (the clones list would
+        # alias through a shallow copy)
+        ss = {**ss, "clones": list(ss.get("clones", []))}
         clone_ops: List[ShardOp] = []
         if oi is not None and not oi.get("whiteout") and \
                 ss.get("seq", 0) < snapc_seq:
@@ -3878,7 +3885,8 @@ class OSDDaemon:
             ss_raw: Optional[bytes] = None
             if snapc is not None:
                 clone_ops, ss_raw = await self._snap_clone_prep(
-                    state, pool, oid, snapc[0], snapc[1])
+                    state, pool, oid, snapc[0], snapc[1],
+                    head=(oi, ss))
                 if ss_raw is not None:
                     ss = json.loads(ss_raw)
             if pool.type == TYPE_REPLICATED:
@@ -3925,7 +3933,8 @@ class OSDDaemon:
             ss_raw: Optional[bytes] = None
             if snapc is not None:
                 clone_ops, ss_raw = await self._snap_clone_prep(
-                    state, pool, oid, snapc[0], snapc[1])
+                    state, pool, oid, snapc[0], snapc[1],
+                    head=(oi, _ss))
             entry = self._next_entry(state, pool, oid, "modify",
                                      oi.get("size", 0))
             oi_raw = json.dumps({"size": oi.get("size", 0),
@@ -4004,12 +4013,13 @@ class OSDDaemon:
             return -95  # EOPNOTSUPP
         async with state.obj_lock(oid):
             await self._wait_for_degraded(state, pool, oid)
+            oi, ss = await self._head_info(state, pool, oid)
             clone_ops: List[ShardOp] = []
             ss_raw: Optional[bytes] = None
             if snapc is not None:
                 clone_ops, ss_raw = await self._snap_clone_prep(
-                    state, pool, oid, snapc[0], snapc[1])
-            oi, _ss = await self._head_info(state, pool, oid)
+                    state, pool, oid, snapc[0], snapc[1],
+                    head=(oi, ss))
             size = oi.get("size", 0) \
                 if oi is not None and not oi.get("whiteout") else 0
             entry = self._next_entry(state, pool, oid, "modify", size)

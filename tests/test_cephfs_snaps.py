@@ -206,6 +206,28 @@ def test_capped_writer_cows_after_recall():
     run(main())
 
 
+def test_snapshot_captures_buffered_cap_size():
+    """A writer's size buffered under an rw cap (never yet flushed)
+    must be visible in the snapshot: mksnap recalls the cap and
+    persists the flushed size on the PRE-snapshot side."""
+    async def main():
+        cluster, mdss, clients, (fs_a, fs_b) = \
+            await _fs_cluster(num_clients=2)
+        try:
+            f = await fs_a.open("/buf", "w")
+            await f.write(0, b"0123456789abcdef")  # size only buffered
+            # no flush/close: the 16-byte size lives in A's dirty caps
+            await fs_b.mksnap("/", "s")
+            st = await fs_b.stat("/.snap/s/buf")
+            assert st["size"] == 16, st
+            assert await fs_b.read_file("/.snap/s/buf") == \
+                b"0123456789abcdef"
+            await f.close()
+        finally:
+            await _teardown(cluster, mdss, clients)
+    run(main())
+
+
 def test_snapshots_survive_mds_failover():
     async def main():
         cluster, mdss, clients, (fs,) = await _fs_cluster()
