@@ -28,13 +28,14 @@ import logging
 from typing import Dict, Optional
 
 from ceph_tpu.cephfs import CephFS, CephFSError
+from ceph_tpu.common.periodic import PeriodicDaemon
 
 log = logging.getLogger("cephfs.mirror")
 
 ENOENT = -2
 
 
-class DirMirror:
+class DirMirror(PeriodicDaemon):
     """Replicates ONE directory's snapshots src -> dst (the
     PeerReplayer role)."""
 
@@ -42,12 +43,14 @@ class DirMirror:
         self.src = src
         self.dst = dst
         self.path = "/" + "/".join(p for p in path.split("/") if p)
-        self._task: Optional[asyncio.Task] = None
-        self._stop = asyncio.Event()
+        self._tick_what = f"cephfs-mirror {self.path}"
         # observability
         self.snaps_synced = 0
         self.files_copied = 0
         self.entries_deleted = 0
+
+    async def _tick(self) -> None:
+        await self.sync_once()
 
     # -- one sync pass -----------------------------------------------------
 
@@ -74,6 +77,7 @@ class DirMirror:
         synced_ids = await self._load_state()
         src_ids = {s["name"]: s["snapid"] for s in src_snaps}
         # prune: dropped at the source, or re-created under an old name
+        pruned = False
         for name in sorted(dst_have):
             if name in src_ids and \
                     synced_ids.get(name, src_ids[name]) == \
@@ -82,6 +86,7 @@ class DirMirror:
             await self.dst.rmsnap(self.path, name)
             dst_have.discard(name)
             synced_ids.pop(name, None)
+            pruned = True
         created = 0
         prev: Optional[str] = None
         for snap in src_snaps:
@@ -99,7 +104,9 @@ class DirMirror:
             self.snaps_synced += 1
             created += 1
             prev = name
-        if created == 0:
+        if created == 0 and pruned:
+            # state changed only by pruning; an idle pass writes
+            # nothing to the destination
             await self._save_state(synced_ids)
         return created
 
@@ -146,7 +153,12 @@ class DirMirror:
         diffing against prev_dir (the previously synced snapshot view)
         to skip unchanged entries."""
         src_entries = await self.src.readdir(src_dir)
-        src_entries.pop(".cephfs-mirror", None)  # root-mirror state
+        at_dst_root = dst_dir == "/"
+        if at_dst_root:
+            # only when mirroring INTO the root does the state dir
+            # live inside the synced tree; deeper a ".cephfs-mirror"
+            # entry is ordinary user data and must replicate
+            src_entries.pop(".cephfs-mirror", None)
         prev_entries: Dict[str, dict] = {}
         if prev_dir is not None:
             try:
@@ -160,7 +172,8 @@ class DirMirror:
                 raise
             await self._ensure_dir(self.dst, dst_dir)
             dst_entries = {}
-        dst_entries.pop(".cephfs-mirror", None)
+        if at_dst_root:
+            dst_entries.pop(".cephfs-mirror", None)
         # remove entries the source snapshot does not have
         for name in sorted(set(dst_entries) - set(src_entries)):
             await self._rm_tree(f"{dst_dir}/{name}")
@@ -232,33 +245,3 @@ class DirMirror:
             await self.dst.unlink(path)
         self.entries_deleted += 1
 
-    # -- continuous mode (the mirror daemon loop) --------------------------
-
-    async def start(self, interval: float = 1.0) -> None:
-        self._stop.clear()
-
-        async def loop():
-            while not self._stop.is_set():
-                try:
-                    await self.sync_once()
-                except asyncio.CancelledError:
-                    raise
-                except Exception:
-                    log.exception("mirror %s: sync failed; retrying",
-                                  self.path)
-                try:
-                    await asyncio.wait_for(self._stop.wait(), interval)
-                except asyncio.TimeoutError:
-                    pass
-
-        self._task = asyncio.get_running_loop().create_task(loop())
-
-    async def stop(self) -> None:
-        self._stop.set()
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None

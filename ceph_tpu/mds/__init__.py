@@ -375,6 +375,7 @@ class MDSDaemon:
         # snap contexts BEFORE any replayed mutation: replayed dir
         # writes and purges must COW against every live snapshot
         await self._refresh_snapc()
+        await self._sweep_pending_snaps()
         await self._replay_journal()
         log.info("mds.%s: ACTIVE at %s (epoch %d)", self.name,
                  self.msgr.addr, self._epoch)
@@ -1582,10 +1583,12 @@ class MDSDaemon:
         return int(float(raw.decode()))
 
     async def _dir_snaps(self, ino: int) -> Dict[str, dict]:
-        """Snapshots taken ON directory ino: name -> record."""
+        """Snapshots taken ON directory ino: name -> record.  PENDING
+        rows (mksnap in flight or crashed mid-way) are invisible —
+        they exist only so their snapids stay accounted for."""
         return {rec["name"]: rec
                 for rec in (await self._snap_records()).values()
-                if rec["ino"] == ino}
+                if rec["ino"] == ino and not rec.get("pending")}
 
     async def _refresh_snapc(self) -> None:
         """Recompute both pools' write snap contexts from the snap
@@ -1747,15 +1750,29 @@ class MDSDaemon:
         self._snap_invalidate()
         if name in await self._dir_snaps(inode["ino"]):
             return EEXIST, {}
-        # Phase 1 — allocate snapids, but keep OUR metadata write
-        # context on the pre-snap side: the cap-flush persists below
-        # must not clone against the new snapid, or the snapshot would
-        # record capped writers' stale (possibly zero) sizes forever.
+        # Phase 1 — allocate snapids and record them as a PENDING
+        # table row BEFORE any advertisement.  Pending rows are
+        # invisible to .snap readers but their snapids ride every
+        # write context, so clones created against them stay
+        # accounted for: a crash mid-mksnap leaves a row the
+        # takeover sweeps (releasing the snapids into removed_snaps,
+        # which trims the clones) instead of a permanent leak.
+        # OUR metadata write context stays on the pre-snap side: the
+        # cap-flush persists below must not clone against the new
+        # snapid, or the snapshot would record capped writers' stale
+        # (possibly zero) sizes forever.
         meta_ctx = (self.meta.snapc_seq, list(self.meta.snapc_snaps))
         data_snap = await self.data_io.create_selfmanaged_snap()
         meta_snap = await self.meta.create_selfmanaged_snap()
         self.meta.set_snap_context(*meta_ctx)  # defer metadata arming
-        # Phase 2 — bump the DURABLE table version first, then arm the
+        rec = {"name": name, "ino": inode["ino"],
+               "meta_snap": meta_snap, "data_snap": data_snap,
+               "ctime": self._now(), "pending": True,
+               "rank": self.rank}
+        row_key = f"{data_snap:016x}"
+        await self.meta.omap_set(
+            SNAPTABLE_OBJ, {row_key: json.dumps(rec).encode()})
+        # Phase 2 — bump the DURABLE table version, then arm the
         # client-facing data context at that version and recall every
         # cap: each recall carries the new context (a capped writer
         # COWs its very next write), and the acks return dirty sizes,
@@ -1770,13 +1787,10 @@ class MDSDaemon:
         flushed = await self._revoke_all_caps()
         for fl in flushed:
             await self._apply_flush_locked(fl, fl.get("path", ""))
-        # Phase 3 — publish the snapshot and arm everyone else.
-        rec = {"name": name, "ino": inode["ino"],
-               "meta_snap": meta_snap, "data_snap": data_snap,
-               "ctime": self._now()}
+        # Phase 3 — finalize the row: the snapshot becomes visible.
+        rec.pop("pending")
         await self.meta.omap_set(
-            SNAPTABLE_OBJ,
-            {f"{data_snap:016x}": json.dumps(rec).encode()})
+            SNAPTABLE_OBJ, {row_key: json.dumps(rec).encode()})
         await self._bump_snap_ver()
         await self._refresh_snapc()
         await self._snap_fanout()
@@ -1828,6 +1842,32 @@ class MDSDaemon:
             {"name": n, "snapid": r["data_snap"],
              "ctime": r.get("ctime", 0)}
             for n, r in sorted(snaps.items())]}
+
+    async def _sweep_pending_snaps(self) -> None:
+        """Takeover: a PENDING row for our rank is a crashed mksnap —
+        release its pool snapids (removed_snaps -> the OSDs trim any
+        clones clients already created against them) and drop the
+        row.  Other ranks' pending rows are their own in-flight or
+        crashed mksnaps; their successors sweep them."""
+        self._snap_invalidate()
+        for key, rec in (await self._snap_records()).items():
+            if not rec.get("pending") or \
+                    rec.get("rank", 0) != self.rank:
+                continue
+            log.warning("mds.%s: sweeping crashed mksnap %r "
+                        "(snapid %s)", self.name, rec.get("name"),
+                        rec.get("data_snap"))
+            for io, snapid in ((self.data_io, rec["data_snap"]),
+                               (self.meta, rec["meta_snap"])):
+                try:
+                    await io.remove_selfmanaged_snap(snapid)
+                except RadosError as e:
+                    if e.rc != ENOENT:
+                        raise
+            await self.meta.omap_rm_keys(SNAPTABLE_OBJ, [key])
+            await self._bump_snap_ver()
+        self._snap_invalidate()
+        await self._refresh_snapc()
 
     async def _op_peer_snap_refresh(self, args, conn=None
                                     ) -> Tuple[int, Dict[str, Any]]:

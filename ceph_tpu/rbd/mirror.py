@@ -20,6 +20,7 @@ import asyncio
 import logging
 from typing import Optional
 
+from ceph_tpu.common.periodic import PeriodicDaemon
 from ceph_tpu.rados.client import IoCtx, RadosError
 from ceph_tpu.rbd import RBD, Image
 from ceph_tpu.rbd.journal import ImageJournal
@@ -27,7 +28,7 @@ from ceph_tpu.rbd.journal import ImageJournal
 log = logging.getLogger("rbd.mirror")
 
 
-class MirrorReplayer:
+class MirrorReplayer(PeriodicDaemon):
     """Replicates ONE image src -> dst (ImageReplayer role)."""
 
     def __init__(self, src_ioctx: IoCtx, dst_ioctx: IoCtx,
@@ -37,8 +38,10 @@ class MirrorReplayer:
         self.image_name = image_name
         self.peer_name = peer_name
         self._rbd = RBD()
-        self._task: Optional[asyncio.Task] = None
-        self._stop = asyncio.Event()
+        self._tick_what = f"rbd-mirror {image_name}"
+
+    async def _tick(self) -> None:
+        await self.replay_once()
 
     async def bootstrap(self) -> None:
         """Full sync: create the secondary image and copy current
@@ -132,25 +135,4 @@ class MirrorReplayer:
 
     # -- continuous mode (the rbd-mirror daemon loop) ----------------------
 
-    async def start(self, interval: float = 0.5) -> None:
-        self._stop.clear()
-
-        async def loop():
-            while not self._stop.is_set():
-                try:
-                    await self.replay_once()
-                except Exception:
-                    log.exception("mirror %s: replay pass failed",
-                                  self.image_name)
-                try:
-                    await asyncio.wait_for(self._stop.wait(), interval)
-                except asyncio.TimeoutError:
-                    pass
-
-        self._task = asyncio.get_running_loop().create_task(loop())
-
-    async def stop(self) -> None:
-        self._stop.set()
-        if self._task is not None:
-            await self._task
-            self._task = None
+    # continuous mode: start(interval)/stop() from PeriodicDaemon

@@ -84,15 +84,21 @@ class RGWLite:
 
     def __init__(self, client, data_pool: str, meta_pool: str,
                  stripe_size: int = DEFAULT_STRIPE_SIZE,
-                 aio_window: int = 8, etag_hash: str = "md5"):
+                 aio_window: int = 8, etag_hash: str = "md5",
+                 zone: str = "default"):
         self.client = client
         self.etag_hash = etag_hash
         self.data = client.open_ioctx(data_pool)
         self.meta = client.open_ioctx(meta_pool)
         self.stripe_size = stripe_size
         self.aio_window = aio_window
+        # multisite zone identity (rgw_zone.h role): stamped into
+        # every change-log entry so a sync peer can tell local writes
+        # from replicated ones (active-active loop prevention)
+        self.zone = zone
         self._uploads = 0
         self._writes = 0
+        self._log_ns: Optional[int] = None  # change-log key ratchet
         # serializes read-modify-writes of upload/bucket meta docs
         # within this gateway instance (one gateway per cluster in this
         # tier; multi-gateway index updates need the omap op milestone)
@@ -188,6 +194,119 @@ class RGWLite:
         # drain without migration
         return cls._meta_oid("gc") if shard == 0 \
             else cls._meta_oid("gc", str(shard))
+
+    # -- multisite change log (the datalog/bilog role) ---------------------
+    #
+    # Reference parity: rgw_datalog.h / cls_rgw bilog — every bucket
+    # or object mutation appends a marker-ordered entry to a SHARDED
+    # log that sync agents tail incrementally.  Entries are dirty-set
+    # HINTS, not op payloads: a peer re-fetches the named key's
+    # CURRENT state from this zone and reconciles (the
+    # fetch_remote_obj discipline), so replay is idempotent and
+    # ordering within a key is irrelevant past the newest entry.
+    # Entry keys are time-ordered and unique per gateway; like the
+    # index RMW above, one gateway instance per cluster is this
+    # tier's deployment shape.
+
+    LOG_SHARDS = 8
+
+    @classmethod
+    def _synclog_oid(cls, shard: int) -> str:
+        return cls._meta_oid("sync.log", str(shard))
+
+    def _log_shard(self, bucket: str) -> int:
+        # process-stable hash (builtin hash() is salted per process;
+        # shard assignment must survive gateway restarts)
+        from ceph_tpu.ops.rjenkins import ceph_str_hash_rjenkins
+
+        return ceph_str_hash_rjenkins(bucket.encode()) \
+            % self.LOG_SHARDS
+
+    async def _log_change(self, bucket: str,
+                          key: Optional[str] = None,
+                          origin: Optional[str] = None) -> None:
+        self._writes += 1
+        # monotonic ratchet over the wall clock: a backwards clock
+        # step (NTP) must never mint keys below a peer's saved marker
+        # — those entries would be invisible to sync and then trimmed.
+        # Seeded from the persisted log tail on first use so the
+        # ratchet survives restarts too.
+        if self._log_ns is None:
+            self._log_ns = await self._log_tail_ns()
+        ns = max(time.time_ns(), self._log_ns + 1)
+        self._log_ns = ns
+        entry_key = f"{ns:020d}.{self._writes}"
+        entry = {"bucket": bucket, "key": key,
+                 "zone": origin or self.zone,
+                 "ts": time.time()}
+        await self.meta.omap_set(
+            self._synclog_oid(self._log_shard(bucket)),
+            {entry_key: json.dumps(entry).encode()})
+
+    async def _log_tail_ns(self) -> int:
+        tail = 0
+        for shard in range(self.LOG_SHARDS):
+            try:
+                omap = await self.meta.omap_get(
+                    self._synclog_oid(shard))
+            except Exception:
+                continue
+            for k in omap:
+                try:
+                    tail = max(tail, int(k.split(".", 1)[0]))
+                except ValueError:
+                    pass
+        return tail
+
+    async def sync_log_entries(self, shard: int,
+                               after: str = "",
+                               limit: int = 1024
+                               ) -> List[Tuple[str, Dict]]:
+        """Log entries with key > after, oldest first."""
+        try:
+            omap = await self.meta.omap_get(self._synclog_oid(shard))
+        except Exception:
+            return []
+        out = sorted((k, json.loads(v.decode()))
+                     for k, v in omap.items() if k > after)
+        return out[:limit]
+
+    async def sync_peer_position(self, peer: str, shard: int,
+                                 marker: str) -> None:
+        """A peer records how far it has applied this shard — the
+        trim floor (the reference's per-peer sync status markers)."""
+        await self.meta.omap_set(
+            self._meta_oid("sync.peers", peer, str(shard)),
+            {"marker": marker.encode()})
+
+    async def sync_log_trim(self, shard: int) -> int:
+        """Drop entries every registered peer has applied (mdlog/
+        datalog trim role).  Returns entries removed."""
+        prefix = self._meta_oid("sync.peers", "")
+        names = [n for n in await self.meta.list_objects()
+                 if n.startswith(prefix)
+                 and n.endswith(self._SEP + str(shard))]
+        if not names:
+            return 0
+        floors = []
+        for n in names:
+            try:
+                omap = await self.meta.omap_get(n)
+                floors.append(omap.get("marker", b"").decode())
+            except Exception:
+                floors.append("")
+        floor = min(floors)
+        if not floor:
+            return 0
+        try:
+            omap = await self.meta.omap_get(self._synclog_oid(shard))
+        except Exception:
+            return 0
+        dead = [k for k in omap if k <= floor]
+        if dead:
+            await self.meta.omap_rm_keys(self._synclog_oid(shard),
+                                         dead)
+        return len(dead)
 
     # -- deferred stripe GC (rgw_gc.cc role) -------------------------------
 
@@ -353,14 +472,16 @@ class RGWLite:
 
     # -- versioning (RGWSetBucketVersioning / versioned PUT-GET-DEL) -------
 
-    async def put_bucket_versioning(self, bucket: str,
-                                    status: str) -> None:
+    async def put_bucket_versioning(self, bucket: str, status: str,
+                                    _origin: Optional[str] = None
+                                    ) -> None:
         if status not in (VER_ENABLED, VER_SUSPENDED):
             raise RGWError("InvalidRequest", f"bad status {status!r}")
         async with self._meta_lock(self._bucket_oid(bucket)):
             doc = await self._bucket(bucket)
             doc["versioning"] = status
             await self._store(self._bucket_oid(bucket), doc)
+        await self._log_change(bucket, origin=_origin)
 
     async def get_bucket_versioning(self, bucket: str) -> str:
         return (await self._bucket(bucket)).get("versioning", VER_OFF)
@@ -401,7 +522,8 @@ class RGWLite:
     # -- buckets -----------------------------------------------------------
 
     async def create_bucket(self, bucket: str, owner: str = "",
-                            acl: str = "private") -> None:
+                            acl: str = "private",
+                            _origin: Optional[str] = None) -> None:
         if acl not in CANNED_ACLS:
             raise RGWError("InvalidArgument", f"bad acl {acl!r}")
         if await self._load(self._bucket_oid(bucket)) is not None:
@@ -409,6 +531,7 @@ class RGWLite:
         await self._store(self._bucket_oid(bucket),
                           {"name": bucket, "objects": {},
                            "owner": owner, "acl": acl})
+        await self._log_change(bucket, origin=_origin)
 
     # -- ACLs (rgw_acl.cc / RGWAccessControlPolicy role) -------------------
 
@@ -417,13 +540,15 @@ class RGWLite:
         return {"owner": doc.get("owner", ""),
                 "acl": doc.get("acl", "private")}
 
-    async def put_bucket_acl(self, bucket: str, acl: str) -> None:
+    async def put_bucket_acl(self, bucket: str, acl: str,
+                             _origin: Optional[str] = None) -> None:
         if acl not in CANNED_ACLS:
             raise RGWError("InvalidArgument", f"bad acl {acl!r}")
         async with self._meta_lock(self._bucket_oid(bucket)):
             doc = await self._bucket(bucket)
             doc["acl"] = acl
             await self._store(self._bucket_oid(bucket), doc)
+        await self._log_change(bucket, origin=_origin)
 
     async def get_object_acl(self, bucket: str, key: str) -> str:
         doc = await self._bucket(bucket)
@@ -432,8 +557,8 @@ class RGWLite:
             raise RGWError("NoSuchKey", f"{bucket}/{key}")
         return entry.get("acl", "private")
 
-    async def put_object_acl(self, bucket: str, key: str,
-                             acl: str) -> None:
+    async def put_object_acl(self, bucket: str, key: str, acl: str,
+                             _origin: Optional[str] = None) -> None:
         if acl not in CANNED_ACLS:
             raise RGWError("InvalidArgument", f"bad acl {acl!r}")
         async with self._meta_lock(self._bucket_oid(bucket)):
@@ -443,6 +568,7 @@ class RGWLite:
                 raise RGWError("NoSuchKey", f"{bucket}/{key}")
             entry["acl"] = acl
             await self._store(self._bucket_oid(bucket), doc)
+        await self._log_change(bucket, key, origin=_origin)
 
     async def _bucket(self, bucket: str) -> Dict:
         doc = await self._load(self._bucket_oid(bucket))
@@ -513,7 +639,9 @@ class RGWLite:
     # -- lifecycle (rgw_lc.cc role) ----------------------------------------
 
     async def put_bucket_lifecycle(self, bucket: str,
-                                   rules: List[Dict]) -> None:
+                                   rules: List[Dict],
+                                   _origin: Optional[str] = None
+                                   ) -> None:
         for rule in rules:
             if rule.get("status", "Enabled") not in ("Enabled",
                                                      "Disabled"):
@@ -527,6 +655,7 @@ class RGWLite:
             doc = await self._bucket(bucket)
             doc["lifecycle"] = list(rules)
             await self._store(self._bucket_oid(bucket), doc)
+        await self._log_change(bucket, origin=_origin)
 
     async def get_bucket_lifecycle(self, bucket: str) -> List[Dict]:
         return (await self._bucket(bucket)).get("lifecycle", [])
@@ -617,7 +746,8 @@ class RGWLite:
         return sorted(n[len(prefix):] for n in names
                       if n.startswith(prefix))
 
-    async def delete_bucket(self, bucket: str) -> None:
+    async def delete_bucket(self, bucket: str,
+                            _origin: Optional[str] = None) -> None:
         # emptiness check + removal under the bucket meta lock: a PUT
         # linking a new object concurrently must not be orphaned by a
         # delete that checked before the link landed
@@ -626,6 +756,7 @@ class RGWLite:
             if doc["objects"] or doc.get("versioned_keys"):
                 raise RGWError("BucketNotEmpty", bucket)
             await self.meta.remove(self._bucket_oid(bucket))
+        await self._log_change(bucket, origin=_origin)
 
     async def head_object(self, bucket: str, key: str
                           ) -> Dict[str, Any]:
@@ -643,12 +774,15 @@ class RGWLite:
         return etag
 
     async def put_object_ex(self, bucket: str, key: str,
-                            data: bytes, acl: Optional[str] = None
+                            data: bytes, acl: Optional[str] = None,
+                            _origin: Optional[str] = None
                             ) -> Tuple[str, Optional[str]]:
         """Single-shot PUT (RGWPutObj + AtomicObjectProcessor role);
         under versioning every PUT lands as a new immutable version
         (rgw_op.cc:3712's versioned path).  Returns (etag, version_id)
-        — version_id None on unversioned buckets."""
+        — version_id None on unversioned buckets.  _origin: the
+        originating zone when applied by a sync agent (rides the
+        change log so the write is not echoed back)."""
         await self._bucket(bucket)  # existence check before the write
         writer = StripeWriter(self.data, self.aio_window)
         prefix = f"{self._head_oid(bucket, key)}.{self._write_id()}"
@@ -661,11 +795,12 @@ class RGWLite:
             raise
         etag = self._etag_from_manifest(manifest, data)
         return await self._link_by_status(bucket, key, manifest, etag,
-                                          acl=acl)
+                                          acl=acl, _origin=_origin)
 
     async def _link_by_status(self, bucket: str, key: str,
                               manifest: Manifest, etag: str,
-                              acl: Optional[str] = None
+                              acl: Optional[str] = None,
+                              _origin: Optional[str] = None
                               ) -> Tuple[str, Optional[str]]:
         """Link a finished upload under ONE bucket lock, adjudicating
         the versioning status AT LINK TIME — a versioning flip during
@@ -679,6 +814,7 @@ class RGWLite:
             if status == VER_OFF and not vdoc["versions"]:
                 await self._link_locked(doc, bucket, key, manifest,
                                         etag, acl=acl)
+                await self._log_change(bucket, key, origin=_origin)
                 return etag, None
             # versioned path — also when the key ALREADY has versions
             # with versioning since switched off: existing versions
@@ -686,6 +822,7 @@ class RGWLite:
             vid = await self._link_version_locked(
                 doc, vdoc, bucket, key, manifest, etag,
                 null_version=(status != VER_ENABLED), acl=acl)
+            await self._log_change(bucket, key, origin=_origin)
             return etag, vid
 
     async def _link_locked(self, doc: Dict, bucket: str, key: str,
@@ -834,8 +971,16 @@ class RGWLite:
         return out, etag
 
     async def delete_object(self, bucket: str, key: str,
-                            version_id: Optional[str] = None
+                            version_id: Optional[str] = None,
+                            _origin: Optional[str] = None
                             ) -> Optional[str]:
+        out = await self._delete_object_impl(bucket, key, version_id)
+        await self._log_change(bucket, key, origin=_origin)
+        return out
+
+    async def _delete_object_impl(self, bucket: str, key: str,
+                                  version_id: Optional[str] = None
+                                  ) -> Optional[str]:
         """DELETE, adjudicated under ONE bucket lock.  Unversioned:
         drop the object (stripes deferred to GC).  Versioning enabled
         + no versionId: insert a DELETE MARKER (versions survive).
@@ -969,6 +1114,90 @@ class RGWLite:
             vdoc = await self._versions(bucket, key)
             self._drop_version_locked(vdoc, version_id, missing_ok)
             await self._finish_versions_locked(doc, bucket, key, vdoc)
+
+    # -- multisite apply seam (fetch_remote_obj role) ----------------------
+
+    async def sync_replace_versions(self, bucket: str, key: str,
+                                    src_versions: List[Dict],
+                                    blobs: Dict[str, bytes],
+                                    origin: str) -> None:
+        """Make this zone's version set for (bucket, key) EXACTLY
+        match a peer's, preserving version ids, mtimes and order (the
+        reference replicates version ids across zones —
+        rgw_data_sync.cc fetch_remote_obj with preset attrs).
+        src_versions: the peer's newest-first version list; blobs:
+        data for version ids this zone lacks.  Stripes are written
+        before the lock; dropped versions' stripes go to GC."""
+        uploaded: Dict[str, Manifest] = {}
+        for v in src_versions:
+            vid = v["version_id"]
+            if v.get("delete_marker") or vid not in blobs:
+                continue
+            writer = StripeWriter(self.data, self.aio_window)
+            prefix = (f"{self._head_oid(bucket, key)}"
+                      f".{self._write_id()}")
+            proc = PutObjProcessor(writer, prefix, self.stripe_size)
+            try:
+                await proc.process(blobs[vid])
+                uploaded[vid] = await proc.complete()
+            except Exception:
+                await writer.cancel()
+                raise
+        async with self._meta_lock(self._bucket_oid(bucket)):
+            doc = await self._bucket(bucket)
+            vdoc = await self._versions(bucket, key)
+            if not vdoc["versions"]:
+                # a plain pre-versioning head here must fold into the
+                # "null" version (same discipline as the local
+                # versioned-write path) or its head doc and stripes
+                # would be orphaned under the new version set
+                vdoc["versions"] = await self._migrate_legacy_head(
+                    bucket, key)
+            have = {v["version_id"]: v for v in vdoc["versions"]}
+            new_list: List[Dict] = []
+            for v in src_versions:
+                vid = v["version_id"]
+                if vid in uploaded:
+                    # freshly fetched peer data WINS over a same-id
+                    # local entry (a divergent "null" version): the
+                    # loser's stripes are garbage
+                    old = have.pop(vid, None)
+                    if old is not None and old.get("manifest"):
+                        vdoc.setdefault("_gc", []).extend(
+                            st["oid"]
+                            for st in old["manifest"]["stripes"])
+                    m = uploaded[vid]
+                    new_list.append(
+                        {"version_id": vid,
+                         "etag": v.get("etag", ""),
+                         "manifest": m.to_dict(),
+                         "size": m.obj_size,
+                         "mtime": v.get("mtime", time.time()),
+                         "delete_marker": False})
+                elif vid in have:
+                    new_list.append(have.pop(vid))
+                elif v.get("delete_marker"):
+                    new_list.append(
+                        {"version_id": vid, "etag": "",
+                         "manifest": None, "size": 0,
+                         "mtime": v.get("mtime", time.time()),
+                         "delete_marker": True})
+                # else: peer listed it but no blob arrived (raced a
+                # source-side delete) — next log entry reconciles
+            # versions only we had: their stripes are garbage now
+            vdoc["versions"] = new_list
+            for dead in have.values():
+                if dead.get("manifest"):
+                    vdoc.setdefault("_gc", []).extend(
+                        st["oid"]
+                        for st in dead["manifest"]["stripes"])
+            if new_list:
+                vk = set(doc.setdefault("versioned_keys", []))
+                vk.add(key)
+                doc["versioned_keys"] = sorted(vk)
+            await self._finish_versions_locked(doc, bucket, key,
+                                               vdoc)
+        await self._log_change(bucket, key, origin=origin)
 
     # -- multipart ---------------------------------------------------------
 

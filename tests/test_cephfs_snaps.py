@@ -253,6 +253,55 @@ def test_snapshots_survive_mds_failover():
     run(main())
 
 
+def test_crashed_mksnap_pending_row_swept_on_takeover():
+    """A PENDING snap-table row (mksnap crashed between snapid
+    allocation and finalize) must be invisible to .snap readers and
+    get swept on takeover — its pool snapids released so clones
+    trim instead of leaking."""
+    async def main():
+        import json as _json
+        cluster, mdss, clients, (fs,) = await _fs_cluster()
+        try:
+            await fs.mkdir("/p")
+            await fs.write_file("/p/f", b"data")
+            # simulate the crash artifact: allocate real pool snapids
+            # and leave a pending row behind
+            meta_io = clients[0].open_ioctx("cephfs.meta")
+            data_io = clients[0].open_ioctx("cephfs.data")
+            dsnap = await data_io.create_selfmanaged_snap()
+            msnap = await meta_io.create_selfmanaged_snap()
+            row = {"name": "ghost", "ino": 1, "meta_snap": msnap,
+                   "data_snap": dsnap, "ctime": 0.0,
+                   "pending": True, "rank": 0}
+            await meta_io.omap_set(
+                "mds_snaptable",
+                {f"{dsnap:016x}": _json.dumps(row).encode()})
+            # invisible while pending
+            assert all(s["name"] != "ghost"
+                       for s in await fs.lssnap("/"))
+            # failover sweeps it
+            await mdss[0].stop()
+            nxt = MDSDaemon(cluster.mon.addr, "cephfs.meta",
+                            "cephfs.data", name="b",
+                            lock_interval=0.3)
+            await nxt.start()
+            mdss[:] = [nxt]
+            for _ in range(50):
+                omap = await meta_io.omap_get("mds_snaptable")
+                if f"{dsnap:016x}" not in omap:
+                    break
+                await asyncio.sleep(0.2)
+            omap = await meta_io.omap_get("mds_snaptable")
+            assert f"{dsnap:016x}" not in omap, "row not swept"
+            # the released snapid landed in removed_snaps (trimmable)
+            await clients[0].refresh_map()
+            pool = clients[0].osdmap.pools[data_io.pool_id]
+            assert dsnap in getattr(pool, "removed_snaps", [])
+        finally:
+            await _teardown(cluster, mdss, clients)
+    run(main())
+
+
 def test_root_snapshot_covers_tree():
     async def main():
         cluster, mdss, clients, (fs,) = await _fs_cluster()
