@@ -768,6 +768,14 @@ class Image:
         # re-read it UNDER the lock so our read-modify-writes (snapc,
         # size, snaps) start from the current state
         await self.refresh()
+        # the refresh may have just revealed a migration fence set
+        # since we opened — fail the acquiring mutation, not the ones
+        # after it
+        try:
+            self._fence_migration_source()
+        except RadosError:
+            await self.release_exclusive_lock()
+            raise
         await self._renew_lock_stamp()
         self._lock_task = asyncio.get_running_loop().create_task(
             self._lock_renew_loop())
@@ -823,11 +831,20 @@ class Image:
 
     # -- I/O (mutators) ----------------------------------------------------
 
+    def _fence_migration_source(self) -> None:
+        """A migration source is write-fenced (Migration.cc prepare
+        semantics re-designed as a header flag): clients must switch
+        to the destination image, whose writes are the live ones."""
+        if self.meta.get("migration"):
+            raise RadosError(-30, "image is a migration source"
+                                  " (write-fenced)")  # EROFS
+
     async def write(self, offset: int, data: bytes) -> int:
         if self._read_snap is not None:
             raise RadosError(-30, "image is open at a snapshot")  # EROFS
         if offset + len(data) > self.meta["size"]:
             raise RadosError(-27, "write past image size")  # EFBIG
+        self._fence_migration_source()
         await self._ensure_lock()
         seq = await self._j_append({"op": "write", "offset": offset,
                                     "data": data})
@@ -864,6 +881,7 @@ class Image:
         them to sparse), partial spans are zeroed."""
         if self._read_snap is not None:
             raise RadosError(-30, "image is open at a snapshot")
+        self._fence_migration_source()
         await self._ensure_lock()
         seq = await self._j_append({"op": "discard", "offset": offset,
                                     "length": length})
@@ -890,6 +908,7 @@ class Image:
     async def resize(self, new_size: int) -> None:
         if self._read_snap is not None:
             raise RadosError(-30, "image is open at a snapshot")
+        self._fence_migration_source()
         await self._ensure_lock()
         seq = await self._j_append({"op": "resize", "size": new_size})
         old = self.meta["size"]
@@ -929,6 +948,7 @@ class Image:
     async def snap_create(self, snap_name: str) -> int:
         if snap_name in self.meta["snaps"]:
             raise RadosError(-17, f"snap {snap_name!r} exists")
+        self._fence_migration_source()
         await self._ensure_lock()
         jseq = await self._j_append({"op": "snap_create",
                                      "snap_name": snap_name})

@@ -159,6 +159,38 @@ async def _dispatch(client, ioctx, rbd: RBD, args) -> int:
         print(json.dumps({"bootstrapped": True,
                           "events_replayed": applied}))
         return 0
+    if cmd == "deep-cp":
+        from ceph_tpu.rbd.migrate import deep_copy
+
+        dst_io = client.open_ioctx(args.dest_pool) \
+            if args.dest_pool else ioctx
+        new_id = await deep_copy(ioctx, args.image, dst_io,
+                                 args.dest, data_pool=args.data_pool)
+        print(json.dumps({"id": new_id}))
+        return 0
+    if cmd == "migration":
+        from ceph_tpu.rbd import migrate as _mg
+
+        dst_io = client.open_ioctx(args.dest_pool) \
+            if args.dest_pool else ioctx
+        if args.verb == "prepare":
+            if not args.dest:
+                print("migration prepare needs a dest image",
+                      file=sys.stderr)
+                return 22
+            new_id = await _mg.migration_prepare(
+                ioctx, args.image, dst_io, args.dest,
+                data_pool=args.data_pool)
+            print(json.dumps({"id": new_id, "state": "prepared"}))
+            return 0
+        fn = {"execute": _mg.migration_execute,
+              "commit": _mg.migration_commit,
+              "abort": _mg.migration_abort}[args.verb]
+        await fn(dst_io if args.dest_pool else ioctx, args.image)
+        print(json.dumps({"state": args.verb}))
+        return 0
+    if cmd == "bench":
+        return await _bench(ioctx, rbd, args)
     print(f"unknown command {cmd}", file=sys.stderr)
     return 22
 
@@ -191,6 +223,63 @@ async def _snap(ioctx, rbd: RBD, args) -> int:
         return 0
     finally:
         await img.close()
+
+
+async def _bench(ioctx, rbd: RBD, args) -> int:
+    """`rbd bench` (tools/rbd/action/Bench.cc role): drive the image
+    with N concurrent sequential/random IOs and report ops/s, MB/s."""
+    import time as _time
+
+    io_size = _size(args.io_size)
+    total = _size(args.io_total)
+    img = await rbd.open(ioctx, args.image)
+    if img.size() < io_size:
+        print("image smaller than --io-size", file=sys.stderr)
+        return 22
+    ops = max(1, total // io_size)
+    span = img.size() - io_size
+    # deterministic LCG offsets for rand (no retry loops, replayable)
+    state = 0x5DEECE66D
+
+    def offsets():
+        nonlocal state
+        pos = 0
+        for _ in range(ops):
+            if args.io_pattern == "rand":
+                state = (state * 6364136223846793005 + 1442695040888963407) \
+                    & ((1 << 64) - 1)
+                yield (state >> 16) % (span + 1) if span else 0
+            else:
+                yield pos
+                pos = (pos + io_size) % (span + 1 if span else 1)
+
+    payload = bytes(io_size)
+    sem = asyncio.Semaphore(args.io_threads)
+    did = {"read": 0, "write": 0}
+
+    async def one(i: int, off: int) -> None:
+        async with sem:
+            write = args.io_type == "write" or (
+                args.io_type == "readwrite" and i % 2 == 0)
+            if write:
+                await img.write(off, payload)
+                did["write"] += 1
+            else:
+                await img.read(off, io_size)
+                did["read"] += 1
+
+    t0 = _time.perf_counter()
+    await asyncio.gather(*(one(i, off)
+                           for i, off in enumerate(offsets())))
+    dt = _time.perf_counter() - t0
+    await img.close()
+    print(json.dumps({
+        "io_type": args.io_type, "io_size": io_size, "ops": ops,
+        "reads": did["read"], "writes": did["write"],
+        "elapsed_s": round(dt, 4),
+        "ops_per_sec": round(ops / dt, 2),
+        "mb_per_sec": round(ops * io_size / dt / (1 << 20), 2)}))
+    return 0
 
 
 def main(argv=None) -> int:
@@ -235,6 +324,29 @@ def main(argv=None) -> int:
     mi = sub.add_parser("mirror")
     mi.add_argument("image")
     mi.add_argument("--dst-pool", required=True)
+    dc = sub.add_parser("deep-cp")
+    dc.add_argument("image")
+    dc.add_argument("dest")
+    dc.add_argument("--dest-pool", default=None)
+    dc.add_argument("--data-pool", default=None)
+    mg = sub.add_parser("migration")
+    mg.add_argument("verb", choices=["prepare", "execute",
+                                     "commit", "abort"])
+    mg.add_argument("image")
+    mg.add_argument("dest", nargs="?", default=None,
+                    help="dest image (prepare only)")
+    mg.add_argument("--dest-pool", default=None)
+    mg.add_argument("--data-pool", default=None)
+    be = sub.add_parser("bench")
+    be.add_argument("image")
+    be.add_argument("--io-type", choices=["write", "read",
+                                          "readwrite"],
+                    default="write")
+    be.add_argument("--io-size", default="4K")
+    be.add_argument("--io-total", default="16M")
+    be.add_argument("--io-pattern", choices=["seq", "rand"],
+                    default="seq")
+    be.add_argument("--io-threads", type=int, default=16)
 
     args = ap.parse_args(argv)
     try:

@@ -243,6 +243,46 @@ def test_rbd_cli_end_to_end(tmp_path):
             mirrored = await rbd.open(bio, "disk")
             assert await mirrored.read(0, 13) == b"cli export me"
             await mirrored.close()
+
+            # deep-cp with snapshot history to the backup pool
+            rc, out, err = await _rbd_cli(
+                mon, "deep-cp", "disk", "deep", "--dest-pool",
+                "backup")
+            assert rc == 0, err
+            deep = await rbd.open(bio, "deep")
+            assert await deep.read(0, 13) == b"cli export me"
+            assert [s["name"] for s in await deep.snap_list()] \
+                == ["s1"]
+            await deep.close()
+
+            # migration prepare/execute/commit through the CLI
+            rc, out, err = await _rbd_cli(
+                mon, "migration", "prepare", "disk2", "mig",
+                "--dest-pool", "backup")
+            assert rc == 0, err
+            rc, out, err = await _rbd_cli(
+                mon, "migration", "execute", "mig",
+                "--dest-pool", "backup")
+            assert rc == 0, err
+            rc, out, err = await _rbd_cli(
+                mon, "migration", "commit", "mig",
+                "--dest-pool", "backup")
+            assert rc == 0, err
+            rc, out, _ = await _rbd_cli(mon, "ls")
+            assert b"disk2" not in out
+            mig = await rbd.open(bio, "mig")
+            assert await mig.read(0, 13) == b"cli export me"
+            await mig.close()
+
+            # rbd bench prints sane numbers
+            rc, out, err = await _rbd_cli(
+                mon, "bench", "disk", "--io-type", "readwrite",
+                "--io-size", "4K", "--io-total", "64K")
+            assert rc == 0, err
+            doc = json.loads(out)
+            assert doc["ops"] == 16
+            assert doc["reads"] + doc["writes"] == 16
+            assert doc["ops_per_sec"] > 0
         finally:
             await cluster.stop()
 

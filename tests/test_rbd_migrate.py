@@ -1,0 +1,182 @@
+"""RBD deep-copy and migration (librbd deep_copy/ + api/Migration.cc
+roles).
+
+1. deep_copy replicates data AND snapshot history (per-snap content,
+   protection flags), within and across clusters;
+2. the delta passes move unchanged data once;
+3. migration: prepare links dst to src (reads fall through
+   immediately), execute copies, commit deletes the source; the
+   source is write-fenced after prepare;
+4. abort backs out cleanly.
+"""
+
+import asyncio
+
+import pytest
+
+from cluster_helpers import Cluster
+
+from ceph_tpu.rados.client import RadosError
+from ceph_tpu.rbd import RBD
+from ceph_tpu.rbd.migrate import (
+    deep_copy,
+    migration_abort,
+    migration_commit,
+    migration_execute,
+    migration_prepare,
+)
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 180))
+
+
+async def _cluster(pools=("rbd",)):
+    cluster = Cluster(num_osds=3)
+    await cluster.start()
+    for p in pools:
+        await cluster.client.create_replicated_pool(p, size=2,
+                                                    pg_num=4)
+    return cluster
+
+
+def test_deep_copy_with_snapshot_history():
+    async def main():
+        cluster = await _cluster()
+        try:
+            io = cluster.client.open_ioctx("rbd")
+            rbd = RBD()
+            await rbd.create(io, "src", 4 << 20, order=20)
+            img = await rbd.open(io, "src")
+            await img.write(0, b"A" * 8192)
+            await img.snap_create("s1")
+            await img.snap_protect("s1")
+            await img.write(0, b"B" * 4096)          # changes s2
+            await img.write(1 << 20, b"C" * 4096)    # new data
+            await img.snap_create("s2")
+            await img.write(0, b"H" * 1024)          # head only
+            await img.close()
+
+            await deep_copy(io, "src", io, "dst")
+            dst = await rbd.open(io, "dst")
+            # head
+            assert await dst.read(0, 1024) == b"H" * 1024
+            assert await dst.read(1 << 20, 4096) == b"C" * 4096
+            # snapshot views
+            assert sorted(s["name"] for s in await dst.snap_list()) \
+                == ["s1", "s2"]
+            assert await dst.snap_is_protected("s1")
+            dst.snap_set("s1")
+            assert await dst.read(0, 8192) == b"A" * 8192
+            assert await dst.read(1 << 20, 4096) == bytes(4096)
+            dst.snap_set("s2")
+            assert await dst.read(0, 4096) == b"B" * 4096
+            assert await dst.read(4096, 4096) == b"A" * 4096
+            assert await dst.read(1 << 20, 4096) == b"C" * 4096
+        finally:
+            await cluster.stop()
+    run(main())
+
+
+def test_deep_copy_across_clusters():
+    async def main():
+        ca, cb = await _cluster(), await _cluster()
+        try:
+            io_a = ca.client.open_ioctx("rbd")
+            io_b = cb.client.open_ioctx("rbd")
+            rbd = RBD()
+            await rbd.create(io_a, "img", 2 << 20, order=20)
+            img = await rbd.open(io_a, "img")
+            await img.write(0, b"xyz" * 1000)
+            await img.snap_create("snap")
+            await img.close()
+            await deep_copy(io_a, "img", io_b, "img")
+            got = await rbd.open(io_b, "img")
+            assert await got.read(0, 3000) == b"xyz" * 1000
+            assert [s["name"] for s in await got.snap_list()] == \
+                ["snap"]
+        finally:
+            await ca.stop()
+            await cb.stop()
+    run(main())
+
+
+def test_migration_lifecycle():
+    async def main():
+        cluster = await _cluster(pools=("rbd", "fast"))
+        try:
+            io = cluster.client.open_ioctx("rbd")
+            fast = cluster.client.open_ioctx("fast")
+            rbd = RBD()
+            await rbd.create(io, "vm", 2 << 20, order=20)
+            img = await rbd.open(io, "vm")
+            await img.write(0, b"boot" * 256)
+            await img.write(1 << 20, b"data" * 256)
+            await img.close()
+
+            await migration_prepare(io, "vm", fast, "vm")
+            # reads fall through BEFORE any copying
+            dst = await rbd.open(fast, "vm")
+            assert await dst.read(0, 1024) == b"boot" * 256
+            # the source is write-fenced now
+            src = await rbd.open(io, "vm")
+            with pytest.raises(RadosError):
+                await src.write(0, b"nope")
+            # destination takes live writes during migration
+            await dst.write(4096, b"LIVE" * 256)
+            await migration_execute(fast, "vm")
+            # flattened: content self-contained
+            assert await dst.read(1 << 20, 1024) == b"data" * 256
+            assert await dst.read(4096, 1024) == b"LIVE" * 256
+            await migration_commit(fast, "vm")
+            assert "vm" not in await rbd.list(io)      # source gone
+            fresh = await rbd.open(fast, "vm")
+            assert fresh.meta.get("migration_source") is None
+            assert await fresh.read(0, 1024) == b"boot" * 256
+            await dst.close()
+        finally:
+            await cluster.stop()
+    run(main())
+
+
+def test_migration_abort():
+    async def main():
+        cluster = await _cluster(pools=("rbd", "fast"))
+        try:
+            io = cluster.client.open_ioctx("rbd")
+            fast = cluster.client.open_ioctx("fast")
+            rbd = RBD()
+            await rbd.create(io, "img", 1 << 20, order=20)
+            img = await rbd.open(io, "img")
+            await img.write(0, b"keepme!!")
+            await img.close()
+            await migration_prepare(io, "img", fast, "img")
+            await migration_abort(fast, "img")
+            assert "img" not in await rbd.list(fast)
+            # source unfenced and intact
+            src = await rbd.open(io, "img")
+            assert src.meta.get("migration") is None
+            await src.write(8, b"writable")
+            assert await src.read(0, 16) == b"keepme!!writable"
+            await src.close()
+        finally:
+            await cluster.stop()
+    run(main())
+
+
+def test_migration_refuses_snapshotted_source():
+    async def main():
+        cluster = await _cluster(pools=("rbd", "fast"))
+        try:
+            io = cluster.client.open_ioctx("rbd")
+            fast = cluster.client.open_ioctx("fast")
+            rbd = RBD()
+            await rbd.create(io, "s", 1 << 20, order=20)
+            img = await rbd.open(io, "s")
+            await img.snap_create("x")
+            await img.close()
+            with pytest.raises(RadosError):
+                await migration_prepare(io, "s", fast, "s")
+        finally:
+            await cluster.stop()
+    run(main())
