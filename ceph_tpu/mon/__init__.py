@@ -144,6 +144,10 @@ class MonDaemon:
         from collections import deque
 
         self._cluster_log: "deque" = deque(maxlen=2048)
+        # crash reports (the mgr crash module role, kept on the mon so
+        # reports are quorum-replicated and survive any single daemon):
+        # crash_id -> report dict (+ "archived" flag)
+        self._crash: Dict[str, Dict[str, Any]] = {}
         # forwarded-command reply routing (MForward role)
         self._fwd_tid = 0
         self._fwd_pending: Dict[int, Tuple[Connection, int]] = {}
@@ -178,6 +182,9 @@ class MonDaemon:
             doc = json.loads(cfg.decode())
             self._config_kv = doc.get("kv", {})
             self._config_version = int(doc.get("version", 0))
+        crash = self.store.get("mon", b"crash")
+        if crash:
+            self._crash = json.loads(crash.decode())
         aux = self.store.get("mon", b"aux")
         if aux:
             doc = json.loads(aux.decode())
@@ -204,6 +211,7 @@ class MonDaemon:
             "kv": self._config_kv,
             "version": self._config_version,
         }).encode())
+        t.set("mon", b"crash", json.dumps(self._crash).encode())
         t.set("mon", b"aux", json.dumps({
             "laggy_probability": self._laggy_probability,
             "laggy_interval": self._laggy_interval,
@@ -325,6 +333,23 @@ class MonDaemon:
         b"C"+json (centralized config mutation) — the PaxosService
         multiplexing role collapsed onto one tag byte; untagged values
         are legacy map incrementals."""
+        if value[:1] == b"R":
+            doc = json.loads(value[1:].decode())
+            op = doc.get("op")
+            if op == "post":
+                rep = doc["report"]
+                self._crash.setdefault(rep["crash_id"], rep)
+            elif op == "archive":
+                rep = self._crash.get(doc["crash_id"])
+                if rep is not None:
+                    rep["archived"] = True
+            elif op == "archive_all":
+                for rep in self._crash.values():
+                    rep["archived"] = True
+            elif op == "rm":
+                self._crash.pop(doc["crash_id"], None)
+            self._stage_mon(t, None)
+            return
         if value[:1] == b"C":
             doc = json.loads(value[1:].decode())
             section, name = doc["section"], doc["name"]
@@ -356,8 +381,10 @@ class MonDaemon:
         m = self.osdmap.encode()
         cfg = json.dumps({"kv": self._config_kv,
                           "version": self._config_version}).encode()
+        crash = json.dumps(self._crash).encode()
         return (len(m).to_bytes(8, "big") + m
-                + len(cfg).to_bytes(8, "big") + cfg)
+                + len(cfg).to_bytes(8, "big") + cfg
+                + len(crash).to_bytes(8, "big") + crash)
 
     def _paxos_install(self, v: int, blob: bytes, t) -> None:
         """Full-state catch-up past a trimmed log (OP_FULL)."""
@@ -371,6 +398,10 @@ class MonDaemon:
             self._config_kv = doc.get("kv", {})
             self._config_version = int(doc.get("version", 0))
             self._push_config()
+            rest = rest[8 + clen:]
+        if rest:  # crash table (older snapshots simply lack it)
+            rlen = int.from_bytes(rest[:8], "big")
+            self._crash = json.loads(rest[8:8 + rlen].decode())
         self._inc_log.clear()
         self._stage_mon(t, None)
         self._publish()
@@ -712,6 +743,13 @@ class MonDaemon:
                 "config rm": self._cmd_config_rm,
                 "config get": self._cmd_config_get,
                 "log last": self._cmd_log_last,
+                "crash post": self._cmd_crash_post,
+                "crash ls": self._cmd_crash_ls,
+                "crash ls-new": self._cmd_crash_ls,
+                "crash info": self._cmd_crash_info,
+                "crash archive": self._cmd_crash_archive,
+                "crash archive-all": self._cmd_crash_archive,
+                "crash rm": self._cmd_crash_rm,
             }.get(prefix)
             if handler is None:
                 return -22, {"error": f"unknown command {prefix!r}"}
@@ -1000,6 +1038,71 @@ class MonDaemon:
         n = int(cmd.get("num", 20))
         return 0, {"entries": list(self._cluster_log)[-n:]}
 
+    # -- crash reports (pybind/mgr/crash + ceph-crash roles) ---------------
+    #
+    # Daemons post a report when they die unexpectedly; reports are
+    # quorum-replicated (tag b"R"), surface as a RECENT_CRASH health
+    # warning until archived, and survive mon restarts via the store
+    # snapshot.
+
+    CRASH_RECENT_S = 14 * 86400  # RECENT_CRASH window (reference dflt)
+
+    async def _cmd_crash_post(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        rep = dict(cmd.get("report") or {})
+        if not rep.get("crash_id"):
+            return -22, {"error": "report needs a crash_id"}
+        rep.setdefault("timestamp", time.time())
+        async with self._mutation_lock:
+            ok = await self.paxos.propose(b"R" + json.dumps(
+                {"op": "post", "report": rep}).encode())
+            if not ok:
+                return -11, {"error": "no quorum; retry"}
+        self.clog("ERR", f"mon.{self.rank}",
+                  f"daemon {rep.get('entity', '?')} crashed:"
+                  f" {rep['crash_id']}")
+        return 0, {"crash_id": rep["crash_id"]}
+
+    async def _cmd_crash_ls(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        new_only = cmd.get("prefix") == "crash ls-new"
+        out = [{"crash_id": cid,
+                "entity": rep.get("entity", ""),
+                "timestamp": rep.get("timestamp", 0),
+                "archived": bool(rep.get("archived"))}
+               for cid, rep in sorted(self._crash.items())
+               if not (new_only and rep.get("archived"))]
+        return 0, {"crashes": out}
+
+    async def _cmd_crash_info(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        rep = self._crash.get(cmd.get("id", ""))
+        if rep is None:
+            return -2, {"error": "no such crash"}
+        return 0, {"report": rep}
+
+    async def _cmd_crash_archive(self, cmd
+                                 ) -> Tuple[int, Dict[str, Any]]:
+        if cmd.get("prefix") == "crash archive-all":
+            doc = {"op": "archive_all"}
+        else:
+            cid = cmd.get("id", "")
+            if cid not in self._crash:
+                return -2, {"error": "no such crash"}
+            doc = {"op": "archive", "crash_id": cid}
+        async with self._mutation_lock:
+            if not await self.paxos.propose(
+                    b"R" + json.dumps(doc).encode()):
+                return -11, {"error": "no quorum; retry"}
+        return 0, {}
+
+    async def _cmd_crash_rm(self, cmd) -> Tuple[int, Dict[str, Any]]:
+        cid = cmd.get("id", "")
+        if cid not in self._crash:
+            return -2, {"error": "no such crash"}
+        async with self._mutation_lock:
+            if not await self.paxos.propose(b"R" + json.dumps(
+                    {"op": "rm", "crash_id": cid}).encode()):
+                return -11, {"error": "no quorum; retry"}
+        return 0, {}
+
     async def _cmd_mon_stat(self, cmd) -> Tuple[int, Dict[str, Any]]:
         """Quorum observability (`ceph mon stat` role)."""
         out = {"rank": self.rank, "num_mons": len(self.mon_addrs) or 1,
@@ -1052,5 +1155,15 @@ class MonDaemon:
             checks["PG_DEGRADED"] = {
                 "severity": "HEALTH_WARN",
                 "summary": f"{degraded} pgs degraded"}
+        recent = [cid for cid, rep in self._crash.items()
+                  if not rep.get("archived")
+                  and time.time() - rep.get("timestamp", 0)
+                  < self.CRASH_RECENT_S]
+        if recent:
+            checks["RECENT_CRASH"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(recent)} daemons have recently"
+                           " crashed",
+                "detail": sorted(recent)}
         status = "HEALTH_OK" if not checks else "HEALTH_WARN"
         return 0, {"status": status, "checks": checks}

@@ -29,21 +29,33 @@ async def _main() -> None:
     ap.add_argument("--config", type=str, default="{}",
                     help="JSON osd config overrides")
     args = ap.parse_args()
-    if args.store_path:
-        from ceph_tpu.os.tpustore import TPUStore
+    try:
+        if args.store_path:
+            from ceph_tpu.os.tpustore import TPUStore
 
-        store = TPUStore(args.store_path)
-        if not os.path.exists(os.path.join(args.store_path, "block")):
-            os.makedirs(args.store_path, exist_ok=True)
+            store = TPUStore(args.store_path)
+            if not os.path.exists(os.path.join(args.store_path,
+                                               "block")):
+                os.makedirs(args.store_path, exist_ok=True)
+                store.mkfs()
+            store.mount()
+        else:
+            store = MemStore()
             store.mkfs()
-        store.mount()
-    else:
-        store = MemStore()
-        store.mkfs()
-        store.mount()
-    osd = OSDDaemon(args.id, args.mon, store=store,
-                    config=json.loads(args.config))
-    addr = await osd.start(port=args.port)
+            store.mount()
+        osd = OSDDaemon(args.id, args.mon, store=store,
+                        config=json.loads(args.config))
+        addr = await osd.start(port=args.port)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        raise
+    except BaseException as e:
+        # boot died (bad store, bind failure, mount corruption): post
+        # a crash report before exiting (the ceph-crash role) —
+        # best-effort over a FRESH connection, never masks the error
+        from ceph_tpu.common.crash import post_crash
+
+        await post_crash(args.mon, f"osd.{args.id}", e)
+        raise
     print(f"OSD_ADDR {addr}", flush=True)
     try:
         await asyncio.Event().wait()

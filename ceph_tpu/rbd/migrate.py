@@ -80,8 +80,31 @@ async def deep_copy(src_ioctx: IoCtx, src_name: str,
             if dst.size() != size:
                 await dst.resize(size)
 
+            sparse_ok = not src._has_parent()
+
+            async def _absent(img: Image, objno: int) -> bool:
+                from ceph_tpu.rbd import _data
+
+                try:
+                    # stat resolves at the handle's read snap, like
+                    # any read op
+                    await img.data_ioctx.stat(_data(img.id, objno))
+                    return False
+                except ObjectNotFound:
+                    return True
+
             async def one(off: int, span: int, rd=reader) -> None:
                 async with sem:
+                    objno = off // objsz
+                    if sparse_ok and await _absent(rd, objno) and (
+                            prev_reader is None
+                            or off >= prev_size
+                            or await _absent(prev_reader, objno)):
+                        # absent in BOTH passes: nothing changed and
+                        # nothing to write — a sparse image skips the
+                        # two full-object reads (parent-backed images
+                        # cannot skip: absent still reads through)
+                        return
                     cur = await rd.read(off, span)
                     if prev_reader is not None and off < prev_size:
                         old = await prev_reader.read(
@@ -203,10 +226,17 @@ async def migration_prepare(src_ioctx: IoCtx, src_name: str,
     return dst_id
 
 
-async def migration_execute(dst_ioctx: IoCtx, dst_name: str) -> None:
-    """Copy everything down (flatten through the migration link)."""
+async def migration_execute(dst_ioctx: IoCtx, dst_name: str,
+                            image: Optional[Image] = None) -> None:
+    """Copy everything down (flatten through the migration link).
+    For exclusive-lock images with a LIVE writer, pass that writer's
+    open handle as `image` — flatten then runs under the lock it
+    already holds (the reference executes migration inside librbd for
+    the same reason); a second handle would wait out the holder and
+    fail EBUSY."""
     rbd = RBD()
-    dst = await rbd.open(dst_ioctx, dst_name)
+    dst = image if image is not None \
+        else await rbd.open(dst_ioctx, dst_name)
     ms = dst.meta.get("migration_source")
     if ms is None:
         raise RadosError(EINVAL, f"{dst_name!r} is not a migration"
@@ -228,7 +258,8 @@ async def migration_execute(dst_ioctx: IoCtx, dst_name: str) -> None:
         except Exception:
             pass  # source header gone: commit already ran elsewhere
     finally:
-        await dst.close()
+        if image is None:  # never close a caller-owned handle
+            await dst.close()
 
 
 async def migration_commit(dst_ioctx: IoCtx, dst_name: str) -> None:
@@ -270,12 +301,15 @@ async def migration_abort(dst_ioctx: IoCtx, dst_name: str) -> None:
     if ms.get("state") == "executed":
         raise RadosError(EINVAL, "already executed: commit or keep")
     await dst.close()
-    await rbd.remove(dst_ioctx, dst_name)  # deregisters the child
+    # unfence the source FIRST: if it fails, dst still exists and
+    # abort can be retried — the reverse order would strand a
+    # permanently write-fenced source with no remaining handle on it
     src_io = IoCtx(dst_ioctx.client, ms["pool_id"])
     src = Image(src_io, ms["name"], ms["image_id"])
     try:
         await src.refresh()
         src.meta.pop("migration", None)
         await src._save()
-    except Exception:
-        pass
+    except ObjectNotFound:
+        pass  # source already gone: nothing to unfence
+    await rbd.remove(dst_ioctx, dst_name)  # deregisters the child

@@ -65,6 +65,11 @@ async def _dispatch(client: RadosClient, args) -> int:
         rc, out = await client.mon_command({"prefix": cmd})
         _out(out)
         return 0 if rc == 0 else 1
+    if cmd == "crash":
+        rc, out = await client.mon_command(
+            {"prefix": f"crash {args.verb}", "id": args.id})
+        _out(out)
+        return 0 if rc == 0 else 1
     if cmd == "tell":
         rc, out = await client.osd_command(
             args.osd, {"prefix": " ".join(args.tell_cmd)})
@@ -182,6 +187,10 @@ def main(argv=None) -> int:
                     help="JSON EC profile (makes an EC pool)")
     sub.add_parser("status")
     sub.add_parser("health")
+    cr = sub.add_parser("crash")
+    cr.add_argument("verb", choices=["ls", "ls-new", "info",
+                                     "archive", "archive-all", "rm"])
+    cr.add_argument("id", nargs="?", default="")
     tell = sub.add_parser("tell")
     tell.add_argument("osd", type=int)
     tell.add_argument("tell_cmd", nargs="+")

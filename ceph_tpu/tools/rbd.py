@@ -186,7 +186,7 @@ async def _dispatch(client, ioctx, rbd: RBD, args) -> int:
         fn = {"execute": _mg.migration_execute,
               "commit": _mg.migration_commit,
               "abort": _mg.migration_abort}[args.verb]
-        await fn(dst_io if args.dest_pool else ioctx, args.image)
+        await fn(dst_io, args.image)
         print(json.dumps({"state": args.verb}))
         return 0
     if cmd == "bench":

@@ -1,0 +1,172 @@
+"""Crash reporting (pybind/mgr/crash + ceph-crash roles) and CephFS
+subvolumes (mgr/volumes role).
+
+Crash: post -> ls/info -> RECENT_CRASH health warning -> archive
+clears it -> reports survive a mon restart.  Volumes: group +
+subvolume lifecycle, getpath, usage accounting, quota intent,
+snapshots over the .snap machinery.
+"""
+
+import asyncio
+
+import pytest
+
+from cluster_helpers import Cluster
+
+from ceph_tpu.cephfs import CephFS, CephFSError
+from ceph_tpu.cephfs.volumes import VolumeClient
+from ceph_tpu.common.crash import make_report, post_crash
+from ceph_tpu.mds import MDSDaemon
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 150))
+
+
+def test_crash_post_ls_health_archive():
+    async def main():
+        cluster = Cluster(num_osds=2)
+        await cluster.start()
+        try:
+            mon = cluster.mon.addr
+            try:
+                raise RuntimeError("simulated osd abort")
+            except RuntimeError as e:
+                cid = await post_crash(mon, "osd.7", e)
+            assert cid
+            rc, out = await cluster.client.mon_command(
+                {"prefix": "crash ls"})
+            assert rc == 0
+            assert [c["crash_id"] for c in out["crashes"]] == [cid]
+            assert out["crashes"][0]["entity"] == "osd.7"
+            rc, out = await cluster.client.mon_command(
+                {"prefix": "crash info", "id": cid})
+            assert rc == 0
+            assert "simulated osd abort" in out["report"]["exception"]
+            assert any("RuntimeError" in ln
+                       for ln in out["report"]["backtrace"])
+            # health warning until archived
+            rc, health = await cluster.client.mon_command(
+                {"prefix": "health"})
+            assert "RECENT_CRASH" in health["checks"]
+            rc, _ = await cluster.client.mon_command(
+                {"prefix": "crash archive", "id": cid})
+            assert rc == 0
+            rc, health = await cluster.client.mon_command(
+                {"prefix": "health"})
+            assert "RECENT_CRASH" not in health["checks"]
+            # ls-new hides archived, ls keeps it
+            rc, out = await cluster.client.mon_command(
+                {"prefix": "crash ls-new"})
+            assert out["crashes"] == []
+            rc, out = await cluster.client.mon_command(
+                {"prefix": "crash ls"})
+            assert out["crashes"][0]["archived"] is True
+            # rm drops it
+            rc, _ = await cluster.client.mon_command(
+                {"prefix": "crash rm", "id": cid})
+            assert rc == 0
+            rc, out = await cluster.client.mon_command(
+                {"prefix": "crash ls"})
+            assert out["crashes"] == []
+        finally:
+            await cluster.stop()
+    run(main())
+
+
+def test_osd_boot_crash_posts_report(tmp_path):
+    """A real OSD process whose boot dies posts a crash report the
+    monitors list (the ceph-crash scanner role, process-level)."""
+    async def main():
+        import subprocess
+        import sys
+
+        cluster = Cluster(num_osds=1)
+        await cluster.start()
+        try:
+            bad_store = tmp_path / "notadir"
+            bad_store.write_bytes(b"i am a file, not a store dir")
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, "-m", "ceph_tpu.osd",
+                "--id", "9", "--mon", cluster.mon.addr,
+                "--store-path", str(bad_store),
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                env={"PYTHONPATH": ".", "JAX_PLATFORMS": "cpu",
+                     "PATH": "/usr/bin:/bin:/usr/local/bin"})
+            await asyncio.wait_for(proc.communicate(), 60)
+            assert proc.returncode != 0
+            rc, out = await cluster.client.mon_command(
+                {"prefix": "crash ls"})
+            assert rc == 0
+            assert any(c["entity"] == "osd.9"
+                       for c in out["crashes"]), out
+        finally:
+            await cluster.stop()
+    run(main())
+
+
+def test_crash_report_shape():
+    rep = make_report("mds.a", ValueError("boom"))
+    assert rep["entity"] == "mds.a"
+    assert "mds.a" in rep["crash_id"]
+    assert rep["exception"] == "ValueError('boom')"
+
+
+def test_volumes_lifecycle():
+    async def main():
+        cluster = Cluster(num_osds=3)
+        await cluster.start()
+        await cluster.client.create_replicated_pool("m", size=2,
+                                                    pg_num=4)
+        await cluster.client.create_replicated_pool("d", size=2,
+                                                    pg_num=4)
+        mds = MDSDaemon(cluster.mon.addr, "m", "d", name="v",
+                        lock_interval=0.3)
+        await mds.start()
+        try:
+            fs = CephFS(cluster.client, "m", "d")
+            vc = VolumeClient(fs)
+            # groups
+            await vc.group_create("apps")
+            assert await vc.group_ls() == ["apps"]
+            # subvolumes (grouped and default-group)
+            path = await vc.create("web", group="apps",
+                                   size=1 << 20)
+            assert path == "/volumes/apps/web"
+            await vc.create("scratch")
+            assert await vc.ls(group="apps") == ["web"]
+            assert await vc.ls() == ["scratch"]
+            assert await vc.getpath("web", group="apps") == path
+            with pytest.raises(CephFSError):
+                await vc.getpath("nope")
+            with pytest.raises(CephFSError):
+                await vc.create("web", group="apps")  # EEXIST
+            # usage + quota intent
+            await fs.write_file(f"{path}/blob", b"z" * 4096)
+            info = await vc.info("web", group="apps")
+            assert info["bytes_used"] == 4096
+            assert info["bytes_quota"] == 1 << 20
+            out = await vc.resize("web", 2 << 20, group="apps")
+            assert out["size"] == 2 << 20
+            with pytest.raises(CephFSError):
+                await vc.resize("web", 1 << 20, group="apps",
+                                no_shrink=True)
+            # snapshots ride the .snap machinery
+            await vc.snapshot_create("web", "s1", group="apps")
+            assert [s["name"]
+                    for s in await vc.snapshot_ls("web",
+                                                  group="apps")] \
+                == ["s1"]
+            assert await fs.read_file(
+                f"{path}/.snap/s1/blob") == b"z" * 4096
+            with pytest.raises(CephFSError):
+                await vc.rm("web", group="apps")  # has snapshots
+            await vc.snapshot_rm("web", "s1", group="apps")
+            await vc.rm("web", group="apps")
+            assert await vc.ls(group="apps") == []
+            await vc.group_rm("apps")
+            assert await vc.group_ls() == []
+        finally:
+            await mds.stop()
+            await cluster.stop()
+    run(main())
