@@ -317,7 +317,13 @@ class CephFS:
         re-discover and resend (Client session reconnect role)."""
         last: Optional[BaseException] = None
         self.mds_requests += 1
-        for attempt in range(30):
+        # EAGAIN (subtree mid-migration) has its OWN budget: the
+        # freeze can legitimately last up to the MDS's 30s export TTL
+        # plus peer timeouts, far beyond the connection-retry budget
+        eagain_left = 150  # x0.3s ~ 45s
+        attempt = 0
+        while attempt < 30:
+            attempt += 1
             rank = self._rank_of(op, args, await self._num_mds_ranks())
             if rank not in self._mds_addrs:
                 self._mds_addrs[rank] = await self._discover_mds(rank)
@@ -353,6 +359,14 @@ class CephFS:
                 if old is not None:
                     self._drop_addr_caps(old)
                 self._num_ranks = None
+                await asyncio.sleep(0.3)
+                continue
+            if reply.rc == -11 and eagain_left > 0:
+                # EAGAIN: subtree frozen (migrating) — wait it out
+                # without burning the connection-retry budget
+                eagain_left -= 1
+                attempt -= 1
+                last = CephFSError(-11, "subtree migrating")
                 await asyncio.sleep(0.3)
                 continue
             if reply.rc != 0:

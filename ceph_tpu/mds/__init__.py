@@ -48,10 +48,12 @@ Re-designs vs the reference, deliberate:
   re-drives unfinished intents.  Top-level rmdir asks the owner rank
   to adjudicate emptiness and fence creates (peer_rmdir_begin/done,
   TTL-bounded dying mark); the owner removes the dir object under its
-  own epoch.  DIRECTORY renames that would re-home a subtree return
-  EXDEV (per-rank fencing epochs are incomparable; the reference's
-  Migrator moves metadata instead — documented gap).  Clients route
-  by the same rule from the published mds_map object.
+  own epoch.  DIRECTORY renames that re-home a subtree run the
+  SUBTREE EXPORT protocol (the Migrator role, src/mds/Migrator.cc):
+  the importer rank re-creates the tree under fresh inos in its own
+  fencing domain and the exporter purges the old objects — no
+  cross-rank epoch comparison anywhere (see _export_subtree).
+  Clients route by the same rule from the published mds_map object.
 
 Layout in the metadata pool:
   mds_lock[.r]             cls_lock state + rank r's MDS addr (xattr)
@@ -98,6 +100,9 @@ ENOTEMPTY = -39
 ESTALE = -116
 
 EROFS = -30
+EAGAIN = -11
+EFBIG = -27
+EBUSY = -16
 
 ROOT_INO = 1
 LOCK_OBJ = "mds_lock"
@@ -193,6 +198,17 @@ class MDSDaemon:
         # cross-rank rename intents journaled but not yet finished
         # (crash recovery drives them to completion on takeover)
         self._pending_intents: Dict[int, Dict[str, Any]] = {}
+        # subtree exports in flight (Migrator role): intent seq ->
+        # {"intent": op, "imported": op?}; re-driven on takeover
+        self._pending_exports: Dict[int, Dict[str, Any]] = {}
+        # imports WE completed, by intent id -> new root ino (the
+        # importer-side idempotency record, rebuilt from the journal)
+        self._imports: Dict[str, int] = {}
+        # frozen subtree path prefixes (normalized) -> expiry: client
+        # mutations under them bounce EAGAIN while a dump is the
+        # authoritative copy (TTL-bounded: a crashed coordinator
+        # cannot wedge the subtree)
+        self._frozen_subtrees: Dict[str, float] = {}
         # top-level dirs another rank is removing: our creates into
         # them bounce until the mark clears or expires (peer_rmdir)
         self._dying_dirs: Dict[int, float] = {}
@@ -385,6 +401,8 @@ class MDSDaemon:
             # journaled conclusion (state must be active first — the
             # peer RPCs below go through live messengers)
             await self._finish_pending_renames()
+        if self._pending_exports:
+            await self._finish_pending_exports()
 
     async def _replay_journal(self) -> None:
         from ceph_tpu.cls.journal import ENTRY_PREFIX
@@ -402,6 +420,8 @@ class MDSDaemon:
             for k, v in omap.items() if k.startswith(ENTRY_PREFIX))
         top = applied
         pending: Dict[int, Dict[str, Any]] = {}
+        exports: Dict[int, Dict[str, Any]] = {}
+        imports: Dict[str, int] = {}
         for seq, blob in entries:
             ops = json.loads(blob.decode())
             # intent/finish pairing spans the applied watermark: an
@@ -409,15 +429,30 @@ class MDSDaemon:
             # while its finish never landed — scan ALL retained
             # entries for pairing, apply only the un-applied ones
             for op in ops:
-                if op.get("op") == "rename_intent":
+                kind = op.get("op")
+                if kind == "rename_intent":
                     pending[seq] = op
-                elif op.get("op") == "rename_finish":
+                elif kind == "rename_finish":
                     pending.pop(int(op.get("intent_seq", -1)), None)
+                elif kind == "export_intent":
+                    exports[seq] = {"intent": op}
+                elif kind == "export_imported":
+                    rec = exports.get(int(op.get("intent_seq", -1)))
+                    if rec is not None:
+                        rec["imported"] = op
+                elif kind == "export_finish":
+                    exports.pop(int(op.get("intent_seq", -1)), None)
+                elif kind == "import_done":
+                    imports[op["id"]] = int(op["root"])
+                elif kind == "import_forget":
+                    imports.pop(op.get("id", ""), None)
             if seq <= applied:
                 continue
             await self._apply_ops(ops)
             top = seq
         self._pending_intents = pending
+        self._pending_exports = exports
+        self._imports = imports
         self._seq = max(top, applied) + 1
         self._applied_mark = top
         await self.meta.execute(
@@ -504,11 +539,14 @@ class MDSDaemon:
                     if e.rc != ENOENT:
                         raise
                 self._dirs.pop(op["ino"], None)
-            elif kind in ("rename_intent", "rename_finish"):
-                # bookkeeping entries for the cross-rank rename
-                # protocol: no object mutation — replay pairs them up
-                # (_replay_journal) and _finish_pending_renames drives
-                # any unfinished intent to completion
+            elif kind in ("rename_intent", "rename_finish",
+                          "export_intent", "export_imported",
+                          "export_finish", "import_done",
+                          "import_forget"):
+                # bookkeeping entries for the cross-rank rename and
+                # subtree-export protocols: no object mutation —
+                # replay pairs them up (_replay_journal) and the
+                # takeover finishers drive unfinished ones
                 pass
             elif kind == "purgefile":
                 # a rename clobbered a file: its data objects have no
@@ -921,11 +959,29 @@ class MDSDaemon:
                     msg.tid, ESTALE,
                     {"error": "misrouted", "rank": want}))
                 return
+        if self._frozen_subtrees and msg.op in self.MUTATING_OPS:
+            # a frozen subtree is mid-export: its dump is the
+            # authoritative copy, so mutations under it must wait
+            # (EAGAIN; clients retry) — reads stay fine
+            now = time.monotonic()
+            paths = [self._norm_path(msg.args.get(k, ""))
+                     for k in ("path", "src", "dst")
+                     if msg.args.get(k)]
+            for pref, exp in list(self._frozen_subtrees.items()):
+                if exp <= now:
+                    self._frozen_subtrees.pop(pref, None)
+                    continue
+                if any(p == pref or p.startswith(pref + "/")
+                       for p in paths):
+                    await conn.send(MClientReply(
+                        msg.tid, EAGAIN,
+                        {"error": "subtree migrating; retry"}))
+                    return
         self.ops_served += 1
         try:
             if msg.op in ("lookup", "readdir", "stat", "readlink",
                           "peer_revoke", "rename", "rmdir", "lssnap",
-                          "peer_snap_refresh"):
+                          "peer_snap_refresh", "peer_subtree_thaw"):
                 # reads are lock-free; rename/rmdir manage their own
                 # locking (they must release it around peer RPCs);
                 # peer_revoke must never wait on the mutation lock
@@ -1182,15 +1238,27 @@ class MDSDaemon:
         src journal; takeover re-drives it (peer_link is idempotent).
 
         DIRECTORY renames that would RE-HOME a subtree (src and dst
-        top-level hashes differ) return EXDEV: per-rank fencing epochs
-        are incomparable, so migrating object ownership across ranks
-        is not supported — callers fall back to copy+delete exactly as
-        they do for rename(2) across filesystems.  (The reference's
-        Migrator moves the metadata instead; documented gap.)"""
+        top-level hashes differ) run the SUBTREE EXPORT protocol
+        (_export_subtree — the Migrator role): the importer rank
+        re-creates the tree under fresh inos in its own fencing
+        domain, so no cross-rank epoch comparison ever happens."""
         src_parts = [p for p in args["src"].split("/") if p]
         dst_parts = [p for p in args["dst"].split("/") if p]
         if not src_parts or not dst_parts:
             return EINVAL, {}
+        if self.num_ranks > 1 and \
+                self._subtree_rank(src_parts[0]) != \
+                self._subtree_rank(dst_parts[0]):
+            # re-homing applies only to DIRECTORY renames: peek at the
+            # src type (lock-free read; _export_subtree re-validates
+            # under the lock and bounces ESTALE on a race)
+            try:
+                _p, _n, peek = await self._resolve(args["src"])
+            except MDSError as e:
+                return e.rc, {}
+            if peek is not None and peek.get("type") == "dir":
+                return await self._export_subtree(args, src_parts,
+                                                  dst_parts)
         dst_rank = owner_rank(args["dst"], self.num_ranks)
         if self.num_ranks > 1 and dst_rank != self.rank:
             return await self._rename_cross_rank(args, dst_rank,
@@ -1232,8 +1300,9 @@ class MDSDaemon:
         if inode["type"] == "dir" and self.num_ranks > 1:
             sub, ok = self._dir_move_ranks(src_parts, dst_parts, True)
             if ok is None:
-                return EXDEV, {"error": "directory rename would"
-                                        " re-home its subtree"}
+                # the src became a dir after _op_rename's peek: a
+                # retry takes the subtree-export path
+                return ESTALE, {"error": "src changed; retry"}
             if sub != self.rank:
                 # paths under the moved dir are served by rank `sub`:
                 # its clients' path caches (and its path-keyed state)
@@ -1301,8 +1370,9 @@ class MDSDaemon:
                 sub, ok = self._dir_move_ranks(src_parts, dst_parts,
                                                True)
                 if ok is None:
-                    return EXDEV, {"error": "directory rename would"
-                                            " re-home its subtree"}
+                    # raced into a dir post-peek: retry re-routes to
+                    # the subtree-export path
+                    return ESTALE, {"error": "src changed; retry"}
             flush = await self._revoke_caps(inode["ino"])
             if flush.get("size_max") is not None:
                 inode["size"] = max(inode.get("size", 0),
@@ -1402,6 +1472,374 @@ class MDSDaemon:
                 ops.insert(0, self._dentry(src_dir, src_name, None))
             await self._commit(ops)
         self._pending_intents.clear()
+
+    # -- subtree migration (Migrator/MExportDir role) ----------------------
+    #
+    # A directory rename whose src and dst top-level components hash
+    # to different ranks RE-HOMES the subtree.  Per-rank fencing
+    # epochs are incomparable, so ownership of the existing dir
+    # OBJECTS cannot move — instead, like the reference's Migrator
+    # (/root/reference/src/mds/Migrator.cc: EXPORT serializes the
+    # subtree metadata and the importer re-journals it as its own),
+    # the importer re-creates the subtree under FRESH inos in its own
+    # fencing domain and the exporter purges the old objects:
+    #
+    #   A (owner of the src dentry) journals export_intent
+    #   S (subtree rank) dumps the tree and FREEZES it (EAGAIN
+    #     to mutations under the prefix, TTL-bounded)
+    #   T (new subtree rank) allocates new inos, rewrites dir
+    #     entries (dir children remapped, file inos unchanged — data
+    #     objects never move), journals ONE import entry
+    #   D (owner of the dst dentry) links dst -> new root (peer_link)
+    #   A removes the src dentry, S purges the old dir objects
+    #     (snap-context aware: snapshots... see the EBUSY guard),
+    #   A journals export_finish.
+    #
+    # Crash at any point re-drives from the journal: import is
+    # idempotent (intent-id keyed), link is idempotent, purge is
+    # ENOENT-tolerant.  Deposed-active writes stay harmless with NO
+    # cross-rank epoch comparison: stale writes can only touch the
+    # OLD objects (garbage awaiting purge) or A's own chain (same-
+    # rank fencing).  Subtrees referenced by CephFS snapshots refuse
+    # to migrate (EBUSY): snapshot resolution keys dirs by ino and
+    # the re-created tree has new inos.
+
+    EXPORT_FREEZE_TTL = 30.0
+    EXPORT_MAX_DIRS = 2048
+    MUTATING_OPS = frozenset((
+        "mkdir", "create", "symlink", "unlink", "rmdir", "rename",
+        "setattr", "mksnap", "rmsnap"))
+
+    @staticmethod
+    def _norm_path(path: str) -> str:
+        return "/" + "/".join(p for p in path.split("/") if p)
+
+    async def _export_subtree(self, args, src_parts, dst_parts
+                              ) -> Tuple[int, Dict[str, Any]]:
+        src_path = self._norm_path(args["src"])
+        dst_path = self._norm_path(args["dst"])
+        if dst_path == src_path or \
+                dst_path.startswith(src_path + "/"):
+            return EINVAL, {"error": "dst inside the moved subtree"}
+        async with self._mutation_lock:
+            src_parent, src_name, inode = await self._resolve(
+                src_path)
+            if inode is None:
+                return ENOENT, {}
+            if inode["type"] != "dir":
+                # raced to a non-dir: the ordinary rename paths apply
+                return ESTALE, {"error": "src changed; retry"}
+            _dp, dn, existing = await self._resolve(dst_path)
+            if not dn:
+                return EINVAL, {}
+            if existing is not None:
+                # migration does not clobber (the reference freezes
+                # only clean exports too); callers remove dst first
+                return EEXIST, {"error": "dst exists"}
+            intent_id = f"x{self.rank}.{self._epoch}.{self._seq}"
+            seq = await self._commit([{
+                "op": "export_intent", "id": intent_id,
+                "src_dir": src_parent, "src_name": src_name,
+                "src": src_path, "dst": dst_path, "inode": inode}])
+            intent = {
+                "seq": seq, "src_dir": src_parent,
+                "src_name": src_name, "src": src_path,
+                "dst": dst_path, "inode": inode, "id": intent_id}
+        # freeze HERE too: ops on the src dentry itself (rename/
+        # rmdir/mksnap of a TOP-LEVEL dir) route to the dentry owner
+        # — this rank — not the subtree rank, and must bounce while
+        # the export runs
+        self._frozen_subtrees[src_path] = \
+            time.monotonic() + self.EXPORT_FREEZE_TTL
+        return await self._export_drive(intent)
+
+    async def _export_drive(self, it: Dict[str, Any]
+                            ) -> Tuple[int, Dict[str, Any]]:
+        """Drive one export intent end to end (initial run and
+        takeover re-drive share this).  NEVER called holding the
+        mutation lock (peer RPCs inside)."""
+        seq = it["seq"]
+        inode = it["inode"]
+        src_parts = [p for p in it["src"].split("/") if p]
+        dst_parts = [p for p in it["dst"].split("/") if p]
+        s_rank = self._subtree_rank(src_parts[0])
+        t_rank = self._subtree_rank(dst_parts[0])
+        d_rank = owner_rank(it["dst"], self.num_ranks)
+        # every rank's clients hold soon-stale paths: recall all caps
+        for r in range(self.num_ranks):
+            try:
+                if r == self.rank:
+                    for fl in await self._revoke_all_caps():
+                        await self._apply_flush(fl,
+                                                fl.get("path", ""))
+                else:
+                    await self._peer_request(
+                        r, "peer_revoke", {"revoke_all": True})
+            except (RadosError, ObjectNotFound, ConnectionError,
+                    OSError, asyncio.TimeoutError):
+                return ESTALE, {"error": f"rank {r} unavailable"}
+        # dump + freeze at the subtree rank
+        rc, out = await self._peer_call(
+            s_rank, "peer_subtree_dump",
+            {"root": inode["ino"], "prefix": it["src"],
+             "max_dirs": self.EXPORT_MAX_DIRS})
+        if rc != 0:
+            await self._close_export(seq, it["src"])
+            return rc, out
+        dirs = out["dirs"]
+        old_inos = [d["ino"] for d in dirs]
+        # import at the new subtree rank (idempotent by intent id)
+        rc, iout = await self._peer_call(
+            t_rank, "peer_subtree_import",
+            {"id": it["id"], "dirs": dirs, "root": inode["ino"]})
+        if rc != 0:
+            await self._peer_call(s_rank, "peer_subtree_thaw",
+                                  {"prefix": it["src"]})
+            await self._close_export(seq, it["src"])
+            return rc, iout
+        new_root = int(iout["root"])
+        async with self._mutation_lock:
+            await self._commit([{
+                "op": "export_imported", "intent_seq": seq,
+                "id": it["id"], "old_inos": old_inos,
+                "new_root": new_root,
+                "created": list(iout.get("created", []))}])
+        return await self._export_finish_phase(
+            seq, it, old_inos, new_root, s_rank, d_rank)
+
+    async def _export_finish_phase(self, seq: int, it: Dict[str, Any],
+                                   old_inos, new_root: int,
+                                   s_rank: int, d_rank: int
+                                   ) -> Tuple[int, Dict[str, Any]]:
+        new_inode = dict(it["inode"], ino=new_root)
+        rc, out = await self._peer_call(
+            d_rank, "peer_link", {"dst": it["dst"],
+                                  "inode": new_inode})
+        if rc != 0:
+            # dst raced into existence: leave the intent open (a
+            # takeover retries once the conflict clears) — the new
+            # objects are unreachable garbage until then.  Thaw: the
+            # re-drive DISCARDS the stale import and re-dumps, so the
+            # src must stay usable.
+            await self._peer_call(s_rank, "peer_subtree_thaw",
+                                  {"prefix": it["src"]})
+            self._frozen_subtrees.pop(it["src"], None)
+            return rc, out
+        async with self._mutation_lock:
+            try:
+                cur = (await self._load_dir(it["src_dir"])).get(
+                    it["src_name"])
+            except MDSError:
+                cur = None
+            if cur is not None and \
+                    cur.get("ino") == it["inode"]["ino"]:
+                await self._commit([self._dentry(
+                    it["src_dir"], it["src_name"], None)])
+        rc, _pout = await self._peer_call(
+            s_rank, "peer_subtree_purge",
+            {"inos": old_inos, "prefix": it["src"]})
+        self._frozen_subtrees.pop(it["src"], None)
+        if rc != 0:
+            # old objects linger; the intent stays open so a takeover
+            # re-purges (idempotent).  The rename itself is complete.
+            log.warning("mds.%s: export purge at rank %d failed;"
+                        " will re-drive", self.name, s_rank)
+            return 0, {"inode": new_inode}
+        await self._close_export(seq)
+        return 0, {"inode": new_inode}
+
+    async def _close_export(self, seq: int,
+                            src_path: Optional[str] = None) -> None:
+        async with self._mutation_lock:
+            await self._commit([{"op": "export_finish",
+                                 "intent_seq": seq}])
+        self._pending_exports.pop(seq, None)
+        if src_path is not None:
+            self._frozen_subtrees.pop(src_path, None)
+
+    async def _peer_call(self, rank: int, op: str, args: dict
+                         ) -> Tuple[int, Dict[str, Any]]:
+        """peer_request that treats self-rank uniformly (the local
+        fastpath makes a self-RPC cheap) and folds transport errors
+        into ESTALE."""
+        try:
+            return await self._peer_request(rank, op, args,
+                                            timeout=20.0)
+        except (RadosError, ObjectNotFound, ConnectionError, OSError,
+                asyncio.TimeoutError):
+            return ESTALE, {"error": f"rank {rank} unreachable"}
+
+    async def _finish_pending_exports(self) -> None:
+        """Takeover: re-drive every journaled export_intent without a
+        matching export_finish."""
+        for seq, rec in sorted(self._pending_exports.items()):
+            op = rec["intent"]
+            it = {"seq": seq, "src_dir": op["src_dir"],
+                  "src_name": op["src_name"], "src": op["src"],
+                  "dst": op["dst"], "inode": op["inode"],
+                  "id": op["id"]}
+            try:
+                if "imported" in rec:
+                    await self._redrive_imported(seq, it,
+                                                 rec["imported"])
+                else:
+                    # not imported yet: if the src dentry still names
+                    # the old ino, redo the whole drive; else the
+                    # export never really started — close it
+                    try:
+                        cur = (await self._load_dir(
+                            it["src_dir"])).get(it["src_name"])
+                    except MDSError:
+                        cur = None
+                    if cur is not None and \
+                            cur.get("ino") == it["inode"]["ino"]:
+                        await self._export_drive(it)
+                    else:
+                        await self._close_export(seq, it["src"])
+            except Exception:
+                log.exception("mds.%s: export re-drive %d failed;"
+                              " left pending", self.name, seq)
+
+    async def _redrive_imported(self, seq: int, it: Dict[str, Any],
+                                imp: Dict[str, Any]) -> None:
+        """Re-drive an export that crashed after the import.  The
+        imported copy may be STALE: if the source thawed and took
+        mutations since, re-linking it would silently discard them —
+        so the copy is only finished when the dst link already
+        LANDED; otherwise it is discarded and the export redone from
+        a fresh dump."""
+        src_parts = [p for p in it["src"].split("/") if p]
+        dst_parts = [p for p in it["dst"].split("/") if p]
+        s_rank = self._subtree_rank(src_parts[0])
+        t_rank = self._subtree_rank(dst_parts[0])
+        d_rank = owner_rank(it["dst"], self.num_ranks)
+        new_root = int(imp["new_root"])
+        try:
+            _dp, _dn, dst_cur = await self._resolve(it["dst"])
+        except MDSError:
+            dst_cur = None
+        if dst_cur is not None and dst_cur.get("ino") == new_root:
+            # the link landed before the crash: the imported tree IS
+            # the live one — finish (src unlink + old purge)
+            await self._export_finish_phase(
+                seq, it, imp["old_inos"], new_root, s_rank, d_rank)
+            return
+        # link never landed: the imported copy is unreachable and
+        # possibly stale — discard it (purge the new objects, drop
+        # the importer's idempotency record)
+        await self._peer_call(
+            t_rank, "peer_subtree_forget",
+            {"id": it["id"], "inos": list(imp.get("created", []))})
+        try:
+            cur = (await self._load_dir(it["src_dir"])).get(
+                it["src_name"])
+        except MDSError:
+            cur = None
+        if cur is not None and cur.get("ino") == it["inode"]["ino"]:
+            # source intact: redo the export under a FRESH intent id
+            # (the forget dropped the old id's record)
+            it = dict(it, id=it["id"] + f".r{self._epoch}")
+            await self._export_drive(it)
+        else:
+            # source moved on (post-thaw user activity): the export
+            # is moot
+            await self._close_export(seq, it["src"])
+
+    async def _op_peer_subtree_dump(self, args, conn=None
+                                    ) -> Tuple[int, Dict[str, Any]]:
+        """Subtree-rank half: serialize the tree (the MExportDir
+        payload role) and freeze the prefix.  Runs under OUR mutation
+        lock, so the dump is a consistent cut."""
+        root = int(args["root"])
+        max_dirs = int(args.get("max_dirs", self.EXPORT_MAX_DIRS))
+        out_dirs: List[Dict[str, Any]] = []
+        todo = [root]
+        while todo:
+            if len(out_dirs) >= max_dirs:
+                return EFBIG, {"error": "subtree too large to"
+                                        " migrate"}
+            ino = todo.pop()
+            try:
+                entries = await self._load_dir(ino, owned=True)
+            except MDSError:
+                entries = {}  # half-created dir object: export empty
+            out_dirs.append({"ino": ino, "entries": entries})
+            todo.extend(e["ino"] for e in entries.values()
+                        if e.get("type") == "dir")
+        # snapshots key dirs by ino; a migrated (re-inoed) subtree
+        # would orphan them — refuse BEFORE freezing
+        self._snap_invalidate()
+        recs = await self._snap_records()
+        inos = {d["ino"] for d in out_dirs}
+        if any(r["ino"] in inos for r in recs.values()):
+            return EBUSY, {"error": "subtree has snapshots"}
+        self._frozen_subtrees[self._norm_path(args["prefix"])] = \
+            time.monotonic() + self.EXPORT_FREEZE_TTL
+        return 0, {"dirs": out_dirs}
+
+    async def _op_peer_subtree_thaw(self, args, conn=None
+                                    ) -> Tuple[int, Dict[str, Any]]:
+        """Abort path: release the freeze early (lock-free — pure
+        in-memory state; the TTL is the backstop)."""
+        self._frozen_subtrees.pop(
+            self._norm_path(args.get("prefix", "")), None)
+        return 0, {}
+
+    async def _op_peer_subtree_import(self, args, conn=None
+                                      ) -> Tuple[int, Dict[str, Any]]:
+        """New-subtree-rank half: re-create the dirs under fresh inos
+        in OUR fencing domain (the importer re-journals the metadata
+        as its own — Migrator.cc import).  Idempotent by intent id."""
+        intent = args["id"]
+        if intent in self._imports:
+            return 0, {"root": self._imports[intent]}
+        dirs = args["dirs"]
+        mapping = {int(d["ino"]): await self._alloc_ino()
+                   for d in dirs}
+        ops: List[Dict[str, Any]] = []
+        for d in dirs:
+            new_ino = mapping[int(d["ino"])]
+            ops.append({"op": "mkdirobj", "ino": new_ino})
+            for name, ent in d["entries"].items():
+                ent = dict(ent)
+                if ent.get("type") == "dir":
+                    ent["ino"] = mapping.get(int(ent["ino"]),
+                                             ent["ino"])
+                ops.append(self._dentry(new_ino, name, ent))
+        root_new = mapping[int(args["root"])]
+        ops.append({"op": "import_done", "id": intent,
+                    "root": root_new})
+        await self._commit(ops)
+        self._imports[intent] = root_new
+        return 0, {"root": root_new,
+                   "created": sorted(mapping.values())}
+
+    async def _op_peer_subtree_forget(self, args, conn=None
+                                      ) -> Tuple[int, Dict[str, Any]]:
+        """Discard a stale import: remove the created (never-linked)
+        dir objects and drop the idempotency record, so the
+        coordinator's re-drive can import a FRESH dump.  The forget
+        is journaled — a takeover must not resurrect the record."""
+        intent = args.get("id", "")
+        ops = [{"op": "rmdirobj", "ino": int(i)}
+               for i in args.get("inos", [])]
+        ops.append({"op": "import_forget", "id": intent})
+        await self._commit(ops)
+        self._imports.pop(intent, None)
+        return 0, {}
+
+    async def _op_peer_subtree_purge(self, args, conn=None
+                                     ) -> Tuple[int, Dict[str, Any]]:
+        """Subtree-rank half: drop the exported (now garbage) dir
+        objects and thaw the prefix.  guarded_remove is fenced by OUR
+        chain and tolerant of already-gone objects."""
+        ops = [{"op": "rmdirobj", "ino": int(i)}
+               for i in args.get("inos", [])]
+        if ops:
+            await self._commit(ops)
+        self._frozen_subtrees.pop(
+            self._norm_path(args.get("prefix", "")), None)
+        return 0, {}
 
     async def _op_peer_link(self, args,
                             conn=None) -> Tuple[int, Dict[str, Any]]:

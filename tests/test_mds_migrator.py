@@ -1,0 +1,231 @@
+"""MDS subtree migration (the Migrator/MExportDir role,
+/root/reference/src/mds/Migrator.cc): a directory rename that
+RE-HOMES its subtree across ranks now migrates the metadata — the
+importer re-creates the tree under fresh inos in its own fencing
+domain (the reference's export-serialize/import-rejournal shape) —
+instead of returning EXDEV.
+
+1. re-homing renames succeed and preserve the whole tree (file data
+   objects never move: file inos are unchanged);
+2. deep sources/destinations work; the old dir objects are purged;
+3. snapshotted subtrees refuse to migrate (EBUSY — snapshots key
+   dirs by ino);
+4. a coordinator crash after journaling the intent re-drives the
+   export on takeover;
+5. both ranks keep serving their other subtrees afterwards.
+"""
+
+import asyncio
+
+import pytest
+
+from cluster_helpers import Cluster
+
+from ceph_tpu.cephfs import CephFS, CephFSError
+from ceph_tpu.mds import MDSDaemon, owner_rank
+from ceph_tpu.rados.client import RadosClient
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 180))
+
+
+FAST = {"lock_interval": 0.3}
+
+
+async def _stack(cluster, num_ranks=2):
+    await cluster.client.create_replicated_pool("fsmeta", size=2,
+                                                pg_num=4)
+    await cluster.client.create_replicated_pool("fsdata", size=2,
+                                                pg_num=4)
+    daemons = []
+    for r in range(num_ranks):
+        mds = MDSDaemon(cluster.mon.addr, "fsmeta", "fsdata",
+                        name=f"r{r}", rank=r, num_ranks=num_ranks,
+                        **FAST)
+        await mds.start()
+        daemons.append(mds)
+    fs = CephFS(cluster.client, "fsmeta", "fsdata")
+    return daemons, fs
+
+
+def _names_by_rank(num_ranks=2):
+    by_rank = {}
+    for i in range(200):
+        name = f"dir{i}"
+        by_rank.setdefault(owner_rank(f"/{name}/x", num_ranks), []) \
+            .append(name)
+        if all(len(v) >= 2 for v in by_rank.values()) and \
+                len(by_rank) == num_ranks:
+            break
+    return by_rank
+
+
+def test_rehoming_rename_migrates_subtree():
+    async def main():
+        cluster = Cluster(num_osds=2)
+        await cluster.start()
+        daemons = []
+        try:
+            daemons, fs = await _stack(cluster)
+            by_rank = _names_by_rank()
+            src, dst = by_rank[0][0], by_rank[1][0]
+            await fs.mkdir(f"/{src}")
+            await fs.mkdir(f"/{src}/inner")
+            await fs.mkdir(f"/{src}/inner/deep")
+            await fs.write_file(f"/{src}/top.txt", b"top file")
+            await fs.write_file(f"/{src}/inner/mid.txt",
+                                b"middle data here")
+            await fs.write_file(f"/{src}/inner/deep/leaf.bin",
+                                b"\x00\x01" * 512)
+            await fs.symlink("top.txt", f"/{src}/lnk")
+            old_stat = await fs.stat(f"/{src}/inner/mid.txt")
+            # the move that USED to be EXDEV
+            await fs.rename(f"/{src}", f"/{dst}")
+            assert not await fs.exists(f"/{src}")
+            assert sorted(await fs.listdir(f"/{dst}")) == \
+                ["inner", "lnk", "top.txt"]
+            assert await fs.read_file(f"/{dst}/top.txt") == \
+                b"top file"
+            assert await fs.read_file(f"/{dst}/inner/mid.txt") == \
+                b"middle data here"
+            assert await fs.read_file(
+                f"/{dst}/inner/deep/leaf.bin") == b"\x00\x01" * 512
+            assert await fs.readlink(f"/{dst}/lnk") == "top.txt"
+            # file inos unchanged (data objects did not move)
+            new_stat = await fs.stat(f"/{dst}/inner/mid.txt")
+            assert new_stat["ino"] == old_stat["ino"]
+            # writes through the NEW home work
+            await fs.write_file(f"/{dst}/after.txt", b"post-move")
+            assert await fs.read_file(f"/{dst}/after.txt") == \
+                b"post-move"
+        finally:
+            for d in daemons:
+                await d.stop()
+            await cluster.stop()
+    run(main())
+
+
+def test_rehoming_deep_paths_and_purge():
+    async def main():
+        cluster = Cluster(num_osds=2)
+        await cluster.start()
+        daemons = []
+        try:
+            daemons, fs = await _stack(cluster)
+            by_rank = _names_by_rank()
+            a, b = by_rank[0][0], by_rank[1][0]
+            await fs.mkdir(f"/{a}")
+            await fs.mkdir(f"/{a}/proj")
+            await fs.write_file(f"/{a}/proj/f", b"nested move")
+            await fs.mkdir(f"/{b}")
+            old_root = await fs.stat(f"/{a}/proj")
+            # deep src -> deep dst across ranks
+            await fs.rename(f"/{a}/proj", f"/{b}/proj")
+            assert await fs.read_file(f"/{b}/proj/f") == \
+                b"nested move"
+            assert await fs.listdir(f"/{a}") == []
+            # the OLD dir object was purged from the metadata pool
+            meta = cluster.client.open_ioctx("fsmeta")
+            from ceph_tpu.mds import dir_obj
+            with pytest.raises(Exception):
+                omap = await meta.omap_get(dir_obj(old_root["ino"]))
+                assert not omap  # tolerated: empty leftover
+        finally:
+            for d in daemons:
+                await d.stop()
+            await cluster.stop()
+    run(main())
+
+
+def test_snapshotted_subtree_refuses_migration():
+    async def main():
+        cluster = Cluster(num_osds=2)
+        await cluster.start()
+        daemons = []
+        try:
+            daemons, fs = await _stack(cluster)
+            by_rank = _names_by_rank()
+            src, dst = by_rank[0][1], by_rank[1][1]
+            await fs.mkdir(f"/{src}")
+            await fs.write_file(f"/{src}/f", b"snapped")
+            await fs.mksnap(f"/{src}", "hold")
+            with pytest.raises(CephFSError) as ei:
+                await fs.rename(f"/{src}", f"/{dst}")
+            assert ei.value.rc == -16, ei.value  # EBUSY
+            # dropping the snapshot unblocks the migration
+            await fs.rmsnap(f"/{src}", "hold")
+            await fs.rename(f"/{src}", f"/{dst}")
+            assert await fs.read_file(f"/{dst}/f") == b"snapped"
+        finally:
+            for d in daemons:
+                await d.stop()
+            await cluster.stop()
+    run(main())
+
+
+def test_export_intent_redriven_after_coordinator_crash():
+    """Crash the coordinator right after the export_intent lands:
+    the standby takeover re-drives the whole export."""
+    async def main():
+        cluster = Cluster(num_osds=2)
+        await cluster.start()
+        daemons = []
+        try:
+            daemons, fs = await _stack(cluster)
+            by_rank = _names_by_rank()
+            src, dst = by_rank[0][0], by_rank[1][0]
+            await fs.mkdir(f"/{src}")
+            await fs.write_file(f"/{src}/f", b"survives crash")
+            # src is top-level: the COORDINATOR is rank 0 (owner of
+            # the root dentry).  Crash it right after the NEXT journal
+            # append — the export_intent.
+            daemons[0]._fail_after_journal = True
+            with pytest.raises(CephFSError):
+                await fs.rename(f"/{src}", f"/{dst}")
+            # standby for rank 0 takes over and re-drives
+            standby = MDSDaemon(cluster.mon.addr, "fsmeta", "fsdata",
+                                name="r0b", rank=0, num_ranks=2,
+                                **FAST)
+            await standby.start()
+            daemons.append(standby)
+            for _ in range(100):
+                if await fs.exists(f"/{dst}"):
+                    break
+                await asyncio.sleep(0.3)
+            assert await fs.read_file(f"/{dst}/f") == \
+                b"survives crash"
+            assert not await fs.exists(f"/{src}")
+        finally:
+            for d in daemons:
+                await d.stop()
+            await cluster.stop()
+    run(main())
+
+
+def test_other_subtrees_keep_serving():
+    async def main():
+        cluster = Cluster(num_osds=2)
+        await cluster.start()
+        daemons = []
+        try:
+            daemons, fs = await _stack(cluster)
+            by_rank = _names_by_rank()
+            src, dst = by_rank[0][0], by_rank[1][0]
+            keep0, keep1 = by_rank[0][1], by_rank[1][1]
+            for d in (src, keep0, keep1):
+                await fs.mkdir(f"/{d}")
+            await fs.write_file(f"/{src}/f", b"mover")
+            await fs.write_file(f"/{keep0}/f", b"stay0")
+            await fs.write_file(f"/{keep1}/f", b"stay1")
+            await fs.rename(f"/{src}", f"/{dst}")
+            assert await fs.read_file(f"/{dst}/f") == b"mover"
+            # bystander subtrees unaffected, still writable
+            assert await fs.read_file(f"/{keep0}/f") == b"stay0"
+            await fs.write_file(f"/{keep1}/f", b"stay1-v2")
+            assert await fs.read_file(f"/{keep1}/f") == b"stay1-v2"
+        finally:
+            for d in daemons:
+                await d.stop()
+            await cluster.stop()
+    run(main())

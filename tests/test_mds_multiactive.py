@@ -170,12 +170,12 @@ def test_rank_standby_takeover():
 
 
 def test_rehoming_dir_rename_is_exdev():
-    """A directory rename that would move its subtree to a different
-    rank returns EXDEV (per-rank fencing epochs are incomparable;
-    callers fall back to copy+delete as for cross-fs rename(2))."""
+    """Historically a re-homing directory rename returned EXDEV;
+    it now MIGRATES the subtree (the Migrator role — full coverage
+    in test_mds_migrator.py).  This test keeps the surrounding
+    invariants: file renames across ranks and hash-stable dir
+    renames behave as before."""
     async def main():
-        from ceph_tpu.cephfs import CephFSError
-
         cluster = Cluster(num_osds=2)
         await cluster.start()
         daemons = []
@@ -186,12 +186,10 @@ def test_rehoming_dir_rename_is_exdev():
             await fs.mkdir(f"/{d1}")
             await fs.mkdir(f"/{d0}/inner")
             await fs.write_file(f"/{d0}/inner/f", b"stay")
-            try:
-                await fs.rename(f"/{d0}/inner", f"/{d1}/moved")
-                assert False, "re-homing dir rename must fail"
-            except CephFSError as e:
-                assert e.rc == -18, e  # EXDEV
-            # contents untouched
+            # the re-homing rename now migrates instead of EXDEV
+            await fs.rename(f"/{d0}/inner", f"/{d1}/moved")
+            assert await fs.read_file(f"/{d1}/moved/f") == b"stay"
+            await fs.rename(f"/{d1}/moved", f"/{d0}/inner")
             assert await fs.read_file(f"/{d0}/inner/f") == b"stay"
             # FILE renames across the same ranks still work
             await fs.rename(f"/{d0}/inner/f", f"/{d1}/f")
