@@ -52,11 +52,13 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
 from typing import Any, Dict, List, Optional
 
 from ceph_tpu.rados.client import IoCtx, ObjectNotFound, RadosError
 
 RBD_DIRECTORY = "rbd_directory"
+RBD_TRASH = "rbd_trash"
 DEFAULT_ORDER = 22  # 4 MiB objects, the reference default
 
 
@@ -225,6 +227,23 @@ class RBD:
             raise RadosError(-39, "image has snapshots")  # ENOTEMPTY
         if img.meta.get("children"):
             raise RadosError(-39, "image has dependent clones")
+        await self._destroy(ioctx, img)
+        try:
+            # value-checked: if a concurrent create already reclaimed
+            # the name with a fresh id, its claim must survive
+            await ioctx.execute(
+                RBD_DIRECTORY, "dir", "remove",
+                json.dumps({"key": f"name_{name}",
+                            "value": image_id}).encode())
+        except RadosError:
+            pass
+
+    @staticmethod
+    async def _destroy(ioctx: IoCtx, img: "Image") -> None:
+        """Delete an image's data/map/journal/header (shared by
+        remove() and trash_rm(); directory/trash bookkeeping is the
+        caller's)."""
+        image_id = img.id
         objects = (img.size() + img.object_size - 1) // img.object_size
         todo = range(objects)
         if img._om_enabled():
@@ -243,15 +262,133 @@ class RBD:
         if parent is not None:
             await img._deregister_child()
         await _ignore_enoent(ioctx.remove(_header(image_id)))
+
+    # -- trash (librbd api/Trash.cc role) ----------------------------------
+    #
+    # `rbd trash mv` detaches the NAME (the image becomes invisible to
+    # open/ls) but keeps every object; restore re-claims a name, rm
+    # destroys for real once the deferment window has passed.  The
+    # safety property: an accidental delete is reversible until purge.
+
+    async def trash_mv(self, ioctx: IoCtx, name: str,
+                       delay: float = 0.0) -> str:
+        directory = await self._dir(ioctx)
+        image_id = directory.get(name)
+        if image_id is None:
+            raise ObjectNotFound(-2, name)
+        img = await self.open(ioctx, name)
         try:
-            # value-checked: if a concurrent create already reclaimed
-            # the name with a fresh id, its claim must survive
+            if img.meta.get("children"):
+                raise RadosError(-39, "image has dependent clones")
+            if img.meta.get("migration"):
+                raise RadosError(-16, "image is migrating")  # EBUSY
+        finally:
+            # the open may have acquired the exclusive lock (journal
+            # replay); never leak it past the mv
+            await img.close()
+        now = time.time()
+        # trash entry FIRST, then drop the name: a crash in between
+        # leaves the image findable in BOTH (restore converges);
+        # the reverse order would leave it findable in NEITHER
+        await ioctx.omap_set(RBD_TRASH, {image_id: json.dumps({
+            "name": name, "moved_at": now,
+            "deferment_end": now + max(0.0, delay)}).encode()})
+        try:
             await ioctx.execute(
                 RBD_DIRECTORY, "dir", "remove",
                 json.dumps({"key": f"name_{name}",
                             "value": image_id}).encode())
         except RadosError:
-            pass
+            pass  # name already re-claimed: trash entry still valid
+        return image_id
+
+    async def trash_ls(self, ioctx: IoCtx) -> List[Dict[str, Any]]:
+        try:
+            omap = await ioctx.omap_get(RBD_TRASH)
+        except ObjectNotFound:
+            return []
+        out = []
+        for image_id, raw in sorted(omap.items()):
+            doc = json.loads(raw.decode())
+            out.append(dict(doc, id=image_id))
+        return out
+
+    async def _trash_entry(self, ioctx: IoCtx,
+                           image_id: str) -> Dict[str, Any]:
+        try:
+            omap = await ioctx.omap_get(RBD_TRASH)
+        except ObjectNotFound:
+            omap = {}
+        raw = omap.get(image_id)
+        if raw is None:
+            raise ObjectNotFound(-2, f"no trash entry {image_id}")
+        return json.loads(raw.decode())
+
+    async def trash_restore(self, ioctx: IoCtx, image_id: str,
+                            new_name: Optional[str] = None) -> str:
+        doc = await self._trash_entry(ioctx, image_id)
+        name = new_name or doc["name"]
+        try:
+            await ioctx.execute(
+                RBD_DIRECTORY, "dir", "add",
+                json.dumps({"key": f"name_{name}",
+                            "value": image_id}).encode())
+        except RadosError:
+            # trash_mv's crash window leaves the image findable in
+            # BOTH the directory and the trash; if the existing claim
+            # already maps this exact id, restore just converges
+            if (await self._dir(ioctx)).get(name) != image_id:
+                raise RadosError(-17, f"name {name!r} is taken")
+        await ioctx.omap_rm_keys(RBD_TRASH, [image_id])
+        return name
+
+    async def trash_rm(self, ioctx: IoCtx, image_id: str,
+                       force: bool = False) -> None:
+        doc = await self._trash_entry(ioctx, image_id)
+        await self._trash_rm_doc(ioctx, image_id, doc, force)
+
+    async def _trash_rm_doc(self, ioctx: IoCtx, image_id: str,
+                            doc: Dict[str, Any],
+                            force: bool) -> None:
+        if not force and time.time() < doc.get("deferment_end", 0):
+            raise RadosError(
+                -1, "deferment window has not passed"
+                    " (use force)")  # EPERM
+        img = Image(ioctx, doc["name"], image_id)
+        try:
+            await img.refresh()
+        except ObjectNotFound:
+            # a prior trash_rm crashed after destroying the header:
+            # the entry is the only leftover — drop it and converge
+            await ioctx.omap_rm_keys(RBD_TRASH, [image_id])
+            return
+        if img.meta.get("children"):
+            raise RadosError(-39, "image has dependent clones")
+        for snap_name, snap in list(img.meta["snaps"].items()):
+            if snap.get("protected"):
+                raise RadosError(-16,
+                                 f"snap {snap_name!r} is protected")
+        for snap_name in list(img.meta["snaps"]):
+            await img.snap_remove(snap_name)
+        await self._destroy(ioctx, img)
+        await ioctx.omap_rm_keys(RBD_TRASH, [image_id])
+
+    async def trash_purge(self, ioctx: IoCtx) -> int:
+        """Destroy every entry whose deferment has expired; returns
+        how many were reclaimed."""
+        n = 0
+        for entry in await self.trash_ls(ioctx):
+            if time.time() < entry.get("deferment_end", 0):
+                continue
+            try:
+                await self._trash_rm_doc(ioctx, entry["id"], entry,
+                                         force=False)
+                n += 1
+            except RadosError as e:
+                if e.rc not in (-16, -39):
+                    raise  # real I/O failure — surface it
+                continue  # protected snaps / clones: left in trash
+        return n
 
     async def list(self, ioctx: IoCtx) -> List[str]:
         return sorted(await self._dir(ioctx))

@@ -191,6 +191,33 @@ async def _dispatch(client, ioctx, rbd: RBD, args) -> int:
         return 0
     if cmd == "bench":
         return await _bench(ioctx, rbd, args)
+    if cmd == "replay":
+        from ceph_tpu.rbd.replay import replay_trace
+
+        img = await rbd.open(ioctx, args.image)
+        with open(args.trace) as fh:
+            stats = await replay_trace(fh, img, speed=args.speed)
+        await img.close()
+        print(json.dumps(stats))
+        return 0
+    if cmd == "trash":
+        if args.verb == "mv":
+            image_id = await rbd.trash_mv(ioctx, args.target,
+                                          delay=args.delay)
+            print(json.dumps({"id": image_id}))
+        elif args.verb == "ls":
+            for e in await rbd.trash_ls(ioctx):
+                print(json.dumps(e))
+        elif args.verb == "restore":
+            name = await rbd.trash_restore(ioctx, args.target,
+                                           new_name=args.name)
+            print(json.dumps({"name": name}))
+        elif args.verb == "rm":
+            await rbd.trash_rm(ioctx, args.target, force=args.force)
+        elif args.verb == "purge":
+            n = await rbd.trash_purge(ioctx)
+            print(json.dumps({"removed": n}))
+        return 0
     print(f"unknown command {cmd}", file=sys.stderr)
     return 22
 
@@ -256,23 +283,32 @@ async def _bench(ioctx, rbd: RBD, args) -> int:
     payload = bytes(io_size)
     sem = asyncio.Semaphore(args.io_threads)
     did = {"read": 0, "write": 0}
+    target = img
+    trace_fh = None
+    if getattr(args, "trace", None):
+        from ceph_tpu.rbd.replay import ImageTracer
+
+        trace_fh = open(args.trace, "w")
+        target = ImageTracer(img, trace_fh)
 
     async def one(i: int, off: int) -> None:
         async with sem:
             write = args.io_type == "write" or (
                 args.io_type == "readwrite" and i % 2 == 0)
             if write:
-                await img.write(off, payload)
+                await target.write(off, payload)
                 did["write"] += 1
             else:
-                await img.read(off, io_size)
+                await target.read(off, io_size)
                 did["read"] += 1
 
     t0 = _time.perf_counter()
     await asyncio.gather(*(one(i, off)
                            for i, off in enumerate(offsets())))
     dt = _time.perf_counter() - t0
-    await img.close()
+    await target.close()
+    if trace_fh is not None:
+        trace_fh.close()
     print(json.dumps({
         "io_type": args.io_type, "io_size": io_size, "ops": ops,
         "reads": did["read"], "writes": did["write"],
@@ -347,6 +383,22 @@ def main(argv=None) -> int:
     be.add_argument("--io-pattern", choices=["seq", "rand"],
                     default="seq")
     be.add_argument("--io-threads", type=int, default=16)
+    be.add_argument("--trace", default=None,
+                    help="record the workload as a JSONL trace")
+    rp = sub.add_parser("replay")
+    rp.add_argument("trace")
+    rp.add_argument("image")
+    rp.add_argument("--speed", type=float, default=1.0,
+                    help="pacing multiplier (0 = full speed)")
+    tr = sub.add_parser("trash")
+    tr.add_argument("verb", choices=["mv", "ls", "restore", "rm",
+                                     "purge"])
+    tr.add_argument("target", nargs="?", default="",
+                    help="image name (mv) or image id (restore/rm)")
+    tr.add_argument("--delay", type=float, default=0.0)
+    tr.add_argument("--force", action="store_true")
+    tr.add_argument("--name", default=None,
+                    help="restore under a different name")
 
     args = ap.parse_args(argv)
     try:

@@ -1,0 +1,197 @@
+"""RBD trash (librbd api/Trash.cc role) and rbd-replay
+(src/rbd_replay role).
+
+Trash: mv hides the image but keeps its objects; restore brings it
+back intact (new name supported); rm respects the deferment window;
+purge reclaims expired entries; protected snaps / clones refuse.
+
+Replay: a recorded workload re-executes faithfully against another
+image (content-identical with data capture; deterministic synthetic
+payloads without).
+"""
+
+import asyncio
+import io
+import json
+
+import pytest
+
+from cluster_helpers import Cluster
+
+from ceph_tpu.rados.client import ObjectNotFound, RadosError
+from ceph_tpu.rbd import RBD
+from ceph_tpu.rbd.replay import ImageTracer, replay_trace
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 150))
+
+
+async def _cluster():
+    cluster = Cluster(num_osds=3)
+    await cluster.start()
+    await cluster.client.create_replicated_pool("rbd", size=2,
+                                                pg_num=4)
+    return cluster
+
+
+def test_trash_mv_restore_cycle():
+    async def main():
+        cluster = await _cluster()
+        try:
+            io_ = cluster.client.open_ioctx("rbd")
+            rbd = RBD()
+            await rbd.create(io_, "vm", 1 << 20, order=18)
+            img = await rbd.open(io_, "vm")
+            await img.write(0, b"precious data")
+            await img.snap_create("s1")
+            await img.close()
+            image_id = await rbd.trash_mv(io_, "vm")
+            # hidden from the namespace, objects intact
+            assert "vm" not in await rbd.list(io_)
+            with pytest.raises(ObjectNotFound):
+                await rbd.open(io_, "vm")
+            entries = await rbd.trash_ls(io_)
+            assert [e["id"] for e in entries] == [image_id]
+            assert entries[0]["name"] == "vm"
+            # restore under a NEW name; snapshots survive the trip
+            name = await rbd.trash_restore(io_, image_id,
+                                           new_name="vm2")
+            assert name == "vm2"
+            back = await rbd.open(io_, "vm2")
+            assert await back.read(0, 13) == b"precious data"
+            assert [s["name"] for s in await back.snap_list()] == \
+                ["s1"]
+            assert await rbd.trash_ls(io_) == []
+        finally:
+            await cluster.stop()
+    run(main())
+
+
+def test_trash_rm_deferment_and_purge():
+    async def main():
+        cluster = await _cluster()
+        try:
+            io_ = cluster.client.open_ioctx("rbd")
+            rbd = RBD()
+            await rbd.create(io_, "later", 1 << 20, order=18)
+            await rbd.create(io_, "now", 1 << 20, order=18)
+            deferred = await rbd.trash_mv(io_, "later", delay=3600)
+            expired = await rbd.trash_mv(io_, "now")
+            # inside the window: refused without force
+            with pytest.raises(RadosError):
+                await rbd.trash_rm(io_, deferred)
+            # purge reclaims ONLY the expired entry
+            assert await rbd.trash_purge(io_) == 1
+            ids = [e["id"] for e in await rbd.trash_ls(io_)]
+            assert ids == [deferred]
+            assert expired not in ids
+            # force overrides the window
+            await rbd.trash_rm(io_, deferred, force=True)
+            assert await rbd.trash_ls(io_) == []
+        finally:
+            await cluster.stop()
+    run(main())
+
+
+def test_trash_rm_snapshotted_image():
+    async def main():
+        cluster = await _cluster()
+        try:
+            io_ = cluster.client.open_ioctx("rbd")
+            rbd = RBD()
+            await rbd.create(io_, "snapped", 1 << 20, order=18)
+            img = await rbd.open(io_, "snapped")
+            await img.write(0, b"x" * 4096)
+            await img.snap_create("keep")
+            await img.close()
+            image_id = await rbd.trash_mv(io_, "snapped")
+            # unprotected snaps are swept by trash rm
+            await rbd.trash_rm(io_, image_id)
+            assert await rbd.trash_ls(io_) == []
+            # protected snaps refuse
+            await rbd.create(io_, "prot", 1 << 20, order=18)
+            img = await rbd.open(io_, "prot")
+            await img.snap_create("locked")
+            await img.snap_protect("locked")
+            await img.close()
+            pid = await rbd.trash_mv(io_, "prot")
+            with pytest.raises(RadosError):
+                await rbd.trash_rm(io_, pid)
+        finally:
+            await cluster.stop()
+    run(main())
+
+
+def test_record_and_replay_workload():
+    async def main():
+        cluster = await _cluster()
+        try:
+            io_ = cluster.client.open_ioctx("rbd")
+            rbd = RBD()
+            await rbd.create(io_, "src", 1 << 20, order=18)
+            await rbd.create(io_, "dst", 1 << 20, order=18)
+            src = await rbd.open(io_, "src")
+            buf = io.StringIO()
+            traced = ImageTracer(src, buf, record_data=True)
+            await traced.write(0, b"header block")
+            await traced.write(64 << 10, b"Z" * 8192)
+            await traced.read(0, 12)
+            await traced.discard(64 << 10, 4096)
+            await traced.close()
+            # replay full-speed onto dst; content must match src
+            dst = await rbd.open(io_, "dst")
+            lines = buf.getvalue().splitlines()
+            stats = await replay_trace(lines, dst, speed=0)
+            assert stats["ops"] == 4
+            assert stats["writes"] == 2 and stats["reads"] == 1
+            for off, ln in ((0, 12), (64 << 10, 8192)):
+                s = await rbd.open(io_, "src")
+                a = await s.read(off, ln)
+                b = await dst.read(off, ln)
+                assert a == b, off
+            await dst.close()
+        finally:
+            await cluster.stop()
+    run(main())
+
+
+def test_bench_trace_then_replay_cli(tmp_path):
+    async def main():
+        import subprocess
+        import sys
+
+        cluster = await _cluster()
+        try:
+            mon = cluster.mon.addr
+            env = {"PYTHONPATH": ".", "JAX_PLATFORMS": "cpu",
+                   "PATH": "/usr/bin:/bin:/usr/local/bin"}
+
+            async def cli(*args):
+                proc = await asyncio.create_subprocess_exec(
+                    sys.executable, "-m", "ceph_tpu.tools.rbd",
+                    "-m", mon, "-p", "rbd", *args,
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    env=env)
+                out, err = await proc.communicate()
+                return proc.returncode, out, err
+
+            rc, _, err = await cli("create", "b1", "--size", "256K",
+                                   "--order", "14")
+            assert rc == 0, err
+            trace = tmp_path / "wk.jsonl"
+            rc, out, err = await cli(
+                "bench", "b1", "--io-type", "write", "--io-size",
+                "4K", "--io-total", "32K", "--trace", str(trace))
+            assert rc == 0, err
+            assert len(trace.read_text().splitlines()) == 8
+            rc, _, err = await cli("create", "b2", "--size", "256K",
+                                   "--order", "14")
+            assert rc == 0, err
+            rc, out, err = await cli("replay", str(trace), "b2",
+                                     "--speed", "0")
+            assert rc == 0, err
+            assert json.loads(out)["writes"] == 8
+        finally:
+            await cluster.stop()
+    run(main())
