@@ -371,6 +371,16 @@ class RBD:
         for snap_name in list(img.meta["snaps"]):
             await img.snap_remove(snap_name)
         await self._destroy(ioctx, img)
+        try:
+            # the trash_mv crash window can leave the NAME claimed in
+            # the directory too; value-checked removal so a phantom
+            # entry never outlives the destroyed image
+            await ioctx.execute(
+                RBD_DIRECTORY, "dir", "remove",
+                json.dumps({"key": f"name_{doc['name']}",
+                            "value": image_id}).encode())
+        except RadosError:
+            pass  # name not claimed (the normal case) or re-claimed
         await ioctx.omap_rm_keys(RBD_TRASH, [image_id])
 
     async def trash_purge(self, ioctx: IoCtx) -> int:

@@ -77,7 +77,8 @@ async def replay_trace(lines, image: Image, speed: float = 1.0,
     their recorded timestamps scaled by 1/speed (speed=0 -> as fast
     as possible).  Returns {ops, reads, writes, elapsed_s}."""
     stats = {"ops": 0, "reads": 0, "writes": 0}
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()   # pacing clock (rebased on capped gaps)
+    t_start = t0               # wall clock (never rebased)
     for line in lines:
         line = line.strip()
         if not line:
@@ -112,5 +113,5 @@ async def replay_trace(lines, image: Image, speed: float = 1.0,
         else:
             continue  # unknown op: skip (forward compatibility)
         stats["ops"] += 1
-    stats["elapsed_s"] = round(time.perf_counter() - t0, 4)
+    stats["elapsed_s"] = round(time.perf_counter() - t_start, 4)
     return stats

@@ -222,20 +222,23 @@ class RGWLite:
         return ceph_str_hash_rjenkins(bucket.encode()) \
             % self.LOG_SHARDS
 
-    async def _log_change(self, bucket: str,
-                          key: Optional[str] = None,
-                          origin: Optional[str] = None) -> None:
+    async def _next_log_key(self) -> str:
+        """Monotonic, time-ordered key for log/queue entries: a
+        backwards clock step (NTP) must never mint keys below a
+        peer's saved marker — those entries would be invisible to
+        sync and then trimmed.  Seeded from the persisted log tail on
+        first use so the ratchet survives restarts too."""
         self._writes += 1
-        # monotonic ratchet over the wall clock: a backwards clock
-        # step (NTP) must never mint keys below a peer's saved marker
-        # — those entries would be invisible to sync and then trimmed.
-        # Seeded from the persisted log tail on first use so the
-        # ratchet survives restarts too.
         if self._log_ns is None:
             self._log_ns = await self._log_tail_ns()
         ns = max(time.time_ns(), self._log_ns + 1)
         self._log_ns = ns
-        entry_key = f"{ns:020d}.{self._writes}"
+        return f"{ns:020d}.{self._writes}"
+
+    async def _log_change(self, bucket: str,
+                          key: Optional[str] = None,
+                          origin: Optional[str] = None) -> None:
+        entry_key = await self._next_log_key()
         entry = {"bucket": bucket, "key": key,
                  "zone": origin or self.zone,
                  "ts": time.time()}
@@ -278,6 +281,105 @@ class RGWLite:
         await self.meta.omap_set(
             self._meta_oid("sync.peers", peer, str(shard)),
             {"marker": marker.encode()})
+
+    # -- bucket notifications (rgw_notify / pubsub role) -------------------
+    #
+    # Reference parity: /root/reference/src/rgw/rgw_notify.cc +
+    # cls_2pc_queue — per-bucket notification configs emit S3-shaped
+    # event records on object mutations.  Zero-egress re-design: the
+    # PERSISTENT QUEUE mode is the product (the reference has it too);
+    # consumers pull and ack instead of receiving pushes.  Queue
+    # objects are per-topic omaps with the same monotonic keys as the
+    # sync log.
+
+    @classmethod
+    def _topic_oid(cls, topic: str) -> str:
+        return cls._meta_oid("notify.topic", topic)
+
+    async def put_bucket_notification(self, bucket: str,
+                                      rules: List[Dict]) -> None:
+        """rules: [{"id", "topic", "events": ["s3:ObjectCreated:*",
+        ...], "filter_prefix": ""}] (PutBucketNotificationConfiguration
+        role)."""
+        for rule in rules:
+            if not rule.get("topic"):
+                raise RGWError("InvalidArgument", "rule needs a topic")
+            if not rule.get("events"):
+                # AWS rejects a configuration without Events; a
+                # forgotten key must not silently subscribe to all
+                raise RGWError("InvalidArgument", "rule needs events")
+            for ev in rule["events"]:
+                if not ev.startswith("s3:"):
+                    raise RGWError("InvalidArgument",
+                                   f"bad event {ev!r}")
+        async with self._meta_lock(self._bucket_oid(bucket)):
+            doc = await self._bucket(bucket)
+            doc["notifications"] = list(rules)
+            await self._store(self._bucket_oid(bucket), doc)
+        await self._log_change(bucket)
+
+    async def get_bucket_notification(self,
+                                      bucket: str) -> List[Dict]:
+        return (await self._bucket(bucket)).get("notifications", [])
+
+    @staticmethod
+    def _event_matches(rule: Dict, event: str, key: str) -> bool:
+        if key is not None and \
+                not key.startswith(rule.get("filter_prefix", "")):
+            return False
+        for want in rule.get("events", []):  # no events: match none
+            if want.endswith("*"):
+                if event.startswith(want[:-1]):
+                    return True
+            elif want == event:
+                return True
+        return False
+
+    async def _notify_event(self, doc: Optional[Dict], bucket: str,
+                            key: str, event: str,
+                            **fields) -> None:
+        """Append one event record to every matching topic queue.
+        `doc` is the (possibly already-loaded) bucket doc — None
+        loads it."""
+        if doc is None:
+            try:
+                doc = await self._bucket(bucket)
+            except RGWError:
+                return
+        rules = [r for r in doc.get("notifications", [])
+                 if self._event_matches(r, event, key)]
+        if not rules:
+            return
+        entry_key = await self._next_log_key()
+        record = {"eventName": event, "bucket": bucket, "key": key,
+                  "eventTime": time.time(), "zone": self.zone}
+        record.update({k: v for k, v in fields.items()
+                       if v is not None})
+        raw = json.dumps(record).encode()
+        for rule in rules:
+            await self.meta.omap_set(
+                self._topic_oid(rule["topic"]), {entry_key: raw})
+
+    async def pull_notifications(self, topic: str, max_events: int = 100
+                                 ) -> List[Tuple[str, Dict]]:
+        """Oldest-first events with their ack keys (the persistent-
+        queue consumer surface)."""
+        from ceph_tpu.rados.client import ObjectNotFound
+
+        try:
+            omap = await self.meta.omap_get(self._topic_oid(topic))
+        except ObjectNotFound:
+            return []  # topic never written — real I/O errors raise:
+            # "empty queue" and "cluster unhealthy" must not look alike
+        out = sorted((k, json.loads(v.decode()))
+                     for k, v in omap.items())
+        return out[:max_events]
+
+    async def ack_notifications(self, topic: str,
+                                keys: List[str]) -> None:
+        if keys:
+            await self.meta.omap_rm_keys(self._topic_oid(topic),
+                                         list(keys))
 
     async def sync_log_trim(self, shard: int) -> int:
         """Drop entries every registered peer has applied (mdlog/
@@ -800,7 +902,8 @@ class RGWLite:
     async def _link_by_status(self, bucket: str, key: str,
                               manifest: Manifest, etag: str,
                               acl: Optional[str] = None,
-                              _origin: Optional[str] = None
+                              _origin: Optional[str] = None,
+                              event: str = "s3:ObjectCreated:Put"
                               ) -> Tuple[str, Optional[str]]:
         """Link a finished upload under ONE bucket lock, adjudicating
         the versioning status AT LINK TIME — a versioning flip during
@@ -815,6 +918,9 @@ class RGWLite:
                 await self._link_locked(doc, bucket, key, manifest,
                                         etag, acl=acl)
                 await self._log_change(bucket, key, origin=_origin)
+                await self._notify_event(doc, bucket, key, event,
+                                         etag=etag,
+                                         size=manifest.obj_size)
                 return etag, None
             # versioned path — also when the key ALREADY has versions
             # with versioning since switched off: existing versions
@@ -823,6 +929,10 @@ class RGWLite:
                 doc, vdoc, bucket, key, manifest, etag,
                 null_version=(status != VER_ENABLED), acl=acl)
             await self._log_change(bucket, key, origin=_origin)
+            await self._notify_event(doc, bucket, key, event,
+                                     etag=etag,
+                                     size=manifest.obj_size,
+                                     version_id=vid)
             return etag, vid
 
     async def _link_locked(self, doc: Dict, bucket: str, key: str,
@@ -976,6 +1086,11 @@ class RGWLite:
                             ) -> Optional[str]:
         out = await self._delete_object_impl(bucket, key, version_id)
         await self._log_change(bucket, key, origin=_origin)
+        await self._notify_event(
+            None, bucket, key,
+            "s3:ObjectRemoved:DeleteMarkerCreated" if out is not None
+            else "s3:ObjectRemoved:Delete",
+            version_id=out or version_id)
         return out
 
     async def _delete_object_impl(self, bucket: str, key: str,
@@ -1298,7 +1413,8 @@ class RGWLite:
         # a multipart completion on a versioned bucket lands as a
         # version, never as a stray head doc
         _etag_, _vid = await self._link_by_status(
-            bucket, key, stitched, combined, acl=doc.get("acl"))
+            bucket, key, stitched, combined, acl=doc.get("acl"),
+            event="s3:ObjectCreated:CompleteMultipartUpload")
         await self.meta.remove(self._upload_oid(bucket, key, upload_id))
         return combined
 
