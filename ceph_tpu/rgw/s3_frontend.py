@@ -67,6 +67,16 @@ class _HttpError(Exception):
         self.code = code
 
 
+def _canonical_query(pairs) -> str:
+    """The sigv4 canonical query string (sorted, RFC3986-quoted) —
+    ONE implementation shared by both verifiers and both signers, so
+    a canonicalization fix can never diverge them."""
+    return "&".join(sorted(
+        "=".join((urllib.parse.quote(k, safe="-_.~"),
+                  urllib.parse.quote(v, safe="-_.~")))
+        for k, v in pairs))
+
+
 def _sig_key(secret: str, date: str, region: str, service: str) -> bytes:
     k = hmac.new(("AWS4" + secret).encode(), date.encode(),
                  hashlib.sha256).digest()
@@ -138,7 +148,8 @@ class S3Frontend:
                     return  # malformed framing: drop the connection
                 if length > MAX_BODY or length < 0:
                     return
-                if length and not self._plausible_auth(headers):
+                if length and not self._plausible_auth(headers) \
+                        and not self._plausible_presigned(target):
                     # screen BEFORE buffering: an unauthenticated peer
                     # must not make the gateway hold a multi-GiB body
                     # in memory just to 403 it.  A request with NO auth
@@ -189,6 +200,17 @@ class S3Frontend:
                 return v.split("/", 1)[0] in self.users
         return False
 
+    def _plausible_presigned(self, target: str) -> bool:
+        """Same screen for query-string auth: a presigned-shaped URL
+        naming a KNOWN access key may carry a large body (the PUT
+        case); full verification still runs afterwards."""
+        _path, _, query = target.partition("?")
+        if "X-Amz-Signature=" not in query:
+            return False
+        params = dict(urllib.parse.parse_qsl(query))
+        cred = params.get("X-Amz-Credential", "")
+        return cred.split("/", 1)[0] in self.users
+
     # -- sigv4 -------------------------------------------------------------
 
     def _verify_sigv4(self, method: str, path: str, query: str,
@@ -225,11 +247,8 @@ class S3Frontend:
         # --aws-sigv4) signs the RAW query string verbatim (no sort,
         # no k= for bare keys), so a second pass accepts that form:
         # same HMAC strength, alternative canonicalization
-        cq_spec = "&".join(sorted(
-            "=".join((urllib.parse.quote(k, safe="-_.~"),
-                      urllib.parse.quote(v, safe="-_.~")))
-            for k, v in urllib.parse.parse_qsl(
-                query, keep_blank_values=True)))
+        cq_spec = _canonical_query(urllib.parse.parse_qsl(
+            query, keep_blank_values=True))
         ch = "".join(f"{h}:{' '.join(headers.get(h, '').split())}\n"
                      for h in signed_headers.split(";"))
         scope = f"{date}/{region}/{service}/aws4_request"
@@ -263,6 +282,63 @@ class S3Frontend:
             raise _HttpError("RequestTimeTooSkewed", amz_date)
         return access
 
+    def _verify_presigned(self, method: str, path: str, query: str,
+                          headers: Dict[str, str]) -> str:
+        """Query-string sigv4 (presigned URLs — the
+        AWSv4ComplSingle/query-auth role): the signature covers every
+        X-Amz-* query param except the signature itself, with an
+        UNSIGNED-PAYLOAD body hash; validity is bounded by
+        X-Amz-Date + X-Amz-Expires rather than the skew window."""
+        params = dict(urllib.parse.parse_qsl(
+            query, keep_blank_values=True))
+        if params.get("X-Amz-Algorithm") != "AWS4-HMAC-SHA256":
+            raise _HttpError("AccessDenied", "bad presign algorithm")
+        cred = params.get("X-Amz-Credential", "").split("/")
+        if len(cred) != 5:
+            raise _HttpError("AccessDenied", "bad credential scope")
+        access, date, region, service, _term = cred
+        secret = self.users.get(access)
+        if secret is None:
+            raise _HttpError("AccessDenied", "unknown access key")
+        amz_date = params.get("X-Amz-Date", "")
+        try:
+            then = datetime.datetime.strptime(
+                amz_date, "%Y%m%dT%H%M%SZ").replace(
+                tzinfo=datetime.timezone.utc)
+            expires = int(params.get("X-Amz-Expires", "0"))
+        except ValueError:
+            raise _HttpError("AccessDenied", "bad presign date")
+        if not 0 < expires <= 604800:
+            # S3's AuthorizationQueryParametersError: a leaked URL
+            # must not be a permanent credential (7-day cap)
+            raise _HttpError("AccessDenied",
+                             "X-Amz-Expires out of range")
+        now = datetime.datetime.now(datetime.timezone.utc)
+        age = (now - then).total_seconds()
+        if age > expires:
+            raise _HttpError("AccessDenied", "Request has expired")
+        if age < -900:  # not valid before its own date (minus skew)
+            raise _HttpError("AccessDenied", "not yet valid")
+        signed_headers = params.get("X-Amz-SignedHeaders", "host")
+        cq = _canonical_query(
+            (k, v) for k, v in params.items()
+            if k != "X-Amz-Signature")
+        ch = "".join(f"{h}:{' '.join(headers.get(h, '').split())}\n"
+                     for h in signed_headers.split(";"))
+        creq = "\n".join([method, path, cq, ch, signed_headers,
+                          "UNSIGNED-PAYLOAD"])
+        scope = f"{date}/{region}/{service}/aws4_request"
+        to_sign = "\n".join([
+            "AWS4-HMAC-SHA256", amz_date, scope,
+            hashlib.sha256(creq.encode()).hexdigest()])
+        want = hmac.new(_sig_key(secret, date, region, service),
+                        to_sign.encode(), hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want,
+                                   params.get("X-Amz-Signature", "")):
+            raise _HttpError("SignatureDoesNotMatch",
+                             "bad presigned signature")
+        return access
+
     # -- dispatch ----------------------------------------------------------
 
     async def _handle(self, method: str, target: str,
@@ -270,7 +346,12 @@ class S3Frontend:
                       ) -> Tuple[int, Dict[str, str], bytes]:
         path, _, query = target.partition("?")
         try:
-            if headers.get("authorization") or not self.anonymous_ok:
+            if "X-Amz-Signature=" in query and \
+                    not headers.get("authorization"):
+                access = self._verify_presigned(method, path, query,
+                                                headers)
+            elif headers.get("authorization") or \
+                    not self.anonymous_ok:
                 access = self._verify_sigv4(method, path, query,
                                             headers, body)
             else:
@@ -732,10 +813,7 @@ def sign_request(method: str, url_path: str, query: Dict[str, str],
     out["x-amz-date"] = amz_date
     out["x-amz-content-sha256"] = payload_hash
     signed = sorted({k.lower() for k in out})
-    cq = "&".join(sorted(
-        "=".join((urllib.parse.quote(k, safe="-_.~"),
-                  urllib.parse.quote(v, safe="-_.~")))
-        for k, v in query.items()))
+    cq = _canonical_query(query.items())
     lower = {k.lower(): v for k, v in out.items()}
     ch = "".join(f"{h}:{' '.join(lower.get(h, '').split())}\n"
                  for h in signed)
@@ -750,3 +828,37 @@ def sign_request(method: str, url_path: str, query: Dict[str, str],
         f"AWS4-HMAC-SHA256 Credential={access}/{scope}, "
         f"SignedHeaders={';'.join(signed)}, Signature={sig}")
     return out
+
+
+def presign_url(method: str, host: str, url_path: str,
+                access: str, secret: str, expires: int = 3600,
+                query: Optional[Dict[str, str]] = None,
+                region: str = "us-east-1") -> str:
+    """Mint a presigned URL (query-string sigv4, UNSIGNED-PAYLOAD) —
+    what `aws s3 presign` / boto3 generate_presigned_url produce; any
+    plain HTTP client can then use it with no credentials."""
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    date = now.strftime("%Y%m%d")
+    scope = f"{date}/{region}/s3/aws4_request"
+    # canonical-URI rule: path segments percent-encoded, "/" kept —
+    # the URL carries the SAME encoded form the signature covers, so
+    # keys with spaces/reserved chars verify
+    url_path = urllib.parse.quote(url_path, safe="/-_.~")
+    params = dict(query or {})
+    params.update({
+        "X-Amz-Algorithm": "AWS4-HMAC-SHA256",
+        "X-Amz-Credential": f"{access}/{scope}",
+        "X-Amz-Date": amz_date,
+        "X-Amz-Expires": str(expires),
+        "X-Amz-SignedHeaders": "host",
+    })
+    cq = _canonical_query(params.items())
+    creq = "\n".join([method, url_path, cq, f"host:{host}\n",
+                      "host", "UNSIGNED-PAYLOAD"])
+    to_sign = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                         hashlib.sha256(creq.encode()).hexdigest()])
+    sig = hmac.new(_sig_key(secret, date, region, "s3"),
+                   to_sign.encode(), hashlib.sha256).hexdigest()
+    return (f"http://{host}{url_path}?{cq}"
+            f"&X-Amz-Signature={sig}")
