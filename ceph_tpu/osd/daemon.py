@@ -431,7 +431,40 @@ class OSDDaemon:
                     int(cmd["trace_id"], 16)
                     if cmd.get("trace_id") else None)},
                 "blkin-role spans collected on this daemon"),
+            "statfs": (
+                lambda cmd: self._cmd_statfs(),
+                "store usage + per-pool object/byte breakdown"),
         }
+
+    def _cmd_statfs(self) -> Dict[str, Any]:
+        """Store usage plus a per-pool breakdown from this OSD's own
+        shard collections (the MPGStats/osd_stat_t reporting role,
+        pulled over the tell surface instead of pushed): bytes are
+        RAW stored bytes on THIS osd (chunks for EC, one copy for
+        replicated); objects count heads only."""
+        out: Dict[str, Any] = dict(self.store.statfs())
+        pools: Dict[int, Dict[str, int]] = {}
+        for pg, state in list(self.pgs.items()):
+            pool = self.osdmap.pools.get(pg.pool)
+            if pool is None:
+                continue
+            try:
+                my_shard = state.my_shard(self.osd_id, pool.type)
+            except Exception:
+                continue
+            agg = pools.setdefault(pg.pool,
+                                   {"objects": 0, "bytes": 0})
+            for name in self._list_shard_objects(pg, my_shard):
+                try:
+                    st = self.store.stat(self._cid(pg, my_shard),
+                                         ObjectId(name))
+                except (KeyError, IOError, OSError):
+                    continue
+                agg["bytes"] += int(st.get("size", 0))
+                if not is_internal_name(name):
+                    agg["objects"] += 1
+        out["pools"] = {str(k): v for k, v in pools.items()}
+        return out
 
     def _start_admin_socket(self, path: str) -> None:
         from ceph_tpu.common.admin_socket import AdminSocket

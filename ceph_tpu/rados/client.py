@@ -435,6 +435,56 @@ class RadosClient:
             raise KeyError(f"no pool {pool_name!r}")
         return IoCtx(self, pool_id)
 
+    async def df(self) -> Dict[str, Any]:
+        """Cluster + per-pool usage (the librados cluster_stat /
+        get_pool_stats roles behind `ceph df` / `rados df`): pulls
+        each up OSD's statfs over the tell surface and aggregates.
+        Raw bytes are what the stores hold (all copies/chunks);
+        logical objects divide the raw head count by the pool's
+        replication/stripe width (approximate mid-recovery)."""
+        import asyncio as _asyncio
+
+        async def one(osd: int):
+            # an unreachable OSD degrades the report, never fails it
+            try:
+                rc, out = await self.osd_command(osd,
+                                                 {"prefix": "statfs"})
+                return out if rc == 0 else None
+            except (RadosError, ConnectionError, OSError,
+                    _asyncio.TimeoutError):
+                return None
+
+        reports = await _asyncio.gather(
+            *(one(o) for o in self.osdmap.get_up_osds()))
+        total = avail = used = 0
+        raw: Dict[int, Dict[str, int]] = {}
+        for out in reports:
+            if out is None:
+                continue
+            total += int(out.get("total", 0))
+            avail += int(out.get("available", 0))
+            used += int(out.get("allocated", 0))
+            for pid, st in out.get("pools", {}).items():
+                agg = raw.setdefault(int(pid),
+                                     {"objects": 0, "bytes": 0})
+                agg["objects"] += int(st.get("objects", 0))
+                agg["bytes"] += int(st.get("bytes", 0))
+        pools = []
+        for pid, agg in sorted(raw.items()):
+            pool = self.osdmap.pools.get(pid)
+            if pool is None:
+                continue
+            width = max(1, getattr(pool, "size", 1))
+            pools.append({
+                "id": pid, "name": pool.name,
+                "objects": agg["objects"] // width,
+                "objects_raw": agg["objects"],
+                "bytes_used": agg["bytes"]})
+        return {"cluster": {"total_bytes": total,
+                            "avail_bytes": avail,
+                            "used_bytes": used},
+                "pools": pools}
+
 
 class IoCtx:
     """librados::IoCtx over the wire."""

@@ -68,13 +68,17 @@ class _HttpError(Exception):
 
 
 def _canonical_query(pairs) -> str:
-    """The sigv4 canonical query string (sorted, RFC3986-quoted) —
-    ONE implementation shared by both verifiers and both signers, so
-    a canonicalization fix can never diverge them."""
-    return "&".join(sorted(
-        "=".join((urllib.parse.quote(k, safe="-_.~"),
-                  urllib.parse.quote(v, safe="-_.~")))
-        for k, v in pairs))
+    """The sigv4 canonical query string (RFC3986-quoted, sorted by
+    encoded NAME then encoded VALUE — sorting the joined "k=v"
+    strings would mis-order names that prefix each other, e.g.
+    key2 before key=) — ONE implementation shared by both verifiers
+    and both signers, so a canonicalization fix can never diverge
+    them."""
+    quoted = sorted(
+        (urllib.parse.quote(k, safe="-_.~"),
+         urllib.parse.quote(v, safe="-_.~"))
+        for k, v in pairs)
+    return "&".join(f"{k}={v}" for k, v in quoted)
 
 
 def _sig_key(secret: str, date: str, region: str, service: str) -> bytes:
@@ -289,8 +293,8 @@ class S3Frontend:
         X-Amz-* query param except the signature itself, with an
         UNSIGNED-PAYLOAD body hash; validity is bounded by
         X-Amz-Date + X-Amz-Expires rather than the skew window."""
-        params = dict(urllib.parse.parse_qsl(
-            query, keep_blank_values=True))
+        pairs = urllib.parse.parse_qsl(query, keep_blank_values=True)
+        params = dict(pairs)  # X-Amz fields occur once per spec
         if params.get("X-Amz-Algorithm") != "AWS4-HMAC-SHA256":
             raise _HttpError("AccessDenied", "bad presign algorithm")
         cred = params.get("X-Amz-Credential", "").split("/")
@@ -320,9 +324,10 @@ class S3Frontend:
         if age < -900:  # not valid before its own date (minus skew)
             raise _HttpError("AccessDenied", "not yet valid")
         signed_headers = params.get("X-Amz-SignedHeaders", "host")
+        # canonicalize from the PAIR list: duplicate parameter names
+        # are legal and signed individually
         cq = _canonical_query(
-            (k, v) for k, v in params.items()
-            if k != "X-Amz-Signature")
+            (k, v) for k, v in pairs if k != "X-Amz-Signature")
         ch = "".join(f"{h}:{' '.join(headers.get(h, '').split())}\n"
                      for h in signed_headers.split(";"))
         creq = "\n".join([method, path, cq, ch, signed_headers,
@@ -346,8 +351,13 @@ class S3Frontend:
                       ) -> Tuple[int, Dict[str, str], bytes]:
         path, _, query = target.partition("?")
         try:
-            if "X-Amz-Signature=" in query and \
-                    not headers.get("authorization"):
+            if not headers.get("authorization") and any(
+                    k == "X-Amz-Signature"
+                    for k, _v in urllib.parse.parse_qsl(
+                        query, keep_blank_values=True)):
+                # a REAL X-Amz-Signature parameter — not a substring
+                # inside some value — selects query auth; anything
+                # else stays on the anonymous path
                 access = self._verify_presigned(method, path, query,
                                                 headers)
             elif headers.get("authorization") or \

@@ -70,6 +70,9 @@ async def _dispatch(client: RadosClient, args) -> int:
             {"prefix": f"crash {args.verb}", "id": args.id})
         _out(out)
         return 0 if rc == 0 else 1
+    if cmd == "df":
+        _out(await client.df())
+        return 0
     if cmd == "tell":
         rc, out = await client.osd_command(
             args.osd, {"prefix": " ".join(args.tell_cmd)})
@@ -187,6 +190,7 @@ def main(argv=None) -> int:
                     help="JSON EC profile (makes an EC pool)")
     sub.add_parser("status")
     sub.add_parser("health")
+    sub.add_parser("df")
     cr = sub.add_parser("crash")
     cr.add_argument("verb", choices=["ls", "ls-new", "info",
                                      "archive", "archive-all", "rm"])

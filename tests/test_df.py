@@ -1,0 +1,46 @@
+"""`rados df` / librados cluster_stat + get_pool_stats roles: the
+client aggregates each OSD's statfs (store totals + per-pool raw
+object/byte breakdown) into cluster and per-pool usage."""
+
+import asyncio
+
+from cluster_helpers import Cluster
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 120))
+
+
+def test_df_cluster_and_pool_accounting():
+    async def main():
+        cluster = Cluster(num_osds=3, osds_per_host=1)
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool(
+                "rep", size=3, pg_num=4)
+            await cluster.client.create_ec_pool(
+                "ec", {"plugin": "ec_jax",
+                       "technique": "reed_sol_van", "k": "2",
+                       "m": "1", "crush-failure-domain": "osd",
+                       "tpu": "false"}, pg_num=4)
+            rep = cluster.client.open_ioctx("rep")
+            ec = cluster.client.open_ioctx("ec")
+            for i in range(5):
+                await rep.write_full(f"r{i}", b"R" * 1000)
+            await ec.write_full("big", b"E" * 6000)
+            df = await cluster.client.df()
+            assert df["cluster"]["total_bytes"] > 0
+            assert df["cluster"]["used_bytes"] >= 0
+            pools = {p["name"]: p for p in df["pools"]}
+            # replicated: 5 logical objects, 3 raw copies each,
+            # >= 3x bytes stored
+            assert pools["rep"]["objects"] == 5
+            assert pools["rep"]["objects_raw"] == 15
+            assert pools["rep"]["bytes_used"] >= 3 * 5 * 1000
+            # EC 2+1: one logical object striped into 3 chunks
+            assert pools["ec"]["objects"] == 1
+            assert pools["ec"]["objects_raw"] == 3
+            assert pools["ec"]["bytes_used"] >= 6000  # k+m overhead
+        finally:
+            await cluster.stop()
+    run(main())
