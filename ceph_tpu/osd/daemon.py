@@ -436,15 +436,18 @@ class OSDDaemon:
                 "store usage + per-pool object/byte breakdown"),
         }
 
-    def _cmd_statfs(self) -> Dict[str, Any]:
+    async def _cmd_statfs(self) -> Dict[str, Any]:
         """Store usage plus a per-pool breakdown from this OSD's own
         shard collections (the MPGStats/osd_stat_t reporting role,
         pulled over the tell surface instead of pushed): bytes are
         RAW stored bytes on THIS osd (chunks for EC, one copy for
-        replicated); objects count heads only."""
+        replicated); objects count heads only.  Yields between PGs —
+        a large OSD's scan must not stall heartbeats and client I/O
+        sharing the event loop."""
         out: Dict[str, Any] = dict(self.store.statfs())
         pools: Dict[int, Dict[str, int]] = {}
         for pg, state in list(self.pgs.items()):
+            await asyncio.sleep(0)
             pool = self.osdmap.pools.get(pg.pool)
             if pool is None:
                 continue
@@ -454,7 +457,10 @@ class OSDDaemon:
                 continue
             agg = pools.setdefault(pg.pool,
                                    {"objects": 0, "bytes": 0})
-            for name in self._list_shard_objects(pg, my_shard):
+            for i, name in enumerate(
+                    self._list_shard_objects(pg, my_shard)):
+                if i % 256 == 255:
+                    await asyncio.sleep(0)
                 try:
                     st = self.store.stat(self._cid(pg, my_shard),
                                          ObjectId(name))
@@ -469,9 +475,22 @@ class OSDDaemon:
     def _start_admin_socket(self, path: str) -> None:
         from ceph_tpu.common.admin_socket import AdminSocket
 
+        loop = asyncio.get_running_loop()
+
+        def wrap(fn):
+            # the asok serve thread is synchronous: run coroutine
+            # handlers on the daemon loop and wait for the result
+            def call(cmd):
+                out = fn(cmd)
+                if asyncio.iscoroutine(out):
+                    return asyncio.run_coroutine_threadsafe(
+                        out, loop).result(30)
+                return out
+            return call
+
         sock = AdminSocket(path, version=f"ceph_tpu osd.{self.osd_id}")
         for name, (fn, help_text) in self._admin_commands().items():
-            sock.register_command(name, fn, help_text)
+            sock.register_command(name, wrap(fn), help_text)
         sock.init()
         self._admin_socket = sock
 
@@ -616,6 +635,8 @@ class OSDDaemon:
         try:
             if entry is not None:
                 out = entry[0](msg.cmd)
+                if asyncio.iscoroutine(out):
+                    out = await out  # async handlers (statfs scan)
                 rc = 0
             elif prefix == "scrub":
                 # trigger an immediate scrub of my primary PGs and

@@ -442,8 +442,6 @@ class RadosClient:
         Raw bytes are what the stores hold (all copies/chunks);
         logical objects divide the raw head count by the pool's
         replication/stripe width (approximate mid-recovery)."""
-        import asyncio as _asyncio
-
         async def one(osd: int):
             # an unreachable OSD degrades the report, never fails it
             try:
@@ -451,10 +449,10 @@ class RadosClient:
                                                  {"prefix": "statfs"})
                 return out if rc == 0 else None
             except (RadosError, ConnectionError, OSError,
-                    _asyncio.TimeoutError):
+                    asyncio.TimeoutError):
                 return None
 
-        reports = await _asyncio.gather(
+        reports = await asyncio.gather(
             *(one(o) for o in self.osdmap.get_up_osds()))
         total = avail = used = 0
         raw: Dict[int, Dict[str, int]] = {}
@@ -470,14 +468,16 @@ class RadosClient:
                 agg["objects"] += int(st.get("objects", 0))
                 agg["bytes"] += int(st.get("bytes", 0))
         pools = []
-        for pid, agg in sorted(raw.items()):
-            pool = self.osdmap.pools.get(pid)
-            if pool is None:
-                continue
+        # every pool in the map is listed (zeros when no OSD reported
+        # it yet), like the reference's `ceph df`
+        for pid, pool in sorted(self.osdmap.pools.items()):
+            agg = raw.get(pid, {"objects": 0, "bytes": 0})
             width = max(1, getattr(pool, "size", 1))
             pools.append({
                 "id": pid, "name": pool.name,
-                "objects": agg["objects"] // width,
+                # ceiling: a degraded pool (fewer copies than size)
+                # must not under-count its logical objects to zero
+                "objects": -(-agg["objects"] // width),
                 "objects_raw": agg["objects"],
                 "bytes_used": agg["bytes"]})
         return {"cluster": {"total_bytes": total,

@@ -289,6 +289,78 @@ def test_rbd_cli_end_to_end(tmp_path):
     run(main())
 
 
+def test_cephfs_cli_end_to_end(tmp_path):
+    """ls/mkdir/put/get/mv/snap/subvolume through the cephfs CLI
+    against a live cluster (cephfs-shell + fs subvolume roles)."""
+    async def main():
+        from ceph_tpu.mds import MDSDaemon
+
+        cluster = Cluster(num_osds=2)
+        await cluster.start()
+        mds = None
+        try:
+            mon = cluster.mon.addr
+            await cluster.client.create_replicated_pool(
+                "cephfs.meta", size=2, pg_num=4)
+            await cluster.client.create_replicated_pool(
+                "cephfs.data", size=2, pg_num=4)
+            mds = MDSDaemon(mon, "cephfs.meta", "cephfs.data",
+                            lock_interval=0.3)
+            await mds.start()
+
+            async def cli(*args, input_=None):
+                proc = await asyncio.create_subprocess_exec(
+                    sys.executable, "-m", "ceph_tpu.tools.cephfs",
+                    "-m", mon, *args,
+                    stdin=subprocess.PIPE if input_ else None,
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    env=_CLI_ENV)
+                out, err = await proc.communicate(input_)
+                return proc.returncode, out, err
+
+            rc, _, err = await cli("mkdir", "-p", "/a/b")
+            assert rc == 0, err
+            src = tmp_path / "in.bin"
+            src.write_bytes(b"cli file transfer")
+            rc, _, err = await cli("put", str(src), "/a/b/f")
+            assert rc == 0, err
+            rc, out, err = await cli("cat", "/a/b/f")
+            assert rc == 0 and out == b"cli file transfer", err
+            rc, out, _ = await cli("ls", "/a/b")
+            assert b"f" in out
+            rc, _, err = await cli("mv", "/a/b/f", "/a/g")
+            assert rc == 0, err
+            # snapshots through the CLI
+            rc, out, err = await cli("snap", "create", "/a", "s1")
+            assert rc == 0, err
+            rc, _, err = await cli("rm", "/a/g")
+            assert rc == 0, err
+            rc, out, _ = await cli("cat", "/a/.snap/s1/g")
+            assert out == b"cli file transfer"
+            rc, out, _ = await cli("snap", "ls", "/a")
+            assert b"s1" in out
+            rc, _, err = await cli("snap", "rm", "/a", "s1")
+            assert rc == 0, err
+            # subvolumes
+            rc, out, err = await cli("subvolume", "create", "pvc",
+                                     "--group", "csi", "--size",
+                                     "1048576")
+            assert rc == 0, err
+            assert json.loads(out)["path"] == "/volumes/csi/pvc"
+            rc, out, _ = await cli("subvolume", "info", "pvc",
+                                   "--group", "csi")
+            assert json.loads(out)["bytes_quota"] == 1048576
+            rc, _, err = await cli("subvolume", "rm", "pvc",
+                                   "--group", "csi")
+            assert rc == 0, err
+        finally:
+            if mds is not None:
+                await mds.stop()
+            await cluster.stop()
+
+    run(main())
+
+
 _CLI_ENV = {"PYTHONPATH": ".", "JAX_PLATFORMS": "cpu",
             "PATH": "/usr/bin:/bin:/usr/local/bin"}
 
