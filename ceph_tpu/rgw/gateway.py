@@ -282,6 +282,100 @@ class RGWLite:
             self._meta_oid("sync.peers", peer, str(shard)),
             {"marker": marker.encode()})
 
+    # -- users (rgw_user / radosgw-admin role) -----------------------------
+    #
+    # Durable user records in the meta pool: one doc per uid plus an
+    # access-key index omap for O(1) auth lookups.  The reference
+    # stores these through RGWUserCtl/cls_user; same shape, JSON docs.
+
+    USER_KEYS_OID = "user.keys"
+
+    @classmethod
+    def _user_oid(cls, uid: str) -> str:
+        return cls._meta_oid("user", uid)
+
+    async def user_create(self, uid: str, display_name: str = "",
+                          access_key: Optional[str] = None,
+                          secret_key: Optional[str] = None) -> Dict:
+        """Note: a gateway's STATIC bootstrap keys (S3Frontend users
+        dict) take precedence over same-named durable keys — pick
+        generated keys (the default) to stay clear of them."""
+        if await self._load(self._user_oid(uid)) is not None:
+            raise RGWError("UserAlreadyExists", uid)
+        import os as _os
+
+        access_key = access_key or \
+            "AK" + _os.urandom(9).hex().upper()
+        secret_key = secret_key or _os.urandom(20).hex()
+        try:
+            taken = await self.meta.omap_get(
+                self._meta_oid(self.USER_KEYS_OID))
+        except Exception:
+            taken = {}
+        if access_key in taken:
+            # overwriting the index entry would hijack another
+            # user's credential
+            raise RGWError("KeyExists", access_key)
+        doc = {"uid": uid, "display_name": display_name or uid,
+               "keys": [{"access_key": access_key,
+                         "secret_key": secret_key}],
+               "suspended": False, "created": time.time()}
+        await self._store(self._user_oid(uid), doc)
+        await self.meta.omap_set(
+            self._meta_oid(self.USER_KEYS_OID),
+            {access_key: json.dumps(
+                {"uid": uid, "secret": secret_key}).encode()})
+        return doc
+
+    async def user_info(self, uid: str) -> Dict:
+        doc = await self._load(self._user_oid(uid))
+        if doc is None:
+            raise RGWError("NoSuchUser", uid)
+        return doc
+
+    async def user_list(self) -> List[str]:
+        prefix = self._user_oid("")
+        names = await self.meta.list_objects()
+        return sorted(n[len(prefix):] for n in names
+                      if n.startswith(prefix))
+
+    async def user_set_suspended(self, uid: str,
+                                 suspended: bool) -> None:
+        doc = await self.user_info(uid)
+        doc["suspended"] = bool(suspended)
+        await self._store(self._user_oid(uid), doc)
+
+    async def user_rm(self, uid: str) -> None:
+        doc = await self.user_info(uid)
+        await self.meta.omap_rm_keys(
+            self._meta_oid(self.USER_KEYS_OID),
+            [k["access_key"] for k in doc.get("keys", [])])
+        await self.meta.remove(self._user_oid(uid))
+
+    async def user_key_lookup(self, access_key: str
+                              ) -> Optional[str]:
+        """access key -> secret, or None (unknown / suspended).
+        Transient cluster errors RAISE — "key unknown" and "meta
+        pool unhealthy" must never look alike, or the frontend would
+        evict valid cached credentials."""
+        from ceph_tpu.rados.client import ObjectNotFound
+
+        try:
+            omap = await self.meta.omap_get(
+                self._meta_oid(self.USER_KEYS_OID))
+        except ObjectNotFound:
+            return None  # no users ever created
+        raw = omap.get(access_key)
+        if raw is None:
+            return None
+        rec = json.loads(raw.decode())
+        try:
+            if (await self.user_info(rec["uid"])).get("suspended"):
+                return None
+        except RGWError:
+            return None  # index entry orphaned by a partial rm
+        return rec["secret"]
+
     # -- bucket notifications (rgw_notify / pubsub role) -------------------
     #
     # Reference parity: /root/reference/src/rgw/rgw_notify.cc +

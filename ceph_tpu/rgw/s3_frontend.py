@@ -96,6 +96,10 @@ class S3Frontend:
                  anonymous_ok: bool = True):
         self.rgw = rgw
         self.users = dict(users)  # access_key -> secret_key
+        # durable-table keys cached in self.users, with expiry
+        # (monotonic); static bootstrap keys are not tracked here
+        self._durable_keys: Dict[str, float] = {}
+        self._neg_keys: Dict[str, float] = {}  # confirmed-unknown
         # anonymous_ok: admit unauthenticated requests as identity
         # None so canned-ACL checks adjudicate them (public-read
         # buckets); False restores require-sigv4-always
@@ -152,6 +156,12 @@ class S3Frontend:
                     return  # malformed framing: drop the connection
                 if length > MAX_BODY or length < 0:
                     return
+                if length and not self._plausible_auth(headers):
+                    # a durable-table user may not be cached yet:
+                    # hydrate before judging (one omap read, only on
+                    # the unknown-key path)
+                    _p, _, _q = target.partition("?")
+                    await self._ensure_user(headers, _q)
                 if length and not self._plausible_auth(headers) \
                         and not self._plausible_presigned(target):
                     # screen BEFORE buffering: an unauthenticated peer
@@ -194,15 +204,73 @@ class S3Frontend:
 
     def _plausible_auth(self, headers: Dict[str, str]) -> bool:
         """Cheap pre-body screen: sigv4-shaped Authorization with a
-        KNOWN access key (full verification still runs on the body)."""
-        authz = headers.get("authorization", "")
-        if not authz.startswith("AWS4-HMAC-SHA256 "):
+        KNOWN access key (full verification still runs on the body).
+        One credential parser (_claimed_access) serves the screen and
+        both verifiers."""
+        if not headers.get("authorization", "").startswith(
+                "AWS4-HMAC-SHA256 "):
             return False
-        for part in authz[len("AWS4-HMAC-SHA256 "):].split(","):
-            k, _, v = part.strip().partition("=")
-            if k == "Credential":
-                return v.split("/", 1)[0] in self.users
-        return False
+        return self._claimed_access(headers, "") in self.users
+
+    @staticmethod
+    def _claimed_access(headers: Dict[str, str],
+                        query: str) -> Optional[str]:
+        """The access key a request CLAIMS (header or query auth) —
+        unverified; used only to hydrate the key cache."""
+        authz = headers.get("authorization", "")
+        if authz.startswith("AWS4-HMAC-SHA256 "):
+            for part in authz[len("AWS4-HMAC-SHA256 "):].split(","):
+                k, _, v = part.strip().partition("=")
+                if k == "Credential":
+                    return v.split("/", 1)[0]
+        for k, v in urllib.parse.parse_qsl(query):
+            if k == "X-Amz-Credential":
+                return v.split("/", 1)[0]
+        return None
+
+    USER_CACHE_TTL = 5.0
+    USER_NEG_TTL = 2.0
+
+    async def _ensure_user(self, headers: Dict[str, str],
+                           query: str) -> None:
+        """Hydrate self.users from the DURABLE user table (the
+        radosgw-admin-created users) before the sync verifiers run.
+        The static dict stays the bootstrap (never expires, takes
+        precedence over a same-named durable key); durable keys carry
+        a short TTL so suspension/removal take effect within seconds.
+        Misses are negative-cached briefly — random-credential spam
+        must not buy a meta-pool read per request — short enough that
+        a just-created user works almost immediately.  A transient
+        cluster error keeps whatever is cached (never evicts)."""
+        import time as _time
+
+        access = self._claimed_access(headers, query)
+        if not access:
+            return
+        now = _time.monotonic()
+        expiry = self._durable_keys.get(access)
+        if access in self.users and expiry is None:
+            return  # static bootstrap key
+        if expiry is not None and now < expiry:
+            return
+        if now < self._neg_keys.get(access, 0):
+            return  # recently confirmed unknown
+        try:
+            secret = await self.rgw.user_key_lookup(access)
+        except Exception:
+            return  # cluster hiccup: keep the cached state as-is
+        if secret is not None:
+            self.users[access] = secret
+            self._durable_keys[access] = now + self.USER_CACHE_TTL
+            self._neg_keys.pop(access, None)
+        else:
+            if expiry is not None:
+                # durable key revoked/suspended since last refresh
+                self.users.pop(access, None)
+                self._durable_keys.pop(access, None)
+            if len(self._neg_keys) > 4096:
+                self._neg_keys.clear()  # bounded
+            self._neg_keys[access] = now + self.USER_NEG_TTL
 
     def _plausible_presigned(self, target: str) -> bool:
         """Same screen for query-string auth: a presigned-shaped URL
@@ -351,6 +419,7 @@ class S3Frontend:
                       ) -> Tuple[int, Dict[str, str], bytes]:
         path, _, query = target.partition("?")
         try:
+            await self._ensure_user(headers, query)
             if not headers.get("authorization") and any(
                     k == "X-Amz-Signature"
                     for k, _v in urllib.parse.parse_qsl(

@@ -1,0 +1,128 @@
+"""RGW durable users (rgw_user / radosgw-admin roles): admin-created
+users authenticate against the live HTTP frontend (header and
+presigned auth), suspension/removal take effect within the cache
+TTL, and the CLI drives the whole lifecycle."""
+
+import asyncio
+import json
+import subprocess
+import sys
+
+import pytest
+
+from cluster_helpers import Cluster
+
+from ceph_tpu.rgw import RGWLite
+from ceph_tpu.rgw.gateway import RGWError
+from ceph_tpu.rgw.s3_frontend import S3Frontend, presign_url
+
+from test_s3_http import ACCESS, SECRET, MiniS3, _stack
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 150))
+
+
+def test_durable_user_lifecycle_through_frontend():
+    async def main():
+        cluster = Cluster(num_osds=3, osds_per_host=1)
+        await cluster.start()
+        fe = None
+        try:
+            fe, addr = await _stack(cluster)
+            fe.USER_CACHE_TTL = 0.5  # fast suspension visibility
+            fe.USER_NEG_TTL = 0.5    # fast re-enable visibility
+            rgw = fe.rgw
+            doc = await rgw.user_create("alice",
+                                        display_name="Alice A")
+            ak = doc["keys"][0]["access_key"]
+            sk = doc["keys"][0]["secret_key"]
+            assert await rgw.user_list() == ["alice"]
+            with pytest.raises(RGWError):
+                await rgw.user_create("alice")
+            # alice signs requests with her OWN keys (never in the
+            # frontend's static bootstrap dict)
+            s3 = MiniS3(addr, access=ak, secret=sk)
+            st, _, _ = await s3.request("PUT", "/alice-bucket")
+            assert st == 200
+            st, _, _ = await s3.request("PUT", "/alice-bucket/f",
+                                        body=b"hers")
+            assert st == 200
+            # presigned by alice works too
+            url = presign_url("GET", addr, "/alice-bucket/f",
+                              ak, sk, expires=60)
+            st, _, body = await s3.request(
+                "GET", url[len(f"http://{addr}"):].partition("?")[0]
+                + "?" + url.partition("?")[2], sign=False)
+            assert st == 200 and body == b"hers"
+            # suspension takes effect within the TTL
+            await rgw.user_set_suspended("alice", True)
+            await asyncio.sleep(0.7)
+            st, _, _ = await s3.request("GET", "/alice-bucket/f")
+            assert st == 403
+            await rgw.user_set_suspended("alice", False)
+            await asyncio.sleep(0.7)
+            st, _, body = await s3.request("GET", "/alice-bucket/f")
+            assert st == 200 and body == b"hers"
+            # removal revokes the key permanently
+            await rgw.user_rm("alice")
+            await asyncio.sleep(0.7)
+            st, _, _ = await s3.request("GET", "/alice-bucket/f")
+            assert st == 403
+            # the static bootstrap user still authenticates (its own
+            # namespace; alice's private bucket stays hers)
+            boot = MiniS3(addr, access=ACCESS, secret=SECRET)
+            st, _, _ = await boot.request("PUT", "/boot-bucket")
+            assert st == 200
+            st, _, _ = await boot.request("GET", "/alice-bucket/f")
+            assert st == 403  # private ACL, different owner
+        finally:
+            if fe is not None:
+                await fe.stop()
+            await cluster.stop()
+    run(main())
+
+
+def test_radosgw_admin_cli(tmp_path):
+    async def main():
+        cluster = Cluster(num_osds=2)
+        await cluster.start()
+        try:
+            mon = cluster.mon.addr
+            await cluster.client.create_replicated_pool(
+                "rgw.meta", size=2, pg_num=4)
+            await cluster.client.create_replicated_pool(
+                "rgw.data", size=2, pg_num=4)
+            env = {"PYTHONPATH": ".", "JAX_PLATFORMS": "cpu",
+                   "PATH": "/usr/bin:/bin:/usr/local/bin"}
+
+            async def cli(*args):
+                proc = await asyncio.create_subprocess_exec(
+                    sys.executable, "-m",
+                    "ceph_tpu.tools.radosgw_admin", "-m", mon,
+                    *args, stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE, env=env)
+                out, err = await proc.communicate()
+                return proc.returncode, out, err
+
+            rc, out, err = await cli("user", "create", "--uid",
+                                     "bob", "--display-name", "Bob")
+            assert rc == 0, err
+            doc = json.loads(out)
+            assert doc["uid"] == "bob"
+            assert doc["keys"][0]["access_key"].startswith("AK")
+            rc, out, _ = await cli("user", "ls")
+            assert json.loads(out) == ["bob"]
+            rc, out, _ = await cli("user", "info", "--uid", "bob")
+            assert json.loads(out)["display_name"] == "Bob"
+            rc, _, _ = await cli("user", "suspend", "--uid", "bob")
+            assert rc == 0
+            rc, out, _ = await cli("user", "info", "--uid", "bob")
+            assert json.loads(out)["suspended"] is True
+            rc, _, _ = await cli("user", "rm", "--uid", "bob")
+            assert rc == 0
+            rc, out, _ = await cli("user", "ls")
+            assert json.loads(out) == []
+        finally:
+            await cluster.stop()
+    run(main())
