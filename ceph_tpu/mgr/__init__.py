@@ -78,12 +78,13 @@ class MgrDaemon:
         from ceph_tpu.mgr.dashboard import DashboardModule
         from ceph_tpu.mgr.pg_autoscaler import PgAutoscalerModule
         from ceph_tpu.mgr.prometheus import PrometheusModule
+        from ceph_tpu.mgr.rbd_support import RbdSupportModule
         from ceph_tpu.mgr.telemetry import TelemetryModule
 
         await self.client.connect()
         for cls in (BalancerModule, PgAutoscalerModule,
                     PrometheusModule, DashboardModule,
-                    TelemetryModule):
+                    TelemetryModule, RbdSupportModule):
             if self._module_filter is not None and \
                     cls.NAME not in self._module_filter:
                 continue

@@ -1,0 +1,129 @@
+"""rbd_support module: snapshot schedules + trash purge schedules.
+
+Reference parity: /root/reference/src/pybind/mgr/rbd_support/ — the
+mgr module behind `rbd mirror snapshot schedule` and `rbd trash purge
+schedule`: schedules are cluster data (not mgr-local state), the
+module's serve loop creates timestamped snapshots for scheduled
+images (with retention pruning) and sweeps expired trash entries for
+scheduled pools.
+
+Schedules live in each rbd pool's `rbd_schedules` object omap:
+  snap\\x1f<image>   {"interval": s, "keep": n}   per-image snapshots
+  trash\\x1f         {"interval": s}              pool trash purge
+Last-run bookkeeping is module-local (a mgr failover just re-runs at
+most one interval early — schedules are idempotent)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Any, Dict, Tuple
+
+from ceph_tpu.mgr import MgrModule
+from ceph_tpu.rados.client import ObjectNotFound, RadosError
+
+log = logging.getLogger("mgr")
+
+SCHEDULES_OID = "rbd_schedules"
+SEP = "\x1f"
+
+
+class RbdSupportModule(MgrModule):
+    NAME = "rbd_support"
+
+    # snapshots created by the schedule: rbd_support's timestamp-name
+    # shape (scheduled-%Y-%m-%dT%H:%M:%S)
+    SNAP_PREFIX = "scheduled-"
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self._last_run: Dict[Tuple[str, str], float] = {}
+
+    # -- schedule admin (the `rbd ... schedule add/ls/rm` surface) ---------
+
+    @staticmethod
+    async def schedule_snapshots(ioctx, image: str, interval: float,
+                                 keep: int = 3) -> None:
+        await ioctx.omap_set(SCHEDULES_OID, {
+            f"snap{SEP}{image}": json.dumps(
+                {"interval": interval, "keep": int(keep)}).encode()})
+
+    @staticmethod
+    async def schedule_trash_purge(ioctx, interval: float) -> None:
+        await ioctx.omap_set(SCHEDULES_OID, {
+            f"trash{SEP}": json.dumps(
+                {"interval": interval}).encode()})
+
+    @staticmethod
+    async def schedule_rm(ioctx, key: str) -> None:
+        await ioctx.omap_rm_keys(SCHEDULES_OID, [key])
+
+    @staticmethod
+    async def schedule_ls(ioctx) -> Dict[str, Dict[str, Any]]:
+        try:
+            omap = await ioctx.omap_get(SCHEDULES_OID)
+        except ObjectNotFound:
+            return {}
+        return {k: json.loads(v.decode()) for k, v in omap.items()}
+
+    # -- serve -------------------------------------------------------------
+
+    async def serve_once(self) -> None:
+        osdmap = self.mgr.osdmap
+        if osdmap is None:
+            return
+        for pool in list(osdmap.pools.values()):
+            try:
+                await self._serve_pool(pool.name)
+            except (RadosError, ObjectNotFound):
+                continue  # pool without schedules / transient
+
+    async def _serve_pool(self, pool_name: str) -> None:
+        ioctx = self.mgr.client.open_ioctx(pool_name)
+        schedules = await self.schedule_ls(ioctx)
+        if not schedules:
+            return
+        from ceph_tpu.rbd import RBD
+
+        rbd = RBD()
+        now = time.time()
+        for key, sched in schedules.items():
+            last = self._last_run.get((pool_name, key), 0.0)
+            if now - last < float(sched.get("interval", 3600)):
+                continue
+            self._last_run[(pool_name, key)] = now
+            kind, _, image = key.partition(SEP)
+            try:
+                if kind == "trash":
+                    n = await rbd.trash_purge(ioctx)
+                    if n:
+                        log.info("rbd_support: purged %d trash"
+                                 " entries from %s", n, pool_name)
+                elif kind == "snap":
+                    await self._scheduled_snapshot(
+                        rbd, ioctx, image,
+                        int(sched.get("keep", 3)))
+            except (RadosError, ObjectNotFound):
+                log.warning("rbd_support: schedule %r on %s failed",
+                            key, pool_name, exc_info=True)
+
+    async def _scheduled_snapshot(self, rbd, ioctx, image: str,
+                                  keep: int) -> None:
+        img = await rbd.open(ioctx, image)
+        try:
+            stamp = time.strftime("%Y-%m-%dT%H:%M:%S",
+                                  time.gmtime())
+            name = f"{self.SNAP_PREFIX}{stamp}"
+            if name not in img.meta["snaps"]:
+                await img.snap_create(name)
+            # retention: prune the oldest scheduled snaps past `keep`
+            # (never touches manually-created or protected snaps)
+            mine = sorted(
+                s for s in img.meta["snaps"]
+                if s.startswith(self.SNAP_PREFIX)
+                and not img.meta["snaps"][s].get("protected"))
+            for stale in mine[:-keep] if keep > 0 else mine:
+                await img.snap_remove(stale)
+        finally:
+            await img.close()

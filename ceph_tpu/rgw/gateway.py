@@ -307,11 +307,14 @@ class RGWLite:
         access_key = access_key or \
             "AK" + _os.urandom(9).hex().upper()
         secret_key = secret_key or _os.urandom(20).hex()
+        from ceph_tpu.rados.client import ObjectNotFound
+
         try:
             taken = await self.meta.omap_get(
                 self._meta_oid(self.USER_KEYS_OID))
-        except Exception:
-            taken = {}
+        except ObjectNotFound:
+            taken = {}  # no users yet — any OTHER error must raise,
+            # or a transient fault would disable the hijack guard
         if access_key in taken:
             # overwriting the index entry would hijack another
             # user's credential

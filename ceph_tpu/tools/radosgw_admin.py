@@ -46,8 +46,10 @@ def main(argv=None) -> int:
 
 
 async def _run(args) -> int:
-    client = RadosClient(args.mon, secret=args.secret or None,
-                         name="client.rgw-admin")
+    # no fixed entity name: repeated CLI runs must not collide in the
+    # OSDs' (client, tid) reqid dedup cache (client.py's uniqueness
+    # invariant) — the default per-process uuid keeps runs distinct
+    client = RadosClient(args.mon, secret=args.secret or None)
     await client.connect()
     try:
         rgw = RGWLite(client, args.data_pool, args.meta_pool)

@@ -1,0 +1,74 @@
+"""mgr rbd_support module (pybind/mgr/rbd_support role): snapshot
+schedules with retention and trash purge schedules, driven by the
+module's serve loop off cluster-stored schedule data."""
+
+import asyncio
+
+from cluster_helpers import Cluster
+
+from ceph_tpu.mgr import MgrDaemon
+from ceph_tpu.mgr.rbd_support import RbdSupportModule
+from ceph_tpu.rbd import RBD
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 150))
+
+
+def test_snapshot_schedule_with_retention_and_trash_purge():
+    async def main():
+        cluster = Cluster(num_osds=3)
+        await cluster.start()
+        mgr = None
+        try:
+            await cluster.client.create_replicated_pool(
+                "rbd", size=2, pg_num=4)
+            io = cluster.client.open_ioctx("rbd")
+            rbd = RBD()
+            await rbd.create(io, "vm", 1 << 20, order=18)
+            img = await rbd.open(io, "vm")
+            await img.write(0, b"scheduled data")
+            await img.close()
+            # a manual snapshot the schedule must never prune
+            img = await rbd.open(io, "vm")
+            await img.snap_create("manual")
+            await img.close()
+            # expired trash entry for the purge schedule
+            await rbd.create(io, "old", 1 << 20, order=18)
+            await rbd.trash_mv(io, "old")
+
+            await RbdSupportModule.schedule_snapshots(
+                io, "vm", interval=0.5, keep=2)
+            await RbdSupportModule.schedule_trash_purge(
+                io, interval=0.5)
+            scheds = await RbdSupportModule.schedule_ls(io)
+            assert len(scheds) == 2
+
+            mgr = MgrDaemon(cluster.mon.addr,
+                            modules=["rbd_support"],
+                            tick_interval=0.3)
+            await mgr.start()
+            # several intervals pass: snapshots accumulate but stay
+            # capped at keep=2; the trash drains
+            for _ in range(60):
+                await asyncio.sleep(0.4)
+                img = await rbd.open(io, "vm")
+                mine = [s for s in img.meta["snaps"]
+                        if s.startswith("scheduled-")]
+                trash = await rbd.trash_ls(io)
+                if len(mine) >= 2 and not trash:
+                    break
+            img = await rbd.open(io, "vm")
+            mine = [s for s in img.meta["snaps"]
+                    if s.startswith("scheduled-")]
+            assert 1 <= len(mine) <= 2, img.meta["snaps"]
+            assert "manual" in img.meta["snaps"]  # never pruned
+            assert await rbd.trash_ls(io) == []   # purge ran
+            # schedule removal stops the machinery
+            await RbdSupportModule.schedule_rm(io, "snap\x1fvm")
+            assert len(await RbdSupportModule.schedule_ls(io)) == 1
+        finally:
+            if mgr is not None:
+                await mgr.stop()
+            await cluster.stop()
+    run(main())
