@@ -1096,9 +1096,41 @@ class OSDDaemon:
         grace = self.config["osd_heartbeat_grace"]
         while not self._stopping:
             await asyncio.sleep(interval)
+            now = time.monotonic()
+            # mon session keepalive: a restarted mon loses subscriber
+            # connections silently, and a BOOT whose subscription
+            # sends were injected/faulted away leaves this daemon
+            # mapless — in both cases maps go quiet.  This check runs
+            # BEFORE the mapless guard below: osdmap None is the
+            # WORST staleness, not an exemption (a zombie OSD that
+            # never re-subscribes wedges recovery cluster-wide; found
+            # by the injection thrasher).
+            if now - self._last_map_rx > max(5.0, 4 * interval):
+                self._last_map_rx = now
+                epoch = self.osdmap.epoch if self.osdmap else 0
+                # a MAPLESS renew is abnormal (boot subscription
+                # lost); a steady-state renew on an idle cluster is
+                # routine and must not spam the log
+                (log.info if epoch == 0 else log.debug)(
+                    "osd.%d: mon quiet at epoch %s; re-subscribing",
+                    self.osd_id, epoch or "none")
+                # hunt: rotating through the monmap finds a serving
+                # peer behind a dead mon / dropped conn
+                self._hunt_mon()
+                try:
+                    await self.msgr.send_to(
+                        self.mon_addr,
+                        MGetMap(since_epoch=epoch, subscribe=True))
+                    if self.osdmap is None and self.msgr.addr:
+                        # never booted into the map either: the mon
+                        # may not know this daemon exists at all
+                        await self.msgr.send_to(
+                            self.mon_addr,
+                            MOSDBoot(self.osd_id, self.msgr.addr))
+                except (ConnectionError, OSError):
+                    pass  # this mon down too; next cycle hunts on
             if self.osdmap is None:
                 continue
-            now = time.monotonic()
             # one-shot injected heartbeat outage
             # (heartbeat_inject_failure = seconds of silence): mute
             # pings AND replies for that long, then self-clear.  Peers
@@ -1130,24 +1162,6 @@ class OSDDaemon:
                             MOSDBoot(self.osd_id, self.msgr.addr))
                     except (ConnectionError, OSError):
                         pass
-            # mon session keepalive: a restarted mon loses subscriber
-            # connections silently; if maps have gone quiet, drop the
-            # possibly-half-open cached connection and re-subscribe on a
-            # fresh one (MonClient hunting/renew role).  A healthy mon
-            # answers MGetMap at once, which resets the quiet clock.
-            if now - self._last_map_rx > max(5.0, 4 * interval):
-                self._last_map_rx = now
-                # hunt: the current mon has gone quiet — a dead mon, a
-                # dead leader behind it, or a silently dropped conn.
-                # Rotating through the monmap finds a serving peer.
-                self._hunt_mon()
-                try:
-                    await self.msgr.send_to(
-                        self.mon_addr,
-                        MGetMap(since_epoch=self.osdmap.epoch,
-                                subscribe=True))
-                except (ConnectionError, OSError):
-                    pass  # this mon down too; next cycle hunts on
             self.op_tracker.check_slow()
             peers = self._heartbeat_peers()
             # prune state for ex-peers so a later re-add restarts fresh
@@ -1176,7 +1190,16 @@ class OSDDaemon:
                     except (ConnectionError, OSError):
                         pass
 
-            await asyncio.gather(*(ping_one(p) for p in peers))
+            try:
+                await asyncio.gather(*(ping_one(p) for p in peers))
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # the heartbeat loop carries failure detection AND the
+                # mon-subscription keepalive: one bad iteration must
+                # never kill it for the daemon's lifetime
+                log.exception("osd.%d: heartbeat iteration failed",
+                              self.osd_id)
 
     # -- local shard store helpers -----------------------------------------
 

@@ -299,6 +299,16 @@ def test_multiprocess_thrash_sigkill_under_load(tmp_path):
                         procs[victim] = spawn_osd(victim)
                         _read_addr(procs[victim], "OSD_ADDR")
                         await up_count(NUM)
+                    # liveness floor: writes must complete once the
+                    # cluster is whole again.  On a slow host most of
+                    # the thrash window is spent degraded (writes
+                    # parked behind recovery), so give the workload a
+                    # bounded HEALTHY window to reach the floor
+                    # rather than racing the kill schedule
+                    for _ in range(1200):
+                        if acked[0] >= 10:
+                            break
+                        await asyncio.sleep(0.1)
                 finally:
                     task.cancel()
                     try:
@@ -328,7 +338,11 @@ def test_multiprocess_thrash_sigkill_under_load(tmp_path):
             finally:
                 await client.shutdown()
 
-        asyncio.run(asyncio.wait_for(drive(), 360))
+        # 5 kill/respawn cycles + mon restart + the bounded healthy
+        # window for the acked floor + the health settle: the backstop
+        # must cover their worst-case sum, or a slow host dies here
+        # with a bare TimeoutError instead of a diagnosable assert
+        asyncio.run(asyncio.wait_for(drive(), 600))
     finally:
         for proc in list(procs.values()) + [mon_box[0]]:
             if proc is not None and proc.poll() is None:
