@@ -1096,110 +1096,115 @@ class OSDDaemon:
         grace = self.config["osd_heartbeat_grace"]
         while not self._stopping:
             await asyncio.sleep(interval)
-            now = time.monotonic()
-            # mon session keepalive: a restarted mon loses subscriber
-            # connections silently, and a BOOT whose subscription
-            # sends were injected/faulted away leaves this daemon
-            # mapless — in both cases maps go quiet.  This check runs
-            # BEFORE the mapless guard below: osdmap None is the
-            # WORST staleness, not an exemption (a zombie OSD that
-            # never re-subscribes wedges recovery cluster-wide; found
-            # by the injection thrasher).
-            if now - self._last_map_rx > max(5.0, 4 * interval):
-                self._last_map_rx = now
-                epoch = self.osdmap.epoch if self.osdmap else 0
-                # a MAPLESS renew is abnormal (boot subscription
-                # lost); a steady-state renew on an idle cluster is
-                # routine and must not spam the log
-                (log.info if epoch == 0 else log.debug)(
-                    "osd.%d: mon quiet at epoch %s; re-subscribing",
-                    self.osd_id, epoch or "none")
-                # hunt: rotating through the monmap finds a serving
-                # peer behind a dead mon / dropped conn
-                self._hunt_mon()
-                try:
-                    await self.msgr.send_to(
-                        self.mon_addr,
-                        MGetMap(since_epoch=epoch, subscribe=True))
-                    if self.osdmap is None and self.msgr.addr:
-                        # never booted into the map either: the mon
-                        # may not know this daemon exists at all
-                        await self.msgr.send_to(
-                            self.mon_addr,
-                            MOSDBoot(self.osd_id, self.msgr.addr))
-                except (ConnectionError, OSError):
-                    pass  # this mon down too; next cycle hunts on
-            if self.osdmap is None:
-                continue
-            # one-shot injected heartbeat outage
-            # (heartbeat_inject_failure = seconds of silence): mute
-            # pings AND replies for that long, then self-clear.  Peers
-            # see a dead heartbeat surface on a live daemon — exactly
-            # the failure the mon's reporter quorum must adjudicate.
-            inj = float(self.config.get(
-                "heartbeat_inject_failure", 0) or 0)
-            if inj > 0 and now >= self._hb_mute_until:
-                self.config["heartbeat_inject_failure"] = 0
-                self._hb_mute_until = now + inj
-                log.warning("osd.%d: injecting %.1fs heartbeat"
-                            " failure", self.osd_id, inj)
-            if now < self._hb_mute_until:
-                self._hb_resume_stale = True
-                continue
-            if getattr(self, "_hb_resume_stale", False):
-                # coming out of a mute: every peer timestamp is stale by
-                # the mute length — restart the clocks or this daemon
-                # would instantly (and falsely) report every peer failed
-                self._hb_resume_stale = False
-                self._hb_last_rx.clear()
-                # and if the outage got us (rightly) marked down, no map
-                # event will re-fire the MOSDAlive path — re-boot now
-                if not self.osdmap.is_up(self.osd_id) and self.msgr.addr:
-                    self._last_boot_sent = now
-                    try:
-                        await self.msgr.send_to(
-                            self.mon_addr,
-                            MOSDBoot(self.osd_id, self.msgr.addr))
-                    except (ConnectionError, OSError):
-                        pass
-            self.op_tracker.check_slow()
-            peers = self._heartbeat_peers()
-            # prune state for ex-peers so a later re-add restarts fresh
-            for gone in set(self._hb_last_rx) - peers:
-                self._hb_last_rx.pop(gone, None)
-
-            async def ping_one(peer: int) -> None:
-                addr = self.osdmap.osd_addrs.get(peer)
-                if addr is None:
-                    return
-                self._hb_last_rx.setdefault(peer, now)
-                try:
-                    await self.msgr.send_to(
-                        addr, MPing(PING, now, epoch=self._epoch(),
-                                    from_osd=self.osd_id))
-                except (ConnectionError, OSError):
-                    pass
-                elapsed = now - self._hb_last_rx[peer]
-                if elapsed > grace:
-                    # report to mon (send_failures, OSD.cc:5889)
-                    try:
-                        await self.msgr.send_to(
-                            self.mon_addr,
-                            MOSDFailure(peer, self.osd_id, elapsed,
-                                        self._epoch()))
-                    except (ConnectionError, OSError):
-                        pass
-
             try:
-                await asyncio.gather(*(ping_one(p) for p in peers))
+                await self._heartbeat_once(interval, grace)
             except asyncio.CancelledError:
                 raise
             except Exception:
-                # the heartbeat loop carries failure detection AND the
-                # mon-subscription keepalive: one bad iteration must
-                # never kill it for the daemon's lifetime
+                # this loop carries failure detection AND the mon-
+                # subscription keepalive: one bad iteration must
+                # never kill it for the daemon's lifetime (a silent
+                # death here recreates the mapless-zombie wedge)
                 log.exception("osd.%d: heartbeat iteration failed",
                               self.osd_id)
+
+    async def _heartbeat_once(self, interval: float,
+                              grace: float) -> None:
+        now = time.monotonic()
+        # mon session keepalive: a restarted mon loses subscriber
+        # connections silently, and a BOOT whose subscription
+        # sends were injected/faulted away leaves this daemon
+        # mapless — in both cases maps go quiet.  This check runs
+        # BEFORE the mapless guard below: osdmap None is the
+        # WORST staleness, not an exemption (a zombie OSD that
+        # never re-subscribes wedges recovery cluster-wide; found
+        # by the injection thrasher).
+        if now - self._last_map_rx > max(5.0, 4 * interval):
+            self._last_map_rx = now
+            epoch = self.osdmap.epoch if self.osdmap else 0
+            # a MAPLESS renew is abnormal (boot subscription
+            # lost); a steady-state renew on an idle cluster is
+            # routine and must not spam the log
+            (log.info if epoch == 0 else log.debug)(
+                "osd.%d: mon quiet at epoch %s; re-subscribing",
+                self.osd_id, epoch or "none")
+            # hunt: rotating through the monmap finds a serving
+            # peer behind a dead mon / dropped conn
+            self._hunt_mon()
+            try:
+                await self.msgr.send_to(
+                    self.mon_addr,
+                    MGetMap(since_epoch=epoch, subscribe=True))
+                if self.osdmap is None and self.msgr.addr:
+                    # never booted into the map either: the mon
+                    # may not know this daemon exists at all
+                    await self.msgr.send_to(
+                        self.mon_addr,
+                        MOSDBoot(self.osd_id, self.msgr.addr))
+            except (ConnectionError, OSError):
+                pass  # this mon down too; next cycle hunts on
+        if self.osdmap is None:
+            return
+        # one-shot injected heartbeat outage
+        # (heartbeat_inject_failure = seconds of silence): mute
+        # pings AND replies for that long, then self-clear.  Peers
+        # see a dead heartbeat surface on a live daemon — exactly
+        # the failure the mon's reporter quorum must adjudicate.
+        inj = float(self.config.get(
+            "heartbeat_inject_failure", 0) or 0)
+        if inj > 0 and now >= self._hb_mute_until:
+            self.config["heartbeat_inject_failure"] = 0
+            self._hb_mute_until = now + inj
+            log.warning("osd.%d: injecting %.1fs heartbeat"
+                        " failure", self.osd_id, inj)
+        if now < self._hb_mute_until:
+            self._hb_resume_stale = True
+            return
+        if getattr(self, "_hb_resume_stale", False):
+            # coming out of a mute: every peer timestamp is stale by
+            # the mute length — restart the clocks or this daemon
+            # would instantly (and falsely) report every peer failed
+            self._hb_resume_stale = False
+            self._hb_last_rx.clear()
+            # and if the outage got us (rightly) marked down, no map
+            # event will re-fire the MOSDAlive path — re-boot now
+            if not self.osdmap.is_up(self.osd_id) and self.msgr.addr:
+                self._last_boot_sent = now
+                try:
+                    await self.msgr.send_to(
+                        self.mon_addr,
+                        MOSDBoot(self.osd_id, self.msgr.addr))
+                except (ConnectionError, OSError):
+                    pass
+        self.op_tracker.check_slow()
+        peers = self._heartbeat_peers()
+        # prune state for ex-peers so a later re-add restarts fresh
+        for gone in set(self._hb_last_rx) - peers:
+            self._hb_last_rx.pop(gone, None)
+
+        async def ping_one(peer: int) -> None:
+            addr = self.osdmap.osd_addrs.get(peer)
+            if addr is None:
+                return
+            self._hb_last_rx.setdefault(peer, now)
+            try:
+                await self.msgr.send_to(
+                    addr, MPing(PING, now, epoch=self._epoch(),
+                                from_osd=self.osd_id))
+            except (ConnectionError, OSError):
+                pass
+            elapsed = now - self._hb_last_rx[peer]
+            if elapsed > grace:
+                # report to mon (send_failures, OSD.cc:5889)
+                try:
+                    await self.msgr.send_to(
+                        self.mon_addr,
+                        MOSDFailure(peer, self.osd_id, elapsed,
+                                    self._epoch()))
+                except (ConnectionError, OSError):
+                    pass
+
+        await asyncio.gather(*(ping_one(p) for p in peers))
 
     # -- local shard store helpers -----------------------------------------
 

@@ -36,6 +36,14 @@ def main(argv=None) -> int:
     us.add_argument("--display-name", default="")
     us.add_argument("--access-key", default=None)
     us.add_argument("--secret-key", default=None)
+    sy = sub.add_parser("sync")
+    sy.add_argument("verb", choices=["full", "run", "trim"])
+    sy.add_argument("--dest-mon", required=True,
+                    help="destination zone's mon address")
+    sy.add_argument("--zone", default="master",
+                    help="this (source) zone's name")
+    sy.add_argument("--dest-zone", default="secondary")
+    sy.add_argument("--dest-secret", default="")
 
     args = ap.parse_args(argv)
     try:
@@ -52,6 +60,8 @@ async def _run(args) -> int:
     client = RadosClient(args.mon, secret=args.secret or None)
     await client.connect()
     try:
+        if args.cmd == "sync":
+            return await _sync(client, args)
         rgw = RGWLite(client, args.data_pool, args.meta_pool)
         verb = args.verb
         if verb != "ls" and not args.uid:
@@ -76,6 +86,38 @@ async def _run(args) -> int:
         return 0
     finally:
         await client.shutdown()
+
+
+async def _sync(src_client, args) -> int:
+    """One-shot multisite sync pass src -> dest (the radosgw-admin
+    `data sync run` role; continuous replication embeds RGWSyncAgent
+    instead)."""
+    from ceph_tpu.rgw.multisite import RGWSyncAgent
+
+    dst_client = RadosClient(args.dest_mon,
+                             secret=args.dest_secret or None)
+    await dst_client.connect()
+    try:
+        src = RGWLite(src_client, args.data_pool, args.meta_pool,
+                      zone=args.zone)
+        dst = RGWLite(dst_client, args.data_pool, args.meta_pool,
+                      zone=args.dest_zone)
+        agent = RGWSyncAgent(src, dst)
+        if args.verb == "full":
+            n = await agent.full_sync()
+            print(json.dumps({"keys_reconciled": n}))
+        elif args.verb == "run":
+            applied = await agent.sync_once()
+            print(json.dumps({
+                "entries_applied": applied,
+                "objects_copied": agent.objects_copied,
+                "entries_skipped": agent.entries_skipped}))
+        elif args.verb == "trim":
+            print(json.dumps(
+                {"trimmed": await agent.trim_source_log()}))
+        return 0
+    finally:
+        await dst_client.shutdown()
 
 
 if __name__ == "__main__":

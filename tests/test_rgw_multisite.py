@@ -211,6 +211,58 @@ def test_bucket_deletion_propagates():
     run(main())
 
 
+def test_sync_cli(tmp_path):
+    """radosgw-admin sync full/run/trim drives the agent from the
+    shell against two live clusters."""
+    async def main():
+        import subprocess
+        import sys
+
+        za, zb = await _zone("east"), await _zone("west")
+        a, b = za[1], zb[1]
+        try:
+            await a.create_bucket("cli-bkt")
+            await a.put_object("cli-bkt", "k", b"over the CLI")
+            import os
+            import pathlib
+
+            repo = pathlib.Path(__file__).resolve().parent.parent
+            env = dict(os.environ)
+            env["PYTHONPATH"] = str(repo)
+            env["JAX_PLATFORMS"] = "cpu"
+
+            async def cli(verb):
+                proc = await asyncio.create_subprocess_exec(
+                    sys.executable, "-m",
+                    "ceph_tpu.tools.radosgw_admin",
+                    "-m", za[0].mon.addr, "--data-pool", "data",
+                    "--meta-pool", "meta", "sync", verb,
+                    "--dest-mon", zb[0].mon.addr,
+                    "--zone", "east", "--dest-zone", "west",
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    env=env)
+                out, err = await proc.communicate()
+                return proc.returncode, out, err
+
+            rc, out, err = await cli("full")
+            assert rc == 0, err
+            import json
+            assert json.loads(out)["keys_reconciled"] == 1
+            assert await b.get_object("cli-bkt", "k") == \
+                b"over the CLI"
+            await a.put_object("cli-bkt", "k2", b"incremental")
+            rc, out, err = await cli("run")
+            assert rc == 0, err
+            assert await b.get_object("cli-bkt", "k2") == \
+                b"incremental"
+            rc, out, err = await cli("trim")
+            assert rc == 0, err
+            assert json.loads(out)["trimmed"] >= 1
+        finally:
+            await _teardown(za, zb)
+    run(main())
+
+
 def test_continuous_mode():
     async def main():
         za, zb = await _zone("a"), await _zone("b")

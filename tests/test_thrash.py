@@ -142,6 +142,10 @@ async def _run_thrash(*, seed: int, num_osds: int, osds_per_host: int,
                 list(cluster.mons.values()):
             d.msgr.inject_socket_failures = 0
             d.msgr.inject_internal_delays = 0.0
+            # the CONFIG copies too: a central-config push mid-heal
+            # re-applies msgr injection from the daemon's config dict
+            d.config["ms_inject_socket_failures"] = 0
+            d.config["ms_inject_internal_delays"] = 0.0
         try:
             await cluster.wait_for_clean(timeout=clean_timeout)
         except TimeoutError:
