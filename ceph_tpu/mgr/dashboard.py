@@ -12,6 +12,7 @@ through the CLI/mon command path like everything else) and serves:
   GET /api/osds      per-OSD up/in + pg count + op counters
   GET /api/pools     pool table incl. autoscaler recommendations
   GET /api/mons      quorum state
+  GET /api/df        cluster + per-pool usage (`ceph df` role)
   GET /api/log       recent cluster log lines
 
 The HTML is rendered client-side from /api/status+osds+log by a few
@@ -166,6 +167,9 @@ class DashboardModule(MgrModule):
                 rc, stat = await self.mgr.client.mon_command(
                     {"prefix": "mon stat"})
                 return stat if rc == 0 else {}
+            if what == "df":
+                # cluster + per-pool usage (the `ceph df` panel)
+                return await self.mgr.client.df()
             if what == "log":
                 rc, out = await self.mgr.client.mon_command(
                     {"prefix": "log last", "num": 50})

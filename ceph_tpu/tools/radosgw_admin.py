@@ -48,7 +48,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     try:
         return asyncio.run(_run(args))
-    except RGWError as e:
+    except (RGWError, ValueError, KeyError) as e:
+        # ValueError: e.g. sync with identical zone names;
+        # KeyError: a named pool does not exist on that cluster
         print(f"radosgw-admin: {e}", file=sys.stderr)
         return 1
 

@@ -239,6 +239,12 @@ def test_dashboard_serves_status_ui():
             _, body = await get("/api/log")
             assert isinstance(json.loads(body)["lines"], list)
 
+            _, body = await get("/api/df")
+            df = json.loads(body)
+            assert df["cluster"]["total_bytes"] > 0
+            assert any(p["name"] == "p" and p["bytes_used"] >= 1024
+                       for p in df["pools"])
+
             head, _ = await get("/api/nonesuch")
             assert head.startswith("HTTP/1.0 404")
             await mgr.stop()
