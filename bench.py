@@ -24,10 +24,36 @@ from __future__ import annotations
 import functools
 import json
 import os
+import subprocess
+import sys
 import time
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
+
+# CEPH_TPU_BENCH_SMOKE=1: tiny shapes, headline only (tests drive the
+# contract path end-to-end without paying a real measurement)
+_SMOKE = os.environ.get("CEPH_TPU_BENCH_SMOKE") == "1"
+
+_CONTRACT_METRIC = "ec_jax_encode_k8m3_4MiB_stripe"
+_contract_emitted = False
+
+
+def _emit_contract(value: Optional[float],
+                   vs_baseline: Optional[float]) -> None:
+    """Print the one-line JSON driver contract, exactly once, before
+    any optional extended benches run — a wedged tunnel or a crashed
+    secondary bench can no longer yield an empty bench."""
+    global _contract_emitted
+    if _contract_emitted:
+        return
+    _contract_emitted = True
+    print(json.dumps({
+        "metric": _CONTRACT_METRIC,
+        "value": round(value, 3) if value is not None else None,
+        "unit": "GiB/s",
+        "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
+    }), flush=True)
 
 
 def bench_lrc_crc() -> float:
@@ -349,8 +375,11 @@ def main() -> None:
     from ceph_tpu import native
 
     k, m = 8, 3
-    chunk = 512 * 1024          # 4 MiB stripe = k * 512 KiB
-    batch = 16                  # stripes per dispatch (64 MiB data)
+    if _SMOKE:
+        chunk, batch = 4096, 2
+    else:
+        chunk = 512 * 1024      # 4 MiB stripe = k * 512 KiB
+        batch = 16              # stripes per dispatch (64 MiB data)
     matrix = rs.reed_sol_van_matrix(k, m)
     gf_pallas.register_matrix(matrix)  # what ec_jax init() does
     mbits = jnp.asarray(gf.gf_matrix_to_bits(matrix))
@@ -403,9 +432,13 @@ def main() -> None:
         return (t(n) - t(1)) / (n - 1)
 
     def device_seconds_per_encode(mb, d, rows, n=201, iters=5):
+        if _SMOKE:
+            n, iters = 3, 1
         return differenced(lambda nn: loop(mb, d, nn, rows), n, iters)
 
     def words_seconds(mat, d, rows, n=801, iters=5):
+        if _SMOKE:
+            n, iters = 3, 1
         key = tuple(tuple(int(c) for c in row) for row in mat)
         return differenced(lambda nn: loop_words(d, key, nn, rows), n, iters)
 
@@ -419,30 +452,16 @@ def main() -> None:
         t_enc = device_seconds_per_encode(mbits, data, rows=m)
         enc_gibs = data_bytes / t_enc / (1 << 30)
 
-    # decode sweep over 1..m erasures (the reference benchmark sweeps
-    # erasure counts: ceph_erasure_code_benchmark.cc:251-317).  Lost
-    # chunks 0..e-1 rebuilt from k survivors; the production decode path
-    # is the generic SMEM-coefficient kernel (unregistered matrices).
     decode_sweep = {}
     dec_gibs = None
-    for e in range(1, m + 1):
-        lost = list(range(e))
-        have = list(range(e, k)) + list(range(k, k + e))
-        dmat = rs.decode_matrix(matrix, k, lost, have)
-        if use_pallas:
-            t_d = words_seconds(dmat, words, rows=e)
-        else:
-            dmb = jnp.asarray(gf.gf_matrix_to_bits(dmat))
-            t_d = device_seconds_per_encode(dmb, data, rows=e)
-        decode_sweep[f"decode_{e}_erasure_gibs"] = (
-            data_bytes / t_d / (1 << 30))
-        if e == 1:
-            dec_gibs = decode_sweep["decode_1_erasure_gibs"]
 
     # CPU baseline: native SIMD GF matmul (AVX2/SSSE3 split-table
     # shuffle, gf_simd.cc — the jerasure-SSE/isa-l speed tier), one
-    # stripe, single thread like ceph_erasure_code_benchmark.
-    lib = native.get_lib()
+    # stripe, single thread like ceph_erasure_code_benchmark.  Runs
+    # BEFORE the decode sweep so the driver contract line (which needs
+    # vs_baseline) goes out ahead of every optional bench.  Smoke mode
+    # skips it: native.get_lib() may lazily build the C++ extension.
+    lib = None if _SMOKE else native.get_lib()
     cpu_gibs = cpu_scalar_gibs = None
     simd_level = None
     cpu_k4m2_gibs = None
@@ -494,23 +513,47 @@ def main() -> None:
     # distinguishable from a measured ratio of exactly 1.0
     vs_baseline = (enc_gibs / cpu_gibs) if cpu_gibs else None
 
+    # the driver contract line, before every optional/extended bench:
+    # a wedge below this point can cost detail rows, never the bench
+    _emit_contract(enc_gibs, vs_baseline)
+
+    # decode sweep over 1..m erasures (the reference benchmark sweeps
+    # erasure counts: ceph_erasure_code_benchmark.cc:251-317).  Lost
+    # chunks 0..e-1 rebuilt from k survivors; the production decode path
+    # is the generic SMEM-coefficient kernel (unregistered matrices).
+    for e in range(1, m + 1):
+        lost = list(range(e))
+        have = list(range(e, k)) + list(range(k, k + e))
+        dmat = rs.decode_matrix(matrix, k, lost, have)
+        if use_pallas:
+            t_d = words_seconds(dmat, words, rows=e)
+        else:
+            dmb = jnp.asarray(gf.gf_matrix_to_bits(dmat))
+            t_d = device_seconds_per_encode(dmb, data, rows=e)
+        decode_sweep[f"decode_{e}_erasure_gibs"] = (
+            data_bytes / t_d / (1 << 30))
+        if e == 1:
+            dec_gibs = decode_sweep["decode_1_erasure_gibs"]
+
     # BASELINE config #3: LRC k=8 m=4 l=4 encode + crc32c over a 16 MiB
     # BlueStore-style blob, wall-clock end to end (host bytes in, chunks +
     # per-4KiB-block checksums out)
     lrc_gibs = None
-    try:
-        lrc_gibs = bench_lrc_crc()
-    except Exception as e:  # report the row as absent, not a crash
-        print(f"# lrc bench failed: {e!r}")
+    if not _SMOKE:
+        try:
+            lrc_gibs = bench_lrc_crc()
+        except Exception as e:  # report the row as absent, not a crash
+            print(f"# lrc bench failed: {e!r}", file=sys.stderr)
 
     # BASELINE config #5: end-to-end 64 MiB multipart PUT (RGW-lite ->
     # rados -> OSD EC encode -> durable shards)
     put_gibs = put_md5_gibs = None
     put_gate = {}
-    try:
-        put_gibs, put_md5_gibs, put_gate = bench_put_e2e()
-    except Exception as e:
-        print(f"# put e2e bench failed: {e!r}")
+    if not _SMOKE:
+        try:
+            put_gibs, put_md5_gibs, put_gate = bench_put_e2e()
+        except Exception as e:
+            print(f"# put e2e bench failed: {e!r}", file=sys.stderr)
 
     details = {
         "encode_gibs": enc_gibs,
@@ -535,36 +578,75 @@ def main() -> None:
     with open("bench_details.json", "w") as f:
         json.dump(details, f, indent=2)
 
-    print(json.dumps({
-        "metric": "ec_jax_encode_k8m3_4MiB_stripe",
-        "value": round(enc_gibs, 3),
-        "unit": "GiB/s",
-        "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
-    }))
 
+def _probe_backend(timeout_s: Optional[float] = None) -> Optional[str]:
+    """Probe jax backend init in a SUBPROCESS under a hard timeout:
+    jax memoizes backend-init failures (an in-process probe would
+    poison this process's later init), and a wedged TPU tunnel can
+    hang jax.devices() forever — the timeout contains that to the
+    child.  Returns the platform string, or None (init failed/hung).
 
-def _backend_ready() -> bool:
-    """Probe in a SUBPROCESS: jax memoizes backend-init failures, so
-    an in-process probe would poison this process's later init."""
-    import subprocess
-    import sys
-
+    Test hooks: CEPH_TPU_BENCH_PROBE overrides the probe source,
+    CEPH_TPU_BENCH_PROBE_TIMEOUT the per-attempt timeout seconds."""
+    src = os.environ.get(
+        "CEPH_TPU_BENCH_PROBE",
+        "import jax; print(jax.devices()[0].platform)")
+    if timeout_s is None:
+        timeout_s = float(os.environ.get(
+            "CEPH_TPU_BENCH_PROBE_TIMEOUT", "90"))
     try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            capture_output=True, timeout=120)
-        return r.returncode == 0
+        r = subprocess.run([sys.executable, "-c", src],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        return False
+        return None
+    if r.returncode != 0:
+        return None
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln]
+    return lines[-1] if lines else "unknown"
+
+
+def _ensure_backend() -> str:
+    """Wait briefly for a flaky tunnel, then FALL BACK to the host CPU
+    tier rather than hang: a degraded number beats a dead round (the
+    BENCH_r05 rc=124 failure mode).  Returns the platform the bench
+    will run on."""
+    attempts = int(os.environ.get("CEPH_TPU_BENCH_PROBE_ATTEMPTS", "3"))
+    retry_sleep = float(os.environ.get(
+        "CEPH_TPU_BENCH_PROBE_RETRY_SLEEP", "20"))
+    for i in range(attempts):
+        platform = _probe_backend()
+        if platform is not None:
+            return platform
+        if i < attempts - 1:
+            time.sleep(retry_sleep)
+    print("# backend probe failed/hung; falling back to CPU tier",
+          file=sys.stderr)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:  # if jax is already imported (preload .pth hook), pin it too
+        if "jax" in sys.modules:
+            sys.modules["jax"].config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    return "cpu"
+
+
+def cli() -> int:
+    """Entry point with the first-and-always contract guarantee: the
+    one JSON line goes out even when the bench itself dies."""
+    backend = _ensure_backend()
+    try:
+        main()
+    except BaseException as e:
+        # null value = no measurement this round; the line itself (the
+        # driver contract) still goes out, details on stderr
+        _emit_contract(None, None)
+        print(f"# bench failed on backend {backend!r}: {e!r}",
+              file=sys.stderr)
+        if isinstance(e, KeyboardInterrupt):
+            raise
+    return 0
 
 
 if __name__ == "__main__":
-    # the axon tunnel is occasionally unavailable for a while; a
-    # bench run that dies on backend init wastes the whole round's
-    # measurement — wait it out briefly before giving up
-    for _attempt in range(6):
-        if _backend_ready():
-            break
-        if _attempt < 5:
-            time.sleep(30)
-    main()
+    sys.exit(cli())

@@ -1,0 +1,59 @@
+"""ceph_tpu.analysis: rule-based static analyzer for this codebase.
+
+Pure-AST lint pass over the package catching the hazard classes the
+runtime test tier can't see until a test happens to trip them: Python
+side effects and host syncs traced into `@jax.jit` kernels, silent
+uint8 overflow in the GF(2^8) paths, jit recompilation hazards, bare
+numpy on traced arrays, event-loop-blocking calls inside the asyncio
+daemons, static lock-order cycles (the lint-time twin of
+common/lockdep.py), and un-awaited asyncio.Lock acquisition.
+
+Run as a gate:  python -m ceph_tpu.analysis [paths]   (exit 0/1)
+Run in tests:   tests/test_static_analysis.py (tier-1)
+Suppress:       `# lint: disable=<rule>` inline, or baseline a
+                finding with a justification in
+                tools/lint_baseline.json (regenerate with
+                `python -m ceph_tpu.analysis --write-baseline`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+from ceph_tpu.analysis.core import (          # noqa: F401
+    Analyzer, FunctionInfo, ModuleInfo, Project, build_project,
+)
+from ceph_tpu.analysis.findings import (      # noqa: F401
+    Baseline, Finding, load_baseline, write_baseline,
+)
+from ceph_tpu.analysis.lockgraph import build_lock_graph  # noqa: F401
+from ceph_tpu.analysis.rules import default_rules         # noqa: F401
+
+#: repo-relative location of the checked-in baseline
+BASELINE_RELPATH = os.path.join("tools", "lint_baseline.json")
+
+
+def default_baseline_path() -> Optional[str]:
+    """tools/lint_baseline.json under the repo root (the package's
+    parent), falling back to the current directory."""
+    pkg_parent = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    for root in (pkg_parent, os.getcwd()):
+        cand = os.path.join(root, BASELINE_RELPATH)
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+def analyze_paths(paths: List[str], rules=None,
+                  config: Optional[dict] = None
+                  ) -> Tuple[List[Finding], Project]:
+    """Parse + run the rule set; returns (fingerprinted findings,
+    project).  `rules` narrows to a subset of rule names."""
+    project = build_project(paths)
+    all_rules = default_rules()
+    if rules is not None:
+        all_rules = {k: v for k, v in all_rules.items() if k in rules}
+    analyzer = Analyzer(project, all_rules, config=config)
+    return analyzer.run(), project

@@ -1,0 +1,103 @@
+"""CLI gate: `python -m ceph_tpu.analysis [paths ...]`.
+
+Exit 0 when every finding is baselined or suppressed, 1 when any new
+finding survives, 2 on usage errors — usable verbatim as a CI step.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from ceph_tpu.analysis import (
+    Baseline, analyze_paths, default_baseline_path, default_rules,
+    load_baseline, write_baseline,
+)
+
+
+def _default_paths() -> List[str]:
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ceph_tpu.analysis",
+        description="AST-based trace-safety / dtype / async-hazard "
+                    "linter for ceph_tpu")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: the ceph_tpu "
+                         "package)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: tools/"
+                         "lint_baseline.json at the repo root)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept the current findings: rewrite the "
+                         "baseline file (keeps existing justifications)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in default_rules():
+            print(name)
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(default_rules())
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    paths = args.paths or _default_paths()
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"no such path: {p}", file=sys.stderr)
+            return 2
+
+    findings, _ = analyze_paths(paths, rules=rules)
+
+    baseline_path = args.baseline or default_baseline_path()
+    baseline = Baseline()
+    if baseline_path and os.path.exists(baseline_path) and \
+            not args.no_baseline:
+        baseline = load_baseline(baseline_path)
+
+    if args.write_baseline:
+        out = args.baseline or baseline_path or os.path.join(
+            "tools", "lint_baseline.json")
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        write_baseline(out, findings, old=baseline)
+        print(f"wrote {len(findings)} finding(s) to {out}",
+              file=sys.stderr)
+        return 0
+
+    new = [f for f in findings if f not in baseline]
+    suppressed = len(findings) - len(new)
+
+    if args.json:
+        print(json.dumps([f.as_dict() for f in new], indent=2))
+    else:
+        for f in new:
+            print(f.render())
+    stale = baseline.stale(findings)
+    summary = (f"{len(new)} finding(s), {suppressed} baselined"
+               + (f", {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'}"
+                  if stale else ""))
+    print(summary, file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

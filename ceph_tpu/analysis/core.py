@@ -1,0 +1,539 @@
+"""AST framework for the static analyzer.
+
+Pure-AST (nothing is imported or executed): every .py file under the
+scan roots is parsed into a ModuleInfo, cross-module references are
+resolved through each module's import table, and the *traced set* —
+functions whose bodies run under jax tracing — is computed as a
+fixpoint: decorator-traced seeds (`@jax.jit`,
+`@functools.partial(jax.jit, ...)`, vmap/pmap/grad), call-site wraps
+(`jax.jit(f)`, `pl.pallas_call(f, ...)`, `jax.lax.fori_loop(.., body,
+..)`), defs nested inside traced functions, plus everything a traced
+body calls that resolves to a function in the scanned package.
+
+Suppressions: `# lint: disable=rule-a,rule-b` on the finding's line
+(or the line above) silences those rules there; on a `def` line it
+covers the whole function; `# lint: disable-file=rule` anywhere
+silences the rule for the module.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ceph_tpu.analysis.findings import Finding, fingerprint_all
+
+_SUPPRESS_RE = re.compile(r"#\s*lint:\s*disable=([\w,\- ]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*lint:\s*disable-file=([\w,\- ]+)")
+
+# decorator / wrapper names that put a function body under jax tracing
+_TRACING_WRAPPERS = {
+    "jit", "pjit", "vmap", "pmap", "grad", "value_and_grad",
+    "pallas_call", "shard_map", "remat", "checkpoint", "custom_vjp",
+    "custom_jvp",
+}
+# jax.lax control-flow HOFs: (attr name, positions of traced callables)
+_LAX_HOFS = {
+    "fori_loop": (2,),
+    "while_loop": (0, 1),
+    "scan": (0,),
+    "cond": (1, 2, 3),
+    "switch": (1,),
+    "map": (0,),
+    "associative_scan": (0,),
+}
+
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def dynamic_names_in(e: ast.AST) -> Set[str]:
+    """Names in an expression, excluding those reached only through
+    `.shape`/`.ndim`/`.dtype`/`.size` — static metadata under jit, so
+    a value derived from them is a plain Python int, not a tracer."""
+    out: Set[str] = set()
+
+    def rec(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        for c in ast.iter_child_nodes(n):
+            rec(c)
+
+    rec(e)
+    return out
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class FunctionInfo:
+    node: ast.AST                  # FunctionDef | AsyncFunctionDef
+    module: "ModuleInfo"
+    qualname: str                  # e.g. "OSDDaemon.handle_op" / "f.<locals>.g"
+    parent_class: Optional[str]
+    is_async: bool
+    params: List[str] = field(default_factory=list)
+    static_params: Set[str] = field(default_factory=set)
+    traced_by: Optional[str] = None   # why this function is traced
+    jit_decorated: bool = False       # directly under a jit decorator
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def lineno(self) -> int:
+        return self.node.lineno
+
+
+@dataclass
+class ModuleInfo:
+    path: str
+    relpath: str                   # repo-relative (fingerprint-stable)
+    modname: str                   # dotted; __init__.py -> package name
+    tree: ast.Module
+    lines: List[str]
+    suppress: Dict[int, Set[str]] = field(default_factory=dict)
+    file_suppress: Set[str] = field(default_factory=set)
+    functions: Dict[str, FunctionInfo] = field(default_factory=dict)
+    # local name -> (module dotted path, attr-or-None)
+    imports: Dict[str, Tuple[str, Optional[str]]] = field(
+        default_factory=dict)
+    # class name -> attrs assigned asyncio.Lock() somewhere in the class
+    lock_attrs: Dict[str, Set[str]] = field(default_factory=dict)
+    # attr -> explicit class label from lockdep.Lock("x.y")
+    lock_labels: Dict[str, str] = field(default_factory=dict)
+    parents: Dict[ast.AST, ast.AST] = field(default_factory=dict)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def is_suppressed(self, rule: str, line: int,
+                      scope_line: int = 0) -> bool:
+        if rule in self.file_suppress:
+            return True
+        for ln in (line, line - 1, scope_line):
+            if ln and rule in self.suppress.get(ln, ()):
+                return True
+        return False
+
+
+def _package_root(path: str) -> Tuple[str, str]:
+    """(repo_root, dotted module name) for a .py file, walking the
+    __init__.py chain upward; a packageless file is named by its stem
+    and rooted at its own directory."""
+    path = os.path.abspath(path)
+    d = os.path.dirname(path)
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        d = os.path.dirname(d)
+    parts.reverse()
+    if parts[-1] == "__init__":
+        parts.pop()
+    return d, ".".join(parts)
+
+
+def parse_module(path: str) -> ModuleInfo:
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    root, modname = _package_root(path)
+    mod = ModuleInfo(
+        path=os.path.abspath(path),
+        relpath=os.path.relpath(os.path.abspath(path), root),
+        modname=modname,
+        tree=ast.parse(src, filename=path),
+        lines=src.splitlines(),
+    )
+    # suppressions are honoured only in real comment tokens — a
+    # docstring merely *describing* the syntax must not disable rules
+    try:
+        comments = [
+            (tok.start[0], tok.string)
+            for tok in tokenize.generate_tokens(io.StringIO(src).readline)
+            if tok.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError):
+        comments = []
+    for i, text in comments:
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            mod.suppress[i] = {r.strip() for r in m.group(1).split(",")
+                               if r.strip()}
+        m = _SUPPRESS_FILE_RE.search(text)
+        if m:
+            mod.file_suppress |= {r.strip() for r in m.group(1).split(",")
+                                  if r.strip()}
+    _index_module(mod)
+    return mod
+
+
+def _index_module(mod: ModuleInfo) -> None:
+    for parent in ast.walk(mod.tree):
+        for child in ast.iter_child_nodes(parent):
+            mod.parents[child] = parent
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                mod.imports[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name, None)
+        elif isinstance(node, ast.ImportFrom):
+            level_prefix = ""
+            if node.level:
+                # level 1 anchors at the package itself for an
+                # __init__.py (whose modname already names the
+                # package) but at the parent for a plain module
+                base = mod.modname.split(".")
+                drop = node.level - (
+                    1 if os.path.basename(mod.path) == "__init__.py"
+                    else 0)
+                if drop:
+                    base = base[: len(base) - drop]
+                level_prefix = ".".join(base) + "." if base else ""
+            if node.module:
+                for alias in node.names:
+                    mod.imports[alias.asname or alias.name] = (
+                        level_prefix + node.module, alias.name)
+            elif node.level:
+                # `from . import sub` binds sibling submodules
+                for alias in node.names:
+                    mod.imports[alias.asname or alias.name] = (
+                        level_prefix + alias.name, None)
+
+    def visit(node: ast.AST, qual: List[str], cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = qual + [child.name]
+                fi = FunctionInfo(
+                    node=child, module=mod, qualname=".".join(q),
+                    parent_class=cls,
+                    is_async=isinstance(child, ast.AsyncFunctionDef),
+                    params=[a.arg for a in (
+                        child.args.posonlyargs + child.args.args
+                        + child.args.kwonlyargs)],
+                )
+                _parse_decorators(fi)
+                mod.functions[fi.qualname] = fi
+                visit(child, q + ["<locals>"], cls)
+            elif isinstance(child, ast.ClassDef):
+                _collect_lock_attrs(mod, child)
+                visit(child, qual + [child.name], child.name)
+            else:
+                visit(child, qual, cls)
+
+    visit(mod.tree, [], None)
+
+
+def _collect_lock_attrs(mod: ModuleInfo, cls: ast.ClassDef) -> None:
+    attrs: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call):
+            callee = dotted(node.value.func) or ""
+            # asyncio.Lock() and the lockdep-instrumented
+            # lockdep.Lock("x.y") count; threading.Lock does not (its
+            # sync `with` is correct).  A bare Lock() only counts when
+            # the import table says it came from asyncio/lockdep —
+            # `from threading import Lock` must not be misclassified.
+            if callee == "Lock":
+                src = mod.imports.get("Lock")
+                if src is None or src[1] != "Lock" or not (
+                        src[0] == "asyncio"
+                        or src[0].endswith("lockdep")):
+                    continue
+            elif not (callee.endswith("asyncio.Lock")
+                      or callee.endswith("lockdep.Lock")):
+                continue
+            label = None
+            if node.value.args and isinstance(
+                    node.value.args[0], ast.Constant) and isinstance(
+                    node.value.args[0].value, str):
+                label = node.value.args[0].value
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    attrs.add(tgt.attr)
+                    if label:
+                        mod.lock_labels[tgt.attr] = label
+    if attrs:
+        mod.lock_attrs[cls.name] = attrs
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    name = dotted(node)
+    return bool(name) and name.split(".")[-1] in ("jit", "pjit")
+
+
+def _static_names_from_call(call: ast.Call,
+                            params: List[str]) -> Set[str]:
+    """static_argnums/static_argnames out of a jit(...) call."""
+    out: Set[str] = set()
+    for kw in call.keywords:
+        val = kw.value
+        items: List[ast.AST]
+        if isinstance(val, (ast.Tuple, ast.List)):
+            items = list(val.elts)
+        else:
+            items = [val]
+        if kw.arg == "static_argnames":
+            out |= {i.value for i in items
+                    if isinstance(i, ast.Constant)
+                    and isinstance(i.value, str)}
+        elif kw.arg == "static_argnums":
+            for i in items:
+                if isinstance(i, ast.Constant) and isinstance(
+                        i.value, int) and i.value < len(params):
+                    out.add(params[i.value])
+    return out
+
+
+def _parse_decorators(fi: FunctionInfo) -> None:
+    for dec in fi.node.decorator_list:
+        if _is_jit_expr(dec):
+            fi.traced_by = "jit-decorator"
+            fi.jit_decorated = True
+        elif isinstance(dec, ast.Call):
+            callee = dotted(dec.func) or ""
+            if callee.split(".")[-1] == "partial" and dec.args and \
+                    _is_jit_expr(dec.args[0]):
+                fi.traced_by = "jit-decorator"
+                fi.jit_decorated = True
+                fi.static_params |= _static_names_from_call(
+                    dec, fi.params)
+            elif _is_jit_expr(dec.func):
+                fi.traced_by = "jit-decorator"
+                fi.jit_decorated = True
+                fi.static_params |= _static_names_from_call(
+                    dec, fi.params)
+            elif (callee.split(".")[-1] in _TRACING_WRAPPERS):
+                fi.traced_by = callee.split(".")[-1]
+        elif dotted(dec) and dotted(dec).split(".")[-1] in \
+                _TRACING_WRAPPERS:
+            fi.traced_by = dotted(dec).split(".")[-1]
+
+
+class Project:
+    """All scanned modules + cross-module resolution + the traced set."""
+
+    def __init__(self, modules: List[ModuleInfo]):
+        self.modules = {m.modname: m for m in modules}
+        self._traced: Optional[Dict[int, FunctionInfo]] = None
+
+    # -- resolution ----------------------------------------------------
+
+    def resolve_function(self, mod: ModuleInfo, node: ast.AST,
+                         cls: Optional[str] = None
+                         ) -> Optional[FunctionInfo]:
+        """Resolve a Name/Attribute reference to a FunctionInfo in the
+        scanned set (same module, or through the import table).  `cls`
+        is the caller's enclosing class, used to bind `self.method`."""
+        name = dotted(node)
+        if not name:
+            return None
+        head, _, rest = name.partition(".")
+        # local function (module scope)
+        if not rest and name in mod.functions:
+            return mod.functions[name]
+        # from X import f [as g]
+        if not rest and head in mod.imports:
+            src_mod, attr = mod.imports[head]
+            target = self.modules.get(src_mod)
+            if target and attr and attr in target.functions:
+                return target.functions[attr]
+        # import X [as m]; m.f(...)
+        if rest and head in mod.imports:
+            src_mod, attr = mod.imports[head]
+            if attr is None:
+                target = self.modules.get(src_mod)
+                if target and rest in target.functions:
+                    return target.functions[rest]
+            else:  # from pkg import mod; mod.f(...)
+                target = self.modules.get(f"{src_mod}.{attr}") or \
+                    self.modules.get(attr)
+                if target and rest in target.functions:
+                    return target.functions[rest]
+        # self.method(...): the enclosing class's method when known,
+        # else a UNIQUE method of that name in this module — a
+        # first-match fallback would bind nondeterministically when
+        # two classes share a method name
+        if rest and head == "self":
+            if cls:
+                exact = mod.functions.get(f"{cls}.{rest}")
+                if exact is not None:
+                    return exact
+            matches = [fi for q, fi in mod.functions.items()
+                       if q.endswith("." + rest) and fi.parent_class]
+            if len(matches) == 1:
+                return matches[0]
+        return None
+
+    # -- traced set ----------------------------------------------------
+
+    def traced_functions(self) -> Dict[int, FunctionInfo]:
+        if self._traced is None:
+            self._traced = self._compute_traced()
+        return self._traced
+
+    def is_traced(self, node: ast.AST) -> bool:
+        return id(node) in self.traced_functions()
+
+    def _compute_traced(self) -> Dict[int, FunctionInfo]:
+        traced: Dict[int, FunctionInfo] = {}
+
+        def mark(fi: FunctionInfo, why: str) -> bool:
+            if id(fi.node) in traced:
+                return False
+            fi.traced_by = fi.traced_by or why
+            traced[id(fi.node)] = fi
+            # defs nested in a traced body are traced (fori_loop
+            # bodies, closures passed to lax HOFs, etc.)
+            for inner in ast.walk(fi.node):
+                if inner is not fi.node and isinstance(
+                        inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    inner_fi = self._function_for(fi.module, inner)
+                    if inner_fi:
+                        mark(inner_fi, "nested-in-traced")
+            return True
+
+        # seeds: decorators + call-site wraps anywhere in the project
+        for mod in self.modules.values():
+            for fi in mod.functions.values():
+                if fi.traced_by:
+                    mark(fi, fi.traced_by)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = dotted(node.func) or ""
+                tail = callee.split(".")[-1]
+                if tail in _TRACING_WRAPPERS:
+                    for arg in node.args[:1]:
+                        fi = self.resolve_function(mod, arg)
+                        if fi:
+                            if tail in ("jit", "pjit"):
+                                fi.jit_decorated = True
+                                fi.static_params |= \
+                                    _static_names_from_call(
+                                        node, fi.params)
+                            mark(fi, f"{tail}-callsite")
+                elif tail in _LAX_HOFS:
+                    for pos in _LAX_HOFS[tail]:
+                        if pos < len(node.args):
+                            fi = self.resolve_function(
+                                mod, node.args[pos])
+                            if fi:
+                                mark(fi, f"lax.{tail}")
+
+        # fixpoint: anything a traced body calls (resolvable in the
+        # scanned package) is traced too
+        changed = True
+        while changed:
+            changed = False
+            for fi in list(traced.values()):
+                for node in ast.walk(fi.node):
+                    if isinstance(node, ast.Call):
+                        callee = self.resolve_function(
+                            fi.module, node.func,
+                            cls=fi.parent_class)
+                        if callee and mark(callee, "called-from-traced"):
+                            changed = True
+        return traced
+
+    def _function_for(self, mod: ModuleInfo,
+                      node: ast.AST) -> Optional[FunctionInfo]:
+        for fi in mod.functions.values():
+            if fi.node is node:
+                return fi
+        return None
+
+    # -- taint ---------------------------------------------------------
+
+    def tainted_locals(self, fi: FunctionInfo) -> Set[str]:
+        """Names in `fi` carrying traced values: non-static params plus
+        locals (transitively) assigned from them, in source order."""
+        tainted = set(fi.params) - fi.static_params
+        tainted.discard("self")
+
+        def expr_tainted(e: ast.AST) -> bool:
+            return bool(dynamic_names_in(e) & tainted)
+
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) and expr_tainted(node.value):
+                for tgt in node.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+            elif isinstance(node, ast.AugAssign) and (
+                    expr_tainted(node.value) or expr_tainted(node.target)):
+                if isinstance(node.target, ast.Name):
+                    tainted.add(node.target.id)
+            elif isinstance(node, ast.For) and expr_tainted(node.iter):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        tainted.add(n.id)
+        return tainted
+
+
+class Analyzer:
+    """Runs the rule set over a Project and collects findings."""
+
+    def __init__(self, project: Project, rules: Dict[str, "object"],
+                 config: Optional[dict] = None):
+        self.project = project
+        self.rules = rules
+        self.config = dict(config or {})
+        self.findings: List[Finding] = []
+
+    def emit(self, rule: str, mod: ModuleInfo, node: ast.AST,
+             message: str, severity: str = "error",
+             symbol: str = "", scope_line: int = 0) -> None:
+        line = getattr(node, "lineno", 0)
+        col = getattr(node, "col_offset", 0)
+        if mod.is_suppressed(rule, line, scope_line):
+            return
+        self.findings.append(Finding(
+            rule=rule, path=mod.relpath.replace(os.sep, "/"),
+            line=line, col=col, message=message, severity=severity,
+            symbol=symbol, text=mod.line_text(line)))
+
+    def run(self) -> List[Finding]:
+        for name, rule in self.rules.items():
+            rule(self)
+        return fingerprint_all(self.findings)
+
+
+def iter_py_files(paths: List[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def build_project(paths: List[str]) -> Project:
+    return Project([parse_module(p) for p in iter_py_files(paths)])

@@ -1,0 +1,106 @@
+"""Finding model + baseline for the static analyzer.
+
+A Finding carries a *stable fingerprint* — a hash of (rule, file,
+enclosing symbol, normalized source line) that survives line-number
+drift — so the checked-in baseline (tools/lint_baseline.json)
+suppresses pre-existing findings while anything NEW fails the gate,
+the same ratchet discipline as a sanitizer suppression file
+(WITH_ASAN/WITH_TSAN suppressions in the reference build).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str            # repo-relative, stable across checkouts
+    line: int
+    col: int
+    message: str
+    severity: str = "error"
+    symbol: str = ""     # enclosing function/class qualname
+    text: str = ""       # stripped source line the finding points at
+    # filled by fingerprint_all (occurrence index disambiguates
+    # identical lines within one symbol)
+    fingerprint: str = ""
+
+    def key(self) -> tuple:
+        return (self.rule, self.path, self.symbol, self.text)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.severity}: "
+                f"{self.rule}: {self.message} [{self.fingerprint}]")
+
+    def as_dict(self) -> dict:
+        return {
+            "fingerprint": self.fingerprint,
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "text": self.text,
+            "severity": self.severity,
+            "message": self.message,
+        }
+
+
+def fingerprint_all(findings: List[Finding]) -> List[Finding]:
+    """Assign stable fingerprints; identical (rule, path, symbol, text)
+    findings get an occurrence suffix in source order so each one
+    baselines independently."""
+    by_key: Dict[tuple, List[Finding]] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        by_key.setdefault(f.key(), []).append(f)
+    for key, group in by_key.items():
+        for i, f in enumerate(group):
+            raw = "\x00".join((f.rule, f.path, f.symbol, f.text, str(i)))
+            f.fingerprint = hashlib.sha256(
+                raw.encode("utf-8", "surrogatepass")).hexdigest()[:16]
+    return findings
+
+
+@dataclass
+class Baseline:
+    """Checked-in set of accepted findings, each with a one-line
+    justification (why it is defensible rather than fixed)."""
+
+    path: Optional[str] = None
+    entries: Dict[str, dict] = field(default_factory=dict)  # fp -> entry
+
+    def __contains__(self, f: Finding) -> bool:
+        return f.fingerprint in self.entries
+
+    def stale(self, findings: Iterable[Finding]) -> List[dict]:
+        """Baseline entries no longer produced (candidates to drop)."""
+        live = {f.fingerprint for f in findings}
+        return [e for fp, e in self.entries.items() if fp not in live]
+
+
+def load_baseline(path) -> Baseline:
+    with open(path) as fh:
+        data = json.load(fh)
+    entries = {e["fingerprint"]: e for e in data.get("findings", [])}
+    return Baseline(path=str(path), entries=entries)
+
+
+def write_baseline(path, findings: List[Finding],
+                   old: Optional[Baseline] = None) -> None:
+    """Write the current finding set as a baseline, carrying forward
+    justifications already recorded for surviving fingerprints."""
+    out = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line)):
+        entry = f.as_dict()
+        prev = old.entries.get(f.fingerprint) if old else None
+        entry["justification"] = (prev or {}).get("justification", "")
+        out.append(entry)
+    with open(path, "w") as fh:
+        json.dump({"version": 1, "findings": out}, fh, indent=2)
+        fh.write("\n")

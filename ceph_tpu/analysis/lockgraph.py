@@ -1,0 +1,268 @@
+"""Static lock-order pass: the lint-time twin of common/lockdep.py.
+
+The runtime detector builds a class-level order graph ("B acquired
+while holding A") from acquisitions it actually sees; whole-cluster
+tests only teach it the orders tests happen to execute.  This pass
+extracts the same graph from the AST — every `async with <lock>`
+nesting, plus locks acquired by functions *called* while a lock is
+held (transitive call summaries) — so a would-be inversion on a path
+no test reaches still fails lint.
+
+Lock classes mirror the runtime's naming:
+  - `self._mutation_lock = asyncio.Lock()` on class C of module
+    ceph_tpu.mds  ->  "mds.mutation"  (module tail + attr, underscores
+    and the `_lock` suffix stripped)
+  - `state.obj_lock(key)` -> "osd.objlock" / "osd.sublock" /
+    "osd.clslock" by key prefix, the exact mapping of
+    osd/daemon.py:_lock_class
+  - `lockdep.guard(lock, "x.y")` -> "x.y" verbatim
+
+Same-class nesting is allowed (the recovery wave's many object locks);
+cross-class cycles are findings.  `build_lock_graph()` is also the API
+tests use to cross-check that every runtime-observed lockdep edge is a
+subset of this static graph.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from ceph_tpu.analysis.core import (
+    Analyzer, FunctionInfo, ModuleInfo, Project, dotted,
+)
+
+
+def _attr_label(mod: ModuleInfo, attr: str) -> str:
+    tail = mod.modname.split(".")[-1]
+    name = attr.strip("_")
+    if name.endswith("_lock"):
+        name = name[: -len("_lock")]
+    elif name.startswith("lock_"):
+        name = name[len("lock_"):]
+    return f"{tail}.{name}"
+
+
+def _objlock_label(call: ast.Call) -> str:
+    """Mirror of osd/daemon.py:_lock_class, applied to the key
+    expression's leading string constant when one is visible."""
+    prefix = ""
+    if call.args:
+        arg = call.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            prefix = arg.value
+        elif isinstance(arg, ast.JoinedStr) and arg.values and \
+                isinstance(arg.values[0], ast.Constant):
+            prefix = str(arg.values[0].value)
+    if prefix.startswith("sub\x00"):
+        return "osd.sublock"
+    if prefix.startswith("_cls_\x00"):
+        return "osd.clslock"
+    return "osd.objlock"
+
+
+def classify_lock(project: Project, mod: ModuleInfo,
+                  expr: ast.AST) -> Optional[str]:
+    """Lock class label for an `async with <expr>` item, or None."""
+    if isinstance(expr, ast.Call):
+        callee = dotted(expr.func) or ""
+        tail = callee.split(".")[-1]
+        if tail == "obj_lock":
+            return _objlock_label(expr)
+        if tail == "guard" and len(expr.args) >= 2 and \
+                isinstance(expr.args[1], ast.Constant) and \
+                isinstance(expr.args[1].value, str):
+            return expr.args[1].value
+        return None
+    if isinstance(expr, ast.Attribute):
+        # label by the module defining the lock attr; prefer the
+        # current module when it defines one of the same name.  An
+        # explicit lockdep.Lock("x.y") label wins over the derived one
+        # (it is what the runtime detector will record).
+        if expr.attr in _own_attrs(mod):
+            return mod.lock_labels.get(expr.attr) \
+                or _attr_label(mod, expr.attr)
+        for m in project.modules.values():
+            if expr.attr in _own_attrs(m):
+                return m.lock_labels.get(expr.attr) \
+                    or _attr_label(m, expr.attr)
+    return None
+
+
+def _own_attrs(mod: ModuleInfo) -> Set[str]:
+    out: Set[str] = set()
+    for attrs in mod.lock_attrs.values():
+        out |= attrs
+    return out
+
+
+@dataclass
+class Edge:
+    src: str
+    dst: str
+    mod: ModuleInfo
+    node: ast.AST          # the inner acquisition (or call) site
+    holder: str            # qualname of the function holding src
+    via: str = ""          # callee qualname when interprocedural
+
+
+class LockGraphBuilder:
+    def __init__(self, project: Project):
+        self.project = project
+        self.edges: List[Edge] = []
+        # function id -> set of lock labels it (transitively) acquires
+        self._acquires: Dict[int, Set[str]] = {}
+        # method name -> its unique FunctionInfo project-wide (None
+        # when the name is ambiguous): the over-approximating fallback
+        # for attribute calls like `self.paxos.propose(...)` that the
+        # import-table resolver can't bind.  Lock analysis wants the
+        # conservative direction — a spurious edge is noise, a missed
+        # edge is a missed deadlock.
+        self._unique_methods: Dict[str, Optional[FunctionInfo]] = {}
+        for m in project.modules.values():
+            for f in m.functions.values():
+                if f.parent_class is None:
+                    continue
+                key = f.name
+                self._unique_methods[key] = (
+                    f if key not in self._unique_methods else None)
+
+    # -- call resolution (extends Project's with <locals> scoping) -----
+
+    def _resolve_call(self, fi: FunctionInfo,
+                      call: ast.Call) -> Optional[FunctionInfo]:
+        name = dotted(call.func)
+        if name and "." not in name:
+            nested = fi.module.functions.get(
+                f"{fi.qualname}.<locals>.{name}")
+            if nested:
+                return nested
+        target = self.project.resolve_function(
+            fi.module, call.func, cls=fi.parent_class)
+        if target is None and name and "." in name:
+            target = self._unique_methods.get(name.split(".")[-1])
+        return target
+
+    # -- per-function direct acquisitions ------------------------------
+
+    def _direct_acquires(self, fi: FunctionInfo) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.AsyncWith):
+                for item in node.items:
+                    label = classify_lock(
+                        self.project, fi.module, item.context_expr)
+                    if label:
+                        out.add(label)
+        return out
+
+    def _transitive_acquires(self) -> None:
+        funcs: List[FunctionInfo] = [
+            fi for m in self.project.modules.values()
+            for fi in m.functions.values()]
+        for fi in funcs:
+            self._acquires[id(fi.node)] = self._direct_acquires(fi)
+        changed = True
+        while changed:
+            changed = False
+            for fi in funcs:
+                acc = self._acquires[id(fi.node)]
+                for node in ast.walk(fi.node):
+                    if isinstance(node, ast.Call):
+                        callee = self._resolve_call(fi, node)
+                        if callee is not None:
+                            extra = self._acquires.get(
+                                id(callee.node), set()) - acc
+                            if extra:
+                                acc |= extra
+                                changed = True
+
+    # -- held-context walk ---------------------------------------------
+
+    def build(self) -> List[Edge]:
+        self._transitive_acquires()
+        for mod in self.project.modules.values():
+            for fi in mod.functions.values():
+                self._walk(fi, fi.node, [])
+        return self.edges
+
+    def _walk(self, fi: FunctionInfo, node: ast.AST,
+              held: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                # nested defs are walked as their own functions (their
+                # bodies run when called, not where defined); calls to
+                # them are covered by the transitive summaries
+                continue
+            if isinstance(child, ast.AsyncWith):
+                labels: List[str] = []
+                for item in child.items:
+                    label = classify_lock(
+                        self.project, fi.module, item.context_expr)
+                    if label:
+                        for h in held + labels:
+                            if h != label:
+                                self.edges.append(Edge(
+                                    h, label, fi.module,
+                                    item.context_expr, fi.qualname))
+                        labels.append(label)
+                self._walk(fi, child, held + labels)
+                continue
+            if isinstance(child, ast.Call) and held:
+                callee = self._resolve_call(fi, child)
+                if callee is not None:
+                    for label in self._acquires.get(
+                            id(callee.node), ()):
+                        for h in held:
+                            if h != label:
+                                self.edges.append(Edge(
+                                    h, label, fi.module, child,
+                                    fi.qualname,
+                                    via=callee.qualname))
+            self._walk(fi, child, held)
+
+
+def build_lock_graph(project: Project) -> Tuple[
+        Dict[str, Set[str]], List[Edge]]:
+    """(adjacency {src: {dst,...}}, edge list with sites)."""
+    edges = LockGraphBuilder(project).build()
+    adj: Dict[str, Set[str]] = {}
+    for e in edges:
+        adj.setdefault(e.src, set()).add(e.dst)
+    return adj, edges
+
+
+def _reachable(adj: Dict[str, Set[str]], src: str, dst: str) -> bool:
+    seen: Set[str] = set()
+    stack = [src]
+    while stack:
+        n = stack.pop()
+        if n == dst:
+            return True
+        if n in seen:
+            continue
+        seen.add(n)
+        stack.extend(adj.get(n, ()))
+    return False
+
+
+def rule_lock_order(a: Analyzer) -> None:
+    adj, edges = build_lock_graph(a.project)
+    reported: Set[Tuple[str, str, str, int]] = set()
+    for e in edges:
+        # this edge closes a cycle iff dst already reaches src
+        if not _reachable(adj, e.dst, e.src):
+            continue
+        key = (e.mod.relpath, e.src, e.dst,
+               getattr(e.node, "lineno", 0))
+        if key in reported:
+            continue
+        reported.add(key)
+        via = f" via {e.via}()" if e.via else ""
+        a.emit("lock-order", e.mod, e.node,
+               f"lock-order cycle: `{e.dst}` acquired{via} while "
+               f"holding `{e.src}`, but the reverse order exists "
+               "elsewhere — would-be deadlock (lockdep class graph)",
+               symbol=e.holder)

@@ -1,0 +1,500 @@
+"""Rule set for the static analyzer.
+
+Two families, both specific to this codebase's hazard classes:
+
+JAX trace-safety (the `@jax.jit` kernels in ops/, ec/, models/):
+  trace-side-effect    Python side effects baked in at trace time
+  trace-host-sync      implicit device->host syncs inside traced code
+  uint8-overflow       narrow-dtype arithmetic in the GF(2^8) paths
+  trace-static-hazard  params needing static_argnums/static_argnames
+  trace-numpy          bare numpy ops applied to traced values
+
+async/daemon safety (the mon/osd/mds/rgw asyncio daemons):
+  async-blocking       event-loop-blocking calls in `async def` bodies
+  lock-order           static lock-order cycles (lockdep, at lint time)
+  lock-no-await        un-awaited asyncio.Lock acquisition / sync `with`
+
+Every rule walks its own scope only (nested defs are analyzed as their
+own traced/async functions), so findings never double-report.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set
+
+from ceph_tpu.analysis.core import (
+    Analyzer, dotted, dynamic_names_in,
+)
+
+# numpy/stdlib call classification ------------------------------------
+
+_BLOCKING_CALLS = {
+    "time.sleep", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+    "subprocess.getoutput", "subprocess.getstatusoutput",
+    "os.system", "os.popen", "os.wait", "os.waitpid",
+    "urllib.request.urlopen", "socket.create_connection",
+}
+_BLOCKING_PREFIXES = ("requests.",)
+_NUMPY_ALIASES = {"np", "numpy"}
+_NARROW_DTYPES = {"uint8", "int8"}
+# numpy attrs that are fine on traced values (metadata / dtype ctors)
+_NUMPY_SAFE_ATTRS = {
+    "shape", "ndim", "dtype", "uint8", "int8", "uint16", "int16",
+    "uint32", "int32", "uint64", "int64", "float16", "float32",
+    "float64", "bool_", "newaxis", "pi", "e", "inf", "nan",
+}
+# host-sync builtins on a traced value.  len() is NOT here: on a
+# traced array it reads the static leading dim (shape metadata), no
+# sync and no trace error.
+_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+
+
+def walk_scope(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested defs or
+    classes (lambdas ARE included: they trace/run in this scope)."""
+    stack = [c for c in ast.iter_child_nodes(root)]
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _resolved_callee(mod, node: ast.Call) -> str:
+    """Dotted callee with the import table applied to the head, so
+    `import subprocess as sp; sp.run` still reads 'subprocess.run'."""
+    name = dotted(node.func)
+    if not name:
+        return ""
+    head, _, rest = name.partition(".")
+    src = mod.imports.get(head)
+    if src is not None:
+        src_mod, attr = src
+        base = src_mod if attr is None else f"{src_mod}.{attr}"
+        return f"{base}.{rest}" if rest else base
+    return name
+
+
+def _is_numpy_call(mod, node: ast.Call) -> Optional[str]:
+    """Return the numpy attr name if this is a np.<attr>(...) call."""
+    name = dotted(node.func)
+    if not name:
+        return None
+    head, _, rest = name.partition(".")
+    if not rest:
+        return None
+    src = mod.imports.get(head)
+    base = head if src is None else src[0]
+    if base in _NUMPY_ALIASES or base == "numpy":
+        return rest.split(".")[0] if "." in rest else rest
+    return None
+
+
+def _args_tainted(node: ast.Call, tainted: Set[str]) -> bool:
+    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+        if dynamic_names_in(arg) & tainted:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------
+# trace-side-effect
+# ---------------------------------------------------------------------
+
+def rule_trace_side_effect(a: Analyzer) -> None:
+    for fi in a.project.traced_functions().values():
+        mod = fi.module
+        for node in walk_scope(fi.node):
+            if isinstance(node, ast.Global):
+                a.emit("trace-side-effect", mod, node,
+                       f"`global {', '.join(node.names)}` inside traced "
+                       f"`{fi.qualname}`: the mutation runs once at "
+                       "trace time, not per call",
+                       symbol=fi.qualname, scope_line=fi.lineno)
+            elif isinstance(node, ast.Call):
+                callee = _resolved_callee(mod, node)
+                if callee == "print":
+                    a.emit("trace-side-effect", mod, node,
+                           f"print() inside traced `{fi.qualname}` fires "
+                           "at trace time only (use jax.debug.print)",
+                           symbol=fi.qualname, scope_line=fi.lineno)
+                elif callee.startswith("time."):
+                    a.emit("trace-side-effect", mod, node,
+                           f"{callee}() inside traced `{fi.qualname}` is "
+                           "evaluated once at trace time and baked into "
+                           "the kernel",
+                           symbol=fi.qualname, scope_line=fi.lineno)
+                elif (callee.startswith(("numpy.random.", "random."))
+                      or _is_numpy_call(mod, node) == "random"
+                      or (_is_numpy_call(mod, node) or "").startswith(
+                          "random")):
+                    a.emit("trace-side-effect", mod, node,
+                           f"host RNG inside traced `{fi.qualname}`: the "
+                           "draw is frozen at trace time (thread "
+                           "jax.random keys instead)",
+                           symbol=fi.qualname, scope_line=fi.lineno)
+
+
+# ---------------------------------------------------------------------
+# trace-host-sync
+# ---------------------------------------------------------------------
+
+def rule_trace_host_sync(a: Analyzer) -> None:
+    for fi in a.project.traced_functions().values():
+        mod = fi.module
+        tainted = a.project.tainted_locals(fi)
+        for node in walk_scope(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "item":
+                if dynamic_names_in(f.value) & tainted:
+                    a.emit("trace-host-sync", mod, node,
+                           f".item() on a traced value in "
+                           f"`{fi.qualname}` forces a device->host sync "
+                           "(trace error under jit)",
+                           symbol=fi.qualname, scope_line=fi.lineno)
+            elif isinstance(f, ast.Name) and f.id in _SYNC_BUILTINS \
+                    and node.args and _args_tainted(node, tainted):
+                a.emit("trace-host-sync", mod, node,
+                       f"{f.id}() on a traced value in `{fi.qualname}` "
+                       "concretizes the tracer (host sync / trace "
+                       "error)",
+                       symbol=fi.qualname, scope_line=fi.lineno)
+            else:
+                np_attr = _is_numpy_call(mod, node)
+                if np_attr in ("asarray", "array") and \
+                        _args_tainted(node, tainted):
+                    a.emit("trace-host-sync", mod, node,
+                           f"np.{np_attr}() on a traced value in "
+                           f"`{fi.qualname}` pulls the array to host "
+                           "mid-trace (use jnp)",
+                           symbol=fi.qualname, scope_line=fi.lineno)
+
+
+# ---------------------------------------------------------------------
+# uint8-overflow
+# ---------------------------------------------------------------------
+
+_OVERFLOW_OPS = {
+    ast.Add: "+", ast.Sub: "-", ast.Mult: "*", ast.LShift: "<<",
+    ast.Pow: "**",
+}
+
+
+def _scope_nodes(root: ast.AST) -> Iterator[ast.AST]:
+    """Child nodes of one variable scope: descends classes but stops
+    at nested function boundaries (each function in mod.functions gets
+    its own scope pass)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _dtype_is_narrow(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return node.value in _NARROW_DTYPES
+    name = dotted(node)
+    return bool(name) and name.split(".")[-1] in _NARROW_DTYPES
+
+
+class _NarrowTracker(ast.NodeVisitor):
+    """Heuristic per-module dtype tracker: an expression is 'narrow'
+    (uint8/int8) if it is built by an explicit narrow construction —
+    jnp.uint8(x), .astype(np.uint8), dtype=np.uint8 kwargs — or derives
+    from a local known to be narrow."""
+
+    def __init__(self) -> None:
+        self.narrow_names: Set[str] = set()
+
+    def is_narrow(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Call):
+            name = dotted(node.func) or ""
+            tail = name.split(".")[-1]
+            if tail in _NARROW_DTYPES:
+                return True
+            if tail == "astype" and node.args and \
+                    _dtype_is_narrow(node.args[0]):
+                return True
+            if tail == "view" and node.args and \
+                    _dtype_is_narrow(node.args[0]):
+                return True
+            for kw in node.keywords:
+                if kw.arg == "dtype" and _dtype_is_narrow(kw.value):
+                    return True
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.narrow_names
+        if isinstance(node, ast.Subscript):
+            return self.is_narrow(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.is_narrow(node.left) or \
+                self.is_narrow(node.right)
+        if isinstance(node, (ast.UnaryOp,)):
+            return self.is_narrow(node.operand)
+        return False
+
+    def feed_assign(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Assign) and self.is_narrow(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.narrow_names.add(tgt.id)
+
+
+def rule_uint8_overflow(a: Analyzer) -> None:
+    patterns = a.config.get("dtype_paths", ("ops/gf", "ec/"))
+    for mod in a.project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if patterns and not any(p in rel for p in patterns):
+            continue
+        # narrow-name tracking is scoped per function (plus module
+        # scope) so a uint8 local in one function can't taint a
+        # same-named name elsewhere
+        module_tracker = _NarrowTracker()
+        for node in _scope_nodes(mod.tree):
+            module_tracker.feed_assign(node)
+
+        def check_scope(root: ast.AST) -> None:
+            tracker = _NarrowTracker()
+            tracker.narrow_names = set(module_tracker.narrow_names)
+            nodes = list(_scope_nodes(root))
+            for node in nodes:  # learn locals first, then flag
+                tracker.feed_assign(node)
+            for node in nodes:
+                if isinstance(node, ast.BinOp) and \
+                        type(node.op) in _OVERFLOW_OPS and (
+                            tracker.is_narrow(node.left)
+                            or tracker.is_narrow(node.right)):
+                    sym = _enclosing_qualname(mod, node)
+                    a.emit(
+                        "uint8-overflow", mod, node,
+                        f"uint8/int8 `{_OVERFLOW_OPS[type(node.op)]}` "
+                        "wraps silently at 256; promote an operand "
+                        "(.astype(jnp.int32)) or justify in the "
+                        "baseline", severity="warning",
+                        symbol=sym, scope_line=_scope_line(mod, node))
+
+        check_scope(mod.tree)
+        for fi in mod.functions.values():
+            check_scope(fi.node)
+
+
+def _enclosing_qualname(mod, node: ast.AST) -> str:
+    cur = node
+    while cur is not None:
+        cur = mod.parents.get(cur)
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for q, fi in mod.functions.items():
+                if fi.node is cur:
+                    return q
+            return cur.name
+    return "<module>"
+
+
+def _scope_line(mod, node: ast.AST) -> int:
+    cur = node
+    while cur is not None:
+        cur = mod.parents.get(cur)
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur.lineno
+    return 0
+
+
+# ---------------------------------------------------------------------
+# trace-static-hazard
+# ---------------------------------------------------------------------
+
+def rule_trace_static_hazard(a: Analyzer) -> None:
+    shape_ctors = {"zeros", "ones", "full", "empty", "arange",
+                   "linspace", "eye", "broadcast_to"}
+    for fi in a.project.traced_functions().values():
+        if not fi.jit_decorated:
+            continue
+        mod = fi.module
+        dynamic = set(fi.params) - fi.static_params - {"self"}
+        names_in = dynamic_names_in
+
+        for node in walk_scope(fi.node):
+            hits: Set[str] = set()
+            what = ""
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == "range" and node.args:
+                hits = set().union(*(names_in(x) for x in node.args)) \
+                    & dynamic
+                what = "range() bound"
+            elif isinstance(node, (ast.If, ast.While)):
+                hits = names_in(node.test) & dynamic
+                what = f"`{type(node).__name__.lower()}` condition"
+            elif isinstance(node, ast.Call):
+                tail = (dotted(node.func) or "").split(".")[-1]
+                if tail in shape_ctors and node.args:
+                    hits = names_in(node.args[0]) & dynamic
+                    what = f"{tail}() shape"
+            if hits:
+                names = ", ".join(sorted(hits))
+                a.emit("trace-static-hazard", mod, node,
+                       f"param(s) {names} of jit'd `{fi.qualname}` "
+                       f"drive a {what}: mark static_argnums/"
+                       "static_argnames or every new value recompiles "
+                       "(traced values here even error)",
+                       severity="warning", symbol=fi.qualname,
+                       scope_line=fi.lineno)
+
+
+# ---------------------------------------------------------------------
+# trace-numpy
+# ---------------------------------------------------------------------
+
+def rule_trace_numpy(a: Analyzer) -> None:
+    for fi in a.project.traced_functions().values():
+        mod = fi.module
+        tainted = a.project.tainted_locals(fi)
+        for node in walk_scope(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            np_attr = _is_numpy_call(mod, node)
+            if np_attr is None or np_attr in _NUMPY_SAFE_ATTRS or \
+                    np_attr in ("asarray", "array", "random"):
+                continue  # asarray/array: rule trace-host-sync's beat
+            if _args_tainted(node, tainted):
+                a.emit("trace-numpy", mod, node,
+                       f"np.{np_attr}() applied to a traced value in "
+                       f"`{fi.qualname}`: numpy can't trace — use the "
+                       "jnp equivalent", severity="warning",
+                       symbol=fi.qualname, scope_line=fi.lineno)
+
+
+# ---------------------------------------------------------------------
+# async-blocking
+# ---------------------------------------------------------------------
+
+def rule_async_blocking(a: Analyzer) -> None:
+    for mod in a.project.modules.values():
+        for fi in mod.functions.values():
+            if not fi.is_async:
+                continue
+            for node in walk_scope(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _resolved_callee(mod, node)
+                blocking = (
+                    callee in _BLOCKING_CALLS
+                    or callee.startswith(_BLOCKING_PREFIXES))
+                if callee == "open" and not _inside_lambda(mod, node):
+                    a.emit("async-blocking", mod, node,
+                           f"sync file I/O (open) in `async def "
+                           f"{fi.qualname}` blocks the daemon's event "
+                           "loop (asyncio.to_thread it)",
+                           symbol=fi.qualname, scope_line=fi.lineno)
+                elif blocking and not _inside_lambda(mod, node):
+                    a.emit("async-blocking", mod, node,
+                           f"{callee}() in `async def {fi.qualname}` "
+                           "blocks the event loop for every task on "
+                           "this daemon (await an async equivalent or "
+                           "asyncio.to_thread)",
+                           symbol=fi.qualname, scope_line=fi.lineno)
+
+
+def _inside_lambda(mod, node: ast.AST) -> bool:
+    """Calls inside a lambda run later (often shipped to an executor);
+    the lambda boundary gets the benefit of the doubt."""
+    cur = node
+    while cur is not None:
+        cur = mod.parents.get(cur)
+        if isinstance(cur, ast.Lambda):
+            return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+    return False
+
+
+# ---------------------------------------------------------------------
+# lock-no-await
+# ---------------------------------------------------------------------
+
+def _class_lock_attrs(project) -> Dict[str, Set[str]]:
+    """class name -> asyncio-lock attrs it assigns, across modules."""
+    out: Dict[str, Set[str]] = {}
+    for mod in project.modules.values():
+        for cls, attrs in mod.lock_attrs.items():
+            out.setdefault(cls, set()).update(attrs)
+    return out
+
+
+def _is_lock_attr(mod, node: ast.AST, attr: str,
+                  by_class: Dict[str, Set[str]]) -> bool:
+    """True when `self.<attr>` resolves to an asyncio lock of the
+    ENCLOSING class.  Name-keyed project-wide matching would turn a
+    same-named threading.Lock in an unrelated class into a finding, so
+    only `self.` accesses bindable to their class are judged."""
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        cur = mod.parents.get(cur)
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for fi in mod.functions.values():
+                if fi.node is cur:
+                    return bool(fi.parent_class) and \
+                        attr in by_class.get(fi.parent_class, ())
+            return False
+    return False
+
+
+def rule_lock_no_await(a: Analyzer) -> None:
+    by_class = _class_lock_attrs(a.project)
+    for mod in a.project.modules.values():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "acquire":
+                base = node.func.value
+                if isinstance(base, ast.Attribute) and \
+                        isinstance(base.value, ast.Name) and \
+                        base.value.id == "self" and \
+                        _is_lock_attr(mod, node, base.attr, by_class) \
+                        and not isinstance(
+                            mod.parents.get(node), ast.Await):
+                    sym = _enclosing_qualname(mod, node)
+                    a.emit("lock-no-await", mod, node,
+                           f"asyncio.Lock `{base.attr}`.acquire() "
+                           "without await: returns a coroutine, the "
+                           "lock is never taken",
+                           symbol=sym,
+                           scope_line=_scope_line(mod, node))
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Attribute) and \
+                            isinstance(expr.value, ast.Name) and \
+                            expr.value.id == "self" and \
+                            _is_lock_attr(mod, node, expr.attr,
+                                          by_class):
+                        sym = _enclosing_qualname(mod, node)
+                        a.emit("lock-no-await", mod, node,
+                               f"sync `with` on asyncio.Lock "
+                               f"`{expr.attr}`: needs `async with`",
+                               symbol=sym,
+                               scope_line=_scope_line(mod, node))
+
+
+def default_rules() -> Dict[str, object]:
+    # lock-order lives in lockgraph.py (it needs the whole-project
+    # graph); imported here to keep one registry
+    from ceph_tpu.analysis.lockgraph import rule_lock_order
+    return {
+        "trace-side-effect": rule_trace_side_effect,
+        "trace-host-sync": rule_trace_host_sync,
+        "uint8-overflow": rule_uint8_overflow,
+        "trace-static-hazard": rule_trace_static_hazard,
+        "trace-numpy": rule_trace_numpy,
+        "async-blocking": rule_async_blocking,
+        "lock-order": rule_lock_order,
+        "lock-no-await": rule_lock_no_await,
+    }

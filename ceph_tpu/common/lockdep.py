@@ -29,7 +29,8 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
-from typing import Dict, List, Set
+import weakref
+from typing import Dict, List, Optional, Set
 
 log = logging.getLogger("lockdep")
 
@@ -37,8 +38,14 @@ enabled = os.environ.get("CEPH_TPU_LOCKDEP", "0") == "1"
 
 # class -> classes acquired while holding it
 _edges: Dict[str, Set[str]] = {}
-# id(task) -> stack of held lock classes
-_held: Dict[int, List[str]] = {}
+# task -> stack of held lock classes.  Keyed by the Task OBJECT under
+# a weak reference, never id(task): a task that dies with entries on
+# its stack (legal — see _ObjLockCtx's cross-task handoff in the OSD
+# recovery wave) must not bequeath phantom "held" locks to a later
+# task that happens to recycle its id, which would fabricate order
+# edges between locks no task ever nested.
+_held: "weakref.WeakKeyDictionary[asyncio.Task, List[str]]" = \
+    weakref.WeakKeyDictionary()
 
 
 class LockOrderInversion(Exception):
@@ -60,15 +67,19 @@ def _reachable(src: str, dst: str) -> bool:
     return False
 
 
-def acquire(cls: str) -> None:
+def acquire(cls: str) -> Optional[asyncio.Task]:
     """Record an acquisition of lock class `cls` by the current task;
-    raises LockOrderInversion on a would-be cycle."""
+    raises LockOrderInversion on a would-be cycle.  Returns the task
+    whose stack recorded it, for callers that may release from a
+    different task (pass it back to `release`)."""
     if not enabled:
-        return
+        return None
     task = asyncio.current_task()
     if task is None:
-        return
-    held = _held.setdefault(id(task), [])
+        return None
+    held = _held.get(task)
+    if held is None:
+        held = _held[task] = []
     for h in held:
         if h == cls:
             continue  # same-class nesting: allowed (see docstring)
@@ -81,15 +92,20 @@ def acquire(cls: str) -> None:
                 raise LockOrderInversion(order)
             _edges.setdefault(h, set()).add(cls)
     held.append(cls)
+    return task
 
 
-def release(cls: str) -> None:
+def release(cls: str, task: Optional[asyncio.Task] = None) -> None:
+    """Drop `cls` from a task's held stack — by default the current
+    task's; pass the task `acquire` returned when the releasing task
+    differs from the acquiring one (lock handed across tasks)."""
     if not enabled:
         return
-    task = asyncio.current_task()
+    if task is None:
+        task = asyncio.current_task()
     if task is None:
         return
-    held = _held.get(id(task))
+    held = _held.get(task)
     if held:
         try:
             held.reverse()
@@ -98,7 +114,7 @@ def release(cls: str) -> None:
         except ValueError:
             pass
         if not held:
-            _held.pop(id(task), None)
+            _held.pop(task, None)
 
 
 def reset() -> None:
@@ -107,23 +123,59 @@ def reset() -> None:
     _held.clear()
 
 
+async def _tracked_acquire(lock: asyncio.Lock,
+                           cls: str) -> Optional[asyncio.Task]:
+    """The one copy of the acquire pairing: record with lockdep, take
+    the lock, un-record if the take itself fails (cancellation while
+    queued).  Returns the recording task for cross-task release."""
+    task = acquire(cls)
+    try:
+        await lock.acquire()
+    except BaseException:
+        release(cls, task)
+        raise
+    return task
+
+
+class Lock(asyncio.Lock):
+    """asyncio.Lock whose `async with` feeds the order graph under a
+    fixed class name: `self._mutation_lock = lockdep.Lock("mds.mutation")`.
+    The class string follows the static analyzer's labeling (module
+    tail + attr name stripped of `_lock`), so runtime-observed edges
+    line up 1:1 with ceph_tpu/analysis/lockgraph.py's graph."""
+
+    def __init__(self, cls: str):
+        super().__init__()
+        self.lockdep_class = cls
+
+    async def __aenter__(self):
+        # enter/exit of one `async with` always run in the same task,
+        # so the current-task release below is the right stack; no
+        # per-entry state may live on self (a waiter queued inside
+        # __aenter__ would race the holder's __aexit__)
+        await _tracked_acquire(self, self.lockdep_class)
+        return None
+
+    async def __aexit__(self, *exc):
+        self.release()
+        release(self.lockdep_class)
+
+
 class guard:
     """Async context manager pairing an asyncio.Lock with lockdep
-    tracking: `async with lockdep.guard(lock, "mds.mutation"): ...`"""
+    tracking: `async with lockdep.guard(lock, "mds.mutation"): ...`
+    Single-use per instance; the instance remembers the acquiring
+    task, so exiting from a different task releases correctly."""
 
     def __init__(self, lock: asyncio.Lock, cls: str):
         self._lock = lock
         self._cls = cls
+        self._task: Optional[asyncio.Task] = None
 
     async def __aenter__(self):
-        acquire(self._cls)
-        try:
-            await self._lock.acquire()
-        except BaseException:
-            release(self._cls)
-            raise
+        self._task = await _tracked_acquire(self._lock, self._cls)
         return self
 
     async def __aexit__(self, *exc):
         self._lock.release()
-        release(self._cls)
+        release(self._cls, self._task)

@@ -72,6 +72,7 @@ import logging
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from ceph_tpu.common import lockdep
 from ceph_tpu.msg import Connection, Messenger
 from ceph_tpu.msg.messages import (
     MClientCaps,
@@ -233,7 +234,7 @@ class MDSDaemon:
         self._stopping = False
         # namespace mutations serialize through one lock (the MDS's
         # whole reason to exist); reads go lock-free off the cache
-        self._mutation_lock = asyncio.Lock()
+        self._mutation_lock = lockdep.Lock("mds.mutation")
         # journal state (valid while active)
         self._epoch = 0        # fencing epoch from journal take_over
         self._seq = 0          # next journal sequence
@@ -256,7 +257,7 @@ class MDSDaemon:
         # MClientCaps revoke/ack round trips whose acks carry the
         # holder's dirty attrs (the cap-flush discipline).
         self._caps: Dict[int, Dict[Any, str]] = {}
-        self._caps_lock = asyncio.Lock()
+        self._caps_lock = lockdep.Lock("mds.caps")
         self._cap_tid = 0
         self._cap_acks: Dict[int, asyncio.Future] = {}
         self.cap_revoke_timeout = 3.0

@@ -36,6 +36,7 @@ import logging
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ceph_tpu.common import lockdep
 from ceph_tpu.ec.registry import create_erasure_code
 from ceph_tpu.mon import paxos as paxos_mod
 from ceph_tpu.msg import Connection, Messenger
@@ -134,7 +135,7 @@ class MonDaemon:
         # one map mutation in flight at a time (the PaxosService
         # single-proposal round): handlers read the map, build an
         # incremental, and propose under this lock
-        self._mutation_lock = asyncio.Lock()
+        self._mutation_lock = lockdep.Lock("mon.mutation")
         # centralized config (ConfigMonitor role): {section: {k: v}},
         # quorum-replicated through paxos, pushed to subscribers
         self._config_kv: Dict[str, Dict[str, str]] = {}

@@ -32,6 +32,7 @@ import random
 import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Set
 
+from ceph_tpu.common import lockdep
 from ceph_tpu.msg.messages import MMonElection, MMonPaxos
 
 log = logging.getLogger("mon.paxos")
@@ -480,7 +481,7 @@ class Paxos:
         self._accepts: Set[int] = set()
         self._begin_version = 0
         self._accept_event: Optional[asyncio.Event] = None
-        self._propose_lock = asyncio.Lock()
+        self._propose_lock = lockdep.Lock("paxos.propose")
         self._lease_task: Optional[asyncio.Task] = None
         self.on_leader_dead: Optional[Callable[[], Awaitable[None]]] = \
             None

@@ -38,7 +38,7 @@ import logging
 import random
 from typing import Awaitable, Callable, Dict, Optional
 
-from ceph_tpu.common import auth
+from ceph_tpu.common import auth, lockdep
 from ceph_tpu.msg import frames
 from ceph_tpu.msg.messages import Message, MHello, decode_message
 
@@ -71,7 +71,7 @@ class Connection:
         self.peer_addr = peer_addr
         self.outbound = outbound
         self._seq = itertools.count()
-        self._send_lock = asyncio.Lock()
+        self._send_lock = lockdep.Lock("msg.send")
         self.closed = False
         # cephx session state
         self.session_key: Optional[bytes] = None

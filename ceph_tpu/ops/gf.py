@@ -259,12 +259,17 @@ if HAVE_JAX:
         return bits.reshape(*data.shape[:-2], k * 8, s)
 
     def _pack_bits(bits):
-        """(..., 8R, S) bits -> (..., R, S) uint8 (LSB-first per byte)."""
+        """(..., 8R, S) bits -> (..., R, S) uint8 (LSB-first per byte).
+
+        Bit weighting runs in int32 (TPU-native lane width): the 0/1
+        planes times powers-of-two stay exact, and no uint8 `<<`/`*`
+        can wrap if a weight or plane is ever wrong upstream.
+        """
         r8, s = bits.shape[-2], bits.shape[-1]
         r = r8 // 8
-        b = bits.reshape(*bits.shape[:-2], r, 8, s).astype(jnp.uint8)
-        weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))[None, :, None]
-        return jnp.sum(b * weights, axis=-2, dtype=jnp.uint8)
+        b = bits.reshape(*bits.shape[:-2], r, 8, s).astype(jnp.int32)
+        weights = (jnp.int32(1) << jnp.arange(8, dtype=jnp.int32))[None, :, None]
+        return jnp.sum(b * weights, axis=-2).astype(jnp.uint8)
 
     @functools.partial(jax.jit, static_argnames=())
     def gf2_matmul_bytes(mbits, data):

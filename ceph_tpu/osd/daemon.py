@@ -243,21 +243,25 @@ class _ObjLockCtx:
     async def __aenter__(self):
         if lockdep.enabled:
             self._cls = _lock_class(self._oid)
-            lockdep.acquire(self._cls)
+            # remember the acquiring task: the recovery wave enters in
+            # gather() subtasks and exits from the parent, and the
+            # release must come off the stack the acquire went onto
+            self._ld_task = lockdep.acquire(self._cls)
         self._entry[1] += 1
         try:
             await self._entry[0].acquire()
         except BaseException:
             self._entry[1] -= 1
             if lockdep.enabled:
-                lockdep.release(self._cls)
+                lockdep.release(self._cls, getattr(
+                    self, "_ld_task", None))
             raise
         return self
 
     async def __aexit__(self, *exc):
         self._entry[0].release()
         if lockdep.enabled and getattr(self, "_cls", None):
-            lockdep.release(self._cls)
+            lockdep.release(self._cls, getattr(self, "_ld_task", None))
         self._entry[1] -= 1
         if self._entry[1] == 0 and \
                 self._table.get(self._oid) is self._entry:

@@ -24,6 +24,7 @@ Decode runs the same matmul with host-inverted decode rows
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import List, Optional
 
 import jax
@@ -33,6 +34,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ceph_tpu.ops import checksum as cks
 from ceph_tpu.ops import gf
+
+
+def _shard_map(f, *, mesh, in_specs, out_specs):
+    """jax.shard_map with the pre-0.6 spelling as fallback: older jax
+    ships it as jax.experimental.shard_map.shard_map, and the
+    replication-check knob was renamed check_rep -> check_vma
+    independently of the move, so pick it off the actual signature
+    (0.5.x-era releases have jax.shard_map but still say check_rep)."""
+    if hasattr(jax, "shard_map"):
+        params = inspect.signature(jax.shard_map).parameters
+        knob = "check_vma" if "check_vma" in params else "check_rep"
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **{knob: False})
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
 
 
 class ShardedPipeline:
@@ -93,12 +110,11 @@ class ShardedPipeline:
                 placement = jnp.zeros((pgs.shape[0], 1), dtype=jnp.int32)
             return parity, crc, placement
 
-        shard = jax.shard_map(
+        shard = _shard_map(
             functools.partial(local_step, self._mbits),
             mesh=mesh,
             in_specs=(P("dp", None, "sp"), P("dp")),
             out_specs=(P("dp", None, "sp"), P("dp"), P("dp")),
-            check_vma=False,
         )
         return jax.jit(shard)
 
@@ -136,11 +152,10 @@ class ShardedPipeline:
             def local(dmat_bits, survivors):
                 return gf.gf2_matmul_bytes(dmat_bits, survivors)
 
-            shard = jax.shard_map(
+            shard = _shard_map(
                 local, mesh=mesh,
                 in_specs=(P(), P("dp", None, "sp")),
                 out_specs=P("dp", None, "sp"),
-                check_vma=False,
             )
             fn = jax.jit(shard)
             self._decode_cache[rows] = fn
@@ -210,6 +225,6 @@ class ShardedPipeline:
     def _jit_words(self, local, runtime_mat: bool = False):
         spec = P("dp", None, None, None)
         in_specs = (P(), spec) if runtime_mat else (spec,)
-        return jax.jit(jax.shard_map(
+        return jax.jit(_shard_map(
             local, mesh=self.mesh, in_specs=in_specs,
-            out_specs=spec, check_vma=False))
+            out_specs=spec))

@@ -55,6 +55,7 @@ import json
 import time
 from typing import Any, Dict, List, Optional
 
+from ceph_tpu.common import lockdep
 from ceph_tpu.rados.client import IoCtx, ObjectNotFound, RadosError
 
 RBD_DIRECTORY = "rbd_directory"
@@ -511,12 +512,12 @@ class Image:
         # _om_lock serializes load+mutate so parallel per-object write
         # tasks can never fork the bitmap and lose marks
         self._om_cache: Optional[bytearray] = None
-        self._om_lock = asyncio.Lock()
+        self._om_lock = lockdep.Lock("rbd.om")
         # serializes absent-check + copyup: without it two concurrent
         # partial writes to one absent object both copy up and the
         # second copyup erases the first write's chunk (librbd guards
         # this with a server-side object-absent condition)
-        self._copyup_lock = asyncio.Lock()
+        self._copyup_lock = lockdep.Lock("rbd.copyup")
         # journaling (feature-gated): write-ahead event log; see
         # ceph_tpu.rbd.journal.  _replaying suppresses re-journaling
         # while replay applies events through the ordinary op methods

@@ -23,6 +23,7 @@ import asyncio
 import json
 from typing import Any, Dict
 
+from ceph_tpu.common import lockdep
 from ceph_tpu.common.encoding import DecodeError, Decoder, Encoder
 
 DEFAULT_CHUNK_MAX = 4 << 20  # rollover threshold per journal object
@@ -81,7 +82,7 @@ class ImageJournal:
         self.hdr: Dict[str, Any] = {}
         self.seq = 0          # last allocated
         self._active_size = 0
-        self._append_lock = asyncio.Lock()
+        self._append_lock = lockdep.Lock("journal.append")
         # out-of-order completions (concurrent writes): the commit
         # POSITION only advances over a CONTIGUOUS prefix — marking
         # seq N committed while N-1 is still applying must not let a

@@ -71,15 +71,27 @@ def _payload(length: int, offset: int) -> bytes:
     return pat * length
 
 
+async def _as_aiter(lines):
+    """Normalize a sync or async line iterable to async, so callers
+    can stream (fileio.iter_lines) or pass a plain list."""
+    if hasattr(lines, "__aiter__"):
+        async for line in lines:
+            yield line
+    else:
+        for line in lines:
+            yield line
+
+
 async def replay_trace(lines, image: Image, speed: float = 1.0,
                        max_lag: float = 30.0) -> Dict[str, Any]:
     """Re-execute a recorded trace against `image`, pacing ops by
     their recorded timestamps scaled by 1/speed (speed=0 -> as fast
-    as possible).  Returns {ops, reads, writes, elapsed_s}."""
+    as possible).  `lines` may be any sync or async iterable of trace
+    lines.  Returns {ops, reads, writes, elapsed_s}."""
     stats = {"ops": 0, "reads": 0, "writes": 0}
     t0 = time.perf_counter()   # pacing clock (rebased on capped gaps)
     t_start = t0               # wall clock (never rebased)
-    for line in lines:
+    async for line in _as_aiter(lines):
         line = line.strip()
         if not line:
             continue

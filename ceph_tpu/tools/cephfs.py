@@ -21,6 +21,7 @@ import sys
 
 from ceph_tpu.cephfs import CephFS, CephFSError
 from ceph_tpu.rados.client import RadosClient
+from ceph_tpu.tools import fileio
 
 
 def main(argv=None) -> int:
@@ -113,8 +114,8 @@ async def _dispatch(fs: CephFS, args) -> int:
         await fs.rename(args.src, args.dst)
         return 0
     if cmd == "put":
-        data = sys.stdin.buffer.read() if args.local == "-" else \
-            open(args.local, "rb").read()
+        data = await fileio.read_stdin() if args.local == "-" else \
+            await fileio.read_bytes(args.local)
         await fs.write_file(args.path, data)
         return 0
     if cmd in ("get", "cat"):
@@ -122,8 +123,7 @@ async def _dispatch(fs: CephFS, args) -> int:
         if cmd == "cat" or args.local == "-":
             sys.stdout.buffer.write(data)
         else:
-            with open(args.local, "wb") as fh:
-                fh.write(data)
+            await fileio.write_bytes(args.local, data)
         return 0
     if cmd == "snap":
         if args.verb == "create":

@@ -27,6 +27,7 @@ import time
 import numpy as np
 
 from ceph_tpu.rados.client import RadosClient, RadosError
+from ceph_tpu.tools import fileio
 
 
 def _out(obj) -> None:
@@ -36,8 +37,7 @@ def _out(obj) -> None:
 async def _run(args) -> int:
     secret = args.secret
     if not secret and args.keyring:
-        with open(args.keyring) as f:
-            secret = f.read().strip()
+        secret = (await fileio.read_text(args.keyring)).strip()
     client = RadosClient(args.mon, secret=secret or None)
     await client.connect()
     try:
@@ -85,8 +85,8 @@ async def _dispatch(client: RadosClient, args) -> int:
         return 2
     io = client.open_ioctx(args.pool)
     if cmd == "put":
-        data = sys.stdin.buffer.read() if args.file == "-" else \
-            open(args.file, "rb").read()
+        data = await fileio.read_stdin() if args.file == "-" else \
+            await fileio.read_bytes(args.file)
         await io.write_full(args.obj, data)
         return 0
     if cmd == "get":
@@ -94,12 +94,11 @@ async def _dispatch(client: RadosClient, args) -> int:
         if args.file == "-":
             sys.stdout.buffer.write(data)
         else:
-            with open(args.file, "wb") as f:
-                f.write(data)
+            await fileio.write_bytes(args.file, data)
         return 0
     if cmd == "append":
-        data = sys.stdin.buffer.read() if args.file == "-" else \
-            open(args.file, "rb").read()
+        data = await fileio.read_stdin() if args.file == "-" else \
+            await fileio.read_bytes(args.file)
         await io.append(args.obj, data)
         return 0
     if cmd == "rm":

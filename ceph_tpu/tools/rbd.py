@@ -27,6 +27,7 @@ import sys
 
 from ceph_tpu.rados.client import RadosClient, RadosError
 from ceph_tpu.rbd import RBD, Image
+from ceph_tpu.tools import fileio
 
 
 def _size(text: str) -> int:
@@ -126,21 +127,21 @@ async def _dispatch(client, ioctx, rbd: RBD, args) -> int:
         if snap:
             img.snap_set(snap)
         out = sys.stdout.buffer if args.path == "-" \
-            else open(args.path, "wb")
-        step = img.object_size
-        total = img.size()
-        for off in range(0, total, step):
-            out.write(await img.read(off, min(step, total - off)))
-        if out is not sys.stdout.buffer:
-            out.close()
-        await img.close()
+            else await fileio.open_file(args.path, "wb")
+        try:
+            step = img.object_size
+            total = img.size()
+            for off in range(0, total, step):
+                chunk = await img.read(off, min(step, total - off))
+                await asyncio.to_thread(out.write, chunk)
+        finally:
+            if out is not sys.stdout.buffer:
+                await asyncio.to_thread(out.close)  # flush off-loop
+            await img.close()
         return 0
     if cmd == "import":
-        src = sys.stdin.buffer if args.path == "-" \
-            else open(args.path, "rb")
-        data = src.read()
-        if src is not sys.stdin.buffer:
-            src.close()
+        data = await fileio.read_stdin() if args.path == "-" \
+            else await fileio.read_bytes(args.path)
         await rbd.create(ioctx, args.image, len(data),
                          order=args.order)
         img = await rbd.open(ioctx, args.image)
@@ -195,8 +196,10 @@ async def _dispatch(client, ioctx, rbd: RBD, args) -> int:
         from ceph_tpu.rbd.replay import replay_trace
 
         img = await rbd.open(ioctx, args.image)
-        with open(args.trace) as fh:
-            stats = await replay_trace(fh, img, speed=args.speed)
+        # stream the trace off-loop in bounded batches: traces can be
+        # multi-GiB, and a sync file handle would block the loop
+        stats = await replay_trace(fileio.iter_lines(args.trace), img,
+                                   speed=args.speed)
         await img.close()
         print(json.dumps(stats))
         return 0

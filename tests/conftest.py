@@ -12,6 +12,18 @@ is still uninitialized though, so jax.config wins.
 
 import os
 
+# Arm the runtime lock-order detector for the whole tier (the
+# WITH_TSAN-style discipline: detection tooling on in CI, off in
+# production).  Set BEFORE ceph_tpu.common.lockdep is imported — it
+# reads the env at import time — and mirrored onto the module flag in
+# case a plugin already pulled it in.
+os.environ.setdefault("CEPH_TPU_LOCKDEP", "1")
+import sys  # noqa: E402
+
+if "ceph_tpu.common.lockdep" in sys.modules:
+    sys.modules["ceph_tpu.common.lockdep"].enabled = (
+        os.environ["CEPH_TPU_LOCKDEP"] == "1")
+
 
 def pytest_configure(config):
     config.addinivalue_line(

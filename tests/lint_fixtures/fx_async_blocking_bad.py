@@ -1,0 +1,10 @@
+"""Seeded violations: event-loop-blocking calls in an async body."""
+import subprocess
+import time
+
+
+async def daemon_tick():
+    time.sleep(0.1)                 # expect: async-blocking
+    subprocess.run(["true"])        # expect: async-blocking
+    with open("/tmp/state") as fh:  # expect: async-blocking
+        return fh.read()

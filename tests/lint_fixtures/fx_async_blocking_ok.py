@@ -1,0 +1,15 @@
+"""Clean twin: awaited equivalents; sync I/O stays in a sync helper
+shipped to a worker thread."""
+import asyncio
+
+
+def _load(path):
+    with open(path) as fh:
+        return fh.read()
+
+
+async def daemon_tick():
+    await asyncio.sleep(0.1)
+    proc = await asyncio.create_subprocess_exec("true")
+    await proc.wait()
+    return await asyncio.to_thread(_load, "/tmp/state")

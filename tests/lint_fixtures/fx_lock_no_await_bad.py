@@ -1,0 +1,14 @@
+"""Seeded violations: asyncio.Lock misuse — an un-awaited .acquire()
+returns a coroutine (lock never taken); a sync `with` does not
+suspend and raises at runtime."""
+import asyncio
+
+
+class Svc:
+    def __init__(self):
+        self.state_lock = asyncio.Lock()
+
+    async def grab(self):
+        self.state_lock.acquire()  # expect: lock-no-await
+        with self.state_lock:      # expect: lock-no-await
+            pass

@@ -1,0 +1,18 @@
+"""Clean twin: awaited acquisition and `async with`."""
+import asyncio
+
+
+class Svc:
+    def __init__(self):
+        self.state_lock = asyncio.Lock()
+
+    async def grab(self):
+        await self.state_lock.acquire()
+        try:
+            pass
+        finally:
+            self.state_lock.release()
+
+    async def grab_ctx(self):
+        async with self.state_lock:
+            pass

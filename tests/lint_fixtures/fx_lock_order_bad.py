@@ -1,0 +1,20 @@
+"""Seeded violation: two lock classes acquired in both orders — a
+would-be deadlock the moment the two paths interleave.  Both edges of
+the cycle are findings (each acquisition site participates)."""
+import asyncio
+
+
+class Pair:
+    def __init__(self):
+        self.alpha_lock = asyncio.Lock()
+        self.beta_lock = asyncio.Lock()
+
+    async def forward(self):
+        async with self.alpha_lock:
+            async with self.beta_lock:    # expect: lock-order
+                pass
+
+    async def backward(self):
+        async with self.beta_lock:
+            async with self.alpha_lock:   # expect: lock-order
+                pass

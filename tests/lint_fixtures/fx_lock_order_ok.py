@@ -1,0 +1,22 @@
+"""Clean twin: both paths honor one global order (alpha before
+beta), including through a callee (the interprocedural summary)."""
+import asyncio
+
+
+class Pair:
+    def __init__(self):
+        self.alpha_lock = asyncio.Lock()
+        self.beta_lock = asyncio.Lock()
+
+    async def _locked_tail(self):
+        async with self.beta_lock:
+            pass
+
+    async def forward(self):
+        async with self.alpha_lock:
+            async with self.beta_lock:
+                pass
+
+    async def forward_via_call(self):
+        async with self.alpha_lock:
+            await self._locked_tail()

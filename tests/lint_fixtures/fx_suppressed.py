@@ -1,0 +1,21 @@
+"""Every violation here is suppressed: inline `# lint: disable=`,
+comment-above placement, and a file-wide `# lint: disable-file=`.
+The fixture test asserts the analyzer reports nothing."""
+import time
+
+import jax
+import numpy as np
+
+# lint: disable-file=trace-numpy
+
+
+@jax.jit
+def kernel(x):
+    t = time.time()  # lint: disable=trace-side-effect
+    y = np.sqrt(x)
+    return y * t
+
+
+async def tick():
+    # lint: disable=async-blocking
+    time.sleep(0.1)

@@ -1,0 +1,12 @@
+"""Seeded violations: implicit device->host syncs on traced values."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def kernel(x):
+    y = x + 1
+    v = y.item()        # expect: trace-host-sync
+    f = float(y)        # expect: trace-host-sync
+    h = np.asarray(y)   # expect: trace-host-sync
+    return v + f + h

@@ -1,0 +1,8 @@
+"""Seeded violation: bare numpy applied to a traced value."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def kernel(x):
+    return np.tanh(x)  # expect: trace-numpy

@@ -1,0 +1,12 @@
+"""Clean twin: jnp on traced values; host numpy only on host
+constants outside the traced scope."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TABLE = np.arange(8, dtype=np.int32)
+
+
+@jax.jit
+def kernel(x):
+    return jnp.tanh(x) + jnp.asarray(TABLE).sum()

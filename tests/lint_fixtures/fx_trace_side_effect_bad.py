@@ -1,0 +1,17 @@
+"""Seeded violations: Python side effects inside a jit-traced body.
+
+Parsed by tests/test_lint_rules.py, never imported.  `# expect:` marks
+the exact (rule, line) each seeded violation must produce.
+"""
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def kernel(x):
+    print("tracing", x)       # expect: trace-side-effect
+    t = time.time()           # expect: trace-side-effect
+    noise = np.random.rand()  # expect: trace-side-effect
+    return x * t + noise

@@ -1,0 +1,13 @@
+"""Seeded violations: dynamic jit params driving Python control flow
+and shapes — every new value recompiles (traced values even error)."""
+import jax
+
+
+@jax.jit
+def kernel(x, n):
+    acc = x
+    for _ in range(n):  # expect: trace-static-hazard
+        acc = acc + 1
+    if n > 3:           # expect: trace-static-hazard
+        acc = acc * 2
+    return acc

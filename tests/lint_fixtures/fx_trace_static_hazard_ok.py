@@ -1,0 +1,14 @@
+"""Clean twin: the same shape-driving param declared static."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def kernel(x, n):
+    acc = x
+    for _ in range(n):
+        acc = acc + 1
+    if n > 3:
+        acc = acc * 2
+    return acc

@@ -1,0 +1,14 @@
+"""Seeded violations: uint8 arithmetic that wraps silently at 256.
+
+The rule is path-scoped to the GF(2^8)/EC modules; the fixture test
+points it here via the dtype_paths config knob.
+"""
+import numpy as np
+
+
+def accumulate(data):
+    acc = data.astype(np.uint8)
+    total = acc * 3     # expect: uint8-overflow
+    shifted = acc << 1  # expect: uint8-overflow
+    wide = acc.astype(np.int32)
+    return total, shifted, wide + wide

@@ -1,0 +1,11 @@
+"""Clean twin: promoted accumulation and GF-style xor (carry-free,
+cannot overflow) on the same narrow input."""
+import numpy as np
+
+
+def accumulate(data):
+    acc = data.astype(np.int32)
+    total = acc * 3
+    narrow = data.astype(np.uint8)
+    mixed = narrow ^ narrow
+    return (total + total).astype(np.uint8), mixed

@@ -1,0 +1,90 @@
+"""bench.py driver-contract tier: the one-line JSON contract must go
+out within the time budget even when TPU device init hangs (the
+BENCH_r05 rc=124 wedged-tunnel failure), and even when the bench body
+itself dies.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+sys.path.insert(0, REPO)
+import bench  # noqa: E402
+
+CONTRACT_KEYS = {"metric", "value", "unit", "vs_baseline"}
+
+
+def test_contract_line_despite_hanging_backend(tmp_path):
+    """Simulated wedged tunnel: the backend probe hangs forever; the
+    bench must fall back to the host/CPU tier and still print the
+    contract line first, within the budget."""
+    env = dict(os.environ)
+    env.update({
+        # the stubbed backend: hangs until the probe's hard timeout
+        "CEPH_TPU_BENCH_PROBE": "import time; time.sleep(300)",
+        "CEPH_TPU_BENCH_PROBE_TIMEOUT": "1",
+        "CEPH_TPU_BENCH_PROBE_ATTEMPTS": "2",
+        "CEPH_TPU_BENCH_PROBE_RETRY_SLEEP": "0",
+        "CEPH_TPU_BENCH_SMOKE": "1",
+    })
+    r = subprocess.run([sys.executable, BENCH], capture_output=True,
+                       text=True, timeout=240, cwd=str(tmp_path),
+                       env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    stdout_lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert stdout_lines, f"no stdout; stderr: {r.stderr[-2000:]}"
+    contract = json.loads(stdout_lines[0])  # FIRST line, parseable
+    assert set(contract) == CONTRACT_KEYS
+    assert contract["metric"] == "ec_jax_encode_k8m3_4MiB_stripe"
+    assert contract["unit"] == "GiB/s"
+    assert contract["value"] is not None and contract["value"] > 0
+    # details stayed out of stdout (they belong in bench_details.json)
+    assert len(stdout_lines) == 1
+    assert (tmp_path / "bench_details.json").exists()
+
+
+def test_fallback_contract_when_bench_body_dies(monkeypatch, capsys):
+    """Even a crash in main() yields the contract line (null value)."""
+    monkeypatch.setattr(bench, "_ensure_backend", lambda: "cpu")
+    monkeypatch.setattr(
+        bench, "main",
+        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    monkeypatch.setattr(bench, "_contract_emitted", False)
+    assert bench.cli() == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    contract = json.loads(out[0])
+    assert set(contract) == CONTRACT_KEYS
+    assert contract["value"] is None
+
+
+def test_probe_timeout_contained():
+    """A hanging probe is killed at the timeout, not waited out."""
+    env_probe = os.environ.get("CEPH_TPU_BENCH_PROBE")
+    os.environ["CEPH_TPU_BENCH_PROBE"] = "import time; time.sleep(60)"
+    try:
+        assert bench._probe_backend(timeout_s=1.0) is None
+    finally:
+        if env_probe is None:
+            os.environ.pop("CEPH_TPU_BENCH_PROBE", None)
+        else:
+            os.environ["CEPH_TPU_BENCH_PROBE"] = env_probe
+
+
+def test_probe_reports_platform():
+    env_probe = os.environ.get("CEPH_TPU_BENCH_PROBE")
+    os.environ["CEPH_TPU_BENCH_PROBE"] = "print('cpu')"
+    try:
+        assert bench._probe_backend(timeout_s=30.0) == "cpu"
+    finally:
+        if env_probe is None:
+            os.environ.pop("CEPH_TPU_BENCH_PROBE", None)
+        else:
+            os.environ["CEPH_TPU_BENCH_PROBE"] = env_probe

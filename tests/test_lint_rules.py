@@ -1,0 +1,145 @@
+"""Static-analyzer rule tier: every rule fires exactly on its seeded
+fixture violation, stays silent on the clean twin, and the
+suppression/baseline machinery suppresses what it claims to.
+
+Fixtures live in tests/lint_fixtures/ and are parsed, never imported;
+`# expect: <rule>` on a line declares that exactly that (rule, line)
+finding must be produced.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import pytest
+
+from ceph_tpu.analysis import analyze_paths, load_baseline, write_baseline
+
+FIXDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "lint_fixtures")
+EXPECT_RE = re.compile(r"#\s*expect:\s*([\w-]+)")
+
+RULES = [
+    "trace-side-effect",
+    "trace-host-sync",
+    "uint8-overflow",
+    "trace-static-hazard",
+    "trace-numpy",
+    "async-blocking",
+    "lock-order",
+    "lock-no-await",
+]
+
+# the dtype rule is path-scoped to ops/gf + ec/ in production; point it
+# at the fixture family here
+CONFIG = {"dtype_paths": ("fx_uint8",)}
+
+
+def _fixture(name: str) -> str:
+    path = os.path.join(FIXDIR, name)
+    assert os.path.exists(path), f"missing fixture {path}"
+    return path
+
+
+def _expected(path: str) -> set:
+    out = set()
+    with open(path) as fh:
+        for i, line in enumerate(fh, 1):
+            m = EXPECT_RE.search(line)
+            if m:
+                out.add((m.group(1), i))
+    return out
+
+
+def _findings(path: str) -> set:
+    findings, _ = analyze_paths([path], config=CONFIG)
+    return {(f.rule, f.line) for f in findings}
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_rule_fires_exactly_on_seeded_violation(rule):
+    bad = _fixture(f"fx_{rule.replace('-', '_')}_bad.py")
+    expected = _expected(bad)
+    assert expected, f"{bad} declares no `# expect:` markers"
+    assert _findings(bad) == expected
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_rule_silent_on_clean_twin(rule):
+    ok = _fixture(f"fx_{rule.replace('-', '_')}_ok.py")
+    assert _findings(ok) == set()
+
+
+def test_inline_and_file_suppressions_silence_findings():
+    assert _findings(_fixture("fx_suppressed.py")) == set()
+
+
+def test_baseline_suppresses_old_but_not_new_findings(tmp_path):
+    bad = _fixture("fx_async_blocking_bad.py")
+    findings, _ = analyze_paths([bad])
+    assert findings
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(str(bl_path), findings)
+    baseline = load_baseline(str(bl_path))
+    assert all(f in baseline for f in findings)
+    fresh, _ = analyze_paths([_fixture("fx_trace_numpy_bad.py")])
+    assert fresh
+    assert all(f not in baseline for f in fresh)
+    assert not baseline.stale(findings)
+    assert baseline.stale(fresh)  # none of the old entries are live
+
+
+def test_suppressions_in_strings_are_inert(tmp_path):
+    """Only real comment tokens may suppress — a docstring or error
+    message *describing* the `# lint: disable=` syntax must not
+    disable rules for the file."""
+    src = tmp_path / "doc.py"
+    src.write_text(
+        '"""Silence with # lint: disable-file=async-blocking."""\n'
+        "import time\n"
+        'MSG = "add # lint: disable=async-blocking to silence"\n'
+        "async def f():\n"
+        "    time.sleep(1)\n"
+        "async def g():\n"
+        "    time.sleep(1)  # lint: disable=async-blocking\n")
+    from ceph_tpu.analysis.core import parse_module
+    mod = parse_module(str(src))
+    assert mod.file_suppress == set()
+    assert list(mod.suppress) == [7]      # only the real comment
+    assert _findings(str(src)) == {("async-blocking", 5)}
+
+
+def test_relative_imports_anchor_like_python(tmp_path):
+    """`from .sub import f` in pkg/__init__.py must resolve to
+    pkg.sub (Python anchors level 1 at the package itself there, at
+    the parent package for a plain module) — a mis-anchored import
+    table silently drops cross-module traced-set and lock edges."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text(
+        "from .sub import helper\nfrom . import sub\n")
+    (pkg / "sub.py").write_text(
+        "from .other import thing\ndef helper():\n    pass\n")
+    (pkg / "other.py").write_text("def thing():\n    pass\n")
+    from ceph_tpu.analysis.core import build_project
+    proj = build_project([str(pkg)])
+    init = proj.modules["pkg"]
+    assert init.imports["helper"] == ("pkg.sub", "helper")
+    assert init.imports["sub"] == ("pkg.sub", None)
+    assert proj.modules["pkg.sub"].imports["thing"] == \
+        ("pkg.other", "thing")
+
+
+def test_fingerprint_survives_line_drift(tmp_path):
+    """The baseline keys on (rule, file, symbol, line text), not line
+    numbers — unrelated edits above a finding must not un-baseline it."""
+    bad = _fixture("fx_trace_numpy_bad.py")
+    before, _ = analyze_paths([bad])
+    shifted = tmp_path / os.path.basename(bad)
+    with open(bad) as fh:
+        shifted.write_text("# padding line\n# padding line\n" + fh.read())
+    after, _ = analyze_paths([str(shifted)])
+    assert {f.fingerprint for f in before} == \
+        {f.fingerprint for f in after}
+    assert sorted(f.line for f in after) != sorted(f.line for f in before)

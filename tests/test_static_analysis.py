@@ -1,0 +1,132 @@
+"""Tier-1 gate for ceph_tpu.analysis: the whole package must be clean
+or baselined, the CLI exit-code contract must hold, and every lock
+order the RUNTIME detector observed during this test session must be
+explained by the STATIC order graph (rule lock-order) — the
+lint-time/run-time cross-check of the lockdep discipline.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+
+import pytest
+
+import ceph_tpu
+from ceph_tpu.analysis import (
+    analyze_paths, build_lock_graph, default_baseline_path,
+    load_baseline,
+)
+from ceph_tpu.analysis.__main__ import main as lint_main
+from ceph_tpu.common import lockdep
+
+PKG = os.path.dirname(os.path.abspath(ceph_tpu.__file__))
+
+# Runtime-observed lock-order edges accepted WITHOUT a static-graph
+# witness, each with its justification (the "baselined against" escape
+# for dynamic dispatch the AST pass cannot see).  Keep empty unless a
+# test demonstrably exercises such a path.
+RUNTIME_EDGE_BASELINE: dict = {
+    ("osd.clslock", "osd.objlock"):
+        "_op_call holds the cls lock and invokes the registered cls "
+        "method through a function value (`fn(ctx, data)`); the method "
+        "body re-enters _op_write_full/_op_remove which take the "
+        "object lock.  The registry indirection is invisible to the "
+        "AST call resolver; order is safe — no path takes objlock "
+        "then clslock (exec is only reachable from the op dispatcher).",
+}
+
+
+@pytest.fixture(scope="module")
+def package_analysis():
+    """One shared full-package pass (it costs seconds, not millis)."""
+    return analyze_paths([PKG])
+
+
+def test_package_clean_or_baselined(package_analysis):
+    findings, _ = package_analysis
+    path = default_baseline_path()
+    baseline = load_baseline(path) if path else None
+    new = [f for f in findings
+           if baseline is None or f not in baseline]
+    assert not new, (
+        "new static-analysis findings (fix, suppress inline, or "
+        "baseline with a justification via --write-baseline):\n"
+        + "\n".join(f.render() for f in new))
+
+
+def test_baseline_entries_live_and_justified(package_analysis):
+    """Ratchet hygiene: no stale entries (fixed findings must leave
+    the baseline) and every accepted finding carries a reason."""
+    findings, _ = package_analysis
+    path = default_baseline_path()
+    assert path, "tools/lint_baseline.json missing"
+    baseline = load_baseline(path)
+    stale = baseline.stale(findings)
+    assert not stale, f"stale baseline entries: {stale}"
+    for entry in baseline.entries.values():
+        assert entry.get("justification", "").strip(), (
+            f"baseline entry without justification: {entry}")
+
+
+def test_cli_exit_codes(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    viol = tmp_path / "viol.py"
+    viol.write_text(
+        "import time\n\n\nasync def tick():\n    time.sleep(1)\n")
+    assert lint_main([str(clean)]) == 0
+    assert lint_main([str(viol), "--no-baseline"]) == 1
+    assert lint_main(["--rules", "no-such-rule", str(clean)]) == 2
+    assert lint_main([str(tmp_path / "missing.py")]) == 2
+
+
+def test_cli_module_invocation(tmp_path):
+    """`python -m ceph_tpu.analysis` is the standalone CI gate."""
+    viol = tmp_path / "viol.py"
+    viol.write_text(
+        "import time\n\n\nasync def tick():\n    time.sleep(1)\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.analysis", str(viol),
+         "--no-baseline"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    assert "async-blocking" in r.stdout
+
+
+def test_runtime_lock_edges_subset_of_static(package_analysis):
+    """Every order edge the runtime detector recorded so far this
+    session must be in the static graph (or the edge baseline): the
+    AST pass over-approximates the runtime, never the reverse."""
+    _, project = package_analysis
+    adj, _ = build_lock_graph(project)
+
+    # drive one known static edge through the runtime detector so the
+    # subset check can never pass vacuously
+    async def nest():
+        a = lockdep.Lock("mds.mutation")
+        b = lockdep.Lock("mds.caps")
+        async with a:
+            async with b:
+                pass
+
+    was = lockdep.enabled
+    lockdep.enabled = True
+    try:
+        asyncio.run(nest())
+    finally:
+        lockdep.enabled = was
+    assert "mds.caps" in lockdep._edges.get("mds.mutation", set())
+
+    unexplained = [
+        (src, dst)
+        for src, dsts in lockdep._edges.items()
+        for dst in dsts
+        if dst not in adj.get(src, set())
+        and (src, dst) not in RUNTIME_EDGE_BASELINE]
+    assert not unexplained, (
+        f"runtime lock-order edges missing from the static graph "
+        f"(teach ceph_tpu/analysis/lockgraph.py to see them, or "
+        f"baseline with a justification): {unexplained}")
