@@ -4,9 +4,10 @@ Pure-AST lint pass over the package catching the hazard classes the
 runtime test tier can't see until a test happens to trip them: Python
 side effects and host syncs traced into `@jax.jit` kernels, silent
 uint8 overflow in the GF(2^8) paths, jit recompilation hazards, bare
-numpy on traced arrays, event-loop-blocking calls inside the asyncio
-daemons, static lock-order cycles (the lint-time twin of
-common/lockdep.py), and un-awaited asyncio.Lock acquisition.
+numpy on traced arrays, direct jax.jit in the EC dispatch layers
+bypassing the ExecPlan cache (ec/plan.py), event-loop-blocking calls
+inside the asyncio daemons, static lock-order cycles (the lint-time
+twin of common/lockdep.py), and un-awaited asyncio.Lock acquisition.
 
 Run as a gate:  python -m ceph_tpu.analysis [paths]   (exit 0/1)
 Run in tests:   tests/test_static_analysis.py (tier-1)

@@ -14,6 +14,12 @@ async/daemon safety (the mon/osd/mds/rgw asyncio daemons):
   lock-order           static lock-order cycles (lockdep, at lint time)
   lock-no-await        un-awaited asyncio.Lock acquisition / sync `with`
 
+EC dispatch discipline:
+  jit-bypass-plan      direct jax.jit on shape-polymorphic EC entry
+                       points that bypass the ExecPlan cache
+                       (ceph_tpu/ec/plan.py): every shape retraces and
+                       the compile is invisible to plan.stats()
+
 Every rule walks its own scope only (nested defs are analyzed as their
 own traced/async functions), so findings never double-report.
 """
@@ -24,7 +30,7 @@ import ast
 from typing import Dict, Iterator, Optional, Set
 
 from ceph_tpu.analysis.core import (
-    Analyzer, dotted, dynamic_names_in,
+    Analyzer, _is_jit_expr, dotted, dynamic_names_in,
 )
 
 # numpy/stdlib call classification ------------------------------------
@@ -417,6 +423,59 @@ def _inside_lambda(mod, node: ast.AST) -> bool:
 
 
 # ---------------------------------------------------------------------
+# jit-bypass-plan
+# ---------------------------------------------------------------------
+
+# EC dispatch modules where jit compiles must route through the
+# ExecPlan cache (ceph_tpu/ec/plan.py `tracked_jit` / a plan kind);
+# the plan module itself is the one legitimate jit site.
+_PLAN_PATHS = ("ec/", "ops/gf.py", "parallel/striped.py")
+_PLAN_EXEMPT = ("ec/plan.py",)
+
+
+def rule_jit_bypass_plan(a: Analyzer) -> None:
+    """Direct jax.jit/pjit in the EC dispatch layers: every new shape
+    pays a silent retrace outside the plan cache's bucketing, counters
+    and LRU.  Route through ceph_tpu.ec.plan (tracked_jit or a plan
+    kind), or baseline with a justification."""
+    paths = a.config.get("plan_paths", _PLAN_PATHS)
+    exempt = a.config.get("plan_exempt", _PLAN_EXEMPT)
+    for mod in a.project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if not any(p in rel for p in paths):
+            continue
+        if any(e in rel for e in exempt):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and _is_jit_expr(node.func):
+                a.emit("jit-bypass-plan", mod, node,
+                       "direct jax.jit in the EC dispatch layer "
+                       "bypasses the ExecPlan cache: every new shape "
+                       "retraces unseen by plan.stats() — use "
+                       "ceph_tpu.ec.plan.tracked_jit or a plan kind",
+                       severity="warning",
+                       symbol=_enclosing_qualname(mod, node),
+                       scope_line=_scope_line(mod, node))
+        for fi in mod.functions.values():
+            for dec in fi.node.decorator_list:
+                direct = _is_jit_expr(dec)
+                via_partial = (
+                    isinstance(dec, ast.Call)
+                    and (dotted(dec.func) or "").split(".")[-1]
+                    == "partial" and dec.args
+                    and _is_jit_expr(dec.args[0]))
+                if direct or via_partial:
+                    a.emit("jit-bypass-plan", mod, dec,
+                           f"`{fi.qualname}` is jit-decorated in the "
+                           "EC dispatch layer, bypassing the ExecPlan "
+                           "cache (shape-polymorphic entry points "
+                           "retrace per shape) — route through "
+                           "ceph_tpu.ec.plan",
+                           severity="warning", symbol=fi.qualname,
+                           scope_line=fi.lineno)
+
+
+# ---------------------------------------------------------------------
 # lock-no-await
 # ---------------------------------------------------------------------
 
@@ -494,6 +553,7 @@ def default_rules() -> Dict[str, object]:
         "uint8-overflow": rule_uint8_overflow,
         "trace-static-hazard": rule_trace_static_hazard,
         "trace-numpy": rule_trace_numpy,
+        "jit-bypass-plan": rule_jit_bypass_plan,
         "async-blocking": rule_async_blocking,
         "lock-order": rule_lock_order,
         "lock-no-await": rule_lock_no_await,

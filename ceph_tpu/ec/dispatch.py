@@ -8,7 +8,7 @@ signature (the ErasureCodeIsaTableCache role).
 from __future__ import annotations
 
 from collections import OrderedDict
-from typing import Callable, Hashable
+from typing import Callable, Hashable, Optional
 
 import numpy as np
 
@@ -16,14 +16,26 @@ from ceph_tpu.ops import gf
 
 
 def gf_matmul(mat: np.ndarray, data: np.ndarray, use_tpu: bool,
-              min_bytes: int = 1) -> np.ndarray:
+              min_bytes: int = 1, sig: Optional[str] = None,
+              use_plan: bool = True) -> np.ndarray:
     """(R,K) GF(2^8) matrix x (K,S) or (B,K,S) uint8, device-dispatched.
 
-    The device branch routes through the DEFAULT-MESH sharded pipeline
+    The device branch routes through the ExecPlan cache (ec/plan.py):
+    shapes bucket onto a handful of compiled plans, and the plan
+    delegates to the DEFAULT-MESH sharded pipeline
     (parallel/backend.py) — the daemons' EC path and the multi-chip
     dryrun compile the same program; a single chip is the (1,1) mesh.
+    `sig` is the codec's plan signature; use_plan=False (the
+    --no-plan-cache toggle) dispatches with exact shapes.
     """
     if use_tpu and gf.backend_available() and data.size >= min_bytes:
+        if use_plan:
+            from ceph_tpu.ec import plan
+
+            if plan.enabled():
+                out = plan.matmul(mat, data, sig=sig)
+                if out is not None:
+                    return out
         from ceph_tpu.parallel import backend
 
         out = backend.matmul(mat, data)
@@ -42,7 +54,9 @@ def gf_matmul(mat: np.ndarray, data: np.ndarray, use_tpu: bool,
 
 
 class LruCache:
-    """Tiny bounded LRU (decode tables keyed by erasure signature)."""
+    """Tiny bounded LRU (decode tables keyed by erasure signature,
+    GF multiply tables, compiled ExecPlans).  Overflow evicts the
+    least-recently-used entry only — never the whole store."""
 
     def __init__(self, cap: int = 256):
         self._store: OrderedDict = OrderedDict()
@@ -51,7 +65,28 @@ class LruCache:
     def __len__(self) -> int:
         return len(self._store)
 
+    def __contains__(self, key: Hashable) -> bool:
+        return key in self._store
+
     _MISS = object()
+
+    def peek(self, key: Hashable, default=None):
+        """Lookup + LRU touch without computing on miss (callers that
+        must build outside a lock pair this with put)."""
+        hit = self._store.get(key, self._MISS)
+        if hit is self._MISS:
+            return default
+        self._store.move_to_end(key)
+        return hit
+
+    def put(self, key: Hashable, value) -> None:
+        self._store[key] = value
+        self._store.move_to_end(key)
+        if len(self._store) > self.cap:
+            self._store.popitem(last=False)
+
+    def clear(self) -> None:
+        self._store.clear()
 
     def get_or_compute(self, key: Hashable, compute: Callable):
         hit = self._store.get(key, self._MISS)
@@ -59,7 +94,5 @@ class LruCache:
             self._store.move_to_end(key)
             return hit
         value = compute()
-        self._store[key] = value
-        if len(self._store) > self.cap:
-            self._store.popitem(last=False)
+        self.put(key, value)
         return value

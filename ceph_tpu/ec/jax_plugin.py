@@ -17,13 +17,14 @@ many stripes per dispatch.
 
 from __future__ import annotations
 
-from typing import Dict, Mapping, Set
+from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
 from ceph_tpu.ec import dispatch
 from ceph_tpu.ec.interface import ErasureCode, ErasureCodeError, to_bool, to_int
 from ceph_tpu.models import reed_solomon as rs
+from ceph_tpu.ops import checksum as cks
 from ceph_tpu.ops import gf
 
 LARGEST_VECTOR_WORDSIZE = 16  # layout-parity constant from the reference
@@ -47,6 +48,8 @@ class ErasureCodeJax(ErasureCode):
         self._decode_cache = dispatch.LruCache(256)
         self.use_tpu = True
         self.tpu_min_bytes = 1  # kernel engages for everything unless configured
+        self.use_plan = True    # route device dispatch through ec/plan.py
+        self._plan_sig: str | None = None
 
     # -- init -------------------------------------------------------------
 
@@ -74,6 +77,7 @@ class ErasureCodeJax(ErasureCode):
             self.packetsize = to_int("packetsize", profile, "2048")
         self.use_tpu = to_bool("tpu", profile, "true") and gf.backend_available()
         self.tpu_min_bytes = to_int("tpu-min-bytes", profile, "1")
+        self.use_plan = to_bool("plan-cache", profile, "true")
         self.sanity_check_k_m(self.k, self.m)
         mapping = profile.get("mapping")
         if mapping and len(mapping) != self.k + self.m:
@@ -145,11 +149,24 @@ class ErasureCodeJax(ErasureCode):
 
     # -- kernels ----------------------------------------------------------
 
+    def plan_signature(self) -> str:
+        """Stable-across-processes identity of this codec's generator
+        (the ExecPlan cache key prefix; see ec/plan.py)."""
+        if self._plan_sig is None:
+            from ceph_tpu.ec import plan
+
+            self._plan_sig = plan.codec_signature(
+                self.technique, self.k, self.m, self.w, self.matrix)
+        return self._plan_sig
+
     def _matmul(self, mat: np.ndarray, data: np.ndarray) -> np.ndarray:
         """(R,K) GF matrix x (K,S) or (B,K,S) uint8 -> parity, device-dispatched."""
         if self.w != 8:
             return self._matmul_wide(mat, data)
-        return dispatch.gf_matmul(mat, data, self.use_tpu, self.tpu_min_bytes)
+        sig = self.plan_signature() if mat is self.matrix else None
+        return dispatch.gf_matmul(mat, data, self.use_tpu,
+                                  self.tpu_min_bytes, sig=sig,
+                                  use_plan=self.use_plan)
 
     def _matmul_wide(self, mat: np.ndarray, data: np.ndarray) -> np.ndarray:
         """Host GF(2^w) matmul for w in {16, 32}: chunks viewed as
@@ -234,3 +251,44 @@ class ErasureCodeJax(ErasureCode):
         """(B, k, S) surviving chunks (rows in `have` order) -> erased chunks."""
         dmat = self._decode_matrix(tuple(have), tuple(erasures))
         return self._matmul(dmat, survivors)
+
+    def encode_many(self, datas: Sequence[np.ndarray]
+                    ) -> List[np.ndarray]:
+        """Coalesced encode: N pending (k, S_i) stripes -> parities in
+        order, folded into ONE batched device dispatch (ec/plan.py's
+        StripeCoalescer; ragged widths pad to the common bucket)."""
+        if self.w != 8 or not datas:
+            return [self._matmul(self.matrix, np.asarray(d, np.uint8))
+                    for d in datas]
+        from ceph_tpu.ec import plan
+
+        total = sum(int(np.asarray(d).size) for d in datas)
+        if self.use_tpu and self.use_plan and plan.enabled() \
+                and total >= self.tpu_min_bytes:
+            return plan.encode_coalesced(self.matrix, datas,
+                                         sig=self.plan_signature())
+        return [self._matmul(self.matrix, np.asarray(d, np.uint8))
+                for d in datas]
+
+    def encode_batch_with_crc(self, data: np.ndarray, init: int = 0
+                              ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Fused encode + per-chunk crc32c in one device dispatch:
+        (B, k, S) -> (parity (B, m, S), crcs (B, k+m) uint32 seeded
+        `init`).  None when the fused plan is unavailable (callers
+        fall back to encode + host CRC)."""
+        if self.w != 8 or not self.use_tpu or not self.use_plan:
+            return None
+        from ceph_tpu.ec import plan
+
+        if not plan.enabled():
+            return None
+        out = plan.encode_with_crc(self.matrix, data,
+                                   sig=self.plan_signature())
+        if out is None:
+            return None
+        parity, crcs = out
+        if init:
+            # crc32c(init, chunk) = crc32c_zeros(init, S) ^ crc32c(0, chunk)
+            adv = cks.crc32c_zeros(init & 0xFFFFFFFF, data.shape[-1])
+            crcs = crcs ^ np.uint32(adv)
+        return parity, crcs

@@ -1,0 +1,532 @@
+"""ExecPlan cache: the compile-once, dispatch-few EC device path.
+
+Every encode/decode request used to walk ec/dispatch.gf_matmul ->
+jax.jit with its *exact* array shapes, so each new (k, m, chunk_bytes,
+batch) combination paid a full XLA retrace, small stripes dispatched
+one at a time, and parity + hinfo CRC were separate device round
+trips.  The XOR-EC literature puts most of the win in this regime in
+the scheduling/fusion around the kernel, not the kernel itself
+(arXiv:2108.02692), and batched distributed-matmul work argues for
+folding many small products into few large ones (arXiv:1804.10331) —
+exactly the shape of the many-small-stripes OSD workload.  This module
+is that layer:
+
+* **ExecPlan cache** — compiled callables keyed by (codec signature,
+  kind, bucketed shape).  A plan is built once (the retrace) and then
+  served from the LRU for every request that lands in the same bucket.
+* **Shape bucketing** — chunk_bytes rounds up to quarter-octave
+  buckets (the next {4,5,6,7}/4 * 2^e multiple, >= 64) and the stripe
+  batch to power-of-two buckets; inputs are zero-padded up and outputs
+  sliced back down.  Zero columns produce zero parity columns and
+  padded stripes are dropped, so padding is invisible to callers while
+  real traffic collapses onto a handful of plans.
+* **Stripe coalescing** — `StripeCoalescer` / `encode_coalesced` fold
+  N pending same-profile (K, S_i) encodes into ONE batched (B, K, S)
+  device call: the device-side twin of the host-path fold in
+  ec/dispatch.gf_matmul.
+* **Buffer donation** — on TPU the padded input buffer (which this
+  module itself creates, so no caller-visible aliasing) is donated to
+  the XLA executable; callers that relinquish a device array can opt
+  in with donate=True.  Donation is disabled off-TPU where XLA would
+  warn and ignore it.
+* **Fused encode + crc32c** — `encode_with_crc` returns parity AND the
+  per-chunk (zero-seeded) hinfo crc32c from one dispatch instead of
+  two (ECUtil::HashInfo's ledger rides the encode).
+* **Observability** — `stats()` exposes hit/miss/retrace counters and
+  per-plan dispatch counts/timings; bench.py and the erasure-code
+  benchmark CLI surface them.
+
+Direct `jax.jit` on shape-polymorphic EC entry points is flagged by
+the `jit-bypass-plan` static-analysis rule; route new compiles through
+`tracked_jit` (or a plan kind) so they stay observable and cached.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ceph_tpu.ec.dispatch import LruCache
+from ceph_tpu.ops import checksum as cks
+from ceph_tpu.ops import gf
+
+try:  # plan building needs jax; the module stays importable without it
+    import jax
+    import jax.numpy as jnp
+
+    HAVE_JAX = True
+except Exception:  # pragma: no cover
+    HAVE_JAX = False
+
+__all__ = [
+    "bucket_batch", "bucket_bytes", "clear", "codec_signature",
+    "device_platform", "enabled", "encode", "encode_coalesced",
+    "encode_with_crc", "matmul", "matrix_signature", "plan_key",
+    "reset_stats", "set_enabled", "stats", "StripeCoalescer",
+    "tracked_jit",
+]
+
+# ---------------------------------------------------------------------------
+# State: the plan cache and its counters
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_plans = LruCache(cap=128)
+_mbits_cache = LruCache(cap=64)      # matrix signature -> device bit matrix
+_counters: Dict[str, int] = {"hits": 0, "misses": 0, "retraces": 0}
+_per_plan: Dict[str, Dict[str, float]] = {}
+_enabled = os.environ.get("CEPH_TPU_PLAN_CACHE", "1") != "0"
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> bool:
+    """Flip the plan cache on/off (the CLI --no-plan-cache toggle);
+    returns the previous state so callers can restore it."""
+    global _enabled
+    prev = _enabled
+    _enabled = bool(on)
+    return prev
+
+
+def stats() -> dict:
+    """Snapshot of plan-cache observability counters.
+
+    hits/misses count plan-cache lookups; retraces counts actual XLA
+    traces (each is one compile); per_plan maps plan labels to
+    dispatch counts and cumulative dispatch seconds (host-side
+    dispatch time — device completion is asynchronous).
+    """
+    with _lock:
+        return {
+            **_counters,
+            "plans": len(_plans),
+            "enabled": _enabled,
+            "per_plan": {k: dict(v) for k, v in _per_plan.items()},
+        }
+
+
+def reset_stats() -> None:
+    with _lock:
+        for k in _counters:
+            _counters[k] = 0
+        _per_plan.clear()
+
+
+def clear() -> None:
+    """Drop every cached plan (tests; production never needs this)."""
+    with _lock:
+        _plans.clear()
+        _mbits_cache.clear()
+
+
+def _note_retrace(label: str) -> None:
+    # called from inside traced wrappers: runs once per XLA trace
+    with _lock:
+        _counters["retraces"] += 1
+        entry = _per_plan.setdefault(
+            label, {"dispatches": 0, "seconds": 0.0, "retraces": 0})
+        entry["retraces"] += 1
+
+
+def _note_dispatch(label: str, seconds: float) -> None:
+    with _lock:
+        entry = _per_plan.setdefault(
+            label, {"dispatches": 0, "seconds": 0.0, "retraces": 0})
+        entry["dispatches"] += 1
+        entry["seconds"] += seconds
+
+
+def tracked_jit(label: str, fn: Callable, **jit_kwargs):
+    """jax.jit with plan-cache observability: the wrapper body runs at
+    trace time only, so the retrace counter increments exactly once
+    per XLA compile.  All EC-path compiles must route through here (or
+    a plan kind) — the jit-bypass-plan lint rule enforces it."""
+
+    def traced(*args, **kwargs):
+        _note_retrace(label)
+        return fn(*args, **kwargs)
+
+    traced.__name__ = getattr(fn, "__name__", label)
+    return jax.jit(traced, **jit_kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Bucketing policy
+# ---------------------------------------------------------------------------
+
+_MIN_BYTES_BUCKET = 64
+
+
+def _round_up_quarter_octave(n: int) -> int:
+    """Smallest value >= n of the form q * 2^(e-3), q in {5,6,7,8}:
+    four buckets per octave, worst-case pad < 25%."""
+    if n <= 4:
+        return max(n, 1)
+    e = (n - 1).bit_length()          # n in (2^(e-1), 2^e]
+    step = 1 << max(e - 3, 0)
+    return -(-n // step) * step
+
+
+def bucket_bytes(s: int) -> int:
+    """Bucket for the chunk-byte axis: quarter-octave, floor 64 (so
+    every bucket is a multiple of 16 — divisible by the mesh sp axis
+    and the 4-byte word layout)."""
+    return _round_up_quarter_octave(max(int(s), _MIN_BYTES_BUCKET))
+
+
+def bucket_batch(b: int) -> int:
+    """Bucket for the stripe-batch axis: next power of two up to 512
+    (ragged arrival batches collapse onto log-many plans), then the
+    next multiple of 128 — a big one-shot object must not pad, encode
+    and CRC up to 2x its stripes just to hit a power of two (waste is
+    bounded < 25% above the cap, and batches that large amortize a
+    compile anyway)."""
+    b = max(int(b), 1)
+    if b <= 512:
+        return 1 << (b - 1).bit_length()
+    return -(-b // 128) * 128
+
+
+# ---------------------------------------------------------------------------
+# Signatures and keys (stable across processes: plain ints + sha256 hex)
+# ---------------------------------------------------------------------------
+
+
+def matrix_signature(matrix: np.ndarray, extra: str = "") -> str:
+    """Process-stable identity of a generator/decode matrix."""
+    m = np.ascontiguousarray(matrix, dtype=np.uint8)
+    h = hashlib.sha256()
+    h.update(repr(m.shape).encode())
+    h.update(m.tobytes())
+    if extra:
+        h.update(extra.encode())
+    return h.hexdigest()[:16]
+
+
+def codec_signature(technique: str, k: int, m: int, w: int,
+                    matrix: np.ndarray) -> str:
+    """The ErasureCodeIsaTableCache-style codec signature, hashed so
+    it is stable across processes and restarts."""
+    return matrix_signature(matrix, extra=f"{technique}/k{k}/m{m}/w{w}")
+
+
+def plan_key(sig: str, kind: str, rows: int, k: int,
+             batch: int, chunk_bytes: int,
+             donate: bool = False) -> tuple:
+    """Cache key: (codec signature, kind, bucketed shape).  Pure
+    strings/ints/bools — identical across processes for identical
+    profiles (asserted by the key-stability test)."""
+    return (sig, kind, int(rows), int(k), bucket_batch(batch),
+            bucket_bytes(chunk_bytes) if kind != "encode_crc"
+            else int(chunk_bytes), bool(donate))
+
+
+def _label(key: tuple) -> str:
+    sig, kind, rows, k, bb, bs, don = key
+    return f"{kind}[{sig}] r{rows}k{k} B{bb} S{bs}" + \
+        ("+don" if don else "")
+
+
+# ---------------------------------------------------------------------------
+# ExecPlan
+# ---------------------------------------------------------------------------
+
+
+class ExecPlan:
+    """One compiled dispatch unit: a callable plus its dispatch stats."""
+
+    __slots__ = ("key", "label", "fn", "executor")
+
+    def __init__(self, key: tuple, fn: Callable, executor: str):
+        self.key = key
+        self.label = _label(key)
+        self.fn = fn
+        self.executor = executor
+
+    def __call__(self, *args):
+        t0 = time.perf_counter()
+        out = self.fn(*args)
+        _note_dispatch(self.label, time.perf_counter() - t0)
+        return out
+
+
+def _get_plan(key: tuple, build: Callable[[], ExecPlan]) -> ExecPlan:
+    with _lock:
+        hit = _plans.peek(key)
+        if hit is not None:
+            _counters["hits"] += 1
+            return hit
+        _counters["misses"] += 1
+    plan = build()  # compile outside the lock (can take seconds)
+    with _lock:
+        _plans.put(key, plan)
+    return plan
+
+
+def device_platform() -> Optional[str]:
+    """The jax backend platform ('tpu', 'cpu', ...), None when no
+    backend initializes (callers gate device-only policies on this)."""
+    if not (HAVE_JAX and gf.backend_available()):
+        return None
+    try:
+        return jax.devices()[0].platform
+    except Exception:  # pragma: no cover
+        return None
+
+
+def _donation_usable() -> bool:
+    # off-TPU XLA ignores donation with a warning; don't ask for it
+    return device_platform() == "tpu"
+
+
+def _mbits_for(matrix: np.ndarray):
+    # keyed by matrix CONTENT, never by the caller's sig: a sig only
+    # buys cache locality, correctness must not depend on callers
+    # keeping it matrix-unique
+    m = np.ascontiguousarray(matrix, dtype=np.uint8)
+    return _mbits_cache.get_or_compute(
+        (m.shape, m.tobytes()),
+        lambda: jnp.asarray(gf.gf_matrix_to_bits(m)))
+
+
+def _pad_batch(arr: np.ndarray, bb: int, bs: int) -> np.ndarray:
+    b, k, s = arr.shape
+    if b == bb and s == bs:
+        return arr
+    return np.pad(arr, ((0, bb - b), (0, 0), (0, bs - s)))
+
+
+# ---------------------------------------------------------------------------
+# Plan kinds
+# ---------------------------------------------------------------------------
+
+
+def _build_local_encode(key: tuple, donate: bool) -> ExecPlan:
+    """Single-dispatch XLA bit-matmul plan; the bit matrix rides as a
+    runtime operand so same-geometry matrices share the compile."""
+    kw = {"donate_argnums": (1,)} if donate else {}
+    jfn = tracked_jit(_label(key), gf._gf2_matmul_bytes_impl, **kw)
+
+    def run(mbits, padded_dev):
+        return jfn(mbits, padded_dev)
+
+    return ExecPlan(key, run, "xla_bits" + ("+donate" if donate else ""))
+
+
+def encode(matrix: np.ndarray, data: np.ndarray, sig: str = None,
+           donate: Optional[bool] = None) -> Optional[np.ndarray]:
+    """(B, K, S) or (K, S) uint8 stripes -> parity, plan-cached.
+
+    Donation policy: None (auto) donates only the padded device buffer
+    this function itself creates from host bytes; True asserts the
+    caller relinquishes a device-resident input; False never donates.
+    Off-TPU backends never donate (XLA would ignore it).  Returns None
+    when no jax backend is available.
+    """
+    if not (HAVE_JAX and gf.backend_available()):
+        return None
+    arr = np.asarray(data, dtype=np.uint8) if isinstance(
+        data, np.ndarray) else data
+    host_input = isinstance(arr, np.ndarray)
+    squeeze = False
+    if (arr.ndim if host_input else len(arr.shape)) == 2:
+        arr = arr[None]
+        squeeze = True
+    b, k, s = arr.shape
+    if s == 0:
+        return None
+    rows = int(np.asarray(matrix).shape[0])
+    sig = sig or matrix_signature(matrix)
+    eff_donate = bool(_donation_usable()
+                      and (donate or (donate is None and host_input)))
+    key = plan_key(sig, "encode", rows, k, b, s, donate=eff_donate)
+    plan = _get_plan(
+        key, lambda: _build_local_encode(key, eff_donate))
+    bb, bs = key[4], key[5]
+    if host_input:
+        padded = jnp.asarray(_pad_batch(arr, bb, bs))
+    else:
+        # device-resident input: only donated when the caller opted in
+        # (donate=True), so no defensive copy is ever needed
+        pad = ((0, bb - b), (0, 0), (0, bs - s))
+        padded = jnp.pad(arr, pad) if (bb != b or bs != s) else arr
+    out = np.asarray(plan(_mbits_for(matrix), padded))[:b, :, :s]
+    return out[0] if squeeze else out
+
+
+def _build_mesh_matmul(key: tuple) -> ExecPlan:
+    """Delegate to the default-mesh sharded pipeline (its per-shape
+    jits are tracked_jit'd in parallel/striped.py, so retraces land in
+    the same counters)."""
+    from ceph_tpu.parallel import backend
+
+    return ExecPlan(key, backend.matmul, "mesh")
+
+
+def matmul(mat: np.ndarray, data, sig: str = None
+           ) -> Optional[np.ndarray]:
+    """Plan-cached device GF(2^8) matmul — the ec/dispatch device
+    entry.  Buckets the (B, S) shape, pads, dispatches through the
+    cached plan, slices the real shape back out.  Returns None when no
+    device path applies (caller falls back to host)."""
+    if not (HAVE_JAX and gf.backend_available()):
+        return None
+    if not isinstance(data, np.ndarray):
+        return None
+    arr = np.asarray(data, dtype=np.uint8)
+    squeeze = False
+    if arr.ndim == 2:
+        arr = arr[None]
+        squeeze = True
+    b, k, s = arr.shape
+    if s == 0 or s % 4:
+        return None
+    mat = np.asarray(mat, dtype=np.uint8)
+    rows = mat.shape[0]
+    # decode matrices cycle per erasure signature: key on shape only so
+    # one compile (matrix as runtime operand) serves every signature
+    key = plan_key(sig or "*", "matmul", rows, k, b, s)
+    plan = _get_plan(key, lambda: _build_mesh_matmul(key))
+    bb, bs = key[4], key[5]
+    out = plan(mat, _pad_batch(arr, bb, bs))
+    if out is None:
+        return None
+    out = np.asarray(out)[:b, :, :s]
+    return out[0] if squeeze else out
+
+
+def _build_encode_crc(key: tuple) -> ExecPlan:
+    """Fused parity + per-chunk zero-seeded crc32c in ONE dispatch
+    (parity and the ECUtil::HashInfo ledger used to be two round
+    trips).  The chunk-byte axis is NOT bucketed here — a CRC is
+    length-exact — so the key carries the exact S; only the stripe
+    batch pads (padded stripes' crcs are sliced off with the parity).
+    """
+    s = key[5]
+    consts = cks.make_crc_consts(s)
+
+    def impl(mbits, d):
+        parity = gf._gf2_matmul_bytes_impl(mbits, d)
+        chunks = jnp.concatenate([d, parity], axis=1)
+        bits = cks.crc32c_partial_bits(chunks, consts)
+        return parity, cks.crc32c_pack_bits(bits)
+
+    jfn = tracked_jit(_label(key), impl)
+    return ExecPlan(key, jfn, "xla_bits+crc")
+
+
+def encode_with_crc(matrix: np.ndarray, data: np.ndarray,
+                    sig: str = None
+                    ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(B, K, S) stripes -> (parity (B, M, S), crc (B, K+M) uint32).
+
+    crcs are ZERO-seeded per-chunk crc32c (seed advances are host
+    scalars: crc32c(init, chunk) = crc32c_zeros(init, S) ^ crc0);
+    callers fold them into cumulative HashInfo ledgers.  Returns None
+    when no jax backend is available.
+    """
+    if not (HAVE_JAX and gf.backend_available()):
+        return None
+    arr = np.asarray(data, dtype=np.uint8)
+    assert arr.ndim == 3, arr.shape
+    b, k, s = arr.shape
+    if s == 0:
+        return None
+    rows = int(np.asarray(matrix).shape[0])
+    sig = sig or matrix_signature(matrix)
+    key = plan_key(sig, "encode_crc", rows, k, b, s)
+    plan = _get_plan(key, lambda: _build_encode_crc(key))
+    bb = key[4]
+    padded = jnp.asarray(_pad_batch(arr, bb, s))
+    parity, crcs = plan(_mbits_for(matrix), padded)
+    return (np.asarray(parity)[:b],
+            np.asarray(crcs).astype(np.uint32)[:b])
+
+
+# ---------------------------------------------------------------------------
+# Stripe coalescing
+# ---------------------------------------------------------------------------
+
+
+def encode_coalesced(matrix: np.ndarray,
+                     datas: Sequence[np.ndarray], sig: str = None
+                     ) -> List[np.ndarray]:
+    """Fold N pending same-profile (K, S_i) encodes into batched
+    (B, K, S) device calls — the device twin of the host-path fold in
+    ec/dispatch.gf_matmul.  Stripes are grouped by byte bucket (one
+    2 MiB outlier must not inflate 63 pending 4 KiB stripes to its
+    width), padded to the group bucket, and each parity sliced back to
+    its own width; same-bucket traffic — the common case — stays ONE
+    dispatch.  A jax-free host fallback keeps the contract."""
+    if not datas:
+        return []
+    arrs = [np.asarray(d, dtype=np.uint8) for d in datas]
+    k = arrs[0].shape[0]
+    for a in arrs:
+        assert a.ndim == 2 and a.shape[0] == k, a.shape
+    groups: Dict[int, List[int]] = {}
+    for i, a in enumerate(arrs):
+        groups.setdefault(bucket_bytes(a.shape[1]), []).append(i)
+    out: List[Optional[np.ndarray]] = [None] * len(arrs)
+    for bs, idxs in groups.items():
+        batch = np.zeros((len(idxs), k, bs), dtype=np.uint8)
+        for row, i in enumerate(idxs):
+            batch[row, :, :arrs[i].shape[1]] = arrs[i]
+        parity = encode(matrix, batch, sig=sig)
+        if parity is None:
+            from ceph_tpu.ec import dispatch
+
+            parity = dispatch.gf_matmul(np.asarray(matrix, np.uint8),
+                                        batch, use_tpu=False)
+        for row, i in enumerate(idxs):
+            out[i] = parity[row, :, :arrs[i].shape[1]]
+    return out
+
+
+class StripeCoalescer:
+    """Accumulates pending same-profile encode requests and serves
+    them all from one batched device dispatch on flush().
+
+    The OSD-side usage shape: enqueue each small stripe as it arrives
+    (`add` returns its ticket), flush when the batch window closes,
+    then pick results up by ticket.
+    """
+
+    def __init__(self, matrix: np.ndarray, sig: str = None,
+                 max_pending: int = 64):
+        self.matrix = np.asarray(matrix, dtype=np.uint8)
+        self.sig = sig or matrix_signature(self.matrix)
+        self.max_pending = max_pending
+        self._pending: List[np.ndarray] = []
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    @property
+    def full(self) -> bool:
+        return len(self._pending) >= self.max_pending
+
+    def add(self, data: np.ndarray) -> int:
+        """Queue one (K, S) stripe; returns its ticket (flush-order
+        index)."""
+        arr = np.asarray(data, dtype=np.uint8)
+        assert arr.ndim == 2 and arr.shape[0] == self.matrix.shape[1], \
+            arr.shape
+        self._pending.append(arr)
+        return len(self._pending) - 1
+
+    def flush(self) -> List[np.ndarray]:
+        """Encode everything pending in one batched dispatch; returns
+        parities in ticket order."""
+        pending, self._pending = self._pending, []
+        if not pending:
+            return []
+        return encode_coalesced(self.matrix, pending, sig=self.sig)

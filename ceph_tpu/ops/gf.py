@@ -28,8 +28,6 @@ implementation for tests and for small/latency-sensitive calls.
 
 from __future__ import annotations
 
-import functools
-
 import numpy as np
 
 try:  # JAX is the TPU execution path; numpy path works without it.
@@ -140,27 +138,38 @@ def gf_matmul_ref(m: np.ndarray, d: np.ndarray) -> np.ndarray:
     return out
 
 
-_mul_table_cache: dict = {}
+_mul_table_cache = None  # bounded LRU, built lazily (avoids an import
+#                          cycle: ec.dispatch imports this module)
+
+
+def _table_cache():
+    global _mul_table_cache
+    if _mul_table_cache is None:
+        from ceph_tpu.ec.dispatch import LruCache
+
+        _mul_table_cache = LruCache(cap=64)
+    return _mul_table_cache
 
 
 def gf_mul_tables(m: np.ndarray) -> np.ndarray:
     """(R,K) GF matrix -> (R*K, 256) per-coefficient multiply tables
-    (the jerasure/isa-l table form consumed by the native region ops)."""
+    (the jerasure/isa-l table form consumed by the native region ops).
+    LRU-cached: a decode-heavy workload cycling >64 matrices evicts
+    the coldest table, never the whole cache."""
     m = np.asarray(m, dtype=np.uint8)
-    key = m.tobytes()
-    hit = _mul_table_cache.get(key)
-    if hit is None:
+    key = (m.shape, m.tobytes())
+
+    def compute() -> np.ndarray:
         r, k = m.shape
         idx = np.arange(256, dtype=np.uint8)
-        hit = np.zeros((r * k, 256), dtype=np.uint8)
+        tables = np.zeros((r * k, 256), dtype=np.uint8)
         for j in range(r):
             for i in range(k):
-                hit[j * k + i] = gf_mul(
+                tables[j * k + i] = gf_mul(
                     np.full(256, m[j, i], np.uint8), idx)
-        if len(_mul_table_cache) > 64:
-            _mul_table_cache.clear()
-        _mul_table_cache[key] = hit
-    return hit
+        return tables
+
+    return _table_cache().get_or_compute(key, compute)
 
 
 def gf_matmul_host(m: np.ndarray, d: np.ndarray) -> np.ndarray:
@@ -271,13 +280,16 @@ if HAVE_JAX:
         weights = (jnp.int32(1) << jnp.arange(8, dtype=jnp.int32))[None, :, None]
         return jnp.sum(b * weights, axis=-2).astype(jnp.uint8)
 
-    @functools.partial(jax.jit, static_argnames=())
-    def gf2_matmul_bytes(mbits, data):
+    def _gf2_matmul_bytes_impl(mbits, data):
         """GF(2^8) matmul on the MXU: mbits (8R,8K) 0/1, data (..., K, S) uint8.
 
         Returns (..., R, S) uint8.  The contraction runs as a bf16 matmul
         (exact: sums <= 8K <= 256 < 2^8 representable in bf16's 8-bit
         mantissa... bf16 integers are exact up to 256), then reduced mod 2.
+
+        Untraced body: ec/plan.py jits it per bucketed shape (with
+        donation on TPU); the module-level `gf2_matmul_bytes` below is
+        the fixed-shape compat wrapper for direct/shard_map callers.
         """
         bits = _unpack_bits(data).astype(jnp.bfloat16)
         mb = mbits.astype(jnp.bfloat16)
@@ -293,6 +305,11 @@ if HAVE_JAX:
             prod = jnp.moveaxis(prod, 0, -2)
         par = prod.astype(jnp.int32) & 1
         return _pack_bits(par)
+
+    # Shape-polymorphic jit kept for direct and shard_map callers (an
+    # inner jit is inlined under shard_map); plan-cached dispatch goes
+    # through ec/plan.py, which jits _gf2_matmul_bytes_impl itself.
+    gf2_matmul_bytes = jax.jit(_gf2_matmul_bytes_impl)
 
     def gf_matmul_device(m: np.ndarray, data):
         """(R,K) GF(2^8) matrix x (..., K, S) uint8 through the fastest

@@ -19,6 +19,7 @@ the whole object (SURVEY.md §7 hard part #4).
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Iterable, List, Mapping, Optional, Set, Tuple
 
 import numpy as np
@@ -163,6 +164,12 @@ def encode_with_hinfo(sinfo: StripeInfo, ec_impl, data,
     lib = native.get_lib()
     use_device = bool(getattr(ec_impl, "use_tpu", False)) and \
         len(data) >= getattr(ec_impl, "tpu_min_bytes", 1)
+    if use_device and matrix is not None \
+            and not ec_impl.get_chunk_mapping():
+        fused = _encode_with_hinfo_device(sinfo, ec_impl, data, want,
+                                          logical_len)
+        if fused is not None:
+            return fused
     if (matrix is None or ec_impl.get_chunk_mapping() or lib is None
             or use_device
             or not hasattr(lib, "ceph_tpu_ec_encode_noT")):
@@ -224,6 +231,93 @@ def encode_with_hinfo(sinfo: StripeInfo, ec_impl, data,
     hinfo.cumulative_shard_hashes = [int(c) for c in crcs]
     hinfo.total_chunk_size = stream
     return out, hinfo, (int(lcrc[0]) if logical_len is not None else None)
+
+
+def _fuse_min_bytes() -> Optional[int]:
+    """Object-size floor for the fused device encode+crc path; None
+    disables it.  CEPH_TPU_FUSE_MIN_BYTES overrides (tests set 0).
+    Default: 1 MiB on a real TPU backend — that is where fusing the
+    parity and hinfo-CRC round-trips into one dispatch pays; on the
+    CPU tier the fused path is the native noT kernel below."""
+    env = os.environ.get("CEPH_TPU_FUSE_MIN_BYTES")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            import sys
+
+            # a typo'd knob must not silently disable the fused tier:
+            # warn and fall through to the default policy
+            print(f"# CEPH_TPU_FUSE_MIN_BYTES={env!r} is not an "
+                  "integer; using the default policy",
+                  file=sys.stderr)
+    from ceph_tpu.ec import plan
+
+    return (1 << 20) if plan.device_platform() == "tpu" else None
+
+
+def _encode_with_hinfo_device(sinfo: StripeInfo, ec_impl, data,
+                              want: Iterable[int],
+                              logical_len: Optional[int]):
+    """Fused DEVICE tier of encode_with_hinfo: stripes batch into one
+    (B, k, chunk) plan-cached dispatch that returns parity AND every
+    chunk's zero-seeded crc32c (ec/plan.encode_with_crc), then the
+    per-stripe chunk crcs fold into the cumulative per-shard ledger on
+    host with the streaming identity
+    crc(c, chunk) = crc32c_zeros(c, len) ^ crc32c(0, chunk).
+    Returns None when the fused plan does not apply (callers fall
+    through to the host tiers)."""
+    fmin = _fuse_min_bytes()
+    if fmin is None or len(data) < max(fmin, 1) \
+            or not hasattr(ec_impl, "encode_batch_with_crc"):
+        return None
+    if not isinstance(data, (bytes, bytearray, memoryview)):
+        data = bytes(data)
+    width = sinfo.get_stripe_width()
+    chunk = sinfo.get_chunk_size()
+    if len(data) % width or ec_impl.get_chunk_size(width) != chunk:
+        return None  # the generic path owns the incompatibility error
+    from ceph_tpu.common.buffer import StridedBuf
+
+    n = ec_impl.get_chunk_count()
+    n_stripes = len(data) // width
+    k = width // chunk
+    src = np.frombuffer(data, dtype=np.uint8)
+    arr = src.reshape(n_stripes, k, chunk)
+    out = ec_impl.encode_batch_with_crc(arr, init=0)
+    if out is None:
+        return None
+    parity, crc0 = out          # (B, m, chunk), (B, k+m) zero-seeded
+    hinfo = HashInfo(n)
+    hashes = []
+    for i in range(n):
+        c = 0xFFFFFFFF
+        for s in range(n_stripes):
+            c = cks.crc32c_zeros(c, chunk) ^ int(crc0[s, i])
+        hashes.append(c & 0xFFFFFFFF)
+    hinfo.cumulative_shard_hashes = hashes
+    hinfo.total_chunk_size = n_stripes * chunk
+    # same zero-copy contract as the native tier below: data shards
+    # are strided views of the caller's buffer, parity rows read-only
+    # memoryviews — the stores adopt immutable buffers, no transpose
+    # or defensive copies on the hot path
+    if src.flags.writeable:
+        src.setflags(write=False)
+    want = set(want)
+    shards: Dict[int, object] = {}
+    for i in range(n):
+        if i not in want:
+            continue
+        if i < k:
+            shards[i] = StridedBuf(arr[:, i, :])
+        else:
+            row = np.ascontiguousarray(parity[:, i - k, :]).reshape(-1)
+            row.setflags(write=False)
+            shards[i] = row.data
+    crc = None
+    if logical_len is not None:
+        crc = cks.crc32c(0xFFFFFFFF, memoryview(data)[:logical_len])
+    return shards, hinfo, crc
 
 
 def decode(sinfo: StripeInfo, ec_impl,

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ceph_tpu.ec import plan
 from ceph_tpu.ops import checksum as cks
 from ceph_tpu.ops import gf
 
@@ -116,7 +117,9 @@ class ShardedPipeline:
             in_specs=(P("dp", None, "sp"), P("dp")),
             out_specs=(P("dp", None, "sp"), P("dp"), P("dp")),
         )
-        return jax.jit(shard)
+        return plan.tracked_jit(
+            f"striped.encode k{self.k}m{self.m} S{self.chunk_bytes}",
+            shard)
 
     def data_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, P("dp", None, "sp"))
@@ -157,7 +160,9 @@ class ShardedPipeline:
                 in_specs=(P(), P("dp", None, "sp")),
                 out_specs=P("dp", None, "sp"),
             )
-            fn = jax.jit(shard)
+            fn = plan.tracked_jit(
+                f"striped.matmul r{rows}k{self.k} S{self.chunk_bytes}",
+                shard)
             self._decode_cache[rows] = fn
         return fn
 
@@ -225,6 +230,8 @@ class ShardedPipeline:
     def _jit_words(self, local, runtime_mat: bool = False):
         spec = P("dp", None, None, None)
         in_specs = (P(), spec) if runtime_mat else (spec,)
-        return jax.jit(_shard_map(
-            local, mesh=self.mesh, in_specs=in_specs,
-            out_specs=spec))
+        kind = "runtime" if runtime_mat else "spec"
+        return plan.tracked_jit(
+            f"striped.words.{kind} k{self.k} S{self.chunk_bytes}",
+            _shard_map(local, mesh=self.mesh, in_specs=in_specs,
+                       out_specs=spec))

@@ -1,0 +1,23 @@
+"""Violation: direct jax.jit on shape-polymorphic EC entry points —
+every (batch, chunk) shape retraces outside the ExecPlan cache."""
+
+import functools
+
+import jax
+
+
+def encode_stripes(mbits, data):
+    return mbits @ data
+
+
+encode_fn = jax.jit(encode_stripes)  # expect: jit-bypass-plan
+
+
+@jax.jit  # expect: jit-bypass-plan
+def decode_stripes(dmat_bits, survivors):
+    return dmat_bits @ survivors
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))  # expect: jit-bypass-plan
+def fused_encode(mbits, data):
+    return mbits @ data

@@ -19,7 +19,8 @@ BENCH = os.path.join(REPO, "bench.py")
 sys.path.insert(0, REPO)
 import bench  # noqa: E402
 
-CONTRACT_KEYS = {"metric", "value", "unit", "vs_baseline"}
+CONTRACT_KEYS = {"metric", "value", "unit", "vs_baseline",
+                 "plan_cache", "truncated"}
 
 
 def test_contract_line_despite_hanging_backend(tmp_path):
@@ -46,9 +47,16 @@ def test_contract_line_despite_hanging_backend(tmp_path):
     assert contract["metric"] == "ec_jax_encode_k8m3_4MiB_stripe"
     assert contract["unit"] == "GiB/s"
     assert contract["value"] is not None and contract["value"] > 0
+    # the plan-cache probe ran: one miss (compile) and one hit on the
+    # same bucketed shape
+    assert contract["plan_cache"]["misses"] >= 1
+    assert contract["plan_cache"]["hits"] >= 1
+    assert contract["truncated"] is False
     # details stayed out of stdout (they belong in bench_details.json)
     assert len(stdout_lines) == 1
     assert (tmp_path / "bench_details.json").exists()
+    details = json.loads((tmp_path / "bench_details.json").read_text())
+    assert "plan_cache" in details and "retraces" in details["plan_cache"]
 
 
 def test_fallback_contract_when_bench_body_dies(monkeypatch, capsys):
@@ -63,6 +71,30 @@ def test_fallback_contract_when_bench_body_dies(monkeypatch, capsys):
     contract = json.loads(out[0])
     assert set(contract) == CONTRACT_KEYS
     assert contract["value"] is None
+
+
+def test_budget_truncates_optional_sections(tmp_path):
+    """An exhausted wall-clock budget (CEPH_TPU_BENCH_BUDGET) skips
+    the optional sections but still emits the full contract line,
+    flagged truncated, well inside the harness timeout."""
+    env = dict(os.environ)
+    env.update({
+        "CEPH_TPU_BENCH_PROBE": "print('cpu')",
+        "CEPH_TPU_BENCH_SMOKE": "1",
+        "CEPH_TPU_BENCH_BUDGET": "0",
+    })
+    r = subprocess.run([sys.executable, BENCH], capture_output=True,
+                       text=True, timeout=240, cwd=str(tmp_path),
+                       env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    stdout_lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    contract = json.loads(stdout_lines[0])
+    assert set(contract) == CONTRACT_KEYS
+    assert contract["truncated"] is True
+    assert contract["value"] is not None and contract["value"] > 0
+    details = json.loads((tmp_path / "bench_details.json").read_text())
+    assert details["truncated"] is True
+    assert details["skipped_sections"]
 
 
 def test_probe_timeout_contained():

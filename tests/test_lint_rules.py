@@ -26,14 +26,16 @@ RULES = [
     "uint8-overflow",
     "trace-static-hazard",
     "trace-numpy",
+    "jit-bypass-plan",
     "async-blocking",
     "lock-order",
     "lock-no-await",
 ]
 
-# the dtype rule is path-scoped to ops/gf + ec/ in production; point it
-# at the fixture family here
-CONFIG = {"dtype_paths": ("fx_uint8",)}
+# the dtype and plan rules are path-scoped to their production
+# modules; point them at their fixture families here
+CONFIG = {"dtype_paths": ("fx_uint8",),
+          "plan_paths": ("fx_jit_bypass_plan",)}
 
 
 def _fixture(name: str) -> str:

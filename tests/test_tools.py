@@ -52,6 +52,26 @@ def test_benchmark_decode_erased_list(capsys):
     assert "(0)" in out and "(3)" in out  # display_chunks marks erased
 
 
+def test_benchmark_plan_cache_toggle(capsys):
+    """--plan-cache/--no-plan-cache flip the ExecPlan cache and the
+    retrace counters print to stderr; stdout keeps the reference
+    one-line contract either way."""
+    from ceph_tpu.ec import plan
+
+    assert ecb.run(["-p", "ec_jax", "-P", "k=4", "-P", "m=2",
+                    "-s", "16384", "-i", "2", "--plan-cache"]) == 0
+    cap = capsys.readouterr()
+    assert len(cap.out.strip().splitlines()) == 1 and "\t" in cap.out
+    assert "plan-cache: enabled=True" in cap.err
+    assert "retraces=" in cap.err
+
+    assert ecb.run(["-p", "ec_jax", "-P", "k=4", "-P", "m=2",
+                    "-s", "16384", "--no-plan-cache"]) == 0
+    cap = capsys.readouterr()
+    assert "plan-cache: enabled=False" in cap.err
+    assert plan.enabled()  # the toggle was restored after the run
+
+
 # -- ceph-erasure-code-tool ------------------------------------------------
 
 PROFILE = "plugin=jerasure,technique=reed_sol_van,k=4,m=2"
