@@ -55,11 +55,13 @@ def _remaining() -> float:
 def _emit_contract(value: Optional[float],
                    vs_baseline: Optional[float],
                    plan_cache: Optional[dict] = None,
+                   encode_service: Optional[dict] = None,
                    truncated: bool = False) -> None:
     """Print the one-line JSON driver contract, exactly once, before
     any optional extended benches run — a wedged tunnel or a crashed
     secondary bench can no longer yield an empty bench.  plan_cache
-    carries the ExecPlan hit/miss/retrace counters; truncated flags a
+    carries the ExecPlan hit/miss/retrace counters, encode_service the
+    micro-batching service probe counters; truncated flags a
     budget-shortened run."""
     global _contract_emitted
     if _contract_emitted:
@@ -71,8 +73,138 @@ def _emit_contract(value: Optional[float],
         "unit": "GiB/s",
         "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
         "plan_cache": plan_cache,
+        "encode_service": encode_service,
         "truncated": bool(truncated),
     }), flush=True)
+
+
+def _service_probe() -> Optional[dict]:
+    """End-to-end probe of the async micro-batching encode service:
+    8 concurrent encodes must produce bit-exact shards/hinfo vs the
+    inline path while sharing batched dispatches.  The counters land
+    in the contract line so the driver sees the service working; None
+    (with a stderr note) when the probe cannot run.
+
+    Contract-first discipline: the probe runs BEFORE _emit_contract,
+    so it is hard-bounded — asyncio.wait_for caps the event loop (a
+    service defect that strands a future must not hang the bench) and
+    an exhausted wall-clock budget skips it outright."""
+    import asyncio
+
+    from ceph_tpu.ec.registry import create_erasure_code
+    from ceph_tpu.osd import ec_util
+    from ceph_tpu.osd.encode_service import EncodeService
+
+    if _remaining() < 0:
+        print("# encode service probe skipped: budget exhausted",
+              file=sys.stderr)
+        return None
+    probe_timeout = float(os.environ.get(
+        "CEPH_TPU_BENCH_SERVICE_PROBE_TIMEOUT", "60"))
+    prev = os.environ.get("CEPH_TPU_FUSE_MIN_BYTES")
+    os.environ["CEPH_TPU_FUSE_MIN_BYTES"] = "0"  # engage off-TPU too
+    try:
+        codec = create_erasure_code(
+            {"plugin": "ec_jax", "technique": "reed_sol_van",
+             "k": "4", "m": "2"})
+        sinfo = ec_util.StripeInfo(4, 4 * 1024)
+        rng = np.random.default_rng(11)
+        bufs = [rng.integers(0, 256, 8192, dtype=np.uint8).tobytes()
+                for _ in range(8)]
+
+        async def run():
+            svc = EncodeService(who="bench-probe")
+            outs = await asyncio.gather(
+                *(svc.encode_with_hinfo(sinfo, codec, b, range(6),
+                                        logical_len=len(b))
+                  for b in bufs))
+            st = svc.stats()
+            await svc.stop()
+            return outs, st
+
+        outs, st = asyncio.run(
+            asyncio.wait_for(run(), timeout=probe_timeout))
+        for b, (shards, hinfo, crc) in zip(bufs, outs):
+            ws, wh, wc = ec_util.encode_with_hinfo(
+                sinfo, codec, b, range(6), logical_len=len(b))
+            assert crc == wc and hinfo.cumulative_shard_hashes == \
+                wh.cumulative_shard_hashes, "service hinfo mismatch"
+            assert all(bytes(shards[i]) == bytes(ws[i])
+                       for i in range(6)), "service shard mismatch"
+        return {key: st[key] for key in ("requests", "batched",
+                                         "inline", "shed", "batches")}
+    except Exception as e:
+        print(f"# encode service probe failed: {e!r}", file=sys.stderr)
+        return None
+    finally:
+        if prev is None:
+            os.environ.pop("CEPH_TPU_FUSE_MIN_BYTES", None)
+        else:
+            os.environ["CEPH_TPU_FUSE_MIN_BYTES"] = prev
+
+
+def bench_write_path() -> dict:
+    """Concurrent-writes throughput through the OSD op engine with the
+    micro-batching encode service on vs off: 32 concurrent 256 KiB
+    write_fulls into an EC 4+2 pool on an in-loop cluster, best of 3
+    trials per mode.  MiB/s of object bytes; per-daemon service
+    counters (summed) ride along."""
+    import asyncio
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_helpers import Cluster
+
+    n_objs, osize = 32, 256 << 10
+    payloads = [np.random.default_rng(100 + i).integers(
+        0, 256, osize, dtype=np.uint8).tobytes()
+        for i in range(n_objs)]
+    profile = {"plugin": "ec_jax", "technique": "reed_sol_van",
+               "k": "4", "m": "2", "crush-failure-domain": "osd",
+               "stripe_unit": "65536"}
+
+    async def run_mode():
+        cluster = Cluster(num_osds=6, osds_per_host=3,
+                          osd_config={"osd_heartbeat_interval": 3.0,
+                                      "osd_heartbeat_grace": 20.0})
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "wp", profile=profile, pg_num=8)
+            io = cluster.client.open_ioctx("wp")
+            best = float("inf")
+            for trial in range(3):
+                t0 = time.perf_counter()
+                await asyncio.gather(
+                    *(io.write_full(f"o{trial}-{i}", payloads[i])
+                      for i in range(n_objs)))
+                dt = time.perf_counter() - t0
+                if trial > 0:       # first trial warms connections
+                    best = min(best, dt)
+            svc: dict = {}
+            for osd in cluster.osds.values():
+                st = osd.encode_service.stats()
+                for key in ("requests", "batched", "inline", "shed",
+                            "batches"):
+                    svc[key] = svc.get(key, 0) + st[key]
+            return n_objs * osize / best / (1 << 20), svc
+        finally:
+            await cluster.stop()
+
+    prev = os.environ.get("CEPH_TPU_ENCODE_SERVICE")
+    try:
+        os.environ["CEPH_TPU_ENCODE_SERVICE"] = "1"
+        mibs_on, svc_counters = asyncio.run(run_mode())
+        os.environ["CEPH_TPU_ENCODE_SERVICE"] = "0"
+        mibs_off, _off = asyncio.run(run_mode())
+    finally:
+        if prev is None:
+            os.environ.pop("CEPH_TPU_ENCODE_SERVICE", None)
+        else:
+            os.environ["CEPH_TPU_ENCODE_SERVICE"] = prev
+    return {"write_burst_32x256KiB_svc_on_mibs": mibs_on,
+            "write_burst_32x256KiB_svc_off_mibs": mibs_off,
+            "write_burst_encode_service": svc_counters}
 
 
 def bench_lrc_crc() -> float:
@@ -555,10 +687,14 @@ def main() -> None:
     ps = ec_plan.stats()
     plan_counters = {key: ps[key] for key in ("hits", "misses",
                                               "retraces")}
+    # encode-service probe (cheap, before the contract): concurrent
+    # awaited encodes bit-exact vs inline, counters into the contract
+    service_counters = _service_probe()
 
     # the driver contract line, before every optional/extended bench:
     # a wedge below this point can cost detail rows, never the bench
     _emit_contract(enc_gibs, vs_baseline, plan_cache=plan_counters,
+                   encode_service=service_counters,
                    truncated=skip_optional)
 
     # decode sweep over 1..m erasures (the reference benchmark sweeps
@@ -608,6 +744,18 @@ def main() -> None:
         except Exception as e:
             print(f"# put e2e bench failed: {e!r}", file=sys.stderr)
 
+    # write-path section: concurrent client writes through the OSD op
+    # engine, micro-batching encode service on vs off (same single
+    # budget decision as the other optional sections)
+    write_path: dict = {}
+    if not _SMOKE and skip_optional:
+        skipped_sections.append("write_path")
+    elif not _SMOKE:
+        try:
+            write_path = bench_write_path()
+        except Exception as e:
+            print(f"# write path bench failed: {e!r}", file=sys.stderr)
+
     details = {
         "encode_gibs": enc_gibs,
         "encode_path": "pallas_words" if use_pallas else "xla_bitplanes",
@@ -622,6 +770,8 @@ def main() -> None:
         "put_64MiB_ec8p3_gibs": put_gibs,
         "put_64MiB_md5_etag_gibs": put_md5_gibs,
         **put_gate,
+        **write_path,
+        "encode_service": service_counters,
         "host_cores": os.cpu_count(),
         "encode_ms_per_batch": t_enc * 1e3,
         "k": k, "m": m, "chunk_bytes": chunk, "batch": batch,

@@ -13,6 +13,11 @@ async/daemon safety (the mon/osd/mds/rgw asyncio daemons):
   async-blocking       event-loop-blocking calls in `async def` bodies
   lock-order           static lock-order cycles (lockdep, at lint time)
   lock-no-await        un-awaited asyncio.Lock acquisition / sync `with`
+  sync-encode-in-async direct ec_util.encode* / codec .encode() in
+                       `async def` bodies under ceph_tpu/osd/ — the
+                       encode runs ON the event loop instead of
+                       riding the micro-batching encode service
+                       (osd/encode_service.py)
 
 EC dispatch discipline:
   jit-bypass-plan      direct jax.jit on shape-polymorphic EC entry
@@ -476,6 +481,60 @@ def rule_jit_bypass_plan(a: Analyzer) -> None:
 
 
 # ---------------------------------------------------------------------
+# sync-encode-in-async
+# ---------------------------------------------------------------------
+
+# OSD daemon modules whose async bodies must route EC encodes through
+# the awaited encode service (osd/encode_service.py): a direct call
+# blocks the event loop for the whole dispatch AND forfeits the
+# micro-batching that folds concurrent writes into one device call.
+_ENCODE_PATHS = ("ceph_tpu/osd/",)
+# receiver names that denote an erasure codec in this codebase (the
+# heuristic keeps str.encode()/json encode noise out of the findings)
+_CODEC_RECEIVERS = {"codec", "ec_impl"}
+_CODEC_ENCODE_ATTRS = {"encode", "encode_chunks", "encode_batch",
+                       "encode_batch_with_crc", "encode_many",
+                       "encode_many_with_crc"}
+
+
+def rule_sync_encode_in_async(a: Analyzer) -> None:
+    """Direct `ec_util.encode*` (or `codec.encode*(...)`) inside an
+    `async def` under ceph_tpu/osd/: the EC encode runs synchronously
+    on the daemon's event loop instead of awaiting the batching
+    encode service.  Intentional inline fallbacks (the service's own
+    degraded path) are baselined with justifications."""
+    paths = a.config.get("encode_paths", _ENCODE_PATHS)
+    for mod in a.project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if not any(p in rel for p in paths):
+            continue
+        for fi in mod.functions.values():
+            if not fi.is_async:
+                continue
+            for node in walk_scope(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _resolved_callee(mod, node)
+                util_encode = ".ec_util.encode" in f".{callee}"
+                codec_encode = (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _CODEC_ENCODE_ATTRS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in _CODEC_RECEIVERS)
+                if util_encode or codec_encode:
+                    what = callee if util_encode else \
+                        f"{node.func.value.id}.{node.func.attr}"
+                    a.emit("sync-encode-in-async", mod, node,
+                           f"synchronous EC encode `{what}` in "
+                           f"`async def {fi.qualname}` runs on the "
+                           "event loop and bypasses the micro-"
+                           "batching encode service — await "
+                           "self.encode_service instead "
+                           "(osd/encode_service.py)",
+                           symbol=fi.qualname, scope_line=fi.lineno)
+
+
+# ---------------------------------------------------------------------
 # lock-no-await
 # ---------------------------------------------------------------------
 
@@ -555,6 +614,7 @@ def default_rules() -> Dict[str, object]:
         "trace-numpy": rule_trace_numpy,
         "jit-bypass-plan": rule_jit_bypass_plan,
         "async-blocking": rule_async_blocking,
+        "sync-encode-in-async": rule_sync_encode_in_async,
         "lock-order": rule_lock_order,
         "lock-no-await": rule_lock_no_await,
     }

@@ -270,6 +270,46 @@ class ErasureCodeJax(ErasureCode):
         return [self._matmul(self.matrix, np.asarray(d, np.uint8))
                 for d in datas]
 
+    def encode_many_with_crc(self, arrs: Sequence[np.ndarray],
+                             init: int = 0
+                             ) -> Optional[List[Tuple[np.ndarray,
+                                                      np.ndarray]]]:
+        """N pending (B_i, k, S) stripe batches -> [(parity_i, crc_i)]
+        in order, folded into ONE fused encode+crc dispatch: same-S
+        batches concatenate along the stripe axis (the encode
+        service's flush path — many concurrent objects, one plan
+        call).  None when the fused plan is unavailable (callers fall
+        back per item)."""
+        if self.w != 8 or not self.use_tpu or not self.use_plan:
+            return None
+        from ceph_tpu.ec import plan
+
+        if not plan.enabled():
+            return None
+        arrs = [np.asarray(a, dtype=np.uint8) for a in arrs]
+        if not arrs:
+            return []
+        s = arrs[0].shape[-1]
+        if any(a.ndim != 3 or a.shape[1] != self.k or a.shape[2] != s
+               for a in arrs):
+            return None
+        big = arrs[0] if len(arrs) == 1 else np.concatenate(arrs, axis=0)
+        out = plan.encode_with_crc(self.matrix, big,
+                                   sig=self.plan_signature())
+        if out is None:
+            return None
+        parity, crcs = out
+        if init:
+            adv = cks.crc32c_zeros(init & 0xFFFFFFFF, s)
+            crcs = crcs ^ np.uint32(adv)
+        res: List[Tuple[np.ndarray, np.ndarray]] = []
+        off = 0
+        for a in arrs:
+            b = a.shape[0]
+            res.append((parity[off:off + b], crcs[off:off + b]))
+            off += b
+        return res
+
     def encode_batch_with_crc(self, data: np.ndarray, init: int = 0
                               ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         """Fused encode + per-chunk crc32c in one device dispatch:

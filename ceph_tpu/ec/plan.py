@@ -78,7 +78,8 @@ __all__ = [
 _lock = threading.Lock()
 _plans = LruCache(cap=128)
 _mbits_cache = LruCache(cap=64)      # matrix signature -> device bit matrix
-_counters: Dict[str, int] = {"hits": 0, "misses": 0, "retraces": 0}
+_counters: Dict[str, int] = {"hits": 0, "misses": 0, "retraces": 0,
+                             "dispatches": 0}
 _per_plan: Dict[str, Dict[str, float]] = {}
 _enabled = os.environ.get("CEPH_TPU_PLAN_CACHE", "1") != "0"
 
@@ -138,6 +139,7 @@ def _note_retrace(label: str) -> None:
 
 def _note_dispatch(label: str, seconds: float) -> None:
     with _lock:
+        _counters["dispatches"] += 1
         entry = _per_plan.setdefault(
             label, {"dispatches": 0, "seconds": 0.0, "retraces": 0})
         entry["dispatches"] += 1

@@ -72,6 +72,7 @@ from ceph_tpu.ops import checksum as cks
 from ceph_tpu.os import ObjectId, ObjectStore, Transaction
 from ceph_tpu.os.memstore import MemStore
 from ceph_tpu.osd import ec_util
+from ceph_tpu.osd.encode_service import EncodeService
 from ceph_tpu.osd import scheduler as sched_mod
 from ceph_tpu.osd.osdmap import OSDMap, PgId, TYPE_ERASURE, TYPE_REPLICATED
 from ceph_tpu.osd.pg_log import (
@@ -321,6 +322,11 @@ class OSDDaemon:
         # tests assert small writes/reads move O(stripe), not O(object)
         self.perf = {"subread_bytes": 0, "subwrite_bytes": 0,
                      "encode_dispatches": 0, "decode_dispatches": 0}
+        # async micro-batching encode/decode front end: concurrent EC
+        # ops share plan-cached device dispatches; inline (pre-service
+        # behavior) when the device tier is absent or
+        # CEPH_TPU_ENCODE_SERVICE=0
+        self.encode_service = EncodeService(who=f"osd.{osd_id}")
         # watch/notify: (pool, oid) -> {(client, cookie): Connection}
         self.watchers: Dict[Tuple[int, str],
                             Dict[Tuple[str, int], Connection]] = {}
@@ -430,6 +436,10 @@ class OSDDaemon:
             "scrub_stats": (
                 lambda cmd: dict(self.scrub_stats),
                 "lifetime scrub object/error/repair counters"),
+            "encode_service": (
+                lambda cmd: self.encode_service.stats(),
+                "micro-batching encode service: batch/fill/wait"
+                " histograms, queue depth, inline fallbacks"),
             "dump_traces": (
                 lambda cmd: {"spans": self.tracer.dump(
                     int(cmd["trace_id"], 16)
@@ -501,6 +511,9 @@ class OSDDaemon:
     async def stop(self) -> None:
         self._stopping = True
         await self.scheduler.stop()
+        # after the scheduler drained: no new client ops enqueue, and
+        # any encode futures still in flight resolve before teardown
+        await self.encode_service.stop()
         if self._admin_socket is not None:
             # shutdown joins the serve thread: keep that wait OFF the
             # shared event loop (co-hosted daemons keep running)
@@ -526,6 +539,7 @@ class OSDDaemon:
         if self._scrub_task is not None:
             self._scrub_task.cancel()
         await self.scheduler.stop()
+        await self.encode_service.stop()
         for ps in self.pgs.values():
             if ps.peering_task is not None:
                 ps.peering_task.cancel()
@@ -2490,7 +2504,7 @@ class OSDDaemon:
                     "chosen": {s: chosen[s]
                                for s in sorted(chosen)[:k]},
                     "attrs": attrs_of(version, chosen), "omap": None}
-            if not self._batch_reconstruct(pool, [plan]):
+            if not await self._batch_reconstruct(pool, [plan]):
                 return False
         await self._recover_commit(state, pool, plan)
         log.info("osd.%d: %s/%s: reinstalled generation %s across"
@@ -2610,7 +2624,7 @@ class OSDDaemon:
                         raise plan
                     if plan is not None:
                         plans.append(plan)
-                reconstructed = self._batch_reconstruct(
+                reconstructed = await self._batch_reconstruct(
                     pool, [p for p in plans if p["kind"] == "ec"])
                 plans = [p for p in plans
                          if p["kind"] != "ec" or p in reconstructed]
@@ -2652,7 +2666,7 @@ class OSDDaemon:
         if plan is None:
             return
         if plan["kind"] == "ec" and \
-                not self._batch_reconstruct(pool, [plan]):
+                not await self._batch_reconstruct(pool, [plan]):
             return
         await self._recover_commit(state, pool, plan)
 
@@ -2817,16 +2831,18 @@ class OSDDaemon:
                 "i_need": i_need, "chosen": chosen_k, "guard": guard,
                 "attrs": _attrs_of(version, chosen), "omap": None}
 
-    def _batch_reconstruct(self, pool,
-                           ec_plans: List[Dict[str, Any]]
-                           ) -> List[Dict[str, Any]]:
+    async def _batch_reconstruct(self, pool,
+                                 ec_plans: List[Dict[str, Any]]
+                                 ) -> List[Dict[str, Any]]:
         """Fill each EC plan's `payload` (all n shard streams): decode
         groups that share a survivor set in one dispatch each, then
         re-encode every successful object's data in one dispatch total
         — shard streams are chunk-aligned, so cross-object batching is
-        plain concatenation along the stripe axis.  A group whose batch
-        fails falls back to per-object decode so one malformed object
-        cannot livelock the rest of the PG; returns the plans that got
+        plain concatenation along the stripe axis.  Both legs await
+        the encode service, so concurrent recovery waves (and client
+        writes) share device dispatches.  A group whose batch fails
+        falls back to per-object decode so one malformed object cannot
+        livelock the rest of the PG; returns the plans that got
         payloads."""
         if not ec_plans:
             return []
@@ -2835,43 +2851,28 @@ class OSDDaemon:
         n = codec.get_chunk_count()
         chunk = sinfo.get_chunk_size()
         width = sinfo.get_stripe_width()
-        groups: Dict[tuple, List[Dict[str, Any]]] = {}
-        for plan in ec_plans:
-            groups.setdefault(tuple(sorted(plan["chosen"])),
-                              []).append(plan)
+        maps = [p["chosen"] for p in ec_plans]
+        # one fold per distinct survivor set (the service/ec_util
+        # decode_many contract), counted as such
+        self.perf["decode_dispatches"] += len(
+            {tuple(sorted(m)) for m in maps})
+        results = await self.encode_service.decode_many(sinfo, codec,
+                                                        maps)
         datas: Dict[str, bytes] = {}
-
-        def decode_one(p: Dict[str, Any]) -> None:
-            self.perf["decode_dispatches"] += 1
-            datas[p["oid"]] = ec_util.decode(sinfo, codec, p["chosen"])
-
-        for have, group in groups.items():
-            try:
-                streams = {s: b"".join(p["chosen"][s] for p in group)
-                           for s in have}
-                self.perf["decode_dispatches"] += 1
-                data = ec_util.decode(sinfo, codec, streams)
-                off = 0
-                for p in group:
-                    stream_len = len(next(iter(p["chosen"].values())))
-                    span = (stream_len // chunk) * width
-                    datas[p["oid"]] = data[off:off + span]
-                    off += span
-            except Exception:
-                for p in group:
-                    try:
-                        decode_one(p)
-                    except Exception:
-                        log.exception(
-                            "osd.%d: reconstruct of %s failed",
-                            self.osd_id, p["oid"])
+        for p, res in zip(ec_plans, results):
+            if isinstance(res, BaseException):
+                log.error("osd.%d: reconstruct of %s failed",
+                          self.osd_id, p["oid"], exc_info=res)
+            else:
+                datas[p["oid"]] = res
         done = [p for p in ec_plans if p["oid"] in datas]
         if not done:
             return []
         try:
             all_data = b"".join(datas[p["oid"]] for p in done)
             self.perf["encode_dispatches"] += 1
-            full = ec_util.encode(sinfo, codec, all_data, range(n))
+            full = await self.encode_service.encode(
+                sinfo, codec, all_data, range(n))
             offsets: Dict[int, int] = {s: 0 for s in range(n)}
             for p in done:
                 span = len(datas[p["oid"]])
@@ -2887,7 +2888,7 @@ class OSDDaemon:
             for p in done:
                 try:
                     self.perf["encode_dispatches"] += 1
-                    p["payload"] = ec_util.encode(
+                    p["payload"] = await self.encode_service.encode(
                         sinfo, codec, datas[p["oid"]], range(n))
                     done2.append(p)
                 except Exception:
@@ -3489,16 +3490,8 @@ class OSDDaemon:
         if snapc is not None:
             clone_ops, ss_raw = await self._snap_clone_prep(
                 state, pool, oid, snapc[0], snapc[1])
-        entry = self._next_entry(state, pool, oid, "modify", len(data))
-        oi = json.dumps({"size": len(data),
-                         "version": entry["version"]}).encode()
         out: Dict[str, Any] = {}
-        if pool.type == TYPE_REPLICATED:
-            ops = [ShardOp("create"), ShardOp("truncate", size=0),
-                   ShardOp("write", 0, data),
-                   ShardOp("setattr", name=OI_ATTR, value=oi)]
-            shard_ops = {-1: ops}
-        else:
+        if pool.type == TYPE_ERASURE:
             codec = self._codec(pool.id)
             sinfo = self._sinfo(pool.id)
             width = sinfo.get_stripe_width()
@@ -3506,9 +3499,25 @@ class OSDDaemon:
             # data may be a zero-copy memoryview of the op frame; only
             # materialize when padding actually forces a copy
             padded = (bytes(data) + bytes(pad)) if pad else data
-            shards, hinfo, data_crc = ec_util.encode_with_hinfo(
-                sinfo, codec, padded, range(codec.get_chunk_count()),
-                logical_len=len(data))
+            # awaited BEFORE the version is allocated: concurrent
+            # writes batch their encodes into shared device dispatches
+            # (encode_service), and no suspension point sits between
+            # _next_entry and _submit_shard_writes — log entries still
+            # land in version order
+            shards, hinfo, data_crc = \
+                await self.encode_service.encode_with_hinfo(
+                    sinfo, codec, padded,
+                    range(codec.get_chunk_count()),
+                    logical_len=len(data))
+        entry = self._next_entry(state, pool, oid, "modify", len(data))
+        oi = json.dumps({"size": len(data),
+                         "version": entry["version"]}).encode()
+        if pool.type == TYPE_REPLICATED:
+            ops = [ShardOp("create"), ShardOp("truncate", size=0),
+                   ShardOp("write", 0, data),
+                   ShardOp("setattr", name=OI_ATTR, value=oi)]
+            shard_ops = {-1: ops}
+        else:
             if data_crc is not None:
                 # content digest back to the client (the librados
                 # returnvec role): a gateway can derive its ETag from
@@ -3672,7 +3681,8 @@ class OSDDaemon:
                             buf = buf + bytes(frag_len - len(buf))
                         frags[s] = buf
                     self.perf["decode_dispatches"] += 1
-                    decoded = ec_util.decode(sinfo, codec, frags)
+                    decoded = await self.encode_service.decode(
+                        sinfo, codec, frags)
                     merged[:len(decoded)] = decoded
             else:
                 old_size = 0
@@ -3681,6 +3691,12 @@ class OSDDaemon:
         merged[rel:rel + len(data)] = data
         new_size = max(old_size or 0, offset + len(data))
 
+        # re-encode awaited BEFORE the version is allocated (same
+        # ordering discipline as _op_write_full_locked): concurrent
+        # RMWs share a batched dispatch through the encode service
+        self.perf["encode_dispatches"] += 1
+        shards = await self.encode_service.encode(
+            sinfo, codec, bytes(merged), range(n))
         entry = self._next_entry(state, pool, oid, "modify", new_size)
         oi_raw = json.dumps({"size": new_size,
                              "version": entry["version"]}).encode()
@@ -3688,8 +3704,6 @@ class OSDDaemon:
         hinfo.set_total_chunk_size_clear_hash(
             (-(-new_size // width)) * chunk)
         hinfo_raw = json.dumps(hinfo.to_dict()).encode()
-        self.perf["encode_dispatches"] += 1
-        shards = ec_util.encode(sinfo, codec, bytes(merged), range(n))
         chunk_off = (start // width) * chunk
         shard_ops = {}
         for shard in range(n):
@@ -3899,7 +3913,8 @@ class OSDDaemon:
                     buf += bytes(frag_len - len(buf))
                 frags[s] = buf
             self.perf["decode_dispatches"] += 1
-            data = ec_util.decode(sinfo, codec, frags)
+            data = await self.encode_service.decode(sinfo, codec,
+                                                    frags)
             rel = offset - start
             return 0, data[rel:rel + min(length, size - offset)]
         candidates, _complete = await self._gather_object_shards(
@@ -3925,8 +3940,8 @@ class OSDDaemon:
         except Exception:
             return EIO, b""
         self.perf["decode_dispatches"] += 1
-        data = ec_util.decode(sinfo, codec,
-                              {s: good[s] for s in minimum if s in good})
+        data = await self.encode_service.decode(
+            sinfo, codec, {s: good[s] for s in minimum if s in good})
         data = data[:size]
         if length:
             data = data[offset:offset + length]

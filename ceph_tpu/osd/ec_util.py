@@ -256,38 +256,24 @@ def _fuse_min_bytes() -> Optional[int]:
     return (1 << 20) if plan.device_platform() == "tpu" else None
 
 
-def _encode_with_hinfo_device(sinfo: StripeInfo, ec_impl, data,
-                              want: Iterable[int],
-                              logical_len: Optional[int]):
-    """Fused DEVICE tier of encode_with_hinfo: stripes batch into one
-    (B, k, chunk) plan-cached dispatch that returns parity AND every
-    chunk's zero-seeded crc32c (ec/plan.encode_with_crc), then the
-    per-stripe chunk crcs fold into the cumulative per-shard ledger on
-    host with the streaming identity
-    crc(c, chunk) = crc32c_zeros(c, len) ^ crc32c(0, chunk).
-    Returns None when the fused plan does not apply (callers fall
-    through to the host tiers)."""
-    fmin = _fuse_min_bytes()
-    if fmin is None or len(data) < max(fmin, 1) \
-            or not hasattr(ec_impl, "encode_batch_with_crc"):
-        return None
-    if not isinstance(data, (bytes, bytearray, memoryview)):
-        data = bytes(data)
-    width = sinfo.get_stripe_width()
-    chunk = sinfo.get_chunk_size()
-    if len(data) % width or ec_impl.get_chunk_size(width) != chunk:
-        return None  # the generic path owns the incompatibility error
+def _fused_result(sinfo: StripeInfo, ec_impl, src: np.ndarray,
+                  arr: np.ndarray, parity, crc0,
+                  want: Iterable[int], logical_len: Optional[int],
+                  data) -> Tuple[Dict[int, object], "HashInfo",
+                                 Optional[int]]:
+    """Assemble one object's (shards, hinfo, data_crc) from the fused
+    device outputs: the per-stripe zero-seeded chunk crcs fold into
+    the cumulative per-shard ledger on host with the streaming
+    identity crc(c, chunk) = crc32c_zeros(c, len) ^ crc32c(0, chunk).
+    Zero-copy contract (same as the native tier): data shards are
+    strided views of the caller's buffer, parity rows read-only
+    memoryviews — the stores adopt immutable buffers, no transpose or
+    defensive copies on the hot path."""
     from ceph_tpu.common.buffer import StridedBuf
 
     n = ec_impl.get_chunk_count()
-    n_stripes = len(data) // width
-    k = width // chunk
-    src = np.frombuffer(data, dtype=np.uint8)
-    arr = src.reshape(n_stripes, k, chunk)
-    out = ec_impl.encode_batch_with_crc(arr, init=0)
-    if out is None:
-        return None
-    parity, crc0 = out          # (B, m, chunk), (B, k+m) zero-seeded
+    chunk = sinfo.get_chunk_size()
+    n_stripes, k, _ = arr.shape
     hinfo = HashInfo(n)
     hashes = []
     for i in range(n):
@@ -297,10 +283,6 @@ def _encode_with_hinfo_device(sinfo: StripeInfo, ec_impl, data,
         hashes.append(c & 0xFFFFFFFF)
     hinfo.cumulative_shard_hashes = hashes
     hinfo.total_chunk_size = n_stripes * chunk
-    # same zero-copy contract as the native tier below: data shards
-    # are strided views of the caller's buffer, parity rows read-only
-    # memoryviews — the stores adopt immutable buffers, no transpose
-    # or defensive copies on the hot path
     if src.flags.writeable:
         src.setflags(write=False)
     want = set(want)
@@ -318,6 +300,195 @@ def _encode_with_hinfo_device(sinfo: StripeInfo, ec_impl, data,
     if logical_len is not None:
         crc = cks.crc32c(0xFFFFFFFF, memoryview(data)[:logical_len])
     return shards, hinfo, crc
+
+
+def _encode_with_hinfo_device(sinfo: StripeInfo, ec_impl, data,
+                              want: Iterable[int],
+                              logical_len: Optional[int]):
+    """Fused DEVICE tier of encode_with_hinfo: stripes batch into one
+    (B, k, chunk) plan-cached dispatch that returns parity AND every
+    chunk's zero-seeded crc32c (ec/plan.encode_with_crc); the crcs
+    fold into the cumulative ledger in _fused_result.  Returns None
+    when the fused plan does not apply (callers fall through to the
+    host tiers)."""
+    fmin = _fuse_min_bytes()
+    if fmin is None or len(data) < max(fmin, 1) \
+            or not hasattr(ec_impl, "encode_batch_with_crc"):
+        return None
+    if not isinstance(data, (bytes, bytearray, memoryview)):
+        data = bytes(data)
+    width = sinfo.get_stripe_width()
+    chunk = sinfo.get_chunk_size()
+    if len(data) % width or ec_impl.get_chunk_size(width) != chunk:
+        return None  # the generic path owns the incompatibility error
+    n_stripes = len(data) // width
+    k = width // chunk
+    src = np.frombuffer(data, dtype=np.uint8)
+    arr = src.reshape(n_stripes, k, chunk)
+    out = ec_impl.encode_batch_with_crc(arr, init=0)
+    if out is None:
+        return None
+    parity, crc0 = out          # (B, m, chunk), (B, k+m) zero-seeded
+    return _fused_result(sinfo, ec_impl, src, arr, parity, crc0,
+                         want, logical_len, data)
+
+
+def device_fused_available(ec_impl) -> bool:
+    """True when the fused device encode tier can engage for this
+    codec — the encode service's batching gate.  Requires a real
+    policy floor (``_fuse_min_bytes()`` is None on the CPU-only
+    default, which keeps the service fully inline there), a
+    device-enabled codec, and the fused batched entry points."""
+    return (_fuse_min_bytes() is not None
+            and bool(getattr(ec_impl, "use_tpu", False))
+            and not ec_impl.get_chunk_mapping()
+            and hasattr(ec_impl, "encode_many_with_crc"))
+
+
+def encode_many_with_hinfo(sinfo: StripeInfo, ec_impl,
+                           items) -> List[Tuple[Dict[int, object],
+                                                "HashInfo",
+                                                Optional[int]]]:
+    """N whole-object encodes of one codec profile in ONE dispatch.
+
+    ``items`` is a sequence of ``(data, want, logical_len)`` tuples;
+    returns per-item ``(shards, hinfo, data_crc)`` exactly as
+    encode_with_hinfo would produce.  The device tier folds every
+    item's stripes into a single fused encode+crc plan call (the
+    encode service's flush path); when the fused plan does not apply
+    the items run the inline tiers one by one — results are
+    bit-identical either way."""
+    items = list(items)
+    if not items:
+        return []
+    fused = _encode_many_device(sinfo, ec_impl, items)
+    if fused is not None:
+        return fused
+    return [encode_with_hinfo(sinfo, ec_impl, d, w, logical_len=l)
+            for d, w, l in items]
+
+
+def _encode_many_device(sinfo: StripeInfo, ec_impl, items):
+    """Batched twin of _encode_with_hinfo_device: the fuse-bytes floor
+    applies to the TOTAL batch (aggregating small concurrent writes
+    past the floor is the service's whole point).  Returns None when
+    any item cannot ride the fused plan — the caller then runs all of
+    them inline."""
+    fmin = _fuse_min_bytes()
+    if fmin is None or not getattr(ec_impl, "use_tpu", False) \
+            or not hasattr(ec_impl, "encode_many_with_crc") \
+            or ec_impl.get_chunk_mapping():
+        return None
+    width = sinfo.get_stripe_width()
+    chunk = sinfo.get_chunk_size()
+    if ec_impl.get_chunk_size(width) != chunk:
+        return None
+    datas = []
+    total = 0
+    for d, _w, _l in items:
+        if not isinstance(d, (bytes, bytearray, memoryview)):
+            d = bytes(d)
+        if len(d) == 0 or len(d) % width:
+            return None
+        datas.append(d)
+        total += len(d)
+    if total < max(fmin, 1) or \
+            total < getattr(ec_impl, "tpu_min_bytes", 1):
+        return None
+    k = width // chunk
+    srcs = [np.frombuffer(d, dtype=np.uint8) for d in datas]
+    arrs = [s.reshape(-1, k, chunk) for s in srcs]
+    out = ec_impl.encode_many_with_crc(arrs, init=0)
+    if out is None:
+        return None
+    results = []
+    for (item, d, src, arr, (parity, crc0)) in zip(
+            items, datas, srcs, arrs, out):
+        _data, want, logical_len = item
+        results.append(_fused_result(sinfo, ec_impl, src, arr,
+                                     parity, crc0, want, logical_len,
+                                     d))
+    return results
+
+
+def encode_many(sinfo: StripeInfo, ec_impl, datas,
+                wants) -> List[Dict[int, bytes]]:
+    """N plain whole-object encodes (same profile) in one dispatch.
+
+    Shard streams are chunk-aligned, so cross-object batching is
+    concatenation along the stripe axis (the recovery-path fold,
+    generalized): ONE ``encode`` of the joined bytes, then each
+    object's shard slices come back out.  Per-object fallback keeps
+    one malformed object from failing the rest."""
+    datas = list(datas)
+    wants = [set(w) for w in wants]
+    assert len(datas) == len(wants)
+    width = sinfo.get_stripe_width()
+    chunk = sinfo.get_chunk_size()
+
+    def one(d, w) -> Dict[int, bytes]:
+        return encode(sinfo, ec_impl,
+                      d if isinstance(d, bytes) else bytes(d), w)
+
+    if len(datas) <= 1 or any(len(d) % width for d in datas):
+        return [one(d, w) for d, w in zip(datas, wants)]
+    union = set().union(*wants)
+    try:
+        joined = b"".join(bytes(d) for d in datas)
+        full = encode(sinfo, ec_impl, joined, union)
+    except Exception:
+        return [one(d, w) for d, w in zip(datas, wants)]
+    out: List[Dict[int, bytes]] = []
+    offsets = {s: 0 for s in union}
+    for d, w in zip(datas, wants):
+        shard_len = (len(d) // width) * chunk
+        shards = {}
+        # offsets advance for EVERY union shard — each item owns a
+        # shard_len slice of every joined stream whether or not it
+        # asked for that shard
+        for s in union:
+            if s in w:
+                shards[s] = full.get(s, b"")[
+                    offsets[s]:offsets[s] + shard_len]
+            offsets[s] += shard_len
+        out.append(shards)
+    return out
+
+
+def decode_many(sinfo: StripeInfo, ec_impl,
+                maps) -> List[bytes]:
+    """N decode requests (same profile) -> logical byte streams.
+
+    Requests sharing a survivor-shard set concatenate their per-shard
+    streams and decode in ONE dispatch (the recovery-wave fold, shared
+    with the read path); a failed group retries per request so one
+    malformed object cannot poison its group."""
+    maps = list(maps)
+    out: List[Optional[bytes]] = [None] * len(maps)
+    groups: Dict[tuple, List[int]] = {}
+    for i, m in enumerate(maps):
+        groups.setdefault(tuple(sorted(m)), []).append(i)
+    chunk = sinfo.get_chunk_size()
+    width = sinfo.get_stripe_width()
+    for key, idxs in groups.items():
+        if len(idxs) == 1:
+            i = idxs[0]
+            out[i] = decode(sinfo, ec_impl, maps[i])
+            continue
+        try:
+            streams = {s: b"".join(bytes(maps[i][s]) for i in idxs)
+                       for s in key}
+            data = decode(sinfo, ec_impl, streams)
+            off = 0
+            for i in idxs:
+                stream_len = len(next(iter(maps[i].values())))
+                span = (stream_len // chunk) * width
+                out[i] = data[off:off + span]
+                off += span
+        except Exception:
+            for i in idxs:
+                out[i] = decode(sinfo, ec_impl, maps[i])
+    return out  # type: ignore[return-value]
 
 
 def decode(sinfo: StripeInfo, ec_impl,

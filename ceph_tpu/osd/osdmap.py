@@ -20,6 +20,7 @@ pg_pool_t (src/osd/osd_types.{h,cc}):
 from __future__ import annotations
 
 import json
+import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -29,6 +30,8 @@ from ceph_tpu.common.encoding import Decoder, Encoder
 from ceph_tpu.crush.map import CRUSH_ITEM_NONE, CrushMap
 from ceph_tpu.crush import mapper as crush_mapper
 from ceph_tpu.ops import rjenkins
+
+log = logging.getLogger("ceph_tpu.osdmap")
 
 # osd state bits (ceph_osd_state)
 CEPH_OSD_EXISTS = 1
@@ -715,6 +718,16 @@ class OSDMapMapping:
         from ceph_tpu.ops import gf
 
         m = self._map
+        device_ok = use_tpu and gf.backend_available() \
+            and not m.crush.choose_args
+        # compile probe hoisted out of the per-pool walk: each
+        # (ruleno, result_max) compiles at most once per update, an
+        # unsupported ruleno is remembered so sibling pools skip the
+        # probe entirely, and the pools that fell back to the scalar
+        # mapper are logged instead of silently pinned
+        compiled: Dict[Tuple[int, int], Optional[object]] = {}
+        unsupported_rules: set = set()
+        fallback_pools: List[int] = []
         for pool_id, pool in m.pools.items():
             entries = []
             raw_rows: Optional[np.ndarray] = None
@@ -722,17 +735,27 @@ class OSDMapMapping:
             pps = np.array(
                 [pool.raw_pg_to_pps(PgId(pool_id, ps))
                  for ps in range(pool.pg_num)], dtype=np.int64)
-            if use_tpu and gf.backend_available() and ruleno >= 0 \
-                    and not m.crush.choose_args:
-                try:
-                    from ceph_tpu.crush import kernel as ck
+            if device_ok and ruleno >= 0 and \
+                    ruleno not in unsupported_rules:
+                key = (ruleno, pool.size)
+                if key not in compiled:
+                    try:
+                        from ceph_tpu.crush import kernel as ck
 
-                    run = ck.compile_rule(m.crush, ruleno,
-                                          result_max=pool.size,
-                                          weight=m.osd_weight)
-                    raw_rows = run(pps)
-                except NotImplementedError:
-                    raw_rows = None
+                        compiled[key] = ck.compile_rule(
+                            m.crush, ruleno, result_max=pool.size,
+                            weight=m.osd_weight)
+                    except NotImplementedError:
+                        compiled[key] = None
+                        unsupported_rules.add(ruleno)
+                run = compiled[key]
+                if run is not None:
+                    try:
+                        raw_rows = run(pps)
+                    except NotImplementedError:
+                        raw_rows = None
+            if raw_rows is None and device_ok and ruleno >= 0:
+                fallback_pools.append(pool_id)
             for ps in range(pool.pg_num):
                 pg = PgId(pool_id, ps)
                 if raw_rows is not None:
@@ -752,6 +775,11 @@ class OSDMapMapping:
                 else:
                     entries.append(m.pg_to_up_acting_osds(pg))
             self._by_pool[pool_id] = entries
+        if fallback_pools:
+            log.info(
+                "OSDMapMapping: pools %s fell back to the scalar"
+                " mapper (CRUSH rule unsupported by the vectorized"
+                " kernel)", fallback_pools)
 
     def get(self, pg: PgId) -> Tuple[List[int], int, List[int], int]:
         return self._by_pool[pg.pool][pg.ps]

@@ -21,9 +21,11 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-# observability: how many device dispatches the pipeline served — the
-# dryrun and tests assert the cluster datapath actually lands here
-stats: Dict[str, int] = {"matmul_calls": 0}
+# observability: how many device dispatches the pipeline served (and
+# how many stripe rows rode them — calls vs rows is the batching fill
+# the encode service buys) — the dryrun and tests assert the cluster
+# datapath actually lands here
+stats: Dict[str, int] = {"matmul_calls": 0, "batch_rows": 0}
 
 
 @functools.lru_cache(maxsize=1)
@@ -70,6 +72,7 @@ def matmul(mat: np.ndarray, data) -> Optional[np.ndarray]:
         arr = np.concatenate(
             [arr, np.zeros((pad, k, s), dtype=np.uint8)], axis=0)
     stats["matmul_calls"] += 1
+    stats["batch_rows"] += b
     out = np.asarray(pipe.matmul(np.asarray(mat, np.uint8), arr))
     if pad:
         out = out[:b]

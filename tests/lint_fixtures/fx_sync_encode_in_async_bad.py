@@ -1,0 +1,19 @@
+"""Violation: synchronous EC encode on the daemon's event loop —
+every concurrent write stalls behind the dispatch, and none of them
+share a batched device call."""
+
+from ceph_tpu.osd import ec_util
+
+
+async def write_full(sinfo, codec, data):
+    shards, hinfo, crc = ec_util.encode_with_hinfo(  # expect: sync-encode-in-async
+        sinfo, codec, data, range(6), logical_len=len(data))
+    return shards, hinfo, crc
+
+
+async def rmw_reencode(sinfo, codec, merged):
+    return ec_util.encode(sinfo, codec, merged, range(6))  # expect: sync-encode-in-async
+
+
+async def codec_direct(codec, want, buf):
+    return codec.encode(want, buf)  # expect: sync-encode-in-async

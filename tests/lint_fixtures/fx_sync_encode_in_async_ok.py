@@ -1,0 +1,20 @@
+"""Clean twin: encodes ride the awaited micro-batching service;
+str.encode() and sync-scope helpers stay silent."""
+
+import json
+
+from ceph_tpu.osd import ec_util
+
+
+async def write_full(service, sinfo, codec, data):
+    return await service.encode_with_hinfo(sinfo, codec, data,
+                                           range(6),
+                                           logical_len=len(data))
+
+
+async def attr_bytes(oi):
+    return json.dumps(oi).encode()
+
+
+def host_reencode(sinfo, codec, merged):
+    return ec_util.encode(sinfo, codec, merged, range(6))

@@ -20,7 +20,7 @@ sys.path.insert(0, REPO)
 import bench  # noqa: E402
 
 CONTRACT_KEYS = {"metric", "value", "unit", "vs_baseline",
-                 "plan_cache", "truncated"}
+                 "plan_cache", "encode_service", "truncated"}
 
 
 def test_contract_line_despite_hanging_backend(tmp_path):
@@ -51,6 +51,11 @@ def test_contract_line_despite_hanging_backend(tmp_path):
     # same bucketed shape
     assert contract["plan_cache"]["misses"] >= 1
     assert contract["plan_cache"]["hits"] >= 1
+    # the encode-service probe ran: concurrent requests shared batched
+    # dispatches (bit-exactness is asserted inside the probe)
+    assert contract["encode_service"]["requests"] >= 1
+    assert contract["encode_service"]["batches"] >= 1
+    assert contract["encode_service"]["batched"] >= 1
     assert contract["truncated"] is False
     # details stayed out of stdout (they belong in bench_details.json)
     assert len(stdout_lines) == 1

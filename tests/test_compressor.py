@@ -174,6 +174,27 @@ def test_histograms_match_host():
         scoring.byte_histograms_host(blocks))
 
 
+def test_host_histograms_match_per_row_bincount():
+    """The vectorized offset-bincount host path is equivalent to the
+    per-row np.bincount it replaced (incl. degenerate shapes)."""
+    rng = np.random.default_rng(6)
+    for shape in [(1, 1), (3, 7), (32, 1024), (7, 256)]:
+        blocks = rng.integers(0, 256, shape, dtype=np.uint8)
+        want = np.stack([np.bincount(row, minlength=256)
+                         for row in blocks]).astype(np.int32)
+        got = scoring.byte_histograms_host(blocks)
+        assert got.dtype == np.int32 and got.shape == (shape[0], 256)
+        np.testing.assert_array_equal(got, want)
+    empty = scoring.byte_histograms_host(
+        np.zeros((0, 16), dtype=np.uint8))
+    assert empty.shape == (0, 256) and empty.dtype == np.int32
+    # saturated single-value rows exercise the minlength tail
+    ones = np.full((4, 100), 255, dtype=np.uint8)
+    hist = scoring.byte_histograms_host(ones)
+    assert hist[:, 255].tolist() == [100] * 4
+    assert hist.sum() == 400
+
+
 def test_entropy_extremes():
     rng = np.random.default_rng(4)
     zeros = np.zeros((4, 4096), dtype=np.uint8)

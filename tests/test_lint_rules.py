@@ -28,14 +28,16 @@ RULES = [
     "trace-numpy",
     "jit-bypass-plan",
     "async-blocking",
+    "sync-encode-in-async",
     "lock-order",
     "lock-no-await",
 ]
 
-# the dtype and plan rules are path-scoped to their production
-# modules; point them at their fixture families here
+# the dtype, plan, and encode rules are path-scoped to their
+# production modules; point them at their fixture families here
 CONFIG = {"dtype_paths": ("fx_uint8",),
-          "plan_paths": ("fx_jit_bypass_plan",)}
+          "plan_paths": ("fx_jit_bypass_plan",),
+          "encode_paths": ("fx_sync_encode_in_async",)}
 
 
 def _fixture(name: str) -> str:
