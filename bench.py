@@ -56,13 +56,14 @@ def _emit_contract(value: Optional[float],
                    vs_baseline: Optional[float],
                    plan_cache: Optional[dict] = None,
                    encode_service: Optional[dict] = None,
+                   tier: Optional[dict] = None,
                    truncated: bool = False) -> None:
     """Print the one-line JSON driver contract, exactly once, before
     any optional extended benches run — a wedged tunnel or a crashed
     secondary bench can no longer yield an empty bench.  plan_cache
     carries the ExecPlan hit/miss/retrace counters, encode_service the
-    micro-batching service probe counters; truncated flags a
-    budget-shortened run."""
+    micro-batching service probe counters, tier the hot-set/read-tier
+    probe counters; truncated flags a budget-shortened run."""
     global _contract_emitted
     if _contract_emitted:
         return
@@ -74,8 +75,96 @@ def _emit_contract(value: Optional[float],
         "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
         "plan_cache": plan_cache,
         "encode_service": encode_service,
+        "tier": tier,
         "truncated": bool(truncated),
     }), flush=True)
+
+
+def _tier_probe() -> Optional[dict]:
+    """Pre-contract probe of the hot-set/read-tier subsystem: the
+    device-batched bloom positions must match the host rjenkins oracle
+    bit-exactly, and a zipfian stream through the TierAgent must
+    record / promote / hit / evict.  Counters land in the contract
+    line; None (with a stderr note) when the probe cannot run.
+
+    Contract-first discipline (same as _service_probe): skipped when
+    the wall-clock budget is spent, and the body — which includes a
+    device dispatch — runs on a worker thread under a hard timeout so
+    a wedged tunnel cannot park the bench past the contract line."""
+    if _remaining() < 0:
+        print("# tier probe skipped: budget exhausted",
+              file=sys.stderr)
+        return None
+    probe_timeout = float(os.environ.get(
+        "CEPH_TPU_BENCH_TIER_PROBE_TIMEOUT", "60"))
+    try:
+        # a DAEMON thread, not a ThreadPoolExecutor: executor workers
+        # are non-daemon and joined at interpreter exit, so a wedged
+        # dispatch would hang the whole bench after the contract line
+        import threading
+
+        box: dict = {}
+
+        def runner():
+            try:
+                box["out"] = _tier_probe_body()
+            except BaseException as e:  # surfaced below
+                box["err"] = e
+
+        t = threading.Thread(target=runner, daemon=True,
+                             name="tier-probe")
+        t.start()
+        t.join(probe_timeout)
+        if t.is_alive():
+            print("# tier probe timed out (wedged dispatch?)",
+                  file=sys.stderr)
+            return None
+        if "err" in box:
+            raise box["err"]
+        return box.get("out")
+    except Exception as e:
+        print(f"# tier probe failed: {e!r}", file=sys.stderr)
+        return None
+
+
+def _tier_probe_body() -> dict:
+    """The probe proper; failures propagate to the runner thread's
+    capture in _tier_probe — one reporting layer, like
+    _service_probe."""
+    from ceph_tpu.osd import hitset as hm
+    from ceph_tpu.osd.tier import TierAgent
+    from ceph_tpu.tools.rados import zipf_indices
+
+    hashes = np.array([hm.hash_oid(f"probe_{i}")
+                       for i in range(256)], dtype=np.uint32)
+    nbits, nhash = hm.bloom_geometry(1024, 0.05)
+    host = hm.bloom_positions(hashes, nbits, nhash)
+    # 0 = no jax, the device lane never ran (positions_for would
+    # silently fall back to the same host math being oracled)
+    device_bitexact = 0
+    if hm.HAVE_JAX:
+        dev = hm.positions_for(hashes, nbits, nhash, device=True)
+        assert np.array_equal(host, dev), "device/host bloom mismatch"
+        device_bitexact = 1
+
+    agent = TierAgent("bench-probe", {
+        "osd_tier_enable": True,
+        "osd_tier_promote_min_recency": 2,
+        "osd_tier_cache_bytes": 8 << 10})
+    payload = b"\xab" * 1024
+    for i in zipf_indices(1.2, 32, 512, seed=7):
+        oid = f"obj_{int(i)}"
+        hits = agent.note_read("pg", oid)
+        if agent.lookup("pg", oid) is not None:
+            continue
+        if agent.wants_promote("pg", oid, hits) and \
+                agent.begin_promote("pg", oid):
+            agent.end_promote("pg", oid, payload)
+    c = agent.perf
+    out = {key: c.get(key) for key in
+           ("records", "hit", "miss", "promote", "evict")}
+    out["device_bitexact"] = device_bitexact
+    return out
 
 
 def _service_probe() -> Optional[dict]:
@@ -205,6 +294,86 @@ def bench_write_path() -> dict:
     return {"write_burst_32x256KiB_svc_on_mibs": mibs_on,
             "write_burst_32x256KiB_svc_off_mibs": mibs_off,
             "write_burst_encode_service": svc_counters}
+
+
+def bench_tier() -> dict:
+    """Skewed-read leg through a live cluster, read tier on vs off:
+    24 x 32 KiB objects in an EC 4+2 pool, 256 zipf(1.2) reads.  The
+    decode-dispatch delta from plan.stats() shows the hot-read bypass
+    working (tier on: hot objects decode once); the byte-equality
+    check shows it is exact."""
+    import asyncio
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_helpers import Cluster
+    from ceph_tpu.ec import plan as ec_plan
+    from ceph_tpu.tools.rados import zipf_indices
+
+    n_objs, osize, n_reads = 24, 32 << 10, 256
+    payloads = [np.random.default_rng(300 + i).integers(
+        0, 256, osize, dtype=np.uint8).tobytes()
+        for i in range(n_objs)]
+    profile = {"plugin": "ec_jax", "technique": "reed_sol_van",
+               "k": "4", "m": "2", "crush-failure-domain": "osd"}
+    idx = zipf_indices(1.2, n_objs, n_reads, seed=41)
+
+    async def run_mode():
+        cluster = Cluster(num_osds=6, osds_per_host=3,
+                          osd_config={"osd_heartbeat_interval": 3.0,
+                                      "osd_heartbeat_grace": 20.0,
+                                      "osd_hit_set_period": 3600.0})
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "tp", profile=profile, pg_num=8)
+            io = cluster.client.open_ioctx("tp")
+            for i in range(n_objs):
+                await io.write_full(f"t{i}", payloads[i])
+            # warm pass promotes the hot set, timed pass measures it
+            for i in idx[:64]:
+                await io.read(f"t{int(i)}")
+            await asyncio.sleep(0.2)  # let promotions land
+            d0 = ec_plan.stats()["dispatches"]
+            t0 = time.perf_counter()
+            datas = [await io.read(f"t{int(i)}") for i in idx]
+            dt = time.perf_counter() - t0
+            dispatches = ec_plan.stats()["dispatches"] - d0
+            tier_counters: dict = {}
+            for osd in cluster.osds.values():
+                for key, v in osd.tier.counters().items():
+                    if isinstance(v, int):
+                        tier_counters[key] = \
+                            tier_counters.get(key, 0) + v
+            digest = hash(tuple(bytes(d) for d in datas))
+            ok = all(bytes(d) == payloads[int(i)]
+                     for d, i in zip(datas, idx))
+            return dt, dispatches, tier_counters, digest, ok
+        finally:
+            await cluster.stop()
+
+    prev = os.environ.get("CEPH_TPU_TIER")
+    try:
+        os.environ["CEPH_TPU_TIER"] = "1"
+        dt_on, disp_on, counters, digest_on, ok_on = \
+            asyncio.run(run_mode())
+        os.environ["CEPH_TPU_TIER"] = "0"
+        dt_off, disp_off, _c, digest_off, ok_off = \
+            asyncio.run(run_mode())
+    finally:
+        if prev is None:
+            os.environ.pop("CEPH_TPU_TIER", None)
+        else:
+            os.environ["CEPH_TPU_TIER"] = prev
+    return {
+        "tier_zipf_reads_on_ops_per_sec": n_reads / max(dt_on, 1e-9),
+        "tier_zipf_reads_off_ops_per_sec": n_reads / max(dt_off, 1e-9),
+        "tier_decode_dispatches_on": disp_on,
+        "tier_decode_dispatches_off": disp_off,
+        "tier_bytes_identical": bool(ok_on and ok_off
+                                     and digest_on == digest_off),
+        "tier_counters": counters,
+    }
 
 
 def bench_lrc_crc() -> float:
@@ -690,11 +859,15 @@ def main() -> None:
     # encode-service probe (cheap, before the contract): concurrent
     # awaited encodes bit-exact vs inline, counters into the contract
     service_counters = _service_probe()
+    # hot-set/read-tier probe (cheap, before the contract):
+    # device-batched bloom bit-exact + agent promote/hit/evict alive
+    tier_counters = _tier_probe()
 
     # the driver contract line, before every optional/extended bench:
     # a wedge below this point can cost detail rows, never the bench
     _emit_contract(enc_gibs, vs_baseline, plan_cache=plan_counters,
                    encode_service=service_counters,
+                   tier=tier_counters,
                    truncated=skip_optional)
 
     # decode sweep over 1..m erasures (the reference benchmark sweeps
@@ -756,6 +929,17 @@ def main() -> None:
         except Exception as e:
             print(f"# write path bench failed: {e!r}", file=sys.stderr)
 
+    # tier section: skewed-read leg through a live cluster, read tier
+    # on vs off, decode-dispatch delta from plan.stats()
+    tier_section: dict = {}
+    if not _SMOKE and skip_optional:
+        skipped_sections.append("tier")
+    elif not _SMOKE:
+        try:
+            tier_section = bench_tier()
+        except Exception as e:
+            print(f"# tier bench failed: {e!r}", file=sys.stderr)
+
     details = {
         "encode_gibs": enc_gibs,
         "encode_path": "pallas_words" if use_pallas else "xla_bitplanes",
@@ -771,7 +955,9 @@ def main() -> None:
         "put_64MiB_md5_etag_gibs": put_md5_gibs,
         **put_gate,
         **write_path,
+        **tier_section,
         "encode_service": service_counters,
+        "tier": tier_counters,
         "host_cores": os.cpu_count(),
         "encode_ms_per_batch": t_enc * 1e3,
         "k": k, "m": m, "chunk_bytes": chunk, "batch": batch,

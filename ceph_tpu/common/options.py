@@ -163,6 +163,36 @@ def _opts() -> List[Option]:
         Option("mon_osd_laggy_weight", "float", 0.3, A, min=0.0, max=1.0),
         Option("mon_osd_adjust_heartbeat_grace", "bool", True, A),
         Option("heartbeat_inject_failure", "uint", 0, D),
+        # -- hot-set tracking / read tier (HitSet.h + the tier agent;
+        #    flat-substrate redesign: the tier caches DECODED objects
+        #    on the primary, not a second pool) -----------------------
+        Option("osd_tier_enable", "bool", True, A,
+               desc="hot-set tracking + decoded-object read tier"
+                    " (env kill switch: CEPH_TPU_TIER=0)",
+               flags=FLAG_STARTUP),
+        Option("osd_hit_set_count", "uint", 4, A, min=1, max=32,
+               desc="hit sets per PG stack (open + archived)"),
+        Option("osd_hit_set_period", "secs", 10.0, A,
+               desc="seconds before the open hit set seals+rotates"),
+        Option("osd_hit_set_target_size", "uint", 1024, A,
+               desc="expected insertions per bloom hit set"),
+        Option("osd_hit_set_bloom_fpp", "float", 0.05, A,
+               min=0.0, max=0.5,
+               desc="bloom hit-set false-positive probability"),
+        Option("osd_hit_set_type", "str", "bloom", A,
+               enum_values=("bloom", "explicit_hash"),
+               desc="hit-set implementation"),
+        Option("osd_tier_promote_min_recency", "uint", 2, A, min=1,
+               desc="hit count across the stack before an EC object"
+                    " is promoted into the decoded-object tier"),
+        Option("osd_tier_cache_bytes", "size", 64 << 20, A,
+               desc="decoded-object tier byte budget (LRU evicts"
+                    " beyond it)"),
+        Option("osd_tier_promote_max_inflight", "uint", 4, A, min=1,
+               desc="concurrent agent promotions per daemon"),
+        Option("osd_tier_promote_backoff", "secs", 5.0, A,
+               desc="cool-down before re-attempting a failed"
+                    " promotion of the same object"),
         # -- osd/pg --------------------------------------------------------
         Option("osd_pool_default_size", "uint", 3, B),
         Option("osd_pool_default_min_size", "uint", 0, A),

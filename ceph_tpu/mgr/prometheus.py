@@ -83,6 +83,83 @@ class PrometheusModule(MgrModule):
         finally:
             writer.close()
 
+    @staticmethod
+    def _safe_name(name: str) -> str:
+        """Metric-name charset: [a-zA-Z0-9_:]; everything else -> _."""
+        return "".join(c if c.isalnum() or c in "_:" else "_"
+                       for c in name)
+
+    # perf-dump leaves that are LEVELS, not monotone counts: declaring
+    # them counters would make rate()/increase() read every decrease
+    # as a counter reset.  Matched on the flattened metric's suffix.
+    _GAUGE_SUFFIXES = (
+        "_cached_bytes", "_cached_objects", "_inflight",
+        "_queue_depth", "_queue_bytes", "_window_ms",
+        "_max_batch_bytes", "_enabled", "_plans",
+    )
+
+    @classmethod
+    def _emit_perf(cls, lines: List[str], seen_types: set,
+                   metric: str, value,
+                   labels: Dict[str, Any]) -> None:
+        """One perf-dump entry -> exposition lines.
+
+        - numeric/bool: plain counter sample;
+        - PerfCounters histogram dump ({buckets, bounds, count, sum}):
+          cumulative `_bucket{le=...}` rows + `_count`/`_sum`;
+        - a `profiles`/`per_plan` map: recurse with a `profile` label
+          instead of exploding the metric namespace;
+        - any other dict: recurse with _-joined names (the tier /
+          plan_cache / encode_service sections).
+        Non-numeric leaves (strings, lists) are skipped."""
+        metric = cls._safe_name(metric)
+        if isinstance(value, bool):
+            value = int(value)
+        if isinstance(value, (int, float)):
+            if metric not in seen_types:
+                kind = "gauge" if metric.endswith(
+                    cls._GAUGE_SUFFIXES) else "counter"
+                lines.append(f"# TYPE {metric} {kind}")
+                seen_types.add(metric)
+            lines.append(_fmt(metric, value, labels))
+            return
+        if not isinstance(value, dict):
+            return
+        if "buckets" in value and "bounds" in value:
+            if metric not in seen_types:
+                lines.append(f"# TYPE {metric} histogram")
+                seen_types.add(metric)
+            cum = 0
+            for bound, n in zip(value["bounds"], value["buckets"]):
+                cum += n
+                lines.append(_fmt(f"{metric}_bucket", cum,
+                                  {**labels, "le": bound}))
+            cum += value["buckets"][-1] if len(value["buckets"]) > \
+                len(value["bounds"]) else 0
+            lines.append(_fmt(f"{metric}_bucket", cum,
+                              {**labels, "le": "+Inf"}))
+            lines.append(_fmt(f"{metric}_count",
+                              value.get("count", cum), labels))
+            lines.append(_fmt(f"{metric}_sum", value.get("sum", 0),
+                              labels))
+            return
+        for special in ("profiles", "per_plan"):
+            suffix = "_" + special
+            if not metric.endswith(suffix):
+                continue
+            base = metric[:-len(suffix)] + "_profile"
+            for profile, stats in sorted(value.items()):
+                if not isinstance(stats, dict):
+                    continue
+                plabels = {**labels, "profile": profile}
+                for k, v in sorted(stats.items()):
+                    cls._emit_perf(lines, seen_types, f"{base}_{k}",
+                                   v, plabels)
+            return
+        for k, v in sorted(value.items()):
+            cls._emit_perf(lines, seen_types, f"{metric}_{k}", v,
+                           labels)
+
     async def collect(self) -> str:
         """One exposition document from the subscribed map + scrapes."""
         lines: List[str] = []
@@ -118,20 +195,19 @@ class PrometheusModule(MgrModule):
                 lines.append(_fmt("ceph_pool_recommended_pg_num",
                                   row["pg_num_ideal"],
                                   {"pool": row["pool_name"]}))
-        # per-OSD perf counters over the tell surface
+        # per-OSD perf counters over the tell surface.  The dump is
+        # nested since the tier/plan-cache/encode-service sections
+        # landed: scalars flatten with _-joined names, per-profile
+        # maps become `profile` labels, histogram dicts export as
+        # prometheus histograms (read-frequency rows etc.)
         perf = await self.mgr.scrape_osd_perf()
         seen_types = set()
         for o, counters in sorted(perf.items()):
+            labels = {"ceph_daemon": f"osd.{o}"}
             for key, value in sorted(counters.items()):
-                if not isinstance(value, (int, float)):
-                    continue
-                metric = f"ceph_osd_{key}"
-                if metric not in seen_types:
-                    lines.append(f"# TYPE {metric} counter")
-                    seen_types.add(metric)
-                lines.append(_fmt(metric, value,
-                                  {"ceph_daemon": f"osd.{o}"}))
-        # mon health
+                self._emit_perf(lines, seen_types, f"ceph_osd_{key}",
+                                value, labels)
+        # mon health (emitted after the perf walk)
         try:
             rc, health = await self.mgr.client.mon_command(
                 {"prefix": "health"})

@@ -540,12 +540,13 @@ class MOSDSubRead(Message):
 
     TAG = 13
 
-    VERSION = 2  # v2 appends want_omap
+    VERSION = 3  # v2 appends want_omap; v3 appends record (hit-set)
     COMPAT = 1
 
     def __init__(self, tid: int, pg: PgId, shard: int, oid: str,
                  offset: int = 0, length: int = 0,
-                 want_attrs: bool = True, want_omap: bool = False):
+                 want_attrs: bool = True, want_omap: bool = False,
+                 record: bool = False):
         self.tid = tid
         self.pg = pg
         self.shard = shard
@@ -554,6 +555,10 @@ class MOSDSubRead(Message):
         self.length = length
         self.want_attrs = want_attrs
         self.want_omap = want_omap
+        # client-read provenance: only these sub-reads feed the
+        # replica's hot-set tracking (scrub/recovery/stat probes
+        # would drown the skew signal)
+        self.record = record
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u64(self.tid)
@@ -564,6 +569,7 @@ class MOSDSubRead(Message):
         enc.u64(self.length)
         enc.bool(self.want_attrs)
         enc.bool(self.want_omap)
+        enc.bool(self.record)
 
     @classmethod
     def decode(cls, data: bytes) -> "MOSDSubRead":
@@ -573,6 +579,8 @@ class MOSDSubRead(Message):
                   dec.u64(), dec.u64(), dec.bool())
         if struct_v >= 2:
             msg.want_omap = dec.bool()
+        if struct_v >= 3:
+            msg.record = dec.bool()
         dec.finish()
         return msg
 

@@ -73,6 +73,7 @@ from ceph_tpu.os import ObjectId, ObjectStore, Transaction
 from ceph_tpu.os.memstore import MemStore
 from ceph_tpu.osd import ec_util
 from ceph_tpu.osd.encode_service import EncodeService
+from ceph_tpu.osd.tier import TierAgent
 from ceph_tpu.osd import scheduler as sched_mod
 from ceph_tpu.osd.osdmap import OSDMap, PgId, TYPE_ERASURE, TYPE_REPLICATED
 from ceph_tpu.osd.pg_log import (
@@ -123,6 +124,11 @@ RB_PREFIX = "_rbgen_"
 # field role).  The separator is unprintable so client object names can
 # never collide with clone names.
 SNAP_SEP = "\x16"
+
+# sealed hit sets persist in the pg-meta object's omap under this key
+# prefix (the reference persists hit_set archives as PG objects; one
+# omap namespace per PG plays that role on this substrate)
+HITSET_OMAP_PREFIX = "hitset_"
 
 
 def clone_name(oid: str, cloneid: int) -> str:
@@ -327,6 +333,11 @@ class OSDDaemon:
         # behavior) when the device tier is absent or
         # CEPH_TPU_ENCODE_SERVICE=0
         self.encode_service = EncodeService(who=f"osd.{osd_id}")
+        # hot-set tracking + decoded-object read tier (HitSet + the
+        # PrimaryLogPG agent role); kill switch CEPH_TPU_TIER=0 /
+        # osd_tier_enable=false
+        self.tier = TierAgent(who=f"osd.{osd_id}", config=self.config)
+        self._promote_tasks: Set[asyncio.Task] = set()
         # watch/notify: (pool, oid) -> {(client, cookie): Connection}
         self.watchers: Dict[Tuple[int, str],
                             Dict[Tuple[str, int], Connection]] = {}
@@ -425,8 +436,16 @@ class OSDDaemon:
                 lambda cmd: self.op_tracker.dump_historic(),
                 "show recently completed client ops"),
             "perf dump": (
-                lambda cmd: dict(self.perf),
-                "data-path transfer/dispatch counters"),
+                lambda cmd: self._cmd_perf_dump(),
+                "data-path transfer/dispatch counters + tier/"
+                "plan-cache/encode-service sub-sections"),
+            "tier_status": (
+                lambda cmd: self.tier.status(),
+                "read-tier cache occupancy + hit/miss/promote/evict"
+                " counters"),
+            "hitset_dump": (
+                lambda cmd: self._cmd_hitset_dump(),
+                "per-PG hot-set stacks + persisted hitset omap keys"),
             "dump_pgs": (
                 lambda cmd: {str(pg): {"state": st.state,
                                        "primary": st.primary,
@@ -449,6 +468,53 @@ class OSDDaemon:
                 lambda cmd: self._cmd_statfs(),
                 "store usage + per-pool object/byte breakdown"),
         }
+
+    def _cmd_perf_dump(self) -> Dict[str, Any]:
+        """Flat data-path counters plus the nested observability
+        sections the prometheus exporter flattens: tier (hit-set +
+        cache), plan_cache (ExecPlan hits/misses/retraces/dispatches)
+        and encode_service (micro-batching counters + per-profile
+        batch/fill stats)."""
+        from ceph_tpu.ec import plan as ec_plan
+
+        out: Dict[str, Any] = dict(self.perf)
+        out["tier"] = self.tier.counters()
+        out["plan_cache"] = {
+            k: int(v) for k, v in ec_plan.stats().items()
+            if isinstance(v, (bool, int))}
+        svc = self.encode_service.stats()
+        out["encode_service"] = {
+            k: (int(v) if isinstance(v, bool) else v)
+            for k, v in svc.items()
+            if isinstance(v, (bool, int, float))}
+        out["encode_service"]["profiles"] = {
+            label: {k: v for k, v in st.items()
+                    if isinstance(v, (int, float, dict))
+                    and not isinstance(v, bool)}
+            for label, st in svc.get("profiles", {}).items()}
+        return out
+
+    def _cmd_hitset_dump(self) -> Dict[str, Any]:
+        """Live per-PG stacks + the hitset omap keys persisted on this
+        daemon's shard collections (the kv omap prefix archive)."""
+        out: Dict[str, Any] = {"stacks": self.tier.hitset_dump(),
+                               "persisted": {}}
+        for pg, state in list(self.pgs.items()):
+            pool = self.osdmap.pools.get(pg.pool) \
+                if self.osdmap else None
+            if pool is None:
+                continue
+            shard = state.my_shard(self.osd_id, pool.type)
+            try:
+                omap = self.store.omap_get(self._cid(pg, shard),
+                                           ObjectId(PGMETA_OID))
+            except (KeyError, IOError):
+                continue
+            keys = sorted(k for k in omap
+                          if k.startswith(HITSET_OMAP_PREFIX))
+            if keys:
+                out["persisted"][str(pg)] = keys
+        return out
 
     async def _cmd_statfs(self) -> Dict[str, Any]:
         """Store usage plus a per-pool breakdown from this OSD's own
@@ -510,6 +576,11 @@ class OSDDaemon:
 
     async def stop(self) -> None:
         self._stopping = True
+        for task in list(self._promote_tasks):
+            task.cancel()
+        if self._promote_tasks:
+            await asyncio.gather(*list(self._promote_tasks),
+                                 return_exceptions=True)
         await self.scheduler.stop()
         # after the scheduler drained: no new client ops enqueue, and
         # any encode futures still in flight resolve before teardown
@@ -538,6 +609,8 @@ class OSDDaemon:
             self._hb_task.cancel()
         if self._scrub_task is not None:
             self._scrub_task.cancel()
+        for task in list(self._promote_tasks):
+            task.cancel()
         await self.scheduler.stop()
         await self.encode_service.stop()
         for ps in self.pgs.values():
@@ -1037,9 +1110,11 @@ class OSDDaemon:
                     state.interval_epoch = self.osdmap.epoch
                     state.state = "inactive"
                     state.active_event.clear()
-                    # primary-side extent cache is only coherent within
-                    # one interval
+                    # primary-side extent cache and read tier are only
+                    # coherent within one interval — a new primary may
+                    # have applied writes this daemon never saw
                     state.extent_cache.clear()
+                    self.tier.drop_pg(pg)
                     if state.peering_task is not None:
                         state.peering_task.cancel()
                         state.peering_task = None
@@ -1458,6 +1533,18 @@ class OSDDaemon:
                                msg: MOSDSubRead) -> None:
         state = self.pgs.get(msg.pg)
         pool = self.osdmap.pools.get(msg.pg.pool) if self.osdmap else None
+        if self.tier.enabled and state is not None and \
+                getattr(msg, "record", False) and \
+                not is_internal_name(msg.oid) and \
+                msg.oid != PGMETA_OID:
+            # replica-side hot-set observability for CLIENT reads only
+            # (msg.record rides from the primary's _op_read gather);
+            # scrub/recovery/stat sub-reads would drown the skew
+            # signal.  Promotion decisions stay with the primary's
+            # own hitset.
+            self.tier.record_read(msg.pg, msg.oid)
+            if self.tier.sealed_pending():
+                self._persist_sealed_hitsets()
         if state is not None and pool is not None:
             plog = self._load_log(state, pool)
             # the missing guard protects my CURRENT shard only; stray
@@ -1826,7 +1913,8 @@ class OSDDaemon:
     async def _read_candidates(
             self, pg: PgId, shard: int, osd: int, oid: str,
             include_rollback: bool,
-            offset: int = 0, length: int = 0
+            offset: int = 0, length: int = 0,
+            record: bool = False
     ) -> Tuple[List[Tuple[int, bytes, Dict[str, bytes]]], bool]:
         """Read one (shard, osd)'s main object — and, when asked, its
         rollback generation — as selection candidates.  offset/length
@@ -1855,7 +1943,8 @@ class OSDDaemon:
                 continue
             tid = self._next_tid()
             reply = await self._request(
-                osd, MOSDSubRead(tid, pg, shard, name, offset, length),
+                osd, MOSDSubRead(tid, pg, shard, name, offset, length,
+                                 record=record and name == oid),
                 tid)
             if reply is not None and reply.rc == 0:
                 self.perf["subread_bytes"] += len(reply.data)
@@ -1868,7 +1957,8 @@ class OSDDaemon:
             self, state: PGState, pool, oid: str,
             exclude_missing: bool = True,
             include_rollback: bool = False,
-            offset: int = 0, length: int = 0
+            offset: int = 0, length: int = 0,
+            record: bool = False
     ) -> Tuple[List[Tuple[int, bytes, Dict[str, bytes]]], bool]:
         """Collect available (shard, payload, attrs) candidates for an
         object from up acting shards, CONCURRENTLY (local read for mine,
@@ -1903,7 +1993,8 @@ class OSDDaemon:
                 # from selection
                 continue
             jobs.append(self._read_candidates(
-                pg, shard, osd, oid, include_rollback, offset, length))
+                pg, shard, osd, oid, include_rollback, offset, length,
+                record=record))
         results = await asyncio.gather(*jobs) if jobs else []
         complete = complete and all(ok for _sub, ok in results)
         return [c for sub, _ok in results for c in sub], complete
@@ -2942,6 +3033,9 @@ class OSDDaemon:
         oid = plan["oid"]
         targets = plan["targets"]
         i_need = plan["i_need"]
+        # recovery rewrites shards (or removes the object): the tier
+        # entry may describe pre-adjudication state
+        self.tier.invalidate(pg, oid)
 
         if plan["kind"] == "remove":
             async def remove_peer(shard_key: int, osd: int) -> None:
@@ -3304,6 +3398,10 @@ class OSDDaemon:
         under — not the live epoch, so an op parked across an interval
         change can never outrun replica fencing."""
         pg = state.pg
+        # EVERY primary mutation funnels through here: drop the
+        # decoded-object tier entry BEFORE any shard changes so a
+        # concurrent-looking read can never see post-write cached bytes
+        self.tier.invalidate(pg, oid)
         if admit_epoch is None:
             admit_epoch = state.interval_epoch
         # fenced by a newer interval (a peering query outran our map, or
@@ -3821,9 +3919,143 @@ class OSDDaemon:
                 self._acked_version(state, pool, oid) > version:
             raise UnfoundObject(oid)
 
+    # -- read tier agent (HitSet + PrimaryLogPG agent role) ----------------
+
+    def _persist_sealed_hitsets(self) -> None:
+        """Archive sealed hit sets into the pg-meta object's omap
+        under the hitset_ key prefix (hit_set persistence role),
+        trimming entries that decayed off the stack."""
+        for pg, seq, hs in self.tier.pop_sealed():
+            state = self.pgs.get(pg)
+            pool = self.osdmap.pools.get(pg.pool) \
+                if self.osdmap else None
+            if state is None or pool is None:
+                continue
+            shard = state.my_shard(self.osd_id, pool.type)
+            cid = self._cid(pg, shard)
+            meta = ObjectId(PGMETA_OID)
+            t = Transaction()
+            t.touch(cid, meta)
+            t.omap_setkeys(cid, meta, {
+                f"{HITSET_OMAP_PREFIX}{seq:08d}":
+                    json.dumps(hs.to_dict()).encode()})
+            stale = seq - max(self.tier.hit_set_count - 1, 1)
+            if stale >= 1:
+                # trim a WINDOW, not just one key: sealed-ring
+                # overflow (quiet persisting path) can skip seqs, and
+                # a single-key trim would strand their archives in
+                # the omap forever
+                t.omap_rmkeys(cid, meta, [
+                    f"{HITSET_OMAP_PREFIX}{s:08d}"
+                    for s in range(max(1, stale - 63), stale + 1)])
+            try:
+                self.store.queue_transaction(t)
+            except (KeyError, IOError):
+                pass  # shard collection gone (interval churn)
+
+    def _tier_kick_promote(self, state: PGState, pool,
+                           oid: str) -> None:
+        """Spawn one deduplicated, inflight-capped promotion task."""
+        if self._stopping or \
+                not self.tier.begin_promote(state.pg, oid):
+            return
+        task = asyncio.get_running_loop().create_task(
+            self._tier_promote(state, pool, oid))
+        self._promote_tasks.add(task)
+        task.add_done_callback(self._promote_tasks.discard)
+
+    async def _tier_promote(self, state: PGState, pool,
+                            oid: str) -> None:
+        """Agent promotion: decode the whole object ONCE through the
+        cold read path and install the bytes in the tier.  Runs under
+        the mClock background_best_effort class (client reads keep
+        their reservation; a promotion storm is throttled, never
+        starves I/O) and under the per-object lock, so the install
+        cannot race a writer's invalidation."""
+        pg = state.pg
+        interval = state.interval_epoch
+        installed = False
+        span = self.tracer.start(f"tier_promote {pg} {oid}")
+        try:
+            async def decode_and_install():
+                nonlocal installed
+                async with state.obj_lock(oid):
+                    if self._stopping or state.state != "active" or \
+                            state.interval_epoch != interval or \
+                            state.primary != self.osd_id:
+                        span.event("aborted: interval/teardown")
+                        return
+                    rc, payload = await self._op_read(
+                        state, pool, oid, 0, 0, use_tier=False)
+                    if rc != 0:
+                        span.event(f"decode rc={rc}")
+                        return
+                    # the decode awaited: re-check the interval (it
+                    # only ever advances) — a map flap during the
+                    # decode may have let another primary commit
+                    # writes this daemon never saw, and drop_pg has
+                    # already run; installing would cache stale bytes
+                    # nothing will invalidate
+                    if self._stopping or \
+                            state.interval_epoch != interval or \
+                            state.primary != self.osd_id:
+                        span.event("aborted: interval moved mid-decode")
+                        return
+                    self.tier.end_promote(pg, oid, bytes(payload))
+                    installed = True
+                    span.event(f"promoted {len(payload)}B")
+            await self.scheduler.run(sched_mod.BEST_EFFORT, 4.0,
+                                     decode_and_install)
+        except asyncio.CancelledError:
+            pass                      # daemon teardown
+        except (RuntimeError, UnfoundObject):
+            pass                      # scheduler stopped / degraded
+        except Exception:
+            log.exception("osd.%d: tier promote %s/%s failed",
+                          self.osd_id, pg, oid)
+        finally:
+            if not installed:
+                self.tier.end_promote(pg, oid, None)
+            self.tracer.finish(span)
+
+    @staticmethod
+    def _tier_slice(data: bytes, offset: int, length: int) -> bytes:
+        """Slice a cached decoded object exactly like the cold path
+        slices its decode output (same offset/length semantics, so the
+        bypass is bit-identical)."""
+        if offset >= len(data):
+            return b""
+        if length:
+            return data[offset:offset + length]
+        if offset:
+            return data[offset:]
+        return data
+
     async def _op_read(self, state: PGState, pool, oid: str,
-                       offset: int, length: int
+                       offset: int, length: int,
+                       use_tier: bool = True
                        ) -> Tuple[int, bytes]:
+        # hot-set tracking + read tier: record the read, serve a
+        # promoted EC object straight from the decoded-object cache
+        # (zero EC plan dispatches), and kick an agent promotion when
+        # the hit count crosses osd_tier_promote_min_recency.
+        # use_tier=False is the promotion decode itself (and the
+        # coherency tests' cold-path oracle).
+        tracked = (use_tier and self.tier.enabled
+                   and not is_internal_name(oid))
+        if tracked:
+            self.tier.record_read(state.pg, oid)
+            if self.tier.sealed_pending():
+                self._persist_sealed_hitsets()
+            if pool.type == TYPE_ERASURE:
+                cached = self.tier.lookup(state.pg, oid)
+                if cached is not None:
+                    return 0, self._tier_slice(cached, offset, length)
+                # promote signal only on a miss: a steady-state tier
+                # hit skips the archived-bloom probes entirely
+                hit_count = self.tier.hit_count(state.pg, oid)
+                if self.tier.wants_promote(state.pg, oid, hit_count):
+                    self._tier_kick_promote(state, pool, oid)
         if pool.type == TYPE_REPLICATED:
             # fast path: primary serves from its own copy when the
             # object is fully recovered (the reference's normal read)
@@ -3843,7 +4075,7 @@ class OSDDaemon:
                 if rc == ENOENT:
                     return ENOENT, b""
             candidates, _complete = await self._gather_object_shards(
-                state, pool, oid)
+                state, pool, oid, record=tracked)
             if not candidates:
                 self._block_if_unfound(state, pool, oid)
                 return ENOENT, b""
@@ -3878,7 +4110,8 @@ class OSDDaemon:
             chunk_off = (start // width) * chunk
             chunk_len = (span // width) * chunk
             candidates, _complete = await self._gather_object_shards(
-                state, pool, oid, offset=chunk_off, length=chunk_len)
+                state, pool, oid, offset=chunk_off, length=chunk_len,
+                record=tracked)
             if not candidates:
                 self._block_if_unfound(state, pool, oid)
                 return ENOENT, b""
@@ -3918,7 +4151,7 @@ class OSDDaemon:
             rel = offset - start
             return 0, data[rel:rel + min(length, size - offset)]
         candidates, _complete = await self._gather_object_shards(
-            state, pool, oid)
+            state, pool, oid, record=tracked)
         if not candidates:
             self._block_if_unfound(state, pool, oid)
             return ENOENT, b""

@@ -1,0 +1,330 @@
+"""HitSet: hot-set tracking for the read tier.
+
+Reference parity: HitSet (/root/reference/src/osd/HitSet.h:35) — a
+probabilistic set of recently-touched objects, persisted per PG as a
+decaying stack of N sets rotated on a period, consumed by the tiering
+agent's promote/evict decisions (PrimaryLogPG::hit_set_* and the agent
+in PrimaryLogPG.cc).  Two implementations, like the reference:
+
+- BloomHitSet   (compressible_bloom_filter role): fixed false-positive
+  budget, constant memory;
+- ExplicitHashHitSet: exact 32-bit hash set (the small-PG fallback).
+
+The substrate twist: bloom insert/contains run over the SAME
+vectorized rjenkins kernels CRUSH placement uses (ops/rjenkins.py
+`hash32_2(..., xp)`), so a batch of object hashes maps to its k bloom
+bit positions in ONE device dispatch (`xp=jnp`, jitted through the
+plan cache's tracked_jit for retrace observability), with the numpy
+host path (`xp=np`) producing bit-identical positions — uint32
+wraparound math is exact on both.  Off-device (no jax) everything runs
+on the host path.
+
+Object names enter as the same 32-bit Jenkins string hash the PG
+mapper uses (`ceph_str_hash_rjenkins`), so the hot-set key space is
+the reference's hobject hash space.
+"""
+
+from __future__ import annotations
+
+import base64
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ceph_tpu.ops import rjenkins
+
+try:  # pragma: no cover - exercised via the device path tests
+    import jax.numpy as jnp
+
+    HAVE_JAX = True
+except Exception:  # pragma: no cover
+    HAVE_JAX = False
+
+# batches below this hash on the host: a device dispatch per handful
+# of oids costs more latency than it saves lanes
+DEVICE_MIN_BATCH = 8
+
+_LN2 = float(np.log(2.0))
+
+
+def hash_oid(oid: str) -> int:
+    """Object name -> the 32-bit Jenkins hash the PG mapper uses
+    (hobject_t::get_hash role): one hash space for placement and
+    hot-set tracking."""
+    return rjenkins.ceph_str_hash_rjenkins(oid.encode())
+
+
+def bloom_geometry(target_size: int, fpp: float) -> tuple:
+    """(nbits, nhash) for `target_size` insertions at false-positive
+    probability `fpp` (the standard Bloom sizing the reference's
+    bloom_filter::compute_optimal_parameters performs)."""
+    n = max(int(target_size), 1)
+    p = min(max(float(fpp), 1e-9), 0.5)
+    nbits = int(np.ceil(-n * np.log(p) / (_LN2 * _LN2)))
+    nbits = max(nbits, 8)
+    nhash = max(1, int(round(nbits / n * _LN2)))
+    return nbits, min(nhash, 32)
+
+
+def bloom_positions(hashes, nbits: int, nhash: int, xp=np):
+    """[B] uint32 oid hashes -> [B, nhash] uint32 bloom bit positions.
+
+    Every op is elementwise uint32-lane work through the rjenkins mix,
+    so with xp=jnp the whole batch maps in one fused device dispatch;
+    xp=np is the bit-exact host oracle.  Position i uses seed i (the
+    per-probe salt), mixed through the same hash32_2 kernel CRUSH
+    bulk placement vmaps."""
+    h = xp.asarray(hashes).astype(xp.uint32).reshape(-1, 1)
+    seeds = xp.arange(nhash, dtype=xp.uint32).reshape(1, -1)
+    return (rjenkins.hash32_2(h, seeds, xp=xp)
+            % xp.uint32(nbits)).astype(xp.uint32)
+
+
+_device_fns: Dict[tuple, Any] = {}
+
+
+def _device_positions(hashes: np.ndarray, nbits: int,
+                      nhash: int) -> np.ndarray:
+    """Device-batched positions: one jitted dispatch per pow2-bucketed
+    batch (shape churn would retrace per unique batch size)."""
+    from ceph_tpu.ec import plan
+
+    key = (nbits, nhash)
+    fn = _device_fns.get(key)
+    if fn is None:
+        def impl(h):
+            return bloom_positions(h, nbits, nhash, xp=jnp)
+
+        fn = plan.tracked_jit(f"hitset_bloom_b{nbits}_k{nhash}", impl)
+        _device_fns[key] = fn
+    n = len(hashes)
+    cap = plan.bucket_batch(n)
+    if cap > n:
+        # pad with the last element: duplicate inserts/queries are
+        # idempotent and the tail is sliced off below
+        hashes = np.concatenate(
+            [hashes, np.full(cap - n, hashes[-1], dtype=np.uint32)])
+    return np.asarray(fn(jnp.asarray(hashes)))[:n]
+
+
+def positions_for(hashes, nbits: int, nhash: int,
+                  device: Optional[bool] = None) -> np.ndarray:
+    """Dispatch policy: device for real batches when jax is present,
+    host otherwise.  Both paths are bit-exact."""
+    arr = np.asarray(hashes, dtype=np.uint32).reshape(-1)
+    if arr.size == 0:
+        return np.zeros((0, nhash), dtype=np.uint32)
+    if device is None:
+        device = HAVE_JAX and arr.size >= DEVICE_MIN_BATCH
+    if device and HAVE_JAX:
+        return _device_positions(arr, nbits, nhash)
+    return bloom_positions(arr, nbits, nhash, xp=np)
+
+
+class BloomHitSet:
+    """Bloom-filter hit set (HitSet.h:117 BloomHitSet role)."""
+
+    kind = "bloom"
+
+    def __init__(self, target_size: int = 1024, fpp: float = 0.05,
+                 nbits: Optional[int] = None,
+                 nhash: Optional[int] = None):
+        self.target_size = int(target_size)
+        self.fpp = float(fpp)
+        if nbits is None or nhash is None:
+            nbits, nhash = bloom_geometry(target_size, fpp)
+        self.nbits = int(nbits)
+        self.nhash = int(nhash)
+        self.bits = np.zeros((self.nbits + 7) // 8, dtype=np.uint8)
+        self.count = 0  # insertions (unique-ish; callers dedup)
+
+    # -- insert / query ----------------------------------------------------
+
+    def insert_batch(self, hashes,
+                     device: Optional[bool] = None) -> None:
+        arr = np.asarray(hashes, dtype=np.uint32).reshape(-1)
+        if arr.size == 0:
+            return
+        pos = positions_for(arr, self.nbits, self.nhash,
+                            device=device).reshape(-1)
+        # scatter-OR on the host bitset (reads must answer
+        # synchronously; the device's job was the hashing lanes)
+        np.bitwise_or.at(self.bits, pos >> 3,
+                         (1 << (pos & 7)).astype(np.uint8))
+        self.count += int(arr.size)
+
+    def insert(self, h: int) -> None:
+        self.insert_batch([h], device=False)
+
+    def contains_batch(self, hashes,
+                       device: Optional[bool] = None) -> np.ndarray:
+        arr = np.asarray(hashes, dtype=np.uint32).reshape(-1)
+        if arr.size == 0:
+            return np.zeros(0, dtype=bool)
+        pos = positions_for(arr, self.nbits, self.nhash, device=device)
+        got = (self.bits[pos >> 3] >> (pos & 7)) & 1
+        return got.all(axis=1)
+
+    def contains(self, h: int) -> bool:
+        return bool(self.contains_batch([h], device=False)[0])
+
+    # -- wire --------------------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "target_size": self.target_size,
+                "fpp": self.fpp, "nbits": self.nbits,
+                "nhash": self.nhash, "count": self.count,
+                "bits": base64.b64encode(self.bits.tobytes()).decode()}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "BloomHitSet":
+        hs = cls(d.get("target_size", 1024), d.get("fpp", 0.05),
+                 nbits=d["nbits"], nhash=d["nhash"])
+        hs.count = int(d.get("count", 0))
+        raw = np.frombuffer(base64.b64decode(d["bits"]),
+                            dtype=np.uint8)
+        hs.bits = raw.copy()
+        return hs
+
+
+class ExplicitHashHitSet:
+    """Exact 32-bit-hash hit set (HitSet.h ExplicitHashHitSet role)."""
+
+    kind = "explicit_hash"
+
+    def __init__(self, target_size: int = 1024, fpp: float = 0.0):
+        self.target_size = int(target_size)
+        self.hashes: set = set()
+
+    @property
+    def count(self) -> int:
+        return len(self.hashes)
+
+    def insert_batch(self, hashes,
+                     device: Optional[bool] = None) -> None:
+        arr = np.asarray(hashes, dtype=np.uint32).reshape(-1)
+        self.hashes.update(int(x) for x in arr)
+
+    def insert(self, h: int) -> None:
+        self.hashes.add(int(np.uint32(h)))
+
+    def contains_batch(self, hashes,
+                       device: Optional[bool] = None) -> np.ndarray:
+        arr = np.asarray(hashes, dtype=np.uint32).reshape(-1)
+        return np.array([int(x) in self.hashes for x in arr],
+                        dtype=bool)
+
+    def contains(self, h: int) -> bool:
+        return int(np.uint32(h)) in self.hashes
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "target_size": self.target_size,
+                "count": self.count,
+                "hashes": sorted(self.hashes)}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ExplicitHashHitSet":
+        hs = cls(d.get("target_size", 1024))
+        hs.hashes = {int(x) for x in d.get("hashes", ())}
+        return hs
+
+
+_KINDS = {BloomHitSet.kind: BloomHitSet,
+          ExplicitHashHitSet.kind: ExplicitHashHitSet}
+
+
+def hitset_from_dict(d: Dict[str, Any]):
+    return _KINDS[d["kind"]].from_dict(d)
+
+
+class HitSetStack:
+    """Per-PG decaying stack of hit sets (pg_pool_t hit_set_count /
+    hit_set_period role).
+
+    The OPEN period keeps exact per-hash read counts (this doubles as
+    the read-frequency histogram source); `rotate()` seals it into a
+    bloom/explicit set via ONE device-batched insert and pushes it on
+    the archive, discarding the oldest beyond `count` (the decay).
+    `hit_count()` answers "in how many recent periods was this object
+    read" — the promote signal — as open-presence + archived
+    membership."""
+
+    def __init__(self, count: int = 4, period: float = 10.0,
+                 target_size: int = 1024, fpp: float = 0.05,
+                 kind: str = "bloom"):
+        self.count = max(int(count), 1)
+        self.period = float(period)
+        self.target_size = int(target_size)
+        self.fpp = float(fpp)
+        self.kind = kind if kind in _KINDS else "bloom"
+        self.open_counts: Dict[int, int] = {}
+        self.archived: List[Any] = []
+        self.opened = time.monotonic()
+        self.seq = 0          # rotation sequence (persistence key)
+
+    # -- recording ---------------------------------------------------------
+
+    def insert(self, h: int) -> None:
+        h = int(np.uint32(h))
+        self.open_counts[h] = self.open_counts.get(h, 0) + 1
+
+    def due(self, now: Optional[float] = None) -> bool:
+        if self.period <= 0:
+            return False
+        return (now if now is not None
+                else time.monotonic()) - self.opened >= self.period
+
+    def rotate(self) -> Any:
+        """Seal the open period into an archived set (one batched
+        device insert for every unique hash of the period) and reset.
+        Returns the sealed set (caller persists it)."""
+        sealed = _KINDS[self.kind](self.target_size, self.fpp)
+        if self.open_counts:
+            sealed.insert_batch(
+                np.fromiter(self.open_counts.keys(), dtype=np.uint32,
+                            count=len(self.open_counts)))
+        self.archived.append(sealed)
+        # keep count-1 archived: open + archived = count sets total
+        # (count=1 keeps NO archive — the open set is the whole window)
+        while len(self.archived) > max(self.count - 1, 0):
+            self.archived.pop(0)
+        self.open_counts = {}
+        self.opened = time.monotonic()
+        self.seq += 1
+        return sealed
+
+    # -- queries -----------------------------------------------------------
+
+    def open_count(self, h: int) -> int:
+        return self.open_counts.get(int(np.uint32(h)), 0)
+
+    def hit_count(self, h: int) -> int:
+        """Recency: number of sets (open + archived) containing h.
+        The open set contributes its exact read count so a burst of
+        reads inside one period still registers as hot — on this flat
+        substrate the tier's job is absorbing skew, not aging data
+        across hours (the COVERAGE.md redesign note)."""
+        h = int(np.uint32(h))
+        n = self.open_counts.get(h, 0)
+        for s in self.archived:
+            if s.contains(h):
+                n += 1
+        return n
+
+    def read_frequencies(self) -> List[int]:
+        """Per-object read counts of the open period (histogram feed)."""
+        return list(self.open_counts.values())
+
+    def dump(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "count": self.count,
+            "period": self.period,
+            "seq": self.seq,
+            "open": {"objects": len(self.open_counts),
+                     "reads": sum(self.open_counts.values()),
+                     "age": round(time.monotonic() - self.opened, 3)},
+            "archived": [{"kind": s.kind, "count": s.count}
+                         for s in self.archived],
+        }

@@ -1155,14 +1155,50 @@ class RGWLite:
         return data
 
     async def get_object_ex(self, bucket: str, key: str,
-                            version_id: Optional[str] = None
+                            version_id: Optional[str] = None,
+                            byte_range: Optional[Tuple[int, int]] = None,
+                            range_resolver=None
                             ) -> Tuple[bytes, str]:
         """GET: walk the manifest, fetch stripes concurrently;
-        returns (bytes, etag) from ONE head load."""
+        returns (bytes, etag) from ONE head load.
+
+        byte_range=(first, last) — absolute inclusive offsets —
+        fetches ONLY the overlapping sub-ranges of the touched
+        stripes: a ranged S3 GET of a huge object moves O(range), not
+        O(object), and each sub-read rides the OSD's ranged EC read
+        path (and counts as a tier read).  range_resolver is the
+        single-head-load form: called with the authoritative
+        manifest.obj_size, it returns (first, last) or None (serve
+        the full object) — or raises, which propagates (the
+        frontend's 416)."""
         import asyncio
 
         manifest, etag = await self._manifest(bucket, key, version_id)
         sem = asyncio.Semaphore(self.aio_window)
+
+        if range_resolver is not None:
+            byte_range = range_resolver(manifest.obj_size)
+        if byte_range is not None:
+            first, last = byte_range
+            last = min(last, manifest.obj_size - 1)
+            reads: List[Tuple[str, int, int]] = []
+            off = 0
+            for s in manifest.stripes:
+                lo, hi = max(first, off), min(last, off + s["size"] - 1)
+                if lo <= hi:
+                    reads.append((s["oid"], lo - off, hi - lo + 1))
+                off += s["size"]
+                if off > last:
+                    break
+
+            async def fetch_range(oid: str, ofs: int, ln: int) -> bytes:
+                async with sem:
+                    return await self.data.read(oid, offset=ofs,
+                                                length=ln)
+
+            parts = await asyncio.gather(
+                *(fetch_range(*r) for r in reads))
+            return b"".join(parts), etag
 
         async def fetch(stripe: Dict) -> bytes:
             async with sem:

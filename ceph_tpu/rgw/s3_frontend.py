@@ -50,7 +50,54 @@ _ERR_STATUS = {
     "MethodNotAllowed": 405, "AccessDenied": 403,
     "RequestTimeTooSkewed": 403,
     "SignatureDoesNotMatch": 403, "InternalError": 500,
+    "InvalidRange": 416,
 }
+
+# parse_byte_range sentinel: the range was syntactically valid but
+# lies entirely past the object end (HTTP 416)
+RANGE_UNSATISFIABLE = object()
+
+
+def parse_byte_range(spec: str, size: int):
+    """`Range: bytes=a-b` for object GETs (RGWGetObj::parse_range
+    role, rgw_op.cc:99).
+
+    Returns (first, last) inclusive byte offsets clamped to the
+    object, None when the header should be IGNORED (S3 serves 200 for
+    malformed or multi-range specs), or RANGE_UNSATISFIABLE for a
+    well-formed range with no overlap (416).  Suffix form `bytes=-n`
+    means the final n bytes; `bytes=-0` and a start past EOF are
+    unsatisfiable."""
+    if not spec or not spec.strip().lower().startswith("bytes="):
+        return None
+    body = spec.strip()[len("bytes="):]
+    if "," in body:          # multi-range: S3 ignores and serves 200
+        return None
+    first_s, dash, last_s = body.strip().partition("-")
+    if not dash:
+        return None
+    first_s, last_s = first_s.strip(), last_s.strip()
+    # digits only: int() would admit signed/spaced forms ("--5",
+    # "+3") that are malformed per the grammar and must be IGNORED
+    if first_s and not first_s.isdigit():
+        return None
+    if last_s and not last_s.isdigit():
+        return None
+    if not first_s:          # suffix: last n bytes
+        if not last_s:
+            return None      # bare "bytes=-"
+        n = int(last_s)
+        if n <= 0:
+            return RANGE_UNSATISFIABLE
+        return (max(size - n, 0), size - 1) if size else \
+            RANGE_UNSATISFIABLE
+    first = int(first_s)
+    last = int(last_s) if last_s else size - 1
+    if last_s and last < first:
+        return None
+    if first >= size:
+        return RANGE_UNSATISFIABLE
+    return first, min(last, size - 1)
 
 
 def _int_or_400(text, what: str) -> int:
@@ -62,9 +109,11 @@ def _int_or_400(text, what: str) -> int:
 
 
 class _HttpError(Exception):
-    def __init__(self, code: str, what: str = ""):
+    def __init__(self, code: str, what: str = "",
+                 headers: Optional[Dict[str, str]] = None):
         super().__init__(what or code)
         self.code = code
+        self.headers = dict(headers or {})
 
 
 def _canonical_query(pairs) -> str:
@@ -177,9 +226,12 @@ class S3Frontend:
                 keep = headers.get("connection", "").lower() != "close"
                 status, rhdrs, rbody = await self._handle(
                     method.upper(), target, headers, body)
-                reason = {200: "OK", 204: "No Content", 400: "Bad Request",
+                reason = {200: "OK", 204: "No Content",
+                          206: "Partial Content", 400: "Bad Request",
                           403: "Forbidden", 404: "Not Found",
-                          409: "Conflict", 500: "Internal Server Error",
+                          409: "Conflict",
+                          416: "Range Not Satisfiable",
+                          500: "Internal Server Error",
                           501: "Not Implemented"}.get(status, "OK")
                 out = [f"HTTP/1.1 {status} {reason}\r\n".encode()]
                 rhdrs.setdefault("Content-Length", str(len(rbody)))
@@ -456,7 +508,9 @@ class S3Frontend:
             return await self._object_op(method, bucket, key, q,
                                          headers, body, access)
         except _HttpError as e:
-            return self._error(e.code, str(e))
+            status, hdrs, body = self._error(e.code, str(e))
+            hdrs.update(e.headers)
+            return status, hdrs, body
         except RGWError as e:
             return self._error(e.code, str(e))
         except Exception:
@@ -753,6 +807,19 @@ class S3Frontend:
             rules.append(rule)
         return rules
 
+    @staticmethod
+    def _range_response(etag: str, part: bytes, first: int,
+                        size: int) -> Tuple[int, Dict[str, str], bytes]:
+        """206 Partial Content with Content-Range — one construction
+        for the unversioned pushdown and the versioned slice."""
+        last = first + len(part) - 1
+        return 206, {
+            "ETag": f"\"{etag}\"",
+            "Content-Type": "application/octet-stream",
+            "Content-Length": str(len(part)),
+            "Content-Range": f"bytes {first}-{last}/{size}",
+            "Accept-Ranges": "bytes"}, part
+
     async def _object_op(self, method: str, bucket: str, key: str,
                          q: Dict, headers: Dict, body: bytes,
                          access: Optional[str] = None):
@@ -833,11 +900,59 @@ class S3Frontend:
                          "Content-Length": str(head.get("size", 0))
                          }, b""
         if method == "GET":
+            rng = headers.get("range")
+            version = q.get("versionId")
+            # syntactic screen against a sentinel size: malformed and
+            # multi-range specs fall straight through to a plain 200
+            # without paying any extra lookups
+            if rng and version is None and \
+                    parse_byte_range(rng, 1 << 62) is not None:
+                # ranged GET (206/Content-Range; 416 when the range
+                # misses the object entirely).  One head load: the
+                # gateway resolves the spec against the authoritative
+                # manifest size and fetches only the touched stripe
+                # sub-ranges — each rides the OSD's ranged EC read
+                # path and counts as a tier read.
+                resolved: Dict[str, Any] = {}
+
+                def resolve(size: int):
+                    span = parse_byte_range(rng, size)
+                    if span is RANGE_UNSATISFIABLE:
+                        raise _HttpError(
+                            "InvalidRange", f"{rng} of {size} bytes",
+                            headers={"Content-Range":
+                                     f"bytes */{size}"})
+                    resolved["size"] = size
+                    resolved["span"] = span
+                    return span
+
+                part, etag = await rgw.get_object_ex(
+                    bucket, key, range_resolver=resolve)
+                span, size = resolved["span"], resolved["size"]
+                if span is not None and part:
+                    return self._range_response(etag, part, span[0],
+                                                size)
+                # span None cannot happen post-screen; an empty part
+                # (pathological manifest) degrades to the plain GET
             data, etag = await rgw.get_object_ex(
-                bucket, key, version_id=q.get("versionId"))
+                bucket, key, version_id=version)
+            if rng and version is not None:
+                # versioned ranged GET: versions are immutable, the
+                # simple fetch+slice is exact
+                span = parse_byte_range(rng, len(data))
+                if span is RANGE_UNSATISFIABLE:
+                    status, hdrs, xml = self._error(
+                        "InvalidRange", f"{rng} of {len(data)} bytes")
+                    hdrs["Content-Range"] = f"bytes */{len(data)}"
+                    return status, hdrs, xml
+                if span is not None:
+                    first, last = span
+                    return self._range_response(
+                        etag, data[first:last + 1], first, len(data))
             return 200, {"ETag": f"\"{etag}\"",
                          "Content-Type": "application/octet-stream",
-                         "Content-Length": str(len(data))}, data
+                         "Content-Length": str(len(data)),
+                         "Accept-Ranges": "bytes"}, data
         if method == "DELETE":
             marker = await rgw.delete_object(
                 bucket, key, version_id=q.get("versionId"))

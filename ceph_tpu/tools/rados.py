@@ -134,13 +134,62 @@ async def _dispatch(client: RadosClient, args) -> int:
     return 2
 
 
+def zipf_indices(theta: float, n: int, count: int,
+                 seed: int = 0) -> np.ndarray:
+    """Deterministic Zipf(theta) sample of `count` object ranks in
+    [0, n): P(rank i) ∝ 1/(i+1)^theta, rank 0 hottest.  theta=0 is
+    uniform.  Seeded rng so bench legs (and the tier regression tests
+    built on them) are reproducible."""
+    ranks = np.arange(1, max(int(n), 1) + 1, dtype=np.float64)
+    weights = ranks ** -float(theta)
+    cdf = np.cumsum(weights)
+    cdf /= cdf[-1]
+    u = np.random.default_rng(seed).random(int(count))
+    return np.searchsorted(cdf, u).astype(np.int64)
+
+
 async def _bench(io, args) -> int:
-    """`rados bench <seconds> write|seq` (rados.cc bench role)."""
+    """`rados bench <seconds> write|seq` (rados.cc bench role).
+
+    `seq --read-skew <theta>` runs the skewed-read leg: prefill
+    --objects objects, then hammer them with a deterministic
+    Zipf(theta) index stream — the workload shape that demonstrates
+    (and regression-tests) read-tier hit rates."""
     size = args.block_size
     payload = np.random.default_rng(0).integers(
         0, 256, size, dtype=np.uint8).tobytes()
     deadline = time.monotonic() + args.seconds
     done = [0]
+
+    theta = float(getattr(args, "read_skew", 0.0) or 0.0)
+    if args.mode == "seq" and theta > 0:
+        n_objs = int(args.objects)
+        for i in range(n_objs):
+            await io.write_full(f"bench_z_{i}", payload)
+        # the measurement window opens AFTER the prefill: writing
+        # --objects payloads must not eat into the read leg
+        deadline = time.monotonic() + args.seconds
+
+        async def skewed_reader(slot: int) -> None:
+            idx = zipf_indices(theta, n_objs, 65536,
+                               seed=int(args.seed) + slot)
+            pos = 0
+            while time.monotonic() < deadline:
+                i = int(idx[pos % len(idx)])
+                pos += 1
+                await io.read(f"bench_z_{i}")
+                done[0] += 1
+
+        t0 = time.monotonic()
+        await asyncio.gather(*(skewed_reader(s)
+                               for s in range(args.concurrency)))
+        secs = max(time.monotonic() - t0, 1e-9)
+        _out({"mode": "seq", "read_skew": theta, "objects": n_objs,
+              "ops": done[0], "seconds": round(secs, 3),
+              "ops_per_sec": round(done[0] / secs, 2),
+              "mib_per_sec": round(done[0] * size / secs / (1 << 20),
+                                   2)})
+        return 0
 
     async def writer(slot: int) -> None:
         i = 0
@@ -219,6 +268,14 @@ def main(argv=None) -> int:
     bench.add_argument("-b", "--block-size", type=int,
                        default=4 << 20)
     bench.add_argument("-t", "--concurrency", type=int, default=16)
+    bench.add_argument("--read-skew", type=float, default=0.0,
+                       dest="read_skew", metavar="THETA",
+                       help="seq mode: zipfian read skew exponent"
+                            " (0 = uniform scan)")
+    bench.add_argument("--objects", type=int, default=64,
+                       help="seq --read-skew: prefilled object count")
+    bench.add_argument("--seed", type=int, default=0,
+                       help="seq --read-skew: deterministic rng seed")
     args = ap.parse_args(argv)
     try:
         return asyncio.run(_run(args))

@@ -20,7 +20,7 @@ sys.path.insert(0, REPO)
 import bench  # noqa: E402
 
 CONTRACT_KEYS = {"metric", "value", "unit", "vs_baseline",
-                 "plan_cache", "encode_service", "truncated"}
+                 "plan_cache", "encode_service", "tier", "truncated"}
 
 
 def test_contract_line_despite_hanging_backend(tmp_path):
@@ -56,6 +56,12 @@ def test_contract_line_despite_hanging_backend(tmp_path):
     assert contract["encode_service"]["requests"] >= 1
     assert contract["encode_service"]["batches"] >= 1
     assert contract["encode_service"]["batched"] >= 1
+    # the tier probe ran: device-batched bloom matched the host
+    # oracle bit-exactly and the agent promoted + served hot reads
+    assert contract["tier"]["device_bitexact"] == 1
+    assert contract["tier"]["records"] >= 1
+    assert contract["tier"]["promote"] >= 1
+    assert contract["tier"]["hit"] >= 1
     assert contract["truncated"] is False
     # details stayed out of stdout (they belong in bench_details.json)
     assert len(stdout_lines) == 1

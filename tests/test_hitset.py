@@ -1,0 +1,172 @@
+"""HitSet oracle tier (osd/hitset.py).
+
+The acceptance shape: device-batched bloom insert/contains matches the
+host rjenkins oracle bit-exactly; the bloom false-positive rate stays
+inside its configured budget; the per-PG stack rotates and decays like
+the reference's hit_set_count/hit_set_period machinery; and sets
+survive the persistence round-trip byte-exactly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osd import hitset as hm
+
+RNG = np.random.default_rng(23)
+
+
+def _oid_hashes(prefix: str, n: int) -> np.ndarray:
+    return np.array([hm.hash_oid(f"{prefix}{i}") for i in range(n)],
+                    dtype=np.uint32)
+
+
+# -- device vs host bit-exactness -------------------------------------------
+
+
+def test_device_positions_match_host_oracle():
+    """The jnp-batched bloom positions equal the numpy rjenkins path
+    bit-for-bit — uint32 wraparound is exact on both lanes."""
+    jax = pytest.importorskip("jax")  # noqa: F841
+    hashes = _oid_hashes("obj_", 500)
+    for target, fpp in ((256, 0.05), (1024, 0.01), (64, 0.2)):
+        nbits, nhash = hm.bloom_geometry(target, fpp)
+        host = hm.bloom_positions(hashes, nbits, nhash, xp=np)
+        dev = hm.positions_for(hashes, nbits, nhash, device=True)
+        assert host.dtype == dev.dtype == np.uint32
+        assert np.array_equal(host, dev)
+
+
+def test_device_and_host_inserts_build_identical_filters():
+    jax = pytest.importorskip("jax")  # noqa: F841
+    hashes = _oid_hashes("ins_", 300)
+    via_dev = hm.BloomHitSet(512, 0.05)
+    via_host = hm.BloomHitSet(512, 0.05)
+    via_dev.insert_batch(hashes, device=True)
+    via_host.insert_batch(hashes, device=False)
+    assert np.array_equal(via_dev.bits, via_host.bits)
+    # contains agrees on members and (arbitrary) non-members, through
+    # both dispatch paths
+    probe = np.concatenate([hashes[:50], _oid_hashes("other_", 200)])
+    got_dev = via_dev.contains_batch(probe, device=True)
+    got_host = via_host.contains_batch(probe, device=False)
+    assert np.array_equal(got_dev, got_host)
+    assert got_dev[:50].all()
+
+
+def test_single_and_batch_paths_agree():
+    hs = hm.BloomHitSet(256, 0.05)
+    hashes = _oid_hashes("s_", 64)
+    for h in hashes[:32]:
+        hs.insert(int(h))
+    batch = hm.BloomHitSet(256, 0.05, nbits=hs.nbits, nhash=hs.nhash)
+    batch.insert_batch(hashes[:32], device=False)
+    assert np.array_equal(hs.bits, batch.bits)
+    for h in hashes[:32]:
+        assert hs.contains(int(h))
+
+
+# -- false-positive bound ---------------------------------------------------
+
+
+def test_bloom_false_positive_rate_within_budget():
+    """At the configured target size, the measured fp rate on 20k
+    non-members stays within 2x the configured probability (the
+    standard slack for the pointwise bound)."""
+    for fpp in (0.05, 0.01):
+        hs = hm.BloomHitSet(target_size=1024, fpp=fpp)
+        members = _oid_hashes("m_", 1024)
+        hs.insert_batch(members)
+        others = _oid_hashes("x_", 20000)
+        member_set = {int(h) for h in members}
+        mask = np.array([int(h) not in member_set for h in others])
+        rate = hs.contains_batch(others)[mask].mean()
+        assert rate <= 2.0 * fpp, f"fp rate {rate} vs budget {fpp}"
+        # zero false negatives, ever
+        assert hs.contains_batch(members).all()
+
+
+def test_explicit_hash_hitset_is_exact():
+    hs = hm.ExplicitHashHitSet()
+    members = _oid_hashes("e_", 500)
+    hs.insert_batch(members)
+    assert hs.contains_batch(members).all()
+    others = _oid_hashes("not_", 500)
+    member_set = {int(h) for h in members}
+    mask = np.array([int(h) not in member_set for h in others])
+    assert not hs.contains_batch(others)[mask].any()
+
+
+# -- rotation / decay -------------------------------------------------------
+
+
+def test_stack_rotation_and_decay():
+    """count=3 keeps the open set + 2 archived; the third rotation
+    pushes the oldest period off the stack (the decay)."""
+    st = hm.HitSetStack(count=3, period=3600.0, target_size=64)
+    hot, cold = hm.hash_oid("hot"), hm.hash_oid("cold")
+    st.insert(hot)
+    st.insert(cold)
+    assert st.hit_count(hot) == 1 and st.hit_count(cold) == 1
+    st.rotate()
+    assert st.open_count(hot) == 0       # open set reset
+    assert st.hit_count(hot) == 1        # archived membership
+    st.insert(hot)
+    assert st.hit_count(hot) == 2        # open + 1 archived
+    st.rotate()                           # archive #2 (has hot)
+    st.rotate()                           # archive #3: period-1 decays
+    assert len(st.archived) == 2
+    assert st.hit_count(cold) == 0, "cold should have decayed off"
+    assert st.hit_count(hot) == 1, "only the hot period survives"
+
+
+def test_stack_open_counts_feed_read_frequencies():
+    st = hm.HitSetStack(count=4, period=3600.0)
+    for _ in range(5):
+        st.insert(hm.hash_oid("a"))
+    st.insert(hm.hash_oid("b"))
+    assert sorted(st.read_frequencies()) == [1, 5]
+    # a burst within one period registers as hot (promote signal)
+    assert st.hit_count(hm.hash_oid("a")) == 5
+
+
+def test_stack_due_is_period_driven():
+    st = hm.HitSetStack(count=2, period=0.0)
+    assert not st.due()                  # period 0 = never auto-rotate
+    st2 = hm.HitSetStack(count=2, period=1e-9)
+    st2.opened -= 1.0
+    assert st2.due()
+
+
+# -- persistence round-trip -------------------------------------------------
+
+
+def test_bloom_serialization_roundtrip():
+    hs = hm.BloomHitSet(512, 0.02)
+    hashes = _oid_hashes("ser_", 400)
+    hs.insert_batch(hashes)
+    back = hm.hitset_from_dict(hs.to_dict())
+    assert isinstance(back, hm.BloomHitSet)
+    assert (back.nbits, back.nhash, back.count) == \
+        (hs.nbits, hs.nhash, hs.count)
+    assert np.array_equal(back.bits, hs.bits)
+    assert back.contains_batch(hashes).all()
+
+
+def test_explicit_serialization_roundtrip():
+    hs = hm.ExplicitHashHitSet()
+    hashes = _oid_hashes("ser2_", 100)
+    hs.insert_batch(hashes)
+    back = hm.hitset_from_dict(hs.to_dict())
+    assert isinstance(back, hm.ExplicitHashHitSet)
+    assert back.hashes == hs.hashes
+
+
+def test_geometry_scales_with_budget():
+    """Tighter fpp or larger target -> more bits; nhash stays small."""
+    b1, k1 = hm.bloom_geometry(1024, 0.05)
+    b2, k2 = hm.bloom_geometry(1024, 0.01)
+    b3, _k3 = hm.bloom_geometry(4096, 0.05)
+    assert b2 > b1 and b3 > b1
+    assert 1 <= k1 <= 32 and 1 <= k2 <= 32

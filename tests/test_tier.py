@@ -1,0 +1,502 @@
+"""Read-tier coherency tier (osd/tier.py + the daemon agent wiring).
+
+The acceptance shape: with a skewed read workload against an EC pool,
+repeated reads of a promoted object add ZERO EC plan dispatches and
+are byte-identical to the CEPH_TPU_TIER=0 cold path — including
+immediately after an overwrite/RMW of the same object; eviction obeys
+the byte budget; promotions run under the mClock
+background_best_effort class; counters and hot-set dumps are visible
+over the tell surface and the prometheus exporter.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import plan as ec_plan
+from ceph_tpu.osd import scheduler as sched_mod
+from ceph_tpu.osd.osdmap import PgId
+from ceph_tpu.tools.rados import zipf_indices
+
+from cluster_helpers import Cluster
+
+EC42 = {"plugin": "ec_jax", "technique": "reed_sol_van",
+        "k": "4", "m": "2", "crush-failure-domain": "osd",
+        "tpu": "false"}
+
+# promotion on the 2nd read; no background rotation mid-test
+TIER_CFG = {"osd_tier_promote_min_recency": 2,
+            "osd_hit_set_period": 3600.0}
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 180))
+
+
+def _primary_of(cluster, pool_name: str, oid: str):
+    osdmap = cluster.mon.osdmap
+    pool = [p for p in osdmap.pools.values()
+            if p.name == pool_name][0]
+    from ceph_tpu.ops.rjenkins import ceph_str_hash_rjenkins
+
+    ps = ceph_str_hash_rjenkins(oid.encode())
+    pg = pool.raw_pg_to_pg(PgId(pool.id, ps))
+    _acting, primary = osdmap.pg_to_acting_osds(pg)
+    return cluster.osds[primary]
+
+
+async def _wait_promoted(prim, oid: str, timeout: float = 10.0):
+    for _ in range(int(timeout / 0.05)):
+        if any(k[1] == oid for k in prim.tier.cache):
+            return
+        await asyncio.sleep(0.05)
+    raise TimeoutError(f"{oid} never promoted (cache="
+                       f"{list(prim.tier.cache)})")
+
+
+def _dispatch_counters(cluster):
+    return (ec_plan.stats()["dispatches"],
+            sum(o.perf["decode_dispatches"]
+                for o in cluster.osds.values()))
+
+
+# -- the acceptance bound: hot-read decode bypass ---------------------------
+
+
+def test_promoted_object_serves_with_zero_plan_dispatches():
+    """Two reads promote; the next 16 reads of the hot object add
+    zero EC plan dispatches and zero daemon decode dispatches, with
+    every payload byte-identical to the written object."""
+    async def main():
+        cluster = Cluster(num_osds=6, osds_per_host=3,
+                          osd_config=dict(TIER_CFG))
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "ec", profile=EC42, pg_num=8)
+            io = cluster.client.open_ioctx("ec")
+            obj = bytes(np.random.default_rng(5).integers(
+                0, 256, 150_000, dtype=np.uint8))
+            await io.write_full("hot", obj)
+            prim = _primary_of(cluster, "ec", "hot")
+            assert prim.tier.enabled
+            assert await io.read("hot") == obj      # hit_count 1
+            assert await io.read("hot") == obj      # crosses recency 2
+            await _wait_promoted(prim, "hot")
+            plan0, dec0 = _dispatch_counters(cluster)
+            for _ in range(16):
+                assert await io.read("hot") == obj
+            # ranged reads ride the same cached bytes
+            assert await io.read("hot", offset=100_001,
+                                 length=4096) == obj[100_001:104_097]
+            assert await io.read("hot", offset=149_000,
+                                 length=9999) == obj[149_000:]
+            plan1, dec1 = _dispatch_counters(cluster)
+            assert plan1 == plan0, "hot reads dispatched EC plans"
+            assert dec1 == dec0, "hot reads hit the decode path"
+            assert prim.tier.perf.get("hit") >= 18
+            # the promotion ran under mClock background_best_effort
+            assert prim.scheduler.granted.get(
+                sched_mod.BEST_EFFORT, 0) >= 1
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_tier_reads_bit_identical_to_disabled_tier():
+    """The same zipfian read schedule with the tier enabled and with
+    CEPH_TPU_TIER=0 returns identical bytes for every read —
+    including reads issued immediately after a full overwrite and
+    after a stripe-level RMW of the promoted object (invalidation)."""
+    async def one_mode(monkey_off: bool):
+        cluster = Cluster(num_osds=6, osds_per_host=3,
+                          osd_config=dict(TIER_CFG))
+        await cluster.start()
+        try:
+            if monkey_off:
+                for osd in cluster.osds.values():
+                    osd.tier.enabled = False
+            await cluster.client.create_ec_pool(
+                "ec", profile=EC42, pg_num=8)
+            io = cluster.client.open_ioctx("ec")
+            rng = np.random.default_rng(9)
+            objs = {f"o{i}": bytes(rng.integers(
+                0, 256, 40_000 + 1000 * i, dtype=np.uint8))
+                for i in range(6)}
+            for name, data in objs.items():
+                await io.write_full(name, data)
+            outputs = []
+            for i in zipf_indices(1.2, 6, 48, seed=3):
+                outputs.append(await io.read(f"o{int(i)}"))
+            await asyncio.sleep(0.2)   # promotions land (tier mode)
+            # overwrite the hottest object, then read IMMEDIATELY
+            hot = "o0"
+            new = bytes(rng.integers(0, 256, 52_000, dtype=np.uint8))
+            await io.write_full(hot, new)
+            outputs.append(await io.read(hot))
+            # stripe-level RMW on the (re-promotable) hot object
+            for _ in range(3):
+                outputs.append(await io.read(hot))
+            await asyncio.sleep(0.2)
+            await io.write(hot, b"RMW-BYTES", 12_345)
+            outputs.append(await io.read(hot))
+            outputs.append(await io.read(hot, offset=12_340,
+                                         length=20))
+            return outputs
+        finally:
+            await cluster.stop()
+
+    async def main():
+        with_tier = await one_mode(False)
+        without = await one_mode(True)
+        assert len(with_tier) == len(without)
+        for i, (a, b) in enumerate(zip(with_tier, without)):
+            assert a == b, f"read {i} diverged with tier on"
+
+    run(main())
+
+
+def test_overwrite_invalidates_promoted_entry():
+    async def main():
+        cluster = Cluster(num_osds=6, osds_per_host=3,
+                          osd_config=dict(TIER_CFG))
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "ec", profile=EC42, pg_num=8)
+            io = cluster.client.open_ioctx("ec")
+            v1 = b"a" * 30_000
+            v2 = b"b" * 31_000
+            await io.write_full("x", v1)
+            assert await io.read("x") == v1
+            assert await io.read("x") == v1
+            prim = _primary_of(cluster, "ec", "x")
+            await _wait_promoted(prim, "x")
+            inval0 = prim.tier.perf.get("invalidate")
+            await io.write_full("x", v2)
+            assert prim.tier.perf.get("invalidate") > inval0
+            assert not any(k[1] == "x" for k in prim.tier.cache)
+            assert await io.read("x") == v2
+            # remove after re-promotion: reads must go ENOENT, never
+            # resurrect cached bytes
+            assert await io.read("x") == v2
+            await _wait_promoted(prim, "x")
+            await io.remove("x")
+            from ceph_tpu.rados.client import RadosError
+
+            with pytest.raises(RadosError):
+                await io.read("x")
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_eviction_under_byte_pressure():
+    """A 100 KiB budget holds ~2 of the 40 KiB objects: promoting a
+    hot set of 5 must evict LRU entries and never exceed the budget."""
+    async def main():
+        cluster = Cluster(
+            num_osds=6, osds_per_host=3,
+            osd_config={**TIER_CFG,
+                        "osd_tier_cache_bytes": 100 << 10})
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "ec", profile=EC42, pg_num=8)
+            io = cluster.client.open_ioctx("ec")
+            data = {f"e{i}": bytes([i]) * 40_000 for i in range(5)}
+            for name, payload in data.items():
+                await io.write_full(name, payload)
+            for _ in range(3):
+                for name in data:
+                    assert await io.read(name) == data[name]
+            await asyncio.sleep(0.3)
+            evicted = promoted = 0
+            for osd in cluster.osds.values():
+                assert osd.tier.cache_bytes <= 100 << 10
+                evicted += osd.tier.perf.get("evict")
+                promoted += osd.tier.perf.get("promote")
+            assert promoted >= 3
+            assert evicted >= 1
+            # evicted objects still read correctly (cold path)
+            for name in data:
+                assert await io.read(name) == data[name]
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_recovery_keeps_tier_reads_correct():
+    """Kill a shard holder after promotion: reads of the hot object
+    stay byte-identical through degradation and recovery."""
+    async def main():
+        cluster = Cluster(num_osds=6, osds_per_host=3,
+                          osd_config=dict(TIER_CFG))
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "ec", profile=EC42, pg_num=8)
+            io = cluster.client.open_ioctx("ec")
+            obj = bytes(np.random.default_rng(11).integers(
+                0, 256, 80_000, dtype=np.uint8))
+            await io.write_full("r", obj)
+            assert await io.read("r") == obj
+            assert await io.read("r") == obj
+            prim = _primary_of(cluster, "ec", "r")
+            await _wait_promoted(prim, "r")
+            victim = next(o for o in cluster.osds
+                          if cluster.osds[o] is not prim)
+            await cluster.kill_osd(victim)
+            await cluster.wait_for_osd_down(victim)
+            assert await io.read("r") == obj
+            await cluster.client.mon_command(
+                {"prefix": "osd out", "osd": victim})
+            await cluster.wait_for_clean(timeout=60)
+            assert await io.read("r") == obj
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+# -- observability ----------------------------------------------------------
+
+
+def test_tell_surface_tier_status_and_hitset_dump():
+    async def main():
+        cluster = Cluster(num_osds=6, osds_per_host=3,
+                          osd_config={**TIER_CFG,
+                                      "osd_hit_set_period": 0.2})
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "ec", profile=EC42, pg_num=8)
+            io = cluster.client.open_ioctx("ec")
+            await io.write_full("t", b"z" * 20_000)
+            for _ in range(3):
+                await io.read("t")
+            prim = _primary_of(cluster, "ec", "t")
+            await _wait_promoted(prim, "t")
+            rc, status = await cluster.client.osd_command(
+                prim.osd_id, {"prefix": "tier_status"})
+            assert rc == 0 and status["enabled"]
+            assert status["cached_objects"] >= 1
+            assert status["counters"]["promote"] >= 1
+            assert status["counters"]["hit"] >= 1
+            rc, perf = await cluster.client.osd_command(
+                prim.osd_id, {"prefix": "perf dump"})
+            assert rc == 0
+            assert perf["tier"]["hit"] >= 1
+            assert "read_freq" in perf["tier"]
+            assert "plan_cache" in perf and "hits" in perf["plan_cache"]
+            assert "encode_service" in perf
+            # rotation happened (0.2s period) -> hot sets persisted
+            # into the pg-meta omap prefix; keep reading until one
+            # lands, then assert the dump shows both stack + archive
+            for _ in range(100):
+                await io.read("t")
+                rc, hs = await cluster.client.osd_command(
+                    prim.osd_id, {"prefix": "hitset_dump"})
+                assert rc == 0
+                if hs["persisted"]:
+                    break
+                await asyncio.sleep(0.05)
+            assert hs["stacks"], "no hot-set stacks on the primary"
+            assert hs["persisted"], "no persisted hitset omap keys"
+            keys = next(iter(hs["persisted"].values()))
+            assert all(k.startswith("hitset_") for k in keys)
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_prometheus_exports_tier_and_plan_counters():
+    """The exporter flattens the nested perf sections: tier counters,
+    the read-frequency histogram, plan-cache and encode-service
+    counters all appear as scrapeable rows."""
+    async def main():
+        from ceph_tpu.mgr import MgrDaemon
+
+        cluster = Cluster(num_osds=6, osds_per_host=3,
+                          osd_config={**TIER_CFG,
+                                      "osd_hit_set_period": 0.2})
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "ec", profile=EC42, pg_num=8)
+            io = cluster.client.open_ioctx("ec")
+            await io.write_full("p", b"q" * 10_000)
+            for _ in range(30):
+                await io.read("p")
+                await asyncio.sleep(0.01)
+            mgr = MgrDaemon(cluster.mon.addr, config={})
+            await mgr.start()
+            try:
+                prom = mgr.modules["prometheus"]
+                host, port = prom.addr.split(":")
+                reader, writer = await asyncio.open_connection(
+                    host, int(port))
+                writer.write(b"GET /metrics HTTP/1.0\r\n\r\n")
+                await writer.drain()
+                raw = await asyncio.wait_for(reader.read(), 10.0)
+                writer.close()
+                body = raw.decode().split("\r\n\r\n", 1)[1]
+                assert "ceph_osd_tier_hit" in body
+                assert "ceph_osd_tier_miss" in body
+                assert "ceph_osd_tier_records" in body
+                # read-frequency histogram rows
+                assert "ceph_osd_tier_read_freq_bucket" in body
+                assert 'le="+Inf"' in body
+                # PR-2/PR-3 counters now scrapeable
+                assert "ceph_osd_plan_cache_hits" in body
+                assert "ceph_osd_plan_cache_dispatches" in body
+                assert "ceph_osd_encode_service_requests" in body
+                # exposition stays parseable line by line
+                for line in body.strip().splitlines():
+                    if line.startswith("#"):
+                        continue
+                    name_part, value = line.rsplit(" ", 1)
+                    float(value)
+                    assert name_part[0].isalpha()
+            finally:
+                await mgr.stop()
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_kill_switch_disables_subsystem():
+    """CEPH_TPU_TIER=0 (env) and osd_tier_enable=false (config) both
+    leave the read path untouched: no recording, no promotions."""
+    async def main():
+        cluster = Cluster(
+            num_osds=6, osds_per_host=3,
+            osd_config={**TIER_CFG, "osd_tier_enable": False})
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "ec", profile=EC42, pg_num=8)
+            io = cluster.client.open_ioctx("ec")
+            obj = b"k" * 30_000
+            await io.write_full("k", obj)
+            for _ in range(5):
+                assert await io.read("k") == obj
+            await asyncio.sleep(0.2)
+            for osd in cluster.osds.values():
+                assert not osd.tier.enabled
+                assert not osd.tier.cache
+                assert osd.tier.perf.get("records") == 0
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_TIER", "0")
+    from ceph_tpu.osd.tier import TierAgent
+
+    agent = TierAgent("osd.t", {"osd_tier_enable": True})
+    assert not agent.enabled
+    assert agent.note_read("pg", "o") == 0
+    agent.install("pg", "o", b"data")
+    assert agent.lookup("pg", "o") is None
+
+
+def test_cli_zipf_bench_leg_drives_tier_hits(capsys):
+    """`rados bench seq --read-skew` against an EC pool: the skewed
+    leg runs, reports deterministically-shaped output, and its hot
+    ranks land in the tier (hit counters move)."""
+    import argparse
+    import json
+
+    from ceph_tpu.tools import rados as rados_cli
+
+    async def main():
+        cluster = Cluster(num_osds=6, osds_per_host=3,
+                          osd_config=dict(TIER_CFG))
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "ec", profile=EC42, pg_num=8)
+            io = cluster.client.open_ioctx("ec")
+            args = argparse.Namespace(
+                block_size=8192, concurrency=4, seconds=2,
+                mode="seq", read_skew=1.2, objects=16, seed=0)
+            assert await rados_cli._bench(io, args) == 0
+            return sum(osd.tier.perf.get("hit")
+                       for osd in cluster.osds.values())
+        finally:
+            await cluster.stop()
+
+    hits = None
+    try:
+        hits = asyncio.run(asyncio.wait_for(main(), 120))
+    finally:
+        out = capsys.readouterr().out
+    report = json.loads(out)
+    assert report["mode"] == "seq" and report["read_skew"] == 1.2
+    assert report["objects"] == 16 and report["ops"] > 0
+    assert hits is not None and hits > 0, "skewed leg never hit the tier"
+
+
+def test_oversize_object_never_wipes_the_cache():
+    """An object bigger than the whole byte budget is refused without
+    evicting the existing hot set, and is not re-promoted on every
+    read — until a rewrite (which may shrink it) clears the marker."""
+    from ceph_tpu.osd.tier import TierAgent
+
+    t = TierAgent("osd.t", {"osd_tier_cache_bytes": 1000,
+                            "osd_tier_promote_min_recency": 1})
+    for i in range(4):
+        t.install("pg", f"o{i}", bytes(200))
+    assert len(t.cache) == 4
+    t.install("pg", "giant", bytes(5000))
+    assert len(t.cache) == 4 and t.cache_bytes <= 1000
+    assert t.lookup("pg", "giant") is None
+    assert not t.wants_promote("pg", "giant", 99)
+    t.invalidate("pg", "giant")
+    assert t.wants_promote("pg", "giant", 99)
+
+
+def test_scrub_subreads_do_not_pollute_hitsets():
+    """Scrub fans MOSDSubRead to every shard of every object; none of
+    them may feed the hot-set tracking (only client-read gathers carry
+    record=True), or the skew signal drowns every scrub cycle."""
+    async def main():
+        cluster = Cluster(num_osds=6, osds_per_host=3,
+                          osd_config=dict(TIER_CFG))
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "ec", profile=EC42, pg_num=8)
+            io = cluster.client.open_ioctx("ec")
+            for i in range(5):
+                await io.write_full(f"s{i}", bytes([i]) * 9000)
+            for osd in cluster.osds.values():
+                for pg, state in list(osd.pgs.items()):
+                    pool = osd.osdmap.pools.get(pg.pool)
+                    if pool is None or state.primary != osd.osd_id \
+                            or state.state != "active":
+                        continue
+                    await osd.scrub_pg(state, pool)
+            assert sum(o.tier.perf.get("records")
+                       for o in cluster.osds.values()) == 0, \
+                "scrub sub-reads leaked into the hot-set tracking"
+            # a real client read still records (on the primary AND on
+            # the replicas its gather touches)
+            await io.read("s0")
+            assert sum(o.tier.perf.get("records")
+                       for o in cluster.osds.values()) >= 1
+        finally:
+            await cluster.stop()
+
+    run(main())

@@ -373,3 +373,24 @@ def test_ec_tool_incompatible_stripe_unit(tmp_path, capsys):
     assert rc == 1
     err = capsys.readouterr().err
     assert "incompatible" in err or "usage" in err
+
+
+# -- rados bench zipf sampler (the skewed-read tier leg) --------------------
+
+
+def test_zipf_indices_deterministic_and_skewed():
+    from ceph_tpu.tools.rados import zipf_indices
+
+    a = zipf_indices(1.2, 64, 10_000, seed=5)
+    b = zipf_indices(1.2, 64, 10_000, seed=5)
+    assert np.array_equal(a, b), "same seed must reproduce the stream"
+    assert not np.array_equal(a, zipf_indices(1.2, 64, 10_000, seed=6))
+    assert a.min() >= 0 and a.max() < 64
+    # rank 0 dominates under theta=1.2 and the mass is monotone-ish
+    counts = np.bincount(a, minlength=64)
+    assert counts[0] == counts.max()
+    assert counts[0] > 10_000 / 64 * 4, "head not hot enough"
+    # theta=0 degenerates to uniform (no rank dominates 3x the mean)
+    flat = np.bincount(zipf_indices(0.0, 64, 10_000, seed=5),
+                       minlength=64)
+    assert flat.max() < 3 * 10_000 / 64
