@@ -57,13 +57,16 @@ def _emit_contract(value: Optional[float],
                    plan_cache: Optional[dict] = None,
                    encode_service: Optional[dict] = None,
                    tier: Optional[dict] = None,
+                   device_health: Optional[dict] = None,
                    truncated: bool = False) -> None:
     """Print the one-line JSON driver contract, exactly once, before
     any optional extended benches run — a wedged tunnel or a crashed
     secondary bench can no longer yield an empty bench.  plan_cache
     carries the ExecPlan hit/miss/retrace counters, encode_service the
     micro-batching service probe counters, tier the hot-set/read-tier
-    probe counters; truncated flags a budget-shortened run."""
+    probe counters, device_health the circuit-breaker fault-tolerance
+    probe (forced-failure host fallback bit-exact, trip -> probe ->
+    recovered); truncated flags a budget-shortened run."""
     global _contract_emitted
     if _contract_emitted:
         return
@@ -76,8 +79,128 @@ def _emit_contract(value: Optional[float],
         "plan_cache": plan_cache,
         "encode_service": encode_service,
         "tier": tier,
+        "device_health": device_health,
         "truncated": bool(truncated),
     }), flush=True)
+
+
+def _device_health_probe() -> Optional[dict]:
+    """Pre-contract probe of the device-tier fault layer: with the
+    injection seam forcing every dispatch to fail, an EC matmul must
+    degrade to the bit-exact numpy host path (no exception reaches
+    the caller) and trip the ec-encode breaker; with injection
+    cleared, a forced half-open probe must re-close it.  Counters
+    land in the contract line's device_health key; None (with a
+    stderr note) when the probe cannot run.
+
+    Contract-first discipline: every dispatch inside already rides
+    device_call's own watchdog, so a wedged tunnel is bounded without
+    an extra runner thread here."""
+    if _remaining() < 0:
+        print("# device health probe skipped: budget exhausted",
+              file=sys.stderr)
+        return None
+    prev = os.environ.get("CEPH_TPU_INJECT_DEVICE_FAIL")
+    try:
+        from ceph_tpu.common import circuit
+        from ceph_tpu.ec import dispatch as ec_dispatch
+        from ceph_tpu.models import reed_solomon as rs
+        from ceph_tpu.ops import gf
+
+        circuit.reset_all()
+        mat = rs.reed_sol_van_matrix(4, 2)
+        rng = np.random.default_rng(23)
+        data = rng.integers(0, 256, (8, 4, 256), dtype=np.uint8)
+        oracle = ec_dispatch.gf_matmul(mat, data, use_tpu=False)
+        os.environ["CEPH_TPU_INJECT_DEVICE_FAIL"] = "1.0"
+        bitexact = 1
+        for _ in range(4):   # past the trip threshold
+            out = ec_dispatch.gf_matmul(mat, data, use_tpu=True,
+                                        family="ec-encode")
+            if not np.array_equal(out, oracle):
+                bitexact = 0
+        tripped = circuit.breaker("ec-encode").stats()
+        # heal: clear injection, expire the backoff, one probe dispatch
+        if prev is None:
+            os.environ.pop("CEPH_TPU_INJECT_DEVICE_FAIL", None)
+        else:
+            os.environ["CEPH_TPU_INJECT_DEVICE_FAIL"] = prev
+        circuit.breaker("ec-encode").force_probe()
+        out = ec_dispatch.gf_matmul(mat, data, use_tpu=True,
+                                    family="ec-encode")
+        if not np.array_equal(out, oracle):
+            bitexact = 0
+        healed = circuit.breaker("ec-encode").stats()
+        recovered = int(healed["state"] == "closed"
+                        and healed["recoveries"] >= 1
+                        and gf.backend_available())
+        return {
+            "bitexact": bitexact,
+            "trips": tripped["trips"],
+            "failures": tripped["failures"],
+            "fallbacks": tripped["fallbacks"],
+            "probes": healed["probes"],
+            "recovered": recovered,
+        }
+    except Exception as e:
+        print(f"# device health probe failed: {e!r}", file=sys.stderr)
+        return None
+    finally:
+        if prev is None:
+            os.environ.pop("CEPH_TPU_INJECT_DEVICE_FAIL", None)
+        else:
+            os.environ["CEPH_TPU_INJECT_DEVICE_FAIL"] = prev
+        try:
+            from ceph_tpu.common import circuit
+
+            circuit.reset_all()
+        except Exception:
+            pass
+
+
+def bench_degraded() -> dict:
+    """Degraded-mode throughput delta: the same batched EC encode with
+    the breakers forced open (every dispatch refused -> bit-exact
+    numpy host path) vs the healthy device path — what a wedged
+    accelerator actually costs while the breaker holds it out of the
+    hot path."""
+    from ceph_tpu.common import circuit
+    from ceph_tpu.ec import dispatch as ec_dispatch
+    from ceph_tpu.models import reed_solomon as rs
+
+    k, m = 8, 3
+    chunk = 4096 if _SMOKE else 256 * 1024
+    batch = 2 if _SMOKE else 16
+    mat = rs.reed_sol_van_matrix(k, m)
+    rng = np.random.default_rng(31)
+    data = rng.integers(0, 256, (batch, k, chunk), dtype=np.uint8)
+    nbytes = batch * k * chunk
+
+    def best_gibs(iters: int = 3) -> float:
+        best = float("inf")
+        ec_dispatch.gf_matmul(mat, data, use_tpu=True)  # warm/compile
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            ec_dispatch.gf_matmul(mat, data, use_tpu=True)
+            best = min(best, time.perf_counter() - t0)
+        return nbytes / best / (1 << 30)
+
+    circuit.reset_all()
+    device_gibs = best_gibs()
+    circuit.force_open_all(duration=3600.0)
+    try:
+        host_gibs = best_gibs()
+        fallbacks = circuit.breaker("ec-encode").stats()["fallbacks"]
+    finally:
+        circuit.reset_all()
+    return {
+        "degraded_device_gibs": device_gibs,
+        "degraded_host_gibs": host_gibs,
+        "degraded_delta_pct": round(
+            (host_gibs - device_gibs) / device_gibs * 100.0, 2)
+        if device_gibs else None,
+        "degraded_fallbacks": fallbacks,
+    }
 
 
 def _tier_probe() -> Optional[dict]:
@@ -862,12 +985,16 @@ def main() -> None:
     # hot-set/read-tier probe (cheap, before the contract):
     # device-batched bloom bit-exact + agent promote/hit/evict alive
     tier_counters = _tier_probe()
+    # device-fault probe (cheap, before the contract): forced device
+    # failure degrades bit-exactly to host, breaker trips and recovers
+    device_health_counters = _device_health_probe()
 
     # the driver contract line, before every optional/extended bench:
     # a wedge below this point can cost detail rows, never the bench
     _emit_contract(enc_gibs, vs_baseline, plan_cache=plan_counters,
                    encode_service=service_counters,
                    tier=tier_counters,
+                   device_health=device_health_counters,
                    truncated=skip_optional)
 
     # decode sweep over 1..m erasures (the reference benchmark sweeps
@@ -940,6 +1067,18 @@ def main() -> None:
         except Exception as e:
             print(f"# tier bench failed: {e!r}", file=sys.stderr)
 
+    # degraded-mode section: breakers forced open -> host-path
+    # throughput delta (what a wedged accelerator costs while the
+    # breaker holds it out of the hot path)
+    degraded_section: dict = {}
+    if skip_optional:
+        skipped_sections.append("degraded")
+    else:
+        try:
+            degraded_section = bench_degraded()
+        except Exception as e:
+            print(f"# degraded bench failed: {e!r}", file=sys.stderr)
+
     details = {
         "encode_gibs": enc_gibs,
         "encode_path": "pallas_words" if use_pallas else "xla_bitplanes",
@@ -956,8 +1095,10 @@ def main() -> None:
         **put_gate,
         **write_path,
         **tier_section,
+        **degraded_section,
         "encode_service": service_counters,
         "tier": tier_counters,
+        "device_health": device_health_counters,
         "host_cores": os.cpu_count(),
         "encode_ms_per_batch": t_enc * 1e3,
         "k": k, "m": m, "chunk_bytes": chunk, "batch": batch,

@@ -24,6 +24,14 @@ EC dispatch discipline:
                        points that bypass the ExecPlan cache
                        (ceph_tpu/ec/plan.py): every shape retraces and
                        the compile is invisible to plan.stats()
+  unguarded-device-dispatch
+                       raw device dispatch (backend.matmul /
+                       gf.gf_matmul_tpu / the pallas word kernels) in
+                       ec/, ops/, osd/ outside the breaker guard
+                       (common/circuit.py device_call): a wedged or
+                       faulting accelerator surfaces as a raised
+                       exception instead of degrading to the
+                       bit-exact host path
 
 Every rule walks its own scope only (nested defs are analyzed as their
 own traced/async functions), so findings never double-report.
@@ -481,6 +489,68 @@ def rule_jit_bypass_plan(a: Analyzer) -> None:
 
 
 # ---------------------------------------------------------------------
+# unguarded-device-dispatch
+# ---------------------------------------------------------------------
+
+# modules whose device dispatches must route through the breaker guard
+# (ceph_tpu/common/circuit.py device_call): ec/, ops/ and osd/ host
+# the production data path — a raw dispatch there turns a device fault
+# into a client-visible error instead of a host-path degrade
+_DEVICE_DISPATCH_PATHS = ("ceph_tpu/ec/", "ceph_tpu/ops/",
+                          "ceph_tpu/osd/")
+# callee identities that ARE device dispatches: the mesh pipeline
+# entry, the single-device XLA kernel, and the pallas word kernels
+_DEVICE_ENTRY_TAILS = {"gf_matmul_tpu", "gf_matmul_words",
+                       "gf_matmul_words_runtime"}
+_DEVICE_ENTRY_SUFFIXES = (".backend.matmul",)
+
+
+def _inside_device_call(mod, node: ast.AST) -> bool:
+    """True when the call is lexically inside an argument of a
+    `device_call(...)` invocation (the guard receives it as the
+    supervised body) — that IS the guarded form."""
+    cur = node
+    while cur is not None:
+        cur = mod.parents.get(cur)
+        if isinstance(cur, ast.Call) and \
+                (dotted(cur.func) or "").split(".")[-1] == \
+                "device_call":
+            return True
+    return False
+
+
+def rule_unguarded_device_dispatch(a: Analyzer) -> None:
+    """Raw device dispatch outside circuit.device_call in the data-
+    path modules: no watchdog, no breaker accounting, no injection
+    seam, and a device exception propagates to the caller.  Route the
+    call through the guard (or baseline with a justification — the
+    guard's own internals legitimately dispatch raw)."""
+    paths = a.config.get("device_paths", _DEVICE_DISPATCH_PATHS)
+    for mod in a.project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if not any(p in rel for p in paths):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _resolved_callee(mod, node)
+            if not callee:
+                continue
+            hit = (callee.split(".")[-1] in _DEVICE_ENTRY_TAILS
+                   or callee.endswith(_DEVICE_ENTRY_SUFFIXES))
+            if hit and not _inside_device_call(mod, node):
+                a.emit("unguarded-device-dispatch", mod, node,
+                       f"raw device dispatch `{callee}` outside the "
+                       "breaker guard: a wedged/faulting accelerator "
+                       "raises here instead of degrading to the host "
+                       "path — route through "
+                       "ceph_tpu.common.circuit.device_call",
+                       severity="warning",
+                       symbol=_enclosing_qualname(mod, node),
+                       scope_line=_scope_line(mod, node))
+
+
+# ---------------------------------------------------------------------
 # sync-encode-in-async
 # ---------------------------------------------------------------------
 
@@ -613,6 +683,7 @@ def default_rules() -> Dict[str, object]:
         "trace-static-hazard": rule_trace_static_hazard,
         "trace-numpy": rule_trace_numpy,
         "jit-bypass-plan": rule_jit_bypass_plan,
+        "unguarded-device-dispatch": rule_unguarded_device_dispatch,
         "async-blocking": rule_async_blocking,
         "sync-encode-in-async": rule_sync_encode_in_async,
         "lock-order": rule_lock_order,

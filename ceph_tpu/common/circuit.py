@@ -1,0 +1,551 @@
+"""Device-health circuit breakers + the guarded dispatch choke point.
+
+PRs 2-4 moved the data path's math (EC matmuls, fused CRC, hitset
+hashing, CRUSH batch placement) onto the accelerator assuming every
+XLA dispatch succeeds.  Production does not: runtimes wedge, transfers
+hang, RESOURCE_EXHAUSTED fires under memory pressure.  Coded-
+computation systems treat worker faults and stragglers as the normal
+case and degrade by construction (arXiv:1804.10331, arXiv:2409.01420)
+— this module gives the device tier the same discipline:
+
+* **CircuitBreaker** — one per dispatch *family* (ec-encode,
+  ec-decode, fused-crc, hitset-hash, crush-batch), the classic
+  closed/open/half-open machine: tripped by consecutive failures OR a
+  watchdog timeout, re-closed by a single half-open probe dispatch
+  gated on exponential backoff with full jitter (fixed backoffs
+  synchronize into thundering herds when a breaker trips
+  cluster-wide).
+* **device_call()** — THE choke point every device dispatch routes
+  through.  It runs the call on a watchdog thread with a hard timeout
+  (a wedged TPU cannot hang the event loop), classifies
+  RESOURCE_EXHAUSTED separately (callers halve their batch and
+  retry), records the outcome on the family's breaker, and NEVER
+  raises — callers read the status and fall back to the bit-exact
+  host path.
+* **Fault injection** — `CEPH_TPU_INJECT_DEVICE_FAIL` is read at the
+  same choke point so tests and the thrasher can script device
+  failure deterministically:
+
+      1.0 / 0.25 / p=0.25   fail each dispatch with probability p
+      next=N                fail the next N dispatches, then heal
+      hang=MS               sleep MS milliseconds inside the dispatch
+                            (drives the watchdog timeout)
+      oom=K                 raise RESOURCE_EXHAUSTED when the dispatch
+                            batch exceeds K (drives batch halving)
+
+  Modes combine comma-separated (``p=0.3,hang=5``).  The env var is
+  re-read on every dispatch, so flipping it mid-workload takes effect
+  immediately.
+
+Kill switch: CEPH_TPU_BREAKER=0 restores the raw pre-guard behavior
+(dispatch runs inline, exceptions propagate, no injection).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+__all__ = [
+    "CLOSED", "OPEN", "HALF_OPEN", "FAMILIES",
+    "CircuitBreaker", "DeviceFault", "InjectedResourceExhausted",
+    "breaker", "degraded", "device_call", "enabled", "fault_events",
+    "force_open_all", "injection", "is_resource_exhausted",
+    "parse_injection", "perf_dump", "reset_all", "stats_all",
+]
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+_STATE_CODE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+# the dispatch families the device tier runs; breakers are created on
+# demand so new families cost one registry entry, not a code change
+FAMILIES = ("ec-encode", "ec-decode", "fused-crc", "hitset-hash",
+            "crush-batch")
+
+
+def enabled() -> bool:
+    return os.environ.get("CEPH_TPU_BREAKER", "1") != "0"
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class DeviceFault(RuntimeError):
+    """Injected (or classified) device dispatch failure."""
+
+
+class InjectedResourceExhausted(RuntimeError):
+    """Injected OOM; the message carries RESOURCE_EXHAUSTED so the
+    generic classifier treats it exactly like the real XLA error."""
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """True for XLA/PJRT allocation failures (and their injected
+    twin): the class of error batch halving can actually fix."""
+    text = f"{type(exc).__name__}: {exc}"
+    return ("RESOURCE_EXHAUSTED" in text
+            or "Resource exhausted" in text
+            or "out of memory" in text.lower())
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Closed/open/half-open breaker for one dispatch family.
+
+    closed    dispatches flow; `fail_threshold` CONSECUTIVE failures
+              (or one watchdog timeout — a wedged runtime must not
+              need three straight hangs) trip it open.
+    open      dispatches are refused (callers take the host path)
+              until the backoff expires; the backoff doubles per trip
+              with full jitter, capped at `max_backoff`.
+    half_open exactly ONE probe dispatch is admitted; success
+              re-closes the breaker (and resets the backoff), failure
+              re-opens it with the next backoff step.  Concurrent
+              callers while the probe is in flight are refused.
+    """
+
+    __slots__ = ("family", "fail_threshold", "base_backoff",
+                 "max_backoff", "_clock", "_rng", "_lock", "_state",
+                 "_retry_at", "_opens", "_probing", "counters")
+
+    def __init__(self, family: str, fail_threshold: int = None,
+                 base_backoff: float = None, max_backoff: float = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 rng: Callable[[], float] = random.random):
+        self.family = family
+        self.fail_threshold = int(
+            fail_threshold if fail_threshold is not None
+            else _env_float("CEPH_TPU_BREAKER_THRESHOLD", 3))
+        self.base_backoff = float(
+            base_backoff if base_backoff is not None
+            else _env_float("CEPH_TPU_BREAKER_BACKOFF_S", 0.5))
+        self.max_backoff = float(
+            max_backoff if max_backoff is not None
+            else _env_float("CEPH_TPU_BREAKER_BACKOFF_MAX_S", 30.0))
+        self._clock = clock
+        self._rng = rng
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._retry_at = 0.0
+        self._opens = 0          # consecutive opens: the backoff exponent
+        self._probing = False
+        self.counters: Dict[str, int] = {
+            "successes": 0, "failures": 0, "consecutive": 0,
+            "trips": 0, "probes": 0, "recoveries": 0, "fallbacks": 0,
+            "watchdog_timeouts": 0,
+        }
+
+    # -- state machine -----------------------------------------------------
+
+    def allow(self) -> bool:
+        """Admission check — MUTATING: an open breaker whose backoff
+        expired transitions to half-open and hands THIS caller the
+        probe slot.  Use `degraded()` for a read-only peek."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            now = self._clock()
+            if self._state == OPEN and now >= self._retry_at:
+                self._state = HALF_OPEN
+                self._probing = True
+                self.counters["probes"] += 1
+                return True
+            if self._state == HALF_OPEN and not self._probing:
+                self._probing = True
+                self.counters["probes"] += 1
+                return True
+            return False
+
+    def degraded(self) -> bool:
+        """Read-only: True while dispatches would be refused (open
+        with an unexpired backoff, or a probe already in flight)."""
+        with self._lock:
+            if self._state == CLOSED:
+                return False
+            if self._state == HALF_OPEN:
+                return self._probing
+            return self._clock() < self._retry_at
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.counters["successes"] += 1
+            self.counters["consecutive"] = 0
+            if self._state != CLOSED:
+                self.counters["recoveries"] += 1
+            self._state = CLOSED
+            self._probing = False
+            self._opens = 0
+
+    def record_failure(self, timeout: bool = False) -> None:
+        with self._lock:
+            self.counters["failures"] += 1
+            self.counters["consecutive"] += 1
+            if timeout:
+                self.counters["watchdog_timeouts"] += 1
+            if self._state == HALF_OPEN:
+                self._trip_locked()       # failed probe: back off more
+            elif self._state == CLOSED and (
+                    timeout
+                    or self.counters["consecutive"]
+                    >= self.fail_threshold):
+                self._trip_locked()
+
+    def note_fallback(self) -> None:
+        with self._lock:
+            self.counters["fallbacks"] += 1
+
+    def _trip_locked(self) -> None:
+        self.counters["trips"] += 1
+        self._state = OPEN
+        self._probing = False
+        self._opens += 1
+        # full jitter (AWS style): U(0, min(cap, base * 2^(opens-1))).
+        # Uniform-from-zero is deliberate — a fleet of breakers tripped
+        # by one cluster-wide event must not probe in lockstep.
+        ceiling = min(self.max_backoff,
+                      self.base_backoff * (2 ** (self._opens - 1)))
+        self._retry_at = self._clock() + self._rng() * ceiling
+
+    # -- admin -------------------------------------------------------------
+
+    def force_open(self, duration: Optional[float] = None) -> None:
+        """Admin/bench lever: hold the breaker open (host path) for
+        `duration` seconds (default max_backoff)."""
+        with self._lock:
+            self._state = OPEN
+            self._probing = False
+            self._opens += 1
+            self.counters["trips"] += 1
+            self._retry_at = self._clock() + (
+                duration if duration is not None else self.max_backoff)
+
+    def force_probe(self) -> None:
+        """Expire the backoff: the next allow() is the probe."""
+        with self._lock:
+            if self._state == OPEN:
+                self._retry_at = self._clock()
+            self._probing = False
+
+    def release_probe(self) -> None:
+        """Give the half-open probe slot back WITHOUT a verdict: the
+        probe dispatch ended in an outcome that says nothing about
+        device health (OOM to be batch-halved, a benign
+        NotImplementedError).  Without this the slot would leak and
+        the breaker wedge in half_open forever."""
+        with self._lock:
+            self._probing = False
+
+    def reset(self, counters: bool = True) -> None:
+        with self._lock:
+            self._state = CLOSED
+            self._probing = False
+            self._opens = 0
+            self._retry_at = 0.0
+            if counters:
+                for k in self.counters:
+                    self.counters[k] = 0
+
+    # -- observability -----------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            now = self._clock()
+            return {
+                "state": self._state,
+                "state_code": _STATE_CODE[self._state],
+                "retry_in_s": round(max(self._retry_at - now, 0.0), 3)
+                if self._state == OPEN else 0.0,
+                **self.counters,
+            }
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+_reg_lock = threading.Lock()
+_breakers: Dict[str, CircuitBreaker] = {}
+
+
+def breaker(family: str) -> CircuitBreaker:
+    with _reg_lock:
+        br = _breakers.get(family)
+        if br is None:
+            br = _breakers[family] = CircuitBreaker(family)
+        return br
+
+
+def degraded(family: str) -> bool:
+    """Read-only pre-filter for dispatch routers: True while the
+    family's device path would be refused (skip straight to host
+    without consuming the half-open probe slot)."""
+    if not enabled():
+        return False
+    return breaker(family).degraded()
+
+
+def stats_all() -> Dict[str, Dict[str, Any]]:
+    with _reg_lock:
+        brs = dict(_breakers)
+    out = {f: brs[f].stats() for f in sorted(brs)}
+    for f in FAMILIES:              # always-present rows for dashboards
+        out.setdefault(f, CircuitBreaker(f).stats())
+    return out
+
+
+def perf_dump() -> Dict[str, Dict[str, Any]]:
+    """Numeric-only nested snapshot for `perf dump` (the prometheus
+    flattener skips string leaves, so the state rides as state_code)."""
+    return {f: {k: v for k, v in st.items() if not isinstance(v, str)}
+            for f, st in stats_all().items()}
+
+
+def fault_events(families: Optional[Tuple[str, ...]] = None) -> int:
+    """Monotone total of failures + fallbacks + timeouts — a cheap
+    'did the device tier degrade during this span' delta signal (the
+    encode service's device_fallback accounting).  Pass `families` to
+    scope the sum; unscoped deltas would attribute a concurrent fault
+    in an unrelated family (hitset hashing, CRUSH) to the caller."""
+    with _reg_lock:
+        brs = [br for f, br in _breakers.items()
+               if families is None or f in families]
+    total = 0
+    for br in brs:
+        c = br.counters
+        total += c["failures"] + c["fallbacks"] + c["watchdog_timeouts"]
+    return total
+
+
+def reset_all(counters: bool = True) -> None:
+    with _reg_lock:
+        brs = list(_breakers.values())
+    for br in brs:
+        br.reset(counters=counters)
+
+
+def force_open_all(duration: Optional[float] = None) -> None:
+    for f in FAMILIES:
+        breaker(f).force_open(duration)
+
+
+# ---------------------------------------------------------------------------
+# Fault injection (the scripted seam)
+# ---------------------------------------------------------------------------
+
+_inj_lock = threading.Lock()
+_inj_raw: Optional[str] = None
+_inj_spec: Optional[Dict[str, Any]] = None
+_inj_next_left = 0
+
+
+def parse_injection(raw: Optional[str]) -> Optional[Dict[str, Any]]:
+    """CEPH_TPU_INJECT_DEVICE_FAIL spec -> {p, next, hang_ms,
+    oom_batch} or None when injection is off.  A bare float is
+    shorthand for p=<float>; unknown keys raise (a typo'd fault spec
+    silently injecting nothing would invalidate the test)."""
+    raw = (raw or "").strip()
+    if not raw or raw == "0":
+        return None
+    spec: Dict[str, Any] = {"p": 0.0, "next": 0, "hang_ms": 0.0,
+                            "oom_batch": None}
+    try:
+        spec["p"] = float(raw)
+        return spec
+    except ValueError:
+        pass
+    for part in raw.split(","):
+        key, _, val = part.partition("=")
+        key, val = key.strip(), val.strip()
+        if key in ("p", "prob"):
+            spec["p"] = float(val)
+        elif key in ("next", "fail_next", "fail-next"):
+            spec["next"] = int(val)
+        elif key in ("hang", "hang_ms", "hang-ms"):
+            spec["hang_ms"] = float(val)
+        elif key in ("oom", "oom_batch", "oom-above-batch"):
+            spec["oom_batch"] = int(val)
+        else:
+            raise ValueError(
+                f"unknown CEPH_TPU_INJECT_DEVICE_FAIL mode {part!r}")
+    return spec
+
+
+def injection() -> Optional[Dict[str, Any]]:
+    """Current injection spec; the env var is re-read every call so
+    flipping it mid-workload takes effect on the next dispatch."""
+    global _inj_raw, _inj_spec, _inj_next_left
+    raw = os.environ.get("CEPH_TPU_INJECT_DEVICE_FAIL", "")
+    with _inj_lock:
+        if raw != _inj_raw:
+            _inj_raw = raw
+            _inj_spec = parse_injection(raw)
+            _inj_next_left = _inj_spec["next"] if _inj_spec else 0
+        return _inj_spec
+
+
+def _maybe_inject(family: str, batch: Optional[int]) -> None:
+    """Runs INSIDE the watchdog-supervised dispatch body, so hang
+    injection exercises the real timeout path."""
+    global _inj_next_left
+    spec = injection()
+    if spec is None:
+        return
+    if spec["hang_ms"]:
+        time.sleep(spec["hang_ms"] / 1e3)
+    if spec["oom_batch"] is not None and batch is not None \
+            and batch > spec["oom_batch"]:
+        raise InjectedResourceExhausted(
+            f"RESOURCE_EXHAUSTED (injected: {family} batch {batch} >"
+            f" {spec['oom_batch']})")
+    fire = False
+    if spec["next"]:
+        with _inj_lock:
+            if _inj_next_left > 0:
+                _inj_next_left -= 1
+                fire = True
+    if fire:
+        raise DeviceFault(f"injected device fault ({family}:"
+                          " fail-next)")
+    if spec["p"] and random.random() < spec["p"]:
+        raise DeviceFault(f"injected device fault ({family}:"
+                          f" p={spec['p']})")
+
+
+# ---------------------------------------------------------------------------
+# device_call: the guarded dispatch choke point
+# ---------------------------------------------------------------------------
+
+
+def _default_timeout() -> float:
+    return _env_float("CEPH_TPU_DEVICE_TIMEOUT_S", 120.0)
+
+
+class _Worker:
+    """One reusable watchdog thread: dispatches are handed over on a
+    semaphore instead of paying a Thread spawn per device call (the
+    guard sits on the OSD write hot path).  A worker whose dispatch
+    wedges past the timeout is ABANDONED — never recycled — so the
+    runaway body can finish (or hang forever) without ever touching a
+    later dispatch's result slot."""
+
+    __slots__ = ("_sem", "_task")
+
+    def __init__(self) -> None:
+        self._sem = threading.Semaphore(0)
+        self._task: Optional[tuple] = None
+        t = threading.Thread(target=self._loop, daemon=True,
+                             name="devcall-worker")
+        t.start()
+
+    def _loop(self) -> None:
+        while True:
+            self._sem.acquire()
+            fn, box, done = self._task  # type: ignore[misc]
+            self._task = None
+            try:
+                box["out"] = fn()
+            except BaseException as e:  # classified by device_call
+                box["err"] = e
+            done.set()
+
+    def submit(self, fn: Callable) -> Tuple[dict, threading.Event]:
+        box: dict = {}
+        done = threading.Event()
+        self._task = (fn, box, done)
+        self._sem.release()
+        return box, done
+
+
+_pool_lock = threading.Lock()
+_idle_workers: list = []
+
+
+def _run_watchdog(fn: Callable, timeout: float
+                  ) -> Tuple[bool, dict]:
+    """Run fn on a (pooled) watchdog thread; (finished, box)."""
+    with _pool_lock:
+        worker = _idle_workers.pop() if _idle_workers else None
+    if worker is None:
+        worker = _Worker()
+    box, done = worker.submit(fn)
+    if done.wait(timeout):
+        with _pool_lock:
+            _idle_workers.append(worker)
+        return True, box
+    return False, box   # worker abandoned with its wedged dispatch
+
+
+def device_call(family: str, fn: Callable, *args,
+                batch: Optional[int] = None, label: str = "",
+                timeout: Optional[float] = None,
+                oom_to_fail: bool = False,
+                benign: Tuple[type, ...] = (),
+                ) -> Tuple[str, Any]:
+    """Run one device dispatch through the family's breaker, the
+    injection seam, and a watchdog thread.  NEVER raises; returns
+    (status, value):
+
+      ("ok", result)       dispatched; breaker recorded a success
+      ("open", None)       breaker refused (host path, no dispatch)
+      ("oom", exc)         RESOURCE_EXHAUSTED: halve the batch and
+                           retry (breaker untouched — capacity, not
+                           health); pass oom_to_fail=True at the
+                           single-stripe floor to record it as a
+                           real failure instead
+      ("benign", exc)      exception in `benign`: no breaker impact
+                           (e.g. NotImplementedError from an
+                           unsupported CRUSH rule)
+      ("timeout", None)    watchdog fired: breaker trips immediately
+                           (the runaway dispatch is abandoned on its
+                           daemon thread)
+      ("fail", exc)        dispatch raised: breaker failure recorded
+
+    With CEPH_TPU_BREAKER=0 the guard is bypassed entirely: fn runs
+    inline and exceptions propagate raw (pre-guard behavior).
+    """
+    if not enabled():
+        return "ok", fn(*args)
+    br = breaker(family)
+    if not br.allow():
+        br.note_fallback()
+        return "open", None
+
+    def _body():
+        _maybe_inject(family, batch)
+        return fn(*args)
+
+    finished, box = _run_watchdog(
+        _body, timeout if timeout is not None else _default_timeout())
+    if not finished:
+        br.record_failure(timeout=True)
+        return "timeout", None
+    err = box.get("err")
+    if err is None:
+        br.record_success()
+        return "ok", box.get("out")
+    if isinstance(err, benign):
+        # no health verdict: hand a half-open probe slot back so the
+        # breaker cannot wedge in half_open on a benign outcome
+        br.release_probe()
+        return "benign", err
+    if is_resource_exhausted(err) and not oom_to_fail:
+        br.release_probe()
+        return "oom", err
+    br.record_failure()
+    return "fail", err

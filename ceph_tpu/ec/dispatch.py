@@ -12,12 +12,14 @@ from typing import Callable, Hashable, Optional
 
 import numpy as np
 
+from ceph_tpu.common import circuit
 from ceph_tpu.ops import gf
 
 
 def gf_matmul(mat: np.ndarray, data: np.ndarray, use_tpu: bool,
               min_bytes: int = 1, sig: Optional[str] = None,
-              use_plan: bool = True) -> np.ndarray:
+              use_plan: bool = True,
+              family: str = "ec-encode") -> np.ndarray:
     """(R,K) GF(2^8) matrix x (K,S) or (B,K,S) uint8, device-dispatched.
 
     The device branch routes through the ExecPlan cache (ec/plan.py):
@@ -27,21 +29,20 @@ def gf_matmul(mat: np.ndarray, data: np.ndarray, use_tpu: bool,
     dryrun compile the same program; a single chip is the (1,1) mesh.
     `sig` is the codec's plan signature; use_plan=False (the
     --no-plan-cache toggle) dispatches with exact shapes.
+
+    Every device attempt rides the `family` circuit breaker
+    (common/circuit.py): while the breaker is open — or when the
+    guarded dispatch fails, times out, or exhausts OOM halving — the
+    call degrades to the bit-exact numpy host fold below, so callers
+    NEVER see a device error from this entry.
     """
     if use_tpu and gf.backend_available() and data.size >= min_bytes:
-        if use_plan:
-            from ceph_tpu.ec import plan
-
-            if plan.enabled():
-                out = plan.matmul(mat, data, sig=sig)
-                if out is not None:
-                    return out
-        from ceph_tpu.parallel import backend
-
-        out = backend.matmul(mat, data)
-        if out is not None:
-            return out
-        return np.asarray(gf.gf_matmul_tpu(mat, data))
+        if not circuit.degraded(family):
+            out = _device_matmul(mat, data, sig, use_plan, family)
+            if out is not None:
+                return out
+        else:
+            circuit.breaker(family).note_fallback()
     if data.ndim == 2:
         return gf.gf_matmul_host(mat, data)
     # batched host path: the GF matmul is elementwise across columns, so
@@ -51,6 +52,46 @@ def gf_matmul(mat: np.ndarray, data: np.ndarray, use_tpu: bool,
     flat = np.ascontiguousarray(np.moveaxis(data, 1, 0)).reshape(k, b * s)
     par = gf.gf_matmul_host(mat, flat)
     return np.moveaxis(par.reshape(-1, b, s), 0, 1)
+
+
+def _device_matmul(mat: np.ndarray, data: np.ndarray,
+                   sig: Optional[str], use_plan: bool,
+                   family: str) -> Optional[np.ndarray]:
+    """The device tiers in preference order, every dispatch guarded;
+    None means 'take the host path'."""
+    if use_plan:
+        from ceph_tpu.ec import plan
+
+        if plan.enabled():
+            out = plan.matmul(mat, data, sig=sig, family=family)
+            if out is not None:
+                return out
+    if circuit.degraded(family):     # the plan attempt may have tripped
+        return None
+    from ceph_tpu.parallel import backend
+
+    batch = data.shape[0] if data.ndim == 3 else 1
+    status, out = circuit.device_call(
+        family, backend.matmul, mat, data, batch=batch,
+        label="mesh-direct", oom_to_fail=batch <= 1)
+    if status == "ok" and out is not None:
+        return out
+    if status == "oom" and batch > 1:
+        h = batch // 2
+        first = _device_matmul(mat, data[:h], sig, use_plan, family)
+        second = _device_matmul(mat, data[h:], sig, use_plan, family)
+        if first is not None and second is not None:
+            return np.concatenate([first, second], axis=0)
+        return None
+    if status in ("fail", "timeout", "open", "oom"):
+        return None
+    # mesh declined the shape (ok, None): the single-device XLA kernel.
+    # np.asarray INSIDE the guarded body: the dispatch is async, so a
+    # late error/wedge must land under the watchdog, not at the caller
+    status, out = circuit.device_call(
+        family, lambda: np.asarray(gf.gf_matmul_tpu(mat, data)),
+        batch=batch, label="xla-direct", oom_to_fail=True)
+    return out if status == "ok" else None
 
 
 class LruCache:
@@ -84,6 +125,10 @@ class LruCache:
         self._store.move_to_end(key)
         if len(self._store) > self.cap:
             self._store.popitem(last=False)
+
+    def pop(self, key: Hashable, default=None):
+        """Evict one entry (the poisoned-plan quarantine path)."""
+        return self._store.pop(key, default)
 
     def clear(self) -> None:
         self._store.clear()

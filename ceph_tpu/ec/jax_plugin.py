@@ -163,10 +163,15 @@ class ErasureCodeJax(ErasureCode):
         """(R,K) GF matrix x (K,S) or (B,K,S) uint8 -> parity, device-dispatched."""
         if self.w != 8:
             return self._matmul_wide(mat, data)
-        sig = self.plan_signature() if mat is self.matrix else None
-        return dispatch.gf_matmul(mat, data, self.use_tpu,
-                                  self.tpu_min_bytes, sig=sig,
-                                  use_plan=self.use_plan)
+        encode = mat is self.matrix
+        sig = self.plan_signature() if encode else None
+        return dispatch.gf_matmul(
+            mat, data, self.use_tpu, self.tpu_min_bytes, sig=sig,
+            use_plan=self.use_plan,
+            # the generator matmul is the encode family; everything
+            # else (inverted decode rows) is ec-decode — each trips
+            # and recovers its own breaker
+            family="ec-encode" if encode else "ec-decode")
 
     def _matmul_wide(self, mat: np.ndarray, data: np.ndarray) -> np.ndarray:
         """Host GF(2^w) matmul for w in {16, 32}: chunks viewed as

@@ -51,6 +51,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ceph_tpu.common import circuit
 from ceph_tpu.ec.dispatch import LruCache
 from ceph_tpu.ops import checksum as cks
 from ceph_tpu.ops import gf
@@ -67,8 +68,8 @@ __all__ = [
     "bucket_batch", "bucket_bytes", "clear", "codec_signature",
     "device_platform", "enabled", "encode", "encode_coalesced",
     "encode_with_crc", "matmul", "matrix_signature", "plan_key",
-    "reset_stats", "set_enabled", "stats", "StripeCoalescer",
-    "tracked_jit",
+    "quarantine_info", "reset_stats", "set_enabled", "stats",
+    "StripeCoalescer", "tracked_jit",
 ]
 
 # ---------------------------------------------------------------------------
@@ -79,9 +80,15 @@ _lock = threading.Lock()
 _plans = LruCache(cap=128)
 _mbits_cache = LruCache(cap=64)      # matrix signature -> device bit matrix
 _counters: Dict[str, int] = {"hits": 0, "misses": 0, "retraces": 0,
-                             "dispatches": 0}
+                             "dispatches": 0, "host_fallbacks": 0,
+                             "oom_splits": 0, "quarantines": 0}
 _per_plan: Dict[str, Dict[str, float]] = {}
 _enabled = os.environ.get("CEPH_TPU_PLAN_CACHE", "1") != "0"
+# poisoned-plan quarantine: a compiled callable that keeps failing is
+# evicted and its key blacklisted for a TTL (a single bad compile must
+# not re-trip the breaker forever while healthy plans keep serving)
+_quarantine: Dict[tuple, float] = {}         # key -> expiry (monotonic)
+_plan_failures: Dict[tuple, int] = {}        # key -> consecutive fails
 
 
 def enabled() -> bool:
@@ -106,12 +113,17 @@ def stats() -> dict:
     dispatch time — device completion is asynchronous).
     """
     with _lock:
-        return {
+        out = {
             **_counters,
             "plans": len(_plans),
+            "quarantined_plans": len(_quarantine),
             "enabled": _enabled,
             "per_plan": {k: dict(v) for k, v in _per_plan.items()},
         }
+    # breaker states + trip/probe/fallback counters ride the same
+    # snapshot (the device_health admin command and bench read this)
+    out["device_health"] = circuit.stats_all()
+    return out
 
 
 def reset_stats() -> None:
@@ -126,6 +138,8 @@ def clear() -> None:
     with _lock:
         _plans.clear()
         _mbits_cache.clear()
+        _quarantine.clear()
+        _plan_failures.clear()
 
 
 def _note_retrace(label: str) -> None:
@@ -273,6 +287,107 @@ def _get_plan(key: tuple, build: Callable[[], ExecPlan]) -> ExecPlan:
     return plan
 
 
+# ---------------------------------------------------------------------------
+# Dispatch guard: breaker + watchdog + OOM splitting + plan quarantine
+# ---------------------------------------------------------------------------
+
+
+def _quarantine_ttl() -> float:
+    try:
+        return float(os.environ.get("CEPH_TPU_PLAN_QUARANTINE_S", 30.0))
+    except ValueError:
+        return 30.0
+
+
+def _plan_fail_limit() -> int:
+    try:
+        return int(os.environ.get("CEPH_TPU_PLAN_FAIL_LIMIT", 3))
+    except ValueError:
+        return 3
+
+
+def _quarantined(key: tuple) -> bool:
+    """True while a poisoned plan key is blacklisted (callers take the
+    host path without rebuilding the callable); an expired entry is
+    released so the next request recompiles fresh."""
+    with _lock:
+        expiry = _quarantine.get(key)
+        if expiry is None:
+            return False
+        if time.monotonic() >= expiry:
+            del _quarantine[key]
+            _plan_failures.pop(key, None)
+            return False
+        return True
+
+
+def _note_plan_failure(key: tuple) -> None:
+    """One more dispatch failure for this compiled callable; at the
+    limit the plan is evicted from the cache and its key quarantined
+    for the TTL (poisoned-plan quarantine)."""
+    with _lock:
+        n = _plan_failures.get(key, 0) + 1
+        _plan_failures[key] = n
+        if n >= _plan_fail_limit():
+            _plans.pop(key, None)
+            _quarantine[key] = time.monotonic() + _quarantine_ttl()
+            _plan_failures.pop(key, None)
+            _counters["quarantines"] += 1
+
+
+def quarantine_info() -> dict:
+    """Admin view of the poisoned-plan blacklist."""
+    now = time.monotonic()
+    with _lock:
+        return {
+            "ttl_s": _quarantine_ttl(),
+            "fail_limit": _plan_fail_limit(),
+            "entries": [
+                {"plan": _label(k),
+                 "expires_in_s": round(max(exp - now, 0.0), 3)}
+                for k, exp in _quarantine.items()],
+        }
+
+
+def _materialize(out):
+    """Force async XLA results to completion INSIDE the guarded body:
+    jax dispatch returns placeholder arrays almost immediately, so a
+    late runtime error (or a device that wedges mid-execution) would
+    otherwise surface at the CALLER's np.asarray — outside the
+    watchdog and the breaker's accounting."""
+    if out is None:
+        return None
+    if isinstance(out, tuple):
+        return tuple(_materialize(o) for o in out)
+    return np.asarray(out)
+
+
+def _guarded(family: str, key: tuple, plan: ExecPlan, args: tuple,
+             batch: int) -> Tuple[str, Optional[object]]:
+    """One plan dispatch through the device_call choke point.  Returns
+    ("ok", out), ("oom", None) — caller halves the batch — or
+    ("fail", None) after recording breaker/quarantine state; callers
+    translate "fail" into the bit-exact host path (return None)."""
+
+    def run():
+        return _materialize(plan(*args))
+
+    status, out = circuit.device_call(
+        family, run, batch=batch, label=plan.label,
+        oom_to_fail=batch <= 1)
+    if status == "ok":
+        return "ok", out
+    if status == "oom":
+        with _lock:
+            _counters["oom_splits"] += 1
+        return "oom", None
+    if status in ("fail", "timeout"):
+        _note_plan_failure(key)
+    with _lock:
+        _counters["host_fallbacks"] += 1
+    return "fail", None
+
+
 def device_platform() -> Optional[str]:
     """The jax backend platform ('tpu', 'cpu', ...), None when no
     backend initializes (callers gate device-only policies on this)."""
@@ -324,14 +439,18 @@ def _build_local_encode(key: tuple, donate: bool) -> ExecPlan:
 
 
 def encode(matrix: np.ndarray, data: np.ndarray, sig: str = None,
-           donate: Optional[bool] = None) -> Optional[np.ndarray]:
+           donate: Optional[bool] = None,
+           family: str = "ec-encode") -> Optional[np.ndarray]:
     """(B, K, S) or (K, S) uint8 stripes -> parity, plan-cached.
 
     Donation policy: None (auto) donates only the padded device buffer
     this function itself creates from host bytes; True asserts the
     caller relinquishes a device-resident input; False never donates.
     Off-TPU backends never donate (XLA would ignore it).  Returns None
-    when no jax backend is available.
+    when no jax backend is available, the plan key is quarantined, or
+    the dispatch failed past the guard (callers take the bit-exact
+    host path); RESOURCE_EXHAUSTED recursively halves the batch down
+    to a single stripe before giving up.
     """
     if not (HAVE_JAX and gf.backend_available()):
         return None
@@ -350,6 +469,8 @@ def encode(matrix: np.ndarray, data: np.ndarray, sig: str = None,
     eff_donate = bool(_donation_usable()
                       and (donate or (donate is None and host_input)))
     key = plan_key(sig, "encode", rows, k, b, s, donate=eff_donate)
+    if _quarantined(key):
+        return None
     plan = _get_plan(
         key, lambda: _build_local_encode(key, eff_donate))
     bb, bs = key[4], key[5]
@@ -360,7 +481,23 @@ def encode(matrix: np.ndarray, data: np.ndarray, sig: str = None,
         # (donate=True), so no defensive copy is ever needed
         pad = ((0, bb - b), (0, 0), (0, bs - s))
         padded = jnp.pad(arr, pad) if (bb != b or bs != s) else arr
-    out = np.asarray(plan(_mbits_for(matrix), padded))[:b, :, :s]
+    status, out = _guarded(family, key, plan,
+                           (_mbits_for(matrix), padded), b)
+    if status == "oom" and b > 1:
+        # OOM halving: each half re-buckets onto a smaller plan; GF
+        # parity is per-stripe independent, so the split is bit-exact
+        h = b // 2
+        first = encode(matrix, arr[:h], sig=sig, donate=donate,
+                       family=family)
+        second = encode(matrix, arr[h:], sig=sig, donate=donate,
+                        family=family)
+        if first is None or second is None:
+            return None
+        out = np.concatenate([first, second], axis=0)
+        return out[0] if squeeze else out
+    if status != "ok":
+        return None
+    out = np.asarray(out)[:b, :, :s]
     return out[0] if squeeze else out
 
 
@@ -373,12 +510,14 @@ def _build_mesh_matmul(key: tuple) -> ExecPlan:
     return ExecPlan(key, backend.matmul, "mesh")
 
 
-def matmul(mat: np.ndarray, data, sig: str = None
-           ) -> Optional[np.ndarray]:
+def matmul(mat: np.ndarray, data, sig: str = None,
+           family: str = "ec-decode") -> Optional[np.ndarray]:
     """Plan-cached device GF(2^8) matmul — the ec/dispatch device
     entry.  Buckets the (B, S) shape, pads, dispatches through the
     cached plan, slices the real shape back out.  Returns None when no
-    device path applies (caller falls back to host)."""
+    device path applies, the plan key is quarantined, or the guarded
+    dispatch failed (caller falls back to host); RESOURCE_EXHAUSTED
+    halves the batch recursively first."""
     if not (HAVE_JAX and gf.backend_available()):
         return None
     if not isinstance(data, np.ndarray):
@@ -396,10 +535,21 @@ def matmul(mat: np.ndarray, data, sig: str = None
     # decode matrices cycle per erasure signature: key on shape only so
     # one compile (matrix as runtime operand) serves every signature
     key = plan_key(sig or "*", "matmul", rows, k, b, s)
+    if _quarantined(key):
+        return None
     plan = _get_plan(key, lambda: _build_mesh_matmul(key))
     bb, bs = key[4], key[5]
-    out = plan(mat, _pad_batch(arr, bb, bs))
-    if out is None:
+    status, out = _guarded(family, key, plan,
+                           (mat, _pad_batch(arr, bb, bs)), b)
+    if status == "oom" and b > 1:
+        h = b // 2
+        first = matmul(mat, arr[:h], sig=sig, family=family)
+        second = matmul(mat, arr[h:], sig=sig, family=family)
+        if first is None or second is None:
+            return None
+        out = np.concatenate([first, second], axis=0)
+        return out[0] if squeeze else out
+    if status != "ok" or out is None:
         return None
     out = np.asarray(out)[:b, :, :s]
     return out[0] if squeeze else out
@@ -445,10 +595,24 @@ def encode_with_crc(matrix: np.ndarray, data: np.ndarray,
     rows = int(np.asarray(matrix).shape[0])
     sig = sig or matrix_signature(matrix)
     key = plan_key(sig, "encode_crc", rows, k, b, s)
+    if _quarantined(key):
+        return None
     plan = _get_plan(key, lambda: _build_encode_crc(key))
     bb = key[4]
     padded = jnp.asarray(_pad_batch(arr, bb, s))
-    parity, crcs = plan(_mbits_for(matrix), padded)
+    status, out = _guarded("fused-crc", key, plan,
+                           (_mbits_for(matrix), padded), b)
+    if status == "oom" and b > 1:
+        h = b // 2
+        first = encode_with_crc(matrix, arr[:h], sig=sig)
+        second = encode_with_crc(matrix, arr[h:], sig=sig)
+        if first is None or second is None:
+            return None
+        return (np.concatenate([first[0], second[0]], axis=0),
+                np.concatenate([first[1], second[1]], axis=0))
+    if status != "ok":
+        return None
+    parity, crcs = out
     return (np.asarray(parity)[:b],
             np.asarray(crcs).astype(np.uint32)[:b])
 

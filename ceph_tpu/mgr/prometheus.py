@@ -96,6 +96,10 @@ class PrometheusModule(MgrModule):
         "_cached_bytes", "_cached_objects", "_inflight",
         "_queue_depth", "_queue_bytes", "_window_ms",
         "_max_batch_bytes", "_enabled", "_plans",
+        # device-health breaker leaves: state and backoff are levels,
+        # and the consecutive-failure count resets on every success
+        "_state_code", "_retry_in_s", "_consecutive",
+        "_quarantined_plans",
     )
 
     @classmethod

@@ -32,6 +32,7 @@ import asyncio
 import bisect
 import json
 import logging
+import os
 import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -327,7 +328,11 @@ class OSDDaemon:
         # data-path transfer/dispatch accounting (perf-counter tier);
         # tests assert small writes/reads move O(stripe), not O(object)
         self.perf = {"subread_bytes": 0, "subwrite_bytes": 0,
-                     "encode_dispatches": 0, "decode_dispatches": 0}
+                     "encode_dispatches": 0, "decode_dispatches": 0,
+                     # device-fault degradation accounting: decodes
+                     # re-run inline on host after a device fault
+                     # (scrub-repair / recovery resilience)
+                     "decode_host_retries": 0}
         # async micro-batching encode/decode front end: concurrent EC
         # ops share plan-cached device dispatches; inline (pre-service
         # behavior) when the device tier is absent or
@@ -459,6 +464,11 @@ class OSDDaemon:
                 lambda cmd: self.encode_service.stats(),
                 "micro-batching encode service: batch/fill/wait"
                 " histograms, queue depth, inline fallbacks"),
+            "device_health": (
+                lambda cmd: self._cmd_device_health(),
+                "per-family circuit-breaker states, trip/probe/"
+                "fallback counters, poisoned-plan quarantine, and"
+                " the active fault-injection spec"),
             "dump_traces": (
                 lambda cmd: {"spans": self.tracer.dump(
                     int(cmd["trace_id"], 16)
@@ -492,7 +502,33 @@ class OSDDaemon:
                     if isinstance(v, (int, float, dict))
                     and not isinstance(v, bool)}
             for label, st in svc.get("profiles", {}).items()}
+        # breaker states per dispatch family (numeric-only: the
+        # prometheus flattener exports state as the state_code gauge)
+        from ceph_tpu.common import circuit
+
+        out["device_health"] = circuit.perf_dump()
         return out
+
+    def _cmd_device_health(self) -> Dict[str, Any]:
+        """The device-tier fault surface: breaker state machines,
+        poisoned-plan quarantine, encode-service shed accounting, and
+        whatever fault injection is currently scripted — the operator
+        view of 'is the accelerator path healthy, and what is serving
+        traffic while it is not'."""
+        from ceph_tpu.common import circuit
+        from ceph_tpu.ec import plan as ec_plan
+
+        return {
+            "breakers": circuit.stats_all(),
+            "plan_quarantine": ec_plan.quarantine_info(),
+            "encode_service_device_fallback":
+                self.encode_service.counters.get("device_fallback", 0),
+            "decode_host_retries":
+                self.perf.get("decode_host_retries", 0),
+            "injection": os.environ.get(
+                "CEPH_TPU_INJECT_DEVICE_FAIL", ""),
+            "guard_enabled": circuit.enabled(),
+        }
 
     def _cmd_hitset_dump(self) -> Dict[str, Any]:
         """Live per-PG stacks + the hitset omap keys persisted on this
@@ -2952,10 +2988,28 @@ class OSDDaemon:
         datas: Dict[str, bytes] = {}
         for p, res in zip(ec_plans, results):
             if isinstance(res, BaseException):
-                log.error("osd.%d: reconstruct of %s failed",
-                          self.osd_id, p["oid"], exc_info=res)
-            else:
-                datas[p["oid"]] = res
+                # device-fault resilience (scrub repair rides this
+                # path): a decode that died on the device tier must
+                # retry on the bit-exact host path before the object
+                # counts unrepaired — by now the breaker guard has
+                # degraded the dispatch, so this inline re-run only
+                # raises for genuine data errors (below k survivors,
+                # malformed streams)
+                try:
+                    res = await asyncio.to_thread(
+                        ec_util.decode, sinfo, codec, p["chosen"])
+                    self.perf["decode_host_retries"] += 1
+                except Exception as host_err:
+                    # the host retry's OWN error is the actionable
+                    # one (below-k survivors, malformed streams); the
+                    # superseded batch error rides the message
+                    log.error("osd.%d: reconstruct of %s failed on"
+                              " host retry (batched decode had"
+                              " failed with %r)",
+                              self.osd_id, p["oid"], res,
+                              exc_info=host_err)
+                    continue
+            datas[p["oid"]] = res
         done = [p for p in ec_plans if p["oid"] in datas]
         if not done:
             return []

@@ -140,7 +140,8 @@ class EncodeService:
         self._closed = False
         self._usable_cache: Dict[int, bool] = {}
         self.counters = {"requests": 0, "batched": 0, "inline": 0,
-                         "shed": 0, "batches": 0, "dispatch_errors": 0}
+                         "shed": 0, "batches": 0, "dispatch_errors": 0,
+                         "device_fallback": 0}
 
     # -- public API (the daemon's awaited entry points) -------------------
 
@@ -355,25 +356,46 @@ class EncodeService:
 
     def _run_batch(self, q: _Bucket, payloads: list) -> list:
         """Thread-side batch body: one fused dispatch for the whole
-        batch; a batch-level failure retries per item so one bad
-        request cannot fail its neighbours."""
+        batch.  Flush-failure semantics: a DEVICE fault during the
+        batch must never surface on the per-request futures — the
+        whole accumulated batch sheds to the inline path, where the
+        breaker guard (common/circuit.py) degrades each item to the
+        bit-exact numpy host tier; only genuine host-path errors (bad
+        geometry, malformed payloads) reach a future.  Device trouble
+        during the flush — a batch-level exception OR guard-level
+        fallbacks recorded while it ran — counts once under
+        device_fallback."""
+        from ceph_tpu.common import circuit
+
+        # scoped to the EC families this batch can actually touch — an
+        # unscoped delta would attribute a concurrent hitset/CRUSH
+        # fault to this flush
+        fams = ("ec-encode", "ec-decode", "fused-crc")
+        faults_before = circuit.fault_events(fams)
+        outs: Optional[list] = None
         try:
             if q.kind == "encode_hinfo":
-                return ec_util.encode_many_with_hinfo(
+                outs = ec_util.encode_many_with_hinfo(
                     q.sinfo, q.codec, payloads)
-            if q.kind == "encode":
-                return ec_util.encode_many(
+            elif q.kind == "encode":
+                outs = ec_util.encode_many(
                     q.sinfo, q.codec, [p[0] for p in payloads],
                     [p[1] for p in payloads])
-            return ec_util.decode_many(q.sinfo, q.codec, payloads)
+            else:
+                outs = ec_util.decode_many(q.sinfo, q.codec, payloads)
         except Exception:
-            outs: list = []
+            # shed the batch to the inline host path: per-item, so one
+            # bad request cannot fail its neighbours, and each retry
+            # rides the guard's host degradation
+            outs = []
             for p in payloads:
                 try:
                     outs.append(self._run_one(q, p))
                 except Exception as e:
                     outs.append(e)
-            return outs
+        if circuit.fault_events(fams) > faults_before:
+            self.counters["device_fallback"] += 1
+        return outs
 
     def _run_one(self, q: _Bucket, payload):
         if q.kind == "encode_hinfo":

@@ -85,9 +85,14 @@ _device_fns: Dict[tuple, Any] = {}
 
 
 def _device_positions(hashes: np.ndarray, nbits: int,
-                      nhash: int) -> np.ndarray:
+                      nhash: int) -> Optional[np.ndarray]:
     """Device-batched positions: one jitted dispatch per pow2-bucketed
-    batch (shape churn would retrace per unique batch size)."""
+    batch (shape churn would retrace per unique batch size).  The
+    dispatch rides the hitset-hash breaker guard; None means the
+    device tier is degraded and the caller hashes on the host — the
+    xp=np path is bit-identical, so a tripped breaker costs lanes,
+    never correctness."""
+    from ceph_tpu.common import circuit
     from ceph_tpu.ec import plan
 
     key = (nbits, nhash)
@@ -105,20 +110,35 @@ def _device_positions(hashes: np.ndarray, nbits: int,
         # idempotent and the tail is sliced off below
         hashes = np.concatenate(
             [hashes, np.full(cap - n, hashes[-1], dtype=np.uint32)])
-    return np.asarray(fn(jnp.asarray(hashes)))[:n]
+
+    def run(h):
+        return np.asarray(fn(jnp.asarray(h)))
+
+    status, out = circuit.device_call(
+        "hitset-hash", run, hashes, batch=cap,
+        label=f"hitset b{nbits} k{nhash}", oom_to_fail=True)
+    return out[:n] if status == "ok" else None
 
 
 def positions_for(hashes, nbits: int, nhash: int,
                   device: Optional[bool] = None) -> np.ndarray:
-    """Dispatch policy: device for real batches when jax is present,
-    host otherwise.  Both paths are bit-exact."""
+    """Dispatch policy: device for real batches when jax is present
+    and the hitset-hash breaker is closed, host otherwise.  Both
+    paths are bit-exact."""
     arr = np.asarray(hashes, dtype=np.uint32).reshape(-1)
     if arr.size == 0:
         return np.zeros((0, nhash), dtype=np.uint32)
     if device is None:
         device = HAVE_JAX and arr.size >= DEVICE_MIN_BATCH
     if device and HAVE_JAX:
-        return _device_positions(arr, nbits, nhash)
+        from ceph_tpu.common import circuit
+
+        if not circuit.degraded("hitset-hash"):
+            out = _device_positions(arr, nbits, nhash)
+            if out is not None:
+                return out
+        else:
+            circuit.breaker("hitset-hash").note_fallback()
     return bloom_positions(arr, nbits, nhash, xp=np)
 
 

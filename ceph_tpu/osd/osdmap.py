@@ -715,11 +715,13 @@ class OSDMapMapping:
         self._update(use_tpu)
 
     def _update(self, use_tpu: bool) -> None:
+        from ceph_tpu.common import circuit
         from ceph_tpu.ops import gf
 
         m = self._map
         device_ok = use_tpu and gf.backend_available() \
-            and not m.crush.choose_args
+            and not m.crush.choose_args \
+            and not circuit.degraded("crush-batch")
         # compile probe hoisted out of the per-pool walk: each
         # (ruleno, result_max) compiles at most once per update, an
         # unsupported ruleno is remembered so sibling pools skip the
@@ -750,10 +752,17 @@ class OSDMapMapping:
                         unsupported_rules.add(ruleno)
                 run = compiled[key]
                 if run is not None:
-                    try:
-                        raw_rows = run(pps)
-                    except NotImplementedError:
-                        raw_rows = None
+                    # guarded vmapped straw2 dispatch: a wedged or
+                    # faulting device degrades THIS pool to the
+                    # scalar mapper below (identical placement, more
+                    # host time) instead of failing the map update
+                    status, rows = circuit.device_call(
+                        "crush-batch",
+                        lambda: np.asarray(run(pps)),
+                        batch=len(pps),
+                        label=f"crush r{ruleno}", oom_to_fail=True,
+                        benign=(NotImplementedError,))
+                    raw_rows = rows if status == "ok" else None
             if raw_rows is None and device_ok and ruleno >= 0:
                 fallback_pools.append(pool_id)
             for ps in range(pool.pg_num):

@@ -137,13 +137,31 @@ class ShardedPipeline:
 
         parity (B, m, S) stays mesh-sharded; crcs (B, k+m) uint32 and
         placement (B, R) are dp-sharded, sp-replicated.
+
+        The dispatch rides the ec-encode breaker guard (watchdog +
+        injection seam); there is no host twin at this mesh layer, so
+        an unrecovered failure raises — single-chip callers reach the
+        mesh through ec/dispatch.gf_matmul, which owns the bit-exact
+        host degradation.
         """
+        from ceph_tpu.common import circuit
+
         b = data.shape[0]
         if b % self.dp:
             raise ValueError(f"batch {b} not divisible by dp={self.dp}")
         if pgs is None:
             pgs = jnp.zeros((b,), dtype=jnp.int32)
-        return self._encode(data, jnp.asarray(pgs, dtype=jnp.int32))
+        status, out = circuit.device_call(
+            "ec-encode", self._encode, data,
+            jnp.asarray(pgs, dtype=jnp.int32), batch=int(b),
+            label="striped.encode", oom_to_fail=True)
+        if status != "ok":
+            if isinstance(out, BaseException):
+                raise out
+            raise RuntimeError(
+                f"striped encode unavailable ({status}: ec-encode"
+                " breaker)")
+        return out
 
     # -- decode -----------------------------------------------------------
 

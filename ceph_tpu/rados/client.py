@@ -46,6 +46,20 @@ ENOENT = -2
 ESTALE = -116
 
 
+def full_jitter(base: float, attempt: int, cap: float = 5.0) -> float:
+    """Retry sleep with FULL jitter: U(0, min(cap, base * 2^attempt)).
+
+    The op/mon hunt loops used fixed (or linearly ramped) sleeps —
+    when a device breaker trips cluster-wide, every client that failed
+    in the same instant would retry in the same instant, and keep
+    re-colliding each round (the thundering-herd resonance the AWS
+    backoff analysis quantifies).  Sampling the WHOLE window decorrelates
+    the herd in one round while keeping the same mean pressure."""
+    import random
+
+    return random.uniform(0.0, min(cap, base * (2 ** attempt)))
+
+
 class RadosError(Exception):
     def __init__(self, rc: int, what: str = ""):
         super().__init__(f"rc={rc} {what}")
@@ -155,7 +169,7 @@ class RadosClient:
             except (ConnectionError, OSError) as e:
                 last = e
                 self._hunt_mon()
-                await asyncio.sleep(0.2)
+                await asyncio.sleep(full_jitter(0.2, _attempt, cap=2.0))
                 continue
             for _ in range(500):
                 if self.osdmap is not None:
@@ -346,19 +360,21 @@ class RadosClient:
                 if reply.rc == -11 and "quorum" in str(
                         reply.out.get("error", "")):
                     # election in progress: wait it out and retry
+                    # (jittered — every client sees the same election)
                     last = RadosError(-11, str(reply.out))
-                    await asyncio.sleep(0.4 * (attempt + 1))
+                    await asyncio.sleep(full_jitter(0.8, attempt,
+                                                    cap=4.0))
                     continue
                 return reply.rc, reply.out
             except (asyncio.TimeoutError, ConnectionError,
                     OSError) as e:
                 # a restarted/dead mon leaves a stale cached connection
                 # that may not have seen EOF yet: drop it, hunt to the
-                # next mon in the monmap, retry after a beat
+                # next mon in the monmap, retry after a jittered beat
                 last = e
                 self._hunt_mon()
                 resubscribe = True
-                await asyncio.sleep(0.3 * (attempt + 1))
+                await asyncio.sleep(full_jitter(0.6, attempt, cap=4.0))
             finally:
                 self._futures.pop(tid, None)
         raise RadosError(EAGAIN, f"mon command {cmd!r} failed ({last!r})")
@@ -385,7 +401,7 @@ class RadosClient:
                 return reply.rc, reply.out
             except (asyncio.TimeoutError, ConnectionError, OSError) as e:
                 last = e
-                await asyncio.sleep(0.2)
+                await asyncio.sleep(full_jitter(0.4, attempt, cap=2.0))
             finally:
                 self._futures.pop(tid, None)
         # same error contract as mon_command/_submit: RadosError, not
@@ -607,9 +623,11 @@ class IoCtx:
                 # The floor sleep matters: during bring-up/peering churn
                 # maps arrive continuously, and without it the retry
                 # budget burns in milliseconds while PGs are still
-                # peering (Objecter's backoff discipline).
+                # peering (Objecter's backoff discipline).  Jittered:
+                # a cluster-wide bounce must not resynchronize every
+                # client's resend onto the same instant.
                 await client.wait_for_new_map(0.5)
-                await asyncio.sleep(0.15)
+                await asyncio.sleep(0.05 + full_jitter(0.2, 0))
                 continue
             return reply
         raise RadosError(EAGAIN, f"op on {oid!r} exhausted retries"

@@ -30,6 +30,17 @@ def pytest_configure(config):
         "markers", "slow: long-running multi-process/thrash tier")
 
 
+# True when the device fault-injection seam is scripted for the whole
+# run (the degraded-mode acceptance tier: CEPH_TPU_INJECT_DEVICE_FAIL
+# forces dispatches to fail).  Bit-exactness tests must PASS via the
+# host fallback in that mode; tests that assert live device-dispatch
+# COUNTERS (plans compiled, batches folded, retraces bounded) mark
+# themselves skipif(DEVICE_INJECTION) — their subject is definitionally
+# absent while every dispatch is scripted to fail.
+DEVICE_INJECTION = os.environ.get(
+    "CEPH_TPU_INJECT_DEVICE_FAIL", "") not in ("", "0")
+
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

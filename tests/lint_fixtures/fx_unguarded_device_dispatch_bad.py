@@ -1,0 +1,14 @@
+"""Violation: raw device dispatch outside the breaker guard — a
+wedged or faulting accelerator raises to the caller instead of
+degrading to the bit-exact host path."""
+
+from ceph_tpu.ops import gf
+from ceph_tpu.parallel import backend
+
+
+def reconstruct(dmat, survivors):
+    return backend.matmul(dmat, survivors)  # expect: unguarded-device-dispatch
+
+
+def parity(mat, stripes):
+    return gf.gf_matmul_tpu(mat, stripes)  # expect: unguarded-device-dispatch

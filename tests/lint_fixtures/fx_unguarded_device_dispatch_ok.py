@@ -1,0 +1,25 @@
+"""Clean twin: device dispatches ride circuit.device_call — watchdog,
+breaker accounting, and the fault-injection seam apply, and a failed
+dispatch degrades to the host fold instead of raising."""
+
+from ceph_tpu.common import circuit
+from ceph_tpu.ops import gf
+from ceph_tpu.parallel import backend
+
+
+def reconstruct(dmat, survivors):
+    status, out = circuit.device_call(
+        "ec-decode", backend.matmul, dmat, survivors,
+        batch=len(survivors))
+    if status == "ok" and out is not None:
+        return out
+    return gf.gf_matmul_host(dmat, survivors)
+
+
+def parity(mat, stripes):
+    status, out = circuit.device_call(
+        "ec-encode", gf.gf_matmul_tpu, mat, stripes,
+        batch=len(stripes))
+    if status == "ok":
+        return out
+    return gf.gf_matmul_host(mat, stripes)

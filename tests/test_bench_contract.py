@@ -20,7 +20,8 @@ sys.path.insert(0, REPO)
 import bench  # noqa: E402
 
 CONTRACT_KEYS = {"metric", "value", "unit", "vs_baseline",
-                 "plan_cache", "encode_service", "tier", "truncated"}
+                 "plan_cache", "encode_service", "tier",
+                 "device_health", "truncated"}
 
 
 def test_contract_line_despite_hanging_backend(tmp_path):
@@ -62,6 +63,14 @@ def test_contract_line_despite_hanging_backend(tmp_path):
     assert contract["tier"]["records"] >= 1
     assert contract["tier"]["promote"] >= 1
     assert contract["tier"]["hit"] >= 1
+    # the device-health probe ran: forced device failure degraded to
+    # the bit-exact host path, tripped the breaker, and a half-open
+    # probe re-closed it once injection cleared
+    assert contract["device_health"]["bitexact"] == 1
+    assert contract["device_health"]["trips"] >= 1
+    assert contract["device_health"]["failures"] >= 1
+    assert contract["device_health"]["probes"] >= 1
+    assert contract["device_health"]["recovered"] == 1
     assert contract["truncated"] is False
     # details stayed out of stdout (they belong in bench_details.json)
     assert len(stdout_lines) == 1

@@ -13,6 +13,8 @@ import sys
 import numpy as np
 import pytest
 
+import conftest
+
 jax = pytest.importorskip("jax")
 
 from ceph_tpu.ec import plan  # noqa: E402
@@ -82,6 +84,9 @@ def test_bucket_batch_policy():
     (7, 333),
     (5, 4096),      # exact bucket
 ])
+@pytest.mark.skipif(conftest.DEVICE_INJECTION,
+                    reason="asserts live device-dispatch counters/plans;\
+ subject absent under scripted device-fault injection")
 def test_bucketed_padding_matches_host_reference(batch, chunk):
     mat = rs.reed_sol_van_matrix(4, 2)
     data = RNG.integers(0, 256, (batch, 4, chunk), dtype=np.uint8)
@@ -91,6 +96,9 @@ def test_bucketed_padding_matches_host_reference(batch, chunk):
     assert np.array_equal(got, _host_parity(mat, data))
 
 
+@pytest.mark.skipif(conftest.DEVICE_INJECTION,
+                    reason="asserts live device-dispatch counters/plans;\
+ subject absent under scripted device-fault injection")
 def test_plan_matmul_matches_host_and_squeezes_2d():
     mat = rs.reed_sol_van_matrix(6, 3)
     data = RNG.integers(0, 256, (3, 6, 1000), dtype=np.uint8)
@@ -156,6 +164,9 @@ def test_codec_signature_distinguishes_profiles():
 # -- donation safety --------------------------------------------------------
 
 
+@pytest.mark.skipif(conftest.DEVICE_INJECTION,
+                    reason="asserts live device-dispatch counters/plans;\
+ subject absent under scripted device-fault injection")
 def test_donation_does_not_alias_live_buffers():
     """Encoding twice from the same source array must give identical
     parity and leave the source readable: the plan only ever donates
@@ -184,6 +195,9 @@ def test_donation_does_not_alias_live_buffers():
 # -- stripe coalescing ------------------------------------------------------
 
 
+@pytest.mark.skipif(conftest.DEVICE_INJECTION,
+                    reason="asserts live device-dispatch counters/plans;\
+ subject absent under scripted device-fault injection")
 def test_coalescer_folds_ragged_pending_encodes():
     mat = rs.reed_sol_van_matrix(4, 2)
     co = plan.StripeCoalescer(mat, max_pending=8)
@@ -203,6 +217,9 @@ def test_coalescer_folds_ragged_pending_encodes():
     assert sum(p["dispatches"] for p in st["per_plan"].values()) == 1
 
 
+@pytest.mark.skipif(conftest.DEVICE_INJECTION,
+                    reason="asserts live device-dispatch counters/plans;\
+ subject absent under scripted device-fault injection")
 def test_coalescer_groups_by_bucket_so_outliers_do_not_inflate():
     """One wide outlier must not pad every pending small stripe to its
     width — stripes group per byte bucket (the small ones still share
@@ -233,6 +250,9 @@ def test_codec_encode_many_coalesces():
 # -- fused encode + crc -----------------------------------------------------
 
 
+@pytest.mark.skipif(conftest.DEVICE_INJECTION,
+                    reason="asserts live device-dispatch counters/plans;\
+ subject absent under scripted device-fault injection")
 def test_fused_encode_crc_matches_host():
     mat = rs.reed_sol_van_matrix(4, 2)
     data = RNG.integers(0, 256, (3, 4, 500), dtype=np.uint8)
@@ -247,6 +267,9 @@ def test_fused_encode_crc_matches_host():
                 0, chunks[b, c].tobytes())
 
 
+@pytest.mark.skipif(conftest.DEVICE_INJECTION,
+                    reason="asserts live device-dispatch counters/plans;\
+ subject absent under scripted device-fault injection")
 def test_codec_fused_api_applies_seed():
     codec = _codec(k=4, m=2)
     data = RNG.integers(0, 256, (2, 4, 256), dtype=np.uint8)
@@ -286,6 +309,9 @@ def test_encode_with_hinfo_fused_device_tier(monkeypatch):
 # -- observability + the acceptance bound ----------------------------------
 
 
+@pytest.mark.skipif(conftest.DEVICE_INJECTION,
+                    reason="asserts live device-dispatch counters/plans;\
+ subject absent under scripted device-fault injection")
 def test_stats_counters_track_hits_and_misses():
     plan.clear()
     plan.reset_stats()
@@ -302,6 +328,9 @@ def test_stats_counters_track_hits_and_misses():
     assert entry["dispatches"] >= 1 and entry["seconds"] >= 0
 
 
+@pytest.mark.skipif(conftest.DEVICE_INJECTION,
+                    reason="asserts live device-dispatch counters/plans;\
+ subject absent under scripted device-fault injection")
 def test_fixed_profile_256_stripes_compiles_at_most_3_plans():
     """The acceptance bound: encoding 256 stripes of one fixed profile
     — arriving as ragged batches inside one power-of-two bucket plus

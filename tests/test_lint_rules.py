@@ -27,6 +27,7 @@ RULES = [
     "trace-static-hazard",
     "trace-numpy",
     "jit-bypass-plan",
+    "unguarded-device-dispatch",
     "async-blocking",
     "sync-encode-in-async",
     "lock-order",
@@ -37,7 +38,8 @@ RULES = [
 # production modules; point them at their fixture families here
 CONFIG = {"dtype_paths": ("fx_uint8",),
           "plan_paths": ("fx_jit_bypass_plan",),
-          "encode_paths": ("fx_sync_encode_in_async",)}
+          "encode_paths": ("fx_sync_encode_in_async",),
+          "device_paths": ("fx_unguarded_device_dispatch",)}
 
 
 def _fixture(name: str) -> str:

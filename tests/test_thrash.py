@@ -283,6 +283,61 @@ async def _run_thrash(*, seed: int, num_osds: int, osds_per_host: int,
         await cluster.stop()
 
 
+def test_thrash_device_injection_toggle():
+    """Device-fault thrash leg: CEPH_TPU_INJECT_DEVICE_FAIL flips on
+    and off MID-WORKLOAD while client writes and reads keep flowing.
+    The breaker guard must absorb every scripted device failure into
+    the bit-exact host path — zero client-visible op errors — and the
+    final readback must match every acked write byte for byte."""
+    import os
+
+    from ceph_tpu.common import circuit
+
+    async def main():
+        cluster = Cluster(num_osds=4, osds_per_host=1)
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "devinj", {"plugin": "ec_jax",
+                           "technique": "reed_sol_van",
+                           "k": "2", "m": "1",
+                           "crush-failure-domain": "osd"},
+                pg_num=4)
+            ioctx = cluster.client.open_ioctx("devinj")
+            rng = np.random.default_rng(55)
+            model: dict = {}
+            for i in range(18):
+                # flip the fault seam every few ops: on (every
+                # dispatch fails), off (breakers probe + re-close)
+                if i % 6 == 0:
+                    os.environ["CEPH_TPU_INJECT_DEVICE_FAIL"] = "1.0"
+                elif i % 6 == 3:
+                    os.environ.pop("CEPH_TPU_INJECT_DEVICE_FAIL",
+                                   None)
+                    for fam in circuit.FAMILIES:
+                        circuit.breaker(fam).force_probe()
+                oid = f"obj-{i % 5}"
+                data = rng.integers(
+                    0, 256, 3000 + 977 * i,
+                    dtype=np.uint8).tobytes()
+                # a scripted device fault must NEVER fail a write
+                await ioctx.write_full(oid, data)
+                model[oid] = data
+                # ... nor a read issued while injection is active
+                assert await ioctx.read(oid) == data
+            os.environ.pop("CEPH_TPU_INJECT_DEVICE_FAIL", None)
+            for fam in circuit.FAMILIES:
+                circuit.breaker(fam).force_probe()
+            for oid, data in model.items():
+                assert await ioctx.read(oid) == data
+        finally:
+            os.environ.pop("CEPH_TPU_INJECT_DEVICE_FAIL", None)
+            circuit.reset_all()
+            await cluster.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 240))
+
+
 @pytest.mark.slow
 def test_thrash_ec_k2m2():
     asyncio.run(asyncio.wait_for(_run_thrash(
