@@ -18,6 +18,11 @@ async/daemon safety (the mon/osd/mds/rgw asyncio daemons):
                        encode runs ON the event loop instead of
                        riding the micro-batching encode service
                        (osd/encode_service.py)
+  unhedged-gather      bare asyncio.gather over shard sub-op jobs in
+                       ceph_tpu/osd/ outside the hedge primitive
+                       (osd/hedge.py) — the fan-out completes at the
+                       slowest peer's pace; all-shard write/absence
+                       gathers are baselined with justifications
 
 EC dispatch discipline:
   jit-bypass-plan      direct jax.jit on shape-polymorphic EC entry
@@ -551,6 +556,70 @@ def rule_unguarded_device_dispatch(a: Analyzer) -> None:
 
 
 # ---------------------------------------------------------------------
+# unhedged-gather
+# ---------------------------------------------------------------------
+
+# OSD modules whose sub-read/sub-write fan-outs are judged; the hedge
+# primitive itself legitimately gathers (its cancellation drain)
+_GATHER_PATHS = ("ceph_tpu/osd/",)
+_GATHER_EXEMPT = ("osd/hedge.py",)
+# names that mark a function as fanning out shard sub-ops: the
+# sub-read job maker, the request primitive, and the sub-op messages
+_SUBOP_MARKERS = {"_read_candidates", "_request", "MOSDSubRead",
+                  "MOSDSubWrite"}
+
+
+def _scope_subop_markers(mod, root: ast.AST) -> bool:
+    for node in walk_scope(root):
+        if isinstance(node, ast.Name) and node.id in _SUBOP_MARKERS:
+            return True
+        if isinstance(node, ast.Attribute) and \
+                node.attr in _SUBOP_MARKERS:
+            return True
+    return False
+
+
+def rule_unhedged_gather(a: Analyzer) -> None:
+    """Bare `asyncio.gather` over shard sub-op jobs under ceph_tpu/osd/
+    outside the hedge primitive (osd/hedge.py HedgeTracker.gather):
+    the gather inherits the SLOWEST peer's latency — one degraded OSD
+    sets p99 for every read through it — and its tasks are neither
+    ranked by the per-peer EWMAs nor cancellation-managed.  Read-side
+    fan-outs route through `self.hedge.gather`; write-path and
+    absence-proof gathers that MUST stay all-shard (every shard must
+    ack / every source must answer) are baselined with
+    justifications."""
+    paths = a.config.get("gather_paths", _GATHER_PATHS)
+    exempt = a.config.get("gather_exempt", _GATHER_EXEMPT)
+    for mod in a.project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if not any(p in rel for p in paths):
+            continue
+        if any(e in rel for e in exempt):
+            continue
+        for fi in mod.functions.values():
+            if not fi.is_async:
+                continue
+            if not _scope_subop_markers(mod, fi.node):
+                continue
+            for node in walk_scope(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _resolved_callee(mod, node) != "asyncio.gather":
+                    continue
+                a.emit("unhedged-gather", mod, node,
+                       f"bare asyncio.gather over shard sub-ops in "
+                       f"`{fi.qualname}` completes at the SLOWEST "
+                       "peer's pace and leaves tasks unmanaged — "
+                       "route read fan-outs through the hedged "
+                       "first-k primitive (osd/hedge.py "
+                       "HedgeTracker.gather), or baseline all-shard "
+                       "write/absence gathers with a justification",
+                       severity="warning", symbol=fi.qualname,
+                       scope_line=fi.lineno)
+
+
+# ---------------------------------------------------------------------
 # sync-encode-in-async
 # ---------------------------------------------------------------------
 
@@ -684,6 +753,7 @@ def default_rules() -> Dict[str, object]:
         "trace-numpy": rule_trace_numpy,
         "jit-bypass-plan": rule_jit_bypass_plan,
         "unguarded-device-dispatch": rule_unguarded_device_dispatch,
+        "unhedged-gather": rule_unhedged_gather,
         "async-blocking": rule_async_blocking,
         "sync-encode-in-async": rule_sync_encode_in_async,
         "lock-order": rule_lock_order,

@@ -193,6 +193,36 @@ def _opts() -> List[Option]:
         Option("osd_tier_promote_backoff", "secs", 5.0, A,
                desc="cool-down before re-attempting a failed"
                     " promotion of the same object"),
+        # -- hedged reads (straggler-tolerant first-k sub-reads;
+        #    osd/hedge.py — rateless/coded redundancy scheduling) ------
+        Option("osd_hedge_enable", "bool", True, A,
+               desc="hedged first-k EC sub-reads + per-peer latency"
+                    " EWMAs (env kill switch: CEPH_TPU_HEDGE=0)",
+               flags=FLAG_STARTUP),
+        Option("osd_hedge_delta", "uint", 1, A, min=0, max=16,
+               desc="speculative extra sub-reads beyond k in the"
+                    " initial hedged fan-out (escalates by one while"
+                    " the EWMA spread is high)",
+               see_also=("osd_hedge_spread_escalate",)),
+        Option("osd_hedge_ewma_alpha", "float", 0.25, A,
+               min=0.01, max=1.0,
+               desc="EWMA/EW-variance weight per sub-read RTT sample"),
+        Option("osd_hedge_decay_halflife", "secs", 30.0, A,
+               min=0.1, max=3600.0,
+               desc="idle half-life decaying a peer's latency model"
+                    " toward the prior — recovered OSDs re-earn trust"),
+        Option("osd_hedge_rtt_prior_ms", "float", 10.0, A, min=0.0,
+               desc="RTT prior (ms) for unsampled peers and the decay"
+                    " target"),
+        Option("osd_hedge_delay_floor_ms", "float", 2.0, A, min=0.0,
+               desc="minimum straggler mark (ms) before a flight"
+                    " recruits a spare sub-read"),
+        Option("osd_hedge_delay_cap_ms", "float", 1000.0, A, min=1.0,
+               desc="maximum straggler mark (ms) — bounds how long a"
+                    " cold model waits before hedging"),
+        Option("osd_hedge_spread_escalate", "float", 4.0, A, min=1.0,
+               desc="max-p95/min-EWMA ratio across peers beyond which"
+                    " the speculative Δ escalates by one"),
         # -- osd/pg --------------------------------------------------------
         Option("osd_pool_default_size", "uint", 3, B),
         Option("osd_pool_default_min_size", "uint", 0, A),

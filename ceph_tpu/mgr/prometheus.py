@@ -100,7 +100,19 @@ class PrometheusModule(MgrModule):
         # and the consecutive-failure count resets on every success
         "_state_code", "_retry_in_s", "_consecutive",
         "_quarantined_plans",
+        # hedge per-peer latency model leaves: moving estimates, not
+        # monotone counts
+        "_ewma_ms", "_p95_ms",
     )
+
+    # nested maps that become a LABEL instead of exploding the metric
+    # namespace: map-key suffix -> (metric tail, label name)
+    _LABEL_MAPS = {
+        "profiles": ("profile", "profile"),
+        "per_plan": ("profile", "profile"),
+        # the hedge section's per-peer EWMA/breaker model
+        "peers": ("peer", "peer"),
+    }
 
     @classmethod
     def _emit_perf(cls, lines: List[str], seen_types: set,
@@ -111,8 +123,9 @@ class PrometheusModule(MgrModule):
         - numeric/bool: plain counter sample;
         - PerfCounters histogram dump ({buckets, bounds, count, sum}):
           cumulative `_bucket{le=...}` rows + `_count`/`_sum`;
-        - a `profiles`/`per_plan` map: recurse with a `profile` label
-          instead of exploding the metric namespace;
+        - a `profiles`/`per_plan`/`peers` map: recurse with a
+          `profile`/`peer` label instead of exploding the metric
+          namespace (_LABEL_MAPS);
         - any other dict: recurse with _-joined names (the tier /
           plan_cache / encode_service sections).
         Non-numeric leaves (strings, lists) are skipped."""
@@ -147,15 +160,15 @@ class PrometheusModule(MgrModule):
             lines.append(_fmt(f"{metric}_sum", value.get("sum", 0),
                               labels))
             return
-        for special in ("profiles", "per_plan"):
+        for special, (tail, label) in cls._LABEL_MAPS.items():
             suffix = "_" + special
             if not metric.endswith(suffix):
                 continue
-            base = metric[:-len(suffix)] + "_profile"
+            base = metric[:-len(suffix)] + "_" + tail
             for profile, stats in sorted(value.items()):
                 if not isinstance(stats, dict):
                     continue
-                plabels = {**labels, "profile": profile}
+                plabels = {**labels, label: profile}
                 for k, v in sorted(stats.items()):
                     cls._emit_perf(lines, seen_types, f"{base}_{k}",
                                    v, plabels)

@@ -156,7 +156,6 @@ class Connection:
         if self.closed:
             raise ConnectionError(f"connection to {self.peer_name} closed")
         await self.messenger._inject_faults(self)
-        seq = next(self._seq)
         payload = msg.encode()
         flags = 0
         m = self.messenger
@@ -175,19 +174,27 @@ class Connection:
                     payload = _struct.pack(
                         "<i", -1 if cmsg is None else cmsg) + blob
                     flags |= frames.FLAG_COMPRESSED
-        if key is not None and key is self.session_key and \
-                self.messenger.secure:
-            # secure mode: the payload rides AEAD-sealed under the
-            # session key (hellos stay plaintext — they carry no
-            # secrets and exist before the session does)
-            payload = auth.seal(key, self._tx_role(), seq, payload,
-                                peer_aead=self.peer_aead)
-            flags |= frames.FLAG_SECURE
-        parts = frames.encode_frame_parts(msg.TAG, seq,
-                                          payload, flags=flags,
-                                          key=key,
-                                          role=self._tx_role())
         async with self._send_lock:
+            # seq is allocated INSIDE the send lock: a hedged sub-read
+            # may be CANCELLED while waiting for this lock, and a seq
+            # consumed for a frame that never hits the wire would gap
+            # the receiver's replay check (seq != rx_seq + 1 kills the
+            # connection).  Past this point the only await is drain(),
+            # by which time the frame is fully buffered — cancellation
+            # can no longer corrupt framing.
+            seq = next(self._seq)
+            if key is not None and key is self.session_key and \
+                    self.messenger.secure:
+                # secure mode: the payload rides AEAD-sealed under the
+                # session key (hellos stay plaintext — they carry no
+                # secrets and exist before the session does)
+                payload = auth.seal(key, self._tx_role(), seq, payload,
+                                    peer_aead=self.peer_aead)
+                flags |= frames.FLAG_SECURE
+            parts = frames.encode_frame_parts(msg.TAG, seq,
+                                              payload, flags=flags,
+                                              key=key,
+                                              role=self._tx_role())
             for part in parts:
                 self.writer.write(part)
             try:

@@ -32,6 +32,7 @@ import asyncio
 import bisect
 import json
 import logging
+import math
 import os
 import time
 from collections import OrderedDict
@@ -74,6 +75,7 @@ from ceph_tpu.os import ObjectId, ObjectStore, Transaction
 from ceph_tpu.os.memstore import MemStore
 from ceph_tpu.osd import ec_util
 from ceph_tpu.osd.encode_service import EncodeService
+from ceph_tpu.osd.hedge import HedgeTracker
 from ceph_tpu.osd.tier import TierAgent
 from ceph_tpu.osd import scheduler as sched_mod
 from ceph_tpu.osd.osdmap import OSDMap, PgId, TYPE_ERASURE, TYPE_REPLICATED
@@ -342,6 +344,11 @@ class OSDDaemon:
         # PrimaryLogPG agent role); kill switch CEPH_TPU_TIER=0 /
         # osd_tier_enable=false
         self.tier = TierAgent(who=f"osd.{osd_id}", config=self.config)
+        # straggler-tolerant reads: per-peer sub-read latency EWMAs +
+        # the hedged first-k gather primitive (osd/hedge.py); kill
+        # switches CEPH_TPU_HEDGE=0 / osd_hedge_enable=false
+        self.hedge = HedgeTracker(who=f"osd.{osd_id}",
+                                  config=self.config)
         self._promote_tasks: Set[asyncio.Task] = set()
         # watch/notify: (pool, oid) -> {(client, cookie): Connection}
         self.watchers: Dict[Tuple[int, str],
@@ -448,6 +455,11 @@ class OSDDaemon:
                 lambda cmd: self.tier.status(),
                 "read-tier cache occupancy + hit/miss/promote/evict"
                 " counters"),
+            "hedge_status": (
+                lambda cmd: self.hedge.status(),
+                "hedged-read scheduler: per-peer latency EWMAs/p95 +"
+                " breaker states, hedges fired/won, cancelled"
+                " sub-reads, Δ escalation"),
             "hitset_dump": (
                 lambda cmd: self._cmd_hitset_dump(),
                 "per-PG hot-set stacks + persisted hitset omap keys"),
@@ -507,6 +519,10 @@ class OSDDaemon:
         from ceph_tpu.common import circuit
 
         out["device_health"] = circuit.perf_dump()
+        # hedged-read scheduler: counters + the per-peer EWMA model
+        # (the prometheus flattener turns `peers` into peer-labeled
+        # rows)
+        out["hedge"] = self.hedge.perf()
         return out
 
     def _cmd_device_health(self) -> Dict[str, Any]:
@@ -1969,9 +1985,14 @@ class OSDDaemon:
         out: List[Tuple[int, bytes, Dict[str, bytes]]] = []
         definitive = True
         for name in names:
+            t0 = time.monotonic()
             if osd == self.osd_id:
                 rc, data, at = self._read_shard(
                     pg, shard, name, offset if length else 0, length)
+                # the local read feeds the EWMA too: self ranks by its
+                # actual store latency, not a synthetic zero
+                self.hedge.observe(osd, time.monotonic() - t0,
+                                   ok=rc in (0, ENOENT))
                 if rc == 0:
                     out.append((shard, data, at))
                 elif rc != ENOENT:
@@ -1982,6 +2003,17 @@ class OSDDaemon:
                 osd, MOSDSubRead(tid, pg, shard, name, offset, length,
                                  record=record and name == oid),
                 tid)
+            # every sub-read round trip feeds the per-peer latency
+            # model; a timeout/fault charges the peer its full cost
+            # and trips its breaker toward rank-last.  A fast reply
+            # carrying an ERROR rc (EIO from a dying store) is a
+            # fault too — counting it a success would rank the peer
+            # FASTEST while it serves nothing.  (A CANCELLED request
+            # never reaches here — cancelled RTTs would poison the
+            # model with the canceller's impatience.)
+            self.hedge.observe(osd, time.monotonic() - t0,
+                               ok=reply is not None
+                               and reply.rc in (0, ENOENT))
             if reply is not None and reply.rc == 0:
                 self.perf["subread_bytes"] += len(reply.data)
                 out.append((shard, reply.data, reply.attrs))
@@ -1994,7 +2026,10 @@ class OSDDaemon:
             exclude_missing: bool = True,
             include_rollback: bool = False,
             offset: int = 0, length: int = 0,
-            record: bool = False
+            record: bool = False,
+            need: Optional[int] = None,
+            verify_hinfo: bool = False,
+            selection_out: Optional[list] = None
     ) -> Tuple[List[Tuple[int, bytes, Dict[str, bytes]]], bool]:
         """Collect available (shard, payload, attrs) candidates for an
         object from up acting shards, CONCURRENTLY (local read for mine,
@@ -2002,12 +2037,22 @@ class OSDDaemon:
         preserved previous generation; offset/length restrict each
         shard's payload to a chunk range.
 
+        need=k opts the gather into HEDGED mode (osd/hedge.py): the k
+        fastest-ranked shards plus Δ speculative extras launch first,
+        stragglers recruit spares at their peer's p95-EWMA mark, and
+        the gather returns as soon as `need` DISTINCT shards agree on
+        one version (_select_consistent with the same need/
+        verify_hinfo the caller will apply) — stragglers are cancelled
+        and awaited, never leaked.  Recovery/absence probes pass
+        need=None and keep the exhaustive all-shard semantics.
+
         Second return: True iff every acting member was probed and
-        answered definitively (a down member or failed query means the
-        gather proves nothing about absence)."""
+        answered definitively (a down member, failed query, or hedged
+        early completion means the gather proves nothing about
+        absence)."""
         pg = state.pg
         plog = self._load_log(state, pool)
-        jobs = []
+        jobs: List[Tuple[int, Any]] = []
         complete = True
         for idx, osd in enumerate(state.acting):
             shard = idx if pool.type == TYPE_ERASURE else -1
@@ -2028,12 +2073,67 @@ class OSDDaemon:
                 # source — the data stays on disk but is excluded
                 # from selection
                 continue
-            jobs.append(self._read_candidates(
-                pg, shard, osd, oid, include_rollback, offset, length,
-                record=record))
-        results = await asyncio.gather(*jobs) if jobs else []
-        complete = complete and all(ok for _sub, ok in results)
+
+            def job(shard=shard, osd=osd):
+                return self._read_candidates(
+                    pg, shard, osd, oid, include_rollback, offset,
+                    length, record=record)
+
+            jobs.append((osd, job))
+        sufficient = None
+        if need is not None:
+            # CRC verdicts memoized across the gather's completion
+            # waves: the results list keeps every candidate alive, so
+            # id(attrs) keys stay valid for the memo's whole lifetime
+            hinfo_memo: Dict[int, bool] = {}
+
+            def sufficient(results):
+                cands = [c for sub, _ok in results for c in sub]
+                sel = self._select_consistent(
+                    cands, need=need, verify_hinfo=verify_hinfo,
+                    hinfo_memo=hinfo_memo)
+                if sel[0] is None:
+                    return False
+                # hand the winning (version, chosen, oi) back to the
+                # caller: the accepting sufficient() call ran on
+                # exactly the candidates being returned, so hedged
+                # readers skip re-selecting (and re-verifying hinfo
+                # CRCs over) the same payloads
+                if selection_out is not None:
+                    selection_out[:] = [sel]
+                return True
+        results, ran_all = await self.hedge.gather(
+            jobs, need=need, sufficient=sufficient,
+            failed=(lambda res: not res[0])
+            if need is not None else None)
+        complete = complete and ran_all and \
+            all(ok for _sub, ok in results)
         return [c for sub, _ok in results for c in sub], complete
+
+    async def _gather_and_select(
+            self, state: PGState, pool, oid: str, *, need: int,
+            verify_hinfo: bool = False, offset: int = 0,
+            length: int = 0, record: bool = False
+    ) -> Tuple[List[Tuple[int, bytes, Dict[str, bytes]]], bool,
+               Optional[tuple], Dict[int, bytes], Optional[dict]]:
+        """Hedged gather + consistent selection in ONE step:
+        (candidates, complete, version, chosen, oi).  The selection
+        from the gather's accepting sufficiency check is reused when
+        the gather exited early (it ran on exactly the returned
+        candidates) and recomputed otherwise (all-shard mode, kill
+        switch, insufficient) — the reuse-or-recompute contract lives
+        here once, not at every read site."""
+        sel: list = []
+        candidates, complete = await self._gather_object_shards(
+            state, pool, oid, offset=offset, length=length,
+            record=record, need=need, verify_hinfo=verify_hinfo,
+            selection_out=sel)
+        if not candidates:
+            return [], complete, None, {}, None
+        version, chosen, oi = sel[0] if sel else \
+            self._select_consistent(candidates, need=need,
+                                    verify_hinfo=verify_hinfo)
+        return candidates, complete, version, chosen, oi
 
     async def _gather_stray_shards(
             self, state: PGState, pool, oid: str,
@@ -2070,6 +2170,28 @@ class OSDDaemon:
         complete = complete and all(ok for _sub, ok in results)
         return [c for sub, _ok in results for c in sub], complete
 
+    def _shard_rank(self, state: PGState):
+        """Shard-index sort key fed by the hedge tracker's per-peer
+        EWMAs: survivor-set choices (decode inputs, recovery's
+        chosen-k) prefer shards whose source OSDs are currently
+        fastest, degraded peers last.  The EWMA is quantized to
+        OCTAVES here — the live model decays and takes samples
+        between two calls in the same recovery wave, and a raw-float
+        key would let that jitter normalize identical survivor sets
+        differently and split decode_many's batches; only a genuine
+        (2x) speed difference may reorder shards."""
+        acting = list(state.acting)
+
+        def key(shard: int) -> tuple:
+            osd = acting[shard] if 0 <= shard < len(acting) \
+                else CRUSH_ITEM_NONE
+            if osd == CRUSH_ITEM_NONE:
+                return (2, 1 << 30, shard)
+            degraded, ewma, _osd = self.hedge.rank_key(osd)
+            return (degraded, int(math.log2(max(ewma, 1e-6))), shard)
+
+        return key
+
     @staticmethod
     def _oi_version(at: Dict[str, bytes]) -> Optional[tuple]:
         try:
@@ -2081,7 +2203,8 @@ class OSDDaemon:
 
     def _select_consistent(
             self, candidates: List[Tuple[int, bytes, Dict[str, bytes]]],
-            need: int, verify_hinfo: bool = False
+            need: int, verify_hinfo: bool = False,
+            hinfo_memo: Optional[Dict[int, bool]] = None
     ) -> Tuple[Optional[tuple], Dict[int, bytes], Optional[dict]]:
         """Newest object version reconstructible from >= need distinct
         shards.
@@ -2093,6 +2216,13 @@ class OSDDaemon:
         completed write (the role of ECBackend's rollback-aware log).
         Returns (version, {shard: payload}, object_info) or
         (None, {}, None).
+
+        hinfo_memo (id(attrs) -> verdict) lets a caller that re-runs
+        selection over a growing candidate list — the hedged gather's
+        sufficiency check, once per completion wave — pay each
+        payload's CRC verification once instead of once per wave.
+        Only valid while the caller keeps the candidate tuples alive
+        (id() reuse) and candidates are immutable, both true there.
         """
         groups: Dict[tuple, Dict[int, bytes]] = {}
         ois: Dict[tuple, dict] = {}
@@ -2103,7 +2233,14 @@ class OSDDaemon:
             if verify_hinfo:
                 if HINFO_ATTR not in at:
                     continue  # EC shard without its ledger: suspicious
-                if not _hinfo_chunk_ok(at, shard, payload):
+                if hinfo_memo is None:
+                    ok = _hinfo_chunk_ok(at, shard, payload)
+                else:
+                    ok = hinfo_memo.get(id(at))
+                    if ok is None:
+                        ok = hinfo_memo[id(at)] = _hinfo_chunk_ok(
+                            at, shard, payload)
+                if not ok:
                     continue  # corrupt shard: erasure
             groups.setdefault(version, {}).setdefault(shard, payload)
             ois.setdefault(version, json.loads(at[OI_ATTR]))
@@ -2134,15 +2271,14 @@ class OSDDaemon:
         """(object_info | None, snapset) of the head via a 1-byte
         ranged gather (attrs ride along).  Raises UnfoundObject when
         the head exists per the log but no copy is locatable."""
-        candidates, _complete = await self._gather_object_shards(
-            state, pool, oid, offset=0, length=1)
+        need = self._codec(pool.id).get_data_chunk_count() \
+            if pool.type == TYPE_ERASURE else 1
+        candidates, _complete, version, chosen, oi = \
+            await self._gather_and_select(state, pool, oid,
+                                          need=need, length=1)
         if not candidates:
             self._block_if_unfound(state, pool, oid)
             return None, {"seq": 0, "clones": []}
-        need = self._codec(pool.id).get_data_chunk_count() \
-            if pool.type == TYPE_ERASURE else 1
-        version, chosen, oi = self._select_consistent(candidates,
-                                                      need=need)
         if version is None:
             self._block_if_unfound(state, pool, oid)
             return None, {"seq": 0, "clones": []}
@@ -2626,10 +2762,14 @@ class OSDDaemon:
             if version is None:
                 return False  # genuinely below k: recovery/rollback
                 # adjudication owns this on the next peering
+            try:
+                chosen_k = ec_util.fastest_survivors(
+                    codec, chosen, k, prefer=self._shard_rank(state))
+            except Exception:
+                chosen_k = {s: chosen[s] for s in sorted(chosen)[:k]}
             plan = {"kind": "ec", "oid": oid, "targets": targets,
                     "i_need": True, "guard": guard,
-                    "chosen": {s: chosen[s]
-                               for s in sorted(chosen)[:k]},
+                    "chosen": chosen_k,
                     "attrs": attrs_of(version, chosen), "omap": None}
             if not await self._batch_reconstruct(pool, [plan]):
                 return False
@@ -2951,9 +3091,15 @@ class OSDDaemon:
                 " located %s, probes incomplete — possible source"
                 " down)", self.osd_id, pg, oid, need_v, version)
             return None
-        # normalize to the first k shards (what decode consumes) so
-        # equal survivor sets batch together
-        chosen_k = {s: chosen[s] for s in sorted(chosen)[:k]}
+        # normalize to k shards (what decode consumes) pulled from the
+        # FASTEST survivor set — the hedge tracker's EWMA rank is
+        # stable across a wave, so equal survivor sets batch together
+        # exactly as the old first-k normalization did
+        try:
+            chosen_k = ec_util.fastest_survivors(
+                codec, chosen, k, prefer=self._shard_rank(state))
+        except Exception:
+            chosen_k = {s: chosen[s] for s in sorted(chosen)[:k]}
         return {"kind": "ec", "oid": oid, "targets": targets,
                 "i_need": i_need, "chosen": chosen_k, "guard": guard,
                 "attrs": _attrs_of(version, chosen), "omap": None}
@@ -3800,16 +3946,16 @@ class OSDDaemon:
             # shards and reconstruct the span
             chunk_off = (start // width) * chunk
             chunk_len = (span // width) * chunk
-            candidates, _complete = await self._gather_object_shards(
-                state, pool, oid, offset=chunk_off, length=chunk_len)
+            candidates, _complete, version, good, oi = \
+                await self._gather_and_select(
+                    state, pool, oid, need=k, offset=chunk_off,
+                    length=chunk_len)
             # an unfound object must not be zero-filled and overwritten
             # as if it never existed — block the write like the reads
             if not candidates:
                 self._block_if_unfound(state, pool, oid)
             merged = bytearray(span)
             if candidates:
-                version, good, oi = self._select_consistent(
-                    candidates, need=k)
                 if version is None:
                     self._block_if_unfound(state, pool, oid)
                     self._schedule_object_repair(state, pool, oid)
@@ -3824,11 +3970,12 @@ class OSDDaemon:
                     max(0, (old_padded // width) * chunk
                         - chunk_off))
                 if frag_len > 0:
-                    want = {codec.chunk_index(i) for i in range(k)}
-                    minimum = codec.minimum_to_decode(want, set(good))
+                    chosen_frags = ec_util.fastest_survivors(
+                        codec, good, k,
+                        prefer=self._shard_rank(state))
                     frags = {}
-                    for s in minimum:
-                        buf = good[s][:frag_len]
+                    for s, payload in chosen_frags.items():
+                        buf = payload[:frag_len]
                         if len(buf) < frag_len:
                             buf = buf + bytes(frag_len - len(buf))
                         frags[s] = buf
@@ -4128,13 +4275,12 @@ class OSDDaemon:
                     return 0, data
                 if rc == ENOENT:
                     return ENOENT, b""
-            candidates, _complete = await self._gather_object_shards(
-                state, pool, oid, record=tracked)
+            candidates, _complete, version, chosen, oi = \
+                await self._gather_and_select(state, pool, oid,
+                                              need=1, record=tracked)
             if not candidates:
                 self._block_if_unfound(state, pool, oid)
                 return ENOENT, b""
-            version, chosen, oi = self._select_consistent(
-                candidates, need=1)
             if version is None:
                 self._block_if_unfound(state, pool, oid)
                 return EIO, b""
@@ -4163,14 +4309,13 @@ class OSDDaemon:
                 (offset, length))
             chunk_off = (start // width) * chunk
             chunk_len = (span // width) * chunk
-            candidates, _complete = await self._gather_object_shards(
-                state, pool, oid, offset=chunk_off, length=chunk_len,
-                record=tracked)
+            candidates, _complete, version, good, oi = \
+                await self._gather_and_select(
+                    state, pool, oid, need=k, offset=chunk_off,
+                    length=chunk_len, record=tracked)
             if not candidates:
                 self._block_if_unfound(state, pool, oid)
                 return ENOENT, b""
-            version, good, oi = self._select_consistent(
-                candidates, need=k)
             if version is None:
                 self._block_if_unfound(state, pool, oid)
                 # clean PG but no k-agreement: a soft-failed write
@@ -4188,14 +4333,14 @@ class OSDDaemon:
                            max(0, (padded // width) * chunk - chunk_off))
             if frag_len <= 0:
                 return 0, b""
-            want = {codec.chunk_index(i) for i in range(k)}
             try:
-                minimum = codec.minimum_to_decode(want, set(good))
+                chosen_frags = ec_util.fastest_survivors(
+                    codec, good, k, prefer=self._shard_rank(state))
             except Exception:
                 return EIO, b""
             frags = {}
-            for s in minimum:
-                buf = good[s][:frag_len]
+            for s, payload in chosen_frags.items():
+                buf = payload[:frag_len]
                 if len(buf) < frag_len:
                     buf += bytes(frag_len - len(buf))
                 frags[s] = buf
@@ -4204,15 +4349,15 @@ class OSDDaemon:
                                                     frags)
             rel = offset - start
             return 0, data[rel:rel + min(length, size - offset)]
-        candidates, _complete = await self._gather_object_shards(
-            state, pool, oid, record=tracked)
+        # newest version with >= k intact same-version shards wins;
+        # hinfo crc drops corrupt shards (handle_sub_read's verify)
+        candidates, _complete, version, good, oi = \
+            await self._gather_and_select(state, pool, oid, need=k,
+                                          verify_hinfo=True,
+                                          record=tracked)
         if not candidates:
             self._block_if_unfound(state, pool, oid)
             return ENOENT, b""
-        # newest version with >= k intact same-version shards wins;
-        # hinfo crc drops corrupt shards (handle_sub_read's verify)
-        version, good, oi = self._select_consistent(
-            candidates, need=k, verify_hinfo=True)
         if version is None:
             self._block_if_unfound(state, pool, oid)
             self._schedule_object_repair(state, pool, oid)
@@ -4221,14 +4366,13 @@ class OSDDaemon:
         if oi.get("whiteout"):
             return ENOENT, b""
         size = oi.get("size", 0)
-        want = {codec.chunk_index(i) for i in range(k)}
         try:
-            minimum = codec.minimum_to_decode(want, set(good))
+            frags = ec_util.fastest_survivors(
+                codec, good, k, prefer=self._shard_rank(state))
         except Exception:
             return EIO, b""
         self.perf["decode_dispatches"] += 1
-        data = await self.encode_service.decode(
-            sinfo, codec, {s: good[s] for s in minimum if s in good})
+        data = await self.encode_service.decode(sinfo, codec, frags)
         data = data[:size]
         if length:
             data = data[offset:offset + length]
@@ -4239,16 +4383,16 @@ class OSDDaemon:
     async def _op_stat(self, state: PGState, pool, oid: str
                        ) -> Tuple[int, Dict[str, Any]]:
         # stat needs attrs + version agreement only: fetch one byte per
-        # shard, not the whole payload
-        candidates, _complete = await self._gather_object_shards(
-            state, pool, oid, offset=0, length=1)
+        # shard, not the whole payload — and only the first `need`
+        # consistent answers (hedged)
+        need = self._codec(pool.id).get_data_chunk_count() \
+            if pool.type == TYPE_ERASURE else 1
+        candidates, _complete, version, _chosen, oi = \
+            await self._gather_and_select(state, pool, oid,
+                                          need=need, length=1)
         if not candidates:
             self._block_if_unfound(state, pool, oid)
             return ENOENT, {}
-        need = self._codec(pool.id).get_data_chunk_count() \
-            if pool.type == TYPE_ERASURE else 1
-        version, _chosen, oi = self._select_consistent(
-            candidates, need=need)
         if version is None:
             self._block_if_unfound(state, pool, oid)
             return EIO, {}
@@ -4365,15 +4509,14 @@ class OSDDaemon:
 
     async def _gather_user_attrs(self, state: PGState, pool, oid: str
                                  ) -> Tuple[int, Dict[str, bytes]]:
-        candidates, _complete = await self._gather_object_shards(
-            state, pool, oid, offset=0, length=1)
+        need = self._codec(pool.id).get_data_chunk_count() \
+            if pool.type == TYPE_ERASURE else 1
+        candidates, _complete, version, chosen, oi = \
+            await self._gather_and_select(state, pool, oid,
+                                          need=need, length=1)
         if not candidates:
             self._block_if_unfound(state, pool, oid)
             return ENOENT, {}
-        need = self._codec(pool.id).get_data_chunk_count() \
-            if pool.type == TYPE_ERASURE else 1
-        version, chosen, oi = self._select_consistent(candidates,
-                                                      need=need)
         if version is None:
             self._block_if_unfound(state, pool, oid)
             return EIO, {}

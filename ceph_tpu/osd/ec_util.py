@@ -455,6 +455,40 @@ def encode_many(sinfo: StripeInfo, ec_impl, datas,
     return out
 
 
+def fastest_survivors(ec_impl, have: Mapping[int, bytes], k: int,
+                      prefer=None) -> Dict[int, bytes]:
+    """Choose a decodable subset of survivor shard streams.
+
+    The payloads are already fetched, so decode COST dominates the
+    choice: available data shards always rank first (all-data decode
+    is a free interleave — no GF dispatch), and only the erasure
+    fill-ins among parity shards follow the caller's rank order
+    (fastest peers first — the hedge tracker's EWMA ranking feeds
+    `prefer`; the fetch-side fan-out is where EWMAs buy latency).
+
+    Grows the candidate set in that order until the codec's
+    minimum_to_decode accepts it, then returns exactly the minimum
+    streams.  Deterministic for a fixed rank, so objects decoded in
+    the same wave keep sharing survivor sets (the decode_many
+    batching key).  Raises the codec's error when even the full
+    survivor set cannot decode — the caller's below-k handling owns
+    that, same as a direct minimum_to_decode call."""
+    if not have:
+        raise ValueError("no survivors")
+    want = {ec_impl.chunk_index(i) for i in range(k)}
+    rank = prefer if prefer is not None else (lambda s: (s,))
+    order = sorted(have, key=lambda s: (s not in want, rank(s)))
+    for j in range(min(k, len(order)), len(order) + 1):
+        try:
+            minimum = ec_impl.minimum_to_decode(want, set(order[:j]))
+        except Exception:
+            if j >= len(order):
+                raise
+            continue
+        return {i: have[i] for i in minimum}
+    raise AssertionError("unreachable")  # loop returns or re-raises
+
+
 def decode_many(sinfo: StripeInfo, ec_impl,
                 maps) -> List[bytes]:
     """N decode requests (same profile) -> logical byte streams.

@@ -1,0 +1,17 @@
+"""Violation: a bare asyncio.gather over sub-read jobs completes at
+the SLOWEST peer's pace — one degraded OSD sets p99 for every read
+through this fan-out — and the spawned tasks are neither EWMA-ranked
+nor cancellation-managed."""
+
+import asyncio
+
+
+class Reader:
+    async def fetch_shards(self, pg, oid, acting):
+        jobs = [self._read_candidates(pg, shard, osd, oid)
+                for shard, osd in enumerate(acting)]
+        results = await asyncio.gather(*jobs)  # expect: unhedged-gather
+        return [c for sub, _ok in results for c in sub]
+
+    async def _read_candidates(self, pg, shard, osd, oid):
+        return [], True

@@ -1,0 +1,19 @@
+"""Clean twin: the sub-read fan-out rides the hedged first-k gather
+primitive — EWMA-ranked launch order, delayed hedges at the p95 mark,
+stragglers cancelled AND awaited."""
+
+
+class Reader:
+    async def fetch_shards(self, pg, oid, acting, need):
+        jobs = [(osd,
+                 lambda shard=shard, osd=osd: self._read_candidates(
+                     pg, shard, osd, oid))
+                for shard, osd in enumerate(acting)]
+        results, _ran_all = await self.hedge.gather(
+            jobs, need=need,
+            sufficient=lambda rs: sum(len(s) for s, _ok in rs) >= need,
+            failed=lambda res: not res[0])
+        return [c for sub, _ok in results for c in sub]
+
+    async def _read_candidates(self, pg, shard, osd, oid):
+        return [], True

@@ -21,7 +21,7 @@ import bench  # noqa: E402
 
 CONTRACT_KEYS = {"metric", "value", "unit", "vs_baseline",
                  "plan_cache", "encode_service", "tier",
-                 "device_health", "truncated"}
+                 "device_health", "tail", "truncated"}
 
 
 def test_contract_line_despite_hanging_backend(tmp_path):
@@ -71,6 +71,14 @@ def test_contract_line_despite_hanging_backend(tmp_path):
     assert contract["device_health"]["failures"] >= 1
     assert contract["device_health"]["probes"] >= 1
     assert contract["device_health"]["recovered"] == 1
+    # the hedge probe ran: the need=4 gather completed from the first
+    # four distinct arrivals, the 1 s stragglers were hedged around
+    # and cancelled, and nothing leaked
+    assert contract["tail"]["completed_shards"] >= 4
+    assert contract["tail"]["straggler_avoided"] == 1
+    assert contract["tail"]["hedges_fired"] >= 1
+    assert contract["tail"]["cancelled_subreads"] >= 1
+    assert contract["tail"]["leaked_tasks"] == 0
     assert contract["truncated"] is False
     # details stayed out of stdout (they belong in bench_details.json)
     assert len(stdout_lines) == 1
@@ -115,6 +123,48 @@ def test_budget_truncates_optional_sections(tmp_path):
     details = json.loads((tmp_path / "bench_details.json").read_text())
     assert details["truncated"] is True
     assert details["skipped_sections"]
+
+
+def test_watchdog_contract_line_survives_outer_kill(tmp_path):
+    """The BENCH_r05 rc=124 regression: a bench body that WEDGES in a
+    mandatory stage under a tiny wall-clock budget must still flush a
+    parseable (truncated) contract line via the deadline watchdog
+    BEFORE the outer harness timeout kills the process."""
+    env = dict(os.environ)
+    env.update({
+        "CEPH_TPU_BENCH_PROBE": "print('cpu')",
+        "CEPH_TPU_BENCH_SMOKE": "1",
+        "CEPH_TPU_BENCH_BUDGET": "1",         # artificially tiny
+        "CEPH_TPU_BENCH_WATCHDOG_MARGIN": "2",
+        "CEPH_TPU_BENCH_STALL_S": "120",      # the wedge
+    })
+    proc = subprocess.Popen([sys.executable, BENCH],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True,
+                            cwd=str(tmp_path), env=env)
+    # wait only until the watchdog's line actually lands (~budget +
+    # margin = 3 s), then play the harness and kill the stalled
+    # process — no need to burn the whole stall on the clock
+    import threading
+
+    box: dict = {}
+
+    def reader():
+        box["line"] = proc.stdout.readline()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    t.join(45)
+    proc.kill()
+    proc.wait()
+    assert proc.returncode != 0  # the outer kill DID happen (rc=124 shape)
+    line = box.get("line", "")
+    assert line.strip(), "no contract line before the kill"
+    contract = json.loads(line)
+    assert set(contract) == CONTRACT_KEYS
+    assert contract["metric"] == "ec_jax_encode_k8m3_4MiB_stripe"
+    assert contract["truncated"] is True
+    assert contract["value"] is None  # no measurement this round
 
 
 def test_probe_timeout_contained():

@@ -1,0 +1,509 @@
+"""Hedged-read tier: per-peer latency EWMAs, the hedged first-k
+gather primitive, cancellation safety (no leaked tasks, no corrupted
+connection framing), survivor-set ranking, and the live-cluster
+integration under an injected slow OSD.
+
+The core claims under test:
+1. a hedged gather completes from the first k DISTINCT arrivals and
+   cancels stragglers without leaking a single asyncio task;
+2. hedged and unhedged reads are bit-identical (hedging changes WHEN
+   enough arrivals exist, never what is decoded from them);
+3. a sub-read cancelled mid-send can never corrupt connection framing
+   (frame seqs are allocated under the send lock);
+4. slow peers are learned (EWMA), ranked last, and re-earn trust by
+   decay; faulting peers rank last via their breaker.
+"""
+
+import asyncio
+import os
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osd import ec_util
+from ceph_tpu.osd.hedge import HedgeTracker, PeerStats
+
+from cluster_helpers import Cluster
+
+EC_PROFILE = {"plugin": "ec_jax", "technique": "reed_sol_van",
+              "k": "2", "m": "2", "crush-failure-domain": "osd"}
+
+
+def run(coro, timeout=240):
+    asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+# -- the latency model -----------------------------------------------------
+
+
+def test_ewma_learns_and_decays_toward_prior():
+    now = [0.0]
+    st = PeerStats(3, alpha=0.5, halflife=10.0, prior=0.010,
+                   clock=lambda: now[0])
+    for _ in range(20):
+        now[0] += 0.001
+        st.observe(0.200)
+    assert st.ewma > 0.15          # learned: this peer is slow
+    assert st.p95() >= st.ewma
+    # idle for two half-lives: trust is re-earned toward the prior
+    now[0] += 20.0
+    assert st.ewma_now() < 0.06
+    now[0] += 200.0
+    assert abs(st.ewma_now() - 0.010) < 0.002
+
+
+def test_failures_trip_breaker_and_rank_last():
+    now = [0.0]
+    tr = HedgeTracker("t", clock=lambda: now[0])
+    for osd, rtt in ((1, 0.001), (2, 0.005), (3, 0.002)):
+        for _ in range(3):
+            now[0] += 0.01
+            tr.observe(osd, rtt)
+    # peer 1 is fastest...
+    order = sorted([1, 2, 3], key=tr.rank_key)
+    assert order == [1, 3, 2]
+    # ...until its sub-reads fault: breaker degrades it to rank-last
+    for _ in range(4):
+        now[0] += 0.01
+        tr.observe(1, 5.0, ok=False)
+    assert tr.peer(1).degraded()
+    order = sorted([1, 2, 3], key=tr.rank_key)
+    assert order[-1] == 1
+    # backoff expiry restores normal (EWMA) ranking — trust re-earned
+    now[0] += 3600.0
+    assert not tr.peer(1).degraded()
+    # ...but a STILL-dead peer re-trips on its next failure (the
+    # expired-open sub-read plays the half-open probe), with an
+    # escalated backoff — it can never be reported healthy forever
+    now[0] += 0.01
+    tr.observe(1, 5.0, ok=False)
+    assert tr.peer(1).degraded()
+    # and one genuine success re-closes it for good
+    now[0] += 3600.0
+    tr.observe(1, 0.002, ok=True)
+    assert not tr.peer(1).degraded()
+    assert tr.peer(1).breaker.state == "closed"
+
+
+def test_censored_cancel_never_teaches_fast():
+    """A straggler cancelled the instant faster peers answer must NOT
+    learn the winners' latency (it would rank among the fastest and
+    tax every later read); only elapsed time EXCEEDING its estimate
+    ratchets the model up.  The breaker sees neither direction — a
+    lost race is not evidence of peer health."""
+    now = [0.0]
+    st = PeerStats(7, alpha=0.5, halflife=1e9, prior=0.010,
+                   clock=lambda: now[0])
+    st.observe_censored(0.001)     # cancelled at the winner's 1 ms
+    assert st.ewma == 0.010 and st.samples == 0
+    st.observe_censored(0.050)     # outlived its hedge mark
+    assert st.ewma > 0.010 and st.samples == 1
+    stats = st.breaker.stats()
+    assert stats["successes"] == 0 and stats["failures"] == 0
+
+
+def test_spread_escalates_delta():
+    tr = HedgeTracker("t", {"osd_hedge_delta": 1,
+                            "osd_hedge_spread_escalate": 4.0})
+    for _ in range(4):
+        tr.observe(1, 0.001)
+        tr.observe(2, 0.200)
+    assert tr.spread() > 4.0
+    assert tr.effective_delta() == 2
+    assert tr.counters["escalations"] >= 1
+
+
+# -- the gather primitive --------------------------------------------------
+
+
+def _sub(shard, delay, ok=True):
+    async def job():
+        await asyncio.sleep(delay)
+        if not ok:
+            return [], False
+        return [(shard, bytes([shard % 256]), {})], True
+    return job
+
+
+def _distinct(results):
+    return {c[0] for sub, _ok in results for c in sub}
+
+
+def test_gather_first_k_completes_and_cancels_stragglers():
+    async def main():
+        tr = HedgeTracker("t", {"osd_hedge_delay_floor_ms": 5.0})
+        delays = {0: 0.001, 1: 0.001, 2: 0.001, 3: 1.0, 4: 1.0,
+                  5: 0.001}
+        jobs = [(o, _sub(o, delays[o])) for o in range(6)]
+        t0 = time.perf_counter()
+        results, ran_all = await tr.gather(
+            jobs, need=4,
+            sufficient=lambda rs: len(_distinct(rs)) >= 4,
+            failed=lambda r: not r[0])
+        dt = time.perf_counter() - t0
+        assert len(_distinct(results)) >= 4
+        assert dt < 0.5, "gather waited for the 1s stragglers"
+        assert ran_all is False  # early exit cannot claim completeness
+        assert tr.counters["hedges_fired"] >= 1
+        assert tr.counters["cancelled_subreads"] >= 1
+        # the no-leak guarantee: nothing spawned survives the gather
+        leaked = [t for t in asyncio.all_tasks()
+                  if t is not asyncio.current_task()
+                  and t.get_name().startswith("hedge:")
+                  and not t.done()]
+        assert not leaked
+        # cancelled stragglers fed their elapsed time: the model
+        # learned they are at least hedge-delay slow
+        assert tr.peer(3).samples + tr.peer(4).samples >= 1
+
+    run(main())
+
+
+def test_gather_failed_result_recruits_spare():
+    async def main():
+        tr = HedgeTracker("t", {"osd_hedge_delta": 0,
+                                "osd_hedge_delay_floor_ms": 500.0})
+        # delta=0: exactly k launch; peer 1 faults fast, and the spare
+        # (peer 2) must be recruited IMMEDIATELY by the failed
+        # predicate, not after the 500 ms hedge timer
+        jobs = [(0, _sub(0, 0.001)), (1, _sub(1, 0.002, ok=False)),
+                (2, _sub(2, 0.001))]
+        t0 = time.perf_counter()
+        results, _ran = await tr.gather(
+            jobs, need=2,
+            sufficient=lambda rs: len(_distinct(rs)) >= 2,
+            failed=lambda r: not r[0])
+        assert len(_distinct(results)) >= 2
+        assert time.perf_counter() - t0 < 0.4
+
+    run(main())
+
+
+def test_gather_widens_on_insufficient_non_failed_results():
+    """Results the `failed` predicate accepts but the sufficiency
+    predicate rejects (hinfo-corrupt payloads, version-divergent
+    shards) must WIDEN the fan-out to the remaining ranked spares —
+    not strand them unqueried and fail a readable object."""
+    async def main():
+        tr = HedgeTracker("t", {"osd_hedge_delta": 1,
+                                "osd_hedge_delay_floor_ms": 500.0})
+        # jobs 0-2 all return (divergent copies of) shard 0; only the
+        # never-initially-launched job 3 holds the second distinct
+        # shard.  need=2 + delta=1 launches 0-2; all complete fast,
+        # non-failed, insufficient — the gather must recruit job 3
+        # well before the 500 ms hedge timer could
+        jobs = [(o, _sub(0, 0.001)) for o in range(3)] + \
+            [(3, _sub(1, 0.001))]
+        t0 = time.perf_counter()
+        results, ran_all = await tr.gather(
+            jobs, need=2,
+            sufficient=lambda rs: len(_distinct(rs)) >= 2,
+            failed=lambda r: not r[0])
+        assert len(_distinct(results)) >= 2
+        assert time.perf_counter() - t0 < 0.4
+        assert ran_all is True  # every job ran in the end
+
+    run(main())
+
+
+def test_gather_runs_all_when_insufficient():
+    """An absent object: every shard answers definitively-empty; the
+    gather must run EVERY job and report completeness."""
+    async def main():
+        tr = HedgeTracker("t")
+
+        def empty(shard):
+            async def job():
+                await asyncio.sleep(0.001)
+                return [], True
+            return job
+
+        jobs = [(o, empty(o)) for o in range(5)]
+        results, ran_all = await tr.gather(
+            jobs, need=3, sufficient=lambda rs: False,
+            failed=lambda r: not r[0])
+        assert len(results) == 5
+        assert ran_all is True
+
+    run(main())
+
+
+def test_gather_all_shard_modes():
+    """need=None and the kill switch both run every job (bare-gather
+    parity), with managed task names."""
+    async def main():
+        jobs = [(o, _sub(o, 0.001)) for o in range(4)]
+        tr = HedgeTracker("t")
+        results, ran_all = await tr.gather(jobs)  # need=None
+        assert len(results) == 4 and ran_all
+        os.environ["CEPH_TPU_HEDGE"] = "0"
+        try:
+            tr2 = HedgeTracker("t")
+            assert not tr2.enabled
+            results, ran_all = await tr2.gather(
+                [(o, _sub(o, 0.001)) for o in range(4)], need=2,
+                sufficient=lambda rs: len(_distinct(rs)) >= 2)
+            assert len(results) == 4 and ran_all
+            assert tr2.counters["hedged_gathers"] == 0
+        finally:
+            os.environ.pop("CEPH_TPU_HEDGE", None)
+
+    run(main())
+
+
+def test_gather_propagates_caller_cancellation():
+    async def main():
+        tr = HedgeTracker("t")
+        jobs = [(o, _sub(o, 5.0)) for o in range(4)]
+        task = asyncio.get_running_loop().create_task(tr.gather(
+            jobs, need=2,
+            sufficient=lambda rs: len(_distinct(rs)) >= 2))
+        await asyncio.sleep(0.05)
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        leaked = [t for t in asyncio.all_tasks()
+                  if t is not asyncio.current_task()
+                  and t.get_name().startswith("hedge:")
+                  and not t.done()]
+        assert not leaked
+        # external cancellation charges nobody: the elapsed time is
+        # the canceller's impatience, not the peers' latency
+        assert all(st.samples == 0 for st in tr.peers.values())
+
+    run(main())
+
+
+# -- survivor-set ranking --------------------------------------------------
+
+
+class _FakeCodec:
+    """minimum_to_decode needing `require` in the set (widening)."""
+
+    def __init__(self, k, require=None):
+        self.k = k
+        self.require = require
+
+    def chunk_index(self, i):
+        return i
+
+    def minimum_to_decode(self, want, have):
+        if self.require is not None and self.require not in have:
+            raise ValueError(f"need shard {self.require}")
+        out = set()
+        for s in sorted(have):
+            if len(out) >= self.k:
+                break
+            out.add(s)
+        return out
+
+
+def test_fastest_survivors_data_first_then_rank():
+    codec = _FakeCodec(2)
+    rank = {5: 0, 4: 1, 3: 2, 2: 3, 1: 4, 0: 5}
+    # all data shards present: the free all-data decode always wins —
+    # EWMA rank must never trade a free interleave for a GF dispatch
+    have = {s: bytes([s]) for s in range(6)}
+    out = ec_util.fastest_survivors(codec, have, 2,
+                                    prefer=lambda s: rank[s])
+    assert set(out) == {0, 1}
+    # one data shard missing: the FASTEST-ranked parity fills in
+    have2 = {s: bytes([s]) for s in (0, 2, 3, 4, 5)}
+    out2 = ec_util.fastest_survivors(codec, have2, 2,
+                                     prefer=lambda s: rank[s])
+    assert set(out2) == {0, 5}
+
+
+def test_fastest_survivors_widens_and_raises():
+    # the codec insists on (slow-ranked) shard 2: the preferred
+    # subsets are infeasible and the helper widens until it joins
+    codec = _FakeCodec(2, require=2)
+    rank = {5: 0, 4: 1, 3: 2, 2: 3, 1: 4, 0: 5}
+    have = {s: bytes([s]) for s in (0, 2, 3, 4, 5)}
+    out = ec_util.fastest_survivors(codec, have, 2,
+                                    prefer=lambda s: rank[s])
+    assert 2 in out
+    # infeasible even at the full set: the codec's error propagates
+    with pytest.raises(ValueError):
+        ec_util.fastest_survivors(
+            _FakeCodec(2, require=9), have, 2)
+
+
+# -- cancellation vs connection framing ------------------------------------
+
+
+def test_cancelled_send_does_not_corrupt_framing():
+    """A send cancelled while queued behind the connection send lock
+    must not consume a frame seq: on a keyed connection the receiver
+    enforces seq continuity, and a gapped seq kills the link (the
+    failure mode hedged cancellation would hit constantly)."""
+    from ceph_tpu.common import auth as auth_mod
+    from ceph_tpu.msg import Messenger
+    from ceph_tpu.msg.messages import MOSDOp, MOSDOpReply, OSDOp
+    from ceph_tpu.osd.osdmap import PgId
+
+    async def main():
+        secret = auth_mod.generate_secret()
+        server = Messenger("osd.0",
+                           secret=auth_mod.parse_secret(secret))
+        client = Messenger("client.1",
+                           secret=auth_mod.parse_secret(secret))
+        got = asyncio.Queue()
+
+        async def server_dispatch(conn, msg):
+            await conn.send(MOSDOpReply(msg.tid, 0, b"ok"))
+
+        server.dispatcher = server_dispatch
+        client.dispatcher = lambda c, m: got.put(m)
+        addr = await server.bind()
+        try:
+            conn = await client.connect(addr)
+
+            def op(tid):
+                return MOSDOp(tid, "client.1", PgId(1, 0), "o",
+                              [OSDOp("write", data=b"x")], 1)
+
+            await conn.send(op(1))
+            await asyncio.wait_for(got.get(), 5)
+            # hold the send lock; a second send parks on it; cancel it
+            # there — with seq allocated outside the lock this gapped
+            # the stream and the NEXT frame killed the connection
+            async with conn._send_lock:
+                park = asyncio.get_running_loop().create_task(
+                    conn.send(op(2)))
+                await asyncio.sleep(0.05)
+                park.cancel()
+            try:
+                await park
+            except asyncio.CancelledError:
+                pass
+            await conn.send(op(3))
+            reply = await asyncio.wait_for(got.get(), 5)
+            assert reply.rc == 0
+            assert not conn.closed, "framing corrupted by cancellation"
+        finally:
+            await client.shutdown()
+            await server.shutdown()
+
+    run(main())
+
+
+# -- live cluster ----------------------------------------------------------
+
+
+async def _placements(cluster, io, oids):
+    prim = {}
+    acting_of = {}
+    for oid in oids:
+        pg = io.object_pg(oid)
+        acting, p = cluster.mon.osdmap.pg_to_acting_osds(pg)
+        prim[oid] = p
+        acting_of[oid] = acting
+    return prim, acting_of
+
+
+def test_hedged_reads_bit_exact_under_slow_osd():
+    """One injected slow OSD on the sub-read path: hedged reads stay
+    byte-identical, the primaries fire/win hedges and cancel
+    stragglers cleanly, the hedge_status/perf surfaces report it, and
+    no hedge task survives the workload."""
+    async def main():
+        cluster = Cluster(num_osds=6, osds_per_host=3)
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "hp", EC_PROFILE, pg_num=8)
+            io = cluster.client.open_ioctx("hp")
+            payloads = {}
+            for i in range(12):
+                data = np.random.default_rng(900 + i).integers(
+                    0, 256, 20_000 + 37 * i,
+                    dtype=np.uint8).tobytes()
+                await io.write_full(f"h{i}", data)
+                payloads[f"h{i}"] = data
+            prim, acting_of = await _placements(cluster, io, payloads)
+            counts = {o: 0 for o in cluster.osds}
+            for p in prim.values():
+                counts[p] += 1
+            slow = min(sorted(counts), key=lambda o: counts[o])
+            targets = [o for o in payloads
+                       if prim[o] != slow and slow in acting_of[o]] \
+                or [o for o in payloads if prim[o] != slow]
+            cluster.osds[slow].msgr.inject_internal_delays = 0.08
+            # several passes: primaries learn the slow peer's EWMA,
+            # hedged first-k reads stay bit-exact throughout
+            for _round in range(4):
+                for oid in targets:
+                    assert await io.read(oid) == payloads[oid]
+            evidence = sum(
+                osd.hedge.counters["early_completions"]
+                + osd.hedge.counters["hedges_fired"]
+                for osd in cluster.osds.values())
+            assert evidence > 0, "no hedging activity recorded"
+            # the corrected learning semantics: fast peers earn their
+            # way BELOW the prior via completed RTTs, while the
+            # straggler — overtaken and cancelled on every read — is
+            # never taught the winners' latency (censored samples
+            # move it up only), so it can never out-rank a learned
+            # fast peer
+            fast_learned = False
+            for osd in cluster.osds.values():
+                st = osd.hedge.peers.get(slow)
+                if st is not None:
+                    assert st.ewma_now() >= osd.hedge.prior_s * 0.99
+                for o, p in osd.hedge.peers.items():
+                    if o != slow and p.samples > 0 and \
+                            p.ewma_now() < osd.hedge.prior_s:
+                        fast_learned = True
+            assert fast_learned
+            # observability surfaces
+            primary = prim[targets[0]]
+            rc, st = await cluster.client.osd_command(
+                primary, {"prefix": "hedge_status"})
+            assert rc == 0 and st["enabled"]
+            assert "counters" in st and "peers" in st
+            rc, perf = await cluster.client.osd_command(
+                primary, {"prefix": "perf dump"})
+            assert rc == 0 and "hedge" in perf
+            for key in ("hedges_fired", "hedge_wins",
+                        "cancelled_subreads", "peers"):
+                assert key in perf["hedge"]
+            # drain: no hedge task outlives its gather
+            await asyncio.sleep(0.2)
+            leaked = [t for t in asyncio.all_tasks()
+                      if t.get_name().startswith("hedge:")
+                      and not t.done()]
+            assert not leaked
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_hedge_kill_switch_parity():
+    """CEPH_TPU_HEDGE=0 restores the all-shard gather: reads remain
+    byte-identical and no hedged gather ever runs."""
+    os.environ["CEPH_TPU_HEDGE"] = "0"
+
+    async def main():
+        cluster = Cluster(num_osds=5, osds_per_host=1)
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "kp", EC_PROFILE, pg_num=4)
+            io = cluster.client.open_ioctx("kp")
+            data = np.random.default_rng(77).integers(
+                0, 256, 50_000, dtype=np.uint8).tobytes()
+            await io.write_full("obj", data)
+            assert await io.read("obj") == data
+            for osd in cluster.osds.values():
+                assert not osd.hedge.enabled
+                assert osd.hedge.counters["hedged_gathers"] == 0
+        finally:
+            await cluster.stop()
+
+    try:
+        run(main())
+    finally:
+        os.environ.pop("CEPH_TPU_HEDGE", None)

@@ -28,6 +28,7 @@ RULES = [
     "trace-numpy",
     "jit-bypass-plan",
     "unguarded-device-dispatch",
+    "unhedged-gather",
     "async-blocking",
     "sync-encode-in-async",
     "lock-order",
@@ -39,7 +40,8 @@ RULES = [
 CONFIG = {"dtype_paths": ("fx_uint8",),
           "plan_paths": ("fx_jit_bypass_plan",),
           "encode_paths": ("fx_sync_encode_in_async",),
-          "device_paths": ("fx_unguarded_device_dispatch",)}
+          "device_paths": ("fx_unguarded_device_dispatch",),
+          "gather_paths": ("fx_unhedged_gather",)}
 
 
 def _fixture(name: str) -> str:

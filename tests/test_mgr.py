@@ -175,6 +175,9 @@ def test_prometheus_exporter_serves_metrics():
             # per-OSD perf scraped over the tell surface
             assert "ceph_osd_encode_dispatches" in body or \
                    "ceph_osd_subread_bytes" in body
+            # the hedge section flattened out of the nested perf dump
+            assert "ceph_osd_hedge_hedges_fired" in body
+            assert "ceph_osd_hedge_cancelled_subreads" in body
             # every non-comment line parses as `name{labels} value`
             for line in body.strip().splitlines():
                 if line.startswith("#"):
@@ -187,6 +190,32 @@ def test_prometheus_exporter_serves_metrics():
             await cluster.stop()
 
     run(main())
+
+
+def test_prometheus_flattens_hedge_peers():
+    """The hedge section's per-peer EWMA map becomes peer-labeled
+    rows (like profiles/per_plan become profile-labeled), with the
+    moving estimates typed as gauges."""
+    from ceph_tpu.mgr.prometheus import PrometheusModule
+
+    lines: list = []
+    seen: set = set()
+    PrometheusModule._emit_perf(
+        lines, seen, "ceph_osd_hedge",
+        {"hedges_fired": 3, "hedge_wins": 2, "cancelled_subreads": 5,
+         "peers": {"osd.1": {"ewma_ms": 2.5, "p95_ms": 4.0,
+                             "samples": 7, "state_code": 0}}},
+        {"ceph_daemon": "osd.0"})
+    body = "\n".join(lines)
+    assert 'ceph_osd_hedge_hedges_fired{ceph_daemon="osd.0"} 3' in body
+    assert ('ceph_osd_hedge_peer_ewma_ms{ceph_daemon="osd.0",'
+            'peer="osd.1"} 2.5') in body
+    assert ('ceph_osd_hedge_peer_samples{ceph_daemon="osd.0",'
+            'peer="osd.1"} 7') in body
+    # moving estimates are gauges, not counters
+    assert "# TYPE ceph_osd_hedge_peer_ewma_ms gauge" in body
+    assert "# TYPE ceph_osd_hedge_peer_p95_ms gauge" in body
+    assert "# TYPE ceph_osd_hedge_hedges_fired counter" in body
 
 
 def test_dashboard_serves_status_ui():

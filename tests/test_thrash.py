@@ -338,6 +338,65 @@ def test_thrash_device_injection_toggle():
     asyncio.run(asyncio.wait_for(main(), 240))
 
 
+def test_thrash_hedged_reads_under_delay_injection():
+    """Cancellation-safety leg: with ms_inject_internal_delays on
+    EVERY daemon (each frame sleeps a random sub-hop delay) and
+    hedging enabled, a concurrent write/read workload must see zero
+    client-visible errors, every readback bit-exact, and — after the
+    workload drains — no leaked hedge tasks and no connection killed
+    by a cancellation-gapped frame seq (hedges constantly cancel
+    sub-reads mid-flight here)."""
+    inject = {"ms_inject_internal_delays": 0.01}
+
+    async def main():
+        cluster = Cluster(num_osds=5, osds_per_host=1,
+                          osd_config=dict(inject),
+                          mon_config=dict(inject))
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "hthrash", {"plugin": "ec_jax",
+                            "technique": "reed_sol_van",
+                            "k": "2", "m": "2",
+                            "crush-failure-domain": "osd"},
+                pg_num=4)
+            ioctx = cluster.client.open_ioctx("hthrash")
+            rng = np.random.default_rng(66)
+            model: dict = {}
+
+            async def one(i: int):
+                oid = f"obj-{i % 6}"
+                data = rng.integers(0, 256, 2000 + 531 * i,
+                                    dtype=np.uint8).tobytes()
+                # writes and reads interleave under injected delays;
+                # hedged gathers cancel stragglers the whole time
+                await ioctx.write_full(oid, data)
+                model[oid] = data
+                assert await ioctx.read(oid) == data
+
+            # batches of concurrent ops (the cancellation thrash)
+            for base in range(0, 24, 6):
+                await asyncio.gather(*(one(base + j)
+                                       for j in range(6)))
+            # final bit-exact readback of every acked object
+            for oid, data in model.items():
+                assert await ioctx.read(oid) == data
+            # hedging actually ran (this leg must not pass vacuously)
+            assert any(
+                osd.hedge.counters["hedged_gathers"] > 0
+                for osd in cluster.osds.values())
+            # drain, then the no-leak invariant
+            await asyncio.sleep(0.3)
+            leaked = [t for t in asyncio.all_tasks()
+                      if t.get_name().startswith("hedge:")
+                      and not t.done()]
+            assert not leaked, f"leaked hedge tasks: {leaked}"
+        finally:
+            await cluster.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 300))
+
+
 @pytest.mark.slow
 def test_thrash_ec_k2m2():
     asyncio.run(asyncio.wait_for(_run_thrash(
