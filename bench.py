@@ -63,6 +63,7 @@ def _emit_contract(value: Optional[float],
                    tier: Optional[dict] = None,
                    device_health: Optional[dict] = None,
                    tail: Optional[dict] = None,
+                   load: Optional[dict] = None,
                    truncated: bool = False) -> None:
     """Print the one-line JSON driver contract, exactly once, before
     any optional extended benches run — a wedged tunnel or a crashed
@@ -72,7 +73,9 @@ def _emit_contract(value: Optional[float],
     probe counters, device_health the circuit-breaker fault-tolerance
     probe (forced-failure host fallback bit-exact, trip -> probe ->
     recovered), tail the hedged-read scheduler probe (first-k
-    completion under an injected straggler, cancellation-clean);
+    completion under an injected straggler, cancellation-clean), load
+    the open-loop multi-tenant harness probe (goodput + streaming
+    p50/p95/p99 over the embedded cluster, deterministic schedules);
     truncated flags a budget-shortened run.  Thread-safe: the deadline
     watchdog and the bench body may race to emit."""
     global _contract_emitted
@@ -91,6 +94,7 @@ def _emit_contract(value: Optional[float],
             "tier": tier,
             "device_health": device_health,
             "tail": tail,
+            "load": load,
             "truncated": bool(truncated),
         }), flush=True)
 
@@ -501,6 +505,224 @@ def bench_tail() -> dict:
     out["tail_bytes_identical"] = bool(ok_on and ok_off)
     out["tail_hedge_counters"] = hedge_counters
     return out
+
+
+def _load_probe() -> Optional[dict]:
+    """Pre-contract probe of the open-loop load harness
+    (ceph_tpu/loadgen): a thousand simulated tenants (smoke: 200)
+    fire Poisson-scheduled mixed ops at the embedded cluster, latency
+    measured from SCHEDULED arrival (queueing delay counted, the
+    open-loop discipline), percentiles streamed through the bounded
+    log-bucket histogram.  Schedule determinism is asserted
+    (fingerprint equality across two generations).  Goodput +
+    p50/p95/p99 land in the contract line's `load` key; None (with a
+    stderr note) when the probe cannot run."""
+    if _remaining() < 0:
+        print("# load probe skipped: budget exhausted",
+              file=sys.stderr)
+        return None
+    probe_timeout = float(os.environ.get(
+        "CEPH_TPU_BENCH_LOAD_PROBE_TIMEOUT", "60"))
+    try:
+        import asyncio
+
+        from ceph_tpu.loadgen import (
+            make_tenants, run_embedded, schedule_fingerprint,
+        )
+
+        n_tenants = 200 if _SMOKE else 1000
+        duration = 0.5 if _SMOKE else 1.5
+        tenants = make_tenants(n_tenants, rate=2.0, zipf_theta=1.1,
+                               objects=64, object_size=4096)
+        deterministic = int(
+            schedule_fingerprint(tenants[:64], duration, seed=11)
+            == schedule_fingerprint(tenants[:64], duration, seed=11))
+        rep = asyncio.run(asyncio.wait_for(
+            run_embedded(tenants, duration=duration, seed=11),
+            probe_timeout))
+        return {
+            "tenants": rep["tenants"],
+            "offered": rep["offered"],
+            "completed": rep["completed"],
+            "shed": rep["shed"],
+            "errors": rep["errors"],
+            "goodput_mib_s": rep["goodput_mib_s"],
+            "ops_per_sec": rep["ops_per_sec"],
+            "p50_ms": rep["p50_ms"],
+            "p95_ms": rep["p95_ms"],
+            "p99_ms": rep["p99_ms"],
+            "deterministic": deterministic,
+        }
+    except Exception as e:
+        print(f"# load probe failed: {e!r}", file=sys.stderr)
+        return None
+
+
+def bench_load() -> dict:
+    """Open-loop sweep to the knee: the same 1000-tenant population
+    at doubling per-tenant arrival rates until goodput stops scaling
+    with offered load (completed/offered falls or p99 blows through
+    the knee threshold).  The open-loop discipline is what makes the
+    knee visible: a closed-loop bench would slow its own offering and
+    report a flattering plateau instead."""
+    import asyncio
+
+    from ceph_tpu.loadgen import make_tenants, run_embedded
+    from ceph_tpu.rados.embedded import LocalCluster
+
+    n_tenants = 200 if _SMOKE else 1000
+    duration = 0.5 if _SMOKE else 2.0
+    steps = 3 if _SMOKE else 6
+    out: dict = {"load_sweep": []}
+    knee = None
+    cluster = LocalCluster(num_osds=6)
+    try:
+        cluster.create_replicated_pool("loadgen", size=2, pg_num=16)
+        for i in range(steps):
+            rate = 2.0 * (2 ** i)
+            tenants = make_tenants(n_tenants, rate=rate,
+                                   zipf_theta=1.1, objects=64,
+                                   object_size=4096)
+            rep = asyncio.run(run_embedded(
+                tenants, duration=duration, seed=17,
+                cluster=cluster))
+            row = {"rate_per_tenant": rate,
+                   "offered": rep["offered"],
+                   "completed": rep["completed"],
+                   "dropped": rep["dropped"],
+                   "goodput_mib_s": rep["goodput_mib_s"],
+                   "p50_ms": rep["p50_ms"],
+                   "p99_ms": rep["p99_ms"]}
+            out["load_sweep"].append(row)
+            done_ratio = rep["completed"] / max(rep["offered"], 1)
+            if knee is None and (done_ratio < 0.95
+                                 or (rep["p99_ms"] or 0) > 100.0):
+                knee = rate
+    finally:
+        cluster.shutdown()
+    out["load_knee_rate_per_tenant"] = knee
+    out["load_peak_goodput_mib_s"] = max(
+        (r["goodput_mib_s"] for r in out["load_sweep"]), default=None)
+    return out
+
+
+def bench_qos() -> dict:
+    """QoS isolation proof on a live cluster: tenant B runs a steady
+    light workload while tenant A's offered load goes 10x, with the
+    per-tenant mClock profiles + admission gate ON vs OFF
+    (CEPH_TPU_QOS).  The number that matters: B's p99 degradation
+    across the 1x -> 10x step — bounded with QoS on (A's excess is
+    shed at the front door), unbounded-ish with it off (B queues
+    behind A's flood in the shared class)."""
+    import asyncio
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_helpers import Cluster
+    from ceph_tpu.loadgen import (
+        RadosTarget, TenantSpec, run_open_loop,
+    )
+
+    duration = 2.0 if _SMOKE else 4.0
+    # The contention is real ASYNC service time, not host CPU (which
+    # a single-process cluster would charge to both tenants alike):
+    # EC reads of a tiny shared hot set force remote sub-reads, and
+    # ms_inject_internal_delays on every OSD makes each sub-read
+    # round trip cost ~5 ms while the CPU stays idle.  With one grant
+    # slot per OSD the serving primary's capacity is ~100 ops/s —
+    # A's 10x flood (300/s) oversubscribes it 3x, which is exactly
+    # the regime QoS exists for.  A's mClock limit sits at ~its 1x
+    # offer (limits are PER OSD, the dmclock scope); B rides a
+    # reservation.  The read tier is disabled for both legs — it
+    # would serve the hot set from memory and measure cache
+    # residency, not scheduling.
+    a_rate, b_rate = 30.0, 10.0
+    osize = 64 << 10
+    n_objs = 2
+    delay = 0.005
+    profiles = json.dumps({"A": [0.0, 1.0, 40.0],
+                           "B": [20.0, 5.0, 0.0]})
+    ec_profile = {"plugin": "ec_jax", "technique": "reed_sol_van",
+                  "k": "2", "m": "2", "crush-failure-domain": "osd"}
+
+    async def run_leg(mult: float) -> dict:
+        cluster = Cluster(
+            num_osds=6, osds_per_host=3,
+            osd_config={"osd_heartbeat_interval": 3.0,
+                        "osd_heartbeat_grace": 20.0,
+                        "osd_op_num_threads": 1,
+                        "osd_mclock_tenant_profiles": profiles,
+                        "osd_mclock_admission_max_delay_ms": 10.0})
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "qos", profile=ec_profile, pg_num=8)
+            io = cluster.client.open_ioctx("qos")
+            target = RadosTarget(io)
+            await target.setup(n_objs, osize)
+            for osd in cluster.osds.values():
+                osd.msgr.inject_internal_delays = delay
+            tenants = [
+                TenantSpec(name="A", arrival_rate=a_rate * mult,
+                           blend={"read": 1.0}, zipf_theta=0.0,
+                           objects=n_objs, object_size=osize),
+                TenantSpec(name="B", arrival_rate=b_rate,
+                           blend={"read": 1.0}, zipf_theta=0.0,
+                           objects=n_objs, object_size=osize),
+            ]
+            rep = await run_open_loop(target, tenants,
+                                      duration=duration, seed=23,
+                                      per_tenant=("A", "B"),
+                                      drain_timeout=60.0)
+            shed = 0
+            for osd in cluster.osds.values():
+                shed += osd.admission.counters.get("shed", 0)
+            rep["admission_shed"] = shed
+            return rep
+        finally:
+            await cluster.stop()
+
+    def legs() -> dict:
+        one = asyncio.run(run_leg(1.0))
+        ten = asyncio.run(run_leg(10.0))
+        return {"b_p99_1x_ms": one["per_tenant"]["B"]["p99_ms"],
+                "b_p99_10x_ms": ten["per_tenant"]["B"]["p99_ms"],
+                "b_completed_10x": ten["per_tenant"]["B"]["completed"],
+                "a_completed_10x": ten["per_tenant"]["A"]["completed"],
+                "a_shed_10x": ten["per_tenant"]["A"]["shed"],
+                "admission_shed_10x": ten["admission_shed"]}
+
+    prev = os.environ.get("CEPH_TPU_QOS")
+    prev_tier = os.environ.get("CEPH_TPU_TIER")
+    try:
+        os.environ["CEPH_TPU_TIER"] = "0"
+        os.environ["CEPH_TPU_QOS"] = "1"
+        on = legs()
+        os.environ["CEPH_TPU_QOS"] = "0"
+        off = legs()
+    finally:
+        for name, val in (("CEPH_TPU_QOS", prev),
+                          ("CEPH_TPU_TIER", prev_tier)):
+            if val is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = val
+
+    def ratio(leg):
+        base = max(leg["b_p99_1x_ms"] or 1e-9, 1e-9)
+        return round((leg["b_p99_10x_ms"] or 0.0) / base, 3)
+
+    # "held": B's p99 within 25% of its 1x baseline, or under an
+    # absolute 25 ms floor (single-host noise below which per-op
+    # jitter, not tenant interference, dominates the ratio)
+    held = bool((on["b_p99_10x_ms"] or float("inf"))
+                <= max(1.25 * (on["b_p99_1x_ms"] or 0.0), 25.0))
+    return {
+        "qos_on": on, "qos_off": off,
+        "qos_b_p99_degradation_on_x": ratio(on),
+        "qos_b_p99_degradation_off_x": ratio(off),
+        "qos_isolation_held": held,
+    }
 
 
 def _service_probe() -> Optional[dict]:
@@ -1209,6 +1431,10 @@ def main() -> None:
     # hedged-read probe (cheap, before the contract): first-k
     # completion under an injected straggler, cancellation-clean
     tail_counters = _hedge_probe()
+    # open-loop load probe (cheap, before the contract): hundreds to
+    # a thousand tenants over the embedded cluster, goodput +
+    # streaming percentiles, deterministic schedules
+    load_counters = _load_probe()
 
     # the driver contract line, before every optional/extended bench:
     # a wedge below this point can cost detail rows, never the bench
@@ -1217,6 +1443,7 @@ def main() -> None:
                    tier=tier_counters,
                    device_health=device_health_counters,
                    tail=tail_counters,
+                   load=load_counters,
                    truncated=skip_optional)
 
     # decode sweep over 1..m erasures (the reference benchmark sweeps
@@ -1312,6 +1539,32 @@ def main() -> None:
         except Exception as e:
             print(f"# degraded bench failed: {e!r}", file=sys.stderr)
 
+    # open-loop load sweep: the same tenant population at doubling
+    # arrival rates until the knee (goodput stops tracking offered)
+    load_section: dict = {}
+    if skip_optional:
+        skipped_sections.append("load")
+    else:
+        try:
+            load_section = bench_load()
+        except Exception as e:
+            print(f"# load bench failed: {e!r}", file=sys.stderr)
+
+    # QoS isolation proof: tenant B's p99 across tenant A's 1x->10x
+    # step, per-tenant mClock + admission gate on vs off.  Live
+    # clusters x4: out of smoke mode (the scheduler-level isolation
+    # regression lives in the test tier)
+    qos_section: dict = {}
+    if _SMOKE:
+        pass
+    elif skip_optional:
+        skipped_sections.append("qos")
+    else:
+        try:
+            qos_section = bench_qos()
+        except Exception as e:
+            print(f"# qos bench failed: {e!r}", file=sys.stderr)
+
     details = {
         "encode_gibs": enc_gibs,
         "encode_path": "pallas_words" if use_pallas else "xla_bitplanes",
@@ -1330,10 +1583,13 @@ def main() -> None:
         **tier_section,
         **tail_section,
         **degraded_section,
+        **load_section,
+        **qos_section,
         "encode_service": service_counters,
         "tier": tier_counters,
         "device_health": device_health_counters,
         "tail": tail_counters,
+        "load": load_counters,
         "host_cores": os.cpu_count(),
         "encode_ms_per_batch": t_enc * 1e3,
         "k": k, "m": m, "chunk_bytes": chunk, "batch": batch,

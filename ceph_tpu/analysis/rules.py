@@ -38,6 +38,16 @@ EC dispatch discipline:
                        exception instead of degrading to the
                        bit-exact host path
 
+loadgen/bench discipline:
+  unbounded-latency-buffer
+                       appending per-op latency samples to a plain
+                       list inside a loadgen/bench loop: an open-loop
+                       sweep offers ops at a fixed rate regardless of
+                       completions, so the buffer grows with offered
+                       load times duration — stream into the bounded
+                       log-bucket histogram
+                       (ceph_tpu/loadgen/stats.py) instead
+
 Every rule walks its own scope only (nested defs are analyzed as their
 own traced/async functions), so findings never double-report.
 """
@@ -45,6 +55,7 @@ own traced/async functions), so findings never double-report.
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, Iterator, Optional, Set
 
 from ceph_tpu.analysis.core import (
@@ -674,6 +685,83 @@ def rule_sync_encode_in_async(a: Analyzer) -> None:
 
 
 # ---------------------------------------------------------------------
+# unbounded-latency-buffer
+# ---------------------------------------------------------------------
+
+# modules whose measurement loops are judged: the loadgen subsystem
+# and the CLI bench tools (the paths where per-op sample buffers grow
+# with offered load x duration)
+_LATENCY_PATHS = ("ceph_tpu/loadgen/", "ceph_tpu/tools/")
+# receiver names that denote a latency sample buffer
+_LATENCY_NAME_RE = re.compile(
+    r"lat|latenc|rtt|elapsed|duration|timing|sample")
+# clock reads whose difference is a latency sample
+_CLOCK_CALLS = {
+    "time.monotonic", "time.perf_counter", "time.time",
+    "time.monotonic_ns", "time.perf_counter_ns", "time.time_ns",
+}
+
+
+def _inside_loop(mod, node: ast.AST) -> bool:
+    """True when the node sits inside a for/while of the SAME
+    function scope (a nested def resets the judgment)."""
+    cur = node
+    while cur is not None:
+        cur = mod.parents.get(cur)
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+            return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return False
+    return False
+
+
+def _has_clock_call(mod, node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and \
+                _resolved_callee(mod, sub) in _CLOCK_CALLS:
+            return True
+    return False
+
+
+def rule_unbounded_latency_buffer(a: Analyzer) -> None:
+    """`<buffer>.append(<per-op sample>)` inside a loadgen/bench
+    loop: the list grows without bound under open-loop load (offered
+    rate x duration samples, regardless of completions).  Stream the
+    sample into ceph_tpu.loadgen.stats.LatencyHistogram (constant
+    memory, same percentiles) or baseline a deliberately-bounded
+    buffer with a justification."""
+    paths = a.config.get("latency_paths", _LATENCY_PATHS)
+    for mod in a.project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if not any(p in rel for p in paths):
+            continue
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "append"
+                    and node.args):
+                continue
+            recv = node.func.value
+            recv_name = recv.id if isinstance(recv, ast.Name) else (
+                recv.attr if isinstance(recv, ast.Attribute) else "")
+            looks_latency = bool(
+                _LATENCY_NAME_RE.search(recv_name.lower())) or \
+                _has_clock_call(mod, node.args[0])
+            if looks_latency and _inside_loop(mod, node):
+                a.emit("unbounded-latency-buffer", mod, node,
+                       f"per-op latency sample appended to "
+                       f"`{recv_name or '<expr>'}` inside a bench "
+                       "loop: under open-loop load this list grows "
+                       "with offered rate x duration — stream into "
+                       "ceph_tpu.loadgen.stats.LatencyHistogram "
+                       "(bounded log buckets) instead",
+                       severity="warning",
+                       symbol=_enclosing_qualname(mod, node),
+                       scope_line=_scope_line(mod, node))
+
+
+# ---------------------------------------------------------------------
 # lock-no-await
 # ---------------------------------------------------------------------
 
@@ -754,6 +842,7 @@ def default_rules() -> Dict[str, object]:
         "jit-bypass-plan": rule_jit_bypass_plan,
         "unguarded-device-dispatch": rule_unguarded_device_dispatch,
         "unhedged-gather": rule_unhedged_gather,
+        "unbounded-latency-buffer": rule_unbounded_latency_buffer,
         "async-blocking": rule_async_blocking,
         "sync-encode-in-async": rule_sync_encode_in_async,
         "lock-order": rule_lock_order,

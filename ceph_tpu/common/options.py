@@ -223,6 +223,53 @@ def _opts() -> List[Option]:
         Option("osd_hedge_spread_escalate", "float", 4.0, A, min=1.0,
                desc="max-p95/min-EWMA ratio across peers beyond which"
                     " the speculative Δ escalates by one"),
+        # -- op queue / per-tenant QoS (mClockScheduler.h +
+        #    osd_mclock_* family; tenant extension: client ops carry
+        #    a tenant identity and schedule as `client.<tenant>`
+        #    classes with their own dmClock triples, gated by a
+        #    token-bucket admission stage before the queue) ----------
+        Option("osd_op_queue", "str", "mclock_scheduler", A,
+               enum_values=("mclock_scheduler", "wpq"),
+               desc="op scheduling discipline", flags=FLAG_STARTUP),
+        Option("osd_op_num_threads", "uint", 8, A, min=1,
+               desc="max concurrent scheduler grants (the admit"
+                    " gate's in-flight bound)"),
+        Option("osd_scheduler_queue_depth", "uint", 1024, A, min=1,
+               desc="per-class op queue bound; overflow follows"
+                    " osd_scheduler_overflow"),
+        Option("osd_scheduler_overflow", "str", "shed", A,
+               enum_values=("shed", "block"),
+               desc="bounded-queue overflow policy: shed=EBUSY the"
+                    " caller, block=backpressure until the class"
+                    " drains"),
+        Option("osd_mclock_tenant_enable", "bool", True, A,
+               desc="schedule tenant-tagged client ops as per-tenant"
+                    " mClock classes (env kill switch:"
+                    " CEPH_TPU_QOS=0)", flags=FLAG_STARTUP),
+        Option("osd_mclock_tenant_reservation", "float", 0.0, A,
+               min=0.0,
+               desc="default per-tenant reservation (ops/s; 0 = no"
+                    " floor)"),
+        Option("osd_mclock_tenant_weight", "float", 1.0, A, min=0.01,
+               desc="default per-tenant proportional-share weight"),
+        Option("osd_mclock_tenant_limit", "float", 0.0, A, min=0.0,
+               desc="default per-tenant limit (ops/s; 0 = unlimited)"
+                    " — also the admission gate's bucket rate"),
+        Option("osd_mclock_tenant_profiles", "str", "", A,
+               desc="per-tenant overrides as JSON:"
+                    ' {"<tenant>": [reservation, weight, limit]}'),
+        Option("osd_mclock_admission_enable", "bool", True, A,
+               desc="token-bucket admission gate ahead of the op"
+                    " queue: over-limit tenants are delayed then shed"
+                    " (EBUSY) before consuming execute-stage"
+                    " resources"),
+        Option("osd_mclock_admission_burst", "secs", 2.0, A, min=0.0,
+               desc="bucket capacity in seconds' worth of the"
+                    " tenant's limit rate"),
+        Option("osd_mclock_admission_max_delay_ms", "float", 50.0, A,
+               min=0.0,
+               desc="max in-gate smoothing delay before an over-limit"
+                    " op is shed instead"),
         # -- osd/pg --------------------------------------------------------
         Option("osd_pool_default_size", "uint", 3, B),
         Option("osd_pool_default_min_size", "uint", 0, A),

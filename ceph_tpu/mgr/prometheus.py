@@ -103,6 +103,10 @@ class PrometheusModule(MgrModule):
         # hedge per-peer latency model leaves: moving estimates, not
         # monotone counts
         "_ewma_ms", "_p95_ms",
+        # QoS leaves: queue occupancy, grant concurrency, bucket
+        # levels and the configured bounds are all levels
+        "_in_flight", "_queued", "_max_concurrent",
+        "_max_queue_depth", "_tokens", "_limit_ops",
     )
 
     # nested maps that become a LABEL instead of exploding the metric
@@ -112,6 +116,8 @@ class PrometheusModule(MgrModule):
         "per_plan": ("profile", "profile"),
         # the hedge section's per-peer EWMA/breaker model
         "peers": ("peer", "peer"),
+        # the qos section's per-tenant admission/queue rows
+        "tenants": ("tenant", "tenant"),
     }
 
     @classmethod

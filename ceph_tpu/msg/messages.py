@@ -340,7 +340,8 @@ class MOSDOp(Message):
                  ops: List[OSDOp], epoch: int,
                  snapc_seq: int = 0,
                  snapc_snaps: Optional[List[int]] = None,
-                 snap_id: int = 0):
+                 snap_id: int = 0,
+                 tenant: str = ""):
         self.tid = tid
         self.client = client
         self.pg = pg
@@ -352,12 +353,17 @@ class MOSDOp(Message):
         self.snapc_seq = snapc_seq
         self.snapc_snaps = snapc_snaps or []
         self.snap_id = snap_id
+        # QoS tenant identity ("" = untagged): the OSD schedules the
+        # op under the per-tenant mClock class `client.<tenant>` and
+        # runs it through the admission gate
+        self.tenant = tenant
         # blkin-role trace context: (trace_id, parent span id) or None
         self.trace: Optional[tuple] = None
 
-    # v2 appends the snap context + read snap; v3 the trace context.
-    # COMPAT stays 1 so a v1 frame still decodes with defaults
-    VERSION = 3
+    # v2 appends the snap context + read snap; v3 the trace context;
+    # v4 the QoS tenant.  COMPAT stays 1 so a v1 frame still decodes
+    # with defaults
+    VERSION = 4
     COMPAT = 1
 
     def encode_payload(self, enc: Encoder) -> None:
@@ -372,6 +378,7 @@ class MOSDOp(Message):
         enc.u64(self.snap_id)
         enc.optional(self.trace,
                      lambda e, v: (e.u64(v[0]), e.u64(v[1])))
+        enc.string(self.tenant)
 
     @classmethod
     def decode(cls, data: bytes) -> "MOSDOp":
@@ -385,6 +392,8 @@ class MOSDOp(Message):
             msg.snap_id = dec.u64()
         if struct_v >= 3:
             msg.trace = dec.optional(lambda d: (d.u64(), d.u64()))
+        if struct_v >= 4:
+            msg.tenant = dec.string()
         dec.finish()
         return msg
 

@@ -74,6 +74,7 @@ from ceph_tpu.ops import checksum as cks
 from ceph_tpu.os import ObjectId, ObjectStore, Transaction
 from ceph_tpu.os.memstore import MemStore
 from ceph_tpu.osd import ec_util
+from ceph_tpu.osd.admission import AdmissionGate, SHED
 from ceph_tpu.osd.encode_service import EncodeService
 from ceph_tpu.osd.hedge import HedgeTracker
 from ceph_tpu.osd.tier import TierAgent
@@ -99,6 +100,7 @@ EAGAIN = -11
 ENOENT = -2
 ESTALE = -116
 EIO = -5
+EBUSY = -16
 EINVAL = -22
 
 DEFAULTS = {
@@ -368,11 +370,53 @@ class OSDDaemon:
         self._completed_ops: "OrderedDict[Tuple[str, int], Tuple]" = \
             OrderedDict()
         # QoS op scheduler (mClock/WPQ role): client vs recovery vs
-        # scrub arbitration at the execute stage
+        # scrub arbitration at the execute stage; tenant-tagged client
+        # ops (MOSDOp v4) schedule as per-tenant `client.<t>` classes
+        # with the osd_mclock_tenant_* dmClock triples, behind a
+        # token-bucket admission gate (osd/admission.py).  Kill
+        # switches: CEPH_TPU_QOS=0 / osd_mclock_tenant_enable=false
+        # collapse every tenant back into the shared client class.
+        tenant_profiles: Dict[str, tuple] = {}
+        raw_profiles = str(self.config.get(
+            "osd_mclock_tenant_profiles", "") or "")
+        if raw_profiles:
+            try:
+                tenant_profiles = {
+                    t: tuple(float(x) for x in triple)
+                    for t, triple in json.loads(raw_profiles).items()}
+            except (ValueError, TypeError):
+                log.warning("osd.%d: bad osd_mclock_tenant_profiles"
+                            " %r ignored", osd_id, raw_profiles)
+        tenant_default = (
+            float(self.config.get("osd_mclock_tenant_reservation",
+                                  0.0)),
+            float(self.config.get("osd_mclock_tenant_weight", 1.0)),
+            float(self.config.get("osd_mclock_tenant_limit", 0.0)))
         self.scheduler = sched_mod.make_scheduler(
             str(self.config.get("osd_op_queue", "mclock_scheduler")),
             max_concurrent=int(self.config.get(
-                "osd_op_num_threads", 8)))
+                "osd_op_num_threads", 8)),
+            max_queue_depth=int(self.config.get(
+                "osd_scheduler_queue_depth", 1024)),
+            overflow=str(self.config.get(
+                "osd_scheduler_overflow", "shed")),
+            tenant_default=tenant_default,
+            tenant_profiles=tenant_profiles)
+        self._qos_tenants_enabled = (
+            os.environ.get("CEPH_TPU_QOS", "1") != "0"
+            and bool(self.config.get("osd_mclock_tenant_enable",
+                                     True))
+            and isinstance(self.scheduler,
+                           sched_mod.MClockScheduler))
+        profile_of = (
+            (lambda t: self.scheduler.profile_of(
+                sched_mod.tenant_class(t)))
+            if self._qos_tenants_enabled else (lambda t: (0.0, 1.0,
+                                                          0.0)))
+        self.admission = AdmissionGate(config=self.config,
+                                       profile_of=profile_of)
+        if not self._qos_tenants_enabled:
+            self.admission.enabled = False
         # op tracking + background scrub + admin socket
         from ceph_tpu.osd.op_tracker import OpTracker
 
@@ -481,6 +525,11 @@ class OSDDaemon:
                 "per-family circuit-breaker states, trip/probe/"
                 "fallback counters, poisoned-plan quarantine, and"
                 " the active fault-injection spec"),
+            "qos_status": (
+                lambda cmd: self._cmd_qos_status(),
+                "per-tenant mClock QoS: scheduler grant/queue state,"
+                " tenant profiles, admission-gate admit/delay/shed"
+                " decisions and live bucket levels"),
             "dump_traces": (
                 lambda cmd: {"spans": self.tracer.dump(
                     int(cmd["trace_id"], 16)
@@ -523,6 +572,56 @@ class OSDDaemon:
         # (the prometheus flattener turns `peers` into peer-labeled
         # rows)
         out["hedge"] = self.hedge.perf()
+        # per-tenant QoS: scheduler queue/grant state + admission
+        # decisions (`tenants` flattens to tenant-labeled rows)
+        out["qos"] = self._qos_perf()
+        return out
+
+    def _qos_perf(self) -> Dict[str, Any]:
+        """Nested `qos` perf-dump section: numeric scheduler state
+        plus the admission gate's decision counters, with per-tenant
+        rows under the `tenants` label map."""
+        st = self.scheduler.stats()
+        adm = self.admission.perf()
+        adm["admission_enabled"] = adm.pop("enabled", 0)
+        tenants: Dict[str, Dict[str, Any]] = {
+            t: dict(c) for t, c in adm.pop("tenants", {}).items()}
+        for cls, depth in st.get("queue_depths", {}).items():
+            if cls.startswith(sched_mod.TENANT_PREFIX):
+                t = cls[len(sched_mod.TENANT_PREFIX):]
+                tenants.setdefault(t, {})["queue_depth"] = depth
+        for cls, n in st.get("granted", {}).items():
+            if cls.startswith(sched_mod.TENANT_PREFIX):
+                t = cls[len(sched_mod.TENANT_PREFIX):]
+                tenants.setdefault(t, {})["granted"] = n
+        return {
+            "enabled": int(self._qos_tenants_enabled),
+            "in_flight": st["in_flight"],
+            "queued": st["queued"],
+            "max_concurrent": st["max_concurrent"],
+            "max_queue_depth": st["max_queue_depth"],
+            "queue_shed": sum(st.get("queue_shed", {}).values()),
+            "cancelled_before_grant":
+                st.get("cancelled_before_grant", 0),
+            **adm,
+            "tenants": tenants,
+        }
+
+    def _cmd_qos_status(self) -> Dict[str, Any]:
+        """The operator view of 'who is being served, delayed, shed,
+        and under what profile' — scheduler + admission in one
+        dump."""
+        out: Dict[str, Any] = {
+            "enabled": self._qos_tenants_enabled,
+            "scheduler": self.scheduler.stats(),
+            "admission": self.admission.status(),
+        }
+        if isinstance(self.scheduler, sched_mod.MClockScheduler):
+            out["tenant_default"] = list(
+                self.scheduler.tenant_default)
+            out["tenant_profiles"] = {
+                t: list(p) for t, p in
+                self.scheduler.tenant_profiles.items()}
         return out
 
     def _cmd_device_health(self) -> Dict[str, Any]:
@@ -3443,16 +3542,36 @@ class OSDDaemon:
         if cached is not None:
             rc, data, out = cached
         else:
+            # QoS admit: cost scales with payload so a stream of
+            # huge writes is charged accordingly (mClock item cost)
+            cost = 1.0 + sum(len(op.data) for op in msg.ops) \
+                / (1 << 20)
+            tenant = getattr(msg, "tenant", "") or ""
+            op_class = sched_mod.CLIENT
+            admitted = True
+            if tenant and self._qos_tenants_enabled:
+                op_class = sched_mod.tenant_class(tenant)
+                # the admission gate runs BEFORE the op queue: an
+                # over-limit tenant is delayed/shed here, before its
+                # op consumes a queue slot or any encode-service/
+                # hedge/tier resources at the execute stage
+                if await self.admission.admit(tenant,
+                                              cost) == SHED:
+                    admitted = False
             try:
-                # QoS admit: cost scales with payload so a stream of
-                # huge writes is charged accordingly (mClock item cost)
-                cost = 1.0 + sum(len(op.data) for op in msg.ops) \
-                    / (1 << 20)
-                rc, data, out = await self.scheduler.run(
-                    sched_mod.CLIENT, cost,
-                    lambda: self._execute_ops(state, pool, msg, conn))
+                if not admitted:
+                    rc, data, out = EBUSY, b"", {}
+                else:
+                    rc, data, out = await self.scheduler.run(
+                        op_class, cost,
+                        lambda: self._execute_ops(state, pool, msg,
+                                                  conn))
             except asyncio.CancelledError:
                 raise
+            except sched_mod.QueueFull:
+                # bounded-queue overflow: explicit refusal, the
+                # client sees EBUSY instead of an unbounded park
+                rc, data, out = EBUSY, b"", {}
             except UnfoundObject:
                 rc, data, out = EAGAIN, b"", {}
             except Exception:
@@ -3465,9 +3584,12 @@ class OSDDaemon:
             # Mutating errors ARE cached — an op vector can partially
             # commit before the failing op (e.g. append ok, omap EIO),
             # so re-executing the resend would double-apply the prefix.
-            # EAGAIN alone commits nothing and must re-execute.
-            if rc != EAGAIN and any(op.op in _MUTATING_CLIENT_OPS
-                                    for op in msg.ops):
+            # EAGAIN alone commits nothing and must re-execute; an
+            # EBUSY shed never started, so a resend must get a fresh
+            # admission decision, not a cached refusal.
+            if rc not in (EAGAIN, EBUSY) and \
+                    any(op.op in _MUTATING_CLIENT_OPS
+                        for op in msg.ops):
                 self._completed_ops[reqid] = (rc, data, out)
                 while len(self._completed_ops) > 4096:
                     self._completed_ops.popitem(last=False)

@@ -21,6 +21,16 @@ in-flight grants.  Two disciplines:
   among classes under their limit.  This is the same tag algebra as
   the reference's dmclock library (src/dmclock/), minus the
   distributed delta/rho piggybacking (single-OSD scope here).
+
+Multi-tenant extension (the million-client front door): client ops
+carrying a tenant identity (MOSDOp v4) schedule as per-tenant classes
+`client.<tenant>` with their own (reservation, weight, limit)
+profiles — the typed `osd_mclock_tenant_*` options supply the default
+triple and per-tenant overrides — so one abusive tenant contends
+against its own tags, not against everyone's.  Queues are BOUNDED
+(`max_queue_depth` per class, explicit overflow policy) and
+introspectable via `stats()`, which is the signal the admission gate
+(osd/admission.py) keys off.
 """
 
 from __future__ import annotations
@@ -34,6 +44,9 @@ RECOVERY = "background_recovery"
 SCRUB = "background_scrub"
 BEST_EFFORT = "background_best_effort"
 
+#: per-tenant client classes are `client.<tenant>`
+TENANT_PREFIX = CLIENT + "."
+
 # (reservation ops/s, weight, limit ops/s or 0 = unlimited) — the
 # shape of osd_mclock_profile "balanced": client weighted highest,
 # recovery guaranteed a floor so a client flood cannot starve it
@@ -43,6 +56,28 @@ DEFAULT_PROFILES: Dict[str, Tuple[float, float, float]] = {
     SCRUB: (5.0, 1.0, 50.0),
     BEST_EFFORT: (0.0, 1.0, 50.0),
 }
+
+#: bookkeeping cap for per-tenant class state: at millions of tenants
+#: the tag/queue maps must stay bounded — idle tenants' entries are
+#: pruned once the map outgrows this
+TENANT_STATE_CAP = 4096
+
+
+def tenant_class(tenant: str) -> str:
+    """Scheduler class for a tenant's client ops ('' = the shared
+    default class)."""
+    return f"{TENANT_PREFIX}{tenant}" if tenant else CLIENT
+
+
+class QueueFull(RuntimeError):
+    """Overflow policy 'shed': the class queue is at max_queue_depth.
+    The daemon maps this to EBUSY — the client sees an explicit
+    refusal, never an op silently parked on an unbounded list."""
+
+    def __init__(self, op_class: str, depth: int):
+        super().__init__(f"{op_class} queue full ({depth})")
+        self.op_class = op_class
+        self.depth = depth
 
 
 class _Item:
@@ -59,14 +94,26 @@ class _Item:
 class OpSchedulerBase:
     """Admit gate: run(cls, cost, fn) parks until granted."""
 
-    def __init__(self, max_concurrent: int = 8):
+    def __init__(self, max_concurrent: int = 8,
+                 max_queue_depth: int = 1024,
+                 overflow: str = "shed"):
         self.max_concurrent = max_concurrent
+        # bounded per-class queues with an EXPLICIT overflow policy:
+        # "shed" raises QueueFull at enqueue, "block" parks the caller
+        # until the class drains below the bound (backpressure)
+        self.max_queue_depth = int(max_queue_depth)
+        if overflow not in ("shed", "block"):
+            raise ValueError(f"unknown overflow policy {overflow!r}")
+        self.overflow = overflow
         self._in_flight = 0
         self._queues: Dict[str, List[_Item]] = {}
         self._wake = asyncio.Event()
+        self._drained = asyncio.Event()
         self._grant_task: Optional[asyncio.Task] = None
         self._stopping = False
         self.granted: Dict[str, int] = {}
+        self.shed: Dict[str, int] = {}
+        self.cancelled_before_grant = 0
 
     def start(self) -> None:
         if self._grant_task is None:
@@ -76,6 +123,7 @@ class OpSchedulerBase:
     async def stop(self) -> None:
         self._stopping = True
         self._wake.set()
+        self._drained.set()
         if self._grant_task is not None:
             self._grant_task.cancel()
             try:
@@ -98,6 +146,17 @@ class OpSchedulerBase:
             # queued future would park the caller forever
             raise RuntimeError("scheduler stopped")
         self.start()
+        while len(self._queues.get(op_class, ())) >= \
+                self.max_queue_depth:
+            if self.overflow == "shed":
+                self.shed[op_class] = self.shed.get(op_class, 0) + 1
+                raise QueueFull(op_class,
+                                len(self._queues[op_class]))
+            # block: wait for the class to drain below the bound
+            self._drained.clear()
+            await self._drained.wait()
+            if self._stopping:
+                raise RuntimeError("scheduler stopped")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         item = _Item(max(cost, 1.0), fn, fut)
         self._enqueue(op_class, item)
@@ -108,7 +167,8 @@ class OpSchedulerBase:
             # cancelled AFTER the grant landed: the slot was consumed
             # and fn never ran — release it or the leak eventually
             # deadlocks every class (cancelled-before-grant is handled
-            # by the grant loop when it pops the done future)
+            # by the grant loop when it pops the done future, and its
+            # tag charge is refunded there)
             if fut.done() and not fut.cancelled():
                 self._in_flight -= 1
                 self._wake.set()
@@ -127,8 +187,29 @@ class OpSchedulerBase:
     def _select(self) -> Optional[Tuple[str, _Item]]:
         raise NotImplementedError
 
+    def _uncharge(self, op_class: str, item: _Item) -> None:
+        """Return a cancelled-before-grant item's tag/service charge:
+        the work never ran, so the class must not be debited for it."""
+
     def _queued(self) -> int:
         return sum(len(q) for q in self._queues.values())
+
+    def stats(self) -> Dict[str, Any]:
+        """The introspection surface the admission gate (and
+        qos_status) reads: grant concurrency, per-class depth,
+        grant/shed counters, the bound and its policy."""
+        return {
+            "max_concurrent": self.max_concurrent,
+            "in_flight": self._in_flight,
+            "queued": self._queued(),
+            "max_queue_depth": self.max_queue_depth,
+            "overflow": self.overflow,
+            "queue_depths": {c: len(q)
+                             for c, q in self._queues.items() if q},
+            "granted": dict(self.granted),
+            "queue_shed": dict(self.shed),
+            "cancelled_before_grant": self.cancelled_before_grant,
+        }
 
     async def _grant_loop(self) -> None:
         while not self._stopping:
@@ -137,13 +218,18 @@ class OpSchedulerBase:
                 if picked is None:
                     break
                 op_class, item = picked
+                self._drained.set()
+                if item.future.done():
+                    # caller vanished before the grant: no slot was
+                    # consumed, and the item's tag charge goes back so
+                    # the class is not debited for unrun work
+                    self.cancelled_before_grant += 1
+                    self._uncharge(op_class, item)
+                    continue
                 self._in_flight += 1
                 self.granted[op_class] = \
                     self.granted.get(op_class, 0) + 1
-                if not item.future.done():
-                    item.future.set_result(None)
-                else:  # caller vanished: release the slot
-                    self._in_flight -= 1
+                item.future.set_result(None)
             self._wake.clear()
             if self._queued() == 0 or \
                     self._in_flight >= self.max_concurrent:
@@ -161,8 +247,10 @@ class WPQScheduler(OpSchedulerBase):
     others."""
 
     def __init__(self, weights: Optional[Dict[str, float]] = None,
-                 max_concurrent: int = 8):
-        super().__init__(max_concurrent)
+                 max_concurrent: int = 8,
+                 max_queue_depth: int = 1024,
+                 overflow: str = "shed"):
+        super().__init__(max_concurrent, max_queue_depth, overflow)
         self.weights = weights or {
             c: w for c, (_r, w, _l) in DEFAULT_PROFILES.items()}
         self._served: Dict[str, float] = {}  # weight-normalized
@@ -196,24 +284,70 @@ class WPQScheduler(OpSchedulerBase):
             item.cost / max(self.weights.get(op_class, 1.0), 1e-9)
         return op_class, item
 
+    def _uncharge(self, op_class: str, item: _Item) -> None:
+        self._served[op_class] = self._served.get(op_class, 0.0) - \
+            item.cost / max(self.weights.get(op_class, 1.0), 1e-9)
+
 
 class MClockScheduler(OpSchedulerBase):
-    """dmClock-lite tag scheduler (mClockScheduler.h role)."""
+    """dmClock-lite tag scheduler (mClockScheduler.h role) with
+    per-tenant client classes."""
 
     def __init__(self,
                  profiles: Optional[
                      Dict[str, Tuple[float, float, float]]] = None,
-                 max_concurrent: int = 8):
-        super().__init__(max_concurrent)
+                 max_concurrent: int = 8,
+                 max_queue_depth: int = 1024,
+                 overflow: str = "shed",
+                 tenant_default: Tuple[float, float, float] = (
+                     0.0, 1.0, 0.0),
+                 tenant_profiles: Optional[
+                     Dict[str, Tuple[float, float, float]]] = None):
+        super().__init__(max_concurrent, max_queue_depth, overflow)
         self.profiles = dict(profiles or DEFAULT_PROFILES)
+        # tenant classes: per-tenant override else the default triple
+        # (osd_mclock_tenant_{reservation,weight,limit})
+        self.tenant_default = tuple(tenant_default)
+        self.tenant_profiles = {
+            t: tuple(p) for t, p in (tenant_profiles or {}).items()}
         self._last_r: Dict[str, float] = {}
         self._last_p: Dict[str, float] = {}
         self._last_l: Dict[str, float] = {}
 
+    def profile_of(self, op_class: str) -> Tuple[float, float, float]:
+        """(reservation, weight, limit) for a class: explicit profile,
+        else the tenant override / tenant default for `client.<t>`
+        classes, else best-effort."""
+        p = self.profiles.get(op_class)
+        if p is not None:
+            return p
+        if op_class.startswith(TENANT_PREFIX):
+            t = op_class[len(TENANT_PREFIX):]
+            return self.tenant_profiles.get(t, self.tenant_default)
+        return (0.0, 1.0, 0.0)
+
+    def _prune_idle_tenants(self) -> None:
+        """Tenant-class bookkeeping stays bounded: once the tag maps
+        outgrow TENANT_STATE_CAP, drop tenant classes with EMPTY
+        queues (their tags re-seed from now on the next burst, which
+        is exactly the idle-floor discipline anyway)."""
+        if len(self._last_p) <= TENANT_STATE_CAP:
+            return
+        for c in [c for c in self._last_p
+                  if c.startswith(TENANT_PREFIX)
+                  and not self._queues.get(c)]:
+            self._last_p.pop(c, None)
+            self._last_r.pop(c, None)
+            self._last_l.pop(c, None)
+            self._queues.pop(c, None)
+
     def _enqueue(self, op_class: str, item: _Item) -> None:
         now = time.monotonic()
-        r, w, l = self.profiles.get(op_class, (0.0, 1.0, 0.0))
+        r, w, l = self.profile_of(op_class)
         if r > 0:
+            # the max(now, ...) floor IS the idle-tag-replay guard: a
+            # tenant that slept cannot bank reservation credit and
+            # replay it as an instantaneous burst
             item.r_tag = max(now, self._last_r.get(op_class, 0.0)
                              + item.cost / r)
             self._last_r[op_class] = item.r_tag
@@ -223,21 +357,33 @@ class MClockScheduler(OpSchedulerBase):
             + item.cost / max(w, 1e-9)
         self._last_p[op_class] = item.p_tag
         self._queues.setdefault(op_class, []).append(item)
+        self._prune_idle_tenants()
+
+    def _uncharge(self, op_class: str, item: _Item) -> None:
+        """A cancelled-before-grant op returns its full cost: the R/P
+        charge taken at enqueue AND the limit charge _select just
+        took when it popped the dead item."""
+        r, w, l = self.profile_of(op_class)
+        if r > 0 and op_class in self._last_r:
+            self._last_r[op_class] -= item.cost / r
+        if op_class in self._last_p:
+            self._last_p[op_class] -= item.cost / max(w, 1e-9)
+        if l > 0 and op_class in self._last_l:
+            self._last_l[op_class] -= item.cost / l
 
     def _limit_ok(self, op_class: str, now: float) -> bool:
-        _r, _w, l = self.profiles.get(op_class, (0.0, 1.0, 0.0))
+        _r, _w, l = self.profile_of(op_class)
         if l <= 0:
             return True
         return self._last_l.get(op_class, 0.0) <= now
 
     def _charge_limit(self, op_class: str, item: _Item,
                       now: float) -> None:
-        _r, _w, l = self.profiles.get(op_class, (0.0, 1.0, 0.0))
+        _r, _w, l = self.profile_of(op_class)
         if l > 0:
             self._last_l[op_class] = \
                 max(now, self._last_l.get(op_class, 0.0)) \
                 + item.cost / l
-
 
     def _select(self) -> Optional[Tuple[str, _Item]]:
         now = time.monotonic()
@@ -265,9 +411,22 @@ class MClockScheduler(OpSchedulerBase):
         self._charge_limit(op_class, item, now)
         return op_class, item
 
+    def stats(self) -> Dict[str, Any]:
+        out = super().stats()
+        out["tenant_classes"] = sum(
+            1 for c in self._last_p if c.startswith(TENANT_PREFIX))
+        return out
+
+
+#: kwargs only the mClock discipline understands (make_scheduler
+#: filters them for WPQ so one config surface serves both)
+_MCLOCK_ONLY = ("profiles", "tenant_default", "tenant_profiles")
+
 
 def make_scheduler(kind: str, **kwargs):
     """osd_op_queue option: 'mclock_scheduler' (default) or 'wpq'."""
     if kind in ("wpq", "WPQ"):
+        for key in _MCLOCK_ONLY:
+            kwargs.pop(key, None)
         return WPQScheduler(**kwargs)
     return MClockScheduler(**kwargs)

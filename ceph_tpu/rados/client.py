@@ -11,6 +11,8 @@ and librados::IoCtx (librados_cxx.cc:1247) as the user-facing surface.
 from __future__ import annotations
 
 import asyncio
+import contextlib
+import contextvars
 import logging
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -43,7 +45,27 @@ log = logging.getLogger("rados")
 
 EAGAIN = -11
 ENOENT = -2
+EBUSY = -16
 ESTALE = -116
+
+#: QoS tenant identity riding MOSDOp v4.  A ContextVar instead of a
+#: parameter on every I/O call: the S3 gateway authenticates a request
+#: and every rados op that request fans into inherits the tenant with
+#: zero signature churn (each asyncio task gets its own copy).  An
+#: explicit `IoCtx.tenant` overrides it.
+CURRENT_TENANT: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "rados_tenant", default="")
+
+
+@contextlib.contextmanager
+def tenant_scope(tenant: str):
+    """Ops submitted inside the scope carry `tenant` (unless the
+    IoCtx pins its own)."""
+    token = CURRENT_TENANT.set(tenant)
+    try:
+        yield
+    finally:
+        CURRENT_TENANT.reset(token)
 
 
 def full_jitter(base: float, attempt: int, cap: float = 5.0) -> float:
@@ -445,11 +467,14 @@ class RadosClient:
             await asyncio.sleep(0.01)
         raise TimeoutError(f"pool {name!r} never appeared in the map")
 
-    def open_ioctx(self, pool_name: str) -> "IoCtx":
+    def open_ioctx(self, pool_name: str,
+                   tenant: str = "") -> "IoCtx":
         pool_id = self.osdmap.lookup_pool(pool_name)
         if pool_id < 0:
             raise KeyError(f"no pool {pool_name!r}")
-        return IoCtx(self, pool_id)
+        io = IoCtx(self, pool_id)
+        io.tenant = tenant
+        return io
 
     async def df(self) -> Dict[str, Any]:
         """Cluster + per-pool usage (the librados cluster_stat /
@@ -513,6 +538,9 @@ class IoCtx:
         self.snapc_seq = 0
         self.snapc_snaps: List[int] = []
         self.read_snap = 0
+        # QoS tenant pinned to this IoCtx ("" = inherit the ambient
+        # tenant_scope / CURRENT_TENANT)
+        self.tenant = ""
 
     @property
     def pool(self):
@@ -598,7 +626,9 @@ class IoCtx:
                              ops, osdmap.epoch,
                              snapc_seq=self.snapc_seq,
                              snapc_snaps=self.snapc_snaps,
-                             snap_id=self.read_snap)
+                             snap_id=self.read_snap,
+                             tenant=self.tenant
+                             or CURRENT_TENANT.get())
                 if span is not None:
                     msg.trace = span.context
                     span.event(f"sent to osd.{primary}"

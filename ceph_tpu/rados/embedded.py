@@ -54,6 +54,12 @@ class LocalCluster:
                  store_path: Optional[str] = None, config=None):
         self.osdmap = OSDMap.build_simple(num_osds,
                                           osds_per_host=osds_per_host)
+        # the embedded cluster mutates its map only through
+        # apply_incremental (mark_osd_down/up), so the per-epoch
+        # placement memo is safe — and the open-loop load harness
+        # issues enough ops that an uncached CRUSH walk per op would
+        # measure the mapper, not the store
+        self.osdmap.enable_placement_cache()
         self.stores: Dict[int, ObjectStore] = {}
         self._codecs: Dict[int, object] = {}
         self._stripe_unit = 4096  # osd_pool_erasure_code_stripe_unit
@@ -240,7 +246,11 @@ class IoCtx:
             hinfo = hi
         return shards, size, hinfo
 
-    def read(self, name: str) -> bytes:
+    def read(self, name: str, offset: int = 0,
+             length: int = 0) -> bytes:
+        """Full read, or a ranged read when offset/length given
+        (length 0 = to the end) — the librados read(off, len) shape
+        the load harness's ranged-GET blend drives."""
         pg = self.object_pg(name)
         acting, _primary = self.acting(pg)
         if self.pool.type == TYPE_REPLICATED:
@@ -254,7 +264,8 @@ class IoCtx:
                     data = store.read(cid, ObjectId(name))
                     oi = json.loads(store.getattr(cid, ObjectId(name),
                                                   OI_ATTR))
-                    return data[:oi["size"]]
+                    return self._slice(data[:oi["size"]], offset,
+                                       length)
                 except (KeyError, IOError):
                     continue
             raise KeyError(name)
@@ -273,7 +284,14 @@ class IoCtx:
         minimum = codec.minimum_to_decode(want, set(shards))
         use = {s: shards[s] for s in minimum if s in shards}
         data = ec_util.decode(sinfo, codec, use)
-        return data[:size]
+        return self._slice(data[:size], offset, length)
+
+    @staticmethod
+    def _slice(data: bytes, offset: int, length: int) -> bytes:
+        if offset <= 0 and length <= 0:
+            return data
+        end = offset + length if length > 0 else len(data)
+        return data[max(offset, 0):end]
 
     def stat(self, name: str) -> Dict[str, int]:
         pg = self.object_pg(name)

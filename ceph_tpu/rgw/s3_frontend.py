@@ -490,6 +490,13 @@ class S3Frontend:
                 # the canned-ACL checks below (RGWHandler_REST's
                 # anonymous auth applier role)
                 access = None
+            # the authenticated identity IS the QoS tenant: every
+            # rados op this request fans into carries it (MOSDOp v4),
+            # so the OSDs' per-tenant mClock classes and admission
+            # gate see s3 traffic per access key, not as one blob
+            from ceph_tpu.rados.client import CURRENT_TENANT
+
+            CURRENT_TENANT.set(f"s3:{access}" if access else "s3:anon")
             q = dict(urllib.parse.parse_qsl(query,
                                             keep_blank_values=True))
             parts = urllib.parse.unquote(path).lstrip("/").split("/", 1)

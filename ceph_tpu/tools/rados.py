@@ -154,7 +154,15 @@ async def _bench(io, args) -> int:
     `seq --read-skew <theta>` runs the skewed-read leg: prefill
     --objects objects, then hammer them with a deterministic
     Zipf(theta) index stream — the workload shape that demonstrates
-    (and regression-tests) read-tier hit rates."""
+    (and regression-tests) read-tier hit rates.
+
+    `--tenants N` switches the bench to the OPEN-LOOP multi-tenant
+    harness (ceph_tpu/loadgen): N simulated tenants fire ops on a
+    Poisson schedule at --arrival-rate ops/s each with the --blend
+    op mix, latency measured from scheduled arrival (queueing delay
+    counted), goodput + streaming p50/p95/p99 reported."""
+    if getattr(args, "tenants", 0) > 0:
+        return await _bench_loadgen(io, args)
     size = args.block_size
     payload = np.random.default_rng(0).integers(
         0, 256, size, dtype=np.uint8).tobytes()
@@ -219,6 +227,30 @@ async def _bench(io, args) -> int:
     return 0
 
 
+async def _bench_loadgen(io, args) -> int:
+    """Open-loop multi-tenant leg: the CLI front door onto the
+    loadgen subsystem (ceph_tpu/loadgen)."""
+    from ceph_tpu.loadgen import (
+        RadosTarget, make_tenants, parse_blend, run_open_loop,
+    )
+
+    blend = parse_blend(getattr(args, "blend", "") or "")
+    # --read-skew is the tenants' zipf theta here, taken literally:
+    # an explicit 0 means uniform popularity (same semantics as the
+    # closed-loop skewed-read leg)
+    tenants = make_tenants(
+        int(args.tenants), rate=float(args.arrival_rate),
+        blend=blend, zipf_theta=float(args.read_skew),
+        objects=int(args.objects), object_size=int(args.block_size))
+    target = RadosTarget(io)
+    await target.setup(int(args.objects), int(args.block_size))
+    report = await run_open_loop(target, tenants,
+                                 duration=float(args.seconds),
+                                 seed=int(args.seed))
+    _out({"mode": "loadgen", "blend": blend, **report})
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="rados")
     ap.add_argument("-m", "--mon", required=True,
@@ -276,6 +308,18 @@ def main(argv=None) -> int:
                        help="seq --read-skew: prefilled object count")
     bench.add_argument("--seed", type=int, default=0,
                        help="seq --read-skew: deterministic rng seed")
+    bench.add_argument("--tenants", type=int, default=0,
+                       help="open-loop mode: number of simulated"
+                            " tenants (0 = classic closed-loop"
+                            " bench)")
+    bench.add_argument("--arrival-rate", type=float, default=2.0,
+                       dest="arrival_rate", metavar="OPS_PER_SEC",
+                       help="open-loop mode: per-tenant Poisson"
+                            " arrival rate")
+    bench.add_argument("--blend", default="",
+                       help="open-loop mode: op mix, e.g."
+                            " read=0.7,write=0.2,stat=0.1"
+                            " (kinds: read write stat ranged)")
     args = ap.parse_args(argv)
     try:
         return asyncio.run(_run(args))

@@ -1,0 +1,23 @@
+"""Fixture: per-op latency samples appended to an unbounded list
+inside a bench loop."""
+
+import time
+
+
+async def sweep(target, events):
+    lats = []
+    for ev in events:
+        t0 = time.perf_counter()
+        await target.op(ev)
+        lats.append(time.perf_counter() - t0)  # expect: unbounded-latency-buffer
+    return lats
+
+
+async def sweep_named(target, events):
+    # the receiver NAME alone marks the buffer even when the sample
+    # expression carries no visible clock call
+    samples = []
+    for ev in events:
+        dt = await target.timed_op(ev)
+        samples.append(dt)  # expect: unbounded-latency-buffer
+    return samples

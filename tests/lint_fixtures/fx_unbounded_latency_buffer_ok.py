@@ -1,0 +1,32 @@
+"""Clean twin: latency streams into the bounded histogram; ordinary
+list building in loops stays unflagged."""
+
+import time
+
+from ceph_tpu.loadgen.stats import LatencyHistogram
+
+
+async def sweep(target, events):
+    hist = LatencyHistogram()
+    for ev in events:
+        t0 = time.perf_counter()
+        await target.op(ev)
+        hist.record(time.perf_counter() - t0)
+    return hist.to_dict()
+
+
+def collect_names(rows):
+    # a non-latency append in a loop is not a finding
+    names = []
+    for row in rows:
+        names.append(row.name)
+    return names
+
+
+def one_shot(target):
+    # an append OUTSIDE any loop is not a finding either
+    lats = []
+    t0 = time.perf_counter()
+    target.sync_op()
+    lats.append(time.perf_counter() - t0)
+    return lats

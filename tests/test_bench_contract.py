@@ -21,7 +21,7 @@ import bench  # noqa: E402
 
 CONTRACT_KEYS = {"metric", "value", "unit", "vs_baseline",
                  "plan_cache", "encode_service", "tier",
-                 "device_health", "tail", "truncated"}
+                 "device_health", "tail", "load", "truncated"}
 
 
 def test_contract_line_despite_hanging_backend(tmp_path):
@@ -79,6 +79,15 @@ def test_contract_line_despite_hanging_backend(tmp_path):
     assert contract["tail"]["hedges_fired"] >= 1
     assert contract["tail"]["cancelled_subreads"] >= 1
     assert contract["tail"]["leaked_tasks"] == 0
+    # the open-loop load probe ran: hundreds of tenants drove the
+    # embedded cluster, goodput + streaming percentiles came back,
+    # and the schedule generator is deterministic
+    assert contract["load"]["tenants"] >= 100
+    assert contract["load"]["completed"] >= 1
+    assert contract["load"]["goodput_mib_s"] > 0
+    assert contract["load"]["p99_ms"] is not None
+    assert contract["load"]["p99_ms"] > 0
+    assert contract["load"]["deterministic"] == 1
     assert contract["truncated"] is False
     # details stayed out of stdout (they belong in bench_details.json)
     assert len(stdout_lines) == 1
@@ -123,6 +132,11 @@ def test_budget_truncates_optional_sections(tmp_path):
     details = json.loads((tmp_path / "bench_details.json").read_text())
     assert details["truncated"] is True
     assert details["skipped_sections"]
+    # the new open-loop sections ride the SAME single budget
+    # decision: a tiny budget must skip them (never hang on them),
+    # and the skip is recorded
+    assert "load" in details["skipped_sections"]
+    assert "load_sweep" not in details
 
 
 def test_watchdog_contract_line_survives_outer_kill(tmp_path):

@@ -29,6 +29,7 @@ RULES = [
     "jit-bypass-plan",
     "unguarded-device-dispatch",
     "unhedged-gather",
+    "unbounded-latency-buffer",
     "async-blocking",
     "sync-encode-in-async",
     "lock-order",
@@ -41,7 +42,8 @@ CONFIG = {"dtype_paths": ("fx_uint8",),
           "plan_paths": ("fx_jit_bypass_plan",),
           "encode_paths": ("fx_sync_encode_in_async",),
           "device_paths": ("fx_unguarded_device_dispatch",),
-          "gather_paths": ("fx_unhedged_gather",)}
+          "gather_paths": ("fx_unhedged_gather",),
+          "latency_paths": ("fx_unbounded_latency_buffer",)}
 
 
 def _fixture(name: str) -> str:

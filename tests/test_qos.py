@@ -1,0 +1,606 @@
+"""Per-tenant mClock QoS tier: tag algebra edges, bounded queues,
+the admission gate, and the tenant identity threaded end to end
+(MOSDOp v4 -> per-tenant scheduler classes -> EBUSY sheds ->
+qos_status / perf-dump / prometheus surfaces).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from ceph_tpu.osd.admission import ADMIT, DELAY, SHED, AdmissionGate
+from ceph_tpu.osd.scheduler import (
+    CLIENT,
+    MClockScheduler,
+    QueueFull,
+    RECOVERY,
+    WPQScheduler,
+    make_scheduler,
+    tenant_class,
+)
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+async def _noop():
+    return None
+
+
+# -- scheduler introspection + bounded queues --------------------------
+
+
+def test_stats_exposes_depth_and_grants():
+    async def main():
+        sched = MClockScheduler(max_concurrent=1)
+        gate = asyncio.Event()
+
+        async def slow():
+            await gate.wait()
+
+        loop = asyncio.get_running_loop()
+        jobs = [loop.create_task(sched.run(CLIENT, 1.0, slow))
+                for _ in range(5)]
+        await asyncio.sleep(0.05)
+        st = sched.stats()
+        assert st["max_concurrent"] == 1
+        assert st["in_flight"] == 1
+        assert st["queued"] == 4
+        assert st["queue_depths"].get(CLIENT) == 4
+        assert st["max_queue_depth"] >= 1
+        assert st["overflow"] in ("shed", "block")
+        gate.set()
+        await asyncio.gather(*jobs)
+        assert sched.stats()["granted"][CLIENT] == 5
+        assert sched.stats()["queued"] == 0
+        await sched.stop()
+
+    run(main())
+
+
+def test_bounded_queue_sheds_with_queue_full():
+    async def main():
+        sched = MClockScheduler(max_concurrent=1, max_queue_depth=2,
+                                overflow="shed")
+        gate = asyncio.Event()
+
+        async def slow():
+            await gate.wait()
+
+        loop = asyncio.get_running_loop()
+        jobs = []
+        for _ in range(3):  # 1 granted + 2 queued (the bound)
+            jobs.append(loop.create_task(
+                sched.run(CLIENT, 1.0, slow)))
+            await asyncio.sleep(0.02)
+        assert sched.stats()["queue_depths"].get(CLIENT) == 2
+        with pytest.raises(QueueFull):
+            await sched.run(CLIENT, 1.0, slow)
+        assert sched.stats()["queue_shed"][CLIENT] == 1
+        gate.set()
+        await asyncio.gather(*jobs)
+        await sched.stop()
+
+    run(main())
+
+
+def test_bounded_queue_block_policy_backpressures():
+    async def main():
+        sched = MClockScheduler(max_concurrent=1, max_queue_depth=2,
+                                overflow="block")
+        gate = asyncio.Event()
+
+        async def slow():
+            await gate.wait()
+
+        loop = asyncio.get_running_loop()
+        jobs = [loop.create_task(sched.run(CLIENT, 1.0, slow))
+                for _ in range(3)]
+        await asyncio.sleep(0.05)
+        blocked = loop.create_task(sched.run(CLIENT, 1.0, slow))
+        await asyncio.sleep(0.05)
+        assert not blocked.done()         # parked, not shed
+        gate.set()                        # drain unblocks it
+        await asyncio.gather(*jobs, blocked)
+        assert sched.stats()["granted"][CLIENT] == 4
+        await sched.stop()
+
+    run(main())
+
+
+# -- mClock tag algebra edges ------------------------------------------
+
+
+def test_limit_pinned_class_never_starves_reservation():
+    """A class flooding at its limit tag must not starve a
+    reservation-backed class: the reservation phase runs FIRST and
+    the limited class's excess waits."""
+    async def main():
+        sched = MClockScheduler(profiles={
+            "pinned": (0.0, 100.0, 30.0),   # huge weight, hard cap
+            "reserved": (40.0, 0.1, 0.0),   # floor, tiny weight
+        }, max_concurrent=2)
+        counts = {"pinned": 0, "reserved": 0}
+        stop = [False]
+
+        async def bump(cls):
+            counts[cls] += 1
+            await asyncio.sleep(0.002)
+
+        async def flood():
+            while not stop[0]:
+                await sched.run("pinned", 1.0,
+                                lambda: bump("pinned"))
+
+        loop = asyncio.get_running_loop()
+        floods = [loop.create_task(flood()) for _ in range(4)]
+        t0 = time.monotonic()
+        jobs = []
+        while time.monotonic() - t0 < 1.0:
+            jobs.append(sched.run("reserved", 1.0,
+                                  lambda: bump("reserved")))
+            await asyncio.sleep(0.01)
+        await asyncio.gather(*jobs)
+        stop[0] = True
+        for t in floods:
+            t.cancel()
+        await asyncio.gather(*floods, return_exceptions=True)
+        elapsed = time.monotonic() - t0
+        # reservation held: >= ~half the 40/s floor despite the flood
+        assert counts["reserved"] >= 20 * elapsed * 0.5, counts
+        # the pinned class was capped near its 30/s limit, not its
+        # weight share (generous ceiling for grant-loop slack)
+        assert counts["pinned"] <= 30 * elapsed * 1.8 + 8, counts
+        await sched.stop()
+
+    run(main())
+
+
+def test_cancelled_before_grant_returns_cost():
+    """An op cancelled while queued gives back its R/P/L charge: the
+    class's next op tags as if the dead op never existed."""
+    async def main():
+        sched = MClockScheduler(profiles={
+            "t": (10.0, 2.0, 20.0)}, max_concurrent=1)
+        gate = asyncio.Event()
+
+        async def slow():
+            await gate.wait()
+
+        loop = asyncio.get_running_loop()
+        holder = loop.create_task(sched.run("t", 1.0, slow))
+        await asyncio.sleep(0.02)
+        p_before = sched._last_p.get("t")
+        r_before = sched._last_r.get("t")
+        victim = loop.create_task(sched.run("t", 4.0, _noop))
+        await asyncio.sleep(0.02)
+        # the queued victim advanced the class tags
+        assert sched._last_p["t"] > p_before
+        victim.cancel()
+        await asyncio.gather(victim, return_exceptions=True)
+        gate.set()          # holder finishes; grant loop pops victim
+        await holder
+        await asyncio.sleep(0.02)
+        assert sched.cancelled_before_grant == 1
+        # refunded: tags back to (about) the pre-victim values
+        assert abs(sched._last_p["t"] - p_before) < 1e-6
+        assert abs(sched._last_r["t"] - r_before) < 1e-6
+        await sched.stop()
+
+    run(main())
+
+
+def test_idle_tenant_burst_does_not_replay_idle_tags():
+    """The idle-class tag-replay floor: a tenant that sleeps then
+    bursts must tag from NOW — not from its stale last tag (which
+    would grant it an instant backlog advantage over the classes
+    that kept working), and not be penalized either."""
+    async def main():
+        sched = MClockScheduler(profiles={
+            "sleeper": (50.0, 1.0, 0.0),
+            "steady": (50.0, 1.0, 0.0)}, max_concurrent=1)
+        # steady class works for a while
+        for _ in range(5):
+            await sched.run("steady", 1.0, _noop)
+        await asyncio.sleep(0.3)   # sleeper idle the whole time
+        now = time.monotonic()
+        gate = asyncio.Event()
+
+        async def slow():
+            await gate.wait()
+
+        loop = asyncio.get_running_loop()
+        holder = loop.create_task(sched.run("steady", 1.0, slow))
+        await asyncio.sleep(0.02)
+        burst = [loop.create_task(sched.run("sleeper", 1.0, _noop))
+                 for _ in range(3)]
+        await asyncio.sleep(0.02)
+        # the burst's tags anchor at >= now: no banked idle credit
+        # (r_tag floors at now; p_tag at now + cost/weight)
+        assert sched._last_r["sleeper"] >= now - 1e-3
+        assert sched._last_p["sleeper"] >= now - 1e-3
+        gate.set()
+        await asyncio.gather(holder, *burst)
+        await sched.stop()
+
+    run(main())
+
+
+def test_wpq_uncharge_on_cancelled_grant():
+    async def main():
+        sched = WPQScheduler(weights={CLIENT: 2.0}, max_concurrent=1)
+        gate = asyncio.Event()
+
+        async def slow():
+            await gate.wait()
+
+        loop = asyncio.get_running_loop()
+        holder = loop.create_task(sched.run(CLIENT, 1.0, slow))
+        await asyncio.sleep(0.02)
+        served_before = sched._served.get(CLIENT, 0.0)
+        victim = loop.create_task(sched.run(CLIENT, 6.0, _noop))
+        await asyncio.sleep(0.02)
+        victim.cancel()
+        await asyncio.gather(victim, return_exceptions=True)
+        gate.set()
+        await holder
+        await asyncio.sleep(0.02)
+        # the pop charged then refunded: net zero for the dead op
+        assert abs(sched._served[CLIENT] - served_before) < 1e-9
+        assert sched.cancelled_before_grant == 1
+        await sched.stop()
+
+    run(main())
+
+
+# -- per-tenant classes ------------------------------------------------
+
+
+def test_tenant_profile_resolution():
+    sched = MClockScheduler(tenant_default=(1.0, 2.0, 3.0),
+                            tenant_profiles={"gold": (9.0, 8.0, 0.0)})
+    assert sched.profile_of(tenant_class("gold")) == (9.0, 8.0, 0.0)
+    assert sched.profile_of(tenant_class("other")) == (1.0, 2.0, 3.0)
+    assert sched.profile_of(CLIENT)[1] == 10.0   # stock class intact
+    assert sched.profile_of(RECOVERY)[0] == 25.0
+    assert tenant_class("") == CLIENT
+
+
+def test_make_scheduler_filters_mclock_kwargs_for_wpq():
+    w = make_scheduler("wpq", tenant_default=(0, 1, 0),
+                       tenant_profiles={}, max_queue_depth=7)
+    assert isinstance(w, WPQScheduler)
+    assert w.max_queue_depth == 7
+    m = make_scheduler("mclock_scheduler",
+                       tenant_profiles={"a": (1, 1, 1)})
+    assert isinstance(m, MClockScheduler)
+
+
+def test_tenant_state_stays_bounded():
+    """Millions of tenants must not grow the tag maps without bound:
+    idle tenant classes are pruned past the cap."""
+    from ceph_tpu.osd import scheduler as sched_mod
+
+    async def main():
+        sched = MClockScheduler(max_concurrent=4)
+        old_cap = sched_mod.TENANT_STATE_CAP
+        sched_mod.TENANT_STATE_CAP = 64
+        try:
+            for i in range(300):
+                await sched.run(tenant_class(f"t{i}"), 1.0, _noop)
+            assert len(sched._last_p) <= 64 + 4, len(sched._last_p)
+        finally:
+            sched_mod.TENANT_STATE_CAP = old_cap
+        await sched.stop()
+
+    run(main())
+
+
+def test_tenant_limit_paces_grants():
+    """A tenant's limit tag spaces its grants at the limit rate even
+    with an idle scheduler (the scrub-trickle discipline, per
+    tenant)."""
+    async def main():
+        sched = MClockScheduler(
+            tenant_default=(0.0, 1.0, 0.0),
+            tenant_profiles={"capped": (0.0, 10.0, 25.0)},
+            max_concurrent=4)
+        count = [0]
+
+        async def op():
+            count[0] += 1
+
+        loop = asyncio.get_running_loop()
+        t0 = time.monotonic()
+        jobs = [loop.create_task(
+            sched.run(tenant_class("capped"), 1.0, op))
+            for _ in range(100)]
+        done, pending = await asyncio.wait(jobs, timeout=1.0)
+        elapsed = time.monotonic() - t0
+        for p in pending:
+            p.cancel()
+        await asyncio.gather(*pending, return_exceptions=True)
+        assert count[0] <= 25 * elapsed * 1.8 + 5, count[0]
+        assert count[0] >= 5, count[0]
+        await sched.stop()
+
+    run(main())
+
+
+# -- admission gate ----------------------------------------------------
+
+
+def test_admission_burst_then_shed():
+    async def main():
+        g = AdmissionGate(
+            config={"osd_mclock_admission_burst": 2.0,
+                    "osd_mclock_admission_max_delay_ms": 1.0},
+            profile_of=lambda t: (0.0, 1.0, 5.0))
+        decisions = [await g.admit("t", 1.0) for _ in range(40)]
+        assert decisions.count(ADMIT) == 10   # 5/s x 2s burst
+        assert decisions.count(SHED) == 30
+        assert g.counters[SHED] == 30
+
+    run(main())
+
+
+def test_admission_delay_smooths_small_overruns():
+    async def main():
+        g = AdmissionGate(
+            config={"osd_mclock_admission_burst": 0.01,
+                    "osd_mclock_admission_max_delay_ms": 100.0},
+            profile_of=lambda t: (0.0, 1.0, 50.0))
+        t0 = time.monotonic()
+        decisions = [await g.admit("t", 1.0) for _ in range(5)]
+        elapsed = time.monotonic() - t0
+        # delayed ops still ADMIT (the caller proceeds after the
+        # in-gate sleep); the smoothing shows in the counters and in
+        # wall clock, and nothing was refused
+        assert SHED not in decisions
+        assert g.counters[DELAY] >= 4
+        assert elapsed >= 0.04            # ~4 ops of in-gate pacing
+
+    run(main())
+
+
+def test_admission_unlimited_and_disabled_paths():
+    async def main():
+        g = AdmissionGate(profile_of=lambda t: (0.0, 1.0, 0.0))
+        for _ in range(100):
+            assert await g.admit("free") == ADMIT
+        off = AdmissionGate(
+            config={"osd_mclock_admission_enable": False},
+            profile_of=lambda t: (0.0, 1.0, 0.001))
+        for _ in range(10):
+            assert await off.admit("t") == ADMIT
+        assert off.counters[SHED] == 0
+
+    run(main())
+
+
+def test_admission_state_is_bounded():
+    async def main():
+        from ceph_tpu.osd import admission as adm_mod
+
+        g = AdmissionGate(profile_of=lambda t: (0.0, 1.0, 100.0))
+        old = adm_mod._BUCKET_CAP
+        adm_mod._BUCKET_CAP = 32
+        try:
+            for i in range(200):
+                await g.admit(f"t{i}")
+            assert len(g._buckets) <= 32
+            assert len(g._tenant_counters) <= 32
+        finally:
+            adm_mod._BUCKET_CAP = old
+
+    run(main())
+
+
+# -- scheduler-level isolation (the bench_qos property, fast) ----------
+
+
+def test_tenant_isolation_under_flood():
+    """Tenant B's latency holds while tenant A floods 10x its limit:
+    A is capped by its limit tag, B's reservation carries it.  The
+    scheduler-level twin of the bench_qos acceptance leg."""
+    async def main():
+        sched = MClockScheduler(
+            tenant_profiles={"A": (0.0, 1.0, 50.0),
+                             "B": (50.0, 5.0, 0.0)},
+            max_concurrent=2)
+
+        async def work():
+            await asyncio.sleep(0.002)
+
+        stop = [False]
+
+        async def flood():
+            while not stop[0]:
+                try:
+                    await sched.run(tenant_class("A"), 1.0, work)
+                except QueueFull:
+                    await asyncio.sleep(0.001)
+
+        loop = asyncio.get_running_loop()
+        floods = [loop.create_task(flood()) for _ in range(8)]
+        await asyncio.sleep(0.1)
+        lats = []
+        for _ in range(30):
+            t0 = time.monotonic()
+            await sched.run(tenant_class("B"), 1.0, work)
+            lats.append(time.monotonic() - t0)
+            await asyncio.sleep(0.01)
+        stop[0] = True
+        for t in floods:
+            t.cancel()
+        await asyncio.gather(*floods, return_exceptions=True)
+        lats.sort()
+        p95 = lats[int(0.95 * (len(lats) - 1))]
+        # B's reservation keeps p95 in the tens of ms despite the
+        # 8-way flood (generous for CI jitter; without QoS this sits
+        # behind A's whole backlog)
+        assert p95 < 0.25, lats
+        await sched.stop()
+
+    run(main())
+
+
+# -- end to end: tenant identity over the wire -------------------------
+
+
+def test_cluster_tenant_shed_and_observability():
+    """A burst far over a tenant's limit is shed with EBUSY at the
+    admission gate BEFORE execution; qos_status, perf dump and the
+    prometheus flattener all surface the decisions with tenant
+    labels."""
+    from cluster_helpers import Cluster
+    from ceph_tpu.rados.client import RadosError
+
+    async def main():
+        cluster = Cluster(
+            num_osds=3, osds_per_host=3,
+            osd_config={"osd_mclock_tenant_profiles":
+                        '{"bad": [0, 1, 5]}',
+                        "osd_mclock_admission_max_delay_ms": 5.0})
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool(
+                "p", size=2, pg_num=8)
+            io = cluster.client.open_ioctx("p")
+            await io.write_full("o1", b"x" * 1000)
+            bad = cluster.client.open_ioctx("p", tenant="bad")
+
+            async def one():
+                try:
+                    await bad.stat("o1")
+                    return "ok"
+                except RadosError as e:
+                    assert e.rc == -16, e.rc
+                    return "shed"
+
+            res = await asyncio.gather(*(one() for _ in range(40)))
+            assert res.count("shed") >= 20, res
+            assert res.count("ok") >= 5, res
+
+            sheds = 0
+            qos = None
+            for o in cluster.osds:
+                rc, st = await cluster.client.osd_command(
+                    o, {"prefix": "qos_status"})
+                assert rc == 0
+                sheds += st["admission"]["decisions"]["shed"]
+                if st["admission"]["decisions"]["shed"]:
+                    qos = st
+            assert sheds >= 20
+            assert qos is not None
+            assert qos["tenant_profiles"]["bad"] == [0.0, 1.0, 5.0]
+            assert "bad" in qos["admission"]["tenants"]
+            assert qos["admission"]["tenants"]["bad"]["limit_ops"] \
+                == 5.0
+
+            # perf dump carries the nested qos section...
+            total_shed = 0
+            shed_perf = None
+            for o in cluster.osds:
+                rc, p = await cluster.client.osd_command(
+                    o, {"prefix": "perf dump"})
+                assert rc == 0 and "qos" in p
+                total_shed += p["qos"]["shed"]
+                if p["qos"]["shed"]:
+                    shed_perf = p
+            assert total_shed >= 20
+            p = shed_perf
+            assert p is not None
+            # ...and the prometheus flattener labels tenants
+            from ceph_tpu.mgr.prometheus import PrometheusModule
+
+            lines: list = []
+            seen: set = set()
+            PrometheusModule._emit_perf(
+                lines, seen, "ceph_osd_qos", p["qos"],
+                {"ceph_daemon": "osd.0"})
+            body = "\n".join(lines)
+            assert 'tenant="bad"' in body
+            assert "ceph_osd_qos_tenant_shed{" in body
+            assert "# TYPE ceph_osd_qos_queued gauge" in body
+        finally:
+            await cluster.stop()
+
+    run(main(), timeout=120)
+
+
+def test_cluster_qos_kill_switch(monkeypatch):
+    """CEPH_TPU_QOS=0: tenant tags are ignored — every client op
+    schedules in the shared class, the gate admits everything."""
+    monkeypatch.setenv("CEPH_TPU_QOS", "0")
+    from cluster_helpers import Cluster
+
+    async def main():
+        cluster = Cluster(
+            num_osds=3, osds_per_host=3,
+            osd_config={"osd_mclock_tenant_profiles":
+                        '{"bad": [0, 1, 2]}'})
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool(
+                "p", size=2, pg_num=8)
+            io = cluster.client.open_ioctx("p")
+            await io.write_full("o1", b"x" * 100)
+            bad = cluster.client.open_ioctx("p", tenant="bad")
+            await asyncio.gather(*(bad.stat("o1")
+                                   for _ in range(30)))
+            granted: dict = {}
+            for osd in cluster.osds.values():
+                assert not osd._qos_tenants_enabled
+                assert osd.admission.counters["shed"] == 0
+                for cls, n in osd.scheduler.granted.items():
+                    granted[cls] = granted.get(cls, 0) + n
+            assert "client.bad" not in granted
+            assert granted.get("client", 0) >= 30
+        finally:
+            await cluster.stop()
+
+    run(main(), timeout=120)
+
+
+def test_untagged_ops_unaffected_by_tenant_machinery():
+    """No tenant on the op (stock clients, MOSDOp <= v3 peers):
+    exactly the pre-QoS behavior — shared class, no admission
+    charge."""
+    from cluster_helpers import Cluster
+
+    async def main():
+        cluster = Cluster(num_osds=3, osds_per_host=3)
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool(
+                "p", size=2, pg_num=8)
+            io = cluster.client.open_ioctx("p")
+            await io.write_full("o1", b"y" * 512)
+            assert await io.read("o1") == b"y" * 512
+            for osd in cluster.osds.values():
+                assert all(not c.startswith("client.")
+                           for c in osd.scheduler.granted)
+        finally:
+            await cluster.stop()
+
+    run(main(), timeout=120)
+
+
+def test_mosdop_v4_tenant_round_trip_and_v3_compat():
+    from ceph_tpu.msg.messages import MOSDOp, OSDOp
+    from ceph_tpu.osd.osdmap import PgId
+
+    msg = MOSDOp(7, "client.x", PgId(1, 2), "obj",
+                 [OSDOp("read")], 9, tenant="acme")
+    got = MOSDOp.decode(msg.encode())
+    assert got.tenant == "acme"
+    assert got.oid == "obj" and got.tid == 7
+    # an untagged (default) op decodes tenant ""
+    msg2 = MOSDOp(8, "client.y", PgId(1, 2), "o2",
+                  [OSDOp("stat")], 9)
+    assert MOSDOp.decode(msg2.encode()).tenant == ""
