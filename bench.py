@@ -64,6 +64,7 @@ def _emit_contract(value: Optional[float],
                    device_health: Optional[dict] = None,
                    tail: Optional[dict] = None,
                    load: Optional[dict] = None,
+                   durability: Optional[dict] = None,
                    truncated: bool = False) -> None:
     """Print the one-line JSON driver contract, exactly once, before
     any optional extended benches run — a wedged tunnel or a crashed
@@ -75,9 +76,12 @@ def _emit_contract(value: Optional[float],
     recovered), tail the hedged-read scheduler probe (first-k
     completion under an injected straggler, cancellation-clean), load
     the open-loop multi-tenant harness probe (goodput + streaming
-    p50/p95/p99 over the embedded cluster, deterministic schedules);
-    truncated flags a budget-shortened run.  Thread-safe: the deadline
-    watchdog and the bench body may race to emit."""
+    p50/p95/p99 over the embedded cluster, deterministic schedules),
+    durability the crash-consistency probe (smoke power-cut sweep over
+    TPUStore: crash points explored, zero invariant violations, and
+    the deliberately-broken store caught as a self-test); truncated
+    flags a budget-shortened run.  Thread-safe: the deadline watchdog
+    and the bench body may race to emit."""
     global _contract_emitted
     with _contract_lock:
         if _contract_emitted:
@@ -95,6 +99,7 @@ def _emit_contract(value: Optional[float],
             "device_health": device_health,
             "tail": tail,
             "load": load,
+            "durability": durability,
             "truncated": bool(truncated),
         }), flush=True)
 
@@ -241,6 +246,46 @@ def bench_degraded() -> dict:
     }
 
 
+def _probe_on_daemon_thread(name: str, body, timeout_env: str,
+                            default_timeout: str) -> Optional[dict]:
+    """Run a pre-contract probe body on a DAEMON thread under a hard
+    timeout — not a ThreadPoolExecutor: executor workers are
+    non-daemon and joined at interpreter exit, so a wedged dispatch
+    (or filesystem) would hang the whole bench after the contract
+    line.  Returns the body's dict, or None (with a stderr note) when
+    the probe is over budget, wedges past the timeout, or fails."""
+    if _remaining() < 0:
+        print(f"# {name} probe skipped: budget exhausted",
+              file=sys.stderr)
+        return None
+    probe_timeout = float(os.environ.get(timeout_env, default_timeout))
+    try:
+        import threading
+
+        box: dict = {}
+
+        def runner():
+            try:
+                box["out"] = body()
+            except BaseException as e:  # surfaced below
+                box["err"] = e
+
+        t = threading.Thread(target=runner, daemon=True,
+                             name=f"{name}-probe")
+        t.start()
+        t.join(probe_timeout)
+        if t.is_alive():
+            print(f"# {name} probe timed out (wedged?)",
+                  file=sys.stderr)
+            return None
+        if "err" in box:
+            raise box["err"]
+        return box.get("out")
+    except Exception as e:
+        print(f"# {name} probe failed: {e!r}", file=sys.stderr)
+        return None
+
+
 def _tier_probe() -> Optional[dict]:
     """Pre-contract probe of the hot-set/read-tier subsystem: the
     device-batched bloom positions must match the host rjenkins oracle
@@ -250,42 +295,11 @@ def _tier_probe() -> Optional[dict]:
 
     Contract-first discipline (same as _service_probe): skipped when
     the wall-clock budget is spent, and the body — which includes a
-    device dispatch — runs on a worker thread under a hard timeout so
+    device dispatch — runs on a daemon thread under a hard timeout so
     a wedged tunnel cannot park the bench past the contract line."""
-    if _remaining() < 0:
-        print("# tier probe skipped: budget exhausted",
-              file=sys.stderr)
-        return None
-    probe_timeout = float(os.environ.get(
-        "CEPH_TPU_BENCH_TIER_PROBE_TIMEOUT", "60"))
-    try:
-        # a DAEMON thread, not a ThreadPoolExecutor: executor workers
-        # are non-daemon and joined at interpreter exit, so a wedged
-        # dispatch would hang the whole bench after the contract line
-        import threading
-
-        box: dict = {}
-
-        def runner():
-            try:
-                box["out"] = _tier_probe_body()
-            except BaseException as e:  # surfaced below
-                box["err"] = e
-
-        t = threading.Thread(target=runner, daemon=True,
-                             name="tier-probe")
-        t.start()
-        t.join(probe_timeout)
-        if t.is_alive():
-            print("# tier probe timed out (wedged dispatch?)",
-                  file=sys.stderr)
-            return None
-        if "err" in box:
-            raise box["err"]
-        return box.get("out")
-    except Exception as e:
-        print(f"# tier probe failed: {e!r}", file=sys.stderr)
-        return None
+    return _probe_on_daemon_thread(
+        "tier", _tier_probe_body,
+        "CEPH_TPU_BENCH_TIER_PROBE_TIMEOUT", "60")
 
 
 def _tier_probe_body() -> dict:
@@ -604,6 +618,84 @@ def bench_load() -> dict:
     out["load_peak_goodput_mib_s"] = max(
         (r["goodput_mib_s"] for r in out["load_sweep"]), default=None)
     return out
+
+
+def _durability_probe() -> Optional[dict]:
+    """Pre-contract probe of the crash-consistency layer
+    (ceph_tpu/os/faultstore.py): a smoke power-cut sweep over a mixed
+    TPUStore workload — every explored crash point must satisfy the
+    invariants (mount succeeds, acked txns visible, replay idempotent,
+    csums clean, freelist/blob map consistent) — plus the harness
+    SELF-TEST: the same sweep pointed at a store with its pre-commit
+    fsync removed must report violations.  Counters land in the
+    contract line's `durability` key; None (with a stderr note) when
+    the probe cannot run.
+
+    Contract-first discipline: skipped when the wall-clock budget is
+    spent; the body runs on a daemon thread under a hard timeout so a
+    wedged filesystem cannot park the bench past the contract line.
+    Smoke sizing via CEPH_TPU_BENCH_DURABILITY_TXNS/_POINTS."""
+    return _probe_on_daemon_thread(
+        "durability", _durability_probe_body,
+        "CEPH_TPU_BENCH_DURABILITY_PROBE_TIMEOUT", "90")
+
+
+def _durability_probe_body() -> dict:
+    """The probe proper; failures propagate to the runner thread's
+    capture in _durability_probe — one reporting layer."""
+    import shutil
+    import tempfile
+
+    from ceph_tpu.os.faultstore import BrokenBlockStore, CrashSweep
+
+    txns = int(os.environ.get("CEPH_TPU_BENCH_DURABILITY_TXNS",
+                              "8" if _SMOKE else "16"))
+    max_points = int(os.environ.get(
+        "CEPH_TPU_BENCH_DURABILITY_POINTS",
+        "60" if _SMOKE else "150"))
+    workdir = tempfile.mkdtemp(prefix="bench-durability-")
+    try:
+        rep = CrashSweep(os.path.join(workdir, "good")).run(
+            txns=txns, max_points=max_points)
+        broken = CrashSweep(os.path.join(workdir, "broken"),
+                            store_cls=BrokenBlockStore).run(
+            txns=max(4, txns // 2), max_points=max_points,
+            double_crash=False)
+        return {
+            "points": rep["points"],
+            "distinct_images": rep["distinct_images"],
+            "double_crash_points": rep["double_crash_points"],
+            "violations": len(rep["violations"]),
+            "broken_store_caught": int(bool(broken["violations"])),
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def bench_durability() -> dict:
+    """The FULL crash sweep (every cut, every schedule, double-crash
+    legs) over a larger workload — the acceptance-sized run (>= 200
+    distinct crash points, zero violations), budget-gated like every
+    optional section."""
+    import shutil
+    import tempfile
+
+    from ceph_tpu.os.faultstore import CrashSweep
+
+    workdir = tempfile.mkdtemp(prefix="bench-durability-full-")
+    try:
+        t0 = time.monotonic()
+        rep = CrashSweep(workdir).run(txns=24)
+        return {
+            "durability_points": rep["points"],
+            "durability_distinct_images": rep["distinct_images"],
+            "durability_double_crash_points":
+                rep["double_crash_points"],
+            "durability_violations": len(rep["violations"]),
+            "durability_sweep_seconds": time.monotonic() - t0,
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
 
 
 def bench_qos() -> dict:
@@ -1435,6 +1527,9 @@ def main() -> None:
     # a thousand tenants over the embedded cluster, goodput +
     # streaming percentiles, deterministic schedules
     load_counters = _load_probe()
+    # crash-consistency probe (cheap, before the contract): smoke
+    # power-cut sweep with zero violations + broken-store self-test
+    durability_counters = _durability_probe()
 
     # the driver contract line, before every optional/extended bench:
     # a wedge below this point can cost detail rows, never the bench
@@ -1444,6 +1539,7 @@ def main() -> None:
                    device_health=device_health_counters,
                    tail=tail_counters,
                    load=load_counters,
+                   durability=durability_counters,
                    truncated=skip_optional)
 
     # decode sweep over 1..m erasures (the reference benchmark sweeps
@@ -1550,6 +1646,19 @@ def main() -> None:
         except Exception as e:
             print(f"# load bench failed: {e!r}", file=sys.stderr)
 
+    # full crash sweep: the acceptance-sized power-cut exploration
+    # (every cut/schedule + double-crash legs), zero violations
+    durability_section: dict = {}
+    if _SMOKE:
+        pass  # the pre-contract probe already swept smoke-sized
+    elif skip_optional:
+        skipped_sections.append("durability")
+    else:
+        try:
+            durability_section = bench_durability()
+        except Exception as e:
+            print(f"# durability bench failed: {e!r}", file=sys.stderr)
+
     # QoS isolation proof: tenant B's p99 across tenant A's 1x->10x
     # step, per-tenant mClock + admission gate on vs off.  Live
     # clusters x4: out of smoke mode (the scheduler-level isolation
@@ -1584,12 +1693,14 @@ def main() -> None:
         **tail_section,
         **degraded_section,
         **load_section,
+        **durability_section,
         **qos_section,
         "encode_service": service_counters,
         "tier": tier_counters,
         "device_health": device_health_counters,
         "tail": tail_counters,
         "load": load_counters,
+        "durability": durability_counters,
         "host_cores": os.cpu_count(),
         "encode_ms_per_batch": t_enc * 1e3,
         "k": k, "m": m, "chunk_bytes": chunk, "batch": batch,

@@ -38,6 +38,15 @@ EC dispatch discipline:
                        exception instead of degrading to the
                        bit-exact host path
 
+store durability discipline:
+  commit-before-durability
+                       `on_commit`/ack callbacks in ceph_tpu/os/
+                       reachable before the store's durability point
+                       (block fsync / sync KV batch): the acked
+                       transaction can vanish on power loss — the
+                       invariant the crash sweep (os/faultstore.py)
+                       checks dynamically, enforced here at lint time
+
 loadgen/bench discipline:
   unbounded-latency-buffer
                        appending per-op latency samples to a plain
@@ -829,6 +838,77 @@ def rule_lock_no_await(a: Analyzer) -> None:
                                scope_line=_scope_line(mod, node))
 
 
+# ---------------------------------------------------------------------
+# commit-before-durability
+# ---------------------------------------------------------------------
+
+# store modules whose commit callbacks are judged: firing `on_commit`
+# before the durability point (block fsync / sync KV batch) acks a
+# write a power cut can still lose — the one failure QoS, breakers and
+# hedging cannot paper over
+_DURABILITY_PATHS = ("ceph_tpu/os/",)
+# calls that establish durability for everything before them
+_DURABILITY_FSYNCS = {"os.fsync", "os.fdatasync"}
+_DURABILITY_ATTRS = {"fsync", "fdatasync", "submit_transaction_sync",
+                     "_block_sync"}
+
+
+def _is_durability_call(mod, node: ast.Call) -> bool:
+    if _resolved_callee(mod, node) in _DURABILITY_FSYNCS:
+        return True
+    return isinstance(node.func, ast.Attribute) and \
+        node.func.attr in _DURABILITY_ATTRS
+
+
+def rule_commit_before_durability(a: Analyzer) -> None:
+    """`on_commit`/ack callbacks reachable before the store's
+    durability point in ceph_tpu/os/: a `for cb in txn.on_commit:
+    cb()` loop with no fsync / `submit_transaction_sync` /
+    `_block_sync` lexically ahead of it acks a transaction that a
+    power cut can still erase.  The MemStore no-durability path is
+    intentional and baselined with a justification."""
+    paths = a.config.get("durability_paths", _DURABILITY_PATHS)
+    for mod in a.project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if not any(p in rel for p in paths):
+            continue
+        for fi in mod.functions.values():
+            durable_lines = [
+                node.lineno for node in walk_scope(fi.node)
+                if isinstance(node, ast.Call)
+                and _is_durability_call(mod, node)]
+            for node in walk_scope(fi.node):
+                if not isinstance(node, ast.For):
+                    continue
+                # `for cb in <expr>.on_commit:` (incl. list(...) wraps)
+                iter_attrs = {sub.attr for sub in ast.walk(node.iter)
+                              if isinstance(sub, ast.Attribute)}
+                if "on_commit" not in iter_attrs or \
+                        not isinstance(node.target, ast.Name):
+                    continue
+                cb = node.target.id
+                for sub in ast.walk(node):
+                    if not (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Name)
+                            and sub.func.id == cb):
+                        continue
+                    if not any(dl < sub.lineno
+                               for dl in durable_lines):
+                        a.emit(
+                            "commit-before-durability", mod, sub,
+                            f"`{fi.qualname}` fires on_commit with no"
+                            " durability point (fsync /"
+                            " submit_transaction_sync / _block_sync)"
+                            " ahead of it — the acked transaction can"
+                            " vanish on power loss; commit the KV"
+                            " batch sync (or fsync the data) before"
+                            " acking, or baseline an intentional"
+                            " no-durability store with a"
+                            " justification",
+                            severity="error", symbol=fi.qualname,
+                            scope_line=fi.lineno)
+
+
 def default_rules() -> Dict[str, object]:
     # lock-order lives in lockgraph.py (it needs the whole-project
     # graph); imported here to keep one registry
@@ -843,6 +923,7 @@ def default_rules() -> Dict[str, object]:
         "unguarded-device-dispatch": rule_unguarded_device_dispatch,
         "unhedged-gather": rule_unhedged_gather,
         "unbounded-latency-buffer": rule_unbounded_latency_buffer,
+        "commit-before-durability": rule_commit_before_durability,
         "async-blocking": rule_async_blocking,
         "sync-encode-in-async": rule_sync_encode_in_async,
         "lock-order": rule_lock_order,

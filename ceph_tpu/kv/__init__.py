@@ -37,6 +37,16 @@ class Transaction:
 
 
 class KeyValueDB:
+    """Durability contract (what the crash model in os/faultstore.py
+    assumes, and what SQLite WAL actually provides): batches are
+    ATOMIC (never torn) and PREFIX-durable — a power cut may lose
+    recently submitted batches, but only from the tail, never out of
+    order.  `submit_transaction` survives process death;
+    `submit_transaction_sync` is the power-cut barrier — it and every
+    batch before it survive the plug being pulled.  Stores must place
+    their commit point (the op that lets on_commit fire) behind the
+    sync form."""
+
     def create_and_open(self) -> None:
         raise NotImplementedError
 
@@ -148,8 +158,9 @@ class SQLiteDB(KeyValueDB):
     def submit_transaction_sync(self, t: Transaction) -> None:
         """Really-durable commit: synchronous=FULL for this transaction
         so a machine crash cannot forget state a caller already
-        published (the mon's Paxos-commit requirement; WAL+NORMAL only
-        survives process death)."""
+        published (the mon's Paxos-commit requirement AND TPUStore's
+        transaction commit point; WAL+NORMAL only survives process
+        death)."""
         with self._lock:
             self._conn.execute("PRAGMA synchronous=FULL")
             try:

@@ -61,9 +61,16 @@ class MemStore(ObjectStore):
         self._colls: Dict[str, Dict[ObjectId, _Object]] = {}
         self._lock = threading.RLock()
         self._mounted = False
+        # in-RAM stores still carry an identity: the cluster harness
+        # asserts a revived OSD remounted the SAME store (fsid match),
+        # and MemStore must answer that question too
+        self.fsid = ""
 
     def mkfs(self) -> None:
+        import uuid
+
         self._colls.clear()
+        self.fsid = uuid.uuid4().hex
 
     def mount(self) -> None:
         self._mounted = True

@@ -29,6 +29,7 @@ from __future__ import annotations
 import json
 import os as _os
 import threading
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -199,6 +200,23 @@ class TPUStore(ObjectStore):
         self.csum_block_size = 4096
         self.comp_mode = 0  # COMP_NONE unless configured
         self.required_ratio = gate.DEFAULT_REQUIRED_RATIO
+        # store identity: written once at mkfs, read back at mount —
+        # a remount of the same directory must present the same fsid
+        # (the BlueStore fsid file role; cluster harnesses assert a
+        # revived OSD got ITS disk back, not a fresh one)
+        self.fsid: str = ""
+        # durability/observability counters (l_bluestore_* perf role);
+        # surfaced by the daemon's `store_status` and perf dump
+        self.perf: Dict[str, int] = {
+            "kv_commits": 0,
+            "block_fsyncs": 0,
+            "deferred_writes": 0,
+            "deferred_bytes": 0,
+            "journal_replays": 0,
+            "journal_replayed_entries": 0,
+            "journal_replayed_bytes": 0,
+            "csum_read_failures": 0,
+        }
         self._load_config()
 
     def _load_config(self) -> None:
@@ -224,14 +242,26 @@ class TPUStore(ObjectStore):
 
     def mkfs(self) -> None:
         _os.makedirs(self.path, exist_ok=True)
+        # the block file (and its directory entry) must be durable
+        # BEFORE the superblock commit below: a store whose KV says it
+        # is valid but whose block file's dirent died with the power
+        # would fail mount
+        with open(self._block_path, "ab"):
+            pass
+        dirfd = _os.open(self.path, _os.O_RDONLY)
+        try:
+            _os.fsync(dirfd)
+        finally:
+            _os.close(dirfd)
         self._kv.create_and_open()
         t = self._kv.get_transaction()
         t.set(P_SUPER, b"format", b"tpustore-1")
+        t.set(P_SUPER, b"fsid", uuid.uuid4().hex.encode())
         t.set(P_FREELIST, b"state",
               json.dumps(self._alloc.to_json()).encode())
-        self._kv.submit_transaction(t)
-        with open(self._block_path, "ab"):
-            pass
+        # mkfs is a durability point: a power cut right after must
+        # still find a mountable store
+        self._kv.submit_transaction_sync(t)
         self._kv.close()
 
     def mount(self) -> None:
@@ -239,27 +269,42 @@ class TPUStore(ObjectStore):
         fmt = self._kv.get(P_SUPER, b"format")
         if fmt != b"tpustore-1":
             raise RuntimeError(f"{self.path}: not a tpustore ({fmt!r})")
+        self.fsid = (self._kv.get(P_SUPER, b"fsid") or b"").decode()
         state = self._kv.get(P_FREELIST, b"state")
         self._alloc = Allocator.from_json(json.loads(state))
         self._block = open(self._block_path, "r+b")
         self._replay_deferred()
         self._mounted = True
 
+    def _block_sync(self) -> None:
+        """The block-file durability barrier: everything written
+        before this survives a power cut (the ONE choke point, so a
+        fault-injecting subclass can record — or deliberately omit —
+        the barrier)."""
+        self._block.flush()
+        _os.fsync(self._block.fileno())
+        self.perf["block_fsyncs"] += 1
+
     def _replay_deferred(self) -> None:
         """Apply journaled in-place writes that may not have reached
-        the block file before a crash (idempotent), then trim."""
+        the block file before a crash (idempotent — a crash DURING
+        replay just replays again on the next mount), then trim."""
         keys = []
         for key, value in self._kv.get_iterator(P_DEFER):
             off = int.from_bytes(value[:8], "little")
             self._pwrite(off, value[8:])
             keys.append(key)
             self._defer_seq = max(self._defer_seq, int(key))
+            self.perf["journal_replayed_entries"] += 1
+            self.perf["journal_replayed_bytes"] += len(value) - 8
         if keys:
-            self._block.flush()
-            _os.fsync(self._block.fileno())
+            self.perf["journal_replays"] += 1
+            self._block_sync()
             t = self._kv.get_transaction()
             for key in keys:
                 t.rmkey(P_DEFER, key)
+            # trim loss is benign (replay is idempotent and KV batches
+            # are prefix-durable), so the trim rides a NORMAL commit
             self._kv.submit_transaction(t)
 
     def _flush_deferred(self) -> None:
@@ -268,8 +313,7 @@ class TPUStore(ObjectStore):
         amortization that makes small overwrites cheap)."""
         if not self._pending_defer:
             return
-        self._block.flush()
-        _os.fsync(self._block.fileno())
+        self._block_sync()
         t = self._kv.get_transaction()
         for key, _off, _ln in self._pending_defer:
             t.rmkey(P_DEFER, key)
@@ -280,12 +324,40 @@ class TPUStore(ObjectStore):
         if self._block is not None:
             self._flush_deferred()
         if self._block is not None:
-            self._block.flush()
-            _os.fsync(self._block.fileno())
+            self._block_sync()
             self._block.close()
             self._block = None
         self._kv.close()
         self._mounted = False
+
+    def crash(self) -> None:
+        """Process-crash seam for tests/harnesses: abandon the store
+        WITHOUT the clean umount's deferred flush + fsync.  Bytes
+        already handed to the OS survive (process-crash semantics — a
+        remount replays the deferred WAL); a power cut additionally
+        loses un-synced state, which FaultStore.crash_powercut
+        synthesizes on top of this."""
+        if self._block is not None:
+            try:
+                # hand userspace-buffered bytes to the OS page cache
+                # (a crashed process loses nothing it already wrote);
+                # deliberately NO fsync and NO journal trim
+                self._block.flush()
+            except ValueError:
+                pass
+            self._block.close()
+            self._block = None
+        self._kv.close()
+        self._mounted = False
+        self._pending_defer = []
+        self._defer_overlay.clear()
+
+    def perf_counters(self) -> Dict[str, int]:
+        """Durability counters + live gauges (the perf-dump `store`
+        section / `store_status` payload)."""
+        out = dict(self.perf)
+        out["deferred_queue_depth"] = len(self._pending_defer)
+        return out
 
     # -- onode cache-free helpers ------------------------------------------
 
@@ -391,6 +463,8 @@ class TPUStore(ObjectStore):
                     + delta)
             self._txc_defer.append(
                 (old.offset + write_off, delta, key))
+            self.perf["deferred_writes"] += 1
+            self.perf["deferred_bytes"] += len(delta)
             self._defer_overlay[old.offset] = bytes(raw)
             if old.stored_len > len(raw):
                 # the shrunken tail is unreferenced: free it
@@ -444,6 +518,7 @@ class TPUStore(ObjectStore):
                 blob.csum_type, blob.csum_block, 0, padded_len,
                 padded, blob.csum_data)
             if bad >= 0:
+                self.perf["csum_read_failures"] += 1
                 raise IOError(
                     f"csum mismatch at blob offset {bad}"
                     f" (device offset {blob.offset + bad})")
@@ -542,9 +617,16 @@ class TPUStore(ObjectStore):
             # purely-deferred txn carries its data IN the KV batch and
             # skips the block fsync entirely (the deferred-write win)
             if self._txc_direct:
-                self._block.flush()
-                _os.fsync(self._block.fileno())
-            self._kv.submit_transaction(kvt)
+                self._block_sync()
+            # the commit point IS the durability point: once this
+            # returns, on_commit fires and the ack must survive a
+            # power cut — so the batch goes down SYNC (BlueStore syncs
+            # its RocksDB WAL the same way; the WAL-mode NORMAL
+            # default only survives process death, and an acked write
+            # that vanishes on power loss is the one failure nothing
+            # upstack can repair)
+            self._kv.submit_transaction_sync(kvt)
+            self.perf["kv_commits"] += 1
             # apply deferred in-place writes AFTER the commit point:
             # their durability is the journal entry; the block file
             # catches up here and fsyncs lazily in batches
@@ -625,6 +707,8 @@ class TPUStore(ObjectStore):
             self._object_remove(kvt, cid, dst)
             dst_onode = _Onode()
             dst_onode.xattrs = dict(src_onode.xattrs)
+            dst_onode.omap_header = src_onode.omap_header
+            dst_onode.alloc_hint_flags = src_onode.alloc_hint_flags
             self._put_onode(kvt, cid, dst, dst_onode)
             self._object_write(kvt, cid, dst, 0, data)
             # omap copy

@@ -336,7 +336,13 @@ class OSDDaemon:
                      # device-fault degradation accounting: decodes
                      # re-run inline on host after a device fault
                      # (scrub-repair / recovery resilience)
-                     "decode_host_retries": 0}
+                     "decode_host_retries": 0,
+                     # objects this shard received as RECOVERY pushes
+                     # (installs of entries from its missing set) —
+                     # the log-based-vs-backfill discriminator: a
+                     # revived OSD with an intact store recovers only
+                     # the log diff, not the whole PG
+                     "recovery_installs": 0}
         # async micro-batching encode/decode front end: concurrent EC
         # ops share plan-cached device dispatches; inline (pre-service
         # behavior) when the device tier is absent or
@@ -530,6 +536,12 @@ class OSDDaemon:
                 "per-tenant mClock QoS: scheduler grant/queue state,"
                 " tenant profiles, admission-gate admit/delay/shed"
                 " decisions and live bucket levels"),
+            "store_status": (
+                lambda cmd: self._cmd_store_status(),
+                "backing object store: type, fsid, mount state,"
+                " statfs, and the durability counters (journal"
+                " replays/bytes, csum read failures, deferred-queue"
+                " depth, fsyncs)"),
             "dump_traces": (
                 lambda cmd: {"spans": self.tracer.dump(
                     int(cmd["trace_id"], 16)
@@ -575,7 +587,27 @@ class OSDDaemon:
         # per-tenant QoS: scheduler queue/grant state + admission
         # decisions (`tenants` flattens to tenant-labeled rows)
         out["qos"] = self._qos_perf()
+        # backing-store durability counters (TPUStore; MemStore has
+        # none) — flattens to ceph_osd_store_* gauges
+        pc = getattr(self.store, "perf_counters", None)
+        if callable(pc):
+            out["store"] = {k: v for k, v in pc().items()
+                            if isinstance(v, (int, float))}
         return out
+
+    def _cmd_store_status(self) -> Dict[str, Any]:
+        """The operator view of the backing store: what engine, which
+        disk (fsid), is it mounted, how full, and whether the
+        durability machinery (deferred WAL, csum reads) has been
+        exercised or is reporting failures."""
+        pc = getattr(self.store, "perf_counters", None)
+        return {
+            "type": type(self.store).__name__,
+            "fsid": getattr(self.store, "fsid", ""),
+            "mounted": bool(getattr(self.store, "_mounted", True)),
+            "statfs": self.store.statfs(),
+            "perf": pc() if callable(pc) else {},
+        }
 
     def _qos_perf(self) -> Dict[str, Any]:
         """Nested `qos` perf-dump section: numeric scheduler state
@@ -1651,6 +1683,8 @@ class OSDDaemon:
                         plog.trim_to(
                             int(self.config["osd_min_pg_log_entries"]))
                 # a write (client or recovery push) fills the object in
+                if msg.log_entry is None and msg.oid in plog.missing:
+                    self.perf["recovery_installs"] += 1
                 plog.missing.pop(msg.oid, None)
                 plog.stage(t, cid)
                 self.store.queue_transaction(t)

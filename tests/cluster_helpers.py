@@ -3,20 +3,49 @@
 Spins a mini-mon + N OSD daemons on loopback inside one asyncio loop —
 all "nodes" are endpoints on 127.0.0.1, exactly like ceph-helpers runs
 real daemons on one host (SURVEY.md §4.2).  kill_osd drops a daemon off
-the network without clean shutdown (its store survives, like a crashed
-process with an intact disk); revive_osd boots a fresh daemon on the
-surviving store.
+the network without clean shutdown; revive_osd boots a fresh daemon.
+
+Store lifecycle across kill/revive is an EXPLICIT contract
+(`persistent=`):
+
+- persistent=False (default, MemStore): the in-RAM store object
+  survives the kill and the revived daemon reboots on it — a crashed
+  process with an intact page cache, no remount path exercised.
+- persistent=True (TPUStore via `tpustore_factory`): kill_osd
+  crash-closes the store (no clean umount, no deferred-WAL flush —
+  and, with CEPH_TPU_CRASH_INJECT armed on a FaultStore, a synthesized
+  POWER-CUT image); revive_osd builds a fresh store over the same
+  directory, mounts it (replaying the deferred WAL) and asserts the
+  remounted fsid matches the killed store's — the revived OSD got ITS
+  disk back, not a fresh one.
 """
 
 from __future__ import annotations
 
 import asyncio
+import os
 from typing import Dict, List, Optional
 
 from ceph_tpu.mon import MonDaemon
 from ceph_tpu.os.memstore import MemStore
 from ceph_tpu.osd.daemon import OSDDaemon
 from ceph_tpu.rados.client import RadosClient
+
+
+def tpustore_factory(base_dir, fault: bool = False):
+    """Per-OSD TPUStore directories under `base_dir` (the
+    Cluster(store_factory=..., persistent=True) mode).  fault=True
+    arms the FaultStore recording shim so kill_osd can synthesize
+    power-cut images (CEPH_TPU_CRASH_INJECT) and tests can script
+    bit-rot into live shards."""
+    from ceph_tpu.os.faultstore import FaultStore
+    from ceph_tpu.os.tpustore import TPUStore
+
+    def make(osd_id: int):
+        cls = FaultStore if fault else TPUStore
+        return cls(os.path.join(str(base_dir), f"osd-{osd_id}"))
+
+    return make
 
 FAST_CONFIG = {
     # tight timings so failure-detection tests run in seconds — but not
@@ -42,7 +71,7 @@ class Cluster:
     def __init__(self, num_osds: int = 4, osds_per_host: int = 2,
                  osd_config: Optional[dict] = None,
                  mon_config: Optional[dict] = None,
-                 store_factory=None,
+                 store_factory=None, persistent: bool = False,
                  client_secret: Optional[str] = None,
                  num_mons: int = 1, client_secure: bool = False):
         self.num_osds = num_osds
@@ -58,6 +87,11 @@ class Cluster:
         self.mon_config = dict(FAST_MON_CONFIG)
         self.mon_config.update(mon_config or {})
         self.store_factory = store_factory or (lambda osd_id: MemStore())
+        self.persistent = persistent
+        assert not (persistent and store_factory is None), \
+            "persistent=True needs a disk-backed store_factory" \
+            " (tpustore_factory)"
+        self.fsids: Dict[int, str] = {}
         self.client_secret = client_secret
         self.client_secure = client_secure
         self.mons: Dict[int, MonDaemon] = {}
@@ -95,6 +129,7 @@ class Cluster:
             store.mkfs()
             store.mount()
             self.stores[osd_id] = store
+            self.fsids[osd_id] = getattr(store, "fsid", "")
             await self._boot_osd(osd_id)
         self.client = RadosClient(self.mon_addrs,
                                   secret=self.client_secret,
@@ -160,11 +195,50 @@ class Cluster:
     # -- failure injection (thrashosds kill_osd/revive_osd role) -----------
 
     async def kill_osd(self, osd_id: int) -> None:
+        """Crash an OSD: the daemon drops off the network without
+        clean shutdown.  In persistent mode the STORE crashes too —
+        no clean umount, no deferred-WAL flush; with
+        CEPH_TPU_CRASH_INJECT armed on a FaultStore, the on-disk
+        directory is rewritten to a synthesized power-cut image
+        (un-synced writes lost) before any revive can remount it."""
         await self.osds[osd_id].kill()
         del self.osds[osd_id]
+        if self.persistent:
+            from ceph_tpu.os.faultstore import (
+                FaultStore, crash_inject_enabled)
+
+            store = self.stores.pop(osd_id)
+            if isinstance(store, FaultStore) and crash_inject_enabled():
+                store.crash_powercut()
+            else:
+                store.crash()
 
     async def revive_osd(self, osd_id: int) -> None:
+        """Boot a fresh daemon at the dead rank.
+
+        CONTRACT: with persistent=False (the MemStore default) the
+        daemon reboots on the SURVIVING in-memory store object — no
+        remount happens and nothing was ever lost.  With
+        persistent=True the store object died with the daemon; a new
+        store is built over the same directory and MOUNTED (journal
+        replay runs here), and the remounted fsid must match the
+        killed store's — booting a different/fresh disk under a
+        revived OSD id is a harness bug this assert catches."""
         assert osd_id not in self.osds
+        if self.persistent:
+            assert osd_id not in self.stores
+            store = self.store_factory(osd_id)
+            store.mount()   # remount the same directory: WAL replays
+            want = self.fsids.get(osd_id)
+            got = getattr(store, "fsid", "")
+            if want and got != want:
+                # don't leak the mounted handle: stop() only umounts
+                # stores that made it into self.stores
+                store.umount()
+                raise AssertionError(
+                    f"osd.{osd_id} remounted fsid {got!r} != {want!r}"
+                    " (fresh store under a revived OSD?)")
+            self.stores[osd_id] = store
         await self._boot_osd(osd_id)
 
     async def wait_for_osd_down(self, osd_id: int,

@@ -1,0 +1,16 @@
+"""Seeded violation: the store acks (fires on_commit) BEFORE its
+durability point — the sync KV commit happens after the callbacks, so
+a power cut between them erases an acked transaction."""
+
+
+class LeakyStore:
+    def __init__(self, kv):
+        self._kv = kv
+
+    def queue_transaction(self, txn):
+        kvt = self._kv.get_transaction()
+        for op in txn.ops:
+            kvt.add(op)
+        for cb in txn.on_commit:
+            cb()  # expect: commit-before-durability
+        self._kv.submit_transaction_sync(kvt)

@@ -1,0 +1,21 @@
+"""Clean twin: the sync KV commit (the durability point) precedes the
+on_commit callbacks, so an ack implies the transaction survives a
+power cut."""
+
+import os
+
+
+class DurableStore:
+    def __init__(self, kv, block):
+        self._kv = kv
+        self._block = block
+
+    def queue_transaction(self, txn):
+        kvt = self._kv.get_transaction()
+        for op in txn.ops:
+            kvt.add(op)
+        self._block.flush()
+        os.fsync(self._block.fileno())
+        self._kv.submit_transaction_sync(kvt)
+        for cb in txn.on_commit:
+            cb()

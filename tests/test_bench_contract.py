@@ -21,7 +21,8 @@ import bench  # noqa: E402
 
 CONTRACT_KEYS = {"metric", "value", "unit", "vs_baseline",
                  "plan_cache", "encode_service", "tier",
-                 "device_health", "tail", "load", "truncated"}
+                 "device_health", "tail", "load", "durability",
+                 "truncated"}
 
 
 def test_contract_line_despite_hanging_backend(tmp_path):
@@ -88,6 +89,13 @@ def test_contract_line_despite_hanging_backend(tmp_path):
     assert contract["load"]["p99_ms"] is not None
     assert contract["load"]["p99_ms"] > 0
     assert contract["load"]["deterministic"] == 1
+    # the crash-consistency probe ran: the smoke power-cut sweep
+    # explored crash points with ZERO invariant violations, and the
+    # deliberately-broken store (fsync removed) was caught by the
+    # same sweep (the harness self-test)
+    assert contract["durability"]["points"] >= 20
+    assert contract["durability"]["violations"] == 0
+    assert contract["durability"]["broken_store_caught"] == 1
     assert contract["truncated"] is False
     # details stayed out of stdout (they belong in bench_details.json)
     assert len(stdout_lines) == 1

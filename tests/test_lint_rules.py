@@ -30,6 +30,7 @@ RULES = [
     "unguarded-device-dispatch",
     "unhedged-gather",
     "unbounded-latency-buffer",
+    "commit-before-durability",
     "async-blocking",
     "sync-encode-in-async",
     "lock-order",
@@ -43,7 +44,8 @@ CONFIG = {"dtype_paths": ("fx_uint8",),
           "encode_paths": ("fx_sync_encode_in_async",),
           "device_paths": ("fx_unguarded_device_dispatch",),
           "gather_paths": ("fx_unhedged_gather",),
-          "latency_paths": ("fx_unbounded_latency_buffer",)}
+          "latency_paths": ("fx_unbounded_latency_buffer",),
+          "durability_paths": ("fx_commit_before_durability",)}
 
 
 def _fixture(name: str) -> str:

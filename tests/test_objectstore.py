@@ -263,10 +263,13 @@ def test_tpustore_remount_persistence(tmp_path):
     t.omap_setkeys(CID, OID, {"pk": b"pv"})
     s.queue_transaction(t)
     alloc_before = s.statfs()["allocated"]
+    fsid = s.fsid
+    assert fsid
     s.umount()
 
     s2 = TPUStore(path)
     s2.mount()
+    assert s2.fsid == fsid  # the same disk presents the same identity
     assert s2.read(CID, OID) == data
     assert s2.getattr(CID, OID, "hinfo_key") == b"ledger"
     assert s2.omap_get(CID, OID) == {"pk": b"pv"}
