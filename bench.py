@@ -65,6 +65,7 @@ def _emit_contract(value: Optional[float],
                    tail: Optional[dict] = None,
                    load: Optional[dict] = None,
                    durability: Optional[dict] = None,
+                   mesh: Optional[dict] = None,
                    truncated: bool = False) -> None:
     """Print the one-line JSON driver contract, exactly once, before
     any optional extended benches run — a wedged tunnel or a crashed
@@ -79,9 +80,11 @@ def _emit_contract(value: Optional[float],
     p50/p95/p99 over the embedded cluster, deterministic schedules),
     durability the crash-consistency probe (smoke power-cut sweep over
     TPUStore: crash points explored, zero invariant violations, and
-    the deliberately-broken store caught as a self-test); truncated
-    flags a budget-shortened run.  Thread-safe: the deadline watchdog
-    and the bench body may race to emit."""
+    the deliberately-broken store caught as a self-test), mesh the
+    multi-chip mesh probe (same batch bit-exact through 1-device /
+    N-device / host oracle, sick chip shrinks the mesh with zero host
+    fallbacks); truncated flags a budget-shortened run.  Thread-safe:
+    the deadline watchdog and the bench body may race to emit."""
     global _contract_emitted
     with _contract_lock:
         if _contract_emitted:
@@ -100,6 +103,7 @@ def _emit_contract(value: Optional[float],
             "tail": tail,
             "load": load,
             "durability": durability,
+            "mesh": mesh,
             "truncated": bool(truncated),
         }), flush=True)
 
@@ -199,6 +203,69 @@ def _device_health_probe() -> Optional[dict]:
             circuit.reset_all()
         except Exception:
             pass
+
+
+def _meshbench_subprocess(args: list, timeout_s: float
+                          ) -> Optional[dict]:
+    """Run ceph_tpu.parallel.meshbench in a SUBPROCESS and parse its
+    one-line JSON.  A subprocess for two reasons: the CPU backend's
+    device-count virtualization (XLA_FLAGS) must land before the
+    backend initializes — too late in this process — and a wedged
+    tunnel stays contained behind the hard timeout."""
+    env = dict(os.environ)
+    env.setdefault("CEPH_TPU_MESH_MIN_BYTES", "0")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "ceph_tpu.parallel.meshbench",
+             *args],
+            capture_output=True, text=True, timeout=timeout_s,
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        print("# meshbench subprocess timed out (wedged?)",
+              file=sys.stderr)
+        return None
+    if r.returncode != 0:
+        print(f"# meshbench failed rc={r.returncode}:"
+              f" {r.stderr[-1000:]}", file=sys.stderr)
+        return None
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln]
+    try:
+        return json.loads(lines[-1]) if lines else None
+    except json.JSONDecodeError:
+        print(f"# meshbench emitted no JSON: {r.stdout[-500:]}",
+              file=sys.stderr)
+        return None
+
+
+def _mesh_probe() -> Optional[dict]:
+    """Pre-contract probe of the mesh-sharded EC data plane: the SAME
+    stripe batch must be bit-identical through the single-device
+    plan, the N-device mesh plan, and the host numpy oracle; then a
+    scripted sick chip (sick=<id> injection) must shrink the mesh —
+    per-device breaker tripped, survivors re-planned, output still
+    bit-exact, ZERO host fallbacks.  Counters land in the contract
+    line's `mesh` key (first-and-always under the PR-6 watchdog);
+    None (with a stderr note) when the probe cannot run."""
+    if _remaining() < 0:
+        print("# mesh probe skipped: budget exhausted",
+              file=sys.stderr)
+        return None
+    timeout_s = float(os.environ.get(
+        "CEPH_TPU_BENCH_MESH_PROBE_TIMEOUT", "120"))
+    return _meshbench_subprocess(["--probe", "--smoke"], timeout_s)
+
+
+def bench_mesh() -> dict:
+    """Mesh scale-out sweep: the fused encode+crc workload at mesh
+    sizes 1 -> 2 -> 4 -> 8 (capped at visible devices), GiB/s per
+    size and the speedup over the single-chip leg, bit-exactness
+    asserted at every size.  The MULTICHIP driver rounds run the
+    same sweep via __graft_entry__.dryrun_multichip's JSON tail."""
+    timeout_s = float(os.environ.get(
+        "CEPH_TPU_BENCH_MESH_SWEEP_TIMEOUT", "300"))
+    args = ["--sweep"] + (["--smoke"] if _SMOKE else [])
+    out = _meshbench_subprocess(args, timeout_s)
+    return out or {}
 
 
 def bench_degraded() -> dict:
@@ -1530,6 +1597,9 @@ def main() -> None:
     # crash-consistency probe (cheap, before the contract): smoke
     # power-cut sweep with zero violations + broken-store self-test
     durability_counters = _durability_probe()
+    # mesh probe (before the contract): 1-dev/N-dev/host bit-exact,
+    # sick chip shrinks the mesh with zero host fallbacks
+    mesh_counters = _mesh_probe()
 
     # the driver contract line, before every optional/extended bench:
     # a wedge below this point can cost detail rows, never the bench
@@ -1540,6 +1610,7 @@ def main() -> None:
                    tail=tail_counters,
                    load=load_counters,
                    durability=durability_counters,
+                   mesh=mesh_counters,
                    truncated=skip_optional)
 
     # decode sweep over 1..m erasures (the reference benchmark sweeps
@@ -1623,6 +1694,18 @@ def main() -> None:
         except Exception as e:
             print(f"# tail bench failed: {e!r}", file=sys.stderr)
 
+    # mesh scale-out section: the fused encode+crc sweep at mesh
+    # sizes 1 -> 2 -> 4 -> 8 — GiB/s per size, speedup over the
+    # single-chip leg, bit-exact at every size
+    mesh_section: dict = {}
+    if skip_optional:
+        skipped_sections.append("mesh")
+    else:
+        try:
+            mesh_section = bench_mesh()
+        except Exception as e:
+            print(f"# mesh bench failed: {e!r}", file=sys.stderr)
+
     # degraded-mode section: breakers forced open -> host-path
     # throughput delta (what a wedged accelerator costs while the
     # breaker holds it out of the hot path)
@@ -1691,6 +1774,7 @@ def main() -> None:
         **write_path,
         **tier_section,
         **tail_section,
+        **mesh_section,
         **degraded_section,
         **load_section,
         **durability_section,
@@ -1701,6 +1785,7 @@ def main() -> None:
         "tail": tail_counters,
         "load": load_counters,
         "durability": durability_counters,
+        "mesh": mesh_counters,
         "host_cores": os.cpu_count(),
         "encode_ms_per_batch": t_enc * 1e3,
         "k": k, "m": m, "chunk_bytes": chunk, "batch": batch,

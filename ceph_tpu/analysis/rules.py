@@ -37,6 +37,14 @@ EC dispatch discipline:
                        faulting accelerator surfaces as a raised
                        exception instead of degrading to the
                        bit-exact host path
+  unplanned-mesh-dispatch
+                       raw shard_map/pjit in ec/, osd/, parallel/
+                       bypassing the plan cache (ec/plan.py
+                       tracked_jit / mesh plan kinds) or the breaker
+                       guard: the compile is invisible to
+                       plan.stats(), binds a device set no health
+                       shrink can retire, and dispatches without
+                       watchdog or sick-chip attribution
 
 store durability discipline:
   commit-before-durability
@@ -576,6 +584,70 @@ def rule_unguarded_device_dispatch(a: Analyzer) -> None:
 
 
 # ---------------------------------------------------------------------
+# unplanned-mesh-dispatch
+# ---------------------------------------------------------------------
+
+# modules whose multi-chip compiles must ride the plan cache: a raw
+# shard_map/pjit in the data path compiles outside plan.stats()
+# (retraces invisible), binds whatever device set exists at build
+# time (a dead chip's mesh is never retired), and dispatches outside
+# the breaker guard (no watchdog, no sick-chip attribution)
+_MESH_DISPATCH_PATHS = ("ceph_tpu/ec/", "ceph_tpu/osd/",
+                        "ceph_tpu/parallel/")
+_MESH_ENTRY_TAILS = {"shard_map", "pjit"}
+
+
+def _inside_tracked_jit(mod, node: ast.AST) -> bool:
+    """True when the call is lexically inside an argument of a
+    `tracked_jit(...)` invocation — the compile lands in the plan
+    cache's retrace counters, that IS the planned form."""
+    cur = node
+    while cur is not None:
+        cur = mod.parents.get(cur)
+        if isinstance(cur, ast.Call) and \
+                (dotted(cur.func) or "").split(".")[-1] == \
+                "tracked_jit":
+            return True
+    return False
+
+
+def rule_unplanned_mesh_dispatch(a: Analyzer) -> None:
+    """Raw shard_map/pjit in ec/, osd/, parallel/ bypassing the plan
+    cache and the breaker guard: route the compiled callable through
+    plan.tracked_jit (or a plan kind keyed on the mesh signature, so
+    a shrunken healthy set retires the stale executable), and the
+    dispatch through circuit.device_call.  The striped.py internals
+    that legitimately sit UNDER the plan builders are baselined with
+    justifications."""
+    paths = a.config.get("mesh_paths", _MESH_DISPATCH_PATHS)
+    for mod in a.project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if not any(p in rel for p in paths):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _resolved_callee(mod, node)
+            if not callee or \
+                    callee.split(".")[-1] not in _MESH_ENTRY_TAILS:
+                continue
+            if _inside_tracked_jit(mod, node) or \
+                    _inside_device_call(mod, node):
+                continue
+            a.emit("unplanned-mesh-dispatch", mod, node,
+                   f"raw mesh compile `{callee}` outside the plan "
+                   "cache: the XLA trace is invisible to "
+                   "plan.stats(), the executable binds a device set "
+                   "no health shrink can retire, and the dispatch "
+                   "skips the breaker guard — wrap with "
+                   "ceph_tpu.ec.plan.tracked_jit (or a mesh plan "
+                   "kind) and dispatch via circuit.device_call",
+                   severity="warning",
+                   symbol=_enclosing_qualname(mod, node),
+                   scope_line=_scope_line(mod, node))
+
+
+# ---------------------------------------------------------------------
 # unhedged-gather
 # ---------------------------------------------------------------------
 
@@ -921,6 +993,7 @@ def default_rules() -> Dict[str, object]:
         "trace-numpy": rule_trace_numpy,
         "jit-bypass-plan": rule_jit_bypass_plan,
         "unguarded-device-dispatch": rule_unguarded_device_dispatch,
+        "unplanned-mesh-dispatch": rule_unplanned_mesh_dispatch,
         "unhedged-gather": rule_unhedged_gather,
         "unbounded-latency-buffer": rule_unbounded_latency_buffer,
         "commit-before-durability": rule_commit_before_durability,

@@ -22,6 +22,17 @@ case and degrade by construction (arXiv:1804.10331, arXiv:2409.01420)
   retry), records the outcome on the family's breaker, and NEVER
   raises — callers read the status and fall back to the bit-exact
   host path.
+* **Per-device health** — every chip gets its own breaker family
+  (``device:<id>``, threshold 1: a failed probe targeted the chip, the
+  verdict is decisive).  Mesh dispatches pass ``devices=`` so the
+  choke point records success on every participating chip's breaker;
+  failures are attributed only by an actual probe — a dispatch whose
+  family IS the chip's own breaker (plan._probe_devices).  Ordinary
+  dispatch failures, single- or multi-chip, cannot be attributed here
+  — the mesh layer (ec/plan.py) probes each participant individually
+  and re-plans on the surviving set, so one sick chip shrinks the
+  mesh instead of degrading the whole batch to host.
+
 * **Fault injection** — `CEPH_TPU_INJECT_DEVICE_FAIL` is read at the
   same choke point so tests and the thrasher can script device
   failure deterministically:
@@ -32,6 +43,9 @@ case and degrade by construction (arXiv:1804.10331, arXiv:2409.01420)
                             (drives the watchdog timeout)
       oom=K                 raise RESOURCE_EXHAUSTED when the dispatch
                             batch exceeds K (drives batch halving)
+      sick=D                fail any dispatch whose `devices` include
+                            device id D (drives the mesh-shrink path:
+                            sick chip out, smaller mesh in)
 
   Modes combine comma-separated (``p=0.3,hang=5``).  The env var is
   re-read on every dispatch, so flipping it mid-workload takes effect
@@ -52,7 +66,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 __all__ = [
     "CLOSED", "OPEN", "HALF_OPEN", "FAMILIES",
     "CircuitBreaker", "DeviceFault", "InjectedResourceExhausted",
-    "breaker", "degraded", "device_call", "enabled", "fault_events",
+    "breaker", "degraded", "device_breaker", "device_call",
+    "device_degraded", "device_stats", "enabled", "fault_events",
     "force_open_all", "injection", "is_resource_exhausted",
     "parse_injection", "perf_dump", "reset_all", "stats_all",
 ]
@@ -64,6 +79,12 @@ _STATE_CODE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
 # demand so new families cost one registry entry, not a code change
 FAMILIES = ("ec-encode", "ec-decode", "fused-crc", "hitset-hash",
             "crush-batch")
+
+# per-chip breaker families ride the same registry under this prefix;
+# they are created with fail_threshold 1 — a failed dispatch PINNED to
+# one chip (the mesh layer's attribution probe) is a decisive verdict,
+# unlike a family failure that might be a transient of any layer
+DEVICE_FAMILY_PREFIX = "device:"
 
 
 def enabled() -> bool:
@@ -246,6 +267,22 @@ class CircuitBreaker:
         with self._lock:
             self._probing = False
 
+    def absolve(self) -> None:
+        """Rescind a failure verdict that was ATTRIBUTED elsewhere:
+        the mesh layer probed the participants of a failed multi-chip
+        dispatch and found a sick chip — the chip's own breaker now
+        owns the fault, so this family must not stay tripped (an open
+        family breaker would degrade every caller to host, exactly
+        what the mesh shrink exists to avoid).  Re-closes, clears the
+        consecutive count and the backoff escalation; lifetime
+        failure/trip counters are kept (they happened)."""
+        with self._lock:
+            self._state = CLOSED
+            self._probing = False
+            self._opens = 0
+            self._retry_at = 0.0
+            self.counters["consecutive"] = 0
+
     def reset(self, counters: bool = True) -> None:
         with self._lock:
             self._state = CLOSED
@@ -287,8 +324,44 @@ def breaker(family: str) -> CircuitBreaker:
     with _reg_lock:
         br = _breakers.get(family)
         if br is None:
-            br = _breakers[family] = CircuitBreaker(family)
+            kw = {}
+            if family.startswith(DEVICE_FAMILY_PREFIX):
+                kw["fail_threshold"] = int(_env_float(
+                    "CEPH_TPU_DEVICE_BREAKER_THRESHOLD", 1))
+            br = _breakers[family] = CircuitBreaker(family, **kw)
         return br
+
+
+def device_breaker(device_id: int) -> CircuitBreaker:
+    """The per-chip breaker: family ``device:<id>`` in the shared
+    registry (threshold 1 — attribution probes are decisive)."""
+    return breaker(f"{DEVICE_FAMILY_PREFIX}{int(device_id)}")
+
+
+def device_degraded(device_id: int) -> bool:
+    """Read-only per-chip health: True while the chip is held out of
+    the mesh (its breaker open with an unexpired backoff).  An
+    expired backoff reads healthy — the chip rejoins the next mesh
+    build, and that dispatch is its de-facto half-open probe."""
+    if not enabled():
+        return False
+    return device_breaker(device_id).degraded()
+
+
+def device_stats() -> Dict[str, Dict[str, Any]]:
+    """Per-chip breaker snapshot keyed by device id (string, for the
+    prometheus label map); `dispatches` aliases the success count —
+    the satellite gauge ceph_osd_device_*{device=...} reads it."""
+    with _reg_lock:
+        brs = {f[len(DEVICE_FAMILY_PREFIX):]: br
+               for f, br in _breakers.items()
+               if f.startswith(DEVICE_FAMILY_PREFIX)}
+    out = {}
+    for dev, br in sorted(brs.items(), key=lambda kv: kv[0]):
+        st = br.stats()
+        st["dispatches"] = st["successes"]
+        out[dev] = st
+    return out
 
 
 def degraded(family: str) -> bool:
@@ -311,9 +384,13 @@ def stats_all() -> Dict[str, Dict[str, Any]]:
 
 def perf_dump() -> Dict[str, Dict[str, Any]]:
     """Numeric-only nested snapshot for `perf dump` (the prometheus
-    flattener skips string leaves, so the state rides as state_code)."""
+    flattener skips string leaves, so the state rides as state_code).
+    Per-chip ``device:<id>`` families are excluded here — the daemon
+    exports them under a `devices` label map instead, so chips become
+    a ``device=`` label rather than a metric name per chip."""
     return {f: {k: v for k, v in st.items() if not isinstance(v, str)}
-            for f, st in stats_all().items()}
+            for f, st in stats_all().items()
+            if not f.startswith(DEVICE_FAMILY_PREFIX)}
 
 
 def fault_events(families: Optional[Tuple[str, ...]] = None) -> int:
@@ -356,14 +433,15 @@ _inj_next_left = 0
 
 def parse_injection(raw: Optional[str]) -> Optional[Dict[str, Any]]:
     """CEPH_TPU_INJECT_DEVICE_FAIL spec -> {p, next, hang_ms,
-    oom_batch} or None when injection is off.  A bare float is
-    shorthand for p=<float>; unknown keys raise (a typo'd fault spec
-    silently injecting nothing would invalidate the test)."""
+    oom_batch, sick_device} or None when injection is off.  A bare
+    float is shorthand for p=<float>; unknown keys raise (a typo'd
+    fault spec silently injecting nothing would invalidate the
+    test)."""
     raw = (raw or "").strip()
     if not raw or raw == "0":
         return None
     spec: Dict[str, Any] = {"p": 0.0, "next": 0, "hang_ms": 0.0,
-                            "oom_batch": None}
+                            "oom_batch": None, "sick_device": None}
     try:
         spec["p"] = float(raw)
         return spec
@@ -380,6 +458,8 @@ def parse_injection(raw: Optional[str]) -> Optional[Dict[str, Any]]:
             spec["hang_ms"] = float(val)
         elif key in ("oom", "oom_batch", "oom-above-batch"):
             spec["oom_batch"] = int(val)
+        elif key in ("sick", "sick_device", "sick-device"):
+            spec["sick_device"] = int(val)
         else:
             raise ValueError(
                 f"unknown CEPH_TPU_INJECT_DEVICE_FAIL mode {part!r}")
@@ -399,7 +479,8 @@ def injection() -> Optional[Dict[str, Any]]:
         return _inj_spec
 
 
-def _maybe_inject(family: str, batch: Optional[int]) -> None:
+def _maybe_inject(family: str, batch: Optional[int],
+                  devices: Optional[Tuple[int, ...]] = None) -> None:
     """Runs INSIDE the watchdog-supervised dispatch body, so hang
     injection exercises the real timeout path."""
     global _inj_next_left
@@ -408,6 +489,11 @@ def _maybe_inject(family: str, batch: Optional[int]) -> None:
         return
     if spec["hang_ms"]:
         time.sleep(spec["hang_ms"] / 1e3)
+    if spec["sick_device"] is not None and devices \
+            and spec["sick_device"] in devices:
+        raise DeviceFault(
+            f"injected device fault ({family}: sick device"
+            f" {spec['sick_device']} in dispatch set {devices})")
     if spec["oom_batch"] is not None and batch is not None \
             and batch > spec["oom_batch"]:
         raise InjectedResourceExhausted(
@@ -496,6 +582,7 @@ def device_call(family: str, fn: Callable, *args,
                 timeout: Optional[float] = None,
                 oom_to_fail: bool = False,
                 benign: Tuple[type, ...] = (),
+                devices: Optional[Tuple[int, ...]] = None,
                 ) -> Tuple[str, Any]:
     """Run one device dispatch through the family's breaker, the
     injection seam, and a watchdog thread.  NEVER raises; returns
@@ -516,6 +603,17 @@ def device_call(family: str, fn: Callable, *args,
                            daemon thread)
       ("fail", exc)        dispatch raised: breaker failure recorded
 
+    `devices` names the chips participating in a mesh dispatch (jax
+    device ids): success records on every chip's ``device:<id>``
+    breaker.  Failures are NEVER attributed here — a failed
+    multi-chip dispatch says nothing about which chip, and a failed
+    ordinary single-chip dispatch must not trip the chip's
+    threshold-1 breaker on a transient the family breaker would
+    tolerate.  Only an actual attribution probe (whose `family` IS
+    the chip's ``device:<id>`` breaker — plan._probe_devices) speaks
+    for a chip's failure.  The sick-device injection mode keys on
+    this set.
+
     With CEPH_TPU_BREAKER=0 the guard is bypassed entirely: fn runs
     inline and exceptions propagate raw (pre-guard behavior).
     """
@@ -525,9 +623,14 @@ def device_call(family: str, fn: Callable, *args,
     if not br.allow():
         br.note_fallback()
         return "open", None
+    # chips whose breaker this call may speak for — when the family
+    # itself IS a device:<id> breaker, skip that id (one verdict, not
+    # two, per dispatch)
+    attr = tuple(d for d in (devices or ())
+                 if family != f"{DEVICE_FAMILY_PREFIX}{d}")
 
     def _body():
-        _maybe_inject(family, batch)
+        _maybe_inject(family, batch, devices)
         return fn(*args)
 
     finished, box = _run_watchdog(
@@ -538,6 +641,8 @@ def device_call(family: str, fn: Callable, *args,
     err = box.get("err")
     if err is None:
         br.record_success()
+        for d in attr:
+            device_breaker(d).record_success()
         return "ok", box.get("out")
     if isinstance(err, benign):
         # no health verdict: hand a half-open probe slot back so the

@@ -24,11 +24,12 @@ def gf_matmul(mat: np.ndarray, data: np.ndarray, use_tpu: bool,
 
     The device branch routes through the ExecPlan cache (ec/plan.py):
     shapes bucket onto a handful of compiled plans, and the plan
-    delegates to the DEFAULT-MESH sharded pipeline
-    (parallel/backend.py) — the daemons' EC path and the multi-chip
-    dryrun compile the same program; a single chip is the (1,1) mesh.
-    `sig` is the codec's plan signature; use_plan=False (the
-    --no-plan-cache toggle) dispatches with exact shapes.
+    delegates to the LIVE HEALTHY device mesh (parallel/backend.py)
+    — the daemons' EC path and the multi-chip dryrun compile the same
+    program; a single chip is the (1,1) mesh, and a chip whose
+    ``device:<id>`` breaker is open is simply absent from the next
+    mesh build.  `sig` is the codec's plan signature; use_plan=False
+    (the --no-plan-cache toggle) dispatches with exact shapes.
 
     Every device attempt rides the `family` circuit breaker
     (common/circuit.py): while the breaker is open — or when the
@@ -73,7 +74,8 @@ def _device_matmul(mat: np.ndarray, data: np.ndarray,
     batch = data.shape[0] if data.ndim == 3 else 1
     status, out = circuit.device_call(
         family, backend.matmul, mat, data, batch=batch,
-        label="mesh-direct", oom_to_fail=batch <= 1)
+        label="mesh-direct", oom_to_fail=batch <= 1,
+        devices=backend.mesh_device_ids() or None)
     if status == "ok" and out is not None:
         return out
     if status == "oom" and batch > 1:

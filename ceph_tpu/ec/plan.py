@@ -32,9 +32,24 @@ is that layer:
 * **Fused encode + crc32c** — `encode_with_crc` returns parity AND the
   per-chunk (zero-seeded) hinfo crc32c from one dispatch instead of
   two (ECUtil::HashInfo's ledger rides the encode).
+* **Mesh-sharded plans** — batches past the mesh gates
+  (`CEPH_TPU_MESH_MIN_STRIPES` stripes, `CEPH_TPU_MESH_MIN_BYTES`
+  bytes, >= 2 healthy chips) compile onto the LIVE HEALTHY device
+  mesh instead of one chip: the plan key carries the device-set
+  signature, the stripe batch shards data-parallel over the mesh
+  ("stripe" -> dp; "shard" and "byte" stay within-chip — the logical
+  axis rules in parallel/striped.py), inputs are device_put
+  pre-sharded (SNIPPETS [3]) and parity + fused CRC never re-land on
+  host between stages.  A failed mesh dispatch probes each
+  participating chip individually (common/circuit.py ``device:<id>``
+  breakers): a sick chip trips ITS breaker, the family verdict is
+  absolved, and the dispatch re-plans on the surviving set — the mesh
+  shrinks, the batch never degrades to host because one chip died.
+  Kill switch CEPH_TPU_MESH=0 (bit-identical single-device plans).
 * **Observability** — `stats()` exposes hit/miss/retrace counters and
-  per-plan dispatch counts/timings; bench.py and the erasure-code
-  benchmark CLI surface them.
+  per-plan dispatch counts/timings (plus the mesh section: healthy
+  set, dispatches, shrinks); bench.py and the erasure-code benchmark
+  CLI surface them.
 
 Direct `jax.jit` on shape-polymorphic EC entry points is flagged by
 the `jit-bypass-plan` static-analysis rule; route new compiles through
@@ -67,9 +82,10 @@ except Exception:  # pragma: no cover
 __all__ = [
     "bucket_batch", "bucket_bytes", "clear", "codec_signature",
     "device_platform", "enabled", "encode", "encode_coalesced",
-    "encode_with_crc", "matmul", "matrix_signature", "plan_key",
-    "quarantine_info", "reset_stats", "set_enabled", "stats",
-    "StripeCoalescer", "tracked_jit",
+    "encode_with_crc", "matmul", "matrix_signature", "mesh_enabled",
+    "mesh_dispatches", "mesh_info", "plan_key", "quarantine_info",
+    "reset_stats", "set_enabled", "stats", "StripeCoalescer",
+    "tracked_jit",
 ]
 
 # ---------------------------------------------------------------------------
@@ -81,7 +97,9 @@ _plans = LruCache(cap=128)
 _mbits_cache = LruCache(cap=64)      # matrix signature -> device bit matrix
 _counters: Dict[str, int] = {"hits": 0, "misses": 0, "retraces": 0,
                              "dispatches": 0, "host_fallbacks": 0,
-                             "oom_splits": 0, "quarantines": 0}
+                             "oom_splits": 0, "quarantines": 0,
+                             "mesh_dispatches": 0, "mesh_rows": 0,
+                             "mesh_shrinks": 0, "mesh_probes": 0}
 _per_plan: Dict[str, Dict[str, float]] = {}
 _enabled = os.environ.get("CEPH_TPU_PLAN_CACHE", "1") != "0"
 # poisoned-plan quarantine: a compiled callable that keeps failing is
@@ -123,6 +141,9 @@ def stats() -> dict:
     # breaker states + trip/probe/fallback counters ride the same
     # snapshot (the device_health admin command and bench read this)
     out["device_health"] = circuit.stats_all()
+    # mesh policy + live healthy set (outside the lock: mesh_info
+    # takes it itself)
+    out["mesh"] = mesh_info()
     return out
 
 
@@ -236,19 +257,31 @@ def codec_signature(technique: str, k: int, m: int, w: int,
 
 def plan_key(sig: str, kind: str, rows: int, k: int,
              batch: int, chunk_bytes: int,
-             donate: bool = False) -> tuple:
-    """Cache key: (codec signature, kind, bucketed shape).  Pure
-    strings/ints/bools — identical across processes for identical
-    profiles (asserted by the key-stability test)."""
-    return (sig, kind, int(rows), int(k), bucket_batch(batch),
-            bucket_bytes(chunk_bytes) if kind != "encode_crc"
-            else int(chunk_bytes), bool(donate))
+             donate: bool = False,
+             mesh: Tuple[int, ...] = ()) -> tuple:
+    """Cache key: (codec signature, kind, bucketed shape, mesh).
+    Pure strings/ints/bools — identical across processes for
+    identical profiles (asserted by the key-stability test).  `mesh`
+    is the participating device-id set for a mesh-sharded plan (a
+    compiled executable binds its devices, so a plan built for a set
+    containing a now-dead chip must miss); the batch bucket rounds up
+    to a multiple of the mesh size so every chip gets whole
+    stripes."""
+    bb = bucket_batch(batch)
+    if mesh:
+        bb = -(-bb // len(mesh)) * len(mesh)
+    return (sig, kind, int(rows), int(k), bb,
+            bucket_bytes(chunk_bytes) if kind not in
+            ("encode_crc", "mesh_encode_crc")
+            else int(chunk_bytes), bool(donate),
+            tuple(int(d) for d in mesh))
 
 
 def _label(key: tuple) -> str:
-    sig, kind, rows, k, bb, bs, don = key
+    sig, kind, rows, k, bb, bs, don, mesh = key
     return f"{kind}[{sig}] r{rows}k{k} B{bb} S{bs}" + \
-        ("+don" if don else "")
+        ("+don" if don else "") + \
+        (f"+mesh{len(mesh)}" if mesh else "")
 
 
 # ---------------------------------------------------------------------------
@@ -257,15 +290,23 @@ def _label(key: tuple) -> str:
 
 
 class ExecPlan:
-    """One compiled dispatch unit: a callable plus its dispatch stats."""
+    """One compiled dispatch unit: a callable plus its dispatch stats.
 
-    __slots__ = ("key", "label", "fn", "executor")
+    Mesh plans carry `sharding` (a NamedSharding over their device
+    set) and `devices` (the participating chip ids, the device_call
+    attribution set); single-device plans leave both None/()."""
 
-    def __init__(self, key: tuple, fn: Callable, executor: str):
+    __slots__ = ("key", "label", "fn", "executor", "sharding",
+                 "devices")
+
+    def __init__(self, key: tuple, fn: Callable, executor: str,
+                 sharding=None, devices: Tuple[int, ...] = ()):
         self.key = key
         self.label = _label(key)
         self.fn = fn
         self.executor = executor
+        self.sharding = sharding
+        self.devices = devices
 
     def __call__(self, *args):
         t0 = time.perf_counter()
@@ -363,24 +404,35 @@ def _materialize(out):
 
 
 def _guarded(family: str, key: tuple, plan: ExecPlan, args: tuple,
-             batch: int) -> Tuple[str, Optional[object]]:
+             batch: int, defer_verdict: bool = False
+             ) -> Tuple[str, Optional[object]]:
     """One plan dispatch through the device_call choke point.  Returns
     ("ok", out), ("oom", None) — caller halves the batch — or
     ("fail", None) after recording breaker/quarantine state; callers
-    translate "fail" into the bit-exact host path (return None)."""
+    translate "fail" into the bit-exact host path (return None).
+
+    Mesh plans pass defer_verdict=True: a failure there is NOT yet a
+    plan failure or a host fallback — the mesh layer first probes the
+    participating chips and either shrinks the mesh (chip's fault,
+    plan is fine) or falls through to the single-device plan (which
+    owns its own accounting)."""
 
     def run():
         return _materialize(plan(*args))
 
     status, out = circuit.device_call(
         family, run, batch=batch, label=plan.label,
-        oom_to_fail=batch <= 1)
+        oom_to_fail=batch <= 1, devices=plan.devices or None)
     if status == "ok":
         return "ok", out
     if status == "oom":
         with _lock:
             _counters["oom_splits"] += 1
         return "oom", None
+    if defer_verdict:
+        # raw status up: "open" means no dispatch happened (nothing
+        # to probe), "fail"/"timeout" mean the mesh layer attributes
+        return status, None
     if status in ("fail", "timeout"):
         _note_plan_failure(key)
     with _lock:
@@ -422,6 +474,174 @@ def _pad_batch(arr: np.ndarray, bb: int, bs: int) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Mesh policy: when a batch rides the multi-chip mesh, and over which
+# surviving devices
+# ---------------------------------------------------------------------------
+
+
+def mesh_enabled() -> bool:
+    """Multi-chip mesh dispatch kill switch (CEPH_TPU_MESH=0 pins
+    every plan to a single device — bit-identical output)."""
+    return os.environ.get("CEPH_TPU_MESH", "1") != "0"
+
+
+def _mesh_min_bytes() -> int:
+    """Batch-size floor (total data bytes) below which the mesh is
+    not worth the fan-out; one chip's plan serves.  Default 1 MiB —
+    the same altitude as the fused-CRC floor."""
+    try:
+        return int(os.environ.get("CEPH_TPU_MESH_MIN_BYTES",
+                                  str(1 << 20)))
+    except ValueError:
+        return 1 << 20
+
+
+def _mesh_min_stripes() -> int:
+    try:
+        return int(os.environ.get("CEPH_TPU_MESH_MIN_STRIPES", "2"))
+    except ValueError:
+        return 2
+
+
+def _mesh_max_devices() -> int:
+    """0 = no cap; the bench mesh sweep sets this to measure 1, 2,
+    4, 8-chip legs of the SAME workload."""
+    try:
+        return int(os.environ.get("CEPH_TPU_MESH_MAX_DEVICES", "0"))
+    except ValueError:
+        return 0
+
+
+def _healthy_jax_devices() -> list:
+    try:
+        devs = list(jax.devices())
+    except Exception:
+        return []
+    return [d for d in devs if not circuit.device_degraded(d.id)]
+
+
+def _mesh_devices(batch: int, nbytes: int) -> Optional[tuple]:
+    """The device set a (batch, nbytes) dispatch should shard over,
+    or None for the single-device plan: mesh off / too small a batch
+    / fewer than two healthy chips.  At most one chip per stripe —
+    padding a 3-stripe batch onto 8 chips would compute more zeros
+    than data."""
+    if not (mesh_enabled() and HAVE_JAX):
+        return None
+    if batch < _mesh_min_stripes() or nbytes < _mesh_min_bytes():
+        return None
+    healthy = _healthy_jax_devices()
+    cap = _mesh_max_devices()
+    if cap:
+        healthy = healthy[:cap]
+    if len(healthy) < 2:
+        return None
+    return tuple(healthy[:min(len(healthy), batch)])
+
+
+def _probe_timeout() -> float:
+    try:
+        return float(os.environ.get("CEPH_TPU_MESH_PROBE_TIMEOUT_S",
+                                    20.0))
+    except ValueError:
+        return 20.0
+
+
+def _probe_devices(device_ids: Sequence[int]) -> list:
+    """Attribute a failed mesh dispatch: a trivial dispatch PINNED to
+    each participating chip, guarded by that chip's own
+    ``device:<id>`` breaker (threshold 1 — the probe targeted the
+    chip, a failure is decisive and trips it; the sick-device
+    injection seam fires here too).  Returns the ids that failed
+    their probe."""
+    dev_by_id = {d.id: d for d in (jax.devices() if HAVE_JAX else [])}
+    sick = []
+    for did in device_ids:
+        dev = dev_by_id.get(did)
+        if dev is None:
+            sick.append(did)
+            continue
+
+        def probe(d=dev):
+            x = jax.device_put(np.arange(8, dtype=np.uint8), d)
+            return np.asarray(x + 1)
+
+        status, _ = circuit.device_call(
+            f"{circuit.DEVICE_FAMILY_PREFIX}{did}", probe, batch=1,
+            label=f"mesh-probe:device{did}", devices=(did,),
+            timeout=_probe_timeout())
+        with _lock:
+            _counters["mesh_probes"] += 1
+        if status not in ("ok", "benign", "oom"):
+            sick.append(did)
+    return sick
+
+
+def _mesh_dispatch(family: str, key: tuple, plan: ExecPlan,
+                   args: tuple, batch: int) -> Tuple[str, object]:
+    """One mesh-plan dispatch with sick-chip attribution.  Returns
+    ("ok", out) / ("oom", None) / ("shrunk", None) — a sick chip was
+    found and tripped, re-plan on the survivors — / ("fail", None) —
+    a genuine (non-chip) failure, fall to the single-device plan."""
+    status, out = _guarded(family, key, plan, args, batch,
+                           defer_verdict=True)
+    if status == "ok":
+        with _lock:
+            _counters["mesh_dispatches"] += 1
+            _counters["mesh_rows"] += batch
+        return "ok", out
+    if status == "oom":
+        return "oom", None
+    if status == "open":
+        return "fail", None
+    sick = _probe_devices(plan.devices)
+    if sick:
+        # the chip's breaker owns the fault (tripped by its probe);
+        # the family must not stay tripped or every caller would
+        # degrade to host — the point of the shrink is that they
+        # re-plan instead
+        circuit.breaker(family).absolve()
+        with _lock:
+            _counters["mesh_shrinks"] += 1
+        return "shrunk", None
+    _note_plan_failure(key)
+    return "fail", None
+
+
+def mesh_dispatches() -> int:
+    """Monotone mesh-dispatch count (the encode service reads the
+    delta around a flush to report mesh_batches)."""
+    with _lock:
+        return _counters["mesh_dispatches"]
+
+
+def mesh_info() -> dict:
+    """Admin view of the mesh policy + live health: the device_health
+    tell command and meshbench surface this."""
+    total, healthy = 0, []
+    if HAVE_JAX and gf.backend_available():
+        try:
+            devs = jax.devices()
+            total = len(devs)
+            healthy = [d.id for d in devs
+                       if not circuit.device_degraded(d.id)]
+        except Exception:
+            pass
+    with _lock:
+        counters = {k: _counters[k] for k in
+                    ("mesh_dispatches", "mesh_rows", "mesh_shrinks",
+                     "mesh_probes")}
+    return {
+        "enabled": mesh_enabled(),
+        "devices_total": total,
+        "healthy": healthy,
+        "min_bytes": _mesh_min_bytes(),
+        "min_stripes": _mesh_min_stripes(),
+        **counters,
+    }
+
+
+# ---------------------------------------------------------------------------
 # Plan kinds
 # ---------------------------------------------------------------------------
 
@@ -436,6 +656,73 @@ def _build_local_encode(key: tuple, donate: bool) -> ExecPlan:
         return jfn(mbits, padded_dev)
 
     return ExecPlan(key, run, "xla_bits" + ("+donate" if donate else ""))
+
+
+def _build_mesh_encode(key: tuple, devices: tuple) -> ExecPlan:
+    """Stripe-parallel mesh twin of the local encode plan: the same
+    bit-matmul shard_mapped over a pure data-parallel mesh of the
+    surviving chips (parallel/striped.py owns the kernel + the
+    logical axis rules)."""
+    from ceph_tpu.parallel import striped
+
+    mesh = striped.stripe_mesh(list(devices))
+    jfn, sharding = striped.build_mesh_encode(mesh, _label(key))
+    return ExecPlan(key, jfn, f"mesh_bits[{len(devices)}]",
+                    sharding=sharding,
+                    devices=tuple(d.id for d in devices))
+
+
+def _build_mesh_encode_crc(key: tuple, devices: tuple,
+                           chunk_bytes: int) -> ExecPlan:
+    """Mesh twin of the fused encode+crc plan (the flush path's
+    product shape): parity and the hinfo CRC stay device-resident
+    between the stages of ONE stripe-parallel dispatch."""
+    from ceph_tpu.parallel import striped
+
+    mesh = striped.stripe_mesh(list(devices))
+    jfn, sharding = striped.build_mesh_encode_crc(
+        mesh, chunk_bytes, _label(key))
+    return ExecPlan(key, jfn, f"mesh_bits+crc[{len(devices)}]",
+                    sharding=sharding,
+                    devices=tuple(d.id for d in devices))
+
+
+def _mesh_encode_attempt(kind: str, family: str, matrix: np.ndarray,
+                         arr: np.ndarray, sig: str, rows: int,
+                         k: int, b: int, s: int
+                         ) -> Tuple[str, Optional[object]]:
+    """Try an encode-kind dispatch on the healthy mesh, shrinking on
+    sick chips.  Returns ("none", None) — take the single-device
+    plan — or ("ok", out) / ("oom", None).  Out is the raw padded
+    plan output; callers slice."""
+    devices = _mesh_devices(b, b * k * s)
+    for _attempt in range(8):           # shrink at most once per chip
+        if not devices:
+            return "none", None
+        ids = tuple(d.id for d in devices)
+        key = plan_key(sig, kind, rows, k, b, s, mesh=ids)
+        if _quarantined(key):
+            return "none", None
+        if kind == "mesh_encode_crc":
+            plan = _get_plan(
+                key, lambda: _build_mesh_encode_crc(key, devices, s))
+        else:
+            plan = _get_plan(
+                key, lambda: _build_mesh_encode(key, devices))
+        bb, bs = key[4], key[5]
+        # shard straight from host bytes in ONE device_put — landing
+        # on the default device first and re-scattering would double
+        # the transfer on the flush hot path
+        padded = jax.device_put(_pad_batch(arr, bb, bs),
+                                plan.sharding)
+        status, out = _mesh_dispatch(
+            family, key, plan, (_mbits_for(matrix), padded), b)
+        if status in ("ok", "oom"):
+            return status, out
+        if status != "shrunk":
+            return "none", None
+        devices = _mesh_devices(b, b * k * s)  # the survivors
+    return "none", None
 
 
 def encode(matrix: np.ndarray, data: np.ndarray, sig: str = None,
@@ -466,6 +753,25 @@ def encode(matrix: np.ndarray, data: np.ndarray, sig: str = None,
         return None
     rows = int(np.asarray(matrix).shape[0])
     sig = sig or matrix_signature(matrix)
+    if host_input:
+        # mesh attempt first: big-enough host batches shard over the
+        # healthy chips (device-resident inputs follow the caller's
+        # donation contract and stay on their single device)
+        mstatus, mout = _mesh_encode_attempt(
+            "mesh_encode", family, matrix, arr, sig, rows, k, b, s)
+        if mstatus == "ok":
+            out = np.asarray(mout)[:b, :, :s]
+            return out[0] if squeeze else out
+        if mstatus == "oom" and b > 1:
+            h = b // 2
+            first = encode(matrix, arr[:h], sig=sig, donate=donate,
+                           family=family)
+            second = encode(matrix, arr[h:], sig=sig, donate=donate,
+                            family=family)
+            if first is None or second is None:
+                return None
+            out = np.concatenate([first, second], axis=0)
+            return out[0] if squeeze else out
     eff_donate = bool(_donation_usable()
                       and (donate or (donate is None and host_input)))
     key = plan_key(sig, "encode", rows, k, b, s, donate=eff_donate)
@@ -502,12 +808,15 @@ def encode(matrix: np.ndarray, data: np.ndarray, sig: str = None,
 
 
 def _build_mesh_matmul(key: tuple) -> ExecPlan:
-    """Delegate to the default-mesh sharded pipeline (its per-shape
+    """Delegate to the healthy-set sharded pipeline (its per-shape
     jits are tracked_jit'd in parallel/striped.py, so retraces land in
-    the same counters)."""
+    the same counters).  The key's mesh element is the device-id set
+    the pipeline rides — it doubles as the device_call attribution
+    set, so the sick-device injection seam and per-chip success
+    accounting see decode dispatches too."""
     from ceph_tpu.parallel import backend
 
-    return ExecPlan(key, backend.matmul, "mesh")
+    return ExecPlan(key, backend.matmul, "mesh", devices=key[7])
 
 
 def matmul(mat: np.ndarray, data, sig: str = None,
@@ -532,15 +841,32 @@ def matmul(mat: np.ndarray, data, sig: str = None,
         return None
     mat = np.asarray(mat, dtype=np.uint8)
     rows = mat.shape[0]
-    # decode matrices cycle per erasure signature: key on shape only so
-    # one compile (matrix as runtime operand) serves every signature
-    key = plan_key(sig or "*", "matmul", rows, k, b, s)
-    if _quarantined(key):
-        return None
-    plan = _get_plan(key, lambda: _build_mesh_matmul(key))
-    bb, bs = key[4], key[5]
-    status, out = _guarded(family, key, plan,
-                           (mat, _pad_batch(arr, bb, bs)), b)
+    from ceph_tpu.parallel import backend
+
+    status, out = None, None
+    for _attempt in range(8):           # shrink at most once per chip
+        # decode matrices cycle per erasure signature: key on shape
+        # (matrix as runtime operand) + the LIVE healthy device set —
+        # a shrink retires the dead chip's plans by key miss
+        mesh_sig = backend.mesh_device_ids()
+        key = plan_key(sig or "*", "matmul", rows, k, b, s,
+                       mesh=mesh_sig)
+        if _quarantined(key):
+            return None
+        plan = _get_plan(key, lambda: _build_mesh_matmul(key))
+        bb, bs = key[4], key[5]
+        args = (mat, _pad_batch(arr, bb, bs))
+        if len(mesh_sig) > 1:
+            status, out = _mesh_dispatch(family, key, plan, args, b)
+            if status == "shrunk":
+                continue                # re-plan on the survivors
+            if status == "fail":
+                with _lock:
+                    _counters["host_fallbacks"] += 1
+                return None
+        else:
+            status, out = _guarded(family, key, plan, args, b)
+        break
     if status == "oom" and b > 1:
         h = b // 2
         first = matmul(mat, arr[:h], sig=sig, family=family)
@@ -555,6 +881,18 @@ def matmul(mat: np.ndarray, data, sig: str = None,
     return out[0] if squeeze else out
 
 
+def fused_encode_crc_step(mbits, d, consts):
+    """THE fused parity + per-chunk zero-seeded crc32c kernel — the
+    one trace both the single-device plan and the mesh builders
+    (parallel/striped.build_mesh_encode_crc) wrap.  Bit-exact
+    single-vs-mesh parity depends on them tracing identical math, so
+    there is exactly one definition."""
+    parity = gf._gf2_matmul_bytes_impl(mbits, d)
+    chunks = jnp.concatenate([d, parity], axis=1)
+    bits = cks.crc32c_partial_bits(chunks, consts)
+    return parity, cks.crc32c_pack_bits(bits)
+
+
 def _build_encode_crc(key: tuple) -> ExecPlan:
     """Fused parity + per-chunk zero-seeded crc32c in ONE dispatch
     (parity and the ECUtil::HashInfo ledger used to be two round
@@ -566,10 +904,7 @@ def _build_encode_crc(key: tuple) -> ExecPlan:
     consts = cks.make_crc_consts(s)
 
     def impl(mbits, d):
-        parity = gf._gf2_matmul_bytes_impl(mbits, d)
-        chunks = jnp.concatenate([d, parity], axis=1)
-        bits = cks.crc32c_partial_bits(chunks, consts)
-        return parity, cks.crc32c_pack_bits(bits)
+        return fused_encode_crc_step(mbits, d, consts)
 
     jfn = tracked_jit(_label(key), impl)
     return ExecPlan(key, jfn, "xla_bits+crc")
@@ -594,6 +929,24 @@ def encode_with_crc(matrix: np.ndarray, data: np.ndarray,
         return None
     rows = int(np.asarray(matrix).shape[0])
     sig = sig or matrix_signature(matrix)
+    # mesh attempt first: the encode service's flush batches land
+    # here — one stripe-parallel dispatch over the healthy chips,
+    # parity + CRC fused on-device
+    mstatus, mout = _mesh_encode_attempt(
+        "mesh_encode_crc", "fused-crc", matrix, arr, sig, rows, k,
+        b, s)
+    if mstatus == "ok":
+        mparity, mcrcs = mout
+        return (np.asarray(mparity)[:b],
+                np.asarray(mcrcs).astype(np.uint32)[:b])
+    if mstatus == "oom" and b > 1:
+        h = b // 2
+        first = encode_with_crc(matrix, arr[:h], sig=sig)
+        second = encode_with_crc(matrix, arr[h:], sig=sig)
+        if first is None or second is None:
+            return None
+        return (np.concatenate([first[0], second[0]], axis=0),
+                np.concatenate([first[1], second[1]], axis=0))
     key = plan_key(sig, "encode_crc", rows, k, b, s)
     if _quarantined(key):
         return None

@@ -97,9 +97,10 @@ class PrometheusModule(MgrModule):
         "_queue_depth", "_queue_bytes", "_window_ms",
         "_max_batch_bytes", "_enabled", "_plans",
         # device-health breaker leaves: state and backoff are levels,
-        # and the consecutive-failure count resets on every success
+        # and the consecutive-failure count resets on every success;
+        # a chip's mesh membership is a level too
         "_state_code", "_retry_in_s", "_consecutive",
-        "_quarantined_plans",
+        "_quarantined_plans", "_mesh_member",
         # hedge per-peer latency model leaves: moving estimates, not
         # monotone counts
         "_ewma_ms", "_p95_ms",
@@ -118,6 +119,8 @@ class PrometheusModule(MgrModule):
         "peers": ("peer", "peer"),
         # the qos section's per-tenant admission/queue rows
         "tenants": ("tenant", "tenant"),
+        # the device-health section's per-chip breaker + mesh rows
+        "devices": ("device", "device"),
     }
 
     @classmethod
@@ -129,9 +132,9 @@ class PrometheusModule(MgrModule):
         - numeric/bool: plain counter sample;
         - PerfCounters histogram dump ({buckets, bounds, count, sum}):
           cumulative `_bucket{le=...}` rows + `_count`/`_sum`;
-        - a `profiles`/`per_plan`/`peers` map: recurse with a
-          `profile`/`peer` label instead of exploding the metric
-          namespace (_LABEL_MAPS);
+        - a `profiles`/`per_plan`/`peers`/`tenants`/`devices` map:
+          recurse with a `profile`/`peer`/`tenant`/`device` label
+          instead of exploding the metric namespace (_LABEL_MAPS);
         - any other dict: recurse with _-joined names (the tier /
           plan_cache / encode_service sections).
         Non-numeric leaves (strings, lists) are skipped."""

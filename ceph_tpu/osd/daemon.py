@@ -529,8 +529,9 @@ class OSDDaemon:
             "device_health": (
                 lambda cmd: self._cmd_device_health(),
                 "per-family circuit-breaker states, trip/probe/"
-                "fallback counters, poisoned-plan quarantine, and"
-                " the active fault-injection spec"),
+                "fallback counters, per-chip breakers + live mesh"
+                " membership, poisoned-plan quarantine, and the"
+                " active fault-injection spec"),
             "qos_status": (
                 lambda cmd: self._cmd_qos_status(),
                 "per-tenant mClock QoS: scheduler grant/queue state,"
@@ -576,10 +577,23 @@ class OSDDaemon:
                     and not isinstance(v, bool)}
             for label, st in svc.get("profiles", {}).items()}
         # breaker states per dispatch family (numeric-only: the
-        # prometheus flattener exports state as the state_code gauge)
+        # prometheus flattener exports state as the state_code gauge);
+        # per-chip breakers ride a `devices` label map so each chip is
+        # a ceph_osd_device_health_device_*{device=...} row, with its
+        # live mesh membership alongside
         from ceph_tpu.common import circuit
 
-        out["device_health"] = circuit.perf_dump()
+        dh = circuit.perf_dump()
+        devices = {
+            dev: {k: v for k, v in st.items()
+                  if not isinstance(v, str)}
+            for dev, st in circuit.device_stats().items()}
+        if devices:
+            healthy = set(ec_plan.mesh_info().get("healthy", []))
+            for dev, st in devices.items():
+                st["mesh_member"] = int(int(dev) in healthy)
+            dh["devices"] = devices
+        out["device_health"] = dh
         # hedged-read scheduler: counters + the per-peer EWMA model
         # (the prometheus flattener turns `peers` into peer-labeled
         # rows)
@@ -667,9 +681,17 @@ class OSDDaemon:
 
         return {
             "breakers": circuit.stats_all(),
+            # per-chip health + the live mesh: which chips are in the
+            # dispatch mesh right now, which are held out, and the
+            # shrink/probe history ('one sick chip shrinks the mesh,
+            # not the batch to host' — the operator proof)
+            "devices": circuit.device_stats(),
+            "mesh": ec_plan.mesh_info(),
             "plan_quarantine": ec_plan.quarantine_info(),
             "encode_service_device_fallback":
                 self.encode_service.counters.get("device_fallback", 0),
+            "encode_service_mesh_batches":
+                self.encode_service.counters.get("mesh_batches", 0),
             "decode_host_retries":
                 self.perf.get("decode_host_retries", 0),
             "injection": os.environ.get(

@@ -16,6 +16,13 @@ slots, so while batch N computes on device, batch N+1 accumulates and
 the sub-write network fan-out of already-completed ops overlaps the
 next dispatch.
 
+Mesh scale-out: each flush picks mesh vs single-device through the
+plan cache (ec/plan.py) — a batch past the CEPH_TPU_MESH_MIN_BYTES /
+_MIN_STRIPES gates shards stripe-parallel over the live healthy chip
+mesh, and a sick chip shrinks the mesh (never degrades the flush to
+host).  The `mesh_batches` counter reports how many flushes rode the
+mesh.
+
 Knobs (read at construction):
 
   CEPH_TPU_ENCODE_BATCH_WINDOW_MS  accumulation window, default 1.0
@@ -141,7 +148,7 @@ class EncodeService:
         self._usable_cache: Dict[int, bool] = {}
         self.counters = {"requests": 0, "batched": 0, "inline": 0,
                          "shed": 0, "batches": 0, "dispatch_errors": 0,
-                         "device_fallback": 0}
+                         "device_fallback": 0, "mesh_batches": 0}
 
     # -- public API (the daemon's awaited entry points) -------------------
 
@@ -366,12 +373,17 @@ class EncodeService:
         fallbacks recorded while it ran — counts once under
         device_fallback."""
         from ceph_tpu.common import circuit
+        from ceph_tpu.ec import plan as ec_plan
 
         # scoped to the EC families this batch can actually touch — an
         # unscoped delta would attribute a concurrent hitset/CRUSH
         # fault to this flush
         fams = ("ec-encode", "ec-decode", "fused-crc")
         faults_before = circuit.fault_events(fams)
+        # whether THIS flush rode the multi-chip mesh (plan.py picks
+        # mesh vs single-device per flush from batch size + mesh
+        # health; the delta surfaces the choice per batch)
+        mesh_before = ec_plan.mesh_dispatches()
         outs: Optional[list] = None
         try:
             if q.kind == "encode_hinfo":
@@ -395,6 +407,8 @@ class EncodeService:
                     outs.append(e)
         if circuit.fault_events(fams) > faults_before:
             self.counters["device_fallback"] += 1
+        if ec_plan.mesh_dispatches() > mesh_before:
+            self.counters["mesh_batches"] += 1
         return outs
 
     def _run_one(self, q: _Bucket, payload):

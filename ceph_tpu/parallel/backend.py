@@ -7,6 +7,15 @@ a single real chip is simply the (dp=1, sp=1) mesh, multi-chip needs
 no separate implementation (the SURVEY §5.7/§5.8 stance: striping
 across chips is the same program over a bigger mesh).
 
+The mesh is derived from the LIVE HEALTHY device set: chips held out
+by their per-device breaker (common/circuit.py ``device:<id>``
+families) are excluded, and the mesh — with its compiled pipelines —
+is rebuilt whenever that set changes, so one sick chip shrinks the
+mesh instead of poisoning every dispatch.  Awkward survivor counts
+(3, 5, 7 chips) and chunk widths the byte axis cannot divide reshape
+to a pure data-parallel (n, 1) mesh rather than raising or declining
+(the partial-mesh fallback).
+
 Matmuls are dp-sharded over the stripe batch; at sp==1 the per-device
 kernel is the packed-word Pallas path (ops/gf_pallas.py) for host
 inputs, the XLA bit-decomposition otherwise; at sp>1 the byte axis is
@@ -17,56 +26,125 @@ ICI collectives (parallel/striped.py).
 from __future__ import annotations
 
 import functools
-from typing import Dict, Optional, Tuple
+import os
+from typing import Dict, List, Optional
 
 import numpy as np
 
 # observability: how many device dispatches the pipeline served (and
 # how many stripe rows rode them — calls vs rows is the batching fill
-# the encode service buys) — the dryrun and tests assert the cluster
-# datapath actually lands here
-stats: Dict[str, int] = {"matmul_calls": 0, "batch_rows": 0}
+# the encode service buys; mesh_rebuilds counts healthy-set changes) —
+# the dryrun and tests assert the cluster datapath actually lands here
+stats: Dict[str, int] = {"matmul_calls": 0, "batch_rows": 0,
+                         "mesh_rebuilds": 0}
 
 
-@functools.lru_cache(maxsize=1)
-def default_mesh():
+def healthy_devices() -> List:
+    """The live device set minus chips whose per-device breaker holds
+    them out.  Never empty while jax has devices: with every chip
+    degraded, device 0 is kept so the family breaker (which owns the
+    'device tier entirely down' verdict) still decides host fallback.
+    CEPH_TPU_MESH=0 pins the set to one device (the single-chip kill
+    switch — bit-identical to the pre-mesh behavior)."""
     import jax
 
+    from ceph_tpu.common import circuit
+
+    devs = list(jax.devices())
+    if os.environ.get("CEPH_TPU_MESH", "1") == "0":
+        return devs[:1]
+    healthy = [d for d in devs if not circuit.device_degraded(d.id)]
+    return healthy or devs[:1]
+
+
+def mesh_device_ids() -> tuple:
+    """Device ids the next dispatch would ride (the `devices=`
+    attribution set for device_call); () when jax is unavailable."""
+    try:
+        return tuple(d.id for d in healthy_devices())
+    except Exception:
+        return ()
+
+
+_mesh_cache: Dict[tuple, object] = {}
+
+
+def default_mesh():
+    """The healthy-set mesh, rebuilt when the set changes (tests and
+    the multichip dryrun override this symbol to pin a mesh)."""
     from ceph_tpu.parallel.mesh import make_mesh
 
-    return make_mesh(jax.devices())
+    devs = healthy_devices()
+    sig = tuple(d.id for d in devs)
+    mesh = _mesh_cache.get(sig)
+    if mesh is None:
+        if _mesh_cache:
+            stats["mesh_rebuilds"] += 1
+        if len(_mesh_cache) > 16:       # bound churn bookkeeping
+            _mesh_cache.clear()
+        mesh = _mesh_cache[sig] = make_mesh(devs)
+    return mesh
+
+
+def _mesh_for_chunk(chunk: int):
+    """The dispatch mesh for a given chunk width: the healthy-set
+    default, reshaped to pure data-parallel when the byte axis's sp
+    split does not divide the chunk (a partial mesh reshapes, it
+    never raises)."""
+    from ceph_tpu.parallel.mesh import make_mesh
+
+    mesh = default_mesh()
+    sp = dict(mesh.shape).get("sp", 1)
+    if sp > 1 and chunk % sp:
+        devs = list(mesh.devices.flat)
+        key = (tuple(d.id for d in devs), "dp-only")
+        flat = _mesh_cache.get(key)
+        if flat is None:
+            flat = _mesh_cache[key] = make_mesh(devs, dp=len(devs),
+                                                sp=1)
+        mesh = flat
+    return mesh
+
+
+def _mesh_sig(mesh) -> tuple:
+    """Process-local identity of a mesh: device ids + axis shape (a
+    pipeline compiled for a dead chip's mesh must not serve the
+    shrunken survivor set)."""
+    return (tuple(d.id for d in mesh.devices.flat),
+            tuple(dict(mesh.shape).items()))
 
 
 @functools.lru_cache(maxsize=64)
-def _pipeline(k: int, r: int, chunk: int):
-    """Keyed by SHAPE only: matrices ride as runtime operands (decode
-    cycles through per-erasure-signature matrices — keying on the
-    matrix would rebuild and recompile per signature)."""
+def _pipeline(k: int, r: int, chunk: int, mesh_sig: tuple = ()):
+    """Keyed by SHAPE + mesh signature: matrices ride as runtime
+    operands (decode cycles through per-erasure-signature matrices —
+    keying on the matrix would rebuild and recompile per signature);
+    the mesh signature retires pipelines when the healthy set
+    changes."""
     from ceph_tpu.models import reed_solomon as rs
     from ceph_tpu.parallel.striped import ShardedPipeline
 
-    return ShardedPipeline(default_mesh(), k, r, chunk,
+    return ShardedPipeline(_mesh_for_chunk(chunk), k, r, chunk,
                            rs.reed_sol_van_matrix(k, r))
 
 
 def matmul(mat: np.ndarray, data) -> Optional[np.ndarray]:
-    """(R,K) GF(2^8) matrix x (K,S)/(B,K,S) uint8 over the default
+    """(R,K) GF(2^8) matrix x (K,S)/(B,K,S) uint8 over the healthy
     mesh; None when the input cannot ride the mesh (caller falls back
     to the single-device path)."""
     if not isinstance(data, np.ndarray):
         return None
-    mesh = default_mesh()
-    sp = mesh.shape["sp"]
-    dp = mesh.shape["dp"]
     arr = data
     squeeze = False
     if arr.ndim == 2:
         arr = arr[None]
         squeeze = True
     b, k, s = arr.shape
-    if s == 0 or s % sp or s % 4:
+    if s == 0 or s % 4:
         return None
-    pipe = _pipeline(k, len(mat), s)
+    mesh = _mesh_for_chunk(s)
+    dp = dict(mesh.shape).get("dp", 1)
+    pipe = _pipeline(k, len(mat), s, _mesh_sig(mesh))
     pad = -b % dp
     if pad:
         arr = np.concatenate(

@@ -1,0 +1,276 @@
+"""Multi-chip mesh bench + probe: the scale-out proof as one module.
+
+Two entry points, shared by bench.py (the `_mesh_probe` pre-contract
+check and the budget-gated `bench_mesh` sweep section), the multichip
+driver tail (`__graft_entry__.dryrun_multichip`), and the test tier:
+
+* ``probe_report()`` — correctness: the SAME stripe batch through the
+  single-device plan, the N-device mesh plan, and the host numpy
+  oracle must be bit-identical; then a scripted sick chip
+  (``CEPH_TPU_INJECT_DEVICE_FAIL=sick=<id>``) must shrink the mesh —
+  breaker tripped, survivors re-planned, output still bit-exact,
+  ZERO host fallbacks.
+* ``sweep_report(sizes)`` — throughput: the same fused encode+crc
+  workload at mesh sizes 1 -> 2 -> 4 -> 8 (capped at the visible
+  device count via CEPH_TPU_MESH_MAX_DEVICES), GiB/s of data bytes
+  per size and the speedup over the single-chip leg.  On real
+  multi-chip hardware near-linear scaling is the acceptance shape;
+  on a single-core host with virtual devices the sweep still proves
+  the plans compile and stay bit-exact at every size.
+
+CLI (``python -m ceph_tpu.parallel.meshbench --probe|--sweep``)
+prints ONE JSON line — bench.py runs it as a subprocess so the
+device-count virtualization (XLA_FLAGS) can be applied before the
+backend initializes, and a wedged tunnel stays contained.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+import numpy as np
+
+_SWEEP_SIZES = (1, 2, 4, 8)
+
+
+@contextlib.contextmanager
+def _mesh_gates_open():
+    """Hold the mesh byte gate open for the measurement, RESTORING it
+    after: the dryrun driver tail runs these reports in-process, and
+    a leaked CEPH_TPU_MESH_MIN_BYTES=0 would make every later tiny
+    batch in that process mesh (the 1 MiB floor silently gone)."""
+    prev = os.environ.get("CEPH_TPU_MESH_MIN_BYTES")
+    os.environ.setdefault("CEPH_TPU_MESH_MIN_BYTES", "0")
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("CEPH_TPU_MESH_MIN_BYTES", None)
+        else:
+            os.environ["CEPH_TPU_MESH_MIN_BYTES"] = prev
+
+
+def ensure_devices(n: int = 8) -> int:
+    """Make >= n devices visible when the platform allows it: real
+    accelerator devices are used as-is; the CPU backend is virtualized
+    via xla_force_host_platform_device_count (must run before the
+    backend initializes — the reason bench.py subprocesses this
+    module).  Returns the visible device count."""
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)",
+                  flags)
+    if m is None:
+        flags += f" --xla_force_host_platform_device_count={n}"
+    elif int(m.group(1)) < n:
+        flags = (flags[:m.start()] +
+                 f"--xla_force_host_platform_device_count={n}" +
+                 flags[m.end():])
+    os.environ["XLA_FLAGS"] = flags.strip()
+
+    import jax
+
+    return len(jax.devices())
+
+
+def _workload(smoke: bool):
+    from ceph_tpu.models import reed_solomon as rs
+
+    if smoke:
+        k, m, chunk, batch = 4, 2, 16 * 1024, 32
+    else:
+        k, m, chunk, batch = 8, 3, 256 * 1024, 64
+    rng = np.random.default_rng(929)
+    data = rng.integers(0, 256, (batch, k, chunk), dtype=np.uint8)
+    return rs.reed_sol_van_matrix(k, m), data, m
+
+
+def _host_oracle(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+    from ceph_tpu.ops import gf
+
+    return np.stack([gf.gf_matmul_host(matrix, data[i])
+                     for i in range(data.shape[0])])
+
+
+def _encode_crc(matrix, data, max_devices: int):
+    """One fused encode+crc through the plan cache with the mesh
+    capped at `max_devices` chips (0 = single-device plans only)."""
+    from ceph_tpu.ec import plan
+
+    prev = os.environ.get("CEPH_TPU_MESH_MAX_DEVICES")
+    prev_mesh = os.environ.get("CEPH_TPU_MESH")
+    try:
+        if max_devices <= 1:
+            os.environ["CEPH_TPU_MESH"] = "0"
+        else:
+            os.environ["CEPH_TPU_MESH"] = "1"
+            os.environ["CEPH_TPU_MESH_MAX_DEVICES"] = str(max_devices)
+        return plan.encode_with_crc(matrix, data, sig="meshbench")
+    finally:
+        for name, val in (("CEPH_TPU_MESH_MAX_DEVICES", prev),
+                          ("CEPH_TPU_MESH", prev_mesh)):
+            if val is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = val
+
+
+def probe_report(smoke: bool = True) -> dict:
+    """The pre-contract mesh probe: bit-exactness across 1-device /
+    N-device / host oracle, then the sick-chip shrink leg.  Raises on
+    any violated invariant (the caller reports the probe failed)."""
+    with _mesh_gates_open():
+        return _probe_report(smoke)
+
+
+def _probe_report(smoke: bool) -> dict:
+    from ceph_tpu.common import circuit
+    from ceph_tpu.ec import plan
+
+    n = ensure_devices()
+    matrix, data, m = _workload(smoke)
+    oracle = _host_oracle(matrix, data)
+    circuit.reset_all()
+    plan.reset_stats()
+
+    single = _encode_crc(matrix, data, 1)
+    meshed = _encode_crc(matrix, data, n)
+    bitexact = int(
+        single is not None and meshed is not None
+        and np.array_equal(single[0], oracle)
+        and np.array_equal(meshed[0], oracle)
+        and np.array_equal(single[1], meshed[1]))
+    mesh_dispatches = plan.stats()["mesh_dispatches"]
+
+    # sick-chip leg: the LAST device starts failing; the dispatch
+    # must shrink the mesh (probe -> trip -> re-plan) and stay
+    # bit-exact with ZERO host fallbacks.  Not applicable on a
+    # single-device environment (no mesh to shrink).
+    if n < 2:
+        return {
+            "devices": n,
+            "bitexact": bitexact,
+            "mesh_dispatches": mesh_dispatches,
+            "sick_chip_shrunk": None,
+            "host_fallbacks": plan.stats()["host_fallbacks"],
+        }
+    sick_chip_shrunk = 0
+    host_fallbacks = -1
+    prev_inject = os.environ.get("CEPH_TPU_INJECT_DEVICE_FAIL")
+    try:
+        import jax
+
+        sick_id = jax.devices()[-1].id
+        os.environ["CEPH_TPU_INJECT_DEVICE_FAIL"] = f"sick={sick_id}"
+        out = _encode_crc(matrix, data, n)
+        st = plan.stats()
+        host_fallbacks = st["host_fallbacks"]
+        # NOTE: no healthy-list assertion — the device breaker's
+        # full-jitter backoff is uniform from zero, so the chip may
+        # legitimately read re-admittable within milliseconds (its
+        # next dispatch is the half-open probe).  The invariants are:
+        # the dispatch SUCCEEDED bit-exactly, a shrink happened, the
+        # chip's breaker tripped, and nothing fell to host.
+        sick_chip_shrunk = int(
+            out is not None
+            and np.array_equal(out[0], oracle)
+            and st["mesh_shrinks"] >= 1
+            and host_fallbacks == 0
+            and circuit.device_breaker(sick_id).state == "open")
+    finally:
+        if prev_inject is None:
+            os.environ.pop("CEPH_TPU_INJECT_DEVICE_FAIL", None)
+        else:
+            os.environ["CEPH_TPU_INJECT_DEVICE_FAIL"] = prev_inject
+        circuit.reset_all()
+    return {
+        "devices": n,
+        "bitexact": bitexact,
+        "mesh_dispatches": mesh_dispatches,
+        "sick_chip_shrunk": sick_chip_shrunk,
+        "host_fallbacks": host_fallbacks,
+    }
+
+
+def sweep_report(sizes: Optional[List[int]] = None,
+                 smoke: bool = True, iters: int = 3) -> dict:
+    """GiB/s of data bytes per mesh size, best-of-`iters` after a
+    compile/warm pass, bit-exactness asserted at every size against
+    the single-chip leg's parity."""
+    with _mesh_gates_open():
+        return _sweep_report(sizes, smoke, iters)
+
+
+def _sweep_report(sizes: Optional[List[int]], smoke: bool,
+                  iters: int) -> dict:
+    n = ensure_devices()
+    matrix, data, m = _workload(smoke)
+    nbytes = data.nbytes
+    sizes = [s for s in (sizes or _SWEEP_SIZES) if s <= n]
+    rows = []
+    base_out = None
+    base_gibs = None
+    for size in sizes:
+        out = _encode_crc(matrix, data, size)  # compile + warm
+        if out is None:
+            rows.append({"devices": size, "gibs": None,
+                         "speedup_x": None})
+            continue
+        if base_out is None:
+            base_out = out
+        else:
+            assert np.array_equal(out[0], base_out[0]), \
+                f"mesh size {size} parity != single-chip parity"
+            assert np.array_equal(out[1], base_out[1]), \
+                f"mesh size {size} crcs != single-chip crcs"
+        best = float("inf")
+        for _ in range(max(iters, 1)):
+            t0 = time.perf_counter()
+            _encode_crc(matrix, data, size)
+            best = min(best, time.perf_counter() - t0)
+        gibs = nbytes / best / (1 << 30)
+        if base_gibs is None:
+            base_gibs = gibs
+        rows.append({"devices": size, "gibs": round(gibs, 3),
+                     "speedup_x": round(gibs / base_gibs, 2)
+                     if base_gibs else None})
+    speedups = [r["speedup_x"] for r in rows
+                if r["speedup_x"] is not None]
+    return {
+        "mesh_sweep": rows,
+        "mesh_devices_visible": n,
+        "mesh_speedup_max_x": max(speedups) if speedups else None,
+        "mesh_workload_bytes": nbytes,
+        "mesh_smoke": bool(smoke),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="meshbench")
+    ap.add_argument("--probe", action="store_true")
+    ap.add_argument("--sweep", action="store_true")
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--sizes", type=str, default="")
+    args = ap.parse_args(argv)
+    smoke = args.smoke or os.environ.get(
+        "CEPH_TPU_BENCH_SMOKE") == "1"
+    out = {}
+    if args.probe or not args.sweep:
+        out.update(probe_report(smoke=smoke))
+    if args.sweep:
+        sizes = [int(s) for s in args.sizes.split(",") if s] or None
+        out.update(sweep_report(sizes=sizes, smoke=smoke))
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,6 +53,90 @@ def _shard_map(f, *, mesh, in_specs, out_specs):
                check_rep=False)
 
 
+# ---------------------------------------------------------------------------
+# Logical axis rules (the T5X partitioner pattern, SNIPPETS [1]/[2])
+# ---------------------------------------------------------------------------
+
+# The EC data plane's logical axes and where each lands on the chip
+# mesh.  `stripe` is data-parallel over the mesh's "dp" axis (stripes
+# are plentiful and independent); `shard` (the k+m chunk axis) stays
+# WITHIN a chip — a stripe's shards share the generator matmul, and
+# splitting them would turn a local MXU product into cross-chip
+# traffic; `byte` may be sequence-parallel over "sp" (elementwise for
+# the code, so only the 32-bit CRC fold ever crosses ICI).  The
+# product-path mesh plans (ec/plan.py) use pure stripe-parallel
+# (sp=1) meshes; the dryrun exercises the sp>1 byte split.
+LOGICAL_AXIS_RULES = (("stripe", "dp"), ("shard", None), ("byte", "sp"))
+
+
+def logical_spec(*logical_axes, rules=LOGICAL_AXIS_RULES,
+                 mesh: Optional[Mesh] = None):
+    """PartitionSpec for an array whose dims carry the given logical
+    axis names (None = unnamed/replicated dim).  A rule that maps to
+    a mesh axis ABSENT from `mesh` (e.g. a pure ("dp",) stripe mesh
+    with no "sp") resolves to None — the same array spec works on any
+    mesh shape, which is what lets a shrunken mesh reuse the same
+    kernel builders."""
+    table = dict(rules)
+    names = []
+    axes = set(mesh.axis_names) if mesh is not None else None
+    for ax in logical_axes:
+        m = table.get(ax) if ax is not None else None
+        if m is not None and axes is not None and m not in axes:
+            m = None
+        names.append(m)
+    return P(*names)
+
+
+def stripe_mesh(devices) -> Mesh:
+    """A pure data-parallel ("dp",) mesh over the given devices: one
+    stripe sub-batch per chip, shards and bytes within-chip — the
+    product path's mesh shape (ec/plan.py mesh plans)."""
+    return Mesh(np.asarray(devices), axis_names=("dp",))
+
+
+def build_mesh_encode(mesh: Mesh, label: str):
+    """Compiled mesh EC encode: (mbits, (B, k, S)) -> (B, m, S) with
+    the stripe batch sharded over "dp".  The GF(2) bit-matmul is
+    purely local per chip (the byte axis is elementwise for the
+    code), so there is no collective at all — near-linear scaling is
+    the expected shape.  Returns (jitted_fn, input_sharding); callers
+    device_put the batch with the sharding first (the pre-sharded-
+    input discipline, SNIPPETS [3]) so dispatch never re-lands bytes
+    on host between stages."""
+    from ceph_tpu.ec import plan
+
+    data_spec = logical_spec("stripe", "shard", "byte", mesh=mesh)
+    fn = _shard_map(gf._gf2_matmul_bytes_impl, mesh=mesh,
+                    in_specs=(P(), data_spec), out_specs=data_spec)
+    return (plan.tracked_jit(label, fn),
+            NamedSharding(mesh, data_spec))
+
+
+def build_mesh_encode_crc(mesh: Mesh, chunk_bytes: int, label: str):
+    """Compiled mesh fused encode + per-chunk zero-seeded crc32c:
+    (mbits, (B, k, S)) -> (parity (B, m, S), crcs (B, k+m) packed
+    bits).  Traces plan.fused_encode_crc_step — the SAME kernel the
+    single-device plan jits, so single-vs-mesh bit-exactness is by
+    construction — sharded stripe-parallel; with whole chunks
+    on-chip the CRC needs no cross-chip fold, and parity + CRC stay
+    device-resident between the stages inside ONE dispatch.  Returns
+    (jitted_fn, input_sharding)."""
+    from ceph_tpu.ec import plan
+    from ceph_tpu.ops import checksum as cks
+
+    consts = cks.make_crc_consts(chunk_bytes)
+    data_spec = logical_spec("stripe", "shard", "byte", mesh=mesh)
+    crc_spec = logical_spec("stripe", "shard", mesh=mesh)
+    local_step = functools.partial(plan.fused_encode_crc_step,
+                                   consts=consts)
+    fn = _shard_map(local_step, mesh=mesh,
+                    in_specs=(P(), data_spec),
+                    out_specs=(data_spec, crc_spec))
+    return (plan.tracked_jit(label, fn),
+            NamedSharding(mesh, data_spec))
+
+
 class ShardedPipeline:
     """A compiled multi-chip encode(+hinfo crc)(+placement) step."""
 
@@ -62,8 +146,12 @@ class ShardedPipeline:
         self.mesh = mesh
         self.k, self.m = k, m
         self.chunk_bytes = chunk_bytes
-        self.sp = mesh.shape["sp"]
-        self.dp = mesh.shape["dp"]
+        # partial meshes (a shrunken healthy set, or a pure ("dp",)
+        # stripe mesh) may lack either axis: an absent axis is size 1,
+        # not an error — the same pipeline code serves every shape
+        shape = dict(mesh.shape)
+        self.sp = shape.get("sp", 1)
+        self.dp = shape.get("dp", 1)
         if chunk_bytes % self.sp:
             raise ValueError(
                 f"chunk_bytes {chunk_bytes} not divisible by sp={self.sp}")
@@ -96,13 +184,19 @@ class ShardedPipeline:
 
     def _build_encode(self):
         mesh = self.mesh
+        has_sp = "sp" in dict(mesh.shape)
 
         def local_step(mbits, data, pgs):
             # data (B_l, k, S_l); pgs (B_l,)
             parity = gf.gf2_matmul_bytes(mbits, data)
             chunks = jnp.concatenate([data, parity], axis=1)
             part = cks.crc32c_partial_bits(chunks, self._crc_consts)
-            gathered = jax.lax.all_gather(part, "sp")  # (P, B_l, k+m, 32)
+            if has_sp:
+                # (P, B_l, k+m, 32): combine per-segment partials
+                gathered = jax.lax.all_gather(part, "sp")
+            else:
+                # pure stripe mesh: whole chunks on-chip, no fold
+                gathered = part[None]
             crc = cks.crc32c_pack_bits(self._fold_segments(gathered))
             crc = crc ^ jnp.uint32(self._seed_adv)
             if self._placement_one is not None:
@@ -111,21 +205,26 @@ class ShardedPipeline:
                 placement = jnp.zeros((pgs.shape[0], 1), dtype=jnp.int32)
             return parity, crc, placement
 
+        data_spec = logical_spec("stripe", "shard", "byte", mesh=mesh)
+        row_spec = logical_spec("stripe", mesh=mesh)
         shard = _shard_map(
             functools.partial(local_step, self._mbits),
             mesh=mesh,
-            in_specs=(P("dp", None, "sp"), P("dp")),
-            out_specs=(P("dp", None, "sp"), P("dp"), P("dp")),
+            in_specs=(data_spec, row_spec),
+            out_specs=(data_spec, row_spec, row_spec),
         )
         return plan.tracked_jit(
             f"striped.encode k{self.k}m{self.m} S{self.chunk_bytes}",
             shard)
 
     def data_sharding(self) -> NamedSharding:
-        return NamedSharding(self.mesh, P("dp", None, "sp"))
+        return NamedSharding(
+            self.mesh, logical_spec("stripe", "shard", "byte",
+                                    mesh=self.mesh))
 
     def pg_sharding(self) -> NamedSharding:
-        return NamedSharding(self.mesh, P("dp"))
+        return NamedSharding(self.mesh,
+                             logical_spec("stripe", mesh=self.mesh))
 
     def put_stripes(self, data) -> jax.Array:
         """Place a (B, k, S) host batch onto the mesh with dp/sp sharding."""
@@ -154,7 +253,8 @@ class ShardedPipeline:
         status, out = circuit.device_call(
             "ec-encode", self._encode, data,
             jnp.asarray(pgs, dtype=jnp.int32), batch=int(b),
-            label="striped.encode", oom_to_fail=True)
+            label="striped.encode", oom_to_fail=True,
+            devices=tuple(d.id for d in self.mesh.devices.flat))
         if status != "ok":
             if isinstance(out, BaseException):
                 raise out
@@ -173,10 +273,11 @@ class ShardedPipeline:
             def local(dmat_bits, survivors):
                 return gf.gf2_matmul_bytes(dmat_bits, survivors)
 
+            spec = logical_spec("stripe", "shard", "byte", mesh=mesh)
             shard = _shard_map(
                 local, mesh=mesh,
-                in_specs=(P(), P("dp", None, "sp")),
-                out_specs=P("dp", None, "sp"),
+                in_specs=(P(), spec),
+                out_specs=spec,
             )
             fn = plan.tracked_jit(
                 f"striped.matmul r{rows}k{self.k} S{self.chunk_bytes}",
@@ -240,13 +341,16 @@ class ShardedPipeline:
             args = (fn, jnp.asarray(
                 np.asarray(mat, np.uint8).astype(np.int32)))
         words = jnp.asarray(gf_pallas.words_from_bytes(data))
-        sharding = NamedSharding(self.mesh, P("dp", None, None, None))
+        sharding = NamedSharding(
+            self.mesh, logical_spec("stripe", "shard", None, None,
+                                    mesh=self.mesh))
         dw = jax.device_put(words, sharding)
         out = np.asarray(args[0](*args[1:], dw))
         return gf_pallas.bytes_from_words(out)
 
     def _jit_words(self, local, runtime_mat: bool = False):
-        spec = P("dp", None, None, None)
+        spec = logical_spec("stripe", "shard", None, None,
+                            mesh=self.mesh)
         in_specs = (P(), spec) if runtime_mat else (spec,)
         kind = "runtime" if runtime_mat else "spec"
         return plan.tracked_jit(

@@ -1,0 +1,20 @@
+"""Violation: raw mesh compiles outside the plan cache — the XLA
+trace is invisible to plan.stats(), the executable binds whatever
+device set existed at build time (a sick chip's mesh is never
+retired), and the dispatch skips the breaker guard."""
+
+import jax
+from jax.experimental.pjit import pjit
+
+from ceph_tpu.ops import gf
+
+
+def build_encode(mesh, in_specs, out_specs):
+    return jax.shard_map(gf._gf2_matmul_bytes_impl, mesh=mesh,  # expect: unplanned-mesh-dispatch
+                         in_specs=in_specs, out_specs=out_specs)
+
+
+def build_encode_pjit(in_shardings, out_shardings):
+    return pjit(gf._gf2_matmul_bytes_impl,  # expect: unplanned-mesh-dispatch
+                in_shardings=in_shardings,
+                out_shardings=out_shardings)

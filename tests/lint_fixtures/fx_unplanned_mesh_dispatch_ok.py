@@ -1,0 +1,24 @@
+"""Clean twin: mesh compiles ride plan.tracked_jit (retraces land in
+plan.stats(), the plan key carries the device-set signature) and any
+raw dispatch body sits under circuit.device_call."""
+
+import jax
+
+from ceph_tpu.common import circuit
+from ceph_tpu.ec import plan
+from ceph_tpu.ops import gf
+
+
+def build_encode(mesh, in_specs, out_specs, label):
+    return plan.tracked_jit(
+        label,
+        jax.shard_map(gf._gf2_matmul_bytes_impl, mesh=mesh,
+                      in_specs=in_specs, out_specs=out_specs))
+
+
+def dispatch(fn, mbits, batch, device_ids):
+    status, out = circuit.device_call(
+        "fused-crc", jax.shard_map(fn, mesh=None, in_specs=(),
+                                   out_specs=()), mbits,
+        batch=len(batch), devices=device_ids)
+    return out if status == "ok" else None

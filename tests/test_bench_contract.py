@@ -22,7 +22,7 @@ import bench  # noqa: E402
 CONTRACT_KEYS = {"metric", "value", "unit", "vs_baseline",
                  "plan_cache", "encode_service", "tier",
                  "device_health", "tail", "load", "durability",
-                 "truncated"}
+                 "mesh", "truncated"}
 
 
 def test_contract_line_despite_hanging_backend(tmp_path):
@@ -96,6 +96,16 @@ def test_contract_line_despite_hanging_backend(tmp_path):
     assert contract["durability"]["points"] >= 20
     assert contract["durability"]["violations"] == 0
     assert contract["durability"]["broken_store_caught"] == 1
+    # the mesh probe ran: the same batch was bit-identical through
+    # the single-device plan, the N-device mesh plan and the host
+    # oracle, and a scripted sick chip SHRANK the mesh (per-device
+    # breaker tripped, survivors re-planned) instead of degrading
+    # the batch to host
+    assert contract["mesh"]["devices"] >= 2
+    assert contract["mesh"]["bitexact"] == 1
+    assert contract["mesh"]["mesh_dispatches"] >= 1
+    assert contract["mesh"]["sick_chip_shrunk"] == 1
+    assert contract["mesh"]["host_fallbacks"] == 0
     assert contract["truncated"] is False
     # details stayed out of stdout (they belong in bench_details.json)
     assert len(stdout_lines) == 1
@@ -145,6 +155,10 @@ def test_budget_truncates_optional_sections(tmp_path):
     # and the skip is recorded
     assert "load" in details["skipped_sections"]
     assert "load_sweep" not in details
+    # the mesh sweep section too (the probe's `mesh` contract key is
+    # pre-contract and still rides, budget permitting)
+    assert "mesh" in details["skipped_sections"]
+    assert "mesh_sweep" not in details
 
 
 def test_watchdog_contract_line_survives_outer_kill(tmp_path):

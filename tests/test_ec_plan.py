@@ -147,9 +147,17 @@ def test_plan_key_stable_across_processes():
     import json
 
     remote = json.loads(r.stdout.strip())
-    assert list(local) == remote
+    assert json.loads(json.dumps(local)) == remote
     # and bucketing is baked into the key: same bucket, same key
     assert plan.plan_key(sig, "encode", 3, 8, 33, 4100) == local
+    # the mesh element is part of the key (a plan compiled for a
+    # device set must miss for any other set), pure ints — stable
+    meshed = plan.plan_key(sig, "encode", 3, 8, 33, 4100,
+                           mesh=(0, 1, 2))
+    assert meshed != local and meshed[7] == (0, 1, 2)
+    # mesh batch bucket rounds to a multiple of the mesh size (whole
+    # stripes per chip): pow2 bucket 64 -> 66 on a 3-chip mesh
+    assert meshed[4] == 66
 
 
 def test_codec_signature_distinguishes_profiles():

@@ -28,6 +28,7 @@ RULES = [
     "trace-numpy",
     "jit-bypass-plan",
     "unguarded-device-dispatch",
+    "unplanned-mesh-dispatch",
     "unhedged-gather",
     "unbounded-latency-buffer",
     "commit-before-durability",
@@ -43,6 +44,7 @@ CONFIG = {"dtype_paths": ("fx_uint8",),
           "plan_paths": ("fx_jit_bypass_plan",),
           "encode_paths": ("fx_sync_encode_in_async",),
           "device_paths": ("fx_unguarded_device_dispatch",),
+          "mesh_paths": ("fx_unplanned_mesh_dispatch",),
           "gather_paths": ("fx_unhedged_gather",),
           "latency_paths": ("fx_unbounded_latency_buffer",),
           "durability_paths": ("fx_commit_before_durability",)}
