@@ -66,6 +66,7 @@ def _emit_contract(value: Optional[float],
                    load: Optional[dict] = None,
                    durability: Optional[dict] = None,
                    mesh: Optional[dict] = None,
+                   trace: Optional[dict] = None,
                    truncated: bool = False) -> None:
     """Print the one-line JSON driver contract, exactly once, before
     any optional extended benches run — a wedged tunnel or a crashed
@@ -83,7 +84,9 @@ def _emit_contract(value: Optional[float],
     the deliberately-broken store caught as a self-test), mesh the
     multi-chip mesh probe (same batch bit-exact through 1-device /
     N-device / host oracle, sick chip shrinks the mesh with zero host
-    fallbacks); truncated flags a budget-shortened run.  Thread-safe:
+    fallbacks), trace the critical-path tracing probe (reducer
+    correctness + spans-on-vs-off overhead at sample rate 0);
+    truncated flags a budget-shortened run.  Thread-safe:
     the deadline watchdog and the bench body may race to emit."""
     global _contract_emitted
     with _contract_lock:
@@ -104,6 +107,7 @@ def _emit_contract(value: Optional[float],
             "load": load,
             "durability": durability,
             "mesh": mesh,
+            "trace": trace,
             "truncated": bool(truncated),
         }), flush=True)
 
@@ -473,6 +477,216 @@ def _hedge_probe() -> Optional[dict]:
     except Exception as e:
         print(f"# hedge probe failed: {e!r}", file=sys.stderr)
         return None
+
+
+def _trace_probe() -> Optional[dict]:
+    """Pre-contract probe of the critical-path tracing layer.  Two
+    halves: (1) the critical-path reducer reconstructs a hand-built
+    span tree correctly — the longest hedged child owns the wait, the
+    cancelled straggler is off the path; (2) the measured op-throughput
+    delta of spans ON (sample rate 0 — the production bulk
+    configuration) vs the CEPH_TPU_TRACE=0 kill switch, driven through
+    a live loopback cluster so the per-op cost is the real pipeline,
+    alternating phases on one cluster with min-of filtering.  Counters
+    land in the contract line's `trace` key; None (with a stderr note)
+    when the probe cannot run."""
+    if _remaining() < 0:
+        print("# trace probe skipped: budget exhausted",
+              file=sys.stderr)
+        return None
+    probe_timeout = float(os.environ.get(
+        "CEPH_TPU_BENCH_TRACE_PROBE_TIMEOUT", "90"))
+    try:
+        import asyncio
+
+        from ceph_tpu.common import tracing
+
+        # -- half 1: reducer sanity on a hand-built tree -------------
+        mk = lambda sid, parent, name, t0, dur, **attrs: {  # noqa: E731
+            "span_id": sid, "parent_id": parent, "name": name,
+            "t0_us": t0, "duration_us": dur,
+            "attrs": attrs or {}}
+        tree = [
+            mk("r", "", "osd_op obj", 0, 10_000),
+            mk("q", "r", "queue.client", 0, 2_000),
+            mk("a", "r", "subread osd.1", 2_000, 7_000),
+            mk("b", "r", "subread osd.2", 2_000, 8_000,
+               cancelled=True),
+        ]
+        cp = tracing.critical_path(tree)
+        st = cp["stages"]
+        cp_ok = int(cp["total_us"] == 10_000
+                    and st.get("queue.client") == 2_000
+                    and st.get("subread") == 7_000
+                    and st.get("osd_op") == 1_000)
+
+        # deterministic span-layer cost: the representative per-op
+        # span shape (root + queue + encode_wait + 3 sub-op children +
+        # reduce + stage histograms), microbenchmarked — the stable
+        # numerator behind the noisier live A/B delta below
+        # Tracer.enabled re-reads CEPH_TPU_TRACE per trace: force it ON
+        # for the microbench (a bench launched with the kill switch
+        # armed would otherwise time NULL_SPAN no-ops and report a
+        # vacuous ~0% overhead_ratio_pct); half 2 below forces the env
+        # per phase and the shared finally restores the caller's value
+        prev = os.environ.get("CEPH_TPU_TRACE")
+        os.environ["CEPH_TPU_TRACE"] = "1"
+        try:
+            tracer = tracing.Tracer("probe", sample_rate=0.0)
+            tracer.record_stages({"warm": 1})  # one-time lazy import
+            n_syn = 2000
+            t0 = time.perf_counter()
+            for _ in range(n_syn):
+                root = tracer.start("osd_op obj")
+                tok = tracing.current_span.set(root)
+                for name in ("queue.client", "encode_wait",
+                             "subread osd.0", "subread osd.1",
+                             "subread osd.2"):
+                    root.child(name).finish()
+                tracing.current_span.reset(tok)
+                tracer.finish(root)
+                tracer.record_stages(
+                    tracing.critical_path_spans(root)["stages"])
+            span_cost_us = (time.perf_counter() - t0) / n_syn * 1e6
+        finally:
+            if prev is None:
+                os.environ.pop("CEPH_TPU_TRACE", None)
+            else:
+                os.environ["CEPH_TPU_TRACE"] = prev
+
+        # -- half 2: overhead of spans-on (rate 0) vs kill switch ----
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tests"))
+        from cluster_helpers import Cluster
+
+        n_ops = 30 if _SMOKE else 80
+        payload = bytes(bytearray(range(256))) * 128  # 32 KiB
+        profile = {"plugin": "ec_jax", "technique": "reed_sol_van",
+                   "k": "2", "m": "1", "crush-failure-domain": "osd"}
+
+        async def run() -> dict:
+            cluster = Cluster(
+                num_osds=3, osds_per_host=3,
+                osd_config={"osd_trace_sample_rate": 0.0})
+            await cluster.start()
+            try:
+                # EC pool: the product data path (encode service,
+                # hedged sub-reads, fused plans) — the op cost the
+                # span layer is amortized against in production
+                await cluster.client.create_ec_pool(
+                    "traceprobe", profile=profile, pg_num=4)
+                io = cluster.client.open_ioctx("traceprobe")
+
+                async def phase() -> float:
+                    t0 = time.perf_counter()
+                    for i in range(n_ops):
+                        await io.write_full(f"o{i % 8}", payload)
+                        await io.read(f"o{i % 8}")
+                    return time.perf_counter() - t0
+
+                await phase()  # warm: placement, plans, stores
+                times = {"on": [], "off": []}
+                for mode in ("off", "on", "off", "on", "off", "on"):
+                    os.environ["CEPH_TPU_TRACE"] = \
+                        "0" if mode == "off" else "1"
+                    times[mode].append(await phase())
+                stages = set()
+                samples = 0
+                for osd in cluster.osds.values():
+                    stages.update(osd.tracer.stage_hist)
+                    samples += osd.tracer.counters["stage_samples"]
+                # min-of-3 per mode: alternating phases on ONE live
+                # cluster, minima filter scheduler/GC hiccups
+                t_on, t_off = min(times["on"]), min(times["off"])
+                op_cost_us = t_off / (2 * n_ops) * 1e6
+                return {
+                    "ops_per_phase": 2 * n_ops,
+                    # live A/B delta (noisy on shared hardware) ...
+                    "overhead_pct": round(
+                        (t_on - t_off) / t_off * 100.0, 2),
+                    # ... and the stable decomposition: span-layer
+                    # cost over the real per-op cost
+                    "op_cost_us": round(op_cost_us, 1),
+                    "overhead_ratio_pct": round(
+                        span_cost_us / op_cost_us * 100.0, 2),
+                    "stages_seen": len(stages),
+                    "stage_samples": samples,
+                }
+            finally:
+                await cluster.stop()
+
+        prev = os.environ.get("CEPH_TPU_TRACE")
+        try:
+            out = asyncio.run(asyncio.wait_for(run(), probe_timeout))
+        finally:
+            if prev is None:
+                os.environ.pop("CEPH_TPU_TRACE", None)
+            else:
+                os.environ["CEPH_TPU_TRACE"] = prev
+        out["span_cost_us"] = round(span_cost_us, 2)
+        out["cp_ok"] = cp_ok
+        return out
+    except Exception as e:
+        print(f"# trace probe failed: {e!r}", file=sys.stderr)
+        return None
+
+
+def bench_trace() -> dict:
+    """Per-stage latency decomposition under load: concurrent mixed
+    R/W clients against a live EC cluster with tracing on, then the
+    OSDs' per-stage critical-path histograms roll up (element-wise
+    LatencyHistogram merge, the loadgen harness's streaming
+    percentiles) into stage p50/p99 self-times — the decomposition
+    ROADMAP items 2-4 are judged by."""
+    import asyncio
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_helpers import Cluster
+
+    n_clients = 4 if _SMOKE else 8
+    ops_each = 16 if _SMOKE else 48
+    osize = 16 << 10
+    profile = {"plugin": "ec_jax", "technique": "reed_sol_van",
+               "k": "2", "m": "2", "crush-failure-domain": "osd"}
+
+    async def run() -> dict:
+        cluster = Cluster(num_osds=5, osds_per_host=5)
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "tracebench", profile=profile, pg_num=8)
+            io = cluster.client.open_ioctx("tracebench")
+
+            async def worker(c: int) -> None:
+                data = b"%d" % c * (osize // 2)
+                for i in range(ops_each):
+                    oid = f"c{c}-o{i % 6}"
+                    await io.write_full(oid, data)
+                    await io.read(oid)
+
+            await asyncio.gather(*(worker(c)
+                                   for c in range(n_clients)))
+            from ceph_tpu.loadgen.stats import LatencyHistogram
+
+            merged: dict = {}
+            for osd in cluster.osds.values():
+                for stage, h in osd.tracer.stage_hist.items():
+                    agg = merged.setdefault(stage, LatencyHistogram())
+                    agg.merge(h)
+            out = {}
+            for stage, h in sorted(merged.items()):
+                p50, p99 = h.percentile(0.5), h.percentile(0.99)
+                out[stage] = {
+                    "count": h.count,
+                    "p50_ms": round(p50 * 1e3, 3) if p50 else 0.0,
+                    "p99_ms": round(p99 * 1e3, 3) if p99 else 0.0,
+                }
+            return {"trace_stage_summary": out}
+        finally:
+            await cluster.stop()
+
+    return asyncio.run(run())
 
 
 def bench_tail() -> dict:
@@ -1600,6 +1814,10 @@ def main() -> None:
     # mesh probe (before the contract): 1-dev/N-dev/host bit-exact,
     # sick chip shrinks the mesh with zero host fallbacks
     mesh_counters = _mesh_probe()
+    # critical-path tracing probe (before the contract): reducer
+    # reconstructs a hand-built tree, spans-on-vs-off overhead at
+    # sample rate 0 through a live loopback cluster
+    trace_counters = _trace_probe()
 
     # the driver contract line, before every optional/extended bench:
     # a wedge below this point can cost detail rows, never the bench
@@ -1611,6 +1829,7 @@ def main() -> None:
                    load=load_counters,
                    durability=durability_counters,
                    mesh=mesh_counters,
+                   trace=trace_counters,
                    truncated=skip_optional)
 
     # decode sweep over 1..m erasures (the reference benchmark sweeps
@@ -1706,6 +1925,18 @@ def main() -> None:
         except Exception as e:
             print(f"# mesh bench failed: {e!r}", file=sys.stderr)
 
+    # per-stage latency decomposition under load: concurrent EC R/W
+    # clients, then the OSDs' critical-path stage histograms roll up
+    # into stage p50/p99 self-times
+    trace_section: dict = {}
+    if skip_optional:
+        skipped_sections.append("trace")
+    else:
+        try:
+            trace_section = bench_trace()
+        except Exception as e:
+            print(f"# trace bench failed: {e!r}", file=sys.stderr)
+
     # degraded-mode section: breakers forced open -> host-path
     # throughput delta (what a wedged accelerator costs while the
     # breaker holds it out of the hot path)
@@ -1774,6 +2005,7 @@ def main() -> None:
         **write_path,
         **tier_section,
         **tail_section,
+        **trace_section,
         **mesh_section,
         **degraded_section,
         **load_section,
@@ -1786,6 +2018,7 @@ def main() -> None:
         "load": load_counters,
         "durability": durability_counters,
         "mesh": mesh_counters,
+        "trace": trace_counters,
         "host_cores": os.cpu_count(),
         "encode_ms_per_batch": t_enc * 1e3,
         "k": k, "m": m, "chunk_bytes": chunk, "batch": batch,

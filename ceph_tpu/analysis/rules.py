@@ -23,6 +23,12 @@ async/daemon safety (the mon/osd/mds/rgw asyncio daemons):
                        (osd/hedge.py) — the fan-out completes at the
                        slowest peer's pace; all-shard write/absence
                        gathers are baselined with justifications
+  span-leak            tracer.start(...) whose span is not finished
+                       in a finally / context manager on every path —
+                       a leaked span never reaches the ring, the
+                       critical-path histograms, or the tail
+                       exemplars; use `async with tracer.span(...)`
+                       (common/tracing.py) or finish in a finally
 
 EC dispatch discipline:
   jit-bypass-plan      direct jax.jit on shape-polymorphic EC entry
@@ -712,6 +718,97 @@ def rule_unhedged_gather(a: Analyzer) -> None:
 
 
 # ---------------------------------------------------------------------
+# span-leak
+# ---------------------------------------------------------------------
+
+
+def _span_finally_names(fi_node: ast.AST) -> Set[str]:
+    """Names referenced anywhere in a try/finally's finalbody within
+    this function: a span passed (or receiver'd) there is finished on
+    every path — `self.tracer.finish(span)`, `span.finish()`, and
+    helper calls like `self._finish_op_span(span, op)` all count."""
+    names: Set[str] = set()
+    for node in walk_scope(fi_node):
+        if isinstance(node, ast.Try) and node.finalbody:
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub.id)
+    return names
+
+
+def rule_span_leak(a: Analyzer) -> None:
+    """`<...>.tracer.start(...)` (or bare `tracer.start(...)`) whose
+    span does not provably finish on every path: the span must either
+    be passed straight into a `.finish(...)` call, or be bound to a
+    name that a try/finally in the same function references.  A leaked
+    span is invisible — it never reaches the dump_traces ring, the
+    critical-path stage histograms, or the tail-exemplar retention —
+    and on an exception path it silently drops the one op most worth
+    explaining.  The idiomatic fix is the context-manager surface:
+    `async with tracer.span(...)` / `tracing.child_span(...)`."""
+    for mod in a.project.modules.values():
+        for fi in mod.functions.values():
+            finally_names: Optional[Set[str]] = None
+            parents: Optional[Dict[ast.AST, ast.AST]] = None
+            for node in walk_scope(fi.node):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "start"):
+                    continue
+                recv = node.func.value
+                if not ((isinstance(recv, ast.Name)
+                         and recv.id == "tracer")
+                        or (isinstance(recv, ast.Attribute)
+                            and recv.attr == "tracer")):
+                    continue
+                if parents is None:
+                    parents = {c: p for p in ast.walk(fi.node)
+                               for c in ast.iter_child_nodes(p)}
+                # walk up: directly consumed by a .finish(...) call?
+                # bound to a name?  (conditional expressions and
+                # boolop fallbacks still resolve to their Assign)
+                cur = node
+                bound: Optional[str] = None
+                safe = False
+                while cur in parents:
+                    up = parents[cur]
+                    if isinstance(up, ast.Call) and \
+                            isinstance(up.func, ast.Attribute) and \
+                            up.func.attr == "finish" and \
+                            cur in up.args:
+                        safe = True  # t.finish(t.start(...))
+                        break
+                    if isinstance(up, ast.Assign) and \
+                            len(up.targets) == 1 and \
+                            isinstance(up.targets[0], ast.Name):
+                        bound = up.targets[0].id
+                        break
+                    if isinstance(up, (ast.stmt, ast.ExceptHandler)):
+                        break
+                    cur = up
+                if safe:
+                    continue
+                if bound is not None:
+                    if finally_names is None:
+                        finally_names = _span_finally_names(fi.node)
+                    if bound in finally_names:
+                        continue
+                a.emit("span-leak", mod, node,
+                       f"span started in `{fi.qualname}` is not"
+                       " finished in a finally/context-manager on"
+                       " every path — an exception (or early return)"
+                       " leaks it out of the trace ring, the stage"
+                       " histograms and the tail exemplars; use"
+                       " `async with tracer.span(...)` /"
+                       " `tracing.child_span(...)`, or finish the"
+                       " bound span in a try/finally",
+                       severity="warning",
+                       symbol=fi.qualname,
+                       scope_line=fi.lineno)
+
+
+# ---------------------------------------------------------------------
 # sync-encode-in-async
 # ---------------------------------------------------------------------
 
@@ -995,6 +1092,7 @@ def default_rules() -> Dict[str, object]:
         "unguarded-device-dispatch": rule_unguarded_device_dispatch,
         "unplanned-mesh-dispatch": rule_unplanned_mesh_dispatch,
         "unhedged-gather": rule_unhedged_gather,
+        "span-leak": rule_span_leak,
         "unbounded-latency-buffer": rule_unbounded_latency_buffer,
         "commit-before-durability": rule_commit_before_durability,
         "async-blocking": rule_async_blocking,

@@ -63,6 +63,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ceph_tpu.common import tracing
+
 __all__ = [
     "CLOSED", "OPEN", "HALF_OPEN", "FAMILIES",
     "CircuitBreaker", "DeviceFault", "InjectedResourceExhausted",
@@ -622,6 +624,7 @@ def device_call(family: str, fn: Callable, *args,
     br = breaker(family)
     if not br.allow():
         br.note_fallback()
+        tracing.event(f"circuit {family} open (host fallback)")
         return "open", None
     # chips whose breaker this call may speak for — when the family
     # itself IS a device:<id> breaker, skip that id (one verdict, not
@@ -637,6 +640,7 @@ def device_call(family: str, fn: Callable, *args,
         _body, timeout if timeout is not None else _default_timeout())
     if not finished:
         br.record_failure(timeout=True)
+        tracing.event(f"circuit {family} watchdog timeout")
         return "timeout", None
     err = box.get("err")
     if err is None:
@@ -651,6 +655,8 @@ def device_call(family: str, fn: Callable, *args,
         return "benign", err
     if is_resource_exhausted(err) and not oom_to_fail:
         br.release_probe()
+        tracing.event(f"circuit {family} oom (batch {batch})")
         return "oom", err
     br.record_failure()
+    tracing.event(f"circuit {family} dispatch failed")
     return "fail", err

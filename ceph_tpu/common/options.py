@@ -270,6 +270,18 @@ def _opts() -> List[Option]:
                min=0.0,
                desc="max in-gate smoothing delay before an over-limit"
                     " op is shed instead"),
+        # -- critical-path tracing (common/tracing.py: stage spans,
+        #    head sampling for ring retention, tail-exemplar trees) ---
+        Option("osd_trace_enable", "bool", True, A,
+               desc="stage-span tracing + critical-path attribution"
+                    " (env kill switch: CEPH_TPU_TRACE=0)",
+               flags=FLAG_STARTUP),
+        Option("osd_trace_sample_rate", "float", 1.0, A,
+               min=0.0, max=1.0,
+               desc="head-sampling probability that a locally-rooted"
+                    " trace is retained in the dump_traces ring —"
+                    " stage histograms and tail exemplars see every"
+                    " op regardless"),
         # -- osd/pg --------------------------------------------------------
         Option("osd_pool_default_size", "uint", 3, B),
         Option("osd_pool_default_min_size", "uint", 0, A),

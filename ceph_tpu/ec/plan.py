@@ -66,7 +66,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ceph_tpu.common import circuit
+from ceph_tpu.common import circuit, tracing
 from ceph_tpu.ec.dispatch import LruCache
 from ceph_tpu.ops import checksum as cks
 from ceph_tpu.ops import gf
@@ -374,6 +374,7 @@ def _note_plan_failure(key: tuple) -> None:
             _quarantine[key] = time.monotonic() + _quarantine_ttl()
             _plan_failures.pop(key, None)
             _counters["quarantines"] += 1
+            tracing.event(f"plan quarantined {_label(key)}")
 
 
 def quarantine_info() -> dict:
@@ -428,6 +429,7 @@ def _guarded(family: str, key: tuple, plan: ExecPlan, args: tuple,
     if status == "oom":
         with _lock:
             _counters["oom_splits"] += 1
+        tracing.event(f"plan oom halving {plan.label}")
         return "oom", None
     if defer_verdict:
         # raw status up: "open" means no dispatch happened (nothing
@@ -437,6 +439,7 @@ def _guarded(family: str, key: tuple, plan: ExecPlan, args: tuple,
         _note_plan_failure(key)
     with _lock:
         _counters["host_fallbacks"] += 1
+    tracing.event(f"plan host fallback {plan.label}")
     return "fail", None
 
 
@@ -603,6 +606,8 @@ def _mesh_dispatch(family: str, key: tuple, plan: ExecPlan,
         circuit.breaker(family).absolve()
         with _lock:
             _counters["mesh_shrinks"] += 1
+        tracing.event(
+            f"mesh shrink: sick device(s) {sick} retired")
         return "shrunk", None
     _note_plan_failure(key)
     return "fail", None

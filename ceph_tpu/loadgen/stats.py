@@ -85,6 +85,24 @@ class LatencyHistogram:
     def mean(self) -> Optional[float]:
         return (self.total / self.count) if self.count else None
 
+    def to_perf_histogram(self) -> Dict[str, object]:
+        """Prometheus-shaped export ({bounds, buckets, count, sum}):
+        the fine log buckets fold per-octave so a stage histogram
+        costs ~28 exposition rows, not ~450.  Bounds are upper edges
+        in SECONDS; the mgr flattener renders cumulative
+        `_bucket{le=...}` rows plus `_count`/`_sum`."""
+        bounds: List[float] = []
+        buckets: List[int] = []
+        i = 1
+        while i < _NBINS:
+            j = min(i + _PER_OCTAVE, _NBINS)
+            bounds.append(round(self._edge(j - 1), 9))
+            buckets.append(self.bins[0] + sum(self.bins[i:j])
+                           if i == 1 else sum(self.bins[i:j]))
+            i = j
+        return {"bounds": bounds, "buckets": buckets,
+                "count": self.count, "sum": round(self.total, 6)}
+
     def to_dict(self) -> Dict[str, float]:
         """Percentile summary in milliseconds (report shape)."""
         out: Dict[str, float] = {"count": self.count}

@@ -108,6 +108,10 @@ class PrometheusModule(MgrModule):
         # levels and the configured bounds are all levels
         "_in_flight", "_queued", "_max_concurrent",
         "_max_queue_depth", "_tokens", "_limit_ops",
+        # tracing leaves: percentile estimates, the sampling knob and
+        # the exemplar-ring occupancy are levels, not monotone counts
+        "_p50_ms", "_p99_ms", "_sample_rate", "_exemplars_held",
+        "_complaint_time_s",
     )
 
     # nested maps that become a LABEL instead of exploding the metric
@@ -121,6 +125,9 @@ class PrometheusModule(MgrModule):
         "tenants": ("tenant", "tenant"),
         # the device-health section's per-chip breaker + mesh rows
         "devices": ("device", "device"),
+        # the trace section's per-stage critical-path self-time rows
+        # (ceph_osd_trace_stage_self_seconds_bucket{stage=...})
+        "stage": ("stage", "stage"),
     }
 
     @classmethod

@@ -549,7 +549,8 @@ class MOSDSubRead(Message):
 
     TAG = 13
 
-    VERSION = 3  # v2 appends want_omap; v3 appends record (hit-set)
+    VERSION = 4  # v2 appends want_omap; v3 appends record (hit-set);
+    #              v4 the blkin-role trace context
     COMPAT = 1
 
     def __init__(self, tid: int, pg: PgId, shard: int, oid: str,
@@ -568,6 +569,8 @@ class MOSDSubRead(Message):
         # replica's hot-set tracking (scrub/recovery/stat probes
         # would drown the skew signal)
         self.record = record
+        # blkin-role trace context: (trace_id, parent span id) or None
+        self.trace: Optional[tuple] = None
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u64(self.tid)
@@ -579,6 +582,8 @@ class MOSDSubRead(Message):
         enc.bool(self.want_attrs)
         enc.bool(self.want_omap)
         enc.bool(self.record)
+        enc.optional(self.trace,
+                     lambda e, v: (e.u64(v[0]), e.u64(v[1])))
 
     @classmethod
     def decode(cls, data: bytes) -> "MOSDSubRead":
@@ -590,6 +595,8 @@ class MOSDSubRead(Message):
             msg.want_omap = dec.bool()
         if struct_v >= 3:
             msg.record = dec.bool()
+        if struct_v >= 4:
+            msg.trace = dec.optional(lambda d: (d.u64(), d.u64()))
         dec.finish()
         return msg
 

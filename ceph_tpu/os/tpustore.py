@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ceph_tpu.common import checksummer as csum_mod
+from ceph_tpu.common import tracing
 from ceph_tpu.common.checksummer import CSUM_NONE, Checksummer
 from ceph_tpu.compressor import Compressor, gate, scoring
 from ceph_tpu.kv import SQLiteDB
@@ -617,7 +618,8 @@ class TPUStore(ObjectStore):
             # purely-deferred txn carries its data IN the KV batch and
             # skips the block fsync entirely (the deferred-write win)
             if self._txc_direct:
-                self._block_sync()
+                with tracing.child_span_sync("fsync"):
+                    self._block_sync()
             # the commit point IS the durability point: once this
             # returns, on_commit fires and the ack must survive a
             # power cut — so the batch goes down SYNC (BlueStore syncs
@@ -625,7 +627,8 @@ class TPUStore(ObjectStore):
             # default only survives process death, and an acked write
             # that vanishes on power loss is the one failure nothing
             # upstack can repair)
-            self._kv.submit_transaction_sync(kvt)
+            with tracing.child_span_sync("kv_commit"):
+                self._kv.submit_transaction_sync(kvt)
             self.perf["kv_commits"] += 1
             # apply deferred in-place writes AFTER the commit point:
             # their durability is the journal entry; the block file

@@ -31,6 +31,8 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ceph_tpu.common import tracing
+
 ADMIT = "admit"
 DELAY = "delay"
 SHED = "shed"
@@ -113,12 +115,19 @@ class AdmissionGate:
         wait = (cost - b[0]) / limit
         if wait <= self.max_delay_s:
             # the delay IS the charge: the refill during the sleep
-            # covers the op
+            # covers the op.  The smoothing sleep is a pipeline stage
+            # an op can visibly spend its time in — span it (no-op
+            # when the op is untraced; an instant ADMIT above costs
+            # no wall time and gets no span)
             b[0] -= cost
             self._count(tenant, DELAY)
-            await asyncio.sleep(wait)
+            async with tracing.child_span("admission",
+                                          tenant=tenant) as sp:
+                sp.set_attr("decision", DELAY)
+                await asyncio.sleep(wait)
             return ADMIT
         self._count(tenant, SHED)
+        tracing.event(f"admission shed tenant={tenant}")
         return SHED
 
     # -- observability -----------------------------------------------------

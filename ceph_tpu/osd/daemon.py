@@ -40,7 +40,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ceph_tpu.crush.map import CRUSH_ITEM_NONE
 from ceph_tpu.ec.registry import create_erasure_code
-from ceph_tpu.common import lockdep
+from ceph_tpu.common import lockdep, tracing
 from ceph_tpu.msg import Connection, Messenger
 from ceph_tpu.msg.messages import (
     MConfig,
@@ -260,6 +260,10 @@ class _ObjLockCtx:
             # release must come off the stack the acquire went onto
             self._ld_task = lockdep.acquire(self._cls)
         self._entry[1] += 1
+        # obj-lock WAIT is a pipeline stage: span only when contended
+        # (an uncontended acquire is a no-op, not time the op spent)
+        lk_span = tracing.start_child("objlock") \
+            if self._entry[0].locked() else tracing.NULL_SPAN
         try:
             await self._entry[0].acquire()
         except BaseException:
@@ -267,7 +271,10 @@ class _ObjLockCtx:
             if lockdep.enabled:
                 lockdep.release(self._cls, getattr(
                     self, "_ld_task", None))
+            lk_span.set_attr("cancelled", True)
+            lk_span.finish()
             raise
+        lk_span.finish()
         return self
 
     async def __aexit__(self, *exc):
@@ -435,9 +442,15 @@ class OSDDaemon:
         self._scrub_task: Optional[asyncio.Task] = None
         self._admin_socket = None
         self.scrub_stats = {"objects": 0, "errors": 0, "repaired": 0}
-        from ceph_tpu.common import tracing
-
-        self.tracer = tracing.Tracer(f"osd.{osd_id}")
+        # stage-span tracing: head-sampled ring retention (the bulk),
+        # tail-based exemplar retention via the op tracker (the ops
+        # worth explaining keep their full tree even at rate 0)
+        self.tracer = tracing.Tracer(
+            f"osd.{osd_id}",
+            sample_rate=float(self.config.get(
+                "osd_trace_sample_rate", 1.0)),
+            enabled=bool(self.config.get("osd_trace_enable", True)))
+        self.encode_service.tracer = self.tracer
 
     @property
     def mon_addr(self) -> str:
@@ -548,6 +561,12 @@ class OSDDaemon:
                     int(cmd["trace_id"], 16)
                     if cmd.get("trace_id") else None)},
                 "blkin-role spans collected on this daemon"),
+            "dump_op_trace": (
+                lambda cmd: self._cmd_dump_op_trace(
+                    cmd.get("trace_id", "")),
+                "render one tail-exemplar op's span tree with"
+                " critical-path stage self-times (no trace_id lists"
+                " the retained exemplars)"),
             "statfs": (
                 lambda cmd: self._cmd_statfs(),
                 "store usage + per-pool object/byte breakdown"),
@@ -607,7 +626,38 @@ class OSDDaemon:
         if callable(pc):
             out["store"] = {k: v for k, v in pc().items()
                             if isinstance(v, (int, float))}
+        # op tracker: lifetime op count, in-flight gauge, slow-op and
+        # tail-exemplar totals
+        out["op_tracker"] = self.op_tracker.perf()
+        # critical-path tracing: per-stage self-time histograms (the
+        # `stage` label map flattens to ceph_osd_trace_stage_* rows)
+        out["trace"] = {
+            "enabled": int(self.tracer.enabled),
+            "sample_rate": self.tracer.sample_rate,
+            **self.tracer.counters,
+            "stage": self.tracer.stage_perf(),
+        }
         return out
+
+    def _cmd_dump_op_trace(self, trace_id: str) -> Dict[str, Any]:
+        """One tail-exemplar op's journey: the span tree, the
+        critical-path stage decomposition, and a rendered text tree
+        (self-time per span) — the operator's answer to 'which stage
+        did this slow op spend its time in'."""
+        if not trace_id:
+            return {"exemplars": self.op_tracker.exemplar_ids()}
+        doc = self.op_tracker.get_trace(trace_id)
+        if doc is None:
+            return {"error": f"no exemplar for trace {trace_id!r}",
+                    "exemplars": self.op_tracker.exemplar_ids()}
+        cp = doc.get("critical_path") or {}
+        rendered = [
+            "{}{} [{}] self={:.3f}ms span={:.3f}ms".format(
+                "  " * e.get("depth", 0), e.get("name", ""),
+                e.get("stage", ""), e.get("self_us", 0) / 1e3,
+                e.get("span_us", 0) / 1e3)
+            for e in cp.get("path", [])]
+        return {**doc, "rendered": rendered}
 
     def _cmd_store_status(self) -> Dict[str, Any]:
         """The operator view of the backing store: what engine, which
@@ -859,6 +909,17 @@ class OSDDaemon:
         unit = codec.get_chunk_size(k * base)
         return ec_util.StripeInfo(k, k * unit)
 
+    async def _traced_subwrite(self, osd: int, msg: Message,
+                               tid: int) -> Optional[Message]:
+        """Per-peer `subwrite osd.N` stage span around the ack wait —
+        the write-side twin of hedge.py's per-peer subread spans, so a
+        slow replica's ack attributes to ITS span instead of opaque
+        osd_op self-time.  child_span installs the span as current, so
+        _request stamps the wire context with the PER-PEER span and
+        the replica's sub_write tree parents under it."""
+        async with tracing.child_span(f"subwrite osd.{osd}", peer=osd):
+            return await self._request(osd, msg, tid)
+
     async def _request(self, osd: int, msg: Message,
                        tid: int) -> Optional[Message]:
         """Send to a peer OSD and await the tid-matched reply; None on
@@ -866,13 +927,17 @@ class OSDDaemon:
         addr = self.osdmap.osd_addrs.get(osd)
         if addr is None:
             return None
-        if isinstance(msg, MOSDSubWrite) and msg.trace is None:
-            # sub-ops fanned out under a traced client op inherit its
-            # span as parent (blkin's "span per sub-op" shape)
-            from ceph_tpu.common import tracing
-
+        if isinstance(msg, (MOSDSubWrite, MOSDSubRead)) and \
+                msg.trace is None:
+            # sub-ops fanned out under a SAMPLED client op inherit its
+            # span as parent (blkin's "span per sub-op" shape); the
+            # hedged sub-read fan-out rides the same tail field
+            # (MOSDSubRead v4).  Unsampled ops do NOT propagate: the
+            # peer would pay span + ring retention for a trace nobody
+            # keeps (tail exemplars are primary-local trees)
             parent = tracing.current_span.get()
-            if parent is not None:
+            if parent is not None and parent.sampled and \
+                    parent.context is not None:
                 msg.trace = parent.context
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._futures[tid] = fut
@@ -1002,6 +1067,14 @@ class OSDDaemon:
                          self.osd_id, name, val)
                 self.config[name] = val
         self._apply_msgr_injection()
+        # sample_rate is deliberately NOT FLAG_STARTUP (options.py): a
+        # central `config set osd osd_trace_sample_rate ...` must reach
+        # the live Tracer, whose copy was taken at construction
+        try:
+            self.tracer.sample_rate = float(self.config.get(
+                "osd_trace_sample_rate", self.tracer.sample_rate))
+        except (TypeError, ValueError):
+            pass
 
     def _apply_msgr_injection(self) -> None:
         """Push ms_inject_* config into the live messenger (the options
@@ -1592,13 +1665,15 @@ class OSDDaemon:
     async def _handle_sub_write(self, conn: Connection,
                                 msg: MOSDSubWrite) -> None:
         if msg.trace is not None:
-            span = self.tracer.start(
-                f"sub_write {msg.oid} shard {msg.shard}",
-                context=msg.trace)
-            try:
+            # tracer.span installs the span as current: the replica-
+            # side stage spans below (kv_commit/fsync in the store,
+            # contended objlock) attach to THIS tree — the place the
+            # write actually pays its durability cost must not render
+            # as an opaque span
+            async with self.tracer.span(
+                    f"sub_write {msg.oid} shard {msg.shard}",
+                    context=msg.trace):
                 await self._handle_sub_write_inner(conn, msg)
-            finally:
-                self.tracer.finish(span)
             return
         await self._handle_sub_write_inner(conn, msg)
 
@@ -1738,6 +1813,19 @@ class OSDDaemon:
 
     async def _handle_sub_read(self, conn: Connection,
                                msg: MOSDSubRead) -> None:
+        if getattr(msg, "trace", None) is not None:
+            # tracer.span installs the span as current so replica-side
+            # annotations (tier recording, store spans) land in this
+            # tree
+            async with self.tracer.span(
+                    f"sub_read {msg.oid} shard {msg.shard}",
+                    context=msg.trace):
+                await self._handle_sub_read_inner(conn, msg)
+            return
+        await self._handle_sub_read_inner(conn, msg)
+
+    async def _handle_sub_read_inner(self, conn: Connection,
+                                     msg: MOSDSubRead) -> None:
         state = self.pgs.get(msg.pg)
         pool = self.osdmap.pools.get(msg.pg.pool) if self.osdmap else None
         if self.tier.enabled and state is not None and \
@@ -3518,25 +3606,55 @@ class OSDDaemon:
         op_id = self.op_tracker.create(
             f"osd_op({msg.client} {msg.pg} {msg.oid!r} "
             f"{[op.op for op in msg.ops]})")
-        span = token = None
-        if msg.trace is not None:
-            # continue the client's trace: this span parents every
-            # sub-op span fanned out below (contextvar propagation)
-            from ceph_tpu.common import tracing
-
-            span = self.tracer.start(
-                f"osd_op {msg.oid} {'+'.join(o.op for o in msg.ops)}",
-                context=msg.trace)
-            token = tracing.current_span.set(span)
+        # EVERY op gets a root span while tracing is enabled (NULL_SPAN
+        # when off): it parents the stage spans fanned out below via
+        # contextvar, continues the client's trace when a wire context
+        # rides in, and feeds the critical-path stage histograms +
+        # tail-exemplar retention at finish.  Head sampling only gates
+        # ring retention, never span existence.
+        span = self.tracer.start(
+            f"osd_op {msg.oid} {'+'.join(o.op for o in msg.ops)}",
+            context=msg.trace)
+        token = tracing.current_span.set(span) if span else None
         try:
             await self._handle_client_op_tracked(conn, msg, op_id)
         finally:
-            self.op_tracker.finish(op_id)
-            if span is not None:
-                from ceph_tpu.common import tracing
-
+            op = self.op_tracker.finish(op_id)
+            if token is not None:
                 tracing.current_span.reset(token)
-                self.tracer.finish(span)
+            self._finish_op_span(span, op)
+
+    def _finish_op_span(self, span, op) -> None:
+        """Close an op's root span and run the critical-path pipeline:
+        per-stage self-times into the streaming histograms, and — for
+        ops in the tail (complaint-time or rolling-p99 breach) — the
+        FULL span tree retained as an exemplar (dump_op_trace /
+        dump_historic_ops)."""
+        if not span:
+            return
+        # finish() returns the rendered tree when sampling already
+        # built one — the tail hook reuses it instead of rendering the
+        # same spans twice
+        tree = self.tracer.finish(span)
+        if op is not None and self.op_tracker.is_tail(op.duration):
+            # the tail pays for its full explanation: rendered tree +
+            # critical path WITH the per-span path
+            if tree is None:
+                tree = span.tree_dicts()
+            cp = tracing.critical_path(tree)
+            self.tracer.record_stages(cp["stages"])
+            self.op_tracker.retain_trace(op, {
+                "trace_id": f"{span.trace_id:016x}",
+                "description": op.description,
+                "duration_ms": round((op.duration or 0.0) * 1e3, 3),
+                "critical_path": cp,
+                "spans": tree,
+            })
+        else:
+            # the bulk pays only the allocation-light reduction: no
+            # dict rendering, stages straight into the histograms
+            cp = tracing.critical_path_spans(span)
+            self.tracer.record_stages(cp["stages"])
 
     async def _handle_client_op_tracked(self, conn: Connection,
                                         msg: MOSDOp,
@@ -3820,7 +3938,7 @@ class OSDDaemon:
                 tid = self._next_tid()
                 self.perf["subwrite_bytes"] += sum(
                     len(op.data) for op in ops)
-                pending.append(self._request(
+                pending.append(self._traced_subwrite(
                     osd, MOSDSubWrite(tid, pg, shard, oid, ops,
                                       admit_epoch, entry,
                                       self.osd_id), tid))
@@ -3928,7 +4046,10 @@ class OSDDaemon:
             except (KeyError, ConnectionError, OSError):
                 pass  # a stale clone is only garbage
         if pending:
-            await asyncio.gather(*pending, return_exceptions=True)
+            # awaited on the client write path (post-ack, pre-return):
+            # a slow peer here must not hide in osd_op self-time
+            async with tracing.child_span("rollback_trim"):
+                await asyncio.gather(*pending, return_exceptions=True)
 
     def _next_entry(self, state: PGState, pool, oid: str, op: str,
                     size: int = 0) -> dict:
@@ -4355,6 +4476,11 @@ class OSDDaemon:
         interval = state.interval_epoch
         installed = False
         span = self.tracer.start(f"tier_promote {pg} {oid}")
+        # install as current: create_task copied the kicking READ's
+        # context, so without this the promotion's queue/objlock stage
+        # spans would parent into the CLIENT op's tree and the
+        # still-running promote would own the op's critical-path tail
+        token = tracing.current_span.set(span if span else None)
         try:
             async def decode_and_install():
                 nonlocal installed
@@ -4393,6 +4519,7 @@ class OSDDaemon:
             log.exception("osd.%d: tier promote %s/%s failed",
                           self.osd_id, pg, oid)
         finally:
+            tracing.current_span.reset(token)
             if not installed:
                 self.tier.end_promote(pg, oid, None)
             self.tracer.finish(span)

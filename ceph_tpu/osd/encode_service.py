@@ -53,6 +53,7 @@ import os
 import time
 from typing import Dict, Iterable, List, Optional
 
+from ceph_tpu.common import tracing
 from ceph_tpu.osd import ec_util
 
 __all__ = ["EncodeService"]
@@ -83,13 +84,17 @@ def _wait_bucket(seconds: float) -> str:
 
 
 class _Req:
-    __slots__ = ("fut", "payload", "nbytes", "t_q")
+    __slots__ = ("fut", "payload", "nbytes", "t_q", "span_ctx")
 
     def __init__(self, fut: asyncio.Future, payload, nbytes: int):
         self.fut = fut
         self.payload = payload
         self.nbytes = nbytes
         self.t_q = time.perf_counter()
+        # the enqueuing op's span context: the batched flush span
+        # LINKS to every op it served (N ops -> 1 device dispatch)
+        span = tracing.current_span.get()
+        self.span_ctx = span.context if span is not None else None
 
 
 class _Bucket:
@@ -145,6 +150,9 @@ class EncodeService:
         self._buckets: Dict[tuple, _Bucket] = {}
         self._tasks: set = set()
         self._closed = False
+        # set by the owning daemon: flush dispatch spans (with links
+        # to the ops each batch served) land in this tracer's ring
+        self.tracer = None
         self._usable_cache: Dict[int, bool] = {}
         self.counters = {"requests": 0, "batched": 0, "inline": 0,
                          "shed": 0, "batches": 0, "dispatch_errors": 0,
@@ -310,7 +318,16 @@ class EncodeService:
             self._flush(q)
         elif q.timer is None:
             q.timer = loop.call_later(self.window_s, self._flush, q)
-        return await req.fut
+        # accumulation wait + shared dispatch, as the op saw it: one
+        # stage span from enqueue to future resolution
+        wait_span = tracing.start_child("encode_wait", kind=q.kind)
+        try:
+            return await req.fut
+        except asyncio.CancelledError:
+            wait_span.set_attr("cancelled", True)
+            raise
+        finally:
+            wait_span.finish()
 
     def _flush(self, q: _Bucket) -> None:
         if q.timer is not None:
@@ -333,14 +350,33 @@ class EncodeService:
             for r in batch:
                 b = _wait_bucket(t0 - r.t_q)
                 wait_hist[b] = wait_hist.get(b, 0) + 1
+            # the batched device dispatch is ONE span serving N ops:
+            # span LINKS carry the attribution (it parents none of
+            # them — their own encode_wait spans cover the wall time)
+            flush_span = self.tracer.start(
+                f"encode_flush {q.label}") if self.tracer is not None \
+                else tracing.NULL_SPAN
+            flush_span.set_attr("requests", len(batch))
+            flush_span.set_attr("bytes", nbytes)
+            for r in batch:
+                flush_span.link(r.span_ctx)
+            token = tracing.current_span.set(flush_span) \
+                if flush_span else None
             try:
-                outs = await asyncio.to_thread(self._run_batch, q,
-                                               [r.payload
-                                                for r in batch])
-            except BaseException as e:
-                self.counters["dispatch_errors"] += 1
-                outs = [e] * len(batch)
-            dt = time.perf_counter() - t0
+                try:
+                    outs = await asyncio.to_thread(
+                        self._run_batch, q,
+                        [r.payload for r in batch])
+                except BaseException as e:
+                    self.counters["dispatch_errors"] += 1
+                    outs = [e] * len(batch)
+                dt = time.perf_counter() - t0
+                flush_span.set_attr("dispatch_ms", round(dt * 1e3, 3))
+            finally:
+                if token is not None:
+                    tracing.current_span.reset(token)
+                if self.tracer is not None:
+                    self.tracer.finish(flush_span)
             self.counters["batches"] += 1
             q.stats["batches"] += 1             # type: ignore[operator]
             q.stats["dispatch_seconds"] += dt   # type: ignore[operator]

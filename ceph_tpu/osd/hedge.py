@@ -48,6 +48,7 @@ from typing import (
     Any, Awaitable, Callable, Dict, List, Optional, Sequence, Tuple,
 )
 
+from ceph_tpu.common import tracing
 from ceph_tpu.common.circuit import CLOSED, CircuitBreaker
 
 log = logging.getLogger("osd.hedge")
@@ -169,18 +170,40 @@ class PeerStats:
         }
 
 
+async def _traced_job(factory, span):
+    """Run one sub-read job under its per-peer span (installed as the
+    task's current span, so the wire context a _request stamps onto
+    MOSDSubRead parents the replica's span to THIS sub-read, not to
+    the whole op): cancellation (a straggler cut loose) is annotated
+    so the critical-path reducer keeps the span off the path — the op
+    never waited for it."""
+    token = tracing.current_span.set(span) if span else None
+    try:
+        return await factory()
+    except asyncio.CancelledError:
+        span.set_attr("cancelled", True)
+        span.event("cancelled straggler")
+        raise
+    finally:
+        if token is not None:
+            tracing.current_span.reset(token)
+        span.finish()
+
+
 class _Flight:
     """One in-flight hedgeable sub-read task's bookkeeping."""
 
-    __slots__ = ("peer", "t0", "deadline", "is_hedge", "hedge_fired")
+    __slots__ = ("peer", "t0", "deadline", "is_hedge", "hedge_fired",
+                 "span")
 
     def __init__(self, peer: int, t0: float, deadline: float,
-                 is_hedge: bool):
+                 is_hedge: bool, span=tracing.NULL_SPAN):
         self.peer = peer
         self.t0 = t0
         self.deadline = deadline
         self.is_hedge = is_hedge
         self.hedge_fired = False
+        self.span = span
 
 
 class HedgeTracker:
@@ -300,7 +323,9 @@ class HedgeTracker:
                   and sufficient is not None and len(jobs) > need)
         if not hedged:
             tasks = [loop.create_task(
-                factory(), name=f"hedge:{self.who}:all:{peer}")
+                _traced_job(factory,
+                            tracing.start_child(f"subread osd.{peer}")),
+                name=f"hedge:{self.who}:all:{peer}")
                 for peer, factory in jobs]
             try:
                 results = await asyncio.gather(*tasks)
@@ -325,13 +350,18 @@ class HedgeTracker:
                 return None
             peer, factory = order[next_i]
             next_i += 1
+            span = tracing.start_child(f"subread osd.{peer}",
+                                       hedge=is_hedge)
             task = loop.create_task(
-                factory(), name=f"hedge:{self.who}:{peer}:{next_i}")
+                _traced_job(factory, span),
+                name=f"hedge:{self.who}:{peer}:{next_i}")
             now = loop.time()
             flights[task] = _Flight(
-                peer, now, now + self.hedge_delay_s(peer), is_hedge)
+                peer, now, now + self.hedge_delay_s(peer), is_hedge,
+                span=span)
             if is_hedge:
                 self.counters["hedges_fired"] += 1
+                tracing.event(f"hedge fired -> osd.{peer}")
             return task
 
         for _ in range(min(len(order), need + self.effective_delta())):
@@ -386,9 +416,12 @@ class HedgeTracker:
                         # transport fault or no candidates from that
                         # shard: recruit a spare now instead of
                         # waiting for a hedge timer
+                        fl.span.set_attr("failed", True)
                         launch(False)
                     elif fl.is_hedge:
                         self.counters["hedge_wins"] += 1
+                        fl.span.set_attr("hedge_win", True)
+                        tracing.event(f"hedge win osd.{fl.peer}")
                 if sufficient(results):
                     if flights or next_i < len(order):
                         self.counters["early_completions"] += 1

@@ -39,6 +39,8 @@ import asyncio
 import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
+from ceph_tpu.common import tracing
+
 CLIENT = "client"
 RECOVERY = "background_recovery"
 SCRUB = "background_scrub"
@@ -67,6 +69,13 @@ def tenant_class(tenant: str) -> str:
     """Scheduler class for a tenant's client ops ('' = the shared
     default class)."""
     return f"{TENANT_PREFIX}{tenant}" if tenant else CLIENT
+
+
+def stage_class(op_class: str) -> str:
+    """Trace-stage key for a scheduler class: per-tenant classes fold
+    into the shared `client` stage (a million tenants must not mint a
+    million stage histograms)."""
+    return CLIENT if op_class.startswith(TENANT_PREFIX) else op_class
 
 
 class QueueFull(RuntimeError):
@@ -146,33 +155,48 @@ class OpSchedulerBase:
             # queued future would park the caller forever
             raise RuntimeError("scheduler stopped")
         self.start()
-        while len(self._queues.get(op_class, ())) >= \
-                self.max_queue_depth:
-            if self.overflow == "shed":
-                self.shed[op_class] = self.shed.get(op_class, 0) + 1
-                raise QueueFull(op_class,
-                                len(self._queues[op_class]))
-            # block: wait for the class to drain below the bound
-            self._drained.clear()
-            await self._drained.wait()
-            if self._stopping:
-                raise RuntimeError("scheduler stopped")
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        item = _Item(max(cost, 1.0), fn, fut)
-        self._enqueue(op_class, item)
-        self._wake.set()
+        # queue WAIT is a pipeline stage: per-mClock-class span
+        # covering the bounded-queue BLOCK wait and the enqueue-to-
+        # grant wait — under saturation the block wait IS the queueing
+        # delay, and it must attribute here, not to the op's self-time
+        # (tenant classes fold into `queue.client` so stage names stay
+        # bounded; the exact class rides as an attr)
+        q_span = tracing.start_child(
+            f"queue.{stage_class(op_class)}", cls=op_class)
         try:
-            await fut  # grant
-        except asyncio.CancelledError:
-            # cancelled AFTER the grant landed: the slot was consumed
-            # and fn never ran — release it or the leak eventually
-            # deadlocks every class (cancelled-before-grant is handled
-            # by the grant loop when it pops the done future, and its
-            # tag charge is refunded there)
-            if fut.done() and not fut.cancelled():
-                self._in_flight -= 1
-                self._wake.set()
-            raise
+            while len(self._queues.get(op_class, ())) >= \
+                    self.max_queue_depth:
+                if self.overflow == "shed":
+                    self.shed[op_class] = \
+                        self.shed.get(op_class, 0) + 1
+                    q_span.set_attr("shed", True)
+                    raise QueueFull(op_class,
+                                    len(self._queues[op_class]))
+                # block: wait for the class to drain below the bound
+                self._drained.clear()
+                await self._drained.wait()
+                if self._stopping:
+                    raise RuntimeError("scheduler stopped")
+            fut: asyncio.Future = \
+                asyncio.get_running_loop().create_future()
+            item = _Item(max(cost, 1.0), fn, fut)
+            self._enqueue(op_class, item)
+            self._wake.set()
+            try:
+                await fut  # grant
+            except asyncio.CancelledError:
+                # cancelled AFTER the grant landed: the slot was
+                # consumed and fn never ran — release it or the leak
+                # eventually deadlocks every class (cancelled-before-
+                # grant is handled by the grant loop when it pops the
+                # done future, and its tag charge is refunded there)
+                if fut.done() and not fut.cancelled():
+                    self._in_flight -= 1
+                    self._wake.set()
+                q_span.set_attr("cancelled", True)
+                raise
+        finally:
+            q_span.finish()
         try:
             return await fn()
         finally:

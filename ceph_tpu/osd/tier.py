@@ -33,6 +33,7 @@ import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ceph_tpu.common import tracing
 from ceph_tpu.common.perf_counters import PerfCounters
 from ceph_tpu.osd import hitset as hitset_mod
 
@@ -185,9 +186,13 @@ class TierAgent:
         entry = self.cache.get(key)
         if entry is None:
             self.perf.inc("miss")
+            # annotate the op's span (no-op untraced): a tier miss
+            # means the read pays the cold decode path below
+            tracing.event("tier miss")
             return None
         self.cache.move_to_end(key)
         self.perf.inc("hit")
+        tracing.event("tier hit")
         return entry["data"]
 
     def wants_promote(self, pg, oid: str, hit_count: int) -> bool:

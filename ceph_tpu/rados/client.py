@@ -593,14 +593,30 @@ class IoCtx:
         # discipline (PrimaryLogPG check_in_progress_op)
         tid = client._next_tid()
         span = None
+        owned = False  # root span in this client's tracer ring
         if client.trace_all:
             span = client.tracer.start(
                 f"{'+'.join(op.op for op in ops)} {oid}")
+            owned = True
+        else:
+            # ambient trace (an S3 frontend's ingress span, or any
+            # caller running under tracing.current_span): the rados
+            # submit becomes a child stage in THAT tree, and the op
+            # carries its context to the OSDs
+            from ceph_tpu.common import tracing
+
+            parent = tracing.current_span.get()
+            if parent is not None and parent:
+                span = parent.child(
+                    f"rados {'+'.join(op.op for op in ops)} {oid}")
         try:
             return await self._submit_traced(oid, ops, tid, span)
         finally:
             if span is not None:
-                client.tracer.finish(span)
+                if owned:
+                    client.tracer.finish(span)
+                else:
+                    span.finish()
 
     async def _submit_traced(self, oid: str, ops: List[OSDOp],
                              tid: int, span) -> MOSDOpReply:
@@ -630,7 +646,13 @@ class IoCtx:
                              tenant=self.tenant
                              or CURRENT_TENANT.get())
                 if span is not None:
-                    msg.trace = span.context
+                    # propagation follows the sampling decision: an
+                    # unsampled ambient trace (gateway sampling off)
+                    # must leave the OSD to its own
+                    # osd_trace_sample_rate instead of forcing the
+                    # whole downstream tree retained
+                    if span.sampled:
+                        msg.trace = span.context
                     span.event(f"sent to osd.{primary}"
                                + (f" (retry {attempt})" if attempt
                                   else ""))

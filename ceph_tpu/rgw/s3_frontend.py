@@ -25,6 +25,7 @@ import datetime
 import hashlib
 import hmac
 import logging
+import os
 import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import Dict, List, Optional, Tuple
@@ -155,6 +156,29 @@ class S3Frontend:
         self.anonymous_ok = anonymous_ok
         self._server: Optional[asyncio.base_events.Server] = None
         self.addr = ""
+        # ingress tracing: every request opens a root span installed
+        # as the task's current span, so the gateway's rados submits
+        # (and through them the OSD op + sub-op spans) parent into ONE
+        # tree spanning s3 -> rados -> osd -> device dispatch.  The
+        # gateway's head-sampling knob (CEPH_TPU_RGW_TRACE_SAMPLE,
+        # default keep-everything) is what gates S3-origin retention:
+        # a SAMPLED ingress root forces the whole downstream tree
+        # sampled (wire contexts inherit the sender's decision), so an
+        # operator turning bulk retention off must turn it off HERE —
+        # an unsampled ingress leaves the OSDs to their own
+        # osd_trace_sample_rate
+        from ceph_tpu.common import tracing
+
+        try:
+            rate = float(os.environ.get(
+                "CEPH_TPU_RGW_TRACE_SAMPLE", "1.0"))
+        except ValueError:
+            rate = 1.0
+        # the gateway has no admin socket: `frontend.tracer.dump()` is
+        # the embedded dump surface, so the retention ring stays small
+        # — sampled trees are kept for the last-N-requests view only
+        self.tracer = tracing.Tracer("rgw", sample_rate=rate,
+                                     max_spans=256)
 
     async def start(self, host: str = "127.0.0.1", port: int = 0,
                     gc_interval: float = 30.0) -> str:
@@ -224,8 +248,12 @@ class S3Frontend:
                         return
                 body = await reader.readexactly(length) if length else b""
                 keep = headers.get("connection", "").lower() != "close"
-                status, rhdrs, rbody = await self._handle(
-                    method.upper(), target, headers, body)
+                async with self.tracer.span(
+                        f"s3.{method.upper()}"
+                        f" {target.partition('?')[0]}") as ingress:
+                    status, rhdrs, rbody = await self._handle(
+                        method.upper(), target, headers, body)
+                    ingress.set_attr("status", status)
                 reason = {200: "OK", 204: "No Content",
                           206: "Partial Content", 400: "Bad Request",
                           403: "Forbidden", 404: "Not Found",

@@ -1,0 +1,19 @@
+"""Violation: spans started outside a finally / context manager leak
+on the exception path — the op most worth explaining (the one that
+raised, or returned early) never reaches the trace ring, the
+critical-path stage histograms, or the tail exemplars."""
+
+
+class Daemon:
+    async def handle_op(self, msg):
+        span = self.tracer.start(f"osd_op {msg.oid}")  # expect: span-leak
+        result = await self.execute(msg)
+        span.finish()              # skipped whenever execute() raises
+        return result
+
+    async def fire_and_forget(self, msg):
+        self.tracer.start(f"osd_op {msg.oid}")  # expect: span-leak
+        return await self.execute(msg)
+
+    async def execute(self, msg):
+        return None

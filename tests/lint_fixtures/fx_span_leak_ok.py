@@ -1,0 +1,30 @@
+"""Clean twin: every span finishes on every path — the context-manager
+surface (tracer.span / tracing.child_span), a try/finally around the
+bound span, or the inline finish(start(...)) shape."""
+
+
+class Daemon:
+    async def handle_op(self, msg):
+        async with self.tracer.span(f"osd_op {msg.oid}") as span:
+            span.event("started")
+            return await self.execute(msg)
+
+    async def handle_sub_op(self, msg):
+        span = self.tracer.start(f"sub_write {msg.oid}")
+        try:
+            return await self.execute(msg)
+        finally:
+            self.tracer.finish(span)
+
+    async def handle_via_helper(self, msg):
+        span = self.tracer.start(f"osd_op {msg.oid}")
+        try:
+            return await self.execute(msg)
+        finally:
+            self._finish_op_span(span, None)
+
+    def mark_once(self, tracer):
+        tracer.finish(tracer.start("probe"))
+
+    async def execute(self, msg):
+        return None

@@ -30,6 +30,7 @@ RULES = [
     "unguarded-device-dispatch",
     "unplanned-mesh-dispatch",
     "unhedged-gather",
+    "span-leak",
     "unbounded-latency-buffer",
     "commit-before-durability",
     "async-blocking",

@@ -59,6 +59,81 @@ def test_op_tracker_lifecycle_and_slow():
     assert t.dump_historic()["num_ops"] == 2
 
 
+def test_op_tracker_lock_consistency_and_perf():
+    """Satellite regression: mark/check_slow mutate per-op state under
+    the tracker lock, so an admin-socket thread dumping concurrently
+    never observes a half-updated event list or double-counts slow
+    ops; perf() carries the lifetime op count + in-flight gauge."""
+    import threading
+
+    t = OpTracker(history_size=8, complaint_time=0.0, who="osd.7")
+    ids = [t.create(f"op-{i}") for i in range(4)]
+    stop = threading.Event()
+    errors = []
+
+    def dumper():
+        while not stop.is_set():
+            try:
+                t.dump_in_flight()
+                t.dump_historic()
+                t.check_slow()
+                t.perf()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+    threads = [threading.Thread(target=dumper) for _ in range(3)]
+    for th in threads:
+        th.start()
+    try:
+        for _ in range(300):
+            for op_id in ids:
+                t.mark(op_id, "event")
+        t.check_slow()
+        for op_id in ids:
+            t.finish(op_id)
+    finally:
+        stop.set()
+        for th in threads:
+            th.join()
+    assert not errors, errors
+    # warn once per op, however many racing check_slow calls ran
+    assert t.slow_ops == 4
+    p = t.perf()
+    assert p["ops_total"] == 4
+    assert p["ops_in_flight"] == 0
+    assert p["slow_ops"] == 4
+
+
+def test_op_tracker_tail_policy_and_exemplars():
+    """is_tail: complaint-time breach always retains; the rolling p99
+    engages only past the warmup; the exemplar ring is bounded and
+    served by trace id."""
+    t = OpTracker(history_size=4, complaint_time=1.0, who="osd.8")
+    assert t.is_tail(2.0)              # complaint breach
+    assert not t.is_tail(0.5)          # too few samples for p99
+    for _ in range(200):
+        op = t.finish(t.create("fast"))
+        assert op is not None and op.duration is not None
+    assert t.is_tail(0.9)              # >> rolling p99 of ~instant ops
+    op = t.finish(t.create("slow"))
+    doc = {"trace_id": "aa" * 8, "critical_path":
+           {"stages": {"subread": 123}, "path": []}, "spans": []}
+    t.retain_trace(op, doc)
+    assert t.get_trace("aa" * 8) is doc
+    assert ("aa" * 8) in t.exemplar_ids()
+    hist = t.dump_historic()
+    assert any(o.get("trace_id") == "aa" * 8
+               and o.get("stages_us") == {"subread": 123}
+               for o in hist["ops"])
+    # ring bound
+    from ceph_tpu.osd.op_tracker import EXEMPLAR_CAP
+    for i in range(EXEMPLAR_CAP + 5):
+        o = t.finish(t.create("x"))
+        t.retain_trace(o, {"trace_id": f"{i:032x}",
+                           "critical_path": {}, "spans": []})
+    assert len(t.exemplar_ids()) == EXEMPLAR_CAP
+
+
 # -- scrub cluster tier ----------------------------------------------------
 
 
