@@ -7,7 +7,13 @@ uint8 overflow in the GF(2^8) paths, jit recompilation hazards, bare
 numpy on traced arrays, direct jax.jit in the EC dispatch layers
 bypassing the ExecPlan cache (ec/plan.py), event-loop-blocking calls
 inside the asyncio daemons, static lock-order cycles (the lint-time
-twin of common/lockdep.py), and un-awaited asyncio.Lock acquisition.
+twin of common/lockdep.py), and un-awaited asyncio.Lock acquisition —
+plus, on the interprocedural callgraph.py layer (module-resolved call
+graph + async-context map), await-atomicity windows, cancellation-
+unsafe acquires, transitive blocking calls, the hot-path-copy
+zero-copy worklist, and stale-suppression hygiene; rules_async.py
+holds those rules and analysis/interleave.py their runtime twin (the
+deterministic-interleaving explorer, CEPH_TPU_INTERLEAVE=1).
 
 Run as a gate:  python -m ceph_tpu.analysis [paths]   (exit 0/1)
 Run in tests:   tests/test_static_analysis.py (tier-1)

@@ -1,7 +1,17 @@
 """CLI gate: `python -m ceph_tpu.analysis [paths ...]`.
 
-Exit 0 when every finding is baselined or suppressed, 1 when any new
-finding survives, 2 on usage errors — usable verbatim as a CI step.
+Exit 0 when every GATING finding (severity error/warning) is baselined
+or suppressed, 1 when any new one survives, 2 on usage errors — usable
+verbatim as a CI step.  "info" findings are advisory worklists
+(hot-path-copy): they never gate and are surfaced separately via
+`--hot-path-report`.
+
+Warm runs replay the incremental cache (.lint_cache.json, keyed by
+per-module sha256 — see cache.py) so the interprocedural pass costs
+hash time, not parse+fixpoint time; `--no-cache` forces a full pass.
+
+`--format=json` emits machine-readable records
+(file/line/col/rule/fingerprint/severity/message) for CI annotation.
 """
 
 from __future__ import annotations
@@ -16,10 +26,21 @@ from ceph_tpu.analysis import (
     Baseline, analyze_paths, default_baseline_path, default_rules,
     load_baseline, write_baseline,
 )
+from ceph_tpu.analysis import cache as lint_cache
+from ceph_tpu.analysis.core import iter_py_files
+from ceph_tpu.analysis.findings import Finding, gating
 
 
 def _default_paths() -> List[str]:
     return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def _emit(findings: List[Finding], fmt: str) -> None:
+    if fmt == "json":
+        print(json.dumps([f.as_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
 
 
 def main(argv: List[str] = None) -> int:
@@ -41,9 +62,21 @@ def main(argv: List[str] = None) -> int:
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule subset")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text", dest="fmt",
+                    help="finding output format (json: one record per "
+                         "finding for CI annotation)")
     ap.add_argument("--json", action="store_true",
-                    help="machine-readable findings on stdout")
+                    help="alias for --format=json")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and don't write .lint_cache.json")
+    ap.add_argument("--hot-path-report", action="store_true",
+                    help="print the hot-path-copy worklist (ROADMAP "
+                         "item 2's zero-copy targets) instead of "
+                         "gating; always exits 0")
     args = ap.parse_args(argv)
+    if args.json:
+        args.fmt = "json"
 
     if args.list_rules:
         for name in default_rules():
@@ -65,7 +98,39 @@ def main(argv: List[str] = None) -> int:
             print(f"no such path: {p}", file=sys.stderr)
             return 2
 
-    findings, _ = analyze_paths(paths, rules=rules)
+    rule_names = sorted(rules if rules is not None else default_rules())
+    cache_path = lint_cache.default_cache_path()
+    findings = None
+    # the cache serves the CI-gate invocation (whole package, all
+    # rules — the expensive one); explicit path or rule subsets are
+    # small and would evict the warm whole-tree entry (one cache key,
+    # one file set, one rule set)
+    use_cache = (not args.no_cache and not args.paths
+                 and not args.rules)
+    if use_cache:
+        hashes = lint_cache.scan_hashes(iter_py_files(paths))
+        findings, changed = lint_cache.load(
+            cache_path, hashes, rule_names)
+        if findings is not None:
+            print(f"cache hit: {len(hashes)} unchanged module(s)",
+                  file=sys.stderr)
+        elif changed:
+            print(f"cache miss: {len(changed)} changed module(s), "
+                  f"e.g. {os.path.basename(changed[0])}",
+                  file=sys.stderr)
+    if findings is None:
+        findings, _ = analyze_paths(paths, rules=rules)
+        if use_cache:
+            lint_cache.save(cache_path, hashes, rule_names, findings)
+
+    gate = gating(findings)
+    worklist = [f for f in findings if f.severity == "info"]
+
+    if args.hot_path_report:
+        _emit(worklist, args.fmt)
+        print(f"{len(worklist)} hot-path copy site(s) — ROADMAP item "
+              "2 zero-copy worklist", file=sys.stderr)
+        return 0
 
     baseline_path = args.baseline or default_baseline_path()
     baseline = Baseline()
@@ -77,21 +142,19 @@ def main(argv: List[str] = None) -> int:
         out = args.baseline or baseline_path or os.path.join(
             "tools", "lint_baseline.json")
         os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
-        write_baseline(out, findings, old=baseline)
-        print(f"wrote {len(findings)} finding(s) to {out}",
+        # info findings are worklists, never baseline entries
+        write_baseline(out, gate, old=baseline)
+        print(f"wrote {len(gate)} finding(s) to {out}",
               file=sys.stderr)
         return 0
 
-    new = [f for f in findings if f not in baseline]
-    suppressed = len(findings) - len(new)
+    new = [f for f in gate if f not in baseline]
+    suppressed = len(gate) - len(new)
 
-    if args.json:
-        print(json.dumps([f.as_dict() for f in new], indent=2))
-    else:
-        for f in new:
-            print(f.render())
-    stale = baseline.stale(findings)
-    summary = (f"{len(new)} finding(s), {suppressed} baselined"
+    _emit(new, args.fmt)
+    stale = baseline.stale(gate)
+    summary = (f"{len(new)} finding(s), {suppressed} baselined, "
+               f"{len(worklist)} advisory"
                + (f", {len(stale)} stale baseline entr"
                   f"{'y' if len(stale) == 1 else 'ies'}"
                   if stale else ""))

@@ -1,0 +1,121 @@
+"""Incremental analysis cache for the CLI gate (.lint_cache.json).
+
+The interprocedural pass (callgraph + async-context + traced-set
+fixpoint) costs whole seconds on the ~55-module tree; CI and the
+pre-commit habit both run `python -m ceph_tpu.analysis` on trees that
+usually haven't changed since the last run.  The cache keys every
+scanned module by its file sha256 — plus the analyzer's OWN sources,
+so editing a rule invalidates results the old rule produced — and
+replays the stored findings when *everything* matches.
+
+Scope is deliberately all-or-nothing: the new rules are
+interprocedural, so a one-line edit in a helper module can create or
+retire a finding in a caller three modules away (that is the entire
+point of transitive-blocking-call).  Reusing per-module results across
+an edit would need the reverse dependency closure of the call graph;
+replaying only bit-identical trees needs nothing but hashes and is
+always sound.  The per-module sha map still earns its keep: a miss
+report names exactly which files moved.
+
+Cache hygiene: the file is advisory and self-invalidating — delete it
+freely, never check it in (.gitignore'd), `--no-cache` bypasses it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ceph_tpu.analysis.findings import Finding
+
+CACHE_VERSION = 1
+CACHE_BASENAME = ".lint_cache.json"
+
+_ANALYSIS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def default_cache_path() -> str:
+    """<repo root>/.lint_cache.json (next to tools/), falling back to
+    the working directory for out-of-repo runs."""
+    pkg_parent = os.path.dirname(os.path.dirname(_ANALYSIS_DIR))
+    root = pkg_parent if os.path.isdir(
+        os.path.join(pkg_parent, "tools")) else os.getcwd()
+    return os.path.join(root, CACHE_BASENAME)
+
+
+def file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for block in iter(lambda: fh.read(1 << 16), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _analyzer_sha() -> str:
+    """One hash over the analysis package's own sources: a rule edit
+    must never replay findings the previous rule computed."""
+    h = hashlib.sha256()
+    for fn in sorted(os.listdir(_ANALYSIS_DIR)):
+        if fn.endswith(".py"):
+            h.update(fn.encode())
+            h.update(file_sha256(os.path.join(_ANALYSIS_DIR, fn))
+                     .encode())
+    return h.hexdigest()
+
+
+def scan_hashes(files: Iterable[str]) -> Dict[str, str]:
+    """abspath -> sha256 for every scanned module (sorted for a
+    stable on-disk representation)."""
+    return {os.path.abspath(p): file_sha256(p) for p in sorted(files)}
+
+
+def load(path: str, files: Dict[str, str],
+         rule_names: Iterable[str]
+         ) -> Tuple[Optional[List[Finding]], List[str]]:
+    """(replayed findings, changed files) — findings is None on any
+    miss, with `changed` naming the modules whose hash moved (empty
+    when the miss is structural: version, rule set, file set)."""
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return None, []
+    if data.get("version") != CACHE_VERSION or \
+            data.get("analyzer") != _analyzer_sha() or \
+            data.get("rules") != sorted(rule_names):
+        return None, []
+    cached_files = data.get("files", {})
+    if set(cached_files) != set(files):
+        return None, []
+    changed = [p for p, sha in files.items()
+               if cached_files.get(p) != sha]
+    if changed:
+        return None, sorted(changed)
+    findings = [Finding(**rec) for rec in data.get("findings", [])]
+    return findings, []
+
+
+def save(path: str, files: Dict[str, str],
+         rule_names: Iterable[str],
+         findings: List[Finding]) -> None:
+    data = {
+        "version": CACHE_VERSION,
+        "analyzer": _analyzer_sha(),
+        "rules": sorted(rule_names),
+        "files": dict(sorted(files.items())),
+        "findings": [f.as_dict() for f in findings],
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(data, fh, indent=1)
+            fh.write("\n")
+        os.replace(tmp, path)
+    except OSError:
+        # a read-only checkout must not break the gate
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass

@@ -1,0 +1,581 @@
+"""Interprocedural layer: whole-program call graph + async-context map.
+
+PR-1's rules were per-function AST walks; every hard concurrency bug
+PRs 3-10 fixed lived BETWEEN functions — a suspension point reached
+through a helper, a lock scope whose protection a callee assumed, a
+blocking syscall three frames below an `async def`.  This module adds
+the whole-program facts those rules need:
+
+  CallGraph          module-resolved edges (import table + `self.`
+                     method binding + unique-method fallback, the
+                     lockgraph.py resolution discipline), with
+                     memoized transitive *blocking* summaries: the
+                     helper-chain proof that a sync file/socket/sleep
+                     call is reachable from a given function.
+
+  async context      per-function map of every SUSPENSION POINT
+                     (`await`, `async with` enter, `async for` step):
+                     which lockdep-classified lock scopes lexically
+                     enclose it, whether a try/finally covers it, and
+                     whether it rides `asyncio.shield`.  This is the
+                     static twin of what the interleave explorer
+                     (analysis/interleave.py) observes at runtime —
+                     `await_site_map()` is the universe the
+                     runtime⊆static cross-check tests against.
+
+  atomicity windows  read-modify-write of `self.` state whose read and
+                     write straddle a suspension point: the PR-3 bug
+                     class, exported with protection verdicts so the
+                     runtime explorer can falsify a "protected by lock
+                     X" claim it drives through unlocked.
+
+Everything is pure AST — nothing is imported or executed.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ceph_tpu.analysis.core import (
+    FunctionInfo, Project, dotted,
+)
+from ceph_tpu.analysis.lockgraph import classify_lock
+
+__all__ = [
+    "CallGraph", "FunctionAsyncContext", "SuspensionPoint",
+    "AtomicityWindow", "async_context", "atomicity_windows",
+    "function_atomicity_windows", "await_site_map",
+    "walk_scope_ordered",
+]
+
+_SCOPE_BOUNDARIES = (ast.FunctionDef, ast.AsyncFunctionDef,
+                     ast.ClassDef, ast.Lambda)
+
+
+def walk_scope_ordered(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk one function scope in SOURCE order (depth-first, children
+    after parents), stopping at nested def/class boundaries.  Source
+    order matters here: the atomicity and cancellation rules reason
+    about what happens *between* two statements."""
+    stack = list(reversed(list(ast.iter_child_nodes(root))))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, _SCOPE_BOUNDARIES):
+            stack.extend(
+                reversed(list(ast.iter_child_nodes(node))))
+
+
+# ---------------------------------------------------------------------
+# call graph
+# ---------------------------------------------------------------------
+
+
+class CallGraph:
+    """Resolved call edges over a Project, plus transitive blocking
+    summaries.
+
+    Resolution mirrors lockgraph.LockGraphBuilder: the import table
+    first, then `self.method` bound through the enclosing class, then
+    nested `<locals>` defs, and finally the unique-method fallback
+    (a method name with exactly ONE project-wide definition binds to
+    it).  The conservative direction is deliberate: for blocking
+    reachability a spurious edge is noise to triage once, a missed
+    edge is a daemon stall no test reproduces.
+    """
+
+    def __init__(self, project: Project,
+                 blocking_exempt: Tuple[str, ...] = ()):
+        self.project = project
+        #: callees treated as NON-blocking by blocking_chain — the
+        #: memoized one-shot inits (native.get_lib) prewarmed off-loop
+        #: at msgr bind/connect, where the steady-state call is a dict
+        #: read.  Entries with a "." match the module-qualified name
+        #: ("ceph_tpu.native.get_lib"), bare entries match any function
+        #: of that name project-wide (test/config convenience)
+        self.blocking_exempt = frozenset(blocking_exempt)
+        self._unique_methods: Dict[str, Optional[FunctionInfo]] = {}
+        for m in project.modules.values():
+            for f in m.functions.values():
+                if f.parent_class is None:
+                    continue
+                self._unique_methods[f.name] = (
+                    f if f.name not in self._unique_methods else None)
+        # id(fi.node) -> [(call node, callee FunctionInfo), ...]
+        self._callees: Dict[int, List[Tuple[ast.Call,
+                                            FunctionInfo]]] = {}
+        # id(fi.node) -> blocking chain ([qualnames..., blocking-callee
+        # string]) or None when nothing blocking is reachable
+        self._blocking: Dict[int, Optional[List[str]]] = {}
+
+    def resolve(self, fi: FunctionInfo,
+                call: ast.Call) -> Optional[FunctionInfo]:
+        name = dotted(call.func)
+        if name and "." not in name:
+            nested = fi.module.functions.get(
+                f"{fi.qualname}.<locals>.{name}")
+            if nested is not None:
+                return nested
+        target = self.project.resolve_function(
+            fi.module, call.func, cls=fi.parent_class)
+        if target is None and name and "." in name:
+            target = self._unique_methods.get(name.split(".")[-1])
+        return target
+
+    def callees(self, fi: FunctionInfo
+                ) -> List[Tuple[ast.Call, FunctionInfo]]:
+        """Resolved (call site, callee) pairs in fi's own scope."""
+        cached = self._callees.get(id(fi.node))
+        if cached is not None:
+            return cached
+        out: List[Tuple[ast.Call, FunctionInfo]] = []
+        for node in walk_scope_ordered(fi.node):
+            if isinstance(node, ast.Call):
+                callee = self.resolve(fi, node)
+                if callee is not None:
+                    out.append((node, callee))
+        self._callees[id(fi.node)] = out
+        return out
+
+    # -- transitive blocking summaries ---------------------------------
+
+    def blocking_chain(self, fi: FunctionInfo,
+                       _stack: Optional[Set[int]] = None
+                       ) -> Optional[List[str]]:
+        """First-found helper chain from `fi` to an event-loop-
+        blocking call through SYNC functions only, as
+        [qualname, qualname, ..., "open"/"time.sleep"/...], or None.
+
+        Async callees are excluded on purpose: their bodies are judged
+        as their own `async def` scopes (awaiting them never blocks
+        the loop), so this summary answers exactly "does calling this
+        SYNC helper stall the loop".  Calls deferred through a lambda
+        get the same benefit of the doubt the direct rule gives them.
+        """
+        from ceph_tpu.analysis.rules import (
+            _BLOCKING_CALLS, _BLOCKING_PREFIXES, _inside_lambda,
+            _resolved_callee, walk_scope,
+        )
+
+        key = id(fi.node)
+        if key in self._blocking:
+            return self._blocking[key]
+        if _stack is None:
+            _stack = set()
+        if key in _stack:        # recursion: no new blocking evidence
+            return None
+        root = not _stack
+        _stack.add(key)
+        chain: Optional[List[str]] = None
+        for node in walk_scope(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _resolved_callee(fi.module, node)
+            blocking = (callee == "open"
+                        or callee in _BLOCKING_CALLS
+                        or callee.startswith(_BLOCKING_PREFIXES))
+            if blocking and not _inside_lambda(fi.module, node):
+                chain = [fi.qualname, callee]
+                break
+        if chain is None:
+            for call, callee_fi in self.callees(fi):
+                if callee_fi.is_async or callee_fi.node is fi.node:
+                    continue
+                if (callee_fi.name in self.blocking_exempt
+                        or f"{callee_fi.module.modname}."
+                           f"{callee_fi.name}" in self.blocking_exempt):
+                    continue
+                if _inside_lambda(fi.module, call):
+                    continue
+                sub = self.blocking_chain(callee_fi, _stack)
+                if sub is not None:
+                    chain = [fi.qualname] + sub
+                    break
+        _stack.discard(key)
+        # a None computed mid-recursion may only mean "the rest of this
+        # path is on the stack" (a cycle member pruned, not proven
+        # clean) — caching it would hide that member's real blocking
+        # chain from every later caller.  Positive chains are concrete
+        # paths and always safe to keep.
+        if chain is not None or root:
+            self._blocking[key] = chain
+        return chain
+
+
+# ---------------------------------------------------------------------
+# async-context map
+# ---------------------------------------------------------------------
+
+
+@dataclass
+class SuspensionPoint:
+    """One place a coroutine can yield the event loop."""
+
+    node: ast.AST
+    kind: str                  # "await" | "async-with" | "async-for"
+    line: int
+    end_line: int
+    #: lockdep class labels of every classified `async with` lock
+    #: scope lexically enclosing this point
+    locks: Tuple[str, ...]
+    #: ids of the enclosing classified AsyncWith nodes (scope
+    #: identity: two separate `async with self._lock` blocks share a
+    #: label but not a scope)
+    lock_scopes: Tuple[int, ...]
+    #: True when a try/finally within the function covers this point
+    in_try_finally: bool
+    #: True for `await asyncio.shield(...)`
+    shielded: bool
+
+
+@dataclass
+class FunctionAsyncContext:
+    fi: FunctionInfo
+    suspensions: List[SuspensionPoint] = field(default_factory=list)
+
+    def between(self, lo: int, hi: int) -> List[SuspensionPoint]:
+        """Suspension points strictly between two source lines."""
+        return [s for s in self.suspensions if lo < s.line < hi]
+
+
+def _is_shield(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and (dotted(node.func) or "").split(".")[-1] == "shield")
+
+
+class _CtxBuilder:
+    """Single-pass visitor tracking (held lock scopes, try/finally
+    coverage) down one function body."""
+
+    def __init__(self, project: Project, fi: FunctionInfo):
+        self.project = project
+        self.fi = fi
+        self.out = FunctionAsyncContext(fi)
+        #: id(node) -> (lock labels, lock scope ids) for every node
+        self.scope_of: Dict[int, Tuple[Tuple[str, ...],
+                                       Tuple[int, ...]]] = {}
+
+    def build(self) -> FunctionAsyncContext:
+        for child in ast.iter_child_nodes(self.fi.node):
+            self._visit(child, (), (), False)
+        self.out.suspensions.sort(key=lambda s: s.line)
+        return self.out
+
+    def _add(self, node: ast.AST, kind: str,
+             locks: Tuple[str, ...], scopes: Tuple[int, ...],
+             in_finally: bool, shielded: bool = False) -> None:
+        self.out.suspensions.append(SuspensionPoint(
+            node=node, kind=kind, line=getattr(node, "lineno", 0),
+            end_line=getattr(node, "end_lineno",
+                             getattr(node, "lineno", 0)),
+            locks=locks, lock_scopes=scopes,
+            in_try_finally=in_finally, shielded=shielded))
+
+    def _visit(self, node: ast.AST, locks: Tuple[str, ...],
+               scopes: Tuple[int, ...], in_finally: bool) -> None:
+        if isinstance(node, _SCOPE_BOUNDARIES):
+            return   # nested scopes are judged as their own functions
+        self.scope_of[id(node)] = (locks, scopes)
+        if isinstance(node, ast.Await):
+            self._add(node, "await", locks, scopes, in_finally,
+                      shielded=_is_shield(node.value))
+        elif isinstance(node, ast.AsyncWith):
+            # __aenter__/__aexit__ are suspension points themselves,
+            # recorded OUTSIDE the scopes the items introduce
+            self._add(node, "async-with", locks, scopes, in_finally)
+            inner_locks, inner_scopes = list(locks), list(scopes)
+            for item in node.items:
+                label = classify_lock(self.project, self.fi.module,
+                                      item.context_expr)
+                if label:
+                    inner_locks.append(label)
+                    inner_scopes.append(id(node))
+                self._visit(item.context_expr, locks, scopes,
+                            in_finally)
+            for stmt in node.body:
+                self._visit(stmt, tuple(inner_locks),
+                            tuple(inner_scopes), in_finally)
+            return
+        elif isinstance(node, ast.AsyncFor):
+            self._add(node, "async-for", locks, scopes, in_finally)
+        elif isinstance(node, ast.Try) and node.finalbody:
+            # everything under the try/else/handlers is cleanup-
+            # covered; the finalbody itself keeps the outer coverage
+            for stmt in node.body + node.orelse:
+                self._visit(stmt, locks, scopes, True)
+            for handler in node.handlers:
+                for stmt in handler.body:
+                    self._visit(stmt, locks, scopes, True)
+            for stmt in node.finalbody:
+                self._visit(stmt, locks, scopes, in_finally)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, locks, scopes, in_finally)
+
+
+def _built_ctx(project: Project, fi: FunctionInfo
+               ) -> Tuple[FunctionAsyncContext,
+                          Dict[int, Tuple[Tuple[str, ...],
+                                          Tuple[int, ...]]]]:
+    """Build (or replay) one function's suspension map.  Memoized on
+    the Project: three consumers walk the same bodies per run
+    (await-atomicity via function_atomicity_windows, the cancellation
+    rule via async_context, the cross-check via await_site_map) and
+    the map is a pure function of the AST the Project owns."""
+    cache = getattr(project, "_async_ctx_cache", None)
+    if cache is None:
+        cache = project._async_ctx_cache = {}
+    key = id(fi.node)
+    hit = cache.get(key)
+    if hit is None:
+        builder = _CtxBuilder(project, fi)
+        hit = cache[key] = (builder.build(), builder.scope_of)
+    return hit
+
+
+def async_context(project: Project,
+                  fi: FunctionInfo) -> FunctionAsyncContext:
+    """The suspension-point map of one function."""
+    return _built_ctx(project, fi)[0]
+
+
+# ---------------------------------------------------------------------
+# atomicity windows (the PR-3 bug class, exported for the explorer)
+# ---------------------------------------------------------------------
+
+
+@dataclass
+class AtomicityWindow:
+    """A `self.<attr>` read-modify-write straddling a suspension."""
+
+    fi: FunctionInfo
+    attr: str
+    read_line: int
+    write_node: ast.AST
+    write_line: int
+    suspensions: List[SuspensionPoint]
+    #: lock labels whose SCOPE (the same `async with` node) covers
+    #: both read and write — non-empty means statically protected
+    protecting: Tuple[str, ...]
+
+    @property
+    def protected(self) -> bool:
+        return bool(self.protecting)
+
+
+def _attr_reads(expr: ast.AST, shared: Set[str]) -> Set[Tuple[str,
+                                                              str]]:
+    """(receiver, attr) pairs read in expr, for receivers in the
+    shared set (`self`, parameters, and locals derived from them)."""
+    out: Set[Tuple[str, str]] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in shared and \
+                isinstance(node.ctx, ast.Load):
+            out.add((node.value.id, node.attr))
+    return out
+
+
+def _names_in(expr: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _write_target_attr(target: ast.AST,
+                       shared: Set[str]) -> Optional[Tuple[str, str]]:
+    """`recv.X = ...` / `recv.X[k] = ...` -> ("recv", "X") for shared
+    receivers."""
+    if isinstance(target, ast.Subscript):
+        target = target.value
+    if isinstance(target, ast.Attribute) and \
+            isinstance(target.value, ast.Name) and \
+            target.value.id in shared:
+        return (target.value.id, target.attr)
+    return None
+
+
+def _assign_name_targets(targets: List[ast.AST]) -> List[str]:
+    out: List[str] = []
+    for t in targets:
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            out.extend(e.id for e in t.elts
+                       if isinstance(e, ast.Name))
+    return out
+
+
+def _contains_await(expr: ast.AST) -> bool:
+    return any(isinstance(n, ast.Await) for n in ast.walk(expr))
+
+
+def function_atomicity_windows(project: Project, fi: FunctionInfo
+                               ) -> List[AtomicityWindow]:
+    """RMW-across-suspension windows in one async function.
+
+    Shape recognized (the PR-3 version-allocation bug, literally):
+
+        v = self.next_version          # read
+        ... await <anything> ...       # suspension: another task can
+                                       # read the SAME value here
+        self.next_version = v + 1      # write derived from the read
+
+    plus the one-statement forms `self.x = self.x + await f()` and
+    `self.x += await f()` (Python loads the target BEFORE evaluating
+    an augmented assignment's value, so the await splits the RMW).
+
+    State is any `<recv>.attr` whose receiver is SHARED across tasks:
+    `self`, a parameter (the daemon passes PGState/Connection objects
+    around), or a local derived from one (`q = self._buckets[key]`) —
+    a local bound to a freshly constructed object is task-private and
+    exempt.
+
+    A window is *protected* when one `async with <lockdep lock>` NODE
+    lexically encloses both the read and the write — the same label in
+    two separate blocks does NOT protect (the suspension between them
+    runs unlocked).  Reads are Assign-value reads flowing into locals
+    (taint is killed by reassignment from clean expressions); if-tests
+    and membership checks are out of scope by design — check-then-act
+    is a different, far noisier class than lost-update RMW.
+    """
+    if not fi.is_async:
+        return []
+    ctx, scope_of = _built_ctx(project, fi)
+    if not ctx.suspensions:
+        return []
+
+    def scopes_at(node: ast.AST) -> Tuple[Tuple[str, ...],
+                                          Tuple[int, ...]]:
+        return scope_of.get(id(node), ((), ()))
+
+    # shared receivers: self + params, grown by derivation, shrunk by
+    # rebinding to fresh objects
+    shared: Set[str] = set(fi.params) | {"self"}
+
+    windows: List[AtomicityWindow] = []
+    # local name -> ((recv, attr) it carries, read line, read scopes)
+    taint: Dict[str, Tuple[Tuple[str, str], int, Tuple[int, ...]]] = {}
+
+    def flag(stmt: ast.AST, key: Tuple[str, str], read_line: int,
+             read_scopes: Tuple[int, ...],
+             spans: List[SuspensionPoint]) -> None:
+        labels, scopes = scopes_at(stmt)
+        common = set(read_scopes) & set(scopes)
+        protecting = tuple(sorted({
+            lbl for lbl, sc in zip(labels, scopes) if sc in common}))
+        windows.append(AtomicityWindow(
+            fi=fi, attr=".".join(key), read_line=read_line,
+            write_node=stmt, write_line=getattr(stmt, "lineno", 0),
+            suspensions=spans, protecting=protecting))
+
+    for stmt in walk_scope_ordered(fi.node):
+        if isinstance(stmt, ast.Assign):
+            line = getattr(stmt, "lineno", 0)
+            end = getattr(stmt, "end_lineno", line)
+            w_attrs = [a for a in
+                       (_write_target_attr(t, shared)
+                        for t in stmt.targets) if a]
+            if w_attrs and _contains_await(stmt.value):
+                # one-statement RMW: self.x = f(self.x, await g())
+                reads_here = _attr_reads(stmt.value, shared)
+                spans = [s for s in ctx.suspensions
+                         if line <= s.line <= end]
+                for key in w_attrs:
+                    if key in reads_here:
+                        flag(stmt, key, line, scopes_at(stmt)[1],
+                             spans)
+            if w_attrs:
+                # two-statement RMW: write derives from a tainted local
+                for key in w_attrs:
+                    for name in _names_in(stmt.value):
+                        t = taint.get(name)
+                        if t is None or t[0] != key:
+                            continue
+                        read_line, read_scopes = t[1], t[2]
+                        spans = [s for s in ctx.suspensions
+                                 if read_line < s.line < line]
+                        if spans:
+                            flag(stmt, key, read_line, read_scopes,
+                                 spans)
+                        break
+            # taint + shared-receiver bookkeeping: targets assigned
+            # from shared state carry it; reassignment from a clean
+            # value kills both
+            attrs_read = _attr_reads(stmt.value, shared)
+            derives = bool(_names_in(stmt.value) & shared)
+            for name in _assign_name_targets(stmt.targets):
+                if attrs_read:
+                    taint[name] = (sorted(attrs_read)[0], line,
+                                   scopes_at(stmt)[1])
+                else:
+                    taint.pop(name, None)
+                if derives:
+                    shared.add(name)
+                else:
+                    shared.discard(name)
+        elif isinstance(stmt, ast.AugAssign):
+            key = _write_target_attr(stmt.target, shared)
+            if key is not None and _contains_await(stmt.value):
+                # self.x += await f(): target loads before the await
+                line = getattr(stmt, "lineno", 0)
+                end = getattr(stmt, "end_lineno", line)
+                spans = [s for s in ctx.suspensions
+                         if line <= s.line <= end]
+                flag(stmt, key, line, scopes_at(stmt)[1], spans)
+    return windows
+
+
+def atomicity_windows(project: Project,
+                      paths: Tuple[str, ...] = ()
+                      ) -> List[AtomicityWindow]:
+    """All RMW-across-suspension windows in async functions under
+    `paths` (module relpath substrings; empty = whole project)."""
+    out: List[AtomicityWindow] = []
+    for mod in project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if paths and not any(p in rel for p in paths):
+            continue
+        for fi in mod.functions.values():
+            out.extend(function_atomicity_windows(project, fi))
+    return out
+
+
+def await_site_map(project: Project) -> Dict[Tuple[str, int], dict]:
+    """The static universe of suspension points, keyed by
+    (module relpath, source line) with every line the suspension's
+    statement spans included — the runtime⊆static contract surface:
+    any await site the interleave explorer observes inside the package
+    must appear here, or the async-context map is blind to a coroutine
+    the runtime actually runs.
+
+    Values carry {"qualname", "kind", "locks"} — `locks` is the
+    statically-claimed lockdep class set held at that point, which the
+    explorer cross-checks against lockdep's runtime held-stack.  When
+    spans overlap (an `async with` header statement covers its whole
+    body), the NARROWEST span owns each line — a task suspended at an
+    inner await's line is at that await, so the inner scope's stronger
+    lock claim is the correct one; equal-width overlaps keep the
+    intersection (never claim a lock that isn't lexically certain).
+    """
+    out: Dict[Tuple[str, int], dict] = {}
+    width: Dict[Tuple[str, int], int] = {}
+    for mod in project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        for fi in mod.functions.values():
+            if not fi.is_async:
+                continue
+            ctx = async_context(project, fi)
+            for s in ctx.suspensions:
+                w = s.end_line - s.line
+                for line in range(s.line, s.end_line + 1):
+                    key = (rel, line)
+                    if key not in out or w < width[key]:
+                        out[key] = {"qualname": fi.qualname,
+                                    "kind": s.kind,
+                                    "locks": set(s.locks)}
+                        width[key] = w
+                    elif w == width[key]:
+                        out[key]["locks"] &= set(s.locks)
+    return out

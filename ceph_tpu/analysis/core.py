@@ -129,12 +129,21 @@ class ModuleInfo:
 
     def is_suppressed(self, rule: str, line: int,
                       scope_line: int = 0) -> bool:
-        if rule in self.file_suppress:
-            return True
+        return self.suppression_match(rule, line, scope_line) \
+            is not None
+
+    def suppression_match(self, rule: str, line: int,
+                          scope_line: int = 0) -> Optional[int]:
+        """The comment line whose suppression covers this finding
+        (-1 for a file-wide suppression), or None.  The Analyzer
+        records matches so rule unused-suppression can flag the
+        comments that covered nothing."""
         for ln in (line, line - 1, scope_line):
             if ln and rule in self.suppress.get(ln, ()):
-                return True
-        return False
+                return ln
+        if rule in self.file_suppress:
+            return -1
+        return None
 
 
 def _package_root(path: str) -> Tuple[str, str]:
@@ -503,13 +512,19 @@ class Analyzer:
         self.rules = rules
         self.config = dict(config or {})
         self.findings: List[Finding] = []
+        # (module relpath, comment line | -1 for file-wide, rule) of
+        # every suppression that actually suppressed a finding — the
+        # ledger rule unused-suppression audits
+        self.suppression_hits: Set[Tuple[str, int, str]] = set()
 
     def emit(self, rule: str, mod: ModuleInfo, node: ast.AST,
              message: str, severity: str = "error",
              symbol: str = "", scope_line: int = 0) -> None:
         line = getattr(node, "lineno", 0)
         col = getattr(node, "col_offset", 0)
-        if mod.is_suppressed(rule, line, scope_line):
+        hit = mod.suppression_match(rule, line, scope_line)
+        if hit is not None:
+            self.suppression_hits.add((mod.relpath, hit, rule))
             return
         self.findings.append(Finding(
             rule=rule, path=mod.relpath.replace(os.sep, "/"),

@@ -15,7 +15,14 @@ import json
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional
 
-SEVERITIES = ("error", "warning")
+#: "info" findings are ADVISORY: worklist entries (hot-path-copy),
+#: never gate failures and never baseline entries
+SEVERITIES = ("error", "warning", "info")
+
+
+def gating(findings: Iterable["Finding"]) -> List["Finding"]:
+    """The findings that can fail the CI gate (info is advisory)."""
+    return [f for f in findings if f.severity != "info"]
 
 
 @dataclass
@@ -45,6 +52,7 @@ class Finding:
             "rule": self.rule,
             "path": self.path,
             "line": self.line,
+            "col": self.col,
             "symbol": self.symbol,
             "text": self.text,
             "severity": self.severity,

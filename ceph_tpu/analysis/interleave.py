@@ -1,0 +1,223 @@
+"""Deterministic-interleaving asyncio explorer: lockdep's schedule twin.
+
+The static rules in rules_async.py claim "no await window is
+unprotected"; this module is the runtime instrument that tries to
+DRIVE the windows.  In the mold of CEPH_TPU_LOCKDEP (runtime lock-edge
+recorder cross-checked against the static lock graph) and the PR-8
+crash sweep ("enumerate every legal schedule mechanically" — there for
+power cuts, here for await interleavings):
+
+  InterleaveLoop   a SelectorEventLoop whose `_run_once` PERMUTES the
+                   ready-queue positions of task wakeups with a seeded
+                   PRNG before running them.  Any ordering of ready
+                   callbacks is a legal asyncio schedule; the default
+                   FIFO is merely the one schedule every test always
+                   sees.  Non-task callbacks (transport plumbing,
+                   timers) keep their slots — only task wakeup order
+                   permutes, which is exactly the freedom a real
+                   contended daemon exercises.
+
+  recording        at each permutation the explorer records a
+                   (task, await-site, locks-held) triple per task
+                   about to step: the innermost ceph_tpu frame the
+                   task is suspended at, plus lockdep's held-class
+                   stack for that task.  tests/test_static_analysis.py
+                   cross-checks runtime ⊆ static: every observed
+                   await site must exist in the analyzer's
+                   await-site map (callgraph.await_site_map), and a
+                   site the static pass claims lock-protected must be
+                   observed with that lock actually held.
+
+Arming:
+
+  CEPH_TPU_INTERLEAVE=1        install the policy process-wide (the
+                               tier's conftest does this), every new
+                               event loop permutes
+  CEPH_TPU_INTERLEAVE_SEED=N   base seed (default 0); loop i of the
+                               process uses seed N+i so reruns replay
+                               the same schedule sequence
+  explore(seed)                context manager for tests: install the
+                               policy + recording for one block
+
+Determinism contract: the schedule is a pure function of (seed, the
+program's own behavior); replaying the same test with the same seed
+replays the same permutations.  No wall clock, no os.urandom.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import os
+import random
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Set, Tuple
+
+__all__ = [
+    "InterleaveLoop", "InterleavePolicy", "explore", "enabled",
+    "install_if_enabled", "records", "clear_records", "await_sites",
+    "AwaitRecord",
+]
+
+enabled = os.environ.get("CEPH_TPU_INTERLEAVE", "0") == "1"
+
+#: cap on retained triples: the cross-check needs site coverage, not
+#: an unbounded event log (a cluster test wakes tasks ~1e5 times)
+RECORD_CAP = 200_000
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@dataclass(frozen=True)
+class AwaitRecord:
+    task_name: str
+    path: str          # ceph_tpu-relative path ("ceph_tpu/osd/...")
+    line: int
+    locks: Tuple[str, ...]   # lockdep held-class stack at suspension
+
+
+_records: List[AwaitRecord] = []
+_recording = False
+_loop_counter = 0
+
+
+def records() -> List[AwaitRecord]:
+    return list(_records)
+
+
+def clear_records() -> None:
+    _records.clear()
+
+
+def await_sites() -> Set[Tuple[str, int]]:
+    """Distinct (relpath, line) await sites observed so far."""
+    return {(r.path, r.line) for r in _records}
+
+
+def _is_task_wakeup(handle) -> Optional[asyncio.Task]:
+    """The Task this ready-queue handle steps, or None for transport/
+    timer/future plumbing (which keeps its FIFO slot)."""
+    cb = getattr(handle, "_callback", None)
+    owner = getattr(cb, "__self__", None)
+    return owner if isinstance(owner, asyncio.Task) else None
+
+
+def _innermost_pkg_frame(task: asyncio.Task
+                         ) -> Optional[Tuple[str, int]]:
+    """(relpath, lineno) of the deepest ceph_tpu frame the suspended
+    task will resume in — the await site, in this package's terms."""
+    try:
+        frames = task.get_stack()
+    except Exception:
+        return None
+    site = None
+    for f in frames:   # outermost -> innermost
+        if f.f_lasti < 0:
+            # coroutine created but never stepped: f_lineno is the
+            # `def` line, not a suspension point — no site to record
+            continue
+        fn = f.f_code.co_filename
+        if os.sep + "ceph_tpu" + os.sep in fn or \
+                fn.startswith(_PKG_DIR):
+            rel = fn
+            idx = fn.rfind(os.sep + "ceph_tpu" + os.sep)
+            if idx >= 0:
+                rel = fn[idx + 1:]
+            site = (rel.replace(os.sep, "/"), f.f_lineno)
+    return site
+
+
+def _held_locks(task: asyncio.Task) -> Tuple[str, ...]:
+    from ceph_tpu.common import lockdep
+    return tuple(lockdep._held.get(task, ()))
+
+
+class InterleaveLoop(asyncio.SelectorEventLoop):
+    """SelectorEventLoop with seeded ready-task permutation."""
+
+    def __init__(self, seed: int = 0):
+        super().__init__()
+        self.interleave_seed = seed
+        self._ilv_rng = random.Random(seed)
+        self.permutations = 0
+
+    def _run_once(self):   # noqa: D401 - asyncio internal override
+        ready = self._ready
+        if len(ready) > 1:
+            items = list(ready)
+            idxs = [i for i, h in enumerate(items)
+                    if _is_task_wakeup(h) is not None]
+            if len(idxs) > 1:
+                order = idxs[:]
+                self._ilv_rng.shuffle(order)
+                if order != idxs:
+                    self.permutations += 1
+                # permute IN PLACE via indexed assignment: a worker
+                # thread's call_soon_threadsafe can append to _ready
+                # concurrently, and clear()+extend() would silently
+                # drop any handle landing between the snapshot and
+                # the rebuild — the awaiting coroutine then hangs on
+                # a deadlock that is the instrument's, not the code's
+                for dst, src in zip(idxs, order):
+                    ready[dst] = items[src]
+                if _recording and len(_records) < RECORD_CAP:
+                    for i in idxs:
+                        task = _is_task_wakeup(items[i])
+                        site = _innermost_pkg_frame(task)
+                        if site is None:
+                            continue
+                        _records.append(AwaitRecord(
+                            task_name=task.get_name(),
+                            path=site[0], line=site[1],
+                            locks=_held_locks(task)))
+        super()._run_once()
+
+
+class InterleavePolicy(asyncio.DefaultEventLoopPolicy):
+    """Every new loop is an InterleaveLoop; loop i uses seed base+i so
+    a multi-loop test (cluster setup/teardown cycles) stays
+    deterministic end to end."""
+
+    def __init__(self, seed: int = 0):
+        super().__init__()
+        self.base_seed = seed
+
+    def new_event_loop(self):
+        global _loop_counter
+        loop = InterleaveLoop(self.base_seed + _loop_counter)
+        _loop_counter += 1
+        return loop
+
+
+def install_if_enabled() -> bool:
+    """conftest hook: arm the policy when CEPH_TPU_INTERLEAVE=1."""
+    if not enabled:
+        return False
+    seed = int(os.environ.get("CEPH_TPU_INTERLEAVE_SEED", "0"))
+    asyncio.set_event_loop_policy(InterleavePolicy(seed))
+    global _recording
+    _recording = True
+    return True
+
+
+@contextlib.contextmanager
+def explore(seed: int = 0, record: bool = True) -> Iterator[None]:
+    """Run a block's event loops under seeded interleaving:
+
+        with interleave.explore(seed=3):
+            asyncio.run(cluster_scenario())
+        triples = interleave.records()
+    """
+    global _recording, _loop_counter
+    prev_policy = asyncio.get_event_loop_policy()
+    prev_recording = _recording
+    prev_counter = _loop_counter
+    _loop_counter = 0
+    asyncio.set_event_loop_policy(InterleavePolicy(seed))
+    _recording = record
+    try:
+        yield
+    finally:
+        _recording = prev_recording
+        _loop_counter = prev_counter
+        asyncio.set_event_loop_policy(prev_policy)

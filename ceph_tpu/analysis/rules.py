@@ -1080,8 +1080,16 @@ def rule_commit_before_durability(a: Analyzer) -> None:
 
 def default_rules() -> Dict[str, object]:
     # lock-order lives in lockgraph.py (it needs the whole-project
-    # graph); imported here to keep one registry
+    # graph) and the interprocedural async rules in rules_async.py
+    # (they need the callgraph.py layer); imported here to keep one
+    # registry.  unused-suppression MUST run last: it audits the
+    # suppression-hit ledger every earlier rule's emit() fills.
     from ceph_tpu.analysis.lockgraph import rule_lock_order
+    from ceph_tpu.analysis.rules_async import (
+        rule_await_atomicity, rule_cancellation_unsafe_acquire,
+        rule_hot_path_copy, rule_transitive_blocking_call,
+        rule_unused_suppression,
+    )
     return {
         "trace-side-effect": rule_trace_side_effect,
         "trace-host-sync": rule_trace_host_sync,
@@ -1099,4 +1107,9 @@ def default_rules() -> Dict[str, object]:
         "sync-encode-in-async": rule_sync_encode_in_async,
         "lock-order": rule_lock_order,
         "lock-no-await": rule_lock_no_await,
+        "await-atomicity": rule_await_atomicity,
+        "cancellation-unsafe-acquire": rule_cancellation_unsafe_acquire,
+        "transitive-blocking-call": rule_transitive_blocking_call,
+        "hot-path-copy": rule_hot_path_copy,
+        "unused-suppression": rule_unused_suppression,
     }

@@ -1,0 +1,364 @@
+"""Interprocedural async-atomicity / cancellation-safety rules.
+
+Four rules over the callgraph.py whole-program layer, each the static
+twin of a bug class a previous PR fixed by hand after a runtime hunt:
+
+  await-atomicity      read-modify-write of `self.` state spanning an
+                       `await` with no lockdep.Lock scope covering both
+                       sides — the PR-3 class (a suspension between
+                       version allocation and submit let a concurrent
+                       write clobber the counter)
+  cancellation-unsafe-acquire
+                       a resource/counter/seq acquired, then a
+                       suspension outside try/finally or
+                       asyncio.shield BEFORE the paired use — the PR-6
+                       class (a sub-read cancelled while parked behind
+                       the send lock consumed a frame seq that never
+                       hit the wire, gapping the receiver's replay
+                       check and killing the connection)
+  transitive-blocking-call
+                       sync file/socket/sleep I/O reachable from an
+                       `async def` through ANY depth of sync helpers
+                       (rule async-blocking only sees direct calls)
+  hot-path-copy        bytes()/b"".join/slice/.copy()/.tobytes()
+                       copies in the msgr→OSD→ec/plan hot path.
+                       Severity "info": this rule is a WORKLIST, not a
+                       gate — its finding list enumerates the copy
+                       sites ROADMAP item 2's zero-copy pass must
+                       retire (`--hot-path-report` prints it)
+
+plus the suppression-hygiene satellite:
+
+  unused-suppression   a `# lint: disable=<rule>` comment that
+                       suppressed nothing this run — dead suppressions
+                       otherwise accumulate and silently swallow the
+                       next real finding on that line
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from types import SimpleNamespace
+from typing import Optional
+
+from ceph_tpu.analysis.callgraph import (
+    CallGraph, async_context, function_atomicity_windows,
+    walk_scope_ordered,
+)
+from ceph_tpu.analysis.core import Analyzer, dotted
+from ceph_tpu.analysis.rules import (
+    _enclosing_qualname, _inside_lambda, _scope_line, walk_scope,
+)
+
+# ---------------------------------------------------------------------
+# await-atomicity
+# ---------------------------------------------------------------------
+
+# daemon modules whose `self.` state is shared across concurrent tasks
+# on one event loop — exactly the processes whose every prior
+# concurrency bug was an unprotected await window
+_ATOMICITY_PATHS = ("ceph_tpu/osd/", "ceph_tpu/msg/", "ceph_tpu/os/",
+                    "ceph_tpu/mon/", "ceph_tpu/mds/")
+
+
+def rule_await_atomicity(a: Analyzer) -> None:
+    """Read-modify-write of `self.<attr>` whose read and write straddle
+    a suspension point with no single lockdep.Lock `async with` scope
+    covering both: between the read and the write every other task on
+    the loop may run, read the SAME value, and one of the two writes is
+    silently lost.  Fix: hold a lockdep.Lock across the window, move
+    the await out of it, or re-derive the value after the await."""
+    paths = a.config.get("atomicity_paths", _ATOMICITY_PATHS)
+    for mod in a.project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if not any(p in rel for p in paths):
+            continue
+        for fi in mod.functions.values():
+            if not fi.is_async:
+                continue
+            for w in function_atomicity_windows(a.project, fi):
+                if w.protected:
+                    continue
+                span = w.suspensions[0].line if w.suspensions \
+                    else w.write_line
+                a.emit(
+                    "await-atomicity", mod, w.write_node,
+                    f"read-modify-write of `{w.attr}` in "
+                    f"`{fi.qualname}` spans an await (read at line "
+                    f"{w.read_line}, suspension at line {span}): "
+                    "another task can interleave and this write "
+                    "clobbers its update — hold one lockdep.Lock "
+                    "scope across the window or re-read after the "
+                    "await",
+                    symbol=fi.qualname, scope_line=fi.lineno)
+
+
+# ---------------------------------------------------------------------
+# cancellation-unsafe-acquire
+# ---------------------------------------------------------------------
+
+_CANCEL_PATHS = ("ceph_tpu/osd/", "ceph_tpu/msg/")
+# call tails that ACQUIRE a resource whose loss on cancellation is a
+# protocol gap: explicit acquire/reserve/alloc verbs, plus this
+# codebase's version allocator
+_ACQUIRE_ATTR_RE = re.compile(r"^(acquire|reserve|alloc)")
+_ACQUIRE_NAMES = {"_next_entry"}
+# `next(<counter>)` on seq/count-named counters consumes a monotonic
+# value (the msgr frame-seq class)
+_COUNTER_RE = re.compile(r"seq|count", re.I)
+
+
+def _acquire_kind(call: ast.Call) -> Optional[str]:
+    name = dotted(call.func) or ""
+    tail = name.split(".")[-1]
+    if tail in _ACQUIRE_NAMES or _ACQUIRE_ATTR_RE.match(tail):
+        return tail
+    if tail == "next" and call.args:
+        arg = dotted(call.args[0]) or ""
+        if _COUNTER_RE.search(arg):
+            return f"next({arg})"
+    return None
+
+
+def rule_cancellation_unsafe_acquire(a: Analyzer) -> None:
+    """A monotonic seq / version / reservation is acquired, then the
+    coroutine can suspend BEFORE the paired use — a cancellation landing
+    on that suspension consumes the resource without ever submitting
+    it (the msgr seq-gap class: the receiver's replay check sees the
+    hole and kills the connection).  Safe shapes: acquire after the
+    last pre-use suspension, the suspension under a try/finally that
+    releases, or `await asyncio.shield(...)`."""
+    paths = a.config.get("cancel_paths", _CANCEL_PATHS)
+    for mod in a.project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if not any(p in rel for p in paths):
+            continue
+        for fi in mod.functions.values():
+            if not fi.is_async:
+                continue
+            ctx = async_context(a.project, fi)
+            if not ctx.suspensions:
+                continue
+            nodes = list(walk_scope_ordered(fi.node))
+            for stmt in nodes:
+                if not isinstance(stmt, ast.Assign) or \
+                        not isinstance(stmt.value, ast.Call):
+                    continue
+                kind = _acquire_kind(stmt.value)
+                if kind is None:
+                    continue
+                bound = {t.id for t in stmt.targets
+                         if isinstance(t, ast.Name)}
+                if not bound:
+                    continue
+                acq_line = getattr(stmt, "end_lineno", stmt.lineno)
+                # first later statement that references the value =
+                # the paired submit/use
+                use_line = None
+                for other in nodes:
+                    if getattr(other, "lineno", 0) <= acq_line or \
+                            not isinstance(other, ast.stmt):
+                        continue
+                    names = {n.id for n in ast.walk(other)
+                             if isinstance(n, ast.Name)}
+                    if names & bound:
+                        use_line = other.lineno
+                        break
+                if use_line is None:
+                    continue   # never used: nothing paired to lose
+                gaps = [s for s in ctx.suspensions
+                        if acq_line < s.line < use_line
+                        and not s.in_try_finally and not s.shielded]
+                if not gaps:
+                    continue
+                a.emit(
+                    "cancellation-unsafe-acquire", mod, stmt,
+                    f"`{kind}` acquired in `{fi.qualname}` but the "
+                    f"coroutine can suspend at line {gaps[0].line} "
+                    f"before the paired use at line {use_line}: a "
+                    "cancellation there consumes the resource "
+                    "without submitting it (seq gap / leaked "
+                    "reservation) — acquire after the suspension, "
+                    "cover it with try/finally that releases, or "
+                    "shield the await",
+                    symbol=fi.qualname, scope_line=fi.lineno)
+
+
+# ---------------------------------------------------------------------
+# transitive-blocking-call
+# ---------------------------------------------------------------------
+
+
+# callees the blocking closure treats as non-blocking: memoized
+# one-shot inits whose steady-state call is a dict read.  get_lib is
+# the native library's build-once entry — every daemon AND client
+# PREWARMS it off-loop at the msgr bind/connect choke point
+# (Messenger._prewarm_native, asyncio.to_thread), so the subprocess
+# compile never runs on a serving event loop; every call after that
+# returns the cached binding.  Module-qualified so only the native
+# package's get_lib is exempt — a future blocking helper that happens
+# to share the name still gets flagged.
+_BLOCKING_EXEMPT = ("ceph_tpu.native.get_lib",)
+
+
+def rule_transitive_blocking_call(a: Analyzer) -> None:
+    """Event-loop-blocking I/O (open / time.sleep / subprocess /
+    urllib / socket) reachable from an `async def` through a chain of
+    SYNC helpers — rule async-blocking's interprocedural closure.  The
+    finding names the whole chain; fix by awaiting an async
+    equivalent, shipping the helper through asyncio.to_thread, or
+    justifying a deliberate boot-time/CLI block in the baseline."""
+    paths = a.config.get("transitive_paths", ())
+    cg = CallGraph(a.project, blocking_exempt=a.config.get(
+        "blocking_exempt", _BLOCKING_EXEMPT))
+    for mod in a.project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if paths and not any(p in rel for p in paths):
+            continue
+        for fi in mod.functions.values():
+            if not fi.is_async:
+                continue
+            seen_callees = set()
+            for call, callee in cg.callees(fi):
+                if callee.is_async or _inside_lambda(mod, call):
+                    continue
+                chain = cg.blocking_chain(callee)
+                if chain is None:
+                    continue
+                key = (call.lineno, id(callee.node))
+                if key in seen_callees:
+                    continue
+                seen_callees.add(key)
+                route = " -> ".join([fi.qualname] + chain)
+                a.emit(
+                    "transitive-blocking-call", mod, call,
+                    f"sync helper `{callee.qualname}` called from "
+                    f"`async def {fi.qualname}` reaches blocking "
+                    f"I/O ({route}): the event loop stalls for "
+                    "every task on this daemon — await an async "
+                    "equivalent or ship the helper through "
+                    "asyncio.to_thread",
+                    symbol=fi.qualname, scope_line=fi.lineno)
+
+
+# ---------------------------------------------------------------------
+# hot-path-copy
+# ---------------------------------------------------------------------
+
+# the msgr→daemon→ec/plan data path: every op's payload crosses these
+# modules, so each pattern here is a per-op full-buffer copy
+_HOT_PATHS = ("ceph_tpu/msg/", "ceph_tpu/osd/daemon.py",
+              "ceph_tpu/osd/ec_util.py",
+              "ceph_tpu/osd/encode_service.py", "ceph_tpu/ec/")
+# receivers that plausibly hold bulk payload bytes (the slice
+# heuristic's noise bound: an int index or a small-tuple slice on an
+# unrelated name is not a worklist entry)
+_BUF_NAME_RE = re.compile(
+    r"data|payload|buf|blob|chunk|shard|stream|frame|part", re.I)
+
+
+def _recv_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def rule_hot_path_copy(a: Analyzer) -> None:
+    """Buffer copies on the msgr→OSD→ec/plan hot path: `bytes(x)`,
+    `b"".join(...)`, payload slicing, `.copy()`, `.tobytes()`.  Each
+    costs a full memcpy per op at line rate.  Severity "info" — the
+    finding list IS ROADMAP item 2's zero-copy worklist (surfaced via
+    `python -m ceph_tpu.analysis --hot-path-report`), not a gate:
+    retire entries with memoryview/StridedBuf views end to end."""
+    paths = a.config.get("hot_paths", _HOT_PATHS)
+    for mod in a.project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if not any(p in rel for p in paths):
+            continue
+        for node in ast.walk(mod.tree):
+            msg = None
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Name) and fn.id == "bytes" \
+                        and len(node.args) == 1 and not isinstance(
+                            node.args[0], ast.Constant):
+                    msg = ("bytes(...) materializes a full copy of "
+                           "the buffer")
+                elif isinstance(fn, ast.Attribute) and \
+                        fn.attr == "join" and isinstance(
+                            fn.value, ast.Constant) and isinstance(
+                            fn.value.value, bytes):
+                    msg = ("b\"\".join(...) concatenates by copying "
+                           "every part")
+                elif isinstance(fn, ast.Attribute) and \
+                        fn.attr == "copy" and not node.args:
+                    msg = ".copy() duplicates the array/buffer"
+                elif isinstance(fn, ast.Attribute) and \
+                        fn.attr == "tobytes" and not node.args:
+                    msg = (".tobytes() copies device/array data into "
+                           "a fresh bytes object")
+            elif isinstance(node, ast.Subscript) and isinstance(
+                    node.slice, ast.Slice) and isinstance(
+                    node.ctx, ast.Load):
+                name = _recv_name(node.value)
+                if name and _BUF_NAME_RE.search(name):
+                    msg = (f"slicing `{name}` copies the byte range "
+                           "(a memoryview slice is zero-copy)")
+            if msg is None:
+                continue
+            a.emit(
+                "hot-path-copy", mod, node,
+                f"{msg} on the msgr→OSD→plan hot path — ROADMAP "
+                "item 2 worklist entry: keep a view "
+                "(memoryview/StridedBuf) end to end, or accept the "
+                "copy knowingly",
+                severity="info",
+                symbol=_enclosing_qualname(mod, node),
+                scope_line=_scope_line(mod, node))
+
+
+# ---------------------------------------------------------------------
+# unused-suppression
+# ---------------------------------------------------------------------
+
+
+def rule_unused_suppression(a: Analyzer) -> None:
+    """A `# lint: disable=<rule>` (or disable-file) comment that
+    suppressed NOTHING in this run: the violation it covered was fixed
+    (or never existed), and the stale comment now silently swallows
+    the next real finding on that line.  Delete it.  Judged only for
+    rules that actually ran, so subset runs can't cry wolf.
+
+    Registered LAST in default_rules(): it reads the suppression-hit
+    ledger every earlier emit() recorded into."""
+    active = set(a.rules) - {"unused-suppression"}
+    for mod in a.project.modules.values():
+        for line in sorted(mod.suppress):
+            for rule in sorted(mod.suppress[line]):
+                if rule not in active:
+                    continue
+                if (mod.relpath, line, rule) in a.suppression_hits:
+                    continue
+                a.emit(
+                    "unused-suppression", mod,
+                    SimpleNamespace(lineno=line, col_offset=0),
+                    f"`# lint: disable={rule}` suppresses nothing "
+                    "(the finding it covered is gone) — delete the "
+                    "stale suppression before it swallows the next "
+                    "real finding here",
+                    severity="warning", symbol="<suppression>")
+        for rule in sorted(mod.file_suppress):
+            if rule not in active:
+                continue
+            if (mod.relpath, -1, rule) in a.suppression_hits:
+                continue
+            a.emit(
+                "unused-suppression", mod,
+                SimpleNamespace(lineno=1, col_offset=0),
+                f"`# lint: disable-file={rule}` suppresses nothing "
+                "in this module — delete the stale file-wide "
+                "suppression",
+                severity="warning", symbol="<suppression>")

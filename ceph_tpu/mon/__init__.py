@@ -228,6 +228,7 @@ class MonDaemon:
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        # native prewarm rides msgr.bind (Messenger._prewarm_native)
         addr = await self.msgr.bind(host, port)
         self._check_task = asyncio.get_running_loop().create_task(
             self._check_failures_loop())

@@ -427,6 +427,20 @@ class Messenger:
 
     # -- lifecycle ---------------------------------------------------------
 
+    @staticmethod
+    async def _prewarm_native() -> None:
+        """Prewarm the native library's build-once path OFF-loop: the
+        first get_lib() may compile the .so (a subprocess), and every
+        wire frame's crc32c rides it.  This is the SHARED choke point —
+        every server binds and every client connects — so MDS and
+        client-only processes get the same guarantee the OSD/Mon
+        daemons do, which is what lets the analyzer exempt get_lib
+        from transitive-blocking-call (rules_async._BLOCKING_EXEMPT:
+        steady-state calls are a dict read)."""
+        from ceph_tpu import native
+        if not native.prewarmed():
+            await asyncio.to_thread(native.get_lib)
+
     async def bind(self, host: str = "127.0.0.1", port: int = 0) -> str:
         if self.secure and self.secret is None:
             # claiming wire encryption with no key would silently send
@@ -434,6 +448,7 @@ class Messenger:
             raise ValueError(
                 f"{self.entity_name}: auth_secure requires a keyring"
                 " (auth_secret)")
+        await self._prewarm_native()
         self._server = await asyncio.start_server(
             self._handle_accept, host, port, limit=self.STREAM_LIMIT)
         port = self._server.sockets[0].getsockname()[1]
@@ -478,6 +493,7 @@ class Messenger:
                     and target._loop is asyncio.get_running_loop()
                     and self._local_compatible(target)):
                 return self._connect_local(addr, target)
+        await self._prewarm_native()
         host, port_s = addr.rsplit(":", 1)
         reader, writer = await asyncio.open_connection(
             host, int(port_s), limit=self.STREAM_LIMIT)
@@ -644,8 +660,11 @@ class Messenger:
 
                     try:
                         (cmsg,) = _struct.unpack_from("<i", payload)
+                        # slice through a memoryview: `payload[4:]`
+                        # would copy the whole frame once just to feed
+                        # bytes() a second copy
                         payload = comp.decompress(
-                            bytes(payload[4:]),
+                            bytes(memoryview(payload)[4:]),
                             None if cmsg < 0 else cmsg)
                     except frames.FrameError:
                         raise

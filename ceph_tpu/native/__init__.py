@@ -145,6 +145,13 @@ def get_lib() -> Optional[ctypes.CDLL]:
     return _lib
 
 
+def prewarmed() -> bool:
+    """True once get_lib() has resolved (built or failed for good):
+    callers that prewarm the one-shot build off-loop can skip the
+    thread hop on every later check."""
+    return _lib is not None or _build_error is not None
+
+
 def _tune_allocator() -> None:
     """Keep multi-MiB data-path buffers on the recycled heap.
 

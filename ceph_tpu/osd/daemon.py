@@ -465,6 +465,13 @@ class OSDDaemon:
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        # prewarm the native library OFF-loop before the store mounts:
+        # msgr.bind prewarms too (Messenger._prewarm_native, the shared
+        # choke point every daemon and client crosses), but the store's
+        # mkfs/mount below can touch native csum BEFORE bind runs
+        from ceph_tpu import native
+        if not native.prewarmed():
+            await asyncio.to_thread(native.get_lib)
         if self._own_store:
             self.store.mkfs()
             self.store.mount()
@@ -4176,10 +4183,17 @@ class OSDDaemon:
             if snapc is not None:
                 clone_ops, ss_raw = await self._snap_clone_prep(
                     state, pool, oid, snapc[0], snapc[1])
-            entry = self._next_entry(state, pool, oid, "modify")
+            # stat BEFORE the version allocation: _next_entry consumes
+            # state.next_version, and a suspension between allocation
+            # and _submit_shard_writes would let a cancellation strand
+            # the version (pg-log gap) or a concurrent write submit a
+            # LATER version first (out-of-order log append) — the same
+            # discipline _op_write_full_locked documents for its
+            # encode awaits
             rc, old_size = await self._stat_size(state, pool, oid)
             new_size = max(old_size if rc == 0 else 0,
                            offset + len(data))
+            entry = self._next_entry(state, pool, oid, "modify")
             oi = json.dumps({"size": new_size,
                              "version": entry["version"]}).encode()
             ops = [ShardOp("create"),

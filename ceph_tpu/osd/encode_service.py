@@ -72,6 +72,17 @@ def _pow2_bucket(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
+def _buf(d):
+    """Pass buffers through to ec_util unchanged; materialize only
+    non-buffer payloads.  The old `bytes(d)`-unless-bytes guard copied
+    every memoryview payload once per inline encode — unnecessary: the
+    write path snapshots caller-mutable buffers BEFORE the service
+    sees them (`_op_write_full_locked`/`_op_write`), so a view here is
+    already stable, and ec_util slices views zero-copy."""
+    return d if isinstance(d, (bytes, bytearray, memoryview)) \
+        else bytes(d)
+
+
 _WAIT_EDGES_MS = (0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0)
 
 
@@ -186,9 +197,7 @@ class EncodeService:
         q = self._bucket_for("encode", sinfo, codec)
         if q is None or not self._admit(q, len(data)):
             self.counters["inline" if q is None else "shed"] += 1
-            return ec_util.encode(
-                sinfo, codec,
-                data if isinstance(data, bytes) else bytes(data), want)
+            return ec_util.encode(sinfo, codec, _buf(data), want)
         return await self._enqueue(q, (data, want), len(data))
 
     async def decode(self, sinfo, codec, to_decode) -> bytes:
@@ -454,7 +463,5 @@ class EncodeService:
                                              logical_len=l)
         if q.kind == "encode":
             d, w = payload
-            return ec_util.encode(
-                q.sinfo, q.codec,
-                d if isinstance(d, bytes) else bytes(d), w)
+            return ec_util.encode(q.sinfo, q.codec, _buf(d), w)
         return ec_util.decode(q.sinfo, q.codec, payload)

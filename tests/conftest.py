@@ -24,6 +24,16 @@ if "ceph_tpu.common.lockdep" in sys.modules:
     sys.modules["ceph_tpu.common.lockdep"].enabled = (
         os.environ["CEPH_TPU_LOCKDEP"] == "1")
 
+# Arm the deterministic-interleaving explorer for the WHOLE tier when
+# CEPH_TPU_INTERLEAVE=1 (lockdep's schedule twin: every event loop any
+# test creates permutes ready-task wakeup order with a seeded PRNG, so
+# the entire suite runs under an adversarial-but-replayable schedule).
+# Off by default; tests/test_static_analysis.py drives cluster
+# scenarios under it explicitly via interleave.explore(seed).
+from ceph_tpu.analysis import interleave  # noqa: E402
+
+interleave.install_if_enabled()
+
 
 def pytest_configure(config):
     config.addinivalue_line(

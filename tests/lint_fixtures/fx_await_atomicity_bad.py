@@ -1,0 +1,31 @@
+"""Violation fixture: rule await-atomicity.
+
+Read-modify-write of `self.` daemon state spanning a suspension point
+with no lockdep.Lock scope covering both sides — the PR-3 bug class:
+a version is allocated, the coroutine suspends, a concurrent task
+reads the SAME value, and one of the two increments is silently lost.
+"""
+import asyncio
+
+
+class Daemon:
+    def __init__(self):
+        self.next_version = 0
+        self.bytes_in_flight = 0
+
+    async def alloc_version(self):
+        v = self.next_version
+        await asyncio.sleep(0)
+        self.next_version = v + 1  # expect: await-atomicity
+        return v
+
+    async def account(self, n):
+        got = await self._quota(n)
+        self.bytes_in_flight += got
+        return got
+
+    async def account_inline(self, n):
+        self.bytes_in_flight += await self._quota(n)  # expect: await-atomicity
+
+    async def _quota(self, n):
+        return n

@@ -1,0 +1,30 @@
+"""Clean twin of fx_await_atomicity_bad: the same RMW shapes made
+safe — one lockdep.Lock scope covering read AND write, or the value
+re-derived after the suspension so no stale read survives an
+interleaving."""
+import asyncio
+
+from ceph_tpu.common import lockdep
+
+
+class Daemon:
+    def __init__(self):
+        self._lock = lockdep.Lock("fx.atomicity")
+        self.next_version = 0
+        self.bytes_in_flight = 0
+
+    async def alloc_version(self):
+        async with self._lock:
+            v = self.next_version
+            await asyncio.sleep(0)
+            self.next_version = v + 1
+        return v
+
+    async def account(self, n):
+        got = await self._quota(n)
+        # read happens AFTER the last suspension: no window
+        self.bytes_in_flight = self.bytes_in_flight + got
+        return got
+
+    async def _quota(self, n):
+        return n

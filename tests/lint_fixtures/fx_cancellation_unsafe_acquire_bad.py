@@ -1,0 +1,21 @@
+"""Violation fixture: rule cancellation-unsafe-acquire.
+
+A monotonic frame seq is consumed, then the coroutine can suspend
+OUTSIDE try/finally before the paired submit — a cancellation landing
+on the suspension consumes the seq without it ever hitting the wire,
+and the receiver's replay check sees the gap (the PR-6 msgr class).
+"""
+import asyncio
+
+
+class Conn:
+    def __init__(self):
+        self.send_seq = iter(range(1 << 20))
+
+    async def send_frame(self, frame):
+        seq = next(self.send_seq)  # expect: cancellation-unsafe-acquire
+        await asyncio.sleep(0)
+        self._submit(seq, frame)
+
+    def _submit(self, seq, frame):
+        pass

@@ -1,0 +1,35 @@
+"""Clean twin of fx_cancellation_unsafe_acquire_bad: every safe shape
+— acquire after the last pre-use suspension, the gap covered by a
+try/finally that pairs the release, or the await shielded from
+cancellation."""
+import asyncio
+
+
+class Conn:
+    def __init__(self):
+        self.send_seq = iter(range(1 << 20))
+
+    async def send_late(self, frame):
+        await self._drain()
+        seq = next(self.send_seq)
+        self._submit(seq, frame)
+
+    async def send_covered(self, frame):
+        seq = next(self.send_seq)
+        try:
+            await asyncio.sleep(0)
+        finally:
+            self._submit(seq, frame)
+
+    async def send_shielded(self, frame):
+        seq = next(self.send_seq)
+        await asyncio.shield(self._flush(seq, frame))
+
+    async def _drain(self):
+        await asyncio.sleep(0)
+
+    async def _flush(self, seq, frame):
+        self._submit(seq, frame)
+
+    def _submit(self, seq, frame):
+        pass

@@ -1,0 +1,10 @@
+"""Violation fixture: rule hot-path-copy (severity "info" — the
+finding list is ROADMAP item 2's zero-copy worklist, not a gate).
+Each line below is one full-buffer memcpy per op at line rate."""
+
+
+def reframe(payload, parts):
+    head = bytes(payload)  # expect: hot-path-copy
+    body = payload[4:]  # expect: hot-path-copy
+    joined = b"".join(parts)  # expect: hot-path-copy
+    return head, body, joined

@@ -1,0 +1,11 @@
+"""Clean twin of fx_hot_path_copy_bad: views end to end — memoryview
+slices are zero-copy, and lengths come from the parts without ever
+concatenating them."""
+
+
+def reframe(payload, parts):
+    view = memoryview(payload)
+    head = view[:4]
+    body = view[4:]
+    total = sum(len(p) for p in parts)
+    return head, body, total

@@ -1,0 +1,18 @@
+"""Violation fixture: rule transitive-blocking-call.
+
+The blocking `open` sits TWO sync frames below the `async def` — the
+direct async-blocking rule cannot see it; the interprocedural closure
+must name the whole helper chain."""
+
+
+def _read_super(path):
+    with open(path) as fh:
+        return fh.read()
+
+
+def _load(path):
+    return _read_super(path)
+
+
+async def serve(path):
+    return _load(path)  # expect: transitive-blocking-call

@@ -1,0 +1,17 @@
+"""Clean twin of fx_transitive_blocking_call_bad: the identical
+helper chain shipped off-loop through asyncio.to_thread — the event
+loop never runs the blocking leaf."""
+import asyncio
+
+
+def _read_super(path):
+    with open(path) as fh:
+        return fh.read()
+
+
+def _load(path):
+    return _read_super(path)
+
+
+async def serve(path):
+    return await asyncio.to_thread(_load, path)

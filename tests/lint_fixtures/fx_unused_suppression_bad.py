@@ -1,0 +1,9 @@
+"""Violation fixture: rule unused-suppression.
+
+The disable comment below suppresses NOTHING — the violation it once
+covered is gone — so it would silently swallow the next real finding
+on that line.  The analyzer must flag the dead comment itself."""
+
+
+async def idle():
+    return 0  # lint: disable=async-blocking  # expect: unused-suppression

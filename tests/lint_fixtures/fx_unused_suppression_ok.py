@@ -1,0 +1,8 @@
+"""Clean twin of fx_unused_suppression_bad: the suppression still
+covers a live finding (the sleep IS a violation, deliberately
+accepted), so it is in use and must not be flagged."""
+import time
+
+
+async def tick():
+    time.sleep(0.1)  # lint: disable=async-blocking

@@ -1,0 +1,288 @@
+"""Unit tier for the interprocedural layer (analysis/callgraph.py):
+call-edge resolution (relative imports, `self.` method binding, the
+unique-method fallback), transitive blocking summaries, async-context
+inference (locks held at each suspension point, try/finally coverage,
+shield detection), and atomicity-window extraction with protection
+verdicts — the facts rules_async.py and the interleave cross-check
+both build on."""
+
+from __future__ import annotations
+
+import pytest
+
+from ceph_tpu.analysis.callgraph import (
+    CallGraph, async_context, await_site_map,
+    function_atomicity_windows,
+)
+from ceph_tpu.analysis.core import build_project
+
+
+def _project(tmp_path, files):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    for name, src in files.items():
+        (pkg / name).write_text(src)
+    return build_project([str(pkg)])
+
+
+def _fi(proj, modname, qualname):
+    return proj.modules[modname].functions[qualname]
+
+
+# -- call-edge resolution ----------------------------------------------
+
+
+def test_callees_resolve_through_relative_imports(tmp_path):
+    proj = _project(tmp_path, {
+        "a.py": ("from .b import helper\n"
+                 "from . import b\n\n\n"
+                 "async def serve():\n"
+                 "    helper()\n"
+                 "    b.other()\n"),
+        "b.py": ("def helper():\n    pass\n\n\n"
+                 "def other():\n    pass\n"),
+    })
+    cg = CallGraph(proj)
+    callees = {c.qualname for _, c in
+               cg.callees(_fi(proj, "pkg.a", "serve"))}
+    assert callees == {"helper", "other"}
+
+
+def test_callees_bind_self_methods_through_class(tmp_path):
+    proj = _project(tmp_path, {
+        "svc.py": ("class A:\n"
+                   "    def work(self):\n"
+                   "        self.step()\n"
+                   "    def step(self):\n"
+                   "        pass\n\n\n"
+                   "class B:\n"
+                   "    def step(self):\n"
+                   "        pass\n"),
+    })
+    cg = CallGraph(proj)
+    (_, callee), = cg.callees(_fi(proj, "pkg.svc", "A.work"))
+    assert callee.qualname == "A.step"     # A's, not B's
+
+
+def test_unique_method_fallback_binds_foreign_receivers(tmp_path):
+    """`conn.flush()` on a non-self receiver still resolves when
+    exactly ONE class project-wide defines flush; two definitions must
+    leave it unresolved rather than bind nondeterministically."""
+    proj = _project(tmp_path, {
+        "conn.py": ("class Conn:\n"
+                    "    def flush(self):\n"
+                    "        pass\n"
+                    "    def close(self):\n"
+                    "        pass\n"),
+        "other.py": ("class Store:\n"
+                     "    def close(self):\n"
+                     "        pass\n"),
+        "use.py": ("def run(conn):\n"
+                   "    conn.flush()\n"
+                   "    conn.close()\n"),
+    })
+    cg = CallGraph(proj)
+    callees = {c.qualname for _, c in
+               cg.callees(_fi(proj, "pkg.use", "run"))}
+    assert callees == {"Conn.flush"}       # close is ambiguous
+
+
+# -- transitive blocking summaries -------------------------------------
+
+
+BLOCKING_SRC = {
+    "deep.py": ("import time\n\n\n"
+                "def leaf():\n"
+                "    time.sleep(0.1)\n"),
+    "mid.py": ("from .deep import leaf\n\n\n"
+               "def helper():\n"
+               "    leaf()\n\n\n"
+               "async def aio_helper():\n"
+               "    pass\n"),
+    "top.py": ("from .mid import helper\n\n\n"
+               "async def serve():\n"
+               "    helper()\n"),
+}
+
+
+def test_blocking_chain_names_the_whole_helper_chain(tmp_path):
+    proj = _project(tmp_path, BLOCKING_SRC)
+    cg = CallGraph(proj)
+    chain = cg.blocking_chain(_fi(proj, "pkg.mid", "helper"))
+    assert chain == ["helper", "leaf", "time.sleep"]
+
+
+def test_blocking_chain_skips_async_callees_and_exempt_names(tmp_path):
+    """Awaiting an async callee never blocks the loop, and exempted
+    memoized one-shot inits (native.get_lib's prewarmed class) are
+    treated as the dict reads they are in steady state."""
+    proj = _project(tmp_path, {
+        "x.py": ("import time\n\n\n"
+                 "async def aio():\n"
+                 "    time.sleep(1)\n\n\n"
+                 "def get_lib():\n"
+                 "    time.sleep(1)\n\n\n"
+                 "def clean():\n"
+                 "    get_lib()\n"),
+    })
+    cg = CallGraph(proj, blocking_exempt=("get_lib",))
+    assert cg.blocking_chain(_fi(proj, "pkg.x", "clean")) is None
+    # the exempt helper itself still reports its own blocking call
+    assert cg.blocking_chain(_fi(proj, "pkg.x", "get_lib")) == \
+        ["get_lib", "time.sleep"]
+    # module-qualified entries scope the exemption to ONE definition:
+    # pkg.x.get_lib matches, another module's get_lib would not
+    cg2 = CallGraph(proj, blocking_exempt=("pkg.x.get_lib",))
+    assert cg2.blocking_chain(_fi(proj, "pkg.x", "clean")) is None
+    cg3 = CallGraph(proj, blocking_exempt=("pkg.other.get_lib",))
+    assert cg3.blocking_chain(_fi(proj, "pkg.x", "clean")) == \
+        ["clean", "get_lib", "time.sleep"]
+
+
+def test_blocking_chain_survives_recursion(tmp_path):
+    proj = _project(tmp_path, {
+        "r.py": ("def ping():\n"
+                 "    pong()\n\n\n"
+                 "def pong():\n"
+                 "    ping()\n"),
+    })
+    cg = CallGraph(proj)
+    assert cg.blocking_chain(_fi(proj, "pkg.r", "ping")) is None
+
+
+def test_blocking_chain_cycle_member_not_poisoned_by_memo(tmp_path):
+    """Querying a cycle member FIRST must not cache a pruned None for
+    its partner: with a() -> b(), c(); b() -> a(); c() -> time.sleep,
+    computing chain(a) visits b while a is on the recursion stack (b's
+    only callee is pruned, no evidence).  A later fresh chain(b) query
+    must still find b -> a -> c -> time.sleep."""
+    proj = _project(tmp_path, {
+        "cyc.py": ("import time\n\n\n"
+                   "def a():\n"
+                   "    b()\n"
+                   "    c()\n\n\n"
+                   "def b():\n"
+                   "    a()\n\n\n"
+                   "def c():\n"
+                   "    time.sleep(1)\n"),
+    })
+    cg = CallGraph(proj)
+    assert cg.blocking_chain(_fi(proj, "pkg.cyc", "a")) == \
+        ["a", "c", "time.sleep"]
+    assert cg.blocking_chain(_fi(proj, "pkg.cyc", "b")) == \
+        ["b", "a", "c", "time.sleep"]
+
+
+# -- async-context inference -------------------------------------------
+
+
+CTX_SRC = {
+    "d.py": ("import asyncio\n\n"
+             "from ceph_tpu.common import lockdep\n\n\n"
+             "class D:\n"
+             "    def __init__(self):\n"
+             "        self._lock = lockdep.Lock('fx.ctx')\n\n"
+             "    async def locked(self):\n"
+             "        async with self._lock:\n"
+             "            await asyncio.sleep(0)\n\n"
+             "    async def covered(self):\n"
+             "        try:\n"
+             "            await asyncio.sleep(0)\n"
+             "        finally:\n"
+             "            await asyncio.sleep(0)\n\n"
+             "    async def shielded(self):\n"
+             "        await asyncio.shield(asyncio.sleep(0))\n"),
+}
+
+
+def test_async_context_tracks_lock_scopes(tmp_path):
+    proj = _project(tmp_path, CTX_SRC)
+    ctx = async_context(proj, _fi(proj, "pkg.d", "D.locked"))
+    kinds = {s.kind: s for s in ctx.suspensions}
+    # the async-with ENTER suspends before the lock is held…
+    assert kinds["async-with"].locks == ()
+    # …the await inside the body holds it
+    assert kinds["await"].locks == ("fx.ctx",)
+    assert kinds["await"].lock_scopes != ()
+
+
+def test_async_context_try_finally_coverage(tmp_path):
+    proj = _project(tmp_path, CTX_SRC)
+    ctx = async_context(proj, _fi(proj, "pkg.d", "D.covered"))
+    by_line = sorted(ctx.suspensions, key=lambda s: s.line)
+    assert by_line[0].in_try_finally       # the try-body await
+    assert not by_line[1].in_try_finally   # the finalbody keeps outer
+
+
+def test_async_context_shield_detection(tmp_path):
+    proj = _project(tmp_path, CTX_SRC)
+    ctx = async_context(proj, _fi(proj, "pkg.d", "D.shielded"))
+    (susp,) = ctx.suspensions
+    assert susp.shielded
+
+
+# -- atomicity windows -------------------------------------------------
+
+
+WINDOW_SRC = {
+    "w.py": ("import asyncio\n\n"
+             "from ceph_tpu.common import lockdep\n\n\n"
+             "class W:\n"
+             "    def __init__(self):\n"
+             "        self._lock = lockdep.Lock('fx.win')\n"
+             "        self.seq = 0\n\n"
+             "    async def bare(self):\n"
+             "        v = self.seq\n"
+             "        await asyncio.sleep(0)\n"
+             "        self.seq = v + 1\n\n"
+             "    async def held(self):\n"
+             "        async with self._lock:\n"
+             "            v = self.seq\n"
+             "            await asyncio.sleep(0)\n"
+             "            self.seq = v + 1\n\n"
+             "    async def split_scopes(self):\n"
+             "        async with self._lock:\n"
+             "            v = self.seq\n"
+             "        async with self._lock:\n"
+             "            self.seq = v + 1\n\n"
+             "    async def no_window(self):\n"
+             "        await asyncio.sleep(0)\n"
+             "        v = self.seq\n"
+             "        self.seq = v + 1\n"),
+}
+
+
+@pytest.mark.parametrize("qualname,n,protected", [
+    ("W.bare", 1, False),
+    ("W.held", 1, True),
+    # same lock label in two SEPARATE scopes does not protect: the
+    # suspension between the blocks runs unlocked
+    ("W.split_scopes", 1, False),
+    ("W.no_window", 0, None),
+])
+def test_atomicity_window_protection_verdicts(tmp_path, qualname, n,
+                                              protected):
+    proj = _project(tmp_path, WINDOW_SRC)
+    windows = function_atomicity_windows(proj, _fi(proj, "pkg.w",
+                                                   qualname))
+    assert len(windows) == n
+    if n:
+        (w,) = windows
+        assert w.attr == "self.seq"
+        assert w.protected is protected
+
+
+def test_await_site_map_spans_and_lock_claims(tmp_path):
+    proj = _project(tmp_path, CTX_SRC)
+    site_map = await_site_map(proj)
+    by_qual = {}
+    for (path, line), info in site_map.items():
+        assert path.endswith("d.py")
+        by_qual.setdefault(info["qualname"], set()).add(line)
+    assert "D.locked" in by_qual and "D.shielded" in by_qual
+    locked_await = [info for info in site_map.values()
+                    if info["qualname"] == "D.locked"
+                    and info["kind"] == "await"]
+    assert locked_await and all(i["locks"] == {"fx.ctx"}
+                                for i in locked_await)

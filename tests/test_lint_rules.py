@@ -37,6 +37,11 @@ RULES = [
     "sync-encode-in-async",
     "lock-order",
     "lock-no-await",
+    "await-atomicity",
+    "cancellation-unsafe-acquire",
+    "transitive-blocking-call",
+    "hot-path-copy",
+    "unused-suppression",
 ]
 
 # the dtype, plan, and encode rules are path-scoped to their
@@ -48,7 +53,11 @@ CONFIG = {"dtype_paths": ("fx_uint8",),
           "mesh_paths": ("fx_unplanned_mesh_dispatch",),
           "gather_paths": ("fx_unhedged_gather",),
           "latency_paths": ("fx_unbounded_latency_buffer",),
-          "durability_paths": ("fx_commit_before_durability",)}
+          "durability_paths": ("fx_commit_before_durability",),
+          "atomicity_paths": ("fx_await_atomicity",),
+          "cancel_paths": ("fx_cancellation_unsafe_acquire",),
+          "transitive_paths": ("fx_transitive_blocking_call",),
+          "hot_paths": ("fx_hot_path_copy",)}
 
 
 def _fixture(name: str) -> str:

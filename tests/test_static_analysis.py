@@ -1,13 +1,18 @@
 """Tier-1 gate for ceph_tpu.analysis: the whole package must be clean
-or baselined, the CLI exit-code contract must hold, and every lock
-order the RUNTIME detector observed during this test session must be
-explained by the STATIC order graph (rule lock-order) — the
-lint-time/run-time cross-check of the lockdep discipline.
+or baselined, the CLI exit-code contract must hold, and the two
+RUNTIME⊆STATIC cross-checks must hold — every lock order the runtime
+detector observed this session must be explained by the static order
+graph (rule lock-order), and every await site the deterministic-
+interleaving explorer drives a cluster through must exist in the
+static async-context map with its lock claims honoured.
 """
 
 from __future__ import annotations
 
 import asyncio
+import contextlib
+import io
+import json
 import os
 import subprocess
 import sys
@@ -17,10 +22,16 @@ import pytest
 import ceph_tpu
 from ceph_tpu.analysis import (
     analyze_paths, build_lock_graph, default_baseline_path,
-    load_baseline,
+    default_rules, load_baseline,
 )
+from ceph_tpu.analysis import cache as lint_cache
+from ceph_tpu.analysis import interleave
 from ceph_tpu.analysis.__main__ import main as lint_main
+from ceph_tpu.analysis.callgraph import await_site_map
+from ceph_tpu.analysis.findings import Finding, gating
 from ceph_tpu.common import lockdep
+
+from cluster_helpers import Cluster
 
 PKG = os.path.dirname(os.path.abspath(ceph_tpu.__file__))
 
@@ -49,7 +60,8 @@ def test_package_clean_or_baselined(package_analysis):
     findings, _ = package_analysis
     path = default_baseline_path()
     baseline = load_baseline(path) if path else None
-    new = [f for f in findings
+    # info findings are advisory worklists (hot-path-copy), not gates
+    new = [f for f in gating(findings)
            if baseline is None or f not in baseline]
     assert not new, (
         "new static-analysis findings (fix, suppress inline, or "
@@ -64,7 +76,7 @@ def test_baseline_entries_live_and_justified(package_analysis):
     path = default_baseline_path()
     assert path, "tools/lint_baseline.json missing"
     baseline = load_baseline(path)
-    stale = baseline.stale(findings)
+    stale = baseline.stale(gating(findings))
     assert not stale, f"stale baseline entries: {stale}"
     for entry in baseline.entries.values():
         assert entry.get("justification", "").strip(), (
@@ -130,3 +142,214 @@ def test_runtime_lock_edges_subset_of_static(package_analysis):
         f"runtime lock-order edges missing from the static graph "
         f"(teach ceph_tpu/analysis/lockgraph.py to see them, or "
         f"baseline with a justification): {unexplained}")
+
+
+# -- hot-path-copy worklist (ROADMAP item 2) ---------------------------
+
+
+def test_hot_path_copy_worklist_enumerates_the_data_path(
+        package_analysis):
+    """The rule's finding list IS the zero-copy worklist: it must be
+    non-empty, advisory (info severity — never a gate failure), and
+    span the msgr→OSD→ec/plan layers an op's payload crosses."""
+    findings, _ = package_analysis
+    worklist = [f for f in findings if f.rule == "hot-path-copy"]
+    assert len(worklist) >= 1
+    assert all(f.severity == "info" for f in worklist)
+    assert not gating(worklist)
+    layers = {f.path.split("/")[1] for f in worklist}
+    assert {"msg", "osd", "ec"} <= layers
+
+
+# -- CLI: --format=json round-trip, --hot-path-report, cache -----------
+
+
+def _capture_cli(argv):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = lint_main(argv)
+    return rc, buf.getvalue()
+
+
+def test_format_json_round_trips(tmp_path):
+    viol = tmp_path / "viol.py"
+    viol.write_text(
+        "import time\n\n\nasync def tick():\n    time.sleep(1)\n")
+    rc, out = _capture_cli([str(viol), "--no-baseline", "--no-cache",
+                            "--format", "json"])
+    assert rc == 1
+    records = json.loads(out)
+    assert records
+    for rec in records:
+        assert {"path", "line", "col", "rule", "fingerprint",
+                "severity", "message", "symbol", "text"} <= set(rec)
+    # records reconstruct bit-for-bit into the Findings the library
+    # API produces — CI annotation sees exactly what the gate saw
+    findings, _ = analyze_paths([str(viol)])
+    assert sorted(Finding(**r).as_dict().items() for r in records) == \
+        sorted(f.as_dict().items() for f in findings)
+
+
+def test_hot_path_report_lists_worklist_and_exits_zero(tmp_path):
+    viol = tmp_path / "copy.py"
+    viol.write_text("def f(payload):\n    return bytes(payload)\n")
+    rc, out = _capture_cli(
+        [str(viol), "--no-cache", "--hot-path-report",
+         "--format", "json"])
+    assert rc == 0
+    records = json.loads(out)
+    # scoped to the production hot path by default: a random file is
+    # not on the worklist...
+    assert records == []
+    # ...but the package IS (count asserted >= 1: the ROADMAP item 2
+    # worklist the CLI hands to the zero-copy PR)
+    pkg_dir = os.path.dirname(os.path.abspath(ceph_tpu.__file__))
+    rc, out = _capture_cli([os.path.join(pkg_dir, "msg"), "--no-cache",
+                            "--hot-path-report", "--format", "json"])
+    assert rc == 0
+    records = json.loads(out)
+    assert len(records) >= 1
+    assert all(r["rule"] == "hot-path-copy" for r in records)
+
+
+def test_cache_replays_only_bit_identical_trees(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import time\n\n\nasync def tick():\n    time.sleep(1)\n")
+    files = lint_cache.scan_hashes([str(src)])
+    findings, _ = analyze_paths([str(src)])
+    cpath = str(tmp_path / ".lint_cache.json")
+    rule_names = sorted(default_rules())
+    lint_cache.save(cpath, files, rule_names, findings)
+
+    replayed, changed = lint_cache.load(cpath, files, rule_names)
+    assert changed == []
+    assert [f.as_dict() for f in replayed] == \
+        [f.as_dict() for f in findings]
+
+    # an edit invalidates the whole result (interprocedural rules can
+    # move findings across modules) and names the changed file
+    src.write_text(src.read_text() + "# edited\n")
+    files2 = lint_cache.scan_hashes([str(src)])
+    replayed2, changed2 = lint_cache.load(cpath, files2, rule_names)
+    assert replayed2 is None
+    assert changed2 == [os.path.abspath(str(src))]
+
+    # a different rule subset is a structural miss
+    replayed3, _ = lint_cache.load(cpath, files, ["async-blocking"])
+    assert replayed3 is None
+
+
+def test_cli_cache_scope_and_no_cache_flag(tmp_path, monkeypatch):
+    """The cache serves the default whole-package gate invocation:
+    explicit path subsets never touch it (they would evict the warm
+    whole-tree entry), and --no-cache bypasses it entirely."""
+    import ceph_tpu.analysis.__main__ as cli
+    viol = tmp_path / "viol.py"
+    viol.write_text(
+        "import time\n\n\nasync def tick():\n    time.sleep(1)\n")
+    cpath = tmp_path / ".lint_cache.json"
+    monkeypatch.setattr(lint_cache, "default_cache_path",
+                        lambda: str(cpath))
+    # explicit path: no cache involvement
+    rc, _ = _capture_cli([str(viol), "--no-baseline"])
+    assert rc == 1
+    assert not cpath.exists()
+    # default-path run (monkeypatched to the tmp file): writes it...
+    monkeypatch.setattr(cli, "_default_paths", lambda: [str(viol)])
+    rc, _ = _capture_cli(["--no-baseline"])
+    assert rc == 1
+    assert cpath.exists()
+    # ...and a warm rerun replays it to the same verdict
+    rc, _ = _capture_cli(["--no-baseline"])
+    assert rc == 1
+    # --no-cache neither reads nor writes
+    cpath.unlink()
+    rc, _ = _capture_cli(["--no-baseline", "--no-cache"])
+    assert rc == 1
+    assert not cpath.exists()
+
+
+# -- deterministic-interleaving explorer: runtime ⊆ static -------------
+
+# Observed await sites accepted WITHOUT a static-map witness, each
+# with its justification (the escape hatch for coroutine shapes the
+# AST async-context pass cannot see).  Keep empty unless a scenario
+# demonstrably drives such a site.
+RUNTIME_SITE_BASELINE: dict = {}
+
+
+async def _interleave_scenario():
+    """A real cluster workload with genuine task contention: mon + 3
+    OSDs over loopback msgr, concurrent client writes and reads.  Any
+    client-visible error fails the test — the zero-client-error
+    invariant under every explored schedule."""
+    cluster = Cluster(num_osds=3)
+    await cluster.start()
+    try:
+        await cluster.client.create_replicated_pool(
+            "ilv", size=2, pg_num=4)
+        ioctx = cluster.client.open_ioctx("ilv")
+        payloads = {f"obj-{i}": bytes([65 + i]) * (4096 + i)
+                    for i in range(6)}
+        await asyncio.gather(*(ioctx.write_full(name, data)
+                               for name, data in payloads.items()))
+        reads = await asyncio.gather(*(ioctx.read(name)
+                                       for name in payloads))
+        assert list(reads) == list(payloads.values())
+    finally:
+        await cluster.stop()
+
+
+@pytest.fixture(scope="module")
+def static_await_sites(package_analysis):
+    _, project = package_analysis
+    return await_site_map(project)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_interleaved_cluster_runtime_subset_of_static(
+        seed, static_await_sites):
+    """Drive the cluster through seeded wakeup-order permutations and
+    cross-check runtime ⊆ static: every (file, line) a task was
+    actually suspended at must be a suspension point in the analyzer's
+    async-context map, and where the map claims a lockdep class is
+    held at that point, the runtime held-stack must agree — otherwise
+    the atomicity verdicts rest on a map that is blind to real
+    schedules."""
+    interleave.clear_records()
+    was = lockdep.enabled
+    lockdep.enabled = True
+    try:
+        with interleave.explore(seed=seed):
+            asyncio.run(asyncio.wait_for(_interleave_scenario(), 120))
+    finally:
+        lockdep.enabled = was
+    records = interleave.records()
+    sites = interleave.await_sites()
+    # non-vacuous: the permuted schedules really drove package code
+    # (a site is recorded only when >=2 task wakeups were ready in the
+    # same loop iteration — genuine contention, not mere activity)
+    assert len(sites) >= 5, f"explorer observed only {sites}"
+
+    unexplained = sorted(
+        s for s in sites
+        if s not in static_await_sites
+        and s not in RUNTIME_SITE_BASELINE)
+    assert not unexplained, (
+        "await sites observed at runtime but absent from the static "
+        "async-context map (callgraph.py is blind to these):\n"
+        + "\n".join(f"  {p}:{ln}" for p, ln in unexplained))
+
+    lock_violations = []
+    for r in records:
+        info = static_await_sites.get((r.path, r.line))
+        if info is None:
+            continue
+        claimed = info["locks"]
+        if claimed and not claimed <= set(r.locks):
+            lock_violations.append(
+                (r.path, r.line, sorted(claimed), list(r.locks)))
+    assert not lock_violations, (
+        "static lock claims not honoured at runtime: "
+        f"{lock_violations[:5]}")

@@ -472,9 +472,17 @@ def test_tail_exemplar_attributes_straggler_subread():
                          if oid in s["name"])
             trace_id = cspan["trace_id"]
 
-            rc, doc = await cluster.client.osd_command(
-                primary, {"prefix": "dump_op_trace",
-                          "trace_id": trace_id})
+            # retention runs in the op handler's finally AFTER the
+            # reply is sent (the design: the client never waits on the
+            # exemplar pipeline), so a fast client can query before
+            # the primary's finish hook lands — poll briefly
+            for _ in range(50):
+                rc, doc = await cluster.client.osd_command(
+                    primary, {"prefix": "dump_op_trace",
+                              "trace_id": trace_id})
+                if rc == 0 and "error" not in doc:
+                    break
+                await asyncio.sleep(0.01)
             assert rc == 0, doc
             assert "error" not in doc, doc
             cp = doc["critical_path"]
