@@ -67,6 +67,7 @@ def _emit_contract(value: Optional[float],
                    durability: Optional[dict] = None,
                    mesh: Optional[dict] = None,
                    trace: Optional[dict] = None,
+                   group_commit: Optional[dict] = None,
                    truncated: bool = False) -> None:
     """Print the one-line JSON driver contract, exactly once, before
     any optional extended benches run — a wedged tunnel or a crashed
@@ -108,6 +109,7 @@ def _emit_contract(value: Optional[float],
             "durability": durability,
             "mesh": mesh,
             "trace": trace,
+            "group_commit": group_commit,
             "truncated": bool(truncated),
         }), flush=True)
 
@@ -1163,6 +1165,206 @@ def _service_probe() -> Optional[dict]:
             os.environ["CEPH_TPU_FUSE_MIN_BYTES"] = prev
 
 
+def _group_commit_probe() -> Optional[dict]:
+    """Pre-contract probe of the TPUStore group-commit lane
+    (os/groupcommit.py): N concurrent durable writes through the
+    GroupCommitter must buy FEWER barriers than writers (fsyncs and
+    kv sync commits < N) with bit-exact readback, while the kill
+    switch leg pays exactly one commit per txn (behavior parity).
+    Counters land in the contract line's `group_commit` key; None
+    (with a stderr note) when the probe cannot run.
+
+    Contract-first discipline: runs before _emit_contract under a
+    hard asyncio.wait_for, on a throwaway store in a tempdir."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    from ceph_tpu.os import ObjectId, Transaction
+    from ceph_tpu.os.groupcommit import GroupCommitter
+    from ceph_tpu.os.tpustore import TPUStore
+
+    if _remaining() < 0:
+        print("# group commit probe skipped: budget exhausted",
+              file=sys.stderr)
+        return None
+    probe_timeout = float(os.environ.get(
+        "CEPH_TPU_BENCH_GC_PROBE_TIMEOUT", "60"))
+    n = 16
+    workdir = tempfile.mkdtemp(prefix="bench-gc-")
+    prev = os.environ.get("CEPH_TPU_GROUP_COMMIT")
+    try:
+        os.environ.pop("CEPH_TPU_GROUP_COMMIT", None)
+        store = TPUStore(os.path.join(workdir, "s"))
+        store.mkfs()
+        store.mount()
+        t = Transaction()
+        t.create_collection("cc")
+        store.queue_transaction(t)
+        payloads = {f"o{i}": bytes([i]) * 65536 for i in range(n)}
+
+        def txn(oid: str, data: bytes) -> Transaction:
+            t = Transaction()
+            t.write("cc", ObjectId(oid), 0, len(data), data)
+            return t
+
+        async def leg(suffix: str):
+            gc = GroupCommitter(store, window_ms=1.0)
+            kv0, fs0 = store.perf["kv_commits"], \
+                store.perf["block_fsyncs"]
+            await asyncio.gather(
+                *(gc.queue_transaction(txn(o + suffix, d))
+                  for o, d in payloads.items()))
+            await gc.stop()
+            return (store.perf["kv_commits"] - kv0,
+                    store.perf["block_fsyncs"] - fs0, gc.stats())
+
+        kv_on, fs_on, st = asyncio.run(
+            asyncio.wait_for(leg(""), probe_timeout))
+        bitexact = int(all(
+            store.read("cc", ObjectId(o)) == d
+            for o, d in payloads.items()))
+        os.environ["CEPH_TPU_GROUP_COMMIT"] = "0"
+        kv_off, fs_off, _st_off = asyncio.run(
+            asyncio.wait_for(leg("-x"), probe_timeout))
+        store.umount()
+        return {
+            "writers": n,
+            "kv_commits": kv_on,
+            "fsyncs": fs_on,
+            "kv_commits_inline": kv_off,
+            "fsyncs_inline": fs_off,
+            "fsyncs_lt_writers": int(fs_on < n),
+            "bitexact": bitexact,
+            "batches": st["batches"],
+            "txns_per_batch_avg": st["txns_per_batch_avg"],
+            "fsyncs_saved": store.perf["gc_fsyncs_saved"],
+        }
+    except Exception as e:
+        print(f"# group commit probe failed: {e!r}", file=sys.stderr)
+        return None
+    finally:
+        if prev is None:
+            os.environ.pop("CEPH_TPU_GROUP_COMMIT", None)
+        else:
+            os.environ["CEPH_TPU_GROUP_COMMIT"] = prev
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def bench_group_commit() -> dict:
+    """p50/p99 end-to-end write latency with TPUStore group commit ON
+    vs OFF (CEPH_TPU_GROUP_COMMIT=0) through a persistent-store
+    cluster, with the win attributed stage-by-stage: the per-OSD
+    critical-path histograms' journal-family stages (kv_commit_wait /
+    kv_commit / fsync) ride along for each mode so a drop in the
+    commit stage cannot hide a regression elsewhere, and the barrier
+    counters prove fsyncs-per-N-concurrent-writes < N."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_helpers import Cluster, tpustore_factory
+
+    n_ops = 24 if _SMOKE else 48
+    osize = 32 << 10
+    payload = np.random.default_rng(41).integers(
+        0, 256, osize, dtype=np.uint8).tobytes()
+    profile = {"plugin": "ec_jax", "technique": "reed_sol_van",
+               "k": "2", "m": "1", "crush-failure-domain": "osd"}
+    journal_stages = ("kv_commit_wait", "kv_commit", "fsync")
+
+    async def run_mode() -> dict:
+        workdir = tempfile.mkdtemp(prefix="bench-gc-cluster-")
+        cluster = Cluster(num_osds=3, osds_per_host=3,
+                          store_factory=tpustore_factory(workdir),
+                          persistent=True,
+                          osd_config={"osd_heartbeat_interval": 3.0,
+                                      "osd_heartbeat_grace": 20.0})
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "gcb", profile=profile, pg_num=8)
+            io = cluster.client.open_ioctx("gcb")
+            await io.write_full("warm", payload)  # connections warm
+            lats: list = []
+
+            async def one(i: int) -> None:
+                t0 = time.perf_counter()
+                await io.write_full(f"w{i}", payload)
+                lats.append(time.perf_counter() - t0)
+
+            kv0 = sum(o.store.perf["kv_commits"]
+                      for o in cluster.osds.values())
+            fs0 = sum(o.store.perf["block_fsyncs"]
+                      for o in cluster.osds.values())
+            await asyncio.gather(*(one(i) for i in range(n_ops)))
+            kv = sum(o.store.perf["kv_commits"]
+                     for o in cluster.osds.values()) - kv0
+            fs = sum(o.store.perf["block_fsyncs"]
+                     for o in cluster.osds.values()) - fs0
+            from ceph_tpu.loadgen.stats import LatencyHistogram
+
+            stages: dict = {}
+            for osd in cluster.osds.values():
+                for stage, h in osd.tracer.stage_hist.items():
+                    if stage not in journal_stages:
+                        continue
+                    agg = stages.setdefault(stage,
+                                            LatencyHistogram())
+                    agg.merge(h)
+            stage_out = {}
+            for stage, h in sorted(stages.items()):
+                p50 = h.percentile(0.5)
+                stage_out[stage] = {
+                    "count": h.count,
+                    "p50_ms": round(p50 * 1e3, 3) if p50 else 0.0,
+                    "self_s": round(h.total, 4),
+                }
+            lats.sort()
+            rb = await io.read("w0")
+            return {
+                "p50_ms": round(lats[len(lats) // 2] * 1e3, 3),
+                "p99_ms": round(
+                    lats[min(len(lats) - 1,
+                             int(len(lats) * 0.99))] * 1e3, 3),
+                "kv_commits": kv,
+                "fsyncs": fs,
+                "stages": stage_out,
+                "bitexact": int(bytes(rb) == payload),
+            }
+        finally:
+            await cluster.stop()
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    prev = os.environ.get("CEPH_TPU_GROUP_COMMIT")
+    try:
+        os.environ.pop("CEPH_TPU_GROUP_COMMIT", None)
+        on = asyncio.run(run_mode())
+        os.environ["CEPH_TPU_GROUP_COMMIT"] = "0"
+        off = asyncio.run(run_mode())
+    finally:
+        if prev is None:
+            os.environ.pop("CEPH_TPU_GROUP_COMMIT", None)
+        else:
+            os.environ["CEPH_TPU_GROUP_COMMIT"] = prev
+    return {
+        "group_commit_writes": n_ops,
+        "group_commit_p50_on_ms": on["p50_ms"],
+        "group_commit_p99_on_ms": on["p99_ms"],
+        "group_commit_p50_off_ms": off["p50_ms"],
+        "group_commit_p99_off_ms": off["p99_ms"],
+        "group_commit_kv_commits_on": on["kv_commits"],
+        "group_commit_kv_commits_off": off["kv_commits"],
+        "group_commit_fsyncs_on": on["fsyncs"],
+        "group_commit_fsyncs_off": off["fsyncs"],
+        "group_commit_bitexact": on["bitexact"] and off["bitexact"],
+        "group_commit_stages_on": on["stages"],
+        "group_commit_stages_off": off["stages"],
+    }
+
+
 def bench_write_path() -> dict:
     """Concurrent-writes throughput through the OSD op engine with the
     micro-batching encode service on vs off: 32 concurrent 256 KiB
@@ -1818,6 +2020,10 @@ def main() -> None:
     # reconstructs a hand-built tree, spans-on-vs-off overhead at
     # sample rate 0 through a live loopback cluster
     trace_counters = _trace_probe()
+    # group-commit probe (before the contract): N concurrent durable
+    # writes share barriers (fsyncs < N), bit-exact, kill switch pays
+    # one commit per txn
+    group_commit_counters = _group_commit_probe()
 
     # the driver contract line, before every optional/extended bench:
     # a wedge below this point can cost detail rows, never the bench
@@ -1830,6 +2036,7 @@ def main() -> None:
                    durability=durability_counters,
                    mesh=mesh_counters,
                    trace=trace_counters,
+                   group_commit=group_commit_counters,
                    truncated=skip_optional)
 
     # decode sweep over 1..m erasures (the reference benchmark sweeps
@@ -1937,6 +2144,19 @@ def main() -> None:
         except Exception as e:
             print(f"# trace bench failed: {e!r}", file=sys.stderr)
 
+    # group-commit section: p50/p99 write latency with the TPUStore
+    # commit lane on vs off, journal-stage self-times per mode, and
+    # the fsyncs-per-N-writers barrier counters
+    group_commit_section: dict = {}
+    if skip_optional:
+        skipped_sections.append("group_commit")
+    else:
+        try:
+            group_commit_section = bench_group_commit()
+        except Exception as e:
+            print(f"# group commit bench failed: {e!r}",
+                  file=sys.stderr)
+
     # degraded-mode section: breakers forced open -> host-path
     # throughput delta (what a wedged accelerator costs while the
     # breaker holds it out of the hot path)
@@ -2006,6 +2226,7 @@ def main() -> None:
         **tier_section,
         **tail_section,
         **trace_section,
+        **group_commit_section,
         **mesh_section,
         **degraded_section,
         **load_section,
@@ -2019,6 +2240,7 @@ def main() -> None:
         "durability": durability_counters,
         "mesh": mesh_counters,
         "trace": trace_counters,
+        "group_commit": group_commit_counters,
         "host_cores": os.cpu_count(),
         "encode_ms_per_batch": t_enc * 1e3,
         "k": k, "m": m, "chunk_bytes": chunk, "batch": batch,

@@ -257,6 +257,12 @@ _HOT_PATHS = ("ceph_tpu/msg/", "ceph_tpu/osd/daemon.py",
 _BUF_NAME_RE = re.compile(
     r"data|payload|buf|blob|chunk|shard|stream|frame|part", re.I)
 
+# constructors whose result slices ZERO-COPY: a name bound to one of
+# these is a view, and slicing it is exactly the discipline this
+# rule's findings prescribe — flagging it would re-list every
+# converted site forever
+_VIEW_CTORS = {"memoryview", "StridedBuf", "toreadonly", "bytes_view"}
+
 
 def _recv_name(node: ast.AST) -> str:
     if isinstance(node, ast.Name):
@@ -264,6 +270,27 @@ def _recv_name(node: ast.AST) -> str:
     if isinstance(node, ast.Attribute):
         return node.attr
     return ""
+
+
+def _view_names(mod) -> dict:
+    """(enclosing qualname) -> names assigned from a view constructor
+    (memoryview(...), StridedBuf(...), .toreadonly(), .bytes_view())
+    anywhere in that scope.  Scope-level, not flow-sensitive — good
+    enough for a worklist rule: a name that is EVER a view in a
+    function is overwhelmingly view-typed at its slice sites."""
+    out: dict = {}
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        name = dotted(node.value.func) or ""
+        if name.split(".")[-1] not in _VIEW_CTORS:
+            continue
+        scope = _enclosing_qualname(mod, node)
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out.setdefault(scope, set()).add(t.id)
+    return out
 
 
 def rule_hot_path_copy(a: Analyzer) -> None:
@@ -278,6 +305,7 @@ def rule_hot_path_copy(a: Analyzer) -> None:
         rel = mod.relpath.replace("\\", "/")
         if not any(p in rel for p in paths):
             continue
+        views = _view_names(mod)
         for node in ast.walk(mod.tree):
             msg = None
             if isinstance(node, ast.Call):
@@ -304,7 +332,9 @@ def rule_hot_path_copy(a: Analyzer) -> None:
                     node.slice, ast.Slice) and isinstance(
                     node.ctx, ast.Load):
                 name = _recv_name(node.value)
-                if name and _BUF_NAME_RE.search(name):
+                if name and _BUF_NAME_RE.search(name) and \
+                        name not in views.get(
+                            _enclosing_qualname(mod, node), ()):
                     msg = (f"slicing `{name}` copies the byte range "
                            "(a memoryview slice is zero-copy)")
             if msg is None:

@@ -71,7 +71,9 @@ class MethodContext:
                                           self.oid, offset, length)
         if rc != 0:
             raise ClsError(rc, "read")
-        return data
+        # the read path hands out zero-copy views; class methods get
+        # REAL bytes (they json-decode, hash, and cache the result)
+        return data if isinstance(data, bytes) else bytes(data)
 
     async def stat(self) -> Dict[str, Any]:
         rc, out = await self._d._op_stat(self._state, self._pool,

@@ -169,7 +169,9 @@ class Connection:
             if comp is not None:
                 import struct as _struct
 
-                blob, cmsg = comp.compress(bytes(payload))
+                # payload is the encoder's bytes: the codec walks it
+                # directly, no defensive copy
+                blob, cmsg = comp.compress(payload)
                 if len(blob) + 4 < len(payload):
                     payload = _struct.pack(
                         "<i", -1 if cmsg is None else cmsg) + blob
@@ -599,7 +601,7 @@ class Messenger:
         else:
             base = key
             if msg.ticket:
-                chk = auth.check_ticket(self.secret, bytes(msg.ticket))
+                chk = auth.check_ticket(self.secret, msg.ticket)
                 if chk is None:
                     raise frames.FrameError("invalid or expired"
                                             " ticket")
@@ -660,11 +662,11 @@ class Messenger:
 
                     try:
                         (cmsg,) = _struct.unpack_from("<i", payload)
-                        # slice through a memoryview: `payload[4:]`
-                        # would copy the whole frame once just to feed
-                        # bytes() a second copy
+                        # hand the codec a VIEW past the header: the
+                        # decompressor walks the frame buffer in
+                        # place — zero copies between socket and codec
                         payload = comp.decompress(
-                            bytes(memoryview(payload)[4:]),
+                            memoryview(payload)[4:],
                             None if cmsg < 0 else cmsg)
                     except frames.FrameError:
                         raise

@@ -77,8 +77,18 @@ def encode_frame_parts(tag: int, seq: int, payload: bytes,
 
 def encode_frame(tag: int, seq: int, payload: bytes,
                  flags: int = 0, key=None, role: bytes = b"") -> bytes:
-    return b"".join(encode_frame_parts(tag, seq, payload,
-                                       flags=flags, key=key, role=role))
+    """Whole-frame convenience form (tests, sniffers).  The product
+    path writes the parts straight to the socket
+    (Connection._send_signed) and never pays this join."""
+    head, body, tail = encode_frame_parts(tag, seq, payload,
+                                          flags=flags, key=key,
+                                          role=role)
+    out = bytearray(head)
+    out += body
+    out += tail
+    # deliberate copy: this convenience form exists to hand tests one
+    # contiguous frame  # lint: disable=hot-path-copy
+    return bytes(out)
 
 
 def check_signature(key, flags: int, pre_buf: bytes,
@@ -92,8 +102,9 @@ def check_signature(key, flags: int, pre_buf: bytes,
         return
     if not flags & FLAG_SIGNED:
         raise FrameError("unsigned frame from peer (auth required)")
-    if not auth.verify(key, sig, role, pre_buf[:PREAMBLE.size],
-                       payload):
+    # memoryview slice: the HMAC walks the view; no preamble copy
+    if not auth.verify(key, sig, role,
+                       memoryview(pre_buf)[:PREAMBLE.size], payload):
         raise FrameError("frame signature mismatch (wrong key?)")
 
 
@@ -103,7 +114,8 @@ def decode_preamble(buf: bytes) -> Tuple[int, int, int, int]:
     (crc,) = CRC.unpack_from(buf, PREAMBLE.size)
     if magic != MAGIC:
         raise FrameError(f"bad magic {magic:#x}")
-    if crc32c(0xFFFFFFFF, buf[:PREAMBLE.size]) != crc:
+    # memoryview slice: crc32c walks the view; no preamble copy
+    if crc32c(0xFFFFFFFF, memoryview(buf)[:PREAMBLE.size]) != crc:
         raise FrameError("preamble crc mismatch")
     return tag, flags, seq, length
 

@@ -631,7 +631,10 @@ class MOSDSubReadReply(Message):
     def decode(cls, data: bytes) -> "MOSDSubReadReply":
         dec = Decoder(data)
         struct_v = dec.start(cls.VERSION)
-        msg = cls(dec.u64(), dec.s32(), dec.bytes(),
+        # bulk shard payload stays a VIEW of the frame buffer: the
+        # primary's gather/decode path slices and CRCs it in place
+        # (k shards per EC read — the copy here was per-shard)
+        msg = cls(dec.u64(), dec.s32(), dec.bytes_view(),
                   dec.map(Decoder.string, Decoder.bytes), dec.s32())
         if struct_v >= 2:
             msg.omap = dec.map(Decoder.string, Decoder.bytes)

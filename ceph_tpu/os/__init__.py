@@ -149,6 +149,26 @@ class ObjectStore:
         """Apply atomically; run on_commit callbacks after durability."""
         raise NotImplementedError
 
+    def submit_batch(self, txns: List[Transaction]
+                     ) -> List[Optional[Exception]]:
+        """Group commit: apply a FIFO batch of transactions, sharing
+        durability barriers where the engine can (TPUStore merges the
+        KV batches into ONE sync commit and the direct writes into ONE
+        block fsync).  Per-txn outcome list: None = committed (its
+        on_commit callbacks have fired), an Exception = that txn
+        failed and nothing of it was applied.  The base implementation
+        is the semantic reference: each txn commits individually, in
+        order — engines may amortize barriers but must not change
+        which states are durable-visible at each ack."""
+        results: List[Optional[Exception]] = []
+        for txn in txns:
+            try:
+                self.queue_transaction(txn)
+                results.append(None)
+            except Exception as e:
+                results.append(e)
+        return results
+
     # -- reads ------------------------------------------------------------
 
     def read(self, cid: str, oid: ObjectId, offset: int = 0,

@@ -488,18 +488,31 @@ class CrashSweep:
         # model snapshots: snapshots[i] = observable state after txn i
         # (snapshots[0] = post-setup state)
         self.snapshots: List[Dict] = []
+        # cumulative txn count at each sync commit boundary: with
+        # group commit (record(batch=K)) one sync covers K txns, so
+        # the durable ceiling at sync j is _sync_txns[j-1], not j
+        self._sync_txns: List[int] = []
         self.base_block: bytes = b""
         self.base_kv: List[Tuple[str, bytes, bytes]] = []
 
     # -- recording run -----------------------------------------------------
 
     def record(self, workload: Optional[Callable] = None,
-               txns: int = 24, seed: int = 0) -> None:
+               txns: int = 24, seed: int = 0,
+               batch: int = 1) -> None:
         """Run the workload once on a recording store and a MemStore
         model in lockstep, keeping the trace and per-txn model
         snapshots.  Recording starts after setup (mkfs + collection),
-        whose durable state becomes the synthesis base — so txn
-        numbering and the trace's sync commits stay 1:1."""
+        whose durable state becomes the synthesis base.
+
+        batch > 1 records through the GROUP-COMMIT path: every K txns
+        ride ONE store.submit_batch (one sync commit, shared fsync,
+        per-txn acks after the shared barrier) — the merged batch must
+        still be a legal trace, txns cut mid-window must vanish
+        WHOLESALE (none acked), and acked txns must never vanish.
+        The model still applies per txn, so snapshots stay per-txn
+        and _sync_txns maps each sync commit to the txn count it made
+        durable."""
         live_dir = _os.path.join(self.workdir, "live")
         if _os.path.exists(live_dir):
             shutil.rmtree(live_dir)
@@ -521,14 +534,26 @@ class CrashSweep:
         self.base_kv = _dump_kv(store._kv)
         store.crashlog.events.clear()
         self.snapshots = [snapshot_store(model)]
-        for i, txn in enumerate(
-                (workload or default_workload)(txns, seed)):
+        self._sync_txns = []
+        batch = max(int(batch), 1)
+        window: List[Transaction] = []
+        work = list((workload or default_workload)(txns, seed))
+        for i, txn in enumerate(work):
             txn.register_on_commit(
                 lambda i=i: store.crashlog.mark(("ack", i + 1)))
             mtxn = Transaction()
             mtxn.ops = list(txn.ops)
             model.queue_transaction(mtxn)
-            store.queue_transaction(txn)
+            window.append(txn)
+            if len(window) >= batch or i == len(work) - 1:
+                if len(window) == 1:
+                    store.queue_transaction(window[0])
+                else:
+                    errs = [e for e in store.submit_batch(window) if e]
+                    if errs:
+                        raise errs[0]
+                self._sync_txns.append(i + 1)
+                window = []
             self.snapshots.append(snapshot_store(model))
         self.events = list(store.crashlog.events)
         store.umount()
@@ -571,7 +596,11 @@ class CrashSweep:
         for ev in self.events[:cut]:
             if ev[0] == EV_KV and ev[2]:
                 syncs += 1
-                ceiling = syncs
+                # one sync commit may cover a whole group-commit
+                # batch: the ceiling is the txn count that sync made
+                # durable (identity when recorded un-batched)
+                ceiling = self._sync_txns[syncs - 1] \
+                    if syncs <= len(self._sync_txns) else syncs
             elif ev[0] == EV_MARK and isinstance(ev[1], tuple) \
                     and ev[1][0] == "ack":
                 floor = max(floor, ev[1][1])
@@ -641,11 +670,14 @@ class CrashSweep:
             txns: int = 24, seed: int = 0,
             max_points: Optional[int] = None,
             stride: int = 1, torn: bool = True,
-            double_crash: bool = True) -> Dict[str, Any]:
+            double_crash: bool = True,
+            batch: int = 1) -> Dict[str, Any]:
         """The sweep: record, then explore.  `stride`/`max_points`
         bound smoke runs (tier-1 sizes via CEPH_TPU_CRASH_SWEEP_*);
+        batch > 1 records through submit_batch (group commit armed);
         returns {points, violations, double_crash_points, ...}."""
-        self.record(workload=workload, txns=txns, seed=seed)
+        self.record(workload=workload, txns=txns, seed=seed,
+                    batch=batch)
         img = _os.path.join(self.workdir, "img")
         points = 0
         dc_points = 0

@@ -72,6 +72,7 @@ from ceph_tpu.msg.messages import (
 )
 from ceph_tpu.ops import checksum as cks
 from ceph_tpu.os import ObjectId, ObjectStore, Transaction
+from ceph_tpu.os.groupcommit import GroupCommitter
 from ceph_tpu.os.memstore import MemStore
 from ceph_tpu.osd import ec_util
 from ceph_tpu.osd.admission import AdmissionGate, SHED
@@ -318,6 +319,14 @@ class OSDDaemon:
         self._hb_mute_until = 0.0
         self.store = store if store is not None else MemStore()
         self._own_store = store is None
+        # group commit (os/groupcommit.py): concurrent durable txns
+        # share ONE kv sync commit + ONE block fsync through a
+        # kv_sync_thread-style commit lane; engages only on stores
+        # that amortize barriers (TPUStore), inline otherwise.  Kill
+        # switches CEPH_TPU_GROUP_COMMIT=0 / osd_group_commit_enable
+        self.committer = GroupCommitter(self.store,
+                                        who=f"osd.{osd_id}",
+                                        config=self.config)
         self.osdmap: Optional[OSDMap] = None
         self.pgs: Dict[PgId, PGState] = {}
         # pg_num per pool as of the last map processed: growth triggers
@@ -633,6 +642,16 @@ class OSDDaemon:
         if callable(pc):
             out["store"] = {k: v for k, v in pc().items()
                             if isinstance(v, (int, float))}
+        # group commit: batches / txns-per-batch histogram / window-
+        # vs-budget flushes (fsyncs_saved rides the store section as
+        # gc_fsyncs_saved) — ceph_osd_group_commit_* rows
+        gc = self.committer.stats()
+        out["group_commit"] = {
+            k: (int(v) if isinstance(v, bool) else v)
+            for k, v in gc.items()
+            if isinstance(v, (bool, int, float))}
+        out["group_commit"]["txns_per_batch_hist"] = \
+            dict(gc["txns_per_batch_hist"])
         # op tracker: lifetime op count, in-flight gauge, slow-op and
         # tail-exemplar totals
         out["op_tracker"] = self.op_tracker.perf()
@@ -678,6 +697,7 @@ class OSDDaemon:
             "mounted": bool(getattr(self.store, "_mounted", True)),
             "statfs": self.store.statfs(),
             "perf": pc() if callable(pc) else {},
+            "group_commit": self.committer.stats(),
         }
 
     def _qos_perf(self) -> Dict[str, Any]:
@@ -847,6 +867,9 @@ class OSDDaemon:
         # after the scheduler drained: no new client ops enqueue, and
         # any encode futures still in flight resolve before teardown
         await self.encode_service.stop()
+        # flush the group-commit window: every acked txn is durable
+        # and no caller is stranded on an unresolved commit future
+        await self.committer.stop()
         if self._admin_socket is not None:
             # shutdown joins the serve thread: keep that wait OFF the
             # shared event loop (co-hosted daemons keep running)
@@ -875,6 +898,12 @@ class OSDDaemon:
             task.cancel()
         await self.scheduler.stop()
         await self.encode_service.stop()
+        # drain the commit lane even on crash-style teardown: an
+        # ACKED txn sitting in a worker-thread batch must reach the
+        # store before the harness power-cuts it (unacked window
+        # txns flush too — they simply commit unacked, which the
+        # crash model allows; acked-but-lost is what it forbids)
+        await self.committer.stop()
         for ps in self.pgs.values():
             if ps.peering_task is not None:
                 ps.peering_task.cancel()
@@ -1258,6 +1287,12 @@ class OSDDaemon:
         from ceph_tpu.osd.osdmap import _calc_mask
         from ceph_tpu.osd.pg_log import PGInfo
 
+        # total-order barrier: the split both READS pgmeta from the
+        # store and re-stages it, so any client txn still in the
+        # group-commit window must land first — and because this
+        # function never awaits, nothing can slip into the window
+        # while it runs
+        self.committer.flush_sync()
         mask = _calc_mask(new_num)
         if pool.type == TYPE_ERASURE:
             shard_list = list(
@@ -1791,7 +1826,11 @@ class OSDDaemon:
                     self.perf["recovery_installs"] += 1
                 plog.missing.pop(msg.oid, None)
                 plog.stage(t, cid)
-                self.store.queue_transaction(t)
+                # replica-side group commit: concurrent sub-writes on
+                # this shard share one barrier (safe under the
+                # per-(shard,object) lock — the await resolves only
+                # when THIS txn is durable, so acks stay honest)
+                await self.committer.queue_transaction(t)
         except _SkipApply:
             pass
         except Exception:
@@ -1938,7 +1977,9 @@ class OSDDaemon:
         if not self.store.collection_exists(cid):
             t.create_collection(cid)
         plog.stage(t, cid)
-        self.store.queue_transaction(t)
+        # peering barrier: the adopted log must not reorder around an
+        # open group-commit window (commit_now drains, then commits)
+        await self.committer.commit_now(t)
         info = plog.info.to_dict()
         info["missing"] = {k: list(v) for k, v in plog.missing.items()}
         await conn.send(MPGLogMsg(msg.tid, msg.pg, msg.shard, info, [],
@@ -2017,7 +2058,8 @@ class OSDDaemon:
                 if not self.store.collection_exists(cid):
                     t.create_collection(cid)
                 plog.stage(t, cid)
-                self.store.queue_transaction(t)
+                # peering barrier: drain the window, commit inline
+                await self.committer.commit_now(t)
             # 4. push auth log to peers; collect their missing sets
             state.peer_missing = {}
             auth_wire_info = plog.info.to_dict()
@@ -2939,7 +2981,10 @@ class OSDDaemon:
                         cid = self._cid(pg, shard)
                         t.remove(cid, ObjectId(oid))
                         t.remove(cid, ObjectId(RB_PREFIX + oid))
-                        self.store.queue_transaction(t)
+                        # scrub barrier: bypass the window (drain +
+                        # inline) so the purge cannot reorder around
+                        # in-window client txns
+                        await self.committer.commit_now(t)
                         log.info("osd.%d: scrub purged deleted"
                                  " straggler %s/%s (shard %d)",
                                  self.osd_id, pg, oid, shard)
@@ -3054,8 +3099,9 @@ class OSDDaemon:
                 plog.missing[oid] = version
                 # DURABLE missing marker: a crash before recovery must
                 # resume the repair, not strand reduced redundancy
+                # (scrub barrier: drained bypass, never windowed)
                 plog.stage(t, my_cid)
-                self.store.queue_transaction(t)
+                await self.committer.commit_now(t)
             else:
                 state.peer_missing.setdefault(shard_key, {})[oid] = \
                     version
@@ -3170,7 +3216,8 @@ class OSDDaemon:
         if not self.store.collection_exists(cid):
             t.create_collection(cid)
         plog.stage(t, cid)
-        self.store.queue_transaction(t)
+        # recovery barrier: drained bypass, never windowed
+        await self.committer.commit_now(t)
 
     async def _recover_object(self, state: PGState, pool, oid: str,
                               peer_shards: Dict[int, int]) -> None:
@@ -3536,7 +3583,8 @@ class OSDDaemon:
                 cid = self._cid(pg, my_shard)
                 t.remove(cid, ObjectId(oid))
                 try:
-                    self.store.queue_transaction(t)
+                    # recovery barrier: drained bypass, never windowed
+                    await self.committer.commit_now(t)
                 except KeyError:
                     pass
             if i_need:
@@ -3546,7 +3594,7 @@ class OSDDaemon:
                 plog.missing.pop(oid, None)
                 plog.stage(t, cid)
                 try:
-                    self.store.queue_transaction(t)
+                    await self.committer.commit_now(t)
                 except KeyError:
                     pass
             return
@@ -3576,7 +3624,8 @@ class OSDDaemon:
                 self._apply_shard_ops(t, cid, oid, ops)
                 plog.missing.pop(oid, None)
                 plog.stage(t, cid)
-                self.store.queue_transaction(t)
+                # recovery install barrier: drained bypass
+                await self.committer.commit_now(t)
             else:
                 tid = self._next_tid()
                 reply = await self._request(
@@ -3924,6 +3973,7 @@ class OSDDaemon:
             return EAGAIN
         plog = self._load_log(state, pool)
         pending = []
+        local_task: Optional[asyncio.Task] = None
         for shard, osd in targets:
             ops = shard_ops.get(shard)
             if ops is None:
@@ -3940,7 +3990,21 @@ class OSDDaemon:
                         int(self.config["osd_min_pg_log_entries"]))
                 plog.missing.pop(oid, None)
                 plog.stage(t, cid)
-                self.store.queue_transaction(t)
+                # group commit, concurrent with the remote fan-out:
+                # the local barrier and the replica RTTs overlap, and
+                # concurrent writers share one fsync.  The task is
+                # created here (in the same sync section as the
+                # plog.append above) so commit-lane order matches
+                # version order.
+                local_task = asyncio.get_running_loop().create_task(
+                    self.committer.queue_transaction(t))
+                # if this op is cancelled mid-gather the commit still
+                # completes (as the old inline commit already had);
+                # pre-retrieve so an orphaned failure cannot log
+                # "exception never retrieved"
+                local_task.add_done_callback(
+                    lambda tk: None if tk.cancelled()
+                    else tk.exception())
             else:
                 tid = self._next_tid()
                 self.perf["subwrite_bytes"] += sum(
@@ -3950,6 +4014,11 @@ class OSDDaemon:
                                       admit_epoch, entry,
                                       self.osd_id), tid))
         replies = await asyncio.gather(*pending) if pending else []
+        if local_task is not None:
+            # raises what the local apply raised (as the inline call
+            # did) — but only after the remote acks are in, so a local
+            # failure cannot strand already-sent sub-writes unawaited
+            await local_task
         # a shard that failed mid-write recovers via peering on the next
         # interval (its pg log lags); the write succeeds if enough
         # shards committed (min_size durability floor)
@@ -4041,7 +4110,9 @@ class OSDDaemon:
                     cid = self._cid(pg, shard)
                     t = Transaction()
                     t.remove(cid, ObjectId(rb))
-                    self.store.queue_transaction(t)
+                    # post-ack trim rides the window (FIFO keeps it
+                    # ordered before any later overwrite's clone)
+                    await self.committer.queue_transaction(t)
                 else:
                     tid = self._next_tid()
                     pending.append(self._request(
@@ -4288,9 +4359,12 @@ class OSDDaemon:
                         prefer=self._shard_rank(state))
                     frags = {}
                     for s, payload in chosen_frags.items():
-                        buf = payload[:frag_len]
+                        # view of the sub-read frame; materialize
+                        # only the short-shard pad case
+                        buf = memoryview(payload)[:frag_len]
                         if len(buf) < frag_len:
-                            buf = buf + bytes(frag_len - len(buf))
+                            buf = bytes(buf) + \
+                                bytes(frag_len - len(buf))
                         frags[s] = buf
                     self.perf["decode_dispatches"] += 1
                     decoded = await self.encode_service.decode(
@@ -4305,10 +4379,13 @@ class OSDDaemon:
 
         # re-encode awaited BEFORE the version is allocated (same
         # ordering discipline as _op_write_full_locked): concurrent
-        # RMWs share a batched dispatch through the encode service
+        # RMWs share a batched dispatch through the encode service.
+        # ONE materialization of the merged span serves the encode
+        # AND the extent cache below (it was two).
         self.perf["encode_dispatches"] += 1
+        merged_b = bytes(merged)
         shards = await self.encode_service.encode(
-            sinfo, codec, bytes(merged), range(n))
+            sinfo, codec, merged_b, range(n))
         entry = self._next_entry(state, pool, oid, "modify", new_size)
         oi_raw = json.dumps({"size": new_size,
                              "version": entry["version"]}).encode()
@@ -4331,7 +4408,7 @@ class OSDDaemon:
                                              admit_epoch)
         if rc == 0:
             self._cache_put(state, oid, entry["version"], new_size,
-                            start, bytes(merged), width)
+                            start, merged_b, width)
         else:
             state.extent_cache.pop(oid, None)
         return rc
@@ -4542,13 +4619,15 @@ class OSDDaemon:
     def _tier_slice(data: bytes, offset: int, length: int) -> bytes:
         """Slice a cached decoded object exactly like the cold path
         slices its decode output (same offset/length semantics, so the
-        bypass is bit-identical)."""
+        bypass is bit-identical).  Returns a VIEW — the reply encoder
+        writes it to the wire without materializing."""
         if offset >= len(data):
             return b""
+        view = memoryview(data)
         if length:
-            return data[offset:offset + length]
+            return view[offset:offset + length]
         if offset:
-            return data[offset:]
+            return view[offset:]
         return data
 
     async def _op_read(self, state: PGState, pool, oid: str,
@@ -4586,12 +4665,15 @@ class OSDDaemon:
                     oi = json.loads(at[OI_ATTR])
                     if oi.get("whiteout"):
                         return ENOENT, b""
-                    data = data[:oi.get("size", len(data))]
+                    # view slices end to end: the reply encoder
+                    # writes the range straight from the store buffer
+                    view = memoryview(data)[:oi.get("size",
+                                                    len(data))]
                     if length:
-                        data = data[offset:offset + length]
+                        view = view[offset:offset + length]
                     elif offset:
-                        data = data[offset:]
-                    return 0, data
+                        view = view[offset:]
+                    return 0, view
                 if rc == ENOENT:
                     return ENOENT, b""
             candidates, _complete, version, chosen, oi = \
@@ -4606,13 +4688,14 @@ class OSDDaemon:
             self._require_fresh(state, pool, oid, version)
             if oi.get("whiteout"):
                 return ENOENT, b""
-            data = chosen[next(iter(chosen))]
-            data = data[:oi.get("size", len(data))]
+            # view slices over the sub-read reply's frame buffer
+            view = memoryview(chosen[next(iter(chosen))])
+            view = view[:oi.get("size", len(view))]
             if length:
-                data = data[offset:offset + length]
+                view = view[offset:offset + length]
             elif offset:
-                data = data[offset:]
-            return 0, data
+                view = view[offset:]
+            return 0, view
         codec = self._codec(pool.id)
         sinfo = self._sinfo(pool.id)
         k = codec.get_data_chunk_count()
@@ -4659,15 +4742,18 @@ class OSDDaemon:
                 return EIO, b""
             frags = {}
             for s, payload in chosen_frags.items():
-                buf = payload[:frag_len]
+                # view of the sub-read frame; materialize ONLY the
+                # short-shard pad case (reads past the object end)
+                buf = memoryview(payload)[:frag_len]
                 if len(buf) < frag_len:
-                    buf += bytes(frag_len - len(buf))
+                    buf = bytes(buf) + bytes(frag_len - len(buf))
                 frags[s] = buf
             self.perf["decode_dispatches"] += 1
             data = await self.encode_service.decode(sinfo, codec,
                                                     frags)
             rel = offset - start
-            return 0, data[rel:rel + min(length, size - offset)]
+            return 0, memoryview(data)[
+                rel:rel + min(length, size - offset)]
         # newest version with >= k intact same-version shards wins;
         # hinfo crc drops corrupt shards (handle_sub_read's verify)
         candidates, _complete, version, good, oi = \
@@ -4692,12 +4778,13 @@ class OSDDaemon:
             return EIO, b""
         self.perf["decode_dispatches"] += 1
         data = await self.encode_service.decode(sinfo, codec, frags)
-        data = data[:size]
+        # view slices over the decode output
+        view = memoryview(data)[:size]
         if length:
-            data = data[offset:offset + length]
+            view = view[offset:offset + length]
         elif offset:
-            data = data[offset:]
-        return 0, data
+            view = view[offset:]
+        return 0, view
 
     async def _op_stat(self, state: PGState, pool, oid: str
                        ) -> Tuple[int, Dict[str, Any]]:

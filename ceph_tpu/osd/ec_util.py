@@ -120,22 +120,34 @@ def encode(sinfo: StripeInfo, ec_impl, data: bytes,
         # every downstream step (the matmul, the per-shard bytes) works
         # on contiguous rows — per-stripe dispatch and strided copies
         # both cost more than the whole encode
-        streams = np.ascontiguousarray(
-            np.moveaxis(arr, 1, 0)).reshape(k, n_stripes * chunk)
+        streams = np.ascontiguousarray(np.moveaxis(arr, 1, 0))
+        # shards leave as FROZEN zero-copy row views (the fused-path
+        # discipline): nothing mutates them after the encode, frozen
+        # OWNERS are store-adoptable (buffer.is_immutable walks the
+        # base chain), and the per-shard tobytes copy was the whole
+        # object's size over again.  Freeze before reshaping so the
+        # row views' base is the frozen owner.
+        streams.setflags(write=False)
+        streams = streams.reshape(k, n_stripes * chunk)
         parity = ec_impl.encode_batch(streams[None])[0]  # (m, B*chunk)
+        parity = np.ascontiguousarray(parity)
+        if parity.base is not None:
+            # e.g. a wrapper over a device buffer: own the memory so
+            # the frozen-owner contract holds (cost parity with the
+            # tobytes this path used to pay)
+            parity = parity.copy()
+        parity.setflags(write=False)
         for i in range(n):
             if i not in want:
                 continue
-            if i < k:
-                out[i] = streams[i].tobytes()
-            else:
-                out[i] = np.ascontiguousarray(parity[i - k]).tobytes()
+            out[i] = streams[i].data if i < k else parity[i - k].data
         return out
 
     # generic path: per-stripe through the interface (array codes, mappings)
     parts: Dict[int, List[bytes]] = {i: [] for i in want}
+    mv = memoryview(data) if not isinstance(data, memoryview) else data
     for s in range(n_stripes):
-        encoded = ec_impl.encode(want, data[s * width:(s + 1) * width])
+        encoded = ec_impl.encode(want, mv[s * width:(s + 1) * width])
         for i, buf in encoded.items():
             assert len(buf) == chunk
             parts[i].append(buf)
@@ -519,12 +531,14 @@ def decode_many(sinfo: StripeInfo, ec_impl,
             # payloads are bytes-like already
             streams = {s: b"".join(maps[i][s] for i in idxs)
                        for s in key}
-            data = decode(sinfo, ec_impl, streams)
+            folded = memoryview(decode(sinfo, ec_impl, streams))
             off = 0
             for i in idxs:
                 stream_len = len(next(iter(maps[i].values())))
                 span = (stream_len // chunk) * width
-                out[i] = data[off:off + span]
+                # view per request: the fold's output is sliced, not
+                # re-copied, on its way back to each caller
+                out[i] = folded[off:off + span]
                 off += span
         except Exception:
             for i in idxs:
@@ -553,7 +567,11 @@ def decode(sinfo: StripeInfo, ec_impl,
     if not erased and not ec_impl.get_chunk_mapping():
         cols = [np.frombuffer(to_decode[i], dtype=np.uint8).reshape(
             n_stripes, chunk) for i in range(k)]
-        return np.stack(cols, axis=1).tobytes()
+        # the stack IS the interleave; hand out a frozen view of it
+        # instead of paying tobytes (a second whole-object pass)
+        full = np.stack(cols, axis=1)
+        full.setflags(write=False)
+        return full.reshape(-1).data
     if hasattr(ec_impl, "decode_batch") and not ec_impl.get_chunk_mapping() \
             and len(have) >= k:
         survivors = np.stack([
@@ -568,7 +586,9 @@ def decode(sinfo: StripeInfo, ec_impl,
                     to_decode[i], dtype=np.uint8).reshape(n_stripes, chunk))
             else:
                 cols.append(np.asarray(recovered[:, erased.index(i), :]))
-        return np.stack(cols, axis=1).tobytes()
+        full = np.stack(cols, axis=1)
+        full.setflags(write=False)
+        return full.reshape(-1).data
 
     out = []
     for s in range(n_stripes):

@@ -116,6 +116,10 @@ class OpSchedulerBase:
         self.overflow = overflow
         self._in_flight = 0
         self._queues: Dict[str, List[_Item]] = {}
+        # live queued-item count, maintained incrementally: _queued()
+        # was a sum over EVERY class queue, which at thousands of
+        # tenant classes made each grant-loop pass O(tenants)
+        self._nqueued = 0
         self._wake = asyncio.Event()
         self._drained = asyncio.Event()
         self._grant_task: Optional[asyncio.Task] = None
@@ -145,6 +149,7 @@ class OpSchedulerBase:
                 if not item.future.done():
                     item.future.cancel()
             q.clear()
+        self._nqueued = 0
 
     async def run(self, op_class: str, cost: float,
                   fn: Callable[[], Awaitable[Any]]) -> Any:
@@ -154,6 +159,28 @@ class OpSchedulerBase:
             # would spawn a grant loop that exits immediately and the
             # queued future would park the caller forever
             raise RuntimeError("scheduler stopped")
+        if self._nqueued == 0 and \
+                self._in_flight < self.max_concurrent and \
+                self._fast_charge(op_class, max(cost, 1.0)):
+            # uncontended fast grant: nothing is queued and a slot is
+            # free, so the grant loop's future/enqueue/select round
+            # trip (two loop hops + an O(classes) scan per op) buys
+            # nothing — charge the class's tags exactly as the queued
+            # path would (fairness accounting stays intact; an
+            # over-limit class is refused here and queues normally)
+            # and run.  The trace span still marks the stage, with
+            # zero wait.
+            self._in_flight += 1
+            self.granted[op_class] = self.granted.get(op_class, 0) + 1
+            q_span = tracing.start_child(
+                f"queue.{stage_class(op_class)}", cls=op_class)
+            q_span.set_attr("fast", True)
+            q_span.finish()
+            try:
+                return await fn()
+            finally:
+                self._in_flight -= 1
+                self._wake.set()
         self.start()
         # queue WAIT is a pipeline stage: per-mClock-class span
         # covering the bounded-queue BLOCK wait and the enqueue-to-
@@ -181,6 +208,7 @@ class OpSchedulerBase:
                 asyncio.get_running_loop().create_future()
             item = _Item(max(cost, 1.0), fn, fut)
             self._enqueue(op_class, item)
+            self._nqueued += 1
             self._wake.set()
             try:
                 await fut  # grant
@@ -215,8 +243,15 @@ class OpSchedulerBase:
         """Return a cancelled-before-grant item's tag/service charge:
         the work never ran, so the class must not be debited for it."""
 
+    def _fast_charge(self, op_class: str, cost: float) -> bool:
+        """Charge the class's tags for an uncontended immediate grant
+        (the enqueue+select accounting, minus the queue).  False =
+        the class may not run right now (rate-gated) and must take
+        the queued path."""
+        return True
+
     def _queued(self) -> int:
-        return sum(len(q) for q in self._queues.values())
+        return self._nqueued
 
     def stats(self) -> Dict[str, Any]:
         """The introspection surface the admission gate (and
@@ -242,6 +277,7 @@ class OpSchedulerBase:
                 if picked is None:
                     break
                 op_class, item = picked
+                self._nqueued -= 1
                 self._drained.set()
                 if item.future.done():
                     # caller vanished before the grant: no slot was
@@ -311,6 +347,14 @@ class WPQScheduler(OpSchedulerBase):
     def _uncharge(self, op_class: str, item: _Item) -> None:
         self._served[op_class] = self._served.get(op_class, 0.0) - \
             item.cost / max(self.weights.get(op_class, 1.0), 1e-9)
+
+    def _fast_charge(self, op_class: str, cost: float) -> bool:
+        # same service charge the pop in _select takes (an idle-floor
+        # catch-up is moot: the fast path only runs with EVERY queue
+        # empty, so there is no backlogged floor to respect)
+        self._served[op_class] = self._served.get(op_class, 0.0) + \
+            cost / max(self.weights.get(op_class, 1.0), 1e-9)
+        return True
 
 
 class MClockScheduler(OpSchedulerBase):
@@ -394,6 +438,27 @@ class MClockScheduler(OpSchedulerBase):
             self._last_p[op_class] -= item.cost / max(w, 1e-9)
         if l > 0 and op_class in self._last_l:
             self._last_l[op_class] -= item.cost / l
+
+    def _fast_charge(self, op_class: str, cost: float) -> bool:
+        # dmClock tags advance exactly as _enqueue + _charge_limit
+        # would have; an over-limit class is REFUSED (it must queue
+        # behind its L-tag like always — the fast path never launders
+        # QoS)
+        now = time.monotonic()
+        if not self._limit_ok(op_class, now):
+            return False
+        r, w, l = self.profile_of(op_class)
+        if r > 0:
+            self._last_r[op_class] = max(
+                now, self._last_r.get(op_class, 0.0) + cost / r)
+        self._last_p[op_class] = \
+            max(now, self._last_p.get(op_class, 0.0)) \
+            + cost / max(w, 1e-9)
+        if l > 0:
+            self._last_l[op_class] = \
+                max(now, self._last_l.get(op_class, 0.0)) + cost / l
+        self._prune_idle_tenants()
+        return True
 
     def _limit_ok(self, op_class: str, now: float) -> bool:
         _r, _w, l = self.profile_of(op_class)

@@ -710,7 +710,12 @@ class IoCtx:
             raise ObjectNotFound(reply.rc, oid)
         if reply.rc != 0:
             raise RadosError(reply.rc, f"read {oid!r}")
-        return reply.data
+        # local-fastpath replies carry zero-copy views of the OSD's
+        # buffers; the public API hands out real bytes (callers
+        # json-decode, hash, and cache them).  Wire replies decode to
+        # bytes already, so this materializes nothing there.
+        data = reply.data
+        return data if isinstance(data, bytes) else bytes(data)
 
     async def stat(self, oid: str) -> Dict[str, Any]:
         reply = await self._submit(oid, [OSDOp("stat")])
@@ -746,7 +751,8 @@ class IoCtx:
             raise ObjectNotFound(reply.rc, oid)
         if reply.rc != 0:
             raise RadosError(reply.rc, f"exec {cls}.{method} on {oid!r}")
-        return reply.data
+        data = reply.data
+        return data if isinstance(data, bytes) else bytes(data)
 
     async def setxattr(self, oid: str, name: str, value: bytes) -> None:
         reply = await self._submit(

@@ -9,3 +9,11 @@ def reframe(payload, parts):
     body = view[4:]
     total = sum(len(p) for p in parts)
     return head, body, total
+
+
+def reslice(payload):
+    # a BUF-named variable bound to a view constructor slices
+    # zero-copy: the rule recognizes the binding and stays silent
+    # (re-flagging converted sites would re-list them forever)
+    data = memoryview(payload)
+    return data[:4], data[4:]

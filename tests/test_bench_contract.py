@@ -22,7 +22,7 @@ import bench  # noqa: E402
 CONTRACT_KEYS = {"metric", "value", "unit", "vs_baseline",
                  "plan_cache", "encode_service", "tier",
                  "device_health", "tail", "load", "durability",
-                 "mesh", "trace", "truncated"}
+                 "mesh", "trace", "group_commit", "truncated"}
 
 
 def test_contract_line_despite_hanging_backend(tmp_path):
@@ -119,6 +119,17 @@ def test_contract_line_despite_hanging_backend(tmp_path):
     # the stable decomposition enforces the <=2% bound: measured
     # span-layer cost per op over the measured live EC op cost
     assert contract["trace"]["overhead_ratio_pct"] <= 2.0
+    # the group-commit probe ran: N concurrent durable writes shared
+    # barriers (fsyncs strictly under the writer count) bit-exactly,
+    # while the kill-switch leg paid one sync commit per txn
+    gc = contract["group_commit"]
+    assert gc["writers"] >= 8
+    assert gc["fsyncs_lt_writers"] == 1
+    assert gc["fsyncs"] < gc["writers"]
+    assert gc["kv_commits"] < gc["kv_commits_inline"]
+    assert gc["kv_commits_inline"] == gc["writers"]
+    assert gc["bitexact"] == 1
+    assert gc["batches"] >= 1
     assert contract["truncated"] is False
     # details stayed out of stdout (they belong in bench_details.json)
     assert len(stdout_lines) == 1

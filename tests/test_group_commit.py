@@ -1,0 +1,478 @@
+"""Group commit (os/groupcommit.py + TPUStore.submit_batch) and the
+zero-copy buffer discipline (PR 12).
+
+Four tiers:
+
+1. Store: submit_batch merges N txns into ONE sync commit + at most
+   one fsync, read-your-writes spans the batch, per-txn on_commit
+   fires in order after the shared barrier, and a failing txn is
+   isolated (it alone reports; the rest commit).
+2. Committer: concurrent awaits share a barrier, FIFO ordering holds
+   across window/bypass/sync-flush lanes, the kill switch is
+   behavior-parity, and drains leave nothing stranded.
+3. Crash: the PR-8 sweep with batching ARMED — zero violations, the
+   broken-store self-tests still caught — plus a cut INSIDE an
+   accumulating window: unacked txns vanish wholesale, acked never.
+4. Zero-copy: bit-exact readback through the REAL wire path while
+   the client thrashes its buffers after each ack, and the
+   sub-read-reply views' immutability discipline.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.os import ObjectId, Transaction
+from ceph_tpu.os.faultstore import (
+    BrokenBlockStore, BrokenCommitStore, CrashSweep, FaultStore,
+    build_image, write_image,
+)
+from ceph_tpu.os.groupcommit import GroupCommitter
+from ceph_tpu.os.memstore import MemStore
+from ceph_tpu.os.tpustore import TPUStore
+
+from cluster_helpers import Cluster, tpustore_factory
+
+
+def _store(path) -> TPUStore:
+    s = TPUStore(str(path))
+    s.mkfs()
+    s.mount()
+    t = Transaction()
+    t.create_collection("cc")
+    s.queue_transaction(t)
+    return s
+
+
+def _wtxn(i: int, size: int = 8192, oid: str = None) -> Transaction:
+    t = Transaction()
+    data = bytes([i % 256]) * size
+    t.write("cc", ObjectId(oid or f"o{i}"), 0, len(data), data)
+    return t
+
+
+# -- 1. store tier ----------------------------------------------------------
+
+
+def test_submit_batch_one_barrier_for_n_txns(tmp_path):
+    s = _store(tmp_path)
+    before = dict(s.perf)
+    fired = []
+    txns = []
+    for i in range(8):
+        t = _wtxn(i, size=100 * 1024)
+        t.register_on_commit(lambda i=i: fired.append(i))
+        txns.append(t)
+    assert s.submit_batch(txns) == [None] * 8
+    # ONE kv sync commit, ONE block fsync — for eight durable writes
+    assert s.perf["kv_commits"] - before["kv_commits"] == 1
+    assert s.perf["block_fsyncs"] - before["block_fsyncs"] == 1
+    assert s.perf["gc_batches"] == 1
+    assert s.perf["gc_txns"] == 8
+    assert s.perf["gc_fsyncs_saved"] == 7
+    assert s.perf["gc_kv_commits_saved"] == 7
+    # acks in batch order, after the shared barrier
+    assert fired == list(range(8))
+    for i in range(8):
+        assert s.read("cc", ObjectId(f"o{i}")) == \
+            bytes([i % 256]) * (100 * 1024)
+    # durable across remount
+    s.umount()
+    s2 = TPUStore(str(tmp_path))
+    s2.mount()
+    for i in range(8):
+        assert s2.read("cc", ObjectId(f"o{i}")) == \
+            bytes([i % 256]) * (100 * 1024)
+    s2.umount()
+
+
+def test_submit_batch_read_your_writes_spans_the_batch(tmp_path):
+    """txn j reads what txn i<j wrote — a batch applies exactly like
+    committing its members in order."""
+    s = _store(tmp_path)
+    t1 = Transaction()
+    t1.write("cc", ObjectId("x"), 0, 4, b"abcd")
+    t2 = Transaction()
+    # same-object overwrite in the same batch: last writer wins
+    t2.write("cc", ObjectId("x"), 2, 2, b"ZZ")
+    t3 = Transaction()
+    t3.clone("cc", ObjectId("x"), ObjectId("x_clone"))
+    assert s.submit_batch([t1, t2, t3]) == [None] * 3
+    assert s.read("cc", ObjectId("x")) == b"abZZ"
+    # the clone captured BOTH earlier txns' effects
+    assert s.read("cc", ObjectId("x_clone")) == b"abZZ"
+
+
+def test_submit_batch_failure_isolated_per_txn(tmp_path):
+    s = _store(tmp_path)
+    good1 = _wtxn(1, oid="g1")
+    bad = Transaction()
+    bad.ops.append(("no-such-op", "cc"))
+    good2 = _wtxn(2, oid="g2")
+    res = s.submit_batch([good1, bad, good2])
+    assert res[0] is None and res[2] is None
+    assert isinstance(res[1], ValueError)
+    assert s.read("cc", ObjectId("g1")) == bytes([1]) * 8192
+    assert s.read("cc", ObjectId("g2")) == bytes([2]) * 8192
+
+
+def test_submit_batch_base_impl_on_memstore():
+    """MemStore keeps the base loop-per-txn submit_batch: same
+    results, same per-txn isolation."""
+    s = MemStore()
+    s.mkfs()
+    s.mount()
+    t = Transaction()
+    t.create_collection("cc")
+    s.queue_transaction(t)
+    bad = Transaction()
+    bad.ops.append(("no-such-op", "cc"))
+    res = s.submit_batch([_wtxn(1, oid="a"), bad])
+    assert res[0] is None and isinstance(res[1], Exception)
+    assert s.read("cc", ObjectId("a")) == bytes([1]) * 8192
+
+
+# -- 2. committer tier ------------------------------------------------------
+
+
+def test_committer_concurrent_txns_share_one_fsync(tmp_path):
+    s = _store(tmp_path)
+
+    async def main():
+        gc = GroupCommitter(s, window_ms=1.0)
+        assert gc.engaged
+        before = s.perf["kv_commits"]
+        await asyncio.gather(
+            *(gc.queue_transaction(_wtxn(i)) for i in range(16)))
+        commits = s.perf["kv_commits"] - before
+        await gc.stop()
+        return commits, gc.stats()
+
+    commits, stats = asyncio.run(main())
+    # 16 concurrent writers, measurably fewer barriers than writers
+    assert commits < 16
+    assert stats["batched"] == 16
+    assert stats["batches"] == commits
+    assert sum(stats["txns_per_batch_hist"].values()) == commits
+    for i in range(16):
+        assert s.read("cc", ObjectId(f"o{i}")) == bytes([i]) * 8192
+
+
+def test_committer_kill_switch_is_inline_parity(tmp_path, monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_GROUP_COMMIT", "0")
+    s = _store(tmp_path)
+
+    async def main():
+        gc = GroupCommitter(s)
+        assert not gc.engaged
+        before = s.perf["kv_commits"]
+        await asyncio.gather(
+            *(gc.queue_transaction(_wtxn(i)) for i in range(4)))
+        assert gc.stats()["inline"] == 4
+        # exactly the pre-batching behavior: one commit per txn
+        assert s.perf["kv_commits"] - before == 4
+
+    asyncio.run(main())
+
+
+def test_committer_memstore_stays_inline():
+    s = MemStore()
+    s.mkfs()
+    s.mount()
+
+    async def main():
+        gc = GroupCommitter(s)
+        # no barriers to amortize: never engages, never adds latency
+        assert not gc.engaged
+
+    asyncio.run(main())
+
+
+def test_committer_flush_sync_is_a_total_order_barrier(tmp_path):
+    s = _store(tmp_path)
+
+    async def main():
+        gc = GroupCommitter(s, window_ms=50.0)  # long window
+        fut = asyncio.ensure_future(
+            gc.queue_transaction(_wtxn(7, oid="pending")))
+        await asyncio.sleep(0)  # let it enqueue into the window
+        assert gc.stats()["pending"] == 1
+        # the sync barrier commits the open window before returning
+        gc.flush_sync()
+        assert s.read("cc", ObjectId("pending")) == bytes([7]) * 8192
+        await fut
+        await gc.stop()
+
+    asyncio.run(main())
+
+
+def test_committer_commit_now_drains_first(tmp_path):
+    """Barrier bypass: same-object window txn commits BEFORE the
+    bypass txn — FIFO holds across lanes."""
+    s = _store(tmp_path)
+
+    async def main():
+        gc = GroupCommitter(s, window_ms=50.0)
+        f1 = asyncio.ensure_future(
+            gc.queue_transaction(_wtxn(1, oid="ord")))
+        await asyncio.sleep(0)
+        t2 = Transaction()
+        t2.write("cc", ObjectId("ord"), 0, 8192, bytes([2]) * 8192)
+        await gc.commit_now(t2)
+        await f1
+        await gc.stop()
+
+    asyncio.run(main())
+    assert s.read("cc", ObjectId("ord")) == bytes([2]) * 8192
+
+
+def test_committer_error_reaches_the_right_caller(tmp_path):
+    s = _store(tmp_path)
+
+    async def main():
+        gc = GroupCommitter(s, window_ms=1.0)
+        bad = Transaction()
+        bad.ops.append(("no-such-op", "cc"))
+        good = gc.queue_transaction(_wtxn(3, oid="ok"))
+        res = await asyncio.gather(gc.queue_transaction(bad), good,
+                                   return_exceptions=True)
+        assert isinstance(res[0], ValueError)
+        assert res[1] is None
+        await gc.stop()
+
+    asyncio.run(main())
+    assert s.read("cc", ObjectId("ok")) == bytes([3]) * 8192
+
+
+def test_committer_stop_drains_and_latches_inline(tmp_path):
+    s = _store(tmp_path)
+
+    async def main():
+        gc = GroupCommitter(s, window_ms=50.0)
+        fut = asyncio.ensure_future(
+            gc.queue_transaction(_wtxn(4, oid="drained")))
+        await asyncio.sleep(0)
+        await gc.stop()
+        await fut  # resolved by the drain, not stranded
+        # post-stop txns run inline (teardown must not park callers)
+        await gc.queue_transaction(_wtxn(5, oid="late"))
+
+    asyncio.run(main())
+    assert s.read("cc", ObjectId("drained")) == bytes([4]) * 8192
+    assert s.read("cc", ObjectId("late")) == bytes([5]) * 8192
+
+
+# -- 3. crash tier ----------------------------------------------------------
+
+SWEEP_TXNS = int(os.environ.get("CEPH_TPU_CRASH_SWEEP_TXNS", "10"))
+SWEEP_POINTS = int(os.environ.get("CEPH_TPU_CRASH_SWEEP_POINTS", "80"))
+
+
+def test_crash_sweep_with_group_commit_armed(tmp_path):
+    """The PR-8 sweep over the mixed workload, recorded through
+    submit_batch: the merged batch is a legal CrashLog trace — every
+    explored cut satisfies every invariant."""
+    rep = CrashSweep(str(tmp_path)).run(
+        txns=SWEEP_TXNS, batch=4, max_points=SWEEP_POINTS)
+    assert rep["violations"] == []
+    assert rep["points"] >= 20
+
+
+def test_batched_sweep_still_catches_broken_stores(tmp_path):
+    """Self-test: batching must not blunt the harness — a store with
+    no pre-commit fsync, and one whose commit point is not sync, must
+    both still be caught."""
+    rep = CrashSweep(str(tmp_path / "b1"),
+                     store_cls=BrokenBlockStore).run(
+        txns=8, batch=4, max_points=60, double_crash=False)
+    assert rep["violations"]
+    rep = CrashSweep(str(tmp_path / "b2"),
+                     store_cls=BrokenCommitStore).run(
+        txns=8, batch=4, max_points=60, double_crash=False)
+    assert rep["violations"]
+
+
+def test_cut_inside_accumulating_window(tmp_path):
+    """Power cut while a batch is ACCUMULATING (before its shared
+    barrier): the window's txns vanish WHOLESALE — none was acked, so
+    nothing is lost-after-ack — while every txn acked by an earlier
+    batch survives."""
+    s = FaultStore(str(tmp_path / "fs"))
+    s.mkfs()
+    s.mount()
+    t = Transaction()
+    t.create_collection("cc")
+    s.queue_transaction(t)
+    s.crashlog.events.clear()
+    base_block = b""
+    base_kv = None
+    acked = []
+    # batch 1: committed, acked
+    batch1 = []
+    for i in range(3):
+        t = _wtxn(i, oid=f"acked{i}")
+        t.register_on_commit(lambda i=i: acked.append(i))
+        batch1.append(t)
+    assert s.submit_batch(batch1) == [None] * 3
+    assert acked == [0, 1, 2]
+    cut_after_batch1 = len(s.crashlog.events)
+    # batch 2: applied into the store's lock but the power dies
+    # BEFORE its commit — simulate by cutting the trace at the
+    # pre-batch point (everything the window wrote is un-synced)
+    batch2 = [_wtxn(10 + i, oid=f"unacked{i}") for i in range(3)]
+    assert s.submit_batch(batch2) == [None] * 3
+    events = list(s.crashlog.events)
+    img = str(tmp_path / "img")
+    block, ops = build_image(events, cut_after_batch1,
+                             drop_pending=True, kv_keep="min",
+                             base_block=base_block)
+    write_image(img, block, ops, base_kv=s.base_kv
+                if base_kv is None else base_kv)
+    s.crash()
+    r = TPUStore(img)
+    r.mount()
+    try:
+        # acked txns never vanish
+        for i in range(3):
+            assert r.read("cc", ObjectId(f"acked{i}")) == \
+                bytes([i]) * 8192
+        # the un-synced window vanished wholesale
+        for i in range(3):
+            with pytest.raises(KeyError):
+                r.read("cc", ObjectId(f"unacked{i}"))
+    finally:
+        r.umount()
+
+
+# -- 4. zero-copy tier ------------------------------------------------------
+
+
+def _run(coro, timeout=180.0):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+EC_PROFILE = {"plugin": "ec_jax", "technique": "reed_sol_van",
+              "k": "2", "m": "1", "crush-failure-domain": "osd"}
+
+
+def test_zero_copy_bit_exact_readback_under_thrash(monkeypatch):
+    """Writes and reads through the REAL socket path (local fastpath
+    off, so frames are encoded, reassembled, and decoded to views),
+    with the client MUTATING its buffer after every ack: the durable
+    shards and every readback must hold the pre-mutation bytes — the
+    view discipline never lets a store or a reply alias a
+    caller-mutable buffer."""
+    from ceph_tpu import msg as msg_mod
+
+    monkeypatch.setattr(msg_mod, "LOCAL_FASTPATH", False)
+
+    async def main():
+        cluster = Cluster(num_osds=3, osds_per_host=3)
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "zc", profile=EC_PROFILE, pg_num=4)
+            io = cluster.client.open_ioctx("zc")
+            rng = np.random.default_rng(7)
+            originals = {}
+            for i in range(6):
+                buf = bytearray(
+                    rng.integers(0, 256, 16384, dtype=np.uint8)
+                    .tobytes())
+                originals[f"t{i}"] = bytes(buf)
+                await io.write_full(f"t{i}", buf)
+                # thrash: the caller reuses its buffer immediately
+                for j in range(len(buf)):
+                    buf[j] = 0xAA
+            for i in range(6):
+                got = await io.read(f"t{i}")
+                assert isinstance(got, bytes)
+                assert got == originals[f"t{i}"], f"t{i} corrupted"
+                # ranged reads slice views server-side: still exact
+                got = await io.read(f"t{i}", offset=1000, length=500)
+                assert got == originals[f"t{i}"][1000:1500]
+        finally:
+            await cluster.stop()
+
+    _run(main())
+
+
+def test_group_commit_on_persistent_cluster(tmp_path):
+    """End to end: N concurrent client writes into a TPUStore-backed
+    cluster; the primaries' and replicas' stores must show fewer
+    barriers than the un-batched path would pay, and the committer's
+    batch histogram must show real multi-txn batches."""
+
+    async def main():
+        cluster = Cluster(num_osds=3, osds_per_host=3,
+                          store_factory=tpustore_factory(tmp_path),
+                          persistent=True)
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "gc", profile=EC_PROFILE, pg_num=4)
+            io = cluster.client.open_ioctx("gc")
+            payloads = {f"g{i}": bytes([i]) * 4096 for i in range(24)}
+            await asyncio.gather(
+                *(io.write_full(oid, data)
+                  for oid, data in payloads.items()))
+            batched = sum(
+                osd.committer.stats()["batched"]
+                for osd in cluster.osds.values())
+            batches = sum(
+                osd.committer.stats()["batches"]
+                for osd in cluster.osds.values())
+            saved = sum(
+                osd.store.perf["gc_kv_commits_saved"]
+                for osd in cluster.osds.values())
+            assert batched > 0, "group commit never engaged"
+            assert batches < batched, \
+                "no txns actually shared a barrier"
+            assert saved > 0
+            for oid, data in payloads.items():
+                assert await io.read(oid) == data
+        finally:
+            await cluster.stop()
+
+    _run(main())
+
+
+def test_sub_read_reply_data_is_a_view():
+    """The wire decode of a sub-read reply hands the payload out as a
+    zero-copy view of the frame buffer."""
+    from ceph_tpu.msg.messages import MOSDSubReadReply
+
+    msg = MOSDSubReadReply(1, 0, b"x" * 4096, {}, shard=0)
+    raw = msg.encode()
+    back = MOSDSubReadReply.decode(raw)
+    assert isinstance(back.data, memoryview)
+    assert bytes(back.data) == b"x" * 4096
+
+
+def test_encode_decode_views_are_immutable_and_exact():
+    """ec_util's batch tiers hand out FROZEN views: store-adoptable
+    (is_immutable) and bit-exact against materialized copies."""
+    from ceph_tpu.common.buffer import is_immutable
+    from ceph_tpu.ec.registry import create_erasure_code
+    from ceph_tpu.osd import ec_util
+
+    codec = create_erasure_code(
+        {"plugin": "ec_jax", "technique": "reed_sol_van",
+         "k": "2", "m": "1"})
+    sinfo = ec_util.StripeInfo(2, 8192)
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, 65536, dtype=np.uint8).tobytes()
+    shards = ec_util.encode(sinfo, codec, data, range(3))
+    for i, shard in shards.items():
+        assert is_immutable(shard), f"shard {i} is caller-mutable"
+    out = ec_util.decode(sinfo, codec,
+                         {0: bytes(shards[0]), 1: bytes(shards[1])})
+    assert bytes(out) == data
+    # decode-from-parity produces the same bytes
+    out = ec_util.decode(sinfo, codec,
+                         {0: bytes(shards[0]), 2: bytes(shards[2])})
+    assert bytes(out) == data
