@@ -66,6 +66,7 @@ def _emit_contract(value: Optional[float],
                    load: Optional[dict] = None,
                    durability: Optional[dict] = None,
                    mesh: Optional[dict] = None,
+                   multihost: Optional[dict] = None,
                    trace: Optional[dict] = None,
                    group_commit: Optional[dict] = None,
                    truncated: bool = False) -> None:
@@ -85,7 +86,11 @@ def _emit_contract(value: Optional[float],
     the deliberately-broken store caught as a self-test), mesh the
     multi-chip mesh probe (same batch bit-exact through 1-device /
     N-device / host oracle, sick chip shrinks the mesh with zero host
-    fallbacks), trace the critical-path tracing probe (reducer
+    fallbacks), multihost the cross-host data-plane probe (bit-exact
+    encode across a real >=2-process jax.distributed group on the
+    hybrid DCN x ICI mesh, plus the host-loss leg: one host:<id>
+    event retires all the host's chips together, one shrink, zero
+    host fallbacks), trace the critical-path tracing probe (reducer
     correctness + spans-on-vs-off overhead at sample rate 0);
     truncated flags a budget-shortened run.  Thread-safe:
     the deadline watchdog and the bench body may race to emit."""
@@ -108,6 +113,7 @@ def _emit_contract(value: Optional[float],
             "load": load,
             "durability": durability,
             "mesh": mesh,
+            "multihost": multihost,
             "trace": trace,
             "group_commit": group_commit,
             "truncated": bool(truncated),
@@ -259,6 +265,42 @@ def _mesh_probe() -> Optional[dict]:
     timeout_s = float(os.environ.get(
         "CEPH_TPU_BENCH_MESH_PROBE_TIMEOUT", "120"))
     return _meshbench_subprocess(["--probe", "--smoke"], timeout_s)
+
+
+def _multihost_probe() -> Optional[dict]:
+    """Pre-contract probe of the cross-host data plane: a REAL
+    2-process ``jax.distributed`` group (spawned by meshbench's
+    ``--processes`` driver; each worker bootstraps through the
+    parallel/multihost.py seam) must encode bit-exactly on the hybrid
+    DCN x ICI mesh, and the host-loss leg (emulated 2-host topology,
+    ``down_host`` injection) must retire the host as ONE event — one
+    shrink, zero per-chip breaker trips, zero host fallbacks, the
+    fused-crc family still closed.  Counters land in the contract
+    line's `multihost` key, first-and-always under the PR-6
+    watchdog; None (with a stderr note) when the probe cannot run."""
+    if _remaining() < 0:
+        print("# multihost probe skipped: budget exhausted",
+              file=sys.stderr)
+        return None
+    timeout_s = float(os.environ.get(
+        "CEPH_TPU_BENCH_MULTIHOST_PROBE_TIMEOUT", "180"))
+    return _meshbench_subprocess(["--processes", "2", "--smoke"],
+                                 timeout_s)
+
+
+def bench_multihost() -> dict:
+    """Cross-host scale-out section: the meshbench ``--processes``
+    sweep axis — real jax.distributed process groups at 1 -> 2 (env
+    CEPH_TPU_BENCH_MULTIHOST_PROCESSES widens it on real pods),
+    bit-exact at every count, GiB/s per leg — plus the host-loss
+    shrink leg.  Budget-gated like every optional section."""
+    timeout_s = float(os.environ.get(
+        "CEPH_TPU_BENCH_MULTIHOST_SWEEP_TIMEOUT", "300"))
+    counts = os.environ.get("CEPH_TPU_BENCH_MULTIHOST_PROCESSES",
+                            "1,2")
+    args = ["--processes", counts] + (["--smoke"] if _SMOKE else [])
+    out = _meshbench_subprocess(args, timeout_s)
+    return out or {}
 
 
 def bench_mesh() -> dict:
@@ -2016,6 +2058,10 @@ def main() -> None:
     # mesh probe (before the contract): 1-dev/N-dev/host bit-exact,
     # sick chip shrinks the mesh with zero host fallbacks
     mesh_counters = _mesh_probe()
+    # multihost probe (before the contract): bit-exact encode across
+    # a real 2-process jax.distributed group + the host-loss leg
+    # (one host event, one shrink, zero host fallbacks)
+    multihost_counters = _multihost_probe()
     # critical-path tracing probe (before the contract): reducer
     # reconstructs a hand-built tree, spans-on-vs-off overhead at
     # sample rate 0 through a live loopback cluster
@@ -2035,6 +2081,7 @@ def main() -> None:
                    load=load_counters,
                    durability=durability_counters,
                    mesh=mesh_counters,
+                   multihost=multihost_counters,
                    trace=trace_counters,
                    group_commit=group_commit_counters,
                    truncated=skip_optional)
@@ -2132,6 +2179,18 @@ def main() -> None:
         except Exception as e:
             print(f"# mesh bench failed: {e!r}", file=sys.stderr)
 
+    # cross-host scale-out section: the --processes sweep axis (real
+    # jax.distributed process groups) + the host-loss shrink leg
+    multihost_section: dict = {}
+    if skip_optional:
+        skipped_sections.append("multihost")
+    else:
+        try:
+            multihost_section = bench_multihost()
+        except Exception as e:
+            print(f"# multihost bench failed: {e!r}",
+                  file=sys.stderr)
+
     # per-stage latency decomposition under load: concurrent EC R/W
     # clients, then the OSDs' critical-path stage histograms roll up
     # into stage p50/p99 self-times
@@ -2228,6 +2287,7 @@ def main() -> None:
         **trace_section,
         **group_commit_section,
         **mesh_section,
+        **multihost_section,
         **degraded_section,
         **load_section,
         **durability_section,
@@ -2239,6 +2299,7 @@ def main() -> None:
         "load": load_counters,
         "durability": durability_counters,
         "mesh": mesh_counters,
+        "multihost": multihost_counters,
         "trace": trace_counters,
         "group_commit": group_commit_counters,
         "host_cores": os.cpu_count(),

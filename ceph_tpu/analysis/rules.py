@@ -51,6 +51,14 @@ EC dispatch discipline:
                        plan.stats(), binds a device set no health
                        shrink can retire, and dispatches without
                        watchdog or sick-chip attribution
+  raw-process-group    jax.distributed.initialize/shutdown outside
+                       the parallel/multihost.py bootstrap seam: a
+                       process group joined elsewhere skips the gloo
+                       CPU-collectives config, the host-topology
+                       map, the plan keys' process-topology element,
+                       and the collective-safe membership agreement
+                       — host loss would wedge a collective instead
+                       of reading as a timeout
 
 store durability discipline:
   commit-before-durability
@@ -654,6 +662,54 @@ def rule_unplanned_mesh_dispatch(a: Analyzer) -> None:
 
 
 # ---------------------------------------------------------------------
+# raw-process-group
+# ---------------------------------------------------------------------
+
+# the bootstrap seam: the ONE module allowed to join or configure the
+# jax.distributed process group (it selects the CPU collectives, owns
+# the host-topology map, and keeps membership agreement
+# collective-safe); everywhere else a raw initialize builds a group
+# the failure-domain machinery cannot see
+_PROCGROUP_EXEMPT = ("parallel/multihost.py",)
+_PROCGROUP_TAILS = {"initialize", "shutdown"}
+
+
+def rule_raw_process_group(a: Analyzer) -> None:
+    """Raw ``jax.distributed.initialize`` / process-group setup
+    outside the parallel/multihost.py bootstrap seam.  The seam is
+    load-bearing: it configures the CPU collectives BEFORE backend
+    init, feeds the host failure-domain topology (``host:<id>``
+    breakers, the plan keys' process-topology element), and keeps
+    membership agreement on the coordinator KV store instead of a
+    collective a dead host would wedge.  Route group setup through
+    ``multihost.initialize()`` / ``bootstrap_from_env()``."""
+    exempt = a.config.get("procgroup_exempt", _PROCGROUP_EXEMPT)
+    for mod in a.project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if any(p in rel for p in exempt):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _resolved_callee(mod, node) or \
+                dotted(node.func) or ""
+            parts = callee.split(".")
+            if len(parts) >= 2 and parts[-2] == "distributed" \
+                    and parts[-1] in _PROCGROUP_TAILS:
+                a.emit("raw-process-group", mod, node,
+                       f"raw process-group setup `{callee}` outside "
+                       "the parallel/multihost.py bootstrap seam: "
+                       "the group skips the collectives config, the "
+                       "host-topology map, topology-aware plan keys "
+                       "and collective-safe membership agreement — "
+                       "call ceph_tpu.parallel.multihost.initialize"
+                       "() instead",
+                       severity="warning",
+                       symbol=_enclosing_qualname(mod, node),
+                       scope_line=_scope_line(mod, node))
+
+
+# ---------------------------------------------------------------------
 # unhedged-gather
 # ---------------------------------------------------------------------
 
@@ -1099,6 +1155,7 @@ def default_rules() -> Dict[str, object]:
         "jit-bypass-plan": rule_jit_bypass_plan,
         "unguarded-device-dispatch": rule_unguarded_device_dispatch,
         "unplanned-mesh-dispatch": rule_unplanned_mesh_dispatch,
+        "raw-process-group": rule_raw_process_group,
         "unhedged-gather": rule_unhedged_gather,
         "span-leak": rule_span_leak,
         "unbounded-latency-buffer": rule_unbounded_latency_buffer,

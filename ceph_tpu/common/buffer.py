@@ -50,6 +50,37 @@ def is_immutable(data) -> bool:
     return False
 
 
+def adopt(data):
+    """Immutable form of `data` for long-lived caches and stores:
+    pass through when is_immutable() PROVES no other owner can
+    mutate the bytes (the common case on the zero-copy read path —
+    frozen decode views, bytes), materialize otherwise.  The honest
+    centralization of the `bytes(payload)`-at-every-site pattern:
+    the copy happens only when adoption genuinely needs one."""
+    return data if is_immutable(data) else bytes(data)
+
+
+def as_buffer(data):
+    """Adapt `data` to something the zero-copy byte paths (frombuffer
+    / memoryview slicing) accept, copying ONLY when the layout
+    genuinely requires it:
+
+    - bytes / bytearray / memoryview pass through untouched;
+    - a C-contiguous uint8 ndarray hands out its buffer view;
+    - a StridedBuf (strided rows — no flat buffer exists) and every
+      other object materialize via bytes() — the one honest copy,
+      and StridedBuf caches its flat form so repeats are free.
+
+    This is the centralized materialize-guard the hot-path-copy
+    worklist's `bytes(x)`-per-call-site pattern collapsed into."""
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        return data
+    if isinstance(data, np.ndarray) and data.dtype == np.uint8 \
+            and data.flags.c_contiguous:
+        return data.reshape(-1).data
+    return bytes(data)
+
+
 class StridedBuf:
     """Read-only logical byte string backed by a strided uint8 view.
 

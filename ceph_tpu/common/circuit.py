@@ -32,6 +32,14 @@ case and degrade by construction (arXiv:1804.10331, arXiv:2409.01420)
   — the mesh layer (ec/plan.py) probes each participant individually
   and re-plans on the surviving set, so one sick chip shrinks the
   mesh instead of degrading the whole batch to host.
+* **Host failure domains** — once the mesh spans hosts
+  (parallel/multihost.py), the unit of loss is the HOST
+  (arXiv:1804.10331's model): ``host:<id>`` breaker families hold a
+  whole host's chips out together.  ``retire_host()`` is ONE breaker
+  event — the host breaker trips once, ``device_degraded()`` reads
+  every chip of a retired host as held out, and none of the chips'
+  own threshold-1 breakers fire (no N-chip breaker storm).  The mesh
+  layer re-keys plans on the survivor processes in one shrink.
 
 * **Fault injection** — `CEPH_TPU_INJECT_DEVICE_FAIL` is read at the
   same choke point so tests and the thrasher can script device
@@ -46,6 +54,11 @@ case and degrade by construction (arXiv:1804.10331, arXiv:2409.01420)
       sick=D                fail any dispatch whose `devices` include
                             device id D (drives the mesh-shrink path:
                             sick chip out, smaller mesh in)
+      down_host=H           fail any dispatch whose `devices` include
+                            a device of host H (parallel/multihost.py
+                            topology — drives the host-loss shrink:
+                            one host:<H> event, all its chips retired
+                            together)
 
   Modes combine comma-separated (``p=0.3,hang=5``).  The env var is
   re-read on every dispatch, so flipping it mid-workload takes effect
@@ -70,8 +83,10 @@ __all__ = [
     "CircuitBreaker", "DeviceFault", "InjectedResourceExhausted",
     "breaker", "degraded", "device_breaker", "device_call",
     "device_degraded", "device_stats", "enabled", "fault_events",
-    "force_open_all", "injection", "is_resource_exhausted",
-    "parse_injection", "perf_dump", "reset_all", "stats_all",
+    "force_open_all", "host_breaker", "host_degraded", "host_stats",
+    "injection", "is_resource_exhausted", "parse_injection",
+    "perf_dump", "probe_raw", "reset_all", "retire_host",
+    "stats_all",
 ]
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
@@ -87,6 +102,11 @@ FAMILIES = ("ec-encode", "ec-decode", "fused-crc", "hitset-hash",
 # one chip (the mesh layer's attribution probe) is a decisive verdict,
 # unlike a family failure that might be a transient of any layer
 DEVICE_FAMILY_PREFIX = "device:"
+
+# per-HOST breaker families (parallel/multihost.py failure domains):
+# losing a host is ONE event on its host:<id> breaker — all its chips
+# read degraded through it, none of their own breakers trip
+HOST_FAMILY_PREFIX = "host:"
 
 
 def enabled() -> bool:
@@ -327,7 +347,8 @@ def breaker(family: str) -> CircuitBreaker:
         br = _breakers.get(family)
         if br is None:
             kw = {}
-            if family.startswith(DEVICE_FAMILY_PREFIX):
+            if family.startswith((DEVICE_FAMILY_PREFIX,
+                                  HOST_FAMILY_PREFIX)):
                 kw["fail_threshold"] = int(_env_float(
                     "CEPH_TPU_DEVICE_BREAKER_THRESHOLD", 1))
             br = _breakers[family] = CircuitBreaker(family, **kw)
@@ -342,12 +363,85 @@ def device_breaker(device_id: int) -> CircuitBreaker:
 
 def device_degraded(device_id: int) -> bool:
     """Read-only per-chip health: True while the chip is held out of
-    the mesh (its breaker open with an unexpired backoff).  An
-    expired backoff reads healthy — the chip rejoins the next mesh
-    build, and that dispatch is its de-facto half-open probe."""
+    the mesh — its own breaker open with an unexpired backoff, OR its
+    HOST's ``host:<id>`` breaker open (a retired host holds all its
+    chips out through ONE breaker; the chips' own breakers never
+    fire).  An expired backoff reads healthy — the chip rejoins the
+    next mesh build, and that dispatch is its de-facto half-open
+    probe."""
     if not enabled():
         return False
-    return device_breaker(device_id).degraded()
+    if device_breaker(device_id).degraded():
+        return True
+    if not _host_families_used:
+        # no host:<id> breaker exists anywhere: skip the topology
+        # lookup entirely (the single-host hot path pays nothing,
+        # and a read must never CREATE a phantom host family)
+        return False
+    return host_degraded(_host_of(device_id))
+
+
+def _host_of(device_id: int) -> int:
+    """Device -> host failure domain; 0 (the trivial domain) when the
+    topology layer is absent.  Lazy import: circuit is a leaf module
+    the parallel package builds on."""
+    try:
+        from ceph_tpu.parallel import multihost
+
+        if multihost.host_count() <= 1:
+            return 0
+        return multihost.host_of_id(device_id)
+    except Exception:  # pragma: no cover - topology layer unavailable
+        return 0
+
+
+# flipped the first time any host:<id> family is created: the
+# device_call success path only pays the host-mapping cost once host
+# failure domains are actually in play
+_host_families_used = False
+
+
+def host_breaker(host_id: int) -> CircuitBreaker:
+    """The per-host breaker: family ``host:<id>`` in the shared
+    registry (threshold 1 — host loss is a decisive, single event)."""
+    global _host_families_used
+    _host_families_used = True
+    return breaker(f"{HOST_FAMILY_PREFIX}{int(host_id)}")
+
+
+def host_degraded(host_id: int) -> bool:
+    """Read-only host health: True while every chip of the host is
+    held out (its host breaker open with an unexpired backoff).
+    Reads never create a family — a host nobody retired has no
+    breaker and is simply healthy."""
+    if not enabled():
+        return False
+    with _reg_lock:
+        br = _breakers.get(f"{HOST_FAMILY_PREFIX}{int(host_id)}")
+    return br is not None and br.degraded()
+
+
+def retire_host(host_id: int,
+                duration: Optional[float] = None) -> None:
+    """Losing a host is ONE event: trip its ``host:<id>`` breaker
+    once.  All the host's chips read degraded through it (the healthy
+    set drops them together in one mesh rebuild) and none of their
+    own threshold-1 breakers fire — retiring an 8-chip host is one
+    breaker trip, not an 8-chip breaker storm."""
+    host_breaker(host_id).force_open(duration)
+    tracing.event(f"host {host_id} retired (one event: all chips"
+                  " held out together)")
+
+
+def host_stats() -> Dict[str, Dict[str, Any]]:
+    """Per-host breaker snapshot keyed by host id (string, for the
+    prometheus label map) — the `hosts` twin of device_stats()."""
+    with _reg_lock:
+        brs = {f[len(HOST_FAMILY_PREFIX):]: br
+               for f, br in _breakers.items()
+               if f.startswith(HOST_FAMILY_PREFIX)}
+    return {h: br.stats()
+            for h, br in sorted(brs.items(), key=lambda kv: kv[0])}
 
 
 def device_stats() -> Dict[str, Dict[str, Any]]:
@@ -387,12 +481,14 @@ def stats_all() -> Dict[str, Dict[str, Any]]:
 def perf_dump() -> Dict[str, Dict[str, Any]]:
     """Numeric-only nested snapshot for `perf dump` (the prometheus
     flattener skips string leaves, so the state rides as state_code).
-    Per-chip ``device:<id>`` families are excluded here — the daemon
-    exports them under a `devices` label map instead, so chips become
-    a ``device=`` label rather than a metric name per chip."""
+    Per-chip ``device:<id>`` and per-host ``host:<id>`` families are
+    excluded here — the daemon exports them under `devices`/`hosts`
+    label maps instead, so chips and hosts become ``device=``/
+    ``host=`` labels rather than a metric name per unit."""
     return {f: {k: v for k, v in st.items() if not isinstance(v, str)}
             for f, st in stats_all().items()
-            if not f.startswith(DEVICE_FAMILY_PREFIX)}
+            if not f.startswith((DEVICE_FAMILY_PREFIX,
+                                 HOST_FAMILY_PREFIX))}
 
 
 def fault_events(families: Optional[Tuple[str, ...]] = None) -> int:
@@ -443,7 +539,8 @@ def parse_injection(raw: Optional[str]) -> Optional[Dict[str, Any]]:
     if not raw or raw == "0":
         return None
     spec: Dict[str, Any] = {"p": 0.0, "next": 0, "hang_ms": 0.0,
-                            "oom_batch": None, "sick_device": None}
+                            "oom_batch": None, "sick_device": None,
+                            "down_host": None}
     try:
         spec["p"] = float(raw)
         return spec
@@ -462,6 +559,8 @@ def parse_injection(raw: Optional[str]) -> Optional[Dict[str, Any]]:
             spec["oom_batch"] = int(val)
         elif key in ("sick", "sick_device", "sick-device"):
             spec["sick_device"] = int(val)
+        elif key in ("down_host", "down-host", "host"):
+            spec["down_host"] = int(val)
         else:
             raise ValueError(
                 f"unknown CEPH_TPU_INJECT_DEVICE_FAIL mode {part!r}")
@@ -496,6 +595,12 @@ def _maybe_inject(family: str, batch: Optional[int],
         raise DeviceFault(
             f"injected device fault ({family}: sick device"
             f" {spec['sick_device']} in dispatch set {devices})")
+    if spec["down_host"] is not None and devices \
+            and any(_host_of(d) == spec["down_host"]
+                    for d in devices):
+        raise DeviceFault(
+            f"injected host loss ({family}: host"
+            f" {spec['down_host']} down, dispatch set {devices})")
     if spec["oom_batch"] is not None and batch is not None \
             and batch > spec["oom_batch"]:
         raise InjectedResourceExhausted(
@@ -579,6 +684,31 @@ def _run_watchdog(fn: Callable, timeout: float
     return False, box   # worker abandoned with its wedged dispatch
 
 
+def probe_raw(family: str, fn: Callable,
+              devices: Optional[Tuple[int, ...]] = None,
+              timeout: Optional[float] = None) -> bool:
+    """Run one attribution probe with the watchdog and the injection
+    seam but NO breaker verdict: the host-aware mesh attribution
+    (ec/plan.py) aggregates raw per-chip results first — a whole
+    host's chips failing must become ONE host:<id> event, not N
+    device-breaker trips — and only then records where the fault
+    actually lives.  Returns True when the probe body succeeded."""
+    if not enabled():
+        try:
+            fn()
+            return True
+        except Exception:
+            return False
+
+    def _body():
+        _maybe_inject(family, 1, devices)
+        return fn()
+
+    finished, box = _run_watchdog(
+        _body, timeout if timeout is not None else _default_timeout())
+    return finished and box.get("err") is None
+
+
 def device_call(family: str, fn: Callable, *args,
                 batch: Optional[int] = None, label: str = "",
                 timeout: Optional[float] = None,
@@ -647,6 +777,15 @@ def device_call(family: str, fn: Callable, *args,
         br.record_success()
         for d in attr:
             device_breaker(d).record_success()
+        if attr and _host_families_used:
+            # a successful dispatch touching a previously-retired
+            # host's chips is the host's de-facto half-open probe:
+            # its breaker re-closes (the chips rejoined when the
+            # backoff expired; the host verdict must follow them)
+            for h in {_host_of(d) for d in attr}:
+                hb = host_breaker(h)
+                if hb.state != CLOSED:
+                    hb.record_success()
         return "ok", box.get("out")
     if isinstance(err, benign):
         # no health verdict: hand a half-open probe slot back so the

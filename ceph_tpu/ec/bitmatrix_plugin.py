@@ -129,16 +129,19 @@ class ErasureCodeJaxBitmatrix(ErasureCode):
     def encode_chunks(self, want_to_encode: Set[int],
                       encoded: Dict[int, bytearray]) -> None:
         # buffers are keyed by on-disk POSITION (chunk_index); the
-        # bitmatrix math lives in logical chunk space
+        # bitmatrix math lives in logical chunk space.  frombuffer
+        # reads in place and rows land back as buffer views (the
+        # bytes()/tobytes() round trip was two extra whole-chunk
+        # copies per encode)
         data = np.stack([
-            np.frombuffer(bytes(encoded[self.chunk_index(i)]),
+            np.frombuffer(encoded[self.chunk_index(i)],
                           dtype=np.uint8)
             for i in range(self.k)])
         packets = self._packets(data)
         coding = self._xor_matmul(self.bitmatrix, packets)
-        out = self._unpackets(coding, self.m)
+        out = np.ascontiguousarray(self._unpackets(coding, self.m))
         for j in range(self.m):
-            encoded[self.chunk_index(self.k + j)][:] = out[j].tobytes()
+            encoded[self.chunk_index(self.k + j)][:] = out[j].data
 
     def decode_chunks(self, want_to_read: Set[int],
                       chunks: Mapping[int, bytes],
@@ -157,11 +160,11 @@ class ErasureCodeJaxBitmatrix(ErasureCode):
             lambda: bmx.decode_bitmatrix(self.bitmatrix, self.k,
                                          self.w, have, erasures))
         survivors = np.stack([
-            np.frombuffer(bytes(decoded[self.chunk_index(i)]),
+            np.frombuffer(decoded[self.chunk_index(i)],
                           dtype=np.uint8)
             for i in have])
         packets = self._packets(survivors)
         rec = self._xor_matmul(rows, packets)
-        out = self._unpackets(rec, len(erasures))
+        out = np.ascontiguousarray(self._unpackets(rec, len(erasures)))
         for row, e in enumerate(erasures):
-            decoded[self.chunk_index(e)][:] = out[row].tobytes()
+            decoded[self.chunk_index(e)][:] = out[row].data

@@ -206,14 +206,17 @@ class ErasureCodeClay(ErasureCode):
                     U[e][z] = out[b, row]
             return
         # generic scalar codec: per-plane through the bytes interface
+        # (plane rows pass as contiguous views; bytearray() owns the
+        # one copy the scratch buffers genuinely need)
         for z in planes:
             sc = U[0].shape[1]
-            chunks = {i: U[i][z].tobytes()
+            chunks = {i: np.ascontiguousarray(U[i][z]).data
                       for i in range(n) if i not in erasures}
-            decoded = {i: bytearray(U[i][z].tobytes()) for i in range(n)}
+            decoded = {i: bytearray(np.ascontiguousarray(U[i][z]))
+                       for i in range(n)}
             self.mds.decode_chunks(set(erasures), chunks, decoded)
             for i in erasures:
-                U[i][z] = np.frombuffer(bytes(decoded[i]),
+                U[i][z] = np.frombuffer(decoded[i],
                                         dtype=np.uint8)[:sc]
 
     # -- layered decode (the heart; encode routes through it too) ---------
@@ -296,8 +299,10 @@ class ErasureCodeClay(ErasureCode):
         C: Dict[int, np.ndarray] = {}
         for i in range(self.k + self.m):
             node = i if i < self.k else i + self.nu
+            # ONE copy (the .copy(): C is a mutable working set); the
+            # old bytes() wrapper paid a second whole-chunk copy first
             C[node] = np.frombuffer(
-                bytes(encoded[i]), dtype=np.uint8).reshape(
+                encoded[i], dtype=np.uint8).reshape(
                     self.sub_chunk_no, sc).copy()
         for i in range(self.k, self.k + self.nu):
             C[i] = np.zeros((self.sub_chunk_no, sc), dtype=np.uint8)
@@ -310,7 +315,8 @@ class ErasureCodeClay(ErasureCode):
                         range(self.k, self.k + self.m)}
         self._decode_layered(parity_nodes, C)
         for i in range(self.k, self.k + self.m):
-            encoded[i][:] = C[i + self.nu].tobytes()
+            encoded[i][:] = np.ascontiguousarray(
+                C[i + self.nu]).reshape(-1).data
 
     def decode_chunks(self, want_to_read: Set[int],
                       chunks: Mapping[int, bytes],
@@ -321,7 +327,8 @@ class ErasureCodeClay(ErasureCode):
         self._decode_layered(erasures, C)
         for i in range(self.k + self.m):
             node = i if i < self.k else i + self.nu
-            decoded[i][:] = C[node].tobytes()
+            decoded[i][:] = np.ascontiguousarray(
+                C[node]).reshape(-1).data
 
     # -- repair (the MSR selling point) -----------------------------------
 
@@ -430,7 +437,7 @@ class ErasureCodeClay(ErasureCode):
             node = i if i < self.k else i + self.nu
             if i in chunks:
                 helper[node] = np.frombuffer(
-                    bytes(chunks[i]), dtype=np.uint8).reshape(
+                    chunks[i], dtype=np.uint8).reshape(
                         repair_subchunks, sc)
             elif i != lost_i:
                 aloof.add(node)
@@ -502,4 +509,6 @@ class ErasureCodeClay(ErasureCode):
                              i2: U[node][z]}, [i1])
                         recovered[z_sw] = out[i1]
 
-        return {lost_i: recovered.tobytes()}
+        recovered = np.ascontiguousarray(recovered)
+        recovered.setflags(write=False)
+        return {lost_i: recovered.reshape(-1).data}

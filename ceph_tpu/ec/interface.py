@@ -31,6 +31,16 @@ ErasureCodeProfile = Dict[str, str]
 SIMD_ALIGN = 32  # reference memory alignment; kept for layout-parity math
 
 
+def _freeze(buf) -> memoryview:
+    """Read-only zero-copy view of a LOCALLY-OWNED bytearray the
+    caller will never touch again (the encode/decode scratch buffers
+    below): the hot-path-copy discipline's replacement for the old
+    per-chunk bytes() materialization, which re-copied every object
+    once more on its way out."""
+    mv = buf if isinstance(buf, memoryview) else memoryview(buf)
+    return mv.toreadonly()
+
+
 class ErasureCodeError(Exception):
     def __init__(self, errno_: int, msg: str):
         super().__init__(msg)
@@ -175,7 +185,10 @@ class ErasureCode:
         want = set(want_to_encode)
         encoded = self.encode_prepare(data)
         self.encode_chunks(want, encoded)
-        return {i: bytes(b) for i, b in encoded.items() if i in want}
+        # chunks leave as frozen views of the locally-built buffers
+        # (nothing holds the bytearrays after this return)
+        return {i: _freeze(b) for i, b in encoded.items()
+                if i in want}
 
     def decode_chunks(self, want_to_read: Set[int],
                       chunks: Mapping[int, bytes],
@@ -187,7 +200,11 @@ class ErasureCode:
                chunk_size: Optional[int] = None) -> Dict[int, bytes]:
         want = set(want_to_read)
         if want <= set(chunks):
-            return {i: bytes(chunks[i]) for i in want}
+            # nothing to decode: pass the caller's buffers through
+            # (immutable already, or a view the caller owns — the
+            # msgr->OSD path feeds immutable frame views here)
+            return {i: chunks[i] if isinstance(chunks[i], bytes)
+                    else _freeze(chunks[i]) for i in want}
         if not chunks:
             raise ErasureCodeError(5, "no chunks to decode from")
         blocksize = len(next(iter(chunks.values())))
@@ -198,7 +215,7 @@ class ErasureCode:
             else:
                 decoded[i] = bytearray(blocksize)
         self.decode_chunks(want, chunks, decoded)
-        return {i: bytes(decoded[i]) for i in want}
+        return {i: _freeze(decoded[i]) for i in want}
 
     def decode_concat(self, chunks: Mapping[int, bytes]) -> bytes:
         """Reassemble data payload in chunk_mapping order (decode_concat)."""
@@ -207,7 +224,7 @@ class ErasureCode:
         out = bytearray()
         for i in range(self.get_data_chunk_count()):
             out += decoded[self.chunk_index(i)]
-        return bytes(out)
+        return _freeze(out)
 
     # -- CRUSH integration (populated once crush module lands) -----------
 

@@ -201,12 +201,16 @@ class ErasureCodeJax(ErasureCode):
     def encode_chunks(self, want_to_encode: Set[int],
                       encoded: Dict[int, bytearray]) -> None:
         k, m = self.k, self.m
+        # frombuffer reads the bytearrays in place (np.stack owns the
+        # copy it needs); parity rows land back via their buffer view
+        # — the old bytes()/tobytes() round trip re-copied every
+        # chunk twice per encode
         data = np.stack([
-            np.frombuffer(bytes(encoded[self.chunk_index(i)]), dtype=np.uint8)
+            np.frombuffer(encoded[self.chunk_index(i)], dtype=np.uint8)
             for i in range(k)])
-        parity = self._matmul(self.matrix, data)
+        parity = np.ascontiguousarray(self._matmul(self.matrix, data))
         for j in range(m):
-            encoded[self.chunk_index(k + j)][:] = parity[j].tobytes()
+            encoded[self.chunk_index(k + j)][:] = parity[j].data
 
     def decode_chunks(self, want_to_read: Set[int],
                       chunks: Mapping[int, bytes],
@@ -222,11 +226,11 @@ class ErasureCodeJax(ErasureCode):
             raise ErasureCodeError(5, "not enough chunks to decode")
         dmat = self._decode_matrix(tuple(have), tuple(erasures))
         src = np.stack([
-            np.frombuffer(bytes(decoded[self.chunk_index(i)]), dtype=np.uint8)
+            np.frombuffer(decoded[self.chunk_index(i)], dtype=np.uint8)
             for i in have])
-        out = self._matmul(dmat, src)
+        out = np.ascontiguousarray(self._matmul(dmat, src))
         for row, e in enumerate(erasures):
-            decoded[self.chunk_index(e)][:] = out[row].tobytes()
+            decoded[self.chunk_index(e)][:] = out[row].data
 
     def _decode_matrix(self, have: tuple, erasures: tuple) -> np.ndarray:
         """LRU-cached decode rows keyed by (have, erasures) — the signature

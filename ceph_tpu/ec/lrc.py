@@ -308,7 +308,9 @@ class ErasureCodeLrc(ErasureCode):
             # pick from `decoded` (not `chunks`) so chunks recovered by
             # other layers feed this one
             if c not in erasures:
-                layer_chunks[j] = bytes(decoded[c])
+                # view, not a copy: the inner decode stacks/consumes
+                # the buffer before any later layer mutates it
+                layer_chunks[j] = memoryview(decoded[c])
             if c in want_to_read or c in layer_erasures:
                 layer_want.add(j)
             layer_decoded[j] = decoded[c]

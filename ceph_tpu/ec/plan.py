@@ -99,7 +99,8 @@ _counters: Dict[str, int] = {"hits": 0, "misses": 0, "retraces": 0,
                              "dispatches": 0, "host_fallbacks": 0,
                              "oom_splits": 0, "quarantines": 0,
                              "mesh_dispatches": 0, "mesh_rows": 0,
-                             "mesh_shrinks": 0, "mesh_probes": 0}
+                             "mesh_shrinks": 0, "mesh_probes": 0,
+                             "host_retirements": 0}
 _per_plan: Dict[str, Dict[str, float]] = {}
 _enabled = os.environ.get("CEPH_TPU_PLAN_CACHE", "1") != "0"
 # poisoned-plan quarantine: a compiled callable that keeps failing is
@@ -242,7 +243,7 @@ def matrix_signature(matrix: np.ndarray, extra: str = "") -> str:
     m = np.ascontiguousarray(matrix, dtype=np.uint8)
     h = hashlib.sha256()
     h.update(repr(m.shape).encode())
-    h.update(m.tobytes())
+    h.update(m.data)    # the hash reads the buffer in place
     if extra:
         h.update(extra.encode())
     return h.hexdigest()[:16]
@@ -258,15 +259,21 @@ def codec_signature(technique: str, k: int, m: int, w: int,
 def plan_key(sig: str, kind: str, rows: int, k: int,
              batch: int, chunk_bytes: int,
              donate: bool = False,
-             mesh: Tuple[int, ...] = ()) -> tuple:
-    """Cache key: (codec signature, kind, bucketed shape, mesh).
-    Pure strings/ints/bools — identical across processes for
-    identical profiles (asserted by the key-stability test).  `mesh`
-    is the participating device-id set for a mesh-sharded plan (a
-    compiled executable binds its devices, so a plan built for a set
-    containing a now-dead chip must miss); the batch bucket rounds up
-    to a multiple of the mesh size so every chip gets whole
-    stripes."""
+             mesh: Tuple[int, ...] = (),
+             proc: tuple = ()) -> tuple:
+    """Cache key: (codec signature, kind, bucketed shape, mesh,
+    process topology).  Pure strings/ints/bools — identical across
+    processes for identical profiles (asserted by the key-stability
+    test).  `mesh` is the participating device-id set for a
+    mesh-sharded plan (a compiled executable binds its devices, so a
+    plan built for a set containing a now-dead chip must miss); the
+    batch bucket rounds up to a multiple of the mesh size so every
+    chip gets whole stripes.  `proc` is the process topology
+    (multihost.topology_signature(): process count + per-process
+    device-set signature) so plans from different CLUSTER shapes —
+    the same 8 chips as 1x8 vs 2x4 — never collide; () is the
+    trivial single-host shape, keeping single-process keys
+    bit-identical to the pre-multihost form."""
     bb = bucket_batch(batch)
     if mesh:
         bb = -(-bb // len(mesh)) * len(mesh)
@@ -274,14 +281,15 @@ def plan_key(sig: str, kind: str, rows: int, k: int,
             bucket_bytes(chunk_bytes) if kind not in
             ("encode_crc", "mesh_encode_crc")
             else int(chunk_bytes), bool(donate),
-            tuple(int(d) for d in mesh))
+            tuple(int(d) for d in mesh), tuple(proc))
 
 
 def _label(key: tuple) -> str:
-    sig, kind, rows, k, bb, bs, don, mesh = key
+    sig, kind, rows, k, bb, bs, don, mesh, proc = key
     return f"{kind}[{sig}] r{rows}k{k} B{bb} S{bs}" + \
         ("+don" if don else "") + \
-        (f"+mesh{len(mesh)}" if mesh else "")
+        (f"+mesh{len(mesh)}" if mesh else "") + \
+        (f"+hosts{proc[0]}" if proc else "")
 
 
 # ---------------------------------------------------------------------------
@@ -515,12 +523,41 @@ def _mesh_max_devices() -> int:
         return 0
 
 
+def _topology() -> tuple:
+    """The process-topology plan-key element (multihost seam); () in
+    every single-host shape."""
+    try:
+        from ceph_tpu.parallel import multihost
+
+        return multihost.topology_signature()
+    except Exception:  # pragma: no cover - topology layer unavailable
+        return ()
+
+
 def _healthy_jax_devices() -> list:
+    """The live healthy device set a mesh plan may bind: every chip
+    minus per-chip breaker holdouts minus retired hosts' chips
+    (device_degraded consults both), and — in a real multi-process
+    group — restricted to the MEMBERSHIP-AGREED set
+    (multihost.agreed_healthy: each process publishes its local
+    observations through the coordinator KV store; a dead host reads
+    as a timeout and is retired, never waited on in a collective), so
+    every surviving process derives the same mesh."""
     try:
         devs = list(jax.devices())
     except Exception:
         return []
-    return [d for d in devs if not circuit.device_degraded(d.id)]
+    healthy = [d for d in devs if not circuit.device_degraded(d.id)]
+    try:
+        from ceph_tpu.parallel import multihost
+
+        if multihost.is_multiprocess():
+            agreed = set(multihost.agreed_healthy(
+                [d.id for d in healthy]))
+            healthy = [d for d in healthy if d.id in agreed]
+    except Exception:  # pragma: no cover - agreement unavailable
+        pass
+    return healthy
 
 
 def _mesh_devices(batch: int, nbytes: int) -> Optional[tuple]:
@@ -580,12 +617,104 @@ def _probe_devices(device_ids: Sequence[int]) -> list:
     return sick
 
 
+def _host_aware() -> bool:
+    """True when the topology spans more than one host failure domain
+    (a real multi-process group, or the emulated in-process
+    CEPH_TPU_MULTIHOST_HOSTS partition) — host-level attribution only
+    makes sense then; single-host keeps the PR-9 per-chip path
+    bit-identically."""
+    try:
+        from ceph_tpu.parallel import multihost
+
+        return multihost.host_count() > 1
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _attribute_failure(device_ids: Sequence[int]
+                       ) -> Tuple[List[int], List[int]]:
+    """Host-aware attribution of a failed mesh dispatch: probe each
+    LOCALLY-addressable participant verdict-free (circuit.probe_raw —
+    watchdog + injection seam, NO breaker recording), then aggregate
+    BEFORE any verdict lands:
+
+    * a host ALL of whose participating chips failed is retired as
+      ONE ``host:<id>`` breaker event — its chips' own breakers never
+      fire (no N-chip breaker storm);
+    * chips failing inside a still-alive host trip their own
+      threshold-1 breakers (the PR-9 sick-chip semantics);
+    * REMOTE hosts (a real multi-process group) are never probed from
+      here — the collective-safe membership agreement owns their
+      verdict: the memo is invalidated and the next healthy-set
+      derivation re-agrees, retiring hosts that no longer answer.
+
+    Returns (retired hosts, sick devices)."""
+    from ceph_tpu.parallel import multihost
+
+    # snapshot hosts ALREADY degraded before this round: a host in
+    # backoff from an earlier retirement must not be re-reported as
+    # this failure's attribution (that would absolve the family
+    # breaker forever and spin the shrink loop on an unchanged set)
+    pre_degraded = {h for h in multihost.hosts()
+                    if circuit.host_degraded(h)}
+    by_host: Dict[int, List[int]] = {}
+    for did in device_ids:
+        by_host.setdefault(multihost.host_of_id(did), []).append(did)
+    dev_by_id = {d.id: d for d in (jax.devices() if HAVE_JAX else [])}
+    retired: List[int] = []
+    sick: List[int] = []
+    for host, ids in sorted(by_host.items()):
+        if not multihost.local_addressable(host):
+            continue  # agreement, not local probes, owns remote hosts
+        bad = []
+        for did in ids:
+            dev = dev_by_id.get(did)
+
+            def probe(d=dev):
+                x = jax.device_put(np.arange(8, dtype=np.uint8), d)
+                return np.asarray(x + 1)
+
+            ok = dev is not None and circuit.probe_raw(
+                f"{circuit.DEVICE_FAMILY_PREFIX}{did}", probe,
+                devices=(did,), timeout=_probe_timeout())
+            with _lock:
+                _counters["mesh_probes"] += 1
+            if not ok:
+                bad.append(did)
+        if not bad:
+            continue
+        if len(bad) == len(ids):
+            # the whole host's complement failed: ONE event
+            circuit.retire_host(host)
+            retired.append(host)
+            with _lock:
+                _counters["host_retirements"] += 1
+        else:
+            for did in bad:
+                circuit.device_breaker(did).record_failure()
+                sick.append(did)
+    if multihost.is_multiprocess():
+        multihost.membership_changed()
+        healthy = [d.id for d in dev_by_id.values()
+                   if not circuit.device_degraded(d.id)]
+        multihost.agreed_healthy(healthy)  # retires unreachable hosts
+        # report only hosts that became degraded IN THIS round —
+        # earlier retirements still in backoff are not this
+        # failure's attribution
+        retired.extend(h for h in multihost.hosts()
+                       if circuit.host_degraded(h)
+                       and h not in pre_degraded
+                       and h not in retired)
+    return retired, sick
+
+
 def _mesh_dispatch(family: str, key: tuple, plan: ExecPlan,
                    args: tuple, batch: int) -> Tuple[str, object]:
-    """One mesh-plan dispatch with sick-chip attribution.  Returns
-    ("ok", out) / ("oom", None) / ("shrunk", None) — a sick chip was
-    found and tripped, re-plan on the survivors — / ("fail", None) —
-    a genuine (non-chip) failure, fall to the single-device plan."""
+    """One mesh-plan dispatch with sick-chip / lost-host attribution.
+    Returns ("ok", out) / ("oom", None) / ("shrunk", None) — a sick
+    chip or dead host was found and retired, re-plan on the survivors
+    — / ("fail", None) — a genuine (non-chip) failure, fall to the
+    single-device plan."""
     status, out = _guarded(family, key, plan, args, batch,
                            defer_verdict=True)
     if status == "ok":
@@ -597,16 +726,22 @@ def _mesh_dispatch(family: str, key: tuple, plan: ExecPlan,
         return "oom", None
     if status == "open":
         return "fail", None
-    sick = _probe_devices(plan.devices)
-    if sick:
-        # the chip's breaker owns the fault (tripped by its probe);
-        # the family must not stay tripped or every caller would
-        # degrade to host — the point of the shrink is that they
-        # re-plan instead
+    if _host_aware():
+        hosts_lost, sick = _attribute_failure(plan.devices)
+    else:
+        hosts_lost, sick = [], _probe_devices(plan.devices)
+    if hosts_lost or sick:
+        # the chip's/host's breaker owns the fault (tripped by its
+        # probe / the membership verdict); the family must not stay
+        # tripped or every caller would degrade to host — the point
+        # of the shrink is that they re-plan instead.  Losing a host
+        # is ONE shrink, exactly like losing one chip.
         circuit.breaker(family).absolve()
         with _lock:
             _counters["mesh_shrinks"] += 1
         tracing.event(
+            f"mesh shrink: host(s) {hosts_lost} / device(s) {sick}"
+            " retired" if hosts_lost else
             f"mesh shrink: sick device(s) {sick} retired")
         return "shrunk", None
     _note_plan_failure(key)
@@ -635,8 +770,8 @@ def mesh_info() -> dict:
     with _lock:
         counters = {k: _counters[k] for k in
                     ("mesh_dispatches", "mesh_rows", "mesh_shrinks",
-                     "mesh_probes")}
-    return {
+                     "mesh_probes", "host_retirements")}
+    out = {
         "enabled": mesh_enabled(),
         "devices_total": total,
         "healthy": healthy,
@@ -644,6 +779,21 @@ def mesh_info() -> dict:
         "min_stripes": _mesh_min_stripes(),
         **counters,
     }
+    # host failure-domain topology (the multihost seam): process
+    # count, per-host device sets, per-host health
+    try:
+        from ceph_tpu.parallel import multihost
+
+        out["hosts"] = {
+            str(h): {"devices": list(ids),
+                     "degraded": int(circuit.host_degraded(h))}
+            for h, ids in sorted(multihost.hosts().items())}
+        out["host_count"] = multihost.host_count()
+        out["processes"] = multihost.process_count()
+        out["multihost_enabled"] = multihost.enabled()
+    except Exception:  # pragma: no cover
+        pass
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -663,16 +813,34 @@ def _build_local_encode(key: tuple, donate: bool) -> ExecPlan:
     return ExecPlan(key, run, "xla_bits" + ("+donate" if donate else ""))
 
 
+def _wrap_gather(jfn: Callable) -> Callable:
+    """Cross-process plans hold only their addressable output shards
+    per process; materialize through the allgather so _guarded's
+    np.asarray (and the watchdog) see the whole result.  Identity in
+    every single-process shape."""
+    from ceph_tpu.parallel import multihost
+
+    if not multihost.is_multiprocess():
+        return jfn
+
+    def run(*args):
+        return multihost.gather(jfn(*args))
+
+    return run
+
+
 def _build_mesh_encode(key: tuple, devices: tuple) -> ExecPlan:
     """Stripe-parallel mesh twin of the local encode plan: the same
-    bit-matmul shard_mapped over a pure data-parallel mesh of the
-    surviving chips (parallel/striped.py owns the kernel + the
+    bit-matmul shard_mapped over a stripe-parallel mesh of the
+    surviving chips — hybrid ("dcn", "dp") when they span hosts, flat
+    ("dp",) within one (parallel/striped.py owns the kernel + the
     logical axis rules)."""
     from ceph_tpu.parallel import striped
 
     mesh = striped.stripe_mesh(list(devices))
     jfn, sharding = striped.build_mesh_encode(mesh, _label(key))
-    return ExecPlan(key, jfn, f"mesh_bits[{len(devices)}]",
+    return ExecPlan(key, _wrap_gather(jfn),
+                    f"mesh_bits[{len(devices)}]",
                     sharding=sharding,
                     devices=tuple(d.id for d in devices))
 
@@ -687,7 +855,8 @@ def _build_mesh_encode_crc(key: tuple, devices: tuple,
     mesh = striped.stripe_mesh(list(devices))
     jfn, sharding = striped.build_mesh_encode_crc(
         mesh, chunk_bytes, _label(key))
-    return ExecPlan(key, jfn, f"mesh_bits+crc[{len(devices)}]",
+    return ExecPlan(key, _wrap_gather(jfn),
+                    f"mesh_bits+crc[{len(devices)}]",
                     sharding=sharding,
                     devices=tuple(d.id for d in devices))
 
@@ -701,11 +870,12 @@ def _mesh_encode_attempt(kind: str, family: str, matrix: np.ndarray,
     plan — or ("ok", out) / ("oom", None).  Out is the raw padded
     plan output; callers slice."""
     devices = _mesh_devices(b, b * k * s)
-    for _attempt in range(8):           # shrink at most once per chip
+    for _attempt in range(8):       # shrink at most once per domain
         if not devices:
             return "none", None
         ids = tuple(d.id for d in devices)
-        key = plan_key(sig, kind, rows, k, b, s, mesh=ids)
+        key = plan_key(sig, kind, rows, k, b, s, mesh=ids,
+                       proc=_topology())
         if _quarantined(key):
             return "none", None
         if kind == "mesh_encode_crc":
@@ -717,9 +887,14 @@ def _mesh_encode_attempt(kind: str, family: str, matrix: np.ndarray,
         bb, bs = key[4], key[5]
         # shard straight from host bytes in ONE device_put — landing
         # on the default device first and re-scattering would double
-        # the transfer on the flush hot path
-        padded = jax.device_put(_pad_batch(arr, bb, bs),
-                                plan.sharding)
+        # the transfer on the flush hot path.  Cross-process plans
+        # assemble the global array from each process's addressable
+        # shards instead (the SPMD contract: every process holds the
+        # same logical batch).
+        from ceph_tpu.parallel import multihost
+
+        padded = multihost.put_global(_pad_batch(arr, bb, bs),
+                                      plan.sharding)
         status, out = _mesh_dispatch(
             family, key, plan, (_mbits_for(matrix), padded), b)
         if status in ("ok", "oom"):
@@ -855,7 +1030,7 @@ def matmul(mat: np.ndarray, data, sig: str = None,
         # a shrink retires the dead chip's plans by key miss
         mesh_sig = backend.mesh_device_ids()
         key = plan_key(sig or "*", "matmul", rows, k, b, s,
-                       mesh=mesh_sig)
+                       mesh=mesh_sig, proc=_topology())
         if _quarantined(key):
             return None
         plan = _get_plan(key, lambda: _build_mesh_matmul(key))

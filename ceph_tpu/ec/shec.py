@@ -167,12 +167,14 @@ class ErasureCodeShec(ErasureCode):
     def encode_chunks(self, want_to_encode: Set[int],
                       encoded: Dict[int, bytearray]) -> None:
         k, m = self.k, self.m
+        # in-place reads + buffer-view writes: the bytes()/tobytes()
+        # round trip copied every chunk twice more per encode
         data = np.stack([
-            np.frombuffer(bytes(encoded[i]), dtype=np.uint8)
+            np.frombuffer(encoded[i], dtype=np.uint8)
             for i in range(k)])
-        parity = self._matmul(self.matrix, data)
+        parity = np.ascontiguousarray(self._matmul(self.matrix, data))
         for j in range(m):
-            encoded[k + j][:] = parity[j].tobytes()
+            encoded[k + j][:] = parity[j].data
 
     # -- recovery-set search (shec_make_decoding_matrix) ------------------
 
@@ -294,19 +296,23 @@ class ErasureCodeShec(ErasureCode):
                 5, "can't find recover matrix for erasure pattern")
         row_ids, col_ids, inv, _minimum = result
         if row_ids:
+            # np.stack owns the copy it needs at read time; recovered
+            # columns land back as buffer views (writes target erased
+            # buffers only — disjoint from the stacked sources)
             src = np.stack([
-                np.frombuffer(bytes(decoded[r]), dtype=np.uint8)
+                np.frombuffer(decoded[r], dtype=np.uint8)
                 for r in row_ids])
-            out = self._matmul(inv, src)
+            out = np.ascontiguousarray(self._matmul(inv, src))
             for ci, col in enumerate(col_ids):
-                decoded[col][:] = out[ci].tobytes()
+                decoded[col][:] = out[ci].data
         # wanted missing parity: re-encode from (now complete) data windows
         lost_parity = [i for i in range(m)
                        if (k + i) in want_to_read and (k + i) not in available]
         if lost_parity:
             data = np.stack([
-                np.frombuffer(bytes(decoded[i]), dtype=np.uint8)
+                np.frombuffer(decoded[i], dtype=np.uint8)
                 for i in range(k)])
-            parity = self._matmul(self.matrix[lost_parity, :], data)
+            parity = np.ascontiguousarray(
+                self._matmul(self.matrix[lost_parity, :], data))
             for row, i in enumerate(lost_parity):
-                decoded[k + i][:] = parity[row].tobytes()
+                decoded[k + i][:] = parity[row].data

@@ -17,6 +17,16 @@ dmclock's delayed-tag throttling plays this role in the reference
 (the client-side delta/rho loop); single-OSD scope here, so a plain
 bucket is the honest equivalent.
 
+Hot-accept-path discipline (ROADMAP item 2 tail): at extreme tenant
+counts the gate itself must cost nothing when it passes untouched.
+``try_admit()`` is the SYNCHRONOUS fast path — one O(1) bucket
+lookup, no coroutine allocation, no per-op profile resolution (the
+tenant's mClock limit is cached IN the bucket entry with a short
+TTL, so the `client.<tenant>` class-string build and the profile
+dict walk happen once per tenant per TTL window, not once per op).
+Only an op the bucket cannot cover falls to the awaitable ``admit``
+slow path, where the delay sleep / shed verdict lives.
+
 Bounded state: tenant buckets live in an LRU capped at
 `_BUCKET_CAP`; per-tenant decision counters are capped the same way
 (the perf-dump `tenants` map must not itself become the unbounded
@@ -38,6 +48,13 @@ DELAY = "delay"
 SHED = "shed"
 
 _BUCKET_CAP = 4096
+# how long a bucket's cached mClock limit serves before the profile
+# resolver is consulted again (config pushes land within this window)
+_LIMIT_TTL_S = 1.0
+
+# bucket entry slots: [tokens, last_refill, cached_limit,
+#                      limit_expiry]
+_TOKENS, _LAST, _LIMIT, _EXPIRY = 0, 1, 2, 3
 
 
 class AdmissionGate:
@@ -70,15 +87,25 @@ class AdmissionGate:
     def _limit(self, tenant: str) -> float:
         return float(self._profile_of(tenant)[2])
 
-    def _bucket(self, tenant: str, limit: float) -> list:
+    def _bucket(self, tenant: str, now: float) -> list:
+        """O(1) on the hot path: one dict lookup + LRU touch.  The
+        tenant's limit rides in the entry and refreshes on a short
+        TTL — the per-op profile resolution (a `client.<t>` string
+        build plus profile-map walks) was a measurable cost at
+        extreme tenant counts."""
         b = self._buckets.get(tenant)
         if b is None:
-            b = [limit * self.burst_s, time.monotonic()]
+            limit = self._limit(tenant)
+            b = [limit * self.burst_s, now, limit,
+                 now + _LIMIT_TTL_S]
             self._buckets[tenant] = b
             while len(self._buckets) > _BUCKET_CAP:
                 self._buckets.popitem(last=False)
         else:
             self._buckets.move_to_end(tenant)
+            if now >= b[_EXPIRY]:
+                b[_LIMIT] = self._limit(tenant)
+                b[_EXPIRY] = now + _LIMIT_TTL_S
         return b
 
     def _count(self, tenant: str, decision: str) -> None:
@@ -93,33 +120,51 @@ class AdmissionGate:
             self._tenant_counters.move_to_end(tenant)
         c[decision] += 1
 
-    async def admit(self, tenant: str, cost: float = 1.0) -> str:
-        """Returns ADMIT (possibly after an in-gate delay, counted
-        DELAY) or SHED.  Unlimited tenants and a disabled gate admit
-        unconditionally."""
+    def try_admit(self, tenant: str,
+                  cost: float = 1.0) -> Optional[str]:
+        """The allocation-free SYNCHRONOUS fast path: ADMIT when the
+        gate passes the op untouched (disabled gate, unlimited
+        tenant, or the bucket covers the cost) — no coroutine object,
+        no profile resolution, one bucket lookup.  None means the
+        slow path must decide (delay or shed): callers then
+        ``await admit(tenant, cost)``."""
         if not self.enabled:
             return ADMIT
-        limit = self._limit(tenant)
+        now = time.monotonic()
+        b = self._bucket(tenant, now)
+        limit = b[_LIMIT]
         if limit <= 0:
             self._count(tenant, ADMIT)
             return ADMIT
-        b = self._bucket(tenant, limit)
-        now = time.monotonic()
         cap = max(limit * self.burst_s, cost)
-        b[0] = min(cap, b[0] + (now - b[1]) * limit)
-        b[1] = now
-        if b[0] >= cost:
-            b[0] -= cost
+        b[_TOKENS] = min(cap, b[_TOKENS] + (now - b[_LAST]) * limit)
+        b[_LAST] = now
+        if b[_TOKENS] >= cost:
+            b[_TOKENS] -= cost
             self._count(tenant, ADMIT)
             return ADMIT
-        wait = (cost - b[0]) / limit
+        return None
+
+    async def admit(self, tenant: str, cost: float = 1.0) -> str:
+        """Returns ADMIT (possibly after an in-gate delay, counted
+        DELAY) or SHED.  Unlimited tenants and a disabled gate admit
+        unconditionally.  Hot-path callers should consult
+        ``try_admit`` first and only await here on its None — this
+        coroutine re-runs the fast path, so calling both never
+        double-charges."""
+        fast = self.try_admit(tenant, cost)
+        if fast is not None:
+            return fast
+        b = self._buckets[tenant]
+        limit = b[_LIMIT]
+        wait = (cost - b[_TOKENS]) / limit
         if wait <= self.max_delay_s:
             # the delay IS the charge: the refill during the sleep
             # covers the op.  The smoothing sleep is a pipeline stage
             # an op can visibly spend its time in — span it (no-op
             # when the op is untraced; an instant ADMIT above costs
             # no wall time and gets no span)
-            b[0] -= cost
+            b[_TOKENS] -= cost
             self._count(tenant, DELAY)
             async with tracing.child_span("admission",
                                           tenant=tenant) as sp:

@@ -40,6 +40,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ceph_tpu.crush.map import CRUSH_ITEM_NONE
 from ceph_tpu.ec.registry import create_erasure_code
+from ceph_tpu.common import buffer as buffer_mod
 from ceph_tpu.common import lockdep, tracing
 from ceph_tpu.msg import Connection, Messenger
 from ceph_tpu.msg.messages import (
@@ -3784,9 +3785,15 @@ class OSDDaemon:
                 # the admission gate runs BEFORE the op queue: an
                 # over-limit tenant is delayed/shed here, before its
                 # op consumes a queue slot or any encode-service/
-                # hedge/tier resources at the execute stage
-                if await self.admission.admit(tenant,
-                                              cost) == SHED:
+                # hedge/tier resources at the execute stage.  The
+                # synchronous fast path carries the common under-
+                # limit accept with zero per-op allocation; only a
+                # bucket miss awaits the delay/shed slow path.
+                decision = self.admission.try_admit(tenant, cost)
+                if decision is None:
+                    decision = await self.admission.admit(tenant,
+                                                          cost)
+                if decision == SHED:
                     admitted = False
             try:
                 if not admitted:
@@ -4172,8 +4179,15 @@ class OSDDaemon:
             width = sinfo.get_stripe_width()
             pad = -len(data) % width
             # data may be a zero-copy memoryview of the op frame; only
-            # materialize when padding actually forces a copy
-            padded = (bytes(data) + bytes(pad)) if pad else data
+            # materialize when padding actually forces a copy — and
+            # then exactly ONE copy into a right-sized buffer (the
+            # bytes(data) + bytes(pad) concat paid two)
+            if pad:
+                padbuf = bytearray(len(data) + pad)
+                padbuf[:len(data)] = data
+                padded = memoryview(padbuf).toreadonly()
+            else:
+                padded = data
             # awaited BEFORE the version is allocated: concurrent
             # writes batch their encodes into shared device dispatches
             # (encode_service), and no suspension point sits between
@@ -4359,12 +4373,13 @@ class OSDDaemon:
                         prefer=self._shard_rank(state))
                     frags = {}
                     for s, payload in chosen_frags.items():
-                        # view of the sub-read frame; materialize
-                        # only the short-shard pad case
+                        # view of the sub-read frame; pad the short-
+                        # shard case with ONE right-sized copy
                         buf = memoryview(payload)[:frag_len]
                         if len(buf) < frag_len:
-                            buf = bytes(buf) + \
-                                bytes(frag_len - len(buf))
+                            pb = bytearray(frag_len)
+                            pb[:len(buf)] = buf
+                            buf = memoryview(pb).toreadonly()
                         frags[s] = buf
                     self.perf["decode_dispatches"] += 1
                     decoded = await self.encode_service.decode(
@@ -4380,10 +4395,12 @@ class OSDDaemon:
         # re-encode awaited BEFORE the version is allocated (same
         # ordering discipline as _op_write_full_locked): concurrent
         # RMWs share a batched dispatch through the encode service.
-        # ONE materialization of the merged span serves the encode
-        # AND the extent cache below (it was two).
+        # ZERO materializations of the merged span: the local
+        # bytearray never escapes or mutates past this point, so a
+        # frozen view serves the encode AND the extent cache (it was
+        # one full copy, and before PR 12 two).
         self.perf["encode_dispatches"] += 1
-        merged_b = bytes(merged)
+        merged_b = memoryview(merged).toreadonly()
         shards = await self.encode_service.encode(
             sinfo, codec, merged_b, range(n))
         entry = self._next_entry(state, pool, oid, "modify", new_size)
@@ -4597,7 +4614,8 @@ class OSDDaemon:
                             state.primary != self.osd_id:
                         span.event("aborted: interval moved mid-decode")
                         return
-                    self.tier.end_promote(pg, oid, bytes(payload))
+                    self.tier.end_promote(pg, oid,
+                                          buffer_mod.adopt(payload))
                     installed = True
                     span.event(f"promoted {len(payload)}B")
             await self.scheduler.run(sched_mod.BEST_EFFORT, 4.0,
@@ -4742,11 +4760,14 @@ class OSDDaemon:
                 return EIO, b""
             frags = {}
             for s, payload in chosen_frags.items():
-                # view of the sub-read frame; materialize ONLY the
-                # short-shard pad case (reads past the object end)
+                # view of the sub-read frame; the short-shard case
+                # (reads past the object end) pads with ONE
+                # right-sized copy
                 buf = memoryview(payload)[:frag_len]
                 if len(buf) < frag_len:
-                    buf = bytes(buf) + bytes(frag_len - len(buf))
+                    pb = bytearray(frag_len)
+                    pb[:len(buf)] = buf
+                    buf = memoryview(pb).toreadonly()
                 frags[s] = buf
             self.perf["decode_dispatches"] += 1
             data = await self.encode_service.decode(sinfo, codec,

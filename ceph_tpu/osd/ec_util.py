@@ -185,8 +185,9 @@ def encode_with_hinfo(sinfo: StripeInfo, ec_impl, data,
     if (matrix is None or ec_impl.get_chunk_mapping() or lib is None
             or use_device
             or not hasattr(lib, "ceph_tpu_ec_encode_noT")):
-        if not isinstance(data, (bytes, bytearray, memoryview)):
-            data = bytes(data)
+        from ceph_tpu.common.buffer import as_buffer
+
+        data = as_buffer(data)
         shards = encode(sinfo, ec_impl, data, want)
         hinfo = HashInfo(n)
         hinfo.append(0, shards)
@@ -327,8 +328,9 @@ def _encode_with_hinfo_device(sinfo: StripeInfo, ec_impl, data,
     if fmin is None or len(data) < max(fmin, 1) \
             or not hasattr(ec_impl, "encode_batch_with_crc"):
         return None
-    if not isinstance(data, (bytes, bytearray, memoryview)):
-        data = bytes(data)
+    from ceph_tpu.common.buffer import as_buffer
+
+    data = as_buffer(data)
     width = sinfo.get_stripe_width()
     chunk = sinfo.get_chunk_size()
     if len(data) % width or ec_impl.get_chunk_size(width) != chunk:
@@ -395,11 +397,12 @@ def _encode_many_device(sinfo: StripeInfo, ec_impl, items):
     chunk = sinfo.get_chunk_size()
     if ec_impl.get_chunk_size(width) != chunk:
         return None
+    from ceph_tpu.common.buffer import as_buffer
+
     datas = []
     total = 0
     for d, _w, _l in items:
-        if not isinstance(d, (bytes, bytearray, memoryview)):
-            d = bytes(d)
+        d = as_buffer(d)
         if len(d) == 0 or len(d) % width:
             return None
         datas.append(d)
@@ -439,8 +442,9 @@ def encode_many(sinfo: StripeInfo, ec_impl, datas,
     chunk = sinfo.get_chunk_size()
 
     def one(d, w) -> Dict[int, bytes]:
-        return encode(sinfo, ec_impl,
-                      d if isinstance(d, bytes) else bytes(d), w)
+        from ceph_tpu.common.buffer import as_buffer
+
+        return encode(sinfo, ec_impl, as_buffer(d), w)
 
     if len(datas) <= 1 or any(len(d) % width for d in datas):
         return [one(d, w) for d, w in zip(datas, wants)]
@@ -590,10 +594,17 @@ def decode(sinfo: StripeInfo, ec_impl,
         full.setflags(write=False)
         return full.reshape(-1).data
 
+    from ceph_tpu.common.buffer import as_buffer
+
     out = []
+    # slice views, not byte ranges: one memoryview per stream, every
+    # per-stripe chunk a zero-copy window of it (as_buffer adapts
+    # StridedBuf shards with their one cached materialization)
+    views = {i: memoryview(as_buffer(buf))
+             for i, buf in to_decode.items()}
     for s in range(n_stripes):
-        chunks = {i: buf[s * chunk:(s + 1) * chunk]
-                  for i, buf in to_decode.items()}
+        chunks = {i: mv[s * chunk:(s + 1) * chunk]
+                  for i, mv in views.items()}
         row = ec_impl.decode_concat(chunks)
         assert len(row) == width
         out.append(row)

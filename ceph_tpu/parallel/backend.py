@@ -41,16 +41,26 @@ stats: Dict[str, int] = {"matmul_calls": 0, "batch_rows": 0,
 
 def healthy_devices() -> List:
     """The live device set minus chips whose per-device breaker holds
-    them out.  Never empty while jax has devices: with every chip
-    degraded, device 0 is kept so the family breaker (which owns the
-    'device tier entirely down' verdict) still decides host fallback.
+    them out and minus RETIRED HOSTS' chips (circuit.device_degraded
+    consults the chip's ``host:<id>`` breaker too, so losing a host
+    drops all its chips in ONE rebuild).  In a real multi-process
+    group the decode-path mesh stays within this process's
+    addressable devices — per-OSD decode work is host-local; the
+    cross-host product path is the mesh ExecPlans in ec/plan.py.
+    Never empty while jax has devices: with every chip degraded,
+    device 0 is kept so the family breaker (which owns the 'device
+    tier entirely down' verdict) still decides host fallback.
     CEPH_TPU_MESH=0 pins the set to one device (the single-chip kill
     switch — bit-identical to the pre-mesh behavior)."""
     import jax
 
     from ceph_tpu.common import circuit
+    from ceph_tpu.parallel import multihost
 
-    devs = list(jax.devices())
+    if multihost.is_multiprocess():
+        devs = list(jax.local_devices())
+    else:
+        devs = list(jax.devices())
     if os.environ.get("CEPH_TPU_MESH", "1") == "0":
         return devs[:1]
     healthy = [d for d in devs if not circuit.device_degraded(d.id)]
@@ -71,7 +81,10 @@ _mesh_cache: Dict[tuple, object] = {}
 
 def default_mesh():
     """The healthy-set mesh, rebuilt when the set changes (tests and
-    the multichip dryrun override this symbol to pin a mesh)."""
+    the multichip dryrun override this symbol to pin a mesh).  A set
+    spanning multiple host failure domains lays out as the hybrid
+    ("dcn", "dp") stripe mesh — sp never crosses DCN."""
+    from ceph_tpu.parallel import multihost
     from ceph_tpu.parallel.mesh import make_mesh
 
     devs = healthy_devices()
@@ -82,7 +95,11 @@ def default_mesh():
             stats["mesh_rebuilds"] += 1
         if len(_mesh_cache) > 16:       # bound churn bookkeeping
             _mesh_cache.clear()
-        mesh = _mesh_cache[sig] = make_mesh(devs)
+        spans_hosts = len({multihost.host_of_id(d.id)
+                           for d in devs}) > 1
+        mesh = _mesh_cache[sig] = (
+            multihost.hybrid_stripe_mesh(devs) if spans_hosts
+            else make_mesh(devs))
     return mesh
 
 
@@ -142,8 +159,10 @@ def matmul(mat: np.ndarray, data) -> Optional[np.ndarray]:
     b, k, s = arr.shape
     if s == 0 or s % 4:
         return None
+    from ceph_tpu.parallel.striped import data_parallel_size
+
     mesh = _mesh_for_chunk(s)
-    dp = dict(mesh.shape).get("dp", 1)
+    dp = data_parallel_size(mesh)
     pipe = _pipeline(k, len(mat), s, _mesh_sig(mesh))
     pad = -b % dp
     if pad:

@@ -17,11 +17,23 @@ driver tail (`__graft_entry__.dryrun_multichip`), and the test tier:
   multi-chip hardware near-linear scaling is the acceptance shape;
   on a single-core host with virtual devices the sweep still proves
   the plans compile and stay bit-exact at every size.
+* ``multihost_report(processes)`` — the CROSS-HOST legs (PR-13
+  tentpole proof): a ``--processes`` sweep axis spawning real
+  ``jax.distributed`` process groups (each worker bootstraps through
+  the ``parallel/multihost.py`` seam, devices split per process,
+  hybrid DCN x ICI mesh) with bit-exactness vs the single-process
+  leg and the host oracle; plus a HOST-LOSS shrink leg over the
+  emulated 2-host topology — ``down_host=<H>`` injection must retire
+  the host as ONE event (host:<id> breaker, no per-chip storm),
+  re-plan on the survivor host in one shrink, zero host(CPU)
+  fallbacks, ``fused-crc`` family still closed, output bit-exact.
 
-CLI (``python -m ceph_tpu.parallel.meshbench --probe|--sweep``)
-prints ONE JSON line — bench.py runs it as a subprocess so the
-device-count virtualization (XLA_FLAGS) can be applied before the
-backend initializes, and a wedged tunnel stays contained.
+CLI (``python -m ceph_tpu.parallel.meshbench
+--probe|--sweep|--processes 1,2``) prints ONE JSON line — bench.py
+runs it as a subprocess so the device-count virtualization
+(XLA_FLAGS) can be applied before the backend initializes, and a
+wedged tunnel stays contained.  ``--worker`` is the internal
+per-process entry the ``--processes`` driver spawns.
 """
 
 from __future__ import annotations
@@ -251,6 +263,222 @@ def _sweep_report(sizes: Optional[List[int]], smoke: bool,
     }
 
 
+# ---------------------------------------------------------------------------
+# Multi-host legs: real process groups + the emulated host-loss shrink
+# ---------------------------------------------------------------------------
+
+
+def host_loss_report(smoke: bool = True) -> dict:
+    """The host-loss shrink leg, hermetic in one process: the
+    EMULATED 2-host topology (CEPH_TPU_MULTIHOST_HOSTS=2 over the
+    virtual devices) with ``down_host=1`` injection.  Losing the host
+    must be ONE event — its ``host:<id>`` breaker trips once, every
+    chip reads degraded through it with ZERO per-chip breaker trips —
+    the dispatch re-plans on the survivor host in ONE shrink, nothing
+    falls back to the host CPU path, the ``fused-crc`` family stays
+    closed, and the output is bit-exact."""
+    from ceph_tpu.common import circuit
+    from ceph_tpu.ec import plan
+    from ceph_tpu.parallel import multihost
+
+    n = ensure_devices()
+    if n < 2:
+        return {"multihost_hosts": 1, "host_loss_shrunk": None}
+    saved = {k: os.environ.get(k) for k in
+             ("CEPH_TPU_MULTIHOST_HOSTS",
+              "CEPH_TPU_INJECT_DEVICE_FAIL")}
+    os.environ["CEPH_TPU_MULTIHOST_HOSTS"] = "2"
+    matrix, data, m = _workload(smoke)
+    oracle = _host_oracle(matrix, data)
+    try:
+        with _mesh_gates_open():
+            circuit.reset_all()
+            plan.reset_stats()
+            clean = _encode_crc(matrix, data, n)
+            os.environ["CEPH_TPU_INJECT_DEVICE_FAIL"] = "down_host=1"
+            lost = _encode_crc(matrix, data, n)
+            st = plan.stats()
+            chip_trips = sum(
+                1 for d in range(n)
+                if circuit.device_breaker(d).state != circuit.CLOSED)
+            return {
+                "multihost_hosts": 2,
+                "host_loss_bitexact": int(
+                    clean is not None and lost is not None
+                    and np.array_equal(clean[0], oracle)
+                    and np.array_equal(lost[0], oracle)),
+                "host_loss_shrunk": int(st["mesh_shrinks"] == 1),
+                "host_retirements": st["host_retirements"],
+                "host_loss_one_event": int(
+                    st["host_retirements"] == 1 and chip_trips == 0),
+                "host_loss_host_fallbacks": st["host_fallbacks"],
+                "host_loss_fused_crc_closed": int(
+                    circuit.breaker("fused-crc").state
+                    == circuit.CLOSED),
+            }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        circuit.reset_all()
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_process_group(nproc: int, smoke: bool,
+                         timeout_s: float) -> Optional[dict]:
+    """Spawn a real ``jax.distributed`` group of `nproc` CPU worker
+    processes (2 virtual devices each) running the fused encode+crc
+    workload over the hybrid DCN x ICI mesh; returns worker 0's JSON
+    report or None.  `timeout_s` is ONE shared deadline for the whole
+    group (not per worker), and every worker arms its own
+    self-destruct at deadline+margin — if this driver is itself
+    killed by an outer timeout, no grandchild stays wedged in a gloo
+    collective forever."""
+    import subprocess
+    import sys as _sys
+
+    port = _free_port()
+    procs = []
+    env_base = {k: v for k, v in os.environ.items()
+                if k != "XLA_FLAGS"}
+    for pid in range(nproc):
+        env = dict(env_base)
+        env.update({
+            "CEPH_TPU_MULTIHOST_COORD": f"127.0.0.1:{port}",
+            "CEPH_TPU_MULTIHOST_NPROC": str(nproc),
+            "CEPH_TPU_MULTIHOST_PID": str(pid),
+            "CEPH_TPU_MULTIHOST_LOCAL_DEVICES": "2",
+            "CEPH_TPU_MESH_MIN_BYTES": "0",
+            "JAX_PLATFORMS": "cpu",
+            # orphan bound: the worker exits on its own even when
+            # nothing is left alive to kill it
+            "CEPH_TPU_MULTIHOST_WORKER_DEADLINE_S":
+                str(timeout_s + 30.0),
+        })
+        if smoke:
+            env["CEPH_TPU_BENCH_SMOKE"] = "1"
+        procs.append(subprocess.Popen(
+            [_sys.executable, "-m", "ceph_tpu.parallel.meshbench",
+             "--worker"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env))
+    outs = []
+    deadline = time.monotonic() + timeout_s
+    try:
+        for p in procs:
+            so, se = p.communicate(
+                timeout=max(deadline - time.monotonic(), 0.1))
+            outs.append((p.returncode, so, se))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        print(f"# multihost {nproc}-process group timed out",
+              file=sys.stderr)
+        return None
+    for rc, so, se in outs:
+        if rc != 0:
+            print(f"# multihost worker failed rc={rc}:"
+                  f" {se[-800:]}", file=sys.stderr)
+            return None
+    lines = [ln for ln in outs[0][1].strip().splitlines() if ln]
+    try:
+        return json.loads(lines[-1]) if lines else None
+    except json.JSONDecodeError:
+        return None
+
+
+def worker_report(smoke: bool = True, iters: int = 3) -> dict:
+    """One process's leg of the ``--processes`` sweep: bootstrap the
+    group through the multihost seam, run the shared workload through
+    the plan cache's mesh path (hybrid mesh, pre-sharded global
+    arrays, allgathered outputs), check bit-exactness against the
+    host oracle every process computes locally."""
+    from ceph_tpu.ec import plan
+    from ceph_tpu.parallel import multihost
+
+    deadline = os.environ.get("CEPH_TPU_MULTIHOST_WORKER_DEADLINE_S")
+    if deadline:
+        import threading
+
+        # self-destruct: a worker orphaned mid-collective (its driver
+        # killed by an outer timeout) must not outlive the round
+        t = threading.Timer(float(deadline), lambda: os._exit(124))
+        t.daemon = True
+        t.start()
+    if not multihost.bootstrap_from_env():
+        ensure_devices()        # single-process leg in the driver
+    import jax
+
+    matrix, data, m = _workload(smoke)
+    oracle = _host_oracle(matrix, data)
+    n = len(jax.devices())
+    with _mesh_gates_open():
+        out = _encode_crc(matrix, data, n)  # compile + warm
+        bitexact = int(out is not None
+                       and np.array_equal(out[0], oracle))
+        best = float("inf")
+        for _ in range(max(iters, 1)):
+            t0 = time.perf_counter()
+            _encode_crc(matrix, data, n)
+            best = min(best, time.perf_counter() - t0)
+    st = plan.stats()
+    return {
+        "processes": multihost.process_count(),
+        "process_index": multihost.process_index(),
+        "devices": n,
+        "hosts": multihost.host_count(),
+        "bitexact": bitexact,
+        "gibs": round(data.nbytes / best / (1 << 30), 3),
+        "mesh_dispatches": st["mesh_dispatches"],
+        "topology": list(multihost.topology_signature()) or None,
+    }
+
+
+def multihost_report(processes: Optional[List[int]] = None,
+                     smoke: bool = True) -> dict:
+    """The ``--processes`` sweep axis + the host-loss shrink leg —
+    the bench_multihost section's body and the `multihost` contract
+    key's source."""
+    counts = processes or [1, 2]
+    # per-leg deadline: strictly below bench.py's subprocess timeouts
+    # (probe 180 / sweep 300), so THIS driver always kills and reaps
+    # its worker group before the outer timeout kills the driver
+    timeout_s = float(os.environ.get(
+        "CEPH_TPU_MULTIHOST_LEG_TIMEOUT_S", "120"))
+    rows = []
+    all_bitexact = 1
+    for nproc in counts:
+        if nproc <= 1:
+            rep = worker_report(smoke=smoke)
+            rep.pop("process_index", None)
+        else:
+            rep = _spawn_process_group(nproc, smoke, timeout_s)
+        if rep is None:
+            rows.append({"processes": nproc, "bitexact": None})
+            all_bitexact = 0
+            continue
+        rep.pop("process_index", None)
+        rows.append(rep)
+        if not rep.get("bitexact"):
+            all_bitexact = 0
+    out = {
+        "process_sweep": rows,
+        "multihost_bitexact": all_bitexact,
+        "processes_max": max(counts),
+    }
+    out.update(host_loss_report(smoke=smoke))
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
 
@@ -259,15 +487,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--sweep", action="store_true")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--sizes", type=str, default="")
+    ap.add_argument("--processes", type=str, default="",
+                    help="multihost sweep axis: process counts, e.g."
+                    " 1,2")
+    ap.add_argument("--worker", action="store_true",
+                    help="internal: one process of a --processes"
+                    " group")
     args = ap.parse_args(argv)
     smoke = args.smoke or os.environ.get(
         "CEPH_TPU_BENCH_SMOKE") == "1"
+    if args.worker:
+        print(json.dumps(worker_report(smoke=smoke)), flush=True)
+        return 0
     out = {}
-    if args.probe or not args.sweep:
+    if args.probe or not (args.sweep or args.processes):
         out.update(probe_report(smoke=smoke))
     if args.sweep:
         sizes = [int(s) for s in args.sizes.split(",") if s] or None
         out.update(sweep_report(sizes=sizes, smoke=smoke))
+    if args.processes:
+        counts = [int(p) for p in args.processes.split(",") if p]
+        out.update(multihost_report(processes=counts, smoke=smoke))
     print(json.dumps(out), flush=True)
     return 0
 

@@ -1,0 +1,493 @@
+"""Multi-process bootstrap seam: hybrid ICI/DCN meshes across hosts.
+
+PR 9 made multi-chip the default dispatch path for batched EC work,
+but its mesh stopped at one host.  This module is the cross-host
+story — the T5X partitioner pattern (SNIPPETS [1]/[2]:
+``multihost_utils`` + ``create_hybrid_device_mesh``-style hybrid
+meshes, logical-axis rules spanning ICI-within-host /
+DCN-across-hosts) applied to the EC data plane:
+
+* **Bootstrap seam** — ``initialize()`` is the ONLY place in the tree
+  allowed to call ``jax.distributed.initialize`` (the
+  ``raw-process-group`` lint rule enforces it).  Multiple CPU
+  processes emulate multi-host today (gloo CPU collectives; real TPU
+  pods later): each worker exports ``CEPH_TPU_MULTIHOST_COORD`` /
+  ``_NPROC`` / ``_PID`` and calls ``bootstrap_from_env()`` before the
+  backend initializes.
+* **Host topology** — every device maps to a HOST failure domain:
+  its owning process in a real multi-process group, or an emulated
+  block when ``CEPH_TPU_MULTIHOST_HOSTS=H`` partitions one process's
+  virtual devices into H hosts (how the host-loss shrink machinery is
+  exercised hermetically in tier-1).  ``topology_signature()`` is the
+  process-topology element Mesh ExecPlan keys carry (process count +
+  per-process device-set signature) so plans from different cluster
+  shapes never collide.
+* **Hybrid meshes** — ``hybrid_stripe_mesh()`` lays the device set
+  out as ("dcn", "dp"): the DCN axis crosses hosts, the dp axis stays
+  within a host's ICI domain.  ``parallel/striped.py``'s
+  LOGICAL_AXIS_RULES map ``stripe`` across ("dcn", "dp") while
+  ``shard``/``byte`` stay within-chip, so the EC kernels need no
+  cross-DCN collective at all — stripes are embarrassingly parallel
+  and the slow interconnect carries nothing per-byte.
+* **Collective-safe membership agreement** — ``agree()`` publishes a
+  per-process payload through the coordinator's key-value store and
+  reads every peer's with a hard timeout: a DEAD host shows up as a
+  timeout, never as a wedged collective (the reason membership cannot
+  ride an allgather: the first thing a lost host breaks is exactly
+  that collective).  arXiv:1804.10331's failure model is the design
+  anchor: once coded work spans hosts, the unit of loss is the HOST,
+  and ``parallel/backend.py`` + ``ec/plan.py`` treat it that way —
+  one ``host:<id>`` breaker event retires all the host's chips
+  together (no per-chip breaker storm), and plans re-key on the
+  survivor processes in one shrink.
+
+Kill switch: ``CEPH_TPU_MULTIHOST=0`` pins everything to the
+single-process behavior (bit-identical to PR 9).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "agree", "agree_healthy", "agreed_healthy", "bootstrap_from_env",
+    "enabled", "gather", "host_count", "host_of_id", "hosts",
+    "hybrid_stripe_mesh", "initialize", "is_initialized",
+    "is_multiprocess", "local_addressable", "local_host",
+    "membership_changed", "process_count", "process_index",
+    "put_global", "topology_signature",
+]
+
+_lock = threading.Lock()
+_initialized = False
+_init_info: Dict[str, int] = {}
+
+
+def enabled() -> bool:
+    """CEPH_TPU_MULTIHOST=0 is the kill switch: no process group is
+    ever joined, the topology reads single-host, and every mesh plan
+    keys exactly as the single-process PR-9 path."""
+    return os.environ.get("CEPH_TPU_MULTIHOST", "1") != "0"
+
+
+# ---------------------------------------------------------------------------
+# Bootstrap (the one place jax.distributed may be initialized)
+# ---------------------------------------------------------------------------
+
+
+def initialize(coordinator: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_count: Optional[int] = None) -> bool:
+    """Join (or create) the jax.distributed process group.  THE
+    bootstrap seam: raw ``jax.distributed.initialize`` outside this
+    function is flagged by the ``raw-process-group`` lint rule.
+
+    Must run BEFORE the jax backend initializes (it selects the gloo
+    CPU collectives the emulated multi-host path needs; on real pods
+    the TPU runtime brings its own ICI/DCN transports).  Idempotent;
+    returns True when a multi-process group is (already) up, False
+    for single-process operation (disabled, nproc <= 1, or jax
+    absent)."""
+    global _initialized
+    with _lock:
+        if _initialized:
+            return True
+        if not enabled():
+            return False
+        coordinator = coordinator or os.environ.get(
+            "CEPH_TPU_MULTIHOST_COORD", "")
+        if num_processes is None:
+            num_processes = int(os.environ.get(
+                "CEPH_TPU_MULTIHOST_NPROC", "1"))
+        if process_id is None:
+            process_id = int(os.environ.get(
+                "CEPH_TPU_MULTIHOST_PID", "0"))
+        if not coordinator or num_processes <= 1:
+            return False
+        if local_device_count is None:
+            env = os.environ.get("CEPH_TPU_MULTIHOST_LOCAL_DEVICES")
+            local_device_count = int(env) if env else None
+        if local_device_count:
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count="
+                    f"{local_device_count}").strip()
+        import jax
+
+        try:
+            # the CPU backend's cross-process collectives (the
+            # emulation transport); a no-op name on backends that
+            # bring their own
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:  # pragma: no cover - older/newer jax
+            pass
+        # THE one sanctioned call (this module is the rule's exempt
+        # seam): everywhere else raw-process-group flags it
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes, process_id=process_id)
+        _initialized = True
+        _init_info.update(nproc=int(num_processes),
+                          pid=int(process_id))
+        return True
+
+
+def bootstrap_from_env() -> bool:
+    """Worker-side entry: join the group described by the
+    CEPH_TPU_MULTIHOST_* env (set by the meshbench ``--processes``
+    driver / a pod launcher); False when the env names no group."""
+    return initialize()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def is_multiprocess() -> bool:
+    return _initialized and process_count() > 1
+
+
+def process_count() -> int:
+    if not _initialized:
+        return 1
+    import jax
+
+    return int(jax.process_count())
+
+
+def process_index() -> int:
+    if not _initialized:
+        return 0
+    import jax
+
+    return int(jax.process_index())
+
+
+# ---------------------------------------------------------------------------
+# Host topology (the failure-domain map)
+# ---------------------------------------------------------------------------
+
+_topo_lock = threading.Lock()
+_topo_cache: Optional[Tuple[str, Dict[int, int],
+                            Tuple[Tuple[int, Tuple[int, ...]], ...]]] \
+    = None
+
+
+def _emulated_hosts() -> int:
+    """CEPH_TPU_MULTIHOST_HOSTS=H partitions a SINGLE process's
+    devices into H emulated hosts (index blocks) — the hermetic way
+    tier-1 exercises host-level failure domains.  Ignored in a real
+    multi-process group (processes ARE the hosts there)."""
+    try:
+        return max(int(os.environ.get("CEPH_TPU_MULTIHOST_HOSTS",
+                                      "1")), 1)
+    except ValueError:
+        return 1
+
+
+def _topology() -> Tuple[Dict[int, int],
+                         Tuple[Tuple[int, Tuple[int, ...]], ...]]:
+    """(device id -> host, ((host, (ids...)), ...)) — memoized on the
+    config that shapes it (the device list itself is stable for a
+    process's lifetime; breakers, not topology, carry health)."""
+    global _topo_cache
+    key = (f"{_initialized}/{_emulated_hosts()}/"
+           f"{os.environ.get('CEPH_TPU_MULTIHOST', '1')}")
+    with _topo_lock:
+        if _topo_cache is not None and _topo_cache[0] == key:
+            return _topo_cache[1], _topo_cache[2]
+    by_id: Dict[int, int] = {}
+    try:
+        import jax
+
+        devs = list(jax.devices())
+    except Exception:
+        devs = []
+    if _initialized:
+        for d in devs:
+            by_id[d.id] = int(d.process_index)
+    elif enabled() and _emulated_hosts() > 1 and devs:
+        h = _emulated_hosts()
+        per = max(len(devs) // h, 1)
+        for i, d in enumerate(devs):
+            by_id[d.id] = min(i // per, h - 1)
+    else:
+        for d in devs:
+            by_id[d.id] = 0
+    groups: Dict[int, List[int]] = {}
+    for did, host in by_id.items():
+        groups.setdefault(host, []).append(did)
+    sig = tuple(sorted((h, tuple(sorted(ids)))
+                       for h, ids in groups.items()))
+    with _topo_lock:
+        _topo_cache = (key, by_id, sig)
+    return by_id, sig
+
+
+def host_of_id(device_id: int) -> int:
+    """The host failure domain owning a device (0 when unknown —
+    single-host operation never consults breakers beyond that)."""
+    by_id, _ = _topology()
+    return by_id.get(int(device_id), 0)
+
+
+def hosts() -> Dict[int, Tuple[int, ...]]:
+    """host -> its device ids (the whole cluster's view)."""
+    _, sig = _topology()
+    return {h: ids for h, ids in sig}
+
+
+def host_count() -> int:
+    _, sig = _topology()
+    return max(len(sig), 1)
+
+
+def local_host() -> int:
+    """The host THIS process's code runs on (its own failure
+    domain): the process index in a real group, host 0 under
+    emulation (every emulated host is locally addressable)."""
+    return process_index() if _initialized else 0
+
+
+def local_addressable(host: int) -> bool:
+    """True when this process can device_put to the host's devices
+    (probe locally); a real remote host is reachable only through
+    `agree()`."""
+    if not _initialized:
+        return True
+    return host == process_index()
+
+
+def topology_signature() -> tuple:
+    """The process-topology element of a mesh ExecPlan key: process
+    count + per-process (or emulated-host) device-set signature.  ()
+    for the trivial single-host shape, so single-process plan keys
+    stay bit-identical to the PR-9 form (the key-stability test's
+    contract)."""
+    _, sig = _topology()
+    if len(sig) <= 1:
+        return ()
+    return (len(sig), sig)
+
+
+# ---------------------------------------------------------------------------
+# Hybrid meshes (DCN across hosts x ICI/dp within)
+# ---------------------------------------------------------------------------
+
+
+def hybrid_stripe_mesh(devices: Sequence):
+    """A mesh for stripe-parallel EC work over `devices`: hosts on a
+    "dcn" axis, each host's chips on "dp" — the
+    create_hybrid_device_mesh shape, built by hand because the
+    emulated topology has no ICI coordinates.  Falls back to a flat
+    ("dp",) mesh when the set sits on one host or the per-host counts
+    are ragged (a shrunken survivor set keeps dispatching either
+    way); the logical axis rules map `stripe` across ("dcn", "dp"),
+    so both shapes serve the same kernels."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = list(devices)
+    by_host: Dict[int, List] = {}
+    for d in devs:
+        by_host.setdefault(host_of_id(d.id), []).append(d)
+    counts = {len(v) for v in by_host.values()}
+    if len(by_host) <= 1 or len(counts) != 1:
+        return Mesh(np.asarray(devs), axis_names=("dp",))
+    rows = [by_host[h] for h in sorted(by_host)]
+    arr = np.asarray(rows, dtype=object).reshape(
+        len(rows), len(rows[0]))
+    return Mesh(arr, axis_names=("dcn", "dp"))
+
+
+def put_global(arr, sharding):
+    """Place a host batch onto a (possibly cross-process) mesh.  The
+    SPMD contract of the multi-process data plane: every process
+    holds the SAME logical batch and contributes its addressable
+    shards; single-process this is exactly jax.device_put."""
+    import jax
+
+    if not is_multiprocess():
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
+def gather(out):
+    """Materialize a dispatch output on every host: identity/asarray
+    single-process, a tiled process_allgather across the group (each
+    process holds only its addressable output shards)."""
+    import numpy as np
+
+    if not is_multiprocess():
+        return np.asarray(out)
+    if isinstance(out, (tuple, list)):
+        return tuple(gather(o) for o in out)
+    if getattr(out, "is_fully_addressable", True):
+        return np.asarray(out)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(
+        multihost_utils.process_allgather(out, tiled=True))
+
+
+# ---------------------------------------------------------------------------
+# Collective-safe agreement (the coordinator KV store, never a
+# collective: a dead host must read as a timeout, not a wedge)
+# ---------------------------------------------------------------------------
+
+
+def _kv_client():
+    from jax._src import distributed
+
+    return distributed.global_state.client
+
+
+def _agree_timeout_s() -> float:
+    try:
+        return float(os.environ.get("CEPH_TPU_MULTIHOST_AGREE_TIMEOUT_S",
+                                    "10"))
+    except ValueError:
+        return 10.0
+
+
+def agree(topic: str, payload: str,
+          timeout_s: Optional[float] = None) -> Dict[int, Optional[str]]:
+    """Publish `payload` under `topic` and read every process's entry
+    back: {process -> payload or None (timed out / unreachable)}.
+
+    SPMD contract: every live process calls agree() with the same
+    topic in the same dispatch order (topics must be unique per round
+    — the caller carries an epoch).  A host that died simply never
+    publishes; its None is the membership verdict.  Single-process:
+    {0: payload} without touching any service."""
+    if not is_multiprocess():
+        return {0: payload}
+    client = _kv_client()
+    pid = process_index()
+    timeout_ms = int((timeout_s if timeout_s is not None
+                      else _agree_timeout_s()) * 1000)
+    try:
+        client.key_value_set(f"ceph_tpu/{topic}/{pid}", payload)
+    except Exception:
+        pass  # duplicate publish on a retried round: the value stands
+    out: Dict[int, Optional[str]] = {}
+    for p in range(process_count()):
+        if p == pid:
+            out[p] = payload
+            continue
+        try:
+            out[p] = client.blocking_key_value_get(
+                f"ceph_tpu/{topic}/{p}", timeout_ms)
+        except Exception:
+            out[p] = None
+    return out
+
+
+def agree_healthy(local_healthy_ids: Sequence[int],
+                  epoch: int = 0,
+                  timeout_s: Optional[float] = None
+                  ) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """Cross-process healthy-set agreement: every process publishes
+    the device ids IT observes healthy (its local breaker state);
+    the agreed set is the union of live hosts' reports restricted to
+    each host's own devices.  Returns (healthy ids, unreachable
+    hosts).  Deterministic across processes — the property that lets
+    every survivor build the same shrunken mesh.  `epoch` labels the
+    agreement round; callers must pass a value that is IDENTICAL on
+    every live process for the same round (agreed_healthy() derives
+    it from the lockstep membership round — a per-process call count
+    would desync topics and make lagging-but-live peers read as
+    dead)."""
+    if not is_multiprocess():
+        return tuple(sorted(int(i) for i in local_healthy_ids)), ()
+    reports = agree(f"healthy/{epoch}",
+                    json.dumps(sorted(int(i)
+                                      for i in local_healthy_ids)),
+                    timeout_s)
+    owned = hosts()
+    healthy: List[int] = []
+    dead: List[int] = []
+    for host, ids in sorted(owned.items()):
+        rep = reports.get(host)
+        if rep is None:
+            dead.append(host)
+            continue
+        try:
+            seen = set(json.loads(rep))
+        except ValueError:
+            dead.append(host)
+            continue
+        mine = [i for i in ids if i in seen]
+        if not mine:
+            # the host answered but owns zero healthy chips: its
+            # whole failure domain is out of the mesh — the same ONE
+            # host event as never answering (device complex down, NIC
+            # up)
+            dead.append(host)
+            continue
+        healthy.extend(mine)
+    return tuple(sorted(healthy)), tuple(dead)
+
+
+_member_lock = threading.Lock()
+_member_round = 0          # bumped ONLY at SPMD-lockstep points
+_member_cache: Optional[Tuple[int, Tuple[int, ...]]] = None
+
+
+def agreed_healthy(local_healthy_ids: Sequence[int]
+                   ) -> Tuple[int, ...]:
+    """Memoized membership agreement.  One agreement runs per
+    MEMBERSHIP ROUND — a counter bumped only by membership_changed(),
+    which is called at SPMD-lockstep points (a mesh dispatch failure
+    and its attribution run on every live process in the same order),
+    so every process agrees under the same round topic.  A local view
+    change between rounds (a chip's jittered backoff expiring is
+    clock-local and NOT lockstep) never triggers a fresh agreement —
+    it would desync round topics across processes and make
+    lagging-but-live peers read as dead; instead the cached agreed
+    set is filtered against the CURRENT local view for this process's
+    OWN devices (dropping a locally-degraded chip is always safe;
+    re-admitting one waits for the next lockstep round).  Hosts that
+    never answer a round are RETIRED (one host:<id> breaker event) —
+    membership loss IS host loss."""
+    global _member_cache
+    local = tuple(sorted(int(i) for i in local_healthy_ids))
+    if not is_multiprocess():
+        return local
+    with _member_lock:
+        round_ = _member_round
+        cached = _member_cache
+    mine = set(hosts().get(local_host(), ()))
+    localset = set(local)
+    if cached is not None and cached[0] == round_:
+        return tuple(i for i in cached[1]
+                     if i not in mine or i in localset)
+    healthy, dead = agree_healthy(local, epoch=round_)
+    if dead:
+        from ceph_tpu.common import circuit
+
+        for h in dead:
+            if not circuit.host_degraded(h):
+                circuit.retire_host(h)
+    with _member_lock:
+        _member_cache = (round_, healthy)
+    return healthy
+
+
+def membership_changed() -> None:
+    """Advance the membership round: the next healthy-set derivation
+    re-agrees under the new round topic.  MUST be called only at
+    SPMD-lockstep points (dispatch-failure attribution) so every
+    live process advances together and agreement topics never
+    desync."""
+    global _member_round, _member_cache
+    with _member_lock:
+        _member_round += 1
+        _member_cache = None

@@ -58,41 +58,66 @@ def _shard_map(f, *, mesh, in_specs, out_specs):
 # ---------------------------------------------------------------------------
 
 # The EC data plane's logical axes and where each lands on the chip
-# mesh.  `stripe` is data-parallel over the mesh's "dp" axis (stripes
-# are plentiful and independent); `shard` (the k+m chunk axis) stays
-# WITHIN a chip — a stripe's shards share the generator matmul, and
-# splitting them would turn a local MXU product into cross-chip
-# traffic; `byte` may be sequence-parallel over "sp" (elementwise for
-# the code, so only the 32-bit CRC fold ever crosses ICI).  The
-# product-path mesh plans (ec/plan.py) use pure stripe-parallel
-# (sp=1) meshes; the dryrun exercises the sp>1 byte split.
-LOGICAL_AXIS_RULES = (("stripe", "dp"), ("shard", None), ("byte", "sp"))
+# mesh.  `stripe` is data-parallel over the DCN-across-hosts x
+# ICI-within-host data axes — ("dcn", "dp"), the T5X hybrid-mesh
+# pattern: stripes are plentiful and independent, so the slow
+# cross-host interconnect carries nothing per-byte; `shard` (the k+m
+# chunk axis) stays WITHIN a chip — a stripe's shards share the
+# generator matmul, and splitting them would turn a local MXU product
+# into cross-chip traffic; `byte` may be sequence-parallel over "sp"
+# (elementwise for the code, so only the 32-bit CRC fold ever crosses
+# ICI — and never DCN).  The product-path mesh plans (ec/plan.py) use
+# stripe-parallel meshes (hybrid ("dcn", "dp") across hosts, flat
+# ("dp",) within one); the dryrun exercises the sp>1 byte split.
+LOGICAL_AXIS_RULES = (("stripe", ("dcn", "dp")), ("shard", None),
+                      ("byte", "sp"))
 
 
 def logical_spec(*logical_axes, rules=LOGICAL_AXIS_RULES,
                  mesh: Optional[Mesh] = None):
     """PartitionSpec for an array whose dims carry the given logical
-    axis names (None = unnamed/replicated dim).  A rule that maps to
-    a mesh axis ABSENT from `mesh` (e.g. a pure ("dp",) stripe mesh
-    with no "sp") resolves to None — the same array spec works on any
-    mesh shape, which is what lets a shrunken mesh reuse the same
-    kernel builders."""
+    axis names (None = unnamed/replicated dim).  A rule may map to
+    ONE mesh axis or a TUPLE of them (`stripe` -> ("dcn", "dp"));
+    axes ABSENT from `mesh` are dropped — a single-host ("dp",)
+    stripe mesh resolves `stripe` to plain "dp", a hybrid mesh to the
+    ("dcn", "dp") pair, and a mesh with neither to replicated — so
+    the same array spec works on any mesh shape, which is what lets a
+    shrunken (or single-host) mesh reuse the same kernel builders."""
     table = dict(rules)
     names = []
     axes = set(mesh.axis_names) if mesh is not None else None
     for ax in logical_axes:
         m = table.get(ax) if ax is not None else None
-        if m is not None and axes is not None and m not in axes:
+        if isinstance(m, tuple):
+            present = tuple(a for a in m
+                            if axes is None or a in axes)
+            m = (None if not present
+                 else present[0] if len(present) == 1 else present)
+        elif m is not None and axes is not None and m not in axes:
             m = None
         names.append(m)
     return P(*names)
 
 
+def data_parallel_size(mesh: Mesh) -> int:
+    """The number of stripe-parallel ways a mesh provides: the
+    product of its data axes (dcn x dp) — what batch divisibility and
+    per-chip whole-stripe rounding key on."""
+    shape = dict(mesh.shape)
+    return shape.get("dcn", 1) * shape.get("dp", 1)
+
+
 def stripe_mesh(devices) -> Mesh:
-    """A pure data-parallel ("dp",) mesh over the given devices: one
-    stripe sub-batch per chip, shards and bytes within-chip — the
-    product path's mesh shape (ec/plan.py mesh plans)."""
-    return Mesh(np.asarray(devices), axis_names=("dp",))
+    """A stripe-parallel mesh over the given devices: one stripe
+    sub-batch per chip, shards and bytes within-chip — the product
+    path's mesh shape (ec/plan.py mesh plans).  Devices spanning more
+    than one host (parallel/multihost.py topology) lay out as a
+    hybrid ("dcn", "dp") mesh — DCN across hosts, dp within — and a
+    single host's set stays the flat ("dp",) mesh, bit-identical to
+    the PR-9 shape."""
+    from ceph_tpu.parallel import multihost
+
+    return multihost.hybrid_stripe_mesh(devices)
 
 
 def build_mesh_encode(mesh: Mesh, label: str):
@@ -147,11 +172,13 @@ class ShardedPipeline:
         self.k, self.m = k, m
         self.chunk_bytes = chunk_bytes
         # partial meshes (a shrunken healthy set, or a pure ("dp",)
-        # stripe mesh) may lack either axis: an absent axis is size 1,
-        # not an error — the same pipeline code serves every shape
+        # stripe mesh) may lack any axis: an absent axis is size 1,
+        # not an error — the same pipeline code serves every shape.
+        # dp is the TOTAL stripe-parallel width (dcn x dp on a hybrid
+        # multi-host mesh)
         shape = dict(mesh.shape)
         self.sp = shape.get("sp", 1)
-        self.dp = shape.get("dp", 1)
+        self.dp = data_parallel_size(mesh)
         if chunk_bytes % self.sp:
             raise ValueError(
                 f"chunk_bytes {chunk_bytes} not divisible by sp={self.sp}")

@@ -1,0 +1,17 @@
+"""Violation: a process group joined outside the multihost bootstrap
+seam — no gloo collectives config, no host-topology map, plan keys
+never learn the cluster shape, and membership agreement would ride a
+collective a dead host wedges."""
+
+import jax
+from jax import distributed
+
+
+def join_group(coordinator, nproc, pid):
+    jax.distributed.initialize(  # expect: raw-process-group
+        coordinator_address=coordinator, num_processes=nproc,
+        process_id=pid)
+
+
+def leave_group():
+    distributed.shutdown()  # expect: raw-process-group

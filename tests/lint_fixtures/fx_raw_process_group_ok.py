@@ -1,0 +1,15 @@
+"""Clean twin: process-group setup routed through the
+parallel/multihost.py bootstrap seam — the collectives config, host
+topology, topology-aware plan keys, and collective-safe membership
+agreement all engage."""
+
+from ceph_tpu.parallel import multihost
+
+
+def join_group(coordinator, nproc, pid):
+    return multihost.initialize(coordinator=coordinator,
+                                num_processes=nproc, process_id=pid)
+
+
+def join_from_env():
+    return multihost.bootstrap_from_env()

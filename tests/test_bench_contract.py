@@ -22,7 +22,8 @@ import bench  # noqa: E402
 CONTRACT_KEYS = {"metric", "value", "unit", "vs_baseline",
                  "plan_cache", "encode_service", "tier",
                  "device_health", "tail", "load", "durability",
-                 "mesh", "trace", "group_commit", "truncated"}
+                 "mesh", "multihost", "trace", "group_commit",
+                 "truncated"}
 
 
 def test_contract_line_despite_hanging_backend(tmp_path):
@@ -106,6 +107,19 @@ def test_contract_line_despite_hanging_backend(tmp_path):
     assert contract["mesh"]["mesh_dispatches"] >= 1
     assert contract["mesh"]["sick_chip_shrunk"] == 1
     assert contract["mesh"]["host_fallbacks"] == 0
+    # the multihost probe ran: a REAL 2-process jax.distributed group
+    # encoded bit-exactly on the hybrid DCN x ICI mesh, and the
+    # host-loss leg retired the lost host as ONE event (one shrink,
+    # no per-chip breaker storm, zero host fallbacks, the fused-crc
+    # family still closed)
+    mh = contract["multihost"]
+    assert mh["processes_max"] >= 2
+    assert mh["multihost_bitexact"] == 1
+    assert mh["host_loss_bitexact"] == 1
+    assert mh["host_loss_shrunk"] == 1
+    assert mh["host_loss_one_event"] == 1
+    assert mh["host_loss_host_fallbacks"] == 0
+    assert mh["host_loss_fused_crc_closed"] == 1
     # the trace probe ran: the critical-path reducer reconstructed
     # the hand-built tree (longest hedged child on the path, the
     # cancelled straggler off it), live ops fed the per-stage
@@ -183,6 +197,9 @@ def test_budget_truncates_optional_sections(tmp_path):
     # pre-contract and still rides, budget permitting)
     assert "mesh" in details["skipped_sections"]
     assert "mesh_sweep" not in details
+    # and the multihost process sweep
+    assert "multihost" in details["skipped_sections"]
+    assert "process_sweep" not in details
     # and the trace decomposition section
     assert "trace" in details["skipped_sections"]
     assert "trace_stage_summary" not in details

@@ -131,8 +131,10 @@ def test_injection_spec_parsing():
     assert circuit.parse_injection("0.25")["p"] == 0.25
     spec = circuit.parse_injection("p=0.5,next=3,hang=20,oom=8")
     assert spec == {"p": 0.5, "next": 3, "hang_ms": 20.0,
-                    "oom_batch": 8, "sick_device": None}
+                    "oom_batch": 8, "sick_device": None,
+                    "down_host": None}
     assert circuit.parse_injection("sick=3")["sick_device"] == 3
+    assert circuit.parse_injection("down_host=1")["down_host"] == 1
     with pytest.raises(ValueError):
         circuit.parse_injection("bogus=1")
 

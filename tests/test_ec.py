@@ -136,8 +136,9 @@ def test_chunk_mapping():
     assert codec.get_chunk_mapping() == [1, 2, 0]
     data = bytes(range(128))
     encoded = codec.encode({0, 1, 2}, data)
-    # data chunks live at positions 1 and 2, parity at 0
-    assert encoded[1] + encoded[2] == data
+    # data chunks live at positions 1 and 2, parity at 0 (chunks are
+    # zero-copy views since the interface went frozen-view)
+    assert bytes(encoded[1]) + bytes(encoded[2]) == data
     degraded = {i: c for i, c in encoded.items() if i != 1}
     assert codec.decode_concat(degraded)[: len(data)] == data
 

@@ -29,6 +29,7 @@ RULES = [
     "jit-bypass-plan",
     "unguarded-device-dispatch",
     "unplanned-mesh-dispatch",
+    "raw-process-group",
     "unhedged-gather",
     "span-leak",
     "unbounded-latency-buffer",

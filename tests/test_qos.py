@@ -334,6 +334,43 @@ def test_tenant_limit_paces_grants():
 # -- admission gate ----------------------------------------------------
 
 
+def test_admission_fast_path_is_sync_with_cached_limit():
+    """The hot accept path (ROADMAP item 2 tail): an under-limit op
+    admits through the SYNCHRONOUS try_admit — no coroutine, one O(1)
+    bucket lookup — and the tenant's limit resolves once per TTL
+    window, not once per op."""
+    calls = []
+
+    def profile_of(t):
+        calls.append(t)
+        return (0.0, 1.0, 1000.0)
+
+    g = AdmissionGate(config={"osd_mclock_admission_burst": 2.0},
+                      profile_of=profile_of)
+    for _ in range(200):
+        assert g.try_admit("t", 1.0) == ADMIT
+    # one profile resolution for 200 ops (cached in the bucket entry)
+    assert len(calls) == 1
+    assert g.counters[ADMIT] == 200
+    # unlimited tenants admit on the fast path too, same caching
+    g2 = AdmissionGate(profile_of=lambda t: (0.0, 1.0, 0.0))
+    assert g2.try_admit("free") == ADMIT
+    # a drained bucket defers to the slow path (None, caller awaits)
+    g3 = AdmissionGate(config={"osd_mclock_admission_burst": 0.5,
+                               "osd_mclock_admission_max_delay_ms":
+                               0.0},
+                       profile_of=lambda t: (0.0, 1.0, 2.0))
+    assert g3.try_admit("t", 1.0) == ADMIT
+    assert g3.try_admit("t", 1.0) is None
+
+    async def main():
+        # and the slow path sheds without double-charging
+        assert await g3.admit("t", 1.0) == SHED
+        assert g3.counters[SHED] == 1
+
+    run(main())
+
+
 def test_admission_burst_then_shed():
     async def main():
         g = AdmissionGate(
