@@ -69,6 +69,7 @@ def _emit_contract(value: Optional[float],
                    multihost: Optional[dict] = None,
                    trace: Optional[dict] = None,
                    group_commit: Optional[dict] = None,
+                   compute: Optional[dict] = None,
                    truncated: bool = False) -> None:
     """Print the one-line JSON driver contract, exactly once, before
     any optional extended benches run — a wedged tunnel or a crashed
@@ -91,7 +92,10 @@ def _emit_contract(value: Optional[float],
     hybrid DCN x ICI mesh, plus the host-loss leg: one host:<id>
     event retires all the host's chips together, one shrink, zero
     host fallbacks), trace the critical-path tracing probe (reducer
-    correctness + spans-on-vs-off overhead at sample rate 0);
+    correctness + spans-on-vs-off overhead at sample rate 0), compute
+    the coded-compute probe (every linear kernel first-k
+    result-domain-decode bit-exact on a parity-including shard
+    subset + the hedged straggler leg);
     truncated flags a budget-shortened run.  Thread-safe:
     the deadline watchdog and the bench body may race to emit."""
     global _contract_emitted
@@ -116,6 +120,7 @@ def _emit_contract(value: Optional[float],
             "multihost": multihost,
             "trace": trace,
             "group_commit": group_commit,
+            "compute": compute,
             "truncated": bool(truncated),
         }), flush=True)
 
@@ -523,6 +528,117 @@ def _hedge_probe() -> Optional[dict]:
         return None
 
 
+def _compute_probe() -> Optional[dict]:
+    """Pre-contract probe of the coded-compute subsystem
+    (ceph_tpu/compute): (1) tiny scan bit-exact — every registered
+    LINEAR kernel evaluated on a parity-including k-subset of one
+    object's coded shards must result-domain-decode to exactly the
+    host reference on the logical bytes; (2) the straggler leg — a
+    need=k hedged sub-compute gather with one 1 s straggler completes
+    from the first k shard-results, the straggler cancelled and
+    awaited.  Counters land in the contract line's `compute` key;
+    None (with a stderr note) when the probe cannot run."""
+    if _remaining() < 0:
+        print("# compute probe skipped: budget exhausted",
+              file=sys.stderr)
+        return None
+    probe_timeout = float(os.environ.get(
+        "CEPH_TPU_BENCH_COMPUTE_PROBE_TIMEOUT", "60"))
+    try:
+        import asyncio
+
+        from ceph_tpu import compute as compute_mod
+        from ceph_tpu.ec.registry import create_erasure_code
+        from ceph_tpu.osd import ec_util
+        from ceph_tpu.osd.hedge import HedgeTracker
+
+        k, m = 2, 2
+        codec = create_erasure_code({
+            "plugin": "ec_jax", "technique": "reed_sol_van",
+            "k": str(k), "m": str(m)})
+        unit = codec.get_chunk_size(k * 4096)
+        sinfo = ec_util.StripeInfo(k, k * unit)
+        rng = np.random.default_rng(41)
+        data = rng.integers(0, 256, sinfo.get_stripe_width() + 97,
+                            dtype=np.uint8).tobytes()
+        padded = data + bytes(-len(data) % sinfo.get_stripe_width())
+        shards = ec_util.encode(sinfo, codec, padded,
+                                range(codec.get_chunk_count()))
+
+        def result_decode(kern, chosen) -> bytes:
+            rsinfo = ec_util.StripeInfo(k, k * kern.lanes)
+            dec = bytes(ec_util.decode(rsinfo, codec, chosen))
+            return bytes(kern.combine(
+                [dec[i * kern.lanes:(i + 1) * kern.lanes]
+                 for i in range(k)]))
+
+        linear = compute_mod.linear_kernels()
+        bitexact = 1
+        chosen_ids = (1, k + m - 1)  # data+parity mix
+        for kern in linear.values():
+            ref = bytes(kern.reference(
+                data, {}, k=k, chunk=sinfo.get_chunk_size()))
+            res = compute_mod.shard_eval_batch(
+                kern, [shards[i] for i in chosen_ids], {})
+            got = result_decode(
+                kern, {i: r for i, r in zip(chosen_ids, res)})
+            if got != ref:
+                bitexact = 0
+
+        async def straggler_leg() -> dict:
+            kern = next(iter(linear.values()))
+            tracker = HedgeTracker("bench-compute-probe", {
+                "osd_hedge_delta": 1,
+                "osd_hedge_rtt_prior_ms": 2.0,
+                "osd_hedge_delay_floor_ms": 5.0,
+            })
+            delays = {0: 0.001, 1: 0.001, 2: 1.0, 3: 0.001}
+
+            async def sub(shard: int) -> tuple:
+                await asyncio.sleep(delays[shard])
+                res = compute_mod.shard_eval_batch(
+                    kern, [shards[shard]], {})
+                return shard, True, res[0]
+
+            jobs = [(o, (lambda s=o: sub(s)))
+                    for o in range(k + m)]
+
+            def sufficient(results) -> bool:
+                return len({r[0] for r in results if r[1]}) >= k
+
+            t0 = time.perf_counter()
+            results, _ran_all = await tracker.gather(
+                jobs, need=k, sufficient=sufficient,
+                failed=lambda r: not r[1], label="subcompute")
+            dt = time.perf_counter() - t0
+            ref = bytes(kern.reference(
+                data, {}, k=k, chunk=sinfo.get_chunk_size()))
+            first_k = {r[0]: r[2] for r in results if r[1]}
+            chosen = dict(list(first_k.items())[:k]) \
+                if len(first_k) >= k else None
+            ok = chosen is not None and \
+                result_decode(kern, chosen) == ref
+            return {
+                "first_k_ms": round(dt * 1e3, 3),
+                "straggler_avoided": int(dt < 0.5),
+                "first_k_bitexact": int(ok),
+                "cancelled_subcomputes":
+                    tracker.counters["cancelled_subreads"],
+            }
+
+        leg = asyncio.run(asyncio.wait_for(straggler_leg(),
+                                           probe_timeout))
+        return {
+            "bitexact": bitexact,
+            "linear_kernels": len(linear),
+            "kernels": len(compute_mod.registered_kernels()),
+            **leg,
+        }
+    except Exception as e:
+        print(f"# compute probe failed: {e!r}", file=sys.stderr)
+        return None
+
+
 def _trace_probe() -> Optional[dict]:
     """Pre-contract probe of the critical-path tracing layer.  Two
     halves: (1) the critical-path reducer reconstructs a hand-built
@@ -844,6 +960,164 @@ def bench_tail() -> dict:
     out["tail_bytes_identical"] = bool(ok_on and ok_off)
     out["tail_hedge_counters"] = hedge_counters
     return out
+
+
+def bench_compute() -> dict:
+    """Coded-compute scan leg through a live cluster: scan N objects
+    with a linear kernel as (1) coded-compute pushdown and (2)
+    client-side read-then-compute (CEPH_TPU_COMPUTE=0), reporting
+    wall-clock per mode, the speedup multiple, bytes moved per mode
+    (sub-read payload bytes vs lane-width result bytes), the
+    per-stage trace decomposition of the scan, and the straggler leg
+    — the same pushdown scan with one injected slow OSD, whose
+    wall-clock must stay flat (hedged first-k sub-computes) while an
+    unhedged read-then-compute pays the delay."""
+    import asyncio
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_helpers import Cluster
+
+    n_objs = int(os.environ.get(
+        "CEPH_TPU_BENCH_COMPUTE_OBJECTS",
+        "32" if _SMOKE else "10000"))
+    if not _SMOKE and _remaining() < 240:
+        # a shrunken leg beats a skipped one when the clock runs low
+        n_objs = min(n_objs, 2000)
+    osize = 4096
+    delay = 0.05 if _SMOKE else 0.25
+    profile = {"plugin": "ec_jax", "technique": "reed_sol_van",
+               "k": "2", "m": "2", "crush-failure-domain": "osd"}
+    payload = np.random.default_rng(600).integers(
+        0, 256, osize, dtype=np.uint8).tobytes()
+
+    async def run() -> dict:
+        cluster = Cluster(num_osds=6, osds_per_host=3,
+                          osd_config={"osd_heartbeat_interval": 3.0,
+                                      "osd_heartbeat_grace": 30.0})
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "compute", profile=profile, pg_num=16)
+            io = cluster.client.open_ioctx("compute")
+            t0 = time.perf_counter()
+            sem = asyncio.Semaphore(64)  # bounded: the op queue is
+
+            async def put(i: int) -> None:
+                async with sem:
+                    await io.write_full(f"c{i}", payload)
+
+            await asyncio.gather(*(put(i) for i in range(n_objs)))
+            prefill_s = time.perf_counter() - t0
+            oids = [f"c{i}" for i in range(n_objs)]
+
+            def subread_bytes() -> int:
+                return sum(o.perf["subread_bytes"]
+                           for o in cluster.osds.values())
+
+            def result_bytes() -> int:
+                return sum(o.compute.perf()["result_bytes"]
+                           for o in cluster.osds.values())
+
+            # leg 1: pushdown scan
+            sb0, rb0 = subread_bytes(), result_bytes()
+            t0 = time.perf_counter()
+            res_push, err = await io.compute("gf_fold", oids)
+            push_s = time.perf_counter() - t0
+            assert not err, err
+            push_payload_bytes = subread_bytes() - sb0
+            push_result_bytes = result_bytes() - rb0
+
+            # leg 2: client-side read-then-compute
+            os.environ["CEPH_TPU_COMPUTE"] = "0"
+            try:
+                sb0 = subread_bytes()
+                t0 = time.perf_counter()
+                res_read, err = await io.compute("gf_fold", oids)
+                read_s = time.perf_counter() - t0
+            finally:
+                os.environ.pop("CEPH_TPU_COMPUTE", None)
+            assert not err, err
+            read_payload_bytes = subread_bytes() - sb0
+            bitexact = all(bytes(res_push[o]) == bytes(res_read[o])
+                           for o in oids)
+
+            # leg 3: straggler — slow the least-primary OSD, rerun
+            # the pushdown scan over objects it does not primary
+            counts = {o: 0 for o in cluster.osds}
+            acting_of = {}
+            for oid in oids[:256]:
+                pg = io.object_pg(oid)
+                acting, p = cluster.mon.osdmap.pg_to_acting_osds(pg)
+                acting_of[oid] = (acting, p)
+                counts[p] = counts.get(p, 0) + 1
+            slow = min(sorted(counts), key=lambda o: counts[o])
+            targets = [oid for oid, (acting, p) in acting_of.items()
+                       if p != slow and slow in acting] or \
+                [oid for oid, (_a, p) in acting_of.items()
+                 if p != slow]
+            # baseline over the SAME targets (amortized plans, no
+            # delay), then the slow-OSD leg: flat means the scan
+            # pays wave overhead, never the injected delay per wave
+            t0 = time.perf_counter()
+            await io.compute("gf_fold", targets)
+            base_s = time.perf_counter() - t0
+            cluster.osds[slow].msgr.inject_internal_delays = delay
+            t0 = time.perf_counter()
+            res_slow, err = await io.compute("gf_fold", targets)
+            slow_s = time.perf_counter() - t0
+            cluster.osds[slow].msgr.inject_internal_delays = 0
+            assert not err, err
+            slow_ok = all(bytes(res_slow[o]) == bytes(res_push[o])
+                          for o in targets)
+
+            # per-stage decomposition of the scan (compute stages
+            # only — the proof the win is attributable)
+            stages = {}
+            for osd in cluster.osds.values():
+                for stage, row in osd.tracer.stage_perf().items():
+                    if "compute" not in stage:
+                        continue
+                    agg = stages.setdefault(
+                        stage, {"count": 0, "p99_ms": 0.0})
+                    agg["count"] += row.get("count", 0)
+                    agg["p99_ms"] = max(agg["p99_ms"],
+                                        row.get("p99_ms", 0.0))
+            hedged = sum(o.hedge.counters["hedged_gathers"]
+                         for o in cluster.osds.values())
+            return {
+                "compute_objects": n_objs,
+                "compute_prefill_s": round(prefill_s, 3),
+                "compute_pushdown_s": round(push_s, 3),
+                "compute_read_then_compute_s": round(read_s, 3),
+                "compute_speedup_x": round(
+                    read_s / max(push_s, 1e-9), 2),
+                "compute_pushdown_payload_bytes": push_payload_bytes,
+                "compute_pushdown_result_bytes": push_result_bytes,
+                "compute_read_payload_bytes": read_payload_bytes,
+                "compute_bytes_ratio": round(
+                    read_payload_bytes
+                    / max(push_payload_bytes + push_result_bytes, 1),
+                    1),
+                "compute_bitexact": int(bitexact),
+                "compute_straggler_objects": len(targets),
+                "compute_straggler_base_s": round(base_s, 3),
+                "compute_straggler_scan_s": round(slow_s, 3),
+                "compute_straggler_delay_s": delay,
+                "compute_straggler_flat": int(
+                    slow_s < max(2.0 * base_s,
+                                 base_s + 2.0 * delay)),
+                "compute_straggler_bitexact": int(slow_ok),
+                "compute_hedged_gathers": hedged,
+                "compute_stage_ms": {
+                    k: {"count": v["count"],
+                        "p99_ms": round(v["p99_ms"], 3)}
+                    for k, v in sorted(stages.items())},
+            }
+        finally:
+            await cluster.stop()
+
+    return asyncio.run(run())
 
 
 def _load_probe() -> Optional[dict]:
@@ -2070,6 +2344,9 @@ def main() -> None:
     # writes share barriers (fsyncs < N), bit-exact, kill switch pays
     # one commit per txn
     group_commit_counters = _group_commit_probe()
+    # coded-compute probe (before the contract): tiny scan bit-exact
+    # through first-k result-domain decode + the hedged straggler leg
+    compute_counters = _compute_probe()
 
     # the driver contract line, before every optional/extended bench:
     # a wedge below this point can cost detail rows, never the bench
@@ -2084,6 +2361,7 @@ def main() -> None:
                    multihost=multihost_counters,
                    trace=trace_counters,
                    group_commit=group_commit_counters,
+                   compute=compute_counters,
                    truncated=skip_optional)
 
     # decode sweep over 1..m erasures (the reference benchmark sweeps
@@ -2216,6 +2494,18 @@ def main() -> None:
             print(f"# group commit bench failed: {e!r}",
                   file=sys.stderr)
 
+    # coded-compute section: the scan-N-objects leg — pushdown vs
+    # client-side read-then-compute wall-clock, bytes moved per mode,
+    # straggler flatness, per-stage compute decomposition
+    compute_section: dict = {}
+    if skip_optional:
+        skipped_sections.append("compute")
+    else:
+        try:
+            compute_section = bench_compute()
+        except Exception as e:
+            print(f"# compute bench failed: {e!r}", file=sys.stderr)
+
     # degraded-mode section: breakers forced open -> host-path
     # throughput delta (what a wedged accelerator costs while the
     # breaker holds it out of the hot path)
@@ -2288,6 +2578,7 @@ def main() -> None:
         **group_commit_section,
         **mesh_section,
         **multihost_section,
+        **compute_section,
         **degraded_section,
         **load_section,
         **durability_section,
@@ -2302,6 +2593,7 @@ def main() -> None:
         "multihost": multihost_counters,
         "trace": trace_counters,
         "group_commit": group_commit_counters,
+        "compute": compute_counters,
         "host_cores": os.cpu_count(),
         "encode_ms_per_batch": t_enc * 1e3,
         "k": k, "m": m, "chunk_bytes": chunk, "batch": batch,

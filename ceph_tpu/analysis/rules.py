@@ -51,6 +51,14 @@ EC dispatch discipline:
                        plan.stats(), binds a device set no health
                        shrink can retire, and dispatches without
                        watchdog or sick-chip attribution
+  unplanned-compute-dispatch
+                       raw coded-compute kernel invocation
+                       (compute.kernels.device_eval) in compute/,
+                       osd/ outside the plan cache (ec/plan.py
+                       compute_eval) or circuit.device_call: the
+                       compile is invisible to plan.stats() and the
+                       dispatch has no watchdog or bit-exact host
+                       degradation
   raw-process-group    jax.distributed.initialize/shutdown outside
                        the parallel/multihost.py bootstrap seam: a
                        process group joined elsewhere skips the gloo
@@ -662,6 +670,55 @@ def rule_unplanned_mesh_dispatch(a: Analyzer) -> None:
 
 
 # ---------------------------------------------------------------------
+# unplanned-compute-dispatch
+# ---------------------------------------------------------------------
+
+# modules whose coded-compute kernel evaluations must ride the plan
+# cache: `compute.kernels.make_device_eval` builds the one traced
+# kernel body, and a raw invocation compiles outside plan.stats()
+# (retraces invisible) and dispatches outside the breaker guard (no
+# watchdog, no host fallback — a wedged accelerator stalls the scan
+# instead of degrading it)
+_COMPUTE_DISPATCH_PATHS = ("ceph_tpu/compute/", "ceph_tpu/osd/")
+_COMPUTE_ENTRY_TAILS = {"device_eval", "make_device_eval"}
+
+
+def rule_unplanned_compute_dispatch(a: Analyzer) -> None:
+    """Raw compute-kernel device invocation in compute//osd/ outside
+    the plan cache / breaker guard: route wave evaluations through
+    ceph_tpu.ec.plan.compute_eval (the `compute` plan kind —
+    tracked_jit + quarantine + the `compute` breaker family) or wrap
+    the dispatch in circuit.device_call.  The bit-exact numpy twin
+    (`host_eval`) is the legitimate raw path."""
+    paths = a.config.get("compute_paths", _COMPUTE_DISPATCH_PATHS)
+    for mod in a.project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if not any(p in rel for p in paths):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _resolved_callee(mod, node) or \
+                dotted(node.func) or ""
+            if callee.split(".")[-1] not in _COMPUTE_ENTRY_TAILS:
+                continue
+            if _inside_tracked_jit(mod, node) or \
+                    _inside_device_call(mod, node):
+                continue
+            a.emit("unplanned-compute-dispatch", mod, node,
+                   f"raw compute-kernel dispatch `{callee}` outside "
+                   "the plan cache: the XLA trace is invisible to "
+                   "plan.stats() and the dispatch skips the breaker "
+                   "guard (no watchdog, no bit-exact host "
+                   "degradation) — route through "
+                   "ceph_tpu.ec.plan.compute_eval or wrap with "
+                   "circuit.device_call",
+                   severity="warning",
+                   symbol=_enclosing_qualname(mod, node),
+                   scope_line=_scope_line(mod, node))
+
+
+# ---------------------------------------------------------------------
 # raw-process-group
 # ---------------------------------------------------------------------
 
@@ -1155,6 +1212,7 @@ def default_rules() -> Dict[str, object]:
         "jit-bypass-plan": rule_jit_bypass_plan,
         "unguarded-device-dispatch": rule_unguarded_device_dispatch,
         "unplanned-mesh-dispatch": rule_unplanned_mesh_dispatch,
+        "unplanned-compute-dispatch": rule_unplanned_compute_dispatch,
         "raw-process-group": rule_raw_process_group,
         "unhedged-gather": rule_unhedged_gather,
         "span-leak": rule_span_leak,

@@ -247,10 +247,15 @@ def rule_transitive_blocking_call(a: Analyzer) -> None:
 # ---------------------------------------------------------------------
 
 # the msgr→daemon→ec/plan data path: every op's payload crosses these
-# modules, so each pattern here is a per-op full-buffer copy
+# modules, so each pattern here is a per-op full-buffer copy.  cls/
+# (object-class methods run per op on the primary) and the coded-
+# compute layer (whole WAVES of shard payloads per dispatch) are on
+# the path too.
 _HOT_PATHS = ("ceph_tpu/msg/", "ceph_tpu/osd/daemon.py",
               "ceph_tpu/osd/ec_util.py",
-              "ceph_tpu/osd/encode_service.py", "ceph_tpu/ec/")
+              "ceph_tpu/osd/encode_service.py", "ceph_tpu/ec/",
+              "ceph_tpu/cls/", "ceph_tpu/compute/",
+              "ceph_tpu/osd/compute.py")
 # receivers that plausibly hold bulk payload bytes (the slice
 # heuristic's noise bound: an int index or a small-tuple slice on an
 # unrelated name is not a worklist entry)

@@ -29,6 +29,16 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 RD = 1   # CLS_METHOD_RD
 WR = 2   # CLS_METHOD_WR
 
+
+def as_text(data, encoding: str = "utf-8") -> str:
+    """Decode a method payload (bytes OR a zero-copy wire/store view)
+    to text without materializing an intermediate bytes object —
+    str(buffer, encoding) reads any buffer directly.  The cls-SDK
+    twin of common/buffer.as_buffer for the JSON-argument idiom."""
+    if isinstance(data, str):
+        return data
+    return str(data, encoding)
+
 ENOENT = -2
 EINVAL = -22
 EPERM = -1
@@ -66,14 +76,25 @@ class MethodContext:
 
     # -- reads -------------------------------------------------------------
 
-    async def read(self, offset: int = 0, length: int = 0) -> bytes:
+    async def read(self, offset: int = 0, length: int = 0):
+        """Object bytes as a ZERO-COPY readonly view of the read
+        path's buffer (frozen decode output / store buffer / frame
+        view): RD-only methods that only slice or compare never pay a
+        whole-object copy.  Methods that genuinely need to own the
+        payload (caching it across awaits, returning it to the wire
+        after a subsequent write) take bytes() themselves; JSON
+        parsing goes through `cls.as_text`."""
+        from ceph_tpu.common.buffer import as_buffer
+
         rc, data = await self._d._op_read(self._state, self._pool,
                                           self.oid, offset, length)
         if rc != 0:
             raise ClsError(rc, "read")
-        # the read path hands out zero-copy views; class methods get
-        # REAL bytes (they json-decode, hash, and cache the result)
-        return data if isinstance(data, bytes) else bytes(data)
+        buf = as_buffer(data)
+        if isinstance(buf, bytes):
+            return buf
+        view = buf if isinstance(buf, memoryview) else memoryview(buf)
+        return view.toreadonly()
 
     async def stat(self) -> Dict[str, Any]:
         rc, out = await self._d._op_stat(self._state, self._pool,

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import json
 
-from ceph_tpu.cls import ClsError, EINVAL, ENOENT, MethodContext, RD, WR
+from ceph_tpu.cls import ClsError, EINVAL, ENOENT, MethodContext, RD, WR, as_text
 
 EEXIST = -17
 
@@ -25,7 +25,7 @@ async def _omap(ctx: MethodContext) -> dict:
 
 
 async def add(ctx: MethodContext, data: bytes) -> bytes:
-    req = json.loads(data.decode())
+    req = json.loads(as_text(data))
     key, value = req.get("key"), req.get("value", "")
     if not key:
         raise ClsError(EINVAL, "missing key")
@@ -40,7 +40,7 @@ async def remove(ctx: MethodContext, data: bytes) -> bytes:
     """{key, value?}: remove an entry; with `value`, only if the
     stored value still matches (compare-and-swap — a racing writer who
     replaced the entry must not have it deleted under them)."""
-    req = json.loads(data.decode())
+    req = json.loads(as_text(data))
     key = req.get("key")
     omap = await _omap(ctx)
     if key not in omap:
@@ -53,7 +53,7 @@ async def remove(ctx: MethodContext, data: bytes) -> bytes:
 
 
 async def get(ctx: MethodContext, data: bytes) -> bytes:
-    req = json.loads(data.decode())
+    req = json.loads(as_text(data))
     omap = await _omap(ctx)
     value = omap.get(req.get("key", ""))
     if value is None:

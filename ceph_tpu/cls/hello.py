@@ -7,14 +7,14 @@ rewrite with EEXIST), replay (reads it back).
 
 from __future__ import annotations
 
-from ceph_tpu.cls import ClsError, ENOATTR, ENOENT, MethodContext, RD, WR
+from ceph_tpu.cls import ClsError, ENOATTR, ENOENT, MethodContext, RD, WR, as_text
 
 EEXIST = -17
 GREETING_ATTR = "hello.greeting"
 
 
 async def say_hello(ctx: MethodContext, data: bytes) -> bytes:
-    name = data.decode() or "world"
+    name = as_text(data) or "world"
     if len(name) > 100:
         raise ClsError(-22, "name too long")
     return f"Hello, {name}!".encode()

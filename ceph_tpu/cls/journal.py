@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import json
 
-from ceph_tpu.cls import ClsError, EINVAL, EPERM, MethodContext, RD, WR
+from ceph_tpu.cls import ClsError, EINVAL, EPERM, MethodContext, RD, WR, as_text
 
 ENTRY_PREFIX = "e"
 
@@ -72,7 +72,7 @@ async def get_state(ctx: MethodContext, data: bytes) -> bytes:
 
 async def append(ctx: MethodContext, data: bytes) -> bytes:
     """{epoch, seq, entry}: fenced, durable journal append."""
-    req = json.loads(data.decode())
+    req = json.loads(as_text(data))
     _check_epoch(await _stored_epoch(ctx), req.get("epoch"))
     try:
         seq = int(req["seq"])
@@ -89,7 +89,7 @@ async def set_applied(ctx: MethodContext, data: bytes) -> bytes:
     otherwise erase entries the new active has not replayed).  The
     caller supplies its previous watermark so trimming is O(trimmed),
     never a full-journal read."""
-    req = json.loads(data.decode())
+    req = json.loads(as_text(data))
     _check_epoch(await _stored_epoch(ctx), req.get("epoch"))
     try:
         applied = int(req["applied"])
@@ -109,7 +109,7 @@ async def guarded_update(ctx: MethodContext, data: bytes) -> bytes:
     xattr).  The apply-phase fence: a deposed active can re-apply only
     state the new active already replayed (idempotent) — any object
     the new epoch has touched refuses the old epoch outright."""
-    req = json.loads(data.decode())
+    req = json.loads(as_text(data))
     try:
         epoch = int(req["epoch"])
         updates = req["set"]
@@ -140,7 +140,7 @@ async def guarded_update(ctx: MethodContext, data: bytes) -> bytes:
 
 async def guarded_remove(ctx: MethodContext, data: bytes) -> bytes:
     """{epoch}: remove THIS object unless fenced by a newer epoch."""
-    req = json.loads(data.decode())
+    req = json.loads(as_text(data))
     try:
         epoch = int(req["epoch"])
     except (KeyError, ValueError, TypeError):

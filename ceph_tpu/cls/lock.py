@@ -22,6 +22,7 @@ from ceph_tpu.cls import (
     MethodContext,
     RD,
     WR,
+    as_text,
 )
 
 EXCLUSIVE = "exclusive"
@@ -73,7 +74,7 @@ async def _store(ctx: MethodContext, name: str, st: dict) -> None:
 
 
 async def lock(ctx: MethodContext, data: bytes) -> bytes:
-    req = json.loads(data.decode())
+    req = json.loads(as_text(data))
     name = req["name"]
     ltype = req.get("type", EXCLUSIVE)
     if ltype not in (EXCLUSIVE, SHARED):
@@ -108,7 +109,7 @@ async def lock(ctx: MethodContext, data: bytes) -> bytes:
 
 
 async def unlock(ctx: MethodContext, data: bytes) -> bytes:
-    req = json.loads(data.decode())
+    req = json.loads(as_text(data))
     st = await _load(ctx, req["name"])
     me = _key(req["owner"], req.get("cookie", ""))
     if me not in st["lockers"]:
@@ -122,7 +123,7 @@ async def unlock(ctx: MethodContext, data: bytes) -> bytes:
 
 async def break_lock(ctx: MethodContext, data: bytes) -> bytes:
     """Admin override: evict a named locker (cls_lock break_lock)."""
-    req = json.loads(data.decode())
+    req = json.loads(as_text(data))
     st = await _load(ctx, req["name"])
     victim = _key(req["locker"], req.get("cookie", ""))
     if victim not in st["lockers"]:
@@ -135,7 +136,7 @@ async def break_lock(ctx: MethodContext, data: bytes) -> bytes:
 
 
 async def get_info(ctx: MethodContext, data: bytes) -> bytes:
-    req = json.loads(data.decode())
+    req = json.loads(as_text(data))
     st = await _load(ctx, req["name"])
     return json.dumps(st).encode()
 

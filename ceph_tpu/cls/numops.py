@@ -10,11 +10,11 @@ from __future__ import annotations
 
 import json
 
-from ceph_tpu.cls import ClsError, EINVAL, ENOENT, MethodContext, RD, WR
+from ceph_tpu.cls import ClsError, EINVAL, ENOENT, MethodContext, RD, WR, as_text
 
 
 async def _rmw(ctx: MethodContext, data: bytes, op) -> bytes:
-    req = json.loads(data.decode())
+    req = json.loads(as_text(data))
     key = req["key"]
     try:
         operand = float(req["value"])

@@ -12,7 +12,17 @@ exposes them over the admin-socket/tell surface (`dump_traces`), which
 fits the single-binary deployment the way the asok perf dump does.
 
 Propagation: a (trace_id, span_id) pair rides in MOSDOp / MOSDSubWrite
-/ MOSDSubRead (versioned tail fields — untraced peers skip them).
+/ MOSDSubRead / MOSDSubCompute (versioned tail fields — untraced
+peers skip them).
+
+Stage names are a span's first whitespace token (`stage_of`): the
+pipeline seams emit `admission`, `queue.<class>`, `objlock`,
+`encode_wait`/`encode_flush`, `subread osd.N` / `subwrite osd.N`,
+`kv_commit_wait`/`fsync`, and the coded-compute workload adds
+`compute_op` (the scan op root), `subcompute osd.N` (per-peer
+hedged sub-compute flights) and `compute ...` (kernel evaluation /
+result-domain decode) — each workload class gets its own rows in
+the stage histograms.
 Inside a daemon the active span travels by contextvar, so nested sends
 (the primary's sub-ops fanned out under the op task) attach the right
 parent without threading a span through every call signature.

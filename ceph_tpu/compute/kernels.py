@@ -1,0 +1,395 @@
+"""The in-tree compute kernels.
+
+Two families (see ceph_tpu/compute/__init__.py for the theory):
+
+GF-linear (pushdown to the coded shards, first-k result-domain
+decode):
+
+- ``gf_fold``        R-lane GF(2^8) fold (XOR of every lane-strided
+                     byte): the checksum-pushdown kernel — a content
+                     digest of the whole object computed without
+                     moving it.
+- ``gf_fingerprint`` seeded GF-weighted fold: a position-sensitive
+                     content fingerprint (dedup candidate scoring) —
+                     unlike the plain fold it detects chunk
+                     permutations, because every lane-row carries its
+                     own GF weight.
+
+Nonlinear (full-decode fallback at the primary; results, not
+payloads, cross the client wire):
+
+- ``count``/``sum``/``min``/``max``  aggregate pushdown over
+                     fixed-width records with an optional predicate
+                     on a little-endian field.
+- ``filter``         predicate scan: matching record indices
+                     (bounded) + total match count.
+- ``compress_score`` order-0 entropy estimate (bits/byte) over
+                     fixed blocks — the compression-candidate scoring
+                     of compressor/scoring.py, run where the data
+                     lives.
+- ``dot_score``      embedding scoring: object bytes as float32
+                     vectors, best dot-product match against the
+                     query vector in args.
+
+Raw-dispatch discipline: ``device_eval`` is the ONE jax kernel body;
+it must only run through the plan cache (ec/plan.py `compute` kind,
+via ``planned_eval``) or inside circuit.device_call — the
+`unplanned-compute-dispatch` lint rule enforces it.  ``host_eval`` is
+the bit-exact numpy twin used by oracles and the degraded path.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+import numpy as np
+
+from ceph_tpu.common.buffer import as_buffer
+from ceph_tpu.compute import (
+    ComputeError, ComputeKernel, DEFAULT_LANES, EINVAL, canon_json,
+)
+
+#: seed of the fingerprint kernel's GF weight stream (a protocol
+#: constant: every daemon and every client oracle must derive the
+#: same weights)
+FINGERPRINT_SEED = 0xCE9
+
+
+def make_device_eval(weights: np.ndarray):
+    """Build THE traced device kernel body for one weight row: a
+    row-weighted XOR fold of the (B, rows, lanes) shard batch —
+    GF(2^8) scalar products via the log/exp field tables, XOR
+    reduction over rows.  This is the fold SHAPE of the linear
+    kernels; the generic bit-matrix matmul would pay an 8x bitplane
+    expansion to express the same reduction.  All-ones weights (the
+    gf_fold kernel) lower to a pure XOR reduce.
+
+    The returned callable must only be invoked through ec/plan.py's
+    `compute` plan kind (tracked_jit + breaker guard) — the
+    `unplanned-compute-dispatch` lint rule flags raw calls."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops import gf
+
+    w = np.asarray(weights, dtype=np.uint8).reshape(-1)
+
+    def xor_rows(arr):
+        return jax.lax.reduce(arr, np.uint8(0),
+                              jax.lax.bitwise_xor, (1,))
+
+    if (w == 1).all():
+        def device_eval_fold(data):
+            return xor_rows(data)[:, None, :]
+
+        return device_eval_fold
+
+    lw = jnp.asarray(gf.GF_LOG[w])
+    nzw = jnp.asarray(w != 0)
+    exp = jnp.asarray(gf.GF_EXP)
+    log = jnp.asarray(gf.GF_LOG)
+
+    def device_eval_weighted(data):
+        # exact jnp twin of gf.gf_mul's table math (bit-exactness
+        # contract with host_eval below)
+        prod = exp[log[data] + lw[None, :, None]]
+        prod = jnp.where((data == 0) | ~nzw[None, :, None],
+                         np.uint8(0), prod)
+        return xor_rows(prod)[:, None, :]
+
+    return device_eval_weighted
+
+
+def host_eval(weights: np.ndarray, batch: np.ndarray) -> np.ndarray:
+    """Bit-exact numpy twin: (1, rows) GF weights x (B, rows, lanes)
+    -> (B, 1, lanes) via the same table math.  The oracle for every
+    device result and the degraded path when the device tier is
+    absent or its breaker is open."""
+    w = np.asarray(weights, dtype=np.uint8).reshape(-1)
+    b = np.ascontiguousarray(batch)
+    if (w == 1).all():
+        out = np.bitwise_xor.reduce(b, axis=1)
+    else:
+        from ceph_tpu.ops import gf
+
+        out = np.bitwise_xor.reduce(
+            gf.gf_mul(w[None, :, None], b), axis=1)
+    return out[:, None, :]
+
+
+def planned_eval(name: str, weights: np.ndarray,
+                 batch: np.ndarray,
+                 sig: str = None) -> np.ndarray:
+    """One wave's kernel evaluation through the plan cache: the
+    `compute` plan kind dispatches device-side under the ``compute``
+    breaker family; None (no backend / open breaker / quarantined
+    plan) degrades to the bit-exact host path.  `sig` is the weight
+    row's content signature (weights_sig memoizes it — re-hashing a
+    64 Ki-row weight stream per dispatch is pure waste)."""
+    from ceph_tpu.ec import plan as ec_plan
+
+    out = ec_plan.compute_eval(name, weights, batch, sig=sig)
+    if out is None:
+        out = host_eval(weights, batch)
+    return np.asarray(out)
+
+
+_SIG_CACHE: Dict[tuple, str] = {}
+
+
+def weights_sig(kernel, rows: int) -> str:
+    """Memoized plan-key signature of a kernel's (name, rows) weight
+    row — pure function of both, so the hash runs once per geometry,
+    not once per wave."""
+    key = (kernel.name, rows)
+    hit = _SIG_CACHE.get(key)
+    if hit is None:
+        from ceph_tpu.ec import plan as ec_plan
+
+        hit = ec_plan.matrix_signature(
+            np.asarray(kernel.row_weights(rows), dtype=np.uint8),
+            extra=f"compute/{kernel.name}")
+        if len(_SIG_CACHE) > 256:
+            _SIG_CACHE.clear()
+        _SIG_CACHE[key] = hit
+    return hit
+
+
+# ---------------------------------------------------------------------------
+# Linear kernels
+# ---------------------------------------------------------------------------
+
+
+class GfFold(ComputeKernel):
+    """R-lane GF fold: result[r] = XOR of bytes at positions == r
+    (mod lanes).  All-ones weight row — the pure checksum kernel."""
+
+    name = "gf_fold"
+    linear = True
+    lanes = DEFAULT_LANES
+
+    def row_weights(self, rows: int) -> np.ndarray:
+        return np.ones((1, rows), dtype=np.uint8)
+
+
+class GfFingerprint(ComputeKernel):
+    """Seeded GF-weighted fold: row j carries a deterministic nonzero
+    GF weight, so permuted content folds differently (the dedup /
+    content-addressing fingerprint).  Weight stream is a protocol
+    constant derived from FINGERPRINT_SEED."""
+
+    name = "gf_fingerprint"
+    linear = True
+    lanes = DEFAULT_LANES
+
+    def __init__(self):
+        # memoized per row count: the stream is a deterministic
+        # protocol constant, and a 10k-object scan would otherwise
+        # regenerate it once per length-group per wave per OSD.
+        # (Full regeneration per rows value, never prefix-slicing a
+        # longer stream: numpy's bounded-integer generation is not
+        # prefix-stable across lengths.)
+        self._weights_cache: Dict[int, np.ndarray] = {}
+
+    def row_weights(self, rows: int) -> np.ndarray:
+        hit = self._weights_cache.get(rows)
+        if hit is None:
+            rng = np.random.default_rng(FINGERPRINT_SEED)
+            # nonzero GF weights: zero rows would blind the
+            # fingerprint
+            hit = rng.integers(1, 256, (1, rows), dtype=np.uint8) \
+                if rows else np.ones((1, 0), dtype=np.uint8)
+            hit.setflags(write=False)
+            if len(self._weights_cache) > 16:
+                self._weights_cache.clear()
+            self._weights_cache[rows] = hit
+        return hit
+
+
+# ---------------------------------------------------------------------------
+# Nonlinear kernels: record aggregates / predicate scan
+# ---------------------------------------------------------------------------
+
+_CMPS = {
+    "eq": np.equal, "ne": np.not_equal,
+    "lt": np.less, "le": np.less_equal,
+    "gt": np.greater, "ge": np.greater_equal,
+}
+
+
+def _int_arg(args: Dict[str, Any], key: str, default: int) -> int:
+    """Client-supplied JSON -> int, or ComputeError(EINVAL): args
+    come off the wire, so a null/string/huge value must surface as
+    the op's rc, never as a TypeError inside the engine."""
+    raw = args.get(key, default)
+    try:
+        val = int(raw)
+    except (TypeError, ValueError):
+        raise ComputeError(EINVAL, f"bad {key}={raw!r}")
+    if not -(1 << 63) <= val < (1 << 64):
+        raise ComputeError(EINVAL, f"{key} out of range")
+    return val
+
+
+def _record_fields(data, args: Dict[str, Any]):
+    """(field values uint64, match mask) for the record-aggregate
+    family: fixed-width records, little-endian unsigned field at
+    [off, off+len), optional predicate {"cmp", "value"}."""
+    rsize = _int_arg(args, "record", 8)
+    off = _int_arg(args, "off", 0)
+    flen = _int_arg(args, "len", min(8, max(rsize - off, 1)))
+    if rsize <= 0 or off < 0 or flen <= 0 or flen > 8 or \
+            off + flen > rsize:
+        raise ComputeError(EINVAL, "bad record/field spec")
+    buf = as_buffer(data)
+    nrec = len(buf) // rsize
+    arr = np.frombuffer(buf, dtype=np.uint8,
+                        count=nrec * rsize).reshape(nrec, rsize)
+    weights = (1 << (8 * np.arange(flen, dtype=np.uint64)))
+    fields = arr[:, off:off + flen].astype(np.uint64) @ weights
+    cmp = args.get("cmp")
+    if cmp is None:
+        return fields, np.ones(nrec, dtype=bool)
+    fn = _CMPS.get(str(cmp))
+    if fn is None:
+        raise ComputeError(EINVAL, f"unknown cmp {cmp!r}")
+    value = _int_arg(args, "value", 0)
+    if value < 0:
+        raise ComputeError(EINVAL, "value must be unsigned")
+    return fields, fn(fields, np.uint64(value))
+
+
+class RecordAgg(ComputeKernel):
+    """count/sum/min/max over a record field, optionally predicated —
+    the filter/aggregate pushdown family (one class, one reducer per
+    registered name)."""
+
+    linear = False
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def validate_args(self, args: Dict[str, Any]) -> None:
+        _record_fields(b"", args)
+
+    def eval_object(self, data, args: Dict[str, Any]) -> bytes:
+        fields, mask = _record_fields(data, args)
+        hit = fields[mask]
+        if self.name == "count":
+            return canon_json({"count": int(mask.sum())})
+        if self.name == "sum":
+            return canon_json({"count": int(mask.sum()),
+                               "sum": int(hit.sum(dtype=np.uint64))
+                               if hit.size else 0})
+        val = None
+        if hit.size:
+            val = int(hit.min() if self.name == "min" else hit.max())
+        return canon_json({"count": int(mask.sum()), self.name: val})
+
+
+class FilterScan(ComputeKernel):
+    """Predicate scan: total matches + the first `limit` matching
+    record indices (the pgls-of-records shape)."""
+
+    name = "filter"
+    linear = False
+
+    def validate_args(self, args: Dict[str, Any]) -> None:
+        _record_fields(b"", args)
+
+    def eval_object(self, data, args: Dict[str, Any]) -> bytes:
+        _fields, mask = _record_fields(data, args)
+        limit = max(0, min(_int_arg(args, "limit", 1024), 65536))
+        idx = np.flatnonzero(mask)
+        return canon_json({"count": int(idx.size),
+                           "indices": [int(i) for i in idx[:limit]]})
+
+
+class CompressScore(ComputeKernel):
+    """Compression-candidate scoring: order-0 entropy (bits/byte)
+    over fixed blocks via compressor/scoring.py's histogram path —
+    incompressible objects (entropy near 8) can skip the codec
+    entirely, decided where the bytes already are."""
+
+    name = "compress_score"
+    linear = False
+
+    def eval_object(self, data, args: Dict[str, Any]) -> bytes:
+        from ceph_tpu.compressor import scoring
+
+        block = _int_arg(args, "block", 4096)
+        if block <= 0:
+            raise ComputeError(EINVAL, "bad block")
+        buf = as_buffer(data)
+        if len(buf) == 0:
+            return canon_json({"blocks": 0, "entropy_bpb": 0.0})
+        nfull = max(len(buf) // block, 1)
+        span = min(len(buf), nfull * block)
+        blocks = np.frombuffer(buf, dtype=np.uint8,
+                               count=(span // nfull) * nfull)
+        blocks = blocks.reshape(nfull, -1)
+        ent = scoring.entropy_bits_per_byte_host(blocks)
+        return canon_json({
+            "blocks": int(nfull),
+            "entropy_bpb": round(float(np.mean(ent)), 4)})
+
+
+class DotScore(ComputeKernel):
+    """Embedding scoring: the object is a run of float32 vectors of
+    dimension args["dim"]; score each against args["query"] and
+    return the best match — inference-adjacent pushdown (the
+    arXiv:2409.01420 workload shape)."""
+
+    name = "dot_score"
+    linear = False
+
+    def validate_args(self, args: Dict[str, Any]) -> None:
+        dim = _int_arg(args, "dim", 0)
+        query = args.get("query")
+        if dim <= 0 or not isinstance(query, (list, tuple)) or \
+                len(query) != dim:
+            raise ComputeError(EINVAL, "dot_score needs dim + query")
+
+    def eval_object(self, data, args: Dict[str, Any]) -> bytes:
+        self.validate_args(args)
+        dim = _int_arg(args, "dim", 0)
+        try:
+            q = np.asarray(args["query"], dtype=np.float32)
+        except (TypeError, ValueError):
+            raise ComputeError(EINVAL, "bad query vector")
+        buf = as_buffer(data)
+        stride = 4 * dim
+        n = len(buf) // stride
+        if n == 0:
+            return canon_json({"n": 0, "best": None, "score": None})
+        emb = np.frombuffer(buf, dtype=np.float32,
+                            count=n * dim).reshape(n, dim)
+        scores = emb @ q
+        best = int(np.argmax(scores))
+        return canon_json({"n": n, "best": best,
+                           "score": round(float(scores[best]), 4)})
+
+
+def register_defaults(register) -> None:
+    """Register the in-tree kernel set (the default_handler role)."""
+    register(GfFold())
+    register(GfFingerprint())
+    for name in ("count", "sum", "min", "max"):
+        register(RecordAgg(name))
+    register(FilterScan())
+    register(CompressScore())
+    register(DotScore())
+
+
+def parse_args(raw: str) -> Dict[str, Any]:
+    """Wire args (JSON text) -> dict; '' means {}."""
+    if not raw:
+        return {}
+    try:
+        out = json.loads(raw)
+    except ValueError:
+        raise ComputeError(EINVAL, "args not JSON")
+    if not isinstance(out, dict):
+        raise ComputeError(EINVAL, "args must be an object")
+    return out

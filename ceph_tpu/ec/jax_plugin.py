@@ -147,6 +147,21 @@ class ErasureCodeJax(ErasureCode):
             alignment += LARGEST_VECTOR_WORDSIZE - modulo
         return alignment
 
+    def supports_result_decode(self) -> bool:
+        """True when GF-linear compute kernels commute with this
+        codec (the coded-compute pushdown gate, ceph_tpu/compute):
+        every plain GF(2^8) matrix technique acts POSITION-WISE on
+        bytes, so a kernel result vector satisfies the same code
+        relation as the shards and decodes through the normal decode
+        path at lane width.  Wide-word (w>8) and cauchy variants mix
+        across byte/word boundaries or carry per-chunk alignment the
+        lane-width synthetic stripe cannot honor; remapped layouts
+        (chunk_mapping) are excluded with them — those codecs take
+        the full-decode fallback."""
+        return (self.matrix is not None and self.w == 8
+                and not self.technique.startswith("cauchy")
+                and not self.get_chunk_mapping())
+
     # -- kernels ----------------------------------------------------------
 
     def plan_signature(self) -> str:

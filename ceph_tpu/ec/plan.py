@@ -81,11 +81,11 @@ except Exception:  # pragma: no cover
 
 __all__ = [
     "bucket_batch", "bucket_bytes", "clear", "codec_signature",
-    "device_platform", "enabled", "encode", "encode_coalesced",
-    "encode_with_crc", "matmul", "matrix_signature", "mesh_enabled",
-    "mesh_dispatches", "mesh_info", "plan_key", "quarantine_info",
-    "reset_stats", "set_enabled", "stats", "StripeCoalescer",
-    "tracked_jit",
+    "compute_eval", "device_platform", "enabled", "encode",
+    "encode_coalesced", "encode_with_crc", "matmul",
+    "matrix_signature", "mesh_enabled", "mesh_dispatches",
+    "mesh_info", "plan_key", "quarantine_info", "reset_stats",
+    "set_enabled", "stats", "StripeCoalescer", "tracked_jit",
 ]
 
 # ---------------------------------------------------------------------------
@@ -985,6 +985,60 @@ def encode(matrix: np.ndarray, data: np.ndarray, sig: str = None,
         return None
     out = np.asarray(out)[:b, :, :s]
     return out[0] if squeeze else out
+
+
+def _build_compute(key: tuple, weights: np.ndarray) -> ExecPlan:
+    """The `compute` plan kind: a coded-compute kernel evaluation —
+    a row-weighted XOR fold of the (B, rows, lanes) batch of shard
+    streams, one trace shared by every wave that lands in the same
+    bucket.  The weight row is a COMPILE-TIME constant (the key
+    carries its content signature), so all-ones kernels lower to a
+    pure XOR reduce instead of a GF table walk."""
+    from ceph_tpu.compute import kernels as compute_kernels
+
+    jfn = tracked_jit(_label(key),
+                      compute_kernels.make_device_eval(weights))
+    return ExecPlan(key, jfn, "xla_fold")
+
+
+def compute_eval(name: str, weights: np.ndarray, data: np.ndarray,
+                 sig: Optional[str] = None,
+                 family: str = "compute") -> Optional[np.ndarray]:
+    """(B, rows, lanes) uint8 shard batch -> (B, 1, lanes) kernel
+    results through the plan cache (kind `compute`, its own breaker
+    family so a compute fault never degrades the encode/decode data
+    path).  Returns None when no jax backend is available, the plan
+    is quarantined, or the guarded dispatch failed — callers take the
+    bit-exact numpy host path; RESOURCE_EXHAUSTED halves the batch
+    recursively first."""
+    if not (HAVE_JAX and gf.backend_available()):
+        return None
+    arr = np.asarray(data, dtype=np.uint8)
+    assert arr.ndim == 3, arr.shape
+    b, rows, lanes = arr.shape
+    if b == 0 or rows == 0 or lanes == 0:
+        return None
+    w = np.asarray(weights, dtype=np.uint8)
+    sig = sig or matrix_signature(w, extra=f"compute/{name}")
+    key = plan_key(sig, "compute", 1, rows, b, lanes)
+    if _quarantined(key):
+        return None
+    plan = _get_plan(key, lambda: _build_compute(key, w))
+    bb, bs = key[4], key[5]
+    padded = jnp.asarray(_pad_batch(arr, bb, bs))
+    status, out = _guarded(family, key, plan, (padded,), b)
+    if status == "oom" and b > 1:
+        h = b // 2
+        first = compute_eval(name, w, arr[:h], sig=sig,
+                             family=family)
+        second = compute_eval(name, w, arr[h:], sig=sig,
+                              family=family)
+        if first is None or second is None:
+            return None
+        return np.concatenate([first, second], axis=0)
+    if status != "ok":
+        return None
+    return np.asarray(out)[:b, :, :lanes]
 
 
 def _build_mesh_matmul(key: tuple) -> ExecPlan:

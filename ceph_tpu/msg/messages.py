@@ -642,6 +642,168 @@ class MOSDSubReadReply(Message):
         return msg
 
 
+# -- coded compute (scan/aggregate/score pushdown) --------------------------
+
+
+@register
+class MOSDCompute(Message):
+    """Client -> primary: run a registered compute kernel over MANY
+    objects' shards where they live (the coded-compute scan op,
+    ceph_tpu/compute).  SET-valued by design — one request names a
+    kernel + many oids, so a 10k-object scan is a handful of frames,
+    not 10k round trips.  cls-exec style, but the primary fans
+    sub-compute ops to the OSDs holding each object's shards and
+    completes each object from the FIRST k shard-results."""
+
+    TAG = 32
+    VERSION = 1
+    COMPAT = 1
+
+    def __init__(self, tid: int, client: str, pool: int,
+                 oids: List[str], kernel: str, args: str = "",
+                 epoch: int = 0, tenant: str = ""):
+        self.tid = tid
+        self.client = client
+        self.pool = pool
+        self.oids = oids
+        self.kernel = kernel
+        self.args = args          # JSON text (kernel-specific)
+        self.epoch = epoch
+        # QoS tenant identity ("" = untagged): compute ops schedule
+        # under the dedicated `compute` mClock class AND pass the
+        # tenant admission gate, so scans cannot starve client I/O
+        self.tenant = tenant
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        enc.string(self.client)
+        enc.s64(self.pool)
+        enc.list(self.oids, Encoder.string)
+        enc.string(self.kernel)
+        enc.string(self.args)
+        enc.u32(self.epoch)
+        enc.string(self.tenant)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MOSDCompute":
+        return cls(dec.u64(), dec.string(), dec.s64(),
+                   dec.list(Decoder.string), dec.string(),
+                   dec.string(), dec.u32(), dec.string())
+
+
+@register
+class MOSDComputeReply(Message):
+    """Primary -> client: per-oid (rc, result bytes) + a summary map
+    (pushdown/fallback counts, result bytes moved) for observability.
+    Only KERNEL RESULTS ride here — never object payloads."""
+
+    TAG = 33
+    VERSION = 1
+    COMPAT = 1
+
+    def __init__(self, tid: int, rc: int,
+                 results: Optional[Dict[str, Tuple[int, bytes]]] = None,
+                 out: Optional[Dict[str, Any]] = None,
+                 replay_epoch: int = 0):
+        self.tid = tid
+        self.rc = rc
+        self.results = results or {}
+        self.out = out or {}
+        self.replay_epoch = replay_epoch
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        enc.s32(self.rc)
+        enc.map(self.results, Encoder.string,
+                lambda e, v: (e.s32(v[0]), e.bytes(v[1])))
+        enc.string(json.dumps(self.out))
+        enc.u32(self.replay_epoch)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MOSDComputeReply":
+        return cls(dec.u64(), dec.s32(),
+                   dec.map(Decoder.string,
+                           lambda d: (d.s32(), d.bytes())),
+                   json.loads(dec.string()), dec.u32())
+
+
+@register
+class MOSDSubCompute(Message):
+    """Primary -> shard OSD: evaluate the kernel over THIS OSD's
+    shards of a wave of objects (MOSDECSubOpRead-shaped, but the
+    reply carries R-byte kernel results, not chunk payloads — the
+    payload bytes never cross the wire).  items are
+    (pool, ps, shard, oid) tuples; the receiver batches every local
+    shard of the wave into ONE plan-cached device dispatch."""
+
+    TAG = 34
+    VERSION = 1
+    COMPAT = 1
+
+    def __init__(self, tid: int, kernel: str, args: str,
+                 items: List[Tuple[int, int, int, str]],
+                 epoch: int = 0):
+        self.tid = tid
+        self.kernel = kernel
+        self.args = args
+        self.items = [tuple(it) for it in items]
+        self.epoch = epoch
+        # blkin-role trace context: (trace_id, parent span id) or None
+        self.trace: Optional[tuple] = None
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        enc.string(self.kernel)
+        enc.string(self.args)
+        enc.list(self.items,
+                 lambda e, it: (e.s64(it[0]), e.u32(it[1]),
+                                e.s32(it[2]), e.string(it[3])))
+        enc.u32(self.epoch)
+        enc.optional(self.trace,
+                     lambda e, v: (e.u64(v[0]), e.u64(v[1])))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MOSDSubCompute":
+        msg = cls(dec.u64(), dec.string(), dec.string(),
+                  dec.list(lambda d: (d.s64(), d.u32(), d.s32(),
+                                      d.string())),
+                  dec.u32())
+        msg.trace = dec.optional(lambda d: (d.u64(), d.u64()))
+        return msg
+
+
+@register
+class MOSDSubComputeReply(Message):
+    """Shard OSD -> primary: per-item (rc, object-info version,
+    result bytes), aligned with the request's item order.  The
+    version rides so the primary can complete each object from k
+    SAME-VERSION shard-results (the consistency story of the
+    hedged first-k read, applied to computation)."""
+
+    TAG = 35
+    VERSION = 1
+    COMPAT = 1
+
+    def __init__(self, tid: int, rc: int,
+                 results: Optional[List[Tuple[int, str, bytes]]] = None):
+        self.tid = tid
+        self.rc = rc
+        self.results = [tuple(r) for r in (results or [])]
+
+    def encode_payload(self, enc: Encoder) -> None:
+        enc.u64(self.tid)
+        enc.s32(self.rc)
+        enc.list(self.results,
+                 lambda e, r: (e.s32(r[0]), e.string(r[1]),
+                               e.bytes(r[2])))
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "MOSDSubComputeReply":
+        return cls(dec.u64(), dec.s32(),
+                   dec.list(lambda d: (d.s32(), d.string(),
+                                       d.bytes_view())))
+
+
 # -- peering ----------------------------------------------------------------
 
 

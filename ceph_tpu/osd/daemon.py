@@ -51,10 +51,14 @@ from ceph_tpu.msg.messages import (
     MOSDBoot,
     MOSDCommand,
     MOSDCommandReply,
+    MOSDCompute,
+    MOSDComputeReply,
     MOSDFailure,
     MOSDMapMsg,
     MOSDOp,
     MOSDOpReply,
+    MOSDSubCompute,
+    MOSDSubComputeReply,
     MOSDSubRead,
     MOSDSubReadReply,
     MOSDSubWrite,
@@ -374,6 +378,14 @@ class OSDDaemon:
         # switches CEPH_TPU_HEDGE=0 / osd_hedge_enable=false
         self.hedge = HedgeTracker(who=f"osd.{osd_id}",
                                   config=self.config)
+        # coded compute: the MOSDCompute scan engine (osd/compute.py)
+        # — linear kernels run ON the coded shards with first-k
+        # result-domain decode; nonlinear kernels take the
+        # full-decode fallback.  Scheduled under its own `compute`
+        # mClock class + the tenant admission gate.
+        from ceph_tpu.osd.compute import ComputeEngine
+
+        self.compute = ComputeEngine(self)
         self._promote_tasks: Set[asyncio.Task] = set()
         # watch/notify: (pool, oid) -> {(client, cookie): Connection}
         self.watchers: Dict[Tuple[int, str],
@@ -634,6 +646,9 @@ class OSDDaemon:
         # (the prometheus flattener turns `peers` into peer-labeled
         # rows)
         out["hedge"] = self.hedge.perf()
+        # coded-compute engine: pushdown-vs-fallback split + result
+        # bytes moved (the scan observability surface)
+        out["compute"] = self.compute.perf()
         # per-tenant QoS: scheduler queue/grant state + admission
         # decisions (`tenants` flattens to tenant-labeled rows)
         out["qos"] = self._qos_perf()
@@ -964,7 +979,8 @@ class OSDDaemon:
         addr = self.osdmap.osd_addrs.get(osd)
         if addr is None:
             return None
-        if isinstance(msg, (MOSDSubWrite, MOSDSubRead)) and \
+        if isinstance(msg, (MOSDSubWrite, MOSDSubRead,
+                            MOSDSubCompute)) and \
                 msg.trace is None:
             # sub-ops fanned out under a SAMPLED client op inherit its
             # span as parent (blkin's "span per sub-op" shape); the
@@ -1006,11 +1022,16 @@ class OSDDaemon:
             await self._handle_ping(conn, msg)
         elif isinstance(msg, MOSDOp):
             await self._handle_client_op(conn, msg)
+        elif isinstance(msg, MOSDCompute):
+            await self._handle_compute_op(conn, msg)
         elif isinstance(msg, MOSDSubWrite):
             await self._handle_sub_write(conn, msg)
         elif isinstance(msg, MOSDSubRead):
             await self._handle_sub_read(conn, msg)
-        elif isinstance(msg, (MOSDSubWriteReply, MOSDSubReadReply)):
+        elif isinstance(msg, MOSDSubCompute):
+            await self._handle_sub_compute(conn, msg)
+        elif isinstance(msg, (MOSDSubWriteReply, MOSDSubReadReply,
+                              MOSDSubComputeReply)):
             self._resolve(msg.tid, msg)
         elif isinstance(msg, MWatchNotifyAck):
             self._handle_notify_ack(conn, msg)
@@ -3834,6 +3855,102 @@ class OSDDaemon:
                                     replay_epoch=self._epoch()
                                     if rc == EAGAIN else 0))
 
+    # -- coded compute (MOSDCompute, osd/compute.py) -----------------------
+
+    async def _handle_compute_op(self, conn: Connection,
+                                 msg: MOSDCompute) -> None:
+        """Client scan op: admission gate first (an over-limit
+        tenant's scan is delayed/shed before it consumes anything),
+        then the engine fans out.  The dedicated `compute` mClock
+        class is charged at the EVAL stage (eval_local_shards), not
+        around the whole op — a wave parked on remote sub-computes
+        must not occupy in-flight op slots while it waits."""
+        op_id = self.op_tracker.create(
+            f"compute({msg.client} {msg.kernel} n={len(msg.oids)})")
+        span = self.tracer.start(
+            f"compute_op {msg.kernel} n{len(msg.oids)}")
+        token = tracing.current_span.set(span) if span else None
+        try:
+            if self.osdmap is None:
+                await conn.send(MOSDComputeReply(msg.tid, EAGAIN))
+                return
+            self.op_tracker.mark(op_id, "started")
+            # admission cost on the client-op scale (1.0 ~ one small
+            # op): a wave scales sublinearly — per-object work is a
+            # lane-width kernel eval, not a payload move
+            cost = 1.0 + len(msg.oids) / 256.0
+            tenant = getattr(msg, "tenant", "") or ""
+            admitted = True
+            if tenant and self._qos_tenants_enabled:
+                decision = self.admission.try_admit(tenant, cost)
+                if decision is None:
+                    decision = await self.admission.admit(tenant,
+                                                          cost)
+                if decision == SHED:
+                    admitted = False
+            try:
+                if not admitted:
+                    rc, results, out = EBUSY, {}, {}
+                else:
+                    rc, results, out = await self.compute.execute(msg)
+            except asyncio.CancelledError:
+                raise
+            except sched_mod.QueueFull:
+                rc, results, out = EBUSY, {}, {}
+            except Exception:
+                log.exception("osd.%d: compute op %r failed",
+                              self.osd_id, msg)
+                rc, results, out = EIO, {}, {}
+            await conn.send(MOSDComputeReply(
+                msg.tid, rc, results, out,
+                replay_epoch=self._epoch() if rc == EAGAIN else 0))
+        finally:
+            op = self.op_tracker.finish(op_id)
+            if token is not None:
+                tracing.current_span.reset(token)
+            self._finish_op_span(span, op)
+
+    async def _handle_sub_compute(self, conn: Connection,
+                                  msg: MOSDSubCompute) -> None:
+        """Shard side of the pushdown: evaluate the kernel over every
+        local shard named by the wave — ONE batched plan-cached
+        dispatch — and return (rc, version, result) per item.  Only
+        kernel results (R bytes each) go back over the wire."""
+        from ceph_tpu import compute as compute_mod
+        from ceph_tpu.compute import ComputeError
+        from ceph_tpu.compute import kernels as compute_kernels
+
+        async def body() -> None:
+            kern = compute_mod.get_kernel(msg.kernel)
+            if kern is None or not kern.linear:
+                await conn.send(MOSDSubComputeReply(msg.tid, EINVAL))
+                return
+            try:
+                args = compute_kernels.parse_args(msg.args)
+            except ComputeError as e:
+                await conn.send(MOSDSubComputeReply(msg.tid, e.rc))
+                return
+            items = [(PgId(pool, ps), shard, oid)
+                     for pool, ps, shard, oid in msg.items]
+            try:
+                results = await self.compute.eval_local_shards(
+                    items, kern, args)
+            except sched_mod.QueueFull:
+                # compute-class overflow: explicit refusal — the
+                # primary's hedged gather treats it as a failed
+                # flight and recruits a spare
+                await conn.send(MOSDSubComputeReply(msg.tid, EBUSY))
+                return
+            await conn.send(MOSDSubComputeReply(msg.tid, 0, results))
+
+        if msg.trace is not None:
+            async with self.tracer.span(
+                    f"sub_compute {msg.kernel} x{len(msg.items)}",
+                    context=msg.trace):
+                await body()
+            return
+        await body()
+
     async def _execute_ops(self, state: PGState, pool, msg: MOSDOp,
                            conn: Optional[Connection] = None
                            ) -> Tuple[int, bytes, Dict[str, Any]]:
@@ -5055,10 +5172,10 @@ class OSDDaemon:
         additionally takes the normal object lock on its own."""
         from ceph_tpu.cls import ClsError, MethodContext
 
-        # class methods receive real bytes (they json-decode inputs);
-        # the wire decode hands bulk data as a zero-copy memoryview
-        if not isinstance(data, bytes):
-            data = bytes(data)
+        # method input stays the wire decode's zero-copy view: class
+        # methods parse through cls.as_text (str() decodes any
+        # buffer) or take bytes() themselves where they genuinely
+        # need to own the payload
         entry = self.class_handler.lookup(cls, method)
         if entry is None:
             return EINVAL, b""

@@ -297,6 +297,7 @@ class HedgeTracker:
             need: Optional[int] = None,
             sufficient: Optional[Callable[[List[Any]], bool]] = None,
             failed: Optional[Callable[[Any], bool]] = None,
+            label: str = "subread",
     ) -> Tuple[List[Any], bool]:
         """Run (peer, job-factory) pairs; return (results, ran_all).
 
@@ -313,7 +314,12 @@ class HedgeTracker:
 
         ran_all is True only when every job ran to completion: an
         early (hedged) exit can never masquerade as an exhaustive
-        probe."""
+        probe.
+
+        label names the per-flight stage spans ("subread" for the EC
+        read fan-out, "subcompute" for coded-compute sub-ops) so each
+        workload class gets its own row in the critical-path stage
+        histograms."""
         jobs = list(jobs)
         if not jobs:
             return [], True
@@ -324,7 +330,8 @@ class HedgeTracker:
         if not hedged:
             tasks = [loop.create_task(
                 _traced_job(factory,
-                            tracing.start_child(f"subread osd.{peer}")),
+                            tracing.start_child(
+                                f"{label} osd.{peer}")),
                 name=f"hedge:{self.who}:all:{peer}")
                 for peer, factory in jobs]
             try:
@@ -350,7 +357,7 @@ class HedgeTracker:
                 return None
             peer, factory = order[next_i]
             next_i += 1
-            span = tracing.start_child(f"subread osd.{peer}",
+            span = tracing.start_child(f"{label} osd.{peer}",
                                        hedge=is_hedge)
             task = loop.create_task(
                 _traced_job(factory, span),

@@ -45,6 +45,12 @@ CLIENT = "client"
 RECOVERY = "background_recovery"
 SCRUB = "background_scrub"
 BEST_EFFORT = "background_best_effort"
+#: coded-compute scans (MOSDCompute): their own dmClock class so a
+#: 10k-object scan contends against its OWN tags — a small
+#: reservation keeps scans progressing under client load, the weight
+#: sits below client I/O, and the limit caps how hard a scan storm
+#: can push (scans must never starve the data path)
+COMPUTE = "compute"
 
 #: per-tenant client classes are `client.<tenant>`
 TENANT_PREFIX = CLIENT + "."
@@ -57,6 +63,7 @@ DEFAULT_PROFILES: Dict[str, Tuple[float, float, float]] = {
     RECOVERY: (25.0, 3.0, 200.0),
     SCRUB: (5.0, 1.0, 50.0),
     BEST_EFFORT: (0.0, 1.0, 50.0),
+    COMPUTE: (10.0, 2.0, 400.0),
 }
 
 #: bookkeeping cap for per-tenant class state: at millions of tenants

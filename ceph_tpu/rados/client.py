@@ -27,6 +27,8 @@ from ceph_tpu.msg.messages import (
     MMonCommandReply,
     MOSDCommand,
     MOSDCommandReply,
+    MOSDCompute,
+    MOSDComputeReply,
     MOSDMapMsg,
     MOSDOp,
     MOSDOpReply,
@@ -238,7 +240,8 @@ class RadosClient:
                 await self.fs_caps_handler(conn, msg)
         elif isinstance(msg, (MAuthReply,
                               MOSDOpReply, MMonCommandReply,
-                              MOSDCommandReply, MClientReply)):
+                              MOSDCommandReply, MOSDComputeReply,
+                              MClientReply)):
             fut = self._futures.pop(msg.tid, None)
             if fut is not None and not fut.done():
                 fut.set_result(msg)
@@ -753,6 +756,163 @@ class IoCtx:
             raise RadosError(reply.rc, f"exec {cls}.{method} on {oid!r}")
         data = reply.data
         return data if isinstance(data, bytes) else bytes(data)
+
+    # -- coded compute (scan/aggregate/score pushdown) ---------------------
+
+    async def compute(self, kernel: str, oids: List[str],
+                      args: Optional[Dict[str, Any]] = None,
+                      wave: int = 1024
+                      ) -> Tuple[Dict[str, bytes], Dict[str, int]]:
+        """Run a registered compute kernel over many objects WHERE
+        THEY LIVE (MOSDCompute, ceph_tpu/compute): one SET-valued op
+        per primary per wave, only kernel results come back.  Returns
+        ({oid: result bytes}, {oid: rc}) — partial results survive
+        per-object errors, the scan-shaped contract.
+
+        Kill switch CEPH_TPU_COMPUTE=0 falls back to client-side
+        read-then-compute with the same kernel reference
+        implementations — bit-identical results, every payload byte
+        over the wire (the parity leg the tests drive)."""
+        from ceph_tpu import compute as compute_mod
+
+        if not compute_mod.env_enabled():
+            return await self._compute_client_side(kernel, oids, args)
+        import json as _json
+
+        client = self.client
+        args_raw = _json.dumps(args, sort_keys=True) if args else ""
+        results: Dict[str, bytes] = {}
+        errors: Dict[str, int] = {}
+        pending = list(dict.fromkeys(oids))
+        for attempt in range(client.max_retries):
+            if not pending:
+                break
+            osdmap = client.osdmap
+            by_primary: Dict[str, List[str]] = {}
+            next_pending: List[str] = []
+            for oid in pending:
+                pg = self.object_pg(oid)
+                primary = client._primary_cached(osdmap, pg)
+                addr = osdmap.osd_addrs.get(primary) \
+                    if primary >= 0 and osdmap.is_up(primary) else None
+                if addr is None:
+                    next_pending.append(oid)
+                    continue
+                by_primary.setdefault(addr, []).append(oid)
+            sem = asyncio.Semaphore(8)
+
+            async def one_wave(addr: str, part: List[str]) -> None:
+                async with sem:
+                    tid = client._next_tid()
+                    fut: asyncio.Future = \
+                        asyncio.get_running_loop().create_future()
+                    client._futures[tid] = fut
+                    try:
+                        await client.msgr.send_to(addr, MOSDCompute(
+                            tid, client.msgr.entity_name,
+                            self.pool_id, part, kernel, args_raw,
+                            osdmap.epoch,
+                            tenant=self.tenant
+                            or CURRENT_TENANT.get()))
+                        # a scan wave legitimately outlives a single
+                        # op's budget: scale the wait with the wave
+                        reply = await asyncio.wait_for(
+                            fut, client.op_timeout
+                            + len(part) / 100.0)
+                    except (ConnectionError, OSError,
+                            asyncio.TimeoutError):
+                        next_pending.extend(part)
+                        await client.refresh_map()
+                        return
+                    finally:
+                        client._futures.pop(tid, None)
+                    if reply.rc == EAGAIN:
+                        next_pending.extend(part)
+                        return
+                    if reply.rc != 0:
+                        for oid in part:
+                            errors[oid] = reply.rc
+                        return
+                    for oid in part:
+                        rc, data = reply.results.get(oid, (EAGAIN,
+                                                           b""))
+                        if rc == 0:
+                            results[oid] = data if isinstance(
+                                data, bytes) else bytes(data)
+                        elif rc == EAGAIN:
+                            next_pending.append(oid)
+                        else:
+                            errors[oid] = rc
+
+            # waves fly concurrently (bounded): the scan is one
+            # logical op — it must not serialize on primary count or
+            # wave count
+            await asyncio.gather(*(
+                one_wave(addr, batch[lo:lo + wave])
+                for addr, batch in by_primary.items()
+                for lo in range(0, len(batch), wave)))
+            if next_pending:
+                await client.wait_for_new_map(0.5)
+                await asyncio.sleep(0.05 + full_jitter(0.2, 0))
+            pending = next_pending
+        for oid in pending:
+            errors.setdefault(oid, EAGAIN)
+        return results, errors
+
+    async def _compute_client_side(self, kernel: str,
+                                   oids: List[str],
+                                   args: Optional[Dict[str, Any]]
+                                   ) -> Tuple[Dict[str, bytes],
+                                              Dict[str, int]]:
+        """CEPH_TPU_COMPUTE=0: read every object and evaluate the
+        kernel locally — the bit-exact parity oracle for the pushdown
+        path (and its bytes-moved foil in the bench)."""
+        from ceph_tpu import compute as compute_mod
+        from ceph_tpu.osd.osdmap import TYPE_ERASURE
+
+        kern = compute_mod.get_kernel(kernel)
+        if kern is None:
+            raise RadosError(-22, f"unknown kernel {kernel!r}")
+        kargs = args or {}
+        try:
+            kern.validate_args(kargs)
+        except compute_mod.ComputeError as e:
+            raise RadosError(e.rc, str(e))
+        pool = self.pool
+        k, chunk = 1, 0
+        if pool.type == TYPE_ERASURE:
+            from ceph_tpu.ec.registry import create_erasure_code
+
+            profile = self.client.osdmap.erasure_code_profiles[
+                pool.erasure_code_profile]
+            codec = create_erasure_code(dict(profile))
+            k = codec.get_data_chunk_count()
+            # default osd_pool_erasure_code_stripe_unit (the linear
+            # kernels' striping parameter; clusters overriding it
+            # must scan with CEPH_TPU_COMPUTE=1)
+            chunk = codec.get_chunk_size(k * 4096)
+        results: Dict[str, bytes] = {}
+        errors: Dict[str, int] = {}
+        sem = asyncio.Semaphore(16)
+
+        async def one(oid: str) -> None:
+            async with sem:
+                try:
+                    data = await self.read(oid)
+                except ObjectNotFound:
+                    errors[oid] = ENOENT
+                    return
+                except RadosError as e:
+                    errors[oid] = e.rc
+                    return
+            try:
+                results[oid] = kern.reference(data, kargs, k, chunk)
+            except compute_mod.ComputeError as e:
+                errors[oid] = e.rc
+
+        await asyncio.gather(*(one(oid)
+                               for oid in dict.fromkeys(oids)))
+        return results, errors
 
     async def setxattr(self, oid: str, name: str, value: bytes) -> None:
         reply = await self._submit(

@@ -133,6 +133,17 @@ def _samples():
                            client_challenge=b"c" * 16,
                            proof=b"p" * 8)
     yield "MAuthReply", m.MAuthReply(105, 0, b"s" * 16, b"ticket")
+    yield "MOSDCompute", m.MOSDCompute(
+        106, "client.abc", 3, ["obj-1", "obj-2"], "gf_fold",
+        '{"record":8}', epoch=12, tenant="t1")
+    yield "MOSDComputeReply", m.MOSDComputeReply(
+        106, 0, {"obj-1": (0, b"\x01" * 32), "obj-2": (-2, b"")},
+        {"pushdown": 1, "fallback": 0}, replay_epoch=0)
+    yield "MOSDSubCompute", m.MOSDSubCompute(
+        107, "gf_fold", "", [(3, 5, 1, "obj-1"), (3, 5, 1, "obj-2")],
+        epoch=12)
+    yield "MOSDSubComputeReply", m.MOSDSubComputeReply(
+        107, 0, [(0, "12'7", b"\x02" * 32), (-2, "", b"")])
 
 
 def _dump(obj) -> dict:

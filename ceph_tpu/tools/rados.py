@@ -14,6 +14,9 @@ Usage examples:
   python -m ceph_tpu.tools.rados -m HOST:PORT status
   python -m ceph_tpu.tools.rados -m HOST:PORT tell 0 perf dump
   python -m ceph_tpu.tools.rados -m HOST:PORT -p data bench 5 write
+  python -m ceph_tpu.tools.rados -m HOST:PORT -p data scan gf_fold
+  python -m ceph_tpu.tools.rados -m HOST:PORT -p data scan count \\
+      --args '{"record":8,"cmp":"lt","value":10}'
 """
 
 from __future__ import annotations
@@ -128,10 +131,37 @@ async def _dispatch(client: RadosClient, args) -> int:
         for k, v in sorted((await io.omap_get(args.obj)).items()):
             print(f"{k}: {v.decode('latin-1')}")
         return 0
+    if cmd == "scan":
+        return await _scan(io, args)
     if cmd == "bench":
         return await _bench(io, args)
     print(f"error: unknown command {cmd!r}", file=sys.stderr)
     return 2
+
+
+async def _scan(io, args) -> int:
+    """`rados scan <kernel> [obj ...]` — the coded-compute front
+    door: run a registered kernel over the named objects (default:
+    every object in the pool) where they live, print per-object
+    results.  Linear kernels (gf_fold, gf_fingerprint) print hex
+    digests; JSON-result kernels (count/sum/min/max/filter,
+    compress_score, dot_score) print decoded JSON."""
+    kargs = json.loads(args.kernel_args) if args.kernel_args else None
+    oids = args.objs or await io.list_objects()
+    if not oids:
+        _out({"results": {}, "errors": {}})
+        return 0
+    results, errors = await io.compute(args.kernel, oids, kargs)
+    rendered = {}
+    for oid, res in sorted(results.items()):
+        try:
+            rendered[oid] = json.loads(res)
+        except (ValueError, UnicodeDecodeError):
+            rendered[oid] = bytes(res).hex()
+    _out({"kernel": args.kernel,
+          "results": rendered,
+          "errors": {k: v for k, v in sorted(errors.items())}})
+    return 0 if not errors else 1
 
 
 def zipf_indices(theta: float, n: int, count: int,
@@ -294,6 +324,17 @@ def main(argv=None) -> int:
     gx = sub.add_parser("getxattr")
     gx.add_argument("obj")
     gx.add_argument("name")
+    scan = sub.add_parser("scan")
+    scan.add_argument("kernel",
+                      help="registered compute kernel (gf_fold,"
+                           " gf_fingerprint, count, sum, min, max,"
+                           " filter, compress_score, dot_score)")
+    scan.add_argument("objs", nargs="*",
+                      help="objects to scan (default: whole pool)")
+    scan.add_argument("--args", default="", dest="kernel_args",
+                      help="kernel args as JSON, e.g."
+                           " '{\"record\":8,\"cmp\":\"lt\","
+                           "\"value\":10}'")
     bench = sub.add_parser("bench")
     bench.add_argument("seconds", type=int)
     bench.add_argument("mode", choices=["write", "seq"])

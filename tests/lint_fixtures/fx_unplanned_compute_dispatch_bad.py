@@ -1,0 +1,9 @@
+"""Seeded violation: raw coded-compute kernel dispatch outside the
+plan cache and the breaker guard."""
+
+from ceph_tpu.compute import kernels
+
+
+def evaluate_wave(weights, batch):
+    fn = kernels.make_device_eval(weights)  # expect: unplanned-compute-dispatch
+    return fn(batch)

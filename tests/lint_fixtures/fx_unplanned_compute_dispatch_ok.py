@@ -1,0 +1,20 @@
+"""Clean twin: kernel evaluation through the plan cache (compute
+plan kind), the bit-exact numpy host twin, and a breaker-guarded
+raw dispatch."""
+
+from ceph_tpu.common import circuit
+from ceph_tpu.compute import kernels
+from ceph_tpu.ec import plan
+
+
+def evaluate_wave(name, weights, batch):
+    out = plan.compute_eval(name, weights, batch)
+    if out is None:
+        out = kernels.host_eval(weights, batch)
+    return out
+
+
+def guarded_probe(weights, batch):
+    return circuit.device_call(
+        "compute",
+        lambda: kernels.make_device_eval(weights)(batch), batch=1)

@@ -23,7 +23,7 @@ CONTRACT_KEYS = {"metric", "value", "unit", "vs_baseline",
                  "plan_cache", "encode_service", "tier",
                  "device_health", "tail", "load", "durability",
                  "mesh", "multihost", "trace", "group_commit",
-                 "truncated"}
+                 "compute", "truncated"}
 
 
 def test_contract_line_despite_hanging_backend(tmp_path):
@@ -144,6 +144,17 @@ def test_contract_line_despite_hanging_backend(tmp_path):
     assert gc["kv_commits_inline"] == gc["writers"]
     assert gc["bitexact"] == 1
     assert gc["batches"] >= 1
+    # the coded-compute probe ran: every registered linear kernel
+    # evaluated on a parity-including k-subset of one object's coded
+    # shards result-domain-decoded bit-exactly to the host reference,
+    # and the hedged sub-compute straggler leg completed from the
+    # first k shard-results (the 1 s straggler cancelled)
+    cp = contract["compute"]
+    assert cp["bitexact"] == 1
+    assert cp["linear_kernels"] >= 2
+    assert cp["straggler_avoided"] == 1
+    assert cp["first_k_bitexact"] == 1
+    assert cp["cancelled_subcomputes"] >= 1
     assert contract["truncated"] is False
     # details stayed out of stdout (they belong in bench_details.json)
     assert len(stdout_lines) == 1

@@ -1,0 +1,317 @@
+"""Coded-compute unit tier: kernel/code commutation, ragged batches,
+device-fault degradation, the `compute` plan kind, and the wire types.
+
+The load-bearing property (ceph_tpu/compute): for every registered
+LINEAR kernel, evaluating on ANY k of the k+m coded shards and
+decoding in the RESULT DOMAIN is bit-exact with decode-then-compute
+on the host — across (k, m) shapes, ragged object sizes, and with the
+device tier scripted to fail (host fallback stays bit-exact).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+
+import numpy as np
+import pytest
+
+import conftest
+from ceph_tpu import compute as compute_mod
+from ceph_tpu.compute import kernels as ck
+from ceph_tpu.ec.registry import create_erasure_code
+from ceph_tpu.osd import ec_util
+
+SHAPES = [(2, 1), (3, 2), (4, 2), (6, 3)]
+# ragged object sizes: sub-chunk, unaligned, multi-stripe
+SIZES = [1, 100, 4096, 3 * 4096 + 123, 8 * 4096 + 1]
+
+
+def _codec_and_sinfo(k: int, m: int):
+    codec = create_erasure_code({
+        "plugin": "ec_jax", "technique": "reed_sol_van",
+        "k": str(k), "m": str(m)})
+    unit = codec.get_chunk_size(k * 4096)
+    return codec, ec_util.StripeInfo(k, k * unit)
+
+
+def _encode_object(codec, sinfo, data: bytes):
+    padded = data + bytes(-len(data) % sinfo.get_stripe_width())
+    return ec_util.encode(sinfo, codec, padded,
+                          range(codec.get_chunk_count()))
+
+
+def _result_decode(kern, codec, k: int, chosen):
+    """First-k result-domain decode + object-level combine — the
+    engine's math (osd/compute.py), inlined for the oracle check."""
+    rsinfo = ec_util.StripeInfo(k, k * kern.lanes)
+    dec = bytes(ec_util.decode(rsinfo, codec, chosen))
+    return kern.combine([dec[i * kern.lanes:(i + 1) * kern.lanes]
+                         for i in range(k)])
+
+
+@pytest.mark.parametrize("k,m", SHAPES)
+@pytest.mark.parametrize("name", ["gf_fold", "gf_fingerprint"])
+def test_linear_kernels_commute_first_k(k, m, name):
+    """Bit-exactness of the pushdown across EVERY k-subset of the
+    coded shards (parity-only subsets included) vs the host oracle
+    on the logical bytes."""
+    kern = compute_mod.get_kernel(name)
+    assert kern is not None and kern.linear
+    codec, sinfo = _codec_and_sinfo(k, m)
+    assert codec.supports_result_decode()
+    rng = np.random.default_rng(17 * k + m)
+    data = rng.integers(0, 256, 2 * sinfo.get_stripe_width() + 321,
+                        dtype=np.uint8).tobytes()
+    shards = _encode_object(codec, sinfo, data)
+    ref = bytes(kern.reference(data, {}, k=k,
+                               chunk=sinfo.get_chunk_size()))
+    subsets = list(itertools.combinations(
+        range(codec.get_chunk_count()), k))
+    for chosen_ids in subsets:
+        results = compute_mod.shard_eval_batch(
+            kern, [shards[i] for i in chosen_ids], {})
+        got = _result_decode(
+            kern, codec, k,
+            {i: r for i, r in zip(chosen_ids, results)})
+        assert bytes(got) == ref, (name, k, m, chosen_ids)
+
+
+@pytest.mark.parametrize("name", ["gf_fold", "gf_fingerprint"])
+def test_linear_kernels_ragged_sizes(name):
+    """Ragged batches: objects of every size class evaluate in one
+    shard_eval_batch call and each matches its per-stream oracle —
+    and the zero pad is invariant (a padded object folds identically
+    to its unpadded self)."""
+    kern = compute_mod.get_kernel(name)
+    k, m = 3, 2
+    codec, sinfo = _codec_and_sinfo(k, m)
+    rng = np.random.default_rng(5)
+    streams = []
+    for size in SIZES:
+        data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        shards = _encode_object(codec, sinfo, data)
+        streams.extend(shards[i] for i in range(k + m))
+    batched = compute_mod.shard_eval_batch(kern, streams, {})
+    for stream, got in zip(streams, batched):
+        assert bytes(got) == bytes(kern.eval_stream(stream))
+    # pad invariance: trailing zeros change nothing
+    data = rng.integers(0, 256, 1000, dtype=np.uint8).tobytes()
+    assert bytes(kern.eval_stream(data)) == \
+        bytes(kern.eval_stream(data + bytes(64)))
+
+
+@pytest.mark.parametrize("k,m", [(2, 2), (4, 2)])
+@pytest.mark.parametrize("name", ["gf_fold", "gf_fingerprint"])
+def test_commutation_under_device_failure(k, m, name, monkeypatch):
+    """CEPH_TPU_INJECT_DEVICE_FAIL forces every device dispatch to
+    fail: the planned path degrades to the numpy host tier and the
+    first-k result-domain decode stays bit-exact (no exception ever
+    reaches the scan)."""
+    from ceph_tpu.common import circuit
+
+    kern = compute_mod.get_kernel(name)
+    codec, sinfo = _codec_and_sinfo(k, m)
+    rng = np.random.default_rng(23)
+    data = rng.integers(0, 256, sinfo.get_stripe_width() + 17,
+                        dtype=np.uint8).tobytes()
+    shards = _encode_object(codec, sinfo, data)
+    ref = bytes(kern.reference(data, {}, k=k,
+                               chunk=sinfo.get_chunk_size()))
+    monkeypatch.setenv("CEPH_TPU_INJECT_DEVICE_FAIL", "1.0")
+    circuit.reset_all()
+    try:
+        chosen_ids = tuple(range(m, k + m))  # parity-heavy subset
+        results = compute_mod.shard_eval_batch(
+            kern, [shards[i] for i in chosen_ids], {})
+        got = _result_decode(
+            kern, codec, k,
+            {i: r for i, r in zip(chosen_ids, results)})
+        assert bytes(got) == ref
+    finally:
+        monkeypatch.delenv("CEPH_TPU_INJECT_DEVICE_FAIL")
+        circuit.reset_all()
+
+
+@pytest.mark.skipif(conftest.DEVICE_INJECTION,
+                    reason="device dispatches scripted to fail")
+def test_compute_plan_kind_is_cached():
+    """The `compute` plan kind rides the ExecPlan cache: a repeated
+    same-geometry wave HITS instead of recompiling, and dispatches
+    land in plan.stats() under the compute label."""
+    from ceph_tpu.ec import plan as ec_plan
+    from ceph_tpu.ops import gf
+
+    if not gf.backend_available():
+        pytest.skip("no jax backend")
+    kern = compute_mod.get_kernel("gf_fold")
+    rng = np.random.default_rng(3)
+    batch = rng.integers(0, 256, (4, 128, kern.lanes),
+                         dtype=np.uint8)
+    weights = kern.row_weights(128)
+    first = ec_plan.compute_eval("gf_fold", weights, batch)
+    assert first is not None
+    before = ec_plan.stats()["hits"]
+    second = ec_plan.compute_eval("gf_fold", weights, batch)
+    assert second is not None
+    assert np.array_equal(first, second)
+    assert ec_plan.stats()["hits"] > before
+    assert np.array_equal(
+        np.asarray(first), np.asarray(ck.host_eval(weights, batch)))
+    assert any("compute[" in label
+               for label in ec_plan.stats()["per_plan"])
+
+
+def test_registry_has_the_advertised_kernel_set():
+    kernels = compute_mod.registered_kernels()
+    linear = {n for n, kn in kernels.items() if kn.linear}
+    assert linear == {"gf_fold", "gf_fingerprint"}
+    assert {"count", "sum", "min", "max", "filter",
+            "compress_score", "dot_score"} <= set(kernels)
+
+
+def test_record_aggregates_match_python_oracle():
+    rng = np.random.default_rng(11)
+    vals = rng.integers(0, 1 << 32, 500, dtype=np.uint64)
+    data = vals.astype("<u8").tobytes() + b"tail"  # ragged tail
+    args = {"record": 8, "off": 0, "len": 8, "cmp": "lt",
+            "value": 1 << 31}
+    hits = [int(v) for v in vals if int(v) < (1 << 31)]
+    count = json.loads(compute_mod.get_kernel("count").eval_object(
+        data, args))
+    assert count == {"count": len(hits)}
+    total = json.loads(compute_mod.get_kernel("sum").eval_object(
+        data, args))
+    assert total == {"count": len(hits), "sum": sum(hits)}
+    lo = json.loads(compute_mod.get_kernel("min").eval_object(
+        data, args))
+    assert lo == {"count": len(hits), "min": min(hits)}
+    hi = json.loads(compute_mod.get_kernel("max").eval_object(
+        data, args))
+    assert hi == {"count": len(hits), "max": max(hits)}
+    flt = json.loads(compute_mod.get_kernel("filter").eval_object(
+        data, {**args, "limit": 7}))
+    oracle_idx = [i for i, v in enumerate(vals)
+                  if int(v) < (1 << 31)]
+    assert flt["count"] == len(oracle_idx)
+    assert flt["indices"] == oracle_idx[:7]
+
+
+def test_record_aggregate_empty_and_bad_args():
+    kern = compute_mod.get_kernel("min")
+    assert json.loads(kern.eval_object(b"", {"record": 8})) == \
+        {"count": 0, "min": None}
+    with pytest.raises(compute_mod.ComputeError):
+        kern.eval_object(b"x" * 16, {"record": 8, "off": 4,
+                                     "len": 8})
+
+
+def test_malformed_wire_args_surface_as_einval():
+    """Args arrive off the wire as client JSON: null/string/negative/
+    huge values must come back as ComputeError(EINVAL) — never a
+    TypeError that the engine logs as an EIO or that crashes the
+    client-side parity path."""
+    kern = compute_mod.get_kernel("count")
+    for bad in ({"record": None}, {"record": "x"},
+                {"record": 1 << 70},
+                {"cmp": "lt", "value": -1},
+                {"cmp": "lt", "value": None}):
+        with pytest.raises(compute_mod.ComputeError) as ei:
+            kern.eval_object(b"\x00" * 64, bad)
+        assert ei.value.rc == -22
+    dot = compute_mod.get_kernel("dot_score")
+    with pytest.raises(compute_mod.ComputeError):
+        dot.eval_object(b"\x00" * 64,
+                        {"dim": 4, "query": ["a", "b", "c", "d"]})
+    with pytest.raises(compute_mod.ComputeError):
+        dot.validate_args({"dim": None, "query": []})
+
+
+def test_compress_score_orders_entropy():
+    kern = compute_mod.get_kernel("compress_score")
+    rng = np.random.default_rng(2)
+    noisy = json.loads(kern.eval_object(
+        rng.integers(0, 256, 16384, dtype=np.uint8).tobytes(), {}))
+    flat = json.loads(kern.eval_object(b"\x00" * 16384, {}))
+    assert noisy["entropy_bpb"] > 7.5
+    assert flat["entropy_bpb"] == 0.0
+
+
+def test_dot_score_finds_best_embedding():
+    kern = compute_mod.get_kernel("dot_score")
+    emb = np.zeros((5, 4), dtype=np.float32)
+    emb[3] = [1.0, 2.0, 3.0, 4.0]
+    out = json.loads(kern.eval_object(
+        emb.tobytes(), {"dim": 4, "query": [1.0, 1.0, 1.0, 1.0]}))
+    assert out["best"] == 3 and out["n"] == 5
+    with pytest.raises(compute_mod.ComputeError):
+        kern.validate_args({"dim": 4, "query": [1.0]})
+
+
+def test_unsupported_codecs_are_gated_out():
+    """Codecs outside the commutation gate must answer False — the
+    engine routes them to the full-decode fallback instead of
+    producing silently wrong result-domain decodes."""
+    lrc = create_erasure_code({
+        "plugin": "lrc", "k": "4", "m": "2", "l": "3"})
+    fn = getattr(lrc, "supports_result_decode", None)
+    assert fn is None or not fn()
+    cauchy = create_erasure_code({
+        "plugin": "ec_jax", "technique": "cauchy_good",
+        "k": "4", "m": "2"})
+    assert not cauchy.supports_result_decode()
+
+
+def test_compute_wire_messages_round_trip():
+    """The four MOSDCompute-family messages survive encode/decode
+    with the versioned-struct discipline."""
+    from ceph_tpu.msg.messages import (
+        MOSDCompute, MOSDComputeReply, MOSDSubCompute,
+        MOSDSubComputeReply, decode_message,
+    )
+
+    op = MOSDCompute(7, "client.x", 3, ["a", "b"], "gf_fold",
+                     '{"x":1}', epoch=9, tenant="t1")
+    back = decode_message(MOSDCompute.TAG, op.encode())
+    assert (back.tid, back.client, back.pool, back.oids,
+            back.kernel, back.args, back.epoch, back.tenant) == \
+        (7, "client.x", 3, ["a", "b"], "gf_fold", '{"x":1}', 9, "t1")
+
+    rep = MOSDComputeReply(7, 0, {"a": (0, b"\x01" * 32),
+                                  "b": (-2, b"")},
+                           {"pushdown": 1}, replay_epoch=4)
+    back = decode_message(MOSDComputeReply.TAG, rep.encode())
+    assert back.results["a"] == (0, b"\x01" * 32)
+    assert back.results["b"] == (-2, b"")
+    assert back.out == {"pushdown": 1} and back.replay_epoch == 4
+
+    sub = MOSDSubCompute(8, "gf_fold", "", [(3, 5, 1, "a")], epoch=9)
+    sub.trace = (123, 456)
+    back = decode_message(MOSDSubCompute.TAG, sub.encode())
+    assert back.items == [(3, 5, 1, "a")]
+    assert back.kernel == "gf_fold" and back.trace == (123, 456)
+
+    srep = MOSDSubComputeReply(8, 0, [(0, "9'4", b"\x02" * 32),
+                                      (-2, "", b"")])
+    back = decode_message(MOSDSubComputeReply.TAG, srep.encode())
+    assert [(rc, v, bytes(r)) for rc, v, r in back.results] == \
+        [(0, "9'4", b"\x02" * 32), (-2, "", b"")]
+
+
+def test_kill_switch_env():
+    assert compute_mod.env_enabled()
+    os.environ["CEPH_TPU_COMPUTE"] = "0"
+    try:
+        assert not compute_mod.env_enabled()
+    finally:
+        del os.environ["CEPH_TPU_COMPUTE"]
+
+
+def test_cli_scan_verb_parses():
+    """The `rados scan` front door: argparse wiring (the live path
+    is covered by the cluster tier)."""
+    from ceph_tpu.tools import rados as rados_cli
+
+    with pytest.raises(SystemExit):
+        rados_cli.main(["-m", "x:1", "scan"])  # kernel required

@@ -1,0 +1,260 @@
+"""Coded-compute cluster tier: the end-to-end scan through live
+daemons — pushdown vs the CEPH_TPU_COMPUTE=0 read-then-compute parity
+leg, bytes-moved accounting, the straggler/dead-OSD legs riding the
+hedged first-k sub-compute fan-out, and the nonlinear full-decode
+fallback (replicated + EC)."""
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cluster_helpers import Cluster
+
+EC22 = {"plugin": "ec_jax", "technique": "reed_sol_van",
+        "k": "2", "m": "2", "crush-failure-domain": "osd"}
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 180))
+
+
+async def _fill(io, n, seed=7, size=8192):
+    payloads = {}
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        data = rng.integers(0, 256, size + 17 * i,
+                            dtype=np.uint8).tobytes()
+        payloads[f"o{i}"] = data
+        await io.write_full(f"o{i}", data)
+    return payloads
+
+
+def test_scan_pushdown_matches_read_then_compute():
+    """The acceptance bit-exactness leg: pushdown results ==
+    client-side read-then-compute for a linear AND a nonlinear
+    kernel, with the pushdown moving only result bytes (no sub-READ
+    traffic at all for the linear kernel) and the engine counters
+    attributing the paths."""
+    async def main():
+        cluster = Cluster(num_osds=5, osds_per_host=5)
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool("cpool",
+                                                profile=EC22,
+                                                pg_num=8)
+            io = cluster.client.open_ioctx("cpool")
+            payloads = await _fill(io, 10)
+            oids = sorted(payloads)
+
+            def subread_bytes():
+                return sum(o.perf["subread_bytes"]
+                           for o in cluster.osds.values())
+
+            before = subread_bytes()
+            results, errors = await io.compute("gf_fold", oids)
+            assert not errors
+            assert set(results) == set(oids)
+            # the pushdown moved ZERO payload bytes: sub-compute
+            # replies carry lane-width results, never chunk streams
+            assert subread_bytes() == before
+            # parity: kill switch -> client-side read-then-compute
+            os.environ["CEPH_TPU_COMPUTE"] = "0"
+            try:
+                ref, referr = await io.compute("gf_fold", oids)
+            finally:
+                del os.environ["CEPH_TPU_COMPUTE"]
+            assert not referr
+            assert {o: bytes(r) for o, r in results.items()} == \
+                {o: bytes(r) for o, r in ref.items()}
+            # the parity leg DID move the payloads over sub-reads
+            assert subread_bytes() > before
+
+            # nonlinear kernel: full-decode fallback, still only
+            # result bytes back to the client
+            res, err = await io.compute("count", oids, {"record": 8})
+            assert not err
+            for oid, r in res.items():
+                assert json.loads(r)["count"] == \
+                    len(payloads[oid]) // 8
+            os.environ["CEPH_TPU_COMPUTE"] = "0"
+            try:
+                ref2, _ = await io.compute("count", oids,
+                                           {"record": 8})
+            finally:
+                del os.environ["CEPH_TPU_COMPUTE"]
+            assert {o: bytes(r) for o, r in res.items()} == \
+                {o: bytes(r) for o, r in ref2.items()}
+
+            pushed = sum(o.compute.perf()["pushdown_objects"]
+                         for o in cluster.osds.values())
+            fell = sum(o.compute.perf()["fallback_objects"]
+                       for o in cluster.osds.values())
+            assert pushed == len(oids)   # gf_fold rode the code
+            assert fell == len(oids)     # count took full decode
+            # a scan of a missing object reports ENOENT, scan-style
+            res3, err3 = await io.compute("gf_fold", ["nope"])
+            assert not res3 and err3 == {"nope": -2}
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_scan_p99_flat_under_one_slow_osd():
+    """The straggler leg: one acting-set OSD gets a large injected
+    delay; the hedged first-k sub-compute fan-out completes every
+    object from the other k shards, so the scan finishes in a small
+    fraction of the delay — and bit-exactly."""
+    async def main():
+        delay = 2.0
+        cluster = Cluster(num_osds=5, osds_per_host=5,
+                          osd_config={"osd_heartbeat_interval": 3.0,
+                                      "osd_heartbeat_grace": 20.0})
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool("spool",
+                                                profile=EC22,
+                                                pg_num=8)
+            io = cluster.client.open_ioctx("spool")
+            payloads = await _fill(io, 8, seed=9)
+            oids = sorted(payloads)
+            ref, _ = await io.compute("gf_fold", oids)
+            # slow the OSD that primaries the FEWEST of our objects,
+            # so it sits on sub-compute fan-outs, not op targets
+            counts = {o: 0 for o in cluster.osds}
+            for oid in oids:
+                pg = io.object_pg(oid)
+                _a, p = cluster.mon.osdmap.pg_to_acting_osds(pg)
+                counts[p] = counts.get(p, 0) + 1
+            slow = min(sorted(counts), key=lambda o: counts[o])
+            targets = [oid for oid in oids
+                       if cluster.mon.osdmap.pg_to_acting_osds(
+                           io.object_pg(oid))[1] != slow]
+            assert targets
+            cluster.osds[slow].msgr.inject_internal_delays = delay
+            t0 = time.monotonic()
+            results, errors = await io.compute("gf_fold", targets)
+            elapsed = time.monotonic() - t0
+            assert not errors
+            assert {o: bytes(results[o]) for o in targets} == \
+                {o: bytes(ref[o]) for o in targets}
+            # first-k completion: the wave never waited out the
+            # injected delay (unhedged, every pg touching the slow
+            # OSD would stall >= delay)
+            assert elapsed < delay, elapsed
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_scan_survives_a_dead_osd():
+    """A DEAD acting-set member is the straggler limit case: the
+    remaining k+m-1 shards still complete every object, bit-exact."""
+    async def main():
+        cluster = Cluster(num_osds=5, osds_per_host=5)
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool("dpool",
+                                                profile=EC22,
+                                                pg_num=8)
+            io = cluster.client.open_ioctx("dpool")
+            payloads = await _fill(io, 6, seed=13)
+            oids = sorted(payloads)
+            ref, _ = await io.compute("gf_fold", oids)
+            victim = max(cluster.osds)
+            await cluster.kill_osd(victim)
+            await cluster.wait_for_osd_down(victim)
+            await cluster.wait_for_clean(60.0)
+            results, errors = await io.compute("gf_fold", oids)
+            assert not errors
+            assert {o: bytes(results[o]) for o in oids} == \
+                {o: bytes(ref[o]) for o in oids}
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_compute_on_replicated_pool_and_scoring_kernels():
+    """Replicated pools take the fallback path (k=1 semantics) for
+    every kernel; the scoring kernels return their canonical JSON."""
+    async def main():
+        cluster = Cluster(num_osds=4)
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool("rp", size=3,
+                                                        pg_num=8)
+            io = cluster.client.open_ioctx("rp")
+            rng = np.random.default_rng(3)
+            noisy = rng.integers(0, 256, 16384,
+                                 dtype=np.uint8).tobytes()
+            await io.write_full("noisy", noisy)
+            await io.write_full("flat", b"\x00" * 16384)
+            emb = np.zeros((4, 8), dtype=np.float32)
+            emb[2] = 1.0
+            await io.write_full("emb", emb.tobytes())
+
+            res, err = await io.compute(
+                "compress_score", ["noisy", "flat"])
+            assert not err
+            assert json.loads(res["noisy"])["entropy_bpb"] > 7.5
+            assert json.loads(res["flat"])["entropy_bpb"] == 0.0
+
+            res, err = await io.compute(
+                "dot_score", ["emb"],
+                {"dim": 8, "query": [1.0] * 8})
+            assert not err
+            assert json.loads(res["emb"])["best"] == 2
+
+            # linear kernel on a replicated pool: k=1 fallback parity
+            res, err = await io.compute("gf_fold", ["noisy"])
+            assert not err
+            os.environ["CEPH_TPU_COMPUTE"] = "0"
+            try:
+                ref, _ = await io.compute("gf_fold", ["noisy"])
+            finally:
+                del os.environ["CEPH_TPU_COMPUTE"]
+            assert bytes(res["noisy"]) == bytes(ref["noisy"])
+
+            # unknown kernel is an explicit refusal
+            res, err = await io.compute("no_such_kernel", ["noisy"])
+            assert not res and err == {"noisy": -22}
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_scan_traces_name_the_compute_stages():
+    """The per-stage observability contract: a scan leaves `compute`
+    / `subcompute` stage samples in the primaries' critical-path
+    histograms (the stage rows the bench's trace decomposition
+    reads)."""
+    async def main():
+        cluster = Cluster(num_osds=5, osds_per_host=5)
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool("tpool",
+                                                profile=EC22,
+                                                pg_num=8)
+            io = cluster.client.open_ioctx("tpool")
+            await _fill(io, 6, seed=21)
+            _res, err = await io.compute(
+                "gf_fold", [f"o{i}" for i in range(6)])
+            assert not err
+            stages = set()
+            for osd in cluster.osds.values():
+                stages.update(osd.tracer.stage_perf())
+            assert any(s.startswith("compute") for s in stages), \
+                stages
+            assert "subcompute" in stages or \
+                any("subcompute" in s for s in stages), stages
+        finally:
+            await cluster.stop()
+
+    run(main())

@@ -29,6 +29,7 @@ RULES = [
     "jit-bypass-plan",
     "unguarded-device-dispatch",
     "unplanned-mesh-dispatch",
+    "unplanned-compute-dispatch",
     "raw-process-group",
     "unhedged-gather",
     "span-leak",
@@ -52,6 +53,7 @@ CONFIG = {"dtype_paths": ("fx_uint8",),
           "encode_paths": ("fx_sync_encode_in_async",),
           "device_paths": ("fx_unguarded_device_dispatch",),
           "mesh_paths": ("fx_unplanned_mesh_dispatch",),
+          "compute_paths": ("fx_unplanned_compute_dispatch",),
           "gather_paths": ("fx_unhedged_gather",),
           "latency_paths": ("fx_unbounded_latency_buffer",),
           "durability_paths": ("fx_commit_before_durability",),
