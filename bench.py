@@ -70,6 +70,7 @@ def _emit_contract(value: Optional[float],
                    trace: Optional[dict] = None,
                    group_commit: Optional[dict] = None,
                    compute: Optional[dict] = None,
+                   xsched: Optional[dict] = None,
                    truncated: bool = False) -> None:
     """Print the one-line JSON driver contract, exactly once, before
     any optional extended benches run — a wedged tunnel or a crashed
@@ -95,7 +96,10 @@ def _emit_contract(value: Optional[float],
     correctness + spans-on-vs-off overhead at sample rate 0), compute
     the coded-compute probe (every linear kernel first-k
     result-domain-decode bit-exact on a parity-including shard
-    subset + the hedged straggler leg);
+    subset + the hedged straggler leg), xsched the codec-compiler
+    probe (schedule-vs-naive bit-exactness over the bitmatrix family
+    + decode submatrices + a GF bit expansion, with the measured
+    XOR-count reduction and memo hits);
     truncated flags a budget-shortened run.  Thread-safe:
     the deadline watchdog and the bench body may race to emit."""
     global _contract_emitted
@@ -121,6 +125,7 @@ def _emit_contract(value: Optional[float],
             "trace": trace,
             "group_commit": group_commit,
             "compute": compute,
+            "xsched": xsched,
             "truncated": bool(truncated),
         }), flush=True)
 
@@ -639,6 +644,73 @@ def _compute_probe() -> Optional[dict]:
         return None
 
 
+def _xsched_probe() -> Optional[dict]:
+    """Pre-contract probe of the XOR-schedule codec compiler
+    (ec/xsched.py): the bitmatrix trio's encode matrices, two decode
+    submatrices and a GF(2^8) cauchy bit expansion compile into
+    schedules that execute BIT-EXACTLY against the naive row-walk
+    oracle; the memo serves repeat compiles from cache; and the best
+    measured XOR-count reduction clears the >=25% acceptance bar
+    (decode inverses and GF expansions are where the CSE bites —
+    encode matrices of the minimal-density codes reduce less, by
+    design).  Counters land in the contract line's `xsched` key;
+    None (with a stderr note) when the probe cannot run."""
+    if _remaining() < 0:
+        print("# xsched probe skipped: budget exhausted",
+              file=sys.stderr)
+        return None
+    try:
+        from ceph_tpu.ec import xsched
+        from ceph_tpu.models import bitmatrix as bmx
+        from ceph_tpu.models import reed_solomon as rs
+        from ceph_tpu.ops import gf as gf_ops
+
+        lib = bmx.liberation_bitmatrix(4, 7)
+        l8 = bmx.liber8tion_bitmatrix(4)
+        cases = {
+            "liberation": lib,
+            "blaum_roth": bmx.blaum_roth_bitmatrix(4, 6),
+            "liber8tion": l8,
+            "liberation_decode": bmx.decode_bitmatrix(
+                lib, 4, 7, (2, 3, 4, 5), (0, 1)),
+            "liber8tion_decode": bmx.decode_bitmatrix(
+                l8, 4, 8, (1, 2, 3, 4), (0, 5)),
+            "cauchy_good_bits": gf_ops.gf_matrix_to_bits(
+                rs.cauchy_good_matrix(4, 2)),
+        }
+        rng = np.random.default_rng(17)
+        before = xsched.stats()
+        bitexact = 1
+        reductions = {}
+        for name, bm in cases.items():
+            sched = xsched.compile_matrix(bm)
+            pk = rng.integers(0, 256, (2, bm.shape[1], 64),
+                              dtype=np.uint8)
+            want = xsched.naive_xor_matmul(bm, pk)
+            out = np.zeros((2, bm.shape[0], 64), dtype=np.uint8)
+            xsched.execute_host(
+                sched, [pk[:, c, :] for c in range(bm.shape[1])],
+                [out[:, r, :] for r in range(bm.shape[0])])
+            if not np.array_equal(out, want):
+                bitexact = 0
+            reductions[name] = round(sched.reduction_pct, 1)
+            xsched.compile_matrix(bm)        # the memo leg
+        after = xsched.stats()
+        return {
+            "bitexact": bitexact,
+            "xor_reduction_pct": max(reductions.values()),
+            "reductions": reductions,
+            "schedules": after["compiled"] - before["compiled"],
+            "cache_hits": after["cache_hits"] - before["cache_hits"],
+            "xors_naive": after["xors_naive"] - before["xors_naive"],
+            "xors_scheduled": after["xors_scheduled"]
+            - before["xors_scheduled"],
+        }
+    except Exception as e:
+        print(f"# xsched probe failed: {e!r}", file=sys.stderr)
+        return None
+
+
 def _trace_probe() -> Optional[dict]:
     """Pre-contract probe of the critical-path tracing layer.  Two
     halves: (1) the critical-path reducer reconstructs a hand-built
@@ -1118,6 +1190,168 @@ def bench_compute() -> dict:
             await cluster.stop()
 
     return asyncio.run(run())
+
+
+def bench_xsched() -> dict:
+    """Codec-compiler acceptance sweep (ROADMAP item 4): bitmatrix
+    encode AND decode GiB/s at small chunks (~4/16/64 KiB), compiled
+    XOR schedule vs the CEPH_TPU_XSCHED=0 naive row-walk.  The host
+    XOR tier is dispatch-free, so the small-chunk delta IS the
+    XOR-count + copy-discipline cut — exactly the regime where every
+    other landed win (batching, mesh, group commit) is already
+    amortized.  A live-cluster leg cites the PR-10 per-stage
+    histograms (the `encode_wait` stage self-time per mode) per the
+    ROADMAP acceptance discipline.  Bit-exactness across modes is
+    asserted on every leg."""
+    import asyncio
+
+    from ceph_tpu.ec.registry import create_erasure_code
+
+    iters = 2 if _SMOKE else 9
+    rng = np.random.default_rng(23)
+
+    def timed(fn) -> float:
+        fn()                    # warm: schedule compiles + caches
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    def with_mode(on: bool, fn):
+        prev = os.environ.get("CEPH_TPU_XSCHED")
+        os.environ["CEPH_TPU_XSCHED"] = "1" if on else "0"
+        try:
+            return fn()
+        finally:
+            if prev is None:
+                os.environ.pop("CEPH_TPU_XSCHED", None)
+            else:
+                os.environ["CEPH_TPU_XSCHED"] = prev
+
+    sweep = {}
+    for tech, w in (("liber8tion", 8), ("liberation", 7)):
+        for target in (4 << 10, 16 << 10, 64 << 10):
+            # packetsize scales with the chunk (the jerasure cache
+            # discipline): region bytes = chunk/w is what the XOR
+            # executor streams per op — the measured crossover where
+            # the schedule's op-count cut beats numpy call overhead
+            # sits near 4 KiB regions
+            ps = max(target // (2 * w) // 16 * 16, 16)
+            codec = create_erasure_code({
+                "plugin": "ec_jax", "technique": tech, "k": "4",
+                "m": "2", "w": str(w), "packetsize": str(ps)})
+            n = codec.k + codec.m
+            align = codec.get_alignment()
+            total = max(round(target * codec.k / align), 1) * align
+            payload = rng.integers(0, 256, total,
+                                   dtype=np.uint8).tobytes()
+            enc_gibs, enc_bytes = {}, {}
+            for mode in ("sched", "naive"):
+                on = mode == "sched"
+                enc_bytes[mode] = with_mode(
+                    on, lambda: codec.encode(range(n), payload))
+                t = with_mode(on, lambda: timed(
+                    lambda: codec.encode(range(n), payload)))
+                enc_gibs[mode] = total / t / (1 << 30)
+            assert {i: bytes(b)
+                    for i, b in enc_bytes["sched"].items()} == \
+                {i: bytes(b) for i, b in enc_bytes["naive"].items()}, \
+                f"{tech}: scheduled parity != naive parity"
+            encoded = enc_bytes["sched"]
+            chunk_len = len(encoded[0])
+            # two erasures, one data + one parity — the RAID-6 worst
+            # case, served by the shared inverted submatrix
+            avail = {i: bytes(encoded[i]) for i in range(n)
+                     if i not in (0, n - 1)}
+            dec_gibs, dec_out = {}, {}
+            for mode in ("sched", "naive"):
+                on = mode == "sched"
+                dec_out[mode] = with_mode(
+                    on, lambda: codec.decode(range(n), avail,
+                                             chunk_len))
+                t = with_mode(on, lambda: timed(
+                    lambda: codec.decode(range(n), avail,
+                                         chunk_len)))
+                dec_gibs[mode] = total / t / (1 << 30)
+            assert all(bytes(dec_out["sched"][i]) ==
+                       bytes(dec_out["naive"][i]) for i in range(n))
+            sweep[f"{tech}_{chunk_len}B"] = {
+                "chunk_bytes": chunk_len,
+                "encode_sched_gibs": round(enc_gibs["sched"], 3),
+                "encode_naive_gibs": round(enc_gibs["naive"], 3),
+                "encode_speedup": round(
+                    enc_gibs["sched"] / enc_gibs["naive"], 3),
+                "decode_sched_gibs": round(dec_gibs["sched"], 3),
+                "decode_naive_gibs": round(dec_gibs["naive"], 3),
+                "decode_speedup": round(
+                    dec_gibs["sched"] / dec_gibs["naive"], 3),
+            }
+
+    # live-cluster leg: the same writes through real daemons per
+    # mode, the win cited in the per-stage critical-path histograms
+    # (PR-10 discipline — "faster" must name the stage).  The leg
+    # runs IN the acceptance regime: 64 KiB chunks (w=8, ps=8 KiB),
+    # where the schedule's XOR cut is memory-bound, not numpy-call-
+    # overhead-bound
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_helpers import Cluster
+
+    profile = {"plugin": "ec_jax", "technique": "liber8tion",
+               "k": "4", "m": "2", "w": "8", "packetsize": "8192",
+               "crush-failure-domain": "osd"}
+    nobj = 4 if _SMOKE else 16
+    payload = rng.integers(0, 256, 4 * 8 * 8192,
+                           dtype=np.uint8).tobytes()
+
+    async def cluster_leg() -> dict:
+        from ceph_tpu.loadgen.stats import LatencyHistogram
+
+        cluster = Cluster(num_osds=6, osds_per_host=6)
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "xsbench", profile=profile, pg_num=8)
+            io = cluster.client.open_ioctx("xsbench")
+            for i in range(nobj):
+                await io.write_full(f"o{i}", payload)
+                got = await io.read(f"o{i}")
+                assert bytes(got) == payload  # parity per mode
+            merged: dict = {}
+            for osd in cluster.osds.values():
+                for stage, h in osd.tracer.stage_hist.items():
+                    agg = merged.setdefault(stage,
+                                            LatencyHistogram())
+                    agg.merge(h)
+            out = {}
+            for stage, h in sorted(merged.items()):
+                p50 = h.percentile(0.5)
+                out[stage] = round((p50 or 0.0) * 1e3, 3)
+            return out
+        finally:
+            await cluster.stop()
+
+    stage_p50 = {}
+    for mode in ("sched", "naive"):
+        stage_p50[mode] = with_mode(
+            mode == "sched", lambda: asyncio.run(cluster_leg()))
+    # the cited stage: the bitmatrix codecs take the INLINE encode
+    # path, whose span (`encode_inline`, added with this bench) is
+    # exactly the codec work — the XOR cut must show up THERE, not
+    # hide in an end-to-end blur; service-batched profiles show as
+    # encode_wait instead
+    cited = next((s for s in ("encode_inline", "encode_wait",
+                              "osd_op")
+                  if any(s in stage_p50[m] for m in stage_p50)),
+                 "osd_op")
+    encode_stage = {mode: stage_p50[mode].get(cited)
+                    for mode in ("sched", "naive")}
+    return {"xsched_sweep": sweep,
+            "xsched_cluster_stage_p50_ms": stage_p50,
+            "xsched_cited_stage": cited,
+            "xsched_cited_stage_p50_ms": encode_stage}
 
 
 def _load_probe() -> Optional[dict]:
@@ -2347,6 +2581,10 @@ def main() -> None:
     # coded-compute probe (before the contract): tiny scan bit-exact
     # through first-k result-domain decode + the hedged straggler leg
     compute_counters = _compute_probe()
+    # codec-compiler probe (before the contract): compiled XOR
+    # schedules bit-exact vs the naive row-walk across the bitmatrix
+    # family, with the measured XOR-count reduction + memo hits
+    xsched_counters = _xsched_probe()
 
     # the driver contract line, before every optional/extended bench:
     # a wedge below this point can cost detail rows, never the bench
@@ -2362,6 +2600,7 @@ def main() -> None:
                    trace=trace_counters,
                    group_commit=group_commit_counters,
                    compute=compute_counters,
+                   xsched=xsched_counters,
                    truncated=skip_optional)
 
     # decode sweep over 1..m erasures (the reference benchmark sweeps
@@ -2506,6 +2745,18 @@ def main() -> None:
         except Exception as e:
             print(f"# compute bench failed: {e!r}", file=sys.stderr)
 
+    # codec-compiler section: the small-chunk scheduled-vs-naive
+    # sweep (encode AND decode) + the live-cluster leg citing the
+    # encode_wait stage histogram per mode
+    xsched_section: dict = {}
+    if skip_optional:
+        skipped_sections.append("xsched")
+    else:
+        try:
+            xsched_section = bench_xsched()
+        except Exception as e:
+            print(f"# xsched bench failed: {e!r}", file=sys.stderr)
+
     # degraded-mode section: breakers forced open -> host-path
     # throughput delta (what a wedged accelerator costs while the
     # breaker holds it out of the hot path)
@@ -2579,6 +2830,7 @@ def main() -> None:
         **mesh_section,
         **multihost_section,
         **compute_section,
+        **xsched_section,
         **degraded_section,
         **load_section,
         **durability_section,
@@ -2594,6 +2846,7 @@ def main() -> None:
         "trace": trace_counters,
         "group_commit": group_commit_counters,
         "compute": compute_counters,
+        "xsched": xsched_counters,
         "host_cores": os.cpu_count(),
         "encode_ms_per_batch": t_enc * 1e3,
         "k": k, "m": m, "chunk_bytes": chunk, "batch": batch,

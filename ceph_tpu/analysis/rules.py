@@ -59,6 +59,16 @@ EC dispatch discipline:
                        compile is invisible to plan.stats() and the
                        dispatch has no watchdog or bit-exact host
                        degradation
+  unscheduled-bitmatrix-xor
+                       naive row-walk XOR loops (bitwise_xor.reduce /
+                       subscripted ^= accumulation inside a loop) in
+                       ec/ outside ec/xsched.py + ec/plan.py: the XOR
+                       program bypasses the schedule compiler's CSE,
+                       memoization and stats — execute a compiled
+                       schedule (xsched.compile_matrix +
+                       execute_host) instead; pure-GF multiply loops
+                       (wide-word fields) are not XOR walks and are
+                       exempt
   raw-process-group    jax.distributed.initialize/shutdown outside
                        the parallel/multihost.py bootstrap seam: a
                        process group joined elsewhere skips the gloo
@@ -719,6 +729,88 @@ def rule_unplanned_compute_dispatch(a: Analyzer) -> None:
 
 
 # ---------------------------------------------------------------------
+# unscheduled-bitmatrix-xor
+# ---------------------------------------------------------------------
+
+# modules whose XOR region programs must ride the schedule compiler
+# (ceph_tpu/ec/xsched.py): a hand-rolled row walk pays the naive XOR
+# count (no CSE), compiles nothing (no memoization) and is invisible
+# to plan.stats()["xsched"].  xsched.py holds the kill-switch naive
+# walk itself and plan.py the device lowering — the two legitimate
+# homes.
+_XSCHED_PATHS = ("ceph_tpu/ec/",)
+_XSCHED_EXEMPT = ("ec/xsched.py", "ec/plan.py")
+# GF-multiply callee tails: a loop that MULTIPLIES (the wide-word
+# GF(2^16/32) host matmul) is field math, not a schedulable pure-XOR
+# walk
+_GF_MUL_TAILS = {"mul", "mul_vec", "gf_mul", "gf_mul_jax"}
+
+
+def _enclosing_loops(mod, node: ast.AST) -> list:
+    out = []
+    cur = node
+    while cur is not None:
+        cur = mod.parents.get(cur)
+        if isinstance(cur, (ast.For, ast.While, ast.AsyncFor)):
+            out.append(cur)
+    return out
+
+
+def _loop_multiplies(loops: list) -> bool:
+    for loop in loops:
+        for sub in ast.walk(loop):
+            if isinstance(sub, ast.Call) and \
+                    (dotted(sub.func) or "").split(".")[-1] in \
+                    _GF_MUL_TAILS:
+                return True
+    return False
+
+
+def rule_unscheduled_bitmatrix_xor(a: Analyzer) -> None:
+    """Naive bitmatrix row-walk in ec/ outside xsched/plan: a loop
+    XOR-folding byte regions (`np.bitwise_xor.reduce(...)` or a
+    subscripted `^=` accumulate) re-pays the naive XOR count on every
+    call — compile the matrix once (xsched.compile_matrix, memoized
+    by sha256 signature) and run the schedule (execute_host / the
+    xor_sched plan kind).  Pure-XOR loops only: loops that also
+    GF-multiply (wide-word fields) are exempt."""
+    paths = a.config.get("xsched_paths", _XSCHED_PATHS)
+    exempt = a.config.get("xsched_exempt", _XSCHED_EXEMPT)
+    for mod in a.project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if not any(p in rel for p in paths):
+            continue
+        if any(e in rel for e in exempt):
+            continue
+        for node in ast.walk(mod.tree):
+            what = None
+            if isinstance(node, ast.Call) and \
+                    (dotted(node.func) or "").endswith(
+                        "bitwise_xor.reduce"):
+                what = "np.bitwise_xor.reduce row-fold"
+            elif isinstance(node, ast.AugAssign) and \
+                    isinstance(node.op, ast.BitXor) and \
+                    isinstance(node.target, ast.Subscript):
+                what = "subscripted ^= XOR accumulation"
+            if what is None:
+                continue
+            loops = _enclosing_loops(mod, node)
+            if not loops or _loop_multiplies(loops):
+                continue
+            a.emit("unscheduled-bitmatrix-xor", mod, node,
+                   f"{what} inside a loop: a naive row walk pays "
+                   "the unoptimized XOR count on every call and "
+                   "compiles nothing — compile the bit matrix once "
+                   "(ceph_tpu.ec.xsched.compile_matrix, memoized by "
+                   "signature) and execute the schedule "
+                   "(xsched.execute_host or the xor_sched plan "
+                   "kind)",
+                   severity="warning",
+                   symbol=_enclosing_qualname(mod, node),
+                   scope_line=_scope_line(mod, node))
+
+
+# ---------------------------------------------------------------------
 # raw-process-group
 # ---------------------------------------------------------------------
 
@@ -1213,6 +1305,7 @@ def default_rules() -> Dict[str, object]:
         "unguarded-device-dispatch": rule_unguarded_device_dispatch,
         "unplanned-mesh-dispatch": rule_unplanned_mesh_dispatch,
         "unplanned-compute-dispatch": rule_unplanned_compute_dispatch,
+        "unscheduled-bitmatrix-xor": rule_unscheduled_bitmatrix_xor,
         "raw-process-group": rule_raw_process_group,
         "unhedged-gather": rule_unhedged_gather,
         "span-leak": rule_span_leak,

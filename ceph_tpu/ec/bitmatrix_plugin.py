@@ -13,19 +13,25 @@ across the chunk (jerasure_bitmatrix_encode's packet walk); coding
 packet r of chunk j is the XOR of the data packets selected by
 bitmatrix row j*w + r.  Packet XOR is VPU/host-SIMD-shaped work, not
 MXU work — the reference runs these codes on CPU XOR too — so the
-execution tier is numpy bitwise-XOR over packet views (the native
-region-xor underneath numpy's core).  Decode inverts the surviving
-k*w x k*w bit submatrix (models/bitmatrix.decode_bitmatrix), the
-isa-style signature-keyed cache holding the result.
+execution tier is the COMPILED XOR schedule (ec/xsched.py: Paar CSE
++ scheduling + memoization by codec/submatrix sha256 signature) run
+over zero-copy packet views (models/bitmatrix.packet_views) straight
+off the chunk buffers; CEPH_TPU_XSCHED=0 pins the naive row-walk
+(xsched.naive_xor_matmul — bit-identical).  Decode inverts the
+surviving k*w x k*w bit submatrix (models/bitmatrix.decode_bitmatrix)
+ONCE per (codec, erasure pattern) PROCESS-wide: the inverted rows
+live in ec/dispatch.py's shared signature-keyed cache, so
+re-instantiated codecs (pool remounts, registry re-resolution) reuse
+them.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Mapping, Set
+from typing import Dict, List, Mapping, Set
 
 import numpy as np
 
-from ceph_tpu.ec import dispatch
+from ceph_tpu.ec import dispatch, xsched
 from ceph_tpu.ec.interface import ErasureCode, ErasureCodeError, to_int
 from ceph_tpu.models import bitmatrix as bmx
 
@@ -45,7 +51,7 @@ class ErasureCodeJaxBitmatrix(ErasureCode):
         self.w = 7
         self.packetsize = DEFAULT_PACKETSIZE
         self.bitmatrix: np.ndarray | None = None
-        self._decode_cache = dispatch.LruCache(256)
+        self._sig: str | None = None
 
     def init(self, profile: Dict[str, str]) -> None:
         profile["technique"] = self.technique
@@ -84,6 +90,12 @@ class ErasureCodeJaxBitmatrix(ErasureCode):
                 self.bitmatrix = bmx.liber8tion_bitmatrix(self.k)
         except ValueError as e:  # prime/bound violations
             raise ErasureCodeError(22, str(e))
+        # process-stable codec identity: keys the shared decode-rows
+        # cache AND the memoized XOR schedules (the ExecPlan signature
+        # discipline — identical profiles share everything)
+        self._sig = xsched.matrix_signature(
+            self.bitmatrix,
+            extra=f"{self.technique}/k{self.k}/w{self.w}")
 
     # -- geometry ----------------------------------------------------------
 
@@ -94,7 +106,8 @@ class ErasureCodeJaxBitmatrix(ErasureCode):
     # -- packet math -------------------------------------------------------
 
     def _packets(self, arrs: np.ndarray) -> np.ndarray:
-        """(n, chunk) -> (blocks, n*w, packetsize) packet stacks."""
+        """(n, chunk) -> (blocks, n*w, packetsize) packet stacks (the
+        naive kill-switch path's layout)."""
         n, chunk = arrs.shape
         blk = self.w * self.packetsize
         assert chunk % blk == 0, (chunk, blk)
@@ -104,18 +117,6 @@ class ErasureCodeJaxBitmatrix(ErasureCode):
             .transpose(1, 0, 2, 3)
             .reshape(b, n * self.w, self.packetsize))
 
-    @staticmethod
-    def _xor_matmul(rows: np.ndarray, packets: np.ndarray) -> np.ndarray:
-        """(R, C) 0/1 x (B, C, ps) byte packets -> (B, R, ps) XORs."""
-        b, _c, ps = packets.shape
-        out = np.zeros((b, rows.shape[0], ps), dtype=np.uint8)
-        for r in range(rows.shape[0]):
-            idx = np.flatnonzero(rows[r])
-            if idx.size:
-                out[:, r] = np.bitwise_xor.reduce(
-                    packets[:, idx, :], axis=1)
-        return out
-
     def _unpackets(self, pk: np.ndarray, n: int) -> np.ndarray:
         """(blocks, n*w, ps) -> (n, chunk) chunk bytes."""
         b = pk.shape[0]
@@ -124,24 +125,52 @@ class ErasureCodeJaxBitmatrix(ErasureCode):
             .transpose(1, 0, 2, 3)
             .reshape(n, b * self.w * self.packetsize))
 
+    def _column_views(self, bufs: List) -> List[np.ndarray]:
+        """Chunk buffers (logical order) -> the bitmatrix's input
+        columns: column i*w + c is packet c of chunk i, each a
+        zero-copy (blocks, packetsize) view over the caller's
+        buffer."""
+        cols: List[np.ndarray] = []
+        for buf in bufs:
+            cols.extend(bmx.packet_views(buf, self.w, self.packetsize))
+        return cols
+
+    def _run(self, rows: np.ndarray, sched_sig: str,
+             src_bufs: List, dst_bufs: List) -> None:
+        """Execute `rows` over the source chunks into the destination
+        chunks: the compiled XOR schedule over packet views by
+        default, the naive row-walk under the kill switch (the
+        bit-exactness oracle) or when the matrix is too dense to
+        compile on the serving path (host_compile_allowed — cached
+        schedules aside, the pure-Python CSE must not stall the
+        event loop on a pathological geometry)."""
+        if xsched.enabled() and xsched.host_compile_allowed(rows):
+            sched = xsched.compile_matrix(rows, sig=sched_sig)
+            outs = self._column_views(dst_bufs)
+            xsched.execute_host(sched, self._column_views(src_bufs),
+                                outs)
+            return
+        data = np.stack([np.frombuffer(b, dtype=np.uint8)
+                         for b in src_bufs])
+        out = self._unpackets(
+            xsched.naive_xor_matmul(rows, self._packets(data)),
+            len(dst_bufs))
+        for j, dst in enumerate(dst_bufs):
+            dst[:] = out[j].data
+
     # -- interface kernels -------------------------------------------------
 
     def encode_chunks(self, want_to_encode: Set[int],
                       encoded: Dict[int, bytearray]) -> None:
         # buffers are keyed by on-disk POSITION (chunk_index); the
-        # bitmatrix math lives in logical chunk space.  frombuffer
-        # reads in place and rows land back as buffer views (the
-        # bytes()/tobytes() round trip was two extra whole-chunk
-        # copies per encode)
-        data = np.stack([
-            np.frombuffer(encoded[self.chunk_index(i)],
-                          dtype=np.uint8)
-            for i in range(self.k)])
-        packets = self._packets(data)
-        coding = self._xor_matmul(self.bitmatrix, packets)
-        out = np.ascontiguousarray(self._unpackets(coding, self.m))
-        for j in range(self.m):
-            encoded[self.chunk_index(self.k + j)][:] = out[j].data
+        # bitmatrix math lives in logical chunk space.  Packet views
+        # read the data bytearrays in place and coding packets are
+        # written straight into the output bytearrays' views — the
+        # schedule path stacks/copies nothing
+        self._run(self.bitmatrix, self._sig,
+                  [encoded[self.chunk_index(i)] for i in range(self.k)],
+                  [encoded[self.chunk_index(self.k + j)]
+                   for j in range(self.m)])
 
     def decode_chunks(self, want_to_read: Set[int],
                       chunks: Mapping[int, bytes],
@@ -155,16 +184,15 @@ class ErasureCodeJaxBitmatrix(ErasureCode):
                      if self.chunk_index(i) in chunks)[:self.k]
         if len(have) < self.k:
             raise ErasureCodeError(5, "not enough chunks to decode")
-        rows = self._decode_cache.get_or_compute(
-            (have, erasures),
+        # the inverted submatrix is shared PROCESS-wide by codec
+        # signature (ec/dispatch.py): a remounted pool's fresh codec
+        # instance reuses this instance's inversions, and the decode
+        # schedule below is memoized under the same key discipline
+        key = (self._sig, have, erasures)
+        rows = dispatch.shared_decode_rows(
+            key,
             lambda: bmx.decode_bitmatrix(self.bitmatrix, self.k,
                                          self.w, have, erasures))
-        survivors = np.stack([
-            np.frombuffer(decoded[self.chunk_index(i)],
-                          dtype=np.uint8)
-            for i in have])
-        packets = self._packets(survivors)
-        rec = self._xor_matmul(rows, packets)
-        out = np.ascontiguousarray(self._unpackets(rec, len(erasures)))
-        for row, e in enumerate(erasures):
-            decoded[self.chunk_index(e)][:] = out[row].data
+        self._run(rows, f"{self._sig}/d{have}/{erasures}",
+                  [decoded[self.chunk_index(i)] for i in have],
+                  [decoded[self.chunk_index(e)] for e in erasures])

@@ -7,6 +7,7 @@ signature (the ErasureCodeIsaTableCache role).
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Callable, Hashable, Optional
 
@@ -79,9 +80,12 @@ def _device_matmul(mat: np.ndarray, data: np.ndarray,
     if status == "ok" and out is not None:
         return out
     if status == "oom" and batch > 1:
-        h = batch // 2
-        first = _device_matmul(mat, data[:h], sig, use_plan, family)
-        second = _device_matmul(mat, data[h:], sig, use_plan, family)
+        # np.split hands back views of the same stripes (no byte
+        # moves); each half re-dispatches under its own guard
+        first_half, second_half = np.split(data, [batch // 2])
+        first = _device_matmul(mat, first_half, sig, use_plan, family)
+        second = _device_matmul(mat, second_half, sig, use_plan,
+                                family)
         if first is not None and second is not None:
             return np.concatenate([first, second], axis=0)
         return None
@@ -143,3 +147,46 @@ class LruCache:
         value = compute()
         self.put(key, value)
         return value
+
+
+# ---------------------------------------------------------------------------
+# Shared decode-rows cache
+# ---------------------------------------------------------------------------
+
+# Inverted decode submatrices keyed by (codec signature, survivors,
+# erasures) — PROCESS-wide, not per codec instance: pool remounts and
+# registry re-resolution build fresh codec objects for identical
+# profiles, and a per-instance cache made each of them re-run the
+# GF(2) Gaussian elimination for every erasure pattern it had already
+# seen.  The signature (xsched.matrix_signature over the generator +
+# geometry) makes identical profiles collide on purpose and distinct
+# ones never.
+_decode_rows = LruCache(cap=512)
+_decode_rows_stats = {"hits": 0, "misses": 0}
+# decode runs on asyncio.to_thread executor threads (the encode
+# service's off-loop workers) as well as the event loop: peek()'s
+# get-then-move_to_end is not atomic under concurrent eviction, so
+# the process-wide cache takes a lock (the inversion itself runs
+# OUTSIDE it — Gaussian elimination can take milliseconds)
+_decode_rows_lock = threading.Lock()
+
+
+def shared_decode_rows(key: Hashable, compute: Callable):
+    """Fetch (or invert-and-cache) decode rows for one (codec sig,
+    erasure pattern); counters feed decode_rows_stats() so the
+    cross-instance reuse is observable."""
+    with _decode_rows_lock:
+        hit = _decode_rows.peek(key, LruCache._MISS)
+        if hit is not LruCache._MISS:
+            _decode_rows_stats["hits"] += 1
+            return hit
+        _decode_rows_stats["misses"] += 1
+    value = compute()
+    with _decode_rows_lock:
+        _decode_rows.put(key, value)
+    return value
+
+
+def decode_rows_stats() -> dict:
+    with _decode_rows_lock:
+        return {**_decode_rows_stats, "entries": len(_decode_rows)}

@@ -58,7 +58,6 @@ the `jit-bypass-plan` static-analysis rule; route new compiles through
 
 from __future__ import annotations
 
-import hashlib
 import os
 import threading
 import time
@@ -67,7 +66,9 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ceph_tpu.common import circuit, tracing
+from ceph_tpu.ec import xsched
 from ceph_tpu.ec.dispatch import LruCache
+from ceph_tpu.ec.xsched import matrix_signature
 from ceph_tpu.ops import checksum as cks
 from ceph_tpu.ops import gf
 
@@ -86,6 +87,7 @@ __all__ = [
     "matrix_signature", "mesh_enabled", "mesh_dispatches",
     "mesh_info", "plan_key", "quarantine_info", "reset_stats",
     "set_enabled", "stats", "StripeCoalescer", "tracked_jit",
+    "xor_sched_direct",
 ]
 
 # ---------------------------------------------------------------------------
@@ -145,6 +147,11 @@ def stats() -> dict:
     # mesh policy + live healthy set (outside the lock: mesh_info
     # takes it itself)
     out["mesh"] = mesh_info()
+    # the codec-compiler section (ec/xsched.py): schedules compiled,
+    # memo hits, xors_naive vs xors_scheduled.  Its cache is keyed by
+    # matrix signature, NOT plan key — plan rebuilds (mesh shrink,
+    # quarantine, clear) never cost a recompilation
+    out["xsched"] = xsched.stats()
     return out
 
 
@@ -238,15 +245,9 @@ def bucket_batch(b: int) -> int:
 # ---------------------------------------------------------------------------
 
 
-def matrix_signature(matrix: np.ndarray, extra: str = "") -> str:
-    """Process-stable identity of a generator/decode matrix."""
-    m = np.ascontiguousarray(matrix, dtype=np.uint8)
-    h = hashlib.sha256()
-    h.update(repr(m.shape).encode())
-    h.update(m.data)    # the hash reads the buffer in place
-    if extra:
-        h.update(extra.encode())
-    return h.hexdigest()[:16]
+# matrix_signature is defined in ec/xsched.py (re-exported here
+# unchanged): compiled XOR schedules and ExecPlans share ONE sha256
+# identity per matrix, so a codec's signature keys both caches.
 
 
 def codec_signature(technique: str, k: int, m: int, w: int,
@@ -470,10 +471,12 @@ def _donation_usable() -> bool:
 def _mbits_for(matrix: np.ndarray):
     # keyed by matrix CONTENT, never by the caller's sig: a sig only
     # buys cache locality, correctness must not depend on callers
-    # keeping it matrix-unique
+    # keeping it matrix-unique.  matrix_signature hashes the buffer
+    # in place — the old (shape, tobytes()) key materialized a copy
+    # of the matrix on every encode dispatch
     m = np.ascontiguousarray(matrix, dtype=np.uint8)
     return _mbits_cache.get_or_compute(
-        (m.shape, m.tobytes()),
+        matrix_signature(m),
         lambda: jnp.asarray(gf.gf_matrix_to_bits(m)))
 
 
@@ -801,6 +804,119 @@ def mesh_info() -> dict:
 # ---------------------------------------------------------------------------
 
 
+# pick caches: matrix signature -> XorSchedule | ("dense", naive),
+# and schedule sig -> tracked jit.  Reached concurrently from the
+# event loop AND the encode service's to_thread workers, so every
+# access takes the lock (LruCache.peek's get-then-move_to_end is not
+# atomic under eviction); compiles/jits happen OUTSIDE it — a racing
+# pair builds twice, last write wins, both results identical
+_sched_lock = threading.Lock()
+_sched_pick = LruCache(cap=64)
+_direct_jits = LruCache(cap=32)
+
+
+def _sched_for(matrix: np.ndarray):
+    """The compiled XOR schedule of a GF(2^8) matrix's bit expansion,
+    memoized by matrix signature, or None when the kill switch is
+    off / the matrix is too dense to ever clear the op-count pick.
+    The density pre-bound matters: Paar CSE is quadratic-ish in the
+    ones count, and a wide-k expansion whose BEST case still exceeds
+    the unroll ceiling must not pay a multi-second compile on its
+    first dispatch just to be rejected.  The cache stores the
+    schedule (or the naive count for too-dense matrices) rather than
+    the verdict, so the policy knobs — `xsched.prefer_schedule` AND
+    the density bound below — are re-judged per call and stay live."""
+    if not xsched.enabled():
+        return None
+    m = np.ascontiguousarray(matrix, dtype=np.uint8)
+    msig = matrix_signature(m)
+    with _sched_lock:
+        sched = _sched_pick.peek(msig)
+    if sched is None:
+        bits = gf.gf_matrix_to_bits(m)
+        naive = int(bits.sum()) - bits.shape[0]
+        if naive // 4 > xsched._max_ops():
+            # even a 75% CSE cut (past the best the literature
+            # reports) could not fit the unroll ceiling: remember
+            # the COUNT, not the verdict, and skip the compile
+            sched = ("dense", naive)
+        else:
+            sched = xsched.compile_matrix(bits, sig=f"{msig}/bits")
+        with _sched_lock:
+            _sched_pick.put(msig, sched)
+    if isinstance(sched, tuple):        # ("dense", naive): re-judge
+        if sched[1] // 4 > xsched._max_ops():
+            return None
+        with _sched_lock:               # the ceiling was raised:
+            _sched_pick.pop(msig)       # compile on the next call
+        return _sched_for(m)
+    return sched
+
+
+def _sched_impl(sched):
+    """The device lowering of one XOR schedule: the SAME GF(2) math
+    as _gf2_matmul_bytes_impl (unpack bit planes, combine, pack) but
+    combined by the compiled XOR program instead of one dense
+    matmul — xors_scheduled region XORs instead of an (8R x 8K)
+    contraction.  Profitable exactly when xsched.prefer_schedule
+    says so (sparse bitmatrix-family expansions)."""
+    n_in = sched.n_in
+
+    def impl(data):
+        bits = gf._unpack_bits(data)          # (..., 8K, S) 0/1
+        tmp = [None] * sched.n_slots
+
+        def ref(r):
+            return bits[..., r, :] if r < n_in else tmp[r - n_in]
+
+        for dst, a, b in sched.ops:
+            tmp[dst] = jnp.bitwise_xor(ref(a), ref(b))
+        rows = []
+        for refs in sched.outputs:
+            if not refs:
+                rows.append(jnp.zeros_like(bits[..., 0, :]))
+                continue
+            acc = ref(refs[0])
+            for r in refs[1:]:
+                acc = jnp.bitwise_xor(acc, ref(r))
+            rows.append(acc)
+        return gf._pack_bits(jnp.stack(rows, axis=-2))
+
+    return impl
+
+
+def _build_xor_sched(key: tuple, sched) -> ExecPlan:
+    """The `xor_sched` plan kind: the schedule lowering jitted per
+    bucketed shape, riding the same guard/quarantine/OOM discipline
+    as every other plan.  The schedule is baked into the trace (its
+    signature IS the key prefix), so unlike the matmul kind there is
+    no runtime matrix operand."""
+    jfn = tracked_jit(_label(key), _sched_impl(sched))
+    return ExecPlan(key, jfn, "xla_xor_sched")
+
+
+def xor_sched_direct(matrix: np.ndarray):
+    """Schedule-vs-matmul pick for direct (non-plan-cached)
+    ops/gf.gf_matmul_device consumers: the jitted shape-polymorphic
+    schedule executor when the measured op count prefers it, else
+    None (caller keeps the dense bit-matmul).  Jits are memoized per
+    schedule signature and tracked, so retraces stay visible in
+    plan.stats()."""
+    if not HAVE_JAX:
+        return None
+    sched = _sched_for(np.asarray(matrix, dtype=np.uint8))
+    if sched is None or not xsched.prefer_schedule(sched):
+        return None
+    with _sched_lock:
+        fn = _direct_jits.peek(sched.sig)
+    if fn is None:
+        fn = tracked_jit(f"xor_sched_direct[{sched.sig}]",
+                         _sched_impl(sched))
+        with _sched_lock:
+            _direct_jits.put(sched.sig, fn)
+    return fn
+
+
 def _build_local_encode(key: tuple, donate: bool) -> ExecPlan:
     """Single-dispatch XLA bit-matmul plan; the bit matrix rides as a
     runtime operand so same-geometry matrices share the compile."""
@@ -933,6 +1049,20 @@ def encode(matrix: np.ndarray, data: np.ndarray, sig: str = None,
         return None
     rows = int(np.asarray(matrix).shape[0])
     sig = sig or matrix_signature(matrix)
+
+    def halve() -> Optional[np.ndarray]:
+        # OOM halving: each half re-buckets onto a smaller plan; GF
+        # parity is per-stripe independent, so the split is bit-exact
+        h = b // 2
+        first = encode(matrix, arr[:h], sig=sig, donate=donate,
+                       family=family)
+        second = encode(matrix, arr[h:], sig=sig, donate=donate,
+                        family=family)
+        if first is None or second is None:
+            return None
+        out = np.concatenate([first, second], axis=0)
+        return out[0] if squeeze else out
+
     if host_input:
         # mesh attempt first: big-enough host batches shard over the
         # healthy chips (device-resident inputs follow the caller's
@@ -943,15 +1073,30 @@ def encode(matrix: np.ndarray, data: np.ndarray, sig: str = None,
             out = np.asarray(mout)[:b, :, :s]
             return out[0] if squeeze else out
         if mstatus == "oom" and b > 1:
-            h = b // 2
-            first = encode(matrix, arr[:h], sig=sig, donate=donate,
-                           family=family)
-            second = encode(matrix, arr[h:], sig=sig, donate=donate,
-                            family=family)
-            if first is None or second is None:
-                return None
-            out = np.concatenate([first, second], axis=0)
-            return out[0] if squeeze else out
+            return halve()
+    # schedule-vs-matmul pick (the xor_sched plan kind): a sparse
+    # bitmatrix-family expansion whose compiled XOR program beats the
+    # dense bit-matmul by measured op count dispatches the program
+    # instead.  The picked kind OWNS the dispatch — a failed or
+    # quarantined xor_sched plan degrades to the bit-exact HOST path
+    # (one plan key per call, exactly like the matmul kind), never to
+    # a second compiled plan
+    sched = _sched_for(np.asarray(matrix, dtype=np.uint8)) \
+        if host_input else None
+    if sched is not None and xsched.prefer_schedule(sched):
+        skey = plan_key(sched.sig, "xor_sched", rows, k, b, s)
+        if _quarantined(skey):
+            return None
+        splan = _get_plan(
+            skey, lambda: _build_xor_sched(skey, sched))
+        padded = jnp.asarray(_pad_batch(arr, skey[4], skey[5]))
+        status, out = _guarded(family, skey, splan, (padded,), b)
+        if status == "oom" and b > 1:
+            return halve()
+        if status != "ok":
+            return None
+        out = np.asarray(out)[:b, :, :s]
+        return out[0] if squeeze else out
     eff_donate = bool(_donation_usable()
                       and (donate or (donate is None and host_input)))
     key = plan_key(sig, "encode", rows, k, b, s, donate=eff_donate)
@@ -970,17 +1115,7 @@ def encode(matrix: np.ndarray, data: np.ndarray, sig: str = None,
     status, out = _guarded(family, key, plan,
                            (_mbits_for(matrix), padded), b)
     if status == "oom" and b > 1:
-        # OOM halving: each half re-buckets onto a smaller plan; GF
-        # parity is per-stripe independent, so the split is bit-exact
-        h = b // 2
-        first = encode(matrix, arr[:h], sig=sig, donate=donate,
-                       family=family)
-        second = encode(matrix, arr[h:], sig=sig, donate=donate,
-                        family=family)
-        if first is None or second is None:
-            return None
-        out = np.concatenate([first, second], axis=0)
-        return out[0] if squeeze else out
+        return halve()
     if status != "ok":
         return None
     out = np.asarray(out)[:b, :, :s]

@@ -1,0 +1,417 @@
+"""Plan-time XOR-schedule compiler for the GF(2) bitmatrix family.
+
+The XOR-EC program-optimization literature (arXiv:2108.02692) treats
+an erasure code's bit matrix as a PROGRAM, not an operand: every
+output row is an XOR of input columns, shared sub-XORs can be
+computed once (common-subexpression elimination), the resulting ops
+can be scheduled for temporary locality, and the whole compiled
+artifact memoized — cutting the XOR count 30-60% before a single
+byte moves.  This module is that compiler:
+
+* **CSE pass** — greedy pairwise extraction (Paar's algorithm):
+  repeatedly find the column pair shared by the most output rows,
+  hoist it into a temporary, and substitute.  Each extraction with
+  multiplicity c saves c-1 XOR region ops.
+* **Scheduling pass** — temporaries are emitted in dependency-DFS
+  order from the outputs (producers land next to their consumers),
+  then a linear-scan allocator maps them onto a bounded set of
+  reusable buffer slots: `n_slots` — the live-temporary bound — is
+  what the executor must allocate, not the temp count.
+* **Memoization** — compiled schedules are cached in a bounded LRU
+  keyed by the same sha256 matrix/codec signature the ExecPlan cache
+  uses (`matrix_signature` lives HERE and ec/plan.py re-exports it);
+  decode schedules key per erasure-pattern submatrix content, so a
+  re-instantiated codec or a rebuilt plan (mesh shrink, quarantine)
+  never recompiles a known matrix.
+
+Two executors lower a schedule:
+
+* the HOST tier (`execute_host`) runs the program over numpy buffer
+  views — the bitmatrix trio's packet regions (models/bitmatrix
+  `packet_views`) execute in place with zero stacking/transpose
+  copies; and
+* the DEVICE tier lives in ec/plan.py as the `xor_sched` plan kind
+  (the same program over bit planes, traced next to the
+  `_gf2_matmul_bytes_impl` matmul lowering) — consumers pick
+  schedule-vs-matmul by the measured op count (`prefer_schedule`).
+
+Kill switch: CEPH_TPU_XSCHED=0 pins every caller to the naive
+row-walk (`naive_xor_matmul`, bit-identical output).  Stats land in
+`plan.stats()["xsched"]` — schedules compiled, cache hits,
+xors_naive vs xors_scheduled.
+
+This module must stay importable without jax (the host tier is pure
+numpy) and must not import ec/plan.py (plan imports us).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ceph_tpu.ec.dispatch import LruCache
+
+__all__ = [
+    "XorSchedule", "compile_matrix", "enabled", "execute_host",
+    "matrix_signature", "naive_xor_matmul", "prefer_schedule",
+    "reset_stats", "stats",
+]
+
+
+def enabled() -> bool:
+    """Schedule-execution kill switch (CEPH_TPU_XSCHED=0 keeps every
+    consumer on the naive row-walk — bit-identical output)."""
+    return os.environ.get("CEPH_TPU_XSCHED", "1") != "0"
+
+
+def _max_ops() -> int:
+    """Op-count ceiling for preferring a schedule on the DEVICE tier:
+    past this, the unrolled XOR program stops beating one dense MXU
+    matmul dispatch (and the traced graph stops being small)."""
+    try:
+        return int(os.environ.get("CEPH_TPU_XSCHED_MAX_OPS", "256"))
+    except ValueError:
+        return 256
+
+
+def _min_reduction() -> float:
+    """Minimum fractional XOR-count saving before a schedule is worth
+    switching lowering for (the measured-op-count pick)."""
+    try:
+        return float(os.environ.get("CEPH_TPU_XSCHED_MIN_REDUCTION",
+                                    "0.25"))
+    except ValueError:
+        return 0.25
+
+
+def _host_max_ones() -> int:
+    """Ones-count ceiling for compiling a matrix on the HOST serving
+    path: the greedy CSE is pure-Python and quadratic-ish in the
+    ones count, and the bitmatrix codecs compile inline (event loop
+    / to_thread worker) on first use of each erasure pattern.  The
+    default admits the whole legal RAID-6 trio space (worst case,
+    liberation k=13 w=13 decode, is ~1.8k ones / ~0.6 s once) while
+    refusing pathological hand-rolled geometries that would stall
+    the daemon for minutes."""
+    try:
+        return int(os.environ.get("CEPH_TPU_XSCHED_HOST_MAX_ONES",
+                                  "4096"))
+    except ValueError:
+        return 4096
+
+
+def host_compile_allowed(matrix: np.ndarray) -> bool:
+    """True when `matrix` is small enough to compile on the serving
+    path (callers above the bound take the naive row-walk)."""
+    return int(np.count_nonzero(matrix)) <= _host_max_ones()
+
+
+# ---------------------------------------------------------------------------
+# Signatures (the sha256 identity the plan cache shares)
+# ---------------------------------------------------------------------------
+
+
+def matrix_signature(matrix: np.ndarray, extra: str = "") -> str:
+    """Process-stable identity of a generator/decode matrix: sha256
+    over shape + buffer (read in place — no tobytes copy) + an
+    optional discriminator.  ec/plan.py re-exports this as the
+    ExecPlan key prefix; schedules and plans share one identity."""
+    m = np.ascontiguousarray(matrix, dtype=np.uint8)
+    h = hashlib.sha256()
+    h.update(repr(m.shape).encode())
+    h.update(m.data)
+    if extra:
+        h.update(extra.encode())
+    return h.hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# The compiled artifact
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class XorSchedule:
+    """One compiled XOR program.
+
+    References are ints: ``ref < n_in`` names input column ``ref``;
+    ``ref >= n_in`` names temporary slot ``ref - n_in``.  ``ops`` are
+    executed in order — ``(dst_slot, a, b)`` meaning
+    ``tmp[dst_slot] = ref(a) ^ ref(b)`` (slots are REUSED once their
+    last reader has run; the order is load-bearing).  ``outputs[r]``
+    lists the refs whose XOR is output row r (len 1 = copy, len 0 =
+    zero fill)."""
+
+    sig: str
+    n_in: int
+    n_out: int
+    n_slots: int
+    ops: Tuple[Tuple[int, int, int], ...]
+    outputs: Tuple[Tuple[int, ...], ...]
+    xors_naive: int
+    xors_scheduled: int
+
+    @property
+    def reduction_pct(self) -> float:
+        if self.xors_naive <= 0:
+            return 0.0
+        return 100.0 * (1.0 - self.xors_scheduled / self.xors_naive)
+
+
+def prefer_schedule(sched: XorSchedule) -> bool:
+    """The schedule-vs-matmul pick for device lowerings, by measured
+    op count: a schedule wins when it is small enough to unroll AND
+    saves at least the configured fraction of the naive XOR count.
+    Sparse bitmatrix programs qualify; dense GF(2^8) bit expansions
+    (e.g. reed_sol k8m3: hundreds of surviving ops) keep the MXU
+    matmul."""
+    if not enabled() or sched.xors_naive <= 0:
+        return False
+    if sched.xors_scheduled > _max_ops():
+        return False
+    return sched.xors_scheduled <= \
+        (1.0 - _min_reduction()) * sched.xors_naive
+
+
+# ---------------------------------------------------------------------------
+# Compilation: Paar CSE + scheduling + slot allocation
+# ---------------------------------------------------------------------------
+
+
+def _paar(rows: List[set], n_in: int) -> List[Tuple[int, int]]:
+    """Greedy pairwise CSE: extract the (ref, ref) pair shared by the
+    most rows into a new temporary until no pair repeats.  Returns
+    the temp definitions; ``rows`` is rewritten in place to reference
+    them.  Deterministic: ties break to the lexicographically
+    smallest pair."""
+    temps: List[Tuple[int, int]] = []
+    next_ref = n_in
+    while True:
+        counts: Dict[Tuple[int, int], int] = {}
+        for row in rows:
+            if len(row) < 2:
+                continue
+            srow = sorted(row)
+            for i in range(len(srow)):
+                for j in range(i + 1, len(srow)):
+                    p = (srow[i], srow[j])
+                    counts[p] = counts.get(p, 0) + 1
+        if not counts:
+            break
+        best = max(counts.values())
+        if best < 2:
+            break
+        a, b = min(p for p, c in counts.items() if c == best)
+        temps.append((a, b))
+        t = next_ref
+        next_ref += 1
+        for row in rows:
+            if a in row and b in row:
+                row.discard(a)
+                row.discard(b)
+                row.add(t)
+    return temps
+
+
+def _schedule(temps: List[Tuple[int, int]], rows: List[set],
+              n_in: int) -> Tuple[int, tuple, tuple]:
+    """The scheduling pass: dependency-DFS emission order from the
+    outputs (locality — a temp is computed just before its consumers
+    need it, dead temps drop out), then linear-scan slot allocation
+    so the executor's live-temporary footprint is ``n_slots``, not
+    ``len(temps)``.  Returns (n_slots, ops, outputs) in slot-space
+    references."""
+    order: List[int] = []
+    seen: set = set()
+    for row in rows:
+        for want in sorted(row):
+            if want < n_in:
+                continue
+            stack = [want]
+            while stack:
+                ref = stack[-1]
+                if ref in seen or ref < n_in:
+                    stack.pop()
+                    continue
+                deps = [s for s in temps[ref - n_in]
+                        if s >= n_in and s not in seen]
+                if deps:
+                    stack.extend(deps)
+                    continue
+                seen.add(ref)
+                order.append(ref)
+                stack.pop()
+    t_count = len(order)
+    # last use of each temp on the (temps..., then outputs...) timeline
+    last: Dict[int, int] = {}
+    for t, ref in enumerate(order):
+        for s in temps[ref - n_in]:
+            if s >= n_in:
+                last[s] = t
+    for r, row in enumerate(rows):
+        for s in row:
+            if s >= n_in:
+                last[s] = t_count + r
+    by_time: Dict[int, List[int]] = {}
+    for ref, t in last.items():
+        by_time.setdefault(t, []).append(ref)
+    free: List[int] = []
+    slot_of: Dict[int, int] = {}
+    n_slots = 0
+    ops: List[Tuple[int, int, int]] = []
+
+    def resolve(s: int) -> int:
+        return s if s < n_in else n_in + slot_of[s]
+
+    for t, ref in enumerate(order):
+        a, b = temps[ref - n_in]
+        ra, rb = resolve(a), resolve(b)
+        # a temp last READ here may donate its slot as this op's dst:
+        # XOR with out= aliasing an operand exactly is well-defined
+        for dead in sorted(by_time.get(t, ())):
+            free.append(slot_of[dead])
+        if free:
+            dst = free.pop()
+        else:
+            dst = n_slots
+            n_slots += 1
+        slot_of[ref] = dst
+        ops.append((dst, ra, rb))
+    outputs = tuple(tuple(sorted(resolve(s) for s in row))
+                    for row in rows)
+    return n_slots, tuple(ops), outputs
+
+
+def _compile(bm: np.ndarray, sig: str) -> XorSchedule:
+    n_out, n_in = bm.shape
+    rows = [set(np.flatnonzero(bm[r]).tolist()) for r in range(n_out)]
+    xors_naive = sum(max(len(row) - 1, 0) for row in rows)
+    temps = _paar(rows, n_in)
+    n_slots, ops, outputs = _schedule(temps, rows, n_in)
+    xors_scheduled = len(ops) + sum(max(len(row) - 1, 0)
+                                    for row in outputs)
+    return XorSchedule(sig=sig, n_in=n_in, n_out=n_out,
+                       n_slots=n_slots, ops=ops, outputs=outputs,
+                       xors_naive=xors_naive,
+                       xors_scheduled=xors_scheduled)
+
+
+# ---------------------------------------------------------------------------
+# Memoization + stats
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_cache = LruCache(cap=256)
+_counters: Dict[str, int] = {"compiled": 0, "cache_hits": 0,
+                             "xors_naive": 0, "xors_scheduled": 0}
+
+
+def compile_matrix(bm: np.ndarray,
+                   sig: Optional[str] = None) -> XorSchedule:
+    """Compile (or fetch) the XOR schedule of a (R, C) GF(2) 0/1
+    matrix.  ``sig`` lets callers that already hold the matrix's
+    sha256 identity (plan.codec_signature / matrix_signature) skip
+    the rehash — it MUST be matrix-unique; omitted, the content
+    signature is computed here.  Schedules survive plan rebuilds:
+    this cache is keyed by matrix identity, not by device set or
+    bucketed shape, and ec/plan.py's clear()/quarantine never touch
+    it."""
+    bm = np.ascontiguousarray(bm, dtype=np.uint8)
+    key = sig or matrix_signature(bm)
+    with _lock:
+        hit = _cache.peek(key)
+        if hit is not None:
+            _counters["cache_hits"] += 1
+            return hit
+    sched = _compile(bm, key)
+    with _lock:
+        again = _cache.peek(key)
+        if again is not None:       # racing compile: first one wins
+            _counters["cache_hits"] += 1
+            return again
+        _cache.put(key, sched)
+        _counters["compiled"] += 1
+        _counters["xors_naive"] += sched.xors_naive
+        _counters["xors_scheduled"] += sched.xors_scheduled
+    return sched
+
+
+def stats() -> dict:
+    """The `xsched` observability section plan.stats() embeds."""
+    with _lock:
+        out = dict(_counters)
+        out["cached"] = len(_cache)
+    out["enabled"] = enabled()
+    return out
+
+
+def reset_stats() -> None:
+    with _lock:
+        for k in _counters:
+            _counters[k] = 0
+
+
+def clear() -> None:
+    """Drop memoized schedules (tests only — production relies on
+    survival across plan rebuilds)."""
+    with _lock:
+        _cache.clear()
+
+
+# ---------------------------------------------------------------------------
+# Executors
+# ---------------------------------------------------------------------------
+
+
+def execute_host(sched: XorSchedule, sources: Sequence[np.ndarray],
+                 outs: Sequence[np.ndarray]) -> None:
+    """Run the XOR program over numpy regions, in place.
+
+    ``sources[c]`` is input column c — any same-shape uint8 views
+    (the bitmatrix packet views; strided is fine).  ``outs[r]`` is
+    the writable destination for output row r.  Outputs must not
+    alias sources (the codec layers write parity/recovered chunks,
+    never their inputs).  Temporaries are ``n_slots`` scratch
+    buffers allocated here per call."""
+    n_in = sched.n_in
+    tmp: List[Optional[np.ndarray]] = [None] * sched.n_slots
+
+    def ref(r: int) -> np.ndarray:
+        return sources[r] if r < n_in else tmp[r - n_in]
+
+    for dst, a, b in sched.ops:
+        if tmp[dst] is None:
+            tmp[dst] = np.bitwise_xor(ref(a), ref(b))
+        else:
+            np.bitwise_xor(ref(a), ref(b), out=tmp[dst])
+    for refs, out in zip(sched.outputs, outs):
+        if not refs:
+            out[...] = 0
+        elif len(refs) == 1:
+            out[...] = ref(refs[0])
+        else:
+            np.bitwise_xor(ref(refs[0]), ref(refs[1]), out=out)
+            for r in refs[2:]:
+                np.bitwise_xor(out, ref(r), out=out)
+
+
+def naive_xor_matmul(rows: np.ndarray,
+                     packets: np.ndarray) -> np.ndarray:
+    """(R, C) 0/1 x (B, C, ps) byte packets -> (B, R, ps) XORs — the
+    unscheduled row-walk.  This is the kill-switch fallback and the
+    independent bit-exactness oracle for every schedule; the
+    `unscheduled-bitmatrix-xor` lint rule pins naive walks like this
+    to ec/xsched.py + ec/plan.py."""
+    b, _c, ps = packets.shape
+    out = np.zeros((b, rows.shape[0], ps), dtype=np.uint8)
+    for r in range(rows.shape[0]):
+        idx = np.flatnonzero(rows[r])
+        if idx.size:
+            out[:, r] = np.bitwise_xor.reduce(packets[:, idx, :],
+                                              axis=1)
+    return out

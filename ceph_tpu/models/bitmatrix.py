@@ -112,6 +112,32 @@ def liber8tion_bitmatrix(k: int) -> np.ndarray:
     return _ladder_bitmatrix(_companion_gf256(), k)
 
 
+def packet_views(buf, w: int, packetsize: int) -> list:
+    """One chunk's buffer -> its w per-packet (blocks, packetsize)
+    numpy views, zero-copy.
+
+    The jerasure packet convention this module's matrices index:
+    a chunk of b*w*packetsize bytes is b repeats of w packets;
+    bitmatrix column i*w + c selects packet c of chunk i across every
+    block.  ``packet_views(chunk_i, w, ps)[c]`` IS that column — a
+    strided view over the caller's buffer (bytearray, memoryview or
+    ndarray; writable buffers yield writable views, so coding/
+    recovered chunks are written in place).  The XOR-schedule host
+    tier (ec/xsched.execute_host) runs directly over these views —
+    no stack, no transpose, no copies."""
+    if isinstance(buf, np.ndarray):
+        # a non-contiguous array would make reshape COPY — writes
+        # into the views would land in the throwaway copy, not the
+        # caller's buffer.  Refuse loudly rather than corrupt parity.
+        assert buf.flags.c_contiguous, \
+            "packet_views needs a contiguous buffer"
+        arr = buf.reshape(-1)
+    else:
+        arr = np.frombuffer(buf, dtype=np.uint8)
+    arr = arr.reshape(-1, w, packetsize)
+    return [arr[:, c, :] for c in range(w)]
+
+
 def gf2_inv(mat: np.ndarray) -> np.ndarray:
     """Invert a square 0/1 matrix over GF(2) (Gaussian elimination)."""
     n = mat.shape[0]

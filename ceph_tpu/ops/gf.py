@@ -315,14 +315,22 @@ if HAVE_JAX:
         """(R,K) GF(2^8) matrix x (..., K, S) uint8 through the fastest
         device path: the packed-word xtime Pallas kernel on TPU for
         host-side (numpy) inputs (ops/gf_pallas.py — word-layout entry,
-        ~360 GiB/s on a v5e), the XLA bit-decomposition for device-
-        resident uint8 arrays and non-TPU backends (a device-side
-        uint8->int32 relayout would cost more than the encode)."""
+        ~360 GiB/s on a v5e), then schedule-vs-matmul by measured op
+        count — a sparse bit expansion whose compiled XOR schedule
+        (ec/xsched.py) beats the dense contraction runs as the XOR
+        program (ec/plan.xor_sched_direct), everything else as the XLA
+        bit-decomposition matmul (a device-side uint8->int32 relayout
+        would cost more than the encode)."""
         from ceph_tpu.ops import gf_pallas
 
         if isinstance(data, np.ndarray) and gf_pallas.supported(
                 np.shape(data)):
             return gf_pallas.gf_matmul_pallas(m, data)
+        from ceph_tpu.ec import plan  # lazy: plan imports this module
+
+        jfn = plan.xor_sched_direct(m)
+        if jfn is not None:
+            return jfn(jnp.asarray(data, dtype=jnp.uint8))
         mbits = jnp.asarray(gf_matrix_to_bits(m))
         return gf2_matmul_bytes(mbits, jnp.asarray(data, dtype=jnp.uint8))
 

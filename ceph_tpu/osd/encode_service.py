@@ -182,9 +182,13 @@ class EncodeService:
         if q is None or not self._admit(q, len(data)):
             self.counters["inline" if q is None else "shed"] += 1
             # intentionally-inline degraded path (kill switch, no
-            # device tier, or backpressure shed): today's behavior
-            return ec_util.encode_with_hinfo(sinfo, codec, data, want,
-                                             logical_len=logical_len)
+            # device tier, or backpressure shed): today's behavior.
+            # The span names the stage — inline codec work must be
+            # attributable in the histograms (the xsched bench cites
+            # it), not folded invisibly into osd_op self-time
+            with tracing.child_span_sync("encode_inline"):
+                return ec_util.encode_with_hinfo(
+                    sinfo, codec, data, want, logical_len=logical_len)
         return await self._enqueue(q, (data, want, logical_len),
                                    len(data))
 
@@ -197,7 +201,8 @@ class EncodeService:
         q = self._bucket_for("encode", sinfo, codec)
         if q is None or not self._admit(q, len(data)):
             self.counters["inline" if q is None else "shed"] += 1
-            return ec_util.encode(sinfo, codec, _buf(data), want)
+            with tracing.child_span_sync("encode_inline"):
+                return ec_util.encode(sinfo, codec, _buf(data), want)
         return await self._enqueue(q, (data, want), len(data))
 
     async def decode(self, sinfo, codec, to_decode) -> bytes:
@@ -215,7 +220,8 @@ class EncodeService:
                                                    codec)
         if q is None or not self._admit(q, nbytes):
             self.counters["inline" if q is None else "shed"] += 1
-            return ec_util.decode(sinfo, codec, to_decode)
+            with tracing.child_span_sync("decode_inline"):
+                return ec_util.decode(sinfo, codec, to_decode)
         return await self._enqueue(q, dict(to_decode), nbytes)
 
     async def decode_many(self, sinfo, codec, maps) -> list:

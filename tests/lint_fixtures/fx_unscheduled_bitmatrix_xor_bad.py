@@ -1,0 +1,19 @@
+"""Seeded violations: naive bitmatrix row-walk XOR loops that bypass
+the schedule compiler (ec/xsched.py)."""
+
+import numpy as np
+
+
+def xor_matmul(rows, packets):
+    out = np.zeros((packets.shape[0], rows.shape[0],
+                    packets.shape[2]), dtype=np.uint8)
+    for r in range(rows.shape[0]):
+        idx = np.flatnonzero(rows[r])
+        out[:, r] = np.bitwise_xor.reduce(packets[:, idx, :], axis=1)  # expect: unscheduled-bitmatrix-xor
+    return out
+
+
+def fold_rows(rows, srcs, acc):
+    for r in rows:
+        acc[:] ^= srcs[r]  # expect: unscheduled-bitmatrix-xor
+    return acc

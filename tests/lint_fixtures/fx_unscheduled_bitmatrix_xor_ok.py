@@ -1,0 +1,25 @@
+"""Clean twin: XOR programs ride the schedule compiler; loops that
+GF-multiply (wide-word field math) are not XOR walks; single
+un-looped XOR folds are one-shot reductions, not row walks."""
+
+import numpy as np
+
+from ceph_tpu.ec import xsched
+
+
+def scheduled_encode(bm, sources, outs):
+    sched = xsched.compile_matrix(bm)
+    xsched.execute_host(sched, sources, outs)
+
+
+def wide_word_matmul(mat, words, field):
+    out = np.zeros((words.shape[0], mat.shape[0], words.shape[-1]),
+                   dtype=words.dtype)
+    for j in range(mat.shape[0]):
+        for i in range(words.shape[1]):
+            out[:, j] ^= field.mul_vec(int(mat[j, i]), words[:, i])
+    return out
+
+
+def one_shot_fold(packets):
+    return np.bitwise_xor.reduce(packets, axis=1)

@@ -23,7 +23,7 @@ CONTRACT_KEYS = {"metric", "value", "unit", "vs_baseline",
                  "plan_cache", "encode_service", "tier",
                  "device_health", "tail", "load", "durability",
                  "mesh", "multihost", "trace", "group_commit",
-                 "compute", "truncated"}
+                 "compute", "xsched", "truncated"}
 
 
 def test_contract_line_despite_hanging_backend(tmp_path):
@@ -155,6 +155,16 @@ def test_contract_line_despite_hanging_backend(tmp_path):
     assert cp["straggler_avoided"] == 1
     assert cp["first_k_bitexact"] == 1
     assert cp["cancelled_subcomputes"] >= 1
+    # the codec-compiler probe ran: every compiled XOR schedule
+    # executed bit-exactly against the naive row-walk oracle, the
+    # memo served repeat compiles, and the best measured XOR-count
+    # reduction cleared the >=25% acceptance bar
+    xs = contract["xsched"]
+    assert xs["bitexact"] == 1
+    assert xs["xor_reduction_pct"] >= 25
+    assert xs["schedules"] >= 1
+    assert xs["cache_hits"] >= 1
+    assert xs["xors_scheduled"] < xs["xors_naive"]
     assert contract["truncated"] is False
     # details stayed out of stdout (they belong in bench_details.json)
     assert len(stdout_lines) == 1
@@ -214,6 +224,10 @@ def test_budget_truncates_optional_sections(tmp_path):
     # and the trace decomposition section
     assert "trace" in details["skipped_sections"]
     assert "trace_stage_summary" not in details
+    # and the codec-compiler sweep (its `xsched` contract key is
+    # pre-contract and still rides, budget permitting)
+    assert "xsched" in details["skipped_sections"]
+    assert "xsched_sweep" not in details
 
 
 def test_watchdog_contract_line_survives_outer_kill(tmp_path):

@@ -30,6 +30,7 @@ RULES = [
     "unguarded-device-dispatch",
     "unplanned-mesh-dispatch",
     "unplanned-compute-dispatch",
+    "unscheduled-bitmatrix-xor",
     "raw-process-group",
     "unhedged-gather",
     "span-leak",
@@ -60,7 +61,8 @@ CONFIG = {"dtype_paths": ("fx_uint8",),
           "atomicity_paths": ("fx_await_atomicity",),
           "cancel_paths": ("fx_cancellation_unsafe_acquire",),
           "transitive_paths": ("fx_transitive_blocking_call",),
-          "hot_paths": ("fx_hot_path_copy",)}
+          "hot_paths": ("fx_hot_path_copy",),
+          "xsched_paths": ("fx_unscheduled_bitmatrix_xor",)}
 
 
 def _fixture(name: str) -> str:
