@@ -71,6 +71,7 @@ def _emit_contract(value: Optional[float],
                    group_commit: Optional[dict] = None,
                    compute: Optional[dict] = None,
                    xsched: Optional[dict] = None,
+                   spmd: Optional[dict] = None,
                    truncated: bool = False) -> None:
     """Print the one-line JSON driver contract, exactly once, before
     any optional extended benches run — a wedged tunnel or a crashed
@@ -99,7 +100,10 @@ def _emit_contract(value: Optional[float],
     subset + the hedged straggler leg), xsched the codec-compiler
     probe (schedule-vs-naive bit-exactness over the bitmatrix family
     + decode submatrices + a GF bit expansion, with the measured
-    XOR-count reduction and memo hits);
+    XOR-count reduction and memo hits), spmd the collective-safety
+    cross-check (static collective-site map non-empty, the 2-process
+    smoke leg's runtime-observed collective trace ⊆ the static map,
+    per-process order congruence);
     truncated flags a budget-shortened run.  Thread-safe:
     the deadline watchdog and the bench body may race to emit."""
     global _contract_emitted
@@ -126,6 +130,7 @@ def _emit_contract(value: Optional[float],
             "group_commit": group_commit,
             "compute": compute,
             "xsched": xsched,
+            "spmd": spmd,
             "truncated": bool(truncated),
         }), flush=True)
 
@@ -294,8 +299,68 @@ def _multihost_probe() -> Optional[dict]:
         return None
     timeout_s = float(os.environ.get(
         "CEPH_TPU_BENCH_MULTIHOST_PROBE_TIMEOUT", "180"))
-    return _meshbench_subprocess(["--processes", "2", "--smoke"],
-                                 timeout_s)
+    # arm the collective-trace recorder in the worker processes: the
+    # meshbench driver inherits this env and forwards it, and its
+    # cross-worker congruence verdict rides back in the report for
+    # _spmd_probe to check against the static site map
+    prev = os.environ.get("CEPH_TPU_COLLECTIVE_TRACE")
+    os.environ["CEPH_TPU_COLLECTIVE_TRACE"] = "1"
+    try:
+        return _meshbench_subprocess(["--processes", "2", "--smoke"],
+                                     timeout_s)
+    finally:
+        if prev is None:
+            os.environ.pop("CEPH_TPU_COLLECTIVE_TRACE", None)
+        else:
+            os.environ["CEPH_TPU_COLLECTIVE_TRACE"] = prev
+
+
+def _spmd_probe(multihost_counters: Optional[dict]) -> Optional[dict]:
+    """Pre-contract collective-safety cross-check: the static
+    collective-site map (analysis/collective.py) must be non-empty,
+    and the 2-process smoke leg's runtime-observed collective trace
+    (recorded by the multihost probe's workers) must be a subset of
+    it with per-process order congruence — runtime ⊆ static, the
+    same discipline as the lockdep and interleave checks."""
+    if _remaining() < 0:
+        print("# spmd probe skipped: budget exhausted",
+              file=sys.stderr)
+        return None
+    try:
+        import ceph_tpu
+        from ceph_tpu.analysis.collective import collective_site_map
+        from ceph_tpu.analysis.core import build_project
+
+        pkg = os.path.dirname(os.path.abspath(ceph_tpu.__file__))
+        smap = collective_site_map(build_project([pkg]))
+        out: dict = {
+            "static_sites": len({(v["qualname"], k[0])
+                                 for k, v in smap.items()}),
+            "static_lines": len(smap),
+            "runtime_sites": None,
+            "runtime_subset_static": None,
+            "order_congruent": None,
+        }
+        trace = None
+        for row in (multihost_counters or {}).get(
+                "process_sweep", []):
+            if isinstance(row, dict) and \
+                    row.get("spmd_trace") is not None:
+                trace = row["spmd_trace"]
+                out["order_congruent"] = row.get(
+                    "spmd_order_congruent")
+                break
+        if trace is not None:
+            pkg_sites = {(p, ln) for p, ln, *_ in trace
+                         if p.startswith("ceph_tpu/")}
+            out["runtime_sites"] = len(pkg_sites)
+            out["runtime_subset_static"] = int(
+                all(s in smap for s in pkg_sites))
+        return out
+    except Exception as exc:  # pragma: no cover - probe must not
+        print(f"# spmd probe failed: {exc!r}",   # block the contract
+              file=sys.stderr)
+        return None
 
 
 def bench_multihost() -> dict:
@@ -2570,6 +2635,10 @@ def main() -> None:
     # a real 2-process jax.distributed group + the host-loss leg
     # (one host event, one shrink, zero host fallbacks)
     multihost_counters = _multihost_probe()
+    # spmd collective-safety probe (before the contract): static
+    # collective-site map non-empty, the 2-process leg's runtime
+    # trace ⊆ static map, per-process order congruence
+    spmd_counters = _spmd_probe(multihost_counters)
     # critical-path tracing probe (before the contract): reducer
     # reconstructs a hand-built tree, spans-on-vs-off overhead at
     # sample rate 0 through a live loopback cluster
@@ -2601,6 +2670,7 @@ def main() -> None:
                    group_commit=group_commit_counters,
                    compute=compute_counters,
                    xsched=xsched_counters,
+                   spmd=spmd_counters,
                    truncated=skip_optional)
 
     # decode sweep over 1..m erasures (the reference benchmark sweeps

@@ -15,6 +15,18 @@ zero-copy worklist, and stale-suppression hygiene; rules_async.py
 holds those rules and analysis/interleave.py their runtime twin (the
 deterministic-interleaving explorer, CEPH_TPU_INTERLEAVE=1).
 
+PR 16 adds the SPMD collective-safety family: collective.py maps
+every collective call site (multihost.agree*/put_global/gather,
+process_allgather, coordinator-KV barriers, lax collectives) with
+its enclosing control-flow predicates, exception paths and timeout
+guards, and rules_spmd.py applies divergent-collective,
+collective-order, unguarded-collective-timeout and
+topology-stale-state over it.  Their runtime twin is interleave.py's
+collective-trace recorder (CEPH_TPU_COLLECTIVE_TRACE=1), cross-
+checked runtime ⊆ static with per-process order congruence by a real
+2-process group in tests/test_spmd_safety.py; baselined SPMD
+findings are ratchet-pinned at zero by tools/collective_ratchet.json.
+
 Run as a gate:  python -m ceph_tpu.analysis [paths]   (exit 0/1)
 Run in tests:   tests/test_static_analysis.py (tier-1)
 Suppress:       `# lint: disable=<rule>` inline, or baseline a

@@ -101,12 +101,12 @@ def main(argv: List[str] = None) -> int:
     rule_names = sorted(rules if rules is not None else default_rules())
     cache_path = lint_cache.default_cache_path()
     findings = None
-    # the cache serves the CI-gate invocation (whole package, all
-    # rules — the expensive one); explicit path or rule subsets are
-    # small and would evict the warm whole-tree entry (one cache key,
-    # one file set, one rule set)
-    use_cache = (not args.no_cache and not args.paths
-                 and not args.rules)
+    # the cache is keyed by the active rule-set hash, so a `--rules`
+    # subset run stores under its own entry and can never poison (or
+    # evict) the full gate's; explicit path subsets still bypass —
+    # they change the FILE set, and a warm whole-tree entry per
+    # ad-hoc path selection isn't worth the churn
+    use_cache = not args.no_cache and not args.paths
     if use_cache:
         hashes = lint_cache.scan_hashes(iter_py_files(paths))
         findings, changed = lint_cache.load(

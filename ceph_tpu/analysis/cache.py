@@ -17,6 +17,11 @@ replaying only bit-identical trees needs nothing but hashes and is
 always sound.  The per-module sha map still earns its keep: a miss
 report names exactly which files moved.
 
+Entries are keyed by the ACTIVE RULE-SET hash: a `--rules` subset run
+stores under its own key and can never poison (or evict) the full
+gate's entry — each ruleset replays only findings produced by exactly
+that ruleset over exactly these hashes.
+
 Cache hygiene: the file is advisory and self-invalidating — delete it
 freely, never check it in (.gitignore'd), `--no-cache` bypasses it.
 """
@@ -30,7 +35,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 from ceph_tpu.analysis.findings import Finding
 
-CACHE_VERSION = 1
+CACHE_VERSION = 2
 CACHE_BASENAME = ".lint_cache.json"
 
 _ANALYSIS_DIR = os.path.dirname(os.path.abspath(__file__))
@@ -71,6 +76,13 @@ def scan_hashes(files: Iterable[str]) -> Dict[str, str]:
     return {os.path.abspath(p): file_sha256(p) for p in sorted(files)}
 
 
+def _ruleset_key(rule_names: Iterable[str]) -> str:
+    """Stable hash of the active rule set: the entry key that keeps a
+    `--rules` subset run from ever poisoning the full gate's entry."""
+    h = hashlib.sha256("\n".join(sorted(rule_names)).encode())
+    return h.hexdigest()[:16]
+
+
 def load(path: str, files: Dict[str, str],
          rule_names: Iterable[str]
          ) -> Tuple[Optional[List[Finding]], List[str]]:
@@ -83,29 +95,45 @@ def load(path: str, files: Dict[str, str],
     except (OSError, ValueError):
         return None, []
     if data.get("version") != CACHE_VERSION or \
-            data.get("analyzer") != _analyzer_sha() or \
-            data.get("rules") != sorted(rule_names):
+            data.get("analyzer") != _analyzer_sha():
         return None, []
-    cached_files = data.get("files", {})
+    entry = data.get("entries", {}).get(_ruleset_key(rule_names))
+    if entry is None or entry.get("rules") != sorted(rule_names):
+        return None, []
+    cached_files = entry.get("files", {})
     if set(cached_files) != set(files):
         return None, []
     changed = [p for p, sha in files.items()
                if cached_files.get(p) != sha]
     if changed:
         return None, sorted(changed)
-    findings = [Finding(**rec) for rec in data.get("findings", [])]
+    findings = [Finding(**rec) for rec in entry.get("findings", [])]
     return findings, []
 
 
 def save(path: str, files: Dict[str, str],
          rule_names: Iterable[str],
          findings: List[Finding]) -> None:
-    data = {
-        "version": CACHE_VERSION,
-        "analyzer": _analyzer_sha(),
+    # merge into the existing entry table when version + analyzer
+    # still match — a subset run must not evict the full gate's entry
+    entries: Dict[str, dict] = {}
+    try:
+        with open(path) as fh:
+            old = json.load(fh)
+        if old.get("version") == CACHE_VERSION and \
+                old.get("analyzer") == _analyzer_sha():
+            entries = dict(old.get("entries", {}))
+    except (OSError, ValueError):
+        pass
+    entries[_ruleset_key(rule_names)] = {
         "rules": sorted(rule_names),
         "files": dict(sorted(files.items())),
         "findings": [f.as_dict() for f in findings],
+    }
+    data = {
+        "version": CACHE_VERSION,
+        "analyzer": _analyzer_sha(),
+        "entries": entries,
     }
     tmp = f"{path}.tmp.{os.getpid()}"
     try:

@@ -1,0 +1,365 @@
+"""Collective-site map: the static universe of the SPMD cross-process
+plane.
+
+Multi-host SPMD correctness is a *congruence* property: every process
+in the group must reach the same collectives, in the same order, with
+the same participation decisions.  The canonical failure is not a
+wrong answer but a silent wedge — one process raises past an
+agreement, branches on ``process_index``, or reorders two
+collectives, and every peer blocks forever (or worse, retires a live
+host).  This module extracts, per ``ast.Call`` that crosses the
+process boundary, the facts the rules in ``rules_spmd.py`` and the
+runtime cross-check in ``interleave.py`` need:
+
+* **kind** — ``agreement`` (``multihost.agree``/``agree_healthy``/
+  ``agreed_healthy``), ``put-global``, ``gather``, ``allgather``
+  (``multihost_utils.process_allgather``), ``barrier``
+  (``sync_global_devices`` / ``wait_at_barrier``), ``kv-wait``
+  (``blocking_key_value_get``), ``kv-set`` (``key_value_set``), and
+  ``collective`` (``jax.lax`` collectives inside traced bodies).
+* **process_branches** — enclosing ``if``/``while`` tests that depend
+  on the process identity (``process_index``, ``process_count``,
+  ``local_host``, ``local_addressable`` or names assigned from them).
+  Group-uniform kill switches (``is_multiprocess``, ``enabled``) are
+  NOT process-dependent: every process takes the same branch.
+* **swallow_line** — the enclosing ``try`` whose handler neither
+  re-raises nor returns, i.e. an exception path on which this process
+  silently *skips* the collective and continues with state its peers
+  don't share.
+* **prior_divergent_exits** — ``raise``/``return`` statements earlier
+  in the same function guarded by a process-dependent predicate: the
+  "process 1 bails before the agreement" shape.
+* **has_timeout** — for coordinator-KV waits, whether a hard timeout
+  argument is present (a dead host must read as a timeout, never a
+  wedge — the discipline ``multihost.agree`` established).
+
+``collective_site_map(project)`` renders the sites as a
+``{(relpath, line): site}`` dict covering every line of each call
+span (mirroring ``callgraph.await_site_map``), so a runtime trace
+frame — whose ``f_lineno`` may land anywhere inside a multi-line
+call — can be checked for membership: runtime ⊆ static.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from ceph_tpu.analysis.core import ModuleInfo, Project, dotted
+
+# seam entry points: calls resolving to these (module-qualified) names
+# are the cross-process plane.  Tail-matched against the resolved
+# dotted callee so both `multihost.agree` at a call site and the bare
+# `agree` inside parallel/multihost.py itself classify.
+_SEAM_KINDS = {
+    "agree": "agreement",
+    "agree_healthy": "agreement",
+    "agreed_healthy": "agreement",
+    "put_global": "put-global",
+    "gather": "gather",
+}
+_MULTIHOST_UTILS = {
+    "process_allgather": "allgather",
+    "sync_global_devices": "barrier",
+}
+# coordinator-KV client methods: the names are distinctive enough to
+# classify on the attribute tail alone (the client object is opaque)
+_KV_KINDS = {
+    "blocking_key_value_get": "kv-wait",
+    "wait_at_barrier": "barrier",
+    "key_value_set": "kv-set",
+}
+# jax.lax collectives — required to carry a jax/lax-qualified head so
+# an arbitrary method named `all_gather` does not classify
+_LAX_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "psum_scatter",
+}
+
+# process-identity reads: two processes evaluating the same predicate
+# over these can take DIFFERENT branches
+PROCESS_DEPENDENT = {
+    "process_index", "process_count", "local_host",
+    "local_addressable", "host_of_id",
+}
+
+# kinds that can block on peers (or retire them): divergence here is
+# a wedge / false host-retirement, not a handled timeout.  kv-wait
+# and kv-set are excluded — the per-peer timeout-to-None discipline
+# inside multihost.agree makes their divergence a verdict, not a hang.
+WEDGEABLE = {
+    "agreement", "put-global", "gather", "allgather", "barrier",
+    "collective",
+}
+
+
+@dataclass
+class CollectiveSite:
+    """One cross-process call site plus its control-flow facts."""
+
+    node: ast.Call
+    mod: ModuleInfo
+    qualname: str
+    scope_line: int
+    kind: str
+    callee: str
+    line: int
+    end_line: int
+    # (line, predicate-name) of enclosing process-dependent tests
+    process_branches: Tuple[Tuple[int, str], ...] = ()
+    # enclosing `try` line whose handler swallows (no raise/return)
+    swallow_line: int = 0
+    # (line, predicate-name) of earlier raise/return under a
+    # process-dependent predicate in the same function scope
+    prior_divergent_exits: Tuple[Tuple[int, str], ...] = ()
+    has_timeout: bool = False
+
+    def key(self) -> Tuple[str, int]:
+        return (self.mod.relpath.replace("\\", "/"), self.line)
+
+
+def _call_name(mod: ModuleInfo, call: ast.Call) -> str:
+    """Resolved dotted callee: the import table maps the head
+    (`import X as m; m.f(..)` -> `X.f`); bare names stay bare."""
+    name = dotted(call.func)
+    if not name:
+        return ""
+    head, _, rest = name.partition(".")
+    if head in mod.imports:
+        base, attr = mod.imports[head]
+        full = base + ("." + attr if attr else "")
+        return full + ("." + rest if rest else "")
+    return name
+
+
+def classify_call(mod: ModuleInfo, call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(kind, resolved-callee) when the call crosses the process
+    boundary; None otherwise."""
+    name = _call_name(mod, call)
+    if not name:
+        return None
+    parts = name.split(".")
+    tail = parts[-1]
+    if tail in _KV_KINDS:
+        return (_KV_KINDS[tail], name)
+    if tail in _MULTIHOST_UTILS and "multihost_utils" in parts:
+        return (_MULTIHOST_UTILS[tail], name)
+    if tail in _SEAM_KINDS:
+        # module-qualified seam call, or a bare call to the seam
+        # function from inside the multihost module itself
+        if len(parts) > 1 and parts[-2] == "multihost":
+            return (_SEAM_KINDS[tail], name)
+        if len(parts) == 1 and \
+                mod.modname.rsplit(".", 1)[-1] == "multihost" and \
+                tail in mod.functions:
+            return (_SEAM_KINDS[tail], mod.modname + "." + tail)
+        return None
+    if tail in _LAX_COLLECTIVES and \
+            any(p in ("lax", "jax") for p in parts[:-1]):
+        return ("collective", name)
+    return None
+
+
+def _names_in(expr: ast.AST) -> Set[str]:
+    """Every Name id and Attribute tail mentioned in an expression."""
+    out: Set[str] = set()
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+def _process_tainted_names(scope: ast.AST) -> Set[str]:
+    """Names assigned (anywhere in the scope) from an expression that
+    reads the process identity — `pid = process_index()` taints `pid`
+    so `p == pid` reads as process-dependent."""
+    tainted: Set[str] = set()
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Assign) and \
+                _names_in(n.value) & PROCESS_DEPENDENT:
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    tainted.add(t.id)
+    return tainted
+
+
+def _predicate_dependence(test: ast.AST,
+                          tainted: Set[str]) -> Optional[str]:
+    """The process-identity name a predicate reads, or None when the
+    test is group-uniform (data-dependent or a kill switch)."""
+    names = _names_in(test)
+    hit = names & PROCESS_DEPENDENT
+    if hit:
+        return sorted(hit)[0]
+    hit = names & tainted
+    if hit:
+        return sorted(hit)[0]
+    return None
+
+
+def _handler_swallows(handler: ast.ExceptHandler) -> bool:
+    """A handler that neither raises nor returns lets execution fall
+    through past the try with the collective skipped — divergent
+    state peers don't share.  `except: return sentinel` is an
+    explicit verdict and does not count."""
+    for n in ast.walk(handler):
+        if isinstance(n, (ast.Raise, ast.Return)):
+            return False
+    return True
+
+
+def _in_block(node: ast.AST, block: List[ast.stmt],
+              parents: Dict[ast.AST, ast.AST]) -> bool:
+    """True when node's ancestor chain passes through one of the
+    given statements (e.g. membership in a Try body vs its
+    handlers)."""
+    stmts = set(map(id, block))
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if id(cur) in stmts:
+            return True
+        cur = parents.get(cur)
+    return False
+
+
+def _scope_of(mod: ModuleInfo, node: ast.AST) -> Tuple[ast.AST, str, int]:
+    """(enclosing scope node, qualname, scope line)."""
+    cur = mod.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for fi in mod.functions.values():
+                if fi.node is cur:
+                    return (cur, fi.qualname, cur.lineno)
+            return (cur, cur.name, cur.lineno)
+        cur = mod.parents.get(cur)
+    return (mod.tree, "<module>", 0)
+
+
+def _site_facts(mod: ModuleInfo, call: ast.Call, kind: str,
+                scope: ast.AST, tainted: Set[str]) -> Tuple[
+                    Tuple[Tuple[int, str], ...], int]:
+    """Walk the parent chain from the call up to its scope collecting
+    process-dependent branch tests and the nearest swallowing try."""
+    branches: List[Tuple[int, str]] = []
+    swallow = 0
+    child: ast.AST = call
+    cur = mod.parents.get(call)
+    while cur is not None and cur is not scope:
+        if isinstance(cur, (ast.If, ast.While)) and \
+                not _in_block(child, [cur.test], mod.parents):
+            dep = _predicate_dependence(cur.test, tainted)
+            if dep:
+                branches.append((cur.lineno, dep))
+        elif isinstance(cur, ast.IfExp):
+            dep = _predicate_dependence(cur.test, tainted)
+            if dep:
+                branches.append((cur.lineno, dep))
+        elif isinstance(cur, ast.Try) and not swallow and \
+                _in_block(child, cur.body, mod.parents):
+            for h in cur.handlers:
+                if _handler_swallows(h):
+                    swallow = cur.lineno
+                    break
+        child = cur
+        cur = mod.parents.get(cur)
+    return (tuple(branches), swallow)
+
+
+def _divergent_exits(mod: ModuleInfo, scope: ast.AST,
+                     tainted: Set[str]) -> List[Tuple[int, str]]:
+    """raise/return statements inside this scope whose enclosing If
+    test is process-dependent: past one of these, processes are on
+    different progress trajectories.  `continue`/`break` only skip
+    loop iterations, never subsequent collectives, so they don't
+    count."""
+    out: List[Tuple[int, str]] = []
+    for n in ast.walk(scope):
+        if not isinstance(n, (ast.Raise, ast.Return)):
+            continue
+        cur = mod.parents.get(n)
+        while cur is not None and cur is not scope:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                break       # nested scope: not this function's exit
+            if isinstance(cur, (ast.If, ast.While)):
+                dep = _predicate_dependence(cur.test, tainted)
+                if dep:
+                    out.append((n.lineno, dep))
+                    break
+            cur = mod.parents.get(cur)
+    return sorted(out)
+
+
+def _has_timeout_arg(call: ast.Call) -> bool:
+    if len(call.args) >= 2:
+        return True
+    return any(kw.arg and "timeout" in kw.arg for kw in call.keywords)
+
+
+def collect_sites(project: Project) -> List[CollectiveSite]:
+    """Every collective site in the project, with facts (memoized on
+    the project — three rules and the runtime cross-check share one
+    extraction pass)."""
+    cached = getattr(project, "_collective_sites", None)
+    if cached is not None:
+        return cached
+    sites: List[CollectiveSite] = []
+    for mod in project.modules.values():
+        # lazily computed per enclosing scope
+        scope_cache: Dict[int, Tuple[Set[str],
+                                     List[Tuple[int, str]]]] = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cls = classify_call(mod, node)
+            if cls is None:
+                continue
+            kind, callee = cls
+            scope, qualname, scope_line = _scope_of(mod, node)
+            cached = scope_cache.get(id(scope))
+            if cached is None:
+                tainted = _process_tainted_names(scope)
+                exits = _divergent_exits(mod, scope, tainted)
+                cached = scope_cache[id(scope)] = (tainted, exits)
+            tainted, exits = cached
+            branches, swallow = _site_facts(mod, node, kind, scope,
+                                            tainted)
+            sites.append(CollectiveSite(
+                node=node, mod=mod, qualname=qualname,
+                scope_line=scope_line, kind=kind, callee=callee,
+                line=node.lineno,
+                end_line=getattr(node, "end_lineno", None)
+                or node.lineno,
+                process_branches=branches,
+                swallow_line=swallow,
+                prior_divergent_exits=tuple(
+                    e for e in exits if e[0] < node.lineno),
+                has_timeout=_has_timeout_arg(node)))
+    sites.sort(key=lambda s: (s.mod.relpath, s.line,
+                              s.node.col_offset))
+    project._collective_sites = sites
+    return sites
+
+
+def collective_site_map(project: Project) -> Dict[Tuple[str, int],
+                                                  Dict[str, object]]:
+    """{(relpath, line): {qualname, kind, callee}} for every line a
+    collective call spans — a runtime frame anywhere inside the call
+    must map back to the site (narrowest span wins on overlap, the
+    ``await_site_map`` convention)."""
+    out: Dict[Tuple[str, int], Dict[str, object]] = {}
+    width: Dict[Tuple[str, int], int] = {}
+    for s in collect_sites(project):
+        rel = s.mod.relpath.replace("\\", "/")
+        span = s.end_line - s.line
+        for line in range(s.line, s.end_line + 1):
+            key = (rel, line)
+            if key in out and width[key] <= span:
+                continue
+            out[key] = {"qualname": s.qualname, "kind": s.kind,
+                        "callee": s.callee}
+            width[key] = span
+    return out

@@ -42,6 +42,19 @@ Arming:
 Determinism contract: the schedule is a pure function of (seed, the
 program's own behavior); replaying the same test with the same seed
 replays the same permutations.  No wall clock, no os.urandom.
+
+The SPMD collective plane gets the same treatment: a collective-trace
+recorder (``record_collective``, armed by CEPH_TPU_COLLECTIVE_TRACE=1
+for in-memory records or CEPH_TPU_COLLECTIVE_TRACE_FILE=<path> for a
+per-process JSONL the multi-process harness collects) is called at
+every ``multihost`` seam entry (agree / agree_healthy /
+agreed_healthy / put_global / gather) and records the CALLER's
+package call site.  tests/test_spmd_safety.py and the meshbench
+multi-process legs cross-check runtime ⊆ static against
+``collective.collective_site_map`` and assert per-process ORDER
+CONGRUENCE: every process must observe the same collective sequence,
+or the group was divergent (the wedge class rules_spmd.py flags
+statically).
 """
 
 from __future__ import annotations
@@ -56,7 +69,9 @@ from typing import Iterator, List, Optional, Set, Tuple
 __all__ = [
     "InterleaveLoop", "InterleavePolicy", "explore", "enabled",
     "install_if_enabled", "records", "clear_records", "await_sites",
-    "AwaitRecord",
+    "AwaitRecord", "CollectiveRecord", "collective_trace_armed",
+    "record_collective", "collective_records",
+    "clear_collective_records", "collective_sites",
 ]
 
 enabled = os.environ.get("CEPH_TPU_INTERLEAVE", "0") == "1"
@@ -92,6 +107,97 @@ def clear_records() -> None:
 def await_sites() -> Set[Tuple[str, int]]:
     """Distinct (relpath, line) await sites observed so far."""
     return {(r.path, r.line) for r in _records}
+
+
+# ---------------------------------------------------------------
+# SPMD collective-trace recorder: the cross-process runtime twin
+# ---------------------------------------------------------------
+
+@dataclass(frozen=True)
+class CollectiveRecord:
+    kind: str          # agreement / put-global / gather / ...
+    op: str            # seam entry point name (agree, gather, ...)
+    path: str          # caller site, ceph_tpu-relative when in-pkg
+    line: int
+    topic: str         # agreement topic ("" for data collectives)
+    seq: int           # per-process monotonic sequence number
+
+
+_collective_records: List[CollectiveRecord] = []
+_collective_seq = 0
+
+
+def collective_trace_armed() -> bool:
+    return bool(os.environ.get("CEPH_TPU_COLLECTIVE_TRACE") == "1"
+                or os.environ.get("CEPH_TPU_COLLECTIVE_TRACE_FILE"))
+
+
+def collective_records() -> List[CollectiveRecord]:
+    return list(_collective_records)
+
+
+def clear_collective_records() -> None:
+    global _collective_seq
+    _collective_records.clear()
+    _collective_seq = 0
+
+
+def collective_sites() -> Set[Tuple[str, int]]:
+    """Distinct in-package (relpath, line) collective call sites
+    observed so far — the runtime side of runtime ⊆ static."""
+    return {(r.path, r.line) for r in _collective_records
+            if r.path.startswith("ceph_tpu/")}
+
+
+def _caller_site(depth: int) -> Optional[Tuple[str, int]]:
+    """(path, lineno) of the frame `depth` levels up: the package
+    call site that entered the seam.  In-package paths are
+    ceph_tpu-relative (matching ModuleInfo.relpath); out-of-package
+    callers (tests, scratch worker scripts) keep their basename so
+    order congruence still compares across processes."""
+    import sys
+    try:
+        f = sys._getframe(depth)
+    except ValueError:
+        return None
+    fn = f.f_code.co_filename
+    idx = fn.rfind(os.sep + "ceph_tpu" + os.sep)
+    if idx >= 0:
+        rel = fn[idx + 1:].replace(os.sep, "/")
+    else:
+        rel = os.path.basename(fn)
+    return (rel, f.f_lineno)
+
+
+def record_collective(op: str, kind: str, topic: str = "",
+                      depth: int = 2) -> None:
+    """Record one seam entry.  Cheap no-op unless armed; with
+    CEPH_TPU_COLLECTIVE_TRACE_FILE set, each record is also appended
+    as a JSON line so a subprocess worker's trace survives its exit
+    (the multi-process harness reads the per-process files back)."""
+    if not collective_trace_armed():
+        return
+    site = _caller_site(depth)
+    if site is None:
+        return
+    global _collective_seq
+    _collective_seq += 1
+    rec = CollectiveRecord(kind=kind, op=op, path=site[0],
+                           line=site[1], topic=topic,
+                           seq=_collective_seq)
+    if len(_collective_records) < RECORD_CAP:
+        _collective_records.append(rec)
+    path = os.environ.get("CEPH_TPU_COLLECTIVE_TRACE_FILE")
+    if path:
+        import json
+        try:
+            with open(path, "a") as fh:
+                fh.write(json.dumps({
+                    "kind": rec.kind, "op": rec.op, "path": rec.path,
+                    "line": rec.line, "topic": rec.topic,
+                    "seq": rec.seq}) + "\n")
+        except OSError:  # tracing must never break the data plane
+            pass
 
 
 def _is_task_wakeup(handle) -> Optional[asyncio.Task]:

@@ -1295,6 +1295,10 @@ def default_rules() -> Dict[str, object]:
         rule_hot_path_copy, rule_transitive_blocking_call,
         rule_unused_suppression,
     )
+    from ceph_tpu.analysis.rules_spmd import (
+        rule_collective_order, rule_divergent_collective,
+        rule_topology_stale_state, rule_unguarded_collective_timeout,
+    )
     return {
         "trace-side-effect": rule_trace_side_effect,
         "trace-host-sync": rule_trace_host_sync,
@@ -1319,5 +1323,10 @@ def default_rules() -> Dict[str, object]:
         "cancellation-unsafe-acquire": rule_cancellation_unsafe_acquire,
         "transitive-blocking-call": rule_transitive_blocking_call,
         "hot-path-copy": rule_hot_path_copy,
+        "divergent-collective": rule_divergent_collective,
+        "collective-order": rule_collective_order,
+        "unguarded-collective-timeout":
+            rule_unguarded_collective_timeout,
+        "topology-stale-state": rule_topology_stale_state,
         "unused-suppression": rule_unused_suppression,
     }

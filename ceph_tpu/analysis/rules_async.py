@@ -199,7 +199,14 @@ def rule_cancellation_unsafe_acquire(a: Analyzer) -> None:
 # returns the cached binding.  Module-qualified so only the native
 # package's get_lib is exempt — a future blocking helper that happens
 # to share the name still gets flagged.
-_BLOCKING_EXEMPT = ("ceph_tpu.native.get_lib",)
+_BLOCKING_EXEMPT = (
+    "ceph_tpu.native.get_lib",
+    # the collective-trace recorder's JSONL append: diagnostics-only,
+    # armed by env in the multi-process harness, never on a hot
+    # daemon path — and the data plane must not be restructured
+    # around its instrument
+    "ceph_tpu.analysis.interleave.record_collective",
+)
 
 
 def rule_transitive_blocking_call(a: Analyzer) -> None:

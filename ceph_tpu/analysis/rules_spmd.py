@@ -1,0 +1,280 @@
+"""SPMD collective-safety rules over the collective-site map.
+
+The cross-process plane's contract is lockstep congruence: every
+process must reach the same collectives, in the same order, under
+the same verdicts.  These rules flag the static shapes that break
+it:
+
+* ``divergent-collective`` — a wedgeable collective (agreement,
+  put_global/gather, allgather, barrier, lax collective) reachable
+  under a process-dependent predicate (``process_index``/
+  ``process_count``/host-topology reads, or names tainted by them),
+  or skippable on an exception path peers don't share (an enclosing
+  ``try`` whose handler neither raises nor returns), or preceded in
+  the same function by a ``raise``/``return`` under a
+  process-dependent predicate (the "one process bails before the
+  agreement" shape).  Group-uniform kill switches
+  (``is_multiprocess()``-style) take the same branch everywhere and
+  are exempt.
+* ``collective-order`` — an ``if`` whose two arms issue the same
+  collectives in *inverted* relative order: two processes taking
+  different arms deadlock against each other (A waits in collective
+  X while B waits in collective Y).
+* ``unguarded-collective-timeout`` — a coordinator-KV wait without a
+  hard timeout argument, a KV call outside the ``multihost.agree``
+  seam (ad-hoc half-protocols must ride the agreement discipline),
+  or an untimed global barrier: a dead host must read as a timeout,
+  never a wedge.
+* ``topology-stale-state`` — a module-level cache keyed by a
+  device-id-derived expression in a function that never consults
+  ``topology_signature()``/mesh-signature: the same chips under a
+  different cluster shape (1x8 vs 2x4) replay stale state — the
+  stale-plan-after-shrink/join class that elasticity makes hot.
+
+All four are path-scoped to the cross-process tier
+(``ceph_tpu/parallel/``, ``ceph_tpu/ec/``) via ``spmd_paths``-family
+config keys, mirroring the other production-scoped rules.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.analysis.collective import (
+    WEDGEABLE, CollectiveSite, collect_sites)
+
+_SPMD_PATHS = ("ceph_tpu/parallel/", "ceph_tpu/ec/")
+_SEAM_PATHS = ("ceph_tpu/parallel/multihost.py",)
+
+# function-body mentions that mark a cache key as topology-aware
+_TOPO_AWARE = {"topology_signature", "_topology", "mesh_sig",
+               "_mesh_sig"}
+
+
+def _scoped_sites(a, key: str, default=_SPMD_PATHS) -> List[
+        CollectiveSite]:
+    paths = a.config.get(key, default)
+    out = []
+    for s in collect_sites(a.project):
+        rel = s.mod.relpath.replace("\\", "/")
+        if any(p in rel for p in paths):
+            out.append(s)
+    return out
+
+
+def rule_divergent_collective(a) -> None:
+    """Wedgeable collectives whose reachability is process-dependent."""
+    for s in _scoped_sites(a, "spmd_paths"):
+        if s.kind not in WEDGEABLE:
+            continue
+        if s.process_branches:
+            line, name = s.process_branches[0]
+            a.emit("divergent-collective", s.mod, s.node,
+                   f"{s.kind} collective `{s.callee}` is guarded by a "
+                   f"process-dependent predicate (`{name}` at line "
+                   f"{line}): processes taking different branches "
+                   "skip it and peers wedge (or retire a live host)",
+                   symbol=s.qualname, scope_line=s.scope_line)
+        elif s.swallow_line:
+            a.emit("divergent-collective", s.mod, s.node,
+                   f"{s.kind} collective `{s.callee}` sits in a try "
+                   f"(line {s.swallow_line}) whose handler neither "
+                   "raises nor returns: on a local exception this "
+                   "process silently skips the collective and "
+                   "continues with state its peers don't share",
+                   symbol=s.qualname, scope_line=s.scope_line)
+        elif s.prior_divergent_exits:
+            line, name = s.prior_divergent_exits[0]
+            a.emit("divergent-collective", s.mod, s.node,
+                   f"{s.kind} collective `{s.callee}` follows a "
+                   f"raise/return at line {line} guarded by "
+                   f"process-dependent `{name}`: a process exiting "
+                   "there never reaches the collective its peers "
+                   "block in",
+                   symbol=s.qualname, scope_line=s.scope_line)
+
+
+def _order_token(s: CollectiveSite) -> str:
+    """Identity of a collective for ordering: callee plus the static
+    prefix of its first argument (the topic distinguishes two agree()
+    calls)."""
+    tok = s.callee
+    if s.node.args:
+        arg = s.node.args[0]
+        if isinstance(arg, ast.Constant):
+            tok += ":" + repr(arg.value)
+        elif isinstance(arg, ast.JoinedStr):
+            head = arg.values[0] if arg.values else None
+            if isinstance(head, ast.Constant):
+                tok += ":" + repr(head.value)
+    return tok
+
+
+def _arm_tokens(a, sites: List[CollectiveSite],
+                block: List[ast.stmt],
+                mod) -> List[str]:
+    from ceph_tpu.analysis.collective import _in_block
+
+    return [_order_token(s) for s in sites
+            if _in_block(s.node, block, mod.parents)]
+
+
+def rule_collective_order(a) -> None:
+    """Branch arms that issue the same collectives in inverted order."""
+    sites = [s for s in _scoped_sites(a, "spmd_paths")
+             if s.kind in WEDGEABLE]
+    by_mod: Dict[str, List[CollectiveSite]] = {}
+    for s in sites:
+        by_mod.setdefault(s.mod.relpath, []).append(s)
+    for rel, mod_sites in by_mod.items():
+        mod = mod_sites[0].mod
+        seen_ifs = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.If) or not node.orelse:
+                continue
+            if id(node) in seen_ifs:
+                continue
+            seen_ifs.add(id(node))
+            body = _arm_tokens(a, mod_sites, node.body, mod)
+            other = _arm_tokens(a, mod_sites, node.orelse, mod)
+            common = [t for t in dict.fromkeys(body)
+                      if t in other]
+            if len(common) < 2:
+                continue
+            body_order = [t for t in body if t in common]
+            other_order = [t for t in other if t in common]
+            # compare first-occurrence order of the shared tokens
+            first_b = list(dict.fromkeys(body_order))
+            first_o = list(dict.fromkeys(other_order))
+            if first_b != first_o:
+                a.emit("collective-order", mod, node,
+                       "branch arms issue the same collectives in "
+                       f"different relative order ({first_b} vs "
+                       f"{first_o}): two processes taking different "
+                       "arms block in different collectives and "
+                       "deadlock against each other",
+                       symbol=mod_sites[0].qualname,
+                       scope_line=mod_sites[0].scope_line)
+
+
+def rule_unguarded_collective_timeout(a) -> None:
+    """Coordinator-KV waits and barriers outside the hard-timeout
+    discipline."""
+    seam = a.config.get("spmd_seam_paths", _SEAM_PATHS)
+    for s in collect_sites(a.project):
+        if s.kind not in ("kv-wait", "kv-set", "barrier"):
+            continue
+        rel = s.mod.relpath.replace("\\", "/")
+        in_seam = any(p in rel for p in seam)
+        if s.kind == "barrier" and s.callee.endswith(
+                "sync_global_devices"):
+            a.emit("unguarded-collective-timeout", s.mod, s.node,
+                   f"`{s.callee}` is an untimed global barrier: a "
+                   "dead host wedges every peer forever — ride "
+                   "`multihost.agree`, whose per-peer KV waits turn "
+                   "a dead host into a timeout verdict",
+                   symbol=s.qualname, scope_line=s.scope_line)
+            continue
+        if not in_seam:
+            a.emit("unguarded-collective-timeout", s.mod, s.node,
+                   f"coordinator-KV call `{s.callee}` outside the "
+                   "multihost.agree seam: ad-hoc KV protocols bypass "
+                   "the hard-timeout + agreement discipline — route "
+                   "through `multihost.agree`",
+                   symbol=s.qualname, scope_line=s.scope_line)
+            continue
+        if s.kind in ("kv-wait", "barrier") and not s.has_timeout:
+            a.emit("unguarded-collective-timeout", s.mod, s.node,
+                   f"blocking KV wait `{s.callee}` has no hard "
+                   "timeout argument: a dead peer must read as a "
+                   "timeout, never a wedge",
+                   symbol=s.qualname, scope_line=s.scope_line)
+
+
+def _module_cache_names(mod) -> set:
+    names = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign):    # `_c: Dict[..] = {}`
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        is_dict = isinstance(value, ast.Dict) or (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id == "dict")
+        if not is_dict:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and "cache" in t.id.lower():
+                names.add(t.id)
+    return names
+
+
+def _device_derived(expr: ast.AST, fn: ast.AST) -> bool:
+    """The key expression (or, for a bare name, its assignment in the
+    function) derives from device identities (`d.id` over a device
+    collection)."""
+    def _reads_ids(e: ast.AST) -> bool:
+        return any(isinstance(n, ast.Attribute) and n.attr == "id"
+                   for n in ast.walk(e))
+
+    if _reads_ids(expr):
+        return True
+    if isinstance(expr, ast.Name):
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == expr.id
+                    for t in n.targets) and _reads_ids(n.value):
+                return True
+    return False
+
+
+def rule_topology_stale_state(a) -> None:
+    """Device-set-keyed module caches missing the topology signature."""
+    paths = a.config.get("spmd_state_paths", _SPMD_PATHS)
+    for mod in a.project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if not any(p in rel for p in paths):
+            continue
+        caches = _module_cache_names(mod)
+        if not caches:
+            continue
+        for fi in mod.functions.values():
+            fn = fi.node
+            mentions = {n.attr for n in ast.walk(fn)
+                        if isinstance(n, ast.Attribute)}
+            mentions |= {n.id for n in ast.walk(fn)
+                         if isinstance(n, ast.Name)}
+            if mentions & _TOPO_AWARE:
+                continue
+            flagged = set()
+            for node in ast.walk(fn):
+                cache: Optional[str] = None
+                key: Optional[ast.AST] = None
+                if isinstance(node, ast.Subscript) and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id in caches:
+                    cache, key = node.value.id, node.slice
+                elif isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "get" and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id in caches and node.args:
+                    cache, key = node.func.value.id, node.args[0]
+                if cache is None or cache in flagged or key is None:
+                    continue
+                if not _device_derived(key, fn):
+                    continue
+                flagged.add(cache)
+                a.emit("topology-stale-state", mod, node,
+                       f"cache `{cache}` is keyed by a device-id set "
+                       "but the key never folds in "
+                       "`topology_signature()`: the same chips under "
+                       "a different cluster shape (1x8 vs 2x4) "
+                       "replay stale state after a shrink/join",
+                       symbol=fi.qualname, scope_line=fi.lineno)

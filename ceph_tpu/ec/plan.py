@@ -553,14 +553,22 @@ def _healthy_jax_devices() -> list:
     healthy = [d for d in devs if not circuit.device_degraded(d.id)]
     try:
         from ceph_tpu.parallel import multihost
-
-        if multihost.is_multiprocess():
-            agreed = set(multihost.agreed_healthy(
-                [d.id for d in healthy]))
-            healthy = [d for d in healthy if d.id in agreed]
+    except Exception:  # pragma: no cover - topology tier unavailable
+        return healthy
+    if not multihost.is_multiprocess():
+        return healthy
+    try:
+        agreed = set(multihost.agreed_healthy(
+            [d.id for d in healthy]))
     except Exception:  # pragma: no cover - agreement unavailable
-        pass
-    return healthy
+        # the coordinator is unreachable: this process cannot know
+        # the group view, and proceeding on its LOCAL view while
+        # peers hold the agreed one builds divergent meshes (a
+        # cross-process wedge).  Decline the mesh — the caller falls
+        # back to the single-device plan and peers retire this
+        # process by timeout.
+        return []
+    return [d for d in healthy if d.id in agreed]
 
 
 def _mesh_devices(batch: int, nbytes: int) -> Optional[tuple]:

@@ -88,7 +88,11 @@ def default_mesh():
     from ceph_tpu.parallel.mesh import make_mesh
 
     devs = healthy_devices()
-    sig = tuple(d.id for d in devs)
+    # the same chip ids under a different cluster shape (1x8 vs 2x4
+    # host domains) must NOT replay a cached mesh — spans_hosts (and
+    # with it the flat-vs-hybrid layout) is a function of topology,
+    # not of the id set alone
+    sig = (tuple(d.id for d in devs), multihost.topology_signature())
     mesh = _mesh_cache.get(sig)
     if mesh is None:
         if _mesh_cache:
@@ -108,13 +112,15 @@ def _mesh_for_chunk(chunk: int):
     default, reshaped to pure data-parallel when the byte axis's sp
     split does not divide the chunk (a partial mesh reshapes, it
     never raises)."""
+    from ceph_tpu.parallel import multihost
     from ceph_tpu.parallel.mesh import make_mesh
 
     mesh = default_mesh()
     sp = dict(mesh.shape).get("sp", 1)
     if sp > 1 and chunk % sp:
         devs = list(mesh.devices.flat)
-        key = (tuple(d.id for d in devs), "dp-only")
+        key = (tuple(d.id for d in devs), "dp-only",
+               multihost.topology_signature())
         flat = _mesh_cache.get(key)
         if flat is None:
             flat = _mesh_cache[key] = make_mesh(devs, dp=len(devs),

@@ -389,11 +389,29 @@ def _spawn_process_group(nproc: int, smoke: bool,
             print(f"# multihost worker failed rc={rc}:"
                   f" {se[-800:]}", file=sys.stderr)
             return None
-    lines = [ln for ln in outs[0][1].strip().splitlines() if ln]
-    try:
-        return json.loads(lines[-1]) if lines else None
-    except json.JSONDecodeError:
+    reports = []
+    for _rc, so, _se in outs:
+        lines = [ln for ln in so.strip().splitlines() if ln]
+        try:
+            reports.append(json.loads(lines[-1]) if lines else None)
+        except json.JSONDecodeError:
+            reports.append(None)
+    rep = reports[0]
+    if rep is None:
         return None
+    # collective cross-check (armed via CEPH_TPU_COLLECTIVE_TRACE=1,
+    # inherited by the workers): every process must observe the SAME
+    # collective sequence — a divergent trace is the silent-wedge
+    # class rules_spmd.py flags statically
+    traces = [r.get("collective_trace") if r else None
+              for r in reports]
+    if all(t is not None for t in traces):
+        rep = dict(rep)
+        rep["spmd_trace"] = traces[0]
+        rep["spmd_order_congruent"] = int(
+            all(t == traces[0] for t in traces[1:]))
+        rep.pop("collective_trace", None)
+    return rep
 
 
 def worker_report(smoke: bool = True, iters: int = 3) -> dict:
@@ -431,7 +449,7 @@ def worker_report(smoke: bool = True, iters: int = 3) -> dict:
             _encode_crc(matrix, data, n)
             best = min(best, time.perf_counter() - t0)
     st = plan.stats()
-    return {
+    rep = {
         "processes": multihost.process_count(),
         "process_index": multihost.process_index(),
         "devices": n,
@@ -441,6 +459,13 @@ def worker_report(smoke: bool = True, iters: int = 3) -> dict:
         "mesh_dispatches": st["mesh_dispatches"],
         "topology": list(multihost.topology_signature()) or None,
     }
+    from ceph_tpu.analysis import interleave
+
+    if interleave.collective_trace_armed():
+        rep["collective_trace"] = [
+            [r.path, r.line, r.op]
+            for r in interleave.collective_records()]
+    return rep
 
 
 def multihost_report(processes: Optional[List[int]] = None,

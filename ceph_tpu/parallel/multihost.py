@@ -306,6 +306,21 @@ def hybrid_stripe_mesh(devices: Sequence):
     return Mesh(arr, axis_names=("dcn", "dp"))
 
 
+def _trace_collective(op: str, kind: str, topic: str = "") -> None:
+    """Runtime twin hook (analysis/interleave.py): records the
+    caller's call site at every seam entry so the multi-process
+    harness can assert runtime ⊆ static-site-map and per-process
+    order congruence.  Unarmed, this is one env read."""
+    if not (os.environ.get("CEPH_TPU_COLLECTIVE_TRACE") == "1"
+            or os.environ.get("CEPH_TPU_COLLECTIVE_TRACE_FILE")):
+        return
+    from ceph_tpu.analysis import interleave
+
+    # depth 4: _caller_site <- record_collective <- _trace_collective
+    # <- seam fn <- the caller whose site the static map must contain
+    interleave.record_collective(op, kind, topic, depth=4)
+
+
 def put_global(arr, sharding):
     """Place a host batch onto a (possibly cross-process) mesh.  The
     SPMD contract of the multi-process data plane: every process
@@ -315,6 +330,7 @@ def put_global(arr, sharding):
 
     if not is_multiprocess():
         return jax.device_put(arr, sharding)
+    _trace_collective("put_global", "put-global")
     return jax.make_array_from_callback(
         arr.shape, sharding, lambda idx: arr[idx])
 
@@ -327,6 +343,7 @@ def gather(out):
 
     if not is_multiprocess():
         return np.asarray(out)
+    _trace_collective("gather", "gather")
     if isinstance(out, (tuple, list)):
         return tuple(gather(o) for o in out)
     if getattr(out, "is_fully_addressable", True):
@@ -369,6 +386,7 @@ def agree(topic: str, payload: str,
     {0: payload} without touching any service."""
     if not is_multiprocess():
         return {0: payload}
+    _trace_collective("agree", "agreement", topic)
     client = _kv_client()
     pid = process_index()
     timeout_ms = int((timeout_s if timeout_s is not None
@@ -407,6 +425,7 @@ def agree_healthy(local_healthy_ids: Sequence[int],
     dead)."""
     if not is_multiprocess():
         return tuple(sorted(int(i) for i in local_healthy_ids)), ()
+    _trace_collective("agree_healthy", "agreement", f"healthy/{epoch}")
     reports = agree(f"healthy/{epoch}",
                     json.dumps(sorted(int(i)
                                       for i in local_healthy_ids)),
@@ -461,6 +480,7 @@ def agreed_healthy(local_healthy_ids: Sequence[int]
     local = tuple(sorted(int(i) for i in local_healthy_ids))
     if not is_multiprocess():
         return local
+    _trace_collective("agreed_healthy", "agreement")
     with _member_lock:
         round_ = _member_round
         cached = _member_cache

@@ -1,0 +1,14 @@
+"""Violation twin for collective-order: the two arms of a branch
+issue the same collectives in inverted relative order — a process
+taking the `if` arm blocks in the meta round while a peer taking the
+`else` arm blocks in the data round, and neither ever completes."""
+from ceph_tpu.parallel import multihost
+
+
+def exchange(retrying, epoch):
+    if retrying:  # expect: collective-order
+        multihost.agree(f"meta/{epoch}", "m")
+        multihost.agree(f"data/{epoch}", "d")
+    else:
+        multihost.agree(f"data/{epoch}", "d")
+        multihost.agree(f"meta/{epoch}", "m")

@@ -1,0 +1,13 @@
+"""Clean twin: both arms issue the collectives in the SAME relative
+order (the arms may differ in payloads and local work — order is the
+cross-process contract, not content)."""
+from ceph_tpu.parallel import multihost
+
+
+def exchange(retrying, epoch):
+    if retrying:
+        multihost.agree(f"meta/{epoch}", "m-retry")
+        multihost.agree(f"data/{epoch}", "d-retry")
+    else:
+        multihost.agree(f"meta/{epoch}", "m")
+        multihost.agree(f"data/{epoch}", "d")

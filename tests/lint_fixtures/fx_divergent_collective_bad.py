@@ -1,0 +1,28 @@
+"""Violation twins for divergent-collective: wedgeable collectives
+whose reachability depends on the process identity — the silent-wedge
+class (peers block forever in a collective one process never enters,
+or retire a live host whose agreement never arrived)."""
+from ceph_tpu.parallel import multihost
+
+
+def ranked_announce(epoch):
+    # only process 0 enters the agreement: every peer's per-process
+    # KV read times out and process 0's round reads the group as dead
+    if multihost.process_index() == 0:
+        multihost.agree(f"announce/{epoch}", "leader")  # expect: divergent-collective
+
+
+def bail_before_agree(epoch):
+    # process 1 raises past the collective its peers block in
+    if multihost.process_index() == 1:
+        raise RuntimeError("local bail")
+    return multihost.agree(f"round/{epoch}", "payload")  # expect: divergent-collective
+
+
+def swallowed_agreement(ids):
+    # a local exception skips the agreement and execution continues
+    # with membership state the peers don't share
+    try:
+        return multihost.agree_healthy(ids)  # expect: divergent-collective
+    except Exception:
+        pass

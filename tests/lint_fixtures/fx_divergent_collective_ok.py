@@ -1,0 +1,30 @@
+"""Clean twins: collectives under group-uniform guards (every process
+takes the same branch), explicit-verdict exception paths, and
+data-dependent predicates stay silent."""
+from ceph_tpu.parallel import multihost
+
+
+def guarded_announce(epoch):
+    # is_multiprocess() is a group-uniform kill switch: every process
+    # evaluates it identically, nobody diverges
+    if not multihost.is_multiprocess():
+        return {0: "leader"}
+    return multihost.agree(f"announce/{epoch}", "leader")
+
+
+def declined_agreement(ids):
+    # the handler RETURNS an explicit verdict — the caller sees "no
+    # agreement" instead of silently divergent state
+    try:
+        return multihost.agree_healthy(ids)
+    except Exception:
+        return None
+
+
+def batched_rounds(payloads, epoch):
+    # a data-dependent loop: identical inputs on every process (the
+    # SPMD contract callers already carry) walk identical rounds
+    out = []
+    for i, payload in enumerate(payloads):
+        out.append(multihost.agree(f"batch/{epoch}/{i}", payload))
+    return out

@@ -1,0 +1,15 @@
+"""Violation twin for topology-stale-state: a module-level cache
+keyed by the device-id set alone.  The same chips under a different
+cluster shape (1x8 vs 2x4 host domains) replay stale state after a
+shrink or a join — the flat-vs-hybrid mesh layout is a function of
+topology, not of the id set."""
+
+_mesh_cache = {}
+
+
+def cached_mesh(devs, build):
+    sig = tuple(d.id for d in devs)
+    mesh = _mesh_cache.get(sig)  # expect: topology-stale-state
+    if mesh is None:
+        mesh = _mesh_cache[sig] = build(devs)
+    return mesh

@@ -1,0 +1,16 @@
+"""Clean twin: the cache key folds in the topology signature, so a
+cluster-shape change over the same chips misses and rebuilds."""
+
+_mesh_cache = {}
+
+
+def topology_signature():
+    return ()
+
+
+def cached_mesh(devs, build):
+    sig = (tuple(d.id for d in devs), topology_signature())
+    mesh = _mesh_cache.get(sig)
+    if mesh is None:
+        mesh = _mesh_cache[sig] = build(devs)
+    return mesh

@@ -1,0 +1,14 @@
+"""Violation twins for unguarded-collective-timeout: a blocking
+coordinator-KV wait with no hard timeout, and an untimed global
+barrier — a dead host must read as a timeout verdict, never a
+wedge."""
+
+
+def wait_for_peer(client, topic, peer):
+    return client.blocking_key_value_get(f"{topic}/{peer}")  # expect: unguarded-collective-timeout
+
+
+def fleet_barrier():
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("fleet")  # expect: unguarded-collective-timeout

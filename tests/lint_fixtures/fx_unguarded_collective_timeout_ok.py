@@ -1,0 +1,14 @@
+"""Clean twins: the KV wait carries a hard timeout (the
+multihost.agree discipline), and the barrier rides the agreement
+seam, whose per-peer timed KV reads turn a dead host into a
+membership verdict."""
+from ceph_tpu.parallel import multihost
+
+
+def wait_for_peer(client, topic, peer, timeout_ms):
+    return client.blocking_key_value_get(f"{topic}/{peer}",
+                                         timeout_ms)
+
+
+def fleet_barrier(epoch):
+    return multihost.agree(f"barrier/{epoch}", "here", timeout_s=5.0)

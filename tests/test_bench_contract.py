@@ -23,7 +23,7 @@ CONTRACT_KEYS = {"metric", "value", "unit", "vs_baseline",
                  "plan_cache", "encode_service", "tier",
                  "device_health", "tail", "load", "durability",
                  "mesh", "multihost", "trace", "group_commit",
-                 "compute", "xsched", "truncated"}
+                 "compute", "xsched", "spmd", "truncated"}
 
 
 def test_contract_line_despite_hanging_backend(tmp_path):
@@ -165,6 +165,17 @@ def test_contract_line_despite_hanging_backend(tmp_path):
     assert xs["schedules"] >= 1
     assert xs["cache_hits"] >= 1
     assert xs["xors_scheduled"] < xs["xors_naive"]
+    # the SPMD collective-safety probe ran: the static collective-site
+    # map is non-empty, the 2-process smoke leg's runtime-observed
+    # collective trace was a subset of it, and every process observed
+    # the same collective order (the analyzer's runtime cross-check
+    # riding the multihost sweep)
+    sp = contract["spmd"]
+    assert sp["static_sites"] >= 5
+    assert sp["static_lines"] >= sp["static_sites"]
+    assert sp["runtime_sites"] >= 1
+    assert sp["runtime_subset_static"] == 1
+    assert sp["order_congruent"] == 1
     assert contract["truncated"] is False
     # details stayed out of stdout (they belong in bench_details.json)
     assert len(stdout_lines) == 1

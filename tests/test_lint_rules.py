@@ -44,6 +44,10 @@ RULES = [
     "cancellation-unsafe-acquire",
     "transitive-blocking-call",
     "hot-path-copy",
+    "divergent-collective",
+    "collective-order",
+    "unguarded-collective-timeout",
+    "topology-stale-state",
     "unused-suppression",
 ]
 
@@ -62,7 +66,11 @@ CONFIG = {"dtype_paths": ("fx_uint8",),
           "cancel_paths": ("fx_cancellation_unsafe_acquire",),
           "transitive_paths": ("fx_transitive_blocking_call",),
           "hot_paths": ("fx_hot_path_copy",),
-          "xsched_paths": ("fx_unscheduled_bitmatrix_xor",)}
+          "xsched_paths": ("fx_unscheduled_bitmatrix_xor",),
+          "spmd_paths": ("fx_divergent_collective",
+                         "fx_collective_order"),
+          "spmd_seam_paths": ("fx_unguarded_collective_timeout",),
+          "spmd_state_paths": ("fx_topology_stale_state",)}
 
 
 def _fixture(name: str) -> str:

@@ -1,0 +1,302 @@
+"""SPMD collective-safety tier (ISSUE 16): the static rules' runtime
+twin plus the regression tests for the real findings the analyzer
+surfaced.
+
+Four legs:
+
+* **Runtime ⊆ static + order congruence** — a REAL 2-process
+  ``jax.distributed`` group (gloo CPU collectives) runs the meshbench
+  smoke workload with the collective-trace recorder armed; every
+  in-package call site a worker observed must exist in the static
+  collective-site map, and every process must observe the SAME
+  collective sequence.
+* **Seeded-divergence self-test** — a deliberately divergent toy
+  module (process 1 raises before ``agree``) is caught by BOTH the
+  static ``divergent-collective`` rule and the multi-process replay
+  (trace incongruence), while process 0 reads the missing peer as a
+  TIMEOUT verdict, never a wedge — the BrokenBlockStore pattern for
+  the cross-process plane.
+* **Real-finding regressions** — ``ec/plan.py`` declines the mesh
+  (instead of proceeding on a divergent local view) when agreement
+  infrastructure fails; ``parallel/backend.py`` mesh caches key on
+  the topology signature so a cluster-shape change over the same
+  chips cannot replay a stale flat/hybrid mesh.
+* **Seam discipline** — an ad-hoc coordinator-KV wait outside
+  ``parallel/multihost.py`` is flagged even when it carries a
+  timeout: half-protocols must ride the agreement seam.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import ceph_tpu
+import conftest
+from ceph_tpu.analysis import analyze_paths
+from ceph_tpu.analysis.collective import collective_site_map
+from ceph_tpu.analysis.core import build_project
+
+jax = pytest.importorskip("jax")
+
+from ceph_tpu.common import circuit  # noqa: E402
+from ceph_tpu.ec import plan  # noqa: E402
+from ceph_tpu.parallel import backend, multihost  # noqa: E402
+
+PKG = os.path.dirname(os.path.abspath(ceph_tpu.__file__))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8,
+    reason="needs the conftest 8-virtual-device CPU mesh")
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("CEPH_TPU_MULTIHOST_HOSTS", raising=False)
+    circuit.reset_all()
+    yield
+    circuit.reset_all()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_pair(worker_src: str, tmp_path, extra_env=None,
+                timeout: float = 240.0):
+    """Two jax.distributed worker processes running `worker_src`;
+    returns [(rc, stdout, stderr), ...]."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(worker_src)
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS",)}
+        env.update({
+            "CEPH_TPU_MULTIHOST_COORD": f"127.0.0.1:{port}",
+            "CEPH_TPU_MULTIHOST_NPROC": "2",
+            "CEPH_TPU_MULTIHOST_PID": str(pid),
+            "CEPH_TPU_MULTIHOST_LOCAL_DEVICES": "2",
+            "CEPH_TPU_MULTIHOST_WORKER_DEADLINE_S": str(timeout),
+            "CEPH_TPU_COLLECTIVE_TRACE": "1",
+            "JAX_PLATFORMS": "cpu",
+        })
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker)], stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, env=env))
+    outs = []
+    try:
+        for p in procs:
+            so, se = p.communicate(timeout=timeout)
+            outs.append((p.returncode, so, se))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+def _results(outs):
+    reports = []
+    for rc, so, se in outs:
+        assert rc == 0, se[-2000:]
+        line = [ln for ln in so.splitlines()
+                if ln.startswith("RESULT ")][-1]
+        reports.append(json.loads(line[len("RESULT "):]))
+    return reports
+
+
+# -- leg 1: live 2-process runtime ⊆ static + order congruence ---------
+
+_LIVE_WORKER_SRC = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["CEPH_TPU_MESH_MIN_BYTES"] = "0"
+    from ceph_tpu.parallel import meshbench
+    rep = meshbench.worker_report(smoke=True, iters=1)
+    print("RESULT " + json.dumps(rep), flush=True)
+""")
+
+
+@pytest.mark.skipif(conftest.DEVICE_INJECTION,
+                    reason="spawns its own process group; injection\
+ would fail every dispatch inside it")
+def test_two_process_trace_subset_of_static_and_congruent(tmp_path):
+    """THE runtime cross-check: every collective call site two real
+    processes observe must exist in the static collective-site map,
+    and both processes must observe the SAME sequence — the runtime ⊆
+    static discipline of the lockdep and interleave checks, extended
+    to the cross-process plane."""
+    outs = _spawn_pair(_LIVE_WORKER_SRC.format(repo=REPO), tmp_path)
+    reports = _results(outs)
+    assert all(r.get("bitexact") for r in reports)
+    traces = [r.get("collective_trace") for r in reports]
+    assert all(t for t in traces), "recorder produced no records"
+    # per-process order congruence: same sites, same order
+    assert traces[0] == traces[1], (
+        "processes observed divergent collective sequences:\n"
+        f"  p0={traces[0]}\n  p1={traces[1]}")
+    # non-vacuous: the smoke leg drives agreement AND data collectives
+    ops = {row[2] for row in traces[0]}
+    assert "agreed_healthy" in ops, ops
+    assert {"put_global", "gather"} & ops, ops
+    # runtime ⊆ static
+    smap = collective_site_map(build_project([PKG]))
+    pkg_sites = {(p, ln) for p, ln, _op in traces[0]
+                 if p.startswith("ceph_tpu/")}
+    assert pkg_sites, "no in-package sites recorded"
+    unexplained = sorted(s for s in pkg_sites if s not in smap)
+    assert not unexplained, (
+        "collective sites observed at runtime but absent from the "
+        "static site map (collective.py is blind to these):\n"
+        + "\n".join(f"  {p}:{ln}" for p, ln in unexplained))
+
+
+# -- leg 2: seeded-divergence self-test --------------------------------
+
+_DIVERGENT_SRC = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from ceph_tpu.parallel import multihost
+    from ceph_tpu.analysis import interleave
+
+
+    def broken_round(epoch):
+        if multihost.process_index() == 1:
+            raise RuntimeError("divergent: bail before the agreement")
+        return multihost.agree("toy/%d" % epoch, "x", timeout_s=3.0)
+
+
+    def main():
+        assert multihost.bootstrap_from_env(), "group did not form"
+        ok, reports = 1, None
+        try:
+            reports = broken_round(0)
+        except RuntimeError:
+            ok = 0
+        trace = [[r.path, r.line, r.op]
+                 for r in interleave.collective_records()]
+        print("RESULT " + json.dumps({{
+            "pid": multihost.process_index(), "ok": ok,
+            "peer_timed_out": (None if reports is None
+                               else int(reports.get(1) is None)),
+            "trace": trace}}), flush=True)
+        # skip atexit distributed teardown: the divergent process
+        # already broke the group by design
+        sys.stdout.flush()
+        os._exit(0)
+
+
+    main()
+""")
+
+
+def _divergent_src() -> str:
+    return _DIVERGENT_SRC.format(repo=REPO)
+
+
+@pytest.mark.skipif(conftest.DEVICE_INJECTION,
+                    reason="spawns its own process group")
+def test_seeded_divergence_caught_by_replay(tmp_path):
+    """Harness self-test: one process raises before the agreement.
+    The replay must SEE the divergence (incongruent traces), and the
+    surviving process must read the missing peer as a timeout verdict
+    — completing within the deadline, never wedging."""
+    outs = _spawn_pair(_divergent_src(), tmp_path)
+    reports = _results(outs)
+    by_pid = {r["pid"]: r for r in reports}
+    assert by_pid[1]["ok"] == 0           # the seeded bail fired
+    assert by_pid[0]["ok"] == 1           # the survivor completed...
+    assert by_pid[0]["peer_timed_out"] == 1   # ...with a timeout
+    # the replay catches the divergence: the traces are incongruent
+    # (process 0 entered the agreement, process 1 never did)
+    assert by_pid[0]["trace"] != by_pid[1]["trace"]
+    assert any(op == "agree" for _p, _ln, op in by_pid[0]["trace"])
+    assert not any(op == "agree"
+                   for _p, _ln, op in by_pid[1]["trace"])
+
+
+def test_seeded_divergence_caught_statically(tmp_path):
+    """The same toy module the replay catches must be caught by the
+    static rule: the agreement follows a raise guarded by a
+    process_index branch — the divergent-collective shape."""
+    src = _divergent_src()
+    path = tmp_path / "toy_divergent_worker.py"
+    path.write_text(src)
+    agree_line = next(i for i, ln in enumerate(src.splitlines(), 1)
+                      if "multihost.agree(" in ln)
+    findings, _ = analyze_paths(
+        [str(path)], config={"spmd_paths": ("toy_divergent",)})
+    assert {(f.rule, f.line) for f in findings} == {
+        ("divergent-collective", agree_line)}
+
+
+# -- leg 3: regressions for the real findings the analyzer surfaced ----
+
+def test_agreement_failure_declines_mesh(monkeypatch):
+    """ec/plan.py finding (divergent-collective): when agreement
+    infrastructure fails in a multiprocess group, _healthy_jax_devices
+    must DECLINE the mesh (single-device plan; peers retire this
+    process by timeout) — before the fix it swallowed the exception
+    and proceeded on its unagreed LOCAL view, building a mesh its
+    peers don't share."""
+    monkeypatch.setattr(multihost, "is_multiprocess", lambda: True)
+
+    def boom(ids):
+        raise RuntimeError("coordinator unreachable")
+
+    monkeypatch.setattr(multihost, "agreed_healthy", boom)
+    assert plan._healthy_jax_devices() == []
+
+    # the agreed path still filters to the agreed subset
+    monkeypatch.setattr(multihost, "agreed_healthy",
+                        lambda ids: tuple(sorted(ids)[:1]))
+    healthy = plan._healthy_jax_devices()
+    assert [d.id for d in healthy] == \
+        sorted(d.id for d in jax.devices())[:1]
+
+
+def test_mesh_cache_keys_on_topology(monkeypatch):
+    """parallel/backend.py finding (topology-stale-state): the same
+    chip ids under a different cluster shape must rebuild the mesh —
+    before the fix the device-id-only cache key replayed the flat
+    mesh after the topology grew a second host domain (and vice
+    versa)."""
+    flat = backend.default_mesh()
+    assert "dcn" not in flat.axis_names
+    monkeypatch.setenv("CEPH_TPU_MULTIHOST_HOSTS", "2")
+    hybrid = backend.default_mesh()
+    assert "dcn" in hybrid.axis_names, (
+        "topology change over the same chips replayed the stale "
+        f"flat mesh {hybrid.axis_names}")
+    monkeypatch.delenv("CEPH_TPU_MULTIHOST_HOSTS")
+    again = backend.default_mesh()
+    assert "dcn" not in again.axis_names
+
+
+# -- leg 4: seam discipline --------------------------------------------
+
+def test_kv_wait_outside_seam_is_flagged(tmp_path):
+    """An ad-hoc coordinator-KV wait outside parallel/multihost.py is
+    flagged even WITH a timeout: half-protocols must ride the
+    multihost.agree seam (the default spmd_seam_paths scope)."""
+    src = tmp_path / "adhoc_kv.py"
+    src.write_text(
+        "def wait(client):\n"
+        "    return client.blocking_key_value_get('k', 1000)\n")
+    findings, _ = analyze_paths([str(src)])
+    assert {(f.rule, f.line) for f in findings} == {
+        ("unguarded-collective-timeout", 2)}

@@ -286,6 +286,32 @@ def test_cache_replays_only_bit_identical_trees(tmp_path):
     assert replayed3 is None
 
 
+def test_cache_ruleset_entries_are_independent(tmp_path):
+    """The cache is keyed by the active rule-set hash: a `--rules`
+    subset run stores under its own entry and must neither poison nor
+    evict the full gate's (the PR-11 poisoning fix, extended)."""
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import time\n\n\nasync def tick():\n    time.sleep(1)\n")
+    files = lint_cache.scan_hashes([str(src)])
+    cpath = str(tmp_path / ".lint_cache.json")
+    full_rules = sorted(default_rules())
+    full, _ = analyze_paths([str(src)])
+    lint_cache.save(cpath, files, full_rules, full)
+
+    # subset run: its own findings under its own entry...
+    subset, _ = analyze_paths([str(src)], rules=["async-blocking"])
+    lint_cache.save(cpath, files, ["async-blocking"], subset)
+    re_sub, _ = lint_cache.load(cpath, files, ["async-blocking"])
+    assert [f.as_dict() for f in re_sub] == \
+        [f.as_dict() for f in subset]
+    # ...and the full entry survives the subset save untouched
+    re_full, changed = lint_cache.load(cpath, files, full_rules)
+    assert changed == []
+    assert [f.as_dict() for f in re_full] == \
+        [f.as_dict() for f in full]
+
+
 def test_cli_cache_scope_and_no_cache_flag(tmp_path, monkeypatch):
     """The cache serves the default whole-package gate invocation:
     explicit path subsets never touch it (they would evict the warm
@@ -399,3 +425,72 @@ def test_interleaved_cluster_runtime_subset_of_static(
     assert not lock_violations, (
         "static lock claims not honoured at runtime: "
         f"{lock_violations[:5]}")
+
+
+# -- SPMD collective-safety: site map + baselined-finding ratchet ------
+
+SPMD_RULES = {"divergent-collective", "collective-order",
+              "unguarded-collective-timeout", "topology-stale-state"}
+
+
+def test_collective_site_map_covers_the_seam(package_analysis):
+    """The static collective-site map must see the cross-process
+    plane: the agreement seam in ec/plan.py, the data collectives
+    (put_global/gather), and the in-tree shard_map lax collective —
+    an empty or partial map would make every runtime ⊆ static
+    cross-check vacuously green."""
+    from ceph_tpu.analysis.collective import (
+        collect_sites, collective_site_map)
+
+    _, project = package_analysis
+    sites = collect_sites(project)
+    kinds = {s.kind for s in sites}
+    assert {"agreement", "put-global", "gather", "kv-wait",
+            "collective"} <= kinds, kinds
+    by_file = {s.mod.relpath.replace("\\", "/") for s in sites}
+    assert "ceph_tpu/ec/plan.py" in by_file
+    assert "ceph_tpu/parallel/multihost.py" in by_file
+    smap = collective_site_map(project)
+    assert len(smap) >= len(sites)
+    # multi-line call spans key every covered line (a runtime frame's
+    # f_lineno can land anywhere inside the call): the agree() call
+    # inside agree_healthy spans several lines and every one of them
+    # must map back to that one agreement site
+    span = [s for s in sites
+            if s.callee.endswith("multihost.agree")
+            and s.end_line > s.line]
+    assert span, "expected a multi-line agree() call in the seam"
+    rel = span[0].mod.relpath.replace("\\", "/")
+    for line in range(span[0].line, span[0].end_line + 1):
+        assert smap[(rel, line)]["kind"] == "agreement", (rel, line)
+
+
+def test_collective_ratchet_holds(package_analysis):
+    """CI gate for the SPMD rules: the count of BASELINED findings
+    from the four collective rules must not exceed
+    tools/collective_ratchet.json's ceilings (0 at PR-16 enumeration
+    time — all three real findings were fixed, not baselined), so
+    justified-away divergence hazards cannot silently accumulate as
+    the elastic-membership surface (ROADMAP item 1) grows."""
+    from collections import Counter
+
+    with open(os.path.join(os.path.dirname(PKG), "tools",
+                           "collective_ratchet.json")) as fh:
+        ratchet = json.load(fh)
+    assert set(ratchet["max_by_rule"]) == SPMD_RULES
+    with open(default_baseline_path()) as fh:
+        entries = [rec for rec in json.load(fh)["findings"]
+                   if rec["rule"] in SPMD_RULES]
+    assert len(entries) <= ratchet["max_baselined"], (
+        f"baselined SPMD findings grew to {len(entries)} > ratchet "
+        f"{ratchet['max_baselined']}: fix the divergence hazard "
+        "instead of baselining it (or lower the ratchet when fixing)")
+    by_rule = Counter(rec["rule"] for rec in entries)
+    for rule, cap in ratchet["max_by_rule"].items():
+        assert by_rule.get(rule, 0) <= cap, (
+            f"{rule}: {by_rule.get(rule, 0)} baselined findings > "
+            f"ratchet {cap}")
+    # and the package itself is CURRENTLY clean of live SPMD findings
+    findings, _ = package_analysis
+    live = [f for f in findings if f.rule in SPMD_RULES]
+    assert not live, [f.render() for f in live]
