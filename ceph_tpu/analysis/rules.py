@@ -734,12 +734,16 @@ def rule_unplanned_compute_dispatch(a: Analyzer) -> None:
 
 # modules whose XOR region programs must ride the schedule compiler
 # (ceph_tpu/ec/xsched.py): a hand-rolled row walk pays the naive XOR
-# count (no CSE), compiles nothing (no memoization) and is invisible
-# to plan.stats()["xsched"].  xsched.py holds the kill-switch naive
-# walk itself and plan.py the device lowering — the two legitimate
-# homes.
-_XSCHED_PATHS = ("ceph_tpu/ec/",)
-_XSCHED_EXEMPT = ("ec/xsched.py", "ec/plan.py")
+# count (no CSE), compiles nothing (no memoization), never reaches
+# the native fused-tape executor (xsched.execute_native) and is
+# invisible to plan.stats()["xsched"].  The OSD data path
+# (ceph_tpu/osd/) is covered too — its encode/recovery folds are the
+# hot small-op band that the native executor exists for.  xsched.py
+# holds the kill-switch naive walk itself and plan.py the device
+# lowering — the two legitimate homes; osdmap.py XORs scalar state
+# flag words, not byte regions.
+_XSCHED_PATHS = ("ceph_tpu/ec/", "ceph_tpu/osd/")
+_XSCHED_EXEMPT = ("ec/xsched.py", "ec/plan.py", "osd/osdmap.py")
 # GF-multiply callee tails: a loop that MULTIPLIES (the wide-word
 # GF(2^16/32) host matmul) is field math, not a schedulable pure-XOR
 # walk
@@ -767,13 +771,16 @@ def _loop_multiplies(loops: list) -> bool:
 
 
 def rule_unscheduled_bitmatrix_xor(a: Analyzer) -> None:
-    """Naive bitmatrix row-walk in ec/ outside xsched/plan: a loop
-    XOR-folding byte regions (`np.bitwise_xor.reduce(...)` or a
-    subscripted `^=` accumulate) re-pays the naive XOR count on every
-    call — compile the matrix once (xsched.compile_matrix, memoized
-    by sha256 signature) and run the schedule (execute_host / the
-    xor_sched plan kind).  Pure-XOR loops only: loops that also
-    GF-multiply (wide-word fields) are exempt."""
+    """Naive bitmatrix row-walk in ec/ or osd/ outside xsched/plan:
+    a loop XOR-folding byte regions (`np.bitwise_xor.reduce(...)` or
+    a subscripted `^=` accumulate) re-pays the naive XOR count on
+    every call and never reaches the native fused tape — compile the
+    matrix once (xsched.compile_matrix, memoized by sha256
+    signature) and run the schedule through the execute seam
+    (xsched.execute, which picks execute_native when the runtime is
+    built and falls back to execute_host; or the xor_sched plan
+    kind).  Pure-XOR loops only: loops that also GF-multiply
+    (wide-word fields) are exempt."""
     paths = a.config.get("xsched_paths", _XSCHED_PATHS)
     exempt = a.config.get("xsched_exempt", _XSCHED_EXEMPT)
     for mod in a.project.modules.values():
@@ -799,12 +806,14 @@ def rule_unscheduled_bitmatrix_xor(a: Analyzer) -> None:
                 continue
             a.emit("unscheduled-bitmatrix-xor", mod, node,
                    f"{what} inside a loop: a naive row walk pays "
-                   "the unoptimized XOR count on every call and "
-                   "compiles nothing — compile the bit matrix once "
+                   "the unoptimized XOR count on every call, "
+                   "compiles nothing and bypasses the native fused "
+                   "tape — compile the bit matrix once "
                    "(ceph_tpu.ec.xsched.compile_matrix, memoized by "
-                   "signature) and execute the schedule "
-                   "(xsched.execute_host or the xor_sched plan "
-                   "kind)",
+                   "signature) and run it through the execute seam "
+                   "(xsched.execute: native single-dispatch tape "
+                   "when built, execute_host fallback; or the "
+                   "xor_sched plan kind)",
                    severity="warning",
                    symbol=_enclosing_qualname(mod, node),
                    scope_line=_scope_line(mod, node))

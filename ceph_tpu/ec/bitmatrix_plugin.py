@@ -135,17 +135,66 @@ class ErasureCodeJaxBitmatrix(ErasureCode):
             cols.extend(bmx.packet_views(buf, self.w, self.packetsize))
         return cols
 
+    def _pack_arena(self, prog, src_bufs: List) -> np.ndarray:
+        """One execution arena for the native tape: ``(n_regions,
+        blocks * packetsize)`` with input columns filled from the
+        source chunks.  When blocks == 1 a chunk's bytes ARE its w
+        input regions back to back, so filling is one flat copy per
+        chunk; multi-block chunks take one strided transpose-copy per
+        chunk (block-major packets -> packet-major regions)."""
+        w, ps = self.w, self.packetsize
+        blocks = len(src_bufs[0]) // (w * ps)
+        arena = np.empty((prog.n_regions, blocks * ps), np.uint8)
+        cols = arena[:prog.n_in]
+        if blocks == 1:
+            flat = cols.reshape(len(src_bufs), w * ps)
+            for i, src in enumerate(src_bufs):
+                flat[i] = np.frombuffer(src, np.uint8)
+        else:
+            grid = cols.reshape(len(src_bufs), w, blocks, ps)
+            for i, src in enumerate(src_bufs):
+                grid[i] = (np.frombuffer(src, np.uint8)
+                           .reshape(blocks, w, ps).transpose(1, 0, 2))
+        return arena
+
+    def _unpack_arena(self, prog, arena: np.ndarray,
+                      dst_bufs: List) -> None:
+        """Write the arena's output regions back into the destination
+        chunk buffers (the inverse layout of `_pack_arena`)."""
+        w, ps = self.w, self.packetsize
+        blocks = arena.shape[1] // ps
+        rows = arena[prog.out_base:]
+        if blocks == 1:
+            flat = rows.reshape(len(dst_bufs), w * ps)
+            for j, dst in enumerate(dst_bufs):
+                np.frombuffer(dst, np.uint8)[...] = flat[j]
+        else:
+            grid = rows.reshape(len(dst_bufs), w, blocks, ps)
+            for j, dst in enumerate(dst_bufs):
+                (np.frombuffer(dst, np.uint8).reshape(blocks, w, ps)
+                 )[...] = grid[j].transpose(1, 0, 2)
+
     def _run(self, rows: np.ndarray, sched_sig: str,
              src_bufs: List, dst_bufs: List) -> None:
         """Execute `rows` over the source chunks into the destination
-        chunks: the compiled XOR schedule over packet views by
-        default, the naive row-walk under the kill switch (the
-        bit-exactness oracle) or when the matrix is too dense to
-        compile on the serving path (host_compile_allowed — cached
-        schedules aside, the pure-Python CSE must not stall the
-        event loop on a pathological geometry)."""
+        chunks: the compiled XOR schedule by default — lowered to ONE
+        fused native tape run over a packed chunk arena when the
+        native executor is built and enabled
+        (CEPH_TPU_NATIVE_XSCHED=0 falls back to the per-op host tier
+        over zero-copy packet views, bit-identical) — and the naive
+        row-walk under the kill switch (the bit-exactness oracle) or
+        when the matrix is too dense to compile on the serving path
+        (host_compile_allowed — cached schedules aside, the
+        pure-Python CSE must not stall the event loop on a
+        pathological geometry)."""
         if xsched.enabled() and xsched.host_compile_allowed(rows):
             sched = xsched.compile_matrix(rows, sig=sched_sig)
+            if xsched.native_available():
+                prog = xsched.lower_program(sched)
+                arena = self._pack_arena(prog, src_bufs)
+                xsched.execute_native(prog, arena)
+                self._unpack_arena(prog, arena, dst_bufs)
+                return
             outs = self._column_views(dst_bufs)
             xsched.execute_host(sched, self._column_views(src_bufs),
                                 outs)

@@ -24,8 +24,17 @@ byte moves.  This module is that compiler:
   re-instantiated codec or a rebuilt plan (mesh shrink, quarantine)
   never recompiles a known matrix.
 
-Two executors lower a schedule:
+Three executors lower a schedule:
 
+* the NATIVE tier (`lower_program` + `execute_native`) flattens a
+  schedule ONCE into an `XorProgram` — a flat int32 op tape of
+  ``(dst, srcA, srcB)`` region triples over a uniform region arena
+  ``(n_objects, n_regions, region_bytes)`` — memoized next to the
+  schedule in the same signature cache, and runs the whole tape in a
+  single C++ call (native/src/xor_sched.cc: word-wide uint64 XOR
+  loops, unrolled).  This is the small-op band winner: one
+  Python→native transition per BATCH instead of one numpy dispatch
+  per XOR, and the same tape replays over N packed objects;
 * the HOST tier (`execute_host`) runs the program over numpy buffer
   views — the bitmatrix trio's packet regions (models/bitmatrix
   `packet_views`) execute in place with zero stacking/transpose
@@ -35,10 +44,17 @@ Two executors lower a schedule:
   `_gf2_matmul_bytes_impl` matmul lowering) — consumers pick
   schedule-vs-matmul by the measured op count (`prefer_schedule`).
 
-Kill switch: CEPH_TPU_XSCHED=0 pins every caller to the naive
-row-walk (`naive_xor_matmul`, bit-identical output).  Stats land in
-`plan.stats()["xsched"]` — schedules compiled, cache hits,
-xors_naive vs xors_scheduled.
+`execute()` is the tier seam: native when built and enabled, host
+fallback always available.
+
+Kill switches: CEPH_TPU_XSCHED=0 pins every caller to the naive
+row-walk (`naive_xor_matmul`, bit-identical output);
+CEPH_TPU_NATIVE_XSCHED=0 pins schedule execution to the host tier
+(native and host are bit-identical too — the parity sweep in
+tests/test_xsched_native.py holds all three equal byte-for-byte).
+Stats land in `plan.stats()["xsched"]` — schedules compiled, cache
+hits, xors_naive vs xors_scheduled, native-vs-host executions and
+tape-cache hits/misses.
 
 This module must stay importable without jax (the host tier is pure
 numpy) and must not import ec/plan.py (plan imports us).
@@ -46,6 +62,7 @@ numpy) and must not import ec/plan.py (plan imports us).
 
 from __future__ import annotations
 
+import ctypes
 import hashlib
 import os
 import threading
@@ -54,12 +71,15 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ceph_tpu import native as _native
 from ceph_tpu.ec.dispatch import LruCache
 
 __all__ = [
-    "XorSchedule", "compile_matrix", "enabled", "execute_host",
-    "matrix_signature", "naive_xor_matmul", "prefer_schedule",
-    "reset_stats", "stats",
+    "XorProgram", "XorSchedule", "compile_matrix", "crc_regions_native",
+    "enabled", "execute", "execute_host", "execute_native",
+    "host_compile_allowed", "lower_program", "matrix_signature",
+    "naive_xor_matmul", "native_available", "native_enabled",
+    "prefer_schedule", "reset_stats", "stats",
 ]
 
 
@@ -67,6 +87,22 @@ def enabled() -> bool:
     """Schedule-execution kill switch (CEPH_TPU_XSCHED=0 keeps every
     consumer on the naive row-walk — bit-identical output)."""
     return os.environ.get("CEPH_TPU_XSCHED", "1") != "0"
+
+
+def native_enabled() -> bool:
+    """Native-executor kill switch (CEPH_TPU_NATIVE_XSCHED=0 pins
+    schedule execution to the host tier — bit-identical output)."""
+    return os.environ.get("CEPH_TPU_NATIVE_XSCHED", "1") != "0"
+
+
+def native_available() -> bool:
+    """True when the fused tape executor may be used: kill switch up
+    AND the native library built with xor_sched.cc (a stale cached .so
+    or a missing toolchain silently falls back to `execute_host`)."""
+    if not native_enabled():
+        return False
+    lib = _native.get_lib()
+    return lib is not None and hasattr(lib, "ceph_tpu_xsched_exec")
 
 
 def _max_ops() -> int:
@@ -161,6 +197,62 @@ class XorSchedule:
         if self.xors_naive <= 0:
             return 0.0
         return 100.0 * (1.0 - self.xors_scheduled / self.xors_naive)
+
+
+@dataclass(frozen=True)
+class XorProgram:
+    """A schedule lowered to the native executor's flat op tape.
+
+    The region index space per object: ``[0, n_in)`` input columns,
+    ``[n_in, n_in + n_slots)`` reusable temp slots, ``[out_base,
+    out_base + n_out)`` output rows — ``n_regions`` uniform regions
+    total, so an execution arena is ``(n_objects, n_regions,
+    region_bytes)`` contiguous uint8 and the SAME tape replays for
+    every packed object.  ``tape`` is C-contiguous int32 ``(n_ops,
+    3)`` triples ``(dst, a, b)``: ``b >= 0`` XOR2, ``b == -1`` copy,
+    ``b == -2`` accumulate (dst ^= a), ``a == -1`` zero fill —
+    exactly native/src/xor_sched.cc's encoding."""
+
+    sig: str
+    n_in: int
+    n_out: int
+    n_slots: int
+    n_regions: int
+    tape: np.ndarray
+    n_ops: int
+
+    @property
+    def out_base(self) -> int:
+        return self.n_in + self.n_slots
+
+
+def _lower(sched: XorSchedule) -> XorProgram:
+    """Flatten a schedule into the tape.  Schedule refs map to region
+    indices IDENTICALLY (ref < n_in is input column ref; ref >= n_in
+    is temp slot ref - n_in, which lives at region n_in + slot =
+    ref); output row r lands at region out_base + r."""
+    n_in, n_slots = sched.n_in, sched.n_slots
+    out_base = n_in + n_slots
+    ops: List[Tuple[int, int, int]] = []
+    for dst, a, b in sched.ops:
+        ops.append((n_in + dst, a, b))
+    for r, refs in enumerate(sched.outputs):
+        dst = out_base + r
+        if not refs:
+            ops.append((dst, -1, -1))
+        elif len(refs) == 1:
+            ops.append((dst, refs[0], -1))
+        else:
+            ops.append((dst, refs[0], refs[1]))
+            for extra in refs[2:]:
+                ops.append((dst, extra, -2))
+    tape = np.ascontiguousarray(np.asarray(ops, dtype=np.int32)
+                                .reshape(len(ops), 3))
+    tape.setflags(write=False)
+    return XorProgram(sig=sched.sig, n_in=n_in, n_out=sched.n_out,
+                      n_slots=n_slots,
+                      n_regions=out_base + sched.n_out, tape=tape,
+                      n_ops=len(ops))
 
 
 def prefer_schedule(sched: XorSchedule) -> bool:
@@ -308,7 +400,9 @@ def _compile(bm: np.ndarray, sig: str) -> XorSchedule:
 _lock = threading.Lock()
 _cache = LruCache(cap=256)
 _counters: Dict[str, int] = {"compiled": 0, "cache_hits": 0,
-                             "xors_naive": 0, "xors_scheduled": 0}
+                             "xors_naive": 0, "xors_scheduled": 0,
+                             "tape_hits": 0, "tape_misses": 0,
+                             "exec_native": 0, "exec_host": 0}
 
 
 def compile_matrix(bm: np.ndarray,
@@ -341,12 +435,37 @@ def compile_matrix(bm: np.ndarray,
     return sched
 
 
+def lower_program(sched: XorSchedule) -> XorProgram:
+    """The native tape of a schedule, memoized ALONGSIDE it in the
+    same signature-keyed cache (key ``sig + "/tape"``): lowering
+    happens once per matrix identity, and `clear()` drops schedules
+    and tapes together.  `stats()` counts tape hits/misses separately
+    from schedule-cache traffic so the bench attribution can name
+    where a small-op win came from."""
+    key = sched.sig + "/tape"
+    with _lock:
+        hit = _cache.peek(key)
+        if hit is not None:
+            _counters["tape_hits"] += 1
+            return hit
+    prog = _lower(sched)
+    with _lock:
+        again = _cache.peek(key)
+        if again is not None:       # racing lowering: first one wins
+            _counters["tape_hits"] += 1
+            return again
+        _cache.put(key, prog)
+        _counters["tape_misses"] += 1
+    return prog
+
+
 def stats() -> dict:
     """The `xsched` observability section plan.stats() embeds."""
     with _lock:
         out = dict(_counters)
         out["cached"] = len(_cache)
     out["enabled"] = enabled()
+    out["native_enabled"] = native_enabled()
     return out
 
 
@@ -378,6 +497,8 @@ def execute_host(sched: XorSchedule, sources: Sequence[np.ndarray],
     alias sources (the codec layers write parity/recovered chunks,
     never their inputs).  Temporaries are ``n_slots`` scratch
     buffers allocated here per call."""
+    with _lock:
+        _counters["exec_host"] += 1
     n_in = sched.n_in
     tmp: List[Optional[np.ndarray]] = [None] * sched.n_slots
 
@@ -398,6 +519,88 @@ def execute_host(sched: XorSchedule, sources: Sequence[np.ndarray],
             np.bitwise_xor(ref(refs[0]), ref(refs[1]), out=out)
             for r in refs[2:]:
                 np.bitwise_xor(out, ref(r), out=out)
+
+
+_I32P = ctypes.POINTER(ctypes.c_int32)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+_U32P = ctypes.POINTER(ctypes.c_uint32)
+
+
+def execute_native(prog: XorProgram, arena: np.ndarray) -> None:
+    """Run the whole op tape in ONE native call.
+
+    ``arena`` is ``(n_objects, n_regions, region_bytes)`` — or 2-D
+    ``(n_regions, region_bytes)`` for a single object — C-contiguous
+    uint8, input regions filled by the caller; temps and outputs are
+    produced in place.  The same tape replays for every object, so a
+    packed batch of thousands of tiny objects is one Python→native
+    transition total."""
+    if arena.ndim == 2:
+        n_objects, (n_regions, rbytes) = 1, arena.shape
+    else:
+        n_objects, n_regions, rbytes = arena.shape
+    if n_regions != prog.n_regions:
+        raise ValueError(
+            f"arena has {n_regions} regions, program needs "
+            f"{prog.n_regions}")
+    if not arena.flags.c_contiguous or arena.dtype != np.uint8:
+        raise ValueError("arena must be C-contiguous uint8")
+    lib = _native.get_lib()
+    lib.ceph_tpu_xsched_exec(
+        prog.tape.ctypes.data_as(_I32P), prog.n_ops,
+        arena.ctypes.data_as(_U8P), n_regions, rbytes, n_objects)
+    with _lock:
+        _counters["exec_native"] += 1
+
+
+def crc_regions_native(arena: np.ndarray, spans: np.ndarray,
+                       crcs: np.ndarray) -> None:
+    """Fold contiguous region spans of a FLAT arena into crc32c
+    accumulators natively: ``spans`` is ``(n, 3)`` int32 rows
+    ``(region_start, region_count, crc_slot)`` over the flattened
+    ``(total_regions, region_bytes)`` view of ``arena``; ``crcs`` is
+    the uint32 accumulator vector (callers seed it — HashInfo uses
+    0xFFFFFFFF).  Spans fold in order, so a multi-stripe shard
+    accumulates stripe by stripe exactly like ``HashInfo.append``."""
+    flat = arena.reshape(-1, arena.shape[-1])
+    spans = np.ascontiguousarray(spans, dtype=np.int32)
+    if not flat.flags.c_contiguous:
+        raise ValueError("arena must be C-contiguous")
+    if not crcs.flags.c_contiguous or crcs.dtype != np.uint32:
+        raise ValueError("crcs must be C-contiguous uint32")
+    lib = _native.get_lib()
+    lib.ceph_tpu_xsched_crc_spans(
+        flat.ctypes.data_as(_U8P), flat.shape[1],
+        spans.ctypes.data_as(_I32P), spans.shape[0],
+        crcs.ctypes.data_as(_U32P))
+
+
+def execute(sched: XorSchedule, sources: Sequence[np.ndarray],
+            outs: Sequence[np.ndarray]) -> str:
+    """The tier seam: run the program natively when the fused executor
+    is built and enabled, else `execute_host` — same signature, same
+    bytes, returns which tier ran ("native" / "host").
+
+    The native path packs sources into a fresh region arena and
+    copies outputs back out (two extra passes over the data — far
+    cheaper than one numpy dispatch per XOR in the small-op band);
+    callers that control their own layout (bitmatrix chunk packing,
+    the encode service's multi-object arenas) skip these copies by
+    calling `lower_program` + `execute_native` directly."""
+    if native_available() and len(sources):
+        rbytes = int(sources[0].nbytes)
+        if all(int(s.nbytes) == rbytes for s in sources):
+            prog = lower_program(sched)
+            arena = np.empty((prog.n_regions, rbytes), dtype=np.uint8)
+            for c, src in enumerate(sources):
+                arena[c].reshape(src.shape)[...] = src
+            execute_native(prog, arena)
+            base = prog.out_base
+            for r, out in enumerate(outs):
+                out[...] = arena[base + r].reshape(out.shape)
+            return "native"
+    execute_host(sched, sources, outs)
+    return "host"
 
 
 def naive_xor_matmul(rows: np.ndarray,

@@ -118,6 +118,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             u8p, u64, u64, u8p, u64, u64, u8p, u32p, u64, u32p]
     except AttributeError:  # stale .so without datapath.cc
         pass
+    try:  # fused XOR-schedule executor (xor_sched.cc)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.ceph_tpu_xsched_exec.restype = None
+        lib.ceph_tpu_xsched_exec.argtypes = [i32p, u64, u8p, u64, u64,
+                                             u64]
+        lib.ceph_tpu_xsched_crc_spans.restype = None
+        lib.ceph_tpu_xsched_crc_spans.argtypes = [u8p, u64, i32p, u64,
+                                                  u32p]
+    except AttributeError:  # stale .so without xor_sched.cc
+        pass
     try:  # AEAD (aesgcm.cc) — msgr2 secure mode
         for op in ("seal", "open"):
             fn = getattr(lib, f"ceph_tpu_aesgcm_{op}")

@@ -35,7 +35,7 @@ import logging
 import math
 import os
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ceph_tpu.crush.map import CRUSH_ITEM_NONE
@@ -225,7 +225,7 @@ class PGState:
         could hand two writers the same object.)"""
         entry = self.obj_locks.get(oid)
         if entry is None:
-            entry = self.obj_locks[oid] = [asyncio.Lock(), 0]
+            entry = self.obj_locks[oid] = [_ObjLock(), 0]
         return _ObjLockCtx(self.obj_locks, oid, entry)
 
     def my_shard(self, osd: int, pool_type: int) -> int:
@@ -247,8 +247,69 @@ def _lock_class(oid: str) -> str:
     return "osd.objlock"
 
 
+class _ObjLock:
+    """asyncio.Lock-equivalent mutex with a SYNCHRONOUS uncontended
+    acquire (`try_acquire`) — the object-lock half of the sub-chunk
+    write fast lane.  The async semantics mirror CPython's
+    asyncio.Lock exactly (FIFO waiter wakeup; a waiter cancelled
+    after being woken passes the wakeup on), so contended acquirers
+    behave as before; the sync path only wins the lock when it is
+    free with no waiters, which preserves FIFO fairness."""
+
+    __slots__ = ("_locked", "_waiters")
+
+    def __init__(self) -> None:
+        self._locked = False
+        self._waiters: Optional[deque] = None
+
+    def locked(self) -> bool:
+        return self._locked
+
+    def try_acquire(self) -> bool:
+        """Take the lock without suspending iff it is free and nobody
+        is queued for it (a queued waiter keeps FIFO priority)."""
+        if self._locked or self._waiters:
+            return False
+        self._locked = True
+        return True
+
+    async def acquire(self) -> bool:
+        if not self._locked and not self._waiters:
+            self._locked = True
+            return True
+        if self._waiters is None:
+            self._waiters = deque()
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.append(fut)
+        try:
+            try:
+                await fut
+            finally:
+                self._waiters.remove(fut)
+        except asyncio.CancelledError:
+            # woken then cancelled: the wakeup must not be lost
+            if not self._locked:
+                self._wake_up_first()
+            raise
+        self._locked = True
+        return True
+
+    def release(self) -> None:
+        if not self._locked:
+            raise RuntimeError("release of unlocked _ObjLock")
+        self._locked = False
+        self._wake_up_first()
+
+    def _wake_up_first(self) -> None:
+        if not self._waiters:
+            return
+        fut = self._waiters[0]
+        if not fut.done():
+            fut.set_result(True)
+
+
 class _ObjLockCtx:
-    """Context manager pairing an asyncio.Lock with a user refcount so
+    """Context manager pairing an _ObjLock with a user refcount so
     idle entries can be dropped without racing pending acquirers.
     Acquisitions feed lockdep (CEPH_TPU_LOCKDEP=1) for order-inversion
     detection."""
@@ -292,6 +353,32 @@ class _ObjLockCtx:
                 self._table.get(self._oid) is self._entry:
             del self._table[self._oid]
         return False
+
+    def try_enter(self) -> bool:
+        """Synchronous uncontended acquire — the obj-lock half of the
+        sub-chunk fast lane: same lock, refcount, eviction, and
+        lockdep discipline as `async with`, minus the coroutine
+        round trip (and minus the objlock span, which is
+        contended-only anyway).  False = contended; take the async
+        path.  Pair a True return with `exit_sync()`."""
+        if lockdep.enabled:
+            self._cls = _lock_class(self._oid)
+            self._ld_task = lockdep.acquire(self._cls)
+        if not self._entry[0].try_acquire():
+            if lockdep.enabled:
+                lockdep.release(self._cls, self._ld_task)
+            return False
+        self._entry[1] += 1
+        return True
+
+    def exit_sync(self) -> None:
+        self._entry[0].release()
+        if lockdep.enabled and getattr(self, "_cls", None):
+            lockdep.release(self._cls, getattr(self, "_ld_task", None))
+        self._entry[1] -= 1
+        if self._entry[1] == 0 and \
+                self._table.get(self._oid) is self._entry:
+            del self._table[self._oid]
 
 
 class OSDDaemon:
@@ -443,6 +530,12 @@ class OSDDaemon:
                                      True))
             and isinstance(self.scheduler,
                            sched_mod.MClockScheduler))
+        # sub-chunk op fast lane (scheduler.try_acquire + sync obj
+        # lock): identical admission/QoS accounting, minus the per-op
+        # queue/objlock coroutine micro-costs.  CEPH_TPU_OP_FAST_LANE=0
+        # pins every op to the queued path (behavioral twin).
+        self._op_fast_lane = os.environ.get(
+            "CEPH_TPU_OP_FAST_LANE", "1") != "0"
         profile_of = (
             (lambda t: self.scheduler.profile_of(
                 sched_mod.tenant_class(t)))
@@ -960,6 +1053,19 @@ class OSDDaemon:
             self.config["osd_pool_erasure_code_stripe_unit"]))
         unit = codec.get_chunk_size(k * base)
         return ec_util.StripeInfo(k, k * unit)
+
+    def _op_fast_lane_ok(self, pool, nbytes: int) -> bool:
+        """Gate for the sub-chunk client-op fast lane: EC-pool ops
+        whose payload fits in one chunk (the small-object band the
+        encode service packs into native tape batches).  Anything
+        bigger keeps the queued path — large ops are the ones mClock
+        reordering actually helps."""
+        if not self._op_fast_lane or pool.type != TYPE_ERASURE:
+            return False
+        try:
+            return nbytes <= self._sinfo(pool.id).get_chunk_size()
+        except Exception:
+            return False
 
     async def _traced_subwrite(self, osd: int, msg: Message,
                                tid: int) -> Optional[Message]:
@@ -3796,8 +3902,8 @@ class OSDDaemon:
         else:
             # QoS admit: cost scales with payload so a stream of
             # huge writes is charged accordingly (mClock item cost)
-            cost = 1.0 + sum(len(op.data) for op in msg.ops) \
-                / (1 << 20)
+            nbytes = sum(len(op.data) for op in msg.ops)
+            cost = 1.0 + nbytes / (1 << 20)
             tenant = getattr(msg, "tenant", "") or ""
             op_class = sched_mod.CLIENT
             admitted = True
@@ -3819,6 +3925,19 @@ class OSDDaemon:
             try:
                 if not admitted:
                     rc, data, out = EBUSY, b"", {}
+                elif self._op_fast_lane_ok(pool, nbytes) and \
+                        self.scheduler.try_acquire(op_class, cost):
+                    # sub-chunk fast lane: the scheduler charges the
+                    # class's dmClock tags exactly as run()'s fast
+                    # grant would (fairness accounting identical,
+                    # over-limit classes refused into the queued
+                    # path), minus the per-op lambda/coroutine round
+                    # trip the stage histograms priced on tiny writes
+                    try:
+                        rc, data, out = await self._execute_ops(
+                            state, pool, msg, conn)
+                    finally:
+                        self.scheduler.release()
                 else:
                     rc, data, out = await self.scheduler.run(
                         op_class, cost,
@@ -4266,8 +4385,21 @@ class OSDDaemon:
                              admit_epoch: Optional[int] = None,
                              snapc=None) -> Tuple[int, Dict[str, Any]]:
         # per-object lock on EVERY pool type: SnapSet updates are
-        # read-modify-write and must not race other writes or trim
-        async with state.obj_lock(oid):
+        # read-modify-write and must not race other writes or trim.
+        # Uncontended (the dominant small-write case), the lock is
+        # taken synchronously — the PR-10 stage histograms priced the
+        # per-op objlock coroutine round trip, and the contended path
+        # below is unchanged (span and all)
+        ctx = state.obj_lock(oid)
+        if ctx.try_enter():
+            try:
+                if pool.type == TYPE_ERASURE:
+                    state.extent_cache.pop(oid, None)
+                return await self._op_write_full_locked(
+                    state, pool, oid, data, admit_epoch, snapc)
+            finally:
+                ctx.exit_sync()
+        async with ctx:
             if pool.type == TYPE_ERASURE:
                 state.extent_cache.pop(oid, None)
             return await self._op_write_full_locked(

@@ -378,8 +378,121 @@ def encode_many_with_hinfo(sinfo: StripeInfo, ec_impl,
     fused = _encode_many_device(sinfo, ec_impl, items)
     if fused is not None:
         return fused
+    packed = _encode_many_bitmatrix(sinfo, ec_impl, items)
+    if packed is not None:
+        return packed
     return [encode_with_hinfo(sinfo, ec_impl, d, w, logical_len=l)
             for d, w, l in items]
+
+
+def bitmatrix_native_available(ec_impl) -> bool:
+    """True when the packed multi-object NATIVE tape tier can engage
+    for this codec — the encode service's batching gate for the
+    bitmatrix family (the device gate is device_fused_available).
+    Requires the fused native executor (built + CEPH_TPU_NATIVE_XSCHED
+    up), the schedule compiler (CEPH_TPU_XSCHED up, matrix within the
+    serving-path compile bound), and an identity chunk mapping."""
+    from ceph_tpu.ec import xsched
+
+    bm = getattr(ec_impl, "bitmatrix", None)
+    return (bm is not None
+            and getattr(ec_impl, "_sig", None) is not None
+            and not ec_impl.get_chunk_mapping()
+            and xsched.enabled()
+            and xsched.native_available()
+            and xsched.host_compile_allowed(bm))
+
+
+def _encode_many_bitmatrix(sinfo: StripeInfo, ec_impl, items):
+    """Packed multi-object tier for the bitmatrix family: EVERY stripe
+    of every item becomes one object of a single native region arena,
+    so a flushed bucket of thousands of tiny writes runs as ONE
+    compiled XOR tape call, and the per-shard HashInfo crc32c ledger
+    folds natively over arena spans in a second call.  Requires
+    single-block chunks (chunk == w * packetsize — a chunk's bytes ARE
+    its w input regions back to back, so packing is one flat copy per
+    item); anything else returns None and the caller runs the items
+    inline, bit-identically."""
+    if not bitmatrix_native_available(ec_impl):
+        return None
+    from ceph_tpu.common.buffer import StridedBuf, as_buffer
+    from ceph_tpu.ec import xsched
+
+    width = sinfo.get_stripe_width()
+    chunk = sinfo.get_chunk_size()
+    w, ps = ec_impl.w, ec_impl.packetsize
+    n = ec_impl.get_chunk_count()
+    k = width // chunk
+    if chunk != w * ps or ec_impl.get_chunk_size(width) != chunk \
+            or k != ec_impl.k:
+        return None
+    datas = []
+    stripes_of = []
+    for d, _want, _l in items:
+        d = as_buffer(d)
+        if len(d) == 0 or len(d) % width:
+            return None
+        datas.append(d)
+        stripes_of.append(len(d) // width)
+    sched = xsched.compile_matrix(ec_impl.bitmatrix, sig=ec_impl._sig)
+    prog = xsched.lower_program(sched)
+    n_regions, out_base = prog.n_regions, prog.out_base
+    total = sum(stripes_of)
+    arena = np.empty((total, n_regions, ps), dtype=np.uint8)
+    s0 = 0
+    for d, ns in zip(datas, stripes_of):
+        arena[s0:s0 + ns, :k * w, :] = \
+            np.frombuffer(d, dtype=np.uint8).reshape(ns, k * w, ps)
+        s0 += ns
+    xsched.execute_native(prog, arena)
+    # per-shard cumulative crc ledger: one span per (stripe, shard),
+    # stripe-ordered so multi-stripe shards fold like HashInfo.append
+    m = n - k
+    offs = np.concatenate([np.arange(k, dtype=np.int64) * w,
+                           out_base + np.arange(m, dtype=np.int64) * w])
+    rows = np.arange(total, dtype=np.int64)[:, None] * n_regions
+    item_of = np.repeat(np.arange(len(items), dtype=np.int64),
+                        stripes_of)
+    spans = np.empty((total * n, 3), dtype=np.int32)
+    spans[:, 0] = (rows + offs[None, :]).reshape(-1)
+    spans[:, 1] = w
+    spans[:, 2] = (item_of[:, None] * n
+                   + np.arange(n, dtype=np.int64)[None, :]).reshape(-1)
+    crcs = np.full(len(items) * n, 0xFFFFFFFF, dtype=np.uint32)
+    xsched.crc_regions_native(arena, spans, crcs)
+    results = []
+    s0 = 0
+    for (item, d, ns) in zip(items, datas, stripes_of):
+        _data, want, logical_len = item
+        src = np.frombuffer(d, dtype=np.uint8)
+        if src.flags.writeable:
+            src.setflags(write=False)
+        grid = src.reshape(ns, k, chunk)
+        it = len(results)
+        want = set(want)
+        shards: Dict[int, object] = {}
+        for i in range(n):
+            if i not in want:
+                continue
+            if i < k:
+                shards[i] = StridedBuf(grid[:, i, :])
+            else:
+                row = np.ascontiguousarray(
+                    arena[s0:s0 + ns,
+                          out_base + (i - k) * w:out_base + (i - k + 1) * w,
+                          :]).reshape(-1)
+                row.setflags(write=False)
+                shards[i] = row.data
+        hinfo = HashInfo(n)
+        hinfo.cumulative_shard_hashes = [
+            int(c) for c in crcs[it * n:(it + 1) * n]]
+        hinfo.total_chunk_size = ns * chunk
+        crc = None
+        if logical_len is not None:
+            crc = cks.crc32c(0xFFFFFFFF, memoryview(d)[:logical_len])
+        results.append((shards, hinfo, crc))
+        s0 += ns
+    return results
 
 
 def _encode_many_device(sinfo: StripeInfo, ec_impl, items):

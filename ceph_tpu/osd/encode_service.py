@@ -5,8 +5,10 @@ client write still called `ec_util.encode_with_hinfo` synchronously,
 one object at a time, on the asyncio event loop.  This service is the
 missing layer between the cluster datapath and the batched kernels:
 concurrent write handlers **await** their encodes here, requests
-accumulate during a batch window (~1ms, or until a byte budget
-fills — whichever first), then ONE flush dispatches the whole batch
+pool while a dispatch is in flight (an idle bucket dispatches
+immediately — adaptive group commit, so the small-op band never
+pays an accumulation wait it can't amortize; a ~1ms window and a
+byte budget bound the pooling), then ONE flush dispatches the batch
 through the plan-cached fused encode+crc path **off-loop**
 (asyncio.to_thread, the event loop never blocks on the device) and
 resolves each request's future with its own shards + hinfo CRCs.
@@ -25,7 +27,9 @@ mesh.
 
 Knobs (read at construction):
 
-  CEPH_TPU_ENCODE_BATCH_WINDOW_MS  accumulation window, default 1.0
+  CEPH_TPU_ENCODE_BATCH_WINDOW_MS  accumulation upper bound (the
+                                   common path is the adaptive
+                                   idle/completion flush), default 1.0
   CEPH_TPU_ENCODE_BATCH_BYTES      flush early once this many bytes
                                    are pending (default 8 MiB)
   CEPH_TPU_ENCODE_SERVICE=0        kill switch — every call runs the
@@ -33,10 +37,14 @@ Knobs (read at construction):
                                    and behavior unchanged from the
                                    un-batched daemon
 
-Degradation policy: batching only engages when the fused device tier
-can (ec_util.device_fused_available) — on CPU-only runs (no
-CEPH_TPU_FUSE_MIN_BYTES floor) every request takes the inline path,
-so existing behavior is untouched.  Backpressure is a bounded queue
+Degradation policy: batching engages when a batched tier can — the
+fused device tier (ec_util.device_fused_available) or, for the
+bitmatrix family on the hinfo write path, the packed native XOR-tape
+tier (ec_util.bitmatrix_native_available: N objects' regions pack
+into ONE arena and the whole bucket executes as a single compiled
+tape run, per-shard CRC ledger folded natively over arena spans).
+On CPU-only runs with neither tier every request takes the inline
+path, so existing behavior is untouched.  Backpressure is a bounded queue
 per profile (requests + bytes, counting in-flight batches); overflow
 **sheds to the inline path** instead of queueing unboundedly, so a
 storm degrades to today's latency rather than deadlocking.
@@ -113,17 +121,24 @@ class _Bucket:
 
     __slots__ = ("kind", "label", "sinfo", "codec", "pending",
                  "nbytes", "outstanding", "outstanding_bytes",
-                 "timer", "sem", "stats")
+                 "timer", "sem", "stats", "in_flight", "tier",
+                 "last_arrival", "ewma_gap")
 
-    def __init__(self, kind: str, label: str, sinfo, codec):
+    def __init__(self, kind: str, label: str, sinfo, codec,
+                 tier: str = "device"):
         self.kind = kind
         self.label = label
         self.sinfo = sinfo
         self.codec = codec
+        self.tier = tier
         self.pending: List[_Req] = []
         self.nbytes = 0
         self.outstanding = 0          # queued + in-flight requests
         self.outstanding_bytes = 0
+        self.in_flight = 0            # dispatched batches not yet done
+        # arrival-density tracking (the bitmatrix hot/cold router)
+        self.last_arrival: Optional[float] = None
+        self.ewma_gap: Optional[float] = None
         self.timer: Optional[asyncio.TimerHandle] = None
         # two dispatch slots: the double buffer — batch N on device,
         # batch N+1 accumulating/launching behind it
@@ -164,10 +179,11 @@ class EncodeService:
         # set by the owning daemon: flush dispatch spans (with links
         # to the ops each batch served) land in this tracer's ring
         self.tracer = None
-        self._usable_cache: Dict[int, bool] = {}
+        self._usable_cache: Dict[int, str] = {}
         self.counters = {"requests": 0, "batched": 0, "inline": 0,
-                         "shed": 0, "batches": 0, "dispatch_errors": 0,
-                         "device_fallback": 0, "mesh_batches": 0}
+                         "inline_cold": 0, "shed": 0, "batches": 0,
+                         "dispatch_errors": 0, "device_fallback": 0,
+                         "mesh_batches": 0}
 
     # -- public API (the daemon's awaited entry points) -------------------
 
@@ -179,10 +195,13 @@ class EncodeService:
         want = tuple(want)
         self.counters["requests"] += 1
         q = self._bucket_for("encode_hinfo", sinfo, codec)
+        if q is not None and self._cold_inline(q):
+            self.counters["inline_cold"] += 1
+            q = None
         if q is None or not self._admit(q, len(data)):
             self.counters["inline" if q is None else "shed"] += 1
-            # intentionally-inline degraded path (kill switch, no
-            # device tier, or backpressure shed): today's behavior.
+            # intentionally-inline path (kill switch, no batchable
+            # tier, a cold bitmatrix bucket, or backpressure shed).
             # The span names the stage — inline codec work must be
             # attributable in the histograms (the xsched bench cites
             # it), not folded invisibly into osd_op self-time
@@ -285,32 +304,45 @@ class EncodeService:
 
     # -- internals --------------------------------------------------------
 
-    def _usable(self, codec) -> bool:
+    def _usable(self, codec) -> str:
+        """The batching tier this codec can ride: "device" (fused
+        encode+crc plan), "bitmatrix" (packed native XOR tape —
+        ec_util._encode_many_bitmatrix), or "" (inline only)."""
         if not self.enabled or self._closed:
-            return False
+            return ""
         key = id(codec)
         hit = self._usable_cache.get(key)
         if hit is None:
-            hit = ec_util.device_fused_available(codec)
+            if ec_util.device_fused_available(codec):
+                hit = "device"
+            elif ec_util.bitmatrix_native_available(codec):
+                hit = "bitmatrix"
+            else:
+                hit = ""
             self._usable_cache[key] = hit
         return hit
 
     def _bucket_for(self, kind: str, sinfo, codec
                     ) -> Optional[_Bucket]:
-        if not self._usable(codec):
+        tier = self._usable(codec)
+        if not tier:
+            return None
+        # the packed native tape tier only exists for the hinfo write
+        # path: plain encode / decode stay inline for bitmatrix
+        if tier == "bitmatrix" and kind != "encode_hinfo":
             return None
         if kind == "decode" and not hasattr(codec, "decode_batch"):
             return None
         sig = codec.plan_signature() if hasattr(codec,
                                                 "plan_signature") \
-            else str(id(codec))
+            else getattr(codec, "_sig", None) or str(id(codec))
         key = (kind, sig, sinfo.get_stripe_width(),
                sinfo.get_chunk_size())
         q = self._buckets.get(key)
         if q is None:
             label = f"{kind}[{sig[:8]}] w{sinfo.get_stripe_width()}" \
                     f" c{sinfo.get_chunk_size()}"
-            q = _Bucket(kind, label, sinfo, codec)
+            q = _Bucket(kind, label, sinfo, codec, tier=tier)
             self._buckets[key] = q
         return q
 
@@ -319,6 +351,32 @@ class EncodeService:
         return (q.outstanding < self.max_queue_requests
                 and q.outstanding_bytes + nbytes
                 <= self.max_queue_bytes)
+
+    def _cold_inline(self, q: _Bucket) -> bool:
+        """Hot/cold router for the packed bitmatrix tape tier.  A
+        singleton tape batch pays the off-loop hop (task + to_thread
+        round trip, ~ms under load) to save ~0.1 ms of codec work —
+        a pure loss, so a COLD bucket (observed inter-arrival EWMA
+        wider than the batch window) runs the encode inline on the
+        caller, where the fused native tape is still one C++ call.
+        Once arrivals pack well inside the window (a true burst — the
+        hot bar is a quarter-window, so Poisson flukes at light load
+        don't seed doomed singleton batches) — or a batch is already
+        pooling/in flight to join — requests take the packed
+        multi-object path.  The device tier never routes here: its
+        per-op dispatch cost is exactly what batching amortizes."""
+        if q.tier != "bitmatrix":
+            return False
+        now = time.perf_counter()
+        if q.last_arrival is not None:
+            gap = now - q.last_arrival
+            q.ewma_gap = gap if q.ewma_gap is None \
+                else 0.5 * q.ewma_gap + 0.5 * gap
+        q.last_arrival = now
+        if q.pending or q.in_flight:
+            return False        # a batch is forming: join it
+        return q.ewma_gap is None or \
+            q.ewma_gap > self.window_s / 4.0
 
     async def _enqueue(self, q: _Bucket, payload, nbytes: int):
         loop = asyncio.get_running_loop()
@@ -329,9 +387,18 @@ class EncodeService:
         q.outstanding_bytes += nbytes
         q.stats["requests"] += 1                # type: ignore[operator]
         self.counters["batched"] += 1
-        if q.nbytes >= self.max_batch_bytes or self.window_s == 0.0:
+        if (q.nbytes >= self.max_batch_bytes or self.window_s == 0.0
+                or q.in_flight == 0):
+            # adaptive group commit: an idle bucket dispatches NOW —
+            # the small-op band must not pay the accumulation window
+            # when there is nothing to accumulate behind.  Batching
+            # still emerges under pressure: while a dispatch is in
+            # flight, arrivals pool here and the completion hook in
+            # _dispatch flushes them as one batch.
             self._flush(q)
         elif q.timer is None:
+            # upper bound only — the completion-triggered flush is
+            # the common path; the timer catches a wedged dispatch
             q.timer = loop.call_later(self.window_s, self._flush, q)
         # accumulation wait + shared dispatch, as the op saw it: one
         # stage span from enqueue to future resolution
@@ -352,10 +419,19 @@ class EncodeService:
             return
         batch, q.pending = q.pending, []
         nbytes, q.nbytes = q.nbytes, 0
+        q.in_flight += 1
         task = asyncio.get_running_loop().create_task(
             self._dispatch(q, batch, nbytes))
         self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
+
+        def _done(t, q=q):
+            self._tasks.discard(t)
+            q.in_flight -= 1
+            # completion-triggered flush: everything that pooled
+            # while this batch computed goes out as the next batch
+            if q.pending and not self._closed:
+                self._flush(q)
+        task.add_done_callback(_done)
 
     async def _dispatch(self, q: _Bucket, batch: List[_Req],
                         nbytes: int) -> None:

@@ -134,6 +134,7 @@ class OpSchedulerBase:
         self.granted: Dict[str, int] = {}
         self.shed: Dict[str, int] = {}
         self.cancelled_before_grant = 0
+        self.fast_lane: Dict[str, int] = {}
 
     def start(self) -> None:
         if self._grant_task is None:
@@ -238,6 +239,33 @@ class OpSchedulerBase:
             self._in_flight -= 1
             self._wake.set()
 
+    def try_acquire(self, op_class: str, cost: float) -> bool:
+        """Synchronous twin of run()'s uncontended fast grant — the
+        sub-chunk write fast lane.  Succeeds ONLY under the exact
+        conditions the fast grant would (nothing queued, a slot free,
+        the class's dmClock tags advanced and within limit), with
+        identical accounting: granted counts, tag charges, and the
+        queue stage span all land as if run() had fast-granted, so
+        QoS fairness and the per-stage histograms cannot drift between
+        lanes.  The caller MUST pair a True return with release()."""
+        if self._stopping or self._nqueued != 0 or \
+                self._in_flight >= self.max_concurrent or \
+                not self._fast_charge(op_class, max(cost, 1.0)):
+            return False
+        self._in_flight += 1
+        self.granted[op_class] = self.granted.get(op_class, 0) + 1
+        self.fast_lane[op_class] = self.fast_lane.get(op_class, 0) + 1
+        q_span = tracing.start_child(
+            f"queue.{stage_class(op_class)}", cls=op_class)
+        q_span.set_attr("fast", True)
+        q_span.finish()
+        return True
+
+    def release(self) -> None:
+        """Release a try_acquire slot (mirrors run()'s finally)."""
+        self._in_flight -= 1
+        self._wake.set()
+
     # -- subclass surface --------------------------------------------------
 
     def _enqueue(self, op_class: str, item: _Item) -> None:
@@ -275,6 +303,7 @@ class OpSchedulerBase:
             "granted": dict(self.granted),
             "queue_shed": dict(self.shed),
             "cancelled_before_grant": self.cancelled_before_grant,
+            "fast_lane": dict(self.fast_lane),
         }
 
     async def _grant_loop(self) -> None:

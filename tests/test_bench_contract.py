@@ -165,6 +165,16 @@ def test_contract_line_despite_hanging_backend(tmp_path):
     assert xs["schedules"] >= 1
     assert xs["cache_hits"] >= 1
     assert xs["xors_scheduled"] < xs["xors_naive"]
+    # the native fused-tape executor leg: when the C++ executor is
+    # buildable (it is, in CI) the lowered tape ran bit-exactly on a
+    # packed multi-object arena AND through the execute() seam, with
+    # the tape memo serving the re-lower
+    assert xs["native_available"] in (0, 1)
+    if xs["native_available"]:
+        assert xs["native_bitexact"] == 1
+        assert xs["exec_native"] >= 2
+        assert xs["tape_misses"] >= 1
+        assert xs["tape_hits"] >= 1
     # the SPMD collective-safety probe ran: the static collective-site
     # map is non-empty, the 2-process smoke leg's runtime-observed
     # collective trace was a subset of it, and every process observed
@@ -239,6 +249,9 @@ def test_budget_truncates_optional_sections(tmp_path):
     # pre-contract and still rides, budget permitting)
     assert "xsched" in details["skipped_sections"]
     assert "xsched_sweep" not in details
+    # and the small-op open-loop section
+    assert "smallop" in details["skipped_sections"]
+    assert "smallop_modes" not in details
 
 
 def test_watchdog_contract_line_survives_outer_kill(tmp_path):

@@ -433,6 +433,35 @@ SPMD_RULES = {"divergent-collective", "collective-order",
               "unguarded-collective-timeout", "topology-stale-state"}
 
 
+def test_unscheduled_xor_rule_covers_osd_data_path(tmp_path):
+    """The unscheduled-bitmatrix-xor rule gates the OSD data path,
+    not just ec/: a naive XOR row-walk under ceph_tpu/osd/ must fire
+    (the native fused tape is the hot small-op band), while
+    osdmap.py's scalar state-flag XORs stay exempt."""
+    pkg = tmp_path / "ceph_tpu"
+    osd = pkg / "osd"
+    osd.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (osd / "__init__.py").write_text("")
+    (osd / "naive.py").write_text(
+        "import numpy as np\n\n\n"
+        "def fold(rows, srcs, acc):\n"
+        "    for r in rows:\n"
+        "        acc[:] ^= srcs[r]\n"
+        "    return acc\n")
+    (osd / "osdmap.py").write_text(
+        "def apply_inc(state, inc):\n"
+        "    for osd, bits in inc.items():\n"
+        "        state[osd] ^= bits\n"
+        "    return state\n")
+    findings, _ = analyze_paths(
+        [str(osd / "naive.py"), str(osd / "osdmap.py")],
+        rules=["unscheduled-bitmatrix-xor"])
+    hits = {(f.path, f.rule) for f in findings}
+    assert hits == {("ceph_tpu/osd/naive.py",
+                     "unscheduled-bitmatrix-xor")}, hits
+
+
 def test_collective_site_map_covers_the_seam(package_analysis):
     """The static collective-site map must see the cross-process
     plane: the agreement seam in ec/plan.py, the data collectives

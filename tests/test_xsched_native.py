@@ -1,0 +1,378 @@
+"""Native fused XOR-tape executor (native/src/xor_sched.cc lowered
+via ec/xsched.py lower_program/execute_native): bit-parity of the
+native tape against execute_host AND naive_xor_matmul across the
+bitmatrix (technique, k, w) space and random matrices, the packed
+multi-object arena path (ec_util._encode_many_bitmatrix) against
+per-item encode_with_hinfo, the CEPH_TPU_NATIVE_XSCHED=0 kill switch
+/ automatic host fallback, the crc-span folding kernel against
+direct crc32c folds, and the tape-cache + native-vs-host executor
+counters in xsched.stats().
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import xsched
+from ceph_tpu.ec.registry import create_erasure_code
+from ceph_tpu.ops import checksum as cks
+from ceph_tpu.osd import ec_util
+
+RNG = np.random.default_rng(0xFA57)
+
+NATIVE = xsched.native_available()
+needs_native = pytest.mark.skipif(
+    not NATIVE, reason="native xor_sched executor not built")
+
+
+def _codec(technique: str, **extra):
+    profile = {"plugin": "ec_jax", "technique": technique, "k": "4",
+               "m": "2", "packetsize": "32", "tpu": "false"}
+    profile.update({k: str(v) for k, v in extra.items()})
+    return create_erasure_code(profile)
+
+
+def _exec_host(sched: xsched.XorSchedule,
+               pk: np.ndarray) -> np.ndarray:
+    """Host tier over a (B, C, ps) packet stack -> (B, R, ps)."""
+    b, c, ps = pk.shape
+    out = np.zeros((b, sched.n_out, ps), dtype=np.uint8)
+    xsched.execute_host(sched, [pk[:, i, :] for i in range(c)],
+                        [out[:, r, :] for r in range(sched.n_out)])
+    return out
+
+
+def _exec_native(sched: xsched.XorSchedule,
+                 pk: np.ndarray) -> np.ndarray:
+    """Native tape over the same packet stack: each of the B packet
+    blocks is one arena object (the multi-object replay path)."""
+    b, c, ps = pk.shape
+    prog = xsched.lower_program(sched)
+    arena = np.zeros((b, prog.n_regions, ps), dtype=np.uint8)
+    arena[:, :c, :] = pk
+    xsched.execute_native(prog, arena)
+    return np.ascontiguousarray(arena[:, prog.out_base:, :])
+
+
+# -- tape lowering invariants ------------------------------------------
+
+
+def test_lowered_tape_shape_and_region_space():
+    bm = (RNG.integers(0, 2, (10, 24), dtype=np.uint8))
+    sched = xsched.compile_matrix(bm)
+    prog = xsched.lower_program(sched)
+    assert prog.sig == sched.sig
+    assert prog.n_in == sched.n_in and prog.n_out == sched.n_out
+    assert prog.n_slots == sched.n_slots
+    assert prog.out_base == prog.n_in + prog.n_slots
+    assert prog.n_regions == prog.out_base + prog.n_out
+    assert prog.tape.dtype == np.int32
+    assert prog.tape.shape == (prog.n_ops, 3)
+    assert prog.tape.flags.c_contiguous
+    assert not prog.tape.flags.writeable
+    # every dst is a temp slot or an output region, never an input
+    assert int(prog.tape[:, 0].min()) >= prog.n_in
+    # every output region is written at least once
+    written = set(prog.tape[:, 0].tolist())
+    for r in range(prog.n_out):
+        assert prog.out_base + r in written
+
+
+def test_tape_cache_hits_and_misses_counted():
+    bm = RNG.integers(0, 2, (8, 16), dtype=np.uint8)
+    sched = xsched.compile_matrix(bm)
+    xsched.clear()
+    sched = xsched.compile_matrix(bm)  # repopulate schedule cache
+    xsched.reset_stats()
+    p1 = xsched.lower_program(sched)
+    p2 = xsched.lower_program(sched)
+    st = xsched.stats()
+    assert st["tape_misses"] == 1 and st["tape_hits"] == 1
+    assert p1 is p2  # memoized artifact, not a re-lowering
+
+
+# -- bit-parity: native vs host vs naive -------------------------------
+
+
+@needs_native
+@pytest.mark.parametrize("shape,ps,b", [
+    ((8, 16), 64, 1), ((14, 28), 32, 3), ((24, 48), 16, 7),
+    ((6, 64), 128, 2),
+])
+def test_random_matrix_parity_three_tiers(shape, ps, b):
+    """naive row-walk == host schedule == native tape, byte for byte,
+    including multi-object arenas (b packed objects per run)."""
+    for trial in range(6):
+        bm = RNG.integers(0, 2, shape, dtype=np.uint8)
+        pk = RNG.integers(0, 256, (b, shape[1], ps), dtype=np.uint8)
+        want = xsched.naive_xor_matmul(bm, pk)
+        sched = xsched.compile_matrix(bm)
+        assert np.array_equal(_exec_host(sched, pk), want)
+        assert np.array_equal(_exec_native(sched, pk), want)
+
+
+@needs_native
+@pytest.mark.parametrize("technique,k,w", [
+    ("liberation", 4, 7), ("liberation", 7, 11),
+    ("blaum_roth", 4, 6), ("blaum_roth", 6, 10),
+    ("liber8tion", 4, 8), ("liber8tion", 8, 8),
+])
+def test_bitmatrix_family_parity_sweep(technique, k, w):
+    """(k, m, w) sweep over the bitmatrix trio: the codec's generator
+    matrix runs identically through all three executors."""
+    codec = _codec(technique, k=k, w=w)
+    bm = codec.bitmatrix
+    ps = 32
+    pk = RNG.integers(0, 256, (2, k * w, ps), dtype=np.uint8)
+    want = xsched.naive_xor_matmul(bm, pk)
+    sched = xsched.compile_matrix(bm, sig=codec._sig)
+    assert np.array_equal(_exec_host(sched, pk), want)
+    assert np.array_equal(_exec_native(sched, pk), want)
+
+
+@needs_native
+@pytest.mark.parametrize("technique,w,blocks", [
+    ("liberation", 7, 1), ("liberation", 7, 3),
+    ("blaum_roth", 6, 2), ("liber8tion", 8, 1), ("liber8tion", 8, 4),
+])
+def test_codec_encode_parity_native_vs_host_vs_naive(
+        monkeypatch, technique, w, blocks):
+    """Full-codec parity: encode under the native tape, the host tier
+    (CEPH_TPU_NATIVE_XSCHED=0), and the naive row-walk
+    (CEPH_TPU_XSCHED=0) produces identical chunks — single-block and
+    multi-block chunk geometries both."""
+    ps = 32
+    # k=4 chunks of `blocks` w-packet blocks each (blocks==1 is the
+    # flat-copy packing fast path, >1 the strided transpose copy)
+    payload = bytes(RNG.integers(
+        0, 256, 4 * w * ps * blocks, dtype=np.uint8))
+
+    def encode(**env):
+        for key in ("CEPH_TPU_XSCHED", "CEPH_TPU_NATIVE_XSCHED"):
+            monkeypatch.delenv(key, raising=False)
+        for key, val in env.items():
+            monkeypatch.setenv(key, val)
+        codec = _codec(technique, w=w, packetsize=ps)
+        out = codec.encode(range(codec.k + codec.m), payload)
+        return {i: bytes(b) for i, b in out.items()}
+
+    native = encode()
+    host = encode(CEPH_TPU_NATIVE_XSCHED="0")
+    naive = encode(CEPH_TPU_XSCHED="0")
+    assert native == host == naive
+
+
+@needs_native
+def test_codec_decode_parity_all_erasures(monkeypatch):
+    """Decode schedules (inverted submatrices) hold the same parity
+    across every 1- and 2-erasure pattern."""
+    import itertools
+
+    codec = _codec("liber8tion", w=8, packetsize=32)
+    n = codec.k + codec.m
+    payload = bytes(RNG.integers(0, 256, codec.get_alignment() * 2,
+                                 dtype=np.uint8))
+    encoded = codec.encode(range(n), payload)
+    chunk_len = len(encoded[0])
+    for erased in itertools.combinations(range(n), 2):
+        avail = {i: bytes(encoded[i]) for i in range(n)
+                 if i not in erased}
+        got_native = codec.decode(range(n), avail, chunk_len)
+        monkeypatch.setenv("CEPH_TPU_NATIVE_XSCHED", "0")
+        got_host = codec.decode(range(n), dict(avail), chunk_len)
+        monkeypatch.delenv("CEPH_TPU_NATIVE_XSCHED")
+        for i in range(n):
+            assert bytes(got_native[i]) == bytes(encoded[i]), erased
+            assert bytes(got_host[i]) == bytes(encoded[i]), erased
+
+
+# -- the execute() tier seam + kill switch -----------------------------
+
+
+@needs_native
+def test_execute_seam_picks_native_and_counts_it():
+    bm = RNG.integers(0, 2, (6, 12), dtype=np.uint8)
+    sched = xsched.compile_matrix(bm)
+    pk = RNG.integers(0, 256, (1, 12, 64), dtype=np.uint8)
+    outs = np.zeros((1, 6, 64), dtype=np.uint8)
+    xsched.reset_stats()
+    tier = xsched.execute(sched, [pk[:, i, :] for i in range(12)],
+                          [outs[:, r, :] for r in range(6)])
+    assert tier == "native"
+    st = xsched.stats()
+    assert st["exec_native"] == 1 and st["exec_host"] == 0
+    assert np.array_equal(outs, xsched.naive_xor_matmul(bm, pk))
+
+
+def test_kill_switch_falls_back_to_host(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_NATIVE_XSCHED", "0")
+    assert not xsched.native_enabled()
+    assert not xsched.native_available()
+    bm = RNG.integers(0, 2, (6, 12), dtype=np.uint8)
+    sched = xsched.compile_matrix(bm)
+    pk = RNG.integers(0, 256, (1, 12, 64), dtype=np.uint8)
+    outs = np.zeros((1, 6, 64), dtype=np.uint8)
+    xsched.reset_stats()
+    tier = xsched.execute(sched, [pk[:, i, :] for i in range(12)],
+                          [outs[:, r, :] for r in range(6)])
+    assert tier == "host"
+    st = xsched.stats()
+    assert st["exec_host"] == 1 and st["exec_native"] == 0
+    assert st["native_enabled"] is False
+    assert np.array_equal(outs, xsched.naive_xor_matmul(bm, pk))
+
+
+@needs_native
+def test_execute_seam_host_on_ragged_sources():
+    """Mixed-size source views cannot share one uniform region arena:
+    the seam must quietly take the host tier, same bytes."""
+    bm = np.array([[1, 1, 0], [0, 1, 1]], dtype=np.uint8)
+    sched = xsched.compile_matrix(bm)
+    srcs = [RNG.integers(0, 256, 64, dtype=np.uint8),
+            RNG.integers(0, 256, 64, dtype=np.uint8),
+            RNG.integers(0, 256, 1, dtype=np.uint8)]  # ragged nbytes
+    outs = [np.zeros(64, dtype=np.uint8), np.zeros(64, dtype=np.uint8)]
+    tier = xsched.execute(sched, srcs, outs)
+    assert tier == "host"
+    assert np.array_equal(outs[0], srcs[0] ^ srcs[1])
+    assert np.array_equal(outs[1], srcs[1] ^ srcs[2])
+
+
+# -- the crc-span folding kernel ---------------------------------------
+
+
+@needs_native
+def test_crc_spans_match_direct_folds():
+    """crc_regions_native folds (start, count, slot) spans exactly
+    like sequential ceph_tpu crc32c over the same bytes — including
+    multiple spans accumulating into ONE slot in order (the
+    multi-stripe shard ledger)."""
+    arena = RNG.integers(0, 256, (3, 5, 64), dtype=np.uint8)
+    flat = arena.reshape(-1, 64)
+    spans = np.array([
+        (0, 2, 0),       # regions 0-1 -> slot 0
+        (3, 1, 1),       # region 3 -> slot 1
+        (5, 2, 0),       # regions 5-6 APPEND into slot 0
+        (14, 1, 2),      # last region -> slot 2
+    ], dtype=np.int32)
+    crcs = np.full(3, 0xFFFFFFFF, dtype=np.uint32)
+    xsched.crc_regions_native(arena, spans, crcs)
+    want = [0xFFFFFFFF] * 3
+    for start, count, slot in spans.tolist():
+        chunk = np.ascontiguousarray(
+            flat[start:start + count]).reshape(-1)
+        want[slot] = cks.crc32c(want[slot], chunk.data)
+    assert crcs.tolist() == want
+
+
+# -- the packed multi-object encode tier -------------------------------
+
+
+def _bitmatrix_codec_and_sinfo(k=4, w=8, ps=512):
+    codec = _codec("liber8tion", k=k, w=w, packetsize=ps)
+    chunk = w * ps
+    return codec, ec_util.StripeInfo(k, k * chunk), chunk
+
+
+@needs_native
+def test_packed_multi_object_parity_with_inline():
+    """_encode_many_bitmatrix: shards, cumulative per-shard CRC
+    ledger, total_chunk_size and logical data crc all byte-identical
+    to per-item encode_with_hinfo — ragged per-item stripe counts
+    included."""
+    codec, sinfo, chunk = _bitmatrix_codec_and_sinfo()
+    width = sinfo.get_stripe_width()
+    n = codec.k + codec.m
+    want = list(range(n))
+    items = []
+    for stripes in (1, 3, 1, 2, 5, 1):
+        d = bytes(RNG.integers(0, 256, stripes * width,
+                               dtype=np.uint8))
+        items.append((d, want, len(d) - 7))
+    packed = ec_util._encode_many_bitmatrix(sinfo, codec, items)
+    assert packed is not None
+    assert ec_util.bitmatrix_native_available(codec)
+    for (shards, hinfo, crc), (d, w_, l) in zip(packed, items):
+        ws, wh, wc = ec_util.encode_with_hinfo(sinfo, codec, d, w_,
+                                               logical_len=l)
+        assert crc == wc
+        assert hinfo.total_chunk_size == wh.total_chunk_size
+        assert hinfo.cumulative_shard_hashes == \
+            wh.cumulative_shard_hashes
+        for i in range(n):
+            assert bytes(shards[i]) == bytes(ws[i]), i
+
+
+@needs_native
+def test_packed_tier_routes_through_encode_many():
+    """encode_many_with_hinfo reaches the packed tier for bitmatrix
+    codecs (one exec_native for the whole batch) and matches it."""
+    codec, sinfo, chunk = _bitmatrix_codec_and_sinfo()
+    width = sinfo.get_stripe_width()
+    items = [(bytes(RNG.integers(0, 256, width, dtype=np.uint8)),
+              list(range(6)), width) for _ in range(9)]
+    xsched.reset_stats()
+    outs = ec_util.encode_many_with_hinfo(sinfo, codec, items)
+    st = xsched.stats()
+    assert st["exec_native"] == 1     # ONE tape run for all 9 objects
+    direct = ec_util._encode_many_bitmatrix(sinfo, codec, items)
+    for (shards, hinfo, crc), (ds, dh, dc) in zip(outs, direct):
+        assert crc == dc
+        assert hinfo.cumulative_shard_hashes == \
+            dh.cumulative_shard_hashes
+        for i in range(6):
+            assert bytes(shards[i]) == bytes(ds[i])
+
+
+@needs_native
+def test_packed_tier_refuses_bad_geometry(monkeypatch):
+    """Multi-block chunks, unaligned items and the kill switch all
+    return None (callers fall back inline, bit-identically)."""
+    codec, sinfo, chunk = _bitmatrix_codec_and_sinfo()
+    width = sinfo.get_stripe_width()
+    good = [(bytes(RNG.integers(0, 256, width, dtype=np.uint8)),
+             [0, 1], None)]
+    # chunk != w*ps: a 2-block stripe geometry
+    big = ec_util.StripeInfo(codec.k, codec.k * chunk * 2)
+    assert ec_util._encode_many_bitmatrix(big, codec, [
+        (bytes(RNG.integers(0, 256, chunk * 2 * codec.k,
+                            dtype=np.uint8)), [0], None)]) is None
+    # item not stripe-aligned / empty
+    assert ec_util._encode_many_bitmatrix(
+        sinfo, codec, [(b"x" * (width - 1), [0], None)]) is None
+    assert ec_util._encode_many_bitmatrix(
+        sinfo, codec, [(b"", [0], None)]) is None
+    # kill switch: gate closes entirely
+    monkeypatch.setenv("CEPH_TPU_NATIVE_XSCHED", "0")
+    assert not ec_util.bitmatrix_native_available(codec)
+    assert ec_util._encode_many_bitmatrix(sinfo, codec, good) is None
+    monkeypatch.delenv("CEPH_TPU_NATIVE_XSCHED")
+    monkeypatch.setenv("CEPH_TPU_XSCHED", "0")
+    assert not ec_util.bitmatrix_native_available(codec)
+    monkeypatch.delenv("CEPH_TPU_XSCHED")
+    # non-bitmatrix codecs never qualify
+    rs = create_erasure_code({"plugin": "ec_jax",
+                              "technique": "reed_sol_van", "k": "4",
+                              "m": "2", "tpu": "false"})
+    assert not ec_util.bitmatrix_native_available(rs)
+
+
+@needs_native
+def test_packed_tier_data_shards_are_views_parity_immutable():
+    """Data shards come back as zero-copy strided views of the frozen
+    source and parity buffers are read-only — store-adoptable, like
+    the datapath tier's contract."""
+    codec, sinfo, chunk = _bitmatrix_codec_and_sinfo()
+    width = sinfo.get_stripe_width()
+    d = bytes(RNG.integers(0, 256, 2 * width, dtype=np.uint8))
+    [(shards, hinfo, _)] = ec_util._encode_many_bitmatrix(
+        sinfo, codec, [(d, list(range(6)), None)])
+    for i in range(4):
+        got = bytes(shards[i])
+        stripes = np.frombuffer(d, np.uint8).reshape(2, 4, chunk)
+        assert got == np.ascontiguousarray(
+            stripes[:, i, :]).tobytes()
+    for j in (4, 5):
+        mv = memoryview(shards[j])
+        assert mv.readonly and len(mv) == 2 * chunk
