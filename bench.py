@@ -72,6 +72,7 @@ def _emit_contract(value: Optional[float],
                    compute: Optional[dict] = None,
                    xsched: Optional[dict] = None,
                    spmd: Optional[dict] = None,
+                   repair: Optional[dict] = None,
                    truncated: bool = False) -> None:
     """Print the one-line JSON driver contract, exactly once, before
     any optional extended benches run — a wedged tunnel or a crashed
@@ -103,7 +104,10 @@ def _emit_contract(value: Optional[float],
     XOR-count reduction and memo hits), spmd the collective-safety
     cross-check (static collective-site map non-empty, the 2-process
     smoke leg's runtime-observed collective trace ⊆ the static map,
-    per-process order congruence);
+    per-process order congruence), repair the MSR regenerating-codec
+    probe (every single-erasure pattern rebuilt bit-exact from d
+    beta-fragments, with the measured bytes-read-per-repaired-byte
+    ratio vs the classic k-read);
     truncated flags a budget-shortened run.  Thread-safe:
     the deadline watchdog and the bench body may race to emit."""
     global _contract_emitted
@@ -131,6 +135,7 @@ def _emit_contract(value: Optional[float],
             "compute": compute,
             "xsched": xsched,
             "spmd": spmd,
+            "repair": repair,
             "truncated": bool(truncated),
         }), flush=True)
 
@@ -436,6 +441,109 @@ def bench_degraded() -> dict:
     }
 
 
+def bench_repair() -> dict:
+    """Repair-bandwidth-optimal recovery end to end: a live MSR
+    (k=4 m=3 d=6) pool loses one OSD; the repair-aware recovery
+    engine rebuilds each lost chunk from d beta-fragments (d/alpha =
+    2 chunks of payload per rebuilt chunk vs the classic k-read's 4),
+    then the same scenario runs with CEPH_TPU_MSR_REPAIR=0 for the
+    classic k-read baseline.  Reports bytes-read-per-repaired-byte
+    for both legs, the recovery wall clock, and the recover_read /
+    recover_decode stage histograms the daemons recorded."""
+    import asyncio
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_helpers import Cluster
+
+    n_objs = 4 if _SMOKE else 12
+    osize = (16 << 10) if _SMOKE else (192 << 10)
+    profile = {"plugin": "ec_msr", "k": "4", "m": "3", "d": "6",
+               "crush-failure-domain": "osd"}
+
+    async def leg() -> dict:
+        cluster = Cluster(num_osds=9)
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool("msr", profile=profile,
+                                                pg_num=8)
+            io = cluster.client.open_ioctx("msr")
+            rng = np.random.default_rng(0xD6)
+            payloads = {
+                f"o{i}": rng.integers(0, 256, osize + 31 * i,
+                                      dtype=np.uint8).tobytes()
+                for i in range(n_objs)}
+            for oid, b in payloads.items():
+                await io.write_full(oid, b)
+            await cluster.kill_osd(0)
+            await cluster.wait_for_osd_down(0)
+            t0 = time.monotonic()
+            await cluster.client.mon_command(
+                {"prefix": "osd out", "osd": 0})
+            await cluster.wait_for_clean(120)
+            wall = time.monotonic() - t0
+            for oid, b in payloads.items():
+                assert await io.read(oid) == b, f"{oid} corrupt"
+            perf = {key: sum(o.perf[key]
+                             for o in cluster.osds.values())
+                    for key in ("recovery_bytes_read",
+                                "recovery_bytes_repaired",
+                                "repair_objects", "repair_fragments",
+                                "repair_fallbacks")}
+            stages: dict = {}
+            for osd in cluster.osds.values():
+                for st, row in osd.tracer.stage_perf().items():
+                    if st not in ("recover_read", "recover_decode"):
+                        continue
+                    agg = stages.setdefault(
+                        st, {"count": 0, "sum_s": 0.0, "p99_ms": 0.0})
+                    agg["count"] += row["count"]
+                    agg["sum_s"] += row["self_seconds"].get("sum", 0.0)
+                    agg["p99_ms"] = max(agg["p99_ms"], row["p99_ms"])
+            return {"wall_s": wall, "perf": perf, "stages": stages}
+        finally:
+            await cluster.stop()
+
+    def bytes_ratio(leg_out: dict) -> Optional[float]:
+        made = leg_out["perf"]["recovery_bytes_repaired"]
+        return round(leg_out["perf"]["recovery_bytes_read"] / made, 3) \
+            if made else None
+
+    # each leg runs twice: the first pays the one-time XLA traces of
+    # the repair/decode plans (plan memoization is process-global and
+    # the re-run's geometry matches exactly), the second is the
+    # steady-state measurement — what a long-lived OSD actually sees
+    on_cold = asyncio.run(leg())
+    on = asyncio.run(leg())
+    saved = os.environ.get("CEPH_TPU_MSR_REPAIR")
+    os.environ["CEPH_TPU_MSR_REPAIR"] = "0"
+    try:
+        off_cold = asyncio.run(leg())
+        off = asyncio.run(leg())
+    finally:
+        if saved is None:
+            os.environ.pop("CEPH_TPU_MSR_REPAIR", None)
+        else:
+            os.environ["CEPH_TPU_MSR_REPAIR"] = saved
+    r_on, r_off = bytes_ratio(on), bytes_ratio(off)
+    return {
+        "repair_bytes_per_repaired_byte": r_on,
+        "repair_kread_bytes_per_repaired_byte": r_off,
+        "repair_vs_kread_bytes": round(r_on / r_off, 3)
+        if r_on and r_off else None,
+        "repair_objects": on["perf"]["repair_objects"],
+        "repair_fragments": on["perf"]["repair_fragments"],
+        "repair_fallbacks": on["perf"]["repair_fallbacks"],
+        "repair_recovery_wall_s": round(on["wall_s"], 3),
+        "repair_kread_recovery_wall_s": round(off["wall_s"], 3),
+        "repair_recovery_cold_wall_s": round(on_cold["wall_s"], 3),
+        "repair_kread_recovery_cold_wall_s": round(
+            off_cold["wall_s"], 3),
+        "repair_stages": on["stages"],
+        "repair_kread_stages": off["stages"],
+    }
+
+
 def _probe_on_daemon_thread(name: str, body, timeout_env: str,
                             default_timeout: str) -> Optional[dict]:
     """Run a pre-contract probe body on a DAEMON thread under a hard
@@ -530,6 +638,54 @@ def _tier_probe_body() -> dict:
            ("records", "hit", "miss", "promote", "evict")}
     out["device_bitexact"] = device_bitexact
     return out
+
+
+def _repair_probe() -> Optional[dict]:
+    """Pre-contract probe of the product-matrix MSR regenerating
+    codec (ec/msr.py): every single-erasure pattern of a k=4 m=3 d=6
+    profile must rebuild bit-exact from d beta-fragments, and the
+    fragment bytes must land exactly on the MSR bound (d/alpha per
+    chunk — half the classic k-read here).  Counters land in the
+    contract line's repair key; None (with a stderr note) when the
+    probe cannot run.
+
+    Contract-first discipline (same as _tier_probe): skipped when the
+    wall-clock budget is spent, and the body — whose matmuls may ride
+    a device plan — runs on a daemon thread under a hard timeout."""
+    return _probe_on_daemon_thread(
+        "repair", _repair_probe_body,
+        "CEPH_TPU_BENCH_REPAIR_PROBE_TIMEOUT", "60")
+
+
+def _repair_probe_body() -> dict:
+    from ceph_tpu.ec.registry import create_erasure_code
+
+    k, m, d = 4, 3, 6
+    n = k + m
+    codec = create_erasure_code({"plugin": "ec_msr", "k": str(k),
+                                 "m": str(m), "d": str(d)})
+    alpha = codec.get_sub_chunk_count()
+    rng = np.random.default_rng(0x4E7)
+    data = rng.integers(0, 256, (1 << 14) if _SMOKE else (1 << 18),
+                        dtype=np.uint8).tobytes()
+    enc = codec.encode(range(n), data)
+    chunks = {i: bytes(enc[i]) for i in range(n)}
+    frag_bytes = kread_bytes = patterns = 0
+    for lost in range(n):
+        helpers = codec.minimum_to_repair(
+            lost, [i for i in range(n) if i != lost])
+        frags = {h: codec.repair_project(lost, chunks[h])
+                 for h in helpers}
+        assert codec.repair(lost, frags) == chunks[lost], \
+            f"repair mismatch for shard {lost}"
+        frag_bytes += sum(len(f) for f in frags.values())
+        kread_bytes += k * len(chunks[lost])
+        patterns += 1
+    return {
+        "patterns_bitexact": patterns,
+        "k": k, "m": m, "d": d, "alpha": alpha,
+        "bytes_ratio_vs_kread": round(frag_bytes / kread_bytes, 4),
+    }
 
 
 def _hedge_probe() -> Optional[dict]:
@@ -2873,6 +3029,10 @@ def main() -> None:
     # schedules bit-exact vs the naive row-walk across the bitmatrix
     # family, with the measured XOR-count reduction + memo hits
     xsched_counters = _xsched_probe()
+    # MSR regenerating-codec probe (before the contract): every
+    # single-erasure pattern rebuilt bit-exact from d beta-fragments,
+    # fragment bytes on the product-matrix bound (0.5x the k-read)
+    repair_counters = _repair_probe()
 
     # the driver contract line, before every optional/extended bench:
     # a wedge below this point can cost detail rows, never the bench
@@ -2890,6 +3050,7 @@ def main() -> None:
                    compute=compute_counters,
                    xsched=xsched_counters,
                    spmd=spmd_counters,
+                   repair=repair_counters,
                    truncated=skip_optional)
 
     # decode sweep over 1..m erasures (the reference benchmark sweeps
@@ -3071,6 +3232,18 @@ def main() -> None:
         except Exception as e:
             print(f"# degraded bench failed: {e!r}", file=sys.stderr)
 
+    # repair-bandwidth section: live MSR pool loses an OSD, the
+    # repair-aware recovery's bytes-read-per-repaired-byte + wall
+    # clock vs the CEPH_TPU_MSR_REPAIR=0 classic k-read baseline
+    repair_section: dict = {}
+    if skip_optional:
+        skipped_sections.append("repair")
+    else:
+        try:
+            repair_section = bench_repair()
+        except Exception as e:
+            print(f"# repair bench failed: {e!r}", file=sys.stderr)
+
     # open-loop load sweep: the same tenant population at doubling
     # arrival rates until the knee (goodput stops tracking offered)
     load_section: dict = {}
@@ -3135,6 +3308,7 @@ def main() -> None:
         **xsched_section,
         **smallop_section,
         **degraded_section,
+        **repair_section,
         **load_section,
         **durability_section,
         **qos_section,
@@ -3150,6 +3324,7 @@ def main() -> None:
         "group_commit": group_commit_counters,
         "compute": compute_counters,
         "xsched": xsched_counters,
+        "repair": repair_counters,
         "host_cores": os.cpu_count(),
         "encode_ms_per_batch": t_enc * 1e3,
         "k": k, "m": m, "chunk_bytes": chunk, "batch": batch,

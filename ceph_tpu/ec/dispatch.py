@@ -100,6 +100,41 @@ def _device_matmul(mat: np.ndarray, data: np.ndarray,
     return out if status == "ok" else None
 
 
+def gf_repair_matmul(mat: np.ndarray, data: np.ndarray,
+                     use_tpu: bool = True, min_bytes: int = 1,
+                     sig: Optional[str] = None, use_plan: bool = True,
+                     family: str = "ec-repair") -> np.ndarray:
+    """Repair-kind twin of gf_matmul for the regenerating-code path:
+    helper-side projections (1 x alpha) and primary-side
+    reconstructions (alpha x d) dispatch through the `repair` plan
+    kind (ec/plan.py), where the small per-erasure-pattern matrix is
+    a compile-time constant baked into the trace — memoized by codec
+    signature + erasure pattern, xsched-compiled when the bit
+    expansion wins.  Rides its own `ec-repair` breaker family so a
+    repair-path fault never degrades the encode/decode data path;
+    while degraded (or when the guarded dispatch fails) the call
+    takes the bit-exact numpy host fold below, so callers NEVER see
+    a device error from this entry.
+    """
+    if use_tpu and gf.backend_available() and data.size >= min_bytes:
+        if not circuit.degraded(family):
+            if use_plan:
+                from ceph_tpu.ec import plan
+
+                if plan.enabled():
+                    out = plan.repair(mat, data, sig=sig, family=family)
+                    if out is not None:
+                        return out
+        else:
+            circuit.breaker(family).note_fallback()
+    if data.ndim == 2:
+        return gf.gf_matmul_host(mat, data)
+    b, k, s = data.shape
+    flat = np.ascontiguousarray(np.moveaxis(data, 1, 0)).reshape(k, b * s)
+    par = gf.gf_matmul_host(mat, flat)
+    return np.moveaxis(par.reshape(-1, b, s), 0, 1)
+
+
 class LruCache:
     """Tiny bounded LRU (decode tables keyed by erasure signature,
     GF multiply tables, compiled ExecPlans).  Overflow evicts the

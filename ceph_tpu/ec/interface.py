@@ -115,6 +115,15 @@ class ErasureCode:
     def get_sub_chunk_count(self) -> int:
         return 1
 
+    def supports_fractional_repair(self) -> bool:
+        """True when the codec can rebuild ONE lost chunk from
+        sub-chunk fractions of d >= k helpers (the regenerating-code
+        repair API: minimum_to_repair / repair_project / repair)
+        instead of k full chunks.  The recovery engine gates its
+        repair-aware path on this; everything else keeps the classic
+        k-read reconstruct."""
+        return False
+
     def get_alignment(self) -> int:
         raise NotImplementedError
 

@@ -1184,6 +1184,90 @@ def compute_eval(name: str, weights: np.ndarray, data: np.ndarray,
     return np.asarray(out)[:b, :, :lanes]
 
 
+def _build_repair(key: tuple, matrix: np.ndarray) -> ExecPlan:
+    """The `repair` plan kind: a regenerating-code repair matmul —
+    helper-side projection rows or the primary's reconstruction
+    matrix — where the matrix is a COMPILE-TIME constant like the
+    compute kind's weight row (the key carries its content
+    signature, so one plan serves every wave of the same codec +
+    erasure pattern).  Repair matrices are tiny (alpha x d), so
+    baking them lets XLA fold the bit expansion into the trace
+    instead of shipping a runtime operand per dispatch."""
+    mbits = jnp.asarray(gf.gf_matrix_to_bits(
+        np.ascontiguousarray(matrix, dtype=np.uint8)))
+    jfn = tracked_jit(_label(key),
+                      lambda d: gf._gf2_matmul_bytes_impl(mbits, d))
+    return ExecPlan(key, jfn, "xla_bits_const")
+
+
+def repair(mat: np.ndarray, data, sig: Optional[str] = None,
+           family: str = "ec-repair") -> Optional[np.ndarray]:
+    """(B, D, S) or (D, S) uint8 helper fragments x the (R, D) repair
+    matrix -> lost sub-chunk rows, plan-cached (kind `repair`).
+
+    The plan key hashes the MATRIX CONTENT (the caller's sig rides as
+    a cache-locality extra only) because the matrix is baked into the
+    trace — correctness must not depend on callers keeping sigs
+    matrix-unique.  Same schedule-vs-matmul pick as the encode kind:
+    a sparse bit expansion whose compiled XOR program wins by op
+    count dispatches as an xor_sched plan instead.  Returns None when
+    no jax backend is available, the plan key is quarantined, or the
+    guarded dispatch failed (callers take the bit-exact host path);
+    RESOURCE_EXHAUSTED halves the batch recursively first."""
+    if not (HAVE_JAX and gf.backend_available()):
+        return None
+    if not isinstance(data, np.ndarray):
+        return None
+    arr = np.asarray(data, dtype=np.uint8)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[None]
+    if arr.ndim != 3:
+        return None
+    b, kk, s = arr.shape
+    if s == 0 or b == 0:
+        return None
+    mat = np.ascontiguousarray(np.asarray(mat, dtype=np.uint8))
+    rows = mat.shape[0]
+    sig = matrix_signature(mat, extra=sig or "repair")
+
+    def halve() -> Optional[np.ndarray]:
+        h = b // 2
+        first = repair(mat, arr[:h], sig=sig, family=family)
+        second = repair(mat, arr[h:], sig=sig, family=family)
+        if first is None or second is None:
+            return None
+        out = np.concatenate([first, second], axis=0)
+        return out[0] if squeeze else out
+
+    sched = _sched_for(mat)
+    if sched is not None and xsched.prefer_schedule(sched):
+        skey = plan_key(sched.sig, "xor_sched", rows, kk, b, s)
+        if _quarantined(skey):
+            return None
+        splan = _get_plan(skey, lambda: _build_xor_sched(skey, sched))
+        padded = jnp.asarray(_pad_batch(arr, skey[4], skey[5]))
+        status, out = _guarded(family, skey, splan, (padded,), b)
+        if status == "oom" and b > 1:
+            return halve()
+        if status != "ok":
+            return None
+        out = np.asarray(out)[:b, :, :s]
+        return out[0] if squeeze else out
+    key = plan_key(sig, "repair", rows, kk, b, s)
+    if _quarantined(key):
+        return None
+    plan = _get_plan(key, lambda: _build_repair(key, mat))
+    padded = jnp.asarray(_pad_batch(arr, key[4], key[5]))
+    status, out = _guarded(family, key, plan, (padded,), b)
+    if status == "oom" and b > 1:
+        return halve()
+    if status != "ok":
+        return None
+    out = np.asarray(out)[:b, :, :s]
+    return out[0] if squeeze else out
+
+
 def _build_mesh_matmul(key: tuple) -> ExecPlan:
     """Delegate to the healthy-set sharded pipeline (its per-shape
     jits are tracked_jit'd in parallel/striped.py, so retraces land in

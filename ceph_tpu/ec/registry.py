@@ -171,9 +171,17 @@ def _register_builtin(reg: ErasureCodePluginRegistry) -> None:
         codec.init(profile)
         return codec
 
+    def msr_factory(profile: ErasureCodeProfile) -> ErasureCode:
+        from ceph_tpu.ec.msr import ErasureCodeMsr
+
+        codec = ErasureCodeMsr()
+        codec.init(profile)
+        return codec
+
     reg.add("lrc", ErasureCodePlugin("lrc", lrc_factory))
     reg.add("shec", ErasureCodePlugin("shec", shec_factory))
     reg.add("clay", ErasureCodePlugin("clay", clay_factory))
+    reg.add("ec_msr", ErasureCodePlugin("ec_msr", msr_factory))
 
 
 def create_erasure_code(profile: ErasureCodeProfile) -> ErasureCode:

@@ -549,8 +549,9 @@ class MOSDSubRead(Message):
 
     TAG = 13
 
-    VERSION = 4  # v2 appends want_omap; v3 appends record (hit-set);
-    #              v4 the blkin-role trace context
+    VERSION = 5  # v2 appends want_omap; v3 appends record (hit-set);
+    #              v4 the blkin-role trace context; v5 the repair
+    #              sub-chunk fraction spec (regenerating-code reads)
     COMPAT = 1
 
     def __init__(self, tid: int, pg: PgId, shard: int, oid: str,
@@ -571,6 +572,13 @@ class MOSDSubRead(Message):
         self.record = record
         # blkin-role trace context: (trace_id, parent span id) or None
         self.trace: Optional[tuple] = None
+        # repair-fragment read: (lost chunk id, expected sub-chunk
+        # count alpha) or None.  When set the replica projects its
+        # stored chunk against the codec's repair vector and ships the
+        # beta = chunk/alpha byte fragment instead of the full chunk;
+        # an alpha mismatch (profile drift) answers EOPNOTSUPP so the
+        # primary falls back to the classic k-read path
+        self.repair: Optional[tuple] = None
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u64(self.tid)
@@ -584,6 +592,8 @@ class MOSDSubRead(Message):
         enc.bool(self.record)
         enc.optional(self.trace,
                      lambda e, v: (e.u64(v[0]), e.u64(v[1])))
+        enc.optional(self.repair,
+                     lambda e, v: (e.s32(v[0]), e.u32(v[1])))
 
     @classmethod
     def decode(cls, data: bytes) -> "MOSDSubRead":
@@ -597,6 +607,8 @@ class MOSDSubRead(Message):
             msg.record = dec.bool()
         if struct_v >= 4:
             msg.trace = dec.optional(lambda d: (d.u64(), d.u64()))
+        if struct_v >= 5:
+            msg.repair = dec.optional(lambda d: (d.s32(), d.u32()))
         dec.finish()
         return msg
 

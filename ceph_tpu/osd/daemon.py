@@ -38,6 +38,8 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+import numpy as np
+
 from ceph_tpu.crush.map import CRUSH_ITEM_NONE
 from ceph_tpu.ec.registry import create_erasure_code
 from ceph_tpu.common import buffer as buffer_mod
@@ -108,6 +110,7 @@ ESTALE = -116
 EIO = -5
 EBUSY = -16
 EINVAL = -22
+EOPNOTSUPP = -95
 
 DEFAULTS = {
     "osd_heartbeat_interval": 1.0,
@@ -450,7 +453,20 @@ class OSDDaemon:
                      # the log-based-vs-backfill discriminator: a
                      # revived OSD with an intact store recovers only
                      # the log diff, not the whole PG
-                     "recovery_installs": 0}
+                     "recovery_installs": 0,
+                     # repair-bandwidth accounting (ALL codecs): bytes
+                     # the recovery engine pulled over the wire vs
+                     # bytes of lost chunks it rebuilt — the scrapeable
+                     # bytes-read-per-repaired-byte ratio the
+                     # regenerating-code path is judged by
+                     "recovery_bytes_read": 0,
+                     "recovery_bytes_repaired": 0,
+                     # fractional-repair engine: waves served by the
+                     # MSR repair path vs objects that fell back to
+                     # the classic k-read reconstruct
+                     "repair_fragments": 0,
+                     "repair_objects": 0,
+                     "repair_fallbacks": 0}
         # async micro-batching encode/decode front end: concurrent EC
         # ops share plan-cached device dispatches; inline (pre-service
         # behavior) when the device tier is absent or
@@ -2024,6 +2040,9 @@ class OSDDaemon:
                 await conn.send(MOSDSubReadReply(
                     msg.tid, ENOENT, shard=msg.shard))
                 return
+        if getattr(msg, "repair", None) is not None:
+            await self._answer_repair_read(conn, msg, pool)
+            return
         rc, data, attrs = self._read_shard(
             msg.pg, msg.shard, msg.oid,
             msg.offset if msg.length else 0, msg.length)
@@ -2037,6 +2056,39 @@ class OSDDaemon:
         await conn.send(MOSDSubReadReply(
             msg.tid, rc, data, attrs if msg.want_attrs else {},
             shard=msg.shard, omap=omap))
+
+    async def _answer_repair_read(self, conn: Connection,
+                                  msg: MOSDSubRead, pool) -> None:
+        """Helper side of regenerating-code repair: read my full
+        chunk, project it against the codec's repair vector for the
+        lost chunk, ship the beta = chunk/alpha byte fragment.  Any
+        mismatch with the primary's view of the codec (no fractional
+        repair, alpha drift, misaligned chunk) answers EOPNOTSUPP —
+        the primary treats that helper as failed and, past d
+        survivors, falls back to the classic k-read path."""
+        lost, alpha = msg.repair
+        rc, data, attrs = self._read_shard(msg.pg, msg.shard, msg.oid,
+                                           0, 0)
+        if rc == 0:
+            codec = self._codec(pool.id) if pool is not None else None
+            if codec is None or \
+                    not getattr(codec, "supports_fractional_repair",
+                                lambda: False)() or \
+                    codec.get_sub_chunk_count() != alpha or \
+                    len(data) % max(alpha, 1):
+                rc, data = EOPNOTSUPP, b""
+            else:
+                try:
+                    frag = await asyncio.to_thread(
+                        codec.repair_project, lost, data)
+                    self.perf["repair_fragments"] += 1
+                    data = frag
+                except Exception:
+                    rc, data = EOPNOTSUPP, b""
+        await conn.send(MOSDSubReadReply(
+            msg.tid, rc, data if rc == 0 else b"",
+            attrs if msg.want_attrs and rc == 0 else {},
+            shard=msg.shard))
 
     # -- peering -----------------------------------------------------------
 
@@ -2557,7 +2609,8 @@ class OSDDaemon:
 
     async def _gather_stray_shards(
             self, state: PGState, pool, oid: str,
-            have: Set[Tuple[int, int]]
+            have: Set[Tuple[int, int]],
+            length: int = 0
     ) -> Tuple[List[Tuple[int, bytes, Dict[str, bytes]]], bool]:
         """Search shards OUTSIDE the acting mapping: prior-interval
         members may hold the only up-to-date copies after several
@@ -2582,7 +2635,8 @@ class OSDDaemon:
                        for o in range(self.osdmap.max_osd)
                        if self.osdmap.exists(o))
         jobs = [self._read_candidates(pg, shard, osd, oid,
-                                      include_rollback=True)
+                                      include_rollback=True,
+                                      length=length)
                 for osd in self.osdmap.get_up_osds()
                 for shard in shard_list
                 if (shard, osd) not in have]
@@ -3185,11 +3239,9 @@ class OSDDaemon:
             if version is None:
                 return False  # genuinely below k: recovery/rollback
                 # adjudication owns this on the next peering
-            try:
-                chosen_k = ec_util.fastest_survivors(
-                    codec, chosen, k, prefer=self._shard_rank(state))
-            except Exception:
-                chosen_k = {s: chosen[s] for s in sorted(chosen)[:k]}
+            chosen_k = ec_util.choose_decode_set(
+                codec, chosen, k, prefer=self._shard_rank(state),
+                first_k=True)
             plan = {"kind": "ec", "oid": oid, "targets": targets,
                     "i_need": True, "guard": guard,
                     "chosen": chosen_k,
@@ -3316,9 +3368,11 @@ class OSDDaemon:
                     if plan is not None:
                         plans.append(plan)
                 reconstructed = await self._batch_reconstruct(
-                    pool, [p for p in plans if p["kind"] == "ec"])
+                    pool, [p for p in plans
+                           if p["kind"] in ("ec", "ec_repair")])
                 plans = [p for p in plans
-                         if p["kind"] != "ec" or p in reconstructed]
+                         if p["kind"] not in ("ec", "ec_repair")
+                         or p in reconstructed]
                 # commits run OUTSIDE the QoS scheduler: object locks
                 # are held here, and client ops blocked on those locks
                 # sit inside scheduler slots — commits queued behind
@@ -3357,25 +3411,55 @@ class OSDDaemon:
         plan = await self._recover_plan(state, pool, oid, peer_shards)
         if plan is None:
             return
-        if plan["kind"] == "ec" and \
+        if plan["kind"] in ("ec", "ec_repair") and \
                 not await self._batch_reconstruct(pool, [plan]):
             return
         await self._recover_commit(state, pool, plan)
 
     async def _recover_plan(self, state: PGState, pool, oid: str,
-                            peer_shards: Dict[int, int]
+                            peer_shards: Dict[int, int],
+                            allow_repair: bool = True
                             ) -> Optional[Dict[str, Any]]:
         """Locate and select an object's authoritative copy; returns a
-        commit plan or None (unfound — stays missing)."""
+        commit plan or None (unfound — stays missing).
+
+        allow_repair=False forces the classic full-chunk plan even for
+        regenerating codecs — the recursion target when the repair
+        fast path hits a complication (too few helpers, fragment
+        fetch/verify failure)."""
         pg = state.pg
         plog = self._load_log(state, pool)
         state.extent_cache.pop(oid, None)  # recovery rewrites shards
+        targets = [(shard_key, osd)
+                   for shard_key, osd in peer_shards.items()
+                   if oid in state.peer_missing.get(shard_key, {})]
+        i_need = oid in plog.missing
+        # REPAIR-AWARE probe sizing: when every missing target is the
+        # SAME single chunk of a regenerating codec, the plan needs
+        # only versions and attrs from the survivors — 1-byte thin
+        # reads — because the payload will be rebuilt from beta-size
+        # repair fragments shipped by d helpers, never from full
+        # chunks.  Any complication downgrades to the classic plan.
+        repair_lost: Optional[int] = None
+        if allow_repair and pool.type == TYPE_ERASURE and \
+                self._repair_enabled():
+            codec0 = self._codec(pool.id)
+            lost_set = {sk for sk, _o in targets}
+            if i_need:
+                lost_set.add(state.my_shard(self.osd_id, pool.type))
+            if len(lost_set) == 1 and \
+                    codec0.supports_fractional_repair():
+                cand = next(iter(lost_set))
+                if 0 <= cand < codec0.get_chunk_count():
+                    repair_lost = cand
+        probe_len = 1 if repair_lost is not None else 0
+        t_read = time.monotonic()
         # include_rollback: an acked write that later partial writes
         # pushed off some heads may survive only in acting members'
         # rollback generations — recovery (and especially the
         # no-version purge decision below) must see them
         candidates, acting_complete = await self._gather_object_shards(
-            state, pool, oid, include_rollback=True)
+            state, pool, oid, include_rollback=True, length=probe_len)
         # always search strays during recovery: after several remaps the
         # newest acked version may exist only on prior-interval members
         have = set()
@@ -3383,13 +3467,11 @@ class OSDDaemon:
             if osd != CRUSH_ITEM_NONE:
                 have.add((idx if pool.type == TYPE_ERASURE else -1, osd))
         strays, stray_complete = await self._gather_stray_shards(
-            state, pool, oid, have)
+            state, pool, oid, have, length=probe_len)
         candidates += strays
+        self.tracer.record_stages(
+            {"recover_read": int((time.monotonic() - t_read) * 1e6)})
         probes_complete = acting_complete and stray_complete
-        targets = [(shard_key, osd)
-                   for shard_key, osd in peer_shards.items()
-                   if oid in state.peer_missing.get(shard_key, {})]
-        i_need = oid in plog.missing
         # the newest version the PG log says was acked — recovery may
         # not install anything OLDER unless every possible source was
         # probed (otherwise a stale stray copy silently rolls back an
@@ -3475,8 +3557,12 @@ class OSDDaemon:
 
         codec = self._codec(pool.id)
         k = codec.get_data_chunk_count()
+        # thin probes carry 1-byte payloads, so the per-shard CRC
+        # ledger cannot be checked here; the repair path instead
+        # verifies the REBUILT stream against the ledger and falls
+        # back to this plan (full reads, verify_hinfo) on mismatch
         version, chosen, _oi = self._select_consistent(
-            candidates, need=k, verify_hinfo=True)
+            candidates, need=k, verify_hinfo=repair_lost is None)
         if version is None:
             if not probes_complete:
                 # not enough same-version shards REACHABLE yet: the
@@ -3516,15 +3602,41 @@ class OSDDaemon:
                 " located %s, probes incomplete — possible source"
                 " down)", self.osd_id, pg, oid, need_v, version)
             return None
+        if repair_lost is not None:
+            # rank the helper pool by the hedge tracker's EWMAs (the
+            # same octave-quantized key the decode survivor choice
+            # uses) and keep every eligible shard: the fragment fetch
+            # hedges over the tail as straggler replacements
+            rank = self._shard_rank(state)
+            acting = list(state.acting)
+            helper_pool = [
+                s for s in sorted(chosen, key=rank)
+                if s != repair_lost and 0 <= s < len(acting)
+                and acting[s] != CRUSH_ITEM_NONE
+                and self.osdmap.is_up(acting[s])]
+            if len(helper_pool) >= codec.repair_degree():
+                return {"kind": "ec_repair", "oid": oid,
+                        "targets": targets, "i_need": i_need,
+                        "lost": repair_lost,
+                        "helpers": [(s, acting[s])
+                                    for s in helper_pool],
+                        "guard": guard,
+                        "attrs": _attrs_of(version, chosen),
+                        "version": version, "omap": None, "pg": pg,
+                        "state": state,
+                        "peer_shards": dict(peer_shards)}
+            # fewer than d up acting helpers hold this version: the
+            # repair math needs exactly d, so take the classic k-read
+            # plan (which may also use strays/rollback generations)
+            return await self._recover_plan(
+                state, pool, oid, peer_shards, allow_repair=False)
         # normalize to k shards (what decode consumes) pulled from the
         # FASTEST survivor set — the hedge tracker's EWMA rank is
         # stable across a wave, so equal survivor sets batch together
         # exactly as the old first-k normalization did
-        try:
-            chosen_k = ec_util.fastest_survivors(
-                codec, chosen, k, prefer=self._shard_rank(state))
-        except Exception:
-            chosen_k = {s: chosen[s] for s in sorted(chosen)[:k]}
+        chosen_k = ec_util.choose_decode_set(
+            codec, chosen, k, prefer=self._shard_rank(state),
+            first_k=True)
         return {"kind": "ec", "oid": oid, "targets": targets,
                 "i_need": i_need, "chosen": chosen_k, "guard": guard,
                 "attrs": _attrs_of(version, chosen), "omap": None}
@@ -3541,19 +3653,61 @@ class OSDDaemon:
         writes) share device dispatches.  A group whose batch fails
         falls back to per-object decode so one malformed object cannot
         livelock the rest of the PG; returns the plans that got
-        payloads."""
+        payloads.
+
+        `ec_repair` plans take the regenerating-code leg first
+        (_batch_repair: beta-size fragments from d helpers, one
+        plan-cached dispatch per helper set); a repair that cannot
+        complete is RE-PLANNED classic (allow_repair=False, full reads
+        + hinfo verify) in place and rejoins the decode leg — the
+        caller's plan identity is preserved by mutating the dict."""
         if not ec_plans:
             return []
+        repair_plans = [p for p in ec_plans if p["kind"] == "ec_repair"]
+        ec_plans = [p for p in ec_plans if p["kind"] != "ec_repair"]
+        done_repair: List[Dict[str, Any]] = []
+        if repair_plans:
+            repaired, fallbacks = await self._batch_repair(
+                pool, repair_plans)
+            done_repair.extend(repaired)
+            for p in fallbacks:
+                self.perf["repair_fallbacks"] += 1
+                try:
+                    p2 = await self._recover_plan(
+                        p["state"], pool, p["oid"], p["peer_shards"],
+                        allow_repair=False)
+                except Exception:
+                    log.exception(
+                        "osd.%d: classic re-plan of %s after repair"
+                        " fallback failed", self.osd_id, p["oid"])
+                    continue
+                if p2 is None:
+                    continue
+                p.clear()
+                p.update(p2)
+                if p["kind"] == "ec":
+                    ec_plans.append(p)
+                else:
+                    # adjudicated remove: needs no reconstruct, commit
+                    # handles it — but it must count as "done" so the
+                    # wave's commit phase keeps the plan
+                    done_repair.append(p)
+        if not ec_plans:
+            return done_repair
         codec = self._codec(pool.id)
         sinfo = self._sinfo(pool.id)
         n = codec.get_chunk_count()
         chunk = sinfo.get_chunk_size()
         width = sinfo.get_stripe_width()
         maps = [p["chosen"] for p in ec_plans]
+        for p in ec_plans:
+            self.perf["recovery_bytes_read"] += sum(
+                len(b) for b in p["chosen"].values())
         # one fold per distinct survivor set (the service/ec_util
         # decode_many contract), counted as such
         self.perf["decode_dispatches"] += len(
             {tuple(sorted(m)) for m in maps})
+        t_dec = time.monotonic()
         results = await self.encode_service.decode_many(sinfo, codec,
                                                         maps)
         datas: Dict[str, bytes] = {}
@@ -3611,7 +3765,153 @@ class OSDDaemon:
                     log.exception("osd.%d: re-encode of %s failed",
                                   self.osd_id, p["oid"])
             done = done2
-        return done
+        self.tracer.record_stages(
+            {"recover_decode": int((time.monotonic() - t_dec) * 1e6)})
+        return done + done_repair
+
+    def _repair_enabled(self) -> bool:
+        """Repair-aware recovery kill switch: CEPH_TPU_MSR_REPAIR=0
+        (env) or osd_msr_repair_enable=false (config) forces the
+        classic k-read reconstruct for every object.  Results are
+        bit-identical either way — repair and full decode agree by
+        construction — so the switch exists for triage, not safety."""
+        if os.environ.get("CEPH_TPU_MSR_REPAIR", "1") == "0":
+            return False
+        return bool(self.config.get("osd_msr_repair_enable", True))
+
+    async def _batch_repair(
+            self, pool, plans: List[Dict[str, Any]]
+    ) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]]]:
+        """Regenerating-code leg of _batch_reconstruct: fetch beta =
+        chunk/alpha byte fragments from d helpers per object (hedged —
+        stragglers recruit the next-ranked helper), then rebuild every
+        lost chunk with ONE plan-cached dispatch per (lost, helper
+        set) group: fragment streams of same-group objects concatenate
+        along the byte axis, so cross-object batching is free exactly
+        as in the decode leg.  Returns (done, fallbacks); fallback
+        plans re-enter planning as classic full reads.
+
+        The rebuilt stream is verified against the shard's crc32c
+        ledger (hinfo) before it counts — fragments themselves cannot
+        be CRC-checked, so a corrupt helper surfaces HERE and demotes
+        the object to the verified classic path."""
+        codec = self._codec(pool.id)
+        alpha = codec.get_sub_chunk_count()
+        d = codec.repair_degree()
+        t_read = time.monotonic()
+
+        async def fetch_one(plan: Dict[str, Any]
+                            ) -> Optional[Dict[int, bytes]]:
+            pg, oid = plan["pg"], plan["oid"]
+            lost, want_v = plan["lost"], plan["version"]
+
+            async def frag_job(shard: int, osd: int):
+                ts = time.monotonic()
+                if osd == self.osd_id:
+                    rc, data, at = self._read_shard(pg, shard, oid,
+                                                    0, 0)
+                    self.hedge.observe(osd, time.monotonic() - ts,
+                                       ok=rc in (0, ENOENT))
+                    if rc != 0 or self._oi_version(at) != want_v:
+                        return None
+                    try:
+                        frag = await asyncio.to_thread(
+                            codec.repair_project, lost, data)
+                    except Exception:
+                        return None
+                    self.perf["repair_fragments"] += 1
+                    return (shard, frag)
+                tid = self._next_tid()
+                m = MOSDSubRead(tid, pg, shard, oid)
+                m.repair = (lost, alpha)
+                reply = await self._request(osd, m, tid)
+                self.hedge.observe(osd, time.monotonic() - ts,
+                                   ok=reply is not None
+                                   and reply.rc in (0, ENOENT))
+                if reply is None or reply.rc != 0 or \
+                        self._oi_version(reply.attrs) != want_v:
+                    # EOPNOTSUPP (codec drift), a stale version, or a
+                    # transport fault all just fail this helper; the
+                    # hedge recruits the next-ranked one
+                    return None
+                self.perf["subread_bytes"] += len(reply.data)
+                return (shard, reply.data)
+
+            jobs = [(osd, (lambda s=shard, o=osd: frag_job(s, o)))
+                    for shard, osd in plan["helpers"]]
+
+            def sufficient(results) -> bool:
+                return len({r[0] for r in results
+                            if r is not None}) >= d
+
+            results, _all = await self.hedge.gather(
+                jobs, need=d, sufficient=sufficient,
+                failed=lambda r: r is None, label="repair_read")
+            frags: Dict[int, bytes] = {}
+            for r in results:
+                if r is not None:
+                    frags.setdefault(r[0], r[1])
+            if len(frags) < d:
+                return None
+            # exactly d fragments in helper-rank order feed the math
+            rank = {s: i for i, (s, _o) in enumerate(plan["helpers"])}
+            keep = sorted(frags, key=lambda s: rank.get(s, 1 << 30))[:d]
+            if len({len(frags[s]) for s in keep}) != 1:
+                return None  # ragged shard lengths: not one version
+            self.perf["recovery_bytes_read"] += sum(
+                len(frags[s]) for s in keep)
+            return {s: frags[s] for s in keep}
+
+        fetched = await asyncio.gather(*(fetch_one(p) for p in plans))
+        self.tracer.record_stages(
+            {"recover_read": int((time.monotonic() - t_read) * 1e6)})
+
+        t_dec = time.monotonic()
+        done: List[Dict[str, Any]] = []
+        fallbacks: List[Dict[str, Any]] = []
+        groups: Dict[tuple, List[Dict[str, Any]]] = {}
+        for plan, frags in zip(plans, fetched):
+            if frags is None:
+                fallbacks.append(plan)
+                continue
+            plan["_frags"] = frags
+            groups.setdefault(
+                (plan["lost"], tuple(sorted(frags))), []).append(plan)
+        for (lost, helpers), group in groups.items():
+            try:
+                stacked = np.concatenate(
+                    [np.stack([np.frombuffer(p["_frags"][h],
+                                             dtype=np.uint8)
+                               for h in helpers]) for p in group],
+                    axis=1)
+                syms = await asyncio.to_thread(
+                    codec.repair_syms, lost, helpers, stacked)
+                off = 0
+                for p in group:
+                    flen = len(p["_frags"][helpers[0]])
+                    stream = codec.repair_assemble(
+                        syms[:, off:off + flen])
+                    off += flen
+                    if not _hinfo_chunk_ok(p["attrs"], lost, stream):
+                        log.warning(
+                            "osd.%d: repaired chunk of %s fails its"
+                            " crc ledger — falling back to verified"
+                            " full decode", self.osd_id, p["oid"])
+                        fallbacks.append(p)
+                        continue
+                    p["payload"] = {lost: stream}
+                    self.perf["repair_objects"] += 1
+                    done.append(p)
+            except Exception:
+                log.exception(
+                    "osd.%d: batched repair of %d objects (lost=%d)"
+                    " failed", self.osd_id, len(group), lost)
+                fallbacks.extend(group)
+        for p in plans:
+            p.pop("_frags", None)
+        self.tracer.record_stages(
+            {"recover_decode": int((time.monotonic() - t_dec) * 1e6)})
+        return done, fallbacks
 
     async def _locate_holders(self, pg: PgId, pool,
                               oid: str) -> List[Tuple[int, int]]:
@@ -3772,6 +4072,7 @@ class OSDDaemon:
             # mark THIS target recovered as soon as its own push
             # lands: a failed sibling push must not cause successful
             # targets to be re-pushed next interval
+            self.perf["recovery_bytes_repaired"] += len(buf)
             if shard_key is not None:
                 state.peer_missing.get(shard_key, {}).pop(oid, None)
 
@@ -4617,9 +4918,11 @@ class OSDDaemon:
                     max(0, (old_padded // width) * chunk
                         - chunk_off))
                 if frag_len > 0:
-                    chosen_frags = ec_util.fastest_survivors(
+                    chosen_frags = ec_util.choose_decode_set(
                         codec, good, k,
                         prefer=self._shard_rank(state))
+                    if chosen_frags is None:
+                        return EIO
                     frags = {}
                     for s, payload in chosen_frags.items():
                         # view of the sub-read frame; pad the short-
@@ -5002,10 +5305,9 @@ class OSDDaemon:
                            max(0, (padded // width) * chunk - chunk_off))
             if frag_len <= 0:
                 return 0, b""
-            try:
-                chosen_frags = ec_util.fastest_survivors(
-                    codec, good, k, prefer=self._shard_rank(state))
-            except Exception:
+            chosen_frags = ec_util.choose_decode_set(
+                codec, good, k, prefer=self._shard_rank(state))
+            if chosen_frags is None:
                 return EIO, b""
             frags = {}
             for s, payload in chosen_frags.items():
@@ -5041,10 +5343,9 @@ class OSDDaemon:
         if oi.get("whiteout"):
             return ENOENT, b""
         size = oi.get("size", 0)
-        try:
-            frags = ec_util.fastest_survivors(
-                codec, good, k, prefer=self._shard_rank(state))
-        except Exception:
+        frags = ec_util.choose_decode_set(
+            codec, good, k, prefer=self._shard_rank(state))
+        if frags is None:
             return EIO, b""
         self.perf["decode_dispatches"] += 1
         data = await self.encode_service.decode(sinfo, codec, frags)

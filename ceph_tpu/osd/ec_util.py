@@ -623,6 +623,24 @@ def fastest_survivors(ec_impl, have: Mapping[int, bytes], k: int,
     raise AssertionError("unreachable")  # loop returns or re-raises
 
 
+def choose_decode_set(ec_impl, have: Mapping[int, bytes], k: int,
+                      prefer=None, first_k: bool = False,
+                      ) -> Optional[Dict[int, bytes]]:
+    """fastest_survivors plus the daemon's standard failure policy —
+    one idiom instead of a try/rank/fallback copy at every call site.
+
+    Returns the minimal decodable survivor map.  When no subset
+    decodes: the first k shards by index if `first_k` (recovery paths
+    that defer below-k adjudication to the decode attempt itself),
+    else None (read paths that answer EIO)."""
+    try:
+        return fastest_survivors(ec_impl, have, k, prefer=prefer)
+    except Exception:
+        if first_k:
+            return {s: have[s] for s in sorted(have)[:k]}
+        return None
+
+
 def decode_many(sinfo: StripeInfo, ec_impl,
                 maps) -> List[bytes]:
     """N decode requests (same profile) -> logical byte streams.

@@ -62,7 +62,7 @@ import time
 from typing import Dict, Iterable, List, Optional
 
 from ceph_tpu.common import tracing
-from ceph_tpu.osd import ec_util
+from ceph_tpu.osd import ec_util, scheduler
 
 __all__ = ["EncodeService"]
 
@@ -136,9 +136,12 @@ class _Bucket:
         self.outstanding = 0          # queued + in-flight requests
         self.outstanding_bytes = 0
         self.in_flight = 0            # dispatched batches not yet done
-        # arrival-density tracking (the bitmatrix hot/cold router)
-        self.last_arrival: Optional[float] = None
-        self.ewma_gap: Optional[float] = None
+        # arrival-density tracking (the bitmatrix hot/cold router),
+        # keyed by mClock class: a recovery wave's dense arrivals
+        # must not mark the bucket hot for sparse client writes (and
+        # a client trickle must not mask a forming recovery batch)
+        self.last_arrival: Dict[str, float] = {}
+        self.ewma_gap: Dict[str, float] = {}
         self.timer: Optional[asyncio.TimerHandle] = None
         # two dispatch slots: the double buffer — batch N on device,
         # batch N+1 accumulating/launching behind it
@@ -367,16 +370,22 @@ class EncodeService:
         per-op dispatch cost is exactly what batching amortizes."""
         if q.tier != "bitmatrix":
             return False
+        # per-mClock-class arrival density: the op's scheduler class
+        # rides the contextvar set by scheduler.run() ('' outside any
+        # grant); tenant classes fold so the dicts stay bounded
+        cls = scheduler.stage_class(scheduler.current_class())
         now = time.perf_counter()
-        if q.last_arrival is not None:
-            gap = now - q.last_arrival
-            q.ewma_gap = gap if q.ewma_gap is None \
-                else 0.5 * q.ewma_gap + 0.5 * gap
-        q.last_arrival = now
+        last = q.last_arrival.get(cls)
+        if last is not None:
+            gap = now - last
+            prev = q.ewma_gap.get(cls)
+            q.ewma_gap[cls] = gap if prev is None \
+                else 0.5 * prev + 0.5 * gap
+        q.last_arrival[cls] = now
         if q.pending or q.in_flight:
             return False        # a batch is forming: join it
-        return q.ewma_gap is None or \
-            q.ewma_gap > self.window_s / 4.0
+        gap = q.ewma_gap.get(cls)
+        return gap is None or gap > self.window_s / 4.0
 
     async def _enqueue(self, q: _Bucket, payload, nbytes: int):
         loop = asyncio.get_running_loop()

@@ -36,6 +36,7 @@ introspectable via `stats()`, which is the signal the admission gate
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
@@ -70,6 +71,20 @@ DEFAULT_PROFILES: Dict[str, Tuple[float, float, float]] = {
 #: the tag/queue maps must stay bounded — idle tenants' entries are
 #: pruned once the map outgrows this
 TENANT_STATE_CAP = 4096
+
+
+#: mClock class of the op currently executing under a run() grant.
+#: Downstream services key per-class state off this (the encode
+#: service's hot/cold arrival-density router) instead of threading a
+#: class argument through every call chain.
+_current_class: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "ceph_tpu_op_class", default="")
+
+
+def current_class() -> str:
+    """Scheduler class of the currently-running op, '' outside any
+    grant (direct calls, tests, startup)."""
+    return _current_class.get()
 
 
 def tenant_class(tenant: str) -> str:
@@ -184,9 +199,11 @@ class OpSchedulerBase:
                 f"queue.{stage_class(op_class)}", cls=op_class)
             q_span.set_attr("fast", True)
             q_span.finish()
+            tok = _current_class.set(op_class)
             try:
                 return await fn()
             finally:
+                _current_class.reset(tok)
                 self._in_flight -= 1
                 self._wake.set()
         self.start()
@@ -233,9 +250,11 @@ class OpSchedulerBase:
                 raise
         finally:
             q_span.finish()
+        tok = _current_class.set(op_class)
         try:
             return await fn()
         finally:
+            _current_class.reset(tok)
             self._in_flight -= 1
             self._wake.set()
 

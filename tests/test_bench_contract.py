@@ -23,7 +23,7 @@ CONTRACT_KEYS = {"metric", "value", "unit", "vs_baseline",
                  "plan_cache", "encode_service", "tier",
                  "device_health", "tail", "load", "durability",
                  "mesh", "multihost", "trace", "group_commit",
-                 "compute", "xsched", "spmd", "truncated"}
+                 "compute", "xsched", "spmd", "repair", "truncated"}
 
 
 def test_contract_line_despite_hanging_backend(tmp_path):
@@ -186,6 +186,13 @@ def test_contract_line_despite_hanging_backend(tmp_path):
     assert sp["runtime_sites"] >= 1
     assert sp["runtime_subset_static"] == 1
     assert sp["order_congruent"] == 1
+    # the MSR repair probe ran: every single-erasure pattern rebuilt
+    # bit-exact from d beta-fragments, and the fragment bytes beat the
+    # classic k-read (the regenerating-code point: ratio < 1)
+    rp = contract["repair"]
+    assert rp["patterns_bitexact"] == rp["k"] + rp["m"]
+    assert rp["alpha"] == rp["d"] - rp["k"] + 1
+    assert 0 < rp["bytes_ratio_vs_kread"] < 1
     assert contract["truncated"] is False
     # details stayed out of stdout (they belong in bench_details.json)
     assert len(stdout_lines) == 1

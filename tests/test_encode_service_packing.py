@@ -369,6 +369,85 @@ def test_fast_lane_refused_while_work_is_queued():
     run(main())
 
 
+# -- per-mClock-class arrival density (the hot/cold router) ------------
+
+
+def test_current_class_rides_both_grant_paths():
+    """scheduler.current_class() reports the running op's class under
+    both the fast grant and the queued grant, and resets after."""
+    from ceph_tpu.osd import scheduler as sched_mod
+
+    async def main():
+        s = MClockScheduler(max_concurrent=1)
+        seen = []
+
+        async def probe():
+            seen.append(sched_mod.current_class())
+
+        assert sched_mod.current_class() == ""
+        await s.run("background_recovery", 1.0, probe)  # fast grant
+        hold = asyncio.Event()
+
+        async def holder():
+            await hold.wait()
+
+        first = asyncio.ensure_future(s.run("client", 1.0, holder))
+        await asyncio.sleep(0)
+        queued = asyncio.ensure_future(s.run("client", 1.0, probe))
+        await asyncio.sleep(0)
+        hold.set()
+        await asyncio.gather(first, queued)
+        assert sched_mod.current_class() == ""
+        await s.stop()
+        return seen
+
+    assert run(main()) == ["background_recovery", "client"]
+
+
+@needs_native
+def test_cold_router_tracks_arrival_density_per_class():
+    """A dense recovery wave heating the bucket must not drag sparse
+    client singletons onto the off-loop batch hop: arrival density is
+    per mClock class, so the client trickle stays inline_cold while
+    the recovery burst batches through the packed tier."""
+    codec, sinfo = _codec(), _sinfo()
+    sched = MClockScheduler(
+        profiles={"background_recovery": (0.0, 1.0, 0.0),
+                  "client": (0.0, 1.0, 0.0)},
+        max_concurrent=32)
+    bufs = [_payload() for _ in range(16)]
+
+    async def main():
+        svc = EncodeService(window_ms=50)
+
+        def enc(b):
+            return svc.encode_with_hinfo(sinfo, codec, b, range(6),
+                                         logical_len=len(b))
+
+        outs = await asyncio.gather(
+            *(sched.run("background_recovery", 1.0, lambda b=b: enc(b))
+              for b in bufs))
+        hot = dict(svc.stats())
+        assert hot["batched"] >= 8, "recovery burst never batched"
+        # gaps ~4x the window: a cold trickle in ANY class — but the
+        # bucket-global EWMA of old would have inherited the burst's
+        # dense gaps and routed these through the batch hop
+        for _ in range(3):
+            out = await sched.run("client", 1.0,
+                                  lambda: enc(bufs[0]))
+            _check_item(sinfo, codec, bufs[0], out)
+            await asyncio.sleep(0.02)
+        st = svc.stats()
+        await svc.stop()
+        return outs, hot, st
+
+    outs, hot, st = run(main())
+    assert st["inline_cold"] - hot["inline_cold"] == 3, \
+        "client trickle lost its per-class cold routing"
+    for b, got in zip(bufs, outs):
+        _check_item(sinfo, codec, b, got)
+
+
 # -- _ObjLock: the sync-acquire objlock half ---------------------------
 
 
