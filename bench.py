@@ -73,6 +73,7 @@ def _emit_contract(value: Optional[float],
                    xsched: Optional[dict] = None,
                    spmd: Optional[dict] = None,
                    repair: Optional[dict] = None,
+                   inference: Optional[dict] = None,
                    truncated: bool = False) -> None:
     """Print the one-line JSON driver contract, exactly once, before
     any optional extended benches run — a wedged tunnel or a crashed
@@ -107,7 +108,12 @@ def _emit_contract(value: Optional[float],
     per-process order congruence), repair the MSR regenerating-codec
     probe (every single-erasure pattern rebuilt bit-exact from d
     beta-fragments, with the measured bytes-read-per-repaired-byte
-    ratio vs the classic k-read);
+    ratio vs the classic k-read), inference the coded inference
+    serving probe (exact combine bit-identical to the host oracle,
+    every single-shard-loss pattern served from the Fisher-fused
+    substitutes within the error budget, the hedged sub-infer
+    straggler leg completing from the first structurally-sufficient
+    arrival set);
     truncated flags a budget-shortened run.  Thread-safe:
     the deadline watchdog and the bench body may race to emit."""
     global _contract_emitted
@@ -136,6 +142,7 @@ def _emit_contract(value: Optional[float],
             "xsched": xsched,
             "spmd": spmd,
             "repair": repair,
+            "inference": inference,
             "truncated": bool(truncated),
         }), flush=True)
 
@@ -865,6 +872,127 @@ def _compute_probe() -> Optional[dict]:
         return None
 
 
+def _inference_probe() -> Optional[dict]:
+    """Pre-contract probe of coded inference serving
+    (ceph_tpu/inference): (1) the exact combine over all k data
+    contributions is BIT-identical to the host oracle
+    (model.exact_forward); (2) every single-data-shard-loss pattern
+    serves from the Fisher-fused substitute streams with true
+    relative error <= the structural estimate <= the budget; (3) the
+    straggler leg — a hedged sub-infer gather with one 1 s straggler
+    completes from the first structurally-sufficient arrival set,
+    combines within budget, and cancels the straggler.  Counters land
+    in the contract line's `inference` key; None (with a stderr note)
+    when the probe cannot run."""
+    if _remaining() < 0:
+        print("# inference probe skipped: budget exhausted",
+              file=sys.stderr)
+        return None
+    probe_timeout = float(os.environ.get(
+        "CEPH_TPU_BENCH_INFER_PROBE_TIMEOUT", "60"))
+    try:
+        import asyncio
+
+        from ceph_tpu.inference import fisher, model, registry
+        from ceph_tpu.osd.hedge import HedgeTracker
+
+        k, m, chunk, budget, nq = 3, 2, 1024, 0.05, 16
+        spec, blobs = registry.build(
+            "bench-model", "linear",
+            registry.make_model("linear", 32, 48, seed=11),
+            k, m, chunk)
+        data = blobs[registry.params_oid("bench-model")]
+        streams = model.object_streams(spec, data)
+        q = np.random.default_rng(17).standard_normal(
+            (nq, 32)).astype(np.float32)
+        exact = model.exact_forward(spec, data, q)
+        eref = float(np.linalg.norm(exact)) or 1.0
+        parts = {i: model.shard_forward(spec, streams[i], q)
+                 for i in range(k)}
+        fused = {j: model.shard_forward(spec, streams[k + j], q)
+                 for j in range(m)}
+        # all-data combine funnels through the same fixed op order as
+        # the oracle: bit-identical, not merely close
+        all_data = fisher.combine(spec, parts, {}, q, budget)
+        bitexact = int(all_data is not None and
+                       all_data[0].tobytes() == exact.tobytes())
+        patterns, within = 0, 1
+        max_rel, max_est = 0.0, 0.0
+        for drop in range(k):
+            dp = {i: parts[i] for i in range(k) if i != drop}
+            res = fisher.combine(spec, dp, fused, q, budget)
+            patterns += 1
+            if res is None:
+                within = 0
+                continue
+            scores, est, _sub = res
+            rel = float(np.linalg.norm(scores - exact)) / eref
+            max_rel, max_est = max(max_rel, rel), max(max_est, est)
+            if not (rel <= est and fisher.check_budget(est, budget)):
+                within = 0
+
+        async def straggler_leg() -> dict:
+            tracker = HedgeTracker("bench-infer-probe", {
+                "osd_hedge_delta": 1,
+                "osd_hedge_rtt_prior_ms": 2.0,
+                "osd_hedge_delay_floor_ms": 5.0,
+            })
+            delays = {i: 0.001 for i in range(k + m)}
+            delays[1] = 1.0  # one slow data-stream holder
+            qscale = fisher.query_scale(q)
+
+            async def sub(idx: int) -> tuple:
+                await asyncio.sleep(delays[idx])
+                return idx, True, model.shard_forward(
+                    spec, streams[idx], q)
+
+            jobs = [(i, (lambda s=i: sub(s))) for i in range(k + m)]
+
+            def sufficient(results) -> bool:
+                got = {r[0] for r in results if r[1]}
+                est = fisher.structural_error(
+                    spec, sorted(i for i in got if i < k),
+                    sorted(i - k for i in got if i >= k), qscale)
+                return est is not None and \
+                    fisher.check_budget(est, budget)
+
+            t0 = time.perf_counter()
+            results, _ran_all = await tracker.gather(
+                jobs, need=k, sufficient=sufficient,
+                failed=lambda r: not r[1], label="subinfer")
+            dt = time.perf_counter() - t0
+            got = {r[0]: r[2] for r in results if r[1]}
+            res = fisher.combine(
+                spec, {i: v for i, v in got.items() if i < k},
+                {i - k: v for i, v in got.items() if i >= k},
+                q, budget)
+            ok = res is not None and \
+                float(np.linalg.norm(res[0] - exact)) / eref <= budget
+            return {
+                "first_sufficient_ms": round(dt * 1e3, 3),
+                "straggler_avoided": int(dt < 0.5),
+                "straggler_within_budget": int(ok),
+                "substituted_streams": res[2] if res else -1,
+                "cancelled_subinfers":
+                    tracker.counters["cancelled_subreads"],
+            }
+
+        leg = asyncio.run(asyncio.wait_for(straggler_leg(),
+                                           probe_timeout))
+        return {
+            "bitexact": bitexact,
+            "patterns": patterns,
+            "within_budget": within,
+            "max_rel_err": round(max_rel, 9),
+            "max_est_error": round(max_est, 9),
+            "budget": budget,
+            **leg,
+        }
+    except Exception as e:
+        print(f"# inference probe failed: {e!r}", file=sys.stderr)
+        return None
+
+
 def _xsched_probe() -> Optional[dict]:
     """Pre-contract probe of the XOR-schedule codec compiler
     (ec/xsched.py): the bitmatrix trio's encode matrices, two decode
@@ -1441,6 +1569,191 @@ def bench_compute() -> dict:
                 "compute_straggler_bitexact": int(slow_ok),
                 "compute_hedged_gathers": hedged,
                 "compute_stage_ms": {
+                    k: {"count": v["count"],
+                        "p99_ms": round(v["p99_ms"], 3)}
+                    for k, v in sorted(stages.items())},
+            }
+        finally:
+            await cluster.stop()
+
+    return asyncio.run(run())
+
+
+def bench_inference() -> dict:
+    """Coded inference serving leg through a live cluster: a linear
+    scorer stored Fisher-fused into an EC pool, queried (1) through
+    the code (approximate serving allowed under the default budget),
+    (2) exact through the code, and (3) client-side read-then-infer
+    (CEPH_TPU_INFERENCE=0) — reporting wall-clock and sub-read bytes
+    moved per mode, the approx-vs-exact accuracy delta against the
+    budget, the kill-switch bit-parity, the per-stage infer trace
+    decomposition, and the straggler leg: per-query p99 with one
+    injected slow stream-holder OSD, coded serving vs the degraded
+    read-then-infer baseline."""
+    import asyncio
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_helpers import Cluster
+    from ceph_tpu.inference import registry
+    from ceph_tpu.loadgen.stats import LatencyHistogram
+
+    n_ops = int(os.environ.get("CEPH_TPU_BENCH_INFER_OPS",
+                               "24" if _SMOKE else "200"))
+    nq, dim, out = 16, 64, 256
+    delay = 0.05 if _SMOKE else 0.25
+    budget = 0.05
+    profile = {"plugin": "ec_jax", "technique": "reed_sol_van",
+               "k": "3", "m": "2", "crush-failure-domain": "osd"}
+
+    async def run() -> dict:
+        cluster = Cluster(num_osds=6, osds_per_host=3,
+                          osd_config={"osd_heartbeat_interval": 3.0,
+                                      "osd_heartbeat_grace": 30.0})
+        await cluster.start()
+        try:
+            await cluster.client.create_ec_pool(
+                "infer", profile=profile, pg_num=8)
+            io = cluster.client.open_ioctx("infer")
+            spec = await io.store_model(
+                "bench-model", "linear",
+                registry.make_model("linear", dim, out, seed=23),
+                m=1)
+            rng = np.random.default_rng(29)
+            batches = [rng.standard_normal((nq, dim)
+                                           ).astype(np.float32)
+                       for _ in range(n_ops)]
+            await io.infer(spec, batches[0])  # warm plans/admission
+            # client-visible wire cost per mode: read-then-infer
+            # ships the WHOLE params object down every op; coded
+            # serving ships the query batch up and the scores blob
+            # back (result_bytes on the compute engine).  OSD-side
+            # sub-read counters are useless here — the exact leg
+            # promotes the params object into the hot tier and later
+            # client reads skip the EC fan-out.
+            params_bytes = len(await io.read(spec["params_oid"]))
+            query_bytes = batches[0].nbytes
+
+            def result_bytes() -> int:
+                return sum(o.compute.perf()["result_bytes"]
+                           for o in cluster.osds.values())
+
+            async def sweep(exact: bool = False,
+                            hist: Optional[LatencyHistogram] = None
+                            ) -> tuple:
+                t0 = time.perf_counter()
+                results = []
+                for qb in batches:
+                    s0 = time.perf_counter()
+                    results.append(await io.infer(spec, qb,
+                                                  exact=exact))
+                    if hist is not None:
+                        hist.record(time.perf_counter() - s0)
+                return time.perf_counter() - t0, results
+
+            # leg 1: coded serving (approximate allowed)
+            rb0 = result_bytes()
+            coded_s, res_coded = await sweep()
+            coded_bytes = (result_bytes() - rb0
+                           + n_ops * query_bytes)
+            # leg 2: exact through the code (full-decode fallback)
+            exact_s, res_exact = await sweep(exact=True)
+            # leg 3: kill switch — client-side read-then-infer
+            os.environ["CEPH_TPU_INFERENCE"] = "0"
+            try:
+                read_s, res_read = await sweep()
+                read_bytes = n_ops * params_bytes
+            finally:
+                os.environ.pop("CEPH_TPU_INFERENCE", None)
+            parity = all(
+                a["scores"].tobytes() == b["scores"].tobytes()
+                for a, b in zip(res_exact, res_read))
+            max_rel = max(
+                float(np.linalg.norm(a["scores"] - b["scores"]) /
+                      max(np.linalg.norm(b["scores"]), 1e-12))
+                for a, b in zip(res_coded, res_exact))
+            max_est = max(float(r["est_error"]) for r in res_coded)
+            modes = {}
+            for r in res_coded:
+                modes[r["mode"]] = modes.get(r["mode"], 0) + 1
+
+            # straggler leg: slow a non-primary holder of one of the
+            # model's serving streams (acting[:k+m of the MODEL]);
+            # the hedged sub-infer fan-out must keep coded p99 flat
+            pg = io.object_pg(spec["params_oid"])
+            acting, primary = cluster.mon.osdmap.pg_to_acting_osds(pg)
+            nstreams = int(spec["k"]) + int(spec["m"])
+            slow = next(o for o in acting[:nstreams]
+                        if o != primary and o >= 0)
+            base_h = LatencyHistogram()
+            await sweep(hist=base_h)
+            cluster.osds[slow].msgr.inject_internal_delays = delay
+            try:
+                slow_h = LatencyHistogram()
+                _s, res_slow = await sweep(hist=slow_h)
+                os.environ["CEPH_TPU_INFERENCE"] = "0"
+                try:
+                    slow_read_h = LatencyHistogram()
+                    await sweep(hist=slow_read_h)
+                finally:
+                    os.environ.pop("CEPH_TPU_INFERENCE", None)
+            finally:
+                cluster.osds[slow].msgr.inject_internal_delays = 0
+            slow_rel = max(
+                float(np.linalg.norm(a["scores"] - b["scores"]) /
+                      max(np.linalg.norm(b["scores"]), 1e-12))
+                for a, b in zip(res_slow, res_exact))
+
+            stages = {}
+            infer_counters: dict = {}
+            for osd in cluster.osds.values():
+                for stage, row in osd.tracer.stage_perf().items():
+                    if "infer" not in stage:
+                        continue
+                    agg = stages.setdefault(
+                        stage, {"count": 0, "p99_ms": 0.0})
+                    agg["count"] += row.get("count", 0)
+                    agg["p99_ms"] = max(agg["p99_ms"],
+                                        row.get("p99_ms", 0.0))
+                for key, v in osd.inference.perf_dump().items():
+                    if isinstance(v, int):
+                        infer_counters[key] = \
+                            infer_counters.get(key, 0) + v
+            base_p99 = base_h.percentile(0.99) or 0.0
+            coded_p99 = slow_h.percentile(0.99) or 0.0
+            read_p99 = slow_read_h.percentile(0.99) or 0.0
+            return {
+                "inference_ops": n_ops,
+                "inference_queries_per_op": nq,
+                "inference_coded_s": round(coded_s, 3),
+                "inference_exact_s": round(exact_s, 3),
+                "inference_read_then_infer_s": round(read_s, 3),
+                "inference_speedup_vs_read_x": round(
+                    read_s / max(coded_s, 1e-9), 2),
+                "inference_params_bytes": params_bytes,
+                "inference_coded_wire_bytes": coded_bytes,
+                "inference_read_wire_bytes": read_bytes,
+                "inference_bytes_ratio": round(
+                    read_bytes / max(coded_bytes, 1), 1),
+                "inference_killswitch_parity": int(parity),
+                "inference_max_rel_err": round(max_rel, 9),
+                "inference_max_est_error": round(max_est, 9),
+                "inference_accuracy_ok": int(max_rel <= budget),
+                "inference_modes": modes,
+                "inference_osd_counters": infer_counters,
+                "inference_straggler_delay_s": delay,
+                "inference_straggler_base_p99_ms": round(
+                    base_p99 * 1e3, 3),
+                "inference_straggler_coded_p99_ms": round(
+                    coded_p99 * 1e3, 3),
+                "inference_straggler_read_p99_ms": round(
+                    read_p99 * 1e3, 3),
+                "inference_straggler_flat": int(
+                    coded_p99 < max(2.0 * base_p99,
+                                    base_p99 + 0.5 * delay)),
+                "inference_straggler_accuracy_ok": int(
+                    slow_rel <= budget),
+                "inference_stage_ms": {
                     k: {"count": v["count"],
                         "p99_ms": round(v["p99_ms"], 3)}
                     for k, v in sorted(stages.items())},
@@ -3033,6 +3346,11 @@ def main() -> None:
     # single-erasure pattern rebuilt bit-exact from d beta-fragments,
     # fragment bytes on the product-matrix bound (0.5x the k-read)
     repair_counters = _repair_probe()
+    # coded-inference probe (before the contract): Fisher-fused
+    # serving streams bit-exact on the full set, every single-shard
+    # loss within the error budget, and the hedged straggler leg
+    # first-sufficient without the slow stream
+    inference_counters = _inference_probe()
 
     # the driver contract line, before every optional/extended bench:
     # a wedge below this point can cost detail rows, never the bench
@@ -3051,6 +3369,7 @@ def main() -> None:
                    xsched=xsched_counters,
                    spmd=spmd_counters,
                    repair=repair_counters,
+                   inference=inference_counters,
                    truncated=skip_optional)
 
     # decode sweep over 1..m erasures (the reference benchmark sweeps
@@ -3195,6 +3514,19 @@ def main() -> None:
         except Exception as e:
             print(f"# compute bench failed: {e!r}", file=sys.stderr)
 
+    # coded-inference section: the serve-through-the-code leg —
+    # coded approx vs exact vs read-then-infer wall-clock and bytes,
+    # accuracy delta vs the budget, kill-switch parity, straggler
+    # p99 flatness, per-stage infer decomposition
+    inference_section: dict = {}
+    if skip_optional:
+        skipped_sections.append("inference")
+    else:
+        try:
+            inference_section = bench_inference()
+        except Exception as e:
+            print(f"# inference bench failed: {e!r}", file=sys.stderr)
+
     # codec-compiler section: the small-chunk scheduled-vs-naive
     # sweep (encode AND decode) + the live-cluster leg citing the
     # encode_wait stage histogram per mode
@@ -3305,6 +3637,7 @@ def main() -> None:
         **mesh_section,
         **multihost_section,
         **compute_section,
+        **inference_section,
         **xsched_section,
         **smallop_section,
         **degraded_section,
@@ -3325,6 +3658,7 @@ def main() -> None:
         "compute": compute_counters,
         "xsched": xsched_counters,
         "repair": repair_counters,
+        "inference": inference_counters,
         "host_cores": os.cpu_count(),
         "encode_ms_per_batch": t_enc * 1e3,
         "k": k, "m": m, "chunk_bytes": chunk, "batch": batch,

@@ -87,6 +87,18 @@ store durability discipline:
                        invariant the crash sweep (os/faultstore.py)
                        checks dynamically, enforced here at lint time
 
+inference serving discipline:
+  unbudgeted-approx-result
+                       an approximate combine (least-squares solve of
+                       missing shard contributions feeding combined
+                       scores) in ceph_tpu/inference/ returned without
+                       consulting the error-budget gate
+                       (inference/fisher.py check_budget): a result
+                       whose estimated error nobody priced against the
+                       caller's budget — every approximate serving
+                       result must pass check_budget or yield to the
+                       exact full-decode fallback
+
 loadgen/bench discipline:
   unbounded-latency-buffer
                        appending per-op latency samples to a plain
@@ -1154,6 +1166,69 @@ def rule_unbounded_latency_buffer(a: Analyzer) -> None:
 
 
 # ---------------------------------------------------------------------
+# unbudgeted-approx-result
+# ---------------------------------------------------------------------
+
+# modules whose approximate-combine returns must ride the error-budget
+# gate (ceph_tpu/inference/fisher.py check_budget)
+_APPROX_PATHS = ("ceph_tpu/inference/", "ceph_tpu/osd/inference")
+# callee tails of the approximate step: solving missing shard
+# contributions from fused results is what makes the output an
+# ESTIMATE rather than the exact forward
+_APPROX_SOLVER_TAILS = {"lstsq", "pinv", "solve"}
+# callee tails / name fragments that synthesize final combined scores
+_APPROX_COMBINE_TAILS = {"combine", "combine_contributions"}
+_BUDGET_GATE = "check_budget"
+
+
+def rule_unbudgeted_approx_result(a: Analyzer) -> None:
+    """A function in the inference paths that both SOLVES missing
+    shard contributions (lstsq/pinv — the approximate step) and
+    synthesizes combined scores, yet returns without ever consulting
+    fisher.check_budget: the result's estimated error was never
+    priced against the caller's budget, so an out-of-budget
+    approximation serves silently instead of falling back to the
+    exact full-decode path.  Pure solver helpers (no combine) and
+    exact paths (no solve) are not findings."""
+    paths = a.config.get("approx_paths", _APPROX_PATHS)
+    for mod in a.project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if not any(p in rel for p in paths):
+            continue
+        for fi in mod.functions.values():
+            tails: Set[str] = set()
+            for node in _scope_nodes(fi.node):
+                if isinstance(node, ast.Call):
+                    callee = _resolved_callee(mod, node) or \
+                        dotted(node.func) or ""
+                    tails.add(callee.split(".")[-1])
+            if not tails & _APPROX_SOLVER_TAILS:
+                continue
+            combines = bool(tails & _APPROX_COMBINE_TAILS) or \
+                "combine" in fi.node.name.lower() or \
+                "approx" in fi.node.name.lower()
+            if not combines or _BUDGET_GATE in tails:
+                continue
+            for node in _scope_nodes(fi.node):
+                if not isinstance(node, ast.Return) or \
+                        node.value is None or \
+                        (isinstance(node.value, ast.Constant)
+                         and node.value.value is None):
+                    continue
+                a.emit("unbudgeted-approx-result", mod, node,
+                       f"`{fi.qualname}` returns an approximate "
+                       "combine (least-squares solve of missing "
+                       "shard contributions) without consulting "
+                       "ceph_tpu.inference.fisher.check_budget: the "
+                       "estimated error was never priced against "
+                       "the caller's budget — gate the return on "
+                       "check_budget(est, budget) or fall back to "
+                       "the exact full-decode path",
+                       severity="warning", symbol=fi.qualname,
+                       scope_line=fi.lineno)
+
+
+# ---------------------------------------------------------------------
 # lock-no-await
 # ---------------------------------------------------------------------
 
@@ -1323,6 +1398,7 @@ def default_rules() -> Dict[str, object]:
         "unhedged-gather": rule_unhedged_gather,
         "span-leak": rule_span_leak,
         "unbounded-latency-buffer": rule_unbounded_latency_buffer,
+        "unbudgeted-approx-result": rule_unbudgeted_approx_result,
         "commit-before-durability": rule_commit_before_durability,
         "async-blocking": rule_async_blocking,
         "sync-encode-in-async": rule_sync_encode_in_async,

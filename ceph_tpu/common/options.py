@@ -270,6 +270,14 @@ def _opts() -> List[Option]:
                min=0.0,
                desc="max in-gate smoothing delay before an over-limit"
                     " op is shed instead"),
+        # -- coded inference serving (ceph_tpu/inference) ------------------
+        Option("osd_inference_error_budget", "float", 0.05, A,
+               min=0.0, max=1e6,
+               desc="default per-query relative error budget for"
+                    " Fisher-fused approximate serving: an arrival"
+                    " set whose structural error bound exceeds it"
+                    " (or a caller demanding exactness) takes the"
+                    " exact full-decode fallback"),
         # -- critical-path tracing (common/tracing.py: stage spans,
         #    head sampling for ring retention, tail-exemplar trees) ---
         Option("osd_trace_enable", "bool", True, A,

@@ -125,10 +125,21 @@ class ComputeKernel:
     evaluated per SHARD on the OSDs and combined in the result domain;
     their object-level answer is the GF-sum (XOR) of the k data-shard
     results.  linear=False kernels define `eval_object` on the
-    reconstructed logical bytes."""
+    reconstructed logical bytes.
+
+    approx_capable=True marks a NONLINEAR kernel that can still run
+    per-shard with an approximate result-domain combine (the Fisher
+    fusion seam, ceph_tpu/inference/): the OSD pushdown and
+    sub-compute paths admit `linear or approx_capable` kernels and
+    call `shard_eval` — which such kernels override — instead of
+    assuming the GF batched eval.  qos_class names the mClock class
+    the per-shard eval is charged to, so inference work is shaped by
+    its own dmClock profile rather than riding the compute class."""
 
     name = ""
     linear = False
+    approx_capable = False
+    qos_class = "compute"
     lanes = DEFAULT_LANES
 
     # -- common ------------------------------------------------------------
@@ -185,6 +196,19 @@ class ComputeKernel:
         # lane-width result (32 B), not a payload copy
         return acc.tobytes()  # lint: disable=hot-path-copy
 
+    # -- per-shard surface (linear AND approx_capable) ---------------------
+
+    def shard_eval(self, payloads: Sequence,
+                   args: Dict[str, Any]) -> List[bytes]:
+        """Evaluate a wave of locally-held shard payloads -> one
+        result blob each.  Linear kernels get the batched plan-cached
+        GF eval for free; approx_capable kernels override with their
+        own per-shard forward (ceph_tpu/inference/kernels.py)."""
+        if not self.linear:
+            raise NotImplementedError(
+                f"kernel {self.name} has no per-shard evaluation")
+        return shard_eval_batch(self, payloads, args)
+
 
 # ---------------------------------------------------------------------------
 # Registry (plugin_registry pattern)
@@ -224,6 +248,11 @@ def _ensure_defaults() -> None:
     from ceph_tpu.compute import kernels as _k
 
     _k.register_defaults(register)
+    # the inference subsystem registers its approx_capable kernels
+    # through the same seam (satellite of the dot_score gating fix)
+    from ceph_tpu.inference import kernels as _ik
+
+    _ik.register_defaults(register)
 
 
 def shard_eval_batch(kernel: ComputeKernel, payloads: Sequence,

@@ -15,8 +15,11 @@ decode):
                      permutations, because every lane-row carries its
                      own GF weight.
 
-Nonlinear (full-decode fallback at the primary; results, not
-payloads, cross the client wire):
+Nonlinear (per-kernel `approx_capable` decides the path: False means
+the full-decode fallback at the primary, True means per-shard
+pushdown with a result-domain approximate combine — the seam the
+inference engine's kernels register through, ceph_tpu/inference/;
+either way results, not payloads, cross the client wire):
 
 - ``count``/``sum``/``min``/``max``  aggregate pushdown over
                      fixed-width records with an optional predicate
@@ -343,6 +346,12 @@ class DotScore(ComputeKernel):
 
     name = "dot_score"
     linear = False
+    # argmax over raw object bytes has no per-shard decomposition:
+    # NOT approx-capable, so it keeps the full-decode path.  The
+    # coded serving of this workload shape lives in
+    # ceph_tpu/inference/ (Fisher-fused shards, `infer` kernel),
+    # whose kernels set approx_capable=True through this same seam.
+    approx_capable = False
 
     def validate_args(self, args: Dict[str, Any]) -> None:
         dim = _int_arg(args, "dim", 0)

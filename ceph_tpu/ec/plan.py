@@ -1184,6 +1184,71 @@ def compute_eval(name: str, weights: np.ndarray, data: np.ndarray,
     return np.asarray(out)[:b, :, :lanes]
 
 
+def _build_inference(key: tuple, arch: str) -> ExecPlan:
+    """The `inference` plan kind: batched query-x-shard scoring for
+    the coded inference engine — every serving stream's forward pass
+    over the query batch in ONE dispatch.  Unlike the compute kind
+    the parameters are RUNTIME operands (each stored model differs;
+    baking them would compile per model), so one trace per
+    (arch, dims, query bucket) serves every model of that shape."""
+    if arch == "linear":
+        def fwd(tables, q):
+            # (B, rows, dim) x (nq, dim) -> (B, nq, rows)
+            return jnp.einsum("qd,brd->bqr", q, tables,
+                              preferred_element_type=jnp.float32)
+    else:
+        def fwd(w1, b1, w2, q):
+            # (B,h,dim),(B,h),(B,o,h) x (nq,dim) -> (B, nq, o)
+            hid = jnp.maximum(
+                jnp.einsum("qd,bhd->bqh", q, w1,
+                           preferred_element_type=jnp.float32)
+                + b1[:, None, :], 0.0)
+            return jnp.einsum("bqh,boh->bqo", hid, w2,
+                              preferred_element_type=jnp.float32)
+    return ExecPlan(key, tracked_jit(_label(key), fwd), "xla_infer")
+
+
+def inference_eval(arch: str, ops: tuple, queries: np.ndarray,
+                   sig: str, family: str = "ec-inference"
+                   ) -> Optional[np.ndarray]:
+    """Stacked per-stream parameters + (nq, dim) query batch ->
+    (B, nq, cols) float32 contributions through the plan cache (kind
+    `inference`, its own breaker family so an inference fault never
+    trips the encode/decode or compute paths).  The sig must encode
+    ALL parameter dims (they are runtime operands, invisible to the
+    key otherwise); only the query batch rides the bucketed axis.
+    Returns None on no backend / quarantine / guarded failure —
+    callers take the bit-exact numpy forward (model.shard_forward);
+    RESOURCE_EXHAUSTED halves the query batch recursively first."""
+    if not (HAVE_JAX and gf.backend_available()):
+        return None
+    q = np.asarray(queries, dtype=np.float32)
+    nq = q.shape[0]
+    nstreams = ops[0].shape[0]
+    if nq == 0 or nstreams == 0:
+        return None
+    key = plan_key(sig, "inference", nstreams, 0, nq, 0)
+    if _quarantined(key):
+        return None
+    plan = _get_plan(key, lambda: _build_inference(key, arch))
+    bq = key[4]
+    qp = np.pad(q, ((0, bq - nq), (0, 0))) if bq != nq else q
+    status, out = _guarded(
+        family, key, plan,
+        tuple(jnp.asarray(np.asarray(o, dtype=np.float32))
+              for o in ops) + (jnp.asarray(qp),), nq)
+    if status == "oom" and nq > 1:
+        h = nq // 2
+        first = inference_eval(arch, ops, q[:h], sig, family=family)
+        second = inference_eval(arch, ops, q[h:], sig, family=family)
+        if first is None or second is None:
+            return None
+        return np.concatenate([first, second], axis=1)
+    if status != "ok":
+        return None
+    return np.asarray(out)[:, :nq, :]
+
+
 def _build_repair(key: tuple, matrix: np.ndarray) -> ExecPlan:
     """The `repair` plan kind: a regenerating-code repair matmul —
     helper-side projection rows or the primary's reconstruction

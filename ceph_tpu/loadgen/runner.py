@@ -63,10 +63,19 @@ async def run_open_loop(target: Target,
         else:
             lat = loop.time() - sched_abs
             agg_h.record(lat)
-            agg_g.ok(moved)
+            # infer events carry their QUERY batch in ev.size: credit
+            # scored queries (the serving goodput unit) alongside the
+            # score bytes the target moved
+            if ev.kind == "infer":
+                agg_g.scored(ev.size, moved)
+            else:
+                agg_g.ok(moved)
             if t is not None:
                 t[0].record(lat)
-                t[1].ok(moved)
+                if ev.kind == "infer":
+                    t[1].scored(ev.size, moved)
+                else:
+                    t[1].ok(moved)
 
     for ev in merged_schedule(tenants, duration, seed):
         sched_abs = t0 + ev.t

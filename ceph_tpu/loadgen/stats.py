@@ -122,11 +122,13 @@ class GoodputMeter:
     goodput is the metric the north star is judged by, not offered
     throughput)."""
 
-    __slots__ = ("ops", "bytes", "shed", "errors", "dropped")
+    __slots__ = ("ops", "bytes", "queries", "shed", "errors",
+                 "dropped")
 
     def __init__(self) -> None:
         self.ops = 0
         self.bytes = 0
+        self.queries = 0
         self.shed = 0
         self.errors = 0
         self.dropped = 0
@@ -135,16 +137,25 @@ class GoodputMeter:
         self.ops += 1
         self.bytes += int(nbytes)
 
+    def scored(self, nqueries: int, nbytes: int) -> None:
+        """One completed `infer` op: credit the scored-query payload
+        (queries are the goodput unit of the serving workload; the
+        score bytes still count toward byte goodput)."""
+        self.ops += 1
+        self.queries += int(nqueries)
+        self.bytes += int(nbytes)
+
     def merge(self, other: "GoodputMeter") -> None:
         self.ops += other.ops
         self.bytes += other.bytes
+        self.queries += other.queries
         self.shed += other.shed
         self.errors += other.errors
         self.dropped += other.dropped
 
     def to_dict(self, elapsed_s: float) -> Dict[str, float]:
         dt = max(elapsed_s, 1e-9)
-        return {
+        out = {
             "completed": self.ops,
             "shed": self.shed,
             "errors": self.errors,
@@ -152,3 +163,7 @@ class GoodputMeter:
             "ops_per_sec": round(self.ops / dt, 2),
             "goodput_mib_s": round(self.bytes / dt / (1 << 20), 3),
         }
+        if self.queries:
+            out["queries"] = self.queries
+            out["queries_per_sec"] = round(self.queries / dt, 2)
+        return out

@@ -59,6 +59,37 @@ def _write_payload(size: int, slot: int) -> bytes:
     return _payload(size, seed=slot + 2)
 
 
+# -- coded inference serving (the `infer` blend op) -----------------------
+
+#: the one shared model every infer op scores against (stored lazily
+#: on the first infer op, so blends without `infer` pay nothing)
+INFER_MODEL = "lg-model"
+INFER_DIM = 32
+INFER_OUT = 48
+
+
+@functools.lru_cache(maxsize=256)
+def _infer_queries(nq: int, slot: int) -> np.ndarray:
+    """Deterministic query batches memoized by (batch, object slot) —
+    same rationale as _write_payload: the generator must not bill
+    query synthesis as service latency."""
+    return np.random.default_rng(0xC0DE ^ slot).standard_normal(
+        (nq, INFER_DIM)).astype(np.float32)
+
+
+def _infer_blobs():
+    """(spec, blobs) for the shared loadgen model with a fixed
+    host-side layout — the read-then-infer substrate for targets
+    whose pool has no coded serving layout (replicated pools, the
+    embedded slice)."""
+    from ceph_tpu.inference import registry
+
+    return registry.build(
+        INFER_MODEL, "linear",
+        registry.make_model("linear", INFER_DIM, INFER_OUT, seed=7),
+        k=2, m=1, chunk=1024)
+
+
 class EmbeddedTarget(Target):
     """Drives an embedded LocalCluster IoCtx (synchronous calls; the
     embedded slice has no event loop of its own to starve)."""
@@ -66,12 +97,32 @@ class EmbeddedTarget(Target):
     def __init__(self, io) -> None:
         self.io = io
         self._objects = 0
+        self._infer_spec = None
 
     async def setup(self, objects: int, object_size: int) -> None:
         data = _payload(object_size, seed=1)
         for i in range(objects):
             self.io.write_full(f"lg-{i}", data)
         self._objects = objects
+
+    def _infer(self, obj: int, nq: int) -> int:
+        """The embedded slice has no compute wire, so infer ops take
+        the read-then-infer shape (the CEPH_TPU_INFERENCE=0 path):
+        read the params object, host exact forward, credit the score
+        bytes.  The model is stored lazily on the first infer op."""
+        from ceph_tpu.inference import model as inf_model
+        from ceph_tpu.inference import registry as inf_registry
+
+        if self._infer_spec is None:
+            spec, blobs = _infer_blobs()
+            for oid, blob in blobs.items():
+                self.io.write_full(oid, blob)
+            self._infer_spec = spec
+        spec = self._infer_spec
+        data = self.io.read(inf_registry.params_oid(INFER_MODEL))
+        scores = inf_model.exact_forward(
+            spec, data, _infer_queries(max(nq, 1), obj & 7))
+        return scores.nbytes
 
     async def op(self, tenant: str, kind: str, obj: int,
                  size: int) -> int:
@@ -85,6 +136,8 @@ class EmbeddedTarget(Target):
         if kind == "stat":
             io.stat(name)
             return 0
+        if kind == "infer":
+            return self._infer(obj, size)
         # write: per-tenant namespace so writers never collide with
         # the shared read set
         io.write_full(f"lg-w-{tenant}-{obj & 7}",
@@ -99,12 +152,51 @@ class RadosTarget(Target):
     def __init__(self, io) -> None:
         self.io = io
         self._objects = 0
+        self._infer_spec = None
+        self._infer_via_read = False
+        self._infer_lock = asyncio.Lock()
 
     async def setup(self, objects: int, object_size: int) -> None:
         data = _payload(object_size, seed=1)
         await asyncio.gather(*(self.io.write_full(f"lg-{i}", data)
                                for i in range(objects)))
         self._objects = objects
+
+    async def _infer_model(self):
+        """Lazily store the shared model: through the coded layout
+        (store_model) when the pool is EC — infer ops then ride the
+        MOSDCompute serving path — else as raw objects served by the
+        client-side read-then-infer shape."""
+        from ceph_tpu.rados.client import RadosError
+
+        async with self._infer_lock:
+            if self._infer_spec is None:
+                from ceph_tpu.inference import registry
+                try:
+                    self._infer_spec = await self.io.store_model(
+                        INFER_MODEL, "linear",
+                        registry.make_model("linear", INFER_DIM,
+                                            INFER_OUT, seed=7))
+                except RadosError:
+                    spec, blobs = _infer_blobs()
+                    for oid, blob in blobs.items():
+                        await self.io.write_full(oid, blob)
+                    self._infer_spec = spec
+                    self._infer_via_read = True
+        return self._infer_spec
+
+    async def _infer(self, obj: int, nq: int) -> int:
+        from ceph_tpu.inference import model as inf_model
+        from ceph_tpu.inference import registry as inf_registry
+
+        spec = self._infer_spec or await self._infer_model()
+        queries = _infer_queries(max(nq, 1), obj & 7)
+        if self._infer_via_read:
+            data = await self.io.read(
+                inf_registry.params_oid(INFER_MODEL))
+            return inf_model.exact_forward(spec, data, queries).nbytes
+        res = await self.io.infer(spec, queries)
+        return res["scores"].nbytes
 
     async def op(self, tenant: str, kind: str, obj: int,
                  size: int) -> int:
@@ -123,6 +215,8 @@ class RadosTarget(Target):
                 if kind == "stat":
                     await io.stat(name)
                     return 0
+                if kind == "infer":
+                    return await self._infer(obj, size)
                 await io.write_full(f"lg-w-{tenant}-{obj & 7}",
                                     _write_payload(size, obj & 7))
                 return size
@@ -231,6 +325,10 @@ class S3Target(Target):
 
     async def op(self, tenant: str, kind: str, obj: int,
                  size: int) -> int:
+        if kind == "infer":
+            # no S3 verb maps to coded scoring; misconfigured blends
+            # must surface, not silently count as writes
+            raise RuntimeError("s3 target does not serve infer ops")
         if kind == "read":
             status, body = await self._request("GET", self._key(obj))
         elif kind == "ranged":

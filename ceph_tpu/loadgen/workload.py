@@ -21,7 +21,7 @@ import numpy as np
 
 from ceph_tpu.tools.rados import zipf_indices
 
-OP_KINDS = ("read", "write", "stat", "ranged")
+OP_KINDS = ("read", "write", "stat", "ranged", "infer")
 
 #: default blend: read-mostly with a write/stat/ranged tail — the
 #: object-store shape the north star describes
@@ -59,6 +59,7 @@ class TenantSpec:
     objects: int = 64                   # shared hot-set size addressed
     object_size: int = 4096             # write payload / read size
     poisson: bool = True                # False: deterministic spacing
+    infer_batch: int = 8                # queries per `infer` op
 
     def seed_for(self, base_seed: int) -> int:
         """Stable per-tenant seed: crc32 of the name folded with the
@@ -119,9 +120,13 @@ def tenant_events(spec: TenantSpec, duration: float,
     objs = zipf_indices(spec.zipf_theta, spec.objects, count,
                         seed=spec.seed_for(seed) ^ 0x5F5E5F)
     for i in range(count):
-        yield OpEvent(t=float(times[i]), tenant=spec.name,
-                      kind=kinds[int(kind_idx[i])],
-                      obj=int(objs[i]), size=spec.object_size)
+        kind = kinds[int(kind_idx[i])]
+        # infer ops size in QUERIES (the per-tenant batch knob), not
+        # payload bytes — goodput credits scored queries for them
+        yield OpEvent(t=float(times[i]), tenant=spec.name, kind=kind,
+                      obj=int(objs[i]),
+                      size=spec.infer_batch if kind == "infer"
+                      else spec.object_size)
 
 
 def merged_schedule(tenants: Iterable[TenantSpec], duration: float,

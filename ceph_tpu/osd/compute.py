@@ -128,10 +128,22 @@ class ComputeEngine:
                 except asyncio.TimeoutError:
                     return False, {oid: (EAGAIN, b"")
                                    for oid in oids}
+            # per-kernel capability, not a blanket nonlinear gate:
+            # GF-linear kernels push down exactly; approx_capable
+            # kernels (inference/) push down with a result-domain
+            # approximate combine of their own
             use_push = False
-            if pool.type == TYPE_ERASURE and kern.linear:
+            if pool.type == TYPE_ERASURE and (
+                    kern.linear or kern.approx_capable):
                 use_push = _codec_pushdown_ok(d._codec(pool.id))
             self.counters["waves"] += 1
+            if use_push and not kern.linear:
+                # approx_capable pushdown: the inference engine owns
+                # the per-shard fan-out and the Fisher result-domain
+                # combine (a GF decode of nonlinear results would be
+                # meaningless)
+                return True, await d.inference.wave(
+                    state, pool, oids, kern, msg.args, args)
             if use_push:
                 return True, await self._wave_pushdown(
                     state, pool, oids, kern, msg.args, args)
@@ -415,15 +427,12 @@ class ComputeEngine:
             # An op slot is NOT held across the wave's remote round
             # trips (a parked scan must never occupy the op queue's
             # in-flight slots while it waits on peers).
-            from ceph_tpu.osd import scheduler as sched_mod
-
             async with tracing.child_span(
                     f"compute eval {kern.name} x{len(payloads)}"):
                 evaluated = await d.scheduler.run(
-                    sched_mod.COMPUTE, 1.0 + len(payloads) / 256.0,
+                    kern.qos_class, 1.0 + len(payloads) / 256.0,
                     lambda: asyncio.to_thread(
-                        compute_mod.shard_eval_batch, kern,
-                        payloads, args))
+                        kern.shard_eval, payloads, args))
         else:
             evaluated = []
         out: List[Tuple[int, str, bytes]] = []
@@ -468,11 +477,11 @@ class ComputeEngine:
                         async with tracing.child_span(
                                 f"compute eval {kern.name}"):
                             try:
-                                # the eval charges the compute mClock
+                                # the eval charges the kernel's mClock
                                 # class (the CPU stage; the hedged
                                 # read above holds no op slot)
                                 res = await d.scheduler.run(
-                                    sched_mod.COMPUTE, 1.0,
+                                    kern.qos_class, 1.0,
                                     lambda: asyncio.to_thread(
                                         kern.reference, data, args,
                                         k, chunk))

@@ -487,8 +487,10 @@ class OSDDaemon:
         # full-decode fallback.  Scheduled under its own `compute`
         # mClock class + the tenant admission gate.
         from ceph_tpu.osd.compute import ComputeEngine
+        from ceph_tpu.osd.inference import InferenceEngine
 
         self.compute = ComputeEngine(self)
+        self.inference = InferenceEngine(self)
         self._promote_tasks: Set[asyncio.Task] = set()
         # watch/notify: (pool, oid) -> {(client, cookie): Connection}
         self.watchers: Dict[Tuple[int, str],
@@ -708,6 +710,11 @@ class OSDDaemon:
             "statfs": (
                 lambda cmd: self._cmd_statfs(),
                 "store usage + per-pool object/byte breakdown"),
+            "inference_status": (
+                lambda cmd: self.inference.perf_dump(),
+                "coded inference serving: query/approx/fallback"
+                " counters, substituted streams, and the estimated"
+                " relative-error histogram"),
         }
 
     def _cmd_perf_dump(self) -> Dict[str, Any]:
@@ -758,6 +765,9 @@ class OSDDaemon:
         # coded-compute engine: pushdown-vs-fallback split + result
         # bytes moved (the scan observability surface)
         out["compute"] = self.compute.perf()
+        # coded inference serving: approx-vs-exact split + the
+        # est_error histogram (flattens to ceph_osd_inference_* rows)
+        out["inference"] = self.inference.perf_dump()
         # per-tenant QoS: scheduler queue/grant state + admission
         # decisions (`tenants` flattens to tenant-labeled rows)
         out["qos"] = self._qos_perf()
@@ -4342,7 +4352,11 @@ class OSDDaemon:
 
         async def body() -> None:
             kern = compute_mod.get_kernel(msg.kernel)
-            if kern is None or not kern.linear:
+            # per-kernel capability gate (not blanket linear-only):
+            # approx_capable kernels run per-shard too, with the
+            # primary doing a result-domain approximate combine
+            if kern is None or not (kern.linear or
+                                    kern.approx_capable):
                 await conn.send(MOSDSubComputeReply(msg.tid, EINVAL))
                 return
             try:

@@ -52,6 +52,11 @@ BEST_EFFORT = "background_best_effort"
 #: sits below client I/O, and the limit caps how hard a scan storm
 #: can push (scans must never starve the data path)
 COMPUTE = "compute"
+#: coded inference queries (the `infer` kernels): latency-sensitive
+#: serving, so a reservation like compute's but a tighter limit — a
+#: query storm is shed back to the client (EBUSY) before it can
+#: squeeze the data path or the compute scans
+INFERENCE = "inference"
 
 #: per-tenant client classes are `client.<tenant>`
 TENANT_PREFIX = CLIENT + "."
@@ -65,6 +70,7 @@ DEFAULT_PROFILES: Dict[str, Tuple[float, float, float]] = {
     SCRUB: (5.0, 1.0, 50.0),
     BEST_EFFORT: (0.0, 1.0, 50.0),
     COMPUTE: (10.0, 2.0, 400.0),
+    INFERENCE: (10.0, 3.0, 300.0),
 }
 
 #: bookkeeping cap for per-tenant class state: at millions of tenants

@@ -914,6 +914,114 @@ class IoCtx:
                                for oid in dict.fromkeys(oids)))
         return results, errors
 
+    # -- coded inference serving (Fisher-fused approximate scoring) --------
+
+    async def store_model(self, name: str, kind: str, params,
+                          m: int = 1, fisher_info=None
+                          ) -> Dict[str, Any]:
+        """Shard + Fisher-fuse a model into THIS EC pool's stripe
+        geometry (ceph_tpu/inference/registry): the pool's k data
+        chunks carry the k_model = k_pool - m data parameter shards
+        plus the m fused shards, and the manifest object carries the
+        calibrated spec.  Returns the spec."""
+        from ceph_tpu.ec.registry import create_erasure_code
+        from ceph_tpu.inference import registry as inf_registry
+        from ceph_tpu.osd.osdmap import TYPE_ERASURE
+
+        pool = self.pool
+        if pool.type != TYPE_ERASURE:
+            raise RadosError(-22, "store_model needs an EC pool")
+        profile = self.client.osdmap.erasure_code_profiles[
+            pool.erasure_code_profile]
+        codec = create_erasure_code(dict(profile))
+        k_pool = codec.get_data_chunk_count()
+        if not 0 < m < k_pool:
+            raise RadosError(-22, f"bad fused-shard count m={m}")
+        chunk = codec.get_chunk_size(k_pool * 4096)
+        spec, blobs = inf_registry.build(
+            name, kind, params, k_pool - m, m, chunk,
+            fisher_info=fisher_info)
+        for oid, blob in blobs.items():
+            await self.write_full(oid, blob)
+        return spec
+
+    async def load_model(self, name: str) -> Dict[str, Any]:
+        """Read + cache a stored model's manifest (the spec rides
+        every query's args, so the cache makes a query one round
+        trip, not two)."""
+        cache = getattr(self, "_model_cache", None)
+        if cache is None:
+            cache = self._model_cache = {}
+        spec = cache.get(name)
+        if spec is None:
+            import json as _json
+
+            from ceph_tpu.inference import registry as inf_registry
+
+            spec = _json.loads(
+                await self.read(inf_registry.manifest_oid(name)))
+            cache[name] = spec
+        return spec
+
+    async def infer(self, name, queries, exact: bool = False,
+                    budget: Optional[float] = None
+                    ) -> Dict[str, Any]:
+        """Score a query batch against a stored model THROUGH the
+        code (MOSDCompute `infer`): per-shard forward passes run on
+        the OSDs holding the serving streams, the primary combines
+        the first sufficient arrival set (Fisher-averaged when fused
+        shards substitute for stragglers), and the per-query error
+        budget — `osd_inference_error_budget` when None — gates every
+        approximate result.  exact=True demands the bit-exact
+        full-decode path.  Returns the decoded result dict:
+        scores (nq x out float32), mode, est_error, substituted.
+
+        Kill switch CEPH_TPU_INFERENCE=0 falls back to client-side
+        read-then-infer with the same host reference forward —
+        bit-identical result bytes, every parameter byte over the
+        wire (the parity leg tests/test_inference.py drives)."""
+        from ceph_tpu import inference as inf_mod
+        from ceph_tpu.inference import kernels as inf_kernels
+        from ceph_tpu.inference import model as inf_model
+
+        spec = name if isinstance(name, dict) \
+            else await self.load_model(name)
+        try:
+            inf_model.validate_spec(spec)
+        except ValueError as e:
+            raise RadosError(-22, str(e))
+        if not inf_mod.env_enabled():
+            return await self._infer_client_side(spec, queries)
+        args: Dict[str, Any] = {
+            "model": spec,
+            "q": inf_kernels.encode_queries(queries),
+        }
+        if exact:
+            args["exact"] = True
+        if budget is not None:
+            args["budget"] = float(budget)
+        oid = spec["params_oid"]
+        results, errors = await self.compute(
+            inf_mod.INFER_KERNEL, [oid], args)
+        if oid not in results:
+            raise RadosError(errors.get(oid, EAGAIN),
+                             f"infer {spec.get('name')!r}")
+        return inf_kernels.decode_result(results[oid])
+
+    async def _infer_client_side(self, spec: Dict[str, Any],
+                                 queries) -> Dict[str, Any]:
+        """CEPH_TPU_INFERENCE=0: read the whole params object and run
+        the host reference forward — the same exact_forward + blob
+        the engine's exact fallback uses, so the result bytes are
+        bit-identical to exact=True serving."""
+        from ceph_tpu.inference import kernels as inf_kernels
+        from ceph_tpu.inference import model as inf_model
+
+        data = await self.read(spec["params_oid"])
+        scores = inf_model.exact_forward(spec, data, queries)
+        return inf_kernels.decode_result(
+            inf_kernels.result_blob(scores, "exact", 0.0, 0))
+
     async def setxattr(self, oid: str, name: str, value: bytes) -> None:
         reply = await self._submit(
             oid, [OSDOp("setxattr", data=value, args={"name": name})])

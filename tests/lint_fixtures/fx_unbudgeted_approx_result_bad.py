@@ -1,0 +1,21 @@
+"""Seeded violation: an approximate combine (least-squares solve of
+the missing shard contributions) returned without ever consulting the
+error-budget gate."""
+
+import numpy as np
+
+from ceph_tpu.inference import model
+
+
+def combine_missing(spec, data_parts, fused_parts, budget):
+    k = int(spec["k"])
+    missing = [i for i in range(k) if i not in data_parts]
+    a = np.asarray(spec["coeff"], dtype=np.float64)
+    sub = a[np.asarray(sorted(fused_parts))][:, np.asarray(missing)]
+    rhs = np.stack([fused_parts[j].reshape(-1)
+                    for j in sorted(fused_parts)])
+    sol, _resid, _rank, _sv = np.linalg.lstsq(sub, rhs, rcond=None)
+    parts = [data_parts.get(i) for i in range(k)]
+    for row, i in enumerate(missing):
+        parts[i] = sol[row].reshape(parts[0].shape)
+    return model.combine_contributions(spec, parts)  # expect: unbudgeted-approx-result

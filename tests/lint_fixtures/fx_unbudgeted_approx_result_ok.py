@@ -1,0 +1,37 @@
+"""Clean twins: the approximate combine gated on check_budget, a
+pure solver helper (no combined scores synthesized), and an exact
+path (no solve)."""
+
+import numpy as np
+
+from ceph_tpu.inference import model
+from ceph_tpu.inference.fisher import check_budget
+
+
+def combine_missing(spec, data_parts, fused_parts, budget, est):
+    k = int(spec["k"])
+    missing = [i for i in range(k) if i not in data_parts]
+    a = np.asarray(spec["coeff"], dtype=np.float64)
+    sub = a[np.asarray(sorted(fused_parts))][:, np.asarray(missing)]
+    rhs = np.stack([fused_parts[j].reshape(-1)
+                    for j in sorted(fused_parts)])
+    sol, _resid, _rank, _sv = np.linalg.lstsq(sub, rhs, rcond=None)
+    if not check_budget(est, budget):
+        return None
+    parts = [data_parts.get(i) for i in range(k)]
+    for row, i in enumerate(missing):
+        parts[i] = sol[row].reshape(parts[0].shape)
+    return model.combine_contributions(spec, parts)
+
+
+def solver_gain(coeff, fused_ids, missing):
+    """Solver internals only: no combined scores leave this scope."""
+    sub = np.asarray(coeff)[np.asarray(fused_ids)][:,
+                                                   np.asarray(missing)]
+    pinv = np.linalg.pinv(sub)
+    return pinv, float(np.linalg.norm(pinv, 2))
+
+
+def exact_combine(spec, parts):
+    """Exact path: no solve happened, nothing to budget."""
+    return model.combine_contributions(spec, parts)

@@ -23,7 +23,8 @@ CONTRACT_KEYS = {"metric", "value", "unit", "vs_baseline",
                  "plan_cache", "encode_service", "tier",
                  "device_health", "tail", "load", "durability",
                  "mesh", "multihost", "trace", "group_commit",
-                 "compute", "xsched", "spmd", "repair", "truncated"}
+                 "compute", "xsched", "spmd", "repair", "inference",
+                 "truncated"}
 
 
 def test_contract_line_despite_hanging_backend(tmp_path):
@@ -193,6 +194,21 @@ def test_contract_line_despite_hanging_backend(tmp_path):
     assert rp["patterns_bitexact"] == rp["k"] + rp["m"]
     assert rp["alpha"] == rp["d"] - rp["k"] + 1
     assert 0 < rp["bytes_ratio_vs_kread"] < 1
+    # the coded-inference probe ran: the full-set Fisher combine is
+    # bit-exact against the host oracle, every single-shard-loss
+    # pattern stayed within the error budget with an honest estimate
+    # (rel <= est <= budget), and the hedged sub-infer straggler leg
+    # completed from the first sufficient arrival set (slow stream
+    # substituted by a fused shard, straggler cancelled)
+    inf = contract["inference"]
+    assert inf["bitexact"] == 1
+    assert inf["within_budget"] == 1
+    assert inf["patterns"] >= 3
+    assert inf["max_rel_err"] <= inf["max_est_error"] <= inf["budget"]
+    assert inf["straggler_avoided"] == 1
+    assert inf["straggler_within_budget"] == 1
+    assert inf["substituted_streams"] >= 1
+    assert inf["cancelled_subinfers"] >= 1
     assert contract["truncated"] is False
     # details stayed out of stdout (they belong in bench_details.json)
     assert len(stdout_lines) == 1
@@ -259,6 +275,10 @@ def test_budget_truncates_optional_sections(tmp_path):
     # and the small-op open-loop section
     assert "smallop" in details["skipped_sections"]
     assert "smallop_modes" not in details
+    # and the coded-inference serving section (its `inference`
+    # contract key is pre-contract and still rides, budget permitting)
+    assert "inference" in details["skipped_sections"]
+    assert "inference_modes" not in details
 
 
 def test_watchdog_contract_line_survives_outer_kill(tmp_path):

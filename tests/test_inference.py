@@ -1,0 +1,320 @@
+"""Coded inference serving tier (ceph_tpu/inference): the Fisher
+algebra's bound-honesty property sweep on the host (every arrival
+pattern either refuses or serves with true error <= the estimate <=
+the budget), the exact-path bit-parity contract, and the live-cluster
+legs — CEPH_TPU_INFERENCE=0 read-then-infer parity, approximate
+serving within budget under shard loss, and the hedged straggler
+leg completing without the slow stream holder."""
+
+import asyncio
+import itertools
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cluster_helpers import Cluster
+from ceph_tpu.inference import fisher, kernels, model, registry
+
+EC32 = {"plugin": "ec_jax", "technique": "reed_sol_van",
+        "k": "3", "m": "2", "crush-failure-domain": "osd"}
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 180))
+
+
+def _built(kind, k, m, dim=24, out=36, seed=5, chunk=512):
+    hidden = 24 if kind == "mlp" else 0  # divisible by every k here
+    spec, blobs = registry.build(
+        f"t-{kind}-{k}-{m}", kind,
+        registry.make_model(kind, dim, out, seed=seed,
+                            hidden=hidden), k, m, chunk)
+    data = blobs[registry.params_oid(spec["name"])]
+    streams = model.object_streams(spec, data)
+    return spec, data, streams
+
+
+def _queries(spec, nq=12, seed=3):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((nq, int(spec["dim"])
+                                )).astype(np.float32)
+
+
+# -- host property suite ---------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["linear", "mlp"])
+def test_exact_combine_bit_parity(kind):
+    """All k data contributions through fisher.combine are BIT-equal
+    to the whole-object oracle — the contract every exact serving
+    path (engine fallback, kill switch) leans on."""
+    spec, data, streams = _built(kind, 3, 2)
+    q = _queries(spec)
+    parts = {i: model.shard_forward(spec, streams[i], q)
+             for i in range(3)}
+    res = fisher.combine(spec, parts, {}, q, 0.01)
+    assert res is not None
+    scores, est, substituted = res
+    assert est == 0.0 and substituted == 0
+    assert scores.tobytes() == \
+        model.exact_forward(spec, data, q).tobytes()
+
+
+@pytest.mark.parametrize("kind,k,m", [("linear", 2, 1),
+                                      ("linear", 3, 2),
+                                      ("linear", 4, 2),
+                                      ("mlp", 2, 1),
+                                      ("mlp", 3, 2)])
+def test_bound_honesty_across_all_patterns(kind, k, m):
+    """EVERY (data subset, fused subset) arrival pattern either
+    refuses (structural_error None when |missing| > |fused answered|
+    — nothing to solve with) or serves with true relative error <=
+    the estimate.  The estimate is what the budget gate prices, so an
+    estimate below the truth would let over-budget scores through."""
+    spec, data, streams = _built(kind, k, m)
+    q = _queries(spec)
+    exact = model.exact_forward(spec, data, q)
+    eref = float(np.linalg.norm(exact)) or 1.0
+    parts = {i: model.shard_forward(spec, streams[i], q)
+             for i in range(k)}
+    fused = {j: model.shard_forward(spec, streams[k + j], q)
+             for j in range(m)}
+    served = refused = 0
+    for nd in range(k + 1):
+        for dsub in itertools.combinations(range(k), nd):
+            for nf in range(m + 1):
+                for fsub in itertools.combinations(range(m), nf):
+                    dp = {i: parts[i] for i in dsub}
+                    fp = {j: fused[j] for j in fsub}
+                    # budget None: accept ANY estimate, so serve
+                    # whenever the pattern is solvable at all
+                    res = fisher.combine(spec, dp, fp, q, None)
+                    if k - nd > nf:
+                        assert res is None  # underdetermined
+                        refused += 1
+                        continue
+                    assert res is not None, (dsub, fsub)
+                    scores, est, substituted = res
+                    assert substituted == k - nd
+                    rel = float(np.linalg.norm(scores - exact)) / eref
+                    assert rel <= max(est, 1e-6), (dsub, fsub, rel,
+                                                   est)
+                    served += 1
+    assert served and refused
+
+
+@pytest.mark.parametrize("kind,k,m", [("linear", 3, 2), ("mlp", 3, 1)])
+def test_budget_gate_refuses_over_budget_patterns(kind, k, m):
+    """A vanishing budget refuses every lossy pattern (est > 0) while
+    still serving the full data set (est == 0) — the gate is the
+    engine's exact-fallback trigger, not a soft preference."""
+    spec, data, streams = _built(kind, k, m)
+    q = _queries(spec)
+    parts = {i: model.shard_forward(spec, streams[i], q)
+             for i in range(k)}
+    fused = {j: model.shard_forward(spec, streams[k + j], q)
+             for j in range(m)}
+    assert fisher.combine(spec, parts, {}, q, 1e-300) is not None
+    for drop in range(k):
+        dp = {i: parts[i] for i in range(k) if i != drop}
+        assert fisher.combine(spec, dp, fused, q, 1e-300) is None
+        assert fisher.combine(spec, dp, fused, q, None) is not None
+
+
+def test_structural_error_prices_patterns_before_results():
+    """The hedged gather's sufficiency predicate: structural_error is
+    a pure function of WHICH streams answered, monotone enough to
+    rank patterns — full data prices 0, every lossy pattern prices
+    > 0, unsolvable prices None."""
+    spec, _data, _streams = _built("linear", 3, 2)
+    qscale = fisher.query_scale(_queries(spec))
+    assert fisher.structural_error(spec, [0, 1, 2], [], qscale) == 0.0
+    lossy = fisher.structural_error(spec, [0, 1], [0], qscale)
+    assert lossy is not None and lossy > 0.0
+    assert fisher.structural_error(spec, [0], [0], qscale) is None
+    assert fisher.structural_error(spec, [0, 1], [], qscale) is None
+
+
+def test_result_blob_roundtrip_and_exact_mode_bytes():
+    """The wire result blob: decode(inverse) recovers scores, mode,
+    est_error, substituted; two exact blobs over the same scores are
+    byte-identical (what the kill-switch parity leg compares)."""
+    scores = np.arange(24, dtype=np.float32).reshape(4, 6) / 7.0
+    blob = kernels.result_blob(scores, "approx", 0.0125, 2)
+    out = kernels.decode_result(blob)
+    assert out["scores"].tobytes() == scores.tobytes()
+    assert out["mode"] == "approx"
+    assert out["est_error"] == pytest.approx(0.0125)
+    assert out["substituted"] == 2
+    assert kernels.result_blob(scores, "exact", 0.0, 0) == \
+        kernels.result_blob(scores.copy(), "exact", 0.0, 0)
+
+
+def test_validate_spec_rejects_malformed_manifests():
+    """Manifests come off the wire: structural garbage must raise
+    ValueError (the engine maps it to EINVAL), never KeyError."""
+    spec, _data, _streams = _built("linear", 2, 1)
+    model.validate_spec(spec)
+    for mutate in (lambda s: s.pop("kind"),
+                   lambda s: s.update(kind="rnn"),
+                   lambda s: s.update(k=0),
+                   lambda s: s.update(shard_rows=[1])):
+        bad = dict(spec)
+        mutate(bad)
+        with pytest.raises(ValueError):
+            model.validate_spec(bad)
+
+
+# -- live-cluster legs -----------------------------------------------------
+
+
+async def _serving_cluster(kind="linear", dim=32, out=64, seed=21):
+    cluster = Cluster(num_osds=5, osds_per_host=5,
+                      osd_config={"osd_heartbeat_interval": 3.0,
+                                  "osd_heartbeat_grace": 30.0})
+    await cluster.start()
+    await cluster.client.create_ec_pool("ipool", profile=EC32,
+                                        pg_num=8)
+    io = cluster.client.open_ioctx("ipool")
+    spec = await io.store_model(
+        "m0", kind, registry.make_model(kind, dim, out, seed=seed),
+        m=1)
+    return cluster, io, spec
+
+
+def test_killswitch_parity_and_approx_budget_live():
+    """The acceptance parity leg: exact=True serving through the code
+    is BIT-identical to CEPH_TPU_INFERENCE=0 client-side
+    read-then-infer; default-budget serving stays within the budget
+    of the exact scores and the engine counters attribute the ops."""
+    async def main():
+        cluster, io, spec = await _serving_cluster()
+        try:
+            budget = 0.05
+            rng = np.random.default_rng(2)
+            for _ in range(6):
+                q = rng.standard_normal((8, 32)).astype(np.float32)
+                ex = await io.infer(spec, q, exact=True)
+                assert ex["mode"] == "exact"
+                assert ex["est_error"] == 0.0
+                os.environ["CEPH_TPU_INFERENCE"] = "0"
+                try:
+                    ref = await io.infer(spec, q)
+                finally:
+                    del os.environ["CEPH_TPU_INFERENCE"]
+                assert ref["mode"] == "exact"
+                assert ex["scores"].tobytes() == \
+                    ref["scores"].tobytes()
+                served = await io.infer(spec, q, budget=budget)
+                assert served["est_error"] <= budget
+                rel = float(np.linalg.norm(
+                    served["scores"] - ex["scores"]) /
+                    max(np.linalg.norm(ex["scores"]), 1e-12))
+                assert rel <= budget
+            counters = {}
+            for osd in cluster.osds.values():
+                for key, v in osd.inference.perf_dump().items():
+                    if isinstance(v, int):
+                        counters[key] = counters.get(key, 0) + v
+            assert counters["ops"] >= 12  # exact + budget legs
+            assert counters["exact_fallbacks"] >= 6
+            assert counters["errors"] == 0
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_shard_loss_served_within_budget_live():
+    """A DEAD serving-stream holder: queries keep serving through the
+    survivors (fused substitution or full-decode fallback), always
+    within budget of the pre-loss exact scores."""
+    async def main():
+        cluster, io, spec = await _serving_cluster()
+        try:
+            budget = 0.05
+            q = np.random.default_rng(4).standard_normal(
+                (8, 32)).astype(np.float32)
+            ex = await io.infer(spec, q, exact=True)
+            pg = io.object_pg(spec["params_oid"])
+            acting, primary = \
+                cluster.mon.osdmap.pg_to_acting_osds(pg)
+            nstreams = int(spec["k"]) + int(spec["m"])
+            victim = next(o for o in acting[:nstreams]
+                          if o != primary and o >= 0)
+            await cluster.kill_osd(victim)
+            await cluster.wait_for_osd_down(victim)
+            await cluster.wait_for_clean(60.0)
+            res = await io.infer(spec, q, budget=budget)
+            assert res["est_error"] <= budget
+            rel = float(np.linalg.norm(res["scores"] - ex["scores"])
+                        / max(np.linalg.norm(ex["scores"]), 1e-12))
+            assert rel <= budget
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_straggler_first_sufficient_live():
+    """One slow serving-stream holder: the hedged sub-infer fan-out
+    completes from the first structurally-sufficient arrival set in a
+    small fraction of the injected delay, within budget."""
+    async def main():
+        delay = 2.0
+        cluster, io, spec = await _serving_cluster()
+        try:
+            budget = 0.05
+            q = np.random.default_rng(6).standard_normal(
+                (8, 32)).astype(np.float32)
+            ex = await io.infer(spec, q, exact=True)
+            await io.infer(spec, q)  # warm plans + admission
+            pg = io.object_pg(spec["params_oid"])
+            acting, primary = \
+                cluster.mon.osdmap.pg_to_acting_osds(pg)
+            nstreams = int(spec["k"]) + int(spec["m"])
+            slow = next(o for o in acting[:nstreams]
+                        if o != primary and o >= 0)
+            cluster.osds[slow].msgr.inject_internal_delays = delay
+            try:
+                t0 = time.monotonic()
+                res = await io.infer(spec, q, budget=budget)
+                elapsed = time.monotonic() - t0
+            finally:
+                cluster.osds[slow].msgr.inject_internal_delays = 0
+            assert elapsed < delay, elapsed
+            assert res["est_error"] <= budget
+            rel = float(np.linalg.norm(res["scores"] - ex["scores"])
+                        / max(np.linalg.norm(ex["scores"]), 1e-12))
+            assert rel <= budget
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_store_model_demands_ec_pool_and_validates():
+    """store_model on a replicated pool and infer with a malformed
+    spec both surface EINVAL-shaped RadosError, not engine
+    tracebacks."""
+    async def main():
+        from ceph_tpu.rados.client import RadosError
+
+        cluster = Cluster(num_osds=4)
+        await cluster.start()
+        try:
+            await cluster.client.create_replicated_pool(
+                "rp", size=3, pg_num=8)
+            io = cluster.client.open_ioctx("rp")
+            with pytest.raises(RadosError):
+                await io.store_model(
+                    "m1", "linear",
+                    registry.make_model("linear", 8, 8, seed=1))
+            with pytest.raises(RadosError):
+                await io.infer({"kind": "rnn"}, np.zeros((1, 8)))
+        finally:
+            await cluster.stop()
+
+    run(main())

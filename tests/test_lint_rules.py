@@ -35,6 +35,7 @@ RULES = [
     "unhedged-gather",
     "span-leak",
     "unbounded-latency-buffer",
+    "unbudgeted-approx-result",
     "commit-before-durability",
     "async-blocking",
     "sync-encode-in-async",
@@ -61,6 +62,7 @@ CONFIG = {"dtype_paths": ("fx_uint8",),
           "compute_paths": ("fx_unplanned_compute_dispatch",),
           "gather_paths": ("fx_unhedged_gather",),
           "latency_paths": ("fx_unbounded_latency_buffer",),
+          "approx_paths": ("fx_unbudgeted_approx_result",),
           "durability_paths": ("fx_commit_before_durability",),
           "atomicity_paths": ("fx_await_atomicity",),
           "cancel_paths": ("fx_cancellation_unsafe_acquire",),
