@@ -74,6 +74,7 @@ def _emit_contract(value: Optional[float],
                    spmd: Optional[dict] = None,
                    repair: Optional[dict] = None,
                    inference: Optional[dict] = None,
+                   chaos: Optional[dict] = None,
                    truncated: bool = False) -> None:
     """Print the one-line JSON driver contract, exactly once, before
     any optional extended benches run — a wedged tunnel or a crashed
@@ -113,7 +114,11 @@ def _emit_contract(value: Optional[float],
     every single-shard-loss pattern served from the Fisher-fused
     substitutes within the error budget, the hedged sub-infer
     straggler leg completing from the first structurally-sufficient
-    arrival set);
+    arrival set), chaos the compound-chaos probe (a seeded composed
+    3-hazard scenario — stragglers x device faults x kill-switch
+    flips — over live multi-tenant traffic with every invariant
+    monitor armed: the seed is echoed so any violation replays, and
+    violations must be 0);
     truncated flags a budget-shortened run.  Thread-safe:
     the deadline watchdog and the bench body may race to emit."""
     global _contract_emitted
@@ -143,6 +148,7 @@ def _emit_contract(value: Optional[float],
             "spmd": spmd,
             "repair": repair,
             "inference": inference,
+            "chaos": chaos,
             "truncated": bool(truncated),
         }), flush=True)
 
@@ -2310,8 +2316,8 @@ def bench_qos() -> dict:
     # slot per OSD the serving primary's capacity is ~100 ops/s —
     # A's 10x flood (300/s) oversubscribes it 3x, which is exactly
     # the regime QoS exists for.  A's mClock limit sits at ~its 1x
-    # offer (limits are PER OSD, the dmclock scope); B rides a
-    # reservation.  The read tier is disabled for both legs — it
+    # offer (held cluster-wide by the delta/rho piggyback,
+    # CEPH_TPU_DMCLOCK); B rides a reservation.  The read tier is disabled for both legs — it
     # would serve the hot set from memory and measure cache
     # residency, not scheduling.
     a_rate, b_rate = 30.0, 10.0
@@ -2400,6 +2406,196 @@ def bench_qos() -> dict:
         "qos_b_p99_degradation_on_x": ratio(on),
         "qos_b_p99_degradation_off_x": ratio(off),
         "qos_isolation_held": held,
+    }
+
+
+def _chaos_probe() -> Optional[dict]:
+    """Pre-contract probe of the compound-chaos engine
+    (ceph_tpu/chaos/): a seeded composed 3-hazard scenario —
+    messenger stragglers x probabilistic device faults x live
+    kill-switch flips — over open-loop two-tenant traffic on a live
+    loopback cluster, with every invariant monitor armed (zero client
+    errors, bit-exact readback, durability sweep, leak audit).  The
+    counters land in the contract line's `chaos` key with the seed
+    echoed, so a violating round replays from the contract line
+    alone.  None (with a stderr note) when the probe cannot run."""
+    return _probe_on_daemon_thread(
+        "chaos", _chaos_probe_body,
+        "CEPH_TPU_BENCH_CHAOS_PROBE_TIMEOUT", "120")
+
+
+def _chaos_probe_body() -> dict:
+    import asyncio
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_helpers import Cluster
+    from ceph_tpu.chaos import compose, run_scenario
+    from ceph_tpu.loadgen import TenantSpec
+
+    seed = int(os.environ.get("CEPH_TPU_BENCH_CHAOS_SEED", "20107"))
+    duration = float(os.environ.get("CEPH_TPU_BENCH_CHAOS_S",
+                                    "3.0" if _SMOKE else "5.0"))
+
+    async def run() -> dict:
+        cluster = Cluster(num_osds=4)
+        await cluster.start()
+        try:
+            sc = compose(
+                seed=seed, duration=duration,
+                tenants=[TenantSpec(f"t{i}", arrival_rate=30.0,
+                                    objects=16, object_size=4096)
+                         for i in range(2)],
+                osd_ids=[0, 1, 2, 3],
+                hazards=("straggler", "device_fail", "kill_switch"),
+                p99_bounds={"t0": 5000.0, "t1": 5000.0},
+                objects=16, object_size=4096)
+            return await run_scenario(cluster, sc)
+        finally:
+            await cluster.stop()
+
+    rep = asyncio.run(asyncio.wait_for(run(), 110))
+    return {
+        "seed": rep["seed"],
+        "duration_s": duration,
+        "events_fired": len(rep["events_fired"]),
+        "hazards": sorted({e["hazard"]
+                           for e in rep["events_fired"]}),
+        "reads_verified": rep["reads_verified"],
+        "acked_writes_swept": rep["acked_writes_swept"],
+        "flag_flips": rep["flag_flips"],
+        "errors": rep["loadgen"]["errors"],
+        "violations": len(rep["violations"]),
+    }
+
+
+def bench_chaos() -> dict:
+    """The full compound matrix, budget-gated: >= 20 s of open-loop
+    three-tenant traffic x all six hazard kinds (stragglers, device
+    faults, host loss, kill-switch flips, power-cut kill/revive on
+    persistent FaultStore OSDs, drain/backfill) with zero tolerated
+    violations, plus the dmClock delta/rho legs: a limit-capped
+    tenant's completed rate with the piggyback ON (~its limit,
+    cluster-wide) vs OFF (~N_primaries x its limit, the per-OSD-only
+    hole).  The worst completed op's retained trace tree ships in
+    bench_details.json as the exemplar even on a green run."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from cluster_helpers import Cluster, tpustore_factory
+    from ceph_tpu.chaos import compose, run_scenario
+    from ceph_tpu.chaos.monitors import capture_worst_op
+    from ceph_tpu.common import flags
+    from ceph_tpu.loadgen import (
+        RadosTarget, TenantSpec, run_open_loop,
+    )
+
+    seed = int(os.environ.get("CEPH_TPU_BENCH_CHAOS_SEED", "20107"))
+    duration = float(os.environ.get("CEPH_TPU_BENCH_CHAOS_FULL_S",
+                                    "25.0"))
+    t0 = time.monotonic()
+    workdir = tempfile.mkdtemp(prefix="bench-chaos-")
+    prev_ci = flags.peek("CEPH_TPU_CRASH_INJECT")
+    flags.set_flag("CEPH_TPU_CRASH_INJECT", "1")
+
+    async def matrix() -> dict:
+        cluster = Cluster(num_osds=6, persistent=True,
+                          store_factory=tpustore_factory(
+                              workdir, fault=True),
+                          osd_config={"osd_max_backfills": 1})
+        await cluster.start()
+        try:
+            sc = compose(
+                seed=seed, duration=duration,
+                tenants=[TenantSpec(f"t{i}", arrival_rate=25.0,
+                                    objects=24, object_size=8192)
+                         for i in range(3)],
+                osd_ids=list(range(6)),
+                hazards=("straggler", "device_fail", "host_down",
+                         "kill_switch", "powercut", "drain"),
+                persistent_osds=list(range(1, 6)),
+                protected_osds=[0],
+                p99_bounds={f"t{i}": 10_000.0 for i in range(3)},
+                objects=24, object_size=8192)
+            rep = await run_scenario(cluster, sc, pool_size=3)
+            # exemplar even when green: the slowest op the storm
+            # produced, with its retained span tree when the tail
+            # policy kept one
+            rep.setdefault("worst_op", capture_worst_op(cluster))
+            return rep
+        finally:
+            await cluster.stop()
+
+    async def dmclock_leg(enabled: str) -> dict:
+        profiles = json.dumps({"capped": [0.0, 1.0, 25.0]})
+        cluster = Cluster(num_osds=4, osd_config={
+            "osd_mclock_tenant_profiles": profiles})
+        await cluster.start()
+        prev = flags.peek("CEPH_TPU_DMCLOCK")
+        flags.set_flag("CEPH_TPU_DMCLOCK", enabled)
+        try:
+            await cluster.client.create_replicated_pool(
+                "qos", size=2, pg_num=32)
+            target = RadosTarget(cluster.client.open_ioctx("qos"))
+            await target.setup(32, 4096)
+            rep = await run_open_loop(
+                target,
+                [TenantSpec("capped", arrival_rate=80.0,
+                            blend={"read": 1.0}, objects=32,
+                            object_size=4096)],
+                4.0, seed=seed, per_tenant=["capped"])
+            t = rep["per_tenant"]["capped"]
+            return {"rate_ops_s": round(
+                        t["completed"] / max(rep["elapsed_s"], 1e-9),
+                        2),
+                    "p99_ms": t["p99_ms"],
+                    "errors": rep["errors"]}
+        finally:
+            if prev is None:
+                flags.clear("CEPH_TPU_DMCLOCK")
+            else:
+                flags.set_flag("CEPH_TPU_DMCLOCK", prev)
+            await cluster.stop()
+
+    try:
+        rep = asyncio.run(asyncio.wait_for(matrix(), 300))
+        dm_on = asyncio.run(asyncio.wait_for(dmclock_leg("1"), 120))
+        dm_off = asyncio.run(asyncio.wait_for(dmclock_leg("0"), 120))
+    finally:
+        if prev_ci is None:
+            flags.clear("CEPH_TPU_CRASH_INJECT")
+        else:
+            flags.set_flag("CEPH_TPU_CRASH_INJECT", prev_ci)
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    per_tenant = {
+        name: {"p99_ms": t.get("p99_ms"),
+               "ops_per_sec": t.get("ops_per_sec"),
+               "goodput_mib_s": t.get("goodput_mib_s"),
+               "errors": t.get("errors")}
+        for name, t in rep["loadgen"].get("per_tenant", {}).items()}
+    return {
+        "chaos_seed": rep["seed"],
+        "chaos_duration_s": duration,
+        "chaos_events_fired": len(rep["events_fired"]),
+        "chaos_hazards": sorted({e["hazard"]
+                                 for e in rep["events_fired"]}),
+        "chaos_powercuts": rep["powercuts"],
+        "chaos_reads_verified": rep["reads_verified"],
+        "chaos_acked_writes_swept": rep["acked_writes_swept"],
+        "chaos_flag_flips": rep["flag_flips"],
+        "chaos_violations": rep["violations"],
+        "chaos_per_tenant": per_tenant,
+        "chaos_worst_op": rep.get("worst_op"),
+        "chaos_dmclock_on": dm_on,
+        "chaos_dmclock_off": dm_off,
+        "chaos_dmclock_separation_x": round(
+            dm_off["rate_ops_s"] / max(dm_on["rate_ops_s"], 1e-9),
+            2),
+        "chaos_seconds": round(time.monotonic() - t0, 1),
     }
 
 
@@ -3351,6 +3547,10 @@ def main() -> None:
     # loss within the error budget, and the hedged straggler leg
     # first-sufficient without the slow stream
     inference_counters = _inference_probe()
+    # compound-chaos probe (before the contract): a seeded composed
+    # 3-hazard scenario over live traffic, every invariant monitor
+    # armed, violations=0 and the seed echoed for replay
+    chaos_counters = _chaos_probe()
 
     # the driver contract line, before every optional/extended bench:
     # a wedge below this point can cost detail rows, never the bench
@@ -3370,6 +3570,7 @@ def main() -> None:
                    spmd=spmd_counters,
                    repair=repair_counters,
                    inference=inference_counters,
+                   chaos=chaos_counters,
                    truncated=skip_optional)
 
     # decode sweep over 1..m erasures (the reference benchmark sweeps
@@ -3615,6 +3816,22 @@ def main() -> None:
         except Exception as e:
             print(f"# qos bench failed: {e!r}", file=sys.stderr)
 
+    # compound-chaos section: the full six-hazard matrix over a
+    # persistent cluster with zero tolerated violations, the dmClock
+    # delta/rho on/off legs, and the worst-op trace exemplar.  Live
+    # clusters x3: out of smoke mode (the composed-matrix regression
+    # lives in the test tier's slow leg)
+    chaos_section: dict = {}
+    if _SMOKE:
+        pass
+    elif skip_optional:
+        skipped_sections.append("chaos")
+    else:
+        try:
+            chaos_section = bench_chaos()
+        except Exception as e:
+            print(f"# chaos bench failed: {e!r}", file=sys.stderr)
+
     details = {
         "encode_gibs": enc_gibs,
         "encode_path": "pallas_words" if use_pallas else "xla_bitplanes",
@@ -3645,6 +3862,7 @@ def main() -> None:
         **load_section,
         **durability_section,
         **qos_section,
+        **chaos_section,
         "encode_service": service_counters,
         "tier": tier_counters,
         "device_health": device_health_counters,
@@ -3659,6 +3877,7 @@ def main() -> None:
         "xsched": xsched_counters,
         "repair": repair_counters,
         "inference": inference_counters,
+        "chaos": chaos_counters,
         "host_cores": os.cpu_count(),
         "encode_ms_per_batch": t_enc * 1e3,
         "k": k, "m": m, "chunk_bytes": chunk, "batch": batch,

@@ -62,6 +62,8 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import os
+
+from ceph_tpu.common import flags
 import random
 from dataclasses import dataclass
 from typing import Iterator, List, Optional, Set, Tuple
@@ -74,7 +76,7 @@ __all__ = [
     "clear_collective_records", "collective_sites",
 ]
 
-enabled = os.environ.get("CEPH_TPU_INTERLEAVE", "0") == "1"
+enabled = flags.get("CEPH_TPU_INTERLEAVE") == "1"
 
 #: cap on retained triples: the cross-check needs site coverage, not
 #: an unbounded event log (a cluster test wakes tasks ~1e5 times)
@@ -128,8 +130,8 @@ _collective_seq = 0
 
 
 def collective_trace_armed() -> bool:
-    return bool(os.environ.get("CEPH_TPU_COLLECTIVE_TRACE") == "1"
-                or os.environ.get("CEPH_TPU_COLLECTIVE_TRACE_FILE"))
+    return bool(flags.get("CEPH_TPU_COLLECTIVE_TRACE") == "1"
+                or flags.get("CEPH_TPU_COLLECTIVE_TRACE_FILE"))
 
 
 def collective_records() -> List[CollectiveRecord]:
@@ -187,7 +189,7 @@ def record_collective(op: str, kind: str, topic: str = "",
                            seq=_collective_seq)
     if len(_collective_records) < RECORD_CAP:
         _collective_records.append(rec)
-    path = os.environ.get("CEPH_TPU_COLLECTIVE_TRACE_FILE")
+    path = flags.get("CEPH_TPU_COLLECTIVE_TRACE_FILE")
     if path:
         import json
         try:
@@ -299,7 +301,7 @@ def install_if_enabled() -> bool:
     """conftest hook: arm the policy when CEPH_TPU_INTERLEAVE=1."""
     if not enabled:
         return False
-    seed = int(os.environ.get("CEPH_TPU_INTERLEAVE_SEED", "0"))
+    seed = flags.flag_int("CEPH_TPU_INTERLEAVE_SEED")
     asyncio.set_event_loop_policy(InterleavePolicy(seed))
     global _recording
     _recording = True

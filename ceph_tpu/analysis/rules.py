@@ -1367,6 +1367,89 @@ def rule_commit_before_durability(a: Analyzer) -> None:
                             scope_line=fi.lineno)
 
 
+
+# ---------------------------------------------------------------------
+# unregistered-kill-switch
+# ---------------------------------------------------------------------
+
+# the one module allowed to touch os.environ with CEPH_TPU_ literals:
+# the kill-switch registry itself
+_KILL_SWITCH_REGISTRY_PATHS = ("common/flags.py",)
+# environ accessors whose literal first argument is a flag read/write
+_ENVIRON_METHODS = {"get", "getenv", "setdefault", "pop"}
+
+
+def _mentions_environ(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "environ":
+            return True
+        if isinstance(sub, ast.Name) and sub.id == "environ":
+            return True
+    return False
+
+
+def _kill_switch_key(node: ast.AST) -> Optional[str]:
+    """The CEPH_TPU_* literal this node reads/writes straight off the
+    process environment, or None."""
+
+    def lit(e):
+        if isinstance(e, ast.Constant) and isinstance(e.value, str) \
+                and e.value.startswith("CEPH_TPU_"):
+            return e.value
+        return None
+
+    if isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and node.args:
+        # os.environ.get/setdefault/pop("CEPH_TPU_X"), os.getenv(...)
+        if node.func.attr == "getenv" or (
+                node.func.attr in _ENVIRON_METHODS
+                and _mentions_environ(node.func.value)):
+            return lit(node.args[0])
+    if isinstance(node, ast.Subscript) and \
+            _mentions_environ(node.value):
+        # os.environ["CEPH_TPU_X"] — read or assignment
+        return lit(node.slice)
+    if isinstance(node, ast.Compare) and \
+            isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+            _mentions_environ(node.comparators[0]):
+        # "CEPH_TPU_X" in os.environ
+        return lit(node.left)
+    return None
+
+
+def rule_unregistered_kill_switch(a: Analyzer) -> None:
+    """Raw ``os.environ`` access with a ``CEPH_TPU_*`` literal outside
+    ``common/flags.py``: the switch is invisible to the registry — no
+    declared default/scope, no live-flip hook, no audit trail for the
+    chaos engine's kill-switch hazard — and its per-site default
+    string can drift.  Route reads through ``flags.get`` /
+    ``flags.enabled`` / ``flags.flag_float`` / ``flags.flag_int`` and
+    writes through ``flags.set_flag`` / ``flags.clear`` /
+    ``flags.setdefault``, registering the flag in the table."""
+    exempt = a.config.get("kill_switch_registry_paths",
+                          _KILL_SWITCH_REGISTRY_PATHS)
+    for mod in a.project.modules.values():
+        rel = mod.relpath.replace("\\", "/")
+        if any(p in rel for p in exempt):
+            continue
+        for node in ast.walk(mod.tree):
+            key = _kill_switch_key(node)
+            if key is None:
+                continue
+            a.emit(
+                "unregistered-kill-switch", mod, node,
+                f"raw os.environ access of `{key}` bypasses the "
+                "kill-switch registry (ceph_tpu/common/flags.py): "
+                "no declared default/scope, no live-flip hook, no "
+                "audit for chaos kill-switch flips — use "
+                "flags.get/enabled/flag_float/flag_int (reads) or "
+                "flags.set_flag/clear/setdefault (writes) and "
+                "register the flag",
+                severity="error",
+                symbol=_enclosing_qualname(mod, node),
+                scope_line=_scope_line(mod, node))
+
+
 def default_rules() -> Dict[str, object]:
     # lock-order lives in lockgraph.py (it needs the whole-project
     # graph) and the interprocedural async rules in rules_async.py
@@ -1400,6 +1483,7 @@ def default_rules() -> Dict[str, object]:
         "unbounded-latency-buffer": rule_unbounded_latency_buffer,
         "unbudgeted-approx-result": rule_unbudgeted_approx_result,
         "commit-before-durability": rule_commit_before_durability,
+        "unregistered-kill-switch": rule_unregistered_kill_switch,
         "async-blocking": rule_async_blocking,
         "sync-encode-in-async": rule_sync_encode_in_async,
         "lock-order": rule_lock_order,

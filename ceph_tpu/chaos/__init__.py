@@ -1,0 +1,35 @@
+"""Compound-chaos engine: composed fault orchestration with
+cluster-wide QoS and always-on invariant monitors (ROADMAP item 6).
+
+Every hazard this tree survives is proven in isolation — stragglers
+(test_hedge), device/host faults (test_device_breaker, meshbench),
+power cuts (test_crash_consistency), kill-switch flips (per-subsystem
+tests).  Production hits them all at once.  This package composes the
+EXISTING injectors into continuous scenarios over open-loop
+multi-tenant traffic, with invariant monitors that never sleep:
+
+- zero client-visible errors (sheds are QoS, not errors),
+- bit-exact readback of every read against the seeded expected bytes,
+- durability: an acked write survives a power-cut kill/revive,
+- per-tenant p99 bounds and cluster-wide limit conformance
+  (the dmClock delta/rho piggyback, CEPH_TPU_DMCLOCK),
+- no leaked scheduler slots / tracked ops / breaker probes after
+  the storm passes.
+
+Determinism is the design center: a :class:`~ceph_tpu.chaos.scenario.
+Scenario` is a declarative timeline (hazard, start, duration, params)
+drawn from ONE seeded RNG, and the loadgen schedule derives from the
+same seed — any violation replays from the printed seed alone.  When
+a monitor fires, it captures the worst op's full ``dump_op_trace``
+tree from the OSDs as the failure exemplar.
+"""
+
+from ceph_tpu.chaos.engine import ChaosEngine, run_scenario
+from ceph_tpu.chaos.hazards import HAZARDS, Hazard
+from ceph_tpu.chaos.monitors import ChaosTarget, Violation
+from ceph_tpu.chaos.scenario import HazardEvent, Scenario, compose
+
+__all__ = [
+    "ChaosEngine", "run_scenario", "HAZARDS", "Hazard",
+    "ChaosTarget", "Violation", "HazardEvent", "Scenario", "compose",
+]

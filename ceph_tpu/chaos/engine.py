@@ -1,0 +1,227 @@
+"""The orchestrator: one seeded timeline over live traffic.
+
+ChaosEngine runs a :class:`~ceph_tpu.chaos.scenario.Scenario` against
+a live Cluster: it prefills the pool, drives the open-loop
+multi-tenant load through a :class:`ChaosTarget` (inline bit-exact
+verification + the acked-write ledger), and walks the scenario's
+event timeline firing hazards at their seeded offsets while the
+invariant monitors watch.  After the last event it restores every
+flag it touched (snapshot backstop), lets the cluster settle, then
+runs the end-of-run judgments: report bounds, durability sweep, leak
+audit.  The returned report leads with the seed — a violating run
+replays from that number alone.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, List, Optional
+
+from ceph_tpu.chaos.hazards import HAZARDS, Hazard
+from ceph_tpu.chaos.monitors import (ChaosTarget, Violation,
+                                     capture_worst_op, check_leaks,
+                                     evaluate_report)
+from ceph_tpu.chaos.scenario import Scenario
+from ceph_tpu.common import flags
+from ceph_tpu.loadgen.runner import run_open_loop
+from ceph_tpu.loadgen.targets import RadosTarget
+
+__all__ = ["ChaosEngine", "run_scenario"]
+
+log = logging.getLogger(__name__)
+
+
+def _conflict_key(kind: str, params: Dict[str, Any]) -> Optional[str]:
+    """Hazards sharing one global lever must not overlap — the second
+    start would save the first's injected value as its "previous" and
+    restore chaos into the steady state.  Key such levers; the engine
+    stops the incumbent before starting the newcomer."""
+    if kind == "device_fail":
+        return "flag:CEPH_TPU_INJECT_DEVICE_FAIL"
+    if kind == "kill_switch":
+        return f"flag:{params.get('flag', '')}"
+    if kind in ("powercut", "drain", "straggler"):
+        return f"{kind}:osd{params.get('osd')}"
+    return None
+
+
+class ChaosEngine:
+    """One scenario run over a live cluster.  Reusable only per
+    instance-per-run (monitors accumulate)."""
+
+    def __init__(self, cluster, scenario: Scenario,
+                 pool: str = "chaos", pool_size: int = 2,
+                 pg_num: int = 16) -> None:
+        self.cluster = cluster
+        self.scenario = scenario
+        self.pool = pool
+        self.pool_size = pool_size
+        self.pg_num = pg_num
+        self.target: Optional[ChaosTarget] = None
+        self.violations: List[Violation] = []
+        self.events_fired: List[Dict[str, Any]] = []
+        self._powercut_osds: List[int] = []
+        self._sweep_pending = False
+
+    # -- hazard context callbacks -----------------------------------------
+
+    def note_powercut(self, osd: int) -> None:
+        self._powercut_osds.append(osd)
+        self._sweep_pending = True
+
+    def revive_failed(self, osd: int) -> None:
+        self.violations.append(Violation(
+            "revive-failed",
+            f"osd.{osd} failed to revive after power cut",
+            {"osd": osd}))
+
+    # -- run ----------------------------------------------------------------
+
+    async def _ensure_pool(self):
+        from ceph_tpu.rados.client import RadosError
+
+        client = self.cluster.client
+        if client.osdmap.lookup_pool(self.pool) < 0:
+            try:
+                await client.create_replicated_pool(
+                    self.pool, size=self.pool_size, pg_num=self.pg_num)
+            except RadosError:
+                if client.osdmap.lookup_pool(self.pool) < 0:
+                    raise
+        return client.open_ioctx(self.pool)
+
+    def _touched_flags(self) -> List[str]:
+        out = {"CEPH_TPU_INJECT_DEVICE_FAIL"}
+        for ev in self.scenario.events:
+            if ev.hazard == "kill_switch":
+                out.add(ev.params["flag"])
+        return sorted(out)
+
+    async def run(self) -> Dict[str, Any]:
+        sc = self.scenario
+        log.info("chaos: seed=%d duration=%.0fs events=%d "
+                 "(replay with this seed)", sc.seed, sc.duration,
+                 len(sc.events))
+        io = await self._ensure_pool()
+        self.target = ChaosTarget(RadosTarget(io), io, sc.object_size)
+        await self.target.setup(sc.objects, sc.object_size)
+        await self.cluster.wait_for_clean(timeout=30.0)
+
+        snapshot = {n: flags.peek(n) for n in self._touched_flags()}
+        flips_before = len(flags.flips())
+
+        traffic = asyncio.get_running_loop().create_task(
+            run_open_loop(self.target, sc.tenants, sc.duration,
+                          seed=sc.seed,
+                          per_tenant=[t.name for t in sc.tenants]))
+        try:
+            await self._run_timeline()
+            report = await traffic
+        finally:
+            traffic.cancel()
+            # snapshot backstop: whatever a hazard failed to restore
+            for name, prev in snapshot.items():
+                if flags.peek(name) != prev:
+                    if prev is None:
+                        flags.clear(name)
+                    else:
+                        flags.set_flag(name, prev)
+
+        # settle, then judge: the leak monitors only mean something
+        # once in-flight work has had time to retire
+        await asyncio.sleep(sc.settle_s)
+        try:
+            await self.cluster.wait_for_clean(timeout=30.0)
+        except TimeoutError:
+            self.violations.append(Violation(
+                "never-clean",
+                "cluster failed to go clean after the storm"))
+
+        self.violations.extend(evaluate_report(
+            report, sc.p99_bounds, sc.rate_bounds))
+        await self.target.durability_sweep()
+        # inline monitors (bit-rot + sweep findings) accumulate on
+        # the target; fold them in once
+        self.violations.extend(self.target.violations)
+        self.violations.extend(check_leaks(self.cluster))
+
+        out: Dict[str, Any] = {
+            "seed": sc.seed,
+            "scenario": sc.to_dict(),
+            "loadgen": report,
+            "events_fired": list(self.events_fired),
+            "powercuts": list(self._powercut_osds),
+            "reads_verified": self.target.reads_verified,
+            "acked_writes_swept": len(self.target.acked),
+            "flag_flips": len(flags.flips()) - flips_before,
+            "violations": [v.to_dict() for v in self.violations],
+        }
+        if self.violations:
+            worst = capture_worst_op(self.cluster)
+            if worst is not None:
+                out["worst_op"] = worst
+            log.error("chaos: %d violation(s); replay with seed=%d",
+                      len(self.violations), sc.seed)
+        return out
+
+    async def _run_timeline(self) -> None:
+        """Fire every scenario event at its seeded offset.  Actions
+        are a merged (time, start|stop, hazard) walk; conflicting
+        hazards (same global lever) pre-empt the incumbent."""
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        actions = []
+        for ev in self.scenario.events:
+            cls = HAZARDS.get(ev.hazard)
+            if cls is None:
+                raise ValueError(f"unknown hazard {ev.hazard!r}")
+            h = cls(ev.params)
+            actions.append((ev.start, 0, "start", h, ev))
+            actions.append((ev.start + ev.duration, 1, "stop", h, ev))
+        actions.sort(key=lambda a: (a[0], a[1]))
+        active: Dict[str, Hazard] = {}
+        for when, _tie, what, h, ev in actions:
+            delay = (t0 + when) - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            key = _conflict_key(h.name, h.params)
+            try:
+                if what == "start":
+                    incumbent = active.get(key) if key else None
+                    if incumbent is not None and incumbent.active:
+                        await incumbent.stop(self)
+                    await h.start(self)
+                    if key and h.active:
+                        active[key] = h
+                    self.events_fired.append(
+                        {**ev.to_dict(), "fired_at": round(
+                            loop.time() - t0, 3)})
+                else:
+                    await h.stop(self)
+                    if key and active.get(key) is h:
+                        del active[key]
+                    if h.name == "powercut" and self._sweep_pending:
+                        self._sweep_pending = False
+                        await self.target.durability_sweep()
+            except Exception as e:  # noqa: BLE001 — a hazard adapter
+                # crashing must not abort the storm: record and go on
+                log.exception("chaos: %s %s failed", what, h.name)
+                self.violations.append(Violation(
+                    "hazard-error",
+                    f"{what} of {h.name} raised {type(e).__name__}: "
+                    f"{e}", {"event": ev.to_dict()}))
+        # storm over: force-stop anything still holding its lever
+        for h in list(active.values()):
+            if h.active:
+                try:
+                    await h.stop(self)
+                except Exception:
+                    log.exception("chaos: final stop of %s failed",
+                                  h.name)
+
+
+async def run_scenario(cluster, scenario: Scenario,
+                       **kw) -> Dict[str, Any]:
+    """One-call harness: engine + run, returns the report."""
+    return await ChaosEngine(cluster, scenario, **kw).run()

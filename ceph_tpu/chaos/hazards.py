@@ -1,0 +1,202 @@
+"""Hazard adapters: each wraps one EXISTING injection seam behind a
+uniform start/stop surface the engine can schedule.
+
+No hazard invents a new fault path — each drives the same lever the
+per-subsystem tests already prove in isolation (that is the point:
+the composition is the only new variable):
+
+- ``straggler``   -> ``ms_inject_internal_delays`` +
+  ``_apply_msgr_injection()`` on a live daemon,
+- ``device_fail`` -> ``CEPH_TPU_INJECT_DEVICE_FAIL`` (incl.
+  ``down_host=``/``sick=`` modes) through the flags registry,
+- ``kill_switch`` -> any registered ``CEPH_TPU_*`` flag flip,
+- ``powercut``    -> ``Cluster.kill_osd``/``revive_osd`` (with
+  ``CEPH_TPU_CRASH_INJECT`` armed on a persistent FaultStore this is
+  a synthesized power-cut image, not a polite shutdown),
+- ``drain``       -> ``osd out`` / ``osd in`` mon commands (backfill
+  off/onto the OSD under load).
+
+start()/stop() are idempotent per event and must leave the system
+restorable: whatever they touched is put back in stop(), and the
+engine re-asserts a pre-scenario flags snapshot afterwards as the
+backstop.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict
+
+from ceph_tpu.common import flags
+
+__all__ = ["Hazard", "HAZARDS"]
+
+log = logging.getLogger(__name__)
+
+
+class Hazard:
+    """One scheduled activation of a hazard kind."""
+
+    name = "hazard"
+
+    def __init__(self, params: Dict[str, Any]):
+        self.params = dict(params)
+        self.active = False
+
+    async def start(self, ctx) -> None:
+        raise NotImplementedError
+
+    async def stop(self, ctx) -> None:
+        raise NotImplementedError
+
+
+class StragglerHazard(Hazard):
+    """Messenger-level delay on one OSD: every send on that daemon
+    sleeps `delay_s` first (ms_inject_internal_delays role) — the
+    hedge/straggler seam, now under composed load."""
+
+    name = "straggler"
+
+    def __init__(self, params):
+        super().__init__(params)
+        self._prev = 0
+
+    async def start(self, ctx) -> None:
+        osd = self.params["osd"]
+        daemon = ctx.cluster.osds.get(osd)
+        if daemon is None:
+            return  # concurrently power-cut: nothing to slow down
+        self._prev = daemon.config.get("ms_inject_internal_delays", 0)
+        daemon.config["ms_inject_internal_delays"] = \
+            self.params.get("delay_s", 0.05)
+        daemon._apply_msgr_injection()
+        self.active = True
+
+    async def stop(self, ctx) -> None:
+        osd = self.params["osd"]
+        daemon = ctx.cluster.osds.get(osd)
+        if daemon is None or not self.active:
+            return
+        daemon.config["ms_inject_internal_delays"] = self._prev
+        daemon._apply_msgr_injection()
+        self.active = False
+
+
+class DeviceFailHazard(Hazard):
+    """Cluster-wide device/host fault injection: the spec string goes
+    straight into CEPH_TPU_INJECT_DEVICE_FAIL (re-read per dispatch),
+    so ``p=0.1``, ``down_host=1``, ``sick=3`` all ride here."""
+
+    name = "device_fail"
+
+    def __init__(self, params):
+        super().__init__(params)
+        self._prev = None
+
+    async def start(self, ctx) -> None:
+        self._prev = flags.peek("CEPH_TPU_INJECT_DEVICE_FAIL")
+        flags.set_flag("CEPH_TPU_INJECT_DEVICE_FAIL",
+                       self.params["spec"])
+        self.active = True
+
+    async def stop(self, ctx) -> None:
+        if not self.active:
+            return
+        if self._prev is None:
+            flags.clear("CEPH_TPU_INJECT_DEVICE_FAIL")
+        else:
+            flags.set_flag("CEPH_TPU_INJECT_DEVICE_FAIL", self._prev)
+        self.active = False
+
+
+class KillSwitchHazard(Hazard):
+    """Live cross-mode flip: force a registered kill switch to
+    `value` (default \"0\": fall back to the behavioral twin), restore
+    on stop.  Clients must not be able to tell."""
+
+    name = "kill_switch"
+
+    def __init__(self, params):
+        super().__init__(params)
+        self._prev = None
+
+    async def start(self, ctx) -> None:
+        flag = self.params["flag"]
+        self._prev = flags.peek(flag)
+        flags.set_flag(flag, str(self.params.get("value", "0")))
+        self.active = True
+
+    async def stop(self, ctx) -> None:
+        if not self.active:
+            return
+        flag = self.params["flag"]
+        if self._prev is None:
+            flags.clear(flag)
+        else:
+            flags.set_flag(flag, self._prev)
+        self.active = False
+
+
+class PowercutHazard(Hazard):
+    """Kill an OSD without clean shutdown, revive it after the hold.
+    On a persistent FaultStore cluster with CEPH_TPU_CRASH_INJECT the
+    kill synthesizes a power-cut disk image; the revive remounts and
+    replays the WAL — the durability monitor then checks every
+    acked-before-cut write."""
+
+    name = "powercut"
+
+    async def start(self, ctx) -> None:
+        osd = self.params["osd"]
+        if osd not in ctx.cluster.osds:
+            return  # already down (overlapping cut): skip
+        await ctx.cluster.kill_osd(osd)
+        ctx.note_powercut(osd)
+        self.active = True
+
+    async def stop(self, ctx) -> None:
+        if not self.active:
+            return
+        osd = self.params["osd"]
+        try:
+            await ctx.cluster.revive_osd(osd)
+            await ctx.cluster.wait_for_osd_up(osd, timeout=20.0)
+        except Exception:
+            log.exception("chaos: revive of osd.%d failed", osd)
+            ctx.revive_failed(osd)
+        self.active = False
+
+
+class DrainHazard(Hazard):
+    """Elasticity: mark an OSD out (CRUSH reweights, data backfills
+    off it while client load keeps flowing), back in on stop (it
+    backfills back).  The osd_max_backfills throttle is what keeps
+    this survivable."""
+
+    name = "drain"
+
+    async def start(self, ctx) -> None:
+        osd = self.params["osd"]
+        rc, _out = await ctx.cluster.client.mon_command(
+            {"prefix": "osd out", "osd": osd})
+        if rc == 0:
+            self.active = True
+        else:
+            log.warning("chaos: osd out %d rc=%d", osd, rc)
+
+    async def stop(self, ctx) -> None:
+        if not self.active:
+            return
+        osd = self.params["osd"]
+        rc, _out = await ctx.cluster.client.mon_command(
+            {"prefix": "osd in", "osd": osd})
+        if rc != 0:
+            log.warning("chaos: osd in %d rc=%d", osd, rc)
+        self.active = False
+
+
+HAZARDS = {
+    h.name: h for h in (StragglerHazard, DeviceFailHazard,
+                        KillSwitchHazard, PowercutHazard,
+                        DrainHazard)
+}

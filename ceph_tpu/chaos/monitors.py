@@ -1,0 +1,280 @@
+"""Always-on invariant monitors for composed-chaos runs.
+
+The monitors are the product here: a chaos run that "didn't crash"
+proves nothing.  Each invariant is checked continuously (ChaosTarget,
+inline with every op) or at deterministic barriers (the engine, after
+settle):
+
+- **zero client errors** — sheds are QoS doing its job; anything else
+  surfacing to the client during a storm the system claims to mask is
+  a violation,
+- **bit-exact readback** — every read is compared against the seeded
+  expected bytes inline; a recovery/repair/failover path returning
+  plausible-but-wrong data is the worst storage failure mode,
+- **durability** — every write acked before a power cut must read
+  back after kill + revive + WAL replay,
+- **bounded tails** — per-tenant p99 must stay under the scenario
+  bound; a protected tenant starving under compound faults is an
+  isolation failure even when all ops "succeed",
+- **cluster-wide limit conformance** — a limit-L tenant spread over N
+  primaries must complete ~L ops/s TOTAL (the dmClock delta/rho
+  piggyback), not N x L,
+- **no leaks** — after the storm settles: zero scheduler slots held,
+  zero tracked ops live, zero breaker probes stuck half-open.
+
+When a monitor fires it grabs the worst completed op's retained trace
+tree (dump_op_trace shape) from the OSDs as the failure exemplar, so
+a red run explains itself without a rerun.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+from ceph_tpu.loadgen.targets import (EBUSY, SheddedOp, Target,
+                                      _payload, _write_payload)
+
+__all__ = ["Violation", "ChaosTarget", "evaluate_report",
+           "check_leaks", "capture_worst_op"]
+
+
+class Violation:
+    """One invariant breach, self-describing enough to file."""
+
+    __slots__ = ("kind", "detail", "info")
+
+    def __init__(self, kind: str, detail: str,
+                 info: Optional[Dict[str, Any]] = None):
+        self.kind = kind
+        self.detail = detail
+        self.info = dict(info or {})
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "detail": self.detail,
+                **({"info": self.info} if self.info else {})}
+
+    def __repr__(self) -> str:
+        return f"Violation({self.kind}: {self.detail})"
+
+
+@functools.lru_cache(maxsize=8)
+def _expected_read(size: int) -> bytes:
+    """The shared hot-set content (targets.setup writes
+    _payload(size, seed=1) into every `lg-<i>`)."""
+    return _payload(size, seed=1)
+
+
+class ChaosTarget(Target):
+    """Wraps a networked target: delegates the op mix, but serves
+    read/ranged itself so every byte coming back is compared against
+    the seeded expected content inline, and keeps the acked-write
+    ledger the durability sweep checks after each power cut.
+
+    Needs the wrapped target's IoCtx (`io`) because Target.op returns
+    byte COUNTS — verification needs the bytes."""
+
+    def __init__(self, inner: Target, io, object_size: int) -> None:
+        self.inner = inner
+        self.io = io
+        self.object_size = int(object_size)
+        self._objects = 0
+        #: oid -> set of acceptable (size, slot) payloads.  Every
+        #: write to lg-w-<tenant>-<slot> carries _write_payload(size,
+        #: slot); sizes can differ per tenant spec, so the sweep
+        #: accepts any payload this run ever acked for the oid.
+        self.acked: Dict[str, set] = {}
+        self.violations: List[Violation] = []
+        self.reads_verified = 0
+
+    async def setup(self, objects: int, object_size: int) -> None:
+        await self.inner.setup(objects, object_size)
+        self._objects = objects
+        self.object_size = int(object_size)
+
+    async def close(self) -> None:
+        await self.inner.close()
+
+    async def op(self, tenant: str, kind: str, obj: int,
+                 size: int) -> int:
+        if kind in ("read", "ranged"):
+            return await self._verified_read(tenant, kind, obj, size)
+        moved = await self.inner.op(tenant, kind, obj, size)
+        if kind == "write":
+            # only reached when the inner op ACKED (sheds/errors
+            # raised past us): this write is now a durability promise
+            slot = obj & 7
+            self.acked.setdefault(f"lg-w-{tenant}-{slot}",
+                                  set()).add((size, slot))
+        return moved
+
+    async def _verified_read(self, tenant: str, kind: str, obj: int,
+                             size: int) -> int:
+        from ceph_tpu.rados.client import RadosError, tenant_scope
+
+        name = f"lg-{obj % max(self._objects, 1)}"
+        try:
+            with tenant_scope(tenant):
+                if kind == "read":
+                    off, ln = 0, None
+                    data = await self.io.read(name)
+                else:
+                    off = size // 4
+                    ln = max(size // 4, 1)
+                    data = await self.io.read(name, offset=off,
+                                              length=ln)
+        except RadosError as e:
+            if e.rc == EBUSY:
+                raise SheddedOp(tenant) from e
+            raise
+        full = _expected_read(self.object_size)
+        expect = full if ln is None else full[off:off + ln]
+        if data != expect:
+            self.violations.append(Violation(
+                "bit-rot",
+                f"{kind} of {name} returned {len(data)}B != expected "
+                f"{len(expect)}B (first diff at "
+                f"{_first_diff(data, expect)})",
+                {"tenant": tenant, "object": name, "kind": kind,
+                 "offset": off}))
+        self.reads_verified += 1
+        return len(data)
+
+    async def durability_sweep(self) -> List[Violation]:
+        """Read back every acked write and demand one of its acked
+        payloads, bit-exact.  Run after each power-cut revive (the
+        WAL-replay path) and once at scenario end."""
+        from ceph_tpu.rados.client import RadosError
+
+        out: List[Violation] = []
+        for oid, wants in sorted(self.acked.items()):
+            try:
+                data = await self.io.read(oid)
+            except RadosError as e:
+                out.append(Violation(
+                    "durability-lost",
+                    f"acked object {oid} unreadable after revive "
+                    f"(rc={e.rc})", {"object": oid}))
+                continue
+            if not any(data == _write_payload(size, slot)
+                       for size, slot in wants):
+                out.append(Violation(
+                    "durability-corrupt",
+                    f"acked object {oid} read back {len(data)}B "
+                    f"matching none of {len(wants)} acked payloads",
+                    {"object": oid,
+                     "acked_sizes": sorted(s for s, _ in wants)}))
+        self.violations.extend(out)
+        return out
+
+
+def _first_diff(a: bytes, b: bytes) -> int:
+    for i, (x, y) in enumerate(zip(a, b)):
+        if x != y:
+            return i
+    return min(len(a), len(b))
+
+
+def evaluate_report(report: Dict[str, Any],
+                    p99_bounds: Dict[str, float],
+                    rate_bounds: Dict[str, float]) -> List[Violation]:
+    """Judge a finished loadgen report against the scenario bounds:
+    zero client errors, per-tenant p99 ceilings (ms), and per-tenant
+    completed-rate ceilings (the cluster-wide dmClock limit check)."""
+    out: List[Violation] = []
+    if report.get("errors", 0):
+        out.append(Violation(
+            "client-errors",
+            f"{report['errors']} client-visible errors "
+            f"(of {report.get('offered', 0)} offered)"))
+    per = report.get("per_tenant", {})
+    for name, bound in sorted(p99_bounds.items()):
+        t = per.get(name)
+        if t is None or t.get("count", 0) == 0:
+            out.append(Violation(
+                "tenant-starved",
+                f"tenant {name} completed zero ops "
+                f"(p99 bound {bound}ms unevaluable)",
+                {"tenant": name}))
+            continue
+        if t.get("errors", 0):
+            out.append(Violation(
+                "client-errors",
+                f"tenant {name}: {t['errors']} errors",
+                {"tenant": name}))
+        p99 = t.get("p99_ms")
+        if p99 is not None and p99 > bound:
+            out.append(Violation(
+                "p99-exceeded",
+                f"tenant {name} p99 {p99}ms > bound {bound}ms",
+                {"tenant": name, "p99_ms": p99, "bound_ms": bound}))
+    elapsed = max(report.get("elapsed_s", 0.0), 1e-9)
+    for name, ceil in sorted(rate_bounds.items()):
+        t = per.get(name)
+        rate = (t or {}).get("completed", 0) / elapsed
+        if rate > ceil:
+            out.append(Violation(
+                "limit-exceeded",
+                f"tenant {name} completed {rate:.1f} ops/s > "
+                f"cluster-wide ceiling {ceil:.1f} (per-OSD-only "
+                f"limits let a spread tenant multiply its limit)",
+                {"tenant": name, "rate": round(rate, 2),
+                 "ceiling": ceil}))
+    return out
+
+
+def check_leaks(cluster) -> List[Violation]:
+    """Post-settle resource audit over every live daemon: scheduler
+    slots, tracked ops, breaker probes.  Anything nonzero after the
+    storm + settle window is a leak some fault path forgot to
+    release."""
+    from ceph_tpu.common import circuit
+
+    out: List[Violation] = []
+    for osd_id, daemon in sorted(cluster.osds.items()):
+        held = daemon.scheduler._in_flight
+        if held:
+            out.append(Violation(
+                "leak-scheduler-slot",
+                f"osd.{osd_id} scheduler holds {held} slots after "
+                "settle", {"osd": osd_id, "held": held}))
+        live = daemon.op_tracker.perf()["ops_in_flight"]
+        if live:
+            out.append(Violation(
+                "leak-tracked-op",
+                f"osd.{osd_id} has {live} tracked ops live after "
+                "settle",
+                {"osd": osd_id, "ops": live,
+                 "dump": daemon.op_tracker.dump_in_flight()}))
+    with circuit._reg_lock:
+        brs = dict(circuit._breakers)
+    for family, br in sorted(brs.items()):
+        if br._probing:
+            out.append(Violation(
+                "leak-breaker-probe",
+                f"breaker {family} still holds its half-open probe "
+                "after settle", {"family": family}))
+    return out
+
+
+def capture_worst_op(cluster) -> Optional[Dict[str, Any]]:
+    """The failure exemplar: scan every daemon's historic ring for the
+    slowest completed op; when the tail policy retained its span tree,
+    attach the full dump_op_trace doc.  Called when any monitor fires
+    so a red run ships its own explanation."""
+    worst: Optional[Dict[str, Any]] = None
+    for osd_id, daemon in sorted(cluster.osds.items()):
+        hist = daemon.op_tracker.dump_historic()
+        for op in hist.get("ops", ()):
+            if worst is None or op.get("duration", 0.0) > \
+                    worst["op"].get("duration", 0.0):
+                worst = {"osd": osd_id, "op": op}
+    if worst is None:
+        return None
+    tid = worst["op"].get("trace_id", "")
+    if tid:
+        daemon = cluster.osds.get(worst["osd"])
+        doc = daemon.op_tracker.get_trace(tid) if daemon else None
+        if doc is not None:
+            worst["trace"] = doc
+    return worst

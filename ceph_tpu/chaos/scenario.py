@@ -1,0 +1,199 @@
+"""Declarative chaos timelines, composed from one seeded RNG.
+
+A scenario is data: a list of (hazard, start, duration, params)
+events plus the traffic spec and the invariant bounds.  Everything
+random — event placement, hazard targets, kill-switch choices, the
+loadgen schedule — derives from ``Scenario.seed``, so a violating run
+replays bit-for-bit from the seed printed in its report.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional, Sequence
+
+from ceph_tpu.loadgen.workload import TenantSpec
+
+__all__ = ["HazardEvent", "Scenario", "compose",
+           "DEFAULT_KILL_SWITCHES"]
+
+
+#: the cross-mode flip set from the issue: each is a default-on fast
+#: path with a behavioral-twin fallback, so flipping any of them
+#: mid-traffic must be invisible to clients (results bit-identical,
+#: zero errors)
+DEFAULT_KILL_SWITCHES = (
+    "CEPH_TPU_XSCHED",
+    "CEPH_TPU_COMPUTE",
+    "CEPH_TPU_NATIVE_XSCHED",
+    "CEPH_TPU_MSR_REPAIR",
+    "CEPH_TPU_INFERENCE",
+)
+
+
+class HazardEvent:
+    """One timeline entry: fire `hazard` at `start` (seconds from
+    scenario start), hold it for `duration`, with `params`."""
+
+    __slots__ = ("hazard", "start", "duration", "params")
+
+    def __init__(self, hazard: str, start: float, duration: float,
+                 params: Optional[Dict[str, Any]] = None):
+        self.hazard = hazard
+        self.start = float(start)
+        self.duration = float(duration)
+        self.params = dict(params or {})
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"hazard": self.hazard, "start": round(self.start, 3),
+                "duration": round(self.duration, 3),
+                "params": dict(self.params)}
+
+    def __repr__(self) -> str:
+        return (f"HazardEvent({self.hazard!r}, t={self.start:.2f}"
+                f"+{self.duration:.2f}, {self.params})")
+
+
+class Scenario:
+    """The replayable unit: seed + traffic + timeline + bounds."""
+
+    def __init__(self, seed: int, duration: float,
+                 tenants: Sequence[TenantSpec],
+                 events: Sequence[HazardEvent],
+                 p99_bounds: Optional[Dict[str, float]] = None,
+                 rate_bounds: Optional[Dict[str, float]] = None,
+                 objects: int = 32, object_size: int = 8192,
+                 settle_s: float = 2.0):
+        self.seed = int(seed)
+        self.duration = float(duration)
+        self.tenants = list(tenants)
+        self.events = sorted(events, key=lambda e: e.start)
+        # per-tenant invariant bounds; absent tenant = unmonitored
+        self.p99_bounds = dict(p99_bounds or {})
+        # cluster-wide completed-ops/s ceilings (the dmClock monitor:
+        # a limit-L tenant spread over N primaries must not complete
+        # more than ~L/s TOTAL — per-OSD mClock grants it N x L)
+        self.rate_bounds = dict(rate_bounds or {})
+        self.objects = int(objects)
+        self.object_size = int(object_size)
+        # post-traffic settle window before the leak monitors judge
+        self.settle_s = float(settle_s)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "seed": self.seed,
+            "duration_s": self.duration,
+            "tenants": [t.name for t in self.tenants],
+            "events": [e.to_dict() for e in self.events],
+            "p99_bounds": dict(self.p99_bounds),
+            "rate_bounds": dict(self.rate_bounds),
+            "objects": self.objects,
+            "object_size": self.object_size,
+        }
+
+
+def _windows(rng: random.Random, duration: float, n: int,
+             hold: float, lead: float = 0.5) -> List[float]:
+    """n non-anchored start times in [lead, duration - hold]: jittered
+    stratified placement so repeated hazards spread over the run
+    instead of clustering at one instant."""
+    if n <= 0:
+        return []
+    span = max(duration - hold - lead, 0.0)
+    out = []
+    for i in range(n):
+        lo = lead + span * i / n
+        hi = lead + span * (i + 1) / n
+        out.append(rng.uniform(lo, hi))
+    return out
+
+
+def compose(seed: int, duration: float,
+            tenants: Sequence[TenantSpec],
+            osd_ids: Sequence[int],
+            hazards: Sequence[str] = ("straggler", "device_fail",
+                                      "kill_switch"),
+            persistent_osds: Sequence[int] = (),
+            protected_osds: Sequence[int] = (),
+            kill_switches: Sequence[str] = DEFAULT_KILL_SWITCHES,
+            p99_bounds: Optional[Dict[str, float]] = None,
+            rate_bounds: Optional[Dict[str, float]] = None,
+            objects: int = 32, object_size: int = 8192) -> Scenario:
+    """Seeded scenario composer: one event per requested hazard kind
+    per ~20 s of runtime, placed and parameterized by `seed`.
+
+    - ``straggler``: messenger delay on a random OSD.
+    - ``device_fail``: probabilistic device-fault injection
+      (CEPH_TPU_INJECT_DEVICE_FAIL) cluster-wide.
+    - ``host_down``: down_host=<H> via the same injection seam.
+    - ``kill_switch``: flip a random switch from `kill_switches` off,
+      restore after the hold.
+    - ``powercut``: kill/revive a random OSD from `persistent_osds`
+      (falls back to any non-protected OSD on MemStore clusters —
+      then it exercises crash/revive, not disk durability).
+    - ``drain``: mark a random OSD out (backfill off it under load),
+      back in after the hold.
+
+    `protected_osds` are never killed or drained (keep a quorum of
+    primaries alive so the client can always make progress)."""
+    rng = random.Random(seed)
+    rounds = max(int(duration / 20.0), 1)
+    events: List[HazardEvent] = []
+    killable = [o for o in osd_ids if o not in set(protected_osds)]
+    cuttable = [o for o in (persistent_osds or killable)
+                if o not in set(protected_osds)]
+    for kind in hazards:
+        if kind == "straggler":
+            hold = min(6.0, duration / 3)
+            for t0 in _windows(rng, duration, rounds, hold):
+                events.append(HazardEvent(
+                    "straggler", t0, hold,
+                    {"osd": rng.choice(list(osd_ids)),
+                     "delay_s": round(rng.uniform(0.02, 0.08), 3)}))
+        elif kind == "device_fail":
+            hold = min(5.0, duration / 3)
+            for t0 in _windows(rng, duration, rounds, hold):
+                events.append(HazardEvent(
+                    "device_fail", t0, hold,
+                    {"spec": f"p={round(rng.uniform(0.05, 0.2), 3)}"}))
+        elif kind == "host_down":
+            hold = min(4.0, duration / 4)
+            for t0 in _windows(rng, duration, rounds, hold):
+                events.append(HazardEvent(
+                    "device_fail", t0, hold,
+                    {"spec": "down_host=%d" % rng.choice((0, 1))}))
+        elif kind == "kill_switch":
+            hold = min(4.0, duration / 3)
+            for t0 in _windows(rng, duration,
+                               max(rounds, 2), hold):
+                events.append(HazardEvent(
+                    "kill_switch", t0, hold,
+                    {"flag": rng.choice(list(kill_switches)),
+                     "value": "0"}))
+        elif kind == "powercut":
+            if not cuttable:
+                continue
+            # kill + detect + revive + re-peer needs real time: one
+            # cut per ~30 s, held short so retries bridge it
+            hold = min(3.0, duration / 5)
+            n = max(int(duration / 30.0), 1)
+            for t0 in _windows(rng, duration - 8.0, n, hold,
+                               lead=2.0):
+                events.append(HazardEvent(
+                    "powercut", t0, hold,
+                    {"osd": rng.choice(cuttable)}))
+        elif kind == "drain":
+            if not killable:
+                continue
+            hold = min(8.0, duration / 2)
+            n = max(int(duration / 40.0), 1)
+            for t0 in _windows(rng, duration - 4.0, n, hold,
+                               lead=1.0):
+                events.append(HazardEvent(
+                    "drain", t0, hold,
+                    {"osd": rng.choice(killable)}))
+        else:
+            raise ValueError(f"unknown hazard kind {kind!r}")
+    return Scenario(seed, duration, tenants, events,
+                    p99_bounds=p99_bounds, rate_bounds=rate_bounds,
+                    objects=objects, object_size=object_size)

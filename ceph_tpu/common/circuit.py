@@ -71,6 +71,8 @@ Kill switch: CEPH_TPU_BREAKER=0 restores the raw pre-guard behavior
 from __future__ import annotations
 
 import os
+
+from ceph_tpu.common import flags
 import random
 import threading
 import time
@@ -110,12 +112,12 @@ HOST_FAMILY_PREFIX = "host:"
 
 
 def enabled() -> bool:
-    return os.environ.get("CEPH_TPU_BREAKER", "1") != "0"
+    return flags.enabled("CEPH_TPU_BREAKER")
 
 
 def _env_float(name: str, default: float) -> float:
     try:
-        return float(os.environ.get(name, default))
+        return flags.flag_float(name, default)
     except ValueError:
         return default
 
@@ -571,7 +573,7 @@ def injection() -> Optional[Dict[str, Any]]:
     """Current injection spec; the env var is re-read every call so
     flipping it mid-workload takes effect on the next dispatch."""
     global _inj_raw, _inj_spec, _inj_next_left
-    raw = os.environ.get("CEPH_TPU_INJECT_DEVICE_FAIL", "")
+    raw = flags.get("CEPH_TPU_INJECT_DEVICE_FAIL") or ""
     with _inj_lock:
         if raw != _inj_raw:
             _inj_raw = raw

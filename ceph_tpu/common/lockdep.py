@@ -29,12 +29,14 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+
+from ceph_tpu.common import flags
 import weakref
 from typing import Dict, List, Optional, Set
 
 log = logging.getLogger("lockdep")
 
-enabled = os.environ.get("CEPH_TPU_LOCKDEP", "0") == "1"
+enabled = flags.get("CEPH_TPU_LOCKDEP") == "1"
 
 # class -> classes acquired while holding it
 _edges: Dict[str, Set[str]] = {}

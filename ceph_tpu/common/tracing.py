@@ -62,6 +62,8 @@ import contextlib
 import contextvars
 import random
 import os
+
+from ceph_tpu.common import flags
 import secrets
 import threading
 import time
@@ -85,7 +87,7 @@ TREE_CAP = 512
 
 
 def env_enabled() -> bool:
-    return os.environ.get("CEPH_TPU_TRACE", "1") != "0"
+    return flags.enabled("CEPH_TPU_TRACE")
 
 
 # span/trace ids need uniqueness, not unpredictability — a PRNG

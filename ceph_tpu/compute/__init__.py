@@ -46,6 +46,7 @@ import os
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
+from ceph_tpu.common import flags
 
 ENOENT = -2
 EINVAL = -22
@@ -60,7 +61,7 @@ DEFAULT_LANES = 32
 
 def env_enabled() -> bool:
     """CEPH_TPU_COMPUTE=0 restores client-side read-then-compute."""
-    return os.environ.get("CEPH_TPU_COMPUTE", "1") != "0"
+    return flags.enabled("CEPH_TPU_COMPUTE")
 
 
 class ComputeError(Exception):

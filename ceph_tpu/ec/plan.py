@@ -59,6 +59,8 @@ the `jit-bypass-plan` static-analysis rule; route new compiles through
 from __future__ import annotations
 
 import os
+
+from ceph_tpu.common import flags
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -104,7 +106,7 @@ _counters: Dict[str, int] = {"hits": 0, "misses": 0, "retraces": 0,
                              "mesh_shrinks": 0, "mesh_probes": 0,
                              "host_retirements": 0}
 _per_plan: Dict[str, Dict[str, float]] = {}
-_enabled = os.environ.get("CEPH_TPU_PLAN_CACHE", "1") != "0"
+_enabled = flags.enabled("CEPH_TPU_PLAN_CACHE")
 # poisoned-plan quarantine: a compiled callable that keeps failing is
 # evicted and its key blacklisted for a TTL (a single bad compile must
 # not re-trip the breaker forever while healthy plans keep serving)
@@ -344,14 +346,14 @@ def _get_plan(key: tuple, build: Callable[[], ExecPlan]) -> ExecPlan:
 
 def _quarantine_ttl() -> float:
     try:
-        return float(os.environ.get("CEPH_TPU_PLAN_QUARANTINE_S", 30.0))
+        return flags.flag_float("CEPH_TPU_PLAN_QUARANTINE_S")
     except ValueError:
         return 30.0
 
 
 def _plan_fail_limit() -> int:
     try:
-        return int(os.environ.get("CEPH_TPU_PLAN_FAIL_LIMIT", 3))
+        return flags.flag_int("CEPH_TPU_PLAN_FAIL_LIMIT")
     except ValueError:
         return 3
 
@@ -496,7 +498,7 @@ def _pad_batch(arr: np.ndarray, bb: int, bs: int) -> np.ndarray:
 def mesh_enabled() -> bool:
     """Multi-chip mesh dispatch kill switch (CEPH_TPU_MESH=0 pins
     every plan to a single device — bit-identical output)."""
-    return os.environ.get("CEPH_TPU_MESH", "1") != "0"
+    return flags.enabled("CEPH_TPU_MESH")
 
 
 def _mesh_min_bytes() -> int:
@@ -504,15 +506,14 @@ def _mesh_min_bytes() -> int:
     not worth the fan-out; one chip's plan serves.  Default 1 MiB —
     the same altitude as the fused-CRC floor."""
     try:
-        return int(os.environ.get("CEPH_TPU_MESH_MIN_BYTES",
-                                  str(1 << 20)))
+        return flags.flag_int("CEPH_TPU_MESH_MIN_BYTES")
     except ValueError:
         return 1 << 20
 
 
 def _mesh_min_stripes() -> int:
     try:
-        return int(os.environ.get("CEPH_TPU_MESH_MIN_STRIPES", "2"))
+        return flags.flag_int("CEPH_TPU_MESH_MIN_STRIPES")
     except ValueError:
         return 2
 
@@ -521,7 +522,7 @@ def _mesh_max_devices() -> int:
     """0 = no cap; the bench mesh sweep sets this to measure 1, 2,
     4, 8-chip legs of the SAME workload."""
     try:
-        return int(os.environ.get("CEPH_TPU_MESH_MAX_DEVICES", "0"))
+        return flags.flag_int("CEPH_TPU_MESH_MAX_DEVICES")
     except ValueError:
         return 0
 
@@ -592,8 +593,7 @@ def _mesh_devices(batch: int, nbytes: int) -> Optional[tuple]:
 
 def _probe_timeout() -> float:
     try:
-        return float(os.environ.get("CEPH_TPU_MESH_PROBE_TIMEOUT_S",
-                                    20.0))
+        return flags.flag_float("CEPH_TPU_MESH_PROBE_TIMEOUT_S")
     except ValueError:
         return 20.0
 

@@ -65,6 +65,8 @@ from __future__ import annotations
 import ctypes
 import hashlib
 import os
+
+from ceph_tpu.common import flags
 import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -86,13 +88,13 @@ __all__ = [
 def enabled() -> bool:
     """Schedule-execution kill switch (CEPH_TPU_XSCHED=0 keeps every
     consumer on the naive row-walk — bit-identical output)."""
-    return os.environ.get("CEPH_TPU_XSCHED", "1") != "0"
+    return flags.enabled("CEPH_TPU_XSCHED")
 
 
 def native_enabled() -> bool:
     """Native-executor kill switch (CEPH_TPU_NATIVE_XSCHED=0 pins
     schedule execution to the host tier — bit-identical output)."""
-    return os.environ.get("CEPH_TPU_NATIVE_XSCHED", "1") != "0"
+    return flags.enabled("CEPH_TPU_NATIVE_XSCHED")
 
 
 def native_available() -> bool:
@@ -110,7 +112,7 @@ def _max_ops() -> int:
     past this, the unrolled XOR program stops beating one dense MXU
     matmul dispatch (and the traced graph stops being small)."""
     try:
-        return int(os.environ.get("CEPH_TPU_XSCHED_MAX_OPS", "256"))
+        return flags.flag_int("CEPH_TPU_XSCHED_MAX_OPS")
     except ValueError:
         return 256
 
@@ -119,8 +121,7 @@ def _min_reduction() -> float:
     """Minimum fractional XOR-count saving before a schedule is worth
     switching lowering for (the measured-op-count pick)."""
     try:
-        return float(os.environ.get("CEPH_TPU_XSCHED_MIN_REDUCTION",
-                                    "0.25"))
+        return flags.flag_float("CEPH_TPU_XSCHED_MIN_REDUCTION")
     except ValueError:
         return 0.25
 
@@ -135,8 +136,7 @@ def _host_max_ones() -> int:
     refusing pathological hand-rolled geometries that would stall
     the daemon for minutes."""
     try:
-        return int(os.environ.get("CEPH_TPU_XSCHED_HOST_MAX_ONES",
-                                  "4096"))
+        return flags.flag_int("CEPH_TPU_XSCHED_HOST_MAX_ONES")
     except ValueError:
         return 4096
 

@@ -52,6 +52,8 @@ from __future__ import annotations
 
 import os
 
+from ceph_tpu.common import flags
+
 #: default per-query relative error budget (osd_inference_error_budget)
 DEFAULT_ERROR_BUDGET = 0.05
 
@@ -63,4 +65,4 @@ INFER_SHARD_KERNEL = "infer_shard"
 
 def env_enabled() -> bool:
     """CEPH_TPU_INFERENCE=0 restores client-side read-then-infer."""
-    return os.environ.get("CEPH_TPU_INFERENCE", "1") != "0"
+    return flags.enabled("CEPH_TPU_INFERENCE")

@@ -341,7 +341,9 @@ class MOSDOp(Message):
                  snapc_seq: int = 0,
                  snapc_snaps: Optional[List[int]] = None,
                  snap_id: int = 0,
-                 tenant: str = ""):
+                 tenant: str = "",
+                 qos_delta: int = 1,
+                 qos_rho: int = 1):
         self.tid = tid
         self.client = client
         self.pg = pg
@@ -357,13 +359,20 @@ class MOSDOp(Message):
         # op under the per-tenant mClock class `client.<tenant>` and
         # runs it through the admission gate
         self.tenant = tenant
+        # dmClock piggyback (delta/rho): completions this tenant saw
+        # at OTHER OSDs since its last op on the target (plus one) —
+        # all-phase and reservation-phase respectively.  The target's
+        # mClock tags advance by delta x cost, making per-tenant
+        # reservation/limit hold cluster-wide.  1/1 = local mClock.
+        self.qos_delta = max(int(qos_delta), 1)
+        self.qos_rho = max(int(qos_rho), 1)
         # blkin-role trace context: (trace_id, parent span id) or None
         self.trace: Optional[tuple] = None
 
     # v2 appends the snap context + read snap; v3 the trace context;
-    # v4 the QoS tenant.  COMPAT stays 1 so a v1 frame still decodes
-    # with defaults
-    VERSION = 4
+    # v4 the QoS tenant; v5 the dmClock delta/rho piggyback.  COMPAT
+    # stays 1 so a v1 frame still decodes with defaults
+    VERSION = 5
     COMPAT = 1
 
     def encode_payload(self, enc: Encoder) -> None:
@@ -379,6 +388,8 @@ class MOSDOp(Message):
         enc.optional(self.trace,
                      lambda e, v: (e.u64(v[0]), e.u64(v[1])))
         enc.string(self.tenant)
+        enc.u32(self.qos_delta)
+        enc.u32(self.qos_rho)
 
     @classmethod
     def decode(cls, data: bytes) -> "MOSDOp":
@@ -394,6 +405,9 @@ class MOSDOp(Message):
             msg.trace = dec.optional(lambda d: (d.u64(), d.u64()))
         if struct_v >= 4:
             msg.tenant = dec.string()
+        if struct_v >= 5:
+            msg.qos_delta = max(dec.u32(), 1)
+            msg.qos_rho = max(dec.u32(), 1)
         dec.finish()
         return msg
 
@@ -401,10 +415,15 @@ class MOSDOp(Message):
 @register
 class MOSDOpReply(Message):
     TAG = 10
+    # v2 appends the dmClock grant phase (the rho piggyback).  COMPAT
+    # stays 1 so archived/old-peer frames decode with the default
+    VERSION = 2
+    COMPAT = 1
 
     def __init__(self, tid: int, rc: int, data: bytes = b"",
                  out: Optional[Dict[str, Any]] = None,
-                 replay_epoch: int = 0):
+                 replay_epoch: int = 0,
+                 qos_phase: str = ""):
         self.tid = tid
         self.rc = rc
         self.data = data
@@ -412,6 +431,10 @@ class MOSDOpReply(Message):
         # >0: client should wait for this map epoch and resend (the
         # ENOENT-on-wrong-primary / EAGAIN resend discipline)
         self.replay_epoch = replay_epoch
+        # dmClock phase the op's scheduler grant won ("reservation" /
+        # "priority", "" when unscheduled): the client ServiceTracker
+        # counts reservation-phase completions into rho
+        self.qos_phase = qos_phase
 
     def encode_payload(self, enc: Encoder) -> None:
         enc.u64(self.tid)
@@ -419,11 +442,18 @@ class MOSDOpReply(Message):
         enc.bytes(self.data)
         enc.string(json.dumps(self.out))
         enc.u32(self.replay_epoch)
+        enc.string(self.qos_phase)
 
     @classmethod
-    def decode_payload(cls, dec: Decoder) -> "MOSDOpReply":
-        return cls(dec.u64(), dec.s32(), dec.bytes(),
-                   json.loads(dec.string()), dec.u32())
+    def decode(cls, data: bytes) -> "MOSDOpReply":
+        dec = Decoder(data)
+        struct_v = dec.start(cls.VERSION)
+        msg = cls(dec.u64(), dec.s32(), dec.bytes(),
+                  json.loads(dec.string()), dec.u32())
+        if struct_v >= 2:
+            msg.qos_phase = dec.string()
+        dec.finish()
+        return msg
 
 
 # -- primary -> shard sub-ops ----------------------------------------------

@@ -38,6 +38,7 @@ from __future__ import annotations
 import functools
 
 import numpy as np
+from ceph_tpu.common import flags
 
 from ceph_tpu.ops import checksum as cks
 
@@ -89,9 +90,7 @@ def _mk_stack(length: int) -> np.ndarray:
 
 def supported(length: int, n_blocks: int,
               platform: str | None = None) -> bool:
-    import os
-
-    if os.environ.get("CEPH_TPU_PALLAS", "1") == "0":
+    if not flags.enabled("CEPH_TPU_PALLAS"):
         return False  # same kill switch as gf_pallas
     if not HAVE_JAX:
         return False

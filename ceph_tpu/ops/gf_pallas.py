@@ -47,6 +47,7 @@ import functools
 import os
 
 import numpy as np
+from ceph_tpu.common import flags
 
 try:
     import jax
@@ -106,7 +107,7 @@ def supported(data_shape, platform: str | None = None) -> bool:
     """True when the words kernel can run: a TPU backend (or forced
     interpret mode) and S a multiple of 512 bytes (one (1,128) int32
     row).  CEPH_TPU_PALLAS=0 is the kill switch."""
-    if os.environ.get("CEPH_TPU_PALLAS", "1") == "0":
+    if not flags.enabled("CEPH_TPU_PALLAS"):
         return False
     if not HAVE_JAX:
         return False

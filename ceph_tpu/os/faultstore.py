@@ -42,6 +42,8 @@ from __future__ import annotations
 
 import hashlib
 import os as _os
+
+from ceph_tpu.common import flags
 import shutil
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -61,7 +63,7 @@ EV_MARK = "mark"      # (label,) — ack/txn markers ride the trace
 
 
 def crash_inject_enabled() -> bool:
-    return _os.environ.get("CEPH_TPU_CRASH_INJECT", "1") != "0"
+    return flags.enabled("CEPH_TPU_CRASH_INJECT")
 
 
 class CrashLog:

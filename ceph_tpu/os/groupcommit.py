@@ -61,6 +61,8 @@ from __future__ import annotations
 
 import asyncio
 import os
+
+from ceph_tpu.common import flags
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
@@ -72,7 +74,7 @@ __all__ = ["GroupCommitter"]
 
 def _env_float(name: str, default: float) -> float:
     try:
-        return float(os.environ.get(name, default))
+        return flags.flag_float(name, default)
     except ValueError:
         return default
 
@@ -100,7 +102,7 @@ class GroupCommitter:
         self.who = who
         config = config or {}
         self.enabled = (
-            os.environ.get("CEPH_TPU_GROUP_COMMIT", "1") != "0"
+            flags.enabled("CEPH_TPU_GROUP_COMMIT")
             and bool(config.get("osd_group_commit_enable", True)))
         # engage only where barriers exist to amortize: a store that
         # kept the base (loop-per-txn) submit_batch gains nothing

@@ -12,6 +12,8 @@ import asyncio
 import json
 import logging
 import os
+
+from ceph_tpu.common import flags
 import sys
 
 from ceph_tpu.os.memstore import MemStore
@@ -19,7 +21,7 @@ from ceph_tpu.osd.daemon import OSDDaemon
 
 
 async def _main() -> None:
-    if os.environ.get("CEPH_TPU_DEBUG"):
+    if flags.get("CEPH_TPU_DEBUG"):
         logging.basicConfig(level=logging.DEBUG)
     ap = argparse.ArgumentParser()
     ap.add_argument("--id", type=int, required=True)

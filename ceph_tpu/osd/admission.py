@@ -37,6 +37,8 @@ from __future__ import annotations
 
 import asyncio
 import os
+
+from ceph_tpu.common import flags
 import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -68,7 +70,7 @@ class AdmissionGate:
         config = config or {}
         self.enabled = bool(config.get(
             "osd_mclock_admission_enable", True)) and \
-            os.environ.get("CEPH_TPU_QOS", "1") != "0"
+            flags.enabled("CEPH_TPU_QOS")
         # burst: seconds' worth of the limit rate a sleeping tenant
         # may spend instantly on wake (bucket capacity)
         self.burst_s = float(config.get(

@@ -34,6 +34,8 @@ import json
 import logging
 import math
 import os
+
+from ceph_tpu.common import flags
 import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -543,7 +545,7 @@ class OSDDaemon:
             tenant_default=tenant_default,
             tenant_profiles=tenant_profiles)
         self._qos_tenants_enabled = (
-            os.environ.get("CEPH_TPU_QOS", "1") != "0"
+            flags.enabled("CEPH_TPU_QOS")
             and bool(self.config.get("osd_mclock_tenant_enable",
                                      True))
             and isinstance(self.scheduler,
@@ -552,8 +554,18 @@ class OSDDaemon:
         # lock): identical admission/QoS accounting, minus the per-op
         # queue/objlock coroutine micro-costs.  CEPH_TPU_OP_FAST_LANE=0
         # pins every op to the queued path (behavioral twin).
-        self._op_fast_lane = os.environ.get(
-            "CEPH_TPU_OP_FAST_LANE", "1") != "0"
+        self._op_fast_lane = flags.enabled("CEPH_TPU_OP_FAST_LANE")
+        # backfill/recovery throttle (osd_max_backfills role): at most
+        # N PGs may run _recover_pg concurrently on this OSD.  An
+        # elasticity event (osd out/in, revive) re-peers MANY PGs at
+        # once; without the cap their plan waves all contend for
+        # scheduler slots and device dispatches at the same time and
+        # client reservations starve exactly when the cluster is
+        # already degraded.
+        self._backfill_sem = asyncio.Semaphore(
+            max(int(self.config.get("osd_max_backfills", 1)), 1))
+        self.perf["backfills_active"] = 0
+        self.perf["backfill_waits"] = 0
         profile_of = (
             (lambda t: self.scheduler.profile_of(
                 sched_mod.tenant_class(t)))
@@ -906,8 +918,8 @@ class OSDDaemon:
                 self.encode_service.counters.get("mesh_batches", 0),
             "decode_host_retries":
                 self.perf.get("decode_host_retries", 0),
-            "injection": os.environ.get(
-                "CEPH_TPU_INJECT_DEVICE_FAIL", ""),
+            "injection": flags.get(
+                "CEPH_TPU_INJECT_DEVICE_FAIL") or "",
             "guard_enabled": circuit.enabled(),
         }
 
@@ -3320,6 +3332,26 @@ class OSDDaemon:
         3. COMMIT — install/push all objects concurrently.
         """
         pg = state.pg
+        # the per-OSD backfill cap: PGs queue here, not in the device
+        # layer.  Taken BEFORE any object lock (same slot/lock
+        # discipline as the pacing token below — a capped PG holds
+        # nothing a client op could be waiting on).
+        if self._backfill_sem.locked():
+            self.perf["backfill_waits"] = \
+                self.perf.get("backfill_waits", 0) + 1
+        async with self._backfill_sem:
+            self.perf["backfills_active"] = \
+                self.perf.get("backfills_active", 0) + 1
+            try:
+                await self._recover_pg_throttled(state, pool,
+                                                peer_shards)
+            finally:
+                self.perf["backfills_active"] -= 1
+
+    async def _recover_pg_throttled(self, state: PGState, pool,
+                                    peer_shards: Dict[int, int]
+                                    ) -> None:
+        pg = state.pg
         plog = self._load_log(state, pool)
         my_shard = state.my_shard(self.osd_id, pool.type)
         # union of all objects anyone is missing
@@ -3353,7 +3385,13 @@ class OSDDaemon:
                 return None
 
             async def plan_locked(oid: str):
-                await self.scheduler.run(sched_mod.RECOVERY, 1.0, _noop)
+                # push-only objects (a peer is behind, this primary is
+                # whole) are BACKFILL work: they ride the best-effort
+                # class so a drain/add wave cannot eat the reservation
+                # budget client ops share with genuine self-recovery
+                cls = sched_mod.RECOVERY if oid in plog.missing \
+                    else sched_mod.BEST_EFFORT
+                await self.scheduler.run(cls, 1.0, _noop)
                 ctx = state.obj_lock(oid)
                 await ctx.__aenter__()
                 held[oid] = ctx
@@ -3785,7 +3823,7 @@ class OSDDaemon:
         classic k-read reconstruct for every object.  Results are
         bit-identical either way — repair and full decode agree by
         construction — so the switch exists for triage, not safety."""
-        if os.environ.get("CEPH_TPU_MSR_REPAIR", "1") == "0":
+        if not flags.enabled("CEPH_TPU_MSR_REPAIR"):
             return False
         return bool(self.config.get("osd_msr_repair_enable", True))
 
@@ -4216,6 +4254,15 @@ class OSDDaemon:
             nbytes = sum(len(op.data) for op in msg.ops)
             cost = 1.0 + nbytes / (1 << 20)
             tenant = getattr(msg, "tenant", "") or ""
+            # dmClock piggyback: the client's ServiceTracker counted
+            # its completions at OTHER OSDs since its last op here —
+            # the tag advance below charges this class for them, so
+            # reservation/limit hold cluster-wide (CEPH_TPU_DMCLOCK=0
+            # pins both to 1: classic per-OSD mClock)
+            qos_delta = qos_rho = 1
+            if flags.enabled("CEPH_TPU_DMCLOCK"):
+                qos_delta = getattr(msg, "qos_delta", 1)
+                qos_rho = getattr(msg, "qos_rho", 1)
             op_class = sched_mod.CLIENT
             admitted = True
             if tenant and self._qos_tenants_enabled:
@@ -4234,10 +4281,12 @@ class OSDDaemon:
                 if decision == SHED:
                     admitted = False
             try:
+                qos_phase = ""
                 if not admitted:
                     rc, data, out = EBUSY, b"", {}
                 elif self._op_fast_lane_ok(pool, nbytes) and \
-                        self.scheduler.try_acquire(op_class, cost):
+                        (qos_phase := self.scheduler.try_acquire(
+                            op_class, cost, qos_delta, qos_rho)):
                     # sub-chunk fast lane: the scheduler charges the
                     # class's dmClock tags exactly as run()'s fast
                     # grant would (fairness accounting identical,
@@ -4250,10 +4299,18 @@ class OSDDaemon:
                     finally:
                         self.scheduler.release()
                 else:
+                    async def _run_and_stamp():
+                        # the grant phase is only visible inside the
+                        # granted context; capture it for the reply
+                        nonlocal qos_phase
+                        qos_phase = sched_mod.current_phase()
+                        return await self._execute_ops(state, pool,
+                                                       msg, conn)
+
+                    qos_phase = ""
                     rc, data, out = await self.scheduler.run(
-                        op_class, cost,
-                        lambda: self._execute_ops(state, pool, msg,
-                                                  conn))
+                        op_class, cost, _run_and_stamp,
+                        qos_delta=qos_delta, qos_rho=qos_rho)
             except asyncio.CancelledError:
                 raise
             except sched_mod.QueueFull:
@@ -4281,9 +4338,10 @@ class OSDDaemon:
                 self._completed_ops[reqid] = (rc, data, out)
                 while len(self._completed_ops) > 4096:
                     self._completed_ops.popitem(last=False)
-        await conn.send(MOSDOpReply(msg.tid, rc, data, out,
-                                    replay_epoch=self._epoch()
-                                    if rc == EAGAIN else 0))
+        await conn.send(MOSDOpReply(
+            msg.tid, rc, data, out,
+            replay_epoch=self._epoch() if rc == EAGAIN else 0,
+            qos_phase=qos_phase if cached is None else ""))
 
     # -- coded compute (MOSDCompute, osd/compute.py) -----------------------
 

@@ -20,6 +20,8 @@ the whole object (SURVEY.md §7 hard part #4).
 from __future__ import annotations
 
 import os
+
+from ceph_tpu.common import flags
 from typing import Dict, Iterable, List, Mapping, Optional, Set, Tuple
 
 import numpy as np
@@ -252,7 +254,7 @@ def _fuse_min_bytes() -> Optional[int]:
     Default: 1 MiB on a real TPU backend — that is where fusing the
     parity and hinfo-CRC round-trips into one dispatch pays; on the
     CPU tier the fused path is the native noT kernel below."""
-    env = os.environ.get("CEPH_TPU_FUSE_MIN_BYTES")
+    env = flags.get("CEPH_TPU_FUSE_MIN_BYTES")
     if env is not None:
         try:
             return int(env)

@@ -58,6 +58,8 @@ from __future__ import annotations
 
 import asyncio
 import os
+
+from ceph_tpu.common import flags
 import time
 from typing import Dict, Iterable, List, Optional
 
@@ -69,7 +71,7 @@ __all__ = ["EncodeService"]
 
 def _env_float(name: str, default: float) -> float:
     try:
-        return float(os.environ.get(name, default))
+        return flags.flag_float(name, default)
     except ValueError:
         return default
 
@@ -162,8 +164,7 @@ class EncodeService:
                  max_queue_requests: int = 256,
                  max_queue_bytes: Optional[int] = None):
         self.who = who
-        self.enabled = os.environ.get(
-            "CEPH_TPU_ENCODE_SERVICE", "1") != "0"
+        self.enabled = flags.enabled("CEPH_TPU_ENCODE_SERVICE")
         if window_ms is None:
             window_ms = _env_float("CEPH_TPU_ENCODE_BATCH_WINDOW_MS",
                                    1.0)

@@ -43,6 +43,8 @@ import asyncio
 import logging
 import math
 import os
+
+from ceph_tpu.common import flags
 import time
 from typing import (
     Any, Awaitable, Callable, Dict, List, Optional, Sequence, Tuple,
@@ -61,7 +63,7 @@ _Z95 = 1.645
 
 
 def env_enabled() -> bool:
-    return os.environ.get("CEPH_TPU_HEDGE", "1") != "0"
+    return flags.enabled("CEPH_TPU_HEDGE")
 
 
 class PeerStats:

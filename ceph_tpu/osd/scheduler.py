@@ -93,6 +93,24 @@ def current_class() -> str:
     return _current_class.get()
 
 
+#: dmClock grant phase of the currently-running op: "reservation"
+#: (granted against the class's r-tag constraint) or "priority"
+#: (proportional-share phase).  Replies carry it back so the client's
+#: ServiceTracker can count rho — reservation-phase completions —
+#: separately from delta (all completions), per the dmClock paper.
+PHASE_RESERVATION = "reservation"
+PHASE_PRIORITY = "priority"
+
+_current_phase: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "ceph_tpu_grant_phase", default="")
+
+
+def current_phase() -> str:
+    """dmClock phase of the currently-running op's grant, '' outside
+    any grant or under a non-mClock scheduler."""
+    return _current_phase.get()
+
+
 def tenant_class(tenant: str) -> str:
     """Scheduler class for a tenant's client ops ('' = the shared
     default class)."""
@@ -118,14 +136,23 @@ class QueueFull(RuntimeError):
 
 
 class _Item:
-    __slots__ = ("cost", "fn", "future", "r_tag", "p_tag")
+    __slots__ = ("cost", "fn", "future", "r_tag", "p_tag",
+                 "delta", "rho")
 
-    def __init__(self, cost: float, fn, future):
+    def __init__(self, cost: float, fn, future,
+                 delta: int = 1, rho: int = 1):
         self.cost = cost
         self.fn = fn
         self.future = future
         self.r_tag = 0.0
         self.p_tag = 0.0
+        # dmClock piggyback multipliers: completions this tenant saw
+        # cluster-wide (delta: all; rho: reservation-phase) at OTHER
+        # OSDs since its last request here, plus one for this op.
+        # 1/1 — a single-OSD or piggyback-off op — reduces every tag
+        # formula below to classic single-server mClock.
+        self.delta = max(int(delta), 1)
+        self.rho = max(int(rho), 1)
 
 
 class OpSchedulerBase:
@@ -181,16 +208,26 @@ class OpSchedulerBase:
         self._nqueued = 0
 
     async def run(self, op_class: str, cost: float,
-                  fn: Callable[[], Awaitable[Any]]) -> Any:
-        """Queue fn under op_class; execute once granted."""
+                  fn: Callable[[], Awaitable[Any]], *,
+                  qos_delta: int = 1, qos_rho: int = 1) -> Any:
+        """Queue fn under op_class; execute once granted.
+
+        qos_delta/qos_rho are the dmClock piggyback multipliers from
+        the client's ServiceTracker (completions it saw at other OSDs
+        since its last op here, plus one): tags advance by
+        delta x cost so per-tenant rates hold CLUSTER-wide, not
+        per-OSD.  1/1 (the default) is classic local mClock."""
         if self._stopping:
             # a latched-stopped scheduler must fail fast: start()
             # would spawn a grant loop that exits immediately and the
             # queued future would park the caller forever
             raise RuntimeError("scheduler stopped")
+        fast_phase = None
         if self._nqueued == 0 and \
-                self._in_flight < self.max_concurrent and \
-                self._fast_charge(op_class, max(cost, 1.0)):
+                self._in_flight < self.max_concurrent:
+            fast_phase = self._fast_charge(
+                op_class, max(cost, 1.0), qos_delta, qos_rho)
+        if fast_phase:
             # uncontended fast grant: nothing is queued and a slot is
             # free, so the grant loop's future/enqueue/select round
             # trip (two loop hops + an O(classes) scan per op) buys
@@ -206,9 +243,11 @@ class OpSchedulerBase:
             q_span.set_attr("fast", True)
             q_span.finish()
             tok = _current_class.set(op_class)
+            ptok = _current_phase.set(fast_phase)
             try:
                 return await fn()
             finally:
+                _current_phase.reset(ptok)
                 _current_class.reset(tok)
                 self._in_flight -= 1
                 self._wake.set()
@@ -237,12 +276,13 @@ class OpSchedulerBase:
                     raise RuntimeError("scheduler stopped")
             fut: asyncio.Future = \
                 asyncio.get_running_loop().create_future()
-            item = _Item(max(cost, 1.0), fn, fut)
+            item = _Item(max(cost, 1.0), fn, fut,
+                         qos_delta, qos_rho)
             self._enqueue(op_class, item)
             self._nqueued += 1
             self._wake.set()
             try:
-                await fut  # grant
+                phase = await fut  # grant (dmClock phase it won)
             except asyncio.CancelledError:
                 # cancelled AFTER the grant landed: the slot was
                 # consumed and fn never ran — release it or the leak
@@ -257,14 +297,17 @@ class OpSchedulerBase:
         finally:
             q_span.finish()
         tok = _current_class.set(op_class)
+        ptok = _current_phase.set(phase or "")
         try:
             return await fn()
         finally:
+            _current_phase.reset(ptok)
             _current_class.reset(tok)
             self._in_flight -= 1
             self._wake.set()
 
-    def try_acquire(self, op_class: str, cost: float) -> bool:
+    def try_acquire(self, op_class: str, cost: float,
+                    qos_delta: int = 1, qos_rho: int = 1):
         """Synchronous twin of run()'s uncontended fast grant — the
         sub-chunk write fast lane.  Succeeds ONLY under the exact
         conditions the fast grant would (nothing queued, a slot free,
@@ -272,10 +315,15 @@ class OpSchedulerBase:
         identical accounting: granted counts, tag charges, and the
         queue stage span all land as if run() had fast-granted, so
         QoS fairness and the per-stage histograms cannot drift between
-        lanes.  The caller MUST pair a True return with release()."""
+        lanes.  Returns the dmClock grant phase (a truthy string) on
+        success, False on refusal; the caller MUST pair a truthy
+        return with release()."""
         if self._stopping or self._nqueued != 0 or \
-                self._in_flight >= self.max_concurrent or \
-                not self._fast_charge(op_class, max(cost, 1.0)):
+                self._in_flight >= self.max_concurrent:
+            return False
+        phase = self._fast_charge(op_class, max(cost, 1.0),
+                                  qos_delta, qos_rho)
+        if not phase:
             return False
         self._in_flight += 1
         self.granted[op_class] = self.granted.get(op_class, 0) + 1
@@ -284,7 +332,7 @@ class OpSchedulerBase:
             f"queue.{stage_class(op_class)}", cls=op_class)
         q_span.set_attr("fast", True)
         q_span.finish()
-        return True
+        return phase
 
     def release(self) -> None:
         """Release a try_acquire slot (mirrors run()'s finally)."""
@@ -296,19 +344,21 @@ class OpSchedulerBase:
     def _enqueue(self, op_class: str, item: _Item) -> None:
         raise NotImplementedError
 
-    def _select(self) -> Optional[Tuple[str, _Item]]:
+    def _select(self) -> Optional[Tuple[str, _Item, str]]:
+        """Pick the next granted item: (class, item, dmClock phase)."""
         raise NotImplementedError
 
     def _uncharge(self, op_class: str, item: _Item) -> None:
         """Return a cancelled-before-grant item's tag/service charge:
         the work never ran, so the class must not be debited for it."""
 
-    def _fast_charge(self, op_class: str, cost: float) -> bool:
+    def _fast_charge(self, op_class: str, cost: float,
+                     delta: int = 1, rho: int = 1):
         """Charge the class's tags for an uncontended immediate grant
-        (the enqueue+select accounting, minus the queue).  False =
-        the class may not run right now (rate-gated) and must take
-        the queued path."""
-        return True
+        (the enqueue+select accounting, minus the queue).  Returns
+        the grant phase (truthy string); False = the class may not
+        run right now (rate-gated) and must take the queued path."""
+        return PHASE_PRIORITY
 
     def _queued(self) -> int:
         return self._nqueued
@@ -337,7 +387,7 @@ class OpSchedulerBase:
                 picked = self._select()
                 if picked is None:
                     break
-                op_class, item = picked
+                op_class, item, phase = picked
                 self._nqueued -= 1
                 self._drained.set()
                 if item.future.done():
@@ -350,7 +400,7 @@ class OpSchedulerBase:
                 self._in_flight += 1
                 self.granted[op_class] = \
                     self.granted.get(op_class, 0) + 1
-                item.future.set_result(None)
+                item.future.set_result(phase)
             self._wake.clear()
             if self._queued() == 0 or \
                     self._in_flight >= self.max_concurrent:
@@ -389,7 +439,7 @@ class WPQScheduler(OpSchedulerBase):
                 self._served.get(op_class, 0.0), floor)
         q.append(item)
 
-    def _select(self) -> Optional[Tuple[str, _Item]]:
+    def _select(self) -> Optional[Tuple[str, _Item, str]]:
         best = None
         for op_class, q in self._queues.items():
             if not q:
@@ -403,19 +453,21 @@ class WPQScheduler(OpSchedulerBase):
         item = self._queues[op_class].pop(0)
         self._served[op_class] = self._served.get(op_class, 0.0) + \
             item.cost / max(self.weights.get(op_class, 1.0), 1e-9)
-        return op_class, item
+        return op_class, item, PHASE_PRIORITY
 
     def _uncharge(self, op_class: str, item: _Item) -> None:
         self._served[op_class] = self._served.get(op_class, 0.0) - \
             item.cost / max(self.weights.get(op_class, 1.0), 1e-9)
 
-    def _fast_charge(self, op_class: str, cost: float) -> bool:
+    def _fast_charge(self, op_class: str, cost: float,
+                     delta: int = 1, rho: int = 1):
         # same service charge the pop in _select takes (an idle-floor
         # catch-up is moot: the fast path only runs with EVERY queue
-        # empty, so there is no backlogged floor to respect)
+        # empty, so there is no backlogged floor to respect).  WPQ is
+        # not dmClock: the piggyback multipliers are ignored.
         self._served[op_class] = self._served.get(op_class, 0.0) + \
             cost / max(self.weights.get(op_class, 1.0), 1e-9)
-        return True
+        return PHASE_PRIORITY
 
 
 class MClockScheduler(OpSchedulerBase):
@@ -476,14 +528,18 @@ class MClockScheduler(OpSchedulerBase):
         if r > 0:
             # the max(now, ...) floor IS the idle-tag-replay guard: a
             # tenant that slept cannot bank reservation credit and
-            # replay it as an instantaneous burst
+            # replay it as an instantaneous burst.  rho scales the
+            # advance by the reservation-phase completions this tenant
+            # won at OTHER OSDs since its last op here (dmClock): the
+            # reservation is then honored cluster-wide, not N-times
+            # over by N primaries.
             item.r_tag = max(now, self._last_r.get(op_class, 0.0)
-                             + item.cost / r)
+                             + item.cost * item.rho / r)
             self._last_r[op_class] = item.r_tag
         else:
             item.r_tag = float("inf")
         item.p_tag = max(now, self._last_p.get(op_class, 0.0)) \
-            + item.cost / max(w, 1e-9)
+            + item.cost * item.delta / max(w, 1e-9)
         self._last_p[op_class] = item.p_tag
         self._queues.setdefault(op_class, []).append(item)
         self._prune_idle_tenants()
@@ -494,13 +550,15 @@ class MClockScheduler(OpSchedulerBase):
         took when it popped the dead item."""
         r, w, l = self.profile_of(op_class)
         if r > 0 and op_class in self._last_r:
-            self._last_r[op_class] -= item.cost / r
+            self._last_r[op_class] -= item.cost * item.rho / r
         if op_class in self._last_p:
-            self._last_p[op_class] -= item.cost / max(w, 1e-9)
+            self._last_p[op_class] -= \
+                item.cost * item.delta / max(w, 1e-9)
         if l > 0 and op_class in self._last_l:
-            self._last_l[op_class] -= item.cost / l
+            self._last_l[op_class] -= item.cost * item.delta / l
 
-    def _fast_charge(self, op_class: str, cost: float) -> bool:
+    def _fast_charge(self, op_class: str, cost: float,
+                     delta: int = 1, rho: int = 1):
         # dmClock tags advance exactly as _enqueue + _charge_limit
         # would have; an over-limit class is REFUSED (it must queue
         # behind its L-tag like always — the fast path never launders
@@ -509,17 +567,25 @@ class MClockScheduler(OpSchedulerBase):
         if not self._limit_ok(op_class, now):
             return False
         r, w, l = self.profile_of(op_class)
+        phase = PHASE_PRIORITY
         if r > 0:
-            self._last_r[op_class] = max(
-                now, self._last_r.get(op_class, 0.0) + cost / r)
+            r_next = self._last_r.get(op_class, 0.0) \
+                + cost * max(rho, 1) / r
+            if r_next <= now:
+                # the grant lands inside the reservation constraint:
+                # this is the phase a queued _select pass 1 would
+                # have used
+                phase = PHASE_RESERVATION
+            self._last_r[op_class] = max(now, r_next)
         self._last_p[op_class] = \
             max(now, self._last_p.get(op_class, 0.0)) \
-            + cost / max(w, 1e-9)
+            + cost * max(delta, 1) / max(w, 1e-9)
         if l > 0:
             self._last_l[op_class] = \
-                max(now, self._last_l.get(op_class, 0.0)) + cost / l
+                max(now, self._last_l.get(op_class, 0.0)) \
+                + cost * max(delta, 1) / l
         self._prune_idle_tenants()
-        return True
+        return phase
 
     def _limit_ok(self, op_class: str, now: float) -> bool:
         _r, _w, l = self.profile_of(op_class)
@@ -533,9 +599,9 @@ class MClockScheduler(OpSchedulerBase):
         if l > 0:
             self._last_l[op_class] = \
                 max(now, self._last_l.get(op_class, 0.0)) \
-                + item.cost / l
+                + item.cost * item.delta / l
 
-    def _select(self) -> Optional[Tuple[str, _Item]]:
+    def _select(self) -> Optional[Tuple[str, _Item, str]]:
         now = time.monotonic()
         # phase 1: reservations behind schedule (constraint-based)
         best = None
@@ -547,7 +613,7 @@ class MClockScheduler(OpSchedulerBase):
             op_class = best[0]
             item = self._queues[op_class].pop(0)
             self._charge_limit(op_class, item, now)
-            return op_class, item
+            return op_class, item, PHASE_RESERVATION
         # phase 2: proportional share among classes under their limit
         best = None
         for op_class, q in self._queues.items():
@@ -559,7 +625,7 @@ class MClockScheduler(OpSchedulerBase):
         op_class = best[0]
         item = self._queues[op_class].pop(0)
         self._charge_limit(op_class, item, now)
-        return op_class, item
+        return op_class, item, PHASE_PRIORITY
 
     def stats(self) -> Dict[str, Any]:
         out = super().stats()

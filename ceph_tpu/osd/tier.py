@@ -29,6 +29,8 @@ osd_tier_enable option.
 from __future__ import annotations
 
 import os
+
+from ceph_tpu.common import flags
 import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -42,7 +44,7 @@ READ_FREQ_BOUNDS = [1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0]
 
 
 def env_enabled() -> bool:
-    return os.environ.get("CEPH_TPU_TIER", "1") != "0"
+    return flags.enabled("CEPH_TPU_TIER")
 
 
 class TierAgent:

@@ -30,6 +30,7 @@ import os
 from typing import Dict, List, Optional
 
 import numpy as np
+from ceph_tpu.common import flags
 
 # observability: how many device dispatches the pipeline served (and
 # how many stripe rows rode them — calls vs rows is the batching fill
@@ -61,7 +62,7 @@ def healthy_devices() -> List:
         devs = list(jax.local_devices())
     else:
         devs = list(jax.devices())
-    if os.environ.get("CEPH_TPU_MESH", "1") == "0":
+    if not flags.enabled("CEPH_TPU_MESH"):
         return devs[:1]
     healthy = [d for d in devs if not circuit.device_degraded(d.id)]
     return healthy or devs[:1]

@@ -47,6 +47,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from ceph_tpu.common import flags
+
 _SWEEP_SIZES = (1, 2, 4, 8)
 
 
@@ -56,15 +58,15 @@ def _mesh_gates_open():
     after: the dryrun driver tail runs these reports in-process, and
     a leaked CEPH_TPU_MESH_MIN_BYTES=0 would make every later tiny
     batch in that process mesh (the 1 MiB floor silently gone)."""
-    prev = os.environ.get("CEPH_TPU_MESH_MIN_BYTES")
-    os.environ.setdefault("CEPH_TPU_MESH_MIN_BYTES", "0")
+    prev = flags.peek("CEPH_TPU_MESH_MIN_BYTES")
+    flags.setdefault("CEPH_TPU_MESH_MIN_BYTES", "0")
     try:
         yield
     finally:
         if prev is None:
-            os.environ.pop("CEPH_TPU_MESH_MIN_BYTES", None)
+            flags.clear("CEPH_TPU_MESH_MIN_BYTES")
         else:
-            os.environ["CEPH_TPU_MESH_MIN_BYTES"] = prev
+            flags.set_flag("CEPH_TPU_MESH_MIN_BYTES", prev)
 
 
 def ensure_devices(n: int = 8) -> int:
@@ -75,16 +77,16 @@ def ensure_devices(n: int = 8) -> int:
     module).  Returns the visible device count."""
     import re
 
-    flags = os.environ.get("XLA_FLAGS", "")
+    xla_flags = os.environ.get("XLA_FLAGS", "")
     m = re.search(r"--xla_force_host_platform_device_count=(\d+)",
-                  flags)
+                  xla_flags)
     if m is None:
-        flags += f" --xla_force_host_platform_device_count={n}"
+        xla_flags += f" --xla_force_host_platform_device_count={n}"
     elif int(m.group(1)) < n:
-        flags = (flags[:m.start()] +
-                 f"--xla_force_host_platform_device_count={n}" +
-                 flags[m.end():])
-    os.environ["XLA_FLAGS"] = flags.strip()
+        xla_flags = (xla_flags[:m.start()] +
+                     f"--xla_force_host_platform_device_count={n}" +
+                     xla_flags[m.end():])
+    os.environ["XLA_FLAGS"] = xla_flags.strip()
 
     import jax
 
@@ -115,22 +117,23 @@ def _encode_crc(matrix, data, max_devices: int):
     capped at `max_devices` chips (0 = single-device plans only)."""
     from ceph_tpu.ec import plan
 
-    prev = os.environ.get("CEPH_TPU_MESH_MAX_DEVICES")
-    prev_mesh = os.environ.get("CEPH_TPU_MESH")
+    prev = flags.peek("CEPH_TPU_MESH_MAX_DEVICES")
+    prev_mesh = flags.peek("CEPH_TPU_MESH")
     try:
         if max_devices <= 1:
-            os.environ["CEPH_TPU_MESH"] = "0"
+            flags.set_flag("CEPH_TPU_MESH", "0")
         else:
-            os.environ["CEPH_TPU_MESH"] = "1"
-            os.environ["CEPH_TPU_MESH_MAX_DEVICES"] = str(max_devices)
+            flags.set_flag("CEPH_TPU_MESH", "1")
+            flags.set_flag("CEPH_TPU_MESH_MAX_DEVICES",
+                           str(max_devices))
         return plan.encode_with_crc(matrix, data, sig="meshbench")
     finally:
         for name, val in (("CEPH_TPU_MESH_MAX_DEVICES", prev),
                           ("CEPH_TPU_MESH", prev_mesh)):
             if val is None:
-                os.environ.pop(name, None)
+                flags.clear(name)
             else:
-                os.environ[name] = val
+                flags.set_flag(name, val)
 
 
 def probe_report(smoke: bool = True) -> dict:
@@ -174,12 +177,13 @@ def _probe_report(smoke: bool) -> dict:
         }
     sick_chip_shrunk = 0
     host_fallbacks = -1
-    prev_inject = os.environ.get("CEPH_TPU_INJECT_DEVICE_FAIL")
+    prev_inject = flags.peek("CEPH_TPU_INJECT_DEVICE_FAIL")
     try:
         import jax
 
         sick_id = jax.devices()[-1].id
-        os.environ["CEPH_TPU_INJECT_DEVICE_FAIL"] = f"sick={sick_id}"
+        flags.set_flag("CEPH_TPU_INJECT_DEVICE_FAIL",
+                       f"sick={sick_id}")
         out = _encode_crc(matrix, data, n)
         st = plan.stats()
         host_fallbacks = st["host_fallbacks"]
@@ -197,9 +201,10 @@ def _probe_report(smoke: bool) -> dict:
             and circuit.device_breaker(sick_id).state == "open")
     finally:
         if prev_inject is None:
-            os.environ.pop("CEPH_TPU_INJECT_DEVICE_FAIL", None)
+            flags.clear("CEPH_TPU_INJECT_DEVICE_FAIL")
         else:
-            os.environ["CEPH_TPU_INJECT_DEVICE_FAIL"] = prev_inject
+            flags.set_flag("CEPH_TPU_INJECT_DEVICE_FAIL",
+                           prev_inject)
         circuit.reset_all()
     return {
         "devices": n,
@@ -284,10 +289,10 @@ def host_loss_report(smoke: bool = True) -> dict:
     n = ensure_devices()
     if n < 2:
         return {"multihost_hosts": 1, "host_loss_shrunk": None}
-    saved = {k: os.environ.get(k) for k in
+    saved = {k: flags.peek(k) for k in
              ("CEPH_TPU_MULTIHOST_HOSTS",
               "CEPH_TPU_INJECT_DEVICE_FAIL")}
-    os.environ["CEPH_TPU_MULTIHOST_HOSTS"] = "2"
+    flags.set_flag("CEPH_TPU_MULTIHOST_HOSTS", "2")
     matrix, data, m = _workload(smoke)
     oracle = _host_oracle(matrix, data)
     try:
@@ -295,7 +300,8 @@ def host_loss_report(smoke: bool = True) -> dict:
             circuit.reset_all()
             plan.reset_stats()
             clean = _encode_crc(matrix, data, n)
-            os.environ["CEPH_TPU_INJECT_DEVICE_FAIL"] = "down_host=1"
+            flags.set_flag("CEPH_TPU_INJECT_DEVICE_FAIL",
+                           "down_host=1")
             lost = _encode_crc(matrix, data, n)
             st = plan.stats()
             chip_trips = sum(
@@ -319,9 +325,9 @@ def host_loss_report(smoke: bool = True) -> dict:
     finally:
         for k, v in saved.items():
             if v is None:
-                os.environ.pop(k, None)
+                flags.clear(k)
             else:
-                os.environ[k] = v
+                flags.set_flag(k, v)
         circuit.reset_all()
 
 
@@ -423,7 +429,7 @@ def worker_report(smoke: bool = True, iters: int = 3) -> dict:
     from ceph_tpu.ec import plan
     from ceph_tpu.parallel import multihost
 
-    deadline = os.environ.get("CEPH_TPU_MULTIHOST_WORKER_DEADLINE_S")
+    deadline = flags.get("CEPH_TPU_MULTIHOST_WORKER_DEADLINE_S")
     if deadline:
         import threading
 
@@ -477,8 +483,7 @@ def multihost_report(processes: Optional[List[int]] = None,
     # per-leg deadline: strictly below bench.py's subprocess timeouts
     # (probe 180 / sweep 300), so THIS driver always kills and reaps
     # its worker group before the outer timeout kills the driver
-    timeout_s = float(os.environ.get(
-        "CEPH_TPU_MULTIHOST_LEG_TIMEOUT_S", "120"))
+    timeout_s = flags.flag_float("CEPH_TPU_MULTIHOST_LEG_TIMEOUT_S")
     rows = []
     all_bitexact = 1
     for nproc in counts:
@@ -519,8 +524,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="internal: one process of a --processes"
                     " group")
     args = ap.parse_args(argv)
-    smoke = args.smoke or os.environ.get(
-        "CEPH_TPU_BENCH_SMOKE") == "1"
+    smoke = args.smoke or flags.get("CEPH_TPU_BENCH_SMOKE") == "1"
     if args.worker:
         print(json.dumps(worker_report(smoke=smoke)), flush=True)
         return 0

@@ -52,6 +52,8 @@ import os
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ceph_tpu.common import flags
+
 __all__ = [
     "agree", "agree_healthy", "agreed_healthy", "bootstrap_from_env",
     "enabled", "gather", "host_count", "host_of_id", "hosts",
@@ -70,7 +72,7 @@ def enabled() -> bool:
     """CEPH_TPU_MULTIHOST=0 is the kill switch: no process group is
     ever joined, the topology reads single-host, and every mesh plan
     keys exactly as the single-process PR-9 path."""
-    return os.environ.get("CEPH_TPU_MULTIHOST", "1") != "0"
+    return flags.enabled("CEPH_TPU_MULTIHOST")
 
 
 # ---------------------------------------------------------------------------
@@ -98,24 +100,24 @@ def initialize(coordinator: Optional[str] = None,
             return True
         if not enabled():
             return False
-        coordinator = coordinator or os.environ.get(
-            "CEPH_TPU_MULTIHOST_COORD", "")
+        coordinator = coordinator or flags.get(
+            "CEPH_TPU_MULTIHOST_COORD")
         if num_processes is None:
-            num_processes = int(os.environ.get(
-                "CEPH_TPU_MULTIHOST_NPROC", "1"))
+            num_processes = flags.flag_int(
+                "CEPH_TPU_MULTIHOST_NPROC")
         if process_id is None:
-            process_id = int(os.environ.get(
-                "CEPH_TPU_MULTIHOST_PID", "0"))
+            process_id = flags.flag_int("CEPH_TPU_MULTIHOST_PID")
         if not coordinator or num_processes <= 1:
             return False
         if local_device_count is None:
-            env = os.environ.get("CEPH_TPU_MULTIHOST_LOCAL_DEVICES")
+            env = flags.get("CEPH_TPU_MULTIHOST_LOCAL_DEVICES")
             local_device_count = int(env) if env else None
         if local_device_count:
-            flags = os.environ.get("XLA_FLAGS", "")
-            if "xla_force_host_platform_device_count" not in flags:
+            xla_flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in xla_flags:
                 os.environ["XLA_FLAGS"] = (
-                    flags + " --xla_force_host_platform_device_count="
+                    xla_flags
+                    + " --xla_force_host_platform_device_count="
                     f"{local_device_count}").strip()
         import jax
 
@@ -185,8 +187,7 @@ def _emulated_hosts() -> int:
     tier-1 exercises host-level failure domains.  Ignored in a real
     multi-process group (processes ARE the hosts there)."""
     try:
-        return max(int(os.environ.get("CEPH_TPU_MULTIHOST_HOSTS",
-                                      "1")), 1)
+        return max(flags.flag_int("CEPH_TPU_MULTIHOST_HOSTS"), 1)
     except ValueError:
         return 1
 
@@ -198,7 +199,7 @@ def _topology() -> Tuple[Dict[int, int],
     process's lifetime; breakers, not topology, carry health)."""
     global _topo_cache
     key = (f"{_initialized}/{_emulated_hosts()}/"
-           f"{os.environ.get('CEPH_TPU_MULTIHOST', '1')}")
+           f"{flags.get('CEPH_TPU_MULTIHOST')}")
     with _topo_lock:
         if _topo_cache is not None and _topo_cache[0] == key:
             return _topo_cache[1], _topo_cache[2]
@@ -311,8 +312,8 @@ def _trace_collective(op: str, kind: str, topic: str = "") -> None:
     caller's call site at every seam entry so the multi-process
     harness can assert runtime ⊆ static-site-map and per-process
     order congruence.  Unarmed, this is one env read."""
-    if not (os.environ.get("CEPH_TPU_COLLECTIVE_TRACE") == "1"
-            or os.environ.get("CEPH_TPU_COLLECTIVE_TRACE_FILE")):
+    if not (flags.get("CEPH_TPU_COLLECTIVE_TRACE") == "1"
+            or flags.get("CEPH_TPU_COLLECTIVE_TRACE_FILE")):
         return
     from ceph_tpu.analysis import interleave
 
@@ -368,8 +369,7 @@ def _kv_client():
 
 def _agree_timeout_s() -> float:
     try:
-        return float(os.environ.get("CEPH_TPU_MULTIHOST_AGREE_TIMEOUT_S",
-                                    "10"))
+        return flags.flag_float("CEPH_TPU_MULTIHOST_AGREE_TIMEOUT_S")
     except ValueError:
         return 10.0
 

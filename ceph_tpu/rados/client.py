@@ -16,6 +16,7 @@ import contextvars
 import logging
 from typing import Any, Dict, List, Optional, Tuple
 
+from ceph_tpu.common import flags
 from ceph_tpu.msg import Connection, Messenger
 from ceph_tpu.msg.messages import (
     MAuth,
@@ -94,6 +95,68 @@ class ObjectNotFound(RadosError):
     pass
 
 
+class ServiceTracker:
+    """Client half of dmClock delta/rho piggybacking (the dmclock
+    ServiceTracker role).
+
+    Per tenant it counts completions cluster-wide (all-phase and
+    reservation-phase); per (tenant, OSD) it remembers how many of
+    those happened at OTHER OSDs as of the tenant's last op there.
+    An outgoing MOSDOp to OSD s then carries
+
+        delta = 1 + other-OSD completions since the last op to s
+        rho   = 1 + other-OSD reservation completions since then
+
+    and s advances its mClock tags by delta x cost — so a tenant
+    spreading load over N primaries is charged at each for what the
+    other N-1 served, and its reservation/limit hold CLUSTER-wide
+    instead of N-times over.  With one OSD (or the piggyback off)
+    both collapse to 1: classic local mClock."""
+
+    #: bounded bookkeeping: (tenant, osd) rows beyond this are evicted
+    #: (their delta restarts at 1 — an under-charge for one op, not
+    #: an error)
+    STATE_CAP = 4096
+
+    def __init__(self):
+        # tenant -> [completions, reservation-phase completions]
+        self._done: Dict[str, List[int]] = {}
+        # (tenant, osd) -> [done_here, done_here_resv,
+        #                   seen_other, seen_other_resv]
+        self._srv: Dict[Tuple[str, int], List[int]] = {}
+
+    def obtain(self, tenant: str, osd: int) -> Tuple[int, int]:
+        """(delta, rho) for an op to `osd`; advances the per-server
+        marker (call once per send)."""
+        tot = self._done.setdefault(tenant, [0, 0])
+        st = self._srv.get((tenant, osd))
+        if st is None:
+            if len(self._srv) >= self.STATE_CAP:
+                # evict arbitrary rows; see STATE_CAP
+                for key in list(self._srv)[:self.STATE_CAP // 4]:
+                    del self._srv[key]
+            st = self._srv[(tenant, osd)] = [0, 0, 0, 0]
+        other = tot[0] - st[0]
+        other_resv = tot[1] - st[1]
+        delta = 1 + max(other - st[2], 0)
+        rho = 1 + max(other_resv - st[3], 0)
+        st[2], st[3] = other, other_resv
+        return delta, rho
+
+    def note_reply(self, tenant: str, osd: int, phase: str) -> None:
+        """Count a completed (scheduled) op: the reply's qos_phase
+        says which dmClock phase the grant won."""
+        tot = self._done.setdefault(tenant, [0, 0])
+        tot[0] += 1
+        st = self._srv.get((tenant, osd))
+        if st is None:
+            st = self._srv[(tenant, osd)] = [0, 0, 0, 0]
+        st[0] += 1
+        if phase == "reservation":
+            tot[1] += 1
+            st[1] += 1
+
+
 class RadosClient:
     def __init__(self, mon_addr, name: Optional[str] = None,
                  op_timeout: float = 10.0, max_retries: int = 30,
@@ -139,6 +202,9 @@ class RadosClient:
         import random as _random
 
         self._tid = _random.getrandbits(48)
+        # dmClock piggyback state (CEPH_TPU_DMCLOCK): shared across
+        # this client's ioctxs — delta/rho are per (tenant, OSD)
+        self.qos_tracker = ServiceTracker()
         self._futures: Dict[int, asyncio.Future] = {}
         self._map_waiters: List[asyncio.Event] = []
         self._placement_cache: Dict[Tuple[int, PgId], int] = {}
@@ -640,14 +706,20 @@ class IoCtx:
             fut: asyncio.Future = \
                 asyncio.get_running_loop().create_future()
             client._futures[tid] = fut
+            tenant = self.tenant or CURRENT_TENANT.get()
+            qos_delta = qos_rho = 1
+            if tenant and flags.enabled("CEPH_TPU_DMCLOCK"):
+                qos_delta, qos_rho = \
+                    client.qos_tracker.obtain(tenant, primary)
             try:
                 msg = MOSDOp(tid, client.msgr.entity_name, pg, oid,
                              ops, osdmap.epoch,
                              snapc_seq=self.snapc_seq,
                              snapc_snaps=self.snapc_snaps,
                              snap_id=self.read_snap,
-                             tenant=self.tenant
-                             or CURRENT_TENANT.get())
+                             tenant=tenant,
+                             qos_delta=qos_delta,
+                             qos_rho=qos_rho)
                 if span is not None:
                     # propagation follows the sampling decision: an
                     # unsampled ambient trace (gateway sampling off)
@@ -684,6 +756,12 @@ class IoCtx:
                 await client.wait_for_new_map(0.5)
                 await asyncio.sleep(0.05 + full_jitter(0.2, 0))
                 continue
+            if tenant and getattr(reply, "qos_phase", ""):
+                # a scheduled completion: feeds the NEXT op's
+                # delta/rho (EAGAIN bounces above never reached the
+                # scheduler and carry no phase)
+                client.qos_tracker.note_reply(
+                    tenant, primary, reply.qos_phase)
             return reply
         raise RadosError(EAGAIN, f"op on {oid!r} exhausted retries"
                                  f" ({last_error!r})")

@@ -26,6 +26,8 @@ import hashlib
 import hmac
 import logging
 import os
+
+from ceph_tpu.common import flags
 import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import Dict, List, Optional, Tuple
@@ -170,8 +172,8 @@ class S3Frontend:
         from ceph_tpu.common import tracing
 
         try:
-            rate = float(os.environ.get(
-                "CEPH_TPU_RGW_TRACE_SAMPLE", "1.0"))
+            rate = flags.flag_float(
+                "CEPH_TPU_RGW_TRACE_SAMPLE")
         except ValueError:
             rate = 1.0
         # the gateway has no admin socket: `frontend.tracer.dump()` is

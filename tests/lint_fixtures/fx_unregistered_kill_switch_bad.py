@@ -1,0 +1,29 @@
+"""Seeded violations: raw os.environ access with CEPH_TPU_* literal
+keys outside the kill-switch registry."""
+
+import os
+from os import environ
+
+
+def read_toggle():
+    return os.environ.get("CEPH_TPU_FROB", "1") != "0"  # expect: unregistered-kill-switch
+
+
+def read_getenv():
+    return os.getenv("CEPH_TPU_FROB_LEVEL", "2")  # expect: unregistered-kill-switch
+
+
+def read_subscript():
+    return os.environ["CEPH_TPU_FROB_MODE"]  # expect: unregistered-kill-switch
+
+
+def write_subscript(value):
+    os.environ["CEPH_TPU_FROB"] = value  # expect: unregistered-kill-switch
+
+
+def probe_membership():
+    return "CEPH_TPU_FROB" in os.environ  # expect: unregistered-kill-switch
+
+
+def pop_from_imported():
+    return environ.pop("CEPH_TPU_FROB", None)  # expect: unregistered-kill-switch

@@ -1,0 +1,31 @@
+"""Clean twin: flag access through the registry, plus environ uses
+the rule must NOT flag (non-CEPH_TPU keys, dynamic keys, whole-dict
+copies)."""
+
+import os
+
+from ceph_tpu.common import flags
+
+
+def read_through_registry():
+    return flags.enabled("CEPH_TPU_FROB")
+
+
+def numeric_through_registry():
+    return flags.flag_float("CEPH_TPU_FROB_LEVEL", 2.0)
+
+
+def write_through_registry(value):
+    flags.set_flag("CEPH_TPU_FROB", value)
+
+
+def foreign_key():
+    return os.environ.get("XLA_FLAGS", "")
+
+
+def dynamic_key(name):
+    return os.environ.get(name)
+
+
+def whole_dict():
+    return dict(os.environ)

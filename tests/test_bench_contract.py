@@ -24,7 +24,7 @@ CONTRACT_KEYS = {"metric", "value", "unit", "vs_baseline",
                  "device_health", "tail", "load", "durability",
                  "mesh", "multihost", "trace", "group_commit",
                  "compute", "xsched", "spmd", "repair", "inference",
-                 "truncated"}
+                 "chaos", "truncated"}
 
 
 def test_contract_line_despite_hanging_backend(tmp_path):
@@ -209,6 +209,18 @@ def test_contract_line_despite_hanging_backend(tmp_path):
     assert inf["straggler_within_budget"] == 1
     assert inf["substituted_streams"] >= 1
     assert inf["cancelled_subinfers"] >= 1
+    # the compound-chaos probe ran: a seeded composed 3-hazard
+    # scenario (stragglers x device faults x kill-switch flips) over
+    # live two-tenant traffic with every invariant monitor armed —
+    # zero violations, zero client errors, reads verified bit-exact,
+    # the seed echoed so a red round replays from the contract line
+    ch = contract["chaos"]
+    assert ch["violations"] == 0
+    assert ch["errors"] == 0
+    assert ch["seed"] == 20107
+    assert ch["events_fired"] >= 2
+    assert ch["reads_verified"] >= 1
+    assert ch["flag_flips"] >= 1
     assert contract["truncated"] is False
     # details stayed out of stdout (they belong in bench_details.json)
     assert len(stdout_lines) == 1
@@ -279,6 +291,11 @@ def test_budget_truncates_optional_sections(tmp_path):
     # contract key is pre-contract and still rides, budget permitting)
     assert "inference" in details["skipped_sections"]
     assert "inference_modes" not in details
+    # the full chaos matrix is smoke-gated (like qos/durability), so
+    # a budget-0 smoke run skips the section body without recording
+    # it — but the pre-contract chaos probe key must NOT ride when
+    # the budget is already spent
+    assert "chaos_violations" not in details
 
 
 def test_watchdog_contract_line_survives_outer_kill(tmp_path):

@@ -37,6 +37,7 @@ RULES = [
     "unbounded-latency-buffer",
     "unbudgeted-approx-result",
     "commit-before-durability",
+    "unregistered-kill-switch",
     "async-blocking",
     "sync-encode-in-async",
     "lock-order",
